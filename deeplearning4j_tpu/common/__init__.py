@@ -1,0 +1,2 @@
+from deeplearning4j_tpu.common.dtypes import DataType  # noqa: F401
+from deeplearning4j_tpu.common.environment import Environment  # noqa: F401

@@ -1,0 +1,84 @@
+"""Data-type system.
+
+Reference parity: nd4j's ``org.nd4j.linalg.api.buffer.DataType`` (the dtype
+enum used across INDArray / ops / serialization). TPU-first notes: BFLOAT16
+is a first-class training dtype here (the MXU's native input type), where the
+reference treated HALF as the reduced-precision citizen.
+"""
+from __future__ import annotations
+
+import enum
+
+import jax.numpy as jnp
+import numpy as np
+
+
+class DataType(enum.Enum):
+    """Mirrors the reference dtype enum, mapped onto jnp dtypes."""
+
+    DOUBLE = "float64"
+    FLOAT = "float32"
+    HALF = "float16"
+    BFLOAT16 = "bfloat16"
+    LONG = "int64"
+    INT = "int32"
+    SHORT = "int16"
+    BYTE = "int8"
+    UBYTE = "uint8"
+    UINT16 = "uint16"
+    UINT32 = "uint32"
+    UINT64 = "uint64"
+    BOOL = "bool"
+    UTF8 = "utf8"  # not a tensor dtype on TPU; kept for API parity
+
+    # ------------------------------------------------------------------
+    @property
+    def jnp(self):
+        if self is DataType.UTF8:
+            raise TypeError("UTF8 is not a numeric dtype")
+        return jnp.dtype(self.value)
+
+    @property
+    def np(self):
+        if self is DataType.UTF8:
+            return np.dtype(object)
+        return np.dtype(self.value)
+
+    def is_fp(self) -> bool:
+        return self in (DataType.DOUBLE, DataType.FLOAT, DataType.HALF,
+                        DataType.BFLOAT16)
+
+    def is_int(self) -> bool:
+        return self in (DataType.LONG, DataType.INT, DataType.SHORT,
+                        DataType.BYTE, DataType.UBYTE, DataType.UINT16,
+                        DataType.UINT32, DataType.UINT64)
+
+    def width(self) -> int:
+        """Bytes per element."""
+        if self is DataType.UTF8:
+            return 0
+        return self.np.itemsize
+
+    # ------------------------------------------------------------------
+    @staticmethod
+    def from_any(x) -> "DataType":
+        if isinstance(x, DataType):
+            return x
+        if isinstance(x, str):
+            try:
+                return DataType[x.upper()]
+            except KeyError:
+                pass
+            x = np.dtype(x)
+        d = np.dtype(jnp.dtype(x).name) if not isinstance(x, np.dtype) else x
+        for dt in DataType:
+            if dt is not DataType.UTF8 and dt.np == d:
+                return dt
+        raise ValueError(f"No DataType for {x!r}")
+
+
+def to_jnp_dtype(x):
+    """Coerce DataType | str | np/jnp dtype to a jnp dtype."""
+    if isinstance(x, DataType):
+        return x.jnp
+    return jnp.dtype(x)

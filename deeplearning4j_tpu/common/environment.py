@@ -1,0 +1,71 @@
+"""Global runtime flags facade.
+
+Reference parity: ``org.nd4j.linalg.factory.Nd4j.getEnvironment()`` backed by
+libnd4j's native ``Environment`` (include/system/Environment.h) plus the
+``ND4JSystemProperties`` / ``ND4JEnvironmentVars`` flag surface (SURVEY.md
+section 5.6). On TPU the native knobs become XLA/libtpu options; this facade
+keeps one place for debug/verbose/profiling toggles and maps what it can onto
+jax config.
+"""
+from __future__ import annotations
+
+import os
+import threading
+from dataclasses import dataclass, field
+
+
+@dataclass
+class _Env:
+    debug: bool = False
+    verbose: bool = False
+    profiling: bool = False
+    check_for_nan: bool = False
+    check_for_inf: bool = False
+    allow_helpers: bool = True          # reference: cuDNN/oneDNN enablement
+    default_float_dtype: str = "float32"
+    # TPU-specific: matmul precision for f32 ops ('default'|'high'|'highest')
+    matmul_precision: str = "default"
+    extra: dict = field(default_factory=dict)
+
+    def set_debug(self, v: bool):
+        self.debug = bool(v)
+
+    def set_verbose(self, v: bool):
+        self.verbose = bool(v)
+
+    def set_profiling(self, v: bool):
+        self.profiling = bool(v)
+
+
+class Environment:
+    """Process-wide singleton, env-var seeded.
+
+    Env vars (analogue of ND4JEnvironmentVars):
+      DL4J_TPU_DEBUG, DL4J_TPU_VERBOSE, DL4J_TPU_PROFILING,
+      DL4J_TPU_CHECK_NAN, DL4J_TPU_CHECK_INF, DL4J_TPU_ALLOW_HELPERS
+    """
+
+    _inst: _Env | None = None
+    _lock = threading.Lock()
+
+    @classmethod
+    def get(cls) -> _Env:
+        with cls._lock:
+            if cls._inst is None:
+                def b(name, dflt=False):
+                    return os.environ.get(name, str(int(dflt))) in (
+                        "1", "true", "True", "yes")
+                cls._inst = _Env(
+                    debug=b("DL4J_TPU_DEBUG"),
+                    verbose=b("DL4J_TPU_VERBOSE"),
+                    profiling=b("DL4J_TPU_PROFILING"),
+                    check_for_nan=b("DL4J_TPU_CHECK_NAN"),
+                    check_for_inf=b("DL4J_TPU_CHECK_INF"),
+                    allow_helpers=b("DL4J_TPU_ALLOW_HELPERS", True),
+                )
+            return cls._inst
+
+    @classmethod
+    def reset(cls):
+        with cls._lock:
+            cls._inst = None

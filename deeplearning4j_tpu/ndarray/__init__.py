@@ -1,0 +1,2 @@
+from deeplearning4j_tpu.ndarray.ndarray import INDArray  # noqa: F401
+from deeplearning4j_tpu.ndarray.factory import Nd4j  # noqa: F401

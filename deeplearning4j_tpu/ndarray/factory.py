@@ -1,0 +1,232 @@
+"""Nd4j: the static ndarray factory.
+
+Reference parity: ``org.nd4j.linalg.factory.Nd4j`` (SURVEY.md J1) plus the
+RNG surface of ``org.nd4j.linalg.api.rng`` (J12). TPU-first: randomness uses
+JAX's splittable threefry keys behind a stateful facade (the reference keeps
+stateful Philox streams; we expose the same ``get_random().set_seed`` API but
+derive a fresh split per call, which is the idiomatic XLA-safe design).
+"""
+from __future__ import annotations
+
+from typing import Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from deeplearning4j_tpu.common.dtypes import DataType, to_jnp_dtype
+from deeplearning4j_tpu.ndarray.ndarray import INDArray, _unwrap
+
+
+class _Random:
+    """Stateful facade over splittable JAX PRNG keys (reference: Nd4j RNG)."""
+
+    def __init__(self, seed: int = 0):
+        self._key = jax.random.PRNGKey(seed)
+        self._seed = seed
+
+    def set_seed(self, seed: int):
+        self._key = jax.random.PRNGKey(int(seed))
+        self._seed = int(seed)
+
+    def get_seed(self) -> int:
+        return self._seed
+
+    def next_key(self) -> jax.Array:
+        self._key, sub = jax.random.split(self._key)
+        return sub
+
+
+_random = _Random(0)
+
+
+def _shape(args) -> tuple[int, ...]:
+    if len(args) == 1 and isinstance(args[0], (tuple, list)):
+        return tuple(int(s) for s in args[0])
+    return tuple(int(s) for s in args)
+
+
+class Nd4j:
+    """Static factory — mirrors the reference's ``Nd4j`` entry point."""
+
+    # -- RNG ------------------------------------------------------------
+    @staticmethod
+    def get_random() -> _Random:
+        return _random
+
+    # -- creation -------------------------------------------------------
+    @staticmethod
+    def create(data=None, *shape, dtype=None) -> INDArray:
+        if data is None:
+            return Nd4j.zeros(*shape, dtype=dtype)
+        if isinstance(data, (int,)) and not shape:
+            # Nd4j.create(n) -> zero vector of length n (reference behavior)
+            return Nd4j.zeros(data, dtype=dtype)
+        arr = jnp.asarray(_unwrap(data))
+        if shape:
+            arr = arr.reshape(_shape(shape))
+        if dtype is not None:
+            arr = arr.astype(to_jnp_dtype(dtype))
+        return INDArray(arr)
+
+    @staticmethod
+    def zeros(*shape, dtype=None) -> INDArray:
+        return INDArray(jnp.zeros(_shape(shape),
+                                  to_jnp_dtype(dtype or "float32")))
+
+    @staticmethod
+    def ones(*shape, dtype=None) -> INDArray:
+        return INDArray(jnp.ones(_shape(shape),
+                                 to_jnp_dtype(dtype or "float32")))
+
+    @staticmethod
+    def zeros_like(a) -> INDArray:
+        return INDArray(jnp.zeros_like(_unwrap(a)))
+
+    @staticmethod
+    def ones_like(a) -> INDArray:
+        return INDArray(jnp.ones_like(_unwrap(a)))
+
+    @staticmethod
+    def value_array_of(shape, value, dtype=None) -> INDArray:
+        return INDArray(jnp.full(_shape([shape]) if isinstance(
+            shape, (tuple, list)) else (int(shape),), value,
+            to_jnp_dtype(dtype or "float32")))
+
+    @staticmethod
+    def scalar(value, dtype=None) -> INDArray:
+        return INDArray(jnp.asarray(value, to_jnp_dtype(dtype)
+                                    if dtype else None))
+
+    @staticmethod
+    def eye(n: int, dtype=None) -> INDArray:
+        return INDArray(jnp.eye(n, dtype=to_jnp_dtype(dtype or "float32")))
+
+    @staticmethod
+    def arange(*args, dtype=None) -> INDArray:
+        return INDArray(jnp.arange(*args,
+                                   dtype=to_jnp_dtype(dtype) if dtype else None))
+
+    @staticmethod
+    def linspace(start, stop, num, dtype=None) -> INDArray:
+        return INDArray(jnp.linspace(start, stop, int(num),
+                                     dtype=to_jnp_dtype(dtype or "float32")))
+
+    # -- random ---------------------------------------------------------
+    @staticmethod
+    def rand(*shape, dtype=None) -> INDArray:
+        return INDArray(jax.random.uniform(
+            _random.next_key(), _shape(shape),
+            to_jnp_dtype(dtype or "float32")))
+
+    @staticmethod
+    def randn(*shape, dtype=None) -> INDArray:
+        return INDArray(jax.random.normal(
+            _random.next_key(), _shape(shape),
+            to_jnp_dtype(dtype or "float32")))
+
+    @staticmethod
+    def rand_int(maxval, *shape) -> INDArray:
+        return INDArray(jax.random.randint(
+            _random.next_key(), _shape(shape), 0, int(maxval),
+            dtype=jnp.int32))
+
+    @staticmethod
+    def bernoulli(p, *shape) -> INDArray:
+        return INDArray(jax.random.bernoulli(
+            _random.next_key(), p, _shape(shape)))
+
+    @staticmethod
+    def shuffle(a: INDArray) -> INDArray:
+        perm = jax.random.permutation(_random.next_key(), a.shape[0])
+        a._write(a.data[perm])
+        return a
+
+    # -- combining ------------------------------------------------------
+    @staticmethod
+    def concat(dim: int, *arrays) -> INDArray:
+        return INDArray(jnp.concatenate([jnp.asarray(_unwrap(a))
+                                         for a in arrays], axis=dim))
+
+    @staticmethod
+    def stack(dim: int, *arrays) -> INDArray:
+        return INDArray(jnp.stack([jnp.asarray(_unwrap(a))
+                                   for a in arrays], axis=dim))
+
+    @staticmethod
+    def vstack(*arrays) -> INDArray:
+        return INDArray(jnp.vstack([jnp.asarray(_unwrap(a))
+                                    for a in arrays]))
+
+    @staticmethod
+    def hstack(*arrays) -> INDArray:
+        return INDArray(jnp.hstack([jnp.asarray(_unwrap(a))
+                                    for a in arrays]))
+
+    @staticmethod
+    def pile(*arrays) -> INDArray:
+        return Nd4j.stack(0, *arrays)
+
+    @staticmethod
+    def tile(a, *reps) -> INDArray:
+        return INDArray(jnp.tile(jnp.asarray(_unwrap(a)), _shape(reps)))
+
+    # -- linalg / misc ---------------------------------------------------
+    @staticmethod
+    def gemm(a, b, transpose_a=False, transpose_b=False,
+             alpha=1.0, beta=0.0, c=None) -> INDArray:
+        A = jnp.asarray(_unwrap(a))
+        B = jnp.asarray(_unwrap(b))
+        if transpose_a:
+            A = A.T
+        if transpose_b:
+            B = B.T
+        out = alpha * (A @ B)
+        if c is not None and beta != 0.0:
+            out = out + beta * jnp.asarray(_unwrap(c))
+        return INDArray(out)
+
+    @staticmethod
+    def matmul(a, b) -> INDArray:
+        return INDArray(jnp.matmul(jnp.asarray(_unwrap(a)),
+                                   jnp.asarray(_unwrap(b))))
+
+    @staticmethod
+    def diag(a) -> INDArray:
+        return INDArray(jnp.diag(jnp.asarray(_unwrap(a))))
+
+    @staticmethod
+    def sort(a, dim: int = -1, ascending: bool = True) -> INDArray:
+        out = jnp.sort(jnp.asarray(_unwrap(a)), axis=dim)
+        if not ascending:
+            out = jnp.flip(out, axis=dim)
+        return INDArray(out)
+
+    @staticmethod
+    def argsort(a, dim: int = -1) -> INDArray:
+        return INDArray(jnp.argsort(jnp.asarray(_unwrap(a)), axis=dim))
+
+    @staticmethod
+    def where(cond, x, y) -> INDArray:
+        return INDArray(jnp.where(jnp.asarray(_unwrap(cond)),
+                                  jnp.asarray(_unwrap(x)),
+                                  jnp.asarray(_unwrap(y))))
+
+    @staticmethod
+    def pad(a, pad_width, mode="constant", constant_values=0) -> INDArray:
+        return INDArray(jnp.pad(jnp.asarray(_unwrap(a)), pad_width,
+                                mode=mode,
+                                **({"constant_values": constant_values}
+                                   if mode == "constant" else {})))
+
+    @staticmethod
+    def one_hot(indices, depth: int, dtype=None) -> INDArray:
+        return INDArray(jax.nn.one_hot(jnp.asarray(_unwrap(indices)),
+                                       depth,
+                                       dtype=to_jnp_dtype(dtype or "float32")))
+
+    @staticmethod
+    def to_flattened(*arrays) -> INDArray:
+        """Flatten+concat — the reference's param-view serialization order."""
+        return INDArray(jnp.concatenate(
+            [jnp.asarray(_unwrap(a)).reshape(-1) for a in arrays]))

@@ -1,0 +1,455 @@
+"""INDArray: a mutable-facade ndarray over an immutable XLA substrate.
+
+Reference parity: ``org.nd4j.linalg.api.ndarray.INDArray`` /
+``BaseNDArray`` (SURVEY.md J1) — the reference API is deeply in-place
+(``subi``/``addi``, views aliasing parent buffers). SURVEY.md section 7 ranks
+reproducing those semantics on a functional substrate as hard part #1; the
+design chosen here:
+
+- A *base* array owns ``_data`` (a jax array). In-place methods compute a new
+  functional value and **rebind** ``_data`` — O(1) bookkeeping, XLA reuses
+  buffers via donation when jitted.
+- A *view* holds ``(_parent, _index)`` and no buffer. Reads re-slice the
+  parent lazily (an XLA slice, fused under jit); in-place writes write
+  through with ``parent.at[index].set(...)``, recursing to the base. This
+  reproduces DL4J's aliasing: mutate the view, the parent sees it — and vice
+  versa — without a mutable buffer anywhere.
+- Documented divergence: ``reshape``/``transpose``/``broadcast`` return
+  fresh base arrays (the reference sometimes returns strided views). Aliasing
+  is guaranteed only for basic-indexing views (``__getitem__``, ``get_row``,
+  ``slice_view``...), which covers the reference's dominant uses (param/grad
+  views, row/column updates).
+
+Every op funnels through :class:`OpExecutioner` for profiling/NaN-panic
+parity with ``DefaultOpExecutioner``.
+"""
+from __future__ import annotations
+
+from typing import Any, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from deeplearning4j_tpu.common.dtypes import DataType, to_jnp_dtype
+from deeplearning4j_tpu.ops.executioner import OpExecutioner
+
+_exec = OpExecutioner.exec
+
+
+def _unwrap(x):
+    if isinstance(x, INDArray):
+        return x.data
+    return x
+
+
+class INDArray:
+    """Dense tensor facade. See module docstring for the aliasing model."""
+
+    __slots__ = ("_data", "_parent", "_index")
+    __array_priority__ = 100  # beat numpy in mixed dunder dispatch
+
+    def __init__(self, data=None, *, _parent: "INDArray | None" = None,
+                 _index=None):
+        if _parent is not None:
+            self._parent = _parent
+            self._index = _index
+            self._data = None
+        else:
+            self._parent = None
+            self._index = None
+            self._data = jnp.asarray(data)
+
+    # -- buffer plumbing ------------------------------------------------
+    @property
+    def is_view(self) -> bool:
+        return self._parent is not None
+
+    @property
+    def data(self) -> jax.Array:
+        """The current functional value (jax array)."""
+        if self._parent is not None:
+            return self._parent.data[self._index]
+        return self._data
+
+    def _write(self, value: jax.Array):
+        """Rebind (base) or write-through (view)."""
+        if self._parent is not None:
+            parent_val = self._parent.data
+            new_parent = parent_val.at[self._index].set(
+                jnp.asarray(value, parent_val.dtype))
+            self._parent._write(new_parent)
+        else:
+            self._data = jnp.asarray(value)
+
+    # -- basic properties ----------------------------------------------
+    @property
+    def shape(self) -> tuple[int, ...]:
+        return tuple(self.data.shape)
+
+    @property
+    def rank(self) -> int:
+        return self.data.ndim
+
+    @property
+    def ndim(self) -> int:
+        return self.data.ndim
+
+    def length(self) -> int:
+        return int(np.prod(self.shape)) if self.shape else 1
+
+    def size(self, dim: int) -> int:
+        return self.shape[dim]
+
+    def data_type(self) -> DataType:
+        return DataType.from_any(self.data.dtype)
+
+    @property
+    def dtype(self):
+        return self.data.dtype
+
+    def is_scalar(self) -> bool:
+        return self.data.ndim == 0 or self.length() == 1
+
+    def is_vector(self) -> bool:
+        s = [d for d in self.shape if d != 1]
+        return self.rank <= 2 and len(s) <= 1
+
+    def is_matrix(self) -> bool:
+        return self.rank == 2
+
+    def is_empty(self) -> bool:
+        return self.length() == 0
+
+    def rows(self) -> int:
+        return self.shape[0]
+
+    def columns(self) -> int:
+        return self.shape[1]
+
+    # -- conversion -----------------------------------------------------
+    def jax(self) -> jax.Array:
+        return self.data
+
+    def to_numpy(self) -> np.ndarray:
+        return np.asarray(self.data)
+
+    def __array__(self, dtype=None):
+        a = np.asarray(self.data)
+        return a.astype(dtype) if dtype is not None else a
+
+    def get_double(self, *idx) -> float:
+        return float(self.data[tuple(idx)] if idx else self.data.reshape(-1)[0])
+
+    def get_int(self, *idx) -> int:
+        return int(self.data[tuple(idx)] if idx else self.data.reshape(-1)[0])
+
+    def item(self):
+        return self.data.reshape(()).item() if self.length() == 1 else \
+            self.to_numpy()
+
+    def cast_to(self, dtype) -> "INDArray":
+        return INDArray(self.data.astype(to_jnp_dtype(dtype)))
+
+    def astype(self, dtype) -> "INDArray":
+        return self.cast_to(dtype)
+
+    # -- copies / assignment --------------------------------------------
+    def dup(self) -> "INDArray":
+        return INDArray(self.data)
+
+    def assign(self, other) -> "INDArray":
+        val = jnp.broadcast_to(jnp.asarray(_unwrap(other), self.dtype),
+                               self.shape)
+        self._write(val)
+        return self
+
+    def put_scalar(self, idx, value) -> "INDArray":
+        if not isinstance(idx, (tuple, list)):
+            idx = (idx,)
+        self._write(self.data.at[tuple(int(i) for i in idx)].set(value))
+        return self
+
+    def put(self, idx, value) -> "INDArray":
+        self._write(self.data.at[idx].set(jnp.asarray(_unwrap(value))))
+        return self
+
+    # -- views ----------------------------------------------------------
+    def __getitem__(self, idx) -> "INDArray":
+        return INDArray(_parent=self, _index=idx)
+
+    def __setitem__(self, idx, value):
+        self._write(self.data.at[idx].set(jnp.asarray(_unwrap(value))))
+
+    def get_row(self, i: int) -> "INDArray":
+        return self[i]
+
+    def get_column(self, j: int) -> "INDArray":
+        return self[:, j]
+
+    def get_rows(self, rows: Sequence[int]) -> "INDArray":
+        return INDArray(self.data[jnp.asarray(list(rows))])
+
+    def get_columns(self, cols: Sequence[int]) -> "INDArray":
+        return INDArray(self.data[:, jnp.asarray(list(cols))])
+
+    def slice_view(self, i: int, dim: int = 0) -> "INDArray":
+        idx = (slice(None),) * dim + (i,)
+        return INDArray(_parent=self, _index=idx)
+
+    def tensor_along_dimension(self, i: int, *dims: int) -> "INDArray":
+        """TAD (SURVEY.md N2): the i-th sub-tensor spanning ``dims``."""
+        dims = sorted(d % self.rank for d in dims)
+        other = [d for d in range(self.rank) if d not in dims]
+        # index i enumerates the coordinates over `other` dims, C-order
+        osh = [self.shape[d] for d in other]
+        coords = np.unravel_index(i, osh) if osh else ()
+        idx: list[Any] = [slice(None)] * self.rank
+        for d, c in zip(other, coords):
+            idx[d] = int(c)
+        return INDArray(_parent=self, _index=tuple(idx))
+
+    def tensors_along_dimension(self, *dims: int) -> int:
+        dims_ = sorted(d % self.rank for d in dims)
+        other = [d for d in range(self.rank) if d not in dims_]
+        return int(np.prod([self.shape[d] for d in other])) if other else 1
+
+    # -- shape ops (return fresh base arrays; documented divergence) ----
+    def reshape(self, *shape) -> "INDArray":
+        if len(shape) == 1 and isinstance(shape[0], (tuple, list)):
+            shape = tuple(shape[0])
+        return INDArray(_exec("reshape", jnp.reshape, self.data,
+                              tuple(int(s) for s in shape)))
+
+    def ravel(self) -> "INDArray":
+        return self.reshape(-1)
+
+    def flatten(self) -> "INDArray":
+        return self.reshape(-1)
+
+    def transpose(self, *axes) -> "INDArray":
+        axes = axes or None
+        if axes and len(axes) == 1 and isinstance(axes[0], (tuple, list)):
+            axes = tuple(axes[0])
+        return INDArray(_exec("transpose", jnp.transpose, self.data, axes))
+
+    def permute(self, *axes) -> "INDArray":
+        return self.transpose(*axes)
+
+    def swap_axes(self, a: int, b: int) -> "INDArray":
+        return INDArray(jnp.swapaxes(self.data, a, b))
+
+    def broadcast(self, *shape) -> "INDArray":
+        if len(shape) == 1 and isinstance(shape[0], (tuple, list)):
+            shape = tuple(shape[0])
+        return INDArray(jnp.broadcast_to(self.data, shape))
+
+    def repeat(self, repeats, axis=None) -> "INDArray":
+        return INDArray(jnp.repeat(self.data, repeats, axis=axis))
+
+    # -- elementwise math ------------------------------------------------
+    def _bin(self, name, fn, other, inplace: bool):
+        out = _exec(name, fn, self.data, jnp.asarray(_unwrap(other)))
+        if inplace:
+            # in-place ops cannot change the buffer dtype (mutable-buffer
+            # semantics): cast the result back, as the reference would
+            self._write(out.astype(self.dtype))
+            return self
+        return INDArray(out)
+
+    def add(self, o): return self._bin("add", jnp.add, o, False)
+    def addi(self, o): return self._bin("add", jnp.add, o, True)
+    def sub(self, o): return self._bin("sub", jnp.subtract, o, False)
+    def subi(self, o): return self._bin("sub", jnp.subtract, o, True)
+    def mul(self, o): return self._bin("mul", jnp.multiply, o, False)
+    def muli(self, o): return self._bin("mul", jnp.multiply, o, True)
+    def div(self, o): return self._bin("div", jnp.divide, o, False)
+    def divi(self, o): return self._bin("div", jnp.divide, o, True)
+
+    def _rbin(self, name, fn, other, inplace: bool):
+        out = _exec(name, fn, jnp.asarray(_unwrap(other)), self.data)
+        if inplace:
+            self._write(out.astype(self.dtype))
+            return self
+        return INDArray(out)
+
+    def rsub(self, o): return self._rbin("rsub", jnp.subtract, o, False)
+    def rsubi(self, o): return self._rbin("rsub", jnp.subtract, o, True)
+    def rdiv(self, o): return self._rbin("rdiv", jnp.divide, o, False)
+    def rdivi(self, o): return self._rbin("rdiv", jnp.divide, o, True)
+
+    def neg(self):
+        return INDArray(_exec("neg", jnp.negative, self.data))
+
+    def negi(self):
+        self._write(_exec("neg", jnp.negative, self.data))
+        return self
+
+    def fmod(self, o): return self._bin("fmod", jnp.fmod, o, False)
+
+    # -- matrix ops -------------------------------------------------------
+    def mmul(self, other) -> "INDArray":
+        return INDArray(_exec("mmul", jnp.matmul, self.data,
+                              jnp.asarray(_unwrap(other))))
+
+    def mmuli(self, other) -> "INDArray":
+        self._write(_exec("mmul", jnp.matmul, self.data,
+                          jnp.asarray(_unwrap(other))))
+        return self
+
+    def dot(self, other) -> float:
+        return float(jnp.vdot(self.data, jnp.asarray(_unwrap(other))))
+
+    # -- python dunders ---------------------------------------------------
+    def __add__(self, o): return self.add(o)
+    def __radd__(self, o): return self.add(o)
+    def __sub__(self, o): return self.sub(o)
+    def __rsub__(self, o): return self.rsub(o)
+    def __mul__(self, o): return self.mul(o)
+    def __rmul__(self, o): return self.mul(o)
+    def __truediv__(self, o): return self.div(o)
+    def __rtruediv__(self, o): return self.rdiv(o)
+    def __matmul__(self, o): return self.mmul(o)
+    def __neg__(self): return self.neg()
+    def __pow__(self, o): return self._bin("pow", jnp.power, o, False)
+    def __abs__(self): return INDArray(_exec("abs", jnp.abs, self.data))
+
+    def __bool__(self):
+        # numpy-style: truth of a multi-element array is ambiguous.
+        # Without this, Python falls back to __len__ and `if a == b:`
+        # silently answers True for any non-empty comparison result.
+        if self.length() != 1:
+            raise ValueError(
+                "The truth value of an INDArray with more than one element "
+                "is ambiguous. Use .any()/.all()/.equals().")
+        return bool(self.data.reshape(()))
+
+    def any(self) -> bool:
+        return bool(jnp.any(self.data))
+
+    def all(self) -> bool:
+        return bool(jnp.all(self.data))
+
+    def __iadd__(self, o): return self.addi(o)
+    def __isub__(self, o): return self.subi(o)
+    def __imul__(self, o): return self.muli(o)
+    def __itruediv__(self, o): return self.divi(o)
+
+    # -- comparisons (bool arrays, reference eq/neq/gt/lt) ---------------
+    def eq(self, o): return self._bin("eq", jnp.equal, o, False)
+    def neq(self, o): return self._bin("neq", jnp.not_equal, o, False)
+    def gt(self, o): return self._bin("gt", jnp.greater, o, False)
+    def gte(self, o): return self._bin("gte", jnp.greater_equal, o, False)
+    def lt(self, o): return self._bin("lt", jnp.less, o, False)
+    def lte(self, o): return self._bin("lte", jnp.less_equal, o, False)
+
+    def __eq__(self, o):  # array-valued, like the reference's eq()
+        return self.eq(o)
+
+    def __ne__(self, o):
+        return self.neq(o)
+
+    def __lt__(self, o): return self.lt(o)
+    def __le__(self, o): return self.lte(o)
+    def __gt__(self, o): return self.gt(o)
+    def __ge__(self, o): return self.gte(o)
+
+    def __hash__(self):
+        return id(self)
+
+    def equals(self, other, eps: float = 1e-5) -> bool:
+        other = _unwrap(other)
+        if tuple(jnp.shape(other)) != self.shape:
+            return False
+        if jnp.issubdtype(self.dtype, jnp.floating):
+            return bool(jnp.allclose(self.data, other, atol=eps))
+        return bool((self.data == other).all())
+
+    def equal_shapes(self, other: "INDArray") -> bool:
+        return self.shape == other.shape
+
+    # -- reductions -------------------------------------------------------
+    def _red(self, name, fn, dims, keep_dims=False, **kw):
+        axis = None
+        if dims:
+            axis = tuple(d % self.rank for d in dims)
+        out = _exec(name, fn, self.data, axis=axis, keepdims=keep_dims, **kw)
+        return INDArray(out)
+
+    def sum(self, *dims, keep_dims=False):
+        return self._red("reduce_sum", jnp.sum, dims, keep_dims)
+
+    def mean(self, *dims, keep_dims=False):
+        return self._red("reduce_mean", jnp.mean, dims, keep_dims)
+
+    def max(self, *dims, keep_dims=False):
+        return self._red("reduce_max", jnp.max, dims, keep_dims)
+
+    def min(self, *dims, keep_dims=False):
+        return self._red("reduce_min", jnp.min, dims, keep_dims)
+
+    def prod(self, *dims, keep_dims=False):
+        return self._red("reduce_prod", jnp.prod, dims, keep_dims)
+
+    def std(self, *dims, bias_corrected=True, keep_dims=False):
+        return self._red("reduce_std", jnp.std, dims, keep_dims,
+                         ddof=1 if bias_corrected else 0)
+
+    def var(self, *dims, bias_corrected=True, keep_dims=False):
+        return self._red("reduce_var", jnp.var, dims, keep_dims,
+                         ddof=1 if bias_corrected else 0)
+
+    def norm1(self, *dims, keep_dims=False):
+        return self._red("reduce_norm1", lambda x, axis, keepdims:
+                         jnp.sum(jnp.abs(x), axis=axis, keepdims=keepdims),
+                         dims, keep_dims)
+
+    def norm2(self, *dims, keep_dims=False):
+        return self._red("reduce_norm2", lambda x, axis, keepdims:
+                         jnp.sqrt(jnp.sum(x * x, axis=axis,
+                                          keepdims=keepdims)),
+                         dims, keep_dims)
+
+    def norm_max(self, *dims, keep_dims=False):
+        return self._red("reduce_normmax", lambda x, axis, keepdims:
+                         jnp.max(jnp.abs(x), axis=axis, keepdims=keepdims),
+                         dims, keep_dims)
+
+    def argmax(self, *dims) -> "INDArray":
+        axis = dims[0] if dims else None
+        return INDArray(_exec("argmax", jnp.argmax, self.data, axis=axis))
+
+    def argmin(self, *dims) -> "INDArray":
+        axis = dims[0] if dims else None
+        return INDArray(_exec("argmin", jnp.argmin, self.data, axis=axis))
+
+    def cumsum(self, dim: int = 0) -> "INDArray":
+        return INDArray(_exec("cumsum", jnp.cumsum, self.data, axis=dim))
+
+    def sum_number(self) -> float:
+        return float(jnp.sum(self.data))
+
+    def mean_number(self) -> float:
+        return float(jnp.mean(self.data))
+
+    def max_number(self) -> float:
+        return float(jnp.max(self.data))
+
+    def min_number(self) -> float:
+        return float(jnp.min(self.data))
+
+    # -- misc -------------------------------------------------------------
+    def where(self, cond, other) -> "INDArray":
+        return INDArray(jnp.where(jnp.asarray(_unwrap(cond)), self.data,
+                                  jnp.asarray(_unwrap(other))))
+
+    def __len__(self):
+        return self.shape[0] if self.shape else 1
+
+    def __repr__(self):
+        kind = "view" if self.is_view else "base"
+        return (f"INDArray({kind}, shape={self.shape}, "
+                f"dtype={self.data_type().name},\n{np.asarray(self.data)})")
+
+    def __str__(self):
+        return str(np.asarray(self.data))

@@ -1,0 +1,1 @@
+from deeplearning4j_tpu.ops.executioner import OpExecutioner, OpProfiler, ProfilerConfig  # noqa: F401

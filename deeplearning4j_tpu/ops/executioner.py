@@ -1,0 +1,135 @@
+"""Op execution funnel: profiling hooks + NaN/Inf panic.
+
+Reference parity: ``org.nd4j.linalg.api.ops.executioner.DefaultOpExecutioner``
+with its ``profilingConfigurableHookIn/Out`` pair, ``OpProfiler`` /
+``ProfilerConfig`` / ``PerformanceTracker`` (SURVEY.md J3/J13, section 5.1),
+and the ``checkForNAN``/``checkForINF`` panic that throws at the offending op.
+
+TPU-first: there is no dispatch to native kernels here — every op is a jax
+callable that XLA compiles and fuses. The executioner exists as the
+*observability* seam: op-level timing (eager only; inside jit XLA fuses and
+the JAX profiler is the tool), call counting, and NaN/Inf scanning.
+"""
+from __future__ import annotations
+
+import time
+from collections import defaultdict
+from dataclasses import dataclass, field
+
+import jax
+import jax.numpy as jnp
+
+from deeplearning4j_tpu.common.environment import Environment
+
+
+class ND4JOpProfilerException(RuntimeError):
+    """Raised when NaN/Inf panic trips (reference: same-named exception)."""
+
+
+@dataclass
+class ProfilerConfig:
+    check_for_nan: bool = False
+    check_for_inf: bool = False
+    native_statistics: bool = False
+    check_elapsed_time: bool = True
+
+    @staticmethod
+    def from_environment() -> "ProfilerConfig":
+        env = Environment.get()
+        return ProfilerConfig(check_for_nan=env.check_for_nan,
+                              check_for_inf=env.check_for_inf)
+
+
+@dataclass
+class _OpStats:
+    invocations: int = 0
+    total_ns: int = 0
+
+
+class OpProfiler:
+    """Per-op invocation counts + wall time (eager path only)."""
+
+    _instance: "OpProfiler | None" = None
+
+    def __init__(self):
+        self.stats: dict[str, _OpStats] = defaultdict(_OpStats)
+        self.config = ProfilerConfig.from_environment()
+
+    @classmethod
+    def get_instance(cls) -> "OpProfiler":
+        if cls._instance is None:
+            cls._instance = OpProfiler()
+        return cls._instance
+
+    def reset(self):
+        self.stats.clear()
+
+    def time_spent(self, op_name: str) -> float:
+        return self.stats[op_name].total_ns / 1e9
+
+    def print_out_dashboard(self) -> str:
+        lines = ["Op profiler dashboard:"]
+        for name, s in sorted(self.stats.items(),
+                              key=lambda kv: -kv[1].total_ns):
+            lines.append(f"  {name:<32} x{s.invocations:<8} "
+                         f"{s.total_ns / 1e6:.3f} ms")
+        out = "\n".join(lines)
+        print(out)
+        return out
+
+
+def _is_concrete(x) -> bool:
+    return not isinstance(x, jax.core.Tracer)
+
+
+class OpExecutioner:
+    """Static funnel every facade op goes through.
+
+    ``exec(name, fn, *args)`` runs ``fn(*args)`` and, when enabled, records
+    timing and scans float outputs for NaN/Inf. Inside a jit trace all hooks
+    degrade to no-ops (XLA owns the schedule there); use
+    ``jax.debug_nans``/``jax.profiler`` for in-graph equivalents.
+    """
+
+    @staticmethod
+    def exec(name: str, fn, *args, **kwargs):
+        prof = OpProfiler.get_instance()
+        env = Environment.get()
+        timing = env.profiling
+        t0 = time.perf_counter_ns() if timing else 0
+        out = fn(*args, **kwargs)
+        if timing:
+            # async dispatch: wait for the device, or we time the enqueue
+            try:
+                jax.block_until_ready(out)
+            except Exception:
+                pass  # tracers can't block; in-trace timing is XLA's job
+            s = prof.stats[name]
+            s.invocations += 1
+            s.total_ns += time.perf_counter_ns() - t0
+        # live-merge Environment toggles so Nd4j.getEnvironment()-style
+        # flag flips work after the singleton exists
+        cfg = prof.config
+        check_nan = cfg.check_for_nan or env.check_for_nan
+        check_inf = cfg.check_for_inf or env.check_for_inf
+        if check_nan or check_inf:
+            OpExecutioner._panic_scan(
+                name, out, ProfilerConfig(check_for_nan=check_nan,
+                                          check_for_inf=check_inf))
+        return out
+
+    @staticmethod
+    def _panic_scan(name, out, cfg: ProfilerConfig):
+        leaves = jax.tree_util.tree_leaves(out)
+        for leaf in leaves:
+            if not hasattr(leaf, "dtype") or not jnp.issubdtype(
+                    leaf.dtype, jnp.floating):
+                continue
+            if not _is_concrete(leaf):
+                continue  # in-trace: leave to jax.debug_nans
+            if cfg.check_for_nan and bool(jnp.isnan(leaf).any()):
+                raise ND4JOpProfilerException(
+                    f"NaN value detected in output of op [{name}]")
+            if cfg.check_for_inf and bool(jnp.isinf(leaf).any()):
+                raise ND4JOpProfilerException(
+                    f"Inf value detected in output of op [{name}]")

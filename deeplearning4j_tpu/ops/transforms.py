@@ -1,0 +1,124 @@
+"""Transforms: stateless elementwise/similarity functions.
+
+Reference parity: ``org.nd4j.linalg.ops.transforms.Transforms`` (SURVEY.md
+J2/J8 neighborhood). Everything lowers to single XLA HLO ops and fuses.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from deeplearning4j_tpu.ndarray.ndarray import INDArray, _unwrap
+
+
+def _u(x):
+    return jnp.asarray(_unwrap(x))
+
+
+def _wrap(fn):
+    def f(x, *args, **kwargs):
+        return INDArray(fn(_u(x), *args, **kwargs))
+    return f
+
+
+abs = _wrap(jnp.abs)  # noqa: A001
+exp = _wrap(jnp.exp)
+log = _wrap(jnp.log)
+sqrt = _wrap(jnp.sqrt)
+floor = _wrap(jnp.floor)
+ceil = _wrap(jnp.ceil)
+round = _wrap(jnp.round)  # noqa: A001
+sign = _wrap(jnp.sign)
+sin = _wrap(jnp.sin)
+cos = _wrap(jnp.cos)
+tanh = _wrap(jnp.tanh)
+sigmoid = _wrap(jax.nn.sigmoid)
+softplus = _wrap(jax.nn.softplus)
+softsign = _wrap(jax.nn.soft_sign)
+elu = _wrap(jax.nn.elu)
+gelu = _wrap(jax.nn.gelu)
+relu = _wrap(jax.nn.relu)
+relu6 = _wrap(jax.nn.relu6)
+hard_sigmoid = _wrap(jax.nn.hard_sigmoid)
+hard_tanh = _wrap(lambda x: jnp.clip(x, -1.0, 1.0))
+swish = _wrap(jax.nn.swish)
+mish = _wrap(jax.nn.mish)
+log_sigmoid = _wrap(jax.nn.log_sigmoid)
+erf = _wrap(jax.scipy.special.erf)
+
+
+def leaky_relu(x, alpha: float = 0.01) -> INDArray:
+    return INDArray(jax.nn.leaky_relu(_u(x), alpha))
+
+
+def pow(x, p) -> INDArray:  # noqa: A001
+    return INDArray(jnp.power(_u(x), _u(p)))
+
+
+def max(x, y) -> INDArray:  # noqa: A001
+    return INDArray(jnp.maximum(_u(x), _u(y)))
+
+
+def min(x, y) -> INDArray:  # noqa: A001
+    return INDArray(jnp.minimum(_u(x), _u(y)))
+
+
+def clip(x, lo, hi) -> INDArray:
+    return INDArray(jnp.clip(_u(x), lo, hi))
+
+
+def softmax(x, axis: int = -1) -> INDArray:
+    return INDArray(jax.nn.softmax(_u(x), axis=axis))
+
+
+def log_softmax(x, axis: int = -1) -> INDArray:
+    return INDArray(jax.nn.log_softmax(_u(x), axis=axis))
+
+
+def unit_vec(x) -> INDArray:
+    v = _u(x)
+    n = jnp.linalg.norm(v)
+    return INDArray(jnp.where(n > 0, v / n, v))
+
+
+def cosine_sim(a, b) -> float:
+    va, vb = _u(a).reshape(-1), _u(b).reshape(-1)
+    return float(jnp.vdot(va, vb) /
+                 (jnp.linalg.norm(va) * jnp.linalg.norm(vb)))
+
+
+def cosine_distance(a, b) -> float:
+    return 1.0 - cosine_sim(a, b)
+
+
+def euclidean_distance(a, b) -> float:
+    return float(jnp.linalg.norm(_u(a).reshape(-1) - _u(b).reshape(-1)))
+
+
+def manhattan_distance(a, b) -> float:
+    return float(jnp.sum(jnp.abs(_u(a).reshape(-1) - _u(b).reshape(-1))))
+
+
+def hamming_distance(a, b) -> float:
+    return float(jnp.mean((_u(a).reshape(-1) != _u(b).reshape(-1))
+                          .astype(jnp.float32)))
+
+
+def dot(a, b) -> float:
+    return float(jnp.vdot(_u(a), _u(b)))
+
+
+def cross(a, b) -> INDArray:
+    return INDArray(jnp.cross(_u(a), _u(b)))
+
+
+def atan2(y, x) -> INDArray:
+    return INDArray(jnp.arctan2(_u(y), _u(x)))
+
+
+def is_nan(x) -> INDArray:
+    return INDArray(jnp.isnan(_u(x)))
+
+
+def is_inf(x) -> INDArray:
+    return INDArray(jnp.isinf(_u(x)))

@@ -1,0 +1,21 @@
+"""Test harness configuration.
+
+Tests run on a virtual 8-device CPU mesh (SURVEY.md section 4.7 TPU
+translation: multi-worker semantics in one process) so the full suite —
+including sharding/collective tests — runs without TPU hardware. Real-TPU
+runs can be forced with DL4J_TPU_TEST_PLATFORM=axon.
+
+Note: this container's sitecustomize imports jax at interpreter start with
+the axon (TPU tunnel) platform pinned; backend *initialization* is lazy, so
+flipping jax_platforms + XLA_FLAGS here (before any jax.devices() call)
+still works. Do not call jax.devices() at import time in any test module.
+"""
+import os
+
+os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "") +
+                           " --xla_force_host_platform_device_count=8")
+
+import jax  # noqa: E402
+
+if os.environ.get("DL4J_TPU_TEST_PLATFORM", "cpu") == "cpu":
+    jax.config.update("jax_platforms", "cpu")

@@ -1,0 +1,247 @@
+"""INDArray / Nd4j factory tests.
+
+Modeled on the reference's backend-parameterized nd4j suites
+(BaseNd4jTestWithBackends, SURVEY.md section 4.2) — here the single XLA
+backend plays the role every backend had to pass.
+"""
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.common.dtypes import DataType
+from deeplearning4j_tpu.ndarray import INDArray, Nd4j
+from deeplearning4j_tpu.ops import transforms
+
+
+class TestCreation:
+    def test_zeros_ones(self):
+        z = Nd4j.zeros(2, 3)
+        assert z.shape == (2, 3)
+        assert z.sum_number() == 0.0
+        o = Nd4j.ones(4)
+        assert o.sum_number() == 4.0
+
+    def test_create_from_list(self):
+        a = Nd4j.create([[1.0, 2.0], [3.0, 4.0]])
+        assert a.shape == (2, 2)
+        assert a.get_double(1, 0) == 3.0
+
+    def test_create_with_shape(self):
+        a = Nd4j.create([1, 2, 3, 4, 5, 6], 2, 3)
+        assert a.shape == (2, 3)
+        assert a.get_double(1, 2) == 6.0
+
+    def test_dtypes(self):
+        a = Nd4j.zeros(2, 2, dtype=DataType.BFLOAT16)
+        assert a.data_type() == DataType.BFLOAT16
+        b = a.cast_to(DataType.FLOAT)
+        assert b.data_type() == DataType.FLOAT
+
+    def test_arange_linspace_eye(self):
+        assert Nd4j.arange(5).length() == 5
+        assert Nd4j.linspace(0, 1, 11).shape == (11,)
+        assert Nd4j.eye(3).sum_number() == 3.0
+
+    def test_rand_seeded_reproducible(self):
+        Nd4j.get_random().set_seed(42)
+        a = Nd4j.randn(3, 3)
+        Nd4j.get_random().set_seed(42)
+        b = Nd4j.randn(3, 3)
+        assert a.equals(b)
+
+    def test_one_hot(self):
+        oh = Nd4j.one_hot([0, 2], 3)
+        np.testing.assert_allclose(oh.to_numpy(),
+                                   [[1, 0, 0], [0, 0, 1]])
+
+
+class TestInPlaceAndViews:
+    """The hard part: reference in-place/view aliasing semantics."""
+
+    def test_addi_rebinds(self):
+        a = Nd4j.ones(2, 2)
+        b = a.addi(1.0)
+        assert b is a
+        assert a.sum_number() == 8.0
+
+    def test_subi_on_view_writes_through_to_parent(self):
+        a = Nd4j.zeros(3, 4)
+        row = a.get_row(1)
+        row.addi(5.0)
+        assert a.sum_number() == 20.0
+        assert a.get_double(1, 2) == 5.0
+        assert a.get_double(0, 0) == 0.0
+
+    def test_view_sees_parent_mutation(self):
+        a = Nd4j.zeros(2, 2)
+        v = a.get_column(0)
+        a.addi(3.0)
+        assert v.sum_number() == 6.0
+
+    def test_nested_views(self):
+        a = Nd4j.zeros(2, 3, 4)
+        s = a.slice_view(1)          # shape (3,4)
+        r = s.get_row(2)             # shape (4,)
+        r.assign(7.0)
+        assert a.get_double(1, 2, 3) == 7.0
+        assert a.sum_number() == 28.0
+
+    def test_setitem(self):
+        a = Nd4j.zeros(3, 3)
+        a[0, :] = Nd4j.ones(3)
+        assert a.sum_number() == 3.0
+
+    def test_put_scalar(self):
+        a = Nd4j.zeros(2, 2)
+        a.put_scalar((1, 1), 9.0)
+        assert a.get_double(1, 1) == 9.0
+
+    def test_assign_broadcasts(self):
+        a = Nd4j.zeros(2, 3)
+        a.assign(2.5)
+        assert a.mean_number() == 2.5
+
+    def test_dup_detaches(self):
+        a = Nd4j.ones(2, 2)
+        d = a.dup()
+        d.addi(1.0)
+        assert a.sum_number() == 4.0
+        assert d.sum_number() == 8.0
+
+    def test_tensor_along_dimension(self):
+        a = Nd4j.arange(24).reshape(2, 3, 4).cast_to(DataType.FLOAT)
+        assert a.tensors_along_dimension(2) == 6
+        tad = a.tensor_along_dimension(1, 2)   # second row along last dim
+        assert tad.shape == (4,)
+        np.testing.assert_allclose(tad.to_numpy(), [4, 5, 6, 7])
+        tad.addi(100.0)
+        assert a.get_double(0, 1, 0) == 104.0
+
+
+class TestMath:
+    def test_elementwise(self):
+        a = Nd4j.create([1.0, 2.0, 3.0])
+        b = Nd4j.create([4.0, 5.0, 6.0])
+        np.testing.assert_allclose((a + b).to_numpy(), [5, 7, 9])
+        np.testing.assert_allclose((a * b).to_numpy(), [4, 10, 18])
+        np.testing.assert_allclose((b / a).to_numpy(), [4, 2.5, 2])
+        np.testing.assert_allclose(a.rsub(10.0).to_numpy(), [9, 8, 7])
+        np.testing.assert_allclose(a.rdiv(6.0).to_numpy(), [6, 3, 2])
+
+    def test_broadcasting(self):
+        a = Nd4j.ones(2, 3)
+        row = Nd4j.create([1.0, 2.0, 3.0])
+        out = a.add(row)
+        np.testing.assert_allclose(out.to_numpy(), [[2, 3, 4], [2, 3, 4]])
+
+    def test_mmul(self):
+        a = Nd4j.create([[1.0, 2.0], [3.0, 4.0]])
+        b = Nd4j.eye(2)
+        assert a.mmul(b).equals(a)
+        assert a.mmul(a).get_double(0, 0) == 7.0
+
+    def test_gemm(self):
+        a = Nd4j.create([[1.0, 2.0], [3.0, 4.0]])
+        out = Nd4j.gemm(a, a, transpose_b=True)
+        np.testing.assert_allclose(out.to_numpy(), [[5, 11], [11, 25]])
+
+    def test_reductions(self):
+        a = Nd4j.create([[1.0, 2.0], [3.0, 4.0]])
+        assert a.sum_number() == 10.0
+        np.testing.assert_allclose(a.sum(0).to_numpy(), [4, 6])
+        np.testing.assert_allclose(a.mean(1).to_numpy(), [1.5, 3.5])
+        assert a.max_number() == 4.0
+        assert float(a.norm1().to_numpy()) == 10.0
+        np.testing.assert_allclose(float(a.norm2().to_numpy()),
+                                   np.sqrt(30.0), rtol=1e-6)
+
+    def test_argmax(self):
+        a = Nd4j.create([[1.0, 5.0, 2.0], [7.0, 0.0, 3.0]])
+        np.testing.assert_array_equal(a.argmax(1).to_numpy(), [1, 0])
+
+    def test_std_bias_correction(self):
+        a = Nd4j.create([1.0, 2.0, 3.0, 4.0])
+        assert abs(float(a.std().to_numpy()) -
+                   np.std([1, 2, 3, 4], ddof=1)) < 1e-6
+
+    def test_comparisons(self):
+        a = Nd4j.create([1.0, 2.0, 3.0])
+        mask = a.gt(1.5)
+        np.testing.assert_array_equal(mask.to_numpy(), [False, True, True])
+
+    def test_shape_ops(self):
+        a = Nd4j.arange(6).reshape(2, 3)
+        assert a.transpose().shape == (3, 2)
+        assert a.reshape(3, 2).shape == (3, 2)
+        assert a.ravel().shape == (6,)
+        b = Nd4j.arange(24).reshape(2, 3, 4)
+        assert b.permute(2, 0, 1).shape == (4, 2, 3)
+
+    def test_concat_stack(self):
+        a, b = Nd4j.ones(2, 3), Nd4j.zeros(2, 3)
+        assert Nd4j.concat(0, a, b).shape == (4, 3)
+        assert Nd4j.concat(1, a, b).shape == (2, 6)
+        assert Nd4j.stack(0, a, b).shape == (2, 2, 3)
+        assert Nd4j.vstack(a, b).shape == (4, 3)
+
+    def test_to_flattened(self):
+        a, b = Nd4j.ones(2, 2), Nd4j.zeros(3)
+        f = Nd4j.to_flattened(a, b)
+        assert f.shape == (7,)
+        assert f.sum_number() == 4.0
+
+
+class TestTransforms:
+    def test_basic(self):
+        a = Nd4j.create([-1.0, 0.0, 1.0])
+        np.testing.assert_allclose(transforms.relu(a).to_numpy(), [0, 0, 1])
+        np.testing.assert_allclose(transforms.abs(a).to_numpy(), [1, 0, 1])
+        s = transforms.sigmoid(Nd4j.zeros(1))
+        assert abs(s.get_double(0) - 0.5) < 1e-6
+
+    def test_softmax_sums_to_one(self):
+        a = Nd4j.randn(4, 10)
+        s = transforms.softmax(a)
+        np.testing.assert_allclose(s.sum(1).to_numpy(), np.ones(4),
+                                   rtol=1e-5)
+
+    def test_distances(self):
+        a = Nd4j.create([1.0, 0.0])
+        b = Nd4j.create([0.0, 1.0])
+        assert abs(transforms.cosine_sim(a, b)) < 1e-6
+        np.testing.assert_allclose(transforms.euclidean_distance(a, b),
+                                   np.sqrt(2), rtol=1e-6)
+        assert transforms.manhattan_distance(a, b) == 2.0
+
+    def test_unit_vec(self):
+        v = transforms.unit_vec(Nd4j.create([3.0, 4.0]))
+        np.testing.assert_allclose(v.to_numpy(), [0.6, 0.8], rtol=1e-6)
+
+
+class TestProfiler:
+    def test_nan_panic(self):
+        from deeplearning4j_tpu.ops.executioner import (
+            ND4JOpProfilerException, OpProfiler)
+        prof = OpProfiler.get_instance()
+        prof.config.check_for_nan = True
+        try:
+            a = Nd4j.create([1.0, float("nan")])
+            with pytest.raises(ND4JOpProfilerException):
+                a.add(1.0)
+        finally:
+            prof.config.check_for_nan = False
+
+    def test_profiling_counts(self):
+        from deeplearning4j_tpu.common.environment import Environment
+        from deeplearning4j_tpu.ops.executioner import OpProfiler
+        env = Environment.get()
+        prof = OpProfiler.get_instance()
+        prof.reset()
+        env.profiling = True
+        try:
+            a = Nd4j.ones(2, 2)
+            a.add(1.0)
+            a.mmul(a)
+            assert prof.stats["add"].invocations == 1
+            assert prof.stats["mmul"].invocations == 1
+        finally:
+            env.profiling = False
