@@ -1,0 +1,1 @@
+from deeplearning4j_tpu.activations.activations import Activation  # noqa: F401

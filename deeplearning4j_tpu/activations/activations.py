@@ -1,0 +1,93 @@
+"""Activation functions.
+
+Reference parity: ``org.nd4j.linalg.activations.Activation`` enum + the
+``IActivation`` impls (SURVEY.md J8). Each member maps to a jax callable;
+backprop comes from jax autodiff rather than the reference's hand-written
+``backprop(in, epsilon)`` pairs. All lower to fused XLA elementwise HLO.
+"""
+from __future__ import annotations
+
+import enum
+
+import jax
+import jax.numpy as jnp
+
+_SELU_ALPHA = 1.6732632423543772
+_SELU_SCALE = 1.0507009873554805
+
+
+def _cube(x):
+    return x * x * x
+
+
+def _rational_tanh(x):
+    # reference RationalTanh: 1.7159 * tanh_approx(2x/3)
+    a = 0.6666667 * x
+    approx = jnp.sign(a) * (1.0 - 1.0 / (1.0 + jnp.abs(a) + a * a +
+                                         1.41645 * a * a * a * a))
+    return 1.7159 * approx
+
+
+def _rectified_tanh(x):
+    return jnp.maximum(0.0, jnp.tanh(x))
+
+
+def _threshold_relu(x, theta=1.0):
+    return jnp.where(x > theta, x, 0.0)
+
+
+class Activation(enum.Enum):
+    CUBE = "cube"
+    ELU = "elu"
+    GELU = "gelu"
+    HARDSIGMOID = "hardsigmoid"
+    HARDTANH = "hardtanh"
+    IDENTITY = "identity"
+    LEAKYRELU = "leakyrelu"
+    MISH = "mish"
+    RATIONALTANH = "rationaltanh"
+    RECTIFIEDTANH = "rectifiedtanh"
+    RELU = "relu"
+    RELU6 = "relu6"
+    SELU = "selu"
+    SIGMOID = "sigmoid"
+    SOFTMAX = "softmax"
+    SOFTPLUS = "softplus"
+    SOFTSIGN = "softsign"
+    SWISH = "swish"
+    TANH = "tanh"
+    THRESHOLDEDRELU = "thresholdedrelu"
+
+    def fn(self):
+        return _FNS[self]
+
+    def __call__(self, x):
+        return _FNS[self](x)
+
+    @staticmethod
+    def from_name(name: str) -> "Activation":
+        return Activation[name.upper()]
+
+
+_FNS = {
+    Activation.CUBE: _cube,
+    Activation.ELU: jax.nn.elu,
+    Activation.GELU: jax.nn.gelu,
+    Activation.HARDSIGMOID: jax.nn.hard_sigmoid,
+    Activation.HARDTANH: lambda x: jnp.clip(x, -1.0, 1.0),
+    Activation.IDENTITY: lambda x: x,
+    Activation.LEAKYRELU: lambda x: jax.nn.leaky_relu(x, 0.01),
+    Activation.MISH: jax.nn.mish,
+    Activation.RATIONALTANH: _rational_tanh,
+    Activation.RECTIFIEDTANH: _rectified_tanh,
+    Activation.RELU: jax.nn.relu,
+    Activation.RELU6: jax.nn.relu6,
+    Activation.SELU: jax.nn.selu,
+    Activation.SIGMOID: jax.nn.sigmoid,
+    Activation.SOFTMAX: lambda x: jax.nn.softmax(x, axis=-1),
+    Activation.SOFTPLUS: jax.nn.softplus,
+    Activation.SOFTSIGN: jax.nn.soft_sign,
+    Activation.SWISH: jax.nn.swish,
+    Activation.TANH: jnp.tanh,
+    Activation.THRESHOLDEDRELU: _threshold_relu,
+}

@@ -1,0 +1,7 @@
+from deeplearning4j_tpu.learning.schedules import (  # noqa: F401
+    ScheduleType, ISchedule, FixedSchedule, StepSchedule,
+    ExponentialSchedule, InverseSchedule, PolySchedule, SigmoidSchedule,
+    MapSchedule, LinearSchedule, CycleSchedule, WarmupSchedule)
+from deeplearning4j_tpu.learning.updaters import (  # noqa: F401
+    IUpdater, Sgd, Adam, AdaMax, Nadam, AMSGrad, AdaGrad, AdaDelta,
+    RmsProp, Nesterovs, NoOp, updater_from_config)

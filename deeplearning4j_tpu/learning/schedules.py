@@ -1,0 +1,233 @@
+"""Learning-rate schedules.
+
+Reference parity: ``org.nd4j.linalg.schedule.ISchedule`` and its
+implementations (SURVEY.md J7). All value computations use jnp so a traced
+iteration counter works inside a jitted train step (the reference evaluates
+schedules host-side per iteration; here the schedule is part of the compiled
+step — the TPU-first design keeps the whole update on device).
+"""
+from __future__ import annotations
+
+import enum
+import math
+from dataclasses import dataclass, field
+from typing import Dict
+
+import jax.numpy as jnp
+
+
+class ScheduleType(enum.Enum):
+    ITERATION = "iteration"
+    EPOCH = "epoch"
+
+
+class ISchedule:
+    """value_at(iteration, epoch) -> lr (jnp scalar ok)."""
+
+    schedule_type: ScheduleType = ScheduleType.ITERATION
+
+    def _t(self, iteration, epoch):
+        return iteration if self.schedule_type is ScheduleType.ITERATION \
+            else epoch
+
+    def value_at(self, iteration, epoch=0):
+        raise NotImplementedError
+
+    # -- JSON round-trip -------------------------------------------------
+    def to_map(self) -> dict:
+        d = {"@class": type(self).__name__}
+        d.update({k: (v.value if isinstance(v, ScheduleType) else v)
+                  for k, v in self.__dict__.items()})
+        return d
+
+    @staticmethod
+    def from_map(d: dict) -> "ISchedule":
+        d = dict(d)
+        cls = _REGISTRY[d.pop("@class")]
+        if not isinstance(cls, type):   # custom deserializer function
+            return cls(d)
+        if "schedule_type" in d:
+            d["schedule_type"] = ScheduleType(d["schedule_type"])
+        return cls(**d)
+
+
+@dataclass
+class FixedSchedule(ISchedule):
+    value: float = 1e-3
+    schedule_type: ScheduleType = ScheduleType.ITERATION
+
+    def value_at(self, iteration, epoch=0):
+        return self.value
+
+
+@dataclass
+class StepSchedule(ISchedule):
+    """lr = initial * decay_rate ^ floor(t / step)."""
+    initial_value: float = 1e-3
+    decay_rate: float = 0.5
+    step: float = 1000.0
+    schedule_type: ScheduleType = ScheduleType.ITERATION
+
+    def value_at(self, iteration, epoch=0):
+        t = self._t(iteration, epoch)
+        return self.initial_value * jnp.power(
+            self.decay_rate, jnp.floor(t / self.step))
+
+
+@dataclass
+class ExponentialSchedule(ISchedule):
+    """lr = initial * gamma ^ t."""
+    initial_value: float = 1e-3
+    gamma: float = 0.999
+    schedule_type: ScheduleType = ScheduleType.ITERATION
+
+    def value_at(self, iteration, epoch=0):
+        return self.initial_value * jnp.power(
+            self.gamma, self._t(iteration, epoch))
+
+
+@dataclass
+class InverseSchedule(ISchedule):
+    """lr = initial / (1 + gamma * t) ^ power."""
+    initial_value: float = 1e-3
+    gamma: float = 0.001
+    power: float = 1.0
+    schedule_type: ScheduleType = ScheduleType.ITERATION
+
+    def value_at(self, iteration, epoch=0):
+        t = self._t(iteration, epoch)
+        return self.initial_value / jnp.power(1.0 + self.gamma * t,
+                                              self.power)
+
+
+@dataclass
+class PolySchedule(ISchedule):
+    """lr = initial * (1 - t/max_iter) ^ power."""
+    initial_value: float = 1e-3
+    power: float = 1.0
+    max_iter: int = 10000
+    schedule_type: ScheduleType = ScheduleType.ITERATION
+
+    def value_at(self, iteration, epoch=0):
+        t = self._t(iteration, epoch)
+        frac = jnp.clip(t / self.max_iter, 0.0, 1.0)
+        return self.initial_value * jnp.power(1.0 - frac, self.power)
+
+
+@dataclass
+class SigmoidSchedule(ISchedule):
+    """lr = initial / (1 + exp(-gamma * (t - step_size)))."""
+    initial_value: float = 1e-3
+    gamma: float = 0.01
+    step_size: int = 1000
+    schedule_type: ScheduleType = ScheduleType.ITERATION
+
+    def value_at(self, iteration, epoch=0):
+        t = self._t(iteration, epoch)
+        return self.initial_value / (
+            1.0 + jnp.exp(-self.gamma * (t - self.step_size)))
+
+
+@dataclass
+class MapSchedule(ISchedule):
+    """Piecewise-constant: explicit t -> lr breakpoints.
+
+    Reference: ``MapSchedule`` (builder with .add(position, value)). Values
+    hold from their breakpoint until the next one.
+    """
+    values: Dict[int, float] = field(default_factory=dict)
+    schedule_type: ScheduleType = ScheduleType.ITERATION
+
+    def __post_init__(self):
+        self.values = {int(k): float(v) for k, v in self.values.items()}
+        if 0 not in self.values:
+            raise ValueError("MapSchedule requires a value for t=0")
+
+    def value_at(self, iteration, epoch=0):
+        t = self._t(iteration, epoch)
+        keys = sorted(self.values)
+        out = jnp.asarray(self.values[keys[0]], jnp.float32)
+        for k in keys[1:]:
+            out = jnp.where(t >= k, self.values[k], out)
+        return out
+
+
+@dataclass
+class LinearSchedule(ISchedule):
+    """Linear from initial to final over max_iter steps (then flat)."""
+    initial_value: float = 1e-3
+    final_value: float = 0.0
+    max_iter: int = 10000
+    schedule_type: ScheduleType = ScheduleType.ITERATION
+
+    def value_at(self, iteration, epoch=0):
+        t = self._t(iteration, epoch)
+        frac = jnp.clip(t / self.max_iter, 0.0, 1.0)
+        return self.initial_value + frac * (self.final_value -
+                                            self.initial_value)
+
+
+@dataclass
+class CycleSchedule(ISchedule):
+    """1cycle: warmup to max, anneal down, final short decay.
+
+    Reference: ``CycleSchedule`` (super-convergence style).
+    """
+    initial_value: float = 1e-4
+    max_value: float = 1e-2
+    final_value: float = 1e-5
+    cycle_length: int = 1000
+    annealing_length: int = 100
+    schedule_type: ScheduleType = ScheduleType.ITERATION
+
+    def value_at(self, iteration, epoch=0):
+        t = self._t(iteration, epoch)
+        up = self.cycle_length // 2
+        down_end = self.cycle_length
+        tf = jnp.asarray(t, jnp.float32)
+        lr_up = self.initial_value + (self.max_value - self.initial_value) \
+            * (tf / max(up, 1))
+        lr_down = self.max_value + (self.initial_value - self.max_value) \
+            * ((tf - up) / max(down_end - up, 1))
+        lr_anneal = self.initial_value + (self.final_value -
+                                          self.initial_value) * jnp.clip(
+            (tf - down_end) / max(self.annealing_length, 1), 0.0, 1.0)
+        out = jnp.where(tf < up, lr_up,
+                        jnp.where(tf < down_end, lr_down, lr_anneal))
+        return out
+
+
+@dataclass
+class WarmupSchedule(ISchedule):
+    """Linear warmup into an inner schedule (transformer-style; extension —
+    the reference composes this manually)."""
+    warmup_steps: int = 1000
+    inner: ISchedule = field(default_factory=lambda: FixedSchedule(1e-3))
+    schedule_type: ScheduleType = ScheduleType.ITERATION
+
+    def value_at(self, iteration, epoch=0):
+        t = jnp.asarray(self._t(iteration, epoch), jnp.float32)
+        peak = self.inner.value_at(iteration, epoch)
+        return jnp.where(t < self.warmup_steps,
+                         peak * t / max(self.warmup_steps, 1), peak)
+
+    def to_map(self) -> dict:
+        return {"@class": "WarmupSchedule",
+                "warmup_steps": self.warmup_steps,
+                "inner": self.inner.to_map(),
+                "schedule_type": self.schedule_type.value}
+
+
+_REGISTRY = {c.__name__: c for c in
+             (FixedSchedule, StepSchedule, ExponentialSchedule,
+              InverseSchedule, PolySchedule, SigmoidSchedule, MapSchedule,
+              LinearSchedule, CycleSchedule)}
+
+
+def _from_map_warmup(d):
+    return WarmupSchedule(warmup_steps=d["warmup_steps"],
+                          inner=ISchedule.from_map(d["inner"]),
+                          schedule_type=ScheduleType(d["schedule_type"]))
+
+
+_REGISTRY["WarmupSchedule"] = _from_map_warmup

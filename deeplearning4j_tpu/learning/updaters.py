@@ -1,0 +1,291 @@
+"""Updaters (optimizers) as pure functions over state pytrees.
+
+Reference parity: ``org.nd4j.linalg.learning.config.IUpdater`` + the
+``GradientUpdater`` implementations (Sgd, Adam, AdaMax, Nadam, AMSGrad,
+AdaGrad, AdaDelta, RmsProp, Nesterovs, NoOp — SURVEY.md J7). The reference
+mutates flat buffer views in place; here each updater is a pure transform
+``(grads, state, iteration) -> (updates, new_state)`` over pytrees — the
+whole update lives inside the jitted train step and XLA fuses it
+(SURVEY.md section 7 design stance: "updaters are pure functions over
+optimizer state pytrees").
+
+Sign convention matches the reference: ``apply`` returns the quantity to be
+**subtracted** from the parameters.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Union
+
+import jax
+import jax.numpy as jnp
+
+from deeplearning4j_tpu.learning.schedules import ISchedule
+
+LrLike = Union[float, ISchedule]
+
+
+def _tmap(fn, *trees):
+    return jax.tree_util.tree_map(fn, *trees)
+
+
+class IUpdater:
+    """Config + pure math for one optimizer."""
+
+    learning_rate: LrLike = 1e-3
+
+    # -- learning rate ---------------------------------------------------
+    def lr_at(self, iteration, epoch=0):
+        if isinstance(self.learning_rate, ISchedule):
+            return self.learning_rate.value_at(iteration, epoch)
+        return self.learning_rate
+
+    def has_learning_rate(self) -> bool:
+        return True
+
+    # -- state / apply ---------------------------------------------------
+    def init_state(self, params) -> Any:
+        return ()
+
+    def apply(self, grads, state, iteration, epoch=0):
+        """-> (updates_to_subtract, new_state)."""
+        raise NotImplementedError
+
+    # -- serialization ---------------------------------------------------
+    def to_map(self) -> dict:
+        d = {"@class": type(self).__name__}
+        for k, v in self.__dict__.items():
+            d[k] = v.to_map() if isinstance(v, ISchedule) else v
+        return d
+
+    @staticmethod
+    def from_map(d: dict) -> "IUpdater":
+        d = dict(d)
+        cls = _REGISTRY[d.pop("@class")]
+        for k, v in d.items():
+            if isinstance(v, dict) and "@class" in v:
+                d[k] = ISchedule.from_map(v)
+        return cls(**d)
+
+    def __eq__(self, other):
+        return type(self) is type(other) and self.__dict__ == other.__dict__
+
+    def __hash__(self):
+        return hash(type(self).__name__)
+
+
+def updater_from_config(x) -> IUpdater:
+    if isinstance(x, IUpdater):
+        return x
+    if isinstance(x, dict):
+        return IUpdater.from_map(x)
+    raise TypeError(f"cannot build updater from {x!r}")
+
+
+# ---------------------------------------------------------------------------
+@dataclass(eq=False)
+class NoOp(IUpdater):
+    """No update (frozen parameters — reference ``NoOp``)."""
+
+    def has_learning_rate(self) -> bool:
+        return False
+
+    def apply(self, grads, state, iteration, epoch=0):
+        return _tmap(jnp.zeros_like, grads), state
+
+
+@dataclass(eq=False)
+class Sgd(IUpdater):
+    learning_rate: LrLike = 1e-3
+
+    def apply(self, grads, state, iteration, epoch=0):
+        lr = self.lr_at(iteration, epoch)
+        return _tmap(lambda g: lr * g, grads), state
+
+
+@dataclass(eq=False)
+class Nesterovs(IUpdater):
+    """SGD with Nesterov momentum.
+
+    v' = mu*v - lr*g ; update = -(mu*v' - lr*g)  (reference formulation:
+    org.nd4j.linalg.learning.NesterovsUpdater applies
+    params += mu*v' - lr*g, i.e. subtracts lr*g - mu*v').
+    """
+    learning_rate: LrLike = 0.1
+    momentum: float = 0.9
+
+    def init_state(self, params):
+        return {"v": _tmap(jnp.zeros_like, params)}
+
+    def apply(self, grads, state, iteration, epoch=0):
+        lr = self.lr_at(iteration, epoch)
+        mu = self.momentum
+        v_new = _tmap(lambda v, g: mu * v - lr * g, state["v"], grads)
+        updates = _tmap(lambda vn, g: lr * g - mu * vn, v_new, grads)
+        return updates, {"v": v_new}
+
+
+@dataclass(eq=False)
+class Adam(IUpdater):
+    learning_rate: LrLike = 1e-3
+    beta1: float = 0.9
+    beta2: float = 0.999
+    epsilon: float = 1e-8
+
+    def init_state(self, params):
+        z = _tmap(jnp.zeros_like, params)
+        return {"m": z, "v": _tmap(jnp.zeros_like, params)}
+
+    def apply(self, grads, state, iteration, epoch=0):
+        t = jnp.asarray(iteration, jnp.float32) + 1.0
+        lr = self.lr_at(iteration, epoch)
+        b1, b2, eps = self.beta1, self.beta2, self.epsilon
+        m = _tmap(lambda m_, g: b1 * m_ + (1 - b1) * g, state["m"], grads)
+        v = _tmap(lambda v_, g: b2 * v_ + (1 - b2) * g * g,
+                  state["v"], grads)
+        bc1 = 1.0 - jnp.power(b1, t)
+        bc2 = 1.0 - jnp.power(b2, t)
+        upd = _tmap(lambda m_, v_: lr * (m_ / bc1) /
+                    (jnp.sqrt(v_ / bc2) + eps), m, v)
+        return upd, {"m": m, "v": v}
+
+
+@dataclass(eq=False)
+class AdaMax(IUpdater):
+    learning_rate: LrLike = 1e-3
+    beta1: float = 0.9
+    beta2: float = 0.999
+    epsilon: float = 1e-8
+
+    def init_state(self, params):
+        return {"m": _tmap(jnp.zeros_like, params),
+                "u": _tmap(jnp.zeros_like, params)}
+
+    def apply(self, grads, state, iteration, epoch=0):
+        t = jnp.asarray(iteration, jnp.float32) + 1.0
+        lr = self.lr_at(iteration, epoch)
+        b1, b2, eps = self.beta1, self.beta2, self.epsilon
+        m = _tmap(lambda m_, g: b1 * m_ + (1 - b1) * g, state["m"], grads)
+        u = _tmap(lambda u_, g: jnp.maximum(b2 * u_, jnp.abs(g)),
+                  state["u"], grads)
+        bc1 = 1.0 - jnp.power(b1, t)
+        upd = _tmap(lambda m_, u_: lr * m_ / (bc1 * (u_ + eps)), m, u)
+        return upd, {"m": m, "u": u}
+
+
+@dataclass(eq=False)
+class Nadam(IUpdater):
+    learning_rate: LrLike = 1e-3
+    beta1: float = 0.9
+    beta2: float = 0.999
+    epsilon: float = 1e-8
+
+    def init_state(self, params):
+        return {"m": _tmap(jnp.zeros_like, params),
+                "v": _tmap(jnp.zeros_like, params)}
+
+    def apply(self, grads, state, iteration, epoch=0):
+        t = jnp.asarray(iteration, jnp.float32) + 1.0
+        lr = self.lr_at(iteration, epoch)
+        b1, b2, eps = self.beta1, self.beta2, self.epsilon
+        m = _tmap(lambda m_, g: b1 * m_ + (1 - b1) * g, state["m"], grads)
+        v = _tmap(lambda v_, g: b2 * v_ + (1 - b2) * g * g,
+                  state["v"], grads)
+        bc1 = 1.0 - jnp.power(b1, t)
+        bc2 = 1.0 - jnp.power(b2, t)
+        upd = _tmap(
+            lambda m_, v_, g: lr / (jnp.sqrt(v_ / bc2) + eps) *
+            (b1 * m_ / bc1 + (1 - b1) * g / bc1),
+            m, v, grads)
+        return upd, {"m": m, "v": v}
+
+
+@dataclass(eq=False)
+class AMSGrad(IUpdater):
+    learning_rate: LrLike = 1e-3
+    beta1: float = 0.9
+    beta2: float = 0.999
+    epsilon: float = 1e-8
+
+    def init_state(self, params):
+        z = _tmap(jnp.zeros_like, params)
+        return {"m": z, "v": _tmap(jnp.zeros_like, params),
+                "vmax": _tmap(jnp.zeros_like, params)}
+
+    def apply(self, grads, state, iteration, epoch=0):
+        t = jnp.asarray(iteration, jnp.float32) + 1.0
+        lr = self.lr_at(iteration, epoch)
+        b1, b2, eps = self.beta1, self.beta2, self.epsilon
+        m = _tmap(lambda m_, g: b1 * m_ + (1 - b1) * g, state["m"], grads)
+        v = _tmap(lambda v_, g: b2 * v_ + (1 - b2) * g * g,
+                  state["v"], grads)
+        vmax = _tmap(jnp.maximum, state["vmax"], v)
+        bc1 = 1.0 - jnp.power(b1, t)
+        upd = _tmap(lambda m_, vm: lr * (m_ / bc1) / (jnp.sqrt(vm) + eps),
+                    m, vmax)
+        return upd, {"m": m, "v": v, "vmax": vmax}
+
+
+@dataclass(eq=False)
+class AdaGrad(IUpdater):
+    learning_rate: LrLike = 1e-1
+    epsilon: float = 1e-6
+
+    def init_state(self, params):
+        return {"G": _tmap(jnp.zeros_like, params)}
+
+    def apply(self, grads, state, iteration, epoch=0):
+        lr = self.lr_at(iteration, epoch)
+        G = _tmap(lambda G_, g: G_ + g * g, state["G"], grads)
+        upd = _tmap(lambda G_, g: lr * g / (jnp.sqrt(G_) + self.epsilon),
+                    G, grads)
+        return upd, {"G": G}
+
+
+@dataclass(eq=False)
+class AdaDelta(IUpdater):
+    rho: float = 0.95
+    epsilon: float = 1e-6
+    learning_rate: LrLike = 1.0  # AdaDelta has no lr; kept for API shape
+
+    def has_learning_rate(self) -> bool:
+        return False
+
+    def init_state(self, params):
+        return {"Eg": _tmap(jnp.zeros_like, params),
+                "Edx": _tmap(jnp.zeros_like, params)}
+
+    def apply(self, grads, state, iteration, epoch=0):
+        rho, eps = self.rho, self.epsilon
+        Eg = _tmap(lambda e, g: rho * e + (1 - rho) * g * g,
+                   state["Eg"], grads)
+        dx = _tmap(lambda e, edx, g:
+                   jnp.sqrt(edx + eps) / jnp.sqrt(e + eps) * g,
+                   Eg, state["Edx"], grads)
+        Edx = _tmap(lambda edx, d: rho * edx + (1 - rho) * d * d,
+                    state["Edx"], dx)
+        return dx, {"Eg": Eg, "Edx": Edx}
+
+
+@dataclass(eq=False)
+class RmsProp(IUpdater):
+    learning_rate: LrLike = 1e-3
+    rms_decay: float = 0.95
+    epsilon: float = 1e-8
+
+    def init_state(self, params):
+        return {"Eg": _tmap(jnp.zeros_like, params)}
+
+    def apply(self, grads, state, iteration, epoch=0):
+        lr = self.lr_at(iteration, epoch)
+        rho = self.rms_decay
+        Eg = _tmap(lambda e, g: rho * e + (1 - rho) * g * g,
+                   state["Eg"], grads)
+        upd = _tmap(lambda e, g: lr * g / (jnp.sqrt(e) + self.epsilon),
+                    Eg, grads)
+        return upd, {"Eg": Eg}
+
+
+_REGISTRY = {c.__name__: c for c in
+             (NoOp, Sgd, Nesterovs, Adam, AdaMax, Nadam, AMSGrad, AdaGrad,
+              AdaDelta, RmsProp)}

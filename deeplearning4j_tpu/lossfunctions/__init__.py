@@ -1,0 +1,1 @@
+from deeplearning4j_tpu.lossfunctions.losses import LossFunction  # noqa: F401

@@ -1,0 +1,197 @@
+"""Loss functions.
+
+Reference parity: ``org.nd4j.linalg.lossfunctions.LossFunctions.LossFunction``
+enum + ``ILossFunction`` impls (SURVEY.md J8): computeScore /
+computeScoreArray / computeGradient — here ``score_array`` gives the
+per-example loss and gradients come from jax autodiff of ``score``.
+
+Conventions (matching the reference):
+- inputs are ``(labels, preds)`` with shape [batch, ...]; an optional
+  per-example or per-timestep ``mask`` zeroes contributions and the mean
+  divides by the *active* count;
+- MCXENT/NEGATIVELOGLIKELIHOOD expect probabilities (post-softmax), as the
+  reference's do — the numerically-fused path (logits) is selected
+  automatically by the NN layer when activation=SOFTMAX, mirroring the
+  reference's softmax+MCXENT fusion.
+"""
+from __future__ import annotations
+
+import enum
+
+import jax.numpy as jnp
+
+_EPS = 1e-7
+
+
+def _apply_mask_and_reduce(per_example, mask, average: bool):
+    """per_example: [batch, ...] already reduced over feature dims to
+    [batch] or [batch, time]. Applies mask, reduces to scalar."""
+    if mask is not None:
+        mask = jnp.asarray(mask)
+        while mask.ndim < per_example.ndim:
+            mask = mask[..., None]
+        mask = jnp.broadcast_to(mask.reshape(mask.shape[:per_example.ndim]),
+                                per_example.shape)
+        per_example = per_example * mask
+        denom = jnp.maximum(jnp.sum(mask), 1.0)
+    else:
+        denom = per_example.size
+    total = jnp.sum(per_example)
+    return total / denom if average else total
+
+
+def _feature_sum(x):
+    """Sum across all non-batch/time leading dims -> [batch] or [batch,t]."""
+    if x.ndim <= 1:
+        return x
+    if x.ndim == 2:
+        return jnp.sum(x, axis=-1)
+    # [batch, time, feat...] -> [batch, time]
+    return jnp.sum(x.reshape(x.shape[0], x.shape[1], -1), axis=-1)
+
+
+def _mse(labels, preds):
+    return _feature_sum((preds - labels) ** 2) / _nfeat(labels)
+
+
+def _nfeat(labels):
+    if labels.ndim <= 1:
+        return 1
+    return labels.shape[-1]
+
+
+def _l1(labels, preds):
+    return _feature_sum(jnp.abs(preds - labels))
+
+
+def _l2(labels, preds):
+    return _feature_sum((preds - labels) ** 2)
+
+
+def _mae(labels, preds):
+    return _feature_sum(jnp.abs(preds - labels)) / _nfeat(labels)
+
+
+def _mcxent(labels, preds):
+    p = jnp.clip(preds, _EPS, 1.0 - _EPS)
+    return -_feature_sum(labels * jnp.log(p))
+
+
+def _xent(labels, preds):
+    p = jnp.clip(preds, _EPS, 1.0 - _EPS)
+    return -_feature_sum(labels * jnp.log(p) +
+                         (1.0 - labels) * jnp.log(1.0 - p))
+
+
+def _hinge(labels, preds):
+    # labels in {-1, +1} (reference convention)
+    return _feature_sum(jnp.maximum(0.0, 1.0 - labels * preds))
+
+
+def _squared_hinge(labels, preds):
+    return _feature_sum(jnp.maximum(0.0, 1.0 - labels * preds) ** 2)
+
+
+def _kld(labels, preds):
+    y = jnp.clip(labels, _EPS, 1.0)
+    p = jnp.clip(preds, _EPS, 1.0)
+    return _feature_sum(y * (jnp.log(y) - jnp.log(p)))
+
+
+def _poisson(labels, preds):
+    p = jnp.clip(preds, _EPS, None)
+    return _feature_sum(p - labels * jnp.log(p))
+
+
+def _msle(labels, preds):
+    return _feature_sum((jnp.log1p(jnp.maximum(preds, -1 + _EPS)) -
+                         jnp.log1p(jnp.maximum(labels, -1 + _EPS))) ** 2) \
+        / _nfeat(labels)
+
+
+def _cosine_proximity(labels, preds):
+    def _norm(v):
+        return jnp.sqrt(jnp.maximum(_feature_sum(v * v), _EPS))
+    return -(_feature_sum(labels * preds) / (_norm(labels) * _norm(preds)))
+
+
+_IMPLS = {}
+
+
+class LossFunction(enum.Enum):
+    MSE = "mse"
+    L1 = "l1"
+    L2 = "l2"
+    MEAN_ABSOLUTE_ERROR = "mae"
+    MEAN_SQUARED_LOGARITHMIC_ERROR = "msle"
+    MCXENT = "mcxent"
+    NEGATIVELOGLIKELIHOOD = "nll"   # alias of MCXENT in the reference
+    XENT = "xent"
+    HINGE = "hinge"
+    SQUARED_HINGE = "squared_hinge"
+    KL_DIVERGENCE = "kld"
+    RECONSTRUCTION_CROSSENTROPY = "recon_xent"
+    POISSON = "poisson"
+    COSINE_PROXIMITY = "cosine_proximity"
+
+    # ------------------------------------------------------------------
+    def score_array(self, labels, preds, mask=None):
+        """Per-example (or per-example-per-timestep) loss."""
+        labels = jnp.asarray(labels)
+        preds = jnp.asarray(preds)
+        out = _IMPLS[self](labels, preds)
+        if mask is not None:
+            m = jnp.asarray(mask)
+            m = m.reshape(m.shape[:out.ndim])
+            out = out * jnp.broadcast_to(m, out.shape)
+        return out
+
+    def score(self, labels, preds, mask=None, average=True):
+        labels = jnp.asarray(labels)
+        preds = jnp.asarray(preds)
+        per = _IMPLS[self](labels, preds)
+        return _apply_mask_and_reduce(per, mask, average)
+
+    # Fused from-logits path for softmax/sigmoid heads (TPU-first: avoids
+    # the clip+log of the probability-space formulas; selected by the
+    # output layer when it owns the final activation).
+    def supports_logits(self) -> bool:
+        return self in (LossFunction.MCXENT,
+                        LossFunction.NEGATIVELOGLIKELIHOOD,
+                        LossFunction.XENT)
+
+    def score_from_logits(self, labels, logits, mask=None, average=True):
+        import jax
+        labels = jnp.asarray(labels)
+        logits = jnp.asarray(logits)
+        if self in (LossFunction.MCXENT, LossFunction.NEGATIVELOGLIKELIHOOD):
+            per = -_feature_sum(labels * jax.nn.log_softmax(logits, axis=-1))
+        elif self is LossFunction.XENT:
+            per = _feature_sum(
+                jnp.maximum(logits, 0) - logits * labels +
+                jnp.log1p(jnp.exp(-jnp.abs(logits))))
+        else:
+            raise ValueError(f"{self} has no logits form")
+        return _apply_mask_and_reduce(per, mask, average)
+
+    @staticmethod
+    def from_name(name: str) -> "LossFunction":
+        return LossFunction[name.upper()]
+
+
+_IMPLS.update({
+    LossFunction.MSE: _mse,
+    LossFunction.L1: _l1,
+    LossFunction.L2: _l2,
+    LossFunction.MEAN_ABSOLUTE_ERROR: _mae,
+    LossFunction.MEAN_SQUARED_LOGARITHMIC_ERROR: _msle,
+    LossFunction.MCXENT: _mcxent,
+    LossFunction.NEGATIVELOGLIKELIHOOD: _mcxent,
+    LossFunction.XENT: _xent,
+    LossFunction.HINGE: _hinge,
+    LossFunction.SQUARED_HINGE: _squared_hinge,
+    LossFunction.KL_DIVERGENCE: _kld,
+    LossFunction.RECONSTRUCTION_CROSSENTROPY: _xent,
+    LossFunction.POISSON: _poisson,
+    LossFunction.COSINE_PROXIMITY: _cosine_proximity,
+})

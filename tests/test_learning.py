@@ -1,0 +1,237 @@
+"""Updater / schedule / activation / loss tests.
+
+Modeled on the reference's updater math tests
+(org.nd4j.linalg.learning.UpdaterTest style: closed-form single-step
+expectations) plus convergence smoke tests.
+"""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.activations import Activation
+from deeplearning4j_tpu.learning import (
+    Adam, AdaDelta, AdaGrad, AMSGrad, AdaMax, ExponentialSchedule,
+    FixedSchedule, ISchedule, IUpdater, LinearSchedule, MapSchedule, Nadam,
+    Nesterovs, NoOp, PolySchedule, RmsProp, Sgd, StepSchedule,
+    WarmupSchedule)
+from deeplearning4j_tpu.lossfunctions import LossFunction
+
+ALL_UPDATERS = [Sgd(0.1), Nesterovs(0.1, 0.9), Adam(1e-2), AdaMax(1e-2),
+                Nadam(1e-2), AMSGrad(1e-2), AdaGrad(0.1), AdaDelta(),
+                RmsProp(1e-2), NoOp()]
+
+
+class TestUpdaters:
+    def test_sgd_single_step(self):
+        up = Sgd(0.5)
+        p = {"w": jnp.ones(3)}
+        g = {"w": jnp.full(3, 2.0)}
+        s = up.init_state(p)
+        upd, s = up.apply(g, s, 0)
+        np.testing.assert_allclose(upd["w"], 1.0)
+
+    def test_adam_first_step_is_lr_sized(self):
+        # after bias correction, |update| == lr for the first step
+        up = Adam(1e-2)
+        p = {"w": jnp.zeros(4)}
+        g = {"w": jnp.full(4, 3.0)}
+        upd, _ = up.apply(g, up.init_state(p), 0)
+        np.testing.assert_allclose(upd["w"], 1e-2, rtol=1e-4)
+
+    def test_adagrad_accumulates(self):
+        up = AdaGrad(1.0, epsilon=0.0)
+        p = {"w": jnp.zeros(1)}
+        g = {"w": jnp.full(1, 2.0)}
+        s = up.init_state(p)
+        upd1, s = up.apply(g, s, 0)
+        np.testing.assert_allclose(upd1["w"], 1.0)  # 2/sqrt(4)
+        upd2, s = up.apply(g, s, 1)
+        np.testing.assert_allclose(upd2["w"], 2.0 / np.sqrt(8.0), rtol=1e-6)
+
+    def test_noop_returns_zero(self):
+        up = NoOp()
+        g = {"w": jnp.ones(3)}
+        upd, _ = up.apply(g, up.init_state(g), 0)
+        assert float(jnp.sum(jnp.abs(upd["w"]))) == 0.0
+
+    @pytest.mark.parametrize("updater", ALL_UPDATERS,
+                             ids=lambda u: type(u).__name__)
+    def test_converges_on_quadratic(self, updater):
+        """Every updater must reduce f(w)=|w|^2 over 100 jitted steps."""
+        if isinstance(updater, NoOp):
+            pytest.skip("NoOp never moves")
+        p = {"w": jnp.full(5, 3.0)}
+        s = updater.init_state(p)
+
+        @jax.jit
+        def step(p, s, it):
+            g = jax.grad(lambda q: jnp.sum(q["w"] ** 2))(p)
+            upd, s = updater.apply(g, s, it)
+            return jax.tree_util.tree_map(lambda a, b: a - b, p, upd), s
+
+        f0 = float(jnp.sum(p["w"] ** 2))
+        # AdaDelta ramps from ~sqrt(eps)-sized steps, so it needs more of them
+        n = 2000 if isinstance(updater, AdaDelta) else 100
+        for it in range(n):
+            p, s = step(p, s, it)
+        assert float(jnp.sum(p["w"] ** 2)) < 0.5 * f0
+
+    @pytest.mark.parametrize("updater", ALL_UPDATERS,
+                             ids=lambda u: type(u).__name__)
+    def test_json_round_trip(self, updater):
+        d = updater.to_map()
+        back = IUpdater.from_map(d)
+        assert back == updater
+
+    def test_schedule_inside_updater(self):
+        up = Sgd(StepSchedule(initial_value=1.0, decay_rate=0.1, step=10))
+        g = {"w": jnp.ones(1)}
+        upd0, _ = up.apply(g, (), 0)
+        upd10, _ = up.apply(g, (), 10)
+        np.testing.assert_allclose(upd0["w"], 1.0)
+        np.testing.assert_allclose(upd10["w"], 0.1, rtol=1e-6)
+
+
+class TestSchedules:
+    def test_fixed(self):
+        assert FixedSchedule(0.5).value_at(100) == 0.5
+
+    def test_step(self):
+        s = StepSchedule(1.0, 0.5, 10)
+        assert float(s.value_at(0)) == 1.0
+        assert float(s.value_at(10)) == 0.5
+        assert float(s.value_at(25)) == 0.25
+
+    def test_exponential(self):
+        s = ExponentialSchedule(1.0, 0.9)
+        np.testing.assert_allclose(float(s.value_at(2)), 0.81, rtol=1e-6)
+
+    def test_poly_hits_zero(self):
+        s = PolySchedule(1.0, power=1.0, max_iter=100)
+        np.testing.assert_allclose(float(s.value_at(100)), 0.0, atol=1e-7)
+        np.testing.assert_allclose(float(s.value_at(50)), 0.5, rtol=1e-6)
+
+    def test_map_schedule(self):
+        s = MapSchedule({0: 1.0, 10: 0.1, 20: 0.01})
+        assert float(s.value_at(5)) == 1.0
+        assert float(s.value_at(10)) == pytest.approx(0.1)
+        assert float(s.value_at(99)) == pytest.approx(0.01)
+
+    def test_map_requires_zero(self):
+        with pytest.raises(ValueError):
+            MapSchedule({5: 1.0})
+
+    def test_linear(self):
+        s = LinearSchedule(1.0, 0.0, 10)
+        np.testing.assert_allclose(float(s.value_at(5)), 0.5, rtol=1e-6)
+        np.testing.assert_allclose(float(s.value_at(100)), 0.0, atol=1e-7)
+
+    def test_warmup(self):
+        s = WarmupSchedule(10, FixedSchedule(1.0))
+        np.testing.assert_allclose(float(s.value_at(5)), 0.5, rtol=1e-6)
+        np.testing.assert_allclose(float(s.value_at(50)), 1.0, rtol=1e-6)
+
+    def test_traced_iteration(self):
+        s = StepSchedule(1.0, 0.5, 10)
+        out = jax.jit(lambda t: s.value_at(t))(jnp.asarray(10))
+        np.testing.assert_allclose(float(out), 0.5)
+
+    def test_json_round_trip(self):
+        for s in [FixedSchedule(0.1), StepSchedule(1.0, 0.5, 10),
+                  MapSchedule({0: 1.0, 5: 0.5}),
+                  WarmupSchedule(10, ExponentialSchedule(1.0, 0.99))]:
+            back = ISchedule.from_map(s.to_map())
+            np.testing.assert_allclose(float(back.value_at(7)),
+                                       float(s.value_at(7)), rtol=1e-6)
+
+
+class TestActivations:
+    @pytest.mark.parametrize("act", list(Activation))
+    def test_all_finite(self, act):
+        x = jnp.linspace(-3.0, 3.0, 31)
+        y = act(x)
+        assert y.shape == x.shape
+        assert bool(jnp.all(jnp.isfinite(y)))
+
+    def test_values(self):
+        x = jnp.asarray([-1.0, 0.0, 2.0])
+        np.testing.assert_allclose(Activation.RELU(x), [0, 0, 2])
+        np.testing.assert_allclose(Activation.IDENTITY(x), x)
+        np.testing.assert_allclose(Activation.CUBE(x), [-1, 0, 8])
+        np.testing.assert_allclose(Activation.HARDTANH(x), [-1, 0, 1])
+        sm = Activation.SOFTMAX(jnp.zeros((2, 4)))
+        np.testing.assert_allclose(jnp.sum(sm, -1), 1.0, rtol=1e-6)
+
+    def test_from_name(self):
+        assert Activation.from_name("relu") is Activation.RELU
+
+
+class TestLosses:
+    def test_mse(self):
+        y = jnp.asarray([[1.0, 2.0]])
+        p = jnp.asarray([[2.0, 4.0]])
+        np.testing.assert_allclose(
+            float(LossFunction.MSE.score(y, p)), (1 + 4) / 2, rtol=1e-6)
+
+    def test_mcxent_matches_nll(self):
+        y = jax.nn.one_hot(jnp.asarray([1, 0]), 3)
+        p = jax.nn.softmax(jnp.asarray([[1.0, 2.0, 0.5],
+                                        [0.1, 0.2, 0.3]]))
+        a = float(LossFunction.MCXENT.score(y, p))
+        b = float(LossFunction.NEGATIVELOGLIKELIHOOD.score(y, p))
+        np.testing.assert_allclose(a, b)
+
+    def test_logits_path_matches_probability_path(self):
+        logits = jnp.asarray([[2.0, -1.0, 0.5], [0.0, 3.0, -2.0]])
+        y = jax.nn.one_hot(jnp.asarray([0, 1]), 3)
+        a = float(LossFunction.MCXENT.score_from_logits(y, logits))
+        b = float(LossFunction.MCXENT.score(y, jax.nn.softmax(logits)))
+        np.testing.assert_allclose(a, b, rtol=1e-5)
+
+    def test_xent_binary(self):
+        y = jnp.asarray([[1.0], [0.0]])
+        p = jnp.asarray([[0.9], [0.1]])
+        expected = -np.log(0.9)
+        np.testing.assert_allclose(float(LossFunction.XENT.score(y, p)),
+                                   expected, rtol=1e-5)
+
+    def test_mask_excludes_examples(self):
+        y = jnp.asarray([[1.0], [1.0]])
+        p = jnp.asarray([[1.0], [0.0]])
+        mask = jnp.asarray([1.0, 0.0])
+        # only first example counts -> loss 0
+        np.testing.assert_allclose(
+            float(LossFunction.MSE.score(y, p, mask=mask)), 0.0, atol=1e-7)
+        mask2 = jnp.asarray([0.0, 1.0])
+        np.testing.assert_allclose(
+            float(LossFunction.MSE.score(y, p, mask=mask2)), 1.0, rtol=1e-6)
+
+    def test_timeseries_mask(self):
+        # [batch=1, time=3, feat=2]
+        y = jnp.ones((1, 3, 2))
+        p = jnp.zeros((1, 3, 2))
+        mask = jnp.asarray([[1.0, 1.0, 0.0]])
+        # MSE per (b,t) = 1.0; two active steps
+        np.testing.assert_allclose(
+            float(LossFunction.MSE.score(y, p, mask=mask)), 1.0, rtol=1e-6)
+
+    def test_hinge(self):
+        y = jnp.asarray([[1.0], [-1.0]])
+        p = jnp.asarray([[0.5], [-2.0]])
+        np.testing.assert_allclose(float(LossFunction.HINGE.score(y, p)),
+                                   0.25, rtol=1e-6)  # (0.5 + 0)/2
+
+    def test_kld_zero_when_equal(self):
+        y = jnp.asarray([[0.3, 0.7]])
+        np.testing.assert_allclose(
+            float(LossFunction.KL_DIVERGENCE.score(y, y)), 0.0, atol=1e-6)
+
+    def test_gradients_flow(self):
+        y = jax.nn.one_hot(jnp.asarray([1]), 3)
+        logits = jnp.asarray([[0.1, 0.2, 0.3]])
+        g = jax.grad(lambda l: LossFunction.MCXENT.score_from_logits(y, l))(
+            logits)
+        # softmax-xent gradient: p - y
+        np.testing.assert_allclose(
+            g, jax.nn.softmax(logits) - y, rtol=1e-5)
