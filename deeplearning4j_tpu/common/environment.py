@@ -50,6 +50,9 @@ class Environment:
 
     @classmethod
     def get(cls) -> _Env:
+        inst = cls._inst
+        if inst is not None:    # lock-free fast path (ops call this per-op)
+            return inst
         with cls._lock:
             if cls._inst is None:
                 def b(name, dflt=False):

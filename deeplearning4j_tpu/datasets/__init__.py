@@ -1,0 +1,9 @@
+from deeplearning4j_tpu.datasets.dataset import DataSet, MultiDataSet  # noqa: F401
+from deeplearning4j_tpu.datasets.iterators import (  # noqa: F401
+    DataSetIterator, ListDataSetIterator, ExistingDataSetIterator,
+    AsyncDataSetIterator)
+from deeplearning4j_tpu.datasets.normalizers import (  # noqa: F401
+    NormalizerStandardize, NormalizerMinMaxScaler,
+    ImagePreProcessingScaler)
+from deeplearning4j_tpu.datasets.mnist import MnistDataSetIterator  # noqa: F401
+from deeplearning4j_tpu.datasets.iris import IrisDataSetIterator  # noqa: F401

@@ -1,0 +1,49 @@
+"""Iris dataset iterator.
+
+Reference parity: ``org.deeplearning4j.datasets.iterator.impl.
+IrisDataSetIterator`` (SURVEY.md D13). The classic 150-row table is not
+shipped in this zero-egress container; a deterministic Gaussian surrogate
+with the classic class structure (one linearly separable class, two
+overlapping) stands in, with the real CSV loadable from
+``$DL4J_TPU_DATA_DIR/iris.csv`` when present.
+"""
+from __future__ import annotations
+
+import os
+from pathlib import Path
+
+import numpy as np
+
+from deeplearning4j_tpu.datasets.dataset import DataSet
+from deeplearning4j_tpu.datasets.iterators import ListDataSetIterator
+
+_MEANS = np.array([[5.0, 3.4, 1.5, 0.25],
+                   [5.9, 2.8, 4.3, 1.3],
+                   [6.6, 3.0, 5.6, 2.0]], dtype=np.float32)
+_STDS = np.array([[0.35, 0.38, 0.17, 0.10],
+                  [0.52, 0.31, 0.47, 0.20],
+                  [0.64, 0.32, 0.55, 0.27]], dtype=np.float32)
+
+
+def _load() -> DataSet:
+    csv = Path(os.environ.get("DL4J_TPU_DATA_DIR", "/nonexistent")) / \
+        "iris.csv"
+    if csv.exists():
+        raw = np.loadtxt(csv, delimiter=",", usecols=(0, 1, 2, 3, 4))
+        x = raw[:, :4].astype(np.float32)
+        y = raw[:, 4].astype(int)
+    else:
+        rng = np.random.RandomState(6)
+        ys = np.repeat(np.arange(3), 50)
+        x = (_MEANS[ys] + _STDS[ys] * rng.randn(150, 4)).astype(np.float32)
+        y = ys
+    labels = np.eye(3, dtype=np.float32)[y]
+    return DataSet(x, labels)
+
+
+class IrisDataSetIterator(ListDataSetIterator):
+    def __init__(self, batch_size: int = 150, num_examples: int = 150):
+        ds = _load()
+        ds.shuffle(seed=42)
+        ds = DataSet(ds.features[:num_examples], ds.labels[:num_examples])
+        super().__init__(ds, batch_size)

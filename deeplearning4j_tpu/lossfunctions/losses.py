@@ -142,6 +142,8 @@ class LossFunction(enum.Enum):
         out = _IMPLS[self](labels, preds)
         if mask is not None:
             m = jnp.asarray(mask)
+            while m.ndim < out.ndim:        # same padding as score()
+                m = m[..., None]
             m = m.reshape(m.shape[:out.ndim])
             out = out * jnp.broadcast_to(m, out.shape)
         return out

@@ -175,6 +175,8 @@ class Nd4j:
     @staticmethod
     def gemm(a, b, transpose_a=False, transpose_b=False,
              alpha=1.0, beta=0.0, c=None) -> INDArray:
+        """C = alpha*op(A)@op(B) + beta*C. When ``c`` is an INDArray the
+        result is also written into it (reference gemm accumulates into C)."""
         A = jnp.asarray(_unwrap(a))
         B = jnp.asarray(_unwrap(b))
         if transpose_a:
@@ -184,6 +186,9 @@ class Nd4j:
         out = alpha * (A @ B)
         if c is not None and beta != 0.0:
             out = out + beta * jnp.asarray(_unwrap(c))
+        if isinstance(c, INDArray):
+            c._write(out)
+            return c
         return INDArray(out)
 
     @staticmethod

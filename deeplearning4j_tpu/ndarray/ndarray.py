@@ -251,11 +251,19 @@ class INDArray:
     def _bin(self, name, fn, other, inplace: bool):
         out = _exec(name, fn, self.data, jnp.asarray(_unwrap(other)))
         if inplace:
-            # in-place ops cannot change the buffer dtype (mutable-buffer
-            # semantics): cast the result back, as the reference would
+            # in-place ops cannot change the buffer dtype or shape
+            # (mutable-buffer semantics): cast back, refuse to grow
+            self._check_inplace_shape(name, out)
             self._write(out.astype(self.dtype))
             return self
         return INDArray(out)
+
+    def _check_inplace_shape(self, name, out):
+        if tuple(out.shape) != self.shape:
+            raise ValueError(
+                f"in-place op [{name}] would change array shape "
+                f"{self.shape} -> {tuple(out.shape)}; a mutable buffer "
+                f"cannot be resized (use the out-of-place variant)")
 
     def add(self, o): return self._bin("add", jnp.add, o, False)
     def addi(self, o): return self._bin("add", jnp.add, o, True)
@@ -269,6 +277,7 @@ class INDArray:
     def _rbin(self, name, fn, other, inplace: bool):
         out = _exec(name, fn, jnp.asarray(_unwrap(other)), self.data)
         if inplace:
+            self._check_inplace_shape(name, out)
             self._write(out.astype(self.dtype))
             return self
         return INDArray(out)
@@ -293,8 +302,10 @@ class INDArray:
                               jnp.asarray(_unwrap(other))))
 
     def mmuli(self, other) -> "INDArray":
-        self._write(_exec("mmul", jnp.matmul, self.data,
-                          jnp.asarray(_unwrap(other))))
+        out = _exec("mmul", jnp.matmul, self.data,
+                    jnp.asarray(_unwrap(other)))
+        self._check_inplace_shape("mmul", out)
+        self._write(out)
         return self
 
     def dot(self, other) -> float:

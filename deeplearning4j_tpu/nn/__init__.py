@@ -1,0 +1,3 @@
+from deeplearning4j_tpu.nn.conf import (  # noqa: F401
+    NeuralNetConfiguration, MultiLayerConfiguration, InputType)
+from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork  # noqa: F401

@@ -1,0 +1,314 @@
+"""Network configuration: fluent builders + JSON round-trip.
+
+Reference parity: ``org.deeplearning4j.nn.conf.NeuralNetConfiguration``
+(Builder -> ListBuilder -> ``MultiLayerConfiguration``), SURVEY.md D1. The
+JSON round-trip is a compatibility contract in the reference (old JSON must
+load); the same guarantee holds here via the layer/preprocessor/updater
+``to_map``/``from_map`` registries.
+"""
+from __future__ import annotations
+
+import enum
+import json
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+from deeplearning4j_tpu.activations import Activation
+from deeplearning4j_tpu.learning.updaters import IUpdater, Sgd
+from deeplearning4j_tpu.nn.conf.inputs import (
+    InputType, InputTypeConvolutional, InputTypeConvolutionalFlat,
+    InputTypeFeedForward, InputTypeRecurrent)
+from deeplearning4j_tpu.nn.conf.layers import Layer
+from deeplearning4j_tpu.nn.conf.preprocessors import (
+    CnnToFeedForwardPreProcessor, FeedForwardToCnnPreProcessor,
+    InputPreProcessor, RnnToFeedForwardPreProcessor)
+from deeplearning4j_tpu.nn.weights import WeightInit
+
+
+class GradientNormalization(enum.Enum):
+    """Reference: org.deeplearning4j.nn.conf.GradientNormalization."""
+    NONE = "none"
+    RENORMALIZE_L2_PER_LAYER = "renorm_l2_layer"
+    RENORMALIZE_L2_PER_PARAM_TYPE = "renorm_l2_param"
+    CLIP_ELEMENT_WISE_ABSOLUTE_VALUE = "clip_elem"
+    CLIP_L2_PER_LAYER = "clip_l2_layer"
+    CLIP_L2_PER_PARAM_TYPE = "clip_l2_param"
+
+
+class BackpropType(enum.Enum):
+    STANDARD = "standard"
+    TRUNCATED_BPTT = "tbptt"
+
+
+class WorkspaceMode(enum.Enum):
+    """Kept for API parity; a no-op on TPU — XLA owns memory (SURVEY.md
+    section 7: donation replaces workspaces)."""
+    ENABLED = "enabled"
+    NONE = "none"
+
+
+@dataclass
+class MultiLayerConfiguration:
+    layers: List[Layer] = field(default_factory=list)
+    input_preprocessors: Dict[int, InputPreProcessor] = \
+        field(default_factory=dict)
+    seed: int = 12345
+    updater: IUpdater = field(default_factory=lambda: Sgd(1e-3))
+    weight_init: WeightInit = WeightInit.XAVIER
+    l1: float = 0.0
+    l2: float = 0.0
+    gradient_normalization: GradientNormalization = \
+        GradientNormalization.NONE
+    gradient_normalization_threshold: float = 1.0
+    backprop_type: BackpropType = BackpropType.STANDARD
+    tbptt_fwd_length: int = 20
+    tbptt_back_length: int = 20
+    dtype: str = "float32"
+    input_type: Optional[InputType] = None
+
+    # -- JSON ------------------------------------------------------------
+    def to_json(self) -> str:
+        d = {
+            "layers": [l.to_map() for l in self.layers],
+            "input_preprocessors": {str(k): v.to_map() for k, v in
+                                    self.input_preprocessors.items()},
+            "seed": self.seed,
+            "updater": self.updater.to_map(),
+            "weight_init": self.weight_init.name,
+            "l1": self.l1,
+            "l2": self.l2,
+            "gradient_normalization": self.gradient_normalization.name,
+            "gradient_normalization_threshold":
+                self.gradient_normalization_threshold,
+            "backprop_type": self.backprop_type.name,
+            "tbptt_fwd_length": self.tbptt_fwd_length,
+            "tbptt_back_length": self.tbptt_back_length,
+            "dtype": self.dtype,
+            "input_type": self.input_type.to_map() if self.input_type
+                          else None,
+        }
+        return json.dumps(d, indent=2)
+
+    @staticmethod
+    def from_json(s: str) -> "MultiLayerConfiguration":
+        d = json.loads(s)
+        return MultiLayerConfiguration(
+            layers=[Layer.from_map(m) for m in d["layers"]],
+            input_preprocessors={int(k): InputPreProcessor.from_map(v)
+                                 for k, v in
+                                 d.get("input_preprocessors", {}).items()},
+            seed=d.get("seed", 12345),
+            updater=IUpdater.from_map(d["updater"]),
+            weight_init=WeightInit[d.get("weight_init", "XAVIER")],
+            l1=d.get("l1", 0.0),
+            l2=d.get("l2", 0.0),
+            gradient_normalization=GradientNormalization[
+                d.get("gradient_normalization", "NONE")],
+            gradient_normalization_threshold=d.get(
+                "gradient_normalization_threshold", 1.0),
+            backprop_type=BackpropType[d.get("backprop_type", "STANDARD")],
+            tbptt_fwd_length=d.get("tbptt_fwd_length", 20),
+            tbptt_back_length=d.get("tbptt_back_length", 20),
+            dtype=d.get("dtype", "float32"),
+            input_type=InputType.from_map(d["input_type"])
+                       if d.get("input_type") else None,
+        )
+
+    # -- shape inference (reference: setInputType walk) ------------------
+    def resolve_shapes(self):
+        """Infer n_in per layer and insert preprocessors, given input_type."""
+        if self.input_type is None:
+            return
+        cur = self.input_type
+        for i, layer in enumerate(self.layers):
+            if i in self.input_preprocessors:
+                cur = self.input_preprocessors[i].get_output_type(cur)
+            else:
+                pre = _default_preprocessor(cur, layer)
+                if pre is not None:
+                    self.input_preprocessors[i] = pre
+                    cur = pre.get_output_type(cur)
+            layer.set_n_in(cur, override=False)
+            cur = layer.get_output_type(cur)
+
+    def get_layer(self, i: int) -> Layer:
+        return self.layers[i]
+
+
+def _wants_cnn_input(layer: Layer) -> bool:
+    from deeplearning4j_tpu.nn.conf.layers import (BatchNormalization,
+                                                   ConvolutionLayer,
+                                                   SubsamplingLayer)
+    return isinstance(layer, (ConvolutionLayer, SubsamplingLayer))
+
+
+def _wants_ff_input(layer: Layer) -> bool:
+    from deeplearning4j_tpu.nn.conf.layers import (BaseOutputLayer,
+                                                   DenseLayer,
+                                                   RnnOutputLayer)
+    return isinstance(layer, DenseLayer) and not isinstance(
+        layer, RnnOutputLayer)
+
+
+def _default_preprocessor(cur: InputType, layer: Layer):
+    """Insert the standard shape adapters (reference:
+    InputType.getPreProcessorForInputType semantics)."""
+    if isinstance(cur, InputTypeConvolutionalFlat) and _wants_cnn_input(
+            layer):
+        return FeedForwardToCnnPreProcessor(cur.height, cur.width,
+                                            cur.channels)
+    if isinstance(cur, InputTypeConvolutional) and _wants_ff_input(layer):
+        return CnnToFeedForwardPreProcessor(cur.height, cur.width,
+                                            cur.channels)
+    if isinstance(cur, InputTypeConvolutionalFlat) and _wants_ff_input(
+            layer):
+        return None  # already flat
+    return None
+
+
+class ListBuilder:
+    """Reference: NeuralNetConfiguration.ListBuilder."""
+
+    def __init__(self, base: "NeuralNetConfiguration.Builder"):
+        self._base = base
+        self._layers: List[Layer] = []
+        self._preprocessors: Dict[int, InputPreProcessor] = {}
+        self._input_type: Optional[InputType] = None
+        self._backprop_type = BackpropType.STANDARD
+        self._tbptt_fwd = 20
+        self._tbptt_back = 20
+
+    def layer(self, *args) -> "ListBuilder":
+        """layer(l) or layer(index, l)."""
+        if len(args) == 2:
+            idx, l = args
+            while len(self._layers) <= idx:
+                self._layers.append(None)  # type: ignore[arg-type]
+            self._layers[idx] = l
+        else:
+            self._layers.append(args[0])
+        return self
+
+    def input_pre_processor(self, idx: int,
+                            p: InputPreProcessor) -> "ListBuilder":
+        self._preprocessors[idx] = p
+        return self
+
+    def set_input_type(self, t: InputType) -> "ListBuilder":
+        self._input_type = t
+        return self
+
+    def backprop_type(self, t: BackpropType) -> "ListBuilder":
+        self._backprop_type = t
+        return self
+
+    def t_bptt_length(self, fwd: int, back: int = None) -> "ListBuilder":
+        self._tbptt_fwd = fwd
+        self._tbptt_back = back if back is not None else fwd
+        return self
+
+    def build(self) -> MultiLayerConfiguration:
+        b = self._base
+        conf = MultiLayerConfiguration(
+            layers=list(self._layers),
+            input_preprocessors=dict(self._preprocessors),
+            seed=b._seed,
+            updater=b._updater,
+            weight_init=b._weight_init,
+            l1=b._l1, l2=b._l2,
+            gradient_normalization=b._grad_norm,
+            gradient_normalization_threshold=b._grad_norm_threshold,
+            backprop_type=self._backprop_type,
+            tbptt_fwd_length=self._tbptt_fwd,
+            tbptt_back_length=self._tbptt_back,
+            dtype=b._dtype,
+            input_type=self._input_type,
+        )
+        # apply global defaults to layers that didn't override
+        for l in conf.layers:
+            if l.updater is None:
+                l.updater = b._updater
+            if l.weight_init is None:
+                l.weight_init = b._weight_init
+            if l.l1 is None:
+                l.l1 = b._l1
+            if l.l2 is None:
+                l.l2 = b._l2
+            if l.dropout is None and b._dropout is not None:
+                l.dropout = b._dropout
+            if b._activation is not None and "activation" not in \
+                    getattr(l, "_explicit", ()):
+                pass  # per-layer activation defaults stay as declared
+        conf.resolve_shapes()
+        return conf
+
+
+class NeuralNetConfiguration:
+    """Entry point: ``NeuralNetConfiguration.Builder()``."""
+
+    class Builder:
+        def __init__(self):
+            self._seed = 12345
+            self._updater: IUpdater = Sgd(1e-3)
+            self._weight_init = WeightInit.XAVIER
+            self._l1 = 0.0
+            self._l2 = 0.0
+            self._dropout: Optional[float] = None
+            self._activation: Optional[Activation] = None
+            self._grad_norm = GradientNormalization.NONE
+            self._grad_norm_threshold = 1.0
+            self._dtype = "float32"
+
+        def seed(self, s: int) -> "NeuralNetConfiguration.Builder":
+            self._seed = int(s)
+            return self
+
+        def updater(self, u: IUpdater) -> "NeuralNetConfiguration.Builder":
+            self._updater = u
+            return self
+
+        def weight_init(self, w: WeightInit
+                        ) -> "NeuralNetConfiguration.Builder":
+            self._weight_init = w
+            return self
+
+        def l1(self, v: float) -> "NeuralNetConfiguration.Builder":
+            self._l1 = float(v)
+            return self
+
+        def l2(self, v: float) -> "NeuralNetConfiguration.Builder":
+            self._l2 = float(v)
+            return self
+
+        def dropout(self, p: float) -> "NeuralNetConfiguration.Builder":
+            self._dropout = float(p)
+            return self
+
+        def activation(self, a: Activation
+                       ) -> "NeuralNetConfiguration.Builder":
+            self._activation = a
+            return self
+
+        def gradient_normalization(
+                self, g: GradientNormalization
+        ) -> "NeuralNetConfiguration.Builder":
+            self._grad_norm = g
+            return self
+
+        def gradient_normalization_threshold(
+                self, t: float) -> "NeuralNetConfiguration.Builder":
+            self._grad_norm_threshold = float(t)
+            return self
+
+        def data_type(self, dtype: str
+                      ) -> "NeuralNetConfiguration.Builder":
+            self._dtype = dtype
+            return self
+
+        def list(self) -> ListBuilder:  # noqa: A003
+            return ListBuilder(self)
+
+        def graph_builder(self):
+            from deeplearning4j_tpu.nn.conf.graph_builders import \
+                GraphBuilder
+            return GraphBuilder(self)

@@ -1,0 +1,109 @@
+"""Input types for layer shape inference.
+
+Reference parity: ``org.deeplearning4j.nn.conf.inputs.InputType`` (SURVEY.md
+D1) — the shape-inference currency flowing through ``setInputType``:
+each layer maps an input type to an output type, and mismatches insert
+preprocessors.
+
+TPU-first divergence (documented): convolutional activations are **NHWC**
+(XLA:TPU's preferred layout; the MXU tiles the trailing channel dim),
+where the reference is NCHW. ``InputType.convolutional(h, w, c)`` keeps the
+reference's argument order; only the in-memory layout differs.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+
+class InputType:
+    @staticmethod
+    def feed_forward(size: int) -> "InputTypeFeedForward":
+        return InputTypeFeedForward(int(size))
+
+    @staticmethod
+    def recurrent(size: int, timesteps: int = -1) -> "InputTypeRecurrent":
+        return InputTypeRecurrent(int(size), int(timesteps))
+
+    @staticmethod
+    def convolutional(height: int, width: int,
+                      channels: int) -> "InputTypeConvolutional":
+        return InputTypeConvolutional(int(height), int(width), int(channels))
+
+    @staticmethod
+    def convolutional_flat(height: int, width: int,
+                           channels: int) -> "InputTypeConvolutionalFlat":
+        return InputTypeConvolutionalFlat(int(height), int(width),
+                                          int(channels))
+
+    # -- serde ----------------------------------------------------------
+    def to_map(self) -> dict:
+        d = {"@class": type(self).__name__}
+        d.update(self.__dict__)
+        return d
+
+    @staticmethod
+    def from_map(d: dict) -> "InputType":
+        d = dict(d)
+        cls = _REGISTRY[d.pop("@class")]
+        return cls(**d)
+
+    def arrays_per_example(self) -> int:
+        raise NotImplementedError
+
+
+@dataclass
+class InputTypeFeedForward(InputType):
+    size: int
+
+    def arrays_per_example(self) -> int:
+        return self.size
+
+    def shape(self, batch: int = -1):
+        return (batch, self.size)
+
+
+@dataclass
+class InputTypeRecurrent(InputType):
+    size: int
+    timesteps: int = -1
+
+    def arrays_per_example(self) -> int:
+        return self.size * max(self.timesteps, 1)
+
+    def shape(self, batch: int = -1):
+        return (batch, self.timesteps, self.size)
+
+
+@dataclass
+class InputTypeConvolutional(InputType):
+    height: int
+    width: int
+    channels: int
+
+    def arrays_per_example(self) -> int:
+        return self.height * self.width * self.channels
+
+    def shape(self, batch: int = -1):
+        # NHWC (TPU-first; see module docstring)
+        return (batch, self.height, self.width, self.channels)
+
+
+@dataclass
+class InputTypeConvolutionalFlat(InputType):
+    height: int
+    width: int
+    channels: int
+
+    def arrays_per_example(self) -> int:
+        return self.height * self.width * self.channels
+
+    def get_flattened_size(self) -> int:
+        return self.arrays_per_example()
+
+    def shape(self, batch: int = -1):
+        return (batch, self.arrays_per_example())
+
+
+_REGISTRY = {c.__name__: c for c in
+             (InputTypeFeedForward, InputTypeRecurrent,
+              InputTypeConvolutional, InputTypeConvolutionalFlat)}

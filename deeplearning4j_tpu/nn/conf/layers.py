@@ -1,0 +1,621 @@
+"""Layer configurations + their functional runtime math.
+
+Reference parity: ``org.deeplearning4j.nn.conf.layers.*`` (config classes,
+SURVEY.md D1) and ``org.deeplearning4j.nn.layers.**`` (runtime twins, D4).
+The reference splits config from runtime layer objects; here each config
+dataclass *is* the runtime: it exposes pure functions
+
+    init_params(key, input_type)            -> param dict
+    init_state(input_type)                  -> state dict (e.g. BN stats)
+    forward(params, x, training, rng, state) -> (y, new_state)
+    get_output_type(input_type)             -> InputType
+
+so the network compiles every layer into one jitted step (SURVEY.md §7:
+"the layer-config API compiles into a single jitted train step"). There is
+no helper seam (D5): cuDNN/oneDNN helpers are replaced by XLA lowerings —
+``lax.conv_general_dilated`` / ``lax.reduce_window`` hit the TPU MXU/VPU
+directly (BASELINE.json north star: "cuDNN helpers lower to XLA ops").
+
+Layout: conv activations are NHWC, kernels HWIO (XLA:TPU native);
+recurrent activations are [batch, time, features]. The reference's NCHW /
+[b, f, t] layouts exist only at import boundaries.
+"""
+from __future__ import annotations
+
+import enum
+from dataclasses import dataclass, field
+from typing import Optional, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+
+from deeplearning4j_tpu.activations import Activation
+from deeplearning4j_tpu.learning.updaters import IUpdater
+from deeplearning4j_tpu.lossfunctions import LossFunction
+from deeplearning4j_tpu.nn.conf.inputs import (
+    InputType, InputTypeConvolutional, InputTypeFeedForward,
+    InputTypeRecurrent)
+from deeplearning4j_tpu.nn.weights import WeightInit
+
+
+class PoolingType(enum.Enum):
+    MAX = "max"
+    AVG = "avg"
+    SUM = "sum"
+    PNORM = "pnorm"
+
+
+class ConvolutionMode(enum.Enum):
+    """Reference: Strict/Truncate/Same. Truncate == XLA VALID."""
+    STRICT = "strict"
+    TRUNCATE = "truncate"
+    SAME = "same"
+
+
+def _pair(v) -> Tuple[int, int]:
+    if isinstance(v, (tuple, list)):
+        return (int(v[0]), int(v[1]))
+    return (int(v), int(v))
+
+
+class _Builder:
+    """Fluent builder shim for reference-style ``Layer.Builder()`` chains."""
+
+    def __init__(self, cls, *args, **kwargs):
+        self._cls = cls
+        self._kw = dict(kwargs)
+        if args:  # positional kernel size etc. handled per-class
+            self._kw.update(cls._builder_positional(*args))
+
+    def __getattr__(self, name):
+        def setter(*v):
+            self._kw[name] = v[0] if len(v) == 1 else tuple(v)
+            return self
+        return setter
+
+    def build(self):
+        return self._cls(**self._kw)
+
+
+@dataclass
+class Layer:
+    """Base layer config. Fields mirror BaseLayer/FeedForwardLayer."""
+
+    n_in: int = 0
+    n_out: int = 0
+    activation: Activation = Activation.IDENTITY
+    weight_init: Optional[WeightInit] = None      # None -> net default
+    bias_init: float = 0.0
+    updater: Optional[IUpdater] = None            # None -> net default
+    l1: Optional[float] = None
+    l2: Optional[float] = None
+    dropout: Optional[float] = None               # retain probability
+    name: Optional[str] = None
+
+    # -- builder parity --------------------------------------------------
+    @classmethod
+    def Builder(cls, *args, **kwargs) -> _Builder:  # noqa: N802
+        return _Builder(cls, *args, **kwargs)
+
+    @staticmethod
+    def _builder_positional(*args) -> dict:
+        return {}
+
+    # -- runtime protocol ------------------------------------------------
+    def has_params(self) -> bool:
+        return True
+
+    def has_state(self) -> bool:
+        return False
+
+    def is_pretrain_param(self, name: str) -> bool:
+        return False
+
+    def init_params(self, key, input_type: InputType, dtype=jnp.float32):
+        return {}
+
+    def init_state(self, input_type: InputType, dtype=jnp.float32):
+        return {}
+
+    def forward(self, params, x, *, training: bool, rng=None, state=None):
+        raise NotImplementedError
+
+    def get_output_type(self, input_type: InputType) -> InputType:
+        raise NotImplementedError
+
+    def set_n_in(self, input_type: InputType, override: bool):
+        """Shape inference hook (reference: FeedForwardLayer.setNIn)."""
+        if isinstance(input_type, InputTypeFeedForward) and \
+                (override or not self.n_in):
+            self.n_in = input_type.size
+
+    # -- input dropout (reference applies dropout to layer *input*) ------
+    def _maybe_dropout(self, x, training: bool, rng):
+        if self.dropout is None or not training or rng is None:
+            return x
+        p = float(self.dropout)
+        keep = jax.random.bernoulli(rng, p, x.shape)
+        return jnp.where(keep, x / p, 0.0)
+
+    # -- serde -----------------------------------------------------------
+    def to_map(self) -> dict:
+        d = {"@class": type(self).__name__}
+        for k, v in self.__dict__.items():
+            if isinstance(v, enum.Enum):
+                v = v.name
+            elif isinstance(v, IUpdater):
+                v = v.to_map()
+            elif isinstance(v, LossFunction):
+                v = v.name
+            d[k] = v
+        return d
+
+    @staticmethod
+    def from_map(d: dict) -> "Layer":
+        d = dict(d)
+        cls = LAYER_REGISTRY[d.pop("@class")]
+        for k, v in list(d.items()):
+            if k == "activation" and isinstance(v, str):
+                d[k] = Activation[v]
+            elif k == "weight_init" and isinstance(v, str):
+                d[k] = WeightInit[v]
+            elif k == "updater" and isinstance(v, dict):
+                d[k] = IUpdater.from_map(v)
+            elif k == "loss_function" and isinstance(v, str):
+                d[k] = LossFunction[v]
+            elif k in ("pooling_type",) and isinstance(v, str):
+                d[k] = PoolingType[v]
+            elif k in ("convolution_mode",) and isinstance(v, str):
+                d[k] = ConvolutionMode[v]
+            elif isinstance(v, list):
+                d[k] = tuple(v)
+        return cls(**d)
+
+
+# ---------------------------------------------------------------------------
+@dataclass
+class DenseLayer(Layer):
+    """Fully connected layer (reference: conf.layers.DenseLayer /
+    runtime layers.feedforward.dense.DenseLayer)."""
+
+    has_bias: bool = True
+    activation: Activation = Activation.SIGMOID
+
+    def init_params(self, key, input_type, dtype=jnp.float32):
+        wi = self.weight_init or WeightInit.XAVIER
+        k1, _ = jax.random.split(key)
+        p = {"W": wi.init(k1, (self.n_in, self.n_out),
+                          self.n_in, self.n_out, dtype)}
+        if self.has_bias:
+            p["b"] = jnp.full((self.n_out,), self.bias_init, dtype)
+        return p
+
+    def forward(self, params, x, *, training, rng=None, state=None):
+        x = self._maybe_dropout(x, training, rng)
+        z = x @ params["W"]
+        if self.has_bias:
+            z = z + params["b"]
+        return self.activation(z), state
+
+    def get_output_type(self, input_type):
+        return InputType.feed_forward(self.n_out)
+
+
+@dataclass
+class ConvolutionLayer(Layer):
+    """2D convolution (reference: conf.layers.ConvolutionLayer; runtime
+    convolution.ConvolutionLayer with CudnnConvolutionHelper — here the
+    lowering is ``lax.conv_general_dilated`` straight onto the MXU)."""
+
+    kernel_size: Tuple[int, int] = (3, 3)
+    stride: Tuple[int, int] = (1, 1)
+    padding: Tuple[int, int] = (0, 0)
+    dilation: Tuple[int, int] = (1, 1)
+    convolution_mode: ConvolutionMode = ConvolutionMode.TRUNCATE
+    has_bias: bool = True
+
+    @staticmethod
+    def _builder_positional(*args) -> dict:
+        # reference: ConvolutionLayer.Builder(kh, kw)
+        if len(args) == 1:
+            return {"kernel_size": _pair(args[0])}
+        return {"kernel_size": (int(args[0]), int(args[1]))}
+
+    def __post_init__(self):
+        self.kernel_size = _pair(self.kernel_size)
+        self.stride = _pair(self.stride)
+        self.padding = _pair(self.padding)
+        self.dilation = _pair(self.dilation)
+
+    def _pad_cfg(self):
+        if self.convolution_mode is ConvolutionMode.SAME:
+            return "SAME"
+        ph, pw = self.padding
+        return [(ph, ph), (pw, pw)]
+
+    def init_params(self, key, input_type, dtype=jnp.float32):
+        kh, kw = self.kernel_size
+        c_in = self.n_in
+        fan_in = kh * kw * c_in
+        fan_out = kh * kw * self.n_out
+        wi = self.weight_init or WeightInit.XAVIER
+        k1, _ = jax.random.split(key)
+        # HWIO kernel layout (XLA native)
+        p = {"W": wi.init(k1, (kh, kw, c_in, self.n_out),
+                          fan_in, fan_out, dtype)}
+        if self.has_bias:
+            p["b"] = jnp.full((self.n_out,), self.bias_init, dtype)
+        return p
+
+    def forward(self, params, x, *, training, rng=None, state=None):
+        x = self._maybe_dropout(x, training, rng)
+        z = jax.lax.conv_general_dilated(
+            x, params["W"],
+            window_strides=self.stride,
+            padding=self._pad_cfg(),
+            rhs_dilation=self.dilation,
+            dimension_numbers=("NHWC", "HWIO", "NHWC"))
+        if self.has_bias:
+            z = z + params["b"]
+        return self.activation(z), state
+
+    def set_n_in(self, input_type, override):
+        if isinstance(input_type, InputTypeConvolutional) and \
+                (override or not self.n_in):
+            self.n_in = input_type.channels
+
+    def get_output_type(self, input_type):
+        assert isinstance(input_type, InputTypeConvolutional), input_type
+        h, w = input_type.height, input_type.width
+        kh, kw = self.kernel_size
+        sh, sw = self.stride
+        dh, dw = self.dilation
+        ekh, ekw = (kh - 1) * dh + 1, (kw - 1) * dw + 1
+        if self.convolution_mode is ConvolutionMode.SAME:
+            oh = -(-h // sh)
+            ow = -(-w // sw)
+        else:
+            ph, pw = self.padding
+            oh = (h + 2 * ph - ekh) // sh + 1
+            ow = (w + 2 * pw - ekw) // sw + 1
+        return InputType.convolutional(oh, ow, self.n_out)
+
+
+@dataclass
+class SubsamplingLayer(Layer):
+    """Pooling (reference: conf.layers.SubsamplingLayer; cuDNN/oneDNN
+    helpers replaced by ``lax.reduce_window``)."""
+
+    pooling_type: PoolingType = PoolingType.MAX
+    kernel_size: Tuple[int, int] = (2, 2)
+    stride: Tuple[int, int] = (2, 2)
+    padding: Tuple[int, int] = (0, 0)
+    convolution_mode: ConvolutionMode = ConvolutionMode.TRUNCATE
+    pnorm: int = 2
+
+    @staticmethod
+    def _builder_positional(*args) -> dict:
+        out = {}
+        rest = list(args)
+        if rest and isinstance(rest[0], PoolingType):
+            out["pooling_type"] = rest.pop(0)
+        if rest:
+            out["kernel_size"] = _pair(rest.pop(0))
+        if rest:
+            out["stride"] = _pair(rest.pop(0))
+        return out
+
+    def __post_init__(self):
+        self.kernel_size = _pair(self.kernel_size)
+        self.stride = _pair(self.stride)
+        self.padding = _pair(self.padding)
+
+    def has_params(self) -> bool:
+        return False
+
+    def forward(self, params, x, *, training, rng=None, state=None):
+        kh, kw = self.kernel_size
+        sh, sw = self.stride
+        if self.convolution_mode is ConvolutionMode.SAME:
+            pad = "SAME"
+        else:
+            ph, pw = self.padding
+            pad = [(0, 0), (ph, ph), (pw, pw), (0, 0)]
+        dims = (1, kh, kw, 1)
+        strides = (1, sh, sw, 1)
+        if self.pooling_type is PoolingType.MAX:
+            z = jax.lax.reduce_window(x, -jnp.inf, jax.lax.max, dims,
+                                      strides, pad)
+        elif self.pooling_type is PoolingType.SUM:
+            z = jax.lax.reduce_window(x, 0.0, jax.lax.add, dims, strides,
+                                      pad)
+        elif self.pooling_type is PoolingType.AVG:
+            s = jax.lax.reduce_window(x, 0.0, jax.lax.add, dims, strides,
+                                      pad)
+            n = jax.lax.reduce_window(jnp.ones_like(x), 0.0, jax.lax.add,
+                                      dims, strides, pad)
+            z = s / n
+        else:  # PNORM
+            p = float(self.pnorm)
+            s = jax.lax.reduce_window(jnp.abs(x) ** p, 0.0, jax.lax.add,
+                                      dims, strides, pad)
+            z = s ** (1.0 / p)
+        return z, state
+
+    def get_output_type(self, input_type):
+        assert isinstance(input_type, InputTypeConvolutional), input_type
+        h, w = input_type.height, input_type.width
+        kh, kw = self.kernel_size
+        sh, sw = self.stride
+        if self.convolution_mode is ConvolutionMode.SAME:
+            oh, ow = -(-h // sh), -(-w // sw)
+        else:
+            ph, pw = self.padding
+            oh = (h + 2 * ph - kh) // sh + 1
+            ow = (w + 2 * pw - kw) // sw + 1
+        return InputType.convolutional(oh, ow, input_type.channels)
+
+    def set_n_in(self, input_type, override):
+        pass
+
+
+@dataclass
+class BatchNormalization(Layer):
+    """Batch norm (reference: conf.layers.BatchNormalization with
+    CudnnBatchNormalizationHelper — here plain XLA ops that fuse into the
+    surrounding conv; running stats are functional state carried by the
+    network, replacing the reference's mutable arrays)."""
+
+    decay: float = 0.9
+    eps: float = 1e-5
+    gamma_init: float = 1.0
+    beta_init: float = 0.0
+    lock_gamma_beta: bool = False
+
+    def has_state(self) -> bool:
+        return True
+
+    def _nf(self, input_type):
+        if isinstance(input_type, InputTypeConvolutional):
+            return input_type.channels
+        return input_type.size
+
+    def init_params(self, key, input_type, dtype=jnp.float32):
+        nf = self._nf(input_type)
+        return {"gamma": jnp.full((nf,), self.gamma_init, dtype),
+                "beta": jnp.full((nf,), self.beta_init, dtype)}
+
+    def init_state(self, input_type, dtype=jnp.float32):
+        nf = self._nf(input_type)
+        return {"mean": jnp.zeros((nf,), dtype),
+                "var": jnp.ones((nf,), dtype)}
+
+    def forward(self, params, x, *, training, rng=None, state=None):
+        axes = tuple(range(x.ndim - 1))  # all but channel/feature
+        if training:
+            mean = jnp.mean(x, axis=axes)
+            var = jnp.var(x, axis=axes)
+            d = self.decay
+            new_state = {"mean": d * state["mean"] + (1 - d) * mean,
+                         "var": d * state["var"] + (1 - d) * var}
+        else:
+            mean, var = state["mean"], state["var"]
+            new_state = state
+        xn = (x - mean) / jnp.sqrt(var + self.eps)
+        out = xn * params["gamma"] + params["beta"]
+        return self.activation(out), new_state
+
+    def get_output_type(self, input_type):
+        return input_type
+
+    def set_n_in(self, input_type, override):
+        self.n_in = self.n_out = self._nf(input_type)
+
+
+@dataclass
+class ActivationLayer(Layer):
+    def has_params(self) -> bool:
+        return False
+
+    def forward(self, params, x, *, training, rng=None, state=None):
+        return self.activation(x), state
+
+    def get_output_type(self, input_type):
+        return input_type
+
+    def set_n_in(self, input_type, override):
+        pass
+
+
+@dataclass
+class DropoutLayer(Layer):
+    """Standalone dropout layer; ``dropout`` is the retain probability,
+    matching the reference's convention."""
+
+    dropout: float = 0.5
+
+    def has_params(self) -> bool:
+        return False
+
+    def forward(self, params, x, *, training, rng=None, state=None):
+        return self._maybe_dropout(x, training, rng), state
+
+    def get_output_type(self, input_type):
+        return input_type
+
+    def set_n_in(self, input_type, override):
+        pass
+
+
+@dataclass
+class EmbeddingLayer(Layer):
+    """Index -> vector lookup (reference: conf.layers.EmbeddingLayer).
+    Input: int [batch] or [batch, 1]."""
+
+    has_bias: bool = False
+
+    def init_params(self, key, input_type, dtype=jnp.float32):
+        wi = self.weight_init or WeightInit.XAVIER
+        p = {"W": wi.init(key, (self.n_in, self.n_out),
+                          self.n_in, self.n_out, dtype)}
+        if self.has_bias:
+            p["b"] = jnp.full((self.n_out,), self.bias_init, dtype)
+        return p
+
+    def forward(self, params, x, *, training, rng=None, state=None):
+        idx = x.astype(jnp.int32)
+        if idx.ndim == 2 and idx.shape[-1] == 1:
+            idx = idx[..., 0]
+        z = params["W"][idx]
+        if self.has_bias:
+            z = z + params["b"]
+        return self.activation(z), state
+
+    def get_output_type(self, input_type):
+        return InputType.feed_forward(self.n_out)
+
+    def set_n_in(self, input_type, override):
+        pass  # n_in is vocabulary size; never inferred from input width
+
+
+@dataclass
+class GlobalPoolingLayer(Layer):
+    """Global pooling over spatial or time dims (reference:
+    conf.layers.GlobalPoolingLayer). Supports masked time averaging."""
+
+    pooling_type: PoolingType = PoolingType.MAX
+
+    def has_params(self) -> bool:
+        return False
+
+    def forward(self, params, x, *, training, rng=None, state=None,
+                mask=None):
+        if x.ndim == 4:          # NHWC -> pool H,W
+            axes = (1, 2)
+        elif x.ndim == 3:        # [b, t, f] -> pool t
+            axes = (1,)
+        else:
+            return x, state
+        if mask is not None and x.ndim == 3:
+            m = mask[..., None]
+            if self.pooling_type is PoolingType.MAX:
+                z = jnp.max(jnp.where(m > 0, x, -jnp.inf), axis=1)
+            elif self.pooling_type is PoolingType.SUM:
+                z = jnp.sum(x * m, axis=1)
+            else:
+                z = jnp.sum(x * m, axis=1) / jnp.maximum(
+                    jnp.sum(m, axis=1), 1.0)
+            return z, state
+        if self.pooling_type is PoolingType.MAX:
+            z = jnp.max(x, axis=axes)
+        elif self.pooling_type is PoolingType.SUM:
+            z = jnp.sum(x, axis=axes)
+        elif self.pooling_type is PoolingType.AVG:
+            z = jnp.mean(x, axis=axes)
+        else:
+            p = float(self.pnorm) if hasattr(self, "pnorm") else 2.0
+            z = jnp.sum(jnp.abs(x) ** p, axis=axes) ** (1.0 / p)
+        return z, state
+
+    def get_output_type(self, input_type):
+        if isinstance(input_type, InputTypeConvolutional):
+            return InputType.feed_forward(input_type.channels)
+        if isinstance(input_type, InputTypeRecurrent):
+            return InputType.feed_forward(input_type.size)
+        return input_type
+
+    def set_n_in(self, input_type, override):
+        pass
+
+
+# ---------------------------------------------------------------------------
+@dataclass
+class BaseOutputLayer(DenseLayer):
+    """Common: dense projection + loss head."""
+
+    loss_function: LossFunction = LossFunction.MCXENT
+    activation: Activation = Activation.SOFTMAX
+
+    @staticmethod
+    def _builder_positional(*args) -> dict:
+        return {"loss_function": args[0]} if args else {}
+
+    def compute_loss(self, labels, preds_or_logits, *, from_logits: bool,
+                     mask=None, average=True):
+        lf = self.loss_function
+        if from_logits and lf.supports_logits():
+            return lf.score_from_logits(labels, preds_or_logits, mask=mask,
+                                        average=average)
+        return lf.score(labels, preds_or_logits, mask=mask, average=average)
+
+    def wants_logits(self) -> bool:
+        """Fuse final softmax/sigmoid into the loss (TPU-first: avoids the
+        reference's prob-space clip+log; same trick its MCXENT+softmax
+        fusion performs)."""
+        return (self.loss_function.supports_logits() and
+                self.activation in (Activation.SOFTMAX, Activation.SIGMOID))
+
+    def forward_logits(self, params, x, *, training, rng=None, state=None):
+        x = self._maybe_dropout(x, training, rng)
+        z = x @ params["W"]
+        if self.has_bias:
+            z = z + params["b"]
+        return z, state
+
+
+@dataclass
+class OutputLayer(BaseOutputLayer):
+    """Reference: conf.layers.OutputLayer."""
+
+
+@dataclass
+class RnnOutputLayer(BaseOutputLayer):
+    """Per-timestep output head (reference: conf.layers.RnnOutputLayer).
+    Input [b, t, f] -> output [b, t, n_out]."""
+
+    def set_n_in(self, input_type, override):
+        if isinstance(input_type, InputTypeRecurrent) and \
+                (override or not self.n_in):
+            self.n_in = input_type.size
+
+    def get_output_type(self, input_type):
+        t = input_type.timesteps if isinstance(input_type,
+                                               InputTypeRecurrent) else -1
+        return InputType.recurrent(self.n_out, t)
+
+
+@dataclass
+class LossLayer(BaseOutputLayer):
+    """Loss-only head, no params (reference: conf.layers.LossLayer)."""
+
+    def has_params(self) -> bool:
+        return False
+
+    def init_params(self, key, input_type, dtype=jnp.float32):
+        return {}
+
+    def forward(self, params, x, *, training, rng=None, state=None):
+        return self.activation(x), state
+
+    def forward_logits(self, params, x, *, training, rng=None, state=None):
+        return x, state
+
+    def get_output_type(self, input_type):
+        return input_type
+
+    def set_n_in(self, input_type, override):
+        if isinstance(input_type, InputTypeFeedForward):
+            self.n_in = self.n_out = input_type.size
+
+
+LAYER_REGISTRY: dict = {c.__name__: c for c in
+                        (DenseLayer, ConvolutionLayer, SubsamplingLayer,
+                         BatchNormalization, ActivationLayer, DropoutLayer,
+                         EmbeddingLayer, GlobalPoolingLayer, OutputLayer,
+                         RnnOutputLayer, LossLayer)}
+
+
+def register_layer(cls):
+    """Register a layer class for JSON round-trip (zoo/custom layers)."""
+    LAYER_REGISTRY[cls.__name__] = cls
+    return cls

@@ -1,0 +1,91 @@
+"""Input preprocessors: shape adapters auto-inserted between layers.
+
+Reference parity: ``org.deeplearning4j.nn.conf.preprocessor.*`` (SURVEY.md
+D1): FeedForwardToCnnPreProcessor, CnnToFeedForwardPreProcessor,
+RnnToFeedForwardPreProcessor, FeedForwardToRnnPreProcessor. All are pure
+reshapes — XLA folds them into the surrounding ops.
+
+Layout note: CNN activations are NHWC here (see inputs.py); the flat order
+used by ``convolutional_flat`` is [h, w, c] row-major, which matches the
+flattened NHWC buffer, so flatten/unflatten are views.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+from deeplearning4j_tpu.nn.conf.inputs import InputType
+
+
+class InputPreProcessor:
+    def pre_process(self, x):
+        raise NotImplementedError
+
+    def get_output_type(self, input_type: InputType) -> InputType:
+        raise NotImplementedError
+
+    def to_map(self) -> dict:
+        d = {"@class": type(self).__name__}
+        d.update(self.__dict__)
+        return d
+
+    @staticmethod
+    def from_map(d: dict) -> "InputPreProcessor":
+        d = dict(d)
+        cls = _REGISTRY[d.pop("@class")]
+        return cls(**d)
+
+
+@dataclass
+class FeedForwardToCnnPreProcessor(InputPreProcessor):
+    height: int
+    width: int
+    channels: int
+
+    def pre_process(self, x):
+        return x.reshape(x.shape[0], self.height, self.width, self.channels)
+
+    def get_output_type(self, input_type):
+        return InputType.convolutional(self.height, self.width,
+                                       self.channels)
+
+
+@dataclass
+class CnnToFeedForwardPreProcessor(InputPreProcessor):
+    height: int
+    width: int
+    channels: int
+
+    def pre_process(self, x):
+        return x.reshape(x.shape[0], -1)
+
+    def get_output_type(self, input_type):
+        return InputType.feed_forward(self.height * self.width *
+                                      self.channels)
+
+
+@dataclass
+class RnnToFeedForwardPreProcessor(InputPreProcessor):
+    """[b, t, f] -> [b*t, f] (reference folds time into batch)."""
+
+    def pre_process(self, x):
+        return x.reshape(-1, x.shape[-1])
+
+    def get_output_type(self, input_type):
+        return InputType.feed_forward(input_type.size)
+
+
+@dataclass
+class FeedForwardToRnnPreProcessor(InputPreProcessor):
+    timesteps: int = -1
+
+    def pre_process(self, x):
+        return x.reshape(x.shape[0] // max(self.timesteps, 1),
+                         self.timesteps, x.shape[-1])
+
+    def get_output_type(self, input_type):
+        return InputType.recurrent(input_type.size, self.timesteps)
+
+
+_REGISTRY = {c.__name__: c for c in
+             (FeedForwardToCnnPreProcessor, CnnToFeedForwardPreProcessor,
+              RnnToFeedForwardPreProcessor, FeedForwardToRnnPreProcessor)}

@@ -1,0 +1,46 @@
+"""Gradient normalization / clipping.
+
+Reference parity: ``org.deeplearning4j.nn.conf.GradientNormalization``
+applied by ``BaseLayer.backpropGradient``/updater path (SURVEY.md D6).
+Pure functions over one layer's gradient dict, applied inside the jitted
+step.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from deeplearning4j_tpu.nn.conf.builders import GradientNormalization
+
+
+def _global_l2(tree) -> jnp.ndarray:
+    leaves = jax.tree_util.tree_leaves(tree)
+    return jnp.sqrt(sum(jnp.sum(l * l) for l in leaves) + 1e-12)
+
+
+def apply_gradient_normalization(kind: GradientNormalization,
+                                 threshold: float, layer_grads: dict):
+    """layer_grads: one layer's param-name -> grad dict."""
+    if kind is GradientNormalization.NONE or not layer_grads:
+        return layer_grads
+    if kind is GradientNormalization.RENORMALIZE_L2_PER_LAYER:
+        n = _global_l2(layer_grads)
+        return jax.tree_util.tree_map(lambda g: g / n, layer_grads)
+    if kind is GradientNormalization.RENORMALIZE_L2_PER_PARAM_TYPE:
+        return {k: v / jnp.sqrt(jnp.sum(v * v) + 1e-12)
+                for k, v in layer_grads.items()}
+    if kind is GradientNormalization.CLIP_ELEMENT_WISE_ABSOLUTE_VALUE:
+        t = threshold
+        return jax.tree_util.tree_map(lambda g: jnp.clip(g, -t, t),
+                                      layer_grads)
+    if kind is GradientNormalization.CLIP_L2_PER_LAYER:
+        n = _global_l2(layer_grads)
+        scale = jnp.minimum(1.0, threshold / n)
+        return jax.tree_util.tree_map(lambda g: g * scale, layer_grads)
+    if kind is GradientNormalization.CLIP_L2_PER_PARAM_TYPE:
+        out = {}
+        for k, v in layer_grads.items():
+            n = jnp.sqrt(jnp.sum(v * v) + 1e-12)
+            out[k] = v * jnp.minimum(1.0, threshold / n)
+        return out
+    raise ValueError(kind)

@@ -1,0 +1,386 @@
+"""MultiLayerNetwork: a sequential layer stack compiled to one jitted step.
+
+Reference parity: ``org.deeplearning4j.nn.multilayer.MultiLayerNetwork``
+(SURVEY.md D2, call stack section 3.1) — ``init/fit/output/score/evaluate``
+with listeners, per-layer updaters, gradient normalization, l1/l2.
+
+TPU-first mapping of the reference's fit() loop (section 3.1):
+- fwd/bwd/updater orchestration per minibatch -> ONE ``jax.jit`` function
+  (value_and_grad over the whole stack + pure updater transforms), traced
+  once per input signature, buffers donated so XLA reuses them
+  (donation replaces the reference's workspace machinery D8/J6);
+- the flattened param/gradient views -> params stay a pytree; flattening
+  exists only as a serialization order (utils.ModelSerializer);
+- cuDNN helper dispatch -> nothing: layers lower to XLA ops directly.
+"""
+from __future__ import annotations
+
+import logging
+from typing import List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from deeplearning4j_tpu.common.dtypes import to_jnp_dtype
+from deeplearning4j_tpu.nn.conf.builders import (BackpropType,
+                                                 MultiLayerConfiguration)
+from deeplearning4j_tpu.nn.conf.layers import BaseOutputLayer
+from deeplearning4j_tpu.nn.gradient import apply_gradient_normalization
+from deeplearning4j_tpu.optimize.listeners import TrainingListener
+
+log = logging.getLogger("deeplearning4j_tpu")
+
+
+def _as_jnp(x, dtype=None):
+    from deeplearning4j_tpu.ndarray.ndarray import INDArray
+    if isinstance(x, INDArray):
+        x = x.data
+    arr = jnp.asarray(x)
+    if dtype is not None and jnp.issubdtype(arr.dtype, jnp.floating):
+        arr = arr.astype(dtype)
+    return arr
+
+
+class MultiLayerNetwork:
+    def __init__(self, conf: MultiLayerConfiguration):
+        self.conf = conf
+        self.params: dict = {}
+        self.states: dict = {}
+        self.updater_states: dict = {}
+        self.listeners: List[TrainingListener] = []
+        self.iteration_count = 0
+        self.epoch_count = 0
+        self.last_batch_size = 0
+        self._score = float("nan")
+        self._rng = jax.random.PRNGKey(conf.seed)
+        self._train_step = None
+        self._initialized = False
+        self._dtype = to_jnp_dtype(conf.dtype)
+
+    # ------------------------------------------------------------------
+    def init(self) -> "MultiLayerNetwork":
+        if self._initialized:
+            return self
+        conf = self.conf
+        conf.resolve_shapes()
+        key = jax.random.PRNGKey(conf.seed)
+        cur = conf.input_type
+        for i, layer in enumerate(conf.layers):
+            if i in conf.input_preprocessors and cur is not None:
+                cur = conf.input_preprocessors[i].get_output_type(cur)
+            key, sub = jax.random.split(key)
+            self.params[f"layer_{i}"] = layer.init_params(
+                sub, cur, self._dtype) if layer.has_params() else {}
+            self.states[f"layer_{i}"] = layer.init_state(
+                cur, self._dtype) if layer.has_state() else {}
+            if cur is not None:
+                cur = layer.get_output_type(cur)
+        for i, layer in enumerate(conf.layers):
+            up = layer.updater or conf.updater
+            self.updater_states[f"layer_{i}"] = up.init_state(
+                self.params[f"layer_{i}"])
+        self._initialized = True
+        return self
+
+    # ------------------------------------------------------------------
+    def set_listeners(self, *listeners: TrainingListener):
+        self.listeners = list(listeners)
+        return self
+
+    def add_listeners(self, *listeners: TrainingListener):
+        self.listeners.extend(listeners)
+        return self
+
+    # ------------------------------------------------------------------
+    @property
+    def output_layer_conf(self) -> BaseOutputLayer:
+        last = self.conf.layers[-1]
+        if not isinstance(last, BaseOutputLayer):
+            raise ValueError("last layer is not an output layer")
+        return last
+
+    def n_layers(self) -> int:
+        return len(self.conf.layers)
+
+    # ------------------------------------------------------------------
+    def _forward(self, params, states, x, *, training: bool, rng,
+                 stop_at: Optional[int] = None, want_logits: bool):
+        """Walk the stack. Returns (out, new_states)."""
+        conf = self.conf
+        new_states = {}
+        h = x
+        n = len(conf.layers)
+        for i, layer in enumerate(conf.layers):
+            if stop_at is not None and i >= stop_at:
+                break
+            if i in conf.input_preprocessors:
+                h = conf.input_preprocessors[i].pre_process(h)
+            lp = params.get(f"layer_{i}", {})
+            ls = states.get(f"layer_{i}", {})
+            lrng = None
+            if rng is not None:
+                rng, lrng = jax.random.split(rng)
+            is_last = i == n - 1
+            if is_last and want_logits and isinstance(layer,
+                                                      BaseOutputLayer) \
+                    and layer.wants_logits():
+                h, ns = layer.forward_logits(lp, h, training=training,
+                                             rng=lrng, state=ls or None)
+            else:
+                h, ns = layer.forward(lp, h, training=training, rng=lrng,
+                                      state=ls or None)
+            new_states[f"layer_{i}"] = ns if ns is not None else {}
+        return h, new_states
+
+    def _regularization(self, params):
+        """Score-side l1/l2 (reference: applied to weights, not biases)."""
+        reg = 0.0
+        for i, layer in enumerate(self.conf.layers):
+            l1 = layer.l1 or 0.0
+            l2 = layer.l2 or 0.0
+            if l1 == 0.0 and l2 == 0.0:
+                continue
+            for name, p in params.get(f"layer_{i}", {}).items():
+                if name not in ("W",):   # weights only, like the reference
+                    continue
+                if l1:
+                    reg = reg + l1 * jnp.sum(jnp.abs(p))
+                if l2:
+                    reg = reg + 0.5 * l2 * jnp.sum(p * p)
+        return reg
+
+    # ------------------------------------------------------------------
+    def _build_train_step(self):
+        conf = self.conf
+        out_layer = self.output_layer_conf
+        want_logits = out_layer.wants_logits()
+        updaters = [(layer.updater or conf.updater)
+                    for layer in conf.layers]
+
+        def loss_fn(params, states, x, y, mask, rng):
+            out, new_states = self._forward(params, states, x,
+                                            training=True, rng=rng,
+                                            want_logits=True)
+            data_loss = out_layer.compute_loss(y, out,
+                                               from_logits=want_logits,
+                                               mask=mask)
+            return data_loss + self._regularization(params), new_states
+
+        def step(params, states, upd_states, x, y, mask, iteration, rng):
+            (loss, new_states), grads = jax.value_and_grad(
+                loss_fn, has_aux=True)(params, states, x, y, mask, rng)
+            new_params = {}
+            new_upd = {}
+            gn = conf.gradient_normalization
+            thr = conf.gradient_normalization_threshold
+            for i, up in enumerate(updaters):
+                k = f"layer_{i}"
+                g = grads.get(k, {})
+                if not g:
+                    new_params[k] = params.get(k, {})
+                    new_upd[k] = upd_states.get(k, ())
+                    continue
+                g = apply_gradient_normalization(gn, thr, g)
+                updates, us = up.apply(g, upd_states[k], iteration)
+                new_params[k] = jax.tree_util.tree_map(
+                    lambda p, u: p - u, params[k], updates)
+                new_upd[k] = us
+            return new_params, new_states, new_upd, loss
+
+        # donate params/states/updater-state buffers: XLA reuses them
+        # in place of the reference's workspaces
+        self._train_step = jax.jit(step, donate_argnums=(0, 1, 2))
+
+    # ------------------------------------------------------------------
+    def fit(self, data, labels=None, *, n_epochs: int = 1):
+        """fit(x, y) | fit(DataSet) | fit(iterator[, n_epochs])."""
+        if not self._initialized:
+            self.init()
+        if self._train_step is None:
+            self._build_train_step()
+        if labels is not None:
+            self._fit_batch(data, labels, None)
+            return self
+        if hasattr(data, "features") and hasattr(data, "labels"):
+            self._fit_batch(data.features, data.labels,
+                            getattr(data, "labels_mask", None))
+            return self
+        # iterator protocol
+        for _ in range(n_epochs):
+            for lis in self.listeners:
+                lis.on_epoch_start(self)
+            if hasattr(data, "reset"):
+                data.reset()
+            for ds in data:
+                self._fit_batch(ds.features, ds.labels,
+                                getattr(ds, "labels_mask", None))
+            for lis in self.listeners:
+                lis.on_epoch_end(self)
+            self.epoch_count += 1
+        return self
+
+    def _fit_batch(self, x, y, mask):
+        x = _as_jnp(x, self._dtype)
+        y = _as_jnp(y, self._dtype)
+        mask = _as_jnp(mask) if mask is not None else None
+        if self.conf.backprop_type is BackpropType.TRUNCATED_BPTT and \
+                x.ndim == 3:
+            return self._fit_tbptt(x, y, mask)
+        self._rng, rng = jax.random.split(self._rng)
+        self.params, self.states, self.updater_states, loss = \
+            self._train_step(self.params, self.states, self.updater_states,
+                             x, y, mask, jnp.asarray(self.iteration_count),
+                             rng)
+        self._score = float(loss)
+        self.last_batch_size = int(x.shape[0])
+        self.iteration_count += 1
+        for lis in self.listeners:
+            lis.iteration_done(self, self.iteration_count - 1,
+                               self.epoch_count)
+
+    def _fit_tbptt(self, x, y, mask):
+        """Truncated BPTT segmentation (SURVEY.md section 5.7): split the
+        time axis into tbptt_fwd_length segments. Recurrent state carry
+        lands with the recurrent layers (task: recurrent); until then each
+        segment trains independently, matching tBPTT's gradient truncation."""
+        L = self.conf.tbptt_fwd_length
+        T = x.shape[1]
+        for t0 in range(0, T, L):
+            seg_x = x[:, t0:t0 + L]
+            seg_y = y[:, t0:t0 + L] if y.ndim >= 3 else y
+            seg_m = mask[:, t0:t0 + L] if mask is not None and \
+                mask.ndim >= 2 else mask
+            self._rng, rng = jax.random.split(self._rng)
+            self.params, self.states, self.updater_states, loss = \
+                self._train_step(self.params, self.states,
+                                 self.updater_states, seg_x, seg_y, seg_m,
+                                 jnp.asarray(self.iteration_count), rng)
+            self._score = float(loss)
+            self.iteration_count += 1
+        for lis in self.listeners:
+            lis.iteration_done(self, self.iteration_count - 1,
+                               self.epoch_count)
+
+    # ------------------------------------------------------------------
+    def output(self, x, train: bool = False):
+        """Inference forward pass (reference: ``output(INDArray)``)."""
+        if not self._initialized:
+            self.init()
+        x = _as_jnp(x, self._dtype)
+        out, _ = self._forward(self.params, self.states, x,
+                               training=train, rng=None, want_logits=False)
+        return out
+
+    def feed_forward(self, x, train: bool = False) -> list:
+        """All layer activations (reference: feedForward)."""
+        if not self._initialized:
+            self.init()
+        x = _as_jnp(x, self._dtype)
+        acts = [x]
+        h = x
+        rng = None
+        for i, layer in enumerate(self.conf.layers):
+            if i in self.conf.input_preprocessors:
+                h = self.conf.input_preprocessors[i].pre_process(h)
+            h, _ = layer.forward(self.params.get(f"layer_{i}", {}), h,
+                                 training=train, rng=rng,
+                                 state=self.states.get(f"layer_{i}") or
+                                 None)
+            acts.append(h)
+        return acts
+
+    def predict(self, x) -> np.ndarray:
+        """Argmax class predictions (reference: predict)."""
+        return np.asarray(jnp.argmax(self.output(x), axis=-1))
+
+    def score(self, dataset=None) -> float:
+        """Latest minibatch score, or score of a given DataSet."""
+        if dataset is None:
+            return self._score
+        x = _as_jnp(dataset.features, self._dtype)
+        y = _as_jnp(dataset.labels, self._dtype)
+        mask = getattr(dataset, "labels_mask", None)
+        mask = _as_jnp(mask) if mask is not None else None
+        out_layer = self.output_layer_conf
+        want_logits = out_layer.wants_logits()
+        out, _ = self._forward(self.params, self.states, x, training=False,
+                               rng=None, want_logits=True)
+        loss = out_layer.compute_loss(y, out, from_logits=want_logits,
+                                      mask=mask)
+        return float(loss + self._regularization(self.params))
+
+    # ------------------------------------------------------------------
+    def evaluate(self, iterator):
+        """Classification evaluation (reference: evaluate(DataSetIterator))."""
+        from deeplearning4j_tpu.evaluation import Evaluation
+        ev = Evaluation()
+        if hasattr(iterator, "reset"):
+            iterator.reset()
+        for ds in iterator:
+            out = self.output(ds.features)
+            ev.eval(ds.labels, out,
+                    mask=getattr(ds, "labels_mask", None))
+        return ev
+
+    def evaluate_regression(self, iterator):
+        from deeplearning4j_tpu.evaluation import RegressionEvaluation
+        ev = RegressionEvaluation()
+        if hasattr(iterator, "reset"):
+            iterator.reset()
+        for ds in iterator:
+            out = self.output(ds.features)
+            ev.eval(ds.labels, out,
+                    mask=getattr(ds, "labels_mask", None))
+        return ev
+
+    # ------------------------------------------------------------------
+    def num_params(self) -> int:
+        return int(sum(np.prod(p.shape) for p in
+                       jax.tree_util.tree_leaves(self.params)))
+
+    def param_table(self) -> dict:
+        """{"0_W": array, ...} — reference paramTable naming."""
+        out = {}
+        for i in range(self.n_layers()):
+            for name, p in self.params.get(f"layer_{i}", {}).items():
+                out[f"{i}_{name}"] = p
+            for name, s in (self.states.get(f"layer_{i}") or {}).items():
+                out[f"{i}_{name}"] = s
+        return out
+
+    def get_param(self, key: str):
+        i, name = key.split("_", 1)
+        return self.params[f"layer_{i}"][name]
+
+    def set_params_from_table(self, table: dict):
+        for k, v in table.items():
+            i, name = k.split("_", 1)
+            lk = f"layer_{i}"
+            if name in self.params.get(lk, {}):
+                self.params[lk][name] = jnp.asarray(v)
+            elif name in (self.states.get(lk) or {}):
+                self.states[lk][name] = jnp.asarray(v)
+
+    def clone(self) -> "MultiLayerNetwork":
+        import copy
+        net = MultiLayerNetwork(copy.deepcopy(self.conf))
+        if self._initialized:
+            net.init()
+            net.params = jax.tree_util.tree_map(lambda a: a, self.params)
+            net.states = jax.tree_util.tree_map(lambda a: a, self.states)
+            net.updater_states = jax.tree_util.tree_map(
+                lambda a: a, self.updater_states)
+        return net
+
+    def summary(self) -> str:
+        lines = [f"{'idx':<4} {'type':<24} {'nIn->nOut':<14} {'params':<10}"]
+        total = 0
+        for i, layer in enumerate(self.conf.layers):
+            n = int(sum(np.prod(p.shape) for p in
+                        self.params.get(f"layer_{i}", {}).values()))
+            total += n
+            lines.append(f"{i:<4} {type(layer).__name__:<24} "
+                         f"{layer.n_in}->{layer.n_out:<10} {n:<10}")
+        lines.append(f"Total params: {total}")
+        return "\n".join(lines)

@@ -1,0 +1,76 @@
+"""Weight initialization schemes.
+
+Reference parity: ``org.deeplearning4j.nn.weights.WeightInit`` + the
+``IWeightInit`` impls (SURVEY.md D1). fan_in/fan_out conventions follow the
+reference (XAVIER = glorot with 2/(fan_in+fan_out) variance, RELU = He).
+"""
+from __future__ import annotations
+
+import enum
+import math
+
+import jax
+import jax.numpy as jnp
+
+
+class WeightInit(enum.Enum):
+    ZERO = "zero"
+    ONES = "ones"
+    CONSTANT = "constant"
+    NORMAL = "normal"            # N(0, 1/sqrt(fan_in))
+    UNIFORM = "uniform"          # U(-a, a), a = 1/sqrt(fan_in)
+    XAVIER = "xavier"            # N(0, 2/(fan_in+fan_out))
+    XAVIER_UNIFORM = "xavier_uniform"
+    XAVIER_FAN_IN = "xavier_fan_in"
+    RELU = "relu"                # He normal: N(0, 2/fan_in)
+    RELU_UNIFORM = "relu_uniform"
+    LECUN_NORMAL = "lecun_normal"
+    LECUN_UNIFORM = "lecun_uniform"
+    SIGMOID_UNIFORM = "sigmoid_uniform"
+    VAR_SCALING_NORMAL_FAN_AVG = "var_scaling_normal_fan_avg"
+    IDENTITY = "identity"
+
+    def init(self, key, shape, fan_in: float, fan_out: float,
+             dtype=jnp.float32) -> jax.Array:
+        s = tuple(int(x) for x in shape)
+        if self is WeightInit.ZERO:
+            return jnp.zeros(s, dtype)
+        if self is WeightInit.ONES:
+            return jnp.ones(s, dtype)
+        if self is WeightInit.IDENTITY:
+            if len(s) != 2 or s[0] != s[1]:
+                raise ValueError("IDENTITY init needs square 2d shape")
+            return jnp.eye(s[0], dtype=dtype)
+        if self is WeightInit.NORMAL:
+            return jax.random.normal(key, s, dtype) / math.sqrt(fan_in)
+        if self is WeightInit.UNIFORM:
+            a = 1.0 / math.sqrt(fan_in)
+            return jax.random.uniform(key, s, dtype, -a, a)
+        if self is WeightInit.XAVIER:
+            std = math.sqrt(2.0 / (fan_in + fan_out))
+            return std * jax.random.normal(key, s, dtype)
+        if self is WeightInit.XAVIER_UNIFORM:
+            a = math.sqrt(6.0 / (fan_in + fan_out))
+            return jax.random.uniform(key, s, dtype, -a, a)
+        if self is WeightInit.XAVIER_FAN_IN:
+            std = math.sqrt(1.0 / fan_in)
+            return std * jax.random.normal(key, s, dtype)
+        if self is WeightInit.RELU:
+            std = math.sqrt(2.0 / fan_in)
+            return std * jax.random.normal(key, s, dtype)
+        if self is WeightInit.RELU_UNIFORM:
+            a = math.sqrt(6.0 / fan_in)
+            return jax.random.uniform(key, s, dtype, -a, a)
+        if self is WeightInit.LECUN_NORMAL:
+            std = math.sqrt(1.0 / fan_in)
+            return std * jax.random.normal(key, s, dtype)
+        if self is WeightInit.LECUN_UNIFORM:
+            a = math.sqrt(3.0 / fan_in)
+            return jax.random.uniform(key, s, dtype, -a, a)
+        if self is WeightInit.SIGMOID_UNIFORM:
+            a = 4.0 * math.sqrt(6.0 / (fan_in + fan_out))
+            return jax.random.uniform(key, s, dtype, -a, a)
+        if self is WeightInit.VAR_SCALING_NORMAL_FAN_AVG:
+            std = math.sqrt(2.0 / (fan_in + fan_out))
+            return std * jax.random.normal(key, s, dtype)
+        raise ValueError(self)

@@ -1,0 +1,3 @@
+from deeplearning4j_tpu.optimize.listeners import (  # noqa: F401
+    TrainingListener, ScoreIterationListener, PerformanceListener,
+    CollectScoresListener)

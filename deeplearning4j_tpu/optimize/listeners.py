@@ -1,0 +1,81 @@
+"""Training listeners: the metric/observability spine of the train loop.
+
+Reference parity: ``org.deeplearning4j.optimize.api.TrainingListener`` and
+impls ``ScoreIterationListener``, ``PerformanceListener``,
+``CollectScoresListener`` (SURVEY.md D7, section 5.5). CheckpointListener
+lives in utils alongside the serializer.
+"""
+from __future__ import annotations
+
+import logging
+import time
+
+log = logging.getLogger("deeplearning4j_tpu")
+
+
+class TrainingListener:
+    def iteration_done(self, model, iteration: int, epoch: int):
+        pass
+
+    def on_epoch_start(self, model):
+        pass
+
+    def on_epoch_end(self, model):
+        pass
+
+    def on_forward_pass(self, model, activations):
+        pass
+
+    def on_gradient_calculation(self, model):
+        pass
+
+
+class ScoreIterationListener(TrainingListener):
+    """Log score every N iterations (reference: same name)."""
+
+    def __init__(self, print_iterations: int = 10):
+        self.print_iterations = max(1, int(print_iterations))
+
+    def iteration_done(self, model, iteration, epoch):
+        if iteration % self.print_iterations == 0:
+            log.info("Score at iteration %d is %s", iteration,
+                     model.score())
+            print(f"Score at iteration {iteration} is {model.score()}")
+
+
+class PerformanceListener(TrainingListener):
+    """Throughput/iteration-time sampling (reference: same name)."""
+
+    def __init__(self, frequency: int = 10, report_samples: bool = True):
+        self.frequency = max(1, int(frequency))
+        self.report_samples = report_samples
+        self._last_time = None
+        self._last_iter = None
+        self._examples = 0
+
+    def iteration_done(self, model, iteration, epoch):
+        now = time.perf_counter()
+        batch = getattr(model, "last_batch_size", None) or 0
+        self._examples += batch
+        if iteration % self.frequency == 0:
+            if self._last_time is not None:
+                dt = now - self._last_time
+                iters = iteration - self._last_iter
+                msg = (f"iteration {iteration}: {iters / dt:.2f} iters/sec"
+                       + (f", {self._examples / dt:.1f} samples/sec"
+                          if self.report_samples else ""))
+                log.info(msg)
+                print(msg)
+            self._last_time = now
+            self._last_iter = iteration
+            self._examples = 0
+
+
+class CollectScoresListener(TrainingListener):
+    """Collect (iteration, score) pairs in memory (reference: same name)."""
+
+    def __init__(self):
+        self.scores: list[tuple[int, float]] = []
+
+    def iteration_done(self, model, iteration, epoch):
+        self.scores.append((iteration, model.score()))

@@ -1,0 +1,2 @@
+from deeplearning4j_tpu.utils.serializer import ModelSerializer  # noqa: F401
+from deeplearning4j_tpu.utils.checkpoint import CheckpointListener  # noqa: F401

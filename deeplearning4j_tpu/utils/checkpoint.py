@@ -1,0 +1,66 @@
+"""CheckpointListener: periodic model saving with rotation.
+
+Reference parity: ``org.deeplearning4j.optimize.listeners.
+CheckpointListener`` (SURVEY.md D7, section 5.4): every N iterations /
+epochs / minutes, keep-last / keep-every rotation.
+"""
+from __future__ import annotations
+
+import time
+from pathlib import Path
+from typing import List, Optional
+
+from deeplearning4j_tpu.optimize.listeners import TrainingListener
+from deeplearning4j_tpu.utils.serializer import ModelSerializer
+
+
+class CheckpointListener(TrainingListener):
+    def __init__(self, save_dir, *, save_every_n_iterations: int = 0,
+                 save_every_n_epochs: int = 0,
+                 save_every_n_seconds: float = 0.0,
+                 keep_last: int = 0, keep_every: int = 0):
+        self.dir = Path(save_dir)
+        self.dir.mkdir(parents=True, exist_ok=True)
+        self.n_iter = save_every_n_iterations
+        self.n_epoch = save_every_n_epochs
+        self.n_seconds = save_every_n_seconds
+        self.keep_last = keep_last
+        self.keep_every = keep_every
+        self._last_save_time = time.time()
+        self._saved: List[Path] = []
+        self._counter = 0
+
+    def _save(self, model):
+        path = self.dir / f"checkpoint_{self._counter}.zip"
+        ModelSerializer.write_model(model, path)
+        self._counter += 1
+        self._saved.append(path)
+        self._rotate()
+
+    def _rotate(self):
+        if self.keep_last <= 0:
+            return
+        keep: set = set(self._saved[-self.keep_last:])
+        if self.keep_every > 0:
+            for i, p in enumerate(self._saved):
+                if i % self.keep_every == 0:
+                    keep.add(p)
+        for p in self._saved:
+            if p not in keep and p.exists():
+                p.unlink()
+        self._saved = [p for p in self._saved if p in keep or p.exists()]
+
+    def iteration_done(self, model, iteration: int, epoch: int):
+        if self.n_iter > 0 and (iteration + 1) % self.n_iter == 0:
+            self._save(model)
+        elif self.n_seconds > 0 and \
+                time.time() - self._last_save_time >= self.n_seconds:
+            self._save(model)
+            self._last_save_time = time.time()
+
+    def on_epoch_end(self, model):
+        if self.n_epoch > 0 and (model.epoch_count + 1) % self.n_epoch == 0:
+            self._save(model)
+
+    def last_checkpoint(self) -> Optional[Path]:
+        return self._saved[-1] if self._saved else None

@@ -1,0 +1,150 @@
+"""Model checkpoint serialization.
+
+Reference parity: ``org.deeplearning4j.util.ModelSerializer`` (SURVEY.md
+D11, section 5.4): a zip holding ``configuration.json`` +
+``coefficients.bin`` (flattened params in save order) +
+``updaterState.bin`` + optional normalizer. Here the same zip layout with
+npz payloads: the pytree is flattened to the deterministic
+``paramTable`` order, so the "single flattened params view" survives as a
+serialization order only (SURVEY.md section 5.4 TPU note).
+
+For sharded/multi-host async checkpointing use orbax via
+``parallel.checkpoint`` (extension); this serializer is the API-parity
+single-process path.
+"""
+from __future__ import annotations
+
+import io
+import json
+import zipfile
+from pathlib import Path
+
+import jax.numpy as jnp
+import numpy as np
+
+CONFIG_ENTRY = "configuration.json"
+COEFFICIENTS_ENTRY = "coefficients.npz"
+UPDATER_ENTRY = "updaterState.npz"
+STATE_ENTRY = "modelState.npz"
+NORMALIZER_ENTRY = "normalizer.json"
+META_ENTRY = "meta.json"
+
+
+def _tree_to_flat_dict(tree, prefix="") -> dict:
+    out = {}
+    if isinstance(tree, dict):
+        for k in sorted(tree):
+            out.update(_tree_to_flat_dict(tree[k], f"{prefix}{k}/"))
+    elif isinstance(tree, (list, tuple)):
+        for i, v in enumerate(tree):
+            out.update(_tree_to_flat_dict(v, f"{prefix}{i}/"))
+    elif tree is None:
+        pass
+    else:
+        out[prefix[:-1]] = np.asarray(tree)
+    return out
+
+
+def _write_npz(zf: zipfile.ZipFile, name: str, flat: dict):
+    buf = io.BytesIO()
+    np.savez(buf, **flat)
+    zf.writestr(name, buf.getvalue())
+
+
+def _read_npz(zf: zipfile.ZipFile, name: str) -> dict:
+    with zf.open(name) as f:
+        data = np.load(io.BytesIO(f.read()))
+        return {k: data[k] for k in data.files}
+
+
+class ModelSerializer:
+    @staticmethod
+    def write_model(model, path, save_updater: bool = True,
+                    normalizer=None):
+        """model: MultiLayerNetwork or ComputationGraph."""
+        path = Path(path)
+        path.parent.mkdir(parents=True, exist_ok=True)
+        with zipfile.ZipFile(path, "w", zipfile.ZIP_DEFLATED) as zf:
+            zf.writestr(CONFIG_ENTRY, model.conf.to_json())
+            _write_npz(zf, COEFFICIENTS_ENTRY,
+                       _tree_to_flat_dict(model.params))
+            _write_npz(zf, STATE_ENTRY, _tree_to_flat_dict(model.states))
+            if save_updater:
+                _write_npz(zf, UPDATER_ENTRY,
+                           _tree_to_flat_dict(model.updater_states))
+            if normalizer is not None:
+                zf.writestr(NORMALIZER_ENTRY,
+                            json.dumps(normalizer.to_map()))
+            zf.writestr(META_ENTRY, json.dumps({
+                "model_class": type(model).__name__,
+                "iteration_count": model.iteration_count,
+                "epoch_count": model.epoch_count,
+                "format_version": 1,
+            }))
+
+    @staticmethod
+    def restore_multi_layer_network(path, load_updater: bool = True):
+        from deeplearning4j_tpu.nn.conf.builders import \
+            MultiLayerConfiguration
+        from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+        path = Path(path)
+        with zipfile.ZipFile(path) as zf:
+            conf = MultiLayerConfiguration.from_json(
+                zf.read(CONFIG_ENTRY).decode())
+            net = MultiLayerNetwork(conf).init()
+            ModelSerializer._restore_into(zf, net, load_updater)
+        return net
+
+    @staticmethod
+    def restore_computation_graph(path, load_updater: bool = True):
+        from deeplearning4j_tpu.nn.conf.graph_conf import \
+            ComputationGraphConfiguration
+        from deeplearning4j_tpu.nn.graph import ComputationGraph
+        path = Path(path)
+        with zipfile.ZipFile(path) as zf:
+            conf = ComputationGraphConfiguration.from_json(
+                zf.read(CONFIG_ENTRY).decode())
+            net = ComputationGraph(conf).init()
+            ModelSerializer._restore_into(zf, net, load_updater)
+        return net
+
+    @staticmethod
+    def _restore_into(zf, net, load_updater):
+        flat = _read_npz(zf, COEFFICIENTS_ENTRY)
+        net.params = _merge_flat(net.params, flat)
+        if STATE_ENTRY in zf.namelist():
+            net.states = _merge_flat(net.states,
+                                     _read_npz(zf, STATE_ENTRY))
+        if load_updater and UPDATER_ENTRY in zf.namelist():
+            net.updater_states = _merge_flat(
+                net.updater_states, _read_npz(zf, UPDATER_ENTRY))
+        meta = json.loads(zf.read(META_ENTRY).decode()) \
+            if META_ENTRY in zf.namelist() else {}
+        net.iteration_count = meta.get("iteration_count", 0)
+        net.epoch_count = meta.get("epoch_count", 0)
+
+    @staticmethod
+    def restore_normalizer(path):
+        from deeplearning4j_tpu.datasets.normalizers import Normalizer
+        with zipfile.ZipFile(Path(path)) as zf:
+            if NORMALIZER_ENTRY not in zf.namelist():
+                return None
+            return Normalizer.from_map(
+                json.loads(zf.read(NORMALIZER_ENTRY).decode()))
+
+
+def _merge_flat(template_tree, flat: dict):
+    """Rebuild a pytree shaped like template_tree from a flat npz dict."""
+    def build(node, prefix):
+        if isinstance(node, dict):
+            return {k: build(v, f"{prefix}{k}/") for k, v in node.items()}
+        if isinstance(node, (list, tuple)):
+            return type(node)(build(v, f"{prefix}{i}/")
+                              for i, v in enumerate(node))
+        if node is None:
+            return node
+        key = prefix[:-1]
+        if key in flat:
+            return jnp.asarray(flat[key])
+        return node
+    return build(template_tree, "")

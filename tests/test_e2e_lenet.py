@@ -1,0 +1,224 @@
+"""The minimum end-to-end slice (SURVEY.md section 7.5, BASELINE config #1):
+LeNet-5 on MNIST — config builder -> compiled step -> MNIST iterator ->
+fit() -> Evaluation >= 99% test accuracy -> checkpoint save/restore.
+
+Runs against the deterministic synthetic MNIST surrogate in this
+zero-egress container (real IDX/npz data is picked up automatically when
+present — see datasets/mnist.py).
+"""
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.activations import Activation
+from deeplearning4j_tpu.datasets import (AsyncDataSetIterator,
+                                         ImagePreProcessingScaler,
+                                         MnistDataSetIterator)
+from deeplearning4j_tpu.learning import Adam
+from deeplearning4j_tpu.lossfunctions import LossFunction
+from deeplearning4j_tpu.nn import (InputType, MultiLayerNetwork,
+                                   NeuralNetConfiguration)
+from deeplearning4j_tpu.nn.conf.layers import (ConvolutionLayer,
+                                               DenseLayer, OutputLayer,
+                                               PoolingType,
+                                               SubsamplingLayer)
+from deeplearning4j_tpu.nn.weights import WeightInit
+from deeplearning4j_tpu.utils import ModelSerializer
+
+
+def lenet5_conf(seed=123):
+    """LeNet-5 as in the reference's dl4j-examples LeNetMNIST
+    (conv5x5x20 -> max2 -> conv5x5x50 -> max2 -> dense500 -> softmax10)."""
+    return (NeuralNetConfiguration.Builder()
+            .seed(seed)
+            .updater(Adam(1e-3))
+            .weight_init(WeightInit.XAVIER)
+            .list()
+            .layer(ConvolutionLayer.Builder(5, 5)
+                   .n_out(20).stride((1, 1))
+                   .activation(Activation.IDENTITY).build())
+            .layer(SubsamplingLayer.Builder(PoolingType.MAX)
+                   .kernel_size((2, 2)).stride((2, 2)).build())
+            .layer(ConvolutionLayer.Builder(5, 5)
+                   .n_out(50).stride((1, 1))
+                   .activation(Activation.IDENTITY).build())
+            .layer(SubsamplingLayer.Builder(PoolingType.MAX)
+                   .kernel_size((2, 2)).stride((2, 2)).build())
+            .layer(DenseLayer.Builder().n_out(500)
+                   .activation(Activation.RELU).build())
+            .layer(OutputLayer.Builder(LossFunction.NEGATIVELOGLIKELIHOOD)
+                   .n_out(10).activation(Activation.SOFTMAX).build())
+            .set_input_type(InputType.convolutional_flat(28, 28, 1))
+            .build())
+
+
+@pytest.fixture(scope="module")
+def trained_lenet():
+    train_iter = MnistDataSetIterator(batch_size=128, train=True,
+                                      num_examples=6400)
+    net = MultiLayerNetwork(lenet5_conf()).init()
+    net.fit(AsyncDataSetIterator(train_iter), n_epochs=3)
+    return net
+
+
+class TestLeNetEndToEnd:
+    def test_param_count(self):
+        net = MultiLayerNetwork(lenet5_conf()).init()
+        # conv1: 5*5*1*20+20, conv2: 5*5*20*50+50, dense: 800*500+500,
+        # out: 500*10+10
+        expected = (5 * 5 * 1 * 20 + 20) + (5 * 5 * 20 * 50 + 50) + \
+            (4 * 4 * 50 * 500 + 500) + (500 * 10 + 10)
+        assert net.num_params() == expected
+
+    def test_accuracy_gate(self, trained_lenet):
+        """BASELINE.md protocol step 1: >= 99% test accuracy."""
+        test_iter = MnistDataSetIterator(batch_size=256, train=False,
+                                         num_examples=2560)
+        ev = trained_lenet.evaluate(test_iter)
+        assert ev.accuracy() >= 0.99, ev.stats()
+        assert ev.f1() >= 0.99
+
+    def test_checkpoint_round_trip(self, trained_lenet, tmp_path):
+        """BASELINE.md protocol step 1: checkpoint save/restore."""
+        path = tmp_path / "lenet.zip"
+        ModelSerializer.write_model(trained_lenet, path)
+        restored = ModelSerializer.restore_multi_layer_network(path)
+        x = MnistDataSetIterator(batch_size=32, train=False,
+                                 num_examples=32).next().features
+        np.testing.assert_allclose(
+            np.asarray(trained_lenet.output(x)),
+            np.asarray(restored.output(x)), rtol=1e-5, atol=1e-6)
+        assert restored.iteration_count == trained_lenet.iteration_count
+        # updater state restored too: one more fit step must not explode
+        ds = MnistDataSetIterator(batch_size=32, train=True,
+                                  num_examples=32).next()
+        restored.fit(ds)
+        assert np.isfinite(restored.score())
+
+    def test_training_continues_after_restore(self, trained_lenet,
+                                              tmp_path):
+        path = tmp_path / "resume.zip"
+        ModelSerializer.write_model(trained_lenet, path)
+        restored = ModelSerializer.restore_multi_layer_network(path)
+        it = MnistDataSetIterator(batch_size=128, train=True,
+                                  num_examples=640)
+        before = restored.iteration_count
+        restored.fit(it, n_epochs=1)
+        assert restored.iteration_count == before + 5
+
+
+class TestDataPipeline:
+    def test_mnist_shapes(self):
+        it = MnistDataSetIterator(batch_size=64, train=True,
+                                  num_examples=256)
+        ds = it.next()
+        assert ds.features.shape == (64, 784)
+        assert ds.labels.shape == (64, 10)
+        assert 0.0 <= ds.features.min() and ds.features.max() <= 1.0
+        # one-hot labels
+        np.testing.assert_allclose(ds.labels.sum(-1), np.ones(64))
+
+    def test_iterator_reset_and_count(self):
+        it = MnistDataSetIterator(batch_size=100, train=True,
+                                  num_examples=250)
+        n = sum(ds.num_examples() for ds in it)
+        assert n == 250
+        n2 = sum(ds.num_examples() for ds in it)  # auto-reset via __iter__
+        assert n2 == 250
+
+    def test_async_iterator_equivalence(self):
+        base = MnistDataSetIterator(batch_size=64, train=True,
+                                    num_examples=256, shuffle=False)
+        async_it = AsyncDataSetIterator(
+            MnistDataSetIterator(batch_size=64, train=True,
+                                 num_examples=256, shuffle=False))
+        a = [ds.features for ds in base]
+        b = [ds.features for ds in async_it]
+        assert len(a) == len(b)
+        for x, y in zip(a, b):
+            np.testing.assert_array_equal(x, y)
+
+    def test_normalizer_preprocessor_hook(self):
+        it = MnistDataSetIterator(batch_size=64, train=True,
+                                  num_examples=64)
+        scaler = ImagePreProcessingScaler(0.0, 1.0, max_pixel=1.0)
+        it.set_pre_processor(scaler)
+        ds = it.next()
+        assert ds.features.max() <= 1.0
+
+
+class TestNormalizers:
+    def test_standardize_round_trip(self):
+        from deeplearning4j_tpu.datasets import (DataSet,
+                                                 NormalizerStandardize)
+        rng = np.random.RandomState(0)
+        x = (rng.randn(100, 5) * 7 + 3).astype(np.float32)
+        ds = DataSet(x.copy(), np.zeros((100, 1), np.float32))
+        norm = NormalizerStandardize()
+        norm.fit(ds)
+        norm.transform(ds)
+        np.testing.assert_allclose(ds.features.mean(0), 0.0, atol=1e-4)
+        np.testing.assert_allclose(ds.features.std(0), 1.0, atol=1e-2)
+        norm.revert(ds)
+        np.testing.assert_allclose(ds.features, x, rtol=1e-3, atol=1e-3)
+
+    def test_minmax(self):
+        from deeplearning4j_tpu.datasets import (DataSet,
+                                                 NormalizerMinMaxScaler)
+        rng = np.random.RandomState(0)
+        x = (rng.rand(50, 3) * 10 - 5).astype(np.float32)
+        ds = DataSet(x, np.zeros((50, 1), np.float32))
+        norm = NormalizerMinMaxScaler()
+        norm.fit(ds)
+        norm.transform(ds)
+        assert ds.features.min() >= -1e-6
+        assert ds.features.max() <= 1.0 + 1e-6
+
+    def test_normalizer_serde(self):
+        from deeplearning4j_tpu.datasets import (DataSet,
+                                                 NormalizerStandardize)
+        from deeplearning4j_tpu.datasets.normalizers import Normalizer
+        x = np.random.RandomState(0).randn(20, 4).astype(np.float32)
+        norm = NormalizerStandardize()
+        norm.fit(DataSet(x, np.zeros((20, 1))))
+        back = Normalizer.from_map(norm.to_map())
+        np.testing.assert_allclose(back.mean, norm.mean)
+
+
+class TestEvaluation:
+    def test_evaluation_metrics(self):
+        from deeplearning4j_tpu.evaluation import Evaluation
+        ev = Evaluation()
+        labels = np.eye(3)[[0, 0, 1, 1, 2, 2]]
+        preds = np.eye(3)[[0, 1, 1, 1, 2, 0]]  # 4/6 correct
+        ev.eval(labels, preds)
+        assert ev.accuracy() == pytest.approx(4 / 6)
+        assert ev.confusion_matrix()[0, 1] == 1
+        assert "Accuracy" in ev.stats()
+
+    def test_evaluation_with_mask(self):
+        from deeplearning4j_tpu.evaluation import Evaluation
+        ev = Evaluation()
+        labels = np.eye(2)[[0, 1, 1]]
+        preds = np.eye(2)[[0, 0, 0]]
+        mask = np.array([1.0, 1.0, 0.0])
+        ev.eval(labels, preds, mask=mask)
+        assert ev.confusion.sum() == 2
+        assert ev.accuracy() == pytest.approx(0.5)
+
+    def test_roc_auc(self):
+        from deeplearning4j_tpu.evaluation import ROC
+        roc = ROC()
+        labels = np.array([0, 0, 1, 1])
+        scores = np.array([0.1, 0.4, 0.35, 0.8])
+        roc.eval(labels, scores)
+        assert roc.calculate_auc() == pytest.approx(0.75)
+
+    def test_regression_eval(self):
+        from deeplearning4j_tpu.evaluation import RegressionEvaluation
+        ev = RegressionEvaluation()
+        y = np.array([[1.0], [2.0], [3.0]])
+        p = np.array([[1.1], [1.9], [3.2]])
+        ev.eval(y, p)
+        assert ev.mean_squared_error(0) == pytest.approx(
+            (0.01 + 0.01 + 0.04) / 3)
+        assert ev.r_squared(0) > 0.95

@@ -1,0 +1,278 @@
+"""MultiLayerNetwork tests: config building, shape inference, JSON
+round-trip, training convergence (reference test style: GradientCheckTests /
+MultiLayerTest equivalents, SURVEY.md section 4.5/4.8)."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.activations import Activation
+from deeplearning4j_tpu.learning import Adam, Sgd
+from deeplearning4j_tpu.lossfunctions import LossFunction
+from deeplearning4j_tpu.nn import (InputType, MultiLayerConfiguration,
+                                   MultiLayerNetwork, NeuralNetConfiguration)
+from deeplearning4j_tpu.nn.conf.builders import GradientNormalization
+from deeplearning4j_tpu.nn.conf.layers import (
+    ActivationLayer, BatchNormalization, ConvolutionLayer, DenseLayer,
+    DropoutLayer, EmbeddingLayer, GlobalPoolingLayer, OutputLayer,
+    PoolingType, SubsamplingLayer)
+from deeplearning4j_tpu.nn.weights import WeightInit
+
+
+def _mlp_conf(updater=None):
+    return (NeuralNetConfiguration.Builder()
+            .seed(42)
+            .updater(updater or Adam(1e-2))
+            .weight_init(WeightInit.XAVIER)
+            .list()
+            .layer(DenseLayer(n_out=32, activation=Activation.RELU))
+            .layer(DenseLayer(n_out=32, activation=Activation.RELU))
+            .layer(OutputLayer(n_out=3,
+                               loss_function=LossFunction.MCXENT,
+                               activation=Activation.SOFTMAX))
+            .set_input_type(InputType.feed_forward(4))
+            .build())
+
+
+def _toy_classification(n=256, seed=0):
+    """3-class linearly-separable-ish blobs, 4 features."""
+    rng = np.random.RandomState(seed)
+    centers = np.array([[2, 0, 0, 0], [0, 2, 0, 0], [0, 0, 2, 0]],
+                       dtype=np.float32)
+    ys = rng.randint(0, 3, size=n)
+    xs = centers[ys] + 0.3 * rng.randn(n, 4).astype(np.float32)
+    labels = np.eye(3, dtype=np.float32)[ys]
+    return xs, labels, ys
+
+
+class TestConfig:
+    def test_shape_inference(self):
+        conf = _mlp_conf()
+        assert conf.layers[0].n_in == 4
+        assert conf.layers[1].n_in == 32
+        assert conf.layers[2].n_in == 32
+
+    def test_json_round_trip(self):
+        conf = _mlp_conf()
+        js = conf.to_json()
+        back = MultiLayerConfiguration.from_json(js)
+        assert len(back.layers) == 3
+        assert back.layers[0].n_out == 32
+        assert back.layers[2].loss_function == LossFunction.MCXENT
+        assert back.updater == conf.updater
+        assert back.to_json() == js
+
+    def test_cnn_shape_inference_and_preprocessors(self):
+        conf = (NeuralNetConfiguration.Builder()
+                .updater(Sgd(0.1))
+                .list()
+                .layer(ConvolutionLayer(kernel_size=(5, 5), n_out=8,
+                                        stride=(1, 1)))
+                .layer(SubsamplingLayer(pooling_type=PoolingType.MAX,
+                                        kernel_size=(2, 2), stride=(2, 2)))
+                .layer(DenseLayer(n_out=16, activation=Activation.RELU))
+                .layer(OutputLayer(n_out=10))
+                .set_input_type(InputType.convolutional_flat(28, 28, 1))
+                .build())
+        # flat input -> conv needs FF->CNN preprocessor at 0
+        assert 0 in conf.input_preprocessors
+        # pool output (12x12x8) -> dense needs CNN->FF at 2
+        assert 2 in conf.input_preprocessors
+        assert conf.layers[0].n_in == 1
+        assert conf.layers[2].n_in == 12 * 12 * 8
+
+    def test_builder_parity_chain(self):
+        # reference-style fluent Layer.Builder chains
+        layer = (DenseLayer.Builder()
+                 .n_out(64)
+                 .activation(Activation.TANH)
+                 .build())
+        assert layer.n_out == 64
+        assert layer.activation is Activation.TANH
+        conv = ConvolutionLayer.Builder(5, 5).n_out(20).build()
+        assert conv.kernel_size == (5, 5)
+
+
+class TestTraining:
+    def test_mlp_converges(self):
+        xs, labels, ys = _toy_classification()
+        net = MultiLayerNetwork(_mlp_conf()).init()
+        loss0 = None
+        for epoch in range(30):
+            net.fit(xs, labels)
+            if loss0 is None:
+                loss0 = net.score()
+        assert net.score() < 0.3 * loss0
+        preds = net.predict(xs)
+        acc = float(np.mean(preds == ys))
+        assert acc > 0.9
+
+    def test_output_probabilities(self):
+        xs, labels, _ = _toy_classification(32)
+        net = MultiLayerNetwork(_mlp_conf()).init()
+        out = net.output(xs)
+        assert out.shape == (32, 3)
+        np.testing.assert_allclose(np.asarray(jnp.sum(out, -1)),
+                                   np.ones(32), rtol=1e-5)
+
+    def test_score_decreases_with_sgd_and_gradient_clipping(self):
+        xs, labels, _ = _toy_classification()
+        conf = (NeuralNetConfiguration.Builder()
+                .seed(1)
+                .updater(Sgd(0.5))
+                .gradient_normalization(
+                    GradientNormalization.CLIP_L2_PER_LAYER)
+                .gradient_normalization_threshold(1.0)
+                .list()
+                .layer(DenseLayer(n_out=16, activation=Activation.TANH))
+                .layer(OutputLayer(n_out=3))
+                .set_input_type(InputType.feed_forward(4))
+                .build())
+        net = MultiLayerNetwork(conf).init()
+        for _ in range(20):
+            net.fit(xs, labels)
+        ds = type("DS", (), {"features": xs, "labels": labels})()
+        assert net.score(ds) < 1.0
+
+    def test_l2_regularization_included_in_score(self):
+        xs, labels, _ = _toy_classification(16)
+        conf_reg = (NeuralNetConfiguration.Builder().seed(3)
+                    .updater(Sgd(0.0)).l2(10.0).list()
+                    .layer(DenseLayer(n_out=8))
+                    .layer(OutputLayer(n_out=3))
+                    .set_input_type(InputType.feed_forward(4)).build())
+        conf_no = (NeuralNetConfiguration.Builder().seed(3)
+                   .updater(Sgd(0.0)).list()
+                   .layer(DenseLayer(n_out=8))
+                   .layer(OutputLayer(n_out=3))
+                   .set_input_type(InputType.feed_forward(4)).build())
+        ds = type("DS", (), {"features": xs, "labels": labels})()
+        s_reg = MultiLayerNetwork(conf_reg).init().score(ds)
+        s_no = MultiLayerNetwork(conf_no).init().score(ds)
+        assert s_reg > s_no + 0.1
+
+    def test_batchnorm_state_updates(self):
+        xs = np.random.RandomState(0).randn(64, 4).astype(np.float32) * 5
+        labels = np.eye(3, dtype=np.float32)[
+            np.random.RandomState(1).randint(0, 3, 64)]
+        conf = (NeuralNetConfiguration.Builder().seed(0)
+                .updater(Sgd(0.01)).list()
+                .layer(DenseLayer(n_out=8))
+                .layer(BatchNormalization())
+                .layer(OutputLayer(n_out=3))
+                .set_input_type(InputType.feed_forward(4)).build())
+        net = MultiLayerNetwork(conf).init()
+        before = np.asarray(net.states["layer_1"]["mean"]).copy()
+        net.fit(xs, labels)
+        after = np.asarray(net.states["layer_1"]["mean"])
+        assert not np.allclose(before, after)
+
+    def test_dropout_only_in_training(self):
+        xs = np.ones((8, 4), dtype=np.float32)
+        conf = (NeuralNetConfiguration.Builder().seed(0)
+                .updater(Sgd(0.1)).list()
+                .layer(DenseLayer(n_out=16, dropout=0.5))
+                .layer(OutputLayer(n_out=3))
+                .set_input_type(InputType.feed_forward(4)).build())
+        net = MultiLayerNetwork(conf).init()
+        o1 = np.asarray(net.output(xs))
+        o2 = np.asarray(net.output(xs))
+        np.testing.assert_allclose(o1, o2)  # inference is deterministic
+
+    def test_embedding_global_pooling(self):
+        # tiny bag-of-tokens classifier
+        rng = np.random.RandomState(0)
+        tokens = rng.randint(0, 20, size=(32, 6)).astype(np.int32)
+        labels = np.eye(2, dtype=np.float32)[(tokens.sum(-1) % 2)]
+        conf = (NeuralNetConfiguration.Builder().seed(0)
+                .updater(Adam(1e-2)).list()
+                .layer(EmbeddingLayer(n_in=20, n_out=8))
+                .layer(GlobalPoolingLayer(pooling_type=PoolingType.AVG))
+                .layer(OutputLayer(n_in=8, n_out=2))
+                .build())
+        net = MultiLayerNetwork(conf).init()
+        out = net.output(tokens)
+        assert out.shape == (32, 2)
+
+    def test_param_table_and_clone(self):
+        net = MultiLayerNetwork(_mlp_conf()).init()
+        table = net.param_table()
+        assert "0_W" in table and "0_b" in table and "2_W" in table
+        assert net.num_params() == sum(int(np.prod(v.shape))
+                                       for k, v in table.items()
+                                       if not k.endswith(("mean", "var")))
+        c = net.clone()
+        xs = np.ones((2, 4), dtype=np.float32)
+        np.testing.assert_allclose(np.asarray(net.output(xs)),
+                                   np.asarray(c.output(xs)))
+
+    def test_summary(self):
+        net = MultiLayerNetwork(_mlp_conf()).init()
+        s = net.summary()
+        assert "Total params" in s
+
+
+class TestCnnTraining:
+    def test_small_cnn_trains(self):
+        rng = np.random.RandomState(0)
+        xs = rng.randn(64, 8 * 8).astype(np.float32)
+        ys = (xs.reshape(64, 8, 8).mean((1, 2)) > 0).astype(int)
+        labels = np.eye(2, dtype=np.float32)[ys]
+        conf = (NeuralNetConfiguration.Builder().seed(0)
+                .updater(Adam(1e-2)).list()
+                .layer(ConvolutionLayer(kernel_size=(3, 3), n_out=4,
+                                        activation=Activation.RELU))
+                .layer(SubsamplingLayer(kernel_size=(2, 2), stride=(2, 2)))
+                .layer(DenseLayer(n_out=16, activation=Activation.RELU))
+                .layer(OutputLayer(n_out=2))
+                .set_input_type(InputType.convolutional_flat(8, 8, 1))
+                .build())
+        net = MultiLayerNetwork(conf).init()
+        for _ in range(30):
+            net.fit(xs, labels)
+        acc = float(np.mean(net.predict(xs) == ys))
+        assert acc > 0.85
+
+
+class TestGradients:
+    def test_analytic_vs_numeric_gradient(self):
+        """Reference GradientCheckUtil pattern (SURVEY.md section 4.5):
+        central-difference check in float64."""
+        jax.config.update("jax_enable_x64", True)
+        try:
+            xs = np.random.RandomState(0).randn(4, 3)
+            labels = np.eye(2)[np.random.RandomState(1).randint(0, 2, 4)]
+            conf = (NeuralNetConfiguration.Builder().seed(0)
+                    .updater(Sgd(0.1)).data_type("float64").list()
+                    .layer(DenseLayer(n_out=5, activation=Activation.TANH))
+                    .layer(OutputLayer(n_out=2))
+                    .set_input_type(InputType.feed_forward(3)).build())
+            net = MultiLayerNetwork(conf).init()
+            out_layer = net.output_layer_conf
+
+            def loss(params):
+                out, _ = net._forward(params, net.states,
+                                      jnp.asarray(xs), training=False,
+                                      rng=None, want_logits=True)
+                return out_layer.compute_loss(jnp.asarray(labels), out,
+                                              from_logits=True)
+
+            analytic = jax.grad(loss)(net.params)
+            eps = 1e-6
+            for lk in ("layer_0", "layer_1"):
+                W = net.params[lk]["W"]
+                flatW = np.asarray(W).ravel()
+                for idx in [0, flatW.size // 2, flatW.size - 1]:
+                    delta = np.zeros_like(flatW)
+                    delta[idx] = eps
+                    d = delta.reshape(W.shape)
+                    p_plus = dict(net.params)
+                    p_plus[lk] = dict(net.params[lk], W=W + d)
+                    p_minus = dict(net.params)
+                    p_minus[lk] = dict(net.params[lk], W=W - d)
+                    num = (float(loss(p_plus)) - float(loss(p_minus))) / \
+                        (2 * eps)
+                    ana = float(np.asarray(analytic[lk]["W"]).ravel()[idx])
+                    assert abs(num - ana) < 1e-5, (lk, idx, num, ana)
+        finally:
+            jax.config.update("jax_enable_x64", False)
