@@ -1,0 +1,2 @@
+from deeplearning4j_tpu.models.zoo import (  # noqa: F401
+    ZooModel, LeNet, SimpleCNN, VGG16, VGG19, ResNet50, AlexNet)

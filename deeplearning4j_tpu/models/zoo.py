@@ -1,0 +1,321 @@
+"""Model zoo.
+
+Reference parity: ``org.deeplearning4j.zoo.**`` (SURVEY.md D15): ``ZooModel``
+base with ``init()`` building the network; ``LeNet``, ``SimpleCNN``,
+``VGG16/19``, ``ResNet50``, ``AlexNet`` first (the BASELINE configs need
+LeNet + ResNet50). Pretrained-weight download (``initPretrained``) is a
+checkpoint-load hook here — this container has no egress, so weights load
+from a local path.
+
+Architectures follow the reference zoo's configurations; layouts are NHWC
+(TPU-first). ResNet50 is the BASELINE.json north-star model: a
+ComputationGraph of bottleneck residual blocks whose conv+BN+add lower to
+fused XLA ops on the MXU.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Optional, Tuple
+
+from deeplearning4j_tpu.activations import Activation
+from deeplearning4j_tpu.learning.updaters import Adam, IUpdater, Nesterovs
+from deeplearning4j_tpu.lossfunctions import LossFunction
+from deeplearning4j_tpu.nn.conf.builders import NeuralNetConfiguration
+from deeplearning4j_tpu.nn.conf.graph_vertices import ElementWiseVertex
+from deeplearning4j_tpu.nn.conf.inputs import InputType
+from deeplearning4j_tpu.nn.conf.layers import (
+    BatchNormalization, ConvolutionLayer, ConvolutionMode, DenseLayer,
+    DropoutLayer, GlobalPoolingLayer, OutputLayer, PoolingType,
+    SubsamplingLayer)
+from deeplearning4j_tpu.nn.graph import ComputationGraph
+from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+from deeplearning4j_tpu.nn.weights import WeightInit
+
+
+class ZooModel:
+    """Base (reference: org.deeplearning4j.zoo.ZooModel)."""
+
+    def init(self):
+        """Build and initialize the network."""
+        raise NotImplementedError
+
+    def init_pretrained(self, path):
+        """Load pretrained weights from a local checkpoint zip
+        (reference downloads+caches; zero-egress here). The model class
+        is read from the checkpoint's meta.json — no throwaway build."""
+        import json
+        import zipfile
+        from deeplearning4j_tpu.utils import ModelSerializer
+        from deeplearning4j_tpu.utils.serializer import META_ENTRY
+        with zipfile.ZipFile(path) as zf:
+            meta = json.loads(zf.read(META_ENTRY).decode()) \
+                if META_ENTRY in zf.namelist() else {}
+        if meta.get("model_class") == "ComputationGraph":
+            return ModelSerializer.restore_computation_graph(path)
+        return ModelSerializer.restore_multi_layer_network(path)
+
+    def meta_data(self) -> dict:
+        return {"name": type(self).__name__}
+
+
+@dataclass
+class LeNet(ZooModel):
+    """Reference: org.deeplearning4j.zoo.model.LeNet (MNIST-class)."""
+    num_classes: int = 10
+    height: int = 28
+    width: int = 28
+    channels: int = 1
+    seed: int = 123
+    updater: Optional[IUpdater] = None
+
+    def init(self) -> MultiLayerNetwork:
+        conf = (NeuralNetConfiguration.Builder()
+                .seed(self.seed)
+                .updater(self.updater or Adam(1e-3))
+                .weight_init(WeightInit.XAVIER)
+                .list()
+                .layer(ConvolutionLayer(kernel_size=(5, 5), n_out=20,
+                                        stride=(1, 1),
+                                        activation=Activation.IDENTITY))
+                .layer(SubsamplingLayer(pooling_type=PoolingType.MAX,
+                                        kernel_size=(2, 2),
+                                        stride=(2, 2)))
+                .layer(ConvolutionLayer(kernel_size=(5, 5), n_out=50,
+                                        stride=(1, 1),
+                                        activation=Activation.IDENTITY))
+                .layer(SubsamplingLayer(pooling_type=PoolingType.MAX,
+                                        kernel_size=(2, 2),
+                                        stride=(2, 2)))
+                .layer(DenseLayer(n_out=500,
+                                  activation=Activation.RELU))
+                .layer(OutputLayer(
+                    n_out=self.num_classes,
+                    loss_function=LossFunction.NEGATIVELOGLIKELIHOOD,
+                    activation=Activation.SOFTMAX))
+                .set_input_type(InputType.convolutional_flat(
+                    self.height, self.width, self.channels))
+                .build())
+        return MultiLayerNetwork(conf).init()
+
+
+@dataclass
+class SimpleCNN(ZooModel):
+    """Reference: org.deeplearning4j.zoo.model.SimpleCNN."""
+    num_classes: int = 10
+    height: int = 48
+    width: int = 48
+    channels: int = 3
+    seed: int = 123
+
+    def init(self) -> MultiLayerNetwork:
+        def conv(n, k=(3, 3)):
+            return ConvolutionLayer(kernel_size=k, n_out=n,
+                                    convolution_mode=ConvolutionMode.SAME,
+                                    activation=Activation.IDENTITY)
+        b = (NeuralNetConfiguration.Builder()
+             .seed(self.seed).updater(Adam(1e-3))
+             .weight_init(WeightInit.RELU).list())
+        for n in (16, 16):
+            b = b.layer(conv(n)).layer(BatchNormalization(
+                activation=Activation.RELU))
+        b = b.layer(SubsamplingLayer(kernel_size=(2, 2), stride=(2, 2)))
+        for n in (32, 32):
+            b = b.layer(conv(n)).layer(BatchNormalization(
+                activation=Activation.RELU))
+        b = b.layer(SubsamplingLayer(kernel_size=(2, 2), stride=(2, 2)))
+        conf = (b.layer(GlobalPoolingLayer(pooling_type=PoolingType.AVG))
+                .layer(DropoutLayer(dropout=0.5))
+                .layer(OutputLayer(n_out=self.num_classes))
+                .set_input_type(InputType.convolutional(
+                    self.height, self.width, self.channels))
+                .build())
+        return MultiLayerNetwork(conf).init()
+
+
+@dataclass
+class AlexNet(ZooModel):
+    """Reference: org.deeplearning4j.zoo.model.AlexNet (single-stream)."""
+    num_classes: int = 1000
+    height: int = 224
+    width: int = 224
+    channels: int = 3
+    seed: int = 123
+
+    def init(self) -> MultiLayerNetwork:
+        conf = (NeuralNetConfiguration.Builder()
+                .seed(self.seed)
+                .updater(Nesterovs(1e-2, 0.9))
+                .weight_init(WeightInit.RELU)
+                .l2(5e-4)
+                .list()
+                .layer(ConvolutionLayer(kernel_size=(11, 11), n_out=96,
+                                        stride=(4, 4),
+                                        activation=Activation.RELU))
+                .layer(SubsamplingLayer(kernel_size=(3, 3),
+                                        stride=(2, 2)))
+                .layer(ConvolutionLayer(kernel_size=(5, 5), n_out=256,
+                                        convolution_mode=ConvolutionMode
+                                        .SAME,
+                                        activation=Activation.RELU))
+                .layer(SubsamplingLayer(kernel_size=(3, 3),
+                                        stride=(2, 2)))
+                .layer(ConvolutionLayer(kernel_size=(3, 3), n_out=384,
+                                        convolution_mode=ConvolutionMode
+                                        .SAME,
+                                        activation=Activation.RELU))
+                .layer(ConvolutionLayer(kernel_size=(3, 3), n_out=384,
+                                        convolution_mode=ConvolutionMode
+                                        .SAME,
+                                        activation=Activation.RELU))
+                .layer(ConvolutionLayer(kernel_size=(3, 3), n_out=256,
+                                        convolution_mode=ConvolutionMode
+                                        .SAME,
+                                        activation=Activation.RELU))
+                .layer(SubsamplingLayer(kernel_size=(3, 3),
+                                        stride=(2, 2)))
+                .layer(DenseLayer(n_out=4096, dropout=0.5,
+                                  activation=Activation.RELU))
+                .layer(DenseLayer(n_out=4096, dropout=0.5,
+                                  activation=Activation.RELU))
+                .layer(OutputLayer(n_out=self.num_classes))
+                .set_input_type(InputType.convolutional(
+                    self.height, self.width, self.channels))
+                .build())
+        return MultiLayerNetwork(conf).init()
+
+
+def _vgg(blocks, num_classes, height, width, channels, seed):
+    b = (NeuralNetConfiguration.Builder()
+         .seed(seed).updater(Nesterovs(1e-2, 0.9))
+         .weight_init(WeightInit.RELU).list())
+    for n_convs, n_out in blocks:
+        for _ in range(n_convs):
+            b = b.layer(ConvolutionLayer(
+                kernel_size=(3, 3), n_out=n_out,
+                convolution_mode=ConvolutionMode.SAME,
+                activation=Activation.RELU))
+        b = b.layer(SubsamplingLayer(kernel_size=(2, 2), stride=(2, 2)))
+    conf = (b.layer(DenseLayer(n_out=4096, activation=Activation.RELU,
+                               dropout=0.5))
+            .layer(DenseLayer(n_out=4096, activation=Activation.RELU,
+                              dropout=0.5))
+            .layer(OutputLayer(n_out=num_classes))
+            .set_input_type(InputType.convolutional(height, width,
+                                                    channels))
+            .build())
+    return MultiLayerNetwork(conf).init()
+
+
+@dataclass
+class VGG16(ZooModel):
+    """Reference: org.deeplearning4j.zoo.model.VGG16."""
+    num_classes: int = 1000
+    height: int = 224
+    width: int = 224
+    channels: int = 3
+    seed: int = 123
+
+    def init(self) -> MultiLayerNetwork:
+        return _vgg([(2, 64), (2, 128), (3, 256), (3, 512), (3, 512)],
+                    self.num_classes, self.height, self.width,
+                    self.channels, self.seed)
+
+
+@dataclass
+class VGG19(ZooModel):
+    """Reference: org.deeplearning4j.zoo.model.VGG19."""
+    num_classes: int = 1000
+    height: int = 224
+    width: int = 224
+    channels: int = 3
+    seed: int = 123
+
+    def init(self) -> MultiLayerNetwork:
+        return _vgg([(2, 64), (2, 128), (4, 256), (4, 512), (4, 512)],
+                    self.num_classes, self.height, self.width,
+                    self.channels, self.seed)
+
+
+@dataclass
+class ResNet50(ZooModel):
+    """Reference: org.deeplearning4j.zoo.model.ResNet50 — the
+    BASELINE.json north-star model (ComputationGraph, conv/BN/pool
+    lowerings). Standard [3, 4, 6, 3] bottleneck architecture, NHWC.
+    """
+    num_classes: int = 1000
+    height: int = 224
+    width: int = 224
+    channels: int = 3
+    seed: int = 123
+    updater: Optional[IUpdater] = None
+
+    # stage definitions: (n_blocks, bottleneck_width)
+    STAGES: Tuple[Tuple[int, int], ...] = ((3, 64), (4, 128), (6, 256),
+                                           (3, 512))
+
+    def init(self) -> ComputationGraph:
+        g = (NeuralNetConfiguration.Builder()
+             .seed(self.seed)
+             .updater(self.updater or Nesterovs(1e-1, 0.9))
+             .weight_init(WeightInit.RELU)
+             .l2(1e-4)
+             .graph_builder()
+             .add_inputs("input")
+             .set_input_types(InputType.convolutional(
+                 self.height, self.width, self.channels)))
+
+        def conv_bn(name, inp, n_out, kernel, stride, act=True):
+            g.add_layer(f"{name}_conv",
+                        ConvolutionLayer(
+                            kernel_size=kernel, n_out=n_out,
+                            stride=stride,
+                            convolution_mode=ConvolutionMode.SAME,
+                            has_bias=False,
+                            activation=Activation.IDENTITY), inp)
+            g.add_layer(f"{name}_bn",
+                        BatchNormalization(
+                            activation=Activation.RELU if act
+                            else Activation.IDENTITY), f"{name}_conv")
+            return f"{name}_bn"
+
+        # stem
+        last = conv_bn("stem", "input", 64, (7, 7), (2, 2))
+        g.add_layer("stem_pool",
+                    SubsamplingLayer(pooling_type=PoolingType.MAX,
+                                     kernel_size=(3, 3), stride=(2, 2),
+                                     convolution_mode=ConvolutionMode.SAME),
+                    last)
+        last = "stem_pool"
+
+        for si, (n_blocks, width) in enumerate(self.STAGES):
+            for bi in range(n_blocks):
+                name = f"s{si}b{bi}"
+                stride = (2, 2) if (bi == 0 and si > 0) else (1, 1)
+                a = conv_bn(f"{name}_a", last, width, (1, 1), stride)
+                b = conv_bn(f"{name}_b", a, width, (3, 3), (1, 1))
+                c = conv_bn(f"{name}_c", b, width * 4, (1, 1), (1, 1),
+                            act=False)
+                if bi == 0:
+                    sc = conv_bn(f"{name}_sc", last, width * 4, (1, 1),
+                                 stride, act=False)
+                else:
+                    sc = last
+                g.add_vertex(f"{name}_add",
+                             ElementWiseVertex(ElementWiseVertex.Op.Add),
+                             c, sc)
+                g.add_layer(f"{name}_relu", _relu_layer(), f"{name}_add")
+                last = f"{name}_relu"
+
+        g.add_layer("avgpool",
+                    GlobalPoolingLayer(pooling_type=PoolingType.AVG), last)
+        g.add_layer("output",
+                    OutputLayer(n_out=self.num_classes,
+                                loss_function=LossFunction.MCXENT,
+                                activation=Activation.SOFTMAX), "avgpool")
+        conf = g.set_outputs("output").build()
+        return ComputationGraph(conf).init()
+
+
+def _relu_layer():
+    from deeplearning4j_tpu.nn.conf.layers import ActivationLayer
+    return ActivationLayer(activation=Activation.RELU)

@@ -166,6 +166,21 @@ def _default_preprocessor(cur: InputType, layer: Layer):
     return None
 
 
+def apply_layer_defaults(layer: Layer, base: "NeuralNetConfiguration.Builder"):
+    """Flow global builder defaults down to a layer that didn't override
+    them (shared by ListBuilder and GraphBuilder)."""
+    if layer.updater is None:
+        layer.updater = base._updater
+    if layer.weight_init is None:
+        layer.weight_init = base._weight_init
+    if layer.l1 is None:
+        layer.l1 = base._l1
+    if layer.l2 is None:
+        layer.l2 = base._l2
+    if layer.dropout is None and base._dropout is not None:
+        layer.dropout = base._dropout
+
+
 class ListBuilder:
     """Reference: NeuralNetConfiguration.ListBuilder."""
 
@@ -224,21 +239,8 @@ class ListBuilder:
             dtype=b._dtype,
             input_type=self._input_type,
         )
-        # apply global defaults to layers that didn't override
         for l in conf.layers:
-            if l.updater is None:
-                l.updater = b._updater
-            if l.weight_init is None:
-                l.weight_init = b._weight_init
-            if l.l1 is None:
-                l.l1 = b._l1
-            if l.l2 is None:
-                l.l2 = b._l2
-            if l.dropout is None and b._dropout is not None:
-                l.dropout = b._dropout
-            if b._activation is not None and "activation" not in \
-                    getattr(l, "_explicit", ()):
-                pass  # per-layer activation defaults stay as declared
+            apply_layer_defaults(l, b)
         conf.resolve_shapes()
         return conf
 
@@ -309,6 +311,5 @@ class NeuralNetConfiguration:
             return ListBuilder(self)
 
         def graph_builder(self):
-            from deeplearning4j_tpu.nn.conf.graph_builders import \
-                GraphBuilder
+            from deeplearning4j_tpu.nn.conf.graph_conf import GraphBuilder
             return GraphBuilder(self)

@@ -1,0 +1,225 @@
+"""ComputationGraphConfiguration + GraphBuilder.
+
+Reference parity: ``org.deeplearning4j.nn.conf.ComputationGraphConfiguration``
+and ``NeuralNetConfiguration.Builder().graphBuilder()`` (SURVEY.md D1/D3):
+addInputs / addLayer / addVertex / setOutputs / setInputTypes, topo-sorted
+DAG with per-vertex input lists, JSON round-trip.
+"""
+from __future__ import annotations
+
+import json
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Union
+
+from deeplearning4j_tpu.learning.updaters import IUpdater, Sgd
+from deeplearning4j_tpu.nn.conf.builders import (BackpropType,
+                                                 GradientNormalization)
+from deeplearning4j_tpu.nn.conf.graph_vertices import GraphVertex
+from deeplearning4j_tpu.nn.conf.inputs import InputType
+from deeplearning4j_tpu.nn.conf.layers import Layer
+from deeplearning4j_tpu.nn.conf.preprocessors import InputPreProcessor
+from deeplearning4j_tpu.nn.weights import WeightInit
+
+
+@dataclass
+class VertexDef:
+    """One node: a Layer or a GraphVertex + its input vertex names."""
+    name: str
+    content: Union[Layer, GraphVertex]
+    inputs: List[str]
+    preprocessor: Optional[InputPreProcessor] = None
+
+    @property
+    def is_layer(self) -> bool:
+        return isinstance(self.content, Layer)
+
+
+@dataclass
+class ComputationGraphConfiguration:
+    network_inputs: List[str] = field(default_factory=list)
+    network_outputs: List[str] = field(default_factory=list)
+    vertices: Dict[str, VertexDef] = field(default_factory=dict)
+    input_types: List[InputType] = field(default_factory=list)
+    seed: int = 12345
+    updater: IUpdater = field(default_factory=lambda: Sgd(1e-3))
+    weight_init: WeightInit = WeightInit.XAVIER
+    l1: float = 0.0
+    l2: float = 0.0
+    gradient_normalization: GradientNormalization = \
+        GradientNormalization.NONE
+    gradient_normalization_threshold: float = 1.0
+    backprop_type: BackpropType = BackpropType.STANDARD
+    tbptt_fwd_length: int = 20
+    tbptt_back_length: int = 20
+    dtype: str = "float32"
+
+    # ------------------------------------------------------------------
+    def topo_order(self) -> List[str]:
+        """Topologically sorted vertex names (inputs excluded)."""
+        order: List[str] = []
+        visited: Dict[str, int] = {}  # 0=visiting, 1=done
+
+        def visit(name: str):
+            if name in self.network_inputs:
+                return
+            st = visited.get(name)
+            if st == 1:
+                return
+            if st == 0:
+                raise ValueError(f"cycle at vertex {name!r}")
+            visited[name] = 0
+            for dep in self.vertices[name].inputs:
+                visit(dep)
+            visited[name] = 1
+            order.append(name)
+
+        for name in self.vertices:
+            visit(name)
+        return order
+
+    # -- shape inference -------------------------------------------------
+    def resolve_shapes(self):
+        if not self.input_types:
+            return
+        types: Dict[str, InputType] = dict(zip(self.network_inputs,
+                                               self.input_types))
+        from deeplearning4j_tpu.nn.conf.builders import \
+            _default_preprocessor
+        for name in self.topo_order():
+            v = self.vertices[name]
+            in_types = [types[i] for i in v.inputs]
+            cur = in_types[0] if in_types else None
+            if v.is_layer:
+                if v.preprocessor is None and cur is not None:
+                    v.preprocessor = _default_preprocessor(cur, v.content)
+                if v.preprocessor is not None:
+                    cur = v.preprocessor.get_output_type(cur)
+                v.content.set_n_in(cur, override=False)
+                types[name] = v.content.get_output_type(cur)
+            else:
+                types[name] = v.content.get_output_type(in_types)
+        self._resolved_types = types
+
+    # -- JSON --------------------------------------------------------
+    def to_json(self) -> str:
+        d = {
+            "network_inputs": self.network_inputs,
+            "network_outputs": self.network_outputs,
+            "vertices": [{
+                "name": v.name,
+                "kind": "layer" if v.is_layer else "vertex",
+                "content": v.content.to_map(),
+                "inputs": v.inputs,
+                "preprocessor": v.preprocessor.to_map()
+                                if v.preprocessor else None,
+            } for v in self.vertices.values()],
+            "input_types": [t.to_map() for t in self.input_types],
+            "seed": self.seed,
+            "updater": self.updater.to_map(),
+            "weight_init": self.weight_init.name,
+            "l1": self.l1, "l2": self.l2,
+            "gradient_normalization": self.gradient_normalization.name,
+            "gradient_normalization_threshold":
+                self.gradient_normalization_threshold,
+            "backprop_type": self.backprop_type.name,
+            "tbptt_fwd_length": self.tbptt_fwd_length,
+            "tbptt_back_length": self.tbptt_back_length,
+            "dtype": self.dtype,
+        }
+        return json.dumps(d, indent=2)
+
+    @staticmethod
+    def from_json(s: str) -> "ComputationGraphConfiguration":
+        d = json.loads(s)
+        conf = ComputationGraphConfiguration(
+            network_inputs=list(d["network_inputs"]),
+            network_outputs=list(d["network_outputs"]),
+            input_types=[InputType.from_map(t)
+                         for t in d.get("input_types", [])],
+            seed=d.get("seed", 12345),
+            updater=IUpdater.from_map(d["updater"]),
+            weight_init=WeightInit[d.get("weight_init", "XAVIER")],
+            l1=d.get("l1", 0.0), l2=d.get("l2", 0.0),
+            gradient_normalization=GradientNormalization[
+                d.get("gradient_normalization", "NONE")],
+            gradient_normalization_threshold=d.get(
+                "gradient_normalization_threshold", 1.0),
+            backprop_type=BackpropType[d.get("backprop_type", "STANDARD")],
+            tbptt_fwd_length=d.get("tbptt_fwd_length", 20),
+            tbptt_back_length=d.get("tbptt_back_length", 20),
+            dtype=d.get("dtype", "float32"),
+        )
+        for vd in d["vertices"]:
+            content = Layer.from_map(vd["content"]) \
+                if vd["kind"] == "layer" \
+                else GraphVertex.from_map(vd["content"])
+            conf.vertices[vd["name"]] = VertexDef(
+                vd["name"], content, list(vd["inputs"]),
+                InputPreProcessor.from_map(vd["preprocessor"])
+                if vd.get("preprocessor") else None)
+        conf.resolve_shapes()
+        return conf
+
+
+class GraphBuilder:
+    """Reference: NeuralNetConfiguration.Builder().graphBuilder()."""
+
+    def __init__(self, base):
+        self._base = base
+        self._conf = ComputationGraphConfiguration()
+
+    def add_inputs(self, *names: str) -> "GraphBuilder":
+        self._conf.network_inputs.extend(names)
+        return self
+
+    def set_input_types(self, *types: InputType) -> "GraphBuilder":
+        self._conf.input_types = list(types)
+        return self
+
+    def add_layer(self, name: str, layer: Layer,
+                  *inputs: str) -> "GraphBuilder":
+        # optional preprocessor as first input arg (reference overload)
+        pre = None
+        ins = list(inputs)
+        if ins and isinstance(ins[0], InputPreProcessor):
+            pre = ins.pop(0)
+        self._conf.vertices[name] = VertexDef(name, layer, ins, pre)
+        return self
+
+    def add_vertex(self, name: str, vertex: GraphVertex,
+                   *inputs: str) -> "GraphBuilder":
+        self._conf.vertices[name] = VertexDef(name, vertex, list(inputs))
+        return self
+
+    def set_outputs(self, *names: str) -> "GraphBuilder":
+        self._conf.network_outputs = list(names)
+        return self
+
+    def backprop_type(self, t: BackpropType) -> "GraphBuilder":
+        self._conf.backprop_type = t
+        return self
+
+    def t_bptt_length(self, fwd: int, back: int = None) -> "GraphBuilder":
+        self._conf.tbptt_fwd_length = fwd
+        self._conf.tbptt_back_length = back if back is not None else fwd
+        return self
+
+    def build(self) -> ComputationGraphConfiguration:
+        b = self._base
+        c = self._conf
+        c.seed = b._seed
+        c.updater = b._updater
+        c.weight_init = b._weight_init
+        c.l1, c.l2 = b._l1, b._l2
+        c.gradient_normalization = b._grad_norm
+        c.gradient_normalization_threshold = b._grad_norm_threshold
+        c.dtype = b._dtype
+        from deeplearning4j_tpu.nn.conf.builders import \
+            apply_layer_defaults
+        for v in c.vertices.values():
+            if v.is_layer:
+                apply_layer_defaults(v.content, b)
+        if not c.network_outputs:
+            raise ValueError("setOutputs(...) not called")
+        c.resolve_shapes()
+        return c

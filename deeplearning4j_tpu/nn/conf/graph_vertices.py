@@ -1,0 +1,218 @@
+"""Graph vertices: the DAG building blocks of ComputationGraph.
+
+Reference parity: ``org.deeplearning4j.nn.conf.graph.*`` configs and their
+``org.deeplearning4j.nn.graph.vertex.impl.*`` runtime twins (SURVEY.md D3):
+MergeVertex, ElementWiseVertex, SubsetVertex, ScaleVertex, ShiftVertex,
+StackVertex, UnstackVertex, PreprocessorVertex, L2NormalizeVertex. Layer
+vertices wrap a Layer config. All are pure functions fused by XLA.
+"""
+from __future__ import annotations
+
+import enum
+from dataclasses import dataclass, field
+from typing import List, Optional
+
+import jax.numpy as jnp
+
+from deeplearning4j_tpu.nn.conf.inputs import (InputType,
+                                               InputTypeConvolutional,
+                                               InputTypeFeedForward,
+                                               InputTypeRecurrent)
+from deeplearning4j_tpu.nn.conf.preprocessors import InputPreProcessor
+
+
+class GraphVertex:
+    """forward(inputs: list[Array]) -> Array; single-output vertices."""
+
+    def forward(self, inputs: list, *, training: bool = False):
+        raise NotImplementedError
+
+    def get_output_type(self, input_types: List[InputType]) -> InputType:
+        raise NotImplementedError
+
+    def to_map(self) -> dict:
+        d = {"@class": type(self).__name__}
+        for k, v in self.__dict__.items():
+            d[k] = v.name if isinstance(v, enum.Enum) else v
+        return d
+
+    @staticmethod
+    def from_map(d: dict) -> "GraphVertex":
+        d = dict(d)
+        cls = VERTEX_REGISTRY[d.pop("@class")]
+        if cls is ElementWiseVertex and isinstance(d.get("op"), str):
+            d["op"] = ElementWiseVertex.Op[d["op"]]
+        return cls(**d)
+
+
+@dataclass
+class MergeVertex(GraphVertex):
+    """Concatenate along the feature/channel (last) axis (reference:
+    MergeVertex — NCHW channel-1 there, NHWC channel-last here)."""
+
+    def forward(self, inputs, *, training=False):
+        return jnp.concatenate(inputs, axis=-1)
+
+    def get_output_type(self, input_types):
+        t0 = input_types[0]
+        if isinstance(t0, InputTypeConvolutional):
+            return InputType.convolutional(
+                t0.height, t0.width,
+                sum(t.channels for t in input_types))
+        if isinstance(t0, InputTypeRecurrent):
+            return InputType.recurrent(sum(t.size for t in input_types),
+                                       t0.timesteps)
+        return InputType.feed_forward(sum(t.size for t in input_types))
+
+
+@dataclass
+class ElementWiseVertex(GraphVertex):
+    class Op(enum.Enum):
+        Add = "add"
+        Subtract = "subtract"
+        Product = "product"
+        Average = "average"
+        Max = "max"
+
+    op: "ElementWiseVertex.Op" = None
+
+    def __post_init__(self):
+        if isinstance(self.op, str):
+            self.op = ElementWiseVertex.Op[self.op]
+        if self.op is None:
+            self.op = ElementWiseVertex.Op.Add
+
+    def forward(self, inputs, *, training=False):
+        op = self.op
+        if op is ElementWiseVertex.Op.Add:
+            out = inputs[0]
+            for x in inputs[1:]:
+                out = out + x
+            return out
+        if op is ElementWiseVertex.Op.Subtract:
+            assert len(inputs) == 2
+            return inputs[0] - inputs[1]
+        if op is ElementWiseVertex.Op.Product:
+            out = inputs[0]
+            for x in inputs[1:]:
+                out = out * x
+            return out
+        if op is ElementWiseVertex.Op.Average:
+            return sum(inputs) / len(inputs)
+        if op is ElementWiseVertex.Op.Max:
+            out = inputs[0]
+            for x in inputs[1:]:
+                out = jnp.maximum(out, x)
+            return out
+        raise ValueError(op)
+
+    def get_output_type(self, input_types):
+        return input_types[0]
+
+
+@dataclass
+class SubsetVertex(GraphVertex):
+    """Feature range [from_idx, to_idx] inclusive (reference: SubsetVertex)."""
+    from_idx: int = 0
+    to_idx: int = 0
+
+    def forward(self, inputs, *, training=False):
+        return inputs[0][..., self.from_idx:self.to_idx + 1]
+
+    def get_output_type(self, input_types):
+        n = self.to_idx - self.from_idx + 1
+        t = input_types[0]
+        if isinstance(t, InputTypeConvolutional):
+            return InputType.convolutional(t.height, t.width, n)
+        if isinstance(t, InputTypeRecurrent):
+            return InputType.recurrent(n, t.timesteps)
+        return InputType.feed_forward(n)
+
+
+@dataclass
+class ScaleVertex(GraphVertex):
+    scale_factor: float = 1.0
+
+    def forward(self, inputs, *, training=False):
+        return inputs[0] * self.scale_factor
+
+    def get_output_type(self, input_types):
+        return input_types[0]
+
+
+@dataclass
+class ShiftVertex(GraphVertex):
+    shift_factor: float = 0.0
+
+    def forward(self, inputs, *, training=False):
+        return inputs[0] + self.shift_factor
+
+    def get_output_type(self, input_types):
+        return input_types[0]
+
+
+@dataclass
+class StackVertex(GraphVertex):
+    """Stack along batch dim (reference: StackVertex)."""
+
+    def forward(self, inputs, *, training=False):
+        return jnp.concatenate(inputs, axis=0)
+
+    def get_output_type(self, input_types):
+        return input_types[0]
+
+
+@dataclass
+class UnstackVertex(GraphVertex):
+    """Slice the batch dim back apart (reference: UnstackVertex)."""
+    from_idx: int = 0
+    stack_size: int = 1
+
+    def forward(self, inputs, *, training=False):
+        x = inputs[0]
+        n = x.shape[0] // self.stack_size
+        return x[self.from_idx * n:(self.from_idx + 1) * n]
+
+    def get_output_type(self, input_types):
+        return input_types[0]
+
+
+@dataclass
+class L2NormalizeVertex(GraphVertex):
+    eps: float = 1e-8
+
+    def forward(self, inputs, *, training=False):
+        x = inputs[0]
+        axes = tuple(range(1, x.ndim))
+        n = jnp.sqrt(jnp.sum(x * x, axis=axes, keepdims=True) + self.eps)
+        return x / n
+
+    def get_output_type(self, input_types):
+        return input_types[0]
+
+
+@dataclass
+class PreprocessorVertex(GraphVertex):
+    preprocessor: Optional[InputPreProcessor] = None
+
+    def forward(self, inputs, *, training=False):
+        return self.preprocessor.pre_process(inputs[0])
+
+    def get_output_type(self, input_types):
+        return self.preprocessor.get_output_type(input_types[0])
+
+    def to_map(self):
+        return {"@class": "PreprocessorVertex",
+                "preprocessor": self.preprocessor.to_map()}
+
+
+def _preproc_from_map(preprocessor):
+    return PreprocessorVertex(InputPreProcessor.from_map(preprocessor))
+
+
+VERTEX_REGISTRY: dict = {c.__name__: c for c in
+                         (MergeVertex, ElementWiseVertex, SubsetVertex,
+                          ScaleVertex, ShiftVertex, StackVertex,
+                          UnstackVertex, L2NormalizeVertex)}
+VERTEX_REGISTRY["PreprocessorVertex"] = \
+    lambda preprocessor: _preproc_from_map(preprocessor)

@@ -1,0 +1,363 @@
+"""ComputationGraph: DAG model compiled to one jitted step.
+
+Reference parity: ``org.deeplearning4j.nn.graph.ComputationGraph``
+(SURVEY.md D3, call stack 3.2): topo-ordered vertex execution,
+multi-input/multi-output, same fit/output/score/evaluate surface as
+MultiLayerNetwork. The reference's reverse-topo epsilon accumulation
+(fan-out vertices sum incoming gradients) is what reverse-mode autodiff
+does by construction — ``jax.value_and_grad`` over the whole DAG replaces
+the hand-written backprop orchestration.
+"""
+from __future__ import annotations
+
+import logging
+from typing import Dict, List, Optional, Sequence, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from deeplearning4j_tpu.common.dtypes import to_jnp_dtype
+from deeplearning4j_tpu.nn.conf.graph_conf import \
+    ComputationGraphConfiguration
+from deeplearning4j_tpu.nn.conf.layers import BaseOutputLayer
+from deeplearning4j_tpu.nn.gradient import apply_gradient_normalization
+from deeplearning4j_tpu.nn.multilayer import _as_jnp
+from deeplearning4j_tpu.optimize.listeners import TrainingListener
+
+log = logging.getLogger("deeplearning4j_tpu")
+
+
+class ComputationGraph:
+    def __init__(self, conf: ComputationGraphConfiguration):
+        self.conf = conf
+        self.params: dict = {}
+        self.states: dict = {}
+        self.updater_states: dict = {}
+        self.listeners: List[TrainingListener] = []
+        self.iteration_count = 0
+        self.epoch_count = 0
+        self.last_batch_size = 0
+        self._score = float("nan")
+        self._rng = jax.random.PRNGKey(conf.seed)
+        self._train_step = None
+        self._initialized = False
+        self._dtype = to_jnp_dtype(conf.dtype)
+        self._topo = conf.topo_order()
+
+    # ------------------------------------------------------------------
+    def init(self) -> "ComputationGraph":
+        if self._initialized:
+            return self
+        conf = self.conf
+        conf.resolve_shapes()
+        types = getattr(conf, "_resolved_types", {})
+        key = jax.random.PRNGKey(conf.seed)
+        for name in self._topo:
+            v = conf.vertices[name]
+            if not v.is_layer:
+                self.params[name] = {}
+                self.states[name] = {}
+                continue
+            in_type = types.get(v.inputs[0]) if types else None
+            if v.preprocessor is not None and in_type is not None:
+                in_type = v.preprocessor.get_output_type(in_type)
+            key, sub = jax.random.split(key)
+            self.params[name] = v.content.init_params(
+                sub, in_type, self._dtype) if v.content.has_params() else {}
+            self.states[name] = v.content.init_state(
+                in_type, self._dtype) if v.content.has_state() else {}
+        for name in self._topo:
+            v = conf.vertices[name]
+            up = (v.content.updater if v.is_layer and v.content.updater
+                  else conf.updater)
+            self.updater_states[name] = up.init_state(self.params[name])
+        self._initialized = True
+        return self
+
+    # ------------------------------------------------------------------
+    def set_listeners(self, *listeners: TrainingListener):
+        self.listeners = list(listeners)
+        return self
+
+    def output_layer_confs(self) -> Dict[str, BaseOutputLayer]:
+        out = {}
+        for name in self.conf.network_outputs:
+            layer = self.conf.vertices[name].content
+            if isinstance(layer, BaseOutputLayer):
+                out[name] = layer
+        return out
+
+    # ------------------------------------------------------------------
+    def _forward(self, params, states, inputs: Sequence, *,
+                 training: bool, rng, want_logits: bool):
+        """Topo walk. inputs: list matching conf.network_inputs order.
+        Returns ({vertex: activation} for outputs, new_states)."""
+        conf = self.conf
+        acts: Dict[str, jnp.ndarray] = dict(zip(conf.network_inputs,
+                                                inputs))
+        new_states: dict = {}
+        for name in self._topo:
+            v = conf.vertices[name]
+            xs = [acts[i] for i in v.inputs]
+            if v.is_layer:
+                h = xs[0]
+                if v.preprocessor is not None:
+                    h = v.preprocessor.pre_process(h)
+                lrng = None
+                if rng is not None:
+                    rng, lrng = jax.random.split(rng)
+                layer = v.content
+                ls = states.get(name, {})
+                if want_logits and name in conf.network_outputs and \
+                        isinstance(layer, BaseOutputLayer) and \
+                        layer.wants_logits():
+                    h, ns = layer.forward_logits(
+                        params.get(name, {}), h, training=training,
+                        rng=lrng, state=ls or None)
+                else:
+                    h, ns = layer.forward(
+                        params.get(name, {}), h, training=training,
+                        rng=lrng, state=ls or None)
+                new_states[name] = ns if ns is not None else {}
+                acts[name] = h
+            else:
+                acts[name] = v.content.forward(xs, training=training)
+                new_states[name] = {}
+        return acts, new_states
+
+    def _regularization(self, params):
+        reg = 0.0
+        for name in self._topo:
+            v = self.conf.vertices[name]
+            if not v.is_layer:
+                continue
+            l1 = v.content.l1 or 0.0
+            l2 = v.content.l2 or 0.0
+            if l1 == 0.0 and l2 == 0.0:
+                continue
+            W = params.get(name, {}).get("W")
+            if W is None:
+                continue
+            if l1:
+                reg = reg + l1 * jnp.sum(jnp.abs(W))
+            if l2:
+                reg = reg + 0.5 * l2 * jnp.sum(W * W)
+        return reg
+
+    # ------------------------------------------------------------------
+    def _build_train_step(self):
+        conf = self.conf
+        out_confs = self.output_layer_confs()
+        updaters = {name: (conf.vertices[name].content.updater
+                           if conf.vertices[name].is_layer and
+                           conf.vertices[name].content.updater
+                           else conf.updater)
+                    for name in self._topo}
+
+        def loss_fn(params, states, inputs, labels, masks, rng):
+            acts, new_states = self._forward(params, states, inputs,
+                                             training=True, rng=rng,
+                                             want_logits=True)
+            loss = self._regularization(params)
+            for i, out_name in enumerate(conf.network_outputs):
+                layer = out_confs.get(out_name)
+                if layer is None:
+                    continue
+                loss = loss + layer.compute_loss(
+                    labels[i], acts[out_name],
+                    from_logits=layer.wants_logits(),
+                    mask=masks[i] if masks is not None else None)
+            return loss, new_states
+
+        def step(params, states, upd_states, inputs, labels, masks,
+                 iteration, rng):
+            (loss, new_states), grads = jax.value_and_grad(
+                loss_fn, has_aux=True)(params, states, inputs, labels,
+                                       masks, rng)
+            gn = conf.gradient_normalization
+            thr = conf.gradient_normalization_threshold
+            new_params, new_upd = {}, {}
+            for name in self._topo:
+                g = grads.get(name, {})
+                if not g:
+                    new_params[name] = params.get(name, {})
+                    new_upd[name] = upd_states.get(name, ())
+                    continue
+                g = apply_gradient_normalization(gn, thr, g)
+                updates, us = updaters[name].apply(
+                    g, upd_states[name], iteration)
+                new_params[name] = jax.tree_util.tree_map(
+                    lambda p, u: p - u, params[name], updates)
+                new_upd[name] = us
+            return new_params, new_states, new_upd, loss
+
+        self._train_step = jax.jit(step, donate_argnums=(0, 1, 2))
+
+    # ------------------------------------------------------------------
+    def fit(self, data, labels=None, *, n_epochs: int = 1):
+        """fit(x, y) | fit(DataSet/MultiDataSet) | fit(iterator)."""
+        if not self._initialized:
+            self.init()
+        if self._train_step is None:
+            self._build_train_step()
+        if labels is not None:
+            self._fit_batch([data] if not isinstance(data, (list, tuple))
+                            else list(data),
+                            [labels] if not isinstance(labels,
+                                                       (list, tuple))
+                            else list(labels), None)
+            return self
+        if hasattr(data, "features") and hasattr(data, "labels"):
+            self._fit_dataset(data)
+            return self
+        for _ in range(n_epochs):
+            for lis in self.listeners:
+                lis.on_epoch_start(self)
+            if hasattr(data, "reset"):
+                data.reset()
+            for ds in data:
+                self._fit_dataset(ds)
+            for lis in self.listeners:
+                lis.on_epoch_end(self)
+            self.epoch_count += 1
+        return self
+
+    def _fit_dataset(self, ds):
+        feats = ds.features if isinstance(ds.features, list) \
+            else [ds.features]
+        labs = ds.labels if isinstance(ds.labels, list) else [ds.labels]
+        masks = getattr(ds, "labels_masks", None)
+        if masks is None:
+            lm = getattr(ds, "labels_mask", None)
+            masks = [lm] if lm is not None else None
+        self._fit_batch(feats, labs, masks)
+
+    def _fit_batch(self, inputs: list, labels: list, masks):
+        inputs = [_as_jnp(x, self._dtype) for x in inputs]
+        labels = [_as_jnp(y, self._dtype) for y in labels]
+        if masks is not None:
+            masks = [(_as_jnp(m) if m is not None else None)
+                     for m in masks]
+        from deeplearning4j_tpu.nn.conf.builders import BackpropType
+        if self.conf.backprop_type is BackpropType.TRUNCATED_BPTT and \
+                inputs[0].ndim == 3:
+            return self._fit_tbptt(inputs, labels, masks)
+        self._rng, rng = jax.random.split(self._rng)
+        self.params, self.states, self.updater_states, loss = \
+            self._train_step(self.params, self.states,
+                             self.updater_states, inputs, labels, masks,
+                             jnp.asarray(self.iteration_count), rng)
+        self._score = float(loss)
+        self.last_batch_size = int(inputs[0].shape[0])
+        self.iteration_count += 1
+        for lis in self.listeners:
+            lis.iteration_done(self, self.iteration_count - 1,
+                               self.epoch_count)
+
+    def _fit_tbptt(self, inputs: list, labels: list, masks):
+        """tBPTT segmentation over the time axis (SURVEY.md section 5.7);
+        same truncation semantics as MultiLayerNetwork._fit_tbptt."""
+        L = self.conf.tbptt_fwd_length
+        T = inputs[0].shape[1]
+        for t0 in range(0, T, L):
+            seg_in = [x[:, t0:t0 + L] if x.ndim >= 3 else x
+                      for x in inputs]
+            seg_lab = [y[:, t0:t0 + L] if y.ndim >= 3 else y
+                       for y in labels]
+            seg_m = None
+            if masks is not None:
+                seg_m = [m[:, t0:t0 + L] if m is not None and
+                         m.ndim >= 2 else m for m in masks]
+            self._rng, rng = jax.random.split(self._rng)
+            self.params, self.states, self.updater_states, loss = \
+                self._train_step(self.params, self.states,
+                                 self.updater_states, seg_in, seg_lab,
+                                 seg_m, jnp.asarray(self.iteration_count),
+                                 rng)
+            self._score = float(loss)
+            self.iteration_count += 1
+        for lis in self.listeners:
+            lis.iteration_done(self, self.iteration_count - 1,
+                               self.epoch_count)
+
+    # ------------------------------------------------------------------
+    def output(self, *inputs, train: bool = False):
+        """Returns list of output activations (single array if one
+        output) — reference: ComputationGraph.output(INDArray...)."""
+        if not self._initialized:
+            self.init()
+        xs = [_as_jnp(x, self._dtype) for x in inputs]
+        acts, _ = self._forward(self.params, self.states, xs,
+                                training=train, rng=None,
+                                want_logits=False)
+        outs = [acts[n] for n in self.conf.network_outputs]
+        return outs[0] if len(outs) == 1 else outs
+
+    def predict(self, *inputs) -> np.ndarray:
+        out = self.output(*inputs)
+        if isinstance(out, list):
+            out = out[0]
+        return np.asarray(jnp.argmax(out, axis=-1))
+
+    def score(self, dataset=None) -> float:
+        if dataset is None:
+            return self._score
+        feats = dataset.features if isinstance(dataset.features, list) \
+            else [dataset.features]
+        labs = dataset.labels if isinstance(dataset.labels, list) \
+            else [dataset.labels]
+        xs = [_as_jnp(x, self._dtype) for x in feats]
+        ys = [_as_jnp(y, self._dtype) for y in labs]
+        acts, _ = self._forward(self.params, self.states, xs,
+                                training=False, rng=None, want_logits=True)
+        loss = self._regularization(self.params)
+        out_confs = self.output_layer_confs()
+        for i, out_name in enumerate(self.conf.network_outputs):
+            layer = out_confs.get(out_name)
+            if layer is None:
+                continue
+            loss = loss + layer.compute_loss(
+                ys[i], acts[out_name], from_logits=layer.wants_logits())
+        return float(loss)
+
+    def evaluate(self, iterator):
+        from deeplearning4j_tpu.evaluation import Evaluation
+        ev = Evaluation()
+        if hasattr(iterator, "reset"):
+            iterator.reset()
+        for ds in iterator:
+            feats = ds.features if isinstance(ds.features, list) \
+                else [ds.features]
+            out = self.output(*feats)
+            if isinstance(out, list):
+                out = out[0]
+            ev.eval(ds.labels if not isinstance(ds.labels, list)
+                    else ds.labels[0], out,
+                    mask=getattr(ds, "labels_mask", None))
+        return ev
+
+    # ------------------------------------------------------------------
+    def num_params(self) -> int:
+        return int(sum(np.prod(p.shape) for p in
+                       jax.tree_util.tree_leaves(self.params)))
+
+    def param_table(self) -> dict:
+        out = {}
+        for name in self._topo:
+            for pname, p in self.params.get(name, {}).items():
+                out[f"{name}_{pname}"] = p
+        return out
+
+    def summary(self) -> str:
+        lines = [f"{'vertex':<28} {'type':<22} {'inputs':<28} {'params':<10}"]
+        total = 0
+        for name in self._topo:
+            v = self.conf.vertices[name]
+            n = int(sum(np.prod(p.shape)
+                        for p in self.params.get(name, {}).values()))
+            total += n
+            lines.append(f"{name:<28} {type(v.content).__name__:<22} "
+                         f"{','.join(v.inputs):<28} {n:<10}")
+        lines.append(f"Total params: {total}")
+        return "\n".join(lines)

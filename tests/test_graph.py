@@ -1,0 +1,189 @@
+"""ComputationGraph + zoo tests (reference: ComputationGraphTest /
+TestComputationGraphNetwork and zoo instantiation tests, SURVEY.md 4.8)."""
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.activations import Activation
+from deeplearning4j_tpu.learning import Adam, Sgd
+from deeplearning4j_tpu.lossfunctions import LossFunction
+from deeplearning4j_tpu.nn import InputType, NeuralNetConfiguration
+from deeplearning4j_tpu.nn.conf.graph_conf import \
+    ComputationGraphConfiguration
+from deeplearning4j_tpu.nn.conf.graph_vertices import (ElementWiseVertex,
+                                                       L2NormalizeVertex,
+                                                       MergeVertex,
+                                                       ScaleVertex,
+                                                       SubsetVertex)
+from deeplearning4j_tpu.nn.conf.layers import (ActivationLayer,
+                                               BatchNormalization,
+                                               ConvolutionLayer,
+                                               DenseLayer,
+                                               GlobalPoolingLayer,
+                                               OutputLayer)
+from deeplearning4j_tpu.nn.graph import ComputationGraph
+from deeplearning4j_tpu.utils import ModelSerializer
+
+
+def _simple_graph_conf():
+    return (NeuralNetConfiguration.Builder()
+            .seed(7).updater(Adam(1e-2))
+            .graph_builder()
+            .add_inputs("in")
+            .set_input_types(InputType.feed_forward(4))
+            .add_layer("d1", DenseLayer(n_out=16,
+                                        activation=Activation.RELU), "in")
+            .add_layer("d2", DenseLayer(n_out=16,
+                                        activation=Activation.TANH), "in")
+            .add_vertex("merge", MergeVertex(), "d1", "d2")
+            .add_layer("out", OutputLayer(n_out=3), "merge")
+            .set_outputs("out")
+            .build())
+
+
+def _toy(n=256, seed=0):
+    rng = np.random.RandomState(seed)
+    centers = np.array([[2, 0, 0, 0], [0, 2, 0, 0], [0, 0, 2, 0]],
+                       dtype=np.float32)
+    ys = rng.randint(0, 3, size=n)
+    xs = centers[ys] + 0.3 * rng.randn(n, 4).astype(np.float32)
+    return xs, np.eye(3, dtype=np.float32)[ys], ys
+
+
+class TestGraphConfig:
+    def test_topo_and_shapes(self):
+        conf = _simple_graph_conf()
+        order = conf.topo_order()
+        assert order.index("merge") > order.index("d1")
+        assert order.index("out") > order.index("merge")
+        assert conf.vertices["out"].content.n_in == 32  # 16+16 merged
+
+    def test_json_round_trip(self):
+        conf = _simple_graph_conf()
+        js = conf.to_json()
+        back = ComputationGraphConfiguration.from_json(js)
+        assert back.network_outputs == ["out"]
+        assert back.vertices["out"].content.n_in == 32
+        assert isinstance(back.vertices["merge"].content, MergeVertex)
+        assert back.to_json() == js
+
+    def test_cycle_detection(self):
+        conf = _simple_graph_conf()
+        conf.vertices["d1"].inputs = ["out"]  # introduce cycle
+        with pytest.raises(ValueError, match="cycle"):
+            conf.topo_order()
+
+
+class TestGraphTraining:
+    def test_merge_graph_converges(self):
+        xs, labels, ys = _toy()
+        net = ComputationGraph(_simple_graph_conf()).init()
+        for _ in range(40):
+            net.fit(xs, labels)
+        acc = float(np.mean(net.predict(xs) == ys))
+        assert acc > 0.9
+
+    def test_residual_elementwise_add(self):
+        xs, labels, ys = _toy()
+        conf = (NeuralNetConfiguration.Builder()
+                .seed(1).updater(Adam(1e-2))
+                .graph_builder()
+                .add_inputs("in")
+                .set_input_types(InputType.feed_forward(4))
+                .add_layer("d1", DenseLayer(n_out=4,
+                                            activation=Activation.RELU),
+                           "in")
+                .add_vertex("res", ElementWiseVertex(
+                    ElementWiseVertex.Op.Add), "d1", "in")
+                .add_layer("out", OutputLayer(n_out=3), "res")
+                .set_outputs("out")
+                .build())
+        net = ComputationGraph(conf).init()
+        for _ in range(40):
+            net.fit(xs, labels)
+        assert float(np.mean(net.predict(xs) == ys)) > 0.85
+
+    def test_multi_output(self):
+        xs, labels, ys = _toy(64)
+        reg_targets = xs.sum(-1, keepdims=True)
+        conf = (NeuralNetConfiguration.Builder()
+                .seed(2).updater(Adam(1e-2))
+                .graph_builder()
+                .add_inputs("in")
+                .set_input_types(InputType.feed_forward(4))
+                .add_layer("trunk", DenseLayer(
+                    n_out=16, activation=Activation.RELU), "in")
+                .add_layer("cls", OutputLayer(n_out=3), "trunk")
+                .add_layer("reg", OutputLayer(
+                    n_out=1, loss_function=LossFunction.MSE,
+                    activation=Activation.IDENTITY), "trunk")
+                .set_outputs("cls", "reg")
+                .build())
+        net = ComputationGraph(conf).init()
+        for _ in range(30):
+            net.fit([xs], [labels, reg_targets])
+        out_cls, out_reg = net.output(xs)
+        assert out_cls.shape == (64, 3)
+        assert out_reg.shape == (64, 1)
+        # regression head learned something
+        mse = float(np.mean((np.asarray(out_reg) - reg_targets) ** 2))
+        assert mse < np.var(reg_targets)
+
+    def test_vertices_forward(self):
+        import jax.numpy as jnp
+        x = jnp.asarray([[3.0, 4.0]])
+        assert float(ScaleVertex(2.0).forward([x])[0, 0]) == 6.0
+        n = L2NormalizeVertex().forward([x])
+        np.testing.assert_allclose(np.asarray(n), [[0.6, 0.8]], rtol=1e-5)
+        s = SubsetVertex(1, 1).forward([x])
+        assert s.shape == (1, 1)
+
+    def test_graph_serialization_round_trip(self, tmp_path):
+        xs, labels, _ = _toy(32)
+        net = ComputationGraph(_simple_graph_conf()).init()
+        net.fit(xs, labels)
+        p = tmp_path / "graph.zip"
+        ModelSerializer.write_model(net, p)
+        back = ModelSerializer.restore_computation_graph(p)
+        np.testing.assert_allclose(np.asarray(net.output(xs)),
+                                   np.asarray(back.output(xs)),
+                                   rtol=1e-5, atol=1e-6)
+
+
+class TestZoo:
+    def test_lenet_builds_and_outputs(self):
+        from deeplearning4j_tpu.models import LeNet
+        net = LeNet(num_classes=10).init()
+        x = np.random.RandomState(0).rand(2, 784).astype(np.float32)
+        out = net.output(x)
+        assert out.shape == (2, 10)
+
+    def test_simple_cnn(self):
+        from deeplearning4j_tpu.models import SimpleCNN
+        net = SimpleCNN(num_classes=5, height=16, width=16,
+                        channels=3).init()
+        x = np.random.RandomState(0).rand(2, 16, 16, 3).astype(np.float32)
+        assert net.output(x).shape == (2, 5)
+
+    def test_resnet50_structure(self):
+        from deeplearning4j_tpu.models import ResNet50
+        net = ResNet50(num_classes=10, height=32, width=32,
+                       channels=3).init()
+        # 3+4+6+3 = 16 bottleneck blocks, each with an add vertex
+        adds = [n for n in net.conf.vertices if n.endswith("_add")]
+        assert len(adds) == 16
+        # ~23.5M params at 1000 classes; at 10 classes ~ 23.5M - 2M
+        n = net.num_params()
+        assert 20_000_000 < n < 30_000_000
+        x = np.random.RandomState(0).rand(1, 32, 32, 3).astype(np.float32)
+        out = net.output(x)
+        assert out.shape == (1, 10)
+
+    def test_resnet50_trains_a_step(self):
+        from deeplearning4j_tpu.models import ResNet50
+        from deeplearning4j_tpu.learning import Sgd
+        net = ResNet50(num_classes=4, height=32, width=32, channels=3,
+                       updater=Sgd(0.01)).init()
+        x = np.random.RandomState(0).rand(4, 32, 32, 3).astype(np.float32)
+        y = np.eye(4, dtype=np.float32)
+        net.fit(x, y)
+        assert np.isfinite(net.score())
