@@ -41,18 +41,9 @@ class ZooModel:
 
     def init_pretrained(self, path):
         """Load pretrained weights from a local checkpoint zip
-        (reference downloads+caches; zero-egress here). The model class
-        is read from the checkpoint's meta.json — no throwaway build."""
-        import json
-        import zipfile
+        (reference downloads+caches; zero-egress here)."""
         from deeplearning4j_tpu.utils import ModelSerializer
-        from deeplearning4j_tpu.utils.serializer import META_ENTRY
-        with zipfile.ZipFile(path) as zf:
-            meta = json.loads(zf.read(META_ENTRY).decode()) \
-                if META_ENTRY in zf.namelist() else {}
-        if meta.get("model_class") == "ComputationGraph":
-            return ModelSerializer.restore_computation_graph(path)
-        return ModelSerializer.restore_multi_layer_network(path)
+        return ModelSerializer.restore_model(path)
 
     def meta_data(self) -> dict:
         return {"name": type(self).__name__}

@@ -90,7 +90,9 @@ class ElementWiseVertex(GraphVertex):
                 out = out + x
             return out
         if op is ElementWiseVertex.Op.Subtract:
-            assert len(inputs) == 2
+            if len(inputs) != 2:
+                raise ValueError(
+                    f"Subtract needs exactly 2 inputs, got {len(inputs)}")
             return inputs[0] - inputs[1]
         if op is ElementWiseVertex.Op.Product:
             out = inputs[0]

@@ -108,6 +108,17 @@ class Layer:
     def has_state(self) -> bool:
         return False
 
+    def is_recurrent(self) -> bool:
+        """True for layers with transient per-sequence state (h/c)."""
+        return False
+
+    def accepts_mask(self) -> bool:
+        """True if forward() takes a per-timestep mask kwarg."""
+        return self.is_recurrent()
+
+    def zero_state(self, batch: int, dtype=jnp.float32) -> dict:
+        return {}
+
     def is_pretrain_param(self, name: str) -> bool:
         return False
 
@@ -487,6 +498,9 @@ class GlobalPoolingLayer(Layer):
 
     def has_params(self) -> bool:
         return False
+
+    def accepts_mask(self) -> bool:
+        return True
 
     def forward(self, params, x, *, training, rng=None, state=None,
                 mask=None):

@@ -1,0 +1,403 @@
+"""Recurrent layers: LSTM, GravesLSTM, SimpleRnn, GRU, Bidirectional.
+
+Reference parity: ``org.deeplearning4j.nn.conf.layers.{LSTM, GravesLSTM,
+GRU}``, ``recurrent.SimpleRnn``, ``recurrent.Bidirectional`` and their
+runtime twins ``org.deeplearning4j.nn.layers.recurrent.*`` with the static
+``LSTMHelpers`` math + ``CudnnLSTMHelper`` fast path (SURVEY.md D4/D9,
+BASELINE config #3 "GravesLSTM char-RNN exercises CudnnLSTMHelper").
+
+TPU-first design: the time loop is ``jax.lax.scan`` — XLA compiles it to a
+single fused while-loop; the per-step input projection ``x @ W`` for ALL
+timesteps is hoisted out of the scan as one big [b*t, 4H] matmul on the MXU
+(the same restructuring cuDNN performs internally), leaving only the [b, H]
+recurrent matmul inside the loop.
+
+Activations are [batch, time, features]. Recurrent state is a dict
+{"h": [b,H], ("c": [b,H])} threaded functionally: zero at each fit batch,
+carried across tBPTT segments, persisted across ``rnn_time_step`` calls
+(SURVEY.md section 5.7 semantics). Per-timestep masks zero the update and
+hold the previous state, matching the reference's masked-RNN behavior.
+"""
+from __future__ import annotations
+
+import enum
+from dataclasses import dataclass
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from deeplearning4j_tpu.activations import Activation
+from deeplearning4j_tpu.nn.conf.inputs import (InputType,
+                                               InputTypeRecurrent)
+from deeplearning4j_tpu.nn.conf.layers import Layer, register_layer
+from deeplearning4j_tpu.nn.weights import WeightInit
+
+
+@dataclass
+class BaseRecurrentLayer(Layer):
+    activation: Activation = Activation.TANH
+
+    def is_recurrent(self) -> bool:
+        return True
+
+    def zero_state(self, batch: int, dtype=jnp.float32) -> dict:
+        return {"h": jnp.zeros((batch, self.n_out), dtype)}
+
+    def set_n_in(self, input_type, override):
+        if isinstance(input_type, InputTypeRecurrent) and \
+                (override or not self.n_in):
+            self.n_in = input_type.size
+
+    def get_output_type(self, input_type):
+        t = input_type.timesteps if isinstance(input_type,
+                                               InputTypeRecurrent) else -1
+        return InputType.recurrent(self.n_out, t)
+
+    # mask: [b, t] or None. Subclasses implement _scan().
+    def forward(self, params, x, *, training, rng=None, state=None,
+                mask=None):
+        x = self._maybe_dropout(x, training, rng)
+        b = x.shape[0]
+        if not state:
+            state = self.zero_state(b, x.dtype)
+        y, new_state = self._scan(params, x, state, mask)
+        return y, new_state
+
+    def forward_step(self, params, x_t, state):
+        """Single timestep (rnnTimeStep hot path): x_t [b, f]."""
+        y, new_state = self._scan(params, x_t[:, None, :], state, None)
+        return y[:, 0], new_state
+
+    @staticmethod
+    def _run_scan(step, carry, xw, mask):
+        """Shared time-loop dispatch: ``step(carry, (xw_t, m_t|None))``.
+        Owns the [b,t,...] <-> [t,b,...] swaps and the mask/no-mask
+        branching for every recurrent subclass."""
+        if mask is not None:
+            last, ys = jax.lax.scan(step, carry,
+                                    (xw.swapaxes(0, 1),
+                                     mask.swapaxes(0, 1)))
+        else:
+            last, ys = jax.lax.scan(lambda c, xt: step(c, (xt, None)),
+                                    carry, xw.swapaxes(0, 1))
+        return last, ys.swapaxes(0, 1)
+
+
+@dataclass
+class SimpleRnn(BaseRecurrentLayer):
+    """h_t = act(x W + h_{t-1} R + b) (reference: recurrent.SimpleRnn)."""
+
+    has_bias: bool = True
+
+    def init_params(self, key, input_type, dtype=jnp.float32):
+        wi = self.weight_init or WeightInit.XAVIER
+        k1, k2 = jax.random.split(key)
+        p = {"W": wi.init(k1, (self.n_in, self.n_out), self.n_in,
+                          self.n_out, dtype),
+             "RW": wi.init(k2, (self.n_out, self.n_out), self.n_out,
+                           self.n_out, dtype)}
+        if self.has_bias:
+            p["b"] = jnp.full((self.n_out,), self.bias_init, dtype)
+        return p
+
+    def _scan(self, params, x, state, mask):
+        act = self.activation.fn()
+        # hoist the input projection out of the loop: one MXU matmul
+        xw = x @ params["W"]
+        if self.has_bias:
+            xw = xw + params["b"]
+
+        def step(h, inp):
+            xw_t, m_t = inp
+            h_new = act(xw_t + h @ params["RW"])
+            if m_t is not None:
+                h_new = jnp.where(m_t[:, None] > 0, h_new, h)
+            return h_new, h_new
+
+        h_last, ys = self._run_scan(step, state["h"], xw, mask)
+        return ys, {"h": h_last}
+
+
+@dataclass
+class LSTM(BaseRecurrentLayer):
+    """Standard LSTM, gate order [i, f, o, g] (reference: conf.layers.LSTM;
+    the cuDNN helper path is here the scan+fused-matmul lowering)."""
+
+    forget_gate_bias_init: float = 1.0
+    gate_activation: Activation = Activation.SIGMOID
+    has_bias: bool = True
+
+    def zero_state(self, batch: int, dtype=jnp.float32) -> dict:
+        return {"h": jnp.zeros((batch, self.n_out), dtype),
+                "c": jnp.zeros((batch, self.n_out), dtype)}
+
+    def init_params(self, key, input_type, dtype=jnp.float32):
+        wi = self.weight_init or WeightInit.XAVIER
+        k1, k2 = jax.random.split(key)
+        H = self.n_out
+        p = {"W": wi.init(k1, (self.n_in, 4 * H), self.n_in, H, dtype),
+             "RW": wi.init(k2, (H, 4 * H), H, H, dtype)}
+        if self.has_bias:
+            b = jnp.full((4 * H,), self.bias_init, dtype)
+            # forget-gate bias init (reference default 1.0)
+            b = b.at[H:2 * H].set(self.forget_gate_bias_init)
+            p["b"] = b
+        return p
+
+    def _gates(self, z, c_prev, params):
+        H = self.n_out
+        gate = self.gate_activation.fn()
+        act = self.activation.fn()
+        i = gate(z[:, :H])
+        f = gate(z[:, H:2 * H])
+        o = gate(z[:, 2 * H:3 * H])
+        g = act(z[:, 3 * H:])
+        c = f * c_prev + i * g
+        h = o * act(c)
+        return h, c
+
+    def _scan(self, params, x, state, mask):
+        xw = x @ params["W"]
+        if self.has_bias:
+            xw = xw + params["b"]
+
+        def step(carry, inp):
+            h_prev, c_prev = carry
+            xw_t, m_t = inp
+            z = xw_t + h_prev @ params["RW"]
+            h, c = self._gates(z, c_prev, params)
+            if m_t is not None:
+                keep = m_t[:, None] > 0
+                h = jnp.where(keep, h, h_prev)
+                c = jnp.where(keep, c, c_prev)
+            return (h, c), h
+
+        (h_last, c_last), ys = self._run_scan(
+            step, (state["h"], state["c"]), xw, mask)
+        return ys, {"h": h_last, "c": c_last}
+
+
+@dataclass
+class GravesLSTM(LSTM):
+    """LSTM with peephole connections (Graves 2013) — reference:
+    conf.layers.GravesLSTM, the BASELINE config #3 layer. Peepholes add
+    c_{t-1} terms to the input/forget gates and c_t to the output gate."""
+
+    def init_params(self, key, input_type, dtype=jnp.float32):
+        p = super().init_params(key, input_type, dtype)
+        H = self.n_out
+        k = jax.random.fold_in(key, 1)
+        wi = self.weight_init or WeightInit.XAVIER
+        p["pI"] = wi.init(jax.random.fold_in(k, 0), (H,), H, H, dtype)
+        p["pF"] = wi.init(jax.random.fold_in(k, 1), (H,), H, H, dtype)
+        p["pO"] = wi.init(jax.random.fold_in(k, 2), (H,), H, H, dtype)
+        return p
+
+    def _scan(self, params, x, state, mask):
+        H = self.n_out
+        gate = self.gate_activation.fn()
+        act = self.activation.fn()
+        xw = x @ params["W"]
+        if self.has_bias:
+            xw = xw + params["b"]
+
+        def step(carry, inp):
+            h_prev, c_prev = carry
+            xw_t, m_t = inp
+            z = xw_t + h_prev @ params["RW"]
+            i = gate(z[:, :H] + c_prev * params["pI"])
+            f = gate(z[:, H:2 * H] + c_prev * params["pF"])
+            g = act(z[:, 3 * H:])
+            c = f * c_prev + i * g
+            o = gate(z[:, 2 * H:3 * H] + c * params["pO"])
+            h = o * act(c)
+            if m_t is not None:
+                keep = m_t[:, None] > 0
+                h = jnp.where(keep, h, h_prev)
+                c = jnp.where(keep, c, c_prev)
+            return (h, c), h
+
+        (h_last, c_last), ys = self._run_scan(
+            step, (state["h"], state["c"]), xw, mask)
+        return ys, {"h": h_last, "c": c_last}
+
+
+@dataclass
+class GRU(BaseRecurrentLayer):
+    """GRU (reference: conf.layers.GRU / nd4j gruCell op)."""
+
+    gate_activation: Activation = Activation.SIGMOID
+    has_bias: bool = True
+
+    def init_params(self, key, input_type, dtype=jnp.float32):
+        wi = self.weight_init or WeightInit.XAVIER
+        k1, k2 = jax.random.split(key)
+        H = self.n_out
+        p = {"W": wi.init(k1, (self.n_in, 3 * H), self.n_in, H, dtype),
+             "RW": wi.init(k2, (H, 3 * H), H, H, dtype)}
+        if self.has_bias:
+            p["b"] = jnp.full((3 * H,), self.bias_init, dtype)
+        return p
+
+    def _scan(self, params, x, state, mask):
+        H = self.n_out
+        gate = self.gate_activation.fn()
+        act = self.activation.fn()
+        xw = x @ params["W"]
+        if self.has_bias:
+            xw = xw + params["b"]
+
+        def step(h_prev, inp):
+            xw_t, m_t = inp
+            hr = h_prev @ params["RW"]
+            r = gate(xw_t[:, :H] + hr[:, :H])
+            zt = gate(xw_t[:, H:2 * H] + hr[:, H:2 * H])
+            n = act(xw_t[:, 2 * H:] + r * hr[:, 2 * H:])
+            h = (1 - zt) * n + zt * h_prev
+            if m_t is not None:
+                h = jnp.where(m_t[:, None] > 0, h, h_prev)
+            return h, h
+
+        h_last, ys = self._run_scan(step, state["h"], xw, mask)
+        return ys, {"h": h_last}
+
+
+class BidirectionalMode(enum.Enum):
+    CONCAT = "concat"
+    ADD = "add"
+    MUL = "mul"
+    AVERAGE = "average"
+
+
+@dataclass
+class Bidirectional(BaseRecurrentLayer):
+    """Wrapper running a recurrent layer forward + backward over time
+    (reference: recurrent.Bidirectional(mode, layer))."""
+
+    fwd: Optional[BaseRecurrentLayer] = None
+    mode: BidirectionalMode = BidirectionalMode.CONCAT
+
+    def __post_init__(self):
+        if isinstance(self.mode, str):
+            self.mode = BidirectionalMode[self.mode.upper()]
+        if self.fwd is not None:
+            self.n_out = self.fwd.n_out
+
+    def zero_state(self, batch: int, dtype=jnp.float32) -> dict:
+        return {"fwd": self.fwd.zero_state(batch, dtype),
+                "bwd": self.fwd.zero_state(batch, dtype)}
+
+    def set_n_in(self, input_type, override):
+        super().set_n_in(input_type, override)
+        self.fwd.set_n_in(input_type, override)
+
+    def init_params(self, key, input_type, dtype=jnp.float32):
+        k1, k2 = jax.random.split(key)
+        return {"fwd": self.fwd.init_params(k1, input_type, dtype),
+                "bwd": self.fwd.init_params(k2, input_type, dtype)}
+
+    def forward(self, params, x, *, training, rng=None, state=None,
+                mask=None):
+        if not state:
+            state = self.zero_state(x.shape[0], x.dtype)
+        rng_f = rng_b = None
+        if rng is not None:  # independent dropout masks per direction
+            rng_f, rng_b = jax.random.split(rng)
+        y_f, s_f = self.fwd.forward(params["fwd"], x, training=training,
+                                    rng=rng_f, state=state["fwd"],
+                                    mask=mask)
+        x_rev = jnp.flip(x, axis=1)
+        m_rev = jnp.flip(mask, axis=1) if mask is not None else None
+        y_b, s_b = self.fwd.forward(params["bwd"], x_rev,
+                                    training=training, rng=rng_b,
+                                    state=state["bwd"], mask=m_rev)
+        y_b = jnp.flip(y_b, axis=1)
+        if self.mode is BidirectionalMode.CONCAT:
+            y = jnp.concatenate([y_f, y_b], axis=-1)
+        elif self.mode is BidirectionalMode.ADD:
+            y = y_f + y_b
+        elif self.mode is BidirectionalMode.MUL:
+            y = y_f * y_b
+        else:
+            y = 0.5 * (y_f + y_b)
+        return y, {"fwd": s_f, "bwd": s_b}
+
+    def get_output_type(self, input_type):
+        t = input_type.timesteps if isinstance(input_type,
+                                               InputTypeRecurrent) else -1
+        n = self.fwd.n_out * (2 if self.mode is BidirectionalMode.CONCAT
+                              else 1)
+        return InputType.recurrent(n, t)
+
+    def to_map(self):
+        return {"@class": "Bidirectional",
+                "mode": self.mode.name,
+                "fwd": self.fwd.to_map()}
+
+
+@dataclass
+class EmbeddingSequenceLayer(Layer):
+    """[b, t] int tokens -> [b, t, n_out] (reference:
+    conf.layers.EmbeddingSequenceLayer)."""
+
+    has_bias: bool = False
+
+    def init_params(self, key, input_type, dtype=jnp.float32):
+        wi = self.weight_init or WeightInit.XAVIER
+        return {"W": wi.init(key, (self.n_in, self.n_out), self.n_in,
+                             self.n_out, dtype)}
+
+    def forward(self, params, x, *, training, rng=None, state=None):
+        idx = x.astype(jnp.int32)
+        if idx.ndim == 3 and idx.shape[-1] == 1:
+            idx = idx[..., 0]
+        return params["W"][idx], state
+
+    def get_output_type(self, input_type):
+        t = input_type.timesteps if isinstance(input_type,
+                                               InputTypeRecurrent) else -1
+        return InputType.recurrent(self.n_out, t)
+
+    def set_n_in(self, input_type, override):
+        pass  # n_in is the vocabulary size
+
+
+@dataclass
+class LastTimeStepLayer(Layer):
+    """[b, t, f] -> [b, f], last unmasked step (reference:
+    recurrent.LastTimeStep wrapper)."""
+
+    def has_params(self) -> bool:
+        return False
+
+    def accepts_mask(self) -> bool:
+        return True
+
+    def forward(self, params, x, *, training, rng=None, state=None,
+                mask=None):
+        if mask is not None:
+            idx = jnp.maximum(jnp.sum(mask > 0, axis=1) - 1, 0)
+            return x[jnp.arange(x.shape[0]), idx.astype(jnp.int32)], state
+        return x[:, -1], state
+
+    def get_output_type(self, input_type):
+        return InputType.feed_forward(input_type.size)
+
+    def set_n_in(self, input_type, override):
+        if isinstance(input_type, InputTypeRecurrent):
+            self.n_in = self.n_out = input_type.size
+
+
+def _bidir_from_map(d):
+    return Bidirectional(fwd=Layer.from_map(d["fwd"]),
+                         mode=BidirectionalMode[d["mode"]])
+
+
+for _cls in (SimpleRnn, LSTM, GravesLSTM, GRU, EmbeddingSequenceLayer,
+             LastTimeStepLayer):
+    register_layer(_cls)
+
+from deeplearning4j_tpu.nn.conf.layers import LAYER_REGISTRY  # noqa: E402
+
+LAYER_REGISTRY["Bidirectional"] = lambda **d: _bidir_from_map(d)

@@ -90,8 +90,11 @@ class ComputationGraph:
 
     # ------------------------------------------------------------------
     def _forward(self, params, states, inputs: Sequence, *,
-                 training: bool, rng, want_logits: bool):
+                 training: bool, rng, want_logits: bool, fmask=None):
         """Topo walk. inputs: list matching conf.network_inputs order.
+        ``fmask`` is the per-timestep features mask (first input's), passed
+        to mask-aware layers — multi-input graphs with per-input masks can
+        attach masks via PreprocessorVertex if they diverge.
         Returns ({vertex: activation} for outputs, new_states)."""
         conf = self.conf
         acts: Dict[str, jnp.ndarray] = dict(zip(conf.network_inputs,
@@ -109,6 +112,9 @@ class ComputationGraph:
                     rng, lrng = jax.random.split(rng)
                 layer = v.content
                 ls = states.get(name, {})
+                kw = {}
+                if fmask is not None and layer.accepts_mask():
+                    kw["mask"] = fmask
                 if want_logits and name in conf.network_outputs and \
                         isinstance(layer, BaseOutputLayer) and \
                         layer.wants_logits():
@@ -118,13 +124,32 @@ class ComputationGraph:
                 else:
                     h, ns = layer.forward(
                         params.get(name, {}), h, training=training,
-                        rng=lrng, state=ls or None)
+                        rng=lrng, state=ls or None, **kw)
                 new_states[name] = ns if ns is not None else {}
                 acts[name] = h
             else:
                 acts[name] = v.content.forward(xs, training=training)
                 new_states[name] = {}
         return acts, new_states
+
+    # -- recurrent state lifecycle (mirrors MultiLayerNetwork) ----------
+    def _recurrent_names(self):
+        return [n for n in self._topo
+                if self.conf.vertices[n].is_layer and
+                self.conf.vertices[n].content.is_recurrent()]
+
+    def _with_zero_rnn_states(self, states, batch: int):
+        out = dict(states)
+        for n in self._recurrent_names():
+            out[n] = self.conf.vertices[n].content.zero_state(
+                batch, self._dtype)
+        return out
+
+    def _strip_rnn_states(self, states):
+        out = dict(states)
+        for n in self._recurrent_names():
+            out[n] = {}
+        return out
 
     def _regularization(self, params):
         reg = 0.0
@@ -155,10 +180,11 @@ class ComputationGraph:
                            else conf.updater)
                     for name in self._topo}
 
-        def loss_fn(params, states, inputs, labels, masks, rng):
+        def loss_fn(params, states, inputs, labels, fmask, lmasks, rng):
             acts, new_states = self._forward(params, states, inputs,
                                              training=True, rng=rng,
-                                             want_logits=True)
+                                             want_logits=True,
+                                             fmask=fmask)
             loss = self._regularization(params)
             for i, out_name in enumerate(conf.network_outputs):
                 layer = out_confs.get(out_name)
@@ -167,14 +193,14 @@ class ComputationGraph:
                 loss = loss + layer.compute_loss(
                     labels[i], acts[out_name],
                     from_logits=layer.wants_logits(),
-                    mask=masks[i] if masks is not None else None)
+                    mask=lmasks[i] if lmasks is not None else None)
             return loss, new_states
 
-        def step(params, states, upd_states, inputs, labels, masks,
-                 iteration, rng):
+        def step(params, states, upd_states, inputs, labels, fmask,
+                 lmasks, iteration, rng):
             (loss, new_states), grads = jax.value_and_grad(
                 loss_fn, has_aux=True)(params, states, inputs, labels,
-                                       masks, rng)
+                                       fmask, lmasks, rng)
             gn = conf.gradient_normalization
             thr = conf.gradient_normalization_threshold
             new_params, new_upd = {}, {}
@@ -206,7 +232,7 @@ class ComputationGraph:
                             else list(data),
                             [labels] if not isinstance(labels,
                                                        (list, tuple))
-                            else list(labels), None)
+                            else list(labels), None, None)
             return self
         if hasattr(data, "features") and hasattr(data, "labels"):
             self._fit_dataset(data)
@@ -227,27 +253,35 @@ class ComputationGraph:
         feats = ds.features if isinstance(ds.features, list) \
             else [ds.features]
         labs = ds.labels if isinstance(ds.labels, list) else [ds.labels]
-        masks = getattr(ds, "labels_masks", None)
-        if masks is None:
+        lmasks = getattr(ds, "labels_masks", None)
+        if lmasks is None:
             lm = getattr(ds, "labels_mask", None)
-            masks = [lm] if lm is not None else None
-        self._fit_batch(feats, labs, masks)
+            lmasks = [lm] if lm is not None else None
+        fmasks = getattr(ds, "features_masks", None)
+        fmask = fmasks[0] if fmasks else getattr(ds, "features_mask",
+                                                 None)
+        self._fit_batch(feats, labs, fmask, lmasks)
 
-    def _fit_batch(self, inputs: list, labels: list, masks):
+    def _fit_batch(self, inputs: list, labels: list, fmask, lmasks):
         inputs = [_as_jnp(x, self._dtype) for x in inputs]
         labels = [_as_jnp(y, self._dtype) for y in labels]
-        if masks is not None:
-            masks = [(_as_jnp(m) if m is not None else None)
-                     for m in masks]
+        fmask = _as_jnp(fmask) if fmask is not None else None
+        if lmasks is not None:
+            lmasks = [(_as_jnp(m) if m is not None else None)
+                      for m in lmasks]
         from deeplearning4j_tpu.nn.conf.builders import BackpropType
         if self.conf.backprop_type is BackpropType.TRUNCATED_BPTT and \
                 inputs[0].ndim == 3:
-            return self._fit_tbptt(inputs, labels, masks)
+            return self._fit_tbptt(inputs, labels, fmask, lmasks)
         self._rng, rng = jax.random.split(self._rng)
-        self.params, self.states, self.updater_states, loss = \
-            self._train_step(self.params, self.states,
-                             self.updater_states, inputs, labels, masks,
-                             jnp.asarray(self.iteration_count), rng)
+        states_in = self._with_zero_rnn_states(self.states,
+                                               int(inputs[0].shape[0]))
+        self.params, new_states, self.updater_states, loss = \
+            self._train_step(self.params, states_in,
+                             self.updater_states, inputs, labels, fmask,
+                             lmasks, jnp.asarray(self.iteration_count),
+                             rng)
+        self.states = self._strip_rnn_states(new_states)
         self._score = float(loss)
         self.last_batch_size = int(inputs[0].shape[0])
         self.iteration_count += 1
@@ -255,42 +289,48 @@ class ComputationGraph:
             lis.iteration_done(self, self.iteration_count - 1,
                                self.epoch_count)
 
-    def _fit_tbptt(self, inputs: list, labels: list, masks):
+    def _fit_tbptt(self, inputs: list, labels: list, fmask, lmasks):
         """tBPTT segmentation over the time axis (SURVEY.md section 5.7);
-        same truncation semantics as MultiLayerNetwork._fit_tbptt."""
+        same carry/truncation semantics as MultiLayerNetwork._fit_tbptt."""
         L = self.conf.tbptt_fwd_length
         T = inputs[0].shape[1]
+        states = self._with_zero_rnn_states(self.states,
+                                            int(inputs[0].shape[0]))
         for t0 in range(0, T, L):
             seg_in = [x[:, t0:t0 + L] if x.ndim >= 3 else x
                       for x in inputs]
             seg_lab = [y[:, t0:t0 + L] if y.ndim >= 3 else y
                        for y in labels]
-            seg_m = None
-            if masks is not None:
-                seg_m = [m[:, t0:t0 + L] if m is not None and
-                         m.ndim >= 2 else m for m in masks]
+            seg_f = fmask[:, t0:t0 + L] if fmask is not None and \
+                fmask.ndim >= 2 else fmask
+            seg_l = None
+            if lmasks is not None:
+                seg_l = [m[:, t0:t0 + L] if m is not None and
+                         m.ndim >= 2 else m for m in lmasks]
             self._rng, rng = jax.random.split(self._rng)
-            self.params, self.states, self.updater_states, loss = \
-                self._train_step(self.params, self.states,
+            self.params, states, self.updater_states, loss = \
+                self._train_step(self.params, states,
                                  self.updater_states, seg_in, seg_lab,
-                                 seg_m, jnp.asarray(self.iteration_count),
-                                 rng)
+                                 seg_f, seg_l,
+                                 jnp.asarray(self.iteration_count), rng)
             self._score = float(loss)
             self.iteration_count += 1
+        self.states = self._strip_rnn_states(states)
         for lis in self.listeners:
             lis.iteration_done(self, self.iteration_count - 1,
                                self.epoch_count)
 
     # ------------------------------------------------------------------
-    def output(self, *inputs, train: bool = False):
+    def output(self, *inputs, train: bool = False, mask=None):
         """Returns list of output activations (single array if one
         output) — reference: ComputationGraph.output(INDArray...)."""
         if not self._initialized:
             self.init()
         xs = [_as_jnp(x, self._dtype) for x in inputs]
+        mask = _as_jnp(mask) if mask is not None else None
         acts, _ = self._forward(self.params, self.states, xs,
                                 training=train, rng=None,
-                                want_logits=False)
+                                want_logits=False, fmask=mask)
         outs = [acts[n] for n in self.conf.network_outputs]
         return outs[0] if len(outs) == 1 else outs
 
@@ -309,8 +349,16 @@ class ComputationGraph:
             else [dataset.labels]
         xs = [_as_jnp(x, self._dtype) for x in feats]
         ys = [_as_jnp(y, self._dtype) for y in labs]
-        acts, _ = self._forward(self.params, self.states, xs,
-                                training=False, rng=None, want_logits=True)
+        lmasks = getattr(dataset, "labels_masks", None)
+        if lmasks is None:
+            lm = getattr(dataset, "labels_mask", None)
+            lmasks = [lm] if lm is not None else None
+        acts, _ = self._forward(
+            self.params, self.states, xs, training=False, rng=None,
+            want_logits=True,
+            fmask=_as_jnp(getattr(dataset, "features_mask", None))
+            if getattr(dataset, "features_mask", None) is not None
+            else None)
         loss = self._regularization(self.params)
         out_confs = self.output_layer_confs()
         for i, out_name in enumerate(self.conf.network_outputs):
@@ -318,7 +366,8 @@ class ComputationGraph:
             if layer is None:
                 continue
             loss = loss + layer.compute_loss(
-                ys[i], acts[out_name], from_logits=layer.wants_logits())
+                ys[i], acts[out_name], from_logits=layer.wants_logits(),
+                mask=lmasks[i] if lmasks is not None else None)
         return float(loss)
 
     def evaluate(self, iterator):
@@ -329,7 +378,8 @@ class ComputationGraph:
         for ds in iterator:
             feats = ds.features if isinstance(ds.features, list) \
                 else [ds.features]
-            out = self.output(*feats)
+            out = self.output(*feats,
+                              mask=getattr(ds, "features_mask", None))
             if isinstance(out, list):
                 out = out[0]
             ev.eval(ds.labels if not isinstance(ds.labels, list)

@@ -105,8 +105,11 @@ class MultiLayerNetwork:
 
     # ------------------------------------------------------------------
     def _forward(self, params, states, x, *, training: bool, rng,
-                 stop_at: Optional[int] = None, want_logits: bool):
-        """Walk the stack. Returns (out, new_states)."""
+                 stop_at: Optional[int] = None, want_logits: bool,
+                 mask=None):
+        """Walk the stack. ``mask`` is the per-timestep features mask,
+        passed to layers that accept one (recurrent/pooling).
+        Returns (out, new_states)."""
         conf = self.conf
         new_states = {}
         h = x
@@ -121,6 +124,9 @@ class MultiLayerNetwork:
             lrng = None
             if rng is not None:
                 rng, lrng = jax.random.split(rng)
+            kw = {}
+            if mask is not None and layer.accepts_mask():
+                kw["mask"] = mask
             is_last = i == n - 1
             if is_last and want_logits and isinstance(layer,
                                                       BaseOutputLayer) \
@@ -129,9 +135,28 @@ class MultiLayerNetwork:
                                              rng=lrng, state=ls or None)
             else:
                 h, ns = layer.forward(lp, h, training=training, rng=lrng,
-                                      state=ls or None)
+                                      state=ls or None, **kw)
             new_states[f"layer_{i}"] = ns if ns is not None else {}
         return h, new_states
+
+    def _recurrent_keys(self):
+        return [f"layer_{i}" for i, l in enumerate(self.conf.layers)
+                if l.is_recurrent()]
+
+    def _with_zero_rnn_states(self, states, batch: int):
+        """states for a fresh sequence: persistent (BN) entries kept,
+        recurrent entries zeroed for this batch size."""
+        out = dict(states)
+        for i, layer in enumerate(self.conf.layers):
+            if layer.is_recurrent():
+                out[f"layer_{i}"] = layer.zero_state(batch, self._dtype)
+        return out
+
+    def _strip_rnn_states(self, states):
+        out = dict(states)
+        for k in self._recurrent_keys():
+            out[k] = {}
+        return out
 
     def _regularization(self, params):
         """Score-side l1/l2 (reference: applied to weights, not biases)."""
@@ -158,18 +183,23 @@ class MultiLayerNetwork:
         updaters = [(layer.updater or conf.updater)
                     for layer in conf.layers]
 
-        def loss_fn(params, states, x, y, mask, rng):
+        def loss_fn(params, states, x, y, fmask, lmask, rng):
+            # fmask: per-timestep features mask (recurrent/pooling hold);
+            # lmask: labels mask (loss exclusion) — distinct, as in the
+            # reference (featuresMaskArray vs labelsMaskArray)
             out, new_states = self._forward(params, states, x,
                                             training=True, rng=rng,
-                                            want_logits=True)
+                                            want_logits=True, mask=fmask)
             data_loss = out_layer.compute_loss(y, out,
                                                from_logits=want_logits,
-                                               mask=mask)
+                                               mask=lmask)
             return data_loss + self._regularization(params), new_states
 
-        def step(params, states, upd_states, x, y, mask, iteration, rng):
+        def step(params, states, upd_states, x, y, fmask, lmask,
+                 iteration, rng):
             (loss, new_states), grads = jax.value_and_grad(
-                loss_fn, has_aux=True)(params, states, x, y, mask, rng)
+                loss_fn, has_aux=True)(params, states, x, y, fmask,
+                                       lmask, rng)
             new_params = {}
             new_upd = {}
             gn = conf.gradient_normalization
@@ -200,10 +230,11 @@ class MultiLayerNetwork:
         if self._train_step is None:
             self._build_train_step()
         if labels is not None:
-            self._fit_batch(data, labels, None)
+            self._fit_batch(data, labels, None, None)
             return self
         if hasattr(data, "features") and hasattr(data, "labels"):
             self._fit_batch(data.features, data.labels,
+                            getattr(data, "features_mask", None),
                             getattr(data, "labels_mask", None))
             return self
         # iterator protocol
@@ -214,24 +245,31 @@ class MultiLayerNetwork:
                 data.reset()
             for ds in data:
                 self._fit_batch(ds.features, ds.labels,
+                                getattr(ds, "features_mask", None),
                                 getattr(ds, "labels_mask", None))
             for lis in self.listeners:
                 lis.on_epoch_end(self)
             self.epoch_count += 1
         return self
 
-    def _fit_batch(self, x, y, mask):
+    def _fit_batch(self, x, y, fmask, lmask):
         x = _as_jnp(x, self._dtype)
         y = _as_jnp(y, self._dtype)
-        mask = _as_jnp(mask) if mask is not None else None
+        fmask = _as_jnp(fmask) if fmask is not None else None
+        lmask = _as_jnp(lmask) if lmask is not None else None
         if self.conf.backprop_type is BackpropType.TRUNCATED_BPTT and \
                 x.ndim == 3:
-            return self._fit_tbptt(x, y, mask)
+            return self._fit_tbptt(x, y, fmask, lmask)
         self._rng, rng = jax.random.split(self._rng)
-        self.params, self.states, self.updater_states, loss = \
-            self._train_step(self.params, self.states, self.updater_states,
-                             x, y, mask, jnp.asarray(self.iteration_count),
-                             rng)
+        states_in = self._with_zero_rnn_states(self.states,
+                                               int(x.shape[0]))
+        self.params, new_states, self.updater_states, loss = \
+            self._train_step(self.params, states_in, self.updater_states,
+                             x, y, fmask, lmask,
+                             jnp.asarray(self.iteration_count), rng)
+        # standard BPTT: recurrent state resets every minibatch
+        # (reference: fit() clears rnn state); BN stats persist
+        self.states = self._strip_rnn_states(new_states)
         self._score = float(loss)
         self.last_batch_size = int(x.shape[0])
         self.iteration_count += 1
@@ -239,37 +277,78 @@ class MultiLayerNetwork:
             lis.iteration_done(self, self.iteration_count - 1,
                                self.epoch_count)
 
-    def _fit_tbptt(self, x, y, mask):
-        """Truncated BPTT segmentation (SURVEY.md section 5.7): split the
-        time axis into tbptt_fwd_length segments. Recurrent state carry
-        lands with the recurrent layers (task: recurrent); until then each
-        segment trains independently, matching tBPTT's gradient truncation."""
+    def _fit_tbptt(self, x, y, fmask, lmask):
+        """Truncated BPTT (SURVEY.md section 5.7): the time axis splits
+        into tbptt_fwd_length segments; recurrent state carries across
+        segments (no gradient flow between step calls = truncation), and
+        resets at the batch boundary — reference tBPTT semantics."""
         L = self.conf.tbptt_fwd_length
         T = x.shape[1]
+
+        def seg(m, t0):
+            return m[:, t0:t0 + L] if m is not None and m.ndim >= 2 else m
+
+        states = self._with_zero_rnn_states(self.states, int(x.shape[0]))
         for t0 in range(0, T, L):
             seg_x = x[:, t0:t0 + L]
             seg_y = y[:, t0:t0 + L] if y.ndim >= 3 else y
-            seg_m = mask[:, t0:t0 + L] if mask is not None and \
-                mask.ndim >= 2 else mask
             self._rng, rng = jax.random.split(self._rng)
-            self.params, self.states, self.updater_states, loss = \
-                self._train_step(self.params, self.states,
-                                 self.updater_states, seg_x, seg_y, seg_m,
+            self.params, states, self.updater_states, loss = \
+                self._train_step(self.params, states,
+                                 self.updater_states, seg_x, seg_y,
+                                 seg(fmask, t0), seg(lmask, t0),
                                  jnp.asarray(self.iteration_count), rng)
             self._score = float(loss)
             self.iteration_count += 1
+        self.states = self._strip_rnn_states(states)
+        self.last_batch_size = int(x.shape[0])
         for lis in self.listeners:
             lis.iteration_done(self, self.iteration_count - 1,
                                self.epoch_count)
 
+    # -- stateful streaming inference (SURVEY.md section 5.7) -----------
+    def rnn_time_step(self, x):
+        """Feed one step (or a chunk) of a sequence, carrying hidden
+        state across calls (reference: rnnTimeStep)."""
+        if not self._initialized:
+            self.init()
+        x = _as_jnp(x, self._dtype)
+        single_step = x.ndim == 2
+        if single_step:
+            x = x[:, None, :]
+        if getattr(self, "_rnn_stream_states", None) is None:
+            self._rnn_stream_states = self._with_zero_rnn_states(
+                self.states, int(x.shape[0]))
+        out, new_states = self._forward(
+            self.params, self._rnn_stream_states, x, training=False,
+            rng=None, want_logits=False)
+        # keep persistent (BN) states as-is; update only the rnn carries
+        merged = dict(self._rnn_stream_states)
+        for k in self._recurrent_keys():
+            merged[k] = new_states[k]
+        self._rnn_stream_states = merged
+        if single_step and out.ndim == 3:
+            out = out[:, -1]
+        return out
+
+    def rnn_clear_previous_state(self):
+        self._rnn_stream_states = None
+
+    def rnn_get_previous_state(self, layer_idx: int):
+        if getattr(self, "_rnn_stream_states", None) is None:
+            return None
+        return self._rnn_stream_states.get(f"layer_{layer_idx}")
+
     # ------------------------------------------------------------------
-    def output(self, x, train: bool = False):
+    def output(self, x, train: bool = False, mask=None):
         """Inference forward pass (reference: ``output(INDArray)``)."""
         if not self._initialized:
             self.init()
         x = _as_jnp(x, self._dtype)
+        mask = _as_jnp(mask) if mask is not None else None
         out, _ = self._forward(self.params, self.states, x,
-                               training=train, rng=None, want_logits=False)
+                               training=train, rng=None,
+                               want_logits=False, mask=mask)
         return out
 
     def feed_forward(self, x, train: bool = False) -> list:
@@ -318,7 +397,8 @@ class MultiLayerNetwork:
         if hasattr(iterator, "reset"):
             iterator.reset()
         for ds in iterator:
-            out = self.output(ds.features)
+            out = self.output(ds.features,
+                              mask=getattr(ds, "features_mask", None))
             ev.eval(ds.labels, out,
                     mask=getattr(ds, "labels_mask", None))
         return ev

@@ -83,6 +83,18 @@ class ModelSerializer:
             }))
 
     @staticmethod
+    def restore_model(path, load_updater: bool = True):
+        """Dispatch on the checkpoint's meta.json model_class."""
+        with zipfile.ZipFile(Path(path)) as zf:
+            meta = json.loads(zf.read(META_ENTRY).decode()) \
+                if META_ENTRY in zf.namelist() else {}
+        if meta.get("model_class") == "ComputationGraph":
+            return ModelSerializer.restore_computation_graph(
+                path, load_updater)
+        return ModelSerializer.restore_multi_layer_network(
+            path, load_updater)
+
+    @staticmethod
     def restore_multi_layer_network(path, load_updater: bool = True):
         from deeplearning4j_tpu.nn.conf.builders import \
             MultiLayerConfiguration

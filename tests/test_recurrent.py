@@ -1,0 +1,194 @@
+"""Recurrent layer tests (reference: LSTMGradientCheckTests /
+GravesLSTMTest / char-RNN example — SURVEY.md 4.5, BASELINE config #3)."""
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.activations import Activation
+from deeplearning4j_tpu.learning import Adam
+from deeplearning4j_tpu.lossfunctions import LossFunction
+from deeplearning4j_tpu.nn import (InputType, MultiLayerNetwork,
+                                   NeuralNetConfiguration)
+from deeplearning4j_tpu.nn.conf.builders import (BackpropType,
+                                                 MultiLayerConfiguration)
+from deeplearning4j_tpu.nn.conf.layers import RnnOutputLayer
+from deeplearning4j_tpu.nn.conf.layers_recurrent import (
+    GRU, LSTM, Bidirectional, BidirectionalMode, EmbeddingSequenceLayer,
+    GravesLSTM, LastTimeStepLayer, SimpleRnn)
+
+
+def _char_data(n=64, t=20, vocab=8, seed=0):
+    """Deterministic next-token task: x_{t+1} = (x_t + 1) % vocab."""
+    rng = np.random.RandomState(seed)
+    starts = rng.randint(0, vocab, size=n)
+    seq = (starts[:, None] + np.arange(t + 1)[None, :]) % vocab
+    x = np.eye(vocab, dtype=np.float32)[seq[:, :-1]]
+    y = np.eye(vocab, dtype=np.float32)[seq[:, 1:]]
+    return x, y
+
+
+def _rnn_conf(layer, vocab=8, tbptt=None):
+    b = (NeuralNetConfiguration.Builder()
+         .seed(12).updater(Adam(1e-2)).list()
+         .layer(layer)
+         .layer(RnnOutputLayer(n_out=vocab,
+                               loss_function=LossFunction.MCXENT,
+                               activation=Activation.SOFTMAX)))
+    if tbptt:
+        b = b.backprop_type(BackpropType.TRUNCATED_BPTT) \
+             .t_bptt_length(tbptt)
+    return b.set_input_type(InputType.recurrent(vocab)).build()
+
+
+class TestRecurrentLayers:
+    @pytest.mark.parametrize("layer_cls", [SimpleRnn, LSTM, GravesLSTM,
+                                           GRU])
+    def test_char_rnn_learns_next_token(self, layer_cls):
+        vocab = 8
+        x, y = _char_data(vocab=vocab)
+        net = MultiLayerNetwork(
+            _rnn_conf(layer_cls(n_out=32), vocab)).init()
+        for _ in range(60):
+            net.fit(x, y)
+        out = np.asarray(net.output(x))
+        acc = float(np.mean(out.argmax(-1) == y.argmax(-1)))
+        assert acc > 0.95, f"{layer_cls.__name__}: {acc}"
+
+    def test_output_shape(self):
+        x, y = _char_data(n=4, t=10)
+        net = MultiLayerNetwork(_rnn_conf(LSTM(n_out=16))).init()
+        assert net.output(x).shape == (4, 10, 8)
+
+    def test_bidirectional_concat_width(self):
+        x, y = _char_data(n=4, t=6)
+        conf = _rnn_conf(Bidirectional(fwd=LSTM(n_out=16),
+                                       mode=BidirectionalMode.CONCAT))
+        net = MultiLayerNetwork(conf).init()
+        assert conf.layers[1].n_in == 32  # concat doubles features
+        assert net.output(x).shape == (4, 6, 8)
+
+    def test_json_round_trip_recurrent(self):
+        conf = _rnn_conf(GravesLSTM(n_out=16))
+        back = MultiLayerConfiguration.from_json(conf.to_json())
+        assert isinstance(back.layers[0], GravesLSTM)
+        assert back.layers[0].n_in == 8
+        conf2 = _rnn_conf(Bidirectional(fwd=LSTM(n_out=8)))
+        back2 = MultiLayerConfiguration.from_json(conf2.to_json())
+        assert isinstance(back2.layers[0], Bidirectional)
+        assert isinstance(back2.layers[0].fwd, LSTM)
+
+
+class TestTbptt:
+    def test_tbptt_iterations_and_score(self):
+        x, y = _char_data(n=16, t=20)
+        net = MultiLayerNetwork(
+            _rnn_conf(LSTM(n_out=16), tbptt=5)).init()
+        it0 = net.iteration_count
+        net.fit(x, y)
+        # 20 / 5 = 4 segment updates per batch
+        assert net.iteration_count == it0 + 4
+        assert np.isfinite(net.score())
+
+    def test_tbptt_state_carry_matters(self):
+        """With carry, segment 2 sees segment 1's state: training the
+        count-up task with tbptt=2 still converges."""
+        x, y = _char_data(n=64, t=16)
+        net = MultiLayerNetwork(
+            _rnn_conf(LSTM(n_out=32), tbptt=4)).init()
+        for _ in range(60):
+            net.fit(x, y)
+        out = np.asarray(net.output(x))
+        acc = float(np.mean(out.argmax(-1) == y.argmax(-1)))
+        assert acc > 0.9
+
+
+class TestRnnTimeStep:
+    def test_stream_matches_full_sequence(self):
+        x, _ = _char_data(n=4, t=10)
+        net = MultiLayerNetwork(_rnn_conf(GravesLSTM(n_out=16))).init()
+        full = np.asarray(net.output(x))
+        net.rnn_clear_previous_state()
+        steps = [np.asarray(net.rnn_time_step(x[:, t]))
+                 for t in range(10)]
+        stream = np.stack(steps, axis=1)
+        np.testing.assert_allclose(stream, full, rtol=1e-4, atol=1e-5)
+
+    def test_clear_resets(self):
+        x, _ = _char_data(n=2, t=5)
+        net = MultiLayerNetwork(_rnn_conf(LSTM(n_out=8))).init()
+        a = np.asarray(net.rnn_time_step(x[:, 0]))
+        net.rnn_time_step(x[:, 1])
+        net.rnn_clear_previous_state()
+        b = np.asarray(net.rnn_time_step(x[:, 0]))
+        np.testing.assert_allclose(a, b, rtol=1e-5)
+        assert net.rnn_get_previous_state(0) is not None
+
+
+class TestMasking:
+    def test_masked_steps_hold_state_and_output(self):
+        x, y = _char_data(n=2, t=6)
+        mask = np.ones((2, 6), np.float32)
+        mask[:, 3:] = 0.0  # only first 3 steps valid
+        net = MultiLayerNetwork(_rnn_conf(LSTM(n_out=8))).init()
+        layer = net.conf.layers[0]
+        params = net.params["layer_0"]
+        out_m, st_m = layer.forward(params, jnp.asarray(x), training=False,
+                                    rng=None, state=None,
+                                    mask=jnp.asarray(mask))
+        out_3, st_3 = layer.forward(params, jnp.asarray(x[:, :3]),
+                                    training=False, rng=None, state=None)
+        # final state frozen at step 3
+        np.testing.assert_allclose(np.asarray(st_m["h"]),
+                                   np.asarray(st_3["h"]), rtol=1e-5)
+
+    def test_masked_loss_training(self):
+        x, y = _char_data(n=32, t=10)
+        mask = np.ones((32, 10), np.float32)
+        mask[:, 5:] = 0.0
+        net = MultiLayerNetwork(_rnn_conf(LSTM(n_out=16))).init()
+        from deeplearning4j_tpu.datasets import DataSet
+        ds = DataSet(x, y, features_mask=mask, labels_mask=mask)
+        for _ in range(5):
+            net.fit(ds)
+        assert np.isfinite(net.score())
+
+    def test_last_time_step_layer(self):
+        x = np.arange(2 * 4 * 3, dtype=np.float32).reshape(2, 4, 3)
+        layer = LastTimeStepLayer()
+        out, _ = layer.forward({}, jnp.asarray(x), training=False)
+        np.testing.assert_allclose(np.asarray(out), x[:, -1])
+        mask = np.asarray([[1, 1, 0, 0], [1, 1, 1, 1]], np.float32)
+        out_m, _ = layer.forward({}, jnp.asarray(x), training=False,
+                                 mask=jnp.asarray(mask))
+        np.testing.assert_allclose(np.asarray(out_m)[0], x[0, 1])
+        np.testing.assert_allclose(np.asarray(out_m)[1], x[1, 3])
+
+    def test_graph_rnn_state_resets_between_batches(self):
+        """Regression: ComputationGraph must not leak batch-sized rnn
+        state across fit() calls (crashes on batch-size change)."""
+        from deeplearning4j_tpu.nn import NeuralNetConfiguration
+        from deeplearning4j_tpu.nn.graph import ComputationGraph
+        conf = (NeuralNetConfiguration.Builder()
+                .seed(0).updater(Adam(1e-2))
+                .graph_builder()
+                .add_inputs("in")
+                .set_input_types(InputType.recurrent(3))
+                .add_layer("rnn", SimpleRnn(n_out=8), "in")
+                .add_layer("out", RnnOutputLayer(n_out=3), "rnn")
+                .set_outputs("out").build())
+        net = ComputationGraph(conf).init()
+        x4 = np.random.RandomState(0).rand(4, 5, 3).astype(np.float32)
+        y4 = np.eye(3, dtype=np.float32)[
+            np.random.RandomState(1).randint(0, 3, (4, 5))]
+        net.fit(x4, y4)
+        x2, y2 = x4[:2], y4[:2]
+        net.fit(x2, y2)  # batch-size change must not crash
+        assert net.states["rnn"] == {}  # no state persisted
+
+    def test_embedding_sequence(self):
+        tokens = np.random.RandomState(0).randint(0, 10, (4, 6))
+        layer = EmbeddingSequenceLayer(n_in=10, n_out=5)
+        import jax
+        params = layer.init_params(jax.random.PRNGKey(0), None)
+        out, _ = layer.forward(params, jnp.asarray(tokens), training=False)
+        assert out.shape == (4, 6, 5)
