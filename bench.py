@@ -1,0 +1,62 @@
+"""Benchmark: ResNet-50 training throughput, images/sec/chip.
+
+BASELINE.md metric #2 (single-chip leg of the north star). Synthetic
+ImageNet-shaped data (the metric is compute throughput; input pipeline
+is benchmarked separately). `BASELINE.json.published` is empty — no
+reference number exists, so ``vs_baseline`` is reported as 1.0 until a
+reference measurement lands (BASELINE.md measurement protocol step 4).
+
+Prints ONE JSON line:
+  {"metric": ..., "value": N, "unit": "images/sec/chip", "vs_baseline": N}
+"""
+from __future__ import annotations
+
+import json
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def main():
+    from deeplearning4j_tpu.datasets.dataset import DataSet
+    from deeplearning4j_tpu.models.zoo import ResNet50
+
+    on_tpu = jax.devices()[0].platform == "tpu"
+    batch = 64 if on_tpu else 8
+    hw = 224 if on_tpu else 64
+
+    net = ResNet50(num_classes=1000, height=hw, width=hw).init()
+    if net._train_step is None:
+        net._build_train_step()
+
+    rng = np.random.RandomState(0)
+    x = rng.randn(batch, hw, hw, 3).astype(np.float32)
+    y = np.eye(1000, dtype=np.float32)[rng.randint(0, 1000, batch)]
+    ds = DataSet(x, y)
+
+    # warmup (compile)
+    for _ in range(3):
+        net.fit(ds)
+    jax.block_until_ready(net.params)
+
+    steps = 20 if on_tpu else 3
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        net.fit(ds)
+    jax.block_until_ready(net.params)
+    dt = time.perf_counter() - t0
+
+    ips = steps * batch / dt
+    print(json.dumps({
+        "metric": "resnet50_train_throughput"
+                  + ("" if on_tpu else f"_cpu_proxy_{hw}px"),
+        "value": round(ips, 2),
+        "unit": "images/sec/chip",
+        "vs_baseline": 1.0,
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -516,9 +516,12 @@ class GlobalPoolingLayer(Layer):
                 z = jnp.max(jnp.where(m > 0, x, -jnp.inf), axis=1)
             elif self.pooling_type is PoolingType.SUM:
                 z = jnp.sum(x * m, axis=1)
-            else:
+            elif self.pooling_type is PoolingType.AVG:
                 z = jnp.sum(x * m, axis=1) / jnp.maximum(
                     jnp.sum(m, axis=1), 1.0)
+            else:                # PNORM over unmasked timesteps
+                p = float(self.pnorm) if hasattr(self, "pnorm") else 2.0
+                z = jnp.sum(jnp.abs(x * m) ** p, axis=1) ** (1.0 / p)
             return z, state
         if self.pooling_type is PoolingType.MAX:
             z = jnp.max(x, axis=axes)

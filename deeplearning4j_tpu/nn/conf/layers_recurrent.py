@@ -64,11 +64,6 @@ class BaseRecurrentLayer(Layer):
         y, new_state = self._scan(params, x, state, mask)
         return y, new_state
 
-    def forward_step(self, params, x_t, state):
-        """Single timestep (rnnTimeStep hot path): x_t [b, f]."""
-        y, new_state = self._scan(params, x_t[:, None, :], state, None)
-        return y[:, 0], new_state
-
     @staticmethod
     def _run_scan(step, carry, xw, mask):
         """Shared time-loop dispatch: ``step(carry, (xw_t, m_t|None))``.
@@ -194,33 +189,17 @@ class GravesLSTM(LSTM):
         p["pO"] = wi.init(jax.random.fold_in(k, 2), (H,), H, H, dtype)
         return p
 
-    def _scan(self, params, x, state, mask):
+    def _gates(self, z, c_prev, params):
         H = self.n_out
         gate = self.gate_activation.fn()
         act = self.activation.fn()
-        xw = x @ params["W"]
-        if self.has_bias:
-            xw = xw + params["b"]
-
-        def step(carry, inp):
-            h_prev, c_prev = carry
-            xw_t, m_t = inp
-            z = xw_t + h_prev @ params["RW"]
-            i = gate(z[:, :H] + c_prev * params["pI"])
-            f = gate(z[:, H:2 * H] + c_prev * params["pF"])
-            g = act(z[:, 3 * H:])
-            c = f * c_prev + i * g
-            o = gate(z[:, 2 * H:3 * H] + c * params["pO"])
-            h = o * act(c)
-            if m_t is not None:
-                keep = m_t[:, None] > 0
-                h = jnp.where(keep, h, h_prev)
-                c = jnp.where(keep, c, c_prev)
-            return (h, c), h
-
-        (h_last, c_last), ys = self._run_scan(
-            step, (state["h"], state["c"]), xw, mask)
-        return ys, {"h": h_last, "c": c_last}
+        i = gate(z[:, :H] + c_prev * params["pI"])
+        f = gate(z[:, H:2 * H] + c_prev * params["pF"])
+        g = act(z[:, 3 * H:])
+        c = f * c_prev + i * g
+        o = gate(z[:, 2 * H:3 * H] + c * params["pO"])
+        h = o * act(c)
+        return h, c
 
 
 @dataclass

@@ -316,6 +316,7 @@ class ComputationGraph:
             self._score = float(loss)
             self.iteration_count += 1
         self.states = self._strip_rnn_states(states)
+        self.last_batch_size = int(inputs[0].shape[0])
         for lis in self.listeners:
             lis.iteration_done(self, self.iteration_count - 1,
                                self.epoch_count)
@@ -340,6 +341,24 @@ class ComputationGraph:
             out = out[0]
         return np.asarray(jnp.argmax(out, axis=-1))
 
+    @staticmethod
+    def _ds_fmask(ds):
+        """First features mask, honoring both the MultiDataSet plural
+        (features_masks) and DataSet singular (features_mask) attrs —
+        same lookup order as _fit_dataset."""
+        ms = getattr(ds, "features_masks", None)
+        if ms:
+            return ms[0]
+        return getattr(ds, "features_mask", None)
+
+    @staticmethod
+    def _ds_lmasks(ds):
+        ms = getattr(ds, "labels_masks", None)
+        if ms is not None:
+            return ms
+        lm = getattr(ds, "labels_mask", None)
+        return [lm] if lm is not None else None
+
     def score(self, dataset=None) -> float:
         if dataset is None:
             return self._score
@@ -349,16 +368,12 @@ class ComputationGraph:
             else [dataset.labels]
         xs = [_as_jnp(x, self._dtype) for x in feats]
         ys = [_as_jnp(y, self._dtype) for y in labs]
-        lmasks = getattr(dataset, "labels_masks", None)
-        if lmasks is None:
-            lm = getattr(dataset, "labels_mask", None)
-            lmasks = [lm] if lm is not None else None
+        lmasks = self._ds_lmasks(dataset)
+        fmask = self._ds_fmask(dataset)
         acts, _ = self._forward(
             self.params, self.states, xs, training=False, rng=None,
             want_logits=True,
-            fmask=_as_jnp(getattr(dataset, "features_mask", None))
-            if getattr(dataset, "features_mask", None) is not None
-            else None)
+            fmask=_as_jnp(fmask) if fmask is not None else None)
         loss = self._regularization(self.params)
         out_confs = self.output_layer_confs()
         for i, out_name in enumerate(self.conf.network_outputs):
@@ -378,13 +393,13 @@ class ComputationGraph:
         for ds in iterator:
             feats = ds.features if isinstance(ds.features, list) \
                 else [ds.features]
-            out = self.output(*feats,
-                              mask=getattr(ds, "features_mask", None))
+            out = self.output(*feats, mask=self._ds_fmask(ds))
             if isinstance(out, list):
                 out = out[0]
+            lmasks = self._ds_lmasks(ds)
             ev.eval(ds.labels if not isinstance(ds.labels, list)
                     else ds.labels[0], out,
-                    mask=getattr(ds, "labels_mask", None))
+                    mask=lmasks[0] if lmasks else None)
         return ev
 
     # ------------------------------------------------------------------

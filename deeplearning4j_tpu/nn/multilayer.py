@@ -310,6 +310,13 @@ class MultiLayerNetwork:
     def rnn_time_step(self, x):
         """Feed one step (or a chunk) of a sequence, carrying hidden
         state across calls (reference: rnnTimeStep)."""
+        from deeplearning4j_tpu.nn.conf.layers_recurrent import Bidirectional
+        if any(isinstance(l, Bidirectional) for l in self.conf.layers):
+            # reference throws too: the backward direction needs future
+            # timesteps, which streaming cannot provide
+            raise ValueError(
+                "rnnTimeStep is not supported on networks with "
+                "Bidirectional layers")
         if not self._initialized:
             self.init()
         x = _as_jnp(x, self._dtype)
@@ -319,6 +326,12 @@ class MultiLayerNetwork:
         if getattr(self, "_rnn_stream_states", None) is None:
             self._rnn_stream_states = self._with_zero_rnn_states(
                 self.states, int(x.shape[0]))
+            self._rnn_stream_batch = int(x.shape[0])
+        elif int(x.shape[0]) != self._rnn_stream_batch:
+            raise ValueError(
+                f"rnnTimeStep batch size {int(x.shape[0])} != stored "
+                f"state batch size {self._rnn_stream_batch}; call "
+                f"rnn_clear_previous_state() first")
         out, new_states = self._forward(
             self.params, self._rnn_stream_states, x, training=False,
             rng=None, want_logits=False)

@@ -1,0 +1,41 @@
+"""Parallelism & distribution (SURVEY.md §2.6, P1–P6, P11).
+
+TPU-native replacement for the reference's parallelism stack:
+
+- ``org.deeplearning4j.parallelism.ParallelWrapper`` (P1/P2) ->
+  :class:`ParallelWrapper`: one SPMD program over a ``jax.sharding.Mesh``
+  ``data`` axis; the gradient all-reduce is compiled INTO the train step
+  by XLA's GSPMD partitioner and rides ICI — no trainer threads, no
+  parameter copies, no encoded-update queues.
+- ``org.deeplearning4j.spark.parameterserver.training.SharedTrainingMaster``
+  (P4) -> :class:`SharedTrainingMaster`: multi-host DP via
+  ``jax.distributed`` (gRPC control plane) + the same compiled collectives
+  over ICI/DCN. Spark/Aeron disappear.
+- ``org.deeplearning4j.parallelism.ParallelInference`` (P6) ->
+  :class:`ParallelInference`: batched inference sharded over the mesh.
+- threshold gradient encoding (P2 `EncodedGradientsAccumulator`) ->
+  :mod:`.encoding` keeps the *semantics* as an optional compression
+  transform; on TPU the north star replaces it with dense XLA AllReduce.
+"""
+from deeplearning4j_tpu.parallel.mesh import (DEFAULT_DATA_AXIS,
+                                              MeshFactory, data_sharding,
+                                              make_mesh, replicate_tree,
+                                              shard_batch)
+from deeplearning4j_tpu.parallel.wrapper import ParallelWrapper
+from deeplearning4j_tpu.parallel.inference import ParallelInference
+from deeplearning4j_tpu.parallel.sharedtraining import (
+    SharedTrainingConfiguration, SharedTrainingMaster)
+from deeplearning4j_tpu.parallel.encoding import (
+    AdaptiveThresholdAlgorithm, EncodingHandler, FixedThresholdAlgorithm,
+    ResidualClippingPostProcessor, TargetSparsityThresholdAlgorithm,
+    ThresholdAlgorithm, encode_threshold, decode_threshold)
+
+__all__ = [
+    "DEFAULT_DATA_AXIS", "MeshFactory", "make_mesh", "data_sharding",
+    "replicate_tree", "shard_batch", "ParallelWrapper",
+    "ParallelInference", "SharedTrainingMaster",
+    "SharedTrainingConfiguration", "ThresholdAlgorithm",
+    "FixedThresholdAlgorithm", "AdaptiveThresholdAlgorithm",
+    "TargetSparsityThresholdAlgorithm", "ResidualClippingPostProcessor",
+    "EncodingHandler", "encode_threshold", "decode_threshold",
+]

@@ -1,0 +1,167 @@
+"""Threshold gradient encoding (compression) — functional port of the
+reference's gradient-sharing update compression.
+
+Reference parity (SURVEY.md P2, J11):
+``org.deeplearning4j.optimize.solvers.accumulation.encoding.*`` —
+`EncodingHandler` quantizes each gradient to sign(g)*tau for |g| > tau,
+keeps the remainder as a local *residual* added back before the next
+encode, and `ThresholdAlgorithm` adapts tau (Fixed / Adaptive /
+TargetSparsity); `ResidualPostProcessor` clips stale residuals.
+
+TPU-first status: BASELINE.json's north star explicitly replaces the
+encoded-update exchange with a dense XLA AllReduce over ICI — on TPU the
+dense collective is compiled into the step and is bandwidth-optimal, so
+encoding is OFF by default. The semantics are preserved here as a pure
+gradient transform (quantized + residual carry) usable as an optional
+DCN-side compression mode: all ops are dense and jit-friendly (a sparse
+int-index wire format would fight XLA's static shapes for no win
+in-graph).
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+def encode_threshold(g: jnp.ndarray, tau) -> Tuple[jnp.ndarray,
+                                                   jnp.ndarray]:
+    """Quantize ``g`` to {-tau, 0, +tau} elementwise (the reference's
+    native `encodeThreshold` op); returns (quantized, residual)."""
+    q = jnp.where(jnp.abs(g) >= tau, jnp.sign(g) * tau, 0.0).astype(g.dtype)
+    return q, g - q
+
+
+def decode_threshold(q: jnp.ndarray) -> jnp.ndarray:
+    """Identity in the dense representation (reference `decodeThreshold`
+    turns the sparse int stream back into a dense array)."""
+    return q
+
+
+def sparsity(q: jnp.ndarray) -> jnp.ndarray:
+    """Fraction of non-zero (transmitted) elements."""
+    return jnp.mean((q != 0).astype(jnp.float32))
+
+
+class ThresholdAlgorithm:
+    """tau policy. Subclasses return the next tau given the last step's
+    observed sparsity (reference: encoding.threshold.ThresholdAlgorithm)."""
+
+    def initial(self) -> float:
+        raise NotImplementedError
+
+    def next_tau(self, tau: float, last_sparsity: float) -> float:
+        raise NotImplementedError
+
+
+@dataclass
+class FixedThresholdAlgorithm(ThresholdAlgorithm):
+    """Reference: FixedThresholdAlgorithm — constant tau."""
+    threshold: float = 1e-3
+
+    def initial(self) -> float:
+        return self.threshold
+
+    def next_tau(self, tau: float, last_sparsity: float) -> float:
+        return tau
+
+
+@dataclass
+class AdaptiveThresholdAlgorithm(ThresholdAlgorithm):
+    """Reference: AdaptiveThresholdAlgorithm — keep the transmitted
+    fraction inside [min_sparsity_target, max_sparsity_target] by
+    scaling tau by `decay_rate` steps."""
+    initial_threshold: float = 1e-3
+    min_target: float = 1e-4
+    max_target: float = 1e-2
+    decay_rate: float = 1.02
+
+    def initial(self) -> float:
+        return self.initial_threshold
+
+    def next_tau(self, tau: float, last_sparsity: float) -> float:
+        if last_sparsity > self.max_target:    # sending too much -> raise
+            return tau * self.decay_rate
+        if last_sparsity < self.min_target:    # sending too little -> lower
+            return tau / self.decay_rate
+        return tau
+
+
+@dataclass
+class TargetSparsityThresholdAlgorithm(ThresholdAlgorithm):
+    """Reference: TargetSparsityThresholdAlgorithm — steer toward one
+    target transmitted fraction."""
+    initial_threshold: float = 1e-3
+    target: float = 1e-3
+    decay_rate: float = 1.05
+
+    def initial(self) -> float:
+        return self.initial_threshold
+
+    def next_tau(self, tau: float, last_sparsity: float) -> float:
+        if last_sparsity > self.target:
+            return tau * self.decay_rate
+        if last_sparsity < self.target:
+            return tau / self.decay_rate
+        return tau
+
+
+@dataclass
+class ResidualClippingPostProcessor:
+    """Reference: encoding.residual.ResidualClippingPostProcessor —
+    every `frequency` steps, clip residuals to +/- max_multiple*tau so
+    stale residual cannot blow up."""
+    max_multiple: float = 5.0
+    frequency: int = 5
+
+    def apply(self, step: int, tau: float, residual):
+        if self.frequency <= 0 or step % self.frequency != 0:
+            return residual
+        lim = self.max_multiple * tau
+        return jax.tree_util.tree_map(
+            lambda r: jnp.clip(r, -lim, lim), residual)
+
+
+class EncodingHandler:
+    """Stateful encode pipeline (reference:
+    accumulation.encoding.EncodingHandler): residual-corrected threshold
+    quantization with adaptive tau.
+
+    ``encode(grads)`` -> quantized grads tree; residual and tau update
+    internally. The quantized tree is what a DCN-side compressed
+    all-reduce would exchange; callers then apply it like a gradient.
+    """
+
+    def __init__(self, algorithm: Optional[ThresholdAlgorithm] = None,
+                 residual_post: Optional[ResidualClippingPostProcessor]
+                 = None):
+        self.algorithm = algorithm or AdaptiveThresholdAlgorithm()
+        self.residual_post = residual_post or ResidualClippingPostProcessor()
+        self.tau = self.algorithm.initial()
+        self.residual = None
+        self.step = 0
+        self.last_sparsity = 0.0
+
+    def encode(self, grads):
+        if self.residual is None:
+            self.residual = jax.tree_util.tree_map(jnp.zeros_like, grads)
+        corrected = jax.tree_util.tree_map(lambda g, r: g + r, grads,
+                                           self.residual)
+        pairs = jax.tree_util.tree_map(
+            lambda g: encode_threshold(g, self.tau), corrected)
+        quantized = jax.tree_util.tree_map(
+            lambda p: p[0], pairs, is_leaf=lambda x: isinstance(x, tuple))
+        self.residual = jax.tree_util.tree_map(
+            lambda p: p[1], pairs, is_leaf=lambda x: isinstance(x, tuple))
+        leaves = jax.tree_util.tree_leaves(quantized)
+        if leaves:
+            total = sum(l.size for l in leaves)
+            nz = sum(float(jnp.sum(l != 0)) for l in leaves)
+            self.last_sparsity = nz / max(total, 1)
+        self.tau = self.algorithm.next_tau(self.tau, self.last_sparsity)
+        self.residual = self.residual_post.apply(self.step, self.tau,
+                                                 self.residual)
+        self.step += 1
+        return quantized

@@ -1,0 +1,142 @@
+"""ParallelInference: batched multi-device inference.
+
+Reference parity: ``org.deeplearning4j.parallelism.ParallelInference``
+(SURVEY.md P6) — request batching across threads with per-device model
+workers and observable round-trips.
+
+TPU-first design: one jitted forward, batch sharded over the mesh
+``data`` axis; XLA splits the work across devices. `BATCHED` mode's
+request aggregation becomes a `batch_limit`-sized queue flushed through
+the sharded program; `SEQUENTIAL` mode is a plain single call.
+"""
+from __future__ import annotations
+
+import logging
+from typing import List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from deeplearning4j_tpu.parallel.mesh import (DEFAULT_DATA_AXIS,
+                                              data_sharding, make_mesh,
+                                              pad_batch_to_multiple,
+                                              replicate_tree)
+
+log = logging.getLogger("deeplearning4j_tpu")
+
+
+class InferenceMode:
+    SEQUENTIAL = "SEQUENTIAL"
+    BATCHED = "BATCHED"
+
+
+class ParallelInference:
+    def __init__(self, model, mesh=None, *,
+                 inference_mode: str = InferenceMode.BATCHED,
+                 batch_limit: int = 32,
+                 queue_limit: int = 64):
+        self.model = model
+        self.mesh = mesh if mesh is not None else make_mesh()
+        self.inference_mode = inference_mode
+        self.batch_limit = batch_limit
+        self.queue_limit = queue_limit
+        self._fwd = None
+        self._placed = False
+
+    class Builder:
+        def __init__(self, model):
+            self._model = model
+            self._mesh = None
+            self._mode = InferenceMode.BATCHED
+            self._batch_limit = 32
+            self._queue_limit = 64
+            self._workers = None
+
+        def inference_mode(self, mode: str):
+            self._mode = mode
+            return self
+
+        def batch_limit(self, n: int):
+            self._batch_limit = n
+            return self
+
+        def queue_limit(self, n: int):
+            self._queue_limit = n
+            return self
+
+        def workers(self, n: int):
+            self._workers = n
+            return self
+
+        def build(self) -> "ParallelInference":
+            mesh = self._mesh
+            if mesh is None:
+                devs = jax.devices()
+                if self._workers:
+                    devs = devs[:self._workers]
+                mesh = make_mesh({DEFAULT_DATA_AXIS: len(devs)}, devs)
+            return ParallelInference(self._model, mesh,
+                                     inference_mode=self._mode,
+                                     batch_limit=self._batch_limit,
+                                     queue_limit=self._queue_limit)
+
+    # ------------------------------------------------------------------
+    @property
+    def n_workers(self) -> int:
+        return self.mesh.shape[DEFAULT_DATA_AXIS]
+
+    def _ensure(self):
+        m = self.model
+        if not m._initialized:
+            m.init()
+        if not self._placed:
+            m.params = replicate_tree(self.mesh, m.params)
+            m.states = replicate_tree(self.mesh, m.states)
+            self._placed = True
+        if self._fwd is None:
+            from deeplearning4j_tpu.nn.graph import ComputationGraph
+            is_graph = isinstance(m, ComputationGraph)
+
+            def fwd(params, states, x):
+                if is_graph:
+                    acts, _ = m._forward(params, states, [x],
+                                         training=False, rng=None,
+                                         want_logits=False)
+                    return acts[m.conf.network_outputs[0]]
+                out, _ = m._forward(params, states, x, training=False,
+                                    rng=None, want_logits=False)
+                return out
+
+            self._fwd = jax.jit(fwd)
+
+    def output(self, x) -> np.ndarray:
+        """Run inference on ``x``; pads the batch to a shard multiple and
+        slices the padding back off (padding is safe for inference,
+        unlike training — mesh.py note)."""
+        self._ensure()
+        x = jnp.asarray(x)
+        if jnp.issubdtype(x.dtype, jnp.floating):
+            x = x.astype(self.model._dtype)
+        padded, orig = pad_batch_to_multiple(x, self.n_workers)
+        padded = jax.device_put(
+            padded, data_sharding(self.mesh, padded.ndim))
+        out = self._fwd(self.model.params, self.model.states, padded)
+        return np.asarray(out[:orig])
+
+    def output_batched(self, requests: List) -> List[np.ndarray]:
+        """BATCHED mode: aggregate many small requests into shard-wide
+        batches (the reference's observable queue, synchronously)."""
+        self._ensure()
+        arrays = [jnp.asarray(r) for r in requests]
+        sizes = [a.shape[0] for a in arrays]
+        big = jnp.concatenate(arrays, axis=0)
+        outs = []
+        for i in range(0, big.shape[0], self.batch_limit):
+            outs.append(self.output(big[i:i + self.batch_limit]))
+        flat = np.concatenate(outs, axis=0)
+        result, off = [], 0
+        for s in sizes:
+            result.append(flat[off:off + s])
+            off += s
+        return result

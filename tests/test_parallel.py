@@ -1,0 +1,148 @@
+"""Parallelism tests on the virtual 8-device CPU mesh (SURVEY.md §4.7).
+
+Covers: mesh construction, ParallelWrapper DP training (exactness vs
+single-device), ParallelInference batching, SharedTrainingMaster
+single-process path, threshold encoding semantics.
+"""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.activations import Activation
+from deeplearning4j_tpu.datasets.dataset import DataSet
+from deeplearning4j_tpu.datasets.iterators import ListDataSetIterator
+from deeplearning4j_tpu.learning.updaters import Sgd
+from deeplearning4j_tpu.lossfunctions import LossFunction
+from deeplearning4j_tpu.nn.conf.builders import NeuralNetConfiguration
+from deeplearning4j_tpu.nn.conf.inputs import InputType
+from deeplearning4j_tpu.nn.conf.layers import DenseLayer, OutputLayer
+from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+from deeplearning4j_tpu.nn.weights import WeightInit
+from deeplearning4j_tpu.parallel import (AdaptiveThresholdAlgorithm,
+                                         EncodingHandler,
+                                         FixedThresholdAlgorithm,
+                                         ParallelInference, ParallelWrapper,
+                                         SharedTrainingMaster, make_mesh,
+                                         encode_threshold)
+from deeplearning4j_tpu.parallel.mesh import MeshFactory
+
+
+def _mlp(seed=42):
+    conf = (NeuralNetConfiguration.Builder()
+            .seed(seed)
+            .updater(Sgd(0.1))
+            .weight_init(WeightInit.XAVIER)
+            .list()
+            .layer(DenseLayer(n_in=8, n_out=16,
+                              activation=Activation.TANH))
+            .layer(OutputLayer(n_out=3,
+                               loss_function=LossFunction.MCXENT,
+                               activation=Activation.SOFTMAX))
+            .set_input_type(InputType.feed_forward(8))
+            .build())
+    return MultiLayerNetwork(conf).init()
+
+
+def _data(n=64, seed=0):
+    rng = np.random.RandomState(seed)
+    x = rng.randn(n, 8).astype(np.float32)
+    y = np.eye(3, dtype=np.float32)[rng.randint(0, 3, n)]
+    return DataSet(x, y)
+
+
+def test_make_mesh_shapes():
+    m = make_mesh()
+    assert m.shape["data"] == 8
+    m2 = make_mesh({"data": -1, "model": 2})
+    assert m2.shape["data"] == 4 and m2.shape["model"] == 2
+    with pytest.raises(ValueError):
+        make_mesh({"data": 3})
+    m3 = MeshFactory.full(data=2, model=2, seq=2, stage=1)
+    assert m3.shape["seq"] == 2
+
+
+def test_parallel_wrapper_matches_single_device():
+    """8-way DP on the same global batch must equal single-device SGD
+    (exact synchronous semantics)."""
+    ds = _data(64)
+    single = _mlp(seed=7)
+    single.fit(ds)
+
+    parallel_net = _mlp(seed=7)
+    pw = ParallelWrapper.Builder(parallel_net).workers(8).build()
+    assert pw.n_workers == 8
+    pw.fit_batch(ds)
+
+    for k in single.params:
+        for name in single.params[k]:
+            np.testing.assert_allclose(
+                np.asarray(single.params[k][name]),
+                np.asarray(parallel_net.params[k][name]),
+                rtol=2e-4, atol=2e-5)
+
+
+def test_parallel_wrapper_trains_iterator():
+    net = _mlp()
+    it = ListDataSetIterator([_data(32, seed=i) for i in range(4)])
+    pw = ParallelWrapper.Builder(net).workers(8).averaging_frequency(3) \
+        .build()
+    before = net.score()
+    pw.fit(it, n_epochs=3)
+    assert np.isfinite(net.score())
+    assert net.iteration_count == 12
+
+
+def test_parallel_wrapper_trims_odd_batch():
+    net = _mlp()
+    pw = ParallelWrapper.Builder(net).workers(8).build()
+    pw.fit_batch(_data(61))          # trimmed to 56
+    assert net.last_batch_size == 56
+
+
+def test_parallel_inference_pads_and_matches():
+    net = _mlp()
+    x = np.random.RandomState(1).randn(13, 8).astype(np.float32)
+    pi = ParallelInference.Builder(net).batch_limit(8).build()
+    out = pi.output(x)
+    assert out.shape == (13, 3)
+    ref = np.asarray(net.output(x))
+    np.testing.assert_allclose(out, ref, rtol=1e-5, atol=1e-6)
+    outs = pi.output_batched([x[:3], x[3:10], x[10:]])
+    assert [o.shape[0] for o in outs] == [3, 7, 3]
+    np.testing.assert_allclose(np.concatenate(outs), ref, rtol=1e-5,
+                               atol=1e-6)
+
+
+def test_shared_training_master_single_process():
+    net = _mlp()
+    it = ListDataSetIterator([_data(32, seed=i) for i in range(3)])
+    master = (SharedTrainingMaster.Builder(batch_size_per_worker=4)
+              .threshold_algorithm(AdaptiveThresholdAlgorithm())
+              .build())
+    master.fit(net, it, n_epochs=2)
+    assert net.iteration_count == 6
+    assert np.isfinite(net.score())
+
+
+def test_encode_threshold_roundtrip():
+    g = jnp.asarray([0.5, -0.2, 0.001, -0.0005, 2.0])
+    q, r = encode_threshold(g, 0.1)
+    np.testing.assert_allclose(np.asarray(q), [0.1, -0.1, 0.0, 0.0, 0.1])
+    np.testing.assert_allclose(np.asarray(q + r), np.asarray(g), rtol=1e-6)
+
+
+def test_encoding_handler_residual_carry():
+    h = EncodingHandler(FixedThresholdAlgorithm(0.1))
+    g = {"W": jnp.full((4,), 0.06)}          # below tau: nothing sent
+    q1 = h.encode(g)
+    assert float(jnp.sum(jnp.abs(q1["W"]))) == 0.0
+    q2 = h.encode(g)                          # residual accumulates: sent
+    np.testing.assert_allclose(np.asarray(q2["W"]), np.full((4,), 0.1))
+
+
+def test_adaptive_threshold_moves_tau():
+    a = AdaptiveThresholdAlgorithm(initial_threshold=1e-3,
+                                   min_target=1e-4, max_target=1e-2)
+    assert a.next_tau(1e-3, 0.5) > 1e-3       # too dense -> raise tau
+    assert a.next_tau(1e-3, 1e-6) < 1e-3      # too sparse -> lower tau
