@@ -1,0 +1,25 @@
+"""Autodiff graph layer — the SameDiff equivalent (SURVEY.md §2.3).
+
+Reference parity: ``org.nd4j.autodiff.samediff.SameDiff`` (S1 graph
+builder), per-op ``doDiff`` reverse-mode autodiff (S2), Inference/
+TrainingSession executors (S3), ``TrainingConfig``/``fit`` (S4),
+FlatBuffers save/load (S5).
+
+TPU-first mapping: the reference executes the retained op graph
+op-by-op through OpExecutioner, building a second backward graph via
+per-op doDiff. Here the graph IS a trace: evaluation walks the DAG once
+inside ``jax.jit`` so XLA compiles the whole graph (fusing across op
+boundaries the reference cannot), and the gradient function is
+``jax.grad`` of that trace — no per-op doDiff, no second graph, no
+Enter/Exit/Merge/Switch frames (structured ``lax.while_loop``/``cond``
+ops instead). Serialization keeps the reference's contract (graph +
+params + updater state + training config in one file) in a zip of
+JSON + npz rather than FlatBuffers.
+"""
+from deeplearning4j_tpu.autodiff.samediff import (SameDiff, SDVariable,
+                                                  VariableType)
+from deeplearning4j_tpu.autodiff.training import TrainingConfig, History
+from deeplearning4j_tpu.autodiff.registry import OP_REGISTRY, op_coverage
+
+__all__ = ["SameDiff", "SDVariable", "VariableType", "TrainingConfig",
+           "History", "OP_REGISTRY", "op_coverage"]

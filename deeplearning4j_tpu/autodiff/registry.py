@@ -1,0 +1,1095 @@
+"""Op registry: named graph ops -> jax implementations.
+
+Reference parity: libnd4j's ~500 declarable ops (SURVEY.md N5,
+Appendix A domain checklist) carried in Java by the
+``DynamicCustomOp`` hierarchy (J2). Here an op is a pure function
+``fn(inputs: list[Array], attrs: dict) -> Array | tuple`` registered
+under its reference/TF-compatible name; the SameDiff layer dispatches
+through this table and XLA fuses the result (so an "op" needs no
+hand-written kernel or gradient — jax.grad differentiates the trace).
+
+Coverage accounting (§4.3 OpValidation pattern): every op declares a
+domain; ``op_coverage()`` reports per-domain counts and tests assert
+domains are populated.
+"""
+from __future__ import annotations
+
+from functools import partial
+from typing import Callable, Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+OP_REGISTRY: Dict[str, Callable] = {}
+OP_DOMAINS: Dict[str, str] = {}
+
+
+def op(name, domain):
+    def deco(fn):
+        OP_REGISTRY[name] = fn
+        OP_DOMAINS[name] = domain
+        return fn
+    return deco
+
+
+def alias(new, existing):
+    OP_REGISTRY[new] = OP_REGISTRY[existing]
+    OP_DOMAINS[new] = OP_DOMAINS[existing]
+
+
+def op_coverage() -> Dict[str, int]:
+    out: Dict[str, int] = {}
+    for _, d in OP_DOMAINS.items():
+        out[d] = out.get(d, 0) + 1
+    return out
+
+
+def get_op(name: str) -> Callable:
+    if name not in OP_REGISTRY:
+        raise KeyError(f"unknown op '{name}'; known domains: "
+                       f"{sorted(set(OP_DOMAINS.values()))}")
+    return OP_REGISTRY[name]
+
+
+# -- helpers ----------------------------------------------------------------
+
+def _unary(name, domain, fn):
+    OP_REGISTRY[name] = lambda ins, attrs: fn(ins[0])
+    OP_DOMAINS[name] = domain
+
+
+def _binary(name, domain, fn):
+    OP_REGISTRY[name] = lambda ins, attrs: fn(ins[0], ins[1])
+    OP_DOMAINS[name] = domain
+
+
+def _reduce(name, fn):
+    def impl(ins, attrs):
+        axis = attrs.get("axis")
+        if isinstance(axis, (list, tuple)):
+            axis = tuple(axis)
+        return fn(ins[0], axis=axis,
+                  keepdims=bool(attrs.get("keep_dims", False)))
+    OP_REGISTRY[name] = impl
+    OP_DOMAINS[name] = "reduce"
+
+
+# -- arithmetic / broadcastable (Appendix A: broadcastable) -----------------
+_binary("add", "arithmetic", jnp.add)
+_binary("sub", "arithmetic", jnp.subtract)
+_binary("mul", "arithmetic", jnp.multiply)
+_binary("div", "arithmetic", jnp.divide)
+_binary("rdiv", "arithmetic", lambda a, b: b / a)
+_binary("rsub", "arithmetic", lambda a, b: b - a)
+_binary("pow", "arithmetic", jnp.power)
+_binary("floordiv", "arithmetic", jnp.floor_divide)
+_binary("mod", "arithmetic", jnp.mod)
+_binary("maximum", "arithmetic", jnp.maximum)
+_binary("minimum", "arithmetic", jnp.minimum)
+_binary("squared_difference", "arithmetic", lambda a, b: (a - b) ** 2)
+_unary("neg", "arithmetic", jnp.negative)
+_unary("abs", "arithmetic", jnp.abs)
+_unary("sign", "arithmetic", jnp.sign)
+_unary("reciprocal", "arithmetic", jnp.reciprocal)
+
+# -- transforms (same/strict/float) -----------------------------------------
+_unary("exp", "transform", jnp.exp)
+_unary("log", "transform", jnp.log)
+_unary("log1p", "transform", jnp.log1p)
+_unary("expm1", "transform", jnp.expm1)
+_unary("sqrt", "transform", jnp.sqrt)
+_unary("rsqrt", "transform", lambda x: lax.rsqrt(x))
+_unary("square", "transform", jnp.square)
+_unary("cube", "transform", lambda x: x ** 3)
+_unary("floor", "transform", jnp.floor)
+_unary("ceil", "transform", jnp.ceil)
+_unary("round", "transform", jnp.round)
+_unary("sin", "transform", jnp.sin)
+_unary("cos", "transform", jnp.cos)
+_unary("tan", "transform", jnp.tan)
+_unary("asin", "transform", jnp.arcsin)
+_unary("acos", "transform", jnp.arccos)
+_unary("atan", "transform", jnp.arctan)
+_unary("sinh", "transform", jnp.sinh)
+_unary("cosh", "transform", jnp.cosh)
+_unary("tanh", "transform", jnp.tanh)
+_unary("asinh", "transform", jnp.arcsinh)
+_unary("acosh", "transform", jnp.arccosh)
+_unary("atanh", "transform", jnp.arctanh)
+_unary("erf", "transform", jax.scipy.special.erf)
+_unary("erfc", "transform", jax.scipy.special.erfc)
+_binary("atan2", "transform", jnp.arctan2)
+
+
+@op("clip_by_value", "transform")
+def _clip(ins, attrs):
+    return jnp.clip(ins[0], attrs["clip_value_min"],
+                    attrs["clip_value_max"])
+
+
+@op("clip_by_norm", "transform")
+def _clip_norm(ins, attrs):
+    n = jnp.linalg.norm(ins[0])
+    c = attrs["clip_norm"]
+    return jnp.where(n > c, ins[0] * (c / n), ins[0])
+
+
+@op("cast", "transform")
+def _cast(ins, attrs):
+    return ins[0].astype(jnp.dtype(attrs["dtype"]))
+
+
+# -- activations ------------------------------------------------------------
+_unary("relu", "activation", jax.nn.relu)
+_unary("relu6", "activation", jax.nn.relu6)
+_unary("sigmoid", "activation", jax.nn.sigmoid)
+_unary("softplus", "activation", jax.nn.softplus)
+_unary("softsign", "activation", jax.nn.soft_sign)
+_unary("elu", "activation", jax.nn.elu)
+_unary("selu", "activation", jax.nn.selu)
+_unary("gelu", "activation", partial(jax.nn.gelu, approximate=False))
+_unary("gelu_tanh", "activation", partial(jax.nn.gelu, approximate=True))
+_unary("swish", "activation", jax.nn.silu)
+_unary("mish", "activation", jax.nn.mish)
+_unary("hard_sigmoid", "activation", jax.nn.hard_sigmoid)
+_unary("hard_tanh", "activation", lambda x: jnp.clip(x, -1.0, 1.0))
+
+
+@op("leaky_relu", "activation")
+def _leaky(ins, attrs):
+    return jax.nn.leaky_relu(ins[0], attrs.get("alpha", 0.01))
+
+
+@op("softmax", "activation")
+def _softmax(ins, attrs):
+    return jax.nn.softmax(ins[0], axis=attrs.get("axis", -1))
+
+
+@op("log_softmax", "activation")
+def _log_softmax(ins, attrs):
+    return jax.nn.log_softmax(ins[0], axis=attrs.get("axis", -1))
+
+
+@op("prelu", "activation")
+def _prelu(ins, attrs):
+    x, a = ins
+    return jnp.where(x >= 0, x, a * x)
+
+
+# -- blas / linalg ----------------------------------------------------------
+@op("matmul", "blas")
+def _matmul(ins, attrs):
+    a, b = ins
+    if attrs.get("transpose_a"):
+        a = jnp.swapaxes(a, -1, -2)
+    if attrs.get("transpose_b"):
+        b = jnp.swapaxes(b, -1, -2)
+    return a @ b
+
+
+alias("mmul", "matmul")
+alias("batch_matmul", "matmul")
+_binary("tensordot_last", "blas", lambda a, b: jnp.tensordot(a, b, 1))
+_binary("outer", "blas", jnp.outer)
+_binary("dot", "blas", jnp.dot)
+
+
+@op("lu", "linalg")
+def _lu(ins, attrs):
+    return jax.scipy.linalg.lu(ins[0])
+
+
+@op("qr", "linalg")
+def _qr(ins, attrs):
+    return jnp.linalg.qr(ins[0])
+
+
+@op("cholesky", "linalg")
+def _chol(ins, attrs):
+    return jnp.linalg.cholesky(ins[0])
+
+
+@op("svd", "linalg")
+def _svd(ins, attrs):
+    return jnp.linalg.svd(ins[0],
+                          full_matrices=attrs.get("full_matrices", False))
+
+
+@op("matrix_inverse", "linalg")
+def _inv(ins, attrs):
+    return jnp.linalg.inv(ins[0])
+
+
+@op("matrix_determinant", "linalg")
+def _det(ins, attrs):
+    return jnp.linalg.det(ins[0])
+
+
+@op("triangular_solve", "linalg")
+def _trisolve(ins, attrs):
+    return jax.scipy.linalg.solve_triangular(
+        ins[0], ins[1], lower=attrs.get("lower", True))
+
+
+@op("solve", "linalg")
+def _solve(ins, attrs):
+    return jnp.linalg.solve(ins[0], ins[1])
+
+
+@op("trace", "linalg")
+def _trace(ins, attrs):
+    return jnp.trace(ins[0], axis1=-2, axis2=-1)
+
+
+@op("diag", "linalg")
+def _diag(ins, attrs):
+    return jnp.diag(ins[0])
+
+
+@op("diag_part", "linalg")
+def _diag_part(ins, attrs):
+    return jnp.diagonal(ins[0], axis1=-2, axis2=-1)
+
+
+@op("eye", "linalg")
+def _eye(ins, attrs):
+    return jnp.eye(attrs["rows"], attrs.get("cols"),
+                   dtype=attrs.get("dtype", jnp.float32))
+
+
+# -- reductions -------------------------------------------------------------
+_reduce("reduce_sum", jnp.sum)
+_reduce("reduce_mean", jnp.mean)
+_reduce("reduce_max", jnp.max)
+_reduce("reduce_min", jnp.min)
+_reduce("reduce_prod", jnp.prod)
+_reduce("reduce_std", jnp.std)
+_reduce("reduce_var", jnp.var)
+alias("sum", "reduce_sum")
+alias("mean", "reduce_mean")
+alias("amax", "reduce_max")
+alias("amin", "reduce_min")
+
+
+@op("reduce_norm1", "reduce")
+def _norm1(ins, attrs):
+    axis = attrs.get("axis")
+    axis = tuple(axis) if isinstance(axis, (list, tuple)) else axis
+    return jnp.sum(jnp.abs(ins[0]), axis=axis,
+                   keepdims=bool(attrs.get("keep_dims", False)))
+
+
+@op("reduce_norm2", "reduce")
+def _norm2(ins, attrs):
+    axis = attrs.get("axis")
+    axis = tuple(axis) if isinstance(axis, (list, tuple)) else axis
+    return jnp.sqrt(jnp.sum(ins[0] ** 2, axis=axis,
+                            keepdims=bool(attrs.get("keep_dims", False))))
+
+
+@op("reduce_logsumexp", "reduce")
+def _lse(ins, attrs):
+    return jax.scipy.special.logsumexp(ins[0], axis=attrs.get("axis"))
+
+
+@op("cumsum", "reduce")
+def _cumsum(ins, attrs):
+    return jnp.cumsum(ins[0], axis=attrs.get("axis", -1))
+
+
+@op("cumprod", "reduce")
+def _cumprod(ins, attrs):
+    return jnp.cumprod(ins[0], axis=attrs.get("axis", -1))
+
+
+@op("reduce_any", "reduce")
+def _any(ins, attrs):
+    return jnp.any(ins[0], axis=attrs.get("axis"))
+
+
+@op("reduce_all", "reduce")
+def _all(ins, attrs):
+    return jnp.all(ins[0], axis=attrs.get("axis"))
+
+
+# -- indexed reductions -----------------------------------------------------
+@op("argmax", "indexreduce")
+def _argmax(ins, attrs):
+    return jnp.argmax(ins[0], axis=attrs.get("axis", -1))
+
+
+@op("argmin", "indexreduce")
+def _argmin(ins, attrs):
+    return jnp.argmin(ins[0], axis=attrs.get("axis", -1))
+
+
+@op("top_k", "indexreduce")
+def _topk(ins, attrs):
+    return lax.top_k(ins[0], attrs["k"])
+
+
+@op("in_top_k", "indexreduce")
+def _in_topk(ins, attrs):
+    preds, targets = ins
+    _, idx = lax.top_k(preds, attrs["k"])
+    return jnp.any(idx == targets[:, None], axis=-1)
+
+
+# -- boolean / comparison ---------------------------------------------------
+_binary("eq", "boolean", jnp.equal)
+_binary("neq", "boolean", jnp.not_equal)
+_binary("gt", "boolean", jnp.greater)
+_binary("gte", "boolean", jnp.greater_equal)
+_binary("lt", "boolean", jnp.less)
+_binary("lte", "boolean", jnp.less_equal)
+_binary("logical_and", "boolean", jnp.logical_and)
+_binary("logical_or", "boolean", jnp.logical_or)
+_binary("logical_xor", "boolean", jnp.logical_xor)
+_unary("logical_not", "boolean", jnp.logical_not)
+_unary("is_nan", "boolean", jnp.isnan)
+_unary("is_inf", "boolean", jnp.isinf)
+_unary("is_finite", "boolean", jnp.isfinite)
+
+
+@op("where", "boolean")
+def _where(ins, attrs):
+    return jnp.where(ins[0], ins[1], ins[2])
+
+
+alias("select", "where")
+
+# -- bitwise ----------------------------------------------------------------
+_binary("bitwise_and", "bitwise", jnp.bitwise_and)
+_binary("bitwise_or", "bitwise", jnp.bitwise_or)
+_binary("bitwise_xor", "bitwise", jnp.bitwise_xor)
+_binary("left_shift", "bitwise", jnp.left_shift)
+_binary("right_shift", "bitwise", jnp.right_shift)
+_unary("bitwise_not", "bitwise", jnp.invert)
+
+
+# -- shape ops --------------------------------------------------------------
+@op("reshape", "shape")
+def _reshape(ins, attrs):
+    return jnp.reshape(ins[0], attrs["shape"])
+
+
+@op("permute", "shape")
+def _permute(ins, attrs):
+    return jnp.transpose(ins[0], attrs["axes"])
+
+
+alias("transpose", "permute")
+
+
+@op("expand_dims", "shape")
+def _expand(ins, attrs):
+    return jnp.expand_dims(ins[0], attrs["axis"])
+
+
+@op("squeeze", "shape")
+def _squeeze(ins, attrs):
+    return jnp.squeeze(ins[0], attrs.get("axis"))
+
+
+@op("concat", "shape")
+def _concat(ins, attrs):
+    return jnp.concatenate(ins, axis=attrs.get("axis", 0))
+
+
+@op("stack", "shape")
+def _stack(ins, attrs):
+    return jnp.stack(ins, axis=attrs.get("axis", 0))
+
+
+@op("unstack", "shape")
+def _unstack(ins, attrs):
+    axis = attrs.get("axis", 0)
+    n = ins[0].shape[axis]
+    return tuple(jnp.squeeze(s, axis) for s in
+                 jnp.split(ins[0], n, axis=axis))
+
+
+@op("split", "shape")
+def _split(ins, attrs):
+    return tuple(jnp.split(ins[0], attrs["num_splits"],
+                           axis=attrs.get("axis", 0)))
+
+
+@op("split_v", "shape")
+def _split_v(ins, attrs):
+    sizes = attrs["size_splits"]
+    idx = list(jnp.cumsum(jnp.asarray(sizes))[:-1])
+    return tuple(jnp.split(ins[0], idx, axis=attrs.get("axis", 0)))
+
+
+@op("tile", "shape")
+def _tile(ins, attrs):
+    return jnp.tile(ins[0], attrs["reps"])
+
+
+@op("repeat", "shape")
+def _repeat(ins, attrs):
+    return jnp.repeat(ins[0], attrs["repeats"], axis=attrs.get("axis"))
+
+
+@op("flip", "shape")
+def _flip(ins, attrs):
+    return jnp.flip(ins[0], axis=attrs.get("axis"))
+
+
+@op("gather", "shape")
+def _gather(ins, attrs):
+    return jnp.take(ins[0], ins[1].astype(jnp.int32),
+                    axis=attrs.get("axis", 0))
+
+
+@op("gather_nd", "shape")
+def _gather_nd(ins, attrs):
+    params, indices = ins
+    idx = tuple(jnp.moveaxis(indices.astype(jnp.int32), -1, 0))
+    return params[idx]
+
+
+@op("scatter_update", "shape")
+def _scatter_upd(ins, attrs):
+    ref, indices, updates = ins
+    return ref.at[indices.astype(jnp.int32)].set(updates)
+
+
+@op("scatter_add", "shape")
+def _scatter_add(ins, attrs):
+    ref, indices, updates = ins
+    return ref.at[indices.astype(jnp.int32)].add(updates)
+
+
+@op("pad", "shape")
+def _pad(ins, attrs):
+    mode = attrs.get("mode", "constant").lower()
+    pads = [tuple(p) for p in attrs["paddings"]]
+    if mode == "constant":
+        return jnp.pad(ins[0], pads,
+                       constant_values=attrs.get("constant", 0.0))
+    return jnp.pad(ins[0], pads, mode=mode)
+
+
+@op("slice", "shape")
+def _slice(ins, attrs):
+    begin = attrs["begin"]
+    size = attrs["size"]
+    end = [b + s if s >= 0 else ins[0].shape[i]
+           for i, (b, s) in enumerate(zip(begin, size))]
+    return ins[0][tuple(slice(b, e) for b, e in zip(begin, end))]
+
+
+@op("strided_slice", "shape")
+def _strided_slice(ins, attrs):
+    sl = tuple(slice(b, e, s) for b, e, s in
+               zip(attrs["begin"], attrs["end"], attrs["strides"]))
+    return ins[0][sl]
+
+
+@op("shape_of", "shape")
+def _shape_of(ins, attrs):
+    return jnp.asarray(ins[0].shape, dtype=jnp.int32)
+
+
+@op("size", "shape")
+def _size(ins, attrs):
+    return jnp.asarray(ins[0].size, dtype=jnp.int32)
+
+
+@op("rank", "shape")
+def _rank(ins, attrs):
+    return jnp.asarray(ins[0].ndim, dtype=jnp.int32)
+
+
+@op("one_hot", "shape")
+def _one_hot(ins, attrs):
+    return jax.nn.one_hot(ins[0].astype(jnp.int32), attrs["depth"],
+                          axis=attrs.get("axis", -1))
+
+
+@op("reverse_sequence", "shape")
+def _reverse_seq(ins, attrs):
+    x, lengths = ins
+    sa = attrs.get("seq_axis", 1)
+    ba = attrs.get("batch_axis", 0)
+    xm = jnp.moveaxis(x, (ba, sa), (0, 1))     # -> [b, t, ...]
+    t = xm.shape[1]
+    idx = jnp.arange(t)
+    rev = jnp.where(idx[None, :] < lengths[:, None],
+                    lengths[:, None] - 1 - idx[None, :], idx[None, :])
+    out = jnp.take_along_axis(
+        xm, rev[(...,) + (None,) * (xm.ndim - 2)], axis=1)
+    return jnp.moveaxis(out, (0, 1), (ba, sa))
+
+
+@op("broadcast_to", "shape")
+def _broadcast_to(ins, attrs):
+    return jnp.broadcast_to(ins[0], attrs["shape"])
+
+
+@op("zeros_like", "shape")
+def _zeros_like(ins, attrs):
+    return jnp.zeros_like(ins[0])
+
+
+@op("ones_like", "shape")
+def _ones_like(ins, attrs):
+    return jnp.ones_like(ins[0])
+
+
+@op("fill", "shape")
+def _fill(ins, attrs):
+    return jnp.full(attrs["shape"], attrs["value"],
+                    dtype=attrs.get("dtype", jnp.float32))
+
+
+@op("range", "shape")
+def _range(ins, attrs):
+    return jnp.arange(attrs["start"], attrs["limit"],
+                      attrs.get("delta", 1))
+
+
+@op("linspace", "shape")
+def _linspace(ins, attrs):
+    return jnp.linspace(attrs["start"], attrs["stop"], attrs["num"])
+
+
+# -- segment ops ------------------------------------------------------------
+@op("segment_sum", "segment")
+def _segment_sum(ins, attrs):
+    return jax.ops.segment_sum(ins[0], ins[1].astype(jnp.int32),
+                               num_segments=attrs.get("num_segments"))
+
+
+@op("segment_max", "segment")
+def _segment_max(ins, attrs):
+    return jax.ops.segment_max(ins[0], ins[1].astype(jnp.int32),
+                               num_segments=attrs.get("num_segments"))
+
+
+@op("segment_min", "segment")
+def _segment_min(ins, attrs):
+    return jax.ops.segment_min(ins[0], ins[1].astype(jnp.int32),
+                               num_segments=attrs.get("num_segments"))
+
+
+@op("segment_mean", "segment")
+def _segment_mean(ins, attrs):
+    seg = ins[1].astype(jnp.int32)
+    n = attrs.get("num_segments")
+    s = jax.ops.segment_sum(ins[0], seg, num_segments=n)
+    c = jax.ops.segment_sum(jnp.ones_like(ins[0]), seg, num_segments=n)
+    return s / jnp.maximum(c, 1)
+
+
+# -- normalization ----------------------------------------------------------
+@op("layer_norm", "normalization")
+def _layer_norm(ins, attrs):
+    x = ins[0]
+    gain = ins[1] if len(ins) > 1 else None
+    bias = ins[2] if len(ins) > 2 else None
+    axis = attrs.get("axis", -1)
+    eps = attrs.get("epsilon", 1e-5)
+    mu = jnp.mean(x, axis=axis, keepdims=True)
+    var = jnp.var(x, axis=axis, keepdims=True)
+    y = (x - mu) * lax.rsqrt(var + eps)
+    if gain is not None:
+        y = y * gain
+    if bias is not None:
+        y = y + bias
+    return y
+
+
+@op("batch_norm", "normalization")
+def _batch_norm(ins, attrs):
+    x, mean, var, gamma, beta = ins
+    eps = attrs.get("epsilon", 1e-5)
+    return (x - mean) * lax.rsqrt(var + eps) * gamma + beta
+
+
+@op("lrn", "normalization")
+def _lrn(ins, attrs):
+    # local response normalization over the channel (last) axis, NHWC
+    x = ins[0]
+    depth = attrs.get("depth", 5)
+    bias = attrs.get("bias", 1.0)
+    alpha = attrs.get("alpha", 1.0)
+    beta = attrs.get("beta", 0.5)
+    sq = x * x
+    half = depth // 2
+    pads = [(0, 0)] * (x.ndim - 1) + [(half, half)]
+    padded = jnp.pad(sq, pads)
+    win = sum(lax.slice_in_dim(padded, i, i + x.shape[-1], axis=-1)
+              for i in range(depth))
+    return x / jnp.power(bias + alpha * win, beta)
+
+
+@op("standardize", "normalization")
+def _standardize(ins, attrs):
+    x = ins[0]
+    axis = attrs.get("axis", -1)
+    mu = jnp.mean(x, axis=axis, keepdims=True)
+    sd = jnp.std(x, axis=axis, keepdims=True)
+    return (x - mu) / jnp.maximum(sd, 1e-12)
+
+
+@op("moments", "normalization")
+def _moments(ins, attrs):
+    axis = attrs.get("axis")
+    axis = tuple(axis) if isinstance(axis, (list, tuple)) else axis
+    return (jnp.mean(ins[0], axis=axis), jnp.var(ins[0], axis=axis))
+
+
+# -- convolution (NHWC, MXU-friendly) ---------------------------------------
+def _conv_dn(ndim):
+    if ndim == 3:
+        return ("NWC", "WIO", "NWC")
+    if ndim == 4:
+        return ("NHWC", "HWIO", "NHWC")
+    return ("NDHWC", "DHWIO", "NDHWC")
+
+
+@op("conv2d", "convolution")
+def _conv2d(ins, attrs):
+    x, w = ins[0], ins[1]
+    out = lax.conv_general_dilated(
+        x, w, window_strides=tuple(attrs.get("stride", (1, 1))),
+        padding=attrs.get("padding", "SAME"),
+        rhs_dilation=tuple(attrs.get("dilation", (1, 1))),
+        dimension_numbers=_conv_dn(4))
+    if len(ins) > 2:
+        out = out + ins[2]
+    return out
+
+
+@op("conv1d", "convolution")
+def _conv1d(ins, attrs):
+    x, w = ins[0], ins[1]
+    out = lax.conv_general_dilated(
+        x, w, window_strides=(attrs.get("stride", 1),),
+        padding=attrs.get("padding", "SAME"),
+        rhs_dilation=(attrs.get("dilation", 1),),
+        dimension_numbers=_conv_dn(3))
+    if len(ins) > 2:
+        out = out + ins[2]
+    return out
+
+
+@op("conv3d", "convolution")
+def _conv3d(ins, attrs):
+    x, w = ins[0], ins[1]
+    out = lax.conv_general_dilated(
+        x, w, window_strides=tuple(attrs.get("stride", (1, 1, 1))),
+        padding=attrs.get("padding", "SAME"),
+        dimension_numbers=_conv_dn(5))
+    if len(ins) > 2:
+        out = out + ins[2]
+    return out
+
+
+@op("depthwise_conv2d", "convolution")
+def _depthwise(ins, attrs):
+    x, w = ins[0], ins[1]      # w: [H, W, C, M]
+    c = x.shape[-1]
+    kh, kw, _, m = w.shape
+    out = lax.conv_general_dilated(
+        x, jnp.reshape(w, (kh, kw, 1, c * m)),
+        window_strides=tuple(attrs.get("stride", (1, 1))),
+        padding=attrs.get("padding", "SAME"),
+        feature_group_count=c, dimension_numbers=_conv_dn(4))
+    if len(ins) > 2:
+        out = out + ins[2]
+    return out
+
+
+@op("separable_conv2d", "convolution")
+def _separable(ins, attrs):
+    x, dw, pw = ins[0], ins[1], ins[2]
+    y = _depthwise([x, dw], attrs)
+    out = lax.conv_general_dilated(
+        y, pw, window_strides=(1, 1), padding="VALID",
+        dimension_numbers=_conv_dn(4))
+    if len(ins) > 3:
+        out = out + ins[3]
+    return out
+
+
+@op("deconv2d", "convolution")
+def _deconv2d(ins, attrs):
+    x, w = ins[0], ins[1]
+    out = lax.conv_transpose(
+        x, w, strides=tuple(attrs.get("stride", (1, 1))),
+        padding=attrs.get("padding", "SAME"),
+        dimension_numbers=_conv_dn(4))
+    if len(ins) > 2:
+        out = out + ins[2]
+    return out
+
+
+def _pool(x, kind, window, strides, padding):
+    ndim_sp = len(window)
+    dims = (1,) + tuple(window) + (1,)
+    strd = (1,) + tuple(strides) + (1,)
+    if kind == "max":
+        return lax.reduce_window(x, -jnp.inf, lax.max, dims, strd, padding)
+    s = lax.reduce_window(x, 0.0, lax.add, dims, strd, padding)
+    if kind == "sum":
+        return s
+    ones = jnp.ones_like(x)
+    cnt = lax.reduce_window(ones, 0.0, lax.add, dims, strd, padding)
+    return s / cnt
+
+
+@op("max_pool2d", "convolution")
+def _maxpool(ins, attrs):
+    return _pool(ins[0], "max", attrs.get("kernel", (2, 2)),
+                 attrs.get("stride", (2, 2)),
+                 attrs.get("padding", "VALID"))
+
+
+@op("avg_pool2d", "convolution")
+def _avgpool(ins, attrs):
+    return _pool(ins[0], "avg", attrs.get("kernel", (2, 2)),
+                 attrs.get("stride", (2, 2)),
+                 attrs.get("padding", "VALID"))
+
+
+@op("max_pool1d", "convolution")
+def _maxpool1(ins, attrs):
+    return _pool(ins[0], "max", (attrs.get("kernel", 2),),
+                 (attrs.get("stride", 2),), attrs.get("padding", "VALID"))
+
+
+@op("avg_pool1d", "convolution")
+def _avgpool1(ins, attrs):
+    return _pool(ins[0], "avg", (attrs.get("kernel", 2),),
+                 (attrs.get("stride", 2),), attrs.get("padding", "VALID"))
+
+
+@op("max_pool3d", "convolution")
+def _maxpool3(ins, attrs):
+    return _pool(ins[0], "max", attrs.get("kernel", (2, 2, 2)),
+                 attrs.get("stride", (2, 2, 2)),
+                 attrs.get("padding", "VALID"))
+
+
+@op("avg_pool3d", "convolution")
+def _avgpool3(ins, attrs):
+    return _pool(ins[0], "avg", attrs.get("kernel", (2, 2, 2)),
+                 attrs.get("stride", (2, 2, 2)),
+                 attrs.get("padding", "VALID"))
+
+
+@op("upsampling2d", "convolution")
+def _upsample(ins, attrs):
+    s = attrs.get("scale", 2)
+    sh, sw = (s, s) if isinstance(s, int) else s
+    return jnp.repeat(jnp.repeat(ins[0], sh, axis=1), sw, axis=2)
+
+
+@op("im2col", "convolution")
+def _im2col(ins, attrs):
+    # patches as columns (reference helper op); NHWC
+    x = ins[0]
+    kh, kw = attrs["kernel"]
+    sh, sw = attrs.get("stride", (1, 1))
+    b, h, w, c = x.shape
+    oh = (h - kh) // sh + 1
+    ow = (w - kw) // sw + 1
+    idx_h = jnp.arange(oh) * sh
+    idx_w = jnp.arange(ow) * sw
+    patches = x[:, idx_h[:, None, None, None] + jnp.arange(kh)[None, :,
+                                                             None, None],
+                idx_w[None, None, :, None] + jnp.arange(kw)[None, None,
+                                                            None, :], :]
+    return patches.reshape(b, oh, ow, kh * kw * c)
+
+
+# -- image ------------------------------------------------------------------
+@op("resize_bilinear", "image")
+def _resize_bilinear(ins, attrs):
+    x = ins[0]
+    h, w = attrs["size"]
+    return jax.image.resize(x, (x.shape[0], h, w, x.shape[3]), "bilinear")
+
+
+@op("resize_nearest", "image")
+def _resize_nearest(ins, attrs):
+    x = ins[0]
+    h, w = attrs["size"]
+    return jax.image.resize(x, (x.shape[0], h, w, x.shape[3]), "nearest")
+
+
+@op("crop_and_resize", "image")
+def _crop_resize(ins, attrs):
+    img, boxes, box_idx = ins
+    ch, cw = attrs["crop_size"]
+
+    def one(box, bi):
+        y1, x1, y2, x2 = box
+        im = img[bi.astype(jnp.int32)]
+        h, w = im.shape[0], im.shape[1]
+        ys = y1 * (h - 1) + jnp.arange(ch) / max(ch - 1, 1) * \
+            (y2 - y1) * (h - 1)
+        xs = x1 * (w - 1) + jnp.arange(cw) / max(cw - 1, 1) * \
+            (x2 - x1) * (w - 1)
+        y0 = jnp.clip(jnp.floor(ys).astype(jnp.int32), 0, h - 1)
+        x0 = jnp.clip(jnp.floor(xs).astype(jnp.int32), 0, w - 1)
+        y1i = jnp.clip(y0 + 1, 0, h - 1)
+        x1i = jnp.clip(x0 + 1, 0, w - 1)
+        wy = (ys - y0)[:, None, None]
+        wx = (xs - x0)[None, :, None]
+        return (im[y0][:, x0] * (1 - wy) * (1 - wx) +
+                im[y0][:, x1i] * (1 - wy) * wx +
+                im[y1i][:, x0] * wy * (1 - wx) +
+                im[y1i][:, x1i] * wy * wx)
+
+    return jax.vmap(one)(boxes, box_idx)
+
+
+@op("extract_image_patches", "image")
+def _extract_patches(ins, attrs):
+    return _im2col(ins, attrs)
+
+
+@op("non_max_suppression", "image")
+def _nms(ins, attrs):
+    boxes, scores = ins
+    max_out = attrs["max_output_size"]
+    iou_thr = attrs.get("iou_threshold", 0.5)
+
+    def iou(a, b):
+        y1 = jnp.maximum(a[0], b[:, 0])
+        x1 = jnp.maximum(a[1], b[:, 1])
+        y2 = jnp.minimum(a[2], b[:, 2])
+        x2 = jnp.minimum(a[3], b[:, 3])
+        inter = jnp.clip(y2 - y1, 0) * jnp.clip(x2 - x1, 0)
+        area_a = (a[2] - a[0]) * (a[3] - a[1])
+        area_b = (b[:, 2] - b[:, 0]) * (b[:, 3] - b[:, 1])
+        return inter / jnp.maximum(area_a + area_b - inter, 1e-9)
+
+    # static greedy loop (max_out is a static attr)
+    sc = scores
+    picks = []
+    for _ in range(max_out):
+        i = jnp.argmax(sc)
+        picks.append(i)
+        suppress = iou(boxes[i], boxes) > iou_thr
+        sc = jnp.where(suppress, -jnp.inf, sc)
+        sc = sc.at[i].set(-jnp.inf)
+    return jnp.stack(picks)
+
+
+# -- random -----------------------------------------------------------------
+def _rng_from_attrs(attrs):
+    return jax.random.PRNGKey(attrs.get("seed", 0))
+
+
+@op("random_normal", "random")
+def _rand_normal(ins, attrs):
+    return attrs.get("mean", 0.0) + attrs.get("stddev", 1.0) * \
+        jax.random.normal(attrs["rng"], tuple(attrs["shape"]))
+
+
+@op("random_uniform", "random")
+def _rand_uniform(ins, attrs):
+    return jax.random.uniform(attrs["rng"], tuple(attrs["shape"]),
+                              minval=attrs.get("min", 0.0),
+                              maxval=attrs.get("max", 1.0))
+
+
+@op("random_bernoulli", "random")
+def _rand_bern(ins, attrs):
+    return jax.random.bernoulli(attrs["rng"], attrs.get("prob", 0.5),
+                                tuple(attrs["shape"])).astype(jnp.float32)
+
+
+@op("dropout", "random")
+def _dropout(ins, attrs):
+    x = ins[0]
+    p = attrs.get("rate", 0.5)            # drop probability
+    rng = attrs.get("rng")
+    if rng is None or not attrs.get("training", True):
+        return x
+    keep = jax.random.bernoulli(rng, 1.0 - p, x.shape)
+    return jnp.where(keep, x / (1.0 - p), 0.0)
+
+
+# -- losses -----------------------------------------------------------------
+def _apply_weights_reduce(loss, weights, reduction):
+    if weights is not None:
+        loss = loss * weights
+    if reduction == "none":
+        return loss
+    if reduction == "sum":
+        return jnp.sum(loss)
+    return jnp.mean(loss)
+
+
+@op("softmax_cross_entropy", "loss")
+def _sce(ins, attrs):
+    labels, logits = ins[0], ins[1]
+    ls = attrs.get("label_smoothing", 0.0)
+    if ls:
+        n = labels.shape[-1]
+        labels = labels * (1 - ls) + ls / n
+    loss = -jnp.sum(labels * jax.nn.log_softmax(logits, -1), axis=-1)
+    return _apply_weights_reduce(loss, ins[2] if len(ins) > 2 else None,
+                                 attrs.get("reduction", "mean"))
+
+
+@op("sparse_softmax_cross_entropy", "loss")
+def _ssce(ins, attrs):
+    labels, logits = ins[0], ins[1]
+    lp = jax.nn.log_softmax(logits, -1)
+    loss = -jnp.take_along_axis(
+        lp, labels.astype(jnp.int32)[..., None], axis=-1)[..., 0]
+    return _apply_weights_reduce(loss, None,
+                                 attrs.get("reduction", "mean"))
+
+
+@op("sigmoid_cross_entropy", "loss")
+def _bce(ins, attrs):
+    labels, logits = ins[0], ins[1]
+    loss = jnp.maximum(logits, 0) - logits * labels + \
+        jnp.log1p(jnp.exp(-jnp.abs(logits)))
+    return _apply_weights_reduce(loss, ins[2] if len(ins) > 2 else None,
+                                 attrs.get("reduction", "mean"))
+
+
+@op("mean_squared_error", "loss")
+def _mse_loss(ins, attrs):
+    loss = (ins[0] - ins[1]) ** 2
+    return _apply_weights_reduce(loss, ins[2] if len(ins) > 2 else None,
+                                 attrs.get("reduction", "mean"))
+
+
+@op("absolute_difference", "loss")
+def _mae_loss(ins, attrs):
+    loss = jnp.abs(ins[0] - ins[1])
+    return _apply_weights_reduce(loss, ins[2] if len(ins) > 2 else None,
+                                 attrs.get("reduction", "mean"))
+
+
+@op("huber_loss", "loss")
+def _huber(ins, attrs):
+    d = attrs.get("delta", 1.0)
+    err = ins[0] - ins[1]
+    loss = jnp.where(jnp.abs(err) <= d, 0.5 * err ** 2,
+                     d * (jnp.abs(err) - 0.5 * d))
+    return _apply_weights_reduce(loss, ins[2] if len(ins) > 2 else None,
+                                 attrs.get("reduction", "mean"))
+
+
+@op("log_loss", "loss")
+def _log_loss(ins, attrs):
+    labels, preds = ins[0], ins[1]
+    eps = attrs.get("epsilon", 1e-7)
+    loss = -(labels * jnp.log(preds + eps) +
+             (1 - labels) * jnp.log(1 - preds + eps))
+    return _apply_weights_reduce(loss, ins[2] if len(ins) > 2 else None,
+                                 attrs.get("reduction", "mean"))
+
+
+@op("cosine_distance", "loss")
+def _cos_loss(ins, attrs):
+    a, b = ins[0], ins[1]
+    axis = attrs.get("axis", -1)
+    loss = 1.0 - jnp.sum(a * b, axis=axis)
+    return _apply_weights_reduce(loss, None,
+                                 attrs.get("reduction", "mean"))
+
+
+@op("hinge_loss", "loss")
+def _hinge(ins, attrs):
+    labels, logits = ins[0], ins[1]
+    signed = 2.0 * labels - 1.0
+    loss = jnp.maximum(0.0, 1.0 - signed * logits)
+    return _apply_weights_reduce(loss, None,
+                                 attrs.get("reduction", "mean"))
+
+
+# -- attention (Appendix A: attention domain) -------------------------------
+@op("dot_product_attention", "attention")
+def _dpa(ins, attrs):
+    q, k, v = ins[0], ins[1], ins[2]
+    mask = ins[3] if len(ins) > 3 else None
+    scale = attrs.get("scale", 1.0 / (q.shape[-1] ** 0.5))
+    scores = jnp.einsum("...qd,...kd->...qk", q, k) * scale
+    if mask is not None:
+        scores = jnp.where(mask > 0, scores, -1e9)
+    w = jax.nn.softmax(scores, axis=-1)
+    return jnp.einsum("...qk,...kd->...qd", w, v)
+
+
+@op("multi_head_dot_product_attention", "attention")
+def _mhdpa(ins, attrs):
+    # x: [b, t, d]; Wq/Wk/Wv: [d, h*dh]; Wo: [h*dh, d]
+    x, wq, wk, wv, wo = ins[0], ins[1], ins[2], ins[3], ins[4]
+    mask = ins[5] if len(ins) > 5 else None
+    h = attrs["num_heads"]
+    b, t, d = x.shape
+
+    def split(a):
+        return a.reshape(b, t, h, -1).transpose(0, 2, 1, 3)
+
+    q, k, v = split(x @ wq), split(x @ wk), split(x @ wv)
+    m = None
+    if mask is not None:
+        m = mask[:, None, None, :]      # [b, 1, 1, t]
+    o = _dpa([q, k, v] + ([m] if m is not None else []), attrs)
+    o = o.transpose(0, 2, 1, 3).reshape(b, t, -1)
+    return o @ wo
+
+
+# -- recurrent (cell-level ops; layer-level lives in nn.conf) ----------------
+@op("lstm_cell", "recurrent")
+def _lstm_cell(ins, attrs):
+    x, h_prev, c_prev, w, rw, b = ins
+    H = h_prev.shape[-1]
+    z = x @ w + h_prev @ rw + b
+    i = jax.nn.sigmoid(z[:, :H])
+    f = jax.nn.sigmoid(z[:, H:2 * H])
+    o = jax.nn.sigmoid(z[:, 2 * H:3 * H])
+    g = jnp.tanh(z[:, 3 * H:])
+    c = f * c_prev + i * g
+    h = o * jnp.tanh(c)
+    return h, c
+
+
+@op("gru_cell", "recurrent")
+def _gru_cell(ins, attrs):
+    x, h_prev, w, rw, b = ins
+    H = h_prev.shape[-1]
+    xw = x @ w + b
+    hr = h_prev @ rw
+    r = jax.nn.sigmoid(xw[:, :H] + hr[:, :H])
+    zt = jax.nn.sigmoid(xw[:, H:2 * H] + hr[:, H:2 * H])
+    n = jnp.tanh(xw[:, 2 * H:] + r * hr[:, 2 * H:])
+    return (1 - zt) * n + zt * h_prev
+
+
+@op("sru_cell", "recurrent")
+def _sru_cell(ins, attrs):
+    x, c_prev, w, b = ins
+    H = c_prev.shape[-1]
+    z = x @ w + b
+    f = jax.nn.sigmoid(z[:, H:2 * H])
+    r = jax.nn.sigmoid(z[:, 2 * H:3 * H])
+    c = f * c_prev + (1 - f) * z[:, :H]
+    return r * jnp.tanh(c) + (1 - r) * x[:, :H], c
+
+
+# -- compression (threshold encoding, SURVEY.md J11/P2) ---------------------
+@op("encode_threshold", "compression")
+def _encode_thr(ins, attrs):
+    from deeplearning4j_tpu.parallel.encoding import encode_threshold
+    return encode_threshold(ins[0], attrs.get("threshold", 1e-3))
+
+
+@op("decode_threshold", "compression")
+def _decode_thr(ins, attrs):
+    return ins[0]

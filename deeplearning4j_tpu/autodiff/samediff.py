@@ -1,0 +1,682 @@
+"""SameDiff-equivalent graph builder + executor.
+
+Reference parity: ``org.nd4j.autodiff.samediff.SameDiff`` / ``SDVariable``
+(SURVEY.md S1), autodiff (S2), sessions (S3), fit (S4), save/load (S5).
+Call-stack parity: `SameDiff.output()` / `.fit()` (SURVEY.md §3.3).
+
+TPU-first: the op DAG is evaluated by ONE traced-and-jitted function per
+(outputs, placeholder-signature) — XLA sees the whole graph and fuses
+it; `jax.value_and_grad` over that trace replaces the reference's
+reverse-topo `doDiff` backward-graph construction; sessions/dependency
+tracking/memory managers are unnecessary (XLA owns scheduling+memory).
+"""
+from __future__ import annotations
+
+import enum
+import io
+import json
+import zipfile
+from typing import Dict, List, Optional, Sequence, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from deeplearning4j_tpu.autodiff.registry import get_op
+
+# ops that consume a PRNG key at execution time; the executor folds a
+# per-op key out of the step rng (deterministic per op position)
+RNG_OPS = {"dropout", "random_normal", "random_uniform",
+           "random_bernoulli"}
+
+
+class VariableType(enum.Enum):
+    """Reference: org.nd4j.autodiff.samediff.VariableType."""
+    VARIABLE = "VARIABLE"          # trainable
+    CONSTANT = "CONSTANT"
+    PLACEHOLDER = "PLACEHOLDER"
+    ARRAY = "ARRAY"                # op output
+
+
+class SDVariable:
+    """Symbolic handle into a SameDiff graph (reference: SDVariable).
+    Operator overloads build graph nodes; `.eval()` executes."""
+
+    def __init__(self, sd: "SameDiff", name: str, var_type: VariableType,
+                 shape=None, dtype=None):
+        self.sd = sd
+        self.name = name
+        self.var_type = var_type
+        self.shape = tuple(shape) if shape is not None else None
+        self.dtype = dtype
+
+    # -- graph-building sugar ------------------------------------------
+    def _bin(self, other, op):
+        other = self.sd._as_var(other)
+        return self.sd._op(op, [self, other])
+
+    def __add__(self, o):
+        return self._bin(o, "add")
+
+    def __radd__(self, o):
+        return self.sd._as_var(o)._bin(self, "add")
+
+    def __sub__(self, o):
+        return self._bin(o, "sub")
+
+    def __rsub__(self, o):
+        return self.sd._as_var(o)._bin(self, "sub")
+
+    def __mul__(self, o):
+        return self._bin(o, "mul")
+
+    def __rmul__(self, o):
+        return self.sd._as_var(o)._bin(self, "mul")
+
+    def __truediv__(self, o):
+        return self._bin(o, "div")
+
+    def __rtruediv__(self, o):
+        return self.sd._as_var(o)._bin(self, "div")
+
+    def __pow__(self, o):
+        return self._bin(o, "pow")
+
+    def __matmul__(self, o):
+        return self._bin(o, "matmul")
+
+    def __neg__(self):
+        return self.sd._op("neg", [self])
+
+    def __gt__(self, o):
+        return self._bin(o, "gt")
+
+    def __ge__(self, o):
+        return self._bin(o, "gte")
+
+    def __lt__(self, o):
+        return self._bin(o, "lt")
+
+    def __le__(self, o):
+        return self._bin(o, "lte")
+
+    # -- named methods (reference SDVariable surface) ------------------
+    def add(self, o):
+        return self.__add__(o)
+
+    def sub(self, o):
+        return self.__sub__(o)
+
+    def mul(self, o):
+        return self.__mul__(o)
+
+    def div(self, o):
+        return self.__truediv__(o)
+
+    def rdiv(self, o):
+        return self._bin(o, "rdiv")
+
+    def mmul(self, o):
+        return self._bin(o, "matmul")
+
+    def dot(self, o):
+        return self._bin(o, "dot")
+
+    def sum(self, axis=None, keep_dims=False):
+        return self.sd._op("reduce_sum", [self],
+                           {"axis": axis, "keep_dims": keep_dims})
+
+    def mean(self, axis=None, keep_dims=False):
+        return self.sd._op("reduce_mean", [self],
+                           {"axis": axis, "keep_dims": keep_dims})
+
+    def max(self, axis=None, keep_dims=False):
+        return self.sd._op("reduce_max", [self],
+                           {"axis": axis, "keep_dims": keep_dims})
+
+    def min(self, axis=None, keep_dims=False):
+        return self.sd._op("reduce_min", [self],
+                           {"axis": axis, "keep_dims": keep_dims})
+
+    def std(self, axis=None, keep_dims=False):
+        return self.sd._op("reduce_std", [self],
+                           {"axis": axis, "keep_dims": keep_dims})
+
+    def norm2(self, axis=None):
+        return self.sd._op("reduce_norm2", [self], {"axis": axis})
+
+    def argmax(self, axis=-1):
+        return self.sd._op("argmax", [self], {"axis": axis})
+
+    def reshape(self, *shape):
+        if len(shape) == 1 and isinstance(shape[0], (list, tuple)):
+            shape = tuple(shape[0])
+        return self.sd._op("reshape", [self], {"shape": list(shape)})
+
+    def permute(self, *axes):
+        if len(axes) == 1 and isinstance(axes[0], (list, tuple)):
+            axes = tuple(axes[0])
+        return self.sd._op("permute", [self], {"axes": list(axes)})
+
+    def transpose(self):
+        return self.sd._op("permute", [self], {"axes": [1, 0]})
+
+    def cast(self, dtype):
+        return self.sd._op("cast", [self], {"dtype": str(dtype)})
+
+    def rename(self, new_name: str) -> "SDVariable":
+        self.sd._rename(self.name, new_name)
+        self.name = new_name
+        return self
+
+    # -- execution -----------------------------------------------------
+    def eval(self, placeholders: Optional[dict] = None) -> np.ndarray:
+        return self.sd.output(placeholders or {}, [self.name])[self.name]
+
+    def get_arr(self) -> Optional[np.ndarray]:
+        a = self.sd._arrays.get(self.name)
+        return np.asarray(a) if a is not None else None
+
+    def set_arr(self, value):
+        self.sd._arrays[self.name] = jnp.asarray(value)
+
+    def __repr__(self):
+        return (f"SDVariable(name='{self.name}', "
+                f"type={self.var_type.value}, shape={self.shape})")
+
+
+class OpNode:
+    __slots__ = ("op_name", "inputs", "outputs", "attrs")
+
+    def __init__(self, op_name, inputs, outputs, attrs):
+        self.op_name = op_name
+        self.inputs = inputs       # list of variable names
+        self.outputs = outputs     # list of variable names
+        self.attrs = attrs or {}
+
+
+class SameDiff:
+    """The graph. Build with var/constant/placeholder + op namespaces
+    (sd.math, sd.nn, sd.cnn, sd.rnn, sd.loss, sd.image, sd.bitwise,
+    sd.linalg, sd.random); run with output()/fit()."""
+
+    def __init__(self):
+        self.vars: Dict[str, SDVariable] = {}
+        self.ops: List[OpNode] = []
+        self._arrays: Dict[str, jnp.ndarray] = {}   # VARIABLE/CONSTANT
+        self._producer: Dict[str, int] = {}          # var name -> op idx
+        self._name_counter: Dict[str, int] = {}
+        self._exec_cache: Dict = {}
+        self._rng = jax.random.PRNGKey(0)
+        self.loss_variables: List[str] = []
+        self.training_config = None
+        self._updater_state = None
+        from deeplearning4j_tpu.autodiff.opsets import (SDBitwise, SDCNN,
+                                                        SDImage, SDLinalg,
+                                                        SDLoss, SDMath,
+                                                        SDNN, SDRandom,
+                                                        SDRNN)
+        self.math = SDMath(self)
+        self.nn = SDNN(self)
+        self.cnn = SDCNN(self)
+        self.rnn = SDRNN(self)
+        self.loss = SDLoss(self)
+        self.image = SDImage(self)
+        self.bitwise = SDBitwise(self)
+        self.linalg = SDLinalg(self)
+        self.random = SDRandom(self)
+
+    @staticmethod
+    def create() -> "SameDiff":
+        return SameDiff()
+
+    # -- naming --------------------------------------------------------
+    def _unique(self, base: str) -> str:
+        if base not in self.vars and base not in self._name_counter:
+            self._name_counter[base] = 0
+            return base
+        n = self._name_counter.get(base, 0)
+        while True:                      # skip user-taken suffixed names
+            n += 1
+            cand = f"{base}_{n}"
+            if cand not in self.vars:
+                self._name_counter[base] = n
+                return cand
+
+    def _rename(self, old: str, new: str):
+        if new in self.vars:
+            raise ValueError(f"variable '{new}' already exists")
+        v = self.vars.pop(old)
+        self.vars[new] = v
+        if old in self._arrays:
+            self._arrays[new] = self._arrays.pop(old)
+        if old in self._producer:
+            self._producer[new] = self._producer.pop(old)
+        for op_node in self.ops:
+            op_node.inputs = [new if i == old else i
+                              for i in op_node.inputs]
+            op_node.outputs = [new if o == old else o
+                               for o in op_node.outputs]
+        self.loss_variables = [new if n == old else n
+                               for n in self.loss_variables]
+        self._exec_cache.clear()
+
+    # -- variable creation (reference: sd.var/constant/placeHolder) ----
+    def var(self, name: Optional[str] = None, shape=None,
+            dtype=jnp.float32, *, init=None, array=None) -> SDVariable:
+        """Trainable variable. Provide ``array`` or (``shape`` +
+        optional weight-init ``init`` (WeightInit or callable))."""
+        name = self._unique(name or "var")
+        if array is not None:
+            arr = jnp.asarray(array)
+        else:
+            if shape is None:
+                raise ValueError("var needs shape or array")
+            self._rng, k = jax.random.split(self._rng)
+            if init is None:
+                arr = jnp.zeros(shape, dtype)
+            elif callable(getattr(init, "init", None)):
+                fan_in = shape[0] if len(shape) >= 1 else 1
+                fan_out = shape[-1] if len(shape) >= 2 else 1
+                arr = init.init(k, tuple(shape), fan_in, fan_out, dtype)
+            else:
+                arr = init(k, tuple(shape), dtype)
+        v = SDVariable(self, name, VariableType.VARIABLE, arr.shape,
+                       arr.dtype)
+        self.vars[name] = v
+        self._arrays[name] = arr
+        return v
+
+    def constant(self, name_or_array, array=None) -> SDVariable:
+        if array is None:
+            name, array = None, name_or_array
+        else:
+            name = name_or_array
+        arr = jnp.asarray(array)
+        name = self._unique(name or "const")
+        v = SDVariable(self, name, VariableType.CONSTANT, arr.shape,
+                       arr.dtype)
+        self.vars[name] = v
+        self._arrays[name] = arr
+        return v
+
+    def placeholder(self, name: str, shape=None,
+                    dtype=jnp.float32) -> SDVariable:
+        name = self._unique(name)
+        v = SDVariable(self, name, VariableType.PLACEHOLDER, shape, dtype)
+        self.vars[name] = v
+        return v
+
+    place_holder = placeholder     # reference spelling
+
+    def _as_var(self, x) -> SDVariable:
+        if isinstance(x, SDVariable):
+            return x
+        return self.constant(jnp.asarray(x))
+
+    # -- op creation ---------------------------------------------------
+    def _op(self, op_name: str, inputs: Sequence[SDVariable],
+            attrs: Optional[dict] = None, name: Optional[str] = None,
+            n_out: int = 1) -> Union[SDVariable, Tuple[SDVariable, ...]]:
+        get_op(op_name)               # validate early
+        in_names = [v.name for v in inputs]
+        if n_out == 1:
+            out_names = [self._unique(name or op_name)]
+        else:
+            base = name or op_name
+            out_names = [self._unique(f"{base}:{i}")
+                         for i in range(n_out)]
+        node = OpNode(op_name, in_names, out_names, attrs)
+        idx = len(self.ops)
+        self.ops.append(node)
+        outs = []
+        for on in out_names:
+            v = SDVariable(self, on, VariableType.ARRAY)
+            self.vars[on] = v
+            self._producer[on] = idx
+            outs.append(v)
+        self._exec_cache.clear()
+        return outs[0] if n_out == 1 else tuple(outs)
+
+    def invoke(self, op_name, inputs, attrs=None, name=None, n_out=1):
+        """Public escape hatch: call any registered op by name."""
+        return self._op(op_name, [self._as_var(i) for i in inputs],
+                        attrs, name, n_out)
+
+    # -- execution -----------------------------------------------------
+    def _ancestors(self, targets: Sequence[str]) -> List[int]:
+        """Op indices needed to compute ``targets``, in execution order."""
+        needed: set = set()
+        stack = list(targets)
+        seen_vars = set()
+        while stack:
+            vn = stack.pop()
+            if vn in seen_vars:
+                continue
+            seen_vars.add(vn)
+            if vn in self._producer:
+                idx = self._producer[vn]
+                if idx not in needed:
+                    needed.add(idx)
+                    stack.extend(self.ops[idx].inputs)
+        return sorted(needed)
+
+    def _execute(self, values: dict, op_indices: List[int], rng,
+                 training: bool):
+        for idx in op_indices:
+            node = self.ops[idx]
+            attrs = node.attrs
+            if node.op_name in RNG_OPS:
+                attrs = dict(attrs)
+                attrs["rng"] = (jax.random.fold_in(rng, idx)
+                                if rng is not None else None)
+                if node.op_name == "dropout":
+                    attrs["training"] = training
+            ins = [values[i] for i in node.inputs]
+            out = get_op(node.op_name)(ins, attrs)
+            if len(node.outputs) == 1:
+                values[node.outputs[0]] = out
+            else:
+                for on, o in zip(node.outputs, out):
+                    values[on] = o
+        return values
+
+    def _required_placeholders(self, op_indices, out_names):
+        needed = set(out_names)
+        for idx in op_indices:
+            needed.update(self.ops[idx].inputs)
+        return {n for n in needed
+                if n in self.vars and
+                self.vars[n].var_type is VariableType.PLACEHOLDER}
+
+    def _build_fn(self, out_names: Tuple[str, ...], ph_names: Tuple[str,
+                  ...], training: bool):
+        op_indices = self._ancestors(list(out_names))
+        missing = self._required_placeholders(op_indices, out_names) \
+            - set(ph_names)
+        if missing:
+            raise ValueError(
+                f"missing placeholder values for {sorted(missing)} "
+                f"(required to compute {list(out_names)}; "
+                f"provided: {sorted(ph_names)})")
+        const_vals = {n: a for n, a in self._arrays.items()
+                      if self.vars[n].var_type is VariableType.CONSTANT}
+        var_names = [n for n, v in self.vars.items()
+                     if v.var_type is VariableType.VARIABLE]
+
+        def fn(var_vals: dict, ph_vals: dict, rng):
+            values = dict(const_vals)
+            values.update(var_vals)
+            values.update(ph_vals)
+            self._execute(values, op_indices, rng, training)
+            return [values[n] for n in out_names]
+
+        return fn, var_names
+
+    def output(self, placeholders: dict, outputs: Sequence[str],
+               *, training: bool = False) -> Dict[str, np.ndarray]:
+        """Execute the graph (reference: SameDiff.output). The whole
+        requested subgraph compiles to one XLA program, cached per
+        (outputs, placeholder signature)."""
+        outputs = [o.name if isinstance(o, SDVariable) else o
+                   for o in outputs]
+        ph_vals = {k: jnp.asarray(v) for k, v in placeholders.items()}
+        sig = (tuple(outputs), training,
+               tuple(sorted((k, v.shape, str(v.dtype))
+                            for k, v in ph_vals.items())))
+        if sig not in self._exec_cache:
+            fn, var_names = self._build_fn(tuple(outputs),
+                                           tuple(ph_vals), training)
+            self._exec_cache[sig] = (jax.jit(fn), var_names)
+        jfn, var_names = self._exec_cache[sig]
+        var_vals = {n: self._arrays[n] for n in var_names}
+        self._rng, rng = jax.random.split(self._rng)
+        res = jfn(var_vals, ph_vals, rng)
+        return {n: np.asarray(r) for n, r in zip(outputs, res)}
+
+    def batch_output(self):
+        """Fluent executor (reference: sd.batchOutput())."""
+        sd = self
+
+        class _Builder:
+            def __init__(self):
+                self._ph = {}
+                self._outs = []
+
+            def input(self, name, arr):
+                self._ph[name if isinstance(name, str) else name.name] \
+                    = arr
+                return self
+
+            def output(self, *names):
+                self._outs.extend(n if isinstance(n, str) else n.name
+                                  for n in names)
+                return self
+
+            def output_all(self):
+                self._outs = [n for n, v in sd.vars.items()
+                              if v.var_type is VariableType.ARRAY]
+                return self
+
+            def exec(self):
+                return sd.output(self._ph, self._outs)
+
+        return _Builder()
+
+    # -- gradients (S2) ------------------------------------------------
+    def set_loss_variables(self, *names):
+        self.loss_variables = [n.name if isinstance(n, SDVariable) else n
+                               for n in names]
+
+    def calculate_gradients(self, placeholders: dict,
+                            wrt: Sequence[str]) -> Dict[str, np.ndarray]:
+        """Analytic gradients of the summed loss variables wrt the given
+        VARIABLEs (reference: sd.calculateGradients)."""
+        if not self.loss_variables:
+            raise ValueError("call set_loss_variables first")
+        wrt = [w.name if isinstance(w, SDVariable) else w for w in wrt]
+        ph_vals = {k: jnp.asarray(v) for k, v in placeholders.items()}
+        fn, var_names = self._build_fn(tuple(self.loss_variables),
+                                       tuple(ph_vals), True)
+
+        def loss_fn(wrt_vals):
+            var_vals = {n: self._arrays[n] for n in var_names
+                        if n not in wrt_vals}
+            var_vals.update(wrt_vals)
+            outs = fn(var_vals, ph_vals, None)
+            return sum(jnp.sum(o) for o in outs)
+
+        grads = jax.grad(loss_fn)({n: self._arrays[n] for n in wrt})
+        return {n: np.asarray(g) for n, g in grads.items()}
+
+    # -- training (S4) -------------------------------------------------
+    def set_training_config(self, config):
+        self.training_config = config
+
+    def _build_train_step(self, ph_names: Tuple[str, ...]):
+        cfg = self.training_config
+        fn, var_names = self._build_fn(tuple(self.loss_variables),
+                                       ph_names, True)
+        trainable = [n for n in var_names]
+        updater = cfg.updater
+
+        def step(var_vals, upd_state, ph_vals, iteration, rng):
+            def loss_fn(tv):
+                outs = fn(tv, ph_vals, rng)
+                total = sum(jnp.sum(o) for o in outs)
+                if cfg.l2:
+                    total = total + 0.5 * cfg.l2 * sum(
+                        jnp.sum(v * v) for v in tv.values())
+                if cfg.l1:
+                    total = total + cfg.l1 * sum(
+                        jnp.sum(jnp.abs(v)) for v in tv.values())
+                return total
+
+            loss, grads = jax.value_and_grad(loss_fn)(var_vals)
+            updates, new_state = updater.apply(grads, upd_state,
+                                               iteration)
+            new_vars = jax.tree_util.tree_map(lambda p, u: p - u,
+                                              var_vals, updates)
+            return new_vars, new_state, loss
+
+        return jax.jit(step, donate_argnums=(0, 1)), trainable
+
+    def fit(self, iterator=None, *, n_epochs: int = 1,
+            placeholders_fn=None):
+        """fit(MultiDataSetIterator-like). Each element must provide the
+        placeholder dict via training_config's feature/label mappings
+        (reference: TrainingConfig dataSetFeatureMapping), or supply
+        ``placeholders_fn(batch) -> dict``."""
+        from deeplearning4j_tpu.autodiff.training import History
+        cfg = self.training_config
+        if cfg is None:
+            raise ValueError("call set_training_config first")
+        if not self.loss_variables:
+            raise ValueError("call set_loss_variables first")
+        history = History()
+        step_fn = None
+        trainable = None
+        iteration = 0
+        for epoch in range(n_epochs):
+            if hasattr(iterator, "reset"):
+                iterator.reset()
+            epoch_losses = []
+            for batch in iterator:
+                ph = (placeholders_fn(batch) if placeholders_fn
+                      else cfg.placeholders_from(batch))
+                ph_vals = {k: jnp.asarray(v) for k, v in ph.items()}
+                if step_fn is None:
+                    step_fn, trainable = self._build_train_step(
+                        tuple(ph_vals))
+                    if self._updater_state is None:
+                        self._updater_state = cfg.updater.init_state(
+                            {n: self._arrays[n] for n in trainable})
+                        self._restore_updater_leaves()
+                var_vals = {n: self._arrays[n] for n in trainable}
+                self._rng, rng = jax.random.split(self._rng)
+                new_vars, self._updater_state, loss = step_fn(
+                    var_vals, self._updater_state, ph_vals,
+                    jnp.asarray(iteration), rng)
+                self._arrays.update(new_vars)
+                epoch_losses.append(float(loss))
+                iteration += 1
+            history.add_epoch(epoch, epoch_losses)
+        return history
+
+    def _restore_updater_leaves(self):
+        """Graft updater leaves saved by ``save`` onto the freshly-built
+        state tree (same graph + updater -> same treedef), so a loaded
+        model resumes with its optimizer moments intact."""
+        loaded = getattr(self, "_loaded_updater_leaves", None)
+        if loaded is None:
+            return
+        leaves, treedef = jax.tree_util.tree_flatten(self._updater_state)
+        if len(leaves) != len(loaded):
+            raise ValueError(
+                f"saved updater state has {len(loaded)} leaves, current "
+                f"updater expects {len(leaves)} — updater/graph changed "
+                f"since save")
+        self._updater_state = jax.tree_util.tree_unflatten(
+            treedef, [jnp.asarray(l) for l in loaded])
+        self._loaded_updater_leaves = None
+
+    # -- serialization (S5) --------------------------------------------
+    def save(self, path: str, save_updater_state: bool = True):
+        """Zip: graph.json + arrays.npz (+ updater npz) — the same
+        contract as the reference .fb (graph + params + updater state +
+        training config)."""
+        graph = {
+            "variables": [
+                {"name": v.name, "type": v.var_type.value,
+                 "shape": list(v.shape) if v.shape else None,
+                 "dtype": str(v.dtype) if v.dtype else None}
+                for v in self.vars.values()],
+            "ops": [{"op": o.op_name, "inputs": o.inputs,
+                     "outputs": o.outputs,
+                     "attrs": _json_attrs(o.attrs)} for o in self.ops],
+            "loss_variables": self.loss_variables,
+            "training_config": (self.training_config.to_map()
+                                if self.training_config else None),
+        }
+        with zipfile.ZipFile(path, "w") as z:
+            z.writestr("graph.json", json.dumps(graph, indent=1))
+            buf = io.BytesIO()
+            np.savez(buf, **{k: np.asarray(v)
+                             for k, v in self._arrays.items()})
+            z.writestr("arrays.npz", buf.getvalue())
+            if save_updater_state and self._updater_state is not None:
+                leaves, treedef = jax.tree_util.tree_flatten(
+                    self._updater_state)
+                buf2 = io.BytesIO()
+                np.savez(buf2, **{f"leaf_{i}": np.asarray(l)
+                                  for i, l in enumerate(leaves)})
+                z.writestr("updater.npz", buf2.getvalue())
+
+    @staticmethod
+    def load(path: str) -> "SameDiff":
+        from deeplearning4j_tpu.autodiff.training import TrainingConfig
+        sd = SameDiff()
+        with zipfile.ZipFile(path) as z:
+            graph = json.loads(z.read("graph.json"))
+            arrays = np.load(io.BytesIO(z.read("arrays.npz")))
+            arr_map = {k: jnp.asarray(arrays[k]) for k in arrays.files}
+        for vd in graph["variables"]:
+            v = SDVariable(sd, vd["name"], VariableType(vd["type"]),
+                           vd["shape"], vd["dtype"])
+            sd.vars[v.name] = v
+            if v.name in arr_map:
+                sd._arrays[v.name] = arr_map[v.name]
+        for i, od in enumerate(graph["ops"]):
+            node = OpNode(od["op"], od["inputs"], od["outputs"],
+                          od["attrs"])
+            sd.ops.append(node)
+            for on in node.outputs:
+                sd._producer[on] = i
+        sd.loss_variables = graph.get("loss_variables", [])
+        tc = graph.get("training_config")
+        if tc:
+            sd.training_config = TrainingConfig.from_map(tc)
+        with zipfile.ZipFile(path) as z:
+            if "updater.npz" in z.namelist():
+                upd = np.load(io.BytesIO(z.read("updater.npz")))
+                sd._loaded_updater_leaves = [
+                    upd[f"leaf_{i}"] for i in range(len(upd.files))]
+        return sd
+
+    # -- introspection -------------------------------------------------
+    def variables(self) -> List[SDVariable]:
+        return list(self.vars.values())
+
+    def get_variable(self, name: str) -> SDVariable:
+        return self.vars[name]
+
+    def has_variable(self, name: str) -> bool:
+        return name in self.vars
+
+    def summary(self) -> str:
+        lines = [f"{'var':<28}{'type':<14}{'shape':<18}producer op"]
+        for v in self.vars.values():
+            prod = ""
+            if v.name in self._producer:
+                prod = self.ops[self._producer[v.name]].op_name
+            lines.append(f"{v.name:<28}{v.var_type.value:<14}"
+                         f"{str(v.shape):<18}{prod}")
+        lines.append(f"{len(self.ops)} ops, {len(self.vars)} variables")
+        return "\n".join(lines)
+
+
+def _json_attrs(attrs: dict) -> dict:
+    out = {}
+    for k, v in (attrs or {}).items():
+        if k == "rng":
+            continue
+        if isinstance(v, (np.integer,)):
+            v = int(v)
+        elif isinstance(v, (np.floating,)):
+            v = float(v)
+        elif isinstance(v, tuple):
+            v = list(v)
+        elif hasattr(v, "dtype") and hasattr(v, "tolist"):
+            v = v.tolist()
+        out[k] = v
+    return out

@@ -253,14 +253,8 @@ class ComputationGraph:
         feats = ds.features if isinstance(ds.features, list) \
             else [ds.features]
         labs = ds.labels if isinstance(ds.labels, list) else [ds.labels]
-        lmasks = getattr(ds, "labels_masks", None)
-        if lmasks is None:
-            lm = getattr(ds, "labels_mask", None)
-            lmasks = [lm] if lm is not None else None
-        fmasks = getattr(ds, "features_masks", None)
-        fmask = fmasks[0] if fmasks else getattr(ds, "features_mask",
-                                                 None)
-        self._fit_batch(feats, labs, fmask, lmasks)
+        self._fit_batch(feats, labs, self._ds_fmask(ds),
+                        self._ds_lmasks(ds))
 
     def _fit_batch(self, inputs: list, labels: list, fmask, lmasks):
         inputs = [_as_jnp(x, self._dtype) for x in inputs]

@@ -158,8 +158,9 @@ class EncodingHandler:
         leaves = jax.tree_util.tree_leaves(quantized)
         if leaves:
             total = sum(l.size for l in leaves)
-            nz = sum(float(jnp.sum(l != 0)) for l in leaves)
-            self.last_sparsity = nz / max(total, 1)
+            # one device sync for the whole tree, not one per leaf
+            nz = sum(jnp.sum(l != 0) for l in leaves)
+            self.last_sparsity = float(nz) / max(total, 1)
         self.tau = self.algorithm.next_tau(self.tau, self.last_sparsity)
         self.residual = self.residual_post.apply(self.step, self.tau,
                                                  self.residual)

@@ -134,20 +134,10 @@ class SharedTrainingMaster:
         if jax.process_count() == 1:
             pw.fit(iterator, n_epochs=n_epochs)
             return model
-        # multi-host: local arrays -> global sharded arrays; same epoch/
-        # listener protocol as the single-host path
-        if not pw._placed:
-            pw._place_model()
-        for _ in range(n_epochs):
-            if hasattr(iterator, "reset"):
-                iterator.reset()
-            for lis in model.listeners:
-                lis.on_epoch_start(model)
-            for ds in iterator:
-                model.fit(self._make_global(mesh, ds))
-            for lis in model.listeners:
-                lis.on_epoch_end(model)
-            model.epoch_count += 1
+        # multi-host: same epoch loop, batches assembled globally from
+        # each process's local shard
+        pw.run_epochs(iterator, n_epochs,
+                      lambda ds: self._make_global(mesh, ds))
         return model
 
     def _make_global(self, mesh, ds):

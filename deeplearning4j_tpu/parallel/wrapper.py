@@ -149,6 +149,12 @@ class ParallelWrapper:
     def fit(self, iterator, *, n_epochs: int = 1) -> "ParallelWrapper":
         """fit(DataSetIterator) — same contract as model.fit, executed
         as one SPMD program over the mesh."""
+        return self.run_epochs(iterator, n_epochs, self._shard_dataset)
+
+    def run_epochs(self, iterator, n_epochs, shard_fn):
+        """The one epoch/reset/listener loop, parameterized by how each
+        batch is placed on the mesh (single-host shard vs multi-host
+        global assembly — SharedTrainingMaster passes its own)."""
         if not self._placed:
             self._place_model()
         for _ in range(n_epochs):
@@ -157,7 +163,7 @@ class ParallelWrapper:
             for lis in self.model.listeners:
                 lis.on_epoch_start(self.model)
             for ds in iterator:
-                self.model.fit(self._shard_dataset(ds))
+                self.model.fit(shard_fn(ds))
             for lis in self.model.listeners:
                 lis.on_epoch_end(self.model)
             self.model.epoch_count += 1

@@ -1,0 +1,236 @@
+"""SameDiff graph-layer tests (SURVEY.md §2.3 S1-S5, §4.3 op-validation
+pattern: forward values AND analytic-vs-numeric gradients)."""
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from deeplearning4j_tpu.autodiff import (OP_REGISTRY, SameDiff,
+                                         TrainingConfig, VariableType,
+                                         op_coverage)
+from deeplearning4j_tpu.datasets.dataset import DataSet
+from deeplearning4j_tpu.datasets.iterators import ListDataSetIterator
+from deeplearning4j_tpu.learning.updaters import Adam, Sgd
+from deeplearning4j_tpu.nn.weights import WeightInit
+
+
+def test_build_and_eval_arithmetic():
+    sd = SameDiff.create()
+    a = sd.var("a", array=np.array([1.0, 2.0, 3.0]))
+    b = sd.constant("b", np.array([10.0, 20.0, 30.0]))
+    c = (a + b) * 2.0
+    out = c.eval()
+    np.testing.assert_allclose(out, [22.0, 44.0, 66.0])
+
+
+def test_placeholder_mlp_forward():
+    sd = SameDiff.create()
+    x = sd.placeholder("x", shape=(None, 4))
+    w = sd.var("w", shape=(4, 3), init=WeightInit.XAVIER)
+    b = sd.var("b", array=np.zeros(3, np.float32))
+    logits = sd.nn.linear(x, w, b, name="logits")
+    probs = sd.nn.softmax(logits, name="probs")
+    xv = np.random.RandomState(0).randn(5, 4).astype(np.float32)
+    res = sd.output({"x": xv}, [probs.name])
+    assert res[probs.name].shape == (5, 3)
+    np.testing.assert_allclose(res[probs.name].sum(-1), np.ones(5),
+                               rtol=1e-5)
+
+
+def test_whole_graph_is_one_jit_cache_entry():
+    sd = SameDiff.create()
+    x = sd.placeholder("x", shape=(None, 4))
+    y = sd.math.tanh(x * 2.0)
+    xv = np.ones((3, 4), np.float32)
+    sd.output({"x": xv}, [y.name])
+    sd.output({"x": xv}, [y.name])          # same sig -> cached
+    assert len(sd._exec_cache) == 1
+    sd.output({"x": np.ones((6, 4), np.float32)}, [y.name])
+    assert len(sd._exec_cache) == 2
+
+
+def test_analytic_vs_numeric_gradient():
+    """The §4.3 OpValidation pattern: finite-difference check."""
+    sd = SameDiff.create()
+    x = sd.placeholder("x", shape=(None, 3))
+    w = sd.var("w", array=np.array([[0.5], [-1.0], [2.0]], np.float32))
+    out = sd.math.sigmoid(x @ w)
+    loss = out.sum()
+    sd.set_loss_variables(loss.name)
+    xv = np.random.RandomState(1).randn(4, 3).astype(np.float32)
+    g = sd.calculate_gradients({"x": xv}, ["w"])["w"]
+
+    eps = 1e-3
+    w0 = sd.get_variable("w").get_arr()
+    num = np.zeros_like(w0)
+    for i in range(3):
+        for sgn, acc in ((1, 1), (-1, -1)):
+            wp = w0.copy()
+            wp[i, 0] += sgn * eps
+            sd.get_variable("w").set_arr(wp)
+            num[i, 0] += acc * sd.output({"x": xv},
+                                         [loss.name])[loss.name]
+    sd.get_variable("w").set_arr(w0)
+    num /= 2 * eps
+    np.testing.assert_allclose(g, num, rtol=1e-2, atol=1e-3)
+
+
+def test_fit_linear_regression():
+    sd = SameDiff.create()
+    x = sd.placeholder("x", shape=(None, 2))
+    y = sd.placeholder("y", shape=(None, 1))
+    w = sd.var("w", array=np.zeros((2, 1), np.float32))
+    b = sd.var("b", array=np.zeros((1,), np.float32))
+    pred = x @ w + b
+    loss = sd.loss.mean_squared_error(y, pred, name="loss")
+    sd.set_loss_variables("loss")
+    sd.set_training_config(
+        TrainingConfig.Builder().updater(Adam(0.1))
+        .data_set_feature_mapping("x").data_set_label_mapping("y")
+        .build())
+    rng = np.random.RandomState(0)
+    xv = rng.randn(256, 2).astype(np.float32)
+    true_w = np.array([[2.0], [-3.0]], np.float32)
+    yv = xv @ true_w + 0.5
+    it = ListDataSetIterator([DataSet(xv[i:i + 64], yv[i:i + 64])
+                              for i in range(0, 256, 64)])
+    hist = sd.fit(it, n_epochs=60)
+    assert hist.final_loss() < 1e-2
+    np.testing.assert_allclose(sd.get_variable("w").get_arr(), true_w,
+                               atol=0.1)
+    np.testing.assert_allclose(sd.get_variable("b").get_arr(), [0.5],
+                               atol=0.1)
+
+
+def test_save_load_roundtrip(tmp_path):
+    sd = SameDiff.create()
+    x = sd.placeholder("x", shape=(None, 4))
+    w = sd.var("w", shape=(4, 2), init=WeightInit.XAVIER)
+    out = sd.nn.softmax(x @ w, name="out")
+    sd.set_loss_variables("out")
+    sd.set_training_config(
+        TrainingConfig.Builder().updater(Sgd(0.01))
+        .data_set_feature_mapping("x").build())
+    xv = np.random.RandomState(0).randn(3, 4).astype(np.float32)
+    before = sd.output({"x": xv}, ["out"])["out"]
+
+    p = str(tmp_path / "model.sdz")
+    sd.save(p)
+    sd2 = SameDiff.load(p)
+    after = sd2.output({"x": xv}, ["out"])["out"]
+    np.testing.assert_allclose(after, before, rtol=1e-6)
+    assert sd2.training_config.updater == Sgd(0.01)
+    assert sd2.loss_variables == ["out"]
+
+
+def test_save_load_resumes_updater_state(tmp_path):
+    """load must restore optimizer moments, not reset them (reference
+    contract: .fb carries updater state)."""
+    def make():
+        sd = SameDiff.create()
+        x = sd.placeholder("x", shape=(None, 2))
+        y = sd.placeholder("y", shape=(None, 1))
+        w = sd.var("w", array=np.zeros((2, 1), np.float32))
+        loss = sd.loss.mean_squared_error(y, x @ w, name="loss")
+        sd.set_loss_variables("loss")
+        sd.set_training_config(
+            TrainingConfig.Builder().updater(Adam(0.05))
+            .data_set_feature_mapping("x").data_set_label_mapping("y")
+            .build())
+        return sd
+
+    rng = np.random.RandomState(0)
+    xv = rng.randn(32, 2).astype(np.float32)
+    yv = (xv @ np.array([[1.0], [2.0]], np.float32))
+    it = ListDataSetIterator([DataSet(xv, yv)])
+
+    sd = make()
+    sd.fit(it, n_epochs=3)
+    p = str(tmp_path / "resume.sdz")
+    sd.save(p)
+    sd.fit(it, n_epochs=2)                       # continue in-memory
+    expected = sd.get_variable("w").get_arr()
+
+    sd2 = SameDiff.load(p)
+    sd2.fit(it, n_epochs=2)                      # resume from disk
+    np.testing.assert_allclose(sd2.get_variable("w").get_arr(),
+                               expected, rtol=1e-5, atol=1e-6)
+
+
+def test_multi_output_ops():
+    sd = SameDiff.create()
+    x = sd.var("x", array=np.arange(12, dtype=np.float32).reshape(3, 4))
+    parts = sd.math.split(x, 2, axis=1)
+    assert len(parts) == 2
+    np.testing.assert_allclose(parts[0].eval(),
+                               np.arange(12).reshape(3, 4)[:, :2])
+    m, v = sd.math.moments(x, axis=0)
+    np.testing.assert_allclose(
+        m.eval(), np.arange(12).reshape(3, 4).mean(0), rtol=1e-6)
+
+
+def test_attention_op():
+    sd = SameDiff.create()
+    b, t, d, h = 2, 5, 8, 2
+    x = sd.placeholder("x", shape=(None, t, d))
+    rng = np.random.RandomState(0)
+
+    def w():
+        return rng.randn(d, d).astype(np.float32) * 0.1
+
+    wq, wk, wv, wo = (sd.constant(w()) for _ in range(4))
+    att = sd.nn.multi_head_dot_product_attention(x, wq, wk, wv, wo,
+                                                 num_heads=h)
+    mask = sd.placeholder("mask", shape=(None, t))
+    att_m = sd.nn.multi_head_dot_product_attention(
+        x, wq, wk, wv, wo, num_heads=h, mask=mask)
+    xv = rng.randn(b, t, d).astype(np.float32)
+    out = sd.output({"x": xv}, [att.name])[att.name]
+    assert out.shape == (b, t, d)
+    mv = np.ones((b, t), np.float32)
+    mv[:, -2:] = 0
+    out_m = sd.output({"x": xv, "mask": mv}, [att_m.name])[att_m.name]
+    assert np.isfinite(out_m).all()
+
+
+def test_dropout_training_vs_inference():
+    sd = SameDiff.create()
+    x = sd.placeholder("x", shape=(None, 50))
+    y = sd.nn.dropout(x, rate=0.5)
+    xv = np.ones((4, 50), np.float32)
+    inference = sd.output({"x": xv}, [y.name])[y.name]
+    np.testing.assert_allclose(inference, xv)   # no-op at inference
+    train = sd.output({"x": xv}, [y.name], training=True)[y.name]
+    assert (train == 0).sum() > 0               # some dropped
+    kept = train[train != 0]
+    np.testing.assert_allclose(kept, 2.0)        # inverted scaling
+
+
+def test_op_coverage_domains():
+    """§4.3 coverage accounting: every Appendix-A domain populated."""
+    cov = op_coverage()
+    for domain in ("arithmetic", "transform", "activation", "blas",
+                   "linalg", "reduce", "indexreduce", "boolean",
+                   "bitwise", "shape", "segment", "normalization",
+                   "convolution", "image", "random", "loss",
+                   "attention", "recurrent", "compression"):
+        assert cov.get(domain, 0) > 0, f"empty op domain {domain}"
+    assert len(OP_REGISTRY) >= 180
+
+
+def test_rename_and_summary():
+    sd = SameDiff.create()
+    x = sd.placeholder("x", shape=(None, 2))
+    y = sd.math.tanh(x).rename("activation_out")
+    assert sd.has_variable("activation_out")
+    res = sd.output({"x": np.zeros((1, 2), np.float32)},
+                    ["activation_out"])
+    assert "activation_out" in res
+    assert "activation_out" in sd.summary()
+
+
+def test_unknown_op_raises():
+    sd = SameDiff.create()
+    a = sd.var("a", array=np.ones(3))
+    with pytest.raises(KeyError):
+        sd._op("definitely_not_an_op", [a])
