@@ -871,14 +871,17 @@ def _nms(ins, attrs):
         area_b = (b[:, 2] - b[:, 0]) * (b[:, 3] - b[:, 1])
         return inter / jnp.maximum(area_a + area_b - inter, 1e-9)
 
-    # static greedy loop (max_out is a static attr)
+    # static greedy loop (max_out is a static attr); once every box is
+    # picked or suppressed, remaining slots are padded with -1 so the
+    # caller can distinguish real picks (TF pads with fewer outputs).
     sc = scores
     picks = []
     for _ in range(max_out):
         i = jnp.argmax(sc)
-        picks.append(i)
+        valid = sc[i] > -jnp.inf
+        picks.append(jnp.where(valid, i, -1))
         suppress = iou(boxes[i], boxes) > iou_thr
-        sc = jnp.where(suppress, -jnp.inf, sc)
+        sc = jnp.where(valid & suppress, -jnp.inf, sc)
         sc = sc.at[i].set(-jnp.inf)
     return jnp.stack(picks)
 
@@ -1015,34 +1018,22 @@ def _hinge(ins, attrs):
 # -- attention (Appendix A: attention domain) -------------------------------
 @op("dot_product_attention", "attention")
 def _dpa(ins, attrs):
+    from deeplearning4j_tpu.ops.attention import dot_product_attention
     q, k, v = ins[0], ins[1], ins[2]
     mask = ins[3] if len(ins) > 3 else None
-    scale = attrs.get("scale", 1.0 / (q.shape[-1] ** 0.5))
-    scores = jnp.einsum("...qd,...kd->...qk", q, k) * scale
-    if mask is not None:
-        scores = jnp.where(mask > 0, scores, -1e9)
-    w = jax.nn.softmax(scores, axis=-1)
-    return jnp.einsum("...qk,...kd->...qd", w, v)
+    return dot_product_attention(q, k, v, mask,
+                                 scale=attrs.get("scale"))
 
 
 @op("multi_head_dot_product_attention", "attention")
 def _mhdpa(ins, attrs):
     # x: [b, t, d]; Wq/Wk/Wv: [d, h*dh]; Wo: [h*dh, d]
+    from deeplearning4j_tpu.ops.attention import multi_head_attention
     x, wq, wk, wv, wo = ins[0], ins[1], ins[2], ins[3], ins[4]
     mask = ins[5] if len(ins) > 5 else None
-    h = attrs["num_heads"]
-    b, t, d = x.shape
-
-    def split(a):
-        return a.reshape(b, t, h, -1).transpose(0, 2, 1, 3)
-
-    q, k, v = split(x @ wq), split(x @ wk), split(x @ wv)
-    m = None
-    if mask is not None:
-        m = mask[:, None, None, :]      # [b, 1, 1, t]
-    o = _dpa([q, k, v] + ([m] if m is not None else []), attrs)
-    o = o.transpose(0, 2, 1, 3).reshape(b, t, -1)
-    return o @ wo
+    params = {"Wq": wq, "Wk": wk, "Wv": wv, "Wo": wo}
+    return multi_head_attention(params, x, x, attrs["num_heads"],
+                                key_mask=mask)
 
 
 # -- recurrent (cell-level ops; layer-level lives in nn.conf) ----------------

@@ -179,6 +179,9 @@ class SDVariable:
 
     def set_arr(self, value):
         self.sd._arrays[self.name] = jnp.asarray(value)
+        # constant values are baked into cached executors; invalidate
+        if self.var_type is VariableType.CONSTANT:
+            self.sd._exec_cache.clear()
 
     def __repr__(self):
         return (f"SDVariable(name='{self.name}', "
@@ -399,10 +402,17 @@ class SameDiff:
                 f"missing placeholder values for {sorted(missing)} "
                 f"(required to compute {list(out_names)}; "
                 f"provided: {sorted(ph_names)})")
+        # restrict to the requested subgraph: variables/constants outside
+        # it must not be shipped per call nor receive l1/l2 gradients
+        needed = set(out_names)
+        for idx in op_indices:
+            needed.update(self.ops[idx].inputs)
         const_vals = {n: a for n, a in self._arrays.items()
-                      if self.vars[n].var_type is VariableType.CONSTANT}
+                      if n in needed and
+                      self.vars[n].var_type is VariableType.CONSTANT}
         var_names = [n for n, v in self.vars.items()
-                     if v.var_type is VariableType.VARIABLE]
+                     if n in needed and
+                     v.var_type is VariableType.VARIABLE]
 
         def fn(var_vals: dict, ph_vals: dict, rng):
             values = dict(const_vals)
@@ -465,6 +475,9 @@ class SameDiff:
 
     # -- gradients (S2) ------------------------------------------------
     def set_loss_variables(self, *names):
+        # accept varargs or a single list/tuple (reference overloads)
+        if len(names) == 1 and isinstance(names[0], (list, tuple)):
+            names = names[0]
         self.loss_variables = [n.name if isinstance(n, SDVariable) else n
                                for n in names]
 
@@ -483,7 +496,8 @@ class SameDiff:
             var_vals = {n: self._arrays[n] for n in var_names
                         if n not in wrt_vals}
             var_vals.update(wrt_vals)
-            outs = fn(var_vals, ph_vals, None)
+            # deterministic key so random ops in the loss subgraph work
+            outs = fn(var_vals, ph_vals, jax.random.PRNGKey(0))
             return sum(jnp.sum(o) for o in outs)
 
         grads = jax.grad(loss_fn)({n: self._arrays[n] for n in wrt})

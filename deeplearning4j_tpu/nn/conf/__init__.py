@@ -3,4 +3,5 @@ from deeplearning4j_tpu.nn.conf.builders import (  # noqa: F401
     GradientNormalization, BackpropType, WorkspaceMode)
 from deeplearning4j_tpu.nn.conf.inputs import InputType  # noqa: F401
 from deeplearning4j_tpu.nn.conf import layers  # noqa: F401
+from deeplearning4j_tpu.nn.conf import layers_attention  # noqa: F401
 from deeplearning4j_tpu.nn.conf import preprocessors  # noqa: F401

@@ -1,0 +1,200 @@
+"""Attention layers.
+
+Reference parity (SURVEY.md D4 "attention"):
+``org.deeplearning4j.nn.conf.layers.SelfAttentionLayer``,
+``LearnedSelfAttentionLayer``, ``RecurrentAttentionLayer`` — in the
+reference these are SameDiff-backed layers built on the nd4j
+``multi_head_dot_product_attention`` op. Here each is a config dataclass
+whose forward lowers to one fused einsum/softmax/einsum chain that XLA
+maps onto the MXU; no per-head loop, heads are a tensor dimension.
+
+Activations are [batch, time, features]. Masks are [batch, time] key
+masks: masked timesteps neither attend nor get attended to (scores set
+to -inf before softmax), matching the reference's masked attention.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import jax
+import jax.numpy as jnp
+
+from deeplearning4j_tpu.activations import Activation
+from deeplearning4j_tpu.nn.conf.inputs import (InputType,
+                                               InputTypeRecurrent)
+from deeplearning4j_tpu.nn.conf.layers import Layer, register_layer
+from deeplearning4j_tpu.nn.weights import WeightInit
+from deeplearning4j_tpu.ops.attention import (  # noqa: F401
+    dot_product_attention, multi_head_attention)
+
+
+@dataclass
+class BaseAttentionLayer(Layer):
+    """Shared config: n_heads * head_size projection width."""
+
+    n_heads: int = 1
+    head_size: int = 0          # 0 -> n_out // n_heads
+
+    def _head_size(self) -> int:
+        return self.head_size or max(self.n_out // self.n_heads, 1)
+
+    def accepts_mask(self) -> bool:
+        return True
+
+    def set_n_in(self, input_type, override):
+        if isinstance(input_type, InputTypeRecurrent) and \
+                (override or not self.n_in):
+            self.n_in = input_type.size
+            if not self.n_out:
+                self.n_out = self.n_in
+
+    def _proj_params(self, key, q_dim, kv_dim, dtype):
+        wi = self.weight_init or WeightInit.XAVIER
+        hs = self._head_size() * self.n_heads
+        k1, k2, k3, k4 = jax.random.split(key, 4)
+        return {
+            "Wq": wi.init(k1, (q_dim, hs), q_dim, hs, dtype),
+            "Wk": wi.init(k2, (kv_dim, hs), kv_dim, hs, dtype),
+            "Wv": wi.init(k3, (kv_dim, hs), kv_dim, hs, dtype),
+            "Wo": wi.init(k4, (hs, self.n_out), hs, self.n_out, dtype),
+        }
+
+
+@register_layer
+@dataclass
+class SelfAttentionLayer(BaseAttentionLayer):
+    """Self-attention over the input sequence (reference:
+    conf.layers.SelfAttentionLayer). ``project_input=False`` requires
+    a single head and applies unprojected dot-product attention."""
+
+    project_input: bool = True
+
+    def init_params(self, key, input_type, dtype=jnp.float32):
+        if not self.project_input:
+            return {}
+        return self._proj_params(key, self.n_in, self.n_in, dtype)
+
+    def has_params(self) -> bool:
+        return self.project_input
+
+    def forward(self, params, x, *, training, rng=None, state=None,
+                mask=None):
+        x = self._maybe_dropout(x, training, rng)
+        if not self.project_input:
+            m = mask[:, None, :] if mask is not None else None
+            y = dot_product_attention(x, x, x, m)
+        else:
+            y = multi_head_attention(params, x, x, self.n_heads,
+                                     key_mask=mask)
+        if mask is not None:
+            y = y * mask[:, :, None]
+        return self.activation(y), state
+
+    def get_output_type(self, input_type):
+        t = input_type.timesteps if isinstance(input_type,
+                                               InputTypeRecurrent) else -1
+        n = self.n_out if self.project_input else self.n_in
+        return InputType.recurrent(n, t)
+
+
+@register_layer
+@dataclass
+class LearnedSelfAttentionLayer(BaseAttentionLayer):
+    """Attention with ``n_queries`` learned query vectors (reference:
+    conf.layers.LearnedSelfAttentionLayer). Output has a fixed
+    ``n_queries`` timesteps regardless of input length — the
+    reference's sequence-summarisation head."""
+
+    n_queries: int = 1
+
+    def init_params(self, key, input_type, dtype=jnp.float32):
+        kq, kp = jax.random.split(key)
+        wi = self.weight_init or WeightInit.XAVIER
+        p = self._proj_params(kp, self.n_in, self.n_in, dtype)
+        p["Q"] = wi.init(kq, (self.n_queries, self.n_in),
+                         self.n_in, self.n_in, dtype)
+        return p
+
+    def forward(self, params, x, *, training, rng=None, state=None,
+                mask=None):
+        x = self._maybe_dropout(x, training, rng)
+        b = x.shape[0]
+        q = jnp.broadcast_to(params["Q"],
+                             (b,) + params["Q"].shape)
+        y = multi_head_attention(params, q, x, self.n_heads,
+                                 key_mask=mask)
+        return self.activation(y), state
+
+    def get_output_type(self, input_type):
+        return InputType.recurrent(self.n_out, self.n_queries)
+
+
+@register_layer
+@dataclass
+class RecurrentAttentionLayer(BaseAttentionLayer):
+    """Recurrent cell whose per-timestep input is augmented with an
+    attention readout over the full sequence, queried by the previous
+    hidden state (reference: conf.layers.RecurrentAttentionLayer):
+
+        a_t = MHA(q = h_{t-1}, kv = x)
+        h_t = act(x_t W + h_{t-1} R + a_t + b)
+
+    The attention readout is recomputed each step inside one
+    ``lax.scan``; XLA hoists the shared K/V projections out of the
+    loop, so per-step cost is one [b,1,d]x[b,t,d] attention."""
+
+    activation: Activation = Activation.TANH
+    has_bias: bool = True
+
+    def is_recurrent(self) -> bool:
+        return True
+
+    def zero_state(self, batch: int, dtype=jnp.float32) -> dict:
+        return {"h": jnp.zeros((batch, self.n_out), dtype)}
+
+    def init_params(self, key, input_type, dtype=jnp.float32):
+        wi = self.weight_init or WeightInit.XAVIER
+        k1, k2, k3 = jax.random.split(key, 3)
+        p = self._proj_params(k3, self.n_out, self.n_in, dtype)
+        p["W"] = wi.init(k1, (self.n_in, self.n_out), self.n_in,
+                         self.n_out, dtype)
+        p["R"] = wi.init(k2, (self.n_out, self.n_out), self.n_out,
+                         self.n_out, dtype)
+        if self.has_bias:
+            p["b"] = jnp.full((self.n_out,), self.bias_init, dtype)
+        return p
+
+    def forward(self, params, x, *, training, rng=None, state=None,
+                mask=None):
+        x = self._maybe_dropout(x, training, rng)
+        b, t, _ = x.shape
+        if not state:
+            state = self.zero_state(b, x.dtype)
+        act = self.activation.fn()
+        xw = x @ params["W"]                       # hoisted input proj
+        if "b" in params:
+            xw = xw + params["b"]
+
+        def step(h, inp):
+            xw_t, m_t = inp
+            a = multi_head_attention(params, h[:, None, :], x,
+                                     self.n_heads, key_mask=mask)[:, 0]
+            h_new = act(xw_t + h @ params["R"] + a)
+            if m_t is not None:
+                h_new = jnp.where(m_t[:, None] > 0, h_new, h)
+            return h_new, h_new
+
+        if mask is not None:
+            h_last, ys = jax.lax.scan(step, state["h"],
+                                      (xw.swapaxes(0, 1),
+                                       mask.swapaxes(0, 1)))
+        else:
+            h_last, ys = jax.lax.scan(
+                lambda h, xt: step(h, (xt, None)), state["h"],
+                xw.swapaxes(0, 1))
+        return ys.swapaxes(0, 1), {"h": h_last}
+
+    def get_output_type(self, input_type):
+        t = input_type.timesteps if isinstance(input_type,
+                                               InputTypeRecurrent) else -1
+        return InputType.recurrent(self.n_out, t)

@@ -1,0 +1,54 @@
+"""Shared attention math — the single implementation behind both the
+graph-op registry (``dot_product_attention`` /
+``multi_head_dot_product_attention``, reference nd4j op names) and the
+NN attention layers (SURVEY.md D4). One einsum/softmax/einsum chain
+that XLA fuses onto the MXU; heads are a tensor dimension, never a
+Python loop.
+
+Mask semantics (matching the reference's masked attention): masks are
+key masks broadcastable to [..., t_q, t_k]; 0 = masked. Masked keys
+get score -inf before softmax; rows whose keys are ALL masked produce
+zeros (not uniform garbage).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+NEG_INF = -1e9
+
+
+def dot_product_attention(q, k, v, mask=None, scale=None):
+    """Scaled dot-product attention on [..., t, d] tensors."""
+    if scale is None:
+        scale = 1.0 / (q.shape[-1] ** 0.5)
+    scores = jnp.einsum("...qd,...kd->...qk", q, k) * scale
+    if mask is not None:
+        scores = jnp.where(mask > 0, scores, NEG_INF)
+    w = jax.nn.softmax(scores, axis=-1)
+    if mask is not None:
+        w = jnp.where(mask > 0, w, 0.0)
+    return jnp.einsum("...qk,...kd->...qd", w, v)
+
+
+def split_heads(a, n_heads):
+    b, t, _ = a.shape
+    return a.reshape(b, t, n_heads, -1).transpose(0, 2, 1, 3)
+
+
+def merge_heads(a):
+    b, h, t, dh = a.shape
+    return a.transpose(0, 2, 1, 3).reshape(b, t, h * dh)
+
+
+def multi_head_attention(params, q_in, kv_in, n_heads, key_mask=None):
+    """Projected MHA. params: Wq/Wk/Wv [*, h*dh], Wo [h*dh, n_out].
+
+    q_in: [b, tq, dq]; kv_in: [b, tk, dk]; key_mask: [b, tk] or None.
+    """
+    q = split_heads(q_in @ params["Wq"], n_heads)
+    k = split_heads(kv_in @ params["Wk"], n_heads)
+    v = split_heads(kv_in @ params["Wv"], n_heads)
+    m = key_mask[:, None, None, :] if key_mask is not None else None
+    o = dot_product_attention(q, k, v, m)
+    return merge_heads(o) @ params["Wo"]

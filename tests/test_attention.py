@@ -1,0 +1,201 @@
+"""Attention layer tests (reference: dl4j AttentionLayerTest — the
+SelfAttention/LearnedSelfAttention/RecurrentAttention gradient-check
+suite, SURVEY.md D4 "attention")."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.activations import Activation
+from deeplearning4j_tpu.learning import Adam
+from deeplearning4j_tpu.lossfunctions import LossFunction
+from deeplearning4j_tpu.nn import (InputType, MultiLayerNetwork,
+                                   NeuralNetConfiguration)
+from deeplearning4j_tpu.nn.conf.layers import (GlobalPoolingLayer,
+                                               OutputLayer, PoolingType,
+                                               RnnOutputLayer)
+from deeplearning4j_tpu.nn.conf.layers_attention import (
+    LearnedSelfAttentionLayer, RecurrentAttentionLayer, SelfAttentionLayer,
+    dot_product_attention, multi_head_attention)
+from deeplearning4j_tpu.nn.conf.inputs import InputTypeRecurrent
+
+
+def _seq_cls_data(n=64, t=12, f=8, seed=0):
+    """Class = whether feature-0 mean over time is positive."""
+    rng = np.random.RandomState(seed)
+    x = rng.randn(n, t, f).astype(np.float32)
+    y_idx = (x[:, :, 0].mean(1) > 0).astype(int)
+    y = np.eye(2, dtype=np.float32)[y_idx]
+    return x, y
+
+
+def _attn_net(attn_layer, f=8):
+    conf = (NeuralNetConfiguration.Builder()
+            .seed(7).updater(Adam(5e-3)).list()
+            .layer(attn_layer)
+            .layer(GlobalPoolingLayer(pooling_type=PoolingType.AVG))
+            .layer(OutputLayer(n_out=2,
+                               loss_function=LossFunction.MCXENT,
+                               activation=Activation.SOFTMAX))
+            .set_input_type(InputType.recurrent(f))
+            .build())
+    return MultiLayerNetwork(conf).init()
+
+
+class TestDotProductAttention:
+    def test_matches_manual_softmax(self):
+        rng = np.random.RandomState(0)
+        q = jnp.asarray(rng.randn(2, 4, 8), jnp.float32)
+        k = jnp.asarray(rng.randn(2, 6, 8), jnp.float32)
+        v = jnp.asarray(rng.randn(2, 6, 8), jnp.float32)
+        out = dot_product_attention(q, k, v)
+        s = np.einsum("bqd,bkd->bqk", q, k) / np.sqrt(8)
+        w = np.exp(s - s.max(-1, keepdims=True))
+        w /= w.sum(-1, keepdims=True)
+        ref = np.einsum("bqk,bkd->bqd", w, v)
+        np.testing.assert_allclose(np.asarray(out), ref, atol=1e-5)
+
+    def test_key_mask_excludes_timesteps(self):
+        """Changing a masked key/value must not change the output."""
+        rng = np.random.RandomState(1)
+        q = jnp.asarray(rng.randn(1, 3, 4), jnp.float32)
+        kv = rng.randn(1, 5, 4).astype(np.float32)
+        mask = jnp.asarray([[1, 1, 1, 0, 0]], jnp.float32)[:, None, :]
+        out1 = dot_product_attention(q, jnp.asarray(kv), jnp.asarray(kv),
+                                     mask)
+        kv2 = kv.copy()
+        kv2[:, 3:] = 99.0
+        out2 = dot_product_attention(q, jnp.asarray(kv2),
+                                     jnp.asarray(kv2), mask)
+        np.testing.assert_allclose(np.asarray(out1), np.asarray(out2),
+                                   atol=1e-6)
+
+
+class TestAttentionLayers:
+    @pytest.mark.parametrize("layer,factor", [
+        (SelfAttentionLayer(n_out=16, n_heads=2), 0.5),
+        # unprojected: no attention params, only the head learns
+        (SelfAttentionLayer(n_heads=1, project_input=False), 0.85),
+        (LearnedSelfAttentionLayer(n_out=16, n_heads=2, n_queries=4), 0.5),
+        (RecurrentAttentionLayer(n_out=16, n_heads=2), 0.5),
+    ])
+    def test_learns_sequence_classification(self, layer, factor):
+        x, y = _seq_cls_data()
+        net = _attn_net(layer)
+        first = None
+        for i in range(80):
+            net.fit(x, y)
+            if first is None:
+                first = net.score()
+        assert net.score() < first * factor, \
+            f"{type(layer).__name__}: {first} -> {net.score()}"
+
+    def test_self_attention_output_shape(self):
+        x, _ = _seq_cls_data(n=4, t=10)
+        lay = SelfAttentionLayer(n_in=8, n_out=16, n_heads=4)
+        p = lay.init_params(jax.random.PRNGKey(0), InputTypeRecurrent(8))
+        y, _ = lay.forward(p, jnp.asarray(x), training=False)
+        assert y.shape == (4, 10, 16)
+
+    def test_learned_queries_fixed_output_length(self):
+        lay = LearnedSelfAttentionLayer(n_in=8, n_out=16, n_heads=2,
+                                        n_queries=3)
+        p = lay.init_params(jax.random.PRNGKey(0), InputTypeRecurrent(8))
+        for t in (5, 9):
+            x = jnp.zeros((2, t, 8))
+            y, _ = lay.forward(p, x, training=False)
+            assert y.shape == (2, 3, 16)
+        ot = lay.get_output_type(InputTypeRecurrent(8, 9))
+        assert ot.timesteps == 3 and ot.size == 16
+
+    def test_recurrent_attention_is_stateful_sequence_map(self):
+        lay = RecurrentAttentionLayer(n_in=8, n_out=16, n_heads=2)
+        p = lay.init_params(jax.random.PRNGKey(0), InputTypeRecurrent(8))
+        x = jnp.asarray(np.random.RandomState(0)
+                        .randn(2, 7, 8), jnp.float32)
+        y, st = lay.forward(p, x, training=False)
+        assert y.shape == (2, 7, 16)
+        np.testing.assert_allclose(np.asarray(st["h"]),
+                                   np.asarray(y[:, -1]), atol=1e-6)
+
+    def test_mask_isolates_padded_steps(self):
+        """Output at unmasked steps is unchanged by padded-step values."""
+        for lay in (SelfAttentionLayer(n_in=8, n_out=8, n_heads=2),
+                    RecurrentAttentionLayer(n_in=8, n_out=8, n_heads=2)):
+            p = lay.init_params(jax.random.PRNGKey(0),
+                                InputTypeRecurrent(8))
+            rng = np.random.RandomState(3)
+            x = rng.randn(2, 6, 8).astype(np.float32)
+            mask = jnp.asarray(np.array([[1, 1, 1, 1, 0, 0],
+                                         [1, 1, 0, 0, 0, 0]],
+                                        np.float32))
+            y1, _ = lay.forward(p, jnp.asarray(x), training=False,
+                                mask=mask)
+            x2 = x.copy()
+            x2[0, 4:] = 7.0
+            x2[1, 2:] = -3.0
+            y2, _ = lay.forward(p, jnp.asarray(x2), training=False,
+                                mask=mask)
+            np.testing.assert_allclose(np.asarray(y1[0, :4]),
+                                       np.asarray(y2[0, :4]), atol=1e-5)
+            np.testing.assert_allclose(np.asarray(y1[1, :2]),
+                                       np.asarray(y2[1, :2]), atol=1e-5)
+
+    def test_json_round_trip(self):
+        from deeplearning4j_tpu.nn.conf.layers import Layer
+        for lay in (SelfAttentionLayer(n_in=8, n_out=16, n_heads=2),
+                    LearnedSelfAttentionLayer(n_in=8, n_out=16,
+                                              n_queries=4),
+                    RecurrentAttentionLayer(n_in=8, n_out=16)):
+            lay2 = Layer.from_map(lay.to_map())
+            assert lay2 == lay
+
+    def test_gradcheck_self_attention(self):
+        """Analytic vs numeric gradients (reference:
+        AttentionLayerTest gradient checks, SURVEY.md 4.5)."""
+        lay = SelfAttentionLayer(n_in=4, n_out=4, n_heads=2)
+        p = lay.init_params(jax.random.PRNGKey(0), InputTypeRecurrent(4))
+        x = jnp.asarray(np.random.RandomState(0).randn(2, 5, 4),
+                        jnp.float64 if jax.config.read("jax_enable_x64")
+                        else jnp.float32)
+
+        def loss(params):
+            y, _ = lay.forward(params, x, training=False)
+            return jnp.sum(y ** 2)
+
+        g = jax.grad(loss)(p)
+        eps = 1e-3
+        for name in ("Wq", "Wo"):
+            w = np.asarray(p[name]).copy()
+            idx = (0, 1)
+            for sgn in (1,):
+                w_p, w_m = w.copy(), w.copy()
+                w_p[idx] += eps
+                w_m[idx] -= eps
+                lp = loss({**p, name: jnp.asarray(w_p)})
+                lm = loss({**p, name: jnp.asarray(w_m)})
+                num = (lp - lm) / (2 * eps)
+                ana = np.asarray(g[name])[idx]
+                assert abs(num - ana) / max(abs(num), 1e-3) < 5e-2
+
+
+class TestAttentionInRnnPipeline:
+    def test_attention_between_rnn_and_output(self):
+        """Self-attention composes with RnnOutputLayer (per-step)."""
+        x, _ = _seq_cls_data(n=8, t=6)
+        y = np.eye(2, dtype=np.float32)[
+            (x[:, :, 0] > 0).astype(int)]
+        conf = (NeuralNetConfiguration.Builder()
+                .seed(3).updater(Adam(1e-2)).list()
+                .layer(SelfAttentionLayer(n_out=16, n_heads=2))
+                .layer(RnnOutputLayer(
+                    n_out=2, loss_function=LossFunction.MCXENT,
+                    activation=Activation.SOFTMAX))
+                .set_input_type(InputType.recurrent(8))
+                .build())
+        net = MultiLayerNetwork(conf).init()
+        out = net.output(x)
+        assert out.shape == (8, 6, 2)
+        for _ in range(30):
+            net.fit(x, y)
+        assert np.isfinite(net.score())

@@ -234,3 +234,48 @@ def test_unknown_op_raises():
     a = sd.var("a", array=np.ones(3))
     with pytest.raises(KeyError):
         sd._op("definitely_not_an_op", [a])
+
+
+def test_constant_set_arr_invalidates_cache():
+    """set_arr on a CONSTANT must not serve stale cached executions."""
+    sd = SameDiff.create()
+    c = sd.constant("c", np.float32(1.0))
+    y = c + 1.0
+    assert float(y.eval()) == 2.0
+    c.set_arr(np.float32(5.0))
+    assert float(y.eval()) == 6.0
+
+
+def test_fit_does_not_touch_unrelated_branch():
+    """Variables outside the loss subgraph keep their values even with
+    l2 regularization configured (code-review regression)."""
+    from deeplearning4j_tpu.autodiff.training import TrainingConfig
+    sd = SameDiff.create()
+    x = sd.placeholder("x", shape=(None, 2))
+    w = sd.var("w", array=np.ones((2, 1), np.float32))
+    other = sd.var("other", array=np.full((3,), 7.0, np.float32))
+    pred = x @ w
+    lbl = sd.placeholder("y", shape=(None, 1))
+    loss = sd.loss.mean_squared_error(lbl, pred, name="loss")
+    sd.set_loss_variables([loss.name])
+    sd.set_training_config(
+        TrainingConfig(updater=Sgd(0.1), l2=0.1,
+                       data_set_feature_mapping=["x"],
+                       data_set_label_mapping=["y"]))
+    it = ListDataSetIterator([DataSet(np.ones((4, 2), np.float32),
+                                      np.zeros((4, 1), np.float32))])
+    sd.fit(it, n_epochs=1)
+    np.testing.assert_array_equal(other.get_arr(),
+                                  np.full((3,), 7.0, np.float32))
+    assert not np.allclose(w.get_arr(), np.ones((2, 1)))
+
+
+def test_nms_pads_with_minus_one():
+    from deeplearning4j_tpu.autodiff.registry import get_op
+    boxes = np.array([[0, 0, 1, 1], [0, 0, 1, 1.01]], np.float32)
+    scores = np.array([0.9, 0.8], np.float32)
+    out = np.asarray(get_op("non_max_suppression")(
+        [jnp.asarray(boxes), jnp.asarray(scores)],
+        {"max_output_size": 5, "iou_threshold": 0.5}))
+    assert out[0] == 0
+    assert all(out[1:] == -1)  # second box suppressed, rest padded
