@@ -71,6 +71,11 @@ class SelfAttentionLayer(BaseAttentionLayer):
 
     def init_params(self, key, input_type, dtype=jnp.float32):
         if not self.project_input:
+            if self.n_heads != 1:
+                raise ValueError(
+                    "SelfAttentionLayer(project_input=False) requires "
+                    f"n_heads=1, got {self.n_heads} (reference rejects "
+                    "projectInput=false with nHeads!=1)")
             return {}
         return self._proj_params(key, self.n_in, self.n_in, dtype)
 

@@ -25,6 +25,9 @@ from deeplearning4j_tpu.parallel.wrapper import ParallelWrapper
 from deeplearning4j_tpu.parallel.inference import ParallelInference
 from deeplearning4j_tpu.parallel.sharedtraining import (
     SharedTrainingConfiguration, SharedTrainingMaster)
+from deeplearning4j_tpu.parallel.sequence import (
+    blockwise_attention, flash_attention, ring_attention,
+    ring_self_attention, ulysses_attention, ulysses_self_attention)
 from deeplearning4j_tpu.parallel.encoding import (
     AdaptiveThresholdAlgorithm, EncodingHandler, FixedThresholdAlgorithm,
     ResidualClippingPostProcessor, TargetSparsityThresholdAlgorithm,
@@ -38,4 +41,7 @@ __all__ = [
     "FixedThresholdAlgorithm", "AdaptiveThresholdAlgorithm",
     "TargetSparsityThresholdAlgorithm", "ResidualClippingPostProcessor",
     "EncodingHandler", "encode_threshold", "decode_threshold",
+    "blockwise_attention", "flash_attention", "ring_attention",
+    "ring_self_attention", "ulysses_attention",
+    "ulysses_self_attention",
 ]

@@ -1,0 +1,353 @@
+"""Sequence/context parallelism (SURVEY.md §2.6 P9, §5.7).
+
+The reference has NO sequence parallelism — long sequences are handled
+only by truncated BPTT (SURVEY.md 5.7). This module is the TPU-native
+extension that makes long-context first-class:
+
+- :func:`blockwise_attention` — memory-efficient attention: online
+  softmax over key/value blocks (`lax.scan`), O(t) activation memory
+  instead of O(t^2); exact same function as dense softmax attention.
+- :func:`flash_attention` — the same computation as a Pallas TPU
+  kernel (tiled into VMEM, MXU matmuls, fp32 accumulators); backward
+  pass recomputes via the blockwise form (flash-style recompute trades
+  FLOPs for HBM, the standard TPU tradeoff).
+- :func:`ring_attention` — context parallelism over a mesh ``seq``
+  axis: Q/K/V sharded along time; K/V blocks rotate around the ring
+  via ``lax.ppermute`` (ICI neighbor exchange) while each device
+  accumulates online-softmax partials. Memory per chip: O(t/n_sp).
+- :func:`ulysses_attention` — all-to-all sequence parallelism: swap
+  the sharded axis from time to heads (``lax.all_to_all``), run local
+  full-sequence attention on h/n heads, swap back.
+
+All forms compute the identical function as dense attention (up to
+float associativity), so tests compare against
+:func:`deeplearning4j_tpu.ops.attention.dot_product_attention`.
+
+Conventions: activations [batch, heads, time, head_dim]; causal masks
+use *global* positions, so sharded forms mask correctly across shards.
+"""
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+NEG_INF = -1e30
+
+
+def _shard_map(f, mesh, *, in_specs, out_specs):
+    """jax.shard_map across jax versions (experimental alias pre-0.8)."""
+    if hasattr(jax, "shard_map"):
+        return jax.shard_map(f, mesh=mesh, in_specs=in_specs,
+                             out_specs=out_specs)
+    from jax.experimental.shard_map import shard_map as _sm
+    return _sm(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs)
+
+
+# ---------------------------------------------------------------------------
+# blockwise (online-softmax) attention — pure JAX, differentiable
+# ---------------------------------------------------------------------------
+def _block_update(carry, qb, kb, vb, mask_b, scale):
+    """One online-softmax step: fold K/V block into (o, l, m)."""
+    o, l, m = carry                      # o:[...,tq,d] l,m:[...,tq]
+    s = jnp.einsum("...qd,...kd->...qk", qb, kb) * scale
+    if mask_b is not None:
+        s = jnp.where(mask_b, s, NEG_INF)
+    m_new = jnp.maximum(m, jnp.max(s, axis=-1))
+    # renormalize previous accumulator, fold in this block. exp() of
+    # masked scores must be EXACTLY 0 (not exp(NEG_INF - NEG_INF) = 1)
+    # so fully-masked rows accumulate l = 0 and finalize to zeros,
+    # matching the dense reference's fully-masked-row semantics.
+    corr = jnp.exp(m - m_new)
+    p = jnp.where(s <= NEG_INF / 2, 0.0, jnp.exp(s - m_new[..., None]))
+    l_new = l * corr + jnp.sum(p, axis=-1)
+    o_new = o * corr[..., None] + jnp.einsum("...qk,...kd->...qd", p, vb)
+    return (o_new, l_new, m_new)
+
+
+def _finalize(o, l):
+    return o / jnp.maximum(l, 1e-30)[..., None]
+
+
+def blockwise_attention(q, k, v, *, causal: bool = False,
+                        block_k: int = 256,
+                        q_offset=0, k_offset=0,
+                        key_mask: Optional[jax.Array] = None):
+    """Exact attention with O(t) memory via online softmax.
+
+    q: [..., tq, d]; k/v: [..., tk, d]; key_mask: [..., tk] (0=masked).
+    ``q_offset``/``k_offset`` are the global positions of element 0 —
+    the hook ring attention uses for cross-shard causal masking.
+    """
+    tq, d = q.shape[-2], q.shape[-1]
+    tk = k.shape[-2]
+    scale = 1.0 / (d ** 0.5)
+    block_k = min(block_k, tk)
+    n_blocks = -(-tk // block_k)
+    pad = n_blocks * block_k - tk
+    if pad:
+        kp = jnp.pad(k, [(0, 0)] * (k.ndim - 2) + [(0, pad), (0, 0)])
+        vp = jnp.pad(v, [(0, 0)] * (v.ndim - 2) + [(0, pad), (0, 0)])
+        km = jnp.pad(key_mask if key_mask is not None else
+                     jnp.ones(k.shape[:-1], bool),
+                     [(0, 0)] * (k.ndim - 2) + [(0, pad)])
+    else:
+        kp, vp, km = k, v, key_mask
+
+    q_pos = q_offset + jnp.arange(tq)
+
+    def scan_body(carry, i):
+        s = i * block_k
+        kb = lax.dynamic_slice_in_dim(kp, s, block_k, axis=-2)
+        vb = lax.dynamic_slice_in_dim(vp, s, block_k, axis=-2)
+        k_pos = k_offset + s + jnp.arange(block_k)
+        mask_b = None
+        if causal:
+            mask_b = q_pos[:, None] >= k_pos[None, :]
+        if km is not None:
+            kmb = lax.dynamic_slice_in_dim(km, s, block_k, axis=-1)
+            kmb = kmb[..., None, :]
+            mask_b = kmb if mask_b is None else (mask_b & (kmb > 0))
+        return _block_update(carry, q, kb, vb, mask_b, scale), None
+
+    # carry derived from q so it inherits q's varying-manual-axes when
+    # called inside shard_map (e.g. the Ulysses local attention)
+    o0 = (q * 0).astype(jnp.promote_types(q.dtype, jnp.float32))
+    l0 = o0[..., 0]
+    m0 = l0 + NEG_INF
+    (o, l, _), _ = lax.scan(scan_body, (o0, l0, m0),
+                            jnp.arange(n_blocks))
+    return _finalize(o, l).astype(q.dtype)
+
+
+# ---------------------------------------------------------------------------
+# Pallas flash-attention kernel (TPU)
+# ---------------------------------------------------------------------------
+def _flash_kernel(q_ref, k_ref, v_ref, o_ref, o_acc, l_acc, m_acc, *,
+                  n_kb: int, causal: bool, scale: float):
+    """One (bh, iq, jk) grid cell: fold K/V block jk into the online-
+    softmax accumulator for query block iq. Only [block, d] slabs are
+    VMEM-resident — K/V stream through the grid (O(block) VMEM).
+    Accumulators live in VMEM scratch, which persists across the
+    innermost (jk) grid dimension; l/m are stored lane-replicated
+    (block_q, 128) to respect the (8, 128) VPU tile."""
+    import jax.experimental.pallas as pl
+
+    block_q, d = q_ref.shape
+    block_k = k_ref.shape[0]
+    iq = pl.program_id(1)
+    jk = pl.program_id(2)
+
+    @pl.when(jk == 0)
+    def _init():
+        o_acc[:] = jnp.zeros_like(o_acc)
+        l_acc[:] = jnp.zeros_like(l_acc)
+        m_acc[:] = jnp.full_like(m_acc, NEG_INF)
+
+    def _update():
+        q = q_ref[:].astype(jnp.float32)
+        kb = k_ref[:].astype(jnp.float32)
+        vb = v_ref[:].astype(jnp.float32)
+        s = jax.lax.dot_general(q, kb, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32)
+        s = s * scale
+        if causal:
+            q_pos = iq * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0)
+            k_pos = jk * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1)
+            s = jnp.where(q_pos >= k_pos, s, NEG_INF)
+        m_prev = m_acc[:, :1]
+        l_prev = l_acc[:, :1]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
+        corr = jnp.exp(m_prev - m_new)
+        p = jnp.where(s <= NEG_INF / 2, 0.0, jnp.exp(s - m_new))
+        l_new = l_prev * corr + jnp.sum(p, axis=-1, keepdims=True)
+        o_acc[:] = o_acc[:] * corr + jax.lax.dot_general(
+            p, vb, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        m_acc[:] = m_new + jnp.zeros_like(m_acc)
+        l_acc[:] = l_new + jnp.zeros_like(l_acc)
+
+    if causal:
+        # skip key blocks entirely in the masked future (~2x FLOPs)
+        @pl.when((iq + 1) * block_q > jk * block_k)
+        def _():
+            _update()
+    else:
+        _update()
+
+    @pl.when(jk == n_kb - 1)
+    def _finalize_out():
+        l = jnp.maximum(l_acc[:, :1], 1e-30)
+        o_ref[:] = (o_acc[:] / l).astype(o_ref.dtype)
+
+
+def _flash_forward(q, k, v, causal: bool, block_q: int, block_k: int,
+                   interpret: bool):
+    import jax.experimental.pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    b, h, tq, d = q.shape
+    tk = k.shape[2]
+    scale = 1.0 / (d ** 0.5)
+    block_q = min(block_q, tq)
+    block_k = min(block_k, tk)
+    if tq % block_q or tk % block_k:
+        raise ValueError(f"seq lens ({tq},{tk}) must divide blocks "
+                         f"({block_q},{block_k})")
+    n_kb = tk // block_k
+    qr = q.reshape(b * h, tq, d)
+    kr = k.reshape(b * h, tk, d)
+    vr = v.reshape(b * h, tk, d)
+
+    kernel = functools.partial(_flash_kernel, n_kb=n_kb, causal=causal,
+                               scale=scale)
+    out = pl.pallas_call(
+        kernel,
+        grid=(b * h, tq // block_q, n_kb),
+        in_specs=[
+            pl.BlockSpec((None, block_q, d),
+                         lambda bh, iq, jk: (bh, iq, 0)),
+            pl.BlockSpec((None, block_k, d),
+                         lambda bh, iq, jk: (bh, jk, 0)),
+            pl.BlockSpec((None, block_k, d),
+                         lambda bh, iq, jk: (bh, jk, 0)),
+        ],
+        out_specs=pl.BlockSpec((None, block_q, d),
+                               lambda bh, iq, jk: (bh, iq, 0)),
+        out_shape=jax.ShapeDtypeStruct((b * h, tq, d), q.dtype),
+        scratch_shapes=[
+            pltpu.VMEM((block_q, d), jnp.float32),
+            pltpu.VMEM((block_q, 128), jnp.float32),
+            pltpu.VMEM((block_q, 128), jnp.float32),
+        ],
+        interpret=interpret,
+    )(qr, kr, vr)
+    return out.reshape(b, h, tq, d)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+def flash_attention(q, k, v, causal: bool = False, block_q: int = 128,
+                    block_k: int = 128, interpret: Optional[bool] = None):
+    """Fused attention kernel, [b, h, t, d]. Equals dense softmax
+    attention; O(block) VMEM. Backward = flash-style recompute through
+    :func:`blockwise_attention` (jax.grad-differentiable)."""
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    return _flash_forward(q, k, v, causal, block_q, block_k, interpret)
+
+
+def _flash_fwd(q, k, v, causal, block_q, block_k, interpret):
+    out = flash_attention(q, k, v, causal, block_q, block_k, interpret)
+    return out, (q, k, v)
+
+
+def _flash_bwd(causal, block_q, block_k, interpret, res, g):
+    q, k, v = res
+    _, vjp = jax.vjp(
+        lambda q, k, v: blockwise_attention(q, k, v, causal=causal,
+                                            block_k=block_k), q, k, v)
+    return vjp(g)
+
+
+flash_attention.defvjp(_flash_fwd, _flash_bwd)
+
+
+# ---------------------------------------------------------------------------
+# ring attention — context parallelism over a mesh axis
+# ---------------------------------------------------------------------------
+def ring_attention(q, k, v, axis_name: str, *, causal: bool = False,
+                   block_k: int = 256):
+    """Attention with Q/K/V sharded along time over ``axis_name``.
+
+    Call INSIDE ``shard_map``: q/k/v are the local shards
+    [b, h, t_local, d]. K/V shards rotate around the ring with
+    ``lax.ppermute`` (neighbor ICI hop per step) while each device
+    folds the visiting block into its online-softmax accumulator —
+    t_local^2 compute per step, O(t_local) memory, n_sp steps.
+    Causal masking uses global positions so the result equals dense
+    causal attention on the gathered sequence.
+    """
+    n_sp = lax.psum(1, axis_name)
+    my = lax.axis_index(axis_name)
+    t_local = q.shape[-2]
+    scale = 1.0 / (q.shape[-1] ** 0.5)
+    q_pos = my * t_local + jnp.arange(t_local)
+
+    # derive the carry from q so it carries q's varying-manual-axes
+    # (jax>=0.8 shard_map type-checks vma through scan carries)
+    acc_dt = jnp.promote_types(q.dtype, jnp.float32)
+    o0 = (q * 0).astype(acc_dt)
+    l0 = o0[..., 0]
+    m0 = l0 + NEG_INF
+
+    def step(carry, s):
+        (o, l, m), (kb, vb) = carry
+        src = (my - s) % n_sp              # who produced this block
+        mask = None
+        if causal:
+            k_pos = src * t_local + jnp.arange(t_local)
+            mask = q_pos[:, None] >= k_pos[None, :]
+        acc = _block_update((o, l, m), q, kb, vb, mask, scale)
+        # rotate: send our current block to the next device in the ring
+        perm = [(i, (i + 1) % n_sp) for i in range(n_sp)]
+        kb = lax.ppermute(kb, axis_name, perm)
+        vb = lax.ppermute(vb, axis_name, perm)
+        return (acc, (kb, vb)), None
+
+    (acc, _), _ = lax.scan(step, ((o0, l0, m0), (k, v)),
+                           jnp.arange(n_sp))
+    o, l, _ = acc
+    return _finalize(o, l).astype(q.dtype)
+
+
+def _seq_sharded_call(local_fn, mesh, q, k, v, seq_axis, causal):
+    """Common shard_map plumbing: q/k/v are GLOBAL [b, h, t, d] arrays;
+    time sharded over ``seq_axis``, batch over ``data`` when present."""
+    from jax.sharding import PartitionSpec as P
+
+    data = "data" if "data" in mesh.axis_names else None
+    spec = P(data, None, seq_axis, None)
+    fn = _shard_map(
+        functools.partial(local_fn, axis_name=seq_axis, causal=causal),
+        mesh, in_specs=(spec, spec, spec), out_specs=spec)
+    return fn(q, k, v)
+
+
+def ring_self_attention(mesh, q, k, v, *, seq_axis: str = "seq",
+                        causal: bool = False):
+    return _seq_sharded_call(ring_attention, mesh, q, k, v, seq_axis,
+                             causal)
+
+
+# ---------------------------------------------------------------------------
+# Ulysses — all-to-all sequence parallelism
+# ---------------------------------------------------------------------------
+def ulysses_attention(q, k, v, axis_name: str, *, causal: bool = False,
+                      block_k: int = 256):
+    """DeepSpeed-Ulysses-style SP. Call INSIDE shard_map with
+    [b, h, t_local, d] shards, h divisible by the axis size: all-to-all
+    re-shards time->heads, local attention sees the FULL sequence for
+    h/n heads, then all-to-all back. Two collectives total; better
+    ICI utilisation than a ring when h >= n_sp."""
+    # [b, h, t/n, d] -> [b, h/n, t, d]
+    qh = lax.all_to_all(q, axis_name, split_axis=1, concat_axis=2,
+                        tiled=True)
+    kh = lax.all_to_all(k, axis_name, split_axis=1, concat_axis=2,
+                        tiled=True)
+    vh = lax.all_to_all(v, axis_name, split_axis=1, concat_axis=2,
+                        tiled=True)
+    o = blockwise_attention(qh, kh, vh, causal=causal, block_k=block_k)
+    # [b, h/n, t, d] -> [b, h, t/n, d]
+    return lax.all_to_all(o, axis_name, split_axis=2, concat_axis=1,
+                          tiled=True)
+
+
+def ulysses_self_attention(mesh, q, k, v, *, seq_axis: str = "seq",
+                           causal: bool = False):
+    return _seq_sharded_call(ulysses_attention, mesh, q, k, v, seq_axis,
+                             causal)

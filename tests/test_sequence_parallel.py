@@ -1,0 +1,154 @@
+"""Sequence/context parallelism tests (SURVEY.md P9/§5.7 extension).
+
+Every sharded/blocked attention form must equal dense softmax
+attention (ops.attention.dot_product_attention) on gathered data.
+Runs on the virtual 8-device CPU mesh (conftest)."""
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.ops.attention import dot_product_attention
+from deeplearning4j_tpu.parallel import make_mesh
+from deeplearning4j_tpu.parallel.sequence import (
+    blockwise_attention, flash_attention, ring_attention,
+    ring_self_attention, ulysses_self_attention)
+
+
+def _qkv(b=2, h=4, t=64, d=16, seed=0):
+    rng = np.random.RandomState(seed)
+    mk = lambda: jnp.asarray(rng.randn(b, h, t, d).astype(np.float32))
+    return mk(), mk(), mk()
+
+
+def _dense(q, k, v, causal=False):
+    mask = None
+    if causal:
+        t = q.shape[-2]
+        mask = jnp.tril(jnp.ones((t, t), bool))
+    return dot_product_attention(q, k, v, mask)
+
+
+class TestBlockwise:
+    @pytest.mark.parametrize("causal", [False, True])
+    @pytest.mark.parametrize("block_k", [16, 24, 64])
+    def test_matches_dense(self, causal, block_k):
+        q, k, v = _qkv()
+        out = blockwise_attention(q, k, v, causal=causal,
+                                  block_k=block_k)
+        np.testing.assert_allclose(np.asarray(out),
+                                   np.asarray(_dense(q, k, v, causal)),
+                                   atol=2e-5)
+
+    def test_key_mask(self):
+        q, k, v = _qkv(t=32)
+        km = jnp.asarray((np.arange(32) < 20)[None, None, :]
+                         * np.ones((2, 4, 1)), jnp.float32)
+        out = blockwise_attention(q, k, v, key_mask=km, block_k=16)
+        ref = dot_product_attention(q, k, v, km[..., None, :])
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   atol=2e-5)
+
+    def test_grad_matches_dense(self):
+        q, k, v = _qkv(b=1, h=2, t=32, d=8)
+
+        def loss_block(q, k, v):
+            return jnp.sum(blockwise_attention(q, k, v, causal=True,
+                                               block_k=16) ** 2)
+
+        def loss_dense(q, k, v):
+            return jnp.sum(_dense(q, k, v, True) ** 2)
+
+        g1 = jax.grad(loss_block, argnums=(0, 1, 2))(q, k, v)
+        g2 = jax.grad(loss_dense, argnums=(0, 1, 2))(q, k, v)
+        for a, b in zip(g1, g2):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       atol=5e-5)
+
+
+class TestFlashAttention:
+    @pytest.mark.parametrize("causal", [False, True])
+    def test_matches_dense(self, causal):
+        q, k, v = _qkv(t=256, d=32)
+        out = flash_attention(q, k, v, causal, 128, 128)
+        np.testing.assert_allclose(np.asarray(out),
+                                   np.asarray(_dense(q, k, v, causal)),
+                                   atol=2e-5)
+
+    def test_grad_flows(self):
+        q, k, v = _qkv(b=1, h=1, t=128, d=16)
+
+        def loss(q, k, v):
+            return jnp.sum(flash_attention(q, k, v, True) ** 2)
+
+        def loss_dense(q, k, v):
+            return jnp.sum(_dense(q, k, v, True) ** 2)
+
+        g1 = jax.grad(loss, argnums=(0, 1, 2))(q, k, v)
+        g2 = jax.grad(loss_dense, argnums=(0, 1, 2))(q, k, v)
+        for a, b in zip(g1, g2):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       atol=5e-5)
+
+    def test_rejects_indivisible_lengths(self):
+        q, k, v = _qkv(t=48)
+        with pytest.raises(ValueError):
+            flash_attention(q, k, v, False, 32, 32, True)
+
+
+class TestRingAttention:
+    @pytest.mark.parametrize("causal", [False, True])
+    def test_matches_dense_over_mesh(self, causal):
+        mesh = make_mesh({"seq": 8})
+        q, k, v = _qkv(t=64)
+        out = ring_self_attention(mesh, q, k, v, causal=causal)
+        np.testing.assert_allclose(np.asarray(out),
+                                   np.asarray(_dense(q, k, v, causal)),
+                                   atol=2e-5)
+
+    def test_with_data_axis(self):
+        mesh = make_mesh({"data": 2, "seq": 4})
+        q, k, v = _qkv(b=4, t=32)
+        out = ring_self_attention(mesh, q, k, v, causal=True)
+        np.testing.assert_allclose(np.asarray(out),
+                                   np.asarray(_dense(q, k, v, True)),
+                                   atol=2e-5)
+
+    def test_grad_through_ring(self):
+        mesh = make_mesh({"seq": 4}, jax.devices()[:4])
+        q, k, v = _qkv(b=1, h=2, t=32, d=8)
+
+        def loss(q, k, v):
+            return jnp.sum(ring_self_attention(mesh, q, k, v,
+                                               causal=True) ** 2)
+
+        def loss_dense(q, k, v):
+            return jnp.sum(_dense(q, k, v, True) ** 2)
+
+        g1 = jax.grad(loss, argnums=(0, 1, 2))(q, k, v)
+        g2 = jax.grad(loss_dense, argnums=(0, 1, 2))(q, k, v)
+        for a, b in zip(g1, g2):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       atol=5e-5)
+
+
+class TestUlysses:
+    @pytest.mark.parametrize("causal", [False, True])
+    def test_matches_dense_over_mesh(self, causal):
+        mesh = make_mesh({"seq": 4}, jax.devices()[:4])  # h=4 % 4 == 0
+        q, k, v = _qkv(t=64)
+        out = ulysses_self_attention(mesh, q, k, v, causal=causal)
+        np.testing.assert_allclose(np.asarray(out),
+                                   np.asarray(_dense(q, k, v, causal)),
+                                   atol=2e-5)
+
+    def test_fully_masked_rows_are_zero(self):
+        """Fully-masked rows must be 0 like the dense reference, not
+        mean(V) (code-review regression)."""
+        q, k, v = _qkv(b=1, h=1, t=16, d=8)
+        km = jnp.zeros((1, 1, 16))         # everything masked
+        out = blockwise_attention(q, k, v, key_mask=km, block_k=8)
+        np.testing.assert_array_equal(np.asarray(out),
+                                      np.zeros_like(out))
