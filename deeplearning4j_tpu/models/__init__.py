@@ -1,2 +1,4 @@
 from deeplearning4j_tpu.models.zoo import (  # noqa: F401
     ZooModel, LeNet, SimpleCNN, VGG16, VGG19, ResNet50, AlexNet)
+from deeplearning4j_tpu.models.bert import (  # noqa: F401
+    Bert, BertConfig, BertForSequenceClassification)

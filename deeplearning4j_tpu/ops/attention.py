@@ -18,8 +18,12 @@ import jax.numpy as jnp
 NEG_INF = -1e9
 
 
-def dot_product_attention(q, k, v, mask=None, scale=None):
-    """Scaled dot-product attention on [..., t, d] tensors."""
+def dot_product_attention(q, k, v, mask=None, scale=None,
+                          dropout_rng=None, dropout_rate=0.0):
+    """Scaled dot-product attention on [..., t, d] tensors.
+
+    ``dropout_rng``/``dropout_rate``: attention-probability dropout
+    (applied to the post-softmax weights, TF/HF BERT style)."""
     if scale is None:
         scale = 1.0 / (q.shape[-1] ** 0.5)
     scores = jnp.einsum("...qd,...kd->...qk", q, k) * scale
@@ -28,6 +32,10 @@ def dot_product_attention(q, k, v, mask=None, scale=None):
     w = jax.nn.softmax(scores, axis=-1)
     if mask is not None:
         w = jnp.where(mask > 0, w, 0.0)
+    if dropout_rng is not None and dropout_rate > 0.0:
+        keep = jax.random.bernoulli(dropout_rng, 1.0 - dropout_rate,
+                                    w.shape)
+        w = jnp.where(keep, w / (1.0 - dropout_rate), 0.0)
     return jnp.einsum("...qk,...kd->...qd", w, v)
 
 

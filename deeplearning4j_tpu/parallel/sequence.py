@@ -126,16 +126,22 @@ def blockwise_attention(q, k, v, *, causal: bool = False,
 # ---------------------------------------------------------------------------
 # Pallas flash-attention kernel (TPU)
 # ---------------------------------------------------------------------------
-def _flash_kernel(q_ref, k_ref, v_ref, o_ref, o_acc, l_acc, m_acc, *,
-                  n_kb: int, causal: bool, scale: float):
+def _flash_kernel(q_ref, k_ref, v_ref, *rest, n_kb: int, causal: bool,
+                  scale: float, has_mask: bool):
     """One (bh, iq, jk) grid cell: fold K/V block jk into the online-
     softmax accumulator for query block iq. Only [block, d] slabs are
     VMEM-resident — K/V stream through the grid (O(block) VMEM).
     Accumulators live in VMEM scratch, which persists across the
     innermost (jk) grid dimension; l/m are stored lane-replicated
-    (block_q, 128) to respect the (8, 128) VPU tile."""
+    (block_q, 128) to respect the (8, 128) VPU tile. Optional key
+    mask streams as a (1, block_k) slab per key block."""
     import jax.experimental.pallas as pl
 
+    if has_mask:
+        mask_ref, o_ref, o_acc, l_acc, m_acc = rest
+    else:
+        o_ref, o_acc, l_acc, m_acc = rest
+        mask_ref = None
     block_q, d = q_ref.shape
     block_k = k_ref.shape[0]
     iq = pl.program_id(1)
@@ -160,6 +166,8 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, o_acc, l_acc, m_acc, *,
             k_pos = jk * block_k + jax.lax.broadcasted_iota(
                 jnp.int32, (block_q, block_k), 1)
             s = jnp.where(q_pos >= k_pos, s, NEG_INF)
+        if mask_ref is not None:
+            s = jnp.where(mask_ref[:1, :] > 0, s, NEG_INF)
         m_prev = m_acc[:, :1]
         l_prev = l_acc[:, :1]
         m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
@@ -186,8 +194,8 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, o_acc, l_acc, m_acc, *,
         o_ref[:] = (o_acc[:] / l).astype(o_ref.dtype)
 
 
-def _flash_forward(q, k, v, causal: bool, block_q: int, block_k: int,
-                   interpret: bool):
+def _flash_forward(q, k, v, key_mask, causal: bool, block_q: int,
+                   block_k: int, interpret: bool):
     import jax.experimental.pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
@@ -203,20 +211,34 @@ def _flash_forward(q, k, v, causal: bool, block_q: int, block_k: int,
     qr = q.reshape(b * h, tq, d)
     kr = k.reshape(b * h, tk, d)
     vr = v.reshape(b * h, tk, d)
+    has_mask = key_mask is not None
 
     kernel = functools.partial(_flash_kernel, n_kb=n_kb, causal=causal,
-                               scale=scale)
+                               scale=scale, has_mask=has_mask)
+    in_specs = [
+        pl.BlockSpec((None, block_q, d),
+                     lambda bh, iq, jk: (bh, iq, 0)),
+        pl.BlockSpec((None, block_k, d),
+                     lambda bh, iq, jk: (bh, jk, 0)),
+        pl.BlockSpec((None, block_k, d),
+                     lambda bh, iq, jk: (bh, jk, 0)),
+    ]
+    inputs = [qr, kr, vr]
+    if has_mask:
+        # [b, tk] key mask broadcast to (b*h, 1, tk): a (1, block_k)
+        # VMEM slab per key block (sublane dim 1 == full array dim, the
+        # only sub-8 block shape Mosaic accepts); XLA materializes the
+        # broadcast lazily so HBM cost stays ~b*tk
+        km = jnp.broadcast_to(
+            key_mask.astype(jnp.float32)[:, None, None, :],
+            (b, h, 1, tk)).reshape(b * h, 1, tk)
+        inputs.append(km)
+        in_specs.append(pl.BlockSpec((None, 1, block_k),
+                                     lambda bh, iq, jk: (bh, 0, jk)))
     out = pl.pallas_call(
         kernel,
         grid=(b * h, tq // block_q, n_kb),
-        in_specs=[
-            pl.BlockSpec((None, block_q, d),
-                         lambda bh, iq, jk: (bh, iq, 0)),
-            pl.BlockSpec((None, block_k, d),
-                         lambda bh, iq, jk: (bh, jk, 0)),
-            pl.BlockSpec((None, block_k, d),
-                         lambda bh, iq, jk: (bh, jk, 0)),
-        ],
+        in_specs=in_specs,
         out_specs=pl.BlockSpec((None, block_q, d),
                                lambda bh, iq, jk: (bh, iq, 0)),
         out_shape=jax.ShapeDtypeStruct((b * h, tq, d), q.dtype),
@@ -226,32 +248,39 @@ def _flash_forward(q, k, v, causal: bool, block_q: int, block_k: int,
             pltpu.VMEM((block_q, 128), jnp.float32),
         ],
         interpret=interpret,
-    )(qr, kr, vr)
+    )(*inputs)
     return out.reshape(b, h, tq, d)
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
 def flash_attention(q, k, v, causal: bool = False, block_q: int = 128,
-                    block_k: int = 128, interpret: Optional[bool] = None):
+                    block_k: int = 128,
+                    interpret: Optional[bool] = None, key_mask=None):
     """Fused attention kernel, [b, h, t, d]. Equals dense softmax
-    attention; O(block) VMEM. Backward = flash-style recompute through
+    attention; O(block) VMEM. ``key_mask``: [b, tk], 0 = masked.
+    Backward = flash-style recompute through
     :func:`blockwise_attention` (jax.grad-differentiable)."""
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
-    return _flash_forward(q, k, v, causal, block_q, block_k, interpret)
+    return _flash_forward(q, k, v, key_mask, causal, block_q, block_k,
+                          interpret)
 
 
-def _flash_fwd(q, k, v, causal, block_q, block_k, interpret):
-    out = flash_attention(q, k, v, causal, block_q, block_k, interpret)
-    return out, (q, k, v)
+def _flash_fwd(q, k, v, causal, block_q, block_k, interpret,
+               key_mask=None):
+    out = flash_attention(q, k, v, causal, block_q, block_k, interpret,
+                          key_mask)
+    return out, (q, k, v, key_mask)
 
 
 def _flash_bwd(causal, block_q, block_k, interpret, res, g):
-    q, k, v = res
+    q, k, v, key_mask = res
+    km = None if key_mask is None else key_mask[:, None, :]
     _, vjp = jax.vjp(
         lambda q, k, v: blockwise_attention(q, k, v, causal=causal,
-                                            block_k=block_k), q, k, v)
-    return vjp(g)
+                                            block_k=block_k,
+                                            key_mask=km), q, k, v)
+    return vjp(g) + (None,)      # no cotangent for the mask
 
 
 flash_attention.defvjp(_flash_fwd, _flash_bwd)

@@ -1,0 +1,120 @@
+"""BERT family tests (BASELINE config #4's model class; reference gets
+BERT via SameDiff TF import + BertIterator, SURVEY.md S6/D16)."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.learning.updaters import Adam
+from deeplearning4j_tpu.models.bert import (Bert, BertConfig,
+                                            BertForSequenceClassification)
+
+
+def _mlm_batch(n=16, t=32, vocab=1000, seed=0, mask_id=3):
+    """Synthetic copy task: mask 15% of tokens, predict them."""
+    rng = np.random.RandomState(seed)
+    # learnable structure: token at i+1 == token at i + 1 (mod small set)
+    base = rng.randint(10, 30, size=(n, 1))
+    ids = (base + np.arange(t)[None, :]) % 20 + 10
+    labels = np.full((n, t), -1, np.int64)
+    mask_pos = rng.rand(n, t) < 0.15
+    labels[mask_pos] = ids[mask_pos]
+    inp = ids.copy()
+    inp[mask_pos] = mask_id
+    return {
+        "input_ids": inp.astype(np.int32),
+        "token_type_ids": np.zeros((n, t), np.int32),
+        "attention_mask": np.ones((n, t), np.float32),
+        "mlm_labels": labels,
+        "nsp_labels": rng.randint(0, 2, n).astype(np.int32),
+    }
+
+
+class TestBertEncoder:
+    def test_output_shapes(self):
+        c = BertConfig.tiny()
+        bert = Bert(c).init()
+        ids = np.zeros((2, 16), np.int32)
+        seq, pooled = bert.output(ids)
+        assert seq.shape == (2, 16, c.hidden_size)
+        assert pooled.shape == (2, c.hidden_size)
+
+    def test_attention_mask_isolates_padding(self):
+        bert = Bert(BertConfig.tiny()).init()
+        rng = np.random.RandomState(0)
+        ids = rng.randint(10, 100, (2, 16)).astype(np.int32)
+        am = np.ones((2, 16), np.float32)
+        am[:, 12:] = 0.0
+        seq1, _ = bert.output(ids, attention_mask=am)
+        ids2 = ids.copy()
+        ids2[:, 12:] = 999       # change padded tokens
+        seq2, _ = bert.output(ids2, attention_mask=am)
+        np.testing.assert_allclose(np.asarray(seq1[:, :12]),
+                                   np.asarray(seq2[:, :12]), atol=1e-5)
+
+    def test_pretraining_learns(self):
+        bert = Bert(BertConfig.tiny(), updater=Adam(1e-3)).init()
+        batch = _mlm_batch()
+        first = bert.fit_batch(batch)
+        for _ in range(60):
+            loss = bert.fit_batch(batch)
+        assert loss < first * 0.5, f"{first} -> {loss}"
+
+    def test_remat_matches_plain(self):
+        ids = np.arange(32, dtype=np.int32).reshape(2, 16) + 10
+        b1 = Bert(BertConfig.tiny(remat=False), seed=5).init()
+        b2 = Bert(BertConfig.tiny(remat=True), seed=5).init()
+        s1, _ = b1.output(ids)
+        s2, _ = b2.output(ids)
+        np.testing.assert_allclose(np.asarray(s1), np.asarray(s2),
+                                   atol=1e-5)
+
+    def test_bf16_compute(self):
+        c = BertConfig.tiny(compute_dtype="bfloat16")
+        bert = Bert(c).init()
+        seq, pooled = bert.output(np.zeros((2, 8), np.int32) + 11)
+        assert seq.dtype == jnp.float32      # cast back at the top
+        assert np.all(np.isfinite(np.asarray(seq)))
+        loss = bert.fit_batch(_mlm_batch(n=4, t=8))
+        assert np.isfinite(loss)
+
+    def test_flash_attention_path_matches_dense(self):
+        ids = (np.arange(256, dtype=np.int32).reshape(2, 128) % 50) + 10
+        b1 = Bert(BertConfig.tiny(use_flash_attention=False),
+                  seed=3).init()
+        b2 = Bert(BertConfig.tiny(use_flash_attention=True),
+                  seed=3).init()
+        s1, _ = b1.output(ids)       # no mask -> flash kicks in for b2
+        s2, _ = b2.output(ids)
+        np.testing.assert_allclose(np.asarray(s1), np.asarray(s2),
+                                   atol=2e-3)
+
+
+class TestBertFineTune:
+    def test_classifier_learns(self):
+        bert = Bert(BertConfig.tiny()).init()
+        clf = BertForSequenceClassification(bert, num_labels=2,
+                                            updater=Adam(1e-3))
+        rng = np.random.RandomState(0)
+        n, t = 32, 16
+        ids = rng.randint(10, 100, (n, t)).astype(np.int32)
+        labels = (ids[:, 0] > 50).astype(np.int32)
+        batch = {"input_ids": ids,
+                 "attention_mask": np.ones((n, t), np.float32),
+                 "labels": labels}
+        first = clf.fit_batch(batch)
+        for _ in range(60):
+            loss = clf.fit_batch(batch)
+        assert loss < first * 0.3, f"{first} -> {loss}"
+        acc = float(np.mean(clf.predict(ids) == labels))
+        assert acc > 0.9
+
+    def test_mlm_loss_ignores_unmasked(self):
+        bert = Bert(BertConfig.tiny()).init()
+        batch = _mlm_batch(n=4, t=8)
+        batch["mlm_labels"][:] = -1          # nothing to predict
+        batch.pop("nsp_labels")
+        loss = bert.pretrain_loss(bert.params,
+                                  {k: jnp.asarray(v)
+                                   for k, v in batch.items()})
+        assert float(loss) == 0.0

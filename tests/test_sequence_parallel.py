@@ -152,3 +152,34 @@ class TestUlysses:
         out = blockwise_attention(q, k, v, key_mask=km, block_k=8)
         np.testing.assert_array_equal(np.asarray(out),
                                       np.zeros_like(out))
+
+    def test_flash_key_mask_matches_dense(self):
+        """In-kernel key masking equals dense masked attention."""
+        q, k, v = _qkv(b=2, h=4, t=128, d=16)
+        km_np = np.ones((2, 128), np.float32)
+        km_np[0, 100:] = 0.0
+        km_np[1, 64:] = 0.0
+        km = jnp.asarray(km_np)
+        out = flash_attention(q, k, v, False, 64, 64, None, km)
+        ref = dot_product_attention(q, k, v, km[:, None, None, :])
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   atol=2e-5)
+
+    def test_flash_key_mask_grad(self):
+        q, k, v = _qkv(b=1, h=2, t=64, d=8)
+        km = jnp.asarray(np.concatenate(
+            [np.ones((1, 48)), np.zeros((1, 16))], 1), jnp.float32)
+
+        def loss(q, k, v):
+            return jnp.sum(flash_attention(q, k, v, False, 64, 64,
+                                           None, km) ** 2)
+
+        def loss_dense(q, k, v):
+            return jnp.sum(dot_product_attention(
+                q, k, v, km[:, None, None, :]) ** 2)
+
+        g1 = jax.grad(loss, argnums=(0, 1, 2))(q, k, v)
+        g2 = jax.grad(loss_dense, argnums=(0, 1, 2))(q, k, v)
+        for a, b in zip(g1, g2):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       atol=5e-5)
