@@ -1,0 +1,273 @@
+"""Image loading + augmentation (reference: ``datavec-data-image`` —
+``NativeImageLoader`` (JavaCPP-OpenCV), ``ImageRecordReader``
+(label-from-path), ``ImageTransform`` augmentations; SURVEY.md V3 —
+the ImageNet input path for ResNet-50).
+
+Decode uses Pillow when available (PNG/JPEG/...); `.npy`/`.ppm` load
+without it. Augmentations are pure-numpy HWC float32 transforms
+composable via :class:`PipelineImageTransform` — host-side work that
+overlaps device compute through the async prefetch iterator
+(datasets.iterators.AsyncDataSetIterator).
+"""
+from __future__ import annotations
+
+import os
+import random as _random
+from typing import List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from deeplearning4j_tpu.datavec.records import RecordReader
+from deeplearning4j_tpu.datavec.split import InputSplit
+from deeplearning4j_tpu.datavec.writable import (IntWritable,
+                                                 NDArrayWritable)
+
+try:
+    from PIL import Image as _PILImage
+    _HAS_PIL = True
+except Exception:                                  # pragma: no cover
+    _HAS_PIL = False
+
+
+class ImageLoader:
+    """Decode + resize to HWC float32 (reference: NativeImageLoader;
+    NHWC here — XLA:TPU's native conv layout, the reference's NCHW
+    exists only at import boundaries)."""
+
+    def __init__(self, height: int, width: int, channels: int = 3):
+        self.h, self.w, self.c = int(height), int(width), int(channels)
+
+    def load(self, path_or_array) -> np.ndarray:
+        a = self._decode(path_or_array)
+        a = self._to_channels(a)
+        if a.shape[:2] != (self.h, self.w):
+            a = _resize_bilinear(a, self.h, self.w)
+        return a.astype(np.float32)
+
+    def _decode(self, src) -> np.ndarray:
+        if isinstance(src, np.ndarray):
+            return src
+        path = str(src)
+        if path.endswith(".npy"):
+            return np.load(path)
+        if _HAS_PIL:
+            with _PILImage.open(path) as im:
+                return np.asarray(im.convert(
+                    "RGB" if self.c == 3 else "L"))
+        raise RuntimeError(f"cannot decode {path}: Pillow unavailable "
+                           "(use .npy inputs)")
+
+    def _to_channels(self, a: np.ndarray) -> np.ndarray:
+        if a.ndim == 2:
+            a = a[:, :, None]
+        if a.shape[2] != self.c:
+            if self.c == 1:
+                a = a.mean(axis=2, keepdims=True)
+            elif self.c == 3 and a.shape[2] == 1:
+                a = np.repeat(a, 3, axis=2)
+            else:
+                raise ValueError(f"cannot map {a.shape[2]} channels "
+                                 f"to {self.c}")
+        return a
+
+
+def _resize_bilinear(a: np.ndarray, h: int, w: int) -> np.ndarray:
+    """Pure-numpy bilinear resize, HWC."""
+    H, W = a.shape[:2]
+    ys = (np.arange(h) + 0.5) * H / h - 0.5
+    xs = (np.arange(w) + 0.5) * W / w - 0.5
+    y0 = np.clip(np.floor(ys).astype(int), 0, H - 1)
+    x0 = np.clip(np.floor(xs).astype(int), 0, W - 1)
+    y1 = np.clip(y0 + 1, 0, H - 1)
+    x1 = np.clip(x0 + 1, 0, W - 1)
+    wy = np.clip(ys - y0, 0, 1)[:, None, None]
+    wx = np.clip(xs - x0, 0, 1)[None, :, None]
+    a = a.astype(np.float32)
+    top = a[y0][:, x0] * (1 - wx) + a[y0][:, x1] * wx
+    bot = a[y1][:, x0] * (1 - wx) + a[y1][:, x1] * wx
+    return top * (1 - wy) + bot * wy
+
+
+# -- transforms --------------------------------------------------------------
+class ImageTransform:
+    """HWC float32 -> HWC float32 (reference: ImageTransform chain)."""
+
+    def __init__(self, random_seed: Optional[int] = None):
+        self.rng = _random.Random(random_seed)
+
+    def transform(self, img: np.ndarray) -> np.ndarray:
+        raise NotImplementedError
+
+    def __call__(self, img):
+        return self.transform(img)
+
+
+class ResizeImageTransform(ImageTransform):
+    def __init__(self, height: int, width: int, **kw):
+        super().__init__(**kw)
+        self.h, self.w = height, width
+
+    def transform(self, img):
+        return _resize_bilinear(img, self.h, self.w)
+
+
+class FlipImageTransform(ImageTransform):
+    """mode: 0 = vertical, 1 = horizontal, -1 = both, None = random
+    choice each call (reference: FlipImageTransform/OpenCV flip)."""
+
+    def __init__(self, mode: Optional[int] = 1, **kw):
+        super().__init__(**kw)
+        self.mode = mode
+
+    def transform(self, img):
+        m = self.mode
+        if m is None:
+            m = self.rng.choice([0, 1, -1])
+        if m in (1, -1):
+            img = img[:, ::-1]
+        if m in (0, -1):
+            img = img[::-1]
+        return np.ascontiguousarray(img)
+
+
+class RandomCropTransform(ImageTransform):
+    def __init__(self, height: int, width: int, **kw):
+        super().__init__(**kw)
+        self.h, self.w = height, width
+
+    def transform(self, img):
+        H, W = img.shape[:2]
+        if H < self.h or W < self.w:
+            img = _resize_bilinear(img, max(H, self.h), max(W, self.w))
+            H, W = img.shape[:2]
+        y = self.rng.randint(0, H - self.h) if H > self.h else 0
+        x = self.rng.randint(0, W - self.w) if W > self.w else 0
+        return img[y:y + self.h, x:x + self.w]
+
+
+class CropImageTransform(ImageTransform):
+    """Center crop by margins (reference: CropImageTransform)."""
+
+    def __init__(self, crop_top: int, crop_left: int, crop_bottom: int,
+                 crop_right: int, **kw):
+        super().__init__(**kw)
+        self.t, self.l = crop_top, crop_left
+        self.b, self.r = crop_bottom, crop_right
+
+    def transform(self, img):
+        H, W = img.shape[:2]
+        return img[self.t:H - self.b or None,
+                   self.l:W - self.r or None]
+
+
+class RotateImageTransform(ImageTransform):
+    """Rotate by angle degrees (bilinear, reflect-free zero fill)."""
+
+    def __init__(self, angle: float, **kw):
+        super().__init__(**kw)
+        self.angle = angle
+
+    def transform(self, img):
+        th = np.deg2rad(self.angle)
+        H, W = img.shape[:2]
+        cy, cx = (H - 1) / 2, (W - 1) / 2
+        yy, xx = np.meshgrid(np.arange(H), np.arange(W),
+                             indexing="ij")
+        ys = cy + (yy - cy) * np.cos(th) - (xx - cx) * np.sin(th)
+        xs = cx + (yy - cy) * np.sin(th) + (xx - cx) * np.cos(th)
+        y0 = np.clip(np.floor(ys).astype(int), 0, H - 1)
+        x0 = np.clip(np.floor(xs).astype(int), 0, W - 1)
+        y1 = np.clip(y0 + 1, 0, H - 1)
+        x1 = np.clip(x0 + 1, 0, W - 1)
+        wy = np.clip(ys - y0, 0, 1)[..., None]
+        wx = np.clip(xs - x0, 0, 1)[..., None]
+        out = (img[y0, x0] * (1 - wy) * (1 - wx) +
+               img[y1, x0] * wy * (1 - wx) +
+               img[y0, x1] * (1 - wy) * wx +
+               img[y1, x1] * wy * wx)
+        inside = ((ys >= 0) & (ys <= H - 1) &
+                  (xs >= 0) & (xs <= W - 1))[..., None]
+        return np.where(inside, out, 0.0).astype(np.float32)
+
+
+class ColorConversionTransform(ImageTransform):
+    """Grayscale conversion kept channel-shaped."""
+
+    def transform(self, img):
+        if img.shape[2] == 1:
+            return img
+        g = (0.299 * img[..., 0] + 0.587 * img[..., 1] +
+             0.114 * img[..., 2])
+        return np.repeat(g[..., None], img.shape[2], axis=2)
+
+
+class BrightnessContrastTransform(ImageTransform):
+    def __init__(self, alpha: float = 1.0, beta: float = 0.0, **kw):
+        super().__init__(**kw)
+        self.alpha, self.beta = alpha, beta
+
+    def transform(self, img):
+        return img * self.alpha + self.beta
+
+
+class PipelineImageTransform(ImageTransform):
+    """Chain with optional per-stage probabilities (reference:
+    PipelineImageTransform)."""
+
+    def __init__(self, transforms: Sequence[ImageTransform],
+                 probabilities: Optional[Sequence[float]] = None,
+                 shuffle: bool = False, **kw):
+        super().__init__(**kw)
+        self.transforms = list(transforms)
+        self.probs = list(probabilities) if probabilities else None
+
+    def transform(self, img):
+        for i, t in enumerate(self.transforms):
+            if self.probs is None or \
+                    self.rng.random() < self.probs[i]:
+                img = t.transform(img)
+        return img
+
+
+# -- reader -------------------------------------------------------------------
+class ParentPathLabelGenerator:
+    """Label = name of the parent directory (reference:
+    io.labels.ParentPathLabelGenerator)."""
+
+    def label_for(self, path: str) -> str:
+        return os.path.basename(os.path.dirname(str(path)))
+
+
+class ImageRecordReader(RecordReader):
+    """[NDArrayWritable(image), IntWritable(label)] per file
+    (reference: ImageRecordReader)."""
+
+    def __init__(self, height: int, width: int, channels: int = 3,
+                 label_generator=None,
+                 image_transform: Optional[ImageTransform] = None):
+        self.loader = ImageLoader(height, width, channels)
+        self.label_gen = label_generator
+        self.image_transform = image_transform
+        self.labels: List[str] = []
+
+    def initialize(self, split: InputSplit):
+        self.split = split
+        if self.label_gen is not None:
+            self.labels = sorted({self.label_gen.label_for(p)
+                                  for p in split.locations()})
+        self.reset()
+        return self
+
+    def num_labels(self) -> int:
+        return len(self.labels)
+
+    def _make_iter(self):
+        for loc in self.split.locations():
+            img = self.loader.load(loc)
+            if self.image_transform is not None:
+                img = self.image_transform.transform(img)
+            rec = [NDArrayWritable(img)]
+            if self.label_gen is not None:
+                rec.append(IntWritable(self.labels.index(
+                    self.label_gen.label_for(loc))))
+            yield rec

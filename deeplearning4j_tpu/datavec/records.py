@@ -1,0 +1,179 @@
+"""Record readers (reference: ``org.datavec.api.records.reader.impl.*``,
+SURVEY.md V1): InputSplit -> iterable records of Writables.
+
+A record is ``List[Writable]``; a sequence record is
+``List[List[Writable]]`` (time-major), exactly the reference contract
+consumed by ``RecordReaderDataSetIterator``.
+"""
+from __future__ import annotations
+
+import csv as _csv
+import io
+import os
+from typing import Iterator, List, Optional, Sequence
+
+from deeplearning4j_tpu.datavec.split import InputSplit, ListStringSplit
+from deeplearning4j_tpu.datavec.writable import Text, Writable
+
+Record = List[Writable]
+SequenceRecord = List[List[Writable]]
+
+
+class RecordReader:
+    """Iterator over records (reference: records.reader.RecordReader)."""
+
+    def initialize(self, split: InputSplit) -> "RecordReader":
+        self.split = split
+        self.reset()
+        return self
+
+    def reset(self):
+        self._iter = self._make_iter()
+
+    def has_next(self) -> bool:
+        if not hasattr(self, "_peek"):
+            try:
+                self._peek = next(self._iter)
+            except StopIteration:
+                return False
+        return True
+
+    def next(self) -> Record:
+        if not self.has_next():
+            raise StopIteration
+        rec = self._peek
+        del self._peek
+        return rec
+
+    def __iter__(self) -> Iterator[Record]:
+        self.reset()
+        while self.has_next():
+            yield self.next()
+
+    def _make_iter(self) -> Iterator[Record]:
+        raise NotImplementedError
+
+
+class LineRecordReader(RecordReader):
+    """One record per line of each file (reference: LineRecordReader).
+    With ListStringSplit, each element IS a line."""
+
+    def _lines(self):
+        for loc in self.split.locations():
+            if isinstance(self.split, ListStringSplit) or \
+                    not (isinstance(loc, str) and os.path.isfile(loc)):
+                yield str(loc)
+            else:
+                with open(loc, "r") as f:
+                    for line in f:
+                        yield line.rstrip("\n")
+
+    def _make_iter(self):
+        for line in self._lines():
+            yield [Text(line)]
+
+
+class CSVRecordReader(LineRecordReader):
+    """Comma (or custom) delimited lines -> one Writable per field
+    (reference: CSVRecordReader; skip_num_lines for headers)."""
+
+    def __init__(self, skip_num_lines: int = 0, delimiter: str = ",",
+                 quote: str = '"'):
+        self.skip = int(skip_num_lines)
+        self.delimiter = delimiter
+        self.quote = quote
+
+    def _make_iter(self):
+        n = 0
+        for line in self._lines():
+            n += 1
+            if n <= self.skip:
+                continue
+            row = next(_csv.reader(io.StringIO(line),
+                                   delimiter=self.delimiter,
+                                   quotechar=self.quote))
+            yield [Text(f) for f in row]
+
+
+class CollectionRecordReader(RecordReader):
+    """In-memory records (reference: CollectionRecordReader)."""
+
+    def __init__(self, records: Sequence[Sequence]):
+        self.records = [[Writable.of(v) for v in r] for r in records]
+        self.split = None
+        self.reset()
+
+    def initialize(self, split=None):
+        self.reset()
+        return self
+
+    def _make_iter(self):
+        return iter(self.records)
+
+
+# -- sequences --------------------------------------------------------------
+class SequenceRecordReader(RecordReader):
+    def next_sequence(self) -> SequenceRecord:
+        return self.next()
+
+
+class CSVSequenceRecordReader(SequenceRecordReader):
+    """One file per sequence; each line is a timestep (reference:
+    CSVSequenceRecordReader)."""
+
+    def __init__(self, skip_num_lines: int = 0, delimiter: str = ","):
+        self.skip = int(skip_num_lines)
+        self.delimiter = delimiter
+
+    def _make_iter(self):
+        for loc in self.split.locations():
+            with open(loc, "r") as f:
+                lines = [ln.rstrip("\n") for ln in f][self.skip:]
+            yield [[Text(x) for x in
+                    next(_csv.reader(io.StringIO(ln),
+                                     delimiter=self.delimiter))]
+                   for ln in lines if ln]
+
+
+class CollectionSequenceRecordReader(SequenceRecordReader):
+    """In-memory sequences (reference:
+    CollectionSequenceRecordReader)."""
+
+    def __init__(self, sequences: Sequence[Sequence[Sequence]]):
+        self.sequences = [[[Writable.of(v) for v in step]
+                           for step in seq] for seq in sequences]
+        self.split = None
+        self.reset()
+
+    def initialize(self, split=None):
+        self.reset()
+        return self
+
+    def _make_iter(self):
+        return iter(self.sequences)
+
+
+class TransformProcessRecordReader(RecordReader):
+    """Applies a TransformProcess on the fly (reference:
+    TransformProcessRecordReader). Records filtered out by the process
+    are skipped."""
+
+    def __init__(self, reader: RecordReader, transform_process):
+        self.reader = reader
+        self.tp = transform_process
+
+    def initialize(self, split: InputSplit):
+        self.reader.initialize(split)
+        self.reset()
+        return self
+
+    def reset(self):
+        if hasattr(self.reader, "_iter"):
+            self.reader.reset()
+        self._iter = self._make_iter()
+
+    def _make_iter(self):
+        for rec in self.reader:
+            out = self.tp.execute_record(rec)
+            if out is not None:
+                yield out

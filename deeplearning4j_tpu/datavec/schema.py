@@ -1,0 +1,110 @@
+"""Column schema (reference: ``org.datavec.api.transform.schema.Schema``,
+SURVEY.md V2): typed column metadata that TransformProcess threads
+through every operation so output types are known statically."""
+from __future__ import annotations
+
+import enum
+from typing import List, Optional, Sequence
+
+
+class ColumnType(enum.Enum):
+    INTEGER = "Integer"
+    LONG = "Long"
+    DOUBLE = "Double"
+    FLOAT = "Float"
+    CATEGORICAL = "Categorical"
+    STRING = "String"
+    BOOLEAN = "Boolean"
+    TIME = "Time"
+    NDARRAY = "NDArray"
+
+
+class ColumnMetaData:
+    def __init__(self, name: str, ctype: ColumnType,
+                 state_names: Optional[Sequence[str]] = None):
+        self.name = name
+        self.ctype = ctype
+        self.state_names = list(state_names) if state_names else None
+
+    def __repr__(self):
+        extra = f", states={self.state_names}" if self.state_names else ""
+        return f"ColumnMetaData({self.name!r}, {self.ctype.name}{extra})"
+
+
+class Schema:
+    """Immutable column list; build via ``Schema.Builder()``."""
+
+    def __init__(self, columns: List[ColumnMetaData]):
+        self.columns = list(columns)
+        names = [c.name for c in self.columns]
+        if len(set(names)) != len(names):
+            raise ValueError(f"duplicate column names: {names}")
+
+    # -- queries ---------------------------------------------------------
+    def column_names(self) -> List[str]:
+        return [c.name for c in self.columns]
+
+    def num_columns(self) -> int:
+        return len(self.columns)
+
+    def index_of(self, name: str) -> int:
+        for i, c in enumerate(self.columns):
+            if c.name == name:
+                return i
+        raise KeyError(f"no column '{name}'; have {self.column_names()}")
+
+    def column(self, name: str) -> ColumnMetaData:
+        return self.columns[self.index_of(name)]
+
+    def type_of(self, name: str) -> ColumnType:
+        return self.column(name).ctype
+
+    def __repr__(self):
+        return "Schema(\n  " + "\n  ".join(map(repr, self.columns)) + \
+            "\n)"
+
+    class Builder:
+        def __init__(self):
+            self._cols: List[ColumnMetaData] = []
+
+        def add_column_integer(self, *names):
+            for n in names:
+                self._cols.append(ColumnMetaData(n, ColumnType.INTEGER))
+            return self
+
+        def add_column_long(self, *names):
+            for n in names:
+                self._cols.append(ColumnMetaData(n, ColumnType.LONG))
+            return self
+
+        def add_column_double(self, *names):
+            for n in names:
+                self._cols.append(ColumnMetaData(n, ColumnType.DOUBLE))
+            return self
+
+        def add_column_float(self, *names):
+            for n in names:
+                self._cols.append(ColumnMetaData(n, ColumnType.FLOAT))
+            return self
+
+        def add_column_string(self, *names):
+            for n in names:
+                self._cols.append(ColumnMetaData(n, ColumnType.STRING))
+            return self
+
+        def add_column_boolean(self, *names):
+            for n in names:
+                self._cols.append(ColumnMetaData(n, ColumnType.BOOLEAN))
+            return self
+
+        def add_column_categorical(self, name, state_names):
+            self._cols.append(ColumnMetaData(
+                name, ColumnType.CATEGORICAL, state_names))
+            return self
+
+        def add_column_ndarray(self, name):
+            self._cols.append(ColumnMetaData(name, ColumnType.NDARRAY))
+            return self
+
+        def build(self) -> "Schema":
+            return Schema(self._cols)

@@ -1,0 +1,74 @@
+"""Input splits (reference: ``org.datavec.api.split.*``, SURVEY.md V1):
+where records come from, decoupled from how they are parsed."""
+from __future__ import annotations
+
+import glob as _glob
+import os
+import random
+from typing import List, Optional, Sequence
+
+
+class InputSplit:
+    def locations(self) -> List[str]:
+        raise NotImplementedError
+
+    def length(self) -> int:
+        return len(self.locations())
+
+
+class FileSplit(InputSplit):
+    """Recursive directory (or single-file) split with optional
+    extension filter and shuffle (reference: api.split.FileSplit)."""
+
+    def __init__(self, root: str,
+                 allowed_extensions: Optional[Sequence[str]] = None,
+                 random_seed: Optional[int] = None):
+        self.root = str(root)
+        self.allowed = (tuple(e.lower().lstrip(".")
+                              for e in allowed_extensions)
+                        if allowed_extensions else None)
+        self.seed = random_seed
+        self._locs: Optional[List[str]] = None
+
+    def locations(self) -> List[str]:
+        if self._locs is None:
+            if os.path.isfile(self.root):
+                files = [self.root]
+            else:
+                files = sorted(
+                    p for p in _glob.glob(
+                        os.path.join(self.root, "**", "*"),
+                        recursive=True)
+                    if os.path.isfile(p))
+            if self.allowed is not None:
+                files = [f for f in files
+                         if f.rsplit(".", 1)[-1].lower() in self.allowed]
+            if self.seed is not None:
+                rng = random.Random(self.seed)
+                rng.shuffle(files)
+            self._locs = files
+        return self._locs
+
+
+class ListStringSplit(InputSplit):
+    """In-memory list of 'lines' (reference: ListStringSplit)."""
+
+    def __init__(self, data: Sequence):
+        self.data = list(data)
+
+    def locations(self):
+        return self.data
+
+
+class NumberedFileInputSplit(InputSplit):
+    """Pattern like ``file_%d.csv`` over [min_idx, max_idx]
+    (reference: NumberedFileInputSplit)."""
+
+    def __init__(self, base_string: str, min_idx: int, max_idx: int):
+        if "%d" not in base_string:
+            raise ValueError("pattern must contain %d")
+        self.base = base_string
+        self.lo, self.hi = int(min_idx), int(max_idx)
+
+    def locations(self):
+        return [self.base % i for i in range(self.lo, self.hi + 1)]

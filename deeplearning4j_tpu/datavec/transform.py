@@ -1,0 +1,349 @@
+"""TransformProcess (reference:
+``org.datavec.api.transform.TransformProcess`` + ``transform.*`` op
+classes, SURVEY.md V2): a schema-typed DAG of record operations built
+once, executed per record (streaming) or over a whole collection
+(`LocalTransformExecutor` — reference ``datavec-local``).
+
+Each step is (schema_fn, record_fn): schema_fn threads column metadata
+(so the final schema is known before any data flows), record_fn maps a
+record (or filters it by returning None).
+"""
+from __future__ import annotations
+
+import math
+from typing import Callable, List, Optional, Sequence
+
+import numpy as np
+
+from deeplearning4j_tpu.datavec.schema import (ColumnMetaData, ColumnType,
+                                               Schema)
+from deeplearning4j_tpu.datavec.writable import (DoubleWritable,
+                                                 IntWritable, Text,
+                                                 Writable)
+
+MathOp = {
+    "Add": lambda a, b: a + b,
+    "Subtract": lambda a, b: a - b,
+    "Multiply": lambda a, b: a * b,
+    "Divide": lambda a, b: a / b,
+    "Modulus": lambda a, b: a % b,
+    "ReverseSubtract": lambda a, b: b - a,
+    "ReverseDivide": lambda a, b: b / a,
+    "ScalarMin": min,
+    "ScalarMax": max,
+}
+
+MathFunction = {
+    "ABS": abs, "CEIL": math.ceil, "FLOOR": math.floor,
+    "EXP": math.exp, "LOG": math.log, "LOG2": lambda v: math.log2(v),
+    "SQRT": math.sqrt, "SIN": math.sin, "COS": math.cos,
+    "TAN": math.tan, "SIGNUM": lambda v: (v > 0) - (v < 0),
+}
+
+
+class TransformProcess:
+    """Built via ``TransformProcess.Builder(initial_schema)``."""
+
+    def __init__(self, initial_schema: Schema, steps):
+        self.initial_schema = initial_schema
+        self.steps = steps          # list of (name, schema_fn, rec_fn)
+        s = initial_schema
+        for _, schema_fn, _ in steps:
+            s = schema_fn(s)
+        self.final_schema = s
+
+    def get_final_schema(self) -> Schema:
+        return self.final_schema
+
+    def execute_record(self, record: Sequence[Writable]):
+        """Run one record through every step; None = filtered out."""
+        rec = list(record)
+        schema = self.initial_schema
+        for _, schema_fn, rec_fn in self.steps:
+            rec = rec_fn(schema, rec)
+            schema = schema_fn(schema)
+            if rec is None:
+                return None
+        return rec
+
+    def execute(self, records) -> List[List[Writable]]:
+        """Collection execution (reference: LocalTransformExecutor
+        .execute)."""
+        out = []
+        for r in records:
+            t = self.execute_record(r)
+            if t is not None:
+                out.append(t)
+        return out
+
+    class Builder:
+        def __init__(self, initial_schema: Schema):
+            self.schema0 = initial_schema
+            self.steps = []
+
+        def _add(self, name, schema_fn, rec_fn):
+            self.steps.append((name, schema_fn, rec_fn))
+            return self
+
+        # -- column structure ops ---------------------------------------
+        def remove_columns(self, *names):
+            names = set(names)
+
+            def sf(s):
+                return Schema([c for c in s.columns
+                               if c.name not in names])
+
+            def rf(s, r):
+                keep = [i for i, c in enumerate(s.columns)
+                        if c.name not in names]
+                return [r[i] for i in keep]
+
+            return self._add(f"remove{sorted(names)}", sf, rf)
+
+        def remove_all_columns_except_for(self, *names):
+            keep_names = set(names)
+
+            def sf(s):
+                return Schema([c for c in s.columns
+                               if c.name in keep_names])
+
+            def rf(s, r):
+                keep = [i for i, c in enumerate(s.columns)
+                        if c.name in keep_names]
+                return [r[i] for i in keep]
+
+            return self._add(f"keep{sorted(keep_names)}", sf, rf)
+
+        def rename_column(self, old: str, new: str):
+            def sf(s):
+                return Schema([ColumnMetaData(new, c.ctype,
+                                              c.state_names)
+                               if c.name == old else c
+                               for c in s.columns])
+
+            return self._add(f"rename {old}->{new}", sf,
+                             lambda s, r: list(r))
+
+        def reorder_columns(self, *names):
+            def sf(s):
+                return Schema([s.column(n) for n in names])
+
+            def rf(s, r):
+                return [r[s.index_of(n)] for n in names]
+
+            return self._add(f"reorder{list(names)}", sf, rf)
+
+        def duplicate_column(self, src: str, new: str):
+            def sf(s):
+                c = s.column(src)
+                return Schema(s.columns +
+                              [ColumnMetaData(new, c.ctype,
+                                              c.state_names)])
+
+            def rf(s, r):
+                return list(r) + [r[s.index_of(src)]]
+
+            return self._add(f"dup {src}->{new}", sf, rf)
+
+        # -- type conversions -------------------------------------------
+        def string_to_categorical(self, name: str, state_names):
+            states = list(state_names)
+
+            def sf(s):
+                return Schema([ColumnMetaData(name,
+                                              ColumnType.CATEGORICAL,
+                                              states)
+                               if c.name == name else c
+                               for c in s.columns])
+
+            def rf(s, r):
+                i = s.index_of(name)
+                v = str(r[i].to_python())
+                if v not in states:
+                    raise ValueError(f"value '{v}' not in categorical "
+                                     f"states {states} for '{name}'")
+                return r[:i] + [Text(v)] + r[i + 1:]
+
+            return self._add(f"toCategorical {name}", sf, rf)
+
+        def categorical_to_integer(self, *names):
+            todo = set(names)
+
+            def sf(s):
+                return Schema([ColumnMetaData(c.name, ColumnType.INTEGER)
+                               if c.name in todo else c
+                               for c in s.columns])
+
+            def rf(s, r):
+                r = list(r)
+                for n in todo:
+                    i = s.index_of(n)
+                    states = s.column(n).state_names
+                    r[i] = IntWritable(states.index(
+                        str(r[i].to_python())))
+                return r
+
+            return self._add(f"cat->int {sorted(todo)}", sf, rf)
+
+        def categorical_to_one_hot(self, *names):
+            todo = list(names)
+
+            def sf(s):
+                cols = []
+                for c in s.columns:
+                    if c.name in todo:
+                        cols.extend(ColumnMetaData(
+                            f"{c.name}[{st}]", ColumnType.INTEGER)
+                            for st in c.state_names)
+                    else:
+                        cols.append(c)
+                return Schema(cols)
+
+            def rf(s, r):
+                out = []
+                for c, v in zip(s.columns, r):
+                    if c.name in todo:
+                        val = str(v.to_python())
+                        out.extend(IntWritable(1 if st == val else 0)
+                                   for st in c.state_names)
+                    else:
+                        out.append(v)
+                return out
+
+            return self._add(f"oneHot {todo}", sf, rf)
+
+        def convert_to_double(self, *names):
+            todo = set(names)
+
+            def sf(s):
+                return Schema([ColumnMetaData(c.name, ColumnType.DOUBLE)
+                               if c.name in todo else c
+                               for c in s.columns])
+
+            def rf(s, r):
+                return [DoubleWritable(v.to_double())
+                        if c.name in todo else v
+                        for c, v in zip(s.columns, r)]
+
+            return self._add(f"toDouble {sorted(todo)}", sf, rf)
+
+        def convert_to_integer(self, *names):
+            todo = set(names)
+
+            def sf(s):
+                return Schema([ColumnMetaData(c.name, ColumnType.INTEGER)
+                               if c.name in todo else c
+                               for c in s.columns])
+
+            def rf(s, r):
+                return [IntWritable(v.to_int())
+                        if c.name in todo else v
+                        for c, v in zip(s.columns, r)]
+
+            return self._add(f"toInt {sorted(todo)}", sf, rf)
+
+        def convert_to_string(self, *names):
+            todo = set(names)
+
+            def sf(s):
+                return Schema([ColumnMetaData(c.name, ColumnType.STRING)
+                               if c.name in todo else c
+                               for c in s.columns])
+
+            def rf(s, r):
+                return [Text(str(v.to_python()))
+                        if c.name in todo else v
+                        for c, v in zip(s.columns, r)]
+
+            return self._add(f"toString {sorted(todo)}", sf, rf)
+
+        # -- math ---------------------------------------------------------
+        def double_math_op(self, name: str, op: str, scalar: float):
+            f = MathOp[op]
+
+            def rf(s, r):
+                i = s.index_of(name)
+                return (r[:i] +
+                        [DoubleWritable(f(r[i].to_double(), scalar))] +
+                        r[i + 1:])
+
+            return self._add(f"{op}({name},{scalar})",
+                             lambda s: s, rf)
+
+        def double_math_function(self, name: str, fn: str):
+            f = MathFunction[fn]
+
+            def rf(s, r):
+                i = s.index_of(name)
+                return (r[:i] +
+                        [DoubleWritable(f(r[i].to_double()))] +
+                        r[i + 1:])
+
+            return self._add(f"{fn}({name})", lambda s: s, rf)
+
+        def integer_math_op(self, name: str, op: str, scalar: int):
+            f = MathOp[op]
+
+            def rf(s, r):
+                i = s.index_of(name)
+                return (r[:i] +
+                        [IntWritable(int(f(r[i].to_int(), scalar)))] +
+                        r[i + 1:])
+
+            return self._add(f"{op}({name},{scalar})",
+                             lambda s: s, rf)
+
+        # -- filters ------------------------------------------------------
+        def filter(self, predicate: Callable[[Schema, list], bool]):
+            """Drop records where predicate(schema, record) is True
+            (reference: FilterOp semantics — condition true = remove)."""
+
+            def rf(s, r):
+                return None if predicate(s, r) else r
+
+            return self._add("filter", lambda s: s, rf)
+
+        def filter_invalid_values(self, *names):
+            todo = set(names)
+
+            def bad(s, r):
+                for n in todo:
+                    v = r[s.index_of(n)]
+                    try:
+                        d = v.to_double()
+                    except (TypeError, ValueError):
+                        return True
+                    if d != d:          # NaN
+                        return True
+                return False
+
+            return self.filter(bad)
+
+        def conditional_replace_value_transform(
+                self, name: str, new_value,
+                condition: Callable[[Writable], bool]):
+            def rf(s, r):
+                i = s.index_of(name)
+                if condition(r[i]):
+                    return (r[:i] + [Writable.of(new_value)] +
+                            r[i + 1:])
+                return r
+
+            return self._add(f"condReplace {name}", lambda s: s, rf)
+
+        def build(self) -> "TransformProcess":
+            return TransformProcess(self.schema0, self.steps)
+
+
+class LocalTransformExecutor:
+    """Reference: ``org.datavec.local.transforms
+    .LocalTransformExecutor.execute``."""
+
+    @staticmethod
+    def execute(records, tp: TransformProcess):
+        return tp.execute(records)
+
+    @staticmethod
+    def execute_to_numpy(records, tp: TransformProcess) -> np.ndarray:
+        rows = LocalTransformExecutor.execute(records, tp)
+        return np.array([[w.to_double() for w in r] for r in rows])

@@ -1,0 +1,114 @@
+"""Writable value types (reference: ``org.datavec.api.writable.*``,
+SURVEY.md V1).
+
+The reference's Writables are Hadoop-style boxed values flowing through
+record readers and transforms. Here they are thin typed boxes over
+Python/numpy scalars — the type tags matter (schema validation,
+transform dispatch), the boxing is cheap, and ``.to_python()`` /
+``Writable.of()`` convert at the numpy boundary.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+
+class Writable:
+    __slots__ = ("value",)
+
+    def __init__(self, value):
+        self.value = value
+
+    def to_python(self):
+        return self.value
+
+    def to_double(self) -> float:
+        return float(self.value)
+
+    def to_int(self) -> int:
+        return int(self.value)
+
+    def __eq__(self, other):
+        return (type(self) is type(other) and
+                self.value == other.value)
+
+    def __hash__(self):
+        return hash((type(self).__name__, self.value))
+
+    def __repr__(self):
+        return f"{type(self).__name__}({self.value!r})"
+
+    @staticmethod
+    def of(v) -> "Writable":
+        """Best-effort boxing of a Python/numpy value."""
+        if isinstance(v, Writable):
+            return v
+        if isinstance(v, (bool, np.bool_)):
+            return BooleanWritable(bool(v))
+        if isinstance(v, (int, np.integer)):
+            return IntWritable(int(v))
+        if isinstance(v, (float, np.floating)):
+            return DoubleWritable(float(v))
+        if isinstance(v, np.ndarray):
+            return NDArrayWritable(v)
+        if v is None:
+            return NullWritable()
+        return Text(str(v))
+
+
+class IntWritable(Writable):
+    def __init__(self, value: int):
+        super().__init__(int(value))
+
+
+class LongWritable(IntWritable):
+    pass
+
+
+class DoubleWritable(Writable):
+    def __init__(self, value: float):
+        super().__init__(float(value))
+
+
+class FloatWritable(DoubleWritable):
+    pass
+
+
+class BooleanWritable(Writable):
+    def __init__(self, value: bool):
+        super().__init__(bool(value))
+
+    def to_double(self):
+        return 1.0 if self.value else 0.0
+
+
+class Text(Writable):
+    def __init__(self, value: str):
+        super().__init__(str(value))
+
+    def to_double(self):
+        return float(self.value)
+
+    def to_int(self):
+        return int(float(self.value))
+
+
+class NullWritable(Writable):
+    def __init__(self):
+        super().__init__(None)
+
+    def to_double(self):
+        return float("nan")
+
+
+class NDArrayWritable(Writable):
+    """Tensor-valued column (reference: image/sequence features)."""
+
+    def __init__(self, value):
+        super().__init__(np.asarray(value))
+
+    def __eq__(self, other):
+        return (type(self) is type(other) and
+                np.array_equal(self.value, other.value))
+
+    def __hash__(self):
+        return id(self)
