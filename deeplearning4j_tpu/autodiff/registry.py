@@ -723,6 +723,7 @@ def _deconv2d(ins, attrs):
     out = lax.conv_transpose(
         x, w, strides=tuple(attrs.get("stride", (1, 1))),
         padding=attrs.get("padding", "SAME"),
+        transpose_kernel=attrs.get("transpose_kernel", False),
         dimension_numbers=_conv_dn(4))
     if len(ins) > 2:
         out = out + ins[2]
@@ -1083,4 +1084,43 @@ def _encode_thr(ins, attrs):
 
 @op("decode_threshold", "compression")
 def _decode_thr(ins, attrs):
+    return ins[0]
+
+
+# -- generic contraction / indexing (used by the TF importer) ---------------
+@op("einsum", "blas")
+def _einsum(ins, attrs):
+    return jnp.einsum(attrs["equation"], *ins)
+
+
+def spec_to_index(spec) -> tuple:
+    """{"kind": "slice"|"int"|"newaxis"|"ellipsis", ...} items → a
+    python indexing tuple (shared by the 'index' op and the TF
+    importer's StridedSlice constant folder)."""
+    idx = []
+    for item in spec:
+        kind = item["kind"]
+        if kind == "slice":
+            idx.append(slice(item.get("begin"), item.get("end"),
+                             item.get("stride")))
+        elif kind == "int":
+            idx.append(item["i"])
+        elif kind == "newaxis":
+            idx.append(None)
+        elif kind == "ellipsis":
+            idx.append(Ellipsis)
+        else:
+            raise ValueError(f"bad index spec kind {kind!r}")
+    return tuple(idx)
+
+
+@op("index", "shape")
+def _index(ins, attrs):
+    """Generalized indexing — the importer's lowering target for TF
+    StridedSlice masks."""
+    return ins[0][spec_to_index(attrs["spec"])]
+
+
+@op("identity", "transform")
+def _identity_op(ins, attrs):
     return ins[0]

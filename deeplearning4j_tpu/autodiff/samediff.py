@@ -250,6 +250,7 @@ class SameDiff:
         if new in self.vars:
             raise ValueError(f"variable '{new}' already exists")
         v = self.vars.pop(old)
+        v.name = new
         self.vars[new] = v
         if old in self._arrays:
             self._arrays[new] = self._arrays.pop(old)
@@ -345,6 +346,7 @@ class SameDiff:
         """Public escape hatch: call any registered op by name."""
         return self._op(op_name, [self._as_var(i) for i in inputs],
                         attrs, name, n_out)
+
 
     # -- execution -----------------------------------------------------
     def _ancestors(self, targets: Sequence[str]) -> List[int]:

@@ -1,0 +1,1 @@
+"""Model import: TF GraphDef (S6/S7) and Keras (D14) front-doors."""

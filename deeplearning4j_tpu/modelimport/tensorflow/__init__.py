@@ -1,0 +1,6 @@
+"""TF GraphDef import (SURVEY.md S6/S7)."""
+from deeplearning4j_tpu.modelimport.tensorflow.importer import (
+    GraphDefImporter, TensorflowFrameworkImporter, TFGraphMapper)
+
+__all__ = ["GraphDefImporter", "TensorflowFrameworkImporter",
+           "TFGraphMapper"]

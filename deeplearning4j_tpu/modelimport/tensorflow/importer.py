@@ -1,0 +1,489 @@
+"""Frozen TF GraphDef → SameDiff importer.
+
+Reference parity: `TensorflowFrameworkImporter.runImport` /
+`ImportGraph` in `samediff-import-tensorflow`, and the legacy
+`org.nd4j.imports.graphmapper.tf.TFGraphMapper` (SURVEY.md S6/S7,
+call stack §3.3 "Import front-door").
+
+TPU-first design: rather than replaying TF's dynamic-shape machinery,
+the importer (a) constant-folds the GraphDef's shape-arithmetic chains
+(Shape → StridedSlice → Pack → Reshape) with numpy, using
+``jax.eval_shape`` to propagate static shapes through every emitted op,
+and (b) emits into the SameDiff op DAG, which compiles to ONE XLA
+program at execution. Static shapes are exactly what XLA:TPU wants.
+"""
+from __future__ import annotations
+
+import os
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+
+from deeplearning4j_tpu.autodiff.registry import get_op
+from deeplearning4j_tpu.autodiff.samediff import (SameDiff, SDVariable,
+                                                  VariableType)
+from deeplearning4j_tpu.modelimport.tensorflow import mappings
+from deeplearning4j_tpu.modelimport.tensorflow.mappings import TF_OP_MAP
+from deeplearning4j_tpu.modelimport.tensorflow.protobuf import (
+    NodeDef, parse_graphdef, tf_dtype_to_np)
+
+_SKIP_OPS = {"NoOp", "Assert", "SaveV2", "RestoreV2", "MergeV2Checkpoints"}
+
+
+def _canon(ref: str) -> str:
+    """TF input ref → canonical var name ('x:0' == 'x'; '^x' is a
+    control dep on x)."""
+    if ref.startswith("^"):
+        ref = ref[1:]
+    if ref.endswith(":0"):
+        ref = ref[:-2]
+    return ref
+
+
+def _node_of(ref: str) -> str:
+    ref = _canon(ref)
+    return ref.split(":")[0]
+
+
+class _Ctx:
+    """Mapping context handed to each TF_OP_MAP rule (the attr/tensor
+    adapter surface of the reference's MappingProcess)."""
+
+    def __init__(self, importer: "GraphDefImporter"):
+        self._imp = importer
+        self.sd = importer.sd
+
+    def var(self, ref: str) -> SDVariable:
+        return self._imp._materialize(_canon(ref))
+
+    def static(self, ref: str) -> Optional[np.ndarray]:
+        return self._imp.static_values.get(_canon(ref))
+
+    def require_static(self, node: NodeDef, i: int) -> np.ndarray:
+        ref = _canon(node.inputs[i])
+        val = self._imp.static_values.get(ref)
+        if val is None:
+            raise ValueError(
+                f"TF import: input {i} ('{ref}') of node "
+                f"'{node.name}' ({node.op}) must be statically known — "
+                f"provide concrete input_shapes so shape chains fold")
+        return val
+
+
+class GraphDefImporter:
+    """One-shot importer for a frozen (inference) GraphDef."""
+
+    def __init__(self, graph_def, input_shapes: Optional[dict] = None):
+        if isinstance(graph_def, (str, os.PathLike)):
+            with open(graph_def, "rb") as fh:
+                graph_def = fh.read()
+        if isinstance(graph_def, (bytes, bytearray)):
+            self.nodes = parse_graphdef(bytes(graph_def))
+        else:                        # already a parsed NodeDef list
+            self.nodes = list(graph_def)
+        self.input_shapes = {k: tuple(v) for k, v in
+                             (input_shapes or {}).items()}
+        self.sd = SameDiff()
+        self.static_values: Dict[str, np.ndarray] = {}
+        self.var_map: Dict[str, SDVariable] = {}
+        self.avals: Dict[str, jax.ShapeDtypeStruct] = {}
+        self.placeholders: List[str] = []
+        self.outputs: List[str] = []
+
+    # -- name/value plumbing ------------------------------------------
+    def _materialize(self, name: str) -> SDVariable:
+        v = self.var_map.get(name)
+        if v is not None:
+            return v
+        if name in self.static_values:
+            arr = self.static_values[name]
+            if arr.dtype == object:
+                raise ValueError(f"string tensor '{name}' cannot be a "
+                                 f"graph input")
+            c = self.sd.constant(name, arr)
+            if c.name != name:       # name collided with an sd-internal
+                raise RuntimeError(f"constant name collision: {name}")
+            self.var_map[name] = c
+            self.avals[name] = jax.ShapeDtypeStruct(arr.shape, arr.dtype)
+            return c
+        raise KeyError(f"TF import: reference to unknown tensor "
+                       f"'{name}'")
+
+    def _bind(self, node: NodeDef, result, start_idx: int):
+        """Attach mapping result vars to 'name', 'name:1', …"""
+        if result is None:
+            return
+        outs = (list(result) if isinstance(result, (list, tuple))
+                else [result])
+        for i, v in enumerate(outs):
+            target = node.name if i == 0 else f"{node.name}:{i}"
+            if not isinstance(v, SDVariable):
+                raise TypeError(f"mapping for {node.op} returned "
+                                f"{type(v)}")
+            if v.name in self.var_map:
+                # passthrough of an already-bound tensor (constant
+                # splat &c): alias the TF name to it, keep the var
+                self.var_map[target] = v
+                if v.name in self.avals:
+                    self.avals[target] = self.avals[v.name]
+            else:
+                if v.name != target:
+                    self._rename_local(v, target, start_idx)
+                self.var_map[target] = v
+
+    def _rename_local(self, v: SDVariable, new: str, start_idx: int):
+        """Rename a var created by THIS mapping rule. Only ops emitted
+        since start_idx can reference it, so the rewrite is O(ops in
+        this rule) — not SameDiff._rename's whole-graph scan (which
+        would make a 2000-node BERT import quadratic)."""
+        sd = self.sd
+        old = v.name
+        if new in sd.vars:
+            raise ValueError(f"variable '{new}' already exists")
+        sd.vars.pop(old)
+        v.name = new
+        sd.vars[new] = v
+        if old in sd._arrays:
+            sd._arrays[new] = sd._arrays.pop(old)
+        if old in sd._producer:
+            sd._producer[new] = sd._producer.pop(old)
+        for op_node in sd.ops[start_idx:]:
+            op_node.inputs = [new if i == old else i
+                              for i in op_node.inputs]
+            op_node.outputs = [new if o == old else o
+                               for o in op_node.outputs]
+
+    # -- shape propagation --------------------------------------------
+    def _infer_new_ops(self, start_idx: int):
+        """eval_shape every op emitted since start_idx; record avals and
+        fill in SDVariable shapes (cheap — no FLOPs, no device)."""
+        for node in self.sd.ops[start_idx:]:
+            in_avals = []
+            ok = True
+            for name in node.inputs:
+                av = self.avals.get(name)
+                if av is None:
+                    arr = self.sd._arrays.get(name)
+                    if arr is not None:
+                        av = jax.ShapeDtypeStruct(arr.shape, arr.dtype)
+                        self.avals[name] = av
+                    else:
+                        ok = False
+                        break
+                in_avals.append(av)
+            if not ok:
+                continue
+            attrs = dict(node.attrs or {})
+            if node.op_name in ("random_normal", "random_uniform",
+                                "random_bernoulli", "dropout"):
+                attrs["rng"] = jax.random.PRNGKey(0)
+            try:
+                out = jax.eval_shape(
+                    lambda *xs: get_op(node.op_name)(list(xs), attrs),
+                    *in_avals)
+            except Exception:
+                continue
+            outs = out if isinstance(out, (list, tuple)) else [out]
+            for on, av in zip(node.outputs, outs):
+                self.avals[on] = jax.ShapeDtypeStruct(av.shape, av.dtype)
+                sv = self.sd.vars[on]
+                sv.shape = tuple(av.shape)
+                sv.dtype = av.dtype
+
+    def _known_shape(self, ref: str) -> Optional[Tuple[int, ...]]:
+        av = self.avals.get(ref)
+        if av is not None:
+            return tuple(av.shape)
+        arr = self.static_values.get(ref)
+        if arr is not None:
+            return tuple(arr.shape)
+        return None
+
+    # -- constant folding ---------------------------------------------
+    def _try_fold(self, node: NodeDef) -> bool:
+        fold = _FOLDERS.get(node.op)
+        if fold is None:
+            return False
+        try:
+            result = fold(self, node)
+        except _NoFold:
+            return False
+        if result is None:
+            return False
+        if isinstance(result, (list, tuple)):
+            for i, arr in enumerate(result):
+                key = node.name if i == 0 else f"{node.name}:{i}"
+                self.static_values[key] = np.asarray(arr)
+        else:
+            self.static_values[node.name] = np.asarray(result)
+        return True
+
+    def _statics(self, node: NodeDef) -> List[np.ndarray]:
+        vals = []
+        for ref in node.inputs:
+            if ref.startswith("^"):
+                continue
+            v = self.static_values.get(_canon(ref))
+            if v is None:
+                raise _NoFold()
+            vals.append(v)
+        return vals
+
+    # -- main loop -----------------------------------------------------
+    def run(self) -> SameDiff:
+        by_name = {n.name: n for n in self.nodes}
+        order = _topo_sort(self.nodes, by_name)
+        unmapped = sorted({n.op for n in order
+                           if n.op not in TF_OP_MAP
+                           and n.op not in ("Const", "Placeholder")
+                           and n.op not in _SKIP_OPS
+                           and n.op not in _FOLDERS})
+        if unmapped:
+            raise NotImplementedError(
+                f"TF import: no mapping for ops {unmapped} "
+                f"(reference parity: OpMappingRegistry lookup failure)")
+        ctx = _Ctx(self)
+        for node in order:
+            if node.op in _SKIP_OPS:
+                continue
+            if node.op == "Const":
+                self.static_values[node.name] = node.attr("value")
+                continue
+            if node.op == "Placeholder":
+                shape = self.input_shapes.get(node.name)
+                if shape is None:
+                    shape = node.attr("shape")
+                dtype = tf_dtype_to_np(int(node.attr("dtype", 1)))
+                ph = self.sd.placeholder(node.name, shape, dtype)
+                self.var_map[node.name] = ph
+                self.placeholders.append(node.name)
+                if shape is not None and all(
+                        d is not None and d >= 0 for d in shape):
+                    self.avals[node.name] = jax.ShapeDtypeStruct(
+                        tuple(shape), np.dtype(dtype))
+                continue
+            if self._try_fold(node):
+                continue
+            # control deps ('^x') order execution in TF; the compiled
+            # XLA program has no side effects to order, so they are
+            # dropped before positional/variadic input handling
+            node.inputs = [r for r in node.inputs
+                           if not r.startswith("^")]
+            rule = TF_OP_MAP[node.op]
+            n_ops_before = len(self.sd.ops)
+            result = rule(ctx, node)
+            self._bind(node, result, n_ops_before)
+            self._infer_new_ops(n_ops_before)
+        self.outputs = _terminal_names(order, self.var_map)
+        return self.sd
+
+
+class _NoFold(Exception):
+    pass
+
+
+def _topo_sort(nodes: Sequence[NodeDef], by_name) -> List[NodeDef]:
+    order: List[NodeDef] = []
+    state: Dict[str, int] = {}        # 0 visiting, 1 done
+
+    def visit(n: NodeDef):
+        stack = [(n, iter(n.inputs))]
+        state[n.name] = 0
+        while stack:
+            node, it = stack[-1]
+            advanced = False
+            for ref in it:
+                dep = by_name.get(_node_of(ref))
+                if dep is None:
+                    raise KeyError(f"missing node '{_node_of(ref)}'")
+                st = state.get(dep.name)
+                if st == 0:
+                    raise ValueError(f"cycle at '{dep.name}' — "
+                                     f"control-flow loops unsupported")
+                if st is None:
+                    state[dep.name] = 0
+                    stack.append((dep, iter(dep.inputs)))
+                    advanced = True
+                    break
+            if not advanced:
+                state[node.name] = 1
+                order.append(node)
+                stack.pop()
+
+    for n in nodes:
+        if state.get(n.name) is None:
+            visit(n)
+    return order
+
+
+def _terminal_names(order, var_map) -> List[str]:
+    consumed = set()
+    for n in order:
+        for ref in n.inputs:
+            consumed.add(_node_of(ref))
+    return [n.name for n in order
+            if n.name not in consumed and n.name in var_map]
+
+
+# -- numpy constant folders -------------------------------------------------
+def _f_shape(imp, node):
+    ref = _canon(node.inputs[0])
+    shape = imp._known_shape(ref)
+    if shape is None or any(d is None or d < 0 for d in shape):
+        raise _NoFold()
+    return np.asarray(shape, np.int32)
+
+
+def _f_identity(imp, node):
+    return imp._statics(node)[0]
+
+
+def _f_strided_slice(imp, node):
+    from deeplearning4j_tpu.autodiff.registry import spec_to_index
+    x, begin, end, strides = imp._statics(node)
+    spec = mappings.strided_slice_spec(
+        [int(v) for v in begin], [int(v) for v in end],
+        [int(v) for v in strides], node.attr("begin_mask", 0),
+        node.attr("end_mask", 0), node.attr("ellipsis_mask", 0),
+        node.attr("new_axis_mask", 0), node.attr("shrink_axis_mask", 0))
+    return np.asarray(x)[spec_to_index(spec)]
+
+
+def _f_pack(imp, node):
+    return np.stack(imp._statics(node), axis=node.attr("axis", 0))
+
+
+def _f_concat(imp, node):
+    vals = imp._statics(node)
+    return np.concatenate(vals[:-1], axis=int(vals[-1]))
+
+
+def _f_binop(fn):
+    def fold(imp, node):
+        a, b = imp._statics(node)
+        return fn(a, b)
+    return fold
+
+
+def _f_unop(fn):
+    def fold(imp, node):
+        return fn(imp._statics(node)[0])
+    return fold
+
+
+def _f_reshape(imp, node):
+    x, shape = imp._statics(node)
+    return np.reshape(x, [int(s) for s in shape])
+
+
+def _f_cast(imp, node):
+    dst = tf_dtype_to_np(int(node.attr("DstT", 1)))
+    return imp._statics(node)[0].astype(dst)
+
+
+def _f_range(imp, node):
+    s, l, d = [np.asarray(v).reshape(())[()] for v in
+               imp._statics(node)]
+    return np.arange(s, l, d)
+
+
+def _f_fill(imp, node):
+    dims, val = imp._statics(node)
+    return np.full([int(d) for d in dims],
+                   np.asarray(val).reshape(())[()])
+
+
+def _f_gather_v2(imp, node):
+    if int(node.attr("batch_dims", 0)) != 0:
+        raise _NoFold()          # keep parity with the emit path
+    x, idx, axis = imp._statics(node)
+    return np.take(x, idx.astype(np.int64), axis=int(axis))
+
+
+def _f_expand_dims(imp, node):
+    x, ax = imp._statics(node)
+    return np.expand_dims(x, int(np.asarray(ax).reshape(())[()]))
+
+
+def _f_squeeze(imp, node):
+    dims = node.attr("squeeze_dims") or None
+    x = imp._statics(node)[0]
+    return np.squeeze(x, tuple(int(d) for d in dims) if dims else None)
+
+
+def _f_transpose(imp, node):
+    x, perm = imp._statics(node)
+    return np.transpose(x, [int(p) for p in perm])
+
+
+def _f_prod(imp, node):
+    x, axes = imp._statics(node)
+    return np.prod(x, axis=tuple(int(a) for a in
+                                 np.asarray(axes).reshape(-1)),
+                   keepdims=bool(node.attr("keep_dims", False)))
+
+
+def _f_unpack(imp, node):
+    x = imp._statics(node)[0]
+    axis = node.attr("axis", 0)
+    return [np.squeeze(s, axis) for s in
+            np.split(x, x.shape[axis], axis=axis)]
+
+
+def _f_size(imp, node):
+    ref = _canon(node.inputs[0])
+    shape = imp._known_shape(ref)
+    if shape is None or any(d is None or d < 0 for d in shape):
+        raise _NoFold()
+    return np.asarray(int(np.prod(shape)), np.int32)
+
+
+def _f_rank(imp, node):
+    ref = _canon(node.inputs[0])
+    shape = imp._known_shape(ref)
+    if shape is None:
+        raise _NoFold()
+    return np.asarray(len(shape), np.int32)
+
+
+_FOLDERS = {
+    "Shape": _f_shape, "ShapeN": None, "Size": _f_size, "Rank": _f_rank,
+    "Identity": _f_identity, "StridedSlice": _f_strided_slice,
+    "Pack": _f_pack, "ConcatV2": _f_concat, "Reshape": _f_reshape,
+    "Cast": _f_cast, "Range": _f_range, "Fill": _f_fill,
+    "GatherV2": _f_gather_v2, "ExpandDims": _f_expand_dims,
+    "Squeeze": _f_squeeze, "Transpose": _f_transpose, "Prod": _f_prod,
+    "Unpack": _f_unpack,
+    "Add": _f_binop(np.add), "AddV2": _f_binop(np.add),
+    "Sub": _f_binop(np.subtract), "Mul": _f_binop(np.multiply),
+    "RealDiv": _f_binop(np.true_divide),
+    "FloorDiv": _f_binop(np.floor_divide),
+    "FloorMod": _f_binop(np.mod),
+    "Maximum": _f_binop(np.maximum), "Minimum": _f_binop(np.minimum),
+    "Neg": _f_unop(np.negative),
+}
+_FOLDERS = {k: v for k, v in _FOLDERS.items() if v is not None}
+
+
+class TensorflowFrameworkImporter:
+    """Reference: org.nd4j.samediff.frameworkimport.tensorflow.importer.
+    TensorflowFrameworkImporter (SURVEY.md S6)."""
+
+    @staticmethod
+    def run_import(graph_def, input_shapes: Optional[dict] = None
+                   ) -> SameDiff:
+        return GraphDefImporter(graph_def, input_shapes).run()
+
+    runImport = run_import
+
+
+class TFGraphMapper:
+    """Legacy front-door (reference: TFGraphMapper, SURVEY.md S7)."""
+
+    @staticmethod
+    def import_graph(graph_def, input_shapes: Optional[dict] = None
+                     ) -> SameDiff:
+        return GraphDefImporter(graph_def, input_shapes).run()
+
+    importGraph = import_graph

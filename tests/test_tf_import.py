@@ -1,0 +1,287 @@
+"""TF GraphDef import conformance (SURVEY.md S6/S7, §4.4).
+
+The reference proves import fidelity by executing a corpus of real
+exported TF graphs and comparing tensors against TF-produced ground
+truth (TFGraphTestAllSameDiff). Same approach here: graphs are built
+with the in-image TF 2.21, frozen to GraphDef bytes, imported, and
+outputs compared against TF's own execution.
+"""
+import numpy as np
+import pytest
+
+tf = pytest.importorskip("tensorflow")
+
+from deeplearning4j_tpu.modelimport.tensorflow import (  # noqa: E402
+    TensorflowFrameworkImporter, TFGraphMapper)
+from deeplearning4j_tpu.modelimport.tensorflow.protobuf import (  # noqa
+    parse_graphdef, parse_tensor)
+
+
+def freeze(fn, *specs):
+    """tf.function → frozen GraphDef bytes + concrete function."""
+    from tensorflow.python.framework.convert_to_constants import \
+        convert_variables_to_constants_v2
+    cf = tf.function(fn).get_concrete_function(*specs)
+    frozen = convert_variables_to_constants_v2(cf)
+    gd = frozen.graph.as_graph_def()
+    return gd.SerializeToString(), frozen
+
+
+def _import_and_compare(fn, feeds, atol=1e-4, input_shapes=None):
+    specs = [tf.TensorSpec(v.shape, tf.as_dtype(v.dtype))
+             for v in feeds.values()]
+    gd_bytes, frozen = freeze(fn, *specs)
+    expected = frozen(**{k: tf.constant(v) for k, v in feeds.items()})
+    if isinstance(expected, (list, tuple)):
+        expected = expected[0]
+    shapes = input_shapes or {k: v.shape for k, v in feeds.items()}
+    imp = TensorflowFrameworkImporter.run_import(gd_bytes, shapes)
+    importer_outs = [n for n in imp.vars if n.startswith("Identity")]
+    out_name = sorted(importer_outs)[0]
+    got = imp.output(feeds, [out_name])[out_name]
+    np.testing.assert_allclose(got, np.asarray(expected), atol=atol,
+                               rtol=1e-3)
+    return imp
+
+
+class TestProtobufDecoder:
+    def test_const_roundtrip_dtypes(self):
+        for arr in [np.arange(6, dtype=np.float32).reshape(2, 3),
+                    np.arange(6, dtype=np.int64).reshape(3, 2),
+                    np.asarray([True, False]),
+                    np.asarray(3.5, np.float64)]:
+            gd = tf.Graph()
+            with gd.as_default():
+                tf.constant(arr, name="c")
+            raw = gd.as_graph_def().SerializeToString()
+            nodes = parse_graphdef(raw)
+            const = [n for n in nodes if n.name == "c"][0]
+            got = const.attr("value")
+            np.testing.assert_array_equal(got, arr)
+
+    def test_splat_fill_tensor(self):
+        gd = tf.Graph()
+        with gd.as_default():
+            tf.constant(np.full((4, 4), 7.0, np.float32), name="c")
+        nodes = parse_graphdef(gd.as_graph_def().SerializeToString())
+        got = [n for n in nodes if n.name == "c"][0].attr("value")
+        np.testing.assert_array_equal(got, np.full((4, 4), 7.0))
+
+
+class TestOpConformance:
+    def test_mlp(self):
+        w1 = tf.Variable(np.random.RandomState(0)
+                         .randn(8, 16).astype(np.float32))
+        b1 = tf.Variable(np.zeros(16, np.float32))
+        w2 = tf.Variable(np.random.RandomState(1)
+                         .randn(16, 4).astype(np.float32))
+
+        def f(x):
+            h = tf.nn.relu(tf.matmul(x, w1) + b1)
+            return tf.nn.softmax(tf.matmul(h, w2))
+
+        x = np.random.RandomState(2).randn(3, 8).astype(np.float32)
+        _import_and_compare(f, {"x": x})
+
+    def test_shape_arith_reshape_chain(self):
+        def f(x):
+            s = tf.shape(x)
+            b = s[0]
+            flat = tf.reshape(x, tf.stack([b, -1]))
+            return tf.reduce_mean(flat, axis=1, keepdims=True)
+
+        x = np.random.RandomState(0).randn(2, 3, 4).astype(np.float32)
+        _import_and_compare(f, {"x": x})
+
+    def test_strided_slice_masks(self):
+        def f(x):
+            return x[:, 1:, ::2] + x[:, :-1, 1::2]
+
+        x = np.random.RandomState(0).randn(2, 5, 8).astype(np.float32)
+        _import_and_compare(f, {"x": x})
+
+    def test_concat_pad_tile(self):
+        def f(x):
+            y = tf.concat([x, x * 2.0], axis=-1)
+            y = tf.pad(y, [[0, 0], [1, 1]])
+            return tf.tile(y, [1, 2])
+
+        x = np.random.RandomState(0).randn(3, 4).astype(np.float32)
+        _import_and_compare(f, {"x": x})
+
+    def test_conv_bn_pool(self):
+        rs = np.random.RandomState(0)
+        k = tf.Variable(rs.randn(3, 3, 2, 4).astype(np.float32) * 0.1)
+        gamma = tf.Variable(np.ones(4, np.float32))
+        beta = tf.Variable(np.zeros(4, np.float32))
+        mean = tf.Variable(rs.randn(4).astype(np.float32) * 0.01)
+        var = tf.Variable(np.abs(rs.randn(4)).astype(np.float32) + 1.0)
+
+        def f(x):
+            y = tf.nn.conv2d(x, k, strides=1, padding="SAME")
+            y, _, _ = tf.compat.v1.nn.fused_batch_norm(
+                y, gamma, beta, mean, var, is_training=False)
+            y = tf.nn.max_pool2d(y, 2, 2, "VALID")
+            return tf.nn.relu(y)
+
+        x = rs.randn(2, 8, 8, 2).astype(np.float32)
+        _import_and_compare(f, {"x": x})
+
+    def test_gather_one_hot_argmax(self):
+        table = tf.Variable(np.random.RandomState(0)
+                            .randn(10, 6).astype(np.float32))
+
+        def f(ids):
+            emb = tf.gather(table, ids)
+            probs = tf.nn.softmax(emb, axis=-1)
+            am = tf.argmax(probs, axis=-1)
+            return tf.one_hot(am, 6)
+
+        ids = np.asarray([[1, 2], [7, 3]], np.int32)
+        _import_and_compare(f, {"ids": ids})
+
+    def test_legacy_mapper_front_door(self):
+        def f(x):
+            return tf.exp(x) * tf.sigmoid(x)
+
+        x = np.random.RandomState(0).randn(4).astype(np.float32)
+        gd_bytes, frozen = freeze(
+            f, tf.TensorSpec([4], tf.float32))
+        sd = TFGraphMapper.import_graph(gd_bytes, {"x": (4,)})
+        out = [n for n in sd.vars if n.startswith("Identity")][0]
+        got = sd.output({"x": x}, [out])[out]
+        np.testing.assert_allclose(
+            got, np.exp(x) / (1 + np.exp(-x)) * (1 + np.exp(-x))
+            * (1 / (1 + np.exp(-x))), atol=1e-5)
+
+    def test_unmapped_op_reports_names(self):
+        def f(x):
+            return tf.raw_ops.Betainc(a=x, b=x, x=x)
+
+        x = np.abs(np.random.RandomState(0).randn(3)
+                   .astype(np.float32)) + 0.5
+        gd_bytes, _ = freeze(f, tf.TensorSpec([3], tf.float32))
+        with pytest.raises(NotImplementedError, match="Betainc"):
+            TensorflowFrameworkImporter.run_import(gd_bytes,
+                                                   {"x": (3,)})
+
+
+class TestBertImport:
+    """Acceptance config #4 skeleton: BERT-class encoder via TF import
+    (BASELINE.md #4). A compact BERT encoder (embeddings + transformer
+    blocks with Einsum MHA + LayerNorm + GELU FFN + pooler) is frozen
+    from TF and must reproduce TF's outputs through the importer."""
+
+    def _build_bert(self, vocab=50, hidden=16, heads=2, layers=2,
+                    seq=12):
+        rs = np.random.RandomState(0)
+        p = {}
+        p["tok"] = tf.Variable(rs.randn(vocab, hidden)
+                               .astype(np.float32) * 0.1)
+        p["pos"] = tf.Variable(rs.randn(seq, hidden)
+                               .astype(np.float32) * 0.1)
+        p["seg"] = tf.Variable(rs.randn(2, hidden)
+                               .astype(np.float32) * 0.1)
+        for i in range(layers):
+            for nm in ["q", "k", "v", "o"]:
+                p[f"l{i}_{nm}w"] = tf.Variable(
+                    rs.randn(hidden, hidden).astype(np.float32) * 0.1)
+                p[f"l{i}_{nm}b"] = tf.Variable(
+                    np.zeros(hidden, np.float32))
+            p[f"l{i}_ffw1"] = tf.Variable(
+                rs.randn(hidden, hidden * 4).astype(np.float32) * 0.1)
+            p[f"l{i}_ffb1"] = tf.Variable(
+                np.zeros(hidden * 4, np.float32))
+            p[f"l{i}_ffw2"] = tf.Variable(
+                rs.randn(hidden * 4, hidden).astype(np.float32) * 0.1)
+            p[f"l{i}_ffb2"] = tf.Variable(np.zeros(hidden, np.float32))
+            for ln in ["ln1", "ln2"]:
+                p[f"l{i}_{ln}g"] = tf.Variable(np.ones(hidden,
+                                                       np.float32))
+                p[f"l{i}_{ln}b"] = tf.Variable(np.zeros(hidden,
+                                                        np.float32))
+        p["poolw"] = tf.Variable(rs.randn(hidden, hidden)
+                                 .astype(np.float32) * 0.1)
+        p["poolb"] = tf.Variable(np.zeros(hidden, np.float32))
+        self.heads = heads
+        self.hidden = hidden
+        self.layers = layers
+        return p
+
+    def _bert_fn(self, p):
+        heads, hidden, layers = self.heads, self.hidden, self.layers
+        hd = hidden // heads
+
+        def layer_norm(x, g, b):
+            mu = tf.reduce_mean(x, axis=-1, keepdims=True)
+            var = tf.reduce_mean(tf.math.squared_difference(x, mu),
+                                 axis=-1, keepdims=True)
+            return (x - mu) * tf.math.rsqrt(var + 1e-12) * g + b
+
+        def f(ids, seg, mask):
+            x = (tf.gather(p["tok"], ids) + p["pos"][None]
+                 + tf.gather(p["seg"], seg))
+            neg = (1.0 - tf.cast(mask, tf.float32)) * -1e9
+            neg = neg[:, None, None, :]
+            for i in range(layers):
+                def proj(nm, t):
+                    y = tf.matmul(t, p[f"l{i}_{nm}w"]) + p[f"l{i}_{nm}b"]
+                    s = tf.shape(y)
+                    y = tf.reshape(y, tf.stack([s[0], s[1], heads, hd]))
+                    return tf.transpose(y, [0, 2, 1, 3])
+
+                q, k, v = (proj("q", x), proj("k", x), proj("v", x))
+                scores = tf.matmul(q, k, transpose_b=True) \
+                    / np.float32(np.sqrt(hd))
+                probs = tf.nn.softmax(scores + neg, axis=-1)
+                ctxv = tf.transpose(tf.matmul(probs, v), [0, 2, 1, 3])
+                s = tf.shape(ctxv)
+                ctxv = tf.reshape(ctxv, tf.stack([s[0], s[1], hidden]))
+                att = tf.matmul(ctxv, p[f"l{i}_ow"]) + p[f"l{i}_ob"]
+                x = layer_norm(x + att, p[f"l{i}_ln1g"],
+                               p[f"l{i}_ln1b"])
+                h = tf.matmul(x, p[f"l{i}_ffw1"]) + p[f"l{i}_ffb1"]
+                h = 0.5 * h * (1.0 + tf.math.erf(
+                    h / np.float32(np.sqrt(2.0))))
+                h = tf.matmul(h, p[f"l{i}_ffw2"]) + p[f"l{i}_ffb2"]
+                x = layer_norm(x + h, p[f"l{i}_ln2g"], p[f"l{i}_ln2b"])
+            pooled = tf.tanh(
+                tf.matmul(x[:, 0], p["poolw"]) + p["poolb"])
+            return pooled
+
+        return f
+
+    def test_bert_encoder_conformance(self):
+        p = self._build_bert()
+        f = self._bert_fn(p)
+        rs = np.random.RandomState(3)
+        ids = rs.randint(0, 50, (2, 12)).astype(np.int32)
+        seg = np.zeros((2, 12), np.int32)
+        seg[:, 6:] = 1
+        mask = np.ones((2, 12), np.int32)
+        mask[1, 9:] = 0
+        _import_and_compare(
+            f, {"ids": ids, "seg": seg, "mask": mask}, atol=1e-4)
+
+    def test_bert_graph_reimport_roundtrip(self, tmp_path):
+        """Imported graph must survive our native save/load (S5)."""
+        p = self._build_bert(layers=1)
+        f = self._bert_fn(p)
+        ids = np.asarray([[1, 2, 3, 4, 5, 6, 7, 8, 9, 10, 11, 12]],
+                         np.int32)
+        seg = np.zeros((1, 12), np.int32)
+        mask = np.ones((1, 12), np.int32)
+        specs = [tf.TensorSpec(v.shape, tf.as_dtype(v.dtype))
+                 for v in (ids, seg, mask)]
+        gd_bytes, _ = freeze(f, *specs)
+        sd = TensorflowFrameworkImporter.run_import(
+            gd_bytes, {"ids": (1, 12), "seg": (1, 12),
+                       "mask": (1, 12)})
+        out = [n for n in sd.vars if n.startswith("Identity")][0]
+        want = sd.output({"ids": ids, "seg": seg, "mask": mask}, [out])
+        path = str(tmp_path / "bert.sdz")
+        sd.save(path)
+        from deeplearning4j_tpu.autodiff.samediff import SameDiff
+        sd2 = SameDiff.load(path)
+        got = sd2.output({"ids": ids, "seg": seg, "mask": mask}, [out])
+        np.testing.assert_allclose(got[out], want[out], atol=1e-6)
