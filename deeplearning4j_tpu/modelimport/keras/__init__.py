@@ -1,0 +1,5 @@
+"""Keras HDF5/.keras import (SURVEY.md D14)."""
+from deeplearning4j_tpu.modelimport.keras.importer import (
+    InvalidKerasConfigurationException, KerasModelImport)
+
+__all__ = ["KerasModelImport", "InvalidKerasConfigurationException"]

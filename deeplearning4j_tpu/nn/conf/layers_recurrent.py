@@ -227,12 +227,17 @@ class GRU(BaseRecurrentLayer):
         if self.has_bias:
             xw = xw + params["b"]
 
+        # optional recurrent bias on the candidate gate (set by the
+        # Keras importer for reset_after=True GRUs; absent otherwise)
+        rb = params.get("rb")
+
         def step(h_prev, inp):
             xw_t, m_t = inp
             hr = h_prev @ params["RW"]
             r = gate(xw_t[:, :H] + hr[:, :H])
             zt = gate(xw_t[:, H:2 * H] + hr[:, H:2 * H])
-            n = act(xw_t[:, 2 * H:] + r * hr[:, 2 * H:])
+            hr_n = hr[:, 2 * H:] if rb is None else hr[:, 2 * H:] + rb
+            n = act(xw_t[:, 2 * H:] + r * hr_n)
             h = (1 - zt) * n + zt * h_prev
             if m_t is not None:
                 h = jnp.where(m_t[:, None] > 0, h, h_prev)

@@ -1,0 +1,164 @@
+"""Keras import conformance (SURVEY.md D14, §4.6).
+
+The reference validates Keras import against stored .h5 fixtures whose
+activations were produced by Keras itself. Same protocol: models are
+built+saved with the in-image Keras, imported, and predictions compared
+against Keras outputs.
+"""
+import numpy as np
+import pytest
+
+tf = pytest.importorskip("tensorflow")
+keras = tf.keras
+
+from deeplearning4j_tpu.modelimport.keras import (  # noqa: E402
+    InvalidKerasConfigurationException, KerasModelImport)
+
+
+def _save(model, tmp_path, fmt):
+    path = str(tmp_path / f"model.{fmt}")
+    model.save(path)
+    return path
+
+
+def _compare_sequential(model, x, tmp_path, fmt="keras", atol=1e-4):
+    path = _save(model, tmp_path, fmt)
+    net = KerasModelImport.import_keras_sequential_model_and_weights(
+        path)
+    want = np.asarray(model(x, training=False))
+    got = net.output(x)
+    np.testing.assert_allclose(got, want, atol=atol, rtol=1e-3)
+    return net
+
+
+class TestSequentialImport:
+    def test_mlp_both_formats(self, tmp_path):
+        model = keras.Sequential([
+            keras.layers.Input((12,)),
+            keras.layers.Dense(16, activation="relu"),
+            keras.layers.Dense(5, activation="softmax"),
+        ])
+        x = np.random.RandomState(0).randn(4, 12).astype(np.float32)
+        _compare_sequential(model, x, tmp_path, "keras")
+        _compare_sequential(model, x, tmp_path, "h5")
+
+    def test_cnn_bn_pool_flatten(self, tmp_path):
+        model = keras.Sequential([
+            keras.layers.Input((12, 12, 3)),
+            keras.layers.Conv2D(8, 3, padding="same",
+                                activation="relu"),
+            keras.layers.BatchNormalization(),
+            keras.layers.MaxPooling2D(2),
+            keras.layers.Conv2D(4, 3, padding="valid"),
+            keras.layers.Activation("tanh"),
+            keras.layers.Flatten(),
+            keras.layers.Dense(7, activation="softmax"),
+        ])
+        # give BN non-trivial moving stats
+        model.layers[1].set_weights([
+            np.random.RandomState(1).rand(8).astype(np.float32) + 0.5,
+            np.random.RandomState(2).randn(8).astype(np.float32) * 0.1,
+            np.random.RandomState(3).randn(8).astype(np.float32) * 0.1,
+            np.random.RandomState(4).rand(8).astype(np.float32) + 0.5,
+        ])
+        x = np.random.RandomState(5).randn(2, 12, 12, 3) \
+            .astype(np.float32)
+        _compare_sequential(model, x, tmp_path)
+
+    def test_lstm_return_sequences_false(self, tmp_path):
+        model = keras.Sequential([
+            keras.layers.Input((7, 5)),
+            keras.layers.LSTM(6),
+            keras.layers.Dense(3, activation="softmax"),
+        ])
+        x = np.random.RandomState(0).randn(2, 7, 5).astype(np.float32)
+        _compare_sequential(model, x, tmp_path)
+
+    def test_gru_reset_after_bias(self, tmp_path):
+        model = keras.Sequential([
+            keras.layers.Input((5, 4)),
+            keras.layers.GRU(6, return_sequences=True),
+        ])
+        # nonzero recurrent candidate bias exercises the rb param
+        w = model.layers[0].get_weights()
+        w[2] = np.random.RandomState(0).randn(*w[2].shape) \
+            .astype(np.float32) * 0.3
+        model.layers[0].set_weights(w)
+        x = np.random.RandomState(1).randn(3, 5, 4).astype(np.float32)
+        _compare_sequential(model, x, tmp_path)
+
+    def test_simple_rnn_and_embedding(self, tmp_path):
+        model = keras.Sequential([
+            keras.layers.Input((6,)),
+            keras.layers.Embedding(20, 8),
+            keras.layers.SimpleRNN(5, activation="tanh"),
+            keras.layers.Dense(2, activation="softmax"),
+        ])
+        x = np.random.RandomState(0).randint(0, 20, (3, 6)) \
+            .astype(np.int32)
+        path = _save(model, tmp_path, "keras")
+        net = KerasModelImport \
+            .import_keras_sequential_model_and_weights(path)
+        want = np.asarray(model(x, training=False))
+        got = net.output(x.astype(np.float32))
+        np.testing.assert_allclose(got, want, atol=1e-4, rtol=1e-3)
+
+    def test_compiled_model_gets_output_layer(self, tmp_path):
+        model = keras.Sequential([
+            keras.layers.Input((4,)),
+            keras.layers.Dense(3, activation="softmax"),
+        ])
+        model.compile(loss="categorical_crossentropy", optimizer="sgd")
+        path = _save(model, tmp_path, "keras")
+        net = KerasModelImport \
+            .import_keras_sequential_model_and_weights(path)
+        from deeplearning4j_tpu.lossfunctions import LossFunction
+        from deeplearning4j_tpu.nn.conf.layers import OutputLayer
+        assert isinstance(net.conf.layers[-1], OutputLayer)
+        assert net.conf.layers[-1].loss_function is LossFunction.MCXENT
+        # and it can fit
+        x = np.random.RandomState(0).randn(8, 4).astype(np.float32)
+        y = np.eye(3, dtype=np.float32)[
+            np.random.RandomState(1).randint(0, 3, 8)]
+        net.fit(x, y)
+
+    def test_unsupported_layer_reports_type(self, tmp_path):
+        model = keras.Sequential([
+            keras.layers.Input((8, 8, 2)),
+            keras.layers.Conv2DTranspose(3, 2),
+        ])
+        path = _save(model, tmp_path, "keras")
+        with pytest.raises(InvalidKerasConfigurationException,
+                           match="Conv2DTranspose"):
+            KerasModelImport \
+                .import_keras_sequential_model_and_weights(path)
+
+
+class TestFunctionalImport:
+    def test_two_branch_residual(self, tmp_path):
+        inp = keras.Input((10,), name="feat")
+        a = keras.layers.Dense(8, activation="relu")(inp)
+        b = keras.layers.Dense(8, activation="tanh")(inp)
+        s = keras.layers.Add()([a, b])
+        out = keras.layers.Dense(4, activation="softmax")(s)
+        model = keras.Model(inp, out)
+        path = _save(model, tmp_path, "keras")
+        net = KerasModelImport.import_keras_model_and_weights(path)
+        x = np.random.RandomState(0).randn(3, 10).astype(np.float32)
+        want = np.asarray(model(x, training=False))
+        got = net.output(x)[0]
+        np.testing.assert_allclose(got, want, atol=1e-4, rtol=1e-3)
+
+    def test_concat_branches(self, tmp_path):
+        inp = keras.Input((6,))
+        a = keras.layers.Dense(4, activation="relu")(inp)
+        b = keras.layers.Dense(3, activation="sigmoid")(inp)
+        c = keras.layers.Concatenate()([a, b])
+        out = keras.layers.Dense(2)(c)
+        model = keras.Model(inp, out)
+        path = _save(model, tmp_path, "keras")
+        net = KerasModelImport.import_keras_model_and_weights(path)
+        x = np.random.RandomState(0).randn(5, 6).astype(np.float32)
+        want = np.asarray(model(x, training=False))
+        got = net.output(x)[0]
+        np.testing.assert_allclose(got, want, atol=1e-4, rtol=1e-3)
