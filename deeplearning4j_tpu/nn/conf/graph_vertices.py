@@ -73,6 +73,7 @@ class ElementWiseVertex(GraphVertex):
         Product = "product"
         Average = "average"
         Max = "max"
+        Min = "min"
 
     op: "ElementWiseVertex.Op" = None
 
@@ -105,6 +106,11 @@ class ElementWiseVertex(GraphVertex):
             out = inputs[0]
             for x in inputs[1:]:
                 out = jnp.maximum(out, x)
+            return out
+        if op is ElementWiseVertex.Op.Min:
+            out = inputs[0]
+            for x in inputs[1:]:
+                out = jnp.minimum(out, x)
             return out
         raise ValueError(op)
 

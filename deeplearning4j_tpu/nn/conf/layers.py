@@ -92,6 +92,19 @@ class Layer:
     dropout: Optional[float] = None               # retain probability
     name: Optional[str] = None
 
+    def __post_init__(self):
+        # accept strings for enum-typed fields (reference: DL4J builders
+        # take Activation.RELU; the string spelling is a convenience)
+        for f in ("activation", "gate_activation"):
+            v = getattr(self, f, None)
+            if isinstance(v, str):
+                setattr(self, f, Activation.from_name(v))
+        if isinstance(self.weight_init, str):
+            self.weight_init = WeightInit[self.weight_init.upper()]
+        lf = getattr(self, "loss_function", None)
+        if isinstance(lf, str):
+            self.loss_function = LossFunction[lf.upper()]
+
     # -- builder parity --------------------------------------------------
     @classmethod
     def Builder(cls, *args, **kwargs) -> _Builder:  # noqa: N802
@@ -165,15 +178,11 @@ class Layer:
     def from_map(d: dict) -> "Layer":
         d = dict(d)
         cls = LAYER_REGISTRY[d.pop("@class")]
+        # enum-name strings for activation/weight_init/loss_function are
+        # coerced by Layer.__post_init__; only non-Layer-field enums here
         for k, v in list(d.items()):
-            if k == "activation" and isinstance(v, str):
-                d[k] = Activation[v]
-            elif k == "weight_init" and isinstance(v, str):
-                d[k] = WeightInit[v]
-            elif k == "updater" and isinstance(v, dict):
+            if k == "updater" and isinstance(v, dict):
                 d[k] = IUpdater.from_map(v)
-            elif k == "loss_function" and isinstance(v, str):
-                d[k] = LossFunction[v]
             elif k in ("pooling_type",) and isinstance(v, str):
                 d[k] = PoolingType[v]
             elif k in ("convolution_mode",) and isinstance(v, str):
@@ -233,6 +242,7 @@ class ConvolutionLayer(Layer):
         return {"kernel_size": (int(args[0]), int(args[1]))}
 
     def __post_init__(self):
+        super().__post_init__()
         self.kernel_size = _pair(self.kernel_size)
         self.stride = _pair(self.stride)
         self.padding = _pair(self.padding)
@@ -317,6 +327,7 @@ class SubsamplingLayer(Layer):
         return out
 
     def __post_init__(self):
+        super().__post_init__()
         self.kernel_size = _pair(self.kernel_size)
         self.stride = _pair(self.stride)
         self.padding = _pair(self.padding)

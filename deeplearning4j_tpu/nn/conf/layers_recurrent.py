@@ -208,6 +208,9 @@ class GRU(BaseRecurrentLayer):
 
     gate_activation: Activation = Activation.SIGMOID
     has_bias: bool = True
+    # separate recurrent bias on the candidate gate, gated by r
+    # (Keras GRU reset_after=True semantics; set by the Keras importer)
+    recurrent_bias: bool = False
 
     def init_params(self, key, input_type, dtype=jnp.float32):
         wi = self.weight_init or WeightInit.XAVIER
@@ -217,6 +220,8 @@ class GRU(BaseRecurrentLayer):
              "RW": wi.init(k2, (H, 3 * H), H, H, dtype)}
         if self.has_bias:
             p["b"] = jnp.full((3 * H,), self.bias_init, dtype)
+        if self.recurrent_bias:
+            p["rb"] = jnp.zeros((H,), dtype)
         return p
 
     def _scan(self, params, x, state, mask):
@@ -263,6 +268,7 @@ class Bidirectional(BaseRecurrentLayer):
     mode: BidirectionalMode = BidirectionalMode.CONCAT
 
     def __post_init__(self):
+        super().__post_init__()
         if isinstance(self.mode, str):
             self.mode = BidirectionalMode[self.mode.upper()]
         if self.fwd is not None:

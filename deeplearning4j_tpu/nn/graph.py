@@ -329,6 +329,12 @@ class ComputationGraph:
         outs = [acts[n] for n in self.conf.network_outputs]
         return outs[0] if len(outs) == 1 else outs
 
+    def outputs(self, *inputs, train: bool = False, mask=None) -> list:
+        """Always-a-list variant (reference: ComputationGraph.output
+        returns INDArray[] regardless of output count)."""
+        out = self.output(*inputs, train=train, mask=mask)
+        return out if isinstance(out, list) else [out]
+
     def predict(self, *inputs) -> np.ndarray:
         out = self.output(*inputs)
         if isinstance(out, list):

@@ -146,7 +146,7 @@ class TestFunctionalImport:
         net = KerasModelImport.import_keras_model_and_weights(path)
         x = np.random.RandomState(0).randn(3, 10).astype(np.float32)
         want = np.asarray(model(x, training=False))
-        got = net.output(x)[0]
+        got = net.outputs(x)[0]
         np.testing.assert_allclose(got, want, atol=1e-4, rtol=1e-3)
 
     def test_concat_branches(self, tmp_path):
@@ -160,5 +160,5 @@ class TestFunctionalImport:
         net = KerasModelImport.import_keras_model_and_weights(path)
         x = np.random.RandomState(0).randn(5, 6).astype(np.float32)
         want = np.asarray(model(x, training=False))
-        got = net.output(x)[0]
+        got = net.outputs(x)[0]
         np.testing.assert_allclose(got, want, atol=1e-4, rtol=1e-3)
