@@ -147,3 +147,23 @@ def pad_batch_to_multiple(x, n: int):
     pad = n - rem
     reps = jnp.repeat(x[-1:], pad, axis=0)
     return jnp.concatenate([x, reps], axis=0), b
+
+
+def shard_map(f, mesh: Mesh, *, in_specs, out_specs):
+    """jax.shard_map across jax versions (experimental alias pre-0.8).
+    The package-public seam every parallel module builds on."""
+    if hasattr(jax, "shard_map"):
+        return jax.shard_map(f, mesh=mesh, in_specs=in_specs,
+                             out_specs=out_specs)
+    from jax.experimental.shard_map import shard_map as _sm
+    return _sm(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs)
+
+
+def axis_size(axis: str) -> int:
+    """Concrete size of a mesh axis from inside shard_map tracing
+    (the mesh is static, so this is a Python int on every jax we
+    support)."""
+    from jax import lax
+    if hasattr(lax, "axis_size"):
+        return int(lax.axis_size(axis))
+    return int(lax.psum(1, axis))

@@ -1,0 +1,143 @@
+"""Pipeline parallelism — GPipe-style microbatching over a mesh axis.
+
+The reference has NO pipeline parallelism (SURVEY.md §2.6 P8: ABSENT).
+This is the TPU-native extension: the layer stack is split into
+``n_stages`` contiguous stages laid out along a mesh ``pipe`` axis;
+microbatches stream through the stages with activations handed to the
+next stage via ``lax.ppermute`` (a neighbor exchange that rides ICI).
+
+Everything is expressed as ONE ``lax.scan`` over clock ticks inside
+``shard_map``, so:
+- XLA sees a static loop — compiles once, overlaps the ppermute with
+  the next tick's compute where possible;
+- the schedule is fully differentiable: the VJP of ``ppermute`` is the
+  reverse permute and the VJP of ``scan`` is a reverse-time scan, so
+  ``jax.grad`` of a pipelined loss IS the backward pipeline (bubbles
+  and all) with no hand-written 1F1B machinery;
+- ``jax.checkpoint`` on the stage fn gives the standard
+  remat-per-microbatch memory policy.
+
+Bubble fraction is the GPipe ``(S-1)/(M+S-1)``; pick
+``n_micro >> n_stages`` to amortise.
+"""
+from __future__ import annotations
+
+from typing import Callable, Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+PIPE_AXIS = "pipe"
+
+
+def to_microbatches(x, n_micro: int):
+    """[b, ...] -> [n_micro, b/n_micro, ...] (leading-dim split)."""
+    b = x.shape[0]
+    if b % n_micro:
+        raise ValueError(f"batch {b} not divisible by {n_micro} microbatches")
+    return x.reshape((n_micro, b // n_micro) + x.shape[1:])
+
+
+def from_microbatches(x):
+    return x.reshape((-1,) + x.shape[2:])
+
+
+def pipeline_apply(stage_fn: Callable, stage_params, x_micro,
+                   axis: str = PIPE_AXIS,
+                   remat: bool = False,
+                   with_aux: bool = False,
+                   varying_axes: Optional[tuple] = None):
+    """Run microbatches through the stage pipeline (inside shard_map).
+
+    stage_fn(params, x) -> y with ``y.shape == x.shape`` (transformer
+    blocks preserve [mb, t, d], so stacks satisfy this naturally).
+    ``stage_params`` are THIS device's stage weights. ``x_micro`` is
+    [n_micro, mb, ...], same on every stage (only stage 0 reads it).
+    Returns [n_micro, mb, ...]; rows are valid on the LAST stage.
+
+    With ``with_aux`` the stage fn returns ``(y, aux_scalar)`` (e.g. a
+    MoE load-balancing loss); returns ``(outputs, aux_sum)`` where
+    ``aux_sum`` accumulates only *valid* ticks — warm-up/drain bubble
+    ticks compute on garbage activations and must not contribute.
+    """
+    n_st = _axis_size_concrete(axis)
+    stage = lax.axis_index(axis)
+    n_micro = x_micro.shape[0]
+    raw = stage_fn if with_aux else (
+        lambda p, x: (stage_fn(p, x), jnp.zeros((), x.dtype)))
+    fn = jax.checkpoint(raw) if remat else raw
+    perm = [(i, (i + 1) % n_st) for i in range(n_st)]
+
+    def tick(carry, t):
+        state, outputs, aux_acc = carry
+        inject = x_micro[jnp.clip(t, 0, n_micro - 1)]
+        x_in = jnp.where(stage == 0, inject, state)
+        y, aux = fn(stage_params, x_in)
+        valid = (t >= stage) & (t - stage < n_micro)
+        aux_acc = aux_acc + jnp.where(valid, aux, 0.0)
+        out_idx = jnp.clip(t - (n_st - 1), 0, n_micro - 1)
+        collect = (stage == n_st - 1) & (t >= n_st - 1)
+        outputs = jnp.where(collect, outputs.at[out_idx].set(y), outputs)
+        state = lax.ppermute(y, axis, perm)
+        return (state, outputs, aux_acc), None
+
+    vaxes = tuple(varying_axes) if varying_axes else (axis,)
+    state0 = _varying(jnp.zeros_like(x_micro[0]), vaxes)
+    out0 = _varying(jnp.zeros_like(x_micro), vaxes)
+    aux0 = _varying(jnp.zeros((), x_micro.dtype), vaxes)
+    (_, outputs, aux_sum), _ = lax.scan(
+        tick, (state0, out0, aux0), jnp.arange(n_micro + n_st - 1))
+    if with_aux:
+        return outputs, aux_sum
+    return outputs
+
+
+def _varying(x, axes):
+    """Mark x as device-varying over ``axes`` (shard_map VMA typing —
+    the scan carry differs per stage even though it starts as zeros;
+    with MoE/DP inside the stage fn it also varies over those axes)."""
+    axes = (axes,) if isinstance(axes, str) else tuple(axes)
+    have = getattr(getattr(x, "aval", None), "vma", frozenset())
+    axes = tuple(a for a in axes if a not in have)
+    if not axes:
+        return x
+    if hasattr(lax, "pcast"):
+        return lax.pcast(x, axes, to="varying")
+    if hasattr(lax, "pvary"):
+        return lax.pvary(x, axes)
+    return x
+
+
+from .mesh import axis_size as _axis_size_concrete  # shared helper
+
+
+def last_stage_only(value, axis: str = PIPE_AXIS):
+    """Zero ``value`` except on the last pipeline stage, then psum —
+    every stage ends up holding the last stage's value (the way a
+    pipelined loss becomes a global scalar)."""
+    n_st = lax.psum(1, axis)
+    stage = lax.axis_index(axis)
+    keep = (stage == n_st - 1).astype(value.dtype)
+    return lax.psum(value * keep, axis)
+
+
+def pipeline_loss(stage_fn: Callable, loss_fn: Callable, stage_params,
+                  x_micro, y_micro, axis: str = PIPE_AXIS,
+                  remat: bool = False):
+    """Forward the pipeline and reduce a mean loss on the last stage.
+
+    loss_fn(outputs_mb, labels_mb) -> scalar mean loss per microbatch.
+    Returns the same scalar on every stage (safe to grad through).
+    """
+    outs = pipeline_apply(stage_fn, stage_params, x_micro, axis, remat)
+    n_micro = x_micro.shape[0]
+    per_mb = jax.vmap(loss_fn)(outs, y_micro)
+    return last_stage_only(jnp.mean(per_mb), axis)
+
+
+def init_stage_params(init_fn: Callable, axis: str = PIPE_AXIS):
+    """Build THIS stage's params inside shard_map:
+    ``init_fn(stage_index) -> params pytree`` (use lax.switch or
+    index-folded RNG keys inside)."""
+    return init_fn(lax.axis_index(axis))

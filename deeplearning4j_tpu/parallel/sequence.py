@@ -38,13 +38,7 @@ from jax import lax
 NEG_INF = -1e30
 
 
-def _shard_map(f, mesh, *, in_specs, out_specs):
-    """jax.shard_map across jax versions (experimental alias pre-0.8)."""
-    if hasattr(jax, "shard_map"):
-        return jax.shard_map(f, mesh=mesh, in_specs=in_specs,
-                             out_specs=out_specs)
-    from jax.experimental.shard_map import shard_map as _sm
-    return _sm(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs)
+from .mesh import shard_map as _shard_map  # public seam, re-exported
 
 
 # ---------------------------------------------------------------------------

@@ -1,0 +1,226 @@
+"""Tensor (model) parallelism — megatron-style layer sharding.
+
+The reference has NO tensor parallelism (SURVEY.md §2.6 P7: ABSENT —
+its in-node strategy is whole-model replicas per device,
+`org.deeplearning4j.parallelism.ParallelWrapper`). This module is the
+TPU-native extension: weight matrices are split across a mesh ``model``
+axis and XLA collectives (psum / reduce_scatter / all_gather over ICI)
+stitch the math back together.
+
+Two classic layouts (Megatron-LM):
+
+- **column parallel**: ``W: [d_in, d_out/tp]`` — input replicated,
+  output feature-sharded. No communication in forward; the backward
+  pass psums dX (shard_map autodiff inserts it from the in_specs).
+- **row parallel**: ``W: [d_in/tp, d_out]`` — input feature-sharded,
+  output needs a psum (ICI all-reduce). Bias added once, after the sum.
+
+A transformer block does column→row for both the QKV/out-proj pair
+(heads shard over ``model``) and the MLP up/down pair, so each block
+costs exactly two all-reduces forward — the canonical TP recipe.
+
+**Megatron sequence parallelism** (``sequence_parallel=True``): the
+residual stream stays sharded along *time* over the SAME ``model``
+axis in the norm/residual regions; each all-reduce is replaced by an
+all_gather (entering a TP region) + reduce_scatter (leaving it) pair —
+same bytes on the wire, but activation memory per chip drops to
+``t/tp``. This is SP in the Megatron sense; ring/Ulysses CP over a
+dedicated ``seq`` axis lives in :mod:`.sequence`.
+
+All functions here are *manual-collective* primitives meant to run
+inside ``jax.shard_map`` (the pipeline runtime wraps everything in one
+shard_map over the full mesh). ``axis`` is the mesh axis name.
+"""
+from __future__ import annotations
+
+from typing import Callable, Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..ops.attention import dot_product_attention
+from .mesh import axis_size
+
+MODEL_AXIS = "model"
+
+
+# ---------------------------------------------------------------------------
+# parallel dense primitives (inside shard_map)
+# ---------------------------------------------------------------------------
+def column_parallel_dense(x, w, b=None):
+    """x replicated over tp, w/b local output-shards -> sharded output."""
+    y = x @ w
+    if b is not None:
+        y = y + b
+    return y
+
+
+def row_parallel_dense(x, w, b=None, axis: str = MODEL_AXIS):
+    """x feature-sharded, w local input-shard -> full (replicated) output.
+
+    The psum is the TP all-reduce (rides ICI when ``model`` is laid out
+    on an ICI dimension of the physical mesh)."""
+    y = lax.psum(x @ w, axis)
+    if b is not None:
+        y = y + b
+    return y
+
+
+def row_parallel_dense_scatter(x, w, b=None, axis: str = MODEL_AXIS,
+                               seq_dim: int = 1):
+    """Row-parallel dense that leaves the output *sequence*-sharded:
+    reduce_scatter over ``axis`` along ``seq_dim`` instead of psum.
+    The exit collective of a TP region under Megatron-SP."""
+    y = lax.psum_scatter(x @ w, axis, scatter_dimension=seq_dim,
+                         tiled=True)
+    if b is not None:
+        y = y + b
+    return y
+
+
+def sp_all_gather(x, axis: str = MODEL_AXIS, seq_dim: int = 1):
+    """Gather the time dimension from the model axis (enter TP region)."""
+    return lax.all_gather(x, axis, axis=seq_dim, tiled=True)
+
+
+# ---------------------------------------------------------------------------
+# TP transformer pieces
+# ---------------------------------------------------------------------------
+def tp_mlp(x, params, axis: str = MODEL_AXIS,
+           activation: Callable = jax.nn.gelu,
+           sequence_parallel: bool = False):
+    """Column→row parallel 2-layer MLP.
+
+    params: ``Wi [d, ff/tp]``, ``bi [ff/tp]``, ``Wo [ff/tp, d]``,
+    ``bo [d]`` (bo must be identical on all tp shards).
+    With ``sequence_parallel`` x is [b, t/tp, d] in and out.
+    """
+    if sequence_parallel:
+        x = sp_all_gather(x, axis)
+    h = activation(column_parallel_dense(x, params["Wi"], params["bi"]))
+    if sequence_parallel:
+        return row_parallel_dense_scatter(h, params["Wo"], params["bo"],
+                                          axis)
+    return row_parallel_dense(h, params["Wo"], params["bo"], axis)
+
+
+def tp_self_attention(x, params, n_heads_local: int,
+                      axis: str = MODEL_AXIS, mask=None,
+                      sequence_parallel: bool = False):
+    """Multi-head self-attention with heads sharded over ``axis``.
+
+    params: ``Wq/Wk/Wv [d, h_local*dh]``, ``Wo [h_local*dh, d]``,
+    ``bo [d]`` (replicated). QKV projections are column-parallel (no
+    comm), attention runs on local heads, out-proj is row-parallel.
+    x: [b, t, d] (or [b, t/tp, d] under sequence_parallel).
+    """
+    if sequence_parallel:
+        x = sp_all_gather(x, axis)
+    b, t, d = x.shape
+    dh = params["Wq"].shape[-1] // n_heads_local
+
+    def heads(a):
+        return a.reshape(b, t, n_heads_local, dh).transpose(0, 2, 1, 3)
+
+    q = heads(x @ params["Wq"])
+    k = heads(x @ params["Wk"])
+    v = heads(x @ params["Wv"])
+    o = dot_product_attention(q, k, v, mask)
+    o = o.transpose(0, 2, 1, 3).reshape(b, t, n_heads_local * dh)
+    if sequence_parallel:
+        return row_parallel_dense_scatter(o, params["Wo"], params["bo"],
+                                          axis)
+    return row_parallel_dense(o, params["Wo"], params["bo"], axis)
+
+
+def layer_norm(x, g, b, eps: float = 1e-5):
+    m = jnp.mean(x, -1, keepdims=True)
+    v = jnp.mean(jnp.square(x - m), -1, keepdims=True)
+    return (x - m) * lax.rsqrt(v + eps) * g + b
+
+
+def tp_transformer_block(x, params, n_heads_local: int,
+                         axis: str = MODEL_AXIS, mask=None,
+                         activation: Callable = jax.nn.gelu,
+                         sequence_parallel: bool = False,
+                         mlp_fn: Optional[Callable] = None):
+    """Pre-LN transformer block, TP (optionally Megatron-SP) sharded.
+
+    ``mlp_fn(h) -> h`` overrides the dense MLP (the MoE hook). Under
+    sequence_parallel the norms/residuals run on [b, t/tp, d] shards —
+    exactly the memory saving Megatron-SP exists for.
+    """
+    h = layer_norm(x, params["ln1_g"], params["ln1_b"])
+    x = x + tp_self_attention(h, params["attn"], n_heads_local, axis,
+                              mask, sequence_parallel=sequence_parallel)
+    h = layer_norm(x, params["ln2_g"], params["ln2_b"])
+    if mlp_fn is not None:
+        return x + mlp_fn(h)
+    return x + tp_mlp(h, params["mlp"], axis, activation,
+                      sequence_parallel=sequence_parallel)
+
+
+# ---------------------------------------------------------------------------
+# param init (local shards built from a global spec, deterministic)
+# ---------------------------------------------------------------------------
+def init_tp_block_params(key, d_model: int, n_heads: int, d_ff: int,
+                         tp: int, tp_rank, dtype=jnp.float32):
+    """Build ONE tp-shard of a block's params. Each shard slices the
+    same globally-initialized weights, so (tp=k) == (tp=1) numerically.
+
+    ``tp_rank`` may be a traced value (lax.axis_index) — slicing uses
+    dynamic_slice so this works inside shard_map."""
+    ks = jax.random.split(key, 4)
+    dh = d_model // n_heads
+
+    def col_shard(k, d_in, d_out):  # [d_in, d_out] -> local [d_in, d_out/tp]
+        w = jax.random.normal(k, (d_in, d_out), dtype) * (d_in ** -0.5)
+        return lax.dynamic_slice_in_dim(
+            w, tp_rank * (d_out // tp), d_out // tp, axis=1)
+
+    def row_shard(k, d_in, d_out):  # local [d_in/tp, d_out]
+        w = jax.random.normal(k, (d_in, d_out), dtype) * (d_in ** -0.5)
+        return lax.dynamic_slice_in_dim(
+            w, tp_rank * (d_in // tp), d_in // tp, axis=0)
+
+    return {
+        "ln1_g": jnp.ones((d_model,), dtype),
+        "ln1_b": jnp.zeros((d_model,), dtype),
+        "ln2_g": jnp.ones((d_model,), dtype),
+        "ln2_b": jnp.zeros((d_model,), dtype),
+        "attn": {
+            "Wq": col_shard(ks[0], d_model, n_heads * dh),
+            "Wk": col_shard(jax.random.fold_in(ks[0], 1), d_model,
+                            n_heads * dh),
+            "Wv": col_shard(jax.random.fold_in(ks[0], 2), d_model,
+                            n_heads * dh),
+            "Wo": row_shard(ks[1], n_heads * dh, d_model),
+            "bo": jnp.zeros((d_model,), dtype),
+        },
+        "mlp": {
+            "Wi": col_shard(ks[2], d_model, d_ff),
+            "bi": jnp.zeros((d_ff // tp,), dtype),
+            "Wo": row_shard(ks[3], d_ff, d_model),
+            "bo": jnp.zeros((d_model,), dtype),
+        },
+    }
+
+
+# ---------------------------------------------------------------------------
+# GSPMD PartitionSpec rules (the pjit/auto-sharding path)
+# ---------------------------------------------------------------------------
+def megatron_specs(axis: str = MODEL_AXIS):
+    """PartitionSpecs for a tp block's params under GSPMD auto
+    partitioning (annotate params with NamedSharding(mesh, spec) and
+    jit — XLA inserts the same collectives the manual path spells
+    out). Keys mirror :func:`init_tp_block_params`."""
+    from jax.sharding import PartitionSpec as P
+    col = P(None, axis)
+    row = P(axis, None)
+    rep = P()
+    return {
+        "ln1_g": rep, "ln1_b": rep, "ln2_g": rep, "ln2_b": rep,
+        "attn": {"Wq": col, "Wk": col, "Wv": col, "Wo": row, "bo": rep},
+        "mlp": {"Wi": col, "bi": P(axis), "Wo": row, "bo": rep},
+    }

@@ -1,0 +1,163 @@
+"""Tensor-parallelism tests (SURVEY.md §2.6 P7 — TPU-native extension).
+
+Every TP-sharded form must match its single-device (tp=1) equivalent,
+forward AND backward, on the virtual 8-device CPU mesh (conftest)."""
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import PartitionSpec as P
+
+from deeplearning4j_tpu.parallel import make_mesh
+from deeplearning4j_tpu.parallel.sequence import _shard_map
+from deeplearning4j_tpu.parallel.tensor import (
+    init_tp_block_params, tp_mlp, tp_self_attention,
+    tp_transformer_block)
+
+B, T, D, H, FF = 2, 16, 32, 4, 64
+
+
+def _x(seed=0):
+    rng = np.random.RandomState(seed)
+    return jnp.asarray(rng.randn(B, T, D).astype(np.float32))
+
+
+def _ref_params():
+    """tp=1 params (the full weights every sharded run slices)."""
+    return init_tp_block_params(jax.random.PRNGKey(7), D, H, FF,
+                                tp=1, tp_rank=0)
+
+
+def _run_sharded(fn, x, tp, sequence_parallel=False):
+    """Run fn(params_shard, x) under shard_map over a model axis of
+    size ``tp``; params are built per-rank inside the shard_map so each
+    device holds only its slice."""
+    mesh = make_mesh({"model": tp}, jax.devices()[:tp])
+
+    def body(xs):
+        rank = jax.lax.axis_index("model")
+        params = init_tp_block_params(jax.random.PRNGKey(7), D, H, FF,
+                                      tp=tp, tp_rank=rank)
+        return fn(params, xs)
+
+    in_spec = P(None, "model", None) if sequence_parallel else P()
+    out_spec = in_spec
+    return _shard_map(body, mesh, in_specs=(in_spec,),
+                      out_specs=out_spec)(x)
+
+
+class TestTpMlp:
+    @pytest.mark.parametrize("tp", [2, 4])
+    def test_matches_dense(self, tp):
+        x = _x()
+        ref = tp_mlp_ref(x)
+        out = _run_sharded(
+            lambda p, xs: tp_mlp(xs, p["mlp"]), x, tp)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   atol=1e-5)
+
+    def test_sequence_parallel_matches(self):
+        x = _x()
+        ref = tp_mlp_ref(x)
+        out = _run_sharded(
+            lambda p, xs: tp_mlp(xs, p["mlp"], sequence_parallel=True),
+            x, tp=4, sequence_parallel=True)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   atol=1e-5)
+
+
+def tp_mlp_ref(x):
+    p = _ref_params()
+    return tp_mlp_local(x, p["mlp"])
+
+
+def tp_mlp_local(x, mp):
+    return jax.nn.gelu(x @ mp["Wi"] + mp["bi"]) @ mp["Wo"] + mp["bo"]
+
+
+def attn_ref(x):
+    from deeplearning4j_tpu.ops.attention import dot_product_attention
+    p = _ref_params()["attn"]
+    dh = D // H
+
+    def heads(a):
+        return a.reshape(B, T, H, dh).transpose(0, 2, 1, 3)
+
+    o = dot_product_attention(heads(x @ p["Wq"]), heads(x @ p["Wk"]),
+                              heads(x @ p["Wv"]))
+    return o.transpose(0, 2, 1, 3).reshape(B, T, D) @ p["Wo"] + p["bo"]
+
+
+class TestTpAttention:
+    @pytest.mark.parametrize("tp", [2, 4])
+    def test_matches_dense(self, tp):
+        x = _x()
+        out = _run_sharded(
+            lambda p, xs: tp_self_attention(xs, p["attn"], H // tp),
+            x, tp)
+        np.testing.assert_allclose(np.asarray(out),
+                                   np.asarray(attn_ref(x)), atol=1e-5)
+
+    def test_sequence_parallel_matches(self):
+        x = _x()
+        out = _run_sharded(
+            lambda p, xs: tp_self_attention(xs, p["attn"], H // 2,
+                                            sequence_parallel=True),
+            x, tp=2, sequence_parallel=True)
+        np.testing.assert_allclose(np.asarray(out),
+                                   np.asarray(attn_ref(x)), atol=1e-5)
+
+
+class TestTpBlock:
+    def block_ref(self, x):
+        p = _ref_params()
+        from deeplearning4j_tpu.parallel.tensor import layer_norm
+        h = layer_norm(x, p["ln1_g"], p["ln1_b"])
+        x = x + attn_ref_p(h, p["attn"])
+        h = layer_norm(x, p["ln2_g"], p["ln2_b"])
+        return x + tp_mlp_local(h, p["mlp"])
+
+    @pytest.mark.parametrize("sp", [False, True])
+    def test_matches_dense(self, sp):
+        x = _x(3)
+        tp = 2
+        out = _run_sharded(
+            lambda p, xs: tp_transformer_block(
+                xs, p, H // tp, sequence_parallel=sp),
+            x, tp, sequence_parallel=sp)
+        np.testing.assert_allclose(np.asarray(out),
+                                   np.asarray(self.block_ref(x)),
+                                   atol=2e-5)
+
+    def test_gradients_match(self):
+        """Backward through the sharded block == backward through the
+        dense block (shard_map transposes the collectives)."""
+        x = _x(5)
+        tp = 2
+
+        def loss_sharded(xs):
+            out = _run_sharded(
+                lambda p, z: tp_transformer_block(z, p, H // tp), xs, tp)
+            return jnp.sum(out ** 2)
+
+        def loss_ref(xs):
+            return jnp.sum(self.block_ref(xs) ** 2)
+
+        g1 = jax.grad(loss_sharded)(x)
+        g2 = jax.grad(loss_ref)(x)
+        np.testing.assert_allclose(np.asarray(g1), np.asarray(g2),
+                                   atol=5e-4, rtol=1e-4)
+
+
+def attn_ref_p(x, p):
+    from deeplearning4j_tpu.ops.attention import dot_product_attention
+    dh = D // H
+
+    def heads(a):
+        return a.reshape(B, T, H, dh).transpose(0, 2, 1, 3)
+
+    o = dot_product_attention(heads(x @ p["Wq"]), heads(x @ p["Wk"]),
+                              heads(x @ p["Wv"]))
+    return o.transpose(0, 2, 1, 3).reshape(B, T, D) @ p["Wo"] + p["bo"]
