@@ -125,14 +125,26 @@ class AsyncDataSetIterator(DataSetIterator):
 
     _SENTINEL = object()
 
-    def __init__(self, base: DataSetIterator, queue_size: int = 4):
+    def __init__(self, base: DataSetIterator, queue_size: int = 4,
+                 use_native: bool = True):
         super().__init__()
         self._base = base
         self._queue_size = max(1, queue_size)
-        self._queue: queue.Queue = queue.Queue(self._queue_size)
+        self._use_native = use_native
+        self._queue = self._make_queue()
         self._thread: Optional[threading.Thread] = None
         self._next = None
         self._started = False
+
+    def _make_queue(self):
+        """The bounded ring between the feeder thread and fit() is the
+        native pthread queue when the C++ runtime is built (reference:
+        the native workspace-backed async queue), else queue.Queue."""
+        if self._use_native:
+            from deeplearning4j_tpu.native import NativeQueue, available
+            if available():
+                return NativeQueue(self._queue_size)
+        return queue.Queue(self._queue_size)
 
     def _feeder(self):
         self._base.reset()
@@ -141,12 +153,21 @@ class AsyncDataSetIterator(DataSetIterator):
         self._queue.put(self._SENTINEL)
 
     def reset(self):
-        if self._thread is not None and self._thread.is_alive():
-            # drain so the old feeder can finish
-            while self._queue.get() is not self._SENTINEL:
-                pass
-            self._thread.join()
-        self._queue = queue.Queue(self._queue_size)
+        t = self._thread
+        if t is not None and t.is_alive():
+            # Drain so the old feeder can finish. Timed gets, because
+            # the sentinel may ALREADY have been consumed (iterator
+            # fully exhausted) while the feeder is still between its
+            # final put and thread exit — a blocking get would then
+            # wait forever on a producer that never pushes again.
+            while t.is_alive():
+                try:
+                    if self._queue.get(timeout=0.05) is self._SENTINEL:
+                        break
+                except Exception:   # Empty timeout / closed: re-check
+                    continue
+            t.join()
+        self._queue = self._make_queue()
         self._thread = threading.Thread(target=self._feeder, daemon=True)
         self._thread.start()
         self._started = True

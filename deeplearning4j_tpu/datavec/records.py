@@ -94,6 +94,19 @@ class CSVRecordReader(LineRecordReader):
                                    quotechar=self.quote))
             yield [Text(f) for f in row]
 
+    def numeric_matrix(self, split=None):
+        """Bulk-parse the whole split as a float32 [rows, cols] matrix
+        via the native CSV parser (C++ fast path, SURVEY.md V1's
+        high-rate ingest; falls back to Python parsing). Use for
+        all-numeric files — the record iterator handles mixed types."""
+        import numpy as _np
+        from deeplearning4j_tpu.native import parse_csv_floats
+        if split is not None:
+            self.initialize(split)
+        text = "\n".join(l for i, l in enumerate(self._lines())
+                         if i >= self.skip)
+        return _np.asarray(parse_csv_floats(text, self.delimiter))
+
 
 class CollectionRecordReader(RecordReader):
     """In-memory records (reference: CollectionRecordReader)."""

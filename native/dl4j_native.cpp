@@ -1,0 +1,318 @@
+// dl4j_native — host-side C++ runtime for deeplearning4j_tpu.
+//
+// The reference keeps its performance-critical host runtime native
+// (SURVEY.md §2.1: libnd4j memory/workspaces N12, execution runtime
+// N13, threshold encode/decode compression ops J11/P2, Aeron chunk
+// CRC §5.8, DataVec parsing V1). On TPU the *device* math belongs to
+// XLA, but the host-side runtime around it is still native here:
+//
+//  - threshold gradient codec (the reference's native encoder behind
+//    EncodedGradientsAccumulator): sparse ±tau encoding + residual
+//  - CRC32 for chunked tensor transfer integrity
+//  - arena allocator (workspace-style host staging buffers)
+//  - pthread bounded ring queue (async data-prefetch backbone)
+//  - CSV float parser (DataVec record-reader fast path)
+//  - Kahn toposort (graph-session scheduling)
+//
+// Flat C ABI (extern "C"), bound from Python via ctypes — the same
+// seam style as the reference's NativeOps.h (SURVEY.md N14), minus JNI.
+//
+// Build: make -C native   (g++ -O3 -fPIC -shared -pthread)
+
+#include <atomic>
+#include <chrono>
+#include <cstdint>
+#include <cstdlib>
+#include <cstring>
+#include <cstdio>
+#include <cmath>
+#include <condition_variable>
+#include <mutex>
+#include <new>
+#include <vector>
+
+extern "C" {
+
+// ---------------------------------------------------------------------------
+// CRC32 (IEEE 802.3, zlib-compatible) — chunk integrity for tensor
+// transfer, parity with the reference's Aeron chunk CRC.
+// ---------------------------------------------------------------------------
+static uint32_t g_crc_table[256];
+static std::atomic<int> g_crc_ready{0};
+
+static void crc_init() {
+    for (uint32_t i = 0; i < 256; ++i) {
+        uint32_t c = i;
+        for (int k = 0; k < 8; ++k)
+            c = (c & 1) ? 0xEDB88320u ^ (c >> 1) : c >> 1;
+        g_crc_table[i] = c;
+    }
+    g_crc_ready.store(1);
+}
+
+uint32_t dl4j_crc32(const uint8_t* data, int64_t n) {
+    if (!g_crc_ready.load()) crc_init();
+    uint32_t c = 0xFFFFFFFFu;
+    for (int64_t i = 0; i < n; ++i)
+        c = g_crc_table[(c ^ data[i]) & 0xFF] ^ (c >> 8);
+    return c ^ 0xFFFFFFFFu;
+}
+
+// ---------------------------------------------------------------------------
+// Threshold gradient codec (reference: native encodeThreshold /
+// decodeThreshold ops feeding EncodedGradientsAccumulator, SURVEY.md
+// P2). Encoding: for every |g[i]| >= tau emit sign(g[i]) * (i + 1)
+// as int32. Decode adds ±tau into the target buffer. The residual
+// update subtracts the transmitted part, keeping the untransmitted
+// remainder for the next step.
+// ---------------------------------------------------------------------------
+int64_t dl4j_threshold_encode(const float* g, int64_t n, float tau,
+                              int32_t* out, int64_t cap) {
+    int64_t k = 0;
+    for (int64_t i = 0; i < n; ++i) {
+        float v = g[i];
+        if (v >= tau) {
+            if (k < cap) out[k] = (int32_t)(i + 1);
+            ++k;
+        } else if (v <= -tau) {
+            if (k < cap) out[k] = -(int32_t)(i + 1);
+            ++k;
+        }
+    }
+    return k;  // caller re-runs with bigger cap if k > cap
+}
+
+void dl4j_threshold_decode(const int32_t* enc, int64_t k, float tau,
+                           float* out, int64_t n) {
+    for (int64_t j = 0; j < k; ++j) {
+        int32_t e = enc[j];
+        int64_t idx = (e > 0 ? e : -e) - 1;
+        if (idx >= 0 && idx < n) out[idx] += (e > 0) ? tau : -tau;
+    }
+}
+
+void dl4j_threshold_residual(float* residual, const int32_t* enc,
+                             int64_t k, float tau, int64_t n) {
+    for (int64_t j = 0; j < k; ++j) {
+        int32_t e = enc[j];
+        int64_t idx = (e > 0 ? e : -e) - 1;
+        if (idx >= 0 && idx < n) residual[idx] -= (e > 0) ? tau : -tau;
+    }
+}
+
+// ---------------------------------------------------------------------------
+// Arena allocator — workspace-style bump allocator for host staging
+// buffers (reference MemoryWorkspace / libnd4j memory N12: scoped
+// arena reuse instead of per-step malloc/GC pressure).
+// ---------------------------------------------------------------------------
+struct Dl4jArena {
+    uint8_t* base;
+    int64_t cap;
+    int64_t used;
+    int64_t high_water;
+};
+
+void* dl4j_arena_create(int64_t cap) {
+    auto* a = new (std::nothrow) Dl4jArena();
+    if (!a) return nullptr;
+    a->base = (uint8_t*)std::malloc((size_t)cap);
+    if (!a->base) { delete a; return nullptr; }
+    a->cap = cap;
+    a->used = 0;
+    a->high_water = 0;
+    return a;
+}
+
+void* dl4j_arena_alloc(void* arena, int64_t size, int64_t align) {
+    auto* a = (Dl4jArena*)arena;
+    if (align <= 0) align = 64;
+    int64_t off = (a->used + align - 1) & ~(align - 1);
+    if (off + size > a->cap) return nullptr;  // spill: caller mallocs
+    a->used = off + size;
+    if (a->used > a->high_water) a->high_water = a->used;
+    return a->base + off;
+}
+
+void dl4j_arena_reset(void* arena) { ((Dl4jArena*)arena)->used = 0; }
+int64_t dl4j_arena_used(void* arena) { return ((Dl4jArena*)arena)->used; }
+int64_t dl4j_arena_high_water(void* arena) {
+    return ((Dl4jArena*)arena)->high_water;
+}
+
+void dl4j_arena_destroy(void* arena) {
+    auto* a = (Dl4jArena*)arena;
+    std::free(a->base);
+    delete a;
+}
+
+// ---------------------------------------------------------------------------
+// Bounded blocking ring queue — the async-prefetch backbone
+// (reference: AsyncDataSetIterator's bounded queue between the ETL
+// thread and fit(), SURVEY.md J9 / call stack 3.1 "async prefetch
+// thread"). Items are opaque uintptr tokens.
+// ---------------------------------------------------------------------------
+struct Dl4jQueue {
+    std::vector<uintptr_t> buf;
+    size_t head = 0, tail = 0, count = 0;
+    bool closed = false;
+    std::mutex m;
+    std::condition_variable not_full, not_empty;
+};
+
+void* dl4j_queue_create(int32_t cap) {
+    auto* q = new (std::nothrow) Dl4jQueue();
+    if (!q) return nullptr;
+    q->buf.resize(cap > 0 ? cap : 1);
+    return q;
+}
+
+// returns 1 on success, 0 on timeout, -1 if closed
+int32_t dl4j_queue_push(void* qp, uintptr_t item, double timeout_s) {
+    auto* q = (Dl4jQueue*)qp;
+    std::unique_lock<std::mutex> lk(q->m);
+    auto pred = [q] { return q->closed || q->count < q->buf.size(); };
+    if (timeout_s < 0) {
+        q->not_full.wait(lk, pred);
+    } else if (!q->not_full.wait_for(
+                   lk, std::chrono::duration<double>(timeout_s), pred)) {
+        return 0;
+    }
+    if (q->closed) return -1;
+    q->buf[q->tail] = item;
+    q->tail = (q->tail + 1) % q->buf.size();
+    ++q->count;
+    q->not_empty.notify_one();
+    return 1;
+}
+
+// returns 1 with *out set, 0 on timeout, -1 if closed AND drained
+int32_t dl4j_queue_pop(void* qp, uintptr_t* out, double timeout_s) {
+    auto* q = (Dl4jQueue*)qp;
+    std::unique_lock<std::mutex> lk(q->m);
+    auto pred = [q] { return q->count > 0 || q->closed; };
+    if (timeout_s < 0) {
+        q->not_empty.wait(lk, pred);
+    } else if (!q->not_empty.wait_for(
+                   lk, std::chrono::duration<double>(timeout_s), pred)) {
+        return 0;
+    }
+    if (q->count == 0) return -1;  // closed and drained
+    *out = q->buf[q->head];
+    q->head = (q->head + 1) % q->buf.size();
+    --q->count;
+    q->not_full.notify_one();
+    return 1;
+}
+
+int64_t dl4j_queue_size(void* qp) {
+    auto* q = (Dl4jQueue*)qp;
+    std::lock_guard<std::mutex> lk(q->m);
+    return (int64_t)q->count;
+}
+
+void dl4j_queue_close(void* qp) {
+    auto* q = (Dl4jQueue*)qp;
+    std::lock_guard<std::mutex> lk(q->m);
+    q->closed = true;
+    q->not_empty.notify_all();
+    q->not_full.notify_all();
+}
+
+void dl4j_queue_destroy(void* qp) { delete (Dl4jQueue*)qp; }
+
+// ---------------------------------------------------------------------------
+// CSV float parser — DataVec CSVRecordReader fast path (SURVEY.md
+// V1). Parses delimiter-separated floats; rows separated by '\n'.
+// Returns number of values written, or -1 if out of capacity,
+// -2 on ragged rows. n_rows/n_cols report the parsed shape.
+// ---------------------------------------------------------------------------
+int64_t dl4j_parse_csv_floats(const char* buf, int64_t len, char delim,
+                              float* out, int64_t cap,
+                              int64_t* n_rows, int64_t* n_cols) {
+    int64_t k = 0, rows = 0, cols = -1, cur_cols = 0;
+    const char* p = buf;
+    const char* end = buf + len;
+    while (p < end) {
+        // skip fully blank trailing lines
+        if (*p == '\n' && cur_cols == 0) {
+            const char* q = p;
+            while (q < end && (*q == '\n' || *q == '\r')) ++q;
+            if (q >= end) break;
+        }
+        // delimit THIS field first (strtof alone would eat the
+        // newline as leading whitespace and merge rows when a field
+        // is empty/whitespace)
+        const char* fe = p;
+        while (fe < end && *fe != delim && *fe != '\n') ++fe;
+        bool has_content = false;
+        for (const char* c = p; c < fe; ++c)
+            if (*c != ' ' && *c != '\t' && *c != '\r') {
+                has_content = true;
+                break;
+            }
+        float v = NAN;
+        if (has_content) {
+            char* next = nullptr;
+            v = strtof(p, &next);
+            if (next == p || next > fe) v = NAN;
+        }
+        if (k >= cap) return -1;
+        out[k++] = v;
+        ++cur_cols;
+        p = fe;
+        if (p >= end || *p == '\n') {
+            ++rows;
+            if (cols < 0) cols = cur_cols;
+            else if (cols != cur_cols) return -2;
+            cur_cols = 0;
+            if (p < end) ++p;
+        } else {
+            ++p;  // delim
+        }
+    }
+    if (cur_cols > 0) {  // final row without trailing newline
+        ++rows;
+        if (cols < 0) cols = cur_cols;
+        else if (cols != cur_cols) return -2;
+    }
+    *n_rows = rows;
+    *n_cols = cols < 0 ? 0 : cols;
+    return k;
+}
+
+// ---------------------------------------------------------------------------
+// Kahn toposort — graph-session scheduling (reference: SameDiff
+// AbstractSession topo traversal S3 / libnd4j GraphExecutioner N11).
+// Returns number of nodes placed; < n_nodes means a cycle.
+// ---------------------------------------------------------------------------
+int32_t dl4j_toposort(const int32_t* src, const int32_t* dst,
+                      int64_t n_edges, int32_t n_nodes,
+                      int32_t* order) {
+    std::vector<int32_t> indeg(n_nodes, 0);
+    std::vector<int64_t> head(n_nodes, -1);
+    std::vector<int64_t> nxt(n_edges, -1);
+    for (int64_t e = 0; e < n_edges; ++e) {
+        int32_t s = src[e], d = dst[e];
+        if (s < 0 || s >= n_nodes || d < 0 || d >= n_nodes) return -1;
+        ++indeg[d];
+        nxt[e] = head[s];
+        head[s] = e;
+    }
+    std::vector<int32_t> ready;
+    ready.reserve(n_nodes);
+    for (int32_t i = 0; i < n_nodes; ++i)
+        if (indeg[i] == 0) ready.push_back(i);
+    int32_t placed = 0;
+    // FIFO over the ready set -> deterministic schedule for a given
+    // edge list (validity, not byte-equality with the Python
+    // fallback, is the contract).
+    for (size_t qh = 0; qh < ready.size(); ++qh) {
+        int32_t u = ready[qh];
+        order[placed++] = u;
+        for (int64_t e = head[u]; e != -1; e = nxt[e])
+            if (--indeg[dst[e]] == 0) ready.push_back(dst[e]);
+    }
+    return placed;
+}
+
+}  // extern "C"

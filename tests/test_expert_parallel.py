@@ -10,7 +10,7 @@ from jax.sharding import PartitionSpec as P
 from deeplearning4j_tpu.parallel import make_mesh
 from deeplearning4j_tpu.parallel.expert import (
     init_moe_params, moe_ffn, topk_gating)
-from deeplearning4j_tpu.parallel.sequence import _shard_map
+from deeplearning4j_tpu.parallel.mesh import shard_map as _shard_map
 
 B, T, D, FF, E = 8, 4, 16, 32, 4
 N = B * T

@@ -1,0 +1,207 @@
+"""Native C++ host-runtime tests (SURVEY.md §2.7: threshold codec,
+CRC, workspace arena, async queue, CSV fast path, toposort).
+
+The library auto-builds with the container's g++; every API also has
+a pure-Python fallback exercised via DL4J_TPU_DISABLE_NATIVE."""
+import threading
+import zlib
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu import native as nat
+
+
+@pytest.fixture(scope="module")
+def built():
+    ok = nat.ensure_built()
+    if not ok:
+        pytest.skip("native lib unavailable (no compiler?)")
+    return ok
+
+
+class TestCrc32:
+    def test_matches_zlib(self, built):
+        for payload in [b"", b"a", b"hello world" * 100,
+                        np.arange(1000, dtype=np.float32).tobytes()]:
+            assert nat.crc32(payload) == zlib.crc32(payload) & 0xFFFFFFFF
+
+
+class TestThresholdCodec:
+    def test_roundtrip(self, built):
+        rng = np.random.RandomState(0)
+        g = rng.randn(10_000).astype(np.float32) * 0.01
+        tau = 0.015
+        enc = nat.threshold_encode(g, tau)
+        # every encoded index has |g| >= tau
+        idx = np.abs(enc) - 1
+        assert (np.abs(g[idx]) >= tau).all()
+        assert len(enc) == int((np.abs(g) >= tau).sum())
+        dec = nat.threshold_decode(enc, tau, g.size)
+        np.testing.assert_allclose(dec[idx],
+                                   np.sign(g[idx]) * tau, atol=1e-7)
+        assert dec[np.setdiff1d(np.arange(g.size), idx)].sum() == 0
+
+    def test_residual(self, built):
+        rng = np.random.RandomState(1)
+        g = rng.randn(1000).astype(np.float32) * 0.01
+        tau = 0.012
+        enc = nat.threshold_encode(g, tau)
+        res = g.copy()
+        nat.threshold_residual(res, enc, tau)
+        # residual + decoded == original gradient
+        np.testing.assert_allclose(
+            res + nat.threshold_decode(enc, tau, g.size), g, atol=1e-6)
+
+    def test_matches_python_fallback(self, built, monkeypatch):
+        rng = np.random.RandomState(2)
+        g = rng.randn(5000).astype(np.float32) * 0.02
+        enc_native = nat.threshold_encode(g, 0.03)
+        monkeypatch.setenv("DL4J_TPU_DISABLE_NATIVE", "1")
+        from deeplearning4j_tpu.native import bridge
+        monkeypatch.setattr(bridge, "_lib", None)
+        monkeypatch.setattr(bridge, "_build_attempted", True)
+        enc_py = nat.threshold_encode(g, 0.03)
+        np.testing.assert_array_equal(enc_native, enc_py)
+
+
+class TestToposort:
+    def test_valid_order(self, built):
+        edges = [(0, 2), (1, 2), (2, 3), (1, 3), (3, 4)]
+        order = nat.toposort(edges, 5)
+        pos = {n: i for i, n in enumerate(order)}
+        assert sorted(order) == [0, 1, 2, 3, 4]
+        for s, d in edges:
+            assert pos[s] < pos[d]
+
+    def test_cycle_raises(self, built):
+        with pytest.raises(ValueError, match="cycle"):
+            nat.toposort([(0, 1), (1, 2), (2, 0)], 3)
+
+    def test_empty(self, built):
+        assert nat.toposort([], 0) == []
+
+
+class TestCsv:
+    def test_parse_matrix(self, built):
+        text = "1.5,2,3\n-4,5e-2,6\n7,8,9.25\n"
+        m = nat.parse_csv_floats(text)
+        np.testing.assert_allclose(
+            m, [[1.5, 2, 3], [-4, 0.05, 6], [7, 8, 9.25]])
+
+    def test_ragged_raises(self, built):
+        with pytest.raises(ValueError, match="ragged"):
+            nat.parse_csv_floats("1,2\n3,4,5\n")
+
+    def test_empty_trailing_field_keeps_row_boundary(self, built):
+        """Regression: an empty field before a newline must become NaN
+        in place, not let the parser eat the newline and merge rows."""
+        m = nat.parse_csv_floats("1,\n3,4\n")
+        assert m.shape == (2, 2)
+        assert m[0, 0] == 1.0 and np.isnan(m[0, 1])
+        np.testing.assert_allclose(m[1], [3, 4])
+        m2 = nat.parse_csv_floats("1, \n , 2\n")   # whitespace fields
+        assert m2.shape == (2, 2)
+        assert np.isnan(m2[0, 1]) and np.isnan(m2[1, 0])
+
+    def test_no_trailing_newline(self, built):
+        m = nat.parse_csv_floats("1,2\n3,4")
+        np.testing.assert_allclose(m, [[1, 2], [3, 4]])
+
+    def test_record_reader_fast_path(self, built, tmp_path):
+        p = tmp_path / "data.csv"
+        rows = np.arange(30, dtype=np.float32).reshape(10, 3)
+        p.write_text("\n".join(",".join(str(v) for v in r)
+                               for r in rows))
+        from deeplearning4j_tpu.datavec.records import CSVRecordReader
+        from deeplearning4j_tpu.datavec.split import FileSplit
+        rr = CSVRecordReader()
+        m = rr.numeric_matrix(FileSplit(str(p)))
+        np.testing.assert_allclose(m, rows)
+
+
+class TestQueue:
+    def test_fifo_and_blocking(self, built):
+        q = nat.NativeQueue(4)
+        items = list(range(100))
+        out = []
+
+        def producer():
+            for i in items:
+                q.put(("item", i))
+            q.put(None)  # sentinel
+
+        t = threading.Thread(target=producer)
+        t.start()
+        while True:
+            obj = q.get(timeout=5.0)
+            if obj is None:
+                break
+            out.append(obj[1])
+        t.join()
+        assert out == items
+
+    def test_timeout(self, built):
+        import queue as pyq
+        q = nat.NativeQueue(2)
+        with pytest.raises(pyq.Empty):
+            q.get(timeout=0.05)
+        q.put(1)
+        q.put(2)
+        assert not q.put(3, timeout=0.05)   # full -> timed out
+
+    def test_close_unblocks(self, built):
+        q = nat.NativeQueue(2)
+        errs = []
+
+        def getter():
+            try:
+                q.get(timeout=5.0)
+            except StopIteration:
+                errs.append("stopped")
+
+        t = threading.Thread(target=getter)
+        t.start()
+        q.close()
+        t.join(timeout=5.0)
+        assert errs == ["stopped"]
+
+
+class TestArena:
+    def test_alloc_reset_reuse(self, built):
+        with nat.arena(1 << 16) as ws:
+            a = ws.alloc((64,), np.float32)
+            a[:] = 3.0
+            used1 = ws.used
+            assert used1 >= 64 * 4
+            b = ws.alloc((32,), np.int32)
+            b[:] = 7
+            assert ws.used > used1
+            ws.reset()
+            assert ws.used == 0
+            c = ws.alloc((64,), np.float32)
+            # same storage reused after reset (native path)
+            assert ws.used == used1
+            assert ws.high_water >= used1
+
+    def test_spill_beyond_capacity(self, built):
+        ws = nat.arena(128)
+        big = ws.alloc((1024,), np.float32)   # > capacity -> spill
+        big[:] = 1.0
+        assert big.shape == (1024,)
+
+
+class TestAsyncIterator:
+    def test_streams_all_batches(self, built):
+        from deeplearning4j_tpu.datasets.dataset import DataSet
+        from deeplearning4j_tpu.datasets.iterators import (
+            AsyncDataSetIterator, ListDataSetIterator)
+        x = np.arange(80, dtype=np.float32).reshape(20, 4)
+        y = np.eye(2, dtype=np.float32)[np.arange(20) % 2]
+        base = ListDataSetIterator(DataSet(x, y), 5)
+        it = AsyncDataSetIterator(base, queue_size=2)
+        seen = [ds.features[0, 0].item() for ds in it]
+        assert seen == [0.0, 20.0, 40.0, 60.0]
+        # reset + re-iterate works
+        seen2 = [ds.features[0, 0].item() for ds in it]
+        assert seen2 == seen

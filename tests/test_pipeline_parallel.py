@@ -10,7 +10,7 @@ from jax.sharding import PartitionSpec as P
 from deeplearning4j_tpu.parallel import make_mesh
 from deeplearning4j_tpu.parallel.pipeline import (
     from_microbatches, pipeline_apply, pipeline_loss, to_microbatches)
-from deeplearning4j_tpu.parallel.sequence import _shard_map
+from deeplearning4j_tpu.parallel.mesh import shard_map as _shard_map
 
 B, T, D = 8, 4, 16
 N_STAGES = 4

@@ -11,7 +11,7 @@ import pytest
 from jax.sharding import PartitionSpec as P
 
 from deeplearning4j_tpu.parallel import make_mesh
-from deeplearning4j_tpu.parallel.sequence import _shard_map
+from deeplearning4j_tpu.parallel.mesh import shard_map as _shard_map
 from deeplearning4j_tpu.parallel.tensor import (
     init_tp_block_params, tp_mlp, tp_self_attention,
     tp_transformer_block)
@@ -78,16 +78,7 @@ def tp_mlp_local(x, mp):
 
 
 def attn_ref(x):
-    from deeplearning4j_tpu.ops.attention import dot_product_attention
-    p = _ref_params()["attn"]
-    dh = D // H
-
-    def heads(a):
-        return a.reshape(B, T, H, dh).transpose(0, 2, 1, 3)
-
-    o = dot_product_attention(heads(x @ p["Wq"]), heads(x @ p["Wk"]),
-                              heads(x @ p["Wv"]))
-    return o.transpose(0, 2, 1, 3).reshape(B, T, D) @ p["Wo"] + p["bo"]
+    return attn_ref_p(x, _ref_params()["attn"])
 
 
 class TestTpAttention:
