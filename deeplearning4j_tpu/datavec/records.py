@@ -103,6 +103,17 @@ class CSVRecordReader(LineRecordReader):
         from deeplearning4j_tpu.native import parse_csv_floats
         if split is not None:
             self.initialize(split)
+        locs = []
+        try:
+            locs = list(self.split.locations())
+        except Exception:
+            pass
+        if len(locs) == 1 and self.skip == 0:
+            # single plain file: hand raw bytes straight to the C
+            # parser — no per-line Python iteration, no join copy
+            with open(locs[0], "rb") as f:
+                data = f.read()
+            return _np.asarray(parse_csv_floats(data, self.delimiter))
         text = "\n".join(l for i, l in enumerate(self._lines())
                          if i >= self.skip)
         return _np.asarray(parse_csv_floats(text, self.delimiter))

@@ -1,0 +1,27 @@
+"""Early stopping (SURVEY.md D12 — `org.deeplearning4j.earlystopping`).
+
+`EarlyStoppingConfiguration.Builder` + termination conditions +
+score calculators + model savers + `EarlyStoppingTrainer`, matching
+the reference's class names and semantics: train epoch-by-epoch,
+score on a holdout every N epochs, keep the best model, stop when an
+epoch/iteration termination condition fires, return an
+`EarlyStoppingResult` with the best model restored.
+"""
+from .conditions import (BestScoreEpochTerminationCondition,
+                         MaxEpochsTerminationCondition,
+                         MaxScoreIterationTerminationCondition,
+                         MaxTimeIterationTerminationCondition,
+                         ScoreImprovementEpochTerminationCondition)
+from .saver import InMemoryModelSaver, LocalFileModelSaver
+from .scorecalc import DataSetLossCalculator
+from .trainer import (EarlyStoppingConfiguration, EarlyStoppingResult,
+                      EarlyStoppingTrainer)
+
+__all__ = ["EarlyStoppingConfiguration", "EarlyStoppingTrainer",
+           "EarlyStoppingResult", "MaxEpochsTerminationCondition",
+           "ScoreImprovementEpochTerminationCondition",
+           "BestScoreEpochTerminationCondition",
+           "MaxTimeIterationTerminationCondition",
+           "MaxScoreIterationTerminationCondition",
+           "DataSetLossCalculator", "InMemoryModelSaver",
+           "LocalFileModelSaver"]

@@ -1,0 +1,99 @@
+"""Termination conditions (reference: `org.deeplearning4j.
+earlystopping.termination.*` — same class names, same semantics)."""
+from __future__ import annotations
+
+import time
+
+
+class EpochTerminationCondition:
+    def initialize(self):
+        pass
+
+    def terminate(self, epoch: int, score: float,
+                  minimize: bool = True) -> bool:
+        raise NotImplementedError
+
+
+class IterationTerminationCondition:
+    def initialize(self):
+        pass
+
+    def terminate(self, score: float) -> bool:
+        raise NotImplementedError
+
+
+class MaxEpochsTerminationCondition(EpochTerminationCondition):
+    def __init__(self, max_epochs: int):
+        self.max_epochs = max_epochs
+
+    def terminate(self, epoch, score, minimize=True):
+        return epoch + 1 >= self.max_epochs
+
+
+class ScoreImprovementEpochTerminationCondition(
+        EpochTerminationCondition):
+    """Stop after ``max_epochs_without_improvement`` stagnant epochs
+    (optionally requiring ``min_improvement`` per epoch)."""
+
+    def __init__(self, max_epochs_without_improvement: int,
+                 min_improvement: float = 0.0):
+        self.patience = max_epochs_without_improvement
+        self.min_improvement = min_improvement
+        self.best = None
+        self.stagnant = 0
+
+    def initialize(self):
+        self.best = None
+        self.stagnant = 0
+
+    def terminate(self, epoch, score, minimize=True):
+        if self.best is None:
+            self.best = score
+            return False
+        improved = (self.best - score if minimize
+                    else score - self.best) > self.min_improvement
+        if improved:
+            self.best = score
+            self.stagnant = 0
+        else:
+            self.stagnant += 1
+        return self.stagnant >= self.patience
+
+
+class BestScoreEpochTerminationCondition(EpochTerminationCondition):
+    """Stop once the score is at least as good as a target."""
+
+    def __init__(self, target: float):
+        self.target = target
+
+    def terminate(self, epoch, score, minimize=True):
+        return score <= self.target if minimize else \
+            score >= self.target
+
+
+class MaxTimeIterationTerminationCondition(
+        IterationTerminationCondition):
+    def __init__(self, max_seconds: float):
+        self.max_seconds = max_seconds
+        self._start = None
+
+    def initialize(self):
+        self._start = time.time()
+
+    def terminate(self, score):
+        if self._start is None:
+            self.initialize()
+        return time.time() - self._start > self.max_seconds
+
+
+class MaxScoreIterationTerminationCondition(
+        IterationTerminationCondition):
+    """Abort if the minibatch score explodes past a bound
+    (divergence guard)."""
+
+    def __init__(self, max_score: float):
+        self.max_score = max_score
+
+    def terminate(self, score):
+        import math
+        return score > self.max_score or math.isnan(score)

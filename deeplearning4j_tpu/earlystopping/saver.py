@@ -1,0 +1,55 @@
+"""Model savers (reference: `org.deeplearning4j.earlystopping.saver.
+{InMemoryModelSaver, LocalFileModelSaver}`)."""
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+
+class InMemoryModelSaver:
+    def __init__(self):
+        self._best = None
+        self._latest = None
+
+    def save_best_model(self, model, score):
+        self._best = model.clone()
+
+    def save_latest_model(self, model, score):
+        self._latest = model.clone()
+
+    def get_best_model(self):
+        return self._best
+
+    def get_latest_model(self):
+        return self._latest
+
+
+class LocalFileModelSaver:
+    """Zip-format persistence via ModelSerializer (reference keeps
+    bestModel.bin / latestModel.bin in a directory)."""
+
+    def __init__(self, directory: str):
+        self.directory = directory
+        os.makedirs(directory, exist_ok=True)
+
+    def _path(self, name):
+        return os.path.join(self.directory, name)
+
+    def save_best_model(self, model, score):
+        from ..utils.serializer import ModelSerializer
+        ModelSerializer.write_model(model, self._path("bestModel.bin"))
+
+    def save_latest_model(self, model, score):
+        from ..utils.serializer import ModelSerializer
+        ModelSerializer.write_model(model,
+                                    self._path("latestModel.bin"))
+
+    def get_best_model(self):
+        from ..utils.serializer import ModelSerializer
+        return ModelSerializer.restore_model(
+            self._path("bestModel.bin"))
+
+    def get_latest_model(self):
+        from ..utils.serializer import ModelSerializer
+        return ModelSerializer.restore_model(
+            self._path("latestModel.bin"))

@@ -1,0 +1,24 @@
+"""Score calculators (reference: `org.deeplearning4j.earlystopping.
+scorecalc.DataSetLossCalculator`)."""
+from __future__ import annotations
+
+
+class DataSetLossCalculator:
+    """Average model loss over a holdout iterator; lower is better."""
+
+    minimize_score = True
+
+    def __init__(self, iterator, average: bool = True):
+        self.iterator = iterator
+        self.average = average
+
+    def calculate_score(self, model) -> float:
+        total, n = 0.0, 0
+        self.iterator.reset()
+        while self.iterator.has_next():
+            ds = self.iterator.next()
+            total += float(model.score(ds)) * ds.num_examples()
+            n += ds.num_examples()
+        if n == 0:
+            raise ValueError("empty score iterator")
+        return total / n if self.average else total

@@ -1,0 +1,150 @@
+"""EarlyStoppingTrainer (reference: `org.deeplearning4j.earlystopping.
+trainer.EarlyStoppingTrainer` + `EarlyStoppingConfiguration` +
+`EarlyStoppingResult`)."""
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional
+
+from .saver import InMemoryModelSaver
+
+
+class EarlyStoppingConfiguration:
+    def __init__(self, score_calculator=None, model_saver=None,
+                 epoch_termination_conditions=None,
+                 iteration_termination_conditions=None,
+                 evaluate_every_n_epochs: int = 1,
+                 save_last_model: bool = False):
+        self.score_calculator = score_calculator
+        self.model_saver = model_saver or InMemoryModelSaver()
+        self.epoch_conditions = epoch_termination_conditions or []
+        self.iteration_conditions = \
+            iteration_termination_conditions or []
+        self.evaluate_every_n_epochs = evaluate_every_n_epochs
+        self.save_last_model = save_last_model
+
+    class Builder:
+        def __init__(self):
+            self._kw: Dict[str, Any] = {}
+
+        def score_calculator(self, sc):
+            self._kw["score_calculator"] = sc
+            return self
+
+        def model_saver(self, ms):
+            self._kw["model_saver"] = ms
+            return self
+
+        def epoch_termination_conditions(self, *conds):
+            self._kw["epoch_termination_conditions"] = list(conds)
+            return self
+
+        def iteration_termination_conditions(self, *conds):
+            self._kw["iteration_termination_conditions"] = list(conds)
+            return self
+
+        def evaluate_every_n_epochs(self, n):
+            self._kw["evaluate_every_n_epochs"] = n
+            return self
+
+        def save_last_model(self, b=True):
+            self._kw["save_last_model"] = b
+            return self
+
+        def build(self):
+            return EarlyStoppingConfiguration(**self._kw)
+
+
+@dataclass
+class EarlyStoppingResult:
+    termination_reason: str            # "EpochTermination" | ...
+    termination_details: str
+    score_vs_epoch: Dict[int, float] = field(default_factory=dict)
+    best_model_epoch: int = -1
+    best_model_score: float = float("nan")
+    total_epochs: int = 0
+    best_model: Any = None
+
+    def get_best_model(self):
+        return self.best_model
+
+
+class EarlyStoppingTrainer:
+    """Train epoch-by-epoch with scoring/checkpointing between epochs."""
+
+    def __init__(self, conf: EarlyStoppingConfiguration, model,
+                 train_iterator):
+        self.conf = conf
+        self.model = model
+        self.iterator = train_iterator
+
+    def fit(self) -> EarlyStoppingResult:
+        c = self.conf
+        for cond in c.epoch_conditions + c.iteration_conditions:
+            cond.initialize()
+        best_score: Optional[float] = None
+        best_epoch = -1
+        scores: Dict[int, float] = {}
+        epoch = 0
+        reason, details = "Unknown", ""
+        minimize = getattr(c.score_calculator, "minimize_score", True)
+
+        while True:
+            # -- one training epoch, iteration guards inside ---------
+            self.iterator.reset()
+            aborted = False
+            while self.iterator.has_next():
+                ds = self.iterator.next()
+                self.model.fit(ds)
+                s = float(self.model.score())
+                for cond in c.iteration_conditions:
+                    if cond.terminate(s):
+                        reason = "IterationTermination"
+                        details = type(cond).__name__
+                        aborted = True
+                        break
+                if aborted:
+                    break
+            if aborted:
+                break
+
+            # -- score + save best -----------------------------------
+            if c.score_calculator is not None and \
+                    epoch % c.evaluate_every_n_epochs == 0:
+                s = c.score_calculator.calculate_score(self.model)
+                scores[epoch] = s
+                better = (best_score is None
+                          or (s < best_score if minimize
+                              else s > best_score))
+                if better:
+                    best_score = s
+                    best_epoch = epoch
+                    c.model_saver.save_best_model(self.model, s)
+            if c.save_last_model:
+                c.model_saver.save_latest_model(
+                    self.model, scores.get(epoch, float("nan")))
+
+            # -- epoch termination -----------------------------------
+            stop = False
+            for cond in c.epoch_conditions:
+                if cond.terminate(epoch, scores.get(epoch,
+                                                    float("nan")),
+                                  minimize):
+                    reason = "EpochTermination"
+                    details = type(cond).__name__
+                    stop = True
+                    break
+            epoch += 1
+            if stop:
+                break
+
+        best = c.model_saver.get_best_model()
+        return EarlyStoppingResult(
+            termination_reason=reason,
+            termination_details=details,
+            score_vs_epoch=scores,
+            best_model_epoch=best_epoch,
+            best_model_score=(best_score if best_score is not None
+                              else float("nan")),
+            total_epochs=epoch,
+            best_model=best if best is not None else self.model)

@@ -76,6 +76,10 @@ def _configure(lib):
 def ensure_built(force: bool = False) -> bool:
     """Build (once) and load the native library. Returns success."""
     global _lib, _build_attempted
+    if _lib is not None and not force:
+        # lock-free fast path: every native entry point calls this,
+        # so the loaded case must not serialize threads
+        return True
     if os.environ.get("DL4J_TPU_DISABLE_NATIVE"):
         return False
     with _lock:
@@ -155,6 +159,12 @@ def threshold_decode(enc: np.ndarray, tau: float, n: int,
     enc = np.ascontiguousarray(np.asarray(enc, np.int32).ravel())
     if out is None:
         out = np.zeros(n, np.float32)
+    elif (out.dtype != np.float32 or not out.flags.c_contiguous
+          or out.size < n):
+        raise ValueError(
+            f"out must be C-contiguous float32 with size >= {n}, got "
+            f"{out.dtype} size {out.size} contiguous="
+            f"{out.flags.c_contiguous}")
     if ensure_built():
         _lib.dl4j_threshold_decode(_ptr(enc), enc.size,
                                    ctypes.c_float(tau), _ptr(out), n)
@@ -238,8 +248,14 @@ def parse_csv_floats(text, delim: str = ",") -> np.ndarray:
         if k >= 0:
             return out[:k].reshape(rows.value, cols.value).copy()
         # k == -1 capacity miss -> fall through to python path
+    def to_f(x):
+        try:
+            return float(x)
+        except ValueError:     # non-numeric field -> NaN (native
+            return float("nan")  # strtof behaves the same way)
+
     rows = [r for r in text.decode().split("\n") if r.strip()]
-    parsed = [[float(x) if x.strip() else float("nan")
+    parsed = [[to_f(x) if x.strip() else float("nan")
                for x in r.split(delim)] for r in rows]
     width = {len(r) for r in parsed}
     if len(width) > 1:
@@ -379,6 +395,10 @@ class arena:
             p = _lib.dl4j_arena_alloc(self._handle, size, 64)
             if p:
                 buf = (ctypes.c_char * size).from_address(p)
+                # keep the arena alive while any view escapes: the
+                # array's base chain reaches buf, and buf pins the
+                # arena (else __del__ would free() under live views)
+                buf._owner = self
                 return np.frombuffer(buf, dtype).reshape(shape)
         a = np.empty(shape, dtype)
         self._spill.append(a)

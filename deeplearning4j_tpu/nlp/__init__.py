@@ -1,0 +1,23 @@
+"""NLP subsystem (SURVEY.md D16 parity).
+
+Reference: `deeplearning4j-nlp` — `org.deeplearning4j.text.tokenization`
+(tokenizer factories + preprocessors), `org.deeplearning4j.models`
+(Word2Vec / ParagraphVectors / SequenceVectors over a VocabCache), and
+`org.deeplearning4j.iterator.BertIterator` (wordpiece + MLM masking).
+
+TPU-first design: embedding training is a single jitted SGNS step —
+batched skip-gram pairs with negative sampling as one gather/einsum/
+scatter-add program (the reference trains per-word with HS/NS inner
+loops on the JVM; here the MXU sees [batch, dim] matmuls).
+"""
+from .tokenization import (BertWordPieceTokenizer, DefaultTokenizer,
+                           DefaultTokenizerFactory,
+                           CommonPreprocessor)
+from .vocab import VocabCache, build_vocab
+from .word2vec import ParagraphVectors, SequenceVectors, Word2Vec
+from .bert_iterator import BertIterator
+
+__all__ = ["DefaultTokenizer", "DefaultTokenizerFactory",
+           "CommonPreprocessor", "BertWordPieceTokenizer",
+           "VocabCache", "build_vocab", "Word2Vec", "SequenceVectors",
+           "ParagraphVectors", "BertIterator"]

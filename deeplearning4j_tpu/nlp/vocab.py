@@ -1,0 +1,65 @@
+"""Vocabulary cache (reference: `org.deeplearning4j.models.word2vec.
+wordstore.inmemory.AbstractCache` / `VocabConstructor`).
+
+Holds word -> index, counts, and the unigram^0.75 negative-sampling
+table the SGNS trainer draws from (the reference builds the same
+table natively for its negative sampling).
+"""
+from __future__ import annotations
+
+from collections import Counter
+from typing import Dict, Iterable, List, Optional
+
+import numpy as np
+
+
+class VocabCache:
+    def __init__(self, words: List[str], counts: Dict[str, int]):
+        self.words = words
+        self.index: Dict[str, int] = {w: i for i, w in
+                                      enumerate(words)}
+        self.counts = counts
+        self._neg_table: Optional[np.ndarray] = None
+
+    def __len__(self):
+        return len(self.words)
+
+    def __contains__(self, w):
+        return w in self.index
+
+    def id_of(self, w: str) -> int:
+        return self.index[w]
+
+    def word_at(self, i: int) -> str:
+        return self.words[i]
+
+    def count_of(self, w: str) -> int:
+        return self.counts.get(w, 0)
+
+    def total_count(self) -> int:
+        return sum(self.counts[w] for w in self.words)
+
+    def neg_sampling_probs(self, power: float = 0.75) -> np.ndarray:
+        """Unigram^power distribution over word indices (word2vec's
+        negative-sampling table, normalized instead of the reference's
+        1e8-slot discretized table)."""
+        if self._neg_table is None:
+            f = np.array([self.counts[w] for w in self.words],
+                         np.float64) ** power
+            self._neg_table = (f / f.sum()).astype(np.float32)
+        return self._neg_table
+
+
+def build_vocab(token_seqs: Iterable[List[str]],
+                min_word_frequency: int = 1,
+                max_size: Optional[int] = None) -> VocabCache:
+    """reference: VocabConstructor.buildJointVocabulary — count,
+    prune by min frequency, order by descending count."""
+    c = Counter()
+    for seq in token_seqs:
+        c.update(seq)
+    items = [(w, n) for w, n in c.most_common()
+             if n >= min_word_frequency]
+    if max_size:
+        items = items[:max_size]
+    return VocabCache([w for w, _ in items], dict(items))

@@ -1,0 +1,317 @@
+"""Word2Vec / SequenceVectors / ParagraphVectors.
+
+Reference parity: `org.deeplearning4j.models.word2vec.Word2Vec`,
+`models.sequencevectors.SequenceVectors`,
+`models.paragraphvectors.ParagraphVectors` (SURVEY.md D16) with the
+reference's builder API (minWordFrequency / layerSize / windowSize /
+negativeSample / iterations → snake_case).
+
+TPU-first: the reference trains word-by-word on JVM threads
+(HS/negative-sampling inner loops). Here training is ONE jitted SGNS
+step over a [batch] of skip-gram pairs — gathers, a [b,d]×[b,k,d]
+einsum, log-sigmoid losses, and scatter-add parameter updates, all
+fused by XLA. Negative samples are drawn host-side from the
+unigram^0.75 table (vocab.py) and shipped with the batch.
+"""
+from __future__ import annotations
+
+import functools
+from typing import Dict, Iterable, List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .tokenization import DefaultTokenizerFactory
+from .vocab import VocabCache, build_vocab
+
+
+def _sgns_step(win, wout, centers, contexts, negatives, lr):
+    """One skip-gram negative-sampling SGD step (jitted)."""
+    def loss_fn(win, wout):
+        v = win[centers]                       # [b, d]
+        u = wout[contexts]                     # [b, d]
+        pos = jax.nn.log_sigmoid(jnp.sum(v * u, -1))
+        s = jnp.einsum("bd,bkd->bk", v, wout[negatives])
+        neg = jnp.sum(jax.nn.log_sigmoid(-s), -1)
+        # SUM, not mean: per-pair gradient magnitude then matches the
+        # classic per-pair SGD update at word2vec's canonical lr
+        return -jnp.sum(pos + neg)
+
+    loss, grads = jax.value_and_grad(loss_fn, argnums=(0, 1))(win, wout)
+    return win - lr * grads[0], wout - lr * grads[1], loss
+
+
+class SequenceVectors:
+    """Shared SGNS trainer over (center, context) index pairs.
+
+    Subclasses define how pairs are generated from sequences; this
+    class owns vocab, embedding matrices, training, and the lookup /
+    similarity API (reference: SequenceVectors is exactly this seam).
+
+    ``learning_rate`` applies to the batched SUM loss, i.e. per-pair
+    update scale. A word hit by many pairs in one batch accumulates
+    all of them simultaneously, so small vocabularies want a smaller
+    lr than the classic 0.025 (rule of thumb: 0.025 * vocab/batch
+    capped at 0.025; divergence shows as NaN similarities).
+    """
+
+    def __init__(self, layer_size=64, window_size=5, negative=5,
+                 learning_rate=0.01, min_learning_rate=1e-4,
+                 epochs=1, batch_size=512, min_word_frequency=1,
+                 seed=12345, tokenizer_factory=None):
+        self.layer_size = layer_size
+        self.window_size = window_size
+        self.negative = negative
+        self.learning_rate = learning_rate
+        self.min_learning_rate = min_learning_rate
+        self.epochs = epochs
+        self.batch_size = batch_size
+        self.min_word_frequency = min_word_frequency
+        self.seed = seed
+        self.tokenizer_factory = (tokenizer_factory or
+                                  DefaultTokenizerFactory())
+        self.vocab: Optional[VocabCache] = None
+        self.syn0: Optional[np.ndarray] = None   # input/lookup table
+        self.syn1: Optional[np.ndarray] = None   # output table
+        self._step = jax.jit(_sgns_step)
+
+    # -- data --------------------------------------------------------
+    def _tokenize_corpus(self, sentences: Iterable) -> List[List[str]]:
+        seqs = []
+        for s in sentences:
+            if isinstance(s, str):
+                seqs.append(self.tokenizer_factory.create(s)
+                            .get_tokens())
+            else:
+                seqs.append(list(s))
+        return seqs
+
+    def _skipgram_pairs(self, ids: List[int], rng) -> List:
+        pairs = []
+        for i, c in enumerate(ids):
+            w = 1 + rng.randint(self.window_size)  # shrunk window
+            for j in range(max(0, i - w), min(len(ids), i + w + 1)):
+                if j != i:
+                    pairs.append((c, ids[j]))
+        return pairs
+
+    # -- training ----------------------------------------------------
+    def _init_tables(self, n_in: int, n_out: int):
+        rng = np.random.RandomState(self.seed)
+        self.syn0 = ((rng.rand(n_in, self.layer_size) - 0.5)
+                     / self.layer_size).astype(np.float32)
+        self.syn1 = np.zeros((n_out, self.layer_size), np.float32)
+
+    def _train_pairs(self, all_pairs: np.ndarray, n_out: int):
+        rng = np.random.RandomState(self.seed + 1)
+        probs = self.vocab.neg_sampling_probs().astype(np.float64)
+        probs = probs / probs.sum()
+        win = jnp.asarray(self.syn0)
+        wout = jnp.asarray(self.syn1)
+        n = len(all_pairs)
+        steps_total = max(1, self.epochs * ((n + self.batch_size - 1)
+                                            // self.batch_size))
+        step_i = 0
+        for ep in range(self.epochs):
+            order = rng.permutation(n)
+            for s in range(0, n, self.batch_size):
+                sel = order[s:s + self.batch_size]
+                if len(sel) < self.batch_size:   # pad to fixed shape
+                    sel = np.concatenate(
+                        [sel, rng.choice(n, self.batch_size - len(sel))])
+                batch = all_pairs[sel]
+                negs = rng.choice(len(probs),
+                                  (self.batch_size, self.negative),
+                                  p=probs)
+                lr = max(self.min_learning_rate,
+                         self.learning_rate
+                         * (1 - step_i / steps_total))
+                win, wout, _ = self._step(
+                    win, wout, jnp.asarray(batch[:, 0]),
+                    jnp.asarray(batch[:, 1]),
+                    jnp.asarray(negs), lr)
+                step_i += 1
+        self.syn0 = np.asarray(win)
+        self.syn1 = np.asarray(wout)
+
+    # -- lookup API (reference: WordVectors interface) ----------------
+    def get_word_vector(self, word: str) -> np.ndarray:
+        return self.syn0[self.vocab.id_of(word)]
+
+    def get_word_vector_matrix(self) -> np.ndarray:
+        return self.syn0
+
+    def has_word(self, w: str) -> bool:
+        return self.vocab is not None and w in self.vocab
+
+    def similarity(self, a: str, b: str) -> float:
+        va, vb = self.get_word_vector(a), self.get_word_vector(b)
+        return float(va @ vb / (np.linalg.norm(va)
+                                * np.linalg.norm(vb) + 1e-12))
+
+    def words_nearest(self, word: str, n: int = 10) -> List[str]:
+        v = self.get_word_vector(word)
+        m = self.syn0
+        sims = (m @ v) / ((np.linalg.norm(m, axis=1)
+                           * np.linalg.norm(v)) + 1e-12)
+        order = np.argsort(-sims)
+        out = [self.vocab.word_at(i) for i in order
+               if self.vocab.word_at(i) != word]
+        return out[:n]
+
+
+class Word2Vec(SequenceVectors):
+    """Skip-gram negative-sampling word embeddings (reference:
+    Word2Vec.Builder().minWordFrequency().layerSize().windowSize()...)."""
+
+    class Builder:
+        def __init__(self):
+            self._kw = {}
+            self._sentences = None
+
+        def min_word_frequency(self, v):
+            self._kw["min_word_frequency"] = v
+            return self
+
+        def layer_size(self, v):
+            self._kw["layer_size"] = v
+            return self
+
+        def window_size(self, v):
+            self._kw["window_size"] = v
+            return self
+
+        def negative_sample(self, v):
+            self._kw["negative"] = int(v)
+            return self
+
+        def learning_rate(self, v):
+            self._kw["learning_rate"] = v
+            return self
+
+        def min_learning_rate(self, v):
+            self._kw["min_learning_rate"] = v
+            return self
+
+        def epochs(self, v):
+            self._kw["epochs"] = v
+            return self
+
+        def batch_size(self, v):
+            self._kw["batch_size"] = v
+            return self
+
+        def seed(self, v):
+            self._kw["seed"] = int(v)
+            return self
+
+        def tokenizer_factory(self, tf):
+            self._kw["tokenizer_factory"] = tf
+            return self
+
+        def iterate(self, sentences):
+            self._sentences = sentences
+            return self
+
+        def build(self) -> "Word2Vec":
+            w = Word2Vec(**self._kw)
+            w._pending = self._sentences
+            return w
+
+    def __init__(self, **kw):
+        super().__init__(**kw)
+        self._pending = None
+
+    def fit(self, sentences=None):
+        sentences = sentences if sentences is not None else self._pending
+        seqs = self._tokenize_corpus(sentences)
+        self.vocab = build_vocab(seqs, self.min_word_frequency)
+        v = len(self.vocab)
+        self._init_tables(v, v)
+        pairs = []
+        rng = np.random.RandomState(self.seed + 2)
+        for seq in seqs:
+            ids = [self.vocab.id_of(t) for t in seq
+                   if t in self.vocab]
+            pairs.extend(self._skipgram_pairs(ids, rng))
+        if not pairs:
+            raise ValueError("no training pairs (corpus too small "
+                             "for min_word_frequency?)")
+        self._train_pairs(np.asarray(pairs, np.int32), v)
+        return self
+
+
+class ParagraphVectors(SequenceVectors):
+    """PV-DBOW document embeddings (reference: ParagraphVectors with
+    DBOW sequence learning): a document vector is trained to predict
+    the words it contains via the same SGNS objective."""
+
+    def __init__(self, **kw):
+        super().__init__(**kw)
+        self.doc_vectors: Optional[np.ndarray] = None
+        self.labels: List[str] = []
+
+    def fit(self, documents: Sequence, labels: Optional[List[str]]
+            = None):
+        seqs = self._tokenize_corpus(documents)
+        self.labels = labels or [f"DOC_{i}" for i in
+                                 range(len(seqs))]
+        self.vocab = build_vocab(seqs, self.min_word_frequency)
+        v = len(self.vocab)
+        self._init_tables(len(seqs), v)
+        pairs = []
+        for d, seq in enumerate(seqs):
+            for t in seq:
+                if t in self.vocab:
+                    pairs.append((d, self.vocab.id_of(t)))
+        self._train_pairs(np.asarray(pairs, np.int32), v)
+        self.doc_vectors = self.syn0
+        return self
+
+    def get_doc_vector(self, label_or_idx) -> np.ndarray:
+        i = (self.labels.index(label_or_idx)
+             if isinstance(label_or_idx, str) else label_or_idx)
+        return self.doc_vectors[i]
+
+    def infer_vector(self, text, steps: int = 50,
+                     learning_rate: float = 0.05) -> np.ndarray:
+        """Train a fresh doc vector against the FROZEN word table
+        (reference: ParagraphVectors.inferVector)."""
+        toks = (self.tokenizer_factory.create(text).get_tokens()
+                if isinstance(text, str) else list(text))
+        ids = np.asarray([self.vocab.id_of(t) for t in toks
+                          if t in self.vocab], np.int32)
+        if ids.size == 0:
+            return np.zeros(self.layer_size, np.float32)
+        rng = np.random.RandomState(self.seed + 3)
+        dv = ((rng.rand(self.layer_size) - 0.5)
+              / self.layer_size).astype(np.float32)
+        probs = self.vocab.neg_sampling_probs().astype(np.float64)
+        probs = probs / probs.sum()
+        wout = jnp.asarray(self.syn1)
+
+        @jax.jit
+        def step(dv, contexts, negatives, lr):
+            def loss_fn(dv):
+                u = wout[contexts]
+                pos = jax.nn.log_sigmoid(u @ dv)
+                s = jnp.einsum("d,bkd->bk", dv, wout[negatives])
+                neg = jnp.sum(jax.nn.log_sigmoid(-s), -1)
+                return -jnp.mean(pos + neg)
+            g = jax.grad(loss_fn)(dv)
+            return dv - lr * g
+
+        dv = jnp.asarray(dv)
+        for i in range(steps):
+            negs = rng.choice(len(probs), (ids.size, self.negative),
+                              p=probs)
+            lr = learning_rate * (1 - i / steps) + 1e-4
+            dv = step(dv, jnp.asarray(ids), jnp.asarray(negs), lr)
+        return np.asarray(dv)
+
+    def similarity_to_label(self, text, label) -> float:
+        v = self.infer_vector(text)
+        d = self.get_doc_vector(label)
+        return float(v @ d / (np.linalg.norm(v) * np.linalg.norm(d)
+                              + 1e-12))

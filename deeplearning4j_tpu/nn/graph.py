@@ -228,14 +228,16 @@ class ComputationGraph:
         if self._train_step is None:
             self._build_train_step()
         if labels is not None:
-            self._fit_batch([data] if not isinstance(data, (list, tuple))
-                            else list(data),
-                            [labels] if not isinstance(labels,
-                                                       (list, tuple))
-                            else list(labels), None, None)
+            for _ in range(n_epochs):
+                self._fit_batch(
+                    [data] if not isinstance(data, (list, tuple))
+                    else list(data),
+                    [labels] if not isinstance(labels, (list, tuple))
+                    else list(labels), None, None)
             return self
         if hasattr(data, "features") and hasattr(data, "labels"):
-            self._fit_dataset(data)
+            for _ in range(n_epochs):
+                self._fit_dataset(data)
             return self
         for _ in range(n_epochs):
             for lis in self.listeners:

@@ -230,12 +230,14 @@ class MultiLayerNetwork:
         if self._train_step is None:
             self._build_train_step()
         if labels is not None:
-            self._fit_batch(data, labels, None, None)
+            for _ in range(n_epochs):
+                self._fit_batch(data, labels, None, None)
             return self
         if hasattr(data, "features") and hasattr(data, "labels"):
-            self._fit_batch(data.features, data.labels,
-                            getattr(data, "features_mask", None),
-                            getattr(data, "labels_mask", None))
+            for _ in range(n_epochs):
+                self._fit_batch(data.features, data.labels,
+                                getattr(data, "features_mask", None),
+                                getattr(data, "labels_mask", None))
             return self
         # iterator protocol
         for _ in range(n_epochs):

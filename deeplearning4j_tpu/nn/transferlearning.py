@@ -1,0 +1,133 @@
+"""Transfer learning — graph surgery on trained networks.
+
+Reference parity: `org.deeplearning4j.nn.transferlearning.
+{TransferLearning, FineTuneConfiguration}` (SURVEY.md D10): take a
+trained `MultiLayerNetwork`, freeze a feature-extractor prefix,
+remove/replace output layers, append new layers, override the
+updater/regularization for the fine-tune phase — keeping the trained
+weights of every retained layer.
+
+Freezing is expressed as the `NoOp` updater on the frozen layer
+(exactly the reference's FrozenLayer mechanism: gradients are
+computed but the update is identity), so the jitted train step needs
+no special casing.
+"""
+from __future__ import annotations
+
+import copy
+from dataclasses import dataclass
+from typing import List, Optional
+
+import jax
+import numpy as np
+
+from ..learning.updaters import IUpdater, NoOp
+from .conf.builders import MultiLayerConfiguration
+from .multilayer import MultiLayerNetwork
+
+
+@dataclass
+class FineTuneConfiguration:
+    """Overrides applied to the whole net for the fine-tune phase
+    (reference: FineTuneConfiguration.Builder subset)."""
+    updater: Optional[IUpdater] = None
+    l1: Optional[float] = None
+    l2: Optional[float] = None
+    seed: Optional[int] = None
+
+    def apply_to(self, conf: MultiLayerConfiguration):
+        if self.updater is not None:
+            conf.updater = self.updater
+            for layer in conf.layers:
+                if layer.updater is not None and \
+                        not isinstance(layer.updater, NoOp):
+                    layer.updater = None   # net-level updater wins
+        if self.l1 is not None:
+            conf.l1 = self.l1
+        if self.l2 is not None:
+            conf.l2 = self.l2
+        if self.seed is not None:
+            conf.seed = self.seed
+
+
+class TransferLearning:
+    class Builder:
+        def __init__(self, net: MultiLayerNetwork):
+            if not net._initialized:
+                raise ValueError("source network must be initialized")
+            self._net = net
+            self._conf = copy.deepcopy(net.conf)
+            self._keep = list(range(len(self._conf.layers)))
+            self._appended: List = []
+            self._freeze_until = -1
+            self._fine_tune: Optional[FineTuneConfiguration] = None
+            self._nout_replaced = {}
+
+        def fine_tune_configuration(self, ftc: FineTuneConfiguration):
+            self._fine_tune = ftc
+            return self
+
+        def set_feature_extractor(self, layer_idx: int):
+            """Freeze layers 0..layer_idx inclusive (reference
+            semantics)."""
+            self._freeze_until = layer_idx
+            return self
+
+        def remove_output_layer(self):
+            return self.remove_layers_from_output(1)
+
+        def remove_layers_from_output(self, n: int):
+            if n > len(self._keep):
+                raise ValueError("removing more layers than exist")
+            self._keep = self._keep[:len(self._keep) - n]
+            return self
+
+        def n_out_replace(self, layer_idx: int, n_out: int):
+            """Replace layer_idx's output width (+ reinit it and fix
+            the downstream layer's n_in) keeping its type/config."""
+            self._nout_replaced[layer_idx] = n_out
+            return self
+
+        def add_layer(self, layer):
+            self._appended.append(layer)
+            return self
+
+        def build(self) -> MultiLayerNetwork:
+            old_layers = self._conf.layers
+            layers = [old_layers[i] for i in self._keep] \
+                + list(self._appended)
+            conf = copy.deepcopy(self._conf)
+            conf.layers = layers
+            conf.input_preprocessors = {
+                i: p for i, p in conf.input_preprocessors.items()
+                if i < len(layers)}
+
+            reinit = set()   # new-net indices whose params re-randomize
+            for idx, n_out in self._nout_replaced.items():
+                layers[idx].n_out = n_out
+                reinit.add(idx)
+                if idx + 1 < len(layers):
+                    layers[idx + 1].n_in = None   # re-inferred
+                    reinit.add(idx + 1)
+            for i in range(len(self._appended)):
+                reinit.add(len(self._keep) + i)
+            if self._appended and self._keep:
+                # appended layers infer n_in from the retained stack
+                pass
+
+            if self._fine_tune is not None:
+                self._fine_tune.apply_to(conf)
+            for i in range(min(self._freeze_until + 1, len(layers))):
+                layers[i].updater = NoOp()
+                layers[i].frozen = True
+
+            new = MultiLayerNetwork(conf)
+            new.init()
+            # copy trained params for retained, non-reinit layers
+            for new_i, old_i in enumerate(self._keep):
+                if new_i in reinit:
+                    continue
+                old_p = self._net.params.get(f"layer_{old_i}", {})
+                new.params[f"layer_{new_i}"] = jax.tree_util.tree_map(
+                    lambda a: a, old_p)
+            return new

@@ -1,0 +1,21 @@
+"""Reinforcement learning subsystem (SURVEY.md D18 — RL4J parity).
+
+Reference: `rl4j/` — `org.deeplearning4j.rl4j.mdp.MDP` (environment
+contract), `learning.sync.qlearning.QLearningDiscreteDense` (DQN with
+target network, epsilon-greedy, experience replay),
+`learning.async.a3c` (advantage actor-critic), `policy.DQNPolicy`.
+
+TPU-first: the Q/policy networks are jitted pure functions; the DQN
+TD-target update and the A2C advantage update are each ONE jitted
+step over a replay minibatch (the reference runs per-transition JVM
+loops + fit() calls).
+"""
+from .mdp import MDP, CartPole, GridWorld, StepReply
+from .qlearning import QLearningConfiguration, QLearningDiscreteDense
+from .policy import DQNPolicy, EpsGreedy
+from .a2c import A2CConfiguration, A2CDiscreteDense
+
+__all__ = ["MDP", "StepReply", "CartPole", "GridWorld",
+           "QLearningConfiguration", "QLearningDiscreteDense",
+           "DQNPolicy", "EpsGreedy", "A2CConfiguration",
+           "A2CDiscreteDense"]

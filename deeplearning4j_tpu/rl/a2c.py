@@ -1,0 +1,128 @@
+"""Advantage actor-critic (reference: `org.deeplearning4j.rl4j.
+learning.async.a3c.discrete.A3CDiscreteDense`). The reference runs
+asynchronous JVM worker threads against a shared model; on TPU the
+idiomatic equivalent is synchronous A2C — N rollouts collected, ONE
+jitted policy+value update (async gradient races buy nothing when the
+step itself is a single fused XLA program)."""
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .mdp import MDP
+from .qlearning import _mlp_apply, _mlp_init
+
+
+@dataclass
+class A2CConfiguration:
+    seed: int = 123
+    gamma: float = 0.99
+    learning_rate: float = 3e-3
+    n_step: int = 32            # rollout length between updates
+    entropy_coef: float = 0.01
+    value_coef: float = 0.5
+    max_step: int = 20_000
+    hidden: tuple = (64,)
+
+
+class A2CDiscreteDense:
+    """Shared-trunk actor-critic over dense observations."""
+
+    def __init__(self, mdp: MDP, conf: Optional[A2CConfiguration]
+                 = None):
+        self.mdp = mdp
+        self.conf = conf or A2CConfiguration()
+        c = self.conf
+        key = jax.random.PRNGKey(c.seed)
+        k1, k2, k3 = jax.random.split(key, 3)
+        trunk_sizes = (mdp.obs_size,) + tuple(c.hidden)
+        self.params = {
+            "trunk": _mlp_init(k1, trunk_sizes),
+            "pi": _mlp_init(k2, (trunk_sizes[-1], mdp.n_actions)),
+            "v": _mlp_init(k3, (trunk_sizes[-1], 1)),
+        }
+        self._rng = np.random.RandomState(c.seed + 1)
+        self.step_count = 0
+        self._update = jax.jit(self._make_update())
+
+    def _forward(self, params, obs):
+        h = _mlp_apply(params["trunk"], obs)
+        h = jax.nn.relu(h)
+        return (_mlp_apply(params["pi"], h),
+                _mlp_apply(params["v"], h)[..., 0])
+
+    def _make_update(self):
+        c = self.conf
+
+        def update(params, obs, act, ret):
+            def loss_fn(p):
+                logits, v = self._forward(p, obs)
+                logp = jax.nn.log_softmax(logits)
+                adv = ret - v
+                pg = -jnp.mean(jnp.take_along_axis(
+                    logp, act[:, None], -1)[:, 0]
+                    * jax.lax.stop_gradient(adv))
+                vloss = jnp.mean(adv ** 2)
+                ent = -jnp.mean(jnp.sum(jnp.exp(logp) * logp, -1))
+                return pg + c.value_coef * vloss - c.entropy_coef * ent
+
+            loss, g = jax.value_and_grad(loss_fn)(params)
+            new = jax.tree_util.tree_map(
+                lambda p_, g_: p_ - c.learning_rate * g_, params, g)
+            return new, loss
+
+        return update
+
+    def choose_action(self, obs, greedy: bool = False) -> int:
+        logits, _ = self._forward(self.params,
+                                  jnp.asarray(obs[None]))
+        p = np.asarray(jax.nn.softmax(logits[0]))
+        if greedy:
+            return int(p.argmax())
+        return int(self._rng.choice(len(p), p=p / p.sum()))
+
+    def train(self, n_updates: Optional[int] = None) -> List[float]:
+        """Collect n_step rollouts and update until max_step;
+        returns per-episode rewards."""
+        c = self.conf
+        rewards, ep_reward = [], 0.0
+        obs = self.mdp.reset()
+        buf_o, buf_a, buf_r, buf_d = [], [], [], []
+        updates = 0
+        while self.step_count < c.max_step:
+            buf_o.append(obs)
+            a = self.choose_action(obs)
+            reply = self.mdp.step(a)
+            buf_a.append(a)
+            buf_r.append(reply.reward)
+            buf_d.append(reply.done)
+            ep_reward += reply.reward
+            obs = reply.observation
+            self.step_count += 1
+            if reply.done:
+                rewards.append(ep_reward)
+                ep_reward = 0.0
+                obs = self.mdp.reset()
+            if len(buf_o) >= c.n_step:
+                # n-step discounted returns, bootstrapped from V
+                _, v_last = self._forward(
+                    self.params, jnp.asarray(obs[None]))
+                ret = float(v_last[0]) if not buf_d[-1] else 0.0
+                rets = np.zeros(len(buf_r), np.float32)
+                for i in reversed(range(len(buf_r))):
+                    ret = buf_r[i] + c.gamma * ret * (1 - buf_d[i])
+                    rets[i] = ret
+                self.params, _ = self._update(
+                    self.params,
+                    jnp.asarray(np.stack(buf_o)),
+                    jnp.asarray(np.asarray(buf_a, np.int32)),
+                    jnp.asarray(rets))
+                buf_o, buf_a, buf_r, buf_d = [], [], [], []
+                updates += 1
+                if n_updates is not None and updates >= n_updates:
+                    break
+        return rewards

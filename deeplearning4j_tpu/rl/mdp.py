@@ -1,0 +1,132 @@
+"""MDP environment contract + built-in test environments.
+
+Reference: `org.deeplearning4j.rl4j.mdp.MDP` (reset/step/isDone +
+observation/action spaces) and its toy MDPs; `StepReply` is the
+reference's step return carrier. CartPole matches the classic
+dynamics (the reference ships gym bindings; zero-egress here, so the
+physics live in-repo). GridWorld is a small deterministic MDP for
+exact-value tests.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any, Optional, Tuple
+
+import numpy as np
+
+
+@dataclass
+class StepReply:
+    observation: np.ndarray
+    reward: float
+    done: bool
+    info: Any = None
+
+
+class MDP:
+    """reset() -> obs; step(action) -> StepReply; close()."""
+
+    obs_size: int
+    n_actions: int
+
+    def reset(self) -> np.ndarray:
+        raise NotImplementedError
+
+    def step(self, action: int) -> StepReply:
+        raise NotImplementedError
+
+    def is_done(self) -> bool:
+        raise NotImplementedError
+
+    def close(self):
+        pass
+
+
+class CartPole(MDP):
+    """Classic cart-pole balancing (gym CartPole-v1 dynamics)."""
+
+    obs_size = 4
+    n_actions = 2
+
+    def __init__(self, seed: int = 0, max_steps: int = 500):
+        self._rng = np.random.RandomState(seed)
+        self.max_steps = max_steps
+        self.gravity = 9.8
+        self.masscart, self.masspole = 1.0, 0.1
+        self.total_mass = self.masscart + self.masspole
+        self.length = 0.5
+        self.polemass_length = self.masspole * self.length
+        self.force_mag = 10.0
+        self.tau = 0.02
+        self.theta_threshold = 12 * 2 * np.pi / 360
+        self.x_threshold = 2.4
+        self._state = None
+        self._steps = 0
+        self._done = True
+
+    def reset(self) -> np.ndarray:
+        self._state = self._rng.uniform(-0.05, 0.05, 4)
+        self._steps = 0
+        self._done = False
+        return self._state.astype(np.float32)
+
+    def step(self, action: int) -> StepReply:
+        x, x_dot, th, th_dot = self._state
+        force = self.force_mag if action == 1 else -self.force_mag
+        cos, sin = np.cos(th), np.sin(th)
+        temp = (force + self.polemass_length * th_dot ** 2 * sin) \
+            / self.total_mass
+        th_acc = (self.gravity * sin - cos * temp) / (
+            self.length * (4.0 / 3.0
+                           - self.masspole * cos ** 2
+                           / self.total_mass))
+        x_acc = temp - self.polemass_length * th_acc * cos \
+            / self.total_mass
+        x += self.tau * x_dot
+        x_dot += self.tau * x_acc
+        th += self.tau * th_dot
+        th_dot += self.tau * th_acc
+        self._state = np.array([x, x_dot, th, th_dot])
+        self._steps += 1
+        self._done = bool(x < -self.x_threshold or x > self.x_threshold
+                          or th < -self.theta_threshold
+                          or th > self.theta_threshold
+                          or self._steps >= self.max_steps)
+        return StepReply(self._state.astype(np.float32), 1.0,
+                         self._done)
+
+    def is_done(self) -> bool:
+        return self._done
+
+
+class GridWorld(MDP):
+    """1-D corridor: start left, +1 reward at the right end,
+    deterministic — Q-values have a closed form (gamma^k), used for
+    exact DQN convergence tests."""
+
+    def __init__(self, n: int = 6):
+        self.n = n
+        self.obs_size = n
+        self.n_actions = 2   # 0 = left, 1 = right
+        self._pos = 0
+        self._done = True
+
+    def _obs(self):
+        o = np.zeros(self.n, np.float32)
+        o[self._pos] = 1.0
+        return o
+
+    def reset(self):
+        self._pos = 0
+        self._done = False
+        return self._obs()
+
+    def step(self, action: int) -> StepReply:
+        self._pos = max(0, min(self.n - 1,
+                               self._pos + (1 if action == 1 else -1)))
+        done = self._pos == self.n - 1
+        self._done = done
+        return StepReply(self._obs(), 1.0 if done else 0.0, done)
+
+    def is_done(self):
+        return self._done

@@ -1,0 +1,48 @@
+"""Policies (reference: `org.deeplearning4j.rl4j.policy.{Policy,
+DQNPolicy,EpsGreedy}`)."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+
+class DQNPolicy:
+    """Greedy argmax-Q policy over a trained network."""
+
+    def __init__(self, params, q_fn):
+        self.params = params
+        self._q_fn = q_fn
+
+    def next_action(self, obs) -> int:
+        q = self._q_fn(self.params, jnp.asarray(
+            np.asarray(obs)[None]))
+        return int(jnp.argmax(q[0]))
+
+    def play(self, mdp, max_steps: int = 1000) -> float:
+        """Run one greedy episode; returns total reward
+        (reference: Policy.play)."""
+        obs = mdp.reset()
+        total = 0.0
+        for _ in range(max_steps):
+            reply = mdp.step(self.next_action(obs))
+            total += reply.reward
+            obs = reply.observation
+            if reply.done:
+                break
+        return total
+
+
+class EpsGreedy:
+    """Epsilon-greedy wrapper (reference: EpsGreedy policy)."""
+
+    def __init__(self, inner, n_actions: int, epsilon: float = 0.1,
+                 seed: int = 0):
+        self.inner = inner
+        self.n_actions = n_actions
+        self.epsilon = epsilon
+        self._rng = np.random.RandomState(seed)
+
+    def next_action(self, obs) -> int:
+        if self._rng.rand() < self.epsilon:
+            return self._rng.randint(self.n_actions)
+        return self.inner.next_action(obs)

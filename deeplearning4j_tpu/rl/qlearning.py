@@ -1,0 +1,186 @@
+"""DQN (reference: `org.deeplearning4j.rl4j.learning.sync.qlearning.
+discrete.QLearningDiscreteDense` + `QLearning.QLConfiguration`):
+epsilon-greedy exploration, uniform experience replay, target network
+synced every ``target_dqn_update_freq`` steps, double-DQN option.
+
+TPU-first: the Q-network is a pure MLP over params pytrees; the TD
+update is one jitted step (gather/argmax/Huber) over a replay batch.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .mdp import MDP
+
+
+@dataclass
+class QLearningConfiguration:
+    """reference: QLearning.QLConfiguration (field-for-field)."""
+    seed: int = 123
+    max_epoch_step: int = 200
+    max_step: int = 10_000
+    exp_replay_size: int = 10_000
+    batch_size: int = 64
+    target_dqn_update_freq: int = 100
+    update_start: int = 100
+    reward_factor: float = 1.0
+    gamma: float = 0.99
+    error_clamp: float = 1.0
+    min_epsilon: float = 0.05
+    epsilon_nb_step: int = 3000
+    double_dqn: bool = True
+    learning_rate: float = 1e-3
+    hidden: tuple = (64, 64)
+
+
+def _mlp_init(key, sizes):
+    params = []
+    for i in range(len(sizes) - 1):
+        key, k = jax.random.split(key)
+        w = jax.random.normal(k, (sizes[i], sizes[i + 1])) \
+            * np.sqrt(2.0 / sizes[i])
+        params.append({"w": w, "b": jnp.zeros(sizes[i + 1])})
+    return params
+
+
+def _mlp_apply(params, x):
+    for i, lyr in enumerate(params):
+        x = x @ lyr["w"] + lyr["b"]
+        if i < len(params) - 1:
+            x = jax.nn.relu(x)
+    return x
+
+
+class ReplayMemory:
+    """Uniform ring-buffer replay (reference: ExpReplay)."""
+
+    def __init__(self, capacity: int, obs_size: int, seed: int):
+        self.capacity = capacity
+        self.obs = np.zeros((capacity, obs_size), np.float32)
+        self.next_obs = np.zeros((capacity, obs_size), np.float32)
+        self.action = np.zeros(capacity, np.int32)
+        self.reward = np.zeros(capacity, np.float32)
+        self.done = np.zeros(capacity, np.float32)
+        self.size = 0
+        self._pos = 0
+        self._rng = np.random.RandomState(seed)
+
+    def store(self, o, a, r, no, d):
+        i = self._pos
+        self.obs[i], self.action[i], self.reward[i] = o, a, r
+        self.next_obs[i], self.done[i] = no, float(d)
+        self._pos = (self._pos + 1) % self.capacity
+        self.size = min(self.size + 1, self.capacity)
+
+    def sample(self, n):
+        idx = self._rng.randint(0, self.size, n)
+        return (self.obs[idx], self.action[idx], self.reward[idx],
+                self.next_obs[idx], self.done[idx])
+
+
+class QLearningDiscreteDense:
+    """DQN over a dense-observation MDP (reference class name)."""
+
+    def __init__(self, mdp: MDP,
+                 conf: Optional[QLearningConfiguration] = None):
+        self.mdp = mdp
+        self.conf = conf or QLearningConfiguration()
+        c = self.conf
+        key = jax.random.PRNGKey(c.seed)
+        sizes = (mdp.obs_size,) + tuple(c.hidden) + (mdp.n_actions,)
+        self.params = _mlp_init(key, sizes)
+        self.target_params = jax.tree_util.tree_map(
+            lambda a: a, self.params)
+        self.memory = ReplayMemory(c.exp_replay_size, mdp.obs_size,
+                                   c.seed + 1)
+        self._rng = np.random.RandomState(c.seed + 2)
+        self.step_count = 0
+        self._train_step = jax.jit(self._make_step())
+        self._q_fn = jax.jit(_mlp_apply)
+
+    def _make_step(self):
+        c = self.conf
+
+        def step(params, target_params, obs, act, rew, nobs, done):
+            if c.double_dqn:
+                # online net picks, target net evaluates
+                next_a = jnp.argmax(_mlp_apply(params, nobs), -1)
+                next_q = jnp.take_along_axis(
+                    _mlp_apply(target_params, nobs),
+                    next_a[:, None], -1)[:, 0]
+            else:
+                next_q = jnp.max(_mlp_apply(target_params, nobs), -1)
+            target = rew * c.reward_factor \
+                + c.gamma * next_q * (1.0 - done)
+
+            def loss_fn(p):
+                q = jnp.take_along_axis(_mlp_apply(p, obs),
+                                        act[:, None], -1)[:, 0]
+                err = q - jax.lax.stop_gradient(target)
+                # Huber (the reference's error clamp)
+                d = c.error_clamp
+                ae = jnp.abs(err)
+                return jnp.mean(jnp.where(
+                    ae <= d, 0.5 * err ** 2, d * (ae - 0.5 * d)))
+
+            loss, g = jax.value_and_grad(loss_fn)(params)
+            new = jax.tree_util.tree_map(
+                lambda p, gg: p - c.learning_rate * gg, params, g)
+            return new, loss
+
+        return step
+
+    # -- policy -------------------------------------------------------
+    def epsilon(self) -> float:
+        c = self.conf
+        f = min(1.0, self.step_count / max(1, c.epsilon_nb_step))
+        return 1.0 + f * (c.min_epsilon - 1.0)
+
+    def choose_action(self, obs, greedy: bool = False) -> int:
+        if not greedy and self._rng.rand() < self.epsilon():
+            return self._rng.randint(self.mdp.n_actions)
+        q = self._q_fn(self.params, jnp.asarray(obs[None]))
+        return int(jnp.argmax(q[0]))
+
+    # -- training -----------------------------------------------------
+    def train_epoch(self) -> float:
+        """One episode; returns its total reward."""
+        c = self.conf
+        obs = self.mdp.reset()
+        total = 0.0
+        for _ in range(c.max_epoch_step):
+            a = self.choose_action(obs)
+            reply = self.mdp.step(a)
+            self.memory.store(obs, a, reply.reward,
+                              reply.observation, reply.done)
+            total += reply.reward
+            obs = reply.observation
+            self.step_count += 1
+            if (self.memory.size >= c.update_start):
+                batch = self.memory.sample(c.batch_size)
+                self.params, _ = self._train_step(
+                    self.params, self.target_params,
+                    *(jnp.asarray(x) for x in batch))
+            if self.step_count % c.target_dqn_update_freq == 0:
+                self.target_params = jax.tree_util.tree_map(
+                    lambda a_: a_, self.params)
+            if reply.done:
+                break
+        return total
+
+    def train(self, n_epochs: Optional[int] = None) -> List[float]:
+        rewards = []
+        while self.step_count < self.conf.max_step:
+            rewards.append(self.train_epoch())
+            if n_epochs is not None and len(rewards) >= n_epochs:
+                break
+        return rewards
+
+    def get_policy(self):
+        from .policy import DQNPolicy
+        return DQNPolicy(self.params, self._q_fn)

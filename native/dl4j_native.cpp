@@ -233,11 +233,11 @@ int64_t dl4j_parse_csv_floats(const char* buf, int64_t len, char delim,
     const char* p = buf;
     const char* end = buf + len;
     while (p < end) {
-        // skip fully blank trailing lines
-        if (*p == '\n' && cur_cols == 0) {
-            const char* q = p;
-            while (q < end && (*q == '\n' || *q == '\r')) ++q;
-            if (q >= end) break;
+        // skip blank lines anywhere (the Python fallback filters
+        // them, so the two paths must agree)
+        if ((*p == '\n' || *p == '\r') && cur_cols == 0) {
+            ++p;
+            continue;
         }
         // delimit THIS field first (strtof alone would eat the
         // newline as leading whitespace and merge rows when a field

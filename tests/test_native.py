@@ -108,6 +108,32 @@ class TestCsv:
         m = nat.parse_csv_floats("1,2\n3,4")
         np.testing.assert_allclose(m, [[1, 2], [3, 4]])
 
+    def test_blank_interior_lines_skipped(self, built):
+        """Native and fallback must agree: blank lines filtered."""
+        m = nat.parse_csv_floats("1,2\n\n3,4\n\n")
+        np.testing.assert_allclose(m, [[1, 2], [3, 4]])
+
+    def test_non_numeric_field_is_nan_both_paths(self, built,
+                                                 monkeypatch):
+        m = nat.parse_csv_floats("a,2\n3,4\n")
+        assert np.isnan(m[0, 0]) and m[0, 1] == 2
+        monkeypatch.setenv("DL4J_TPU_DISABLE_NATIVE", "1")
+        from deeplearning4j_tpu.native import bridge
+        monkeypatch.setattr(bridge, "_lib", None)
+        monkeypatch.setattr(bridge, "_build_attempted", True)
+        m2 = nat.parse_csv_floats("a,2\n3,4\n")
+        assert np.isnan(m2[0, 0]) and m2[0, 1] == 2
+
+    def test_decode_rejects_bad_out_buffer(self, built):
+        enc = nat.threshold_encode(
+            np.array([1.0, -1.0], np.float32), 0.5)
+        with pytest.raises(ValueError, match="float32"):
+            nat.threshold_decode(enc, 0.5, 2,
+                                 out=np.zeros(2, np.float64))
+        with pytest.raises(ValueError, match="size"):
+            nat.threshold_decode(enc, 0.5, 2,
+                                 out=np.zeros(1, np.float32))
+
     def test_record_reader_fast_path(self, built, tmp_path):
         p = tmp_path / "data.csv"
         rows = np.arange(30, dtype=np.float32).reshape(10, 3)
@@ -189,6 +215,15 @@ class TestArena:
         big = ws.alloc((1024,), np.float32)   # > capacity -> spill
         big[:] = 1.0
         assert big.shape == (1024,)
+
+    def test_escaping_view_pins_arena(self, built):
+        """A view outliving its arena must keep the malloc block
+        alive (no use-after-free)."""
+        import gc
+        a = nat.arena(1 << 12).alloc((64,), np.float32)
+        gc.collect()
+        a[:] = 7.0                      # would corrupt freed memory
+        assert (np.asarray(a) == 7.0).all()
 
 
 class TestAsyncIterator:

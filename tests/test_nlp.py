@@ -1,0 +1,166 @@
+"""NLP subsystem tests (SURVEY.md D16: tokenizers, Word2Vec,
+ParagraphVectors, BertIterator)."""
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.nlp import (BertIterator, BertWordPieceTokenizer,
+                                    CommonPreprocessor,
+                                    DefaultTokenizerFactory,
+                                    ParagraphVectors, Word2Vec,
+                                    build_vocab)
+
+
+class TestTokenizers:
+    def test_default_tokenizer_with_preprocessor(self):
+        tf = DefaultTokenizerFactory()
+        tf.set_token_pre_processor(CommonPreprocessor())
+        tk = tf.create("Hello, World!  FOO-bar 42.")
+        assert tk.get_tokens() == ["hello", "world", "foobar", "42"]
+        assert tk.count_tokens() == 4
+        assert tk.has_more_tokens()
+        assert tk.next_token() == "hello"
+
+    def test_wordpiece_classic(self):
+        vocab = ["[PAD]", "[UNK]", "[CLS]", "[SEP]", "[MASK]",
+                 "un", "##aff", "##able", "runn", "##ing", "the"]
+        tk = BertWordPieceTokenizer(vocab)
+        assert tk.tokenize("unaffable") == ["un", "##aff", "##able"]
+        assert tk.tokenize("THE unaffable") == ["the", "un", "##aff",
+                                                "##able"]
+        assert tk.tokenize("xyzzy") == ["[UNK]"]
+
+    def test_wordpiece_punctuation_split(self):
+        vocab = ["[UNK]", "hello", "world", ",", "!"]
+        tk = BertWordPieceTokenizer(vocab)
+        assert tk.tokenize("hello, world!") == ["hello", ",", "world",
+                                                "!"]
+
+    def test_vocab_builder_roundtrip(self):
+        corpus = ["the quick brown fox", "the lazy dog",
+                  "the quick dog"]
+        vocab = BertWordPieceTokenizer.build_vocab(corpus, size=64)
+        tk = BertWordPieceTokenizer(vocab)
+        ids = tk.encode("the quick dog")
+        assert tk.vocab["[UNK]"] not in ids
+        assert len(ids) == 3
+
+
+def _two_cluster_corpus(n=300, seed=0):
+    """Sentences drawn from two disjoint co-occurrence clusters."""
+    rng = np.random.RandomState(seed)
+    a = ["apple", "banana", "cherry", "grape"]
+    b = ["bolt", "nut", "wrench", "hammer"]
+    out = []
+    for _ in range(n):
+        words = a if rng.rand() < 0.5 else b
+        out.append(" ".join(rng.choice(words, 6)))
+    return out, a, b
+
+
+class TestWord2Vec:
+    def test_cluster_similarity(self):
+        corpus, a, b = _two_cluster_corpus()
+        w2v = (Word2Vec.Builder()
+               .min_word_frequency(2).layer_size(24).window_size(3)
+               .negative_sample(5).epochs(8).seed(7)
+               .learning_rate(0.0025)   # tiny vocab: see class doc
+               .iterate(corpus).build())
+        w2v.fit()
+        intra = w2v.similarity("apple", "banana")
+        inter = w2v.similarity("apple", "wrench")
+        assert intra > inter + 0.2, (intra, inter)
+        near = w2v.words_nearest("bolt", 3)
+        assert set(near) <= set(b), near
+
+    def test_vector_api(self):
+        corpus, a, b = _two_cluster_corpus(100)
+        w2v = Word2Vec(layer_size=8, epochs=1, seed=1)
+        w2v.fit(corpus)
+        assert w2v.has_word("apple")
+        assert not w2v.has_word("zebra")
+        assert w2v.get_word_vector("apple").shape == (8,)
+        assert w2v.get_word_vector_matrix().shape[1] == 8
+
+
+class TestParagraphVectors:
+    def test_doc_clusters_and_inference(self):
+        corpus, a, b = _two_cluster_corpus(120, seed=3)
+        labels = [f"D{i}" for i in range(len(corpus))]
+        pv = ParagraphVectors(layer_size=16, epochs=50, seed=5,
+                              negative=5, learning_rate=0.02)
+        pv.fit(corpus, labels)
+        # infer a new fruit-doc: closer to fruit docs than tool docs
+        v = pv.infer_vector("apple cherry banana grape apple cherry",
+                            steps=300, learning_rate=0.08)
+        sims = pv.doc_vectors @ v / (
+            np.linalg.norm(pv.doc_vectors, axis=1)
+            * np.linalg.norm(v) + 1e-12)
+        fruit = [i for i, s in enumerate(corpus) if "apple" in s
+                 or "banana" in s or "cherry" in s or "grape" in s]
+        tools = [i for i in range(len(corpus)) if i not in fruit]
+        assert sims[fruit].mean() > sims[tools].mean() + 0.1
+
+
+class TestBertIterator:
+    def _tokenizer(self):
+        corpus = ["the quick brown fox jumps over the lazy dog"] * 4
+        vocab = BertWordPieceTokenizer.build_vocab(corpus, size=128)
+        return BertWordPieceTokenizer(vocab)
+
+    def test_shapes_and_special_tokens(self):
+        tk = self._tokenizer()
+        sents = ["the quick brown fox", "the lazy dog"] * 4
+        it = BertIterator(tk, sents, max_length=16, batch_size=4)
+        batch = it.next()
+        assert batch["input_ids"].shape == (4, 16)
+        assert batch["attention_mask"].shape == (4, 16)
+        assert (batch["input_ids"][:, 0] == tk.id_of("[CLS]")).all()
+        # mlm task: labels -1 on unmasked, original ids on masked
+        lab = batch["mlm_labels"]
+        assert ((lab == -1) | (lab >= 0)).all()
+
+    def test_masking_statistics(self):
+        tk = self._tokenizer()
+        sents = ["the quick brown fox jumps over the lazy dog"] * 64
+        it = BertIterator(tk, sents, max_length=16, batch_size=64,
+                          mask_prob=0.15, seed=2)
+        b = it.next()
+        real = np.isin(b["input_ids"], [tk.id_of("[PAD]"),
+                                        tk.id_of("[CLS]"),
+                                        tk.id_of("[SEP]")],
+                       invert=True)
+        n_masked = (b["mlm_labels"] >= 0).sum()
+        n_maskable = real.sum() + (
+            b["input_ids"] == tk.id_of("[MASK]")).sum()
+        frac = n_masked / n_maskable
+        assert 0.08 < frac < 0.25, frac
+
+    def test_feeds_bert_pretraining(self):
+        tk = self._tokenizer()
+        sents = ["the quick brown fox jumps", "the lazy dog sleeps",
+                 "quick dog over fox", "lazy fox the dog"] * 2
+        it = BertIterator(tk, sents, max_length=12, batch_size=8,
+                          seed=0)
+        from deeplearning4j_tpu.models.bert import Bert, BertConfig
+        conf = BertConfig.tiny(vocab_size=len(tk.vocab),
+                               max_position_embeddings=12)
+        bert = Bert(conf).init()
+        it.reset()
+        losses = []
+        for _ in range(6):
+            if not it.has_next():
+                it.reset()
+            losses.append(bert.fit_batch(it.next()))
+        assert np.isfinite(losses).all()
+
+    def test_classification_task(self):
+        tk = self._tokenizer()
+        sents = ["the quick fox", "lazy dog", "quick dog",
+                 "lazy fox"]
+        it = BertIterator(tk, sents, max_length=8, batch_size=4,
+                          task=BertIterator.SEQ_CLASSIFICATION,
+                          labels=[0, 1, 0, 1])
+        b = it.next()
+        assert b["labels"].shape == (4, 2)
+        assert (b["labels"].sum(1) == 1).all()
+        assert "mlm_labels" not in b

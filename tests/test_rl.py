@@ -1,0 +1,96 @@
+"""RL subsystem tests (SURVEY.md D18: MDP, DQN, A2C, policies)."""
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.rl import (A2CConfiguration, A2CDiscreteDense,
+                                   CartPole, DQNPolicy, GridWorld,
+                                   QLearningConfiguration,
+                                   QLearningDiscreteDense)
+
+
+class TestMdp:
+    def test_cartpole_contract(self):
+        env = CartPole(seed=0)
+        obs = env.reset()
+        assert obs.shape == (4,)
+        reply = env.step(1)
+        assert reply.observation.shape == (4,)
+        assert reply.reward == 1.0
+        # random policy fails well before max_steps
+        steps = 0
+        env.reset()
+        while not env.is_done() and steps < 600:
+            env.step(np.random.randint(2))
+            steps += 1
+        assert steps < 500
+
+    def test_gridworld_deterministic(self):
+        env = GridWorld(4)
+        env.reset()
+        r = [env.step(1) for _ in range(3)]
+        assert r[-1].done and r[-1].reward == 1.0
+        assert sum(x.reward for x in r[:-1]) == 0
+
+
+class TestDqn:
+    def test_gridworld_learns_optimal_policy(self):
+        env = GridWorld(5)
+        conf = QLearningConfiguration(
+            seed=3, max_step=4000, max_epoch_step=30,
+            exp_replay_size=2000, batch_size=32,
+            target_dqn_update_freq=50, update_start=50,
+            epsilon_nb_step=1500, learning_rate=5e-3, hidden=(32,))
+        dqn = QLearningDiscreteDense(env, conf)
+        dqn.train()
+        policy = dqn.get_policy()
+        assert isinstance(policy, DQNPolicy)
+        # optimal: 4 steps right, total reward 1
+        total = policy.play(GridWorld(5), max_steps=10)
+        assert total == 1.0
+        # greedy action from start must be RIGHT
+        assert policy.next_action(GridWorld(5).reset()) == 1
+
+    def test_epsilon_anneals(self):
+        dqn = QLearningDiscreteDense(GridWorld(4),
+                                     QLearningConfiguration(
+                                         epsilon_nb_step=100,
+                                         min_epsilon=0.1))
+        assert dqn.epsilon() == pytest.approx(1.0)
+        dqn.step_count = 50
+        assert 0.1 < dqn.epsilon() < 1.0
+        dqn.step_count = 1000
+        assert dqn.epsilon() == pytest.approx(0.1)
+
+    def test_cartpole_improves(self):
+        conf = QLearningConfiguration(
+            seed=0, max_step=15000, max_epoch_step=500,
+            batch_size=64, target_dqn_update_freq=100,
+            update_start=500, epsilon_nb_step=5000,
+            learning_rate=1e-3, hidden=(64, 64),
+            exp_replay_size=20000)
+        dqn = QLearningDiscreteDense(CartPole(seed=1), conf)
+        rewards = dqn.train()
+        early = np.mean(rewards[:5])
+        greedy = np.mean([dqn.get_policy().play(CartPole(seed=100 + i),
+                                                max_steps=500)
+                          for i in range(3)])
+        assert greedy > early + 20, (early, greedy)
+        assert greedy > 40, greedy
+
+
+class TestA2C:
+    def test_gridworld_learns(self):
+        env = GridWorld(5)
+        conf = A2CConfiguration(seed=1, max_step=6000, n_step=16,
+                                learning_rate=5e-3, hidden=(32,))
+        a2c = A2CDiscreteDense(env, conf)
+        a2c.train()
+        # greedy rollout reaches the goal
+        env2 = GridWorld(5)
+        obs = env2.reset()
+        for _ in range(6):
+            reply = env2.step(a2c.choose_action(obs, greedy=True))
+            obs = reply.observation
+            if reply.done:
+                break
+        assert reply.done and reply.reward == 1.0

@@ -1,0 +1,156 @@
+"""Transfer learning (SURVEY.md D10) + early stopping (D12) tests."""
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.activations import Activation
+from deeplearning4j_tpu.datasets.dataset import DataSet
+from deeplearning4j_tpu.datasets.iterators import ListDataSetIterator
+from deeplearning4j_tpu.earlystopping import (
+    DataSetLossCalculator, EarlyStoppingConfiguration,
+    EarlyStoppingTrainer, InMemoryModelSaver,
+    MaxEpochsTerminationCondition,
+    MaxScoreIterationTerminationCondition,
+    ScoreImprovementEpochTerminationCondition)
+from deeplearning4j_tpu.learning import Adam
+from deeplearning4j_tpu.learning.updaters import NoOp
+from deeplearning4j_tpu.lossfunctions import LossFunction
+from deeplearning4j_tpu.nn import (InputType, MultiLayerNetwork,
+                                   NeuralNetConfiguration)
+from deeplearning4j_tpu.nn.conf.layers import DenseLayer, OutputLayer
+from deeplearning4j_tpu.nn.transferlearning import (
+    FineTuneConfiguration, TransferLearning)
+
+
+def _blobs(n=240, n_classes=3, seed=0):
+    rng = np.random.RandomState(seed)
+    centers = np.eye(n_classes, 4, dtype=np.float32) * 3
+    xs, ys = [], []
+    for i in range(n):
+        c = i % n_classes
+        xs.append(centers[c] + rng.randn(4).astype(np.float32) * 0.4)
+        ys.append(c)
+    x = np.stack(xs)
+    y = np.eye(n_classes, dtype=np.float32)[ys]
+    return x, y
+
+
+def _net(n_classes=3, seed=7):
+    conf = (NeuralNetConfiguration.Builder()
+            .seed(seed).updater(Adam(5e-2))
+            .list()
+            .layer(DenseLayer(n_out=16, activation=Activation.RELU))
+            .layer(DenseLayer(n_out=12, activation=Activation.RELU))
+            .layer(OutputLayer(n_out=n_classes,
+                               activation=Activation.SOFTMAX,
+                               loss_function=LossFunction.MCXENT))
+            .set_input_type(InputType.feed_forward(4))
+            .build())
+    net = MultiLayerNetwork(conf)
+    net.init()
+    return net
+
+
+class TestTransferLearning:
+    def _trained(self):
+        x, y = _blobs()
+        net = _net()
+        net.fit(DataSet(x, y), n_epochs=30)
+        return net
+
+    def test_freeze_and_replace_output(self):
+        src = self._trained()
+        new = (TransferLearning.Builder(src)
+               .fine_tune_configuration(
+                   FineTuneConfiguration(updater=Adam(2e-2)))
+               .set_feature_extractor(1)     # freeze layers 0..1
+               .remove_output_layer()
+               .add_layer(OutputLayer(n_out=2,
+                                      activation=Activation.SOFTMAX,
+                                      loss_function=LossFunction
+                                      .MCXENT))
+               .build())
+        # retained layers carry the trained weights
+        np.testing.assert_array_equal(
+            np.asarray(src.params["layer_0"]["W"]),
+            np.asarray(new.params["layer_0"]["W"]))
+        assert isinstance(new.conf.layers[0].updater, NoOp)
+        assert isinstance(new.conf.layers[1].updater, NoOp)
+
+        w0_before = np.asarray(new.params["layer_0"]["W"]).copy()
+        # binary relabeling of the same blobs
+        x, y3 = _blobs()
+        y2 = np.eye(2, dtype=np.float32)[(y3.argmax(1) > 0)
+                                         .astype(int)]
+        new.fit(DataSet(x, y2), n_epochs=25)
+        # frozen weights unchanged, new head learns the task
+        np.testing.assert_array_equal(
+            w0_before, np.asarray(new.params["layer_0"]["W"]))
+        pred = np.asarray(new.output(x)).argmax(1)
+        acc = (pred == y2.argmax(1)).mean()
+        assert acc > 0.9, acc
+
+    def test_n_out_replace(self):
+        src = self._trained()
+        new = (TransferLearning.Builder(src)
+               .n_out_replace(1, 20)
+               .build())
+        assert new.params["layer_1"]["W"].shape == (16, 20)
+        assert new.params["layer_2"]["W"].shape == (20, 3)
+        # layer 0 retained
+        np.testing.assert_array_equal(
+            np.asarray(src.params["layer_0"]["W"]),
+            np.asarray(new.params["layer_0"]["W"]))
+        # still trainable end-to-end
+        x, y = _blobs()
+        new.fit(DataSet(x, y), n_epochs=3)
+        assert np.isfinite(new.score())
+
+
+class TestEarlyStopping:
+    def _iters(self):
+        x, y = _blobs(180, seed=1)
+        train = ListDataSetIterator(DataSet(x[:120], y[:120]), 30)
+        val = ListDataSetIterator(DataSet(x[120:], y[120:]), 30)
+        return train, val
+
+    def test_max_epochs_terminates(self):
+        train, val = self._iters()
+        conf = (EarlyStoppingConfiguration.Builder()
+                .score_calculator(DataSetLossCalculator(val))
+                .model_saver(InMemoryModelSaver())
+                .epoch_termination_conditions(
+                    MaxEpochsTerminationCondition(4))
+                .build())
+        res = EarlyStoppingTrainer(conf, _net(), train).fit()
+        assert res.termination_reason == "EpochTermination"
+        assert res.total_epochs == 4
+        assert len(res.score_vs_epoch) == 4
+        assert res.best_model is not None
+        assert np.isfinite(res.best_model_score)
+
+    def test_score_improvement_patience(self):
+        train, val = self._iters()
+        conf = (EarlyStoppingConfiguration.Builder()
+                .score_calculator(DataSetLossCalculator(val))
+                .epoch_termination_conditions(
+                    ScoreImprovementEpochTerminationCondition(2),
+                    MaxEpochsTerminationCondition(100))
+                .build())
+        res = EarlyStoppingTrainer(conf, _net(), train).fit()
+        assert res.total_epochs < 100
+        # best model scores at least as well as the final epoch
+        assert res.best_model_score <= \
+            list(res.score_vs_epoch.values())[-1] + 1e-6
+
+    def test_divergence_guard_aborts(self):
+        train, val = self._iters()
+        conf = (EarlyStoppingConfiguration.Builder()
+                .score_calculator(DataSetLossCalculator(val))
+                .iteration_termination_conditions(
+                    MaxScoreIterationTerminationCondition(1e-9))
+                .epoch_termination_conditions(
+                    MaxEpochsTerminationCondition(50))
+                .build())
+        res = EarlyStoppingTrainer(conf, _net(), train).fit()
+        assert res.termination_reason == "IterationTermination"
+        assert res.total_epochs == 0
