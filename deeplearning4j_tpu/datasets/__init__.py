@@ -7,3 +7,6 @@ from deeplearning4j_tpu.datasets.normalizers import (  # noqa: F401
     ImagePreProcessingScaler)
 from deeplearning4j_tpu.datasets.mnist import MnistDataSetIterator  # noqa: F401
 from deeplearning4j_tpu.datasets.iris import IrisDataSetIterator  # noqa: F401
+from deeplearning4j_tpu.datasets.vision import (  # noqa: F401
+    Cifar10DataSetIterator, EmnistDataSetIterator,
+    TinyImageNetDataSetIterator)

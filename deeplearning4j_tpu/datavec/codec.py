@@ -1,0 +1,54 @@
+"""Video/frame-sequence modality (SURVEY.md V4: `datavec-data-codec`
+— `CodecRecordReader` yielding per-frame sequences).
+
+The reference decodes containers via JavaCPP-ffmpeg; this image has
+no codec libraries, so the native-decode path is gated. Supported
+here: ``.npy``/``.npz`` frame stacks ([t, h, w, c]) — the
+decoded-frames interchange format — with the same sequence-record
+contract downstream transforms consume.
+"""
+from __future__ import annotations
+
+from typing import List
+
+import numpy as np
+
+from .records import SequenceRecordReader
+from .writable import NDArrayWritable
+
+
+class CodecRecordReader(SequenceRecordReader):
+    """One sequence record per file; each step is one frame
+    (reference: CodecRecordReader with startFrame/numFrames/rate)."""
+
+    def __init__(self, start_frame: int = 0, num_frames: int = -1,
+                 rate: int = 1):
+        self.start_frame = start_frame
+        self.num_frames = num_frames
+        self.rate = rate
+        self.split = None
+
+    def initialize(self, split):
+        self.split = split
+        self.reset()
+        return self
+
+    def _frames(self, loc) -> np.ndarray:
+        loc = str(loc)
+        if loc.endswith(".npy"):
+            return np.load(loc)
+        if loc.endswith(".npz"):
+            z = np.load(loc)
+            return z[list(z.files)[0]]
+        raise NotImplementedError(
+            f"codec decode for '{loc}': only .npy/.npz frame stacks "
+            "are supported in this build (no ffmpeg in the image); "
+            "pre-extract frames to numpy")
+
+    def _make_iter(self):
+        for loc in self.split.locations():
+            f = self._frames(loc)
+            end = (self.start_frame + self.num_frames * self.rate
+                   if self.num_frames > 0 else len(f))
+            sel = f[self.start_frame:end:self.rate]
+            yield [[NDArrayWritable(fr)] for fr in sel]

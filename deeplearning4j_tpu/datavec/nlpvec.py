@@ -1,0 +1,80 @@
+"""Text vectorizers (SURVEY.md V4: `datavec-data-nlp` —
+`BagOfWordsVectorizer`, `TfidfVectorizer`)."""
+from __future__ import annotations
+
+import math
+from collections import Counter
+from typing import Dict, Iterable, List, Optional
+
+import numpy as np
+
+
+class BagOfWordsVectorizer:
+    """Count vectors over a fitted vocabulary (reference: same name;
+    tokenization delegates to the nlp tokenizer factory)."""
+
+    def __init__(self, tokenizer_factory=None,
+                 min_word_frequency: int = 1,
+                 max_vocab: Optional[int] = None):
+        if tokenizer_factory is None:
+            from ..nlp.tokenization import DefaultTokenizerFactory
+            tokenizer_factory = DefaultTokenizerFactory()
+        self.tf = tokenizer_factory
+        self.min_word_frequency = min_word_frequency
+        self.max_vocab = max_vocab
+        self.vocab: Dict[str, int] = {}
+
+    def _tokens(self, text: str) -> List[str]:
+        return self.tf.create(text).get_tokens()
+
+    def fit(self, corpus: Iterable[str]) -> "BagOfWordsVectorizer":
+        c = Counter()
+        for doc in corpus:
+            c.update(self._tokens(doc))
+        items = [(w, n) for w, n in c.most_common()
+                 if n >= self.min_word_frequency]
+        if self.max_vocab:
+            items = items[:self.max_vocab]
+        self.vocab = {w: i for i, (w, _) in enumerate(items)}
+        return self
+
+    def transform(self, text: str) -> np.ndarray:
+        v = np.zeros(len(self.vocab), np.float32)
+        for t in self._tokens(text):
+            i = self.vocab.get(t)
+            if i is not None:
+                v[i] += 1.0
+        return v
+
+    def fit_transform(self, corpus) -> np.ndarray:
+        corpus = list(corpus)
+        self.fit(corpus)
+        return np.stack([self.transform(d) for d in corpus])
+
+
+class TfidfVectorizer(BagOfWordsVectorizer):
+    """TF-IDF with smoothed idf = ln((1+N)/(1+df)) + 1 (reference:
+    TfidfVectorizer over lucene; same weighting family)."""
+
+    def __init__(self, **kw):
+        super().__init__(**kw)
+        self.idf: Optional[np.ndarray] = None
+
+    def fit(self, corpus: Iterable[str]) -> "TfidfVectorizer":
+        corpus = list(corpus)
+        super().fit(corpus)
+        df = np.zeros(len(self.vocab), np.float64)
+        for doc in corpus:
+            for i in {self.vocab[t] for t in self._tokens(doc)
+                      if t in self.vocab}:
+                df[i] += 1
+        n = len(corpus)
+        self.idf = (np.log((1.0 + n) / (1.0 + df)) + 1.0) \
+            .astype(np.float32)
+        return self
+
+    def transform(self, text: str) -> np.ndarray:
+        counts = super().transform(text)
+        total = counts.sum()
+        tf = counts / total if total else counts
+        return tf * self.idf

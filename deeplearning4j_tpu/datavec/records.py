@@ -108,7 +108,9 @@ class CSVRecordReader(LineRecordReader):
             locs = list(self.split.locations())
         except Exception:
             pass
-        if len(locs) == 1 and self.skip == 0:
+        import os as _os
+        if len(locs) == 1 and self.skip == 0 \
+                and _os.path.isfile(locs[0]):
             # single plain file: hand raw bytes straight to the C
             # parser — no per-line Python iteration, no join copy
             with open(locs[0], "rb") as f:

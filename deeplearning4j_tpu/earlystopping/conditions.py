@@ -47,6 +47,9 @@ class ScoreImprovementEpochTerminationCondition(
         self.stagnant = 0
 
     def terminate(self, epoch, score, minimize=True):
+        import math
+        if isinstance(score, float) and math.isnan(score):
+            return False          # no evaluation this epoch
         if self.best is None:
             self.best = score
             return False

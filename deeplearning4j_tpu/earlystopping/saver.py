@@ -46,8 +46,10 @@ class LocalFileModelSaver:
 
     def get_best_model(self):
         from ..utils.serializer import ModelSerializer
-        return ModelSerializer.restore_model(
-            self._path("bestModel.bin"))
+        p = self._path("bestModel.bin")
+        if not os.path.exists(p):
+            return None
+        return ModelSerializer.restore_model(p)
 
     def get_latest_model(self):
         from ..utils.serializer import ModelSerializer

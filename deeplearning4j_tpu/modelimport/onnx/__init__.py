@@ -1,0 +1,11 @@
+"""ONNX model import (SURVEY.md S7 — `samediff-import-onnx` parity).
+
+Wire-format protobuf decode (no `onnx` package needed), an
+`OpMappingRegistry`-style rule table, and a one-pass importer into
+SameDiff. A minimal encoder lives in `.protobuf` for building ONNX
+bytes (tests, lightweight export).
+"""
+from .importer import OnnxImporter, import_onnx
+from .protobuf import parse_model
+
+__all__ = ["OnnxImporter", "import_onnx", "parse_model"]

@@ -1,0 +1,186 @@
+"""ONNX → SameDiff importer (SURVEY.md S7: `samediff-import-onnx`,
+`OnnxFrameworkImporter.runImport` equivalent).
+
+ONNX names TENSORS (every node output has an explicit name and graphs
+are serialized in topological order), so the importer is a single
+forward pass: initializers become constants, non-initializer graph
+inputs become placeholders, each node maps through `ONNX_OP_MAP`, and
+graph outputs become SameDiff outputs.
+"""
+from __future__ import annotations
+
+import os
+from typing import Dict, List, Optional, Tuple
+
+import jax
+import numpy as np
+
+from ...autodiff.samediff import SameDiff, SDVariable
+from .mappings import ONNX_OP_MAP
+from .protobuf import OnnxGraph, OnnxNode, parse_model
+
+
+class OnnxImporter:
+    """One-shot importer for an ONNX inference model."""
+
+    def __init__(self, model, input_shapes: Optional[dict] = None):
+        if isinstance(model, (str, os.PathLike)):
+            with open(model, "rb") as fh:
+                model = fh.read()
+        if isinstance(model, (bytes, bytearray)):
+            self.graph = parse_model(bytes(model))
+        elif isinstance(model, OnnxGraph):
+            self.graph = model
+        else:
+            raise TypeError(type(model))
+        self.input_shapes = {k: tuple(v) for k, v in
+                             (input_shapes or {}).items()}
+        self.sd = SameDiff()
+        self.var_map: Dict[str, SDVariable] = {}
+        self.statics: Dict[str, np.ndarray] = dict(
+            self.graph.initializers)
+        self.shapes: Dict[str, Tuple[int, ...]] = {}
+        self.avals: Dict[str, jax.ShapeDtypeStruct] = {}
+        self.placeholders: List[str] = []
+        self._uniq = 0
+
+    # -- ctx API used by mapping rules --------------------------------
+    def var(self, name: str) -> SDVariable:
+        v = self.var_map.get(name)
+        if v is not None:
+            return v
+        if name in self.statics:
+            arr = self.statics[name]
+            c = self.sd.constant(self.unique(name), arr)
+            self.var_map[name] = c
+            self.shapes[name] = tuple(arr.shape)
+            return c
+        raise KeyError(f"ONNX import: unknown tensor '{name}'")
+
+    def static(self, name: str) -> Optional[np.ndarray]:
+        return self.statics.get(name)
+
+    def require_static(self, node: OnnxNode, i: int) -> np.ndarray:
+        name = node.inputs[i]
+        arr = self.statics.get(name)
+        if arr is None:
+            raise NotImplementedError(
+                f"{node.op} '{node.name}': input {i} ('{name}') must "
+                f"be a constant/initializer")
+        return arr
+
+    def set_static(self, name: str, arr: np.ndarray):
+        self.statics[name] = arr
+        self.shapes[name] = tuple(arr.shape)
+
+    def shape_of(self, name: str) -> Optional[Tuple[int, ...]]:
+        sh = self.shapes.get(name)
+        if sh is not None:
+            return sh
+        v = self.var_map.get(name)
+        if v is not None:
+            av = self.avals.get(v.name)
+            if av is not None:
+                return tuple(av.shape)
+        return None
+
+    def unique(self, base: str) -> str:
+        self._uniq += 1
+        return f"{base}__{self._uniq}"
+
+    # -- shape inference (same machinery as the TF importer) ----------
+    def _infer_new_ops(self, start_idx: int):
+        """jax.eval_shape every op emitted since start_idx — abstract
+        eval only, no FLOPs — so rules downstream can read concrete
+        shapes (Flatten/Slice/grouped Conv need them)."""
+        from ...autodiff.samediff import get_op
+        for node in self.sd.ops[start_idx:]:
+            in_avals = []
+            ok = True
+            for name in node.inputs:
+                av = self.avals.get(name)
+                if av is None:
+                    arr = self.sd._arrays.get(name)
+                    if arr is not None:
+                        av = jax.ShapeDtypeStruct(arr.shape, arr.dtype)
+                        self.avals[name] = av
+                    else:
+                        ok = False
+                        break
+                in_avals.append(av)
+            if not ok:
+                continue
+            attrs = dict(node.attrs or {})
+            try:
+                out = jax.eval_shape(
+                    lambda *xs: get_op(node.op_name)(list(xs), attrs),
+                    *in_avals)
+            except Exception:
+                continue
+            outs = out if isinstance(out, (list, tuple)) else [out]
+            for on, av in zip(node.outputs, outs):
+                self.avals[on] = jax.ShapeDtypeStruct(av.shape,
+                                                      av.dtype)
+                sv = self.sd.vars[on]
+                sv.shape = tuple(av.shape)
+                sv.dtype = av.dtype
+
+    def run(self) -> SameDiff:
+        g = self.graph
+        init_names = set(g.initializers)
+        for name, shape in g.inputs:
+            if name in init_names:
+                continue
+            shape = self.input_shapes.get(name, shape)
+            if shape is None or any(d < 0 for d in shape):
+                raise ValueError(
+                    f"input '{name}' needs a concrete shape; pass "
+                    f"input_shapes={{'{name}': (...)}}")
+            ph = self.sd.placeholder(name, shape=tuple(shape))
+            self.var_map[name] = ph
+            self.shapes[name] = tuple(shape)
+            self.avals[ph.name] = jax.ShapeDtypeStruct(
+                tuple(shape), np.float32)
+            self.placeholders.append(name)
+
+        for node in g.nodes:
+            rule = ONNX_OP_MAP.get(node.op)
+            if rule is None:
+                raise NotImplementedError(
+                    f"no ONNX mapping for op '{node.op}' "
+                    f"(node '{node.name}')")
+            start_idx = len(self.sd.ops)
+            result = rule(self, node)
+            self._infer_new_ops(start_idx)
+            if result is None:        # rule produced statics only
+                continue
+            outs = (list(result) if isinstance(result, (list, tuple))
+                    else [result])
+            for i, v in enumerate(outs):
+                if i < len(node.outputs) and node.outputs[i]:
+                    self.var_map[node.outputs[i]] = v
+                    av = self.avals.get(v.name)
+                    if av is not None:
+                        self.shapes[node.outputs[i]] = tuple(av.shape)
+
+        for out in g.outputs:
+            self.var(out)             # materialize if static
+        self.sd.outputs = list(g.outputs)
+        return self.sd
+
+    def output(self, placeholders: dict, outputs=None):
+        """Run the imported graph: {input_name: array} -> list of
+        output arrays, ordered like the ONNX graph outputs."""
+        outs = outputs or self.sd.outputs
+        ph = {self.var_map[k].name: v for k, v in placeholders.items()}
+        res = self.sd.output(ph, [self.var_map[o].name for o in outs])
+        return [res[self.var_map[o].name] for o in outs]
+
+
+def import_onnx(model, input_shapes: Optional[dict] = None) \
+        -> "OnnxImporter":
+    """Parse + map an ONNX model; returns the importer (``.sd`` is
+    the SameDiff graph, ``.output`` runs it)."""
+    imp = OnnxImporter(model, input_shapes)
+    imp.run()
+    return imp

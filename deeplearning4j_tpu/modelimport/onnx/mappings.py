@@ -1,0 +1,455 @@
+"""Per-op ONNX → SameDiff mapping rules (SURVEY.md S7:
+`samediff-import-onnx`'s OpMappingRegistry equivalent — the same
+rule-function pattern as the TF importer's `mappings.py`).
+
+ONNX convs/pools are NCHW with OIHW weights; our conv ops are NHWC
+with HWIO kernels (the TPU-friendly layout), so rules transpose on
+the way in/out and XLA cancels adjacent transposes after fusion.
+"""
+from __future__ import annotations
+
+from typing import Callable, Dict
+
+import numpy as np
+
+ONNX_OP_MAP: Dict[str, Callable] = {}
+
+
+def onnx_op(*names):
+    def deco(fn):
+        for n in names:
+            ONNX_OP_MAP[n] = fn
+        return fn
+    return deco
+
+
+# -- passthrough ------------------------------------------------------------
+@onnx_op("Identity")
+def _identity(ctx, node):
+    return ctx.sd._op("identity", [ctx.var(node.inputs[0])])
+
+
+@onnx_op("Dropout")
+def _dropout(ctx, node):
+    # inference import: identity (+ all-true mask if requested)
+    y = ctx.sd._op("identity", [ctx.var(node.inputs[0])])
+    if len(node.outputs) > 1:
+        mask = ctx.sd._op("ones_like", [ctx.var(node.inputs[0])])
+        return [y, mask]
+    return y
+
+
+# -- elementwise ------------------------------------------------------------
+_BINARY = {"Add": "add", "Sub": "sub", "Mul": "mul", "Div": "div",
+           "Pow": "pow", "Greater": "gt", "Less": "lt",
+           "Equal": "eq", "Min": "minimum", "Max": "maximum",
+           "And": "logical_and", "Or": "logical_or"}
+
+
+def _binary(ctx, node):
+    out = ctx.var(node.inputs[0])
+    for other in node.inputs[1:]:
+        out = ctx.sd._op(_BINARY[node.op], [out, ctx.var(other)])
+    return out
+
+
+for _n in _BINARY:
+    ONNX_OP_MAP[_n] = _binary
+
+
+@onnx_op("Sum", "Mean")
+def _variadic(ctx, node):
+    out = ctx.var(node.inputs[0])
+    for other in node.inputs[1:]:
+        out = ctx.sd._op("add", [out, ctx.var(other)])
+    if node.op == "Mean" and len(node.inputs) > 1:
+        out = ctx.sd._op("div", [out, ctx.sd.constant(
+            ctx.unique("mean_n"),
+            np.float32(len(node.inputs)))])
+    return out
+
+
+_UNARY = {"Relu": "relu", "Sigmoid": "sigmoid", "Tanh": "tanh",
+          "Exp": "exp", "Log": "log", "Sqrt": "sqrt", "Neg": "neg",
+          "Abs": "abs", "Erf": "erf", "Floor": "floor",
+          "Ceil": "ceil", "Round": "round", "Sign": "sign",
+          "Softplus": "softplus", "Softsign": "softsign",
+          "Not": "logical_not", "Reciprocal": "reciprocal",
+          "Sin": "sin", "Cos": "cos", "Tan": "tan", "Asin": "asin",
+          "Acos": "acos", "Atan": "atan", "Sinh": "sinh",
+          "Cosh": "cosh", "Asinh": "asinh", "Acosh": "acosh",
+          "Atanh": "atanh"}
+
+
+def _unary(ctx, node):
+    return ctx.sd._op(_UNARY[node.op], [ctx.var(node.inputs[0])])
+
+
+for _n in _UNARY:
+    ONNX_OP_MAP[_n] = _unary
+
+
+@onnx_op("LeakyRelu")
+def _leaky(ctx, node):
+    return ctx.sd._op("leaky_relu", [ctx.var(node.inputs[0])],
+                      {"alpha": node.attr("alpha", 0.01)})
+
+
+@onnx_op("Elu")
+def _elu(ctx, node):
+    return ctx.sd._op("elu", [ctx.var(node.inputs[0])])
+
+
+@onnx_op("Selu")
+def _selu(ctx, node):
+    return ctx.sd._op("selu", [ctx.var(node.inputs[0])])
+
+
+@onnx_op("Clip")
+def _clip(ctx, node):
+    lo, hi = -np.inf, np.inf
+    if node.attrs.get("min") is not None:
+        lo = node.attr("min")
+    elif len(node.inputs) > 1 and node.inputs[1]:
+        lo = float(ctx.require_static(node, 1))
+    if node.attrs.get("max") is not None:
+        hi = node.attr("max")
+    elif len(node.inputs) > 2 and node.inputs[2]:
+        hi = float(ctx.require_static(node, 2))
+    return ctx.sd._op("clip_by_value", [ctx.var(node.inputs[0])],
+                      {"clip_value_min": float(lo),
+                       "clip_value_max": float(hi)})
+
+
+@onnx_op("Softmax", "LogSoftmax")
+def _softmax(ctx, node):
+    axis = int(node.attr("axis", -1))
+    opn = "softmax" if node.op == "Softmax" else "log_softmax"
+    return ctx.sd._op(opn, [ctx.var(node.inputs[0])], {"axis": axis})
+
+
+@onnx_op("Gelu")
+def _gelu(ctx, node):
+    return ctx.sd._op("gelu", [ctx.var(node.inputs[0])])
+
+
+# -- linear algebra ---------------------------------------------------------
+@onnx_op("MatMul")
+def _matmul(ctx, node):
+    return ctx.sd._op("matmul", [ctx.var(node.inputs[0]),
+                                 ctx.var(node.inputs[1])])
+
+
+@onnx_op("Gemm")
+def _gemm(ctx, node):
+    alpha = node.attr("alpha", 1.0)
+    beta = node.attr("beta", 1.0)
+    ta, tb = node.attr("transA", 0), node.attr("transB", 0)
+    a = ctx.var(node.inputs[0])
+    b = ctx.var(node.inputs[1])
+    y = ctx.sd._op("matmul", [a, b],
+                   {"transpose_a": bool(ta), "transpose_b": bool(tb)})
+    if alpha != 1.0:
+        y = ctx.sd._op("mul", [y, ctx.sd.constant(
+            ctx.unique("gemm_alpha"), np.float32(alpha))])
+    if len(node.inputs) > 2 and node.inputs[2]:
+        c = ctx.var(node.inputs[2])
+        if beta != 1.0:
+            c = ctx.sd._op("mul", [c, ctx.sd.constant(
+                ctx.unique("gemm_beta"), np.float32(beta))])
+        y = ctx.sd._op("add", [y, c])
+    return y
+
+
+# -- shape ops --------------------------------------------------------------
+@onnx_op("Reshape")
+def _reshape(ctx, node):
+    shape = [int(v) for v in
+             np.asarray(ctx.require_static(node, 1)).reshape(-1)]
+    return ctx.sd._op("reshape", [ctx.var(node.inputs[0])],
+                      {"shape": shape})
+
+
+@onnx_op("Flatten")
+def _flatten(ctx, node):
+    axis = int(node.attr("axis", 1))
+    x = ctx.var(node.inputs[0])
+    shape = ctx.shape_of(node.inputs[0])
+    if shape is not None and axis <= len(shape):
+        lead = int(np.prod(shape[:axis])) if axis else 1
+        return ctx.sd._op("reshape", [x], {"shape": [lead, -1]})
+    raise NotImplementedError("Flatten with unknown input shape")
+
+
+@onnx_op("Transpose")
+def _transpose(ctx, node):
+    perm = node.attr("perm")
+    return ctx.sd._op("transpose", [ctx.var(node.inputs[0])],
+                      {"axes": [int(p) for p in perm]
+                       if perm is not None else None})
+
+
+@onnx_op("Concat")
+def _concat(ctx, node):
+    return ctx.sd._op("concat", [ctx.var(i) for i in node.inputs],
+                      {"axis": int(node.attr("axis", 0))})
+
+
+@onnx_op("Squeeze")
+def _squeeze(ctx, node):
+    axes = node.attr("axes")
+    if axes is None and len(node.inputs) > 1:
+        axes = [int(v) for v in
+                np.asarray(ctx.require_static(node, 1)).reshape(-1)]
+    return ctx.sd._op("squeeze", [ctx.var(node.inputs[0])],
+                      {"axis": tuple(int(a) for a in axes)
+                       if axes is not None else None})
+
+
+@onnx_op("Unsqueeze")
+def _unsqueeze(ctx, node):
+    axes = node.attr("axes")
+    if axes is None and len(node.inputs) > 1:
+        axes = [int(v) for v in
+                np.asarray(ctx.require_static(node, 1)).reshape(-1)]
+    x = ctx.var(node.inputs[0])
+    for ax in sorted(int(a) for a in axes):
+        x = ctx.sd._op("expand_dims", [x], {"axis": ax})
+    return x
+
+
+@onnx_op("Gather")
+def _gather(ctx, node):
+    return ctx.sd._op("gather", [ctx.var(node.inputs[0]),
+                                 ctx.var(node.inputs[1])],
+                      {"axis": int(node.attr("axis", 0))})
+
+
+@onnx_op("Slice")
+def _slice(ctx, node):
+    if len(node.inputs) > 1:       # opset 10+: starts/ends as inputs
+        starts = [int(v) for v in
+                  np.asarray(ctx.require_static(node, 1)).reshape(-1)]
+        ends = [int(v) for v in
+                np.asarray(ctx.require_static(node, 2)).reshape(-1)]
+        axes = ([int(v) for v in np.asarray(
+            ctx.require_static(node, 3)).reshape(-1)]
+            if len(node.inputs) > 3 and node.inputs[3]
+            else list(range(len(starts))))
+        steps = ([int(v) for v in np.asarray(
+            ctx.require_static(node, 4)).reshape(-1)]
+            if len(node.inputs) > 4 and node.inputs[4]
+            else [1] * len(starts))
+    else:
+        starts = [int(v) for v in node.attr("starts")]
+        ends = [int(v) for v in node.attr("ends")]
+        axes = [int(v) for v in node.attr("axes",
+                                          range(len(starts)))]
+        steps = [1] * len(starts)
+    shape = ctx.shape_of(node.inputs[0])
+    if shape is None:
+        raise NotImplementedError("Slice of unknown-shape tensor")
+    begin = [0] * len(shape)
+    end = list(shape)
+    stride = [1] * len(shape)
+    for st, en, ax, sp in zip(starts, ends, axes, steps):
+        d = shape[ax]
+        if st < 0:
+            st += d
+        if en < 0:
+            en += d
+        begin[ax] = min(max(st, 0), d)
+        end[ax] = min(max(en, 0), d)
+        stride[ax] = sp
+    return ctx.sd._op("strided_slice", [ctx.var(node.inputs[0])],
+                      {"begin": begin, "end": end, "strides": stride})
+
+
+@onnx_op("Cast")
+def _cast(ctx, node):
+    from .protobuf import ONNX_DTYPES
+    to = ONNX_DTYPES[int(node.attr("to"))]
+    return ctx.sd._op("cast", [ctx.var(node.inputs[0])],
+                      {"dtype": np.dtype(to).name})
+
+
+@onnx_op("Shape")
+def _shape(ctx, node):
+    shape = ctx.shape_of(node.inputs[0])
+    if shape is None:
+        raise NotImplementedError("Shape of unknown-shape tensor")
+    return ctx.sd.constant(ctx.unique(f"{node.outputs[0]}_shape"),
+                           np.asarray(shape, np.int64))
+
+
+@onnx_op("Pad")
+def _pad(ctx, node):
+    mode = node.attr("mode", b"constant")
+    if isinstance(mode, bytes):
+        mode = mode.decode()
+    if len(node.inputs) > 1:
+        pads = [int(v) for v in
+                np.asarray(ctx.require_static(node, 1)).reshape(-1)]
+    else:
+        pads = [int(v) for v in node.attr("pads")]
+    n = len(pads) // 2
+    pairs = [(pads[i], pads[i + n]) for i in range(n)]
+    return ctx.sd._op("pad", [ctx.var(node.inputs[0])],
+                      {"paddings": pairs, "mode": mode})
+
+
+# -- reductions -------------------------------------------------------------
+_REDUCE = {"ReduceMean": "reduce_mean", "ReduceSum": "reduce_sum",
+           "ReduceMax": "reduce_max", "ReduceMin": "reduce_min",
+           "ReduceProd": "reduce_prod"}
+
+
+def _reduce(ctx, node):
+    axes = node.attr("axes")
+    if axes is None and len(node.inputs) > 1 and node.inputs[1]:
+        axes = [int(v) for v in
+                np.asarray(ctx.require_static(node, 1)).reshape(-1)]
+    keep = bool(node.attr("keepdims", 1))
+    return ctx.sd._op(_REDUCE[node.op], [ctx.var(node.inputs[0])],
+                      {"axis": tuple(int(a) for a in axes)
+                       if axes is not None else None,
+                       "keep_dims": keep})
+
+
+for _n in _REDUCE:
+    ONNX_OP_MAP[_n] = _reduce
+
+
+# -- conv / pool / norm (NCHW -> NHWC) --------------------------------------
+def _nchw_to_nhwc(ctx, v):
+    return ctx.sd._op("transpose", [v], {"axes": [0, 2, 3, 1]})
+
+
+def _nhwc_to_nchw(ctx, v):
+    return ctx.sd._op("transpose", [v], {"axes": [0, 3, 1, 2]})
+
+
+def _conv_padding(node):
+    auto = node.attr("auto_pad", b"NOTSET")
+    if isinstance(auto, bytes):
+        auto = auto.decode()
+    if auto in ("SAME_UPPER", "SAME_LOWER"):
+        return "SAME"
+    if auto == "VALID":
+        return "VALID"
+    pads = node.attr("pads")
+    if not pads:
+        return "VALID"
+    pads = [int(p) for p in pads]
+    n = len(pads) // 2
+    return [(pads[i], pads[i + n]) for i in range(n)]
+
+
+@onnx_op("Conv")
+def _conv(ctx, node):
+    w_np = ctx.static(node.inputs[1])
+    if w_np is None:
+        raise NotImplementedError("Conv with non-constant weights")
+    group = int(node.attr("group", 1))
+    strides = [int(s) for s in node.attr("strides", [1, 1])]
+    dil = [int(d) for d in node.attr("dilations", [1, 1])]
+    padding = _conv_padding(node)
+    x = _nchw_to_nhwc(ctx, ctx.var(node.inputs[0]))
+    attrs = {"stride": tuple(strides), "padding": padding,
+             "dilation": tuple(dil)}
+    cin_total = w_np.shape[1] * group
+    if group == 1:
+        w = ctx.sd.constant(ctx.unique(f"{node.inputs[1]}_hwio"),
+                            np.transpose(w_np, (2, 3, 1, 0)))
+        y = ctx.sd._op("conv2d", [x, w], attrs)
+    elif group == cin_total and w_np.shape[1] == 1:
+        # depthwise: OIHW [C*m, 1, kH, kW] -> HWC(m) [kH, kW, C, m]
+        m = w_np.shape[0] // group
+        dw = np.transpose(w_np, (2, 3, 0, 1)).reshape(
+            w_np.shape[2], w_np.shape[3], group, m)
+        w = ctx.sd.constant(ctx.unique(f"{node.inputs[1]}_dw"), dw)
+        y = ctx.sd._op("depthwise_conv2d", [x, w], attrs)
+    else:
+        # grouped conv: per-group conv2d + concat on channels
+        outs = []
+        cg = w_np.shape[1]
+        og = w_np.shape[0] // group
+        xin_shape = ctx.shape_of(node.inputs[0])   # NCHW
+        if xin_shape is None:
+            raise NotImplementedError("grouped Conv without shape")
+        n_, c_, h_, w_ = xin_shape
+        for g in range(group):
+            xs = ctx.sd._op(
+                "strided_slice", [x],
+                {"begin": [0, 0, 0, g * cg],
+                 "end": [n_, h_, w_, (g + 1) * cg],
+                 "strides": [1, 1, 1, 1]})
+            wg = ctx.sd.constant(
+                ctx.unique(f"{node.inputs[1]}_g{g}"),
+                np.transpose(w_np[g * og:(g + 1) * og], (2, 3, 1, 0)))
+            outs.append(ctx.sd._op("conv2d", [xs, wg], attrs))
+        y = ctx.sd._op("concat", outs, {"axis": 3})
+    if len(node.inputs) > 2 and node.inputs[2]:
+        y = ctx.sd._op("add", [y, ctx.var(node.inputs[2])])
+    return _nhwc_to_nchw(ctx, y)
+
+
+@onnx_op("MaxPool", "AveragePool")
+def _pool(ctx, node):
+    ks = [int(k) for k in node.attr("kernel_shape")]
+    st = [int(s) for s in node.attr("strides", ks)]
+    padding = _conv_padding(node)
+    x = _nchw_to_nhwc(ctx, ctx.var(node.inputs[0]))
+    opn = "max_pool2d" if node.op == "MaxPool" else "avg_pool2d"
+    y = ctx.sd._op(opn, [x], {"kernel": tuple(ks),
+                              "stride": tuple(st),
+                              "padding": padding})
+    return _nhwc_to_nchw(ctx, y)
+
+
+@onnx_op("GlobalAveragePool", "GlobalMaxPool")
+def _global_pool(ctx, node):
+    opn = ("reduce_mean" if node.op == "GlobalAveragePool"
+           else "reduce_max")
+    return ctx.sd._op(opn, [ctx.var(node.inputs[0])],
+                      {"axis": (2, 3), "keep_dims": True})
+
+
+@onnx_op("BatchNormalization")
+def _batch_norm(ctx, node):
+    x = _nchw_to_nhwc(ctx, ctx.var(node.inputs[0]))
+    gamma = ctx.var(node.inputs[1])
+    beta = ctx.var(node.inputs[2])
+    mean = ctx.var(node.inputs[3])
+    var = ctx.var(node.inputs[4])
+    y = ctx.sd._op("batch_norm", [x, mean, var, gamma, beta],
+                   {"epsilon": node.attr("epsilon", 1e-5)})
+    return _nhwc_to_nchw(ctx, y)
+
+
+@onnx_op("Constant")
+def _constant(ctx, node):
+    for key in ("value", "value_float", "value_int", "value_floats",
+                "value_ints"):
+        v = node.attr(key)
+        if v is not None:
+            arr = np.asarray(v)
+            if key == "value_int":
+                arr = arr.astype(np.int64)
+            if key == "value_ints":
+                arr = arr.astype(np.int64)
+            ctx.set_static(node.outputs[0], arr)
+            return None
+    raise NotImplementedError("Constant without value attr")
+
+
+@onnx_op("ConstantOfShape")
+def _constant_of_shape(ctx, node):
+    shape = [int(v) for v in
+             np.asarray(ctx.require_static(node, 0)).reshape(-1)]
+    v = node.attr("value")
+    fill = np.asarray(v).reshape(-1) if v is not None else \
+        np.zeros(1, np.float32)
+    ctx.set_static(node.outputs[0],
+                   np.full(shape, fill[0], fill.dtype))
+    return None

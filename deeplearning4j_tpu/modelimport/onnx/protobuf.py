@@ -1,0 +1,298 @@
+"""ONNX wire-format codec (no `onnx` package dependency).
+
+Decoder mirrors `..tensorflow.protobuf` (shared varint/field
+machinery) for the ONNX schema subset an inference importer needs:
+ModelProto → GraphProto → NodeProto/TensorProto/AttributeProto/
+ValueInfoProto. Field numbers follow onnx.proto3 (onnx/onnx.proto,
+IR version 3+).
+
+A minimal ENCODER for the same subset lives here too — it writes
+valid ModelProto bytes for graphs we construct (used by the test
+fixtures, and usable as a lightweight exporter).
+
+Reference parity: `samediff-import-onnx` (SURVEY.md S7) decodes ONNX
+protobuf via the official Java bindings; the wire format is the
+contract, not the library.
+"""
+from __future__ import annotations
+
+import struct
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ..tensorflow.protobuf import decode_fields, _packed_floats, \
+    _packed_varints, _signed
+
+# onnx TensorProto.DataType
+ONNX_DTYPES = {
+    1: np.float32, 2: np.uint8, 3: np.int8, 4: np.uint16, 5: np.int16,
+    6: np.int32, 7: np.int64, 9: np.bool_, 10: np.float16,
+    11: np.float64, 12: np.uint32, 13: np.uint64,
+}
+NP_TO_ONNX = {np.dtype(v): k for k, v in ONNX_DTYPES.items()}
+
+
+class OnnxTensor:
+    def __init__(self, name: str, array: np.ndarray):
+        self.name = name
+        self.array = array
+
+
+def parse_tensor(buf: bytes) -> OnnxTensor:
+    f = decode_fields(buf)
+    dims = _packed_varints(f.get(1, []))
+    dt = int(f[2][0][1]) if 2 in f else 1
+    name = f[8][0][1].decode() if 8 in f else ""
+    np_dt = ONNX_DTYPES.get(dt)
+    if np_dt is None:
+        raise NotImplementedError(f"onnx tensor dtype enum {dt}")
+    if 9 in f:                                  # raw_data
+        arr = np.frombuffer(f[9][0][1], np_dt)
+    elif 4 in f:                                # float_data
+        arr = np.asarray(_packed_floats(f[4]), np.float32)
+    elif 7 in f:                                # int64_data
+        arr = np.asarray([_signed(v) for v in _packed_varints(f[7])],
+                         np.int64)
+    elif 5 in f:                                # int32_data
+        arr = np.asarray([_signed(v) for v in _packed_varints(f[5])],
+                         np.int32).astype(np_dt)
+    elif 10 in f:                               # double_data
+        from ..tensorflow.protobuf import _packed_doubles
+        arr = np.asarray(_packed_doubles(f[10]), np.float64)
+    else:
+        arr = np.zeros(0, np_dt)
+    return OnnxTensor(name, arr.reshape(dims).astype(np_dt, copy=False))
+
+
+class OnnxAttr:
+    def __init__(self, name: str, kind: int, value):
+        self.name = name
+        self.kind = kind
+        self.value = value
+
+
+def parse_attribute(buf: bytes) -> OnnxAttr:
+    f = decode_fields(buf)
+    name = f[1][0][1].decode() if 1 in f else ""
+    # AttributeProto.type enum: 1=FLOAT 2=INT 3=STRING 4=TENSOR
+    # 6=FLOATS 7=INTS 8=STRINGS
+    kind = int(f[20][0][1]) if 20 in f else 0
+    if 2 in f and kind in (0, 1):
+        raw = f[2][0][1]
+        val = (struct.unpack("<f", raw)[0]
+               if isinstance(raw, (bytes, bytearray)) else float(raw))
+        return OnnxAttr(name, 1, val)
+    if 3 in f and kind in (0, 2):
+        return OnnxAttr(name, 2, _signed(int(f[3][0][1])))
+    if 4 in f and kind in (0, 3):
+        return OnnxAttr(name, 3, f[4][0][1])
+    if 5 in f and kind in (0, 4):
+        return OnnxAttr(name, 4, parse_tensor(f[5][0][1]).array)
+    if 7 in f and kind in (0, 6):
+        return OnnxAttr(name, 6, _packed_floats(f[7]))
+    if 8 in f and kind in (0, 7):
+        return OnnxAttr(name, 7,
+                        [_signed(v) for v in _packed_varints(f[8])])
+    if 9 in f and kind in (0, 8):
+        return OnnxAttr(name, 8, [e[1] for e in f[9]])
+    return OnnxAttr(name, kind, None)
+
+
+class OnnxNode:
+    def __init__(self, op_type: str, inputs: List[str],
+                 outputs: List[str], name: str,
+                 attrs: Dict[str, OnnxAttr]):
+        self.op = op_type
+        self.inputs = inputs
+        self.outputs = outputs
+        self.name = name
+        self.attrs = attrs
+
+    def attr(self, key: str, default=None):
+        a = self.attrs.get(key)
+        return default if a is None else a.value
+
+    def __repr__(self):
+        return (f"OnnxNode({self.op}, in={self.inputs}, "
+                f"out={self.outputs})")
+
+
+def parse_node(buf: bytes) -> OnnxNode:
+    f = decode_fields(buf)
+    return OnnxNode(
+        op_type=f[4][0][1].decode() if 4 in f else "",
+        inputs=[e[1].decode() for e in f.get(1, [])],
+        outputs=[e[1].decode() for e in f.get(2, [])],
+        name=f[3][0][1].decode() if 3 in f else "",
+        attrs={a.name: a for a in
+               (parse_attribute(e[1]) for e in f.get(5, []))})
+
+
+def parse_value_info(buf: bytes) -> Tuple[str,
+                                          Optional[Tuple[int, ...]]]:
+    """ValueInfoProto -> (name, shape or None). Dims with dim_param
+    (symbolic) become -1."""
+    f = decode_fields(buf)
+    name = f[1][0][1].decode() if 1 in f else ""
+    shape = None
+    if 2 in f:                                   # TypeProto
+        t = decode_fields(f[2][0][1])
+        if 1 in t:                               # tensor_type
+            tt = decode_fields(t[1][0][1])
+            if 2 in tt:                          # TensorShapeProto
+                sh = decode_fields(tt[2][0][1])
+                dims = []
+                for _, dbuf in sh.get(1, []):    # Dimension
+                    d = decode_fields(dbuf)
+                    if 1 in d:                   # dim_value
+                        dims.append(int(d[1][0][1]))
+                    else:
+                        dims.append(-1)
+                shape = tuple(dims)
+    return name, shape
+
+
+class OnnxGraph:
+    def __init__(self, nodes, initializers, inputs, outputs, name):
+        self.nodes: List[OnnxNode] = nodes
+        self.initializers: Dict[str, np.ndarray] = initializers
+        self.inputs: List[Tuple[str, Optional[tuple]]] = inputs
+        self.outputs: List[str] = outputs
+        self.name = name
+
+
+def parse_graph(buf: bytes) -> OnnxGraph:
+    f = decode_fields(buf)
+    nodes = [parse_node(e[1]) for e in f.get(1, [])]
+    inits = {}
+    for _, tbuf in f.get(5, []):
+        t = parse_tensor(tbuf)
+        inits[t.name] = t.array
+    inputs = [parse_value_info(e[1]) for e in f.get(11, [])]
+    outputs = [parse_value_info(e[1])[0] for e in f.get(12, [])]
+    name = f[2][0][1].decode() if 2 in f else ""
+    return OnnxGraph(nodes, inits, inputs, outputs, name)
+
+
+def parse_model(buf: bytes) -> OnnxGraph:
+    f = decode_fields(buf)
+    if 7 not in f:
+        raise ValueError("not an ONNX ModelProto (no graph field)")
+    return parse_graph(f[7][0][1])
+
+
+# ---------------------------------------------------------------------------
+# minimal encoder
+# ---------------------------------------------------------------------------
+def _varint(v: int) -> bytes:
+    out = bytearray()
+    v &= (1 << 64) - 1
+    while True:
+        b = v & 0x7F
+        v >>= 7
+        if v:
+            out.append(b | 0x80)
+        else:
+            out.append(b)
+            return bytes(out)
+
+
+def _tag(field: int, wire: int) -> bytes:
+    return _varint((field << 3) | wire)
+
+
+def _len_field(field: int, payload: bytes) -> bytes:
+    return _tag(field, 2) + _varint(len(payload)) + payload
+
+
+def _int_field(field: int, v: int) -> bytes:
+    return _tag(field, 0) + _varint(v)
+
+
+def encode_tensor(name: str, arr: np.ndarray) -> bytes:
+    arr = np.ascontiguousarray(arr)
+    out = bytearray()
+    for d in arr.shape:
+        out += _int_field(1, d)
+    out += _int_field(2, NP_TO_ONNX[arr.dtype])
+    out += _len_field(8, name.encode())
+    out += _len_field(9, arr.tobytes())
+    return bytes(out)
+
+
+def encode_attr(name: str, value) -> bytes:
+    out = bytearray()
+    out += _len_field(1, name.encode())
+    if isinstance(value, float):
+        out += _tag(2, 5) + struct.pack("<f", value)
+        out += _int_field(20, 1)
+    elif isinstance(value, (bool, int, np.integer)):
+        out += _tag(3, 0) + _varint(int(value))
+        out += _int_field(20, 2)
+    elif isinstance(value, (bytes, str)):
+        v = value.encode() if isinstance(value, str) else value
+        out += _len_field(4, v)
+        out += _int_field(20, 3)
+    elif isinstance(value, np.ndarray):
+        out += _len_field(5, encode_tensor("", value))
+        out += _int_field(20, 4)
+    elif isinstance(value, (list, tuple)) and value and \
+            isinstance(value[0], float):
+        for v in value:
+            out += _tag(7, 5) + struct.pack("<f", v)
+        out += _int_field(20, 6)
+    elif isinstance(value, (list, tuple)):
+        for v in value:
+            out += _tag(8, 0) + _varint(int(v) & ((1 << 64) - 1))
+        out += _int_field(20, 7)
+    else:
+        raise TypeError(f"attr {name}: {type(value)}")
+    return bytes(out)
+
+
+def encode_node(op_type: str, inputs: Sequence[str],
+                outputs: Sequence[str], name: str = "",
+                **attrs) -> bytes:
+    out = bytearray()
+    for i in inputs:
+        out += _len_field(1, i.encode())
+    for o in outputs:
+        out += _len_field(2, o.encode())
+    if name:
+        out += _len_field(3, name.encode())
+    out += _len_field(4, op_type.encode())
+    for k, v in attrs.items():
+        out += _len_field(5, encode_attr(k, v))
+    return bytes(out)
+
+
+def encode_value_info(name: str, shape: Sequence[int],
+                      dtype=np.float32) -> bytes:
+    dims = b"".join(_len_field(1, _int_field(1, d)) for d in shape)
+    tshape = _len_field(2, dims)
+    tensor_type = _int_field(1, NP_TO_ONNX[np.dtype(dtype)]) + tshape
+    type_proto = _len_field(1, tensor_type)
+    return _len_field(1, name.encode()) + _len_field(2, type_proto)
+
+
+def encode_model(nodes: Sequence[bytes],
+                 initializers: Dict[str, np.ndarray],
+                 inputs: Sequence[bytes],
+                 outputs: Sequence[bytes],
+                 graph_name: str = "graph") -> bytes:
+    g = bytearray()
+    for n in nodes:
+        g += _len_field(1, n)
+    g += _len_field(2, graph_name.encode())
+    for name, arr in initializers.items():
+        g += _len_field(5, encode_tensor(name, arr))
+    for vi in inputs:
+        g += _len_field(11, vi)
+    for vi in outputs:
+        g += _len_field(12, vi)
+    model = _int_field(1, 8)                      # ir_version
+    model += _len_field(7, bytes(g))
+    # opset_import: domain "" version 13
+    model += _len_field(8, _len_field(1, b"") + _int_field(2, 13))
+    return bytes(model)

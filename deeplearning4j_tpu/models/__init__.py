@@ -1,4 +1,9 @@
 from deeplearning4j_tpu.models.zoo import (  # noqa: F401
     ZooModel, LeNet, SimpleCNN, VGG16, VGG19, ResNet50, AlexNet)
+from deeplearning4j_tpu.models.zoo_extra import (  # noqa: F401
+    Darknet19, InceptionResNetV1, NASNet, SqueezeNet,
+    TextGenerationLSTM, TinyYOLO, UNet, Xception, YOLO2)
 from deeplearning4j_tpu.models.bert import (  # noqa: F401
     Bert, BertConfig, BertForSequenceClassification)
+from deeplearning4j_tpu.models.transformer import (  # noqa: F401
+    DistributedTransformerLM, TransformerLMConfig)

@@ -1,0 +1,601 @@
+"""Model zoo, part 2 (SURVEY.md D15 long tail).
+
+Reference parity: `org.deeplearning4j.zoo.model.{Darknet19, TinyYOLO,
+YOLO2, Xception, SqueezeNet, UNet, InceptionResNetV1, NASNet,
+TextGenerationLSTM}`. Architectures follow the reference zoo configs;
+all NHWC, built on the same config/graph builders as the rest of the
+framework (so they serialize, transfer-learn, and shard like any
+user model).
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Optional, Tuple
+
+from deeplearning4j_tpu.activations import Activation
+from deeplearning4j_tpu.learning.updaters import Adam, IUpdater
+from deeplearning4j_tpu.lossfunctions import LossFunction
+from deeplearning4j_tpu.nn.conf.builders import NeuralNetConfiguration
+from deeplearning4j_tpu.nn.conf.graph_vertices import (ElementWiseVertex,
+                                                       MergeVertex)
+from deeplearning4j_tpu.nn.conf.inputs import InputType
+from deeplearning4j_tpu.nn.conf.layers import (
+    ActivationLayer, BatchNormalization, ConvolutionLayer,
+    ConvolutionMode, DenseLayer, DropoutLayer, GlobalPoolingLayer,
+    OutputLayer, PoolingType, SubsamplingLayer)
+from deeplearning4j_tpu.nn.conf.layers_conv_extra import (
+    Deconvolution2D, SeparableConvolution2D, Upsampling2D)
+from deeplearning4j_tpu.nn.conf.layers_objdetect import Yolo2OutputLayer
+from deeplearning4j_tpu.nn.conf.layers_recurrent import LSTM
+from deeplearning4j_tpu.nn.conf.layers import RnnOutputLayer
+from deeplearning4j_tpu.nn.graph import ComputationGraph
+from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+from deeplearning4j_tpu.nn.weights import WeightInit
+from deeplearning4j_tpu.models.zoo import ZooModel
+
+
+def _conv(n, k=(3, 3), s=(1, 1), act=Activation.IDENTITY, bias=False):
+    return ConvolutionLayer(kernel_size=k, n_out=n, stride=s,
+                            convolution_mode=ConvolutionMode.SAME,
+                            has_bias=bias, activation=act)
+
+
+def _lrelu():
+    return ActivationLayer(activation=Activation.LEAKYRELU)
+
+
+@dataclass
+class Darknet19(ZooModel):
+    """reference: zoo.model.Darknet19 — conv/BN/leaky-relu backbone,
+    1x1 bottlenecks between 3x3 blocks, 5 maxpools."""
+    num_classes: int = 1000
+    height: int = 224
+    width: int = 224
+    channels: int = 3
+    seed: int = 123
+    updater: Optional[IUpdater] = None
+
+    #: (filters, kernel) per conv; 'M' = maxpool
+    PLAN = (32, "M", 64, "M", 128, (64, 1), 128, "M", 256, (128, 1),
+            256, "M", 512, (256, 1), 512, (256, 1), 512, "M", 1024,
+            (512, 1), 1024, (512, 1), 1024)
+
+    def _backbone(self, b):
+        for item in self.PLAN:
+            if item == "M":
+                b = b.layer(SubsamplingLayer(
+                    kernel_size=(2, 2), stride=(2, 2),
+                    convolution_mode=ConvolutionMode.SAME))
+                continue
+            if isinstance(item, tuple):
+                n, k = item
+                b = b.layer(_conv(n, (k, k)))
+            else:
+                b = b.layer(_conv(item))
+            b = b.layer(BatchNormalization(
+                activation=Activation.LEAKYRELU))
+        return b
+
+    def init(self) -> MultiLayerNetwork:
+        b = (NeuralNetConfiguration.Builder().seed(self.seed)
+             .updater(self.updater or Adam(1e-3))
+             .weight_init(WeightInit.RELU).list())
+        b = self._backbone(b)
+        conf = (b.layer(ConvolutionLayer(
+                    kernel_size=(1, 1), n_out=self.num_classes,
+                    convolution_mode=ConvolutionMode.SAME,
+                    activation=Activation.IDENTITY))
+                .layer(GlobalPoolingLayer(
+                    pooling_type=PoolingType.AVG))
+                .layer(OutputLayer(
+                    n_out=self.num_classes,
+                    loss_function=LossFunction.MCXENT,
+                    activation=Activation.SOFTMAX))
+                .set_input_type(InputType.convolutional(
+                    self.height, self.width, self.channels))
+                .build())
+        return MultiLayerNetwork(conf).init()
+
+
+@dataclass
+class TinyYOLO(ZooModel):
+    """reference: zoo.model.TinyYOLO — 9-conv darknet-tiny backbone +
+    Yolo2OutputLayer; 416x416/32 -> 13x13 grid."""
+    num_classes: int = 20
+    height: int = 416
+    width: int = 416
+    channels: int = 3
+    seed: int = 123
+    anchors: Tuple[Tuple[float, float], ...] = (
+        (1.08, 1.19), (3.42, 4.41), (6.63, 11.38), (9.42, 5.11),
+        (16.62, 10.52))
+
+    def init(self) -> MultiLayerNetwork:
+        b = (NeuralNetConfiguration.Builder().seed(self.seed)
+             .updater(Adam(1e-3)).weight_init(WeightInit.RELU).list())
+        for i, n in enumerate((16, 32, 64, 128, 256, 512)):
+            b = b.layer(_conv(n)).layer(BatchNormalization(
+                activation=Activation.LEAKYRELU))
+            if i < 5:
+                b = b.layer(SubsamplingLayer(
+                    kernel_size=(2, 2), stride=(2, 2),
+                    convolution_mode=ConvolutionMode.SAME))
+        b = b.layer(_conv(1024)).layer(BatchNormalization(
+            activation=Activation.LEAKYRELU))
+        a = len(self.anchors)
+        conf = (b.layer(ConvolutionLayer(
+                    kernel_size=(1, 1),
+                    n_out=a * (5 + self.num_classes),
+                    convolution_mode=ConvolutionMode.SAME,
+                    has_bias=True, activation=Activation.IDENTITY))
+                .layer(Yolo2OutputLayer(anchors=self.anchors))
+                .set_input_type(InputType.convolutional(
+                    self.height, self.width, self.channels))
+                .build())
+        return MultiLayerNetwork(conf).init()
+
+
+@dataclass
+class YOLO2(ZooModel):
+    """reference: zoo.model.YOLO2 — Darknet19 backbone +
+    Yolo2OutputLayer detection head."""
+    num_classes: int = 80
+    height: int = 416
+    width: int = 416
+    channels: int = 3
+    seed: int = 123
+    anchors: Tuple[Tuple[float, float], ...] = (
+        (0.57273, 0.677385), (1.87446, 2.06253), (3.33843, 5.47434),
+        (7.88282, 3.52778), (9.77052, 9.16828))
+
+    def init(self) -> MultiLayerNetwork:
+        d = Darknet19(seed=self.seed)
+        b = (NeuralNetConfiguration.Builder().seed(self.seed)
+             .updater(Adam(1e-3)).weight_init(WeightInit.RELU).list())
+        b = d._backbone(b)
+        for _ in range(2):
+            b = b.layer(_conv(1024)).layer(BatchNormalization(
+                activation=Activation.LEAKYRELU))
+        a = len(self.anchors)
+        conf = (b.layer(ConvolutionLayer(
+                    kernel_size=(1, 1),
+                    n_out=a * (5 + self.num_classes),
+                    convolution_mode=ConvolutionMode.SAME,
+                    has_bias=True, activation=Activation.IDENTITY))
+                .layer(Yolo2OutputLayer(anchors=self.anchors))
+                .set_input_type(InputType.convolutional(
+                    self.height, self.width, self.channels))
+                .build())
+        return MultiLayerNetwork(conf).init()
+
+
+@dataclass
+class SqueezeNet(ZooModel):
+    """reference: zoo.model.SqueezeNet — fire modules
+    (squeeze 1x1 -> expand 1x1 | 3x3 concat)."""
+    num_classes: int = 1000
+    height: int = 224
+    width: int = 224
+    channels: int = 3
+    seed: int = 123
+
+    FIRES = ((16, 64), (16, 64), (32, 128), "M", (32, 128),
+             (48, 192), (48, 192), (64, 256), "M", (64, 256))
+
+    def init(self) -> ComputationGraph:
+        g = (NeuralNetConfiguration.Builder().seed(self.seed)
+             .updater(Adam(1e-3)).weight_init(WeightInit.RELU)
+             .graph_builder().add_inputs("input")
+             .set_input_types(InputType.convolutional(
+                 self.height, self.width, self.channels)))
+        g.add_layer("stem", _conv(64, (3, 3), (2, 2),
+                                  Activation.RELU, bias=True), "input")
+        g.add_layer("stem_pool", SubsamplingLayer(
+            kernel_size=(3, 3), stride=(2, 2),
+            convolution_mode=ConvolutionMode.SAME), "stem")
+        last = "stem_pool"
+        fi = 0
+        for item in self.FIRES:
+            if item == "M":
+                g.add_layer(f"pool{fi}", SubsamplingLayer(
+                    kernel_size=(3, 3), stride=(2, 2),
+                    convolution_mode=ConvolutionMode.SAME), last)
+                last = f"pool{fi}"
+                continue
+            sq, ex = item
+            n = f"fire{fi}"
+            g.add_layer(f"{n}_sq", _conv(sq, (1, 1),
+                                         act=Activation.RELU,
+                                         bias=True), last)
+            g.add_layer(f"{n}_e1", _conv(ex, (1, 1),
+                                         act=Activation.RELU,
+                                         bias=True), f"{n}_sq")
+            g.add_layer(f"{n}_e3", _conv(ex, (3, 3),
+                                         act=Activation.RELU,
+                                         bias=True), f"{n}_sq")
+            g.add_vertex(f"{n}_cat", MergeVertex(), f"{n}_e1",
+                         f"{n}_e3")
+            last = f"{n}_cat"
+            fi += 1
+        g.add_layer("drop", DropoutLayer(dropout=0.5), last)
+        g.add_layer("head_conv", _conv(self.num_classes, (1, 1),
+                                       act=Activation.RELU,
+                                       bias=True), "drop")
+        g.add_layer("gap", GlobalPoolingLayer(
+            pooling_type=PoolingType.AVG), "head_conv")
+        g.add_layer("output", OutputLayer(
+            n_out=self.num_classes,
+            loss_function=LossFunction.MCXENT,
+            activation=Activation.SOFTMAX), "gap")
+        return ComputationGraph(g.set_outputs("output").build()).init()
+
+
+@dataclass
+class Xception(ZooModel):
+    """reference: zoo.model.Xception — separable-conv towers with
+    residual shortcuts (entry/middle/exit flows; middle-flow depth
+    configurable, 8 in the paper)."""
+    num_classes: int = 1000
+    height: int = 299
+    width: int = 299
+    channels: int = 3
+    seed: int = 123
+    middle_blocks: int = 8
+
+    def init(self) -> ComputationGraph:
+        g = (NeuralNetConfiguration.Builder().seed(self.seed)
+             .updater(Adam(1e-3)).weight_init(WeightInit.RELU)
+             .graph_builder().add_inputs("input")
+             .set_input_types(InputType.convolutional(
+                 self.height, self.width, self.channels)))
+
+        def conv_bn(name, inp, n, k, s, act=True):
+            g.add_layer(f"{name}_c", _conv(n, k, s), inp)
+            g.add_layer(f"{name}_bn", BatchNormalization(
+                activation=Activation.RELU if act
+                else Activation.IDENTITY), f"{name}_c")
+            return f"{name}_bn"
+
+        def sep_bn(name, inp, n, act_first=True, act_last=False):
+            src = inp
+            if act_first:
+                g.add_layer(f"{name}_pre", ActivationLayer(
+                    activation=Activation.RELU), inp)
+                src = f"{name}_pre"
+            g.add_layer(f"{name}_s", SeparableConvolution2D(
+                kernel_size=(3, 3), n_out=n,
+                convolution_mode=ConvolutionMode.SAME,
+                has_bias=False,
+                activation=Activation.IDENTITY), src)
+            g.add_layer(f"{name}_bn", BatchNormalization(
+                activation=Activation.RELU if act_last
+                else Activation.IDENTITY), f"{name}_s")
+            return f"{name}_bn"
+
+        last = conv_bn("stem1", "input", 32, (3, 3), (2, 2))
+        last = conv_bn("stem2", last, 64, (3, 3), (1, 1))
+
+        # entry flow: 3 residual down blocks
+        for i, n in enumerate((128, 256, 728)):
+            name = f"entry{i}"
+            a = sep_bn(f"{name}_a", last, n, act_first=i > 0)
+            bse = sep_bn(f"{name}_b", a, n)
+            g.add_layer(f"{name}_pool", SubsamplingLayer(
+                kernel_size=(3, 3), stride=(2, 2),
+                convolution_mode=ConvolutionMode.SAME), bse)
+            sc = conv_bn(f"{name}_sc", last, n, (1, 1), (2, 2),
+                         act=False)
+            g.add_vertex(f"{name}_add",
+                         ElementWiseVertex(ElementWiseVertex.Op.Add),
+                         f"{name}_pool", sc)
+            last = f"{name}_add"
+
+        # middle flow: residual triple-separable blocks
+        for i in range(self.middle_blocks):
+            name = f"mid{i}"
+            a = sep_bn(f"{name}_a", last, 728)
+            b2 = sep_bn(f"{name}_b", a, 728)
+            c = sep_bn(f"{name}_c", b2, 728)
+            g.add_vertex(f"{name}_add",
+                         ElementWiseVertex(ElementWiseVertex.Op.Add),
+                         c, last)
+            last = f"{name}_add"
+
+        # exit flow
+        a = sep_bn("exit_a", last, 728)
+        b2 = sep_bn("exit_b", a, 1024)
+        g.add_layer("exit_pool", SubsamplingLayer(
+            kernel_size=(3, 3), stride=(2, 2),
+            convolution_mode=ConvolutionMode.SAME), b2)
+        sc = conv_bn("exit_sc", last, 1024, (1, 1), (2, 2), act=False)
+        g.add_vertex("exit_add",
+                     ElementWiseVertex(ElementWiseVertex.Op.Add),
+                     "exit_pool", sc)
+        last = sep_bn("exit_c", "exit_add", 1536, act_first=False,
+                      act_last=True)
+        last = sep_bn("exit_d", last, 2048, act_first=False,
+                      act_last=True)
+        g.add_layer("gap", GlobalPoolingLayer(
+            pooling_type=PoolingType.AVG), last)
+        g.add_layer("output", OutputLayer(
+            n_out=self.num_classes,
+            loss_function=LossFunction.MCXENT,
+            activation=Activation.SOFTMAX), "gap")
+        return ComputationGraph(g.set_outputs("output").build()).init()
+
+
+@dataclass
+class UNet(ZooModel):
+    """reference: zoo.model.UNet — encoder/decoder with skip
+    concats; sigmoid 1-channel segmentation head."""
+    height: int = 128
+    width: int = 128
+    channels: int = 3
+    seed: int = 123
+    base_filters: int = 64
+    depth: int = 4
+
+    def init(self) -> ComputationGraph:
+        g = (NeuralNetConfiguration.Builder().seed(self.seed)
+             .updater(Adam(1e-3)).weight_init(WeightInit.RELU)
+             .graph_builder().add_inputs("input")
+             .set_input_types(InputType.convolutional(
+                 self.height, self.width, self.channels)))
+
+        def double_conv(name, inp, n):
+            g.add_layer(f"{name}_c1", _conv(n, act=Activation.RELU,
+                                            bias=True), inp)
+            g.add_layer(f"{name}_c2", _conv(n, act=Activation.RELU,
+                                            bias=True), f"{name}_c1")
+            return f"{name}_c2"
+
+        skips = []
+        last = "input"
+        for d in range(self.depth):
+            n = self.base_filters * (2 ** d)
+            last = double_conv(f"enc{d}", last, n)
+            skips.append(last)
+            g.add_layer(f"down{d}", SubsamplingLayer(
+                kernel_size=(2, 2), stride=(2, 2)), last)
+            last = f"down{d}"
+
+        last = double_conv("bottom", last,
+                           self.base_filters * (2 ** self.depth))
+
+        for d in reversed(range(self.depth)):
+            n = self.base_filters * (2 ** d)
+            g.add_layer(f"up{d}", Deconvolution2D(
+                kernel_size=(2, 2), n_out=n, stride=(2, 2),
+                convolution_mode=ConvolutionMode.SAME,
+                activation=Activation.IDENTITY), last)
+            g.add_vertex(f"cat{d}", MergeVertex(), f"up{d}", skips[d])
+            last = double_conv(f"dec{d}", f"cat{d}", n)
+
+        g.add_layer("head", ConvolutionLayer(
+            kernel_size=(1, 1), n_out=1,
+            convolution_mode=ConvolutionMode.SAME,
+            activation=Activation.SIGMOID), last)
+        from deeplearning4j_tpu.nn.conf.layers import CnnLossLayer
+        g.add_layer("output", CnnLossLayer(
+            loss_function=LossFunction.XENT,
+            activation=Activation.IDENTITY), "head")
+        return ComputationGraph(g.set_outputs("output").build()).init()
+
+
+@dataclass
+class InceptionResNetV1(ZooModel):
+    """reference: zoo.model.InceptionResNetV1 (FaceNet backbone):
+    stem + scaled-residual inception blocks (A/B/C) with reduction
+    blocks between. Block counts configurable (5/10/5 in the
+    reference)."""
+    num_classes: int = 1000
+    height: int = 160
+    width: int = 160
+    channels: int = 3
+    seed: int = 123
+    blocks: Tuple[int, int, int] = (2, 3, 2)   # A, B, C counts
+
+    def init(self) -> ComputationGraph:
+        g = (NeuralNetConfiguration.Builder().seed(self.seed)
+             .updater(Adam(1e-3)).weight_init(WeightInit.RELU)
+             .graph_builder().add_inputs("input")
+             .set_input_types(InputType.convolutional(
+                 self.height, self.width, self.channels)))
+
+        def conv_bn(name, inp, n, k=(3, 3), s=(1, 1), act=True):
+            g.add_layer(f"{name}_c", _conv(n, k, s), inp)
+            g.add_layer(f"{name}_bn", BatchNormalization(
+                activation=Activation.RELU if act
+                else Activation.IDENTITY), f"{name}_c")
+            return f"{name}_bn"
+
+        def scaled_residual(name, inp, branches, n_out, scale=0.17):
+            """concat branches -> 1x1 up -> scale -> add -> relu."""
+            g.add_vertex(f"{name}_cat", MergeVertex(), *branches)
+            g.add_layer(f"{name}_up", ConvolutionLayer(
+                kernel_size=(1, 1), n_out=n_out,
+                convolution_mode=ConvolutionMode.SAME, has_bias=True,
+                activation=Activation.IDENTITY), f"{name}_cat")
+            from deeplearning4j_tpu.nn.conf.graph_vertices import \
+                ScaleVertex
+            g.add_vertex(f"{name}_scale", ScaleVertex(scale),
+                         f"{name}_up")
+            g.add_vertex(f"{name}_add",
+                         ElementWiseVertex(ElementWiseVertex.Op.Add),
+                         inp, f"{name}_scale")
+            g.add_layer(f"{name}_relu", ActivationLayer(
+                activation=Activation.RELU), f"{name}_add")
+            return f"{name}_relu"
+
+        # stem (slightly reduced vs paper; same topology family)
+        last = conv_bn("stem1", "input", 32, (3, 3), (2, 2))
+        last = conv_bn("stem2", last, 64, (3, 3), (1, 1))
+        g.add_layer("stem_pool", SubsamplingLayer(
+            kernel_size=(3, 3), stride=(2, 2),
+            convolution_mode=ConvolutionMode.SAME), last)
+        last = conv_bn("stem3", "stem_pool", 128, (1, 1), (1, 1))
+        last = conv_bn("stem4", last, 256, (3, 3), (2, 2))
+
+        # Inception-A blocks (35x35 family)
+        for i in range(self.blocks[0]):
+            n = f"A{i}"
+            b0 = conv_bn(f"{n}_b0", last, 32, (1, 1))
+            b1 = conv_bn(f"{n}_b1a", last, 32, (1, 1))
+            b1 = conv_bn(f"{n}_b1b", b1, 32, (3, 3))
+            b2 = conv_bn(f"{n}_b2a", last, 32, (1, 1))
+            b2 = conv_bn(f"{n}_b2b", b2, 32, (3, 3))
+            b2 = conv_bn(f"{n}_b2c", b2, 32, (3, 3))
+            last = scaled_residual(n, last, [b0, b1, b2], 256)
+
+        # reduction-A
+        ra = conv_bn("redA_c", last, 384, (3, 3), (2, 2))
+        g.add_layer("redA_pool", SubsamplingLayer(
+            kernel_size=(3, 3), stride=(2, 2),
+            convolution_mode=ConvolutionMode.SAME), last)
+        g.add_vertex("redA_cat", MergeVertex(), ra, "redA_pool")
+        last = "redA_cat"
+        ch = 384 + 256
+
+        # Inception-B blocks
+        for i in range(self.blocks[1]):
+            n = f"B{i}"
+            b0 = conv_bn(f"{n}_b0", last, 128, (1, 1))
+            b1 = conv_bn(f"{n}_b1a", last, 128, (1, 1))
+            b1 = conv_bn(f"{n}_b1b", b1, 128, (1, 7))
+            b1 = conv_bn(f"{n}_b1c", b1, 128, (7, 1))
+            last = scaled_residual(n, last, [b0, b1], ch, scale=0.1)
+
+        # reduction-B
+        rb = conv_bn("redB_c", last, 256, (3, 3), (2, 2))
+        g.add_layer("redB_pool", SubsamplingLayer(
+            kernel_size=(3, 3), stride=(2, 2),
+            convolution_mode=ConvolutionMode.SAME), last)
+        g.add_vertex("redB_cat", MergeVertex(), rb, "redB_pool")
+        last = "redB_cat"
+        ch = ch + 256
+
+        # Inception-C blocks
+        for i in range(self.blocks[2]):
+            n = f"C{i}"
+            b0 = conv_bn(f"{n}_b0", last, 192, (1, 1))
+            b1 = conv_bn(f"{n}_b1a", last, 192, (1, 1))
+            b1 = conv_bn(f"{n}_b1b", b1, 192, (1, 3))
+            b1 = conv_bn(f"{n}_b1c", b1, 192, (3, 1))
+            last = scaled_residual(n, last, [b0, b1], ch, scale=0.2)
+
+        g.add_layer("gap", GlobalPoolingLayer(
+            pooling_type=PoolingType.AVG), last)
+        g.add_layer("drop", DropoutLayer(dropout=0.2), "gap")
+        g.add_layer("output", OutputLayer(
+            n_out=self.num_classes,
+            loss_function=LossFunction.MCXENT,
+            activation=Activation.SOFTMAX), "drop")
+        return ComputationGraph(g.set_outputs("output").build()).init()
+
+
+@dataclass
+class NASNet(ZooModel):
+    """reference: zoo.model.NASNet (NASNet-A mobile). Normal cells:
+    separable-conv + pooling branch pairs summed then concatenated;
+    reduction cells stride-2. Cell counts configurable (4@ penultimate
+    in mobile)."""
+    num_classes: int = 1000
+    height: int = 224
+    width: int = 224
+    channels: int = 3
+    seed: int = 123
+    penultimate_filters: int = 1056
+    cells_per_stack: int = 2
+
+    def init(self) -> ComputationGraph:
+        g = (NeuralNetConfiguration.Builder().seed(self.seed)
+             .updater(Adam(1e-3)).weight_init(WeightInit.RELU)
+             .graph_builder().add_inputs("input")
+             .set_input_types(InputType.convolutional(
+                 self.height, self.width, self.channels)))
+        f0 = self.penultimate_filters // 24
+
+        def sep_bn(name, inp, n, k=(3, 3), s=(1, 1)):
+            g.add_layer(f"{name}_s", SeparableConvolution2D(
+                kernel_size=k, n_out=n, stride=s,
+                convolution_mode=ConvolutionMode.SAME, has_bias=False,
+                activation=Activation.RELU), inp)
+            g.add_layer(f"{name}_bn", BatchNormalization(
+                activation=Activation.IDENTITY), f"{name}_s")
+            return f"{name}_bn"
+
+        def normal_cell(name, inp, n):
+            """two sep-conv branches + avgpool branch, concat."""
+            a = sep_bn(f"{name}_a", inp, n, (5, 5))
+            b = sep_bn(f"{name}_b", inp, n, (3, 3))
+            g.add_vertex(f"{name}_ab",
+                         ElementWiseVertex(ElementWiseVertex.Op.Add),
+                         a, b)
+            g.add_layer(f"{name}_pool", SubsamplingLayer(
+                pooling_type=PoolingType.AVG, kernel_size=(3, 3),
+                stride=(1, 1),
+                convolution_mode=ConvolutionMode.SAME), inp)
+            p = conv_bn(f"{name}_pw", f"{name}_pool", n)
+            g.add_vertex(f"{name}_cat", MergeVertex(),
+                         f"{name}_ab", p)
+            return f"{name}_cat"
+
+        def conv_bn(name, inp, n, k=(1, 1), s=(1, 1)):
+            g.add_layer(f"{name}_c", _conv(n, k, s), inp)
+            g.add_layer(f"{name}_bn", BatchNormalization(
+                activation=Activation.RELU), f"{name}_c")
+            return f"{name}_bn"
+
+        def reduction_cell(name, inp, n):
+            a = sep_bn(f"{name}_a", inp, n, (5, 5), (2, 2))
+            g.add_layer(f"{name}_mp", SubsamplingLayer(
+                kernel_size=(3, 3), stride=(2, 2),
+                convolution_mode=ConvolutionMode.SAME), inp)
+            b = conv_bn(f"{name}_mpw", f"{name}_mp", n)
+            g.add_vertex(f"{name}_cat", MergeVertex(), a, b)
+            return f"{name}_cat"
+
+        last = conv_bn("stem", "input", f0, (3, 3), (2, 2))
+        n = f0
+        for stack in range(3):
+            for c in range(self.cells_per_stack):
+                last = normal_cell(f"s{stack}n{c}", last, n)
+            if stack < 2:
+                n *= 2
+                last = reduction_cell(f"s{stack}r", last, n)
+        g.add_layer("relu_out", ActivationLayer(
+            activation=Activation.RELU), last)
+        g.add_layer("gap", GlobalPoolingLayer(
+            pooling_type=PoolingType.AVG), "relu_out")
+        g.add_layer("output", OutputLayer(
+            n_out=self.num_classes,
+            loss_function=LossFunction.MCXENT,
+            activation=Activation.SOFTMAX), "gap")
+        return ComputationGraph(g.set_outputs("output").build()).init()
+
+
+@dataclass
+class TextGenerationLSTM(ZooModel):
+    """reference: zoo.model.TextGenerationLSTM — stacked LSTM
+    character model with per-timestep softmax."""
+    total_unique_characters: int = 47
+    max_length: int = 60
+    units: int = 256
+    layers: int = 2
+    seed: int = 123
+
+    def init(self) -> MultiLayerNetwork:
+        b = (NeuralNetConfiguration.Builder().seed(self.seed)
+             .updater(Adam(2e-3)).weight_init(WeightInit.XAVIER)
+             .list())
+        for _ in range(self.layers):
+            b = b.layer(LSTM(n_out=self.units,
+                             activation=Activation.TANH))
+        conf = (b.layer(RnnOutputLayer(
+                    n_out=self.total_unique_characters,
+                    loss_function=LossFunction.MCXENT,
+                    activation=Activation.SOFTMAX))
+                .set_input_type(InputType.recurrent(
+                    self.total_unique_characters, self.max_length))
+                .build())
+        return MultiLayerNetwork(conf).init()

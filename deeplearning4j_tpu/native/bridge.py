@@ -76,12 +76,12 @@ def _configure(lib):
 def ensure_built(force: bool = False) -> bool:
     """Build (once) and load the native library. Returns success."""
     global _lib, _build_attempted
+    if os.environ.get("DL4J_TPU_DISABLE_NATIVE"):
+        return False
     if _lib is not None and not force:
         # lock-free fast path: every native entry point calls this,
         # so the loaded case must not serialize threads
         return True
-    if os.environ.get("DL4J_TPU_DISABLE_NATIVE"):
-        return False
     with _lock:
         if _lib is not None:
             return True

@@ -70,19 +70,30 @@ class BertIterator:
         return self.batch_size
 
     # -- featurization ------------------------------------------------
-    def _encode_one(self, sentence) -> np.ndarray:
+    def _encode_one(self, sentence):
+        """-> (ids, token_type_ids): segment 1 covers sentence B of a
+        pair including its trailing [SEP] (BERT convention)."""
+        types = None
         if isinstance(sentence, tuple):      # sentence pair
             a, b = sentence
-            ids = ([self.cls_id] + self.tk.encode(a)[: self.max_length]
-                   + [self.sep_id] + self.tk.encode(b))
-            ids = ids[: self.max_length - 1] + [self.sep_id]
+            seg_a = ([self.cls_id]
+                     + self.tk.encode(a)[: self.max_length - 3]
+                     + [self.sep_id])
+            seg_b = self.tk.encode(b)
+            seg_b = seg_b[: self.max_length - len(seg_a) - 1] \
+                + [self.sep_id]
+            ids = seg_a + seg_b
+            types = [0] * len(seg_a) + [1] * len(seg_b)
         else:
             ids = ([self.cls_id]
                    + self.tk.encode(sentence)[: self.max_length - 2]
                    + [self.sep_id])
         out = np.full(self.max_length, self.pad_id, np.int32)
         out[: len(ids)] = ids
-        return out
+        tt = np.zeros(self.max_length, np.int32)
+        if types is not None:
+            tt[: len(types)] = types
+        return out, tt
 
     def _mask(self, ids: np.ndarray) -> Tuple[np.ndarray, np.ndarray]:
         """BERT MLM corruption. Returns (corrupted, labels)."""
@@ -111,10 +122,11 @@ class BertIterator:
                 for i in range(self._pos, end)]
         sl = slice(self._pos, end)
         self._pos = end
-        ids = np.stack(rows)
+        ids = np.stack([r[0] for r in rows])
+        tts = np.stack([r[1] for r in rows])
         att = (ids != self.pad_id).astype(np.float32)
         batch = {"input_ids": ids,
-                 "token_type_ids": np.zeros_like(ids),
+                 "token_type_ids": tts,
                  "attention_mask": att}
         if self.task == self.UNSUPERVISED:
             pairs = [self._mask(r) for r in ids]

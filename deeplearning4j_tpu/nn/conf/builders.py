@@ -144,10 +144,13 @@ def _wants_cnn_input(layer: Layer) -> bool:
 
 def _wants_ff_input(layer: Layer) -> bool:
     from deeplearning4j_tpu.nn.conf.layers import (BaseOutputLayer,
+                                                   CnnLossLayer,
                                                    DenseLayer,
                                                    RnnOutputLayer)
+    from deeplearning4j_tpu.nn.conf.layers_objdetect import \
+        Yolo2OutputLayer
     return isinstance(layer, DenseLayer) and not isinstance(
-        layer, RnnOutputLayer)
+        layer, (RnnOutputLayer, CnnLossLayer, Yolo2OutputLayer))
 
 
 def _default_preprocessor(cur: InputType, layer: Layer):

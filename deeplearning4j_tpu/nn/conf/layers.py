@@ -636,11 +636,42 @@ class LossLayer(BaseOutputLayer):
             self.n_in = self.n_out = input_type.size
 
 
+@dataclass
+class CnnLossLayer(BaseOutputLayer):
+    """Per-pixel loss head on [b, h, w, c] activations — no params,
+    no flattening (reference: conf.layers.CnnLossLayer; used by
+    segmentation nets like UNet)."""
+
+    activation: Activation = Activation.IDENTITY
+
+    def has_params(self) -> bool:
+        return False
+
+    def init_params(self, key, input_type, dtype=jnp.float32):
+        return {}
+
+    def set_n_in(self, input_type, override):
+        pass
+
+    def get_output_type(self, input_type):
+        return input_type
+
+    def wants_logits(self) -> bool:
+        return False
+
+    def forward(self, params, x, *, training, rng=None, state=None):
+        return self.activation(x), state
+
+    def forward_logits(self, params, x, *, training, rng=None,
+                       state=None):
+        return x, state
+
+
 LAYER_REGISTRY: dict = {c.__name__: c for c in
                         (DenseLayer, ConvolutionLayer, SubsamplingLayer,
                          BatchNormalization, ActivationLayer, DropoutLayer,
                          EmbeddingLayer, GlobalPoolingLayer, OutputLayer,
-                         RnnOutputLayer, LossLayer)}
+                         RnnOutputLayer, LossLayer, CnnLossLayer)}
 
 
 def register_layer(cls):

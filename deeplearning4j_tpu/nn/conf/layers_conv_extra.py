@@ -1,0 +1,137 @@
+"""Additional convolution-family layers (SURVEY.md D4 long tail).
+
+Reference parity: `conf.layers.SeparableConvolution2D` (Xception),
+`conf.layers.Deconvolution2D` (transposed conv, UNet upsampling path),
+`conf.layers.Upsampling2D` (nearest-neighbor). All NHWC / HWIO — the
+XLA-native layouts.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+
+from deeplearning4j_tpu.nn.conf.inputs import (InputType,
+                                               InputTypeConvolutional)
+from deeplearning4j_tpu.nn.conf.layers import (ConvolutionLayer,
+                                               ConvolutionMode, Layer,
+                                               _pair, register_layer)
+from deeplearning4j_tpu.nn.weights import WeightInit
+
+
+@register_layer
+@dataclass
+class SeparableConvolution2D(ConvolutionLayer):
+    """Depthwise-separable conv (reference:
+    SeparableConvolution2D with depth_multiplier): depthwise
+    [kh,kw,C,mult] then pointwise [1,1,C*mult,n_out]."""
+
+    depth_multiplier: int = 1
+
+    def init_params(self, key, input_type, dtype=jnp.float32):
+        kh, kw = self.kernel_size
+        c_in = self.n_in
+        m = self.depth_multiplier
+        wi = self.weight_init or WeightInit.XAVIER
+        k1, k2 = jax.random.split(key)
+        p = {"dW": wi.init(k1, (kh, kw, c_in, m), kh * kw,
+                           kh * kw * m, dtype),
+             "pW": wi.init(k2, (1, 1, c_in * m, self.n_out),
+                           c_in * m, self.n_out, dtype)}
+        if self.has_bias:
+            p["b"] = jnp.full((self.n_out,), self.bias_init, dtype)
+        return p
+
+    def forward(self, params, x, *, training, rng=None, state=None):
+        x = self._maybe_dropout(x, training, rng)
+        c_in = x.shape[-1]
+        kh, kw, _, m = params["dW"].shape
+        # depthwise = grouped conv with feature_group_count = C
+        dw = params["dW"].reshape(kh, kw, 1, c_in * m)
+        z = jax.lax.conv_general_dilated(
+            x, dw, window_strides=self.stride,
+            padding=self._pad_cfg(), rhs_dilation=self.dilation,
+            dimension_numbers=("NHWC", "HWIO", "NHWC"),
+            feature_group_count=c_in)
+        z = jax.lax.conv_general_dilated(
+            z, params["pW"], window_strides=(1, 1), padding="VALID",
+            dimension_numbers=("NHWC", "HWIO", "NHWC"))
+        if self.has_bias:
+            z = z + params["b"]
+        return self.activation(z), state
+
+
+@register_layer
+@dataclass
+class Deconvolution2D(ConvolutionLayer):
+    """Transposed convolution (reference: Deconvolution2D)."""
+
+    def init_params(self, key, input_type, dtype=jnp.float32):
+        kh, kw = self.kernel_size
+        wi = self.weight_init or WeightInit.XAVIER
+        k1, _ = jax.random.split(key)
+        p = {"W": wi.init(k1, (kh, kw, self.n_in, self.n_out),
+                          kh * kw * self.n_in, kh * kw * self.n_out,
+                          dtype)}
+        if self.has_bias:
+            p["b"] = jnp.full((self.n_out,), self.bias_init, dtype)
+        return p
+
+    def forward(self, params, x, *, training, rng=None, state=None):
+        x = self._maybe_dropout(x, training, rng)
+        pad = ("SAME" if self.convolution_mode is ConvolutionMode.SAME
+               else [(p, p) for p in self.padding])
+        z = jax.lax.conv_transpose(
+            x, params["W"], strides=self.stride, padding=pad,
+            dimension_numbers=("NHWC", "HWIO", "NHWC"))
+        if self.has_bias:
+            z = z + params["b"]
+        return self.activation(z), state
+
+    def get_output_type(self, input_type):
+        assert isinstance(input_type, InputTypeConvolutional)
+        h, w = input_type.height, input_type.width
+        kh, kw = self.kernel_size
+        sh, sw = self.stride
+        if self.convolution_mode is ConvolutionMode.SAME:
+            oh, ow = h * sh, w * sw
+        else:
+            ph, pw = self.padding
+            oh = (h - 1) * sh + kh - 2 * ph
+            ow = (w - 1) * sw + kw - 2 * pw
+        return InputType.convolutional(oh, ow, self.n_out)
+
+
+@register_layer
+@dataclass
+class Upsampling2D(Layer):
+    """Nearest-neighbor upsampling (reference: Upsampling2D)."""
+
+    size: Tuple[int, int] = (2, 2)
+
+    @staticmethod
+    def _builder_positional(*args) -> dict:
+        return {"size": _pair(args[0])} if args else {}
+
+    def __post_init__(self):
+        super().__post_init__()
+        self.size = _pair(self.size)
+
+    def has_params(self) -> bool:
+        return False
+
+    def set_n_in(self, input_type, override):
+        pass
+
+    def forward(self, params, x, *, training, rng=None, state=None):
+        sh, sw = self.size
+        z = jnp.repeat(jnp.repeat(x, sh, axis=1), sw, axis=2)
+        return z, state
+
+    def get_output_type(self, input_type):
+        assert isinstance(input_type, InputTypeConvolutional)
+        return InputType.convolutional(input_type.height * self.size[0],
+                                       input_type.width * self.size[1],
+                                       input_type.channels)

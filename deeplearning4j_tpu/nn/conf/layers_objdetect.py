@@ -1,0 +1,111 @@
+"""Object-detection output layer (SURVEY.md D4:
+`org.deeplearning4j.nn.conf.layers.objdetect.Yolo2OutputLayer`).
+
+YOLOv2 loss head. TPU-first data layout (NHWC end-to-end):
+
+- predictions: [b, H, W, A*(5+C)] from the final conv — per anchor
+  (tx, ty, tw, th, to) + C class scores;
+- labels: [b, H, W, 4+C] — per grid cell: (cx, cy, w, h) of the
+  object centered in that cell, in *cell units* (cx, cy in [0,1]
+  relative to the cell; w, h in grid units), then a one-hot class.
+  A cell with no object is all zeros. (The reference uses
+  [mb, 4+C, H, W] NCHW; the content is the same.)
+
+Loss (Redmon & Farhadi, YOLO9000 §2): the anchor with best IoU
+against the ground-truth box is responsible — coordinate MSE +
+objectness-vs-IoU MSE + class cross-entropy on it; other anchors pay
+lambda_noobj * sigmoid(to)^2.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import List, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from deeplearning4j_tpu.nn.conf.inputs import (InputType,
+                                               InputTypeConvolutional)
+from deeplearning4j_tpu.nn.conf.layers import (BaseOutputLayer,
+                                               register_layer)
+
+
+@register_layer
+@dataclass
+class Yolo2OutputLayer(BaseOutputLayer):
+    """reference: objdetect.Yolo2OutputLayer.Builder()
+    .boundingBoxPriors(anchors).lambdaCoord(5).lambdaNoObj(0.5)."""
+
+    anchors: Tuple[Tuple[float, float], ...] = ((1.0, 1.0),
+                                               (2.0, 2.0))
+    lambda_coord: float = 5.0
+    lambda_no_obj: float = 0.5
+
+    def has_params(self) -> bool:
+        return False
+
+    def set_n_in(self, input_type, override):
+        pass
+
+    def get_output_type(self, input_type):
+        return input_type
+
+    def wants_logits(self) -> bool:
+        return False
+
+    def forward(self, params, x, *, training, rng=None, state=None):
+        return x, state
+
+    # -- loss ---------------------------------------------------------
+    def _decode(self, preds):
+        """[b,H,W,A*(5+C)] -> xy [.,A,2] wh [.,A,2] obj [.,A] cls."""
+        b, h, w, _ = preds.shape
+        a = len(self.anchors)
+        p = preds.reshape(b, h, w, a, -1)
+        xy = jax.nn.sigmoid(p[..., 0:2])           # within-cell offset
+        anchors = jnp.asarray(self.anchors)        # [A, 2] grid units
+        wh = jnp.exp(jnp.clip(p[..., 2:4], -8, 8)) * anchors
+        obj = p[..., 4]
+        cls = p[..., 5:]
+        return xy, wh, obj, cls
+
+    @staticmethod
+    def _iou(wh_a, wh_b, xy_a, xy_b):
+        """IoU of boxes sharing a coordinate frame (grid units)."""
+        lt = jnp.maximum(xy_a - wh_a / 2, xy_b - wh_b / 2)
+        rb = jnp.minimum(xy_a + wh_a / 2, xy_b + wh_b / 2)
+        inter = jnp.prod(jnp.clip(rb - lt, 0), -1)
+        ua = jnp.prod(wh_a, -1) + jnp.prod(wh_b, -1) - inter
+        return inter / jnp.maximum(ua, 1e-9)
+
+    def compute_loss(self, labels, preds, *, from_logits=False,
+                     mask=None, average=True):
+        xy, wh, obj, cls = self._decode(preds)       # [b,h,w,A,*]
+        gt_xy = labels[..., None, 0:2]               # [b,h,w,1,2]
+        gt_wh = labels[..., None, 2:4]
+        gt_cls = labels[..., 4:]                     # [b,h,w,C]
+        has_obj = (jnp.sum(labels[..., 2:4], -1) > 0)  # [b,h,w]
+
+        iou = self._iou(wh, jnp.broadcast_to(gt_wh, wh.shape),
+                        xy, jnp.broadcast_to(gt_xy, xy.shape))
+        resp = jax.nn.one_hot(jnp.argmax(iou, -1),
+                              iou.shape[-1])         # [b,h,w,A]
+        resp = resp * has_obj[..., None]
+
+        coord = jnp.sum(resp[..., None] *
+                        (jnp.square(xy - gt_xy)
+                         + jnp.square(jnp.sqrt(wh)
+                                      - jnp.sqrt(jnp.maximum(
+                                          gt_wh, 1e-9)))), (-2, -1))
+        obj_s = jax.nn.sigmoid(obj)
+        obj_loss = jnp.sum(resp * jnp.square(
+            obj_s - jax.lax.stop_gradient(iou)), -1)
+        noobj_loss = jnp.sum((1 - resp) * jnp.square(obj_s), -1)
+        logp = jax.nn.log_softmax(cls, -1)
+        cls_loss = -jnp.sum(resp * jnp.sum(
+            gt_cls[..., None, :] * logp, -1), -1)
+
+        per_cell = (self.lambda_coord * coord + obj_loss
+                    + self.lambda_no_obj * noobj_loss + cls_loss)
+        loss = jnp.sum(per_cell, (1, 2))             # per example
+        return jnp.mean(loss) if average else loss

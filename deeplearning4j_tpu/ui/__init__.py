@@ -1,0 +1,19 @@
+"""Training UI / stats subsystem (SURVEY.md D17).
+
+Reference: `deeplearning4j-ui` — `StatsListener` collects per-iteration
+model statistics into a `StatsStorage` (in-memory / file), and the
+Vert.x `VertxUIServer` renders them. Here the storage formats are
+in-memory and JSONL-on-disk (machine-readable; any dashboard can tail
+it), plus a static-HTML report renderer in place of the live web
+server (zero-dependency, works over a shared filesystem).
+
+The Chrome-trace `ProfilingListener` (SURVEY.md S8/§5.1) writes
+chrome://tracing-compatible JSON for per-iteration timing.
+"""
+from .stats import (FileStatsStorage, InMemoryStatsStorage,
+                    StatsListener, render_html_report)
+from .profiling import ProfilingListener
+
+__all__ = ["StatsListener", "InMemoryStatsStorage",
+           "FileStatsStorage", "render_html_report",
+           "ProfilingListener"]

@@ -1,0 +1,64 @@
+"""Chrome-trace profiling listener (reference:
+`org.nd4j.autodiff.listeners.profiler.ProfilingListener` — SURVEY.md
+S8/§5.1: writes chrome://tracing JSON).
+
+On TPU, per-op timing inside a jitted step is invisible from Python
+(XLA fuses the whole step) — use ``jax.profiler`` for op-level TPU
+traces. This listener records what the host CAN see — iteration and
+epoch spans, scores — in the same chrome://tracing format so both
+traces load into one timeline.
+"""
+from __future__ import annotations
+
+import json
+import os
+import time
+from typing import List, Optional
+
+from ..optimize.listeners import TrainingListener
+
+
+class ProfilingListener(TrainingListener):
+    def __init__(self, output_path: str, max_events: int = 100_000):
+        self.output_path = output_path
+        self.max_events = max_events
+        self.events: List[dict] = []
+        self._iter_start: Optional[float] = None
+        self._epoch_start: Optional[float] = None
+        self._pid = os.getpid()
+
+    def _us(self, t: float) -> int:
+        return int(t * 1e6)
+
+    def _emit(self, name: str, start: float, end: float, args=None):
+        if len(self.events) >= self.max_events:
+            return
+        self.events.append({
+            "name": name, "ph": "X", "pid": self._pid, "tid": 1,
+            "ts": self._us(start), "dur": self._us(end - start),
+            "args": args or {}})
+
+    def on_epoch_start(self, model):
+        self._epoch_start = time.time()
+
+    def on_epoch_end(self, model):
+        if self._epoch_start is not None:
+            self._emit("epoch", self._epoch_start, time.time())
+            self._epoch_start = None
+        self.flush()
+
+    def iteration_done(self, model, iteration: int, epoch: int):
+        now = time.time()
+        if self._iter_start is None:
+            self._iter_start = now
+            return
+        self._emit(f"iteration {iteration}", self._iter_start, now,
+                   {"iteration": iteration, "epoch": epoch,
+                    "score": float(model.score())})
+        self._iter_start = now
+
+    def flush(self) -> str:
+        with open(self.output_path, "w") as f:
+            json.dump({"traceEvents": self.events,
+                       "displayTimeUnit": "ms"}, f)
+        return self.output_path

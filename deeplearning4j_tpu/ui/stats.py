@@ -1,0 +1,177 @@
+"""StatsListener + StatsStorage (reference:
+`org.deeplearning4j.ui.model.stats.StatsListener`,
+`org.deeplearning4j.ui.model.storage.{InMemoryStatsStorage,
+FileStatsStorage}` — SURVEY.md D17/§5.5).
+
+Collected per report (every ``frequency`` iterations): score,
+per-layer parameter/update/activation summary stats (mean absolute
+value + histograms), update:parameter ratios (the reference UI's
+headline training-health chart), iteration timing, and memory info.
+"""
+from __future__ import annotations
+
+import json
+import time
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from ..optimize.listeners import TrainingListener
+
+
+def _summary(arr, bins: int = 20) -> dict:
+    a = np.asarray(arr, np.float32).ravel()
+    if a.size == 0:
+        return {"mean_abs": 0.0, "mean": 0.0, "std": 0.0,
+                "hist": [], "edges": []}
+    hist, edges = np.histogram(a, bins=bins)
+    return {"mean_abs": float(np.abs(a).mean()),
+            "mean": float(a.mean()), "std": float(a.std()),
+            "hist": hist.tolist(),
+            "edges": [float(e) for e in edges]}
+
+
+class InMemoryStatsStorage:
+    """reference: InMemoryStatsStorage."""
+
+    def __init__(self):
+        self.reports: List[dict] = []
+
+    def put_report(self, report: dict):
+        self.reports.append(report)
+
+    def get_reports(self) -> List[dict]:
+        return list(self.reports)
+
+    def latest(self) -> Optional[dict]:
+        return self.reports[-1] if self.reports else None
+
+
+class FileStatsStorage(InMemoryStatsStorage):
+    """JSONL-on-disk storage (reference: FileStatsStorage's mapdb
+    file, re-designed as line-delimited JSON so anything can tail it)."""
+
+    def __init__(self, path: str):
+        super().__init__()
+        self.path = path
+        try:                       # load existing reports (resume)
+            with open(path) as f:
+                self.reports = [json.loads(l) for l in f
+                                if l.strip()]
+        except FileNotFoundError:
+            pass
+
+    def put_report(self, report: dict):
+        super().put_report(report)
+        with open(self.path, "a") as f:
+            f.write(json.dumps(report) + "\n")
+
+
+class StatsListener(TrainingListener):
+    """Collects model stats into a StatsStorage every N iterations
+    (reference: StatsListener(statsStorage, frequency))."""
+
+    def __init__(self, storage=None, frequency: int = 1,
+                 histograms: bool = True):
+        self.storage = storage if storage is not None \
+            else InMemoryStatsStorage()
+        self.frequency = max(1, int(frequency))
+        self.histograms = histograms
+        self._last_params: Optional[Dict[str, np.ndarray]] = None
+        self._last_time = None
+
+    def _param_table(self, model) -> Dict[str, np.ndarray]:
+        if hasattr(model, "param_table"):
+            return {k: np.asarray(v) for k, v in
+                    model.param_table().items()}
+        return {}
+
+    def iteration_done(self, model, iteration: int, epoch: int):
+        if iteration % self.frequency:
+            self._last_params = None
+            return
+        now = time.time()
+        params = self._param_table(model)
+        report = {
+            "iteration": iteration,
+            "epoch": epoch,
+            "time": now,
+            "score": float(model.score()),
+            "iter_seconds": (now - self._last_time
+                             if self._last_time else None),
+        }
+        layers: Dict[str, dict] = {}
+        for k, p in params.items():
+            entry = {"param": _summary(p) if self.histograms else
+                     {"mean_abs": float(np.abs(p).mean())}}
+            if self._last_params is not None and \
+                    k in self._last_params:
+                upd = p - self._last_params[k]
+                entry["update"] = (_summary(upd) if self.histograms
+                                   else {"mean_abs":
+                                         float(np.abs(upd).mean())})
+                pm = float(np.abs(p).mean())
+                um = float(np.abs(upd).mean())
+                # update:param mean-magnitude ratio — the canonical
+                # learning-health signal (~1e-3 is healthy)
+                entry["update_param_ratio"] = (um / pm if pm > 0
+                                               else 0.0)
+            layers[k] = entry
+        report["layers"] = layers
+        self.storage.put_report(report)
+        self._last_params = params
+        self._last_time = now
+
+
+def render_html_report(storage, path: str, title: str = "Training"):
+    """Static single-file HTML dashboard from a StatsStorage —
+    score curve, update:param ratios, iteration timings (the
+    reference's Vert.x overview page, server-free)."""
+    reports = storage.get_reports()
+    iters = [r["iteration"] for r in reports]
+    scores = [r["score"] for r in reports]
+    ratio_keys = sorted({k for r in reports
+                         for k, v in r.get("layers", {}).items()
+                         if "update_param_ratio" in v})
+    ratios = {k: [r["layers"].get(k, {}).get("update_param_ratio")
+                  for r in reports] for k in ratio_keys}
+    data = json.dumps({"iters": iters, "scores": scores,
+                       "ratios": ratios})
+    html = f"""<!DOCTYPE html>
+<html><head><meta charset="utf-8"><title>{title}</title>
+<style>body{{font-family:sans-serif;margin:2em}}
+.chart{{margin-bottom:2em}}</style></head>
+<body><h1>{title}</h1>
+<div class="chart"><h3>Score vs iteration</h3>
+<canvas id="score" width="800" height="240"></canvas></div>
+<div class="chart"><h3>log10 update:param ratio</h3>
+<canvas id="ratio" width="800" height="240"></canvas></div>
+<script>
+const D = {data};
+function plot(id, series) {{
+  const c = document.getElementById(id), g = c.getContext('2d');
+  const all = series.flatMap(s => s.ys).filter(v => v != null &&
+      isFinite(v));
+  if (!all.length) return;
+  const ymin = Math.min(...all), ymax = Math.max(...all) || 1;
+  const xs = D.iters, xmin = Math.min(...xs),
+        xmax = Math.max(...xs) || 1;
+  series.forEach((s, si) => {{
+    g.strokeStyle = `hsl(${{si * 57 % 360}},70%,45%)`;
+    g.beginPath();
+    s.ys.forEach((y, i) => {{
+      if (y == null || !isFinite(y)) return;
+      const px = 40 + (xs[i] - xmin) / (xmax - xmin || 1) * 740;
+      const py = 220 - (y - ymin) / (ymax - ymin || 1) * 200;
+      i ? g.lineTo(px, py) : g.moveTo(px, py);
+    }});
+    g.stroke();
+  }});
+}}
+plot('score', [{{ys: D.scores}}]);
+plot('ratio', Object.values(D.ratios).map(r => ({{
+  ys: r.map(v => v > 0 ? Math.log10(v) : null)}})));
+</script></body></html>"""
+    with open(path, "w") as f:
+        f.write(html)
+    return path

@@ -233,11 +233,18 @@ int64_t dl4j_parse_csv_floats(const char* buf, int64_t len, char delim,
     const char* p = buf;
     const char* end = buf + len;
     while (p < end) {
-        // skip blank lines anywhere (the Python fallback filters
-        // them, so the two paths must agree)
-        if ((*p == '\n' || *p == '\r') && cur_cols == 0) {
-            ++p;
-            continue;
+        // skip blank (incl. whitespace-only) lines anywhere — the
+        // Python fallback filters them via str.strip(), so the two
+        // paths must agree
+        if (cur_cols == 0) {
+            const char* q = p;
+            while (q < end && (*q == ' ' || *q == '\t' || *q == '\r'))
+                ++q;
+            if (q >= end) break;
+            if (*q == '\n') {
+                p = q + 1;
+                continue;
+            }
         }
         // delimit THIS field first (strtof alone would eat the
         // newline as leading whitespace and merge rows when a field

@@ -1,0 +1,138 @@
+"""Dataset + DataVec modality breadth tests (SURVEY.md D13, V4)."""
+import wave
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.datasets.vision import (
+    Cifar10DataSetIterator, EmnistDataSetIterator,
+    TinyImageNetDataSetIterator)
+from deeplearning4j_tpu.datavec.audio import (WavFileRecordReader,
+                                              log_mel, read_wav,
+                                              stft_power)
+from deeplearning4j_tpu.datavec.codec import CodecRecordReader
+from deeplearning4j_tpu.datavec.nlpvec import (BagOfWordsVectorizer,
+                                               TfidfVectorizer)
+from deeplearning4j_tpu.datavec.split import FileSplit
+
+
+class TestVisionIterators:
+    def test_cifar10(self):
+        it = Cifar10DataSetIterator(8, train=True, num_examples=32)
+        ds = it.next()
+        assert ds.features.shape == (8, 32, 32, 3)
+        assert ds.labels.shape == (8, 10)
+        n = ds.num_examples()
+        total = n
+        while it.has_next():
+            total += it.next().num_examples()
+        assert total == 32
+        it.reset()
+        assert it.has_next()
+
+    def test_emnist_sets(self):
+        it = EmnistDataSetIterator("LETTERS", 4, num_examples=8)
+        ds = it.next()
+        assert ds.features.shape == (4, 28 * 28)
+        assert ds.labels.shape == (4, 26)
+        with pytest.raises(ValueError, match="unknown EMNIST"):
+            EmnistDataSetIterator("NOPE", 4)
+
+    def test_tiny_imagenet(self):
+        it = TinyImageNetDataSetIterator(4, num_examples=8)
+        ds = it.next()
+        assert ds.features.shape == (4, 64, 64, 3)
+        assert ds.labels.shape == (4, 200)
+
+    def test_deterministic_synthetic(self):
+        a = Cifar10DataSetIterator(4, num_examples=8, seed=5).next()
+        b = Cifar10DataSetIterator(4, num_examples=8, seed=5).next()
+        np.testing.assert_array_equal(np.asarray(a.features),
+                                      np.asarray(b.features))
+
+
+class TestAudio:
+    def _write_wav(self, path, sr=8000, seconds=0.5, freq=440.0):
+        t = np.arange(int(sr * seconds)) / sr
+        x = (np.sin(2 * np.pi * freq * t) * 0.5 * 32767) \
+            .astype(np.int16)
+        with wave.open(str(path), "wb") as w:
+            w.setnchannels(1)
+            w.setsampwidth(2)
+            w.setframerate(sr)
+            w.writeframes(x.tobytes())
+        return x
+
+    def test_wav_roundtrip(self, tmp_path):
+        p = tmp_path / "tone.wav"
+        raw = self._write_wav(p)
+        x, sr = read_wav(p)
+        assert sr == 8000
+        np.testing.assert_allclose(x, raw / 32768.0, atol=1e-4)
+
+    def test_spectrogram_peak_at_tone(self, tmp_path):
+        p = tmp_path / "tone.wav"
+        self._write_wav(p, sr=8000, freq=1000.0)
+        x, sr = read_wav(p)
+        pw = stft_power(x, 512, 256)
+        peak_bin = np.asarray(pw.mean(0)).argmax()
+        peak_hz = peak_bin * sr / 512
+        assert abs(peak_hz - 1000.0) < 40
+        lm = log_mel(pw, sr, n_mels=20)
+        assert lm.shape == (pw.shape[0], 20)
+        assert np.isfinite(lm).all()
+
+    def test_record_reader(self, tmp_path):
+        for i in range(2):
+            self._write_wav(tmp_path / f"a{i}.wav",
+                            freq=440.0 * (i + 1))
+        rr = WavFileRecordReader(features="logmel")
+        rr.initialize(FileSplit(str(tmp_path), ["wav"]))
+        recs = list(rr)
+        assert len(recs) == 2
+        assert recs[0][0].value.ndim == 2
+
+
+class TestCodec:
+    def test_npy_frames(self, tmp_path):
+        frames = np.random.RandomState(0).rand(10, 8, 8, 3) \
+            .astype(np.float32)
+        np.save(tmp_path / "clip.npy", frames)
+        rr = CodecRecordReader(start_frame=2, num_frames=3, rate=2)
+        rr.initialize(FileSplit(str(tmp_path), ["npy"]))
+        seq = rr.next_sequence()
+        assert len(seq) == 3
+        np.testing.assert_array_equal(seq[0][0].value, frames[2])
+        np.testing.assert_array_equal(seq[1][0].value, frames[4])
+
+    def test_unsupported_container_errors(self, tmp_path):
+        (tmp_path / "v.mp4").write_bytes(b"x")
+        rr = CodecRecordReader()
+        rr.initialize(FileSplit(str(tmp_path), ["mp4"]))
+        with pytest.raises(NotImplementedError, match="ffmpeg"):
+            rr.next_sequence()
+
+
+class TestTextVectorizers:
+    CORPUS = ["the cat sat on the mat",
+              "the dog sat on the log",
+              "cats and dogs"]
+
+    def test_bag_of_words(self):
+        v = BagOfWordsVectorizer()
+        m = v.fit_transform(self.CORPUS)
+        assert m.shape == (3, len(v.vocab))
+        i_the = v.vocab["the"]
+        assert m[0, i_the] == 2.0
+        assert m[2, i_the] == 0.0
+
+    def test_tfidf_downweights_common(self):
+        v = TfidfVectorizer()
+        m = v.fit_transform(self.CORPUS)
+        # 'the' (2 docs) carries lower idf than 'cat' (1 doc)
+        assert v.idf[v.vocab["the"]] < v.idf[v.vocab["cat"]]
+        assert np.isfinite(m).all()
+        # transform of unseen doc uses fitted vocab only
+        u = v.transform("the purple cat")
+        assert u.shape == (len(v.vocab),)
+        assert u[v.vocab["cat"]] > 0

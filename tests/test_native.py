@@ -112,6 +112,9 @@ class TestCsv:
         """Native and fallback must agree: blank lines filtered."""
         m = nat.parse_csv_floats("1,2\n\n3,4\n\n")
         np.testing.assert_allclose(m, [[1, 2], [3, 4]])
+        # whitespace-only lines count as blank too (fallback strips)
+        m2 = nat.parse_csv_floats("1,2\n \n3,4\n\t\n")
+        np.testing.assert_allclose(m2, [[1, 2], [3, 4]])
 
     def test_non_numeric_field_is_nan_both_paths(self, built,
                                                  monkeypatch):

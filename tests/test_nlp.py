@@ -153,6 +153,25 @@ class TestBertIterator:
             losses.append(bert.fit_batch(it.next()))
         assert np.isfinite(losses).all()
 
+    def test_sentence_pair_segment_ids(self):
+        tk = self._tokenizer()
+        it = BertIterator(tk, [("the quick fox", "the lazy dog")],
+                          max_length=16, batch_size=1, seed=0,
+                          task=BertIterator.SEQ_CLASSIFICATION,
+                          labels=[0], n_labels=2)
+        b = it.next()
+        ids = b["input_ids"][0]
+        tt = b["token_type_ids"][0]
+        sep = tk.id_of("[SEP]")
+        first_sep = int(np.argmax(ids == sep))
+        # segment 0 through the first [SEP], segment 1 after it up to
+        # (and including) the second [SEP], 0 again on padding
+        assert (tt[:first_sep + 1] == 0).all()
+        second_sep = first_sep + 1 + int(
+            np.argmax(ids[first_sep + 1:] == sep))
+        assert (tt[first_sep + 1:second_sep + 1] == 1).all()
+        assert (tt[second_sep + 1:] == 0).all()
+
     def test_classification_task(self):
         tk = self._tokenizer()
         sents = ["the quick fox", "lazy dog", "quick dog",

@@ -1,0 +1,186 @@
+"""ONNX import conformance (SURVEY.md S7, test strategy §4.4: run
+imported graphs and compare tensors against framework ground truth —
+here torch CPU forward passes; fixtures are built with the in-repo
+ONNX encoder since this image has no `onnx` package)."""
+import numpy as np
+import pytest
+
+torch = pytest.importorskip("torch")
+
+from deeplearning4j_tpu.modelimport.onnx import import_onnx
+from deeplearning4j_tpu.modelimport.onnx.protobuf import (
+    encode_model, encode_node, encode_value_info)
+
+
+def _mlp_model(m):
+    """Sequential(Linear, ReLU, Linear, Softmax) as ONNX bytes."""
+    w0 = m[0].weight.detach().numpy()
+    b0 = m[0].bias.detach().numpy()
+    w1 = m[2].weight.detach().numpy()
+    b1 = m[2].bias.detach().numpy()
+    nodes = [
+        encode_node("Gemm", ["x", "w0", "b0"], ["h0"], "fc1",
+                    alpha=1.0, beta=1.0, transB=1),
+        encode_node("Relu", ["h0"], ["h1"], "relu"),
+        encode_node("Gemm", ["h1", "w1", "b1"], ["h2"], "fc2",
+                    alpha=1.0, beta=1.0, transB=1),
+        encode_node("Softmax", ["h2"], ["y"], "sm", axis=-1),
+    ]
+    return encode_model(
+        nodes,
+        {"w0": w0, "b0": b0, "w1": w1, "b1": b1},
+        [encode_value_info("x", (2, 4))],
+        [encode_value_info("y", (2, 3))])
+
+
+class TestMlp:
+    def test_matches_torch(self):
+        torch.manual_seed(0)
+        m = torch.nn.Sequential(torch.nn.Linear(4, 8),
+                                torch.nn.ReLU(),
+                                torch.nn.Linear(8, 3))
+        x = torch.randn(2, 4)
+        want = torch.softmax(m(x), -1).detach().numpy()
+        imp = import_onnx(_mlp_model(m))
+        got = imp.output({"x": x.numpy()})[0]
+        np.testing.assert_allclose(np.asarray(got), want, atol=1e-5)
+
+
+class TestCnn:
+    def _torch_net(self):
+        torch.manual_seed(1)
+        return torch.nn.Sequential(
+            torch.nn.Conv2d(3, 8, 3, stride=1, padding=1),
+            torch.nn.BatchNorm2d(8),
+            torch.nn.ReLU(),
+            torch.nn.MaxPool2d(2, 2),
+            torch.nn.Conv2d(8, 16, 3, stride=2, padding=1),
+            torch.nn.ReLU(),
+            torch.nn.Flatten(),
+            torch.nn.Linear(16 * 4 * 4, 5),
+        ).eval()
+
+    def _onnx(self, net):
+        conv1, bn, _, _, conv2, _, _, fc = net
+        bn.eval()
+        inits = {
+            "w1": conv1.weight.detach().numpy(),
+            "c1b": conv1.bias.detach().numpy(),
+            "g": bn.weight.detach().numpy(),
+            "b": bn.bias.detach().numpy(),
+            "rm": bn.running_mean.detach().numpy(),
+            "rv": bn.running_var.detach().numpy(),
+            "w2": conv2.weight.detach().numpy(),
+            "c2b": conv2.bias.detach().numpy(),
+            "wf": fc.weight.detach().numpy(),
+            "bf": fc.bias.detach().numpy(),
+        }
+        # Conv bias is rank-1 [C]; as NHWC add it broadcasts over the
+        # trailing channel dim directly
+        nodes = [
+            encode_node("Conv", ["x", "w1", "c1b"], ["a"], "c1",
+                        kernel_shape=[3, 3], strides=[1, 1],
+                        pads=[1, 1, 1, 1]),
+            encode_node("BatchNormalization",
+                        ["a", "g", "b", "rm", "rv"], ["bn"], "bn",
+                        epsilon=float(bn.eps)),
+            encode_node("Relu", ["bn"], ["r1"], "r1"),
+            encode_node("MaxPool", ["r1"], ["p1"], "p1",
+                        kernel_shape=[2, 2], strides=[2, 2]),
+            encode_node("Conv", ["p1", "w2", "c2b"], ["c2o"], "c2",
+                        kernel_shape=[3, 3], strides=[2, 2],
+                        pads=[1, 1, 1, 1]),
+            encode_node("Relu", ["c2o"], ["r2"], "r2"),
+            encode_node("Flatten", ["r2"], ["fl"], "fl", axis=1),
+            encode_node("Gemm", ["fl", "wf", "bf"], ["y"], "fc",
+                        alpha=1.0, beta=1.0, transB=1),
+        ]
+        return encode_model(
+            nodes, inits,
+            [encode_value_info("x", (2, 3, 16, 16))],
+            [encode_value_info("y", (2, 5))])
+
+    def test_matches_torch(self):
+        net = self._torch_net()
+        x = torch.randn(2, 3, 16, 16)
+        with torch.no_grad():
+            want = net(x).numpy()
+        imp = import_onnx(self._onnx(net))
+        got = imp.output({"x": x.numpy()})[0]
+        np.testing.assert_allclose(np.asarray(got), want, atol=2e-4,
+                                   rtol=1e-4)
+
+
+class TestOpCoverage:
+    def _run(self, nodes, inits, in_shapes, out_names, feeds):
+        model = encode_model(
+            nodes, inits,
+            [encode_value_info(k, v) for k, v in in_shapes.items()],
+            [encode_value_info(o, ()) for o in out_names])
+        imp = import_onnx(model)
+        return imp.output(feeds, out_names)
+
+    def test_elementwise_chain(self):
+        rng = np.random.RandomState(0)
+        a = rng.randn(3, 4).astype(np.float32)
+        b = rng.randn(3, 4).astype(np.float32)
+        nodes = [
+            encode_node("Add", ["a", "b"], ["s"], "add"),
+            encode_node("Mul", ["s", "a"], ["m"], "mul"),
+            encode_node("Sigmoid", ["m"], ["sg"], "sig"),
+            encode_node("Clip", ["sg"], ["y"], "clip",
+                        min=0.2, max=0.8),
+        ]
+        [got] = self._run(nodes, {}, {"a": (3, 4), "b": (3, 4)},
+                          ["y"], {"a": a, "b": b})
+        want = np.clip(1 / (1 + np.exp(-((a + b) * a))), 0.2, 0.8)
+        np.testing.assert_allclose(np.asarray(got), want, atol=1e-6)
+
+    def test_shape_ops(self):
+        x = np.arange(24, dtype=np.float32).reshape(2, 3, 4)
+        nodes = [
+            encode_node("Transpose", ["x"], ["t"], "tr",
+                        perm=[0, 2, 1]),
+            encode_node("Reshape", ["t", "shp"], ["r"], "rs"),
+            encode_node("Slice", ["r", "st", "en"], ["sl"], "sl"),
+            encode_node("Concat", ["sl", "sl"], ["y"], "cc", axis=0),
+        ]
+        inits = {"shp": np.asarray([4, 6], np.int64),
+                 "st": np.asarray([1], np.int64),
+                 "en": np.asarray([3], np.int64)}
+        [got] = self._run(nodes, inits, {"x": (2, 3, 4)}, ["y"],
+                          {"x": x})
+        t = np.transpose(x, (0, 2, 1)).reshape(4, 6)
+        want = np.concatenate([t[1:3], t[1:3]], 0)
+        np.testing.assert_allclose(np.asarray(got), want)
+
+    def test_reductions_and_gather(self):
+        x = np.arange(12, dtype=np.float32).reshape(3, 4)
+        nodes = [
+            encode_node("ReduceMean", ["x"], ["rm"], "rm",
+                        axes=[1], keepdims=0),
+            encode_node("Gather", ["x", "idx"], ["g"], "g", axis=0),
+            encode_node("ReduceSum", ["g"], ["rs"], "rs",
+                        axes=[0, 1], keepdims=0),
+        ]
+        inits = {"idx": np.asarray([0, 2], np.int64)}
+        rm, rs = self._run(nodes, inits, {"x": (3, 4)},
+                           ["rm", "rs"], {"x": x})
+        np.testing.assert_allclose(np.asarray(rm), x.mean(1))
+        np.testing.assert_allclose(np.asarray(rs),
+                                   x[[0, 2]].sum())
+
+    def test_unmapped_op_errors_clearly(self):
+        nodes = [encode_node("MadeUpOp", ["x"], ["y"], "nope")]
+        with pytest.raises(NotImplementedError, match="MadeUpOp"):
+            self._run(nodes, {}, {"x": (2,)}, ["y"],
+                      {"x": np.zeros(2, np.float32)})
+
+    def test_global_avg_pool_and_gemm(self):
+        torch.manual_seed(2)
+        x = torch.randn(2, 6, 5, 5)
+        want = torch.nn.functional.adaptive_avg_pool2d(x, 1).numpy()
+        nodes = [encode_node("GlobalAveragePool", ["x"], ["y"], "gap")]
+        [got] = self._run(nodes, {}, {"x": (2, 6, 5, 5)}, ["y"],
+                          {"x": x.numpy()})
+        np.testing.assert_allclose(np.asarray(got), want, atol=1e-6)

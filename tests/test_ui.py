@@ -1,0 +1,84 @@
+"""UI stats + profiling tests (SURVEY.md D17, S8/§5.1)."""
+import json
+
+import numpy as np
+
+from deeplearning4j_tpu.activations import Activation
+from deeplearning4j_tpu.datasets.dataset import DataSet
+from deeplearning4j_tpu.learning import Adam
+from deeplearning4j_tpu.lossfunctions import LossFunction
+from deeplearning4j_tpu.nn import (InputType, MultiLayerNetwork,
+                                   NeuralNetConfiguration)
+from deeplearning4j_tpu.nn.conf.layers import DenseLayer, OutputLayer
+from deeplearning4j_tpu.ui import (FileStatsStorage,
+                                   InMemoryStatsStorage,
+                                   ProfilingListener, StatsListener,
+                                   render_html_report)
+
+
+def _net_and_data(listeners):
+    rng = np.random.RandomState(0)
+    x = rng.randn(64, 4).astype(np.float32)
+    y = np.eye(2, dtype=np.float32)[(x[:, 0] > 0).astype(int)]
+    net = MultiLayerNetwork(
+        (NeuralNetConfiguration.Builder().seed(1).updater(Adam(1e-2))
+         .list()
+         .layer(DenseLayer(n_out=8, activation=Activation.RELU))
+         .layer(OutputLayer(n_out=2, activation=Activation.SOFTMAX,
+                            loss_function=LossFunction.MCXENT))
+         .set_input_type(InputType.feed_forward(4)).build())).init()
+    net.set_listeners(*listeners)
+    return net, DataSet(x, y)
+
+
+class TestStatsListener:
+    def test_collects_reports(self):
+        storage = InMemoryStatsStorage()
+        net, ds = _net_and_data([StatsListener(storage, frequency=1)])
+        net.fit(ds, n_epochs=5)
+        reports = storage.get_reports()
+        assert len(reports) == 5
+        r = reports[-1]
+        assert np.isfinite(r["score"])
+        assert "layer_0.W" in r["layers"] or any(
+            "W" in k for k in r["layers"])
+        # update stats + ratio present from the 2nd report onward
+        wkey = next(k for k in r["layers"] if k.endswith("W"))
+        assert "update_param_ratio" in r["layers"][wkey]
+        assert r["layers"][wkey]["update_param_ratio"] > 0
+        assert len(r["layers"][wkey]["param"]["hist"]) == 20
+
+    def test_file_storage_roundtrip(self, tmp_path):
+        p = str(tmp_path / "stats.jsonl")
+        storage = FileStatsStorage(p)
+        net, ds = _net_and_data([StatsListener(storage)])
+        net.fit(ds, n_epochs=3)
+        # a new storage instance reloads the same reports
+        again = FileStatsStorage(p)
+        assert len(again.get_reports()) == 3
+        assert again.latest()["iteration"] == \
+            storage.latest()["iteration"]
+
+    def test_html_report(self, tmp_path):
+        storage = InMemoryStatsStorage()
+        net, ds = _net_and_data([StatsListener(storage)])
+        net.fit(ds, n_epochs=4)
+        out = render_html_report(storage, str(tmp_path / "r.html"))
+        html = open(out).read()
+        assert "<canvas" in html and "Score vs iteration" in html
+        # data payload embedded
+        assert '"scores"' in html
+
+
+class TestProfilingListener:
+    def test_chrome_trace(self, tmp_path):
+        p = str(tmp_path / "trace.json")
+        prof = ProfilingListener(p)
+        net, ds = _net_and_data([prof])
+        net.fit([ds], n_epochs=3)      # iterator path fires epochs
+        trace = json.load(open(p))
+        events = trace["traceEvents"]
+        assert any(e["name"] == "epoch" for e in events)
+        iters = [e for e in events if e["name"].startswith("iteration")]
+        assert iters and all(e["ph"] == "X" and e["dur"] >= 0
+                             for e in iters)

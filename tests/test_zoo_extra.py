@@ -1,0 +1,107 @@
+"""Zoo breadth tests (SURVEY.md D15): every model instantiates at a
+reduced input size, runs forward with correct output shape, and takes
+a finite training step. YOLO models additionally train against the
+Yolo2OutputLayer loss."""
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.models.zoo_extra import (
+    Darknet19, InceptionResNetV1, NASNet, SqueezeNet,
+    TextGenerationLSTM, TinyYOLO, UNet, Xception, YOLO2)
+
+
+def _img(b, h, w, c=3, seed=0):
+    return np.random.RandomState(seed).randn(b, h, w, c) \
+        .astype(np.float32)
+
+
+def _onehot(n, k, seed=0):
+    rng = np.random.RandomState(seed)
+    return np.eye(k, dtype=np.float32)[rng.randint(0, k, n)]
+
+
+class TestClassifiers:
+    @pytest.mark.parametrize("cls,kw,hw", [
+        (Darknet19, {}, 64),
+        (SqueezeNet, {}, 64),
+        (Xception, {"middle_blocks": 1}, 71),
+        (InceptionResNetV1, {"blocks": (1, 1, 1)}, 80),
+        (NASNet, {"cells_per_stack": 1,
+                  "penultimate_filters": 264}, 64),
+    ])
+    def test_forward_and_fit(self, cls, kw, hw):
+        net = cls(num_classes=7, height=hw, width=hw, **kw).init()
+        x = _img(2, hw, hw)
+        out = np.asarray(net.output(x))
+        assert out.shape == (2, 7)
+        np.testing.assert_allclose(out.sum(1), 1.0, atol=1e-4)
+        from deeplearning4j_tpu.datasets.dataset import DataSet
+        net.fit(DataSet(x, _onehot(2, 7)))
+        assert np.isfinite(net.score())
+
+
+class TestUNet:
+    def test_segmentation_shapes(self):
+        net = UNet(height=32, width=32, base_filters=8, depth=2).init()
+        x = _img(2, 32, 32)
+        out = np.asarray(net.output(x))
+        assert out.shape == (2, 32, 32, 1)
+        assert (out >= 0).all() and (out <= 1).all()
+        # binary masks -> finite XENT loss step
+        y = (np.random.RandomState(1).rand(2, 32, 32, 1) > 0.5) \
+            .astype(np.float32)
+        from deeplearning4j_tpu.datasets.dataset import DataSet
+        net.fit(DataSet(x, y))
+        assert np.isfinite(net.score())
+
+
+class TestYolo:
+    def _labels(self, b, h, w, n_classes, seed=0):
+        """A few random cells get a box + class."""
+        rng = np.random.RandomState(seed)
+        lab = np.zeros((b, h, w, 4 + n_classes), np.float32)
+        for bi in range(b):
+            for _ in range(3):
+                i, j = rng.randint(h), rng.randint(w)
+                lab[bi, i, j, 0:2] = rng.rand(2)          # cx, cy
+                lab[bi, i, j, 2:4] = 0.5 + rng.rand(2) * 3  # w, h
+                lab[bi, i, j, 4 + rng.randint(n_classes)] = 1.0
+        return lab
+
+    def test_tiny_yolo_trains(self):
+        net = TinyYOLO(num_classes=4, height=64, width=64).init()
+        x = _img(2, 64, 64)
+        out = np.asarray(net.output(x))
+        a = len(TinyYOLO().anchors)
+        assert out.shape == (2, 2, 2, a * (5 + 4))
+        from deeplearning4j_tpu.datasets.dataset import DataSet
+        lab = self._labels(2, 2, 2, 4)
+        scores = []
+        for i in range(12):
+            net.fit(DataSet(x, lab))
+            scores.append(float(net.score()))
+        assert np.isfinite(scores).all()
+        # noisy early (BN+Adam warmup) but converging
+        assert np.mean(scores[-3:]) < scores[0], scores
+
+    def test_yolo2_instantiates(self):
+        net = YOLO2(num_classes=3, height=64, width=64).init()
+        out = np.asarray(net.output(_img(1, 64, 64)))
+        a = len(YOLO2().anchors)
+        assert out.shape == (1, 2, 2, a * (5 + 3))
+
+
+class TestTextGeneration:
+    def test_char_lstm_trains(self):
+        net = TextGenerationLSTM(total_unique_characters=12,
+                                 max_length=16, units=32,
+                                 layers=2).init()
+        rng = np.random.RandomState(0)
+        ids = rng.randint(0, 12, (4, 16))
+        x = np.eye(12, dtype=np.float32)[ids].astype(np.float32)
+        y = np.eye(12, dtype=np.float32)[np.roll(ids, -1, 1)]
+        from deeplearning4j_tpu.datasets.dataset import DataSet
+        net.fit(DataSet(x, y), n_epochs=3)
+        assert np.isfinite(net.score())
+        out = np.asarray(net.output(x))
+        assert out.shape == (4, 16, 12)
