@@ -1,0 +1,144 @@
+"""Op validation framework (SURVEY.md §4.3:
+`org.nd4j.autodiff.opvalidation.OpValidation` — declarative per-op
+cases checking forward output AND analytic-vs-numeric gradients, plus
+coverage accounting that FAILS when registered ops have no
+validation).
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Callable, Dict, List, Optional, Sequence, Set
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .registry import OP_REGISTRY, get_op
+
+#: ops validated so far (coverage accounting)
+_VALIDATED: Set[str] = set()
+
+
+@dataclass
+class TestCase:
+    """One op validation case (reference: OpValidation TestCase)."""
+    op: str
+    inputs: Sequence[np.ndarray]
+    attrs: Optional[dict] = None
+    expected: Optional[Sequence[np.ndarray]] = None
+    #: reference fn computing expected outputs from inputs (numpy)
+    expected_fn: Optional[Callable] = None
+    gradient_check: bool = True
+    #: which inputs get gradient-checked (default: all float inputs)
+    grad_inputs: Optional[Sequence[int]] = None
+    fwd_tol: float = 1e-5
+    grad_tol: float = 2e-2
+    #: float32 loss values quantize at ~scale*1e-7; a larger step
+    #: keeps the difference above that noise (truncation error is
+    #: O(eps^2) and stays far smaller for these smooth ops)
+    epsilon: float = 1e-2
+    max_entries: int = 8
+    seed: int = 0
+
+
+def validate(tc: TestCase) -> None:
+    """Run one case; raises AssertionError with op context on any
+    mismatch. Records the op as covered.
+
+    Runs under ``default_matmul_precision('highest')``: validation is
+    about op SEMANTICS, so the TPU's default bf16 matmul passes must
+    not show up as forward mismatches."""
+    with jax.default_matmul_precision("highest"):
+        _validate_inner(tc)
+    _VALIDATED.add(tc.op)
+
+
+def _validate_inner(tc: TestCase) -> None:
+    impl = get_op(tc.op)
+    attrs = tc.attrs or {}
+    ins = [jnp.asarray(a) for a in tc.inputs]
+
+    out = impl(list(ins), attrs)
+    outs = list(out) if isinstance(out, (list, tuple)) else [out]
+
+    expected = tc.expected
+    if expected is None and tc.expected_fn is not None:
+        e = tc.expected_fn(*[np.asarray(a) for a in tc.inputs])
+        expected = list(e) if isinstance(e, (list, tuple)) else [e]
+    if expected is not None:
+        assert len(expected) == len(outs), \
+            f"{tc.op}: {len(outs)} outputs, expected {len(expected)}"
+        for i, (got, want) in enumerate(zip(outs, expected)):
+            np.testing.assert_allclose(
+                np.asarray(got, np.float64),
+                np.asarray(want, np.float64),
+                atol=tc.fwd_tol, rtol=tc.fwd_tol,
+                err_msg=f"{tc.op}: forward output {i} mismatch")
+
+    if tc.gradient_check:
+        _check_grads(tc, impl, attrs, ins)
+
+
+def _check_grads(tc: TestCase, impl, attrs, ins):
+    grad_idx = tc.grad_inputs
+    if grad_idx is None:
+        grad_idx = [i for i, a in enumerate(ins)
+                    if jnp.issubdtype(a.dtype, jnp.floating)]
+    if not grad_idx:
+        return
+
+    def scalar_loss(*wrt):
+        full = list(ins)
+        for j, i in enumerate(grad_idx):
+            full[i] = wrt[j]
+        out = impl(full, attrs)
+        outs = list(out) if isinstance(out, (list, tuple)) else [out]
+        return sum(jnp.sum(o * o) for o in outs
+                   if jnp.issubdtype(o.dtype, jnp.floating))
+
+    wrt = [ins[i] for i in grad_idx]
+    analytic = jax.grad(scalar_loss, argnums=tuple(range(len(wrt))))(
+        *wrt)
+    rng = np.random.RandomState(tc.seed)
+    for j, (a, g) in enumerate(zip(wrt, analytic)):
+        a64 = np.asarray(a, np.float64)
+        g64 = np.asarray(g, np.float64)
+        n = a64.size
+        idxs = (range(n) if n <= tc.max_entries else
+                rng.choice(n, tc.max_entries, replace=False))
+        for fi in idxs:
+            d = np.zeros(n)
+            d[fi] = tc.epsilon
+            d = d.reshape(a64.shape)
+
+            def at(off):
+                pert = [jnp.asarray((a64 + off).astype(np.float32))
+                        if k == j else w for k, w in enumerate(wrt)]
+                return float(scalar_loss(*pert))
+
+            numeric = (at(d) - at(-d)) / (2 * tc.epsilon)
+            ana = g64.reshape(-1)[fi]
+            err = abs(numeric - ana)
+            denom = max(abs(numeric), abs(ana))
+            # absolute floor absorbs f32 loss quantization
+            assert err <= 1e-3 or (denom > 0
+                                   and err / denom <= tc.grad_tol), (
+                f"{tc.op}: grad mismatch input {grad_idx[j]} "
+                f"idx {fi}: analytic {ana:.6g} numeric {numeric:.6g}")
+
+
+# -- coverage accounting ----------------------------------------------------
+def validated_ops() -> Set[str]:
+    return set(_VALIDATED)
+
+
+def coverage_report(exclusions: Optional[Set[str]] = None) -> Dict:
+    """reference: OpValidation coverage accounting — which registered
+    ops have at least one validation case."""
+    exclusions = exclusions or set()
+    all_ops = set(OP_REGISTRY)
+    covered = _VALIDATED & all_ops
+    missing = all_ops - covered - exclusions
+    return {"total": len(all_ops), "covered": len(covered),
+            "missing": sorted(missing),
+            "fraction": len(covered) / max(1, len(all_ops))}

@@ -82,3 +82,18 @@ def to_jnp_dtype(x):
     if isinstance(x, DataType):
         return x.jnp
     return jnp.dtype(x)
+
+
+def cast_floats(tree, dtype):
+    """Cast every floating-point array leaf of a pytree to ``dtype``
+    (ints/bools untouched) — the mixed-precision entry cast: master
+    params stay float32, the forward runs in (usually) bfloat16, and
+    the cast's transpose returns float32 gradients."""
+    import jax
+
+    def c(a):
+        if hasattr(a, "dtype") and jnp.issubdtype(a.dtype,
+                                                  jnp.floating):
+            return a.astype(dtype)
+        return a
+    return jax.tree_util.tree_map(c, tree)

@@ -17,6 +17,12 @@ def _np(x):
     from deeplearning4j_tpu.ndarray.ndarray import INDArray
     if isinstance(x, INDArray):
         return x.to_numpy()
+    import jax
+    if isinstance(x, jax.Array):
+        # keep device-resident arrays on device — np.asarray would
+        # round-trip them through the host (and on tunneled TPUs,
+        # through the network) on every fit
+        return x
     return np.asarray(x)
 
 

@@ -239,6 +239,10 @@ class ResNet50(ZooModel):
     channels: int = 3
     seed: int = 123
     updater: Optional[IUpdater] = None
+    #: mixed precision: 'bfloat16' runs the conv/BN math on the MXU's
+    #: native dtype with float32 master params (roughly doubles
+    #: throughput; the reference's cuDNN TensorCore analog)
+    compute_dtype: Optional[str] = None
 
     # stage definitions: (n_blocks, bottleneck_width)
     STAGES: Tuple[Tuple[int, int], ...] = ((3, 64), (4, 128), (6, 256),
@@ -250,6 +254,7 @@ class ResNet50(ZooModel):
              .updater(self.updater or Nesterovs(1e-1, 0.9))
              .weight_init(WeightInit.RELU)
              .l2(1e-4)
+             .compute_data_type(self.compute_dtype)
              .graph_builder()
              .add_inputs("input")
              .set_input_types(InputType.convolutional(

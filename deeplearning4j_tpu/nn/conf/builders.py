@@ -64,6 +64,7 @@ class MultiLayerConfiguration:
     tbptt_fwd_length: int = 20
     tbptt_back_length: int = 20
     dtype: str = "float32"
+    compute_dtype: Optional[str] = None   # None = same as dtype
     input_type: Optional[InputType] = None
 
     # -- JSON ------------------------------------------------------------
@@ -84,6 +85,7 @@ class MultiLayerConfiguration:
             "tbptt_fwd_length": self.tbptt_fwd_length,
             "tbptt_back_length": self.tbptt_back_length,
             "dtype": self.dtype,
+            "compute_dtype": self.compute_dtype,
             "input_type": self.input_type.to_map() if self.input_type
                           else None,
         }
@@ -110,6 +112,7 @@ class MultiLayerConfiguration:
             tbptt_fwd_length=d.get("tbptt_fwd_length", 20),
             tbptt_back_length=d.get("tbptt_back_length", 20),
             dtype=d.get("dtype", "float32"),
+            compute_dtype=d.get("compute_dtype"),
             input_type=InputType.from_map(d["input_type"])
                        if d.get("input_type") else None,
         )
@@ -240,6 +243,7 @@ class ListBuilder:
             tbptt_fwd_length=self._tbptt_fwd,
             tbptt_back_length=self._tbptt_back,
             dtype=b._dtype,
+            compute_dtype=b._compute_dtype,
             input_type=self._input_type,
         )
         for l in conf.layers:
@@ -263,6 +267,7 @@ class NeuralNetConfiguration:
             self._grad_norm = GradientNormalization.NONE
             self._grad_norm_threshold = 1.0
             self._dtype = "float32"
+            self._compute_dtype: Optional[str] = None
 
         def seed(self, s: int) -> "NeuralNetConfiguration.Builder":
             self._seed = int(s)
@@ -308,6 +313,14 @@ class NeuralNetConfiguration:
         def data_type(self, dtype: str
                       ) -> "NeuralNetConfiguration.Builder":
             self._dtype = dtype
+            return self
+
+        def compute_data_type(self, dtype: Optional[str]
+                              ) -> "NeuralNetConfiguration.Builder":
+            """Mixed precision: run forward/backward math in this
+            dtype (canonically 'bfloat16' on TPU — MXU-native) while
+            parameters/optimizer state stay in ``data_type``."""
+            self._compute_dtype = dtype
             return self
 
         def list(self) -> ListBuilder:  # noqa: A003

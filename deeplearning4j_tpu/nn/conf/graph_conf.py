@@ -52,6 +52,7 @@ class ComputationGraphConfiguration:
     tbptt_fwd_length: int = 20
     tbptt_back_length: int = 20
     dtype: str = "float32"
+    compute_dtype: Optional[str] = None   # None = same as dtype
 
     # ------------------------------------------------------------------
     def topo_order(self) -> List[str]:
@@ -125,6 +126,7 @@ class ComputationGraphConfiguration:
             "tbptt_fwd_length": self.tbptt_fwd_length,
             "tbptt_back_length": self.tbptt_back_length,
             "dtype": self.dtype,
+            "compute_dtype": self.compute_dtype,
         }
         return json.dumps(d, indent=2)
 
@@ -148,6 +150,7 @@ class ComputationGraphConfiguration:
             tbptt_fwd_length=d.get("tbptt_fwd_length", 20),
             tbptt_back_length=d.get("tbptt_back_length", 20),
             dtype=d.get("dtype", "float32"),
+            compute_dtype=d.get("compute_dtype"),
         )
         for vd in d["vertices"]:
             content = Layer.from_map(vd["content"]) \
@@ -214,6 +217,7 @@ class GraphBuilder:
         c.gradient_normalization = b._grad_norm
         c.gradient_normalization_threshold = b._grad_norm_threshold
         c.dtype = b._dtype
+        c.compute_dtype = b._compute_dtype
         from deeplearning4j_tpu.nn.conf.builders import \
             apply_layer_defaults
         for v in c.vertices.values():

@@ -97,6 +97,15 @@ class ComputationGraph:
         attach masks via PreprocessorVertex if they diverge.
         Returns ({vertex: activation} for outputs, new_states)."""
         conf = self.conf
+        if conf.compute_dtype:
+            # mixed precision: bfloat16 math, float32 master params —
+            # the entry cast's transpose gives float32 gradients.
+            # States (BN running stats) stay f32: bf16 ulp would
+            # swallow their (1-decay)*delta updates.
+            from deeplearning4j_tpu.common.dtypes import cast_floats
+            cd = conf.compute_dtype
+            params = cast_floats(params, cd)
+            inputs = [cast_floats(x, cd) for x in inputs]
         acts: Dict[str, jnp.ndarray] = dict(zip(conf.network_inputs,
                                                 inputs))
         new_states: dict = {}
@@ -130,6 +139,11 @@ class ComputationGraph:
             else:
                 acts[name] = v.content.forward(xs, training=training)
                 new_states[name] = {}
+        if self.conf.compute_dtype:
+            from deeplearning4j_tpu.common.dtypes import cast_floats
+            for out in self.conf.network_outputs:
+                acts[out] = cast_floats(acts[out], self._dtype)
+            new_states = cast_floats(new_states, self._dtype)
         return acts, new_states
 
     # -- recurrent state lifecycle (mirrors MultiLayerNetwork) ----------
@@ -278,7 +292,7 @@ class ComputationGraph:
                              lmasks, jnp.asarray(self.iteration_count),
                              rng)
         self.states = self._strip_rnn_states(new_states)
-        self._score = float(loss)
+        self._score = loss          # device scalar; float() on read
         self.last_batch_size = int(inputs[0].shape[0])
         self.iteration_count += 1
         for lis in self.listeners:
@@ -309,7 +323,7 @@ class ComputationGraph:
                                  self.updater_states, seg_in, seg_lab,
                                  seg_f, seg_l,
                                  jnp.asarray(self.iteration_count), rng)
-            self._score = float(loss)
+            self._score = loss          # device scalar; float() on read
             self.iteration_count += 1
         self.states = self._strip_rnn_states(states)
         self.last_batch_size = int(inputs[0].shape[0])
@@ -363,7 +377,7 @@ class ComputationGraph:
 
     def score(self, dataset=None) -> float:
         if dataset is None:
-            return self._score
+            return float(self._score)
         feats = dataset.features if isinstance(dataset.features, list) \
             else [dataset.features]
         labs = dataset.labels if isinstance(dataset.labels, list) \

@@ -111,6 +111,18 @@ class MultiLayerNetwork:
         passed to layers that accept one (recurrent/pooling).
         Returns (out, new_states)."""
         conf = self.conf
+        if conf.compute_dtype:
+            # mixed precision: compute in (usually) bfloat16, master
+            # params stay float32; the cast transposes to a cast-back,
+            # so gradients/updates remain float32 (SURVEY.md section 7
+            # "bfloat16 on the MXU" design stance). States (BN running
+            # stats) are NOT cast: their (1-decay)*delta updates would
+            # round to zero at bf16 ulp — normalization statistics stay
+            # f32, the standard mixed-precision rule.
+            from deeplearning4j_tpu.common.dtypes import cast_floats
+            cd = conf.compute_dtype
+            params = cast_floats(params, cd)
+            x = cast_floats(x, cd)
         new_states = {}
         h = x
         n = len(conf.layers)
@@ -137,6 +149,10 @@ class MultiLayerNetwork:
                 h, ns = layer.forward(lp, h, training=training, rng=lrng,
                                       state=ls or None, **kw)
             new_states[f"layer_{i}"] = ns if ns is not None else {}
+        if conf.compute_dtype:
+            from deeplearning4j_tpu.common.dtypes import cast_floats
+            h = cast_floats(h, self._dtype)          # f32 loss/output
+            new_states = cast_floats(new_states, self._dtype)
         return h, new_states
 
     def _recurrent_keys(self):
@@ -272,7 +288,7 @@ class MultiLayerNetwork:
         # standard BPTT: recurrent state resets every minibatch
         # (reference: fit() clears rnn state); BN stats persist
         self.states = self._strip_rnn_states(new_states)
-        self._score = float(loss)
+        self._score = loss          # device scalar; float() on read
         self.last_batch_size = int(x.shape[0])
         self.iteration_count += 1
         for lis in self.listeners:
@@ -300,7 +316,7 @@ class MultiLayerNetwork:
                                  self.updater_states, seg_x, seg_y,
                                  seg(fmask, t0), seg(lmask, t0),
                                  jnp.asarray(self.iteration_count), rng)
-            self._score = float(loss)
+            self._score = loss          # device scalar; float() on read
             self.iteration_count += 1
         self.states = self._strip_rnn_states(states)
         self.last_batch_size = int(x.shape[0])
@@ -371,13 +387,21 @@ class MultiLayerNetwork:
         if not self._initialized:
             self.init()
         x = _as_jnp(x, self._dtype)
+        params = self.params
+        if self.conf.compute_dtype:
+            # same dtype path as fit()/output() — per-layer activations
+            # must match what the trained/predicted path computes
+            from deeplearning4j_tpu.common.dtypes import cast_floats
+            cd = self.conf.compute_dtype
+            params = cast_floats(params, cd)
+            x = cast_floats(x, cd)
         acts = [x]
         h = x
         rng = None
         for i, layer in enumerate(self.conf.layers):
             if i in self.conf.input_preprocessors:
                 h = self.conf.input_preprocessors[i].pre_process(h)
-            h, _ = layer.forward(self.params.get(f"layer_{i}", {}), h,
+            h, _ = layer.forward(params.get(f"layer_{i}", {}), h,
                                  training=train, rng=rng,
                                  state=self.states.get(f"layer_{i}") or
                                  None)
@@ -391,7 +415,7 @@ class MultiLayerNetwork:
     def score(self, dataset=None) -> float:
         """Latest minibatch score, or score of a given DataSet."""
         if dataset is None:
-            return self._score
+            return float(self._score)
         x = _as_jnp(dataset.features, self._dtype)
         y = _as_jnp(dataset.labels, self._dtype)
         mask = getattr(dataset, "labels_mask", None)

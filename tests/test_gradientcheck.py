@@ -1,0 +1,135 @@
+"""Network-level gradient checks (SURVEY.md §4.5:
+GradientCheckUtil + GradientCheckTests / CNNGradientCheckTest /
+LSTMGradientCheckTests)."""
+import numpy as np
+
+from deeplearning4j_tpu.activations import Activation
+from deeplearning4j_tpu.datasets.dataset import DataSet
+from deeplearning4j_tpu.learning import Sgd
+from deeplearning4j_tpu.lossfunctions import LossFunction
+from deeplearning4j_tpu.nn import (InputType, MultiLayerNetwork,
+                                   NeuralNetConfiguration)
+from deeplearning4j_tpu.nn.conf.graph_vertices import ElementWiseVertex
+from deeplearning4j_tpu.nn.conf.layers import (BatchNormalization,
+                                               ConvolutionLayer,
+                                               DenseLayer, OutputLayer,
+                                               RnnOutputLayer,
+                                               SubsamplingLayer)
+from deeplearning4j_tpu.nn.conf.layers_recurrent import LSTM
+from deeplearning4j_tpu.nn.graph import ComputationGraph
+from deeplearning4j_tpu.utils.gradientcheck import GradientCheckUtil
+
+
+def _base():
+    return (NeuralNetConfiguration.Builder().seed(3)
+            .updater(Sgd(1e-2)))
+
+
+class TestGradientChecks:
+    def test_mlp(self):
+        conf = (_base().l2(1e-4).list()
+                .layer(DenseLayer(n_out=10,
+                                  activation=Activation.TANH))
+                .layer(DenseLayer(n_out=8,
+                                  activation=Activation.SIGMOID))
+                .layer(OutputLayer(n_out=3,
+                                   activation=Activation.SOFTMAX,
+                                   loss_function=LossFunction.MCXENT))
+                .set_input_type(InputType.feed_forward(5)).build())
+        net = MultiLayerNetwork(conf).init()
+        rng = np.random.RandomState(0)
+        ds = DataSet(rng.randn(6, 5).astype(np.float32),
+                     np.eye(3, dtype=np.float32)[rng.randint(0, 3, 6)])
+        assert GradientCheckUtil.check_gradients(net, ds)
+
+    def test_cnn_with_bn(self):
+        conf = (_base().list()
+                .layer(ConvolutionLayer(kernel_size=(3, 3), n_out=4,
+                                        activation=Activation.IDENTITY))
+                .layer(BatchNormalization(
+                    activation=Activation.TANH))
+                .layer(SubsamplingLayer(kernel_size=(2, 2),
+                                        stride=(2, 2)))
+                .layer(OutputLayer(n_out=2,
+                                   activation=Activation.SOFTMAX,
+                                   loss_function=LossFunction.MCXENT))
+                .set_input_type(InputType.convolutional(8, 8, 1))
+                .build())
+        net = MultiLayerNetwork(conf).init()
+        rng = np.random.RandomState(1)
+        ds = DataSet(rng.randn(4, 8, 8, 1).astype(np.float32),
+                     np.eye(2, dtype=np.float32)[rng.randint(0, 2, 4)])
+        assert GradientCheckUtil.check_gradients(net, ds)
+
+    def test_lstm(self):
+        conf = (_base().list()
+                .layer(LSTM(n_out=6, activation=Activation.TANH))
+                .layer(RnnOutputLayer(
+                    n_out=2, activation=Activation.SOFTMAX,
+                    loss_function=LossFunction.MCXENT))
+                .set_input_type(InputType.recurrent(3, 7)).build())
+        net = MultiLayerNetwork(conf).init()
+        rng = np.random.RandomState(2)
+        ds = DataSet(
+            rng.randn(3, 7, 3).astype(np.float32),
+            np.eye(2, dtype=np.float32)[
+                rng.randint(0, 2, (3, 7))].astype(np.float32))
+        assert GradientCheckUtil.check_gradients(net, ds)
+
+    def test_graph_residual(self):
+        g = (_base().graph_builder().add_inputs("in")
+             .set_input_types(InputType.feed_forward(6)))
+        g.add_layer("d1", DenseLayer(n_out=6,
+                                     activation=Activation.TANH), "in")
+        g.add_layer("d2", DenseLayer(n_out=6,
+                                     activation=Activation.TANH), "d1")
+        g.add_vertex("add", ElementWiseVertex(ElementWiseVertex.Op.Add),
+                     "d1", "d2")
+        g.add_layer("out", OutputLayer(
+            n_out=2, activation=Activation.SOFTMAX,
+            loss_function=LossFunction.MCXENT), "add")
+        net = ComputationGraph(g.set_outputs("out").build()).init()
+        rng = np.random.RandomState(4)
+        ds = DataSet(rng.randn(5, 6).astype(np.float32),
+                     np.eye(2, dtype=np.float32)[rng.randint(0, 2, 5)])
+        assert GradientCheckUtil.check_gradients(net, ds)
+
+    def test_detects_broken_gradient(self):
+        """Sanity: a wrong analytic gradient MUST fail the check."""
+        conf = (_base().list()
+                .layer(DenseLayer(n_out=4,
+                                  activation=Activation.TANH))
+                .layer(OutputLayer(n_out=2,
+                                   activation=Activation.SOFTMAX,
+                                   loss_function=LossFunction.MCXENT))
+                .set_input_type(InputType.feed_forward(3)).build())
+        net = MultiLayerNetwork(conf).init()
+        rng = np.random.RandomState(5)
+        ds = DataSet(rng.randn(4, 3).astype(np.float32),
+                     np.eye(2, dtype=np.float32)[rng.randint(0, 2, 4)])
+
+        assert GradientCheckUtil.check_gradients(net, ds)
+
+        # corrupt the ANALYTIC side only (scale grads by 1.5): the
+        # checker must notice the disagreement with the numeric side
+        import jax
+        import deeplearning4j_tpu.utils.gradientcheck as gc
+        loss_fn = gc._net_loss_fn(net, ds)
+        real_grad = jax.grad(loss_fn)
+        with _patched(gc.jax, "grad", lambda f: (
+                lambda p: jax.tree_util.tree_map(
+                    lambda a: a * 1.5, real_grad(p)))):
+            assert not GradientCheckUtil.check_gradients(net, ds)
+
+
+class _patched:
+    def __init__(self, obj, name, value):
+        self.obj, self.name, self.value = obj, name, value
+
+    def __enter__(self):
+        self._old = getattr(self.obj, self.name)
+        setattr(self.obj, self.name, self.value)
+
+    def __exit__(self, *a):
+        setattr(self.obj, self.name, self._old)
+        return False

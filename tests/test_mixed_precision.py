@@ -1,0 +1,83 @@
+"""Mixed precision (compute_dtype='bfloat16'): bf16 math, f32 master
+params — SURVEY.md §7 design stance ("bfloat16 on the MXU")."""
+import jax.numpy as jnp
+import numpy as np
+
+from deeplearning4j_tpu.activations import Activation
+from deeplearning4j_tpu.datasets.dataset import DataSet
+from deeplearning4j_tpu.learning import Adam
+from deeplearning4j_tpu.lossfunctions import LossFunction
+from deeplearning4j_tpu.nn import (InputType, MultiLayerNetwork,
+                                   NeuralNetConfiguration)
+from deeplearning4j_tpu.nn.conf.builders import MultiLayerConfiguration
+from deeplearning4j_tpu.nn.conf.layers import (BatchNormalization,
+                                               ConvolutionLayer,
+                                               DenseLayer, OutputLayer)
+
+
+def _conf(compute_dtype=None):
+    return (NeuralNetConfiguration.Builder().seed(7).updater(Adam(2e-2))
+            .compute_data_type(compute_dtype)
+            .list()
+            .layer(ConvolutionLayer(kernel_size=(3, 3), n_out=8))
+            .layer(BatchNormalization(activation=Activation.RELU))
+            .layer(DenseLayer(n_out=16, activation=Activation.RELU))
+            .layer(OutputLayer(n_out=3,
+                               activation=Activation.SOFTMAX,
+                               loss_function=LossFunction.MCXENT))
+            .set_input_type(InputType.convolutional(8, 8, 1))
+            .build())
+
+
+def _data(n=32, seed=0):
+    rng = np.random.RandomState(seed)
+    x = rng.randn(n, 8, 8, 1).astype(np.float32)
+    y = np.eye(3, dtype=np.float32)[rng.randint(0, 3, n)]
+    return DataSet(x, y)
+
+
+class TestMixedPrecision:
+    def test_master_params_stay_f32_and_loss_decreases(self):
+        net = MultiLayerNetwork(_conf("bfloat16")).init()
+        ds = _data()
+        losses = []
+        for _ in range(15):
+            net.fit(ds)
+            losses.append(float(net.score()))
+        for leaf in [v for d in net.params.values() for v in d.values()]:
+            assert leaf.dtype == jnp.float32, leaf.dtype
+        assert np.isfinite(losses).all()
+        assert losses[-1] < losses[0] - 0.1, losses
+
+    def test_output_is_f32(self):
+        net = MultiLayerNetwork(_conf("bfloat16")).init()
+        out = net.output(np.asarray(_data(4).features))
+        assert np.asarray(out).dtype == np.float32
+
+    def test_tracks_f32_training_closely(self):
+        """bf16 and f32 runs agree on early-training loss to bf16
+        tolerance (same seed, same data)."""
+        ds = _data(64, seed=1)
+        runs = {}
+        for cd in (None, "bfloat16"):
+            net = MultiLayerNetwork(_conf(cd)).init()
+            for _ in range(5):
+                net.fit(ds)
+            runs[cd] = float(net.score())
+        assert abs(runs[None] - runs["bfloat16"]) < 0.15, runs
+
+    def test_json_roundtrip_keeps_compute_dtype(self):
+        conf = _conf("bfloat16")
+        again = MultiLayerConfiguration.from_json(conf.to_json())
+        assert again.compute_dtype == "bfloat16"
+        assert MultiLayerConfiguration.from_json(
+            _conf(None).to_json()).compute_dtype is None
+
+    def test_device_resident_dataset_not_copied_to_host(self):
+        import jax
+        x = jax.device_put(jnp.zeros((4, 8, 8, 1), jnp.float32))
+        y = jax.device_put(jnp.eye(3, dtype=jnp.float32)[
+            jnp.asarray([0, 1, 2, 0])])
+        ds = DataSet(x, y)
+        assert ds.features is x       # no host round-trip
+        assert ds.labels is y
