@@ -1124,3 +1124,74 @@ def _index(ins, attrs):
 @op("identity", "transform")
 def _identity_op(ins, attrs):
     return ins[0]
+
+
+# -- control flow (SURVEY.md S3 / Appendix A: while/cond/merge/switch) ------
+# These ops carry TRACED SUBGRAPHS in their attrs (callables built by
+# SameDiff.while_loop/cond/scan from child graphs) and lower to
+# lax.while_loop / lax.cond / lax.scan — the XLA-native control flow
+# the reference's TF-style Enter/Exit/Merge/Switch frames compile to.
+@op("while_loop", "control")
+def _while_loop(ins, attrs):
+    cond = attrs["_cond_call"]
+    body = attrs["_body_call"]
+
+    def c(carry):
+        return jnp.squeeze(cond(*carry)[0]).astype(bool)
+
+    def b(carry):
+        return tuple(body(*carry))
+
+    out = lax.while_loop(c, b, tuple(ins))
+    return out if len(out) > 1 else out[0]
+
+
+@op("cond", "control")
+def _cond(ins, attrs):
+    true_call = attrs["_true_call"]
+    false_call = attrs["_false_call"]
+    pred = jnp.squeeze(ins[0]).astype(bool)
+    out = lax.cond(pred,
+                   lambda ops: tuple(true_call(*ops)),
+                   lambda ops: tuple(false_call(*ops)),
+                   tuple(ins[1:]))
+    return out if len(out) > 1 else out[0]
+
+
+@op("scan", "control")
+def _scan(ins, attrs):
+    body = attrs["_body_call"]
+    n_carry = attrs["n_carry"]
+    carry0 = tuple(ins[:n_carry])
+    xs = tuple(ins[n_carry:])
+
+    def b(carry, x):
+        step_args = () if x is None else tuple(x)
+        res = body(*carry, *step_args)
+        return tuple(res[:n_carry]), tuple(res[n_carry:])
+
+    carry, ys = lax.scan(b, carry0, xs if xs else None,
+                         length=attrs.get("length"))
+    out = tuple(carry) + tuple(ys)
+    return out if len(out) > 1 else out[0]
+
+
+# TF-graph-style primitives, select-lowered: XLA computes BOTH
+# branches and merge selects by the predicate (no dead-branch
+# pruning — which is how GSPMD treats data-dependent branches anyway).
+# switch(data, pred) -> (false_out, true_out): both carry the data so
+# arbitrary (non-zero-preserving) ops can follow on either branch;
+# merge(false_val, true_val, pred) selects the live one.
+@op("switch", "control")
+def _switch(ins, attrs):
+    data, _pred = ins
+    return (data, data)
+
+
+@op("merge", "control")
+def _merge(ins, attrs):
+    if len(ins) != 3:
+        raise ValueError("merge expects (false_val, true_val, pred)")
+    false_val, true_val, pred = ins
+    p = jnp.squeeze(pred).astype(bool)
+    return jnp.where(p, true_val, false_val)

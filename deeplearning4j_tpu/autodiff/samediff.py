@@ -446,6 +446,96 @@ class SameDiff:
         res = jfn(var_vals, ph_vals, rng)
         return {n: np.asarray(r) for n, r in zip(outputs, res)}
 
+    # -- control flow (SURVEY.md S3 / Appendix A) ----------------------
+    def _trace_subgraph(self, fn, n_args: int):
+        """Trace a python-function subgraph into a CHILD SameDiff and
+        return (callable, n_outputs). The callable replays the child
+        graph on traced values — so while/cond/scan bodies lower into
+        the parent's XLA program as lax control flow.
+
+        Child-graph VARIABLES are frozen into the closure as constants
+        (loop bodies can't own trainable state; thread it through the
+        carry instead)."""
+        child = SameDiff()
+        proxies = [child.placeholder(f"_arg{i}", shape=None)
+                   for i in range(n_args)]
+        res = fn(*proxies) if n_args else fn()
+        outs = list(res) if isinstance(res, (list, tuple)) else [res]
+        outs = [o if isinstance(o, SDVariable) else child._as_var(o)
+                for o in outs]
+        out_names = [o.name for o in outs]
+        proxy_names = [p.name for p in proxies]
+        idxs = child._ancestors(out_names)
+        parent = self
+
+        def call(*args):
+            # closure capture: subgraph bodies may reference PARENT
+            # constants/variables (read at trace time, like lax
+            # closures capture values — variable updates appear on
+            # the next compile); parent placeholders can't be
+            # captured — thread those through the loop args instead
+            values = dict(parent._arrays)
+            values.update(child._arrays)
+            values.update(zip(proxy_names, args))
+            child._execute(values, idxs, None, False)
+            return [values[n] for n in out_names]
+
+        return call, len(out_names)
+
+    def while_loop(self, loop_vars: Sequence, cond_fn, body_fn,
+                   name: Optional[str] = None):
+        """``lax.while_loop`` over the graph (reference: SameDiff
+        whileLoop / TF-import Enter..Exit frames). ``cond_fn`` maps
+        the loop vars to a scalar boolean; ``body_fn`` returns updated
+        loop vars (same count/shapes). Forward-only (XLA while is not
+        reverse-differentiable; use :meth:`scan` for trainable loops).
+        """
+        loop_vars = [self._as_var(v) for v in loop_vars]
+        n = len(loop_vars)
+        cond_call, _ = self._trace_subgraph(cond_fn, n)
+        body_call, n_body = self._trace_subgraph(body_fn, n)
+        if n_body != n:
+            raise ValueError(f"while_loop body returned {n_body} vars "
+                             f"for {n} loop vars")
+        return self._op("while_loop", loop_vars,
+                        {"_cond_call": cond_call,
+                         "_body_call": body_call},
+                        name=name, n_out=n)
+
+    def cond(self, pred, true_fn, false_fn, operands: Sequence = (),
+             name: Optional[str] = None):
+        """``lax.cond`` (reference: TF-import Switch/Merge pairs).
+        Both branches take ``operands`` and must return the same
+        number of outputs. Differentiable."""
+        operands = [self._as_var(v) for v in operands]
+        t_call, nt = self._trace_subgraph(true_fn, len(operands))
+        f_call, nf = self._trace_subgraph(false_fn, len(operands))
+        if nt != nf:
+            raise ValueError(f"cond branches disagree: {nt} vs {nf} "
+                             f"outputs")
+        return self._op("cond", [self._as_var(pred)] + operands,
+                        {"_true_call": t_call, "_false_call": f_call},
+                        name=name, n_out=nt)
+
+    def scan(self, body_fn, init: Sequence, xs: Sequence = (),
+             length: Optional[int] = None,
+             name: Optional[str] = None):
+        """``lax.scan``: ``body_fn(*carry, *x_slices) -> (new_carry...,
+        y_outputs...)``. Returns final carries followed by stacked
+        per-step outputs. Differentiable — the trainable-loop form
+        (reference tBPTT-style loops compile to this)."""
+        init = [self._as_var(v) for v in init]
+        xs = [self._as_var(v) for v in xs]
+        body_call, n_total = self._trace_subgraph(
+            body_fn, len(init) + len(xs))
+        if n_total < len(init):
+            raise ValueError("scan body must return at least the "
+                             "carry")
+        return self._op("scan", init + xs,
+                        {"_body_call": body_call,
+                         "n_carry": len(init), "length": length},
+                        name=name, n_out=n_total)
+
     def batch_output(self):
         """Fluent executor (reference: sd.batchOutput())."""
         sd = self

@@ -16,8 +16,14 @@ from .tokenization import (BertWordPieceTokenizer, DefaultTokenizer,
 from .vocab import VocabCache, build_vocab
 from .word2vec import ParagraphVectors, SequenceVectors, Word2Vec
 from .bert_iterator import BertIterator
+from .serializer import (StaticWordVectors, read_word2vec_model,
+                         read_word_vectors, write_word2vec_model,
+                         write_word_vectors)
 
 __all__ = ["DefaultTokenizer", "DefaultTokenizerFactory",
            "CommonPreprocessor", "BertWordPieceTokenizer",
            "VocabCache", "build_vocab", "Word2Vec", "SequenceVectors",
-           "ParagraphVectors", "BertIterator"]
+           "ParagraphVectors", "BertIterator",
+           "write_word_vectors", "read_word_vectors",
+           "write_word2vec_model", "read_word2vec_model",
+           "StaticWordVectors"]

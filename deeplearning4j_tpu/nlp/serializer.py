@@ -1,0 +1,113 @@
+"""Word-vector persistence (reference: `org.deeplearning4j.models.
+embeddings.loader.WordVectorSerializer` — SURVEY.md D16).
+
+Two formats:
+- ``.txt``: the classic word2vec text format (``word v1 v2 ...`` per
+  line, optional count header) — interoperable with gensim/fastText
+  text exports;
+- ``.npz``: compact binary (words + matrix [+ syn1 + counts]) for
+  exact round-trips including the trainable state.
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+
+def write_word_vectors(model, path: str, include_header: bool = True):
+    """Text format from any model with ``vocab`` + ``syn0``."""
+    words = model.vocab.words
+    vecs = model.syn0
+    with open(path, "w", encoding="utf-8") as f:
+        if include_header:
+            f.write(f"{len(words)} {vecs.shape[1]}\n")
+        for i, w in enumerate(words):
+            f.write(w + " " + " ".join("%.6g" % v for v in vecs[i])
+                    + "\n")
+    return path
+
+
+def read_word_vectors(path: str):
+    """Text format -> StaticWordVectors (lookup-only model)."""
+    words, rows = [], []
+    with open(path, encoding="utf-8") as f:
+        first = f.readline()
+        parts = first.rstrip("\n").split(" ")
+        if len(parts) == 2 and all(p.isdigit() for p in parts):
+            pass                      # header line; skip
+        else:
+            words.append(parts[0])
+            rows.append([float(v) for v in parts[1:]])
+        for line in f:
+            parts = line.rstrip("\n").split(" ")
+            if len(parts) < 2:
+                continue
+            words.append(parts[0])
+            rows.append([float(v) for v in parts[1:]])
+    return StaticWordVectors(words, np.asarray(rows, np.float32))
+
+
+def write_word2vec_model(model, path: str):
+    """Full binary round-trip incl. output weights + counts
+    (reference: writeWord2VecModel)."""
+    payload = dict(
+        words=np.asarray(model.vocab.words, dtype=object),
+        counts=np.asarray([model.vocab.counts[w]
+                           for w in model.vocab.words], np.int64),
+        syn0=model.syn0,
+        syn1=model.syn1 if model.syn1 is not None else np.zeros(0),
+        layer_size=np.int64(model.layer_size))
+    if str(path).endswith(".npz"):
+        np.savez_compressed(path, **payload)
+    else:
+        # np.savez_compressed appends '.npz' to bare paths; write to
+        # a handle so the caller's path is exactly what exists
+        with open(path, "wb") as fh:
+            np.savez_compressed(fh, **payload)
+    return path
+
+
+def read_word2vec_model(path: str):
+    """-> Word2Vec with vocab/tables restored (resumable training)."""
+    from .vocab import VocabCache
+    from .word2vec import Word2Vec
+    z = np.load(path, allow_pickle=True)
+    words = [str(w) for w in z["words"]]
+    counts = dict(zip(words, (int(c) for c in z["counts"])))
+    w2v = Word2Vec(layer_size=int(z["layer_size"]))
+    w2v.vocab = VocabCache(words, counts)
+    w2v.syn0 = z["syn0"].astype(np.float32)
+    syn1 = z["syn1"].astype(np.float32)
+    w2v.syn1 = syn1 if syn1.size else None
+    return w2v
+
+
+class StaticWordVectors:
+    """Lookup-only word vectors (reference: StaticWord2Vec /
+    WordVectors interface)."""
+
+    def __init__(self, words, matrix: np.ndarray):
+        self.words = list(words)
+        self.index = {w: i for i, w in enumerate(self.words)}
+        self.syn0 = matrix
+
+    def has_word(self, w) -> bool:
+        return w in self.index
+
+    def get_word_vector(self, w) -> np.ndarray:
+        return self.syn0[self.index[w]]
+
+    def similarity(self, a, b) -> float:
+        va, vb = self.get_word_vector(a), self.get_word_vector(b)
+        return float(va @ vb / (np.linalg.norm(va)
+                                * np.linalg.norm(vb) + 1e-12))
+
+    def words_nearest(self, word, n: int = 10):
+        v = self.get_word_vector(word)
+        sims = (self.syn0 @ v) / (
+            np.linalg.norm(self.syn0, axis=1)
+            * np.linalg.norm(v) + 1e-12)
+        order = np.argsort(-sims)
+        return [self.words[i] for i in order
+                if self.words[i] != word][:n]

@@ -36,9 +36,16 @@ class FineTuneConfiguration:
     seed: Optional[int] = None
 
     def apply_to(self, conf: MultiLayerConfiguration):
+        self._apply(conf, conf.layers)
+
+    def apply_to_graph(self, conf):
+        self._apply(conf, [v.content for v in conf.vertices.values()
+                           if v.is_layer])
+
+    def _apply(self, conf, layers):
         if self.updater is not None:
             conf.updater = self.updater
-            for layer in conf.layers:
+            for layer in layers:
                 if layer.updater is not None and \
                         not isinstance(layer.updater, NoOp):
                     layer.updater = None   # net-level updater wins
@@ -51,6 +58,112 @@ class FineTuneConfiguration:
 
 
 class TransferLearning:
+    class GraphBuilder:
+        """Transfer learning for ComputationGraph (reference:
+        TransferLearning.GraphBuilder): freeze a feature-extractor
+        subgraph, remove vertices, append new layers/vertices, keep
+        trained weights of retained vertices."""
+
+        def __init__(self, net):
+            if not net._initialized:
+                raise ValueError("source graph must be initialized")
+            self._net = net
+            self._conf = copy.deepcopy(net.conf)
+            self._removed = set()
+            self._added = []          # (name, content, inputs)
+            self._freeze_until: Optional[str] = None
+            self._fine_tune: Optional[FineTuneConfiguration] = None
+            self._outputs: Optional[List[str]] = None
+
+        def fine_tune_configuration(self, ftc: FineTuneConfiguration):
+            self._fine_tune = ftc
+            return self
+
+        def set_feature_extractor(self, vertex_name: str):
+            """Freeze ``vertex_name`` and every ancestor vertex."""
+            self._freeze_until = vertex_name
+            return self
+
+        def remove_vertex_and_connections(self, name: str):
+            """Drop a vertex and everything downstream of it."""
+            conf = self._conf
+            dead = {name}
+            changed = True
+            while changed:
+                changed = False
+                for v in conf.vertices.values():
+                    if v.name not in dead and \
+                            any(i in dead for i in v.inputs):
+                        dead.add(v.name)
+                        changed = True
+            self._removed |= dead
+            return self
+
+        def add_layer(self, name: str, layer, *inputs: str):
+            self._added.append((name, layer, list(inputs), True))
+            return self
+
+        def add_vertex(self, name: str, vertex, *inputs: str):
+            self._added.append((name, vertex, list(inputs), False))
+            return self
+
+        def set_outputs(self, *names: str):
+            self._outputs = list(names)
+            return self
+
+        def build(self):
+            from .graph import ComputationGraph
+            from .conf.graph_conf import VertexDef
+            conf = self._conf
+            for name in self._removed:
+                conf.vertices.pop(name, None)
+            conf.network_outputs = [o for o in conf.network_outputs
+                                    if o not in self._removed]
+            for name, content, inputs, _is_layer in self._added:
+                conf.vertices[name] = VertexDef(name, content, inputs)
+            if self._outputs is not None:
+                conf.network_outputs = list(self._outputs)
+
+            if self._fine_tune is not None:
+                self._fine_tune.apply_to_graph(conf)
+
+            frozen = set()
+            if self._freeze_until is not None:
+                stack = [self._freeze_until]
+                while stack:
+                    n = stack.pop()
+                    if n in frozen or n in conf.network_inputs:
+                        continue
+                    frozen.add(n)
+                    v = conf.vertices.get(n)
+                    if v is not None:
+                        stack.extend(v.inputs)
+                for n in frozen:
+                    v = conf.vertices.get(n)
+                    if v is not None and v.is_layer:
+                        v.content.updater = NoOp()
+                        v.content.frozen = True
+
+            # shapes of new layers re-resolve from retained stack
+            if hasattr(conf, "_resolved_types"):
+                delattr(conf, "_resolved_types")
+            new = ComputationGraph(conf)
+            new._topo = conf.topo_order()
+            new.init()
+            added_names = {a[0] for a in self._added}
+            for name in conf.vertices:
+                if name in added_names:
+                    continue
+                old_p = self._net.params.get(name)
+                if old_p:
+                    new.params[name] = jax.tree_util.tree_map(
+                        lambda a: a, old_p)
+                old_s = self._net.states.get(name)
+                if old_s:
+                    new.states[name] = jax.tree_util.tree_map(
+                        lambda a: a, old_s)
+            return new
+
     class Builder:
         def __init__(self, net: MultiLayerNetwork):
             if not net._initialized:

@@ -91,6 +91,11 @@ class GradientCheckUtil:
         params64 = f64(net.params)
         states_save = net.states
         net.states = f64(net.states)
+        # mixed precision must be OFF for the check: _forward would
+        # cast the promoted f64 values back down to bf16, reducing the
+        # comparison to bf16 rounding noise
+        cd_save = net.conf.compute_dtype
+        net.conf.compute_dtype = None
         from deeplearning4j_tpu.parallel.mesh import map_dataset_arrays
 
         def to64(a):
@@ -136,6 +141,7 @@ class GradientCheckUtil:
                                          float(numeric), float(rel)))
         finally:
             net.states = states_save
+            net.conf.compute_dtype = cd_save
         if print_results or failures:
             print(f"GradientCheckUtil: {checked} params checked, "
                   f"{len(failures)} failures")

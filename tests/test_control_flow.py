@@ -1,0 +1,140 @@
+"""SameDiff control flow (SURVEY.md S3 / Appendix A: while/cond/
+switch/merge) lowering to lax.while_loop / lax.cond / lax.scan."""
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.autodiff.samediff import SameDiff
+
+
+class TestWhileLoop:
+    def test_iterative_doubling(self):
+        """double x until its sum exceeds 100 (data-dependent trip
+        count — the thing static graphs can't unroll)."""
+        sd = SameDiff()
+        x = sd.placeholder("x", shape=(4,))
+
+        out = sd.while_loop(
+            [x],
+            lambda v: v.sd._op("lt",
+                               [v.sd._op("reduce_sum", [v]),
+                                v.sd.constant(np.float32(100.0))]),
+            lambda v: v.sd._op("mul",
+                               [v, v.sd.constant(np.float32(2.0))]))
+        res = sd.output({"x": np.ones(4, np.float32)}, [out])
+        got = res[out.name]
+        # 4 -> 8 -> 16 -> 32 -> 64 -> 128 (stops when sum >= 100)
+        np.testing.assert_allclose(got, np.full(4, 32.0))
+
+    def test_multi_var(self):
+        sd = SameDiff()
+        i0 = sd.constant("i0", np.int32(0))
+        acc0 = sd.constant("acc0", np.float32(0.0))
+
+        outs = sd.while_loop(
+            [i0, acc0],
+            lambda i, a: i.sd._op("lt",
+                                  [i, i.sd.constant(np.int32(5))]),
+            lambda i, a: [
+                i.sd._op("add", [i, i.sd.constant(np.int32(1))]),
+                a.sd._op("add", [a, a.sd._op(
+                    "cast", [i], {"dtype": "float32"})])])
+        res = sd.output({}, list(outs))
+        assert res[outs[0].name] == 5
+        assert res[outs[1].name] == 0 + 1 + 2 + 3 + 4
+
+
+class TestCond:
+    @pytest.mark.parametrize("flag,want", [(1.0, 9.0), (0.0, -3.0)])
+    def test_branches(self, flag, want):
+        sd = SameDiff()
+        p = sd.placeholder("p", shape=())
+        x = sd.placeholder("x", shape=())
+        out = sd.cond(
+            p,
+            lambda v: v.sd._op("mul",
+                               [v, v.sd.constant(np.float32(3.0))]),
+            lambda v: v.sd._op("neg", [v]),
+            operands=[x])
+        res = sd.output({"p": np.float32(flag),
+                         "x": np.float32(3.0)}, [out])
+        assert float(res[out.name]) == want
+
+
+class TestScan:
+    def test_cumsum(self):
+        sd = SameDiff()
+        xs = sd.placeholder("xs", shape=(6,))
+        c0 = sd.constant("c0", np.float32(0.0))
+
+        outs = sd.scan(
+            lambda c, x: [c.sd._op("add", [c, x]),
+                          c.sd._op("add", [c, x])],
+            init=[c0], xs=[xs])
+        data = np.arange(1, 7, dtype=np.float32)
+        res = sd.output({"xs": data}, list(outs))
+        assert float(res[outs[0].name]) == data.sum()
+        np.testing.assert_allclose(res[outs[1].name],
+                                   np.cumsum(data))
+
+    def test_linear_rnn_unroll(self):
+        """A tiny recurrent cell as a scan: h' = tanh(h W + x)."""
+        rng = np.random.RandomState(0)
+        W = rng.randn(3, 3).astype(np.float32) * 0.5
+        xs_np = rng.randn(5, 3).astype(np.float32)
+
+        sd = SameDiff()
+        xs = sd.placeholder("xs", shape=(5, 3))
+        h0 = sd.constant("h0", np.zeros(3, np.float32))
+        Wc = sd.constant("W", W)
+
+        def cell(h, x):
+            z = h.sd._op("add", [h.sd._op("matmul", [h, Wc]), x])
+            hn = h.sd._op("tanh", [z])
+            return [hn, hn]
+
+        outs = sd.scan(cell, init=[h0], xs=[xs])
+        res = sd.output({"xs": xs_np}, list(outs))
+
+        h = np.zeros(3, np.float32)
+        hist = []
+        for t in range(5):
+            h = np.tanh(h @ W + xs_np[t])
+            hist.append(h)
+        np.testing.assert_allclose(res[outs[0].name], h, atol=1e-5)
+        np.testing.assert_allclose(res[outs[1].name],
+                                   np.stack(hist), atol=1e-5)
+
+
+class TestSwitchMerge:
+    def test_tf_style_switch_merge(self):
+        """switch -> per-branch ops -> merge(false, true, pred):
+        both branches computed, merge selects. Branch ops need NOT be
+        zero-preserving (the +10 below would corrupt a sum-merge)."""
+        sd = SameDiff()
+        x = sd.placeholder("x", shape=(3,))
+        p = sd.placeholder("p", shape=())
+        f_branch, t_branch = sd._op("switch", [x, p], n_out=2)
+        t_out = sd._op("mul", [t_branch,
+                               sd.constant(np.float32(2.0))])
+        f_out = sd._op("add", [f_branch,
+                               sd.constant(np.float32(10.0))])
+        merged = sd._op("merge", [f_out, t_out, p])
+        v = np.asarray([1.0, 2.0, 3.0], np.float32)
+        r1 = sd.output({"x": v, "p": np.float32(1.0)}, [merged])
+        np.testing.assert_allclose(r1[merged.name], v * 2)
+        r0 = sd.output({"x": v, "p": np.float32(0.0)}, [merged])
+        np.testing.assert_allclose(r0[merged.name], v + 10)
+
+    def test_scan_length_only(self):
+        """xs-less scan: fixed-trip loop driven by `length`."""
+        sd = SameDiff()
+        c0 = sd.constant("c0", np.float32(1.0))
+        outs = sd.scan(
+            lambda c: [c.sd._op("mul",
+                                [c, c.sd.constant(np.float32(2.0))]),
+                       c],
+            init=[c0], xs=(), length=5)
+        res = sd.output({}, list(outs))
+        assert float(res[outs[0].name]) == 32.0
+        np.testing.assert_allclose(res[outs[1].name],
+                                   [1, 2, 4, 8, 16])

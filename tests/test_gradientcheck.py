@@ -94,6 +94,23 @@ class TestGradientChecks:
                      np.eye(2, dtype=np.float32)[rng.randint(0, 2, 5)])
         assert GradientCheckUtil.check_gradients(net, ds)
 
+    def test_mixed_precision_net_checked_in_f64(self):
+        """compute_dtype must be suspended during the check — else
+        both sides reduce to bf16 rounding noise."""
+        conf = (_base().compute_data_type("bfloat16").list()
+                .layer(DenseLayer(n_out=6,
+                                  activation=Activation.TANH))
+                .layer(OutputLayer(n_out=2,
+                                   activation=Activation.SOFTMAX,
+                                   loss_function=LossFunction.MCXENT))
+                .set_input_type(InputType.feed_forward(4)).build())
+        net = MultiLayerNetwork(conf).init()
+        rng = np.random.RandomState(6)
+        ds = DataSet(rng.randn(5, 4).astype(np.float32),
+                     np.eye(2, dtype=np.float32)[rng.randint(0, 2, 5)])
+        assert GradientCheckUtil.check_gradients(net, ds)
+        assert net.conf.compute_dtype == "bfloat16"   # restored
+
     def test_detects_broken_gradient(self):
         """Sanity: a wrong analytic gradient MUST fail the check."""
         conf = (_base().list()

@@ -1,0 +1,119 @@
+"""ComputationGraph transfer learning + word-vector serialization."""
+import numpy as np
+
+from deeplearning4j_tpu.activations import Activation
+from deeplearning4j_tpu.datasets.dataset import DataSet
+from deeplearning4j_tpu.learning import Adam
+from deeplearning4j_tpu.learning.updaters import NoOp
+from deeplearning4j_tpu.lossfunctions import LossFunction
+from deeplearning4j_tpu.nn import (InputType, NeuralNetConfiguration)
+from deeplearning4j_tpu.nn.conf.layers import DenseLayer, OutputLayer
+from deeplearning4j_tpu.nn.graph import ComputationGraph
+from deeplearning4j_tpu.nn.transferlearning import (
+    FineTuneConfiguration, TransferLearning)
+from deeplearning4j_tpu.nlp import Word2Vec
+from deeplearning4j_tpu.nlp.serializer import (read_word2vec_model,
+                                               read_word_vectors,
+                                               write_word2vec_model,
+                                               write_word_vectors)
+
+
+def _graph(n_out=3):
+    g = (NeuralNetConfiguration.Builder().seed(5).updater(Adam(3e-2))
+         .graph_builder().add_inputs("in")
+         .set_input_types(InputType.feed_forward(4)))
+    g.add_layer("f1", DenseLayer(n_out=12,
+                                 activation=Activation.RELU), "in")
+    g.add_layer("f2", DenseLayer(n_out=8,
+                                 activation=Activation.RELU), "f1")
+    g.add_layer("out", OutputLayer(
+        n_out=n_out, activation=Activation.SOFTMAX,
+        loss_function=LossFunction.MCXENT), "f2")
+    return ComputationGraph(g.set_outputs("out").build()).init()
+
+
+def _blob_ds(n=120, k=3, seed=0):
+    rng = np.random.RandomState(seed)
+    y = rng.randint(0, k, n)
+    x = (np.eye(k, 4, dtype=np.float32)[y] * 2.5
+         + rng.randn(n, 4).astype(np.float32) * 0.4)
+    return DataSet(x, np.eye(k, dtype=np.float32)[y])
+
+
+class TestGraphTransferLearning:
+    def test_freeze_replace_head(self):
+        src = _graph()
+        src.fit(_blob_ds(), n_epochs=25)
+
+        new = (TransferLearning.GraphBuilder(src)
+               .fine_tune_configuration(
+                   FineTuneConfiguration(updater=Adam(5e-2)))
+               .set_feature_extractor("f2")
+               .remove_vertex_and_connections("out")
+               .add_layer("newout", OutputLayer(
+                   n_in=8, n_out=2, activation=Activation.SOFTMAX,
+                   loss_function=LossFunction.MCXENT), "f2")
+               .set_outputs("newout")
+               .build())
+        # retained weights copied; extractor frozen
+        np.testing.assert_array_equal(
+            np.asarray(src.params["f1"]["W"]),
+            np.asarray(new.params["f1"]["W"]))
+        assert isinstance(new.conf.vertices["f1"].content.updater,
+                          NoOp)
+        assert isinstance(new.conf.vertices["f2"].content.updater,
+                          NoOp)
+        assert "out" not in new.conf.vertices
+
+        w1 = np.asarray(new.params["f1"]["W"]).copy()
+        ds3 = _blob_ds(seed=2)
+        y2 = np.eye(2, dtype=np.float32)[
+            (np.asarray(ds3.labels).argmax(1) > 0).astype(int)]
+        ds2 = DataSet(ds3.features, y2)
+        new.fit(ds2, n_epochs=30)
+        np.testing.assert_array_equal(
+            w1, np.asarray(new.params["f1"]["W"]))
+        pred = np.asarray(new.output(ds2.features)).argmax(1)
+        acc = (pred == y2.argmax(1)).mean()
+        assert acc > 0.85, acc
+
+
+class TestWordVectorSerde:
+    def _model(self):
+        rng = np.random.RandomState(0)
+        corpus = [" ".join(rng.choice(["red", "green", "blue",
+                                       "cat", "dog"], 5))
+                  for _ in range(40)]
+        w2v = Word2Vec(layer_size=8, epochs=2, seed=1,
+                       learning_rate=0.003)
+        w2v.fit(corpus)
+        return w2v
+
+    def test_text_roundtrip(self, tmp_path):
+        w2v = self._model()
+        p = str(tmp_path / "vecs.txt")
+        write_word_vectors(w2v, p)
+        back = read_word_vectors(p)
+        for w in w2v.vocab.words:
+            assert back.has_word(w)
+            np.testing.assert_allclose(back.get_word_vector(w),
+                                       w2v.get_word_vector(w),
+                                       rtol=1e-4, atol=1e-5)
+        assert abs(back.similarity("cat", "dog")
+                   - w2v.similarity("cat", "dog")) < 1e-3
+
+    def test_binary_roundtrip_resumable(self, tmp_path):
+        w2v = self._model()
+        p = str(tmp_path / "model.npz")
+        write_word2vec_model(w2v, p)
+        back = read_word2vec_model(p)
+        np.testing.assert_array_equal(back.syn0, w2v.syn0)
+        np.testing.assert_array_equal(back.syn1, w2v.syn1)
+        assert back.vocab.words == w2v.vocab.words
+        assert back.vocab.counts == w2v.vocab.counts
+        # resumable: continue training without error
+        back.epochs = 1
+        back._train_pairs(
+            np.asarray([[0, 1], [1, 2]], np.int32),
+            len(back.vocab))
+        assert np.isfinite(back.syn0).all()
