@@ -29,7 +29,7 @@ def main():
     from deeplearning4j_tpu.models.zoo import ResNet50
 
     on_tpu = jax.devices()[0].platform == "tpu"
-    batch = 128 if on_tpu else 8
+    batch = 256 if on_tpu else 8     # 256 ≈ +15% over 128 on v5e
     hw = 224 if on_tpu else 64
 
     net = ResNet50(num_classes=1000, height=hw, width=hw,

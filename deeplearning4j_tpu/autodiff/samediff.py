@@ -214,6 +214,13 @@ class SameDiff:
         self.loss_variables: List[str] = []
         self.training_config = None
         self._updater_state = None
+        #: foreign-var captures (control-flow bodies closing over a
+        #: parent graph): local name -> (owner SameDiff, owner name)
+        self._captures: Dict[str, tuple] = {}
+        #: True when any subgraph captured this graph's VARIABLEs —
+        #: their values are baked per compile, so fit() must drop
+        #: compiled programs after updating them
+        self._captured_by_subgraph = False
         from deeplearning4j_tpu.autodiff.opsets import (SDBitwise, SDCNN,
                                                         SDImage, SDLinalg,
                                                         SDLoss, SDMath,
@@ -323,6 +330,8 @@ class SameDiff:
             attrs: Optional[dict] = None, name: Optional[str] = None,
             n_out: int = 1) -> Union[SDVariable, Tuple[SDVariable, ...]]:
         get_op(op_name)               # validate early
+        inputs = [self._import_foreign(v) if isinstance(v, SDVariable)
+                  and v.sd is not self else v for v in inputs]
         in_names = [v.name for v in inputs]
         if n_out == 1:
             out_names = [self._unique(name or op_name)]
@@ -346,6 +355,22 @@ class SameDiff:
         """Public escape hatch: call any registered op by name."""
         return self._op(op_name, [self._as_var(i) for i in inputs],
                         attrs, name, n_out)
+
+    def _import_foreign(self, v: "SDVariable") -> "SDVariable":
+        """A var of ANOTHER SameDiff used here (control-flow bodies
+        closing over parent vars): register it under a local capture
+        name so it can never collide with this graph's own names —
+        the subgraph runner resolves captures from the owner at call
+        time."""
+        for local, (sd, pname) in self._captures.items():
+            if sd is v.sd and pname == v.name:
+                return self.vars[local]
+        local = self._unique(f"_cap_{v.name}")
+        proxy = SDVariable(self, local, VariableType.PLACEHOLDER,
+                           v.shape, v.dtype)
+        self.vars[local] = proxy
+        self._captures[local] = (v.sd, v.name)
+        return proxy
 
 
     # -- execution -----------------------------------------------------
@@ -461,21 +486,30 @@ class SameDiff:
                    for i in range(n_args)]
         res = fn(*proxies) if n_args else fn()
         outs = list(res) if isinstance(res, (list, tuple)) else [res]
-        outs = [o if isinstance(o, SDVariable) else child._as_var(o)
+        outs = [(o if o.sd is child else child._import_foreign(o))
+                if isinstance(o, SDVariable) else child._as_var(o)
                 for o in outs]
         out_names = [o.name for o in outs]
         proxy_names = [p.name for p in proxies]
         idxs = child._ancestors(out_names)
-        parent = self
+        # closure capture: foreign vars the body referenced were
+        # registered under collision-proof local names (_import_foreign)
+        # mapping back to their owner graph. Values are read at trace
+        # time, like lax closures capture values; owners whose
+        # VARIABLEs are captured invalidate compiled programs on fit.
+        for local, (owner, pname) in child._captures.items():
+            if pname not in owner._arrays:
+                raise ValueError(
+                    f"control-flow body captured '{pname}', which has "
+                    f"no value (a placeholder?) — thread it through "
+                    f"the loop/branch arguments instead")
+            if owner.vars[pname].var_type is VariableType.VARIABLE:
+                owner._captured_by_subgraph = True
 
         def call(*args):
-            # closure capture: subgraph bodies may reference PARENT
-            # constants/variables (read at trace time, like lax
-            # closures capture values — variable updates appear on
-            # the next compile); parent placeholders can't be
-            # captured — thread those through the loop args instead
-            values = dict(parent._arrays)
-            values.update(child._arrays)
+            values = dict(child._arrays)
+            for local, (owner, pname) in child._captures.items():
+                values[local] = owner._arrays[pname]
             values.update(zip(proxy_names, args))
             child._execute(values, idxs, None, False)
             return [values[n] for n in out_names]
@@ -664,6 +698,11 @@ class SameDiff:
                     var_vals, self._updater_state, ph_vals,
                     jnp.asarray(iteration), rng)
                 self._arrays.update(new_vars)
+                if self._captured_by_subgraph:
+                    # control-flow subgraphs bake captured variable
+                    # values per compile — invalidate so the next
+                    # output()/fit trace sees the updated values
+                    self._exec_cache.clear()
                 epoch_losses.append(float(loss))
                 iteration += 1
             history.add_epoch(epoch, epoch_losses)

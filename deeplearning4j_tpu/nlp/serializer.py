@@ -32,15 +32,16 @@ def read_word_vectors(path: str):
     """Text format -> StaticWordVectors (lookup-only model)."""
     words, rows = [], []
     with open(path, encoding="utf-8") as f:
-        first = f.readline()
-        parts = first.rstrip("\n").split(" ")
-        if len(parts) == 2 and all(p.isdigit() for p in parts):
+        # .split() (not split(' ')) so CRLF endings and stray spaces
+        # from other tools' exports parse cleanly
+        first = f.readline().split()
+        if len(first) == 2 and all(p.isdigit() for p in first):
             pass                      # header line; skip
-        else:
-            words.append(parts[0])
-            rows.append([float(v) for v in parts[1:]])
+        elif first:
+            words.append(first[0])
+            rows.append([float(v) for v in first[1:]])
         for line in f:
-            parts = line.rstrip("\n").split(" ")
+            parts = line.split()
             if len(parts) < 2:
                 continue
             words.append(parts[0])
@@ -85,7 +86,8 @@ def read_word2vec_model(path: str):
 
 class StaticWordVectors:
     """Lookup-only word vectors (reference: StaticWord2Vec /
-    WordVectors interface)."""
+    WordVectors interface). Similarity math is shared with the
+    trainable models via :mod:`.vocab` helpers."""
 
     def __init__(self, words, matrix: np.ndarray):
         self.words = list(words)
@@ -99,15 +101,12 @@ class StaticWordVectors:
         return self.syn0[self.index[w]]
 
     def similarity(self, a, b) -> float:
-        va, vb = self.get_word_vector(a), self.get_word_vector(b)
-        return float(va @ vb / (np.linalg.norm(va)
-                                * np.linalg.norm(vb) + 1e-12))
+        from .vocab import cosine_similarity
+        return cosine_similarity(self.get_word_vector(a),
+                                 self.get_word_vector(b))
 
     def words_nearest(self, word, n: int = 10):
-        v = self.get_word_vector(word)
-        sims = (self.syn0 @ v) / (
-            np.linalg.norm(self.syn0, axis=1)
-            * np.linalg.norm(v) + 1e-12)
-        order = np.argsort(-sims)
-        return [self.words[i] for i in order
-                if self.words[i] != word][:n]
+        from .vocab import nearest_words
+        return nearest_words(self.syn0, self.words,
+                             self.get_word_vector(word), n,
+                             exclude=word)

@@ -50,6 +50,20 @@ class VocabCache:
         return self._neg_table
 
 
+def cosine_similarity(a: np.ndarray, b: np.ndarray) -> float:
+    return float(a @ b / (np.linalg.norm(a) * np.linalg.norm(b)
+                          + 1e-12))
+
+
+def nearest_words(matrix: np.ndarray, words, vec: np.ndarray,
+                  n: int, exclude=None):
+    """Top-n words by cosine similarity to ``vec``."""
+    sims = (matrix @ vec) / (np.linalg.norm(matrix, axis=1)
+                             * np.linalg.norm(vec) + 1e-12)
+    order = np.argsort(-sims)
+    return [words[i] for i in order if words[i] != exclude][:n]
+
+
 def build_vocab(token_seqs: Iterable[List[str]],
                 min_word_frequency: int = 1,
                 max_size: Optional[int] = None) -> VocabCache:

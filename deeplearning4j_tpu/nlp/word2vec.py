@@ -146,19 +146,15 @@ class SequenceVectors:
         return self.vocab is not None and w in self.vocab
 
     def similarity(self, a: str, b: str) -> float:
-        va, vb = self.get_word_vector(a), self.get_word_vector(b)
-        return float(va @ vb / (np.linalg.norm(va)
-                                * np.linalg.norm(vb) + 1e-12))
+        from .vocab import cosine_similarity
+        return cosine_similarity(self.get_word_vector(a),
+                                 self.get_word_vector(b))
 
     def words_nearest(self, word: str, n: int = 10) -> List[str]:
-        v = self.get_word_vector(word)
-        m = self.syn0
-        sims = (m @ v) / ((np.linalg.norm(m, axis=1)
-                           * np.linalg.norm(v)) + 1e-12)
-        order = np.argsort(-sims)
-        out = [self.vocab.word_at(i) for i in order
-               if self.vocab.word_at(i) != word]
-        return out[:n]
+        from .vocab import nearest_words
+        return nearest_words(self.syn0, self.vocab.words,
+                             self.get_word_vector(word), n,
+                             exclude=word)
 
 
 class Word2Vec(SequenceVectors):

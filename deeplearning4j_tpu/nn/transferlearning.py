@@ -70,7 +70,7 @@ class TransferLearning:
             self._net = net
             self._conf = copy.deepcopy(net.conf)
             self._removed = set()
-            self._added = []          # (name, content, inputs)
+            self._added = []          # (name, content, inputs) tuples
             self._freeze_until: Optional[str] = None
             self._fine_tune: Optional[FineTuneConfiguration] = None
             self._outputs: Optional[List[str]] = None
@@ -100,12 +100,12 @@ class TransferLearning:
             return self
 
         def add_layer(self, name: str, layer, *inputs: str):
-            self._added.append((name, layer, list(inputs), True))
+            # layer-vs-vertex is derived from the content type
+            # (VertexDef.is_layer); one append path serves both
+            self._added.append((name, layer, list(inputs)))
             return self
 
-        def add_vertex(self, name: str, vertex, *inputs: str):
-            self._added.append((name, vertex, list(inputs), False))
-            return self
+        add_vertex = add_layer
 
         def set_outputs(self, *names: str):
             self._outputs = list(names)
@@ -119,7 +119,7 @@ class TransferLearning:
                 conf.vertices.pop(name, None)
             conf.network_outputs = [o for o in conf.network_outputs
                                     if o not in self._removed]
-            for name, content, inputs, _is_layer in self._added:
+            for name, content, inputs in self._added:
                 conf.vertices[name] = VertexDef(name, content, inputs)
             if self._outputs is not None:
                 conf.network_outputs = list(self._outputs)
@@ -147,8 +147,7 @@ class TransferLearning:
             # shapes of new layers re-resolve from retained stack
             if hasattr(conf, "_resolved_types"):
                 delattr(conf, "_resolved_types")
-            new = ComputationGraph(conf)
-            new._topo = conf.topo_order()
+            new = ComputationGraph(conf)   # ctor topo-sorts conf
             new.init()
             added_names = {a[0] for a in self._added}
             for name in conf.vertices:

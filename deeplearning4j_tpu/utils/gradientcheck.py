@@ -90,20 +90,21 @@ class GradientCheckUtil:
             t)
         params64 = f64(net.params)
         states_save = net.states
-        net.states = f64(net.states)
-        # mixed precision must be OFF for the check: _forward would
-        # cast the promoted f64 values back down to bf16, reducing the
-        # comparison to bf16 rounding noise
         cd_save = net.conf.compute_dtype
-        net.conf.compute_dtype = None
-        from deeplearning4j_tpu.parallel.mesh import map_dataset_arrays
-
-        def to64(a):
-            a = np.asarray(a)
-            return a.astype(np.float64) if np.issubdtype(
-                a.dtype, np.floating) else a
-        ds = map_dataset_arrays(ds, to64)
         try:
+            net.states = f64(net.states)
+            # mixed precision must be OFF for the check: _forward
+            # would cast the promoted f64 values back down to bf16,
+            # reducing the comparison to bf16 rounding noise
+            net.conf.compute_dtype = None
+            from deeplearning4j_tpu.parallel.mesh import \
+                map_dataset_arrays
+
+            def to64(a):
+                a = np.asarray(a)
+                return a.astype(np.float64) if np.issubdtype(
+                    a.dtype, np.floating) else a
+            ds = map_dataset_arrays(ds, to64)
             loss_fn = _net_loss_fn(net, ds)
             analytic = jax.grad(loss_fn)(params64)
             rng = np.random.RandomState(seed)

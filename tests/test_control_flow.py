@@ -105,6 +105,38 @@ class TestScan:
                                    np.stack(hist), atol=1e-5)
 
 
+class TestCaptures:
+    def test_parent_capture_no_name_shadowing(self):
+        """A body that closes over a parent constant AND makes its own
+        same-auto-named constant must keep them distinct (regression:
+        child 'const' used to shadow parent 'const')."""
+        sd = SameDiff()
+        outer = sd.constant(np.float32(2.0))     # auto-named 'const'
+        x = sd.placeholder("x", shape=())
+
+        def branch(v):
+            inner = v.sd.constant(np.float32(5.0))  # child 'const'
+            return v.sd._op("add",
+                            [v.sd._op("mul", [v, outer]), inner])
+
+        out = sd.cond(sd.constant(np.float32(1.0)), branch,
+                      lambda v: v, operands=[x])
+        res = sd.output({"x": np.float32(3.0)}, [out])
+        assert float(res[out.name]) == 3.0 * 2.0 + 5.0
+
+    def test_capturing_placeholder_errors_clearly(self):
+        sd = SameDiff()
+        ph = sd.placeholder("p", shape=())
+        c0 = sd.constant(np.float32(0.0))
+        with pytest.raises(ValueError, match="thread it through"):
+            sd.while_loop(
+                [c0],
+                lambda v: v.sd._op("lt", [v, ph]),
+                lambda v: v.sd._op("add",
+                                   [v, v.sd.constant(
+                                       np.float32(1.0))]))
+
+
 class TestSwitchMerge:
     def test_tf_style_switch_merge(self):
         """switch -> per-branch ops -> merge(false, true, pred):
