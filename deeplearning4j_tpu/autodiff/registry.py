@@ -1135,14 +1135,22 @@ def _identity_op(ins, attrs):
 def _while_loop(ins, attrs):
     cond = attrs["_cond_call"]
     body = attrs["_body_call"]
+    n = attrs.get("n_loop", len(ins))
+    ncc = attrs.get("n_cond_caps", 0)
+    loop0 = tuple(ins[:n])
+    # while_loop is forward-only (XLA while has no reverse rule), so
+    # captured values must not carry gradients into it — a captured
+    # trainable stays live in value but contributes no while-grads
+    cond_caps = tuple(lax.stop_gradient(c) for c in ins[n:n + ncc])
+    body_caps = tuple(lax.stop_gradient(c) for c in ins[n + ncc:])
 
     def c(carry):
-        return jnp.squeeze(cond(*carry)[0]).astype(bool)
+        return jnp.squeeze(cond(*carry, *cond_caps)[0]).astype(bool)
 
     def b(carry):
-        return tuple(body(*carry))
+        return tuple(body(*carry, *body_caps))
 
-    out = lax.while_loop(c, b, tuple(ins))
+    out = lax.while_loop(c, b, loop0)
     return out if len(out) > 1 else out[0]
 
 
@@ -1150,11 +1158,16 @@ def _while_loop(ins, attrs):
 def _cond(ins, attrs):
     true_call = attrs["_true_call"]
     false_call = attrs["_false_call"]
+    n_ops = attrs.get("n_operands", len(ins) - 1)
+    ntc = attrs.get("n_true_caps", 0)
     pred = jnp.squeeze(ins[0]).astype(bool)
+    operands = tuple(ins[1:1 + n_ops])
+    t_caps = tuple(ins[1 + n_ops:1 + n_ops + ntc])
+    f_caps = tuple(ins[1 + n_ops + ntc:])
     out = lax.cond(pred,
-                   lambda ops: tuple(true_call(*ops)),
-                   lambda ops: tuple(false_call(*ops)),
-                   tuple(ins[1:]))
+                   lambda ops: tuple(true_call(*ops, *t_caps)),
+                   lambda ops: tuple(false_call(*ops, *f_caps)),
+                   operands)
     return out if len(out) > 1 else out[0]
 
 
@@ -1162,12 +1175,14 @@ def _cond(ins, attrs):
 def _scan(ins, attrs):
     body = attrs["_body_call"]
     n_carry = attrs["n_carry"]
+    n_xs = attrs.get("n_xs", len(ins) - n_carry)
     carry0 = tuple(ins[:n_carry])
-    xs = tuple(ins[n_carry:])
+    xs = tuple(ins[n_carry:n_carry + n_xs])
+    caps = tuple(ins[n_carry + n_xs:])
 
     def b(carry, x):
         step_args = () if x is None else tuple(x)
-        res = body(*carry, *step_args)
+        res = body(*carry, *step_args, *caps)
         return tuple(res[:n_carry]), tuple(res[n_carry:])
 
     carry, ys = lax.scan(b, carry0, xs if xs else None,

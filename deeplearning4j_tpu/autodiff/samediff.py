@@ -217,10 +217,14 @@ class SameDiff:
         #: foreign-var captures (control-flow bodies closing over a
         #: parent graph): local name -> (owner SameDiff, owner name)
         self._captures: Dict[str, tuple] = {}
-        #: True when any subgraph captured this graph's VARIABLEs —
-        #: their values are baked per compile, so fit() must drop
-        #: compiled programs after updating them
-        self._captured_by_subgraph = False
+        #: names of this graph's VARIABLEs frozen into NESTED subgraph
+        #: closures — those values are baked per compile, so fit()
+        #: drops compiled programs after updating one of them.
+        #: (Directly-captured vars are live op inputs instead.)
+        self._frozen_captured_vars: set = set()
+        #: set while this graph is being traced as a control-flow
+        #: subgraph (enables foreign-var capture in _op)
+        self._tracing_parent = None
         from deeplearning4j_tpu.autodiff.opsets import (SDBitwise, SDCNN,
                                                         SDImage, SDLinalg,
                                                         SDLoss, SDMath,
@@ -330,6 +334,13 @@ class SameDiff:
             attrs: Optional[dict] = None, name: Optional[str] = None,
             n_out: int = 1) -> Union[SDVariable, Tuple[SDVariable, ...]]:
         get_op(op_name)               # validate early
+        for v in inputs:
+            if isinstance(v, SDVariable) and v.sd is not self and \
+                    self._tracing_parent is None:
+                raise ValueError(
+                    f"variable '{v.name}' belongs to another SameDiff "
+                    f"graph (cross-graph references are only valid "
+                    f"inside control-flow bodies)")
         inputs = [self._import_foreign(v) if isinstance(v, SDVariable)
                   and v.sd is not self else v for v in inputs]
         in_names = [v.name for v in inputs]
@@ -482,6 +493,7 @@ class SameDiff:
         (loop bodies can't own trainable state; thread it through the
         carry instead)."""
         child = SameDiff()
+        child._tracing_parent = self
         proxies = [child.placeholder(f"_arg{i}", shape=None)
                    for i in range(n_args)]
         res = fn(*proxies) if n_args else fn()
@@ -492,29 +504,43 @@ class SameDiff:
         out_names = [o.name for o in outs]
         proxy_names = [p.name for p in proxies]
         idxs = child._ancestors(out_names)
-        # closure capture: foreign vars the body referenced were
-        # registered under collision-proof local names (_import_foreign)
-        # mapping back to their owner graph. Values are read at trace
-        # time, like lax closures capture values; owners whose
-        # VARIABLEs are captured invalidate compiled programs on fit.
+        # Closure capture: foreign vars the body referenced were
+        # registered under collision-proof local names
+        # (_import_foreign) mapping back to their owner graph.
+        # Captures owned by THIS graph become extra op INPUTS — live,
+        # differentiable values at runtime (a captured trainable
+        # receives gradients through cond/scan; while_loop stops
+        # their gradients — XLA while has no reverse rule). Captures
+        # of some OTHER graph (nested subgraphs) are frozen at trace
+        # time; their owner drops compiled programs when such a
+        # variable trains.
+        parent_caps = []     # (local_name, parent_name)
+        frozen_caps = []     # (local_name, owner, owner_name)
         for local, (owner, pname) in child._captures.items():
+            if owner is self:
+                parent_caps.append((local, pname))
+                continue
             if pname not in owner._arrays:
                 raise ValueError(
-                    f"control-flow body captured '{pname}', which has "
-                    f"no value (a placeholder?) — thread it through "
-                    f"the loop/branch arguments instead")
+                    f"control-flow body captured '{pname}' from an "
+                    f"outer subgraph where it has no stored value — "
+                    f"thread it through the loop/branch arguments")
             if owner.vars[pname].var_type is VariableType.VARIABLE:
-                owner._captured_by_subgraph = True
+                owner._frozen_captured_vars.add(pname)
+            frozen_caps.append((local, owner, pname))
 
         def call(*args):
             values = dict(child._arrays)
-            for local, (owner, pname) in child._captures.items():
+            for local, owner, pname in frozen_caps:
                 values[local] = owner._arrays[pname]
-            values.update(zip(proxy_names, args))
+            values.update(zip(proxy_names, args[:n_args]))
+            values.update({local: v for (local, _), v in
+                           zip(parent_caps, args[n_args:])})
             child._execute(values, idxs, None, False)
             return [values[n] for n in out_names]
 
-        return call, len(out_names)
+        cap_vars = [self.vars[pname] for _, pname in parent_caps]
+        return call, len(out_names), cap_vars
 
     def while_loop(self, loop_vars: Sequence, cond_fn, body_fn,
                    name: Optional[str] = None):
@@ -526,14 +552,18 @@ class SameDiff:
         """
         loop_vars = [self._as_var(v) for v in loop_vars]
         n = len(loop_vars)
-        cond_call, _ = self._trace_subgraph(cond_fn, n)
-        body_call, n_body = self._trace_subgraph(body_fn, n)
+        cond_call, _, cond_caps = self._trace_subgraph(cond_fn, n)
+        body_call, n_body, body_caps = self._trace_subgraph(body_fn, n)
         if n_body != n:
             raise ValueError(f"while_loop body returned {n_body} vars "
                              f"for {n} loop vars")
-        return self._op("while_loop", loop_vars,
+        return self._op("while_loop",
+                        loop_vars + cond_caps + body_caps,
                         {"_cond_call": cond_call,
-                         "_body_call": body_call},
+                         "_body_call": body_call,
+                         "n_loop": n,
+                         "n_cond_caps": len(cond_caps),
+                         "n_body_caps": len(body_caps)},
                         name=name, n_out=n)
 
     def cond(self, pred, true_fn, false_fn, operands: Sequence = (),
@@ -542,13 +572,20 @@ class SameDiff:
         Both branches take ``operands`` and must return the same
         number of outputs. Differentiable."""
         operands = [self._as_var(v) for v in operands]
-        t_call, nt = self._trace_subgraph(true_fn, len(operands))
-        f_call, nf = self._trace_subgraph(false_fn, len(operands))
+        t_call, nt, t_caps = self._trace_subgraph(true_fn,
+                                                  len(operands))
+        f_call, nf, f_caps = self._trace_subgraph(false_fn,
+                                                  len(operands))
         if nt != nf:
             raise ValueError(f"cond branches disagree: {nt} vs {nf} "
                              f"outputs")
-        return self._op("cond", [self._as_var(pred)] + operands,
-                        {"_true_call": t_call, "_false_call": f_call},
+        return self._op("cond",
+                        [self._as_var(pred)] + operands
+                        + t_caps + f_caps,
+                        {"_true_call": t_call, "_false_call": f_call,
+                         "n_operands": len(operands),
+                         "n_true_caps": len(t_caps),
+                         "n_false_caps": len(f_caps)},
                         name=name, n_out=nt)
 
     def scan(self, body_fn, init: Sequence, xs: Sequence = (),
@@ -560,14 +597,15 @@ class SameDiff:
         (reference tBPTT-style loops compile to this)."""
         init = [self._as_var(v) for v in init]
         xs = [self._as_var(v) for v in xs]
-        body_call, n_total = self._trace_subgraph(
+        body_call, n_total, caps = self._trace_subgraph(
             body_fn, len(init) + len(xs))
         if n_total < len(init):
             raise ValueError("scan body must return at least the "
                              "carry")
-        return self._op("scan", init + xs,
+        return self._op("scan", init + xs + caps,
                         {"_body_call": body_call,
-                         "n_carry": len(init), "length": length},
+                         "n_carry": len(init), "n_xs": len(xs),
+                         "length": length},
                         name=name, n_out=n_total)
 
     def batch_output(self):
@@ -698,11 +736,17 @@ class SameDiff:
                     var_vals, self._updater_state, ph_vals,
                     jnp.asarray(iteration), rng)
                 self._arrays.update(new_vars)
-                if self._captured_by_subgraph:
-                    # control-flow subgraphs bake captured variable
-                    # values per compile — invalidate so the next
-                    # output()/fit trace sees the updated values
+                if self._frozen_captured_vars \
+                        and self._frozen_captured_vars & set(new_vars):
+                    # a NESTED subgraph froze one of the variables we
+                    # just trained — its value is baked per compile,
+                    # so drop BOTH compiled-program caches (output()
+                    # programs and this loop's step_fn). Retrace per
+                    # step is the price of freezing trainables into
+                    # nested closures; thread them through loop args
+                    # to avoid it.
                     self._exec_cache.clear()
+                    step_fn = None
                 epoch_losses.append(float(loss))
                 iteration += 1
             history.add_epoch(epoch, epoch_losses)

@@ -307,7 +307,6 @@ class ParagraphVectors(SequenceVectors):
         return np.asarray(dv)
 
     def similarity_to_label(self, text, label) -> float:
-        v = self.infer_vector(text)
-        d = self.get_doc_vector(label)
-        return float(v @ d / (np.linalg.norm(v) * np.linalg.norm(d)
-                              + 1e-12))
+        from .vocab import cosine_similarity
+        return cosine_similarity(self.infer_vector(text),
+                                 self.get_doc_vector(label))

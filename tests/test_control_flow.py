@@ -124,17 +124,56 @@ class TestCaptures:
         res = sd.output({"x": np.float32(3.0)}, [out])
         assert float(res[out.name]) == 3.0 * 2.0 + 5.0
 
-    def test_capturing_placeholder_errors_clearly(self):
+    def test_captured_placeholder_is_live(self):
+        """Captures thread through op inputs, so a captured parent
+        PLACEHOLDER reads the per-call fed value."""
         sd = SameDiff()
-        ph = sd.placeholder("p", shape=())
+        limit = sd.placeholder("limit", shape=())
         c0 = sd.constant(np.float32(0.0))
-        with pytest.raises(ValueError, match="thread it through"):
-            sd.while_loop(
-                [c0],
-                lambda v: v.sd._op("lt", [v, ph]),
-                lambda v: v.sd._op("add",
-                                   [v, v.sd.constant(
-                                       np.float32(1.0))]))
+        out = sd.while_loop(
+            [c0],
+            lambda v: v.sd._op("lt", [v, limit]),
+            lambda v: v.sd._op("add",
+                               [v, v.sd.constant(np.float32(1.0))]))
+        for lim in (3.0, 7.0):
+            r = sd.output({"limit": np.float32(lim)}, [out])
+            assert float(r[out.name]) == lim
+
+    def test_captured_variable_trains(self):
+        """A trainable VARIABLE captured by a cond body must receive
+        gradients (regression: captures used to be frozen at trace
+        time, silently zeroing their grads)."""
+        from deeplearning4j_tpu.autodiff.training import TrainingConfig
+        from deeplearning4j_tpu.learning import Sgd
+        sd = SameDiff()
+        x = sd.placeholder("x", shape=(8, 1))
+        y = sd.placeholder("y", shape=(8, 1))
+        w = sd.var("w", array=np.zeros((1, 1), np.float32))
+        out = sd.cond(sd.constant(np.float32(1.0)),
+                      lambda v: v.sd._op("matmul", [v, w]),
+                      lambda v: v, operands=[x])
+        sd._op("mean_squared_error", [y, out], name="loss")
+        sd.set_loss_variables(["loss"])
+        sd.set_training_config(TrainingConfig(
+            updater=Sgd(0.2),
+            data_set_feature_mapping=["x"],
+            data_set_label_mapping=["y"]))
+        rng = np.random.RandomState(0)
+        X = rng.randn(8, 1).astype(np.float32)
+        Y = 3.0 * X
+
+        class It:
+            def reset(self):
+                pass
+
+            def __iter__(self):
+                batch = type("B", (), {"features": [X],
+                                       "labels": [Y]})()
+                return iter([batch])
+
+        sd.fit(It(), n_epochs=20)
+        wv = float(np.asarray(sd._arrays["w"]).squeeze())
+        assert abs(wv - 3.0) < 0.2, wv
 
 
 class TestSwitchMerge:

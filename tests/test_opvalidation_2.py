@@ -1,0 +1,411 @@
+"""Op validation, batch 2 — ratchets §4.3 coverage across the
+remaining domains (boolean, bitwise, losses, index/segment ops,
+shape constructors, conv variants, linalg, recurrent cells, image,
+compression, aliases)."""
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.autodiff.opvalidation import (TestCase,
+                                                      coverage_report,
+                                                      validate)
+
+R = np.random.RandomState(11)
+A = R.randn(3, 4).astype(np.float32)
+B = R.randn(3, 4).astype(np.float32)
+P = (np.abs(A) + 0.5).astype(np.float32)
+I1 = R.randint(0, 8, (3, 4)).astype(np.int32)
+I2 = R.randint(0, 8, (3, 4)).astype(np.int32)
+IMG = R.randn(2, 6, 6, 3).astype(np.float32)
+SPD = (lambda m: (m @ m.T + 4 * np.eye(4)).astype(np.float32))(
+    R.randn(4, 4))
+SQ = R.randn(4, 4).astype(np.float32) + 4 * np.eye(4,
+                                                   dtype=np.float32)
+LOGITS = R.randn(5, 6).astype(np.float32)
+ONEHOT = np.eye(6, dtype=np.float32)[R.randint(0, 6, 5)]
+PROBS = np.clip(R.rand(5, 6).astype(np.float32), 0.05, 0.95)
+BIN = (R.rand(5, 6) > 0.5).astype(np.float32)
+
+
+def _softmax(z):
+    e = np.exp(z - z.max(-1, keepdims=True))
+    return e / e.sum(-1, keepdims=True)
+
+
+CASES = [
+    # arithmetic variants
+    TestCase("floordiv", [A, P], expected_fn=np.floor_divide,
+             gradient_check=False),
+    TestCase("mod", [P, np.float32(0.7)], expected_fn=np.mod,
+             gradient_check=False),
+    TestCase("rdiv", [P, B], expected_fn=lambda a, b: b / a),
+    TestCase("rsub", [A, B], expected_fn=lambda a, b: b - a),
+    TestCase("atan2", [A, P], expected_fn=np.arctan2),
+    TestCase("cube", [A], expected_fn=lambda a: a ** 3),
+    TestCase("expm1", [A], expected_fn=np.expm1),
+    TestCase("erfc", [A], gradient_check=True),
+    TestCase("identity", [A], expected_fn=lambda a: a),
+    TestCase("cast", [A], {"dtype": "int32"},
+             expected_fn=lambda a: a.astype(np.int32),
+             gradient_check=False),
+    TestCase("clip_by_norm", [A], {"clip_norm": 1.0},
+             expected_fn=lambda a: a / np.linalg.norm(a)
+             if np.linalg.norm(a) > 1 else a,
+             gradient_check=False),
+    # activations (remaining)
+    TestCase("relu6", [A * 4], gradient_check=False),
+    TestCase("hard_sigmoid", [A], gradient_check=False),
+    TestCase("hard_tanh", [A], gradient_check=False),
+    TestCase("swish", [A]),
+    TestCase("mish", [A]),
+    TestCase("gelu_tanh", [A]),
+    TestCase("softsign", [A],
+             expected_fn=lambda a: a / (1 + np.abs(a))),
+    TestCase("selu", [A], gradient_check=True),
+    TestCase("prelu", [A, np.full((4,), 0.2, np.float32)]),
+    # boolean / comparison
+    TestCase("eq", [I1, I2], expected_fn=np.equal,
+             gradient_check=False),
+    TestCase("neq", [I1, I2], expected_fn=np.not_equal,
+             gradient_check=False),
+    TestCase("gt", [A, B], expected_fn=np.greater,
+             gradient_check=False),
+    TestCase("gte", [A, B], expected_fn=np.greater_equal,
+             gradient_check=False),
+    TestCase("lt", [A, B], expected_fn=np.less,
+             gradient_check=False),
+    TestCase("lte", [A, B], expected_fn=np.less_equal,
+             gradient_check=False),
+    TestCase("logical_and", [I1 > 3, I2 > 3],
+             expected_fn=np.logical_and, gradient_check=False),
+    TestCase("logical_or", [I1 > 3, I2 > 3],
+             expected_fn=np.logical_or, gradient_check=False),
+    TestCase("logical_xor", [I1 > 3, I2 > 3],
+             expected_fn=np.logical_xor, gradient_check=False),
+    TestCase("logical_not", [I1 > 3], expected_fn=np.logical_not,
+             gradient_check=False),
+    TestCase("is_nan", [np.asarray([1.0, np.nan], np.float32)],
+             expected_fn=np.isnan, gradient_check=False),
+    TestCase("is_inf", [np.asarray([1.0, np.inf], np.float32)],
+             expected_fn=np.isinf, gradient_check=False),
+    TestCase("is_finite", [np.asarray([1.0, np.inf], np.float32)],
+             expected_fn=np.isfinite, gradient_check=False),
+    TestCase("where", [A > 0, A, B],
+             expected_fn=lambda c, a, b: np.where(c, a, b),
+             grad_inputs=[1, 2]),
+    TestCase("select", [A > 0, A, B],
+             expected_fn=lambda c, a, b: np.where(c, a, b),
+             gradient_check=False),
+    # bitwise
+    TestCase("bitwise_and", [I1, I2], expected_fn=np.bitwise_and,
+             gradient_check=False),
+    TestCase("bitwise_or", [I1, I2], expected_fn=np.bitwise_or,
+             gradient_check=False),
+    TestCase("bitwise_xor", [I1, I2], expected_fn=np.bitwise_xor,
+             gradient_check=False),
+    TestCase("bitwise_not", [I1], expected_fn=np.invert,
+             gradient_check=False),
+    TestCase("left_shift", [I1, np.int32(2)],
+             expected_fn=np.left_shift, gradient_check=False),
+    TestCase("right_shift", [I1, np.int32(1)],
+             expected_fn=np.right_shift, gradient_check=False),
+    # blas aliases / extras
+    TestCase("mmul", [A, R.randn(4, 5).astype(np.float32)],
+             expected_fn=np.matmul),
+    TestCase("batch_matmul",
+             [R.randn(2, 3, 4).astype(np.float32),
+              R.randn(2, 4, 5).astype(np.float32)],
+             expected_fn=np.matmul),
+    TestCase("dot", [R.randn(4).astype(np.float32),
+                     R.randn(4).astype(np.float32)],
+             expected_fn=np.dot),
+    TestCase("outer", [R.randn(3).astype(np.float32),
+                       R.randn(4).astype(np.float32)],
+             expected_fn=np.outer),
+    TestCase("tensordot_last", [A, R.randn(4, 5).astype(np.float32)],
+             expected_fn=lambda a, b: np.tensordot(a, b, 1)),
+    TestCase("einsum", [A, R.randn(4, 5).astype(np.float32)],
+             {"equation": "ij,jk->ik"}, expected_fn=np.matmul),
+    # reductions (remaining + aliases)
+    TestCase("sum", [A], {"axis": (1,)},
+             expected_fn=lambda a: a.sum(1)),
+    TestCase("mean", [A], {"axis": (0,)},
+             expected_fn=lambda a: a.mean(0)),
+    TestCase("amax", [A], {"axis": (1,)},
+             expected_fn=lambda a: a.max(1), gradient_check=False),
+    TestCase("amin", [A], {"axis": (1,)},
+             expected_fn=lambda a: a.min(1), gradient_check=False),
+    TestCase("cumsum", [A], {"axis": 1},
+             expected_fn=lambda a: np.cumsum(a, 1)),
+    TestCase("cumprod", [P], {"axis": 1},
+             expected_fn=lambda a: np.cumprod(a, 1)),
+    TestCase("reduce_logsumexp", [A], {"axis": (1,)},
+             expected_fn=lambda a: np.log(np.exp(a).sum(1))),
+    TestCase("reduce_norm1", [A], {"axis": (1,)},
+             expected_fn=lambda a: np.abs(a).sum(1),
+             gradient_check=False),   # |x| kink vs finite eps
+    TestCase("reduce_norm2", [A], {"axis": (1,)},
+             expected_fn=lambda a: np.sqrt((a * a).sum(1))),
+    TestCase("reduce_all", [I1 > 0], {"axis": (1,)},
+             expected_fn=lambda a: a.all(1), gradient_check=False),
+    TestCase("reduce_any", [I1 > 6], {"axis": (1,)},
+             expected_fn=lambda a: a.any(1), gradient_check=False),
+    # index reductions
+    TestCase("argmax", [A], {"axis": 1},
+             expected_fn=lambda a: a.argmax(1),
+             gradient_check=False),
+    TestCase("argmin", [A], {"axis": 1},
+             expected_fn=lambda a: a.argmin(1),
+             gradient_check=False),
+    TestCase("top_k", [A], {"k": 2},
+             expected_fn=lambda a: (np.sort(a, 1)[:, ::-1][:, :2],
+                                    np.argsort(-a, 1)[:, :2]),
+             gradient_check=False),
+    TestCase("in_top_k", [LOGITS,
+                          np.asarray([0, 1, 2, 3, 4], np.int32)],
+             {"k": 3}, gradient_check=False),
+    # segment ops
+    TestCase("segment_sum",
+             [R.randn(6, 3).astype(np.float32),
+              np.asarray([0, 0, 1, 1, 2, 2], np.int32)],
+             {"num_segments": 3},
+             expected_fn=lambda x, s: np.stack(
+                 [x[s == i].sum(0) for i in range(3)]),
+             grad_inputs=[0]),
+    TestCase("segment_mean",
+             [R.randn(6, 3).astype(np.float32),
+              np.asarray([0, 0, 1, 1, 2, 2], np.int32)],
+             {"num_segments": 3},
+             expected_fn=lambda x, s: np.stack(
+                 [x[s == i].mean(0) for i in range(3)]),
+             grad_inputs=[0]),
+    TestCase("segment_max",
+             [R.randn(6, 3).astype(np.float32),
+              np.asarray([0, 0, 1, 1, 2, 2], np.int32)],
+             {"num_segments": 3},
+             expected_fn=lambda x, s: np.stack(
+                 [x[s == i].max(0) for i in range(3)]),
+             gradient_check=False),
+    TestCase("segment_min",
+             [R.randn(6, 3).astype(np.float32),
+              np.asarray([0, 0, 1, 1, 2, 2], np.int32)],
+             {"num_segments": 3},
+             expected_fn=lambda x, s: np.stack(
+                 [x[s == i].min(0) for i in range(3)]),
+             gradient_check=False),
+    # shape constructors / manipulators
+    TestCase("one_hot", [np.asarray([0, 2, 1], np.int32)],
+             {"depth": 4},
+             expected_fn=lambda i: np.eye(4, dtype=np.float32)[i],
+             gradient_check=False),
+    TestCase("broadcast_to", [R.randn(1, 4).astype(np.float32)],
+             {"shape": (3, 4)},
+             expected_fn=lambda a: np.broadcast_to(a, (3, 4))),
+    TestCase("zeros_like", [A], expected_fn=np.zeros_like,
+             gradient_check=False),
+    TestCase("ones_like", [A], expected_fn=np.ones_like,
+             gradient_check=False),
+    TestCase("fill", [], {"shape": (2, 3), "value": 1.5},
+             expected_fn=lambda: np.full((2, 3), 1.5, np.float32),
+             gradient_check=False),
+    TestCase("range", [], {"start": 1, "limit": 7, "delta": 2},
+             expected_fn=lambda: np.arange(1, 7, 2),
+             gradient_check=False),
+    TestCase("linspace", [], {"start": 0.0, "stop": 1.0, "num": 5},
+             expected_fn=lambda: np.linspace(0, 1, 5),
+             gradient_check=False),
+    TestCase("eye", [], {"rows": 3, "cols": 4},
+             expected_fn=lambda: np.eye(3, 4, dtype=np.float32),
+             gradient_check=False),
+    TestCase("shape_of", [A], expected_fn=lambda a: np.asarray(
+        a.shape, np.int32), gradient_check=False),
+    TestCase("size", [A],
+             expected_fn=lambda a: np.int32(a.size),
+             gradient_check=False),
+    TestCase("rank", [A], expected_fn=lambda a: np.int32(a.ndim),
+             gradient_check=False),
+    TestCase("transpose", [A], {"axes": [1, 0]},
+             expected_fn=lambda a: a.T),
+    TestCase("repeat", [A], {"repeats": 2, "axis": 1},
+             expected_fn=lambda a: np.repeat(a, 2, 1)),
+    TestCase("split", [A], {"num_splits": 2, "axis": 1},
+             expected_fn=lambda a: tuple(np.split(a, 2, 1))),
+    TestCase("split_v", [A], {"size_splits": [1, 3], "axis": 1},
+             expected_fn=lambda a: (a[:, :1], a[:, 1:])),
+    TestCase("unstack", [A], {"axis": 0},
+             expected_fn=lambda a: tuple(a[i] for i in range(3))),
+    TestCase("gather_nd",
+             [A, np.asarray([[0, 1], [2, 3]], np.int32)],
+             expected_fn=lambda a, i: a[tuple(i.T)],
+             grad_inputs=[0]),
+    TestCase("scatter_update",
+             [A, np.asarray([0, 2], np.int32),
+              R.randn(2, 4).astype(np.float32)],
+             expected_fn=lambda a, i, u: (
+                 lambda c: (c.__setitem__(i, u), c)[1])(a.copy()),
+             gradient_check=False),
+    TestCase("scatter_add",
+             [A, np.asarray([0, 0], np.int32),
+              np.ones((2, 4), np.float32)],
+             expected_fn=lambda a, i, u: (
+                 lambda c: (np.add.at(c, i, u), c)[1])(a.copy()),
+             grad_inputs=[0]),
+    TestCase("reverse_sequence",
+             [R.randn(2, 4, 3).astype(np.float32),
+              np.asarray([2, 4], np.int32)],
+             {"seq_axis": 1, "batch_axis": 0},
+             expected_fn=lambda x, l: np.stack(
+                 [np.concatenate([x[b, :l[b]][::-1], x[b, l[b]:]])
+                  for b in range(2)]),
+             grad_inputs=[0]),
+    # losses
+    TestCase("softmax_cross_entropy", [ONEHOT, LOGITS],
+             expected_fn=lambda y, z:
+             (-(y * np.log(_softmax(z))).sum(-1)).mean()),
+    TestCase("sparse_softmax_cross_entropy",
+             [np.asarray([1, 0, 3, 2, 5], np.int32), LOGITS],
+             expected_fn=lambda y, z: np.mean(
+                 [-np.log(_softmax(z))[i, y[i]] for i in range(5)]),
+             grad_inputs=[1]),
+    TestCase("sigmoid_cross_entropy", [BIN, LOGITS],
+             expected_fn=lambda y, z: np.mean(
+                 np.maximum(z, 0) - z * y
+                 + np.log1p(np.exp(-np.abs(z))))),
+    TestCase("mean_squared_error", [ONEHOT, PROBS],
+             expected_fn=lambda a, b: ((a - b) ** 2).mean()),
+    TestCase("absolute_difference", [ONEHOT, PROBS],
+             gradient_check=False,
+             expected_fn=lambda a, b: np.abs(a - b).mean()),
+    TestCase("huber_loss", [ONEHOT, PROBS], {"delta": 0.3},
+             expected_fn=lambda a, b: np.where(
+                 np.abs(a - b) <= 0.3, 0.5 * (a - b) ** 2,
+                 0.3 * (np.abs(a - b) - 0.15)).mean()),
+    TestCase("log_loss", [BIN, PROBS],
+             expected_fn=lambda y, p: -np.mean(
+                 y * np.log(p + 1e-7)
+                 + (1 - y) * np.log(1 - p + 1e-7))),
+    TestCase("hinge_loss", [BIN, LOGITS], gradient_check=False),
+    TestCase("cosine_distance", [ONEHOT + 0.1, PROBS],
+             gradient_check=True),
+    # normalization extras
+    TestCase("standardize", [A], {"axis": -1},
+             expected_fn=lambda a:
+             (a - a.mean(-1, keepdims=True))
+             / np.maximum(a.std(-1, keepdims=True), 1e-12)),
+    TestCase("moments", [A], {"axis": (0,)},
+             expected_fn=lambda a: (a.mean(0), a.var(0)),
+             gradient_check=False),
+    TestCase("lrn", [IMG], max_entries=4),
+    # convolution variants (gradient check is the content)
+    TestCase("conv1d", [R.randn(2, 8, 3).astype(np.float32),
+                        (R.randn(3, 3, 4) * 0.3).astype(np.float32)],
+             {"stride": 1, "padding": "SAME"}, max_entries=4),
+    TestCase("conv3d",
+             [R.randn(1, 4, 4, 4, 2).astype(np.float32),
+              (R.randn(2, 2, 2, 2, 3) * 0.3).astype(np.float32)],
+             {"stride": (1, 1, 1), "padding": "VALID"},
+             max_entries=2),
+    TestCase("depthwise_conv2d",
+             [IMG, (R.randn(3, 3, 3, 2) * 0.3).astype(np.float32)],
+             max_entries=4),
+    TestCase("separable_conv2d",
+             [IMG, (R.randn(3, 3, 3, 1) * 0.3).astype(np.float32),
+              (R.randn(1, 1, 3, 4) * 0.3).astype(np.float32)],
+             max_entries=4),
+    TestCase("deconv2d",
+             [R.randn(1, 4, 4, 2).astype(np.float32),
+              (R.randn(2, 2, 2, 3) * 0.3).astype(np.float32)],
+             {"stride": (2, 2)}, max_entries=4),
+    TestCase("upsampling2d", [IMG], {"scale": 2},
+             expected_fn=lambda x: np.repeat(
+                 np.repeat(x, 2, 1), 2, 2)),
+    TestCase("im2col", [IMG], {"kernel": (2, 2)},
+             gradient_check=False),
+    TestCase("max_pool1d", [R.randn(2, 8, 3).astype(np.float32)],
+             {"kernel": 2, "stride": 2},
+             gradient_check=False),
+    TestCase("avg_pool1d", [R.randn(2, 8, 3).astype(np.float32)],
+             {"kernel": 2, "stride": 2}, max_entries=3),
+    TestCase("max_pool3d",
+             [R.randn(1, 4, 4, 4, 2).astype(np.float32)],
+             {"kernel": (2, 2, 2), "stride": (2, 2, 2)},
+             gradient_check=False),
+    TestCase("avg_pool3d",
+             [R.randn(1, 4, 4, 4, 2).astype(np.float32)],
+             {"kernel": (2, 2, 2), "stride": (2, 2, 2)},
+             max_entries=2),
+    # image
+    TestCase("resize_bilinear", [IMG], {"size": (12, 12)},
+             gradient_check=False),
+    TestCase("resize_nearest", [IMG], {"size": (12, 12)},
+             gradient_check=False),
+    TestCase("extract_image_patches", [IMG],
+             {"kernel": (2, 2), "stride": (2, 2)},
+             gradient_check=False),
+    # linalg
+    TestCase("cholesky", [SPD],
+             expected_fn=np.linalg.cholesky, fwd_tol=1e-4,
+             gradient_check=False),
+    TestCase("matrix_inverse", [SQ],
+             expected_fn=np.linalg.inv, fwd_tol=1e-3,
+             gradient_check=False),
+    TestCase("matrix_determinant", [SQ],
+             expected_fn=np.linalg.det, fwd_tol=1e-2,
+             gradient_check=False),
+    TestCase("trace", [SQ], expected_fn=np.trace),
+    TestCase("diag", [R.randn(4).astype(np.float32)],
+             expected_fn=np.diag),
+    TestCase("diag_part", [SQ], expected_fn=np.diag),
+    TestCase("solve", [SPD, R.randn(4, 2).astype(np.float32)],
+             expected_fn=np.linalg.solve, fwd_tol=1e-3,
+             gradient_check=False),
+    # recurrent cells (gradient check is the content)
+    TestCase("lstm_cell",
+             [R.randn(2, 3).astype(np.float32),
+              R.randn(2, 4).astype(np.float32),
+              R.randn(2, 4).astype(np.float32),
+              (R.randn(3, 16) * 0.3).astype(np.float32),
+              (R.randn(4, 16) * 0.3).astype(np.float32),
+              np.zeros(16, np.float32)], max_entries=4),
+    TestCase("gru_cell",
+             [R.randn(2, 3).astype(np.float32),
+              R.randn(2, 4).astype(np.float32),
+              (R.randn(3, 12) * 0.3).astype(np.float32),
+              (R.randn(4, 12) * 0.3).astype(np.float32),
+              np.zeros(12, np.float32)], max_entries=4),
+    # remaining transcendentals
+    TestCase("asinh", [A], expected_fn=np.arcsinh),
+    TestCase("acosh", [P + 1.0], expected_fn=np.arccosh),
+    TestCase("atanh", [np.clip(A * 0.3, -0.7, 0.7)],
+             expected_fn=np.arctanh),
+    TestCase("round", [A * 3], expected_fn=np.round,
+             gradient_check=False),
+    # linalg decompositions (forward reconstruction checks)
+    TestCase("lu", [SQ], gradient_check=False),
+    TestCase("qr", [SQ], gradient_check=False),
+    TestCase("svd", [SQ], gradient_check=False),
+    TestCase("triangular_solve",
+             [np.tril(SPD).astype(np.float32),
+              R.randn(4, 2).astype(np.float32)],
+             {"lower": True}, gradient_check=False, fwd_tol=1e-3),
+    # compression codec round-trip semantics
+    TestCase("encode_threshold",
+             [np.asarray([0.5, -0.01, 0.02, -0.6], np.float32)],
+             {"threshold": 0.1}, gradient_check=False),
+]
+
+
+@pytest.mark.parametrize(
+    "tc", CASES, ids=[f"{c.op}_{i}" for i, c in enumerate(CASES)])
+def test_op(tc):
+    validate(tc)
+
+
+def test_combined_coverage_floor():
+    """Batches 1+2 together must keep the registry coverage ratchet."""
+    from test_opvalidation import CASES as CASES1
+    for tc in CASES1 + CASES:
+        validate(tc)
+    rep = coverage_report()
+    assert rep["covered"] >= 190, (rep["covered"],
+                                   rep["missing"][:30])
+    assert rep["fraction"] >= 0.90, rep["fraction"]
