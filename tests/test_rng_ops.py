@@ -1,0 +1,56 @@
+"""Statistical validation of the RNG op domain (the one §4.3 domain
+exact-value ground truth can't cover): distribution moments, range,
+determinism-under-seed, dropout semantics."""
+import jax
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.autodiff.registry import get_op
+
+N = 200_000
+
+
+def _run(op, attrs, seed=0, ins=()):
+    attrs = dict(attrs)
+    attrs["rng"] = jax.random.PRNGKey(seed)
+    return np.asarray(get_op(op)(list(ins), attrs))
+
+
+class TestRandomOps:
+    def test_random_normal_moments(self):
+        x = _run("random_normal", {"shape": (N,)})
+        assert abs(x.mean()) < 0.02
+        assert abs(x.std() - 1.0) < 0.02
+
+    def test_random_uniform_range_and_mean(self):
+        x = _run("random_uniform", {"shape": (N,), "min": 2.0,
+                                    "max": 5.0})
+        assert x.min() >= 2.0 and x.max() < 5.0
+        assert abs(x.mean() - 3.5) < 0.02
+
+    def test_random_bernoulli_rate(self):
+        x = _run("random_bernoulli", {"shape": (N,), "prob": 0.3})
+        assert set(np.unique(x)) <= {0.0, 1.0}
+        assert abs(x.mean() - 0.3) < 0.01
+
+    def test_seed_determinism(self):
+        a = _run("random_normal", {"shape": (64,)}, seed=7)
+        b = _run("random_normal", {"shape": (64,)}, seed=7)
+        c = _run("random_normal", {"shape": (64,)}, seed=8)
+        np.testing.assert_array_equal(a, b)
+        assert not np.array_equal(a, c)
+
+    def test_dropout_semantics(self):
+        x = np.ones((N,), np.float32)
+        y = _run("dropout", {"rate": 0.25, "training": True},
+                 ins=(x,))
+        kept = y != 0
+        # inverted dropout: survivors scaled by 1/(1-rate)
+        np.testing.assert_allclose(np.unique(y[kept]), [1 / 0.75],
+                                   atol=1e-6)
+        assert abs(kept.mean() - 0.75) < 0.01
+        assert abs(y.mean() - 1.0) < 0.02        # expectation kept
+        # inference mode: identity
+        y_eval = _run("dropout", {"rate": 0.25, "training": False},
+                      ins=(x,))
+        np.testing.assert_array_equal(y_eval, x)
