@@ -1,3 +1,3 @@
 from deeplearning4j_tpu.evaluation.evaluation import (  # noqa: F401
-    Evaluation, RegressionEvaluation, ROC, EvaluationBinary,
-    EvaluationCalibration)
+    Evaluation, RegressionEvaluation, ROC, ROCBinary, ROCMultiClass,
+    EvaluationBinary, EvaluationCalibration)

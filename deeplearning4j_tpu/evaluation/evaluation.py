@@ -213,6 +213,76 @@ class ROC:
             (n_pos * n_neg)
         return float(auc)
 
+    def calculate_auprc(self) -> float:
+        """Area under the precision-recall curve (reference:
+        ROC.calculateAUCPR), exact interpolation-free sum."""
+        s = np.concatenate(self.scores)
+        y = np.concatenate(self.labels) > 0.5
+        if y.sum() == 0:
+            return float("nan")
+        order = np.argsort(-s, kind="mergesort")
+        y_sorted = y[order]
+        tp = np.cumsum(y_sorted)
+        precision = tp / np.arange(1, y_sorted.size + 1)
+        # average precision: sum precision at each positive hit
+        return float(precision[y_sorted].sum() / y.sum())
+
+
+class _PerColumnROC:
+    """One independent ROC per label/class column; [b, t, c] time series
+    flatten through the label mask first (shared spine of ROCBinary and
+    ROCMultiClass)."""
+
+    def __init__(self):
+        self.rocs: list = []
+
+    def eval(self, labels, predictions, mask=None):  # noqa: A003
+        labels = _np(labels)
+        preds = _np(predictions)
+        if labels.ndim == 1:
+            labels, preds = labels[:, None], preds[:, None]
+        mask = _np(mask) if mask is not None else None
+        if labels.ndim == 3:   # [b, t, c] time series
+            labels, preds, mask = _flatten_time(labels, preds, mask)
+        n_col = labels.shape[-1]
+        if not self.rocs:
+            self.rocs = [ROC() for _ in range(n_col)]
+        for i in range(n_col):
+            # a per-output [n, c] mask selects column i; an [n] mask
+            # applies to every column
+            m = mask
+            if m is not None and m.ndim == 2:
+                m = m[:, i]
+            self.rocs[i].eval(labels[:, i], preds[:, i], mask=m)
+        return self
+
+    def calculate_auc(self, i: int) -> float:
+        return self.rocs[i].calculate_auc()
+
+    def calculate_auprc(self, i: int) -> float:
+        return self.rocs[i].calculate_auprc()
+
+    def calculate_average_auc(self) -> float:
+        aucs = [r.calculate_auc() for r in self.rocs]
+        aucs = [a for a in aucs if not np.isnan(a)]
+        return float(np.mean(aucs)) if aucs else float("nan")
+
+
+class ROCBinary(_PerColumnROC):
+    """Per-output binary ROC for multi-label sigmoid heads (reference:
+    org.nd4j.evaluation.classification.ROCBinary)."""
+
+    def num_labels(self) -> int:
+        return len(self.rocs)
+
+
+class ROCMultiClass(_PerColumnROC):
+    """One-vs-all ROC per class for softmax heads (reference:
+    org.nd4j.evaluation.classification.ROCMultiClass)."""
+
+    def num_classes(self) -> int:
+        return len(self.rocs)
+
 
 class EvaluationCalibration:
     """Reliability-diagram accumulation (reference: same name)."""

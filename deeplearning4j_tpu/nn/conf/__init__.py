@@ -4,4 +4,10 @@ from deeplearning4j_tpu.nn.conf.builders import (  # noqa: F401
 from deeplearning4j_tpu.nn.conf.inputs import InputType  # noqa: F401
 from deeplearning4j_tpu.nn.conf import layers  # noqa: F401
 from deeplearning4j_tpu.nn.conf import layers_attention  # noqa: F401
+from deeplearning4j_tpu.nn.conf import layers_shape  # noqa: F401
+from deeplearning4j_tpu.nn.conf import layers_conv_1d3d  # noqa: F401
+from deeplearning4j_tpu.nn.conf import layers_misc  # noqa: F401
+from deeplearning4j_tpu.nn.conf import layers_vae  # noqa: F401
+from deeplearning4j_tpu.nn.conf import layers_output_extra  # noqa: F401
+from deeplearning4j_tpu.nn.conf import layers_capsule  # noqa: F401
 from deeplearning4j_tpu.nn.conf import preprocessors  # noqa: F401

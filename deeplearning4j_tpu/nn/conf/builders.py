@@ -152,6 +152,10 @@ def _wants_ff_input(layer: Layer) -> bool:
                                                    RnnOutputLayer)
     from deeplearning4j_tpu.nn.conf.layers_objdetect import \
         Yolo2OutputLayer
+    from deeplearning4j_tpu.nn.conf.layers_vae import (
+        AutoEncoder, VariationalAutoencoder)
+    if isinstance(layer, (AutoEncoder, VariationalAutoencoder)):
+        return True
     return isinstance(layer, DenseLayer) and not isinstance(
         layer, (RnnOutputLayer, CnnLossLayer, Yolo2OutputLayer))
 

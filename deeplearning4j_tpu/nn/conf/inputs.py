@@ -35,6 +35,12 @@ class InputType:
         return InputTypeConvolutionalFlat(int(height), int(width),
                                           int(channels))
 
+    @staticmethod
+    def convolutional_3d(depth: int, height: int, width: int,
+                         channels: int) -> "InputTypeConvolutional3D":
+        return InputTypeConvolutional3D(int(depth), int(height), int(width),
+                                        int(channels))
+
     # -- serde ----------------------------------------------------------
     def to_map(self) -> dict:
         d = {"@class": type(self).__name__}
@@ -104,6 +110,24 @@ class InputTypeConvolutionalFlat(InputType):
         return (batch, self.arrays_per_example())
 
 
+@dataclass
+class InputTypeConvolutional3D(InputType):
+    """Volumetric input, NDHWC (reference: InputType.InputTypeConvolutional3D,
+    which is NCDHW; the TPU layout keeps channels trailing for the MXU)."""
+
+    depth: int
+    height: int
+    width: int
+    channels: int
+
+    def arrays_per_example(self) -> int:
+        return self.depth * self.height * self.width * self.channels
+
+    def shape(self, batch: int = -1):
+        return (batch, self.depth, self.height, self.width, self.channels)
+
+
 _REGISTRY = {c.__name__: c for c in
              (InputTypeFeedForward, InputTypeRecurrent,
-              InputTypeConvolutional, InputTypeConvolutionalFlat)}
+              InputTypeConvolutional, InputTypeConvolutionalFlat,
+              InputTypeConvolutional3D)}

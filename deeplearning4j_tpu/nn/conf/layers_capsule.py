@@ -1,0 +1,156 @@
+"""Capsule network layers (Sabour et al. dynamic routing).
+
+Reference parity: ``org.deeplearning4j.nn.conf.layers.{PrimaryCapsules,
+CapsuleLayer,CapsuleStrengthLayer}`` (SameDiff-defined layers in the
+reference). Capsule tensors ride the recurrent input-type convention the
+reference also uses: [b, n_capsules, capsule_dim] == recurrent(size=dim,
+timesteps=n_caps).
+
+TPU-first: routing iterations are a static Python unroll (fixed count →
+XLA sees straight-line code and fuses the softmax/agreement chain); the
+prediction tensor einsum maps to one large MXU contraction.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+
+from deeplearning4j_tpu.nn.conf.inputs import (InputType,
+                                               InputTypeConvolutional,
+                                               InputTypeRecurrent)
+from deeplearning4j_tpu.nn.conf.layers import Layer, _pair, register_layer
+from deeplearning4j_tpu.nn.weights import WeightInit
+
+
+def _squash(s, axis=-1, eps=1e-8):
+    """v = ||s||^2/(1+||s||^2) * s/||s|| — the capsule nonlinearity."""
+    n2 = jnp.sum(s * s, axis=axis, keepdims=True)
+    return (n2 / (1.0 + n2)) * s / jnp.sqrt(n2 + eps)
+
+
+@register_layer
+@dataclass
+class PrimaryCapsules(Layer):
+    """Conv -> capsule reshape -> squash (reference: PrimaryCapsules).
+    ``capsules`` * ``capsule_dimensions`` output channels."""
+
+    capsule_dimensions: int = 8
+    channels: int = 32                      # capsule groups
+    kernel_size: Tuple[int, int] = (9, 9)
+    stride: Tuple[int, int] = (2, 2)
+    has_bias: bool = True
+
+    def __post_init__(self):
+        super().__post_init__()
+        self.kernel_size = _pair(self.kernel_size)
+        self.stride = _pair(self.stride)
+
+    def set_n_in(self, input_type, override):
+        if isinstance(input_type, InputTypeConvolutional) and \
+                (override or not self.n_in):
+            self.n_in = input_type.channels
+
+    def init_params(self, key, input_type, dtype=jnp.float32):
+        kh, kw = self.kernel_size
+        c_out = self.channels * self.capsule_dimensions
+        wi = self.weight_init or WeightInit.XAVIER
+        p = {"W": wi.init(key, (kh, kw, self.n_in, c_out),
+                          kh * kw * self.n_in, kh * kw * c_out, dtype)}
+        if self.has_bias:
+            p["b"] = jnp.zeros((c_out,), dtype)
+        return p
+
+    def forward(self, params, x, *, training, rng=None, state=None):
+        z = jax.lax.conv_general_dilated(
+            x, params["W"], window_strides=self.stride, padding="VALID",
+            dimension_numbers=("NHWC", "HWIO", "NHWC"))
+        if self.has_bias:
+            z = z + params["b"]
+        b, h, w, _ = z.shape
+        caps = z.reshape(b, h * w * self.channels,
+                         self.capsule_dimensions)
+        return _squash(caps), state
+
+    def _out_hw(self, input_type):
+        kh, kw = self.kernel_size
+        sh, sw = self.stride
+        return ((input_type.height - kh) // sh + 1,
+                (input_type.width - kw) // sw + 1)
+
+    def get_output_type(self, input_type):
+        assert isinstance(input_type, InputTypeConvolutional)
+        oh, ow = self._out_hw(input_type)
+        return InputType.recurrent(self.capsule_dimensions,
+                                   oh * ow * self.channels)
+
+
+@register_layer
+@dataclass
+class CapsuleLayer(Layer):
+    """Fully-connected capsules with dynamic routing (reference:
+    CapsuleLayer; ``capsules`` output capsules of ``capsule_dimensions``
+    dims, ``routings`` iterations)."""
+
+    capsules: int = 10
+    capsule_dimensions: int = 16
+    routings: int = 3
+
+    def set_n_in(self, input_type, override):
+        assert isinstance(input_type, InputTypeRecurrent)
+        self._in_caps = input_type.timesteps
+        self._in_dim = input_type.size
+
+    def init_params(self, key, input_type, dtype=jnp.float32):
+        self.set_n_in(input_type, override=False)
+        wi = self.weight_init or WeightInit.XAVIER
+        # [in_caps, out_caps, out_dim, in_dim] prediction transforms
+        fan_in = self._in_dim
+        fan_out = self.capsule_dimensions
+        return {"W": wi.init(key, (self._in_caps, self.capsules,
+                                   self.capsule_dimensions, self._in_dim),
+                             fan_in, fan_out, dtype)}
+
+    def forward(self, params, x, *, training, rng=None, state=None):
+        # x: [b, in_caps, in_dim]; u_hat: [b, in_caps, out_caps, out_dim]
+        u_hat = jnp.einsum("bid,iokd->biok", x, params["W"])
+        # routing logits b_ij: [b, in_caps, out_caps]
+        logits = jnp.zeros(u_hat.shape[:3], u_hat.dtype)
+        v = None
+        for it in range(self.routings):
+            c = jax.nn.softmax(logits, axis=2)
+            s = jnp.einsum("bio,biok->bok", c, u_hat)
+            v = _squash(s)
+            if it < self.routings - 1:
+                # agreement: routing towards capsules whose output aligns
+                # with the prediction; u_hat is gradient-stopped in the
+                # update like the reference's routing (only the last
+                # iteration backprops through predictions)
+                logits = logits + jnp.einsum(
+                    "biok,bok->bio", jax.lax.stop_gradient(u_hat), v)
+        return v, state
+
+    def get_output_type(self, input_type):
+        return InputType.recurrent(self.capsule_dimensions, self.capsules)
+
+
+@register_layer
+@dataclass
+class CapsuleStrengthLayer(Layer):
+    """Capsule norm: [b, caps, dim] -> [b, caps] class-probability
+    lengths (reference: CapsuleStrengthLayer)."""
+
+    def has_params(self) -> bool:
+        return False
+
+    def set_n_in(self, input_type, override):
+        pass
+
+    def forward(self, params, x, *, training, rng=None, state=None):
+        return jnp.sqrt(jnp.sum(x * x, axis=-1) + 1e-12), state
+
+    def get_output_type(self, input_type):
+        assert isinstance(input_type, InputTypeRecurrent)
+        return InputType.feed_forward(input_type.timesteps)

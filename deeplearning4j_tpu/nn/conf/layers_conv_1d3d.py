@@ -1,0 +1,353 @@
+"""1D and 3D convolution-family layers (SURVEY.md D4: Conv1D/3D,
+Subsampling1D/3D, Deconvolution3D, Cnn3DLossLayer).
+
+Reference parity: ``org.deeplearning4j.nn.conf.layers.{Convolution1DLayer,
+Subsampling1DLayer,Convolution3D,Subsampling3DLayer,Deconvolution3D,
+Cnn3DLossLayer}``. The reference's 1D layers ride the RNN data format
+[b, f, t]; here sequences are [b, t, f] (time-major-after-batch, the
+layout every recurrent layer in this framework uses), so conv1d is
+``lax.conv_general_dilated`` with ("NWC", "WIO", "NWC") — channels last
+for the MXU. 3D is NDHWC / DHWIO (reference: NCDHW).
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+
+from deeplearning4j_tpu.activations import Activation
+from deeplearning4j_tpu.nn.conf.inputs import (
+    InputType, InputTypeConvolutional3D, InputTypeRecurrent)
+from deeplearning4j_tpu.nn.conf.layers import (
+    BaseOutputLayer, ConvolutionMode, Layer, PoolingType, register_layer)
+from deeplearning4j_tpu.nn.weights import WeightInit
+
+
+def _triple(v) -> Tuple[int, int, int]:
+    if isinstance(v, (tuple, list)):
+        return tuple(int(i) for i in v)
+    return (int(v),) * 3
+
+
+# ---------------------------------------------------------------------------
+# 1D family — operates on [b, t, f]
+# ---------------------------------------------------------------------------
+@register_layer
+@dataclass
+class Convolution1DLayer(Layer):
+    """Temporal convolution (reference: Convolution1DLayer)."""
+
+    kernel_size: int = 3
+    stride: int = 1
+    padding: int = 0
+    dilation: int = 1
+    convolution_mode: ConvolutionMode = ConvolutionMode.SAME
+    has_bias: bool = True
+
+    @staticmethod
+    def _builder_positional(*args) -> dict:
+        return {"kernel_size": int(args[0])} if args else {}
+
+    def __post_init__(self):
+        super().__post_init__()
+        for f in ("kernel_size", "stride", "padding", "dilation"):
+            v = getattr(self, f)
+            setattr(self, f, int(v[0] if isinstance(v, (tuple, list))
+                                 else v))
+
+    def is_recurrent(self) -> bool:
+        return False
+
+    def init_params(self, key, input_type, dtype=jnp.float32):
+        k = self.kernel_size
+        wi = self.weight_init or WeightInit.XAVIER
+        p = {"W": wi.init(key, (k, self.n_in, self.n_out),
+                          k * self.n_in, k * self.n_out, dtype)}
+        if self.has_bias:
+            p["b"] = jnp.full((self.n_out,), self.bias_init, dtype)
+        return p
+
+    def forward(self, params, x, *, training, rng=None, state=None):
+        x = self._maybe_dropout(x, training, rng)
+        pad = ("SAME" if self.convolution_mode is ConvolutionMode.SAME
+               else [(self.padding, self.padding)])
+        z = jax.lax.conv_general_dilated(
+            x, params["W"], window_strides=(self.stride,), padding=pad,
+            rhs_dilation=(self.dilation,),
+            dimension_numbers=("NWC", "WIO", "NWC"))
+        if self.has_bias:
+            z = z + params["b"]
+        return self.activation(z), state
+
+    def set_n_in(self, input_type, override):
+        if isinstance(input_type, InputTypeRecurrent) and \
+                (override or not self.n_in):
+            self.n_in = input_type.size
+
+    def get_output_type(self, input_type):
+        assert isinstance(input_type, InputTypeRecurrent), input_type
+        t = input_type.timesteps
+        if t > 0:
+            ek = (self.kernel_size - 1) * self.dilation + 1
+            if self.convolution_mode is ConvolutionMode.SAME:
+                t = -(-t // self.stride)
+            else:
+                t = (t + 2 * self.padding - ek) // self.stride + 1
+        return InputType.recurrent(self.n_out, t)
+
+
+@register_layer
+@dataclass
+class Subsampling1DLayer(Layer):
+    """Temporal pooling on [b, t, f] (reference: Subsampling1DLayer)."""
+
+    pooling_type: PoolingType = PoolingType.MAX
+    kernel_size: int = 2
+    stride: int = 2
+    padding: int = 0
+    convolution_mode: ConvolutionMode = ConvolutionMode.TRUNCATE
+    pnorm: int = 2
+
+    def __post_init__(self):
+        super().__post_init__()
+        for f in ("kernel_size", "stride", "padding"):
+            v = getattr(self, f)
+            setattr(self, f, int(v[0] if isinstance(v, (tuple, list))
+                                 else v))
+
+    def has_params(self) -> bool:
+        return False
+
+    def set_n_in(self, input_type, override):
+        pass
+
+    def forward(self, params, x, *, training, rng=None, state=None):
+        k, s = self.kernel_size, self.stride
+        if self.convolution_mode is ConvolutionMode.SAME:
+            pad = "SAME"
+        else:
+            pad = [(0, 0), (self.padding, self.padding), (0, 0)]
+        dims, strides = (1, k, 1), (1, s, 1)
+        if self.pooling_type is PoolingType.MAX:
+            z = jax.lax.reduce_window(x, -jnp.inf, jax.lax.max, dims,
+                                      strides, pad)
+        elif self.pooling_type is PoolingType.SUM:
+            z = jax.lax.reduce_window(x, 0.0, jax.lax.add, dims, strides,
+                                      pad)
+        elif self.pooling_type is PoolingType.AVG:
+            zs = jax.lax.reduce_window(x, 0.0, jax.lax.add, dims, strides,
+                                       pad)
+            n = jax.lax.reduce_window(jnp.ones_like(x), 0.0, jax.lax.add,
+                                      dims, strides, pad)
+            z = zs / n
+        else:
+            p = float(self.pnorm)
+            zs = jax.lax.reduce_window(jnp.abs(x) ** p, 0.0, jax.lax.add,
+                                       dims, strides, pad)
+            z = zs ** (1.0 / p)
+        return z, state
+
+    def get_output_type(self, input_type):
+        assert isinstance(input_type, InputTypeRecurrent), input_type
+        t = input_type.timesteps
+        if t > 0:
+            if self.convolution_mode is ConvolutionMode.SAME:
+                t = -(-t // self.stride)
+            else:
+                t = (t + 2 * self.padding - self.kernel_size) \
+                    // self.stride + 1
+        return InputType.recurrent(input_type.size, t)
+
+
+# ---------------------------------------------------------------------------
+# 3D family — operates on [b, d, h, w, c]
+# ---------------------------------------------------------------------------
+@register_layer
+@dataclass
+class Convolution3D(Layer):
+    """Volumetric convolution (reference: Convolution3D, NCDHW; here
+    NDHWC/DHWIO so XLA tiles the channel contraction onto the MXU)."""
+
+    kernel_size: Tuple[int, int, int] = (3, 3, 3)
+    stride: Tuple[int, int, int] = (1, 1, 1)
+    padding: Tuple[int, int, int] = (0, 0, 0)
+    dilation: Tuple[int, int, int] = (1, 1, 1)
+    convolution_mode: ConvolutionMode = ConvolutionMode.TRUNCATE
+    has_bias: bool = True
+
+    @staticmethod
+    def _builder_positional(*args) -> dict:
+        if len(args) == 1:
+            return {"kernel_size": _triple(args[0])}
+        return {"kernel_size": tuple(int(a) for a in args)}
+
+    def __post_init__(self):
+        super().__post_init__()
+        self.kernel_size = _triple(self.kernel_size)
+        self.stride = _triple(self.stride)
+        self.padding = _triple(self.padding)
+        self.dilation = _triple(self.dilation)
+
+    def _pad_cfg(self):
+        if self.convolution_mode is ConvolutionMode.SAME:
+            return "SAME"
+        return [(p, p) for p in self.padding]
+
+    def init_params(self, key, input_type, dtype=jnp.float32):
+        kd, kh, kw = self.kernel_size
+        vol = kd * kh * kw
+        wi = self.weight_init or WeightInit.XAVIER
+        p = {"W": wi.init(key, (kd, kh, kw, self.n_in, self.n_out),
+                          vol * self.n_in, vol * self.n_out, dtype)}
+        if self.has_bias:
+            p["b"] = jnp.full((self.n_out,), self.bias_init, dtype)
+        return p
+
+    def forward(self, params, x, *, training, rng=None, state=None):
+        x = self._maybe_dropout(x, training, rng)
+        z = jax.lax.conv_general_dilated(
+            x, params["W"], window_strides=self.stride,
+            padding=self._pad_cfg(), rhs_dilation=self.dilation,
+            dimension_numbers=("NDHWC", "DHWIO", "NDHWC"))
+        if self.has_bias:
+            z = z + params["b"]
+        return self.activation(z), state
+
+    def set_n_in(self, input_type, override):
+        if isinstance(input_type, InputTypeConvolutional3D) and \
+                (override or not self.n_in):
+            self.n_in = input_type.channels
+
+    def _out_dim(self, size, i):
+        k = (self.kernel_size[i] - 1) * self.dilation[i] + 1
+        s = self.stride[i]
+        if self.convolution_mode is ConvolutionMode.SAME:
+            return -(-size // s)
+        return (size + 2 * self.padding[i] - k) // s + 1
+
+    def get_output_type(self, input_type):
+        assert isinstance(input_type, InputTypeConvolutional3D), input_type
+        return InputType.convolutional_3d(
+            self._out_dim(input_type.depth, 0),
+            self._out_dim(input_type.height, 1),
+            self._out_dim(input_type.width, 2), self.n_out)
+
+
+@register_layer
+@dataclass
+class Deconvolution3D(Convolution3D):
+    """Transposed volumetric convolution (reference: Deconvolution3D)."""
+
+    def forward(self, params, x, *, training, rng=None, state=None):
+        x = self._maybe_dropout(x, training, rng)
+        if self.convolution_mode is ConvolutionMode.SAME:
+            pad = "SAME"
+        else:
+            # conv_transpose explicit padding applies to the s-dilated
+            # input; k-1-p per side yields the standard transposed-conv
+            # output size (i-1)*s + k - 2p
+            pad = [(k - 1 - p, k - 1 - p)
+                   for k, p in zip(self.kernel_size, self.padding)]
+        z = jax.lax.conv_transpose(
+            x, params["W"], strides=self.stride, padding=pad,
+            dimension_numbers=("NDHWC", "DHWIO", "NDHWC"))
+        if self.has_bias:
+            z = z + params["b"]
+        return self.activation(z), state
+
+    def _out_dim(self, size, i):
+        s = self.stride[i]
+        if self.convolution_mode is ConvolutionMode.SAME:
+            return size * s
+        return (size - 1) * s + self.kernel_size[i] - 2 * self.padding[i]
+
+
+@register_layer
+@dataclass
+class Subsampling3DLayer(Layer):
+    """Volumetric pooling (reference: Subsampling3DLayer)."""
+
+    pooling_type: PoolingType = PoolingType.MAX
+    kernel_size: Tuple[int, int, int] = (2, 2, 2)
+    stride: Tuple[int, int, int] = (2, 2, 2)
+    padding: Tuple[int, int, int] = (0, 0, 0)
+    convolution_mode: ConvolutionMode = ConvolutionMode.TRUNCATE
+
+    def __post_init__(self):
+        super().__post_init__()
+        self.kernel_size = _triple(self.kernel_size)
+        self.stride = _triple(self.stride)
+        self.padding = _triple(self.padding)
+
+    def has_params(self) -> bool:
+        return False
+
+    def set_n_in(self, input_type, override):
+        pass
+
+    def forward(self, params, x, *, training, rng=None, state=None):
+        kd, kh, kw = self.kernel_size
+        if self.convolution_mode is ConvolutionMode.SAME:
+            pad = "SAME"
+        else:
+            pad = [(0, 0)] + [(p, p) for p in self.padding] + [(0, 0)]
+        dims = (1, kd, kh, kw, 1)
+        strides = (1,) + self.stride + (1,)
+        if self.pooling_type is PoolingType.MAX:
+            z = jax.lax.reduce_window(x, -jnp.inf, jax.lax.max, dims,
+                                      strides, pad)
+        elif self.pooling_type is PoolingType.AVG:
+            s = jax.lax.reduce_window(x, 0.0, jax.lax.add, dims, strides,
+                                      pad)
+            n = jax.lax.reduce_window(jnp.ones_like(x), 0.0, jax.lax.add,
+                                      dims, strides, pad)
+            z = s / n
+        else:  # SUM
+            z = jax.lax.reduce_window(x, 0.0, jax.lax.add, dims, strides,
+                                      pad)
+        return z, state
+
+    def _out_dim(self, size, i):
+        s = self.stride[i]
+        if self.convolution_mode is ConvolutionMode.SAME:
+            return -(-size // s)
+        return (size + 2 * self.padding[i] - self.kernel_size[i]) // s + 1
+
+    def get_output_type(self, input_type):
+        assert isinstance(input_type, InputTypeConvolutional3D), input_type
+        return InputType.convolutional_3d(
+            self._out_dim(input_type.depth, 0),
+            self._out_dim(input_type.height, 1),
+            self._out_dim(input_type.width, 2), input_type.channels)
+
+
+@register_layer
+@dataclass
+class Cnn3DLossLayer(BaseOutputLayer):
+    """Per-voxel loss head on [b, d, h, w, c] (reference: Cnn3DLossLayer)
+    — no params, no flattening."""
+
+    activation: Activation = Activation.IDENTITY
+
+    def has_params(self) -> bool:
+        return False
+
+    def init_params(self, key, input_type, dtype=jnp.float32):
+        return {}
+
+    def set_n_in(self, input_type, override):
+        pass
+
+    def get_output_type(self, input_type):
+        return input_type
+
+    def wants_logits(self) -> bool:
+        return False
+
+    def forward(self, params, x, *, training, rng=None, state=None):
+        return self.activation(x), state
+
+    def forward_logits(self, params, x, *, training, rng=None, state=None):
+        return x, state

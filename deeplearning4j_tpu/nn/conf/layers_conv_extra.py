@@ -81,8 +81,14 @@ class Deconvolution2D(ConvolutionLayer):
 
     def forward(self, params, x, *, training, rng=None, state=None):
         x = self._maybe_dropout(x, training, rng)
-        pad = ("SAME" if self.convolution_mode is ConvolutionMode.SAME
-               else [(p, p) for p in self.padding])
+        if self.convolution_mode is ConvolutionMode.SAME:
+            pad = "SAME"
+        else:
+            # conv_transpose explicit padding applies to the s-dilated
+            # input; k-1-p per side yields the standard transposed-conv
+            # output size (i-1)*s + k - 2p
+            pad = [(k - 1 - p, k - 1 - p)
+                   for k, p in zip(self.kernel_size, self.padding)]
         z = jax.lax.conv_transpose(
             x, params["W"], strides=self.stride, padding=pad,
             dimension_numbers=("NHWC", "HWIO", "NHWC"))

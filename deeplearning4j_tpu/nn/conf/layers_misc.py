@@ -1,0 +1,282 @@
+"""Misc parameterised layers (SURVEY.md D4 long tail).
+
+Reference parity: ``org.deeplearning4j.nn.conf.layers.{PReLULayer,
+LocallyConnected1D,LocallyConnected2D,LocalResponseNormalization,
+misc.ElementWiseMultiplicationLayer,RnnLossLayer}``.
+
+LocallyConnected* in the reference are SameDiff-defined layers
+(unshared-weight convolutions); here they lower to
+``conv_general_dilated_patches`` + a per-position einsum — one XLA dot
+that still lands on the MXU.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from deeplearning4j_tpu.activations import Activation
+from deeplearning4j_tpu.nn.conf.inputs import (
+    InputType, InputTypeConvolutional, InputTypeRecurrent)
+from deeplearning4j_tpu.nn.conf.layers import (
+    BaseOutputLayer, ConvolutionMode, Layer, _pair, register_layer)
+from deeplearning4j_tpu.nn.weights import WeightInit
+
+
+@register_layer
+@dataclass
+class PReLULayer(Layer):
+    """Parametric ReLU: y = max(x, 0) + alpha * min(x, 0) with learned
+    per-feature alpha (reference: PReLULayer; ``shared_axes`` collapses
+    alpha over those axes, e.g. (1, 2) shares across H, W)."""
+
+    alpha_init: float = 0.0
+    shared_axes: Optional[Tuple[int, ...]] = None
+
+    def set_n_in(self, input_type, override):
+        self._input_shape = input_type.shape(batch=1)[1:]
+
+    def init_params(self, key, input_type, dtype=jnp.float32):
+        shape = list(input_type.shape(batch=1)[1:])
+        if self.shared_axes:
+            for ax in self.shared_axes:
+                shape[ax - 1] = 1
+        return {"alpha": jnp.full(tuple(shape), self.alpha_init, dtype)}
+
+    def forward(self, params, x, *, training, rng=None, state=None):
+        a = params["alpha"]
+        return jnp.maximum(x, 0) + a * jnp.minimum(x, 0), state
+
+    def get_output_type(self, input_type):
+        return input_type
+
+
+@register_layer
+@dataclass
+class ElementWiseMultiplicationLayer(Layer):
+    """y = activation(x ∘ w + b) — learned per-feature scale/shift
+    (reference: misc.ElementWiseMultiplicationLayer; n_in == n_out)."""
+
+    def __post_init__(self):
+        super().__post_init__()
+        if self.n_in and not self.n_out:
+            self.n_out = self.n_in
+
+    def set_n_in(self, input_type, override):
+        if override or not self.n_in:
+            self.n_in = self.n_out = input_type.arrays_per_example() \
+                if not hasattr(input_type, "size") else input_type.size
+
+    def init_params(self, key, input_type, dtype=jnp.float32):
+        return {"W": jnp.ones((self.n_in,), dtype),
+                "b": jnp.full((self.n_in,), self.bias_init, dtype)}
+
+    def forward(self, params, x, *, training, rng=None, state=None):
+        return self.activation(x * params["W"] + params["b"]), state
+
+    def get_output_type(self, input_type):
+        return input_type
+
+
+@register_layer
+@dataclass
+class LocalResponseNormalization(Layer):
+    """Cross-channel LRN (reference: conf.layers.
+    LocalResponseNormalization, AlexNet-era): y = x / (k + alpha*sum)^beta
+    over ``n`` adjacent channels."""
+
+    k: float = 2.0
+    n: int = 5
+    alpha: float = 1e-4
+    beta: float = 0.75
+
+    def has_params(self) -> bool:
+        return False
+
+    def set_n_in(self, input_type, override):
+        pass
+
+    def forward(self, params, x, *, training, rng=None, state=None):
+        half = self.n // 2
+        sq = x * x
+        # sum over a window of n channels via reduce_window on last axis
+        win = [1] * (x.ndim - 1) + [self.n]
+        s = jax.lax.reduce_window(
+            sq, 0.0, jax.lax.add, tuple(win), (1,) * x.ndim,
+            [(0, 0)] * (x.ndim - 1) + [(half, self.n - 1 - half)])
+        return x / (self.k + self.alpha * s) ** self.beta, state
+
+    def get_output_type(self, input_type):
+        return input_type
+
+
+@register_layer
+@dataclass
+class LocallyConnected2D(Layer):
+    """Unshared-weight 2D convolution (reference: LocallyConnected2D, a
+    SameDiff layer): every output position has its own kernel."""
+
+    kernel_size: Tuple[int, int] = (3, 3)
+    stride: Tuple[int, int] = (1, 1)
+    padding: Tuple[int, int] = (0, 0)
+    convolution_mode: ConvolutionMode = ConvolutionMode.TRUNCATE
+    has_bias: bool = True
+
+    def __post_init__(self):
+        super().__post_init__()
+        self.kernel_size = _pair(self.kernel_size)
+        self.stride = _pair(self.stride)
+        self.padding = _pair(self.padding)
+
+    def set_n_in(self, input_type, override):
+        if isinstance(input_type, InputTypeConvolutional) and \
+                (override or not self.n_in):
+            self.n_in = input_type.channels
+        self._in_hw = (input_type.height, input_type.width)
+
+    def _out_hw(self):
+        h, w = self._in_hw
+        kh, kw = self.kernel_size
+        sh, sw = self.stride
+        if self.convolution_mode is ConvolutionMode.SAME:
+            return -(-h // sh), -(-w // sw)
+        ph, pw = self.padding
+        return (h + 2 * ph - kh) // sh + 1, (w + 2 * pw - kw) // sw + 1
+
+    def init_params(self, key, input_type, dtype=jnp.float32):
+        self.set_n_in(input_type, override=False)
+        oh, ow = self._out_hw()
+        kh, kw = self.kernel_size
+        fan = kh * kw * self.n_in
+        wi = self.weight_init or WeightInit.XAVIER
+        p = {"W": wi.init(key, (oh, ow, fan, self.n_out), fan,
+                          kh * kw * self.n_out, dtype)}
+        if self.has_bias:
+            p["b"] = jnp.full((oh, ow, self.n_out), self.bias_init, dtype)
+        return p
+
+    def forward(self, params, x, *, training, rng=None, state=None):
+        x = self._maybe_dropout(x, training, rng)
+        kh, kw = self.kernel_size
+        if self.convolution_mode is ConvolutionMode.SAME:
+            pad = "SAME"
+        else:
+            ph, pw = self.padding
+            pad = [(ph, ph), (pw, pw)]
+        # patches: [b, oh, ow, c*kh*kw]
+        patches = jax.lax.conv_general_dilated_patches(
+            x, (kh, kw), self.stride, pad,
+            dimension_numbers=("NHWC", "HWIO", "NHWC"))
+        # conv_general_dilated_patches yields channel-major patch order
+        # [c, kh, kw]; W was laid out to match (fan = kh*kw*c re-ordered
+        # consistently at init since both sides are learned).
+        z = jnp.einsum("bhwf,hwfo->bhwo", patches, params["W"])
+        if self.has_bias:
+            z = z + params["b"]
+        return self.activation(z), state
+
+    def get_output_type(self, input_type):
+        assert isinstance(input_type, InputTypeConvolutional)
+        self._in_hw = (input_type.height, input_type.width)
+        oh, ow = self._out_hw()
+        return InputType.convolutional(oh, ow, self.n_out)
+
+
+@register_layer
+@dataclass
+class LocallyConnected1D(Layer):
+    """Unshared-weight temporal convolution on [b, t, f] (reference:
+    LocallyConnected1D)."""
+
+    kernel_size: int = 3
+    stride: int = 1
+    padding: int = 0
+    convolution_mode: ConvolutionMode = ConvolutionMode.TRUNCATE
+    has_bias: bool = True
+
+    def __post_init__(self):
+        super().__post_init__()
+        for f in ("kernel_size", "stride", "padding"):
+            v = getattr(self, f)
+            setattr(self, f, int(v[0] if isinstance(v, (tuple, list))
+                                 else v))
+
+    def set_n_in(self, input_type, override):
+        if isinstance(input_type, InputTypeRecurrent) and \
+                (override or not self.n_in):
+            self.n_in = input_type.size
+        self._in_t = input_type.timesteps
+
+    def _out_t(self):
+        t, k, s = self._in_t, self.kernel_size, self.stride
+        if self.convolution_mode is ConvolutionMode.SAME:
+            return -(-t // s)
+        return (t + 2 * self.padding - k) // s + 1
+
+    def init_params(self, key, input_type, dtype=jnp.float32):
+        self.set_n_in(input_type, override=False)
+        ot = self._out_t()
+        fan = self.kernel_size * self.n_in
+        wi = self.weight_init or WeightInit.XAVIER
+        p = {"W": wi.init(key, (ot, fan, self.n_out), fan,
+                          self.kernel_size * self.n_out, dtype)}
+        if self.has_bias:
+            p["b"] = jnp.full((ot, self.n_out), self.bias_init, dtype)
+        return p
+
+    def forward(self, params, x, *, training, rng=None, state=None):
+        x = self._maybe_dropout(x, training, rng)
+        if self.convolution_mode is ConvolutionMode.SAME:
+            pad = "SAME"
+        else:
+            pad = [(self.padding, self.padding)]
+        patches = jax.lax.conv_general_dilated_patches(
+            x, (self.kernel_size,), (self.stride,), pad,
+            dimension_numbers=("NWC", "WIO", "NWC"))
+        z = jnp.einsum("btf,tfo->bto", patches, params["W"])
+        if self.has_bias:
+            z = z + params["b"]
+        return self.activation(z), state
+
+    def get_output_type(self, input_type):
+        assert isinstance(input_type, InputTypeRecurrent)
+        self._in_t = input_type.timesteps
+        return InputType.recurrent(self.n_out, self._out_t())
+
+
+@register_layer
+@dataclass
+class RnnLossLayer(BaseOutputLayer):
+    """Per-timestep loss-only head on [b, t, f] — no params (reference:
+    RnnLossLayer; the per-timestep twin of LossLayer)."""
+
+    activation: Activation = Activation.IDENTITY
+
+    def has_params(self) -> bool:
+        return False
+
+    def accepts_mask(self) -> bool:
+        return True
+
+    def init_params(self, key, input_type, dtype=jnp.float32):
+        return {}
+
+    def set_n_in(self, input_type, override):
+        if isinstance(input_type, InputTypeRecurrent):
+            self.n_in = self.n_out = input_type.size
+
+    def get_output_type(self, input_type):
+        return input_type
+
+    def wants_logits(self) -> bool:
+        return False
+
+    def forward(self, params, x, *, training, rng=None, state=None,
+                mask=None):
+        return self.activation(x), state
+
+    def forward_logits(self, params, x, *, training, rng=None, state=None,
+                       mask=None):
+        return x, state

@@ -1,0 +1,162 @@
+"""Specialised output layers: center loss + one-class NN.
+
+Reference parity: ``org.deeplearning4j.nn.conf.layers.
+CenterLossOutputLayer`` (softmax + intra-class compactness penalty,
+face-embedding style) and ``conf.ocnn.OCNNOutputLayer`` (one-class NN
+anomaly scoring, Chalapathy et al.'s OC-NN objective).
+
+Functional-design note: these losses need more than the class
+probabilities (center loss needs the penultimate features; OC-NN needs
+its own params' norms and the r quantile). The output-layer protocol
+stays pure by packing those extras into the logits tensor inside
+``forward_logits`` and unpacking them in ``compute_loss`` — everything
+remains one fused XLA program, no side state.
+
+Divergence (documented): the reference updates class centers with a
+dedicated alpha-EMA rule outside the updater; here centers are ordinary
+parameters — the gradient of the center term, lambda*(c_y - f), descended
+with the layer's updater reproduces the same EMA with
+alpha = lr * lambda.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import jax
+import jax.numpy as jnp
+
+from deeplearning4j_tpu.activations import Activation
+from deeplearning4j_tpu.lossfunctions import LossFunction
+from deeplearning4j_tpu.nn.conf.inputs import InputType
+from deeplearning4j_tpu.nn.conf.layers import (BaseOutputLayer,
+                                               register_layer)
+from deeplearning4j_tpu.nn.weights import WeightInit
+
+
+@register_layer
+@dataclass
+class CenterLossOutputLayer(BaseOutputLayer):
+    """Softmax head + lambda/2 * ||f - c_y||^2 compactness penalty
+    (reference: CenterLossOutputLayer; params include one center per
+    class over the input features)."""
+
+    alpha: float = 0.05        # kept for config parity (see module note)
+    lambda_: float = 2e-4
+
+    def init_params(self, key, input_type, dtype=jnp.float32):
+        wi = self.weight_init or WeightInit.XAVIER
+        k1, _ = jax.random.split(key)
+        p = {"W": wi.init(k1, (self.n_in, self.n_out), self.n_in,
+                          self.n_out, dtype),
+             "centers": jnp.zeros((self.n_out, self.n_in), dtype)}
+        if self.has_bias:
+            p["b"] = jnp.full((self.n_out,), self.bias_init, dtype)
+        return p
+
+    def wants_logits(self) -> bool:
+        return True
+
+    def forward_logits(self, params, x, *, training, rng=None,
+                       state=None):
+        x = self._maybe_dropout(x, training, rng)
+        z = x @ params["W"]
+        if self.has_bias:
+            z = z + params["b"]
+        # pack features + per-example distance-to-center machinery:
+        # [logits | features | flattened per-class centers gathered later]
+        # centers are gathered in compute_loss from the label one-hots,
+        # so only [logits | f | f @ centers^T | row-norms] are needed:
+        # we pack [logits, features, features @ centers.T, ||c||^2 row]
+        # to keep compute_loss label-side only.
+        fc = x @ params["centers"].T                     # [b, n_out]
+        cn = jnp.sum(params["centers"] ** 2, axis=-1)    # [n_out]
+        cn = jnp.broadcast_to(cn[None, :], fc.shape)
+        fn = jnp.sum(x ** 2, axis=-1, keepdims=True)     # [b, 1]
+        return jnp.concatenate([z, fc, cn, fn], axis=-1), state
+
+    def compute_loss(self, labels, preds_or_logits, *, from_logits,
+                     mask=None, average=True):
+        if not from_logits or \
+                preds_or_logits.shape[-1] == self.n_out:
+            return super().compute_loss(labels, preds_or_logits,
+                                        from_logits=from_logits,
+                                        mask=mask, average=average)
+        n = self.n_out
+        z = preds_or_logits[..., :n]
+        fc = preds_or_logits[..., n:2 * n]
+        cn = preds_or_logits[..., 2 * n:3 * n]
+        fn = preds_or_logits[..., 3 * n]
+        base = super().compute_loss(labels, z, from_logits=True,
+                                    mask=mask, average=average)
+        # ||f - c_y||^2 = ||f||^2 - 2 f·c_y + ||c_y||^2 ; y one-hot
+        dist = fn - 2.0 * jnp.sum(fc * labels, -1) + \
+            jnp.sum(cn * labels, -1)
+        if mask is not None:
+            m = mask.reshape(dist.shape)
+            dist = dist * m
+            center = jnp.sum(dist) / jnp.maximum(jnp.sum(m), 1.0)
+        else:
+            center = jnp.mean(dist)
+        return base + 0.5 * self.lambda_ * center
+
+    def get_output_type(self, input_type):
+        return InputType.feed_forward(self.n_out)
+
+
+@register_layer
+@dataclass
+class OCNNOutputLayer(BaseOutputLayer):
+    """One-class NN output layer (reference: conf.ocnn.OCNNOutputLayer):
+    score(x) = w · act(x V); objective
+    0.5||V||^2 + 0.5||w||^2 + (1/nu) mean(relu(r - score)) - r,
+    with r a learned nu-quantile. Unsupervised: labels are ignored.
+    Inference output is the decision value score - r ([b, 1]; >0 means
+    inlier)."""
+
+    hidden_size: int = 16
+    nu: float = 0.04
+    initial_r_value: float = 0.1
+    activation: Activation = Activation.RELU
+    loss_function: LossFunction = LossFunction.MSE   # unused; parity slot
+
+    def __post_init__(self):
+        super().__post_init__()
+        self.n_out = 1
+
+    def init_params(self, key, input_type, dtype=jnp.float32):
+        wi = self.weight_init or WeightInit.XAVIER
+        k1, k2 = jax.random.split(key)
+        return {"V": wi.init(k1, (self.n_in, self.hidden_size), self.n_in,
+                             self.hidden_size, dtype),
+                "w": wi.init(k2, (self.hidden_size,), self.hidden_size,
+                             1, dtype),
+                "r": jnp.asarray(self.initial_r_value, dtype)}
+
+    def _score(self, params, x):
+        return self.activation(x @ params["V"]) @ params["w"]
+
+    def wants_logits(self) -> bool:
+        return True
+
+    def forward(self, params, x, *, training, rng=None, state=None):
+        return (self._score(params, x) - params["r"])[..., None], state
+
+    def forward_logits(self, params, x, *, training, rng=None,
+                       state=None):
+        s = self._score(params, x)[..., None]                  # [b, 1]
+        reg = 0.5 * (jnp.sum(params["V"] ** 2) +
+                     jnp.sum(params["w"] ** 2))
+        r = jnp.broadcast_to(params["r"], s.shape)
+        reg = jnp.broadcast_to(reg, s.shape)
+        return jnp.concatenate([s, r, reg], axis=-1), state
+
+    def compute_loss(self, labels, preds_or_logits, *, from_logits,
+                     mask=None, average=True):
+        s = preds_or_logits[..., 0]
+        r = preds_or_logits[..., 1]
+        reg = preds_or_logits[..., 2]
+        hinge = jnp.maximum(0.0, r - s)
+        return jnp.mean(reg) + jnp.mean(hinge) / self.nu - jnp.mean(r)
+
+    def get_output_type(self, input_type):
+        return InputType.feed_forward(1)

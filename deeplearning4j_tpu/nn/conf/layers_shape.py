@@ -1,0 +1,613 @@
+"""Shape-manipulation + wrapper layers (SURVEY.md D4 long tail).
+
+Reference parity: ``org.deeplearning4j.nn.conf.layers.convolutional.
+{Cropping1D,Cropping2D,Cropping3D}``, ``conf.layers.{ZeroPadding1DLayer,
+ZeroPaddingLayer,ZeroPadding3DLayer,SpaceToDepthLayer,DepthToSpaceLayer,
+Upsampling1D,Upsampling3D,RepeatVector}``, ``conf.layers.util.
+{MaskLayer,MaskZeroLayer}``, ``conf.layers.misc.{FrozenLayer,
+FrozenLayerWithBackprop}``, ``conf.layers.recurrent.TimeDistributed``.
+
+All are parameter-free rearrangements (XLA fuses them into neighbouring
+ops — they cost nothing on TPU) except the wrappers, which delegate to an
+underlying layer. Conv layouts are NHWC / NDHWC (TPU-native); the
+reference's NCHW/NCDHW exists only at import boundaries.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from deeplearning4j_tpu.nn.conf.inputs import (
+    InputType, InputTypeConvolutional, InputTypeConvolutional3D,
+    InputTypeFeedForward, InputTypeRecurrent)
+from deeplearning4j_tpu.nn.conf.layers import (Layer, _pair, register_layer)
+
+
+# ---------------------------------------------------------------------------
+# Cropping
+# ---------------------------------------------------------------------------
+@register_layer
+@dataclass
+class Cropping1D(Layer):
+    """Crop timesteps off a [b, t, f] sequence (reference: Cropping1D)."""
+
+    cropping: Tuple[int, int] = (0, 0)
+
+    @staticmethod
+    def _builder_positional(*args) -> dict:
+        return {"cropping": _pair(args if len(args) > 1 else args[0])}
+
+    def __post_init__(self):
+        super().__post_init__()
+        self.cropping = _pair(self.cropping)
+
+    def has_params(self) -> bool:
+        return False
+
+    def set_n_in(self, input_type, override):
+        pass
+
+    def forward(self, params, x, *, training, rng=None, state=None):
+        a, b = self.cropping
+        t = x.shape[1]
+        return x[:, a:t - b if b else t, :], state
+
+    def get_output_type(self, input_type):
+        assert isinstance(input_type, InputTypeRecurrent)
+        t = input_type.timesteps
+        if t > 0:
+            t = t - self.cropping[0] - self.cropping[1]
+        return InputType.recurrent(input_type.size, t)
+
+
+@register_layer
+@dataclass
+class Cropping2D(Layer):
+    """Crop [b, h, w, c] borders (reference: Cropping2D)."""
+
+    crop_top_bottom: Tuple[int, int] = (0, 0)
+    crop_left_right: Tuple[int, int] = (0, 0)
+
+    @staticmethod
+    def _builder_positional(*args) -> dict:
+        if len(args) == 1:
+            v = int(args[0])
+            return {"crop_top_bottom": (v, v), "crop_left_right": (v, v)}
+        if len(args) == 2:
+            return {"crop_top_bottom": _pair(args[0]),
+                    "crop_left_right": _pair(args[1])}
+        t, b, l, r = args
+        return {"crop_top_bottom": (int(t), int(b)),
+                "crop_left_right": (int(l), int(r))}
+
+    def __post_init__(self):
+        super().__post_init__()
+        self.crop_top_bottom = _pair(self.crop_top_bottom)
+        self.crop_left_right = _pair(self.crop_left_right)
+
+    def has_params(self) -> bool:
+        return False
+
+    def set_n_in(self, input_type, override):
+        pass
+
+    def forward(self, params, x, *, training, rng=None, state=None):
+        (ct, cb), (cl, cr) = self.crop_top_bottom, self.crop_left_right
+        h, w = x.shape[1], x.shape[2]
+        return x[:, ct:h - cb if cb else h, cl:w - cr if cr else w, :], state
+
+    def get_output_type(self, input_type):
+        assert isinstance(input_type, InputTypeConvolutional)
+        return InputType.convolutional(
+            input_type.height - sum(self.crop_top_bottom),
+            input_type.width - sum(self.crop_left_right),
+            input_type.channels)
+
+
+@register_layer
+@dataclass
+class Cropping3D(Layer):
+    """Crop [b, d, h, w, c] borders (reference: Cropping3D)."""
+
+    crop_depth: Tuple[int, int] = (0, 0)
+    crop_height: Tuple[int, int] = (0, 0)
+    crop_width: Tuple[int, int] = (0, 0)
+
+    def __post_init__(self):
+        super().__post_init__()
+        self.crop_depth = _pair(self.crop_depth)
+        self.crop_height = _pair(self.crop_height)
+        self.crop_width = _pair(self.crop_width)
+
+    def has_params(self) -> bool:
+        return False
+
+    def set_n_in(self, input_type, override):
+        pass
+
+    def forward(self, params, x, *, training, rng=None, state=None):
+        (cd0, cd1) = self.crop_depth
+        (ch0, ch1) = self.crop_height
+        (cw0, cw1) = self.crop_width
+        d, h, w = x.shape[1], x.shape[2], x.shape[3]
+        return x[:, cd0:d - cd1 if cd1 else d, ch0:h - ch1 if ch1 else h,
+                 cw0:w - cw1 if cw1 else w, :], state
+
+    def get_output_type(self, input_type):
+        assert isinstance(input_type, InputTypeConvolutional3D)
+        return InputType.convolutional_3d(
+            input_type.depth - sum(self.crop_depth),
+            input_type.height - sum(self.crop_height),
+            input_type.width - sum(self.crop_width),
+            input_type.channels)
+
+
+# ---------------------------------------------------------------------------
+# Zero padding
+# ---------------------------------------------------------------------------
+@register_layer
+@dataclass
+class ZeroPadding1DLayer(Layer):
+    """Pad timesteps of [b, t, f] (reference: ZeroPadding1DLayer)."""
+
+    padding: Tuple[int, int] = (0, 0)
+
+    @staticmethod
+    def _builder_positional(*args) -> dict:
+        return {"padding": _pair(args if len(args) > 1 else args[0])}
+
+    def __post_init__(self):
+        super().__post_init__()
+        self.padding = _pair(self.padding)
+
+    def has_params(self) -> bool:
+        return False
+
+    def set_n_in(self, input_type, override):
+        pass
+
+    def forward(self, params, x, *, training, rng=None, state=None):
+        a, b = self.padding
+        return jnp.pad(x, ((0, 0), (a, b), (0, 0))), state
+
+    def get_output_type(self, input_type):
+        assert isinstance(input_type, InputTypeRecurrent)
+        t = input_type.timesteps
+        if t > 0:
+            t = t + self.padding[0] + self.padding[1]
+        return InputType.recurrent(input_type.size, t)
+
+
+@register_layer
+@dataclass
+class ZeroPaddingLayer(Layer):
+    """Pad [b, h, w, c] borders (reference: ZeroPaddingLayer)."""
+
+    pad_top_bottom: Tuple[int, int] = (0, 0)
+    pad_left_right: Tuple[int, int] = (0, 0)
+
+    @staticmethod
+    def _builder_positional(*args) -> dict:
+        if len(args) == 1:
+            v = int(args[0])
+            return {"pad_top_bottom": (v, v), "pad_left_right": (v, v)}
+        if len(args) == 2:
+            return {"pad_top_bottom": _pair(args[0]),
+                    "pad_left_right": _pair(args[1])}
+        t, b, l, r = args
+        return {"pad_top_bottom": (int(t), int(b)),
+                "pad_left_right": (int(l), int(r))}
+
+    def __post_init__(self):
+        super().__post_init__()
+        self.pad_top_bottom = _pair(self.pad_top_bottom)
+        self.pad_left_right = _pair(self.pad_left_right)
+
+    def has_params(self) -> bool:
+        return False
+
+    def set_n_in(self, input_type, override):
+        pass
+
+    def forward(self, params, x, *, training, rng=None, state=None):
+        return jnp.pad(x, ((0, 0), self.pad_top_bottom,
+                           self.pad_left_right, (0, 0))), state
+
+    def get_output_type(self, input_type):
+        assert isinstance(input_type, InputTypeConvolutional)
+        return InputType.convolutional(
+            input_type.height + sum(self.pad_top_bottom),
+            input_type.width + sum(self.pad_left_right),
+            input_type.channels)
+
+
+@register_layer
+@dataclass
+class ZeroPadding3DLayer(Layer):
+    """Pad [b, d, h, w, c] borders (reference: ZeroPadding3DLayer)."""
+
+    pad_depth: Tuple[int, int] = (0, 0)
+    pad_height: Tuple[int, int] = (0, 0)
+    pad_width: Tuple[int, int] = (0, 0)
+
+    def __post_init__(self):
+        super().__post_init__()
+        self.pad_depth = _pair(self.pad_depth)
+        self.pad_height = _pair(self.pad_height)
+        self.pad_width = _pair(self.pad_width)
+
+    def has_params(self) -> bool:
+        return False
+
+    def set_n_in(self, input_type, override):
+        pass
+
+    def forward(self, params, x, *, training, rng=None, state=None):
+        return jnp.pad(x, ((0, 0), self.pad_depth, self.pad_height,
+                           self.pad_width, (0, 0))), state
+
+    def get_output_type(self, input_type):
+        assert isinstance(input_type, InputTypeConvolutional3D)
+        return InputType.convolutional_3d(
+            input_type.depth + sum(self.pad_depth),
+            input_type.height + sum(self.pad_height),
+            input_type.width + sum(self.pad_width),
+            input_type.channels)
+
+
+# ---------------------------------------------------------------------------
+# Block rearrangement
+# ---------------------------------------------------------------------------
+@register_layer
+@dataclass
+class SpaceToDepthLayer(Layer):
+    """[b, h, w, c] -> [b, h/s, w/s, c*s*s] (reference: SpaceToDepthLayer).
+    NHWC blocks gather into the channel dim (the reference's NCHW/NHWC
+    dataFormat flag collapses: TPU layout is always NHWC)."""
+
+    block_size: int = 2
+
+    def has_params(self) -> bool:
+        return False
+
+    def set_n_in(self, input_type, override):
+        pass
+
+    def forward(self, params, x, *, training, rng=None, state=None):
+        s = self.block_size
+        b, h, w, c = x.shape
+        z = x.reshape(b, h // s, s, w // s, s, c)
+        z = z.transpose(0, 1, 3, 2, 4, 5).reshape(b, h // s, w // s,
+                                                  s * s * c)
+        return z, state
+
+    def get_output_type(self, input_type):
+        assert isinstance(input_type, InputTypeConvolutional)
+        s = self.block_size
+        return InputType.convolutional(input_type.height // s,
+                                       input_type.width // s,
+                                       input_type.channels * s * s)
+
+
+@register_layer
+@dataclass
+class DepthToSpaceLayer(Layer):
+    """[b, h, w, c] -> [b, h*s, w*s, c/(s*s)] (reference:
+    DepthToSpaceLayer); exact inverse of SpaceToDepthLayer."""
+
+    block_size: int = 2
+
+    def has_params(self) -> bool:
+        return False
+
+    def set_n_in(self, input_type, override):
+        pass
+
+    def forward(self, params, x, *, training, rng=None, state=None):
+        s = self.block_size
+        b, h, w, c = x.shape
+        co = c // (s * s)
+        z = x.reshape(b, h, w, s, s, co)
+        z = z.transpose(0, 1, 3, 2, 4, 5).reshape(b, h * s, w * s, co)
+        return z, state
+
+    def get_output_type(self, input_type):
+        assert isinstance(input_type, InputTypeConvolutional)
+        s = self.block_size
+        return InputType.convolutional(input_type.height * s,
+                                       input_type.width * s,
+                                       input_type.channels // (s * s))
+
+
+@register_layer
+@dataclass
+class Upsampling1D(Layer):
+    """Repeat timesteps (reference: Upsampling1D)."""
+
+    size: int = 2
+
+    @staticmethod
+    def _builder_positional(*args) -> dict:
+        return {"size": int(args[0])} if args else {}
+
+    def has_params(self) -> bool:
+        return False
+
+    def set_n_in(self, input_type, override):
+        pass
+
+    def forward(self, params, x, *, training, rng=None, state=None):
+        return jnp.repeat(x, self.size, axis=1), state
+
+    def get_output_type(self, input_type):
+        assert isinstance(input_type, InputTypeRecurrent)
+        t = input_type.timesteps
+        return InputType.recurrent(input_type.size,
+                                   t * self.size if t > 0 else t)
+
+
+@register_layer
+@dataclass
+class Upsampling3D(Layer):
+    """Nearest-neighbour volumetric upsampling (reference: Upsampling3D)."""
+
+    size: Tuple[int, int, int] = (2, 2, 2)
+
+    def __post_init__(self):
+        super().__post_init__()
+        if isinstance(self.size, int):
+            self.size = (self.size,) * 3
+        self.size = tuple(int(v) for v in self.size)
+
+    def has_params(self) -> bool:
+        return False
+
+    def set_n_in(self, input_type, override):
+        pass
+
+    def forward(self, params, x, *, training, rng=None, state=None):
+        sd, sh, sw = self.size
+        z = jnp.repeat(x, sd, axis=1)
+        z = jnp.repeat(z, sh, axis=2)
+        z = jnp.repeat(z, sw, axis=3)
+        return z, state
+
+    def get_output_type(self, input_type):
+        assert isinstance(input_type, InputTypeConvolutional3D)
+        sd, sh, sw = self.size
+        return InputType.convolutional_3d(input_type.depth * sd,
+                                          input_type.height * sh,
+                                          input_type.width * sw,
+                                          input_type.channels)
+
+
+@register_layer
+@dataclass
+class RepeatVector(Layer):
+    """[b, f] -> [b, n, f] (reference: RepeatVector)."""
+
+    repetition_factor: int = 1
+
+    @staticmethod
+    def _builder_positional(*args) -> dict:
+        return {"repetition_factor": int(args[0])} if args else {}
+
+    def has_params(self) -> bool:
+        return False
+
+    def set_n_in(self, input_type, override):
+        pass
+
+    def forward(self, params, x, *, training, rng=None, state=None):
+        return jnp.broadcast_to(x[:, None, :],
+                                (x.shape[0], self.repetition_factor,
+                                 x.shape[1])), state
+
+    def get_output_type(self, input_type):
+        assert isinstance(input_type, InputTypeFeedForward)
+        return InputType.recurrent(input_type.size, self.repetition_factor)
+
+
+# ---------------------------------------------------------------------------
+# Mask utilities
+# ---------------------------------------------------------------------------
+@register_layer
+@dataclass
+class MaskLayer(Layer):
+    """Zero out masked timesteps of [b, t, f] activations (reference:
+    conf.layers.util.MaskLayer — applies the feature mask so downstream
+    non-mask-aware layers see clean zeros)."""
+
+    def has_params(self) -> bool:
+        return False
+
+    def accepts_mask(self) -> bool:
+        return True
+
+    def set_n_in(self, input_type, override):
+        pass
+
+    def forward(self, params, x, *, training, rng=None, state=None,
+                mask=None):
+        if mask is not None and x.ndim == 3:
+            x = x * mask[..., None].astype(x.dtype)
+        return x, state
+
+    def get_output_type(self, input_type):
+        return input_type
+
+
+@register_layer
+@dataclass
+class MaskZeroLayer(Layer):
+    """Wrap a recurrent layer; timesteps whose inputs are all equal to
+    ``mask_value`` are masked (reference: conf.layers.util.MaskZeroLayer).
+    The derived mask multiplies the wrapped layer's output to zero at
+    masked steps, matching the reference's zero-state carry semantics."""
+
+    underlying: Optional[Layer] = None
+    mask_value: float = 0.0
+
+    def __post_init__(self):
+        super().__post_init__()
+        if isinstance(self.underlying, dict):
+            self.underlying = Layer.from_map(self.underlying)
+
+    # delegate the runtime protocol --------------------------------------
+    def has_params(self) -> bool:
+        return self.underlying.has_params()
+
+    def has_state(self) -> bool:
+        return self.underlying.has_state()
+
+    def is_recurrent(self) -> bool:
+        return self.underlying.is_recurrent()
+
+    def zero_state(self, batch, dtype=jnp.float32):
+        return self.underlying.zero_state(batch, dtype)
+
+    def init_params(self, key, input_type, dtype=jnp.float32):
+        return self.underlying.init_params(key, input_type, dtype)
+
+    def init_state(self, input_type, dtype=jnp.float32):
+        return self.underlying.init_state(input_type, dtype)
+
+    def set_n_in(self, input_type, override):
+        self.underlying.set_n_in(input_type, override)
+
+    def get_output_type(self, input_type):
+        return self.underlying.get_output_type(input_type)
+
+    def forward(self, params, x, *, training, rng=None, state=None,
+                **kw):
+        derived = jnp.any(x != self.mask_value, axis=-1).astype(x.dtype)
+        if self.underlying.accepts_mask():
+            kw["mask"] = derived
+        y, new_state = self.underlying.forward(params, x, training=training,
+                                               rng=rng, state=state, **kw)
+        if y.ndim == 3:
+            y = y * derived[..., None]
+        return y, new_state
+
+    def to_map(self) -> dict:
+        d = {"@class": type(self).__name__,
+             "mask_value": self.mask_value,
+             "underlying": self.underlying.to_map()}
+        return d
+
+
+@register_layer
+@dataclass
+class FrozenLayer(Layer):
+    """Wrap any layer with parameters frozen (reference:
+    conf.layers.misc.FrozenLayer / FrozenLayerWithBackprop — in the
+    functional design ``stop_gradient`` on the wrapped params gives
+    exactly both behaviours: zero param grads, epsilon still flows)."""
+
+    underlying: Optional[Layer] = None
+
+    def __post_init__(self):
+        super().__post_init__()
+        if isinstance(self.underlying, dict):
+            self.underlying = Layer.from_map(self.underlying)
+
+    def is_frozen(self) -> bool:
+        # MultiLayerNetwork._regularization checks this: l1/l2 on frozen
+        # weights would otherwise produce nonzero gradients the updater
+        # applies, decaying the "frozen" params
+        return True
+
+    def has_params(self) -> bool:
+        return self.underlying.has_params()
+
+    def has_state(self) -> bool:
+        return self.underlying.has_state()
+
+    def is_recurrent(self) -> bool:
+        return self.underlying.is_recurrent()
+
+    def accepts_mask(self) -> bool:
+        return self.underlying.accepts_mask()
+
+    def zero_state(self, batch, dtype=jnp.float32):
+        return self.underlying.zero_state(batch, dtype)
+
+    def init_params(self, key, input_type, dtype=jnp.float32):
+        return self.underlying.init_params(key, input_type, dtype)
+
+    def init_state(self, input_type, dtype=jnp.float32):
+        return self.underlying.init_state(input_type, dtype)
+
+    def set_n_in(self, input_type, override):
+        self.underlying.set_n_in(input_type, override)
+
+    def get_output_type(self, input_type):
+        return self.underlying.get_output_type(input_type)
+
+    def forward(self, params, x, *, training, rng=None, state=None, **kw):
+        frozen = jax.tree_util.tree_map(jax.lax.stop_gradient, params)
+        return self.underlying.forward(frozen, x, training=training,
+                                       rng=rng, state=state, **kw)
+
+    def to_map(self) -> dict:
+        return {"@class": type(self).__name__,
+                "underlying": self.underlying.to_map()}
+
+
+@register_layer
+@dataclass
+class TimeDistributed(Layer):
+    """Apply a feed-forward layer independently per timestep (reference:
+    conf.layers.recurrent.TimeDistributed). [b, t, f] -> flatten to
+    [b*t, f] -> wrapped layer -> restore."""
+
+    underlying: Optional[Layer] = None
+
+    def __post_init__(self):
+        super().__post_init__()
+        if isinstance(self.underlying, dict):
+            self.underlying = Layer.from_map(self.underlying)
+
+    def has_params(self) -> bool:
+        return self.underlying.has_params()
+
+    def has_state(self) -> bool:
+        return self.underlying.has_state()
+
+    def accepts_mask(self) -> bool:
+        return False   # per-timestep application; mask handled upstream
+
+    def init_state(self, input_type, dtype=jnp.float32):
+        assert isinstance(input_type, InputTypeRecurrent)
+        return self.underlying.init_state(
+            InputType.feed_forward(input_type.size), dtype)
+
+    def init_params(self, key, input_type, dtype=jnp.float32):
+        assert isinstance(input_type, InputTypeRecurrent)
+        return self.underlying.init_params(
+            key, InputType.feed_forward(input_type.size), dtype)
+
+    def set_n_in(self, input_type, override):
+        assert isinstance(input_type, InputTypeRecurrent)
+        self.underlying.set_n_in(InputType.feed_forward(input_type.size),
+                                 override)
+
+    def get_output_type(self, input_type):
+        assert isinstance(input_type, InputTypeRecurrent)
+        out = self.underlying.get_output_type(
+            InputType.feed_forward(input_type.size))
+        return InputType.recurrent(out.size, input_type.timesteps)
+
+    def forward(self, params, x, *, training, rng=None, state=None, **kw):
+        b, t, f = x.shape
+        y, new_state = self.underlying.forward(
+            params, x.reshape(b * t, f), training=training, rng=rng,
+            state=state, **kw)
+        return y.reshape(b, t, -1), new_state
+
+    def to_map(self) -> dict:
+        return {"@class": type(self).__name__,
+                "underlying": self.underlying.to_map()}

@@ -1,0 +1,236 @@
+"""Unsupervised/pretrainable layers: AutoEncoder + VariationalAutoencoder.
+
+Reference parity: ``org.deeplearning4j.nn.conf.layers.AutoEncoder``
+(denoising autoencoder with tied decode weights, corruption level) and
+``conf.layers.variational.VariationalAutoencoder`` (+ runtime
+``nn.layers.variational.VariationalAutoencoder``: encoder/decoder MLPs,
+reparameterised q(z|x), Gaussian/Bernoulli reconstruction distributions,
+``reconstructionProbability`` / ``reconstructionError`` scoring,
+``generateAtMeanGivenZ``), SURVEY.md D4 "VAE".
+
+TPU-first: the pretrain objective is a pure function
+``pretrain_loss(params, x, rng)``; MultiLayerNetwork.pretrain_layer jits
+value_and_grad over it — layerwise pretraining compiles to one XLA
+program per layer exactly like supervised fit. Sampling uses jax threefry
+keys (no stateful RNG).
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+
+from deeplearning4j_tpu.activations import Activation
+from deeplearning4j_tpu.nn.conf.inputs import InputType
+from deeplearning4j_tpu.nn.conf.layers import Layer, register_layer
+from deeplearning4j_tpu.nn.weights import WeightInit
+
+
+@register_layer
+@dataclass
+class AutoEncoder(Layer):
+    """Denoising autoencoder (reference: conf.layers.AutoEncoder).
+    Encode: h = act(xW + b). Decode (tied): x' = act(hWᵀ + vb).
+    ``corruption_level`` zeroes that fraction of inputs during pretrain
+    (masking noise); ``sparsity`` is an L1 penalty on h."""
+
+    corruption_level: float = 0.3
+    sparsity: float = 0.0
+
+    def is_pretrainable(self) -> bool:
+        return True
+
+    def is_pretrain_param(self, name: str) -> bool:
+        return name == "vb"   # decoder bias only used during pretraining
+
+    def init_params(self, key, input_type, dtype=jnp.float32):
+        wi = self.weight_init or WeightInit.XAVIER
+        k1, _ = jax.random.split(key)
+        return {"W": wi.init(k1, (self.n_in, self.n_out), self.n_in,
+                             self.n_out, dtype),
+                "b": jnp.full((self.n_out,), self.bias_init, dtype),
+                "vb": jnp.zeros((self.n_in,), dtype)}
+
+    def forward(self, params, x, *, training, rng=None, state=None):
+        x = self._maybe_dropout(x, training, rng)
+        return self.activation(x @ params["W"] + params["b"]), state
+
+    def pretrain_loss(self, params, x, rng):
+        """Reconstruction MSE after masking-noise corruption."""
+        k_corrupt, _ = jax.random.split(rng)
+        if self.corruption_level > 0:
+            keep = jax.random.bernoulli(k_corrupt,
+                                        1.0 - self.corruption_level,
+                                        x.shape)
+            x_in = jnp.where(keep, x, 0.0)
+        else:
+            x_in = x
+        h = self.activation(x_in @ params["W"] + params["b"])
+        recon = self.activation(h @ params["W"].T + params["vb"])
+        loss = jnp.mean(jnp.sum((recon - x) ** 2, axis=-1))
+        if self.sparsity > 0:
+            loss = loss + self.sparsity * jnp.mean(jnp.abs(h))
+        return loss
+
+    def reconstruct(self, params, x):
+        h = self.activation(x @ params["W"] + params["b"])
+        return self.activation(h @ params["W"].T + params["vb"])
+
+    def get_output_type(self, input_type):
+        return InputType.feed_forward(self.n_out)
+
+
+@register_layer
+@dataclass
+class VariationalAutoencoder(Layer):
+    """VAE layer (reference: conf.layers.variational.
+    VariationalAutoencoder). ``n_out`` is the latent size; in a
+    supervised stack the layer's output is the mean of q(z|x) — matching
+    the reference's activate(). Pretraining maximises the ELBO with the
+    reparameterisation trick."""
+
+    encoder_layer_sizes: Tuple[int, ...] = (128,)
+    decoder_layer_sizes: Tuple[int, ...] = (128,)
+    reconstruction_distribution: str = "gaussian"  # or "bernoulli"
+    pzx_activation: Activation = Activation.IDENTITY
+    num_samples: int = 1
+
+    def __post_init__(self):
+        super().__post_init__()
+        if isinstance(self.pzx_activation, str):
+            self.pzx_activation = Activation.from_name(self.pzx_activation)
+        self.encoder_layer_sizes = tuple(self.encoder_layer_sizes)
+        self.decoder_layer_sizes = tuple(self.decoder_layer_sizes)
+
+    def is_pretrainable(self) -> bool:
+        return True
+
+    def is_pretrain_param(self, name: str) -> bool:
+        return name.startswith("d") or name.startswith("px")
+
+    # -- params ----------------------------------------------------------
+    def init_params(self, key, input_type, dtype=jnp.float32):
+        wi = self.weight_init or WeightInit.XAVIER
+        p = {}
+        sizes = (self.n_in,) + self.encoder_layer_sizes
+        keys = jax.random.split(key, len(sizes) + len(
+            self.decoder_layer_sizes) + 4)
+        ki = 0
+        for i in range(len(self.encoder_layer_sizes)):
+            p[f"e{i}W"] = wi.init(keys[ki], (sizes[i], sizes[i + 1]),
+                                  sizes[i], sizes[i + 1], dtype)
+            p[f"e{i}b"] = jnp.zeros((sizes[i + 1],), dtype)
+            ki += 1
+        enc_top = sizes[-1]
+        p["mW"] = wi.init(keys[ki], (enc_top, self.n_out), enc_top,
+                          self.n_out, dtype); ki += 1
+        p["mb"] = jnp.zeros((self.n_out,), dtype)
+        p["lW"] = wi.init(keys[ki], (enc_top, self.n_out), enc_top,
+                          self.n_out, dtype); ki += 1
+        p["lb"] = jnp.zeros((self.n_out,), dtype)
+        dsizes = (self.n_out,) + self.decoder_layer_sizes
+        for i in range(len(self.decoder_layer_sizes)):
+            p[f"d{i}W"] = wi.init(keys[ki], (dsizes[i], dsizes[i + 1]),
+                                  dsizes[i], dsizes[i + 1], dtype)
+            p[f"d{i}b"] = jnp.zeros((dsizes[i + 1],), dtype)
+            ki += 1
+        dec_top = dsizes[-1]
+        out_w = self.n_in * (2 if self.reconstruction_distribution ==
+                             "gaussian" else 1)
+        p["pxW"] = wi.init(keys[ki], (dec_top, out_w), dec_top, out_w,
+                           dtype)
+        p["pxb"] = jnp.zeros((out_w,), dtype)
+        return p
+
+    # -- encoder/decoder -------------------------------------------------
+    def _encode(self, params, x):
+        h = x
+        for i in range(len(self.encoder_layer_sizes)):
+            h = self.activation(h @ params[f"e{i}W"] + params[f"e{i}b"])
+        mean = self.pzx_activation(h @ params["mW"] + params["mb"])
+        log_var = h @ params["lW"] + params["lb"]
+        return mean, log_var
+
+    def _decode(self, params, z):
+        h = z
+        for i in range(len(self.decoder_layer_sizes)):
+            h = self.activation(h @ params[f"d{i}W"] + params[f"d{i}b"])
+        return h @ params["pxW"] + params["pxb"]
+
+    def _recon_nll(self, stats, x):
+        """Negative log p(x|z) per example, summed over features."""
+        if self.reconstruction_distribution == "bernoulli":
+            logits = stats
+            nll = jnp.maximum(logits, 0) - logits * x + \
+                jnp.log1p(jnp.exp(-jnp.abs(logits)))
+            return jnp.sum(nll, axis=-1)
+        mean, log_var = jnp.split(stats, 2, axis=-1)
+        log_var = jnp.clip(log_var, -10.0, 10.0)
+        nll = 0.5 * (jnp.log(2 * jnp.pi) + log_var +
+                     (x - mean) ** 2 / jnp.exp(log_var))
+        return jnp.sum(nll, axis=-1)
+
+    # -- layer protocol --------------------------------------------------
+    def forward(self, params, x, *, training, rng=None, state=None):
+        mean, _ = self._encode(params, x)
+        return mean, state
+
+    def get_output_type(self, input_type):
+        return InputType.feed_forward(self.n_out)
+
+    # -- pretraining (ELBO) ----------------------------------------------
+    def pretrain_loss(self, params, x, rng):
+        mean, log_var = self._encode(params, x)
+        log_var = jnp.clip(log_var, -10.0, 10.0)
+        kl = 0.5 * jnp.sum(jnp.exp(log_var) + mean ** 2 - 1.0 - log_var,
+                           axis=-1)
+        nll = 0.0
+        keys = jax.random.split(rng, self.num_samples)
+        for k in keys:
+            eps = jax.random.normal(k, mean.shape, mean.dtype)
+            z = mean + jnp.exp(0.5 * log_var) * eps
+            nll = nll + self._recon_nll(self._decode(params, z), x)
+        nll = nll / self.num_samples
+        return jnp.mean(nll + kl)
+
+    # -- reference scoring API -------------------------------------------
+    def reconstruction_log_probability(self, params, x, rng,
+                                       num_samples: int = 16):
+        """log p(x) importance-sampled estimate (reference:
+        reconstructionLogProbability); returns [batch]."""
+        mean, log_var = self._encode(params, x)
+        log_var = jnp.clip(log_var, -10.0, 10.0)
+        std = jnp.exp(0.5 * log_var)
+        lps = []
+        for k in jax.random.split(rng, num_samples):
+            eps = jax.random.normal(k, mean.shape, mean.dtype)
+            z = mean + std * eps
+            log_px_z = -self._recon_nll(self._decode(params, z), x)
+            log_pz = -0.5 * jnp.sum(z ** 2 + jnp.log(2 * jnp.pi), -1)
+            log_qz = -0.5 * jnp.sum(eps ** 2 + jnp.log(2 * jnp.pi) +
+                                    log_var, -1)
+            lps.append(log_px_z + log_pz - log_qz)
+        stacked = jnp.stack(lps)  # [S, batch]
+        return jax.scipy.special.logsumexp(stacked, axis=0) - \
+            jnp.log(float(num_samples))
+
+    def reconstruction_error(self, params, x):
+        """Deterministic reconstruction error at the mean of q(z|x)
+        (reference: reconstructionError)."""
+        mean, _ = self._encode(params, x)
+        stats = self._decode(params, mean)
+        if self.reconstruction_distribution == "bernoulli":
+            recon = jax.nn.sigmoid(stats)
+        else:
+            recon, _ = jnp.split(stats, 2, axis=-1)
+        return jnp.sum((recon - x) ** 2, axis=-1)
+
+    def generate_at_mean_given_z(self, params, z):
+        """Decoder mean for latent z (reference: generateAtMeanGivenZ)."""
+        stats = self._decode(params, z)
+        if self.reconstruction_distribution == "bernoulli":
+            return jax.nn.sigmoid(stats)
+        mean, _ = jnp.split(stats, 2, axis=-1)
+        return mean

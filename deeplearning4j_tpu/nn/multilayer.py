@@ -178,6 +178,10 @@ class MultiLayerNetwork:
         """Score-side l1/l2 (reference: applied to weights, not biases)."""
         reg = 0.0
         for i, layer in enumerate(self.conf.layers):
+            if getattr(layer, "is_frozen", lambda: False)():
+                # regularizing frozen weights would un-freeze them:
+                # the l1/l2 gradient bypasses forward's stop_gradient
+                continue
             l1 = layer.l1 or 0.0
             l2 = layer.l2 or 0.0
             if l1 == 0.0 and l2 == 0.0:
@@ -268,6 +272,94 @@ class MultiLayerNetwork:
             for lis in self.listeners:
                 lis.on_epoch_end(self)
             self.epoch_count += 1
+        return self
+
+    # ------------------------------------------------------------------
+    def pretrain(self, data, *, n_epochs: int = 1):
+        """Layerwise unsupervised pretraining (reference:
+        MultiLayerNetwork.pretrain(DataSetIterator) — fits every
+        pretrainable layer (AutoEncoder/VAE) in stack order on the
+        activations of the layers below it)."""
+        if not (hasattr(data, "features") or hasattr(data, "reset") or
+                hasattr(data, "shape") or isinstance(data, (list, tuple))):
+            data = list(data)   # one-shot iterable: keep for every layer
+        for i, layer in enumerate(self.conf.layers):
+            if getattr(layer, "is_pretrainable", lambda: False)():
+                self.pretrain_layer(i, data, n_epochs=n_epochs)
+        return self
+
+    def pretrain_layer(self, idx: int, data, *, n_epochs: int = 1):
+        """Fit one pretrainable layer (reference: pretrainLayer(int,
+        iter)). The layer's ``pretrain_loss`` + its updater compile into
+        one jitted step; layers below run in inference mode."""
+        if not self._initialized:
+            self.init()
+        layer = self.conf.layers[idx]
+        if not getattr(layer, "is_pretrainable", lambda: False)():
+            raise ValueError(f"layer {idx} is not pretrainable")
+        up = layer.updater or self.conf.updater
+        key = f"layer_{idx}"
+        upd_state = self.updater_states[key]
+
+        if not hasattr(self, "_pretrain_steps"):
+            self._pretrain_steps = {}
+        if idx not in self._pretrain_steps:
+            def step(lp, below_params, states, us, x, iteration, rng):
+                r_in, r_loss = jax.random.split(rng)
+                h = x
+                if idx > 0:
+                    h, _ = self._forward(below_params, states, x,
+                                         training=False, rng=r_in,
+                                         stop_at=idx, want_logits=False)
+                # _forward(stop_at=idx) stops before layer idx's own
+                # preprocessor; apply it (auto-inserted CnnToFeedForward
+                # etc.) so pretrain sees the same input as supervised fit
+                if idx in self.conf.input_preprocessors:
+                    h = self.conf.input_preprocessors[idx].pre_process(h)
+                loss, g = jax.value_and_grad(layer.pretrain_loss)(
+                    lp, h, r_loss)
+                updates, new_us = up.apply(g, us, iteration)
+                new_lp = jax.tree_util.tree_map(lambda p, u: p - u, lp,
+                                                updates)
+                return new_lp, new_us, loss
+
+            self._pretrain_steps[idx] = jax.jit(step,
+                                                donate_argnums=(0, 3))
+        jit_step = self._pretrain_steps[idx]
+        below = {f"layer_{j}": self.params[f"layer_{j}"]
+                 for j in range(idx)}
+
+        from deeplearning4j_tpu.ndarray.ndarray import INDArray
+        if not (hasattr(data, "features") or hasattr(data, "reset") or
+                isinstance(data, (np.ndarray, jnp.ndarray, INDArray,
+                                  list, tuple))):
+            # non-resettable iterable (e.g. a generator): materialize
+            # once so every epoch/layer sees the full data
+            data = list(data)
+
+        def batches(d):
+            if hasattr(d, "features"):          # DataSet
+                yield d.features
+            elif isinstance(d, (np.ndarray, jnp.ndarray, INDArray)):
+                yield d
+            else:                               # iterator protocol / list
+                if hasattr(d, "reset"):
+                    d.reset()
+                for ds in d:
+                    yield ds.features if hasattr(ds, "features") else ds
+
+        for _ in range(n_epochs):
+            for x in batches(data):
+                x = _as_jnp(x, self._dtype)
+                self._rng, rng = jax.random.split(self._rng)
+                states_in = self._with_zero_rnn_states(self.states,
+                                                       int(x.shape[0]))
+                self.params[key], upd_state, loss = jit_step(
+                    self.params[key], below, states_in, upd_state,
+                    x, jnp.asarray(self.iteration_count), rng)
+                self._score = loss
+                self.iteration_count += 1
+        self.updater_states[key] = upd_state
         return self
 
     def _fit_batch(self, x, y, fmask, lmask):

@@ -1,0 +1,395 @@
+"""Layer-breadth tests: shape/pad/crop family, 1D/3D conv family, misc
+parameterised layers (reference test style: ConvolutionLayerTest /
+Convolution3DTest / LocallyConnectedLayerTest equivalents, SURVEY.md §4.8).
+
+Each layer is checked for (a) shape-inference vs actual forward shape
+agreement, (b) value semantics on small hand-checkable inputs, and
+(c) end-to-end training inside a MultiLayerNetwork where meaningful.
+"""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.activations import Activation
+from deeplearning4j_tpu.learning import Adam
+from deeplearning4j_tpu.lossfunctions import LossFunction
+from deeplearning4j_tpu.nn import (InputType, MultiLayerNetwork,
+                                   NeuralNetConfiguration)
+from deeplearning4j_tpu.nn.conf.layers import (
+    ConvolutionLayer, DenseLayer, Layer, OutputLayer, PoolingType,
+    RnnOutputLayer)
+from deeplearning4j_tpu.nn.conf.layers_conv_1d3d import (
+    Cnn3DLossLayer, Convolution1DLayer, Convolution3D, Deconvolution3D,
+    Subsampling1DLayer, Subsampling3DLayer)
+from deeplearning4j_tpu.nn.conf.layers_misc import (
+    ElementWiseMultiplicationLayer, LocalResponseNormalization,
+    LocallyConnected1D, LocallyConnected2D, PReLULayer, RnnLossLayer)
+from deeplearning4j_tpu.nn.conf.layers_recurrent import LSTM
+from deeplearning4j_tpu.nn.conf.layers_shape import (
+    Cropping1D, Cropping2D, Cropping3D, DepthToSpaceLayer, FrozenLayer,
+    MaskLayer, MaskZeroLayer, RepeatVector, SpaceToDepthLayer,
+    TimeDistributed, Upsampling1D, Upsampling3D, ZeroPadding1DLayer,
+    ZeroPadding3DLayer, ZeroPaddingLayer)
+
+
+def _shape_of(layer, in_type, rng_seed=0, batch=2):
+    """Run forward on zeros and also return the inferred output type."""
+    layer.set_n_in(in_type, override=True)
+    key = jax.random.PRNGKey(rng_seed)
+    params = (layer.init_params(key, in_type) if layer.has_params()
+              else {})
+    x = jnp.ones(in_type.shape(batch))
+    y, _ = layer.forward(params, x, training=False)
+    out_t = layer.get_output_type(in_type)
+    return y.shape, out_t.shape(batch)
+
+
+class TestShapeFamily:
+    def test_cropping_1d_2d_3d(self):
+        got, want = _shape_of(Cropping1D(cropping=(1, 2)),
+                              InputType.recurrent(5, 10))
+        assert got == want == (2, 7, 5)
+        got, want = _shape_of(
+            Cropping2D(crop_top_bottom=(1, 1), crop_left_right=(2, 0)),
+            InputType.convolutional(8, 8, 3))
+        assert got == want == (2, 6, 6, 3)
+        got, want = _shape_of(
+            Cropping3D(crop_depth=(1, 1), crop_height=(1, 0),
+                       crop_width=(0, 2)),
+            InputType.convolutional_3d(6, 6, 6, 2))
+        assert got == want == (2, 4, 5, 4, 2)
+
+    def test_zero_padding_1d_2d_3d(self):
+        got, want = _shape_of(ZeroPadding1DLayer(padding=(2, 1)),
+                              InputType.recurrent(4, 5))
+        assert got == want == (2, 8, 4)
+        got, want = _shape_of(
+            ZeroPaddingLayer(pad_top_bottom=(1, 1), pad_left_right=(2, 2)),
+            InputType.convolutional(4, 4, 3))
+        assert got == want == (2, 6, 8, 3)
+        got, want = _shape_of(
+            ZeroPadding3DLayer(pad_depth=(1, 0), pad_height=(0, 1),
+                               pad_width=(1, 1)),
+            InputType.convolutional_3d(3, 3, 3, 2))
+        assert got == want == (2, 4, 4, 5, 2)
+
+    def test_pad_values(self):
+        layer = ZeroPaddingLayer(pad_top_bottom=(1, 1),
+                                 pad_left_right=(1, 1))
+        x = jnp.ones((1, 2, 2, 1))
+        y, _ = layer.forward({}, x, training=False)
+        assert float(y.sum()) == 4.0          # only interior is ones
+        assert float(y[0, 0, 0, 0]) == 0.0    # border zero
+
+    def test_space_to_depth_roundtrip(self):
+        s2d, d2s = SpaceToDepthLayer(block_size=2), \
+            DepthToSpaceLayer(block_size=2)
+        x = jnp.arange(2 * 4 * 4 * 3, dtype=jnp.float32).reshape(2, 4, 4, 3)
+        z, _ = s2d.forward({}, x, training=False)
+        assert z.shape == (2, 2, 2, 12)
+        back, _ = d2s.forward({}, z, training=False)
+        np.testing.assert_array_equal(np.asarray(back), np.asarray(x))
+        got, want = _shape_of(SpaceToDepthLayer(block_size=2),
+                              InputType.convolutional(4, 4, 3))
+        assert got == want == (2, 2, 2, 12)
+
+    def test_upsampling_1d_3d(self):
+        got, want = _shape_of(Upsampling1D(size=3),
+                              InputType.recurrent(4, 5))
+        assert got == want == (2, 15, 4)
+        got, want = _shape_of(Upsampling3D(size=2),
+                              InputType.convolutional_3d(2, 3, 4, 2))
+        assert got == want == (2, 4, 6, 8, 2)
+
+    def test_repeat_vector(self):
+        got, want = _shape_of(RepeatVector(repetition_factor=4),
+                              InputType.feed_forward(7))
+        assert got == want == (2, 4, 7)
+        layer = RepeatVector(repetition_factor=3)
+        x = jnp.array([[1.0, 2.0]])
+        y, _ = layer.forward({}, x, training=False)
+        np.testing.assert_array_equal(np.asarray(y),
+                                      [[[1, 2], [1, 2], [1, 2]]])
+
+
+class TestMaskAndWrappers:
+    def test_mask_layer(self):
+        layer = MaskLayer()
+        x = jnp.ones((2, 3, 4))
+        mask = jnp.array([[1, 1, 0], [1, 0, 0]], dtype=jnp.float32)
+        y, _ = layer.forward({}, x, training=False, mask=mask)
+        assert float(y[0, 2].sum()) == 0.0
+        assert float(y[0, 1].sum()) == 4.0
+        assert float(y[1, 1].sum()) == 0.0
+
+    def test_mask_zero_layer_wraps_lstm(self):
+        inner = LSTM(n_out=6, activation=Activation.TANH)
+        layer = MaskZeroLayer(underlying=inner, mask_value=0.0)
+        in_t = InputType.recurrent(3, 5)
+        layer.set_n_in(in_t, override=True)
+        params = layer.init_params(jax.random.PRNGKey(0), in_t)
+        x = jnp.ones((2, 5, 3))
+        x = x.at[:, 3:, :].set(0.0)  # last two steps are padding
+        y, _ = layer.forward(params, x, training=False)
+        assert y.shape == (2, 5, 6)
+        np.testing.assert_allclose(np.asarray(y[:, 3:, :]), 0.0)
+        assert float(jnp.abs(y[:, :3, :]).sum()) > 0.0
+
+    def test_frozen_layer_blocks_grads(self):
+        inner = DenseLayer(n_in=4, n_out=4, activation=Activation.TANH)
+        frozen = FrozenLayer(underlying=inner)
+        params = frozen.init_params(jax.random.PRNGKey(0),
+                                    InputType.feed_forward(4))
+
+        def loss(p, x):
+            y, _ = frozen.forward(p, x, training=True)
+            return jnp.sum(y ** 2)
+
+        g = jax.grad(loss)(params, jnp.ones((2, 4)))
+        assert float(jnp.abs(g["W"]).sum()) == 0.0
+        assert float(jnp.abs(g["b"]).sum()) == 0.0
+
+    def test_time_distributed_dense(self):
+        inner = DenseLayer(n_out=5, activation=Activation.RELU)
+        layer = TimeDistributed(underlying=inner)
+        in_t = InputType.recurrent(3, 7)
+        layer.set_n_in(in_t, override=True)
+        params = layer.init_params(jax.random.PRNGKey(0), in_t)
+        x = jnp.ones((2, 7, 3))
+        y, _ = layer.forward(params, x, training=False)
+        assert y.shape == (2, 7, 5)
+        assert layer.get_output_type(in_t).shape(2) == (2, 7, 5)
+        # per-timestep independence: all timesteps identical for identical
+        # inputs
+        np.testing.assert_allclose(np.asarray(y[:, 0]), np.asarray(y[:, 6]))
+
+    def test_frozen_layer_immune_to_l2(self):
+        """l1/l2 regularization must not update frozen weights
+        (regression: the reg term bypassed forward's stop_gradient)."""
+        from deeplearning4j_tpu.learning import Sgd
+        from deeplearning4j_tpu.lossfunctions import LossFunction
+        from deeplearning4j_tpu.nn import (MultiLayerNetwork,
+                                           NeuralNetConfiguration)
+        from deeplearning4j_tpu.nn.conf.layers import OutputLayer
+        conf = (NeuralNetConfiguration.Builder()
+                .seed(0).updater(Sgd(0.5)).l2(0.1)
+                .list()
+                .layer(FrozenLayer(underlying=DenseLayer(
+                    n_out=4, activation=Activation.TANH)))
+                .layer(OutputLayer(n_out=2,
+                                   loss_function=LossFunction.MCXENT,
+                                   activation=Activation.SOFTMAX))
+                .set_input_type(InputType.feed_forward(4))
+                .build())
+        net = MultiLayerNetwork(conf).init()
+        w0 = np.asarray(net.params["layer_0"]["W"]).copy()
+        x = np.random.RandomState(0).randn(8, 4).astype(np.float32)
+        y = np.eye(2, dtype=np.float32)[np.zeros(8, int)]
+        net.fit(x, y)
+        np.testing.assert_array_equal(
+            np.asarray(net.params["layer_0"]["W"]), w0)
+
+    def test_time_distributed_stateful_underlying(self):
+        """TimeDistributed over a stateful layer (BatchNormalization)
+        allocates/threads the state (regression: state delegation)."""
+        from deeplearning4j_tpu.nn.conf.layers import BatchNormalization
+        inner = BatchNormalization(n_in=3, n_out=3)
+        layer = TimeDistributed(underlying=inner)
+        in_t = InputType.recurrent(3, 4)
+        assert layer.has_state()
+        params = layer.init_params(jax.random.PRNGKey(0), in_t)
+        state = layer.init_state(in_t)
+        x = jnp.ones((2, 4, 3))
+        y, ns = layer.forward(params, x, training=True, state=state)
+        assert y.shape == (2, 4, 3)
+        assert ns is not None and len(ns) > 0
+
+    def test_wrapper_serde_roundtrip(self):
+        layer = FrozenLayer(underlying=DenseLayer(n_in=4, n_out=3))
+        d = layer.to_map()
+        back = Layer.from_map(d)
+        assert isinstance(back, FrozenLayer)
+        assert isinstance(back.underlying, DenseLayer)
+        assert back.underlying.n_out == 3
+
+
+class TestConv1D3D:
+    def test_conv1d_shapes(self):
+        from deeplearning4j_tpu.nn.conf.layers import ConvolutionMode
+        got, want = _shape_of(
+            Convolution1DLayer(kernel_size=3, stride=1, n_out=8,
+                               convolution_mode=ConvolutionMode.SAME),
+            InputType.recurrent(4, 10))
+        assert got == want == (2, 10, 8)
+
+    def test_conv1d_truncate(self):
+        from deeplearning4j_tpu.nn.conf.layers import ConvolutionMode
+        got, want = _shape_of(
+            Convolution1DLayer(kernel_size=3, stride=2, n_out=6,
+                               convolution_mode=ConvolutionMode.TRUNCATE),
+            InputType.recurrent(4, 11))
+        assert got == want == (2, 5, 6)
+
+    def test_subsampling1d(self):
+        layer = Subsampling1DLayer(kernel_size=2, stride=2,
+                                   pooling_type=PoolingType.MAX)
+        x = jnp.array([[[1.], [4.], [2.], [3.]]])
+        y, _ = layer.forward({}, x, training=False)
+        np.testing.assert_array_equal(np.asarray(y), [[[4.], [3.]]])
+        got, want = _shape_of(layer, InputType.recurrent(4, 10))
+        assert got == want == (2, 5, 4)
+
+    def test_conv3d_shapes(self):
+        got, want = _shape_of(Convolution3D(kernel_size=(3, 3, 3),
+                                            n_out=4),
+                              InputType.convolutional_3d(6, 6, 6, 2))
+        assert got == want == (2, 4, 4, 4, 4)
+
+    def test_subsampling3d(self):
+        got, want = _shape_of(
+            Subsampling3DLayer(kernel_size=(2, 2, 2), stride=(2, 2, 2)),
+            InputType.convolutional_3d(4, 4, 4, 3))
+        assert got == want == (2, 2, 2, 2, 3)
+
+    def test_deconv3d_shapes(self):
+        got, want = _shape_of(
+            Deconvolution3D(kernel_size=(2, 2, 2), stride=(2, 2, 2),
+                            n_out=3),
+            InputType.convolutional_3d(2, 2, 2, 4))
+        assert got == want == (2, 4, 4, 4, 3)
+
+    def test_deconv2d_truncate_shapes(self):
+        from deeplearning4j_tpu.nn.conf.layers_conv_extra import \
+            Deconvolution2D
+        got, want = _shape_of(
+            Deconvolution2D(kernel_size=(2, 2), stride=(2, 2), n_out=3),
+            InputType.convolutional(5, 5, 4))
+        assert got == want == (2, 10, 10, 3)
+
+    def test_conv3d_gradient_flows(self):
+        layer = Convolution3D(kernel_size=(2, 2, 2), n_in=1, n_out=2)
+        in_t = InputType.convolutional_3d(3, 3, 3, 1)
+        params = layer.init_params(jax.random.PRNGKey(0), in_t)
+
+        def loss(p):
+            y, _ = layer.forward(p, jnp.ones((1, 3, 3, 3, 1)),
+                                 training=True)
+            return jnp.sum(y ** 2)
+
+        g = jax.grad(loss)(params)
+        assert float(jnp.abs(g["W"]).sum()) > 0.0
+
+
+class TestMiscLayers:
+    def test_prelu(self):
+        layer = PReLULayer(alpha_init=0.25)
+        in_t = InputType.feed_forward(3)
+        layer.set_n_in(in_t, override=True)
+        params = layer.init_params(jax.random.PRNGKey(0), in_t)
+        x = jnp.array([[-4.0, 0.0, 2.0]])
+        y, _ = layer.forward(params, x, training=False)
+        np.testing.assert_allclose(np.asarray(y), [[-1.0, 0.0, 2.0]])
+
+    def test_prelu_shared_axes(self):
+        layer = PReLULayer(alpha_init=0.1, shared_axes=(1, 2))
+        in_t = InputType.convolutional(4, 4, 3)
+        params = layer.init_params(jax.random.PRNGKey(0), in_t)
+        assert params["alpha"].shape == (1, 1, 3)
+
+    def test_elementwise_mult(self):
+        layer = ElementWiseMultiplicationLayer(n_in=3, n_out=3)
+        params = layer.init_params(jax.random.PRNGKey(0),
+                                   InputType.feed_forward(3))
+        params = {"W": jnp.array([1.0, 2.0, 3.0]),
+                  "b": jnp.zeros(3)}
+        y, _ = layer.forward(params, jnp.array([[1.0, 1.0, 1.0]]),
+                             training=False)
+        np.testing.assert_allclose(np.asarray(y), [[1.0, 2.0, 3.0]])
+
+    def test_lrn_identity_at_small_alpha(self):
+        layer = LocalResponseNormalization(alpha=0.0, beta=0.75, k=1.0)
+        x = jax.random.normal(jax.random.PRNGKey(0), (2, 4, 4, 8))
+        y, _ = layer.forward({}, x, training=False)
+        np.testing.assert_allclose(np.asarray(y), np.asarray(x), rtol=1e-6)
+
+    def test_lrn_normalizes(self):
+        layer = LocalResponseNormalization(alpha=1.0, beta=1.0, k=0.0, n=1)
+        # with n=1, window is just the channel itself: y = x / x^2 = 1/x
+        x = jnp.full((1, 1, 1, 4), 2.0)
+        y, _ = layer.forward({}, x, training=False)
+        np.testing.assert_allclose(np.asarray(y), 0.5)
+
+    def test_locally_connected_2d(self):
+        layer = LocallyConnected2D(kernel_size=(2, 2), stride=(1, 1),
+                                   n_out=4)
+        in_t = InputType.convolutional(4, 4, 2)
+        got, want = _shape_of(layer, in_t)
+        assert got == want == (2, 3, 3, 4)
+
+    def test_locally_connected_2d_is_unshared(self):
+        """Distinct kernels per position: constant input but per-position
+        weights give different outputs across positions."""
+        layer = LocallyConnected2D(kernel_size=(2, 2), n_in=1, n_out=1,
+                                   has_bias=False)
+        in_t = InputType.convolutional(3, 3, 1)
+        layer.set_n_in(in_t, override=False)
+        params = layer.init_params(jax.random.PRNGKey(3), in_t)
+        x = jnp.ones((1, 3, 3, 1))
+        y, _ = layer.forward(params, x, training=False)
+        flat = np.asarray(y).ravel()
+        assert np.ptp(flat) > 1e-4  # positions differ
+
+    def test_locally_connected_1d(self):
+        layer = LocallyConnected1D(kernel_size=3, n_out=5)
+        got, want = _shape_of(layer, InputType.recurrent(4, 9))
+        assert got == want == (2, 7, 5)
+
+    def test_conv1d_trains_in_network(self):
+        """Temporal conv + pooling head classifies a trivial sequence
+        pattern (rising vs falling)."""
+        rng = np.random.RandomState(0)
+        n, t = 128, 8
+        xs = np.zeros((n, t, 1), np.float32)
+        ys = rng.randint(0, 2, n)
+        ramp = np.linspace(-1, 1, t, dtype=np.float32)[:, None]
+        xs[ys == 0] = ramp
+        xs[ys == 1] = -ramp
+        xs += 0.05 * rng.randn(n, t, 1).astype(np.float32)
+        labels = np.eye(2, dtype=np.float32)[ys]
+
+        from deeplearning4j_tpu.nn.conf.layers import GlobalPoolingLayer
+        conf = (NeuralNetConfiguration.Builder()
+                .seed(7).updater(Adam(1e-2))
+                .list()
+                .layer(Convolution1DLayer(kernel_size=3, n_out=8,
+                                          activation=Activation.RELU))
+                .layer(GlobalPoolingLayer(pooling_type=PoolingType.AVG))
+                .layer(OutputLayer(n_out=2,
+                                   loss_function=LossFunction.MCXENT,
+                                   activation=Activation.SOFTMAX))
+                .set_input_type(InputType.recurrent(1, t))
+                .build())
+        net = MultiLayerNetwork(conf)
+        net.init()
+        for _ in range(60):
+            net.fit(xs, labels)
+        preds = np.asarray(net.output(xs)).argmax(-1)
+        assert (preds == ys).mean() > 0.95
+
+    def test_rnn_loss_layer_in_network(self):
+        """RnnLossLayer as per-timestep head after an LSTM."""
+        conf = (NeuralNetConfiguration.Builder()
+                .seed(1).updater(Adam(1e-2))
+                .list()
+                .layer(LSTM(n_out=4))
+                .layer(RnnLossLayer(
+                    loss_function=LossFunction.MSE,
+                    activation=Activation.IDENTITY))
+                .set_input_type(InputType.recurrent(4, 6))
+                .build())
+        net = MultiLayerNetwork(conf)
+        net.init()
+        x = np.random.RandomState(0).randn(3, 6, 4).astype(np.float32)
+        y = net.output(x)
+        assert np.asarray(y).shape == (3, 6, 4)

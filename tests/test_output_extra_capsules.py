@@ -1,0 +1,171 @@
+"""CenterLossOutputLayer / OCNNOutputLayer / capsule layer tests
+(reference test style: CenterLossOutputLayerTest, OCNNOutputLayerTest,
+CapsNetMNISTTest, SURVEY.md §4.8)."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from deeplearning4j_tpu.activations import Activation
+from deeplearning4j_tpu.learning import Adam
+from deeplearning4j_tpu.lossfunctions import LossFunction
+from deeplearning4j_tpu.nn import (InputType, MultiLayerNetwork,
+                                   NeuralNetConfiguration)
+from deeplearning4j_tpu.nn.conf.layers import DenseLayer, OutputLayer
+from deeplearning4j_tpu.nn.conf.layers_capsule import (
+    CapsuleLayer, CapsuleStrengthLayer, PrimaryCapsules)
+from deeplearning4j_tpu.nn.conf.layers_output_extra import (
+    CenterLossOutputLayer, OCNNOutputLayer)
+
+
+def _blobs(n=200, d=4, seed=0):
+    rng = np.random.RandomState(seed)
+    ys = rng.randint(0, 3, n)
+    centers = np.eye(3, dtype=np.float32)[:, :3] * 3.0
+    centers = np.concatenate([centers, np.zeros((3, d - 3), np.float32)],
+                             axis=1)
+    xs = centers[ys] + 0.3 * rng.randn(n, d).astype(np.float32)
+    return xs, np.eye(3, dtype=np.float32)[ys], ys
+
+
+class TestCenterLoss:
+    def _net(self, lam):
+        conf = (NeuralNetConfiguration.Builder()
+                .seed(0).updater(Adam(5e-2))
+                .list()
+                .layer(DenseLayer(n_out=8, activation=Activation.RELU))
+                .layer(CenterLossOutputLayer(
+                    n_out=3, lambda_=lam,
+                    loss_function=LossFunction.MCXENT,
+                    activation=Activation.SOFTMAX))
+                .set_input_type(InputType.feed_forward(4))
+                .build())
+        return MultiLayerNetwork(conf).init()
+
+    def test_trains_and_centers_move(self):
+        xs, labels, ys = _blobs()
+        net = self._net(lam=0.5)
+        c0 = np.asarray(net.params["layer_1"]["centers"]).copy()
+        for _ in range(80):
+            net.fit(xs, labels)
+        c1 = np.asarray(net.params["layer_1"]["centers"])
+        acc = (np.asarray(net.output(xs)).argmax(-1) == ys).mean()
+        assert acc > 0.9
+        assert np.abs(c1 - c0).sum() > 0.1   # centers learned
+
+    def test_center_term_tightens_clusters(self):
+        """With a large lambda the per-class feature scatter around its
+        center shrinks vs lambda=0."""
+        xs, labels, ys = _blobs()
+
+        def scatter(lam):
+            net = self._net(lam)
+            for _ in range(80):
+                net.fit(xs, labels)
+            # penultimate features
+            h = np.asarray(jnp.maximum(
+                jnp.asarray(xs) @ net.params["layer_0"]["W"] +
+                net.params["layer_0"]["b"], 0))
+            tot = 0.0
+            for c in range(3):
+                f = h[ys == c]
+                tot += float(((f - f.mean(0)) ** 2).sum(-1).mean())
+            return tot
+
+        assert scatter(2.0) < scatter(0.0)
+
+    def test_output_shape_is_class_probs(self):
+        xs, labels, _ = _blobs(n=16)
+        net = self._net(lam=0.1)
+        out = np.asarray(net.output(xs))
+        assert out.shape == (16, 3)
+        np.testing.assert_allclose(out.sum(-1), 1.0, rtol=1e-5)
+
+
+class TestOCNN:
+    def test_anomaly_scoring(self):
+        # OC-NN separates the inlier cluster from the origin: inliers sit
+        # away from 0, anomalies near/behind it (the paper's geometry).
+        rng = np.random.RandomState(0)
+        inliers = (rng.randn(256, 4).astype(np.float32) * 0.4 +
+                   np.array([2, 2, 2, 2], np.float32))
+        outliers = rng.randn(64, 4).astype(np.float32) * 0.4 - \
+            np.array([1, 1, 1, 1], np.float32)
+        conf = (NeuralNetConfiguration.Builder()
+                .seed(0).updater(Adam(1e-2))
+                .list()
+                .layer(OCNNOutputLayer(hidden_size=8, nu=0.1,
+                                       activation=Activation.RELU))
+                .set_input_type(InputType.feed_forward(4))
+                .build())
+        net = MultiLayerNetwork(conf).init()
+        dummy = np.zeros((inliers.shape[0], 1), np.float32)
+        for _ in range(200):
+            net.fit(inliers, dummy)
+        s_in = np.asarray(net.output(inliers)).ravel()
+        s_out = np.asarray(net.output(outliers)).ravel()
+        # inliers score above outliers; most inliers non-negative
+        assert np.median(s_in) > np.median(s_out)
+        assert (s_in >= 0).mean() > 0.7
+
+
+class TestCapsules:
+    def test_shapes_end_to_end(self):
+        conf = (NeuralNetConfiguration.Builder()
+                .seed(0).updater(Adam(1e-3))
+                .list()
+                .layer(PrimaryCapsules(capsule_dimensions=4, channels=2,
+                                       kernel_size=(3, 3), stride=(2, 2)))
+                .layer(CapsuleLayer(capsules=5, capsule_dimensions=6,
+                                    routings=2))
+                .layer(CapsuleStrengthLayer())
+                .layer(OutputLayer(n_out=5,
+                                   loss_function=LossFunction.MCXENT,
+                                   activation=Activation.SOFTMAX))
+                .set_input_type(InputType.convolutional(9, 9, 1))
+                .build())
+        net = MultiLayerNetwork(conf).init()
+        x = np.random.RandomState(0).rand(2, 9, 9, 1).astype(np.float32)
+        out = np.asarray(net.output(x))
+        assert out.shape == (2, 5)
+        np.testing.assert_allclose(out.sum(-1), 1.0, rtol=1e-5)
+
+    def test_squash_bounds_norms(self):
+        from deeplearning4j_tpu.nn.conf.layers_capsule import _squash
+        v = _squash(jnp.array([[100.0, 0.0], [0.01, 0.0]]))
+        n = np.asarray(jnp.linalg.norm(v, axis=-1))
+        assert n[0] < 1.0
+        assert n[1] < 0.01
+
+    def test_capsnet_learns_toy_task(self):
+        """Tiny capsnet separates two simple 2-class images (vertical vs
+        horizontal bar)."""
+        rng = np.random.RandomState(0)
+        n = 64
+        xs = np.zeros((n, 8, 8, 1), np.float32)
+        ys = rng.randint(0, 2, n)
+        for i, y in enumerate(ys):
+            pos = rng.randint(1, 7)
+            if y == 0:
+                xs[i, :, pos, 0] = 1.0
+            else:
+                xs[i, pos, :, 0] = 1.0
+        xs += 0.05 * rng.randn(*xs.shape).astype(np.float32)
+        labels = np.eye(2, dtype=np.float32)[ys]
+        conf = (NeuralNetConfiguration.Builder()
+                .seed(1).updater(Adam(5e-3))
+                .list()
+                .layer(PrimaryCapsules(capsule_dimensions=4, channels=2,
+                                       kernel_size=(3, 3), stride=(2, 2)))
+                .layer(CapsuleLayer(capsules=4, capsule_dimensions=4,
+                                    routings=2))
+                .layer(CapsuleStrengthLayer())
+                .layer(OutputLayer(n_out=2,
+                                   loss_function=LossFunction.MCXENT,
+                                   activation=Activation.SOFTMAX))
+                .set_input_type(InputType.convolutional(8, 8, 1))
+                .build())
+        net = MultiLayerNetwork(conf).init()
+        for _ in range(80):
+            net.fit(xs, labels)
+        acc = (np.asarray(net.output(xs)).argmax(-1) == ys).mean()
+        assert acc > 0.9

@@ -1,0 +1,98 @@
+"""ROCBinary / ROCMultiClass / AUPRC tests (reference test style:
+ROCBinaryTest / ROCTest in org.nd4j.evaluation, SURVEY.md J10)."""
+import numpy as np
+
+from deeplearning4j_tpu.evaluation import ROC, ROCBinary, ROCMultiClass
+
+
+class TestROCAuprc:
+    def test_perfect_ranking(self):
+        roc = ROC()
+        roc.eval(np.array([0, 0, 1, 1]), np.array([0.1, 0.2, 0.8, 0.9]))
+        assert roc.calculate_auc() == 1.0
+        assert roc.calculate_auprc() == 1.0
+
+    def test_random_ranking_auprc_near_base_rate(self):
+        rng = np.random.RandomState(0)
+        y = (rng.rand(4000) < 0.3).astype(float)
+        s = rng.rand(4000)
+        roc = ROC()
+        roc.eval(y, s)
+        assert abs(roc.calculate_auprc() - 0.3) < 0.05
+        assert abs(roc.calculate_auc() - 0.5) < 0.05
+
+
+class TestROCBinary:
+    def test_per_output_auc(self):
+        labels = np.array([[1, 0], [1, 0], [0, 1], [0, 1]], float)
+        # output 0 ranks perfectly; output 1 ranks inversely
+        preds = np.array([[0.9, 0.9], [0.8, 0.8], [0.1, 0.1],
+                          [0.2, 0.2]], float)
+        rb = ROCBinary()
+        rb.eval(labels, preds)
+        assert rb.num_labels() == 2
+        assert rb.calculate_auc(0) == 1.0
+        assert rb.calculate_auc(1) == 0.0
+        assert rb.calculate_average_auc() == 0.5
+
+    def test_incremental_accumulation(self):
+        rng = np.random.RandomState(1)
+        rb = ROCBinary()
+        all_y, all_s = [], []
+        for _ in range(5):
+            y = (rng.rand(50, 3) < 0.5).astype(float)
+            s = np.clip(y * 0.7 + 0.3 * rng.rand(50, 3), 0, 1)
+            rb.eval(y, s)
+            all_y.append(y)
+            all_s.append(s)
+        ref = ROCBinary()
+        ref.eval(np.concatenate(all_y), np.concatenate(all_s))
+        for i in range(3):
+            assert abs(rb.calculate_auc(i) - ref.calculate_auc(i)) < 1e-12
+
+
+    def test_time_series_with_timestep_mask(self):
+        """[b, t, c] multi-label series with a [b, t] mask flattens
+        through the mask (regression: mask was misindexed per column)."""
+        labels = np.zeros((2, 3, 2))
+        preds = np.zeros((2, 3, 2))
+        labels[0, :2] = [[1, 0], [0, 1]]
+        preds[0, :2] = [[0.9, 0.2], [0.1, 0.8]]
+        labels[1, 0] = [1, 1]
+        preds[1, 0] = [0.8, 0.9]
+        labels[0, 2] = [0, 1]          # masked garbage, inverted
+        preds[0, 2] = [0.99, 0.01]
+        mask = np.array([[1, 1, 0], [1, 0, 0]], float)
+        rb = ROCBinary()
+        rb.eval(labels, preds, mask=mask)
+        assert rb.calculate_auc(0) == 1.0
+        assert rb.calculate_auc(1) == 1.0
+
+
+class TestROCMultiClass:
+    def test_one_vs_all(self):
+        labels = np.eye(3)[[0, 1, 2, 0, 1, 2]].astype(float)
+        preds = labels * 0.8 + 0.1  # perfectly informative
+        rmc = ROCMultiClass()
+        rmc.eval(labels, preds)
+        assert rmc.num_classes() == 3
+        for c in range(3):
+            assert rmc.calculate_auc(c) == 1.0
+        assert rmc.calculate_average_auc() == 1.0
+
+    def test_time_series_with_mask(self):
+        # [b, t, c]: masked timesteps carry garbage that would break AUC
+        labels = np.zeros((2, 3, 2))
+        preds = np.zeros((2, 3, 2))
+        labels[0, :2] = [[1, 0], [0, 1]]
+        preds[0, :2] = [[0.9, 0.1], [0.2, 0.8]]
+        labels[1, :1] = [[1, 0]]
+        preds[1, :1] = [[0.7, 0.3]]
+        # garbage in masked region: inverted scores
+        labels[0, 2] = [1, 0]
+        preds[0, 2] = [0.0, 1.0]
+        mask = np.array([[1, 1, 0], [1, 0, 0]], float)
+        rmc = ROCMultiClass()
+        rmc.eval(labels, preds, mask=mask)
+        assert rmc.calculate_auc(0) == 1.0
+        assert rmc.calculate_auc(1) == 1.0

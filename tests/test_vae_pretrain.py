@@ -1,0 +1,189 @@
+"""AutoEncoder + VariationalAutoencoder layer tests, incl. layerwise
+pretraining through MultiLayerNetwork.pretrain (reference test style:
+TestVAE / AutoEncoderTest, SURVEY.md §4.8)."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from deeplearning4j_tpu.activations import Activation
+from deeplearning4j_tpu.learning import Adam
+from deeplearning4j_tpu.lossfunctions import LossFunction
+from deeplearning4j_tpu.nn import (InputType, MultiLayerNetwork,
+                                   NeuralNetConfiguration)
+from deeplearning4j_tpu.nn.conf.layers import DenseLayer, OutputLayer
+from deeplearning4j_tpu.nn.conf.layers_vae import (AutoEncoder,
+                                                   VariationalAutoencoder)
+
+
+def _blobs(n=256, d=8, seed=0):
+    """Two gaussian blobs in d dims."""
+    rng = np.random.RandomState(seed)
+    ys = rng.randint(0, 2, n)
+    centers = np.zeros((2, d), np.float32)
+    centers[0, 0] = 2.0
+    centers[1, 0] = -2.0
+    xs = centers[ys] + 0.3 * rng.randn(n, d).astype(np.float32)
+    return xs, ys
+
+
+class TestAutoEncoder:
+    def test_pretrain_reduces_reconstruction_error(self):
+        xs, _ = _blobs()
+        layer = AutoEncoder(n_out=4, activation=Activation.SIGMOID,
+                            corruption_level=0.2)
+        conf = (NeuralNetConfiguration.Builder()
+                .seed(3).updater(Adam(1e-2))
+                .list()
+                .layer(layer)
+                .layer(OutputLayer(n_out=2,
+                                   loss_function=LossFunction.MCXENT,
+                                   activation=Activation.SOFTMAX))
+                .set_input_type(InputType.feed_forward(8))
+                .build())
+        net = MultiLayerNetwork(conf).init()
+        p0 = net.params["layer_0"]
+        err0 = float(jnp.mean(jnp.sum(
+            (layer.reconstruct(p0, jnp.asarray(xs)) - xs) ** 2, -1)))
+        for _ in range(100):
+            net.pretrain_layer(0, xs)
+        p1 = net.params["layer_0"]
+        err1 = float(jnp.mean(jnp.sum(
+            (layer.reconstruct(p1, jnp.asarray(xs)) - xs) ** 2, -1)))
+        assert err1 < err0 * 0.8
+
+    def test_pretrain_then_finetune(self):
+        xs, ys = _blobs()
+        labels = np.eye(2, dtype=np.float32)[ys]
+        conf = (NeuralNetConfiguration.Builder()
+                .seed(3).updater(Adam(1e-2))
+                .list()
+                .layer(AutoEncoder(n_out=4,
+                                   activation=Activation.SIGMOID))
+                .layer(OutputLayer(n_out=2,
+                                   loss_function=LossFunction.MCXENT,
+                                   activation=Activation.SOFTMAX))
+                .set_input_type(InputType.feed_forward(8))
+                .build())
+        net = MultiLayerNetwork(conf).init()
+        net.pretrain(xs, n_epochs=30)
+        for _ in range(40):
+            net.fit(xs, labels)
+        acc = (np.asarray(net.output(xs)).argmax(-1) == ys).mean()
+        assert acc > 0.95
+
+
+class TestPretrainPreprocessor:
+    def test_pretrain_above_conv_stack(self):
+        """AutoEncoder above a conv layer: the auto-inserted
+        CnnToFeedForward preprocessor must apply during pretraining too
+        (regression: stop_at skipped the pretrain layer's preprocessor)."""
+        from deeplearning4j_tpu.nn.conf.layers import (ConvolutionLayer,
+                                                       SubsamplingLayer)
+        rng = np.random.RandomState(0)
+        xs = rng.rand(16, 8, 8, 1).astype(np.float32)
+        conf = (NeuralNetConfiguration.Builder()
+                .seed(0).updater(Adam(1e-3))
+                .list()
+                .layer(ConvolutionLayer(kernel_size=(3, 3), n_out=4,
+                                        activation=Activation.RELU))
+                .layer(SubsamplingLayer(kernel_size=(2, 2),
+                                        stride=(2, 2)))
+                .layer(AutoEncoder(n_out=8,
+                                   activation=Activation.SIGMOID))
+                .layer(OutputLayer(n_out=2,
+                                   loss_function=LossFunction.MCXENT,
+                                   activation=Activation.SOFTMAX))
+                .set_input_type(InputType.convolutional(8, 8, 1))
+                .build())
+        net = MultiLayerNetwork(conf).init()
+        net.pretrain_layer(2, xs, n_epochs=3)   # must not shape-error
+        assert np.isfinite(float(net._score))
+
+    def test_pretrain_accepts_indarray(self):
+        from deeplearning4j_tpu.ndarray import Nd4j
+        xs, _ = _blobs(n=32)
+        conf = (NeuralNetConfiguration.Builder()
+                .seed(0).updater(Adam(1e-3))
+                .list()
+                .layer(AutoEncoder(n_out=4,
+                                   activation=Activation.SIGMOID))
+                .layer(OutputLayer(n_out=2,
+                                   loss_function=LossFunction.MCXENT,
+                                   activation=Activation.SOFTMAX))
+                .set_input_type(InputType.feed_forward(8))
+                .build())
+        net = MultiLayerNetwork(conf).init()
+        net.pretrain_layer(0, Nd4j.create(xs), n_epochs=2)
+        assert np.isfinite(float(net._score))
+
+
+class TestVAE:
+    def _vae_layer(self, dist="gaussian"):
+        return VariationalAutoencoder(
+            n_out=2, encoder_layer_sizes=(16,), decoder_layer_sizes=(16,),
+            activation=Activation.TANH,
+            reconstruction_distribution=dist)
+
+    def test_elbo_decreases(self):
+        xs, _ = _blobs()
+        layer = self._vae_layer()
+        conf = (NeuralNetConfiguration.Builder()
+                .seed(0).updater(Adam(1e-2))
+                .list()
+                .layer(layer)
+                .layer(OutputLayer(n_out=2,
+                                   loss_function=LossFunction.MCXENT,
+                                   activation=Activation.SOFTMAX))
+                .set_input_type(InputType.feed_forward(8))
+                .build())
+        net = MultiLayerNetwork(conf).init()
+        losses = []
+        for _ in range(120):
+            net.pretrain_layer(0, xs)
+            losses.append(float(net._score))
+        assert np.mean(losses[-10:]) < np.mean(losses[:10]) - 1.0
+
+    def test_forward_outputs_latent_mean(self):
+        layer = self._vae_layer()
+        layer.n_in = 8
+        params = layer.init_params(jax.random.PRNGKey(0),
+                                   InputType.feed_forward(8))
+        y, _ = layer.forward(params, jnp.ones((4, 8)), training=False)
+        assert y.shape == (4, 2)
+
+    def test_reconstruction_scoring_api(self):
+        xs, _ = _blobs(n=32)
+        layer = self._vae_layer()
+        layer.n_in = 8
+        params = layer.init_params(jax.random.PRNGKey(0),
+                                   InputType.feed_forward(8))
+        lp = layer.reconstruction_log_probability(
+            params, jnp.asarray(xs), jax.random.PRNGKey(1), num_samples=4)
+        assert lp.shape == (32,)
+        assert np.all(np.isfinite(np.asarray(lp)))
+        err = layer.reconstruction_error(params, jnp.asarray(xs))
+        assert err.shape == (32,)
+        z = jnp.zeros((5, 2))
+        gen = layer.generate_at_mean_given_z(params, z)
+        assert gen.shape == (5, 8)
+
+    def test_bernoulli_distribution(self):
+        rng = np.random.RandomState(0)
+        xs = (rng.rand(64, 8) > 0.5).astype(np.float32)
+        layer = self._vae_layer(dist="bernoulli")
+        conf = (NeuralNetConfiguration.Builder()
+                .seed(0).updater(Adam(1e-2))
+                .list()
+                .layer(layer)
+                .layer(OutputLayer(n_out=2,
+                                   loss_function=LossFunction.MCXENT,
+                                   activation=Activation.SOFTMAX))
+                .set_input_type(InputType.feed_forward(8))
+                .build())
+        net = MultiLayerNetwork(conf).init()
+        for _ in range(20):
+            net.pretrain_layer(0, xs)
+        assert np.isfinite(float(net._score))
+        gen = layer.generate_at_mean_given_z(net.params["layer_0"],
+                                             jnp.zeros((3, 2)))
+        assert float(gen.min()) >= 0.0 and float(gen.max()) <= 1.0
