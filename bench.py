@@ -34,8 +34,6 @@ def main():
 
     net = ResNet50(num_classes=1000, height=hw, width=hw,
                    compute_dtype="bfloat16").init()
-    if net._train_step is None:
-        net._build_train_step()
 
     rng = np.random.RandomState(0)
     x = rng.randn(batch, hw, hw, 3).astype(np.float32)
@@ -44,18 +42,17 @@ def main():
     ds = DataSet(jax.device_put(jnp.asarray(x)),
                  jax.device_put(jnp.asarray(y)))
 
-    # warmup (compile)
-    for _ in range(3):
-        net.fit(ds)
+    steps = 60 if on_tpu else 3
+    # fit_steps: `steps` iterations per dispatch (steps_per_execution),
+    # removing the per-step host dispatch gap (~+13% at this shape)
+    net.fit_steps(ds, steps)     # warmup (compile)
     jax.block_until_ready(net.params)
     float(net.score())
 
-    steps = 15 if on_tpu else 3
     best = 0.0
     for _trial in range(3):
         t0 = time.perf_counter()
-        for _ in range(steps):
-            net.fit(ds)
+        net.fit_steps(ds, steps)
         jax.block_until_ready(net.params)
         # score() syncs on the final step's loss — guarantees the whole
         # dispatch chain actually executed before we stop the clock

@@ -365,6 +365,33 @@ _binary("bitwise_or", "bitwise", jnp.bitwise_or)
 _binary("bitwise_xor", "bitwise", jnp.bitwise_xor)
 _binary("left_shift", "bitwise", jnp.left_shift)
 _binary("right_shift", "bitwise", jnp.right_shift)
+
+
+_UNSIGNED = {1: jnp.uint8, 2: jnp.uint16, 4: jnp.uint32, 8: jnp.uint64}
+
+
+def _rotate(x, s, left: bool):
+    # rotate in the unsigned domain: arithmetic right shift on signed
+    # ints would sign-fill instead of wrapping
+    ut = _UNSIGNED[x.dtype.itemsize]
+    bits = jnp.asarray(x.dtype.itemsize * 8, ut)
+    ux = x.astype(ut)
+    us = s.astype(ut) % bits
+    if left:
+        r = (ux << us) | (ux >> ((bits - us) % bits))
+    else:
+        r = (ux >> us) | (ux << ((bits - us) % bits))
+    return r.astype(x.dtype)
+
+
+@op("cyclic_shift_left", "bitwise")
+def _rotl(ins, attrs):
+    return _rotate(ins[0], ins[1], left=True)
+
+
+@op("cyclic_shift_right", "bitwise")
+def _rotr(ins, attrs):
+    return _rotate(ins[0], ins[1], left=False)
 _unary("bitwise_not", "bitwise", jnp.invert)
 
 
@@ -583,6 +610,31 @@ def _segment_mean(ins, attrs):
     s = jax.ops.segment_sum(ins[0], seg, num_segments=n)
     c = jax.ops.segment_sum(jnp.ones_like(ins[0]), seg, num_segments=n)
     return s / jnp.maximum(c, 1)
+
+
+@op("segment_prod", "segment")
+def _segment_prod(ins, attrs):
+    return jax.ops.segment_prod(ins[0], ins[1].astype(jnp.int32),
+                                num_segments=attrs.get("num_segments"))
+
+
+# unsorted variants: jax segment ops accept unsorted ids natively, so
+# these alias the sorted spellings (reference: unsortedSegment* ops are
+# distinct kernels in libnd4j; XLA scatter handles both)
+alias("unsorted_segment_sum", "segment_sum")
+alias("unsorted_segment_max", "segment_max")
+alias("unsorted_segment_min", "segment_min")
+alias("unsorted_segment_mean", "segment_mean")
+alias("unsorted_segment_prod", "segment_prod")
+
+
+@op("unsorted_segment_sqrt_n", "segment")
+def _segment_sqrt_n(ins, attrs):
+    seg = ins[1].astype(jnp.int32)
+    n = attrs.get("num_segments")
+    s = jax.ops.segment_sum(ins[0], seg, num_segments=n)
+    c = jax.ops.segment_sum(jnp.ones_like(ins[0]), seg, num_segments=n)
+    return s / jnp.sqrt(jnp.maximum(c, 1))
 
 
 # -- normalization ----------------------------------------------------------
@@ -1073,6 +1125,51 @@ def _sru_cell(ins, attrs):
     r = jax.nn.sigmoid(z[:, 2 * H:3 * H])
     c = f * c_prev + (1 - f) * z[:, :H]
     return r * jnp.tanh(c) + (1 - r) * x[:, :H], c
+
+
+@op("lstm_layer", "recurrent")
+def _lstm_layer(ins, attrs):
+    """Full-sequence LSTM via lax.scan (reference: libnd4j lstmLayer,
+    the op behind the reference's cuDNN LSTM path). Inputs: x [b, t, f],
+    h0 [b, H], c0 [b, H], w [f, 4H], rw [H, 4H], b [4H].
+    Returns (h_seq [b, t, H], h_last, c_last)."""
+    x, h0, c0, w, rw, b = ins
+    H = h0.shape[-1]
+
+    def cell(carry, xt):
+        h_prev, c_prev = carry
+        z = xt @ w + h_prev @ rw + b
+        i = jax.nn.sigmoid(z[:, :H])
+        f = jax.nn.sigmoid(z[:, H:2 * H])
+        o = jax.nn.sigmoid(z[:, 2 * H:3 * H])
+        g = jnp.tanh(z[:, 3 * H:])
+        c = f * c_prev + i * g
+        h = o * jnp.tanh(c)
+        return (h, c), h
+
+    (h_last, c_last), hs = lax.scan(cell, (h0, c0),
+                                    jnp.swapaxes(x, 0, 1))
+    return jnp.swapaxes(hs, 0, 1), h_last, c_last
+
+
+@op("sru", "recurrent")
+def _sru_layer(ins, attrs):
+    """Full-sequence SRU via lax.scan (reference: libnd4j sru op).
+    Inputs: x [b, t, f], c0 [b, H], w [f, 3H], b [3H] with H == f.
+    Returns (out_seq [b, t, H], c_last)."""
+    x, c0, w, b = ins
+    H = c0.shape[-1]
+
+    def cell(c_prev, xt):
+        z = xt @ w + b
+        f = jax.nn.sigmoid(z[:, H:2 * H])
+        r = jax.nn.sigmoid(z[:, 2 * H:3 * H])
+        c = f * c_prev + (1 - f) * z[:, :H]
+        out = r * jnp.tanh(c) + (1 - r) * xt[:, :H]
+        return c, out
+
+    c_last, outs = lax.scan(cell, c0, jnp.swapaxes(x, 0, 1))
+    return jnp.swapaxes(outs, 0, 1), c_last
 
 
 # -- compression (threshold encoding, SURVEY.md J11/P2) ---------------------

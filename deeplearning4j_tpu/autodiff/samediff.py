@@ -540,7 +540,13 @@ class SameDiff:
             return [values[n] for n in out_names]
 
         cap_vars = [self.vars[pname] for _, pname in parent_caps]
-        return call, len(out_names), cap_vars
+        # serializable description of the subgraph (sd.save writes it;
+        # load rebuilds the call closure from it) — the live refs
+        # (child, frozen owners) are resolved to arrays at save time
+        spec = {"child": child, "frozen_caps": frozen_caps,
+                "proxies": proxy_names, "outs": out_names,
+                "parent_cap_locals": [l for l, _ in parent_caps]}
+        return call, len(out_names), cap_vars, spec
 
     def while_loop(self, loop_vars: Sequence, cond_fn, body_fn,
                    name: Optional[str] = None):
@@ -552,8 +558,10 @@ class SameDiff:
         """
         loop_vars = [self._as_var(v) for v in loop_vars]
         n = len(loop_vars)
-        cond_call, _, cond_caps = self._trace_subgraph(cond_fn, n)
-        body_call, n_body, body_caps = self._trace_subgraph(body_fn, n)
+        cond_call, _, cond_caps, cond_spec = self._trace_subgraph(
+            cond_fn, n)
+        body_call, n_body, body_caps, body_spec = self._trace_subgraph(
+            body_fn, n)
         if n_body != n:
             raise ValueError(f"while_loop body returned {n_body} vars "
                              f"for {n} loop vars")
@@ -561,6 +569,8 @@ class SameDiff:
                         loop_vars + cond_caps + body_caps,
                         {"_cond_call": cond_call,
                          "_body_call": body_call,
+                         "_cond_spec": cond_spec,
+                         "_body_spec": body_spec,
                          "n_loop": n,
                          "n_cond_caps": len(cond_caps),
                          "n_body_caps": len(body_caps)},
@@ -572,10 +582,10 @@ class SameDiff:
         Both branches take ``operands`` and must return the same
         number of outputs. Differentiable."""
         operands = [self._as_var(v) for v in operands]
-        t_call, nt, t_caps = self._trace_subgraph(true_fn,
-                                                  len(operands))
-        f_call, nf, f_caps = self._trace_subgraph(false_fn,
-                                                  len(operands))
+        t_call, nt, t_caps, t_spec = self._trace_subgraph(true_fn,
+                                                          len(operands))
+        f_call, nf, f_caps, f_spec = self._trace_subgraph(false_fn,
+                                                          len(operands))
         if nt != nf:
             raise ValueError(f"cond branches disagree: {nt} vs {nf} "
                              f"outputs")
@@ -583,6 +593,7 @@ class SameDiff:
                         [self._as_var(pred)] + operands
                         + t_caps + f_caps,
                         {"_true_call": t_call, "_false_call": f_call,
+                         "_true_spec": t_spec, "_false_spec": f_spec,
                          "n_operands": len(operands),
                          "n_true_caps": len(t_caps),
                          "n_false_caps": len(f_caps)},
@@ -597,13 +608,14 @@ class SameDiff:
         (reference tBPTT-style loops compile to this)."""
         init = [self._as_var(v) for v in init]
         xs = [self._as_var(v) for v in xs]
-        body_call, n_total, caps = self._trace_subgraph(
+        body_call, n_total, caps, body_spec = self._trace_subgraph(
             body_fn, len(init) + len(xs))
         if n_total < len(init):
             raise ValueError("scan body must return at least the "
                              "carry")
         return self._op("scan", init + xs + caps,
                         {"_body_call": body_call,
+                         "_body_spec": body_spec,
                          "n_carry": len(init), "n_xs": len(xs),
                          "length": length},
                         name=name, n_out=n_total)
@@ -774,6 +786,7 @@ class SameDiff:
         """Zip: graph.json + arrays.npz (+ updater npz) — the same
         contract as the reference .fb (graph + params + updater state +
         training config)."""
+        cf_arrays: dict = {}   # control-flow subgraph constants/captures
         graph = {
             "variables": [
                 {"name": v.name, "type": v.var_type.value,
@@ -782,7 +795,9 @@ class SameDiff:
                 for v in self.vars.values()],
             "ops": [{"op": o.op_name, "inputs": o.inputs,
                      "outputs": o.outputs,
-                     "attrs": _json_attrs(o.attrs)} for o in self.ops],
+                     "attrs": _json_attrs(o.attrs, cf_arrays,
+                                          f"__cf.op{i}")}
+                    for i, o in enumerate(self.ops)],
             "loss_variables": self.loss_variables,
             "training_config": (self.training_config.to_map()
                                 if self.training_config else None),
@@ -791,7 +806,8 @@ class SameDiff:
             z.writestr("graph.json", json.dumps(graph, indent=1))
             buf = io.BytesIO()
             np.savez(buf, **{k: np.asarray(v)
-                             for k, v in self._arrays.items()})
+                             for k, v in self._arrays.items()},
+                     **cf_arrays)
             z.writestr("arrays.npz", buf.getvalue())
             if save_updater_state and self._updater_state is not None:
                 leaves, treedef = jax.tree_util.tree_flatten(
@@ -817,7 +833,8 @@ class SameDiff:
                 sd._arrays[v.name] = arr_map[v.name]
         for i, od in enumerate(graph["ops"]):
             node = OpNode(od["op"], od["inputs"], od["outputs"],
-                          od["attrs"])
+                          _rebuild_cf_attrs(od["op"], od["attrs"],
+                                            arr_map))
             sd.ops.append(node)
             for on in node.outputs:
                 sd._producer[on] = i
@@ -854,12 +871,15 @@ class SameDiff:
         return "\n".join(lines)
 
 
-def _json_attrs(attrs: dict) -> dict:
+def _json_attrs(attrs: dict, array_sink: Optional[dict] = None,
+                prefix: str = "") -> dict:
     out = {}
     for k, v in (attrs or {}).items():
-        if k == "rng":
-            continue
-        if isinstance(v, (np.integer,)):
+        if k == "rng" or callable(v):
+            continue    # call closures are rebuilt from *_spec on load
+        if k.endswith("_spec") and isinstance(v, dict) and "child" in v:
+            v = _spec_to_json(v, array_sink, f"{prefix}.{k}")
+        elif isinstance(v, (np.integer,)):
             v = int(v)
         elif isinstance(v, (np.floating,)):
             v = float(v)
@@ -869,3 +889,100 @@ def _json_attrs(attrs: dict) -> dict:
             v = v.tolist()
         out[k] = v
     return out
+
+
+def _spec_to_json(spec: dict, array_sink: Optional[dict] = None,
+                  prefix: str = "") -> dict:
+    """Serialize a control-flow subgraph (see _trace_subgraph): child
+    graph structure + constants, with frozen outer-graph captures baked
+    to their save-time values (matching the runtime freeze semantics).
+    Arrays go into ``array_sink`` (written to the zip's arrays.npz
+    under ``prefix``) — large captured weights stay binary; without a
+    sink they inline into the JSON (small graphs / tests)."""
+    child = spec["child"]
+    arrays = {n: np.asarray(a) for n, a in child._arrays.items()}
+    for local, owner, pname in spec["frozen_caps"]:
+        arrays[local] = np.asarray(owner._arrays[pname])
+    out = {
+        "vars": [{"name": v.name, "type": v.var_type.value,
+                  "shape": list(v.shape) if v.shape else None,
+                  "dtype": str(v.dtype) if v.dtype else None}
+                 for v in child.vars.values()],
+        "ops": [{"op": o.op_name, "inputs": o.inputs,
+                 "outputs": o.outputs,
+                 "attrs": _json_attrs(o.attrs, array_sink,
+                                      f"{prefix}.op{i}")}
+                for i, o in enumerate(child.ops)],
+        "proxies": spec["proxies"],
+        "outs": spec["outs"],
+        "parent_cap_locals": spec["parent_cap_locals"],
+    }
+    if array_sink is not None:
+        out["arrays_prefix"] = prefix
+        out["array_names"] = sorted(arrays)
+        for n, a in arrays.items():
+            array_sink[f"{prefix}/{n}"] = a
+    else:
+        out["arrays"] = {n: {"dtype": str(a.dtype), "data": a.tolist()}
+                         for n, a in arrays.items()}
+    return out
+
+
+def _call_from_json_spec(spec: dict, arr_map: Optional[dict] = None):
+    """Rebuild a subgraph call closure from its serialized form (the
+    load-side twin of _trace_subgraph's `call`). ``arr_map`` holds the
+    zip's arrays.npz entries for npz-referenced specs."""
+    child = SameDiff()
+    for vd in spec["vars"]:
+        v = SDVariable(child, vd["name"], VariableType(vd["type"]),
+                       tuple(vd["shape"]) if vd["shape"] else None,
+                       vd["dtype"])
+        child.vars[v.name] = v
+    if "arrays_prefix" in spec:
+        pre = spec["arrays_prefix"]
+        for n in spec["array_names"]:
+            child._arrays[n] = jnp.asarray(arr_map[f"{pre}/{n}"])
+    else:
+        for n, rec in spec.get("arrays", {}).items():
+            child._arrays[n] = jnp.asarray(
+                np.asarray(rec["data"], dtype=rec["dtype"]))
+    for i, od in enumerate(spec["ops"]):
+        attrs = _rebuild_cf_attrs(od["op"], od["attrs"], arr_map)
+        node = OpNode(od["op"], od["inputs"], od["outputs"], attrs)
+        child.ops.append(node)
+        for on in node.outputs:
+            child._producer[on] = i
+    idxs = child._ancestors(list(spec["outs"]))
+    proxies = list(spec["proxies"])
+    cap_locals = list(spec["parent_cap_locals"])
+    outs = list(spec["outs"])
+    n_args = len(proxies)
+
+    def call(*args):
+        values = dict(child._arrays)
+        values.update(zip(proxies, args[:n_args]))
+        values.update(zip(cap_locals, args[n_args:]))
+        child._execute(values, idxs, None, False)
+        return [values[n] for n in outs]
+
+    return call
+
+
+#: control-flow attrs: call-closure key -> serialized-spec key
+_CF_CALL_SPECS = {"_cond_call": "_cond_spec", "_body_call": "_body_spec",
+                  "_true_call": "_true_spec",
+                  "_false_call": "_false_spec"}
+
+
+def _rebuild_cf_attrs(op_name: str, attrs: dict,
+                      arr_map: Optional[dict] = None) -> dict:
+    """Recreate call closures for a (possibly nested) control-flow op
+    loaded from JSON; no-op for ordinary ops."""
+    if op_name not in ("while_loop", "cond", "scan"):
+        return attrs
+    attrs = dict(attrs)
+    for call_key, spec_key in _CF_CALL_SPECS.items():
+        spec = attrs.get(spec_key)
+        if spec is not None and call_key not in attrs:
+            attrs[call_key] = _call_from_json_spec(spec, arr_map)
+    return attrs

@@ -243,6 +243,13 @@ class ResNet50(ZooModel):
     #: native dtype with float32 master params (roughly doubles
     #: throughput; the reference's cuDNN TensorCore analog)
     compute_dtype: Optional[str] = None
+    #: MLPerf-style TPU stem: space-to-depth(2) + 4x4/s1 conv replaces
+    #: the 7x7/s2 conv on 3 channels — mathematically the same function
+    #: class (the 4x4x12 kernel is the scattered zero-padded 8x8x3
+    #: kernel) with an MXU-friendly 192-deep contraction instead of a
+    #: 3-channel one. Off by default: parameter layout differs from the
+    #: reference checkpoint format.
+    stem_space_to_depth: bool = False
 
     # stage definitions: (n_blocks, bottleneck_width)
     STAGES: Tuple[Tuple[int, int], ...] = ((3, 64), (4, 128), (6, 256),
@@ -275,7 +282,16 @@ class ResNet50(ZooModel):
             return f"{name}_bn"
 
         # stem
-        last = conv_bn("stem", "input", 64, (7, 7), (2, 2))
+        if self.stem_space_to_depth:
+            from deeplearning4j_tpu.nn.conf.layers_shape import \
+                SpaceToDepthLayer
+            g.add_layer("stem_s2d", SpaceToDepthLayer(block_size=2),
+                        "input")
+            # SAME on k=4/s=1 pads (1, 2) == the 7x7/s2 conv's (2, 3)
+            # in input coordinates: exact output-shape equivalence
+            last = conv_bn("stem", "stem_s2d", 64, (4, 4), (1, 1))
+        else:
+            last = conv_bn("stem", "input", 64, (7, 7), (2, 2))
         g.add_layer("stem_pool",
                     SubsamplingLayer(pooling_type=PoolingType.MAX,
                                      kernel_size=(3, 3), stride=(2, 2),

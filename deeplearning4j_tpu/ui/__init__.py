@@ -4,8 +4,8 @@ Reference: `deeplearning4j-ui` — `StatsListener` collects per-iteration
 model statistics into a `StatsStorage` (in-memory / file), and the
 Vert.x `VertxUIServer` renders them. Here the storage formats are
 in-memory and JSONL-on-disk (machine-readable; any dashboard can tail
-it), plus a static-HTML report renderer in place of the live web
-server (zero-dependency, works over a shared filesystem).
+it), a static-HTML report renderer, and a live stdlib-HTTP `UIServer`
+(VertxUIServer parity: attach a storage, watch during training).
 
 The Chrome-trace `ProfilingListener` (SURVEY.md S8/§5.1) writes
 chrome://tracing-compatible JSON for per-iteration timing.
@@ -13,7 +13,8 @@ chrome://tracing-compatible JSON for per-iteration timing.
 from .stats import (FileStatsStorage, InMemoryStatsStorage,
                     StatsListener, render_html_report)
 from .profiling import ProfilingListener
+from .server import UIServer
 
 __all__ = ["StatsListener", "InMemoryStatsStorage",
            "FileStatsStorage", "render_html_report",
-           "ProfilingListener"]
+           "ProfilingListener", "UIServer"]

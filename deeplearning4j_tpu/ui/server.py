@@ -1,0 +1,173 @@
+"""Live training dashboard server.
+
+Reference parity: ``org.deeplearning4j.ui.VertxUIServer`` (SURVEY.md
+D17): ``UIServer.getInstance().attach(statsStorage)`` then watch the
+dashboard during training. Vert.x + WebSocket push is re-designed as a
+stdlib ``ThreadingHTTPServer`` + polling fetch: zero dependencies, same
+charts (score curve, update:param ratio), and the storage contract is
+identical — any InMemoryStatsStorage/FileStatsStorage can be attached,
+during or after training.
+
+Endpoints:
+- ``/``             live dashboard (auto-refreshes every 2s)
+- ``/api/reports``  all reports of every attached storage (JSON)
+- ``/api/latest``   most recent report (JSON)
+"""
+from __future__ import annotations
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import List, Optional
+
+
+_PAGE = """<!DOCTYPE html>
+<html><head><meta charset="utf-8"><title>deeplearning4j_tpu UI</title>
+<style>body{font-family:sans-serif;margin:2em}
+.chart{margin-bottom:2em}</style></head>
+<body><h1>Training dashboard</h1>
+<div>iteration: <b id="it">-</b> &nbsp; score: <b id="sc">-</b></div>
+<div class="chart"><h3>Score vs iteration</h3>
+<canvas id="score" width="800" height="240"></canvas></div>
+<div class="chart"><h3>log10 update:param ratio</h3>
+<canvas id="ratio" width="800" height="240"></canvas></div>
+<script>
+function plot(id, xs, series) {
+  const c = document.getElementById(id), g = c.getContext('2d');
+  g.clearRect(0, 0, c.width, c.height);
+  const all = series.flatMap(s => s).filter(v => v != null &&
+      isFinite(v));
+  if (!all.length) return;
+  const ymin = Math.min(...all), ymax = Math.max(...all);
+  const xmin = Math.min(...xs), xmax = Math.max(...xs);
+  series.forEach((ys, si) => {
+    g.strokeStyle = `hsl(${si * 57 % 360},70%,45%)`;
+    g.beginPath();
+    let started = false;
+    ys.forEach((y, i) => {
+      if (y == null || !isFinite(y)) return;
+      const px = 40 + (xs[i] - xmin) / (xmax - xmin || 1) * 740;
+      const py = 220 - (y - ymin) / (ymax - ymin || 1) * 200;
+      started ? g.lineTo(px, py) : g.moveTo(px, py);
+      started = true;
+    });
+    g.stroke();
+  });
+}
+async function tick() {
+  try {
+    const rs = await (await fetch('/api/reports')).json();
+    if (rs.length) {
+      const last = rs[rs.length - 1];
+      document.getElementById('it').textContent = last.iteration;
+      document.getElementById('sc').textContent =
+          last.score.toFixed(5);
+      const iters = rs.map(r => r.iteration);
+      plot('score', iters, [rs.map(r => r.score)]);
+      const keys = [...new Set(rs.flatMap(r =>
+          Object.entries(r.layers || {})
+              .filter(([k, v]) => 'update_param_ratio' in v)
+              .map(([k]) => k)))];
+      plot('ratio', iters, keys.map(k => rs.map(r => {
+        const v = (r.layers || {})[k];
+        return v && v.update_param_ratio > 0 ?
+            Math.log10(v.update_param_ratio) : null;
+      })));
+    }
+  } catch (e) {}
+  setTimeout(tick, 2000);
+}
+tick();
+</script></body></html>"""
+
+
+class UIServer:
+    """Singleton live dashboard (reference: UIServer.getInstance())."""
+
+    _instance: Optional["UIServer"] = None
+
+    def __init__(self):
+        self._storages: List = []
+        self._httpd: Optional[ThreadingHTTPServer] = None
+        self._thread: Optional[threading.Thread] = None
+        self.port: Optional[int] = None
+
+    @classmethod
+    def get_instance(cls) -> "UIServer":
+        if cls._instance is None:
+            cls._instance = cls()
+        return cls._instance
+
+    def attach(self, storage) -> "UIServer":
+        if storage not in self._storages:
+            self._storages.append(storage)
+        return self
+
+    def detach(self, storage) -> "UIServer":
+        if storage in self._storages:
+            self._storages.remove(storage)
+        return self
+
+    # ------------------------------------------------------------------
+    def start(self, port: int = 9000) -> "UIServer":
+        """Serve on 127.0.0.1:port (0 picks a free port; see
+        ``self.port``). Idempotent."""
+        if self._httpd is not None:
+            return self
+        server = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, *a):      # silence request logging
+                pass
+
+            def _json(self, obj, code=200):
+                body = json.dumps(obj).encode()
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_GET(self):               # noqa: N802
+                if self.path == "/" or self.path.startswith("/train"):
+                    body = _PAGE.encode()
+                    self.send_response(200)
+                    self.send_header("Content-Type",
+                                     "text/html; charset=utf-8")
+                    self.send_header("Content-Length", str(len(body)))
+                    self.end_headers()
+                    self.wfile.write(body)
+                elif self.path == "/api/reports":
+                    reports = []
+                    for s in server._storages:
+                        reports.extend(s.get_reports())
+                    self._json(reports)
+                elif self.path == "/api/latest":
+                    latest = None
+                    for s in server._storages:
+                        r = s.latest()
+                        if r and (latest is None or
+                                  r["time"] > latest["time"]):
+                            latest = r
+                    self._json(latest)
+                else:
+                    self._json({"error": "not found"}, 404)
+
+        self._httpd = ThreadingHTTPServer(("127.0.0.1", port), Handler)
+        self.port = self._httpd.server_address[1]
+        self._thread = threading.Thread(target=self._httpd.serve_forever,
+                                        daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self):
+        if self._httpd is not None:
+            self._httpd.shutdown()
+            self._httpd.server_close()
+            self._httpd = None
+            self._thread = None
+            self.port = None
+
+    @property
+    def url(self) -> Optional[str]:
+        return f"http://127.0.0.1:{self.port}" if self.port else None

@@ -1,0 +1,101 @@
+"""fit_steps (multi-iteration single-dispatch training) semantics:
+N fit() calls and one fit_steps(N) must produce identical parameters,
+updater state, and iteration count for deterministic (rng-free) models
+(the Keras steps_per_execution analog; SURVEY.md §7 perf work)."""
+import jax
+import numpy as np
+
+from deeplearning4j_tpu.activations import Activation
+from deeplearning4j_tpu.datasets.dataset import DataSet
+from deeplearning4j_tpu.learning import Adam
+from deeplearning4j_tpu.lossfunctions import LossFunction
+from deeplearning4j_tpu.nn import InputType, NeuralNetConfiguration
+from deeplearning4j_tpu.nn.conf.layers import (BatchNormalization,
+                                               DenseLayer, OutputLayer)
+from deeplearning4j_tpu.nn.graph import ComputationGraph
+
+
+def _net(seed=0):
+    g = (NeuralNetConfiguration.Builder()
+         .seed(seed).updater(Adam(1e-2))
+         .graph_builder()
+         .add_inputs("in"))
+    g.add_layer("d1", DenseLayer(n_out=8, activation=Activation.RELU),
+                "in")
+    g.add_layer("bn", BatchNormalization(), "d1")
+    g.add_layer("out", OutputLayer(n_out=3,
+                                   loss_function=LossFunction.MCXENT,
+                                   activation=Activation.SOFTMAX), "bn")
+    g.set_outputs("out")
+    g.set_input_types(InputType.feed_forward(4))
+    return ComputationGraph(g.build()).init()
+
+
+def test_fit_steps_matches_fit_loop():
+    rng = np.random.RandomState(0)
+    x = rng.randn(32, 4).astype(np.float32)
+    y = np.eye(3, dtype=np.float32)[rng.randint(0, 3, 32)]
+    ds = DataSet(x, y)
+
+    a, b = _net(), _net()
+    for _ in range(5):
+        a.fit(ds)
+    b.fit_steps(ds, 5)
+
+    assert a.iteration_count == b.iteration_count == 5
+    fa = jax.tree_util.tree_leaves(a.params)
+    fb = jax.tree_util.tree_leaves(b.params)
+    for la, lb in zip(fa, fb):
+        np.testing.assert_allclose(np.asarray(la), np.asarray(lb),
+                                   rtol=1e-5, atol=1e-6)
+    # BN running stats advanced identically (states threaded in-loop)
+    sa = jax.tree_util.tree_leaves(a.states)
+    sb = jax.tree_util.tree_leaves(b.states)
+    for la, lb in zip(sa, sb):
+        np.testing.assert_allclose(np.asarray(la), np.asarray(lb),
+                                   rtol=1e-5, atol=1e-6)
+    # subsequent single-step fits continue from the same point
+    a.fit(ds)
+    b.fit(ds)
+    for la, lb in zip(jax.tree_util.tree_leaves(a.params),
+                      jax.tree_util.tree_leaves(b.params)):
+        np.testing.assert_allclose(np.asarray(la), np.asarray(lb),
+                                   rtol=1e-5, atol=1e-6)
+
+
+def test_fit_steps_trains():
+    rng = np.random.RandomState(1)
+    x = rng.randn(64, 4).astype(np.float32)
+    ys = (x[:, 0] > 0).astype(int) + (x[:, 1] > 0).astype(int)
+    y = np.eye(3, dtype=np.float32)[ys]
+    ds = DataSet(x, y)
+    net = _net(seed=3)
+    net.fit_steps(ds, 2)
+    first = float(net.score())
+    for _ in range(10):
+        net.fit_steps(ds, 10)
+    assert float(net.score()) < first * 0.5
+    assert net.iteration_count == 102
+
+
+def test_fit_steps_rejects_masked_data():
+    import pytest
+    rng = np.random.RandomState(0)
+    x = rng.randn(4, 4).astype(np.float32)
+    y = np.eye(3, dtype=np.float32)[rng.randint(0, 3, 4)]
+    ds = DataSet(x, y)
+    ds.features_mask = np.ones((4, 2), np.float32)
+    net = _net()
+    with pytest.raises(ValueError, match="mask"):
+        net.fit_steps(ds, 2)
+
+
+def test_stem_space_to_depth_variant_builds():
+    """ResNet50 stem_space_to_depth option: same output contract."""
+    from deeplearning4j_tpu.models.zoo import ResNet50
+    net = ResNet50(num_classes=10, height=32, width=32,
+                   stem_space_to_depth=True).init()
+    x = np.random.RandomState(0).rand(2, 32, 32, 3).astype(np.float32)
+    out = net.output(x)
+    arr = np.asarray(out[0] if isinstance(out, (list, tuple)) else out)
+    assert arr.shape == (2, 10)

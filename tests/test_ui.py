@@ -71,6 +71,34 @@ class TestStatsListener:
 
 
 class TestProfilingListener:
+    def test_live_ui_server(self):
+        """UIServer serves the dashboard + JSON API for an attached
+        storage (reference: VertxUIServer.attach(statsStorage))."""
+        import json as _json
+        import urllib.request
+
+        from deeplearning4j_tpu.ui import InMemoryStatsStorage, UIServer
+        storage = InMemoryStatsStorage()
+        storage.put_report({"iteration": 0, "epoch": 0, "time": 1.0,
+                            "score": 2.5, "layers": {}})
+        server = UIServer.get_instance().attach(storage)
+        server.start(port=0)
+        try:
+            base = server.url
+            html = urllib.request.urlopen(base + "/").read().decode()
+            assert "Training dashboard" in html
+            reports = _json.loads(urllib.request.urlopen(
+                base + "/api/reports").read())
+            assert len(reports) == 1 and reports[0]["score"] == 2.5
+            storage.put_report({"iteration": 1, "epoch": 0, "time": 2.0,
+                                "score": 1.5, "layers": {}})
+            latest = _json.loads(urllib.request.urlopen(
+                base + "/api/latest").read())
+            assert latest["score"] == 1.5    # live: sees new reports
+        finally:
+            server.stop()
+            server.detach(storage)
+
     def test_chrome_trace(self, tmp_path):
         p = str(tmp_path / "trace.json")
         prof = ProfilingListener(p)
