@@ -1,0 +1,278 @@
+"""Group-by reduction and two-table joins (SURVEY.md V2).
+
+Reference parity: ``org.datavec.api.transform.reduce.Reducer`` (group
+records by key column(s), aggregate every other column with a per-column
+``ReduceOp``) and ``org.datavec.api.transform.join.Join``
+(Inner/LeftOuter/RightOuter/FullOuter joins of two schema'd record
+sets). The reference executes these on Spark (`datavec-spark`) or
+locally (`datavec-local`); here the local executor covers both roles —
+cluster-scale ETL belongs to the host data pipeline, not the TPU.
+"""
+from __future__ import annotations
+
+import enum
+import math
+from typing import Dict, List, Optional, Sequence
+
+from deeplearning4j_tpu.datavec.schema import (ColumnMetaData, ColumnType,
+                                               Schema)
+
+
+class ReduceOp(enum.Enum):
+    """Reference: org.datavec.api.transform.ops.AggregableReductionUtils
+    op set."""
+    MIN = "min"
+    MAX = "max"
+    SUM = "sum"
+    MEAN = "mean"
+    STDEV = "stdev"
+    COUNT = "count"
+    COUNT_UNIQUE = "count_unique"
+    FIRST = "first"
+    LAST = "last"
+    RANGE = "range"
+
+
+_NUMERIC = (ColumnType.INTEGER, ColumnType.LONG, ColumnType.DOUBLE,
+            ColumnType.FLOAT)
+
+
+def _reduce_values(op: ReduceOp, values: list):
+    if op is ReduceOp.COUNT:
+        return len(values)
+    if op is ReduceOp.COUNT_UNIQUE:
+        return len(set(values))
+    if op is ReduceOp.FIRST:
+        return values[0]
+    if op is ReduceOp.LAST:
+        return values[-1]
+    nums = [float(v) for v in values]
+    if op is ReduceOp.MIN:
+        return min(nums)
+    if op is ReduceOp.MAX:
+        return max(nums)
+    if op is ReduceOp.SUM:
+        return sum(nums)
+    if op is ReduceOp.MEAN:
+        return sum(nums) / len(nums)
+    if op is ReduceOp.RANGE:
+        return max(nums) - min(nums)
+    if op is ReduceOp.STDEV:
+        m = sum(nums) / len(nums)
+        if len(nums) < 2:
+            return 0.0
+        return math.sqrt(sum((x - m) ** 2 for x in nums) /
+                         (len(nums) - 1))
+    raise ValueError(op)
+
+
+_TYPE_AGNOSTIC = (ReduceOp.COUNT, ReduceOp.COUNT_UNIQUE,
+                  ReduceOp.FIRST, ReduceOp.LAST)
+
+
+def _out_type(op: ReduceOp, in_type: ColumnType,
+              column: str) -> ColumnType:
+    if op in (ReduceOp.COUNT, ReduceOp.COUNT_UNIQUE):
+        return ColumnType.LONG
+    if op in (ReduceOp.FIRST, ReduceOp.LAST):
+        return in_type
+    if in_type not in _NUMERIC:
+        raise ValueError(
+            f"ReduceOp.{op.name} on non-numeric column '{column}' "
+            f"({in_type.name}); tag it with first/last/count_columns")
+    if op in (ReduceOp.MEAN, ReduceOp.STDEV, ReduceOp.RANGE):
+        return ColumnType.DOUBLE
+    return in_type
+
+
+class Reducer:
+    """Group-by aggregation (reference: transform.reduce.Reducer).
+
+    Reducer.Builder(default_op).key_columns("k")
+        .sum_columns("a").mean_columns("b").build()
+    """
+
+    def __init__(self, keys: List[str], default_op: ReduceOp,
+                 column_ops: Dict[str, ReduceOp]):
+        self.keys = keys
+        self.default_op = default_op
+        self.column_ops = column_ops
+
+    class Builder:
+        def __init__(self, default_op: ReduceOp = ReduceOp.SUM):
+            self._default = default_op
+            self._keys: List[str] = []
+            self._ops: Dict[str, ReduceOp] = {}
+
+        def key_columns(self, *names: str) -> "Reducer.Builder":
+            self._keys.extend(names)
+            return self
+
+        def _tag(self, op: ReduceOp, names) -> "Reducer.Builder":
+            for n in names:
+                self._ops[n] = op
+            return self
+
+        def min_columns(self, *n):
+            return self._tag(ReduceOp.MIN, n)
+
+        def max_columns(self, *n):
+            return self._tag(ReduceOp.MAX, n)
+
+        def sum_columns(self, *n):
+            return self._tag(ReduceOp.SUM, n)
+
+        def mean_columns(self, *n):
+            return self._tag(ReduceOp.MEAN, n)
+
+        def stdev_columns(self, *n):
+            return self._tag(ReduceOp.STDEV, n)
+
+        def count_columns(self, *n):
+            return self._tag(ReduceOp.COUNT, n)
+
+        def count_unique_columns(self, *n):
+            return self._tag(ReduceOp.COUNT_UNIQUE, n)
+
+        def first_columns(self, *n):
+            return self._tag(ReduceOp.FIRST, n)
+
+        def last_columns(self, *n):
+            return self._tag(ReduceOp.LAST, n)
+
+        def range_columns(self, *n):
+            return self._tag(ReduceOp.RANGE, n)
+
+        def build(self) -> "Reducer":
+            if not self._keys:
+                raise ValueError("Reducer needs key columns")
+            return Reducer(self._keys, self._default, dict(self._ops))
+
+    # ------------------------------------------------------------------
+    def transform_schema(self, schema: Schema) -> Schema:
+        cols = []
+        for name in schema.column_names():
+            if name in self.keys:
+                cols.append(ColumnMetaData(name, schema.type_of(name)))
+            else:
+                op = self.column_ops.get(name, self.default_op)
+                cols.append(ColumnMetaData(
+                    f"{op.value}({name})",
+                    _out_type(op, schema.type_of(name), name)))
+        return Schema(cols)
+
+    def execute(self, schema: Schema,
+                records: Sequence[Sequence]) -> List[List]:
+        self.transform_schema(schema)   # validates op/column-type combos
+        names = schema.column_names()
+        key_idx = [schema.index_of(k) for k in self.keys]
+        groups: Dict[tuple, List[Sequence]] = {}
+        order: List[tuple] = []
+        for r in records:
+            k = tuple(r[i] for i in key_idx)
+            if k not in groups:
+                groups[k] = []
+                order.append(k)
+            groups[k].append(r)
+        out = []
+        for k in order:
+            rows = groups[k]
+            rec = []
+            for i, name in enumerate(names):
+                if name in self.keys:
+                    rec.append(rows[0][i])
+                else:
+                    op = self.column_ops.get(name, self.default_op)
+                    rec.append(_reduce_values(op, [r[i] for r in rows]))
+            out.append(rec)
+        return out
+
+
+class JoinType(enum.Enum):
+    INNER = "inner"
+    LEFT_OUTER = "left_outer"
+    RIGHT_OUTER = "right_outer"
+    FULL_OUTER = "full_outer"
+
+
+class Join:
+    """Two-table join on key columns (reference: transform.join.Join).
+
+    Join.Builder(JoinType.INNER).set_join_columns("k")
+        .set_schemas(left, right).build()
+    then ``join.execute(left_records, right_records)``.
+    """
+
+    def __init__(self, join_type: JoinType, keys: List[str],
+                 left: Schema, right: Schema):
+        self.join_type = join_type
+        self.keys = keys
+        self.left = left
+        self.right = right
+
+    class Builder:
+        def __init__(self, join_type: JoinType = JoinType.INNER):
+            self._type = join_type
+            self._keys: List[str] = []
+            self._left: Optional[Schema] = None
+            self._right: Optional[Schema] = None
+
+        def set_join_columns(self, *names: str) -> "Join.Builder":
+            self._keys.extend(names)
+            return self
+
+        def set_schemas(self, left: Schema,
+                        right: Schema) -> "Join.Builder":
+            self._left, self._right = left, right
+            return self
+
+        def build(self) -> "Join":
+            if not self._keys or self._left is None:
+                raise ValueError("Join needs key columns and schemas")
+            return Join(self._type, self._keys, self._left, self._right)
+
+    # ------------------------------------------------------------------
+    def output_schema(self) -> Schema:
+        cols = [ColumnMetaData(n, self.left.type_of(n))
+                for n in self.left.column_names()]
+        for n in self.right.column_names():
+            if n not in self.keys:
+                cols.append(ColumnMetaData(n, self.right.type_of(n)))
+        return Schema(cols)
+
+    def execute(self, left_records: Sequence[Sequence],
+                right_records: Sequence[Sequence]) -> List[List]:
+        lk = [self.left.index_of(k) for k in self.keys]
+        rk = [self.right.index_of(k) for k in self.keys]
+        r_other = [i for i, n in enumerate(self.right.column_names())
+                   if n not in self.keys]
+        l_width = self.left.num_columns()
+        r_width = len(r_other)
+
+        rindex: Dict[tuple, List[Sequence]] = {}
+        for r in right_records:
+            rindex.setdefault(tuple(r[i] for i in rk), []).append(r)
+
+        out: List[List] = []
+        matched_right: set = set()
+        for l in left_records:
+            k = tuple(l[i] for i in lk)
+            matches = rindex.get(k)
+            if matches:
+                matched_right.add(k)
+                for r in matches:
+                    out.append(list(l) + [r[i] for i in r_other])
+            elif self.join_type in (JoinType.LEFT_OUTER,
+                                    JoinType.FULL_OUTER):
+                out.append(list(l) + [None] * r_width)
+        if self.join_type in (JoinType.RIGHT_OUTER, JoinType.FULL_OUTER):
+            for k, rows in rindex.items():
+                if k in matched_right:
+                    continue
+                for r in rows:
+                    # key values land in their left-schema positions
+                    left_part = [None] * l_width
+                    for kn, kv in zip(self.keys, k):
+                        left_part[self.left.index_of(kn)] = kv
+                    out.append(left_part + [r[i] for i in r_other])
+        return out

@@ -1,5 +1,6 @@
 """Keras HDF5/.keras import (SURVEY.md D14)."""
 from deeplearning4j_tpu.modelimport.keras.importer import (
     InvalidKerasConfigurationException, KerasModelImport)
+from deeplearning4j_tpu.modelimport.keras import mappers_extra  # noqa: F401
 
 __all__ = ["KerasModelImport", "InvalidKerasConfigurationException"]

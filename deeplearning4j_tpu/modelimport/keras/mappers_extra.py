@@ -1,0 +1,310 @@
+"""Keras layer mappers beyond the core set (SURVEY.md D14 — the
+reference's ~60 `KerasLayer` subclasses; this module covers the conv
+1D/3D/transposed/separable family, pooling 1D/3D, shape layers
+(crop/pad/upsample/repeat), PReLU, and the TimeDistributed and
+Bidirectional wrappers).
+
+Weight-layout notes (verified against live Keras in
+tests/test_keras_import_extra.py):
+- Conv1D kernel (k, in, out) and Conv3D (kd, kh, kw, in, out) match
+  this framework's layouts directly.
+- Conv2DTranspose kernel is (kh, kw, OUT, IN); jax
+  ``conv_transpose(transpose_kernel=True)`` consumes exactly that
+  gradient-of-conv orientation, so the Deconvolution2D forward flips it
+  into our (kh, kw, in, out) with a spatial mirror.
+- SeparableConv2D splits into depthwise (kh, kw, in, mult) +
+  pointwise (1, 1, in*mult, out) — our SeparableConvolution2D layout.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from deeplearning4j_tpu.activations import Activation
+from deeplearning4j_tpu.modelimport.keras.importer import (
+    Emit, InvalidKerasConfigurationException, _activation, _conv_mode,
+    _pair, keras_layer)
+from deeplearning4j_tpu.nn.conf.layers import PoolingType
+from deeplearning4j_tpu.nn.conf.layers_conv_1d3d import (
+    Convolution1DLayer, Convolution3D, Subsampling1DLayer,
+    Subsampling3DLayer)
+from deeplearning4j_tpu.nn.conf.layers_conv_extra import (
+    Deconvolution2D, DepthwiseConvolution2D, SeparableConvolution2D,
+    Upsampling2D)
+from deeplearning4j_tpu.nn.conf.layers_misc import PReLULayer
+from deeplearning4j_tpu.nn.conf.layers_recurrent import (
+    Bidirectional, BidirectionalMode)
+from deeplearning4j_tpu.nn.conf.layers_shape import (
+    Cropping1D, Cropping2D, Cropping3D, RepeatVector, TimeDistributed,
+    Upsampling1D, Upsampling3D, ZeroPadding1DLayer, ZeroPadding3DLayer,
+    ZeroPaddingLayer)
+
+
+from deeplearning4j_tpu.nn.conf.layers_conv_1d3d import _triple  # noqa: E402
+
+
+def _check_channels_last(cfg):
+    if cfg.get("data_format", "channels_last") == "channels_first":
+        raise InvalidKerasConfigurationException(
+            f"channels_first {cfg['__class__']} unsupported "
+            f"(NHWC-native framework)")
+
+
+@keras_layer("Conv1D")
+def _map_conv1d(cfg, bag):
+    _check_channels_last(cfg)
+    layer = Convolution1DLayer(
+        n_out=int(cfg["filters"]),
+        kernel_size=int(_first(cfg["kernel_size"])),
+        stride=int(_first(cfg.get("strides", 1))),
+        dilation=int(_first(cfg.get("dilation_rate", 1))),
+        causal=cfg.get("padding") == "causal",
+        convolution_mode=_conv_mode(cfg),
+        activation=_activation(cfg),
+        has_bias=bool(cfg.get("use_bias", True)))
+    params = {"W": bag.get(0, "kernel")}
+    if layer.has_bias:
+        params["b"] = bag.get(1, "bias")
+    return [Emit(layer=layer, params=params)]
+
+
+@keras_layer("Conv3D")
+def _map_conv3d(cfg, bag):
+    _check_channels_last(cfg)
+    layer = Convolution3D(
+        n_out=int(cfg["filters"]),
+        kernel_size=_triple(cfg["kernel_size"]),
+        stride=_triple(cfg.get("strides", 1)),
+        dilation=_triple(cfg.get("dilation_rate", 1)),
+        convolution_mode=_conv_mode(cfg),
+        activation=_activation(cfg),
+        has_bias=bool(cfg.get("use_bias", True)))
+    params = {"W": bag.get(0, "kernel")}
+    if layer.has_bias:
+        params["b"] = bag.get(1, "bias")
+    return [Emit(layer=layer, params=params)]
+
+
+@keras_layer("Conv2DTranspose")
+def _map_conv2d_transpose(cfg, bag):
+    _check_channels_last(cfg)
+    if _pair(cfg.get("dilation_rate", 1)) != (1, 1):
+        raise InvalidKerasConfigurationException(
+            "Conv2DTranspose with dilation_rate != 1 unsupported")
+    layer = Deconvolution2D(
+        n_out=int(cfg["filters"]),
+        kernel_size=_pair(cfg["kernel_size"]),
+        stride=_pair(cfg.get("strides", 1)),
+        convolution_mode=_conv_mode(cfg),
+        activation=_activation(cfg),
+        has_bias=bool(cfg.get("use_bias", True)))
+    # keras kernel (kh, kw, out, in) built for gradient-of-conv; our
+    # conv_transpose(transpose_kernel=False, HWIO) needs (kh, kw, in,
+    # out) mirrored spatially
+    k = np.asarray(bag.get(0, "kernel"))
+    w = np.transpose(k, (0, 1, 3, 2))[::-1, ::-1]
+    params = {"W": w}
+    if layer.has_bias:
+        params["b"] = bag.get(1, "bias")
+    return [Emit(layer=layer, params=params)]
+
+
+@keras_layer("SeparableConv2D")
+def _map_separable_conv2d(cfg, bag):
+    _check_channels_last(cfg)
+    layer = SeparableConvolution2D(
+        n_out=int(cfg["filters"]),
+        kernel_size=_pair(cfg["kernel_size"]),
+        stride=_pair(cfg.get("strides", 1)),
+        dilation=_pair(cfg.get("dilation_rate", 1)),
+        depth_multiplier=int(cfg.get("depth_multiplier", 1)),
+        convolution_mode=_conv_mode(cfg),
+        activation=_activation(cfg),
+        has_bias=bool(cfg.get("use_bias", True)))
+    params = {"dW": bag.get(0, "depthwise_kernel"),
+              "pW": bag.get(1, "pointwise_kernel")}
+    if layer.has_bias:
+        params["b"] = bag.get(2, "bias")
+    return [Emit(layer=layer, params=params)]
+
+
+@keras_layer("DepthwiseConv2D")
+def _map_depthwise_conv2d(cfg, bag):
+    _check_channels_last(cfg)
+    layer = DepthwiseConvolution2D(
+        kernel_size=_pair(cfg["kernel_size"]),
+        stride=_pair(cfg.get("strides", 1)),
+        dilation=_pair(cfg.get("dilation_rate", 1)),
+        depth_multiplier=int(cfg.get("depth_multiplier", 1)),
+        convolution_mode=_conv_mode(cfg),
+        activation=_activation(cfg),
+        has_bias=bool(cfg.get("use_bias", True)))
+    params = {"dW": bag.get(0, "depthwise_kernel")}
+    if layer.has_bias:
+        params["b"] = bag.get(1, "bias")
+    return [Emit(layer=layer, params=params)]
+
+
+def _first(v):
+    return v[0] if isinstance(v, (list, tuple)) else v
+
+
+@keras_layer("MaxPooling1D", "AveragePooling1D")
+def _map_pool1d(cfg, bag):
+    kind = (PoolingType.MAX if "Max" in cfg["__class__"]
+            else PoolingType.AVG)
+    pool = int(_first(cfg.get("pool_size", 2)))
+    strides = cfg.get("strides")
+    layer = Subsampling1DLayer(
+        pooling_type=kind, kernel_size=pool,
+        stride=int(_first(strides)) if strides is not None else pool,
+        convolution_mode=_conv_mode(cfg))
+    return [Emit(layer=layer)]
+
+
+@keras_layer("MaxPooling3D", "AveragePooling3D")
+def _map_pool3d(cfg, bag):
+    kind = (PoolingType.MAX if "Max" in cfg["__class__"]
+            else PoolingType.AVG)
+    pool = _triple(cfg.get("pool_size", 2))
+    strides = cfg.get("strides")
+    layer = Subsampling3DLayer(
+        pooling_type=kind, kernel_size=pool,
+        stride=_triple(strides) if strides is not None else pool,
+        convolution_mode=_conv_mode(cfg))
+    return [Emit(layer=layer)]
+
+
+@keras_layer("UpSampling1D")
+def _map_upsample1d(cfg, bag):
+    return [Emit(layer=Upsampling1D(size=int(cfg.get("size", 2))))]
+
+
+@keras_layer("UpSampling2D")
+def _map_upsample2d(cfg, bag):
+    if cfg.get("interpolation", "nearest") != "nearest":
+        raise InvalidKerasConfigurationException(
+            "UpSampling2D: only nearest interpolation supported")
+    return [Emit(layer=Upsampling2D(size=_pair(cfg.get("size", 2))))]
+
+
+@keras_layer("UpSampling3D")
+def _map_upsample3d(cfg, bag):
+    return [Emit(layer=Upsampling3D(size=_triple(cfg.get("size", 2))))]
+
+
+@keras_layer("Cropping1D")
+def _map_cropping1d(cfg, bag):
+    return [Emit(layer=Cropping1D(cropping=_pair(cfg["cropping"])))]
+
+
+@keras_layer("Cropping2D")
+def _map_cropping2d(cfg, bag):
+    c = cfg["cropping"]
+    if isinstance(c, int):
+        tb = lr = (c, c)
+    else:
+        tb, lr = _pair(c[0]), _pair(c[1])
+    return [Emit(layer=Cropping2D(crop_top_bottom=tb,
+                                  crop_left_right=lr))]
+
+
+@keras_layer("Cropping3D")
+def _map_cropping3d(cfg, bag):
+    c = cfg["cropping"]
+    return [Emit(layer=Cropping3D(crop_depth=_pair(c[0]),
+                                  crop_height=_pair(c[1]),
+                                  crop_width=_pair(c[2])))]
+
+
+@keras_layer("ZeroPadding1D")
+def _map_zeropad1d(cfg, bag):
+    return [Emit(layer=ZeroPadding1DLayer(
+        padding=_pair(cfg["padding"])))]
+
+
+@keras_layer("ZeroPadding2D")
+def _map_zeropad2d(cfg, bag):
+    p = cfg["padding"]
+    if isinstance(p, int):
+        tb = lr = (p, p)
+    else:
+        tb, lr = _pair(p[0]), _pair(p[1])
+    return [Emit(layer=ZeroPaddingLayer(pad_top_bottom=tb,
+                                        pad_left_right=lr))]
+
+
+@keras_layer("ZeroPadding3D")
+def _map_zeropad3d(cfg, bag):
+    p = cfg["padding"]
+    return [Emit(layer=ZeroPadding3DLayer(pad_depth=_pair(p[0]),
+                                          pad_height=_pair(p[1]),
+                                          pad_width=_pair(p[2])))]
+
+
+@keras_layer("RepeatVector")
+def _map_repeat_vector(cfg, bag):
+    return [Emit(layer=RepeatVector(repetition_factor=int(cfg["n"])))]
+
+
+@keras_layer("PReLU")
+def _map_prelu(cfg, bag):
+    shared = cfg.get("shared_axes")
+    layer = PReLULayer(shared_axes=tuple(shared) if shared else None)
+    return [Emit(layer=layer, params={"alpha": bag.get(0, "alpha")})]
+
+
+@keras_layer("TimeDistributed")
+def _map_time_distributed(cfg, bag):
+    from deeplearning4j_tpu.modelimport.keras.importer import \
+        KERAS_LAYER_MAP
+    inner_cfg = dict(cfg["layer"]["config"])
+    inner_cls = cfg["layer"]["class_name"]
+    inner_cfg["__class__"] = inner_cls
+    if inner_cls not in KERAS_LAYER_MAP:
+        raise InvalidKerasConfigurationException(
+            f"TimeDistributed: no mapper for inner layer {inner_cls}")
+    inner_bag = cfg.get("__layer_bag__")
+    if inner_bag is not None and inner_bag.ordered:
+        bag = inner_bag
+    inner = KERAS_LAYER_MAP[inner_cls](inner_cfg, bag)
+    if len(inner) != 1 or inner[0].layer is None:
+        raise InvalidKerasConfigurationException(
+            "TimeDistributed: inner layer must map to one layer")
+    return [Emit(layer=TimeDistributed(underlying=inner[0].layer),
+                 params=inner[0].params)]
+
+
+@keras_layer("Bidirectional")
+def _map_bidirectional(cfg, bag):
+    from deeplearning4j_tpu.modelimport.keras.importer import \
+        KERAS_LAYER_MAP
+    inner_cls = cfg["layer"]["class_name"]
+    inner_cfg = dict(cfg["layer"]["config"])
+    inner_cfg["__class__"] = inner_cls
+    if not inner_cfg.get("return_sequences", False):
+        # keras return_sequences=False merges fwd's LAST step with
+        # bwd's last PROCESSED step (original t=0); position-based
+        # LastTimeStep extraction cannot express that — reject rather
+        # than import wrong semantics
+        raise InvalidKerasConfigurationException(
+            "Bidirectional with return_sequences=False unsupported "
+            "(keras merges fwd[T-1] with bwd[0])")
+    mode = {"concat": BidirectionalMode.CONCAT,
+            "sum": BidirectionalMode.ADD,
+            "ave": BidirectionalMode.AVERAGE,
+            "mul": BidirectionalMode.MUL}.get(
+                cfg.get("merge_mode", "concat"))
+    if mode is None:
+        raise InvalidKerasConfigurationException(
+            f"Bidirectional merge_mode {cfg.get('merge_mode')}")
+    fwd_bag = cfg.get("__forward_layer_bag__")
+    bwd_bag = cfg.get("__backward_layer_bag__")
+    if fwd_bag is None or bwd_bag is None:
+        raise InvalidKerasConfigurationException(
+            "Bidirectional: forward/backward weights not found "
+            "(use the .keras format)")
+    fwd = KERAS_LAYER_MAP[inner_cls](dict(inner_cfg), fwd_bag)
+    bwd = KERAS_LAYER_MAP[inner_cls](dict(inner_cfg), bwd_bag)
+    layer = Bidirectional(fwd=fwd[0].layer, mode=mode)
+    return [Emit(layer=layer, params={"fwd": fwd[0].params,
+                                      "bwd": bwd[0].params})]

@@ -170,6 +170,13 @@ def _default_preprocessor(cur: InputType, layer: Layer):
     if isinstance(cur, InputTypeConvolutional) and _wants_ff_input(layer):
         return CnnToFeedForwardPreProcessor(cur.height, cur.width,
                                             cur.channels)
+    from deeplearning4j_tpu.nn.conf.inputs import InputTypeConvolutional3D
+    if isinstance(cur, InputTypeConvolutional3D) and \
+            _wants_ff_input(layer):
+        from deeplearning4j_tpu.nn.conf.preprocessors import \
+            Cnn3DToFeedForwardPreProcessor
+        return Cnn3DToFeedForwardPreProcessor(cur.depth, cur.height,
+                                              cur.width, cur.channels)
     if isinstance(cur, InputTypeConvolutionalFlat) and _wants_ff_input(
             layer):
         return None  # already flat

@@ -45,6 +45,9 @@ class Convolution1DLayer(Layer):
     dilation: int = 1
     convolution_mode: ConvolutionMode = ConvolutionMode.SAME
     has_bias: bool = True
+    #: causal (WaveNet-style) padding: left-pad (k-1)*dilation so
+    #: output[t] sees only inputs <= t; overrides convolution_mode
+    causal: bool = False
 
     @staticmethod
     def _builder_positional(*args) -> dict:
@@ -71,8 +74,12 @@ class Convolution1DLayer(Layer):
 
     def forward(self, params, x, *, training, rng=None, state=None):
         x = self._maybe_dropout(x, training, rng)
-        pad = ("SAME" if self.convolution_mode is ConvolutionMode.SAME
-               else [(self.padding, self.padding)])
+        if self.causal:
+            pad = [((self.kernel_size - 1) * self.dilation, 0)]
+        elif self.convolution_mode is ConvolutionMode.SAME:
+            pad = "SAME"
+        else:
+            pad = [(self.padding, self.padding)]
         z = jax.lax.conv_general_dilated(
             x, params["W"], window_strides=(self.stride,), padding=pad,
             rhs_dilation=(self.dilation,),
@@ -91,7 +98,9 @@ class Convolution1DLayer(Layer):
         t = input_type.timesteps
         if t > 0:
             ek = (self.kernel_size - 1) * self.dilation + 1
-            if self.convolution_mode is ConvolutionMode.SAME:
+            if self.causal:
+                t = (t + (ek - 1) - ek) // self.stride + 1
+            elif self.convolution_mode is ConvolutionMode.SAME:
                 t = -(-t // self.stride)
             else:
                 t = (t + 2 * self.padding - ek) // self.stride + 1

@@ -65,6 +65,45 @@ class SeparableConvolution2D(ConvolutionLayer):
 
 @register_layer
 @dataclass
+class DepthwiseConvolution2D(ConvolutionLayer):
+    """Depthwise conv without the pointwise stage (reference:
+    conf.layers.DepthwiseConvolution2D): each input channel convolves
+    with ``depth_multiplier`` filters; n_out = n_in * depth_multiplier."""
+
+    depth_multiplier: int = 1
+
+    def init_params(self, key, input_type, dtype=jnp.float32):
+        kh, kw = self.kernel_size
+        m = self.depth_multiplier
+        wi = self.weight_init or WeightInit.XAVIER
+        p = {"dW": wi.init(key, (kh, kw, self.n_in, m), kh * kw,
+                           kh * kw * m, dtype)}
+        if self.has_bias:
+            p["b"] = jnp.full((self.n_in * m,), self.bias_init, dtype)
+        return p
+
+    def forward(self, params, x, *, training, rng=None, state=None):
+        x = self._maybe_dropout(x, training, rng)
+        c_in = x.shape[-1]
+        kh, kw, _, m = params["dW"].shape
+        dw = params["dW"].reshape(kh, kw, 1, c_in * m)
+        z = jax.lax.conv_general_dilated(
+            x, dw, window_strides=self.stride,
+            padding=self._pad_cfg(), rhs_dilation=self.dilation,
+            dimension_numbers=("NHWC", "HWIO", "NHWC"),
+            feature_group_count=c_in)
+        if self.has_bias:
+            z = z + params["b"]
+        return self.activation(z), state
+
+    def get_output_type(self, input_type):
+        out = super().get_output_type(input_type)
+        return InputType.convolutional(
+            out.height, out.width, self.n_in * self.depth_multiplier)
+
+
+@register_layer
+@dataclass
 class Deconvolution2D(ConvolutionLayer):
     """Transposed convolution (reference: Deconvolution2D)."""
 

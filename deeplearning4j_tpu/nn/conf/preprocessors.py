@@ -64,6 +64,24 @@ class CnnToFeedForwardPreProcessor(InputPreProcessor):
 
 
 @dataclass
+class Cnn3DToFeedForwardPreProcessor(InputPreProcessor):
+    """[b, d, h, w, c] -> [b, d*h*w*c] (reference:
+    Cnn3DToFeedForwardPreProcessor)."""
+
+    depth: int
+    height: int
+    width: int
+    channels: int
+
+    def pre_process(self, x):
+        return x.reshape(x.shape[0], -1)
+
+    def get_output_type(self, input_type):
+        return InputType.feed_forward(self.depth * self.height *
+                                      self.width * self.channels)
+
+
+@dataclass
 class RnnToFeedForwardPreProcessor(InputPreProcessor):
     """[b, t, f] -> [b*t, f] (reference folds time into batch)."""
 
@@ -88,4 +106,5 @@ class FeedForwardToRnnPreProcessor(InputPreProcessor):
 
 _REGISTRY = {c.__name__: c for c in
              (FeedForwardToCnnPreProcessor, CnnToFeedForwardPreProcessor,
+              Cnn3DToFeedForwardPreProcessor,
               RnnToFeedForwardPreProcessor, FeedForwardToRnnPreProcessor)}

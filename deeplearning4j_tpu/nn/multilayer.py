@@ -569,7 +569,11 @@ class MultiLayerNetwork:
             i, name = k.split("_", 1)
             lk = f"layer_{i}"
             if name in self.params.get(lk, {}):
-                self.params[lk][name] = jnp.asarray(v)
+                if isinstance(v, dict):   # wrapper sub-trees (fwd/bwd)
+                    for sub, a in v.items():
+                        self.params[lk][name][sub] = jnp.asarray(a)
+                else:
+                    self.params[lk][name] = jnp.asarray(v)
             elif name in (self.states.get(lk) or {}):
                 self.states[lk][name] = jnp.asarray(v)
 

@@ -1,0 +1,202 @@
+"""Keras import conformance for the extended mapper set (SURVEY.md
+D14/§4.6): conv 1D/3D/transpose/separable/depthwise, pooling 1D/3D,
+crop/pad/upsample/repeat, PReLU, TimeDistributed, Bidirectional.
+Protocol: build+save with the in-image Keras, import, compare outputs.
+"""
+import numpy as np
+import pytest
+
+tf = pytest.importorskip("tensorflow")
+keras = tf.keras
+
+from deeplearning4j_tpu.modelimport.keras import (  # noqa: E402
+    InvalidKerasConfigurationException, KerasModelImport)
+
+
+def _compare(model, x, tmp_path, atol=1e-4):
+    path = str(tmp_path / "model.keras")
+    model.save(path)
+    net = KerasModelImport.import_keras_sequential_model_and_weights(
+        path)
+    want = np.asarray(model(x, training=False))
+    got = np.asarray(net.output(x))
+    np.testing.assert_allclose(got, want, atol=atol, rtol=1e-3)
+    return net
+
+
+R = np.random.RandomState(0)
+
+
+class TestConvFamily:
+    def test_conv1d_pool1d(self, tmp_path):
+        model = keras.Sequential([
+            keras.layers.Input((12, 3)),
+            keras.layers.Conv1D(8, 3, padding="same",
+                                activation="relu"),
+            keras.layers.MaxPooling1D(2),
+            keras.layers.Conv1D(4, 3, padding="valid", strides=2),
+            keras.layers.GlobalAveragePooling1D(),
+            keras.layers.Dense(5, activation="softmax"),
+        ])
+        _compare(model, R.randn(4, 12, 3).astype(np.float32), tmp_path)
+
+    def test_conv1d_causal(self, tmp_path):
+        """WaveNet-style causal padding (regression: was silently
+        imported as valid)."""
+        model = keras.Sequential([
+            keras.layers.Input((12, 2)),
+            keras.layers.Conv1D(4, 3, padding="causal",
+                                dilation_rate=2),
+            keras.layers.Conv1D(2, 3, padding="causal"),
+        ])
+        _compare(model, R.randn(2, 12, 2).astype(np.float32), tmp_path)
+
+    def test_conv3d_model_roundtrips(self, tmp_path):
+        """Conv3D nets serialize with the auto-inserted 3D preprocessor
+        (regression: Cnn3DToFeedForwardPreProcessor missing from the
+        serde registry)."""
+        from deeplearning4j_tpu.utils.serializer import ModelSerializer
+        model = keras.Sequential([
+            keras.layers.Input((4, 4, 4, 1)),
+            keras.layers.Conv3D(2, 2),
+            keras.layers.Flatten(),
+            keras.layers.Dense(3),
+        ])
+        path = str(tmp_path / "m.keras")
+        model.save(path)
+        net = KerasModelImport \
+            .import_keras_sequential_model_and_weights(path)
+        x = R.randn(2, 4, 4, 4, 1).astype(np.float32)
+        want = np.asarray(net.output(x))
+        zpath = str(tmp_path / "net.zip")
+        ModelSerializer.write_model(net, zpath, save_updater=False)
+        net2 = ModelSerializer.restore_multi_layer_network(zpath)
+        np.testing.assert_allclose(np.asarray(net2.output(x)), want,
+                                   rtol=1e-5)
+
+    def test_conv3d_pool3d(self, tmp_path):
+        model = keras.Sequential([
+            keras.layers.Input((6, 6, 6, 2)),
+            keras.layers.Conv3D(4, 3, padding="same",
+                                activation="relu"),
+            keras.layers.MaxPooling3D(2),
+            keras.layers.Conv3D(2, 2, padding="valid"),
+            keras.layers.Flatten(),
+            keras.layers.Dense(3),
+        ])
+        _compare(model, R.randn(2, 6, 6, 6, 2).astype(np.float32),
+                 tmp_path)
+
+    def test_conv2d_transpose(self, tmp_path):
+        for pad, stride in (("same", 2), ("valid", 2), ("same", 1)):
+            model = keras.Sequential([
+                keras.layers.Input((5, 5, 3)),
+                keras.layers.Conv2DTranspose(4, 3, strides=stride,
+                                             padding=pad),
+            ])
+            _compare(model, R.randn(2, 5, 5, 3).astype(np.float32),
+                     tmp_path)
+
+    def test_separable_and_depthwise(self, tmp_path):
+        model = keras.Sequential([
+            keras.layers.Input((8, 8, 3)),
+            keras.layers.SeparableConv2D(6, 3, padding="same",
+                                         activation="relu"),
+            keras.layers.DepthwiseConv2D(3, padding="valid",
+                                         depth_multiplier=2),
+            keras.layers.GlobalAveragePooling2D(),
+        ])
+        _compare(model, R.randn(2, 8, 8, 3).astype(np.float32),
+                 tmp_path)
+
+
+class TestShapeFamily:
+    def test_crop_pad_upsample_2d(self, tmp_path):
+        model = keras.Sequential([
+            keras.layers.Input((8, 8, 2)),
+            keras.layers.ZeroPadding2D(((1, 2), (0, 1))),
+            keras.layers.Cropping2D(((0, 1), (2, 0))),
+            keras.layers.UpSampling2D(2),
+            keras.layers.Conv2D(2, 1),
+        ])
+        _compare(model, R.randn(2, 8, 8, 2).astype(np.float32),
+                 tmp_path)
+
+    def test_crop_pad_upsample_1d(self, tmp_path):
+        model = keras.Sequential([
+            keras.layers.Input((10, 3)),
+            keras.layers.ZeroPadding1D((1, 2)),
+            keras.layers.Cropping1D((2, 1)),
+            keras.layers.UpSampling1D(2),
+            keras.layers.Conv1D(2, 1),
+        ])
+        _compare(model, R.randn(2, 10, 3).astype(np.float32), tmp_path)
+
+    def test_pad_upsample_3d(self, tmp_path):
+        model = keras.Sequential([
+            keras.layers.Input((4, 4, 4, 1)),
+            keras.layers.ZeroPadding3D(((1, 0), (0, 1), (1, 1))),
+            keras.layers.UpSampling3D(2),
+            keras.layers.Cropping3D(((1, 1), (0, 2), (2, 0))),
+        ])
+        _compare(model, R.randn(1, 4, 4, 4, 1).astype(np.float32),
+                 tmp_path)
+
+    def test_repeat_vector(self, tmp_path):
+        model = keras.Sequential([
+            keras.layers.Input((6,)),
+            keras.layers.Dense(4, activation="tanh"),
+            keras.layers.RepeatVector(3),
+            keras.layers.LSTM(5, return_sequences=True),
+        ])
+        _compare(model, R.randn(2, 6).astype(np.float32), tmp_path)
+
+
+class TestMiscAndWrappers:
+    def test_prelu(self, tmp_path):
+        model = keras.Sequential([
+            keras.layers.Input((7,)),
+            keras.layers.Dense(5),
+            keras.layers.PReLU(),
+        ])
+        # non-trivial alphas
+        model.layers[-1].set_weights(
+            [R.rand(5).astype(np.float32) * 0.5])
+        _compare(model, R.randn(3, 7).astype(np.float32), tmp_path)
+
+    def test_time_distributed_dense(self, tmp_path):
+        model = keras.Sequential([
+            keras.layers.Input((4, 6)),
+            keras.layers.TimeDistributed(
+                keras.layers.Dense(3, activation="relu")),
+        ])
+        _compare(model, R.randn(2, 4, 6).astype(np.float32), tmp_path)
+
+    def test_bidirectional_lstm(self, tmp_path):
+        model = keras.Sequential([
+            keras.layers.Input((5, 4)),
+            keras.layers.Bidirectional(
+                keras.layers.LSTM(6, return_sequences=True)),
+        ])
+        _compare(model, R.randn(2, 5, 4).astype(np.float32), tmp_path)
+
+    def test_bidirectional_sum_mode(self, tmp_path):
+        model = keras.Sequential([
+            keras.layers.Input((5, 4)),
+            keras.layers.Bidirectional(
+                keras.layers.SimpleRNN(6, return_sequences=True),
+                merge_mode="sum"),
+        ])
+        _compare(model, R.randn(2, 5, 4).astype(np.float32), tmp_path)
+
+    def test_bidirectional_no_sequences_rejected(self, tmp_path):
+        model = keras.Sequential([
+            keras.layers.Input((5, 4)),
+            keras.layers.Bidirectional(keras.layers.LSTM(6)),
+        ])
+        path = str(tmp_path / "model.keras")
+        model.save(path)
+        with pytest.raises(InvalidKerasConfigurationException,
+                           match="return_sequences"):
+            KerasModelImport.import_keras_sequential_model_and_weights(
+                path)

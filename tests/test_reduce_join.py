@@ -1,0 +1,122 @@
+"""DataVec Reducer + Join tests (reference test style: TestReduce /
+TestJoin in datavec-api, SURVEY.md V2)."""
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.datavec.reduce_join import (Join, JoinType,
+                                                    Reducer, ReduceOp)
+from deeplearning4j_tpu.datavec.schema import ColumnType, Schema
+
+
+def _schema():
+    return (Schema.Builder()
+            .add_column_string("user")
+            .add_column_double("amount")
+            .add_column_integer("qty")
+            .build())
+
+
+RECORDS = [
+    ["alice", 10.0, 1],
+    ["bob", 2.0, 5],
+    ["alice", 30.0, 3],
+    ["bob", 4.0, 1],
+    ["alice", 20.0, 2],
+]
+
+
+class TestReducer:
+    def test_sum_and_mean(self):
+        red = (Reducer.Builder(ReduceOp.SUM)
+               .key_columns("user")
+               .mean_columns("amount")
+               .build())
+        out = red.execute(_schema(), RECORDS)
+        by_user = {r[0]: r for r in out}
+        assert by_user["alice"][1] == pytest.approx(20.0)  # mean amount
+        assert by_user["alice"][2] == 6                    # sum qty
+        assert by_user["bob"][1] == pytest.approx(3.0)
+        assert by_user["bob"][2] == 6
+
+    def test_schema_transform(self):
+        red = (Reducer.Builder(ReduceOp.SUM)
+               .key_columns("user")
+               .mean_columns("amount")
+               .count_columns("qty")
+               .build())
+        out_schema = red.transform_schema(_schema())
+        assert out_schema.column_names() == \
+            ["user", "mean(amount)", "count(qty)"]
+        assert out_schema.type_of("mean(amount)") is ColumnType.DOUBLE
+        assert out_schema.type_of("count(qty)") is ColumnType.LONG
+
+    def test_stdev_minmax_range_unique(self):
+        red = (Reducer.Builder(ReduceOp.MIN)
+               .key_columns("user")
+               .stdev_columns("amount")
+               .count_unique_columns("qty")
+               .build())
+        out = red.execute(_schema(), RECORDS)
+        by_user = {r[0]: r for r in out}
+        assert by_user["alice"][1] == pytest.approx(10.0)  # stdev
+        assert by_user["alice"][2] == 3                    # unique qtys
+        assert by_user["bob"][2] == 2
+
+
+    def test_numeric_op_on_string_column_rejected(self):
+        red = (Reducer.Builder(ReduceOp.SUM)
+               .key_columns("amount")   # leaves 'user' (string) to SUM
+               .build())
+        with pytest.raises(ValueError, match="user"):
+            red.execute(_schema(), RECORDS)
+
+    def test_string_column_with_first_op_ok(self):
+        red = (Reducer.Builder(ReduceOp.SUM)
+               .key_columns("qty")
+               .first_columns("user")
+               .build())
+        out = red.execute(_schema(), RECORDS)
+        assert all(isinstance(r[0], (int, float)) or r[0] is not None
+                   for r in out)
+
+
+class TestJoin:
+    def _schemas(self):
+        left = (Schema.Builder().add_column_string("k")
+                .add_column_double("lv").build())
+        right = (Schema.Builder().add_column_string("k")
+                 .add_column_integer("rv").build())
+        return left, right
+
+    def _join(self, jt):
+        left, right = self._schemas()
+        return (Join.Builder(jt).set_join_columns("k")
+                .set_schemas(left, right).build())
+
+    LEFT = [["a", 1.0], ["b", 2.0], ["c", 3.0]]
+    RIGHT = [["a", 10], ["a", 11], ["d", 40]]
+
+    def test_inner(self):
+        out = self._join(JoinType.INNER).execute(self.LEFT, self.RIGHT)
+        assert sorted(out) == [["a", 1.0, 10], ["a", 1.0, 11]]
+
+    def test_left_outer(self):
+        out = self._join(JoinType.LEFT_OUTER).execute(self.LEFT,
+                                                      self.RIGHT)
+        assert ["b", 2.0, None] in out and ["c", 3.0, None] in out
+        assert len(out) == 4
+
+    def test_right_outer(self):
+        out = self._join(JoinType.RIGHT_OUTER).execute(self.LEFT,
+                                                       self.RIGHT)
+        assert ["d", None, 40] in out
+        assert len(out) == 3
+
+    def test_full_outer(self):
+        out = self._join(JoinType.FULL_OUTER).execute(self.LEFT,
+                                                      self.RIGHT)
+        assert len(out) == 5   # 2 matches + b + c + d
+
+    def test_output_schema(self):
+        j = self._join(JoinType.INNER)
+        assert j.output_schema().column_names() == ["k", "lv", "rv"]
