@@ -19,6 +19,7 @@ from typing import Callable, Dict, Tuple
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax import lax
 
 OP_REGISTRY: Dict[str, Callable] = {}
@@ -612,6 +613,72 @@ def _segment_mean(ins, attrs):
     return s / jnp.maximum(c, 1)
 
 
+@op("space_to_depth", "shape")
+def _space_to_depth(ins, attrs):
+    s = int(attrs.get("block_size", 2))
+    b, h, w, c = ins[0].shape
+    z = ins[0].reshape(b, h // s, s, w // s, s, c)
+    return z.transpose(0, 1, 3, 2, 4, 5).reshape(b, h // s, w // s,
+                                                 s * s * c)
+
+
+@op("depth_to_space", "shape")
+def _depth_to_space(ins, attrs):
+    s = int(attrs.get("block_size", 2))
+    b, h, w, c = ins[0].shape
+    co = c // (s * s)
+    z = ins[0].reshape(b, h, w, s, s, co)
+    return z.transpose(0, 1, 3, 2, 4, 5).reshape(b, h * s, w * s, co)
+
+
+@op("reverse", "shape")
+def _reverse(ins, attrs):
+    axes = attrs.get("axes")
+    if axes is None and len(ins) > 1:
+        axes = [int(a) for a in np.asarray(ins[1]).reshape(-1)]
+    return jnp.flip(ins[0], axis=tuple(axes))
+
+
+@op("roll", "shape")
+def _roll(ins, attrs):
+    shift = attrs.get("shift")
+    axes = attrs.get("axes")
+    if shift is None and len(ins) > 2:
+        shift = [int(s) for s in np.asarray(ins[1]).reshape(-1)]
+        axes = [int(a) for a in np.asarray(ins[2]).reshape(-1)]
+    return jnp.roll(ins[0], tuple(np.atleast_1d(shift)),
+                    tuple(np.atleast_1d(axes)))
+
+
+@op("scatter_nd", "shape")
+def _scatter_nd(ins, attrs):
+    idx, updates = ins[0].astype(jnp.int32), ins[1]
+    shape = attrs.get("shape")
+    if shape is None and len(ins) > 2:
+        shape = [int(s) for s in np.asarray(ins[2]).reshape(-1)]
+    out = jnp.zeros(tuple(shape), updates.dtype)
+    return out.at[tuple(jnp.moveaxis(idx, -1, 0))].add(updates)
+
+
+@op("invert_permutation", "shape")
+def _invert_permutation(ins, attrs):
+    p = ins[0].astype(jnp.int32)
+    return jnp.zeros_like(p).at[p].set(jnp.arange(p.shape[0],
+                                                  dtype=p.dtype))
+
+
+@op("matrix_diag", "linalg")
+def _matrix_diag(ins, attrs):
+    v = ins[0]
+    eye = jnp.eye(v.shape[-1], dtype=v.dtype)
+    return v[..., None] * eye
+
+
+@op("matrix_diag_part", "linalg")
+def _matrix_diag_part(ins, attrs):
+    return jnp.diagonal(ins[0], axis1=-2, axis2=-1)
+
+
 @op("segment_prod", "segment")
 def _segment_prod(ins, attrs):
     return jax.ops.segment_prod(ins[0], ins[1].astype(jnp.int32),
@@ -736,6 +803,7 @@ def _conv3d(ins, attrs):
     out = lax.conv_general_dilated(
         x, w, window_strides=tuple(attrs.get("stride", (1, 1, 1))),
         padding=attrs.get("padding", "SAME"),
+        rhs_dilation=tuple(attrs.get("dilation", (1, 1, 1))),
         dimension_numbers=_conv_dn(5))
     if len(ins) > 2:
         out = out + ins[2]

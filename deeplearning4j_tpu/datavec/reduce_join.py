@@ -46,13 +46,16 @@ def _reduce_values(op: ReduceOp, values: list):
         return values[0]
     if op is ReduceOp.LAST:
         return values[-1]
-    nums = [float(v) for v in values]
+    # MIN/MAX/SUM keep exact int arithmetic on integer columns (the
+    # declared output type is the input type; float() would lose
+    # precision above 2^53)
     if op is ReduceOp.MIN:
-        return min(nums)
+        return min(values)
     if op is ReduceOp.MAX:
-        return max(nums)
+        return max(values)
     if op is ReduceOp.SUM:
-        return sum(nums)
+        return sum(values)
+    nums = [float(v) for v in values]
     if op is ReduceOp.MEAN:
         return sum(nums) / len(nums)
     if op is ReduceOp.RANGE:

@@ -339,6 +339,13 @@ def _f_identity(imp, node):
     return imp._statics(node)[0]
 
 
+def _f_slice(imp, node):
+    x, begin, size = imp._statics(node)
+    idx = tuple(slice(int(b), None if int(s) == -1 else int(b) + int(s))
+                for b, s in zip(begin.reshape(-1), size.reshape(-1)))
+    return np.asarray(x)[idx]
+
+
 def _f_strided_slice(imp, node):
     from deeplearning4j_tpu.autodiff.registry import spec_to_index
     x, begin, end, strides = imp._statics(node)
@@ -450,6 +457,7 @@ def _f_rank(imp, node):
 _FOLDERS = {
     "Shape": _f_shape, "ShapeN": None, "Size": _f_size, "Rank": _f_rank,
     "Identity": _f_identity, "StridedSlice": _f_strided_slice,
+    "Slice": _f_slice,
     "Pack": _f_pack, "ConcatV2": _f_concat, "Reshape": _f_reshape,
     "Cast": _f_cast, "Range": _f_range, "Fill": _f_fill,
     "GatherV2": _f_gather_v2, "ExpandDims": _f_expand_dims,

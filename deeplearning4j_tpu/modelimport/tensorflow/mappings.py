@@ -554,3 +554,184 @@ def strided_slice_spec(begin, end, strides, begin_mask, end_mask,
                 item["end"] = int(end[i])
             spec.append(item)
     return spec
+
+
+# -- breadth batch 2: 3D conv/pool, block rearrange, segment/scatter, --------
+# -- linalg, xent losses (SURVEY.md S6 coverage accounting) ------------------
+@tf_op("SpaceToDepth")
+def _space_to_depth(ctx, node):
+    if node.attr("data_format", b"NHWC") != b"NHWC":
+        raise NotImplementedError("SpaceToDepth: NHWC only")
+    return ctx.sd._op("space_to_depth", [ctx.var(node.inputs[0])],
+                      {"block_size": int(node.attr("block_size", 2))})
+
+
+@tf_op("DepthToSpace")
+def _depth_to_space(ctx, node):
+    if node.attr("data_format", b"NHWC") != b"NHWC":
+        raise NotImplementedError("DepthToSpace: NHWC only")
+    return ctx.sd._op("depth_to_space", [ctx.var(node.inputs[0])],
+                      {"block_size": int(node.attr("block_size", 2))})
+
+
+@tf_op("Conv3D")
+def _conv3d(ctx, node):
+    if node.attr("data_format", b"NDHWC") != b"NDHWC":
+        raise NotImplementedError("Conv3D: NDHWC only")
+    strides = [int(s) for s in node.attr("strides", [1] * 5)]
+    dil = [int(d) for d in node.attr("dilations", [1] * 5)]
+    return ctx.sd._op(
+        "conv3d", [ctx.var(node.inputs[0]), ctx.var(node.inputs[1])],
+        {"stride": tuple(strides[1:4]), "dilation": tuple(dil[1:4]),
+         "padding": node.attr("padding", b"SAME").decode()})
+
+
+@tf_op("MaxPool3D", "AvgPool3D")
+def _pool3d(ctx, node):
+    if node.attr("data_format", b"NDHWC") != b"NDHWC":
+        raise NotImplementedError("Pool3D: NDHWC only")
+    ks = [int(k) for k in node.attr("ksize", [1, 2, 2, 2, 1])]
+    st = [int(s) for s in node.attr("strides", [1, 2, 2, 2, 1])]
+    opn = "max_pool3d" if node.op == "MaxPool3D" else "avg_pool3d"
+    return ctx.sd._op(opn, [ctx.var(node.inputs[0])],
+                      {"kernel": tuple(ks[1:4]),
+                       "stride": tuple(st[1:4]),
+                       "padding": node.attr("padding",
+                                            b"VALID").decode()})
+
+
+@tf_op("ReverseV2")
+def _reverse_v2(ctx, node):
+    axes = np.asarray(ctx.require_static(node, 1))
+    return ctx.sd._op("reverse", [ctx.var(node.inputs[0])],
+                      {"axes": [int(a) for a in axes.reshape(-1)]})
+
+
+@tf_op("Cumprod")
+def _cumprod(ctx, node):
+    if node.attr("exclusive", False) or node.attr("reverse", False):
+        raise NotImplementedError("Cumprod: exclusive/reverse modes")
+    axis = int(np.asarray(ctx.require_static(node, 1)))
+    return ctx.sd._op("cumprod", [ctx.var(node.inputs[0])],
+                      {"axis": axis})
+
+
+@tf_op("Roll")
+def _roll_tf(ctx, node):
+    shift = np.asarray(ctx.require_static(node, 1))
+    axes = np.asarray(ctx.require_static(node, 2))
+    return ctx.sd._op("roll", [ctx.var(node.inputs[0])],
+                      {"shift": [int(s) for s in shift.reshape(-1)],
+                       "axes": [int(a) for a in axes.reshape(-1)]})
+
+
+@tf_op("ScatterNd")
+def _scatter_nd_tf(ctx, node):
+    shape = np.asarray(ctx.require_static(node, 2))
+    return ctx.sd._op("scatter_nd",
+                      [ctx.var(node.inputs[0]),
+                       ctx.var(node.inputs[1])],
+                      {"shape": [int(s) for s in shape.reshape(-1)]})
+
+
+@tf_op("InvertPermutation")
+def _invert_perm(ctx, node):
+    return ctx.sd._op("invert_permutation", [ctx.var(node.inputs[0])])
+
+
+@tf_op("SegmentSum", "SegmentMax", "SegmentMin", "SegmentMean",
+       "SegmentProd")
+def _segment(ctx, node):
+    opn = {"SegmentSum": "segment_sum", "SegmentMax": "segment_max",
+           "SegmentMin": "segment_min", "SegmentMean": "segment_mean",
+           "SegmentProd": "segment_prod"}[node.op]
+    # num_segments must be static under jit; fold it from the ids
+    ids = np.asarray(ctx.require_static(node, 1))
+    return ctx.sd._op(opn, [ctx.var(node.inputs[0]),
+                            ctx.var(node.inputs[1])],
+                      {"num_segments": int(ids.max()) + 1})
+
+
+@tf_op("UnsortedSegmentSum", "UnsortedSegmentMax", "UnsortedSegmentMin",
+       "UnsortedSegmentProd")
+def _unsorted_segment(ctx, node):
+    opn = {"UnsortedSegmentSum": "unsorted_segment_sum",
+           "UnsortedSegmentMax": "unsorted_segment_max",
+           "UnsortedSegmentMin": "unsorted_segment_min",
+           "UnsortedSegmentProd": "unsorted_segment_prod"}[node.op]
+    n = int(np.asarray(ctx.require_static(node, 2)))
+    return ctx.sd._op(opn, [ctx.var(node.inputs[0]),
+                            ctx.var(node.inputs[1])],
+                      {"num_segments": n})
+
+
+@tf_op("LRN")
+def _lrn(ctx, node):
+    # TF windows [i-r, i+r] (2r+1 wide); our lrn takes the full width
+    r = int(node.attr("depth_radius", 5))
+    return ctx.sd._op("lrn", [ctx.var(node.inputs[0])],
+                      {"depth": 2 * r + 1,
+                       "bias": float(node.attr("bias", 1.0)),
+                       "alpha": float(node.attr("alpha", 1.0)),
+                       "beta": float(node.attr("beta", 0.5))})
+
+
+def _check_diag_k(ctx, node):
+    """V2/V3 carry a k (diagonal offset) input; only k=0 is supported."""
+    if len(node.inputs) > 1:
+        k = np.asarray(ctx.require_static(node, 1))
+        if np.any(k != 0):
+            raise NotImplementedError(
+                f"{node.op}: only the main diagonal (k=0) is supported")
+
+
+@tf_op("MatrixDiag", "MatrixDiagV2", "MatrixDiagV3")
+def _matrix_diag_tf(ctx, node):
+    _check_diag_k(ctx, node)
+    return ctx.sd._op("matrix_diag", [ctx.var(node.inputs[0])])
+
+
+@tf_op("MatrixDiagPart", "MatrixDiagPartV2", "MatrixDiagPartV3")
+def _matrix_diag_part_tf(ctx, node):
+    _check_diag_k(ctx, node)
+    return ctx.sd._op("matrix_diag_part", [ctx.var(node.inputs[0])])
+
+
+@tf_op("Cholesky")
+def _cholesky_tf(ctx, node):
+    return ctx.sd._op("cholesky", [ctx.var(node.inputs[0])])
+
+
+@tf_op("MatrixInverse")
+def _matrix_inverse_tf(ctx, node):
+    return ctx.sd._op("matrix_inverse", [ctx.var(node.inputs[0])])
+
+
+@tf_op("SoftmaxCrossEntropyWithLogits")
+def _softmax_xent(ctx, node):
+    logits = ctx.var(node.inputs[0])
+    labels = ctx.var(node.inputs[1])
+    loss = ctx.sd._op("softmax_cross_entropy", [labels, logits],
+                      {"reduction": "none"})
+    # TF also returns backprop dL/dlogits = softmax - labels
+    sm = ctx.sd._op("softmax", [logits])
+    grad = ctx.sd._op("sub", [sm, labels])
+    return [loss, grad]
+
+
+@tf_op("SparseSoftmaxCrossEntropyWithLogits")
+def _sparse_softmax_xent(ctx, node):
+    logits = ctx.var(node.inputs[0])
+    labels = ctx.var(node.inputs[1])
+    loss = ctx.sd._op("sparse_softmax_cross_entropy",
+                      [labels, logits], {"reduction": "none"})
+    if not (logits.shape and logits.shape[-1] and
+            int(logits.shape[-1]) > 0):
+        raise NotImplementedError(
+            "SparseSoftmaxCrossEntropyWithLogits: class count must be "
+            "statically known for the backprop output")
+    onehot = ctx.sd._op("one_hot", [labels],
+                        {"depth": int(logits.shape[-1])})
+    sm = ctx.sd._op("softmax", [logits])
+    grad = ctx.sd._op("sub", [sm, onehot])
+    return [loss, grad]

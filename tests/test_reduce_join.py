@@ -76,8 +76,21 @@ class TestReducer:
                .first_columns("user")
                .build())
         out = red.execute(_schema(), RECORDS)
-        assert all(isinstance(r[0], (int, float)) or r[0] is not None
-                   for r in out)
+        # schema order: [user, amount, qty]; key=qty keeps its position
+        by_qty = {r[2]: r for r in out}
+        assert by_qty[1][0] == "alice"   # first record with qty=1
+        assert by_qty[5][0] == "bob"
+        assert by_qty[1][1] == pytest.approx(14.0)  # 10.0 + 4.0
+
+    def test_int_sum_stays_int(self):
+        red = (Reducer.Builder(ReduceOp.SUM)
+               .key_columns("user")
+               .first_columns("amount")
+               .build())
+        out = red.execute(_schema(), RECORDS)
+        qty_sum = {r[0]: r[2] for r in out}
+        assert qty_sum["alice"] == 6 and isinstance(qty_sum["alice"],
+                                                    int)
 
 
 class TestJoin:

@@ -1,0 +1,135 @@
+"""TF GraphDef import conformance, batch 2 (SURVEY.md S6/§4.4):
+3D conv/pool, block rearrangement, segment/scatter, linalg, LRN,
+cross-entropy ops. Same protocol as test_tf_import: freeze a
+tf.function with the in-image TF, import, compare outputs."""
+import numpy as np
+import pytest
+
+tf = pytest.importorskip("tensorflow")
+
+from test_tf_import import _import_and_compare  # noqa: E402
+
+R = np.random.RandomState(0)
+
+
+class TestBreadthBatch2:
+    def test_space_depth_roundtrip(self):
+        x = R.randn(2, 4, 4, 3).astype(np.float32)
+
+        def fn(x):
+            return tf.nn.depth_to_space(tf.nn.space_to_depth(x, 2), 2)
+
+        _import_and_compare(fn, {"x": x})
+
+    def test_conv3d_pool3d(self):
+        x = R.randn(1, 6, 6, 6, 2).astype(np.float32)
+        w = (R.randn(3, 3, 3, 2, 4) * 0.3).astype(np.float32)
+
+        def fn(x):
+            y = tf.nn.conv3d(x, w, [1, 1, 1, 1, 1], "SAME")
+            return tf.nn.max_pool3d(y, 2, 2, "VALID")
+
+        _import_and_compare(fn, {"x": x})
+
+    def test_conv3d_dilated(self):
+        """Dilated Conv3D (regression: dilation was silently dropped)."""
+        x = R.randn(1, 8, 8, 8, 1).astype(np.float32)
+        w = (R.randn(2, 2, 2, 1, 2) * 0.3).astype(np.float32)
+
+        def fn(x):
+            return tf.nn.conv3d(x, w, [1, 1, 1, 1, 1], "VALID",
+                                dilations=[1, 2, 2, 2, 1])
+
+        _import_and_compare(fn, {"x": x})
+
+    def test_matrix_diag_nonzero_k_rejected(self):
+        x = R.randn(3, 4, 4).astype(np.float32)
+
+        def fn(x):
+            return tf.linalg.diag_part(x, k=1)
+
+        with pytest.raises(NotImplementedError, match="k=0"):
+            _import_and_compare(fn, {"x": x})
+
+    def test_reverse_roll(self):
+        x = R.randn(3, 5).astype(np.float32)
+
+        def fn(x):
+            return tf.roll(tf.reverse(x, axis=[1]), shift=[2], axis=[0])
+
+        _import_and_compare(fn, {"x": x})
+
+    def test_cumprod_matrixdiag(self):
+        x = (R.rand(3, 4).astype(np.float32) + 0.5)
+
+        def fn(x):
+            return tf.linalg.diag(tf.math.cumprod(x, axis=1))
+
+        _import_and_compare(fn, {"x": x})
+
+    def test_scatter_nd_invert_permutation(self):
+        idx = np.asarray([[1], [3]], np.int32)
+        upd = np.asarray([9.0, 7.0], np.float32)
+
+        def fn(u):
+            s = tf.scatter_nd(idx, u, [5])
+            p = tf.constant([2, 0, 1, 4, 3], tf.int32)
+            return tf.gather(s, tf.math.invert_permutation(p))
+
+        _import_and_compare(fn, {"u": upd})
+
+    def test_segment_ops(self):
+        x = R.randn(6, 3).astype(np.float32)
+        seg = np.asarray([0, 0, 1, 1, 1, 2], np.int32)
+
+        def fn(x):
+            return tf.math.segment_sum(x, seg)
+
+        _import_and_compare(fn, {"x": x})
+
+    def test_unsorted_segment(self):
+        x = R.randn(6, 3).astype(np.float32)
+        seg = np.asarray([2, 0, 1, 0, 1, 2], np.int32)
+
+        def fn(x):
+            return tf.math.unsorted_segment_sum(x, seg, 3)
+
+        _import_and_compare(fn, {"x": x})
+
+    def test_lrn(self):
+        x = R.randn(2, 4, 4, 8).astype(np.float32)
+
+        def fn(x):
+            return tf.nn.local_response_normalization(
+                x, depth_radius=2, bias=1.0, alpha=1e-3, beta=0.75)
+
+        _import_and_compare(fn, {"x": x})
+
+    def test_cholesky_inverse(self):
+        a = R.randn(4, 4).astype(np.float32)
+        spd = (a @ a.T + 4 * np.eye(4)).astype(np.float32)
+
+        def fn(m):
+            return tf.linalg.cholesky(m) + tf.linalg.inv(m)
+
+        _import_and_compare(fn, {"m": spd}, atol=1e-3)
+
+    def test_sparse_softmax_xent(self):
+        logits = R.randn(5, 7).astype(np.float32)
+        labels = np.asarray([0, 3, 6, 2, 1], np.int64)
+
+        def fn(lg):
+            return tf.nn.sparse_softmax_cross_entropy_with_logits(
+                labels=labels, logits=lg)
+
+        _import_and_compare(fn, {"lg": logits})
+
+    def test_softmax_xent(self):
+        logits = R.randn(5, 7).astype(np.float32)
+        labels = np.eye(7, dtype=np.float32)[[0, 3, 6, 2, 1]]
+
+        def fn(lg):
+            return tf.nn.softmax_cross_entropy_with_logits(
+                labels=labels, logits=lg)
+
+        _import_and_compare(fn, {"lg": logits})
