@@ -86,6 +86,7 @@ _binary("rsub", "arithmetic", lambda a, b: b - a)
 _binary("pow", "arithmetic", jnp.power)
 _binary("floordiv", "arithmetic", jnp.floor_divide)
 _binary("mod", "arithmetic", jnp.mod)
+_binary("fmod", "arithmetic", jnp.fmod)   # C-style sign-of-dividend
 _binary("maximum", "arithmetic", jnp.maximum)
 _binary("minimum", "arithmetic", jnp.minimum)
 _binary("squared_difference", "arithmetic", lambda a, b: (a - b) ** 2)
@@ -446,8 +447,9 @@ def _split(ins, attrs):
 
 @op("split_v", "shape")
 def _split_v(ins, attrs):
-    sizes = attrs["size_splits"]
-    idx = list(jnp.cumsum(jnp.asarray(sizes))[:-1])
+    # sizes are static graph attrs: split points must be concrete
+    # under jit, so the cumsum runs in Python, not on device
+    idx = list(np.cumsum([int(s) for s in attrs["size_splits"]])[:-1])
     return tuple(jnp.split(ins[0], idx, axis=attrs.get("axis", 0)))
 
 
@@ -658,6 +660,14 @@ def _scatter_nd(ins, attrs):
         shape = [int(s) for s in np.asarray(ins[2]).reshape(-1)]
     out = jnp.zeros(tuple(shape), updates.dtype)
     return out.at[tuple(jnp.moveaxis(idx, -1, 0))].add(updates)
+
+
+@op("scatter_nd_update", "shape")
+def _scatter_nd_update(ins, attrs):
+    """data, indices [..., d], updates -> data with updates written
+    (reference: scatter_upd declarable op / ONNX ScatterND)."""
+    data, idx, updates = ins[0], ins[1].astype(jnp.int32), ins[2]
+    return data.at[tuple(jnp.moveaxis(idx, -1, 0))].set(updates)
 
 
 @op("invert_permutation", "shape")

@@ -37,12 +37,19 @@ def _flatten_time(labels, preds, mask):
 
 
 class Evaluation:
-    """Multi-class classification metrics."""
+    """Multi-class classification metrics. ``top_n > 1`` additionally
+    tracks top-N accuracy (reference: Evaluation(int numClasses, int
+    topN) — a prediction counts as top-N correct when the true class is
+    among the N highest-probability outputs)."""
 
-    def __init__(self, num_classes: Optional[int] = None, labels=None):
+    def __init__(self, num_classes: Optional[int] = None, labels=None,
+                 top_n: int = 1):
         self.num_classes = num_classes
         self.label_names = labels
         self.confusion: Optional[np.ndarray] = None
+        self.top_n = max(1, int(top_n))
+        self._top_n_correct = 0
+        self._top_n_total = 0
 
     # ------------------------------------------------------------------
     def eval(self, labels, predictions, mask=None):  # noqa: A003
@@ -67,8 +74,23 @@ class Evaluation:
         if mask is not None:
             keep = mask.reshape(-1) > 0
             true_idx, pred_idx = true_idx[keep], pred_idx[keep]
+            preds = preds[keep] if preds.ndim == 2 else preds
         np.add.at(self.confusion, (true_idx, pred_idx), 1)
+        if self.top_n > 1 and preds.ndim == 2:
+            k = min(self.top_n, preds.shape[-1])
+            topk = np.argpartition(-preds, k - 1, axis=-1)[:, :k]
+            self._top_n_correct += int(
+                (topk == true_idx[:, None]).any(-1).sum())
+            self._top_n_total += int(true_idx.size)
         return self
+
+    def top_n_accuracy(self) -> float:
+        """Reference: Evaluation.topNAccuracy()."""
+        if self.top_n == 1:
+            return self.accuracy()
+        if self._top_n_total == 0:
+            return float("nan")
+        return self._top_n_correct / self._top_n_total
 
     # ------------------------------------------------------------------
     def _tp(self):

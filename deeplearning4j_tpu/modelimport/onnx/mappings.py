@@ -453,3 +453,280 @@ def _constant_of_shape(ctx, node):
     ctx.set_static(node.outputs[0],
                    np.full(shape, fill[0], fill.dtype))
     return None
+
+
+# -- breadth batch 2 (SURVEY.md S7 coverage): shape/index/norm/rnn ----------
+@onnx_op("Split")
+def _split(ctx, node):
+    axis = int(node.attr("axis", 0))
+    sizes = node.attr("split")
+    if sizes is None and len(node.inputs) > 1 and node.inputs[1]:
+        sizes = [int(s) for s in
+                 np.asarray(ctx.require_static(node, 1)).reshape(-1)]
+    x = ctx.var(node.inputs[0])
+    n_out = len(node.outputs)
+    if sizes is None:
+        return ctx.sd._op("split", [x],
+                          {"num_splits": n_out, "axis": axis},
+                          n_out=n_out)
+    return ctx.sd._op("split_v", [x],
+                      {"size_splits": [int(s) for s in sizes],
+                       "axis": axis},
+                      n_out=n_out)
+
+
+@onnx_op("Expand")
+def _expand(ctx, node):
+    shape = [int(s) for s in
+             np.asarray(ctx.require_static(node, 1)).reshape(-1)]
+    return ctx.sd._op("broadcast_to", [ctx.var(node.inputs[0])],
+                      {"shape": shape})
+
+
+@onnx_op("Where")
+def _where(ctx, node):
+    return ctx.sd._op("where", [ctx.var(node.inputs[0]),
+                                ctx.var(node.inputs[1]),
+                                ctx.var(node.inputs[2])])
+
+
+@onnx_op("ArgMax", "ArgMin")
+def _argminmax(ctx, node):
+    opn = "argmax" if node.op == "ArgMax" else "argmin"
+    axis = int(node.attr("axis", 0))
+    out = ctx.sd._op(opn, [ctx.var(node.inputs[0])], {"axis": axis})
+    if bool(node.attr("keepdims", 1)):
+        out = ctx.sd._op("expand_dims", [out], {"axis": axis})
+    return out
+
+
+@onnx_op("Tile")
+def _tile(ctx, node):
+    reps = [int(r) for r in
+            np.asarray(ctx.require_static(node, 1)).reshape(-1)]
+    return ctx.sd._op("tile", [ctx.var(node.inputs[0])],
+                      {"reps": reps})
+
+
+@onnx_op("Range")
+def _range(ctx, node):
+    start = np.asarray(ctx.require_static(node, 0)).reshape(-1)[0]
+    limit = np.asarray(ctx.require_static(node, 1)).reshape(-1)[0]
+    delta = np.asarray(ctx.require_static(node, 2)).reshape(-1)[0]
+    # ONNX: output dtype == input dtype (int Range must stay int)
+    arr = np.arange(start, limit, delta, dtype=start.dtype)
+    ctx.set_static(node.outputs[0], arr)
+    return ctx.sd.constant(ctx.unique("range"), arr)
+
+
+@onnx_op("OneHot")
+def _one_hot(ctx, node):
+    depth = int(np.asarray(ctx.require_static(node, 1)).reshape(-1)[0])
+    vals = np.asarray(ctx.require_static(node, 2)).reshape(-1)
+    axis = int(node.attr("axis", -1))
+    oh = ctx.sd._op("one_hot", [ctx.var(node.inputs[0])],
+                    {"depth": depth, "axis": axis})
+    if float(vals[0]) != 0.0 or float(vals[1]) != 1.0:
+        off, on = float(vals[0]), float(vals[1])
+        scale = ctx.sd.constant(ctx.unique("oh_s"),
+                                np.float32(on - off))
+        shift = ctx.sd.constant(ctx.unique("oh_o"), np.float32(off))
+        oh = ctx.sd._op("add", [ctx.sd._op("mul", [oh, scale]), shift])
+    return oh
+
+
+@onnx_op("CumSum")
+def _cumsum(ctx, node):
+    axis = int(np.asarray(ctx.require_static(node, 1)).reshape(-1)[0])
+    if node.attr("exclusive", 0) or node.attr("reverse", 0):
+        raise NotImplementedError("CumSum: exclusive/reverse modes")
+    return ctx.sd._op("cumsum", [ctx.var(node.inputs[0])],
+                      {"axis": axis})
+
+
+@onnx_op("TopK")
+def _topk(ctx, node):
+    k = int(np.asarray(ctx.require_static(node, 1)).reshape(-1)[0])
+    if int(node.attr("axis", -1)) not in (-1,):
+        raise NotImplementedError("TopK: only last axis")
+    if not bool(node.attr("largest", 1)):
+        raise NotImplementedError("TopK: smallest mode")
+    return ctx.sd._op("top_k", [ctx.var(node.inputs[0])],
+                      {"k": k}, n_out=2)
+
+
+@onnx_op("Einsum")
+def _einsum(ctx, node):
+    return ctx.sd._op("einsum",
+                      [ctx.var(i) for i in node.inputs],
+                      {"equation": node.attr("equation").decode()
+                       if isinstance(node.attr("equation"), bytes)
+                       else node.attr("equation")})
+
+
+@onnx_op("LRN")
+def _lrn_onnx(ctx, node):
+    # ONNX LRN is NCHW over the C axis; ours is channel-last
+    x = _nchw_to_nhwc(ctx, ctx.var(node.inputs[0]))
+    y = ctx.sd._op("lrn", [x],
+                   {"depth": int(node.attr("size", 5)),
+                    "bias": float(node.attr("bias", 1.0)),
+                    # ONNX alpha is the SUM coefficient pre-divided
+                    # by size; our op multiplies the raw window sum
+                    "alpha": float(node.attr("alpha", 1e-4)) /
+                    int(node.attr("size", 5)),
+                    "beta": float(node.attr("beta", 0.75))})
+    return _nhwc_to_nchw(ctx, y)
+
+
+@onnx_op("SpaceToDepth")
+def _space_to_depth_onnx(ctx, node):
+    # ONNX output channels order [dy, dx, c] — exactly the NHWC op's
+    # layout, so only the NCHW<->NHWC transposes are needed
+    x = _nchw_to_nhwc(ctx, ctx.var(node.inputs[0]))
+    y = ctx.sd._op("space_to_depth", [x],
+                   {"block_size": int(node.attr("blocksize", 2))})
+    return _nhwc_to_nchw(ctx, y)
+
+
+@onnx_op("DepthToSpace")
+def _depth_to_space_onnx(ctx, node):
+    mode = node.attr("mode", b"DCR")
+    mode = mode.decode() if isinstance(mode, bytes) else mode
+    b = int(node.attr("blocksize", 2))
+    x = _nchw_to_nhwc(ctx, ctx.var(node.inputs[0]))
+    if mode == "DCR":
+        # DCR channel order [dy, dx, co] == the NHWC op's expectation
+        xg = x
+    else:
+        # CRD stores [co, dy, dx]; permute to [dy, dx, co] (needs the
+        # static channel count)
+        cin = ctx.shape_of(node.inputs[0])
+        if cin is None:
+            raise NotImplementedError(
+                "DepthToSpace CRD: unknown input shape")
+        c = cin[1]
+        co = c // (b * b)
+        perm = np.arange(c).reshape(co, b * b).T.reshape(-1)
+        xg = ctx.sd._op("gather", [x, ctx.sd.constant(
+            ctx.unique("d2s_perm"), perm.astype(np.int32))],
+            {"axis": -1})
+    y = ctx.sd._op("depth_to_space", [xg], {"block_size": b})
+    return _nhwc_to_nchw(ctx, y)
+
+
+@onnx_op("ScatterND")
+def _scatter_nd_onnx(ctx, node):
+    data = ctx.var(node.inputs[0])
+    idx = ctx.var(node.inputs[1])
+    upd = ctx.var(node.inputs[2])
+    return ctx.sd._op("scatter_nd_update", [data, idx, upd])
+
+
+@onnx_op("ReduceL2")
+def _reduce_l2(ctx, node):
+    axes = node.attr("axes")
+    if axes is None and len(node.inputs) > 1 and node.inputs[1]:
+        axes = [int(v) for v in
+                np.asarray(ctx.require_static(node, 1)).reshape(-1)]
+    keep = bool(node.attr("keepdims", 1))
+    sq = ctx.sd._op("square", [ctx.var(node.inputs[0])])
+    s = ctx.sd._op("reduce_sum", [sq],
+                   {"axis": tuple(axes) if axes else None,
+                    "keep_dims": keep})
+    return ctx.sd._op("sqrt", [s])
+
+
+@onnx_op("InstanceNormalization")
+def _instance_norm(ctx, node):
+    # NCHW: normalize over spatial dims per channel per example
+    x = ctx.var(node.inputs[0])
+    scale = ctx.var(node.inputs[1])
+    bias = ctx.var(node.inputs[2])
+    eps = float(node.attr("epsilon", 1e-5))
+    xn = ctx.sd._op("standardize", [x], {"axis": (2, 3),
+                                         "epsilon": eps})
+    s = ctx.sd._op("reshape", [scale], {"shape": (1, -1, 1, 1)})
+    b = ctx.sd._op("reshape", [bias], {"shape": (1, -1, 1, 1)})
+    return ctx.sd._op("add", [ctx.sd._op("mul", [xn, s]), b])
+
+
+@onnx_op("LayerNormalization")
+def _layer_norm_onnx(ctx, node):
+    axis = int(node.attr("axis", -1))
+    if axis not in (-1,):
+        raise NotImplementedError("LayerNormalization: only last axis")
+    eps = float(node.attr("epsilon", 1e-5))
+    ins = [ctx.var(node.inputs[0]), ctx.var(node.inputs[1])]
+    if len(node.inputs) > 2 and node.inputs[2]:
+        ins.append(ctx.var(node.inputs[2]))
+    return ctx.sd._op("layer_norm", ins, {"axis": -1, "epsilon": eps})
+
+
+@onnx_op("PRelu")
+def _prelu_onnx(ctx, node):
+    x = ctx.var(node.inputs[0])
+    a = ctx.var(node.inputs[1])
+    pos = ctx.sd._op("relu", [x])
+    neg = ctx.sd._op("mul", [a, ctx.sd._op("minimum", [
+        x, ctx.sd.constant(ctx.unique("zero"), np.float32(0.0))])])
+    return ctx.sd._op("add", [pos, neg])
+
+
+@onnx_op("HardSigmoid")
+def _hard_sigmoid(ctx, node):
+    alpha = float(node.attr("alpha", 0.2))
+    beta = float(node.attr("beta", 0.5))
+    x = ctx.var(node.inputs[0])
+    ax = ctx.sd._op("mul", [x, ctx.sd.constant(
+        ctx.unique("hs_a"), np.float32(alpha))])
+    s = ctx.sd._op("add", [ax, ctx.sd.constant(
+        ctx.unique("hs_b"), np.float32(beta))])
+    return ctx.sd._op("clip_by_value", [s],
+                      {"clip_value_min": 0.0, "clip_value_max": 1.0})
+
+
+@onnx_op("Mod")
+def _mod(ctx, node):
+    if not int(node.attr("fmod", 0)):
+        return ctx.sd._op("mod", [ctx.var(node.inputs[0]),
+                                  ctx.var(node.inputs[1])])
+    return ctx.sd._op("fmod", [ctx.var(node.inputs[0]),
+                               ctx.var(node.inputs[1])])
+
+
+@onnx_op("ConvTranspose")
+def _conv_transpose_onnx(ctx, node):
+    w_np = ctx.static(node.inputs[1])
+    if w_np is None:
+        raise NotImplementedError(
+            "ConvTranspose with non-constant weights")
+    if int(node.attr("group", 1)) != 1:
+        raise NotImplementedError("ConvTranspose: grouped")
+    if node.attr("dilations") is not None and \
+            any(int(d) != 1 for d in node.attr("dilations", [])):
+        raise NotImplementedError("ConvTranspose: dilations != 1")
+    strides = [int(s) for s in node.attr("strides", [1, 1])]
+    pads = [int(p) for p in node.attr("pads", [0, 0, 0, 0])]
+    if node.attr("output_padding") is not None and \
+            any(int(p) for p in node.attr("output_padding", [])):
+        raise NotImplementedError("ConvTranspose: output_padding")
+    if pads[0] != pads[2] or pads[1] != pads[3]:
+        raise NotImplementedError("ConvTranspose: asymmetric pads")
+    x = _nchw_to_nhwc(ctx, ctx.var(node.inputs[0]))
+    # ONNX W is IOHW [C_in, C_out, kH, kW]; ours HWIO (conv_transpose
+    # applies the kernel un-mirrored, matching gradient-of-conv with
+    # the spatial flip baked in here)
+    w = np.transpose(w_np, (2, 3, 0, 1))[::-1, ::-1]
+    wv = ctx.sd.constant(ctx.unique(f"{node.inputs[1]}_hwio"),
+                         np.ascontiguousarray(w))
+    # conv_transpose explicit padding applies to the s-dilated input;
+    # k-1-p per side yields ONNX's (i-1)*s + k - 2p output size
+    kh, kw = w_np.shape[2], w_np.shape[3]
+    attrs = {"stride": tuple(strides),
+             "padding": [(kh - 1 - pads[0], kh - 1 - pads[0]),
+                         (kw - 1 - pads[1], kw - 1 - pads[1])]}
+    y = ctx.sd._op("deconv2d", [x, wv], attrs)
+    if len(node.inputs) > 2 and node.inputs[2]:
+        y = ctx.sd._op("add", [y, ctx.var(node.inputs[2])])
+    return _nhwc_to_nchw(ctx, y)

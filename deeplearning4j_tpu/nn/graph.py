@@ -314,7 +314,9 @@ class ComputationGraph:
                     return step_fn(p, s, u, inputs, labels, None, None,
                                    it0 + i, r)
 
-                zero = jnp.zeros((), jnp.float32)
+                # loss carry must match step_fn's loss dtype (bf16 nets
+                # produce a bf16 loss)
+                zero = jnp.zeros((), self._dtype)
                 return jax.lax.fori_loop(
                     0, steps, body,
                     (params, states, upd, zero))

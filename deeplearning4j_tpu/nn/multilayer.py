@@ -240,6 +240,7 @@ class MultiLayerNetwork:
 
         # donate params/states/updater-state buffers: XLA reuses them
         # in place of the reference's workspaces
+        self._step_fn = step        # unjitted (multi-step path reuses)
         self._train_step = jax.jit(step, donate_argnums=(0, 1, 2))
 
     # ------------------------------------------------------------------
@@ -272,6 +273,62 @@ class MultiLayerNetwork:
             for lis in self.listeners:
                 lis.on_epoch_end(self)
             self.epoch_count += 1
+        return self
+
+    # ------------------------------------------------------------------
+    def fit_steps(self, ds, steps: int):
+        """Run ``steps`` train iterations on one device-resident batch
+        in ONE jit dispatch (lax.fori_loop over the compiled step; the
+        Keras steps_per_execution idea — see ComputationGraph.fit_steps).
+        Masks unsupported on this fast path; listeners fire once per
+        group with the final loss."""
+        if not self._initialized:
+            self.init()
+        if self._train_step is None:
+            self._build_train_step()
+        if getattr(ds, "features_mask", None) is not None or \
+                getattr(ds, "labels_mask", None) is not None:
+            raise ValueError(
+                "fit_steps does not support masked DataSets — padded "
+                "timesteps would train as real data; use fit()")
+        x = _as_jnp(ds.features, self._dtype)
+        y = _as_jnp(ds.labels, self._dtype)
+
+        if not hasattr(self, "_multi_steps"):
+            self._multi_steps = {}
+        if steps not in self._multi_steps:
+            step_fn = self._step_fn
+
+            def multi(params, states, upd, x, y, it0, rng):
+                def body(i, carry):
+                    p, s, u, _ = carry
+                    r = jax.random.fold_in(rng, i)
+                    return step_fn(p, s, u, x, y, None, None, it0 + i, r)
+
+                # loss carry must match step_fn's loss dtype (bf16 nets
+                # produce a bf16 loss)
+                zero = jnp.zeros((), self._dtype)
+                return jax.lax.fori_loop(0, steps, body,
+                                         (params, states, upd, zero))
+
+            self._multi_steps[steps] = jax.jit(multi,
+                                               donate_argnums=(0, 1, 2))
+
+        states_in = self._with_zero_rnn_states(self.states,
+                                               int(x.shape[0]))
+        self._rng, rng = jax.random.split(self._rng)
+        self.params, new_states, self.updater_states, loss = \
+            self._multi_steps[steps](self.params, states_in,
+                                     self.updater_states, x, y,
+                                     jnp.asarray(self.iteration_count),
+                                     rng)
+        self.states = self._strip_rnn_states(new_states)
+        self._score = loss
+        self.last_batch_size = int(x.shape[0])
+        self.iteration_count += steps
+        for lis in self.listeners:
+            lis.iteration_done(self, self.iteration_count - 1,
+                               self.epoch_count)
         return self
 
     # ------------------------------------------------------------------

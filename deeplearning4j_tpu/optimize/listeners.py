@@ -71,6 +71,46 @@ class PerformanceListener(TrainingListener):
             self._examples = 0
 
 
+class EvaluativeListener(TrainingListener):
+    """Evaluate on a held-out iterator every N iterations (reference:
+    org.deeplearning4j.optimize.listeners.EvaluativeListener with
+    InvocationType.ITERATION_END). Results accumulate in
+    ``self.evaluations`` as (iteration, Evaluation) pairs; a
+    ``callback(iteration, evaluation)`` hook fires per run."""
+
+    def __init__(self, iterator, frequency: int = 10, callback=None):
+        if not (hasattr(iterator, "reset") or
+                hasattr(iterator, "features") or
+                isinstance(iterator, (list, tuple))):
+            iterator = list(iterator)   # one-shot iterable: keep it
+        self.iterator = iterator
+        self.frequency = max(1, int(frequency))
+        self.callback = callback
+        self.evaluations = []
+
+    def iteration_done(self, model, iteration, epoch):
+        if iteration % self.frequency:
+            return
+        from deeplearning4j_tpu.evaluation import Evaluation
+        e = Evaluation()
+        if hasattr(self.iterator, "reset"):
+            self.iterator.reset()
+        data = self.iterator
+        if hasattr(data, "features"):          # single DataSet
+            data = [data]
+        for ds in data:
+            out = model.output(ds.features)
+            if isinstance(out, (list, tuple)):
+                out = out[0]
+            e.eval(ds.labels, out,
+                   mask=getattr(ds, "labels_mask", None))
+        self.evaluations.append((iteration, e))
+        log.info("Evaluation at iteration %d: accuracy %.4f", iteration,
+                 e.accuracy())
+        if self.callback is not None:
+            self.callback(iteration, e)
+
+
 class CollectScoresListener(TrainingListener):
     """Collect (iteration, score) pairs in memory (reference: same name)."""
 

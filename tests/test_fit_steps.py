@@ -4,6 +4,7 @@ updater state, and iteration count for deterministic (rng-free) models
 (the Keras steps_per_execution analog; SURVEY.md §7 perf work)."""
 import jax
 import numpy as np
+import pytest
 
 from deeplearning4j_tpu.activations import Activation
 from deeplearning4j_tpu.datasets.dataset import DataSet
@@ -76,6 +77,79 @@ def test_fit_steps_trains():
         net.fit_steps(ds, 10)
     assert float(net.score()) < first * 0.5
     assert net.iteration_count == 102
+
+
+def test_multilayer_fit_steps_matches_fit_loop():
+    from deeplearning4j_tpu.nn import (MultiLayerNetwork,
+                                       NeuralNetConfiguration)
+
+    def mk():
+        conf = (NeuralNetConfiguration.Builder()
+                .seed(0).updater(Adam(1e-2))
+                .list()
+                .layer(DenseLayer(n_out=8, activation=Activation.RELU))
+                .layer(BatchNormalization())
+                .layer(OutputLayer(n_out=3,
+                                   loss_function=LossFunction.MCXENT,
+                                   activation=Activation.SOFTMAX))
+                .set_input_type(InputType.feed_forward(4))
+                .build())
+        return MultiLayerNetwork(conf).init()
+
+    rng = np.random.RandomState(0)
+    x = rng.randn(32, 4).astype(np.float32)
+    y = np.eye(3, dtype=np.float32)[rng.randint(0, 3, 32)]
+    ds = DataSet(x, y)
+    a, b = mk(), mk()
+    for _ in range(5):
+        a.fit(ds)
+    b.fit_steps(ds, 5)
+    assert a.iteration_count == b.iteration_count == 5
+    for la, lb in zip(jax.tree_util.tree_leaves(a.params),
+                      jax.tree_util.tree_leaves(b.params)):
+        np.testing.assert_allclose(np.asarray(la), np.asarray(lb),
+                                   rtol=1e-5, atol=1e-6)
+
+
+def test_evaluative_listener_runs_during_training():
+    from deeplearning4j_tpu.nn import (MultiLayerNetwork,
+                                       NeuralNetConfiguration)
+    from deeplearning4j_tpu.optimize.listeners import EvaluativeListener
+    conf = (NeuralNetConfiguration.Builder()
+            .seed(0).updater(Adam(1e-2))
+            .list()
+            .layer(DenseLayer(n_out=8, activation=Activation.RELU))
+            .layer(OutputLayer(n_out=3,
+                               loss_function=LossFunction.MCXENT,
+                               activation=Activation.SOFTMAX))
+            .set_input_type(InputType.feed_forward(4))
+            .build())
+    net = MultiLayerNetwork(conf).init()
+    rng = np.random.RandomState(0)
+    x = rng.randn(32, 4).astype(np.float32)
+    y = np.eye(3, dtype=np.float32)[rng.randint(0, 3, 32)]
+    lis = EvaluativeListener(DataSet(x, y), frequency=2)
+    net.set_listeners(lis)
+    for _ in range(5):
+        net.fit(x, y)
+    assert len(lis.evaluations) == 3    # iterations 0, 2, 4
+    it, e = lis.evaluations[-1]
+    assert 0.0 <= e.accuracy() <= 1.0
+
+
+def test_top_n_accuracy():
+    from deeplearning4j_tpu.evaluation import Evaluation
+    labels = np.eye(4)[[0, 1, 2, 3]].astype(float)
+    preds = np.asarray([
+        [0.6, 0.3, 0.1, 0.0],   # top1 correct
+        [0.5, 0.4, 0.1, 0.0],   # top1 wrong, top2 correct
+        [0.5, 0.3, 0.1, 0.1],   # top1 wrong, top2 wrong
+        [0.1, 0.2, 0.3, 0.4],   # top1 correct
+    ])
+    e = Evaluation(top_n=2)
+    e.eval(labels, preds)
+    assert e.accuracy() == pytest.approx(0.5)
+    assert e.top_n_accuracy() == pytest.approx(0.75)
 
 
 def test_fit_steps_rejects_masked_data():

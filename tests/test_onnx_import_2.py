@@ -1,0 +1,188 @@
+"""ONNX import conformance, batch 2 (SURVEY.md S7/§4.4): shape/index
+ops, normalization, ConvTranspose, PRelu — fixtures hand-encoded with
+the in-repo ONNX encoder, ground truth from torch CPU."""
+import numpy as np
+import pytest
+
+torch = pytest.importorskip("torch")
+
+from deeplearning4j_tpu.modelimport.onnx import import_onnx
+from deeplearning4j_tpu.modelimport.onnx.protobuf import (
+    encode_model, encode_node, encode_value_info)
+
+R = np.random.RandomState(0)
+
+
+def _run(nodes, inits, in_specs, out_specs, feeds):
+    model = encode_model(nodes, inits,
+                         [encode_value_info(n, s) for n, s in in_specs],
+                         [encode_value_info(n, s) for n, s in out_specs])
+    imp = import_onnx(model)
+    return imp.output(feeds)
+
+
+class TestShapeIndexOps:
+    def test_split_where_argmax(self):
+        x = R.randn(4, 6).astype(np.float32)
+        nodes = [
+            encode_node("Split", ["x"], ["a", "b"], "sp", axis=1,
+                        split=[2, 4]),
+            encode_node("ArgMax", ["b"], ["am"], "am", axis=1,
+                        keepdims=0),
+            encode_node("Cast", ["am"], ["amf"], "c", to=1),
+            encode_node("ReduceSum", ["a"], ["s"], "rs", axes=[1],
+                        keepdims=0),
+            encode_node("Greater", ["s", "amf"], ["g"], "gt"),
+            encode_node("Where", ["g", "s", "amf"], ["y"], "w"),
+        ]
+        got = _run(nodes, {}, [("x", (4, 6))], [("y", (4,))],
+                   {"x": x})[0]
+        a, b = x[:, :2], x[:, 2:]
+        s = a.sum(1)
+        am = b.argmax(1).astype(np.float32)
+        want = np.where(s > am, s, am)
+        np.testing.assert_allclose(np.asarray(got), want, atol=1e-5)
+
+    def test_tile_expand_onehot(self):
+        idx = np.asarray([0, 2, 1], np.int64)
+        nodes = [
+            encode_node("OneHot", ["i", "depth", "vals"], ["oh"], "oh",
+                        axis=-1),
+            encode_node("Tile", ["oh", "reps"], ["t"], "t"),
+            encode_node("Expand", ["t", "eshape"], ["y"], "e"),
+        ]
+        inits = {"depth": np.asarray(4, np.int64),
+                 "vals": np.asarray([0.0, 1.0], np.float32),
+                 "reps": np.asarray([2, 1], np.int64),
+                 "eshape": np.asarray([1, 6, 4], np.int64)}
+        got = _run(nodes, inits, [("i", (3,))], [("y", (1, 6, 4))],
+                   {"i": idx})[0]
+        oh = np.eye(4, dtype=np.float32)[idx]
+        want = np.tile(oh, (2, 1))[None]
+        np.testing.assert_allclose(np.asarray(got), want)
+
+    def test_topk_cumsum(self):
+        x = R.randn(3, 8).astype(np.float32)
+        nodes = [
+            encode_node("TopK", ["x", "k"], ["v", "i"], "tk", axis=-1),
+            encode_node("CumSum", ["v", "ax"], ["y"], "cs"),
+        ]
+        inits = {"k": np.asarray(3, np.int64),
+                 "ax": np.asarray(1, np.int32)}
+        got = _run(nodes, inits, [("x", (3, 8))], [("y", (3, 3))],
+                   {"x": x})[0]
+        tv = np.sort(x, axis=-1)[:, ::-1][:, :3]
+        np.testing.assert_allclose(np.asarray(got),
+                                   np.cumsum(tv, axis=1), atol=1e-5)
+
+    def test_scatter_nd(self):
+        data = np.zeros((5,), np.float32)
+        nodes = [encode_node("ScatterND", ["d", "i", "u"], ["y"], "sc")]
+        inits = {"i": np.asarray([[1], [3]], np.int64),
+                 "u": np.asarray([7.0, 9.0], np.float32)}
+        got = _run(nodes, inits, [("d", (5,))], [("y", (5,))],
+                   {"d": data})[0]
+        np.testing.assert_allclose(np.asarray(got),
+                                   [0, 7, 0, 9, 0])
+
+
+class TestNormAndActivations:
+    def test_layer_norm_matches_torch(self):
+        x = torch.randn(4, 10)
+        ln = torch.nn.LayerNorm(10).eval()
+        with torch.no_grad():
+            ln.weight.copy_(torch.rand(10) + 0.5)
+            ln.bias.copy_(torch.randn(10) * 0.1)
+        want = ln(x).detach().numpy()
+        nodes = [encode_node("LayerNormalization", ["x", "g", "b"],
+                             ["y"], "ln", axis=-1,
+                             epsilon=float(ln.eps))]
+        inits = {"g": ln.weight.detach().numpy(),
+                 "b": ln.bias.detach().numpy()}
+        got = _run(nodes, inits, [("x", (4, 10))], [("y", (4, 10))],
+                   {"x": x.numpy()})[0]
+        np.testing.assert_allclose(np.asarray(got), want, atol=1e-5)
+
+    def test_instance_norm_matches_torch(self):
+        x = torch.randn(2, 3, 8, 8)
+        inorm = torch.nn.InstanceNorm2d(3, affine=True).eval()
+        with torch.no_grad():
+            inorm.weight.copy_(torch.rand(3) + 0.5)
+            inorm.bias.copy_(torch.randn(3) * 0.1)
+        want = inorm(x).detach().numpy()
+        nodes = [encode_node("InstanceNormalization", ["x", "g", "b"],
+                             ["y"], "in", epsilon=1e-5)]
+        inits = {"g": inorm.weight.detach().numpy(),
+                 "b": inorm.bias.detach().numpy()}
+        got = _run(nodes, inits, [("x", (2, 3, 8, 8))],
+                   [("y", (2, 3, 8, 8))], {"x": x.numpy()})[0]
+        np.testing.assert_allclose(np.asarray(got), want, atol=1e-4)
+
+    def test_prelu_hardsigmoid(self):
+        x = torch.randn(3, 6)
+        alpha = torch.rand(6) * 0.4
+        want = torch.nn.functional.hardsigmoid(
+            torch.nn.functional.prelu(x, alpha)).numpy()
+        # torch hardsigmoid: clip(x/6 + 1/2, 0, 1) -> alpha=1/6, beta=.5
+        nodes = [
+            encode_node("PRelu", ["x", "a"], ["p"], "pr"),
+            encode_node("HardSigmoid", ["p"], ["y"], "hs",
+                        alpha=1.0 / 6.0, beta=0.5),
+        ]
+        got = _run(nodes, {"a": alpha.numpy()}, [("x", (3, 6))],
+                   [("y", (3, 6))], {"x": x.numpy()})[0]
+        np.testing.assert_allclose(np.asarray(got), want, atol=1e-5)
+
+    def test_lrn_matches_torch(self):
+        x = torch.randn(2, 8, 5, 5)
+        lrn = torch.nn.LocalResponseNorm(5, alpha=1e-3, beta=0.75,
+                                         k=1.0)
+        want = lrn(x).detach().numpy()
+        nodes = [encode_node("LRN", ["x"], ["y"], "lrn", size=5,
+                             alpha=1e-3, beta=0.75, bias=1.0)]
+        got = _run(nodes, {}, [("x", (2, 8, 5, 5))],
+                   [("y", (2, 8, 5, 5))], {"x": x.numpy()})[0]
+        np.testing.assert_allclose(np.asarray(got), want, atol=1e-5)
+
+
+class TestConvTranspose:
+    @pytest.mark.parametrize("stride,pad", [(2, 0), (2, 1), (1, 1)])
+    def test_matches_torch(self, stride, pad):
+        torch.manual_seed(0)
+        m = torch.nn.ConvTranspose2d(3, 4, 3, stride=stride,
+                                     padding=pad).eval()
+        x = torch.randn(2, 3, 5, 5)
+        want = m(x).detach().numpy()
+        nodes = [encode_node("ConvTranspose", ["x", "w", "b"], ["y"],
+                             "ct", strides=[stride, stride],
+                             pads=[pad, pad, pad, pad],
+                             kernel_shape=[3, 3])]
+        inits = {"w": m.weight.detach().numpy(),
+                 "b": m.bias.detach().numpy()}
+        got = _run(nodes, inits, [("x", (2, 3, 5, 5))],
+                   [("y", tuple(want.shape))], {"x": x.numpy()})[0]
+        np.testing.assert_allclose(np.asarray(got), want, atol=1e-4)
+
+
+class TestBlockRearrange:
+    def test_depth_to_space_dcr_matches_torch(self):
+        x = torch.randn(1, 8, 3, 3)
+        want = torch.nn.functional.pixel_shuffle(x, 2).numpy()
+        # torch pixel_shuffle == ONNX DepthToSpace mode=CRD
+        nodes = [encode_node("DepthToSpace", ["x"], ["y"], "d2s",
+                             blocksize=2, mode="CRD")]
+        got = _run(nodes, {}, [("x", (1, 8, 3, 3))],
+                   [("y", (1, 2, 6, 6))], {"x": x.numpy()})[0]
+        np.testing.assert_allclose(np.asarray(got), want, atol=1e-6)
+
+    def test_space_to_depth_roundtrip_with_dcr(self):
+        x = R.randn(1, 2, 4, 4).astype(np.float32)
+        nodes = [
+            encode_node("SpaceToDepth", ["x"], ["s"], "s2d",
+                        blocksize=2),
+            encode_node("DepthToSpace", ["s"], ["y"], "d2s",
+                        blocksize=2, mode="DCR"),
+        ]
+        got = _run(nodes, {}, [("x", (1, 2, 4, 4))],
+                   [("y", (1, 2, 4, 4))], {"x": x})[0]
+        np.testing.assert_allclose(np.asarray(got), x, atol=1e-6)
