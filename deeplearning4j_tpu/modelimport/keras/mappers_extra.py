@@ -308,3 +308,23 @@ def _map_bidirectional(cfg, bag):
     layer = Bidirectional(fwd=fwd[0].layer, mode=mode)
     return [Emit(layer=layer, params={"fwd": fwd[0].params,
                                       "bwd": bwd[0].params})]
+
+
+@keras_layer("GaussianNoise", "GaussianDropout", "AlphaDropout",
+             "SpatialDropout1D", "SpatialDropout2D", "SpatialDropout3D")
+def _map_noise_layers(cfg, bag):
+    """Training-only noise layers -> DropoutLayer with the matching
+    IDropout variant (identity at inference, same as keras)."""
+    from deeplearning4j_tpu.nn.conf.dropout import (
+        AlphaDropout, GaussianDropout, GaussianNoise, SpatialDropout)
+    from deeplearning4j_tpu.nn.conf.layers import DropoutLayer
+    cls = cfg["__class__"]
+    if cls == "GaussianNoise":
+        d = GaussianNoise(stddev=float(cfg.get("stddev", 0.1)))
+    elif cls == "GaussianDropout":
+        d = GaussianDropout(rate=float(cfg.get("rate", 0.1)))
+    elif cls == "AlphaDropout":
+        d = AlphaDropout(p=1.0 - float(cfg.get("rate", 0.05)))
+    else:
+        d = SpatialDropout(p=1.0 - float(cfg.get("rate", 0.5)))
+    return [Emit(layer=DropoutLayer(dropout=d))]

@@ -479,6 +479,11 @@ def _split(ctx, node):
 def _expand(ctx, node):
     shape = [int(s) for s in
              np.asarray(ctx.require_static(node, 1)).reshape(-1)]
+    in_shape = ctx.shape_of(node.inputs[0])
+    if in_shape is not None:
+        # ONNX Expand is max-dim broadcast: a target dim of 1 keeps
+        # the input dim (plain broadcast_to would reject it)
+        shape = list(np.broadcast_shapes(tuple(in_shape), tuple(shape)))
     return ctx.sd._op("broadcast_to", [ctx.var(node.inputs[0])],
                       {"shape": shape})
 
@@ -547,7 +552,10 @@ def _cumsum(ctx, node):
 @onnx_op("TopK")
 def _topk(ctx, node):
     k = int(np.asarray(ctx.require_static(node, 1)).reshape(-1)[0])
-    if int(node.attr("axis", -1)) not in (-1,):
+    axis = int(node.attr("axis", -1))
+    in_shape = ctx.shape_of(node.inputs[0])
+    rank = len(in_shape) if in_shape is not None else None
+    if axis != -1 and (rank is None or axis != rank - 1):
         raise NotImplementedError("TopK: only last axis")
     if not bool(node.attr("largest", 1)):
         raise NotImplementedError("TopK: smallest mode")
@@ -654,7 +662,9 @@ def _instance_norm(ctx, node):
 @onnx_op("LayerNormalization")
 def _layer_norm_onnx(ctx, node):
     axis = int(node.attr("axis", -1))
-    if axis not in (-1,):
+    in_shape = ctx.shape_of(node.inputs[0])
+    rank = len(in_shape) if in_shape is not None else None
+    if axis != -1 and (rank is None or axis != rank - 1):
         raise NotImplementedError("LayerNormalization: only last axis")
     eps = float(node.attr("epsilon", 1e-5))
     ins = [ctx.var(node.inputs[0]), ctx.var(node.inputs[1])]
@@ -706,6 +716,10 @@ def _conv_transpose_onnx(ctx, node):
     if node.attr("dilations") is not None and \
             any(int(d) != 1 for d in node.attr("dilations", [])):
         raise NotImplementedError("ConvTranspose: dilations != 1")
+    ap = node.attr("auto_pad", b"NOTSET")
+    ap = ap.decode() if isinstance(ap, bytes) else ap
+    if ap not in ("NOTSET", ""):
+        raise NotImplementedError(f"ConvTranspose: auto_pad={ap}")
     strides = [int(s) for s in node.attr("strides", [1, 1])]
     pads = [int(p) for p in node.attr("pads", [0, 0, 0, 0])]
     if node.attr("output_padding") is not None and \

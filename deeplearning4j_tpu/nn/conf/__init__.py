@@ -11,3 +11,6 @@ from deeplearning4j_tpu.nn.conf import layers_vae  # noqa: F401
 from deeplearning4j_tpu.nn.conf import layers_output_extra  # noqa: F401
 from deeplearning4j_tpu.nn.conf import layers_capsule  # noqa: F401
 from deeplearning4j_tpu.nn.conf import preprocessors  # noqa: F401
+from deeplearning4j_tpu.nn.conf.dropout import (  # noqa: F401
+    AlphaDropout, Dropout, GaussianDropout, GaussianNoise, IDropout,
+    SpatialDropout, WeightNoise)

@@ -1,0 +1,156 @@
+"""Dropout variants + weight noise (SURVEY.md D1/D4 regularization).
+
+Reference parity: ``org.deeplearning4j.nn.conf.dropout.{Dropout,
+GaussianDropout,GaussianNoise,AlphaDropout,SpatialDropout}`` (the
+IDropout hierarchy — a layer's ``dropout`` can be any of these, not
+just a retain probability) and ``conf.weightnoise.{WeightNoise,
+DropConnect}`` (noise applied to the *parameters* each forward pass).
+
+All are pure functions of (x, rng): stateless, jit-friendly, applied
+inside the compiled step.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import jax
+import jax.numpy as jnp
+
+
+class IDropout:
+    """Base activation-noise interface (reference: conf.dropout
+    IDropout)."""
+
+    def apply(self, x, rng):
+        raise NotImplementedError
+
+    # -- serde ----------------------------------------------------------
+    def to_map(self) -> dict:
+        d = {"@class": type(self).__name__}
+        d.update(self.__dict__)
+        return d
+
+    @staticmethod
+    def from_map(d: dict) -> "IDropout":
+        d = dict(d)
+        return _REGISTRY[d.pop("@class")](**d)
+
+
+@dataclass
+class Dropout(IDropout):
+    """Inverted dropout; ``p`` is the RETAIN probability (the
+    reference's convention)."""
+
+    p: float = 0.5
+
+    def apply(self, x, rng):
+        keep = jax.random.bernoulli(rng, self.p, x.shape)
+        return jnp.where(keep, x / self.p, 0.0)
+
+
+@dataclass
+class GaussianDropout(IDropout):
+    """Multiplicative gaussian noise N(1, rate/(1-rate)) (reference:
+    GaussianDropout)."""
+
+    rate: float = 0.1
+
+    def apply(self, x, rng):
+        stddev = (self.rate / (1.0 - self.rate)) ** 0.5
+        return x * (1.0 + stddev *
+                    jax.random.normal(rng, x.shape, x.dtype))
+
+
+@dataclass
+class GaussianNoise(IDropout):
+    """Additive gaussian noise N(0, stddev) (reference: GaussianNoise)."""
+
+    stddev: float = 0.1
+
+    def apply(self, x, rng):
+        return x + self.stddev * jax.random.normal(rng, x.shape, x.dtype)
+
+
+@dataclass
+class AlphaDropout(IDropout):
+    """SELU-preserving dropout (reference: AlphaDropout; Klambauer et
+    al.): dropped units take the value alpha', and an affine correction
+    keeps mean/variance at (0, 1). ``p`` is the retain probability."""
+
+    p: float = 0.95
+
+    # fixed-point constants of SELU
+    _ALPHA = 1.6732632423543772
+    _SCALE = 1.0507009873554805
+
+    def apply(self, x, rng):
+        ap = -self._ALPHA * self._SCALE
+        keep = jax.random.bernoulli(rng, self.p, x.shape)
+        a = (self.p + ap * ap * self.p * (1 - self.p)) ** -0.5
+        b = -a * ap * (1 - self.p)
+        return a * jnp.where(keep, x, ap) + b
+
+
+@dataclass
+class SpatialDropout(IDropout):
+    """Drop whole feature maps/channels (reference: SpatialDropout):
+    one keep/drop decision per trailing-channel per example. ``p`` is
+    the retain probability."""
+
+    p: float = 0.5
+
+    def apply(self, x, rng):
+        shape = (x.shape[0],) + (1,) * (x.ndim - 2) + (x.shape[-1],)
+        keep = jax.random.bernoulli(rng, self.p, shape)
+        return jnp.where(keep, x / self.p, 0.0)
+
+
+@dataclass
+class WeightNoise:
+    """Parameter noise applied each training forward pass (reference:
+    conf.weightnoise.WeightNoise with a gaussian distribution, or
+    DropConnect via ``is_dropconnect``). ``additive`` gaussian N(0,
+    stddev) or multiplicative N(1, stddev); DropConnect zeroes weights
+    with probability 1-p instead."""
+
+    stddev: float = 0.05
+    additive: bool = True
+    apply_to_bias: bool = False
+    is_dropconnect: bool = False
+    p: float = 0.5              # DropConnect retain probability
+
+    def apply(self, params: dict, rng) -> dict:
+        out = {}
+        for name, w in params.items():
+            if not self.apply_to_bias and name in ("b", "gamma", "beta"):
+                out[name] = w
+                continue
+            rng, sub = jax.random.split(rng)
+            if isinstance(w, dict):        # wrapper sub-trees
+                out[name] = self.apply(w, sub)
+            elif self.is_dropconnect:
+                keep = jax.random.bernoulli(sub, self.p, w.shape)
+                out[name] = jnp.where(keep, w / self.p, 0.0)
+            elif self.additive:
+                out[name] = w + self.stddev * jax.random.normal(
+                    sub, w.shape, w.dtype)
+            else:
+                out[name] = w * (1.0 + self.stddev * jax.random.normal(
+                    sub, w.shape, w.dtype))
+        return out
+
+    def to_map(self) -> dict:
+        d = {"@class": type(self).__name__}
+        d.update(self.__dict__)
+        return d
+
+    @staticmethod
+    def from_map(d: dict) -> "WeightNoise":
+        d = dict(d)
+        d.pop("@class", None)
+        return WeightNoise(**d)
+
+
+_REGISTRY = {c.__name__: c for c in
+             (Dropout, GaussianDropout, GaussianNoise, AlphaDropout,
+              SpatialDropout)}

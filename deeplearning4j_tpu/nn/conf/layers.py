@@ -89,7 +89,10 @@ class Layer:
     updater: Optional[IUpdater] = None            # None -> net default
     l1: Optional[float] = None
     l2: Optional[float] = None
-    dropout: Optional[float] = None               # retain probability
+    #: float retain probability OR an IDropout variant (conf.dropout)
+    dropout: object = None
+    #: optional WeightNoise/DropConnect applied to params in training
+    weight_noise: object = None
     name: Optional[str] = None
 
     def __post_init__(self):
@@ -157,17 +160,22 @@ class Layer:
     def _maybe_dropout(self, x, training: bool, rng):
         if self.dropout is None or not training or rng is None:
             return x
+        from deeplearning4j_tpu.nn.conf.dropout import IDropout
+        if isinstance(self.dropout, IDropout):   # reference: IDropout
+            return self.dropout.apply(x, rng)
         p = float(self.dropout)
         keep = jax.random.bernoulli(rng, p, x.shape)
         return jnp.where(keep, x / p, 0.0)
 
     # -- serde -----------------------------------------------------------
     def to_map(self) -> dict:
+        from deeplearning4j_tpu.nn.conf.dropout import IDropout, \
+            WeightNoise
         d = {"@class": type(self).__name__}
         for k, v in self.__dict__.items():
             if isinstance(v, enum.Enum):
                 v = v.name
-            elif isinstance(v, IUpdater):
+            elif isinstance(v, (IUpdater, IDropout, WeightNoise)):
                 v = v.to_map()
             elif isinstance(v, LossFunction):
                 v = v.name
@@ -183,6 +191,13 @@ class Layer:
         for k, v in list(d.items()):
             if k == "updater" and isinstance(v, dict):
                 d[k] = IUpdater.from_map(v)
+            elif k == "dropout" and isinstance(v, dict):
+                from deeplearning4j_tpu.nn.conf.dropout import IDropout
+                d[k] = IDropout.from_map(v)
+            elif k == "weight_noise" and isinstance(v, dict):
+                from deeplearning4j_tpu.nn.conf.dropout import \
+                    WeightNoise
+                d[k] = WeightNoise.from_map(v)
             elif k in ("pooling_type",) and isinstance(v, str):
                 d[k] = PoolingType[v]
             elif k in ("convolution_mode",) and isinstance(v, str):

@@ -120,6 +120,13 @@ class ComputationGraph:
                 if rng is not None:
                     rng, lrng = jax.random.split(rng)
                 layer = v.content
+                lp = params.get(name, {})
+                if training and layer.weight_noise is not None and \
+                        lrng is not None and lp:
+                    # reference: conf.weightnoise — params perturbed
+                    # per forward; gradients flow to the clean params
+                    lrng, wn_rng = jax.random.split(lrng)
+                    lp = layer.weight_noise.apply(lp, wn_rng)
                 ls = states.get(name, {})
                 kw = {}
                 if fmask is not None and layer.accepts_mask():
@@ -128,11 +135,11 @@ class ComputationGraph:
                         isinstance(layer, BaseOutputLayer) and \
                         layer.wants_logits():
                     h, ns = layer.forward_logits(
-                        params.get(name, {}), h, training=training,
+                        lp, h, training=training,
                         rng=lrng, state=ls or None)
                 else:
                     h, ns = layer.forward(
-                        params.get(name, {}), h, training=training,
+                        lp, h, training=training,
                         rng=lrng, state=ls or None, **kw)
                 new_states[name] = ns if ns is not None else {}
                 acts[name] = h

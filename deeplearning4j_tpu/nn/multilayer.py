@@ -136,6 +136,12 @@ class MultiLayerNetwork:
             lrng = None
             if rng is not None:
                 rng, lrng = jax.random.split(rng)
+            if training and layer.weight_noise is not None and \
+                    lrng is not None and lp:
+                # reference: conf.weightnoise — params perturbed per
+                # forward pass; gradients flow to the clean params
+                lrng, wn_rng = jax.random.split(lrng)
+                lp = layer.weight_noise.apply(lp, wn_rng)
             kw = {}
             if mask is not None and layer.accepts_mask():
                 kw["mask"] = mask

@@ -99,7 +99,9 @@ class EvaluativeListener(TrainingListener):
         if hasattr(data, "features"):          # single DataSet
             data = [data]
         for ds in data:
-            out = model.output(ds.features)
+            fmask = getattr(ds, "features_mask", None)
+            out = (model.output(ds.features, mask=fmask)
+                   if fmask is not None else model.output(ds.features))
             if isinstance(out, (list, tuple)):
                 out = out[0]
             e.eval(ds.labels, out,

@@ -1,0 +1,125 @@
+"""IDropout variants + WeightNoise tests (reference test style:
+TestDropout / TestWeightNoise in org.deeplearning4j.nn.conf.dropout,
+SURVEY.md D1/D4)."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.activations import Activation
+from deeplearning4j_tpu.learning import Adam, Sgd
+from deeplearning4j_tpu.lossfunctions import LossFunction
+from deeplearning4j_tpu.nn import (InputType, MultiLayerNetwork,
+                                   NeuralNetConfiguration)
+from deeplearning4j_tpu.nn.conf.dropout import (AlphaDropout, Dropout,
+                                                GaussianDropout,
+                                                GaussianNoise, IDropout,
+                                                SpatialDropout,
+                                                WeightNoise)
+from deeplearning4j_tpu.nn.conf.layers import (DenseLayer, Layer,
+                                               OutputLayer)
+
+K = jax.random.PRNGKey(0)
+X = jax.random.normal(jax.random.PRNGKey(1), (512, 64))
+
+
+class TestVariants:
+    def test_dropout_zeroes_and_scales(self):
+        y = np.asarray(Dropout(p=0.8).apply(X, K))
+        frac_zero = (y == 0).mean()
+        assert 0.1 < frac_zero < 0.3          # ~20% dropped
+        kept = y[y != 0]
+        x = np.asarray(X)[y != 0]
+        np.testing.assert_allclose(kept, x / 0.8, rtol=1e-5)
+
+    def test_gaussian_dropout_mean_preserving(self):
+        big = jnp.ones((200_000,))
+        y = np.asarray(GaussianDropout(rate=0.2).apply(big, K))
+        assert abs(y.mean() - 1.0) < 0.01
+        assert abs(y.std() - 0.5) < 0.02      # sqrt(0.2/0.8) = 0.5
+
+    def test_gaussian_noise_additive(self):
+        big = jnp.zeros((200_000,))
+        y = np.asarray(GaussianNoise(stddev=0.3).apply(big, K))
+        assert abs(y.mean()) < 0.01
+        assert abs(y.std() - 0.3) < 0.01
+
+    def test_alpha_dropout_preserves_moments(self):
+        big = jax.random.normal(K, (500_000,))
+        y = np.asarray(AlphaDropout(p=0.9).apply(big,
+                                                 jax.random.PRNGKey(7)))
+        assert abs(y.mean()) < 0.02
+        assert abs(y.std() - 1.0) < 0.02
+
+    def test_spatial_dropout_drops_whole_channels(self):
+        x = jnp.ones((8, 5, 5, 16))
+        y = np.asarray(SpatialDropout(p=0.5).apply(x, K))
+        # per (example, channel): either all zero or all scaled
+        per_chan = y.reshape(8, 25, 16)
+        all_zero = (per_chan == 0).all(axis=1)
+        all_kept = (per_chan == 2.0).all(axis=1)
+        assert np.all(all_zero | all_kept)
+        assert 0.2 < all_zero.mean() < 0.8
+
+    def test_serde_roundtrip(self):
+        layer = DenseLayer(n_in=4, n_out=3,
+                           dropout=GaussianDropout(rate=0.3),
+                           weight_noise=WeightNoise(stddev=0.1))
+        back = Layer.from_map(layer.to_map())
+        assert isinstance(back.dropout, GaussianDropout)
+        assert back.dropout.rate == pytest.approx(0.3)
+        assert isinstance(back.weight_noise, WeightNoise)
+
+
+class TestInNetwork:
+    def _net(self, **layer_kw):
+        conf = (NeuralNetConfiguration.Builder()
+                .seed(0).updater(Adam(1e-2))
+                .list()
+                .layer(DenseLayer(n_out=16, activation=Activation.RELU,
+                                  **layer_kw))
+                .layer(OutputLayer(n_out=2,
+                                   loss_function=LossFunction.MCXENT,
+                                   activation=Activation.SOFTMAX))
+                .set_input_type(InputType.feed_forward(4))
+                .build())
+        return MultiLayerNetwork(conf).init()
+
+    def test_gaussian_dropout_trains(self):
+        rng = np.random.RandomState(0)
+        xs = rng.randn(128, 4).astype(np.float32)
+        ys = (xs[:, 0] > 0).astype(int)
+        labels = np.eye(2, dtype=np.float32)[ys]
+        net = self._net(dropout=GaussianDropout(rate=0.1))
+        for _ in range(60):
+            net.fit(xs, labels)
+        acc = (np.asarray(net.output(xs)).argmax(-1) == ys).mean()
+        assert acc > 0.9
+
+    def test_weight_noise_training_vs_inference(self):
+        """Noise perturbs training forwards only; inference is clean
+        and deterministic."""
+        rng = np.random.RandomState(0)
+        xs = rng.randn(16, 4).astype(np.float32)
+        net = self._net(weight_noise=WeightNoise(stddev=0.5))
+        out1 = np.asarray(net.output(xs))
+        out2 = np.asarray(net.output(xs))
+        np.testing.assert_array_equal(out1, out2)
+        # training still converges (small noise)
+        net2 = self._net(weight_noise=WeightNoise(stddev=0.02))
+        ys = (xs[:, 0] > 0).astype(int)
+        labels = np.eye(2, dtype=np.float32)[ys]
+        for _ in range(80):
+            net2.fit(xs, labels)
+        acc = (np.asarray(net2.output(xs)).argmax(-1) == ys).mean()
+        assert acc > 0.85
+
+    def test_dropconnect(self):
+        """DropConnect zeroes weights during training forwards."""
+        wn = WeightNoise(is_dropconnect=True, p=0.5)
+        params = {"W": jnp.ones((10, 10)), "b": jnp.ones((10,))}
+        out = wn.apply(params, K)
+        w = np.asarray(out["W"])
+        assert set(np.unique(w)).issubset({0.0, 2.0})
+        assert 0.2 < (w == 0).mean() < 0.8
+        np.testing.assert_array_equal(np.asarray(out["b"]), 1.0)

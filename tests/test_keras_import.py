@@ -126,11 +126,11 @@ class TestSequentialImport:
         model = keras.Sequential([
             keras.layers.Input((8,)),
             keras.layers.Dense(4),
-            keras.layers.GaussianNoise(0.1),
+            keras.layers.UnitNormalization(),
         ])
         path = _save(model, tmp_path, "keras")
         with pytest.raises(InvalidKerasConfigurationException,
-                           match="GaussianNoise"):
+                           match="UnitNormalization"):
             KerasModelImport \
                 .import_keras_sequential_model_and_weights(path)
 

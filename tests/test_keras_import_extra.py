@@ -189,6 +189,19 @@ class TestMiscAndWrappers:
         ])
         _compare(model, R.randn(2, 5, 4).astype(np.float32), tmp_path)
 
+    def test_noise_layers_import_as_inference_identity(self, tmp_path):
+        """GaussianNoise/GaussianDropout/AlphaDropout import and are
+        identity at inference, matching keras."""
+        model = keras.Sequential([
+            keras.layers.Input((6,)),
+            keras.layers.Dense(5, activation="tanh"),
+            keras.layers.GaussianNoise(0.3),
+            keras.layers.GaussianDropout(0.2),
+            keras.layers.AlphaDropout(0.1),
+            keras.layers.Dense(3),
+        ])
+        _compare(model, R.randn(4, 6).astype(np.float32), tmp_path)
+
     def test_bidirectional_no_sequences_rejected(self, tmp_path):
         model = keras.Sequential([
             keras.layers.Input((5, 4)),

@@ -75,6 +75,25 @@ class TestShapeIndexOps:
         np.testing.assert_allclose(np.asarray(got),
                                    np.cumsum(tv, axis=1), atol=1e-5)
 
+    def test_expand_with_ones_dims(self):
+        """ONNX Expand max-dim semantics: target dim 1 keeps the input
+        dim (regression: plain broadcast_to rejected it)."""
+        x = R.randn(3, 4).astype(np.float32)
+        nodes = [encode_node("Expand", ["x", "s"], ["y"], "e")]
+        got = _run(nodes, {"s": np.asarray([3, 1], np.int64)},
+                   [("x", (3, 4))], [("y", (3, 4))], {"x": x})[0]
+        np.testing.assert_allclose(np.asarray(got), x)
+
+    def test_topk_positive_last_axis(self):
+        """axis given as rank-1 instead of -1 (regression)."""
+        x = R.randn(3, 8).astype(np.float32)
+        nodes = [encode_node("TopK", ["x", "k"], ["v", "i"], "tk",
+                             axis=1)]
+        got = _run(nodes, {"k": np.asarray(2, np.int64)},
+                   [("x", (3, 8))], [("v", (3, 2))], {"x": x})[0]
+        want = np.sort(x, axis=-1)[:, ::-1][:, :2]
+        np.testing.assert_allclose(np.asarray(got), want, atol=1e-6)
+
     def test_scatter_nd(self):
         data = np.zeros((5,), np.float32)
         nodes = [encode_node("ScatterND", ["d", "i", "u"], ["y"], "sc")]
