@@ -1,20 +1,104 @@
 """Video/frame-sequence modality (SURVEY.md V4: `datavec-data-codec`
 — `CodecRecordReader` yielding per-frame sequences).
 
-The reference decodes containers via JavaCPP-ffmpeg; this image has
-no codec libraries, so the native-decode path is gated. Supported
-here: ``.npy``/``.npz`` frame stacks ([t, h, w, c]) — the
-decoded-frames interchange format — with the same sequence-record
-contract downstream transforms consume.
+The reference decodes containers via JavaCPP-ffmpeg; this image has no
+ffmpeg, so decode is done in-repo: a pure-python RIFF parser handles
+``.avi`` containers with uncompressed (DIB/BGR) or MJPEG streams,
+Pillow handles multi-frame ``.gif``/``.tiff``, and ``.npy``/``.npz``
+frame stacks ([t, h, w, c]) remain the interchange format. Same
+sequence-record contract downstream transforms consume.
 """
 from __future__ import annotations
 
+import io
+import struct
 from typing import List
 
 import numpy as np
 
 from .records import SequenceRecordReader
 from .writable import NDArrayWritable
+
+
+def _read_avi_frames(path: str) -> np.ndarray:
+    """Minimal RIFF/AVI demuxer for the two codec-free stream types:
+    biCompression==0 (raw bottom-up BGR) and MJPG (per-frame JPEG,
+    decoded with Pillow). Returns [t, h, w, 3] uint8 RGB."""
+    data = open(path, "rb").read()
+    if data[:4] != b"RIFF" or data[8:12] != b"AVI ":
+        raise ValueError(f"{path}: not an AVI (RIFF) file")
+
+    frames: List[bytes] = []
+    hdr = {}                # w, h, bits, comp of the VIDEO stream
+    last_strh_type = [None]
+
+    def walk(buf, off, end):
+        while off + 8 <= end:
+            fourcc = buf[off:off + 4]
+            size = struct.unpack("<I", buf[off + 4:off + 8])[0]
+            body = off + 8
+            if fourcc in (b"RIFF", b"LIST"):
+                walk(buf, body + 4, body + size)   # skip list type
+            elif fourcc == b"strh" and size >= 4:
+                last_strh_type[0] = buf[body:body + 4]
+            elif fourcc == b"strf" and not hdr and size >= 40 and \
+                    last_strh_type[0] == b"vids":
+                # only the video stream's BITMAPINFOHEADER (an audio
+                # stream's 40-byte WAVEFORMATEXTENSIBLE must not win)
+                (_, w, h, _, bits, comp) = struct.unpack(
+                    "<IiiHHI", buf[body:body + 20])
+                hdr.update(w=w, h=h, bits=bits, comp=comp)
+            elif fourcc[2:4] in (b"db", b"dc") and size > 0:
+                frames.append(buf[body:body + size])
+            off = body + size + (size & 1)         # chunks pad to even
+
+    walk(data, 12, len(data))
+    if not frames:
+        raise ValueError(f"{path}: no video frames found")
+    if not hdr:
+        raise ValueError(f"{path}: no video stream header (strf) "
+                         f"found — damaged AVI?")
+    comp = hdr["comp"]
+    mjpg = struct.unpack("<I", b"MJPG")[0]
+    out = []
+    if comp == 0:                           # raw DIB: bottom-up BGR(A)
+        w, h = hdr["w"], abs(hdr["h"])
+        bits = hdr["bits"]
+        if bits not in (24, 32):
+            raise NotImplementedError(
+                f"{path}: raw AVI with biBitCount={bits} "
+                f"(24/32 supported)")
+        bpp = bits // 8
+        flip = hdr["h"] > 0                 # positive height=bottom-up
+        row = (w * bpp + 3) & ~3            # rows pad to 4 bytes
+        for fb in frames:
+            a = np.frombuffer(fb[:row * h], np.uint8)
+            a = a.reshape(h, row)[:, :w * bpp].reshape(h, w, bpp)
+            a = a[::-1] if flip else a
+            out.append(a[..., 2::-1].copy())  # BGR(A) -> RGB
+    elif comp == mjpg or comp == struct.unpack("<I", b"mjpg")[0]:
+        try:
+            from PIL import Image
+        except Exception as e:              # pragma: no cover
+            raise NotImplementedError(
+                "MJPEG AVI needs Pillow for JPEG decode") from e
+        for fb in frames:
+            img = Image.open(io.BytesIO(fb)).convert("RGB")
+            out.append(np.asarray(img))
+    else:
+        fourcc = struct.pack("<I", comp)
+        raise NotImplementedError(
+            f"{path}: AVI codec {fourcc!r} unsupported (raw DIB and "
+            f"MJPG only in this build; no ffmpeg)")
+    return np.stack(out)
+
+
+def _read_pil_frames(path: str) -> np.ndarray:
+    """Multi-frame GIF/TIFF via Pillow."""
+    from PIL import Image, ImageSequence
+    img = Image.open(path)
+    return np.stack([np.asarray(f.convert("RGB"))
+                     for f in ImageSequence.Iterator(img)])
 
 
 class CodecRecordReader(SequenceRecordReader):
@@ -40,10 +124,14 @@ class CodecRecordReader(SequenceRecordReader):
         if loc.endswith(".npz"):
             z = np.load(loc)
             return z[list(z.files)[0]]
+        if loc.lower().endswith(".avi"):
+            return _read_avi_frames(loc)
+        if loc.lower().endswith((".gif", ".tif", ".tiff")):
+            return _read_pil_frames(loc)
         raise NotImplementedError(
-            f"codec decode for '{loc}': only .npy/.npz frame stacks "
-            "are supported in this build (no ffmpeg in the image); "
-            "pre-extract frames to numpy")
+            f"codec decode for '{loc}': supported containers are "
+            ".avi (raw/MJPEG), .gif/.tiff, and .npy/.npz frame "
+            "stacks (no ffmpeg in this build)")
 
     def _make_iter(self):
         for loc in self.split.locations():

@@ -1,2 +1,3 @@
 from deeplearning4j_tpu.utils.serializer import ModelSerializer  # noqa: F401
-from deeplearning4j_tpu.utils.checkpoint import CheckpointListener  # noqa: F401
+from deeplearning4j_tpu.utils.checkpoint import (  # noqa: F401
+    CheckpointListener, FaultTolerantTrainer)

@@ -1,17 +1,31 @@
-"""CheckpointListener: periodic model saving with rotation.
+"""CheckpointListener + fault-tolerant resumable training.
 
 Reference parity: ``org.deeplearning4j.optimize.listeners.
 CheckpointListener`` (SURVEY.md D7, section 5.4): every N iterations /
-epochs / minutes, keep-last / keep-every rotation.
+epochs / minutes, keep-last / keep-every rotation, plus the static
+checkpoint accessors (``availableCheckpoints`` / ``lastCheckpoint`` /
+``loadCheckpointMLN``). Saves are ATOMIC (tmp + rename) so a crash
+mid-save never corrupts the newest checkpoint on disk.
+
+:class:`FaultTolerantTrainer` is SURVEY.md §5.3's TPU translation of
+the reference's (weak) elasticity guarantees: "elasticity = resumable
+jobs". It restores the newest loadable checkpoint before training and
+skips over corrupt files — a restarted job resumes with optimizer
+state, iteration count, and epoch count intact.
 """
 from __future__ import annotations
 
+import logging
+import os
+import re
 import time
 from pathlib import Path
 from typing import List, Optional
 
 from deeplearning4j_tpu.optimize.listeners import TrainingListener
 from deeplearning4j_tpu.utils.serializer import ModelSerializer
+
+log = logging.getLogger("deeplearning4j_tpu")
 
 
 class CheckpointListener(TrainingListener):
@@ -32,9 +46,15 @@ class CheckpointListener(TrainingListener):
 
     def _save(self, model):
         path = self.dir / f"checkpoint_{self._counter}.zip"
-        ModelSerializer.write_model(model, path)
+        tmp = self.dir / f".checkpoint_{self._counter}.zip.tmp"
+        ModelSerializer.write_model(model, tmp)
+        os.replace(tmp, path)      # atomic: readers never see partials
         self._counter += 1
         self._saved.append(path)
+        # epoch_count increments AFTER on_epoch_end fires, so both
+        # counters are needed to recognize "same state" duplicates
+        self._last_saved_state = (model.iteration_count,
+                                  model.epoch_count)
         self._rotate()
 
     def _rotate(self):
@@ -64,3 +84,103 @@ class CheckpointListener(TrainingListener):
 
     def last_checkpoint(self) -> Optional[Path]:
         return self._saved[-1] if self._saved else None
+
+    # -- static accessors (reference: CheckpointListener statics) --------
+    @staticmethod
+    def available_checkpoints(save_dir) -> List[Path]:
+        """Checkpoints on disk, oldest -> newest (reference:
+        availableCheckpoints)."""
+        d = Path(save_dir)
+        if not d.is_dir():
+            return []
+        def idx(p):
+            m = re.match(r"checkpoint_(\d+)\.zip$", p.name)
+            return int(m.group(1)) if m else -1
+        return sorted((p for p in d.glob("checkpoint_*.zip")
+                       if idx(p) >= 0), key=idx)
+
+    @staticmethod
+    def last_checkpoint_in(save_dir) -> Optional[Path]:
+        cps = CheckpointListener.available_checkpoints(save_dir)
+        return cps[-1] if cps else None
+
+    @staticmethod
+    def load_checkpoint(save_dir_or_path, *, skip_corrupt: bool = True):
+        """Load the newest loadable checkpoint (reference:
+        loadCheckpointMLN/loadLastCheckpointMLN). With ``skip_corrupt``
+        a truncated/partial newest file falls back to the previous one
+        — the §5.3 crash-recovery path."""
+        p = Path(save_dir_or_path)
+        candidates = ([p] if p.is_file()
+                      else list(reversed(
+                          CheckpointListener.available_checkpoints(p))))
+        last_err = None
+        for cp in candidates:
+            try:
+                return ModelSerializer.restore_model(cp)
+            except Exception as e:            # corrupt / partial file
+                last_err = e
+                if not skip_corrupt:
+                    raise
+                log.warning("skipping unreadable checkpoint %s: %s",
+                            cp, e)
+        if last_err is not None:
+            raise last_err
+        return None
+
+
+class FaultTolerantTrainer:
+    """Resumable training loop (SURVEY.md §5.3: checkpoint-restart is
+    the framework's elasticity story, matching the reference's actual
+    guarantees). Restores the newest loadable checkpoint at
+    construction; ``fit`` then trains with periodic atomic checkpoints.
+
+    Usage::
+
+        trainer = FaultTolerantTrainer(lambda: build_net(), "ckpts",
+                                       save_every_n_iterations=100)
+        trainer.fit(train_iter, n_epochs=10)   # safe to re-run after
+                                               # a crash: it resumes
+    """
+
+    def __init__(self, model_factory, save_dir, *,
+                 save_every_n_iterations: int = 0,
+                 save_every_n_epochs: int = 1,
+                 keep_last: int = 3):
+        self.save_dir = Path(save_dir)
+        restored = None
+        if CheckpointListener.available_checkpoints(self.save_dir):
+            restored = CheckpointListener.load_checkpoint(self.save_dir)
+        self.model = restored if restored is not None \
+            else model_factory()
+        self.resumed = restored is not None
+        self._listener = CheckpointListener(
+            self.save_dir,
+            save_every_n_iterations=save_every_n_iterations,
+            save_every_n_epochs=save_every_n_epochs,
+            keep_last=keep_last)
+        # continue numbering after existing checkpoints
+        existing = CheckpointListener.available_checkpoints(
+            self.save_dir)
+        if existing:
+            m = re.match(r"checkpoint_(\d+)\.zip$", existing[-1].name)
+            self._listener._counter = int(m.group(1)) + 1
+            self._listener._saved = list(existing)
+        self.model.add_listeners(self._listener)
+
+    def fit(self, data, *, n_epochs: int = 1):
+        """Train until ``n_epochs`` TOTAL epochs are done — a resumed
+        job runs only the remaining epochs, so crash + re-run converges
+        to the same amount of training as an uncrashed run."""
+        remaining = n_epochs - self.model.epoch_count
+        if remaining <= 0:
+            log.info("fit: %d epochs already done, nothing to do",
+                     self.model.epoch_count)
+            return self.model
+        self.model.fit(data, n_epochs=remaining)
+        # final checkpoint — skipped when the epoch-end listener just
+        # saved this exact state (don't burn a rotation slot on a dup)
+        state = (self.model.iteration_count, self.model.epoch_count)
+        if getattr(self._listener, "_last_saved_state", None) != state:
+            self._listener._save(self.model)
+        return self.model

@@ -112,6 +112,60 @@ class TestCodec:
         with pytest.raises(NotImplementedError, match="ffmpeg"):
             rr.next_sequence()
 
+    @staticmethod
+    def _write_raw_avi(path, frames_rgb):
+        """Minimal RIFF/AVI with uncompressed bottom-up BGR frames
+        (includes a strh 'vids' header like real muxers)."""
+        import struct
+        t, h, w, _ = frames_rgb.shape
+        row = (w * 3 + 3) & ~3
+        strh = b"vids" + b"DIB " + b"\0" * 48
+        strf = struct.pack("<IiiHHI", 40, w, h, 1, 24, 0) + b"\0" * 20
+
+        def chunk(fourcc, body):
+            pad = b"\0" if len(body) % 2 else b""
+            return fourcc + struct.pack("<I", len(body)) + body + pad
+
+        movi_frames = b""
+        for f in frames_rgb:
+            bgr = f[..., ::-1]
+            rows = b"".join(
+                bgr[y].tobytes() + b"\0" * (row - w * 3)
+                for y in range(h - 1, -1, -1))   # bottom-up
+            movi_frames += chunk(b"00db", rows)
+        strl = b"strl" + chunk(b"strh", strh) + chunk(b"strf", strf)
+        hdrl = b"hdrl" + chunk(b"LIST", strl)
+        movi = b"movi" + movi_frames
+        body = b"AVI " + chunk(b"LIST", hdrl) + chunk(b"LIST", movi)
+        with open(path, "wb") as fp:
+            fp.write(b"RIFF" + struct.pack("<I", len(body)) + body)
+
+    def test_raw_avi_frames(self, tmp_path):
+        frames = np.random.RandomState(1).randint(
+            0, 255, (4, 6, 5, 3), dtype=np.uint8)
+        self._write_raw_avi(tmp_path / "clip.avi", frames)
+        rr = CodecRecordReader()
+        rr.initialize(FileSplit(str(tmp_path), ["avi"]))
+        seq = rr.next_sequence()
+        assert len(seq) == 4
+        np.testing.assert_array_equal(seq[0][0].value, frames[0])
+        np.testing.assert_array_equal(seq[3][0].value, frames[3])
+
+    def test_gif_frames(self, tmp_path):
+        pil = pytest.importorskip("PIL.Image")
+        rng = np.random.RandomState(2)
+        frames = rng.randint(0, 255, (3, 8, 8, 3), dtype=np.uint8)
+        imgs = [pil.fromarray(f) for f in frames]
+        imgs[0].save(tmp_path / "anim.gif", save_all=True,
+                     append_images=imgs[1:], duration=100, loop=0)
+        rr = CodecRecordReader()
+        rr.initialize(FileSplit(str(tmp_path), ["gif"]))
+        seq = rr.next_sequence()
+        assert len(seq) == 3
+        # GIF palettizes to 256 colors; frames survive approximately
+        got = np.stack([s[0].value for s in seq]).astype(np.int32)
+        assert np.abs(got - frames.astype(np.int32)).mean() < 16
+
 
 class TestTextVectorizers:
     CORPUS = ["the cat sat on the mat",

@@ -1,0 +1,134 @@
+"""Checkpoint-restart fault tolerance (SURVEY.md §5.3/§5.4: resumable
+jobs are the elasticity guarantee; reference test style:
+TestCheckpointListener)."""
+import os
+import zipfile
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.activations import Activation
+from deeplearning4j_tpu.learning import Adam
+from deeplearning4j_tpu.lossfunctions import LossFunction
+from deeplearning4j_tpu.nn import (InputType, MultiLayerNetwork,
+                                   NeuralNetConfiguration)
+from deeplearning4j_tpu.nn.conf.layers import DenseLayer, OutputLayer
+from deeplearning4j_tpu.utils import (CheckpointListener,
+                                      FaultTolerantTrainer)
+
+
+def _factory():
+    conf = (NeuralNetConfiguration.Builder()
+            .seed(0).updater(Adam(1e-2))
+            .list()
+            .layer(DenseLayer(n_out=8, activation=Activation.RELU))
+            .layer(OutputLayer(n_out=2,
+                               loss_function=LossFunction.MCXENT,
+                               activation=Activation.SOFTMAX))
+            .set_input_type(InputType.feed_forward(4))
+            .build())
+    return MultiLayerNetwork(conf).init()
+
+
+def _data(n=32, seed=0):
+    rng = np.random.RandomState(seed)
+    x = rng.randn(n, 4).astype(np.float32)
+    y = np.eye(2, dtype=np.float32)[(x[:, 0] > 0).astype(int)]
+    return x, y
+
+
+class TestCheckpointAccessors:
+    def test_available_and_last(self, tmp_path):
+        net = _factory()
+        x, y = _data()
+        lis = CheckpointListener(tmp_path, save_every_n_iterations=2)
+        net.set_listeners(lis)
+        for _ in range(6):
+            net.fit(x, y)
+        cps = CheckpointListener.available_checkpoints(tmp_path)
+        assert len(cps) == 3
+        assert CheckpointListener.last_checkpoint_in(tmp_path) == cps[-1]
+        restored = CheckpointListener.load_checkpoint(tmp_path)
+        assert restored.iteration_count == 6
+
+    def test_corrupt_newest_falls_back(self, tmp_path):
+        net = _factory()
+        x, y = _data()
+        lis = CheckpointListener(tmp_path, save_every_n_iterations=2)
+        net.set_listeners(lis)
+        for _ in range(4):
+            net.fit(x, y)
+        cps = CheckpointListener.available_checkpoints(tmp_path)
+        assert len(cps) == 2
+        # simulate crash-truncated newest checkpoint
+        with open(cps[-1], "r+b") as f:
+            f.truncate(100)
+        restored = CheckpointListener.load_checkpoint(tmp_path)
+        assert restored.iteration_count == 2   # fell back to older
+        with pytest.raises(Exception):
+            CheckpointListener.load_checkpoint(cps[-1],
+                                               skip_corrupt=False)
+
+
+class TestFaultTolerantTrainer:
+    def test_resume_continues_counters_and_params(self, tmp_path):
+        x, y = _data(64)
+
+        class OneEpoch:
+            """8-batch iterator."""
+            def __init__(self):
+                self._i = 0
+            def reset(self):
+                self._i = 0
+            def __iter__(self):
+                from deeplearning4j_tpu.datasets.dataset import DataSet
+                for i in range(8):
+                    yield DataSet(x[i * 8:(i + 1) * 8],
+                                  y[i * 8:(i + 1) * 8])
+
+        t1 = FaultTolerantTrainer(_factory, tmp_path,
+                                  save_every_n_epochs=1)
+        assert not t1.resumed
+        t1.fit(OneEpoch(), n_epochs=2)
+        it1 = t1.model.iteration_count
+        assert it1 == 16
+
+        # "restart the job": new trainer on the same dir resumes
+        t2 = FaultTolerantTrainer(_factory, tmp_path,
+                                  save_every_n_epochs=1)
+        assert t2.resumed
+        assert t2.model.iteration_count == it1
+        w1 = np.asarray(t1.model.params["layer_0"]["W"])
+        w2 = np.asarray(t2.model.params["layer_0"]["W"])
+        np.testing.assert_array_equal(w1, w2)
+        # n_epochs is the TOTAL target: re-running the crashed job's
+        # fit(n_epochs=2) does nothing; asking for 3 runs ONE more
+        t2.fit(OneEpoch(), n_epochs=2)
+        assert t2.model.iteration_count == it1       # already done
+        t2.fit(OneEpoch(), n_epochs=3)
+        assert t2.model.iteration_count == 24
+
+    def test_checkpoint_numbering_continues(self, tmp_path):
+        x, y = _data()
+        t1 = FaultTolerantTrainer(_factory, tmp_path,
+                                  save_every_n_epochs=1)
+        t1.fit([_ds(x, y)], n_epochs=1)
+        names1 = {p.name for p in
+                  CheckpointListener.available_checkpoints(tmp_path)}
+        t2 = FaultTolerantTrainer(_factory, tmp_path,
+                                  save_every_n_epochs=1)
+        t2.fit([_ds(x, y)], n_epochs=2)
+        names2 = {p.name for p in
+                  CheckpointListener.available_checkpoints(tmp_path)}
+        # numbering continues upward (no clobbering); rotation may trim
+        # the oldest files
+        def top(names):
+            return max(int(n.split("_")[1].split(".")[0])
+                       for n in names)
+        assert top(names2) > top(names1)
+        assert names2 - names1      # genuinely new checkpoints exist
+
+
+def _ds(x, y):
+    from deeplearning4j_tpu.datasets.dataset import DataSet
+    return DataSet(x, y)
