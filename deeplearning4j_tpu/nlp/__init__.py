@@ -15,6 +15,7 @@ from .tokenization import (BertWordPieceTokenizer, DefaultTokenizer,
                            CommonPreprocessor)
 from .vocab import VocabCache, build_vocab
 from .word2vec import ParagraphVectors, SequenceVectors, Word2Vec
+from .glove import Glove
 from .bert_iterator import BertIterator
 from .serializer import (StaticWordVectors, read_word2vec_model,
                          read_word_vectors, write_word2vec_model,
@@ -23,6 +24,7 @@ from .serializer import (StaticWordVectors, read_word2vec_model,
 __all__ = ["DefaultTokenizer", "DefaultTokenizerFactory",
            "CommonPreprocessor", "BertWordPieceTokenizer",
            "VocabCache", "build_vocab", "Word2Vec", "SequenceVectors",
+           "Glove",
            "ParagraphVectors", "BertIterator",
            "write_word_vectors", "read_word_vectors",
            "write_word2vec_model", "read_word2vec_model",

@@ -1,0 +1,188 @@
+"""GloVe embeddings (reference: ``org.deeplearning4j.models.glove.
+Glove`` — co-occurrence-matrix factorization with AdaGrad, SURVEY.md
+D16).
+
+TPU-first: the reference trains per-pair on JVM threads with an
+AdaGrad inner loop; here one jitted step processes a [batch] of
+non-zero co-occurrence entries — gathers, the weighted-least-squares
+loss f(x)(w_i·w̃_j + b_i + b̃_j − log x)², and scatter-add AdaGrad
+updates — fused by XLA. Co-occurrence accumulation (sparse,
+data-dependent) stays host-side, like the reference's
+AbstractCoOccurrences pass.
+"""
+from __future__ import annotations
+
+from typing import Dict, Iterable, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .vocab import build_vocab
+from .word2vec import SequenceVectors
+
+
+def _glove_step(state, rows, cols, logx, fx, lr):
+    """One AdaGrad step over a batch of co-occurrence entries."""
+    w, wc, b, bc, gw, gwc, gb, gbc = state
+
+    def loss_fn(w, wc, b, bc):
+        diff = (jnp.sum(w[rows] * wc[cols], -1) + b[rows] + bc[cols]
+                - logx)
+        return jnp.sum(fx * diff * diff)
+
+    loss, grads = jax.value_and_grad(loss_fn, argnums=(0, 1, 2, 3))(
+        w, wc, b, bc)
+    out = []
+    for p, g, acc in ((w, grads[0], gw), (wc, grads[1], gwc),
+                      (b, grads[2], gb), (bc, grads[3], gbc)):
+        acc = acc + g * g
+        p = p - lr * g / jnp.sqrt(acc + 1e-8)
+        out.extend([p, acc])
+    new_state = (out[0], out[2], out[4], out[6],
+                 out[1], out[3], out[5], out[7])
+    return new_state, loss
+
+
+class Glove(SequenceVectors):
+    """GloVe trainer with the reference's builder surface
+    (``xMax``/``alpha``/``learningRate``/``epochs``/...); shares the
+    WordVectors lookup/similarity API via SequenceVectors."""
+
+    def __init__(self, layer_size=64, window_size=5, x_max=100.0,
+                 alpha=0.75, learning_rate=0.05, epochs=5,
+                 batch_size=2048, min_word_frequency=1, seed=12345,
+                 symmetric=True, tokenizer_factory=None):
+        super().__init__(layer_size=layer_size, window_size=window_size,
+                         learning_rate=learning_rate, epochs=epochs,
+                         batch_size=batch_size,
+                         min_word_frequency=min_word_frequency,
+                         seed=seed,
+                         tokenizer_factory=tokenizer_factory)
+        self.x_max = float(x_max)
+        self.alpha = float(alpha)
+        self.symmetric = bool(symmetric)
+        self._glove_jit = jax.jit(_glove_step)
+
+    # -- builder (reference API shape) -----------------------------------
+    class Builder:
+        def __init__(self):
+            self._kw = {}
+            self._sentences = None
+
+        def iterate(self, sentences):
+            self._sentences = sentences
+            return self
+
+        def layer_size(self, v):
+            self._kw["layer_size"] = int(v)
+            return self
+
+        def window_size(self, v):
+            self._kw["window_size"] = int(v)
+            return self
+
+        def x_max(self, v):
+            self._kw["x_max"] = float(v)
+            return self
+
+        def alpha(self, v):
+            self._kw["alpha"] = float(v)
+            return self
+
+        def learning_rate(self, v):
+            self._kw["learning_rate"] = float(v)
+            return self
+
+        def epochs(self, v):
+            self._kw["epochs"] = int(v)
+            return self
+
+        def batch_size(self, v):
+            self._kw["batch_size"] = int(v)
+            return self
+
+        def min_word_frequency(self, v):
+            self._kw["min_word_frequency"] = int(v)
+            return self
+
+        def symmetric(self, v):
+            self._kw["symmetric"] = bool(v)
+            return self
+
+        def seed(self, v):
+            self._kw["seed"] = int(v)
+            return self
+
+        def build(self) -> "Glove":
+            g = Glove(**self._kw)
+            g._sentences = self._sentences
+            return g
+
+    # -- co-occurrence accumulation (reference: AbstractCoOccurrences) ---
+    def _cooccurrences(self, seqs: List[List[str]]) -> Tuple[np.ndarray,
+                                                             np.ndarray,
+                                                             np.ndarray]:
+        counts: Dict[Tuple[int, int], float] = {}
+        for toks in seqs:
+            ids = [self.vocab.id_of(t) for t in toks
+                   if t in self.vocab]
+            for i, ci in enumerate(ids):
+                for j in range(i + 1, min(len(ids),
+                                          i + 1 + self.window_size)):
+                    w = 1.0 / (j - i)          # distance weighting
+                    a, b = ci, ids[j]
+                    counts[(a, b)] = counts.get((a, b), 0.0) + w
+                    if self.symmetric:
+                        counts[(b, a)] = counts.get((b, a), 0.0) + w
+        rows = np.fromiter((k[0] for k in counts), np.int32,
+                           len(counts))
+        cols = np.fromiter((k[1] for k in counts), np.int32,
+                           len(counts))
+        vals = np.fromiter(counts.values(), np.float32, len(counts))
+        return rows, cols, vals
+
+    # -- training --------------------------------------------------------
+    def fit(self, sentences: Optional[Iterable] = None) -> "Glove":
+        sentences = sentences if sentences is not None \
+            else getattr(self, "_sentences", None)
+        seqs = self._tokenize_corpus(sentences)
+        self.vocab = build_vocab(seqs, self.min_word_frequency)
+        n = len(self.vocab)
+        rows, cols, vals = self._cooccurrences(seqs)
+        if rows.size == 0:
+            raise ValueError("empty co-occurrence matrix (corpus too "
+                             "small for the vocab/window settings)")
+        logx = np.log(vals)
+        fx = np.minimum(1.0, (vals / self.x_max) ** self.alpha) \
+            .astype(np.float32)
+
+        rng = np.random.RandomState(self.seed)
+        d = self.layer_size
+        def init(shape):
+            return ((rng.rand(*shape) - 0.5) / d).astype(np.float32)
+        state = (jnp.asarray(init((n, d))), jnp.asarray(init((n, d))),
+                 jnp.zeros(n, jnp.float32), jnp.zeros(n, jnp.float32),
+                 jnp.zeros((n, d), jnp.float32),
+                 jnp.zeros((n, d), jnp.float32),
+                 jnp.zeros(n, jnp.float32), jnp.zeros(n, jnp.float32))
+
+        nnz = rows.size
+        bs = min(self.batch_size, nnz)
+        for _ in range(self.epochs):
+            order = rng.permutation(nnz)
+            for s in range(0, nnz, bs):
+                sel = order[s:s + bs]
+                if len(sel) < bs:              # pad to a fixed shape
+                    sel = np.concatenate(
+                        [sel, rng.choice(nnz, bs - len(sel))])
+                state, _ = self._glove_jit(
+                    state, jnp.asarray(rows[sel]),
+                    jnp.asarray(cols[sel]), jnp.asarray(logx[sel]),
+                    jnp.asarray(fx[sel]),
+                    jnp.float32(self.learning_rate))
+        # final embedding: w + w̃ (the GloVe paper's recommendation,
+        # which the reference follows)
+        self.syn0 = np.asarray(state[0]) + np.asarray(state[1])
+        self.syn1 = np.asarray(state[1])
+        return self

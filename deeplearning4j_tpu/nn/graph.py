@@ -268,9 +268,11 @@ class ComputationGraph:
                 data.reset()
             for ds in data:
                 self._fit_dataset(ds)
+            # epochs-completed advances BEFORE listeners (see
+            # MultiLayerNetwork.fit: checkpoint-resume correctness)
+            self.epoch_count += 1
             for lis in self.listeners:
                 lis.on_epoch_end(self)
-            self.epoch_count += 1
         return self
 
     def _next_rng(self):

@@ -276,9 +276,12 @@ class MultiLayerNetwork:
                 self._fit_batch(ds.features, ds.labels,
                                 getattr(ds, "features_mask", None),
                                 getattr(ds, "labels_mask", None))
+            # epochs-completed count advances BEFORE listeners fire:
+            # an epoch-end checkpoint then serializes the true count
+            # (a resumed job must not retrain a finished epoch)
+            self.epoch_count += 1
             for lis in self.listeners:
                 lis.on_epoch_end(self)
-            self.epoch_count += 1
         return self
 
     # ------------------------------------------------------------------

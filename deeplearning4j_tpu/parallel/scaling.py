@@ -1,0 +1,89 @@
+"""Scaling-efficiency measurement harness (BASELINE.md protocol step 3
+for the north-star metric: aggregate throughput at 8..256 chips,
+efficiency = (aggregate at N / aggregate at 8) * (8/N), pass >= 0.70
+at N=256).
+
+Runs the data-parallel train step on meshes built from device SUBSETS
+(the same chips-per-run discipline a pod sweep uses), times a fixed
+number of steps with a device-resident per-chip batch, and reports
+per-size throughput + efficiency relative to the smallest size. On a
+virtual CPU mesh the numbers validate only the MACHINERY — real
+efficiency comes from an ICI-connected pod run of this same function.
+"""
+from __future__ import annotations
+
+import time
+from typing import Callable, Dict, List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from deeplearning4j_tpu.parallel.mesh import DEFAULT_DATA_AXIS, make_mesh
+from deeplearning4j_tpu.parallel.wrapper import ParallelWrapper
+
+
+def measure_dp_scaling(model_factory: Callable[[], object],
+                       make_batch: Callable[[int], object],
+                       chip_counts: Sequence[int],
+                       *, per_chip_batch: int = 8, steps: int = 10,
+                       warmup: int = 2,
+                       devices: Optional[Sequence] = None) -> Dict:
+    """Time DP training at each mesh size (weak scaling: the per-chip
+    batch stays constant, the pod protocol).
+
+    - ``model_factory()`` -> a fresh MultiLayerNetwork/ComputationGraph
+    - ``make_batch(global_batch)`` -> a DataSet of that many examples
+    - ``chip_counts`` e.g. (1, 2, 4, 8) locally; (8, 32, 64, 128, 256)
+      on a pod.
+
+    Returns {"sizes": [...], "throughput": {n: examples/sec},
+    "efficiency": {n: eff vs smallest}, "base": n0}.
+    """
+    devices = list(devices if devices is not None else jax.devices())
+    sizes = [int(n) for n in chip_counts if n <= len(devices)]
+    if not sizes:
+        raise ValueError(f"no chip_counts fit {len(devices)} devices")
+    throughput: Dict[int, float] = {}
+    for n in sizes:
+        mesh = make_mesh({DEFAULT_DATA_AXIS: n}, devices=devices[:n])
+        net = model_factory()
+        pw = ParallelWrapper(net, mesh)
+        ds = make_batch(n * per_chip_batch)
+        for _ in range(warmup):
+            pw.fit_batch(ds)
+        _sync(net)
+        t0 = time.perf_counter()
+        for _ in range(steps):
+            pw.fit_batch(ds)
+        _sync(net)
+        dt = time.perf_counter() - t0
+        global_batch = _batch_size(ds)
+        throughput[n] = steps * global_batch / dt
+    base = min(sizes)
+    efficiency = {n: (throughput[n] / throughput[base]) * (base / n)
+                  for n in sizes}
+    return {"sizes": sizes, "throughput": throughput,
+            "efficiency": efficiency, "base": base}
+
+
+def _batch_size(ds) -> int:
+    f = ds.features
+    f = f[0] if isinstance(f, (list, tuple)) else f
+    return int(np.asarray(f.shape[0]))
+
+
+def _sync(net):
+    jax.block_until_ready(net.params)
+    s = net.score() if callable(getattr(net, "score", None)) else None
+    if s is not None:
+        float(s)
+
+
+def scaling_report(result: Dict) -> str:
+    """Human-readable table (the BASELINE.md step-3 artifact)."""
+    lines = [f"{'chips':>6} {'examples/sec':>14} {'efficiency':>11}"]
+    for n in result["sizes"]:
+        lines.append(f"{n:>6} {result['throughput'][n]:>14.1f} "
+                     f"{result['efficiency'][n]:>10.1%}")
+    return "\n".join(lines)

@@ -164,9 +164,9 @@ class ParallelWrapper:
                 lis.on_epoch_start(self.model)
             for ds in iterator:
                 self.model.fit(shard_fn(ds))
+            self.model.epoch_count += 1
             for lis in self.model.listeners:
                 lis.on_epoch_end(self.model)
-            self.model.epoch_count += 1
         return self
 
     def fit_batch(self, ds):

@@ -51,8 +51,6 @@ class CheckpointListener(TrainingListener):
         os.replace(tmp, path)      # atomic: readers never see partials
         self._counter += 1
         self._saved.append(path)
-        # epoch_count increments AFTER on_epoch_end fires, so both
-        # counters are needed to recognize "same state" duplicates
         self._last_saved_state = (model.iteration_count,
                                   model.epoch_count)
         self._rotate()
@@ -79,7 +77,8 @@ class CheckpointListener(TrainingListener):
             self._last_save_time = time.time()
 
     def on_epoch_end(self, model):
-        if self.n_epoch > 0 and (model.epoch_count + 1) % self.n_epoch == 0:
+        # epoch_count is epochs COMPLETED by the time listeners fire
+        if self.n_epoch > 0 and model.epoch_count % self.n_epoch == 0:
             self._save(model)
 
     def last_checkpoint(self) -> Optional[Path]:

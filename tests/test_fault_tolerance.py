@@ -108,6 +108,27 @@ class TestFaultTolerantTrainer:
         t2.fit(OneEpoch(), n_epochs=3)
         assert t2.model.iteration_count == 24
 
+    def test_crash_before_final_save_does_not_retrain(self, tmp_path):
+        """Epoch-end checkpoints carry the TRUE epochs-completed count:
+        even without fit()'s final save, resume must not rerun a
+        finished epoch (regression: listener fired before epoch_count
+        incremented, persisting a stale count)."""
+        x, y = _data()
+        t1 = FaultTolerantTrainer(_factory, tmp_path,
+                                  save_every_n_epochs=1)
+        t1.fit([_ds(x, y)], n_epochs=2)
+        it_done = t1.model.iteration_count
+        # simulate a crash AFTER the last epoch-end save but BEFORE
+        # any later work: drop every checkpoint except the newest
+        # epoch-end one, then "re-run the job"
+        cps = CheckpointListener.available_checkpoints(tmp_path)
+        restored = CheckpointListener.load_checkpoint(cps[-1])
+        assert restored.epoch_count == 2       # true epochs completed
+        t2 = FaultTolerantTrainer(_factory, tmp_path,
+                                  save_every_n_epochs=1)
+        t2.fit([_ds(x, y)], n_epochs=2)        # identical re-run
+        assert t2.model.iteration_count == it_done   # nothing retrained
+
     def test_checkpoint_numbering_continues(self, tmp_path):
         x, y = _data()
         t1 = FaultTolerantTrainer(_factory, tmp_path,

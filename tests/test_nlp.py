@@ -183,3 +183,38 @@ class TestBertIterator:
         assert b["labels"].shape == (4, 2)
         assert (b["labels"].sum(1) == 1).all()
         assert "mlm_labels" not in b
+
+
+class TestGlove:
+    def _corpus(self):
+        # two topical clusters so co-occurrence separates them
+        animals = "the cat chased the dog while the dog chased the cat"
+        royals = "the king ruled the queen and the queen ruled the king"
+        return ([animals] * 20 + [royals] * 20 +
+                ["cat and dog are animals"] * 10 +
+                ["king and queen are royals"] * 10)
+
+    def test_trains_and_clusters(self):
+        from deeplearning4j_tpu.nlp import Glove
+        g = (Glove.Builder()
+             .iterate(self._corpus())
+             .layer_size(16).window_size(4)
+             .learning_rate(0.05).epochs(60).seed(7)
+             .build())
+        g.fit()
+        assert g.has_word("cat") and g.has_word("king")
+        # within-topic similarity beats cross-topic
+        assert g.similarity("cat", "dog") > g.similarity("cat", "queen")
+        assert g.similarity("king", "queen") > \
+            g.similarity("king", "dog")
+
+    def test_vectors_finite_and_lookup_api(self):
+        from deeplearning4j_tpu.nlp import Glove
+        g = (Glove.Builder().iterate(self._corpus())
+             .layer_size(8).epochs(5).build())
+        g.fit()
+        v = g.get_word_vector("cat")
+        assert v.shape == (8,)
+        assert np.isfinite(v).all()
+        near = g.words_nearest("cat", 3)
+        assert len(near) == 3 and "cat" not in near

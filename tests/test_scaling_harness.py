@@ -1,0 +1,52 @@
+"""Scaling-efficiency measurement harness (BASELINE.md step 3
+machinery, validated on the virtual 8-device CPU mesh — real numbers
+come from running the same function on an ICI pod)."""
+import numpy as np
+
+from deeplearning4j_tpu.activations import Activation
+from deeplearning4j_tpu.datasets.dataset import DataSet
+from deeplearning4j_tpu.learning import Sgd
+from deeplearning4j_tpu.lossfunctions import LossFunction
+from deeplearning4j_tpu.nn import (InputType, MultiLayerNetwork,
+                                   NeuralNetConfiguration)
+from deeplearning4j_tpu.nn.conf.layers import DenseLayer, OutputLayer
+from deeplearning4j_tpu.parallel.scaling import (measure_dp_scaling,
+                                                 scaling_report)
+
+
+def _factory():
+    conf = (NeuralNetConfiguration.Builder()
+            .seed(0).updater(Sgd(1e-2))
+            .list()
+            .layer(DenseLayer(n_out=16, activation=Activation.RELU))
+            .layer(OutputLayer(n_out=3,
+                               loss_function=LossFunction.MCXENT,
+                               activation=Activation.SOFTMAX))
+            .set_input_type(InputType.feed_forward(8))
+            .build())
+    return MultiLayerNetwork(conf).init()
+
+
+def _make_batch(global_batch):
+    rng = np.random.RandomState(0)
+    x = rng.randn(global_batch, 8).astype(np.float32)
+    y = np.eye(3, dtype=np.float32)[rng.randint(0, 3, global_batch)]
+    return DataSet(x, y)
+
+
+def test_measures_all_sizes_and_reports():
+    res = measure_dp_scaling(_factory, _make_batch, (1, 2, 4, 8),
+                             per_chip_batch=4, steps=3, warmup=1)
+    assert res["sizes"] == [1, 2, 4, 8]
+    assert res["base"] == 1
+    for n in res["sizes"]:
+        assert res["throughput"][n] > 0
+    assert res["efficiency"][1] == 1.0
+    report = scaling_report(res)
+    assert "chips" in report and "8" in report
+
+
+def test_oversized_counts_skipped():
+    res = measure_dp_scaling(_factory, _make_batch, (2, 4, 1024),
+                             per_chip_batch=4, steps=2, warmup=1)
+    assert res["sizes"] == [2, 4]      # 1024 > virtual mesh size
