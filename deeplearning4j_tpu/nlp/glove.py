@@ -114,6 +114,10 @@ class Glove(SequenceVectors):
             self._kw["seed"] = int(v)
             return self
 
+        def tokenizer_factory(self, v):
+            self._kw["tokenizer_factory"] = v
+            return self
+
         def build(self) -> "Glove":
             g = Glove(**self._kw)
             g._sentences = self._sentences

@@ -124,12 +124,11 @@ class CapsuleLayer(Layer):
             s = jnp.einsum("bio,biok->bok", c, u_hat)
             v = _squash(s)
             if it < self.routings - 1:
-                # agreement: routing towards capsules whose output aligns
-                # with the prediction; u_hat is gradient-stopped in the
-                # update like the reference's routing (only the last
-                # iteration backprops through predictions)
-                logits = logits + jnp.einsum(
-                    "biok,bok->bio", jax.lax.stop_gradient(u_hat), v)
+                # agreement: routing towards capsules whose output
+                # aligns with the prediction; fully differentiable
+                # (the reference's SameDiff routing loop backprops
+                # through every iteration)
+                logits = logits + jnp.einsum("biok,bok->bio", u_hat, v)
         return v, state
 
     def get_output_type(self, input_type):

@@ -13,10 +13,9 @@ efficiency comes from an ICI-connected pod run of this same function.
 from __future__ import annotations
 
 import time
-from typing import Callable, Dict, List, Optional, Sequence
+from typing import Callable, Dict, Optional, Sequence
 
 import jax
-import jax.numpy as jnp
 import numpy as np
 
 from deeplearning4j_tpu.parallel.mesh import DEFAULT_DATA_AXIS, make_mesh

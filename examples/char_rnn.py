@@ -1,0 +1,69 @@
+"""GravesLSTM character RNN with tBPTT + temperature sampling
+(BASELINE config #3; reference example: LSTMCharModellingExample)."""
+import os
+import sys
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+import numpy as np
+
+from deeplearning4j_tpu.activations import Activation
+from deeplearning4j_tpu.learning import Adam
+from deeplearning4j_tpu.lossfunctions import LossFunction
+from deeplearning4j_tpu.nn import (InputType, MultiLayerNetwork,
+                                   NeuralNetConfiguration)
+from deeplearning4j_tpu.nn.conf.builders import BackpropType
+from deeplearning4j_tpu.nn.conf.layers import RnnOutputLayer
+from deeplearning4j_tpu.nn.conf.layers_recurrent import GravesLSTM
+
+TEXT = ("the quick brown fox jumps over the lazy dog. "
+        "pack my box with five dozen liquor jugs. ") * 40
+
+
+def main(epochs=20, seq_len=32, hidden=64):
+    chars = sorted(set(TEXT))
+    idx = {c: i for i, c in enumerate(chars)}
+    n = len(chars)
+
+    # [b, t] one-hot sequences: predict the next character
+    ids = np.asarray([idx[c] for c in TEXT], np.int32)
+    starts = np.arange(0, len(ids) - seq_len - 1, seq_len)
+    x = np.stack([np.eye(n, dtype=np.float32)[ids[s:s + seq_len]]
+                  for s in starts])
+    y = np.stack([np.eye(n, dtype=np.float32)[ids[s + 1:s + seq_len + 1]]
+                  for s in starts])
+
+    conf = (NeuralNetConfiguration.Builder()
+            .seed(12345)
+            .updater(Adam(5e-3))
+            .list()
+            .layer(GravesLSTM(n_out=hidden,
+                              activation=Activation.TANH))
+            .layer(RnnOutputLayer(n_out=n,
+                                  loss_function=LossFunction.MCXENT,
+                                  activation=Activation.SOFTMAX))
+            .set_input_type(InputType.recurrent(n, seq_len))
+            .backprop_type(BackpropType.TRUNCATED_BPTT)
+            .t_bptt_length(16)
+            .build())
+    net = MultiLayerNetwork(conf).init()
+    for ep in range(epochs):
+        net.fit(x, y)
+        if ep % 5 == 0:
+            print(f"epoch {ep}: loss {float(net.score()):.4f}")
+
+    # sampling: stateful rnn_time_step, one char at a time
+    rng = np.random.RandomState(0)
+    net.rnn_clear_previous_state()
+    cur = np.eye(n, dtype=np.float32)[idx["t"]][None, None]
+    out = ["t"]
+    for _ in range(60):
+        probs = np.asarray(net.rnn_time_step(cur))[0, 0]
+        c = rng.choice(n, p=probs / probs.sum())
+        out.append(chars[c])
+        cur = np.eye(n, dtype=np.float32)[c][None, None]
+    print("sample:", "".join(out))
+    return float(net.score())
+
+
+if __name__ == "__main__":
+    main()

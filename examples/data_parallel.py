@@ -1,0 +1,48 @@
+"""Data-parallel training over a device mesh (reference example:
+ParallelWrapper multi-GPU training; here pjit DP over jax devices —
+run with XLA_FLAGS=--xla_force_host_platform_device_count=8
+JAX_PLATFORMS=cpu for a virtual 8-device mesh)."""
+import os
+import sys
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+import numpy as np
+
+from deeplearning4j_tpu.activations import Activation
+from deeplearning4j_tpu.datasets.dataset import DataSet
+from deeplearning4j_tpu.learning import Adam
+from deeplearning4j_tpu.lossfunctions import LossFunction
+from deeplearning4j_tpu.nn import (InputType, MultiLayerNetwork,
+                                   NeuralNetConfiguration)
+from deeplearning4j_tpu.nn.conf.layers import DenseLayer, OutputLayer
+from deeplearning4j_tpu.parallel.wrapper import ParallelWrapper
+
+
+def main():
+    import jax
+    rng = np.random.RandomState(0)
+    x = rng.randn(512, 16).astype(np.float32)
+    y = np.eye(4, dtype=np.float32)[rng.randint(0, 4, 512)]
+
+    conf = (NeuralNetConfiguration.Builder()
+            .seed(0).updater(Adam(1e-2))
+            .list()
+            .layer(DenseLayer(n_out=64, activation=Activation.RELU))
+            .layer(OutputLayer(n_out=4,
+                               loss_function=LossFunction.MCXENT,
+                               activation=Activation.SOFTMAX))
+            .set_input_type(InputType.feed_forward(16))
+            .build())
+    net = MultiLayerNetwork(conf).init()
+    pw = (ParallelWrapper.Builder(net)
+          .workers(len(jax.devices()))
+          .build())
+    for _ in range(20):
+        pw.fit_batch(DataSet(x, y))
+    print(f"devices: {len(jax.devices())}, "
+          f"loss: {float(net.score()):.4f}")
+    return float(net.score())
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,65 @@
+"""LeNet-5 on MNIST: the minimal end-to-end slice (BASELINE config #1;
+reference example: LeNetMNIST). Uses the synthetic-MNIST fallback when
+the real files are absent (zero-egress environments)."""
+import os
+import sys
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+import numpy as np
+
+from deeplearning4j_tpu.activations import Activation
+from deeplearning4j_tpu.datasets.mnist import MnistDataSetIterator
+from deeplearning4j_tpu.evaluation import Evaluation
+from deeplearning4j_tpu.learning import Adam
+from deeplearning4j_tpu.lossfunctions import LossFunction
+from deeplearning4j_tpu.nn import (InputType, MultiLayerNetwork,
+                                   NeuralNetConfiguration)
+from deeplearning4j_tpu.nn.conf.layers import (ConvolutionLayer,
+                                               DenseLayer, OutputLayer,
+                                               PoolingType,
+                                               SubsamplingLayer)
+from deeplearning4j_tpu.optimize.listeners import ScoreIterationListener
+from deeplearning4j_tpu.utils import ModelSerializer
+
+
+def build():
+    return (NeuralNetConfiguration.Builder()
+            .seed(123)
+            .updater(Adam(1e-3))
+            .list()
+            .layer(ConvolutionLayer(kernel_size=(5, 5), n_out=20,
+                                    activation=Activation.RELU))
+            .layer(SubsamplingLayer(pooling_type=PoolingType.MAX,
+                                    kernel_size=(2, 2), stride=(2, 2)))
+            .layer(ConvolutionLayer(kernel_size=(5, 5), n_out=50,
+                                    activation=Activation.RELU))
+            .layer(SubsamplingLayer(pooling_type=PoolingType.MAX,
+                                    kernel_size=(2, 2), stride=(2, 2)))
+            .layer(DenseLayer(n_out=500, activation=Activation.RELU))
+            .layer(OutputLayer(n_out=10,
+                               loss_function=LossFunction.MCXENT,
+                               activation=Activation.SOFTMAX))
+            .set_input_type(InputType.convolutional_flat(28, 28, 1))
+            .build())
+
+
+def main(epochs=1):
+    train = MnistDataSetIterator(batch_size=128, train=True)
+    test = MnistDataSetIterator(batch_size=128, train=False)
+    net = MultiLayerNetwork(build()).init()
+    net.set_listeners(ScoreIterationListener(50))
+    net.fit(train, n_epochs=epochs)
+
+    e = Evaluation()
+    for ds in test:
+        e.eval(ds.labels, net.output(ds.features))
+    print(f"accuracy: {e.accuracy():.4f}  f1: {e.f1():.4f}")
+
+    ModelSerializer.write_model(net, "/tmp/lenet_mnist.zip",
+                                save_updater=True)
+    print("saved to /tmp/lenet_mnist.zip")
+    return e.accuracy()
+
+
+if __name__ == "__main__":
+    main()

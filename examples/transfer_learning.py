@@ -1,0 +1,59 @@
+"""Transfer learning: freeze a trained feature stack, replace the head
+(reference example: EditLastLayerOthersFrozen)."""
+import os
+import sys
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+import numpy as np
+
+from deeplearning4j_tpu.activations import Activation
+from deeplearning4j_tpu.learning import Adam
+from deeplearning4j_tpu.lossfunctions import LossFunction
+from deeplearning4j_tpu.nn import (InputType, MultiLayerNetwork,
+                                   NeuralNetConfiguration)
+from deeplearning4j_tpu.nn.conf.layers import DenseLayer, OutputLayer
+from deeplearning4j_tpu.nn.transferlearning import TransferLearning
+
+
+def main():
+    rng = np.random.RandomState(0)
+    # pretrain a 3-class base model
+    x = rng.randn(256, 8).astype(np.float32)
+    y3 = np.eye(3, dtype=np.float32)[rng.randint(0, 3, 256)]
+    base_conf = (NeuralNetConfiguration.Builder()
+                 .seed(0).updater(Adam(1e-2))
+                 .list()
+                 .layer(DenseLayer(n_out=32,
+                                   activation=Activation.RELU))
+                 .layer(DenseLayer(n_out=16,
+                                   activation=Activation.RELU))
+                 .layer(OutputLayer(n_out=3,
+                                    loss_function=LossFunction.MCXENT,
+                                    activation=Activation.SOFTMAX))
+                 .set_input_type(InputType.feed_forward(8))
+                 .build())
+    base = MultiLayerNetwork(base_conf).init()
+    for _ in range(30):
+        base.fit(x, y3)
+
+    # new 2-class task: freeze features, swap the head
+    y2 = np.eye(2, dtype=np.float32)[(x[:, 0] > 0).astype(int)]
+    net = (TransferLearning.Builder(base)
+           .set_feature_extractor(1)        # freeze layers 0..1
+           .remove_output_layer()
+           .add_layer(OutputLayer(n_out=2,
+                                  loss_function=LossFunction.MCXENT,
+                                  activation=Activation.SOFTMAX))
+           .build())
+    w_before = np.asarray(net.params["layer_0"]["W"]).copy()
+    for _ in range(40):
+        net.fit(x, y2)
+    w_after = np.asarray(net.params["layer_0"]["W"])
+    acc = (np.asarray(net.output(x)).argmax(-1) == y2.argmax(-1)).mean()
+    print(f"fine-tuned accuracy: {acc:.3f}; "
+          f"frozen weights moved: {np.abs(w_after - w_before).max():.2e}")
+    return acc
+
+
+if __name__ == "__main__":
+    main()

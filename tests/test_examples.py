@@ -1,0 +1,33 @@
+"""Examples stay importable and the fast ones run (reference:
+dl4j-examples parity; heavy examples are exercised by their own
+subsystem suites)."""
+import importlib.util
+import pathlib
+import sys
+
+import pytest
+
+EX = pathlib.Path(__file__).parent.parent / "examples"
+
+
+def _load(name):
+    spec = importlib.util.spec_from_file_location(name, EX / f"{name}.py")
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+@pytest.mark.parametrize("name", ["lenet_mnist", "char_rnn",
+                                  "transfer_learning", "data_parallel",
+                                  "custom_layer_samediff"])
+def test_importable(name):
+    assert _load(name).main is not None
+
+
+def test_custom_layer_example_runs():
+    assert _load("custom_layer_samediff").main() > 0.9
+
+
+def test_data_parallel_example_runs():
+    import numpy as np
+    assert np.isfinite(_load("data_parallel").main())

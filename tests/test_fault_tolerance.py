@@ -118,11 +118,14 @@ class TestFaultTolerantTrainer:
                                   save_every_n_epochs=1)
         t1.fit([_ds(x, y)], n_epochs=2)
         it_done = t1.model.iteration_count
-        # simulate a crash AFTER the last epoch-end save but BEFORE
-        # any later work: drop every checkpoint except the newest
-        # epoch-end one, then "re-run the job"
+        # simulate a crash right after the last epoch-end save (fit's
+        # final save was deduplicated against it, so the newest file IS
+        # the epoch-end save): keep only it, then "re-run the job"
         cps = CheckpointListener.available_checkpoints(tmp_path)
-        restored = CheckpointListener.load_checkpoint(cps[-1])
+        epoch_end_cp = cps[-1]
+        for p in cps[:-1]:
+            p.unlink()
+        restored = CheckpointListener.load_checkpoint(epoch_end_cp)
         assert restored.epoch_count == 2       # true epochs completed
         t2 = FaultTolerantTrainer(_factory, tmp_path,
                                   save_every_n_epochs=1)

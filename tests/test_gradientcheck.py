@@ -2,6 +2,7 @@
 GradientCheckUtil + GradientCheckTests / CNNGradientCheckTest /
 LSTMGradientCheckTests)."""
 import numpy as np
+import jax.numpy as jnp
 
 from deeplearning4j_tpu.activations import Activation
 from deeplearning4j_tpu.datasets.dataset import DataSet
@@ -92,6 +93,72 @@ class TestGradientChecks:
         rng = np.random.RandomState(4)
         ds = DataSet(rng.randn(5, 6).astype(np.float32),
                      np.eye(2, dtype=np.float32)[rng.randint(0, 2, 5)])
+        assert GradientCheckUtil.check_gradients(net, ds)
+
+    def test_capsule_net(self):
+        """Dynamic-routing capsules pass the f64 numeric gradient check
+        (§4.5 style for the new layer families)."""
+        from deeplearning4j_tpu.nn.conf.layers_capsule import (
+            CapsuleLayer, CapsuleStrengthLayer, PrimaryCapsules)
+        conf = (_base().list()
+                .layer(PrimaryCapsules(capsule_dimensions=4, channels=2,
+                                       kernel_size=(3, 3),
+                                       stride=(2, 2)))
+                .layer(CapsuleLayer(capsules=3, capsule_dimensions=4,
+                                    routings=2))
+                .layer(CapsuleStrengthLayer())
+                .layer(OutputLayer(n_out=3,
+                                   activation=Activation.SOFTMAX,
+                                   loss_function=LossFunction.MCXENT))
+                .set_input_type(InputType.convolutional(7, 7, 1))
+                .build())
+        net = MultiLayerNetwork(conf).init()
+        rng = np.random.RandomState(5)
+        ds = DataSet(rng.randn(3, 7, 7, 1).astype(np.float32),
+                     np.eye(3, dtype=np.float32)[rng.randint(0, 3, 3)])
+        assert GradientCheckUtil.check_gradients(net, ds)
+
+    def test_locally_connected_and_conv1d(self):
+        from deeplearning4j_tpu.nn.conf.layers import GlobalPoolingLayer
+        from deeplearning4j_tpu.nn.conf.layers_conv_1d3d import \
+            Convolution1DLayer
+        from deeplearning4j_tpu.nn.conf.layers_misc import \
+            LocallyConnected1D
+        conf = (_base().list()
+                .layer(Convolution1DLayer(kernel_size=3, n_out=4,
+                                          causal=True,
+                                          activation=Activation.TANH))
+                .layer(LocallyConnected1D(kernel_size=3, n_out=3,
+                                          activation=Activation.TANH))
+                .layer(GlobalPoolingLayer())
+                .layer(OutputLayer(n_out=2,
+                                   activation=Activation.SOFTMAX,
+                                   loss_function=LossFunction.MCXENT))
+                .set_input_type(InputType.recurrent(3, 8)).build())
+        net = MultiLayerNetwork(conf).init()
+        rng = np.random.RandomState(6)
+        ds = DataSet(rng.randn(3, 8, 3).astype(np.float32),
+                     np.eye(2, dtype=np.float32)[rng.randint(0, 2, 3)])
+        assert GradientCheckUtil.check_gradients(net, ds)
+
+    def test_center_loss_head(self):
+        from deeplearning4j_tpu.nn.conf.layers_output_extra import \
+            CenterLossOutputLayer
+        conf = (_base().list()
+                .layer(DenseLayer(n_out=6,
+                                  activation=Activation.TANH))
+                .layer(CenterLossOutputLayer(
+                    n_out=3, lambda_=0.3,
+                    activation=Activation.SOFTMAX,
+                    loss_function=LossFunction.MCXENT))
+                .set_input_type(InputType.feed_forward(4)).build())
+        net = MultiLayerNetwork(conf).init()
+        rng = np.random.RandomState(7)
+        # non-zero centers so the center term has a gradient everywhere
+        net.params["layer_1"]["centers"] = \
+            jnp.asarray(rng.randn(3, 6).astype(np.float32) * 0.1)
+        ds = DataSet(rng.randn(5, 4).astype(np.float32),
+                     np.eye(3, dtype=np.float32)[rng.randint(0, 3, 5)])
         assert GradientCheckUtil.check_gradients(net, ds)
 
     def test_mixed_precision_net_checked_in_f64(self):
