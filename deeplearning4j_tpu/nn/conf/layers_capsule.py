@@ -97,6 +97,12 @@ class CapsuleLayer(Layer):
     capsules: int = 10
     capsule_dimensions: int = 16
     routings: int = 3
+    #: detach u_hat in the routing-logit updates (Sabour et al.'s
+    #: u_hat_stopped trick). Default False = fully differentiable,
+    #: matching the reference's SameDiff routing loop, which contains
+    #: no gradient-stop op — and making analytic gradients equal the
+    #: numeric check.
+    stop_routing_gradients: bool = False
 
     def set_n_in(self, input_type, override):
         assert isinstance(input_type, InputTypeRecurrent)
@@ -119,16 +125,14 @@ class CapsuleLayer(Layer):
         # routing logits b_ij: [b, in_caps, out_caps]
         logits = jnp.zeros(u_hat.shape[:3], u_hat.dtype)
         v = None
+        u_route = (jax.lax.stop_gradient(u_hat)
+                   if self.stop_routing_gradients else u_hat)
         for it in range(self.routings):
             c = jax.nn.softmax(logits, axis=2)
             s = jnp.einsum("bio,biok->bok", c, u_hat)
             v = _squash(s)
             if it < self.routings - 1:
-                # agreement: routing towards capsules whose output
-                # aligns with the prediction; fully differentiable
-                # (the reference's SameDiff routing loop backprops
-                # through every iteration)
-                logits = logits + jnp.einsum("biok,bok->bio", u_hat, v)
+                logits = logits + jnp.einsum("biok,bok->bio", u_route, v)
         return v, state
 
     def get_output_type(self, input_type):

@@ -51,17 +51,23 @@ def main(epochs=20, seq_len=32, hidden=64):
         if ep % 5 == 0:
             print(f"epoch {ep}: loss {float(net.score()):.4f}")
 
-    # sampling: stateful rnn_time_step, one char at a time
-    rng = np.random.RandomState(0)
-    net.rnn_clear_previous_state()
-    cur = np.eye(n, dtype=np.float32)[idx["t"]][None, None]
-    out = ["t"]
-    for _ in range(60):
-        probs = np.asarray(net.rnn_time_step(cur))[0, 0]
-        c = rng.choice(n, p=probs / probs.sum())
-        out.append(chars[c])
-        cur = np.eye(n, dtype=np.float32)[c][None, None]
-    print("sample:", "".join(out))
+    # temperature sampling: stateful rnn_time_step, one char at a
+    # time; temperature < 1 sharpens, > 1 flattens the distribution
+    def sample(temperature=0.7, length=60):
+        rng = np.random.RandomState(0)
+        net.rnn_clear_previous_state()
+        cur = np.eye(n, dtype=np.float32)[idx["t"]][None, None]
+        out = ["t"]
+        for _ in range(length):
+            probs = np.asarray(net.rnn_time_step(cur))[0, 0]
+            logits = np.log(np.maximum(probs, 1e-9)) / temperature
+            p = np.exp(logits - logits.max())
+            c = rng.choice(n, p=p / p.sum())
+            out.append(chars[c])
+            cur = np.eye(n, dtype=np.float32)[c][None, None]
+        return "".join(out)
+
+    print("sample (T=0.7):", sample(0.7))
     return float(net.score())
 
 

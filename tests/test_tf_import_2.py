@@ -12,6 +12,38 @@ from test_tf_import import _import_and_compare  # noqa: E402
 R = np.random.RandomState(0)
 
 
+class TestFullModelConformance:
+    def test_keras_resnet50_graphdef(self):
+        """Import a full Keras ResNet50 frozen GraphDef (~1800 nodes:
+        Conv/BiasAdd/folded-BN/Pad/MaxPool/Mean/residual-Add/Softmax)
+        and match TF's outputs — the §4.4 conformance protocol on the
+        BASELINE config #2 architecture."""
+        from tensorflow.python.framework.convert_to_constants import \
+            convert_variables_to_constants_v2
+        from deeplearning4j_tpu.modelimport.tensorflow import \
+            TensorflowFrameworkImporter
+        keras = tf.keras
+        keras.utils.set_random_seed(0)
+        m = keras.applications.ResNet50(weights=None,
+                                        input_shape=(64, 64, 3),
+                                        classes=10)
+        cf = tf.function(
+            lambda x: m(x, training=False)).get_concrete_function(
+            tf.TensorSpec((2, 64, 64, 3), tf.float32))
+        frozen = convert_variables_to_constants_v2(cf)
+        gd = frozen.graph.as_graph_def().SerializeToString()
+        x = R.randn(2, 64, 64, 3).astype(np.float32)
+        res = frozen(tf.constant(x))
+        want = np.asarray(res[0] if isinstance(res, (list, tuple))
+                          else res)
+        imp = TensorflowFrameworkImporter.run_import(
+            gd, {"x": x.shape})
+        out = sorted(n for n in imp.vars
+                     if n.startswith("Identity"))[0]
+        got = imp.output({"x": x}, [out])[out]
+        np.testing.assert_allclose(got, want, atol=2e-4, rtol=1e-3)
+
+
 class TestBreadthBatch2:
     def test_space_depth_roundtrip(self):
         x = R.randn(2, 4, 4, 3).astype(np.float32)
