@@ -101,10 +101,20 @@ class SharedTrainingMaster:
     def _ensure_distributed(self):
         c = self.config
         if c.coordinator_address and not self._initialized_dist:
-            jax.distributed.initialize(
-                coordinator_address=c.coordinator_address,
-                num_processes=c.num_processes,
-                process_id=c.process_id)
+            # idempotent: the host program may have initialized the
+            # world already (it must happen before ANY jax computation,
+            # e.g. before building the model)
+            if not jax.distributed.is_initialized():
+                jax.distributed.initialize(
+                    coordinator_address=c.coordinator_address,
+                    num_processes=c.num_processes,
+                    process_id=c.process_id)
+            elif c.num_processes is not None and \
+                    jax.process_count() != c.num_processes:
+                raise ValueError(
+                    f"jax.distributed world has {jax.process_count()} "
+                    f"processes but this master was configured for "
+                    f"{c.num_processes}")
             self._initialized_dist = True
             log.info("jax.distributed up: process %d/%d, %d global devices",
                      jax.process_index(), jax.process_count(),
