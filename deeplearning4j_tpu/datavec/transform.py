@@ -347,3 +347,73 @@ class LocalTransformExecutor:
     def execute_to_numpy(records, tp: TransformProcess) -> np.ndarray:
         rows = LocalTransformExecutor.execute(records, tp)
         return np.array([[w.to_double() for w in r] for r in rows])
+
+
+# ---------------------------------------------------------------------------
+# Sequence operations (reference: TransformProcess.convertToSequence /
+# trimSequence / offsetSequence, and reduce.Reducer over windows —
+# SURVEY.md V2 "sequence" ops)
+# ---------------------------------------------------------------------------
+def convert_to_sequence(schema, records, key_column: str,
+                        sort_column=None):
+    """Group flat records into per-key sequences (reference:
+    convertToSequence(keyColumn, comparator)); each sequence is sorted
+    by ``sort_column`` when given, else kept in input order. Returns
+    (keys, sequences) with keys in first-appearance order."""
+    ki = schema.index_of(key_column)
+    si = schema.index_of(sort_column) if sort_column else None
+    groups, order = {}, []
+    for r in records:
+        k = r[ki]
+        if k not in groups:
+            groups[k] = []
+            order.append(k)
+        groups[k].append(list(r))
+    seqs = []
+    for k in order:
+        rows = groups[k]
+        if si is not None:
+            rows = sorted(rows, key=lambda r: r[si])
+        seqs.append(rows)
+    return order, seqs
+
+
+def trim_sequence(sequences, max_length: int, from_start: bool = True):
+    """Cap sequence length (reference: trimSequence): keep the first
+    (``from_start``) or last ``max_length`` steps."""
+    if max_length <= 0:
+        return [[] for _ in sequences]
+    if from_start:
+        return [s[:max_length] for s in sequences]
+    return [s[-max_length:] for s in sequences]
+
+
+def offset_sequence(sequences, offset: int):
+    """Shift steps off the front (positive) or back (negative)
+    (reference: offsetSequence with OperationType.TrimSequence)."""
+    if offset >= 0:
+        return [s[offset:] for s in sequences]
+    return [s[:offset] for s in sequences]
+
+
+def reduce_sequence_by_window(schema, sequence, window: int,
+                              reducer, stride=None,
+                              include_partial: bool = True):
+    """Tumbling/strided windows over one sequence, each reduced to one
+    record by a :class:`deeplearning4j_tpu.datavec.reduce_join.Reducer`
+    (reference: reduceSequenceByWindow(reducer, TimeWindowFunction)).
+    The trailing partial window is kept by default
+    (``include_partial=False`` drops it). Returns the reduced
+    sequence."""
+    stride = stride or window
+    out = []
+    s = 0
+    while s < len(sequence):
+        win = sequence[s:s + window]
+        if len(win) < window and not include_partial:
+            break
+        # Reducer.execute validates op/column-type combos and reduces
+        # per column; one window == one group (keys constant within it)
+        out.extend(reducer.execute(schema, win))
+        s += stride
+    return out

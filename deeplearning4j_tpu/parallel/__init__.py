@@ -24,7 +24,8 @@ from deeplearning4j_tpu.parallel.mesh import (DEFAULT_DATA_AXIS,
 from deeplearning4j_tpu.parallel.wrapper import ParallelWrapper
 from deeplearning4j_tpu.parallel.inference import ParallelInference
 from deeplearning4j_tpu.parallel.sharedtraining import (
-    SharedTrainingConfiguration, SharedTrainingMaster)
+    ParameterAveragingTrainingMaster, SharedTrainingConfiguration,
+    SharedTrainingMaster)
 from deeplearning4j_tpu.parallel.sequence import (
     blockwise_attention, flash_attention, ring_attention,
     ring_self_attention, ulysses_attention, ulysses_self_attention)
@@ -36,7 +37,7 @@ from deeplearning4j_tpu.parallel.encoding import (
 __all__ = [
     "DEFAULT_DATA_AXIS", "MeshFactory", "make_mesh", "data_sharding",
     "replicate_tree", "shard_batch", "ParallelWrapper",
-    "ParallelInference", "SharedTrainingMaster",
+    "ParallelInference", "SharedTrainingMaster", "ParameterAveragingTrainingMaster",
     "SharedTrainingConfiguration", "ThresholdAlgorithm",
     "FixedThresholdAlgorithm", "AdaptiveThresholdAlgorithm",
     "TargetSparsityThresholdAlgorithm", "ResidualClippingPostProcessor",

@@ -172,3 +172,33 @@ class SharedTrainingMaster:
                 data_sharding(mesh, a.ndim, DEFAULT_DATA_AXIS), a)
 
         return map_dataset_arrays(ds, glob)
+
+
+class ParameterAveragingTrainingMaster(SharedTrainingMaster):
+    """Reference: ``org.deeplearning4j.spark.impl.paramavg.
+    ParameterAveragingTrainingMaster`` — Spark's broadcast-params /
+    average-every-N-rounds scheme (SURVEY.md P3).
+
+    TPU-native, synchronous in-step AllReduce makes every iteration an
+    exact average, which is the averaging scheme's N=1 fixed point with
+    none of its staleness — so this class is the same trainer with the
+    reference's builder surface (``averaging_frequency``/
+    ``rdd_data_set_num_examples``-style knobs accepted and logged)."""
+
+    class Builder(SharedTrainingMaster.Builder):
+        def __init__(self, rdd_data_set_num_examples: int = 32):
+            super().__init__(
+                batch_size_per_worker=rdd_data_set_num_examples)
+
+        def averaging_frequency(self, n: int):
+            log.info("averagingFrequency=%d accepted for API parity; "
+                     "in-step AllReduce averages exactly every "
+                     "iteration", n)
+            return self
+
+        def batch_size_per_worker(self, n: int):
+            self._c.batch_size_per_worker = n
+            return self
+
+        def build(self) -> "ParameterAveragingTrainingMaster":
+            return ParameterAveragingTrainingMaster(self._c)

@@ -133,3 +133,62 @@ class TestJoin:
     def test_output_schema(self):
         j = self._join(JoinType.INNER)
         assert j.output_schema().column_names() == ["k", "lv", "rv"]
+
+
+class TestSequenceOps:
+    def test_convert_to_sequence_sorted(self):
+        from deeplearning4j_tpu.datavec.transform import \
+            convert_to_sequence
+        schema = (Schema.Builder().add_column_string("user")
+                  .add_column_integer("t")
+                  .add_column_double("v").build())
+        recs = [["a", 2, 1.0], ["b", 1, 9.0], ["a", 1, 2.0],
+                ["a", 3, 3.0], ["b", 2, 8.0]]
+        keys, seqs = convert_to_sequence(schema, recs, "user",
+                                         sort_column="t")
+        assert keys == ["a", "b"]
+        assert [r[1] for r in seqs[0]] == [1, 2, 3]
+        assert [r[2] for r in seqs[1]] == [9.0, 8.0]
+
+    def test_trim_and_offset(self):
+        from deeplearning4j_tpu.datavec.transform import (offset_sequence,
+                                                          trim_sequence)
+        seqs = [[[i] for i in range(6)]]
+        assert trim_sequence(seqs, 3)[0] == [[0], [1], [2]]
+        assert trim_sequence(seqs, 2, from_start=False)[0] == [[4], [5]]
+        assert offset_sequence(seqs, 2)[0][0] == [2]
+        assert offset_sequence(seqs, -2)[0][-1] == [3]
+
+    def test_reduce_sequence_by_window(self):
+        from deeplearning4j_tpu.datavec.transform import \
+            reduce_sequence_by_window
+        schema = (Schema.Builder().add_column_string("user")
+                  .add_column_double("v").build())
+        seq = [["a", 1.0], ["a", 2.0], ["a", 3.0], ["a", 4.0]]
+        red = (Reducer.Builder(ReduceOp.MEAN)
+               .key_columns("user").build())
+        out = reduce_sequence_by_window(schema, seq, 2, red)
+        assert out == [["a", 1.5], ["a", 3.5]]
+
+    def test_window_partial_tail(self):
+        from deeplearning4j_tpu.datavec.transform import \
+            reduce_sequence_by_window
+        schema = (Schema.Builder().add_column_string("user")
+                  .add_column_double("v").build())
+        seq = [["a", 1.0], ["a", 2.0], ["a", 3.0], ["a", 4.0],
+               ["a", 10.0]]
+        red = (Reducer.Builder(ReduceOp.MEAN)
+               .key_columns("user").build())
+        # partial tail kept by default...
+        out = reduce_sequence_by_window(schema, seq, 2, red)
+        assert out == [["a", 1.5], ["a", 3.5], ["a", 10.0]]
+        # ...and droppable on request
+        out2 = reduce_sequence_by_window(schema, seq, 2, red,
+                                         include_partial=False)
+        assert out2 == [["a", 1.5], ["a", 3.5]]
+
+    def test_trim_to_zero(self):
+        from deeplearning4j_tpu.datavec.transform import trim_sequence
+        seqs = [[[1], [2]]]
+        assert trim_sequence(seqs, 0) == [[]]
+        assert trim_sequence(seqs, 0, from_start=False) == [[]]
