@@ -61,13 +61,31 @@ def main():
         best = max(best, steps * batch / dt)
 
     ips = best
-    print(json.dumps({
+    line = {
         "metric": "resnet50_train_throughput"
                   + ("" if on_tpu else f"_cpu_proxy_{hw}px"),
         "value": round(ips, 2),
         "unit": "images/sec/chip",
         "vs_baseline": 1.0,
-    }))
+    }
+    # Roofline evidence (BENCH_notes_r02.md): XLA cost analysis of the
+    # optimized train step (shared helper; flops are a floor).
+    try:
+        from benchmarks.cost_util import (V5E_BF16_PEAK_TFLOPS,
+                                          V5E_HBM_GBPS, graph_step_cost)
+        flops, byts = graph_step_cost(net, x, y)
+        step_s = batch / ips
+        tf = flops / step_s / 1e12
+        gbps = byts / step_s / 1e9
+        line["tflops"] = round(tf, 1)
+        if on_tpu:
+            line["pct_bf16_peak"] = round(
+                100 * tf / V5E_BF16_PEAK_TFLOPS, 1)
+            line["pct_hbm_peak"] = round(100 * gbps / V5E_HBM_GBPS, 1)
+    except Exception as e:
+        import sys
+        print(f"roofline block failed: {e!r}", file=sys.stderr)
+    print(json.dumps(line))
 
 
 if __name__ == "__main__":

@@ -1,0 +1,81 @@
+"""Secondary benchmark: BERT-base MLM pretraining throughput
+(BASELINE config #4). bf16 + Pallas flash attention + per-layer remat,
+batch 256 x seq 128 — the round-1 configuration, now with XLA
+cost-analysis MFU evidence.
+
+Prints ONE JSON line: {"metric": "bert_mlm_train_throughput", ...}.
+"""
+from __future__ import annotations
+
+import json
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+V5E_BF16_PEAK_TFLOPS = 197.0
+
+
+def main(batch=256, seq=128, steps=8):
+    from deeplearning4j_tpu.learning import Adam
+    from deeplearning4j_tpu.models.bert import Bert, BertConfig
+
+    on_tpu = jax.devices()[0].platform == "tpu"
+    if not on_tpu:
+        batch, seq, steps = 4, 128, 2
+        conf = BertConfig.tiny(compute_dtype="bfloat16",
+                               hidden_dropout_prob=0.0,
+                               attention_probs_dropout_prob=0.0)
+    else:
+        conf = BertConfig(compute_dtype="bfloat16", remat=True,
+                          use_flash_attention=True,
+                          hidden_dropout_prob=0.0,
+                          attention_probs_dropout_prob=0.0,
+                          max_predictions_per_seq=32)
+
+    model = Bert(conf, Adam(1e-4)).init()
+    rng = np.random.RandomState(0)
+    ids = rng.randint(0, conf.vocab_size, (batch, seq)).astype(np.int32)
+    mlm_labels = np.where(rng.rand(batch, seq) < 0.15,
+                          rng.randint(0, conf.vocab_size, (batch, seq)),
+                          -1).astype(np.int32)
+    batch_d = {"input_ids": jax.device_put(jnp.asarray(ids)),
+               "mlm_labels": jax.device_put(jnp.asarray(mlm_labels))}
+
+    model.fit_batch(batch_d)      # compile; fit_batch syncs on loss
+
+    best = 0.0
+    for _ in range(3):
+        t0 = time.perf_counter()
+        for _ in range(steps):
+            loss = model.fit_batch(batch_d)  # each call syncs on loss
+        assert np.isfinite(loss)
+        dt = time.perf_counter() - t0
+        best = max(best, steps * batch * seq / dt)
+
+    line = {"metric": "bert_mlm_train_throughput"
+                      + ("" if on_tpu else "_cpu_proxy"),
+            "value": round(best, 1),
+            "unit": "tokens/sec/chip"}
+
+    # Analytic matmul FLOPs (XLA's cost_analysis undercounts dot FLOPs
+    # inside fusions and cannot see the Pallas flash custom call —
+    # see BENCH_notes_r02.md). fwd multiply-adds x2, train = 3x fwd
+    # (+1 fwd again under remat, counted separately as recompute).
+    H, I = conf.hidden_size, conf.intermediate_size
+    L, V = conf.num_hidden_layers, conf.vocab_size
+    k = conf.max_predictions_per_seq or seq
+    per_layer = 4 * 2 * H * H + 2 * 2 * H * I + 4 * seq * H
+    head = (2 * H * H + 2 * H * V) * (k / seq)
+    fwd_per_token = L * per_layer + head
+    train_flops_per_token = 3 * fwd_per_token
+    tf = best * train_flops_per_token / 1e12
+    line["tflops_analytic"] = round(tf, 1)
+    if on_tpu:
+        line["pct_bf16_peak"] = round(100 * tf / V5E_BF16_PEAK_TFLOPS, 1)
+    print(json.dumps(line))
+
+
+if __name__ == "__main__":
+    main()

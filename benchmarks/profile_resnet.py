@@ -1,0 +1,125 @@
+"""Profiler evidence for the ResNet-50 benchmark (BASELINE config #2).
+
+Captures a jax.profiler device trace of the train step, aggregates
+device-op time by HLO category, and prints:
+  - step time + throughput,
+  - XLA cost-analysis FLOPs/bytes -> achieved TFLOP/s, %-of-peak,
+    HBM GB/s vs peak (the roofline),
+  - top device ops by total time.
+
+The output of this script is the basis of BENCH_notes_r02.md.
+"""
+from __future__ import annotations
+
+import glob
+import gzip
+import json
+import os
+import re
+import sys
+import tempfile
+import time
+from collections import defaultdict
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+from benchmarks.cost_util import (V5E_BF16_PEAK_TFLOPS,  # noqa: E402
+                                  V5E_HBM_GBPS, graph_step_cost)
+
+
+def categorize(name: str) -> str:
+    base = re.sub(r"[.\d]+$", "", name)
+    if "convolution" in base or base == "fusion":
+        # TPU XLA fuses each conv with its epilogue into a generic
+        # "fusion.N" computation — the unnamed fusions ARE the convs
+        return "conv + fused epilogue (fwd/bwd)"
+    if "select_and_scatter" in base:
+        return "maxpool backward"
+    if "reduce_window" in base:
+        return "maxpool forward"
+    if "multiply_reduce" in base or "convert_reduce" in base:
+        return "BN statistics / weight-grad reductions"
+    if "fusion" in base:
+        return f"fused elementwise ({base})"
+    if base in ("copy", "copy-start", "copy-done"):
+        return "copy"
+    if "all-reduce" in base or "psum" in base:
+        return "collective"
+    return base
+
+
+def main(batch=256, hw=224, steps=60):
+    from deeplearning4j_tpu.datasets.dataset import DataSet
+    from deeplearning4j_tpu.models.zoo import ResNet50
+
+    on_tpu = jax.devices()[0].platform == "tpu"
+    if not on_tpu:
+        batch, hw, steps = 8, 64, 3
+
+    net = ResNet50(num_classes=1000, height=hw, width=hw,
+                   compute_dtype="bfloat16").init()
+    rng = np.random.RandomState(0)
+    x = rng.randn(batch, hw, hw, 3).astype(np.float32)
+    y = np.eye(1000, dtype=np.float32)[rng.randint(0, 1000, batch)]
+    ds = DataSet(jax.device_put(jnp.asarray(x)),
+                 jax.device_put(jnp.asarray(y)))
+
+    # -- cost analysis (on the optimized HLO) --------------------------
+    net.fit(ds)
+    float(net.score())
+    flops, byts = graph_step_cost(net, x, y)
+
+    # -- timed steady-state run ----------------------------------------
+    net.fit_steps(ds, steps)
+    float(net.score())
+    best = 0.0
+    for _ in range(3):
+        t0 = time.perf_counter()
+        net.fit_steps(ds, steps)
+        assert np.isfinite(float(net.score()))
+        best = max(best, steps * batch / (time.perf_counter() - t0))
+    step_s = batch / best
+
+    print(f"throughput: {best:.0f} img/s  (step {step_s * 1e3:.1f} ms, "
+          f"batch {batch})")
+    print(f"cost analysis: {flops / 1e9:.0f} GFLOP/step, "
+          f"{byts / 1e9:.1f} GB accessed/step")
+    tf = flops / step_s / 1e12
+    gbps = byts / step_s / 1e9
+    print(f"achieved: {tf:.1f} TFLOP/s = {tf / V5E_BF16_PEAK_TFLOPS:.1%} "
+          f"of bf16 peak; {gbps:.0f} GB/s = {gbps / V5E_HBM_GBPS:.1%} of "
+          f"HBM peak  <-- the binding roofline")
+
+    # -- device trace ---------------------------------------------------
+    tdir = tempfile.mkdtemp(prefix="jaxtrace")
+    jax.profiler.start_trace(tdir)
+    for _ in range(3):
+        net.fit(ds)
+    float(net.score())
+    jax.profiler.stop_trace()
+
+    f = glob.glob(f"{tdir}/**/*.trace.json.gz", recursive=True)[0]
+    d = json.load(gzip.open(f))
+    cats = defaultdict(float)
+    for e in d.get("traceEvents", []):
+        if e.get("ph") != "X" or e.get("dur", 0) <= 0:
+            continue
+        name = e.get("name", "?")
+        # keep device-lane HLO ops only: skip python/host spans and the
+        # whole-module step markers (purely numeric names)
+        if name.isdigit() or \
+                name.startswith(("$", "jit_", "Pjit", "np.", "b'")) or \
+                "/" in name or " " in name:
+            continue
+        cats[categorize(name)] += e["dur"] / 1e3  # -> ms
+    total = sum(cats.values())
+    print(f"\ndevice-op time over 3 traced steps: {total:.1f} ms")
+    for cat, ms in sorted(cats.items(), key=lambda kv: -kv[1])[:14]:
+        print(f"  {ms / 3:7.2f} ms/step  {ms / total:6.1%}  {cat}")
+
+
+if __name__ == "__main__":
+    main()

@@ -103,6 +103,11 @@ class BertConfig:
     # training with attention_probs_dropout_prob > 0 uses the dense
     # path — set it to 0.0 to train through the flash kernel.
     use_flash_attention: bool = False
+    # MLM head on at most this many gathered positions per sequence
+    # (the reference TF BERT pretraining knob of the same name);
+    # 0 = decode every position. Rows with more masked positions than
+    # this train on the first max_predictions_per_seq of them.
+    max_predictions_per_seq: int = 0
 
     @staticmethod
     def base():
@@ -317,8 +322,25 @@ class Bert(_Trainable):
             params, batch["input_ids"],
             batch.get("token_type_ids"), batch.get("attention_mask"),
             training=training, rng=rng)
-        logits = self.mlm_logits(params, seq)
         labels = batch["mlm_labels"]
+        k = self.conf.max_predictions_per_seq
+        if k and k < labels.shape[1]:
+            # Gather the (at most k) masked positions per sequence and
+            # run the vocab-sized decoder on [b, k, H] instead of
+            # [b, t, H] — the reference TF BERT's
+            # max_predictions_per_seq design. With ~15% masking the
+            # decoder matmul is the single largest head cost; rows
+            # with more than k masked positions train on the first k
+            # (identical to the reference's truncation).
+            masked = labels >= 0
+            # stable argsort of "not masked": masked positions first,
+            # original order preserved within each group
+            pos = jnp.argsort(~masked, axis=1, stable=True)[:, :k]
+            labels = jnp.take_along_axis(labels, pos, axis=1)
+            seq_sel = jnp.take_along_axis(seq, pos[..., None], axis=1)
+        else:
+            seq_sel = seq
+        logits = self.mlm_logits(params, seq_sel)
         w = (labels >= 0).astype(jnp.float32)
         safe = jnp.maximum(labels, 0)
         logp = jax.nn.log_softmax(logits, -1)

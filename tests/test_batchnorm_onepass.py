@@ -1,0 +1,56 @@
+"""One-pass BN statistics gating (nn/conf/layers.py BatchNormalization).
+
+bf16/f16 activations take the fused single-read E[x]/E[x^2] path with
+f32 accumulation; f32+ activations keep the accurate two-pass form —
+the E[x^2]-E[x]^2 cancellation has no headroom at equal precision
+(review finding: un-normalized inputs with |mean| >> std would see
+catastrophic cancellation, possibly var clamped to 0).
+"""
+import jax.numpy as jnp
+import numpy as np
+
+from deeplearning4j_tpu.nn.conf.layers import BatchNormalization
+
+
+def _forward(x, dtype):
+    bn = BatchNormalization(n_in=x.shape[-1], n_out=x.shape[-1])
+    nf = x.shape[-1]
+    params = {"gamma": jnp.ones(nf, dtype), "beta": jnp.zeros(nf, dtype)}
+    state = {"mean": jnp.zeros(nf, jnp.float32),
+             "var": jnp.ones(nf, jnp.float32)}
+    return bn.forward(params, jnp.asarray(x, dtype), training=True,
+                      state=state)
+
+
+class TestOnePassBN:
+    def test_bf16_stats_match_reference(self):
+        rng = np.random.RandomState(0)
+        x = (rng.randn(64, 16) * 2 + 5).astype(np.float64)
+        _, ns = _forward(x, jnp.bfloat16)
+        # decay 0.9: new_mean = 0.1 * batch_mean
+        got_mean = np.asarray(ns["mean"]) / 0.1
+        got_var = (np.asarray(ns["var"]) - 0.9) / 0.1
+        assert np.allclose(got_mean, x.mean(0), rtol=2e-2, atol=1e-2)
+        assert np.allclose(got_var, x.var(0), rtol=5e-2, atol=1e-2)
+
+    def test_f32_high_dynamic_range_stays_accurate(self):
+        # mean ~1e4, std ~1: one-pass in f32 would lose the variance
+        # entirely (cancellation); the two-pass branch must hold
+        rng = np.random.RandomState(1)
+        x = (rng.randn(256, 8) + 1e4).astype(np.float32)
+        out, ns = _forward(x, jnp.float32)
+        got_var = (np.asarray(ns["var"]) - 0.9) / 0.1
+        ref_var = x.astype(np.float64).var(0)
+        assert np.allclose(got_var, ref_var, rtol=1e-2), (got_var,
+                                                          ref_var)
+        # normalized output must have ~unit variance, not explode
+        ov = np.asarray(out, np.float64).var(0)
+        assert np.all(ov > 0.5) and np.all(ov < 2.0), ov
+
+    def test_bf16_output_normalized(self):
+        rng = np.random.RandomState(2)
+        x = (rng.randn(128, 4) * 3 - 7).astype(np.float32)
+        out, _ = _forward(x, jnp.bfloat16)
+        o = np.asarray(out, np.float64)
+        assert np.allclose(o.mean(0), 0, atol=5e-2)
+        assert np.allclose(o.var(0), 1, atol=1e-1)

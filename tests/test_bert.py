@@ -118,3 +118,44 @@ class TestBertFineTune:
                                   {k: jnp.asarray(v)
                                    for k, v in batch.items()})
         assert float(loss) == 0.0
+
+    def test_gathered_mlm_head_matches_full_decode(self):
+        """max_predictions_per_seq >= masked count per row must yield
+        the exact full-decode loss and gradients (models/bert.py)."""
+        batch = _mlm_batch(n=8, t=32)
+        batch.pop("nsp_labels")
+        max_masked = int((batch["mlm_labels"] >= 0).sum(1).max())
+        full = Bert(BertConfig.tiny(), seed=5).init()
+        gath = Bert(BertConfig.tiny(
+            max_predictions_per_seq=max_masked + 2), seed=5).init()
+        jb = {k: jnp.asarray(v) for k, v in batch.items()}
+        lf = float(full.pretrain_loss(full.params, jb, training=False))
+        lg = float(gath.pretrain_loss(gath.params, jb, training=False))
+        assert abs(lf - lg) < 1e-5, (lf, lg)
+        gf = jax.grad(lambda p: full.pretrain_loss(
+            p, jb, training=False))(full.params)
+        gg = jax.grad(lambda p: gath.pretrain_loss(
+            p, jb, training=False))(gath.params)
+        deltas = jax.tree_util.tree_map(
+            lambda a, b: float(jnp.max(jnp.abs(a - b))), gf, gg)
+        assert max(jax.tree_util.tree_leaves(deltas)) < 1e-6
+
+    def test_gathered_mlm_head_truncates_overfull_rows(self):
+        """Rows with more masked positions than the cap train on the
+        first cap positions (reference TF-BERT truncation)."""
+        batch = _mlm_batch(n=4, t=16)
+        batch.pop("nsp_labels")
+        batch["mlm_labels"] = batch["input_ids"].astype(np.int64).copy()
+        bert = Bert(BertConfig.tiny(max_predictions_per_seq=4),
+                    seed=1).init()
+        jb = {k: jnp.asarray(v) for k, v in batch.items()}
+        loss = float(bert.pretrain_loss(bert.params, jb, training=False))
+        # manually decode only the first 4 positions
+        ref = Bert(BertConfig.tiny(), seed=1).init()
+        jb4 = dict(jb)
+        lab = np.full((4, 16), -1, np.int64)
+        lab[:, :4] = batch["mlm_labels"][:, :4]
+        jb4["mlm_labels"] = jnp.asarray(lab)
+        ref_loss = float(ref.pretrain_loss(ref.params, jb4,
+                                           training=False))
+        assert abs(loss - ref_loss) < 1e-5, (loss, ref_loss)
