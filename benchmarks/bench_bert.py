@@ -10,11 +10,15 @@ from __future__ import annotations
 import json
 import time
 
+import os
+import sys
+
 import jax
 import jax.numpy as jnp
 import numpy as np
 
-V5E_BF16_PEAK_TFLOPS = 197.0
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+from benchmarks.cost_util import V5E_BF16_PEAK_TFLOPS  # noqa: E402
 
 
 def main(batch=256, seq=128, steps=8):

@@ -1306,6 +1306,32 @@ def _identity_op(ins, attrs):
 # SameDiff.while_loop/cond/scan from child graphs) and lower to
 # lax.while_loop / lax.cond / lax.scan — the XLA-native control flow
 # the reference's TF-style Enter/Exit/Merge/Switch frames compile to.
+@jax.custom_vjp
+def _while_capture_trap(x):
+    """Identity on the forward pass; requesting a gradient through it
+    raises — an unbounded while_loop has no reverse rule (XLA while
+    is not reverse-differentiable), and silently stopping the
+    gradient trains wrong. Applied to the loop outputs, so every
+    reverse path into the loop hits it."""
+    return x
+
+
+def _while_trap_fwd(x):
+    return x, None
+
+
+def _while_trap_bwd(_res, _g):
+    raise NotImplementedError(
+        "gradient requested through a while_loop capture. XLA's while "
+        "has no reverse rule; pass max_iterations=N to while_loop to "
+        "lower it to a reverse-differentiable bounded scan (the "
+        "TF maximum_iterations semantics), or thread the value so the "
+        "loss does not depend on the loop.")
+
+
+_while_capture_trap.defvjp(_while_trap_fwd, _while_trap_bwd)
+
+
 @op("while_loop", "control")
 def _while_loop(ins, attrs):
     cond = attrs["_cond_call"]
@@ -1313,11 +1339,43 @@ def _while_loop(ins, attrs):
     n = attrs.get("n_loop", len(ins))
     ncc = attrs.get("n_cond_caps", 0)
     loop0 = tuple(ins[:n])
-    # while_loop is forward-only (XLA while has no reverse rule), so
-    # captured values must not carry gradients into it — a captured
-    # trainable stays live in value but contributes no while-grads
-    cond_caps = tuple(lax.stop_gradient(c) for c in ins[n:n + ncc])
-    body_caps = tuple(lax.stop_gradient(c) for c in ins[n + ncc:])
+    max_iter = attrs.get("max_iterations")
+
+    if max_iter is not None:
+        # Reverse-differentiable lowering (reference: SameDiff builds
+        # gradients through TF Enter/Exit/NextIteration loop frames;
+        # TF's while_loop(maximum_iterations=...)): run a lax.scan for
+        # the static bound, masking updates once the condition goes
+        # false. scan has a transpose rule, so gradients flow through
+        # loop vars AND captures; trips beyond the bound truncate
+        # exactly like TF's maximum_iterations.
+        cond_caps = tuple(ins[n:n + ncc])
+        body_caps = tuple(ins[n + ncc:])
+
+        def step(carry, _):
+            vars_, done = carry
+            cnd = jnp.squeeze(
+                cond(*vars_, *cond_caps)[0]).astype(bool)
+            active = jnp.logical_and(jnp.logical_not(done), cnd)
+            new_vars = tuple(body(*vars_, *body_caps))
+            vars_ = tuple(jnp.where(active, nv, ov)
+                          for nv, ov in zip(new_vars, vars_))
+            return (vars_, jnp.logical_or(done,
+                                          jnp.logical_not(cnd))), None
+
+        (out, _done), _ = lax.scan(
+            step, (loop0, jnp.asarray(False)), None,
+            length=int(max_iter))
+        return out if len(out) > 1 else out[0]
+
+    # Unbounded: true lax.while_loop. No reverse rule exists, so the
+    # gradient must not SILENTLY vanish — every reverse path into the
+    # loop enters through its outputs, and the trap on them raises
+    # with the fix (max_iterations) the moment a gradient is
+    # requested. Captures stay live (stop_gradient would be the
+    # silent-wrong-training trap this replaces).
+    cond_caps = tuple(ins[n:n + ncc])
+    body_caps = tuple(ins[n + ncc:])
 
     def c(carry):
         return jnp.squeeze(cond(*carry, *cond_caps)[0]).astype(bool)
@@ -1325,7 +1383,8 @@ def _while_loop(ins, attrs):
     def b(carry):
         return tuple(body(*carry, *body_caps))
 
-    out = lax.while_loop(c, b, loop0)
+    out = tuple(_while_capture_trap(o)
+                for o in lax.while_loop(c, b, loop0))
     return out if len(out) > 1 else out[0]
 
 

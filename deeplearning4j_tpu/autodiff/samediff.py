@@ -324,6 +324,28 @@ class SameDiff:
 
     place_holder = placeholder     # reference spelling
 
+    def convert_to_variables(self, names: Sequence,
+                             values: Optional[dict] = None):
+        """Promote placeholders/constants to trainable VARIABLEs
+        (reference: SameDiff.convertToVariable(s) — used after import
+        to make trained tensors differentiable/trainable). ``values``
+        supplies initial arrays for converted placeholders."""
+        for n in names:
+            name = n.name if isinstance(n, SDVariable) else n
+            v = self.vars[name]
+            if values and name in values:
+                arr = jnp.asarray(values[name])
+                self._arrays[name] = arr
+                v.shape, v.dtype = arr.shape, arr.dtype
+            if name not in self._arrays:
+                raise ValueError(
+                    f"convert_to_variables('{name}'): no stored value "
+                    f"— pass one via values={{'{name}': array}}")
+            v.var_type = VariableType.VARIABLE
+        self._exec_cache.clear()
+
+    convertToVariables = convert_to_variables
+
     def _as_var(self, x) -> SDVariable:
         if isinstance(x, SDVariable):
             return x
@@ -549,13 +571,19 @@ class SameDiff:
         return call, len(out_names), cap_vars, spec
 
     def while_loop(self, loop_vars: Sequence, cond_fn, body_fn,
-                   name: Optional[str] = None):
-        """``lax.while_loop`` over the graph (reference: SameDiff
-        whileLoop / TF-import Enter..Exit frames). ``cond_fn`` maps
-        the loop vars to a scalar boolean; ``body_fn`` returns updated
-        loop vars (same count/shapes). Forward-only (XLA while is not
-        reverse-differentiable; use :meth:`scan` for trainable loops).
-        """
+                   name: Optional[str] = None,
+                   max_iterations: Optional[int] = None):
+        """Dynamic loop over the graph (reference: SameDiff whileLoop /
+        TF-import Enter..Exit frames). ``cond_fn`` maps the loop vars
+        to a scalar boolean; ``body_fn`` returns updated loop vars
+        (same count/shapes).
+
+        With ``max_iterations=N`` (TF ``maximum_iterations``
+        semantics) the loop lowers to a bounded masked ``lax.scan`` —
+        fully reverse-differentiable through loop vars and captures,
+        truncating after N trips. Without it, the loop lowers to
+        ``lax.while_loop``: unbounded, but forward-only — a gradient
+        request through it raises loudly (never silently zeros)."""
         loop_vars = [self._as_var(v) for v in loop_vars]
         n = len(loop_vars)
         cond_call, _, cond_caps, cond_spec = self._trace_subgraph(
@@ -573,7 +601,8 @@ class SameDiff:
                          "_body_spec": body_spec,
                          "n_loop": n,
                          "n_cond_caps": len(cond_caps),
-                         "n_body_caps": len(body_caps)},
+                         "n_body_caps": len(body_caps),
+                         "max_iterations": max_iterations},
                         name=name, n_out=n)
 
     def cond(self, pred, true_fn, false_fn, operands: Sequence = (),

@@ -26,9 +26,13 @@ from deeplearning4j_tpu.autodiff.samediff import (SameDiff, SDVariable,
 from deeplearning4j_tpu.modelimport.tensorflow import mappings
 from deeplearning4j_tpu.modelimport.tensorflow.mappings import TF_OP_MAP
 from deeplearning4j_tpu.modelimport.tensorflow.protobuf import (
-    NodeDef, parse_graphdef, tf_dtype_to_np)
+    FunctionDef, NodeDef, parse_graphdef_with_library, tf_dtype_to_np)
 
 _SKIP_OPS = {"NoOp", "Assert", "SaveV2", "RestoreV2", "MergeV2Checkpoints"}
+
+#: functional control-flow ops handled by the importer itself (not
+#: TF_OP_MAP rules): bodies live in the GraphDef function library
+_FUNCTIONAL_OPS = {"While", "StatelessWhile", "If", "StatelessIf"}
 
 
 def _canon(ref: str) -> str:
@@ -44,6 +48,55 @@ def _canon(ref: str) -> str:
 def _node_of(ref: str) -> str:
     ref = _canon(ref)
     return ref.split(":")[0]
+
+
+#: output-arg order of mapped multi-output TF ops (from the TF op
+#: registry): function-body refs name the PORT ('node:indices:0');
+#: binding uses flat indices, so the port name must translate to its
+#: base offset. Single-output ops need no entry (their only port is
+#: flat index 0); ops with one REPEATED output arg ('output') are flat
+#: already.
+_TF_MULTI_OUT_ARGS = {
+    "TopKV2": ["values", "indices"],
+    "Unique": ["y", "idx"],
+    "UniqueV2": ["y", "idx"],
+    "FusedBatchNorm": ["y", "batch_mean", "batch_variance",
+                       "reserve_space_1", "reserve_space_2"],
+    "FusedBatchNormV2": ["y", "batch_mean", "batch_variance",
+                         "reserve_space_1", "reserve_space_2"],
+    "FusedBatchNormV3": ["y", "batch_mean", "batch_variance",
+                         "reserve_space_1", "reserve_space_2",
+                         "reserve_space_3"],
+    "SoftmaxCrossEntropyWithLogits": ["loss", "backprop"],
+    "SparseSoftmaxCrossEntropyWithLogits": ["loss", "backprop"],
+}
+
+
+def _canon_func_ref(ref: str, producer_ops: Optional[dict] = None
+                    ) -> str:
+    """Function-body tensor refs are ``node:out_arg_name:idx`` (vs the
+    graph's ``node:idx``); normalize to the graph style the importer
+    binds (flat-index ports: 'node' for 0, 'node:i' otherwise).
+    ``producer_ops`` maps node name -> TF op name so named ports of
+    multi-output ops translate to their flat offset."""
+    if ref.startswith("^"):
+        return ref
+    parts = ref.split(":")
+    if len(parts) == 3:
+        node, port, idx = parts
+        flat = int(idx)
+        op_name = (producer_ops or {}).get(node)
+        args = _TF_MULTI_OUT_ARGS.get(op_name)
+        if args is not None:
+            if port not in args:
+                raise NotImplementedError(
+                    f"TF import: unknown output port '{port}' of "
+                    f"{op_name} node '{node}'")
+            flat += args.index(port)
+        return node if flat == 0 else f"{node}:{flat}"
+    if len(parts) == 2 and not parts[1].isdigit():
+        return parts[0]
+    return ref
 
 
 class _Ctx:
@@ -74,16 +127,24 @@ class _Ctx:
 class GraphDefImporter:
     """One-shot importer for a frozen (inference) GraphDef."""
 
-    def __init__(self, graph_def, input_shapes: Optional[dict] = None):
+    def __init__(self, graph_def, input_shapes: Optional[dict] = None,
+                 while_max_iterations=None):
         if isinstance(graph_def, (str, os.PathLike)):
             with open(graph_def, "rb") as fh:
                 graph_def = fh.read()
+        self.functions: Dict[str, FunctionDef] = {}
         if isinstance(graph_def, (bytes, bytearray)):
-            self.nodes = parse_graphdef(bytes(graph_def))
+            self.nodes, self.functions = parse_graphdef_with_library(
+                bytes(graph_def))
         else:                        # already a parsed NodeDef list
             self.nodes = list(graph_def)
         self.input_shapes = {k: tuple(v) for k, v in
                              (input_shapes or {}).items()}
+        #: int (all loops) or {while_node_name: int}: lower imported
+        #: While ops to the bounded reverse-differentiable form
+        #: (SameDiff.while_loop(max_iterations=...)); None = unbounded
+        #: forward-only import
+        self.while_max_iterations = while_max_iterations
         self.sd = SameDiff()
         self.static_values: Dict[str, np.ndarray] = {}
         self.var_map: Dict[str, SDVariable] = {}
@@ -231,24 +292,61 @@ class GraphDefImporter:
         return vals
 
     # -- main loop -----------------------------------------------------
+    def _all_reachable_nodes(self, order) -> List[NodeDef]:
+        """Top-level nodes plus the bodies of every function reachable
+        through functional control-flow (transitively), so the
+        unmapped-op precheck sees loop/branch internals too."""
+        out = list(order)
+        seen = set()
+        stack = list(order)
+        while stack:
+            node = stack.pop()
+            if node.op not in _FUNCTIONAL_OPS:
+                continue
+            for key in ("cond", "body", "then_branch", "else_branch"):
+                fname = node.attr(key)
+                if not fname or fname in seen:
+                    continue
+                seen.add(fname)
+                fd = self.functions.get(fname)
+                if fd is None:
+                    continue        # _function raises at import time
+                out.extend(fd.nodes)
+                stack.extend(fd.nodes)
+        return out
+
     def run(self) -> SameDiff:
         by_name = {n.name: n for n in self.nodes}
         order = _topo_sort(self.nodes, by_name)
-        unmapped = sorted({n.op for n in order
+        unmapped = sorted({n.op
+                           for n in self._all_reachable_nodes(order)
                            if n.op not in TF_OP_MAP
                            and n.op not in ("Const", "Placeholder")
                            and n.op not in _SKIP_OPS
+                           and n.op not in _FUNCTIONAL_OPS
                            and n.op not in _FOLDERS})
         if unmapped:
             raise NotImplementedError(
                 f"TF import: no mapping for ops {unmapped} "
                 f"(reference parity: OpMappingRegistry lookup failure)")
-        ctx = _Ctx(self)
+        self._import_node_list(order, _Ctx(self))
+        self.outputs = _terminal_names(order, self.var_map)
+        return self.sd
+
+    def _import_node_list(self, order, ctx):
+        """The per-node import loop — shared by the top-level graph
+        and function bodies (While/If cond/body subgraphs)."""
         for node in order:
             if node.op in _SKIP_OPS:
                 continue
             if node.op == "Const":
-                self.static_values[node.name] = node.attr("value")
+                val = node.attr("value")
+                if isinstance(val, Exception):
+                    raise NotImplementedError(
+                        f"TF import: Const '{node.name}' holds a "
+                        f"tensor this decoder cannot represent "
+                        f"({val})") from val
+                self.static_values[node.name] = val
                 continue
             if node.op == "Placeholder":
                 shape = self.input_shapes.get(node.name)
@@ -263,6 +361,12 @@ class GraphDefImporter:
                     self.avals[node.name] = jax.ShapeDtypeStruct(
                         tuple(shape), np.dtype(dtype))
                 continue
+            if node.op in ("While", "StatelessWhile"):
+                self._import_while(node)
+                continue
+            if node.op in ("If", "StatelessIf"):
+                self._import_if(node)
+                continue
             if self._try_fold(node):
                 continue
             # control deps ('^x') order execution in TF; the compiled
@@ -275,15 +379,96 @@ class GraphDefImporter:
             result = rule(ctx, node)
             self._bind(node, result, n_ops_before)
             self._infer_new_ops(n_ops_before)
-        self.outputs = _terminal_names(order, self.var_map)
-        return self.sd
+
+    # -- functional control flow (TF2 While/If; SURVEY.md S3:
+    # the reference maps legacy Enter/Exit/NextIteration frames — TF2
+    # exports the same loops as library functions) -------------------
+    def _function(self, name: str) -> FunctionDef:
+        fd = self.functions.get(name)
+        if fd is None:
+            raise NotImplementedError(
+                f"TF import: GraphDef references function '{name}' "
+                f"but the library does not define it")
+        return fd
+
+    def _function_as_callable(self, fd: FunctionDef):
+        """Wrap a FunctionDef as a python callable over SDVariables,
+        suitable for SameDiff.while_loop/cond subgraph tracing: the
+        body's nodes import into the CHILD graph the proxies live in,
+        with function args bound by position."""
+        arg_names = [a for a, _ in fd.input_args]
+        producer_ops = {n.name: n.op for n in fd.nodes}
+        norm_nodes = [
+            NodeDef(n.name, n.op,
+                    [_canon_func_ref(r, producer_ops)
+                     for r in n.inputs],
+                    n.attrs)
+            for n in fd.nodes]
+
+        def fn(*args):
+            child_sd = args[0].sd if args else self.sd
+            sub = GraphDefImporter.__new__(GraphDefImporter)
+            sub.nodes = norm_nodes
+            sub.functions = self.functions
+            sub.input_shapes = {}
+            sub.while_max_iterations = self.while_max_iterations
+            sub.sd = child_sd
+            sub.static_values = {}
+            sub.var_map = dict(zip(arg_names, args))
+            sub.avals = {}
+            sub.placeholders = []
+            sub.outputs = []
+            by_name = {n.name: n for n in norm_nodes}
+            order = _topo_sort(norm_nodes, by_name,
+                               external=set(arg_names))
+            sub._import_node_list(order, _Ctx(sub))
+            outs = []
+            for out_name, _ in fd.output_args:
+                ref = _canon_func_ref(fd.ret.get(out_name, out_name),
+                                      producer_ops)
+                outs.append(sub._materialize(_canon(ref)))
+            return outs
+
+        return fn
+
+    def _import_while(self, node: NodeDef):
+        cond_fd = self._function(node.attr("cond"))
+        body_fd = self._function(node.attr("body"))
+        loop_vars = [self._materialize(_canon(r)) for r in node.inputs
+                     if not r.startswith("^")]
+        mi = self.while_max_iterations
+        if isinstance(mi, dict):
+            mi = mi.get(node.name)
+        n_ops_before = len(self.sd.ops)
+        outs = self.sd.while_loop(
+            loop_vars, self._function_as_callable(cond_fd),
+            self._function_as_callable(body_fd),
+            max_iterations=None if mi is None else int(mi))
+        self._bind(node, outs, n_ops_before)
+        self._infer_new_ops(n_ops_before)
+
+    def _import_if(self, node: NodeDef):
+        then_fd = self._function(node.attr("then_branch"))
+        else_fd = self._function(node.attr("else_branch"))
+        ins = [r for r in node.inputs if not r.startswith("^")]
+        pred = self._materialize(_canon(ins[0]))
+        operands = [self._materialize(_canon(r)) for r in ins[1:]]
+        n_ops_before = len(self.sd.ops)
+        outs = self.sd.cond(
+            pred, self._function_as_callable(then_fd),
+            self._function_as_callable(else_fd), operands)
+        self._bind(node, outs, n_ops_before)
+        self._infer_new_ops(n_ops_before)
 
 
 class _NoFold(Exception):
     pass
 
 
-def _topo_sort(nodes: Sequence[NodeDef], by_name) -> List[NodeDef]:
+def _topo_sort(nodes: Sequence[NodeDef], by_name,
+               external=frozenset()) -> List[NodeDef]:
+    """``external``: names resolvable outside this node list (function
+    args in While/If bodies) — legal dangling references."""
     order: List[NodeDef] = []
     state: Dict[str, int] = {}        # 0 visiting, 1 done
 
@@ -296,6 +481,8 @@ def _topo_sort(nodes: Sequence[NodeDef], by_name) -> List[NodeDef]:
             for ref in it:
                 dep = by_name.get(_node_of(ref))
                 if dep is None:
+                    if _node_of(ref) in external:
+                        continue
                     raise KeyError(f"missing node '{_node_of(ref)}'")
                 st = state.get(dep.name)
                 if st == 0:
@@ -479,9 +666,10 @@ class TensorflowFrameworkImporter:
     TensorflowFrameworkImporter (SURVEY.md S6)."""
 
     @staticmethod
-    def run_import(graph_def, input_shapes: Optional[dict] = None
-                   ) -> SameDiff:
-        return GraphDefImporter(graph_def, input_shapes).run()
+    def run_import(graph_def, input_shapes: Optional[dict] = None,
+                   while_max_iterations=None) -> SameDiff:
+        return GraphDefImporter(graph_def, input_shapes,
+                                while_max_iterations).run()
 
     runImport = run_import
 
@@ -490,8 +678,9 @@ class TFGraphMapper:
     """Legacy front-door (reference: TFGraphMapper, SURVEY.md S7)."""
 
     @staticmethod
-    def import_graph(graph_def, input_shapes: Optional[dict] = None
-                     ) -> SameDiff:
-        return GraphDefImporter(graph_def, input_shapes).run()
+    def import_graph(graph_def, input_shapes: Optional[dict] = None,
+                     while_max_iterations=None) -> SameDiff:
+        return GraphDefImporter(graph_def, input_shapes,
+                                while_max_iterations).run()
 
     importGraph = import_graph

@@ -194,7 +194,14 @@ def parse_attr(buf: bytes) -> Attr:
     if 7 in f:
         return Attr("shape", parse_shape(f[7][0][1]))
     if 8 in f:
-        return Attr("tensor", parse_tensor(f[8][0][1]))
+        try:
+            return Attr("tensor", parse_tensor(f[8][0][1]))
+        except Exception as e:
+            # e.g. DT_VARIANT consts (TensorArray/TensorList flow
+            # state) or unknown-rank shapes: defer the failure so the
+            # importer's unmapped-op precheck can report the real
+            # problem first; touching the value raises then
+            return Attr("tensor_error", e)
     if 10 in f:
         nf = decode_fields(f[10][0][1])
         name = nf[1][0][1].decode() if 1 in nf else ""
@@ -255,3 +262,64 @@ def parse_graphdef(buf: bytes) -> List[NodeDef]:
     """GraphDef: node=1 (repeated NodeDef)."""
     f = decode_fields(buf)
     return [parse_node(raw) for _, raw in f.get(1, [])]
+
+
+class FunctionDef:
+    """One decoded tf.FunctionDef (the body/cond subgraphs of
+    functional control flow: While/StatelessWhile/If)."""
+    __slots__ = ("name", "input_args", "output_args", "nodes", "ret")
+
+    def __init__(self, name, input_args, output_args, nodes, ret):
+        self.name = name
+        self.input_args = input_args    # [(arg_name, dtype_enum)]
+        self.output_args = output_args  # [(arg_name, dtype_enum)]
+        self.nodes = nodes              # [NodeDef]
+        self.ret = ret                  # {output_arg_name: tensor_ref}
+
+    def __repr__(self):
+        return (f"FunctionDef('{self.name}' "
+                f"{[a for a, _ in self.input_args]} -> "
+                f"{[a for a, _ in self.output_args]}, "
+                f"{len(self.nodes)} nodes)")
+
+
+def _parse_arg_def(buf: bytes) -> Tuple[str, int]:
+    """OpDef.ArgDef: name=1, type=3."""
+    f = decode_fields(buf)
+    name = f[1][0][1].decode() if 1 in f else ""
+    dtype = f[3][0][1] if 3 in f else 0
+    return name, dtype
+
+
+def parse_function_def(buf: bytes) -> FunctionDef:
+    """FunctionDef: signature(OpDef)=1, node_def=3, ret(map)=4."""
+    f = decode_fields(buf)
+    name, in_args, out_args = "", [], []
+    if 1 in f:                                     # OpDef
+        sf = decode_fields(f[1][0][1])
+        name = sf[1][0][1].decode() if 1 in sf else ""
+        in_args = [_parse_arg_def(raw) for _, raw in sf.get(2, [])]
+        out_args = [_parse_arg_def(raw) for _, raw in sf.get(3, [])]
+    nodes = [parse_node(raw) for _, raw in f.get(3, [])]
+    ret: Dict[str, str] = {}
+    for _, entry in f.get(4, []):                  # map<string,string>
+        ef = decode_fields(entry)
+        k = ef[1][0][1].decode() if 1 in ef else ""
+        v = ef[2][0][1].decode() if 2 in ef else ""
+        ret[k] = v
+    return FunctionDef(name, in_args, out_args, nodes, ret)
+
+
+def parse_graphdef_with_library(buf: bytes
+                                ) -> Tuple[List[NodeDef],
+                                           Dict[str, FunctionDef]]:
+    """GraphDef: node=1, library(FunctionDefLibrary{function=1})=2."""
+    f = decode_fields(buf)
+    nodes = [parse_node(raw) for _, raw in f.get(1, [])]
+    functions: Dict[str, FunctionDef] = {}
+    for _, raw in f.get(2, []):
+        lf = decode_fields(raw)
+        for _, fraw in lf.get(1, []):
+            fd = parse_function_def(fraw)
+            functions[fd.name] = fd
+    return nodes, functions

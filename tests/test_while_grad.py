@@ -1,0 +1,140 @@
+"""Gradients through dynamic while-loops (SURVEY.md S2/S3: the
+reference SameDiff backprops through TF Enter/Exit/NextIteration loop
+frames; here while_loop(max_iterations=N) lowers to a bounded masked
+lax.scan with a transpose rule — autodiff/registry.py).
+
+Also pins the loud-failure contract: an UNBOUNDED while_loop has no
+reverse rule, and a gradient request through a captured value must
+raise (round-1 behavior silently stopped the gradient — a correctness
+cliff for imported graphs with trainable dynamic loops)."""
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.autodiff.samediff import SameDiff
+
+
+def _doubling_loop(sd, x, max_iterations=None):
+    """double v until sum(v) >= 100 (data-dependent trip count)."""
+    return sd.while_loop(
+        [x],
+        lambda v: v.sd._op("lt",
+                           [v.sd._op("reduce_sum", [v]),
+                            v.sd.constant(np.float32(100.0))]),
+        lambda v: v.sd._op("mul",
+                           [v, v.sd.constant(np.float32(2.0))]),
+        max_iterations=max_iterations)
+
+
+class TestBoundedWhileGrad:
+    def test_forward_matches_unbounded(self):
+        for seed in range(3):
+            rng = np.random.RandomState(seed)
+            xv = rng.rand(4).astype(np.float32) + 0.5
+            outs = {}
+            for mi in (None, 16):
+                sd = SameDiff()
+                x = sd.placeholder("x", shape=(4,))
+                out = _doubling_loop(sd, x, mi).rename("res")
+                outs[mi] = sd.output({"x": xv}, ["res"])["res"]
+            np.testing.assert_allclose(outs[None], outs[16])
+
+    def test_analytic_vs_numeric_gradient(self):
+        """d(loss)/dw through a data-dependent trip count: w scales
+        the start vector; away from trip-count boundaries the loop is
+        locally k doublings, so the gradient is smooth and the
+        numeric check is valid."""
+        sd = SameDiff()
+        w = sd.var("w", array=np.float32([1.1, 0.9, 1.3, 0.7]))
+        x = sd.placeholder("x", shape=(4,))
+        scaled = sd._op("mul", [w, x])
+        out = _doubling_loop(sd, scaled, max_iterations=16)
+        loss = sd._op("reduce_sum", [out]).rename("loss")
+        sd.set_loss_variables(["loss"])
+        xv = np.float32([1.0, 2.0, 0.5, 1.5])
+        g = sd.calculate_gradients({"x": xv}, ["w"])["w"]
+
+        def f(wv):
+            sd2 = SameDiff()
+            w2 = sd2.var("w", array=wv.astype(np.float32))
+            x2 = sd2.placeholder("x", shape=(4,))
+            s2 = sd2._op("mul", [w2, x2])
+            o2 = _doubling_loop(sd2, s2, max_iterations=16)
+            l2 = sd2._op("reduce_sum", [o2]).rename("l2")
+            return float(sd2.output({"x": xv}, ["l2"])["l2"])
+
+        w0 = np.float64([1.1, 0.9, 1.3, 0.7])
+        eps = 1e-3
+        num = np.zeros(4)
+        for i in range(4):
+            wp, wm = w0.copy(), w0.copy()
+            wp[i] += eps
+            wm[i] -= eps
+            num[i] = (f(wp) - f(wm)) / (2 * eps)
+        np.testing.assert_allclose(g, num, rtol=1e-2, atol=1e-3)
+
+    def test_capture_receives_gradient(self):
+        """A trainable captured by the bounded loop BODY (not threaded
+        through the carry) gets real gradients: loss = sum(x + w
+        added k times) -> dloss/dw = k * size."""
+        sd = SameDiff()
+        w = sd.var("w", array=np.float32(0.5))
+        x = sd.placeholder("x", shape=(3,))
+        out = sd.while_loop(
+            [x],
+            lambda v: v.sd._op("lt",
+                               [v.sd._op("reduce_sum", [v]),
+                                v.sd.constant(np.float32(30.0))]),
+            lambda v: v.sd._op("add", [v, w]),
+            max_iterations=64)
+        loss = sd._op("reduce_sum", [out]).rename("loss")
+        sd.set_loss_variables(["loss"])
+        xv = np.float32([1.0, 1.0, 1.0])
+        # trips: sum goes 3 -> +1.5/trip; stops when >= 30: 18 trips
+        g = sd.calculate_gradients({"x": xv}, ["w"])["w"]
+        assert float(g) == pytest.approx(18 * 3, rel=1e-5)
+
+    def test_truncation_at_max_iterations(self):
+        """Fewer allowed trips than the condition wants: TF
+        maximum_iterations semantics — stop after N."""
+        sd = SameDiff()
+        x = sd.placeholder("x", shape=(4,))
+        out = _doubling_loop(sd, x, max_iterations=2).rename("res")
+        got = sd.output({"x": np.ones(4, np.float32)}, ["res"])["res"]
+        np.testing.assert_allclose(got, np.full(4, 4.0))  # 2 doublings
+
+    def test_bounded_roundtrip_serialization(self, tmp_path):
+        sd = SameDiff()
+        x = sd.placeholder("x", shape=(4,))
+        out = _doubling_loop(sd, x, max_iterations=16).rename("res")
+        feed = {"x": np.ones(4, np.float32)}
+        want = sd.output(feed, ["res"])["res"]
+        p = str(tmp_path / "bounded.sdz")
+        sd.save(p)
+        sd2 = SameDiff.load(p)
+        got = sd2.output(feed, ["res"])["res"]
+        np.testing.assert_allclose(got, want)
+
+
+class TestUnboundedWhileGradRaises:
+    def test_capture_gradient_raises_loudly(self):
+        sd = SameDiff()
+        w = sd.var("w", array=np.float32(0.5))
+        x = sd.placeholder("x", shape=(3,))
+        out = sd.while_loop(
+            [x],
+            lambda v: v.sd._op("lt",
+                               [v.sd._op("reduce_sum", [v]),
+                                v.sd.constant(np.float32(30.0))]),
+            lambda v: v.sd._op("add", [v, w]))
+        sd._op("reduce_sum", [out]).rename("loss")
+        sd.set_loss_variables(["loss"])
+        with pytest.raises(Exception, match="max_iterations"):
+            sd.calculate_gradients({"x": np.ones(3, np.float32)},
+                                   ["w"])
+
+    def test_forward_still_works_unbounded(self):
+        sd = SameDiff()
+        x = sd.placeholder("x", shape=(4,))
+        out = _doubling_loop(sd, x).rename("res")
+        got = sd.output({"x": np.ones(4, np.float32)}, ["res"])["res"]
+        np.testing.assert_allclose(got, np.full(4, 32.0))
