@@ -518,6 +518,13 @@ class SameDiff:
         child._tracing_parent = self
         proxies = [child.placeholder(f"_arg{i}", shape=None)
                    for i in range(n_args)]
+        try:
+            # zero-arg bodies have no proxy to learn the child graph
+            # from; publish it on the callable (the TF importer's
+            # function bodies read this to emit into the right graph)
+            fn._trace_child_sd = child
+        except (AttributeError, TypeError):
+            pass
         res = fn(*proxies) if n_args else fn()
         outs = list(res) if isinstance(res, (list, tuple)) else [res]
         outs = [(o if o.sd is child else child._import_foreign(o))
@@ -531,11 +538,13 @@ class SameDiff:
         # (_import_foreign) mapping back to their owner graph.
         # Captures owned by THIS graph become extra op INPUTS — live,
         # differentiable values at runtime (a captured trainable
-        # receives gradients through cond/scan; while_loop stops
-        # their gradients — XLA while has no reverse rule). Captures
-        # of some OTHER graph (nested subgraphs) are frozen at trace
-        # time; their owner drops compiled programs when such a
-        # variable trains.
+        # receives gradients through cond/scan and through
+        # while_loop(max_iterations=N); an UNBOUNDED while_loop
+        # raises on any gradient request through its outputs — XLA
+        # while has no reverse rule, and silence would train wrong).
+        # Captures of some OTHER graph (nested subgraphs) are frozen
+        # at trace time; their owner drops compiled programs when
+        # such a variable trains.
         parent_caps = []     # (local_name, parent_name)
         frozen_caps = []     # (local_name, owner, owner_name)
         for local, (owner, pname) in child._captures.items():

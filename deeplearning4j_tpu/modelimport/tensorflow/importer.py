@@ -406,7 +406,10 @@ class GraphDefImporter:
             for n in fd.nodes]
 
         def fn(*args):
-            child_sd = args[0].sd if args else self.sd
+            # the child graph comes from the proxies, or (zero-arg
+            # branches) from the handle _trace_subgraph publishes
+            child_sd = (args[0].sd if args
+                        else getattr(fn, "_trace_child_sd", self.sd))
             sub = GraphDefImporter.__new__(GraphDefImporter)
             sub.nodes = norm_nodes
             sub.functions = self.functions

@@ -3,9 +3,11 @@
 Reference parity: ``org.deeplearning4j.zoo.**`` (SURVEY.md D15): ``ZooModel``
 base with ``init()`` building the network; ``LeNet``, ``SimpleCNN``,
 ``VGG16/19``, ``ResNet50``, ``AlexNet`` first (the BASELINE configs need
-LeNet + ResNet50). Pretrained-weight download (``initPretrained``) is a
-checkpoint-load hook here — this container has no egress, so weights load
-from a local path.
+LeNet + ResNet50). ``init_pretrained()`` loads the checkpoints BUNDLED
+under ``models/pretrained/`` (trained + gated by
+``scripts/train_pretrained.py`` — this container has no egress, so the
+weights ship with the package instead of downloading); pass a path for
+your own checkpoints.
 
 Architectures follow the reference zoo's configurations; layouts are NHWC
 (TPU-first). ResNet50 is the BASELINE.json north-star model: a
@@ -32,18 +34,46 @@ from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
 from deeplearning4j_tpu.nn.weights import WeightInit
 
 
+#: bundled checkpoints (scripts/train_pretrained.py trains and gates
+#: them; meta.json records accuracy/dataset). The reference downloads
+#: from its model repository; zero egress here, so the weights SHIP
+#: with the package instead.
+def pretrained_dir():
+    import pathlib
+    return pathlib.Path(__file__).parent / "pretrained"
+
+
+def pretrained_meta() -> dict:
+    import json
+    with open(pretrained_dir() / "meta.json") as fh:
+        return json.load(fh)
+
+
 class ZooModel:
     """Base (reference: org.deeplearning4j.zoo.ZooModel)."""
+
+    #: key into the bundled pretrained/ dir; None = no shipped weights
+    pretrained_name: Optional[str] = None
 
     def init(self):
         """Build and initialize the network."""
         raise NotImplementedError
 
-    def init_pretrained(self, path):
-        """Load pretrained weights from a local checkpoint zip
-        (reference downloads+caches; zero-egress here)."""
+    def init_pretrained(self, path=None):
+        """Load pretrained weights (reference: initPretrained — it
+        downloads+caches; here the default resolves to the checkpoint
+        bundled with the package, or pass an explicit zip path)."""
         from deeplearning4j_tpu.utils import ModelSerializer
-        return ModelSerializer.restore_model(path)
+        if path is None:
+            name = self.pretrained_name
+            if name is None:
+                raise ValueError(
+                    f"{type(self).__name__} has no bundled pretrained "
+                    f"weights; pass an explicit checkpoint path")
+            path = str(pretrained_dir() / f"{name}.zip")
+        return ModelSerializer.restore_model(str(path))
+
+    initPretrained = init_pretrained
 
     def meta_data(self) -> dict:
         return {"name": type(self).__name__}
@@ -52,6 +82,7 @@ class ZooModel:
 @dataclass
 class LeNet(ZooModel):
     """Reference: org.deeplearning4j.zoo.model.LeNet (MNIST-class)."""
+    pretrained_name = "lenet"
     num_classes: int = 10
     height: int = 28
     width: int = 28
@@ -232,7 +263,14 @@ class ResNet50(ZooModel):
     """Reference: org.deeplearning4j.zoo.model.ResNet50 — the
     BASELINE.json north-star model (ComputationGraph, conv/BN/pool
     lowerings). Standard [3, 4, 6, 3] bottleneck architecture, NHWC.
+
+    The bundled checkpoint ('resnet_cifar') is the CIFAR-scale
+    variant trained by scripts/train_pretrained.py — restoring it
+    returns THAT graph (32x32, STAGES ((2,16),(2,32))), not the
+    ImageNet-sized default, since a checkpoint zip carries its full
+    configuration (no ImageNet data exists in this container).
     """
+    pretrained_name = "resnet_cifar"
     num_classes: int = 1000
     height: int = 224
     width: int = 224
@@ -331,3 +369,40 @@ class ResNet50(ZooModel):
 def _relu_layer():
     from deeplearning4j_tpu.nn.conf.layers import ActivationLayer
     return ActivationLayer(activation=Activation.RELU)
+
+
+# -- convenience constructors over the bundled checkpoints ------------------
+def lenet(pretrained: bool = False, **kw):
+    """LeNet network; ``pretrained=True`` loads the bundled
+    synthetic-MNIST checkpoint (>=99% on its test split — meta.json)."""
+    if pretrained:
+        if kw:
+            raise ValueError(
+                f"lenet(pretrained=True) loads the bundled checkpoint "
+                f"with its own architecture; architecture kwargs "
+                f"{sorted(kw)} would be silently ignored — drop them "
+                f"or build fresh with pretrained=False")
+        return LeNet().init_pretrained()
+    return LeNet(**kw).init()
+
+
+def resnet_cifar(pretrained: bool = True):
+    """The bundled CIFAR-scale ResNet checkpoint (see ResNet50 note)."""
+    if pretrained:
+        return ResNet50().init_pretrained()
+    return ResNet50(num_classes=10, height=32, width=32,
+                    STAGES=((2, 16), (2, 32))).init()
+
+
+def char_rnn(pretrained: bool = True):
+    """Bundled GravesLSTM character model. Returns (net, chars) — the
+    vocabulary (index -> char) ships in pretrained/meta.json."""
+    if not pretrained:
+        raise ValueError("char_rnn is only offered as the bundled "
+                         "checkpoint; build your own via examples/"
+                         "char_rnn.py otherwise")
+    from deeplearning4j_tpu.utils import ModelSerializer
+    net = ModelSerializer.restore_model(
+        str(pretrained_dir() / "charrnn.zip"))
+    chars = pretrained_meta()["charrnn"]["chars"]
+    return net, chars

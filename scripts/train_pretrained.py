@@ -1,0 +1,128 @@
+"""Train and save the bundled pretrained zoo checkpoints
+(SURVEY.md D15: the reference ZooModel ships usable pretrained
+weights; zero egress forbids downloads, not shipping locally-trained
+checkpoints).
+
+Writes deeplearning4j_tpu/models/pretrained/{lenet,charrnn,
+resnet_cifar}.zip plus meta.json recording the dataset (deterministic
+synthetic surrogates — the only data in this container), the gate
+each checkpoint passed, and the training config. Re-run this script
+to regenerate; tests/test_pretrained_zoo.py enforces the gates on
+the committed artifacts.
+"""
+import json
+import os
+import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parents[1]))
+
+import numpy as np
+
+OUT = (Path(__file__).resolve().parents[1] / "deeplearning4j_tpu" /
+       "models" / "pretrained")
+
+CHARRNN_TEXT = ("the quick brown fox jumps over the lazy dog. "
+                "pack my box with five dozen liquor jugs. ") * 50
+
+
+def train_lenet():
+    from deeplearning4j_tpu.datasets.mnist import MnistDataSetIterator
+    from deeplearning4j_tpu.models.zoo import LeNet
+    from deeplearning4j_tpu.utils import ModelSerializer
+
+    net = LeNet(num_classes=10).init()
+    train_it = MnistDataSetIterator(256, train=True, num_examples=20000)
+    test_it = MnistDataSetIterator(512, train=False, num_examples=5000)
+    for _ in range(3):
+        net.fit(train_it)
+    ev = net.evaluate(test_it)
+    acc = float(ev.accuracy())
+    assert acc >= 0.99, f"LeNet gate failed: {acc:.4f} < 0.99"
+    ModelSerializer.write_model(net, str(OUT / "lenet.zip"),
+                                save_updater=False)
+    return {"accuracy": round(acc, 4), "dataset": "synthetic-mnist",
+            "epochs": 3, "train_examples": 20000}
+
+
+def train_charrnn():
+    from deeplearning4j_tpu.activations import Activation
+    from deeplearning4j_tpu.learning import Adam
+    from deeplearning4j_tpu.lossfunctions import LossFunction
+    from deeplearning4j_tpu.nn import (InputType, MultiLayerNetwork,
+                                       NeuralNetConfiguration)
+    from deeplearning4j_tpu.nn.conf.layers import RnnOutputLayer
+    from deeplearning4j_tpu.nn.conf.layers_recurrent import GravesLSTM
+    from deeplearning4j_tpu.utils import ModelSerializer
+
+    chars = sorted(set(CHARRNN_TEXT))
+    idx = {c: i for i, c in enumerate(chars)}
+    n = len(chars)
+    seq_len = 32
+    ids = np.asarray([idx[c] for c in CHARRNN_TEXT], np.int32)
+    starts = np.arange(0, len(ids) - seq_len - 1, seq_len)
+    eye = np.eye(n, dtype=np.float32)
+    x = np.stack([eye[ids[s:s + seq_len]] for s in starts])
+    y = np.stack([eye[ids[s + 1:s + seq_len + 1]] for s in starts])
+
+    conf = (NeuralNetConfiguration.Builder()
+            .seed(12345)
+            .updater(Adam(5e-3))
+            .list()
+            .layer(GravesLSTM(n_out=128, activation=Activation.TANH))
+            .layer(RnnOutputLayer(n_out=n,
+                                  loss_function=LossFunction.MCXENT,
+                                  activation=Activation.SOFTMAX))
+            .set_input_type(InputType.recurrent(n, seq_len))
+            .build())
+    net = MultiLayerNetwork(conf).init()
+    for _ in range(60):
+        net.fit(x, y)
+    probs = np.asarray(net.output(x))
+    acc = float((probs.argmax(-1) == y.argmax(-1)).mean())
+    assert acc >= 0.90, f"char-RNN gate failed: {acc:.4f} < 0.90"
+    ModelSerializer.write_model(net, str(OUT / "charrnn.zip"),
+                                save_updater=False)
+    return {"next_char_accuracy": round(acc, 4), "hidden": 128,
+            "seq_len": seq_len, "chars": "".join(chars)}
+
+
+def train_resnet_cifar():
+    from deeplearning4j_tpu.datasets.vision import Cifar10DataSetIterator
+    from deeplearning4j_tpu.learning import Adam
+    from deeplearning4j_tpu.models.zoo import ResNet50
+    from deeplearning4j_tpu.utils import ModelSerializer
+
+    net = ResNet50(num_classes=10, height=32, width=32,
+                   updater=Adam(1e-3),
+                   STAGES=((2, 16), (2, 32))).init()
+    train_it = Cifar10DataSetIterator(256, train=True,
+                                      num_examples=10000)
+    test_it = Cifar10DataSetIterator(512, train=False,
+                                     num_examples=2000)
+    for _ in range(3):
+        net.fit(train_it)
+    ev = net.evaluate(test_it)
+    acc = float(ev.accuracy())
+    assert acc >= 0.90, f"ResNet-CIFAR gate failed: {acc:.4f} < 0.90"
+    ModelSerializer.write_model(net, str(OUT / "resnet_cifar.zip"),
+                                save_updater=False)
+    return {"accuracy": round(acc, 4), "dataset": "synthetic-cifar10",
+            "stages": [[2, 16], [2, 32]], "epochs": 3,
+            "train_examples": 10000}
+
+
+def main():
+    OUT.mkdir(parents=True, exist_ok=True)
+    meta = {"lenet": train_lenet(),
+            "charrnn": train_charrnn(),
+            "resnet_cifar": train_resnet_cifar()}
+    with open(OUT / "meta.json", "w") as fh:
+        json.dump(meta, fh, indent=2)
+    for name, m in meta.items():
+        size = os.path.getsize(OUT / f"{name}.zip")
+        print(f"{name}: {m} ({size / 1e6:.2f} MB)")
+
+
+if __name__ == "__main__":
+    main()

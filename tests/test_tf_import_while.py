@@ -199,3 +199,22 @@ class TestFunctionBodyPorts:
         gd, _ = _freeze(f, tf.TensorSpec((2,), tf.float32))
         with pytest.raises(NotImplementedError, match="no mapping"):
             TensorflowFrameworkImporter.run_import(gd, {"x": (2,)})
+
+    def test_zero_operand_branches(self):
+        """Branches that capture nothing (constant-only lambdas)
+        produce zero-arg FunctionDefs; each must still trace into its
+        OWN child graph (regression: both imported into the parent,
+        colliding on same-named nodes)."""
+        def f(x):
+            return tf.cond(tf.reduce_sum(x) > 0.0,
+                           lambda: tf.constant([1.0, 2.0]),
+                           lambda: tf.constant([3.0, 4.0]))
+
+        spec = tf.TensorSpec((3,), tf.float32)
+        gd, frozen = _freeze(f, spec)
+        imp = TensorflowFrameworkImporter.run_import(gd, {"x": (3,)})
+        out = _output_name(imp)
+        for xv in (np.float32([1, 1, 1]), np.float32([-1, -1, -1])):
+            want = np.asarray(frozen(tf.constant(xv)))
+            got = imp.output({"x": xv}, [out])[out]
+            np.testing.assert_allclose(got, want)
