@@ -62,7 +62,10 @@ class ZooModel:
     def init_pretrained(self, path=None):
         """Load pretrained weights (reference: initPretrained — it
         downloads+caches; here the default resolves to the checkpoint
-        bundled with the package, or pass an explicit zip path)."""
+        bundled with the package, or pass an explicit zip path). The
+        checkpoint zip carries its own full configuration; customized
+        architecture fields on this instance therefore cannot apply,
+        and customizing them while loading bundled weights raises."""
         from deeplearning4j_tpu.utils import ModelSerializer
         if path is None:
             name = self.pretrained_name
@@ -70,8 +73,25 @@ class ZooModel:
                 raise ValueError(
                     f"{type(self).__name__} has no bundled pretrained "
                     f"weights; pass an explicit checkpoint path")
+            changed = self._non_default_fields()
+            if changed:
+                raise ValueError(
+                    f"{type(self).__name__}({', '.join(changed)}) "
+                    f"customizes the architecture, but the bundled "
+                    f"'{name}' checkpoint carries its own "
+                    f"configuration — the customization would be "
+                    f"silently ignored. Drop the kwargs, or pass an "
+                    f"explicit checkpoint path trained with them.")
             path = str(pretrained_dir() / f"{name}.zip")
         return ModelSerializer.restore_model(str(path))
+
+    def _non_default_fields(self):
+        import dataclasses
+        if not dataclasses.is_dataclass(self):
+            return []
+        return [f.name for f in dataclasses.fields(self)
+                if f.default is not dataclasses.MISSING
+                and getattr(self, f.name) != f.default]
 
     initPretrained = init_pretrained
 

@@ -97,3 +97,12 @@ class TestTransferFromPretrained:
         pred = np.asarray(ft.output(xte)).argmax(-1)
         acc = float((pred == yte).mean())
         assert acc >= 0.90, acc
+
+    def test_customized_architecture_rejected(self):
+        """Customized dataclass fields cannot apply to a bundled
+        checkpoint (it carries its own config) — loading must raise,
+        not silently return a different architecture."""
+        with pytest.raises(ValueError, match="customizes"):
+            ResNet50(num_classes=5).init_pretrained()
+        with pytest.raises(ValueError, match="customizes"):
+            LeNet(height=32, width=32).init_pretrained()
