@@ -23,7 +23,8 @@ import numpy as np
 from deeplearning4j_tpu.autodiff.registry import get_op
 from deeplearning4j_tpu.autodiff.samediff import (SameDiff, SDVariable,
                                                   VariableType)
-from deeplearning4j_tpu.modelimport.tensorflow import mappings
+from deeplearning4j_tpu.modelimport.tensorflow import (mappings,
+                                                       v1_control_flow)
 from deeplearning4j_tpu.modelimport.tensorflow.mappings import TF_OP_MAP
 from deeplearning4j_tpu.modelimport.tensorflow.protobuf import (
     FunctionDef, NodeDef, parse_graphdef_with_library, tf_dtype_to_np)
@@ -316,6 +317,12 @@ class GraphDefImporter:
         return out
 
     def run(self) -> SameDiff:
+        if any(n.op in v1_control_flow.V1_CONTROL_FLOW_OPS
+               for n in self.nodes):
+            # legacy v1 frames (frozen tf.while_loop/tf.cond) →
+            # functional While/If, which lower to lax below
+            self.nodes = v1_control_flow.deframe(self.nodes,
+                                                 self.functions)
         by_name = {n.name: n for n in self.nodes}
         order = _topo_sort(self.nodes, by_name)
         unmapped = sorted({n.op
@@ -441,7 +448,11 @@ class GraphDefImporter:
                      if not r.startswith("^")]
         mi = self.while_max_iterations
         if isinstance(mi, dict):
-            mi = mi.get(node.name)
+            key = node.name
+            if key not in mi and key.endswith("__v1_while"):
+                # deframed v1 loop: fall back to the TF loop name
+                key = key[:-len("__v1_while")]
+            mi = mi.get(key)
         n_ops_before = len(self.sd.ops)
         outs = self.sd.while_loop(
             loop_vars, self._function_as_callable(cond_fd),

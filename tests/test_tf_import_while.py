@@ -14,11 +14,10 @@ from deeplearning4j_tpu.modelimport.tensorflow import (  # noqa: E402
 
 
 def _freeze(fn, *specs):
-    """Concrete-function GraphDef (NOT convert_variables_to_constants:
-    that pass lowers functional While into legacy v1 Enter/Exit frames;
-    TF2 SavedModel/tf.function exports keep the functional form this
-    importer maps). The test fns take all tensors as args, so there
-    are no variables to freeze."""
+    """Concrete-function GraphDef — the TF2 functional export form.
+    The legacy v1 Enter/Exit frame form that
+    convert_variables_to_constants produces is covered by
+    test_tf_import_v1_control_flow.py (frame reconstruction)."""
     cf = tf.function(fn).get_concrete_function(*specs)
     return cf.graph.as_graph_def().SerializeToString(), cf
 
