@@ -297,7 +297,24 @@ def _lse(ins, attrs):
 
 @op("cumsum", "reduce")
 def _cumsum(ins, attrs):
-    return jnp.cumsum(ins[0], axis=attrs.get("axis", -1))
+    """TF Cumsum / ONNX CumSum semantics: ``exclusive`` shifts the
+    scan by one (first element 0), ``reverse`` scans from the end."""
+    x = ins[0]
+    ax = attrs.get("axis", -1) % x.ndim
+    if attrs.get("reverse", False):
+        x = jnp.flip(x, ax)
+    y = jnp.cumsum(x, axis=ax)
+    if attrs.get("exclusive", False):
+        # shift by one (exact — never subtract, which breaks on inf
+        # and loses precision on cancellation)
+        pad = [(0, 0)] * x.ndim
+        pad[ax] = (1, 0)
+        sl = [slice(None)] * x.ndim
+        sl[ax] = slice(0, x.shape[ax])
+        y = jnp.pad(y, pad)[tuple(sl)]
+    if attrs.get("reverse", False):
+        y = jnp.flip(y, ax)
+    return y
 
 
 @op("cumprod", "reduce")
@@ -328,7 +345,26 @@ def _argmin(ins, attrs):
 
 @op("top_k", "indexreduce")
 def _topk(ins, attrs):
-    return lax.top_k(ins[0], attrs["k"])
+    """``axis`` (default last) and ``largest`` (default True; False =
+    ONNX TopK smallest mode).  Non-last axes move to the minor
+    position for the XLA-native minor-dim sort and back.  Smallest
+    mode uses a stable ascending argsort (exact for every dtype —
+    negation would corrupt unsigned ints and INT_MIN)."""
+    x = ins[0]
+    k = attrs["k"]
+    ax = attrs.get("axis", -1) % x.ndim
+    largest = attrs.get("largest", True)
+    if ax != x.ndim - 1:
+        x = jnp.moveaxis(x, ax, -1)
+    if largest:
+        vals, idx = lax.top_k(x, k)
+    else:
+        idx = jnp.argsort(x, axis=-1)[..., :k].astype(jnp.int32)
+        vals = jnp.take_along_axis(x, idx, axis=-1)
+    if ax != ins[0].ndim - 1:
+        vals = jnp.moveaxis(vals, -1, ax)
+        idx = jnp.moveaxis(idx, -1, ax)
+    return vals, idx
 
 
 @op("in_top_k", "indexreduce")
@@ -470,8 +506,18 @@ def _flip(ins, attrs):
 
 @op("gather", "shape")
 def _gather(ins, attrs):
-    return jnp.take(ins[0], ins[1].astype(jnp.int32),
-                    axis=attrs.get("axis", 0))
+    """``batch_dims`` (TF GatherV2): the leading b dims of params and
+    indices are shared batch dims; the take applies per batch element
+    (vmapped — lowers to one XLA gather)."""
+    bd = int(attrs.get("batch_dims", 0))
+    axis = attrs.get("axis", 0) % ins[0].ndim
+    idx = ins[1].astype(jnp.int32)
+    if bd == 0:
+        return jnp.take(ins[0], idx, axis=axis)
+    take = lambda p, i: jnp.take(p, i, axis=axis - bd)
+    for _ in range(bd):
+        take = jax.vmap(take)
+    return take(ins[0], idx)
 
 
 @op("gather_nd", "shape")
@@ -850,9 +896,11 @@ def _separable(ins, attrs):
 @op("deconv2d", "convolution")
 def _deconv2d(ins, attrs):
     x, w = ins[0], ins[1]
+    dil = tuple(attrs.get("dilation", (1, 1)))
     out = lax.conv_transpose(
         x, w, strides=tuple(attrs.get("stride", (1, 1))),
         padding=attrs.get("padding", "SAME"),
+        rhs_dilation=None if dil == (1, 1) else dil,
         transpose_kernel=attrs.get("transpose_kernel", False),
         dimension_numbers=_conv_dn(4))
     if len(ins) > 2:

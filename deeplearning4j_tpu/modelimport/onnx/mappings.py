@@ -543,24 +543,19 @@ def _one_hot(ctx, node):
 @onnx_op("CumSum")
 def _cumsum(ctx, node):
     axis = int(np.asarray(ctx.require_static(node, 1)).reshape(-1)[0])
-    if node.attr("exclusive", 0) or node.attr("reverse", 0):
-        raise NotImplementedError("CumSum: exclusive/reverse modes")
     return ctx.sd._op("cumsum", [ctx.var(node.inputs[0])],
-                      {"axis": axis})
+                      {"axis": axis,
+                       "exclusive": bool(node.attr("exclusive", 0)),
+                       "reverse": bool(node.attr("reverse", 0))})
 
 
 @onnx_op("TopK")
 def _topk(ctx, node):
     k = int(np.asarray(ctx.require_static(node, 1)).reshape(-1)[0])
-    axis = int(node.attr("axis", -1))
-    in_shape = ctx.shape_of(node.inputs[0])
-    rank = len(in_shape) if in_shape is not None else None
-    if axis != -1 and (rank is None or axis != rank - 1):
-        raise NotImplementedError("TopK: only last axis")
-    if not bool(node.attr("largest", 1)):
-        raise NotImplementedError("TopK: smallest mode")
     return ctx.sd._op("top_k", [ctx.var(node.inputs[0])],
-                      {"k": k}, n_out=2)
+                      {"k": k, "axis": int(node.attr("axis", -1)),
+                       "largest": bool(node.attr("largest", 1))},
+                      n_out=2)
 
 
 @onnx_op("Einsum")
@@ -661,16 +656,26 @@ def _instance_norm(ctx, node):
 
 @onnx_op("LayerNormalization")
 def _layer_norm_onnx(ctx, node):
+    """ONNX normalizes over dims [axis, rank): a non-last axis becomes
+    a tuple of axes; Scale/B have shape x.shape[axis:] so they
+    broadcast against x without reshapes."""
     axis = int(node.attr("axis", -1))
-    in_shape = ctx.shape_of(node.inputs[0])
-    rank = len(in_shape) if in_shape is not None else None
-    if axis != -1 and (rank is None or axis != rank - 1):
-        raise NotImplementedError("LayerNormalization: only last axis")
     eps = float(node.attr("epsilon", 1e-5))
+    if axis == -1:
+        ax = -1
+    else:
+        in_shape = ctx.shape_of(node.inputs[0])
+        if in_shape is None:
+            raise NotImplementedError(
+                "LayerNormalization: non-last axis needs a known "
+                "input shape")
+        rank = len(in_shape)
+        ax = axis % rank
+        ax = -1 if ax == rank - 1 else tuple(range(ax, rank))
     ins = [ctx.var(node.inputs[0]), ctx.var(node.inputs[1])]
     if len(node.inputs) > 2 and node.inputs[2]:
         ins.append(ctx.var(node.inputs[2]))
-    return ctx.sd._op("layer_norm", ins, {"axis": -1, "epsilon": eps})
+    return ctx.sd._op("layer_norm", ins, {"axis": ax, "epsilon": eps})
 
 
 @onnx_op("PRelu")
@@ -707,40 +712,92 @@ def _mod(ctx, node):
 
 @onnx_op("ConvTranspose")
 def _conv_transpose_onnx(ctx, node):
+    """Full ONNX attribute surface: group, dilations, output_padding,
+    asymmetric pads, auto_pad.  Output size per spatial dim:
+    (i-1)*s + (k-1)*d + 1 - pad_begin - pad_end + output_padding."""
     w_np = ctx.static(node.inputs[1])
     if w_np is None:
         raise NotImplementedError(
             "ConvTranspose with non-constant weights")
-    if int(node.attr("group", 1)) != 1:
-        raise NotImplementedError("ConvTranspose: grouped")
-    if node.attr("dilations") is not None and \
-            any(int(d) != 1 for d in node.attr("dilations", [])):
-        raise NotImplementedError("ConvTranspose: dilations != 1")
+    group = int(node.attr("group", 1))
+    strides = [int(s) for s in node.attr("strides", [1, 1])]
+    dil = [int(d) for d in node.attr("dilations", [1, 1])]
+    out_pad = [int(p) for p in node.attr("output_padding", [0, 0])]
+    kh, kw = w_np.shape[2], w_np.shape[3]
+    ke = [(kh - 1) * dil[0] + 1, (kw - 1) * dil[1] + 1]
     ap = node.attr("auto_pad", b"NOTSET")
     ap = ap.decode() if isinstance(ap, bytes) else ap
-    if ap not in ("NOTSET", ""):
+    out_shape = node.attr("output_shape")
+
+    def _split(totals, extra_at_begin):
+        # int(t/2) truncates toward zero: negative totals (stride >
+        # kernel extent) must keep begin at 0 so the first output
+        # sample stays at the origin
+        begin = [(t - int(t / 2) if extra_at_begin else int(t / 2))
+                 for t in totals]
+        return begin + [t - b for t, b in zip(totals, begin)]
+
+    if out_shape is not None:
+        # pads derived from the requested output size (spec formula)
+        xin = ctx.shape_of(node.inputs[0])
+        if xin is None:
+            raise NotImplementedError(
+                "ConvTranspose: output_shape needs a known input "
+                "shape")
+        totals = [strides[d] * (xin[2 + d] - 1) + out_pad[d] + ke[d]
+                  - int(out_shape[d]) for d in range(2)]
+        pads = _split(totals, extra_at_begin=(ap != "SAME_UPPER"))
+    elif ap in ("SAME_UPPER", "SAME_LOWER"):
+        # output_shape[i] = input_shape[i] * strides[i]; a negative
+        # total (stride > kernel extent) flows through as extra
+        # conv_transpose padding — no clamp
+        totals = [ke[d] - strides[d] for d in range(2)]
+        pads = _split(totals, extra_at_begin=(ap == "SAME_LOWER"))
+    elif ap in ("NOTSET", "", "VALID"):
+        pads = [int(p) for p in node.attr("pads", [0, 0, 0, 0])]
+    else:
         raise NotImplementedError(f"ConvTranspose: auto_pad={ap}")
-    strides = [int(s) for s in node.attr("strides", [1, 1])]
-    pads = [int(p) for p in node.attr("pads", [0, 0, 0, 0])]
-    if node.attr("output_padding") is not None and \
-            any(int(p) for p in node.attr("output_padding", [])):
-        raise NotImplementedError("ConvTranspose: output_padding")
-    if pads[0] != pads[2] or pads[1] != pads[3]:
-        raise NotImplementedError("ConvTranspose: asymmetric pads")
-    x = _nchw_to_nhwc(ctx, ctx.var(node.inputs[0]))
-    # ONNX W is IOHW [C_in, C_out, kH, kW]; ours HWIO (conv_transpose
-    # applies the kernel un-mirrored, matching gradient-of-conv with
-    # the spatial flip baked in here)
-    w = np.transpose(w_np, (2, 3, 0, 1))[::-1, ::-1]
-    wv = ctx.sd.constant(ctx.unique(f"{node.inputs[1]}_hwio"),
-                         np.ascontiguousarray(w))
     # conv_transpose explicit padding applies to the s-dilated input;
-    # k-1-p per side yields ONNX's (i-1)*s + k - 2p output size
-    kh, kw = w_np.shape[2], w_np.shape[3]
-    attrs = {"stride": tuple(strides),
-             "padding": [(kh - 1 - pads[0], kh - 1 - pads[0]),
-                         (kw - 1 - pads[1], kw - 1 - pads[1])]}
-    y = ctx.sd._op("deconv2d", [x, wv], attrs)
+    # ke-1-p per side yields the ONNX output size, with
+    # output_padding widening the END side only
+    attrs = {"stride": tuple(strides), "dilation": tuple(dil),
+             "padding": [(ke[0] - 1 - pads[0],
+                          ke[0] - 1 - pads[2] + out_pad[0]),
+                         (ke[1] - 1 - pads[1],
+                          ke[1] - 1 - pads[3] + out_pad[1])]}
+    x = _nchw_to_nhwc(ctx, ctx.var(node.inputs[0]))
+
+    def _wv(arr, tag):
+        # ONNX W is IOHW [C_in, C_out, kH, kW]; ours HWIO
+        # (conv_transpose applies the kernel un-mirrored, matching
+        # gradient-of-conv with the spatial flip baked in here)
+        w = np.transpose(arr, (2, 3, 0, 1))[::-1, ::-1]
+        return ctx.sd.constant(ctx.unique(f"{node.inputs[1]}{tag}"),
+                               np.ascontiguousarray(w))
+
+    if group == 1:
+        y = ctx.sd._op("deconv2d", [x, _wv(w_np, "_hwio")], attrs)
+    else:
+        # per-group transpose-conv + concat on channels (W holds
+        # C_in total rows, C_out/group columns)
+        xin_shape = ctx.shape_of(node.inputs[0])   # NCHW
+        if xin_shape is None:
+            raise NotImplementedError(
+                "grouped ConvTranspose without a known input shape")
+        n_, c_, h_, wdim = xin_shape
+        cg = c_ // group
+        outs = []
+        for g in range(group):
+            xs = ctx.sd._op(
+                "strided_slice", [x],
+                {"begin": [0, 0, 0, g * cg],
+                 "end": [n_, h_, wdim, (g + 1) * cg],
+                 "strides": [1, 1, 1, 1]})
+            outs.append(ctx.sd._op(
+                "deconv2d",
+                [xs, _wv(w_np[g * cg:(g + 1) * cg], f"_g{g}")],
+                attrs))
+        y = ctx.sd._op("concat", outs, {"axis": 3})
     if len(node.inputs) > 2 and node.inputs[2]:
         y = ctx.sd._op("add", [y, ctx.var(node.inputs[2])])
     return _nhwc_to_nchw(ctx, y)

@@ -194,7 +194,10 @@ def _argmin(ctx, node):
 @tf_op("Cumsum")
 def _cumsum(ctx, node):
     axis = int(np.asarray(ctx.require_static(node, 1)).reshape(())[()])
-    return ctx.sd._op("cumsum", [ctx.var(node.inputs[0])], {"axis": axis})
+    return ctx.sd._op("cumsum", [ctx.var(node.inputs[0])],
+                      {"axis": axis,
+                       "exclusive": bool(node.attr("exclusive", False)),
+                       "reverse": bool(node.attr("reverse", False))})
 
 
 @tf_op("TopKV2")
@@ -339,11 +342,9 @@ def _slice(ctx, node):
 def _gather_v2(ctx, node):
     axis = int(np.asarray(ctx.require_static(node, 2)).reshape(())[()])
     bd = int(node.attr("batch_dims", 0))
-    if bd != 0:
-        raise NotImplementedError("GatherV2 batch_dims != 0")
     return ctx.sd._op("gather",
                       [ctx.var(node.inputs[0]), ctx.var(node.inputs[1])],
-                      {"axis": axis})
+                      {"axis": axis, "batch_dims": bd})
 
 
 @tf_op("Gather")
@@ -558,20 +559,32 @@ def strided_slice_spec(begin, end, strides, begin_mask, end_mask,
 
 # -- breadth batch 2: 3D conv/pool, block rearrange, segment/scatter, --------
 # -- linalg, xent losses (SURVEY.md S6 coverage accounting) ------------------
+def _block_rearrange(ctx, node, op_name):
+    """SpaceToDepth/DepthToSpace in either layout: the registry op is
+    NHWC-native; NCHW wraps it in two transposes (XLA folds layout
+    permutations into the surrounding program)."""
+    x = ctx.var(node.inputs[0])
+    attrs = {"block_size": int(node.attr("block_size", 2))}
+    fmt = node.attr("data_format", b"NHWC")
+    if fmt not in (b"NHWC", b"NCHW"):
+        raise NotImplementedError(f"{node.op}: data_format={fmt}")
+    nchw = fmt == b"NCHW"
+    if nchw:
+        x = ctx.sd._op("transpose", [x], {"axes": (0, 2, 3, 1)})
+    y = ctx.sd._op(op_name, [x], attrs)
+    if nchw:
+        y = ctx.sd._op("transpose", [y], {"axes": (0, 3, 1, 2)})
+    return y
+
+
 @tf_op("SpaceToDepth")
 def _space_to_depth(ctx, node):
-    if node.attr("data_format", b"NHWC") != b"NHWC":
-        raise NotImplementedError("SpaceToDepth: NHWC only")
-    return ctx.sd._op("space_to_depth", [ctx.var(node.inputs[0])],
-                      {"block_size": int(node.attr("block_size", 2))})
+    return _block_rearrange(ctx, node, "space_to_depth")
 
 
 @tf_op("DepthToSpace")
 def _depth_to_space(ctx, node):
-    if node.attr("data_format", b"NHWC") != b"NHWC":
-        raise NotImplementedError("DepthToSpace: NHWC only")
-    return ctx.sd._op("depth_to_space", [ctx.var(node.inputs[0])],
-                      {"block_size": int(node.attr("block_size", 2))})
+    return _block_rearrange(ctx, node, "depth_to_space")
 
 
 @tf_op("Conv3D")

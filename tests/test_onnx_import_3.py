@@ -1,0 +1,216 @@
+"""ONNX import conformance, batch 3 (round-2 verdict op-gap closure):
+ConvTranspose full attribute surface (grouped / dilated /
+output_padding / asymmetric pads / auto_pad / output_shape), TopK
+smallest + non-last axis, CumSum exclusive/reverse, non-last-axis
+LayerNormalization.  Fixtures hand-encoded with the in-repo ONNX
+encoder; ground truth from torch CPU."""
+import numpy as np
+import pytest
+
+torch = pytest.importorskip("torch")
+import torch.nn.functional as F  # noqa: E402
+
+from deeplearning4j_tpu.modelimport.onnx import import_onnx  # noqa: E402
+from deeplearning4j_tpu.modelimport.onnx.protobuf import (  # noqa: E402
+    encode_model, encode_node, encode_value_info)
+
+R = np.random.RandomState(7)
+
+
+def _run(nodes, inits, in_specs, out_specs, feeds):
+    model = encode_model(nodes, inits,
+                         [encode_value_info(n, s) for n, s in in_specs],
+                         [encode_value_info(n, s) for n, s in out_specs])
+    imp = import_onnx(model)
+    return imp.output(feeds)
+
+
+def _conv_transpose_case(x, w, want, **attrs):
+    nodes = [encode_node("ConvTranspose", ["x", "w"], ["y"], "ct",
+                         **attrs)]
+    got = _run(nodes, {"w": w}, [("x", x.shape)],
+               [("y", tuple(want.shape))], {"x": x})[0]
+    np.testing.assert_allclose(np.asarray(got), want, rtol=1e-4,
+                               atol=1e-4)
+
+
+class TestConvTransposeModes:
+    def test_grouped(self):
+        x = R.randn(2, 4, 5, 5).astype(np.float32)
+        w = R.randn(4, 3, 3, 3).astype(np.float32)  # C_in, C_out/g
+        want = F.conv_transpose2d(torch.tensor(x), torch.tensor(w),
+                                  stride=2, groups=2).numpy()
+        _conv_transpose_case(x, w, want, strides=[2, 2], group=2)
+
+    def test_dilated(self):
+        x = R.randn(1, 3, 6, 6).astype(np.float32)
+        w = R.randn(3, 2, 3, 3).astype(np.float32)
+        want = F.conv_transpose2d(torch.tensor(x), torch.tensor(w),
+                                  dilation=2).numpy()
+        _conv_transpose_case(x, w, want, dilations=[2, 2])
+
+    def test_output_padding(self):
+        x = R.randn(1, 3, 5, 5).astype(np.float32)
+        w = R.randn(3, 2, 3, 3).astype(np.float32)
+        want = F.conv_transpose2d(torch.tensor(x), torch.tensor(w),
+                                  stride=2, padding=1,
+                                  output_padding=1).numpy()
+        _conv_transpose_case(x, w, want, strides=[2, 2],
+                             pads=[1, 1, 1, 1],
+                             output_padding=[1, 1])
+
+    def test_asymmetric_pads(self):
+        x = R.randn(1, 2, 6, 6).astype(np.float32)
+        w = R.randn(2, 2, 3, 3).astype(np.float32)
+        # torch has no asymmetric transpose pads: emulate by slicing
+        # the unpadded result ([pad_begin : size - pad_end])
+        full = F.conv_transpose2d(torch.tensor(x),
+                                  torch.tensor(w), stride=2).numpy()
+        want = full[:, :, 1:full.shape[2] - 2, 0:full.shape[3] - 1]
+        _conv_transpose_case(x, w, want, strides=[2, 2],
+                             pads=[1, 0, 2, 1])
+
+    def test_grouped_dilated_combo(self):
+        x = R.randn(1, 4, 4, 4).astype(np.float32)
+        w = R.randn(4, 2, 2, 2).astype(np.float32)
+        want = F.conv_transpose2d(torch.tensor(x), torch.tensor(w),
+                                  stride=2, dilation=2, padding=1,
+                                  groups=2).numpy()
+        _conv_transpose_case(x, w, want, strides=[2, 2],
+                             dilations=[2, 2], pads=[1, 1, 1, 1],
+                             group=2)
+
+    def test_auto_pad_same_upper(self):
+        x = R.randn(1, 2, 5, 5).astype(np.float32)
+        w = R.randn(2, 3, 3, 3).astype(np.float32)
+        # SAME_UPPER: output = input * stride
+        s = 2
+        full = F.conv_transpose2d(torch.tensor(x), torch.tensor(w),
+                                  stride=s).numpy()
+        total = 3 - s                       # ke - s = 1
+        b = total // 2                      # extra at the END
+        want = full[:, :, b:b + 5 * s, b:b + 5 * s]
+        _conv_transpose_case(x, w, want, strides=[s, s],
+                             auto_pad=b"SAME_UPPER")
+
+    def test_auto_pad_same_upper_stride_exceeds_kernel(self):
+        """stride > kernel extent: total padding goes NEGATIVE and
+        must flow through (regression: a max(...,0) clamp shrank the
+        output below input*stride)."""
+        x = R.randn(1, 1, 5, 5).astype(np.float32)
+        w = R.randn(1, 1, 1, 1).astype(np.float32)
+        want = np.zeros((1, 1, 10, 10), np.float32)
+        want[:, :, ::2, ::2] = x * w[0, 0, 0, 0]
+        _conv_transpose_case(x, w, want, strides=[2, 2],
+                             auto_pad=b"SAME_UPPER")
+
+    def test_output_shape_attr(self):
+        x = R.randn(1, 2, 4, 4).astype(np.float32)
+        w = R.randn(2, 2, 3, 3).astype(np.float32)
+        # output_shape=[9,9]: total_pad = 2*3+3-9 = 0 → full output
+        want = F.conv_transpose2d(torch.tensor(x), torch.tensor(w),
+                                  stride=2).numpy()
+        _conv_transpose_case(x, w, want, strides=[2, 2],
+                             output_shape=[9, 9])
+
+
+class TestTopKModes:
+    def test_smallest(self):
+        x = R.randn(3, 8).astype(np.float32)
+        nodes = [encode_node("TopK", ["x", "k"], ["v", "i"], "tk",
+                             axis=-1, largest=0)]
+        got = _run(nodes, {"k": np.asarray(3, np.int64)},
+                   [("x", (3, 8))], [("v", (3, 3)), ("i", (3, 3))],
+                   {"x": x})
+        want_v, want_i = torch.topk(torch.tensor(x), 3, largest=False)
+        np.testing.assert_allclose(np.asarray(got[0]), want_v.numpy(),
+                                   atol=1e-6)
+        np.testing.assert_array_equal(np.asarray(got[1]),
+                                      want_i.numpy())
+
+    def test_non_last_axis(self):
+        x = R.randn(6, 4).astype(np.float32)
+        nodes = [encode_node("TopK", ["x", "k"], ["v", "i"], "tk",
+                             axis=0)]
+        got = _run(nodes, {"k": np.asarray(2, np.int64)},
+                   [("x", (6, 4))], [("v", (2, 4)), ("i", (2, 4))],
+                   {"x": x})
+        want_v, want_i = torch.topk(torch.tensor(x), 2, dim=0)
+        np.testing.assert_allclose(np.asarray(got[0]), want_v.numpy(),
+                                   atol=1e-6)
+        np.testing.assert_array_equal(np.asarray(got[1]),
+                                      want_i.numpy())
+
+    def test_smallest_integer_dtype(self):
+        """Smallest mode on int32 including 0 and INT32_MIN
+        (regression: negation corrupted unsigned/INT_MIN orderings —
+        INT_MIN negates to itself and ranked largest)."""
+        x = np.asarray([[5, 0, np.iinfo(np.int32).min, 3]],
+                       np.int32)
+        nodes = [encode_node("TopK", ["x", "k"], ["v", "i"], "tk",
+                             axis=-1, largest=0)]
+        got = _run(nodes, {"k": np.asarray(2, np.int64)},
+                   [("x", (1, 4))], [("v", (1, 2)), ("i", (1, 2))],
+                   {"x": x})
+        np.testing.assert_array_equal(
+            np.asarray(got[0]),
+            [[np.iinfo(np.int32).min, 0]])
+        np.testing.assert_array_equal(np.asarray(got[1]), [[2, 1]])
+
+    def test_smallest_non_last_axis(self):
+        x = R.randn(5, 3, 4).astype(np.float32)
+        nodes = [encode_node("TopK", ["x", "k"], ["v", "i"], "tk",
+                             axis=1, largest=0)]
+        got = _run(nodes, {"k": np.asarray(2, np.int64)},
+                   [("x", (5, 3, 4))], [("v", (5, 2, 4))], {"x": x})
+        want_v, _ = torch.topk(torch.tensor(x), 2, dim=1,
+                               largest=False)
+        np.testing.assert_allclose(np.asarray(got[0]), want_v.numpy(),
+                                   atol=1e-6)
+
+
+class TestCumSumModes:
+    @pytest.mark.parametrize("exclusive,reverse", [(1, 0), (0, 1),
+                                                   (1, 1)])
+    def test_modes(self, exclusive, reverse):
+        x = R.randn(4, 6).astype(np.float32)
+        nodes = [encode_node("CumSum", ["x", "ax"], ["y"], "cs",
+                             exclusive=exclusive, reverse=reverse)]
+        got = _run(nodes, {"ax": np.asarray(1, np.int32)},
+                   [("x", (4, 6))], [("y", (4, 6))], {"x": x})[0]
+        ref = x[:, ::-1] if reverse else x
+        want = np.cumsum(ref, axis=1)
+        if exclusive:
+            want = want - ref
+        if reverse:
+            want = want[:, ::-1]
+        np.testing.assert_allclose(np.asarray(got), want, atol=1e-5)
+
+    def test_exclusive_with_inf(self):
+        """Exclusive must SHIFT, not subtract: inf inputs produce NaN
+        under inclusive-minus-self (regression)."""
+        x = np.asarray([[1.0, np.inf, 2.0]], np.float32)
+        nodes = [encode_node("CumSum", ["x", "ax"], ["y"], "cs",
+                             exclusive=1)]
+        got = _run(nodes, {"ax": np.asarray(1, np.int32)},
+                   [("x", (1, 3))], [("y", (1, 3))], {"x": x})[0]
+        np.testing.assert_array_equal(np.asarray(got),
+                                      [[0.0, 1.0, np.inf]])
+
+
+class TestLayerNormAxes:
+    def test_non_last_axis_matches_torch(self):
+        x = R.randn(3, 4, 5).astype(np.float32)
+        scale = R.randn(4, 5).astype(np.float32)
+        bias = R.randn(4, 5).astype(np.float32)
+        nodes = [encode_node("LayerNormalization",
+                             ["x", "scale", "bias"], ["y"], "ln",
+                             axis=1)]
+        got = _run(nodes, {"scale": scale, "bias": bias},
+                   [("x", (3, 4, 5))], [("y", (3, 4, 5))],
+                   {"x": x})[0]
+        want = F.layer_norm(torch.tensor(x), (4, 5),
+                            torch.tensor(scale),
+                            torch.tensor(bias)).numpy()
+        np.testing.assert_allclose(np.asarray(got), want, rtol=1e-4,
+                                   atol=1e-5)

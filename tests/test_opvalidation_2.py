@@ -519,6 +519,60 @@ CASES = [
 ]
 
 
+def _dpa_expected(q, k, v):
+    s = np.einsum("btd,bsd->bts", q, k) / np.sqrt(q.shape[-1])
+    return np.einsum("bts,bsd->btd", _softmax(s), v)
+
+
+def _mha_expected(x, wq, wk, wv, wo, h):
+    b, t, _ = x.shape
+
+    def split(a):
+        return a.reshape(b, t, h, -1).transpose(0, 2, 1, 3)
+
+    q, k, v = split(x @ wq), split(x @ wk), split(x @ wv)
+    s = np.einsum("bhtd,bhsd->bhts", q, k) / np.sqrt(q.shape[-1])
+    o = np.einsum("bhts,bhsd->bhtd", _softmax(s), v)
+    return o.transpose(0, 2, 1, 3).reshape(b, t, -1) @ wo
+
+
+_QKV = [(R.randn(2, 3, 4) * 0.5).astype(np.float32) for _ in range(3)]
+_MHX = (R.randn(2, 3, 8) * 0.5).astype(np.float32)
+_MHW = [(R.randn(8, 8) * 0.3).astype(np.float32) for _ in range(4)]
+
+# batch 3 (round-2 verdict: ratchet the floor) — attention, image,
+# indexing, compression ops previously covered only by their dedicated
+# suites now also carry opvalidation ground truth
+CASES += [
+    TestCase("dot_product_attention", _QKV,
+             expected=[_dpa_expected(*_QKV)]),
+    TestCase("multi_head_dot_product_attention",
+             [_MHX] + _MHW, {"num_heads": 2},
+             expected=[_mha_expected(_MHX, *_MHW, h=2)]),
+    TestCase("index", [A],
+             {"spec": [{"kind": "int", "i": 1},
+                       {"kind": "slice", "begin": 0, "end": 4,
+                        "stride": 2}]},
+             expected=[A[1, 0:4:2]]),
+    TestCase("decode_threshold", [A], expected=[A],
+             gradient_check=False),
+    # exact-grid crop: box [0,0,1,1] at the full crop size samples
+    # integer coordinates, so bilinear == identity
+    TestCase("crop_and_resize",
+             [IMG, np.asarray([[0., 0., 1., 1.]], np.float32),
+              np.asarray([0], np.int32)], {"crop_size": (6, 6)},
+             expected=[IMG[0:1]], gradient_check=False),
+    TestCase("non_max_suppression",
+             [np.asarray([[0, 0, 1, 1], [0, 0, 1, 1],
+                          [2, 2, 3, 3], [0, 0, .9, .9]],
+                         np.float32),
+              np.asarray([.9, .8, .7, .6], np.float32)],
+             {"max_output_size": 3, "iou_threshold": 0.5},
+             expected=[np.asarray([0, 2, -1], np.int32)],
+             gradient_check=False),
+]
+
+
 @pytest.mark.parametrize(
     "tc", CASES, ids=[f"{c.op}_{i}" for i, c in enumerate(CASES)])
 def test_op(tc):
@@ -531,6 +585,6 @@ def test_combined_coverage_floor():
     for tc in CASES1 + CASES:
         validate(tc)
     rep = coverage_report()
-    assert rep["covered"] >= 215, (rep["covered"],
+    assert rep["covered"] >= 220, (rep["covered"],
                                    rep["missing"][:30])
-    assert rep["fraction"] >= 0.92, rep["fraction"]
+    assert rep["fraction"] >= 0.95, rep["fraction"]
