@@ -17,11 +17,15 @@ Prints ONE JSON line:
 from __future__ import annotations
 
 import json
-import time
+import os
+import subprocess
+import sys
 
 import jax
 import jax.numpy as jnp
 import numpy as np
+
+_ROOT = os.path.dirname(os.path.abspath(__file__))
 
 
 def main():
@@ -49,22 +53,25 @@ def main():
     jax.block_until_ready(net.params)
     float(net.score())
 
-    best = 0.0
-    for _trial in range(3):
-        t0 = time.perf_counter()
+    from benchmarks.timing import median_throughput
+
+    def run_once():
         net.fit_steps(ds, steps)
         jax.block_until_ready(net.params)
         # score() syncs on the final step's loss — guarantees the whole
         # dispatch chain actually executed before we stop the clock
-        assert np.isfinite(float(net.score()))
-        dt = time.perf_counter() - t0
-        best = max(best, steps * batch / dt)
+        # (the sync lives OUTSIDE the assert: python -O must not
+        # remove it)
+        s = float(net.score())
+        assert np.isfinite(s)
 
-    ips = best
+    stats = median_throughput(run_once, steps * batch,
+                              n_trials=5 if on_tpu else 3)
+    ips = stats["value"]
     line = {
         "metric": "resnet50_train_throughput"
                   + ("" if on_tpu else f"_cpu_proxy_{hw}px"),
-        "value": round(ips, 2),
+        **stats,
         "unit": "images/sec/chip",
         "vs_baseline": 1.0,
     }
@@ -83,8 +90,54 @@ def main():
                 100 * tf / V5E_BF16_PEAK_TFLOPS, 1)
             line["pct_hbm_peak"] = round(100 * gbps / V5E_HBM_GBPS, 1)
     except Exception as e:
-        import sys
         print(f"roofline block failed: {e!r}", file=sys.stderr)
+    # exercise the pod scaling harness's REAL clock path at n=1 (the
+    # round-2 verdict asked that parallel/scaling.py time something
+    # real before it is trusted on a pod); small shape — this checks
+    # the machinery, not the headline number
+    try:
+        from deeplearning4j_tpu.datasets.dataset import DataSet as DS
+        from deeplearning4j_tpu.models.zoo import LeNet
+        from deeplearning4j_tpu.parallel.scaling import \
+            measure_dp_scaling
+
+        def _mk_batch(n):
+            r = np.random.RandomState(1)
+            return DS(r.randn(n, 28, 28, 1).astype(np.float32),
+                      np.eye(10, dtype=np.float32)[
+                          r.randint(0, 10, n)])
+
+        rep = measure_dp_scaling(
+            lambda: LeNet(num_classes=10).init(), _mk_batch, (1,),
+            per_chip_batch=64, steps=5, warmup=1)
+        line["scaling_n1_ips"] = round(rep["throughput"][1], 1)
+    except Exception as e:
+        print(f"scaling-harness leg failed: {e!r}", file=sys.stderr)
+    # CPU-proxy pipeline overhead, every round (round-2 verdict Weak
+    # #3: regressions in the host data-path software must be caught
+    # even though the axon tunnel makes the on-rig e2e number
+    # bandwidth-bound). Subprocess on the CPU backend.
+    try:
+        env = {**os.environ, "PYTHONPATH": "", "JAX_PLATFORMS": "cpu"}
+        out = subprocess.run(
+            [sys.executable,
+             os.path.join(_ROOT, "benchmarks", "bench_pipeline.py")],
+            capture_output=True, text=True, timeout=900, env=env,
+            cwd=_ROOT)
+        if out.returncode != 0:
+            raise RuntimeError(
+                f"rc={out.returncode}: {out.stderr.strip()[-400:]}")
+        for ln in out.stdout.strip().splitlines():
+            if not ln.startswith("{"):
+                continue              # tolerate library banners
+            rec = json.loads(ln)
+            if rec["metric"].startswith("input_pipeline_overhead"):
+                line["pipeline_overhead_cpu_proxy_pct"] = rec["value"]
+        if "pipeline_overhead_cpu_proxy_pct" not in line:
+            print("pipeline-proxy leg: no overhead line in child "
+                  "output", file=sys.stderr)
+    except Exception as e:
+        print(f"pipeline-proxy leg failed: {e!r}", file=sys.stderr)
     print(json.dumps(line))
 
 

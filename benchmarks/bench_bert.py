@@ -8,8 +8,6 @@ Prints ONE JSON line: {"metric": "bert_mlm_train_throughput", ...}.
 from __future__ import annotations
 
 import json
-import time
-
 import os
 import sys
 
@@ -49,18 +47,20 @@ def main(batch=256, seq=128, steps=8):
 
     model.fit_batch(batch_d)      # compile; fit_batch syncs on loss
 
-    best = 0.0
-    for _ in range(3):
-        t0 = time.perf_counter()
+    from benchmarks.timing import median_throughput
+
+    def run_once():
+        loss = None
         for _ in range(steps):
             loss = model.fit_batch(batch_d)  # each call syncs on loss
-        assert np.isfinite(loss)
-        dt = time.perf_counter() - t0
-        best = max(best, steps * batch * seq / dt)
+        assert loss is not None and np.isfinite(loss)
 
+    stats = median_throughput(run_once, steps * batch * seq,
+                              n_trials=5 if on_tpu else 3)
+    best = stats["value"]
     line = {"metric": "bert_mlm_train_throughput"
                       + ("" if on_tpu else "_cpu_proxy"),
-            "value": round(best, 1),
+            **stats,
             "unit": "tokens/sec/chip"}
 
     # Analytic matmul FLOPs (XLA's cost_analysis undercounts dot FLOPs

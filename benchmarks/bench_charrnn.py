@@ -17,14 +17,17 @@ Prints ONE JSON line: {"metric": "charrnn_train_throughput", ...}.
 from __future__ import annotations
 
 import json
-import time
+import os
+import sys
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
 
-def main(batch=64, seq_len=64, hidden=512, vocab=80, steps=30):
+
+def main(batch=64, seq_len=64, hidden=512, vocab=80, steps=200):
     from deeplearning4j_tpu.activations import Activation
     from deeplearning4j_tpu.datasets.dataset import DataSet
     from deeplearning4j_tpu.learning import Adam
@@ -62,19 +65,23 @@ def main(batch=64, seq_len=64, hidden=512, vocab=80, steps=30):
     jax.block_until_ready(net.params)
     float(net.score())
 
-    best = 0.0
-    for _ in range(5):
-        t0 = time.perf_counter()
+    from benchmarks.timing import median_throughput
+
+    def run_once():
         net.fit_steps(ds, steps)
         jax.block_until_ready(net.params)
-        assert np.isfinite(float(net.score()))
-        dt = time.perf_counter() - t0
-        best = max(best, steps * batch * seq_len / dt)
+        s = float(net.score())      # sync must survive python -O
+        assert np.isfinite(s)
 
+    # 200 steps/trial (~1s of device work) amortizes tunnel jitter;
+    # median-of-5 is the committed number (round-2 verdict Weak #2:
+    # the single-run spread spanned 2x)
+    stats = median_throughput(run_once, steps * batch * seq_len,
+                              n_trials=5)
     print(json.dumps({
         "metric": "charrnn_train_throughput"
                   + ("" if on_tpu else "_cpu_proxy"),
-        "value": round(best, 1),
+        **stats,
         "unit": "chars/sec/chip",
     }))
 

@@ -23,11 +23,15 @@ quarters; fusing it into the step proper is a possible further step.
 from __future__ import annotations
 
 import json
+import os
+import sys
 import time
 
 import jax
 import jax.numpy as jnp
 import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
 
 
 class _SyntheticU8Images:

@@ -1,0 +1,32 @@
+"""Shared steady-state measurement protocol (BASELINE.md step 2;
+round-2 verdict Weak #1/#2: single-run numbers disagree with their
+notes by more than tunnel variance).
+
+``median_throughput`` runs a warm, self-syncing closure N times and
+reports the MEDIAN rate plus min/max, so the committed artifact is
+robust to run-to-run jitter through the shared tunnel and matches
+what the notes claim."""
+from __future__ import annotations
+
+import statistics
+import time
+from typing import Callable, Dict
+
+
+def median_throughput(run_once: Callable[[], None], units_per_run,
+                      n_trials: int = 5) -> Dict[str, float]:
+    """``run_once`` must execute the full measured work AND sync on a
+    computed scalar (not just block_until_ready).  Returns
+    {"value": median units/s, "min": ..., "max": ..., "n_trials": N}.
+    """
+    rates = []
+    for _ in range(n_trials):
+        t0 = time.perf_counter()
+        run_once()
+        dt = time.perf_counter() - t0
+        rates.append(units_per_run / dt)
+    rates.sort()
+    return {"value": round(statistics.median(rates), 2),
+            "min": round(rates[0], 2),
+            "max": round(rates[-1], 2),
+            "n_trials": n_trials}
