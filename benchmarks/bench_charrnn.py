@@ -77,7 +77,7 @@ def main(batch=64, seq_len=64, hidden=512, vocab=80, steps=200):
     # median-of-5 is the committed number (round-2 verdict Weak #2:
     # the single-run spread spanned 2x)
     stats = median_throughput(run_once, steps * batch * seq_len,
-                              n_trials=5)
+                              n_trials=5 if on_tpu else 3)
     print(json.dumps({
         "metric": "charrnn_train_throughput"
                   + ("" if on_tpu else "_cpu_proxy"),

@@ -428,44 +428,37 @@ class BatchNormalization(Layer):
                 "var": jnp.ones((nf,), dtype)}
 
     def forward(self, params, x, *, training, rng=None, state=None):
-        axes = tuple(range(x.ndim - 1))  # all but channel/feature
         if training:
-            if x.dtype in (jnp.bfloat16, jnp.float16):
-                # One-pass statistics: E[x] and E[x^2] are independent
-                # reductions over the same operand, so XLA multi-
-                # output-fuses them into a single read of the
-                # activation — the two-pass mean-then-var form costs
-                # one extra full HBM pass per BN layer, and the
-                # profiler shows BN reductions dominate the ResNet-50
-                # step (benchmarks/profile_resnet.py). The f32
-                # accumulator carries ~16 more mantissa bits than the
-                # bf16 activations, so the E[x^2]-E[x]^2 cancellation
-                # is benign (the cuDNN/TF fused-BN approach). For f32+
-                # activations that margin does not exist — keep the
-                # accurate two-pass form there.
-                xf = x.astype(jnp.float32)
-                n = x.size // x.shape[-1]
-                mean = jnp.sum(xf, axis=axes) / n
-                var = jnp.maximum(
-                    jnp.sum(jax.lax.square(xf), axis=axes) / n
-                    - jax.lax.square(mean), 0.0)
+            # shared forward math (one-pass E[x]/E[x^2] for bf16 — one
+            # fused HBM read, the dominant ResNet-50 cost per
+            # benchmarks/profile_resnet.py — two-pass for f32; see
+            # ops/bn_pallas.py:bn_forward_math). With
+            # DL4J_TPU_FUSED_BN_BWD the SAME forward runs under a
+            # custom_vjp whose backward is the hand Pallas kernel
+            # pair (measured slower than XLA's autodiff on ResNet-50;
+            # kept as the tuning seam — BENCH_notes_r03.md).
+            from deeplearning4j_tpu.ops.bn_pallas import (
+                bn_forward_math, bn_train_normalize,
+                fused_bn_bwd_enabled)
+            if fused_bn_bwd_enabled():
+                out, mean, var = bn_train_normalize(
+                    x, params["gamma"], params["beta"], self.eps)
             else:
-                mean = jnp.mean(x, axis=axes)
-                var = jnp.var(x, axis=axes)
+                out, mean, var, _ = bn_forward_math(
+                    x, params["gamma"], params["beta"], self.eps)
             d = self.decay
             new_state = {"mean": d * state["mean"] + (1 - d) * mean,
                          "var": d * state["var"] + (1 - d) * var}
-        else:
-            acc = jnp.promote_types(x.dtype, jnp.float32)
-            mean = state["mean"].astype(acc)
-            var = state["var"].astype(acc)
-            new_state = state
+            return self.activation(out), new_state
+        acc = jnp.promote_types(x.dtype, jnp.float32)
+        mean = state["mean"].astype(acc)
+        var = state["var"].astype(acc)
         # x * scale + bias with per-channel scale/bias: one fused
         # multiply-add over the tensor instead of subtract/divide chains
         scale = params["gamma"].astype(var.dtype) / jnp.sqrt(var + self.eps)
         bias = params["beta"].astype(var.dtype) - mean * scale
         out = x * scale.astype(x.dtype) + bias.astype(x.dtype)
-        return self.activation(out), new_state
+        return self.activation(out), state
 
     def get_output_type(self, input_type):
         return input_type

@@ -1,0 +1,202 @@
+"""Fused batch-norm backward — Pallas TPU kernel.
+
+Reference parity: ``CudnnBatchNormalizationHelper.backprop`` (SURVEY.md
+D9/N8 — the helper seam exists precisely to hand-tune where the
+stock lowering falls short).  The XLA autodiff of the BN normalize
+splits the backward into separate reduction and elementwise fusions
+that each re-read the activation and its cotangent from HBM; on a
+ResNet-50 step the profiler attributes ~21 ms to those re-reads
+(BENCH_notes_r02.md).  The ResNet-50 train step sits at ~94% of the
+HBM roofline, so bytes ARE the step time.
+
+This kernel pair caps BN-backward traffic at the provable minimum of
+two passes:
+
+  pass 1 (reduce):  read x, dy  → Σdy, Σdy·x̂  (= dβ, dγ)
+  pass 2 (dx):      read x, dy  → dx = A·dy + D·x + E
+
+with A/D/E per-channel f32 coefficients folded OUTSIDE the kernel
+from the sums (the algebra: dx = γr(dy − Σdy/M − x̂·Σdyx̂/M) plus the
+running-stat cotangent terms, rearranged into one FMA form so the
+inner loop is two mul-adds per element).
+
+Enabled behind ``DL4J_TPU_FUSED_BN_BWD=1`` (Environment
+``extra["fused_bn_bwd"]``).  Off-TPU the kernels run in Pallas
+interpret mode, so the f64 gradient checks exercise the SAME code
+path the chip runs.
+"""
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+
+def fused_bn_bwd_enabled() -> bool:
+    import os
+
+    from deeplearning4j_tpu.common.environment import Environment
+    env = Environment.get()
+    flag = env.extra.get("fused_bn_bwd")
+    if flag is None:
+        flag = os.environ.get("DL4J_TPU_FUSED_BN_BWD", "0") in (
+            "1", "true", "True", "yes")
+    return bool(flag)
+
+
+def _interpret() -> bool:
+    return jax.devices()[0].platform != "tpu"
+
+
+def _block_rows(M: int, C: int) -> int:
+    """~512KB f32 working set per operand block, sublane-aligned."""
+    bm = max(8, min(4096, (512 * 1024) // (4 * max(C, 128))))
+    bm = (bm // 8) * 8
+    return min(bm, max(8, ((M + 7) // 8) * 8))
+
+
+def _reduce_kernel(x_ref, dy_ref, stat_ref, acc_ref, *, M, bm, acc_t):
+    i = pl.program_id(0)
+    x = x_ref[...].astype(acc_t)
+    dy = dy_ref[...].astype(acc_t)
+    rows = jax.lax.broadcasted_iota(jnp.int32, x.shape, 0)
+    valid = (i * bm + rows) < M
+    dy = jnp.where(valid, dy, 0)
+    xhat = (x - stat_ref[0:1, :]) * stat_ref[1:2, :]
+    # mask the PRODUCT too: padded x rows hold garbage (0·NaN = NaN)
+    part = jnp.concatenate(
+        [jnp.sum(dy, axis=0, keepdims=True),
+         jnp.sum(jnp.where(valid, dy * xhat, 0), axis=0,
+                 keepdims=True)], axis=0)
+
+    @pl.when(i == 0)
+    def _init():
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+
+    acc_ref[...] += part
+
+
+def _dx_kernel(x_ref, dy_ref, coef_ref, dx_ref, *, acc_t):
+    x = x_ref[...].astype(acc_t)
+    dy = dy_ref[...].astype(acc_t)
+    a = coef_ref[0:1, :]
+    d = coef_ref[1:2, :]
+    e = coef_ref[2:3, :]
+    dx_ref[...] = (a * dy + d * x + e).astype(dx_ref.dtype)
+
+
+def _bn_bwd_sums(x2d, dy2d, mean, rstd, acc_t):
+    """Pass 1: Σdy and Σdy·x̂ per channel, one read of x and dy."""
+    M, C = x2d.shape
+    bm = _block_rows(M, C)
+    grid = (pl.cdiv(M, bm),)
+    stat = jnp.stack([mean, rstd]).astype(acc_t)      # [2, C]
+    acc = pl.pallas_call(
+        partial(_reduce_kernel, M=M, bm=bm, acc_t=acc_t),
+        grid=grid,
+        in_specs=[pl.BlockSpec((bm, C), lambda i: (i, 0)),
+                  pl.BlockSpec((bm, C), lambda i: (i, 0)),
+                  pl.BlockSpec((2, C), lambda i: (0, 0))],
+        out_specs=pl.BlockSpec((2, C), lambda i: (0, 0)),
+        out_shape=jax.ShapeDtypeStruct((2, C), acc_t),
+        interpret=_interpret(),
+    )(x2d, dy2d, stat)
+    return acc[0], acc[1]
+
+
+def _bn_bwd_dx(x2d, dy2d, a, d, e, acc_t):
+    """Pass 2: dx = A·dy + D·x + E (pure per-channel FMA)."""
+    M, C = x2d.shape
+    bm = _block_rows(M, C)
+    grid = (pl.cdiv(M, bm),)
+    coef = jnp.stack([a, d, e]).astype(acc_t)         # [3, C]
+    return pl.pallas_call(
+        partial(_dx_kernel, acc_t=acc_t),
+        grid=grid,
+        in_specs=[pl.BlockSpec((bm, C), lambda i: (i, 0)),
+                  pl.BlockSpec((bm, C), lambda i: (i, 0)),
+                  pl.BlockSpec((3, C), lambda i: (0, 0))],
+        out_specs=pl.BlockSpec((bm, C), lambda i: (i, 0)),
+        out_shape=jax.ShapeDtypeStruct((M, C), x2d.dtype),
+        interpret=_interpret(),
+    )(x2d, dy2d, coef)
+
+
+@partial(jax.custom_vjp, nondiff_argnums=(3,))
+def bn_train_normalize(x, gamma, beta, eps):
+    """Training-mode BN normalize with batch statistics, returning
+    ``(y, mean, var)`` — the fused-backward drop-in for the layer's
+    inline math (one-pass E[x]/E[x²] statistics, f32 accumulation)."""
+    y, mean, var, _ = bn_forward_math(x, gamma, beta, eps)
+    return y, mean, var
+
+
+def bn_forward_math(x, gamma, beta, eps):
+    """THE training-mode BN forward — single source of truth shared by
+    the inline layer path and the fused-backward custom_vjp.
+
+    Statistics policy: for bf16/f16 activations, one-pass E[x]/E[x²]
+    with f32 accumulation (one fused HBM read; the f32 accumulator's
+    ~16 extra mantissa bits make the cancellation benign — the
+    cuDNN/TF fused-BN formulation).  For f32+ activations that margin
+    does not exist, so the accurate two-pass mean-then-var form is
+    used.  Returns (y, mean, var, rstd)."""
+    axes = tuple(range(x.ndim - 1))
+    acc_t = jnp.promote_types(x.dtype, jnp.float32)
+    if x.dtype in (jnp.bfloat16, jnp.float16):
+        xf = x.astype(acc_t)
+        n = x.size // x.shape[-1]
+        mean = jnp.sum(xf, axis=axes) / n
+        var = jnp.maximum(
+            jnp.sum(jax.lax.square(xf), axis=axes) / n
+            - jax.lax.square(mean), 0.0)
+    else:
+        mean = jnp.mean(x, axis=axes)
+        var = jnp.var(x, axis=axes)
+    rstd = jax.lax.rsqrt(var + eps)
+    scale = gamma.astype(acc_t) * rstd
+    bias = beta.astype(acc_t) - mean * scale
+    # x·scale + bias: one fused multiply-add over the tensor instead
+    # of subtract/divide chains
+    y = x * scale.astype(x.dtype) + bias.astype(x.dtype)
+    return y, mean, var, rstd
+
+
+def _bn_fwd(x, gamma, beta, eps):
+    y, mean, var, rstd = bn_forward_math(x, gamma, beta, eps)
+    return (y, mean, var), (x, gamma, mean, rstd)
+
+
+def _bn_bwd(eps, res, cts):
+    dy, dmean_ct, dvar_ct = cts
+    x, gamma, mean, rstd = res
+    acc_t = jnp.promote_types(x.dtype, jnp.float32)
+    C = x.shape[-1]
+    M = x.size // C
+    x2d = x.reshape(M, C)
+    dy2d = dy.reshape(M, C)
+
+    sdy, sdyx = _bn_bwd_sums(x2d, dy2d, mean.astype(acc_t),
+                             rstd.astype(acc_t), acc_t)
+    g = gamma.astype(acc_t)
+    r = rstd.astype(acc_t)
+    mu = mean.astype(acc_t)
+    inv_m = 1.0 / M
+    # dx = γr·dy − γr·Σdy/M − γr²·x̂-coefficient... rearranged into
+    # dx = A·dy + D·x + E with the mean/var cotangent terms folded in
+    a_coef = g * r
+    d_coef = -g * r * r * (sdyx * inv_m) \
+        + 2.0 * dvar_ct.astype(acc_t) * inv_m
+    e_coef = (-a_coef * (sdy * inv_m)
+              + dmean_ct.astype(acc_t) * inv_m
+              - d_coef * mu)
+    dx = _bn_bwd_dx(x2d, dy2d, a_coef, d_coef, e_coef,
+                    acc_t).reshape(x.shape)
+    dgamma = sdyx.astype(gamma.dtype)
+    dbeta = sdy.astype(gamma.dtype)
+    return dx, dgamma, dbeta
+
+
+bn_train_normalize.defvjp(_bn_fwd, _bn_bwd)
