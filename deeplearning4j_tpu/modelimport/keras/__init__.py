@@ -2,5 +2,9 @@
 from deeplearning4j_tpu.modelimport.keras.importer import (
     InvalidKerasConfigurationException, KerasModelImport)
 from deeplearning4j_tpu.modelimport.keras import mappers_extra  # noqa: F401
+from deeplearning4j_tpu.modelimport.keras import mappers_modern  # noqa: F401
+from deeplearning4j_tpu.modelimport.keras.mappers_modern import \
+    register_keras_layer_mapper
 
-__all__ = ["KerasModelImport", "InvalidKerasConfigurationException"]
+__all__ = ["KerasModelImport", "InvalidKerasConfigurationException",
+           "register_keras_layer_mapper"]

@@ -548,7 +548,9 @@ class GlobalPoolingLayer(Layer):
 
     def forward(self, params, x, *, training, rng=None, state=None,
                 mask=None):
-        if x.ndim == 4:          # NHWC -> pool H,W
+        if x.ndim == 5:          # NDHWC -> pool D,H,W
+            axes = (1, 2, 3)
+        elif x.ndim == 4:        # NHWC -> pool H,W
             axes = (1, 2)
         elif x.ndim == 3:        # [b, t, f] -> pool t
             axes = (1,)
@@ -579,7 +581,10 @@ class GlobalPoolingLayer(Layer):
         return z, state
 
     def get_output_type(self, input_type):
-        if isinstance(input_type, InputTypeConvolutional):
+        from deeplearning4j_tpu.nn.conf.inputs import \
+            InputTypeConvolutional3D
+        if isinstance(input_type, (InputTypeConvolutional,
+                                   InputTypeConvolutional3D)):
             return InputType.feed_forward(input_type.channels)
         if isinstance(input_type, InputTypeRecurrent):
             return InputType.feed_forward(input_type.size)

@@ -34,6 +34,9 @@ class BaseAttentionLayer(Layer):
 
     n_heads: int = 1
     head_size: int = 0          # 0 -> n_out // n_heads
+    #: learn projection biases (the Keras MultiHeadAttention
+    #: ``use_bias=True`` form; the reference layer has none)
+    has_bias: bool = False
 
     def _head_size(self) -> int:
         return self.head_size or max(self.n_out // self.n_heads, 1)
@@ -52,12 +55,18 @@ class BaseAttentionLayer(Layer):
         wi = self.weight_init or WeightInit.XAVIER
         hs = self._head_size() * self.n_heads
         k1, k2, k3, k4 = jax.random.split(key, 4)
-        return {
+        p = {
             "Wq": wi.init(k1, (q_dim, hs), q_dim, hs, dtype),
             "Wk": wi.init(k2, (kv_dim, hs), kv_dim, hs, dtype),
             "Wv": wi.init(k3, (kv_dim, hs), kv_dim, hs, dtype),
             "Wo": wi.init(k4, (hs, self.n_out), hs, self.n_out, dtype),
         }
+        if self.has_bias:
+            p.update({"bq": jnp.zeros((hs,), dtype),
+                      "bk": jnp.zeros((hs,), dtype),
+                      "bv": jnp.zeros((hs,), dtype),
+                      "bo": jnp.zeros((self.n_out,), dtype)})
+        return p
 
 
 @register_layer

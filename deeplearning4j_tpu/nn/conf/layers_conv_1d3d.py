@@ -360,3 +360,65 @@ class Cnn3DLossLayer(BaseOutputLayer):
 
     def forward_logits(self, params, x, *, training, rng=None, state=None):
         return x, state
+
+
+@register_layer
+@dataclass
+class Deconvolution1D(Layer):
+    """Temporal transposed convolution on [b, t, f] (reference: the
+    Keras ``Conv1DTranspose`` import target; 1D sibling of
+    Deconvolution2D/3D)."""
+
+    kernel_size: int = 3
+    stride: int = 1
+    padding: int = 0
+    convolution_mode: ConvolutionMode = ConvolutionMode.SAME
+    has_bias: bool = True
+
+    def __post_init__(self):
+        super().__post_init__()
+        for f in ("kernel_size", "stride", "padding"):
+            v = getattr(self, f)
+            setattr(self, f, int(v[0] if isinstance(v, (tuple, list))
+                                 else v))
+
+    def set_n_in(self, input_type, override):
+        if isinstance(input_type, InputTypeRecurrent) and \
+                (override or not self.n_in):
+            self.n_in = input_type.size
+
+    def init_params(self, key, input_type, dtype=jnp.float32):
+        k = self.kernel_size
+        wi = self.weight_init or WeightInit.XAVIER
+        p = {"W": wi.init(key, (k, self.n_in, self.n_out),
+                          k * self.n_in, k * self.n_out, dtype)}
+        if self.has_bias:
+            p["b"] = jnp.full((self.n_out,), self.bias_init, dtype)
+        return p
+
+    def forward(self, params, x, *, training, rng=None, state=None):
+        x = self._maybe_dropout(x, training, rng)
+        if self.convolution_mode is ConvolutionMode.SAME:
+            pad = "SAME"
+        else:
+            # conv_transpose explicit padding applies to the s-dilated
+            # input; k-1-p per side yields (i-1)*s + k - 2p outputs
+            k, p = self.kernel_size, self.padding
+            pad = [(k - 1 - p, k - 1 - p)]
+        z = jax.lax.conv_transpose(
+            x, params["W"], strides=(self.stride,), padding=pad,
+            dimension_numbers=("NWC", "WIO", "NWC"))
+        if self.has_bias:
+            z = z + params["b"]
+        return self.activation(z), state
+
+    def get_output_type(self, input_type):
+        assert isinstance(input_type, InputTypeRecurrent), input_type
+        t = input_type.timesteps
+        if t > 0:
+            if self.convolution_mode is ConvolutionMode.SAME:
+                t = t * self.stride
+            else:
+                t = (t - 1) * self.stride + self.kernel_size \
+                    - 2 * self.padding
+        return InputType.recurrent(self.n_out, t)

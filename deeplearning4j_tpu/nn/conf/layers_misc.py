@@ -280,3 +280,78 @@ class RnnLossLayer(BaseOutputLayer):
     def forward_logits(self, params, x, *, training, rng=None, state=None,
                        mask=None):
         return x, state
+
+
+@register_layer
+@dataclass
+class LayerNormalization(Layer):
+    """Layer normalization over the trailing (feature/channel) axis
+    with learned per-feature gain/bias (reference: the Keras
+    ``LayerNormalization`` import target; the reference's SameDiff
+    ``standardize`` + gain/bias composition).  Works on [b, f],
+    [b, t, f] and [b, h, w, c] — the normalized axis is always the
+    last, which is the TPU lane dimension."""
+
+    eps: float = 1e-3               # keras default epsilon
+    scale: bool = True              # learn gamma
+    center: bool = True             # learn beta
+
+    def set_n_in(self, input_type, override):
+        # trailing-axis feature count for every layout
+        nf = getattr(input_type, "channels", None)
+        if nf is None:
+            nf = input_type.size
+        if override or not self.n_in:
+            self.n_in = nf
+        self.n_out = self.n_in
+
+    def has_params(self) -> bool:
+        return self.scale or self.center
+
+    def init_params(self, key, input_type, dtype=jnp.float32):
+        p = {}
+        if self.scale:
+            p["gamma"] = jnp.ones((self.n_in,), dtype)
+        if self.center:
+            p["beta"] = jnp.zeros((self.n_in,), dtype)
+        return p
+
+    def forward(self, params, x, *, training, rng=None, state=None,
+                mask=None):
+        x = self._maybe_dropout(x, training, rng)
+        acc = jnp.promote_types(x.dtype, jnp.float32)
+        xf = x.astype(acc)
+        mu = jnp.mean(xf, axis=-1, keepdims=True)
+        var = jnp.var(xf, axis=-1, keepdims=True)
+        y = (xf - mu) * jax.lax.rsqrt(var + self.eps)
+        if self.scale:
+            y = y * params["gamma"].astype(acc)
+        if self.center:
+            y = y + params["beta"].astype(acc)
+        return self.activation(y.astype(x.dtype)), state
+
+    def get_output_type(self, input_type):
+        return input_type
+
+
+@register_layer
+@dataclass
+class UnitNormLayer(Layer):
+    """L2-normalize the trailing axis (the Keras ``UnitNormalization``
+    import target; layer form of L2NormalizeVertex)."""
+
+    eps: float = 1e-12
+
+    def has_params(self) -> bool:
+        return False
+
+    def set_n_in(self, input_type, override):
+        pass
+
+    def forward(self, params, x, *, training, rng=None, state=None,
+                mask=None):
+        n = jnp.sqrt(jnp.sum(jnp.square(x), axis=-1, keepdims=True))
+        return x / jnp.maximum(n, self.eps), state
+
+    def get_output_type(self, input_type):
+        return input_type

@@ -379,13 +379,133 @@ class LastTimeStepLayer(Layer):
             self.n_in = self.n_out = input_type.size
 
 
+@dataclass
+class ConvLSTM2D(BaseRecurrentLayer):
+    """Convolutional LSTM (Shi et al. 2015) over [b, t, h, w, c]
+    sequences — the Keras ``ConvLSTM2D`` import target (reference:
+    ``KerasConvLSTM2D`` mapping in deeplearning4j-modelimport).
+
+    Gate order [i, f, o, g], matching :class:`LSTM`.  The input conv
+    (kernel ``W`` [kh, kw, C, 4F]) applies stride/padding; the
+    recurrent conv (``RW`` [kh, kw, F, 4F]) is stride-1 SAME on the
+    state grid.  TPU-first: the input conv for ALL timesteps is
+    hoisted out of the scan as one batched MXU conv; only the
+    recurrent conv runs per step."""
+
+    kernel_size: Tuple[int, int] = (3, 3)
+    stride: Tuple[int, int] = (1, 1)
+    convolution_mode: "ConvolutionMode" = None
+    gate_activation: Activation = Activation.SIGMOID
+    forget_gate_bias_init: float = 1.0
+    has_bias: bool = True
+    return_sequences: bool = True
+
+    def __post_init__(self):
+        super().__post_init__()
+        from deeplearning4j_tpu.nn.conf.layers import ConvolutionMode
+        if self.convolution_mode is None:
+            self.convolution_mode = ConvolutionMode.SAME
+        self.kernel_size = tuple(int(k) for k in self.kernel_size)
+        self.stride = tuple(int(s) for s in self.stride)
+
+    def _same(self) -> bool:
+        from deeplearning4j_tpu.nn.conf.layers import ConvolutionMode
+        return self.convolution_mode is ConvolutionMode.SAME
+
+    def set_n_in(self, input_type, override):
+        from deeplearning4j_tpu.nn.conf.inputs import \
+            InputTypeConvolutional3D
+        if not isinstance(input_type, InputTypeConvolutional3D):
+            raise ValueError(
+                f"ConvLSTM2D needs InputType.convolutional_3d "
+                f"(time as depth), got {input_type}")
+        if override or not self.n_in:
+            self.n_in = input_type.channels
+        self._grid = self._out_hw(input_type.height, input_type.width)
+
+    def _out_hw(self, h, w):
+        kh, kw = self.kernel_size
+        sh, sw = self.stride
+        if self._same():
+            return (-(-h // sh), -(-w // sw))
+        return ((h - kh) // sh + 1, (w - kw) // sw + 1)
+
+    def zero_state(self, batch: int, dtype=jnp.float32) -> dict:
+        gh, gw = self._grid
+        z = jnp.zeros((batch, gh, gw, self.n_out), dtype)
+        return {"h": z, "c": z}
+
+    def init_params(self, key, input_type, dtype=jnp.float32):
+        kh, kw = self.kernel_size
+        F = self.n_out
+        wi = self.weight_init or WeightInit.XAVIER
+        k1, k2 = jax.random.split(key)
+        fan = kh * kw * self.n_in
+        p = {"W": wi.init(k1, (kh, kw, self.n_in, 4 * F), fan,
+                          kh * kw * F, dtype),
+             "RW": wi.init(k2, (kh, kw, F, 4 * F), kh * kw * F,
+                           kh * kw * F, dtype)}
+        if self.has_bias:
+            b = jnp.full((4 * F,), self.bias_init, dtype)
+            p["b"] = b.at[F:2 * F].set(self.forget_gate_bias_init)
+        return p
+
+    def _scan(self, params, x, state, mask):
+        F = self.n_out
+        gate = self.gate_activation.fn()
+        act = self.activation.fn()
+        b, t, h, w, c = x.shape
+        pad = "SAME" if self._same() else "VALID"
+        # hoist the input conv over every timestep: one conv on the
+        # [b*t] batch
+        xw = jax.lax.conv_general_dilated(
+            x.reshape(b * t, h, w, c), params["W"],
+            window_strides=self.stride, padding=pad,
+            dimension_numbers=("NHWC", "HWIO", "NHWC"))
+        if self.has_bias:
+            xw = xw + params["b"]
+        xw = xw.reshape((b, t) + xw.shape[1:])
+
+        def step(carry, inp):
+            h_prev, c_prev = carry
+            xw_t, m_t = inp
+            z = xw_t + jax.lax.conv_general_dilated(
+                h_prev, params["RW"], window_strides=(1, 1),
+                padding="SAME",
+                dimension_numbers=("NHWC", "HWIO", "NHWC"))
+            i = gate(z[..., :F])
+            f = gate(z[..., F:2 * F])
+            o = gate(z[..., 2 * F:3 * F])
+            g = act(z[..., 3 * F:])
+            cc = f * c_prev + i * g
+            hh = o * act(cc)
+            if m_t is not None:
+                keep = (m_t > 0)[:, None, None, None]
+                hh = jnp.where(keep, hh, h_prev)
+                cc = jnp.where(keep, cc, c_prev)
+            return (hh, cc), hh
+
+        (h_last, c_last), ys = self._run_scan(
+            step, (state["h"], state["c"]), xw, mask)
+        if not self.return_sequences:
+            ys = h_last
+        return ys, {"h": h_last, "c": c_last}
+
+    def get_output_type(self, input_type):
+        oh, ow = self._out_hw(input_type.height, input_type.width)
+        if self.return_sequences:
+            return InputType.convolutional_3d(input_type.depth, oh,
+                                              ow, self.n_out)
+        return InputType.convolutional(oh, ow, self.n_out)
+
+
 def _bidir_from_map(d):
     return Bidirectional(fwd=Layer.from_map(d["fwd"]),
                          mode=BidirectionalMode[d["mode"]])
 
 
 for _cls in (SimpleRnn, LSTM, GravesLSTM, GRU, EmbeddingSequenceLayer,
-             LastTimeStepLayer):
+             LastTimeStepLayer, ConvLSTM2D):
     register_layer(_cls)
 
 from deeplearning4j_tpu.nn.conf.layers import LAYER_REGISTRY  # noqa: E402

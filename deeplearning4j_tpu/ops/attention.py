@@ -50,13 +50,20 @@ def merge_heads(a):
 
 
 def multi_head_attention(params, q_in, kv_in, n_heads, key_mask=None):
-    """Projected MHA. params: Wq/Wk/Wv [*, h*dh], Wo [h*dh, n_out].
+    """Projected MHA. params: Wq/Wk/Wv [*, h*dh], Wo [h*dh, n_out];
+    optional projection biases bq/bk/bv [h*dh] and bo [n_out]
+    (the Keras ``MultiHeadAttention(use_bias=True)`` form).
 
     q_in: [b, tq, dq]; kv_in: [b, tk, dk]; key_mask: [b, tk] or None.
     """
-    q = split_heads(q_in @ params["Wq"], n_heads)
-    k = split_heads(kv_in @ params["Wk"], n_heads)
-    v = split_heads(kv_in @ params["Wv"], n_heads)
+    def proj(x, w, b):
+        y = x @ params[w]
+        return y + params[b] if b in params else y
+
+    q = split_heads(proj(q_in, "Wq", "bq"), n_heads)
+    k = split_heads(proj(kv_in, "Wk", "bk"), n_heads)
+    v = split_heads(proj(kv_in, "Wv", "bv"), n_heads)
     m = key_mask[:, None, None, :] if key_mask is not None else None
     o = dot_product_attention(q, k, v, m)
-    return merge_heads(o) @ params["Wo"]
+    out = merge_heads(o) @ params["Wo"]
+    return out + params["bo"] if "bo" in params else out

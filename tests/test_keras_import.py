@@ -124,13 +124,14 @@ class TestSequentialImport:
 
     def test_unsupported_layer_reports_type(self, tmp_path):
         model = keras.Sequential([
-            keras.layers.Input((8,)),
+            keras.layers.Input((8, 1)),
             keras.layers.Dense(4),
-            keras.layers.UnitNormalization(),
+            # still-unmapped layer type: the error must NAME it
+            keras.layers.CategoryEncoding(num_tokens=4),
         ])
         path = _save(model, tmp_path, "keras")
         with pytest.raises(InvalidKerasConfigurationException,
-                           match="UnitNormalization"):
+                           match="CategoryEncoding"):
             KerasModelImport \
                 .import_keras_sequential_model_and_weights(path)
 

@@ -1,0 +1,249 @@
+"""Keras import conformance, modern batch (SURVEY.md D14; round-2
+verdict ask #5): ConvLSTM2D, LayerNormalization, MultiHeadAttention,
+Conv1DTranspose/Conv3DTranspose, 3D global pooling, custom-layer
+registry seam.  Protocol as test_keras_import: build+save with the
+in-image Keras, import, compare predictions."""
+import numpy as np
+import pytest
+
+tf = pytest.importorskip("tensorflow")
+keras = tf.keras
+
+from deeplearning4j_tpu.modelimport.keras import (  # noqa: E402
+    InvalidKerasConfigurationException, KerasModelImport,
+    register_keras_layer_mapper)
+
+R = np.random.RandomState(4)
+
+
+def _compare_sequential(model, x, tmp_path, atol=1e-4):
+    path = str(tmp_path / "model.keras")
+    model.save(path)
+    net = KerasModelImport.import_keras_sequential_model_and_weights(
+        path)
+    want = np.asarray(model(x, training=False))
+    got = np.asarray(net.output(x))
+    np.testing.assert_allclose(got, want, atol=atol, rtol=1e-3)
+    return net
+
+
+def _compare_functional(model, x, tmp_path, atol=1e-4):
+    path = str(tmp_path / "model.keras")
+    model.save(path)
+    net = KerasModelImport.import_keras_model_and_weights(path)
+    want = np.asarray(model(x, training=False))
+    got = np.asarray(net.output(x))
+    np.testing.assert_allclose(got, want, atol=atol, rtol=1e-3)
+    return net
+
+
+class TestLayerNormalization:
+    def test_dense_ln(self, tmp_path):
+        model = keras.Sequential([
+            keras.layers.Input((10,)),
+            keras.layers.Dense(12, activation="relu"),
+            keras.layers.LayerNormalization(),
+            keras.layers.Dense(4),
+        ])
+        # non-trivial gamma/beta
+        model.layers[1].set_weights([
+            (1.0 + 0.3 * R.randn(12)).astype(np.float32),
+            (0.2 * R.randn(12)).astype(np.float32)])
+        x = R.randn(5, 10).astype(np.float32)
+        _compare_sequential(model, x, tmp_path)
+
+    def test_sequence_ln_no_center(self, tmp_path):
+        model = keras.Sequential([
+            keras.layers.Input((7, 6)),
+            keras.layers.LayerNormalization(center=False),
+            keras.layers.Dense(3),
+        ])
+        model.layers[0].set_weights([
+            (1.0 + 0.2 * R.randn(6)).astype(np.float32)])
+        x = R.randn(4, 7, 6).astype(np.float32)
+        _compare_sequential(model, x, tmp_path)
+
+
+class TestConvLSTM2D:
+    def test_return_sequences_false(self, tmp_path):
+        model = keras.Sequential([
+            keras.layers.Input((4, 8, 8, 3)),
+            keras.layers.ConvLSTM2D(5, 3, padding="same"),
+            keras.layers.GlobalAveragePooling2D(),
+            keras.layers.Dense(2),
+        ])
+        x = (R.randn(2, 4, 8, 8, 3) * 0.5).astype(np.float32)
+        _compare_sequential(model, x, tmp_path, atol=3e-4)
+
+    def test_variable_length_time(self, tmp_path):
+        """Input((None, h, w, c)) — the canonical ConvLSTM pattern
+        (regression: the None time dim misclassified the input as 2D
+        convolutional)."""
+        model = keras.Sequential([
+            keras.layers.Input((None, 6, 6, 2)),
+            keras.layers.ConvLSTM2D(3, 3, padding="same"),
+            keras.layers.GlobalAveragePooling2D(),
+        ])
+        x = (R.randn(2, 5, 6, 6, 2) * 0.5).astype(np.float32)
+        _compare_sequential(model, x, tmp_path, atol=3e-4)
+
+    def test_return_sequences_true_strided(self, tmp_path):
+        model = keras.Sequential([
+            keras.layers.Input((3, 8, 8, 2)),
+            keras.layers.ConvLSTM2D(4, 3, strides=2, padding="valid",
+                                    return_sequences=True),
+            keras.layers.GlobalAveragePooling3D(),
+            keras.layers.Dense(2),
+        ])
+        x = (R.randn(2, 3, 8, 8, 2) * 0.5).astype(np.float32)
+        _compare_sequential(model, x, tmp_path, atol=3e-4)
+
+
+class TestMultiHeadAttention:
+    def test_self_attention(self, tmp_path):
+        inp = keras.layers.Input((6, 16))
+        y = keras.layers.MultiHeadAttention(
+            num_heads=2, key_dim=8, name="mha")(inp, inp)
+        y = keras.layers.GlobalAveragePooling1D()(y)
+        y = keras.layers.Dense(3)(y)
+        model = keras.Model(inp, y)
+        x = R.randn(2, 6, 16).astype(np.float32)
+        _compare_functional(model, x, tmp_path)
+
+    def test_no_bias(self, tmp_path):
+        inp = keras.layers.Input((5, 8))
+        y = keras.layers.MultiHeadAttention(
+            num_heads=4, key_dim=4, use_bias=False)(inp, inp, inp)
+        y = keras.layers.GlobalAveragePooling1D()(y)
+        model = keras.Model(inp, y)
+        x = R.randn(3, 5, 8).astype(np.float32)
+        _compare_functional(model, x, tmp_path)
+
+
+class TestUnitNormalization:
+    def test_unit_norm(self, tmp_path):
+        model = keras.Sequential([
+            keras.layers.Input((9,)),
+            keras.layers.Dense(6, activation="tanh"),
+            keras.layers.UnitNormalization(),
+        ])
+        x = R.randn(4, 9).astype(np.float32)
+        _compare_sequential(model, x, tmp_path)
+
+
+class TestConvTranspose1D3D:
+    def test_conv1d_transpose(self, tmp_path):
+        model = keras.Sequential([
+            keras.layers.Input((10, 4)),
+            keras.layers.Conv1DTranspose(6, 3, strides=2,
+                                         padding="same",
+                                         activation="relu"),
+            keras.layers.Conv1DTranspose(2, 3, padding="valid"),
+        ])
+        x = R.randn(3, 10, 4).astype(np.float32)
+        _compare_sequential(model, x, tmp_path)
+
+    def test_conv3d_transpose(self, tmp_path):
+        model = keras.Sequential([
+            keras.layers.Input((4, 4, 4, 2)),
+            keras.layers.Conv3DTranspose(3, 3, strides=2,
+                                         padding="same"),
+        ])
+        x = R.randn(2, 4, 4, 4, 2).astype(np.float32)
+        _compare_sequential(model, x, tmp_path)
+
+
+class TestCustomLayerSeam:
+    def test_register_custom_layer(self, tmp_path):
+        """The registerCustomLayer seam: a user-defined Keras layer
+        imports through a user-registered mapper."""
+
+        @keras.utils.register_keras_serializable("test")
+        class ScaleShift(keras.layers.Layer):
+            def __init__(self, factor=2.0, **kw):
+                super().__init__(**kw)
+                self.factor = factor
+
+            def build(self, input_shape):
+                self.shift = self.add_weight(
+                    shape=(input_shape[-1],), initializer="zeros",
+                    name="shift")
+
+            def call(self, x):
+                return x * self.factor + self.shift
+
+            def get_config(self):
+                return {**super().get_config(),
+                        "factor": self.factor}
+
+        from deeplearning4j_tpu.modelimport.keras.importer import Emit
+        from deeplearning4j_tpu.nn.conf.layers import ActivationLayer
+        from deeplearning4j_tpu.nn.conf.layers_misc import \
+            LayerNormalization  # noqa: F401  (import check only)
+
+        import jax.numpy as jnp
+        from deeplearning4j_tpu.nn.conf.layers import Layer
+        from dataclasses import dataclass
+
+        @dataclass
+        class ScaleShiftLayer(Layer):
+            factor: float = 1.0
+
+            def set_n_in(self, input_type, override):
+                self.n_in = self.n_out = input_type.size
+
+            def init_params(self, key, input_type, dtype=jnp.float32):
+                return {"shift": jnp.zeros((self.n_in,), dtype)}
+
+            def forward(self, params, x, *, training, rng=None,
+                        state=None):
+                return x * self.factor + params["shift"], state
+
+            def get_output_type(self, input_type):
+                return input_type
+
+        @register_keras_layer_mapper("ScaleShift")
+        def _map_scale_shift(cfg, bag):
+            layer = ScaleShiftLayer(factor=float(cfg["factor"]))
+            return [Emit(layer=layer,
+                         params={"shift": bag.get(0, "shift")})]
+
+        try:
+            model = keras.Sequential([
+                keras.layers.Input((6,)),
+                keras.layers.Dense(5, activation="tanh"),
+                ScaleShift(factor=1.5),
+            ])
+            model.layers[1].set_weights(
+                [(0.3 * R.randn(5)).astype(np.float32)])
+            x = R.randn(4, 6).astype(np.float32)
+            _compare_sequential(model, x, tmp_path)
+        finally:
+            from deeplearning4j_tpu.modelimport.keras.importer import \
+                KERAS_LAYER_MAP
+            KERAS_LAYER_MAP.pop("ScaleShift", None)
+
+    def test_unregistered_custom_layer_fails_loudly(self, tmp_path):
+        @keras.utils.register_keras_serializable("test2")
+        class Mystery(keras.layers.Layer):
+            def call(self, x):
+                return x * 2.0
+
+        model = keras.Sequential([
+            keras.layers.Input((4,)),
+            Mystery(),
+        ])
+        path = str(tmp_path / "model.keras")
+        model.save(path)
+        with pytest.raises(InvalidKerasConfigurationException,
+                           match="no mapper"):
+            KerasModelImport.import_keras_sequential_model_and_weights(
+                path)
+
+
+def test_mapper_count_floor():
+    """Registry breadth ratchet (reference has ~60 KerasLayer
+    subclasses; SURVEY.md D14)."""
+    from deeplearning4j_tpu.modelimport.keras.importer import \
+        KERAS_LAYER_MAP
+    assert len(KERAS_LAYER_MAP) >= 60, sorted(KERAS_LAYER_MAP)
