@@ -217,10 +217,11 @@ class SameDiff:
         #: foreign-var captures (control-flow bodies closing over a
         #: parent graph): local name -> (owner SameDiff, owner name)
         self._captures: Dict[str, tuple] = {}
-        #: names of this graph's VARIABLEs frozen into NESTED subgraph
-        #: closures — those values are baked per compile, so fit()
-        #: drops compiled programs after updating one of them.
-        #: (Directly-captured vars are live op inputs instead.)
+        #: names of this graph's VARIABLEs frozen into the closures of
+        #: subgraphs owned by UNRELATED graphs — baked per compile, so
+        #: fit() drops compiled programs after updating one of them.
+        #: (Captures within one tracing chain — direct or nested — are
+        #: live op inputs and never land here.)
         self._frozen_captured_vars: set = set()
         #: set while this graph is being traced as a control-flow
         #: subgraph (enables foreign-var capture in _op)
@@ -542,14 +543,27 @@ class SameDiff:
         # while_loop(max_iterations=N); an UNBOUNDED while_loop
         # raises on any gradient request through its outputs — XLA
         # while has no reverse rule, and silence would train wrong).
-        # Captures of some OTHER graph (nested subgraphs) are frozen
-        # at trace time; their owner drops compiled programs when
-        # such a variable trains.
+        # Captures owned by a graph FURTHER UP the tracing chain
+        # (nested subgraphs) re-capture level-by-level, so they stay
+        # live op inputs at every level and gradients flow the same
+        # way.  Only captures of a genuinely UNRELATED graph are
+        # frozen at trace time; their owner drops compiled programs
+        # when such a variable trains.
         parent_caps = []     # (local_name, parent_name)
         frozen_caps = []     # (local_name, owner, owner_name)
         for local, (owner, pname) in child._captures.items():
             if owner is self:
                 parent_caps.append((local, pname))
+                continue
+            anc = self._tracing_parent
+            while anc is not None and anc is not owner:
+                anc = anc._tracing_parent
+            if anc is owner:
+                # thread LIVE through this intermediate graph: the
+                # re-captured proxy becomes a real op input here and
+                # resolves one level up on the next trace
+                proxy = self._import_foreign(owner.vars[pname])
+                parent_caps.append((local, proxy.name))
                 continue
             if pname not in owner._arrays:
                 raise ValueError(

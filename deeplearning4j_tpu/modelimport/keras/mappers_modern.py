@@ -25,7 +25,9 @@ import numpy as np
 
 from deeplearning4j_tpu.modelimport.keras.importer import (
     Emit, InvalidKerasConfigurationException, KERAS_LAYER_MAP,
-    _activation, _conv_mode, _pair, keras_layer)
+    _activation, _conv_mode,
+    _lstm_reorder as _convlstm_reorder,   # same [i,f,c,o]→[i,f,o,g]
+    _pair, keras_layer)
 from deeplearning4j_tpu.nn.conf.layers import (GlobalPoolingLayer,
                                                PoolingType)
 from deeplearning4j_tpu.nn.conf.layers_attention import \
@@ -62,12 +64,6 @@ def _reject_output_padding(cfg):
                              else [op])):
         raise InvalidKerasConfigurationException(
             f"{cfg['__class__']} output_padding unsupported")
-
-
-# keras gate order [i, f, c, o] → ours [i, f, o, g]: the shared
-# last-axis reorder (importer._lstm_reorder)
-from deeplearning4j_tpu.modelimport.keras.importer import \
-    _lstm_reorder as _convlstm_reorder  # noqa: E402
 
 
 @keras_layer("ConvLSTM2D")

@@ -556,18 +556,24 @@ class GlobalPoolingLayer(Layer):
             axes = (1,)
         else:
             return x, state
-        if mask is not None and x.ndim == 3:
-            m = mask[..., None]
+        if mask is not None and x.ndim in (3, 5):
+            # time mask over [b, t, f] or [b, t, h, w, c] (masked
+            # ConvLSTM sequences): padded steps drop out of the pool
+            m = mask.reshape(mask.shape[:2] + (1,) * (x.ndim - 2))
+            spatial = 1
+            for d in x.shape[2:-1]:
+                spatial *= d
             if self.pooling_type is PoolingType.MAX:
-                z = jnp.max(jnp.where(m > 0, x, -jnp.inf), axis=1)
+                z = jnp.max(jnp.where(m > 0, x, -jnp.inf), axis=axes)
             elif self.pooling_type is PoolingType.SUM:
-                z = jnp.sum(x * m, axis=1)
+                z = jnp.sum(x * m, axis=axes)
             elif self.pooling_type is PoolingType.AVG:
-                z = jnp.sum(x * m, axis=1) / jnp.maximum(
-                    jnp.sum(m, axis=1), 1.0)
+                denom = jnp.maximum(jnp.sum(mask, axis=1),
+                                    1.0)[:, None] * spatial
+                z = jnp.sum(x * m, axis=axes) / denom
             else:                # PNORM over unmasked timesteps
                 p = float(self.pnorm) if hasattr(self, "pnorm") else 2.0
-                z = jnp.sum(jnp.abs(x * m) ** p, axis=1) ** (1.0 / p)
+                z = jnp.sum(jnp.abs(x * m) ** p, axis=axes) ** (1.0 / p)
             return z, state
         if self.pooling_type is PoolingType.MAX:
             z = jnp.max(x, axis=axes)

@@ -87,6 +87,17 @@ class TestConvLSTM2D:
         x = (R.randn(2, 5, 6, 6, 2) * 0.5).astype(np.float32)
         _compare_sequential(model, x, tmp_path, atol=3e-4)
 
+    def test_variable_length_time_functional(self, tmp_path):
+        """Same pattern through the FUNCTIONAL front door (regression:
+        the shape heuristic was keyed on the Sequential path's
+        first-layer class)."""
+        inp = keras.layers.Input((None, 6, 6, 2))
+        y = keras.layers.ConvLSTM2D(3, 3, padding="same")(inp)
+        y = keras.layers.GlobalAveragePooling2D()(y)
+        model = keras.Model(inp, y)
+        x = (R.randn(2, 5, 6, 6, 2) * 0.5).astype(np.float32)
+        _compare_functional(model, x, tmp_path, atol=3e-4)
+
     def test_return_sequences_true_strided(self, tmp_path):
         model = keras.Sequential([
             keras.layers.Input((3, 8, 8, 2)),

@@ -1,0 +1,154 @@
+"""Nested-subgraph captures are LIVE (round-2 verdict Weak #5 / ask
+#6): a VARIABLE captured by a subgraph nested two or more levels deep
+(cond-in-cond, while-in-cond) must receive gradients and train, not
+freeze into the closure as a stale constant."""
+import jax
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.autodiff.samediff import SameDiff
+
+
+def _numeric_grad(f, w, eps=1e-3):
+    g = np.zeros_like(w)
+    for i in range(w.size):
+        wp = w.copy(); wp[i] += eps
+        wm = w.copy(); wm[i] -= eps
+        g[i] = (f(wp) - f(wm)) / (2 * eps)
+    return g
+
+
+class TestNestedCaptures:
+    def test_nested_cond_captured_variable_gradient(self):
+        """loss = sum(cond(outer, cond(inner, b*w, b+w), a*0.5)):
+        w is captured by the INNER cond's branches (two levels below
+        the graph that owns it)."""
+        w0 = np.float32([1.5, -0.5, 2.0])
+        xv = np.float32([1.0, 2.0, 3.0])
+
+        def build():
+            sd = SameDiff()
+            x = sd.placeholder("x", (3,))
+            w = sd.var("w", array=w0.copy())
+            pred = sd.math.gt(sd.math.reduce_sum(x),
+                                   sd.constant("c0", np.float32(0.0)))
+
+            def outer_true(a):
+                csd = a.sd
+                p2 = csd.math.gt(
+                    sd.math.reduce_sum(w),    # also captured here
+                    csd._as_var(np.float32(10.0)))
+
+                def inner_true(b):
+                    return b * w              # nested capture of w
+
+                def inner_false(b):
+                    return b + w              # nested capture of w
+
+                y = csd.cond(p2, inner_true, inner_false, [a])
+                return y
+
+            def outer_false(a):
+                return a * 0.5
+
+            y = sd.cond(pred, outer_true, outer_false, [x])
+            loss = sd.math.reduce_sum(y, name="loss")
+            sd.set_loss_variables(["loss"])
+            return sd
+
+        sd = build()
+        # forward: sum(w) = 3 < 10 → inner_false → x + w
+        out = sd.output({"x": xv}, ["loss"])["loss"]
+        assert float(out) == pytest.approx(float((xv + w0).sum()),
+                                           rel=1e-6)
+        got = sd.calculate_gradients({"x": xv}, ["w"])["w"]
+
+        def ref(w):
+            if xv.sum() <= 0:
+                return (xv * 0.5).sum()
+            if w.sum() > 10:
+                return (xv * w).sum()
+            return (xv + w).sum()
+
+        want = _numeric_grad(ref, w0.astype(np.float64))
+        np.testing.assert_allclose(np.asarray(got), want, atol=1e-3)
+
+    def test_nested_cond_captured_variable_trains(self):
+        """fit() through the nested capture must move w (the frozen
+        form trained it as a stale constant: zero gradient)."""
+        from deeplearning4j_tpu.autodiff.training import TrainingConfig
+        from deeplearning4j_tpu.datasets.dataset import DataSet
+        from deeplearning4j_tpu.datasets.iterators import \
+            ListDataSetIterator
+        from deeplearning4j_tpu.learning import Sgd
+
+        w0 = np.float32([2.0, 2.0])
+        sd = SameDiff()
+        x = sd.placeholder("x", (2,))
+        w = sd.var("w", array=w0.copy())
+        pred = sd.math.gt(sd.math.reduce_sum(x),
+                               sd.constant("c0", np.float32(0.0)))
+
+        def outer_true(a):
+            csd = a.sd
+            p2 = csd.math.gt(csd.math.reduce_sum(a),
+                                  csd._as_var(np.float32(100.0)))
+
+            def inner_true(b):
+                return b + w
+
+            def inner_false(b):
+                return b * w          # taken: loss = sum(x*w)
+
+            y = csd.cond(p2, inner_true, inner_false, [a])
+            return y
+
+        y = sd.cond(pred, outer_true, lambda a: a, [x])
+        sd.math.reduce_sum(y, name="loss")
+        sd.set_loss_variables(["loss"])
+        sd.set_training_config(
+            TrainingConfig.Builder().updater(Sgd(0.1))
+            .data_set_feature_mapping("x").build())
+        xv = np.float32([[1.0, 3.0]])[0]
+        it = ListDataSetIterator([DataSet(xv, None)])
+        sd.fit(it, n_epochs=1)
+        got = np.asarray(sd.get_variable("w").get_arr())
+        # d loss / d w = x → w' = w - 0.1 * x
+        np.testing.assert_allclose(got, w0 - 0.1 * xv, rtol=1e-5)
+
+    def test_while_in_cond_captured_variable_gradient(self):
+        """Bounded while INSIDE a cond branch, its body capturing w:
+        gradients flow through both nesting levels."""
+        w0 = np.float32(1.2)
+        sd = SameDiff()
+        x = sd.placeholder("x", ())
+        w = sd.var("w", array=np.float32(w0))
+        pred = sd.math.gt(x, sd.constant("c0", np.float32(0.0)))
+
+        def true_fn(a):
+            csd = a.sd
+            i0 = csd._as_var(np.int32(0))
+
+            def cond_fn(i, acc):
+                return i.sd.math.lt(i, i.sd._as_var(np.int32(3)))
+
+            def body_fn(i, acc):
+                bsd = i.sd
+                return (bsd.math.add(i, bsd._as_var(np.int32(1))),
+                        acc * w)          # nested capture
+
+            outs = csd.while_loop([i0, a], cond_fn, body_fn,
+                                  max_iterations=4)
+            return outs[1]
+
+        y = sd.cond(pred, true_fn, lambda a: a, [x])
+        sd.math.mul(y, sd.constant("one", np.float32(1.0)),
+                    name="loss")
+        sd.set_loss_variables(["loss"])
+        xv = np.float32(2.0)
+        out = sd.output({"x": xv}, ["loss"])["loss"]
+        assert float(out) == pytest.approx(2.0 * w0 ** 3, rel=1e-5)
+        got = float(np.asarray(
+            sd.calculate_gradients({"x": xv}, ["w"])["w"]))
+        # d/dw (x * w^3) = 3 x w^2
+        assert got == pytest.approx(3 * 2.0 * w0 ** 2, rel=1e-4)
