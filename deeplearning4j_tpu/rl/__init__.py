@@ -14,8 +14,13 @@ from .mdp import MDP, CartPole, GridWorld, StepReply
 from .qlearning import QLearningConfiguration, QLearningDiscreteDense
 from .policy import DQNPolicy, EpsGreedy
 from .a2c import A2CConfiguration, A2CDiscreteDense
+from .vectorized import (A3CVectorized, A3CVectorizedConfiguration,
+                         VectorCartPole)
+from .binding import GymMDPAdapter
 
 __all__ = ["MDP", "StepReply", "CartPole", "GridWorld",
            "QLearningConfiguration", "QLearningDiscreteDense",
            "DQNPolicy", "EpsGreedy", "A2CConfiguration",
-           "A2CDiscreteDense"]
+           "A2CDiscreteDense", "A3CVectorized",
+           "A3CVectorizedConfiguration", "VectorCartPole",
+           "GymMDPAdapter"]

@@ -1,0 +1,55 @@
+"""External environment binding seam (reference:
+``org.deeplearning4j.rl4j.mdp.gym.GymEnv`` / the gym-java-client
+bridge — SURVEY.md D18).
+
+``GymMDPAdapter`` wraps any object speaking the gym API — duck-typed,
+no gym import, zero egress — as an :class:`MDP`, accepting both the
+classic 4-tuple ``(obs, reward, done, info)`` and the gymnasium
+5-tuple ``(obs, reward, terminated, truncated, info)`` step returns,
+and ``reset()`` returning either ``obs`` or ``(obs, info)``."""
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import numpy as np
+
+from deeplearning4j_tpu.rl.mdp import MDP, StepReply
+
+
+class GymMDPAdapter(MDP):
+    """Adapt a gym/gymnasium-style env to the MDP contract."""
+
+    def __init__(self, env: Any, obs_size: Optional[int] = None,
+                 n_actions: Optional[int] = None):
+        self._env = env
+        self.obs_size = obs_size if obs_size is not None else \
+            int(np.prod(env.observation_space.shape))
+        self.n_actions = n_actions if n_actions is not None else \
+            int(env.action_space.n)
+        self._done = True
+
+    def reset(self) -> np.ndarray:
+        out = self._env.reset()
+        obs = out[0] if isinstance(out, tuple) else out
+        self._done = False
+        return np.asarray(obs, np.float32).reshape(-1)
+
+    def step(self, action: int) -> StepReply:
+        out = self._env.step(action)
+        if len(out) == 5:        # gymnasium: terminated | truncated
+            obs, reward, terminated, truncated, info = out
+            done = bool(terminated or truncated)
+        else:                    # classic gym 4-tuple
+            obs, reward, done, info = out
+            done = bool(done)
+        self._done = done
+        return StepReply(np.asarray(obs, np.float32).reshape(-1),
+                         float(reward), done, info)
+
+    def is_done(self) -> bool:
+        return self._done
+
+    def close(self):
+        close = getattr(self._env, "close", None)
+        if close is not None:
+            close()

@@ -1,0 +1,220 @@
+"""Vectorized actor-critic — the SPMD-natural A3C equivalent
+(reference: ``org.deeplearning4j.rl4j.learning.async.a3c.discrete.
+A3CDiscreteDense`` and its ``AsyncGlobal``/worker-thread machinery).
+
+The reference parallelizes by racing N JVM worker threads against a
+shared model.  On TPU the idiomatic equivalent is N PARALLEL
+ENVIRONMENTS advanced in lockstep inside the compiled program: the
+environment dynamics are a pure jax function, so one update =
+``lax.scan`` over T steps of (policy forward → categorical sample →
+batched env step) followed by the n-step return recursion (a reverse
+scan) and the gradient update — ONE jitted XLA program end to end.
+No host↔device transfer happens inside an update; the only host work
+is the python loop over updates.
+
+``VectorCartPole`` implements the classic cart-pole dynamics batched
+over envs with per-env auto-reset — exact same physics as
+``mdp.CartPole``.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from deeplearning4j_tpu.rl.qlearning import _mlp_apply, _mlp_init
+
+
+class VectorCartPole:
+    """Batched cart-pole (gym CartPole dynamics) as pure jax.
+
+    State: dict(s=[n, 4], steps=[n], ep_ret=[n]).  ``step`` applies
+    one action per env, auto-resetting finished envs (the returned
+    ``done``/``ep_ret`` describe the transition BEFORE the reset)."""
+
+    obs_size = 4
+    n_actions = 2
+
+    def __init__(self, n_envs: int, max_steps: int = 200):
+        self.n_envs = n_envs
+        self.max_steps = max_steps
+
+    def reset(self, key) -> dict:
+        s = jax.random.uniform(key, (self.n_envs, 4), minval=-0.05,
+                               maxval=0.05)
+        return {"s": s, "steps": jnp.zeros(self.n_envs, jnp.int32),
+                "ep_ret": jnp.zeros(self.n_envs, jnp.float32)}
+
+    def step(self, state: dict, action, key
+             ) -> Tuple[dict, jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+        s = state["s"]
+        x, x_dot, th, th_dot = (s[:, 0], s[:, 1], s[:, 2], s[:, 3])
+        force = jnp.where(action == 1, 10.0, -10.0)
+        cos, sin = jnp.cos(th), jnp.sin(th)
+        polemass_length, total_mass = 0.05, 1.1
+        temp = (force + polemass_length * th_dot ** 2 * sin) \
+            / total_mass
+        th_acc = (9.8 * sin - cos * temp) / (
+            0.5 * (4.0 / 3.0 - 0.1 * cos ** 2 / total_mass))
+        x_acc = temp - polemass_length * th_acc * cos / total_mass
+        tau = 0.02
+        ns = jnp.stack([x + tau * x_dot, x_dot + tau * x_acc,
+                        th + tau * th_dot, th_dot + tau * th_acc], 1)
+        steps = state["steps"] + 1
+        theta_thr = 12 * 2 * jnp.pi / 360
+        done = ((jnp.abs(ns[:, 0]) > 2.4)
+                | (jnp.abs(ns[:, 2]) > theta_thr)
+                | (steps >= self.max_steps))
+        reward = jnp.ones(self.n_envs, jnp.float32)
+        ep_ret = state["ep_ret"] + reward
+        # auto-reset finished envs
+        fresh = jax.random.uniform(key, ns.shape, minval=-0.05,
+                                   maxval=0.05)
+        ns = jnp.where(done[:, None], fresh, ns)
+        new_state = {"s": ns,
+                     "steps": jnp.where(done, 0, steps),
+                     "ep_ret": jnp.where(done, 0.0, ep_ret)}
+        return new_state, reward, done, ep_ret
+
+
+@dataclass
+class A3CVectorizedConfiguration:
+    seed: int = 7
+    n_envs: int = 16             # = the reference's N async workers
+    n_step: int = 32             # rollout length per update
+    gamma: float = 0.99
+    learning_rate: float = 3e-3
+    entropy_coef: float = 0.01
+    value_coef: float = 0.5
+    hidden: tuple = (64,)
+    max_grad_norm: float = 0.5
+
+
+class A3CVectorized:
+    """N-parallel-env advantage actor-critic, one jitted program per
+    update (rollout + returns + gradient step)."""
+
+    def __init__(self, env: VectorCartPole,
+                 conf: Optional[A3CVectorizedConfiguration] = None):
+        self.env = env
+        self.conf = conf or A3CVectorizedConfiguration()
+        c = self.conf
+        key = jax.random.PRNGKey(c.seed)
+        k1, k2, k3, k4, self._key = jax.random.split(key, 5)
+        trunk_sizes = (env.obs_size,) + tuple(c.hidden)
+        self.params = {
+            "trunk": _mlp_init(k1, trunk_sizes),
+            "pi": _mlp_init(k2, (trunk_sizes[-1], env.n_actions)),
+            "v": _mlp_init(k3, (trunk_sizes[-1], 1)),
+        }
+        from deeplearning4j_tpu.learning import Adam
+        self._updater = Adam(c.learning_rate)
+        self._opt_state = {
+            "inner": self._updater.init_state(self.params),
+            "t": jnp.asarray(0, jnp.int32)}
+        self.env_state = env.reset(k4)
+        self._update = jax.jit(self._make_update())
+
+    def _forward(self, params, obs):
+        h = jax.nn.relu(_mlp_apply(params["trunk"], obs))
+        return (_mlp_apply(params["pi"], h),
+                _mlp_apply(params["v"], h)[..., 0])
+
+    def _make_update(self):
+        c = self.conf
+        env = self.env
+
+        def rollout(params, env_state, key):
+            def step(carry, key_t):
+                est = carry
+                ka, ke = jax.random.split(key_t)
+                obs = est["s"]
+                logits, v = self._forward(params, obs)
+                a = jax.random.categorical(ka, logits)
+                nst, r, d, ep = env.step(est, a, ke)
+                return nst, (obs, a, r, d, ep)
+
+            keys = jax.random.split(key, c.n_step)
+            nst, traj = jax.lax.scan(step, env_state, keys)
+            return nst, traj
+
+        def update(params, opt_state, env_state, key):
+            k_roll, k_next = jax.random.split(key)
+            nst, (obs, act, rew, done, ep_ret) = rollout(
+                params, env_state, k_roll)
+
+            def loss_fn(p):
+                T, N = rew.shape
+                logits, v = self._forward(
+                    p, obs.reshape(T * N, -1))
+                logits = logits.reshape(T, N, -1)
+                v = v.reshape(T, N)
+                _, v_boot = self._forward(p, nst["s"])
+                # n-step returns: reverse scan, cut at dones
+                def back(ret, x):
+                    r, d, = x
+                    ret = r + c.gamma * ret * (1.0 - d)
+                    return ret, ret
+
+                _, rets = jax.lax.scan(
+                    back, jax.lax.stop_gradient(v_boot),
+                    (rew, done.astype(jnp.float32)), reverse=True)
+                adv = jax.lax.stop_gradient(rets) - v
+                logp = jax.nn.log_softmax(logits)
+                lp_a = jnp.take_along_axis(
+                    logp, act[..., None], -1)[..., 0]
+                pg = -jnp.mean(lp_a * jax.lax.stop_gradient(adv))
+                vloss = jnp.mean(adv ** 2)
+                ent = -jnp.mean(jnp.sum(jnp.exp(logp) * logp, -1))
+                return (pg + c.value_coef * vloss
+                        - c.entropy_coef * ent)
+
+            loss, g = jax.value_and_grad(loss_fn)(params)
+            gnorm = jnp.sqrt(sum(
+                jnp.sum(jnp.square(x))
+                for x in jax.tree_util.tree_leaves(g)))
+            scale = jnp.minimum(1.0, c.max_grad_norm
+                                / jnp.maximum(gnorm, 1e-8))
+            g = jax.tree_util.tree_map(lambda x: x * scale, g)
+            upd, new_inner = self._updater.apply(g, opt_state["inner"],
+                                                 opt_state["t"])
+            new_params = jax.tree_util.tree_map(
+                lambda p, u: p - u, params, upd)
+            new_opt = {"inner": new_inner, "t": opt_state["t"] + 1}
+            # episode returns finished during this rollout
+            fin = jnp.where(done, ep_ret, jnp.nan)
+            return new_params, new_opt, nst, k_next, loss, fin
+
+        return update
+
+    def train(self, n_updates: int) -> List[float]:
+        """Run ``n_updates`` jitted updates; returns the rewards of
+        every episode finished during training."""
+        finished: List[float] = []
+        for _ in range(n_updates):
+            (self.params, self._opt_state, self.env_state, self._key,
+             loss, fin) = self._update(self.params, self._opt_state,
+                                       self.env_state, self._key)
+            f = np.asarray(fin)
+            finished.extend(f[~np.isnan(f)].tolist())
+        return finished
+
+    def evaluate(self, n_episodes: int = 10,
+                 max_steps: Optional[int] = None) -> float:
+        """Greedy policy, single-env episodes; mean episode reward."""
+        from deeplearning4j_tpu.rl.mdp import CartPole
+        total = 0.0
+        for ep in range(n_episodes):
+            mdp = CartPole(seed=1000 + ep,
+                           max_steps=max_steps or self.env.max_steps)
+            obs = mdp.reset()
+            while not mdp.is_done():
+                logits, _ = self._forward(self.params,
+                                          jnp.asarray(obs[None]))
+                reply = mdp.step(int(np.asarray(logits[0]).argmax()))
+                total += reply.reward
+                obs = reply.observation
+        return total / n_episodes
