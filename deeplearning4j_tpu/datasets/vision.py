@@ -28,9 +28,15 @@ def _data_dir() -> Path:
 
 
 def synthetic_images(n: int, h: int, w: int, c: int, n_classes: int,
-                     train: bool, seed: int) -> Tuple[np.ndarray,
-                                                      np.ndarray]:
-    """Class-conditional smooth templates + noise, [n,h,w,c] float32."""
+                     train: bool, seed: int,
+                     template_weight: float = 0.6
+                     ) -> Tuple[np.ndarray, np.ndarray]:
+    """Class-conditional smooth templates + noise, [n,h,w,c] float32.
+
+    ``template_weight`` sets the signal fraction (default 0.6).  The
+    pretrained-zoo gates evaluate on a LOWER-weight ("hard") split so
+    the gate sits measurably below saturation (a gate that cannot
+    fail is a plumbing test — round-2 verdict Weak #4)."""
     rng = np.random.RandomState(seed if train else seed + 1)
     tpl_rng = np.random.RandomState(seed)
     tpl = tpl_rng.rand(n_classes, h, w, c).astype(np.float32)
@@ -48,7 +54,8 @@ def synthetic_images(n: int, h: int, w: int, c: int, n_classes: int,
         tpl = acc / k
     ys = rng.randint(0, n_classes, n)
     noise = rng.rand(n, h, w, c).astype(np.float32)
-    xs = np.clip(0.6 * tpl[ys] + 0.4 * noise, 0, 1)
+    tw = float(template_weight)
+    xs = np.clip(tw * tpl[ys] + (1.0 - tw) * noise, 0, 1)
     return xs, ys
 
 

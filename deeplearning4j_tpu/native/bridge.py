@@ -19,7 +19,11 @@ import numpy as np
 _REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(
     os.path.abspath(__file__))))
 _NATIVE_DIR = os.path.join(_REPO_ROOT, "native")
-_SO_PATH = os.path.join(_NATIVE_DIR, "build", "libdl4j_native.so")
+#: DL4J_TPU_NATIVE_LIB overrides the library path (the sanitizer
+#: suite points it at the ASan+UBSan build)
+_SO_PATH = os.environ.get(
+    "DL4J_TPU_NATIVE_LIB",
+    os.path.join(_NATIVE_DIR, "build", "libdl4j_native.so"))
 
 _lib = None
 _lock = threading.Lock()
@@ -88,6 +92,17 @@ def ensure_built(force: bool = False) -> bool:
         if _build_attempted and not force:
             return False
         _build_attempted = True
+        if os.environ.get("DL4J_TPU_NATIVE_LIB"):
+            # explicit override: load-or-fail — silently degrading to
+            # the Python fallbacks would defeat the point (e.g. a
+            # sanitizer run that never touches native code)
+            if not os.path.exists(_SO_PATH):
+                raise OSError(
+                    f"DL4J_TPU_NATIVE_LIB={_SO_PATH} does not exist "
+                    f"(build it first, e.g. `make -C native "
+                    f"sanitize`)")
+            _lib = _configure(ctypes.CDLL(_SO_PATH))
+            return True
         if not os.path.exists(_SO_PATH) or force:
             if not os.path.isdir(_NATIVE_DIR):
                 return False

@@ -108,9 +108,19 @@ def train_resnet_cifar():
     acc = float(ev.accuracy())
     if acc < 0.90:
         raise RuntimeError(f"ResNet-CIFAR gate failed: {acc:.4f} < 0.90")
+    from deeplearning4j_tpu.models.pretrained_gates import (
+        HARD_GATE, HARD_TEMPLATE_WEIGHT, eval_resnet_cifar_hard)
+    hard = eval_resnet_cifar_hard(net)
+    if not HARD_GATE[0] <= hard < HARD_GATE[1]:
+        raise RuntimeError(
+            f"ResNet-CIFAR hard-split gate failed: {hard:.4f} "
+            f"outside {HARD_GATE}")
     ModelSerializer.write_model(net, str(OUT / "resnet_cifar.zip"),
                                 save_updater=False)
-    return {"accuracy": round(acc, 4), "dataset": "synthetic-cifar10",
+    return {"accuracy": round(acc, 4),
+            "hard_split_accuracy": round(hard, 4),
+            "hard_split_template_weight": HARD_TEMPLATE_WEIGHT,
+            "dataset": "synthetic-cifar10",
             "stages": [[2, 16], [2, 32]], "epochs": 3,
             "train_examples": 10000}
 
