@@ -520,6 +520,35 @@ def _gather(ins, attrs):
     return take(ins[0], idx)
 
 
+# -- TensorList / TensorArray (TF dynamic-loop accumulators) ----------------
+# TPU-first representation: a STATIC-size list is a dense
+# [n, *element_shape] tensor — SetItem/GetItem are dynamic slice
+# updates (differentiable, and exactly the loop-carry layout XLA
+# wants), Stack/FromTensor are identity.  Dynamic-size lists
+# (PushBack) have no static-shape representation and are rejected at
+# import.  Documented divergence (README migration table): an
+# out-of-bounds index CLAMPS to the last slot (XLA dynamic-slice
+# semantics — no runtime assertion exists inside a compiled program)
+# where TF raises at runtime.
+@op("tensor_list_set_item", "shape")
+def _tl_set_item(ins, attrs):
+    lst, idx, item = ins
+    return jax.lax.dynamic_update_index_in_dim(
+        lst, item.astype(lst.dtype), idx.astype(jnp.int32), 0)
+
+
+@op("tensor_list_get_item", "shape")
+def _tl_get_item(ins, attrs):
+    return jax.lax.dynamic_index_in_dim(ins[0],
+                                        ins[1].astype(jnp.int32), 0,
+                                        keepdims=False)
+
+
+@op("tensor_list_length", "shape")
+def _tl_length(ins, attrs):
+    return jnp.asarray(ins[0].shape[0], jnp.int32)
+
+
 @op("gather_nd", "shape")
 def _gather_nd(ins, attrs):
     params, indices = ins

@@ -27,7 +27,8 @@ from deeplearning4j_tpu.modelimport.tensorflow import (mappings,
                                                        v1_control_flow)
 from deeplearning4j_tpu.modelimport.tensorflow.mappings import TF_OP_MAP
 from deeplearning4j_tpu.modelimport.tensorflow.protobuf import (
-    FunctionDef, NodeDef, parse_graphdef_with_library, tf_dtype_to_np)
+    Attr, FunctionDef, NodeDef, parse_graphdef_with_library,
+    tf_dtype_to_np)
 
 _SKIP_OPS = {"NoOp", "Assert", "SaveV2", "RestoreV2", "MergeV2Checkpoints"}
 
@@ -323,6 +324,7 @@ class GraphDefImporter:
             # functional While/If, which lower to lax below
             self.nodes = v1_control_flow.deframe(self.nodes,
                                                  self.functions)
+        _resolve_tensor_lists(self.nodes)
         by_name = {n.name: n for n in self.nodes}
         order = _topo_sort(self.nodes, by_name)
         unmapped = sorted({n.op
@@ -411,6 +413,7 @@ class GraphDefImporter:
                      for r in n.inputs],
                     n.attrs)
             for n in fd.nodes]
+        _resolve_tensor_lists(norm_nodes)
 
         def fn(*args):
             # the child graph comes from the proxies, or (zero-arg
@@ -473,6 +476,67 @@ class GraphDefImporter:
             self._function_as_callable(else_fd), operands)
         self._bind(node, outs, n_ops_before)
         self._infer_new_ops(n_ops_before)
+
+
+def _resolve_tensor_lists(nodes: Sequence[NodeDef]):
+    """Pre-pass for TensorArray/TensorList graphs: a static-size list
+    materializes as a dense [n, *element_shape] zeros tensor (the
+    XLA-native loop-carry accumulator).  TF records element_shape=-1
+    on TensorListReserve but the CONCRETE shape on downstream
+    Stack/GetItem/Gather consts, so the handle is followed — including
+    POSITIONALLY through While/StatelessWhile boundaries (functional
+    While maps inputs to outputs 1:1) — until a concrete shape
+    appears.  Results are stashed in the Reserve node's attrs for the
+    mapping rule; unresolved Reserves fail loudly there."""
+    by_name = {n.name: n for n in nodes}
+
+    def const_ints(ref):
+        nd = by_name.get(_node_of(ref))
+        if nd is None or nd.op != "Const":
+            return None
+        val = nd.attr("value")
+        if isinstance(val, Exception):
+            return None
+        arr = np.asarray(val).reshape(-1)
+        if arr.size and (arr.astype(np.int64) < 0).any():
+            return None
+        return tuple(int(v) for v in arr)
+
+    for res in nodes:
+        if res.op != "TensorListReserve":
+            continue
+        data_in = [r for r in res.inputs if not r.startswith("^")]
+        shape = const_ints(data_in[0])        # concrete on the nose?
+        num = const_ints(data_in[1])
+        num = num[0] if num else None
+        aliases = {res.name}
+        changed = True
+        while changed and shape is None:
+            changed = False
+            for n in nodes:
+                data = [r for r in n.inputs if not r.startswith("^")]
+                for i, r in enumerate(data):
+                    if _canon(r) not in aliases:
+                        continue
+                    if n.op in ("While", "StatelessWhile"):
+                        al = n.name if i == 0 else f"{n.name}:{i}"
+                        if al not in aliases:
+                            aliases.add(al)
+                            changed = True
+                    elif n.op in ("Identity", "TensorListSetItem") \
+                            and i == 0 and n.name not in aliases:
+                        # SetItem returns the updated handle
+                        aliases.add(n.name)
+                        changed = True
+                    elif n.op in ("TensorListStack",
+                                  "TensorListGetItem",
+                                  "TensorListGather") and i == 0:
+                        sh = const_ints(data[-1])
+                        if sh is not None:
+                            shape = sh
+        if shape is not None and num is not None:
+            res.attrs["_tl_shape"] = Attr("resolved", shape)
+            res.attrs["_tl_num"] = Attr("resolved", num)
 
 
 class _NoFold(Exception):

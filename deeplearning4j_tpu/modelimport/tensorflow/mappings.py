@@ -748,3 +748,59 @@ def _sparse_softmax_xent(ctx, node):
     sm = ctx.sd._op("softmax", [logits])
     grad = ctx.sd._op("sub", [sm, onehot])
     return [loss, grad]
+
+
+# -- TensorList / TensorArray (TF2 dynamic-loop accumulators; the v2
+# lowering of tf.TensorArray — SURVEY.md S3) --------------------------------
+@tf_op("TensorListReserve")
+def _tensor_list_reserve(ctx, node):
+    shape = node.attr("_tl_shape")     # stashed by _resolve_tensor_lists
+    num = node.attr("_tl_num")
+    if shape is None or num is None:
+        raise NotImplementedError(
+            f"TensorListReserve '{node.name}': element shape or size "
+            f"not statically recoverable — dynamic-size TensorLists "
+            f"(PushBack-style) have no static-shape lowering")
+    from deeplearning4j_tpu.modelimport.tensorflow.protobuf import \
+        tf_dtype_to_np
+    dt = tf_dtype_to_np(int(node.attr("element_dtype", 1)))
+    return ctx.sd.constant(f"{node.name}_storage",
+                           np.zeros((int(num),) + tuple(shape), dt))
+
+
+@tf_op("TensorListSetItem")
+def _tensor_list_set_item(ctx, node):
+    if node.attr("resize_if_index_out_of_bounds", False):
+        # dynamic growth: the dense static-size representation would
+        # silently DROP out-of-bounds writes
+        raise NotImplementedError(
+            "TensorListSetItem with resize_if_index_out_of_bounds "
+            "(dynamic-size TensorList) has no static-shape lowering")
+    return ctx.sd._op("tensor_list_set_item",
+                      [ctx.var(node.inputs[0]), ctx.var(node.inputs[1]),
+                       ctx.var(node.inputs[2])])
+
+
+@tf_op("TensorListGetItem")
+def _tensor_list_get_item(ctx, node):
+    return ctx.sd._op("tensor_list_get_item",
+                      [ctx.var(node.inputs[0]),
+                       ctx.var(node.inputs[1])])
+
+
+@tf_op("TensorListStack", "TensorListFromTensor")
+def _tensor_list_identity(ctx, node):
+    # dense representation: the storage IS the stacked tensor
+    return ctx.var(node.inputs[0])
+
+
+@tf_op("TensorListLength")
+def _tensor_list_length(ctx, node):
+    return ctx.sd._op("tensor_list_length", [ctx.var(node.inputs[0])])
+
+
+@tf_op("TensorListGather")
+def _tensor_list_gather(ctx, node):
+    return ctx.sd._op("gather",
+                      [ctx.var(node.inputs[0]),
+                       ctx.var(node.inputs[1])], {"axis": 0})

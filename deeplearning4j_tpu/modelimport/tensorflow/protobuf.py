@@ -126,7 +126,10 @@ def parse_shape(buf: bytes) -> Optional[Tuple[int, ...]]:
     dims = []
     for _, dbuf in f.get(2, []):
         df = decode_fields(dbuf)
-        size = _signed(df[1][0][1]) if 1 in df else -1
+        # proto3 omits zero-valued fields: an absent size IS 0 (e.g.
+        # the shape-[0] element_shape tensor of a scalar TensorList);
+        # unknown dims are an explicit -1
+        size = _signed(df[1][0][1]) if 1 in df else 0
         dims.append(size)
     return tuple(dims)
 

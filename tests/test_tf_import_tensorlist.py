@@ -1,0 +1,140 @@
+"""TF TensorArray/TensorList import (SURVEY.md S3): the v2 lowering
+of ``tf.TensorArray`` — TensorListReserve/SetItem/GetItem/Stack —
+maps onto a dense [n, *element_shape] accumulator (SetItem is a
+dynamic slice update: differentiable, and the loop-carry layout XLA
+wants).  The element shape is recovered from downstream consts, since
+TF records -1 on the Reserve itself."""
+import numpy as np
+import pytest
+
+tf = pytest.importorskip("tensorflow")
+
+from deeplearning4j_tpu.modelimport.tensorflow import (  # noqa: E402
+    TensorflowFrameworkImporter)
+
+
+def _freeze(fn, *specs):
+    cf = tf.function(fn).get_concrete_function(*specs)
+    return cf.graph.as_graph_def().SerializeToString(), cf
+
+
+def _out(imp):
+    return sorted(n for n in imp.vars if n.startswith("Identity"))[0]
+
+
+class TestTensorArrayImport:
+    def test_while_accumulator_scalar(self):
+        """The canonical pattern: a loop writing one scalar per step,
+        stacked after the loop."""
+        def f(x):
+            ta0 = tf.TensorArray(tf.float32, size=3)
+
+            def body(i, ta):
+                return i + 1, ta.write(
+                    i, tf.reduce_sum(x) * tf.cast(i, tf.float32))
+
+            _, ta = tf.while_loop(lambda i, ta: i < 3, body,
+                                  (tf.constant(0), ta0))
+            return ta.stack()
+
+        gd, frozen = _freeze(f, tf.TensorSpec((2,), tf.float32))
+        xv = np.float32([1.5, 2.5])
+        want = np.asarray(frozen(tf.constant(xv)))
+        imp = TensorflowFrameworkImporter.run_import(gd, {"x": (2,)})
+        got = imp.output({"x": xv}, [_out(imp)])[_out(imp)]
+        np.testing.assert_allclose(got, want, rtol=1e-6)
+
+    def test_while_accumulator_vector(self):
+        def f(x):
+            ta0 = tf.TensorArray(tf.float32, size=4)
+
+            def body(i, ta):
+                return i + 1, ta.write(i, x * tf.cast(i, tf.float32))
+
+            _, ta = tf.while_loop(lambda i, ta: i < 4, body,
+                                  (tf.constant(0), ta0))
+            return ta.stack()
+
+        gd, frozen = _freeze(f, tf.TensorSpec((3,), tf.float32))
+        xv = np.float32([1.0, -2.0, 0.5])
+        want = np.asarray(frozen(tf.constant(xv)))
+        imp = TensorflowFrameworkImporter.run_import(gd, {"x": (3,)})
+        got = imp.output({"x": xv}, [_out(imp)])[_out(imp)]
+        np.testing.assert_allclose(got, want, rtol=1e-6)
+
+    def test_read_back_inside_loop(self):
+        """write + read in the same loop (GetItem through the carried
+        handle)."""
+        def f(x):
+            ta0 = tf.TensorArray(tf.float32, size=4,
+                                 clear_after_read=False)
+            ta0 = ta0.write(0, tf.reduce_sum(x))
+
+            def body(i, ta):
+                prev = ta.read(i - 1)
+                return i + 1, ta.write(i, prev * 2.0)
+
+            _, ta = tf.while_loop(lambda i, ta: i < 4, body,
+                                  (tf.constant(1), ta0))
+            return ta.stack()
+
+        gd, frozen = _freeze(f, tf.TensorSpec((2,), tf.float32))
+        xv = np.float32([0.5, 1.0])
+        want = np.asarray(frozen(tf.constant(xv)))
+        imp = TensorflowFrameworkImporter.run_import(gd, {"x": (2,)})
+        got = imp.output({"x": xv}, [_out(imp)])[_out(imp)]
+        np.testing.assert_allclose(got, want, rtol=1e-6)
+
+    def test_gradient_through_accumulator(self):
+        """Gradients flow through the dense SetItem accumulator in the
+        bounded-while lowering — vs tf.GradientTape ground truth."""
+        w0 = np.float32([1.2, 0.8])
+
+        def loop_fn(w):
+            ta0 = tf.TensorArray(tf.float32, size=3)
+
+            def body(i, ta):
+                return i + 1, ta.write(
+                    i, tf.reduce_sum(w) ** tf.cast(i + 1, tf.float32))
+
+            _, ta = tf.while_loop(lambda i, ta: i < 3, body,
+                                  (tf.constant(0), ta0))
+            return tf.reduce_sum(ta.stack())
+
+        with tf.GradientTape() as tape:
+            wt = tf.Variable(w0)
+            loss = loop_fn(wt)
+        want_grad = np.asarray(tape.gradient(loss, wt))
+
+        gd, frozen = _freeze(loop_fn, tf.TensorSpec((2,), tf.float32))
+        imp = TensorflowFrameworkImporter.run_import(
+            gd, {"w": (2,)}, while_max_iterations=8)
+        out = _out(imp)
+        got_loss = float(imp.output({"w": w0}, [out])[out])
+        assert got_loss == pytest.approx(float(frozen(
+            tf.constant(w0))), rel=1e-5)
+        imp.convert_to_variables(["w"], {"w": w0})
+        imp.set_loss_variables([out])
+        got = imp.calculate_gradients({}, ["w"])["w"]
+        np.testing.assert_allclose(got, want_grad, rtol=1e-4)
+
+    def test_dynamic_size_fails_loudly(self):
+        """PushBack-style (dynamic size) lists have no static-shape
+        lowering and must fail with the TensorList message, not import
+        silently wrong."""
+        def f(x):
+            ta0 = tf.TensorArray(tf.float32, size=0,
+                                 dynamic_size=True)
+
+            def body(i, ta):
+                return i + 1, ta.write(i, x[0] * tf.cast(
+                    i, tf.float32))
+
+            _, ta = tf.while_loop(lambda i, ta: i < 3, body,
+                                  (tf.constant(0), ta0))
+            return ta.stack()
+
+        gd, _ = _freeze(f, tf.TensorSpec((2,), tf.float32))
+        with pytest.raises(NotImplementedError,
+                           match="TensorList|no mapping"):
+            TensorflowFrameworkImporter.run_import(gd, {"x": (2,)})

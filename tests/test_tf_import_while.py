@@ -182,22 +182,24 @@ class TestFunctionBodyPorts:
     def test_unmapped_op_in_body_fails_precheck(self):
         """An unmapped op inside a While body must fail the import
         precheck with the 'no mapping' parity message, not a bare
-        KeyError mid-trace."""
+        KeyError mid-trace.  (TensorArray, the original example here,
+        imports now — test_tf_import_tensorlist.py.)"""
         def f(x):
-            def cond(i, ta):
+            def cond(i, acc):
                 return i < 3
 
-            def body(i, ta):
-                return i + 1, ta.write(i, tf.reduce_sum(x) * tf.cast(
-                    i, tf.float32))
+            def body(i, acc):
+                s = tf.linalg.svd(tf.reshape(acc, (2, 2)),
+                                  compute_uv=False)
+                return i + 1, acc + tf.reduce_sum(s)
 
-            ta0 = tf.TensorArray(tf.float32, size=3)
-            _, ta = tf.while_loop(cond, body, (tf.constant(0), ta0))
-            return ta.stack()
+            _, acc = tf.while_loop(cond, body,
+                                   (tf.constant(0), x))
+            return acc
 
-        gd, _ = _freeze(f, tf.TensorSpec((2,), tf.float32))
+        gd, _ = _freeze(f, tf.TensorSpec((4,), tf.float32))
         with pytest.raises(NotImplementedError, match="no mapping"):
-            TensorflowFrameworkImporter.run_import(gd, {"x": (2,)})
+            TensorflowFrameworkImporter.run_import(gd, {"x": (4,)})
 
     def test_zero_operand_branches(self):
         """Branches that capture nothing (constant-only lambdas)
