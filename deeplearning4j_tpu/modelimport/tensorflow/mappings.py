@@ -759,8 +759,12 @@ def _tensor_list_reserve(ctx, node):
     if shape is None or num is None:
         raise NotImplementedError(
             f"TensorListReserve '{node.name}': element shape or size "
-            f"not statically recoverable — dynamic-size TensorLists "
-            f"(PushBack-style) have no static-shape lowering")
+            f"not recoverable by the resolver (it reads direct Const "
+            f"producers, following the handle through While "
+            f"boundaries). Either the list is dynamic-size "
+            f"(PushBack-style — no static-shape lowering exists) or "
+            f"the size/shape comes through a derived chain this "
+            f"resolver does not fold yet")
     from deeplearning4j_tpu.modelimport.tensorflow.protobuf import \
         tf_dtype_to_np
     dt = tf_dtype_to_np(int(node.attr("element_dtype", 1)))
