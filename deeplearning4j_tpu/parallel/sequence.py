@@ -148,9 +148,12 @@ def _flash_kernel(q_ref, k_ref, v_ref, *rest, n_kb: int, causal: bool,
         m_acc[:] = jnp.full_like(m_acc, NEG_INF)
 
     def _update():
-        q = q_ref[:].astype(jnp.float32)
-        kb = k_ref[:].astype(jnp.float32)
-        vb = v_ref[:].astype(jnp.float32)
+        # operands stay bf16 — the MXU runs bf16×bf16→f32 natively at
+        # 2x the f32 rate; accumulation is f32 via
+        # preferred_element_type (casting inputs to f32 halves
+        # matmul throughput for zero accuracy gain)
+        q = q_ref[:]
+        kb = k_ref[:]
         s = jax.lax.dot_general(q, kb, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32)
         s = s * scale
@@ -169,7 +172,7 @@ def _flash_kernel(q_ref, k_ref, v_ref, *rest, n_kb: int, causal: bool,
         p = jnp.where(s <= NEG_INF / 2, 0.0, jnp.exp(s - m_new))
         l_new = l_prev * corr + jnp.sum(p, axis=-1, keepdims=True)
         o_acc[:] = o_acc[:] * corr + jax.lax.dot_general(
-            p, vb, (((1,), (0,)), ((), ())),
+            p.astype(v_ref.dtype), v_ref[:], (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
         m_acc[:] = m_new + jnp.zeros_like(m_acc)
         l_acc[:] = l_new + jnp.zeros_like(l_acc)
@@ -196,11 +199,18 @@ def _flash_forward(q, k, v, key_mask, causal: bool, block_q: int,
     b, h, tq, d = q.shape
     tk = k.shape[2]
     scale = 1.0 / (d ** 0.5)
-    block_q = min(block_q, tq)
-    block_k = min(block_k, tk)
-    if tq % block_q or tk % block_k:
-        raise ValueError(f"seq lens ({tq},{tk}) must divide blocks "
-                         f"({block_q},{block_k})")
+
+    def _fit(block, t):
+        # largest divisor of t that is <= the requested block (halve
+        # until it divides): a 1536-long sequence runs with 512-blocks
+        # rather than erroring on the 1024 default
+        block = min(block, t)
+        while t % block:
+            block //= 2
+        return max(block, 1)
+
+    block_q = _fit(block_q, tq)
+    block_k = _fit(block_k, tk)
     n_kb = tk // block_k
     qr = q.reshape(b * h, tq, d)
     kr = k.reshape(b * h, tk, d)
@@ -247,13 +257,19 @@ def _flash_forward(q, k, v, key_mask, causal: bool, block_q: int,
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
-def flash_attention(q, k, v, causal: bool = False, block_q: int = 128,
-                    block_k: int = 128,
+def flash_attention(q, k, v, causal: bool = False, block_q: int = 1024,
+                    block_k: int = 1024,
                     interpret: Optional[bool] = None, key_mask=None):
     """Fused attention kernel, [b, h, t, d]. Equals dense softmax
     attention; O(block) VMEM. ``key_mask``: [b, tk], 0 = masked.
     Backward = flash-style recompute through
-    :func:`blockwise_attention` (jax.grad-differentiable)."""
+    :func:`blockwise_attention` (jax.grad-differentiable).
+
+    Default 1024x1024 blocks measured 4.2x faster than 128x256 at seq
+    8192 on v5e (fewer grid steps amortize the per-block overhead; the
+    f32 score block is 4 MB of VMEM) — BENCH_notes_r03.md. Blocks
+    clamp to the sequence length, so short sequences still work;
+    below ~4k prefer plain XLA attention, which wins outright there."""
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
     return _flash_forward(q, k, v, key_mask, causal, block_q, block_k,

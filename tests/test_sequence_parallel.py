@@ -92,10 +92,16 @@ class TestFlashAttention:
             np.testing.assert_allclose(np.asarray(a), np.asarray(b),
                                        atol=5e-5)
 
-    def test_rejects_indivisible_lengths(self):
+    def test_indivisible_lengths_autofit_blocks(self):
+        """Blocks that don't divide the sequence shrink to a divisor
+        instead of erroring (t=48 with 32-blocks runs at 16)."""
         q, k, v = _qkv(t=48)
-        with pytest.raises(ValueError):
-            flash_attention(q, k, v, False, 32, 32, True)
+        out = flash_attention(q, k, v, False, 32, 32, True)
+        s = np.einsum("bhqd,bhkd->bhqk", q, k) / np.sqrt(q.shape[-1])
+        e = np.exp(s - s.max(-1, keepdims=True))
+        want = np.einsum("bhqk,bhkd->bhqd",
+                         e / e.sum(-1, keepdims=True), v)
+        np.testing.assert_allclose(np.asarray(out), want, atol=2e-5)
 
 
 class TestRingAttention:
