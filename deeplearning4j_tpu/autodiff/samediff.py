@@ -496,14 +496,78 @@ class SameDiff:
                tuple(sorted((k, v.shape, str(v.dtype))
                             for k, v in ph_vals.items())))
         if sig not in self._exec_cache:
-            fn, var_names = self._build_fn(tuple(outputs),
-                                           tuple(ph_vals), training)
-            self._exec_cache[sig] = (jax.jit(fn), var_names)
+            _, _, fn, var_vals = self._prepare(placeholders, outputs,
+                                               training)
+            self._exec_cache[sig] = (jax.jit(fn), list(var_vals))
         jfn, var_names = self._exec_cache[sig]
         var_vals = {n: self._arrays[n] for n in var_names}
         self._rng, rng = jax.random.split(self._rng)
         res = jfn(var_vals, ph_vals, rng)
         return {n: np.asarray(r) for n, r in zip(outputs, res)}
+
+    def _prepare(self, placeholders: dict, outputs: Sequence[str],
+                 training: bool):
+        """Shared preamble of output/to_stablehlo/export_serialized:
+        name normalization, placeholder coercion, subgraph build,
+        variable-value gather."""
+        outputs = tuple(o.name if isinstance(o, SDVariable) else o
+                        for o in outputs)
+        ph_vals = {k: (v if isinstance(v, jax.ShapeDtypeStruct)
+                       else jnp.asarray(v))
+                   for k, v in placeholders.items()}
+        fn, var_names = self._build_fn(outputs, tuple(ph_vals),
+                                       training)
+        var_vals = {n: self._arrays[n] for n in var_names}
+        return outputs, ph_vals, fn, var_vals
+
+    def to_stablehlo(self, placeholders: dict,
+                     outputs: Sequence[str],
+                     *, training: bool = False) -> str:
+        """StableHLO text of the ONE compiled program this subgraph
+        lowers to (SURVEY.md §2.7 item 1: the "StableHLO graph
+        emitter" role of the reference's native graph runtime —
+        here the emitter is the jax lowering of the already-built
+        program; this is the portable, inspectable artifact).
+        ``placeholders`` supply shapes/dtypes (arrays or
+        ShapeDtypeStruct)."""
+        _, ph_vals, fn, var_vals = self._prepare(placeholders,
+                                                 outputs, training)
+        lowered = jax.jit(fn).lower(var_vals, ph_vals,
+                                    jax.random.PRNGKey(0))
+        return lowered.as_text()
+
+    def export_serialized(self, placeholders: dict,
+                          outputs: Sequence[str],
+                          *, training: bool = False) -> bytes:
+        """Portable serialized program (``jax.export`` bytes: versioned
+        StableHLO + calling convention) — the AOT hand-off artifact
+        for serving runtimes.  The RNG key stays a program INPUT so
+        stochastic graphs (dropout, random ops) are reseedable per
+        call.  Round-trips with :func:`deserialize_and_call`."""
+        from jax import export as jax_export
+        _, ph_vals, fn, var_vals = self._prepare(placeholders,
+                                                 outputs, training)
+
+        def closed(ph, rng):
+            return fn(var_vals, ph, rng)
+
+        args = {k: jax.ShapeDtypeStruct(v.shape, v.dtype)
+                for k, v in ph_vals.items()}
+        key_spec = jax.ShapeDtypeStruct(
+            jax.random.PRNGKey(0).shape,
+            jax.random.PRNGKey(0).dtype)
+        exported = jax_export.export(jax.jit(closed))(args, key_spec)
+        return bytes(exported.serialize())
+
+    @staticmethod
+    def deserialize_and_call(blob: bytes, placeholders: dict,
+                             seed: int = 0):
+        """Run a program serialized by :meth:`export_serialized`."""
+        from jax import export as jax_export
+        exported = jax_export.deserialize(bytearray(blob))
+        return exported.call({k: jnp.asarray(v)
+                              for k, v in placeholders.items()},
+                             jax.random.PRNGKey(seed))
 
     # -- control flow (SURVEY.md S3 / Appendix A) ----------------------
     def _trace_subgraph(self, fn, n_args: int):
