@@ -26,6 +26,67 @@ from .tokenization import DefaultTokenizerFactory
 from .vocab import VocabCache, build_vocab
 
 
+def build_huffman(counts: np.ndarray):
+    """Huffman tree over word frequencies (reference: the
+    `HuffmanTree`/`PointIndex` construction behind
+    ``useHierarchicSoftmax``).  Returns per-word padded path arrays
+    ``(nodes [V, L], codes [V, L], mask [V, L])`` where ``nodes`` are
+    internal-node ids (0..V-2), ``codes`` the binary branch taken and
+    ``mask`` marks real path entries."""
+    import heapq
+    v = len(counts)
+    if v == 1:
+        return (np.zeros((1, 1), np.int32), np.zeros((1, 1),
+                np.float32), np.ones((1, 1), np.float32))
+    heap = [(int(c), i) for i, c in enumerate(counts)]
+    heapq.heapify(heap)
+    parent = {}
+    binary = {}
+    nxt = v                       # internal nodes: v .. 2v-2
+    while len(heap) > 1:
+        ca, a = heapq.heappop(heap)
+        cb, b = heapq.heappop(heap)
+        parent[a], parent[b] = nxt, nxt
+        binary[a], binary[b] = 0, 1
+        heapq.heappush(heap, (ca + cb, nxt))
+        nxt += 1
+    paths = []
+    for w in range(v):
+        nodes, codes = [], []
+        n = w
+        while n in parent:
+            nodes.append(parent[n] - v)   # internal id 0..v-2
+            codes.append(binary[n])
+            n = parent[n]
+        paths.append((nodes, codes))
+    L = max(len(n) for n, _ in paths)
+    nodes_a = np.zeros((v, L), np.int32)
+    codes_a = np.zeros((v, L), np.float32)
+    mask_a = np.zeros((v, L), np.float32)
+    for w, (nodes, codes) in enumerate(paths):
+        k = len(nodes)
+        nodes_a[w, :k] = nodes
+        codes_a[w, :k] = codes
+        mask_a[w, :k] = 1.0
+    return nodes_a, codes_a, mask_a
+
+
+def _hs_step(win, wout, centers, nodes, codes, mask, lr):
+    """One skip-gram HIERARCHICAL-SOFTMAX SGD step (jitted): the
+    output distribution is the product of sigmoid branch decisions
+    along the context word's Huffman path — O(log V) dot products per
+    pair instead of k negatives."""
+    def loss_fn(win, wout):
+        v = win[centers]                        # [b, d]
+        u = wout[nodes]                         # [b, L, d]
+        s = jnp.einsum("bd,bld->bl", v, u)
+        sign = 1.0 - 2.0 * codes                # code 0 → +1, 1 → -1
+        return -jnp.sum(jax.nn.log_sigmoid(sign * s) * mask)
+
+    loss, grads = jax.value_and_grad(loss_fn, argnums=(0, 1))(win, wout)
+    return win - lr * grads[0], wout - lr * grads[1], loss
+
+
 def _sgns_step(win, wout, centers, contexts, negatives, lr):
     """One skip-gram negative-sampling SGD step (jitted)."""
     def loss_fn(win, wout):
@@ -59,10 +120,14 @@ class SequenceVectors:
     def __init__(self, layer_size=64, window_size=5, negative=5,
                  learning_rate=0.01, min_learning_rate=1e-4,
                  epochs=1, batch_size=512, min_word_frequency=1,
-                 seed=12345, tokenizer_factory=None):
+                 seed=12345, tokenizer_factory=None,
+                 use_hierarchic_softmax=False):
         self.layer_size = layer_size
         self.window_size = window_size
         self.negative = negative
+        #: reference `useHierarchicSoftmax`: O(log V) Huffman-path
+        #: sigmoid decisions replace the k negative samples
+        self.use_hierarchic_softmax = use_hierarchic_softmax
         self.learning_rate = learning_rate
         self.min_learning_rate = min_learning_rate
         self.epochs = epochs
@@ -75,6 +140,8 @@ class SequenceVectors:
         self.syn0: Optional[np.ndarray] = None   # input/lookup table
         self.syn1: Optional[np.ndarray] = None   # output table
         self._step = jax.jit(_sgns_step)
+        self._hs = jax.jit(_hs_step)
+        self._huffman = None
 
     # -- data --------------------------------------------------------
     def _tokenize_corpus(self, sentences: Iterable) -> List[List[str]]:
@@ -105,8 +172,15 @@ class SequenceVectors:
 
     def _train_pairs(self, all_pairs: np.ndarray, n_out: int):
         rng = np.random.RandomState(self.seed + 1)
-        probs = self.vocab.neg_sampling_probs().astype(np.float64)
-        probs = probs / probs.sum()
+        hs = self.use_hierarchic_softmax
+        if hs:
+            counts = np.array([self.vocab.counts[w]
+                               for w in self.vocab.words], np.int64)
+            h_nodes, h_codes, h_mask = build_huffman(counts)
+            self._huffman = (h_nodes, h_codes, h_mask)
+        else:
+            probs = self.vocab.neg_sampling_probs().astype(np.float64)
+            probs = probs / probs.sum()
         win = jnp.asarray(self.syn0)
         wout = jnp.asarray(self.syn1)
         n = len(all_pairs)
@@ -121,16 +195,24 @@ class SequenceVectors:
                     sel = np.concatenate(
                         [sel, rng.choice(n, self.batch_size - len(sel))])
                 batch = all_pairs[sel]
-                negs = rng.choice(len(probs),
-                                  (self.batch_size, self.negative),
-                                  p=probs)
                 lr = max(self.min_learning_rate,
                          self.learning_rate
                          * (1 - step_i / steps_total))
-                win, wout, _ = self._step(
-                    win, wout, jnp.asarray(batch[:, 0]),
-                    jnp.asarray(batch[:, 1]),
-                    jnp.asarray(negs), lr)
+                if hs:
+                    ctx = batch[:, 1]
+                    win, wout, _ = self._hs(
+                        win, wout, jnp.asarray(batch[:, 0]),
+                        jnp.asarray(h_nodes[ctx]),
+                        jnp.asarray(h_codes[ctx]),
+                        jnp.asarray(h_mask[ctx]), lr)
+                else:
+                    negs = rng.choice(len(probs),
+                                      (self.batch_size, self.negative),
+                                      p=probs)
+                    win, wout, _ = self._step(
+                        win, wout, jnp.asarray(batch[:, 0]),
+                        jnp.asarray(batch[:, 1]),
+                        jnp.asarray(negs), lr)
                 step_i += 1
         self.syn0 = np.asarray(win)
         self.syn1 = np.asarray(wout)
@@ -182,6 +264,10 @@ class Word2Vec(SequenceVectors):
             self._kw["negative"] = int(v)
             return self
 
+        def use_hierarchic_softmax(self, v=True):
+            self._kw["use_hierarchic_softmax"] = bool(v)
+            return self
+
         def learning_rate(self, v):
             self._kw["learning_rate"] = v
             return self
@@ -224,7 +310,8 @@ class Word2Vec(SequenceVectors):
         seqs = self._tokenize_corpus(sentences)
         self.vocab = build_vocab(seqs, self.min_word_frequency)
         v = len(self.vocab)
-        self._init_tables(v, v)
+        self._init_tables(
+            v, max(v - 1, 1) if self.use_hierarchic_softmax else v)
         pairs = []
         rng = np.random.RandomState(self.seed + 2)
         for seq in seqs:
@@ -255,7 +342,9 @@ class ParagraphVectors(SequenceVectors):
                                  range(len(seqs))]
         self.vocab = build_vocab(seqs, self.min_word_frequency)
         v = len(self.vocab)
-        self._init_tables(len(seqs), v)
+        self._init_tables(
+            len(seqs),
+            max(v - 1, 1) if self.use_hierarchic_softmax else v)
         pairs = []
         for d, seq in enumerate(seqs):
             for t in seq:
@@ -283,9 +372,35 @@ class ParagraphVectors(SequenceVectors):
         rng = np.random.RandomState(self.seed + 3)
         dv = ((rng.rand(self.layer_size) - 0.5)
               / self.layer_size).astype(np.float32)
+        wout = jnp.asarray(self.syn1)
+
+        if self.use_hierarchic_softmax:
+            # inference against the FROZEN Huffman internal-node
+            # table: the same path objective training used
+            h_nodes, h_codes, h_mask = self._huffman
+            nodes = jnp.asarray(h_nodes[ids])
+            codes = jnp.asarray(h_codes[ids])
+            mask = jnp.asarray(h_mask[ids])
+
+            @jax.jit
+            def hs_step(dv, lr):
+                def loss_fn(dv):
+                    s = jnp.einsum("d,bld->bl", dv, wout[nodes])
+                    sign = 1.0 - 2.0 * codes
+                    # mean over words, SUM over the path — the same
+                    # per-word gradient scale as the SGNS branch
+                    return -jnp.mean(jnp.sum(
+                        jax.nn.log_sigmoid(sign * s) * mask, -1))
+                return dv - lr * jax.grad(loss_fn)(dv)
+
+            dv = jnp.asarray(dv)
+            for i in range(steps):
+                lr = learning_rate * (1 - i / steps) + 1e-4
+                dv = hs_step(dv, lr)
+            return np.asarray(dv)
+
         probs = self.vocab.neg_sampling_probs().astype(np.float64)
         probs = probs / probs.sum()
-        wout = jnp.asarray(self.syn1)
 
         @jax.jit
         def step(dv, contexts, negatives, lr):

@@ -218,3 +218,61 @@ class TestGlove:
         assert np.isfinite(v).all()
         near = g.words_nearest("cat", 3)
         assert len(near) == 3 and "cat" not in near
+
+
+class TestHierarchicalSoftmax:
+    def test_huffman_paths_are_prefix_free_and_frequency_ordered(self):
+        from deeplearning4j_tpu.nlp.word2vec import build_huffman
+        counts = np.asarray([100, 50, 20, 10, 5, 2, 1])
+        nodes, codes, mask = build_huffman(counts)
+        v = len(counts)
+        assert nodes.shape == codes.shape == mask.shape
+        assert nodes.max() <= v - 2
+        lens = mask.sum(1)
+        # Huffman property: more frequent words get shorter codes
+        assert lens[0] == lens.min()
+        assert lens[-1] == lens.max()
+        # prefix-free: no full path equals the prefix of another
+        paths = [tuple(zip(nodes[w][:int(lens[w])],
+                           codes[w][:int(lens[w])])) for w in range(v)]
+        for i in range(v):
+            for j in range(v):
+                if i != j:
+                    assert paths[i] != paths[j][:len(paths[i])]
+
+    def test_hs_paragraph_vectors_infer(self):
+        """PV-DBOW with HS: inference must use the Huffman-path
+        objective (regression: it indexed the [V-1] internal-node
+        table with word ids and silently clamped) — same relative
+        cluster gate as the SGNS inference test."""
+        from deeplearning4j_tpu.nlp.word2vec import ParagraphVectors
+        corpus, a, b = _two_cluster_corpus(120, seed=3)
+        pv = ParagraphVectors(layer_size=16, epochs=50, seed=5,
+                              learning_rate=0.02,
+                              use_hierarchic_softmax=True)
+        pv.fit(corpus)
+        v = pv.infer_vector("apple cherry banana grape apple cherry",
+                            steps=300, learning_rate=0.08)
+        sims = pv.doc_vectors @ v / (
+            np.linalg.norm(pv.doc_vectors, axis=1)
+            * np.linalg.norm(v) + 1e-12)
+        fruit = [i for i, s in enumerate(corpus) if "apple" in s
+                 or "banana" in s or "cherry" in s or "grape" in s]
+        tools = [i for i in range(len(corpus)) if i not in fruit]
+        assert sims[fruit].mean() > sims[tools].mean() + 0.1
+
+    def test_hs_word2vec_clusters(self):
+        """Same two-cluster quality gate as the SGNS test, trained
+        with useHierarchicSoftmax (reference mode parity)."""
+        corpus, a, b = _two_cluster_corpus(100)
+        w2v = (Word2Vec.Builder()
+               .min_word_frequency(2).layer_size(24).window_size(3)
+               .use_hierarchic_softmax(True).epochs(8).seed(7)
+               .learning_rate(0.0025)
+               .iterate(corpus).build())
+        w2v.fit()
+        # HS output table has V-1 internal nodes
+        assert w2v.syn1.shape[0] == len(w2v.vocab) - 1
+        intra = w2v.similarity("apple", "banana")
+        inter = w2v.similarity("apple", "wrench")
+        assert intra > inter + 0.2, (intra, inter)
