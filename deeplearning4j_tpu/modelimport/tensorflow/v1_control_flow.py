@@ -94,12 +94,32 @@ def _consumers_map(nodes: Sequence[NodeDef]) -> Dict[str, List[NodeDef]]:
     return out
 
 
+def _is_pivot_anchor(node: Optional[NodeDef], by_name) -> bool:
+    """The switch_t/switch_f shape: an Identity whose single data
+    input is a Switch port — the control anchor v1 conds hang
+    constant-only branches on."""
+    if node is None or node.op != "Identity":
+        return False
+    data = _data_inputs(node)
+    if len(data) != 1:
+        return False
+    sw = by_name.get(_node_of(data[0]))
+    return sw is not None and sw.op in _SWITCH
+
+
 def _backslice(roots: Sequence[str], by_name: Dict[str, NodeDef],
-               stops: Set[str]) -> Set[str]:
+               stops: Set[str], follow_anchors: bool = False
+               ) -> Set[str]:
     """Backward data-flow closure from ``roots`` (node names), never
-    entering ``stops``.  Control deps are not followed (the lowered XLA
-    program has no side effects to order).  Names not in ``by_name``
-    (function args, already-imported externals) terminate the walk."""
+    entering ``stops``.  With ``follow_anchors`` the walk also crosses
+    control deps whose target matches the PIVOT-ANCHOR shape
+    (Identity-of-Switch) — while-frame slices need it so a nested
+    cond's ``^switch_t`` chain (anchor → pivot Switch → pred) rides
+    into the body function where the cond reconstruction can use it.
+    Arbitrary control deps (e.g. ``^ext`` ordering against outer
+    nodes) are NOT followed — swallowing out-of-frame nodes would
+    delete them from the enclosing graph.  Names not in ``by_name``
+    terminate the walk."""
     seen: Set[str] = set()
     stack = [r for r in roots if r not in stops]
     while stack:
@@ -110,10 +130,16 @@ def _backslice(roots: Sequence[str], by_name: Dict[str, NodeDef],
         if node is None:
             continue
         seen.add(nm)
-        for ref in _data_inputs(node):
+        for ref in node.inputs:
             dep = _node_of(ref)
-            if dep not in seen and dep not in stops:
-                stack.append(dep)
+            if dep in seen or dep in stops:
+                continue
+            if ref.startswith("^"):
+                if follow_anchors and _is_pivot_anchor(
+                        by_name.get(dep), by_name):
+                    stack.append(dep)
+                continue
+            stack.append(dep)
     return seen
 
 
@@ -145,15 +171,27 @@ def _rewrite_slice(slice_nodes: Sequence[NodeDef],
                    ref_map: Dict[str, str],
                    expect_port: Dict[str, int]) -> List[NodeDef]:
     """Copy slice nodes into a synthetic function body: boundary refs
-    (``ref_map`` keyed by node name) become argument names, control
-    deps are dropped (data flow fully determines the lowered
-    program)."""
+    (``ref_map`` keyed by node name) become argument names.  Control
+    deps are KEPT at this stage — a nested constant-only cond's branch
+    parity lives in its ``^switch_t``/``^switch_f`` anchors, which the
+    fn-level cond reconstruction still needs; `_strip_control_deps`
+    runs after it."""
     out = []
     for n in slice_nodes:
-        new_inputs = [_guarded_rewrite(r, ref_map, expect_port)
-                      for r in n.inputs if not r.startswith("^")]
+        new_inputs = [r if r.startswith("^")
+                      else _guarded_rewrite(r, ref_map, expect_port)
+                      for r in n.inputs]
         out.append(NodeDef(n.name, n.op, new_inputs, n.attrs))
     return out
+
+
+def _strip_control_deps(nodes: List[NodeDef]) -> List[NodeDef]:
+    """Final fn-body pass: the lowered XLA program has no side effects
+    to order, and out-of-list control targets would break the
+    importer's topo sort."""
+    for n in nodes:
+        n.inputs = [r for r in n.inputs if not r.startswith("^")]
+    return nodes
 
 
 # -- while frames ------------------------------------------------------------
@@ -255,10 +293,10 @@ def _plan_while(fname, enters, nodes, by_name, consumers):
     const_names = {c.name for c in const_enters}
     stops = merge_names | switch_names | const_names | {loopcond.name}
     cond_slice = _backslice([_node_of(loopcond.inputs[0])], by_name,
-                            stops)
+                            stops, follow_anchors=True)
     body_slice = _backslice(
         [_node_of(lv.nextiter.inputs[0]) for lv in loop_vars],
-        by_name, stops)
+        by_name, stops, follow_anchors=True)
     for nm in cond_slice | body_slice:
         if by_name[nm].op in _ENTER:    # nested frame — defer
             return None
@@ -290,11 +328,17 @@ def _apply_while(plan, nodes, functions, by_name):
 
     def _fn_nodes(slice_set):
         picked = sorted(slice_set, key=node_order.get)
-        return _rewrite_slice([by_name[nm] for nm in picked], ref_map,
-                              expect_port)
+        rewritten = _rewrite_slice([by_name[nm] for nm in picked],
+                                   ref_map, expect_port)
+        # nested cond reconstruction inside the body: pivot anchors
+        # (control-only Switch/Identity chains) live OUTSIDE the data
+        # slice, so hand the full graph as a parity lookup
+        return _strip_control_deps(
+            _deframe_conds(rewritten, functions,
+                           pivot_lookup=by_name))
 
-    cond_fn_nodes = _deframe_conds(_fn_nodes(cond_slice), functions)
-    body_fn_nodes = _deframe_conds(_fn_nodes(body_slice), functions)
+    cond_fn_nodes = _fn_nodes(cond_slice)
+    body_fn_nodes = _fn_nodes(body_slice)
 
     cond_name = _fresh(f"__v1_{fname}_cond", functions)
     functions[cond_name] = FunctionDef(
@@ -353,35 +397,46 @@ def _apply_while(plan, nodes, functions, by_name):
     if anchor == len(nodes):
         out.append(while_node)
         out.extend(aliases)
-    return _check_no_dangling(out, removed)
+    return _check_no_dangling(out, removed, nodes)
 
 
-def _check_no_dangling(nodes, removed):
-    """Post-rewrite integrity pass.  Pivot residue — Switch nodes whose
-    pred was swallowed into a subgraph, and the Identity/Const anchors
-    hanging off them — cascades away; any OTHER node left with a
-    dangling data reference means the structure was not reducible."""
+def _check_no_dangling(nodes, removed, original):
+    """Post-rewrite integrity pass.  Two cleanups cascade to a
+    fixpoint: (a) pivot residue — Switch/Identity/Const chains with
+    dangling references into the swallowed structure; (b) DEAD nodes:
+    anything that HAD consumers in the original graph but lost every
+    one to the removal (e.g. a pred feeding only a pivot's control
+    anchors).  Original graph outputs were never consumed, so (b)
+    cannot touch them.  A node still dangling at the fixpoint means
+    the structure was not reducible."""
     out = list(nodes)
     live_ok = {n.name for n in out}
+    orig_consumed = {_node_of(r) for n in original for r in n.inputs}
     changed = True
     while changed:
         changed = False
+        consumed_now = {_node_of(r) for n in out for r in n.inputs}
         for n in list(out):
             dangling = [r for r in _data_inputs(n)
                         if _node_of(r) in removed
                         and _node_of(r) not in live_ok]
-            if not dangling:
-                continue
-            if n.op in _SWITCH or n.op in ("Identity", "Const"):
+            dead = (n.name in orig_consumed
+                    and n.name not in consumed_now
+                    and n.op != "Placeholder")   # feeds stay
+            cascadable = (n.op in _SWITCH
+                          or n.op in ("Identity", "Const"))
+            if (dangling and cascadable) or dead:
                 out.remove(n)
                 live_ok.discard(n.name)
                 removed.add(n.name)
                 changed = True
-            else:
-                raise _err(f"node '{n.name}' references "
-                           f"frame-internal '{_node_of(dangling[0])}' "
-                           f"from outside the frame")
     for n in out:
+        for r in _data_inputs(n):
+            nm = _node_of(r)
+            if nm in removed and nm not in live_ok:
+                raise _err(f"node '{n.name}' references "
+                           f"frame-internal '{nm}' from outside the "
+                           f"frame")
         n.inputs = [r for r in n.inputs
                     if not (r.startswith("^")
                             and _node_of(r) in removed
@@ -442,7 +497,8 @@ def _pivot_parity(slice_set: Set[str], root_ref: str, by_name
     return None, None
 
 
-def _plan_cond_merge(m: NodeDef, by_name) -> Optional[_CondMerge]:
+def _plan_cond_merge(m: NodeDef, by_name,
+                     pivot_lookup=None) -> Optional[_CondMerge]:
     """Classify one Merge's two inputs into true/false branches by the
     Switch ports their backward slices read.  Returns None if an inner
     Merge makes it not-yet-reducible."""
@@ -468,7 +524,8 @@ def _plan_cond_merge(m: NodeDef, by_name) -> Optional[_CondMerge]:
         if port is None:
             # constant-only branch: parity lives in the control deps
             # anchoring it to the pivot (switch_t/switch_f)
-            port, piv_pred = _pivot_parity(slice_set, ref, by_name)
+            port, piv_pred = _pivot_parity(
+                slice_set, ref, pivot_lookup or by_name)
             if pred_ref is None:
                 pred_ref = piv_pred
         if sw_names and pred_ref is None:
@@ -527,18 +584,25 @@ def _backslice_stop_switch(roots, by_name):
 
 
 def _deframe_conds(nodes: List[NodeDef],
-                   functions: Dict[str, FunctionDef]) -> List[NodeDef]:
+                   functions: Dict[str, FunctionDef],
+                   pivot_lookup: Optional[Dict[str, NodeDef]] = None
+                   ) -> List[NodeDef]:
     while True:
         by_name = {n.name: n for n in nodes}
+        # parity anchors of nested const-only conds may live outside
+        # this node list (while-body slices): consult the enclosing
+        # graph for pivot lookups only — never for slicing
+        lookup = ({**pivot_lookup, **by_name} if pivot_lookup
+                  else by_name)
         merges = [n for n in nodes if n.op in _MERGE]
         if not merges:
             return nodes
         plans: Dict[str, List[_CondMerge]] = {}
         for m in merges:
-            cm = _plan_cond_merge(m, by_name)
+            cm = _plan_cond_merge(m, by_name, lookup)
             if cm is None:
                 continue
-            pred = _resolve_identity(_node_of(cm.pred_ref), by_name)
+            pred = _resolve_identity(_node_of(cm.pred_ref), lookup)
             plans.setdefault(pred, []).append(cm)
         if not plans:
             raise _err(f"no reducible Switch/Merge diamond among "
@@ -591,8 +655,9 @@ def _apply_cond(group: List[_CondMerge], nodes, functions, by_name):
             slice_set |= cm.slices[port]
         picked = sorted(slice_set, key=node_order.get)
         expect = {nm: port for nm in switch_names}
-        fn_nodes = _rewrite_slice([by_name[nm] for nm in picked],
-                                  ref_map, expect)
+        fn_nodes = _strip_control_deps(
+            _rewrite_slice([by_name[nm] for nm in picked],
+                           ref_map, expect))
         ret = {}
         for i, cm in enumerate(group):
             ret[f"__out{i}"] = _guarded_rewrite(cm.branch_refs[port],
@@ -648,7 +713,7 @@ def _apply_cond(group: List[_CondMerge], nodes, functions, by_name):
         if n.name in removed:
             continue
         out.append(n)
-    return _check_no_dangling(out, removed)
+    return _check_no_dangling(out, removed, nodes)
 
 
 # -- final sweep -------------------------------------------------------------
