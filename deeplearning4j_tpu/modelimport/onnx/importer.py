@@ -143,7 +143,15 @@ class OnnxImporter:
                 tuple(shape), np.float32)
             self.placeholders.append(name)
 
-        for node in g.nodes:
+        self._import_nodes(g.nodes)
+
+        for out in g.outputs:
+            self.var(out)             # materialize if static
+        self.sd.outputs = list(g.outputs)
+        return self.sd
+
+    def _import_nodes(self, nodes):
+        for node in nodes:
             rule = ONNX_OP_MAP.get(node.op)
             if rule is None:
                 raise NotImplementedError(
@@ -163,10 +171,24 @@ class OnnxImporter:
                     if av is not None:
                         self.shapes[node.outputs[i]] = tuple(av.shape)
 
-        for out in g.outputs:
-            self.var(out)             # materialize if static
-        self.sd.outputs = list(g.outputs)
-        return self.sd
+    def subgraph_callable(self, g, arg_names):
+        """Wrap a control-flow subgraph (If/Loop body GraphProto) as a
+        callable for ``SameDiff.cond/while_loop`` tracing.  ONNX
+        subgraphs are LEXICALLY scoped: names not bound by arguments
+        or subgraph initializers resolve from THIS importer — the
+        child graph captures them (live op inputs)."""
+        parent = self
+
+        def fn(*args):
+            child_sd = (args[0].sd if args
+                        else getattr(fn, "_trace_child_sd",
+                                     parent.sd))
+            sub = _SubImporter(parent, g, child_sd,
+                               dict(zip(arg_names, args)))
+            sub._import_nodes(g.nodes)
+            return [sub.var(o) for o in g.outputs]
+
+        return fn
 
     def output(self, placeholders: dict, outputs=None):
         """Run the imported graph: {input_name: array} -> list of
@@ -175,6 +197,47 @@ class OnnxImporter:
         ph = {self.var_map[k].name: v for k, v in placeholders.items()}
         res = self.sd.output(ph, [self.var_map[o].name for o in outs])
         return [res[self.var_map[o].name] for o in outs]
+
+
+class _SubImporter(OnnxImporter):
+    """Importer for a control-flow subgraph: emits into the CHILD
+    SameDiff the cond/while tracer provides; unresolved names fall
+    back to the enclosing importer (lexical scoping)."""
+
+    def __init__(self, parent, g, child_sd, bound):
+        self.graph = g
+        self.input_shapes = {}
+        self.sd = child_sd
+        self.var_map = dict(bound)
+        self.statics = dict(parent.statics)
+        self.statics.update(g.initializers)
+        # seed shapes from the subgraph's declared ValueInfos so
+        # shape-dependent rules (Flatten/Slice/Conv) work inside
+        # bodies
+        self.shapes = {name: tuple(shape)
+                       for name, shape in g.inputs
+                       if shape is not None
+                       and all(d is not None and d >= 0
+                               for d in shape)}
+        self.avals = {}
+        self.placeholders = []
+        self._uniq = 0
+        self._parent = parent
+
+    def var(self, name: str):
+        try:
+            return super().var(name)
+        except KeyError:
+            # lexical capture from the enclosing graph: referencing
+            # the parent's var inside the child registers a live
+            # capture (samediff._import_foreign)
+            return self._parent.var(name)
+
+    def shape_of(self, name: str):
+        sh = super().shape_of(name)
+        if sh is None and name not in self.var_map:
+            sh = self._parent.shape_of(name)   # captured tensor
+        return sh
 
 
 def import_onnx(model, input_shapes: Optional[dict] = None) \

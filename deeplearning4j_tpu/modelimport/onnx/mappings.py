@@ -801,3 +801,82 @@ def _conv_transpose_onnx(ctx, node):
     if len(node.inputs) > 2 and node.inputs[2]:
         y = ctx.sd._op("add", [y, ctx.var(node.inputs[2])])
     return _nhwc_to_nchw(ctx, y)
+
+
+# -- control flow (SURVEY.md S7/S3: ONNX If/Loop map to the same lax
+# lowering the TF While/If path uses) ---------------------------------------
+@onnx_op("If")
+def _if_onnx(ctx, node):
+    then_g = node.attrs["then_branch"].value
+    else_g = node.attrs["else_branch"].value
+    pred = ctx.var(node.inputs[0])
+    outs = ctx.sd.cond(pred,
+                       ctx.subgraph_callable(then_g, []),
+                       ctx.subgraph_callable(else_g, []), [])
+    return outs if isinstance(outs, tuple) else (outs,)
+
+
+@onnx_op("Loop")
+def _loop_onnx(ctx, node):
+    """ONNX Loop: inputs (M?, cond?, v_initial...), body graph with
+    inputs (iter_num, cond_in, v_in...) and outputs (cond_out,
+    v_out..., scan_outputs...).  Lowers to SameDiff.while_loop over
+    loop vars (i, cond, *carried) — with a STATIC trip count M the
+    bounded, reverse-differentiable form.  Scan outputs (per-iteration
+    accumulation) are not yet lowered — loud."""
+    body = node.attrs["body"].value
+    m_name = node.inputs[0] if len(node.inputs) > 0 else ""
+    cond_name = node.inputs[1] if len(node.inputs) > 1 else ""
+    carried_names = [n for n in node.inputs[2:]]
+    n_carried = len(carried_names)
+    body_in_names = [n for n, _ in body.inputs]
+    if len(body.outputs) - 1 != n_carried:
+        raise NotImplementedError(
+            f"Loop '{node.name}': {len(body.outputs) - 1 - n_carried} "
+            f"scan output(s) not supported (carried deps only)")
+    if len(body_in_names) != 2 + n_carried:
+        raise NotImplementedError(
+            f"Loop '{node.name}': body declares {len(body_in_names)} "
+            f"inputs for 2 + {n_carried} loop-carried values")
+    m_static = ctx.static(m_name) if m_name else None
+    if m_static is not None:
+        m_static = int(np.asarray(m_static).reshape(())[()])
+    elif m_name:
+        # a runtime trip count can't bound the lowered loop — silence
+        # here would run a DIFFERENT trip count than the model says
+        raise NotImplementedError(
+            f"Loop '{node.name}': trip count '{m_name}' must be a "
+            f"constant/initializer (dynamic M unsupported)")
+    carried = [ctx.var(n) for n in carried_names]
+    i0 = ctx.sd.constant(ctx.unique("loop_i"), np.asarray(0, np.int32))
+    if cond_name:
+        cond0 = ctx.var(cond_name)
+    else:
+        cond0 = ctx.sd.constant(ctx.unique("loop_c"),
+                                np.asarray(True))
+    m_const = (None if m_static is None else
+               ctx.sd.constant(ctx.unique("loop_m"),
+                               np.asarray(m_static, np.int32)))
+
+    body_fn_inner = ctx.subgraph_callable(body, body_in_names)
+
+    def cond_fn(i, c, *vs):
+        csd = i.sd
+        keep = c
+        if m_const is not None:
+            keep = csd._op("logical_and",
+                           [keep, csd._op("lt", [i, m_const])])
+        return keep
+
+    def body_fn(i, c, *vs):
+        csd = i.sd
+        outs = body_fn_inner(i, c, *vs)
+        cond_out, v_outs = outs[0], outs[1:]
+        one = csd._as_var(np.asarray(1, np.int32))
+        return tuple([csd._op("add", [i, one]), cond_out]
+                     + list(v_outs))
+
+    outs = ctx.sd.while_loop(
+        [i0, cond0] + carried, cond_fn, body_fn,
+        max_iterations=m_static)
+    return tuple(outs[2:2 + n_carried])

@@ -76,8 +76,10 @@ def parse_attribute(buf: bytes) -> OnnxAttr:
     f = decode_fields(buf)
     name = f[1][0][1].decode() if 1 in f else ""
     # AttributeProto.type enum: 1=FLOAT 2=INT 3=STRING 4=TENSOR
-    # 6=FLOATS 7=INTS 8=STRINGS
+    # 5=GRAPH 6=FLOATS 7=INTS 8=STRINGS
     kind = int(f[20][0][1]) if 20 in f else 0
+    if 6 in f and kind in (0, 5):               # g: control-flow body
+        return OnnxAttr(name, 5, parse_graph(f[6][0][1]))
     if 2 in f and kind in (0, 1):
         raw = f[2][0][1]
         val = (struct.unpack("<f", raw)[0]
@@ -221,9 +223,40 @@ def encode_tensor(name: str, arr: np.ndarray) -> bytes:
     return bytes(out)
 
 
+class GraphAttr:
+    """Wrapper marking an attr value as an encoded subgraph (If/Loop
+    bodies) for :func:`encode_attr`."""
+
+    def __init__(self, graph_bytes: bytes):
+        self.graph_bytes = graph_bytes
+
+
+def encode_graph(nodes: Sequence[bytes],
+                 initializers: Dict[str, np.ndarray],
+                 inputs: Sequence[bytes],
+                 outputs: Sequence[bytes],
+                 graph_name: str = "sub") -> bytes:
+    """Bare GraphProto bytes (control-flow subgraph)."""
+    g = bytearray()
+    for n in nodes:
+        g += _len_field(1, n)
+    g += _len_field(2, graph_name.encode())
+    for name, arr in initializers.items():
+        g += _len_field(5, encode_tensor(name, arr))
+    for vi in inputs:
+        g += _len_field(11, vi)
+    for vi in outputs:
+        g += _len_field(12, vi)
+    return bytes(g)
+
+
 def encode_attr(name: str, value) -> bytes:
     out = bytearray()
     out += _len_field(1, name.encode())
+    if isinstance(value, GraphAttr):
+        out += _len_field(6, value.graph_bytes)
+        out += _int_field(20, 5)
+        return bytes(out)
     if isinstance(value, float):
         out += _tag(2, 5) + struct.pack("<f", value)
         out += _int_field(20, 1)

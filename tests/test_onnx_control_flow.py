@@ -1,0 +1,130 @@
+"""ONNX control-flow import (SURVEY.md S7/S3): If and Loop map to the
+same lax lowering the TF While/If path uses; subgraphs are LEXICALLY
+scoped (outer tensors captured live).  Fixtures hand-encoded with the
+in-repo encoder; ground truth is the spec semantics in numpy."""
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.modelimport.onnx import import_onnx
+from deeplearning4j_tpu.modelimport.onnx.protobuf import (
+    GraphAttr, encode_graph, encode_model, encode_node,
+    encode_value_info)
+
+R = np.random.RandomState(2)
+
+
+def _model(nodes, inits, in_specs, out_specs):
+    return encode_model(
+        nodes, inits,
+        [encode_value_info(n, s) for n, s in in_specs],
+        [encode_value_info(n, s) for n, s in out_specs])
+
+
+class TestIf:
+    def test_if_with_lexical_capture(self):
+        """Branches reference the OUTER tensor x and initializer z by
+        name (no subgraph inputs) — ONNX lexical scoping."""
+        then_g = encode_graph(
+            [encode_node("Mul", ["x", "z"], ["tout"], "m")],
+            {}, [], [encode_value_info("tout", (3,))])
+        else_g = encode_graph(
+            [encode_node("Sub", ["x", "z"], ["eout"], "s")],
+            {}, [], [encode_value_info("eout", (3,))])
+        nodes = [
+            encode_node("ReduceSum", ["x"], ["s"], "rs", keepdims=0),
+            encode_node("Greater", ["s", "thr"], ["p"], "gt"),
+            encode_node("If", ["p"], ["y"], "if",
+                        then_branch=GraphAttr(then_g),
+                        else_branch=GraphAttr(else_g)),
+        ]
+        inits = {"z": np.float32([2.0, 3.0, 4.0]),
+                 "thr": np.float32(0.0)}
+        m = _model(nodes, inits, [("x", (3,))], [("y", (3,))])
+        imp = import_onnx(m)
+        for xv in (np.float32([1, 2, 3]), np.float32([-1, -2, -3])):
+            got = np.asarray(imp.output({"x": xv})[0])
+            want = xv * inits["z"] if xv.sum() > 0 else xv - inits["z"]
+            np.testing.assert_allclose(got, want, rtol=1e-6)
+
+
+class TestLoop:
+    def _loop_model(self, m_val=None, with_cond_update=False):
+        # body: (i, c, v) -> (c_out, v*1.1 + x)
+        body_nodes = [
+            encode_node("Mul", ["v_in", "scale"], ["vs"], "m"),
+            encode_node("Add", ["vs", "x"], ["v_out"], "a"),
+        ]
+        if with_cond_update:
+            # keep while sum(v) < 40
+            body_nodes += [
+                encode_node("ReduceSum", ["v_out"], ["sv"], "rs",
+                            keepdims=0),
+                encode_node("Less", ["sv", "limit"], ["c_out"], "lt"),
+            ]
+        else:
+            body_nodes += [
+                encode_node("Identity", ["c_in"], ["c_out"], "ci"),
+            ]
+        body = encode_graph(
+            body_nodes, {"scale": np.float32(1.1)},
+            [encode_value_info("i", ()),
+             encode_value_info("c_in", ()),
+             encode_value_info("v_in", (2,))],
+            [encode_value_info("c_out", ()),
+             encode_value_info("v_out", (2,))])
+        inits = {"v0": np.float32([1.0, 2.0]),
+                 "limit": np.float32(40.0)}
+        loop_inputs = ["M", "cond0", "v0"]
+        if m_val is not None:
+            inits["M"] = np.asarray(m_val, np.int64)
+        inits["cond0"] = np.asarray(True)
+        nodes = [encode_node("Loop", loop_inputs, ["vf"], "loop",
+                             body=GraphAttr(body))]
+        return _model(nodes, inits, [("x", (2,))], [("vf", (2,))])
+
+    def test_static_trip_count(self):
+        imp = import_onnx(self._loop_model(m_val=4))
+        xv = np.float32([0.5, -0.25])
+        got = np.asarray(imp.output({"x": xv})[0])
+        v = np.float32([1.0, 2.0])
+        for _ in range(4):
+            v = v * np.float32(1.1) + xv
+        np.testing.assert_allclose(got, v, rtol=1e-5)
+
+    def test_dynamic_condition(self):
+        imp = import_onnx(self._loop_model(m_val=50,
+                                           with_cond_update=True))
+        xv = np.float32([1.0, 2.0])
+        got = np.asarray(imp.output({"x": xv})[0])
+        v = np.float32([1.0, 2.0])
+        # ONNX: iterate while cond (checked BEFORE each iteration)
+        cond = True
+        for _ in range(50):
+            if not cond:
+                break
+            v = v * np.float32(1.1) + xv
+            cond = v.sum() < 40.0
+        np.testing.assert_allclose(got, v, rtol=1e-5)
+
+    def test_scan_outputs_fail_loudly(self):
+        body = encode_graph(
+            [encode_node("Identity", ["c_in"], ["c_out"], "ci"),
+             encode_node("Add", ["v_in", "x"], ["v_out"], "a"),
+             encode_node("Identity", ["v_out"], ["scan0"], "sc")],
+            {},
+            [encode_value_info("i", ()),
+             encode_value_info("c_in", ()),
+             encode_value_info("v_in", (2,))],
+            [encode_value_info("c_out", ()),
+             encode_value_info("v_out", (2,)),
+             encode_value_info("scan0", (2,))])
+        inits = {"M": np.asarray(3, np.int64),
+                 "cond0": np.asarray(True),
+                 "v0": np.float32([0.0, 0.0])}
+        nodes = [encode_node("Loop", ["M", "cond0", "v0"],
+                             ["vf", "stack"], "loop",
+                             body=GraphAttr(body))]
+        m = _model(nodes, inits, [("x", (2,))],
+                   [("vf", (2,)), ("stack", (3, 2))])
+        with pytest.raises(NotImplementedError, match="scan"):
+            import_onnx(m)
