@@ -821,19 +821,22 @@ def _loop_onnx(ctx, node):
     """ONNX Loop: inputs (M?, cond?, v_initial...), body graph with
     inputs (iter_num, cond_in, v_in...) and outputs (cond_out,
     v_out..., scan_outputs...).  Lowers to SameDiff.while_loop over
-    loop vars (i, cond, *carried) — with a STATIC trip count M the
-    bounded, reverse-differentiable form.  Scan outputs (per-iteration
-    accumulation) are not yet lowered — loud."""
+    loop vars (i, cond, *carried, *scan_accumulators) — with a STATIC
+    trip count M the bounded, reverse-differentiable form.  Scan
+    outputs accumulate into dense [M, elem] tensors (the TensorArray
+    lowering); early-terminating conds leave tail rows zero (README
+    migration table).  Dynamic M raises loudly."""
     body = node.attrs["body"].value
     m_name = node.inputs[0] if len(node.inputs) > 0 else ""
     cond_name = node.inputs[1] if len(node.inputs) > 1 else ""
     carried_names = [n for n in node.inputs[2:]]
     n_carried = len(carried_names)
     body_in_names = [n for n, _ in body.inputs]
-    if len(body.outputs) - 1 != n_carried:
+    n_scan = len(body.outputs) - 1 - n_carried
+    if n_scan < 0:
         raise NotImplementedError(
-            f"Loop '{node.name}': {len(body.outputs) - 1 - n_carried} "
-            f"scan output(s) not supported (carried deps only)")
+            f"Loop '{node.name}': body declares fewer outputs than "
+            f"1 + {n_carried} carried values")
     if len(body_in_names) != 2 + n_carried:
         raise NotImplementedError(
             f"Loop '{node.name}': body declares {len(body_in_names)} "
@@ -847,6 +850,32 @@ def _loop_onnx(ctx, node):
         raise NotImplementedError(
             f"Loop '{node.name}': trip count '{m_name}' must be a "
             f"constant/initializer (dynamic M unsupported)")
+    scan_names = body.outputs[1 + n_carried:]
+    accs = []
+    if n_scan:
+        # scan outputs: dense [M, *elem] accumulators written per
+        # iteration (the TensorArray lowering).  Needs a static M and
+        # declared element shapes.  Documented divergence (README):
+        # an early-terminating cond leaves the tail rows ZERO —
+        # static shapes cannot express ONNX's [actual_trips, ...].
+        if m_static is None:
+            raise NotImplementedError(
+                f"Loop '{node.name}': scan outputs need a constant "
+                f"trip count M")
+        for sn in scan_names:
+            sh = body.output_shapes.get(sn)
+            if sh is None or any(d is None or d < 0 for d in sh):
+                raise NotImplementedError(
+                    f"Loop '{node.name}': scan output '{sn}' needs a "
+                    f"declared concrete shape in the body graph")
+            dt = body.output_dtypes.get(sn)
+            if dt is None:
+                raise NotImplementedError(
+                    f"Loop '{node.name}': scan output '{sn}' needs a "
+                    f"declared element dtype in the body graph")
+            accs.append(ctx.sd.constant(
+                ctx.unique(f"{node.name}_scan"),
+                np.zeros((m_static,) + tuple(sh), dt)))
     carried = [ctx.var(n) for n in carried_names]
     i0 = ctx.sd.constant(ctx.unique("loop_i"), np.asarray(0, np.int32))
     if cond_name:
@@ -870,13 +899,19 @@ def _loop_onnx(ctx, node):
 
     def body_fn(i, c, *vs):
         csd = i.sd
-        outs = body_fn_inner(i, c, *vs)
-        cond_out, v_outs = outs[0], outs[1:]
+        carried_in = vs[:n_carried]
+        acc_in = vs[n_carried:]
+        outs = body_fn_inner(i, c, *carried_in)
+        cond_out = outs[0]
+        v_outs = list(outs[1:1 + n_carried])
+        scan_vals = outs[1 + n_carried:]
+        acc_out = [csd._op("tensor_list_set_item", [a, i, sv])
+                   for a, sv in zip(acc_in, scan_vals)]
         one = csd._as_var(np.asarray(1, np.int32))
         return tuple([csd._op("add", [i, one]), cond_out]
-                     + list(v_outs))
+                     + v_outs + acc_out)
 
     outs = ctx.sd.while_loop(
-        [i0, cond0] + carried, cond_fn, body_fn,
+        [i0, cond0] + carried + accs, cond_fn, body_fn,
         max_iterations=m_static)
-    return tuple(outs[2:2 + n_carried])
+    return tuple(outs[2:2 + n_carried + n_scan])

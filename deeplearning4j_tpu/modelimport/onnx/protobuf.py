@@ -132,16 +132,20 @@ def parse_node(buf: bytes) -> OnnxNode:
 
 
 def parse_value_info(buf: bytes) -> Tuple[str,
-                                          Optional[Tuple[int, ...]]]:
-    """ValueInfoProto -> (name, shape or None). Dims with dim_param
-    (symbolic) become -1."""
+                                          Optional[Tuple[int, ...]],
+                                          Optional[type]]:
+    """ValueInfoProto -> (name, shape or None, numpy dtype or None).
+    Dims with dim_param (symbolic) become -1."""
     f = decode_fields(buf)
     name = f[1][0][1].decode() if 1 in f else ""
     shape = None
+    dtype = None
     if 2 in f:                                   # TypeProto
         t = decode_fields(f[2][0][1])
         if 1 in t:                               # tensor_type
             tt = decode_fields(t[1][0][1])
+            if 1 in tt:                          # elem_type
+                dtype = ONNX_DTYPES.get(int(tt[1][0][1]))
             if 2 in tt:                          # TensorShapeProto
                 sh = decode_fields(tt[2][0][1])
                 dims = []
@@ -152,15 +156,22 @@ def parse_value_info(buf: bytes) -> Tuple[str,
                     else:
                         dims.append(-1)
                 shape = tuple(dims)
-    return name, shape
+    return name, shape, dtype
 
 
 class OnnxGraph:
-    def __init__(self, nodes, initializers, inputs, outputs, name):
+    def __init__(self, nodes, initializers, inputs, outputs, name,
+                 output_shapes=None, output_dtypes=None):
         self.nodes: List[OnnxNode] = nodes
         self.initializers: Dict[str, np.ndarray] = initializers
         self.inputs: List[Tuple[str, Optional[tuple]]] = inputs
         self.outputs: List[str] = outputs
+        #: declared output shapes/dtypes (control-flow bodies: Loop
+        #: scan outputs need their element shape + dtype)
+        self.output_shapes: Dict[str, Optional[tuple]] = \
+            output_shapes or {}
+        self.output_dtypes: Dict[str, Optional[type]] = \
+            output_dtypes or {}
         self.name = name
 
 
@@ -171,10 +182,13 @@ def parse_graph(buf: bytes) -> OnnxGraph:
     for _, tbuf in f.get(5, []):
         t = parse_tensor(tbuf)
         inits[t.name] = t.array
-    inputs = [parse_value_info(e[1]) for e in f.get(11, [])]
-    outputs = [parse_value_info(e[1])[0] for e in f.get(12, [])]
+    inputs = [parse_value_info(e[1])[:2] for e in f.get(11, [])]
+    out_infos = [parse_value_info(e[1]) for e in f.get(12, [])]
     name = f[2][0][1].decode() if 2 in f else ""
-    return OnnxGraph(nodes, inits, inputs, outputs, name)
+    return OnnxGraph(
+        nodes, inits, inputs, [n for n, _, _ in out_infos], name,
+        output_shapes={n: sh for n, sh, _ in out_infos},
+        output_dtypes={n: dt for n, _, dt in out_infos})
 
 
 def parse_model(buf: bytes) -> OnnxGraph:
@@ -314,18 +328,9 @@ def encode_model(nodes: Sequence[bytes],
                  inputs: Sequence[bytes],
                  outputs: Sequence[bytes],
                  graph_name: str = "graph") -> bytes:
-    g = bytearray()
-    for n in nodes:
-        g += _len_field(1, n)
-    g += _len_field(2, graph_name.encode())
-    for name, arr in initializers.items():
-        g += _len_field(5, encode_tensor(name, arr))
-    for vi in inputs:
-        g += _len_field(11, vi)
-    for vi in outputs:
-        g += _len_field(12, vi)
     model = _int_field(1, 8)                      # ir_version
-    model += _len_field(7, bytes(g))
+    model += _len_field(7, encode_graph(nodes, initializers, inputs,
+                                        outputs, graph_name))
     # opset_import: domain "" version 13
     model += _len_field(8, _len_field(1, b"") + _int_field(2, 13))
     return bytes(model)

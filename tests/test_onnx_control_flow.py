@@ -106,7 +106,7 @@ class TestLoop:
             cond = v.sum() < 40.0
         np.testing.assert_allclose(got, v, rtol=1e-5)
 
-    def test_scan_outputs_fail_loudly(self):
+    def _scan_model(self):
         body = encode_graph(
             [encode_node("Identity", ["c_in"], ["c_out"], "ci"),
              encode_node("Add", ["v_in", "x"], ["v_out"], "a"),
@@ -124,7 +124,15 @@ class TestLoop:
         nodes = [encode_node("Loop", ["M", "cond0", "v0"],
                              ["vf", "stack"], "loop",
                              body=GraphAttr(body))]
-        m = _model(nodes, inits, [("x", (2,))],
-                   [("vf", (2,)), ("stack", (3, 2))])
-        with pytest.raises(NotImplementedError, match="scan"):
-            import_onnx(m)
+        return _model(nodes, inits, [("x", (2,))],
+                      [("vf", (2,)), ("stack", (3, 2))])
+
+    def test_scan_outputs_stack_per_iteration(self):
+        """Scan outputs accumulate into a dense [M, elem] tensor (the
+        TensorArray lowering): vf = 3x, stack = [x, 2x, 3x]."""
+        imp = import_onnx(self._scan_model())
+        xv = np.float32([1.5, -0.5])
+        vf, stack = (np.asarray(a) for a in imp.output({"x": xv}))
+        np.testing.assert_allclose(vf, 3 * xv, rtol=1e-6)
+        np.testing.assert_allclose(
+            stack, np.stack([xv, 2 * xv, 3 * xv]), rtol=1e-6)
