@@ -844,6 +844,10 @@ def _loop_onnx(ctx, node):
     m_static = ctx.static(m_name) if m_name else None
     if m_static is not None:
         m_static = int(np.asarray(m_static).reshape(())[()])
+        if m_static >= 2 ** 31 - 1:
+            # torch exports while-style loops as M=INT64_MAX plus a
+            # real cond: effectively unbounded
+            m_static = None
     elif m_name:
         # a runtime trip count can't bound the lowered loop — silence
         # here would run a DIFFERENT trip count than the model says
@@ -860,8 +864,9 @@ def _loop_onnx(ctx, node):
         # static shapes cannot express ONNX's [actual_trips, ...].
         if m_static is None:
             raise NotImplementedError(
-                f"Loop '{node.name}': scan outputs need a constant "
-                f"trip count M")
+                f"Loop '{node.name}': scan outputs need a FINITE "
+                f"constant trip count M (unbounded/while-style loops "
+                f"cannot preallocate the stacked result)")
         for sn in scan_names:
             sh = body.output_shapes.get(sn)
             if sh is None or any(d is None or d < 0 for d in sh):
@@ -869,6 +874,10 @@ def _loop_onnx(ctx, node):
                     f"Loop '{node.name}': scan output '{sn}' needs a "
                     f"declared concrete shape in the body graph")
             dt = body.output_dtypes.get(sn)
+            if isinstance(dt, int):
+                raise NotImplementedError(
+                    f"Loop '{node.name}': scan output '{sn}' has "
+                    f"unsupported ONNX element dtype enum {dt}")
             if dt is None:
                 raise NotImplementedError(
                     f"Loop '{node.name}': scan output '{sn}' needs a "

@@ -145,7 +145,10 @@ def parse_value_info(buf: bytes) -> Tuple[str,
         if 1 in t:                               # tensor_type
             tt = decode_fields(t[1][0][1])
             if 1 in tt:                          # elem_type
-                dtype = ONNX_DTYPES.get(int(tt[1][0][1]))
+                enum = int(tt[1][0][1])
+                # unmapped enums keep the raw int so consumers can
+                # say "unsupported dtype N" instead of "missing"
+                dtype = ONNX_DTYPES.get(enum, enum)
             if 2 in tt:                          # TensorShapeProto
                 sh = decode_fields(tt[2][0][1])
                 dims = []

@@ -1,0 +1,33 @@
+"""Vectorized A3C on CartPole (reference example: the rl4j-examples
+`A3CCartpole`). The reference races async JVM worker threads; here N
+parallel environments advance in lockstep INSIDE the compiled update
+program — rollout, returns, and the gradient step are one jitted XLA
+program (see rl/vectorized.py)."""
+import os
+import sys
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+import numpy as np
+
+
+def main():
+    from deeplearning4j_tpu.rl import (A3CVectorized,
+                                       A3CVectorizedConfiguration,
+                                       VectorCartPole)
+
+    env = VectorCartPole(n_envs=16, max_steps=200)
+    agent = A3CVectorized(env, A3CVectorizedConfiguration(seed=7))
+    for round_i in range(8):
+        finished = agent.train(200)
+        score = agent.evaluate(n_episodes=5)
+        recent = np.mean(finished[-20:]) if finished else 0.0
+        print(f"round {round_i + 1}: {len(finished)} episodes, "
+              f"train mean(last 20) {recent:6.1f}, "
+              f"greedy eval {score:6.1f}")
+        if score >= 195.0:
+            print("solved (>= 195/200)")
+            break
+
+
+if __name__ == "__main__":
+    main()

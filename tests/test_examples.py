@@ -19,9 +19,15 @@ def _load(name):
 
 @pytest.mark.parametrize("name", ["lenet_mnist", "char_rnn",
                                   "transfer_learning", "data_parallel",
-                                  "custom_layer_samediff"])
+                                  "custom_layer_samediff",
+                                  "tf_frozen_import", "a3c_cartpole"])
 def test_importable(name):
     assert _load(name).main is not None
+
+
+def test_tf_frozen_import_example_runs():
+    pytest.importorskip("tensorflow")
+    _load("tf_frozen_import").main()   # asserts parity internally
 
 
 def test_custom_layer_example_runs():
