@@ -94,6 +94,21 @@ def _map_convlstm2d(cfg, bag):
     return [Emit(layer=layer, params=params)]
 
 
+def _scale_center_params(cfg, bag):
+    """Shared gamma/beta extraction for the norm-layer family (keras
+    weight order: gamma first when present, then beta)."""
+    scale = bool(cfg.get("scale", True))
+    center = bool(cfg.get("center", True))
+    params = {}
+    i = 0
+    if scale:
+        params["gamma"] = bag.get(i, "gamma")
+        i += 1
+    if center:
+        params["beta"] = bag.get(i, "beta")
+    return scale, center, params
+
+
 @keras_layer("LayerNormalization")
 def _map_layer_norm(cfg, bag):
     axis = cfg.get("axis", -1)
@@ -105,17 +120,9 @@ def _map_layer_norm(cfg, bag):
         raise InvalidKerasConfigurationException(
             f"LayerNormalization axis={axis} unsupported (axis=-1 "
             f"only — channels are the TPU lane dim)")
-    scale = bool(cfg.get("scale", True))
-    center = bool(cfg.get("center", True))
+    scale, center, params = _scale_center_params(cfg, bag)
     layer = LayerNormalization(eps=float(cfg.get("epsilon", 1e-3)),
                                scale=scale, center=center)
-    params = {}
-    i = 0
-    if scale:
-        params["gamma"] = bag.get(i, "gamma")
-        i += 1
-    if center:
-        params["beta"] = bag.get(i, "beta")
     return [Emit(layer=layer, params=params)]
 
 
@@ -226,3 +233,19 @@ def _map_global_pool_3d(cfg, bag):
     kind = (PoolingType.MAX if "Max" in cfg["__class__"]
             else PoolingType.AVG)
     return [Emit(layer=GlobalPoolingLayer(pooling_type=kind))]
+
+
+@keras_layer("GroupNormalization")
+def _map_group_norm(cfg, bag):
+    from deeplearning4j_tpu.nn.conf.layers_misc import \
+        GroupNormalization
+    axis = cfg.get("axis", -1)
+    if axis != -1:
+        raise InvalidKerasConfigurationException(
+            f"GroupNormalization axis={axis} unsupported (channels "
+            f"last only)")
+    scale, center, params = _scale_center_params(cfg, bag)
+    layer = GroupNormalization(groups=int(cfg.get("groups", 32)),
+                               eps=float(cfg.get("epsilon", 1e-3)),
+                               scale=scale, center=center)
+    return [Emit(layer=layer, params=params)]

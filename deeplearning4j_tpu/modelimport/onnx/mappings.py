@@ -845,6 +845,12 @@ def _loop_onnx(ctx, node):
     if m_static is not None:
         m_static = int(np.asarray(m_static).reshape(())[()])
         if m_static >= 2 ** 31 - 1:
+            if not cond_name:
+                # no cond to ever stop it: lowering would hang, not
+                # run a quintillion-trip for-loop
+                raise NotImplementedError(
+                    f"Loop '{node.name}': trip count {m_static} "
+                    f"with no cond input cannot lower")
             # torch exports while-style loops as M=INT64_MAX plus a
             # real cond: effectively unbounded
             m_static = None

@@ -355,3 +355,62 @@ class UnitNormLayer(Layer):
 
     def get_output_type(self, input_type):
         return input_type
+
+
+@register_layer
+@dataclass
+class GroupNormalization(Layer):
+    """Group normalization (Wu & He 2018; the Keras
+    ``GroupNormalization`` import target): channels split into
+    ``groups``, normalized over (group, spatial) with per-channel
+    gain/bias.  ``groups=-1`` is instance norm (one group per
+    channel); ``groups=1`` is layer norm over all channels+spatial."""
+
+    groups: int = 32
+    eps: float = 1e-3
+    scale: bool = True
+    center: bool = True
+
+    def set_n_in(self, input_type, override):
+        nf = getattr(input_type, "channels", None)
+        if nf is None:
+            nf = input_type.size
+        if override or not self.n_in:
+            self.n_in = nf
+        self.n_out = self.n_in
+
+    def has_params(self) -> bool:
+        return self.scale or self.center
+
+    def init_params(self, key, input_type, dtype=jnp.float32):
+        p = {}
+        if self.scale:
+            p["gamma"] = jnp.ones((self.n_in,), dtype)
+        if self.center:
+            p["beta"] = jnp.zeros((self.n_in,), dtype)
+        return p
+
+    def forward(self, params, x, *, training, rng=None, state=None,
+                mask=None):
+        x = self._maybe_dropout(x, training, rng)
+        c = x.shape[-1]
+        g = c if self.groups == -1 else self.groups
+        if c % g:
+            raise ValueError(f"channels {c} not divisible by "
+                             f"groups {g}")
+        acc = jnp.promote_types(x.dtype, jnp.float32)
+        xf = x.astype(acc).reshape(x.shape[:-1] + (g, c // g))
+        # normalize over (spatial..., channels-in-group) per example
+        axes = tuple(range(1, x.ndim - 1)) + (x.ndim,)
+        mu = jnp.mean(xf, axis=axes, keepdims=True)
+        var = jnp.var(xf, axis=axes, keepdims=True)
+        y = ((xf - mu) * jax.lax.rsqrt(var + self.eps)) \
+            .reshape(x.shape)
+        if self.scale:
+            y = y * params["gamma"].astype(acc)
+        if self.center:
+            y = y + params["beta"].astype(acc)
+        return self.activation(y.astype(x.dtype)), state
+
+    def get_output_type(self, input_type):
+        return input_type

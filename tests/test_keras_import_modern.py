@@ -131,6 +131,22 @@ class TestMultiHeadAttention:
         _compare_functional(model, x, tmp_path)
 
 
+class TestGroupNormalization:
+    @pytest.mark.parametrize("groups", [2, 1, -1])
+    def test_conv_group_norm(self, tmp_path, groups):
+        model = keras.Sequential([
+            keras.layers.Input((6, 6, 8)),
+            keras.layers.Conv2D(8, 3, padding="same"),
+            keras.layers.GroupNormalization(groups=groups),
+            keras.layers.ReLU(),
+        ])
+        model.layers[1].set_weights([
+            (1.0 + 0.2 * R.randn(8)).astype(np.float32),
+            (0.1 * R.randn(8)).astype(np.float32)])
+        x = R.randn(2, 6, 6, 8).astype(np.float32)
+        _compare_sequential(model, x, tmp_path, atol=3e-4)
+
+
 class TestUnitNormalization:
     def test_unit_norm(self, tmp_path):
         model = keras.Sequential([
