@@ -249,3 +249,46 @@ def _map_group_norm(cfg, bag):
                                eps=float(cfg.get("epsilon", 1e-3)),
                                scale=scale, center=center)
     return [Emit(layer=layer, params=params)]
+
+
+# -- preprocessing layers (common heads of exported vision models) ----------
+@keras_layer("Rescaling")
+def _map_rescaling(cfg, bag):
+    from deeplearning4j_tpu.nn.conf.layers_misc import ScaleOffsetLayer
+
+    def coef(v, dflt):
+        if v is None:
+            return dflt
+        if isinstance(v, (int, float)):
+            return float(v)
+        return [float(e) for e in np.asarray(v).reshape(-1)]
+
+    return [Emit(layer=ScaleOffsetLayer(
+        scale=coef(cfg.get("scale"), 1.0),
+        offset=coef(cfg.get("offset"), 0.0)))]
+
+
+@keras_layer("Resizing")
+def _map_resizing(cfg, bag):
+    interp = cfg.get("interpolation", "bilinear")
+    if interp not in ("bilinear", "nearest"):
+        raise InvalidKerasConfigurationException(
+            f"Resizing interpolation={interp} unsupported")
+    if cfg.get("crop_to_aspect_ratio") or cfg.get(
+            "pad_to_aspect_ratio"):
+        raise InvalidKerasConfigurationException(
+            "Resizing with aspect-ratio crop/pad unsupported")
+    from deeplearning4j_tpu.nn.conf.layers_misc import ResizingLayer
+    return [Emit(layer=ResizingLayer(
+        height=int(cfg["height"]), width=int(cfg["width"]),
+        interpolation=interp))]
+
+
+@keras_layer("RandomFlip", "RandomRotation", "RandomZoom",
+             "RandomTranslation", "RandomContrast", "RandomBrightness")
+def _map_random_augment(cfg, bag):
+    # shape-preserving augmentation layers are inference no-ops
+    # (keras applies them only under training=True).  RandomCrop is
+    # NOT here: it center-crops at inference, changing shapes —
+    # unmapped, so it fails loudly.
+    return [Emit(skip=True)]

@@ -414,3 +414,77 @@ class GroupNormalization(Layer):
 
     def get_output_type(self, input_type):
         return input_type
+
+
+@register_layer
+@dataclass
+class ScaleOffsetLayer(Layer):
+    """y = x * scale + offset (the Keras ``Rescaling`` import target;
+    e.g. 1/255 pixel normalization baked into exported models).
+    ``scale``/``offset`` may be scalars or broadcastable lists
+    (per-channel normalization)."""
+
+    scale: object = 1.0
+    offset: object = 0.0
+
+    def has_params(self) -> bool:
+        return False
+
+    def set_n_in(self, input_type, override):
+        pass
+
+    def _coef(self, v, x):
+        # floats stay WEAKLY typed (python scalar / f32 list): integer
+        # pixel inputs promote to float instead of collapsing to
+        # jnp.asarray(1/255, uint8) == 0
+        if isinstance(v, (int, float)):
+            return v
+        return jnp.asarray(v, jnp.float32)
+
+    def forward(self, params, x, *, training, rng=None, state=None,
+                mask=None):
+        return x * self._coef(self.scale, x) \
+            + self._coef(self.offset, x), state
+
+    def get_output_type(self, input_type):
+        return input_type
+
+
+@register_layer
+@dataclass
+class ResizingLayer(Layer):
+    """Spatial resize on [b, h, w, c] (the Keras ``Resizing`` import
+    target)."""
+
+    height: int = 224
+    width: int = 224
+    interpolation: str = "bilinear"
+
+    def __post_init__(self):
+        super().__post_init__()
+        if self.interpolation not in ("bilinear", "nearest"):
+            raise ValueError(
+                f"ResizingLayer interpolation="
+                f"'{self.interpolation}' unsupported "
+                f"(bilinear|nearest)")
+
+    def has_params(self) -> bool:
+        return False
+
+    def set_n_in(self, input_type, override):
+        pass
+
+    def forward(self, params, x, *, training, rng=None, state=None,
+                mask=None):
+        method = ("nearest" if self.interpolation == "nearest"
+                  else "bilinear")
+        # antialias=False matches tf.image.resize's default (keras
+        # Resizing semantics); jax antialiases minification by default
+        return jax.image.resize(
+            x, (x.shape[0], self.height, self.width, x.shape[3]),
+            method, antialias=False), state
+
+    def get_output_type(self, input_type):
+        assert isinstance(input_type, InputTypeConvolutional)
+        return InputType.convolutional(self.height, self.width,
+                                       input_type.channels)

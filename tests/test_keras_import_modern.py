@@ -268,9 +268,49 @@ class TestCustomLayerSeam:
                 path)
 
 
+class TestPreprocessingLayers:
+    def test_rescale_resize_augment_head(self, tmp_path):
+        """The common exported-vision-model head: Resizing → Rescaling
+        → augmentation (inference no-ops) → conv."""
+        model = keras.Sequential([
+            keras.layers.Input((10, 12, 3)),
+            keras.layers.Resizing(8, 8),
+            keras.layers.Rescaling(1.0 / 255, offset=-0.5),
+            keras.layers.RandomFlip(),
+            keras.layers.RandomRotation(0.2),
+            keras.layers.Conv2D(4, 3, padding="same"),
+        ])
+        x = (R.rand(2, 10, 12, 3) * 255).astype(np.float32)
+        _compare_sequential(model, x, tmp_path, atol=3e-4)
+
+    def test_per_channel_rescaling(self, tmp_path):
+        """Array scale/offset (per-channel ImageNet-style norm) and
+        integer pixel inputs promoting to float."""
+        model = keras.Sequential([
+            keras.layers.Input((4, 4, 3)),
+            keras.layers.Rescaling(
+                scale=[1 / 0.229, 1 / 0.224, 1 / 0.225],
+                offset=[-0.1, 0.2, 0.0]),
+        ])
+        x = R.rand(2, 4, 4, 3).astype(np.float32)
+        net = _compare_sequential(model, x, tmp_path)
+        # uint8 pixels must not collapse to zero (weak typing)
+        xi = (x * 255).astype(np.uint8)
+        out = np.asarray(net.output(xi))
+        assert np.abs(out).max() > 1.0
+
+    def test_nearest_resizing(self, tmp_path):
+        model = keras.Sequential([
+            keras.layers.Input((6, 6, 2)),
+            keras.layers.Resizing(12, 9, interpolation="nearest"),
+        ])
+        x = R.rand(2, 6, 6, 2).astype(np.float32)
+        _compare_sequential(model, x, tmp_path)
+
+
 def test_mapper_count_floor():
     """Registry breadth ratchet (reference has ~60 KerasLayer
     subclasses; SURVEY.md D14)."""
     from deeplearning4j_tpu.modelimport.keras.importer import \
         KERAS_LAYER_MAP
-    assert len(KERAS_LAYER_MAP) >= 60, sorted(KERAS_LAYER_MAP)
+    assert len(KERAS_LAYER_MAP) >= 70, sorted(KERAS_LAYER_MAP)
