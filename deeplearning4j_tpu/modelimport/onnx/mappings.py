@@ -805,6 +805,29 @@ def _conv_transpose_onnx(ctx, node):
 
 # -- control flow (SURVEY.md S7/S3: ONNX If/Loop map to the same lax
 # lowering the TF While/If path uses) ---------------------------------------
+def _scan_accumulators(ctx, node, body, scan_names, m):
+    """Dense [m, *elem] zero accumulators for Loop/Scan scan outputs;
+    shape and dtype must be declared in the body graph."""
+    accs = []
+    for sn in scan_names:
+        sh = body.output_shapes.get(sn)
+        if sh is None or any(d is None or d < 0 for d in sh):
+            raise NotImplementedError(
+                f"{node.op} '{node.name}': scan output '{sn}' needs "
+                f"a declared concrete shape in the body graph")
+        dt = body.output_dtypes.get(sn)
+        if isinstance(dt, int):
+            raise NotImplementedError(
+                f"{node.op} '{node.name}': scan output '{sn}' has "
+                f"unsupported ONNX element dtype enum {dt}")
+        if dt is None:
+            raise NotImplementedError(
+                f"{node.op} '{node.name}': scan output '{sn}' needs "
+                f"a declared element dtype in the body graph")
+        accs.append(ctx.sd.constant(
+            ctx.unique(f"{node.name}_scan"),
+            np.zeros((m,) + tuple(sh), dt)))
+    return accs
 @onnx_op("If")
 def _if_onnx(ctx, node):
     then_g = node.attrs["then_branch"].value
@@ -873,24 +896,8 @@ def _loop_onnx(ctx, node):
                 f"Loop '{node.name}': scan outputs need a FINITE "
                 f"constant trip count M (unbounded/while-style loops "
                 f"cannot preallocate the stacked result)")
-        for sn in scan_names:
-            sh = body.output_shapes.get(sn)
-            if sh is None or any(d is None or d < 0 for d in sh):
-                raise NotImplementedError(
-                    f"Loop '{node.name}': scan output '{sn}' needs a "
-                    f"declared concrete shape in the body graph")
-            dt = body.output_dtypes.get(sn)
-            if isinstance(dt, int):
-                raise NotImplementedError(
-                    f"Loop '{node.name}': scan output '{sn}' has "
-                    f"unsupported ONNX element dtype enum {dt}")
-            if dt is None:
-                raise NotImplementedError(
-                    f"Loop '{node.name}': scan output '{sn}' needs a "
-                    f"declared element dtype in the body graph")
-            accs.append(ctx.sd.constant(
-                ctx.unique(f"{node.name}_scan"),
-                np.zeros((m_static,) + tuple(sh), dt)))
+        accs = _scan_accumulators(ctx, node, body, scan_names,
+                                  m_static)
     carried = [ctx.var(n) for n in carried_names]
     i0 = ctx.sd.constant(ctx.unique("loop_i"), np.asarray(0, np.int32))
     if cond_name:
@@ -930,3 +937,83 @@ def _loop_onnx(ctx, node):
         [i0, cond0] + carried + accs, cond_fn, body_fn,
         max_iterations=m_static)
     return tuple(outs[2:2 + n_carried + n_scan])
+
+
+@onnx_op("Scan")
+def _scan_onnx(ctx, node):
+    """ONNX Scan (opset 9+ form, no sequence_lens): inputs = N state
+    initials then M scan inputs sliced along axis 0 per iteration;
+    body(state..., slices...) -> (new_state..., scan_outputs...).
+    The trip count is the scan inputs' leading dim (static), so the
+    lowering is the bounded differentiable while: slices read with a
+    dynamic index, scan outputs accumulate densely."""
+    body = node.attrs["body"].value
+    if node.attr("num_scan_inputs") is None:
+        raise NotImplementedError(
+            f"Scan '{node.name}': required attribute "
+            f"num_scan_inputs is missing")
+    n_scan_in = int(node.attr("num_scan_inputs"))
+    n_state = len(node.inputs) - n_scan_in
+    if n_state < 0:
+        raise NotImplementedError(
+            f"Scan '{node.name}': num_scan_inputs "
+            f"{n_scan_in} > {len(node.inputs)} inputs")
+    if len(body.outputs) < n_state:
+        raise NotImplementedError(
+            f"Scan '{node.name}': body declares {len(body.outputs)} "
+            f"outputs for {n_state} states")
+    for a in ("scan_input_axes", "scan_input_directions",
+              "scan_output_axes", "scan_output_directions"):
+        v = node.attr(a)
+        if v is not None and any(int(e) for e in v):
+            raise NotImplementedError(
+                f"Scan '{node.name}': non-default {a} unsupported")
+    body_in_names = [n for n, _ in body.inputs]
+    if len(body_in_names) != n_state + n_scan_in:
+        raise NotImplementedError(
+            f"Scan '{node.name}': body declares "
+            f"{len(body_in_names)} inputs for {n_state} states + "
+            f"{n_scan_in} scan inputs")
+    states = [ctx.var(n) for n in node.inputs[:n_state]]
+    scan_ins = [ctx.var(n) for n in node.inputs[n_state:]]
+    lengths = {ctx.shape_of(n)[0] if ctx.shape_of(n) else None
+               for n in node.inputs[n_state:]}
+    if len(lengths) != 1 or None in lengths:
+        # an UNKNOWN length must fail too: a shorter actual input
+        # would silently re-read its last row for the tail iterations
+        raise NotImplementedError(
+            f"Scan '{node.name}': every scan-input length must be "
+            f"static and uniform (got "
+            f"{sorted(lengths, key=str)})")
+    m = int(lengths.pop())
+    n_scan_out = len(body.outputs) - n_state
+    scan_out_names = body.outputs[n_state:]
+    accs = _scan_accumulators(ctx, node, body, scan_out_names, m)
+    i0 = ctx.sd.constant(ctx.unique("scan_i"), np.asarray(0, np.int32))
+    body_fn_inner = ctx.subgraph_callable(body, body_in_names)
+
+    def cond_fn(i, *vs):
+        return i.sd._op("lt", [i, i.sd._as_var(
+            np.asarray(m, np.int32))])
+
+    def body_fn(i, *vs):
+        csd = i.sd
+        st = vs[:n_state]
+        sc = vs[n_state:n_state + n_scan_in]
+        acc = vs[n_state + n_scan_in:]
+        slices = [csd._op("tensor_list_get_item", [s, i]) for s in sc]
+        outs = body_fn_inner(*(list(st) + slices))
+        new_st = list(outs[:n_state])
+        scan_vals = outs[n_state:]
+        new_acc = [csd._op("tensor_list_set_item", [a, i, sv])
+                   for a, sv in zip(acc, scan_vals)]
+        one = csd._as_var(np.asarray(1, np.int32))
+        return tuple([csd._op("add", [i, one])] + new_st
+                     + list(sc) + new_acc)
+
+    outs = ctx.sd.while_loop(
+        [i0] + states + scan_ins + accs, cond_fn, body_fn,
+        max_iterations=m)
+    final_states = outs[1:1 + n_state]
+    final_accs = outs[1 + n_state + n_scan_in:]
+    return tuple(list(final_states) + list(final_accs[:n_scan_out]))

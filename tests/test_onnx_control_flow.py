@@ -127,6 +127,30 @@ class TestLoop:
         return _model(nodes, inits, [("x", (2,))],
                       [("vf", (2,)), ("stack", (3, 2))])
 
+    def test_onnx_scan_op(self):
+        """ONNX Scan: cumulative sum over the leading axis — one
+        state, one scan input, one scan output."""
+        body = encode_graph(
+            [encode_node("Add", ["s_in", "x_t"], ["s_out"], "a"),
+             encode_node("Identity", ["s_out"], ["y_t"], "i")],
+            {},
+            [encode_value_info("s_in", (2,)),
+             encode_value_info("x_t", (2,))],
+            [encode_value_info("s_out", (2,)),
+             encode_value_info("y_t", (2,))])
+        inits = {"s0": np.float32([0.0, 10.0])}
+        nodes = [encode_node("Scan", ["s0", "xs"], ["sf", "ys"],
+                             "scan", body=GraphAttr(body),
+                             num_scan_inputs=1)]
+        m = _model(nodes, inits, [("xs", (4, 2))],
+                   [("sf", (2,)), ("ys", (4, 2))])
+        imp = import_onnx(m)
+        xs = R.randn(4, 2).astype(np.float32)
+        sf, ys = (np.asarray(a) for a in imp.output({"xs": xs}))
+        want = np.cumsum(xs, axis=0) + np.float32([0.0, 10.0])
+        np.testing.assert_allclose(ys, want, rtol=1e-5)
+        np.testing.assert_allclose(sf, want[-1], rtol=1e-5)
+
     def test_scan_outputs_stack_per_iteration(self):
         """Scan outputs accumulate into a dense [M, elem] tensor (the
         TensorArray lowering): vf = 3x, stack = [x, 2x, 3x]."""
