@@ -1017,3 +1017,105 @@ def _scan_onnx(ctx, node):
     final_states = outs[1:1 + n_state]
     final_accs = outs[1 + n_state + n_scan_in:]
     return tuple(list(final_states) + list(final_accs[:n_scan_out]))
+
+
+@onnx_op("LSTM")
+def _lstm_onnx(ctx, node):
+    """ONNX LSTM (what torch exports nn.LSTM to): X [seq, b, in]
+    (layout=0), W [dirs, 4H, in] / R [dirs, 4H, H] in gate order
+    (i, o, f, c), B [dirs, 8H] = Wb ++ Rb.  Lowers onto the scan-based
+    ``lstm_layer`` op (gate order [i, f, o, g]): weights reorder and
+    transpose statically; the reverse direction flips time around the
+    scan.  Outputs Y [seq, dirs, b, H], Y_h / Y_c [dirs, b, H]."""
+    if int(node.attr("layout", 0)) != 0:
+        raise NotImplementedError(
+            f"LSTM '{node.name}': layout=1 (batch-major) unsupported")
+    if node.attr("activations") is not None:
+        raise NotImplementedError(
+            f"LSTM '{node.name}': custom activations unsupported")
+    if len(node.inputs) > 4 and node.inputs[4]:
+        raise NotImplementedError(
+            f"LSTM '{node.name}': sequence_lens unsupported")
+    if len(node.inputs) > 7 and node.inputs[7]:
+        raise NotImplementedError(
+            f"LSTM '{node.name}': peephole weights (P) unsupported")
+    if node.attr("clip") is not None:
+        raise NotImplementedError(
+            f"LSTM '{node.name}': clip unsupported")
+    if node.attr("input_forget"):
+        raise NotImplementedError(
+            f"LSTM '{node.name}': input_forget (coupled gates) "
+            f"unsupported")
+    direction = node.attr("direction", b"forward")
+    direction = (direction.decode()
+                 if isinstance(direction, bytes) else direction)
+    dirs = 2 if direction == "bidirectional" else 1
+    H = int(node.attr("hidden_size"))
+    w_np = np.asarray(ctx.require_static(node, 1))   # [dirs, 4H, in]
+    r_np = np.asarray(ctx.require_static(node, 2))   # [dirs, 4H, H]
+    b_np = (np.asarray(ctx.require_static(node, 3))
+            if len(node.inputs) > 3 and node.inputs[3]
+            else np.zeros((dirs, 8 * H), np.float32))
+
+    def reorder(m):
+        # rows (i, o, f, c) -> (i, f, o, g)
+        blocks = [m[0:H], m[2 * H:3 * H], m[H:2 * H], m[3 * H:]]
+        return np.concatenate(blocks, axis=0)
+
+    x = ctx.var(node.inputs[0])
+    xb = ctx.sd._op("transpose", [x], {"axes": (1, 0, 2)})  # [b,t,in]
+    in_shape = ctx.shape_of(node.inputs[0])
+    if in_shape is None:
+        raise NotImplementedError(
+            f"LSTM '{node.name}': input shape must be known")
+    b = int(in_shape[1])
+
+    def initial(idx, tag):
+        if len(node.inputs) > idx and node.inputs[idx]:
+            v = ctx.var(node.inputs[idx])       # [dirs, b, H]
+            return [ctx.sd._op("tensor_list_get_item",
+                               [v, ctx.sd.constant(
+                                   ctx.unique(f"{tag}_d"),
+                                   np.asarray(d, np.int32))])
+                    for d in range(dirs)]
+        zero = ctx.sd.constant(ctx.unique(tag),
+                               np.zeros((b, H), np.float32))
+        return [zero] * dirs
+
+    h0s = initial(5, f"{node.name}_h0")
+    c0s = initial(6, f"{node.name}_c0")
+
+    y_dirs, h_lasts, c_lasts = [], [], []
+    for d in range(dirs):
+        w = ctx.sd.constant(ctx.unique(f"{node.name}_w{d}"),
+                            np.ascontiguousarray(
+                                reorder(w_np[d]).T))     # [in, 4H]
+        rw = ctx.sd.constant(ctx.unique(f"{node.name}_r{d}"),
+                             np.ascontiguousarray(
+                                 reorder(r_np[d]).T))    # [H, 4H]
+        bias = ctx.sd.constant(
+            ctx.unique(f"{node.name}_b{d}"),
+            reorder(b_np[d][:4 * H])
+            + reorder(b_np[d][4 * H:]))
+        xin = xb
+        if d == 1 or direction == "reverse":
+            xin = ctx.sd._op("reverse", [xb], {"axes": (1,)})
+        outs = ctx.sd._op("lstm_layer",
+                          [xin, h0s[d], c0s[d], w, rw, bias],
+                          n_out=3)
+        h_seq, h_last, c_last = outs
+        if d == 1 or direction == "reverse":
+            h_seq = ctx.sd._op("reverse", [h_seq], {"axes": (1,)})
+        # [b, t, H] -> [t, 1, b, H]
+        ht = ctx.sd._op("transpose", [h_seq], {"axes": (1, 0, 2)})
+        y_dirs.append(ctx.sd._op("expand_dims", [ht], {"axis": 1}))
+        h_lasts.append(ctx.sd._op("expand_dims", [h_last],
+                                  {"axis": 0}))
+        c_lasts.append(ctx.sd._op("expand_dims", [c_last],
+                                  {"axis": 0}))
+
+    def cat(parts, axis):
+        return (parts[0] if len(parts) == 1
+                else ctx.sd._op("concat", parts, {"axis": axis}))
+
+    return (cat(y_dirs, 1), cat(h_lasts, 0), cat(c_lasts, 0))

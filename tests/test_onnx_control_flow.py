@@ -160,3 +160,76 @@ class TestLoop:
         np.testing.assert_allclose(vf, 3 * xv, rtol=1e-6)
         np.testing.assert_allclose(
             stack, np.stack([xv, 2 * xv, 3 * xv]), rtol=1e-6)
+
+
+class TestOnnxLSTM:
+    def _lstm_model(self, direction, seq=5, b=3, inp=4, H=6,
+                    with_initial=False):
+        dirs = 2 if direction == "bidirectional" else 1
+        rng = np.random.RandomState(8)
+        # build in ONNX gate order (i, o, f, c) directly
+        W = (rng.randn(dirs, 4 * H, inp) * 0.3).astype(np.float32)
+        Rw = (rng.randn(dirs, 4 * H, H) * 0.3).astype(np.float32)
+        B = (rng.randn(dirs, 8 * H) * 0.1).astype(np.float32)
+        inits = {"W": W, "R": Rw, "B": B}
+        ins = ["x", "W", "R", "B"]
+        if with_initial:
+            inits["h0"] = (rng.randn(dirs, b, H) * 0.2).astype(
+                np.float32)
+            inits["c0"] = (rng.randn(dirs, b, H) * 0.2).astype(
+                np.float32)
+            ins += ["", "h0", "c0"]
+        nodes = [encode_node("LSTM", ins, ["Y", "Yh", "Yc"], "lstm",
+                             hidden_size=H, direction=direction)]
+        m = _model(nodes, inits, [("x", (seq, b, inp))],
+                   [("Y", (seq, dirs, b, H)), ("Yh", (dirs, b, H)),
+                    ("Yc", (dirs, b, H))])
+        return m, W, Rw, B, inits
+
+    @staticmethod
+    def _ref_lstm(x, W, Rw, B, h0, c0):
+        """numpy reference in ONNX (i, o, f, c) order, one
+        direction."""
+        seq, b, _ = x.shape
+        H = Rw.shape[1]
+        sig = lambda v: 1.0 / (1.0 + np.exp(-v))
+        h, c = h0.copy(), c0.copy()
+        ys = []
+        wb, rb = B[:4 * H], B[4 * H:]
+        for t in range(seq):
+            z = x[t] @ W.T + h @ Rw.T + wb + rb
+            i = sig(z[:, :H])
+            o = sig(z[:, H:2 * H])
+            f = sig(z[:, 2 * H:3 * H])
+            g = np.tanh(z[:, 3 * H:])
+            c = f * c + i * g
+            h = o * np.tanh(c)
+            ys.append(h.copy())
+        return np.stack(ys), h, c
+
+    @pytest.mark.parametrize("direction", ["forward", "reverse",
+                                           "bidirectional"])
+    def test_lstm_matches_reference(self, direction):
+        seq, b, inp, H = 5, 3, 4, 6
+        m, W, Rw, B, inits = self._lstm_model(direction, seq, b, inp,
+                                              H, with_initial=True)
+        imp = import_onnx(m)
+        x = np.random.RandomState(1).randn(seq, b, inp) \
+            .astype(np.float32) * 0.5
+        Y, Yh, Yc = (np.asarray(a) for a in imp.output({"x": x}))
+        dirs = Y.shape[1]
+        for d in range(dirs):
+            xd = x if (direction == "forward" or d == 0
+                       and direction == "bidirectional") else x[::-1]
+            if direction == "reverse":
+                xd = x[::-1]
+            ys, h, c = self._ref_lstm(xd, W[d], Rw[d], B[d],
+                                      inits["h0"][d], inits["c0"][d])
+            if direction == "reverse" or d == 1:
+                ys = ys[::-1]
+            np.testing.assert_allclose(Y[:, d], ys, rtol=1e-4,
+                                       atol=1e-5)
+            np.testing.assert_allclose(Yh[d], h, rtol=1e-4,
+                                       atol=1e-5)
+            np.testing.assert_allclose(Yc[d], c, rtol=1e-4,
+                                       atol=1e-5)
