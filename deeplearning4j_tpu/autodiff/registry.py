@@ -1307,6 +1307,39 @@ def _lstm_layer(ins, attrs):
     return jnp.swapaxes(hs, 0, 1), h_last, c_last
 
 
+@op("gru_layer", "recurrent")
+def _gru_layer(ins, attrs):
+    """Full-sequence GRU via lax.scan, ONNX GRU semantics (gate order
+    (z, r, h) on the last weight axis; ``linear_before_reset`` per the
+    spec — torch exports 1).  Inputs: x [b, t, f], h0 [b, H],
+    w [f, 3H], rw [H, 3H], wb [3H], rb [3H].
+    Returns (h_seq [b, t, H], h_last)."""
+    x, h0, w, rw, wb, rb = ins
+    H = h0.shape[-1]
+    lbr = bool(attrs.get("linear_before_reset", False))
+
+    def cell(h, xt):
+        xz = xt @ w + wb
+        # lbr=0 computes the h-gate recurrent term on (r*h) separately
+        # — slice the main recurrent matmul to z/r there (a dot can't
+        # be dead-code-split by XLA)
+        hz = h @ (rw if lbr else rw[:, :2 * H])
+        z = jax.nn.sigmoid(xz[:, :H] + hz[:, :H] + rb[:H])
+        r = jax.nn.sigmoid(xz[:, H:2 * H] + hz[:, H:2 * H]
+                           + rb[H:2 * H])
+        if lbr:
+            n = jnp.tanh(xz[:, 2 * H:]
+                         + r * (hz[:, 2 * H:] + rb[2 * H:]))
+        else:
+            n = jnp.tanh(xz[:, 2 * H:]
+                         + (r * h) @ rw[:, 2 * H:] + rb[2 * H:])
+        hn = (1.0 - z) * n + z * h
+        return hn, hn
+
+    h_last, hs = lax.scan(cell, h0, jnp.swapaxes(x, 0, 1))
+    return jnp.swapaxes(hs, 0, 1), h_last
+
+
 @op("sru", "recurrent")
 def _sru_layer(ins, attrs):
     """Full-sequence SRU via lax.scan (reference: libnd4j sru op).

@@ -1019,6 +1019,56 @@ def _scan_onnx(ctx, node):
     return tuple(list(final_states) + list(final_accs[:n_scan_out]))
 
 
+
+def _rnn_guards(ctx, node, default_acts):
+    """Shared LSTM/GRU precondition checks.  Returns (direction,
+    dirs).  An ``activations`` attr spelling out the per-direction
+    DEFAULTS is accepted (tf2onnx serializes it explicitly)."""
+    if int(node.attr("layout", 0)) != 0:
+        raise NotImplementedError(
+            f"{node.op} '{node.name}': layout=1 (batch-major) "
+            f"unsupported")
+    direction = node.attr("direction", b"forward")
+    direction = (direction.decode()
+                 if isinstance(direction, bytes) else direction)
+    dirs = 2 if direction == "bidirectional" else 1
+    acts = node.attr("activations")
+    if acts is not None:
+        got = [a.decode().lower() if isinstance(a, bytes)
+               else str(a).lower() for a in acts]
+        if got != default_acts * dirs:
+            raise NotImplementedError(
+                f"{node.op} '{node.name}': custom activations "
+                f"{got} unsupported")
+    if len(node.inputs) > 4 and node.inputs[4]:
+        raise NotImplementedError(
+            f"{node.op} '{node.name}': sequence_lens unsupported")
+    if node.attr("clip") is not None:
+        raise NotImplementedError(
+            f"{node.op} '{node.name}': clip unsupported")
+    return direction, dirs
+
+
+def _rnn_initial(ctx, node, idx, dirs, b, H, tag):
+    """Per-direction initial state: slice of the [dirs, b, H] input,
+    or zeros."""
+    if len(node.inputs) > idx and node.inputs[idx]:
+        v = ctx.var(node.inputs[idx])
+        return [ctx.sd._op("tensor_list_get_item",
+                           [v, ctx.sd.constant(
+                               ctx.unique(f"{tag}_d"),
+                               np.asarray(d, np.int32))])
+                for d in range(dirs)]
+    zero = ctx.sd.constant(ctx.unique(tag),
+                           np.zeros((b, H), np.float32))
+    return [zero] * dirs
+
+
+def _rnn_concat(ctx, parts, axis):
+    return (parts[0] if len(parts) == 1
+            else ctx.sd._op("concat", parts, {"axis": axis}))
+
+
 @onnx_op("LSTM")
 def _lstm_onnx(ctx, node):
     """ONNX LSTM (what torch exports nn.LSTM to): X [seq, b, in]
@@ -1027,29 +1077,15 @@ def _lstm_onnx(ctx, node):
     ``lstm_layer`` op (gate order [i, f, o, g]): weights reorder and
     transpose statically; the reverse direction flips time around the
     scan.  Outputs Y [seq, dirs, b, H], Y_h / Y_c [dirs, b, H]."""
-    if int(node.attr("layout", 0)) != 0:
-        raise NotImplementedError(
-            f"LSTM '{node.name}': layout=1 (batch-major) unsupported")
-    if node.attr("activations") is not None:
-        raise NotImplementedError(
-            f"LSTM '{node.name}': custom activations unsupported")
-    if len(node.inputs) > 4 and node.inputs[4]:
-        raise NotImplementedError(
-            f"LSTM '{node.name}': sequence_lens unsupported")
+    direction, dirs = _rnn_guards(ctx, node,
+                                  ["sigmoid", "tanh", "tanh"])
     if len(node.inputs) > 7 and node.inputs[7]:
         raise NotImplementedError(
             f"LSTM '{node.name}': peephole weights (P) unsupported")
-    if node.attr("clip") is not None:
-        raise NotImplementedError(
-            f"LSTM '{node.name}': clip unsupported")
     if node.attr("input_forget"):
         raise NotImplementedError(
             f"LSTM '{node.name}': input_forget (coupled gates) "
             f"unsupported")
-    direction = node.attr("direction", b"forward")
-    direction = (direction.decode()
-                 if isinstance(direction, bytes) else direction)
-    dirs = 2 if direction == "bidirectional" else 1
     H = int(node.attr("hidden_size"))
     w_np = np.asarray(ctx.require_static(node, 1))   # [dirs, 4H, in]
     r_np = np.asarray(ctx.require_static(node, 2))   # [dirs, 4H, H]
@@ -1070,20 +1106,8 @@ def _lstm_onnx(ctx, node):
             f"LSTM '{node.name}': input shape must be known")
     b = int(in_shape[1])
 
-    def initial(idx, tag):
-        if len(node.inputs) > idx and node.inputs[idx]:
-            v = ctx.var(node.inputs[idx])       # [dirs, b, H]
-            return [ctx.sd._op("tensor_list_get_item",
-                               [v, ctx.sd.constant(
-                                   ctx.unique(f"{tag}_d"),
-                                   np.asarray(d, np.int32))])
-                    for d in range(dirs)]
-        zero = ctx.sd.constant(ctx.unique(tag),
-                               np.zeros((b, H), np.float32))
-        return [zero] * dirs
-
-    h0s = initial(5, f"{node.name}_h0")
-    c0s = initial(6, f"{node.name}_c0")
+    h0s = _rnn_initial(ctx, node, 5, dirs, b, H, f"{node.name}_h0")
+    c0s = _rnn_initial(ctx, node, 6, dirs, b, H, f"{node.name}_c0")
 
     y_dirs, h_lasts, c_lasts = [], [], []
     for d in range(dirs):
@@ -1114,8 +1138,56 @@ def _lstm_onnx(ctx, node):
         c_lasts.append(ctx.sd._op("expand_dims", [c_last],
                                   {"axis": 0}))
 
-    def cat(parts, axis):
-        return (parts[0] if len(parts) == 1
-                else ctx.sd._op("concat", parts, {"axis": axis}))
+    return (_rnn_concat(ctx, y_dirs, 1), _rnn_concat(ctx, h_lasts, 0),
+            _rnn_concat(ctx, c_lasts, 0))
 
-    return (cat(y_dirs, 1), cat(h_lasts, 0), cat(c_lasts, 0))
+
+@onnx_op("GRU")
+def _gru_onnx(ctx, node):
+    """ONNX GRU (torch nn.GRU export): X [seq, b, in], W [dirs, 3H,
+    in] / R [dirs, 3H, H] in gate order (z, r, h), B [dirs, 6H] =
+    Wb ++ Rb, ``linear_before_reset`` attr (torch exports 1).  Lowers
+    onto the scan-based ``gru_layer`` op, which keeps the ONNX gate
+    order natively — only a transpose of the static weights."""
+    direction, dirs = _rnn_guards(ctx, node, ["sigmoid", "tanh"])
+    H = int(node.attr("hidden_size"))
+    lbr = int(node.attr("linear_before_reset", 0))
+    w_np = np.asarray(ctx.require_static(node, 1))   # [dirs, 3H, in]
+    r_np = np.asarray(ctx.require_static(node, 2))   # [dirs, 3H, H]
+    b_np = (np.asarray(ctx.require_static(node, 3))
+            if len(node.inputs) > 3 and node.inputs[3]
+            else np.zeros((dirs, 6 * H), np.float32))
+
+    x = ctx.var(node.inputs[0])
+    xb = ctx.sd._op("transpose", [x], {"axes": (1, 0, 2)})  # [b,t,in]
+    in_shape = ctx.shape_of(node.inputs[0])
+    if in_shape is None:
+        raise NotImplementedError(
+            f"GRU '{node.name}': input shape must be known")
+    b = int(in_shape[1])
+
+    h0s = _rnn_initial(ctx, node, 5, dirs, b, H, f"{node.name}_h0")
+    y_dirs, h_lasts = [], []
+    for d in range(dirs):
+        w = ctx.sd.constant(ctx.unique(f"{node.name}_w{d}"),
+                            np.ascontiguousarray(w_np[d].T))
+        rw = ctx.sd.constant(ctx.unique(f"{node.name}_r{d}"),
+                             np.ascontiguousarray(r_np[d].T))
+        wb = ctx.sd.constant(ctx.unique(f"{node.name}_wb{d}"),
+                             b_np[d][:3 * H])
+        rb = ctx.sd.constant(ctx.unique(f"{node.name}_rb{d}"),
+                             b_np[d][3 * H:])
+        xin = xb
+        if d == 1 or direction == "reverse":
+            xin = ctx.sd._op("reverse", [xb], {"axes": (1,)})
+        h_seq, h_last = ctx.sd._op(
+            "gru_layer", [xin, h0s[d], w, rw, wb, rb],
+            {"linear_before_reset": lbr}, n_out=2)
+        if d == 1 or direction == "reverse":
+            h_seq = ctx.sd._op("reverse", [h_seq], {"axes": (1,)})
+        ht = ctx.sd._op("transpose", [h_seq], {"axes": (1, 0, 2)})
+        y_dirs.append(ctx.sd._op("expand_dims", [ht], {"axis": 1}))
+        h_lasts.append(ctx.sd._op("expand_dims", [h_last],
+                                  {"axis": 0}))
+
+    return (_rnn_concat(ctx, y_dirs, 1), _rnn_concat(ctx, h_lasts, 0))

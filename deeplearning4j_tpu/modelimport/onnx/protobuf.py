@@ -292,6 +292,12 @@ def encode_attr(name: str, value) -> bytes:
         for v in value:
             out += _tag(7, 5) + struct.pack("<f", v)
         out += _int_field(20, 6)
+    elif isinstance(value, (list, tuple)) and value and \
+            isinstance(value[0], (bytes, str)):
+        for v in value:
+            out += _len_field(9, v.encode() if isinstance(v, str)
+                              else v)
+        out += _int_field(20, 8)
     elif isinstance(value, (list, tuple)):
         for v in value:
             out += _tag(8, 0) + _varint(int(v) & ((1 << 64) - 1))

@@ -233,3 +233,100 @@ class TestOnnxLSTM:
                                        atol=1e-5)
             np.testing.assert_allclose(Yc[d], c, rtol=1e-4,
                                        atol=1e-5)
+
+
+class TestOnnxGRU:
+    @staticmethod
+    def _ref_gru(x, W, Rw, B, h0, lbr):
+        seq = x.shape[0]
+        H = Rw.shape[1]
+        sig = lambda v: 1.0 / (1.0 + np.exp(-v))
+        wb, rb = B[:3 * H], B[3 * H:]
+        h = h0.copy()
+        ys = []
+        for t in range(seq):
+            xz = x[t] @ W.T + wb
+            hz = h @ Rw.T
+            z = sig(xz[:, :H] + hz[:, :H] + rb[:H])
+            r = sig(xz[:, H:2 * H] + hz[:, H:2 * H] + rb[H:2 * H])
+            if lbr:
+                n = np.tanh(xz[:, 2 * H:]
+                            + r * (hz[:, 2 * H:] + rb[2 * H:]))
+            else:
+                n = np.tanh(xz[:, 2 * H:]
+                            + (r * h) @ Rw.T[:, 2 * H:] + rb[2 * H:])
+            h = (1.0 - z) * n + z * h
+            ys.append(h.copy())
+        return np.stack(ys), h
+
+    @pytest.mark.parametrize("direction,lbr",
+                             [("forward", 1), ("forward", 0),
+                              ("bidirectional", 1)])
+    def test_gru_matches_reference(self, direction, lbr):
+        seq, b, inp, H = 5, 3, 4, 6
+        dirs = 2 if direction == "bidirectional" else 1
+        rng = np.random.RandomState(9)
+        W = (rng.randn(dirs, 3 * H, inp) * 0.3).astype(np.float32)
+        Rw = (rng.randn(dirs, 3 * H, H) * 0.3).astype(np.float32)
+        B = (rng.randn(dirs, 6 * H) * 0.1).astype(np.float32)
+        h0 = (rng.randn(dirs, b, H) * 0.2).astype(np.float32)
+        nodes = [encode_node(
+            "GRU", ["x", "W", "R", "B", "", "h0"], ["Y", "Yh"],
+            "gru", hidden_size=H, direction=direction,
+            linear_before_reset=lbr)]
+        m = _model(nodes, {"W": W, "R": Rw, "B": B, "h0": h0},
+                   [("x", (seq, b, inp))],
+                   [("Y", (seq, dirs, b, H)), ("Yh", (dirs, b, H))])
+        imp = import_onnx(m)
+        x = rng.randn(seq, b, inp).astype(np.float32) * 0.5
+        Y, Yh = (np.asarray(a) for a in imp.output({"x": x}))
+        for d in range(dirs):
+            xd = x[::-1] if d == 1 else x
+            ys, h = self._ref_gru(xd, W[d], Rw[d], B[d], h0[d], lbr)
+            if d == 1:
+                ys = ys[::-1]
+            np.testing.assert_allclose(Y[:, d], ys, rtol=1e-4,
+                                       atol=1e-5)
+            np.testing.assert_allclose(Yh[d], h, rtol=1e-4,
+                                       atol=1e-5)
+
+
+class TestRnnDefaults:
+    def test_lstm_no_initial_no_bias_default_activations(self):
+        """Zero-default initial states and bias, plus an activations
+        attr spelling out the DEFAULTS (tf2onnx does this) — all must
+        import."""
+        seq, b, inp, H = 4, 2, 3, 5
+        rng = np.random.RandomState(11)
+        W = (rng.randn(1, 4 * H, inp) * 0.3).astype(np.float32)
+        Rw = (rng.randn(1, 4 * H, H) * 0.3).astype(np.float32)
+        nodes = [encode_node(
+            "LSTM", ["x", "W", "R"], ["Y", "Yh", "Yc"], "lstm",
+            hidden_size=H,
+            activations=[b"Sigmoid", b"Tanh", b"Tanh"])]
+        m = _model(nodes, {"W": W, "R": Rw},
+                   [("x", (seq, b, inp))],
+                   [("Y", (seq, 1, b, H)), ("Yh", (1, b, H)),
+                    ("Yc", (1, b, H))])
+        imp = import_onnx(m)
+        x = rng.randn(seq, b, inp).astype(np.float32) * 0.5
+        Y, Yh, Yc = (np.asarray(a) for a in imp.output({"x": x}))
+        B0 = np.zeros(8 * H, np.float32)
+        ys, h, c = TestOnnxLSTM._ref_lstm(
+            x, W[0], Rw[0], B0, np.zeros((b, H), np.float32),
+            np.zeros((b, H), np.float32))
+        np.testing.assert_allclose(Y[:, 0], ys, rtol=1e-4, atol=1e-5)
+        np.testing.assert_allclose(Yh[0], h, rtol=1e-4, atol=1e-5)
+
+    def test_lstm_truly_custom_activations_rejected(self):
+        W = np.zeros((1, 20, 3), np.float32)
+        Rw = np.zeros((1, 20, 5), np.float32)
+        nodes = [encode_node(
+            "LSTM", ["x", "W", "R"], ["Y", "Yh", "Yc"], "lstm",
+            hidden_size=5,
+            activations=[b"HardSigmoid", b"Tanh", b"Tanh"])]
+        m = _model(nodes, {"W": W, "R": Rw}, [("x", (4, 2, 3))],
+                   [("Y", (4, 1, 2, 5)), ("Yh", (1, 2, 5)),
+                    ("Yc", (1, 2, 5))])
+        with pytest.raises(NotImplementedError, match="activations"):
+            import_onnx(m)
