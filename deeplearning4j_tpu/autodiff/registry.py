@@ -1307,6 +1307,22 @@ def _lstm_layer(ins, attrs):
     return jnp.swapaxes(hs, 0, 1), h_last, c_last
 
 
+@op("rnn_layer", "recurrent")
+def _rnn_layer(ins, attrs):
+    """Full-sequence vanilla RNN via lax.scan (ONNX RNN semantics):
+    h_t = tanh(x_t W + h_{t-1} R + b).  Inputs: x [b, t, f],
+    h0 [b, H], w [f, H], rw [H, H], b [H].
+    Returns (h_seq [b, t, H], h_last)."""
+    x, h0, w, rw, b = ins
+
+    def cell(h, xt):
+        hn = jnp.tanh(xt @ w + h @ rw + b)
+        return hn, hn
+
+    h_last, hs = lax.scan(cell, h0, jnp.swapaxes(x, 0, 1))
+    return jnp.swapaxes(hs, 0, 1), h_last
+
+
 @op("gru_layer", "recurrent")
 def _gru_layer(ins, attrs):
     """Full-sequence GRU via lax.scan, ONNX GRU semantics (gate order

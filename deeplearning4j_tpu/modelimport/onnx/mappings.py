@@ -1069,6 +1069,34 @@ def _rnn_concat(ctx, parts, axis):
             else ctx.sd._op("concat", parts, {"axis": axis}))
 
 
+
+def _rnn_directions(ctx, direction, dirs, xb, run_dir):
+    """Shared per-direction scaffolding for LSTM/GRU/RNN: time-flip
+    the input for the reverse direction, call ``run_dir(d, xin) ->
+    (h_seq [b,t,H], *states [b,H])``, un-flip, reshape to the ONNX
+    [t, dirs, b, H] layout and concat across directions.  Returns
+    (Y, *concatenated_states)."""
+    y_dirs = None
+    state_lists = None
+    for d in range(dirs):
+        xin = xb
+        if d == 1 or direction == "reverse":
+            xin = ctx.sd._op("reverse", [xb], {"axes": (1,)})
+        outs = run_dir(d, xin)
+        h_seq, states = outs[0], outs[1:]
+        if d == 1 or direction == "reverse":
+            h_seq = ctx.sd._op("reverse", [h_seq], {"axes": (1,)})
+        ht = ctx.sd._op("transpose", [h_seq], {"axes": (1, 0, 2)})
+        if y_dirs is None:
+            y_dirs = []
+            state_lists = [[] for _ in states]
+        y_dirs.append(ctx.sd._op("expand_dims", [ht], {"axis": 1}))
+        for lst, st in zip(state_lists, states):
+            lst.append(ctx.sd._op("expand_dims", [st], {"axis": 0}))
+    return tuple([_rnn_concat(ctx, y_dirs, 1)]
+                 + [_rnn_concat(ctx, lst, 0) for lst in state_lists])
+
+
 @onnx_op("LSTM")
 def _lstm_onnx(ctx, node):
     """ONNX LSTM (what torch exports nn.LSTM to): X [seq, b, in]
@@ -1109,8 +1137,7 @@ def _lstm_onnx(ctx, node):
     h0s = _rnn_initial(ctx, node, 5, dirs, b, H, f"{node.name}_h0")
     c0s = _rnn_initial(ctx, node, 6, dirs, b, H, f"{node.name}_c0")
 
-    y_dirs, h_lasts, c_lasts = [], [], []
-    for d in range(dirs):
+    def run_dir(d, xin):
         w = ctx.sd.constant(ctx.unique(f"{node.name}_w{d}"),
                             np.ascontiguousarray(
                                 reorder(w_np[d]).T))     # [in, 4H]
@@ -1121,25 +1148,11 @@ def _lstm_onnx(ctx, node):
             ctx.unique(f"{node.name}_b{d}"),
             reorder(b_np[d][:4 * H])
             + reorder(b_np[d][4 * H:]))
-        xin = xb
-        if d == 1 or direction == "reverse":
-            xin = ctx.sd._op("reverse", [xb], {"axes": (1,)})
-        outs = ctx.sd._op("lstm_layer",
+        return ctx.sd._op("lstm_layer",
                           [xin, h0s[d], c0s[d], w, rw, bias],
                           n_out=3)
-        h_seq, h_last, c_last = outs
-        if d == 1 or direction == "reverse":
-            h_seq = ctx.sd._op("reverse", [h_seq], {"axes": (1,)})
-        # [b, t, H] -> [t, 1, b, H]
-        ht = ctx.sd._op("transpose", [h_seq], {"axes": (1, 0, 2)})
-        y_dirs.append(ctx.sd._op("expand_dims", [ht], {"axis": 1}))
-        h_lasts.append(ctx.sd._op("expand_dims", [h_last],
-                                  {"axis": 0}))
-        c_lasts.append(ctx.sd._op("expand_dims", [c_last],
-                                  {"axis": 0}))
 
-    return (_rnn_concat(ctx, y_dirs, 1), _rnn_concat(ctx, h_lasts, 0),
-            _rnn_concat(ctx, c_lasts, 0))
+    return _rnn_directions(ctx, direction, dirs, xb, run_dir)
 
 
 @onnx_op("GRU")
@@ -1191,3 +1204,35 @@ def _gru_onnx(ctx, node):
                                   {"axis": 0}))
 
     return (_rnn_concat(ctx, y_dirs, 1), _rnn_concat(ctx, h_lasts, 0))
+
+
+@onnx_op("RNN")
+def _rnn_onnx(ctx, node):
+    """ONNX vanilla RNN: h_t = tanh(x W^T + h R^T + Wb + Rb), with
+    W [dirs, H, in] / R [dirs, H, H] / B [dirs, 2H]."""
+    direction, dirs = _rnn_guards(ctx, node, ["tanh"])
+    H = int(node.attr("hidden_size"))
+    w_np = np.asarray(ctx.require_static(node, 1))
+    r_np = np.asarray(ctx.require_static(node, 2))
+    b_np = (np.asarray(ctx.require_static(node, 3))
+            if len(node.inputs) > 3 and node.inputs[3]
+            else np.zeros((dirs, 2 * H), np.float32))
+    x = ctx.var(node.inputs[0])
+    xb = ctx.sd._op("transpose", [x], {"axes": (1, 0, 2)})
+    in_shape = ctx.shape_of(node.inputs[0])
+    if in_shape is None:
+        raise NotImplementedError(
+            f"RNN '{node.name}': input shape must be known")
+    b = int(in_shape[1])
+    h0s = _rnn_initial(ctx, node, 5, dirs, b, H, f"{node.name}_h0")
+    def run_dir(d, xin):
+        w = ctx.sd.constant(ctx.unique(f"{node.name}_w{d}"),
+                            np.ascontiguousarray(w_np[d].T))
+        rw = ctx.sd.constant(ctx.unique(f"{node.name}_r{d}"),
+                             np.ascontiguousarray(r_np[d].T))
+        bias = ctx.sd.constant(ctx.unique(f"{node.name}_b{d}"),
+                               b_np[d][:H] + b_np[d][H:])
+        return ctx.sd._op(
+            "rnn_layer", [xin, h0s[d], w, rw, bias], n_out=2)
+
+    return _rnn_directions(ctx, direction, dirs, xb, run_dir)

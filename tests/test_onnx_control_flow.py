@@ -330,3 +330,29 @@ class TestRnnDefaults:
                     ("Yc", (1, 2, 5))])
         with pytest.raises(NotImplementedError, match="activations"):
             import_onnx(m)
+
+
+class TestOnnxVanillaRNN:
+    def test_rnn_matches_reference(self):
+        seq, b, inp, H = 4, 2, 3, 5
+        rng = np.random.RandomState(12)
+        W = (rng.randn(1, H, inp) * 0.4).astype(np.float32)
+        Rw = (rng.randn(1, H, H) * 0.4).astype(np.float32)
+        B = (rng.randn(1, 2 * H) * 0.1).astype(np.float32)
+        nodes = [encode_node("RNN", ["x", "W", "R", "B"],
+                             ["Y", "Yh"], "rnn", hidden_size=H)]
+        m = _model(nodes, {"W": W, "R": Rw, "B": B},
+                   [("x", (seq, b, inp))],
+                   [("Y", (seq, 1, b, H)), ("Yh", (1, b, H))])
+        imp = import_onnx(m)
+        x = rng.randn(seq, b, inp).astype(np.float32) * 0.5
+        Y, Yh = (np.asarray(a) for a in imp.output({"x": x}))
+        h = np.zeros((b, H), np.float32)
+        ys = []
+        for t in range(seq):
+            h = np.tanh(x[t] @ W[0].T + h @ Rw[0].T
+                        + B[0][:H] + B[0][H:])
+            ys.append(h.copy())
+        np.testing.assert_allclose(Y[:, 0], np.stack(ys), rtol=1e-4,
+                                   atol=1e-5)
+        np.testing.assert_allclose(Yh[0], h, rtol=1e-4, atol=1e-5)
