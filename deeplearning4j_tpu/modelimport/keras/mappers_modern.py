@@ -284,6 +284,12 @@ def _map_resizing(cfg, bag):
         interpolation=interp))]
 
 
+@keras_layer("ActivityRegularization")
+def _map_activity_regularization(cfg, bag):
+    # contributes only a training-loss penalty; inference no-op
+    return [Emit(skip=True)]
+
+
 @keras_layer("RandomFlip", "RandomRotation", "RandomZoom",
              "RandomTranslation", "RandomContrast", "RandomBrightness")
 def _map_random_augment(cfg, bag):

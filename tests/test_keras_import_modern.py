@@ -278,6 +278,7 @@ class TestPreprocessingLayers:
             keras.layers.Rescaling(1.0 / 255, offset=-0.5),
             keras.layers.RandomFlip(),
             keras.layers.RandomRotation(0.2),
+            keras.layers.ActivityRegularization(l2=0.01),
             keras.layers.Conv2D(4, 3, padding="same"),
         ])
         x = (R.rand(2, 10, 12, 3) * 255).astype(np.float32)
