@@ -143,6 +143,14 @@ def _map_mha(cfg, bag):
     two-input vertex and is rejected loudly at the graph builder."""
     h = int(cfg["num_heads"])
     dk = int(cfg["key_dim"])
+    vdim = cfg.get("value_dim")
+    if vdim is not None and int(vdim) != dk:
+        # SelfAttentionLayer has ONE head_size; importing value_dim !=
+        # key_dim would leave the layer config inconsistent with the
+        # loaded Wv/Wo shapes (re-init or round-trip would mismatch)
+        raise InvalidKerasConfigurationException(
+            f"MultiHeadAttention value_dim={vdim} != key_dim={dk} "
+            f"unsupported (uniform head size only)")
     use_bias = bool(cfg.get("use_bias", True))
     att_axes = cfg.get("attention_axes")
     if att_axes not in (None, [1], (1,), 1):

@@ -903,6 +903,12 @@ def _loop_onnx(ctx, node):
     if cond_name:
         cond0 = ctx.var(cond_name)
     else:
+        if m_static is None:
+            # neither a trip count nor a cond input: the spec's
+            # "infinite loop" form, which cannot lower
+            raise NotImplementedError(
+                f"Loop '{node.name}': no trip count and no cond "
+                f"input (infinite loop form) cannot lower")
         cond0 = ctx.sd.constant(ctx.unique("loop_c"),
                                 np.asarray(True))
     m_const = (None if m_static is None else
@@ -913,6 +919,12 @@ def _loop_onnx(ctx, node):
 
     def cond_fn(i, c, *vs):
         csd = i.sd
+        if not cond_name:
+            # for-loop form (M given, cond input absent): the spec
+            # says the body's cond output is ignored — drive the
+            # loop purely by i < M (the body cond is still carried,
+            # it just never gates continuation)
+            return csd._op("lt", [i, m_const])
         keep = c
         if m_const is not None:
             keep = csd._op("logical_and",
@@ -978,9 +990,12 @@ def _scan_onnx(ctx, node):
     scan_ins = [ctx.var(n) for n in node.inputs[n_state:]]
     lengths = {ctx.shape_of(n)[0] if ctx.shape_of(n) else None
                for n in node.inputs[n_state:]}
-    if len(lengths) != 1 or None in lengths:
+    if (len(lengths) != 1 or None in lengths
+            or any(l < 0 for l in lengths)):
         # an UNKNOWN length must fail too: a shorter actual input
-        # would silently re-read its last row for the tail iterations
+        # would silently re-read its last row for the tail
+        # iterations; a SYMBOLIC length parses as -1 and would flow
+        # into np.zeros((-1,...)) with a confusing ValueError
         raise NotImplementedError(
             f"Scan '{node.name}': every scan-input length must be "
             f"static and uniform (got "

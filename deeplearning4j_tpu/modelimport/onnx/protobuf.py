@@ -325,7 +325,11 @@ def encode_node(op_type: str, inputs: Sequence[str],
 
 def encode_value_info(name: str, shape: Sequence[int],
                       dtype=np.float32) -> bytes:
-    dims = b"".join(_len_field(1, _int_field(1, d)) for d in shape)
+    # a negative dim encodes as a SYMBOLIC dim_param (what real
+    # exporters emit for unknown dims; parse_value_info maps it to -1)
+    dims = b"".join(_len_field(1, (_len_field(2, b"N") if d < 0
+                                   else _int_field(1, d)))
+                    for d in shape)
     tshape = _len_field(2, dims)
     tensor_type = _int_field(1, NP_TO_ONNX[np.dtype(dtype)]) + tshape
     type_proto = _len_field(1, tensor_type)

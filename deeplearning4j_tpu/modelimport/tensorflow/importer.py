@@ -130,7 +130,8 @@ class GraphDefImporter:
     """One-shot importer for a frozen (inference) GraphDef."""
 
     def __init__(self, graph_def, input_shapes: Optional[dict] = None,
-                 while_max_iterations=None):
+                 while_max_iterations=None,
+                 outputs: Optional[List[str]] = None):
         if isinstance(graph_def, (str, os.PathLike)):
             with open(graph_def, "rb") as fh:
                 graph_def = fh.read()
@@ -152,6 +153,9 @@ class GraphDefImporter:
         self.var_map: Dict[str, SDVariable] = {}
         self.avals: Dict[str, jax.ShapeDtypeStruct] = {}
         self.placeholders: List[str] = []
+        #: requested fetches; None = infer terminals after import
+        self.requested_outputs = ([_node_of(o) for o in outputs]
+                                  if outputs else None)
         self.outputs: List[str] = []
 
     # -- name/value plumbing ------------------------------------------
@@ -322,8 +326,9 @@ class GraphDefImporter:
                for n in self.nodes):
             # legacy v1 frames (frozen tf.while_loop/tf.cond) →
             # functional While/If, which lower to lax below
-            self.nodes = v1_control_flow.deframe(self.nodes,
-                                                 self.functions)
+            self.nodes = v1_control_flow.deframe(
+                self.nodes, self.functions,
+                keep=frozenset(self.requested_outputs or ()))
         _resolve_tensor_lists(self.nodes)
         by_name = {n.name: n for n in self.nodes}
         order = _topo_sort(self.nodes, by_name)
@@ -339,7 +344,15 @@ class GraphDefImporter:
                 f"TF import: no mapping for ops {unmapped} "
                 f"(reference parity: OpMappingRegistry lookup failure)")
         self._import_node_list(order, _Ctx(self))
-        self.outputs = _terminal_names(order, self.var_map)
+        if self.requested_outputs is not None:
+            missing = [o for o in self.requested_outputs
+                       if o not in self.var_map]
+            if missing:
+                raise KeyError(f"TF import: requested outputs "
+                               f"{missing} not found in graph")
+            self.outputs = list(self.requested_outputs)
+        else:
+            self.outputs = _terminal_names(order, self.var_map)
         return self.sd
 
     def _import_node_list(self, order, ctx):
@@ -745,9 +758,11 @@ class TensorflowFrameworkImporter:
 
     @staticmethod
     def run_import(graph_def, input_shapes: Optional[dict] = None,
-                   while_max_iterations=None) -> SameDiff:
+                   while_max_iterations=None,
+                   outputs: Optional[List[str]] = None) -> SameDiff:
         return GraphDefImporter(graph_def, input_shapes,
-                                while_max_iterations).run()
+                                while_max_iterations,
+                                outputs=outputs).run()
 
     runImport = run_import
 
@@ -757,8 +772,10 @@ class TFGraphMapper:
 
     @staticmethod
     def import_graph(graph_def, input_shapes: Optional[dict] = None,
-                     while_max_iterations=None) -> SameDiff:
+                     while_max_iterations=None,
+                     outputs: Optional[List[str]] = None) -> SameDiff:
         return GraphDefImporter(graph_def, input_shapes,
-                                while_max_iterations).run()
+                                while_max_iterations,
+                                outputs=outputs).run()
 
     importGraph = import_graph

@@ -31,7 +31,8 @@ port 1 is the true branch, port 0 the false branch.
 """
 from __future__ import annotations
 
-from typing import Dict, List, Optional, Sequence, Set, Tuple
+from typing import (AbstractSet, Dict, List, Optional, Sequence, Set,
+                    Tuple)
 
 from deeplearning4j_tpu.modelimport.tensorflow.protobuf import (
     Attr, FunctionDef, NodeDef)
@@ -74,13 +75,16 @@ def _err(msg: str) -> _Irreducible:
         f"TFGraphMapper frame reconstruction)")
 
 
-def deframe(nodes: List[NodeDef], functions: Dict[str, FunctionDef]
-            ) -> List[NodeDef]:
+def deframe(nodes: List[NodeDef], functions: Dict[str, FunctionDef],
+            keep: AbstractSet[str] = frozenset()) -> List[NodeDef]:
     """Rewrite all v1 while frames and Switch/Merge conds in ``nodes``
     into functional While/If nodes.  Registers synthetic FunctionDefs
-    into ``functions`` (mutated).  Returns the new node list."""
-    nodes = _deframe_whiles(nodes, functions)
-    nodes = _deframe_conds(nodes, functions)
+    into ``functions`` (mutated).  Returns the new node list.
+    ``keep`` names requested graph outputs (fetches): they are never
+    removed by the dead-node sweep even if rewriting swallowed their
+    last consumer."""
+    nodes = _deframe_whiles(nodes, functions, keep)
+    nodes = _deframe_conds(nodes, functions, keep=keep)
     return _sweep_dead_v1(nodes)
 
 
@@ -260,7 +264,9 @@ def _frame_structure(enters: List[NodeDef], nodes: List[NodeDef],
 
 
 def _deframe_whiles(nodes: List[NodeDef],
-                    functions: Dict[str, FunctionDef]) -> List[NodeDef]:
+                    functions: Dict[str, FunctionDef],
+                    keep: AbstractSet[str] = frozenset()
+                    ) -> List[NodeDef]:
     while True:
         frames: Dict[str, List[NodeDef]] = {}
         for n in nodes:
@@ -278,7 +284,7 @@ def _deframe_whiles(nodes: List[NodeDef],
             plan = _plan_while(fname, enters, nodes, by_name, consumers)
             if plan is None:        # nested frame inside — do it first
                 continue
-            nodes = _apply_while(plan, nodes, functions, by_name)
+            nodes = _apply_while(plan, nodes, functions, by_name, keep)
             progressed = True
             break                   # rebuild maps, rescan
         if not progressed:
@@ -304,7 +310,8 @@ def _plan_while(fname, enters, nodes, by_name, consumers):
             body_slice)
 
 
-def _apply_while(plan, nodes, functions, by_name):
+def _apply_while(plan, nodes, functions, by_name,
+                 keep: AbstractSet[str] = frozenset()):
     (fname, loop_vars, const_enters, loopcond, cond_slice,
      body_slice) = plan
     n_lv, n_inv = len(loop_vars), len(const_enters)
@@ -397,10 +404,11 @@ def _apply_while(plan, nodes, functions, by_name):
     if anchor == len(nodes):
         out.append(while_node)
         out.extend(aliases)
-    return _check_no_dangling(out, removed, nodes)
+    return _check_no_dangling(out, removed, nodes, keep)
 
 
-def _check_no_dangling(nodes, removed, original):
+def _check_no_dangling(nodes, removed, original,
+                       keep: AbstractSet[str] = frozenset()):
     """Post-rewrite integrity pass.  Two cleanups cascade to a
     fixpoint: (a) pivot residue — Switch/Identity/Const chains with
     dangling references into the swallowed structure; (b) DEAD nodes:
@@ -422,7 +430,8 @@ def _check_no_dangling(nodes, removed, original):
                         and _node_of(r) not in live_ok]
             dead = (n.name in orig_consumed
                     and n.name not in consumed_now
-                    and n.op != "Placeholder")   # feeds stay
+                    and n.op != "Placeholder"    # feeds stay
+                    and n.name not in keep)      # fetches stay
             cascadable = (n.op in _SWITCH
                           or n.op in ("Identity", "Const"))
             if (dangling and cascadable) or dead:
@@ -585,7 +594,8 @@ def _backslice_stop_switch(roots, by_name):
 
 def _deframe_conds(nodes: List[NodeDef],
                    functions: Dict[str, FunctionDef],
-                   pivot_lookup: Optional[Dict[str, NodeDef]] = None
+                   pivot_lookup: Optional[Dict[str, NodeDef]] = None,
+                   keep: AbstractSet[str] = frozenset()
                    ) -> List[NodeDef]:
     while True:
         by_name = {n.name: n for n in nodes}
@@ -613,7 +623,8 @@ def _deframe_conds(nodes: List[NodeDef],
         for pred in sorted(plans):
             by_name = {n.name: n for n in nodes}
             group = _independent_subgroup(plans[pred], by_name)
-            nodes = _apply_cond(group, nodes, functions, by_name)
+            nodes = _apply_cond(group, nodes, functions, by_name,
+                                keep)
 
 
 def _independent_subgroup(group: List[_CondMerge], by_name
@@ -640,7 +651,8 @@ def _independent_subgroup(group: List[_CondMerge], by_name
     return indep
 
 
-def _apply_cond(group: List[_CondMerge], nodes, functions, by_name):
+def _apply_cond(group: List[_CondMerge], nodes, functions, by_name,
+                keep: AbstractSet[str] = frozenset()):
     node_order = {n.name: k for k, n in enumerate(nodes)}
     switch_names = sorted({nm for cm in group
                            for port in (0, 1)
@@ -713,7 +725,7 @@ def _apply_cond(group: List[_CondMerge], nodes, functions, by_name):
         if n.name in removed:
             continue
         out.append(n)
-    return _check_no_dangling(out, removed, nodes)
+    return _check_no_dangling(out, removed, nodes, keep)
 
 
 # -- final sweep -------------------------------------------------------------

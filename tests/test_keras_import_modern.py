@@ -130,6 +130,31 @@ class TestMultiHeadAttention:
         x = R.randn(3, 5, 8).astype(np.float32)
         _compare_functional(model, x, tmp_path)
 
+    def test_value_dim_mismatch_rejected(self, tmp_path):
+        """value_dim != key_dim cannot be expressed by the one-head-
+        size SelfAttentionLayer; a silent import would leave the
+        layer config inconsistent with the loaded Wv/Wo shapes."""
+        inp = keras.layers.Input((6, 16))
+        y = keras.layers.MultiHeadAttention(
+            num_heads=2, key_dim=8, value_dim=4)(inp, inp)
+        model = keras.Model(inp, y)
+        path = str(tmp_path / "model.keras")
+        model.save(path)
+        with pytest.raises(InvalidKerasConfigurationException,
+                           match="value_dim"):
+            KerasModelImport.import_keras_model_and_weights(path)
+
+    def test_value_dim_equal_key_dim_ok(self, tmp_path):
+        """An explicit value_dim == key_dim is fine (it IS the
+        uniform-head-size form)."""
+        inp = keras.layers.Input((6, 16))
+        y = keras.layers.MultiHeadAttention(
+            num_heads=2, key_dim=8, value_dim=8)(inp, inp)
+        y = keras.layers.GlobalAveragePooling1D()(y)
+        model = keras.Model(inp, y)
+        x = R.randn(2, 6, 16).astype(np.float32)
+        _compare_functional(model, x, tmp_path)
+
 
 class TestGroupNormalization:
     @pytest.mark.parametrize("groups", [2, 1, -1])

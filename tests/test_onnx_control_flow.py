@@ -106,6 +106,53 @@ class TestLoop:
             cond = v.sum() < 40.0
         np.testing.assert_allclose(got, v, rtol=1e-5)
 
+    def test_for_loop_form_ignores_body_cond(self):
+        """For-loop form (M given, cond input ABSENT): the spec says
+        the body's cond output is IGNORED — a valid model whose body
+        emits a non-true cond placeholder must still run all M trips
+        (round-3 advisor finding: it used to terminate after one)."""
+        body = encode_graph(
+            [encode_node("Not", ["c_in"], ["c_out"], "ci"),
+             encode_node("Mul", ["v_in", "scale"], ["vs"], "m"),
+             encode_node("Add", ["vs", "x"], ["v_out"], "a")],
+            {"scale": np.float32(1.1)},
+            [encode_value_info("i", ()),
+             encode_value_info("c_in", ()),
+             encode_value_info("v_in", (2,))],
+            [encode_value_info("c_out", ()),
+             encode_value_info("v_out", (2,))])
+        inits = {"M": np.asarray(4, np.int64),
+                 "v0": np.float32([1.0, 2.0])}
+        nodes = [encode_node("Loop", ["M", "", "v0"], ["vf"], "loop",
+                             body=GraphAttr(body))]
+        m = _model(nodes, inits, [("x", (2,))], [("vf", (2,))])
+        imp = import_onnx(m)
+        xv = np.float32([0.5, -0.25])
+        got = np.asarray(imp.output({"x": xv})[0])
+        v = np.float32([1.0, 2.0])
+        for _ in range(4):
+            v = v * np.float32(1.1) + xv
+        np.testing.assert_allclose(got, v, rtol=1e-5)
+
+    def test_no_trip_count_no_cond_rejected(self):
+        """Neither M nor cond = the spec's infinite-loop form, which
+        cannot lower to a bounded program — must raise loudly."""
+        body = encode_graph(
+            [encode_node("Identity", ["c_in"], ["c_out"], "ci"),
+             encode_node("Add", ["v_in", "x"], ["v_out"], "a")],
+            {},
+            [encode_value_info("i", ()),
+             encode_value_info("c_in", ()),
+             encode_value_info("v_in", (2,))],
+            [encode_value_info("c_out", ()),
+             encode_value_info("v_out", (2,))])
+        nodes = [encode_node("Loop", ["", "", "v0"], ["vf"], "loop",
+                             body=GraphAttr(body))]
+        m = _model(nodes, {"v0": np.float32([1.0, 2.0])},
+                   [("x", (2,))], [("vf", (2,))])
+        with pytest.raises(NotImplementedError, match="infinite"):
+            import_onnx(m)
+
     def _scan_model(self):
         body = encode_graph(
             [encode_node("Identity", ["c_in"], ["c_out"], "ci"),
@@ -150,6 +197,46 @@ class TestLoop:
         want = np.cumsum(xs, axis=0) + np.float32([0.0, 10.0])
         np.testing.assert_allclose(ys, want, rtol=1e-5)
         np.testing.assert_allclose(sf, want[-1], rtol=1e-5)
+
+    def test_scan_symbolic_length_rejected(self):
+        """A symbolic scan-input length parses as -1; it must hit the
+        intended NotImplementedError, not np.zeros((-1,...))'s
+        confusing ValueError (round-3 advisor finding).  Exercised at
+        the mapping level with a ctx whose shape lookup yields -1 —
+        the shape a symbolic dim_param decodes to."""
+        from deeplearning4j_tpu.modelimport.onnx.mappings import (
+            ONNX_OP_MAP)
+        from deeplearning4j_tpu.modelimport.onnx.protobuf import (
+            parse_graph)
+        body = encode_graph(
+            [encode_node("Add", ["s_in", "x_t"], ["s_out"], "a"),
+             encode_node("Identity", ["s_out"], ["y_t"], "i")],
+            {},
+            [encode_value_info("s_in", (2,)),
+             encode_value_info("x_t", (2,))],
+            [encode_value_info("s_out", (2,)),
+             encode_value_info("y_t", (2,))])
+        g = parse_graph(encode_graph(
+            [encode_node("Scan", ["s0", "xs"], ["sf", "ys"],
+                         "scan", num_scan_inputs=1,
+                         body=GraphAttr(body))],
+            {}, [encode_value_info("s0", (2,)),
+                 encode_value_info("xs", (-1, 2))],
+            [encode_value_info("sf", (2,)),
+             encode_value_info("ys", (-1, 2))]))
+        scan_node = g.nodes[0]
+        assert scan_node.op == "Scan"
+
+        class _Ctx:
+            def var(self, name):
+                return name
+
+            def shape_of(self, name):
+                return {"s0": (2,), "xs": (-1, 2)}[name]
+
+        with pytest.raises(NotImplementedError,
+                           match="static and uniform"):
+            ONNX_OP_MAP["Scan"](_Ctx(), scan_node)
 
     def test_scan_outputs_stack_per_iteration(self):
         """Scan outputs accumulate into a dense [M, elem] tensor (the
