@@ -326,10 +326,13 @@ def encode_node(op_type: str, inputs: Sequence[str],
 def encode_value_info(name: str, shape: Sequence[int],
                       dtype=np.float32) -> bytes:
     # a negative dim encodes as a SYMBOLIC dim_param (what real
-    # exporters emit for unknown dims; parse_value_info maps it to -1)
-    dims = b"".join(_len_field(1, (_len_field(2, b"N") if d < 0
-                                   else _int_field(1, d)))
-                    for d in shape)
+    # exporters emit for unknown dims; parse_value_info maps it to
+    # -1).  One symbol per position — a shared dim_param would assert
+    # the unknown dims are EQUAL.
+    dims = b"".join(
+        _len_field(1, (_len_field(2, f"N{i}".encode()) if d < 0
+                       else _int_field(1, d)))
+        for i, d in enumerate(shape))
     tshape = _len_field(2, dims)
     tensor_type = _int_field(1, NP_TO_ONNX[np.dtype(dtype)]) + tshape
     type_proto = _len_field(1, tensor_type)

@@ -153,9 +153,12 @@ class GraphDefImporter:
         self.var_map: Dict[str, SDVariable] = {}
         self.avals: Dict[str, jax.ShapeDtypeStruct] = {}
         self.placeholders: List[str] = []
-        #: requested fetches; None = infer terminals after import
-        self.requested_outputs = ([_node_of(o) for o in outputs]
-                                  if outputs else None)
+        #: requested fetches; None = infer terminals after import.
+        #: ':0' normalizes to the bare name (var_map keys the FIRST
+        #: output bare, 'name:i' for the rest — see _bind)
+        self.requested_outputs = (
+            [o[:-2] if o.endswith(":0") else o for o in outputs]
+            if outputs else None)
         self.outputs: List[str] = []
 
     # -- name/value plumbing ------------------------------------------
@@ -328,7 +331,8 @@ class GraphDefImporter:
             # functional While/If, which lower to lax below
             self.nodes = v1_control_flow.deframe(
                 self.nodes, self.functions,
-                keep=frozenset(self.requested_outputs or ()))
+                keep=frozenset(_node_of(o) for o in
+                               (self.requested_outputs or ())))
         _resolve_tensor_lists(self.nodes)
         by_name = {n.name: n for n in self.nodes}
         order = _topo_sort(self.nodes, by_name)

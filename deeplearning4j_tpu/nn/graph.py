@@ -443,6 +443,97 @@ class ComputationGraph:
             out = out[0]
         return np.asarray(jnp.argmax(out, axis=-1))
 
+    # -- stateful streaming inference (SURVEY.md section 5.7;
+    #    reference: ComputationGraph.rnnTimeStep) -----------------------
+    def rnn_time_step(self, *inputs):
+        """Feed one step (2D inputs) or a chunk (3D inputs) of a
+        sequence through the DAG, carrying every recurrent vertex's
+        hidden state across calls (reference: rnnTimeStep).  2D
+        inputs get 2D outputs (the last timestep); 3D chunks return
+        full per-step activations."""
+        from deeplearning4j_tpu.nn.conf.layers_recurrent import (
+            Bidirectional)
+        for n in self._topo:
+            v = self.conf.vertices[n]
+            if v.is_layer and isinstance(v.content, Bidirectional):
+                # reference throws too: the backward direction needs
+                # future timesteps, which streaming cannot provide
+                raise ValueError(
+                    "rnnTimeStep is not supported on graphs with "
+                    "Bidirectional layers")
+        if not self._initialized:
+            self.init()
+        xs = [_as_jnp(x, self._dtype) for x in inputs]
+        # only RECURRENT inputs get the step-dim treatment: a graph
+        # can also carry genuinely feed-forward inputs (e.g. static
+        # metadata merged after LastTimeStep) that must pass through
+        # 2D, exactly as output() passes them
+        from deeplearning4j_tpu.nn.conf.inputs import InputTypeRecurrent
+        rec = [isinstance(t, InputTypeRecurrent)
+               for t in self.conf.input_types] or [True] * len(xs)
+        if len(rec) != len(xs):
+            raise ValueError(
+                f"rnnTimeStep got {len(xs)} inputs for "
+                f"{len(rec)} declared network inputs")
+        single_step = all(x.ndim == 2 for x, r in zip(xs, rec) if r)
+        xs = [x[:, None, :] if r and x.ndim == 2 else x
+              for x, r in zip(xs, rec)]
+        batch = int(xs[0].shape[0])
+        if getattr(self, "_rnn_stream_states", None) is None:
+            self._rnn_stream_states = self._with_zero_rnn_states(
+                self.states, batch)
+            self._rnn_stream_batch = batch
+        elif batch != self._rnn_stream_batch:
+            raise ValueError(
+                f"rnnTimeStep batch size {batch} != stored state "
+                f"batch size {self._rnn_stream_batch}; call "
+                f"rnn_clear_previous_state() first")
+        acts, new_states = self._forward(
+            self.params, self._rnn_stream_states, xs,
+            training=False, rng=None, want_logits=False)
+        # keep persistent (BN) states as-is; update only rnn carries
+        merged = dict(self._rnn_stream_states)
+        for k in self._recurrent_names():
+            merged[k] = new_states[k]
+        self._rnn_stream_states = merged
+        outs = [acts[n] for n in self.conf.network_outputs]
+        if single_step:
+            outs = [o[:, -1] if o.ndim == 3 else o for o in outs]
+        return outs[0] if len(outs) == 1 else outs
+
+    def rnn_clear_previous_state(self):
+        self._rnn_stream_states = None
+
+    def rnn_get_previous_state(self, vertex_name: str):
+        """Stored streaming state of one recurrent vertex, by name
+        (reference: rnnGetPreviousState(String))."""
+        if getattr(self, "_rnn_stream_states", None) is None:
+            return None
+        return self._rnn_stream_states.get(vertex_name)
+
+    def rnn_set_previous_state(self, vertex_name: str, state: dict):
+        """Overwrite one vertex's streaming state (reference:
+        rnnSetPreviousState).  Works on a fresh network too: the
+        batch size is inferred from the provided state arrays."""
+        if not self._initialized:
+            self.init()
+        leaves = jax.tree_util.tree_leaves(state)
+        if not leaves:
+            raise ValueError("cannot infer batch size from an "
+                             "empty state dict")
+        batch = int(leaves[0].shape[0])
+        if getattr(self, "_rnn_stream_states", None) is None:
+            self._rnn_stream_states = self._with_zero_rnn_states(
+                self.states, batch)
+            self._rnn_stream_batch = batch
+        elif batch != self._rnn_stream_batch:
+            raise ValueError(
+                f"rnnSetPreviousState batch size {batch} != stored "
+                f"state batch size {self._rnn_stream_batch}; call "
+                f"rnn_clear_previous_state() first")
+        self._rnn_stream_states = dict(self._rnn_stream_states)
+        self._rnn_stream_states[vertex_name] = state
+
     @staticmethod
     def _ds_fmask(ds):
         """First features mask, honoring both the MultiDataSet plural
