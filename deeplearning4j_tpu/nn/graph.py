@@ -80,6 +80,10 @@ class ComputationGraph:
         self.listeners = list(listeners)
         return self
 
+    def add_listeners(self, *listeners: TrainingListener):
+        self.listeners.extend(listeners)
+        return self
+
     def output_layer_confs(self) -> Dict[str, BaseOutputLayer]:
         out = {}
         for name in self.conf.network_outputs:
@@ -517,6 +521,10 @@ class ComputationGraph:
         batch size is inferred from the provided state arrays."""
         if not self._initialized:
             self.init()
+        if vertex_name not in self._recurrent_names():
+            raise ValueError(
+                f"'{vertex_name}' is not a recurrent vertex "
+                f"(recurrent: {self._recurrent_names()})")
         leaves = jax.tree_util.tree_leaves(state)
         if not leaves:
             raise ValueError("cannot infer batch size from an "

@@ -129,10 +129,20 @@ class SharedTrainingMaster:
         return self._mesh
 
     # ------------------------------------------------------------------
-    def fit(self, model, iterator, *, n_epochs: int = 1):
+    def fit(self, model, iterator, *, n_epochs: int = 1,
+            checkpoint_dir=None, save_every_n_epochs: int = 1,
+            keep_last: int = 3):
         """fit(model, DataSetIterator). Each process iterates its LOCAL
         data partition (the analogue of an executor's RDD partition);
-        arrays are assembled into globally-sharded batches."""
+        arrays are assembled into globally-sharded batches.
+
+        With ``checkpoint_dir`` the multi-host save/resume discipline
+        (SURVEY.md §5.4) is active: if checkpoints exist there the
+        model is RESUMED on every process (same bytes, shared fs) and
+        only the remaining epochs run; process 0 writes asynchronous
+        atomic checkpoints every ``save_every_n_epochs`` behind a
+        world barrier, so a killed job re-run with the same arguments
+        converges to the same state as an uncrashed one."""
         self._ensure_distributed()
         if self.config.threshold_algorithm is not None:
             log.info("threshold_algorithm accepted for API parity but the "
@@ -140,14 +150,39 @@ class SharedTrainingMaster:
                      "(BASELINE north star); see parallel.encoding for the "
                      "compression transform")
         mesh = self._global_mesh()
-        pw = ParallelWrapper(model, mesh)
-        if jax.process_count() == 1:
-            pw.fit(iterator, n_epochs=n_epochs)
-            return model
-        # multi-host: same epoch loop, batches assembled globally from
-        # each process's local shard
-        pw.run_epochs(iterator, n_epochs,
-                      lambda ds: self._make_global(mesh, ds))
+        mgr = None
+        if checkpoint_dir is not None:
+            from deeplearning4j_tpu.utils.checkpoint import (
+                MultiHostCheckpointListener, MultiHostCheckpointManager)
+            mgr = MultiHostCheckpointManager(checkpoint_dir,
+                                             keep_last=keep_last)
+            if mgr.restore_into(model):
+                log.info("resumed from %s at epoch %d",
+                         checkpoint_dir, model.epoch_count)
+                # n_epochs is the TOTAL target for a RESUMED job only:
+                # a warm-started model (epoch_count from elsewhere,
+                # nothing restored here) still trains n_epochs
+                n_epochs = n_epochs - model.epoch_count
+            lis = MultiHostCheckpointListener(mgr, save_every_n_epochs)
+            model.add_listeners(lis)
+            if n_epochs <= 0:
+                log.info("fit: %d epochs already done",
+                         model.epoch_count)
+                model.listeners.remove(lis)
+                return model
+        try:
+            pw = ParallelWrapper(model, mesh)
+            if jax.process_count() == 1:
+                pw.fit(iterator, n_epochs=n_epochs)
+            else:
+                # multi-host: same epoch loop, batches assembled
+                # globally from each process's local shard
+                pw.run_epochs(iterator, n_epochs,
+                              lambda ds: self._make_global(mesh, ds))
+        finally:
+            if mgr is not None:
+                model.listeners.remove(lis)
+                mgr.flush()
         return model
 
     def _make_global(self, mesh, ds):

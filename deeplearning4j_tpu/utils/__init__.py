@@ -1,3 +1,4 @@
 from deeplearning4j_tpu.utils.serializer import ModelSerializer  # noqa: F401
 from deeplearning4j_tpu.utils.checkpoint import (  # noqa: F401
-    CheckpointListener, FaultTolerantTrainer)
+    CheckpointListener, FaultTolerantTrainer,
+    MultiHostCheckpointListener, MultiHostCheckpointManager)

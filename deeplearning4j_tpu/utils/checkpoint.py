@@ -15,6 +15,7 @@ state, iteration count, and epoch count intact.
 """
 from __future__ import annotations
 
+import concurrent.futures
 import logging
 import os
 import re
@@ -22,17 +23,51 @@ import time
 from pathlib import Path
 from typing import List, Optional
 
+import jax
+
 from deeplearning4j_tpu.optimize.listeners import TrainingListener
 from deeplearning4j_tpu.utils.serializer import ModelSerializer
 
 log = logging.getLogger("deeplearning4j_tpu")
 
 
+class _ModelSnapshot:
+    """Host-side copy of everything ``write_model`` reads, taken
+    synchronously at save time so the training loop can keep mutating
+    the live model while the background thread serializes."""
+
+    class _ConfShim:
+        def __init__(self, conf_json: str):
+            self._json = conf_json
+
+        def to_json(self) -> str:
+            return self._json
+
+    def __init__(self, model):
+        self.model_class = type(model).__name__
+        self.conf = _ModelSnapshot._ConfShim(model.conf.to_json())
+        # device->host transfers (the only part the step loop waits on)
+        self.params = jax.device_get(model.params)
+        self.states = jax.device_get(model.states)
+        self.updater_states = jax.device_get(model.updater_states)
+        self.iteration_count = model.iteration_count
+        self.epoch_count = model.epoch_count
+
+
 class CheckpointListener(TrainingListener):
+    """``asynchronous=True`` (default, SURVEY.md §5.4's "async
+    multi-host checkpointing" prescription): ``_save`` snapshots the
+    model device->host and hands serialization + the atomic rename to
+    a background thread, so the step loop never blocks on IO.  At most
+    ONE write is in flight; a new save first joins the previous one
+    (bounded memory, strict file ordering).  Call :meth:`flush` before
+    reading checkpoints from disk."""
+
     def __init__(self, save_dir, *, save_every_n_iterations: int = 0,
                  save_every_n_epochs: int = 0,
                  save_every_n_seconds: float = 0.0,
-                 keep_last: int = 0, keep_every: int = 0):
+                 keep_last: int = 0, keep_every: int = 0,
+                 asynchronous: bool = True):
         self.dir = Path(save_dir)
         self.dir.mkdir(parents=True, exist_ok=True)
         self.n_iter = save_every_n_iterations
@@ -40,20 +75,66 @@ class CheckpointListener(TrainingListener):
         self.n_seconds = save_every_n_seconds
         self.keep_last = keep_last
         self.keep_every = keep_every
+        self.asynchronous = asynchronous
         self._last_save_time = time.time()
         self._saved: List[Path] = []
         self._counter = 0
+        self._executor = None
+        self._pending: Optional[concurrent.futures.Future] = None
+
+    def _write(self, snapshot, tmp: Path, path: Path):
+        ModelSerializer.write_model(
+            snapshot, tmp, model_class=snapshot.model_class)
+        os.replace(tmp, path)  # atomic: readers never see partials
+        self._rotate()
 
     def _save(self, model):
+        self.flush()     # join the previous in-flight write FIRST:
+        # the worker's _rotate reassigns self._saved, so bookkeeping
+        # below must not race it
         path = self.dir / f"checkpoint_{self._counter}.zip"
         tmp = self.dir / f".checkpoint_{self._counter}.zip.tmp"
-        ModelSerializer.write_model(model, tmp)
-        os.replace(tmp, path)      # atomic: readers never see partials
         self._counter += 1
         self._saved.append(path)
         self._last_saved_state = (model.iteration_count,
                                   model.epoch_count)
-        self._rotate()
+        if not self.asynchronous:
+            self._write(_ModelSnapshot(model), tmp, path)
+            return
+        snap = _ModelSnapshot(model)
+        if self._executor is None:
+            self._executor = concurrent.futures.ThreadPoolExecutor(
+                max_workers=1,
+                thread_name_prefix="dl4j-tpu-ckpt")
+        self._pending = self._executor.submit(self._write, snap, tmp,
+                                              path)
+
+    def flush(self):
+        """Join the in-flight background write (reference analogue:
+        orbax ``wait_until_finished``), then park the worker thread.
+        Re-raises a failed write's exception."""
+        if self._pending is not None:
+            pending, self._pending = self._pending, None
+            try:
+                pending.result()
+            finally:
+                if self._executor is not None:
+                    # no non-daemon thread outlives the save burst; a
+                    # later save recreates the executor
+                    self._executor.shutdown(wait=True)
+                    self._executor = None
+
+    def resume_numbering(self, save_dir=None):
+        """Continue checkpoint numbering after whatever an earlier
+        (crashed) run left in ``save_dir`` — the one place the
+        filename pattern is decoded for resume."""
+        existing = CheckpointListener.available_checkpoints(
+            save_dir if save_dir is not None else self.dir)
+        if existing:
+            m = re.match(r"checkpoint_(\d+)\.zip$", existing[-1].name)
+            self._counter = int(m.group(1)) + 1
+            self._saved = list(existing)
+        return self
 
     def _rotate(self):
         if self.keep_last <= 0:
@@ -145,7 +226,7 @@ class FaultTolerantTrainer:
     def __init__(self, model_factory, save_dir, *,
                  save_every_n_iterations: int = 0,
                  save_every_n_epochs: int = 1,
-                 keep_last: int = 3):
+                 keep_last: int = 3, asynchronous: bool = True):
         self.save_dir = Path(save_dir)
         restored = None
         if CheckpointListener.available_checkpoints(self.save_dir):
@@ -157,14 +238,9 @@ class FaultTolerantTrainer:
             self.save_dir,
             save_every_n_iterations=save_every_n_iterations,
             save_every_n_epochs=save_every_n_epochs,
-            keep_last=keep_last)
+            keep_last=keep_last, asynchronous=asynchronous)
         # continue numbering after existing checkpoints
-        existing = CheckpointListener.available_checkpoints(
-            self.save_dir)
-        if existing:
-            m = re.match(r"checkpoint_(\d+)\.zip$", existing[-1].name)
-            self._listener._counter = int(m.group(1)) + 1
-            self._listener._saved = list(existing)
+        self._listener.resume_numbering()
         self.model.add_listeners(self._listener)
 
     def fit(self, data, *, n_epochs: int = 1):
@@ -182,4 +258,107 @@ class FaultTolerantTrainer:
         state = (self.model.iteration_count, self.model.epoch_count)
         if getattr(self._listener, "_last_saved_state", None) != state:
             self._listener._save(self.model)
+        self._listener.flush()   # checkpoints durable before return
         return self.model
+
+
+class MultiHostCheckpointManager:
+    """Save/resume discipline for a multi-process (jax.distributed)
+    world — SURVEY.md §5.4's "async multi-host checkpointing"
+    prescription, which the reference's Spark masters get from the
+    driver being the single writer.
+
+    Discipline: params are replicated-identical on every process by
+    construction (exact synchronous DP — the in-step collectives mean
+    no process's step completes before its peers'), so exactly ONE
+    process (index 0) writes; a named barrier per ``save`` keeps the
+    world aligned on HOW MANY checkpoints exist, and :meth:`flush`
+    barriers AFTER the write so no process proceeds believing a
+    checkpoint exists before its atomic rename landed.  Resume loads
+    the same bytes on ALL processes (shared filesystem, the TPU-pod
+    norm)."""
+
+    def __init__(self, save_dir, *, keep_last: int = 3,
+                 asynchronous: bool = True):
+        self.save_dir = Path(save_dir)
+        self.listener = CheckpointListener(
+            save_dir, keep_last=keep_last,
+            asynchronous=asynchronous).resume_numbering()
+
+    @staticmethod
+    def _barrier(name: str):
+        if jax.process_count() > 1:
+            from jax.experimental import multihost_utils
+            multihost_utils.sync_global_devices(name)
+
+    def save(self, model):
+        """Barrier, then process 0 snapshots + writes (async).  The
+        barrier name uses a manager-level counter that advances on
+        EVERY process (the listener's write counter only moves on the
+        writer, and barrier names must agree world-wide).
+
+        A write failure on process 0 (disk full, permissions) must
+        not become a whole-world hang: the error is held until AFTER
+        the barrier, so peers proceed and process 0 raises visibly."""
+        n = getattr(self, "_save_calls", 0)
+        self._save_calls = n + 1
+        err = None
+        if jax.process_index() == 0:
+            try:
+                # listener._save's internal flush() can re-raise the
+                # PREVIOUS write's failure — catch it here too
+                self.listener._save(model)
+            except Exception as e:    # noqa: BLE001 — re-raised below
+                err = e
+        self._barrier(f"dl4j_ckpt_save_{n}")
+        if err is not None:
+            raise err
+
+    def flush(self):
+        """Join process 0's in-flight write, then barrier so every
+        process observes the checkpoint as durable.  As in ``save``,
+        a writer-side failure surfaces after the barrier instead of
+        deadlocking the world."""
+        err = None
+        if jax.process_index() == 0:
+            try:
+                self.listener.flush()
+            except Exception as e:    # noqa: BLE001 — re-raised below
+                err = e
+        self._barrier("dl4j_ckpt_flush")
+        if err is not None:
+            raise err
+
+    def restore_into(self, model) -> bool:
+        """Load the newest loadable checkpoint on EVERY process and
+        copy its state into ``model`` (params, persistent states,
+        updater state, counters).  Returns True if restored."""
+        self._barrier("dl4j_ckpt_restore")
+        if not CheckpointListener.available_checkpoints(self.save_dir):
+            return False
+        restored = CheckpointListener.load_checkpoint(self.save_dir)
+        if restored is None:
+            return False
+        if not model._initialized:
+            model.init()
+        model.params = restored.params
+        model.states = restored.states
+        model.updater_states = restored.updater_states
+        model.iteration_count = restored.iteration_count
+        model.epoch_count = restored.epoch_count
+        return True
+
+
+class MultiHostCheckpointListener(TrainingListener):
+    """Epoch-cadence hook driving a :class:`MultiHostCheckpointManager`
+    from inside a training loop — every process runs it (the barrier
+    in ``save`` needs all of them), only process 0 writes."""
+
+    def __init__(self, manager: MultiHostCheckpointManager,
+                 save_every_n_epochs: int = 1):
+        self.manager = manager
+        self.n_epoch = max(1, int(save_every_n_epochs))
+
+    def on_epoch_end(self, model):
+        if model.epoch_count % self.n_epoch == 0:
+            self.manager.save(model)

@@ -60,8 +60,10 @@ def _read_npz(zf: zipfile.ZipFile, name: str) -> dict:
 class ModelSerializer:
     @staticmethod
     def write_model(model, path, save_updater: bool = True,
-                    normalizer=None):
-        """model: MultiLayerNetwork or ComputationGraph."""
+                    normalizer=None, model_class: str = None):
+        """model: MultiLayerNetwork or ComputationGraph (or a host
+        snapshot shim exposing the same attrs; ``model_class`` then
+        names the real class for restore dispatch)."""
         path = Path(path)
         path.parent.mkdir(parents=True, exist_ok=True)
         with zipfile.ZipFile(path, "w", zipfile.ZIP_DEFLATED) as zf:
@@ -76,7 +78,7 @@ class ModelSerializer:
                 zf.writestr(NORMALIZER_ENTRY,
                             json.dumps(normalizer.to_map()))
             zf.writestr(META_ENTRY, json.dumps({
-                "model_class": type(model).__name__,
+                "model_class": model_class or type(model).__name__,
                 "iteration_count": model.iteration_count,
                 "epoch_count": model.epoch_count,
                 "format_version": 1,

@@ -45,6 +45,7 @@ class TestCheckpointAccessors:
         net.set_listeners(lis)
         for _ in range(6):
             net.fit(x, y)
+        lis.flush()         # async default: join the background write
         cps = CheckpointListener.available_checkpoints(tmp_path)
         assert len(cps) == 3
         assert CheckpointListener.last_checkpoint_in(tmp_path) == cps[-1]
@@ -58,6 +59,7 @@ class TestCheckpointAccessors:
         net.set_listeners(lis)
         for _ in range(4):
             net.fit(x, y)
+        lis.flush()
         cps = CheckpointListener.available_checkpoints(tmp_path)
         assert len(cps) == 2
         # simulate crash-truncated newest checkpoint
@@ -156,3 +158,81 @@ class TestFaultTolerantTrainer:
 def _ds(x, y):
     from deeplearning4j_tpu.datasets.dataset import DataSet
     return DataSet(x, y)
+
+
+class TestAsyncCheckpointing:
+    """Round-3 verdict ask #5: the step loop must not block on
+    serialize+write — _save snapshots device->host and a background
+    thread does the IO; flush() joins it."""
+
+    def test_async_snapshot_is_consistent_under_further_training(
+            self, tmp_path):
+        """The checkpoint must hold the state AT SAVE TIME even though
+        training keeps mutating the live model while the background
+        thread serializes."""
+        net = _factory()
+        x, y = _data()
+        for _ in range(3):
+            net.fit(x, y)
+        lis = CheckpointListener(tmp_path, asynchronous=True)
+        import jax as _jax
+        at_save = [np.asarray(v) for v in
+                   _jax.tree_util.tree_leaves(_jax.device_get(
+                       net.params))]
+        it_at_save = net.iteration_count
+        lis._save(net)
+        for _ in range(5):          # keep training during the write
+            net.fit(x, y)
+        lis.flush()
+        restored = CheckpointListener.load_checkpoint(tmp_path)
+        assert restored.iteration_count == it_at_save
+        got = [np.asarray(v) for v in
+               _jax.tree_util.tree_leaves(restored.params)]
+        for a, b in zip(got, at_save):
+            np.testing.assert_array_equal(a, b)
+
+    def test_async_equals_sync_bytes_semantics(self, tmp_path):
+        net = _factory()
+        x, y = _data()
+        net.fit(x, y)
+        sync_dir, async_dir = tmp_path / "s", tmp_path / "a"
+        ls = CheckpointListener(sync_dir, asynchronous=False)
+        la = CheckpointListener(async_dir, asynchronous=True)
+        ls._save(net)
+        la._save(net)
+        la.flush()
+        rs = CheckpointListener.load_checkpoint(sync_dir)
+        ra = CheckpointListener.load_checkpoint(async_dir)
+        import jax as _jax
+        for a, b in zip(_jax.tree_util.tree_leaves(rs.params),
+                        _jax.tree_util.tree_leaves(ra.params)):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+        assert rs.iteration_count == ra.iteration_count
+
+    def test_rotation_works_async(self, tmp_path):
+        net = _factory()
+        x, y = _data()
+        lis = CheckpointListener(tmp_path, save_every_n_iterations=1,
+                                 keep_last=2, asynchronous=True)
+        net.set_listeners(lis)
+        for _ in range(5):
+            net.fit(x, y)
+        lis.flush()
+        assert len(CheckpointListener.available_checkpoints(
+            tmp_path)) == 2
+
+    def test_flush_propagates_write_errors(self, tmp_path):
+        net = _factory()
+        x, y = _data()
+        net.fit(x, y)
+        lis = CheckpointListener(tmp_path / "d", asynchronous=True)
+        import shutil
+        lis._save(net)
+        lis.flush()
+        # break the target dir, then save again: the error must not
+        # vanish into the background thread
+        shutil.rmtree(tmp_path / "d")
+        (tmp_path / "d").write_text("not a dir")
+        lis._save(net)
+        with pytest.raises(Exception):
+            lis.flush()

@@ -140,3 +140,138 @@ def test_two_process_shared_training(tmp_path):
                   _jax.tree_util.tree_leaves(ref.params)]
     for k, want in zip(a.files, ref_leaves):
         np.testing.assert_allclose(a[k], want, rtol=1e-4, atol=1e-5)
+
+
+_CKPT_WORKER = textwrap.dedent('''
+import sys
+import jax
+pid, n_proc, port, outdir, total_epochs = (
+    int(sys.argv[1]), int(sys.argv[2]), sys.argv[3], sys.argv[4],
+    int(sys.argv[5]))
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_cpu_collectives_implementation", "gloo")
+jax.distributed.initialize(f"127.0.0.1:{port}", num_processes=n_proc,
+                           process_id=pid)
+
+import numpy as np
+from deeplearning4j_tpu.activations import Activation
+from deeplearning4j_tpu.datasets.dataset import DataSet
+from deeplearning4j_tpu.learning import Sgd
+from deeplearning4j_tpu.lossfunctions import LossFunction
+from deeplearning4j_tpu.nn import (InputType, MultiLayerNetwork,
+                                   NeuralNetConfiguration)
+from deeplearning4j_tpu.nn.conf.layers import DenseLayer, OutputLayer
+from deeplearning4j_tpu.parallel.sharedtraining import \\
+    SharedTrainingMaster
+
+conf = (NeuralNetConfiguration.Builder()
+        .seed(7).updater(Sgd(1e-1))
+        .list()
+        .layer(DenseLayer(n_out=8, activation=Activation.TANH))
+        .layer(OutputLayer(n_out=2, loss_function=LossFunction.MCXENT,
+                           activation=Activation.SOFTMAX))
+        .set_input_type(InputType.feed_forward(4)).build())
+net = MultiLayerNetwork(conf).init()
+
+rng = np.random.RandomState(100 + pid)
+batches = [DataSet(rng.randn(8, 4).astype(np.float32),
+                   np.eye(2, dtype=np.float32)[rng.randint(0, 2, 8)])
+           for _ in range(3)]
+
+master = (SharedTrainingMaster.Builder(batch_size_per_worker=4)
+          .coordinator(f"127.0.0.1:{port}", n_proc, pid)
+          .build())
+master.fit(net, batches, n_epochs=total_epochs,
+           checkpoint_dir=f"{outdir}/ckpts", save_every_n_epochs=1)
+print("RESUMED_AT", pid, net.epoch_count, flush=True)
+
+leaves = jax.tree_util.tree_leaves(net.params)
+np.savez(f"{outdir}/params_{pid}.npz",
+         **{f"l{i}": np.asarray(v) for i, v in enumerate(leaves)})
+print("WORKER_DONE", pid, flush=True)
+import time; time.sleep(2)
+''')
+
+
+def _run_world(tmp_path, total_epochs, n_proc=2):
+    port = _free_port()
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
+    env["PYTHONPATH"] = os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__)))
+    procs = [subprocess.Popen(
+        [sys.executable, "-c", _CKPT_WORKER, str(i), str(n_proc),
+         str(port), str(tmp_path), str(total_epochs)],
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+        env=env) for i in range(n_proc)]
+    outs = []
+    try:
+        for p in procs:
+            out, _ = p.communicate(timeout=420)
+            outs.append(out)
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+    for i, out in enumerate(outs):
+        assert f"WORKER_DONE {i}" in out, \
+            f"worker {i} failed:\n{out[-2000:]}"
+    return outs
+
+
+def test_multihost_checkpoint_save_kill_resume(tmp_path):
+    """SURVEY.md §5.4 multi-host discipline (round-3 verdict ask #5):
+    run 1 trains 1 of 2 epochs with checkpointing and exits (the
+    "kill"); run 2 — fresh processes, same world — RESUMES from the
+    process-0-written checkpoint on both processes and trains only the
+    remaining epoch.  Final params must equal the uncrashed
+    single-process run over the concatenated data, exactly."""
+    _run_world(tmp_path, total_epochs=1)        # run 1, then "crash"
+    from deeplearning4j_tpu.utils import CheckpointListener
+    cps = CheckpointListener.available_checkpoints(
+        tmp_path / "ckpts")
+    assert cps, "process 0 must have written an epoch-1 checkpoint"
+    outs = _run_world(tmp_path, total_epochs=2)  # resumed run
+    for i, out in enumerate(outs):
+        assert f"RESUMED_AT {i} 2" in out       # 2 epochs total done
+
+    a = np.load(tmp_path / "params_0.npz")
+    b = np.load(tmp_path / "params_1.npz")
+    for k in a.files:
+        np.testing.assert_allclose(a[k], b[k], rtol=1e-6, atol=1e-7)
+
+    import jax as _jax
+    if _jax.default_backend() != "cpu":
+        return
+    from deeplearning4j_tpu.activations import Activation
+    from deeplearning4j_tpu.datasets.dataset import DataSet
+    from deeplearning4j_tpu.learning import Sgd
+    from deeplearning4j_tpu.lossfunctions import LossFunction
+    from deeplearning4j_tpu.nn import (InputType, MultiLayerNetwork,
+                                       NeuralNetConfiguration)
+    from deeplearning4j_tpu.nn.conf.layers import (DenseLayer,
+                                                   OutputLayer)
+    conf = (NeuralNetConfiguration.Builder()
+            .seed(7).updater(Sgd(1e-1))
+            .list()
+            .layer(DenseLayer(n_out=8, activation=Activation.TANH))
+            .layer(OutputLayer(n_out=2,
+                               loss_function=LossFunction.MCXENT,
+                               activation=Activation.SOFTMAX))
+            .set_input_type(InputType.feed_forward(4)).build())
+    ref = MultiLayerNetwork(conf).init()
+    rngs = [np.random.RandomState(100 + i) for i in range(2)]
+    parts = [[DataSet(r.randn(8, 4).astype(np.float32),
+                      np.eye(2, dtype=np.float32)[r.randint(0, 2, 8)])
+              for _ in range(3)] for r in rngs]
+    merged = [DataSet(np.concatenate([parts[0][j].features,
+                                      parts[1][j].features]),
+                      np.concatenate([parts[0][j].labels,
+                                      parts[1][j].labels]))
+              for j in range(3)]
+    ref.fit(merged, n_epochs=2)                  # uncrashed run
+    ref_leaves = [np.asarray(v) for v in
+                  _jax.tree_util.tree_leaves(ref.params)]
+    for k, want in zip(a.files, ref_leaves):
+        np.testing.assert_allclose(a[k], want, rtol=1e-4, atol=1e-5)
