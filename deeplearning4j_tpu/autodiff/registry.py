@@ -319,7 +319,23 @@ def _cumsum(ins, attrs):
 
 @op("cumprod", "reduce")
 def _cumprod(ins, attrs):
-    return jnp.cumprod(ins[0], axis=attrs.get("axis", -1))
+    """TF Cumprod semantics: ``exclusive`` shifts the scan by one
+    (first element 1 — the multiplicative identity), ``reverse``
+    scans from the end."""
+    x = ins[0]
+    ax = attrs.get("axis", -1) % x.ndim
+    if attrs.get("reverse", False):
+        x = jnp.flip(x, ax)
+    y = jnp.cumprod(x, axis=ax)
+    if attrs.get("exclusive", False):
+        pad = [(0, 0)] * x.ndim
+        pad[ax] = (1, 0)
+        sl = [slice(None)] * x.ndim
+        sl[ax] = slice(0, x.shape[ax])
+        y = jnp.pad(y, pad, constant_values=1)[tuple(sl)]
+    if attrs.get("reverse", False):
+        y = jnp.flip(y, ax)
+    return y
 
 
 @op("reduce_any", "reduce")

@@ -587,30 +587,60 @@ def _depth_to_space(ctx, node):
     return _block_rearrange(ctx, node, "depth_to_space")
 
 
+def _ncdhw_layout(node):
+    """NDHWC is the registry-native 3D layout; NCDHW wraps in two
+    transposes (same treatment as _block_rearrange — XLA folds the
+    layout permutations into the surrounding program).  Per-element
+    attrs (strides/ksize/dilations) arrive in the GRAPH layout, so
+    the caller permutes them with the returned index map."""
+    fmt = node.attr("data_format", b"NDHWC")
+    if fmt not in (b"NDHWC", b"NCDHW"):
+        raise NotImplementedError(f"{node.op}: data_format={fmt}")
+    return fmt == b"NCDHW"
+
+
+_NCDHW_TO_NDHWC = (0, 2, 3, 4, 1)
+_NDHWC_TO_NCDHW = (0, 4, 1, 2, 3)
+
+
 @tf_op("Conv3D")
 def _conv3d(ctx, node):
-    if node.attr("data_format", b"NDHWC") != b"NDHWC":
-        raise NotImplementedError("Conv3D: NDHWC only")
+    ncdhw = _ncdhw_layout(node)
     strides = [int(s) for s in node.attr("strides", [1] * 5)]
     dil = [int(d) for d in node.attr("dilations", [1] * 5)]
-    return ctx.sd._op(
-        "conv3d", [ctx.var(node.inputs[0]), ctx.var(node.inputs[1])],
+    x = ctx.var(node.inputs[0])
+    if ncdhw:
+        x = ctx.sd._op("transpose", [x], {"axes": _NCDHW_TO_NDHWC})
+        strides = [strides[i] for i in _NCDHW_TO_NDHWC]
+        dil = [dil[i] for i in _NCDHW_TO_NDHWC]
+    y = ctx.sd._op(
+        "conv3d", [x, ctx.var(node.inputs[1])],
         {"stride": tuple(strides[1:4]), "dilation": tuple(dil[1:4]),
          "padding": node.attr("padding", b"SAME").decode()})
+    if ncdhw:
+        y = ctx.sd._op("transpose", [y], {"axes": _NDHWC_TO_NCDHW})
+    return y
 
 
 @tf_op("MaxPool3D", "AvgPool3D")
 def _pool3d(ctx, node):
-    if node.attr("data_format", b"NDHWC") != b"NDHWC":
-        raise NotImplementedError("Pool3D: NDHWC only")
+    ncdhw = _ncdhw_layout(node)
     ks = [int(k) for k in node.attr("ksize", [1, 2, 2, 2, 1])]
     st = [int(s) for s in node.attr("strides", [1, 2, 2, 2, 1])]
+    x = ctx.var(node.inputs[0])
+    if ncdhw:
+        x = ctx.sd._op("transpose", [x], {"axes": _NCDHW_TO_NDHWC})
+        ks = [ks[i] for i in _NCDHW_TO_NDHWC]
+        st = [st[i] for i in _NCDHW_TO_NDHWC]
     opn = "max_pool3d" if node.op == "MaxPool3D" else "avg_pool3d"
-    return ctx.sd._op(opn, [ctx.var(node.inputs[0])],
-                      {"kernel": tuple(ks[1:4]),
-                       "stride": tuple(st[1:4]),
-                       "padding": node.attr("padding",
-                                            b"VALID").decode()})
+    y = ctx.sd._op(opn, [x],
+                   {"kernel": tuple(ks[1:4]),
+                    "stride": tuple(st[1:4]),
+                    "padding": node.attr("padding",
+                                         b"VALID").decode()})
+    if ncdhw:
+        y = ctx.sd._op("transpose", [y], {"axes": _NDHWC_TO_NCDHW})
+    return y
 
 
 @tf_op("ReverseV2")
@@ -622,11 +652,11 @@ def _reverse_v2(ctx, node):
 
 @tf_op("Cumprod")
 def _cumprod(ctx, node):
-    if node.attr("exclusive", False) or node.attr("reverse", False):
-        raise NotImplementedError("Cumprod: exclusive/reverse modes")
     axis = int(np.asarray(ctx.require_static(node, 1)))
     return ctx.sd._op("cumprod", [ctx.var(node.inputs[0])],
-                      {"axis": axis})
+                      {"axis": axis,
+                       "exclusive": bool(node.attr("exclusive", False)),
+                       "reverse": bool(node.attr("reverse", False))})
 
 
 @tf_op("Roll")
