@@ -1,0 +1,138 @@
+"""Distributed ETL seam: deterministic per-process sharding of a
+RecordReader/TransformProcess pipeline.
+
+Reference role: ``datavec-spark``'s distributed transform execution +
+``dl4j-spark``'s ``RDD<DataSet>`` partitioning (SURVEY.md V2/P4).  On
+a TPU pod there is no Spark: every host process reads the SAME input
+(shared filesystem, the pod norm), takes a deterministic contiguous
+shard of it, and feeds :class:`SharedTrainingMaster`'s global-batch
+assembly (``jax.make_array_from_process_local_data``).  The shard
+boundaries depend only on (record count, process count), so a
+restarted or re-run job sees identical partitions — the property
+Spark gets from deterministic RDD lineage.
+"""
+from __future__ import annotations
+
+import logging
+from typing import Optional
+
+import numpy as np
+
+from deeplearning4j_tpu.datasets.dataset import DataSet
+from deeplearning4j_tpu.datasets.iterators import DataSetIterator
+
+log = logging.getLogger("deeplearning4j_tpu")
+
+
+class ShardedDataSetIterator(DataSetIterator):
+    """Per-process shard of a record pipeline, as a DataSetIterator.
+
+    - ``reader``: any :class:`RecordReader` (CSV/line/collection/...),
+      already ``initialize``d; records are read ONCE at construction
+      (host-side ETL, the datavec-local model) and optionally pushed
+      through a ``TransformProcess``.
+    - The N usable records are split into ``process_count`` equal
+      contiguous blocks of ``N // process_count`` (the ragged global
+      tail is dropped AND LOGGED — every process must yield the same
+      number of batches or the in-step collectives deadlock).
+    - Within the block, complete ``batch_size`` batches are yielded;
+      the ragged local tail is likewise dropped and logged.
+    - ``label_index`` + ``n_labels`` → one-hot classification labels
+      (reference: RecordReaderDataSetIterator semantics);
+      ``label_index`` alone → regression target column(s).
+
+    ``process_index``/``process_count`` default to the live
+    ``jax.distributed`` world, so the SAME user code runs single- and
+    multi-process.
+    """
+
+    def __init__(self, reader, batch_size: int, *,
+                 label_index: Optional[int] = None,
+                 n_labels: Optional[int] = None,
+                 transform_process=None,
+                 process_index: Optional[int] = None,
+                 process_count: Optional[int] = None,
+                 dtype=np.float32):
+        super().__init__()
+        import jax
+        self.batch_size = int(batch_size)
+        self.label_index = label_index
+        self.n_labels = n_labels
+        self.dtype = dtype
+        pc = (process_count if process_count is not None
+              else jax.process_count())
+        pi = (process_index if process_index is not None
+              else jax.process_index())
+        if not 0 <= pi < pc:
+            raise ValueError(f"process_index {pi} outside world of "
+                             f"{pc} processes")
+        rows = [list(r) for r in reader]
+        if transform_process is not None:
+            rows = transform_process.execute(rows)
+        mat = np.array(
+            [[w.to_double() if hasattr(w, "to_double") else float(w)
+              for w in r] for r in rows], dtype=dtype)
+        n_total = len(mat)
+        per_proc = n_total // pc
+        if per_proc == 0:
+            raise ValueError(
+                f"{n_total} records cannot shard over {pc} processes")
+        dropped_global = n_total - per_proc * pc
+        if dropped_global:
+            log.warning(
+                "ShardedDataSetIterator: dropping %d ragged tail "
+                "record(s) of %d so all %d processes hold equal "
+                "shards", dropped_global, n_total, pc)
+        shard = mat[pi * per_proc:(pi + 1) * per_proc]
+        n_batches = per_proc // self.batch_size
+        dropped_local = per_proc - n_batches * self.batch_size
+        if dropped_local:
+            log.warning(
+                "ShardedDataSetIterator: dropping %d record(s) of the "
+                "local shard (%d) below batch size %d", dropped_local,
+                per_proc, self.batch_size)
+        if n_batches == 0:
+            raise ValueError(
+                f"local shard of {per_proc} records < batch size "
+                f"{self.batch_size}")
+        self._shard = shard[:n_batches * self.batch_size]
+        self._n_batches = n_batches
+        self._cursor = 0
+        self.process_index = pi
+        self.process_count = pc
+
+    # -- record matrix -> DataSet --------------------------------------
+    def _to_dataset(self, block: np.ndarray) -> DataSet:
+        li = self.label_index
+        if li is None:
+            return self._apply_pre(DataSet(block, block))  # unsupervised
+        li = li % block.shape[1]
+        feats = np.concatenate([block[:, :li], block[:, li + 1:]],
+                               axis=1)
+        if self.n_labels is not None:
+            labels = np.eye(self.n_labels, dtype=self.dtype)[
+                block[:, li].astype(np.int64)]
+        else:
+            labels = block[:, li:li + 1]
+        return self._apply_pre(DataSet(feats, labels))
+
+    # -- DataSetIterator contract --------------------------------------
+    def reset(self):
+        self._cursor = 0
+
+    def has_next(self) -> bool:
+        return self._cursor < self._n_batches
+
+    def next(self) -> DataSet:  # noqa: A003
+        if not self.has_next():
+            raise StopIteration
+        b = self.batch_size
+        block = self._shard[self._cursor * b:(self._cursor + 1) * b]
+        self._cursor += 1
+        return self._to_dataset(block)
+
+    def batch(self) -> int:
+        return self.batch_size
+
+    def total_examples(self) -> int:
+        return self._n_batches * self.batch_size
