@@ -110,7 +110,11 @@ def main():
         rep = measure_dp_scaling(
             lambda: LeNet(num_classes=10).init(), _mk_batch, (1,),
             per_chip_batch=64, steps=5, warmup=1)
-        line["scaling_n1_ips"] = round(rep["throughput"][1], 1)
+        # clock-path CANARY, not a throughput: 5 LeNet steps through
+        # the axon tunnel are dispatch-dominated (r3 verdict Weak #4
+        # — the old name scaling_n1_ips invited misreading)
+        line["scaling_harness_canary_ips"] = round(
+            rep["throughput"][1], 1)
     except Exception as e:
         print(f"scaling-harness leg failed: {e!r}", file=sys.stderr)
     # CPU-proxy pipeline overhead, every round (round-2 verdict Weak

@@ -24,7 +24,7 @@ from benchmarks.cost_util import V5E_BF16_PEAK_TFLOPS  # noqa: E402
 
 
 def main(batch=256, seq=128, steps=8, max_predictions=32,
-         flash=False):
+         flash=False, remat="full"):
     from deeplearning4j_tpu.learning import Adam
     from deeplearning4j_tpu.models.bert import Bert, BertConfig
 
@@ -40,7 +40,11 @@ def main(batch=256, seq=128, steps=8, max_predictions=32,
         # 109k vs 82k tokens/s measured (BENCH_notes_r03.md). The
         # flash kernel's domain is LONG sequences (ring-attention CP),
         # not BERT-base shapes.
-        conf = BertConfig(compute_dtype="bfloat16", remat=True,
+        # remat policy from the r4 MFU sweep (BENCH_notes_r04.md):
+        # "full" recomputes the whole layer, "dots" saves matmul
+        # outputs, "none" stores everything (needs a smaller batch)
+        conf = BertConfig(compute_dtype="bfloat16",
+                          remat=False if remat == "none" else remat,
                           use_flash_attention=flash,
                           hidden_dropout_prob=0.0,
                           attention_probs_dropout_prob=0.0,
@@ -102,6 +106,10 @@ if __name__ == "__main__":
     ap.add_argument("--flash", action="store_true",
                     help="use the Pallas flash-attention kernel "
                          "instead of XLA fused attention")
+    ap.add_argument("--remat", default="full",
+                    choices=["full", "dots", "none"],
+                    help="activation rematerialization policy")
     a = ap.parse_args()
     main(batch=a.batch, seq=a.seq, steps=a.steps,
-         max_predictions=a.max_predictions, flash=a.flash)
+         max_predictions=a.max_predictions, flash=a.flash,
+         remat=a.remat)

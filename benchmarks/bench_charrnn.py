@@ -27,7 +27,8 @@ import numpy as np
 sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
 
 
-def main(batch=64, seq_len=64, hidden=512, vocab=80, steps=200):
+def main(batch=64, seq_len=64, hidden=512, vocab=80, steps=600,
+         n_trials=7):
     from deeplearning4j_tpu.activations import Activation
     from deeplearning4j_tpu.datasets.dataset import DataSet
     from deeplearning4j_tpu.learning import Adam
@@ -73,11 +74,12 @@ def main(batch=64, seq_len=64, hidden=512, vocab=80, steps=200):
         s = float(net.score())      # sync must survive python -O
         assert np.isfinite(s)
 
-    # 200 steps/trial (~1s of device work) amortizes tunnel jitter;
-    # median-of-5 is the committed number (round-2 verdict Weak #2:
-    # the single-run spread spanned 2x)
+    # 600 steps/trial (~3s of device work), median-of-7: the r3
+    # 200-step/5-trial protocol left ±8% spread against the ≤5%
+    # target (r3 verdict Weak #3) — tripling the trial length and
+    # widening the median cuts tunnel jitter's share of the clock
     stats = median_throughput(run_once, steps * batch * seq_len,
-                              n_trials=5 if on_tpu else 3)
+                              n_trials=n_trials if on_tpu else 3)
     print(json.dumps({
         "metric": "charrnn_train_throughput"
                   + ("" if on_tpu else "_cpu_proxy"),

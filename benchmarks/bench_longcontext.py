@@ -1,0 +1,114 @@
+"""Long-context attention TRAIN-step A/B (round-3 verdict ask #3).
+
+The r3 forward tuning gave the Pallas flash kernel 4.2x over its
+untuned self at seq 8k — but training pays fwd+bwd, and the flash
+BACKWARD is jax.vjp through the blockwise-attention reference
+(parallel/sequence.py _flash_bwd), not a hand kernel.  This benchmark
+measures what long-context TRAINING actually costs per step for:
+
+  xla    — dense jnp attention (materializes [b,h,t,t] scores)
+  flash  — Pallas forward + blockwise-autodiff backward (current)
+  block  — blockwise_attention fwd+bwd (pure lax.scan, no Pallas)
+
+Each leg times grad(loss) of one attention call at [b, h, t, d],
+median of n_trials, synced on the loss scalar.  Prints ONE JSON line
+per leg.  Use --seq to sweep (8192 / 16384 are the committed legs).
+"""
+from __future__ import annotations
+
+import argparse
+import functools
+import json
+import os
+import sys
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+
+def xla_attention(q, k, v):
+    d = q.shape[-1]
+    s = jnp.einsum("bhqd,bhkd->bhqk", q, k) / jnp.sqrt(
+        jnp.asarray(d, q.dtype))
+    p = jax.nn.softmax(s.astype(jnp.float32), axis=-1).astype(q.dtype)
+    return jnp.einsum("bhqk,bhkd->bhqd", p, v)
+
+
+def main(seq=8192, batch=1, heads=8, d=128, dtype="bfloat16",
+         trials=5, legs=("xla", "flash", "block")):
+    from benchmarks.timing import median_throughput
+    from deeplearning4j_tpu.parallel.sequence import (
+        blockwise_attention, flash_attention)
+
+    on_tpu = jax.devices()[0].platform == "tpu"
+    if not on_tpu:
+        seq, trials = 512, 2
+    dt = jnp.dtype(dtype)
+    rng = np.random.RandomState(0)
+    shape = (batch, heads, seq, d)
+    q = jax.device_put(jnp.asarray(
+        rng.randn(*shape) * 0.1, dt))
+    k = jax.device_put(jnp.asarray(rng.randn(*shape) * 0.1, dt))
+    v = jax.device_put(jnp.asarray(rng.randn(*shape) * 0.1, dt))
+
+    fns = {
+        "xla": xla_attention,
+        "flash": functools.partial(flash_attention, causal=False),
+        "block": lambda q, k, v: blockwise_attention(q, k, v),
+    }
+    results = {}
+    for leg in legs:
+        fn = fns[leg]
+
+        @jax.jit
+        def train_step(q, k, v, fn=fn):
+            def loss(q, k, v):
+                o = fn(q, k, v)
+                return jnp.sum(o.astype(jnp.float32) ** 2)
+
+            l, grads = jax.value_and_grad(loss, argnums=(0, 1, 2))(
+                q, k, v)
+            return l, grads
+
+        try:
+            l, g = train_step(q, k, v)          # compile
+            jax.block_until_ready(g)
+            assert np.isfinite(float(l))
+
+            def run_once():
+                l, g = train_step(q, k, v)
+                jax.block_until_ready(g)
+                assert np.isfinite(float(l))
+
+            stats = median_throughput(run_once, 1, n_trials=trials)
+            step_ms = 1000.0 / stats["value"]
+            line = {"metric": f"longcontext_attn_train_step_{leg}",
+                    "value": round(step_ms, 2), "unit": "ms/step",
+                    "seq": seq, "batch": batch, "heads": heads,
+                    "d": d, "dtype": dtype,
+                    "min_ms": round(1000.0 / stats["max"], 2),
+                    "max_ms": round(1000.0 / stats["min"], 2),
+                    "n_trials": stats["n_trials"]}
+        except Exception as e:                   # OOM legs are data too
+            line = {"metric": f"longcontext_attn_train_step_{leg}",
+                    "value": None, "seq": seq,
+                    "error": f"{type(e).__name__}: {str(e)[:160]}"}
+        results[leg] = line
+        print(json.dumps(line))
+    return results
+
+
+if __name__ == "__main__":
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--seq", type=int, default=8192)
+    ap.add_argument("--batch", type=int, default=1)
+    ap.add_argument("--heads", type=int, default=8)
+    ap.add_argument("--d", type=int, default=128)
+    ap.add_argument("--trials", type=int, default=5)
+    ap.add_argument("--legs", default="xla,flash,block")
+    a = ap.parse_args()
+    main(seq=a.seq, batch=a.batch, heads=a.heads, d=a.d,
+         trials=a.trials, legs=tuple(a.legs.split(",")))

