@@ -71,7 +71,8 @@ def main(batch=64, seq=128, steps=8, dtype="float32"):
 
     # compile + warm (sd.fit builds the jitted step on first batch)
     hist = sd.fit([b], n_epochs=1, placeholders_fn=lambda x: x)
-    assert np.isfinite(hist.final_loss())
+    first_loss = hist.final_loss()
+    assert np.isfinite(first_loss)
 
     from benchmarks.timing import median_throughput
 
@@ -83,6 +84,11 @@ def main(batch=64, seq=128, steps=8, dtype="float32"):
 
     stats = median_throughput(run_once, steps * batch * seq,
                               n_trials=5 if on_tpu else 3)
+    # the timed steps must have TRAINED (same batch -> memorization);
+    # a wiring bug that zeroes gradients times a lie otherwise
+    last = sd.fit([b], n_epochs=1,
+                  placeholders_fn=lambda x: x).final_loss()
+    assert last < first_loss, (last, first_loss)
     line = {"metric": "bert_imported_mlm_train_throughput"
                       + ("" if on_tpu else "_cpu_proxy"),
             **stats,

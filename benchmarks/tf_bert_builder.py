@@ -127,6 +127,19 @@ def import_and_attach_mlm(gd_bytes, batch, seq, *, vocab, hidden,
     if dtype is not None:
         values = {n: _np.asarray(sd.vars[n].get_arr()).astype(dtype)
                   for n in wnames}
+        # weights alone are not enough: every f32 graph CONSTANT
+        # (mask -1e9, LN eps, 1/sqrt(hd), ...) would upcast the
+        # activations right back to f32 — cast them all, so the whole
+        # imported program computes in `dtype`
+        for n, v in sd.vars.items():
+            if (v.var_type == VariableType.CONSTANT
+                    and n not in wnames):
+                arr = sd._arrays.get(n)
+                if arr is not None and arr.dtype == _np.float32:
+                    import jax.numpy as _jnp
+                    sd._arrays[n] = _jnp.asarray(arr, dtype)
+                    v.dtype = sd._arrays[n].dtype
+        sd._exec_cache.clear()
     sd.convert_to_variables(wnames, values)
     out = sorted(n for n in sd.vars if n.startswith("Identity"))[0]
     tok = [n for n in wnames if sd.vars[n].shape == (vocab, hidden)]
