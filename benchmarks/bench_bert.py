@@ -1,8 +1,11 @@
 """Secondary benchmark: BERT-base MLM pretraining throughput
-(BASELINE config #4). bf16 + per-layer remat + XLA fused attention,
-batch 256 x seq 128 (measured 1.33x faster than the Pallas flash
-kernel at BERT shapes — BENCH_notes_r03.md; flash remains the
-long-context/CP path).
+(BASELINE config #4). bf16 + per-layer FULL remat + XLA fused
+attention, batch 1024 x seq 128 — the r4 remat-policy sweep's winner
+(BENCH_notes_r04.md: full remat beats dots_saveable at every batch,
+and batch is the MFU lever: 256 -> 1024 took 30.1% -> 38.2% of bf16
+peak; dots/no-remat at larger batches fail compile). XLA fused
+attention measured 1.33x over the Pallas flash kernel at BERT shapes
+(BENCH_notes_r03.md); flash remains the long-context/CP path.
 
 Prints ONE JSON line: {"metric": "bert_mlm_train_throughput", ...}.
 CLI flags reproduce the published A/B legs:
@@ -23,7 +26,7 @@ sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
 from benchmarks.cost_util import V5E_BF16_PEAK_TFLOPS  # noqa: E402
 
 
-def main(batch=256, seq=128, steps=8, max_predictions=32,
+def main(batch=1024, seq=128, steps=8, max_predictions=32,
          flash=False, remat="full"):
     from deeplearning4j_tpu.learning import Adam
     from deeplearning4j_tpu.models.bert import Bert, BertConfig
@@ -99,7 +102,7 @@ def main(batch=256, seq=128, steps=8, max_predictions=32,
 if __name__ == "__main__":
     import argparse
     ap = argparse.ArgumentParser()
-    ap.add_argument("--batch", type=int, default=256)
+    ap.add_argument("--batch", type=int, default=1024)
     ap.add_argument("--seq", type=int, default=128)
     ap.add_argument("--steps", type=int, default=8)
     ap.add_argument("--max-predictions", type=int, default=32)
