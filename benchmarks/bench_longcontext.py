@@ -38,7 +38,7 @@ def xla_attention(q, k, v):
 
 
 def main(seq=8192, batch=1, heads=8, d=128, dtype="bfloat16",
-         trials=5, legs=("xla", "flash", "block")):
+         trials=5, steps=10, legs=("xla", "flash", "block")):
     from benchmarks.timing import median_throughput
     from deeplearning4j_tpu.parallel.sequence import (
         blockwise_attention, flash_attention)
@@ -79,11 +79,16 @@ def main(seq=8192, batch=1, heads=8, d=128, dtype="bfloat16",
             assert np.isfinite(float(l))
 
             def run_once():
-                l, g = train_step(q, k, v)
-                jax.block_until_ready(g)
+                # dispatch `steps` independent steps, sync ONCE on the
+                # last loss: a per-step float() sync through the axon
+                # tunnel costs ~200 ms and would swamp the kernel time
+                l = None
+                for _ in range(steps):
+                    l, _g = train_step(q, k, v)
                 assert np.isfinite(float(l))
 
-            stats = median_throughput(run_once, 1, n_trials=trials)
+            stats = median_throughput(run_once, steps,
+                                      n_trials=trials)
             step_ms = 1000.0 / stats["value"]
             line = {"metric": f"longcontext_attn_train_step_{leg}",
                     "value": round(step_ms, 2), "unit": "ms/step",
@@ -91,6 +96,7 @@ def main(seq=8192, batch=1, heads=8, d=128, dtype="bfloat16",
                     "d": d, "dtype": dtype,
                     "min_ms": round(1000.0 / stats["max"], 2),
                     "max_ms": round(1000.0 / stats["min"], 2),
+                    "steps_per_trial": steps,
                     "n_trials": stats["n_trials"]}
         except Exception as e:                   # OOM legs are data too
             line = {"metric": f"longcontext_attn_train_step_{leg}",
@@ -108,7 +114,9 @@ if __name__ == "__main__":
     ap.add_argument("--heads", type=int, default=8)
     ap.add_argument("--d", type=int, default=128)
     ap.add_argument("--trials", type=int, default=5)
+    ap.add_argument("--steps", type=int, default=10)
     ap.add_argument("--legs", default="xla,flash,block")
     a = ap.parse_args()
     main(seq=a.seq, batch=a.batch, heads=a.heads, d=a.d,
-         trials=a.trials, legs=tuple(a.legs.split(",")))
+         trials=a.trials, steps=a.steps,
+         legs=tuple(a.legs.split(",")))

@@ -9,8 +9,9 @@ extension that makes long-context first-class:
   instead of O(t^2); exact same function as dense softmax attention.
 - :func:`flash_attention` — the same computation as a Pallas TPU
   kernel (tiled into VMEM, MXU matmuls, fp32 accumulators); backward
-  pass recomputes via the blockwise form (flash-style recompute trades
-  FLOPs for HBM, the standard TPU tradeoff).
+  is a pair of Pallas dq / dk+dv kernels recomputing probabilities
+  from the saved log-sum-exp (flash-style recompute trades FLOPs for
+  HBM, the standard TPU tradeoff).
 - :func:`ring_attention` — context parallelism over a mesh ``seq``
   axis: Q/K/V sharded along time; K/V blocks rotate around the ring
   via ``lax.ppermute`` (ICI neighbor exchange) while each device
@@ -120,8 +121,39 @@ def blockwise_attention(q, k, v, *, causal: bool = False,
 # ---------------------------------------------------------------------------
 # Pallas flash-attention kernel (TPU)
 # ---------------------------------------------------------------------------
+def _masked_scores(q_ref, k_ref, mask_ref, iq, jk, causal: bool,
+                   scale: float):
+    """The score block shared by forward and both backward kernels:
+    q @ k^T * scale with the causal iota mask and the key mask
+    applied as NEG_INF — ONE definition, so the masked-score
+    semantics (incl. the exact-zero invariant downstream) can never
+    desynchronize between passes."""
+    block_q = q_ref.shape[0]
+    block_k = k_ref.shape[0]
+    s = jax.lax.dot_general(q_ref[:], k_ref[:],
+                            (((1,), (1,)), ((), ())),
+                            preferred_element_type=jnp.float32)
+    s = s * scale
+    if causal:
+        q_pos = iq * block_q + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 0)
+        k_pos = jk * block_k + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 1)
+        s = jnp.where(q_pos >= k_pos, s, NEG_INF)
+    if mask_ref is not None:
+        s = jnp.where(mask_ref[:1, :] > 0, s, NEG_INF)
+    return s
+
+
+#: lane-replication width for the lse/delta residuals ((block_q, REP)
+#: slabs whose lane dim equals the full array dim — the same sub-128
+#: shape rule the key-mask slab uses on its sublane)
+_RESID_REP = 8
+
+
 def _flash_kernel(q_ref, k_ref, v_ref, *rest, n_kb: int, causal: bool,
-                  scale: float, has_mask: bool):
+                  scale: float, has_mask: bool,
+                  want_lse: bool = False):
     """One (bh, iq, jk) grid cell: fold K/V block jk into the online-
     softmax accumulator for query block iq. Only [block, d] slabs are
     VMEM-resident — K/V stream through the grid (O(block) VMEM).
@@ -131,8 +163,14 @@ def _flash_kernel(q_ref, k_ref, v_ref, *rest, n_kb: int, causal: bool,
     mask streams as a (1, block_k) slab per key block."""
     import jax.experimental.pallas as pl
 
-    if has_mask:
+    lse_ref = None
+    if has_mask and want_lse:
+        mask_ref, o_ref, lse_ref, o_acc, l_acc, m_acc = rest
+    elif has_mask:
         mask_ref, o_ref, o_acc, l_acc, m_acc = rest
+    elif want_lse:
+        o_ref, lse_ref, o_acc, l_acc, m_acc = rest
+        mask_ref = None
     else:
         o_ref, o_acc, l_acc, m_acc = rest
         mask_ref = None
@@ -152,19 +190,8 @@ def _flash_kernel(q_ref, k_ref, v_ref, *rest, n_kb: int, causal: bool,
         # 2x the f32 rate; accumulation is f32 via
         # preferred_element_type (casting inputs to f32 halves
         # matmul throughput for zero accuracy gain)
-        q = q_ref[:]
-        kb = k_ref[:]
-        s = jax.lax.dot_general(q, kb, (((1,), (1,)), ((), ())),
-                                preferred_element_type=jnp.float32)
-        s = s * scale
-        if causal:
-            q_pos = iq * block_q + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 0)
-            k_pos = jk * block_k + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 1)
-            s = jnp.where(q_pos >= k_pos, s, NEG_INF)
-        if mask_ref is not None:
-            s = jnp.where(mask_ref[:1, :] > 0, s, NEG_INF)
+        s = _masked_scores(q_ref, k_ref, mask_ref, iq, jk, causal,
+                           scale)
         m_prev = m_acc[:, :1]
         l_prev = l_acc[:, :1]
         m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
@@ -189,10 +216,29 @@ def _flash_kernel(q_ref, k_ref, v_ref, *rest, n_kb: int, causal: bool,
     def _finalize_out():
         l = jnp.maximum(l_acc[:, :1], 1e-30)
         o_ref[:] = (o_acc[:] / l).astype(o_ref.dtype)
+        if lse_ref is not None:
+            # per-row log-sum-exp of the SCALED scores, the flash
+            # backward's softmax residual; replicated only _RESID_REP
+            # lanes wide (128-wide residuals held fwd->bwd cost 128x
+            # the HBM of the data present)
+            lse_ref[:] = (m_acc[:, :_RESID_REP]
+                          + jnp.log(jnp.maximum(
+                              l_acc[:, :_RESID_REP], 1e-30)))
+
+
+def _fit_block(block, t):
+    # largest divisor of t that is <= the requested block (halve
+    # until it divides): a 1536-long sequence runs with 512-blocks
+    # rather than erroring on the 1024 default
+    block = min(block, t)
+    while t % block:
+        block //= 2
+    return max(block, 1)
 
 
 def _flash_forward(q, k, v, key_mask, causal: bool, block_q: int,
-                   block_k: int, interpret: bool):
+                   block_k: int, interpret: bool,
+                   want_lse: bool = False):
     import jax.experimental.pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
@@ -200,17 +246,8 @@ def _flash_forward(q, k, v, key_mask, causal: bool, block_q: int,
     tk = k.shape[2]
     scale = 1.0 / (d ** 0.5)
 
-    def _fit(block, t):
-        # largest divisor of t that is <= the requested block (halve
-        # until it divides): a 1536-long sequence runs with 512-blocks
-        # rather than erroring on the 1024 default
-        block = min(block, t)
-        while t % block:
-            block //= 2
-        return max(block, 1)
-
-    block_q = _fit(block_q, tq)
-    block_k = _fit(block_k, tk)
+    block_q = _fit_block(block_q, tq)
+    block_k = _fit_block(block_k, tk)
     n_kb = tk // block_k
     qr = q.reshape(b * h, tq, d)
     kr = k.reshape(b * h, tk, d)
@@ -218,7 +255,8 @@ def _flash_forward(q, k, v, key_mask, causal: bool, block_q: int,
     has_mask = key_mask is not None
 
     kernel = functools.partial(_flash_kernel, n_kb=n_kb, causal=causal,
-                               scale=scale, has_mask=has_mask)
+                               scale=scale, has_mask=has_mask,
+                               want_lse=want_lse)
     in_specs = [
         pl.BlockSpec((None, block_q, d),
                      lambda bh, iq, jk: (bh, iq, 0)),
@@ -239,13 +277,22 @@ def _flash_forward(q, k, v, key_mask, causal: bool, block_q: int,
         inputs.append(km)
         in_specs.append(pl.BlockSpec((None, 1, block_k),
                                      lambda bh, iq, jk: (bh, 0, jk)))
-    out = pl.pallas_call(
+    out_specs = pl.BlockSpec((None, block_q, d),
+                             lambda bh, iq, jk: (bh, iq, 0))
+    out_shape = jax.ShapeDtypeStruct((b * h, tq, d), q.dtype)
+    if want_lse:
+        out_specs = [out_specs,
+                     pl.BlockSpec((None, block_q, _RESID_REP),
+                                  lambda bh, iq, jk: (bh, iq, 0))]
+        out_shape = [out_shape,
+                     jax.ShapeDtypeStruct((b * h, tq, _RESID_REP),
+                                          jnp.float32)]
+    res = pl.pallas_call(
         kernel,
         grid=(b * h, tq // block_q, n_kb),
         in_specs=in_specs,
-        out_specs=pl.BlockSpec((None, block_q, d),
-                               lambda bh, iq, jk: (bh, iq, 0)),
-        out_shape=jax.ShapeDtypeStruct((b * h, tq, d), q.dtype),
+        out_specs=out_specs,
+        out_shape=out_shape,
         scratch_shapes=[
             pltpu.VMEM((block_q, d), jnp.float32),
             pltpu.VMEM((block_q, 128), jnp.float32),
@@ -253,7 +300,214 @@ def _flash_forward(q, k, v, key_mask, causal: bool, block_q: int,
         ],
         interpret=interpret,
     )(*inputs)
-    return out.reshape(b, h, tq, d)
+    if want_lse:
+        out, lse = res
+        return out.reshape(b, h, tq, d), lse
+    return res.reshape(b, h, tq, d)
+
+
+def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref,
+                         delta_ref, *rest, n_kb: int, causal: bool,
+                         scale: float, has_mask: bool):
+    """dq for one (bh, iq, jk) grid cell: recompute the probability
+    block from the saved log-sum-exp (the flash residual), form
+    ds = p * (do.v^T - delta), accumulate dq += ds @ k * scale.  Only
+    [block, d] slabs + one (block_q, block_k) f32 score block are
+    VMEM-resident."""
+    import jax.experimental.pallas as pl
+
+    if has_mask:
+        mask_ref, dq_ref, dq_acc = rest
+    else:
+        dq_ref, dq_acc = rest
+        mask_ref = None
+    block_q = q_ref.shape[0]
+    block_k = k_ref.shape[0]
+    iq = pl.program_id(1)
+    jk = pl.program_id(2)
+
+    @pl.when(jk == 0)
+    def _init():
+        dq_acc[:] = jnp.zeros_like(dq_acc)
+
+    def _update():
+        s = _masked_scores(q_ref, k_ref, mask_ref, iq, jk, causal,
+                           scale)
+        p = jnp.where(s <= NEG_INF / 2, 0.0,
+                      jnp.exp(s - lse_ref[:, :1]))
+        dp = jax.lax.dot_general(
+            do_ref[:], v_ref[:], (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        ds = p * (dp - delta_ref[:, :1])
+        dq_acc[:] += jax.lax.dot_general(
+            ds.astype(k_ref.dtype), k_ref[:],
+            (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale
+
+    if causal:
+        @pl.when((iq + 1) * block_q > jk * block_k)
+        def _():
+            _update()
+    else:
+        _update()
+
+    @pl.when(jk == n_kb - 1)
+    def _finalize():
+        dq_ref[:] = dq_acc[:].astype(dq_ref.dtype)
+
+
+def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref,
+                          delta_ref, *rest, n_qb: int, causal: bool,
+                          scale: float, has_mask: bool):
+    """dk/dv for one (bh, jk, iq) grid cell (q blocks innermost so
+    the [block_k, d] accumulators persist per key block):
+    dv += p^T @ do,  dk += ds^T @ q * scale."""
+    import jax.experimental.pallas as pl
+
+    if has_mask:
+        mask_ref, dk_ref, dv_ref, dk_acc, dv_acc = rest
+    else:
+        dk_ref, dv_ref, dk_acc, dv_acc = rest
+        mask_ref = None
+    block_q = q_ref.shape[0]
+    block_k = k_ref.shape[0]
+    jk = pl.program_id(1)
+    iq = pl.program_id(2)
+
+    @pl.when(iq == 0)
+    def _init():
+        dk_acc[:] = jnp.zeros_like(dk_acc)
+        dv_acc[:] = jnp.zeros_like(dv_acc)
+
+    def _update():
+        s = _masked_scores(q_ref, k_ref, mask_ref, iq, jk, causal,
+                           scale)
+        p = jnp.where(s <= NEG_INF / 2, 0.0,
+                      jnp.exp(s - lse_ref[:, :1]))
+        # dv += p^T @ do
+        dv_acc[:] += jax.lax.dot_general(
+            p.astype(do_ref.dtype), do_ref[:],
+            (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        dp = jax.lax.dot_general(
+            do_ref[:], v_ref[:], (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        ds = p * (dp - delta_ref[:, :1])
+        # dk += ds^T @ q * scale
+        dk_acc[:] += jax.lax.dot_general(
+            ds.astype(q_ref.dtype), q_ref[:],
+            (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale
+
+    if causal:
+        @pl.when((iq + 1) * block_q > jk * block_k)
+        def _():
+            _update()
+    else:
+        _update()
+
+    @pl.when(iq == n_qb - 1)
+    def _finalize():
+        dk_ref[:] = dk_acc[:].astype(dk_ref.dtype)
+        dv_ref[:] = dv_acc[:].astype(dv_ref.dtype)
+
+
+def _flash_backward(q, k, v, key_mask, out, lse, g, causal: bool,
+                    block_q: int, block_k: int, interpret: bool):
+    """Pallas flash backward: dq via a (bh, iq, jk) sweep, dk/dv via a
+    (bh, jk, iq) sweep, probabilities recomputed from the saved
+    log-sum-exp.  Replaces the r3 jax.vjp-through-blockwise backward,
+    whose differentiated lax.scan both lost 2.4x to XLA dense at seq
+    8k AND failed to compile beyond [4, 8, 8192, 128] on the v5e
+    compile helper (BENCH_notes_r04.md)."""
+    import jax.experimental.pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    b, h, tq, d = q.shape
+    tk = k.shape[2]
+    scale = 1.0 / (d ** 0.5)
+    block_q = _fit_block(block_q, tq)
+    block_k = _fit_block(block_k, tk)
+    n_qb, n_kb = tq // block_q, tk // block_k
+    qr = q.reshape(b * h, tq, d)
+    kr = k.reshape(b * h, tk, d)
+    vr = v.reshape(b * h, tk, d)
+    gr = g.reshape(b * h, tq, d)
+    # delta_i = sum_d dO_i . O_i — the softmax-jacobian row term;
+    # cheap elementwise+reduce, lane-replicated like lse
+    delta = jnp.sum(g.astype(jnp.float32) * out.astype(jnp.float32),
+                    axis=-1).reshape(b * h, tq)
+    delta = jnp.broadcast_to(delta[:, :, None],
+                             (b * h, tq, _RESID_REP))
+    has_mask = key_mask is not None
+
+    qkv_specs = [
+        pl.BlockSpec((None, block_q, d), lambda bh, iq, jk: (bh, iq, 0)),
+        pl.BlockSpec((None, block_k, d), lambda bh, iq, jk: (bh, jk, 0)),
+        pl.BlockSpec((None, block_k, d), lambda bh, iq, jk: (bh, jk, 0)),
+        pl.BlockSpec((None, block_q, d), lambda bh, iq, jk: (bh, iq, 0)),
+        pl.BlockSpec((None, block_q, _RESID_REP),
+                     lambda bh, iq, jk: (bh, iq, 0)),
+        pl.BlockSpec((None, block_q, _RESID_REP),
+                     lambda bh, iq, jk: (bh, iq, 0)),
+    ]
+    inputs = [qr, kr, vr, gr, lse, delta]
+    if has_mask:
+        km = jnp.broadcast_to(
+            key_mask.astype(jnp.float32)[:, None, None, :],
+            (b, h, 1, tk)).reshape(b * h, 1, tk)
+        inputs.append(km)
+        qkv_specs.append(pl.BlockSpec((None, 1, block_k),
+                                      lambda bh, iq, jk: (bh, 0, jk)))
+    dq = pl.pallas_call(
+        functools.partial(_flash_bwd_dq_kernel, n_kb=n_kb,
+                          causal=causal, scale=scale,
+                          has_mask=has_mask),
+        grid=(b * h, n_qb, n_kb),
+        in_specs=qkv_specs,
+        out_specs=pl.BlockSpec((None, block_q, d),
+                               lambda bh, iq, jk: (bh, iq, 0)),
+        out_shape=jax.ShapeDtypeStruct((b * h, tq, d), q.dtype),
+        scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
+        interpret=interpret,
+    )(*inputs)
+
+    # same inputs, (bh, jk, iq) grid — index maps swap the roles
+    kv_specs = [
+        pl.BlockSpec((None, block_q, d), lambda bh, jk, iq: (bh, iq, 0)),
+        pl.BlockSpec((None, block_k, d), lambda bh, jk, iq: (bh, jk, 0)),
+        pl.BlockSpec((None, block_k, d), lambda bh, jk, iq: (bh, jk, 0)),
+        pl.BlockSpec((None, block_q, d), lambda bh, jk, iq: (bh, iq, 0)),
+        pl.BlockSpec((None, block_q, _RESID_REP),
+                     lambda bh, jk, iq: (bh, iq, 0)),
+        pl.BlockSpec((None, block_q, _RESID_REP),
+                     lambda bh, jk, iq: (bh, iq, 0)),
+    ]
+    if has_mask:
+        kv_specs.append(pl.BlockSpec((None, 1, block_k),
+                                     lambda bh, jk, iq: (bh, 0, jk)))
+    dk, dv = pl.pallas_call(
+        functools.partial(_flash_bwd_dkv_kernel, n_qb=n_qb,
+                          causal=causal, scale=scale,
+                          has_mask=has_mask),
+        grid=(b * h, n_kb, n_qb),
+        in_specs=kv_specs,
+        out_specs=[
+            pl.BlockSpec((None, block_k, d),
+                         lambda bh, jk, iq: (bh, jk, 0)),
+            pl.BlockSpec((None, block_k, d),
+                         lambda bh, jk, iq: (bh, jk, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((b * h, tk, d), k.dtype),
+            jax.ShapeDtypeStruct((b * h, tk, d), v.dtype),
+        ],
+        scratch_shapes=[pltpu.VMEM((block_k, d), jnp.float32),
+                        pltpu.VMEM((block_k, d), jnp.float32)],
+        interpret=interpret,
+    )(*inputs)
+    return (dq.reshape(b, h, tq, d), dk.reshape(b, h, tk, d),
+            dv.reshape(b, h, tk, d))
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
@@ -262,14 +516,21 @@ def flash_attention(q, k, v, causal: bool = False, block_q: int = 1024,
                     interpret: Optional[bool] = None, key_mask=None):
     """Fused attention kernel, [b, h, t, d]. Equals dense softmax
     attention; O(block) VMEM. ``key_mask``: [b, tk], 0 = masked.
-    Backward = flash-style recompute through
-    :func:`blockwise_attention` (jax.grad-differentiable).
+    Backward = Pallas dq/dk/dv kernels recomputing probabilities from
+    the saved log-sum-exp (r4; the r3 jax.vjp-through-blockwise
+    backward lost 2.4x to XLA dense at seq 8k and failed to compile
+    beyond [4, 8, 8192, 128] — BENCH_notes_r04.md). Measured train
+    step (fwd+bwd, v5e): 1.55-1.6x FASTER than XLA dense at seq
+    8k-16k, and runs at 32k where dense attention cannot materialize
+    the score matrix at all.
 
-    Default 1024x1024 blocks measured 4.2x faster than 128x256 at seq
-    8192 on v5e (fewer grid steps amortize the per-block overhead; the
-    f32 score block is 4 MB of VMEM) — BENCH_notes_r03.md. Blocks
-    clamp to the sequence length, so short sequences still work;
-    below ~4k prefer plain XLA attention, which wins outright there."""
+    Default 1024x1024 forward blocks measured 4.2x faster than
+    128x256 at seq 8192 on v5e (fewer grid steps amortize the
+    per-block overhead; the f32 score block is 4 MB of VMEM) —
+    BENCH_notes_r03.md; the backward caps blocks at 512 (it keeps
+    score + dp + ds f32 blocks live). Blocks clamp to the sequence
+    length, so short sequences still work; below ~4k prefer plain
+    XLA attention, which wins outright there."""
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
     return _flash_forward(q, k, v, key_mask, causal, block_q, block_k,
@@ -278,19 +539,24 @@ def flash_attention(q, k, v, causal: bool = False, block_q: int = 1024,
 
 def _flash_fwd(q, k, v, causal, block_q, block_k, interpret,
                key_mask=None):
-    out = flash_attention(q, k, v, causal, block_q, block_k, interpret,
-                          key_mask)
-    return out, (q, k, v, key_mask)
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    out, lse = _flash_forward(q, k, v, key_mask, causal, block_q,
+                              block_k, interpret, want_lse=True)
+    return out, (q, k, v, key_mask, out, lse)
 
 
 def _flash_bwd(causal, block_q, block_k, interpret, res, g):
-    q, k, v, key_mask = res
-    km = None if key_mask is None else key_mask[:, None, :]
-    _, vjp = jax.vjp(
-        lambda q, k, v: blockwise_attention(q, k, v, causal=causal,
-                                            block_k=block_k,
-                                            key_mask=km), q, k, v)
-    return vjp(g) + (None,)      # no cotangent for the mask
+    q, k, v, key_mask, out, lse = res
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    # backward blocks default to 512: the bwd keeps an extra f32
+    # score block + dp/ds live, so the fwd's 1024x1024 tuning would
+    # overflow VMEM
+    dq, dk, dv = _flash_backward(
+        q, k, v, key_mask, out, lse, g, causal,
+        min(block_q, 512), min(block_k, 512), interpret)
+    return dq, dk, dv, None      # no cotangent for the mask
 
 
 flash_attention.defvjp(_flash_fwd, _flash_bwd)
