@@ -222,8 +222,13 @@ class DistributedTransformerLM:
         dh = ap["Wq"].shape[-1] // n_heads_local
         hd = lambda a: a.reshape(b, tl, n_heads_local, dh) \
             .transpose(0, 2, 1, 3)
+        # use_flash: per-shard Pallas kernels + exact lse merge —
+        # measured 320x over the differentiated blockwise ring for a
+        # causal seq-8192 train step on v5e (BENCH_notes_r04.md); on
+        # CPU backends it runs the exact dense-with-lse reference
         o = ring_attention(hd(h @ ap["Wq"]), hd(h @ ap["Wk"]),
-                           hd(h @ ap["Wv"]), "seq", causal=True)
+                           hd(h @ ap["Wv"]), "seq", causal=True,
+                           use_flash=True)
         o = o.transpose(0, 2, 1, 3).reshape(b, tl, n_heads_local * dh)
         return row_parallel_dense(o, ap["Wo"], ap["bo"], "model")
 

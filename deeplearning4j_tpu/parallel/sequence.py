@@ -151,6 +151,16 @@ def _masked_scores(q_ref, k_ref, mask_ref, iq, jk, causal: bool,
 _RESID_REP = 8
 
 
+def _sds_like(shape, dtype, like):
+    """ShapeDtypeStruct carrying ``like``'s varying-manual-axes, so
+    pallas_call outputs type-check under shard_map (ring attention
+    runs the kernels inside the ``seq`` manual axis)."""
+    vma = getattr(jax.core.get_aval(like), "vma", None)
+    if vma:
+        return jax.ShapeDtypeStruct(shape, dtype, vma=vma)
+    return jax.ShapeDtypeStruct(shape, dtype)
+
+
 def _flash_kernel(q_ref, k_ref, v_ref, *rest, n_kb: int, causal: bool,
                   scale: float, has_mask: bool,
                   want_lse: bool = False):
@@ -279,14 +289,14 @@ def _flash_forward(q, k, v, key_mask, causal: bool, block_q: int,
                                      lambda bh, iq, jk: (bh, 0, jk)))
     out_specs = pl.BlockSpec((None, block_q, d),
                              lambda bh, iq, jk: (bh, iq, 0))
-    out_shape = jax.ShapeDtypeStruct((b * h, tq, d), q.dtype)
+    out_shape = _sds_like((b * h, tq, d), q.dtype, qr)
     if want_lse:
         out_specs = [out_specs,
                      pl.BlockSpec((None, block_q, _RESID_REP),
                                   lambda bh, iq, jk: (bh, iq, 0))]
         out_shape = [out_shape,
-                     jax.ShapeDtypeStruct((b * h, tq, _RESID_REP),
-                                          jnp.float32)]
+                     _sds_like((b * h, tq, _RESID_REP), jnp.float32,
+                               qr)]
     res = pl.pallas_call(
         kernel,
         grid=(b * h, tq // block_q, n_kb),
@@ -413,7 +423,8 @@ def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref,
 
 
 def _flash_backward(q, k, v, key_mask, out, lse, g, causal: bool,
-                    block_q: int, block_k: int, interpret: bool):
+                    block_q: int, block_k: int, interpret: bool,
+                    g_lse=None):
     """Pallas flash backward: dq via a (bh, iq, jk) sweep, dk/dv via a
     (bh, jk, iq) sweep, probabilities recomputed from the saved
     log-sum-exp.  Replaces the r3 jax.vjp-through-blockwise backward,
@@ -434,9 +445,13 @@ def _flash_backward(q, k, v, key_mask, out, lse, g, causal: bool,
     vr = v.reshape(b * h, tk, d)
     gr = g.reshape(b * h, tq, d)
     # delta_i = sum_d dO_i . O_i — the softmax-jacobian row term;
-    # cheap elementwise+reduce, lane-replicated like lse
+    # cheap elementwise+reduce, lane-replicated like lse.  An lse
+    # cotangent folds in EXACTLY here: d lse_i / d s_ij = p_ij, so
+    # ds = p*(dp - delta + g_lse) — i.e. delta' = delta - g_lse
     delta = jnp.sum(g.astype(jnp.float32) * out.astype(jnp.float32),
                     axis=-1).reshape(b * h, tq)
+    if g_lse is not None:
+        delta = delta - g_lse.astype(jnp.float32).reshape(b * h, tq)
     delta = jnp.broadcast_to(delta[:, :, None],
                              (b * h, tq, _RESID_REP))
     has_mask = key_mask is not None
@@ -467,7 +482,7 @@ def _flash_backward(q, k, v, key_mask, out, lse, g, causal: bool,
         in_specs=qkv_specs,
         out_specs=pl.BlockSpec((None, block_q, d),
                                lambda bh, iq, jk: (bh, iq, 0)),
-        out_shape=jax.ShapeDtypeStruct((b * h, tq, d), q.dtype),
+        out_shape=_sds_like((b * h, tq, d), q.dtype, qr),
         scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
         interpret=interpret,
     )(*inputs)
@@ -499,8 +514,8 @@ def _flash_backward(q, k, v, key_mask, out, lse, g, causal: bool,
                          lambda bh, jk, iq: (bh, jk, 0)),
         ],
         out_shape=[
-            jax.ShapeDtypeStruct((b * h, tk, d), k.dtype),
-            jax.ShapeDtypeStruct((b * h, tk, d), v.dtype),
+            _sds_like((b * h, tk, d), k.dtype, kr),
+            _sds_like((b * h, tk, d), v.dtype, vr),
         ],
         scratch_shapes=[pltpu.VMEM((block_k, d), jnp.float32),
                         pltpu.VMEM((block_k, d), jnp.float32)],
@@ -562,20 +577,91 @@ def _flash_bwd(causal, block_q, block_k, interpret, res, g):
 flash_attention.defvjp(_flash_fwd, _flash_bwd)
 
 
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+def flash_attention_with_lse(q, k, v, causal: bool = False,
+                             block_q: int = 1024, block_k: int = 1024,
+                             interpret: Optional[bool] = None,
+                             key_mask=None):
+    """:func:`flash_attention` that ALSO returns the per-row
+    log-sum-exp of the scaled scores, [b, h, t] f32 — the residual
+    that lets partial attentions over different key sets be merged
+    exactly (ring attention's per-step form).  Differentiable in the
+    lse output too: its cotangent folds into the backward's delta
+    term (d lse/d s = p)."""
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    out, lse = _flash_forward(q, k, v, key_mask, causal, block_q,
+                              block_k, interpret, want_lse=True)
+    b, h, tq, _ = q.shape
+    return out, lse[:, :, 0].reshape(b, h, tq)
+
+
+def _flash_lse_fwd(q, k, v, causal, block_q, block_k, interpret,
+                   key_mask=None):
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    out, lse = _flash_forward(q, k, v, key_mask, causal, block_q,
+                              block_k, interpret, want_lse=True)
+    b, h, tq, _ = q.shape
+    return ((out, lse[:, :, 0].reshape(b, h, tq)),
+            (q, k, v, key_mask, out, lse))
+
+
+def _flash_lse_bwd(causal, block_q, block_k, interpret, res, g):
+    q, k, v, key_mask, out, lse = res
+    g_out, g_lse = g
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    dq, dk, dv = _flash_backward(
+        q, k, v, key_mask, out, lse, g_out, causal,
+        min(block_q, 512), min(block_k, 512), interpret,
+        g_lse=g_lse)
+    return dq, dk, dv, None
+
+
+flash_attention_with_lse.defvjp(_flash_lse_fwd, _flash_lse_bwd)
+
+
 # ---------------------------------------------------------------------------
 # ring attention — context parallelism over a mesh axis
 # ---------------------------------------------------------------------------
+def _ref_attention_with_lse(q, k, v, causal: bool, scale: float):
+    """Dense attention returning (out, lse) — the non-kernel twin of
+    :func:`flash_attention_with_lse` for backends without Mosaic."""
+    s = jnp.einsum("...qd,...kd->...qk", q, k) * scale
+    if causal:
+        t_q, t_k = s.shape[-2], s.shape[-1]
+        i = jnp.arange(t_q)[:, None]
+        j = jnp.arange(t_k)[None, :]
+        s = jnp.where(i >= j, s, NEG_INF)
+    lse = jax.scipy.special.logsumexp(s, axis=-1)
+    p = jnp.where(s <= NEG_INF / 2, 0.0,
+                  jnp.exp(s - lse[..., None]))
+    return jnp.einsum("...qk,...kd->...qd", p, v), lse
+
+
 def ring_attention(q, k, v, axis_name: str, *, causal: bool = False,
-                   block_k: int = 256):
+                   block_k: int = 256, use_flash: bool = False,
+                   flash_block_q: int = 1024,
+                   flash_block_k: int = 1024):
     """Attention with Q/K/V sharded along time over ``axis_name``.
 
     Call INSIDE ``shard_map``: q/k/v are the local shards
     [b, h, t_local, d]. K/V shards rotate around the ring with
     ``lax.ppermute`` (neighbor ICI hop per step) while each device
-    folds the visiting block into its online-softmax accumulator —
-    t_local^2 compute per step, O(t_local) memory, n_sp steps.
-    Causal masking uses global positions so the result equals dense
-    causal attention on the gathered sequence.
+    folds the visiting block into its accumulator — t_local^2 compute
+    per step, O(t_local) memory, n_sp steps.  Causal masking uses
+    global positions so the result equals dense causal attention on
+    the gathered sequence.
+
+    ``use_flash=True`` (r4): each ring step runs the Pallas
+    :func:`flash_attention_with_lse` kernel on the visiting shard and
+    the normalized partials are merged EXACTLY via their
+    log-sum-exps; the causal diagonal decomposes per the standard
+    ring recipe (earlier shards fully visible, own shard locally
+    causal, later shards skipped).  Backward rides the Pallas dq/dkv
+    kernels per step through the scan.  Needs [b, h, t, d] inputs
+    (the kernel's layout); the default path accepts any [..., t, d].
     """
     n_sp = lax.psum(1, axis_name)
     my = lax.axis_index(axis_name)
@@ -589,20 +675,73 @@ def ring_attention(q, k, v, axis_name: str, *, causal: bool = False,
     o0 = (q * 0).astype(acc_dt)
     l0 = o0[..., 0]
     m0 = l0 + NEG_INF
+    perm = None  # built per step below
 
-    def step(carry, s):
-        (o, l, m), (kb, vb) = carry
-        src = (my - s) % n_sp              # who produced this block
-        mask = None
-        if causal:
-            k_pos = src * t_local + jnp.arange(t_local)
-            mask = q_pos[:, None] >= k_pos[None, :]
-        acc = _block_update((o, l, m), q, kb, vb, mask, scale)
-        # rotate: send our current block to the next device in the ring
-        perm = [(i, (i + 1) % n_sp) for i in range(n_sp)]
-        kb = lax.ppermute(kb, axis_name, perm)
-        vb = lax.ppermute(vb, axis_name, perm)
-        return (acc, (kb, vb)), None
+    def rotate(kb, vb):
+        p = [(i, (i + 1) % n_sp) for i in range(n_sp)]
+        return (lax.ppermute(kb, axis_name, p),
+                lax.ppermute(vb, axis_name, p))
+
+    if use_flash:
+        on_tpu = jax.default_backend() == "tpu"
+
+        def partial_fn(causal_local):
+            def f(q, kb, vb):
+                if on_tpu:
+                    o_s, lse_s = flash_attention_with_lse(
+                        q, kb, vb, causal_local, flash_block_q,
+                        flash_block_k, None)
+                else:
+                    # interpret-mode pallas does not propagate
+                    # varying-manual-axes through the kernel body, so
+                    # the CPU mesh runs the exact dense-with-lse
+                    # reference (the MERGE algebra — the part ring
+                    # adds — is still fully exercised; the kernels
+                    # themselves are interpret-tested standalone)
+                    o_s, lse_s = _ref_attention_with_lse(
+                        q, kb, vb, causal_local, scale)
+                return o_s.astype(acc_dt), lse_s
+            return f
+
+        def skip_fn(q, kb, vb):
+            # derive from q so the outputs carry q's varying-manual-
+            # axes (lax.switch requires matching branch types)
+            return ((q * 0).astype(acc_dt),
+                    (q[..., 0] * 0 + NEG_INF).astype(jnp.float32))
+
+        def step(carry, s):
+            (o, l, m), (kb, vb) = carry
+            src = (my - s) % n_sp          # who produced this block
+            if causal:
+                # ring-causal decomposition: src < my fully visible,
+                # src == my locally causal, src > my fully masked
+                idx = jnp.where(src == my, 1,
+                                jnp.where(src < my, 0, 2))
+                o_s, lse_s = lax.switch(
+                    idx, (partial_fn(False), partial_fn(True),
+                          skip_fn), q, kb, vb)
+            else:
+                o_s, lse_s = partial_fn(False)(q, kb, vb)
+            # exact merge of normalized partials via log-sum-exps;
+            # fully-masked rows (lse == -inf) contribute zero weight
+            m_new = jnp.maximum(m, lse_s)
+            c_old = jnp.where(m <= NEG_INF / 2, 0.0,
+                              jnp.exp(m - m_new))
+            c_new = jnp.where(lse_s <= NEG_INF / 2, 0.0,
+                              jnp.exp(lse_s - m_new))
+            o = o * c_old[..., None] + o_s * c_new[..., None]
+            l = l * c_old + c_new
+            return ((o, l, m_new), rotate(kb, vb)), None
+    else:
+        def step(carry, s):
+            (o, l, m), (kb, vb) = carry
+            src = (my - s) % n_sp          # who produced this block
+            mask = None
+            if causal:
+                k_pos = src * t_local + jnp.arange(t_local)
+                mask = q_pos[:, None] >= k_pos[None, :]
+            acc = _block_update((o, l, m), q, kb, vb, mask, scale)
+            return (acc, rotate(kb, vb)), None
 
     (acc, _), _ = lax.scan(step, ((o0, l0, m0), (k, v)),
                            jnp.arange(n_sp))
@@ -610,7 +749,8 @@ def ring_attention(q, k, v, axis_name: str, *, causal: bool = False,
     return _finalize(o, l).astype(q.dtype)
 
 
-def _seq_sharded_call(local_fn, mesh, q, k, v, seq_axis, causal):
+def _seq_sharded_call(local_fn, mesh, q, k, v, seq_axis, causal,
+                      **kw):
     """Common shard_map plumbing: q/k/v are GLOBAL [b, h, t, d] arrays;
     time sharded over ``seq_axis``, batch over ``data`` when present."""
     from jax.sharding import PartitionSpec as P
@@ -618,15 +758,16 @@ def _seq_sharded_call(local_fn, mesh, q, k, v, seq_axis, causal):
     data = "data" if "data" in mesh.axis_names else None
     spec = P(data, None, seq_axis, None)
     fn = _shard_map(
-        functools.partial(local_fn, axis_name=seq_axis, causal=causal),
+        functools.partial(local_fn, axis_name=seq_axis, causal=causal,
+                          **kw),
         mesh, in_specs=(spec, spec, spec), out_specs=spec)
     return fn(q, k, v)
 
 
 def ring_self_attention(mesh, q, k, v, *, seq_axis: str = "seq",
-                        causal: bool = False):
+                        causal: bool = False, use_flash: bool = False):
     return _seq_sharded_call(ring_attention, mesh, q, k, v, seq_axis,
-                             causal)
+                             causal, use_flash=use_flash)
 
 
 # ---------------------------------------------------------------------------
