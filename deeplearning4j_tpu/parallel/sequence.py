@@ -774,12 +774,17 @@ def ring_self_attention(mesh, q, k, v, *, seq_axis: str = "seq",
 # Ulysses — all-to-all sequence parallelism
 # ---------------------------------------------------------------------------
 def ulysses_attention(q, k, v, axis_name: str, *, causal: bool = False,
-                      block_k: int = 256):
+                      block_k: int = 256, use_flash: bool = False):
     """DeepSpeed-Ulysses-style SP. Call INSIDE shard_map with
     [b, h, t_local, d] shards, h divisible by the axis size: all-to-all
     re-shards time->heads, local attention sees the FULL sequence for
     h/n heads, then all-to-all back. Two collectives total; better
-    ICI utilisation than a ring when h >= n_sp."""
+    ICI utilisation than a ring when h >= n_sp.
+
+    ``use_flash=True`` (r4): the local full-sequence attention runs
+    the Pallas flash kernels (fwd + the dq/dkv backward) on TPU; CPU
+    backends keep the blockwise form (interpret-mode pallas cannot
+    propagate varying-manual-axes under shard_map)."""
     # [b, h, t/n, d] -> [b, h/n, t, d]
     qh = lax.all_to_all(q, axis_name, split_axis=1, concat_axis=2,
                         tiled=True)
@@ -787,13 +792,18 @@ def ulysses_attention(q, k, v, axis_name: str, *, causal: bool = False,
                         tiled=True)
     vh = lax.all_to_all(v, axis_name, split_axis=1, concat_axis=2,
                         tiled=True)
-    o = blockwise_attention(qh, kh, vh, causal=causal, block_k=block_k)
+    if use_flash and jax.default_backend() == "tpu":
+        o = flash_attention(qh, kh, vh, causal)
+    else:
+        o = blockwise_attention(qh, kh, vh, causal=causal,
+                                block_k=block_k)
     # [b, h/n, t, d] -> [b, h, t/n, d]
     return lax.all_to_all(o, axis_name, split_axis=2, concat_axis=1,
                           tiled=True)
 
 
 def ulysses_self_attention(mesh, q, k, v, *, seq_axis: str = "seq",
-                           causal: bool = False):
+                           causal: bool = False,
+                           use_flash: bool = False):
     return _seq_sharded_call(ulysses_attention, mesh, q, k, v, seq_axis,
-                             causal)
+                             causal, use_flash=use_flash)
