@@ -13,6 +13,9 @@ Prints ONE JSON line:
   {"metric": "bert_imported_mlm_train_throughput", ...}
 
 Flags: --batch N --seq N --dtype bfloat16|float32 --steps N
+       --max-predictions K   (gathered-K decode head, the native
+                              bench's FLOP-matched shape; default
+                              decodes every position)
 """
 from __future__ import annotations
 
@@ -42,7 +45,8 @@ def _frozen_graph_cached(seq, batch, cache_dir="/tmp/dl4j_tpu_bench"):
     return gd
 
 
-def main(batch=64, seq=128, steps=8, dtype="float32"):
+def main(batch=64, seq=128, steps=8, dtype="float32",
+         max_predictions=None):
     import jax
 
     from benchmarks.tf_bert_builder import (BERT_BASE,
@@ -57,17 +61,28 @@ def main(batch=64, seq=128, steps=8, dtype="float32"):
     sd, _ = import_and_attach_mlm(
         gd, batch, seq, vocab=BERT_BASE["vocab"],
         hidden=BERT_BASE["hidden"], updater=Adam(1e-4),
-        dtype=None if dtype == "float32" else dtype)
+        dtype=None if dtype == "float32" else dtype,
+        max_predictions=max_predictions)
 
     rs = np.random.RandomState(0)
     ids = rs.randint(0, BERT_BASE["vocab"],
                      (batch, seq)).astype(np.int32)
     seg = np.zeros((batch, seq), np.int32)
     mask = np.ones((batch, seq), np.int32)
-    labels = np.where(rs.rand(batch, seq) < 0.15,
-                      rs.randint(0, BERT_BASE["vocab"], (batch, seq)),
-                      -1).astype(np.int32)
-    b = {"ids": ids, "seg": seg, "mask": mask, "mlm_labels": labels}
+    b = {"ids": ids, "seg": seg, "mask": mask}
+    if max_predictions is None:
+        b["mlm_labels"] = np.where(
+            rs.rand(batch, seq) < 0.15,
+            rs.randint(0, BERT_BASE["vocab"], (batch, seq)),
+            -1).astype(np.int32)
+    else:
+        # the native bench's shape: k gathered positions per sequence
+        b["mlm_positions"] = np.stack(
+            [rs.choice(seq, max_predictions, replace=False)
+             for _ in range(batch)]).astype(np.int32)
+        b["mlm_labels"] = rs.randint(
+            0, BERT_BASE["vocab"],
+            (batch, max_predictions)).astype(np.int32)
 
     # compile + warm (sd.fit builds the jitted step on first batch)
     hist = sd.fit([b], n_epochs=1, placeholders_fn=lambda x: x)
@@ -94,6 +109,8 @@ def main(batch=64, seq=128, steps=8, dtype="float32"):
             **stats,
             "unit": "tokens/sec/chip",
             "batch": batch, "seq": seq, "dtype": dtype,
+            "mlm_head": ("full-decode" if max_predictions is None
+                         else f"gathered-{max_predictions}"),
             "import_path": "TF GraphDef -> S6 -> one jitted program"}
     print(json.dumps(line))
     return line
@@ -105,5 +122,11 @@ if __name__ == "__main__":
     ap.add_argument("--seq", type=int, default=128)
     ap.add_argument("--steps", type=int, default=8)
     ap.add_argument("--dtype", default="float32")
+    ap.add_argument("--max-predictions", type=int, default=None,
+                    help="gather this many positions per sequence "
+                         "before the decode matmul (the native "
+                         "bench's FLOP-matched head); default "
+                         "decodes every position")
     a = ap.parse_args()
-    main(batch=a.batch, seq=a.seq, steps=a.steps, dtype=a.dtype)
+    main(batch=a.batch, seq=a.seq, steps=a.steps, dtype=a.dtype,
+         max_predictions=a.max_predictions)

@@ -102,7 +102,8 @@ def build_frozen_bert(seq: int, batch: int, *, vocab=30522, hidden=768,
 
 
 def import_and_attach_mlm(gd_bytes, batch, seq, *, vocab, hidden,
-                          updater=None, dtype=None):
+                          updater=None, dtype=None,
+                          max_predictions=None):
     """Import the frozen encoder, promote every frozen weight to a
     trainable VARIABLE, and attach a weight-tied MLM objective:
     logits = seq_out @ tok_embedding^T, sparse softmax xent over the
@@ -110,7 +111,13 @@ def import_and_attach_mlm(gd_bytes, batch, seq, *, vocab, hidden,
     (sd, loss_name).  ``dtype`` (e.g. ``"bfloat16"``) casts the
     promoted weights so the whole imported program runs in that
     compute dtype — master-weight semantics are NOT preserved; it is
-    the honest 'imported graph, bf16 math' configuration."""
+    the honest 'imported graph, bf16 math' configuration.
+
+    ``max_predictions=k`` gathers k positions per sequence (the
+    ``mlm_positions`` [b, k] placeholder) before the decode matmul —
+    the same gathered head the native ``models/bert.py`` uses, so the
+    imported-vs-native comparison is FLOP-matched; labels are then
+    [b, k].  ``None`` decodes every position (labels [b, seq])."""
     import numpy as _np
 
     from deeplearning4j_tpu.autodiff.samediff import VariableType
@@ -146,9 +153,18 @@ def import_and_attach_mlm(gd_bytes, batch, seq, *, vocab, hidden,
     if len(tok) != 1:
         raise RuntimeError(f"expected one (vocab, hidden) weight, "
                            f"found {tok}")
-    logits = sd._op("matmul", [sd.vars[out], sd.vars[tok[0]]],
+    seq_out = sd.vars[out]
+    if max_predictions is not None:
+        positions = sd.placeholder("mlm_positions",
+                                   shape=(batch, max_predictions))
+        seq_out = sd._op("gather", [seq_out, positions],
+                         {"axis": 1, "batch_dims": 1})
+    logits = sd._op("matmul", [seq_out, sd.vars[tok[0]]],
                     {"transpose_b": True})
-    labels = sd.placeholder("mlm_labels", shape=(batch, seq))
+    labels = sd.placeholder(
+        "mlm_labels",
+        shape=(batch, seq if max_predictions is None
+               else max_predictions))
     zero = sd.constant("mlm_zero", np.asarray(0, np.int32))
     safe = sd._op("maximum", [labels, zero])
     xent = sd._op("sparse_softmax_cross_entropy", [safe, logits],

@@ -1,0 +1,74 @@
+"""Long-context training with ring-attention context parallelism.
+
+The reference handles sequence scale only via truncated BPTT
+(SURVEY.md §5.7); this framework makes long context first-class: the
+sequence is time-sharded over a dedicated ``seq`` mesh axis and
+attention runs as RING attention — K/V shards rotate around the axis
+via ``ppermute`` while each device accumulates its queries' partial
+softmax exactly (log-sum-exp merge). On TPU the per-shard work rides
+the Pallas flash kernels (``use_flash=True``), measured 320x faster
+than differentiated blockwise scan for a causal seq-8192 train step
+(BENCH_notes_r04.md).
+
+Here: the flagship ``DistributedTransformerLM`` on a
+pipe=2 x seq=2 x model=2 mesh learning a tiny next-token task, every
+strategy active in ONE jitted train step. Needs 8 devices — on a
+single-chip or CPU host a virtual 8-device CPU mesh is provisioned
+in-process.
+"""
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+import numpy as np
+
+
+def ensure_devices(n):
+    import jax
+    if len(jax.devices()) >= n:
+        return
+    import jax.extend.backend as eb
+    eb.clear_backends()
+    jax.config.update("jax_platforms", "cpu")
+    jax.config.update("jax_num_cpu_devices", n)
+    assert len(jax.devices()) >= n
+
+
+def main():
+    ensure_devices(8)
+    import jax
+
+    from deeplearning4j_tpu.learning import Adam
+    from deeplearning4j_tpu.models.transformer import (
+        DistributedTransformerLM, TransformerLMConfig)
+    from deeplearning4j_tpu.parallel import make_mesh
+
+    # ring-CP layout: time sharded over `seq`, K/V rotating via
+    # ppermute; tensor parallel over `model`, GPipe over `pipe`
+    mesh = make_mesh({"data": 1, "pipe": 2, "seq": 2, "model": 2},
+                     jax.devices()[:8])
+    conf = TransformerLMConfig(vocab_size=64, max_len=32, d_model=32,
+                               n_heads=4, d_ff=64, layers_per_stage=2)
+    model = DistributedTransformerLM(conf, mesh, Adam(3e-3), n_micro=2)
+    params, opt = model.init(seed=0)
+
+    # toy "long context" task: predict the next token of a fixed
+    # periodic sequence (period 8, so attention must look back)
+    rng = np.random.RandomState(0)
+    base = rng.randint(0, 64, 8)
+    seq = np.tile(base, 32 // 8 + 1)
+    ids = np.stack([seq[:32]] * 4).astype(np.int32)
+    labels = np.stack([seq[1:33]] * 4).astype(np.int32)
+
+    for step in range(30):
+        params, opt, loss = model.train_step(params, opt, ids,
+                                             labels, step)
+        if step % 10 == 0 or step == 29:
+            print(f"step {step:3d}  loss {float(loss):.4f}")
+    assert float(loss) < 2.0, "ring-CP training failed to learn"
+    print("ring-attention CP training: ok")
+
+
+if __name__ == "__main__":
+    main()

@@ -79,3 +79,73 @@ class TestBertBaseRealDims:
         assert np.isfinite(curve).all()
         # ln(30522) ~ 10.3 start; 10 Adam steps on one batch must cut it
         assert curve[-1] < 0.7 * curve[0], curve
+
+
+class TestGatheredMlmHead:
+    """``import_and_attach_mlm(max_predictions=k)`` — the FLOP-matched
+    gathered decode head the imported-model benchmark compares against
+    the native model (BENCH_notes_r04.md).  Toy dims: equivalence, not
+    scale (real dims are covered above)."""
+
+    def test_gathered_head_matches_full_head_loss(self):
+        vocab, hidden, heads, layers, seq, batch, k = \
+            50, 16, 2, 2, 16, 2, 4
+        gd, _ = build_frozen_bert(seq, batch, vocab=vocab,
+                                  hidden=hidden, heads=heads,
+                                  layers=layers, intermediate=32)
+        rs = np.random.RandomState(7)
+        ids = rs.randint(0, vocab, (batch, seq)).astype(np.int32)
+        seg = np.zeros((batch, seq), np.int32)
+        mask = np.ones((batch, seq), np.int32)
+        positions = np.stack(
+            [rs.choice(seq, k, replace=False)
+             for _ in range(batch)]).astype(np.int32)
+        lab_k = rs.randint(0, vocab, (batch, k)).astype(np.int32)
+        # full-head labels: the same labels scattered at the gathered
+        # positions, -1 (ignored) everywhere else
+        lab_full = np.full((batch, seq), -1, np.int32)
+        for b in range(batch):
+            lab_full[b, positions[b]] = lab_k[b]
+
+        sd_full, _ = import_and_attach_mlm(
+            gd, batch, seq, vocab=vocab, hidden=hidden)
+        sd_gat, _ = import_and_attach_mlm(
+            gd, batch, seq, vocab=vocab, hidden=hidden,
+            max_predictions=k)
+        feeds = {"ids": ids, "seg": seg, "mask": mask}
+        loss_full = sd_full.output(
+            {**feeds, "mlm_labels": lab_full},
+            ["mlm_loss"])["mlm_loss"]
+        loss_gat = sd_gat.output(
+            {**feeds, "mlm_positions": positions,
+             "mlm_labels": lab_k},
+            ["mlm_loss"])["mlm_loss"]
+        np.testing.assert_allclose(loss_gat, loss_full,
+                                   rtol=1e-5, atol=1e-6)
+
+    def test_gathered_head_trains(self):
+        vocab, hidden, heads, layers, seq, batch, k = \
+            50, 16, 2, 2, 16, 2, 4
+        gd, _ = build_frozen_bert(seq, batch, vocab=vocab,
+                                  hidden=hidden, heads=heads,
+                                  layers=layers, intermediate=32)
+        from deeplearning4j_tpu.learning import Adam
+        sd, _ = import_and_attach_mlm(
+            gd, batch, seq, vocab=vocab, hidden=hidden,
+            updater=Adam(1e-2), max_predictions=k)
+        rs = np.random.RandomState(1)
+        batch_d = {
+            "ids": rs.randint(0, vocab,
+                              (batch, seq)).astype(np.int32),
+            "seg": np.zeros((batch, seq), np.int32),
+            "mask": np.ones((batch, seq), np.int32),
+            "mlm_positions": np.stack(
+                [rs.choice(seq, k, replace=False)
+                 for _ in range(batch)]).astype(np.int32),
+            "mlm_labels": rs.randint(0, vocab,
+                                     (batch, k)).astype(np.int32)}
+        hist = sd.fit([batch_d] * 20, n_epochs=1,
+                      placeholders_fn=lambda b: b)
+        curve = hist.loss_curve()
+        assert np.isfinite(curve).all()
+        assert curve[-1] < 0.5 * curve[0], curve
