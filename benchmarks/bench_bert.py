@@ -1,11 +1,14 @@
 """Secondary benchmark: BERT-base MLM pretraining throughput
 (BASELINE config #4). bf16 + per-layer FULL remat + XLA fused
-attention, batch 1024 x seq 128 — the r4 remat-policy sweep's winner
-(BENCH_notes_r04.md: full remat beats dots_saveable at every batch,
-and batch is the MFU lever: 256 -> 1024 took 30.1% -> 38.2% of bf16
-peak; dots/no-remat at larger batches fail compile). XLA fused
-attention measured 1.33x over the Pallas flash kernel at BERT shapes
-(BENCH_notes_r03.md); flash remains the long-context/CP path.
+attention, batch 128 x seq 128, fit_steps fori-loop protocol — the
+late-r4 sweep's winner (BENCH_notes_r04.md: once the per-step
+dispatch+sync tax is amortized by the fori loop, SMALL batches win —
+b128 49.2% of bf16 peak vs b1024's 43.9%; the earlier "batch is the
+MFU lever" finding was partly that tax). Full remat still beats
+dots_saveable/no-remat at every batch. XLA fused attention measured
+1.33x over the Pallas flash kernel at BERT shapes and 1.8x at seq
+512 (kernel-backward era re-measurement); flash remains the
+long-context/CP path (crossover ~2k tokens).
 
 Prints ONE JSON line: {"metric": "bert_mlm_train_throughput", ...}.
 CLI flags reproduce the published A/B legs:
@@ -26,7 +29,7 @@ sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
 from benchmarks.cost_util import V5E_BF16_PEAK_TFLOPS  # noqa: E402
 
 
-def main(batch=1024, seq=128, steps=8, max_predictions=32,
+def main(batch=128, seq=128, steps=60, max_predictions=32,
          flash=False, remat="full"):
     from deeplearning4j_tpu.learning import Adam
     from deeplearning4j_tpu.models.bert import Bert, BertConfig
@@ -63,15 +66,17 @@ def main(batch=1024, seq=128, steps=8, max_predictions=32,
     batch_d = {"input_ids": jax.device_put(jnp.asarray(ids)),
                "mlm_labels": jax.device_put(jnp.asarray(mlm_labels))}
 
-    model.fit_batch(batch_d)      # compile; fit_batch syncs on loss
+    model.fit_steps(batch_d, steps)   # compile; syncs on final loss
 
     from benchmarks.timing import median_throughput
 
     def run_once():
-        loss = None
-        for _ in range(steps):
-            loss = model.fit_batch(batch_d)  # each call syncs on loss
-        assert loss is not None and np.isfinite(loss)
+        # ONE fori-loop dispatch + one loss sync per trial: the
+        # per-step dispatch+sync tax through the axon tunnel is fixed,
+        # so amortizing it measures device-limited throughput (the
+        # char-RNN protocol, BENCH_notes_r04.md)
+        loss = model.fit_steps(batch_d, steps)
+        assert np.isfinite(loss)
 
     stats = median_throughput(run_once, steps * batch * seq,
                               n_trials=5 if on_tpu else 3)
@@ -102,9 +107,9 @@ def main(batch=1024, seq=128, steps=8, max_predictions=32,
 if __name__ == "__main__":
     import argparse
     ap = argparse.ArgumentParser()
-    ap.add_argument("--batch", type=int, default=1024)
+    ap.add_argument("--batch", type=int, default=128)
     ap.add_argument("--seq", type=int, default=128)
-    ap.add_argument("--steps", type=int, default=8)
+    ap.add_argument("--steps", type=int, default=60)
     ap.add_argument("--max-predictions", type=int, default=32)
     ap.add_argument("--flash", action="store_true",
                     help="use the Pallas flash-attention kernel "

@@ -799,11 +799,12 @@ class SameDiff:
     def set_training_config(self, config):
         self.training_config = config
         # compiled train steps bake the updater/regularization in
-        self._exec_cache = {k: v for k, v in self._exec_cache.items()
-                            if not (isinstance(k, tuple)
-                                    and k and k[0] == "train")}
+        self._exec_cache = {
+            k: v for k, v in self._exec_cache.items()
+            if not (isinstance(k, tuple) and k
+                    and k[0] in ("train", "train_multi"))}
 
-    def _build_train_step(self, ph_names: Tuple[str, ...]):
+    def _build_raw_train_step(self, ph_names: Tuple[str, ...]):
         cfg = self.training_config
         fn, var_names = self._build_fn(tuple(self.loss_variables),
                                        ph_names, True)
@@ -825,11 +826,77 @@ class SameDiff:
             loss, grads = jax.value_and_grad(loss_fn)(var_vals)
             updates, new_state = updater.apply(grads, upd_state,
                                                iteration)
-            new_vars = jax.tree_util.tree_map(lambda p, u: p - u,
-                                              var_vals, updates)
+            # updater math (bias corrections etc.) may run in f32;
+            # apply it at full precision, then keep each variable's
+            # own dtype — without the cast, bf16 variables silently
+            # promote to f32 after one step (and recompile the step)
+            new_vars = jax.tree_util.tree_map(
+                lambda p, u: (p - u).astype(p.dtype),
+                var_vals, updates)
             return new_vars, new_state, loss
 
+        return step, trainable
+
+    def _build_train_step(self, ph_names: Tuple[str, ...]):
+        step, trainable = self._build_raw_train_step(ph_names)
         return jax.jit(step, donate_argnums=(0, 1)), trainable
+
+    def fit_steps(self, placeholders: Dict, n_steps: int) -> float:
+        """``n_steps`` train-step updates on ONE fixed placeholder
+        batch inside a single ``lax.fori_loop`` dispatch, syncing on
+        the final loss once. The benchmark-grade loop (same recipe as
+        ``MultiLayerNetwork.fit_steps``): per-step dispatch + loss
+        sync through a TPU tunnel is a fixed tax that the fori-loop
+        amortizes. Per-step RNG is ``fold_in(rng, i)``; the updater
+        iteration starts at 0 like ``fit``'s."""
+        cfg = self.training_config
+        if cfg is None:
+            raise ValueError("call set_training_config first")
+        if not self.loss_variables:
+            raise ValueError("call set_loss_variables first")
+        ph_vals = {k: jnp.asarray(v) for k, v in placeholders.items()}
+        key = tuple(sorted(ph_vals))
+        cached = self._exec_cache.get(("train_multi", key))
+        if cached is None:
+            raw, trainable = self._build_raw_train_step(tuple(ph_vals))
+
+            def multi(var_vals, upd_state, ph, rng, n):
+                def body(i, carry):
+                    vv, us, _ = carry
+                    vv, us, loss = raw(vv, us, ph, i,
+                                       jax.random.fold_in(rng, i))
+                    return vv, us, jnp.float32(loss)
+
+                return jax.lax.fori_loop(
+                    0, n, body,
+                    (var_vals, upd_state, jnp.float32(0)))
+
+            cached = (jax.jit(multi, static_argnums=(4,),
+                              donate_argnums=(0, 1)), trainable)
+            self._exec_cache[("train_multi", key)] = cached
+        multi_fn, trainable = cached
+        # checked on EVERY call (not just compile): a subgraph traced
+        # after the first fit_steps can freeze a trainable into a
+        # closure, and the cached fori program would keep reusing the
+        # stale baked capture while training the variable
+        if self._frozen_captured_vars \
+                and self._frozen_captured_vars & set(trainable):
+            raise ValueError(
+                "fit_steps cannot train variables frozen into "
+                "nested-subgraph closures (their values are baked "
+                "per compile; the fori-loop would keep reusing "
+                "stale captures) — use fit(), which retraces per "
+                "step in that case")
+        if self._updater_state is None:
+            self._updater_state = cfg.updater.init_state(
+                {n: self._arrays[n] for n in trainable})
+            self._restore_updater_leaves()
+        var_vals = {n: self._arrays[n] for n in trainable}
+        self._rng, rng = jax.random.split(self._rng)
+        new_vars, self._updater_state, loss = multi_fn(
+            var_vals, self._updater_state, ph_vals, rng, n_steps)
+        self._arrays.update(new_vars)
+        return float(loss)
 
     def fit(self, iterator=None, *, n_epochs: int = 1,
             placeholders_fn=None):

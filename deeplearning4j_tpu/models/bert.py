@@ -39,20 +39,29 @@ from deeplearning4j_tpu.ops.attention import (dot_product_attention,
                                               merge_heads, split_heads)
 
 
-def _make_train_step(loss_fn, updater):
-    """Jitted functional train step shared by every model head:
+def _raw_step(loss_fn, updater):
+    """Functional train step shared by every model head:
     (params, opt_state, iteration, batch, rng) -> (params', state',
-    loss). Params/opt-state buffers are donated (XLA reuses them)."""
+    loss)."""
 
     def step(params, opt_state, iteration, batch, rng):
         loss, grads = jax.value_and_grad(
             lambda p: loss_fn(p, batch, rng))(params)
         updates, new_state = updater.apply(grads, opt_state, iteration)
-        new_params = jax.tree_util.tree_map(lambda p, u: p - u,
-                                            params, updates)
+        # apply the (possibly f32) updater math at full precision but
+        # keep each param's own dtype — bf16 params would otherwise
+        # silently promote to f32 after one step
+        new_params = jax.tree_util.tree_map(
+            lambda p, u: (p - u).astype(p.dtype), params, updates)
         return new_params, new_state, loss
 
-    return jax.jit(step, donate_argnums=(0, 1))
+    return step
+
+
+def _make_train_step(loss_fn, updater):
+    """Jitted train step; params/opt-state buffers are donated (XLA
+    reuses them)."""
+    return jax.jit(_raw_step(loss_fn, updater), donate_argnums=(0, 1))
 
 
 class _Trainable:
@@ -64,17 +73,54 @@ class _Trainable:
     def _loss_fn(self, params, batch, rng):
         raise NotImplementedError
 
-    def fit_batch(self, batch) -> float:
+    def _ensure_step(self):
         if getattr(self, "_step", None) is None:
             self._step = _make_train_step(self._loss_fn, self.updater)
             self._opt_state = self.updater.init_state(self.params)
             self._iteration = 0
+
+    def fit_batch(self, batch) -> float:
+        self._ensure_step()
         batch = {k: jnp.asarray(v) for k, v in batch.items()
                  if v is not None}
         rng = jax.random.PRNGKey(np.random.randint(0, 2 ** 31))
         self.params, self._opt_state, loss = self._step(
             self.params, self._opt_state, self._iteration, batch, rng)
         self._iteration += 1
+        self.score_value = float(loss)
+        return self.score_value
+
+    def fit_steps(self, batch, n_steps: int) -> float:
+        """``n_steps`` updates on ONE device-resident batch inside a
+        single ``lax.fori_loop`` dispatch, syncing on the final loss
+        once — the benchmark-grade loop (same recipe as
+        ``MultiLayerNetwork.fit_steps``: per-step dispatch + loss
+        sync through a TPU tunnel is a fixed tax that a fori-loop
+        amortizes). Per-step RNG is ``fold_in(rng, i)``."""
+        self._ensure_step()
+        if getattr(self, "_multi_step", None) is None:
+            raw = _raw_step(self._loss_fn, self.updater)
+
+            def multi(params, opt_state, it0, batch, rng, n):
+                def body(i, carry):
+                    p, s, _ = carry
+                    p, s, l = raw(p, s, it0 + i, batch,
+                                  jax.random.fold_in(rng, i))
+                    return p, s, jnp.float32(l)
+
+                return lax.fori_loop(
+                    0, n, body,
+                    (params, opt_state, jnp.float32(0)))
+
+            self._multi_step = jax.jit(multi, static_argnums=(5,),
+                                       donate_argnums=(0, 1))
+        batch = {k: jnp.asarray(v) for k, v in batch.items()
+                 if v is not None}
+        rng = jax.random.PRNGKey(np.random.randint(0, 2 ** 31))
+        self.params, self._opt_state, loss = self._multi_step(
+            self.params, self._opt_state, self._iteration, batch,
+            rng, n_steps)
+        self._iteration += n_steps
         self.score_value = float(loss)
         return self.score_value
 

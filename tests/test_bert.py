@@ -52,6 +52,27 @@ class TestBertEncoder:
         np.testing.assert_allclose(np.asarray(seq1[:, :12]),
                                    np.asarray(seq2[:, :12]), atol=1e-5)
 
+    def test_fit_steps_matches_per_step_fit(self):
+        """One fori-loop dispatch of n steps == n fit_batch calls
+        (dropout off, so the per-step rng is inert and the update
+        sequence is deterministic)."""
+        conf = BertConfig.tiny(hidden_dropout_prob=0.0,
+                               attention_probs_dropout_prob=0.0)
+        batch = _mlm_batch()
+        a = Bert(conf, updater=Adam(1e-3)).init()
+        b = Bert(conf, updater=Adam(1e-3)).init()
+        b.params = jax.tree_util.tree_map(jnp.array, a.params)
+        losses = [a.fit_batch(batch) for _ in range(5)]
+        final = b.fit_steps(batch, 5)
+        np.testing.assert_allclose(final, losses[-1],
+                                   rtol=1e-5, atol=1e-6)
+        # params marched in lockstep too
+        for la, lb in zip(jax.tree_util.tree_leaves(a.params),
+                          jax.tree_util.tree_leaves(b.params)):
+            np.testing.assert_allclose(np.asarray(la),
+                                       np.asarray(lb),
+                                       rtol=2e-4, atol=2e-5)
+
     def test_pretraining_learns(self):
         bert = Bert(BertConfig.tiny(), updater=Adam(1e-3)).init()
         batch = _mlm_batch()

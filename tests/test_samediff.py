@@ -279,3 +279,120 @@ def test_nms_pads_with_minus_one():
         {"max_output_size": 5, "iou_threshold": 0.5}))
     assert out[0] == 0
     assert all(out[1:] == -1)  # second box suppressed, rest padded
+
+
+def test_fit_steps_matches_sequential_fit():
+    """One fori-loop dispatch of n steps == n sequential fit steps on
+    the same batch (the benchmark-grade loop must not change the
+    math; rng only matters for dropout, absent here)."""
+    def build():
+        sd = SameDiff.create()
+        x = sd.placeholder("x", shape=(None, 2))
+        y = sd.placeholder("y", shape=(None, 1))
+        w = sd.var("w", array=np.zeros((2, 1), np.float32))
+        b = sd.var("b", array=np.zeros((1,), np.float32))
+        sd.loss.mean_squared_error(y, x @ w + b, name="loss")
+        sd.set_loss_variables("loss")
+        sd.set_training_config(
+            TrainingConfig.Builder().updater(Adam(0.1))
+            .data_set_feature_mapping("x")
+            .data_set_label_mapping("y").build())
+        return sd
+
+    rng = np.random.RandomState(0)
+    xv = rng.randn(64, 2).astype(np.float32)
+    yv = (xv @ np.array([[2.0], [-3.0]], np.float32)) + 0.5
+    batch = {"x": xv, "y": yv}
+
+    sd_seq = build()
+    it = ListDataSetIterator([DataSet(xv, yv)] * 7)
+    hist = sd_seq.fit(it, n_epochs=1)
+    seq_final = hist.loss_curve()[-1]
+
+    sd_multi = build()
+    multi_final = sd_multi.fit_steps(batch, 7)
+    np.testing.assert_allclose(multi_final, seq_final,
+                               rtol=1e-5, atol=1e-7)
+    np.testing.assert_allclose(
+        np.asarray(sd_multi.get_variable("w").get_arr()),
+        np.asarray(sd_seq.get_variable("w").get_arr()),
+        rtol=1e-5, atol=1e-6)
+
+
+def test_fit_steps_then_fit_shares_updater_state():
+    """fit_steps updates persist: a following fit() resumes from the
+    advanced variables (and the updater state tree already exists)."""
+    sd = SameDiff.create()
+    x = sd.placeholder("x", shape=(None, 2))
+    y = sd.placeholder("y", shape=(None, 1))
+    w = sd.var("w", array=np.zeros((2, 1), np.float32))
+    sd.loss.mean_squared_error(y, x @ w, name="loss")
+    sd.set_loss_variables("loss")
+    sd.set_training_config(
+        TrainingConfig.Builder().updater(Adam(0.1))
+        .data_set_feature_mapping("x")
+        .data_set_label_mapping("y").build())
+    rng = np.random.RandomState(1)
+    xv = rng.randn(64, 2).astype(np.float32)
+    yv = (xv @ np.array([[1.0], [2.0]], np.float32))
+    first = sd.fit_steps({"x": xv, "y": yv}, 5)
+    hist = sd.fit(ListDataSetIterator([DataSet(xv, yv)] * 3),
+                  n_epochs=1)
+    assert hist.loss_curve()[-1] < first
+
+
+def test_bf16_variables_keep_dtype_through_training():
+    """Updater math runs in f32 (bias corrections), but a bf16
+    variable must come back bf16 from every step — the silent
+    f32 promotion recompiled the step per fit() call and broke
+    fit_steps' fori carry (round-4 regression)."""
+    sd = SameDiff.create()
+    x = sd.placeholder("x", shape=(None, 2))
+    y = sd.placeholder("y", shape=(None, 1))
+    w = sd.var("w", array=np.zeros((2, 1), np.float32))
+    sd.loss.mean_squared_error(y, x @ w, name="loss")
+    sd.set_loss_variables("loss")
+    sd.convert_to_variables(
+        ["w"], {"w": np.zeros((2, 1)).astype("bfloat16")})
+    sd.set_training_config(
+        TrainingConfig.Builder().updater(Adam(0.1))
+        .data_set_feature_mapping("x")
+        .data_set_label_mapping("y").build())
+    rng = np.random.RandomState(0)
+    xv = rng.randn(32, 2).astype(np.float32)
+    yv = (xv @ np.array([[2.0], [-3.0]], np.float32))
+    sd.fit(ListDataSetIterator([DataSet(xv, yv)] * 2), n_epochs=1)
+    assert str(sd.get_variable("w").get_arr().dtype) == "bfloat16"
+    sd.fit_steps({"x": xv, "y": yv}, 3)   # fori carry needs it too
+    assert str(sd.get_variable("w").get_arr().dtype) == "bfloat16"
+
+
+def test_set_training_config_evicts_fit_steps_cache():
+    """A new TrainingConfig must invalidate the cached fori-loop
+    program too — the updater/lr are baked into it (code-review
+    regression: only ("train", ...) entries were evicted)."""
+    sd = SameDiff.create()
+    x = sd.placeholder("x", shape=(None, 2))
+    y = sd.placeholder("y", shape=(None, 1))
+    sd.var("w", array=np.zeros((2, 1), np.float32))
+    sd.loss.mean_squared_error(y, x @ sd.get_variable("w"),
+                               name="loss")
+    sd.set_loss_variables("loss")
+    sd.set_training_config(
+        TrainingConfig.Builder().updater(Sgd(0.0))
+        .data_set_feature_mapping("x")
+        .data_set_label_mapping("y").build())
+    rng = np.random.RandomState(0)
+    xv = rng.randn(32, 2).astype(np.float32)
+    yv = (xv @ np.array([[2.0], [-3.0]], np.float32))
+    batch = {"x": xv, "y": yv}
+    sd.fit_steps(batch, 3)          # lr=0: w must not move
+    w0 = np.asarray(sd.get_variable("w").get_arr()).copy()
+    assert np.all(w0 == 0.0)
+    sd.set_training_config(
+        TrainingConfig.Builder().updater(Sgd(0.5))
+        .data_set_feature_mapping("x")
+        .data_set_label_mapping("y").build())
+    sd.fit_steps(batch, 3)          # must recompile with lr=0.5
+    w1 = np.asarray(sd.get_variable("w").get_arr())
+    assert np.any(w1 != 0.0), "stale fori program kept lr=0"
