@@ -308,6 +308,9 @@ class ResNet50(ZooModel):
     #: 3-channel one. Off by default: parameter layout differs from the
     #: reference checkpoint format.
     stem_space_to_depth: bool = False
+    #: sqrt(N)-checkpoint the training forward in this many segments
+    #: (0 = store all activations); see ComputationGraphConfiguration
+    remat_segments: int = 0
 
     # stage definitions: (n_blocks, bottleneck_width)
     STAGES: Tuple[Tuple[int, int], ...] = ((3, 64), (4, 128), (6, 256),
@@ -320,6 +323,7 @@ class ResNet50(ZooModel):
              .weight_init(WeightInit.RELU)
              .l2(1e-4)
              .compute_data_type(self.compute_dtype)
+             .remat_segments(self.remat_segments)
              .graph_builder()
              .add_inputs("input")
              .set_input_types(InputType.convolutional(

@@ -65,6 +65,9 @@ class MultiLayerConfiguration:
     tbptt_back_length: int = 20
     dtype: str = "float32"
     compute_dtype: Optional[str] = None   # None = same as dtype
+    #: remat the training forward in this many jax.checkpoint'd
+    #: segments of the layer stack (sqrt(N) checkpointing; 0 = off)
+    remat_segments: int = 0
     input_type: Optional[InputType] = None
 
     # -- JSON ------------------------------------------------------------
@@ -86,6 +89,7 @@ class MultiLayerConfiguration:
             "tbptt_back_length": self.tbptt_back_length,
             "dtype": self.dtype,
             "compute_dtype": self.compute_dtype,
+            "remat_segments": self.remat_segments,
             "input_type": self.input_type.to_map() if self.input_type
                           else None,
         }
@@ -113,6 +117,7 @@ class MultiLayerConfiguration:
             tbptt_back_length=d.get("tbptt_back_length", 20),
             dtype=d.get("dtype", "float32"),
             compute_dtype=d.get("compute_dtype"),
+            remat_segments=d.get("remat_segments", 0),
             input_type=InputType.from_map(d["input_type"])
                        if d.get("input_type") else None,
         )
@@ -255,6 +260,7 @@ class ListBuilder:
             tbptt_back_length=self._tbptt_back,
             dtype=b._dtype,
             compute_dtype=b._compute_dtype,
+            remat_segments=b._remat_segments,
             input_type=self._input_type,
         )
         for l in conf.layers:
@@ -279,6 +285,7 @@ class NeuralNetConfiguration:
             self._grad_norm_threshold = 1.0
             self._dtype = "float32"
             self._compute_dtype: Optional[str] = None
+            self._remat_segments = 0
 
         def seed(self, s: int) -> "NeuralNetConfiguration.Builder":
             self._seed = int(s)
@@ -332,6 +339,16 @@ class NeuralNetConfiguration:
             dtype (canonically 'bfloat16' on TPU — MXU-native) while
             parameters/optimizer state stay in ``data_type``."""
             self._compute_dtype = dtype
+            return self
+
+        def remat_segments(self, n: int
+                           ) -> "NeuralNetConfiguration.Builder":
+            """Rematerialize training activations in ``n``
+            ``jax.checkpoint``'d segments of the stack — only
+            segment-boundary activations are stored for backward
+            (sqrt(N) checkpointing trades recompute FLOPs for HBM
+            activation traffic; 0 = store everything)."""
+            self._remat_segments = int(n)
             return self
 
         def list(self) -> ListBuilder:  # noqa: A003

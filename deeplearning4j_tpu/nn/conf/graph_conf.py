@@ -53,6 +53,13 @@ class ComputationGraphConfiguration:
     tbptt_back_length: int = 20
     dtype: str = "float32"
     compute_dtype: Optional[str] = None   # None = same as dtype
+    #: activation rematerialization: cut the training forward walk
+    #: into this many contiguous segments, each under
+    #: ``jax.checkpoint`` — only segment-boundary activations are
+    #: stored for backward, interior ones are recomputed (the
+    #: sqrt(N)-checkpointing recipe; a TPU-first HBM-traffic knob
+    #: with no reference equivalent). 0 = store everything.
+    remat_segments: int = 0
 
     # ------------------------------------------------------------------
     def topo_order(self) -> List[str]:
@@ -127,6 +134,7 @@ class ComputationGraphConfiguration:
             "tbptt_back_length": self.tbptt_back_length,
             "dtype": self.dtype,
             "compute_dtype": self.compute_dtype,
+            "remat_segments": self.remat_segments,
         }
         return json.dumps(d, indent=2)
 
@@ -151,6 +159,7 @@ class ComputationGraphConfiguration:
             tbptt_back_length=d.get("tbptt_back_length", 20),
             dtype=d.get("dtype", "float32"),
             compute_dtype=d.get("compute_dtype"),
+            remat_segments=d.get("remat_segments", 0),
         )
         for vd in d["vertices"]:
             content = Layer.from_map(vd["content"]) \
@@ -202,6 +211,14 @@ class GraphBuilder:
         self._conf.backprop_type = t
         return self
 
+    def remat_segments(self, n: int) -> "GraphBuilder":
+        """Rematerialize training activations in ``n`` checkpointed
+        segments of the topo walk (0 = off). An explicit value here —
+        including 0 — overrides the base builder's setting."""
+        self._conf.remat_segments = int(n)
+        self._remat_explicit = True
+        return self
+
     def t_bptt_length(self, fwd: int, back: int = None) -> "GraphBuilder":
         self._conf.tbptt_fwd_length = fwd
         self._conf.tbptt_back_length = back if back is not None else fwd
@@ -218,6 +235,8 @@ class GraphBuilder:
         c.gradient_normalization_threshold = b._grad_norm_threshold
         c.dtype = b._dtype
         c.compute_dtype = b._compute_dtype
+        if not getattr(self, "_remat_explicit", False):
+            c.remat_segments = getattr(b, "_remat_segments", 0)
         from deeplearning4j_tpu.nn.conf.builders import \
             apply_layer_defaults
         for v in c.vertices.values():

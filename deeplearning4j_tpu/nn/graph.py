@@ -110,52 +110,132 @@ class ComputationGraph:
             cd = conf.compute_dtype
             params = cast_floats(params, cd)
             inputs = [cast_floats(x, cd) for x in inputs]
-        acts: Dict[str, jnp.ndarray] = dict(zip(conf.network_inputs,
-                                                inputs))
-        new_states: dict = {}
-        for name in self._topo:
+        def run_vertex(name, acts, lrng):
+            """Execute one vertex against the live activation dict;
+            returns (activation, layer_state)."""
             v = conf.vertices[name]
             xs = [acts[i] for i in v.inputs]
-            if v.is_layer:
-                h = xs[0]
-                if v.preprocessor is not None:
-                    h = v.preprocessor.pre_process(h)
-                lrng = None
-                if rng is not None:
-                    rng, lrng = jax.random.split(rng)
-                layer = v.content
-                lp = params.get(name, {})
-                if training and layer.weight_noise is not None and \
-                        lrng is not None and lp:
-                    # reference: conf.weightnoise — params perturbed
-                    # per forward; gradients flow to the clean params
-                    lrng, wn_rng = jax.random.split(lrng)
-                    lp = layer.weight_noise.apply(lp, wn_rng)
-                ls = states.get(name, {})
-                kw = {}
-                if fmask is not None and layer.accepts_mask():
-                    kw["mask"] = fmask
-                if want_logits and name in conf.network_outputs and \
-                        isinstance(layer, BaseOutputLayer) and \
-                        layer.wants_logits():
-                    h, ns = layer.forward_logits(
-                        lp, h, training=training,
-                        rng=lrng, state=ls or None)
-                else:
-                    h, ns = layer.forward(
-                        lp, h, training=training,
-                        rng=lrng, state=ls or None, **kw)
-                new_states[name] = ns if ns is not None else {}
-                acts[name] = h
+            if not v.is_layer:
+                return v.content.forward(xs, training=training), {}
+            h = xs[0]
+            if v.preprocessor is not None:
+                h = v.preprocessor.pre_process(h)
+            layer = v.content
+            lp = params.get(name, {})
+            if training and layer.weight_noise is not None and \
+                    lrng is not None and lp:
+                # reference: conf.weightnoise — params perturbed
+                # per forward; gradients flow to the clean params
+                lrng, wn_rng = jax.random.split(lrng)
+                lp = layer.weight_noise.apply(lp, wn_rng)
+            ls = states.get(name, {})
+            kw = {}
+            if fmask is not None and layer.accepts_mask():
+                kw["mask"] = fmask
+            if want_logits and name in conf.network_outputs and \
+                    isinstance(layer, BaseOutputLayer) and \
+                    layer.wants_logits():
+                h, ns = layer.forward_logits(
+                    lp, h, training=training,
+                    rng=lrng, state=ls or None)
             else:
-                acts[name] = v.content.forward(xs, training=training)
-                new_states[name] = {}
+                h, ns = layer.forward(
+                    lp, h, training=training,
+                    rng=lrng, state=ls or None, **kw)
+            return h, ns if ns is not None else {}
+
+        if training and conf.remat_segments > 1 and \
+                len(self._topo) > 1:
+            acts, new_states = self._forward_segmented(run_vertex, rng,
+                                                       inputs)
+        else:
+            acts = dict(zip(conf.network_inputs, inputs))
+            new_states = {}
+            for name in self._topo:
+                lrng = None
+                if rng is not None and conf.vertices[name].is_layer:
+                    rng, lrng = jax.random.split(rng)
+                h, ns = run_vertex(name, acts, lrng)
+                acts[name] = h
+                new_states[name] = ns
         if self.conf.compute_dtype:
             from deeplearning4j_tpu.common.dtypes import cast_floats
             for out in self.conf.network_outputs:
                 acts[out] = cast_floats(acts[out], self._dtype)
             new_states = cast_floats(new_states, self._dtype)
         return acts, new_states
+
+    def _forward_segmented(self, run_vertex, rng, inputs):
+        """Training forward in ``conf.remat_segments`` contiguous
+        ``jax.checkpoint`` segments of the topo walk: only the
+        activations LIVE at a segment boundary are stored for the
+        backward pass; everything inside a segment is recomputed
+        (sqrt(N) checkpointing — trades recompute FLOPs for HBM
+        activation traffic, usually a win on bandwidth-bound TPUs).
+        Per-vertex RNG is pre-split so the stream does not depend on
+        the segmentation."""
+        conf = self.conf
+        topo = self._topo
+        n_seg = min(conf.remat_segments, len(topo))
+        bounds = np.linspace(0, len(topo), n_seg + 1).astype(int)
+        segments = [topo[bounds[i]:bounds[i + 1]]
+                    for i in range(n_seg)]
+
+        layer_names = [n for n in topo if conf.vertices[n].is_layer]
+        if rng is not None and layer_names:
+            keys = jax.random.split(rng, len(layer_names))
+            rng_for = {n: keys[i] for i, n in enumerate(layer_names)}
+        else:
+            rng_for = {}
+
+        # liveness: an activation must cross a segment boundary iff a
+        # later vertex consumes it or it is a network output
+        consumers: Dict[str, list] = {}
+        for name in topo:
+            for src in conf.vertices[name].inputs:
+                consumers.setdefault(src, []).append(name)
+        pos = {n: i for i, n in enumerate(topo)}
+
+        def needed_after(idx_end):
+            keep = set(conf.network_outputs)
+            for src, cons in consumers.items():
+                if any(pos[c] >= idx_end for c in cons):
+                    keep.add(src)
+            return keep
+
+        live: Dict[str, jnp.ndarray] = dict(zip(conf.network_inputs,
+                                                inputs))
+        new_states: dict = {}
+        for i, seg in enumerate(segments):
+            produced = set(seg)
+            refs = {src for n in seg
+                    for src in conf.vertices[n].inputs}
+            seg_in = sorted(refs - produced)
+            keep = needed_after(bounds[i + 1])
+            seg_out = sorted(produced & keep)
+            seg_rngs = {n: rng_for[n] for n in seg if n in rng_for}
+
+            def seg_fn(in_acts, seg_rngs, seg=seg, seg_out=seg_out):
+                acts = dict(in_acts)
+                ns = {}
+                for name in seg:
+                    h, s = run_vertex(name, acts,
+                                      seg_rngs.get(name))
+                    acts[name] = h
+                    ns[name] = s
+                return {k: acts[k] for k in seg_out}, ns
+
+            if i + 1 < n_seg:
+                seg_fn = jax.checkpoint(seg_fn)
+            # the LAST segment holds the loss head; checkpointing it
+            # buys nothing (its activations feed the loss directly)
+            outs, ns = seg_fn({k: live[k] for k in seg_in}, seg_rngs)
+            live.update(outs)
+            new_states.update(ns)
+            # prune dead activations so they do not stay resident
+            # (reuses this segment's liveness set from above)
+            live = {k: v for k, v in live.items() if k in keep}
+        return live, new_states
 
     # -- recurrent state lifecycle (mirrors MultiLayerNetwork) ----------
     def _recurrent_names(self):

@@ -126,16 +126,13 @@ class MultiLayerNetwork:
         new_states = {}
         h = x
         n = len(conf.layers)
-        for i, layer in enumerate(conf.layers):
-            if stop_at is not None and i >= stop_at:
-                break
+
+        def run_layer(i, h, lrng):
+            layer = conf.layers[i]
             if i in conf.input_preprocessors:
                 h = conf.input_preprocessors[i].pre_process(h)
             lp = params.get(f"layer_{i}", {})
             ls = states.get(f"layer_{i}", {})
-            lrng = None
-            if rng is not None:
-                rng, lrng = jax.random.split(rng)
             if training and layer.weight_noise is not None and \
                     lrng is not None and lp:
                 # reference: conf.weightnoise — params perturbed per
@@ -154,7 +151,46 @@ class MultiLayerNetwork:
             else:
                 h, ns = layer.forward(lp, h, training=training, rng=lrng,
                                       state=ls or None, **kw)
-            new_states[f"layer_{i}"] = ns if ns is not None else {}
+            return h, ns if ns is not None else {}
+
+        if training and stop_at is None and \
+                conf.remat_segments > 1 and n > 1:
+            # sqrt(N) checkpointing: only segment-boundary activations
+            # are stored for backward; interiors are recomputed.
+            # Per-layer RNG is pre-split so the stream does not depend
+            # on the segmentation.
+            n_seg = min(conf.remat_segments, n)   # clamp: >= n means
+            bounds = np.linspace(0, n, n_seg + 1).astype(int)  # per-layer
+            keys = (jax.random.split(rng, n)
+                    if rng is not None else [None] * n)
+
+            def make_seg(lo, hi):
+                def seg_fn(h, seg_keys):
+                    ns = {}
+                    for j in range(lo, hi):
+                        h, s = run_layer(j, h, seg_keys[j - lo])
+                        ns[f"layer_{j}"] = s
+                    return h, ns
+                return seg_fn
+
+            for si in range(n_seg):
+                lo, hi = int(bounds[si]), int(bounds[si + 1])
+                seg_fn = make_seg(lo, hi)
+                if si + 1 < n_seg:
+                    # the last segment holds the loss head — nothing
+                    # to save past it, so leave it unremated
+                    seg_fn = jax.checkpoint(seg_fn)
+                h, ns = seg_fn(h, list(keys[lo:hi]))
+                new_states.update(ns)
+        else:
+            for i in range(n):
+                if stop_at is not None and i >= stop_at:
+                    break
+                lrng = None
+                if rng is not None:
+                    rng, lrng = jax.random.split(rng)
+                h, ns = run_layer(i, h, lrng)
+                new_states[f"layer_{i}"] = ns
         if conf.compute_dtype:
             from deeplearning4j_tpu.common.dtypes import cast_floats
             h = cast_floats(h, self._dtype)          # f32 loss/output
