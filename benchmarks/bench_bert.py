@@ -30,7 +30,7 @@ from benchmarks.cost_util import V5E_BF16_PEAK_TFLOPS  # noqa: E402
 
 
 def main(batch=128, seq=128, steps=60, max_predictions=32,
-         flash=False, remat="full"):
+         flash=False, remat="full", fused_qkv=False):
     from deeplearning4j_tpu.learning import Adam
     from deeplearning4j_tpu.models.bert import Bert, BertConfig
 
@@ -55,6 +55,7 @@ def main(batch=128, seq=128, steps=60, max_predictions=32,
                           hidden_dropout_prob=0.0,
                           attention_probs_dropout_prob=0.0,
                           max_predictions_per_seq=max_predictions,
+                          fused_qkv=fused_qkv,
                           max_position_embeddings=max(512, seq))
 
     model = Bert(conf, Adam(1e-4)).init()
@@ -114,10 +115,12 @@ if __name__ == "__main__":
     ap.add_argument("--flash", action="store_true",
                     help="use the Pallas flash-attention kernel "
                          "instead of XLA fused attention")
+    ap.add_argument("--fused-qkv", action="store_true",
+                    help="q/k/v as one [H,3H] GEMM (A/B flag)")
     ap.add_argument("--remat", default="full",
                     choices=["full", "dots", "none"],
                     help="activation rematerialization policy")
     a = ap.parse_args()
     main(batch=a.batch, seq=a.seq, steps=a.steps,
          max_predictions=a.max_predictions, flash=a.flash,
-         remat=a.remat)
+         remat=a.remat, fused_qkv=a.fused_qkv)

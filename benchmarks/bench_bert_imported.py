@@ -12,10 +12,14 @@ ask #1).
 Prints ONE JSON line:
   {"metric": "bert_imported_mlm_train_throughput", ...}
 
+Defaults reproduce the adopted headline (BENCH_notes_r04.md): true
+bf16 full-constant cast, gathered-32 MLM head (FLOP-matched to the
+native bench), batch 512, SameDiff.fit_steps fori-loop protocol —
+147.7k tokens/s, 0.94x native same-batch.
+
 Flags: --batch N --seq N --dtype bfloat16|float32 --steps N
-       --max-predictions K   (gathered-K decode head, the native
-                              bench's FLOP-matched shape; default
-                              decodes every position)
+       --max-predictions K   (gathered-K decode head; 0 = decode
+                              every position, the full-decode leg)
 """
 from __future__ import annotations
 
@@ -45,8 +49,8 @@ def _frozen_graph_cached(seq, batch, cache_dir="/tmp/dl4j_tpu_bench"):
     return gd
 
 
-def main(batch=64, seq=128, steps=8, dtype="float32",
-         max_predictions=None):
+def main(batch=512, seq=128, steps=16, dtype="bfloat16",
+         max_predictions=32):
     import jax
 
     from benchmarks.tf_bert_builder import (BERT_BASE,
@@ -121,15 +125,16 @@ def main(batch=64, seq=128, steps=8, dtype="float32",
 
 if __name__ == "__main__":
     ap = argparse.ArgumentParser()
-    ap.add_argument("--batch", type=int, default=64)
+    ap.add_argument("--batch", type=int, default=512)
     ap.add_argument("--seq", type=int, default=128)
-    ap.add_argument("--steps", type=int, default=8)
-    ap.add_argument("--dtype", default="float32")
-    ap.add_argument("--max-predictions", type=int, default=None,
+    ap.add_argument("--steps", type=int, default=16)
+    ap.add_argument("--dtype", default="bfloat16")
+    ap.add_argument("--max-predictions", type=int, default=32,
                     help="gather this many positions per sequence "
                          "before the decode matmul (the native "
-                         "bench's FLOP-matched head); default "
-                         "decodes every position")
+                         "bench's FLOP-matched head); 0 decodes "
+                         "every position (the r4-early full-decode "
+                         "leg)")
     a = ap.parse_args()
     main(batch=a.batch, seq=a.seq, steps=a.steps, dtype=a.dtype,
-         max_predictions=a.max_predictions)
+         max_predictions=a.max_predictions or None)

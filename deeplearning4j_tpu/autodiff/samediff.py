@@ -841,21 +841,49 @@ class SameDiff:
         step, trainable = self._build_raw_train_step(ph_names)
         return jax.jit(step, donate_argnums=(0, 1)), trainable
 
-    def fit_steps(self, placeholders: Dict, n_steps: int) -> float:
+    def fit_steps(self, placeholders: Dict, n_steps: int,
+                  mesh=None) -> float:
         """``n_steps`` train-step updates on ONE fixed placeholder
         batch inside a single ``lax.fori_loop`` dispatch, syncing on
         the final loss once. The benchmark-grade loop (same recipe as
         ``MultiLayerNetwork.fit_steps``): per-step dispatch + loss
         sync through a TPU tunnel is a fixed tax that the fori-loop
         amortizes. Per-step RNG is ``fold_in(rng, i)``; the updater
-        iteration starts at 0 like ``fit``'s."""
+        iteration starts at 0 like ``fit``'s.
+
+        ``mesh``: a ``jax.sharding.Mesh`` with a ``data`` axis trains
+        the program DATA-PARALLEL — every placeholder's leading axis
+        is sharded over ``data``, variables/updater state are
+        replicated, and GSPMD inserts the gradient all-reduce inside
+        the compiled step (the ParallelWrapper recipe applied to an
+        imported/authored SameDiff program; no reference equivalent —
+        SameDiff in the reference is single-device)."""
         cfg = self.training_config
         if cfg is None:
             raise ValueError("call set_training_config first")
         if not self.loss_variables:
             raise ValueError("call set_loss_variables first")
         ph_vals = {k: jnp.asarray(v) for k, v in placeholders.items()}
-        key = tuple(sorted(ph_vals))
+        mesh_sig = None
+        if mesh is not None:
+            from deeplearning4j_tpu.parallel import shard_batch
+            if "data" not in mesh.axis_names:
+                raise ValueError(
+                    f"mesh must have a 'data' axis, got "
+                    f"{mesh.axis_names}")
+            ndev = mesh.shape["data"]
+            for k, v in ph_vals.items():
+                # scalars replicate (shard_batch passes them through);
+                # batch-dim arrays must split evenly over the axis
+                if v.ndim > 0 and v.shape[0] % ndev:
+                    raise ValueError(
+                        f"placeholder {k!r} leading dim {v.shape} "
+                        f"not divisible by data axis size {ndev}")
+            ph_vals = shard_batch(mesh, ph_vals)
+            mesh_sig = (tuple(mesh.axis_names),
+                        tuple(int(mesh.shape[a])
+                              for a in mesh.axis_names))
+        key = (tuple(sorted(ph_vals)), mesh_sig)
         cached = self._exec_cache.get(("train_multi", key))
         if cached is None:
             raw, trainable = self._build_raw_train_step(tuple(ph_vals))
@@ -893,6 +921,12 @@ class SameDiff:
             self._restore_updater_leaves()
         var_vals = {n: self._arrays[n] for n in trainable}
         self._rng, rng = jax.random.split(self._rng)
+        if mesh is not None:
+            from deeplearning4j_tpu.parallel import replicate_tree
+            var_vals = replicate_tree(mesh, var_vals)
+            self._updater_state = replicate_tree(
+                mesh, self._updater_state)
+            rng = replicate_tree(mesh, rng)
         new_vars, self._updater_state, loss = multi_fn(
             var_vals, self._updater_state, ph_vals, rng, n_steps)
         self._arrays.update(new_vars)

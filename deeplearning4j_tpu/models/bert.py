@@ -154,6 +154,13 @@ class BertConfig:
     # training with attention_probs_dropout_prob > 0 uses the dense
     # path — set it to 0.0 to train through the flash kernel.
     use_flash_attention: bool = False
+    #: compute q/k/v with ONE [H, 3H] GEMM instead of three [H, H]
+    #: GEMMs. Param layout is unchanged (Wq/Wk/Wv stay separate for
+    #: the TF-checkpoint 1:1 mapping). Measured NULL on v5e: the
+    #: concat sits inside the stacked-layer scan body, is rebuilt on
+    #: every remat pass, and cost 8% at the headline config
+    #: (BENCH_notes_r04.md) — kept for the record, default off
+    fused_qkv: bool = False
     # MLM head on at most this many gathered positions per sequence
     # (the reference TF BERT pretraining knob of the same name);
     # 0 = decode every position. Rows with more masked positions than
@@ -264,9 +271,16 @@ class Bert(_Trainable):
         if rng is not None:
             r_attn, r_out = jax.random.split(rng)
 
-        q = split_heads(x @ lp["Wq"] + lp["bq"], h)
-        k = split_heads(x @ lp["Wk"] + lp["bk"], h)
-        v = split_heads(x @ lp["Wv"] + lp["bv"], h)
+        if c.fused_qkv:
+            w = jnp.concatenate([lp["Wq"], lp["Wk"], lp["Wv"]], 1)
+            bias = jnp.concatenate([lp["bq"], lp["bk"], lp["bv"]])
+            qkv = x @ w + bias
+            q, k, v = (split_heads(t, h)
+                       for t in jnp.split(qkv, 3, axis=-1))
+        else:
+            q = split_heads(x @ lp["Wq"] + lp["bq"], h)
+            k = split_heads(x @ lp["Wk"] + lp["bk"], h)
+            v = split_heads(x @ lp["Wv"] + lp["bv"], h)
         attn_drop = (c.attention_probs_dropout_prob
                      if training and r_attn is not None else 0.0)
         if c.use_flash_attention and attn_drop == 0.0:

@@ -180,3 +180,23 @@ class TestBertFineTune:
         ref_loss = float(ref.pretrain_loss(ref.params, jb4,
                                            training=False))
         assert abs(loss - ref_loss) < 1e-5, (loss, ref_loss)
+
+
+def test_fused_qkv_matches_unfused():
+    """fused_qkv computes identical attention (one [H,3H] GEMM vs
+    three [H,H] GEMMs over the same params)."""
+    import jax
+    base = BertConfig.tiny(hidden_dropout_prob=0.0,
+                           attention_probs_dropout_prob=0.0)
+    import dataclasses
+    fused_conf = dataclasses.replace(base, fused_qkv=True)
+    a = Bert(base).init()
+    b = Bert(fused_conf).init()
+    b.params = jax.tree_util.tree_map(jnp.array, a.params)
+    ids = np.arange(10, 42, dtype=np.int32)[None].repeat(2, 0)
+    sa, pa = a.output(ids)
+    sb, pb = b.output(ids)
+    np.testing.assert_allclose(np.asarray(sa), np.asarray(sb),
+                               atol=2e-5)
+    np.testing.assert_allclose(np.asarray(pa), np.asarray(pb),
+                               atol=2e-5)

@@ -396,3 +396,77 @@ def test_set_training_config_evicts_fit_steps_cache():
     sd.fit_steps(batch, 3)          # must recompile with lr=0.5
     w1 = np.asarray(sd.get_variable("w").get_arr())
     assert np.any(w1 != 0.0), "stale fori program kept lr=0"
+
+
+def test_fit_steps_data_parallel_matches_single_device():
+    """fit_steps(mesh=...) shards the batch over the mesh's data axis
+    with replicated variables; results must match the single-device
+    run (GSPMD's all-reduced grads == the unsharded sum)."""
+    import jax
+    from deeplearning4j_tpu.parallel import make_mesh
+
+    def build():
+        sd = SameDiff.create()
+        x = sd.placeholder("x", shape=(None, 2))
+        y = sd.placeholder("y", shape=(None, 1))
+        w = sd.var("w", array=np.zeros((2, 1), np.float32))
+        b = sd.var("b", array=np.zeros((1,), np.float32))
+        sd.loss.mean_squared_error(y, x @ w + b, name="loss")
+        sd.set_loss_variables("loss")
+        sd.set_training_config(
+            TrainingConfig.Builder().updater(Adam(0.1))
+            .data_set_feature_mapping("x")
+            .data_set_label_mapping("y").build())
+        return sd
+
+    rng = np.random.RandomState(0)
+    xv = rng.randn(64, 2).astype(np.float32)
+    yv = (xv @ np.array([[2.0], [-3.0]], np.float32)) + 0.5
+    batch = {"x": xv, "y": yv}
+
+    single = build()
+    l_single = single.fit_steps(batch, 6)
+
+    mesh = make_mesh({"data": 8}, jax.devices()[:8])
+    dp = build()
+    l_dp = dp.fit_steps(batch, 6, mesh=mesh)
+    np.testing.assert_allclose(l_dp, l_single, rtol=1e-5, atol=1e-7)
+    np.testing.assert_allclose(
+        np.asarray(dp.get_variable("w").get_arr()),
+        np.asarray(single.get_variable("w").get_arr()),
+        rtol=1e-5, atol=1e-6)
+
+    # indivisible batch must be rejected loudly
+    bad = {"x": xv[:60], "y": yv[:60]}
+    try:
+        dp.fit_steps(bad, 1, mesh=mesh)
+        assert False, "expected ValueError for indivisible batch"
+    except ValueError:
+        pass
+
+
+def test_fit_steps_data_parallel_replicates_scalar_placeholder():
+    """Scalar placeholders (loss scales, rate knobs) replicate under
+    fit_steps(mesh=...) instead of being rejected (code-review
+    regression — the inline sharding predated `shard_batch`)."""
+    import jax
+    from deeplearning4j_tpu.parallel import make_mesh
+    sd = SameDiff.create()
+    x = sd.placeholder("x", shape=(None, 2))
+    y = sd.placeholder("y", shape=(None, 1))
+    s = sd.placeholder("s", shape=())
+    w = sd.var("w", array=np.zeros((2, 1), np.float32))
+    sd._op("mul", [sd.loss.mean_squared_error(y, x @ w), s]) \
+        .rename("sloss")
+    sd.set_loss_variables("sloss")
+    sd.set_training_config(
+        TrainingConfig.Builder().updater(Adam(0.1))
+        .data_set_feature_mapping("x")
+        .data_set_label_mapping("y").build())
+    rng = np.random.RandomState(0)
+    xv = rng.randn(64, 2).astype(np.float32)
+    yv = xv @ np.array([[2.0], [-3.0]], np.float32)
+    mesh = make_mesh({"data": 8}, jax.devices()[:8])
+    l = sd.fit_steps({"x": xv, "y": yv, "s": np.float32(1.0)}, 5,
+                     mesh=mesh)
+    assert np.isfinite(l)

@@ -149,3 +149,41 @@ class TestGatheredMlmHead:
         curve = hist.loss_curve()
         assert np.isfinite(curve).all()
         assert curve[-1] < 0.5 * curve[0], curve
+
+    def test_imported_model_trains_data_parallel(self):
+        """An IMPORTED program trains data-parallel over a device
+        mesh via fit_steps(mesh=...) and matches the single-device
+        run — import and scale-out compose (the reference's SameDiff
+        is single-device; SURVEY P1 x S6)."""
+        import jax
+        from deeplearning4j_tpu.parallel import make_mesh
+        vocab, hidden, heads, layers, seq, batch, k = \
+            50, 16, 2, 2, 16, 8, 4
+        gd, _ = build_frozen_bert(seq, batch, vocab=vocab,
+                                  hidden=hidden, heads=heads,
+                                  layers=layers, intermediate=32)
+        from deeplearning4j_tpu.learning import Adam
+        rs = np.random.RandomState(2)
+        batch_d = {
+            "ids": rs.randint(0, vocab,
+                              (batch, seq)).astype(np.int32),
+            "seg": np.zeros((batch, seq), np.int32),
+            "mask": np.ones((batch, seq), np.int32),
+            "mlm_positions": np.stack(
+                [rs.choice(seq, k, replace=False)
+                 for _ in range(batch)]).astype(np.int32),
+            "mlm_labels": rs.randint(0, vocab,
+                                     (batch, k)).astype(np.int32)}
+
+        def build():
+            sd, _ = import_and_attach_mlm(
+                gd, batch, seq, vocab=vocab, hidden=hidden,
+                updater=Adam(1e-2), max_predictions=k)
+            return sd
+
+        l_single = build().fit_steps(batch_d, 8)
+        mesh = make_mesh({"data": 8}, jax.devices()[:8])
+        l_dp = build().fit_steps(batch_d, 8, mesh=mesh)
+        assert np.isfinite(l_dp)
+        np.testing.assert_allclose(l_dp, l_single,
+                                   rtol=1e-4, atol=1e-5)
