@@ -14,8 +14,9 @@ Prints ONE JSON line:
 
 Defaults reproduce the adopted headline (BENCH_notes_r04.md): true
 bf16 full-constant cast, gathered-32 MLM head (FLOP-matched to the
-native bench), batch 512, SameDiff.fit_steps fori-loop protocol —
-147.7k tokens/s, 0.94x native same-batch.
+native bench), batch 128 (the fori-protocol sweep's winner, matching
+the native model's optimum), SameDiff.fit_steps fori-loop protocol —
+170.2k tokens/s, 0.94x native same-batch.
 
 Flags: --batch N --seq N --dtype bfloat16|float32 --steps N
        --max-predictions K   (gathered-K decode head; 0 = decode
@@ -49,7 +50,7 @@ def _frozen_graph_cached(seq, batch, cache_dir="/tmp/dl4j_tpu_bench"):
     return gd
 
 
-def main(batch=512, seq=128, steps=16, dtype="bfloat16",
+def main(batch=128, seq=128, steps=48, dtype="bfloat16",
          max_predictions=32):
     import jax
 
@@ -124,12 +125,17 @@ def main(batch=512, seq=128, steps=16, dtype="bfloat16",
 
 
 if __name__ == "__main__":
+    import inspect
+    # single source of truth for defaults: main()'s signature
+    d = {k: p.default
+         for k, p in inspect.signature(main).parameters.items()}
     ap = argparse.ArgumentParser()
-    ap.add_argument("--batch", type=int, default=512)
-    ap.add_argument("--seq", type=int, default=128)
-    ap.add_argument("--steps", type=int, default=16)
-    ap.add_argument("--dtype", default="bfloat16")
-    ap.add_argument("--max-predictions", type=int, default=32,
+    ap.add_argument("--batch", type=int, default=d["batch"])
+    ap.add_argument("--seq", type=int, default=d["seq"])
+    ap.add_argument("--steps", type=int, default=d["steps"])
+    ap.add_argument("--dtype", default=d["dtype"])
+    ap.add_argument("--max-predictions", type=int,
+                    default=d["max_predictions"],
                     help="gather this many positions per sequence "
                          "before the decode matmul (the native "
                          "bench's FLOP-matched head); 0 decodes "
