@@ -51,7 +51,7 @@ def _frozen_graph_cached(seq, batch, cache_dir="/tmp/dl4j_tpu_bench"):
 
 
 def main(batch=128, seq=128, steps=48, dtype="bfloat16",
-         max_predictions=32):
+         max_predictions=32, remat_segments=0):
     import jax
 
     from benchmarks.tf_bert_builder import (BERT_BASE,
@@ -68,6 +68,8 @@ def main(batch=128, seq=128, steps=48, dtype="bfloat16",
         hidden=BERT_BASE["hidden"], updater=Adam(1e-4),
         dtype=None if dtype == "float32" else dtype,
         max_predictions=max_predictions)
+    if remat_segments:
+        sd.set_remat_segments(remat_segments)
 
     rs = np.random.RandomState(0)
     ids = rs.randint(0, BERT_BASE["vocab"],
@@ -119,6 +121,7 @@ def main(batch=128, seq=128, steps=48, dtype="bfloat16",
             "batch": batch, "seq": seq, "dtype": dtype,
             "mlm_head": ("full-decode" if max_predictions is None
                          else f"gathered-{max_predictions}"),
+            "remat_segments": remat_segments,
             "import_path": "TF GraphDef -> S6 -> one jitted program"}
     print(json.dumps(line))
     return line
@@ -134,6 +137,11 @@ if __name__ == "__main__":
     ap.add_argument("--seq", type=int, default=d["seq"])
     ap.add_argument("--steps", type=int, default=d["steps"])
     ap.add_argument("--dtype", default=d["dtype"])
+    ap.add_argument("--remat-segments", type=int,
+                    default=d["remat_segments"],
+                    help="sqrt(N)-checkpoint the imported op walk "
+                         "in this many segments (the flat-graph "
+                         "memory lever; 0 = off)")
     ap.add_argument("--max-predictions", type=int,
                     default=d["max_predictions"],
                     help="gather this many positions per sequence "
@@ -143,4 +151,5 @@ if __name__ == "__main__":
                          "leg)")
     a = ap.parse_args()
     main(batch=a.batch, seq=a.seq, steps=a.steps, dtype=a.dtype,
-         max_predictions=a.max_predictions or None)
+         max_predictions=a.max_predictions or None,
+         remat_segments=a.remat_segments)

@@ -214,6 +214,12 @@ class SameDiff:
         self.loss_variables: List[str] = []
         self.training_config = None
         self._updater_state = None
+        #: sqrt(N) activation checkpointing for TRAINING programs:
+        #: the op walk is cut into this many jax.checkpoint segments
+        #: (only segment-boundary values are stored for backward).
+        #: The memory lever for FLAT imported graphs, which have no
+        #: layer structure to remat (see set_remat_segments)
+        self.remat_segments: int = 0
         #: foreign-var captures (control-flow bodies closing over a
         #: parent graph): local name -> (owner SameDiff, owner name)
         self._captures: Dict[str, tuple] = {}
@@ -479,10 +485,70 @@ class SameDiff:
             values = dict(const_vals)
             values.update(var_vals)
             values.update(ph_vals)
-            self._execute(values, op_indices, rng, training)
+            if training and self.remat_segments > 1 \
+                    and len(op_indices) > 1:
+                self._execute_segmented(values, op_indices, rng,
+                                        training, out_names)
+            else:
+                self._execute(values, op_indices, rng, training)
             return [values[n] for n in out_names]
 
         return fn, var_names
+
+    def set_remat_segments(self, n: int):
+        """Cut TRAINING forward programs into ``n`` ``jax.checkpoint``
+        segments of the op walk (sqrt(N) activation checkpointing):
+        only segment-boundary values are stored for backward,
+        interiors are recomputed. This is the memory lever for flat
+        IMPORTED graphs, which have no layer boundaries to remat —
+        e.g. imported BERT-base OOMs at batch 1024 without it
+        (BENCH_notes_r04.md). 0 disables. Compiled training programs
+        bake the setting, so the caches are dropped."""
+        self.remat_segments = int(n)
+        self._exec_cache.clear()
+        return self
+
+    def _execute_segmented(self, values: dict, op_indices: List[int],
+                           rng, training: bool,
+                           out_names: Tuple[str, ...]):
+        """The op walk in ``remat_segments`` contiguous
+        ``jax.checkpoint`` segments, with liveness analysis so only
+        values consumed later (or requested outputs) cross segment
+        boundaries. The per-op RNG is ``fold_in(rng, op idx)``
+        (same as the plain walk), so segmentation does not change
+        the stream."""
+        from deeplearning4j_tpu.common.remat import segment_plan
+        read_at = [set(self.ops[i].inputs) for i in op_indices]
+        for lo, hi, wrap in segment_plan(len(op_indices),
+                                         self.remat_segments):
+            seg = op_indices[lo:hi]
+            produced = set()
+            for i in seg:
+                produced.update(self.ops[i].outputs)
+            read = set()
+            for j in range(lo, hi):
+                read.update(read_at[j])
+            needed_after = set(out_names)
+            for j in range(hi, len(op_indices)):
+                needed_after.update(read_at[j])
+            seg_in = sorted((read - produced) & set(values))
+            seg_out = sorted(produced & needed_after)
+
+            def seg_fn(in_vals, seg=seg, seg_out=seg_out):
+                vals = dict(in_vals)
+                self._execute(vals, seg, rng, training)
+                return {k: vals[k] for k in seg_out}
+
+            if wrap:
+                seg_fn = jax.checkpoint(seg_fn)
+            outs = seg_fn({k: values[k] for k in seg_in})
+            # prune: drop values dead past this boundary, keep the
+            # rest (constants/vars/placeholders live in `values` too
+            # and are needed by later segments' seg_in gathers)
+            for k in list(values):
+                if k not in needed_after:
+                    del values[k]
+            values.update(outs)
 
     def output(self, placeholders: dict, outputs: Sequence[str],
                *, training: bool = False) -> Dict[str, np.ndarray]:

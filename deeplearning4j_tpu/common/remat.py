@@ -1,0 +1,27 @@
+"""Shared scaffold for sqrt(N) activation checkpointing.
+
+Three training walks use the same segmentation scheme — the
+MultiLayerNetwork layer stack, the ComputationGraph topo walk, and
+the SameDiff op walk: cut the walk into contiguous segments, wrap
+every segment EXCEPT the last (it holds the loss head — nothing to
+save past it) in ``jax.checkpoint``, so only segment-boundary values
+are stored for backward. This module is the single source of truth
+for the cut points and the wrap policy so the three walks cannot
+drift."""
+from __future__ import annotations
+
+from typing import List, Tuple
+
+import numpy as np
+
+
+def segment_plan(n_items: int, n_segments: int
+                 ) -> List[Tuple[int, int, bool]]:
+    """``[(lo, hi, wrap), ...]`` covering ``range(n_items)`` in
+    ``min(n_segments, n_items)`` contiguous segments; ``wrap`` is
+    True for every segment but the last. ``n_segments`` above the
+    item count clamps to per-item checkpointing."""
+    n_seg = min(int(n_segments), int(n_items))
+    bounds = np.linspace(0, n_items, n_seg + 1).astype(int)
+    return [(int(bounds[i]), int(bounds[i + 1]), i + 1 < n_seg)
+            for i in range(n_seg)]

@@ -174,12 +174,10 @@ class ComputationGraph:
         activation traffic, usually a win on bandwidth-bound TPUs).
         Per-vertex RNG is pre-split so the stream does not depend on
         the segmentation."""
+        from deeplearning4j_tpu.common.remat import segment_plan
         conf = self.conf
         topo = self._topo
-        n_seg = min(conf.remat_segments, len(topo))
-        bounds = np.linspace(0, len(topo), n_seg + 1).astype(int)
-        segments = [topo[bounds[i]:bounds[i + 1]]
-                    for i in range(n_seg)]
+        plan = segment_plan(len(topo), conf.remat_segments)
 
         layer_names = [n for n in topo if conf.vertices[n].is_layer]
         if rng is not None and layer_names:
@@ -206,12 +204,13 @@ class ComputationGraph:
         live: Dict[str, jnp.ndarray] = dict(zip(conf.network_inputs,
                                                 inputs))
         new_states: dict = {}
-        for i, seg in enumerate(segments):
+        for lo, hi, wrap in plan:
+            seg = topo[lo:hi]
             produced = set(seg)
             refs = {src for n in seg
                     for src in conf.vertices[n].inputs}
             seg_in = sorted(refs - produced)
-            keep = needed_after(bounds[i + 1])
+            keep = needed_after(hi)
             seg_out = sorted(produced & keep)
             seg_rngs = {n: rng_for[n] for n in seg if n in rng_for}
 
@@ -225,10 +224,10 @@ class ComputationGraph:
                     ns[name] = s
                 return {k: acts[k] for k in seg_out}, ns
 
-            if i + 1 < n_seg:
+            if wrap:
+                # the LAST segment (wrap=False) holds the loss head;
+                # checkpointing it buys nothing
                 seg_fn = jax.checkpoint(seg_fn)
-            # the LAST segment holds the loss head; checkpointing it
-            # buys nothing (its activations feed the loss directly)
             outs, ns = seg_fn({k: live[k] for k in seg_in}, seg_rngs)
             live.update(outs)
             new_states.update(ns)

@@ -159,8 +159,7 @@ class MultiLayerNetwork:
             # are stored for backward; interiors are recomputed.
             # Per-layer RNG is pre-split so the stream does not depend
             # on the segmentation.
-            n_seg = min(conf.remat_segments, n)   # clamp: >= n means
-            bounds = np.linspace(0, n, n_seg + 1).astype(int)  # per-layer
+            from deeplearning4j_tpu.common.remat import segment_plan
             keys = (jax.random.split(rng, n)
                     if rng is not None else [None] * n)
 
@@ -173,12 +172,9 @@ class MultiLayerNetwork:
                     return h, ns
                 return seg_fn
 
-            for si in range(n_seg):
-                lo, hi = int(bounds[si]), int(bounds[si + 1])
+            for lo, hi, wrap in segment_plan(n, conf.remat_segments):
                 seg_fn = make_seg(lo, hi)
-                if si + 1 < n_seg:
-                    # the last segment holds the loss head — nothing
-                    # to save past it, so leave it unremated
+                if wrap:
                     seg_fn = jax.checkpoint(seg_fn)
                 h, ns = seg_fn(h, list(keys[lo:hi]))
                 new_states.update(ns)

@@ -175,3 +175,75 @@ def test_oversized_segment_count_clamps_to_per_layer():
     for _ in range(3):
         g.fit(ds)
     assert np.isfinite(g.score())
+
+
+class TestSameDiffRematSegments:
+    """`SameDiff.set_remat_segments(n)`: training programs (fit and
+    fit_steps) cut the op walk into jax.checkpoint segments — the
+    memory lever for FLAT imported graphs (no layer structure to
+    remat). Must be a pure re-schedule: identical losses and params."""
+
+    @staticmethod
+    def _build(segs):
+        from deeplearning4j_tpu.autodiff.samediff import SameDiff
+        from deeplearning4j_tpu.autodiff.training import TrainingConfig
+        sd = SameDiff.create()
+        x = sd.placeholder("x", shape=(None, 8))
+        y = sd.placeholder("y", shape=(None, 4))
+        h = x
+        rng = np.random.RandomState(3)
+        for i in range(6):
+            w = sd.var(f"w{i}", array=(rng.randn(8, 8) * 0.3)
+                       .astype(np.float32))
+            h = sd.nn.tanh(h @ w)
+            if i == 2:
+                # an RNG op mid-walk pins the contract that
+                # segmentation does not change the random stream
+                # (per-op rng is fold_in(rng, GLOBAL op idx))
+                h = sd.nn.dropout(h, 0.25)
+        wo = sd.var("wo", array=(rng.randn(8, 4) * 0.3)
+                    .astype(np.float32))
+        sd.loss.mean_squared_error(y, h @ wo, name="loss")
+        sd.set_loss_variables("loss")
+        sd.set_training_config(
+            TrainingConfig.Builder().updater(Adam(0.01))
+            .data_set_feature_mapping("x")
+            .data_set_label_mapping("y").build())
+        if segs:
+            sd.set_remat_segments(segs)
+        return sd
+
+    def test_training_matches_plain(self):
+        rng = np.random.RandomState(0)
+        xv = rng.randn(32, 8).astype(np.float32)
+        yv = rng.randn(32, 4).astype(np.float32)
+        batch = {"x": xv, "y": yv}
+        a = self._build(0)
+        b = self._build(4)
+        la = a.fit_steps(batch, 8)
+        lb = b.fit_steps(batch, 8)
+        np.testing.assert_allclose(lb, la, rtol=1e-5, atol=1e-7)
+        np.testing.assert_allclose(
+            np.asarray(b.get_variable("w0").get_arr()),
+            np.asarray(a.get_variable("w0").get_arr()),
+            rtol=1e-5, atol=1e-6)
+
+    def test_oversized_clamps(self):
+        rng = np.random.RandomState(0)
+        batch = {"x": rng.randn(8, 8).astype(np.float32),
+                 "y": rng.randn(8, 4).astype(np.float32)}
+        sd = self._build(10_000)
+        assert np.isfinite(sd.fit_steps(batch, 2))
+
+    def test_setter_invalidates_compiled_programs(self):
+        """Changing the segmentation after compiling must retrace —
+        the setting is baked into the program."""
+        rng = np.random.RandomState(0)
+        batch = {"x": rng.randn(8, 8).astype(np.float32),
+                 "y": rng.randn(8, 4).astype(np.float32)}
+        sd = self._build(0)
+        sd.fit_steps(batch, 2)
+        assert sd._exec_cache
+        sd.set_remat_segments(3)
+        assert not sd._exec_cache
+        assert np.isfinite(sd.fit_steps(batch, 2))
