@@ -198,6 +198,30 @@ class OpNode:
         self.attrs = attrs or {}
 
 
+def _shard_placeholders(mesh, ph_vals: Dict):
+    """Shared DP placeholder contract of ``output(mesh=)`` and
+    ``fit_steps(mesh=)``: batch dims shard over the mesh's ``data``
+    axis, scalars replicate (``shard_batch`` passes them through),
+    indivisible batches are rejected loudly. Returns
+    ``(ph_vals, mesh_sig)``; ``mesh_sig`` keys compiled-program
+    caches (None when no mesh)."""
+    if mesh is None:
+        return ph_vals, None
+    from deeplearning4j_tpu.parallel import shard_batch
+    if "data" not in mesh.axis_names:
+        raise ValueError(
+            f"mesh must have a 'data' axis, got {mesh.axis_names}")
+    ndev = mesh.shape["data"]
+    for k, v in ph_vals.items():
+        if v.ndim > 0 and v.shape[0] % ndev:
+            raise ValueError(
+                f"placeholder {k!r} leading dim {v.shape} not "
+                f"divisible by data axis size {ndev}")
+    return shard_batch(mesh, ph_vals), (
+        tuple(mesh.axis_names),
+        tuple(int(mesh.shape[a]) for a in mesh.axis_names))
+
+
 class SameDiff:
     """The graph. Build with var/constant/placeholder + op namespaces
     (sd.math, sd.nn, sd.cnn, sd.rnn, sd.loss, sd.image, sd.bitwise,
@@ -551,14 +575,21 @@ class SameDiff:
             values.update(outs)
 
     def output(self, placeholders: dict, outputs: Sequence[str],
-               *, training: bool = False) -> Dict[str, np.ndarray]:
+               *, training: bool = False,
+               mesh=None) -> Dict[str, np.ndarray]:
         """Execute the graph (reference: SameDiff.output). The whole
         requested subgraph compiles to one XLA program, cached per
-        (outputs, placeholder signature)."""
+        (outputs, placeholder signature).
+
+        ``mesh``: a ``jax.sharding.Mesh`` with a ``data`` axis runs
+        inference DATA-PARALLEL — placeholder batch dims shard over
+        ``data``, variables replicate (the batched-inference half of
+        ``fit_steps(mesh=...)``)."""
         outputs = [o.name if isinstance(o, SDVariable) else o
                    for o in outputs]
         ph_vals = {k: jnp.asarray(v) for k, v in placeholders.items()}
-        sig = (tuple(outputs), training,
+        ph_vals, mesh_sig = _shard_placeholders(mesh, ph_vals)
+        sig = (tuple(outputs), training, mesh_sig,
                tuple(sorted((k, v.shape, str(v.dtype))
                             for k, v in ph_vals.items())))
         if sig not in self._exec_cache:
@@ -567,6 +598,9 @@ class SameDiff:
             self._exec_cache[sig] = (jax.jit(fn), list(var_vals))
         jfn, var_names = self._exec_cache[sig]
         var_vals = {n: self._arrays[n] for n in var_names}
+        if mesh is not None:
+            from deeplearning4j_tpu.parallel import replicate_tree
+            var_vals = replicate_tree(mesh, var_vals)
         self._rng, rng = jax.random.split(self._rng)
         res = jfn(var_vals, ph_vals, rng)
         return {n: np.asarray(r) for n, r in zip(outputs, res)}
@@ -930,25 +964,7 @@ class SameDiff:
         if not self.loss_variables:
             raise ValueError("call set_loss_variables first")
         ph_vals = {k: jnp.asarray(v) for k, v in placeholders.items()}
-        mesh_sig = None
-        if mesh is not None:
-            from deeplearning4j_tpu.parallel import shard_batch
-            if "data" not in mesh.axis_names:
-                raise ValueError(
-                    f"mesh must have a 'data' axis, got "
-                    f"{mesh.axis_names}")
-            ndev = mesh.shape["data"]
-            for k, v in ph_vals.items():
-                # scalars replicate (shard_batch passes them through);
-                # batch-dim arrays must split evenly over the axis
-                if v.ndim > 0 and v.shape[0] % ndev:
-                    raise ValueError(
-                        f"placeholder {k!r} leading dim {v.shape} "
-                        f"not divisible by data axis size {ndev}")
-            ph_vals = shard_batch(mesh, ph_vals)
-            mesh_sig = (tuple(mesh.axis_names),
-                        tuple(int(mesh.shape[a])
-                              for a in mesh.axis_names))
+        ph_vals, mesh_sig = _shard_placeholders(mesh, ph_vals)
         key = (tuple(sorted(ph_vals)), mesh_sig)
         cached = self._exec_cache.get(("train_multi", key))
         if cached is None:

@@ -470,3 +470,21 @@ def test_fit_steps_data_parallel_replicates_scalar_placeholder():
     l = sd.fit_steps({"x": xv, "y": yv, "s": np.float32(1.0)}, 5,
                      mesh=mesh)
     assert np.isfinite(l)
+
+
+def test_output_data_parallel_matches_single_device():
+    """output(mesh=...) — DP batched inference: identical results to
+    the single-device run, scalars replicate."""
+    import jax
+    from deeplearning4j_tpu.parallel import make_mesh
+    sd = SameDiff.create()
+    x = sd.placeholder("x", shape=(None, 4))
+    w = sd.var("w", array=np.arange(8, dtype=np.float32)
+               .reshape(4, 2))
+    sd.nn.softmax(x @ w, name="probs")
+    rng = np.random.RandomState(0)
+    xv = rng.randn(64, 4).astype(np.float32)
+    want = sd.output({"x": xv}, ["probs"])["probs"]
+    mesh = make_mesh({"data": 8}, jax.devices()[:8])
+    got = sd.output({"x": xv}, ["probs"], mesh=mesh)["probs"]
+    np.testing.assert_allclose(got, want, rtol=1e-6, atol=1e-7)
