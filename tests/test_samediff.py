@@ -402,6 +402,8 @@ def test_fit_steps_data_parallel_matches_single_device():
     """fit_steps(mesh=...) shards the batch over the mesh's data axis
     with replicated variables; results must match the single-device
     run (GSPMD's all-reduced grads == the unsharded sum)."""
+    from conftest import require_devices
+    require_devices(8)
     import jax
     from deeplearning4j_tpu.parallel import make_mesh
 
@@ -449,6 +451,8 @@ def test_fit_steps_data_parallel_replicates_scalar_placeholder():
     """Scalar placeholders (loss scales, rate knobs) replicate under
     fit_steps(mesh=...) instead of being rejected (code-review
     regression — the inline sharding predated `shard_batch`)."""
+    from conftest import require_devices
+    require_devices(8)
     import jax
     from deeplearning4j_tpu.parallel import make_mesh
     sd = SameDiff.create()
@@ -475,6 +479,8 @@ def test_fit_steps_data_parallel_replicates_scalar_placeholder():
 def test_output_data_parallel_matches_single_device():
     """output(mesh=...) — DP batched inference: identical results to
     the single-device run, scalars replicate."""
+    from conftest import require_devices
+    require_devices(8)
     import jax
     from deeplearning4j_tpu.parallel import make_mesh
     sd = SameDiff.create()

@@ -16,6 +16,19 @@ from deeplearning4j_tpu.parallel.sequence import (
     blockwise_attention, flash_attention, ring_attention,
     ring_self_attention, ulysses_self_attention)
 
+from conftest import require_devices
+
+
+@pytest.fixture(autouse=True)
+def _f32_matmuls():
+    """These are ALGORITHM-equivalence tests (blocked/sharded vs
+    dense); run matmuls at f32 precision so TPU's default-bf16
+    multiplies (~2e-3 abs at these scales) don't drown the
+    comparison. Production precision is a benchmark concern, not a
+    correctness one."""
+    with jax.default_matmul_precision("highest"):
+        yield
+
 
 def _qkv(b=2, h=4, t=64, d=16, seed=0):
     rng = np.random.RandomState(seed)
@@ -89,8 +102,11 @@ class TestFlashAttention:
         g1 = jax.grad(loss, argnums=(0, 1, 2))(q, k, v)
         g2 = jax.grad(loss_dense, argnums=(0, 1, 2))(q, k, v)
         for a, b in zip(g1, g2):
+            # 2e-4 abs: sum-of-squares loss over t=128 amplifies the
+            # f32 rounding on real TPU to ~5e-5 (relative ~6e-5);
+            # CPU sits well under the old 5e-5
             np.testing.assert_allclose(np.asarray(a), np.asarray(b),
-                                       atol=5e-5)
+                                       atol=2e-4)
 
     @pytest.mark.parametrize("causal", [False, True])
     @pytest.mark.parametrize("masked", [False, True])
@@ -127,8 +143,11 @@ class TestFlashAttention:
         gf = jax.grad(loss_flash, (0, 1, 2))(q, k, v)
         gr = jax.grad(loss_ref, (0, 1, 2))(q, k, v)
         for a, want in zip(gf, gr):
+            # 5e-5: the masked case on real TPU sits at ~2.4e-5 even
+            # at f32 matmul precision (fully-masked blocks round the
+            # lse differently); CPU interpret mode is < 7e-6
             np.testing.assert_allclose(np.asarray(a),
-                                       np.asarray(want), atol=2e-5)
+                                       np.asarray(want), atol=5e-5)
 
     def test_indivisible_lengths_autofit_blocks(self):
         """Blocks that don't divide the sequence shrink to a divisor
@@ -143,6 +162,11 @@ class TestFlashAttention:
 
 
 class TestRingAttention:
+
+    @pytest.fixture(autouse=True)
+    def _needs_mesh(self):
+        require_devices(8)
+
     @pytest.mark.parametrize("causal", [False, True])
     def test_matches_dense_over_mesh(self, causal):
         mesh = make_mesh({"seq": 8})
@@ -249,6 +273,11 @@ class TestRingAttention:
 
 
 class TestUlysses:
+
+    @pytest.fixture(autouse=True)
+    def _needs_mesh(self):
+        require_devices(4)
+
     @pytest.mark.parametrize("causal", [False, True])
     def test_matches_dense_over_mesh(self, causal):
         mesh = make_mesh({"seq": 4}, jax.devices()[:4])  # h=4 % 4 == 0

@@ -155,6 +155,8 @@ class TestGatheredMlmHead:
         mesh via fit_steps(mesh=...) and matches the single-device
         run — import and scale-out compose (the reference's SameDiff
         is single-device; SURVEY P1 x S6)."""
+        from conftest import require_devices
+        require_devices(8)
         import jax
         from deeplearning4j_tpu.parallel import make_mesh
         vocab, hidden, heads, layers, seq, batch, k = \
