@@ -198,7 +198,7 @@ class OpNode:
         self.attrs = attrs or {}
 
 
-def _shard_placeholders(mesh, ph_vals: Dict):
+def _shard_placeholders(mesh, ph_vals: Dict, batch_names=None):
     """Shared DP placeholder contract of ``output(mesh=)`` and
     ``fit_steps(mesh=)``: batch dims shard over the mesh's ``data``
     axis, scalars replicate (``shard_batch`` passes them through),
@@ -207,17 +207,48 @@ def _shard_placeholders(mesh, ph_vals: Dict):
     caches (None when no mesh)."""
     if mesh is None:
         return ph_vals, None
-    from deeplearning4j_tpu.parallel import shard_batch
+    from deeplearning4j_tpu.parallel import replicate_tree, shard_batch
     if "data" not in mesh.axis_names:
         raise ValueError(
             f"mesh must have a 'data' axis, got {mesh.axis_names}")
     ndev = mesh.shape["data"]
+    # batch placeholders shard; everything else replicates (GSPMD
+    # semantics are identical either way; only batch tensors gain from
+    # sharding). "Batch" = the leading dim of the feature/label-mapped
+    # placeholders when the caller knows them (fit_steps passes the
+    # TrainingConfig mappings); otherwise inferred as the most common
+    # leading dim among non-scalar placeholders — ties break toward
+    # dims that divide the data axis, then higher rank ([B,T] batch
+    # outranks a [T] aux), then size
+    batch = None
+    if batch_names:
+        for k in batch_names:
+            v = ph_vals.get(k)
+            if v is not None and v.ndim > 0:
+                batch = int(v.shape[0])
+                break
+    if batch is None:
+        leads: dict = {}
+        ranks: dict = {}
+        for v in ph_vals.values():
+            if v.ndim > 0:
+                d = int(v.shape[0])
+                leads[d] = leads.get(d, 0) + 1
+                ranks[d] = max(ranks.get(d, 0), v.ndim)
+        if leads:
+            batch = max(leads, key=lambda d: (
+                leads[d], d % ndev == 0, ranks[d], d))
+    out = {}
     for k, v in ph_vals.items():
-        if v.ndim > 0 and v.shape[0] % ndev:
-            raise ValueError(
-                f"placeholder {k!r} leading dim {v.shape} not "
-                f"divisible by data axis size {ndev}")
-    return shard_batch(mesh, ph_vals), (
+        if v.ndim > 0 and int(v.shape[0]) == batch:
+            if v.shape[0] % ndev:
+                raise ValueError(
+                    f"placeholder {k!r} batch dim {v.shape} not "
+                    f"divisible by data axis size {ndev}")
+            out[k] = shard_batch(mesh, v)
+        else:
+            out[k] = replicate_tree(mesh, v)
+    return out, (
         tuple(mesh.axis_names),
         tuple(int(mesh.shape[a]) for a in mesh.axis_names))
 
@@ -238,6 +269,9 @@ class SameDiff:
         self.loss_variables: List[str] = []
         self.training_config = None
         self._updater_state = None
+        #: updater iteration, persisted across fit()/fit_steps() calls
+        #: (Adam bias correction must not restart per call)
+        self.iteration_count: int = 0
         #: sqrt(N) activation checkpointing for TRAINING programs:
         #: the op walk is cut into this many jax.checkpoint segments
         #: (only segment-boundary values are stored for backward).
@@ -949,7 +983,9 @@ class SameDiff:
         ``MultiLayerNetwork.fit_steps``): per-step dispatch + loss
         sync through a TPU tunnel is a fixed tax that the fori-loop
         amortizes. Per-step RNG is ``fold_in(rng, i)``; the updater
-        iteration starts at 0 like ``fit``'s.
+        iteration continues from ``self.iteration_count`` (shared with
+        ``fit``), so chained calls don't re-apply Adam bias-correction
+        warmup: ``fit_steps(b, 5)`` twice == ``fit_steps(b, 10)``.
 
         ``mesh``: a ``jax.sharding.Mesh`` with a ``data`` axis trains
         the program DATA-PARALLEL — every placeholder's leading axis
@@ -964,16 +1000,18 @@ class SameDiff:
         if not self.loss_variables:
             raise ValueError("call set_loss_variables first")
         ph_vals = {k: jnp.asarray(v) for k, v in placeholders.items()}
-        ph_vals, mesh_sig = _shard_placeholders(mesh, ph_vals)
+        ph_vals, mesh_sig = _shard_placeholders(
+            mesh, ph_vals, batch_names=(cfg.data_set_feature_mapping +
+                                        cfg.data_set_label_mapping))
         key = (tuple(sorted(ph_vals)), mesh_sig)
         cached = self._exec_cache.get(("train_multi", key))
         if cached is None:
             raw, trainable = self._build_raw_train_step(tuple(ph_vals))
 
-            def multi(var_vals, upd_state, ph, rng, n):
+            def multi(var_vals, upd_state, ph, rng, it0, n):
                 def body(i, carry):
                     vv, us, _ = carry
-                    vv, us, loss = raw(vv, us, ph, i,
+                    vv, us, loss = raw(vv, us, ph, it0 + i,
                                        jax.random.fold_in(rng, i))
                     return vv, us, jnp.float32(loss)
 
@@ -981,7 +1019,7 @@ class SameDiff:
                     0, n, body,
                     (var_vals, upd_state, jnp.float32(0)))
 
-            cached = (jax.jit(multi, static_argnums=(4,),
+            cached = (jax.jit(multi, static_argnums=(5,),
                               donate_argnums=(0, 1)), trainable)
             self._exec_cache[("train_multi", key)] = cached
         multi_fn, trainable = cached
@@ -1010,8 +1048,10 @@ class SameDiff:
                 mesh, self._updater_state)
             rng = replicate_tree(mesh, rng)
         new_vars, self._updater_state, loss = multi_fn(
-            var_vals, self._updater_state, ph_vals, rng, n_steps)
+            var_vals, self._updater_state, ph_vals, rng,
+            jnp.asarray(self.iteration_count), n_steps)
         self._arrays.update(new_vars)
+        self.iteration_count += n_steps
         return float(loss)
 
     def fit(self, iterator=None, *, n_epochs: int = 1,
@@ -1029,7 +1069,7 @@ class SameDiff:
         history = History()
         step_fn = None
         trainable = None
-        iteration = 0
+        iteration = self.iteration_count
         for epoch in range(n_epochs):
             if hasattr(iterator, "reset"):
                 iterator.reset()
@@ -1073,6 +1113,7 @@ class SameDiff:
                     step_fn = None
                 epoch_losses.append(float(loss))
                 iteration += 1
+                self.iteration_count = iteration
             history.add_epoch(epoch, epoch_losses)
         return history
 
@@ -1113,6 +1154,9 @@ class SameDiff:
             "loss_variables": self.loss_variables,
             "training_config": (self.training_config.to_map()
                                 if self.training_config else None),
+            # resuming training must continue the updater iteration
+            # (Adam bias correction), not restart warmup at 0
+            "iteration_count": self.iteration_count,
         }
         with zipfile.ZipFile(path, "w") as z:
             z.writestr("graph.json", json.dumps(graph, indent=1))
@@ -1151,6 +1195,7 @@ class SameDiff:
             for on in node.outputs:
                 sd._producer[on] = i
         sd.loss_variables = graph.get("loss_variables", [])
+        sd.iteration_count = graph.get("iteration_count", 0)
         tc = graph.get("training_config")
         if tc:
             sd.training_config = TrainingConfig.from_map(tc)

@@ -74,7 +74,17 @@ class _Trainable:
         raise NotImplementedError
 
     def _ensure_step(self):
+        if getattr(self, "_compiled_updater", None) is not None and \
+                self._compiled_updater is not self.updater:
+            # updater reassigned after compile: the cached programs
+            # bake the OLD update rule (and the opt state's moments
+            # belong to it) — evict everything, like SameDiff's
+            # set_training_config eviction of train_multi
+            self._step = None
+            self._multi_step = None
+            self._opt_state = None
         if getattr(self, "_step", None) is None:
+            self._compiled_updater = self.updater
             self._step = _make_train_step(self._loss_fn, self.updater)
             self._opt_state = self.updater.init_state(self.params)
             self._iteration = 0

@@ -201,6 +201,12 @@ def apply_layer_defaults(layer: Layer, base: "NeuralNetConfiguration.Builder"):
         layer.l2 = base._l2
     if layer.dropout is None and base._dropout is not None:
         layer.dropout = base._dropout
+    if layer.constrain_weights is None and base._constrain_weights:
+        layer.constrain_weights = list(base._constrain_weights)
+    if layer.constrain_bias is None and base._constrain_bias:
+        layer.constrain_bias = list(base._constrain_bias)
+    if layer.constrain_all is None and base._constrain_all:
+        layer.constrain_all = list(base._constrain_all)
 
 
 class ListBuilder:
@@ -286,6 +292,9 @@ class NeuralNetConfiguration:
             self._dtype = "float32"
             self._compute_dtype: Optional[str] = None
             self._remat_segments = 0
+            self._constrain_weights: list = []
+            self._constrain_bias: list = []
+            self._constrain_all: list = []
 
         def seed(self, s: int) -> "NeuralNetConfiguration.Builder":
             self._seed = int(s)
@@ -315,6 +324,24 @@ class NeuralNetConfiguration:
         def activation(self, a: Activation
                        ) -> "NeuralNetConfiguration.Builder":
             self._activation = a
+            return self
+
+        def constrain_weights(self, *constraints
+                              ) -> "NeuralNetConfiguration.Builder":
+            """Post-update projections on every layer's weight params
+            (reference: Builder.constrainWeights)."""
+            self._constrain_weights = list(constraints)
+            return self
+
+        def constrain_bias(self, *constraints
+                           ) -> "NeuralNetConfiguration.Builder":
+            self._constrain_bias = list(constraints)
+            return self
+
+        def constrain_all_parameters(
+                self, *constraints) -> "NeuralNetConfiguration.Builder":
+            """Reference: Builder.constrainAllParameters."""
+            self._constrain_all = list(constraints)
             return self
 
         def gradient_normalization(

@@ -1,0 +1,173 @@
+"""Weight constraints: post-update parameter projections.
+
+Reference parity: ``org.deeplearning4j.nn.conf.constraint`` —
+``MaxNormConstraint``, ``MinMaxNormConstraint``, ``NonNegativeConstraint``,
+``UnitNormConstraint`` (SURVEY.md D1). Semantics follow the reference:
+constraints are applied to the parameters AFTER each updater step (a
+projection, not a gradient penalty), inside the jitted train step so the
+projection fuses with the update.
+
+Norms are computed per output unit: over all axes EXCEPT the last, since
+every weight tensor in this framework stores the output axis last
+(dense ``[n_in, n_out]``, conv ``[kh, kw, c_in, c_out]`` — see
+``nn/conf/layers.py``). An explicit ``dims`` overrides.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+_EPS = 1e-7
+
+
+class LayerConstraint:
+    """Base projection. Reference: o.d.nn.api.layers.LayerConstraint."""
+
+    def apply(self, p):
+        raise NotImplementedError
+
+    def _norm(self, p, dims):
+        if dims is None:
+            dims = tuple(range(p.ndim - 1)) if p.ndim > 1 else (0,)
+        return jnp.sqrt(jnp.sum(
+            jnp.square(p.astype(jnp.float32)), axis=dims, keepdims=True))
+
+    # -- serde ----------------------------------------------------------
+    def to_map(self) -> dict:
+        d = {"@class": type(self).__name__}
+        d.update({k: v for k, v in self.__dict__.items()})
+        return d
+
+    @staticmethod
+    def from_map(d: dict) -> "LayerConstraint":
+        d = dict(d)
+        cls = CONSTRAINT_REGISTRY[d.pop("@class")]
+        if "dims" in d and isinstance(d["dims"], list):
+            d["dims"] = tuple(d["dims"])
+        return cls(**d)
+
+    def __eq__(self, other):
+        return type(self) is type(other) and \
+            self.__dict__ == other.__dict__
+
+    def __repr__(self):
+        args = ", ".join(f"{k}={v}" for k, v in self.__dict__.items())
+        return f"{type(self).__name__}({args})"
+
+
+class MaxNormConstraint(LayerConstraint):
+    """Rescale any unit whose norm exceeds ``max_norm`` down to it.
+    Reference: o.d.nn.conf.constraint.MaxNormConstraint."""
+
+    def __init__(self, max_norm: float = 2.0, dims=None):
+        self.max_norm = float(max_norm)
+        self.dims = tuple(dims) if dims is not None else None
+
+    def apply(self, p):
+        n = self._norm(p, self.dims)
+        scale = jnp.clip(n, None, self.max_norm) / (n + _EPS)
+        return (p * scale.astype(p.dtype)).astype(p.dtype)
+
+
+class MinMaxNormConstraint(LayerConstraint):
+    """Constrain unit norms into ``[min_norm, max_norm]``, moving a
+    fraction ``rate`` of the way there each step. Reference:
+    o.d.nn.conf.constraint.MinMaxNormConstraint."""
+
+    def __init__(self, min_norm: float = 0.0, max_norm: float = 2.0,
+                 rate: float = 1.0, dims=None):
+        self.min_norm = float(min_norm)
+        self.max_norm = float(max_norm)
+        self.rate = float(rate)
+        self.dims = tuple(dims) if dims is not None else None
+
+    def apply(self, p):
+        n = self._norm(p, self.dims)
+        target = jnp.clip(n, self.min_norm, self.max_norm)
+        scale = self.rate * target / (n + _EPS) + (1.0 - self.rate)
+        return (p * scale.astype(p.dtype)).astype(p.dtype)
+
+
+class UnitNormConstraint(LayerConstraint):
+    """Project every unit onto the unit sphere. Reference:
+    o.d.nn.conf.constraint.UnitNormConstraint."""
+
+    def __init__(self, dims=None):
+        self.dims = tuple(dims) if dims is not None else None
+
+    def apply(self, p):
+        n = self._norm(p, self.dims)
+        return (p / (n + _EPS).astype(p.dtype)).astype(p.dtype)
+
+
+class NonNegativeConstraint(LayerConstraint):
+    """Clamp parameters at zero. Reference:
+    o.d.nn.conf.constraint.NonNegativeConstraint."""
+
+    def apply(self, p):
+        return jnp.maximum(p, jnp.zeros((), p.dtype))
+
+
+CONSTRAINT_REGISTRY = {c.__name__: c for c in (
+    MaxNormConstraint, MinMaxNormConstraint, UnitNormConstraint,
+    NonNegativeConstraint)}
+
+
+# ---------------------------------------------------------------------------
+def _is_weight_param(layer, name: str, p) -> bool:
+    # output-axis-last weight matrices/kernels; recurrent RW included,
+    # the way the reference's constrainWeights covers all weight params
+    return name in ("W", "RW") or p.ndim >= 2
+
+
+def _is_bias_param(name: str) -> bool:
+    return name == "b"
+
+
+def apply_constraints(layer, params: dict) -> dict:
+    """Project a layer's freshly-updated param dict through its
+    configured constraints (no-op when the layer has none). Runs inside
+    the jitted train step, after the updater (reference semantics:
+    ``BaseConstraint.applyConstraint`` post-update)."""
+    cw = getattr(layer, "constrain_weights", None)
+    cb = getattr(layer, "constrain_bias", None)
+    ca = getattr(layer, "constrain_all", None)
+    cp = getattr(layer, "constrain_params", None)
+    if not (cw or cb or ca or cp):
+        return params
+    out = {}
+    for name, p in params.items():
+        if isinstance(p, dict):
+            # wrapper layers (Bidirectional fwd/bwd, TimeDistributed)
+            # nest sub-param tables; constrain at the leaves
+            out[name] = apply_constraints(layer, p)
+            continue
+        if cw and _is_weight_param(layer, name, p):
+            for c in cw:
+                p = c.apply(p)
+        if cb and _is_bias_param(name):
+            for c in cb:
+                p = c.apply(p)
+        if ca:
+            for c in ca:
+                p = c.apply(p)
+        if cp:
+            # exact param-name scoping (reference: BaseConstraint
+            # carries a param-name set; Keras constraints are per-param
+            # — kernel vs recurrent vs depthwise vs pointwise)
+            for c in cp.get(name, ()):
+                p = c.apply(p)
+        out[name] = p
+    return out
+
+
+def constraints_to_map(v):
+    """Serde helper for a list-of-constraints field (JSON round-trip)."""
+    if v is None:
+        return None
+    return [c.to_map() for c in v]
+
+
+def constraints_from_map(v):
+    if v is None:
+        return None
+    return [LayerConstraint.from_map(m) for m in v]

@@ -93,6 +93,16 @@ class Layer:
     dropout: object = None
     #: optional WeightNoise/DropConnect applied to params in training
     weight_noise: object = None
+    #: post-update projections (lists of LayerConstraint); None -> net
+    #: default. Reference: o.d.nn.conf.constraint + builder
+    #: constrainWeights/constrainBias/constrainAllParameters
+    constrain_weights: object = None
+    constrain_bias: object = None
+    constrain_all: object = None
+    #: exact param-name scoping: {"W": [c...], "RW": [c...]} — the
+    #: Keras import surface (kernel_constraint vs recurrent_constraint
+    #: are per-param, like the reference's BaseConstraint param sets)
+    constrain_params: object = None
     name: Optional[str] = None
 
     def __post_init__(self):
@@ -171,6 +181,8 @@ class Layer:
     def to_map(self) -> dict:
         from deeplearning4j_tpu.nn.conf.dropout import IDropout, \
             WeightNoise
+        from deeplearning4j_tpu.nn.conf.constraints import \
+            constraints_to_map
         d = {"@class": type(self).__name__}
         for k, v in self.__dict__.items():
             if isinstance(v, enum.Enum):
@@ -179,6 +191,11 @@ class Layer:
                 v = v.to_map()
             elif isinstance(v, LossFunction):
                 v = v.name
+            elif k in ("constrain_weights", "constrain_bias",
+                       "constrain_all"):
+                v = constraints_to_map(v)
+            elif k == "constrain_params" and v is not None:
+                v = {pk: constraints_to_map(pv) for pk, pv in v.items()}
             d[k] = v
         return d
 
@@ -198,6 +215,16 @@ class Layer:
                 from deeplearning4j_tpu.nn.conf.dropout import \
                     WeightNoise
                 d[k] = WeightNoise.from_map(v)
+            elif k in ("constrain_weights", "constrain_bias",
+                       "constrain_all") and isinstance(v, list):
+                from deeplearning4j_tpu.nn.conf.constraints import \
+                    constraints_from_map
+                d[k] = constraints_from_map(v)
+            elif k == "constrain_params" and isinstance(v, dict):
+                from deeplearning4j_tpu.nn.conf.constraints import \
+                    constraints_from_map
+                d[k] = {pk: constraints_from_map(pv)
+                        for pk, pv in v.items()}
             elif k in ("pooling_type",) and isinstance(v, str):
                 d[k] = PoolingType[v]
             elif k in ("convolution_mode",) and isinstance(v, str):

@@ -20,6 +20,7 @@ import numpy as np
 from deeplearning4j_tpu.common.dtypes import to_jnp_dtype
 from deeplearning4j_tpu.nn.conf.graph_conf import \
     ComputationGraphConfiguration
+from deeplearning4j_tpu.nn.conf.constraints import apply_constraints
 from deeplearning4j_tpu.nn.conf.layers import BaseOutputLayer
 from deeplearning4j_tpu.nn.gradient import apply_gradient_normalization
 from deeplearning4j_tpu.nn.multilayer import _as_jnp
@@ -151,10 +152,15 @@ class ComputationGraph:
         else:
             acts = dict(zip(conf.network_inputs, inputs))
             new_states = {}
+            li = 0
             for name in self._topo:
                 lrng = None
                 if rng is not None and conf.vertices[name].is_layer:
-                    rng, lrng = jax.random.split(rng)
+                    # fold_in by layer position — same derivation as
+                    # _forward_segmented, so toggling remat_segments
+                    # does not change the dropout/weight-noise stream
+                    lrng = jax.random.fold_in(rng, li)
+                    li += 1
                 h, ns = run_vertex(name, acts, lrng)
                 acts[name] = h
                 new_states[name] = ns
@@ -172,8 +178,9 @@ class ComputationGraph:
         backward pass; everything inside a segment is recomputed
         (sqrt(N) checkpointing — trades recompute FLOPs for HBM
         activation traffic, usually a win on bandwidth-bound TPUs).
-        Per-vertex RNG is pre-split so the stream does not depend on
-        the segmentation."""
+        Per-vertex RNG is ``fold_in(rng, layer position)`` — the same
+        derivation as the plain walk, so the random stream is invariant
+        to segmentation (and to remat on/off)."""
         from deeplearning4j_tpu.common.remat import segment_plan
         conf = self.conf
         topo = self._topo
@@ -181,8 +188,8 @@ class ComputationGraph:
 
         layer_names = [n for n in topo if conf.vertices[n].is_layer]
         if rng is not None and layer_names:
-            keys = jax.random.split(rng, len(layer_names))
-            rng_for = {n: keys[i] for i, n in enumerate(layer_names)}
+            rng_for = {n: jax.random.fold_in(rng, i)
+                       for i, n in enumerate(layer_names)}
         else:
             rng_for = {}
 
@@ -317,8 +324,12 @@ class ComputationGraph:
                 g = apply_gradient_normalization(gn, thr, g)
                 updates, us = updaters[name].apply(
                     g, upd_states[name], iteration)
-                new_params[name] = jax.tree_util.tree_map(
+                new_p = jax.tree_util.tree_map(
                     lambda p, u: p - u, params[name], updates)
+                v = conf.vertices[name]
+                if v.is_layer:
+                    new_p = apply_constraints(v.content, new_p)
+                new_params[name] = new_p
                 new_upd[name] = us
             return new_params, new_states, new_upd, loss
 

@@ -23,6 +23,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from deeplearning4j_tpu.common.dtypes import to_jnp_dtype
+from deeplearning4j_tpu.nn.conf.constraints import apply_constraints
 from deeplearning4j_tpu.nn.conf.builders import (BackpropType,
                                                  MultiLayerConfiguration)
 from deeplearning4j_tpu.nn.conf.layers import BaseOutputLayer
@@ -157,10 +158,13 @@ class MultiLayerNetwork:
                 conf.remat_segments > 1 and n > 1:
             # sqrt(N) checkpointing: only segment-boundary activations
             # are stored for backward; interiors are recomputed.
-            # Per-layer RNG is pre-split so the stream does not depend
-            # on the segmentation.
+            # Per-layer RNG is fold_in(rng, layer index) — the SAME
+            # derivation as the plain path below, so toggling
+            # remat_segments does not change the dropout/weight-noise
+            # stream (it used to: pre-split here vs sequential split
+            # there)
             from deeplearning4j_tpu.common.remat import segment_plan
-            keys = (jax.random.split(rng, n)
+            keys = ([jax.random.fold_in(rng, j) for j in range(n)]
                     if rng is not None else [None] * n)
 
             def make_seg(lo, hi):
@@ -182,9 +186,11 @@ class MultiLayerNetwork:
             for i in range(n):
                 if stop_at is not None and i >= stop_at:
                     break
-                lrng = None
-                if rng is not None:
-                    rng, lrng = jax.random.split(rng)
+                # fold_in(rng, layer index), matching the segmented
+                # path: the random stream is a function of the layer,
+                # not of how the walk is segmented
+                lrng = (jax.random.fold_in(rng, i)
+                        if rng is not None else None)
                 h, ns = run_layer(i, h, lrng)
                 new_states[f"layer_{i}"] = ns
         if conf.compute_dtype:
@@ -271,8 +277,11 @@ class MultiLayerNetwork:
                     continue
                 g = apply_gradient_normalization(gn, thr, g)
                 updates, us = up.apply(g, upd_states[k], iteration)
-                new_params[k] = jax.tree_util.tree_map(
+                new_p = jax.tree_util.tree_map(
                     lambda p, u: p - u, params[k], updates)
+                # post-update projection (reference: constraints are
+                # applied after the updater, inside the same step)
+                new_params[k] = apply_constraints(conf.layers[i], new_p)
                 new_upd[k] = us
             return new_params, new_states, new_upd, loss
 
@@ -419,6 +428,7 @@ class MultiLayerNetwork:
                 updates, new_us = up.apply(g, us, iteration)
                 new_lp = jax.tree_util.tree_map(lambda p, u: p - u, lp,
                                                 updates)
+                new_lp = apply_constraints(layer, new_lp)
                 return new_lp, new_us, loss
 
             self._pretrain_steps[idx] = jax.jit(step,

@@ -200,3 +200,28 @@ def test_fused_qkv_matches_unfused():
                                atol=2e-5)
     np.testing.assert_allclose(np.asarray(pa), np.asarray(pb),
                                atol=2e-5)
+
+
+def test_updater_reassignment_evicts_compiled_step():
+    """Replacing model.updater after the first fit must recompile the
+    cached step/fori programs with the NEW update rule and reset the
+    opt state (r4 advisor finding: the cache had no invalidation
+    key, so a swapped updater was silently ignored)."""
+    from deeplearning4j_tpu.learning.updaters import Sgd
+    c = BertConfig.tiny(hidden_dropout_prob=0.0,
+                        attention_probs_dropout_prob=0.0)
+    m = Bert(c, Adam(1e-3)).init()
+    batch = _mlm_batch(n=4, t=16, vocab=c.vocab_size)
+    m.fit_batch(batch)
+    old_step = m._step
+    assert m._iteration == 1
+
+    m.updater = Sgd(0.0)            # lr 0: params must stop moving
+    before = jax.tree_util.tree_map(np.asarray, m.params)
+    m.fit_batch(batch)
+    assert m._step is not old_step, "stale compiled step kept old rule"
+    assert m._iteration == 1        # opt state (and iteration) reset
+    after = jax.tree_util.tree_map(np.asarray, m.params)
+    for a, b in zip(jax.tree_util.tree_leaves(before),
+                    jax.tree_util.tree_leaves(after)):
+        np.testing.assert_array_equal(a, b)

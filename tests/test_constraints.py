@@ -1,0 +1,397 @@
+"""Weight constraints (SURVEY.md D1 — the reference's
+org.deeplearning4j.nn.conf.constraint package: MaxNorm/MinMaxNorm/
+UnitNorm/NonNegative post-update projections, builder
+constrainWeights/constrainBias/constrainAllParameters, and the Keras
+kernel_constraint/bias_constraint import surface)."""
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from deeplearning4j_tpu.activations import Activation
+from deeplearning4j_tpu.learning import Sgd
+from deeplearning4j_tpu.lossfunctions import LossFunction
+from deeplearning4j_tpu.nn.conf.builders import (
+    MultiLayerConfiguration, NeuralNetConfiguration)
+from deeplearning4j_tpu.nn.conf.constraints import (
+    LayerConstraint, MaxNormConstraint, MinMaxNormConstraint,
+    NonNegativeConstraint, UnitNormConstraint, apply_constraints)
+from deeplearning4j_tpu.nn.conf.layers import DenseLayer, OutputLayer
+from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+
+
+def _unit_norms(w):
+    return np.sqrt(np.sum(np.square(np.asarray(w, np.float32)),
+                          axis=0))
+
+
+class TestConstraintMath:
+    def test_max_norm_rescales_only_violators(self):
+        w = jnp.asarray([[3.0, 0.1], [4.0, 0.1]])   # norms 5, ~0.14
+        out = np.asarray(MaxNormConstraint(2.0).apply(w))
+        norms = _unit_norms(out)
+        assert norms[0] == pytest.approx(2.0, rel=1e-5)
+        # the compliant unit is untouched
+        np.testing.assert_allclose(out[:, 1], [0.1, 0.1], atol=1e-6)
+
+    def test_unit_norm_projects_to_sphere(self):
+        w = jnp.asarray(np.random.RandomState(0).randn(6, 4) * 3)
+        norms = _unit_norms(UnitNormConstraint().apply(w))
+        np.testing.assert_allclose(norms, 1.0, atol=1e-5)
+
+    def test_min_max_norm_both_sides(self):
+        w = jnp.asarray([[5.0, 0.01], [0.0, 0.0]])  # norms 5, 0.01
+        out = np.asarray(MinMaxNormConstraint(0.5, 2.0).apply(w))
+        norms = _unit_norms(out)
+        assert norms[0] == pytest.approx(2.0, rel=1e-4)
+        assert norms[1] == pytest.approx(0.5, rel=1e-3)
+
+    def test_min_max_norm_partial_rate(self):
+        w = jnp.asarray([[4.0], [0.0]])             # norm 4
+        out = np.asarray(MinMaxNormConstraint(0.0, 2.0, rate=0.5)
+                         .apply(w))
+        # half-way projection: 0.5 * (2/4) + 0.5 = 0.75 -> norm 3
+        assert _unit_norms(out)[0] == pytest.approx(3.0, rel=1e-4)
+
+    def test_non_negative_clamps(self):
+        w = jnp.asarray([[-1.0, 2.0], [3.0, -4.0]])
+        out = np.asarray(NonNegativeConstraint().apply(w))
+        np.testing.assert_allclose(out, [[0.0, 2.0], [3.0, 0.0]])
+
+    def test_bf16_dtype_preserved(self):
+        w = jnp.asarray(np.random.RandomState(1).randn(4, 3),
+                        jnp.bfloat16)
+        for c in (MaxNormConstraint(1.0), UnitNormConstraint(),
+                  MinMaxNormConstraint(0.1, 1.0),
+                  NonNegativeConstraint()):
+            assert c.apply(w).dtype == jnp.bfloat16
+
+    def test_apply_constraints_param_routing(self):
+        layer = DenseLayer(n_in=3, n_out=2)
+        layer.constrain_weights = [NonNegativeConstraint()]
+        layer.constrain_bias = [MaxNormConstraint(0.5)]
+        params = {"W": jnp.asarray([[-1.0, 1.0]] * 3),
+                  "b": jnp.asarray([3.0, 4.0])}      # norm 5
+        out = apply_constraints(layer, params)
+        assert np.asarray(out["W"]).min() >= 0.0
+        assert np.linalg.norm(np.asarray(out["b"])) == \
+            pytest.approx(0.5, rel=1e-4)
+
+
+class TestConstrainedTraining:
+    def _fit(self, constrained: bool, steps=30):
+        b = NeuralNetConfiguration.Builder().seed(7) \
+            .updater(Sgd(0.5))                       # big LR forces drift
+        if constrained:
+            b = b.constrain_weights(MaxNormConstraint(1.0))
+        conf = b.list() \
+            .layer(DenseLayer(n_in=8, n_out=16,
+                              activation=Activation.TANH)) \
+            .layer(OutputLayer(n_in=16, n_out=4,
+                               activation=Activation.SOFTMAX,
+                               loss_function=LossFunction.MCXENT)) \
+            .build()
+        net = MultiLayerNetwork(conf).init()
+        rng = np.random.RandomState(3)
+        x = rng.randn(32, 8).astype(np.float32)
+        y = np.eye(4, dtype=np.float32)[rng.randint(0, 4, 32)]
+        for _ in range(steps):
+            net.fit(x, y)
+        return net
+
+    def test_max_norm_bounds_training_while_free_net_drifts(self):
+        free = self._fit(constrained=False)
+        bound = self._fit(constrained=True)
+        free_norms = np.concatenate([
+            _unit_norms(free.params[k]["W"]) for k in free.params])
+        bound_norms = np.concatenate([
+            _unit_norms(bound.params[k]["W"]) for k in bound.params])
+        assert free_norms.max() > 1.5          # SGD at lr .5 drifts
+        assert bound_norms.max() <= 1.0 + 1e-3  # projection held
+        # and the constrained net still learned (loss finite, moved)
+        assert np.isfinite(bound.score())
+
+    def test_per_layer_constraint_overrides_global(self):
+        conf = NeuralNetConfiguration.Builder().seed(1) \
+            .updater(Sgd(0.5)) \
+            .constrain_weights(MaxNormConstraint(1.0)).list() \
+            .layer(DenseLayer(n_in=4, n_out=8,
+                              activation=Activation.RELU,
+                              constrain_weights=[
+                                  MaxNormConstraint(0.25)])) \
+            .layer(OutputLayer(n_in=8, n_out=2,
+                               activation=Activation.SOFTMAX,
+                               loss_function=LossFunction.MCXENT)) \
+            .build()
+        assert conf.layers[0].constrain_weights == \
+            [MaxNormConstraint(0.25)]
+        assert conf.layers[1].constrain_weights == \
+            [MaxNormConstraint(1.0)]
+        net = MultiLayerNetwork(conf).init()
+        rng = np.random.RandomState(5)
+        x = rng.randn(16, 4).astype(np.float32)
+        y = np.eye(2, dtype=np.float32)[rng.randint(0, 2, 16)]
+        for _ in range(20):
+            net.fit(x, y)
+        assert _unit_norms(net.params["layer_0"]["W"]).max() \
+            <= 0.25 + 1e-3
+        assert _unit_norms(net.params["layer_1"]["W"]).max() \
+            <= 1.0 + 1e-3
+
+    def test_fit_steps_applies_constraints(self):
+        conf = NeuralNetConfiguration.Builder().seed(2) \
+            .updater(Sgd(0.5)) \
+            .constrain_all_parameters(NonNegativeConstraint()).list() \
+            .layer(DenseLayer(n_in=6, n_out=6,
+                              activation=Activation.SIGMOID)) \
+            .layer(OutputLayer(n_in=6, n_out=3,
+                               activation=Activation.SOFTMAX,
+                               loss_function=LossFunction.MCXENT)) \
+            .build()
+        net = MultiLayerNetwork(conf).init()
+        rng = np.random.RandomState(9)
+
+        class DS:
+            features = rng.randn(16, 6).astype(np.float32)
+            labels = np.eye(3, dtype=np.float32)[
+                rng.randint(0, 3, 16)]
+
+        net.fit_steps(DS(), 25)
+        for k, tab in net.params.items():
+            for name, p in tab.items():
+                assert np.asarray(p).min() >= -1e-6, (k, name)
+
+    def test_graph_training_constraint(self):
+        from deeplearning4j_tpu.nn.graph import ComputationGraph
+        conf = NeuralNetConfiguration.Builder().seed(4) \
+            .updater(Sgd(0.5)) \
+            .constrain_weights(UnitNormConstraint()) \
+            .graph_builder() \
+            .add_inputs("in") \
+            .add_layer("d", DenseLayer(n_in=5, n_out=10,
+                                       activation=Activation.TANH),
+                       "in") \
+            .add_layer("out", OutputLayer(
+                n_in=10, n_out=2, activation=Activation.SOFTMAX,
+                loss_function=LossFunction.MCXENT), "d") \
+            .set_outputs("out").build()
+        g = ComputationGraph(conf).init()
+        rng = np.random.RandomState(11)
+        x = rng.randn(12, 5).astype(np.float32)
+        y = np.eye(2, dtype=np.float32)[rng.randint(0, 2, 12)]
+        for _ in range(10):
+            g.fit([x], [y])
+        norms = _unit_norms(g.params["d"]["W"])
+        np.testing.assert_allclose(norms, 1.0, atol=1e-3)
+
+
+class TestConstraintSerde:
+    def test_json_round_trip(self):
+        conf = NeuralNetConfiguration.Builder() \
+            .constrain_weights(MaxNormConstraint(1.5)) \
+            .constrain_bias(NonNegativeConstraint()).list() \
+            .layer(DenseLayer(
+                n_in=3, n_out=4,
+                constrain_all=[MinMaxNormConstraint(0.2, 2.0, 0.7)])) \
+            .layer(OutputLayer(n_in=4, n_out=2,
+                               loss_function=LossFunction.MSE)) \
+            .build()
+        back = MultiLayerConfiguration.from_json(conf.to_json())
+        assert back.layers[0].constrain_all == \
+            [MinMaxNormConstraint(0.2, 2.0, 0.7)]
+        assert back.layers[0].constrain_weights == \
+            [MaxNormConstraint(1.5)]
+        assert back.layers[1].constrain_bias == \
+            [NonNegativeConstraint()]
+
+    def test_registry_round_trip_each(self):
+        for c in (MaxNormConstraint(3.0, dims=(0, 1)),
+                  MinMaxNormConstraint(0.1, 0.9, 0.5),
+                  UnitNormConstraint(), NonNegativeConstraint()):
+            assert LayerConstraint.from_map(c.to_map()) == c
+
+
+class TestKerasConstraintImport:
+    def test_kernel_and_bias_constraints_attach_and_bound(self, tmp_path):
+        tf = pytest.importorskip("tensorflow")
+        keras = tf.keras
+        model = keras.Sequential([
+            keras.layers.Input((6,)),
+            keras.layers.Dense(8, activation="relu",
+                               kernel_constraint=
+                               keras.constraints.MaxNorm(1.0),
+                               bias_constraint=
+                               keras.constraints.NonNeg()),
+            keras.layers.Dense(3, activation="softmax",
+                               kernel_constraint=
+                               keras.constraints.UnitNorm()),
+        ])
+        model.compile(loss="categorical_crossentropy")
+        path = str(tmp_path / "model.keras")
+        model.save(path)
+        from deeplearning4j_tpu.modelimport.keras import KerasModelImport
+        net = KerasModelImport \
+            .import_keras_sequential_model_and_weights(path)
+        # keras axis=0 (its default) translates verbatim to dims=(0,);
+        # kernel_constraint scopes to the kernel param "W" exactly
+        assert net.conf.layers[0].constrain_params == \
+            {"W": [MaxNormConstraint(1.0, dims=(0,))]}
+        assert net.conf.layers[0].constrain_bias == \
+            [NonNegativeConstraint()]
+        assert net.conf.layers[1].constrain_params == \
+            {"W": [UnitNormConstraint(dims=(0,))]}
+        # the imported constraints actually bite during training
+        net.conf.updater = Sgd(0.5)
+        rng = np.random.RandomState(0)
+        x = rng.randn(16, 6).astype(np.float32)
+        y = np.eye(3, dtype=np.float32)[rng.randint(0, 3, 16)]
+        for _ in range(15):
+            net.fit(x, y)
+        assert _unit_norms(net.params["layer_0"]["W"]).max() \
+            <= 1.0 + 1e-3
+        assert np.asarray(net.params["layer_0"]["b"]).min() >= -1e-6
+        np.testing.assert_allclose(
+            _unit_norms(net.params["layer_1"]["W"]), 1.0, atol=1e-3)
+
+    def test_bidirectional_inner_constraint_imports(self, tmp_path):
+        tf = pytest.importorskip("tensorflow")
+        keras = tf.keras
+        model = keras.Sequential([
+            keras.layers.Input((5, 4)),
+            keras.layers.Bidirectional(keras.layers.LSTM(
+                6, return_sequences=True,
+                kernel_constraint=keras.constraints.MaxNorm(0.5))),
+            keras.layers.GlobalAveragePooling1D(),
+            keras.layers.Dense(2, activation="softmax"),
+        ])
+        model.compile(loss="categorical_crossentropy")
+        path = str(tmp_path / "model.keras")
+        model.save(path)
+        from deeplearning4j_tpu.modelimport.keras import KerasModelImport
+        net = KerasModelImport \
+            .import_keras_sequential_model_and_weights(path)
+        # the INNER layer's kernel_constraint scopes to "W" only — the
+        # recurrent kernel RW is NOT projected (keras semantics)
+        assert net.conf.layers[0].constrain_params == \
+            {"W": [MaxNormConstraint(0.5, dims=(0,))]}
+        # the projection recurses into the fwd/bwd nested param tables
+        # without crashing, and bounds both directions' weights
+        net.conf.updater = Sgd(0.5)
+        rng = np.random.RandomState(2)
+        x = rng.randn(8, 5, 4).astype(np.float32)
+        y = np.eye(2, dtype=np.float32)[rng.randint(0, 2, 8)]
+        for _ in range(10):
+            net.fit(x, y)
+        tab = net.params["layer_0"]
+        for d in ("fwd", "bwd"):
+            per_unit = np.sqrt(np.sum(np.square(
+                np.asarray(tab[d]["W"], np.float32)), axis=0))
+            assert per_unit.max() <= 0.5 + 1e-3, d
+
+
+class TestNestedParamTables:
+    def test_global_constraint_with_bidirectional_native(self):
+        """Repro from review: a GLOBAL constraint flows onto a
+        Bidirectional layer whose param table nests fwd/bwd dicts —
+        must project at the leaves, not crash on the dict."""
+        from deeplearning4j_tpu.nn import InputType
+        from deeplearning4j_tpu.nn.conf.layers_recurrent import (
+            Bidirectional, LSTM)
+        from deeplearning4j_tpu.nn.conf.layers import RnnOutputLayer
+        conf = NeuralNetConfiguration.Builder().seed(3) \
+            .updater(Sgd(0.5)) \
+            .constrain_weights(MaxNormConstraint(1.0)).list() \
+            .layer(Bidirectional(fwd=LSTM(n_out=5))) \
+            .layer(RnnOutputLayer(n_out=2,
+                                  activation=Activation.SOFTMAX,
+                                  loss_function=LossFunction.MCXENT)) \
+            .set_input_type(InputType.recurrent(4)).build()
+        net = MultiLayerNetwork(conf).init()
+        rng = np.random.RandomState(7)
+        x = rng.randn(6, 7, 4).astype(np.float32)
+        y = np.eye(2, dtype=np.float32)[rng.randint(0, 2, (6, 7))]
+        for _ in range(8):
+            net.fit(x, y)
+        tab = net.params["layer_0"]
+        for d in ("fwd", "bwd"):
+            for name, p in tab[d].items():
+                if np.ndim(p) >= 2:
+                    per_unit = np.sqrt(np.sum(np.square(
+                        np.asarray(p, np.float32)), axis=0))
+                    assert per_unit.max() <= 1.0 + 1e-3, (d, name)
+
+    def test_lstm_kernel_constraint_does_not_touch_recurrent(
+            self, tmp_path):
+        """keras per-param semantics: kernel_constraint projects the
+        input kernel W only; RW must drift freely (code-review
+        finding: an early draft conflated them)."""
+        tf = pytest.importorskip("tensorflow")
+        keras = tf.keras
+        model = keras.Sequential([
+            keras.layers.Input((6, 3)),
+            keras.layers.LSTM(4, kernel_constraint=
+                              keras.constraints.MaxNorm(0.3)),
+            keras.layers.Dense(2, activation="softmax"),
+        ])
+        model.compile(loss="categorical_crossentropy")
+        path = str(tmp_path / "m.keras")
+        model.save(path)
+        from deeplearning4j_tpu.modelimport.keras import KerasModelImport
+        net = KerasModelImport \
+            .import_keras_sequential_model_and_weights(path)
+        assert net.conf.layers[0].constrain_params == \
+            {"W": [MaxNormConstraint(0.3, dims=(0,))]}
+        net.conf.updater = Sgd(0.5)
+        rng = np.random.RandomState(4)
+        x = rng.randn(16, 6, 3).astype(np.float32)
+        y = np.eye(2, dtype=np.float32)[rng.randint(0, 2, 16)]
+        for _ in range(12):
+            net.fit(x, y)
+        w_norms = np.sqrt(np.sum(np.square(np.asarray(
+            net.params["layer_0"]["W"], np.float32)), axis=0))
+        rw_norms = np.sqrt(np.sum(np.square(np.asarray(
+            net.params["layer_0"]["RW"], np.float32)), axis=0))
+        assert w_norms.max() <= 0.3 + 1e-3
+        assert rw_norms.max() > 0.3      # unconstrained: free to exceed
+
+    def test_unknown_constraint_warns_unless_enforced(self, tmp_path):
+        """Unsupported constraint classes skip with a warning on plain
+        import (inference unaffected) and raise only under
+        enforce_training_config — the reference's switch for
+        training-only config it can't honor."""
+        tf = pytest.importorskip("tensorflow")
+        keras = tf.keras
+
+        @keras.utils.register_keras_serializable("test_constraints")
+        class Odd(keras.constraints.Constraint):
+            def __call__(self, w):
+                return w
+
+            def get_config(self):
+                return {}
+
+        model = keras.Sequential([
+            keras.layers.Input((4,)),
+            keras.layers.Dense(3, kernel_constraint=Odd()),
+        ])
+        path = str(tmp_path / "m.keras")
+        model.save(path)
+        from deeplearning4j_tpu.modelimport.keras import (
+            InvalidKerasConfigurationException, KerasModelImport)
+        net = KerasModelImport \
+            .import_keras_sequential_model_and_weights(path)
+        assert not net.conf.layers[0].constrain_params
+        x = np.random.RandomState(0).randn(2, 4).astype(np.float32)
+        np.testing.assert_allclose(
+            net.output(x), np.asarray(model(x)), atol=1e-4, rtol=1e-3)
+        with pytest.raises(InvalidKerasConfigurationException):
+            KerasModelImport.import_keras_sequential_model_and_weights(
+                path, enforce_training_config=True)
+
+    def test_json_round_trip_constrain_params(self):
+        layer = DenseLayer(n_in=3, n_out=4, constrain_params={
+            "W": [MaxNormConstraint(0.7, dims=(0,))]})
+        from deeplearning4j_tpu.nn.conf.layers import Layer
+        back = Layer.from_map(layer.to_map())
+        assert back.constrain_params == \
+            {"W": [MaxNormConstraint(0.7, dims=(0,))]}

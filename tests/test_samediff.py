@@ -494,3 +494,98 @@ def test_output_data_parallel_matches_single_device():
     mesh = make_mesh({"data": 8}, jax.devices()[:8])
     got = sd.output({"x": xv}, ["probs"], mesh=mesh)["probs"]
     np.testing.assert_allclose(got, want, rtol=1e-6, atol=1e-7)
+
+
+def test_chained_fit_steps_matches_single_call():
+    """The updater iteration persists across fit_steps calls: two
+    fit_steps(batch, 5) == one fit_steps(batch, 10) (Adam's
+    bias-correction warmup must not restart per call — r4 advisor
+    finding)."""
+    def build():
+        sd = SameDiff.create()
+        x = sd.placeholder("x", shape=(None, 2))
+        y = sd.placeholder("y", shape=(None, 1))
+        w = sd.var("w", array=np.zeros((2, 1), np.float32))
+        sd.loss.mean_squared_error(y, x @ w, name="loss")
+        sd.set_loss_variables("loss")
+        sd.set_training_config(
+            TrainingConfig.Builder().updater(Adam(0.1))
+            .data_set_feature_mapping("x")
+            .data_set_label_mapping("y").build())
+        return sd
+
+    rng = np.random.RandomState(0)
+    xv = rng.randn(64, 2).astype(np.float32)
+    yv = xv @ np.array([[2.0], [-3.0]], np.float32)
+    batch = {"x": xv, "y": yv}
+
+    chained = build()
+    chained.fit_steps(batch, 5)
+    chained.fit_steps(batch, 5)
+    assert chained.iteration_count == 10
+
+    single = build()
+    single.fit_steps(batch, 10)
+    np.testing.assert_allclose(
+        np.asarray(chained.get_variable("w").get_arr()),
+        np.asarray(single.get_variable("w").get_arr()),
+        rtol=1e-5, atol=1e-6)
+
+
+def test_fit_continues_iteration_after_fit_steps():
+    """fit() after fit_steps() continues the shared iteration counter
+    instead of restarting Adam warmup at 0."""
+    sd = SameDiff.create()
+    x = sd.placeholder("x", shape=(None, 2))
+    y = sd.placeholder("y", shape=(None, 1))
+    w = sd.var("w", array=np.zeros((2, 1), np.float32))
+    sd.loss.mean_squared_error(y, x @ w, name="loss")
+    sd.set_loss_variables("loss")
+    sd.set_training_config(
+        TrainingConfig.Builder().updater(Adam(0.1))
+        .data_set_feature_mapping("x")
+        .data_set_label_mapping("y").build())
+    rng = np.random.RandomState(1)
+    xv = rng.randn(32, 2).astype(np.float32)
+    yv = xv @ np.array([[1.0], [2.0]], np.float32)
+    sd.fit_steps({"x": xv, "y": yv}, 4)
+    it = ListDataSetIterator([DataSet(xv, yv)] * 3)
+    sd.fit(it, n_epochs=1)
+    assert sd.iteration_count == 7
+
+
+def test_fit_steps_mesh_replicates_non_batch_placeholder():
+    """A non-batch placeholder whose leading dim is NOT divisible by
+    the data axis (e.g. a [n_classes] weight vector) replicates
+    instead of being rejected (r4 advisor finding: only BATCH
+    placeholders need the divisibility contract)."""
+    from conftest import require_devices
+    require_devices(8)
+    import jax
+    from deeplearning4j_tpu.parallel import make_mesh
+    sd = SameDiff.create()
+    x = sd.placeholder("x", shape=(None, 2))
+    y = sd.placeholder("y", shape=(None, 1))
+    cw = sd.placeholder("cw", shape=(3,))      # len 3: not % 8
+    w = sd.var("w", array=np.zeros((2, 1), np.float32))
+    err = sd.loss.mean_squared_error(y, x @ w)
+    sd._op("mul", [err, sd.math.reduce_sum(cw)]).rename("loss")
+    sd.set_loss_variables("loss")
+    sd.set_training_config(
+        TrainingConfig.Builder().updater(Adam(0.1))
+        .data_set_feature_mapping("x")
+        .data_set_label_mapping("y").build())
+    rng = np.random.RandomState(0)
+    xv = rng.randn(64, 2).astype(np.float32)
+    yv = xv @ np.array([[2.0], [-3.0]], np.float32)
+    mesh = make_mesh({"data": 8}, jax.devices()[:8])
+    l = sd.fit_steps({"x": xv, "y": yv,
+                      "cw": np.ones(3, np.float32) / 3}, 5, mesh=mesh)
+    assert np.isfinite(l)
+    # the real batch stays guarded: indivisible BATCH still raises
+    try:
+        sd.fit_steps({"x": xv[:60], "y": yv[:60],
+                      "cw": np.ones(3, np.float32)}, 1, mesh=mesh)
+        assert False, "expected ValueError for indivisible batch"
+    except ValueError:
+        pass
