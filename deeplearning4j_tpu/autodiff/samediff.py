@@ -253,6 +253,23 @@ def _shard_placeholders(mesh, ph_vals: Dict, batch_names=None):
         tuple(int(mesh.shape[a]) for a in mesh.axis_names))
 
 
+def _write_samediff_zip(path, graph: dict, arrays: dict,
+                        cf_arrays: dict, upd_leaves):
+    """Write the SameDiff zip from already-host-resident state (shared
+    by ``save`` and the async checkpoint snapshot's background
+    ``write``)."""
+    with zipfile.ZipFile(path, "w") as z:
+        z.writestr("graph.json", json.dumps(graph, indent=1))
+        buf = io.BytesIO()
+        np.savez(buf, **arrays, **cf_arrays)
+        z.writestr("arrays.npz", buf.getvalue())
+        if upd_leaves is not None:
+            buf2 = io.BytesIO()
+            np.savez(buf2, **{f"leaf_{i}": l
+                              for i, l in enumerate(upd_leaves)})
+            z.writestr("updater.npz", buf2.getvalue())
+
+
 class SameDiff:
     """The graph. Build with var/constant/placeholder + op namespaces
     (sd.math, sd.nn, sd.cnn, sd.rnn, sd.loss, sd.image, sd.bitwise,
@@ -272,6 +289,13 @@ class SameDiff:
         #: updater iteration, persisted across fit()/fit_steps() calls
         #: (Adam bias correction must not restart per call)
         self.iteration_count: int = 0
+        self.epoch_count: int = 0
+        #: TrainingListener bus (reference: SameDiff.setListeners /
+        #: ListenerList — the SAME listener impls MLN/graph use:
+        #: Score/Performance/Evaluative/Checkpoint attach unchanged)
+        self.listeners: list = []
+        self._score: float = float("nan")
+        self.last_batch_size: int = 0
         #: sqrt(N) activation checkpointing for TRAINING programs:
         #: the op walk is cut into this many jax.checkpoint segments
         #: (only segment-boundary values are stored for backward).
@@ -622,7 +646,11 @@ class SameDiff:
         outputs = [o.name if isinstance(o, SDVariable) else o
                    for o in outputs]
         ph_vals = {k: jnp.asarray(v) for k, v in placeholders.items()}
-        ph_vals, mesh_sig = _shard_placeholders(mesh, ph_vals)
+        cfg = self.training_config
+        ph_vals, mesh_sig = _shard_placeholders(
+            mesh, ph_vals,
+            batch_names=(cfg.data_set_feature_mapping +
+                         cfg.data_set_label_mapping) if cfg else None)
         sig = (tuple(outputs), training, mesh_sig,
                tuple(sorted((k, v.shape, str(v.dtype))
                             for k, v in ph_vals.items())))
@@ -1052,25 +1080,99 @@ class SameDiff:
             jnp.asarray(self.iteration_count), n_steps)
         self._arrays.update(new_vars)
         self.iteration_count += n_steps
-        return float(loss)
+        self._score = float(loss)
+        first = next(iter(ph_vals.values()), None)
+        if first is not None and first.ndim:
+            self.last_batch_size = int(first.shape[0])
+        # one listener round per fori group with the final loss (the
+        # MLN fit_steps contract): checkpoints/score logging still
+        # attach to the benchmark-grade loop
+        for lis in self.listeners:
+            lis.iteration_done(self, self.iteration_count - 1,
+                               self.epoch_count)
+        return self._score
+
+    # -- listener bus (reference: SameDiff.setListeners; SURVEY S4/S8:
+    # the same TrainingListener impls as MLN/graph) ---------------------
+    def set_listeners(self, *listeners):
+        self.listeners = list(listeners)
+        return self
+
+    def add_listeners(self, *listeners):
+        self.listeners.extend(listeners)
+        return self
+
+    def score(self) -> float:
+        """Loss of the most recent train step (TrainingListener
+        surface: ScoreIterationListener calls ``model.score()``)."""
+        return float(self._score)
+
+    def _run_validation(self, iterator, evaluations, placeholders_fn):
+        """One pass over the validation iterator: mean loss + the
+        requested per-output-var evaluations (reference: SameDiff.fit's
+        validation ``History`` records)."""
+        cfg = self.training_config
+        evals = {}
+        for name, spec in (evaluations or {}).items():
+            factory, label_idx = (spec if isinstance(spec, tuple)
+                                  else (spec, 0))
+            evals[name] = (factory(), label_idx)
+        if hasattr(iterator, "reset"):
+            iterator.reset()
+        data = iterator
+        if hasattr(data, "features"):
+            data = [data]
+        losses, n = [], 0
+        want = list(evals) + [v for v in self.loss_variables
+                              if v not in evals]
+        for batch in data:
+            ph = (placeholders_fn(batch) if placeholders_fn
+                  else cfg.placeholders_from(batch))
+            out = self.output(ph, want)
+            bl = sum(float(jnp.sum(out[v]))
+                     for v in self.loss_variables)
+            losses.append(bl)
+            n += 1
+            if not evals:
+                continue     # loss-only validation: batches need not
+            labels = batch.labels            # carry .labels at all
+            labels = (labels if isinstance(labels, (list, tuple))
+                      else [labels])
+            for name, (e, li) in evals.items():
+                e.eval(np.asarray(labels[li]), np.asarray(out[name]))
+        val_loss = float(np.mean(losses)) if n else float("nan")
+        return {k: e for k, (e, _) in evals.items()}, val_loss
 
     def fit(self, iterator=None, *, n_epochs: int = 1,
-            placeholders_fn=None):
+            placeholders_fn=None, listeners=None, validation_iter=None,
+            validation_evaluations=None, validation_frequency: int = 1):
         """fit(MultiDataSetIterator-like). Each element must provide the
         placeholder dict via training_config's feature/label mappings
         (reference: TrainingConfig dataSetFeatureMapping), or supply
-        ``placeholders_fn(batch) -> dict``."""
+        ``placeholders_fn(batch) -> dict``.
+
+        ``listeners``: extra TrainingListeners for this call (on top of
+        ``set_listeners``'s) — Score/Performance/Evaluative/Checkpoint
+        impls attach unchanged (the r4 verdict's S4 gap: imported
+        models used to train blind).
+        ``validation_iter`` + ``validation_evaluations``
+        ({output_var: Evaluation-factory or (factory, label_index)}):
+        evaluated every ``validation_frequency`` epochs; results land
+        in the returned History's evaluation records."""
         from deeplearning4j_tpu.autodiff.training import History
         cfg = self.training_config
         if cfg is None:
             raise ValueError("call set_training_config first")
         if not self.loss_variables:
             raise ValueError("call set_loss_variables first")
+        all_listeners = self.listeners + list(listeners or [])
         history = History()
         step_fn = None
         trainable = None
         iteration = self.iteration_count
         for epoch in range(n_epochs):
+            for lis in all_listeners:
+                lis.on_epoch_start(self)
             if hasattr(iterator, "reset"):
                 iterator.reset()
             epoch_losses = []
@@ -1112,9 +1214,33 @@ class SameDiff:
                     self._exec_cache.clear()
                     step_fn = None
                 epoch_losses.append(float(loss))
+                self._score = epoch_losses[-1]
+                first = next(iter(ph_vals.values()))
+                self.last_batch_size = (int(first.shape[0])
+                                        if first.ndim else 0)
+                # advance the counter BEFORE listeners fire (the
+                # MLN/fit_steps convention): an iteration-triggered
+                # checkpoint must serialize the post-step count, so a
+                # resumed job does not re-apply the consumed updater
+                # index. Listeners get the just-consumed index and the
+                # MODEL-lifetime epoch count, like MLN's bus.
                 iteration += 1
                 self.iteration_count = iteration
-            history.add_epoch(epoch, epoch_losses)
+                for lis in all_listeners:
+                    lis.iteration_done(self, iteration - 1,
+                                       self.epoch_count)
+            evals, val_loss = {}, float("nan")
+            if validation_iter is not None and \
+                    (epoch + 1) % max(1, validation_frequency) == 0:
+                evals, val_loss = self._run_validation(
+                    validation_iter, validation_evaluations,
+                    placeholders_fn)
+            history.add_epoch(epoch, epoch_losses, evals, val_loss)
+            # epoch count advances BEFORE listeners fire (an epoch-end
+            # checkpoint must serialize the true count — MLN contract)
+            self.epoch_count += 1
+            for lis in all_listeners:
+                lis.on_epoch_end(self)
         return history
 
     def _restore_updater_leaves(self):
@@ -1139,6 +1265,23 @@ class SameDiff:
         """Zip: graph.json + arrays.npz (+ updater npz) — the same
         contract as the reference .fb (graph + params + updater state +
         training config)."""
+        _write_samediff_zip(path,
+                            *self._serialized_state(save_updater_state))
+
+    def checkpoint_snapshot(self):
+        """Host-side snapshot for the async CheckpointListener: every
+        array is copied device->host NOW; ``write(path)`` can then run
+        on a background thread while training keeps mutating this
+        graph (the same contract as utils.checkpoint._ModelSnapshot
+        for MLN/graph models)."""
+        graph, arrays, cf_arrays, upd = self._serialized_state(True)
+
+        class _Snap:
+            def write(s, path):
+                _write_samediff_zip(path, graph, arrays, cf_arrays, upd)
+        return _Snap()
+
+    def _serialized_state(self, save_updater_state: bool):
         cf_arrays: dict = {}   # control-flow subgraph constants/captures
         graph = {
             "variables": [
@@ -1155,23 +1298,17 @@ class SameDiff:
             "training_config": (self.training_config.to_map()
                                 if self.training_config else None),
             # resuming training must continue the updater iteration
-            # (Adam bias correction), not restart warmup at 0
+            # (Adam bias correction) and the epoch schedule, not
+            # restart either at 0
             "iteration_count": self.iteration_count,
+            "epoch_count": self.epoch_count,
         }
-        with zipfile.ZipFile(path, "w") as z:
-            z.writestr("graph.json", json.dumps(graph, indent=1))
-            buf = io.BytesIO()
-            np.savez(buf, **{k: np.asarray(v)
-                             for k, v in self._arrays.items()},
-                     **cf_arrays)
-            z.writestr("arrays.npz", buf.getvalue())
-            if save_updater_state and self._updater_state is not None:
-                leaves, treedef = jax.tree_util.tree_flatten(
-                    self._updater_state)
-                buf2 = io.BytesIO()
-                np.savez(buf2, **{f"leaf_{i}": np.asarray(l)
-                                  for i, l in enumerate(leaves)})
-                z.writestr("updater.npz", buf2.getvalue())
+        arrays = {k: np.asarray(v) for k, v in self._arrays.items()}
+        upd_leaves = None
+        if save_updater_state and self._updater_state is not None:
+            leaves, _ = jax.tree_util.tree_flatten(self._updater_state)
+            upd_leaves = [np.asarray(l) for l in leaves]
+        return graph, arrays, cf_arrays, upd_leaves
 
     @staticmethod
     def load(path: str) -> "SameDiff":
@@ -1196,6 +1333,7 @@ class SameDiff:
                 sd._producer[on] = i
         sd.loss_variables = graph.get("loss_variables", [])
         sd.iteration_count = graph.get("iteration_count", 0)
+        sd.epoch_count = graph.get("epoch_count", 0)
         tc = graph.get("training_config")
         if tc:
             sd.training_config = TrainingConfig.from_map(tc)

@@ -122,13 +122,24 @@ class TrainingConfig:
 
 class History:
     """Per-epoch training history (reference:
-    org.nd4j.autodiff.listeners.records.History)."""
+    org.nd4j.autodiff.listeners.records.History — loss curves PLUS
+    the evaluation records ``fit`` collects on the validation iterator
+    each epoch)."""
 
     def __init__(self):
         self.epoch_losses: List[List[float]] = []
+        #: one dict per epoch: output-var name -> Evaluation-like
+        #: object (empty dict for epochs without validation)
+        self.epoch_evaluations: List[Dict[str, object]] = []
+        #: mean validation loss per epoch (nan when not measured)
+        self.validation_losses: List[float] = []
 
-    def add_epoch(self, epoch: int, losses: List[float]):
+    def add_epoch(self, epoch: int, losses: List[float],
+                  evaluations: Optional[Dict[str, object]] = None,
+                  validation_loss: float = float("nan")):
         self.epoch_losses.append(losses)
+        self.epoch_evaluations.append(dict(evaluations or {}))
+        self.validation_losses.append(validation_loss)
 
     def final_loss(self) -> float:
         if not self.epoch_losses or not self.epoch_losses[-1]:
@@ -137,6 +148,24 @@ class History:
 
     def loss_curve(self) -> List[float]:
         return [l for ep in self.epoch_losses for l in ep]
+
+    # -- evaluation records (reference: History.finalTrainingEvaluations
+    # / getEvaluations) ------------------------------------------------
+    def evaluations(self, name: str) -> List[object]:
+        """Every recorded evaluation for output var ``name``, in epoch
+        order (epochs without one are skipped)."""
+        return [d[name] for d in self.epoch_evaluations if name in d]
+
+    def final_evaluation(self, name: str):
+        ev = self.evaluations(name)
+        if not ev:
+            raise KeyError(
+                f"no evaluation recorded for {name!r} — pass "
+                f"validation_iter/validation_evaluations to fit")
+        return ev[-1]
+
+    def validation_loss_curve(self) -> List[float]:
+        return list(self.validation_losses)
 
     def __len__(self):
         return len(self.epoch_losses)

@@ -83,8 +83,13 @@ class CheckpointListener(TrainingListener):
         self._pending: Optional[concurrent.futures.Future] = None
 
     def _write(self, snapshot, tmp: Path, path: Path):
-        ModelSerializer.write_model(
-            snapshot, tmp, model_class=snapshot.model_class)
+        if hasattr(snapshot, "write"):
+            # model-provided snapshot (SameDiff.checkpoint_snapshot:
+            # the imported-model path has its own zip format)
+            snapshot.write(tmp)
+        else:
+            ModelSerializer.write_model(
+                snapshot, tmp, model_class=snapshot.model_class)
         os.replace(tmp, path)  # atomic: readers never see partials
         self._rotate()
 
@@ -98,10 +103,12 @@ class CheckpointListener(TrainingListener):
         self._saved.append(path)
         self._last_saved_state = (model.iteration_count,
                                   model.epoch_count)
+        snap = (model.checkpoint_snapshot()
+                if hasattr(model, "checkpoint_snapshot")
+                else _ModelSnapshot(model))
         if not self.asynchronous:
-            self._write(_ModelSnapshot(model), tmp, path)
+            self._write(snap, tmp, path)
             return
-        snap = _ModelSnapshot(model)
         if self._executor is None:
             self._executor = concurrent.futures.ThreadPoolExecutor(
                 max_workers=1,

@@ -1,0 +1,203 @@
+"""SameDiff training listeners + evaluation-during-training (SURVEY.md
+S4/S8 — the reference's SameDiff.fit(iter, epochs, listeners...) with
+ListenerList and History evaluation records; r4 verdict Missing #2:
+the imported-model path used to train blind while MLN/graph had the
+full listener bus)."""
+import os
+import sys
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.autodiff.samediff import SameDiff
+from deeplearning4j_tpu.autodiff.training import TrainingConfig
+from deeplearning4j_tpu.datasets.dataset import DataSet
+from deeplearning4j_tpu.datasets.iterators import ListDataSetIterator
+from deeplearning4j_tpu.evaluation import Evaluation
+from deeplearning4j_tpu.learning import Adam
+from deeplearning4j_tpu.optimize.listeners import (
+    CollectScoresListener, ScoreIterationListener)
+from deeplearning4j_tpu.utils.checkpoint import CheckpointListener
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+
+def _classifier_sd():
+    """Tiny softmax classifier with placeholders x [B,4] / y [B,3]."""
+    sd = SameDiff.create()
+    x = sd.placeholder("x", shape=(None, 4))
+    y = sd.placeholder("y", shape=(None, 3))
+    w = sd.var("w", array=np.zeros((4, 3), np.float32))
+    b = sd.var("b", array=np.zeros((3,), np.float32))
+    logits = (x @ w + b).rename("logits")
+    sd.nn.softmax(logits, name="probs")
+    sd.loss.softmax_cross_entropy(y, logits, name="loss")
+    sd.set_loss_variables("loss")
+    sd.set_training_config(
+        TrainingConfig.Builder().updater(Adam(0.1))
+        .data_set_feature_mapping("x")
+        .data_set_label_mapping("y").build())
+    return sd
+
+
+def _data(n=64, seed=0):
+    rng = np.random.RandomState(seed)
+    x = rng.randn(n, 4).astype(np.float32)
+    labels = (x[:, 0] > 0).astype(int) + (x[:, 1] > 0).astype(int)
+    y = np.eye(3, dtype=np.float32)[labels]
+    return x, y
+
+
+class TestListenerBus:
+    def test_score_and_collect_listeners_fire(self):
+        sd = _classifier_sd()
+        collect = CollectScoresListener()
+        sd.set_listeners(ScoreIterationListener(5), collect)
+        x, y = _data()
+        it = ListDataSetIterator([DataSet(x, y)] * 4)
+        sd.fit(it, n_epochs=3)
+        # 12 iterations, every one collected, scores finite + falling
+        assert len(collect.scores) == 12
+        its = [i for i, _ in collect.scores]
+        assert its == list(range(12))
+        scores = [s for _, s in collect.scores]
+        assert np.isfinite(scores).all()
+        assert scores[-1] < scores[0]
+        assert sd.epoch_count == 3
+
+    def test_per_call_listeners_compose_with_set_listeners(self):
+        sd = _classifier_sd()
+        base = CollectScoresListener()
+        extra = CollectScoresListener()
+        sd.set_listeners(base)
+        x, y = _data()
+        it = ListDataSetIterator([DataSet(x, y)] * 2)
+        sd.fit(it, n_epochs=1, listeners=[extra])
+        assert len(base.scores) == len(extra.scores) == 2
+
+    def test_fit_steps_fires_listener_group(self):
+        sd = _classifier_sd()
+        collect = CollectScoresListener()
+        sd.set_listeners(collect)
+        x, y = _data()
+        sd.fit_steps({"x": x, "y": y}, 7)
+        assert len(collect.scores) == 1
+        assert collect.scores[0][0] == 6     # final iteration index
+        assert np.isfinite(collect.scores[0][1])
+        assert sd.last_batch_size == 64
+
+
+class TestEvaluationDuringTraining:
+    def test_history_gains_evaluation_records(self):
+        sd = _classifier_sd()
+        x, y = _data()
+        xv, yv = _data(n=32, seed=1)
+        it = ListDataSetIterator([DataSet(x, y)] * 4)
+        val = ListDataSetIterator([DataSet(xv, yv)])
+        hist = sd.fit(it, n_epochs=4, validation_iter=val,
+                      validation_evaluations={"probs": Evaluation})
+        assert len(hist.epoch_evaluations) == 4
+        evals = hist.evaluations("probs")
+        assert len(evals) == 4
+        # the task is learnable: final accuracy beats the first epoch's
+        assert evals[-1].accuracy() >= evals[0].accuracy()
+        assert evals[-1].accuracy() > 0.5
+        assert np.isfinite(hist.validation_loss_curve()).all()
+        assert hist.validation_losses[-1] < hist.validation_losses[0]
+        assert hist.final_evaluation("probs") is evals[-1]
+
+    def test_validation_frequency_skips_epochs(self):
+        sd = _classifier_sd()
+        x, y = _data()
+        it = ListDataSetIterator([DataSet(x, y)])
+        val = ListDataSetIterator([DataSet(x, y)])
+        hist = sd.fit(it, n_epochs=4, validation_iter=val,
+                      validation_evaluations={"probs": Evaluation},
+                      validation_frequency=2)
+        assert len(hist.evaluations("probs")) == 2
+        assert np.isnan(hist.validation_losses[0])
+        assert np.isfinite(hist.validation_losses[1])
+
+
+class TestCheckpointListenerOnSameDiff:
+    def test_async_epoch_checkpoints_and_resume(self, tmp_path):
+        sd = _classifier_sd()
+        ckpt = CheckpointListener(tmp_path, save_every_n_epochs=2,
+                                  asynchronous=True)
+        sd.set_listeners(ckpt)
+        x, y = _data()
+        it = ListDataSetIterator([DataSet(x, y)] * 2)
+        sd.fit(it, n_epochs=4)
+        ckpt.flush()
+        saved = sorted(tmp_path.glob("checkpoint_*.zip"))
+        assert len(saved) == 2                   # epochs 2 and 4
+        back = SameDiff.load(str(saved[-1]))
+        np.testing.assert_allclose(
+            np.asarray(back.get_variable("w").get_arr()),
+            np.asarray(sd.get_variable("w").get_arr()),
+            rtol=1e-6, atol=1e-7)
+        # resumable: updater iteration persisted through the zip
+        assert back.iteration_count == 8
+        back.fit(it, n_epochs=1)                 # trains on, no error
+        assert back.iteration_count == 10
+
+    def test_iteration_checkpoints_via_fit_steps(self, tmp_path):
+        """The benchmark-grade fori loop checkpoints too: one listener
+        round per group, so save_every_n_iterations=1 saves after each
+        fit_steps call (BASELINE #4's imported-model training loop)."""
+        sd = _classifier_sd()
+        ckpt = CheckpointListener(tmp_path, save_every_n_iterations=1,
+                                  asynchronous=True)
+        sd.set_listeners(ckpt)
+        x, y = _data()
+        sd.fit_steps({"x": x, "y": y}, 5)
+        sd.fit_steps({"x": x, "y": y}, 5)
+        ckpt.flush()
+        saved = sorted(tmp_path.glob("checkpoint_*.zip"))
+        assert len(saved) == 2
+        back = SameDiff.load(str(saved[-1]))
+        assert back.iteration_count == 10
+
+
+class TestImportedModelParity:
+    """The r4 verdict's acceptance shape: a TF-IMPORTED model trains
+    with a score listener, periodic async checkpoints, and per-epoch
+    eval — the full MLN listener experience on the S6 path (toy dims;
+    real-dim training is test_tf_import_bert_base)."""
+
+    def test_imported_bert_trains_with_listeners_and_checkpoints(
+            self, tmp_path):
+        pytest.importorskip("tensorflow")
+        from benchmarks.tf_bert_builder import (build_frozen_bert,
+                                                import_and_attach_mlm)
+        vocab, hidden, heads, layers, seq, batch = 50, 16, 2, 2, 16, 4
+        gd, _ = build_frozen_bert(seq, batch, vocab=vocab,
+                                  hidden=hidden, heads=heads,
+                                  layers=layers, intermediate=32)
+        sd, loss_name = import_and_attach_mlm(
+            gd, batch, seq, vocab=vocab, hidden=hidden,
+            updater=Adam(1e-3))
+        rs = np.random.RandomState(0)
+        ids = rs.randint(0, vocab, (batch, seq)).astype(np.int32)
+        seg = np.zeros((batch, seq), np.int32)
+        mask = np.ones((batch, seq), np.int32)
+        labels = np.where(rs.rand(batch, seq) < 0.15,
+                          rs.randint(0, vocab, (batch, seq)),
+                          -1).astype(np.int32)
+        b = {"ids": ids, "seg": seg, "mask": mask,
+             "mlm_labels": labels}
+        collect = CollectScoresListener()
+        ckpt = CheckpointListener(tmp_path, save_every_n_epochs=1,
+                                  asynchronous=True)
+        hist = sd.fit([b] * 3, n_epochs=2,
+                      placeholders_fn=lambda bb: bb,
+                      listeners=[collect, ckpt])
+        ckpt.flush()
+        assert len(collect.scores) == 6
+        scores = [s for _, s in collect.scores]
+        assert np.isfinite(scores).all() and scores[-1] < scores[0]
+        saved = sorted(tmp_path.glob("checkpoint_*.zip"))
+        assert len(saved) == 2
+        back = SameDiff.load(str(saved[-1]))
+        assert back.iteration_count == 6
+        assert len(hist) == 2
