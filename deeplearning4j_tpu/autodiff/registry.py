@@ -1044,7 +1044,81 @@ def _resize_bilinear(ins, attrs):
 def _resize_nearest(ins, attrs):
     x = ins[0]
     h, w = attrs["size"]
+    if attrs.get("coordinate_mode") == "asymmetric":
+        # ONNX/torch nearest export convention (asymmetric + floor):
+        # src index = floor(dst * in/out)
+        iy = jnp.floor(jnp.arange(h) * (x.shape[1] / h)).astype(
+            jnp.int32)
+        ix = jnp.floor(jnp.arange(w) * (x.shape[2] / w)).astype(
+            jnp.int32)
+        return x[:, iy][:, :, ix]
     return jax.image.resize(x, (x.shape[0], h, w, x.shape[3]), "nearest")
+
+
+def _cubic_weights(out_size: int, in_size: int, a: float,
+                   boundary: str):
+    """[out, in] separable Keys-cubic interpolation weights with
+    half-pixel centers. Two exporter conventions, probed empirically
+    against the frameworks (see test_tf_import TestResizeVariants):
+    TF ResizeBicubic = a=-0.5 with out-of-range taps DROPPED and the
+    row renormalized ("renorm"); torch/ONNX = a=-0.75 with indices
+    clamped to the edge ("clamp")."""
+    s = in_size / out_size
+    src = (np.arange(out_size) + 0.5) * s - 0.5
+    base = np.floor(src).astype(np.int64)
+    frac = src - base
+    w = np.zeros((out_size, in_size), np.float64)
+    for o in (-1, 0, 1, 2):
+        t = np.abs(frac - o)
+        k = np.where(
+            t <= 1, (a + 2) * t**3 - (a + 3) * t**2 + 1,
+            np.where(t < 2, a * (t**3 - 5 * t**2 + 8 * t - 4), 0.0))
+        idx = base + o
+        if boundary == "renorm":
+            k = np.where((idx < 0) | (idx >= in_size), 0.0, k)
+        idx = np.clip(idx, 0, in_size - 1)
+        np.add.at(w, (np.arange(out_size), idx), k)
+    if boundary == "renorm":
+        w /= w.sum(axis=1, keepdims=True)
+    return jnp.asarray(w, jnp.float32)
+
+
+@op("resize_bicubic", "image")
+def _resize_bicubic(ins, attrs):
+    x = ins[0]
+    h, w = attrs["size"]
+    a = float(attrs.get("cubic_coeff_a", -0.5))
+    boundary = attrs.get("boundary", "renorm")
+    wh = _cubic_weights(h, x.shape[1], a, boundary)
+    ww = _cubic_weights(w, x.shape[2], a, boundary)
+    y = jnp.einsum("oh,bhwc->bowc", wh, x.astype(jnp.float32))
+    y = jnp.einsum("ow,bhwc->bhoc", ww, y)
+    return y.astype(x.dtype) if jnp.issubdtype(x.dtype, jnp.floating) \
+        else y
+
+
+def _area_weights(out_size: int, in_size: int):
+    """[out, in] row-stochastic overlap weights for area resize: output
+    cell i integrates input cells overlapping [i*s, (i+1)*s), s=in/out,
+    weighted by overlap fraction (the TF ResizeArea algorithm)."""
+    s = in_size / out_size
+    i = np.arange(out_size)[:, None]
+    j = np.arange(in_size)[None, :]
+    overlap = np.minimum((i + 1) * s, j + 1) - np.maximum(i * s, j)
+    w = np.clip(overlap, 0.0, 1.0) / s
+    return jnp.asarray(w, jnp.float32)
+
+
+@op("resize_area", "image")
+def _resize_area(ins, attrs):
+    x = ins[0]
+    h, w = attrs["size"]
+    wh = _area_weights(h, x.shape[1])
+    ww = _area_weights(w, x.shape[2])
+    y = jnp.einsum("oh,bhwc->bowc", wh, x.astype(jnp.float32))
+    y = jnp.einsum("ow,bhwc->bhoc", ww, y)
+    return y.astype(x.dtype) if jnp.issubdtype(x.dtype, jnp.floating) \
+        else y
 
 
 @op("crop_and_resize", "image")

@@ -1135,9 +1135,21 @@ class SameDiff:
             n += 1
             if not evals:
                 continue     # loss-only validation: batches need not
-            labels = batch.labels            # carry .labels at all
-            labels = (labels if isinstance(labels, (list, tuple))
-                      else [labels])
+            # labels come from the label-mapped placeholders when the
+            # mapping names them (covers placeholders_fn dict batches),
+            # else from the DataSet protocol       carry .labels at all
+            if cfg.data_set_label_mapping and all(
+                    n in ph for n in cfg.data_set_label_mapping):
+                labels = [ph[n] for n in cfg.data_set_label_mapping]
+            else:
+                labels = getattr(batch, "labels", None)
+                if labels is None:
+                    raise ValueError(
+                        "validation evaluation needs labels: map them "
+                        "via data_set_label_mapping or provide "
+                        "batches with a .labels attribute")
+                labels = (labels if isinstance(labels, (list, tuple))
+                          else [labels])
             for name, (e, li) in evals.items():
                 e.eval(np.asarray(labels[li]), np.asarray(out[name]))
         val_loss = float(np.mean(losses)) if n else float("nan")

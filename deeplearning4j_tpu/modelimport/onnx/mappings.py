@@ -320,6 +320,92 @@ for _n in _REDUCE:
     ONNX_OP_MAP[_n] = _reduce
 
 
+@onnx_op("Resize", "Upsample")
+def _resize(ctx, node):
+    """torch F.interpolate / nn.Upsample export target. 4-D NCHW only
+    (the shape every mainstream exporter emits); modes nearest /
+    linear / cubic map onto the registry's NHWC resize ops. The
+    supported coordinate conventions are exactly what torch emits —
+    half_pixel/pytorch_half_pixel for linear/cubic, asymmetric+floor
+    for nearest — and every other combination raises loudly rather
+    than silently computing the wrong convention."""
+    mode = node.attr("mode", b"nearest")
+    if isinstance(mode, bytes):
+        mode = mode.decode()
+    # Resize-10 (inputs X, scales) and opset-9 Upsample predate the
+    # coordinate_transformation_mode attr; their spec semantics are
+    # "asymmetric". Resize-11+ always carries roi at input 1.
+    legacy = node.op == "Upsample" or len(node.inputs) == 2
+    ct = node.attr("coordinate_transformation_mode",
+                   b"asymmetric" if legacy else b"half_pixel")
+    if isinstance(ct, bytes):
+        ct = ct.decode()
+    in_shape = ctx.shape_of(node.inputs[0])
+    if in_shape is None or len(in_shape) != 4:
+        raise NotImplementedError(
+            "Resize needs a static 4-D NCHW input shape")
+    size = None
+    if len(node.inputs) >= 4 and node.inputs[3]:
+        sizes = [int(s) for s in ctx.require_static(node, 3)]
+        if sizes[:2] != [int(in_shape[0]), int(in_shape[1])]:
+            raise NotImplementedError(
+                f"Resize of batch/channel dims ({sizes[:2]} vs input "
+                f"{tuple(in_shape[:2])}) unsupported")
+        size = sizes[2:]
+    else:
+        si = 2 if len(node.inputs) >= 3 and node.inputs[2] else 1
+        scales = np.asarray(ctx.require_static(node, si),
+                            np.float64).reshape(-1)
+        if scales.size != 4 or scales[0] != 1 or scales[1] != 1:
+            raise NotImplementedError(
+                f"Resize with batch/channel scaling {scales}")
+        size = [int(np.floor(in_shape[2] * scales[2])),
+                int(np.floor(in_shape[3] * scales[3]))]
+    op_for = {"nearest": "resize_nearest", "linear": "resize_bilinear",
+              "cubic": "resize_bicubic"}
+    if mode not in op_for:
+        raise NotImplementedError(f"Resize mode {mode!r}")
+    attrs = {"size": tuple(size)}
+    if mode == "nearest":
+        nm = node.attr("nearest_mode", b"round_prefer_floor")
+        if isinstance(nm, bytes):
+            nm = nm.decode()
+        # torch exports asymmetric+floor; legacy Upsample/Resize-10
+        # are asymmetric by spec (nearest_mode attr didn't exist —
+        # floor is their defined behavior)
+        if ct == "asymmetric" and (nm == "floor" or legacy):
+            attrs["coordinate_mode"] = "asymmetric"
+        else:
+            raise NotImplementedError(
+                f"Resize nearest with coordinate mode {ct!r} + "
+                f"nearest_mode {nm!r} unsupported (torch exports "
+                f"asymmetric+floor)")
+    else:
+        # only the half-pixel family matches the registry lowering
+        # (asymmetric linear/cubic differ even at integer factors)
+        if ct not in ("half_pixel", "pytorch_half_pixel"):
+            if ct == "align_corners":
+                raise NotImplementedError(
+                    "Resize coordinate_transformation_mode="
+                    "align_corners unsupported (export with "
+                    "align_corners=False)")
+            raise NotImplementedError(
+                f"Resize {mode} with coordinate mode {ct!r} "
+                f"unsupported (half_pixel family only)")
+        if ct == "pytorch_half_pixel" and (size[0] <= 1 or
+                                           size[1] <= 1):
+            raise NotImplementedError(
+                "pytorch_half_pixel with an output dim of 1 diverges "
+                "from half_pixel")
+        if mode == "cubic":
+            attrs["cubic_coeff_a"] = float(
+                node.attr("cubic_coeff_a", -0.75))
+            attrs["boundary"] = "clamp"  # the torch/ONNX convention
+    x = _nchw_to_nhwc(ctx, ctx.var(node.inputs[0]))
+    y = ctx.sd._op(op_for[mode], [x], attrs)
+    return _nhwc_to_nchw(ctx, y)
+
+
 # -- conv / pool / norm (NCHW -> NHWC) --------------------------------------
 def _nchw_to_nhwc(ctx, v):
     return ctx.sd._op("transpose", [v], {"axes": [0, 2, 3, 1]})

@@ -523,6 +523,29 @@ def _resize_nearest(ctx, node):
                       {"size": size})
 
 
+@tf_op("ResizeBicubic")
+def _resize_bicubic(ctx, node):
+    if node.attr("align_corners", False) or \
+            not node.attr("half_pixel_centers", False):
+        raise NotImplementedError(
+            "ResizeBicubic without half_pixel_centers unsupported "
+            "(TF2's tf.image.resize emits half-pixel centers; legacy "
+            "TF1 corner conventions are not lowered)")
+    size = _ints(ctx.require_static(node, 1))
+    return ctx.sd._op("resize_bicubic", [ctx.var(node.inputs[0])],
+                      {"size": size})
+
+
+@tf_op("ResizeArea")
+def _resize_area(ctx, node):
+    if node.attr("align_corners", False):
+        raise NotImplementedError("ResizeArea align_corners=True "
+                                  "unsupported")
+    size = _ints(ctx.require_static(node, 1))
+    return ctx.sd._op("resize_area", [ctx.var(node.inputs[0])],
+                      {"size": size})
+
+
 # -- random (rare in frozen inference graphs) -------------------------------
 @tf_op("RandomStandardNormal")
 def _random_normal(ctx, node):

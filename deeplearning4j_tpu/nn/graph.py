@@ -95,11 +95,15 @@ class ComputationGraph:
 
     # ------------------------------------------------------------------
     def _forward(self, params, states, inputs: Sequence, *,
-                 training: bool, rng, want_logits: bool, fmask=None):
+                 training: bool, rng, want_logits: bool, fmask=None,
+                 upto: Optional[str] = None):
         """Topo walk. inputs: list matching conf.network_inputs order.
         ``fmask`` is the per-timestep features mask (first input's), passed
         to mask-aware layers — multi-input graphs with per-input masks can
         attach masks via PreprocessorVertex if they diverge.
+        ``upto``: walk only the ancestor subgraph of this vertex
+        (inclusive) — the pretrain path, where downstream vertices must
+        not even be traced (their params are held out of the step).
         Returns ({vertex: activation} for outputs, new_states)."""
         conf = self.conf
         if conf.compute_dtype:
@@ -150,24 +154,33 @@ class ComputationGraph:
             acts, new_states = self._forward_segmented(run_vertex, rng,
                                                        inputs)
         else:
+            topo = self._topo
+            if upto is not None:
+                need = {upto}
+                for n in reversed(self._topo):
+                    if n in need:
+                        need.update(conf.vertices[n].inputs)
+                topo = [n for n in self._topo if n in need]
             acts = dict(zip(conf.network_inputs, inputs))
             new_states = {}
-            li = 0
-            for name in self._topo:
+            # fold_in by layer position IN THE FULL TOPO — same
+            # derivation as _forward_segmented, so neither toggling
+            # remat_segments nor an upto-restricted walk changes the
+            # dropout/weight-noise stream
+            layer_pos = {n: i for i, n in enumerate(
+                n for n in self._topo if conf.vertices[n].is_layer)}
+            for name in topo:
                 lrng = None
                 if rng is not None and conf.vertices[name].is_layer:
-                    # fold_in by layer position — same derivation as
-                    # _forward_segmented, so toggling remat_segments
-                    # does not change the dropout/weight-noise stream
-                    lrng = jax.random.fold_in(rng, li)
-                    li += 1
+                    lrng = jax.random.fold_in(rng, layer_pos[name])
                 h, ns = run_vertex(name, acts, lrng)
                 acts[name] = h
                 new_states[name] = ns
         if self.conf.compute_dtype:
             from deeplearning4j_tpu.common.dtypes import cast_floats
             for out in self.conf.network_outputs:
-                acts[out] = cast_floats(acts[out], self._dtype)
+                if out in acts:          # absent under a partial walk
+                    acts[out] = cast_floats(acts[out], self._dtype)
             new_states = cast_floats(new_states, self._dtype)
         return acts, new_states
 
@@ -367,6 +380,83 @@ class ComputationGraph:
             self.epoch_count += 1
             for lis in self.listeners:
                 lis.on_epoch_end(self)
+        return self
+
+    def pretrain(self, data, *, n_epochs: int = 1):
+        """Greedy layerwise unsupervised pretraining (reference:
+        ComputationGraph.pretrain(DataSetIterator) — SURVEY.md D3):
+        every pretrainable vertex (AutoEncoder/VAE) is fit in topo
+        order on the activations of the subgraph feeding it, with the
+        rest of the graph held fixed."""
+        from deeplearning4j_tpu.nn.pretrain_util import materialize_once
+        data = materialize_once(data)
+        for name in self._topo:
+            v = self.conf.vertices[name]
+            if v.is_layer and getattr(v.content, "is_pretrainable",
+                                      lambda: False)():
+                self.pretrain_vertex(name, data, n_epochs=n_epochs)
+        return self
+
+    def pretrain_vertex(self, name: str, data, *, n_epochs: int = 1):
+        """Fit one pretrainable vertex (reference:
+        ComputationGraph.pretrainLayer(String, iter)). The vertex's
+        ``pretrain_loss`` + its updater compile into ONE jitted step;
+        upstream vertices run in inference mode, and XLA dead-code
+        eliminates everything downstream of the vertex's input (the
+        walk is traced whole, only ``acts[src]`` is consumed)."""
+        if not self._initialized:
+            self.init()
+        v = self.conf.vertices[name]
+        layer = v.content if v.is_layer else None
+        if layer is None or not getattr(layer, "is_pretrainable",
+                                        lambda: False)():
+            raise ValueError(f"vertex {name!r} is not pretrainable")
+        up = layer.updater or self.conf.updater
+        upd_state = self.updater_states[name]
+
+        if not hasattr(self, "_pretrain_steps"):
+            self._pretrain_steps = {}
+        if name not in self._pretrain_steps:
+            src = v.inputs[0]
+
+            def step(lp, frozen_params, states, us, inputs, iteration,
+                     rng):
+                acts, _ = self._forward(frozen_params, states, inputs,
+                                        training=False, rng=None,
+                                        want_logits=False, upto=src)
+                h = acts[src]
+                if v.preprocessor is not None:
+                    h = v.preprocessor.pre_process(h)
+                loss, g = jax.value_and_grad(layer.pretrain_loss)(
+                    lp, h, rng)
+                updates, new_us = up.apply(g, us, iteration)
+                new_lp = jax.tree_util.tree_map(
+                    lambda p, u: p - u, lp, updates)
+                new_lp = apply_constraints(layer, new_lp)
+                return new_lp, new_us, loss
+
+            self._pretrain_steps[name] = jax.jit(step,
+                                                 donate_argnums=(0, 3))
+        jit_step = self._pretrain_steps[name]
+
+        from deeplearning4j_tpu.nn.pretrain_util import (
+            feature_batches, materialize_once)
+        data = materialize_once(data)
+
+        for _ in range(n_epochs):
+            for inputs in feature_batches(data, as_list=True):
+                inputs = [_as_jnp(x, self._dtype) for x in inputs]
+                rng = self._next_rng()
+                states_in = self._with_zero_rnn_states(
+                    self.states, int(inputs[0].shape[0]))
+                frozen = {k: p for k, p in self.params.items()
+                          if k != name}
+                self.params[name], upd_state, loss = jit_step(
+                    self.params[name], frozen, states_in, upd_state,
+                    inputs, jnp.asarray(self.iteration_count), rng)
+                self._score = loss
+                self.iteration_count += 1
+        self.updater_states[name] = upd_state
         return self
 
     def _next_rng(self):

@@ -387,9 +387,8 @@ class MultiLayerNetwork:
         MultiLayerNetwork.pretrain(DataSetIterator) — fits every
         pretrainable layer (AutoEncoder/VAE) in stack order on the
         activations of the layers below it)."""
-        if not (hasattr(data, "features") or hasattr(data, "reset") or
-                hasattr(data, "shape") or isinstance(data, (list, tuple))):
-            data = list(data)   # one-shot iterable: keep for every layer
+        from deeplearning4j_tpu.nn.pretrain_util import materialize_once
+        data = materialize_once(data)
         for i, layer in enumerate(self.conf.layers):
             if getattr(layer, "is_pretrainable", lambda: False)():
                 self.pretrain_layer(i, data, n_epochs=n_epochs)
@@ -437,27 +436,12 @@ class MultiLayerNetwork:
         below = {f"layer_{j}": self.params[f"layer_{j}"]
                  for j in range(idx)}
 
-        from deeplearning4j_tpu.ndarray.ndarray import INDArray
-        if not (hasattr(data, "features") or hasattr(data, "reset") or
-                isinstance(data, (np.ndarray, jnp.ndarray, INDArray,
-                                  list, tuple))):
-            # non-resettable iterable (e.g. a generator): materialize
-            # once so every epoch/layer sees the full data
-            data = list(data)
-
-        def batches(d):
-            if hasattr(d, "features"):          # DataSet
-                yield d.features
-            elif isinstance(d, (np.ndarray, jnp.ndarray, INDArray)):
-                yield d
-            else:                               # iterator protocol / list
-                if hasattr(d, "reset"):
-                    d.reset()
-                for ds in d:
-                    yield ds.features if hasattr(ds, "features") else ds
+        from deeplearning4j_tpu.nn.pretrain_util import (
+            feature_batches, materialize_once)
+        data = materialize_once(data)
 
         for _ in range(n_epochs):
-            for x in batches(data):
+            for x in feature_batches(data):
                 x = _as_jnp(x, self._dtype)
                 self._rng, rng = jax.random.split(self._rng)
                 states_in = self._with_zero_rnn_states(self.states,

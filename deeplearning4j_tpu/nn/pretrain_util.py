@@ -1,0 +1,36 @@
+"""Shared data plumbing for layerwise pretraining — one place for the
+one-shot-iterable materialization and the features-only batch walk used
+by both MultiLayerNetwork.pretrain_layer and
+ComputationGraph.pretrain_vertex (they must not drift: reference
+``pretrain(DataSetIterator)`` accepts the same inputs on both)."""
+from __future__ import annotations
+
+
+def materialize_once(data):
+    """Listify a non-resettable iterable (e.g. a generator) so every
+    layer/epoch of a greedy pretrain sees the full data; pass through
+    DataSets, arrays, lists, and resettable iterators unchanged."""
+    if not (hasattr(data, "features") or hasattr(data, "reset") or
+            hasattr(data, "shape") or isinstance(data, (list, tuple))):
+        return list(data)
+    return data
+
+
+def feature_batches(data, as_list: bool = False):
+    """Yield feature batches from a DataSet / bare array / iterator /
+    list. ``as_list=True`` wraps singles in a list (the
+    ComputationGraph multi-input convention)."""
+    def wrap(f):
+        if as_list:
+            return f if isinstance(f, list) else [f]
+        return f
+
+    if hasattr(data, "features"):               # DataSet
+        yield wrap(data.features)
+    elif hasattr(data, "shape"):                # bare array
+        yield wrap(data)
+    else:                                       # iterator / list
+        if hasattr(data, "reset"):
+            data.reset()
+        for ds in data:
+            yield wrap(ds.features if hasattr(ds, "features") else ds)

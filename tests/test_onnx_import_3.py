@@ -214,3 +214,95 @@ class TestLayerNormAxes:
                             torch.tensor(bias)).numpy()
         np.testing.assert_allclose(np.asarray(got), want, rtol=1e-4,
                                    atol=1e-5)
+
+
+class TestResize:
+    """Resize/Upsample (torch F.interpolate export target — r4 verdict
+    Missing #4's importer half; there was NO Resize mapping at all)."""
+
+    def _resize_case(self, x, want, scales=None, sizes=None, **attrs):
+        inputs = ["x", ""]               # roi always empty
+        inits = {}
+        if scales is not None:
+            inputs = ["x", "", "scales"]
+            inits["scales"] = np.asarray(scales, np.float32)
+        if sizes is not None:
+            inputs = ["x", "", "", "sizes"]
+            inits["sizes"] = np.asarray(sizes, np.int64)
+        nodes = [encode_node("Resize", inputs, ["y"], "rs", **attrs)]
+        got = _run(nodes, inits, [("x", x.shape)],
+                   [("y", tuple(want.shape))], {"x": x})[0]
+        np.testing.assert_allclose(np.asarray(got), want, rtol=1e-4,
+                                   atol=2e-4)
+
+    def test_nearest_upsample_matches_torch(self):
+        x = R.randn(2, 3, 4, 5).astype(np.float32)
+        want = F.interpolate(torch.tensor(x), scale_factor=2,
+                             mode="nearest").numpy()
+        self._resize_case(
+            x, want, scales=[1, 1, 2, 2], mode=b"nearest",
+            coordinate_transformation_mode=b"asymmetric",
+            nearest_mode=b"floor")
+
+    def test_nearest_fractional_matches_torch(self):
+        x = R.randn(1, 2, 5, 7).astype(np.float32)
+        want = F.interpolate(torch.tensor(x), size=(8, 11),
+                             mode="nearest").numpy()
+        self._resize_case(
+            x, want, sizes=[1, 2, 8, 11], mode=b"nearest",
+            coordinate_transformation_mode=b"asymmetric",
+            nearest_mode=b"floor")
+
+    def test_bilinear_matches_torch(self):
+        x = R.randn(2, 3, 5, 6).astype(np.float32)
+        want = F.interpolate(torch.tensor(x), size=(9, 11),
+                             mode="bilinear",
+                             align_corners=False).numpy()
+        self._resize_case(
+            x, want, sizes=[2, 3, 9, 11], mode=b"linear",
+            coordinate_transformation_mode=b"half_pixel")
+
+    def test_bicubic_matches_torch(self):
+        x = R.randn(1, 2, 6, 7).astype(np.float32)
+        want = F.interpolate(torch.tensor(x), size=(11, 13),
+                             mode="bicubic",
+                             align_corners=False).numpy()
+        self._resize_case(
+            x, want, sizes=[1, 2, 11, 13], mode=b"cubic",
+            coordinate_transformation_mode=b"half_pixel",
+            cubic_coeff_a=-0.75)
+
+    def test_bicubic_downscale_matches_torch(self):
+        x = R.randn(1, 2, 9, 8).astype(np.float32)
+        want = F.interpolate(torch.tensor(x), size=(5, 6),
+                             mode="bicubic",
+                             align_corners=False).numpy()
+        self._resize_case(
+            x, want, sizes=[1, 2, 5, 6], mode=b"cubic",
+            coordinate_transformation_mode=b"half_pixel",
+            cubic_coeff_a=-0.75)
+
+    def test_align_corners_rejected(self):
+        x = R.randn(1, 1, 4, 4).astype(np.float32)
+        nodes = [encode_node(
+            "Resize", ["x", "", "scales"], ["y"], "rs", mode=b"linear",
+            coordinate_transformation_mode=b"align_corners")]
+        model = encode_model(
+            nodes, {"scales": np.asarray([1, 1, 2, 2], np.float32)},
+            [encode_value_info("x", x.shape)],
+            [encode_value_info("y", (1, 1, 8, 8))])
+        with pytest.raises(NotImplementedError):
+            import_onnx(model).output({"x": x})
+
+    def test_legacy_upsample_matches_torch(self):
+        x = R.randn(1, 3, 4, 4).astype(np.float32)
+        want = F.interpolate(torch.tensor(x), scale_factor=2,
+                             mode="nearest").numpy()
+        nodes = [encode_node("Upsample", ["x", "scales"], ["y"], "up",
+                             mode=b"nearest")]
+        got = _run(nodes,
+                   {"scales": np.asarray([1, 1, 2, 2], np.float32)},
+                   [("x", x.shape)], [("y", tuple(want.shape))],
+                   {"x": x})[0]
+        np.testing.assert_allclose(np.asarray(got), want, rtol=1e-4,
+                                   atol=2e-4)

@@ -201,3 +201,17 @@ class TestImportedModelParity:
         back = SameDiff.load(str(saved[-1]))
         assert back.iteration_count == 6
         assert len(hist) == 2
+
+
+def test_validation_with_dict_batches_via_label_mapping():
+    """placeholders_fn-style dict batches validate too: labels come
+    from the label-mapped placeholder, not a .labels attribute
+    (code-review regression)."""
+    sd = _classifier_sd()
+    x, y = _data()
+    hist = sd.fit([{"x": x, "y": y}] * 2, n_epochs=2,
+                  placeholders_fn=lambda b: b,
+                  validation_iter=[{"x": x, "y": y}],
+                  validation_evaluations={"probs": Evaluation})
+    assert len(hist.evaluations("probs")) == 2
+    assert hist.evaluations("probs")[-1].accuracy() > 0.5

@@ -285,3 +285,41 @@ class TestBertImport:
         sd2 = SameDiff.load(path)
         got = sd2.output({"ids": ids, "seg": seg, "mask": mask}, [out])
         np.testing.assert_allclose(got[out], want[out], atol=1e-6)
+
+
+class TestResizeVariants:
+    """SURVEY Appendix A image-domain resize tail (r4 verdict Missing
+    #4): bicubic + area, TF ground truth."""
+
+    def test_resize_bicubic_matches_tf(self):
+        def f(x):
+            return tf.image.resize(x, [7, 9], method="bicubic")
+
+        x = np.random.RandomState(0).rand(2, 5, 6, 3).astype(
+            np.float32)
+        # 1e-3: TF renormalizes edge rows in f32; interior is exact
+        _import_and_compare(f, {"x": x}, atol=1e-3)
+
+    def test_resize_bicubic_upscale(self):
+        def f(x):
+            return tf.image.resize(x, [10, 12], method="bicubic")
+
+        x = np.random.RandomState(1).rand(1, 5, 6, 2).astype(
+            np.float32)
+        _import_and_compare(f, {"x": x}, atol=1e-4)
+
+    def test_resize_area_matches_tf(self):
+        def f(x):
+            return tf.image.resize(x, [3, 4], method="area")
+
+        x = np.random.RandomState(2).rand(2, 9, 8, 3).astype(
+            np.float32)
+        _import_and_compare(f, {"x": x}, atol=1e-4)
+
+    def test_resize_area_fractional(self):
+        def f(x):
+            return tf.image.resize(x, [4, 5], method="area")
+
+        x = np.random.RandomState(3).rand(1, 7, 9, 2).astype(
+            np.float32)
+        _import_and_compare(f, {"x": x}, atol=1e-4)
