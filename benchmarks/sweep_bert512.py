@@ -1,0 +1,75 @@
+"""BERT seq-512 MFU sweep: remat policy x batch under the fori-loop
+protocol (VERDICT r4 weak #1 / next-round #1) — the same grid the
+seq-128 leg got in round 4 (BENCH_notes_r04.md).
+
+Runs every (remat, batch) leg of bench_bert.py at seq 512 /
+max_predictions 76 in a FRESH subprocess (so an HBM OOM in one leg
+cannot poison the next, and each leg gets a clean compile cache),
+collects the JSON lines, and prints a markdown table.
+
+Usage:  python benchmarks/sweep_bert512.py [--steps 60] [--out FILE]
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+import time
+
+CONFIGS = [
+    # (remat, batch) — mirror of the r4 seq-128 grid, seq-512 sized.
+    # b256/none will likely OOM (29G-class activations); the sweep
+    # records that as a data point rather than crashing.
+    ("full", 32), ("full", 64), ("full", 128), ("full", 256),
+    ("dots", 32), ("dots", 64), ("dots", 128),
+    ("none", 32), ("none", 64), ("none", 128),
+]
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--steps", type=int, default=60)
+    ap.add_argument("--out", default="benchmarks/sweep_bert512_results.jsonl")
+    a = ap.parse_args()
+
+    here = os.path.dirname(os.path.abspath(__file__))
+    root = os.path.dirname(here)
+    results = []
+    with open(a.out, "a") as f:
+        for remat, batch in CONFIGS:
+            cmd = [sys.executable, os.path.join(here, "bench_bert.py"),
+                   "--seq", "512", "--max-predictions", "76",
+                   "--batch", str(batch), "--remat", remat,
+                   "--steps", str(a.steps)]
+            t0 = time.time()
+            p = subprocess.run(cmd, capture_output=True, text=True,
+                               cwd=root, timeout=1800)
+            wall = round(time.time() - t0, 1)
+            line = None
+            for ln in p.stdout.splitlines():
+                if ln.startswith("{"):
+                    line = json.loads(ln)
+            if line is None:
+                err = (p.stderr or "")[-400:]
+                oom = "Ran out of memory" in (p.stderr or "")
+                line = {"error": "oom" if oom else "fail", "detail": err}
+            line.update({"remat": remat, "batch": batch, "wall_s": wall})
+            results.append(line)
+            f.write(json.dumps(line) + "\n")
+            f.flush()
+            print(json.dumps(line), flush=True)
+
+    print("\n| remat | batch | tokens/s | % bf16 peak |")
+    print("|---|---|---|---|")
+    for r in results:
+        if "error" in r:
+            print(f"| {r['remat']} | {r['batch']} | {r['error']} | — |")
+        else:
+            print(f"| {r['remat']} | {r['batch']} | "
+                  f"{r['value']:,.0f} | {r.get('pct_bf16_peak', '—')} |")
+
+
+if __name__ == "__main__":
+    main()
