@@ -92,4 +92,12 @@ def main(batch=64, seq_len=64, hidden=512, vocab=80, steps=1500,
 
 
 if __name__ == "__main__":
-    main()
+    import argparse
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--batch", type=int, default=64)
+    ap.add_argument("--seq", type=int, default=64)
+    ap.add_argument("--steps", type=int, default=1500)
+    ap.add_argument("--trials", type=int, default=7)
+    a = ap.parse_args()
+    main(batch=a.batch, seq_len=a.seq, steps=a.steps,
+         n_trials=a.trials)

@@ -1,0 +1,101 @@
+"""char-RNN roofline probe (r4 verdict Weak #2: the 1.88M chars/s
+headline had no ceiling statement). Prints ONE JSON line with the
+XLA-measured per-step FLOPs/bytes of the 2x512 GravesLSTM train step,
+the analytic decomposition, and the implied compute/HBM/launch bounds
+to set the measured step time against.
+
+The analysis (BENCH_notes_r05.md carries the prose): a small-batch
+LSTM step is bound by re-reading the [512, 2048] recurrent weights
+from HBM every timestep of the scan — the arithmetic intensity of the
+[b, 512] @ [512, 2048] recurrent matmul at b=64 is far below the MXU
+ridge, which is exactly why the reference grew a CudnnLSTMHelper
+(SURVEY.md D9). The lever with headroom is batch (amortizes the
+weight read); seq length only adds more serial steps.
+"""
+from __future__ import annotations
+
+import json
+import os
+import sys
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+from benchmarks.cost_util import (V5E_BF16_PEAK_TFLOPS,  # noqa: E402
+                                  V5E_HBM_GBPS)
+
+
+def main(batch=64, seq_len=64, hidden=512, vocab=80):
+    from deeplearning4j_tpu.activations import Activation
+    from deeplearning4j_tpu.learning import Adam
+    from deeplearning4j_tpu.lossfunctions import LossFunction
+    from deeplearning4j_tpu.nn import (InputType, MultiLayerNetwork,
+                                       NeuralNetConfiguration)
+    from deeplearning4j_tpu.nn.conf.layers import RnnOutputLayer
+    from deeplearning4j_tpu.nn.conf.layers_recurrent import GravesLSTM
+
+    conf = (NeuralNetConfiguration.Builder()
+            .seed(12345).updater(Adam(5e-3))
+            .compute_data_type("bfloat16")
+            .list()
+            .layer(GravesLSTM(n_out=hidden,
+                              activation=Activation.TANH))
+            .layer(GravesLSTM(n_out=hidden,
+                              activation=Activation.TANH))
+            .layer(RnnOutputLayer(n_out=vocab,
+                                  loss_function=LossFunction.MCXENT,
+                                  activation=Activation.SOFTMAX))
+            .set_input_type(InputType.recurrent(vocab, seq_len))
+            .build())
+    net = MultiLayerNetwork(conf).init()
+    rng = np.random.RandomState(0)
+    eye = np.eye(vocab, dtype=np.float32)
+    ids = rng.randint(0, vocab, (batch, seq_len + 1))
+    x = jnp.asarray(eye[ids[:, :-1]])
+    y = jnp.asarray(eye[ids[:, 1:]])
+    net.fit(type("DS", (), {"features": x, "labels": y,
+                            "features_mask": None,
+                            "labels_mask": None})())
+
+    ca = net._train_step.lower(
+        net.params, net.states, net.updater_states, x, y, None, None,
+        jnp.asarray(0), jax.random.PRNGKey(0)).compile().cost_analysis()
+    if isinstance(ca, list):
+        ca = ca[0]
+    flops = float(ca.get("flops", 0.0))
+    bytes_accessed = float(ca.get("bytes accessed", 0.0))
+
+    # analytic matmul decomposition (multiply-add = 2 FLOPs)
+    H, V, B, T = hidden, vocab, batch, seq_len
+    in_proj1 = 2 * B * T * V * 4 * H
+    in_proj2 = 2 * B * T * H * 4 * H
+    recur = 2 * B * H * 4 * H * T          # per layer, T serial steps
+    head = 2 * B * T * H * V
+    fwd = in_proj1 + in_proj2 + 2 * recur + head
+    train_flops = 3 * fwd
+
+    # the HBM floor: recurrent weights re-read per timestep (bf16)
+    rw_bytes = 2 * (H * 4 * H) * 2          # two layers
+    rw_traffic_fwd = rw_bytes * T
+    rw_traffic_train = 3 * rw_traffic_fwd   # fwd + 2 bwd passes
+
+    out = {
+        "metric": "charrnn_step_roofline",
+        "config": f"GravesLSTM 2x{H}, b{B}, seq {T}, vocab {V}, bf16",
+        "xla_flops_per_step": flops,
+        "xla_bytes_per_step": bytes_accessed,
+        "analytic_matmul_flops_per_step": train_flops,
+        "rw_weight_retraffic_bytes_per_step": rw_traffic_train,
+        "compute_floor_us": round(
+            train_flops / (V5E_BF16_PEAK_TFLOPS * 1e12) * 1e6, 1),
+        "hbm_floor_us_xla_bytes": round(
+            bytes_accessed / (V5E_HBM_GBPS * 1e9) * 1e6, 1),
+        "serial_matmul_chain": 2 * T,
+    }
+    print(json.dumps(out))
+
+
+if __name__ == "__main__":
+    main()

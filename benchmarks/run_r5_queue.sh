@@ -1,0 +1,16 @@
+#!/bin/bash
+# r5 chip-benchmark queue: waits for the imported sweep, then runs
+# each leg sequentially (one chip, no contention)
+while pgrep -f "bench_bert_imported" > /dev/null; do sleep 20; done
+cd /root/repo
+echo "=== real-decode ETL ($(date)) ==="
+python benchmarks/bench_pipeline.py --real-decode --threads 16 2>/dev/null | grep "^{"
+echo "=== charrnn roofline probe ($(date)) ==="
+python benchmarks/profile_charrnn.py 2>/dev/null | grep "^{"
+echo "=== charrnn batch sweep ($(date)) ==="
+for b in 64 128 256 512; do
+  python benchmarks/bench_charrnn.py --batch $b --steps 1500 --trials 5 2>/dev/null | grep "^{" | sed "s/^/b=$b /"
+done
+echo "=== inference serving ($(date)) ==="
+python benchmarks/bench_inference.py 2>/dev/null | grep "^{"
+echo "=== queue done ($(date)) ==="

@@ -11,7 +11,11 @@ the sharded program; `SEQUENTIAL` mode is a plain single call.
 """
 from __future__ import annotations
 
+import concurrent.futures
 import logging
+import queue as _queue
+import threading
+import time
 from typing import List, Optional
 
 import jax
@@ -27,7 +31,12 @@ log = logging.getLogger("deeplearning4j_tpu")
 
 
 class InferenceMode:
+    #: run each request directly on the shared jitted forward — no
+    #: queue, lowest latency (reference: InferenceMode.INPLACE)
+    INPLACE = "INPLACE"
     SEQUENTIAL = "SEQUENTIAL"
+    #: aggregate requests into up-to-batch_limit batches (reference:
+    #: InferenceMode.BATCHED via the observable queue)
     BATCHED = "BATCHED"
 
 
@@ -35,14 +44,22 @@ class ParallelInference:
     def __init__(self, model, mesh=None, *,
                  inference_mode: str = InferenceMode.BATCHED,
                  batch_limit: int = 32,
-                 queue_limit: int = 64):
+                 queue_limit: int = 64,
+                 batch_window_ms: float = 2.0):
         self.model = model
         self.mesh = mesh if mesh is not None else make_mesh()
         self.inference_mode = inference_mode
         self.batch_limit = batch_limit
         self.queue_limit = queue_limit
+        #: how long the batching worker waits for more requests once
+        #: it holds at least one (the latency/throughput knob)
+        self.batch_window_ms = batch_window_ms
         self._fwd = None
         self._placed = False
+        self._worker = None
+        self._requests = None
+        self._shutdown = False
+        self._lock = threading.Lock()
 
     class Builder:
         def __init__(self, model):
@@ -52,6 +69,7 @@ class ParallelInference:
             self._batch_limit = 32
             self._queue_limit = 64
             self._workers = None
+            self._batch_window_ms = 2.0
 
         def inference_mode(self, mode: str):
             self._mode = mode
@@ -69,6 +87,10 @@ class ParallelInference:
             self._workers = n
             return self
 
+        def batch_window_ms(self, ms: float):
+            self._batch_window_ms = float(ms)
+            return self
+
         def build(self) -> "ParallelInference":
             mesh = self._mesh
             if mesh is None:
@@ -79,7 +101,9 @@ class ParallelInference:
             return ParallelInference(self._model, mesh,
                                      inference_mode=self._mode,
                                      batch_limit=self._batch_limit,
-                                     queue_limit=self._queue_limit)
+                                     queue_limit=self._queue_limit,
+                                     batch_window_ms=
+                                     self._batch_window_ms)
 
     # ------------------------------------------------------------------
     @property
@@ -140,3 +164,90 @@ class ParallelInference:
             result.append(flat[off:off + s])
             off += s
         return result
+
+    # -- async observable serving (reference: ParallelInference's
+    # request queue + worker batching; output(Observable) round) -------
+    def submit(self, x) -> "concurrent.futures.Future":
+        """Enqueue one request; returns a Future resolving to its
+        result. In BATCHED mode a background worker drains the queue,
+        aggregates up to ``batch_limit`` requests (or whatever is
+        waiting after ``batch_window_ms``) into ONE forward, and
+        distributes the slices — the reference's observable BATCHED
+        serving loop. INPLACE/SEQUENTIAL run the request directly
+        (no queue, no cross-request aggregation)."""
+        fut: concurrent.futures.Future = concurrent.futures.Future()
+        if self.inference_mode != InferenceMode.BATCHED:
+            if fut.set_running_or_notify_cancel():
+                try:
+                    fut.set_result(self.output(x))
+                except BaseException as e:       # noqa: BLE001
+                    fut.set_exception(e)
+            return fut
+        with self._lock:
+            self._ensure_worker()
+            q = self._requests
+        q.put((x, fut))
+        return fut
+
+    def _ensure_worker(self):
+        """Start the batching worker (caller holds ``self._lock``)."""
+        if self._worker is not None:
+            return
+        self._requests = _queue.Queue(self.queue_limit)
+        self._shutdown = False
+        q = self._requests                       # bind THIS queue
+
+        def loop():
+            while True:
+                try:
+                    first = q.get(timeout=0.1)
+                except _queue.Empty:
+                    if self._shutdown:
+                        return
+                    continue
+                if first is None:
+                    return
+                batch = [first]
+                deadline = time.monotonic() + self.batch_window_ms / 1e3
+                while len(batch) < self.batch_limit:
+                    left = deadline - time.monotonic()
+                    try:
+                        nxt = q.get(timeout=max(left, 0) or 0.0001)
+                    except _queue.Empty:
+                        break
+                    if nxt is None:
+                        self._flush(batch)
+                        return
+                    batch.append(nxt)
+                self._flush(batch)
+
+        self._worker = threading.Thread(target=loop, daemon=True,
+                                        name="dl4j-tpu-serving")
+        self._worker.start()
+
+    def _flush(self, batch):
+        # a caller may have cancelled its future while queued (client
+        # timeout) — skip those; one cancelled request must not kill
+        # the worker or starve its batch-mates
+        live = [(x, f) for x, f in batch
+                if f.set_running_or_notify_cancel()]
+        if not live:
+            return
+        try:
+            outs = self.output_batched([x for x, _ in live])
+        except BaseException as e:           # noqa: BLE001
+            for _, f in live:
+                f.set_exception(e)
+            return
+        for (_, f), o in zip(live, outs):
+            f.set_result(o)
+
+    def shutdown(self):
+        """Stop the batching worker (pending requests are flushed)."""
+        with self._lock:
+            worker, self._worker = self._worker, None
+            if worker is None:
+                return
+            self._shutdown = True
+            self._requests.put(None)
+        worker.join()

@@ -146,3 +146,58 @@ def test_adaptive_threshold_moves_tau():
                                    min_target=1e-4, max_target=1e-2)
     assert a.next_tau(1e-3, 0.5) > 1e-3       # too dense -> raise tau
     assert a.next_tau(1e-3, 1e-6) < 1e-3      # too sparse -> lower tau
+
+
+def test_parallel_inference_async_submit_batches_and_matches():
+    """The async observable path (reference: ParallelInference's
+    request queue + worker batching): concurrent submits resolve to
+    exactly the per-request results of a direct forward, and the
+    worker aggregated them into shared batches."""
+    from deeplearning4j_tpu.parallel.inference import InferenceMode
+    net = _mlp()
+    rng = np.random.RandomState(2)
+    reqs = [rng.randn(1, 8).astype(np.float32) for _ in range(24)]
+    pi = ParallelInference.Builder(net).batch_limit(8) \
+        .batch_window_ms(20.0).build()   # window long enough to fill
+    flushes = []
+    orig_flush = pi._flush
+    pi._flush = lambda batch: (flushes.append(len(batch)),
+                               orig_flush(batch))[-1]
+    futs = [pi.submit(r) for r in reqs]
+    outs = [f.result(timeout=60) for f in futs]
+    pi.shutdown()
+    for r, o in zip(reqs, outs):
+        np.testing.assert_allclose(o, np.asarray(net.output(r)),
+                                   rtol=1e-5, atol=1e-6)
+    # the worker actually AGGREGATED: far fewer flushes than requests
+    assert sum(flushes) == len(reqs)
+    assert len(flushes) < len(reqs), flushes
+
+    # INPLACE bypasses the queue: no worker thread is ever created
+    pi2 = (ParallelInference.Builder(net)
+           .inference_mode(InferenceMode.INPLACE).build())
+    out = pi2.submit(reqs[0]).result(timeout=5)
+    np.testing.assert_allclose(out, np.asarray(net.output(reqs[0])),
+                               rtol=1e-5, atol=1e-6)
+    assert getattr(pi2, "_worker", None) is None
+
+
+def test_parallel_inference_cancelled_future_does_not_kill_worker():
+    """A client cancelling its queued request (timeout) must not kill
+    the batching worker or starve its batch-mates (code-review
+    regression: set_result on a cancelled Future raises)."""
+    net = _mlp()
+    rng = np.random.RandomState(3)
+    pi = ParallelInference.Builder(net).batch_limit(4) \
+        .batch_window_ms(50.0).build()
+    r = rng.randn(1, 8).astype(np.float32)
+    doomed = pi.submit(r)
+    assert doomed.cancel()               # still queued: cancellable
+    live = [pi.submit(rng.randn(1, 8).astype(np.float32))
+            for _ in range(6)]
+    outs = [f.result(timeout=60) for f in live]
+    assert all(o.shape == (1, 3) for o in outs)
+    # and the worker is still alive for later requests
+    again = pi.submit(r).result(timeout=60)
+    assert again.shape == (1, 3)
+    pi.shutdown()
