@@ -20,7 +20,8 @@ def _load(name):
 @pytest.mark.parametrize("name", ["lenet_mnist", "char_rnn",
                                   "transfer_learning", "data_parallel",
                                   "custom_layer_samediff",
-                                  "tf_frozen_import", "a3c_cartpole"])
+                                  "tf_frozen_import", "a3c_cartpole",
+                                  "serving_inference"])
 def test_importable(name):
     assert _load(name).main is not None
 
@@ -37,3 +38,7 @@ def test_custom_layer_example_runs():
 def test_data_parallel_example_runs():
     import numpy as np
     assert np.isfinite(_load("data_parallel").main())
+
+
+def test_serving_inference_example_runs():
+    _load("serving_inference").main()   # asserts exactness internally
