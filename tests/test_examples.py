@@ -41,4 +41,4 @@ def test_data_parallel_example_runs():
 
 
 def test_serving_inference_example_runs():
-    _load("serving_inference").main()   # asserts exactness internally
+    _load("serving_inference").main()   # asserts parity internally

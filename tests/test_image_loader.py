@@ -1,0 +1,61 @@
+"""ImageLoader decode/resize fidelity (SURVEY.md V3). The r5 ETL
+benchmark moved file decodes onto Pillow's C resize (GIL-released,
+3.5x faster than the numpy fallback per core); these tests pin the
+two paths to each other and the JPEG draft-mode fast path to the
+full-decode result."""
+import numpy as np
+import pytest
+
+PIL = pytest.importorskip("PIL")
+from PIL import Image  # noqa: E402
+
+from deeplearning4j_tpu.datavec.image import (  # noqa: E402
+    ImageLoader, _resize_bilinear)
+
+
+def _photo(size=256, seed=0):
+    rng = np.random.RandomState(seed)
+    y, x = np.mgrid[0:size, 0:size]
+    img = np.clip((y * 0.4 + x * 0.3)[:, :, None] % 256 +
+                  rng.randint(-30, 30, (size, size, 3)), 0,
+                  255).astype(np.uint8)
+    return img
+
+
+def test_file_decode_matches_array_path(tmp_path):
+    """PNG (lossless) file through the PIL resize vs the same pixels
+    through the numpy-array fallback path: the two bilinear resamplers
+    differ only by PIL's antialias taps — close, not identical."""
+    img = _photo()
+    p = str(tmp_path / "img.png")
+    Image.fromarray(img).save(p)
+    loader = ImageLoader(224, 224, 3)
+    from_file = loader.load(p)
+    from_array = loader.load(img)
+    assert from_file.shape == from_array.shape == (224, 224, 3)
+    assert np.mean(np.abs(from_file - from_array)) < 4.0
+    assert np.corrcoef(from_file.ravel(),
+                       from_array.ravel())[0, 1] > 0.99
+
+
+def test_jpeg_draft_downscale_close_to_full_decode(tmp_path):
+    """Big downscale (512 -> 64) engages JPEG draft mode (DCT-domain
+    scaling); the result must stay close to a full decode + resize."""
+    img = _photo(512, seed=1)
+    p = str(tmp_path / "img.jpg")
+    Image.fromarray(img).save(p, quality=95)
+    small = ImageLoader(64, 64, 3).load(p)
+    with Image.open(p) as im:        # full decode, then C resize
+        full = np.asarray(im.convert("RGB"))
+    ref = _resize_bilinear(full, 64, 64)
+    assert small.shape == (64, 64, 3)
+    assert np.mean(np.abs(small - ref)) < 6.0
+
+
+def test_grayscale_and_upscale(tmp_path):
+    img = _photo(32, seed=2)
+    p = str(tmp_path / "img.png")
+    Image.fromarray(img).save(p)
+    g = ImageLoader(48, 48, 1).load(p)
+    assert g.shape == (48, 48, 1)
+    assert g.dtype == np.float32
