@@ -70,6 +70,25 @@ def bench_resnet(on_tpu, n_lat=100):
     dt = time.perf_counter() - t0
     print(json.dumps({"metric": "resnet50_serving_throughput",
                       "value": round(trials * big_n / dt, 1),
+                      "unit": "images/sec/chip", "batch": big_n,
+                      "note": "wire-inclusive (host->device transfer "
+                              "per request; the axon tunnel on this "
+                              "rig)"}))
+
+    # device-resident leg: the CHIP's serving ceiling — input already
+    # on device, time the jitted forward alone (what a co-located
+    # host sees, plus ~0.1 ms dispatch)
+    import jax.numpy as jnp
+    xd = jax.device_put(jnp.asarray(big, jnp.bfloat16))
+    np.asarray(pi._fwd(net.params, net.states, xd))   # warm
+    t0 = time.perf_counter()
+    for _ in range(trials):
+        out = pi._fwd(net.params, net.states, xd)
+    np.asarray(out)                      # one final sync
+    dt = time.perf_counter() - t0
+    print(json.dumps({"metric":
+                      "resnet50_serving_throughput_device_resident",
+                      "value": round(trials * big_n / dt, 1),
                       "unit": "images/sec/chip", "batch": big_n}))
 
     reqs = [rng.randn(1, hw, hw, 3).astype(np.float32)
@@ -135,22 +154,32 @@ def bench_bert_imported(on_tpu, n_lat=50):
                                       updater=Adam(1e-4))
         return sd
 
-    sd = import_at(1)
     rng = np.random.RandomState(0)
 
     def feeds(b):
         return {"ids": rng.randint(0, vocab, (b, seq), dtype=np.int32),
                 "seg": np.zeros((b, seq), np.int32),
                 "mask": np.ones((b, seq), np.int32)}
-    out_var = "encoder_out" if sd.has_variable("encoder_out") else \
-        [n for n in sd.vars if "Identity" in n][0]
+    def cls_var(sd_model, b):
+        """Serve the CLS vector [b, H], not the full [b, T, H] hidden
+        states — a realistic serving head; the full tensor would ship
+        ~50 MB back across the wire per request and measure only the
+        link."""
+        out_var = ("encoder_out"
+                   if sd_model.has_variable("encoder_out") else
+                   [n for n in sd_model.vars if "Identity" in n][0])
+        v = sd_model._op("slice", [sd_model.get_variable(out_var)],
+                         {"begin": [0, 0, 0], "size": [b, 1, hidden]})
+        return v.name
 
+    sd = import_at(1)
+    cv = cls_var(sd, 1)
     one = feeds(1)
-    sd.output(one, [out_var])            # compile b=1
+    sd.output(one, [cv])                 # compile b=1
     times = []
     for _ in range(n_lat if on_tpu else 5):
         t0 = time.perf_counter()
-        np.asarray(sd.output(one, [out_var])[out_var])
+        np.asarray(sd.output(one, [cv])[cv])
         times.append(time.perf_counter() - t0)
     print(json.dumps({"metric": "bert_imported_serving_latency_b1",
                       "seq": seq, "unit": "ms",
@@ -158,17 +187,19 @@ def bench_bert_imported(on_tpu, n_lat=50):
 
     b = 128 if on_tpu else 4
     sd = import_at(b)
+    cv = cls_var(sd, b)
     big = feeds(b)
-    sd.output(big, [out_var])            # compile big batch
+    sd.output(big, [cv])                 # compile big batch
     trials = 5 if on_tpu else 2
     t0 = time.perf_counter()
     for _ in range(trials):
-        np.asarray(sd.output(big, [out_var])[out_var])
+        np.asarray(sd.output(big, [cv])[cv])
     dt = time.perf_counter() - t0
     print(json.dumps({
         "metric": "bert_imported_serving_throughput",
         "value": round(trials * b * seq / dt, 1),
-        "unit": "tokens/sec/chip", "batch": b, "seq": seq}))
+        "unit": "tokens/sec/chip", "batch": b, "seq": seq,
+        "served_output": "CLS vector [b, hidden]"}))
 
 
 def main():

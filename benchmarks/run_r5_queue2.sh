@@ -1,0 +1,7 @@
+#!/bin/bash
+cd /root/repo
+echo "=== inference serving v2 ($(date)) ==="
+python benchmarks/bench_inference.py 2>/dev/null | grep "^{"
+echo "=== real-decode ETL v2 (fast loader) ($(date)) ==="
+python benchmarks/bench_pipeline.py --real-decode --threads 4 2>/dev/null | grep "^{"
+echo "=== queue2 done ($(date)) ==="
