@@ -12,7 +12,10 @@ long-context/CP path (crossover ~2k tokens).
 
 Prints ONE JSON line: {"metric": "bert_mlm_train_throughput", ...}.
 CLI flags reproduce the published A/B legs:
-  --seq 512 --batch 64 --max-predictions 76      (seq-512 leg)
+  --seq 512 --batch 12 --max-predictions 76      (seq-512 leg — the
+      r5 sweep's winner: full remat + b12 = 140.6k tokens/s, 41.9%
+      bf16 peak; see BENCH_notes_r05.md for the remat x batch grid.
+      At seq 512 SMALL batches win — attention memory is O(b*t^2))
   --flash                                        (Pallas kernel leg)
 """
 from __future__ import annotations
