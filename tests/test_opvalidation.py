@@ -175,6 +175,11 @@ def test_coverage_accounting():
         validate(tc)
     rep = coverage_report()
     assert rep["covered"] >= 55, rep["covered"]
-    assert rep["fraction"] >= 0.27, (rep["fraction"],
+    # batch-1's own fraction: the denominator is the WHOLE registry,
+    # so this floor dips as the registry grows (r5: +resize_bicubic/
+    # resize_area -> 238 ops). The ratchet that must only move up is
+    # the COMBINED batches-1+2 floor (>=0.95,
+    # test_opvalidation_2.test_combined_coverage_floor)
+    assert rep["fraction"] >= 0.26, (rep["fraction"],
                                      rep["missing"][:20])
     assert "matmul" in validated_ops()
