@@ -382,8 +382,13 @@ class TestNestedParamTables:
             .import_keras_sequential_model_and_weights(path)
         assert not net.conf.layers[0].constrain_params
         x = np.random.RandomState(0).randn(2, 4).astype(np.float32)
+        import jax
+        with jax.default_matmul_precision("highest"):
+            # f32 matmuls for the TF-parity check (TPU default is
+            # bf16-accumulate — the algorithm-equivalence fixture)
+            got = net.output(x)
         np.testing.assert_allclose(
-            net.output(x), np.asarray(model(x)), atol=1e-4, rtol=1e-3)
+            got, np.asarray(model(x)), atol=1e-4, rtol=1e-3)
         with pytest.raises(InvalidKerasConfigurationException):
             KerasModelImport.import_keras_sequential_model_and_weights(
                 path, enforce_training_config=True)
