@@ -1091,8 +1091,12 @@ def _resize_bicubic(ins, attrs):
     boundary = attrs.get("boundary", "renorm")
     wh = _cubic_weights(h, x.shape[1], a, boundary)
     ww = _cubic_weights(w, x.shape[2], a, boundary)
-    y = jnp.einsum("oh,bhwc->bowc", wh, x.astype(jnp.float32))
-    y = jnp.einsum("ow,bhwc->bhoc", ww, y)
+    # HIGHEST: resize is preprocessing — exact f32 interpolation, not
+    # the TPU default bf16-accumulate (conformance vs TF/torch)
+    y = jnp.einsum("oh,bhwc->bowc", wh, x.astype(jnp.float32),
+                   precision=jax.lax.Precision.HIGHEST)
+    y = jnp.einsum("ow,bhwc->bhoc", ww, y,
+                   precision=jax.lax.Precision.HIGHEST)
     return y.astype(x.dtype) if jnp.issubdtype(x.dtype, jnp.floating) \
         else y
 
@@ -1115,8 +1119,10 @@ def _resize_area(ins, attrs):
     h, w = attrs["size"]
     wh = _area_weights(h, x.shape[1])
     ww = _area_weights(w, x.shape[2])
-    y = jnp.einsum("oh,bhwc->bowc", wh, x.astype(jnp.float32))
-    y = jnp.einsum("ow,bhwc->bhoc", ww, y)
+    y = jnp.einsum("oh,bhwc->bowc", wh, x.astype(jnp.float32),
+                   precision=jax.lax.Precision.HIGHEST)
+    y = jnp.einsum("ow,bhwc->bhoc", ww, y,
+                   precision=jax.lax.Precision.HIGHEST)
     return y.astype(x.dtype) if jnp.issubdtype(x.dtype, jnp.floating) \
         else y
 
