@@ -15,12 +15,15 @@ from __future__ import annotations
 import enum
 import io
 import json
+import logging
 import zipfile
 from typing import Dict, List, Optional, Sequence, Tuple, Union
 
 import jax
 import jax.numpy as jnp
 import numpy as np
+
+log = logging.getLogger("deeplearning4j_tpu")
 
 from deeplearning4j_tpu.autodiff.registry import get_op
 
@@ -577,6 +580,61 @@ class SameDiff:
 
         return fn, var_names
 
+    def _segment_cut_costs(self, op_indices: List[int],
+                           out_names: Tuple[str, ...],
+                           sizes: Optional[dict] = None):
+        """``cost[c]`` = BYTES of intermediate values live across a
+        cut placed before walk position ``c`` (produced earlier,
+        consumed at/after ``c`` or a requested output) — the storage
+        ``min_cut_segment_plan`` minimizes. ``sizes`` maps value name
+        -> bytes (from the abstract shape pass); a missing entry
+        counts 1, so with no size info this degrades to live-value
+        counting."""
+        n = len(op_indices)
+        first_prod = {}
+        last_read = {}
+        for j, i in enumerate(op_indices):
+            for name in self.ops[i].inputs:
+                last_read[name] = j
+            for name in self.ops[i].outputs:
+                first_prod.setdefault(name, j)
+        sizes = sizes or {}
+        diff = np.zeros(n + 2)
+        for name, j in first_prod.items():
+            k = n if name in out_names else last_read.get(name, j)
+            if k > j:
+                w = float(sizes.get(name, 1.0))
+                # crosses every cut c with j < c <= k
+                diff[j + 1] += w
+                diff[k + 1] -= w
+        return np.cumsum(diff)[:n + 1]
+
+    def _value_sizes(self, values: dict, op_indices: List[int], rng,
+                     training: bool) -> dict:
+        """Byte size of every intermediate value, via ONE abstract
+        (shape-only) pass over the walk — jax.eval_shape runs no
+        FLOPs and allocates nothing. Empty on failure (the cut costs
+        then fall back to live-value counts)."""
+        in_structs = {k: jax.ShapeDtypeStruct(v.shape, v.dtype)
+                      for k, v in values.items()
+                      if hasattr(v, "shape") and hasattr(v, "dtype")}
+
+        def walk(vals_in):
+            vals = dict(values)
+            vals.update(vals_in)
+            self._execute(vals, op_indices, rng, training)
+            return vals
+
+        try:
+            out = jax.eval_shape(walk, in_structs)
+            return {k: int(np.prod(v.shape)) * v.dtype.itemsize
+                    for k, v in out.items()
+                    if hasattr(v, "shape") and v.shape is not None}
+        except Exception as e:                    # noqa: BLE001
+            log.debug("abstract size pass failed (%s); min-cut falls "
+                      "back to live-value counts", e)
+            return {}
+
     def set_remat_segments(self, n: int):
         """Cut TRAINING forward programs into ``n`` ``jax.checkpoint``
         segments of the op walk (sqrt(N) activation checkpointing):
@@ -596,13 +654,19 @@ class SameDiff:
         """The op walk in ``remat_segments`` contiguous
         ``jax.checkpoint`` segments, with liveness analysis so only
         values consumed later (or requested outputs) cross segment
-        boundaries. The per-op RNG is ``fold_in(rng, op idx)``
-        (same as the plain walk), so segmentation does not change
-        the stream."""
-        from deeplearning4j_tpu.common.remat import segment_plan
+        boundaries. Boundaries are MIN-CUT placed (fewest live values
+        stored — on a flat imported transformer that finds the layer
+        boundaries, where only the hidden state crosses, instead of
+        cutting mid-attention where the O(t^2) scores are live). The
+        per-op RNG is ``fold_in(rng, op idx)`` (same as the plain
+        walk), so segmentation does not change the stream."""
+        from deeplearning4j_tpu.common.remat import min_cut_segment_plan
         read_at = [set(self.ops[i].inputs) for i in op_indices]
-        for lo, hi, wrap in segment_plan(len(op_indices),
-                                         self.remat_segments):
+        sizes = self._value_sizes(values, op_indices, rng, training)
+        plan = min_cut_segment_plan(
+            len(op_indices), self.remat_segments,
+            self._segment_cut_costs(op_indices, out_names, sizes))
+        for lo, hi, wrap in plan:
             seg = op_indices[lo:hi]
             produced = set()
             for i in seg:

@@ -328,3 +328,126 @@ class TestRngStreamInvariance:
                     np.asarray(a.params[k][w]),
                     np.asarray(b.params[k][w]),
                     rtol=1e-4, atol=1e-5, err_msg=f"{k}/{w}")
+
+
+class TestMinCutBoundaries:
+    """Boundary placement by liveness (r5): on a flat op walk the
+    cuts must land where the fewest values are live — the layer
+    boundaries of an imported transformer — not at fixed even
+    indices."""
+
+    def test_plan_prefers_low_cost_indices(self):
+        from deeplearning4j_tpu.common.remat import (
+            min_cut_segment_plan, segment_plan)
+        n = 100
+        cost = np.full(n + 1, 10.0)
+        # pinches at 23 and 71; even cuts for 3 segments are 33/66
+        cost[23] = 1.0
+        cost[71] = 1.0
+        plan = min_cut_segment_plan(n, 3, cost)
+        bounds = [lo for lo, _, _ in plan] + [plan[-1][1]]
+        assert bounds == [0, 23, 71, 100]
+        # flat cost degrades to the even plan
+        flat = min_cut_segment_plan(n, 3, np.zeros(n + 1))
+        assert flat == segment_plan(n, 3)
+        # boundaries stay strictly monotone even with one global min
+        one = np.full(n + 1, 5.0)
+        one[50] = 0.0
+        p2 = min_cut_segment_plan(n, 4, one)
+        bs = [lo for lo, _, _ in p2] + [n]
+        assert bs == sorted(set(bs)), bs
+
+    def test_samediff_cut_costs_find_the_pinch(self):
+        """A graph with a wide interior (many live values) and a
+        single-value pinch between blocks: the cut cost at the pinch
+        must be the minimum."""
+        from deeplearning4j_tpu.autodiff.samediff import SameDiff
+        sd = SameDiff.create()
+        x = sd.placeholder("x", shape=(4, 8))
+
+        def block(h):
+            a = sd.math.mul(h, h)
+            b = sd.math.sin(h)
+            c = sd.math.add(a, b)       # a and b live in parallel
+            return sd.math.tanh(c)      # pinch: only this crosses
+
+        h1 = block(x)
+        h2 = block(h1)
+        out = sd.math.reduce_sum(h2)
+        ops = list(range(len(sd.ops)))
+        costs = sd._segment_cut_costs(ops, (out.name,))
+        # the cut between the two blocks (before op 4) is a pinch
+        assert costs[4] == min(costs[1:len(sd.ops)])
+        assert costs[4] < costs[2]      # mid-block is wider
+
+    def test_segmented_training_still_matches_plain(self):
+        """Min-cut boundaries keep the math identical (the boundary
+        CHOICE is a schedule, not semantics)."""
+        import jax
+        from deeplearning4j_tpu.autodiff.samediff import SameDiff
+        from deeplearning4j_tpu.autodiff.training import TrainingConfig
+        from deeplearning4j_tpu.learning import Adam
+
+        def build(segments):
+            sd = SameDiff.create()
+            x = sd.placeholder("x", shape=(None, 6))
+            y = sd.placeholder("y", shape=(None, 1))
+            h = x
+            rng = np.random.RandomState(0)
+            for i in range(4):
+                w = sd.var(f"w{i}", array=rng.randn(
+                    6, 6).astype(np.float32) * 0.3)
+                h = sd.math.tanh(h @ w)
+            wo = sd.var("wo", array=rng.randn(6, 1)
+                        .astype(np.float32) * 0.3)
+            sd.loss.mean_squared_error(y, h @ wo, name="loss")
+            sd.set_loss_variables("loss")
+            sd.set_training_config(
+                TrainingConfig.Builder().updater(Adam(0.05))
+                .data_set_feature_mapping("x")
+                .data_set_label_mapping("y").build())
+            if segments:
+                sd.set_remat_segments(segments)
+            return sd
+
+        rng = np.random.RandomState(1)
+        xv = rng.randn(32, 6).astype(np.float32)
+        yv = rng.randn(32, 1).astype(np.float32)
+        plain = build(0)
+        seg = build(3)
+        lp = plain.fit_steps({"x": xv, "y": yv}, 6)
+        ls = seg.fit_steps({"x": xv, "y": yv}, 6)
+        np.testing.assert_allclose(ls, lp, rtol=1e-5, atol=1e-6)
+        for i in range(4):
+            np.testing.assert_allclose(
+                np.asarray(seg.get_variable(f"w{i}").get_arr()),
+                np.asarray(plain.get_variable(f"w{i}").get_arr()),
+                rtol=1e-5, atol=1e-6)
+
+    def test_cut_costs_weigh_bytes_not_counts(self):
+        """The review scenario: a cut where ONE huge tensor is live
+        must cost more than a cut where TWO small tensors are live —
+        size-weighted costs (via the abstract shape pass) get this
+        right where live-value counting inverts it."""
+        import jax
+        from deeplearning4j_tpu.autodiff.samediff import SameDiff
+        sd = SameDiff.create()
+        x = sd.placeholder("x", shape=(2, 4))
+        big = sd._op("tile", [x], {"reps": (64, 64)})   # [128, 256]
+        shrunk = sd.math.reduce_sum(big, axis=1)        # [128]
+        s1 = sd.math.sin(shrunk)
+        s2 = sd.math.cos(shrunk)                        # two small live
+        both = sd.math.add(s1, s2)
+        out = sd.math.reduce_sum(both)
+        ops = list(range(len(sd.ops)))
+        vals = {"x": jax.numpy.zeros((2, 4), jax.numpy.float32)}
+        sizes = sd._value_sizes(vals, ops, jax.random.PRNGKey(0),
+                                False)
+        assert sizes, "abstract shape pass must not fall back"
+        assert sizes[big.name] > sizes[s1.name] * 50
+        costs = sd._segment_cut_costs(ops, (out.name,), sizes)
+        # cut after `big` (only the huge tensor live) must cost MORE
+        # than the cut where s1+s2 (two small values) are live
+        i_big_live = 1      # before reduce_sum: big crosses
+        i_two_small = 4     # before add: s1+s2 cross
+        assert costs[i_big_live] > costs[i_two_small]
