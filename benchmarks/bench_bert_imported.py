@@ -51,7 +51,7 @@ def _frozen_graph_cached(seq, batch, cache_dir="/tmp/dl4j_tpu_bench"):
 
 
 def main(batch=128, seq=128, steps=48, dtype="bfloat16",
-         max_predictions=32, remat_segments=0):
+         max_predictions=32, remat_segments=0, fuse_attention=False):
     import jax
 
     from benchmarks.tf_bert_builder import (BERT_BASE,
@@ -70,6 +70,9 @@ def main(batch=128, seq=128, steps=48, dtype="bfloat16",
         max_predictions=max_predictions)
     if remat_segments:
         sd.set_remat_segments(remat_segments)
+    fused = 0
+    if fuse_attention:
+        fused = sd.fuse_attention_patterns()
 
     rs = np.random.RandomState(0)
     ids = rs.randint(0, BERT_BASE["vocab"],
@@ -122,6 +125,7 @@ def main(batch=128, seq=128, steps=48, dtype="bfloat16",
             "mlm_head": ("full-decode" if max_predictions is None
                          else f"gathered-{max_predictions}"),
             "remat_segments": remat_segments,
+            "fused_attention_sites": fused,
             "import_path": "TF GraphDef -> S6 -> one jitted program"}
     print(json.dumps(line))
     return line
@@ -142,6 +146,9 @@ if __name__ == "__main__":
                     help="sqrt(N)-checkpoint the imported op walk "
                          "in this many segments (the flat-graph "
                          "memory lever; 0 = off)")
+    ap.add_argument("--fuse-attention", action="store_true",
+                    help="run the importer's attention-pattern "
+                         "fusion pass (sdpa_core) before training")
     ap.add_argument("--max-predictions", type=int,
                     default=d["max_predictions"],
                     help="gather this many positions per sequence "
@@ -152,4 +159,5 @@ if __name__ == "__main__":
     a = ap.parse_args()
     main(batch=a.batch, seq=a.seq, steps=a.steps, dtype=a.dtype,
          max_predictions=a.max_predictions or None,
-         remat_segments=a.remat_segments)
+         remat_segments=a.remat_segments,
+         fuse_attention=a.fuse_attention)

@@ -1320,6 +1320,20 @@ def _hinge(ins, attrs):
 
 
 # -- attention (Appendix A: attention domain) -------------------------------
+@op("sdpa_core", "attention")
+def _sdpa_core(ins, attrs):
+    """Fused scaled-dot-product-attention core: softmax(q k^T * scale
+    [+ bias]) v with q/k/v [..., t, dh]. The target of
+    SameDiff.fuse_attention_patterns — one op XLA schedules as a unit
+    (and jax.checkpoint recomputes as a unit). Delegates to the ONE
+    shared attention implementation (ops/attention.py)."""
+    from deeplearning4j_tpu.ops.attention import dot_product_attention
+    q, k, v = ins[0], ins[1], ins[2]
+    bias = ins[3] if len(ins) > 3 else None
+    return dot_product_attention(q, k, v, scale=attrs.get("scale", 1.0),
+                                 bias=bias)
+
+
 @op("dot_product_attention", "attention")
 def _dpa(ins, attrs):
     from deeplearning4j_tpu.ops.attention import dot_product_attention

@@ -19,14 +19,19 @@ NEG_INF = -1e9
 
 
 def dot_product_attention(q, k, v, mask=None, scale=None,
-                          dropout_rng=None, dropout_rate=0.0):
+                          dropout_rng=None, dropout_rate=0.0,
+                          bias=None):
     """Scaled dot-product attention on [..., t, d] tensors.
 
     ``dropout_rng``/``dropout_rate``: attention-probability dropout
-    (applied to the post-softmax weights, TF/HF BERT style)."""
+    (applied to the post-softmax weights, TF/HF BERT style).
+    ``bias``: additive pre-softmax score bias (the exporter-style
+    (1-mask)*-1e4 convention the fused imported path carries)."""
     if scale is None:
         scale = 1.0 / (q.shape[-1] ** 0.5)
     scores = jnp.einsum("...qd,...kd->...qk", q, k) * scale
+    if bias is not None:
+        scores = scores + bias
     if mask is not None:
         scores = jnp.where(mask > 0, scores, NEG_INF)
     w = jax.nn.softmax(scores, axis=-1)

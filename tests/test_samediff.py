@@ -589,3 +589,65 @@ def test_fit_steps_mesh_replicates_non_batch_placeholder():
         assert False, "expected ValueError for indivisible batch"
     except ValueError:
         pass
+
+
+def test_fuse_attention_patterns_rewrites_and_matches():
+    """Graph-optimization pass (reference role: GraphOptimizer): the
+    exporter attention chain matmul(q,k,T)->div->add(bias)->softmax->
+    matmul(.,v) fuses to ONE sdpa_core op with identical outputs;
+    non-matching softmaxes are left alone."""
+    rng = np.random.RandomState(0)
+
+    def build():
+        sd = SameDiff.create()
+        q = sd.placeholder("q", shape=(2, 3, 8, 4))
+        k = sd.placeholder("k", shape=(2, 3, 8, 4))
+        v = sd.placeholder("v", shape=(2, 3, 8, 4))
+        bias = sd.placeholder("bias", shape=(2, 1, 1, 8))
+        scores = sd._op("matmul", [q, k],
+                        {"transpose_a": False, "transpose_b": True})
+        scaled = sd._op("div", [scores, sd.constant(
+            "scale_c", np.float32(2.0))])
+        biased = sd.math.add(scaled, bias)
+        probs = sd.nn.softmax(biased)
+        ctx = sd._op("matmul", [probs, v]).rename("ctx")
+        # an unrelated softmax that must NOT be touched
+        sd.nn.softmax(sd.math.reduce_sum(ctx, axis=-1),
+                      name="other_sm")
+        return sd
+
+    feeds = {"q": rng.randn(2, 3, 8, 4).astype(np.float32),
+             "k": rng.randn(2, 3, 8, 4).astype(np.float32),
+             "v": rng.randn(2, 3, 8, 4).astype(np.float32),
+             "bias": rng.randn(2, 1, 1, 8).astype(np.float32)}
+    sd = build()
+    want = sd.output(feeds, ["ctx", "other_sm"])
+    n = sd.fuse_attention_patterns()
+    assert n == 1
+    fused_ops = [o for o in sd.ops if o.op_name == "sdpa_core"]
+    assert len(fused_ops) == 1
+    assert fused_ops[0].attrs["scale"] == 0.5      # 1 / div-const
+    got = sd.output(feeds, ["ctx", "other_sm"])
+    for kk in want:
+        np.testing.assert_allclose(np.asarray(got[kk]),
+                                   np.asarray(want[kk]),
+                                   rtol=1e-5, atol=1e-6)
+    # idempotent: a second pass finds nothing
+    assert sd.fuse_attention_patterns() == 0
+
+
+def test_fuse_attention_skips_multi_consumer_probs():
+    """If the softmax probabilities feed anything besides the context
+    matmul (e.g. attention visualization), the site must NOT fuse."""
+    sd = SameDiff.create()
+    q = sd.placeholder("q", shape=(1, 2, 4, 4))
+    k = sd.placeholder("k", shape=(1, 2, 4, 4))
+    v = sd.placeholder("v", shape=(1, 2, 4, 4))
+    scores = sd._op("matmul", [q, k],
+                    {"transpose_a": False, "transpose_b": True})
+    scaled = sd._op("mul", [scores, sd.constant(
+        "c", np.float32(0.5))])
+    probs = sd.nn.softmax(scaled)
+    sd._op("matmul", [probs, v]).rename("ctx")
+    sd.math.reduce_sum(probs, name="viz")          # second consumer
+    assert sd.fuse_attention_patterns() == 0

@@ -235,3 +235,44 @@ class TestGatheredMlmHead:
                                        rtol=1e-6, atol=1e-7)
         l3 = sd2.fit_steps(b, 5)
         assert np.isfinite(l3) and l3 < l2
+
+
+class TestAttentionFusion:
+    """The importer's attention-pattern fusion pass on a REAL frozen
+    TF graph (toy dims): every layer's attention must fuse and the
+    forward/loss/training trajectory must be unchanged."""
+
+    def test_imported_bert_fuses_all_layers_exactly(self):
+        from deeplearning4j_tpu.learning import Adam
+        vocab, hidden, heads, layers, seq, batch = 50, 16, 2, 3, 16, 2
+        gd, _ = build_frozen_bert(seq, batch, vocab=vocab,
+                                  hidden=hidden, heads=heads,
+                                  layers=layers, intermediate=32)
+
+        def fresh():
+            sd, loss = import_and_attach_mlm(
+                gd, batch, seq, vocab=vocab, hidden=hidden,
+                updater=Adam(1e-3))
+            return sd, loss
+
+        rs = np.random.RandomState(0)
+        feeds = {
+            "ids": rs.randint(0, vocab, (batch, seq)).astype(np.int32),
+            "seg": np.zeros((batch, seq), np.int32),
+            "mask": np.ones((batch, seq), np.int32),
+            "mlm_labels": np.where(rs.rand(batch, seq) < 0.3,
+                                   rs.randint(0, vocab, (batch, seq)),
+                                   -1).astype(np.int32)}
+
+        plain, loss_name = fresh()
+        fused, _ = fresh()
+        assert fused.fuse_attention_patterns() == layers
+
+        want = plain.output(feeds, [loss_name])[loss_name]
+        got = fused.output(feeds, [loss_name])[loss_name]
+        np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6)
+
+        # identical TRAINING trajectory (same updater, same steps)
+        lp = plain.fit_steps(feeds, 4)
+        lf = fused.fit_steps(feeds, 4)
+        np.testing.assert_allclose(lf, lp, rtol=1e-4, atol=1e-5)
