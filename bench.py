@@ -142,6 +142,35 @@ def main():
                   "output", file=sys.stderr)
     except Exception as e:
         print(f"pipeline-proxy leg failed: {e!r}", file=sys.stderr)
+    # Feeding-ladder leg: per-step input-pipeline stall under the
+    # three feeding modes (sync / host-async / device-prefetch), so
+    # BENCH_*.json rounds track feeding overhead alongside throughput.
+    # CPU-proxy subprocess, like the pipeline leg above.
+    try:
+        env = {**os.environ, "PYTHONPATH": "", "JAX_PLATFORMS": "cpu"}
+        out = subprocess.run(
+            [sys.executable,
+             os.path.join(_ROOT, "benchmarks",
+                          "bench_input_pipeline.py")],
+            capture_output=True, text=True, timeout=900, env=env,
+            cwd=_ROOT)
+        if out.returncode != 0:
+            raise RuntimeError(
+                f"rc={out.returncode}: {out.stderr.strip()[-400:]}")
+        for ln in out.stdout.strip().splitlines():
+            if not ln.startswith("{"):
+                continue              # tolerate library banners
+            rec = json.loads(ln)
+            if rec["metric"] == "input_pipeline_stall_pct":
+                line["input_pipeline_stall_pct"] = rec["value"]
+                line["input_pipeline_stall_sync_pct"] = rec["sync_pct"]
+                line["input_pipeline_stall_host_async_pct"] = \
+                    rec["host_async_pct"]
+        if "input_pipeline_stall_pct" not in line:
+            print("feeding-ladder leg: no stall line in child output",
+                  file=sys.stderr)
+    except Exception as e:
+        print(f"feeding-ladder leg failed: {e!r}", file=sys.stderr)
     print(json.dumps(line))
 
 

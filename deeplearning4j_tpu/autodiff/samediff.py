@@ -241,6 +241,23 @@ def _shard_placeholders(mesh, ph_vals: Dict, batch_names=None):
         if leads:
             batch = max(leads, key=lambda d: (
                 leads[d], d % ndev == 0, ranks[d], d))
+            ties = [d for d in leads
+                    if d != batch and leads[d] == leads[batch]]
+            if ties:
+                # the vote was ambiguous: the losing placeholders get
+                # REPLICATED, silently giving up DP batch sharding for
+                # them (and bypassing the divisibility check they would
+                # have hit as batch tensors)
+                excluded = sorted(
+                    k for k, v in ph_vals.items()
+                    if v.ndim > 0 and int(v.shape[0]) in ties)
+                log.warning(
+                    "batch-dim inference chose leading dim %d but %s "
+                    "tie(s) it — placeholders %s will be replicated, "
+                    "not batch-sharded. Pass explicit "
+                    "data_set_feature_mapping/label_mapping (or "
+                    "batch_names) to disambiguate.",
+                    batch, ties, excluded)
     out = {}
     for k, v in ph_vals.items():
         if v.ndim > 0 and int(v.shape[0]) == batch:
@@ -297,6 +314,7 @@ class SameDiff:
         #: ListenerList — the SAME listener impls MLN/graph use:
         #: Score/Performance/Evaluative/Checkpoint attach unchanged)
         self.listeners: list = []
+        self._retrace_guard = None
         self._score: float = float("nan")
         self.last_batch_size: int = 0
         #: sqrt(N) activation checkpointing for TRAINING programs:
@@ -1163,6 +1181,9 @@ class SameDiff:
         return step, trainable
 
     def _build_train_step(self, ph_names: Tuple[str, ...]):
+        from deeplearning4j_tpu.common.compilecache import \
+            enable_persistent_cache
+        enable_persistent_cache()    # second process loads, not compiles
         step, trainable = self._build_raw_train_step(ph_names)
         return jax.jit(step, donate_argnums=(0, 1)), trainable
 
@@ -1197,6 +1218,9 @@ class SameDiff:
         key = (tuple(sorted(ph_vals)), mesh_sig)
         cached = self._exec_cache.get(("train_multi", key))
         if cached is None:
+            from deeplearning4j_tpu.common.compilecache import \
+                enable_persistent_cache
+            enable_persistent_cache()
             raw, trainable = self._build_raw_train_step(tuple(ph_vals))
 
             def multi(var_vals, upd_state, ph, rng, it0, n):
@@ -1334,7 +1358,9 @@ class SameDiff:
         ({output_var: Evaluation-factory or (factory, label_index)}):
         evaluated every ``validation_frequency`` epochs; results land
         in the returned History's evaluation records."""
-        from deeplearning4j_tpu.autodiff.training import History
+        from deeplearning4j_tpu.autodiff.training import (
+            History, device_prefetch_placeholders)
+        from deeplearning4j_tpu.common.environment import Environment
         cfg = self.training_config
         if cfg is None:
             raise ValueError("call set_training_config first")
@@ -1345,16 +1371,37 @@ class SameDiff:
         step_fn = None
         trainable = None
         iteration = self.iteration_count
+        env = Environment.get()
+
+        def make_ph(batch):
+            # host-side mapping only; the staging generator (or the
+            # sync fallback below) owns the device conversion
+            return (placeholders_fn(batch) if placeholders_fn
+                    else cfg.placeholders_from(batch))
+
         for epoch in range(n_epochs):
             for lis in all_listeners:
                 lis.on_epoch_start(self)
             if hasattr(iterator, "reset"):
                 iterator.reset()
             epoch_losses = []
-            for batch in iterator:
-                ph = (placeholders_fn(batch) if placeholders_fn
-                      else cfg.placeholders_from(batch))
-                ph_vals = {k: jnp.asarray(v) for k, v in ph.items()}
+            # device-prefetch: make_ph + the H2D copies run on a feeder
+            # thread a batch ahead of the step loop
+            staged = (device_prefetch_placeholders(
+                          iterator, make_ph,
+                          depth=env.device_prefetch_depth)
+                      if env.device_prefetch
+                      else ({k: jnp.asarray(v)
+                             for k, v in make_ph(b).items()}
+                            for b in iterator))
+            for ph_vals in staged:
+                if self._retrace_guard is None:
+                    from deeplearning4j_tpu.common.compilecache import \
+                        RetraceGuard
+                    self._retrace_guard = RetraceGuard(
+                        "SameDiff train step")
+                self._retrace_guard.record(
+                    *(ph_vals[k] for k in sorted(ph_vals)))
                 if step_fn is None:
                     # cache the COMPILED step across fit() calls: a
                     # fresh jax.jit wrapper per fit would recompile
@@ -1478,11 +1525,15 @@ class SameDiff:
             "iteration_count": self.iteration_count,
             "epoch_count": self.epoch_count,
         }
-        arrays = {k: np.asarray(v) for k, v in self._arrays.items()}
+        # np.array (copy), not np.asarray: on CPU the conversion is a
+        # zero-copy VIEW of the XLA buffer, and fit donates var/updater
+        # buffers — an executable honoring the donation would mutate a
+        # checkpoint_snapshot while its background write is in flight
+        arrays = {k: np.array(v) for k, v in self._arrays.items()}
         upd_leaves = None
         if save_updater_state and self._updater_state is not None:
             leaves, _ = jax.tree_util.tree_flatten(self._updater_state)
-            upd_leaves = [np.asarray(l) for l in leaves]
+            upd_leaves = [np.array(l) for l in leaves]
         return graph, arrays, cf_arrays, upd_leaves
 
     @staticmethod

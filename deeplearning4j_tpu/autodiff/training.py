@@ -6,10 +6,62 @@ regularization, dataset feature/label -> placeholder mappings) and
 """
 from __future__ import annotations
 
+import queue as _queue
+import threading
 from dataclasses import dataclass, field
-from typing import Dict, List, Optional
+from typing import Callable, Dict, List, Optional
 
 import numpy as np
+
+
+class _FeederError:
+    __slots__ = ("exc",)
+
+    def __init__(self, exc: BaseException):
+        self.exc = exc
+
+
+def device_prefetch_placeholders(iterator, make_ph: Callable,
+                                 depth: int = 2):
+    """Device-side staging for the SameDiff fit loop (the placeholder
+    analogue of ``datasets.prefetch.DevicePrefetcher``): a feeder
+    thread maps each batch through ``make_ph`` (DataSet ->
+    ``{name: array}`` via the TrainingConfig mappings) and the arrays
+    are ``jax.device_put`` ahead of the step that consumes them,
+    double-buffered, so the H2D copy of batch n+1 overlaps the device
+    step on batch n. As in DevicePrefetcher, the put is issued
+    feeder-side on accelerator backends and consumer-side (after the
+    async step dispatch of the previous batch) on CPU. Feeder
+    exceptions re-raise on the consumer; the generator yields dicts
+    of device-resident arrays in iterator order."""
+    import jax
+    import jax.numpy as jnp
+    thread_put = jax.default_backend() != "cpu"
+    q: _queue.Queue = _queue.Queue(max(1, int(depth)))
+    sentinel = object()
+
+    def to_dev(ph):
+        return {k: jax.device_put(jnp.asarray(v))
+                for k, v in ph.items()}
+
+    def feeder():
+        try:
+            for batch in iterator:
+                ph = make_ph(batch)
+                q.put(to_dev(ph) if thread_put else ph)
+            q.put(sentinel)
+        except BaseException as e:       # noqa: BLE001 — re-raised below
+            q.put(_FeederError(e))
+
+    threading.Thread(target=feeder, daemon=True,
+                     name="dl4j-tpu-samediff-prefetch").start()
+    while True:
+        item = q.get()
+        if item is sentinel:
+            return
+        if isinstance(item, _FeederError):
+            raise item.exc
+        yield item if thread_put else to_dev(item)
 
 
 @dataclass

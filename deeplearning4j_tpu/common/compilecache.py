@@ -1,0 +1,154 @@
+"""Persistent XLA compilation cache + retrace guard for the jit funnels.
+
+The reference pays JVM warmup once per process; our analogue is XLA
+compile latency, which every fresh process pays in full at the first
+``fit``/``output`` call — minutes at ResNet/BERT scale on TPU. jax ships
+a content-addressed on-disk compilation cache (the TVM compile-cache
+idea): keyed by (HLO, compile options, backend version), so a second
+process compiling the SAME network loads the serialized executable
+instead of re-running XLA. :func:`enable_persistent_cache` points jax at
+a per-user cache dir; every train-step/inference funnel calls it before
+its first ``jax.jit`` so the cache is on by default
+(``DL4J_TPU_COMPILE_CACHE=0`` opts out, ``DL4J_TPU_COMPILE_CACHE_DIR``
+relocates it).
+
+:class:`RetraceGuard` is the other half of compile-latency hygiene: the
+cache cannot help a process that keeps compiling NEW programs. jit
+retraces per input signature, so ragged minibatches or unbucketed
+sequence lengths silently turn one network into dozens of compiled
+programs. The guard counts distinct signatures per network and warns
+once past a threshold, pointing at padding/bucketing.
+"""
+from __future__ import annotations
+
+import logging
+import os
+import threading
+from typing import Optional
+
+from deeplearning4j_tpu.common.environment import Environment
+
+log = logging.getLogger("deeplearning4j_tpu")
+
+_lock = threading.Lock()
+_enabled_dir: Optional[str] = None
+
+
+def default_cache_dir() -> str:
+    return os.path.join(os.path.expanduser("~"), ".cache",
+                        "deeplearning4j_tpu", "xla-cache")
+
+
+def enable_persistent_cache() -> Optional[str]:
+    """Idempotently enable jax's on-disk compilation cache. Returns the
+    cache dir, or None when disabled. Safe to call from every funnel:
+    only the first call mutates jax config.
+
+    Default ON for accelerator backends (TPU/GPU — where XLA compiles
+    for minutes and D2H copies are real copies). On the CPU backend the
+    cache requires an EXPLICIT ``DL4J_TPU_COMPILE_CACHE=1``: cpu
+    ``device_get``/``np.asarray`` return zero-copy views of XLA
+    buffers, and a cache-loaded executable honors buffer donation that
+    a freshly-compiled CPU one may not — code holding views across a
+    donating step (a pattern CPU-only tests get away with) would see
+    its arrays mutate."""
+    global _enabled_dir
+    env = Environment.get()
+    if not env.compile_cache:
+        return None
+    with _lock:
+        if _enabled_dir is not None:
+            return _enabled_dir
+        import jax
+        if "DL4J_TPU_COMPILE_CACHE" not in os.environ and \
+                jax.default_backend() == "cpu":
+            return None
+        d = env.compile_cache_dir or default_cache_dir()
+        try:
+            os.makedirs(d, exist_ok=True)
+            jax.config.update("jax_compilation_cache_dir", d)
+            # cache unconditionally: the default gates (>=1s compile,
+            # min entry size) exist for shared-filesystem TPU pods;
+            # here losing sub-second CPU entries would make the
+            # second-process win untestable and skip exactly the
+            # programs unit-scale users compile
+            jax.config.update("jax_persistent_cache_min_entry_size_bytes",
+                              -1)
+            jax.config.update("jax_persistent_cache_min_compile_time_secs",
+                              0.0)
+            # jax memoizes "is the cache used?" at the FIRST compile of
+            # the process — which has usually already happened (PRNGKey
+            # init, dtype conversions) by the time a train step is
+            # built. Drop that verdict so the new dir takes effect.
+            from jax._src import compilation_cache as _cc
+            _cc.reset_cache()
+        except Exception as e:          # unwritable dir / exotic jax
+            log.warning("persistent compilation cache disabled: %s", e)
+            return None
+        _enabled_dir = d
+        log.debug("persistent XLA compilation cache at %s", d)
+        return d
+
+
+def _reset_for_tests():
+    """Disable the cache and forget the enabled state so a test can
+    exercise enablement without leaving the persistent cache live for
+    the rest of the process (cache-LOADED executables honor donation —
+    see enable_persistent_cache — which would perturb unrelated tests
+    holding numpy views of donated buffers)."""
+    global _enabled_dir
+    with _lock:
+        if _enabled_dir is not None:
+            import jax
+            from jax._src import compilation_cache as _cc
+            jax.config.update("jax_compilation_cache_dir", None)
+            _cc.reset_cache()
+        _enabled_dir = None
+
+
+def signature_of(*xs) -> tuple:
+    """Hashable (shape, dtype) signature of a batch's arrays; None
+    passes through, lists/tuples recurse (graph multi-input)."""
+    out = []
+    for x in xs:
+        if x is None:
+            out.append(None)
+        elif isinstance(x, (list, tuple)):
+            out.append(signature_of(*x))
+        elif hasattr(x, "shape"):
+            out.append((tuple(x.shape), str(getattr(x, "dtype", ""))))
+        else:
+            out.append(type(x).__name__)
+    return tuple(out)
+
+
+class RetraceGuard:
+    """Counts the distinct input signatures one network has compiled
+    and warns ONCE when the count exceeds the threshold — each new
+    signature is a full XLA recompile (shape churn defeats both the
+    in-process jit cache and the persistent cache's amortization)."""
+
+    def __init__(self, name: str, threshold: Optional[int] = None):
+        self.name = name
+        self.threshold = (threshold if threshold is not None
+                          else Environment.get().retrace_warn_threshold)
+        self._sigs: set = set()
+        self._warned = False
+
+    def record(self, *batch_arrays) -> None:
+        sig = signature_of(*batch_arrays)
+        if sig in self._sigs:
+            return
+        self._sigs.add(sig)
+        if not self._warned and len(self._sigs) > self.threshold:
+            self._warned = True
+            log.warning(
+                "%s has now compiled %d distinct input signatures — "
+                "every new batch shape/dtype recompiles the whole XLA "
+                "program. Pad minibatches to a fixed batch size (or "
+                "bucket sequence lengths) so the step compiles once.",
+                self.name, len(self._sigs))
+
+    @property
+    def n_signatures(self) -> int:
+        return len(self._sigs)

@@ -25,6 +25,18 @@ class _Env:
     default_float_dtype: str = "float32"
     # TPU-specific: matmul precision for f32 ops ('default'|'high'|'highest')
     matmul_precision: str = "default"
+    # device-side input staging (datasets.prefetch.DevicePrefetcher):
+    # fit() wraps iterators so the H2D copy of batch n+1 overlaps the
+    # device step on batch n. Depth 2 = classic double buffering.
+    device_prefetch: bool = True
+    device_prefetch_depth: int = 2
+    # persistent XLA compilation cache (common.compilecache): second
+    # process compiling the same program loads the binary from disk
+    compile_cache: bool = True
+    compile_cache_dir: str = ""         # "" -> ~/.cache/deeplearning4j_tpu
+    # warn after this many distinct compiled input signatures per
+    # network (shape churn -> retrace storm; pad or bucket instead)
+    retrace_warn_threshold: int = 5
     extra: dict = field(default_factory=dict)
 
     def set_debug(self, v: bool):
@@ -42,7 +54,10 @@ class Environment:
 
     Env vars (analogue of ND4JEnvironmentVars):
       DL4J_TPU_DEBUG, DL4J_TPU_VERBOSE, DL4J_TPU_PROFILING,
-      DL4J_TPU_CHECK_NAN, DL4J_TPU_CHECK_INF, DL4J_TPU_ALLOW_HELPERS
+      DL4J_TPU_CHECK_NAN, DL4J_TPU_CHECK_INF, DL4J_TPU_ALLOW_HELPERS,
+      DL4J_TPU_DEVICE_PREFETCH, DL4J_TPU_DEVICE_PREFETCH_DEPTH,
+      DL4J_TPU_COMPILE_CACHE, DL4J_TPU_COMPILE_CACHE_DIR,
+      DL4J_TPU_RETRACE_WARN
     """
 
     _inst: _Env | None = None
@@ -65,6 +80,14 @@ class Environment:
                     check_for_nan=b("DL4J_TPU_CHECK_NAN"),
                     check_for_inf=b("DL4J_TPU_CHECK_INF"),
                     allow_helpers=b("DL4J_TPU_ALLOW_HELPERS", True),
+                    device_prefetch=b("DL4J_TPU_DEVICE_PREFETCH", True),
+                    device_prefetch_depth=int(os.environ.get(
+                        "DL4J_TPU_DEVICE_PREFETCH_DEPTH", "2")),
+                    compile_cache=b("DL4J_TPU_COMPILE_CACHE", True),
+                    compile_cache_dir=os.environ.get(
+                        "DL4J_TPU_COMPILE_CACHE_DIR", ""),
+                    retrace_warn_threshold=int(os.environ.get(
+                        "DL4J_TPU_RETRACE_WARN", "5")),
                 )
             return cls._inst
 

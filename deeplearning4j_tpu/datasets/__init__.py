@@ -2,6 +2,8 @@ from deeplearning4j_tpu.datasets.dataset import DataSet, MultiDataSet  # noqa: F
 from deeplearning4j_tpu.datasets.iterators import (  # noqa: F401
     DataSetIterator, ListDataSetIterator, ExistingDataSetIterator,
     AsyncDataSetIterator)
+from deeplearning4j_tpu.datasets.prefetch import (  # noqa: F401
+    DevicePrefetcher, maybe_device_prefetch)
 from deeplearning4j_tpu.datasets.normalizers import (  # noqa: F401
     NormalizerStandardize, NormalizerMinMaxScaler,
     ImagePreProcessingScaler)

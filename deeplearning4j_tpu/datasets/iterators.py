@@ -3,9 +3,22 @@
 Reference parity: ``org.nd4j.linalg.dataset.api.iterator.DataSetIterator``,
 ``ListDataSetIterator``, ``ExistingDataSetIterator``, and the async
 prefetch wrappers (``AsyncDataSetIterator``) — SURVEY.md J9, call stack
-3.1's "iter.next() (async prefetch thread)". On TPU the host->device copy
-happens at jit boundary; the async iterator overlaps host-side ETL
-(decode/augment/normalize) with device compute via a background thread.
+3.1's "iter.next() (async prefetch thread)".
+
+The feeding ladder, from fully serial to fully overlapped:
+
+1. **sync** — any plain iterator: ETL + H2D copy + device step all on
+   the fit thread.
+2. **host-async** — :class:`AsyncDataSetIterator` (this module): ETL
+   (decode/augment/normalize) runs on a feeder thread; the host->device
+   copy still happens synchronously at the jit boundary.
+3. **device-prefetch** — :class:`~deeplearning4j_tpu.datasets.prefetch.
+   DevicePrefetcher`: the feeder thread also ``jax.device_put``s onto
+   the target sharding, double-buffered, so the H2D DMA of batch n+1
+   overlaps the device step on batch n. ``fit`` applies it to any
+   resettable iterator automatically (``DL4J_TPU_DEVICE_PREFETCH=0``
+   opts out); ``benchmarks/bench_input_pipeline.py`` measures the
+   per-step host-wait each rung removes.
 """
 from __future__ import annotations
 

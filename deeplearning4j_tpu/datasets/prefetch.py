@@ -1,0 +1,272 @@
+"""DevicePrefetcher: device-side double-buffered input staging.
+
+The feeding ladder (SURVEY.md call stack 3.1, "iter.next() (async
+prefetch thread)"):
+
+1. **sync** — ``fit`` calls ``iter.next()`` inline; ETL, the host->
+   device copy, and the device step all serialize.
+2. **host-async** — :class:`AsyncDataSetIterator` moves ETL (decode/
+   augment/normalize) onto a feeder thread, but the H2D copy still
+   happens synchronously at the jit boundary inside ``fit``.
+3. **device-prefetch** (this module) — batches are ALSO
+   ``jax.device_put`` onto the target sharding ahead of consumption,
+   double-buffered, so the H2D DMA of batch n+1 overlaps the device
+   step on batch n and step time approaches ``max(compute, transfer)``
+   instead of ``compute + transfer``.
+
+Where the ``device_put`` is issued (``thread_put``):
+
+- On accelerator backends (TPU/GPU — the default there) the feeder
+  thread issues it, so even a *synchronous* transfer overlaps compute.
+- On the CPU backend the consumer thread issues it one batch ahead of
+  the step dispatch (the ``flax.jax_utils.prefetch_to_device`` idiom:
+  async dispatch keeps the copy off the critical path when the runtime
+  allows). Every jax call then happens on the fit thread — the
+  conservative choice for the virtual-device CPU test mesh, where the
+  runtime sees patterns no production TPU client does.
+
+Placement: replicated/default-device on single chip; with ``mesh=``,
+batch arrays are laid out with ``data_sharding(mesh, ...)`` (leading
+axis over the ``data`` mesh axis) so the per-device shards DMA
+directly without a gather/scatter at dispatch. Callers with bespoke
+placement (ParallelWrapper's trim+shard, SharedTrainingMaster's
+multi-host global assembly) pass ``place_fn``.
+
+Donation safety: every train-step funnel donates ONLY params/states/
+updater-state (``donate_argnums=(0, 1, 2)`` — batch arguments are
+never donated), so a prefetched buffer is never aliased by XLA and a
+staged DataSet can be re-fed (see tests/test_device_prefetch.py).
+
+An :class:`AsyncDataSetIterator` base is unwrapped: this feeder thread
+already overlaps the ETL, and stacking a second consumer thread on the
+async iterator's (possibly native) queue buys nothing.
+
+``fit`` wraps iterators automatically via :func:`maybe_device_prefetch`
+(``DL4J_TPU_DEVICE_PREFETCH=0`` opts out; depth via
+``DL4J_TPU_DEVICE_PREFETCH_DEPTH``, default 2 = double buffering).
+"""
+from __future__ import annotations
+
+import copy
+import logging
+import queue
+import threading
+from typing import Callable, Optional
+
+import numpy as np
+
+from deeplearning4j_tpu.common.environment import Environment
+from deeplearning4j_tpu.datasets.iterators import (AsyncDataSetIterator,
+                                                   DataSetIterator)
+
+log = logging.getLogger("deeplearning4j_tpu")
+
+#: the attrs whose floats are cast to the model dtype before the copy
+#: (mirrors ``_as_jnp(x, dtype)`` in the fit funnels); masks keep their
+#: dtype (the funnels call ``_as_jnp(mask)`` with no dtype)
+_CAST_ATTRS = ("features", "labels")
+
+
+class _FeederError:
+    """Exception captured on the feeder thread, re-raised on the
+    consumer so a failing base iterator fails ``fit`` loudly."""
+    __slots__ = ("exc",)
+
+    def __init__(self, exc: BaseException):
+        self.exc = exc
+
+
+class DevicePrefetcher(DataSetIterator):
+    """Wrap any :class:`DataSetIterator`; ETL runs on a feeder thread
+    and the next ``depth`` batches are staged device-side ahead of
+    consumption."""
+
+    _SENTINEL = object()
+
+    def __init__(self, base: DataSetIterator, *, depth: int = 2,
+                 mesh=None, data_axis: str = "data",
+                 dtype=None,
+                 place_fn: Optional[Callable] = None,
+                 thread_put: Optional[bool] = None):
+        super().__init__()
+        if isinstance(base, AsyncDataSetIterator):
+            base = base._base        # module docstring: no double wrap
+        self._base = base
+        self._depth = max(1, int(depth))
+        self._mesh = mesh
+        self._data_axis = data_axis
+        self._dtype = dtype
+        self._place_fn = place_fn
+        self._thread_put = thread_put
+        self._queue: queue.Queue = queue.Queue(self._depth)
+        self._thread: Optional[threading.Thread] = None
+        self._next = None
+        self._error: Optional[BaseException] = None
+        self._started = False
+        self._consumed = False
+
+    # -- staging stages -------------------------------------------------
+    def _resolve_thread_put(self) -> bool:
+        if self._thread_put is None:
+            import jax
+            self._thread_put = jax.default_backend() != "cpu"
+        return self._thread_put
+
+    def _cast_host(self, ds):
+        """Host-side dtype cast (numpy, feeder thread) so the device
+        buffer already has the model dtype and _as_jnp's astype is a
+        no-op. Skipped when the caller owns placement."""
+        if self._place_fn is not None or self._dtype is None:
+            return ds
+
+        def cast(a):
+            if isinstance(a, np.ndarray) and \
+                    np.issubdtype(a.dtype, np.floating):
+                return np.asarray(a, self._dtype)
+            return a
+
+        out = copy.copy(ds)
+        for attr in _CAST_ATTRS:
+            v = getattr(ds, attr, None)
+            if v is None:
+                continue
+            if isinstance(v, (list, tuple)):
+                setattr(out, attr, [cast(x) for x in v])
+            else:
+                setattr(out, attr, cast(v))
+        return out
+
+    def _put(self, ds):
+        """Issue the device transfer (async dispatch where the runtime
+        supports it — the DMA proceeds while the caller moves on)."""
+        if self._place_fn is not None:
+            return self._place_fn(ds)
+        import jax
+        from deeplearning4j_tpu.parallel.mesh import (DATASET_ARRAY_ATTRS,
+                                                      data_sharding)
+
+        def put(a):
+            if a is None or not hasattr(a, "ndim"):
+                return a
+            if self._mesh is not None and getattr(a, "ndim", 0) > 0:
+                return jax.device_put(
+                    a, data_sharding(self._mesh, a.ndim, self._data_axis))
+            return jax.device_put(a)
+
+        out = copy.copy(ds)
+        for attr in DATASET_ARRAY_ATTRS:
+            v = getattr(ds, attr, None)
+            if v is None:
+                continue
+            if isinstance(v, (list, tuple)):
+                setattr(out, attr, [put(x) for x in v])
+            else:
+                setattr(out, attr, put(v))
+        return out
+
+    # -- feeder ---------------------------------------------------------
+    def _feeder(self, q: queue.Queue, thread_put: bool):
+        try:
+            self._base.reset()
+            while self._base.has_next():
+                ds = self._cast_host(self._base.next())
+                if thread_put:
+                    ds = self._put(ds)
+                q.put(ds)
+            q.put(self._SENTINEL)
+        except BaseException as e:       # noqa: BLE001 — re-raised on
+            q.put(_FeederError(e))       # the consumer thread
+
+    def reset(self):
+        t = self._thread
+        if t is not None and t.is_alive():
+            # drain so the old feeder can finish; timed gets because
+            # the terminal item may already have been consumed while
+            # the feeder is between its final put and thread exit
+            # (the AsyncDataSetIterator drain discipline)
+            while t.is_alive():
+                try:
+                    item = self._queue.get(timeout=0.05)
+                except queue.Empty:
+                    continue
+                if item is self._SENTINEL or isinstance(item,
+                                                        _FeederError):
+                    break
+            t.join()
+        thread_put = self._resolve_thread_put()
+        self._queue = queue.Queue(self._depth)
+        self._error = None
+        self._thread = threading.Thread(
+            target=self._feeder, args=(self._queue, thread_put),
+            daemon=True, name="dl4j-tpu-device-prefetch")
+        self._thread.start()
+        self._started = True
+        self._consumed = False
+        self._advance()
+
+    def _advance(self):
+        """Pull the next batch and — in consumer-put mode — issue its
+        H2D now, BEFORE the caller dispatches the step on the batch we
+        just handed out: transfer n+1 overlaps step n."""
+        item = self._queue.get()
+        if isinstance(item, _FeederError):
+            self._error = item.exc
+            self._next = None
+        elif item is self._SENTINEL:
+            self._next = None
+        else:
+            self._next = item if self._thread_put else self._put(item)
+
+    def has_next(self) -> bool:
+        if not self._started:
+            self.reset()
+        if self._error is not None:
+            e, self._error = self._error, None
+            raise e
+        return self._next is not None
+
+    def next(self):  # noqa: A003
+        if not self.has_next():
+            raise StopIteration("iterator exhausted; call reset()")
+        ds = self._next
+        self._consumed = True
+        self._advance()
+        return ds
+
+    def __iter__(self):
+        # a freshly-reset prefetcher already has batches staged — only
+        # re-reset when stale, so fit's reset() + `for ds in it` does
+        # not discard the staged window every epoch
+        if not self._started or self._consumed:
+            self.reset()
+        while self.has_next():
+            yield self.next()
+
+    def batch(self) -> int:
+        return self._base.batch()
+
+    def set_pre_processor(self, p):
+        # preprocessing must see HOST arrays, on the feeder thread —
+        # delegate to the wrapped iterator
+        self._base.set_pre_processor(p)
+
+
+def maybe_device_prefetch(iterator, *, mesh=None, dtype=None,
+                          place_fn=None, depth: Optional[int] = None):
+    """The fit-funnel hook: wrap ``iterator`` in a
+    :class:`DevicePrefetcher` when the ``DL4J_TPU_DEVICE_PREFETCH``
+    flag is on (default). Returns the input unchanged when the flag is
+    off, when it is already device-prefetched, or when it is not a
+    resettable DataSetIterator-shaped stream (plain lists/generators
+    stay sync — they cannot be re-fed across epochs anyway)."""
+    env = Environment.get()
+    if not env.device_prefetch:
+        return iterator
+    if isinstance(iterator, DevicePrefetcher):
+        return iterator
+    if not (hasattr(iterator, "reset") and hasattr(iterator, "has_next")
+            and hasattr(iterator, "next")):
+        return iterator
+    return DevicePrefetcher(
+        iterator, depth=depth or env.device_prefetch_depth, mesh=mesh,
+        dtype=dtype, place_fn=place_fn)

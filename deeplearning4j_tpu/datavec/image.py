@@ -32,7 +32,17 @@ except Exception:                                  # pragma: no cover
 class ImageLoader:
     """Decode + resize to HWC float32 (reference: NativeImageLoader;
     NHWC here — XLA:TPU's native conv layout, the reference's NCHW
-    exists only at import boundaries)."""
+    exists only at import boundaries).
+
+    INTENTIONAL divergence (ADVICE.md r5): file inputs decoded via
+    Pillow resize with Pillow's antialiased BILINEAR (plus JPEG draft
+    mode), while ndarray/`.npy` inputs resize through the half-pixel
+    numpy ``_resize_bilinear`` below — the same logical image can yield
+    slightly different pixels depending on input form. The PIL path is
+    kept because it is the throughput path (GIL-released SIMD resize,
+    147 -> >1k img/s on the ETL bench) and antialiased downscale is the
+    *better* eval-time convention; feed ``.npy``/arrays end-to-end when
+    bit-consistency between file-fed and array-fed pipelines matters."""
 
     def __init__(self, height: int, width: int, channels: int = 3):
         self.h, self.w, self.c = int(height), int(width), int(channels)

@@ -332,6 +332,17 @@ def _resize(ctx, node):
     mode = node.attr("mode", b"nearest")
     if isinstance(mode, bytes):
         mode = mode.decode()
+    # opset-18 antialias and cubic's exclude_outside change the filter
+    # footprint — the registry lowering implements neither, so nonzero
+    # values must fail loudly, not silently diverge (ADVICE.md)
+    if int(node.attr("antialias", 0)):
+        raise NotImplementedError(
+            "Resize with antialias=1 unsupported (the lowering has no "
+            "antialiasing filter) — export with antialias=False")
+    if int(node.attr("exclude_outside", 0)):
+        raise NotImplementedError(
+            "Resize with exclude_outside=1 unsupported — export with "
+            "exclude_outside=0")
     # Resize-10 (inputs X, scales) and opset-9 Upsample predate the
     # coordinate_transformation_mode attr; their spec semantics are
     # "asymmetric". Resize-11+ always carries roi at input 1.

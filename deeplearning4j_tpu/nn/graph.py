@@ -45,6 +45,7 @@ class ComputationGraph:
         self._initialized = False
         self._dtype = to_jnp_dtype(conf.dtype)
         self._topo = conf.topo_order()
+        self._retrace_guard = None
 
     # ------------------------------------------------------------------
     def init(self) -> "ComputationGraph":
@@ -296,6 +297,9 @@ class ComputationGraph:
 
     # ------------------------------------------------------------------
     def _build_train_step(self):
+        from deeplearning4j_tpu.common.compilecache import \
+            enable_persistent_cache
+        enable_persistent_cache()    # second process loads, not compiles
         conf = self.conf
         out_confs = self.output_layer_confs()
         updaters = {name: (conf.vertices[name].content.updater
@@ -368,6 +372,11 @@ class ComputationGraph:
             for _ in range(n_epochs):
                 self._fit_dataset(data)
             return self
+        # stage batches device-side ahead of the step loop (no-op when
+        # DL4J_TPU_DEVICE_PREFETCH=0 or not a resettable iterator)
+        from deeplearning4j_tpu.datasets.prefetch import \
+            maybe_device_prefetch
+        data = maybe_device_prefetch(data, dtype=self._dtype)
         for _ in range(n_epochs):
             for lis in self.listeners:
                 lis.on_epoch_start(self)
@@ -549,6 +558,11 @@ class ComputationGraph:
         if lmasks is not None:
             lmasks = [(_as_jnp(m) if m is not None else None)
                       for m in lmasks]
+        if self._retrace_guard is None:
+            from deeplearning4j_tpu.common.compilecache import RetraceGuard
+            self._retrace_guard = RetraceGuard(
+                f"{type(self).__name__} train step")
+        self._retrace_guard.record(inputs, labels, fmask, lmasks)
         from deeplearning4j_tpu.nn.conf.builders import BackpropType
         if self.conf.backprop_type is BackpropType.TRUNCATED_BPTT and \
                 inputs[0].ndim == 3:

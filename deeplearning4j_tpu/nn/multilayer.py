@@ -58,6 +58,7 @@ class MultiLayerNetwork:
         self._train_step = None
         self._initialized = False
         self._dtype = to_jnp_dtype(conf.dtype)
+        self._retrace_guard = None
 
     # ------------------------------------------------------------------
     def init(self) -> "MultiLayerNetwork":
@@ -241,6 +242,9 @@ class MultiLayerNetwork:
 
     # ------------------------------------------------------------------
     def _build_train_step(self):
+        from deeplearning4j_tpu.common.compilecache import \
+            enable_persistent_cache
+        enable_persistent_cache()    # second process loads, not compiles
         conf = self.conf
         out_layer = self.output_layer_conf
         want_logits = out_layer.wants_logits()
@@ -307,7 +311,12 @@ class MultiLayerNetwork:
                                 getattr(data, "features_mask", None),
                                 getattr(data, "labels_mask", None))
             return self
-        # iterator protocol
+        # iterator protocol: stage batches device-side ahead of the
+        # step loop (no-op when DL4J_TPU_DEVICE_PREFETCH=0 or the
+        # stream is not a resettable iterator)
+        from deeplearning4j_tpu.datasets.prefetch import \
+            maybe_device_prefetch
+        data = maybe_device_prefetch(data, dtype=self._dtype)
         for _ in range(n_epochs):
             for lis in self.listeners:
                 lis.on_epoch_start(self)
@@ -459,6 +468,11 @@ class MultiLayerNetwork:
         y = _as_jnp(y, self._dtype)
         fmask = _as_jnp(fmask) if fmask is not None else None
         lmask = _as_jnp(lmask) if lmask is not None else None
+        if self._retrace_guard is None:
+            from deeplearning4j_tpu.common.compilecache import RetraceGuard
+            self._retrace_guard = RetraceGuard(
+                f"{type(self).__name__} train step")
+        self._retrace_guard.record(x, y, fmask, lmask)
         if self.conf.backprop_type is BackpropType.TRUNCATED_BPTT and \
                 x.ndim == 3:
             return self._fit_tbptt(x, y, fmask, lmask)

@@ -119,6 +119,9 @@ class ParallelInference:
             m.states = replicate_tree(self.mesh, m.states)
             self._placed = True
         if self._fwd is None:
+            from deeplearning4j_tpu.common.compilecache import \
+                enable_persistent_cache
+            enable_persistent_cache()
             from deeplearning4j_tpu.nn.graph import ComputationGraph
             is_graph = isinstance(m, ComputationGraph)
 
@@ -134,30 +137,60 @@ class ParallelInference:
 
             self._fwd = jax.jit(fwd)
 
+    def _place_chunk(self, x):
+        """Pad to a shard multiple and device_put sharded over the mesh
+        (an async dispatch — the H2D DMA proceeds in the background).
+        Returns (placed, original_batch)."""
+        x = jnp.asarray(x)
+        if jnp.issubdtype(x.dtype, jnp.floating):
+            x = x.astype(self.model._dtype)
+        padded, orig = pad_batch_to_multiple(x, self.n_workers)
+        placed = jax.device_put(
+            padded, data_sharding(self.mesh, padded.ndim))
+        return placed, orig
+
     def output(self, x) -> np.ndarray:
         """Run inference on ``x``; pads the batch to a shard multiple and
         slices the padding back off (padding is safe for inference,
         unlike training — mesh.py note)."""
         self._ensure()
-        x = jnp.asarray(x)
-        if jnp.issubdtype(x.dtype, jnp.floating):
-            x = x.astype(self.model._dtype)
-        padded, orig = pad_batch_to_multiple(x, self.n_workers)
-        padded = jax.device_put(
-            padded, data_sharding(self.mesh, padded.ndim))
-        out = self._fwd(self.model.params, self.model.states, padded)
+        placed, orig = self._place_chunk(x)
+        out = self._fwd(self.model.params, self.model.states, placed)
         return np.asarray(out[:orig])
 
     def output_batched(self, requests: List) -> List[np.ndarray]:
         """BATCHED mode: aggregate many small requests into shard-wide
-        batches (the reference's observable queue, synchronously)."""
+        batches (the reference's observable queue, synchronously).
+
+        Chunks are double-buffered: chunk i+1's sharded ``device_put``
+        is dispatched BEFORE the host blocks on chunk i's result, so
+        the next H2D DMA overlaps the current forward + D2H — the
+        DevicePrefetcher discipline applied to the serving path
+        (``DL4J_TPU_DEVICE_PREFETCH=0`` reverts to serial placement)."""
         self._ensure()
-        arrays = [jnp.asarray(r) for r in requests]
+        from deeplearning4j_tpu.common.environment import Environment
+        arrays = [np.asarray(r) for r in requests]
         sizes = [a.shape[0] for a in arrays]
-        big = jnp.concatenate(arrays, axis=0)
+        big = np.concatenate(arrays, axis=0)
+        chunks = [big[i:i + self.batch_limit]
+                  for i in range(0, big.shape[0], self.batch_limit)]
+        overlap = Environment.get().device_prefetch
         outs = []
-        for i in range(0, big.shape[0], self.batch_limit):
-            outs.append(self.output(big[i:i + self.batch_limit]))
+        placed = self._place_chunk(chunks[0]) if chunks else None
+        for i in range(len(chunks)):
+            cur, orig = placed
+            # device compute for the current chunk: dispatched async
+            out = self._fwd(self.model.params, self.model.states, cur)
+            if i + 1 < len(chunks):
+                if overlap:
+                    # stage chunk i+1 while chunk i computes/transfers
+                    placed = self._place_chunk(chunks[i + 1])
+                    outs.append(np.asarray(out[:orig]))   # sync point
+                else:
+                    outs.append(np.asarray(out[:orig]))
+                    placed = self._place_chunk(chunks[i + 1])
+            else:
+                outs.append(np.asarray(out[:orig]))
         flat = np.concatenate(outs, axis=0)
         result, off = [], 0
         for s in sizes:
@@ -183,10 +216,17 @@ class ParallelInference:
                 except BaseException as e:       # noqa: BLE001
                     fut.set_exception(e)
             return fut
+        # the put happens UNDER the lock shutdown() takes to enqueue
+        # its sentinel: a racing submit can therefore never land behind
+        # the sentinel on a dead queue (which would strand its Future
+        # forever). A submit that wins the lock AFTER shutdown sees
+        # _worker None and _ensure_worker restarts the service. The
+        # put can block briefly when the queue is full; the worker
+        # never takes this lock, so it keeps draining and the put
+        # always completes.
         with self._lock:
             self._ensure_worker()
-            q = self._requests
-        q.put((x, fut))
+            self._requests.put((x, fut))
         return fut
 
     def _ensure_worker(self):

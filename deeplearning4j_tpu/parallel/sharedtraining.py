@@ -136,6 +136,13 @@ class SharedTrainingMaster:
         data partition (the analogue of an executor's RDD partition);
         arrays are assembled into globally-sharded batches.
 
+        Global-batch assembly (``make_array_from_process_local_data`` —
+        metadata + local device_puts, no collective) runs on the
+        DevicePrefetcher feeder thread via ``ParallelWrapper.
+        run_epochs``, one batch ahead of the step loop: every process
+        stages its local shard while its chips step, and the processes
+        stay aligned because only the jitted step itself rendezvouses.
+
         With ``checkpoint_dir`` the multi-host save/resume discipline
         (SURVEY.md §5.4) is active: if checkpoints exist there the
         model is RESUMED on every process (same bytes, shared fs) and

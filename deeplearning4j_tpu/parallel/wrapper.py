@@ -154,15 +154,27 @@ class ParallelWrapper:
     def run_epochs(self, iterator, n_epochs, shard_fn):
         """The one epoch/reset/listener loop, parameterized by how each
         batch is placed on the mesh (single-host shard vs multi-host
-        global assembly — SharedTrainingMaster passes its own)."""
+        global assembly — SharedTrainingMaster passes its own).
+
+        Placement runs via DevicePrefetcher a batch ahead of the step
+        loop (feeder-thread on accelerator backends), so the per-shard
+        H2D DMA of batch n+1 overlaps the device step on batch n (the
+        reference's prefetch workers; ``prefetch_buffer`` is the
+        staging depth)."""
         if not self._placed:
             self._place_model()
+        from deeplearning4j_tpu.datasets.prefetch import \
+            maybe_device_prefetch
+        staged = maybe_device_prefetch(iterator, place_fn=shard_fn,
+                                       depth=self.prefetch_buffer)
+        if staged is not iterator:
+            shard_fn = lambda ds: ds     # noqa: E731 — already placed
         for _ in range(n_epochs):
-            if hasattr(iterator, "reset"):
-                iterator.reset()
+            if hasattr(staged, "reset"):
+                staged.reset()
             for lis in self.model.listeners:
                 lis.on_epoch_start(self.model)
-            for ds in iterator:
+            for ds in staged:
                 self.model.fit(shard_fn(ds))
             self.model.epoch_count += 1
             for lis in self.model.listeners:

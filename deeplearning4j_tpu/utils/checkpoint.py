@@ -46,10 +46,19 @@ class _ModelSnapshot:
     def __init__(self, model):
         self.model_class = type(model).__name__
         self.conf = _ModelSnapshot._ConfShim(model.conf.to_json())
-        # device->host transfers (the only part the step loop waits on)
-        self.params = jax.device_get(model.params)
-        self.states = jax.device_get(model.states)
-        self.updater_states = jax.device_get(model.updater_states)
+        # device->host transfers (the only part the step loop waits on).
+        # np.array (copy) is REQUIRED, not np.asarray: on the CPU
+        # backend device_get returns zero-copy VIEWS of the XLA
+        # buffers, and the train step donates params — an executable
+        # that honors the donation (cache-loaded ones do) would mutate
+        # the snapshot in place while the background thread writes it
+        import numpy as _np
+        self.params = jax.tree_util.tree_map(
+            _np.array, jax.device_get(model.params))
+        self.states = jax.tree_util.tree_map(
+            _np.array, jax.device_get(model.states))
+        self.updater_states = jax.tree_util.tree_map(
+            _np.array, jax.device_get(model.updater_states))
         self.iteration_count = model.iteration_count
         self.epoch_count = model.epoch_count
 
@@ -192,11 +201,28 @@ class CheckpointListener(TrainingListener):
         return cps[-1] if cps else None
 
     @staticmethod
+    def _restore_any(cp: Path):
+        """Format-dispatching restore: SameDiff checkpoints (written by
+        ``SameDiff.checkpoint_snapshot`` — a zip with a ``graph.json``
+        entry) load via ``SameDiff.load``; MLN/graph zips via
+        ``ModelSerializer``. Without this, FaultTolerantTrainer resume
+        on a SameDiff job fell into restore_multi_layer_network and
+        failed confusingly (ADVICE.md)."""
+        import zipfile
+        with zipfile.ZipFile(cp) as z:
+            is_samediff = "graph.json" in z.namelist()
+        if is_samediff:
+            from deeplearning4j_tpu.autodiff.samediff import SameDiff
+            return SameDiff.load(str(cp))
+        return ModelSerializer.restore_model(cp)
+
+    @staticmethod
     def load_checkpoint(save_dir_or_path, *, skip_corrupt: bool = True):
         """Load the newest loadable checkpoint (reference:
         loadCheckpointMLN/loadLastCheckpointMLN). With ``skip_corrupt``
         a truncated/partial newest file falls back to the previous one
-        — the §5.3 crash-recovery path."""
+        — the §5.3 crash-recovery path. Dispatches on the zip format:
+        MLN/ComputationGraph and SameDiff checkpoints both load."""
         p = Path(save_dir_or_path)
         candidates = ([p] if p.is_file()
                       else list(reversed(
@@ -204,7 +230,7 @@ class CheckpointListener(TrainingListener):
         last_err = None
         for cp in candidates:
             try:
-                return ModelSerializer.restore_model(cp)
+                return CheckpointListener._restore_any(cp)
             except Exception as e:            # corrupt / partial file
                 last_err = e
                 if not skip_corrupt:

@@ -236,3 +236,46 @@ class TestAsyncCheckpointing:
         lis._save(net)
         with pytest.raises(Exception):
             lis.flush()
+
+
+class TestSameDiffCheckpointRestore:
+    """load_checkpoint must dispatch on the zip format: SameDiff
+    checkpoints (graph.json entry, the r5 CheckpointListener write
+    path) load via SameDiff.load, not ModelSerializer (ADVICE.md)."""
+
+    def _toy_sd(self):
+        from deeplearning4j_tpu.autodiff import SameDiff
+        from deeplearning4j_tpu.autodiff.training import TrainingConfig
+        from deeplearning4j_tpu.learning.updaters import Sgd as SdSgd
+        sd = SameDiff.create()
+        x = sd.placeholder("x", shape=(None, 2))
+        y = sd.placeholder("y", shape=(None, 1))
+        w = sd.var("w", array=np.zeros((2, 1), np.float32))
+        sd.loss.mean_squared_error(y, x @ w, name="loss")
+        sd.set_loss_variables("loss")
+        sd.set_training_config(
+            TrainingConfig.Builder().updater(SdSgd(0.1))
+            .data_set_feature_mapping("x")
+            .data_set_label_mapping("y").build())
+        return sd
+
+    def test_load_checkpoint_dispatches_samediff_zip(self, tmp_path):
+        from deeplearning4j_tpu.autodiff import SameDiff
+        from deeplearning4j_tpu.datasets.iterators import \
+            ListDataSetIterator
+        sd = self._toy_sd()
+        rng = np.random.RandomState(0)
+        x = rng.randn(8, 2).astype(np.float32)
+        ds = _ds(x, (x @ np.array([[1.], [2.]],
+                                  np.float32)).astype(np.float32))
+        sd.fit(ListDataSetIterator([ds]), n_epochs=2)
+        lis = CheckpointListener(tmp_path, asynchronous=False)
+        lis._save(sd)
+        cp = lis.last_checkpoint()
+        restored = CheckpointListener.load_checkpoint(cp)
+        assert isinstance(restored, SameDiff)
+        assert restored.epoch_count == sd.epoch_count
+        assert restored.iteration_count == sd.iteration_count
+        np.testing.assert_array_equal(
+            np.asarray(restored._arrays["w"]),
+            np.asarray(sd._arrays["w"]))

@@ -651,3 +651,24 @@ def test_fuse_attention_skips_multi_consumer_probs():
     sd._op("matmul", [probs, v]).rename("ctx")
     sd.math.reduce_sum(probs, name="viz")          # second consumer
     assert sd.fuse_attention_patterns() == 0
+
+
+def test_shard_placeholders_warns_on_batch_dim_tie(caplog):
+    """Inferred batch-dim votes can tie; the losers are silently
+    replicated (no DP sharding, no divisibility check) — that must at
+    least WARN, pointing at explicit mappings (ADVICE.md r5)."""
+    import logging
+    from conftest import require_devices
+    require_devices(2)
+    from deeplearning4j_tpu.autodiff.samediff import _shard_placeholders
+    from deeplearning4j_tpu.parallel.mesh import make_mesh
+    mesh = make_mesh({"data": 2}, __import__("jax").devices()[:2])
+    ph = {"a": jnp.ones((4, 8)), "b": jnp.ones((6, 8))}
+    with caplog.at_level(logging.WARNING, "deeplearning4j_tpu"):
+        _shard_placeholders(mesh, ph)
+    assert any("tie" in r.message for r in caplog.records)
+    # explicit batch_names: unambiguous, no warning
+    caplog.clear()
+    with caplog.at_level(logging.WARNING, "deeplearning4j_tpu"):
+        _shard_placeholders(mesh, ph, batch_names=["a"])
+    assert not any("tie" in r.message for r in caplog.records)
