@@ -171,6 +171,16 @@ def main():
                   file=sys.stderr)
     except Exception as e:
         print(f"feeding-ladder leg failed: {e!r}", file=sys.stderr)
+    # Telemetry panel: the registry the run's hot paths recorded into
+    # (train-step histogram, compile-cache counters, prefetch stats
+    # when an iterator fed) — the same data /metrics would serve.
+    try:
+        from deeplearning4j_tpu.common.telemetry import MetricsRegistry
+        reg = MetricsRegistry.get()
+        if reg.enabled:
+            line["telemetry"] = reg.summary()
+    except Exception as e:
+        print(f"telemetry leg failed: {e!r}", file=sys.stderr)
     print(json.dumps(line))
 
 

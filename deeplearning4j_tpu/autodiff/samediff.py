@@ -1262,9 +1262,11 @@ class SameDiff:
             self._updater_state = replicate_tree(
                 mesh, self._updater_state)
             rng = replicate_tree(mesh, rng)
-        new_vars, self._updater_state, loss = multi_fn(
-            var_vals, self._updater_state, ph_vals, rng,
-            jnp.asarray(self.iteration_count), n_steps)
+        from deeplearning4j_tpu.common import telemetry
+        with telemetry.step_span("SameDiff", steps=n_steps):
+            new_vars, self._updater_state, loss = multi_fn(
+                var_vals, self._updater_state, ph_vals, rng,
+                jnp.asarray(self.iteration_count), n_steps)
         self._arrays.update(new_vars)
         self.iteration_count += n_steps
         self._score = float(loss)
@@ -1420,9 +1422,11 @@ class SameDiff:
                         self._restore_updater_leaves()
                 var_vals = {n: self._arrays[n] for n in trainable}
                 self._rng, rng = jax.random.split(self._rng)
-                new_vars, self._updater_state, loss = step_fn(
-                    var_vals, self._updater_state, ph_vals,
-                    jnp.asarray(iteration), rng)
+                from deeplearning4j_tpu.common import telemetry
+                with telemetry.step_span("SameDiff"):
+                    new_vars, self._updater_state, loss = step_fn(
+                        var_vals, self._updater_state, ph_vals,
+                        jnp.asarray(iteration), rng)
                 self._arrays.update(new_vars)
                 if self._frozen_captured_vars \
                         and self._frozen_captured_vars & set(new_vars):

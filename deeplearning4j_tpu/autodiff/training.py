@@ -34,8 +34,12 @@ def device_prefetch_placeholders(iterator, make_ph: Callable,
     async step dispatch of the previous batch) on CPU. Feeder
     exceptions re-raise on the consumer; the generator yields dicts
     of device-resident arrays in iterator order."""
+    import time
+
     import jax
     import jax.numpy as jnp
+
+    from deeplearning4j_tpu.common import telemetry
     thread_put = jax.default_backend() != "cpu"
     q: _queue.Queue = _queue.Queue(max(1, int(depth)))
     sentinel = object()
@@ -47,8 +51,16 @@ def device_prefetch_placeholders(iterator, make_ph: Callable,
     def feeder():
         try:
             for batch in iterator:
-                ph = make_ph(batch)
-                q.put(to_dev(ph) if thread_put else ph)
+                with telemetry.span("prefetch.stage",
+                                    source="samediff"):
+                    ph = make_ph(batch)
+                    item = to_dev(ph) if thread_put else ph
+                q.put(item)
+                if telemetry.enabled():
+                    telemetry.counter(
+                        "dl4j_prefetch_batches_staged_total",
+                        "batches staged by the device prefetcher"
+                    ).inc()
             q.put(sentinel)
         except BaseException as e:       # noqa: BLE001 — re-raised below
             q.put(_FeederError(e))
@@ -56,7 +68,13 @@ def device_prefetch_placeholders(iterator, make_ph: Callable,
     threading.Thread(target=feeder, daemon=True,
                      name="dl4j-tpu-samediff-prefetch").start()
     while True:
-        item = q.get()
+        if telemetry.enabled():
+            t0 = time.perf_counter()
+            item = q.get()
+            telemetry.observe_feed_stall(time.perf_counter() - t0,
+                                         source="samediff_prefetch")
+        else:
+            item = q.get()
         if item is sentinel:
             return
         if isinstance(item, _FeederError):

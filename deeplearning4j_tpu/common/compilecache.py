@@ -26,6 +26,7 @@ import os
 import threading
 from typing import Optional
 
+from deeplearning4j_tpu.common import telemetry
 from deeplearning4j_tpu.common.environment import Environment
 
 log = logging.getLogger("deeplearning4j_tpu")
@@ -134,12 +135,37 @@ class RetraceGuard:
                           else Environment.get().retrace_warn_threshold)
         self._sigs: set = set()
         self._warned = False
+        # bound once: record() runs every step, and the hit path must
+        # not pay a registry lookup + label-key build per step
+        self._hits = telemetry.counter(
+            "dl4j_compile_cache_hits_total",
+            "steps whose input signature matched an "
+            "already-compiled program (no retrace)").bind(
+                network=self.name)
 
     def record(self, *batch_arrays) -> None:
         sig = signature_of(*batch_arrays)
         if sig in self._sigs:
+            # known signature: the in-process executable is reused
+            self._hits.inc()
             return
         self._sigs.add(sig)
+        # new signature: jit traces + compiles (the persistent on-disk
+        # cache may still serve the binary — this counts compiles the
+        # PROCESS had to go through, i.e. retrace pressure)
+        telemetry.counter(
+            "dl4j_compile_cache_misses_total",
+            "steps whose input signature was new to this process "
+            "(trace + XLA compile or persistent-cache load)"
+        ).inc(network=self.name)
+        if len(self._sigs) > 1:
+            telemetry.counter(
+                "dl4j_retrace_total",
+                "recompiles past a network's first signature "
+                "(shape/dtype churn)").inc(network=self.name)
+            telemetry.instant("retrace", network=self.name,
+                              signature=repr(sig),
+                              n_signatures=len(self._sigs))
         if not self._warned and len(self._sigs) > self.threshold:
             self._warned = True
             log.warning(

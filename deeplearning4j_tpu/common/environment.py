@@ -37,6 +37,9 @@ class _Env:
     # warn after this many distinct compiled input signatures per
     # network (shape churn -> retrace storm; pad or bucket instead)
     retrace_warn_threshold: int = 5
+    # unified telemetry spine (common.telemetry): metrics registry +
+    # chrome-trace spans across train/infer/ETL; /metrics on UIServer
+    telemetry: bool = True
     extra: dict = field(default_factory=dict)
 
     def set_debug(self, v: bool):
@@ -57,7 +60,7 @@ class Environment:
       DL4J_TPU_CHECK_NAN, DL4J_TPU_CHECK_INF, DL4J_TPU_ALLOW_HELPERS,
       DL4J_TPU_DEVICE_PREFETCH, DL4J_TPU_DEVICE_PREFETCH_DEPTH,
       DL4J_TPU_COMPILE_CACHE, DL4J_TPU_COMPILE_CACHE_DIR,
-      DL4J_TPU_RETRACE_WARN
+      DL4J_TPU_RETRACE_WARN, DL4J_TPU_TELEMETRY
     """
 
     _inst: _Env | None = None
@@ -88,6 +91,7 @@ class Environment:
                         "DL4J_TPU_COMPILE_CACHE_DIR", ""),
                     retrace_warn_threshold=int(os.environ.get(
                         "DL4J_TPU_RETRACE_WARN", "5")),
+                    telemetry=b("DL4J_TPU_TELEMETRY", True),
                 )
             return cls._inst
 

@@ -1,0 +1,568 @@
+"""Unified telemetry spine: metrics registry + one-timeline tracing.
+
+The reference stack's training-health story (StatsListener,
+PerformanceListener, the Vert.x UI — SURVEY.md D7/D17) observes the
+train loop only.  The perf-critical subsystems grown since (device
+prefetcher, compile cache, batched serving) were invisible outside
+one-off benchmarks; this module is the process-wide instrument panel
+they all report into — the TVM "measure, then tune" discipline
+(PAPERS.md 1802.04799) applied to the runtime itself.
+
+Three pieces:
+
+- :class:`MetricsRegistry` — a thread-safe, process-wide registry of
+  labeled :class:`Counter`/:class:`Gauge`/:class:`Histogram` metrics.
+  Every hot path (prefetch feeder, fit funnels, serving queue,
+  checkpoint writer) records into it; it renders as a Prometheus
+  text-format page (``UIServer`` serves it at ``/metrics``), folds into
+  ``ui.stats`` reports via :class:`MetricsReporterListener`, and lands
+  in ``bench.py`` JSON via :meth:`MetricsRegistry.summary`.
+- :func:`span` — a context manager recording wall-clock spans into a
+  shared chrome-trace event buffer, in the SAME format
+  ``ui.profiling.ProfilingListener`` emits, so host spans, feeder-
+  thread spans, and ``jax.profiler`` TPU traces load into one
+  chrome://tracing / Perfetto timeline.  :func:`export_chrome_trace`
+  writes the buffer; :func:`merge_chrome_traces` folds several trace
+  files (ours or jax.profiler's) into one.
+- ``DL4J_TPU_TELEMETRY`` gate (default on) — when off, every record
+  call is a single attribute check and spans don't allocate
+  (``benchmarks/bench_telemetry.py`` is the overhead microbench).
+
+Metric names follow Prometheus conventions (``dl4j_`` namespace,
+``_seconds``/``_bytes``/``_total`` unit suffixes); the catalog lives in
+README "Observability" and ``scripts/check_telemetry_catalog.py`` keeps
+code and catalog honest.
+"""
+from __future__ import annotations
+
+import gzip
+import json
+import logging
+import os
+import threading
+import time
+from contextlib import contextmanager
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from deeplearning4j_tpu.common.environment import Environment
+from deeplearning4j_tpu.optimize.listeners import TrainingListener
+
+log = logging.getLogger("deeplearning4j_tpu")
+
+#: default latency buckets (seconds) — microseconds (counter overhead,
+#: queue pops) up to tens of seconds (BERT-scale compiles, checkpoints)
+DEFAULT_BUCKETS = (1e-5, 2.5e-5, 5e-5, 1e-4, 2.5e-4, 5e-4,
+                   1e-3, 2.5e-3, 5e-3, 1e-2, 2.5e-2, 5e-2,
+                   0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 30.0)
+
+#: buckets for 0..1 ratios (batch occupancy)
+RATIO_BUCKETS = (0.1, 0.25, 0.5, 0.75, 0.9, 1.0)
+
+_LabelKey = Tuple[Tuple[str, str], ...]
+
+
+def _label_key(labels: dict) -> _LabelKey:
+    return tuple(sorted((k, str(v)) for k, v in labels.items()))
+
+
+def _render_labels(key: _LabelKey, extra: str = "") -> str:
+    parts = [f'{k}="{v}"' for k, v in key]
+    if extra:
+        parts.append(extra)
+    return "{" + ",".join(parts) + "}" if parts else ""
+
+
+class _Metric:
+    """Base: name, help text, per-registry enabled flag shared by
+    reference (the registry flips ``_state['on']`` for all metrics at
+    once — record calls check one dict slot, no lock)."""
+
+    kind = "untyped"
+
+    def __init__(self, name: str, help: str, state: dict):
+        self.name = name
+        self.help = help
+        self._state = state        # {'on': bool}, shared with registry
+        self._lock = threading.Lock()
+        self._series: Dict[_LabelKey, object] = {}
+
+
+class Counter(_Metric):
+    kind = "counter"
+
+    def inc(self, amount: float = 1, **labels) -> None:
+        if not self._state["on"]:
+            return
+        key = _label_key(labels)
+        with self._lock:
+            self._series[key] = self._series.get(key, 0) + amount
+
+    def bind(self, **labels) -> "_BoundCounter":
+        """Pre-resolve a label set for per-step hot paths (see
+        Histogram.bind)."""
+        return _BoundCounter(self, _label_key(labels))
+
+    def value(self, **labels) -> float:
+        return self._series.get(_label_key(labels), 0)
+
+    def _render(self) -> List[str]:
+        return [f"{self.name}{_render_labels(k)} {v:g}"
+                for k, v in sorted(self._series.items())]
+
+    def _snapshot(self):
+        return {";".join(f"{k}={v}" for k, v in key) or "": val
+                for key, val in self._series.items()}
+
+
+class Gauge(_Metric):
+    kind = "gauge"
+
+    def set(self, value: float, **labels) -> None:
+        if not self._state["on"]:
+            return
+        with self._lock:
+            self._series[_label_key(labels)] = value
+
+    def inc(self, amount: float = 1, **labels) -> None:
+        if not self._state["on"]:
+            return
+        key = _label_key(labels)
+        with self._lock:
+            self._series[key] = self._series.get(key, 0) + amount
+
+    def dec(self, amount: float = 1, **labels) -> None:
+        self.inc(-amount, **labels)
+
+    def value(self, **labels) -> float:
+        return self._series.get(_label_key(labels), 0)
+
+    def _render(self) -> List[str]:
+        return [f"{self.name}{_render_labels(k)} {v:g}"
+                for k, v in sorted(self._series.items())]
+
+    def _snapshot(self):
+        return {";".join(f"{k}={v}" for k, v in key) or "": val
+                for key, val in self._series.items()}
+
+
+class _HistSeries:
+    __slots__ = ("counts", "sum", "count")
+
+    def __init__(self, n_buckets: int):
+        self.counts = [0] * (n_buckets + 1)     # last slot = +Inf
+        self.sum = 0.0
+        self.count = 0
+
+
+class Histogram(_Metric):
+    """Fixed-bucket histogram (Prometheus classic): per-label-set
+    bucket counts + sum + count; rendering is cumulative with the
+    ``le`` label, as scrapers expect."""
+
+    kind = "histogram"
+
+    def __init__(self, name, help, state,
+                 buckets: Sequence[float] = DEFAULT_BUCKETS):
+        super().__init__(name, help, state)
+        self.buckets = tuple(sorted(buckets))
+
+    def observe(self, value: float, **labels) -> None:
+        if not self._state["on"]:
+            return
+        self._observe_key(_label_key(labels), value)
+
+    def _observe_key(self, key: _LabelKey, value: float) -> None:
+        with self._lock:
+            s = self._series.get(key)
+            if s is None:
+                s = self._series[key] = _HistSeries(len(self.buckets))
+            i = 0
+            for b in self.buckets:          # linear scan: ~20 buckets,
+                if value <= b:              # cheaper than bisect setup
+                    break
+                i += 1
+            s.counts[i] += 1
+            s.sum += value
+            s.count += 1
+
+    def bind(self, **labels) -> "_BoundHistogram":
+        """Pre-resolve a label set: the returned handle's ``observe``
+        skips per-call label-key construction — for per-step hot
+        paths (step_span caches one per model name)."""
+        return _BoundHistogram(self, _label_key(labels))
+
+    @contextmanager
+    def time(self, **labels):
+        """Observe the wall-clock duration of the with-block."""
+        if not self._state["on"]:
+            yield
+            return
+        t0 = time.perf_counter()
+        try:
+            yield
+        finally:
+            self.observe(time.perf_counter() - t0, **labels)
+
+    def count_of(self, **labels) -> int:
+        s = self._series.get(_label_key(labels))
+        return s.count if s else 0
+
+    def sum_of(self, **labels) -> float:
+        s = self._series.get(_label_key(labels))
+        return s.sum if s else 0.0
+
+    def _render(self) -> List[str]:
+        out = []
+        for key, s in sorted(self._series.items()):
+            cum = 0
+            for b, c in zip(self.buckets, s.counts):
+                cum += c
+                le = 'le="%g"' % b
+                out.append(f"{self.name}_bucket"
+                           f"{_render_labels(key, le)} {cum}")
+            inf = 'le="+Inf"'
+            out.append(f"{self.name}_bucket"
+                       f"{_render_labels(key, inf)} {s.count}")
+            out.append(f"{self.name}_sum{_render_labels(key)} {s.sum:g}")
+            out.append(f"{self.name}_count{_render_labels(key)}"
+                       f" {s.count}")
+        return out
+
+    def _snapshot(self):
+        return {";".join(f"{k}={v}" for k, v in key) or "": {
+                    "count": s.count, "sum": s.sum,
+                    "mean": (s.sum / s.count if s.count else 0.0)}
+                for key, s in self._series.items()}
+
+
+class _BoundHistogram:
+    __slots__ = ("_h", "_key")
+
+    def __init__(self, h: Histogram, key: _LabelKey):
+        self._h = h
+        self._key = key
+
+    def observe(self, value: float) -> None:
+        if self._h._state["on"]:
+            self._h._observe_key(self._key, value)
+
+
+class _BoundCounter:
+    __slots__ = ("_c", "_key")
+
+    def __init__(self, c: Counter, key: _LabelKey):
+        self._c = c
+        self._key = key
+
+    def inc(self, amount: float = 1) -> None:
+        c = self._c
+        if c._state["on"]:
+            with c._lock:
+                c._series[self._key] = \
+                    c._series.get(self._key, 0) + amount
+
+
+class MetricsRegistry:
+    """Process-wide, thread-safe metric registry.  Registration is
+    idempotent: ``counter(name, ...)`` returns the existing metric when
+    ``name`` is already registered (instrument sites in different
+    modules share series by name), and raises on a kind mismatch."""
+
+    _instance: Optional["MetricsRegistry"] = None
+    _instance_lock = threading.Lock()
+
+    def __init__(self, enabled: Optional[bool] = None):
+        if enabled is None:
+            enabled = Environment.get().telemetry
+        self._state = {"on": bool(enabled)}
+        self._lock = threading.Lock()
+        self._metrics: Dict[str, _Metric] = {}
+
+    @classmethod
+    def get(cls) -> "MetricsRegistry":
+        inst = cls._instance
+        if inst is not None:
+            return inst
+        with cls._instance_lock:
+            if cls._instance is None:
+                cls._instance = cls()
+            return cls._instance
+
+    @classmethod
+    def _reset_for_tests(cls):
+        """Drop the singleton (and the trace buffer) so a test sees a
+        clean panel; the next ``get()`` re-reads the env gate."""
+        with cls._instance_lock:
+            cls._instance = None
+        _trace_buffer.clear()
+
+    # -- gate ----------------------------------------------------------
+    @property
+    def enabled(self) -> bool:
+        return self._state["on"]
+
+    def set_enabled(self, on: bool) -> None:
+        self._state["on"] = bool(on)
+
+    # -- registration --------------------------------------------------
+    def _register(self, cls, name, help, **kw) -> _Metric:
+        with self._lock:
+            m = self._metrics.get(name)
+            if m is not None:
+                if not isinstance(m, cls):
+                    raise ValueError(
+                        f"metric {name!r} already registered as "
+                        f"{m.kind}, not {cls.kind}")
+                return m
+            m = cls(name, help, self._state, **kw)
+            self._metrics[name] = m
+            return m
+
+    def counter(self, name: str, help: str = "") -> Counter:
+        return self._register(Counter, name, help)
+
+    def gauge(self, name: str, help: str = "") -> Gauge:
+        return self._register(Gauge, name, help)
+
+    def histogram(self, name: str, help: str = "",
+                  buckets: Sequence[float] = DEFAULT_BUCKETS
+                  ) -> Histogram:
+        return self._register(Histogram, name, help, buckets=buckets)
+
+    # -- export --------------------------------------------------------
+    def render_prometheus(self) -> str:
+        """Prometheus text exposition format (0.0.4)."""
+        lines: List[str] = []
+        with self._lock:
+            metrics = sorted(self._metrics.values(),
+                             key=lambda m: m.name)
+        for m in metrics:
+            lines.append(f"# HELP {m.name} {m.help}")
+            lines.append(f"# TYPE {m.name} {m.kind}")
+            lines.extend(m._render())
+        return "\n".join(lines) + "\n"
+
+    def snapshot(self) -> dict:
+        """{name: {labelkey: value-or-hist-summary}} — the raw panel,
+        JSON-serializable (MetricsReporterListener report payload)."""
+        with self._lock:
+            metrics = list(self._metrics.values())
+        return {m.name: m._snapshot() for m in metrics}
+
+    def summary(self) -> dict:
+        """Compact snapshot for bench.py JSON: drops empty metrics."""
+        return {k: v for k, v in self.snapshot().items() if v}
+
+
+# ----------------------------------------------------------------------
+# module-level conveniences: instrument sites call these; they resolve
+# the singleton and are idempotent per metric name
+def counter(name: str, help: str = "") -> Counter:
+    return MetricsRegistry.get().counter(name, help)
+
+
+def gauge(name: str, help: str = "") -> Gauge:
+    return MetricsRegistry.get().gauge(name, help)
+
+
+def histogram(name: str, help: str = "",
+              buckets: Sequence[float] = DEFAULT_BUCKETS) -> Histogram:
+    return MetricsRegistry.get().histogram(name, help, buckets=buckets)
+
+
+def enabled() -> bool:
+    return MetricsRegistry.get().enabled
+
+
+# ----------------------------------------------------------------------
+# one-timeline tracing: a shared chrome-trace event buffer, same event
+# schema as ui.profiling.ProfilingListener so everything merges
+class _TraceBuffer:
+    def __init__(self, max_events: int = 200_000):
+        self.max_events = int(os.environ.get(
+            "DL4J_TPU_TELEMETRY_MAX_EVENTS", str(max_events)))
+        self._lock = threading.Lock()
+        self.events: List[dict] = []
+        self.dropped = 0
+
+    def append(self, ev: dict) -> None:
+        with self._lock:
+            if len(self.events) >= self.max_events:
+                self.dropped += 1
+                return
+            self.events.append(ev)
+
+    def clear(self) -> None:
+        with self._lock:
+            self.events = []
+            self.dropped = 0
+
+
+_trace_buffer = _TraceBuffer()
+
+
+@contextmanager
+def span(name: str, **attrs):
+    """Record a wall-clock chrome-trace span ("X" event) for the
+    with-block onto THIS thread's timeline row.  Near-free when
+    telemetry is off.  Attrs land in the event's ``args`` and show in
+    the trace viewer's detail pane."""
+    if not MetricsRegistry.get().enabled:
+        yield
+        return
+    t0 = time.time()
+    try:
+        yield
+    finally:
+        t1 = time.time()
+        _trace_buffer.append({
+            "name": name, "ph": "X", "pid": os.getpid(),
+            "tid": threading.get_ident() & 0xFFFF,
+            "ts": int(t0 * 1e6), "dur": int((t1 - t0) * 1e6),
+            "args": attrs})
+
+
+def instant(name: str, **attrs) -> None:
+    """Record a zero-duration chrome-trace instant event (retraces,
+    cache evictions — things with a WHEN but no duration)."""
+    if not MetricsRegistry.get().enabled:
+        return
+    _trace_buffer.append({
+        "name": name, "ph": "i", "s": "p", "pid": os.getpid(),
+        "tid": threading.get_ident() & 0xFFFF,
+        "ts": int(time.time() * 1e6), "args": attrs})
+
+
+def trace_events() -> List[dict]:
+    return list(_trace_buffer.events)
+
+
+def export_chrome_trace(path: str) -> str:
+    """Write the shared span buffer as chrome://tracing JSON (the
+    format ProfilingListener and jax.profiler also emit)."""
+    with _trace_buffer._lock:
+        events = list(_trace_buffer.events)
+        dropped = _trace_buffer.dropped
+    doc = {"traceEvents": events, "displayTimeUnit": "ms",
+           "metadata": {"dropped_events": dropped}}
+    with open(path, "w") as f:
+        json.dump(doc, f)
+    return path
+
+
+def _load_trace(path: str) -> dict:
+    op = gzip.open if str(path).endswith(".gz") else open
+    with op(path, "rt") as f:
+        doc = json.load(f)
+    return doc if isinstance(doc, dict) else {"traceEvents": doc}
+
+
+def merge_chrome_traces(output_path: str, *paths: str) -> str:
+    """Concatenate the traceEvents of several chrome-trace files —
+    telemetry spans, ProfilingListener iteration spans, and a
+    ``jax.profiler`` trace (``.trace.json.gz`` under its log dir) —
+    into ONE file whose timeline shows host and device side by side.
+    Events already share the epoch-microsecond clock; pids/tids keep
+    the sources on separate rows."""
+    events: List[dict] = []
+    meta: dict = {}
+    for p in paths:
+        doc = _load_trace(p)
+        events.extend(doc.get("traceEvents", []))
+        meta.update(doc.get("metadata", {}))
+    doc = {"traceEvents": events, "displayTimeUnit": "ms",
+           "metadata": meta}
+    with open(output_path, "w") as f:
+        json.dump(doc, f)
+    return output_path
+
+
+_STEP_HELP = ("host-observed train-step wall time: dispatch plus "
+              "whatever sync the funnel performs (seconds)")
+
+
+class _StepSpan:
+    """The fit-funnel instrumentation point: times the with-block into
+    the ``dl4j_train_step_seconds`` histogram (labeled by model class)
+    AND records a ``train_step`` chrome-trace span — one call site per
+    funnel keeps MLN/graph/SameDiff step timing comparable.
+
+    Hand-rolled (slots, cached bound histogram per model name) rather
+    than @contextmanager: this runs once per train step, and the <1%
+    overhead budget is measured against millisecond steps."""
+
+    __slots__ = ("model", "attrs", "_bound", "t0", "p0")
+
+    def __init__(self, model: str, attrs: dict):
+        self.model = model
+        self.attrs = attrs
+
+    def __enter__(self):
+        reg = MetricsRegistry.get()
+        if not reg._state["on"]:
+            self._bound = None
+            return self
+        cache = reg.__dict__.setdefault("_step_bound", {})
+        b = cache.get(self.model)
+        if b is None:
+            b = cache[self.model] = histogram(
+                "dl4j_train_step_seconds",
+                _STEP_HELP).bind(model=self.model)
+        self._bound = b
+        self.t0 = time.time()
+        self.p0 = time.perf_counter()
+        return self
+
+    def __exit__(self, *exc):
+        if self._bound is None:
+            return False
+        dt = time.perf_counter() - self.p0
+        self._bound.observe(dt)
+        _trace_buffer.append({
+            "name": "train_step", "ph": "X", "pid": os.getpid(),
+            "tid": threading.get_ident() & 0xFFFF,
+            "ts": int(self.t0 * 1e6), "dur": int(dt * 1e6),
+            "args": {"model": self.model, **self.attrs}})
+        return False
+
+
+def step_span(model: str, **attrs) -> _StepSpan:
+    return _StepSpan(model, attrs)
+
+
+def observe_feed_stall(seconds: float, source: str) -> None:
+    """Time a consumer spent blocked waiting for its next batch —
+    non-zero buckets here mean the input pipeline, not the device, is
+    the bottleneck (the ladder `benchmarks/bench_input_pipeline.py`
+    measures, now visible in production runs)."""
+    histogram("dl4j_feed_stall_seconds",
+              "time the step loop waited on the input pipeline for "
+              "its next batch (seconds)").observe(seconds,
+                                                  source=source)
+
+
+# ----------------------------------------------------------------------
+class MetricsReporterListener(TrainingListener):
+    """Folds registry snapshots into ``ui.stats`` reports every
+    ``frequency`` iterations, so the dashboard (and anything tailing a
+    FileStatsStorage JSONL) charts runtime metrics — queue depths,
+    cache hits, step-time quantiles — alongside score curves.  Attach
+    like any TrainingListener; reports carry a ``telemetry`` key."""
+
+    def __init__(self, storage=None, frequency: int = 10):
+        if storage is None:
+            from deeplearning4j_tpu.ui.stats import InMemoryStatsStorage
+            storage = InMemoryStatsStorage()
+        self.storage = storage
+        self.frequency = max(1, int(frequency))
+
+    def iteration_done(self, model, iteration: int, epoch: int):
+        if iteration % self.frequency:
+            return
+        self.storage.put_report({
+            "iteration": iteration,
+            "epoch": epoch,
+            "time": time.time(),
+            "score": float(model.score()),
+            "layers": {},
+            "telemetry": MetricsRegistry.get().summary()})

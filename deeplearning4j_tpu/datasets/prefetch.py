@@ -51,10 +51,12 @@ import copy
 import logging
 import queue
 import threading
+import time
 from typing import Callable, Optional
 
 import numpy as np
 
+from deeplearning4j_tpu.common import telemetry
 from deeplearning4j_tpu.common.environment import Environment
 from deeplearning4j_tpu.datasets.iterators import (AsyncDataSetIterator,
                                                    DataSetIterator)
@@ -169,13 +171,34 @@ class DevicePrefetcher(DataSetIterator):
         try:
             self._base.reset()
             while self._base.has_next():
-                ds = self._cast_host(self._base.next())
-                if thread_put:
-                    ds = self._put(ds)
+                with telemetry.span("prefetch.stage"):
+                    ds = self._cast_host(self._base.next())
+                    if thread_put:
+                        ds = self._timed_put(ds)
                 q.put(ds)
+                if telemetry.enabled():
+                    telemetry.counter(
+                        "dl4j_prefetch_batches_staged_total",
+                        "batches staged by the device prefetcher"
+                    ).inc()
+                    telemetry.gauge(
+                        "dl4j_prefetch_queue_depth",
+                        "staged batches currently queued ahead of the "
+                        "step loop").set(q.qsize())
             q.put(self._SENTINEL)
         except BaseException as e:       # noqa: BLE001 — re-raised on
             q.put(_FeederError(e))       # the consumer thread
+
+    def _timed_put(self, ds):
+        if not telemetry.enabled():
+            return self._put(ds)
+        t0 = time.perf_counter()
+        out = self._put(ds)
+        telemetry.histogram(
+            "dl4j_prefetch_device_put_seconds",
+            "host->device staging dispatch time per batch (seconds)"
+        ).observe(time.perf_counter() - t0)
+        return out
 
     def reset(self):
         t = self._thread
@@ -208,14 +231,25 @@ class DevicePrefetcher(DataSetIterator):
         """Pull the next batch and — in consumer-put mode — issue its
         H2D now, BEFORE the caller dispatches the step on the batch we
         just handed out: transfer n+1 overlaps step n."""
-        item = self._queue.get()
+        if telemetry.enabled():
+            t0 = time.perf_counter()
+            item = self._queue.get()
+            telemetry.observe_feed_stall(time.perf_counter() - t0,
+                                         source="device_prefetch")
+            telemetry.gauge(
+                "dl4j_prefetch_queue_depth",
+                "staged batches currently queued ahead of the step "
+                "loop").set(self._queue.qsize())
+        else:
+            item = self._queue.get()
         if isinstance(item, _FeederError):
             self._error = item.exc
             self._next = None
         elif item is self._SENTINEL:
             self._next = None
         else:
-            self._next = item if self._thread_put else self._put(item)
+            self._next = item if self._thread_put else \
+                self._timed_put(item)
 
     def has_next(self) -> bool:
         if not self._started:

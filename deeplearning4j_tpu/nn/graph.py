@@ -529,12 +529,15 @@ class ComputationGraph:
         states_in = self._with_zero_rnn_states(self.states,
                                                int(inputs[0].shape[0]))
         rng = self._next_rng()
-        self.params, new_states, self.updater_states, loss = \
-            self._multi_steps[steps](self.params, states_in,
-                                     self.updater_states, inputs,
-                                     labels,
-                                     jnp.asarray(self.iteration_count),
-                                     rng)
+        from deeplearning4j_tpu.common import telemetry
+        with telemetry.step_span("ComputationGraph", steps=steps):
+            self.params, new_states, self.updater_states, loss = \
+                self._multi_steps[steps](self.params, states_in,
+                                         self.updater_states, inputs,
+                                         labels,
+                                         jnp.asarray(
+                                             self.iteration_count),
+                                         rng)
         self.states = self._strip_rnn_states(new_states)
         self._score = loss
         self.last_batch_size = int(inputs[0].shape[0])
@@ -570,11 +573,13 @@ class ComputationGraph:
         rng = self._next_rng()
         states_in = self._with_zero_rnn_states(self.states,
                                                int(inputs[0].shape[0]))
-        self.params, new_states, self.updater_states, loss = \
-            self._train_step(self.params, states_in,
-                             self.updater_states, inputs, labels, fmask,
-                             lmasks, jnp.asarray(self.iteration_count),
-                             rng)
+        from deeplearning4j_tpu.common import telemetry
+        with telemetry.step_span("ComputationGraph"):
+            self.params, new_states, self.updater_states, loss = \
+                self._train_step(self.params, states_in,
+                                 self.updater_states, inputs, labels,
+                                 fmask, lmasks,
+                                 jnp.asarray(self.iteration_count), rng)
         self.states = self._strip_rnn_states(new_states)
         self._score = loss          # device scalar; float() on read
         self.last_batch_size = int(inputs[0].shape[0])

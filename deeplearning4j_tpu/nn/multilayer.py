@@ -376,11 +376,14 @@ class MultiLayerNetwork:
         states_in = self._with_zero_rnn_states(self.states,
                                                int(x.shape[0]))
         self._rng, rng = jax.random.split(self._rng)
-        self.params, new_states, self.updater_states, loss = \
-            self._multi_steps[steps](self.params, states_in,
-                                     self.updater_states, x, y,
-                                     jnp.asarray(self.iteration_count),
-                                     rng)
+        from deeplearning4j_tpu.common import telemetry
+        with telemetry.step_span("MultiLayerNetwork", steps=steps):
+            self.params, new_states, self.updater_states, loss = \
+                self._multi_steps[steps](self.params, states_in,
+                                         self.updater_states, x, y,
+                                         jnp.asarray(
+                                             self.iteration_count),
+                                         rng)
         self.states = self._strip_rnn_states(new_states)
         self._score = loss
         self.last_batch_size = int(x.shape[0])
@@ -479,10 +482,12 @@ class MultiLayerNetwork:
         self._rng, rng = jax.random.split(self._rng)
         states_in = self._with_zero_rnn_states(self.states,
                                                int(x.shape[0]))
-        self.params, new_states, self.updater_states, loss = \
-            self._train_step(self.params, states_in, self.updater_states,
-                             x, y, fmask, lmask,
-                             jnp.asarray(self.iteration_count), rng)
+        from deeplearning4j_tpu.common import telemetry
+        with telemetry.step_span("MultiLayerNetwork"):
+            self.params, new_states, self.updater_states, loss = \
+                self._train_step(self.params, states_in,
+                                 self.updater_states, x, y, fmask, lmask,
+                                 jnp.asarray(self.iteration_count), rng)
         # standard BPTT: recurrent state resets every minibatch
         # (reference: fit() clears rnn state); BN stats persist
         self.states = self._strip_rnn_states(new_states)

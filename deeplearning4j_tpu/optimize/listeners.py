@@ -31,24 +31,37 @@ class TrainingListener:
 
 
 class ScoreIterationListener(TrainingListener):
-    """Log score every N iterations (reference: same name)."""
+    """Log score every N iterations (reference: same name).
 
-    def __init__(self, print_iterations: int = 10):
+    Emits through the ``deeplearning4j_tpu`` logger only; pass
+    ``stdout=True`` to ALSO print (the old behavior double-emitted
+    every message via both channels, spamming production stdout)."""
+
+    def __init__(self, print_iterations: int = 10, *,
+                 stdout: bool = False):
         self.print_iterations = max(1, int(print_iterations))
+        self.stdout = stdout
 
     def iteration_done(self, model, iteration, epoch):
         if iteration % self.print_iterations == 0:
             log.info("Score at iteration %d is %s", iteration,
                      model.score())
-            print(f"Score at iteration {iteration} is {model.score()}")
+            if self.stdout:
+                print(f"Score at iteration {iteration} is "
+                      f"{model.score()}")
 
 
 class PerformanceListener(TrainingListener):
-    """Throughput/iteration-time sampling (reference: same name)."""
+    """Throughput/iteration-time sampling (reference: same name).
 
-    def __init__(self, frequency: int = 10, report_samples: bool = True):
+    Logs only, like :class:`ScoreIterationListener`; ``stdout=True``
+    opts into printing as well."""
+
+    def __init__(self, frequency: int = 10, report_samples: bool = True,
+                 *, stdout: bool = False):
         self.frequency = max(1, int(frequency))
         self.report_samples = report_samples
+        self.stdout = stdout
         self._last_time = None
         self._last_iter = None
         self._examples = 0
@@ -65,7 +78,8 @@ class PerformanceListener(TrainingListener):
                        + (f", {self._examples / dt:.1f} samples/sec"
                           if self.report_samples else ""))
                 log.info(msg)
-                print(msg)
+                if self.stdout:
+                    print(msg)
             self._last_time = now
             self._last_iter = iteration
             self._examples = 0

@@ -22,6 +22,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from deeplearning4j_tpu.common import telemetry
 from deeplearning4j_tpu.parallel.mesh import (DEFAULT_DATA_AXIS,
                                               data_sharding, make_mesh,
                                               pad_batch_to_multiple,
@@ -209,6 +210,10 @@ class ParallelInference:
         serving loop. INPLACE/SEQUENTIAL run the request directly
         (no queue, no cross-request aggregation)."""
         fut: concurrent.futures.Future = concurrent.futures.Future()
+        telemetry.counter(
+            "dl4j_inference_requests_total",
+            "requests submitted to ParallelInference").inc(
+                mode=self.inference_mode)
         if self.inference_mode != InferenceMode.BATCHED:
             if fut.set_running_or_notify_cancel():
                 try:
@@ -226,7 +231,7 @@ class ParallelInference:
         # always completes.
         with self._lock:
             self._ensure_worker()
-            self._requests.put((x, fut))
+            self._requests.put((x, fut, time.monotonic()))
         return fut
 
     def _ensure_worker(self):
@@ -269,17 +274,32 @@ class ParallelInference:
         # a caller may have cancelled its future while queued (client
         # timeout) — skip those; one cancelled request must not kill
         # the worker or starve its batch-mates
-        live = [(x, f) for x, f in batch
+        live = [(x, f, t) for x, f, t in batch
                 if f.set_running_or_notify_cancel()]
         if not live:
             return
+        if telemetry.enabled():
+            now = time.monotonic()
+            lat = telemetry.histogram(
+                "dl4j_inference_queue_seconds",
+                "submit-to-flush latency of a queued request "
+                "(seconds)")
+            for _, _, t in live:
+                lat.observe(now - t)
+            telemetry.histogram(
+                "dl4j_inference_batch_occupancy",
+                "aggregated-batch fill fraction per flush "
+                "(requests / batch_limit)",
+                buckets=telemetry.RATIO_BUCKETS).observe(
+                    len(live) / max(1, self.batch_limit))
         try:
-            outs = self.output_batched([x for x, _ in live])
+            with telemetry.span("inference.flush", requests=len(live)):
+                outs = self.output_batched([x for x, _, _ in live])
         except BaseException as e:           # noqa: BLE001
-            for _, f in live:
+            for _, f, _ in live:
                 f.set_exception(e)
             return
-        for (_, f), o in zip(live, outs):
+        for (_, f, _), o in zip(live, outs):
             f.set_result(o)
 
     def shutdown(self):

@@ -36,6 +36,7 @@ from typing import Optional
 import jax
 import jax.numpy as jnp
 
+from deeplearning4j_tpu.common import telemetry
 from deeplearning4j_tpu.parallel.encoding import (AdaptiveThresholdAlgorithm,
                                                   ThresholdAlgorithm)
 from deeplearning4j_tpu.parallel.mesh import DEFAULT_DATA_AXIS, make_mesh
@@ -157,6 +158,10 @@ class SharedTrainingMaster:
                      "(BASELINE north star); see parallel.encoding for the "
                      "compression transform")
         mesh = self._global_mesh()
+        telemetry.gauge(
+            "dl4j_dp_workers",
+            "devices participating in the data-parallel mesh").set(
+                mesh.size, master=type(self).__name__)
         mgr = None
         if checkpoint_dir is not None:
             from deeplearning4j_tpu.utils.checkpoint import (
@@ -193,6 +198,11 @@ class SharedTrainingMaster:
         return model
 
     def _make_global(self, mesh, ds):
+        with telemetry.span("dp.global_assembly",
+                            processes=jax.process_count()):
+            return self._make_global_inner(mesh, ds)
+
+    def _make_global_inner(self, mesh, ds):
         from deeplearning4j_tpu.parallel.mesh import (data_sharding,
                                                       map_dataset_arrays)
         n_local = max(len(jax.local_devices()), 1)

@@ -19,11 +19,13 @@ averaging and threshold-encoded (lossy) modes. `averagingFrequency` /
 from __future__ import annotations
 
 import logging
+import time
 from typing import Optional
 
 import jax
 import jax.numpy as jnp
 
+from deeplearning4j_tpu.common import telemetry
 from deeplearning4j_tpu.parallel.mesh import (DEFAULT_DATA_AXIS, make_mesh,
                                               data_sharding,
                                               map_dataset_arrays,
@@ -165,6 +167,8 @@ class ParallelWrapper:
             self._place_model()
         from deeplearning4j_tpu.datasets.prefetch import \
             maybe_device_prefetch
+        n = self.n_workers
+        shard_fn = self._timed_place(shard_fn, n)
         staged = maybe_device_prefetch(iterator, place_fn=shard_fn,
                                        depth=self.prefetch_buffer)
         if staged is not iterator:
@@ -175,11 +179,44 @@ class ParallelWrapper:
             for lis in self.model.listeners:
                 lis.on_epoch_start(self.model)
             for ds in staged:
-                self.model.fit(shard_fn(ds))
+                ds = shard_fn(ds)
+                if telemetry.enabled():
+                    # the sharded step COMPILES the gradient all-reduce
+                    # in (psum over the data axis) — this is the whole
+                    # replica-sync step the reference's trainer threads
+                    # + averaging round performed
+                    t0 = time.perf_counter()
+                    self.model.fit(ds)
+                    telemetry.histogram(
+                        "dl4j_dp_step_seconds",
+                        "data-parallel sharded step wall time incl. "
+                        "the fused in-step gradient all-reduce "
+                        "(seconds)").observe(
+                            time.perf_counter() - t0, workers=n)
+                else:
+                    self.model.fit(ds)
             self.model.epoch_count += 1
             for lis in self.model.listeners:
                 lis.on_epoch_end(self.model)
         return self
+
+    @staticmethod
+    def _timed_place(shard_fn, workers: int):
+        """Wrap a batch-placement fn so per-batch shard/assembly time
+        (which runs on the prefetch feeder thread) is measured."""
+        def place(ds):
+            if not telemetry.enabled():
+                return shard_fn(ds)
+            with telemetry.span("dp.place", workers=workers):
+                t0 = time.perf_counter()
+                out = shard_fn(ds)
+                telemetry.histogram(
+                    "dl4j_dp_place_seconds",
+                    "per-batch shard/global-assembly dispatch time on "
+                    "the feeder thread (seconds)").observe(
+                        time.perf_counter() - t0, workers=workers)
+            return out
+        return place
 
     def fit_batch(self, ds):
         if not self._placed:

@@ -14,7 +14,9 @@ from .stats import (FileStatsStorage, InMemoryStatsStorage,
                     StatsListener, render_html_report)
 from .profiling import ProfilingListener
 from .server import UIServer
+from ..common.telemetry import MetricsRegistry, MetricsReporterListener
 
 __all__ = ["StatsListener", "InMemoryStatsStorage",
            "FileStatsStorage", "render_html_report",
-           "ProfilingListener", "UIServer"]
+           "ProfilingListener", "UIServer",
+           "MetricsRegistry", "MetricsReporterListener"]

@@ -11,11 +11,14 @@ traces load into one timeline.
 from __future__ import annotations
 
 import json
+import logging
 import os
 import time
 from typing import List, Optional
 
 from ..optimize.listeners import TrainingListener
+
+log = logging.getLogger("deeplearning4j_tpu")
 
 
 class ProfilingListener(TrainingListener):
@@ -23,6 +26,11 @@ class ProfilingListener(TrainingListener):
         self.output_path = output_path
         self.max_events = max_events
         self.events: List[dict] = []
+        #: events silently discarded past ``max_events`` — surfaced in
+        #: the trace metadata and warned once at flush, so a truncated
+        #: trace is never mistaken for a complete one
+        self.dropped = 0
+        self._warned_drop = False
         self._iter_start: Optional[float] = None
         self._epoch_start: Optional[float] = None
         self._pid = os.getpid()
@@ -32,6 +40,7 @@ class ProfilingListener(TrainingListener):
 
     def _emit(self, name: str, start: float, end: float, args=None):
         if len(self.events) >= self.max_events:
+            self.dropped += 1
             return
         self.events.append({
             "name": name, "ph": "X", "pid": self._pid, "tid": 1,
@@ -58,7 +67,16 @@ class ProfilingListener(TrainingListener):
         self._iter_start = now
 
     def flush(self) -> str:
+        if self.dropped and not self._warned_drop:
+            self._warned_drop = True
+            log.warning(
+                "ProfilingListener dropped %d events past "
+                "max_events=%d — the trace is truncated; raise "
+                "max_events or profile a shorter window",
+                self.dropped, self.max_events)
         with open(self.output_path, "w") as f:
             json.dump({"traceEvents": self.events,
-                       "displayTimeUnit": "ms"}, f)
+                       "displayTimeUnit": "ms",
+                       "metadata": {"dropped_events": self.dropped}},
+                      f)
         return self.output_path

@@ -12,6 +12,9 @@ Endpoints:
 - ``/``             live dashboard (auto-refreshes every 2s)
 - ``/api/reports``  all reports of every attached storage (JSON)
 - ``/api/latest``   most recent report (JSON)
+- ``/metrics``      process-wide telemetry registry in Prometheus
+  text exposition format (``common.telemetry.MetricsRegistry``) —
+  point a Prometheus scrape job (or ``curl``) at it
 """
 from __future__ import annotations
 
@@ -150,6 +153,18 @@ class UIServer:
                                   r["time"] > latest["time"]):
                             latest = r
                     self._json(latest)
+                elif self.path == "/metrics":
+                    from deeplearning4j_tpu.common.telemetry import \
+                        MetricsRegistry
+                    body = MetricsRegistry.get() \
+                        .render_prometheus().encode()
+                    self.send_response(200)
+                    self.send_header(
+                        "Content-Type",
+                        "text/plain; version=0.0.4; charset=utf-8")
+                    self.send_header("Content-Length", str(len(body)))
+                    self.end_headers()
+                    self.wfile.write(body)
                 else:
                     self._json({"error": "not found"}, 404)
 

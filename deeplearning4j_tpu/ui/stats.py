@@ -11,12 +11,16 @@ headline training-health chart), iteration timing, and memory info.
 from __future__ import annotations
 
 import json
+import logging
+import os
 import time
 from typing import Dict, List, Optional
 
 import numpy as np
 
 from ..optimize.listeners import TrainingListener
+
+log = logging.getLogger("deeplearning4j_tpu")
 
 
 def _summary(arr, bins: int = 20) -> dict:
@@ -54,17 +58,39 @@ class FileStatsStorage(InMemoryStatsStorage):
     def __init__(self, path: str):
         super().__init__()
         self.path = path
+        #: a crash mid-append can leave a newline-less tail — the next
+        #: append must not glue onto it
+        self._tail_open = False
         try:                       # load existing reports (resume)
             with open(path) as f:
-                self.reports = [json.loads(l) for l in f
-                                if l.strip()]
+                l = ""
+                for lineno, l in enumerate(f, 1):
+                    if not l.strip():
+                        continue
+                    try:
+                        self.reports.append(json.loads(l))
+                    except ValueError:
+                        # a crash mid-append leaves a truncated tail
+                        # line; resuming must not die on it
+                        log.warning(
+                            "skipping corrupt report on line %d of "
+                            "%s", lineno, path)
+                self._tail_open = bool(l) and not l.endswith("\n")
         except FileNotFoundError:
             pass
 
     def put_report(self, report: dict):
         super().put_report(report)
+        # one write + flush-to-disk per report: a reader tailing the
+        # file (or a resume after a crash) sees whole lines only
+        line = json.dumps(report) + "\n"
+        if self._tail_open:
+            line = "\n" + line
         with open(self.path, "a") as f:
-            f.write(json.dumps(report) + "\n")
+            f.write(line)
+            f.flush()
+            os.fsync(f.fileno())
+        self._tail_open = False
 
 
 class StatsListener(TrainingListener):

@@ -25,6 +25,7 @@ from typing import List, Optional
 
 import jax
 
+from deeplearning4j_tpu.common import telemetry
 from deeplearning4j_tpu.optimize.listeners import TrainingListener
 from deeplearning4j_tpu.utils.serializer import ModelSerializer
 
@@ -92,14 +93,27 @@ class CheckpointListener(TrainingListener):
         self._pending: Optional[concurrent.futures.Future] = None
 
     def _write(self, snapshot, tmp: Path, path: Path):
-        if hasattr(snapshot, "write"):
-            # model-provided snapshot (SameDiff.checkpoint_snapshot:
-            # the imported-model path has its own zip format)
-            snapshot.write(tmp)
-        else:
-            ModelSerializer.write_model(
-                snapshot, tmp, model_class=snapshot.model_class)
-        os.replace(tmp, path)  # atomic: readers never see partials
+        with telemetry.span("checkpoint.save", path=str(path)):
+            t0 = time.perf_counter()
+            if hasattr(snapshot, "write"):
+                # model-provided snapshot (SameDiff.checkpoint_snapshot:
+                # the imported-model path has its own zip format)
+                snapshot.write(tmp)
+            else:
+                ModelSerializer.write_model(
+                    snapshot, tmp, model_class=snapshot.model_class)
+            n_bytes = tmp.stat().st_size
+            os.replace(tmp, path)  # atomic: readers never see partials
+            if telemetry.enabled():
+                telemetry.histogram(
+                    "dl4j_checkpoint_save_seconds",
+                    "checkpoint serialize + atomic-rename time "
+                    "(background thread when asynchronous)").observe(
+                        time.perf_counter() - t0)
+                telemetry.counter(
+                    "dl4j_checkpoint_bytes_total",
+                    "checkpoint bytes moved, by op").inc(n_bytes,
+                                                         op="save")
         self._rotate()
 
     def _save(self, model):
@@ -209,12 +223,25 @@ class CheckpointListener(TrainingListener):
         on a SameDiff job fell into restore_multi_layer_network and
         failed confusingly (ADVICE.md)."""
         import zipfile
-        with zipfile.ZipFile(cp) as z:
-            is_samediff = "graph.json" in z.namelist()
-        if is_samediff:
-            from deeplearning4j_tpu.autodiff.samediff import SameDiff
-            return SameDiff.load(str(cp))
-        return ModelSerializer.restore_model(cp)
+        with telemetry.span("checkpoint.load", path=str(cp)):
+            t0 = time.perf_counter()
+            with zipfile.ZipFile(cp) as z:
+                is_samediff = "graph.json" in z.namelist()
+            if is_samediff:
+                from deeplearning4j_tpu.autodiff.samediff import SameDiff
+                model = SameDiff.load(str(cp))
+            else:
+                model = ModelSerializer.restore_model(cp)
+            if telemetry.enabled():
+                telemetry.histogram(
+                    "dl4j_checkpoint_load_seconds",
+                    "checkpoint restore time (seconds)").observe(
+                        time.perf_counter() - t0)
+                telemetry.counter(
+                    "dl4j_checkpoint_bytes_total",
+                    "checkpoint bytes moved, by op").inc(
+                        Path(cp).stat().st_size, op="load")
+        return model
 
     @staticmethod
     def load_checkpoint(save_dir_or_path, *, skip_corrupt: bool = True):

@@ -1,0 +1,82 @@
+#!/usr/bin/env python
+"""Keep the README metrics catalog honest.
+
+Scans the source tree for telemetry metric registrations
+(``telemetry.counter("dl4j_...")`` / ``gauge`` / ``histogram`` — and
+the registry-method spellings) and fails if any registered ``dl4j_*``
+metric name is missing from the README "Observability" catalog, or if
+the catalog documents a metric no code registers (stale docs are as
+misleading as missing ones).
+
+Runs as a tier-1 test (tests/test_telemetry.py) and standalone:
+
+    python scripts/check_telemetry_catalog.py
+"""
+from __future__ import annotations
+
+import pathlib
+import re
+import sys
+
+ROOT = pathlib.Path(__file__).resolve().parent.parent
+README = ROOT / "README.md"
+
+#: metric registrations: counter("name" / gauge("name" /
+#: histogram("name" — any receiver (telemetry module, a registry, or
+#: the module-level helpers called bare inside telemetry.py)
+_REG_RE = re.compile(
+    r"\b(?:counter|gauge|histogram)\(\s*\n?\s*['\"](dl4j_[a-z0-9_]+)")
+
+#: names prefixed dl4j_ anywhere in the README catalog section
+_DOC_RE = re.compile(r"`(dl4j_[a-z0-9_]+)`")
+
+#: registrations that are deliberately NOT part of the public catalog
+_EXEMPT = {"dl4j_bench_counter_total", "dl4j_bench_hist_seconds"}
+
+
+def registered_metrics() -> set:
+    names = set()
+    for base in ("deeplearning4j_tpu", "benchmarks", "scripts"):
+        for p in (ROOT / base).rglob("*.py"):
+            names.update(_REG_RE.findall(p.read_text()))
+    names.update(_REG_RE.findall((ROOT / "bench.py").read_text()))
+    return names - _EXEMPT
+
+
+def documented_metrics() -> set:
+    text = README.read_text()
+    m = re.search(r"## Observability(.*?)(?:\n## |\Z)", text, re.S)
+    if not m:
+        return set()
+    return set(_DOC_RE.findall(m.group(1)))
+
+
+def main() -> int:
+    reg = registered_metrics()
+    doc = documented_metrics()
+    rc = 0
+    missing = sorted(reg - doc)
+    stale = sorted(doc - reg)
+    if not doc:
+        print("FAIL: README has no '## Observability' catalog section")
+        rc = 1
+    if missing:
+        print("FAIL: metrics registered in code but missing from the "
+              "README Observability catalog:")
+        for n in missing:
+            print(f"  - {n}")
+        rc = 1
+    if stale:
+        print("FAIL: metrics documented in the README catalog but "
+              "registered nowhere in code:")
+        for n in stale:
+            print(f"  - {n}")
+        rc = 1
+    if rc == 0:
+        print(f"OK: {len(reg)} registered metrics all documented, "
+              f"no stale catalog entries")
+    return rc
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,432 @@
+"""Unified telemetry spine tests (ISSUE 2): registry semantics,
+thread-safety, Prometheus rendering, the ``/metrics`` endpoint, the
+chrome-trace span buffer, and — the part that matters — the hot paths
+(prefetcher, compile cache, fit funnels) actually recording during a
+tiny ``fit()``."""
+import json
+import subprocess
+import sys
+import threading
+import urllib.request
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.common import telemetry
+from deeplearning4j_tpu.common.telemetry import (DEFAULT_BUCKETS,
+                                                 MetricsRegistry,
+                                                 MetricsReporterListener)
+
+_ROOT = Path(__file__).resolve().parent.parent
+
+
+@pytest.fixture(autouse=True)
+def _fresh_registry():
+    MetricsRegistry._reset_for_tests()
+    yield
+    MetricsRegistry._reset_for_tests()
+
+
+def _net_and_data(n=64):
+    from deeplearning4j_tpu.activations import Activation
+    from deeplearning4j_tpu.datasets.dataset import DataSet
+    from deeplearning4j_tpu.learning import Adam
+    from deeplearning4j_tpu.lossfunctions import LossFunction
+    from deeplearning4j_tpu.nn import (InputType, MultiLayerNetwork,
+                                       NeuralNetConfiguration)
+    from deeplearning4j_tpu.nn.conf.layers import DenseLayer, OutputLayer
+    rng = np.random.RandomState(0)
+    x = rng.randn(n, 4).astype(np.float32)
+    y = np.eye(2, dtype=np.float32)[(x[:, 0] > 0).astype(int)]
+    net = MultiLayerNetwork(
+        (NeuralNetConfiguration.Builder().seed(1).updater(Adam(1e-2))
+         .list()
+         .layer(DenseLayer(n_out=8, activation=Activation.RELU))
+         .layer(OutputLayer(n_out=2, activation=Activation.SOFTMAX,
+                            loss_function=LossFunction.MCXENT))
+         .set_input_type(InputType.feed_forward(4)).build())).init()
+    return net, DataSet(x, y)
+
+
+class TestRegistry:
+    def test_counter_gauge_basics(self):
+        c = telemetry.counter("dl4j_t_total", "help")
+        c.inc()
+        c.inc(2, model="a")
+        assert c.value() == 1
+        assert c.value(model="a") == 2
+        g = telemetry.gauge("dl4j_t_gauge", "help")
+        g.set(5)
+        g.inc(2)
+        g.dec()
+        assert g.value() == 6
+
+    def test_registration_idempotent_and_kind_checked(self):
+        a = telemetry.counter("dl4j_t_same", "x")
+        b = telemetry.counter("dl4j_t_same", "other help ignored")
+        assert a is b
+        with pytest.raises(ValueError, match="already registered"):
+            telemetry.gauge("dl4j_t_same", "x")
+
+    def test_histogram_bucketing(self):
+        h = telemetry.histogram("dl4j_t_h", "x", buckets=(0.01, 0.1, 1))
+        for v in (0.005, 0.01, 0.05, 0.5, 5.0):
+            h.observe(v)
+        s = h._series[()]
+        # le=0.01 gets 0.005 AND the boundary value 0.01 (le is <=)
+        assert s.counts == [2, 1, 1, 1]
+        assert s.count == 5
+        assert abs(s.sum - 5.565) < 1e-9
+        assert h.count_of() == 5
+
+    def test_disabled_records_nothing(self):
+        reg = MetricsRegistry.get()
+        reg.set_enabled(False)
+        c = telemetry.counter("dl4j_t_off", "x")
+        c.inc()
+        telemetry.histogram("dl4j_t_off_h", "x").observe(1.0)
+        with telemetry.span("off_span"):
+            pass
+        assert c.value() == 0
+        assert telemetry.histogram("dl4j_t_off_h", "x").count_of() == 0
+        assert not any(e["name"] == "off_span"
+                       for e in telemetry.trace_events())
+
+    def test_env_gate(self, monkeypatch):
+        from deeplearning4j_tpu.common.environment import Environment
+        monkeypatch.setenv("DL4J_TPU_TELEMETRY", "0")
+        Environment.reset()
+        MetricsRegistry._reset_for_tests()
+        try:
+            assert not MetricsRegistry.get().enabled
+        finally:
+            monkeypatch.delenv("DL4J_TPU_TELEMETRY")
+            Environment.reset()
+            MetricsRegistry._reset_for_tests()
+
+    def test_thread_safety_concurrent_writers(self):
+        c = telemetry.counter("dl4j_t_mt_total", "x")
+        h = telemetry.histogram("dl4j_t_mt_h", "x")
+        n_threads, n_ops = 8, 2000
+        start = threading.Barrier(n_threads)
+
+        def work(i):
+            start.wait()
+            for _ in range(n_ops):
+                c.inc(worker=str(i % 2))
+                h.observe(0.001)
+
+        ts = [threading.Thread(target=work, args=(i,))
+              for i in range(n_threads)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join()
+        total = sum(c.value(worker=str(w)) for w in (0, 1))
+        assert total == n_threads * n_ops       # no lost increments
+        assert h.count_of() == n_threads * n_ops
+        assert abs(h.sum_of() - n_threads * n_ops * 0.001) < 1e-6
+
+    def test_prometheus_rendering(self):
+        telemetry.counter("dl4j_t_c_total", "a counter").inc(
+            3, model="mln")
+        telemetry.gauge("dl4j_t_g", "a gauge").set(2.5)
+        h = telemetry.histogram("dl4j_t_h_seconds", "a hist",
+                                buckets=(0.1, 1.0))
+        h.observe(0.05)
+        h.observe(0.5)
+        text = MetricsRegistry.get().render_prometheus()
+        assert "# TYPE dl4j_t_c_total counter" in text
+        assert 'dl4j_t_c_total{model="mln"} 3' in text
+        assert "# TYPE dl4j_t_g gauge" in text
+        assert "dl4j_t_g 2.5" in text
+        assert "# HELP dl4j_t_h_seconds a hist" in text
+        # cumulative buckets + +Inf + sum/count
+        assert 'dl4j_t_h_seconds_bucket{le="0.1"} 1' in text
+        assert 'dl4j_t_h_seconds_bucket{le="1"} 2' in text
+        assert 'dl4j_t_h_seconds_bucket{le="+Inf"} 2' in text
+        assert "dl4j_t_h_seconds_count 2" in text
+
+    def test_summary_snapshot(self):
+        telemetry.counter("dl4j_t_c_total", "x").inc(model="a")
+        telemetry.histogram("dl4j_t_h", "x").observe(2.0)
+        s = MetricsRegistry.get().summary()
+        assert s["dl4j_t_c_total"]["model=a"] == 1
+        assert s["dl4j_t_h"][""]["count"] == 1
+        assert s["dl4j_t_h"][""]["mean"] == 2.0
+        json.dumps(s)                       # JSON-serializable
+
+
+class TestSpans:
+    def test_span_and_instant_events(self):
+        with telemetry.span("outer", stage="test"):
+            telemetry.instant("marker", k=1)
+        events = telemetry.trace_events()
+        names = [e["name"] for e in events]
+        assert "outer" in names and "marker" in names
+        outer = next(e for e in events if e["name"] == "outer")
+        assert outer["ph"] == "X" and outer["dur"] >= 0
+        assert outer["args"] == {"stage": "test"}
+
+    def test_export_and_merge(self, tmp_path):
+        with telemetry.span("a"):
+            pass
+        p1 = telemetry.export_chrome_trace(str(tmp_path / "t1.json"))
+        doc = json.load(open(p1))
+        assert any(e["name"] == "a" for e in doc["traceEvents"])
+        assert doc["metadata"]["dropped_events"] == 0
+        # merge with a jax.profiler-shaped second trace
+        p2 = tmp_path / "t2.json"
+        p2.write_text(json.dumps(
+            {"traceEvents": [{"name": "tpu_op", "ph": "X", "pid": 9,
+                              "tid": 1, "ts": 1, "dur": 2}]}))
+        merged = telemetry.merge_chrome_traces(
+            str(tmp_path / "m.json"), p1, str(p2))
+        events = json.load(open(merged))["traceEvents"]
+        assert {"a", "tpu_op"} <= {e["name"] for e in events}
+
+    def test_buffer_cap_counts_drops(self, tmp_path):
+        buf = telemetry._trace_buffer
+        old_max = buf.max_events
+        buf.max_events = len(buf.events) + 1
+        try:
+            with telemetry.span("kept"):
+                pass
+            with telemetry.span("dropped"):
+                pass
+            assert buf.dropped == 1
+            doc = json.load(open(telemetry.export_chrome_trace(
+                str(tmp_path / "t.json"))))
+            assert doc["metadata"]["dropped_events"] == 1
+        finally:
+            buf.max_events = old_max
+
+
+class TestMetricsEndpoint:
+    def test_metrics_roundtrip(self):
+        from deeplearning4j_tpu.ui import UIServer
+        telemetry.counter("dl4j_t_served_total", "x").inc(5)
+        server = UIServer.get_instance().start(port=0)
+        try:
+            resp = urllib.request.urlopen(server.url + "/metrics")
+            assert resp.headers["Content-Type"].startswith("text/plain")
+            text = resp.read().decode()
+            assert "dl4j_t_served_total 5" in text
+            assert "# TYPE dl4j_t_served_total counter" in text
+        finally:
+            server.stop()
+
+
+class TestInstrumentedFit:
+    def test_fit_records_step_prefetch_and_cache_metrics(self):
+        """The acceptance-criteria smoke: a tiny fit() over a real
+        iterator yields non-zero step-time histogram counts, prefetch
+        queue-depth samples + staged batches, and compile-cache
+        hit/miss counters — all visible in one Prometheus page."""
+        from deeplearning4j_tpu.datasets.dataset import DataSet
+        from deeplearning4j_tpu.datasets.iterators import \
+            ListDataSetIterator
+        net, ds = _net_and_data(64)
+        batches = [DataSet(ds.features[i:i + 16], ds.labels[i:i + 16])
+                   for i in range(0, 64, 16)]
+        it = ListDataSetIterator(batches, batch_size=16)
+        net.fit(it, n_epochs=2)
+
+        h = telemetry.histogram("dl4j_train_step_seconds", "")
+        assert h.count_of(model="MultiLayerNetwork") == 8
+        assert h.sum_of(model="MultiLayerNetwork") > 0
+        staged = telemetry.counter(
+            "dl4j_prefetch_batches_staged_total", "")
+        assert staged.value() == 8
+        stall = telemetry.histogram("dl4j_feed_stall_seconds", "")
+        # one observation per queue pop (incl. the end-of-epoch
+        # sentinel pull): at least one per consumed batch
+        assert stall.count_of(source="device_prefetch") >= 8
+        hits = telemetry.counter("dl4j_compile_cache_hits_total", "")
+        misses = telemetry.counter(
+            "dl4j_compile_cache_misses_total", "")
+        name = "MultiLayerNetwork train step"
+        assert misses.value(network=name) == 1      # one signature
+        assert hits.value(network=name) == 7        # 7 reuses
+        # the whole panel renders
+        text = MetricsRegistry.get().render_prometheus()
+        assert "dl4j_train_step_seconds_count" in text
+        assert "dl4j_prefetch_queue_depth" in text
+
+    def test_retrace_counter_on_shape_churn(self):
+        from deeplearning4j_tpu.datasets.dataset import DataSet
+        net, ds = _net_and_data(64)
+        net.fit(DataSet(ds.features[:32], ds.labels[:32]))
+        net.fit(DataSet(ds.features[:48], ds.labels[:48]))
+        retr = telemetry.counter("dl4j_retrace_total", "")
+        assert retr.value(network="MultiLayerNetwork train step") == 1
+        assert any(e["name"] == "retrace"
+                   for e in telemetry.trace_events())
+
+    def test_reporter_listener_folds_snapshots(self):
+        from deeplearning4j_tpu.ui import InMemoryStatsStorage
+        storage = InMemoryStatsStorage()
+        net, ds = _net_and_data()
+        net.set_listeners(MetricsReporterListener(storage, frequency=2))
+        net.fit(ds, n_epochs=5)
+        reports = storage.get_reports()
+        assert len(reports) == 3                    # iterations 0,2,4
+        tel = reports[-1]["telemetry"]
+        assert "dl4j_train_step_seconds" in tel
+        assert tel["dl4j_train_step_seconds"][
+            "model=MultiLayerNetwork"]["count"] >= 4
+
+    def test_checkpoint_metrics(self, tmp_path):
+        from deeplearning4j_tpu.utils.checkpoint import \
+            CheckpointListener
+        net, ds = _net_and_data()
+        lis = CheckpointListener(tmp_path, save_every_n_epochs=1,
+                                 asynchronous=False)
+        net.add_listeners(lis)
+        net.fit([ds], n_epochs=2)
+        assert telemetry.histogram(
+            "dl4j_checkpoint_save_seconds", "").count_of() == 2
+        saved_bytes = telemetry.counter(
+            "dl4j_checkpoint_bytes_total", "").value(op="save")
+        assert saved_bytes > 0
+        CheckpointListener.load_checkpoint(tmp_path)
+        assert telemetry.histogram(
+            "dl4j_checkpoint_load_seconds", "").count_of() == 1
+        assert telemetry.counter(
+            "dl4j_checkpoint_bytes_total", "").value(op="load") > 0
+
+    def test_inference_queue_metrics(self):
+        from deeplearning4j_tpu.parallel.inference import \
+            ParallelInference
+        net, ds = _net_and_data()
+        pi = (ParallelInference.Builder(net).workers(1)
+              .batch_limit(8).build())
+        try:
+            futs = [pi.submit(ds.features[i:i + 2])
+                    for i in range(0, 8, 2)]
+            for f in futs:
+                assert f.result(timeout=30).shape[-1] == 2
+        finally:
+            pi.shutdown()
+        assert telemetry.counter(
+            "dl4j_inference_requests_total", "").value(
+                mode="BATCHED") == 4
+        assert telemetry.histogram(
+            "dl4j_inference_queue_seconds", "").count_of() == 4
+        occ = telemetry.histogram("dl4j_inference_batch_occupancy", "")
+        assert occ.count_of() >= 1
+
+
+class TestOverhead:
+    def test_disabled_overhead_is_trivial(self):
+        """With the gate off a record call must cost no more than a
+        bare method call — budget is generous (5µs) to stay robust on
+        loaded CI, but catches accidental work on the off path."""
+        import time
+        reg = MetricsRegistry.get()
+        c = telemetry.counter("dl4j_t_ovh_total", "x")
+        reg.set_enabled(False)
+        n = 50_000
+        t0 = time.perf_counter()
+        for _ in range(n):
+            c.inc()
+        per_op = (time.perf_counter() - t0) / n
+        assert per_op < 5e-6
+
+    def test_enabled_step_overhead_under_one_pct(self):
+        """ISSUE acceptance: <1% step-time impact with telemetry on.
+        Measured deterministically: the FULL per-step record (a
+        step_span = one histogram observe + one trace event) is timed
+        per-op and compared against a 1ms step — the floor of any
+        real accelerator step (CPU-proxy LeNet steps are ~1ms, TPU
+        ResNet/BERT steps are tens of ms, so 1% here is the worst
+        case). bench_telemetry.py measures the real fit() funnel."""
+        import time
+        n = 20_000
+        t0 = time.perf_counter()
+        for _ in range(n):
+            with telemetry.step_span("ovh"):
+                pass
+        per_step = (time.perf_counter() - t0) / n
+        telemetry._trace_buffer.clear()
+        assert per_step < 0.01 * 1e-3       # <1% of a 1ms step
+
+
+class TestCatalogChecker:
+    def test_catalog_in_sync(self):
+        """Tier-1 wiring for scripts/check_telemetry_catalog.py: every
+        registered metric is documented in README, none are stale."""
+        out = subprocess.run(
+            [sys.executable,
+             str(_ROOT / "scripts" / "check_telemetry_catalog.py")],
+            capture_output=True, text=True, timeout=120)
+        assert out.returncode == 0, out.stdout + out.stderr
+
+
+class TestSatellites:
+    def test_score_listener_logs_not_prints(self, capsys, caplog):
+        import logging
+        from deeplearning4j_tpu.optimize.listeners import \
+            ScoreIterationListener
+        net, ds = _net_and_data()
+        net.set_listeners(ScoreIterationListener(1))
+        with caplog.at_level(logging.INFO, logger="deeplearning4j_tpu"):
+            net.fit(ds)
+        assert "Score at iteration" in caplog.text
+        assert "Score at iteration" not in capsys.readouterr().out
+
+    def test_score_listener_stdout_opt_in(self, capsys):
+        from deeplearning4j_tpu.optimize.listeners import \
+            ScoreIterationListener
+        net, ds = _net_and_data()
+        net.set_listeners(ScoreIterationListener(1, stdout=True))
+        net.fit(ds)
+        assert "Score at iteration" in capsys.readouterr().out
+
+    def test_performance_listener_logs_not_prints(self, capsys, caplog):
+        import logging
+        from deeplearning4j_tpu.optimize.listeners import \
+            PerformanceListener
+        net, ds = _net_and_data()
+        net.set_listeners(PerformanceListener(frequency=1))
+        with caplog.at_level(logging.INFO, logger="deeplearning4j_tpu"):
+            net.fit(ds, n_epochs=3)
+        assert "iters/sec" in caplog.text
+        assert "iters/sec" not in capsys.readouterr().out
+
+    def test_profiling_listener_counts_drops(self, tmp_path, caplog):
+        import logging
+        from deeplearning4j_tpu.ui import ProfilingListener
+        p = str(tmp_path / "trace.json")
+        prof = ProfilingListener(p, max_events=2)
+        net, ds = _net_and_data()
+        net.set_listeners(prof)
+        with caplog.at_level(logging.WARNING,
+                             logger="deeplearning4j_tpu"):
+            net.fit([ds, ds, ds, ds, ds, ds], n_epochs=1)
+        doc = json.load(open(p))
+        assert len(doc["traceEvents"]) == 2
+        assert doc["metadata"]["dropped_events"] == prof.dropped > 0
+        assert "dropped" in caplog.text
+
+    def test_file_stats_storage_skips_corrupt_tail(self, tmp_path,
+                                                   caplog):
+        import logging
+        from deeplearning4j_tpu.ui import FileStatsStorage
+        p = tmp_path / "stats.jsonl"
+        s = FileStatsStorage(str(p))
+        s.put_report({"iteration": 0, "time": 1.0, "score": 2.0})
+        s.put_report({"iteration": 1, "time": 2.0, "score": 1.0})
+        # simulate a crash mid-append: truncated trailing line
+        with open(p, "a") as f:
+            f.write('{"iteration": 2, "time": 3.0, "sco')
+        with caplog.at_level(logging.WARNING,
+                             logger="deeplearning4j_tpu"):
+            again = FileStatsStorage(str(p))
+        assert len(again.get_reports()) == 2
+        assert again.latest()["iteration"] == 1
+        assert "corrupt" in caplog.text
+        # storage stays appendable after a dirty resume
+        again.put_report({"iteration": 3, "time": 4.0, "score": 0.5})
+        assert FileStatsStorage(str(p)).latest()["iteration"] == 3
