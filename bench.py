@@ -171,6 +171,31 @@ def main():
                   file=sys.stderr)
     except Exception as e:
         print(f"feeding-ladder leg failed: {e!r}", file=sys.stderr)
+    # Serving leg: batcher latency percentiles vs batch window + the
+    # warm/cold first-request gap (the shape-bucketed-warmup payoff).
+    # CPU-proxy subprocess, like the pipeline legs above.
+    try:
+        env = {**os.environ, "PYTHONPATH": "", "JAX_PLATFORMS": "cpu"}
+        out = subprocess.run(
+            [sys.executable,
+             os.path.join(_ROOT, "benchmarks", "bench_serving.py")],
+            capture_output=True, text=True, timeout=900, env=env,
+            cwd=_ROOT)
+        if out.returncode != 0:
+            raise RuntimeError(
+                f"rc={out.returncode}: {out.stderr.strip()[-400:]}")
+        for ln in out.stdout.strip().splitlines():
+            if not ln.startswith("{"):
+                continue              # tolerate library banners
+            rec = json.loads(ln)
+            if rec.get("metric") == "serving_latency":
+                rec.pop("metric")
+                line["serving"] = rec
+        if "serving" not in line:
+            print("serving leg: no latency line in child output",
+                  file=sys.stderr)
+    except Exception as e:
+        print(f"serving leg failed: {e!r}", file=sys.stderr)
     # Telemetry panel: the registry the run's hot paths recorded into
     # (train-step histogram, compile-cache counters, prefetch stats
     # when an iterator fed) — the same data /metrics would serve.

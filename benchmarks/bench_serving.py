@@ -1,0 +1,136 @@
+"""Serving-path benchmark: request latency + throughput.
+
+Measures what a serving operator tunes:
+
+- **batch_window_ms sweep** — the latency/throughput knob of the
+  dynamic batcher. Concurrent clients drive a warmed
+  ``ServingBatcher``; per-request submit→result latency is reported
+  as p50/p95/p99 alongside throughput.
+- **warm vs cold first request** — the stall shape-bucketed warmup
+  exists to remove: first request into a cold batcher pays the XLA
+  compile; into a warmed one it pays only queue + compute.
+
+Prints ONE JSON line (``bench.py`` folds it into its ``serving``
+block):
+
+  {"metric": "serving_latency", "windows": {...},
+   "first_request_ms": {"warm": ..., "cold": ...}, ...}
+
+Run: JAX_PLATFORMS=cpu python benchmarks/bench_serving.py
+"""
+from __future__ import annotations
+
+import json
+import os
+import sys
+import threading
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+import numpy as np
+
+N_CLIENTS = 4
+REQS_PER_CLIENT = 40
+WINDOWS_MS = (0.5, 2.0, 8.0)
+BUCKETS = (8, 32)
+
+
+def _net():
+    from deeplearning4j_tpu.activations import Activation
+    from deeplearning4j_tpu.learning import Adam
+    from deeplearning4j_tpu.lossfunctions import LossFunction
+    from deeplearning4j_tpu.nn import (InputType, MultiLayerNetwork,
+                                       NeuralNetConfiguration)
+    from deeplearning4j_tpu.nn.conf.layers import (DenseLayer,
+                                                   OutputLayer)
+    return MultiLayerNetwork(
+        (NeuralNetConfiguration.Builder().seed(7).updater(Adam(1e-2))
+         .list()
+         .layer(DenseLayer(n_out=16, activation=Activation.RELU))
+         .layer(OutputLayer(n_out=3,
+                            loss_function=LossFunction.MCXENT,
+                            activation=Activation.SOFTMAX))
+         .set_input_type(InputType.feed_forward(8)).build())).init()
+
+
+def _batcher(net, window_ms: float):
+    from deeplearning4j_tpu.serving.batcher import ServingBatcher
+    return ServingBatcher(net, BUCKETS, name="bench",
+                          batch_window_ms=window_ms)
+
+
+def _drive(batcher, reqs) -> list:
+    """N client threads, each timing submit→result per request."""
+    lats, lock = [], threading.Lock()
+
+    def client(seed):
+        rng = np.random.RandomState(seed)
+        mine = []
+        for _ in range(reqs):
+            x = rng.randn(1, 8).astype(np.float32)
+            t0 = time.perf_counter()
+            batcher.submit(x).result(timeout=60)
+            mine.append(time.perf_counter() - t0)
+        with lock:
+            lats.extend(mine)
+
+    threads = [threading.Thread(target=client, args=(s,))
+               for s in range(N_CLIENTS)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    return lats
+
+
+def main():
+    from deeplearning4j_tpu.common import telemetry
+
+    net = _net()
+    line = {"metric": "serving_latency",
+            "clients": N_CLIENTS, "reqs_per_client": REQS_PER_CLIENT,
+            "buckets": list(BUCKETS)}
+
+    # warm vs cold first request (the warmup payoff)
+    cold = _batcher(net, 2.0)
+    t0 = time.perf_counter()
+    cold.submit(np.zeros((1, 8), np.float32)).result(timeout=120)
+    cold_ms = (time.perf_counter() - t0) * 1e3
+    cold.shutdown()
+    warm = _batcher(net, 2.0)
+    warm.warmup((8,))
+    t0 = time.perf_counter()
+    warm.submit(np.zeros((1, 8), np.float32)).result(timeout=120)
+    warm_ms = (time.perf_counter() - t0) * 1e3
+    warm.shutdown()
+    line["first_request_ms"] = {"cold": round(cold_ms, 2),
+                                "warm": round(warm_ms, 2)}
+
+    # batch-window sweep on warmed batchers
+    windows = {}
+    for w in WINDOWS_MS:
+        b = _batcher(net, w)
+        b.warmup((8,))
+        t0 = time.perf_counter()
+        lats = _drive(b, REQS_PER_CLIENT)
+        wall = time.perf_counter() - t0
+        b.shutdown()
+        ms = np.asarray(lats) * 1e3
+        windows[str(w)] = {
+            "p50_ms": round(float(np.percentile(ms, 50)), 2),
+            "p95_ms": round(float(np.percentile(ms, 95)), 2),
+            "p99_ms": round(float(np.percentile(ms, 99)), 2),
+            "throughput_rps": round(len(lats) / wall, 1),
+        }
+    line["windows"] = windows
+    # the live registry's own quantile estimate (bucket-resolution)
+    # for the aggregate queue stage — what /metrics scrapers see
+    h = telemetry.histogram("dl4j_serving_latency_seconds")
+    line["queue_p95_ms_registry"] = round(
+        h.quantile(0.95, model="bench", stage="queue") * 1e3, 2)
+    print(json.dumps(line))
+
+
+if __name__ == "__main__":
+    main()

@@ -143,12 +143,15 @@ class RetraceGuard:
             "already-compiled program (no retrace)").bind(
                 network=self.name)
 
-    def record(self, *batch_arrays) -> None:
+    def record(self, *batch_arrays) -> bool:
+        """Record one dispatch; returns True when the signature was
+        already known (no retrace) — callers gate their own
+        cold-compile accounting on it (serving bucket misses)."""
         sig = signature_of(*batch_arrays)
         if sig in self._sigs:
             # known signature: the in-process executable is reused
             self._hits.inc()
-            return
+            return True
         self._sigs.add(sig)
         # new signature: jit traces + compiles (the persistent on-disk
         # cache may still serve the binary — this counts compiles the
@@ -174,6 +177,7 @@ class RetraceGuard:
                 "program. Pad minibatches to a fixed batch size (or "
                 "bucket sequence lengths) so the step compiles once.",
                 self.name, len(self._sigs))
+        return False
 
     @property
     def n_signatures(self) -> int:

@@ -1,0 +1,81 @@
+"""Shared stdlib-HTTP plumbing for the in-process servers.
+
+Both network faces of the system — the training dashboard
+(``ui.server.UIServer``) and the inference server
+(``serving.server.InferenceServer``) — ride the same zero-dependency
+``ThreadingHTTPServer`` pattern: silent request logging, explicit
+Content-Length framing, JSON bodies, and the Prometheus ``/metrics``
+renderer. This module is the one copy of that plumbing.
+
+Bind host: ``DL4J_TPU_HTTP_HOST`` (default ``127.0.0.1`` — loopback
+only; set ``0.0.0.0`` to expose a server beyond the host, e.g. from a
+container).
+"""
+from __future__ import annotations
+
+import json
+import os
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional, Tuple
+
+
+def bind_host() -> str:
+    """The interface every server binds (env-configurable per
+    process; read at ``start()`` time so tests can flip it)."""
+    return os.environ.get("DL4J_TPU_HTTP_HOST", "127.0.0.1")
+
+
+class QuietHandler(BaseHTTPRequestHandler):
+    """BaseHTTPRequestHandler minus the stderr request log, plus the
+    response/body helpers every endpoint needs."""
+
+    #: ThreadingHTTPServer threads die with the process
+    daemon_threads = True
+
+    def log_message(self, *args):       # silence request logging
+        pass
+
+    # -- responses -----------------------------------------------------
+    def send_body(self, body: bytes, content_type: str,
+                  code: int = 200, headers: Optional[dict] = None):
+        self.send_response(code)
+        self.send_header("Content-Type", content_type)
+        self.send_header("Content-Length", str(len(body)))
+        for k, v in (headers or {}).items():
+            self.send_header(k, str(v))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def send_json(self, obj, code: int = 200,
+                  headers: Optional[dict] = None):
+        self.send_body(json.dumps(obj).encode(), "application/json",
+                       code, headers)
+
+    def send_html(self, text: str, code: int = 200):
+        self.send_body(text.encode(), "text/html; charset=utf-8", code)
+
+    def send_metrics(self):
+        """The process-wide telemetry registry in Prometheus text
+        exposition format (0.0.4) — the ``/metrics`` endpoint."""
+        from deeplearning4j_tpu.common.telemetry import MetricsRegistry
+        self.send_body(MetricsRegistry.get().render_prometheus()
+                       .encode(),
+                       "text/plain; version=0.0.4; charset=utf-8")
+
+    # -- requests ------------------------------------------------------
+    def read_body(self) -> bytes:
+        """The request body, bounded by its Content-Length frame."""
+        n = int(self.headers.get("Content-Length") or 0)
+        return self.rfile.read(n) if n > 0 else b""
+
+
+def start_http_server(handler_cls, port: int = 0
+                      ) -> Tuple[ThreadingHTTPServer, threading.Thread]:
+    """Bind ``handler_cls`` on (bind_host(), port) and serve from a
+    daemon thread; port 0 picks a free port (read it back from
+    ``httpd.server_address``)."""
+    httpd = ThreadingHTTPServer((bind_host(), port), handler_cls)
+    thread = threading.Thread(target=httpd.serve_forever, daemon=True)
+    thread.start()
+    return httpd, thread

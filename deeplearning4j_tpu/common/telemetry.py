@@ -203,6 +203,26 @@ class Histogram(_Metric):
         finally:
             self.observe(time.perf_counter() - t0, **labels)
 
+    def quantile(self, q: float, **labels) -> float:
+        """Estimated ``q``-quantile (0..1) from the cumulative bucket
+        counts — the ``histogram_quantile`` discipline: linear
+        interpolation inside the winning bucket, +Inf observations
+        clamp to the top finite edge. 0.0 with no observations.
+        An estimate bounded by bucket resolution, not an exact order
+        statistic — serving benchmarks report p50/p95/p99 from the
+        live registry with it."""
+        s = self._series.get(_label_key(labels))
+        if s is None or s.count == 0:
+            return 0.0
+        target = q * s.count
+        cum, lo = 0.0, 0.0
+        for edge, c in zip(self.buckets, s.counts):
+            if c and cum + c >= target:
+                return lo + (edge - lo) * (target - cum) / c
+            cum += c
+            lo = edge
+        return self.buckets[-1]
+
     def count_of(self, **labels) -> int:
         s = self._series.get(_label_key(labels))
         return s.count if s else 0

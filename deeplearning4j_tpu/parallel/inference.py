@@ -168,6 +168,8 @@ class ParallelInference:
         the next H2D DMA overlaps the current forward + D2H — the
         DevicePrefetcher discipline applied to the serving path
         (``DL4J_TPU_DEVICE_PREFETCH=0`` reverts to serial placement)."""
+        if not requests:
+            return []
         self._ensure()
         from deeplearning4j_tpu.common.environment import Environment
         arrays = [np.asarray(r) for r in requests]

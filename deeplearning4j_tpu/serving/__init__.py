@@ -1,0 +1,33 @@
+"""Model-serving subsystem: versioned registry, shape-bucketed
+batching, admission control, and the HTTP inference server.
+
+The reference ecosystem pairs ``ParallelInference`` with a
+network-facing model server; this package is that layer for the TPU
+stack. The serving-latency discipline follows TVM (PAPERS.md
+1802.04799): compilation happens at *warmup*, never on a request —
+every flush is padded up to a pre-jitted batch-size bucket, and a
+``RetraceGuard`` per model version proves steady state never
+recompiles.
+
+    from deeplearning4j_tpu.serving import ModelRegistry, InferenceServer
+
+    reg = ModelRegistry()
+    reg.register("mnist", net, warmup_shape=(28, 28, 1),
+                 buckets=(8, 32))
+    srv = InferenceServer(reg).start(port=8500)
+    # POST /v1/models/mnist:predict   {"inputs": [[...], ...]}
+"""
+from deeplearning4j_tpu.serving.admission import (AdmissionController,
+                                                  DeadlineExceeded,
+                                                  ShedError)
+from deeplearning4j_tpu.serving.batcher import ServingBatcher
+from deeplearning4j_tpu.serving.registry import (ModelRegistry,
+                                                 ModelStatus,
+                                                 ModelVersion)
+from deeplearning4j_tpu.serving.server import InferenceServer
+
+__all__ = [
+    "AdmissionController", "DeadlineExceeded", "ShedError",
+    "ServingBatcher", "ModelRegistry", "ModelStatus", "ModelVersion",
+    "InferenceServer",
+]

@@ -1,0 +1,140 @@
+"""Admission control for the inference server.
+
+A serving stack that accepts every request melts down under overload:
+queues grow without bound, every request blows its latency SLO, and
+the process eventually OOMs. Admission control bounds the damage —
+requests beyond a per-model in-flight budget are *shed* immediately
+(HTTP 429 + ``Retry-After``) so the requests already admitted still
+meet their deadlines, and shutdown *drains*: no new admissions, wait
+for in-flight work to finish, then stop.
+
+Per-request deadlines ride through the batcher: an admitted request
+whose deadline expires while queued is cancelled, not computed
+(``ServingBatcher._flush`` checks before spending device time).
+"""
+from __future__ import annotations
+
+import math
+import threading
+import time
+from contextlib import contextmanager
+from typing import Dict, Optional
+
+from deeplearning4j_tpu.common import telemetry
+
+
+class ShedError(RuntimeError):
+    """Raised by :meth:`AdmissionController.admit` when a request is
+    rejected. ``reason`` is ``"queue_full"`` (HTTP 429) or
+    ``"draining"`` (HTTP 503); ``retry_after_s`` seeds the
+    ``Retry-After`` header."""
+
+    def __init__(self, reason: str, retry_after_s: float):
+        super().__init__(f"request shed: {reason}")
+        self.reason = reason
+        self.retry_after_s = retry_after_s
+
+
+class DeadlineExceeded(TimeoutError):
+    """A request's deadline passed before its batch was computed; the
+    batcher cancels it instead of spending device time (HTTP 504)."""
+
+
+def deadline_after_ms(ms: Optional[float]) -> Optional[float]:
+    """A ``time.monotonic()`` deadline ``ms`` from now (None passes
+    through: no deadline)."""
+    return None if ms is None else time.monotonic() + float(ms) / 1e3
+
+
+class AdmissionController:
+    """Bounded per-model admission with load shedding and graceful
+    drain.
+
+    - ``max_queue``: in-flight budget per model (queued + computing).
+      Request ``max_queue + 1`` sheds with 429.
+    - ``retry_after_s``: hint returned to shed clients. Defaults to
+      one batch window's worth of drain headroom (1s floor) — by then
+      at least one flush has happened and capacity likely freed.
+    - :meth:`drain`: flip to draining (new requests shed with 503),
+      block until in-flight reaches zero or ``timeout`` passes.
+    """
+
+    def __init__(self, max_queue: int = 64,
+                 retry_after_s: float = 1.0):
+        self.max_queue = int(max_queue)
+        self.retry_after_s = float(retry_after_s)
+        self._lock = threading.Lock()
+        self._idle = threading.Condition(self._lock)
+        self._inflight: Dict[str, int] = {}
+        self._draining = False
+        self._gauge = telemetry.gauge(
+            "dl4j_serving_inflight",
+            "admitted requests currently queued or computing, "
+            "per model")
+        self._shed = telemetry.counter(
+            "dl4j_serving_shed_total",
+            "requests rejected by admission control "
+            "(reason=queue_full -> 429, reason=draining -> 503)")
+
+    @property
+    def draining(self) -> bool:
+        return self._draining
+
+    def inflight(self, model: str) -> int:
+        return self._inflight.get(model, 0)
+
+    # ------------------------------------------------------------------
+    def admit(self, model: str) -> None:
+        """Admit one request for ``model`` or raise :class:`ShedError`.
+        Pair every successful admit with a :meth:`release`."""
+        with self._lock:
+            if self._draining:
+                self._shed.inc(model=model, reason="draining")
+                raise ShedError("draining", self.retry_after_s)
+            n = self._inflight.get(model, 0)
+            if n >= self.max_queue:
+                self._shed.inc(model=model, reason="queue_full")
+                raise ShedError("queue_full", self.retry_after_s)
+            self._inflight[model] = n + 1
+            self._gauge.set(n + 1, model=model)
+
+    def release(self, model: str) -> None:
+        with self._lock:
+            n = max(0, self._inflight.get(model, 0) - 1)
+            self._inflight[model] = n
+            self._gauge.set(n, model=model)
+            if n == 0:
+                self._idle.notify_all()
+
+    @contextmanager
+    def track(self, model: str):
+        """``admit``/``release`` around a request's whole lifetime
+        (queue wait + compute + response)."""
+        self.admit(model)
+        try:
+            yield
+        finally:
+            self.release(model)
+
+    # ------------------------------------------------------------------
+    def drain(self, timeout: float = 30.0) -> bool:
+        """Stop admitting and wait for in-flight work to finish.
+        Returns True when everything drained within ``timeout``."""
+        deadline = time.monotonic() + timeout
+        with self._lock:
+            self._draining = True
+            while any(self._inflight.values()):
+                left = deadline - time.monotonic()
+                if left <= 0:
+                    return False
+                self._idle.wait(left)
+        return True
+
+    def resume(self) -> None:
+        """Leave draining mode (a drained server being restarted)."""
+        with self._lock:
+            self._draining = False
+
+    def retry_after_header(self) -> str:
+        """Integral seconds for the ``Retry-After`` header."""
+        return str(max(1, int(math.ceil(self.retry_after_s))))

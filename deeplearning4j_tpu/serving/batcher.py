@@ -1,0 +1,246 @@
+"""Per-model dynamic batcher with shape-bucketed flushes.
+
+Reuses the ``ParallelInference`` submit/flush discipline (background
+worker drains a queue, aggregates up to ``batch_limit`` requests per
+``batch_window_ms`` window) with one serving-critical change: every
+flush is padded UP to the nearest *warm bucket* — a batch size whose
+XLA program was compiled at warmup — so steady-state requests never
+retrace (TVM's ahead-of-time compilation discipline, PAPERS.md
+1802.04799). A per-version ``RetraceGuard`` counts signatures; after
+warmup its count must not move.
+
+Two model surfaces:
+
+- MLN/ComputationGraph: the jitted sharded forward inherited from
+  ``ParallelInference`` (params replicated over the mesh, batch
+  sharded over ``data``).
+- generic (``SameDiff`` adapters, ONNX importers): any object whose
+  ``output(batch) -> array`` is signature-cached internally — bucket
+  padding keeps *its* cache to one entry per bucket too.
+
+Requests carry an optional ``time.monotonic()`` deadline: a request
+whose deadline expires while queued is cancelled at flush time with
+:class:`~deeplearning4j_tpu.serving.admission.DeadlineExceeded` —
+never computed.
+"""
+from __future__ import annotations
+
+import concurrent.futures
+import time
+from typing import List, Optional, Sequence
+
+import numpy as np
+
+from deeplearning4j_tpu.common import telemetry
+from deeplearning4j_tpu.common.compilecache import RetraceGuard
+from deeplearning4j_tpu.parallel.inference import (InferenceMode,
+                                                   ParallelInference)
+from deeplearning4j_tpu.serving.admission import DeadlineExceeded
+
+_LATENCY_HELP = ("serving request latency by stage: queue "
+                 "(submit->flush), compute (flush forward), total "
+                 "(submit->result), warmup (per-bucket pre-compile) "
+                 "(seconds)")
+
+
+def _latency() -> telemetry.Histogram:
+    return telemetry.histogram("dl4j_serving_latency_seconds",
+                               _LATENCY_HELP)
+
+
+class ServingBatcher(ParallelInference):
+    """A ``ParallelInference`` whose flushes land on warm buckets."""
+
+    def __init__(self, model, buckets: Sequence[int] = (8, 32),
+                 mesh=None, *, name: str = "model",
+                 batch_window_ms: float = 2.0,
+                 queue_limit: int = 256,
+                 guard: Optional[RetraceGuard] = None):
+        #: generic path: no MLN `_forward` funnel — serve through the
+        #: model's own `output(batch)` (SameDiff/ONNX adapters)
+        self._generic = None if hasattr(model, "_forward") \
+            else model.output
+        if not buckets:
+            raise ValueError("need at least one warmup bucket")
+        super().__init__(model, mesh,
+                         inference_mode=InferenceMode.BATCHED,
+                         batch_limit=max(int(b) for b in buckets),
+                         queue_limit=queue_limit,
+                         batch_window_ms=batch_window_ms)
+        if self._generic is None:
+            # sharded forward: buckets must be shard multiples, or the
+            # place-time pad would silently shift them to a new shape
+            w = self.n_workers
+            buckets = {-(-int(b) // w) * w for b in buckets}
+        self.buckets = tuple(sorted(int(b) for b in set(buckets)))
+        self.batch_limit = self.buckets[-1]
+        self.name = name
+        self.guard = guard if guard is not None else RetraceGuard(
+            f"serving:{name}", threshold=len(self.buckets) + 1)
+        self._warmed = False
+
+    # ------------------------------------------------------------------
+    def _ensure(self):
+        if self._generic is not None:
+            return
+        super()._ensure()
+
+    def _bucket_for(self, n: int) -> Optional[int]:
+        for b in self.buckets:
+            if n <= b:
+                return b
+        return None
+
+    def _pad_to_bucket(self, chunk: np.ndarray) -> np.ndarray:
+        """Pad the chunk's batch dim up to the nearest warm bucket by
+        repeating the final row (sliced back off after the forward).
+        Chunks are pre-capped at the largest bucket, so a bucket
+        always exists."""
+        n = chunk.shape[0]
+        b = self._bucket_for(n)
+        if b is None or b == n:
+            return chunk
+        reps = np.repeat(chunk[-1:], b - n, axis=0)
+        return np.concatenate([chunk, reps], axis=0)
+
+    def _record(self, sig_array) -> None:
+        """Guard bookkeeping for one dispatch: a NEW signature after
+        warmup finished is a bucket miss — the request paid the cold
+        compile the warmup set was supposed to cover (feature-shape/
+        dtype drift, or a bucket the set is missing)."""
+        hit = self.guard.record(sig_array)
+        if self._warmed and not hit:
+            telemetry.counter(
+                "dl4j_serving_bucket_miss_total",
+                "post-warmup flushes whose padded signature no warm "
+                "bucket covered — a cold XLA compile on the serving "
+                "path (shape/dtype drift, or grow the bucket set)"
+            ).inc(model=self.name)
+
+    def _forward_padded(self, padded: np.ndarray, orig: int
+                        ) -> np.ndarray:
+        if self._generic is not None:
+            self._record(padded)
+            return np.asarray(self._generic(padded))[:orig]
+        placed, _ = self._place_chunk(padded)
+        self._record(placed)
+        out = self._fwd(self.model.params, self.model.states, placed)
+        return np.asarray(out)[:orig]
+
+    # ------------------------------------------------------------------
+    def warmup(self, input_shape: Sequence[int],
+               dtype=np.float32) -> float:
+        """Pre-compile every bucket's program (one forward per bucket,
+        blocked to completion) so the first real request hits a warm
+        signature. ``input_shape`` is one request's shape WITHOUT the
+        batch dim. Returns total warmup seconds."""
+        self._ensure()
+        lat = _latency()
+        t_all = time.perf_counter()
+        for b in self.buckets:
+            x = np.zeros((b,) + tuple(input_shape), dtype)
+            t0 = time.perf_counter()
+            with telemetry.span("serving.warmup", model=self.name,
+                                bucket=b):
+                # _forward_padded's np.asarray is the sync point: the
+                # bucket's program has fully compiled AND run once by
+                # the time this returns
+                self._forward_padded(x, b)
+            lat.observe(time.perf_counter() - t0, model=self.name,
+                        stage="warmup")
+        self._warmed = True
+        return time.perf_counter() - t_all
+
+    # ------------------------------------------------------------------
+    def output_batched(self, requests: List) -> List[np.ndarray]:
+        """Aggregate ``requests`` into bucket-padded flushes. Unlike
+        the base class this never compiles an odd shape in steady
+        state: total rows are chunked by the largest bucket and each
+        chunk padded to its nearest bucket."""
+        if not requests:
+            return []
+        self._ensure()
+        arrays = [np.asarray(r) for r in requests]
+        sizes = [a.shape[0] for a in arrays]
+        big = np.concatenate(arrays, axis=0) if len(arrays) > 1 \
+            else arrays[0]
+        cap = self.buckets[-1]
+        outs = []
+        for i in range(0, big.shape[0], cap):
+            chunk = np.asarray(big[i:i + cap])
+            n = chunk.shape[0]
+            outs.append(self._forward_padded(
+                self._pad_to_bucket(chunk), n))
+        flat = np.concatenate(outs, axis=0) if len(outs) > 1 else outs[0]
+        result, off = [], 0
+        for s in sizes:
+            result.append(flat[off:off + s])
+            off += s
+        return result
+
+    # ------------------------------------------------------------------
+    def submit(self, x,
+               deadline: Optional[float] = None
+               ) -> "concurrent.futures.Future":
+        """Enqueue one request; ``deadline`` is an absolute
+        ``time.monotonic()`` instant past which the request must not
+        be computed (its Future then raises DeadlineExceeded)."""
+        fut: concurrent.futures.Future = concurrent.futures.Future()
+        if deadline is not None:
+            fut._serving_deadline = float(deadline)
+        telemetry.counter(
+            "dl4j_inference_requests_total",
+            "requests submitted to ParallelInference").inc(
+                mode=self.inference_mode)
+        # same locking discipline as the base class: the put happens
+        # under the lock shutdown() takes to enqueue its sentinel
+        with self._lock:
+            self._ensure_worker()
+            self._requests.put((x, fut, time.monotonic()))
+        return fut
+
+    def _flush(self, batch):
+        now = time.monotonic()
+        live = []
+        for x, f, t in batch:
+            dl = getattr(f, "_serving_deadline", None)
+            if dl is not None and now >= dl:
+                # expired while queued: cancel, never compute
+                telemetry.counter(
+                    "dl4j_serving_deadline_expired_total",
+                    "requests whose deadline passed while queued — "
+                    "cancelled before compute").inc(model=self.name)
+                if f.set_running_or_notify_cancel():
+                    f.set_exception(DeadlineExceeded(
+                        f"deadline passed {now - dl:.3f}s before "
+                        f"flush"))
+                continue
+            if f.set_running_or_notify_cancel():
+                live.append((x, f, t))
+        if not live:
+            return
+        lat = _latency()
+        if telemetry.enabled():
+            for _, _, t in live:
+                lat.observe(now - t, model=self.name, stage="queue")
+            telemetry.histogram(
+                "dl4j_inference_batch_occupancy",
+                "aggregated-batch fill fraction per flush "
+                "(requests / batch_limit)",
+                buckets=telemetry.RATIO_BUCKETS).observe(
+                    len(live) / max(1, self.batch_limit))
+        t0 = time.perf_counter()
+        try:
+            with telemetry.span("serving.flush", model=self.name,
+                                requests=len(live)):
+                outs = self.output_batched([x for x, _, _ in live])
+        except BaseException as e:           # noqa: BLE001
+            for _, f, _ in live:
+                f.set_exception(e)
+            return
+        lat.observe(time.perf_counter() - t0, model=self.name,
+                    stage="compute")
+        end = time.monotonic()
+        for (_, f, t), o in zip(live, outs):
+            lat.observe(end - t, model=self.name, stage="total")
+            f.set_result(o)

@@ -1,0 +1,279 @@
+"""Versioned model registry with warmed, atomically hot-swappable
+serving state.
+
+Reference parity: the model-server half of the DL4J serving story — a
+named catalog of models, each with numbered versions, where exactly
+one version per name is *live* and replacing it never drops an
+in-flight request.
+
+Loading dispatches on the artifact:
+
+- ``*.zip`` (ModelSerializer / SameDiff archives) →
+  :meth:`ModelSerializer.restore_model` (which sniffs SameDiff zips)
+- ``*.h5`` / ``*.keras`` → ``KerasModelImport``
+- ``*.onnx`` → ``modelimport.onnx.import_onnx``
+- any in-memory model object passes straight through
+
+Every registered version is wrapped in a
+:class:`~deeplearning4j_tpu.serving.batcher.ServingBatcher` and —
+when ``warmup_shape`` is given — warmed: each batch-size bucket's XLA
+program compiles *before* the version goes live, so the first real
+request never pays the compile stall. The version's ``RetraceGuard``
+signature count is frozen at warmup end;
+:meth:`ModelRegistry.retraces_since_warmup` returning 0 is the proof
+that steady-state serving never recompiled.
+
+Hot-swap protocol (``register`` on an existing name): load → warm →
+flip the current pointer under the registry lock → retire the old
+version. The old batcher keeps draining its queue (its ``shutdown``
+flushes pending requests), so swaps are hitless.
+"""
+from __future__ import annotations
+
+import threading
+import time
+from pathlib import Path
+from typing import Dict, List, Optional, Sequence
+
+from deeplearning4j_tpu.common import telemetry
+from deeplearning4j_tpu.common.compilecache import RetraceGuard
+from deeplearning4j_tpu.serving.batcher import ServingBatcher
+
+
+class ModelStatus:
+    LOADING = "LOADING"
+    WARMING = "WARMING"
+    READY = "READY"
+    RETIRED = "RETIRED"
+
+
+def load_model(path):
+    """Load a serving artifact, dispatching on its extension."""
+    p = str(path)
+    if p.endswith((".h5", ".keras")):
+        from deeplearning4j_tpu.modelimport.keras.importer import \
+            KerasModelImport
+        return KerasModelImport.import_keras_model_and_weights(p)
+    if p.endswith(".onnx"):
+        from deeplearning4j_tpu.modelimport.onnx import import_onnx
+        return import_onnx(p)
+    from deeplearning4j_tpu.utils.serializer import ModelSerializer
+    return ModelSerializer.restore_model(p)
+
+
+class _SameDiffAdapter:
+    """Serve a ``SameDiff`` graph through the generic batcher surface:
+    ``output(batch) -> array``. Placeholder/output names are explicit
+    or inferred (single placeholder, single terminal op output)."""
+
+    def __init__(self, sd, input_name: Optional[str] = None,
+                 output_name: Optional[str] = None):
+        from deeplearning4j_tpu.autodiff.samediff import VariableType
+        self.sd = sd
+        if input_name is None:
+            phs = [v.name for v in sd.vars.values()
+                   if v.var_type == VariableType.PLACEHOLDER]
+            if len(phs) != 1:
+                raise ValueError(
+                    f"cannot infer the input placeholder from "
+                    f"{phs!r}; pass input_name=")
+            input_name = phs[0]
+        if output_name is None:
+            consumed = {n for op in sd.ops for n in op.inputs}
+            outs = [n for op in sd.ops for n in op.outputs
+                    if n not in consumed]
+            if len(outs) != 1:
+                raise ValueError(
+                    f"cannot infer the output from terminal values "
+                    f"{outs!r}; pass output_name=")
+            output_name = outs[0]
+        self.input_name = input_name
+        self.output_name = output_name
+
+    def output(self, x):
+        return self.sd.output({self.input_name: x},
+                              [self.output_name])[self.output_name]
+
+
+class _OnnxAdapter:
+    """Serve an imported ONNX graph (``OnnxImporter``) the same way:
+    single declared-or-inferred input, first graph output."""
+
+    def __init__(self, imp, input_name: Optional[str] = None,
+                 output_name: Optional[str] = None):
+        ins = [input_name] if input_name else list(imp.placeholders)
+        if len(ins) != 1:
+            raise ValueError(f"cannot infer the input from ONNX "
+                             f"placeholders {ins!r}; pass input_name=")
+        self.imp = imp
+        self.input_name = ins[0]
+        self.outputs = [output_name] if output_name else None
+
+    def output(self, x):
+        return self.imp.output({self.input_name: x}, self.outputs)[0]
+
+
+class ModelVersion:
+    """One immutable (model, batcher, guard) serving unit."""
+
+    def __init__(self, name: str, version: int, model,
+                 batcher: ServingBatcher, source: str):
+        self.name = name
+        self.version = version
+        self.model = model
+        self.batcher = batcher
+        self.source = source
+        self.status = ModelStatus.LOADING
+        self.created = time.time()
+        self.warm_signatures = 0      # guard count frozen at warmup end
+
+    @property
+    def guard(self) -> RetraceGuard:
+        return self.batcher.guard
+
+    def retraces_since_warmup(self) -> int:
+        """Distinct signatures compiled after warmup finished — the
+        number that must stay 0 in steady state."""
+        return self.guard.n_signatures - self.warm_signatures
+
+    def describe(self) -> dict:
+        return {
+            "name": self.name,
+            "version": self.version,
+            "status": self.status,
+            "source": self.source,
+            "warm_buckets": list(self.batcher.buckets),
+            "signatures": self.guard.n_signatures,
+            "retraces_since_warmup": self.retraces_since_warmup(),
+            "created": self.created,
+        }
+
+
+class ModelRegistry:
+    """Named, versioned models with an atomic live pointer per name."""
+
+    def __init__(self, mesh=None, *,
+                 default_buckets: Sequence[int] = (8, 32),
+                 batch_window_ms: float = 2.0,
+                 queue_limit: int = 256):
+        self.mesh = mesh
+        self.default_buckets = tuple(default_buckets)
+        self.batch_window_ms = batch_window_ms
+        self.queue_limit = queue_limit
+        self._lock = threading.Lock()
+        self._current: Dict[str, ModelVersion] = {}
+        self._versions: Dict[str, List[ModelVersion]] = {}
+
+    # ------------------------------------------------------------------
+    def register(self, name: str, model, *,
+                 warmup_shape: Optional[Sequence[int]] = None,
+                 warmup_dtype=None,
+                 buckets: Optional[Sequence[int]] = None,
+                 batch_window_ms: Optional[float] = None,
+                 input_name: Optional[str] = None,
+                 output_name: Optional[str] = None) -> ModelVersion:
+        """Register (or hot-swap) the live version of ``name``.
+
+        ``model`` is an in-memory model or an artifact path (zip / h5
+        / keras / onnx). ``warmup_shape`` (one request's shape without
+        the batch dim) triggers per-bucket pre-compilation BEFORE the
+        version goes live; without it the version serves cold (first
+        request compiles). ``input_name``/``output_name`` disambiguate
+        SameDiff placeholders when serving a graph."""
+        if isinstance(model, (str, Path)):
+            source = str(model)
+            model = load_model(model)
+        else:
+            source = f"memory:{type(model).__name__}"
+        from deeplearning4j_tpu.autodiff.samediff import SameDiff
+        from deeplearning4j_tpu.modelimport.onnx.importer import \
+            OnnxImporter
+        if isinstance(model, SameDiff):
+            model = _SameDiffAdapter(model, input_name, output_name)
+        elif isinstance(model, OnnxImporter):
+            model = _OnnxAdapter(model, input_name, output_name)
+
+        with self._lock:
+            version_no = len(self._versions.get(name, ())) + 1
+        guard = RetraceGuard(f"serving:{name}:v{version_no}")
+        batcher = ServingBatcher(
+            model, buckets or self.default_buckets, self.mesh,
+            name=name,
+            batch_window_ms=(batch_window_ms
+                             if batch_window_ms is not None
+                             else self.batch_window_ms),
+            queue_limit=self.queue_limit, guard=guard)
+        ver = ModelVersion(name, version_no, model, batcher, source)
+
+        if warmup_shape is not None:
+            ver.status = ModelStatus.WARMING
+            import numpy as np
+            secs = batcher.warmup(warmup_shape,
+                                  warmup_dtype or np.float32)
+            telemetry.histogram(
+                "dl4j_serving_warmup_total_seconds",
+                "whole-version warmup wall time: every bucket "
+                "compiled + executed once (seconds)").observe(
+                    secs, model=name)
+        ver.warm_signatures = guard.n_signatures
+        ver.status = ModelStatus.READY
+
+        # atomic flip: requests resolving `name` after this line land
+        # on the new version; the old one drains and retires
+        with self._lock:
+            old = self._current.get(name)
+            self._current[name] = ver
+            self._versions.setdefault(name, []).append(ver)
+        if old is not None:
+            telemetry.counter(
+                "dl4j_serving_hot_swaps_total",
+                "live-version replacements per model (old version "
+                "drained, no request dropped)").inc(model=name)
+            old.status = ModelStatus.RETIRED
+            # flushes anything still queued on the old version, then
+            # stops its worker — in-flight futures all resolve
+            old.batcher.shutdown()
+        return ver
+
+    # ------------------------------------------------------------------
+    def model(self, name: str) -> ModelVersion:
+        """The live version of ``name`` (KeyError when unknown)."""
+        with self._lock:
+            return self._current[name]
+
+    def names(self) -> List[str]:
+        with self._lock:
+            return sorted(self._current)
+
+    def describe(self) -> List[dict]:
+        """Every name's versions, live one first (GET /v1/models)."""
+        with self._lock:
+            items = {n: list(vs) for n, vs in self._versions.items()}
+            current = dict(self._current)
+        out = []
+        for name in sorted(items):
+            live = current.get(name)
+            out.append({
+                "name": name,
+                "live_version": live.version if live else None,
+                "versions": [v.describe() for v in items[name]],
+            })
+        return out
+
+    def ready(self) -> bool:
+        """At least one live version is READY (the /readyz answer)."""
+        with self._lock:
+            return any(v.status == ModelStatus.READY
+                       for v in self._current.values())
+
+    def retraces_since_warmup(self, name: str) -> int:
+        return self.model(name).retraces_since_warmup()
+
+    def shutdown(self):
+        """Drain and stop every live batcher (pending requests are
+        flushed, not dropped)."""
+        with self._lock:
+            vers = list(self._current.values())
+        for v in vers:
+            v.batcher.shutdown()
+            v.status = ModelStatus.RETIRED

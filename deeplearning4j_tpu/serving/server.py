@@ -1,0 +1,204 @@
+"""HTTP inference server over the model registry.
+
+Zero-dependency stdlib ``ThreadingHTTPServer`` (the ``ui.server``
+pattern, via the shared ``common.httputil`` plumbing). One handler
+thread per connection blocks on its request's Future while the
+per-model batcher aggregates concurrent requests into bucket-padded
+flushes.
+
+Endpoints:
+
+- ``POST /v1/models/<name>:predict`` — JSON body
+  ``{"inputs": [[...], ...], "deadline_ms": optional}`` (row-major
+  nested lists, leading batch dim) → ``{"outputs": ..., "model",
+  "version", "batch"}``; or a raw ``.npy`` body
+  (``Content-Type: application/octet-stream``) → raw ``.npy``
+  response. ``X-Deadline-Ms`` header works for both body types.
+- ``GET /v1/models`` — names, versions, status, warm buckets.
+- ``GET /healthz`` — process liveness (200 while serving).
+- ``GET /readyz`` — 200 once ≥1 model is READY and not draining.
+- ``GET /metrics`` — the process-wide Prometheus registry.
+
+Status mapping: shed (queue full) → 429 + ``Retry-After``; draining →
+503 + ``Retry-After``; deadline expired → 504; unknown model → 404;
+bad body → 400.
+"""
+from __future__ import annotations
+
+import io
+import json
+import re
+import threading
+from concurrent import futures
+from typing import Optional
+
+import numpy as np
+
+from deeplearning4j_tpu.common import telemetry
+from deeplearning4j_tpu.common.httputil import (QuietHandler,
+                                                start_http_server)
+from deeplearning4j_tpu.serving.admission import (AdmissionController,
+                                                  DeadlineExceeded,
+                                                  ShedError,
+                                                  deadline_after_ms)
+from deeplearning4j_tpu.serving.registry import ModelRegistry
+
+_PREDICT_RE = re.compile(r"^/v1/models/([^/:]+):predict$")
+
+_NPY_TYPES = ("application/octet-stream", "application/x-npy")
+
+
+class InferenceServer:
+    """Serve a :class:`ModelRegistry` over HTTP."""
+
+    def __init__(self, registry: ModelRegistry,
+                 admission: Optional[AdmissionController] = None,
+                 *, request_timeout_s: float = 60.0):
+        self.registry = registry
+        self.admission = admission if admission is not None \
+            else AdmissionController()
+        #: cap on how long a handler thread waits for its Future when
+        #: the request carries no deadline
+        self.request_timeout_s = request_timeout_s
+        self._httpd = None
+        self._thread: Optional[threading.Thread] = None
+        self.port: Optional[int] = None
+
+    # ------------------------------------------------------------------
+    def start(self, port: int = 0) -> "InferenceServer":
+        """Serve on ``DL4J_TPU_HTTP_HOST``:port (0 picks a free port;
+        see ``self.port``). Idempotent."""
+        if self._httpd is not None:
+            return self
+        server = self
+
+        class Handler(QuietHandler):
+            def do_GET(self):               # noqa: N802
+                if self.path == "/v1/models":
+                    self.send_json({"models":
+                                    server.registry.describe()})
+                elif self.path == "/healthz":
+                    self.send_body(b"ok\n", "text/plain")
+                elif self.path == "/readyz":
+                    ok = (server.registry.ready()
+                          and not server.admission.draining)
+                    self.send_body(b"ready\n" if ok else b"not ready\n",
+                                   "text/plain", 200 if ok else 503)
+                elif self.path == "/metrics":
+                    self.send_metrics()
+                else:
+                    self.send_json({"error": "not found"}, 404)
+
+            def do_POST(self):              # noqa: N802
+                m = _PREDICT_RE.match(self.path)
+                if not m:
+                    self.send_json({"error": "not found"}, 404)
+                    return
+                server._predict(self, m.group(1))
+
+        self._httpd, self._thread = start_http_server(Handler, port)
+        self.port = self._httpd.server_address[1]
+        return self
+
+    def stop(self, drain: bool = True, timeout: float = 30.0):
+        """Stop serving. With ``drain`` (default), admission first
+        rejects new work (503) and in-flight requests finish before
+        the listener closes — the graceful path."""
+        if self._httpd is None:
+            return
+        if drain:
+            self.admission.drain(timeout)
+        self._httpd.shutdown()
+        self._httpd.server_close()
+        self._httpd = None
+        self._thread = None
+        self.port = None
+
+    @property
+    def url(self) -> Optional[str]:
+        if not self.port:
+            return None
+        host = self._httpd.server_address[0] if self._httpd else \
+            "127.0.0.1"
+        if host in ("0.0.0.0", "::"):   # wildcard bind: loopback works
+            host = "127.0.0.1"
+        return f"http://{host}:{self.port}"
+
+    # ------------------------------------------------------------------
+    def _predict(self, handler: QuietHandler, name: str):
+        counted = telemetry.counter(
+            "dl4j_serving_requests_total",
+            "predict requests by model and HTTP status code")
+
+        def finish_json(obj, code, headers=None):
+            counted.inc(model=name, code=str(code))
+            handler.send_json(obj, code, headers)
+
+        try:
+            version = self.registry.model(name)
+        except KeyError:
+            finish_json({"error": f"model {name!r} not found"}, 404)
+            return
+        raw = (handler.headers.get("Content-Type", "")
+               .split(";")[0].strip() in _NPY_TYPES)
+        body = handler.read_body()
+        deadline_ms = handler.headers.get("X-Deadline-Ms")
+        try:
+            if raw:
+                x = np.load(io.BytesIO(body), allow_pickle=False)
+            else:
+                doc = json.loads(body.decode() or "{}")
+                if "inputs" not in doc:
+                    finish_json({"error": "body must carry 'inputs'"},
+                                400)
+                    return
+                x = np.asarray(doc["inputs"], dtype=np.float32)
+                if deadline_ms is None:
+                    deadline_ms = doc.get("deadline_ms")
+            if x.ndim < 1 or x.shape[0] < 1:
+                finish_json({"error": "inputs need a leading batch "
+                                      "dim of >= 1"}, 400)
+                return
+        except Exception as e:          # malformed json / npy
+            finish_json({"error": f"bad request body: {e}"}, 400)
+            return
+        deadline = deadline_after_ms(
+            float(deadline_ms) if deadline_ms is not None else None)
+        try:
+            with self.admission.track(name):
+                fut = version.batcher.submit(x, deadline=deadline)
+                timeout = (float(deadline_ms) / 1e3 + 1.0
+                           if deadline_ms is not None
+                           else self.request_timeout_s)
+                try:
+                    out = fut.result(timeout=timeout)
+                except DeadlineExceeded as e:
+                    finish_json({"error": str(e)}, 504)
+                    return
+                except (TimeoutError, futures.TimeoutError):
+                    # pre-3.11 futures.TimeoutError is its own type
+                    fut.cancel()
+                    finish_json({"error": "request timed out"}, 504)
+                    return
+        except ShedError as e:
+            code = 503 if e.reason == "draining" else 429
+            finish_json(
+                {"error": str(e), "reason": e.reason}, code,
+                {"Retry-After": self.admission.retry_after_header()})
+            return
+        except Exception as e:          # model raised during compute
+            finish_json({"error": f"inference failed: {e}"}, 500)
+            return
+        if raw:
+            buf = io.BytesIO()
+            np.save(buf, np.asarray(out), allow_pickle=False)
+            counted.inc(model=name, code="200")
+            handler.send_body(buf.getvalue(),
+                              "application/octet-stream",
+                              headers={"X-Model-Version":
+                                       str(version.version)})
+        else:
+            finish_json({"outputs": np.asarray(out).tolist(),
+                         "model": name,
+                         "version": version.version,
+                         "batch": int(x.shape[0])}, 200)

@@ -18,10 +18,12 @@ Endpoints:
 """
 from __future__ import annotations
 
-import json
 import threading
-from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from http.server import ThreadingHTTPServer
 from typing import List, Optional
+
+from deeplearning4j_tpu.common.httputil import (QuietHandler,
+                                                start_http_server)
 
 
 _PAGE = """<!DOCTYPE html>
@@ -113,38 +115,21 @@ class UIServer:
 
     # ------------------------------------------------------------------
     def start(self, port: int = 9000) -> "UIServer":
-        """Serve on 127.0.0.1:port (0 picks a free port; see
-        ``self.port``). Idempotent."""
+        """Serve on ``DL4J_TPU_HTTP_HOST``:port (0 picks a free port;
+        see ``self.port``). Idempotent."""
         if self._httpd is not None:
             return self
         server = self
 
-        class Handler(BaseHTTPRequestHandler):
-            def log_message(self, *a):      # silence request logging
-                pass
-
-            def _json(self, obj, code=200):
-                body = json.dumps(obj).encode()
-                self.send_response(code)
-                self.send_header("Content-Type", "application/json")
-                self.send_header("Content-Length", str(len(body)))
-                self.end_headers()
-                self.wfile.write(body)
-
+        class Handler(QuietHandler):
             def do_GET(self):               # noqa: N802
                 if self.path == "/" or self.path.startswith("/train"):
-                    body = _PAGE.encode()
-                    self.send_response(200)
-                    self.send_header("Content-Type",
-                                     "text/html; charset=utf-8")
-                    self.send_header("Content-Length", str(len(body)))
-                    self.end_headers()
-                    self.wfile.write(body)
+                    self.send_html(_PAGE)
                 elif self.path == "/api/reports":
                     reports = []
                     for s in server._storages:
                         reports.extend(s.get_reports())
-                    self._json(reports)
+                    self.send_json(reports)
                 elif self.path == "/api/latest":
                     latest = None
                     for s in server._storages:
@@ -152,27 +137,14 @@ class UIServer:
                         if r and (latest is None or
                                   r["time"] > latest["time"]):
                             latest = r
-                    self._json(latest)
+                    self.send_json(latest)
                 elif self.path == "/metrics":
-                    from deeplearning4j_tpu.common.telemetry import \
-                        MetricsRegistry
-                    body = MetricsRegistry.get() \
-                        .render_prometheus().encode()
-                    self.send_response(200)
-                    self.send_header(
-                        "Content-Type",
-                        "text/plain; version=0.0.4; charset=utf-8")
-                    self.send_header("Content-Length", str(len(body)))
-                    self.end_headers()
-                    self.wfile.write(body)
+                    self.send_metrics()
                 else:
-                    self._json({"error": "not found"}, 404)
+                    self.send_json({"error": "not found"}, 404)
 
-        self._httpd = ThreadingHTTPServer(("127.0.0.1", port), Handler)
+        self._httpd, self._thread = start_http_server(Handler, port)
         self.port = self._httpd.server_address[1]
-        self._thread = threading.Thread(target=self._httpd.serve_forever,
-                                        daemon=True)
-        self._thread.start()
         return self
 
     def stop(self):
@@ -185,4 +157,10 @@ class UIServer:
 
     @property
     def url(self) -> Optional[str]:
-        return f"http://127.0.0.1:{self.port}" if self.port else None
+        if not self.port:
+            return None
+        host = self._httpd.server_address[0] if self._httpd else \
+            "127.0.0.1"
+        if host in ("0.0.0.0", "::"):   # wildcard bind: loopback works
+            host = "127.0.0.1"
+        return f"http://{host}:{self.port}"

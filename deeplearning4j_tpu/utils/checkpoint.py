@@ -216,22 +216,15 @@ class CheckpointListener(TrainingListener):
 
     @staticmethod
     def _restore_any(cp: Path):
-        """Format-dispatching restore: SameDiff checkpoints (written by
-        ``SameDiff.checkpoint_snapshot`` — a zip with a ``graph.json``
-        entry) load via ``SameDiff.load``; MLN/graph zips via
-        ``ModelSerializer``. Without this, FaultTolerantTrainer resume
-        on a SameDiff job fell into restore_multi_layer_network and
-        failed confusingly (ADVICE.md)."""
-        import zipfile
+        """Format-dispatching restore. ``ModelSerializer.restore_model``
+        sniffs SameDiff archives (zip with a ``graph.json`` entry) and
+        MLN/graph zips alike — the one restore entry point
+        FaultTolerantTrainer resume and the serving registry share
+        (ADVICE.md: SameDiff resumes used to fall into
+        restore_multi_layer_network and fail confusingly)."""
         with telemetry.span("checkpoint.load", path=str(cp)):
             t0 = time.perf_counter()
-            with zipfile.ZipFile(cp) as z:
-                is_samediff = "graph.json" in z.namelist()
-            if is_samediff:
-                from deeplearning4j_tpu.autodiff.samediff import SameDiff
-                model = SameDiff.load(str(cp))
-            else:
-                model = ModelSerializer.restore_model(cp)
+            model = ModelSerializer.restore_model(cp)
             if telemetry.enabled():
                 telemetry.histogram(
                     "dl4j_checkpoint_load_seconds",

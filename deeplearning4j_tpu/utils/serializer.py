@@ -85,12 +85,34 @@ class ModelSerializer:
             }))
 
     @staticmethod
-    def restore_model(path, load_updater: bool = True):
-        """Dispatch on the checkpoint's meta.json model_class."""
+    def peek_meta(path) -> dict:
+        """The archive's identity without loading any weights:
+        ``model_class`` (sniffed for pre-meta / SameDiff zips),
+        iteration/epoch counts, format version. The serving registry
+        uses this to describe artifacts it hasn't loaded yet."""
         with zipfile.ZipFile(Path(path)) as zf:
+            names = zf.namelist()
             meta = json.loads(zf.read(META_ENTRY).decode()) \
-                if META_ENTRY in zf.namelist() else {}
-        if meta.get("model_class") == "ComputationGraph":
+                if META_ENTRY in names else {}
+            if "model_class" not in meta:
+                meta["model_class"] = ("SameDiff"
+                                       if "graph.json" in names
+                                       else "MultiLayerNetwork")
+        return meta
+
+    @staticmethod
+    def restore_model(path, load_updater: bool = True):
+        """Dispatch on the archive's meta.json model_class. SameDiff
+        archives (a zip with a ``graph.json`` entry — written by
+        ``SameDiff.save``/``checkpoint_snapshot``) load via
+        ``SameDiff.load``: one restore entry point for every zip the
+        stack writes."""
+        meta = ModelSerializer.peek_meta(path)
+        cls = meta.get("model_class")
+        if cls == "SameDiff":
+            from deeplearning4j_tpu.autodiff.samediff import SameDiff
+            return SameDiff.load(str(path))
+        if cls == "ComputationGraph":
             return ModelSerializer.restore_computation_graph(
                 path, load_updater)
         return ModelSerializer.restore_multi_layer_network(

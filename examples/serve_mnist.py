@@ -1,0 +1,120 @@
+"""Serve an MNIST classifier over HTTP (reference pairing:
+ParallelInference + a network-facing model server).
+
+The full serving lifecycle on one page:
+
+  1. train a small MNIST-shaped network,
+  2. register it in a ``ModelRegistry`` with shape-bucketed warmup
+     (every batch bucket's XLA program compiles BEFORE the first
+     request),
+  3. start the ``InferenceServer`` and drive it like a client would —
+     JSON predict requests with a deadline,
+  4. hot-swap a retrained version under the same name (no request
+     dropped, live pointer flips atomically),
+  5. read back the serving metrics from ``/metrics``.
+
+Synthetic MNIST-shaped data keeps it offline-runnable; point
+``_data()`` at ``datasets.mnist`` for the real thing.
+"""
+import os
+import sys
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+import json
+import urllib.request
+
+import numpy as np
+
+from deeplearning4j_tpu.activations import Activation
+from deeplearning4j_tpu.learning import Adam
+from deeplearning4j_tpu.lossfunctions import LossFunction
+from deeplearning4j_tpu.nn import (InputType, MultiLayerNetwork,
+                                   NeuralNetConfiguration)
+from deeplearning4j_tpu.nn.conf.layers import DenseLayer, OutputLayer
+from deeplearning4j_tpu.serving import (AdmissionController,
+                                        InferenceServer, ModelRegistry)
+
+
+def _net(seed):
+    return MultiLayerNetwork(
+        (NeuralNetConfiguration.Builder().seed(seed).updater(Adam(1e-3))
+         .list()
+         .layer(DenseLayer(n_out=64, activation=Activation.RELU))
+         .layer(OutputLayer(n_out=10,
+                            loss_function=LossFunction.MCXENT,
+                            activation=Activation.SOFTMAX))
+         .set_input_type(InputType.feed_forward(784)).build())).init()
+
+
+def _data(n=256, seed=0):
+    rng = np.random.RandomState(seed)
+    x = rng.rand(n, 784).astype(np.float32)      # MNIST-shaped pixels
+    y = np.eye(10, dtype=np.float32)[rng.randint(0, 10, n)]
+    return x, y
+
+
+def _predict(base, x, deadline_ms=250):
+    req = urllib.request.Request(
+        base + "/v1/models/mnist:predict",
+        data=json.dumps({"inputs": x.tolist(),
+                         "deadline_ms": deadline_ms}).encode(),
+        headers={"Content-Type": "application/json"})
+    return json.loads(urllib.request.urlopen(req).read())
+
+
+def main():
+    x, y = _data()
+    net = _net(seed=42)
+    for _ in range(5):
+        net.fit(x, y)
+
+    # registry + warmup: buckets (8, 32) compile now, not on request 1
+    reg = ModelRegistry(default_buckets=(8, 32), batch_window_ms=2.0)
+    ver = reg.register("mnist", net, warmup_shape=(784,))
+    print(f"registered mnist v{ver.version}: "
+          f"buckets={list(ver.batcher.buckets)}, "
+          f"warm signatures={ver.warm_signatures}")
+
+    srv = InferenceServer(reg, AdmissionController(max_queue=64))
+    srv.start(port=0)                 # 0 picks a free port; see .url
+    base = srv.url
+    print("serving on", base)
+
+    # a client request (single digit, 250ms deadline)
+    resp = _predict(base, x[:1])
+    probs = np.asarray(resp["outputs"][0])
+    print(f"v{resp['version']} prediction: digit "
+          f"{int(probs.argmax())} (p={probs.max():.3f})")
+    # tolerance, not equality: the bucket-padded (batch 8) and direct
+    # (batch 1) programs are separate XLA compilations whose 784-dim
+    # matmuls may tile differently in the low bits
+    np.testing.assert_allclose(
+        np.asarray(resp["outputs"], np.float32),
+        np.asarray(net.output(x[:1])), rtol=1e-5, atol=1e-6)
+
+    # hot-swap: retrain, re-register the SAME name — version bumps,
+    # no request dropped, warmup happens before the pointer flips
+    net2 = _net(seed=7)
+    for _ in range(10):
+        net2.fit(x, y)
+    reg.register("mnist", net2, warmup_shape=(784,))
+    resp = _predict(base, x[:1])
+    print(f"after hot-swap: serving v{resp['version']}")
+    assert resp["version"] == 2
+
+    # zero post-warmup recompiles is the serving-latency guarantee
+    print("retraces since warmup:",
+          reg.retraces_since_warmup("mnist"))
+
+    metrics = urllib.request.urlopen(base + "/metrics").read().decode()
+    served = [ln for ln in metrics.splitlines()
+              if ln.startswith("dl4j_serving_requests_total")]
+    print("\n".join(served))
+
+    srv.stop()
+    reg.shutdown()
+    return reg.retraces_since_warmup("mnist")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,12 +1,19 @@
 #!/usr/bin/env python
 """Keep the README metrics catalog honest.
 
-Scans the source tree for telemetry metric registrations
+Scans the source tree — every ``deeplearning4j_tpu`` subpackage
+(including ``serving/``), ``benchmarks/``, ``scripts/``,
+``examples/``, and ``bench.py`` — for telemetry metric registrations
 (``telemetry.counter("dl4j_...")`` / ``gauge`` / ``histogram`` — and
-the registry-method spellings) and fails if any registered ``dl4j_*``
-metric name is missing from the README "Observability" catalog, or if
-the catalog documents a metric no code registers (stale docs are as
-misleading as missing ones).
+the registry-method spellings) and fails if:
+
+- a registered ``dl4j_*`` metric is missing from the README
+  "Observability" catalog,
+- the catalog documents a metric no code registers (stale docs are as
+  misleading as missing ones), or
+- the catalog's Type column disagrees with the registration kind
+  (a counter documented as a gauge sends scrapers down the wrong
+  rate()/delta() path).
 
 Runs as a tier-1 test (tests/test_telemetry.py) and standalone:
 
@@ -17,6 +24,7 @@ from __future__ import annotations
 import pathlib
 import re
 import sys
+from typing import Dict, Set
 
 ROOT = pathlib.Path(__file__).resolve().parent.parent
 README = ROOT / "README.md"
@@ -25,38 +33,58 @@ README = ROOT / "README.md"
 #: histogram("name" — any receiver (telemetry module, a registry, or
 #: the module-level helpers called bare inside telemetry.py)
 _REG_RE = re.compile(
-    r"\b(?:counter|gauge|histogram)\(\s*\n?\s*['\"](dl4j_[a-z0-9_]+)")
+    r"\b(counter|gauge|histogram)\(\s*\n?\s*['\"](dl4j_[a-z0-9_]+)")
 
 #: names prefixed dl4j_ anywhere in the README catalog section
 _DOC_RE = re.compile(r"`(dl4j_[a-z0-9_]+)`")
 
+#: catalog table rows: | `name` | kind | ...
+_DOC_ROW_RE = re.compile(
+    r"^\|\s*`(dl4j_[a-z0-9_]+)`\s*\|\s*(counter|gauge|histogram)\s*\|",
+    re.M)
+
 #: registrations that are deliberately NOT part of the public catalog
 _EXEMPT = {"dl4j_bench_counter_total", "dl4j_bench_hist_seconds"}
 
-
-def registered_metrics() -> set:
-    names = set()
-    for base in ("deeplearning4j_tpu", "benchmarks", "scripts"):
-        for p in (ROOT / base).rglob("*.py"):
-            names.update(_REG_RE.findall(p.read_text()))
-    names.update(_REG_RE.findall((ROOT / "bench.py").read_text()))
-    return names - _EXEMPT
+_SCAN_BASES = ("deeplearning4j_tpu", "benchmarks", "scripts",
+               "examples")
 
 
-def documented_metrics() -> set:
+def registered_metrics() -> Dict[str, Set[str]]:
+    """{metric name: {registration kinds seen}} across the tree."""
+    names: Dict[str, Set[str]] = {}
+    texts = []
+    for base in _SCAN_BASES:
+        texts.extend(p.read_text()
+                     for p in (ROOT / base).rglob("*.py"))
+    texts.append((ROOT / "bench.py").read_text())
+    for text in texts:
+        for kind, name in _REG_RE.findall(text):
+            if name not in _EXEMPT:
+                names.setdefault(name, set()).add(kind)
+    return names
+
+
+def documented_metrics() -> Dict[str, str]:
+    """{metric name: documented kind} from the catalog table (names
+    mentioned outside table rows count as documented with kind '')."""
     text = README.read_text()
     m = re.search(r"## Observability(.*?)(?:\n## |\Z)", text, re.S)
     if not m:
-        return set()
-    return set(_DOC_RE.findall(m.group(1)))
+        return {}
+    section = m.group(1)
+    doc = {name: "" for name in _DOC_RE.findall(section)}
+    doc.update({name: kind
+                for name, kind in _DOC_ROW_RE.findall(section)})
+    return doc
 
 
 def main() -> int:
     reg = registered_metrics()
     doc = documented_metrics()
     rc = 0
-    missing = sorted(reg - doc)
-    stale = sorted(doc - reg)
+    missing = sorted(set(reg) - set(doc))
+    stale = sorted(set(doc) - set(reg))
     if not doc:
         print("FAIL: README has no '## Observability' catalog section")
         rc = 1
@@ -72,9 +100,19 @@ def main() -> int:
         for n in stale:
             print(f"  - {n}")
         rc = 1
+    kind_clash = sorted(
+        (n, kinds, doc[n]) for n, kinds in reg.items()
+        if doc.get(n) and doc[n] not in kinds)
+    if kind_clash:
+        print("FAIL: catalog Type column disagrees with the "
+              "registration kind:")
+        for n, kinds, documented in kind_clash:
+            print(f"  - {n}: registered {sorted(kinds)}, "
+                  f"documented {documented!r}")
+        rc = 1
     if rc == 0:
-        print(f"OK: {len(reg)} registered metrics all documented, "
-              f"no stale catalog entries")
+        print(f"OK: {len(reg)} registered metrics all documented with "
+              f"matching types, no stale catalog entries")
     return rc
 
 

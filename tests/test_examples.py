@@ -21,7 +21,7 @@ def _load(name):
                                   "transfer_learning", "data_parallel",
                                   "custom_layer_samediff",
                                   "tf_frozen_import", "a3c_cartpole",
-                                  "serving_inference"])
+                                  "serving_inference", "serve_mnist"])
 def test_importable(name):
     assert _load(name).main is not None
 
@@ -42,3 +42,8 @@ def test_data_parallel_example_runs():
 
 def test_serving_inference_example_runs():
     _load("serving_inference").main()   # asserts parity internally
+
+
+def test_serve_mnist_example_runs():
+    # returns retraces_since_warmup — the zero-recompile guarantee
+    assert _load("serve_mnist").main() == 0

@@ -182,6 +182,22 @@ def test_parallel_inference_async_submit_batches_and_matches():
     assert getattr(pi2, "_worker", None) is None
 
 
+def test_parallel_inference_empty_request_list_returns_empty():
+    """output_batched([]) used to raise ValueError out of
+    np.concatenate; an empty flush must be a no-op (ISSUE 3
+    satellite)."""
+    net = _mlp()
+    pi = ParallelInference.Builder(net).build()
+    assert pi.output_batched([]) == []
+    # the _flush path guards the same way: an all-cancelled batch
+    # reaches the worker as an empty live list and must not raise
+    pi._flush([])
+    x = np.random.RandomState(0).randn(2, 8).astype(np.float32)
+    out = pi.submit(x).result(timeout=60)     # worker still healthy
+    assert out.shape == (2, 3)
+    pi.shutdown()
+
+
 def test_parallel_inference_cancelled_future_does_not_kill_worker():
     """A client cancelling its queued request (timeout) must not kill
     the batching worker or starve its batch-mates (code-review

@@ -80,6 +80,20 @@ class TestRegistry:
         assert abs(s.sum - 5.565) < 1e-9
         assert h.count_of() == 5
 
+    def test_histogram_quantile_estimate(self):
+        h = telemetry.histogram("dl4j_t_q", "x",
+                                buckets=(0.01, 0.1, 1.0))
+        assert h.quantile(0.5) == 0.0           # no observations yet
+        for v in (0.005, 0.02, 0.05, 0.2, 5.0):
+            h.observe(v)
+        # median target 2.5 lands in the (0.01, 0.1] bucket (2 obs):
+        # linear interpolation inside it
+        q50 = h.quantile(0.5)
+        assert 0.01 < q50 <= 0.1
+        # +Inf observations clamp to the top finite edge
+        assert h.quantile(0.99) == 1.0
+        assert h.quantile(0.2) <= 0.01
+
     def test_disabled_records_nothing(self):
         reg = MetricsRegistry.get()
         reg.set_enabled(False)
