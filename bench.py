@@ -196,6 +196,33 @@ def main():
                   file=sys.stderr)
     except Exception as e:
         print(f"serving leg failed: {e!r}", file=sys.stderr)
+    # Update-sharding leg: ZeRO-1 sharded vs dense exchange — per-chip
+    # updater-state residency + step time, and the accumulation-window
+    # micro-step times. CPU-proxy subprocess on the virtual 8-device
+    # mesh, like the legs above.
+    try:
+        env = {**os.environ, "PYTHONPATH": "", "JAX_PLATFORMS": "cpu"}
+        out = subprocess.run(
+            [sys.executable,
+             os.path.join(_ROOT, "benchmarks",
+                          "bench_update_sharding.py")],
+            capture_output=True, text=True, timeout=900, env=env,
+            cwd=_ROOT)
+        if out.returncode != 0:
+            raise RuntimeError(
+                f"rc={out.returncode}: {out.stderr.strip()[-400:]}")
+        for ln in out.stdout.strip().splitlines():
+            if not ln.startswith("{"):
+                continue              # tolerate library banners
+            rec = json.loads(ln)
+            if rec.get("metric") == "update_sharding":
+                rec.pop("metric")
+                line["update_sharding"] = rec
+        if "update_sharding" not in line:
+            print("update-sharding leg: no line in child output",
+                  file=sys.stderr)
+    except Exception as e:
+        print(f"update-sharding leg failed: {e!r}", file=sys.stderr)
     # Telemetry panel: the registry the run's hot paths recorded into
     # (train-step histogram, compile-cache counters, prefetch stats
     # when an iterator fed) — the same data /metrics would serve.

@@ -1147,7 +1147,8 @@ class SameDiff:
             if not (isinstance(k, tuple) and k
                     and k[0] in ("train", "train_multi"))}
 
-    def _build_raw_train_step(self, ph_names: Tuple[str, ...]):
+    def _build_raw_train_step(self, ph_names: Tuple[str, ...],
+                              mesh=None, axis: str = "data"):
         cfg = self.training_config
         fn, var_names = self._build_fn(tuple(self.loss_variables),
                                        ph_names, True)
@@ -1167,6 +1168,16 @@ class SameDiff:
                 return total
 
             loss, grads = jax.value_and_grad(loss_fn)(var_vals)
+            if mesh is not None:
+                # ZeRO-1 sharded tail (parallel.zero): updater + state
+                # on 1/N shards; new_vars come back replicated and in
+                # each variable's own dtype
+                from deeplearning4j_tpu.parallel.zero import \
+                    apply_update_sharded
+                new_vars, new_state = apply_update_sharded(
+                    updater, grads, var_vals, upd_state, iteration,
+                    mesh, axis)
+                return new_vars, new_state, loss
             updates, new_state = updater.apply(grads, upd_state,
                                                iteration)
             # updater math (bias corrections etc.) may run in f32;
@@ -1215,13 +1226,18 @@ class SameDiff:
         ph_vals, mesh_sig = _shard_placeholders(
             mesh, ph_vals, batch_names=(cfg.data_set_feature_mapping +
                                         cfg.data_set_label_mapping))
-        key = (tuple(sorted(ph_vals)), mesh_sig)
+        from deeplearning4j_tpu.parallel.zero import (
+            UpdateExchange, resolve_update_exchange)
+        sharded = (resolve_update_exchange(mesh)
+                   is UpdateExchange.SHARDED)
+        key = (tuple(sorted(ph_vals)), mesh_sig, sharded)
         cached = self._exec_cache.get(("train_multi", key))
         if cached is None:
             from deeplearning4j_tpu.common.compilecache import \
                 enable_persistent_cache
             enable_persistent_cache()
-            raw, trainable = self._build_raw_train_step(tuple(ph_vals))
+            raw, trainable = self._build_raw_train_step(
+                tuple(ph_vals), mesh if sharded else None)
 
             def multi(var_vals, upd_state, ph, rng, it0, n):
                 def body(i, carry):
@@ -1254,13 +1270,33 @@ class SameDiff:
             self._updater_state = cfg.updater.init_state(
                 {n: self._arrays[n] for n in trainable})
             self._restore_updater_leaves()
+        self._updater_trainable = list(trainable)
         var_vals = {n: self._arrays[n] for n in trainable}
+        # layout sync: the sharded step consumes/produces the ZeRO-1
+        # flat state; the dense step the per-variable slot trees
+        from deeplearning4j_tpu.learning.updaters import is_dp_sharded
+        if sharded and self._updater_state and \
+                not is_dp_sharded(self._updater_state):
+            from deeplearning4j_tpu.parallel.zero import to_sharded_state
+            self._updater_state = to_sharded_state(
+                var_vals, self._updater_state, mesh.shape["data"])
+        elif not sharded and is_dp_sharded(self._updater_state):
+            from deeplearning4j_tpu.parallel.zero import to_dense_state
+            self._updater_state = to_dense_state(var_vals,
+                                                 self._updater_state)
         self._rng, rng = jax.random.split(self._rng)
         if mesh is not None:
             from deeplearning4j_tpu.parallel import replicate_tree
             var_vals = replicate_tree(mesh, var_vals)
-            self._updater_state = replicate_tree(
-                mesh, self._updater_state)
+            if sharded:
+                # 1/N of the optimizer state per replica — the HBM win
+                from deeplearning4j_tpu.parallel.zero import \
+                    place_updater_states
+                self._updater_state = place_updater_states(
+                    mesh, {"state": self._updater_state})["state"]
+            else:
+                self._updater_state = replicate_tree(
+                    mesh, self._updater_state)
             rng = replicate_tree(mesh, rng)
         from deeplearning4j_tpu.common import telemetry
         with telemetry.step_span("SameDiff", steps=n_steps):
@@ -1420,7 +1456,17 @@ class SameDiff:
                         self._updater_state = cfg.updater.init_state(
                             {n: self._arrays[n] for n in trainable})
                         self._restore_updater_leaves()
+                    self._updater_trainable = list(trainable)
                 var_vals = {n: self._arrays[n] for n in trainable}
+                from deeplearning4j_tpu.learning.updaters import \
+                    is_dp_sharded
+                if is_dp_sharded(self._updater_state):
+                    # left over from a ZeRO-1 fit_steps(mesh=...) run;
+                    # this dense step needs the slot-tree layout
+                    from deeplearning4j_tpu.parallel.zero import \
+                        to_dense_state
+                    self._updater_state = to_dense_state(
+                        var_vals, self._updater_state)
                 self._rng, rng = jax.random.split(self._rng)
                 from deeplearning4j_tpu.common import telemetry
                 with telemetry.step_span("SameDiff"):
@@ -1536,7 +1582,18 @@ class SameDiff:
         arrays = {k: np.array(v) for k, v in self._arrays.items()}
         upd_leaves = None
         if save_updater_state and self._updater_state is not None:
-            leaves, _ = jax.tree_util.tree_flatten(self._updater_state)
+            state = self._updater_state
+            from deeplearning4j_tpu.learning.updaters import \
+                is_dp_sharded
+            if is_dp_sharded(state):
+                # serialize the dense per-variable layout so the saved
+                # leaf order/count is independent of mesh/shard count
+                from deeplearning4j_tpu.parallel.zero import \
+                    to_dense_state
+                names = getattr(self, "_updater_trainable", ())
+                state = to_dense_state(
+                    {n: self._arrays[n] for n in names}, state)
+            leaves, _ = jax.tree_util.tree_flatten(state)
             upd_leaves = [np.array(l) for l in leaves]
         return graph, arrays, cf_arrays, upd_leaves
 

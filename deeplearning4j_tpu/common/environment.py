@@ -40,6 +40,11 @@ class _Env:
     # unified telemetry spine (common.telemetry): metrics registry +
     # chrome-trace spans across train/infer/ETL; /metrics on UIServer
     telemetry: bool = True
+    # ZeRO-1 cross-replica sharded weight update (parallel.zero): on a
+    # dp>1 mesh the updater + its state run on a 1/N parameter shard
+    # per replica instead of fully replicated. 0 restores the dense
+    # replicated update exactly.
+    sharded_update: bool = True
     extra: dict = field(default_factory=dict)
 
     def set_debug(self, v: bool):
@@ -60,7 +65,8 @@ class Environment:
       DL4J_TPU_CHECK_NAN, DL4J_TPU_CHECK_INF, DL4J_TPU_ALLOW_HELPERS,
       DL4J_TPU_DEVICE_PREFETCH, DL4J_TPU_DEVICE_PREFETCH_DEPTH,
       DL4J_TPU_COMPILE_CACHE, DL4J_TPU_COMPILE_CACHE_DIR,
-      DL4J_TPU_RETRACE_WARN, DL4J_TPU_TELEMETRY
+      DL4J_TPU_RETRACE_WARN, DL4J_TPU_TELEMETRY,
+      DL4J_TPU_SHARDED_UPDATE
     """
 
     _inst: _Env | None = None
@@ -92,6 +98,7 @@ class Environment:
                     retrace_warn_threshold=int(os.environ.get(
                         "DL4J_TPU_RETRACE_WARN", "5")),
                     telemetry=b("DL4J_TPU_TELEMETRY", True),
+                    sharded_update=b("DL4J_TPU_SHARDED_UPDATE", True),
                 )
             return cls._inst
 

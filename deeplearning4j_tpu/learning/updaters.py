@@ -15,10 +15,11 @@ Sign convention matches the reference: ``apply`` returns the quantity to be
 from __future__ import annotations
 
 from dataclasses import dataclass, field
-from typing import Any, Union
+from typing import Any, Dict, List, Tuple, Union
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from deeplearning4j_tpu.learning.schedules import ISchedule
 
@@ -27,6 +28,87 @@ LrLike = Union[float, ISchedule]
 
 def _tmap(fn, *trees):
     return jax.tree_util.tree_map(fn, *trees)
+
+
+# ---------------------------------------------------------------------------
+# ZeRO-1 flat layout: arbitrary param pytrees raveled into one padded 1-D
+# vector per dtype, so optimizer state and the weight update can shard
+# evenly along a data-parallel mesh axis (Xu et al., "Automatic
+# Cross-Replica Sharding of Weight Update in Data-Parallel Training").
+# The spec is pure shape metadata — building it under jit tracing is fine.
+
+#: reserved key marking an updater-state dict as dp-sharded flat layout
+DP_SHARDED_KEY = "__dp_sharded__"
+
+
+def is_dp_sharded(state) -> bool:
+    return isinstance(state, dict) and DP_SHARDED_KEY in state
+
+
+class DpFlatSpec:
+    """How a pytree ravels into per-dtype padded flat vectors.
+
+    ``infos``: per leaf (dtype key, offset into its dtype vector, shape);
+    ``sizes``: dtype key -> (original length, padded length). The padded
+    length is the original rounded up to a multiple of ``n_shards`` so a
+    ``P(dp)`` NamedSharding divides it evenly.
+    """
+
+    def __init__(self, treedef, infos, sizes, n_shards: int):
+        self.treedef = treedef
+        self.infos: List[Tuple[str, int, tuple]] = infos
+        self.sizes: Dict[str, Tuple[int, int]] = sizes
+        self.n_shards = n_shards
+
+
+def dp_flatten_spec(tree, n_shards: int) -> DpFlatSpec:
+    leaves, treedef = jax.tree_util.tree_flatten(tree)
+    infos, offsets = [], {}
+    for leaf in leaves:
+        dt = str(jnp.asarray(leaf).dtype if not hasattr(leaf, "dtype")
+                 else leaf.dtype)
+        size = int(np.prod(leaf.shape)) if leaf.shape else 1
+        off = offsets.get(dt, 0)
+        infos.append((dt, off, tuple(leaf.shape)))
+        offsets[dt] = off + size
+    sizes = {}
+    for dt, orig in offsets.items():
+        padded = -(-orig // n_shards) * n_shards
+        sizes[dt] = (orig, padded)
+    return DpFlatSpec(treedef, infos, sizes, n_shards)
+
+
+def dp_ravel(tree, n_shards: int, spec: DpFlatSpec = None):
+    """Ravel ``tree`` to {dtype key: flat padded vector}; zero padding
+    (harmless under every updater here: zero grad + zero state leaves
+    the pad slot untouched, and pads are dropped by :func:`dp_unravel`).
+    Returns (flats, spec)."""
+    if spec is None:
+        spec = dp_flatten_spec(tree, n_shards)
+    leaves = jax.tree_util.tree_leaves(tree)
+    parts: Dict[str, list] = {}
+    for leaf, (dt, _, _) in zip(leaves, spec.infos):
+        parts.setdefault(dt, []).append(jnp.reshape(leaf, (-1,)))
+    flats = {}
+    for dt, chunks in parts.items():
+        flat = chunks[0] if len(chunks) == 1 else jnp.concatenate(chunks)
+        orig, padded = spec.sizes[dt]
+        if padded != orig:
+            flat = jnp.concatenate(
+                [flat, jnp.zeros((padded - orig,), flat.dtype)])
+        flats[dt] = flat
+    return flats, spec
+
+
+def dp_unravel(flats: Dict[str, jnp.ndarray], spec: DpFlatSpec):
+    """Inverse of :func:`dp_ravel` (padding dropped). Only offsets and
+    shapes are consulted, so vectors longer than the spec's padded
+    length (e.g. padded for a different shard count) unravel fine."""
+    leaves = []
+    for dt, off, shape in spec.infos:
+        size = int(np.prod(shape)) if shape else 1
+        leaves.append(jnp.reshape(flats[dt][off:off + size], shape))
+    return jax.tree_util.tree_unflatten(spec.treedef, leaves)
 
 
 class IUpdater:
@@ -46,6 +128,20 @@ class IUpdater:
     # -- state / apply ---------------------------------------------------
     def init_state(self, params) -> Any:
         return ()
+
+    def init_state_sharded(self, params, n_shards: int) -> Any:
+        """State in the ZeRO-1 flat layout: each slot becomes per-dtype
+        padded flat vectors (1/``n_shards`` of which lives on each
+        replica once the caller places them — ``parallel.zero``). Works
+        for every updater whose state is ``zeros_like(params)`` slots,
+        i.e. all of them: ``init_state`` on the raveled params yields
+        the slot structure directly. Stateless updaters return ``()``
+        unchanged."""
+        dense_shape = self.init_state(params)
+        if not dense_shape:
+            return dense_shape
+        flats, _ = dp_ravel(params, n_shards)
+        return {DP_SHARDED_KEY: self.init_state(flats)}
 
     def apply(self, grads, state, iteration, epoch=0):
         """-> (updates_to_subtract, new_state)."""

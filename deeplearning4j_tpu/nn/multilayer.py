@@ -59,6 +59,15 @@ class MultiLayerNetwork:
         self._initialized = False
         self._dtype = to_jnp_dtype(conf.dtype)
         self._retrace_guard = None
+        # ZeRO-1 sharded update (parallel.zero): when a dp mesh is
+        # installed the step tail runs the updater on 1/N param shards
+        self._dp_mesh = None
+        self._dp_axis = "data"
+        # gradient accumulation (reference: GradientsAccumulator)
+        self._accum_steps = 1
+        self._accum_grads = None
+        self._accum_count = 0
+        self._updates_applied = 0
 
     # ------------------------------------------------------------------
     def init(self) -> "MultiLayerNetwork":
@@ -263,15 +272,17 @@ class MultiLayerNetwork:
                                                mask=lmask)
             return data_loss + self._regularization(params), new_states
 
-        def step(params, states, upd_states, x, y, fmask, lmask,
-                 iteration, rng):
-            (loss, new_states), grads = jax.value_and_grad(
-                loss_fn, has_aux=True)(params, states, x, y, fmask,
-                                       lmask, rng)
-            new_params = {}
-            new_upd = {}
-            gn = conf.gradient_normalization
-            thr = conf.gradient_normalization_threshold
+        gn = conf.gradient_normalization
+        thr = conf.gradient_normalization_threshold
+        dp_mesh, dp_axis = self._dp_mesh, self._dp_axis
+
+        def update_tail(params, upd_states, grads, iteration):
+            """Grads -> (new_params, new_upd). Shared by the fused step
+            and the accumulation apply step. With a dp mesh installed
+            the updater runs ZeRO-1 sharded (parallel.zero; the
+            resolver guarantees gradient_normalization NONE there, so
+            skipping it is exact)."""
+            new_params, new_upd = {}, {}
             for i, up in enumerate(updaters):
                 k = f"layer_{i}"
                 g = grads.get(k, {})
@@ -279,26 +290,122 @@ class MultiLayerNetwork:
                     new_params[k] = params.get(k, {})
                     new_upd[k] = upd_states.get(k, ())
                     continue
-                g = apply_gradient_normalization(gn, thr, g)
-                updates, us = up.apply(g, upd_states[k], iteration)
-                new_p = jax.tree_util.tree_map(
-                    lambda p, u: p - u, params[k], updates)
+                if dp_mesh is not None:
+                    from deeplearning4j_tpu.parallel.zero import \
+                        apply_update_sharded
+                    new_p, us = apply_update_sharded(
+                        up, g, params[k], upd_states[k], iteration,
+                        dp_mesh, dp_axis)
+                else:
+                    g = apply_gradient_normalization(gn, thr, g)
+                    updates, us = up.apply(g, upd_states[k], iteration)
+                    new_p = jax.tree_util.tree_map(
+                        lambda p, u: p - u, params[k], updates)
                 # post-update projection (reference: constraints are
                 # applied after the updater, inside the same step)
                 new_params[k] = apply_constraints(conf.layers[i], new_p)
                 new_upd[k] = us
+            return new_params, new_upd
+
+        def step(params, states, upd_states, x, y, fmask, lmask,
+                 iteration, rng):
+            (loss, new_states), grads = jax.value_and_grad(
+                loss_fn, has_aux=True)(params, states, x, y, fmask,
+                                       lmask, rng)
+            new_params, new_upd = update_tail(params, upd_states,
+                                              grads, iteration)
             return new_params, new_states, new_upd, loss
+
+        def grad_step(params, states, x, y, fmask, lmask, rng):
+            # accumulation micro-step: backward only, no update (params
+            # NOT donated — the apply step still reads them)
+            (loss, new_states), grads = jax.value_and_grad(
+                loss_fn, has_aux=True)(params, states, x, y, fmask,
+                                       lmask, rng)
+            return grads, new_states, loss
+
+        def apply_step(params, upd_states, grads, scale, iteration):
+            grads = jax.tree_util.tree_map(lambda g: g * scale, grads)
+            new_params, new_upd = update_tail(params, upd_states,
+                                              grads, iteration)
+            return new_params, new_upd
 
         # donate params/states/updater-state buffers: XLA reuses them
         # in place of the reference's workspaces
         self._step_fn = step        # unjitted (multi-step path reuses)
         self._train_step = jax.jit(step, donate_argnums=(0, 1, 2))
+        self._grad_step = jax.jit(grad_step, donate_argnums=(1,))
+        self._apply_step = jax.jit(apply_step, donate_argnums=(1, 2))
+        self._accum_add = jax.jit(
+            lambda acc, g: jax.tree_util.tree_map(
+                lambda a, b: a + b, acc, g),
+            donate_argnums=(0,))
+
+    # ------------------------------------------------------------------
+    def set_dp_mesh(self, mesh, axis: str = "data"):
+        """Install (or clear, with ``mesh=None``) the data-parallel mesh
+        the jitted step tail specializes on (ZeRO-1 sharded update —
+        ``parallel.zero``). Invalidates compiled steps; callers own
+        converting/placing ``updater_states`` to match."""
+        if mesh is self._dp_mesh and axis == self._dp_axis:
+            return self
+        self.flush_accumulated()
+        self._dp_mesh = mesh
+        self._dp_axis = axis
+        self._train_step = None
+        self._step_fn = None
+        self._grad_step = None
+        self._apply_step = None
+        self._accum_add = None
+        if hasattr(self, "_multi_steps"):
+            del self._multi_steps
+        return self
+
+    def set_accumulation_steps(self, n: int):
+        """Apply the updater once every ``n`` fit() micro-batches on the
+        mean of their gradients (the reference's GradientsAccumulator):
+        effective batch = n x micro-batch with no extra activation HBM."""
+        n = max(int(n), 1)
+        if n != self._accum_steps:
+            self.flush_accumulated()
+            self._accum_steps = n
+        return self
+
+    def flush_accumulated(self):
+        """Apply a partial accumulation window now (epoch end / mode
+        change); no-op when nothing is pending."""
+        if self._accum_count:
+            self._apply_accumulated()
+        return self
+
+    def _apply_accumulated(self):
+        k = self._accum_count
+        scale = jnp.asarray(1.0 / k, jnp.float32)
+        self.params, self.updater_states = self._apply_step(
+            self.params, self.updater_states, self._accum_grads, scale,
+            jnp.asarray(self._updates_applied))
+        self._accum_grads = None
+        self._accum_count = 0
+        self._updates_applied += 1
+
+    def _sync_updater_layout(self):
+        """A checkpoint restored from a ZeRO-1 run carries flat sharded
+        updater state; on a plain (no-mesh) model convert it back to the
+        dense per-layer layout before stepping."""
+        if self._dp_mesh is not None:
+            return
+        from deeplearning4j_tpu.learning.updaters import is_dp_sharded
+        if any(is_dp_sharded(s) for s in self.updater_states.values()):
+            from deeplearning4j_tpu.parallel.zero import states_to_dense
+            self.updater_states = states_to_dense(self.params,
+                                                  self.updater_states)
 
     # ------------------------------------------------------------------
     def fit(self, data, labels=None, *, n_epochs: int = 1):
         """fit(x, y) | fit(DataSet) | fit(iterator[, n_epochs])."""
         if not self._initialized:
             self.init()
+        self._sync_updater_layout()
         if self._train_step is None:
             self._build_train_step()
         if labels is not None:
@@ -326,6 +433,8 @@ class MultiLayerNetwork:
                 self._fit_batch(ds.features, ds.labels,
                                 getattr(ds, "features_mask", None),
                                 getattr(ds, "labels_mask", None))
+            # a partial accumulation window does not leak across epochs
+            self.flush_accumulated()
             # epochs-completed count advances BEFORE listeners fire:
             # an epoch-end checkpoint then serializes the true count
             # (a resumed job must not retrain a finished epoch)
@@ -343,6 +452,7 @@ class MultiLayerNetwork:
         group with the final loss."""
         if not self._initialized:
             self.init()
+        self._sync_updater_layout()
         if self._train_step is None:
             self._build_train_step()
         if getattr(ds, "features_mask", None) is not None or \
@@ -412,6 +522,7 @@ class MultiLayerNetwork:
         one jitted step; layers below run in inference mode."""
         if not self._initialized:
             self.init()
+        self._sync_updater_layout()
         layer = self.conf.layers[idx]
         if not getattr(layer, "is_pretrainable", lambda: False)():
             raise ValueError(f"layer {idx} is not pretrainable")
@@ -479,6 +590,8 @@ class MultiLayerNetwork:
         if self.conf.backprop_type is BackpropType.TRUNCATED_BPTT and \
                 x.ndim == 3:
             return self._fit_tbptt(x, y, fmask, lmask)
+        if self._accum_steps > 1:
+            return self._fit_batch_accum(x, y, fmask, lmask)
         self._rng, rng = jax.random.split(self._rng)
         states_in = self._with_zero_rnn_states(self.states,
                                                int(x.shape[0]))
@@ -490,6 +603,34 @@ class MultiLayerNetwork:
                                  jnp.asarray(self.iteration_count), rng)
         # standard BPTT: recurrent state resets every minibatch
         # (reference: fit() clears rnn state); BN stats persist
+        self.states = self._strip_rnn_states(new_states)
+        self._score = loss          # device scalar; float() on read
+        self.last_batch_size = int(x.shape[0])
+        self.iteration_count += 1
+        for lis in self.listeners:
+            lis.iteration_done(self, self.iteration_count - 1,
+                               self.epoch_count)
+
+    def _fit_batch_accum(self, x, y, fmask, lmask):
+        """Accumulation micro-step: backward + gradient add only; the
+        updater fires once per ``_accum_steps`` window on the mean
+        gradient, with the updater iteration = number of updates
+        APPLIED (so Adam bias correction sees update indices, not
+        micro-batch indices)."""
+        self._rng, rng = jax.random.split(self._rng)
+        states_in = self._with_zero_rnn_states(self.states,
+                                               int(x.shape[0]))
+        from deeplearning4j_tpu.common import telemetry
+        with telemetry.step_span("MultiLayerNetwork",
+                                 accumulating=self._accum_steps):
+            grads, new_states, loss = self._grad_step(
+                self.params, states_in, x, y, fmask, lmask, rng)
+            self._accum_grads = (grads if self._accum_grads is None
+                                 else self._accum_add(self._accum_grads,
+                                                      grads))
+            self._accum_count += 1
+            if self._accum_count >= self._accum_steps:
+                self._apply_accumulated()
         self.states = self._strip_rnn_states(new_states)
         self._score = loss          # device scalar; float() on read
         self.last_batch_size = int(x.shape[0])

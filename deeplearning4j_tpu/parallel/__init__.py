@@ -33,6 +33,9 @@ from deeplearning4j_tpu.parallel.encoding import (
     AdaptiveThresholdAlgorithm, EncodingHandler, FixedThresholdAlgorithm,
     ResidualClippingPostProcessor, TargetSparsityThresholdAlgorithm,
     ThresholdAlgorithm, encode_threshold, decode_threshold)
+from deeplearning4j_tpu.parallel.zero import (
+    UpdateExchange, apply_update_sharded, resolve_update_exchange,
+    states_to_dense, states_to_sharded, update_exchange_bytes)
 
 __all__ = [
     "DEFAULT_DATA_AXIS", "MeshFactory", "make_mesh", "data_sharding",
@@ -45,4 +48,6 @@ __all__ = [
     "blockwise_attention", "flash_attention", "ring_attention",
     "ring_self_attention", "ulysses_attention",
     "ulysses_self_attention",
+    "UpdateExchange", "apply_update_sharded", "resolve_update_exchange",
+    "states_to_dense", "states_to_sharded", "update_exchange_bytes",
 ]

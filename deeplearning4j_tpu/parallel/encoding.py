@@ -158,9 +158,17 @@ class EncodingHandler:
         leaves = jax.tree_util.tree_leaves(quantized)
         if leaves:
             total = sum(l.size for l in leaves)
-            # one device sync for the whole tree, not one per leaf
-            nz = sum(jnp.sum(l != 0) for l in leaves)
-            self.last_sparsity = float(nz) / max(total, 1)
+            # size-weighted mean of per-leaf sparsity() — one device
+            # sync for the whole tree, not one per leaf
+            frac = sum(sparsity(l) * l.size for l in leaves)
+            self.last_sparsity = float(frac) / max(total, 1)
+            from deeplearning4j_tpu.common import telemetry
+            telemetry.gauge(
+                "dl4j_dp_encoding_sparsity",
+                "fraction of gradient elements the threshold encoder "
+                "would transmit (reference: EncodingHandler wire "
+                "density; drives the adaptive tau)").set(
+                    self.last_sparsity)
         self.tau = self.algorithm.next_tau(self.tau, self.last_sparsity)
         self.residual = self.residual_post.apply(self.step, self.tau,
                                                  self.residual)

@@ -88,6 +88,14 @@ def replicated(mesh: Mesh) -> NamedSharding:
     return NamedSharding(mesh, P())
 
 
+def flat_sharding(mesh: Mesh,
+                  axis: str = DEFAULT_DATA_AXIS) -> NamedSharding:
+    """1-D sharding along ``axis`` — the ZeRO-1 flat param/optimizer
+    state layout (``parallel.zero``): each replica holds 1/N of the
+    padded flat vector."""
+    return NamedSharding(mesh, P(axis))
+
+
 def replicate_tree(mesh: Mesh, tree):
     """Place every leaf fully replicated on the mesh (params/opt state
     for DP — the analogue of ParallelWrapper's per-device model copies,

@@ -55,6 +55,13 @@ class SharedTrainingConfiguration:
     workers_per_node: int = -1          # -1 = all local devices
     threshold_algorithm: Optional[ThresholdAlgorithm] = None
     residual_post_processor: object = None
+    # how replicas exchange the weight update: 'dense' (AllReduce +
+    # replicated update), 'sharded' (ZeRO-1 ReduceScatter/AllGather —
+    # parallel.zero), 'auto' (sharded whenever legal)
+    update_exchange: str = "auto"
+    # updater applies every N micro-batches on the mean gradient
+    # (reference: GradientsAccumulator)
+    accumulation_steps: int = 1
     # control plane (jax.distributed); None = single-process
     coordinator_address: Optional[str] = None
     num_processes: Optional[int] = None
@@ -86,6 +93,20 @@ class SharedTrainingMaster:
 
         def residual_post_processor(self, rp):
             self._c.residual_post_processor = rp
+            return self
+
+        def update_exchange(self, mode):
+            """'dense' | 'sharded' | 'auto' — validated eagerly so a
+            typo fails at build time, not first fit."""
+            from deeplearning4j_tpu.parallel.zero import UpdateExchange
+            self._c.update_exchange = UpdateExchange(
+                mode.lower() if isinstance(mode, str) else mode).value
+            return self
+
+        def accumulation_steps(self, n: int):
+            """Apply the updater every ``n`` micro-batches on the mean
+            gradient (reference: GradientsAccumulator)."""
+            self._c.accumulation_steps = max(int(n), 1)
             return self
 
         def coordinator(self, address: str, num_processes: int,
@@ -153,15 +174,23 @@ class SharedTrainingMaster:
         converges to the same state as an uncrashed one."""
         self._ensure_distributed()
         if self.config.threshold_algorithm is not None:
-            log.info("threshold_algorithm accepted for API parity but the "
-                     "update exchange is a dense in-step XLA AllReduce "
-                     "(BASELINE north star); see parallel.encoding for the "
-                     "compression transform")
+            log.info("threshold_algorithm configures the gradient "
+                     "compression transform (parallel.encoding), not "
+                     "the update exchange; the exchange is governed by "
+                     "update_exchange=%r (dense AllReduce | ZeRO-1 "
+                     "sharded ReduceScatter/AllGather)",
+                     self.config.update_exchange)
         mesh = self._global_mesh()
+        from deeplearning4j_tpu.parallel.zero import \
+            resolve_update_exchange
+        mode = resolve_update_exchange(mesh, DEFAULT_DATA_AXIS,
+                                       self.config.update_exchange,
+                                       model)
         telemetry.gauge(
             "dl4j_dp_workers",
             "devices participating in the data-parallel mesh").set(
-                mesh.size, master=type(self).__name__)
+                mesh.size, master=type(self).__name__,
+                update_exchange=mode.value)
         mgr = None
         if checkpoint_dir is not None:
             from deeplearning4j_tpu.utils.checkpoint import (
@@ -183,7 +212,9 @@ class SharedTrainingMaster:
                 model.listeners.remove(lis)
                 return model
         try:
-            pw = ParallelWrapper(model, mesh)
+            pw = ParallelWrapper(
+                model, mesh, update_exchange=mode,
+                accumulation_steps=self.config.accumulation_steps)
             if jax.process_count() == 1:
                 pw.fit(iterator, n_epochs=n_epochs)
             else:

@@ -46,17 +46,29 @@ class ParallelWrapper:
         pw.fit(train_iterator)
     """
 
+    #: reference TrainingMode values (accepted; all lower to the same
+    #: exact in-step collective exchange on TPU)
+    KNOWN_TRAINING_MODES = ("AVERAGING", "SHARED_GRADIENTS", "CUSTOM")
+
     def __init__(self, model, mesh=None, *,
                  data_axis: str = DEFAULT_DATA_AXIS,
                  prefetch_buffer: int = 2,
                  averaging_frequency: int = 1,
-                 report_score_after_averaging: bool = True):
+                 report_score_after_averaging: bool = True,
+                 accumulation_steps: int = 1,
+                 update_exchange="auto"):
         self.model = model
         self.mesh = mesh if mesh is not None else make_mesh()
         self.data_axis = data_axis
         self.prefetch_buffer = prefetch_buffer
         self.averaging_frequency = averaging_frequency  # API parity only
         self.report_score = report_score_after_averaging
+        self.accumulation_steps = max(int(accumulation_steps), 1)
+        #: requested exchange ('auto'|'dense'|'sharded'); resolved to
+        #: the effective UpdateExchange at placement time
+        self.requested_exchange = update_exchange
+        self.update_exchange = None
+        self._exchange_bytes = 0
         self._placed = False
         if averaging_frequency != 1:
             log.info("averagingFrequency=%d ignored: pjit DP is exactly "
@@ -70,6 +82,8 @@ class ParallelWrapper:
             self._prefetch = 2
             self._avg_freq = 1
             self._workers = None
+            self._accum = 1
+            self._exchange = "auto"
 
         def workers(self, n: int) -> "ParallelWrapper.Builder":
             self._workers = n
@@ -87,9 +101,30 @@ class ParallelWrapper:
             self._avg_freq = n
             return self
 
-        def training_mode(self, _mode) -> "ParallelWrapper.Builder":
+        def accumulation_steps(self, n: int) -> "ParallelWrapper.Builder":
+            """Apply the updater every ``n`` micro-batches on the mean
+            gradient (reference: GradientsAccumulator) — effective
+            batch scales n-fold with no extra activation HBM."""
+            self._accum = n
+            return self
+
+        def update_exchange(self, mode) -> "ParallelWrapper.Builder":
+            """'dense' | 'sharded' | 'auto' (zero.UpdateExchange):
+            how replicas exchange the weight update."""
+            from deeplearning4j_tpu.parallel.zero import UpdateExchange
+            self._exchange = UpdateExchange(
+                mode.lower() if isinstance(mode, str) else mode)
+            return self
+
+        def training_mode(self, mode) -> "ParallelWrapper.Builder":
             # AVERAGING / SHARED_GRADIENTS / CUSTOM: all lower to the
-            # same exact in-step all-reduce on TPU
+            # same exact in-step collective exchange on TPU
+            name = str(getattr(mode, "name", mode)).upper()
+            if name not in ParallelWrapper.KNOWN_TRAINING_MODES:
+                log.warning(
+                    "unknown training_mode %r (known: %s); every known "
+                    "mode lowers to the same exact in-step exchange",
+                    mode, ", ".join(ParallelWrapper.KNOWN_TRAINING_MODES))
             return self
 
         def build(self) -> "ParallelWrapper":
@@ -101,7 +136,9 @@ class ParallelWrapper:
                 mesh = make_mesh({DEFAULT_DATA_AXIS: len(devs)}, devs)
             return ParallelWrapper(self._model, mesh,
                                    prefetch_buffer=self._prefetch,
-                                   averaging_frequency=self._avg_freq)
+                                   averaging_frequency=self._avg_freq,
+                                   accumulation_steps=self._accum,
+                                   update_exchange=self._exchange)
 
     # ------------------------------------------------------------------
     @property
@@ -109,14 +146,45 @@ class ParallelWrapper:
         return self.mesh.shape[self.data_axis]
 
     def _place_model(self):
-        """Replicate params/opt-state on the mesh (one-time device_put;
-        afterwards XLA keeps them resident and in sync)."""
+        """Place params/opt-state on the mesh (one-time device_put;
+        afterwards XLA keeps them resident and in sync). Params/states
+        go replicated; with the ZeRO-1 sharded exchange the updater
+        state goes 1/N per replica along the data axis instead
+        (parallel.zero — the Adam-family HBM win)."""
         m = self.model
         if not m._initialized:
             m.init()
+        from deeplearning4j_tpu.parallel.zero import (
+            UpdateExchange, place_updater_states,
+            resolve_update_exchange, states_to_dense, states_to_sharded,
+            update_exchange_bytes)
+        mode = resolve_update_exchange(self.mesh, self.data_axis,
+                                       self.requested_exchange, m)
+        self.update_exchange = mode
         m.params = replicate_tree(self.mesh, m.params)
         m.states = replicate_tree(self.mesh, m.states)
-        m.updater_states = replicate_tree(self.mesh, m.updater_states)
+        if hasattr(m, "set_dp_mesh"):
+            m.set_dp_mesh(self.mesh if mode is UpdateExchange.SHARDED
+                          else None, self.data_axis)
+        if hasattr(m, "set_accumulation_steps"):
+            m.set_accumulation_steps(self.accumulation_steps)
+        elif self.accumulation_steps > 1:
+            log.warning("accumulation_steps=%d ignored: %s has no "
+                        "gradient accumulation support",
+                        self.accumulation_steps, type(m).__name__)
+        if mode is UpdateExchange.SHARDED:
+            m.updater_states = place_updater_states(
+                self.mesh,
+                states_to_sharded(m.params, m.updater_states,
+                                  self.n_workers),
+                self.data_axis)
+        else:
+            # a sharded layout left by a previous placement (or a
+            # restored ZeRO-1 checkpoint) converts back to dense first
+            m.updater_states = replicate_tree(
+                self.mesh, states_to_dense(m.params, m.updater_states))
+        self._exchange_bytes = update_exchange_bytes(m.params,
+                                                     self.n_workers)
         self._placed = True
 
     def _shard(self, a):
@@ -181,20 +249,36 @@ class ParallelWrapper:
             for ds in staged:
                 ds = shard_fn(ds)
                 if telemetry.enabled():
-                    # the sharded step COMPILES the gradient all-reduce
-                    # in (psum over the data axis) — this is the whole
+                    # the sharded step COMPILES the update exchange in
+                    # (dense: gradient all-reduce; ZeRO-1: reduce-
+                    # scatter + all-gather) — this is the whole
                     # replica-sync step the reference's trainer threads
-                    # + averaging round performed
+                    # + averaging round performed. The span bounds the
+                    # fused step and carries the exchange volume, so
+                    # the collective cost shows on the one timeline.
+                    mode = self.update_exchange.value
                     t0 = time.perf_counter()
-                    self.model.fit(ds)
+                    with telemetry.span("dp.update_exchange",
+                                        mode=mode,
+                                        bytes=self._exchange_bytes):
+                        self.model.fit(ds)
                     telemetry.histogram(
                         "dl4j_dp_step_seconds",
                         "data-parallel sharded step wall time incl. "
                         "the fused in-step gradient all-reduce "
                         "(seconds)").observe(
                             time.perf_counter() - t0, workers=n)
+                    telemetry.counter(
+                        "dl4j_dp_update_exchange_bytes_total",
+                        "estimated per-replica wire bytes moved by the "
+                        "in-step update exchange (ring collectives)"
+                    ).inc(self._exchange_bytes, mode=mode)
                 else:
                     self.model.fit(ds)
+            if hasattr(self.model, "flush_accumulated"):
+                # a partial accumulation window must not leak into the
+                # next epoch
+                self.model.flush_accumulated()
             self.model.epoch_count += 1
             for lis in self.model.listeners:
                 lis.on_epoch_end(self.model)

@@ -1,0 +1,200 @@
+"""ZeRO-1 cross-replica sharded weight update (Xu et al., "Automatic
+Cross-Replica Sharding of Weight Update in Data-Parallel Training").
+
+The dense DP step ends in ``AllReduce(grads) -> every replica runs the
+full updater on a full copy of the optimizer state``.  This module
+replaces that tail with ``ReduceScatter -> each replica updates its 1/N
+parameter shard + shard-local updater state -> AllGather of the new
+params``: the optimizer state (2x params for Adam-family) lives sharded
+along the ``data`` axis instead of replicated, freeing HBM, and the
+update-phase HBM traffic drops ~N-fold.
+
+Mechanics: params/grads ravel into one padded flat vector per dtype
+(``learning.updaters.dp_ravel``); ``with_sharding_constraint`` pins the
+summed flat gradient and the updater state to ``P(data)``, so XLA's
+SPMD partitioner lowers the gradient all-reduce to a reduce-scatter and
+runs the (purely elementwise) updater math on 1/N of the elements per
+replica; constraining the new flat params back to replicated inserts
+the all-gather.  Per-element arithmetic is identical to the dense path,
+so SGD results stay bitwise equal and stateful updaters agree to float
+tolerance.
+
+Kill switch: ``DL4J_TPU_SHARDED_UPDATE=0`` (common.environment) forces
+the dense tail everywhere, restoring the exact pre-ZeRO behavior.
+"""
+from __future__ import annotations
+
+import enum
+import logging
+from typing import Dict
+
+import jax
+import numpy as np
+
+from deeplearning4j_tpu.learning.updaters import (DP_SHARDED_KEY, dp_ravel,
+                                                  dp_flatten_spec, dp_unravel,
+                                                  is_dp_sharded)
+from deeplearning4j_tpu.parallel.mesh import (DEFAULT_DATA_AXIS,
+                                              flat_sharding, replicated)
+
+log = logging.getLogger("deeplearning4j_tpu")
+
+
+class UpdateExchange(str, enum.Enum):
+    """How replicas exchange the weight update (the successor of the
+    reference's threshold-encoding `TrainingMode` stance): ``dense`` =
+    AllReduce + fully replicated update, ``sharded`` = ZeRO-1
+    ReduceScatter/AllGather, ``auto`` = sharded whenever legal."""
+    DENSE = "dense"
+    SHARDED = "sharded"
+    AUTO = "auto"
+
+
+def resolve_update_exchange(mesh, axis: str = DEFAULT_DATA_AXIS,
+                            requested=UpdateExchange.AUTO,
+                            model=None) -> UpdateExchange:
+    """Resolve ``auto``/validate a request down to DENSE or SHARDED.
+
+    DENSE whenever the sharded tail cannot apply: env kill switch off,
+    no mesh / dp axis of 1 (nothing to shard across), or the model uses
+    norm-based gradient normalization (it needs the full summed
+    gradient before any slicing)."""
+    if isinstance(requested, str):
+        try:
+            requested = UpdateExchange(requested.lower())
+        except ValueError:
+            raise ValueError(
+                f"unknown update_exchange {requested!r}; expected one "
+                f"of {[e.value for e in UpdateExchange]}") from None
+    from deeplearning4j_tpu.common.environment import Environment
+    if not Environment.get().sharded_update:
+        if requested is UpdateExchange.SHARDED:
+            log.info("update_exchange=sharded requested but "
+                     "DL4J_TPU_SHARDED_UPDATE=0; using dense")
+        return UpdateExchange.DENSE
+    if requested is UpdateExchange.DENSE:
+        return UpdateExchange.DENSE
+    if mesh is None or mesh.shape.get(axis, 1) <= 1:
+        return UpdateExchange.DENSE
+    if model is not None:
+        gn = getattr(getattr(model, "conf", None),
+                     "gradient_normalization", None)
+        if gn is not None and getattr(gn, "name", "NONE") != "NONE":
+            log.info("gradient_normalization=%s needs the full summed "
+                     "gradient; update exchange stays dense", gn.name)
+            return UpdateExchange.DENSE
+    return UpdateExchange.SHARDED
+
+
+# ---------------------------------------------------------------------------
+def apply_update_sharded(updater, grads, params, state, iteration, mesh,
+                         axis: str = DEFAULT_DATA_AXIS, *, epoch=0):
+    """The ZeRO-1 step tail for one param subtree, traced inside the
+    caller's jit.  Returns ``(new_params, new_state)`` with new params
+    fully replicated (post-all-gather) and new state in the sharded
+    flat layout (``{DP_SHARDED_KEY: {slot: {dtype: flat}}}``; stateless
+    updaters pass ``()`` through)."""
+    n = mesh.shape[axis]
+    shard = flat_sharding(mesh, axis)
+    full = replicated(mesh)
+
+    def pin(tree, sh):
+        return jax.tree_util.tree_map(
+            lambda a: jax.lax.with_sharding_constraint(a, sh), tree)
+
+    flat_p, spec = dp_ravel(params, n)
+    flat_g, _ = dp_ravel(grads, n, spec)
+    # grads arrive as a per-shard sum pending all-reduce; pinning the
+    # flat view to P(axis) turns that all-reduce into a reduce-scatter
+    flat_g = pin(flat_g, shard)
+    flat_p = pin(flat_p, shard)
+    inner = state[DP_SHARDED_KEY] if is_dp_sharded(state) else state
+    inner = pin(inner, shard)
+    updates, new_inner = updater.apply(flat_g, inner, iteration, epoch)
+    # updater math may run in f32 (Adam bias correction is a strong
+    # f32); keep each dtype bucket's own dtype, as the dense tail does
+    new_flat = {k: (flat_p[k] - updates[k]).astype(flat_p[k].dtype)
+                for k in flat_p}
+    new_flat = pin(new_flat, full)           # <- the all-gather
+    new_params = dp_unravel(new_flat, spec)
+    new_inner = pin(new_inner, shard)
+    new_state = ({DP_SHARDED_KEY: new_inner} if is_dp_sharded(state)
+                 else new_inner)
+    return new_params, new_state
+
+
+# -- layout conversions ------------------------------------------------------
+def to_sharded_state(params, state, n_shards: int):
+    """One subtree's dense updater state -> ZeRO-1 flat layout."""
+    if not state or is_dp_sharded(state):
+        return state
+    return {DP_SHARDED_KEY: {slot: dp_ravel(tree, n_shards)[0]
+                             for slot, tree in state.items()}}
+
+
+def to_dense_state(params, state):
+    """Inverse of :func:`to_sharded_state` (padding dropped)."""
+    if not is_dp_sharded(state):
+        return state
+    spec = dp_flatten_spec(params, 1)
+    return {slot: dp_unravel(flats, spec)
+            for slot, flats in state[DP_SHARDED_KEY].items()}
+
+
+def states_to_sharded(params: Dict, states: Dict, n_shards: int) -> Dict:
+    """Model-level convenience: convert every layer/vertex entry."""
+    return {k: to_sharded_state(params.get(k, {}), s, n_shards)
+            for k, s in states.items()}
+
+
+def states_to_dense(params: Dict, states: Dict) -> Dict:
+    return {k: to_dense_state(params.get(k, {}), s)
+            for k, s in states.items()}
+
+
+def place_updater_states(mesh, states: Dict,
+                         axis: str = DEFAULT_DATA_AXIS) -> Dict:
+    """Device-put updater states on the mesh: sharded flat entries along
+    ``P(axis)`` (1/N per replica — the whole HBM win), everything else
+    replicated (the pre-ZeRO placement)."""
+    shard = flat_sharding(mesh, axis)
+    full = replicated(mesh)
+
+    def put(tree, sh):
+        return jax.tree_util.tree_map(
+            lambda a: jax.device_put(a, sh) if hasattr(a, "shape") else a,
+            tree)
+
+    out = {}
+    for k, s in states.items():
+        if is_dp_sharded(s):
+            out[k] = {DP_SHARDED_KEY: put(s[DP_SHARDED_KEY], shard)}
+        else:
+            out[k] = put(s, full)
+    return out
+
+
+# -- accounting --------------------------------------------------------------
+def update_exchange_bytes(params, n_shards: int) -> int:
+    """Per-replica wire bytes one update exchange moves (ring
+    collectives): dense AllReduce = 2(N-1)/N * P bytes; the sharded
+    ReduceScatter + AllGather pair moves the same total — the ZeRO-1
+    win is HBM residency and update-phase HBM traffic, not wire bytes."""
+    total = sum(int(np.prod(a.shape)) * a.dtype.itemsize
+                for a in jax.tree_util.tree_leaves(params)
+                if hasattr(a, "shape"))
+    if n_shards <= 1:
+        return 0
+    return int(2 * (n_shards - 1) * total / n_shards)
+
+
+def sharded_state_bytes(states: Dict) -> int:
+    """Total bytes of flat sharded updater state (whole-mesh; each
+    replica holds 1/N of this)."""
+    total = 0
+    for s in states.values():
+        if is_dp_sharded(s):
+            total += sum(int(np.prod(a.shape)) * a.dtype.itemsize
+                         for a in
+                         jax.tree_util.tree_leaves(s[DP_SHARDED_KEY]))
+    return total

@@ -72,8 +72,14 @@ class ModelSerializer:
                        _tree_to_flat_dict(model.params))
             _write_npz(zf, STATE_ENTRY, _tree_to_flat_dict(model.states))
             if save_updater:
+                # ZeRO-1 sharded layouts (parallel.zero) are mesh-shaped
+                # padded flat vectors; checkpoints always store the dense
+                # per-tensor layout so they restore on any device count
+                from deeplearning4j_tpu.parallel.zero import \
+                    states_to_dense
                 _write_npz(zf, UPDATER_ENTRY,
-                           _tree_to_flat_dict(model.updater_states))
+                           _tree_to_flat_dict(states_to_dense(
+                               model.params, model.updater_states)))
             if normalizer is not None:
                 zf.writestr(NORMALIZER_ENTRY,
                             json.dumps(normalizer.to_map()))
