@@ -75,22 +75,44 @@ def main():
         "unit": "images/sec/chip",
         "vs_baseline": 1.0,
     }
+    # provenance stamp: schema version, git rev, jax version, device
+    # kind/count, DL4J_TPU_* env — BENCH_r*.json trajectories are only
+    # comparable when the rig that produced them is on record
+    try:
+        from deeplearning4j_tpu.common import diagnostics
+        line["meta"] = diagnostics.bench_meta()
+    except Exception as e:
+        print(f"meta block failed: {e!r}", file=sys.stderr)
     # Roofline evidence (BENCH_notes_r02.md): XLA cost analysis of the
-    # optimized train step (shared helper; flops are a floor).
+    # optimized train step (shared helper; flops are a floor), run
+    # through the automatic classifier (which roof binds, % of it).
     try:
         from benchmarks.cost_util import (V5E_BF16_PEAK_TFLOPS,
                                           V5E_HBM_GBPS, graph_step_cost)
+        from deeplearning4j_tpu.common import diagnostics
         flops, byts = graph_step_cost(net, x, y)
         step_s = batch / ips
-        tf = flops / step_s / 1e12
-        gbps = byts / step_s / 1e9
-        line["tflops"] = round(tf, 1)
+        roof = diagnostics.roofline(
+            flops, byts, step_s,
+            peak_tflops=V5E_BF16_PEAK_TFLOPS if on_tpu else None,
+            peak_hbm_gbps=V5E_HBM_GBPS if on_tpu else None)
+        # keep the historical top-level keys (r02+ trajectory) AND the
+        # full classification
+        line["tflops"] = round(roof["tflops"], 1)
         if on_tpu:
-            line["pct_bf16_peak"] = round(
-                100 * tf / V5E_BF16_PEAK_TFLOPS, 1)
-            line["pct_hbm_peak"] = round(100 * gbps / V5E_HBM_GBPS, 1)
+            line["pct_bf16_peak"] = roof["pct_compute_peak"]
+            line["pct_hbm_peak"] = roof["pct_hbm_peak"]
+        line["roofline"] = roof
     except Exception as e:
         print(f"roofline block failed: {e!r}", file=sys.stderr)
+    # HBM attribution: where the bytes actually live after the run —
+    # device allocator live/peak plus per-buffer accounting (params /
+    # updater state / staging / activations+workspace residual)
+    try:
+        from deeplearning4j_tpu.common import diagnostics
+        line["memory"] = diagnostics.memory_report(net)
+    except Exception as e:
+        print(f"memory block failed: {e!r}", file=sys.stderr)
     # exercise the pod scaling harness's REAL clock path at n=1 (the
     # round-2 verdict asked that parallel/scaling.py time something
     # real before it is trusted on a pod); small shape — this checks

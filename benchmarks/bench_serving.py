@@ -129,6 +129,25 @@ def main():
     h = telemetry.histogram("dl4j_serving_latency_seconds")
     line["queue_p95_ms_registry"] = round(
         h.quantile(0.95, model="bench", stage="queue") * 1e3, 2)
+    # memory headroom next to the latency percentiles: the dl4j_hbm_*
+    # gauges a /metrics scrape of the serving endpoint reports (empty
+    # on backends without allocator stats, e.g. this CPU proxy)
+    try:
+        from deeplearning4j_tpu.common import diagnostics
+        devs = diagnostics.update_hbm_gauges()
+        if devs:
+            live = sum(d["bytes_in_use"] for d in devs)
+            limit = sum(d["bytes_limit"] for d in devs)
+            line["memory"] = {
+                "hbm_live_bytes": live,
+                "hbm_peak_bytes": sum(d["peak_bytes_in_use"]
+                                      for d in devs),
+                "hbm_limit_bytes": limit,
+                "headroom_pct": (round(100 * (1 - live / limit), 1)
+                                 if limit else None),
+            }
+    except Exception as e:
+        print(f"memory-headroom leg failed: {e!r}", file=sys.stderr)
     print(json.dumps(line))
 
 

@@ -112,6 +112,39 @@ def main():
     per_step_cost = span_cost + out["counter_inc_ns_on"] / 1e9
     out["fit_overhead_pct_analytic"] = round(
         per_step_cost / (on_s / iters) * 100, 2)
+
+    # diagnostics leg: the PR-7 layer rides the same <1% budget.
+    # (a) per-op cost of one flight-recorder record (the only
+    # per-step diagnostics work on a clean run: counter reads, one
+    # dict, ring append — HBM sampled every Nth);
+    # (b) e2e fit() with the recorder on vs off, same interleaved
+    # min-of-N protocol as above;
+    # (c) the analytic ratio the acceptance bar reads.
+    from deeplearning4j_tpu.common import diagnostics
+    rec = diagnostics.FlightRecorder.get()
+    rec.enabled = True
+    loss = 0.5
+    n = 20_000
+    t0 = time.perf_counter()
+    for i in range(n):
+        rec.record(net, "bench", i, loss)
+    record_cost = (time.perf_counter() - t0) / n
+    out["flightrec_record_ns"] = round(record_cost * 1e9, 1)
+    rec_on, rec_off = [], []
+    for _ in range(6):
+        rec.enabled = True
+        rec_on.append(_fit_seconds(net, ds, iters))
+        rec.enabled = False
+        rec_off.append(_fit_seconds(net, ds, iters))
+    rec.enabled = True
+    telemetry._trace_buffer.clear()
+    d_on, d_off = min(rec_on), min(rec_off)
+    out["diag_fit_step_us_on"] = round(d_on / iters * 1e6, 1)
+    out["diag_fit_step_us_off"] = round(d_off / iters * 1e6, 1)
+    out["diag_overhead_pct_measured"] = round(
+        (d_on - d_off) / d_off * 100, 2)
+    out["diag_overhead_pct_analytic"] = round(
+        record_cost / (d_on / iters) * 100, 2)
     print(json.dumps(out))
 
 

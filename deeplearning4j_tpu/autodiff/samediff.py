@@ -1298,13 +1298,16 @@ class SameDiff:
                 self._updater_state = replicate_tree(
                     mesh, self._updater_state)
             rng = replicate_tree(mesh, rng)
-        from deeplearning4j_tpu.common import telemetry
-        with telemetry.step_span("SameDiff", steps=n_steps):
+        from deeplearning4j_tpu.common import diagnostics, telemetry
+        with telemetry.step_span("SameDiff", steps=n_steps) as sp:
             new_vars, self._updater_state, loss = multi_fn(
                 var_vals, self._updater_state, ph_vals, rng,
                 jnp.asarray(self.iteration_count), n_steps)
         self._arrays.update(new_vars)
         self.iteration_count += n_steps
+        diagnostics.after_step(self, "SameDiff",
+                               self.iteration_count - 1, loss, sp,
+                               params=new_vars, steps=n_steps)
         self._score = float(loss)
         first = next(iter(ph_vals.values()), None)
         if first is not None and first.ndim:
@@ -1468,12 +1471,18 @@ class SameDiff:
                     self._updater_state = to_dense_state(
                         var_vals, self._updater_state)
                 self._rng, rng = jax.random.split(self._rng)
-                from deeplearning4j_tpu.common import telemetry
-                with telemetry.step_span("SameDiff"):
+                from deeplearning4j_tpu.common import (diagnostics,
+                                                       telemetry)
+                with telemetry.step_span("SameDiff") as sp:
                     new_vars, self._updater_state, loss = step_fn(
                         var_vals, self._updater_state, ph_vals,
                         jnp.asarray(iteration), rng)
                 self._arrays.update(new_vars)
+                # loss-only watchdog (grads stay fused in the step);
+                # a trip scans the just-updated variables for the
+                # first poisoned leaf
+                diagnostics.after_step(self, "SameDiff", iteration,
+                                       loss, sp, params=new_vars)
                 if self._frozen_captured_vars \
                         and self._frozen_captured_vars & set(new_vars):
                     # a NESTED subgraph froze one of the variables we

@@ -45,6 +45,19 @@ class _Env:
     # per replica instead of fully replicated. 0 restores the dense
     # replicated update exactly.
     sharded_update: bool = True
+    # numerics watchdog (common.diagnostics): opt-in sampled non-finite
+    # check on loss / global grad norm inside the fit funnels; a trip
+    # raises a structured NumericsEvent instead of training on NaNs
+    numerics_watchdog: bool = False
+    numerics_sample: int = 1            # check every Nth step
+    # flight recorder (common.diagnostics): bounded ring of per-step
+    # records, dumped to JSONL + chrome trace on crash/SIGTERM/watchdog
+    flight_recorder: bool = True
+    flight_recorder_steps: int = 256    # ring capacity (last N steps)
+    flight_recorder_dir: str = ""       # "" -> current directory
+    # refresh HBM gauges from jax device memory stats every Nth
+    # recorded step (the stats call is cheap but not free)
+    hbm_sample_steps: int = 16
     extra: dict = field(default_factory=dict)
 
     def set_debug(self, v: bool):
@@ -66,7 +79,10 @@ class Environment:
       DL4J_TPU_DEVICE_PREFETCH, DL4J_TPU_DEVICE_PREFETCH_DEPTH,
       DL4J_TPU_COMPILE_CACHE, DL4J_TPU_COMPILE_CACHE_DIR,
       DL4J_TPU_RETRACE_WARN, DL4J_TPU_TELEMETRY,
-      DL4J_TPU_SHARDED_UPDATE
+      DL4J_TPU_SHARDED_UPDATE, DL4J_TPU_NUMERICS_WATCHDOG,
+      DL4J_TPU_NUMERICS_SAMPLE, DL4J_TPU_FLIGHT_RECORDER,
+      DL4J_TPU_FLIGHT_RECORDER_STEPS, DL4J_TPU_FLIGHT_RECORDER_DIR,
+      DL4J_TPU_HBM_SAMPLE_STEPS
     """
 
     _inst: _Env | None = None
@@ -99,6 +115,16 @@ class Environment:
                         "DL4J_TPU_RETRACE_WARN", "5")),
                     telemetry=b("DL4J_TPU_TELEMETRY", True),
                     sharded_update=b("DL4J_TPU_SHARDED_UPDATE", True),
+                    numerics_watchdog=b("DL4J_TPU_NUMERICS_WATCHDOG"),
+                    numerics_sample=int(os.environ.get(
+                        "DL4J_TPU_NUMERICS_SAMPLE", "1")),
+                    flight_recorder=b("DL4J_TPU_FLIGHT_RECORDER", True),
+                    flight_recorder_steps=int(os.environ.get(
+                        "DL4J_TPU_FLIGHT_RECORDER_STEPS", "256")),
+                    flight_recorder_dir=os.environ.get(
+                        "DL4J_TPU_FLIGHT_RECORDER_DIR", ""),
+                    hbm_sample_steps=int(os.environ.get(
+                        "DL4J_TPU_HBM_SAMPLE_STEPS", "16")),
                 )
             return cls._inst
 

@@ -57,8 +57,18 @@ class QuietHandler(BaseHTTPRequestHandler):
 
     def send_metrics(self):
         """The process-wide telemetry registry in Prometheus text
-        exposition format (0.0.4) — the ``/metrics`` endpoint."""
+        exposition format (0.0.4) — the ``/metrics`` endpoint.
+
+        HBM gauges refresh scrape-time (dl4j_hbm_live_bytes /
+        dl4j_hbm_peak_bytes) so both the UIServer and the serving
+        endpoint report current device memory — bench_serving
+        correlates p99 latency with memory headroom from this."""
         from deeplearning4j_tpu.common.telemetry import MetricsRegistry
+        try:
+            from deeplearning4j_tpu.common import diagnostics
+            diagnostics.update_hbm_gauges()
+        except Exception:   # noqa: BLE001 — scrape must never 500 on
+            pass            # a backend without memory stats
         self.send_body(MetricsRegistry.get().render_prometheus()
                        .encode(),
                        "text/plain; version=0.0.4; charset=utf-8")

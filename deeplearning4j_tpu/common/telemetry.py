@@ -41,6 +41,7 @@ import logging
 import os
 import threading
 import time
+from collections import deque
 from contextlib import contextmanager
 from typing import Dict, List, Optional, Sequence, Tuple
 
@@ -398,23 +399,39 @@ def enabled() -> bool:
 # one-timeline tracing: a shared chrome-trace event buffer, same event
 # schema as ui.profiling.ProfilingListener so everything merges
 class _TraceBuffer:
+    """RING buffer: past ``max_events`` the OLDEST events are evicted,
+    so a week-long run keeps the most recent window (the flight
+    recorder's dump-on-crash wants the end of the run, not the start)
+    at bounded host memory.  Evictions count into ``dropped`` (exported
+    in trace metadata) and the
+    ``dl4j_trace_events_dropped_total`` counter."""
+
     def __init__(self, max_events: int = 200_000):
         self.max_events = int(os.environ.get(
             "DL4J_TPU_TELEMETRY_MAX_EVENTS", str(max_events)))
         self._lock = threading.Lock()
-        self.events: List[dict] = []
+        self.events: "deque[dict]" = deque()
         self.dropped = 0
 
     def append(self, ev: dict) -> None:
+        n_evicted = 0
         with self._lock:
-            if len(self.events) >= self.max_events:
-                self.dropped += 1
-                return
             self.events.append(ev)
+            # max_events is a plain attribute (tests resize it live),
+            # so ring capacity is enforced here, not via deque(maxlen)
+            while len(self.events) > self.max_events:
+                self.events.popleft()
+                self.dropped += 1
+                n_evicted += 1
+        if n_evicted:
+            counter("dl4j_trace_events_dropped_total",
+                    "chrome-trace span-buffer ring evictions (oldest "
+                    "events displaced once the buffer is full)"
+                    ).inc(n_evicted)
 
     def clear(self) -> None:
         with self._lock:
-            self.events = []
+            self.events = deque()
             self.dropped = 0
 
 
@@ -511,13 +528,18 @@ class _StepSpan:
     than @contextmanager: this runs once per train step, and the <1%
     overhead budget is measured against millisecond steps."""
 
-    __slots__ = ("model", "attrs", "_bound", "t0", "p0")
+    __slots__ = ("model", "attrs", "_bound", "t0", "p0", "duration")
 
     def __init__(self, model: str, attrs: dict):
         self.model = model
         self.attrs = attrs
 
     def __enter__(self):
+        # the clock always runs (two perf_counter calls even when
+        # telemetry is off): the flight recorder reads ``duration``
+        # after the with-block, independent of the metrics gate
+        self.duration = 0.0
+        self.p0 = time.perf_counter()
         reg = MetricsRegistry.get()
         if not reg._state["on"]:
             self._bound = None
@@ -530,13 +552,13 @@ class _StepSpan:
                 _STEP_HELP).bind(model=self.model)
         self._bound = b
         self.t0 = time.time()
-        self.p0 = time.perf_counter()
         return self
 
     def __exit__(self, *exc):
+        dt = time.perf_counter() - self.p0
+        self.duration = dt
         if self._bound is None:
             return False
-        dt = time.perf_counter() - self.p0
         self._bound.observe(dt)
         _trace_buffer.append({
             "name": "train_step", "ph": "X", "pid": os.getpid(),

@@ -68,6 +68,25 @@ log = logging.getLogger("deeplearning4j_tpu")
 #: dtype (the funnels call ``_as_jnp(mask)`` with no dtype)
 _CAST_ATTRS = ("features", "labels")
 
+_STAGED_BYTES_HELP = ("bytes of device-prefetched batches currently "
+                      "staged ahead of the step loop")
+
+
+def _ds_nbytes(ds) -> int:
+    """Host-estimated byte size of a DataSet's arrays (the staged-bytes
+    gauge feeding diagnostics.memory_report attribution)."""
+    from deeplearning4j_tpu.parallel.mesh import DATASET_ARRAY_ATTRS
+    total = 0
+    for attr in DATASET_ARRAY_ATTRS:
+        v = getattr(ds, attr, None)
+        if v is None:
+            continue
+        for a in (v if isinstance(v, (list, tuple)) else (v,)):
+            if hasattr(a, "shape") and hasattr(a, "dtype"):
+                total += int(np.prod(a.shape, dtype=np.int64) *
+                             np.dtype(a.dtype).itemsize)
+    return total
+
 
 class _FeederError:
     """Exception captured on the feeder thread, re-raised on the
@@ -185,6 +204,9 @@ class DevicePrefetcher(DataSetIterator):
                         "dl4j_prefetch_queue_depth",
                         "staged batches currently queued ahead of the "
                         "step loop").set(q.qsize())
+                    telemetry.gauge(
+                        "dl4j_prefetch_staged_bytes",
+                        _STAGED_BYTES_HELP).inc(_ds_nbytes(ds))
             q.put(self._SENTINEL)
         except BaseException as e:       # noqa: BLE001 — re-raised on
             q.put(_FeederError(e))       # the consumer thread
@@ -248,6 +270,10 @@ class DevicePrefetcher(DataSetIterator):
         elif item is self._SENTINEL:
             self._next = None
         else:
+            if telemetry.enabled():
+                telemetry.gauge(
+                    "dl4j_prefetch_staged_bytes",
+                    _STAGED_BYTES_HELP).dec(_ds_nbytes(item))
             self._next = item if self._thread_put else \
                 self._timed_put(item)
 

@@ -42,6 +42,7 @@ class ComputationGraph:
         self._score = float("nan")
         self._rng = jax.random.PRNGKey(conf.seed)
         self._train_step = None
+        self._step_gnorm = False    # step emits a real grad norm
         self._initialized = False
         self._dtype = to_jnp_dtype(conf.dtype)
         self._topo = conf.topo_order()
@@ -337,6 +338,21 @@ class ComputationGraph:
         thr = conf.gradient_normalization_threshold
         dp_mesh, dp_axis = self._dp_mesh, self._dp_axis
 
+        # numerics watchdog: when armed the step also emits the global
+        # grad norm in-jit; when off it is a free zeros constant (see
+        # MultiLayerNetwork._build_train_step)
+        from deeplearning4j_tpu.common.diagnostics import watchdog_enabled
+        want_gnorm = watchdog_enabled()
+        self._step_gnorm = want_gnorm
+
+        def grad_norm(grads):
+            if not want_gnorm:
+                return jnp.zeros((), jnp.float32)
+            sq = [jnp.sum(jnp.square(g.astype(jnp.float32)))
+                  for g in jax.tree_util.tree_leaves(grads)]
+            return jnp.sqrt(sum(sq)) if sq else jnp.zeros((),
+                                                          jnp.float32)
+
         def update_tail(params, upd_states, grads, iteration):
             """Grads -> (new_params, new_upd); shared by the fused step
             and the accumulation apply step. With a dp mesh the updater
@@ -373,9 +389,10 @@ class ComputationGraph:
             (loss, new_states), grads = jax.value_and_grad(
                 loss_fn, has_aux=True)(params, states, inputs, labels,
                                        fmask, lmasks, rng)
+            gnorm = grad_norm(grads)
             new_params, new_upd = update_tail(params, upd_states,
                                               grads, iteration)
-            return new_params, new_states, new_upd, loss
+            return new_params, new_states, new_upd, loss, gnorm
 
         def grad_step(params, states, inputs, labels, fmask, lmasks,
                       rng):
@@ -384,7 +401,7 @@ class ComputationGraph:
             (loss, new_states), grads = jax.value_and_grad(
                 loss_fn, has_aux=True)(params, states, inputs, labels,
                                        fmask, lmasks, rng)
-            return grads, new_states, loss
+            return grads, new_states, loss, grad_norm(grads)
 
         def apply_step(params, upd_states, grads, scale, iteration):
             grads = jax.tree_util.tree_map(lambda g: g * scale, grads)
@@ -623,17 +640,18 @@ class ComputationGraph:
 
             def multi(params, states, upd, inputs, labels, it0, rng):
                 def body(i, carry):
-                    p, s, u, _ = carry
+                    p, s, u, _, _ = carry
                     r = jax.random.fold_in(rng, i)
                     return step_fn(p, s, u, inputs, labels, None, None,
                                    it0 + i, r)
 
                 # loss carry must match step_fn's loss dtype (bf16 nets
-                # produce a bf16 loss)
+                # produce a bf16 loss); grad-norm carry is f32
                 zero = jnp.zeros((), self._dtype)
+                gz = jnp.zeros((), jnp.float32)
                 return jax.lax.fori_loop(
                     0, steps, body,
-                    (params, states, upd, zero))
+                    (params, states, upd, zero, gz))
 
             self._multi_steps[steps] = jax.jit(multi,
                                                donate_argnums=(0, 1, 2))
@@ -641,9 +659,9 @@ class ComputationGraph:
         states_in = self._with_zero_rnn_states(self.states,
                                                int(inputs[0].shape[0]))
         rng = self._next_rng()
-        from deeplearning4j_tpu.common import telemetry
-        with telemetry.step_span("ComputationGraph", steps=steps):
-            self.params, new_states, self.updater_states, loss = \
+        from deeplearning4j_tpu.common import diagnostics, telemetry
+        with telemetry.step_span("ComputationGraph", steps=steps) as sp:
+            self.params, new_states, self.updater_states, loss, gnorm = \
                 self._multi_steps[steps](self.params, states_in,
                                          self.updater_states, inputs,
                                          labels,
@@ -654,6 +672,12 @@ class ComputationGraph:
         self._score = loss
         self.last_batch_size = int(inputs[0].shape[0])
         self.iteration_count += steps
+        # one record per group: the final step's loss/grad norm stand
+        # in for the window (the fori_loop body is opaque to the host)
+        diagnostics.after_step(
+            self, "ComputationGraph", self.iteration_count - 1, loss,
+            sp, grad_norm=gnorm if self._step_gnorm else None,
+            params=self.params, steps=steps)
         for lis in self.listeners:
             lis.iteration_done(self, self.iteration_count - 1,
                                self.epoch_count)
@@ -687,9 +711,9 @@ class ComputationGraph:
         rng = self._next_rng()
         states_in = self._with_zero_rnn_states(self.states,
                                                int(inputs[0].shape[0]))
-        from deeplearning4j_tpu.common import telemetry
-        with telemetry.step_span("ComputationGraph"):
-            self.params, new_states, self.updater_states, loss = \
+        from deeplearning4j_tpu.common import diagnostics, telemetry
+        with telemetry.step_span("ComputationGraph") as sp:
+            self.params, new_states, self.updater_states, loss, gnorm = \
                 self._train_step(self.params, states_in,
                                  self.updater_states, inputs, labels,
                                  fmask, lmasks,
@@ -697,6 +721,12 @@ class ComputationGraph:
         self.states = self._strip_rnn_states(new_states)
         self._score = loss          # device scalar; float() on read
         self.last_batch_size = int(inputs[0].shape[0])
+        # grads never leave the fused step; a trip attributes the first
+        # bad leaf in the (poisoned) post-update params
+        diagnostics.after_step(
+            self, "ComputationGraph", self.iteration_count, loss, sp,
+            grad_norm=gnorm if self._step_gnorm else None,
+            params=self.params)
         self.iteration_count += 1
         for lis in self.listeners:
             lis.iteration_done(self, self.iteration_count - 1,
@@ -711,12 +741,19 @@ class ComputationGraph:
         rng = self._next_rng()
         states_in = self._with_zero_rnn_states(self.states,
                                                int(inputs[0].shape[0]))
-        from deeplearning4j_tpu.common import telemetry
+        from deeplearning4j_tpu.common import diagnostics, telemetry
         with telemetry.step_span("ComputationGraph",
-                                 accumulating=self._accum_steps):
-            grads, new_states, loss = self._grad_step(
+                                 accumulating=self._accum_steps) as sp:
+            grads, new_states, loss, gnorm = self._grad_step(
                 self.params, states_in, inputs, labels, fmask, lmasks,
                 rng)
+            # watchdog check BEFORE accumulate/apply: the apply step
+            # donates the accumulated-grad buffers this micro-batch's
+            # grads may alias
+            diagnostics.check_numerics(
+                self, "ComputationGraph", self.iteration_count, loss,
+                grad_norm=gnorm if self._step_gnorm else None,
+                grads=grads)
             self._accum_grads = (grads if self._accum_grads is None
                                  else self._accum_add(self._accum_grads,
                                                       grads))
@@ -726,6 +763,9 @@ class ComputationGraph:
         self.states = self._strip_rnn_states(new_states)
         self._score = loss          # device scalar; float() on read
         self.last_batch_size = int(inputs[0].shape[0])
+        diagnostics.record_step(
+            self, "ComputationGraph", self.iteration_count, loss, sp,
+            grad_norm=gnorm if self._step_gnorm else None)
         self.iteration_count += 1
         for lis in self.listeners:
             lis.iteration_done(self, self.iteration_count - 1,
@@ -750,12 +790,17 @@ class ComputationGraph:
                 seg_l = [m[:, t0:t0 + L] if m is not None and
                          m.ndim >= 2 else m for m in lmasks]
             self._rng, rng = jax.random.split(self._rng)
-            self.params, states, self.updater_states, loss = \
+            self.params, states, self.updater_states, loss, gnorm = \
                 self._train_step(self.params, states,
                                  self.updater_states, seg_in, seg_lab,
                                  seg_f, seg_l,
                                  jnp.asarray(self.iteration_count), rng)
             self._score = loss          # device scalar; float() on read
+            from deeplearning4j_tpu.common import diagnostics
+            diagnostics.after_step(
+                self, "ComputationGraph", self.iteration_count, loss,
+                None, grad_norm=gnorm if self._step_gnorm else None,
+                params=self.params, tbptt_segment=t0 // L)
             self.iteration_count += 1
         self.states = self._strip_rnn_states(states)
         self.last_batch_size = int(inputs[0].shape[0])

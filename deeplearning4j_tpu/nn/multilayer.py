@@ -56,6 +56,7 @@ class MultiLayerNetwork:
         self._score = float("nan")
         self._rng = jax.random.PRNGKey(conf.seed)
         self._train_step = None
+        self._step_gnorm = False    # step emits a real grad norm
         self._initialized = False
         self._dtype = to_jnp_dtype(conf.dtype)
         self._retrace_guard = None
@@ -276,6 +277,23 @@ class MultiLayerNetwork:
         thr = conf.gradient_normalization_threshold
         dp_mesh, dp_axis = self._dp_mesh, self._dp_axis
 
+        # numerics watchdog (common.diagnostics): when armed, the step
+        # also emits the global grad norm — computed in-jit, fused into
+        # the backward, so the host check is one extra scalar read.
+        # When off it is a free zeros constant and XLA dead-code
+        # eliminates the reduction; the step keeps ONE output shape.
+        from deeplearning4j_tpu.common.diagnostics import watchdog_enabled
+        want_gnorm = watchdog_enabled()
+        self._step_gnorm = want_gnorm
+
+        def grad_norm(grads):
+            if not want_gnorm:
+                return jnp.zeros((), jnp.float32)
+            sq = [jnp.sum(jnp.square(g.astype(jnp.float32)))
+                  for g in jax.tree_util.tree_leaves(grads)]
+            return jnp.sqrt(sum(sq)) if sq else jnp.zeros((),
+                                                          jnp.float32)
+
         def update_tail(params, upd_states, grads, iteration):
             """Grads -> (new_params, new_upd). Shared by the fused step
             and the accumulation apply step. With a dp mesh installed
@@ -312,9 +330,10 @@ class MultiLayerNetwork:
             (loss, new_states), grads = jax.value_and_grad(
                 loss_fn, has_aux=True)(params, states, x, y, fmask,
                                        lmask, rng)
+            gnorm = grad_norm(grads)
             new_params, new_upd = update_tail(params, upd_states,
                                               grads, iteration)
-            return new_params, new_states, new_upd, loss
+            return new_params, new_states, new_upd, loss, gnorm
 
         def grad_step(params, states, x, y, fmask, lmask, rng):
             # accumulation micro-step: backward only, no update (params
@@ -322,7 +341,7 @@ class MultiLayerNetwork:
             (loss, new_states), grads = jax.value_and_grad(
                 loss_fn, has_aux=True)(params, states, x, y, fmask,
                                        lmask, rng)
-            return grads, new_states, loss
+            return grads, new_states, loss, grad_norm(grads)
 
         def apply_step(params, upd_states, grads, scale, iteration):
             grads = jax.tree_util.tree_map(lambda g: g * scale, grads)
@@ -470,15 +489,16 @@ class MultiLayerNetwork:
 
             def multi(params, states, upd, x, y, it0, rng):
                 def body(i, carry):
-                    p, s, u, _ = carry
+                    p, s, u, _, _ = carry
                     r = jax.random.fold_in(rng, i)
                     return step_fn(p, s, u, x, y, None, None, it0 + i, r)
 
                 # loss carry must match step_fn's loss dtype (bf16 nets
-                # produce a bf16 loss)
+                # produce a bf16 loss); grad-norm carry is f32
                 zero = jnp.zeros((), self._dtype)
+                gz = jnp.zeros((), jnp.float32)
                 return jax.lax.fori_loop(0, steps, body,
-                                         (params, states, upd, zero))
+                                         (params, states, upd, zero, gz))
 
             self._multi_steps[steps] = jax.jit(multi,
                                                donate_argnums=(0, 1, 2))
@@ -486,9 +506,9 @@ class MultiLayerNetwork:
         states_in = self._with_zero_rnn_states(self.states,
                                                int(x.shape[0]))
         self._rng, rng = jax.random.split(self._rng)
-        from deeplearning4j_tpu.common import telemetry
-        with telemetry.step_span("MultiLayerNetwork", steps=steps):
-            self.params, new_states, self.updater_states, loss = \
+        from deeplearning4j_tpu.common import diagnostics, telemetry
+        with telemetry.step_span("MultiLayerNetwork", steps=steps) as sp:
+            self.params, new_states, self.updater_states, loss, gnorm = \
                 self._multi_steps[steps](self.params, states_in,
                                          self.updater_states, x, y,
                                          jnp.asarray(
@@ -498,6 +518,12 @@ class MultiLayerNetwork:
         self._score = loss
         self.last_batch_size = int(x.shape[0])
         self.iteration_count += steps
+        # one record per group: the final step's loss/grad norm stand
+        # in for the window (the fori_loop body is opaque to the host)
+        diagnostics.after_step(
+            self, "MultiLayerNetwork", self.iteration_count - 1, loss,
+            sp, grad_norm=gnorm if self._step_gnorm else None,
+            params=self.params, steps=steps)
         for lis in self.listeners:
             lis.iteration_done(self, self.iteration_count - 1,
                                self.epoch_count)
@@ -595,9 +621,9 @@ class MultiLayerNetwork:
         self._rng, rng = jax.random.split(self._rng)
         states_in = self._with_zero_rnn_states(self.states,
                                                int(x.shape[0]))
-        from deeplearning4j_tpu.common import telemetry
-        with telemetry.step_span("MultiLayerNetwork"):
-            self.params, new_states, self.updater_states, loss = \
+        from deeplearning4j_tpu.common import diagnostics, telemetry
+        with telemetry.step_span("MultiLayerNetwork") as sp:
+            self.params, new_states, self.updater_states, loss, gnorm = \
                 self._train_step(self.params, states_in,
                                  self.updater_states, x, y, fmask, lmask,
                                  jnp.asarray(self.iteration_count), rng)
@@ -606,6 +632,12 @@ class MultiLayerNetwork:
         self.states = self._strip_rnn_states(new_states)
         self._score = loss          # device scalar; float() on read
         self.last_batch_size = int(x.shape[0])
+        # grads never leave the fused step, so a trip attributes the
+        # first bad leaf in the (poisoned) post-update params
+        diagnostics.after_step(
+            self, "MultiLayerNetwork", self.iteration_count, loss, sp,
+            grad_norm=gnorm if self._step_gnorm else None,
+            params=self.params)
         self.iteration_count += 1
         for lis in self.listeners:
             lis.iteration_done(self, self.iteration_count - 1,
@@ -620,11 +652,19 @@ class MultiLayerNetwork:
         self._rng, rng = jax.random.split(self._rng)
         states_in = self._with_zero_rnn_states(self.states,
                                                int(x.shape[0]))
-        from deeplearning4j_tpu.common import telemetry
+        from deeplearning4j_tpu.common import diagnostics, telemetry
         with telemetry.step_span("MultiLayerNetwork",
-                                 accumulating=self._accum_steps):
-            grads, new_states, loss = self._grad_step(
+                                 accumulating=self._accum_steps) as sp:
+            grads, new_states, loss, gnorm = self._grad_step(
                 self.params, states_in, x, y, fmask, lmask, rng)
+            # watchdog check BEFORE accumulate/apply: the first
+            # micro-batch's grads become _accum_grads, whose buffers
+            # the apply step donates — after that the scan target is
+            # gone
+            diagnostics.check_numerics(
+                self, "MultiLayerNetwork", self.iteration_count, loss,
+                grad_norm=gnorm if self._step_gnorm else None,
+                grads=grads)
             self._accum_grads = (grads if self._accum_grads is None
                                  else self._accum_add(self._accum_grads,
                                                       grads))
@@ -634,6 +674,9 @@ class MultiLayerNetwork:
         self.states = self._strip_rnn_states(new_states)
         self._score = loss          # device scalar; float() on read
         self.last_batch_size = int(x.shape[0])
+        diagnostics.record_step(
+            self, "MultiLayerNetwork", self.iteration_count, loss, sp,
+            grad_norm=gnorm if self._step_gnorm else None)
         self.iteration_count += 1
         for lis in self.listeners:
             lis.iteration_done(self, self.iteration_count - 1,
@@ -650,17 +693,22 @@ class MultiLayerNetwork:
         def seg(m, t0):
             return m[:, t0:t0 + L] if m is not None and m.ndim >= 2 else m
 
+        from deeplearning4j_tpu.common import diagnostics
         states = self._with_zero_rnn_states(self.states, int(x.shape[0]))
         for t0 in range(0, T, L):
             seg_x = x[:, t0:t0 + L]
             seg_y = y[:, t0:t0 + L] if y.ndim >= 3 else y
             self._rng, rng = jax.random.split(self._rng)
-            self.params, states, self.updater_states, loss = \
+            self.params, states, self.updater_states, loss, gnorm = \
                 self._train_step(self.params, states,
                                  self.updater_states, seg_x, seg_y,
                                  seg(fmask, t0), seg(lmask, t0),
                                  jnp.asarray(self.iteration_count), rng)
             self._score = loss          # device scalar; float() on read
+            diagnostics.after_step(
+                self, "MultiLayerNetwork", self.iteration_count, loss,
+                None, grad_norm=gnorm if self._step_gnorm else None,
+                params=self.params, tbptt_segment=t0 // L)
             self.iteration_count += 1
         self.states = self._strip_rnn_states(states)
         self.last_batch_size = int(x.shape[0])

@@ -229,8 +229,11 @@ class SharedTrainingMaster:
         return model
 
     def _make_global(self, mesh, ds):
-        with telemetry.span("dp.global_assembly",
-                            processes=jax.process_count()):
+        from deeplearning4j_tpu.common.diagnostics import collective_span
+        from deeplearning4j_tpu.datasets.prefetch import _ds_nbytes
+        with collective_span("global_assembly", DEFAULT_DATA_AXIS,
+                             _ds_nbytes(ds),
+                             processes=jax.process_count()):
             return self._make_global_inner(mesh, ds)
 
     def _make_global_inner(self, mesh, ds):

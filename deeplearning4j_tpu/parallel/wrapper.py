@@ -258,9 +258,12 @@ class ParallelWrapper:
                     # the collective cost shows on the one timeline.
                     mode = self.update_exchange.value
                     t0 = time.perf_counter()
-                    with telemetry.span("dp.update_exchange",
-                                        mode=mode,
-                                        bytes=self._exchange_bytes):
+                    from deeplearning4j_tpu.common.diagnostics import \
+                        collective_span
+                    with collective_span("update_exchange",
+                                         self.data_axis,
+                                         self._exchange_bytes,
+                                         mode=mode):
                         self.model.fit(ds)
                     telemetry.histogram(
                         "dl4j_dp_step_seconds",

@@ -165,12 +165,19 @@ def place_updater_states(mesh, states: Dict,
             lambda a: jax.device_put(a, sh) if hasattr(a, "shape") else a,
             tree)
 
+    from deeplearning4j_tpu.common.diagnostics import collective_span
+    nbytes = sum(int(np.prod(a.shape)) * a.dtype.itemsize
+                 for s in states.values()
+                 for a in jax.tree_util.tree_leaves(s)
+                 if hasattr(a, "shape"))
     out = {}
-    for k, s in states.items():
-        if is_dp_sharded(s):
-            out[k] = {DP_SHARDED_KEY: put(s[DP_SHARDED_KEY], shard)}
-        else:
-            out[k] = put(s, full)
+    with collective_span("state_placement", axis, nbytes,
+                         entries=len(states)):
+        for k, s in states.items():
+            if is_dp_sharded(s):
+                out[k] = {DP_SHARDED_KEY: put(s[DP_SHARDED_KEY], shard)}
+            else:
+                out[k] = put(s, full)
     return out
 
 

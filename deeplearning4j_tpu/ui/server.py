@@ -12,6 +12,8 @@ Endpoints:
 - ``/``             live dashboard (auto-refreshes every 2s)
 - ``/api/reports``  all reports of every attached storage (JSON)
 - ``/api/latest``   most recent report (JSON)
+- ``/api/memory``   per-buffer HBM attribution report (JSON —
+  ``common.diagnostics.memory_report``)
 - ``/metrics``      process-wide telemetry registry in Prometheus
   text exposition format (``common.telemetry.MetricsRegistry``) —
   point a Prometheus scrape job (or ``curl``) at it
@@ -138,6 +140,12 @@ class UIServer:
                                   r["time"] > latest["time"]):
                             latest = r
                     self.send_json(latest)
+                elif self.path == "/api/memory":
+                    from deeplearning4j_tpu.common import diagnostics
+                    try:
+                        self.send_json(diagnostics.memory_report())
+                    except Exception as e:   # noqa: BLE001
+                        self.send_json({"error": repr(e)}, 500)
                 elif self.path == "/metrics":
                     self.send_metrics()
                 else:

@@ -1,0 +1,162 @@
+#!/usr/bin/env python
+"""Gate a fresh bench JSON against a baseline: exit non-zero on >N%
+throughput (or step-time) regression.
+
+The companion of ``bench.py``'s new ``meta`` block: once rounds are
+comparable run-to-run, a regression becomes a checkable claim instead
+of a diff someone eyeballs. Usage:
+
+    python scripts/check_bench_regression.py BASELINE.json FRESH.json
+    python scripts/check_bench_regression.py --threshold 5 r04.json r05.json
+
+Accepted file shapes (auto-detected):
+
+- a raw ``bench.py`` output line: ``{"metric": ..., "value": ...}``
+- the BENCH_r*.json driver wrapper: ``{"n", "cmd", "rc", "tail",
+  "parsed"}`` — ``parsed`` is used; if absent, the last JSON line in
+  ``tail`` is.
+
+Comparison: for every shared numeric metric with known polarity —
+throughput-like (higher is better: ``value``, ``*_ips``, ``tflops``,
+``throughput_rps``) and time-like (lower is better: ``*_ms``,
+``*_us``, ``*_seconds``, ``*_pct`` overhead figures) — the fresh run
+must not regress by more than ``--threshold`` percent. Improvements
+never fail. Exit 0 = clean, 1 = regression(s), 2 = unusable input.
+
+Self-test (tier-1, no accelerator): comparing the checked-in
+BENCH_r04.json to BENCH_r05.json must pass (r05 improved), and the
+reverse direction at a tight threshold must flag the throughput drop
+(see tests/test_diagnostics.py).
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+#: metrics where larger is better (substring match on the key)
+HIGHER_BETTER = ("value", "tflops", "throughput", "_ips", "_rps")
+#: metrics where smaller is better
+LOWER_BETTER = ("_ms", "_us", "_seconds", "overhead", "stall")
+#: keys that are identity/config, never compared; "canary" keys are
+#: clock-path checks documented as dispatch-noise-dominated
+SKIP = ("metric", "unit", "n_trials", "vs_baseline", "meta", "min",
+        "max", "telemetry", "memory", "canary")
+
+
+def load_bench(path: str) -> dict:
+    """The bench record from either a raw bench.py JSON line or a
+    BENCH_r*.json driver wrapper."""
+    with open(path) as f:
+        doc = json.load(f)
+    if not isinstance(doc, dict):
+        raise ValueError(f"{path}: expected a JSON object")
+    if "metric" in doc:
+        return doc
+    parsed = doc.get("parsed")
+    if isinstance(parsed, dict) and "metric" in parsed:
+        return parsed
+    # wrapper without parsed: last JSON object line in the tail
+    for line in reversed((doc.get("tail") or "").splitlines()):
+        line = line.strip()
+        if line.startswith("{"):
+            try:
+                rec = json.loads(line)
+            except json.JSONDecodeError:
+                continue
+            if "metric" in rec:
+                return rec
+    raise ValueError(f"{path}: no bench record found (neither a raw "
+                     f"line, nor wrapper 'parsed'/'tail')")
+
+
+def _flatten(rec: dict, prefix: str = "") -> dict:
+    out = {}
+    for k, v in rec.items():
+        if k in SKIP or any(s in k for s in ("canary",)):
+            continue
+        key = f"{prefix}{k}"
+        if isinstance(v, dict):
+            out.update(_flatten(v, key + "."))
+        elif isinstance(v, (int, float)) and not isinstance(v, bool):
+            out[key] = float(v)
+    return out
+
+
+def _polarity(key: str):
+    leaf = key.rsplit(".", 1)[-1]
+    for pat in LOWER_BETTER:
+        if pat in leaf:
+            return -1
+    for pat in HIGHER_BETTER:
+        if pat in leaf:
+            return +1
+    return 0           # unknown polarity: informational only
+
+
+def compare(baseline: dict, fresh: dict, threshold_pct: float):
+    """(regressions, improvements, skipped) — each a list of
+    (key, base, fresh, delta_pct) tuples; delta_pct is signed so that
+    negative always means 'got worse'."""
+    base_f, fresh_f = _flatten(baseline), _flatten(fresh)
+    regressions, improvements, skipped = [], [], []
+    for key in sorted(set(base_f) & set(fresh_f)):
+        b, f = base_f[key], fresh_f[key]
+        pol = _polarity(key)
+        if pol == 0 or (b == 0 and not key.endswith("_pct")):
+            skipped.append((key, b, f, 0.0))
+            continue
+        if key.rsplit(".", 1)[-1].endswith("_pct"):
+            # already a percentage: compare in absolute points (a
+            # noise-floor move like -0.9% -> 1.4% must not read as a
+            # -256% relative regression)
+            delta = pol * (f - b)
+        else:
+            delta = pol * (f - b) / abs(b) * 100     # + = improved
+        row = (key, b, f, delta)
+        if delta < -threshold_pct:
+            regressions.append(row)
+        elif delta > 0:
+            improvements.append(row)
+    return regressions, improvements, skipped
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("baseline", help="baseline bench JSON")
+    ap.add_argument("fresh", help="fresh bench JSON to gate")
+    ap.add_argument("--threshold", type=float, default=10.0,
+                    help="max tolerated regression, percent "
+                         "(default 10)")
+    ap.add_argument("-q", "--quiet", action="store_true",
+                    help="only print regressions")
+    args = ap.parse_args(argv)
+    try:
+        base = load_bench(args.baseline)
+        fresh = load_bench(args.fresh)
+    except (OSError, ValueError, json.JSONDecodeError) as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 2
+    if base.get("metric") != fresh.get("metric"):
+        print(f"error: metric mismatch — baseline "
+              f"{base.get('metric')!r} vs fresh "
+              f"{fresh.get('metric')!r}", file=sys.stderr)
+        return 2
+    regs, imps, _ = compare(base, fresh, args.threshold)
+    for key, b, f, d in regs:
+        print(f"REGRESSION {key}: {b:g} -> {f:g} ({d:+.1f}% vs "
+              f"-{args.threshold:g}% allowed)")
+    if not args.quiet:
+        for key, b, f, d in imps:
+            print(f"ok         {key}: {b:g} -> {f:g} ({d:+.1f}%)")
+    if regs:
+        print(f"{len(regs)} regression(s) beyond "
+              f"{args.threshold:g}%", file=sys.stderr)
+        return 1
+    print(f"no regressions beyond {args.threshold:g}% "
+          f"({len(imps)} improved)")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

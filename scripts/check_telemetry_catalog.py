@@ -66,16 +66,20 @@ def registered_metrics() -> Dict[str, Set[str]]:
 
 
 def documented_metrics() -> Dict[str, str]:
-    """{metric name: documented kind} from the catalog table (names
-    mentioned outside table rows count as documented with kind '')."""
+    """{metric name: documented kind} from the catalog tables in the
+    "## Observability" AND "## Diagnostics" sections (names mentioned
+    outside table rows count as documented with kind '')."""
     text = README.read_text()
-    m = re.search(r"## Observability(.*?)(?:\n## |\Z)", text, re.S)
-    if not m:
-        return {}
-    section = m.group(1)
-    doc = {name: "" for name in _DOC_RE.findall(section)}
-    doc.update({name: kind
-                for name, kind in _DOC_ROW_RE.findall(section)})
+    doc: Dict[str, str] = {}
+    for heading in ("Observability", "Diagnostics"):
+        m = re.search(rf"## {heading}(.*?)(?:\n## |\Z)", text, re.S)
+        if not m:
+            continue
+        section = m.group(1)
+        for name in _DOC_RE.findall(section):
+            doc.setdefault(name, "")
+        doc.update({name: kind
+                    for name, kind in _DOC_ROW_RE.findall(section)})
     return doc
 
 
