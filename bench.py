@@ -245,6 +245,32 @@ def main():
                   file=sys.stderr)
     except Exception as e:
         print(f"update-sharding leg failed: {e!r}", file=sys.stderr)
+    # Graph-optimizer leg: per-pass rewrite counts + fused-vs-unfused
+    # imported-BERT step time, and the flash-vs-dense compiled temp
+    # memory floor at a long-sequence shape. CPU-proxy subprocess,
+    # like the legs above.
+    try:
+        env = {**os.environ, "PYTHONPATH": "", "JAX_PLATFORMS": "cpu"}
+        out = subprocess.run(
+            [sys.executable,
+             os.path.join(_ROOT, "benchmarks", "bench_graphopt.py")],
+            capture_output=True, text=True, timeout=900, env=env,
+            cwd=_ROOT)
+        if out.returncode != 0:
+            raise RuntimeError(
+                f"rc={out.returncode}: {out.stderr.strip()[-400:]}")
+        for ln in out.stdout.strip().splitlines():
+            if not ln.startswith("{"):
+                continue              # tolerate library banners
+            rec = json.loads(ln)
+            if rec.get("metric") == "graph_optimizer":
+                rec.pop("metric")
+                line["graph_optimizer"] = rec
+        if "graph_optimizer" not in line:
+            print("graph-optimizer leg: no line in child output",
+                  file=sys.stderr)
+    except Exception as e:
+        print(f"graph-optimizer leg failed: {e!r}", file=sys.stderr)
     # Telemetry panel: the registry the run's hot paths recorded into
     # (train-step histogram, compile-cache counters, prefetch stats
     # when an iterator fed) — the same data /metrics would serve.

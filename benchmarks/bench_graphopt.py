@@ -1,0 +1,130 @@
+"""GraphOptimizer + flash-attention bench leg.
+
+Two measurements, one JSON line (``{"metric": "graph_optimizer"}``):
+
+1. **Imported-BERT pass payoff** — a frozen toy-dim TF BERT imported
+   twice (``optimize=False`` vs the default pipeline) with the MLM
+   head attached; reports the per-pass rewrite counts and the median
+   ``fit_steps`` dispatch time of each program. On CPU this is a
+   proxy (dispatch-dominated at toy dims); the real-dim
+   imported-vs-native gap is bench_bert_imported.py's job on TPU.
+
+2. **Flash memory floor** — XLA ``memory_analysis()`` of a compiled
+   long-sequence sdpa: dense einsum attention materializes the
+   ``[b, h, t, t]`` scores tensor in temp HBM; the Pallas kernel
+   (interpret-mode compile off-TPU, same code path) never does. Temp
+   bytes for both at the long-seq shape quantify the floor the
+   backend removes; falls back to the analytic scores-tensor size if
+   ``memory_analysis`` is unavailable on the backend.
+
+Flags: --batch --seq --layers --steps --flash-seq
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import statistics
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+
+def _median_step_ms(sd, feeds, steps, trials=5):
+    sd.fit_steps(feeds, steps)                    # compile + warm
+    times = []
+    for _ in range(trials):
+        t0 = time.perf_counter()
+        float(sd.fit_steps(feeds, steps))         # syncs final loss
+        times.append((time.perf_counter() - t0) / steps * 1e3)
+    return round(statistics.median(times), 3)
+
+
+def _imported_bert_leg(batch, seq, layers, steps):
+    from benchmarks.tf_bert_builder import (build_frozen_bert,
+                                            import_and_attach_mlm)
+    from deeplearning4j_tpu.learning import Adam
+    vocab, hidden, heads = 50, 32, 2
+    gd, _ = build_frozen_bert(seq, batch, vocab=vocab, hidden=hidden,
+                              heads=heads, layers=layers,
+                              intermediate=64)
+    rs = np.random.RandomState(0)
+    feeds = {
+        "ids": rs.randint(0, vocab, (batch, seq)).astype(np.int32),
+        "seg": np.zeros((batch, seq), np.int32),
+        "mask": np.ones((batch, seq), np.int32),
+        "mlm_labels": np.where(rs.rand(batch, seq) < 0.3,
+                               rs.randint(0, vocab, (batch, seq)),
+                               -1).astype(np.int32)}
+    plain, _ = import_and_attach_mlm(gd, batch, seq, vocab=vocab,
+                                     hidden=hidden, updater=Adam(1e-3),
+                                     optimize=False)
+    opt, _ = import_and_attach_mlm(gd, batch, seq, vocab=vocab,
+                                   hidden=hidden, updater=Adam(1e-3))
+    t_plain = _median_step_ms(plain, feeds, steps)
+    t_opt = _median_step_ms(opt, feeds, steps)
+    return {"batch": batch, "seq": seq, "layers": layers,
+            "counts": dict(opt.graphopt_counts),
+            "step_ms_unoptimized": t_plain,
+            "step_ms_optimized": t_opt,
+            "speedup": round(t_plain / t_opt, 3) if t_opt else None}
+
+
+def _flash_memory_leg(flash_seq):
+    import jax
+    import jax.numpy as jnp
+
+    from deeplearning4j_tpu.ops.attention import dot_product_attention
+    from deeplearning4j_tpu.ops.attention_pallas import flash_sdpa
+    b, h, t, d = 1, 4, flash_seq, 64
+    q = jnp.zeros((b, h, t, d), jnp.float32)
+    scores_bytes = 4 * b * h * t * t
+
+    def _temp_bytes(fn):
+        c = jax.jit(fn).lower(q, q, q).compile()
+        return int(c.memory_analysis().temp_size_in_bytes)
+
+    leg = {"shape": [b, h, t, d],
+           "dense_scores_bytes_analytic": scores_bytes}
+    try:
+        dense = _temp_bytes(
+            lambda q, k, v: dot_product_attention(q, k, v))
+        flash = _temp_bytes(
+            lambda q, k, v: flash_sdpa(q, k, v, block_q=1024,
+                                       block_k=1024))
+        leg.update(dense_temp_bytes=dense, flash_temp_bytes=flash,
+                   temp_ratio=round(dense / flash, 2) if flash
+                   else None, source="memory_analysis")
+    except Exception as e:
+        print(f"memory_analysis unavailable ({e!r}); analytic only",
+              file=sys.stderr)
+        leg["source"] = "analytic"
+    return leg
+
+
+def main(batch=4, seq=64, layers=2, steps=8, flash_seq=4096):
+    line = {"metric": "graph_optimizer"}
+    try:
+        line["imported_bert"] = _imported_bert_leg(batch, seq, layers,
+                                                   steps)
+    except Exception as e:
+        print(f"imported-bert leg failed: {e!r}", file=sys.stderr)
+    try:
+        line["flash_memory"] = _flash_memory_leg(flash_seq)
+    except Exception as e:
+        print(f"flash-memory leg failed: {e!r}", file=sys.stderr)
+    print(json.dumps(line))
+
+
+if __name__ == "__main__":
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--batch", type=int, default=4)
+    ap.add_argument("--seq", type=int, default=64)
+    ap.add_argument("--layers", type=int, default=2)
+    ap.add_argument("--steps", type=int, default=8)
+    ap.add_argument("--flash-seq", type=int, default=4096)
+    a = ap.parse_args()
+    main(a.batch, a.seq, a.layers, a.steps, a.flash_seq)

@@ -103,7 +103,7 @@ def build_frozen_bert(seq: int, batch: int, *, vocab=30522, hidden=768,
 
 def import_and_attach_mlm(gd_bytes, batch, seq, *, vocab, hidden,
                           updater=None, dtype=None,
-                          max_predictions=None):
+                          max_predictions=None, optimize=None):
     """Import the frozen encoder, promote every frozen weight to a
     trainable VARIABLE, and attach a weight-tied MLM objective:
     logits = seq_out @ tok_embedding^T, sparse softmax xent over the
@@ -126,7 +126,8 @@ def import_and_attach_mlm(gd_bytes, batch, seq, *, vocab, hidden,
 
     shapes = {"ids": (batch, seq), "seg": (batch, seq),
               "mask": (batch, seq)}
-    sd = TensorflowFrameworkImporter.run_import(gd_bytes, shapes)
+    sd = TensorflowFrameworkImporter.run_import(gd_bytes, shapes,
+                                                optimize=optimize)
     wnames = [n for n, v in sd.vars.items()
               if v.var_type == VariableType.CONSTANT
               and ("ReadVariableOp" in n or n.endswith("/resource"))]

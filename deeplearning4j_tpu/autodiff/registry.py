@@ -1320,17 +1320,47 @@ def _hinge(ins, attrs):
 
 
 # -- attention (Appendix A: attention domain) -------------------------------
+@op("apply_key_mask", "attention")
+def _apply_key_mask(ins, attrs):
+    """Pre-softmax mask select: where(mask > 0, scores, neg). The
+    strength-reduced form of the exporter's additive
+    ``scores + (1-mask)*neg`` bias chain (autodiff.passes.
+    mask_strength_reduce) — same post-softmax values for any row with
+    >= 1 unmasked key, and the form attention_fuse turns into
+    ``sdpa_core``'s native key-mask mode."""
+    x, m = ins[0], ins[1]
+    neg = attrs.get("neg", -1e9)
+    return jnp.where(m > 0, x, jnp.asarray(neg, x.dtype))
+
+
 @op("sdpa_core", "attention")
 def _sdpa_core(ins, attrs):
     """Fused scaled-dot-product-attention core: softmax(q k^T * scale
-    [+ bias]) v with q/k/v [..., t, dh]. The target of
-    SameDiff.fuse_attention_patterns — one op XLA schedules as a unit
-    (and jax.checkpoint recomputes as a unit). Delegates to the ONE
-    shared attention implementation (ops/attention.py)."""
+    [+ bias | masked]) v with q/k/v [..., t, dh]. The target of the
+    GraphOptimizer attention fusion — one op XLA schedules as a unit
+    (and jax.checkpoint recomputes as a unit).
+
+    ``attrs["mask_mode"] == "key"`` marks the 4th input as a key mask
+    (0 = masked, broadcastable to the score shape) instead of an
+    additive bias. Backend dispatch: the Pallas flash-attention
+    kernel (ops/attention_pallas.py) takes the op when the
+    sequence-length/HBM-headroom heuristic (or the
+    DL4J_TPU_FLASH_ATTENTION override) selects it and the site is
+    structurally streamable (no dense additive bias); otherwise the
+    ONE shared einsum implementation (ops/attention.py) runs."""
     from deeplearning4j_tpu.ops.attention import dot_product_attention
+    from deeplearning4j_tpu.ops.attention_pallas import maybe_flash_sdpa
     q, k, v = ins[0], ins[1], ins[2]
-    bias = ins[3] if len(ins) > 3 else None
-    return dot_product_attention(q, k, v, scale=attrs.get("scale", 1.0),
+    extra = ins[3] if len(ins) > 3 else None
+    scale = attrs.get("scale", 1.0)
+    if attrs.get("mask_mode") == "key":
+        mask, bias = extra, None
+    else:
+        mask, bias = None, extra
+    out = maybe_flash_sdpa(q, k, v, scale, mask=mask, bias=bias)
+    if out is not None:
+        return out
+    return dot_product_attention(q, k, v, mask=mask, scale=scale,
                                  bias=bias)
 
 

@@ -654,103 +654,27 @@ class SameDiff:
             return {}
 
     def fuse_attention_patterns(self) -> int:
-        """Graph-optimization pass (reference role: SameDiff's
+        """Attention-fusion pass (reference role: SameDiff's
         GraphOptimizer/OptimizationConfig): recognize the exporter's
-        op-by-op attention —
-
-            matmul(q, k, transpose_b) -> div/mul(const)
-            [-> add(bias)] -> softmax -> matmul(., v)
-
-        — and rewrite each occurrence to ONE fused ``sdpa_core`` op.
-        XLA then schedules (and under remat, recomputes) the whole
-        pattern as a unit, the way the natively-authored attention
-        lowers. Conservative: every interior value must have exactly
-        one consumer and the scale must be a scalar constant;
-        anything else is left untouched. Returns the number of sites
-        fused; compiled-program caches are dropped when > 0."""
-        consumers: Dict[str, list] = {}
-        for idx, o in enumerate(self.ops):
-            for inp in o.inputs:
-                consumers.setdefault(inp, []).append(idx)
-
-        def producer(name):
-            i = self._producer.get(name)
-            return self.ops[i] if i is not None else None
-
-        def single_use(name):
-            return len(consumers.get(name, ())) == 1
-
-        def scalar_const(name):
-            a = self._arrays.get(name)
-            if a is None or np.size(np.asarray(a)) != 1:
-                return None
-            v = self.vars.get(name)
-            if v is None or v.var_type is not VariableType.CONSTANT:
-                return None
-            return float(np.asarray(a).reshape(()))
-
-        fused = 0
-        for sm in list(self.ops):
-            if sm.op_name != "softmax":
-                continue
-            ax = sm.attrs.get("axis", -1)
-            if ax not in (-1, None):
-                continue
-            pre = producer(sm.inputs[0])
-            bias = None
-            if pre is not None and pre.op_name == "add":
-                l, r = pre.inputs
-                lp, rp = producer(l), producer(r)
-                if lp is not None and lp.op_name in ("div", "mul"):
-                    scal, bias = lp, r
-                elif rp is not None and rp.op_name in ("div", "mul"):
-                    scal, bias = rp, l
-                else:
-                    continue
-                if not single_use(scal.outputs[0]):
-                    continue
-            elif pre is not None and pre.op_name in ("div", "mul"):
-                scal = pre
-            else:
-                continue
-            # div's operand order is load-bearing; mul commutes, so
-            # accept the constant on either side
-            score_in, c = scal.inputs[0], scalar_const(scal.inputs[1])
-            if c is None and scal.op_name == "mul":
-                score_in, c = scal.inputs[1], \
-                    scalar_const(scal.inputs[0])
-            if c is None or (scal.op_name == "div" and c == 0.0):
-                continue
-            scale = (1.0 / c) if scal.op_name == "div" else c
-            mm = producer(score_in)
-            if mm is None or mm.op_name != "matmul" \
-                    or mm.attrs.get("transpose_a") \
-                    or not mm.attrs.get("transpose_b") \
-                    or not single_use(mm.outputs[0]) \
-                    or not single_use(sm.inputs[0]):
-                continue
-            cons = consumers.get(sm.outputs[0], [])
-            if len(cons) != 1:
-                continue
-            out_mm = self.ops[cons[0]]
-            if out_mm.op_name != "matmul" \
-                    or out_mm.inputs[0] != sm.outputs[0] \
-                    or out_mm.attrs.get("transpose_a") \
-                    or out_mm.attrs.get("transpose_b"):
-                continue
-            q_name, k_name = mm.inputs
-            v_name = out_mm.inputs[1]
-            # rewrite IN PLACE: the consumer matmul becomes the fused
-            # op; the old chain is dead (the executor walks ancestors
-            # of the requested outputs only)
-            out_mm.op_name = "sdpa_core"
-            out_mm.inputs = ([q_name, k_name, v_name] +
-                             ([bias] if bias is not None else []))
-            out_mm.attrs = {"scale": scale}
-            fused += 1
+        op-by-op attention and rewrite each occurrence to ONE fused
+        ``sdpa_core`` op — now one pass of the full pipeline in
+        autodiff.passes (see :meth:`optimize`). Kept as a standalone
+        entry point for API compatibility: returns the number of
+        sites fused; compiled-program caches are dropped when > 0."""
+        from deeplearning4j_tpu.autodiff.passes import attention_fuse
+        fused = attention_fuse(self)
         if fused:
             self._exec_cache.clear()
         return fused
+
+    def optimize(self, passes=None) -> Dict[str, int]:
+        """Run the full GraphOptimizer pass pipeline (autodiff.passes):
+        cast folding, mask strength reduction, LayerNorm/GELU
+        re-fusion, attention fusion — ordered, iterated to fixpoint.
+        Importers invoke this automatically post-import unless
+        DL4J_TPU_GRAPHOPT=0. Returns per-pass rewrite counts."""
+        from deeplearning4j_tpu.autodiff.passes import GraphOptimizer
+        return GraphOptimizer(self, passes=passes).run()
 
     def set_remat_segments(self, n: int):
         """Cut TRAINING forward programs into ``n`` ``jax.checkpoint``

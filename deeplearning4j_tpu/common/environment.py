@@ -83,6 +83,14 @@ class Environment:
       DL4J_TPU_NUMERICS_SAMPLE, DL4J_TPU_FLIGHT_RECORDER,
       DL4J_TPU_FLIGHT_RECORDER_STEPS, DL4J_TPU_FLIGHT_RECORDER_DIR,
       DL4J_TPU_HBM_SAMPLE_STEPS
+
+    Read live (not cached here) by their subsystems:
+      DL4J_TPU_GRAPHOPT (post-import GraphOptimizer pipeline, default
+      on; =0 kills), DL4J_TPU_DUMP_GRAPHOPT (op-walk dumps around
+      each mutating pass), DL4J_TPU_FLASH_ATTENTION (tri-state: =1
+      forces the Pallas flash sdpa backend, =0 kills it, unset =
+      auto heuristic), DL4J_TPU_FUSED_BN_BWD (fused BN backward:
+      default on-for-TPU; =0 kills, =1 forces anywhere)
     """
 
     _inst: _Env | None = None

@@ -125,7 +125,7 @@ class OnnxImporter:
                 sv.shape = tuple(av.shape)
                 sv.dtype = av.dtype
 
-    def run(self) -> SameDiff:
+    def run(self, optimize: Optional[bool] = None) -> SameDiff:
         g = self.graph
         init_names = set(g.initializers)
         for name, shape in g.inputs:
@@ -148,6 +148,14 @@ class OnnxImporter:
         for out in g.outputs:
             self.var(out)             # materialize if static
         self.sd.outputs = list(g.outputs)
+        # post-import GraphOptimizer pipeline (autodiff.passes):
+        # canonicalize the exporter's cast/mask/LayerNorm/GELU
+        # arithmetic and fuse attention. Default on; kill with
+        # DL4J_TPU_GRAPHOPT=0 or optimize=False.
+        from deeplearning4j_tpu.autodiff.passes import graphopt_enabled
+        if optimize if optimize is not None else graphopt_enabled():
+            self.graphopt_counts = self.sd.optimize()
+            self.sd.graphopt_counts = self.graphopt_counts
         return self.sd
 
     def _import_nodes(self, nodes):
@@ -240,10 +248,12 @@ class _SubImporter(OnnxImporter):
         return sh
 
 
-def import_onnx(model, input_shapes: Optional[dict] = None) \
-        -> "OnnxImporter":
+def import_onnx(model, input_shapes: Optional[dict] = None,
+                optimize: Optional[bool] = None) -> "OnnxImporter":
     """Parse + map an ONNX model; returns the importer (``.sd`` is
-    the SameDiff graph, ``.output`` runs it)."""
+    the SameDiff graph, ``.output`` runs it). ``optimize`` controls
+    the post-import GraphOptimizer pipeline (None = the
+    DL4J_TPU_GRAPHOPT env default, on)."""
     imp = OnnxImporter(model, input_shapes)
-    imp.run()
+    imp.run(optimize=optimize)
     return imp

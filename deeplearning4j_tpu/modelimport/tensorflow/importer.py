@@ -324,7 +324,7 @@ class GraphDefImporter:
                 stack.extend(fd.nodes)
         return out
 
-    def run(self) -> SameDiff:
+    def run(self, optimize: Optional[bool] = None) -> SameDiff:
         if any(n.op in v1_control_flow.V1_CONTROL_FLOW_OPS
                for n in self.nodes):
             # legacy v1 frames (frozen tf.while_loop/tf.cond) →
@@ -357,6 +357,14 @@ class GraphDefImporter:
             self.outputs = list(self.requested_outputs)
         else:
             self.outputs = _terminal_names(order, self.var_map)
+        # post-import GraphOptimizer pipeline: canonicalize the
+        # exporter's baked cast/mask/LayerNorm/GELU arithmetic and
+        # fuse attention (autodiff.passes). Default on; kill with
+        # DL4J_TPU_GRAPHOPT=0 or optimize=False.
+        from deeplearning4j_tpu.autodiff.passes import graphopt_enabled
+        if optimize if optimize is not None else graphopt_enabled():
+            self.graphopt_counts = self.sd.optimize()
+            self.sd.graphopt_counts = self.graphopt_counts
         return self.sd
 
     def _import_node_list(self, order, ctx):
@@ -763,10 +771,11 @@ class TensorflowFrameworkImporter:
     @staticmethod
     def run_import(graph_def, input_shapes: Optional[dict] = None,
                    while_max_iterations=None,
-                   outputs: Optional[List[str]] = None) -> SameDiff:
+                   outputs: Optional[List[str]] = None,
+                   optimize: Optional[bool] = None) -> SameDiff:
         return GraphDefImporter(graph_def, input_shapes,
                                 while_max_iterations,
-                                outputs=outputs).run()
+                                outputs=outputs).run(optimize=optimize)
 
     runImport = run_import
 
@@ -777,9 +786,10 @@ class TFGraphMapper:
     @staticmethod
     def import_graph(graph_def, input_shapes: Optional[dict] = None,
                      while_max_iterations=None,
-                     outputs: Optional[List[str]] = None) -> SameDiff:
+                     outputs: Optional[List[str]] = None,
+                     optimize: Optional[bool] = None) -> SameDiff:
         return GraphDefImporter(graph_def, input_shapes,
                                 while_max_iterations,
-                                outputs=outputs).run()
+                                outputs=outputs).run(optimize=optimize)
 
     importGraph = import_graph

@@ -11,6 +11,13 @@ maps onto the MXU; no per-head loop, heads are a tensor dimension.
 Activations are [batch, time, features]. Masks are [batch, time] key
 masks: masked timesteps neither attend nor get attended to (scores set
 to -inf before softmax), matching the reference's masked attention.
+
+These layers route through ``ops.attention.dot_product_attention``,
+which on TPU auto-selects the Pallas flash-attention backend
+(``ops.attention_pallas``) at long sequence lengths or when the dense
+[batch, heads, t_q, t_k] scores tensor would not fit comfortably in
+free HBM; ``DL4J_TPU_FLASH_ATTENTION=1/0`` forces/kills it. Bias'd
+projections keep the dense path (flash takes no additive bias).
 """
 from __future__ import annotations
 

@@ -26,7 +26,20 @@ def dot_product_attention(q, k, v, mask=None, scale=None,
     ``dropout_rng``/``dropout_rate``: attention-probability dropout
     (applied to the post-softmax weights, TF/HF BERT style).
     ``bias``: additive pre-softmax score bias (the exporter-style
-    (1-mask)*-1e4 convention the fused imported path carries)."""
+    (1-mask)*-1e4 convention the fused imported path carries).
+
+    Backend dispatch: bias-free, dropout-free sites (every nn
+    attention layer and the fused key-mask imported path) route
+    through the Pallas flash kernel when the sequence-length/
+    HBM-headroom heuristic or DL4J_TPU_FLASH_ATTENTION selects it —
+    see ops/attention_pallas.py; everything else runs the einsum
+    chain below."""
+    if bias is None and (dropout_rng is None or dropout_rate == 0.0):
+        from deeplearning4j_tpu.ops.attention_pallas import \
+            maybe_flash_sdpa
+        out = maybe_flash_sdpa(q, k, v, scale, mask=mask)
+        if out is not None:
+            return out
     if scale is None:
         scale = 1.0 / (q.shape[-1] ** 0.5)
     scores = jnp.einsum("...qd,...kd->...qk", q, k) * scale

@@ -1,0 +1,195 @@
+"""Pallas flash-attention backend for the ``sdpa_core`` op.
+
+The dense einsum attention (ops/attention.py) materializes the
+``[b, h, t_q, t_k]`` scores tensor in HBM — at long sequence lengths
+those bytes dominate the memory floor and the step time (BENCH_r05:
+bytes, not FLOPs, are the lever). This backend routes ``sdpa_core``
+sites onto the blocked online-softmax Pallas kernel
+(parallel/sequence.py — forward + LSE-recomputing backward, measured
+1.55-1.6x faster than XLA dense attention at seq 8k-16k on v5e and
+able to run 32k where dense cannot allocate the score matrix at all),
+which keeps only O(block_q x block_k) scores in VMEM and never writes
+them to HBM.
+
+Adaptation to the ``sdpa_core`` contract:
+
+  * arbitrary ``scale``: the kernel hardcodes the 1/sqrt(d) scaling of
+    natively-authored attention, so q is pre-multiplied by
+    ``scale * sqrt(d)`` (a single elementwise op; exact for the
+    default scale, where the factor is 1.0 and the multiply is
+    skipped);
+  * key masks: ``mask_mode="key"`` sites (the GraphOptimizer's
+    strength-reduced exporter masks) stream a ``[b, t_k]`` key mask
+    through the kernel — dense ADDITIVE biases are not streamable and
+    fall back to the einsum path;
+  * rank: [b, h, t, d] natively, [b, t, d] via a unit heads axis.
+
+Backend selection (``select_attention_backend``): the
+``DL4J_TPU_FLASH_ATTENTION`` env var forces the kernel on (``1``) or
+off (``0``); unset, the kernel auto-engages on TPU when t_k reaches
+``FLASH_MIN_SEQ`` (below ~4k the XLA dense lowering wins outright —
+BENCH_notes_r03) OR when the would-be scores tensor alone would eat
+more than ``HBM_HEADROOM_FRACTION`` of the device's free HBM.
+Off-TPU the kernel runs in Pallas interpret mode (the bn_pallas.py
+pattern), so CPU tests exercise the SAME code path the chip runs.
+"""
+from __future__ import annotations
+
+import math
+import os
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+#: below this key length the XLA dense lowering beats the kernel
+#: outright on TPU (BENCH_notes_r03); auto-selection starts here
+FLASH_MIN_SEQ = 4096
+#: auto-select flash below FLASH_MIN_SEQ once the dense scores tensor
+#: alone would consume this fraction of the device's free HBM
+HBM_HEADROOM_FRACTION = 0.25
+
+
+def flash_attention_override() -> Optional[bool]:
+    """Tri-state DL4J_TPU_FLASH_ATTENTION gate: True (force on) /
+    False (kill switch) / None (auto heuristic). Environment
+    ``extra["flash_attention"]`` overrides the env var."""
+    from deeplearning4j_tpu.common.environment import Environment
+    flag = Environment.get().extra.get("flash_attention")
+    if flag is None:
+        flag = os.environ.get("DL4J_TPU_FLASH_ATTENTION")
+    if flag is None or str(flag) == "":
+        return None
+    return str(flag) in ("1", "true", "True", "yes")
+
+
+def _free_hbm_bytes() -> Optional[int]:
+    try:
+        st = jax.local_devices()[0].memory_stats()
+        return int(st["bytes_limit"]) - int(st["bytes_in_use"])
+    except Exception:           # CPU backend has no memory_stats
+        return None
+
+
+def as_key_mask(mask, batch: int, t_k: int, rank: int):
+    """Reduce a mask broadcastable against [b, (h,) t_q, t_k] scores
+    to the [b, t_k] key-mask form the kernel streams, or None when
+    the mask varies per query/head (right-aligned numpy broadcasting
+    — exactly the dense path's semantics)."""
+    if mask.ndim == 0 or mask.ndim > rank:
+        return None
+    ms = tuple(mask.shape)
+    if ms[-1] != t_k:
+        return None
+    if mask.ndim >= 2 and ms[-2] != 1:
+        return None             # per-query mask: not streamable
+    lead = 1
+    for i, dim in enumerate(ms[:-2] if mask.ndim >= 2 else ()):
+        axis_from_right = mask.ndim - i
+        if axis_from_right == rank:          # the batch axis
+            if dim not in (1, batch):
+                return None
+            lead = dim
+        elif dim != 1:                       # a head/query axis
+            return None
+    flat = jnp.reshape(mask, (lead, t_k))
+    return jnp.broadcast_to(flat, (batch, t_k))
+
+
+def select_attention_backend(q_shape: Tuple[int, ...],
+                             k_shape: Tuple[int, ...], *,
+                             mask_ok: bool = True,
+                             has_bias: bool = False,
+                             platform: Optional[str] = None,
+                             free_hbm: Optional[int] = None,
+                             override=None,
+                             use_env_override: bool = True):
+    """Pick ("flash" | "dense", reason) for an sdpa_core site.
+
+    Structural requirements dominate everything (a dense additive
+    bias or per-query mask cannot stream through the kernel); then
+    the DL4J_TPU_FLASH_ATTENTION override; then the auto heuristic
+    (TPU + long sequence, or scores tensor vs free-HBM headroom).
+    ``platform``/``free_hbm``/``override`` exist for tests — they
+    default to the live device."""
+    if has_bias:
+        return "dense", "additive bias is not streamable"
+    if len(q_shape) not in (3, 4) or len(k_shape) != len(q_shape):
+        return "dense", f"rank {len(q_shape)} not supported"
+    if q_shape[-1] != k_shape[-1]:
+        return "dense", "q/k head-dim mismatch"
+    if not mask_ok:
+        return "dense", "mask is not a key mask"
+    if override is None and use_env_override:
+        override = flash_attention_override()
+    if override is False:
+        return "dense", "DL4J_TPU_FLASH_ATTENTION=0 kill switch"
+    if override is True:
+        return "flash", "DL4J_TPU_FLASH_ATTENTION=1 forced"
+    if platform is None:
+        platform = jax.devices()[0].platform
+    if platform != "tpu":
+        return "dense", f"auto: platform '{platform}' is not tpu"
+    t_k = k_shape[-2]
+    if t_k >= FLASH_MIN_SEQ:
+        return "flash", f"auto: t_k={t_k} >= {FLASH_MIN_SEQ}"
+    scores_bytes = 4            # f32 scores
+    for d in q_shape[:-1]:
+        scores_bytes *= int(d)
+    scores_bytes *= int(t_k)
+    if free_hbm is None:
+        free_hbm = _free_hbm_bytes()
+    if free_hbm is not None and free_hbm > 0 \
+            and scores_bytes > HBM_HEADROOM_FRACTION * free_hbm:
+        return "flash", (f"auto: scores tensor {scores_bytes >> 20} MB"
+                         f" > {HBM_HEADROOM_FRACTION:.0%} of free HBM"
+                         f" ({free_hbm >> 20} MB)")
+    return "dense", f"auto: t_k={t_k} fits the dense lowering"
+
+
+def flash_sdpa(q, k, v, scale: Optional[float] = None, key_mask=None,
+               block_q: int = 1024, block_k: int = 1024,
+               interpret: Optional[bool] = None):
+    """Run sdpa_core semantics on the Pallas kernel:
+    softmax(q k^T * scale, masked) v. q/k/v [b, h, t, d] or
+    [b, t, d]; key_mask [b, t_k] (0 = masked) or None. Differentiable
+    (the kernel carries its own custom VJP; the scale pre-multiply
+    composes). ``interpret=None`` resolves to interpret mode off-TPU,
+    so gradient checks exercise the chip's code path."""
+    from deeplearning4j_tpu.parallel.sequence import flash_attention
+    squeeze_heads = q.ndim == 3
+    if squeeze_heads:
+        q, k, v = q[:, None], k[:, None], v[:, None]
+    d = q.shape[-1]
+    if scale is None:
+        scale = 1.0 / math.sqrt(d)
+    factor = float(scale) * math.sqrt(d)
+    if abs(factor - 1.0) > 1e-9:
+        # the kernel scales scores by 1/sqrt(d); fold the requested
+        # scale into q so q'k^T/sqrt(d) == q k^T * scale
+        q = q * jnp.asarray(factor, q.dtype)
+    if key_mask is not None and key_mask.dtype == jnp.bool_:
+        key_mask = key_mask.astype(jnp.float32)
+    out = flash_attention(q, k, v, False, block_q, block_k, interpret,
+                          key_mask)
+    return out[:, 0] if squeeze_heads else out
+
+
+def maybe_flash_sdpa(q, k, v, scale: Optional[float] = None,
+                     mask=None, bias=None, block_q: int = 1024,
+                     block_k: int = 1024):
+    """Backend dispatch for an sdpa_core site: the flash result when
+    the selection heuristic (or override) takes it, else None — the
+    caller falls back to the dense einsum path."""
+    km, mask_ok = None, True
+    if mask is not None:
+        km = as_key_mask(mask, int(q.shape[0]), int(k.shape[-2]),
+                         q.ndim)
+        mask_ok = km is not None
+    backend, _reason = select_attention_backend(
+        tuple(q.shape), tuple(k.shape), mask_ok=mask_ok,
+        has_bias=bias is not None)
+    if backend != "flash":
+        return None
+    return flash_sdpa(q, k, v, scale, key_mask=km, block_q=block_q,
+                      block_k=block_k)

@@ -35,15 +35,22 @@ from jax.experimental import pallas as pl
 
 
 def fused_bn_bwd_enabled() -> bool:
+    """Default ON on TPU (the kernel is gradient-checked and the
+    ~21 ms HBM re-read saving — BENCH_notes_r02 — is otherwise dead);
+    off elsewhere, where the dense XLA lowering wins and interpret
+    mode would crawl. DL4J_TPU_FUSED_BN_BWD=0 is the kill switch,
+    =1 forces it on anywhere (Environment ``extra["fused_bn_bwd"]``
+    overrides the env var)."""
     import os
 
     from deeplearning4j_tpu.common.environment import Environment
     env = Environment.get()
     flag = env.extra.get("fused_bn_bwd")
     if flag is None:
-        flag = os.environ.get("DL4J_TPU_FUSED_BN_BWD", "0") in (
-            "1", "true", "True", "yes")
-    return bool(flag)
+        flag = os.environ.get("DL4J_TPU_FUSED_BN_BWD")
+    if flag is None or str(flag) == "":
+        return jax.devices()[0].platform == "tpu"
+    return str(flag) in ("1", "true", "True", "yes")
 
 
 def _interpret() -> bool:

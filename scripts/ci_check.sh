@@ -1,0 +1,45 @@
+#!/usr/bin/env bash
+# Repository CI gate — the fast, accelerator-free checks that keep the
+# docs and the perf claims honest:
+#
+#   1. telemetry catalog sync: every registered dl4j_* metric is in
+#      the README Observability catalog with the right type, and the
+#      catalog documents nothing the code no longer registers
+#      (scripts/check_telemetry_catalog.py);
+#   2. bench regression gate: when at least two BENCH_r*.json rounds
+#      are checked in, the newest must not regress any
+#      known-polarity metric of the previous round by more than the
+#      threshold (scripts/check_bench_regression.py).
+#
+# Usage: scripts/ci_check.sh [--threshold PCT]     (default 10)
+# Exit 0 = all gates clean, 1 = a gate failed, 2 = bad usage.
+set -u
+cd "$(dirname "$0")/.."
+
+THRESHOLD=10
+while [ $# -gt 0 ]; do
+  case "$1" in
+    --threshold) THRESHOLD="$2"; shift 2 ;;
+    *) echo "usage: $0 [--threshold PCT]" >&2; exit 2 ;;
+  esac
+done
+
+fail=0
+
+echo "== telemetry catalog sync =="
+python scripts/check_telemetry_catalog.py || fail=1
+
+echo "== bench regression gate =="
+rounds=$(ls BENCH_r*.json 2>/dev/null | sort | tail -n 2)
+n=$(printf '%s\n' "$rounds" | grep -c '[^[:space:]]')
+if [ "$n" -lt 2 ]; then
+  echo "fewer than two BENCH_r*.json rounds checked in; skipping"
+else
+  baseline=$(printf '%s\n' "$rounds" | head -n 1)
+  fresh=$(printf '%s\n' "$rounds" | tail -n 1)
+  echo "comparing $baseline -> $fresh (threshold ${THRESHOLD}%)"
+  python scripts/check_bench_regression.py \
+      --threshold "$THRESHOLD" "$baseline" "$fresh" || fail=1
+fi
+
+exit $fail

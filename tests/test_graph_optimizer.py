@@ -1,0 +1,585 @@
+"""GraphOptimizer pass suite (autodiff/passes.py): per-pass rewrite
+unit tests on hand-built graphs, the full-pipeline fixpoint, and the
+end-to-end exactness proofs on a real imported TF BERT and a
+hand-encoded ONNX transformer (r5 methodology: identical loss and
+identical 4-step training trajectory, optimize-on vs optimize-off)."""
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.autodiff.passes import (
+    _REWRITES, GraphOptimizer, attention_fuse, cast_fold, gelu_refuse,
+    graphopt_enabled, layernorm_refuse, mask_strength_reduce, optimize)
+from deeplearning4j_tpu.autodiff.samediff import SameDiff
+
+R = np.random.RandomState(0)
+
+
+def _ops(sd, name):
+    return [o for o in sd.ops if o.op_name == name]
+
+
+# ---------------------------------------------------------------- cast_fold
+class TestCastFold:
+    def test_identity_cast_repoints_consumers(self):
+        sd = SameDiff.create()
+        x = sd.placeholder("x", shape=(3, 4))
+        c = sd._op("cast", [x], {"dtype": "float32"})
+        sd._op("mul", [c, c]).rename("y")
+        feeds = {"x": R.randn(3, 4).astype(np.float32)}
+        want = sd.output(feeds, ["y"])["y"]
+        assert cast_fold(sd) == 1
+        mul = _ops(sd, "mul")[0]
+        assert mul.inputs == ["x", "x"]
+        np.testing.assert_array_equal(
+            np.asarray(sd.output(feeds, ["y"])["y"]), np.asarray(want))
+
+    def test_roundtrip_collapses_to_direct_read(self):
+        sd = SameDiff.create()
+        x = sd.placeholder("x", shape=(4,))
+        up = sd._op("cast", [x], {"dtype": "float64"})
+        dn = sd._op("cast", [up], {"dtype": "float32"})
+        sd._op("add", [dn, dn]).rename("y")
+        feeds = {"x": R.randn(4).astype(np.float32)}
+        want = sd.output(feeds, ["y"])["y"]
+        counts = optimize(sd, passes=[("cast_fold", cast_fold)])
+        # hop 1: outer cast reads x directly; hop 2: it becomes an
+        # identity cast and the add reads x — two rewrites at fixpoint
+        assert counts["cast_fold"] == 2
+        assert _ops(sd, "add")[0].inputs == ["x", "x"]
+        np.testing.assert_array_equal(
+            np.asarray(sd.output(feeds, ["y"])["y"]), np.asarray(want))
+
+    def test_constant_cast_folds_at_import_time(self):
+        sd = SameDiff.create()
+        x = sd.placeholder("x", shape=(4,))
+        tbl = sd.constant("tbl", np.arange(4, dtype=np.int32))
+        c = sd._op("cast", [tbl], {"dtype": "float32"})
+        sd._op("mul", [x, c]).rename("y")
+        assert cast_fold(sd) == 1
+        new = _ops(sd, "mul")[0].inputs[1]
+        assert new != c.name and "tbl__as_float32" in new
+        assert sd._arrays[new].dtype == np.float32
+        feeds = {"x": R.randn(4).astype(np.float32)}
+        np.testing.assert_array_equal(
+            np.asarray(sd.output(feeds, ["y"])["y"]),
+            feeds["x"] * np.arange(4, dtype=np.float32))
+
+    def test_idempotent(self):
+        sd = SameDiff.create()
+        x = sd.placeholder("x", shape=(4,))
+        c = sd._op("cast", [x], {"dtype": "float32"})
+        sd._op("mul", [c, c]).rename("y")
+        assert cast_fold(sd) == 1
+        assert cast_fold(sd) == 0
+
+
+# ------------------------------------------------- mask_strength_reduce
+def _mask_graph(neg=-1e9, mask_dtype=None, extra_add_consumer=False):
+    import jax.numpy as jnp
+    sd = SameDiff.create()
+    s = sd.placeholder("s", shape=(2, 2, 4, 6))
+    m = sd.placeholder("m", shape=(2, 6),
+                       dtype=mask_dtype or jnp.int32)
+    mf = sd._op("cast", [m], {"dtype": "float32"})
+    sub = sd._op("sub", [sd.constant("one", np.float32(1.0)), mf])
+    mul = sd._op("mul", [sub, sd.constant("neg", np.float32(neg))])
+    b = sd._op("expand_dims", [mul], {"axis": 1})
+    b = sd._op("expand_dims", [b], {"axis": 2})
+    a = sd._op("add", [s, b])
+    if extra_add_consumer:
+        sd._op("reduce_sum", [a], {"axis": None}).rename("side")
+    sd.nn.softmax(a).rename("p")
+    return sd
+
+
+_MASK_FEEDS = {
+    "s": R.randn(2, 2, 4, 6).astype(np.float32),
+    "m": np.asarray([[1, 1, 1, 1, 0, 0], [1, 1, 1, 1, 1, 1]],
+                    np.int32)}
+
+
+class TestMaskStrengthReduce:
+    def test_rewrites_to_key_mask_bitwise_exact(self):
+        sd = _mask_graph()
+        want = sd.output(_MASK_FEEDS, ["p"])["p"]
+        assert mask_strength_reduce(sd) == 1
+        akm = _ops(sd, "apply_key_mask")
+        assert len(akm) == 1 and akm[0].attrs["neg"] == -1e9
+        got = sd.output(_MASK_FEEDS, ["p"])["p"]
+        # post-softmax the select form is BITWISE identical: unmasked
+        # scores pass through untouched, masked ones underflow to 0.0
+        np.testing.assert_array_equal(np.asarray(got),
+                                      np.asarray(want))
+        assert mask_strength_reduce(sd) == 0     # idempotent
+
+    def test_shared_mask_broadcast_is_memoized(self):
+        """N attention layers share one (1-m)*neg chain — the cloned
+        mask broadcast must be emitted once, not once per layer."""
+        import jax.numpy as jnp
+        sd = SameDiff.create()
+        m = sd.placeholder("m", shape=(2, 6), dtype=jnp.int32)
+        mf = sd._op("cast", [m], {"dtype": "float32"})
+        sub = sd._op("sub", [sd.constant("one", np.float32(1.0)), mf])
+        mul = sd._op("mul", [sub, sd.constant("neg",
+                                              np.float32(-1e9))])
+        b = sd._op("expand_dims", [mul], {"axis": 1})
+        b = sd._op("expand_dims", [b], {"axis": 2})
+        for i in range(3):
+            s = sd.placeholder(f"s{i}", shape=(2, 2, 4, 6))
+            sd.nn.softmax(sd._op("add", [s, b])).rename(f"p{i}")
+        assert mask_strength_reduce(sd) == 3
+        masks = {o.inputs[1] for o in _ops(sd, "apply_key_mask")}
+        assert len(masks) == 1
+        clones = [o for o in sd.ops
+                  if o.outputs[0].startswith("graphopt_mask")]
+        assert len(clones) == 2                  # one chain, 2 hops
+
+    def test_skips_non_binary_mask(self):
+        import jax.numpy as jnp
+        sd = _mask_graph(mask_dtype=jnp.float32)  # float provenance
+        assert mask_strength_reduce(sd) == 0
+
+    def test_skips_small_negative_constant(self):
+        sd = _mask_graph(neg=-100.0)   # not provably underflowing
+        assert mask_strength_reduce(sd) == 0
+
+    def test_skips_multi_consumer_add(self):
+        sd = _mask_graph(extra_add_consumer=True)
+        assert mask_strength_reduce(sd) == 0
+
+
+# ----------------------------------------------------- layernorm_refuse
+def _ln_graph(form="tf", extra_mu_consumer=False):
+    sd = SameDiff.create()
+    x = sd.placeholder("x", shape=(2, 6, 8))
+    g = sd.constant("g", R.rand(8).astype(np.float32) + 0.5)
+    b = sd.constant("b", R.randn(8).astype(np.float32))
+    mu = sd._op("reduce_mean", [x], {"axis": -1, "keep_dims": True})
+    d = sd._op("sub", [x, mu])
+    if form == "tf":
+        sq = sd._op("squared_difference", [x, mu])
+    else:
+        sq = sd._op("pow", [d, sd.constant("two", np.float32(2.0))])
+    var = sd._op("reduce_mean", [sq], {"axis": -1, "keep_dims": True})
+    ve = sd._op("add", [var, sd.constant("eps", np.float32(1e-5))])
+    if form == "tf":
+        core = sd._op("mul", [d, sd._op("rsqrt", [ve])])
+    else:
+        core = sd._op("div", [d, sd._op("sqrt", [ve])])
+    y = sd._op("add", [sd._op("mul", [core, g]), b]).rename("y")
+    if extra_mu_consumer:
+        sd._op("reduce_sum", [mu], {"axis": None}).rename("side")
+    return sd
+
+
+class TestLayerNormRefuse:
+    @pytest.mark.parametrize("form", ["tf", "onnx"])
+    def test_refuses_to_native_layer_norm(self, form):
+        sd = _ln_graph(form)
+        feeds = {"x": R.randn(2, 6, 8).astype(np.float32)}
+        want = sd.output(feeds, ["y"])["y"]
+        assert layernorm_refuse(sd) == 1
+        ln = _ops(sd, "layer_norm")
+        assert len(ln) == 1
+        assert ln[0].inputs == ["x", "g", "b"]
+        assert ln[0].attrs["epsilon"] == pytest.approx(1e-5)
+        got = sd.output(feeds, ["y"])["y"]
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   rtol=1e-5, atol=1e-6)
+        assert layernorm_refuse(sd) == 0         # idempotent
+
+    def test_skips_shared_interior(self):
+        sd = _ln_graph("tf", extra_mu_consumer=True)
+        assert layernorm_refuse(sd) == 0
+
+
+# --------------------------------------------------------- gelu_refuse
+def _gelu_graph(form="erf"):
+    sd = SameDiff.create()
+    x = sd.placeholder("x", shape=(2, 8))
+    half = sd.constant("half", np.float32(0.5))
+    one = sd.constant("one", np.float32(1.0))
+    if form == "erf":
+        u = sd._op("div", [x, sd.constant(
+            "sqrt2", np.float32(np.sqrt(2.0)))])
+        inner = sd._op("erf", [u])
+    else:
+        c0 = sd.constant("c0", np.float32(0.7978845608028654))
+        c1 = sd.constant("c1", np.float32(0.044715))
+        x3 = sd._op("pow", [x, sd.constant("three", np.float32(3.0))])
+        inner = sd._op("tanh", [sd._op("mul", [
+            c0, sd._op("add", [x, sd._op("mul", [c1, x3])])])])
+    sd._op("mul", [sd._op("mul", [x, half]),
+                   sd._op("add", [one, inner])]).rename("y")
+    return sd
+
+
+class TestGeluRefuse:
+    @pytest.mark.parametrize("form,opname", [("erf", "gelu"),
+                                             ("tanh", "gelu_tanh")])
+    def test_refuses_decomposed_gelu(self, form, opname):
+        sd = _gelu_graph(form)
+        feeds = {"x": R.randn(2, 8).astype(np.float32)}
+        want = sd.output(feeds, ["y"])["y"]
+        assert gelu_refuse(sd) == 1
+        fused = _ops(sd, opname)
+        assert len(fused) == 1 and fused[0].inputs == ["x"]
+        got = sd.output(feeds, ["y"])["y"]
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   rtol=1e-5, atol=1e-6)
+        assert gelu_refuse(sd) == 0              # idempotent
+
+
+# ---------------------------------------------- attention_fuse (extended)
+class TestAttentionFuseExtensions:
+    def test_fuses_key_mask_form_to_sdpa_core(self):
+        """mask_strength_reduce output feeds the fusion: the combined
+        result is ONE sdpa_core in native key-mask mode."""
+        import jax.numpy as jnp
+        sd = SameDiff.create()
+        q = sd.placeholder("q", shape=(2, 2, 6, 4))
+        k = sd.placeholder("k", shape=(2, 2, 6, 4))
+        v = sd.placeholder("v", shape=(2, 2, 6, 4))
+        m = sd.placeholder("m", shape=(2, 6), dtype=jnp.int32)
+        mf = sd._op("cast", [m], {"dtype": "float32"})
+        sub = sd._op("sub", [sd.constant("one", np.float32(1.0)), mf])
+        mul = sd._op("mul", [sub, sd.constant("neg",
+                                              np.float32(-1e9))])
+        b = sd._op("expand_dims", [mul], {"axis": 1})
+        b = sd._op("expand_dims", [b], {"axis": 2})
+        scores = sd._op("matmul", [q, k],
+                        {"transpose_a": False, "transpose_b": True})
+        scaled = sd._op("div", [scores, sd.constant(
+            "c", np.float32(2.0))])
+        probs = sd.nn.softmax(sd._op("add", [scaled, b]))
+        sd._op("matmul", [probs, v]).rename("ctx")
+        feeds = {"q": R.randn(2, 2, 6, 4).astype(np.float32),
+                 "k": R.randn(2, 2, 6, 4).astype(np.float32),
+                 "v": R.randn(2, 2, 6, 4).astype(np.float32),
+                 "m": np.asarray([[1, 1, 1, 0, 0, 0],
+                                  [1, 1, 1, 1, 1, 1]], np.int32)}
+        want = sd.output(feeds, ["ctx"])["ctx"]
+        counts = optimize(sd)
+        assert counts["mask_strength_reduce"] == 1
+        assert counts["attention_fuse"] == 1
+        core = _ops(sd, "sdpa_core")[0]
+        assert core.attrs == {"scale": 0.5, "mask_mode": "key"}
+        assert len(core.inputs) == 4
+        got = sd.output(feeds, ["ctx"])["ctx"]
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   rtol=1e-5, atol=1e-6)
+
+    def test_fuses_explicit_transpose_k_form(self):
+        """The ONNX export spells k^T as Transpose(k, [..., -1, -2])
+        before the MatMul — that form must fuse too."""
+        sd = SameDiff.create()
+        q = sd.placeholder("q", shape=(2, 2, 6, 4))
+        k = sd.placeholder("k", shape=(2, 2, 6, 4))
+        v = sd.placeholder("v", shape=(2, 2, 6, 4))
+        kt = sd._op("transpose", [k], {"axes": [0, 1, 3, 2]})
+        scores = sd._op("matmul", [q, kt])
+        scaled = sd._op("mul", [scores, sd.constant(
+            "c", np.float32(0.5))])
+        probs = sd.nn.softmax(scaled)
+        sd._op("matmul", [probs, v]).rename("ctx")
+        feeds = {n: R.randn(2, 2, 6, 4).astype(np.float32)
+                 for n in ("q", "k", "v")}
+        want = sd.output(feeds, ["ctx"])["ctx"]
+        assert attention_fuse(sd) == 1
+        core = _ops(sd, "sdpa_core")[0]
+        assert core.inputs == ["q", "k", "v"]
+        got = sd.output(feeds, ["ctx"])["ctx"]
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   rtol=1e-5, atol=1e-6)
+
+
+# ------------------------------------------------------------- pipeline
+class TestPipeline:
+    def test_env_gate(self, monkeypatch):
+        monkeypatch.setenv("DL4J_TPU_GRAPHOPT", "0")
+        assert not graphopt_enabled()
+        monkeypatch.setenv("DL4J_TPU_GRAPHOPT", "1")
+        assert graphopt_enabled()
+        monkeypatch.delenv("DL4J_TPU_GRAPHOPT")
+        assert graphopt_enabled()                # default on
+
+    def test_telemetry_counter_and_dump(self, monkeypatch, capsys):
+        monkeypatch.setenv("DL4J_TPU_DUMP_GRAPHOPT", "1")
+        before = _REWRITES.value(**{"pass": "gelu_refuse"})
+        sd = _gelu_graph("erf")
+        counts = GraphOptimizer(sd).run()
+        assert counts["gelu_refuse"] == 1
+        after = _REWRITES.value(**{"pass": "gelu_refuse"})
+        assert after == before + 1
+        err = capsys.readouterr().err
+        assert "[graphopt] before" in err
+        assert "after gelu_refuse (+1)" in err
+
+    def test_fixpoint_composes_passes(self):
+        """cast folding must EXPOSE the mask chain: with the mask cast
+        hidden behind an f32->f64->f32 round-trip the mask pass only
+        fires after cast_fold unwinds it (same iteration, ordered
+        pipeline)."""
+        import jax.numpy as jnp
+        sd = SameDiff.create()
+        s = sd.placeholder("s", shape=(2, 2, 4, 6))
+        m = sd.placeholder("m", shape=(2, 6), dtype=jnp.int32)
+        mf = sd._op("cast", [m], {"dtype": "float32"})
+        up = sd._op("cast", [mf], {"dtype": "float64"})
+        dn = sd._op("cast", [up], {"dtype": "float32"})
+        sub = sd._op("sub", [sd.constant("one", np.float32(1.0)), dn])
+        mul = sd._op("mul", [sub, sd.constant("neg",
+                                              np.float32(-1e9))])
+        b = sd._op("expand_dims", [mul], {"axis": 1})
+        b = sd._op("expand_dims", [b], {"axis": 2})
+        sd.nn.softmax(sd._op("add", [s, b])).rename("p")
+        want = sd.output(_MASK_FEEDS, ["p"])["p"]
+        counts = optimize(sd)
+        assert counts["cast_fold"] >= 2
+        assert counts["mask_strength_reduce"] == 1
+        got = sd.output(_MASK_FEEDS, ["p"])["p"]
+        np.testing.assert_array_equal(np.asarray(got),
+                                      np.asarray(want))
+        # whole-pipeline idempotency
+        assert sum(optimize(sd).values()) == 0
+
+
+# --------------------------------------- exactness: real TF BERT import
+class TestImportedBertExactness:
+    def test_optimized_import_matches_plain_loss_and_trajectory(self):
+        pytest.importorskip("tensorflow")
+        from benchmarks.tf_bert_builder import (build_frozen_bert,
+                                                import_and_attach_mlm)
+        from deeplearning4j_tpu.learning import Adam
+        vocab, hidden, heads, layers, seq, batch = 50, 16, 2, 2, 16, 2
+        gd, _ = build_frozen_bert(seq, batch, vocab=vocab,
+                                  hidden=hidden, heads=heads,
+                                  layers=layers, intermediate=32)
+        rs = np.random.RandomState(1)
+        feeds = {
+            "ids": rs.randint(0, vocab, (batch, seq)).astype(np.int32),
+            "seg": np.zeros((batch, seq), np.int32),
+            "mask": np.concatenate(
+                [np.ones((batch, seq - 3), np.int32),
+                 np.zeros((batch, 3), np.int32)], axis=1),
+            "mlm_labels": np.where(rs.rand(batch, seq) < 0.3,
+                                   rs.randint(0, vocab, (batch, seq)),
+                                   -1).astype(np.int32)}
+
+        plain, loss = import_and_attach_mlm(
+            gd, batch, seq, vocab=vocab, hidden=hidden,
+            updater=Adam(1e-3), optimize=False)
+        opt, _ = import_and_attach_mlm(
+            gd, batch, seq, vocab=vocab, hidden=hidden,
+            updater=Adam(1e-3))
+
+        # every transformer pass fires on the real frozen graph
+        c = opt.graphopt_counts
+        assert c["mask_strength_reduce"] == layers
+        assert c["layernorm_refuse"] == 2 * layers
+        assert c["gelu_refuse"] == layers
+        assert c["attention_fuse"] == layers
+
+        want = plain.output(feeds, [loss])[loss]
+        got = opt.output(feeds, [loss])[loss]
+        np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6)
+        lp = plain.fit_steps(feeds, 4)
+        lo = opt.fit_steps(feeds, 4)
+        np.testing.assert_allclose(lo, lp, rtol=1e-4, atol=1e-5)
+
+
+# ------------------------------- exactness: hand-encoded ONNX transformer
+def _onnx_encoder(batch=2, seq=8, hidden=16, heads=2, layers=2,
+                  ffn=32, seed=0):
+    """A HF-style ONNX transformer encoder, hand-encoded with the
+    in-repo protobuf writer: explicit Transpose(k), Div-scaled scores,
+    Cast(int64 mask) -> Sub/Mul(-1e4)/Unsqueeze additive bias, the
+    Sub/Pow/Sqrt/Div LayerNorm decomposition, the Div/Erf GELU
+    spelling, plus a dead f32->f64->f32 round-trip on the input."""
+    from deeplearning4j_tpu.modelimport.onnx.protobuf import (
+        encode_model, encode_node, encode_value_info)
+    rs = np.random.RandomState(seed)
+    hd = hidden // heads
+    inits, nodes = {}, []
+
+    def W(name, *shape, scale=0.05):
+        inits[name] = (rs.randn(*shape) * scale).astype(np.float32)
+        return name
+
+    inits["mask_i64"] = np.asarray(
+        [[1] * seq, [1] * (seq - 3) + [0] * 3], np.int64)
+    for n, v in (("c_one", 1.0), ("c_half", 0.5), ("c_two", 2.0),
+                 ("c_eps", 1e-5), ("c_neg", -1e4),
+                 ("c_sqrt2", float(np.sqrt(2.0))),
+                 ("c_sqrt_hd", float(np.sqrt(hd)))):
+        inits[n] = np.float32(v)
+    inits["shape_split"] = np.asarray([batch, seq, heads, hd],
+                                      np.int64)
+    inits["shape_merge"] = np.asarray([batch, seq, hidden], np.int64)
+
+    # input round-trip (dead dtype arithmetic exporters bake in)
+    nodes += [encode_node("Cast", ["x"], ["x_up"], "cu", to=11),
+              encode_node("Cast", ["x_up"], ["h"], "cd", to=1)]
+    # shared additive attention-mask chain
+    nodes += [
+        encode_node("Cast", ["mask_i64"], ["m_f"], "mc", to=1),
+        encode_node("Sub", ["c_one", "m_f"], ["m_inv"], "ms"),
+        encode_node("Mul", ["m_inv", "c_neg"], ["m_neg"], "mm"),
+        encode_node("Unsqueeze", ["m_neg"], ["m_bias"], "mu",
+                    axes=[1, 2]),
+    ]
+
+    cur = "h"
+    for i in range(layers):
+        p = f"l{i}_"
+
+        def proj(nm):
+            W(f"{p}W{nm}", hidden, hidden)
+            W(f"{p}b{nm}", hidden, scale=0.0)
+            nodes.extend([
+                encode_node("MatMul", [cur, f"{p}W{nm}"],
+                            [f"{p}{nm}mm"], f"{p}{nm}0"),
+                encode_node("Add", [f"{p}{nm}mm", f"{p}b{nm}"],
+                            [f"{p}{nm}a"], f"{p}{nm}1"),
+                encode_node("Reshape", [f"{p}{nm}a", "shape_split"],
+                            [f"{p}{nm}r"], f"{p}{nm}2"),
+                encode_node("Transpose", [f"{p}{nm}r"],
+                            [f"{p}{nm}t"], f"{p}{nm}3",
+                            perm=[0, 2, 1, 3]),
+            ])
+            return f"{p}{nm}t"
+
+        q, k, v = proj("q"), proj("k"), proj("v")
+        W(f"{p}Wo", hidden, hidden)
+        W(f"{p}bo", hidden, scale=0.0)
+        nodes += [
+            encode_node("Transpose", [k], [f"{p}kT"], f"{p}a0",
+                        perm=[0, 1, 3, 2]),
+            encode_node("MatMul", [q, f"{p}kT"], [f"{p}sc"], f"{p}a1"),
+            encode_node("Div", [f"{p}sc", "c_sqrt_hd"], [f"{p}scd"],
+                        f"{p}a2"),
+            encode_node("Add", [f"{p}scd", "m_bias"], [f"{p}scm"],
+                        f"{p}a3"),
+            encode_node("Softmax", [f"{p}scm"], [f"{p}pr"], f"{p}a4",
+                        axis=-1),
+            encode_node("MatMul", [f"{p}pr", v], [f"{p}cx"], f"{p}a5"),
+            encode_node("Transpose", [f"{p}cx"], [f"{p}cxt"],
+                        f"{p}a6", perm=[0, 2, 1, 3]),
+            encode_node("Reshape", [f"{p}cxt", "shape_merge"],
+                        [f"{p}cxr"], f"{p}a7"),
+            encode_node("MatMul", [f"{p}cxr", f"{p}Wo"], [f"{p}om"],
+                        f"{p}a8"),
+            encode_node("Add", [f"{p}om", f"{p}bo"], [f"{p}oa"],
+                        f"{p}a9"),
+            encode_node("Add", [cur, f"{p}oa"], [f"{p}res1"],
+                        f"{p}a10"),
+        ]
+
+        def ln(tag, src, dst):
+            g = f"{p}g{tag}"
+            b = f"{p}be{tag}"
+            inits[g] = np.ones(hidden, np.float32)
+            inits[b] = np.zeros(hidden, np.float32)
+            t = f"{p}{tag}"
+            nodes.extend([
+                encode_node("ReduceMean", [src], [f"{t}mu"],
+                            f"{t}n0", axes=[-1], keepdims=1),
+                encode_node("Sub", [src, f"{t}mu"], [f"{t}d"],
+                            f"{t}n1"),
+                encode_node("Pow", [f"{t}d", "c_two"], [f"{t}dd"],
+                            f"{t}n2"),
+                encode_node("ReduceMean", [f"{t}dd"], [f"{t}var"],
+                            f"{t}n3", axes=[-1], keepdims=1),
+                encode_node("Add", [f"{t}var", "c_eps"], [f"{t}ve"],
+                            f"{t}n4"),
+                encode_node("Sqrt", [f"{t}ve"], [f"{t}sd"], f"{t}n5"),
+                encode_node("Div", [f"{t}d", f"{t}sd"], [f"{t}nr"],
+                            f"{t}n6"),
+                encode_node("Mul", [f"{t}nr", g], [f"{t}sg"],
+                            f"{t}n7"),
+                encode_node("Add", [f"{t}sg", b], [dst], f"{t}n8"),
+            ])
+
+        ln("ln1", f"{p}res1", f"{p}x1")
+        W(f"{p}W1", hidden, ffn)
+        W(f"{p}b1", ffn, scale=0.0)
+        W(f"{p}W2", ffn, hidden)
+        W(f"{p}b2", hidden, scale=0.0)
+        nodes += [
+            encode_node("MatMul", [f"{p}x1", f"{p}W1"], [f"{p}h1"],
+                        f"{p}f0"),
+            encode_node("Add", [f"{p}h1", f"{p}b1"], [f"{p}hb"],
+                        f"{p}f1"),
+            encode_node("Div", [f"{p}hb", "c_sqrt2"], [f"{p}gd"],
+                        f"{p}f2"),
+            encode_node("Erf", [f"{p}gd"], [f"{p}ge"], f"{p}f3"),
+            encode_node("Add", [f"{p}ge", "c_one"], [f"{p}g1"],
+                        f"{p}f4"),
+            encode_node("Mul", [f"{p}hb", "c_half"], [f"{p}gh"],
+                        f"{p}f5"),
+            encode_node("Mul", [f"{p}gh", f"{p}g1"], [f"{p}gel"],
+                        f"{p}f6"),
+            encode_node("MatMul", [f"{p}gel", f"{p}W2"], [f"{p}h2"],
+                        f"{p}f7"),
+            encode_node("Add", [f"{p}h2", f"{p}b2"], [f"{p}hb2"],
+                        f"{p}f8"),
+            encode_node("Add", [f"{p}x1", f"{p}hb2"], [f"{p}res2"],
+                        f"{p}f9"),
+        ]
+        ln("ln2", f"{p}res2", f"{p}out" if i < layers - 1 else "y")
+        cur = f"{p}out"
+
+    model = encode_model(
+        nodes, inits,
+        [encode_value_info("x", (batch, seq, hidden))],
+        [encode_value_info("y", (batch, seq, hidden))])
+    wnames = [n for n in inits
+              if n.startswith("l") and inits[n].ndim >= 1]
+    return model, wnames
+
+
+def _onnx_trainable(model, wnames, optimize_flag):
+    from deeplearning4j_tpu.autodiff.samediff import VariableType
+    from deeplearning4j_tpu.autodiff.training import TrainingConfig
+    from deeplearning4j_tpu.learning import Adam
+    from deeplearning4j_tpu.modelimport.onnx import import_onnx
+    imp = import_onnx(model, optimize=optimize_flag)
+    sd = imp.sd
+    wset = set(wnames)
+    promote = [n for n, v in sd.vars.items()
+               if v.var_type == VariableType.CONSTANT
+               and n.split("__")[0] in wset]
+    assert len(promote) == len(wset)
+    sd.convert_to_variables(promote)
+    yv = imp.var_map["y"]
+    sq = sd._op("mul", [yv, yv])
+    sd._op("reduce_sum", [sq], {"axis": None}).rename("loss")
+    sd.set_loss_variables(["loss"])
+    sd.set_training_config(
+        TrainingConfig.Builder().updater(Adam(1e-3)).build())
+    return imp, sd
+
+
+class TestImportedOnnxExactness:
+    def test_optimized_import_matches_plain_loss_and_trajectory(self):
+        layers = 2
+        model, wnames = _onnx_encoder(layers=layers)
+        feeds = {"x": np.random.RandomState(2)
+                 .randn(2, 8, 16).astype(np.float32)}
+
+        _, plain = _onnx_trainable(model, wnames, False)
+        impo, opt = _onnx_trainable(model, wnames, None)
+
+        c = impo.sd.graphopt_counts
+        assert c["cast_fold"] >= 2               # the x round-trip
+        assert c["mask_strength_reduce"] == layers
+        assert c["layernorm_refuse"] == 2 * layers
+        assert c["gelu_refuse"] == layers
+        assert c["attention_fuse"] == layers
+
+        want = plain.output(feeds, ["loss"])["loss"]
+        got = opt.output(feeds, ["loss"])["loss"]
+        np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6)
+        lp = plain.fit_steps(feeds, 4)
+        lo = opt.fit_steps(feeds, 4)
+        np.testing.assert_allclose(lo, lp, rtol=1e-4, atol=1e-5)

@@ -249,10 +249,14 @@ class TestAttentionFusion:
                                   hidden=hidden, heads=heads,
                                   layers=layers, intermediate=32)
 
+        # optimize=False: this test exercises the MANUAL fusion entry
+        # point on an untouched import (the default import now runs
+        # the full GraphOptimizer pipeline, which fuses attention
+        # itself — covered below and in test_graph_optimizer.py)
         def fresh():
             sd, loss = import_and_attach_mlm(
                 gd, batch, seq, vocab=vocab, hidden=hidden,
-                updater=Adam(1e-3))
+                updater=Adam(1e-3), optimize=False)
             return sd, loss
 
         rs = np.random.RandomState(0)
@@ -276,3 +280,14 @@ class TestAttentionFusion:
         lp = plain.fit_steps(feeds, 4)
         lf = fused.fit_steps(feeds, 4)
         np.testing.assert_allclose(lf, lp, rtol=1e-4, atol=1e-5)
+
+        # the DEFAULT import path runs the optimizer pipeline and
+        # fuses every layer on its own — re-fusing finds nothing
+        # (full-pipeline trajectory exactness: test_graph_optimizer.py)
+        auto, loss_a = import_and_attach_mlm(
+            gd, batch, seq, vocab=vocab, hidden=hidden,
+            updater=Adam(1e-3))
+        assert auto.graphopt_counts["attention_fuse"] == layers
+        assert auto.fuse_attention_patterns() == 0
+        got_a = auto.output(feeds, [loss_a])[loss_a]
+        np.testing.assert_allclose(got_a, want, rtol=1e-5, atol=1e-6)
