@@ -113,6 +113,17 @@ def main():
         line["memory"] = diagnostics.memory_report(net)
     except Exception as e:
         print(f"memory block failed: {e!r}", file=sys.stderr)
+    # Scaling-observatory breakdown: where the run's step time went
+    # (data_wait / compute / collective / updater / host_sync /
+    # checkpoint_stall) — phase means sum to ~the mean step time, so a
+    # future throughput regression comes pre-attributed to a phase.
+    try:
+        from deeplearning4j_tpu.common import stepstats
+        bd = stepstats.collector().summary()
+        if bd.get("steps"):
+            line["step_breakdown"] = bd
+    except Exception as e:
+        print(f"step-breakdown block failed: {e!r}", file=sys.stderr)
     # exercise the pod scaling harness's REAL clock path at n=1 (the
     # round-2 verdict asked that parallel/scaling.py time something
     # real before it is trusted on a pod); small shape — this checks
@@ -129,14 +140,27 @@ def main():
                       np.eye(10, dtype=np.float32)[
                           r.randint(0, 10, n)])
 
+        sizes = (1,) if not on_tpu else tuple(sorted(
+            {1, len(jax.devices())}))
         rep = measure_dp_scaling(
-            lambda: LeNet(num_classes=10).init(), _mk_batch, (1,),
+            lambda: LeNet(num_classes=10).init(), _mk_batch, sizes,
             per_chip_batch=64, steps=5, warmup=1)
         # clock-path CANARY, not a throughput: 5 LeNet steps through
         # the axon tunnel are dispatch-dominated (r3 verdict Weak #4
         # — the old name scaling_n1_ips invited misreading)
         line["scaling_harness_canary_ips"] = round(
             rep["throughput"][1], 1)
+        # the ROADMAP item-2 `scaling` block: per-chip throughput and
+        # efficiency at each mesh size vs the smallest-size baseline,
+        # with the cross-host observatory's skew report when a
+        # SharedTrainingMaster leader ran one (single host: zero skew)
+        from deeplearning4j_tpu.common import stepstats
+        line["scaling"] = stepstats.scaling_block(rep)
+        # wire-cost context for the efficiency curve: what one step's
+        # update exchange moves per replica at the largest mesh size
+        from deeplearning4j_tpu.parallel import zero
+        line["scaling"]["update_exchange"] = zero.exchange_report(
+            LeNet(num_classes=10).init().params, max(sizes))
     except Exception as e:
         print(f"scaling-harness leg failed: {e!r}", file=sys.stderr)
     # CPU-proxy pipeline overhead, every round (round-2 verdict Weak

@@ -145,6 +145,36 @@ def main():
         (d_on - d_off) / d_off * 100, 2)
     out["diag_overhead_pct_analytic"] = round(
         record_cost / (d_on / iters) * 100, 2)
+
+    # scaling-observatory leg (PR 9): the stepstats layer rides the
+    # same <1% budget. (a) per-op cost of one breakdown close (the
+    # only per-step observatory work on a clean run: accumulator swap,
+    # phase dict, ring append, one histogram observe); (b) e2e fit()
+    # with the collector on vs off; (c) the analytic ratio.
+    from deeplearning4j_tpu.common import stepstats
+    ss = stepstats.collector()
+    ss.set_enabled(True)
+    n = 20_000
+    t0 = time.perf_counter()
+    for i in range(n):
+        ss.close_step("bench", i, 0.001)
+    close_cost = (time.perf_counter() - t0) / n
+    out["stepstats_close_ns"] = round(close_cost * 1e9, 1)
+    ss_on, ss_off = [], []
+    for _ in range(6):
+        ss.set_enabled(True)
+        ss_on.append(_fit_seconds(net, ds, iters))
+        ss.set_enabled(False)
+        ss_off.append(_fit_seconds(net, ds, iters))
+    ss.set_enabled(True)
+    telemetry._trace_buffer.clear()
+    s_on, s_off = min(ss_on), min(ss_off)
+    out["stepstats_fit_step_us_on"] = round(s_on / iters * 1e6, 1)
+    out["stepstats_fit_step_us_off"] = round(s_off / iters * 1e6, 1)
+    out["stepstats_overhead_pct_measured"] = round(
+        (s_on - s_off) / s_off * 100, 2)
+    out["stepstats_overhead_pct_analytic"] = round(
+        close_cost / (s_on / iters) * 100, 2)
     print(json.dumps(out))
 
 

@@ -60,7 +60,7 @@ from typing import Dict, List, Optional
 
 import numpy as np
 
-from deeplearning4j_tpu.common import telemetry
+from deeplearning4j_tpu.common import stepstats, telemetry
 from deeplearning4j_tpu.common.environment import Environment
 
 log = logging.getLogger("deeplearning4j_tpu")
@@ -300,15 +300,17 @@ def collective_span(kind: str, axis: str, nbytes: int = 0, **attrs):
     with telemetry.span(f"collective.{kind}", axis=axis,
                         bytes=int(nbytes), **attrs):
         yield
+    dt = time.perf_counter() - t0
     telemetry.histogram(
         "dl4j_collective_seconds",
-        _COLLECTIVE_SECONDS_HELP).observe(
-            time.perf_counter() - t0, kind=kind, axis=axis)
+        _COLLECTIVE_SECONDS_HELP).observe(dt, kind=kind, axis=axis)
     if nbytes:
         telemetry.counter(
             "dl4j_collective_bytes_total",
             _COLLECTIVE_BYTES_HELP).inc(int(nbytes), kind=kind,
                                         axis=axis)
+    # fold into the scaling observatory's step breakdown
+    stepstats.note_collective(kind, dt)
 
 
 # ----------------------------------------------------------------------
@@ -441,7 +443,8 @@ class FlightRecorder:
         env = Environment.get()
         self.enabled = bool(env.flight_recorder)
         self.max_steps = max(int(env.flight_recorder_steps), 1)
-        self.dir = env.flight_recorder_dir or "."
+        self.dir = env.flight_recorder_dir or "flightrec"
+        self.keep = max(int(env.flight_recorder_keep), 1)
         self.hbm_sample = max(int(env.hbm_sample_steps), 1)
         self._ring: "deque[dict]" = deque()
         self._lock = threading.Lock()
@@ -630,16 +633,51 @@ class FlightRecorder:
                 reason=reason)
         log.warning("flight recorder: dumped %d step records to %s "
                     "(+ %s) reason=%s", len(ring), path, trace, reason)
+        self._prune()
         return path
+
+    def _prune(self) -> None:
+        """Bounded retention: keep the newest ``keep`` dump pairs in
+        the dump directory, delete older ones (a week of preemptions
+        must not fill the disk with black boxes)."""
+        try:
+            dumps = sorted(
+                (p for p in os.listdir(self.dir)
+                 if p.startswith("flightrec_")
+                 and p.endswith(".jsonl")),
+                key=lambda p: os.path.getmtime(
+                    os.path.join(self.dir, p)))
+        except OSError:
+            return
+        for p in dumps[:-self.keep]:
+            for victim in (p, p[:-len(".jsonl")] + ".trace.json"):
+                try:
+                    os.remove(os.path.join(self.dir, victim))
+                except OSError:
+                    pass
 
 
 # ----------------------------------------------------------------------
 # the calls the fit funnels make per step
+def _close_breakdown(model_name: str, step: int, span,
+                     extra: dict) -> None:
+    """Close the scaling-observatory breakdown for this step and embed
+    its phase decomposition into the flight-recorder record."""
+    try:
+        bd = stepstats.close_step(model_name, step, span)
+    except Exception as e:  # noqa: BLE001 — observability must never
+        log.warning("stepstats close failed: %r", e)
+        return
+    if bd is not None:
+        extra.setdefault("phases", bd["phases"])
+
+
 def record_step(model, model_name: str, step: int, loss, span=None,
                 grad_norm=None, **extra) -> None:
     """Flight-recorder append only — for funnels that already ran
     :func:`check_numerics` mid-step (the accumulation path must check
     grads BEFORE the apply step donates their buffers)."""
+    _close_breakdown(model_name, step, span, extra)
     rec = FlightRecorder.get()
     if rec.enabled:
         rec.record(model, model_name, step, loss, span,
@@ -652,6 +690,7 @@ def after_step(model, model_name: str, step: int, loss, span=None,
     """Record the step into the flight recorder, then run the numerics
     watchdog (which may raise :class:`NumericsEvent`).  Near-free when
     both gates are off: two attribute checks."""
+    _close_breakdown(model_name, step, span, extra)
     rec = FlightRecorder.get()
     if rec.enabled:
         rec.record(model, model_name, step, loss, span,

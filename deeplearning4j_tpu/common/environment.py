@@ -54,10 +54,16 @@ class _Env:
     # records, dumped to JSONL + chrome trace on crash/SIGTERM/watchdog
     flight_recorder: bool = True
     flight_recorder_steps: int = 256    # ring capacity (last N steps)
-    flight_recorder_dir: str = ""       # "" -> current directory
+    flight_recorder_dir: str = "flightrec"  # dump dir (created on dump)
+    flight_recorder_keep: int = 8       # newest K dumps retained
     # refresh HBM gauges from jax device memory stats every Nth
     # recorded step (the stats call is cheap but not free)
     hbm_sample_steps: int = 16
+    # scaling observatory (common.stepstats): per-step phase
+    # decomposition + cross-host straggler detection
+    stepstats: bool = True
+    straggler_factor: float = 2.0       # trip: worker > factor x mean
+    straggler_min_step: float = 1e-3    # no trips below this mean step
     extra: dict = field(default_factory=dict)
 
     def set_debug(self, v: bool):
@@ -82,7 +88,9 @@ class Environment:
       DL4J_TPU_SHARDED_UPDATE, DL4J_TPU_NUMERICS_WATCHDOG,
       DL4J_TPU_NUMERICS_SAMPLE, DL4J_TPU_FLIGHT_RECORDER,
       DL4J_TPU_FLIGHT_RECORDER_STEPS, DL4J_TPU_FLIGHT_RECORDER_DIR,
-      DL4J_TPU_HBM_SAMPLE_STEPS
+      DL4J_TPU_FLIGHT_RECORDER_KEEP, DL4J_TPU_HBM_SAMPLE_STEPS,
+      DL4J_TPU_STEPSTATS, DL4J_TPU_STRAGGLER_FACTOR,
+      DL4J_TPU_STRAGGLER_MIN_STEP
 
     Read live (not cached here) by their subsystems:
       DL4J_TPU_GRAPHOPT (post-import GraphOptimizer pipeline, default
@@ -130,9 +138,16 @@ class Environment:
                     flight_recorder_steps=int(os.environ.get(
                         "DL4J_TPU_FLIGHT_RECORDER_STEPS", "256")),
                     flight_recorder_dir=os.environ.get(
-                        "DL4J_TPU_FLIGHT_RECORDER_DIR", ""),
+                        "DL4J_TPU_FLIGHT_RECORDER_DIR", "flightrec"),
+                    flight_recorder_keep=int(os.environ.get(
+                        "DL4J_TPU_FLIGHT_RECORDER_KEEP", "8")),
                     hbm_sample_steps=int(os.environ.get(
                         "DL4J_TPU_HBM_SAMPLE_STEPS", "16")),
+                    stepstats=b("DL4J_TPU_STEPSTATS", True),
+                    straggler_factor=float(os.environ.get(
+                        "DL4J_TPU_STRAGGLER_FACTOR", "2.0")),
+                    straggler_min_step=float(os.environ.get(
+                        "DL4J_TPU_STRAGGLER_MIN_STEP", "1e-3")),
                 )
             return cls._inst
 

@@ -316,6 +316,8 @@ class MetricsRegistry:
         with cls._instance_lock:
             cls._instance = None
         _trace_buffer.clear()
+        from deeplearning4j_tpu.common import stepstats
+        stepstats.StepStats._reset_for_tests()
 
     # -- gate ----------------------------------------------------------
     @property
@@ -474,14 +476,21 @@ def trace_events() -> List[dict]:
     return list(_trace_buffer.events)
 
 
-def export_chrome_trace(path: str) -> str:
+def export_chrome_trace(path: str,
+                        metadata: Optional[dict] = None) -> str:
     """Write the shared span buffer as chrome://tracing JSON (the
-    format ProfilingListener and jax.profiler also emit)."""
+    format ProfilingListener and jax.profiler also emit).  ``metadata``
+    keys (e.g. ``host`` / ``clock_offset_s`` stamped by a scaling-
+    observatory worker) merge into the document metadata, where
+    :func:`merge_host_traces` reads them back."""
     with _trace_buffer._lock:
         events = list(_trace_buffer.events)
         dropped = _trace_buffer.dropped
+    meta = {"dropped_events": dropped}
+    if metadata:
+        meta.update(metadata)
     doc = {"traceEvents": events, "displayTimeUnit": "ms",
-           "metadata": {"dropped_events": dropped}}
+           "metadata": meta}
     with open(path, "w") as f:
         json.dump(doc, f)
     return path
@@ -507,6 +516,61 @@ def merge_chrome_traces(output_path: str, *paths: str) -> str:
         doc = _load_trace(p)
         events.extend(doc.get("traceEvents", []))
         meta.update(doc.get("metadata", {}))
+    doc = {"traceEvents": events, "displayTimeUnit": "ms",
+           "metadata": meta}
+    with open(output_path, "w") as f:
+        json.dump(doc, f)
+    return output_path
+
+
+def merge_host_traces(output_path: str, *sources) -> str:
+    """Fold per-HOST trace files into one clock-corrected timeline.
+
+    Each source is either a path (no correction) or a dict::
+
+        {"path": ..., "host": "worker3", "clock_offset_s": 0.012}
+
+    ``clock_offset_s`` is how far that host's clock runs AHEAD of the
+    reference (leader) clock — the value ``StepStatsClient`` estimates
+    in its connect handshake — so every event timestamp is shifted by
+    ``-offset`` to express it on the leader clock; a source omitting it
+    falls back to a ``clock_offset_s`` key in its own trace metadata
+    (what :func:`export_chrome_trace` stamps on workers).  Pids are
+    remapped per source so same-pid workers on different hosts land on
+    separate rows, each labeled with its host via ``process_name``
+    metadata events."""
+    events: List[dict] = []
+    meta: dict = {"hosts": []}
+    for idx, src in enumerate(sources):
+        if isinstance(src, (str, os.PathLike)):
+            src = {"path": src}
+        doc = _load_trace(src["path"])
+        doc_meta = doc.get("metadata", {}) or {}
+        host = src.get("host") or doc_meta.get("host") \
+            or f"host{idx}"
+        offset_s = src.get("clock_offset_s")
+        if offset_s is None:
+            offset_s = doc_meta.get("clock_offset_s", 0.0)
+        shift_us = int(float(offset_s) * 1e6)
+        pid_map: Dict[object, int] = {}
+        for ev in doc.get("traceEvents", []):
+            ev = dict(ev)
+            pid = pid_map.get(ev.get("pid"))
+            if pid is None:
+                pid = 1000 * (idx + 1) + len(pid_map)
+                pid_map[ev.get("pid")] = pid
+            ev["pid"] = pid
+            if "ts" in ev:
+                ev["ts"] = int(ev["ts"]) - shift_us
+            events.append(ev)
+        for pid in sorted(pid_map.values()):
+            events.append({
+                "name": "process_name", "ph": "M", "pid": pid,
+                "tid": 0, "args": {"name": host}})
+        meta["hosts"].append({"host": host,
+                              "clock_offset_s": float(offset_s),
+                              "events": len(doc.get("traceEvents",
+                                                    []))})
     doc = {"traceEvents": events, "displayTimeUnit": "ms",
            "metadata": meta}
     with open(output_path, "w") as f:
@@ -581,6 +645,10 @@ def observe_feed_stall(seconds: float, source: str) -> None:
               "time the step loop waited on the input pipeline for "
               "its next batch (seconds)").observe(seconds,
                                                   source=source)
+    # route into the scaling observatory's per-step breakdown as
+    # data_wait (lazy import: stepstats imports this module)
+    from deeplearning4j_tpu.common import stepstats
+    stepstats.note_data_wait(seconds, source)
 
 
 # ----------------------------------------------------------------------

@@ -77,6 +77,9 @@ class SharedTrainingMaster:
         self.config = config or SharedTrainingConfiguration()
         self._mesh = None
         self._initialized_dist = False
+        self._observatory = None        # leader-side aggregator
+        self._obs_client = None         # this process's shipper
+        self._last_observatory = None   # report kept after teardown
 
     class Builder:
         def __init__(self, batch_size_per_worker: int = 32):
@@ -211,6 +214,8 @@ class SharedTrainingMaster:
                          model.epoch_count)
                 model.listeners.remove(lis)
                 return model
+        if jax.process_count() > 1:
+            self._setup_observatory()
         try:
             pw = ParallelWrapper(
                 model, mesh, update_exchange=mode,
@@ -223,10 +228,59 @@ class SharedTrainingMaster:
                 pw.run_epochs(iterator, n_epochs,
                               lambda ds: self._make_global(mesh, ds))
         finally:
+            self._teardown_observatory()
             if mgr is not None:
                 model.listeners.remove(lis)
                 mgr.flush()
         return model
+
+    # -- scaling observatory sidecar -----------------------------------
+    def _setup_observatory(self):
+        """Ship every worker's per-step breakdown to the leader over a
+        sidecar socket (NOT inside the gradient exchange — that is a
+        compiled collective): the leader merges per step, gauges
+        per-worker skew, and trips straggler detection.  The connect
+        handshake gives each worker its clock offset vs the leader for
+        the cross-host trace merge.  Any failure here disables the
+        sidecar — observability must never take training down."""
+        import os
+
+        from deeplearning4j_tpu.common import stepstats
+        port = int(os.environ.get("DL4J_TPU_OBSERVATORY_PORT", "9470"))
+        leader = (self.config.coordinator_address or "").split(":")[0] \
+            or "127.0.0.1"
+        try:
+            if jax.process_index() == 0:
+                self._observatory = stepstats.StepStatsAggregator(
+                    expected_workers=jax.process_count(), port=port,
+                    host="")
+                port = self._observatory.port
+            stepstats.collector().set_worker(jax.process_index(),
+                                             jax.process_count())
+            self._obs_client = stepstats.StepStatsClient(
+                leader, port, worker=jax.process_index())
+            stepstats.collector().add_sink(self._obs_client.ship)
+        except OSError as e:
+            log.warning("scaling observatory sidecar disabled: %r", e)
+
+    def _teardown_observatory(self):
+        from deeplearning4j_tpu.common import stepstats
+        if self._obs_client is not None:
+            stepstats.collector().remove_sink(self._obs_client.ship)
+            self._obs_client.close()
+            self._obs_client = None
+        if self._observatory is not None:
+            self._last_observatory = self._observatory.report()
+            self._observatory.close()
+            self._observatory = None
+
+    def observatory_report(self) -> Optional[dict]:
+        """The leader's merged cross-host view (skew, trips, clock
+        offsets) — live during fit, the final report afterwards; None
+        on non-leader processes and single-process runs."""
+        if self._observatory is not None:
+            return self._observatory.report()
+        return self._last_observatory
 
     def _make_global(self, mesh, ds):
         from deeplearning4j_tpu.common.diagnostics import collective_span

@@ -233,8 +233,14 @@ class ParallelWrapper:
         staging depth)."""
         if not self._placed:
             self._place_model()
+        from deeplearning4j_tpu.common import stepstats
         from deeplearning4j_tpu.datasets.prefetch import \
             maybe_device_prefetch
+        # label this process's breakdowns for the scaling observatory
+        # (single-host: worker 0 of 1; SharedTrainingMaster re-labels
+        # per jax process before handing off to this loop)
+        stepstats.collector().set_worker(jax.process_index(),
+                                         jax.process_count())
         n = self.n_workers
         shard_fn = self._timed_place(shard_fn, n)
         staged = maybe_device_prefetch(iterator, place_fn=shard_fn,

@@ -195,6 +195,25 @@ def update_exchange_bytes(params, n_shards: int) -> int:
     return int(2 * (n_shards - 1) * total / n_shards)
 
 
+def exchange_report(params, n_shards: int, mode=None) -> dict:
+    """Scaling-observatory accounting for one step's update exchange:
+    parameter bytes, per-replica wire bytes (ring-collective model),
+    and the wire:param ratio — the numbers a `scaling` block needs to
+    say whether an efficiency drop tracks the collective budget or a
+    straggler (`bench.py` folds this in next to the efficiency curve)."""
+    total = sum(int(np.prod(a.shape)) * a.dtype.itemsize
+                for a in jax.tree_util.tree_leaves(params)
+                if hasattr(a, "shape"))
+    wire = update_exchange_bytes(params, n_shards)
+    return {
+        "mode": getattr(mode, "value", mode) or "dense",
+        "shards": int(n_shards),
+        "param_bytes": int(total),
+        "wire_bytes_per_replica": int(wire),
+        "wire_to_param_ratio": round(wire / total, 3) if total else 0.0,
+    }
+
+
 def sharded_state_bytes(states: Dict) -> int:
     """Total bytes of flat sharded updater state (whole-mesh; each
     replica holds 1/N of this)."""

@@ -17,6 +17,11 @@ Endpoints:
 - ``/metrics``      process-wide telemetry registry in Prometheus
   text exposition format (``common.telemetry.MetricsRegistry``) —
   point a Prometheus scrape job (or ``curl``) at it
+- ``/api/profile``  scaling-observatory on-demand profiling:
+  ``POST /api/profile?steps=N`` starts a bounded capture (409 while
+  one is active); ``GET`` returns capture status + last result
+  (``common.stepstats.ProfileCapture``; ``scripts/dl4j_profile.py``
+  is the CLI wrapper)
 """
 from __future__ import annotations
 
@@ -148,8 +153,42 @@ class UIServer:
                         self.send_json({"error": repr(e)}, 500)
                 elif self.path == "/metrics":
                     self.send_metrics()
+                elif self.path.split("?")[0] == "/api/profile":
+                    from deeplearning4j_tpu.common.stepstats import \
+                        ProfileCapture
+                    self.send_json(ProfileCapture.current_status())
                 else:
                     self.send_json({"error": "not found"}, 404)
+
+            def do_POST(self):              # noqa: N802
+                path, _, query = self.path.partition("?")
+                if path != "/api/profile":
+                    self.send_json({"error": "not found"}, 404)
+                    return
+                from urllib.parse import parse_qs
+
+                from deeplearning4j_tpu.common.stepstats import (
+                    CaptureActiveError, ProfileCapture)
+                q = parse_qs(query)
+                try:
+                    steps = int(q.get("steps", ["20"])[0])
+                    expire = q.get("expire_seconds", [None])[0]
+                    status = ProfileCapture.start(
+                        steps,
+                        out_dir=(q.get("out_dir", [None])[0]),
+                        use_jax=q.get("jax", ["1"])[0] not in ("0",
+                                                               "false"),
+                        expire_seconds=(float(expire)
+                                        if expire is not None
+                                        else None))
+                    self.send_json({"started": True, **status})
+                except CaptureActiveError as e:
+                    # one capture at a time: concurrent POSTs conflict
+                    self.send_json({"started": False,
+                                    "error": str(e)}, 409)
+                except (ValueError, OSError) as e:
+                    self.send_json({"started": False,
+                                    "error": repr(e)}, 400)
 
         self._httpd, self._thread = start_http_server(Handler, port)
         self.port = self._httpd.server_address[1]

@@ -117,27 +117,46 @@ class CheckpointListener(TrainingListener):
         self._rotate()
 
     def _save(self, model):
-        self.flush()     # join the previous in-flight write FIRST:
-        # the worker's _rotate reassigns self._saved, so bookkeeping
-        # below must not race it
-        path = self.dir / f"checkpoint_{self._counter}.zip"
-        tmp = self.dir / f".checkpoint_{self._counter}.zip.tmp"
-        self._counter += 1
-        self._saved.append(path)
-        self._last_saved_state = (model.iteration_count,
-                                  model.epoch_count)
-        snap = (model.checkpoint_snapshot()
-                if hasattr(model, "checkpoint_snapshot")
-                else _ModelSnapshot(model))
-        if not self.asynchronous:
-            self._write(snap, tmp, path)
-            return
-        if self._executor is None:
-            self._executor = concurrent.futures.ThreadPoolExecutor(
-                max_workers=1,
-                thread_name_prefix="dl4j-tpu-ckpt")
-        self._pending = self._executor.submit(self._write, snap, tmp,
-                                              path)
+        # everything in here runs ON the step loop — join of the
+        # previous write, device->host snapshot, and (when synchronous)
+        # the full serialize.  That is the checkpoint STALL the scaling
+        # observatory attributes (ROADMAP item 5's named metric): async
+        # snapshotting succeeds when this histogram collapses to the
+        # snapshot copy alone.
+        t0 = time.perf_counter()
+        try:
+            self.flush()     # join the previous in-flight write FIRST:
+            # the worker's _rotate reassigns self._saved, so bookkeeping
+            # below must not race it
+            path = self.dir / f"checkpoint_{self._counter}.zip"
+            tmp = self.dir / f".checkpoint_{self._counter}.zip.tmp"
+            self._counter += 1
+            self._saved.append(path)
+            self._last_saved_state = (model.iteration_count,
+                                      model.epoch_count)
+            snap = (model.checkpoint_snapshot()
+                    if hasattr(model, "checkpoint_snapshot")
+                    else _ModelSnapshot(model))
+            if not self.asynchronous:
+                self._write(snap, tmp, path)
+                return
+            if self._executor is None:
+                self._executor = concurrent.futures.ThreadPoolExecutor(
+                    max_workers=1,
+                    thread_name_prefix="dl4j-tpu-ckpt")
+            self._pending = self._executor.submit(self._write, snap,
+                                                  tmp, path)
+        finally:
+            stall = time.perf_counter() - t0
+            if telemetry.enabled():
+                telemetry.histogram(
+                    "dl4j_checkpoint_stall_seconds",
+                    "step-loop-blocking checkpoint time: join of the "
+                    "previous async write + device->host snapshot "
+                    "(plus the whole serialize when synchronous)"
+                    ).observe(stall)
+            from deeplearning4j_tpu.common import stepstats
+            stepstats.note_checkpoint_stall(stall)
 
     def flush(self):
         """Join the in-flight background write (reference analogue:

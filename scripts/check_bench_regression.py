@@ -23,6 +23,12 @@ throughput-like (higher is better: ``value``, ``*_ips``, ``tflops``,
 must not regress by more than ``--threshold`` percent. Improvements
 never fail. Exit 0 = clean, 1 = regression(s), 2 = unusable input.
 
+The PR-9 observatory blocks are understood natively: in ``scaling``,
+per-size ``efficiency`` entries are higher-is-better and ``skew``
+entries lower-is-better (matched on the full dotted path, since the
+leaves are bare size/worker labels); ``step_breakdown`` phase means
+gate as time-like seconds.
+
 Self-test (tier-1, no accelerator): comparing the checked-in
 BENCH_r04.json to BENCH_r05.json must pass (r05 improved), and the
 reverse direction at a tight threshold must flag the throughput drop
@@ -35,9 +41,10 @@ import json
 import sys
 
 #: metrics where larger is better (substring match on the key)
-HIGHER_BETTER = ("value", "tflops", "throughput", "_ips", "_rps")
+HIGHER_BETTER = ("value", "tflops", "throughput", "_ips", "_rps",
+                 "efficiency")
 #: metrics where smaller is better
-LOWER_BETTER = ("_ms", "_us", "_seconds", "overhead", "stall")
+LOWER_BETTER = ("_ms", "_us", "_seconds", "overhead", "stall", "skew")
 #: keys that are identity/config, never compared; "canary" keys are
 #: clock-path checks documented as dispatch-noise-dominated
 SKIP = ("metric", "unit", "n_trials", "vs_baseline", "meta", "min",
@@ -84,13 +91,16 @@ def _flatten(rec: dict, prefix: str = "") -> dict:
 
 
 def _polarity(key: str):
-    leaf = key.rsplit(".", 1)[-1]
-    for pat in LOWER_BETTER:
-        if pat in leaf:
-            return -1
-    for pat in HIGHER_BETTER:
-        if pat in leaf:
-            return +1
+    # leaf first; nested blocks whose leaves are bare labels (the
+    # `scaling` block's `efficiency.8`, `skew_seconds.3` — per-size /
+    # per-worker maps) fall back to a full-path match
+    for probe in (key.rsplit(".", 1)[-1], key):
+        for pat in LOWER_BETTER:
+            if pat in probe:
+                return -1
+        for pat in HIGHER_BETTER:
+            if pat in probe:
+                return +1
     return 0           # unknown polarity: informational only
 
 

@@ -67,11 +67,13 @@ def registered_metrics() -> Dict[str, Set[str]]:
 
 def documented_metrics() -> Dict[str, str]:
     """{metric name: documented kind} from the catalog tables in the
-    "## Observability" AND "## Diagnostics" sections (names mentioned
-    outside table rows count as documented with kind '')."""
+    "## Observability", "## Diagnostics" and "## Scaling observatory"
+    sections (names mentioned outside table rows count as documented
+    with kind '')."""
     text = README.read_text()
     doc: Dict[str, str] = {}
-    for heading in ("Observability", "Diagnostics"):
+    for heading in ("Observability", "Diagnostics",
+                    "Scaling observatory"):
         m = re.search(rf"## {heading}(.*?)(?:\n## |\Z)", text, re.S)
         if not m:
             continue

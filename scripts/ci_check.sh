@@ -3,13 +3,16 @@
 # docs and the perf claims honest:
 #
 #   1. telemetry catalog sync: every registered dl4j_* metric is in
-#      the README Observability catalog with the right type, and the
-#      catalog documents nothing the code no longer registers
+#      the README catalog (Observability / Diagnostics / Scaling
+#      observatory sections) with the right type, and the catalog
+#      documents nothing the code no longer registers
 #      (scripts/check_telemetry_catalog.py);
 #   2. bench regression gate: when at least two BENCH_r*.json rounds
 #      are checked in, the newest must not regress any
 #      known-polarity metric of the previous round by more than the
-#      threshold (scripts/check_bench_regression.py).
+#      threshold — including the PR-9 `scaling` (efficiency up, skew
+#      down) and `step_breakdown` (phase seconds down) blocks
+#      (scripts/check_bench_regression.py).
 #
 # Usage: scripts/ci_check.sh [--threshold PCT]     (default 10)
 # Exit 0 = all gates clean, 1 = a gate failed, 2 = bad usage.

@@ -1,0 +1,104 @@
+#!/usr/bin/env python
+"""Trigger (or poll) an on-demand scaling-observatory profile capture
+against a running training job's UIServer.
+
+The CLI wrapper for ``POST /api/profile`` (the endpoint
+``common.stepstats.ProfileCapture`` backs): starts a step-bounded
+capture — ``jax.profiler`` device trace when available, plus the
+observatory chrome trace and a merged timeline — then optionally polls
+until the capture finalizes and prints where the artifacts landed.
+
+Usage:
+
+    python scripts/dl4j_profile.py --port 9000 --steps 50
+    python scripts/dl4j_profile.py --url http://host:9000 --steps 20 \
+        --wait
+    python scripts/dl4j_profile.py --port 9000 --status
+
+Exit 0 = capture started (or status fetched), 3 = a capture was
+already active (HTTP 409), 1 = anything else.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+import urllib.error
+import urllib.parse
+import urllib.request
+
+
+def _get(url: str) -> dict:
+    with urllib.request.urlopen(url, timeout=10) as r:
+        return json.loads(r.read().decode())
+
+
+def _post(url: str) -> tuple:
+    req = urllib.request.Request(url, data=b"", method="POST")
+    try:
+        with urllib.request.urlopen(req, timeout=10) as r:
+            return r.status, json.loads(r.read().decode())
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read().decode() or "{}")
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--url", default=None,
+                    help="UIServer base URL (default: localhost:PORT)")
+    ap.add_argument("--port", type=int, default=9000)
+    ap.add_argument("--steps", type=int, default=20,
+                    help="train steps to capture (bounded; the capture "
+                    "auto-expires if the job stalls)")
+    ap.add_argument("--out-dir", default=None,
+                    help="server-side artifact directory (default: "
+                    "under the flight-recorder dir)")
+    ap.add_argument("--expire-seconds", type=float, default=None,
+                    help="wall-clock auto-expiry override")
+    ap.add_argument("--no-jax", action="store_true",
+                    help="observatory trace only, skip jax.profiler")
+    ap.add_argument("--wait", action="store_true",
+                    help="poll until the capture finalizes")
+    ap.add_argument("--status", action="store_true",
+                    help="print capture status and exit")
+    args = ap.parse_args(argv)
+
+    base = args.url or f"http://127.0.0.1:{args.port}"
+    base = base.rstrip("/")
+    if args.status:
+        print(json.dumps(_get(base + "/api/profile"), indent=2))
+        return 0
+
+    q = {"steps": str(args.steps)}
+    if args.out_dir:
+        q["out_dir"] = args.out_dir
+    if args.expire_seconds is not None:
+        q["expire_seconds"] = str(args.expire_seconds)
+    if args.no_jax:
+        q["jax"] = "0"
+    code, body = _post(base + "/api/profile?"
+                       + urllib.parse.urlencode(q))
+    print(json.dumps(body, indent=2))
+    if code == 409:
+        print("capture already active (409)", file=sys.stderr)
+        return 3
+    if code != 200:
+        return 1
+    if not args.wait:
+        return 0
+    deadline = time.time() + (args.expire_seconds
+                              or max(60.0, args.steps * 2.0)) + 30.0
+    while time.time() < deadline:
+        st = _get(base + "/api/profile")
+        if not st.get("active"):
+            print(json.dumps(st, indent=2))
+            return 0
+        time.sleep(1.0)
+    print("timed out waiting for the capture to finalize",
+          file=sys.stderr)
+    return 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

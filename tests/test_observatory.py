@@ -1,0 +1,565 @@
+"""Scaling-observatory tests (ISSUE 9): per-step time decomposition,
+cross-host aggregation with clock-skew handshake and straggler
+detection, the clock-corrected multi-host trace merge, flight-recorder
+retention, the bounded on-demand profile capture behind
+``POST /api/profile``, and the regression-gate polarity of the new
+``scaling`` / ``step_breakdown`` bench blocks."""
+import json
+import subprocess
+import sys
+import time
+import urllib.error
+import urllib.request
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.common import stepstats, telemetry
+from deeplearning4j_tpu.common.environment import Environment
+from deeplearning4j_tpu.common.stepstats import (CaptureActiveError,
+                                                 ProfileCapture,
+                                                 StepStatsAggregator,
+                                                 StepStatsClient,
+                                                 estimate_clock_offset)
+from deeplearning4j_tpu.common.telemetry import MetricsRegistry
+
+_ROOT = Path(__file__).resolve().parent.parent
+
+
+@pytest.fixture(autouse=True)
+def _fresh_registry():
+    # MetricsRegistry reset also resets the StepStats singleton
+    MetricsRegistry._reset_for_tests()
+    ProfileCapture._reset_for_tests()
+    yield
+    ProfileCapture._reset_for_tests()
+    MetricsRegistry._reset_for_tests()
+
+
+def _net_and_data(n=64):
+    from deeplearning4j_tpu.activations import Activation
+    from deeplearning4j_tpu.datasets.dataset import DataSet
+    from deeplearning4j_tpu.learning import Adam
+    from deeplearning4j_tpu.lossfunctions import LossFunction
+    from deeplearning4j_tpu.nn import (InputType, MultiLayerNetwork,
+                                       NeuralNetConfiguration)
+    from deeplearning4j_tpu.nn.conf.layers import DenseLayer, OutputLayer
+    rng = np.random.RandomState(0)
+    x = rng.randn(n, 4).astype(np.float32)
+    y = np.eye(2, dtype=np.float32)[(x[:, 0] > 0).astype(int)]
+    net = MultiLayerNetwork(
+        (NeuralNetConfiguration.Builder().seed(1).updater(Adam(1e-2))
+         .list()
+         .layer(DenseLayer(n_out=8, activation=Activation.RELU))
+         .layer(OutputLayer(n_out=2, activation=Activation.SOFTMAX,
+                            loss_function=LossFunction.MCXENT))
+         .set_input_type(InputType.feed_forward(4)).build())).init()
+    return net, DataSet(x, y)
+
+
+def _breakdown(step, step_seconds, worker=0, host=None, phases=None):
+    """A hand-built worker record in the shape StepStats.close_step
+    emits — what the aggregator ingests."""
+    ph = {p: 0.0 for p in stepstats.PHASES}
+    ph["compute"] = step_seconds
+    if phases:
+        ph.update(phases)
+        ph["compute"] = max(step_seconds - sum(phases.values()), 0.0)
+    return {"step": step, "model": "m", "worker": worker,
+            "host": host or f"host{worker}", "n_workers": 3,
+            "step_seconds": step_seconds,
+            "total_seconds": step_seconds, "phases": ph,
+            "collectives": {}}
+
+
+class TestStepBreakdown:
+    def test_phases_sum_to_step_time(self):
+        ss = stepstats.collector()
+        ss.note_data_wait(0.02, "iterator")
+        ss.note_in_step("updater", 0.01)
+        rec = ss.close_step("mln", 3, 0.1)
+        ph = rec["phases"]
+        assert ph["data_wait"] == pytest.approx(0.02)
+        assert ph["updater"] == pytest.approx(0.01)
+        # in-step phases subtract from the compute residual...
+        assert ph["compute"] == pytest.approx(0.09)
+        # ...out-of-step phases extend the total beyond the step span
+        assert rec["step_seconds"] == pytest.approx(0.1)
+        assert rec["total_seconds"] == pytest.approx(0.12)
+        assert sum(ph.values()) == pytest.approx(rec["total_seconds"])
+
+    def test_checkpoint_stall_routing(self):
+        ss = stepstats.collector()
+        ss.note_checkpoint_stall(0.05)
+        rec = ss.close_step("mln", 0, 0.1)
+        assert rec["phases"]["checkpoint_stall"] == pytest.approx(0.05)
+        assert rec["total_seconds"] == pytest.approx(0.15)
+
+    def test_update_exchange_counts_only_excess(self):
+        # the update_exchange span WRAPS the fused step: a 0.15s span
+        # around a 0.1s step is 0.05s of real collective/dispatch time
+        ss = stepstats.collector()
+        rec = ss.close_step("mln", 0, 0.1)
+        ss.note_collective("update_exchange", 0.15)
+        last = ss.last()
+        assert last is rec
+        assert last["phases"]["collective"] == pytest.approx(0.05)
+        assert last["total_seconds"] == pytest.approx(0.15)
+        assert last["collectives"]["update_exchange"] == \
+            pytest.approx(0.15)
+
+    def test_other_collective_kinds_route_to_phases(self):
+        ss = stepstats.collector()
+        ss.note_collective("global_assembly", 0.02)
+        ss.note_collective("state_placement", 0.01)
+        rec = ss.close_step("mln", 0, 0.1)
+        assert rec["phases"]["host_sync"] == pytest.approx(0.02)
+        assert rec["phases"]["updater"] == pytest.approx(0.01)
+        assert rec["collectives"] == {"global_assembly": 0.02,
+                                      "state_placement": 0.01}
+
+    def test_disabled_collects_nothing(self):
+        ss = stepstats.collector()
+        ss.set_enabled(False)
+        ss.note_data_wait(0.5)
+        assert ss.close_step("mln", 0, 0.1) is None
+        assert ss.records() == []
+        ss.set_enabled(True)
+        rec = ss.close_step("mln", 1, 0.1)
+        # the disabled-era data_wait did not leak into this step
+        assert rec["phases"]["data_wait"] == 0.0
+
+    def test_summary_block_and_metric(self):
+        ss = stepstats.collector()
+        for i in range(4):
+            ss.note_data_wait(0.01)
+            ss.close_step("mln", i, 0.1)
+        s = ss.summary()
+        assert s["steps"] == 4
+        assert s["mean_step_seconds"] == pytest.approx(0.1)
+        assert s["mean_total_seconds"] == pytest.approx(0.11)
+        assert sum(s["phases_mean_seconds"].values()) == \
+            pytest.approx(s["mean_total_seconds"])
+        assert s["phases_pct"]["compute"] == pytest.approx(90.9, abs=0.1)
+        page = MetricsRegistry.get().render_prometheus()
+        assert 'dl4j_step_phase_seconds' in page
+        assert 'phase="compute"' in page
+        assert 'phase="data_wait"' in page
+
+    def test_fit_closes_breakdowns(self):
+        """The funnel integration: a real tiny fit() lands breakdown
+        records whose phases sum to ~the observed step time."""
+        net, ds = _net_and_data()
+        for _ in range(3):
+            net.fit(ds)
+        recs = stepstats.collector().records()
+        assert len(recs) >= 3
+        for rec in recs:
+            assert rec["step_seconds"] > 0
+            assert sum(rec["phases"].values()) == \
+                pytest.approx(rec["total_seconds"], rel=1e-6)
+
+
+class TestClockOffset:
+    def test_estimate(self):
+        # local clock 5s ahead: t0=10.0, leader says 5.1, t1=10.2
+        assert estimate_clock_offset(10.0, 5.1, 10.2) == \
+            pytest.approx(5.0)
+        assert estimate_clock_offset(1.0, 1.1, 1.2) == \
+            pytest.approx(0.0)
+
+
+class TestAggregator:
+    def test_clean_run_never_trips(self):
+        agg = StepStatsAggregator(expected_workers=3, trip_factor=2.0,
+                                  min_step_seconds=1e-3)
+        try:
+            for step in range(5):
+                for w, dt in ((0, 0.100), (1, 0.104), (2, 0.098)):
+                    merged = agg.ingest(_breakdown(step, dt, worker=w))
+                assert merged is not None and not merged["tripped"]
+            assert agg.trips == 0
+            rep = agg.report()
+            assert rep["steps_merged"] == 5
+            assert rep["workers"] == 3
+            assert rep["max_skew_seconds"] < 0.01
+        finally:
+            agg.close()
+
+    def test_straggler_trips_and_names_host_and_phase(self, caplog):
+        agg = StepStatsAggregator(expected_workers=3, trip_factor=2.0,
+                                  min_step_seconds=1e-3)
+        try:
+            # one clean step, then worker 2 stalls on input: 0.9s vs a
+            # 0.367s mean is >2x — must trip within that one step
+            for w in range(3):
+                agg.ingest(_breakdown(0, 0.1, worker=w))
+            assert agg.trips == 0
+            merged = None
+            with caplog.at_level("WARNING", "deeplearning4j_tpu"):
+                for w, dt, ph in ((0, 0.1, None), (1, 0.1, None),
+                                  (2, 0.9, {"data_wait": 0.7})):
+                    merged = agg.ingest(
+                        _breakdown(1, dt, worker=w, phases=ph))
+            assert merged["tripped"]
+            assert agg.trips == 1
+            assert merged["worst_worker"] == 2
+            assert merged["worst_host"] == "host2"
+            assert merged["worst_phase"] == "data_wait"
+            assert merged["max_skew_seconds"] == pytest.approx(
+                0.9 - (0.1 + 0.1 + 0.9) / 3)
+            assert any("straggler" in r.getMessage()
+                       and "host2" in r.getMessage()
+                       for r in caplog.records)
+            c = telemetry.counter("dl4j_straggler_trips_total", "t")
+            assert c.value(worker="2", phase="data_wait") == 1
+            g = telemetry.gauge("dl4j_straggler_skew_seconds", "t")
+            assert g.value(worker="2") > 0.5
+        finally:
+            agg.close()
+
+    def test_min_step_guard_blocks_noise_trips(self):
+        # microsecond steps with huge RELATIVE skew must not trip:
+        # the mean is below min_step_seconds
+        agg = StepStatsAggregator(expected_workers=3, trip_factor=2.0,
+                                  min_step_seconds=1e-3)
+        try:
+            for w, dt in ((0, 1e-5), (1, 1e-5), (2, 9e-4)):
+                merged = agg.ingest(_breakdown(0, dt, worker=w))
+            assert not merged["tripped"]
+            assert agg.trips == 0
+        finally:
+            agg.close()
+
+    def test_socket_roundtrip_with_skewed_clock(self):
+        agg = StepStatsAggregator(expected_workers=2, port=0,
+                                  trip_factor=10.0,
+                                  min_step_seconds=1e-3)
+        clients = []
+        try:
+            c0 = StepStatsClient("127.0.0.1", agg.port, worker=0,
+                                 hostname="a")
+            c1 = StepStatsClient("127.0.0.1", agg.port, worker=1,
+                                 hostname="b",
+                                 clock=lambda: time.time() + 5.0)
+            clients += [c0, c1]
+            # the NTP-lite handshake sees host b's clock 5s ahead
+            assert abs(c0.clock_offset_s) < 0.5
+            assert c1.clock_offset_s == pytest.approx(5.0, abs=0.5)
+            c0.ship(_breakdown(0, 0.10, worker=0))
+            c1.ship(_breakdown(0, 0.12, worker=1))
+            deadline = time.time() + 5.0
+            while time.time() < deadline and not agg.merged:
+                time.sleep(0.01)
+            assert agg.merged, "step never merged over the socket"
+            rep = agg.report()
+            assert rep["steps_merged"] == 1
+            assert rep["worker_clock_offsets_s"][1] == \
+                pytest.approx(5.0, abs=0.5)
+            assert agg.worker_hosts[1] == "b"
+        finally:
+            for c in clients:
+                c.close()
+            agg.close()
+
+    def test_dead_client_disables_not_raises(self):
+        agg = StepStatsAggregator(expected_workers=1, port=0)
+        c = StepStatsClient("127.0.0.1", agg.port, worker=0)
+        agg.close()
+        c._f.close()
+        # shipping into a closed socket must not raise — observability
+        # never takes training down
+        c.ship(_breakdown(0, 0.1))
+        c.ship(_breakdown(1, 0.1))
+        assert c._dead
+
+
+_WORKER_SCRIPT = r"""
+import json, sys, time
+sys.path.insert(0, sys.argv[3])
+from deeplearning4j_tpu.common import telemetry
+out, offset = sys.argv[1], float(sys.argv[2])
+telemetry.MetricsRegistry.get().set_enabled(True)
+with telemetry.span("worker_step", rank=sys.argv[2]):
+    time.sleep(0.05)
+telemetry.instant("worker_mark")
+telemetry.export_chrome_trace(
+    out, metadata={"host": "host_off%g" % offset,
+                   "clock_offset_s": offset})
+# simulate the skewed wall clock: shift every recorded timestamp by
+# the offset, as if time.time() on this host ran that far ahead
+doc = json.load(open(out))
+for ev in doc["traceEvents"]:
+    if "ts" in ev:
+        ev["ts"] = int(ev["ts"] + offset * 1e6)
+json.dump(doc, open(out, "w"))
+"""
+
+
+class TestHostTraceMerge:
+    def test_two_subprocess_workers_offset_clocks(self, tmp_path):
+        """Two real worker processes, one with its clock 5s ahead;
+        the merge must pull both onto one monotonic leader timeline."""
+        paths = []
+        for i, offset in enumerate((0.0, 5.0)):
+            p = tmp_path / f"w{i}.trace.json"
+            subprocess.run(
+                [sys.executable, "-c", _WORKER_SCRIPT, str(p),
+                 str(offset), str(_ROOT)],
+                check=True, timeout=60)
+            paths.append(p)
+        merged = tmp_path / "merged.trace.json"
+        # worker 0 passed explicitly; worker 1's offset comes from the
+        # clock_offset_s its own trace metadata carries
+        telemetry.merge_host_traces(
+            str(merged),
+            {"path": str(paths[0]), "host": "leader",
+             "clock_offset_s": 0.0},
+            str(paths[1]))
+        doc = json.loads(merged.read_text())
+        ts = [ev["ts"] for ev in doc["traceEvents"] if "ts" in ev]
+        assert ts
+        # the 5s artificial skew is gone: both workers ran within the
+        # same ~second of wall time, so the corrected union is narrow
+        assert (max(ts) - min(ts)) / 1e6 < 4.0
+        # pids remapped per source onto separate rows
+        pids = {ev["pid"] for ev in doc["traceEvents"]
+                if ev.get("ph") != "M"}
+        assert any(1000 <= p < 2000 for p in pids)
+        assert any(2000 <= p < 3000 for p in pids)
+        names = {ev["args"]["name"] for ev in doc["traceEvents"]
+                 if ev.get("ph") == "M"
+                 and ev.get("name") == "process_name"}
+        assert names == {"leader", "host_off5"}
+        hosts = doc["metadata"]["hosts"]
+        assert [h["clock_offset_s"] for h in hosts] == [0.0, 5.0]
+
+    def test_uncorrected_merge_keeps_the_skew(self, tmp_path):
+        # control: forcing offset 0 for the skewed worker leaves the
+        # 5s gap in place — proving the correction above did the work
+        paths = []
+        for i, offset in enumerate((0.0, 5.0)):
+            p = tmp_path / f"w{i}.trace.json"
+            subprocess.run(
+                [sys.executable, "-c", _WORKER_SCRIPT, str(p),
+                 str(offset), str(_ROOT)],
+                check=True, timeout=60)
+            paths.append(p)
+        merged = tmp_path / "raw.trace.json"
+        telemetry.merge_host_traces(
+            str(merged),
+            {"path": str(paths[0]), "clock_offset_s": 0.0},
+            {"path": str(paths[1]), "clock_offset_s": 0.0})
+        doc = json.loads(merged.read_text())
+        ts = [ev["ts"] for ev in doc["traceEvents"] if "ts" in ev]
+        assert (max(ts) - min(ts)) / 1e6 > 4.0
+
+
+class TestScalingBlock:
+    def test_efficiency_vs_baseline(self):
+        block = stepstats.scaling_block(
+            {"sizes": [1, 8],
+             "throughput": {"1": 100.0, "8": 640.0}})
+        assert block["baseline_chips"] == 1
+        assert block["throughput_per_chip"] == {"1": 100.0, "8": 80.0}
+        assert block["efficiency"]["1"] == pytest.approx(1.0)
+        assert block["efficiency"]["8"] == pytest.approx(0.8)
+        assert block["max_worker_skew_seconds"] == 0.0
+
+    def test_exchange_report_wire_accounting(self):
+        from deeplearning4j_tpu.parallel import zero
+        rep = zero.exchange_report(
+            {"w": np.zeros((8, 8), dtype=np.float32)}, 4)
+        assert rep["param_bytes"] == 256
+        # ring all-reduce: 2(n-1)/n of the params cross the wire
+        assert rep["wire_bytes_per_replica"] == 384
+        assert rep["wire_to_param_ratio"] == pytest.approx(1.5)
+        assert rep["mode"] == "dense"
+
+    def test_observatory_report_attaches(self):
+        obs = {"steps_merged": 10, "max_skew_seconds": 0.02,
+               "trips": 1}
+        block = stepstats.scaling_block(
+            {"sizes": [1], "throughput": {"1": 10.0}},
+            observatory=obs)
+        assert block["observatory"] is obs
+        assert block["max_worker_skew_seconds"] == pytest.approx(0.02)
+
+
+class TestRegressionGatePolarity:
+    @staticmethod
+    def _mod():
+        import importlib.util
+        spec = importlib.util.spec_from_file_location(
+            "check_bench_regression",
+            _ROOT / "scripts" / "check_bench_regression.py")
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+        return mod
+
+    def test_scaling_block_polarity(self):
+        mod = self._mod()
+        base = {"metric": "x", "value": 100.0,
+                "scaling": {"efficiency": {"1": 1.0, "8": 0.9},
+                            "max_worker_skew_seconds": 0.05},
+                "step_breakdown": {
+                    "phases_mean_seconds": {"data_wait": 0.02}}}
+        fresh = {"metric": "x", "value": 100.0,
+                 "scaling": {"efficiency": {"1": 1.0, "8": 0.6},
+                             "max_worker_skew_seconds": 0.01},
+                 "step_breakdown": {
+                     "phases_mean_seconds": {"data_wait": 0.01}}}
+        regs, imps, _ = mod.compare(base, fresh, 10.0)
+        reg_keys = {k for k, *_ in regs}
+        imp_keys = {k for k, *_ in imps}
+        # an efficiency collapse at 8 chips is a gated regression...
+        assert "scaling.efficiency.8" in reg_keys
+        # ...while less skew and less data_wait are improvements
+        assert "scaling.max_worker_skew_seconds" in imp_keys
+        assert "step_breakdown.phases_mean_seconds.data_wait" in \
+            imp_keys
+
+    def test_reverse_direction_flags_skew_growth(self):
+        mod = self._mod()
+        base = {"metric": "x",
+                "scaling": {"max_worker_skew_seconds": 0.01}}
+        fresh = {"metric": "x",
+                 "scaling": {"max_worker_skew_seconds": 0.05}}
+        regs, _, _ = mod.compare(base, fresh, 10.0)
+        assert {k for k, *_ in regs} == \
+            {"scaling.max_worker_skew_seconds"}
+
+
+class TestFlightRecorderRetention:
+    @pytest.fixture(autouse=True)
+    def _fresh_env(self, tmp_path, monkeypatch):
+        from deeplearning4j_tpu.common import diagnostics
+        monkeypatch.setenv("DL4J_TPU_FLIGHT_RECORDER_DIR",
+                           str(tmp_path / "fr"))
+        monkeypatch.setenv("DL4J_TPU_FLIGHT_RECORDER_KEEP", "3")
+        Environment.reset()
+        diagnostics.FlightRecorder._reset_for_tests()
+        yield
+        diagnostics.FlightRecorder._reset_for_tests()
+        Environment.reset()
+
+    def test_default_dir_is_flightrec(self, monkeypatch):
+        monkeypatch.delenv("DL4J_TPU_FLIGHT_RECORDER_DIR",
+                           raising=False)
+        Environment.reset()
+        assert Environment.get().flight_recorder_dir == "flightrec"
+        assert Environment.get().flight_recorder_keep == 3
+
+    def test_prune_keeps_newest_k(self, tmp_path):
+        from deeplearning4j_tpu.common import diagnostics
+        rec = diagnostics.FlightRecorder.get()
+        rec.enabled = True
+        for i in range(5):
+            assert rec.dump(f"r{i}") is not None
+            # spread mtimes so keep-newest ordering is deterministic
+            time.sleep(0.02)
+        d = tmp_path / "fr"
+        left = sorted(p.name for p in d.glob("flightrec_*.jsonl"))
+        assert len(left) == 3
+        assert all(any(f"_r{i}." in n for n in left)
+                   for i in (2, 3, 4))
+        # trace.json partners of pruned dumps went with them
+        traces = sorted(p.name for p in d.glob("*.trace.json"))
+        assert len(traces) == 3
+
+
+class TestProfileCapture:
+    def test_concurrent_capture_conflicts(self, tmp_path):
+        ss = stepstats.collector()
+        status = ProfileCapture.start(
+            3, out_dir=str(tmp_path / "cap"), use_jax=False,
+            expire_seconds=60.0)
+        assert status["active"] and status["remaining_steps"] == 3
+        with pytest.raises(CaptureActiveError):
+            ProfileCapture.start(5, out_dir=str(tmp_path / "cap2"),
+                                 use_jax=False)
+        # step-bounded: three closed steps finalize it
+        for i in range(3):
+            ss.close_step("mln", i, 0.01)
+        st = ProfileCapture.current_status()
+        assert st["active"] is False
+        assert st["last"]["reason"] == "complete"
+        assert st["last"]["steps_captured"] == 3
+        obs = Path(st["last"]["out_dir"]) / "observatory.trace.json"
+        assert obs.exists()
+        assert json.loads(obs.read_text())["traceEvents"] is not None
+        # the slot freed: a new capture can start
+        ProfileCapture.start(1, out_dir=str(tmp_path / "cap3"),
+                             use_jax=False, expire_seconds=60.0)
+        ss.close_step("mln", 9, 0.01)
+        assert ProfileCapture.current_status()["active"] is False
+
+    def test_wall_clock_expiry_backstop(self, tmp_path):
+        ProfileCapture.start(10_000, out_dir=str(tmp_path / "cap"),
+                             use_jax=False, expire_seconds=0.2)
+        deadline = time.time() + 5.0
+        while time.time() < deadline and \
+                ProfileCapture.current_status()["active"]:
+            time.sleep(0.05)
+        st = ProfileCapture.current_status()
+        assert st["active"] is False
+        assert st["last"]["reason"] == "expired"
+        c = telemetry.counter("dl4j_profile_captures_total", "t")
+        assert c.value(reason="expired") == 1
+
+    def test_http_endpoint(self, tmp_path):
+        from deeplearning4j_tpu.ui import UIServer
+        server = UIServer.get_instance().start(port=0)
+        try:
+            url = server.url + "/api/profile"
+            idle = json.loads(urllib.request.urlopen(url).read())
+            assert idle["active"] is False
+            post = urllib.request.Request(
+                url + "?steps=2&jax=0&expire_seconds=60"
+                + f"&out_dir={tmp_path / 'cap'}",
+                data=b"", method="POST")
+            resp = urllib.request.urlopen(post)
+            body = json.loads(resp.read())
+            assert resp.status == 200
+            assert body["started"] and body["remaining_steps"] == 2
+            # a second POST while active is a 409 conflict
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                urllib.request.urlopen(urllib.request.Request(
+                    url + "?steps=2&jax=0", data=b"", method="POST"))
+            assert ei.value.code == 409
+            ss = stepstats.collector()
+            ss.close_step("mln", 0, 0.01)
+            ss.close_step("mln", 1, 0.01)
+            done = json.loads(urllib.request.urlopen(url).read())
+            assert done["active"] is False
+            assert done["last"]["reason"] == "complete"
+            # bad input is a 400, and non-profile POSTs 404
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                urllib.request.urlopen(urllib.request.Request(
+                    url + "?steps=nope", data=b"", method="POST"))
+            assert ei.value.code == 400
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                urllib.request.urlopen(urllib.request.Request(
+                    server.url + "/api/nope", data=b"",
+                    method="POST"))
+            assert ei.value.code == 404
+        finally:
+            server.stop()
+
+
+class TestCheckpointStallMetric:
+    def test_save_records_stall(self, tmp_path):
+        from deeplearning4j_tpu.utils.checkpoint import \
+            CheckpointListener
+        net, ds = _net_and_data()
+        listener = CheckpointListener(str(tmp_path),
+                                      save_every_n_iterations=1,
+                                      keep_last=2)
+        net.set_listeners(listener)
+        net.fit(ds)
+        listener.flush()
+        page = MetricsRegistry.get().render_prometheus()
+        assert "dl4j_checkpoint_stall_seconds" in page
+        rec = stepstats.collector().records()[-1]
+        assert "checkpoint_stall" in rec["phases"]
