@@ -269,6 +269,31 @@ def main():
                   file=sys.stderr)
     except Exception as e:
         print(f"update-sharding leg failed: {e!r}", file=sys.stderr)
+    # FSDP leg: ZeRO-3 vs ZeRO-1 vs dense — per-chip param + updater-
+    # state residency and step time, plus the fsdp accumulation-window
+    # micro-step times. CPU-proxy subprocess on the virtual 8-device
+    # mesh, like the legs above.
+    try:
+        env = {**os.environ, "PYTHONPATH": "", "JAX_PLATFORMS": "cpu"}
+        out = subprocess.run(
+            [sys.executable,
+             os.path.join(_ROOT, "benchmarks", "bench_fsdp.py")],
+            capture_output=True, text=True, timeout=900, env=env,
+            cwd=_ROOT)
+        if out.returncode != 0:
+            raise RuntimeError(
+                f"rc={out.returncode}: {out.stderr.strip()[-400:]}")
+        for ln in out.stdout.strip().splitlines():
+            if not ln.startswith("{"):
+                continue              # tolerate library banners
+            rec = json.loads(ln)
+            if rec.get("metric") == "fsdp":
+                rec.pop("metric")
+                line["fsdp"] = rec
+        if "fsdp" not in line:
+            print("fsdp leg: no line in child output", file=sys.stderr)
+    except Exception as e:
+        print(f"fsdp leg failed: {e!r}", file=sys.stderr)
     # Graph-optimizer leg: per-pass rewrite counts + fused-vs-unfused
     # imported-BERT step time, and the flash-vs-dense compiled temp
     # memory floor at a long-sequence shape. CPU-proxy subprocess,

@@ -306,6 +306,9 @@ class SameDiff:
         self.loss_variables: List[str] = []
         self.training_config = None
         self._updater_state = None
+        #: DpFlatSpec of the fsdp fit_steps window (parallel.zero);
+        #: set by _build_raw_train_step(fsdp=True)
+        self._fsdp_spec = None
         #: updater iteration, persisted across fit()/fit_steps() calls
         #: (Adam bias correction must not restart per call)
         self.iteration_count: int = 0
@@ -1072,26 +1075,56 @@ class SameDiff:
                     and k[0] in ("train", "train_multi"))}
 
     def _build_raw_train_step(self, ph_names: Tuple[str, ...],
-                              mesh=None, axis: str = "data"):
+                              mesh=None, axis: str = "data",
+                              fsdp: bool = False):
         cfg = self.training_config
         fn, var_names = self._build_fn(tuple(self.loss_variables),
                                        ph_names, True)
         trainable = [n for n in var_names]
         updater = cfg.updater
 
-        def step(var_vals, upd_state, ph_vals, iteration, rng):
-            def loss_fn(tv):
-                outs = fn(tv, ph_vals, rng)
-                total = sum(jnp.sum(o) for o in outs)
-                if cfg.l2:
-                    total = total + 0.5 * cfg.l2 * sum(
-                        jnp.sum(v * v) for v in tv.values())
-                if cfg.l1:
-                    total = total + cfg.l1 * sum(
-                        jnp.sum(jnp.abs(v)) for v in tv.values())
-                return total
+        def dense_loss(tv, ph_vals, rng):
+            outs = fn(tv, ph_vals, rng)
+            total = sum(jnp.sum(o) for o in outs)
+            if cfg.l2:
+                total = total + 0.5 * cfg.l2 * sum(
+                    jnp.sum(v * v) for v in tv.values())
+            if cfg.l1:
+                total = total + cfg.l1 * sum(
+                    jnp.sum(jnp.abs(v)) for v in tv.values())
+            return total
 
-            loss, grads = jax.value_and_grad(loss_fn)(var_vals)
+        if fsdp:
+            # ZeRO-3: var_vals travel as the single flat shard dict
+            # ({FSDP_KEY: {dtype: flat}}, resident 1/N along the data
+            # axis); the forward gathers them through the custom-vjp
+            # gather, so the grad cotangent is born reduce-scattered
+            # and the tail never all-gathers the new variables
+            from deeplearning4j_tpu.learning.updaters import (
+                FSDP_KEY, dp_flatten_spec)
+            from deeplearning4j_tpu.parallel.zero import (
+                apply_update_fsdp, fsdp_gather)
+            spec = dp_flatten_spec(
+                {n: self._arrays[n] for n in trainable},
+                mesh.shape[axis])
+            self._fsdp_spec = spec
+
+            def fsdp_step(var_vals, upd_state, ph_vals, iteration, rng):
+                def loss_fn(fv):
+                    tv = fsdp_gather(fv[FSDP_KEY], spec, mesh, axis)
+                    return dense_loss(tv, ph_vals, rng)
+
+                loss, grads = jax.value_and_grad(loss_fn)(var_vals)
+                new_flat, new_state = apply_update_fsdp(
+                    updater, grads[FSDP_KEY], var_vals[FSDP_KEY],
+                    upd_state, iteration, mesh, axis)
+                return {FSDP_KEY: new_flat}, new_state, loss
+
+            return fsdp_step, trainable
+
+        def step(var_vals, upd_state, ph_vals, iteration, rng):
+            loss, grads = jax.value_and_grad(
+                lambda tv: dense_loss(tv, ph_vals, rng))(var_vals)
             if mesh is not None:
                 # ZeRO-1 sharded tail (parallel.zero): updater + state
                 # on 1/N shards; new_vars come back replicated and in
@@ -1123,7 +1156,7 @@ class SameDiff:
         return jax.jit(step, donate_argnums=(0, 1)), trainable
 
     def fit_steps(self, placeholders: Dict, n_steps: int,
-                  mesh=None) -> float:
+                  mesh=None, update_exchange="auto") -> float:
         """``n_steps`` train-step updates on ONE fixed placeholder
         batch inside a single ``lax.fori_loop`` dispatch, syncing on
         the final loss once. The benchmark-grade loop (same recipe as
@@ -1152,16 +1185,18 @@ class SameDiff:
                                         cfg.data_set_label_mapping))
         from deeplearning4j_tpu.parallel.zero import (
             UpdateExchange, resolve_update_exchange)
-        sharded = (resolve_update_exchange(mesh)
-                   is UpdateExchange.SHARDED)
-        key = (tuple(sorted(ph_vals)), mesh_sig, sharded)
+        mode = resolve_update_exchange(mesh, requested=update_exchange)
+        sharded = mode is UpdateExchange.SHARDED
+        fsdp = mode is UpdateExchange.FSDP
+        key = (tuple(sorted(ph_vals)), mesh_sig, mode.value)
         cached = self._exec_cache.get(("train_multi", key))
         if cached is None:
             from deeplearning4j_tpu.common.compilecache import \
                 enable_persistent_cache
             enable_persistent_cache()
             raw, trainable = self._build_raw_train_step(
-                tuple(ph_vals), mesh if sharded else None)
+                tuple(ph_vals), mesh if (sharded or fsdp) else None,
+                fsdp=fsdp)
 
             def multi(var_vals, upd_state, ph, rng, it0, n):
                 def body(i, carry):
@@ -1196,23 +1231,36 @@ class SameDiff:
             self._restore_updater_leaves()
         self._updater_trainable = list(trainable)
         var_vals = {n: self._arrays[n] for n in trainable}
-        # layout sync: the sharded step consumes/produces the ZeRO-1
-        # flat state; the dense step the per-variable slot trees
+        # layout sync: the sharded/fsdp steps consume/produce the
+        # ZeRO-1 flat state; the dense step the per-variable slot trees
+        flat_state = sharded or fsdp
         from deeplearning4j_tpu.learning.updaters import is_dp_sharded
-        if sharded and self._updater_state and \
+        if flat_state and self._updater_state and \
                 not is_dp_sharded(self._updater_state):
             from deeplearning4j_tpu.parallel.zero import to_sharded_state
             self._updater_state = to_sharded_state(
                 var_vals, self._updater_state, mesh.shape["data"])
-        elif not sharded and is_dp_sharded(self._updater_state):
+        elif not flat_state and is_dp_sharded(self._updater_state):
             from deeplearning4j_tpu.parallel.zero import to_dense_state
             self._updater_state = to_dense_state(var_vals,
                                                  self._updater_state)
         self._rng, rng = jax.random.split(self._rng)
         if mesh is not None:
             from deeplearning4j_tpu.parallel import replicate_tree
-            var_vals = replicate_tree(mesh, var_vals)
-            if sharded:
+            if fsdp:
+                # variables enter the flat resident layout: 1/N per
+                # replica along the data axis for the whole fori window
+                from deeplearning4j_tpu.learning.updaters import (
+                    FSDP_KEY, dp_ravel)
+                from deeplearning4j_tpu.parallel.mesh import flat_sharding
+                flats, _ = dp_ravel(var_vals, mesh.shape["data"],
+                                    self._fsdp_spec)
+                shard = flat_sharding(mesh, "data")
+                var_vals = {FSDP_KEY: {dt: jax.device_put(v, shard)
+                                       for dt, v in flats.items()}}
+            else:
+                var_vals = replicate_tree(mesh, var_vals)
+            if flat_state:
                 # 1/N of the optimizer state per replica — the HBM win
                 from deeplearning4j_tpu.parallel.zero import \
                     place_updater_states
@@ -1227,6 +1275,13 @@ class SameDiff:
             new_vars, self._updater_state, loss = multi_fn(
                 var_vals, self._updater_state, ph_vals, rng,
                 jnp.asarray(self.iteration_count), n_steps)
+        if fsdp:
+            # _arrays stay dense between calls (output()/getters read
+            # them directly); the densify gather is timed into
+            # dl4j_fsdp_gather_seconds
+            from deeplearning4j_tpu.parallel.zero import params_to_dense
+            new_vars = params_to_dense(
+                {"vars": new_vars}, {"vars": self._fsdp_spec})["vars"]
         self._arrays.update(new_vars)
         self.iteration_count += n_steps
         diagnostics.after_step(self, "SameDiff",

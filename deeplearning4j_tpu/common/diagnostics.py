@@ -84,6 +84,34 @@ def _tree_bytes(tree) -> int:
     return total
 
 
+def _leaf_resident_bytes(leaf) -> int:
+    """Bytes of one leaf actually resident on a single device.  For a
+    replicated array this equals the full logical bytes; for a
+    dp-sharded flat (ZeRO-1 state, fsdp params) it is the 1/N shard
+    the device really holds — which is what an HBM budget cares
+    about."""
+    if not (hasattr(leaf, "shape") and hasattr(leaf, "dtype")):
+        return 0
+    itemsize = np.dtype(leaf.dtype).itemsize
+    sharding = getattr(leaf, "sharding", None)
+    if sharding is not None:
+        try:
+            shard_shape = sharding.shard_shape(leaf.shape)
+            return int(np.prod(shard_shape, dtype=np.int64) * itemsize)
+        except Exception:       # noqa: BLE001 — exotic sharding types
+            pass
+    return int(np.prod(leaf.shape, dtype=np.int64) * itemsize)
+
+
+def _tree_resident_bytes(tree) -> int:
+    """Per-device resident bytes of a pytree (sharding-aware: a
+    dp-sharded leaf counts its shard, a replicated leaf its full
+    size)."""
+    import jax
+    return sum(_leaf_resident_bytes(leaf)
+               for leaf in jax.tree_util.tree_leaves(tree))
+
+
 def device_memory_stats() -> List[dict]:
     """Per-device allocator stats from jax (``device.memory_stats()``).
     Empty on backends that expose none (CPU)."""
@@ -157,10 +185,15 @@ def _model_attribution(model) -> dict:
     if upd is None:
         upd = getattr(model, "_updater_state", None) or {}
     states = getattr(model, "states", {}) or {}
+    # resident = what one device actually holds (a ZeRO-1 sharded
+    # state or fsdp param flat counts its 1/N shard, not the logical
+    # size); equal to the plain bytes when everything is replicated
     return {
         "params_bytes": _tree_bytes(params),
         "updater_state_bytes": _tree_bytes(upd),
         "model_state_bytes": _tree_bytes(states),
+        "params_resident_bytes": _tree_resident_bytes(params),
+        "updater_state_resident_bytes": _tree_resident_bytes(upd),
     }
 
 
@@ -184,8 +217,11 @@ def memory_report(model=None) -> dict:
         "dl4j_prefetch_staged_bytes",
         "bytes of device-prefetched batches currently staged ahead of "
         "the step loop").value()
+    # account per-device residency (shard-aware), not logical bytes —
+    # under fsdp a model's params_bytes exceeds what any chip holds
     accounted = int(staging) + sum(
-        sum(v.values()) for v in models.values())
+        v["params_resident_bytes"] + v["updater_state_resident_bytes"] +
+        v["model_state_bytes"] for v in models.values())
     report = {
         "schema_version": SCHEMA_VERSION,
         "devices": devices,

@@ -45,6 +45,13 @@ class _Env:
     # per replica instead of fully replicated. 0 restores the dense
     # replicated update exactly.
     sharded_update: bool = True
+    # full FSDP / ZeRO-3 (parallel.zero): params + grads resident 1/N
+    # per replica with per-layer just-in-time all-gather. 0 demotes
+    # update_exchange="fsdp" requests to the ZeRO-1 sharded update.
+    # fsdp_prefetch additionally emits layer k+1's gather while layer
+    # k computes (off -> strictly on-demand gathers).
+    fsdp: bool = True
+    fsdp_prefetch: bool = True
     # numerics watchdog (common.diagnostics): opt-in sampled non-finite
     # check on loss / global grad norm inside the fit funnels; a trip
     # raises a structured NumericsEvent instead of training on NaNs
@@ -85,7 +92,8 @@ class Environment:
       DL4J_TPU_DEVICE_PREFETCH, DL4J_TPU_DEVICE_PREFETCH_DEPTH,
       DL4J_TPU_COMPILE_CACHE, DL4J_TPU_COMPILE_CACHE_DIR,
       DL4J_TPU_RETRACE_WARN, DL4J_TPU_TELEMETRY,
-      DL4J_TPU_SHARDED_UPDATE, DL4J_TPU_NUMERICS_WATCHDOG,
+      DL4J_TPU_SHARDED_UPDATE, DL4J_TPU_FSDP,
+      DL4J_TPU_FSDP_PREFETCH, DL4J_TPU_NUMERICS_WATCHDOG,
       DL4J_TPU_NUMERICS_SAMPLE, DL4J_TPU_FLIGHT_RECORDER,
       DL4J_TPU_FLIGHT_RECORDER_STEPS, DL4J_TPU_FLIGHT_RECORDER_DIR,
       DL4J_TPU_FLIGHT_RECORDER_KEEP, DL4J_TPU_HBM_SAMPLE_STEPS,
@@ -131,6 +139,8 @@ class Environment:
                         "DL4J_TPU_RETRACE_WARN", "5")),
                     telemetry=b("DL4J_TPU_TELEMETRY", True),
                     sharded_update=b("DL4J_TPU_SHARDED_UPDATE", True),
+                    fsdp=b("DL4J_TPU_FSDP", True),
+                    fsdp_prefetch=b("DL4J_TPU_FSDP_PREFETCH", True),
                     numerics_watchdog=b("DL4J_TPU_NUMERICS_WATCHDOG"),
                     numerics_sample=int(os.environ.get(
                         "DL4J_TPU_NUMERICS_SAMPLE", "1")),

@@ -45,6 +45,16 @@ def is_dp_sharded(state) -> bool:
     return isinstance(state, dict) and DP_SHARDED_KEY in state
 
 
+#: reserved key marking a PARAM subtree as FSDP (ZeRO-3) flat layout:
+#: ``{FSDP_KEY: {dtype key: padded flat vector}}`` resident 1/N per
+#: replica along the dp axis (``parallel.zero`` owns the conversions)
+FSDP_KEY = "__fsdp__"
+
+
+def is_fsdp(tree) -> bool:
+    return isinstance(tree, dict) and FSDP_KEY in tree
+
+
 class DpFlatSpec:
     """How a pytree ravels into per-dtype padded flat vectors.
 

@@ -51,6 +51,12 @@ class ComputationGraph:
         # installed the step tail runs the updater on 1/N param shards
         self._dp_mesh = None
         self._dp_axis = "data"
+        # full FSDP / ZeRO-3 (parallel.zero): params live as 1/N flat
+        # shards ({FSDP_KEY: {dtype: flat}} per vertex), gathered
+        # per-vertex just-in-time in the forward; _fsdp_specs keeps the
+        # per-vertex DpFlatSpec needed to densify
+        self._dp_fsdp = False
+        self._fsdp_specs = {}
         # gradient accumulation (reference: GradientsAccumulator)
         self._accum_steps = 1
         self._accum_grads = None
@@ -124,7 +130,10 @@ class ComputationGraph:
             # swallow their (1-decay)*delta updates.
             from deeplearning4j_tpu.common.dtypes import cast_floats
             cd = conf.compute_dtype
-            params = cast_floats(params, cd)
+            # an FsdpParamView casts per-vertex post-gather, keeping
+            # the just-in-time gather schedule
+            params = (params.cast(cd) if hasattr(params, "cast")
+                      else cast_floats(params, cd))
             inputs = [cast_floats(x, cd) for x in inputs]
         def run_vertex(name, acts, lrng):
             """Execute one vertex against the live activation dict;
@@ -318,7 +327,24 @@ class ComputationGraph:
                            else conf.updater)
                     for name in self._topo}
 
+        gn = conf.gradient_normalization
+        thr = conf.gradient_normalization_threshold
+        dp_mesh, dp_axis = self._dp_mesh, self._dp_axis
+        fsdp = self._dp_fsdp and dp_mesh is not None
+        if fsdp:
+            from deeplearning4j_tpu.common.environment import Environment
+            from deeplearning4j_tpu.parallel.zero import FsdpParamView
+            fsdp_specs = dict(self._fsdp_specs)
+            fsdp_prefetch = Environment.get().fsdp_prefetch
+            vertex_order = list(self._topo)
+
         def loss_fn(params, states, inputs, labels, fmask, lmasks, rng):
+            if fsdp:
+                # lazy view over the 1/N flat shards: each vertex's
+                # all-gather is emitted at its point of use in the walk
+                params = FsdpParamView(params, fsdp_specs, dp_mesh,
+                                       dp_axis, order=vertex_order,
+                                       prefetch=fsdp_prefetch)
             acts, new_states = self._forward(params, states, inputs,
                                              training=True, rng=rng,
                                              want_logits=True,
@@ -333,10 +359,6 @@ class ComputationGraph:
                     from_logits=layer.wants_logits(),
                     mask=lmasks[i] if lmasks is not None else None)
             return loss, new_states
-
-        gn = conf.gradient_normalization
-        thr = conf.gradient_normalization_threshold
-        dp_mesh, dp_axis = self._dp_mesh, self._dp_axis
 
         # numerics watchdog: when armed the step also emits the global
         # grad norm in-jit; when off it is a free zeros constant (see
@@ -364,6 +386,22 @@ class ComputationGraph:
                 if not g:
                     new_params[name] = params.get(name, {})
                     new_upd[name] = upd_states.get(name, ())
+                    continue
+                if fsdp:
+                    # ZeRO-3 tail: params/grads already the 1/N flat
+                    # shards and stay that way — no trailing all-gather
+                    # (constraints skipped: the resolver refuses fsdp
+                    # when any layer has them)
+                    from deeplearning4j_tpu.learning.updaters import \
+                        FSDP_KEY
+                    from deeplearning4j_tpu.parallel.zero import \
+                        apply_update_fsdp
+                    new_flat, us = apply_update_fsdp(
+                        updaters[name], g[FSDP_KEY],
+                        params[name][FSDP_KEY], upd_states[name],
+                        iteration, dp_mesh, dp_axis)
+                    new_params[name] = {FSDP_KEY: new_flat}
+                    new_upd[name] = us
                     continue
                 if dp_mesh is not None:
                     from deeplearning4j_tpu.parallel.zero import \
@@ -419,16 +457,23 @@ class ComputationGraph:
             donate_argnums=(0,))
 
     # ------------------------------------------------------------------
-    def set_dp_mesh(self, mesh, axis: str = "data"):
+    def set_dp_mesh(self, mesh, axis: str = "data", mode=None):
         """Install (or clear, with ``mesh=None``) the data-parallel mesh
-        the jitted step tail specializes on (ZeRO-1 sharded update —
-        ``parallel.zero``). Invalidates compiled steps; callers own
-        converting/placing ``updater_states`` to match."""
-        if mesh is self._dp_mesh and axis == self._dp_axis:
+        the jitted step tail specializes on (``parallel.zero``).
+        ``mode="fsdp"`` selects the ZeRO-3 tail: params convert to the
+        1/N flat resident layout here (the model owns both param and
+        updater-state conversion under fsdp); for the ZeRO-1 tail
+        callers still own converting/placing ``updater_states``.
+        Invalidates compiled steps."""
+        fsdp = (str(getattr(mode, "value", mode) or "").lower() == "fsdp"
+                and mesh is not None)
+        if mesh is self._dp_mesh and axis == self._dp_axis and \
+                fsdp == self._dp_fsdp:
             return self
         self.flush_accumulated()
         self._dp_mesh = mesh
         self._dp_axis = axis
+        self._dp_fsdp = fsdp
         self._train_step = None
         self._step_fn = None
         self._grad_step = None
@@ -436,6 +481,7 @@ class ComputationGraph:
         self._accum_add = None
         if hasattr(self, "_multi_steps"):
             del self._multi_steps
+        self._sync_param_layout()
         return self
 
     def set_accumulation_steps(self, n: int):
@@ -477,12 +523,57 @@ class ComputationGraph:
             self.updater_states = states_to_dense(self.params,
                                                   self.updater_states)
 
+    def _params_are_fsdp(self) -> bool:
+        from deeplearning4j_tpu.learning.updaters import is_fsdp
+        return any(is_fsdp(p) for p in self.params.values()
+                   if isinstance(p, dict))
+
+    def _sync_param_layout(self):
+        """Enter/leave the fsdp flat resident param layout
+        (parallel.zero). Entering converts updater state to the ZeRO-1
+        flat layout too (the fsdp tail consumes it) and places both at
+        1/N per replica; leaving densifies params (gather timed into
+        ``dl4j_fsdp_gather_seconds``)."""
+        flat = self._params_are_fsdp()
+        if self._dp_fsdp and self._dp_mesh is not None:
+            if flat:
+                return    # already resident; placement happened on entry
+            from deeplearning4j_tpu.parallel.zero import (
+                params_to_fsdp, place_fsdp_params, place_updater_states,
+                states_to_sharded)
+            n = self._dp_mesh.shape[self._dp_axis]
+            self.updater_states = states_to_sharded(
+                self.params, self.updater_states, n)
+            self.params, self._fsdp_specs = params_to_fsdp(self.params, n)
+            self.params = place_fsdp_params(self._dp_mesh, self.params,
+                                            self._dp_axis)
+            self.updater_states = place_updater_states(
+                self._dp_mesh, self.updater_states, self._dp_axis)
+        elif flat:
+            self._densify_params_inplace()
+
+    def _densify_params_inplace(self):
+        if self._params_are_fsdp():
+            from deeplearning4j_tpu.parallel.zero import params_to_dense
+            self.params = params_to_dense(self.params, self._fsdp_specs)
+            # specs kept: a later _sync_param_layout re-entry recomputes
+
+    def dense_params(self) -> dict:
+        """Params in the dense per-vertex layout regardless of residency
+        (non-mutating; under fsdp this is a full host-side all-gather —
+        checkpoint/inference/introspection consumers only)."""
+        if not self._params_are_fsdp():
+            return self.params
+        from deeplearning4j_tpu.parallel.zero import params_to_dense
+        return params_to_dense(self.params, self._fsdp_specs)
+
     # ------------------------------------------------------------------
     def fit(self, data, labels=None, *, n_epochs: int = 1):
         """fit(x, y) | fit(DataSet/MultiDataSet) | fit(iterator)."""
         if not self._initialized:
             self.init()
         self._sync_updater_layout()
+        self._sync_param_layout()
         if self._train_step is None:
             self._build_train_step()
         if labels is not None:
@@ -543,6 +634,9 @@ class ComputationGraph:
         if not self._initialized:
             self.init()
         self._sync_updater_layout()
+        # pretrain reads/writes per-vertex dense params directly; leave
+        # the flat layout (a later fit() re-enters it)
+        self._densify_params_inplace()
         v = self.conf.vertices[name]
         layer = v.content if v.is_layer else None
         if layer is None or not getattr(layer, "is_pretrainable",
@@ -620,6 +714,7 @@ class ComputationGraph:
         if not self._initialized:
             self.init()
         self._sync_updater_layout()
+        self._sync_param_layout()
         if self._train_step is None:
             self._build_train_step()
         if getattr(ds, "features_mask", None) is not None or \
@@ -816,7 +911,7 @@ class ComputationGraph:
             self.init()
         xs = [_as_jnp(x, self._dtype) for x in inputs]
         mask = _as_jnp(mask) if mask is not None else None
-        acts, _ = self._forward(self.params, self.states, xs,
+        acts, _ = self._forward(self.dense_params(), self.states, xs,
                                 training=train, rng=None,
                                 want_logits=False, fmask=mask)
         outs = [acts[n] for n in self.conf.network_outputs]
@@ -880,7 +975,7 @@ class ComputationGraph:
                 f"batch size {self._rnn_stream_batch}; call "
                 f"rnn_clear_previous_state() first")
         acts, new_states = self._forward(
-            self.params, self._rnn_stream_states, xs,
+            self.dense_params(), self._rnn_stream_states, xs,
             training=False, rng=None, want_logits=False)
         # keep persistent (BN) states as-is; update only rnn carries
         merged = dict(self._rnn_stream_states)
@@ -958,11 +1053,12 @@ class ComputationGraph:
         ys = [_as_jnp(y, self._dtype) for y in labs]
         lmasks = self._ds_lmasks(dataset)
         fmask = self._ds_fmask(dataset)
+        params = self.dense_params()
         acts, _ = self._forward(
-            self.params, self.states, xs, training=False, rng=None,
+            params, self.states, xs, training=False, rng=None,
             want_logits=True,
             fmask=_as_jnp(fmask) if fmask is not None else None)
-        loss = self._regularization(self.params)
+        loss = self._regularization(params)
         out_confs = self.output_layer_confs()
         for i, out_name in enumerate(self.conf.network_outputs):
             layer = out_confs.get(out_name)
@@ -993,22 +1089,24 @@ class ComputationGraph:
     # ------------------------------------------------------------------
     def num_params(self) -> int:
         return int(sum(np.prod(p.shape) for p in
-                       jax.tree_util.tree_leaves(self.params)))
+                       jax.tree_util.tree_leaves(self.dense_params())))
 
     def param_table(self) -> dict:
         out = {}
+        params = self.dense_params()
         for name in self._topo:
-            for pname, p in self.params.get(name, {}).items():
+            for pname, p in params.get(name, {}).items():
                 out[f"{name}_{pname}"] = p
         return out
 
     def summary(self) -> str:
         lines = [f"{'vertex':<28} {'type':<22} {'inputs':<28} {'params':<10}"]
         total = 0
+        params = self.dense_params()
         for name in self._topo:
             v = self.conf.vertices[name]
             n = int(sum(np.prod(p.shape)
-                        for p in self.params.get(name, {}).values()))
+                        for p in params.get(name, {}).values()))
             total += n
             lines.append(f"{name:<28} {type(v.content).__name__:<22} "
                          f"{','.join(v.inputs):<28} {n:<10}")

@@ -64,6 +64,12 @@ class MultiLayerNetwork:
         # installed the step tail runs the updater on 1/N param shards
         self._dp_mesh = None
         self._dp_axis = "data"
+        # full FSDP / ZeRO-3 (parallel.zero): params live as 1/N flat
+        # shards ({FSDP_KEY: {dtype: flat}} per layer), gathered
+        # per-layer just-in-time in the forward; _fsdp_specs keeps the
+        # per-layer DpFlatSpec needed to densify
+        self._dp_fsdp = False
+        self._fsdp_specs = {}
         # gradient accumulation (reference: GradientsAccumulator)
         self._accum_steps = 1
         self._accum_grads = None
@@ -133,7 +139,11 @@ class MultiLayerNetwork:
             # f32, the standard mixed-precision rule.
             from deeplearning4j_tpu.common.dtypes import cast_floats
             cd = conf.compute_dtype
-            params = cast_floats(params, cd)
+            # an FsdpParamView casts per-layer post-gather (gathering
+            # the master dtype then casting would defeat nothing, but
+            # the view must stay a view to keep gathers just-in-time)
+            params = (params.cast(cd) if hasattr(params, "cast")
+                      else cast_floats(params, cd))
             x = cast_floats(x, cd)
         new_states = {}
         h = x
@@ -261,10 +271,27 @@ class MultiLayerNetwork:
         updaters = [(layer.updater or conf.updater)
                     for layer in conf.layers]
 
+        gn = conf.gradient_normalization
+        thr = conf.gradient_normalization_threshold
+        dp_mesh, dp_axis = self._dp_mesh, self._dp_axis
+        fsdp = self._dp_fsdp and dp_mesh is not None
+        if fsdp:
+            from deeplearning4j_tpu.common.environment import Environment
+            from deeplearning4j_tpu.parallel.zero import FsdpParamView
+            fsdp_specs = dict(self._fsdp_specs)
+            fsdp_prefetch = Environment.get().fsdp_prefetch
+            layer_order = [f"layer_{i}" for i in range(len(conf.layers))]
+
         def loss_fn(params, states, x, y, fmask, lmask, rng):
             # fmask: per-timestep features mask (recurrent/pooling hold);
             # lmask: labels mask (loss exclusion) — distinct, as in the
             # reference (featuresMaskArray vs labelsMaskArray)
+            if fsdp:
+                # lazy view over the 1/N flat shards: each layer's
+                # all-gather is emitted at its point of use in the walk
+                params = FsdpParamView(params, fsdp_specs, dp_mesh,
+                                       dp_axis, order=layer_order,
+                                       prefetch=fsdp_prefetch)
             out, new_states = self._forward(params, states, x,
                                             training=True, rng=rng,
                                             want_logits=True, mask=fmask)
@@ -272,10 +299,6 @@ class MultiLayerNetwork:
                                                from_logits=want_logits,
                                                mask=lmask)
             return data_loss + self._regularization(params), new_states
-
-        gn = conf.gradient_normalization
-        thr = conf.gradient_normalization_threshold
-        dp_mesh, dp_axis = self._dp_mesh, self._dp_axis
 
         # numerics watchdog (common.diagnostics): when armed, the step
         # also emits the global grad norm — computed in-jit, fused into
@@ -299,7 +322,10 @@ class MultiLayerNetwork:
             and the accumulation apply step. With a dp mesh installed
             the updater runs ZeRO-1 sharded (parallel.zero; the
             resolver guarantees gradient_normalization NONE there, so
-            skipping it is exact)."""
+            skipping it is exact). Under fsdp params/grads are already
+            the 1/N flat shards and stay that way — no trailing
+            all-gather (constraints skipped: the resolver refuses fsdp
+            when any layer has them)."""
             new_params, new_upd = {}, {}
             for i, up in enumerate(updaters):
                 k = f"layer_{i}"
@@ -307,6 +333,17 @@ class MultiLayerNetwork:
                 if not g:
                     new_params[k] = params.get(k, {})
                     new_upd[k] = upd_states.get(k, ())
+                    continue
+                if fsdp:
+                    from deeplearning4j_tpu.learning.updaters import \
+                        FSDP_KEY
+                    from deeplearning4j_tpu.parallel.zero import \
+                        apply_update_fsdp
+                    new_flat, us = apply_update_fsdp(
+                        up, g[FSDP_KEY], params[k][FSDP_KEY],
+                        upd_states[k], iteration, dp_mesh, dp_axis)
+                    new_params[k] = {FSDP_KEY: new_flat}
+                    new_upd[k] = us
                     continue
                 if dp_mesh is not None:
                     from deeplearning4j_tpu.parallel.zero import \
@@ -361,16 +398,23 @@ class MultiLayerNetwork:
             donate_argnums=(0,))
 
     # ------------------------------------------------------------------
-    def set_dp_mesh(self, mesh, axis: str = "data"):
+    def set_dp_mesh(self, mesh, axis: str = "data", mode=None):
         """Install (or clear, with ``mesh=None``) the data-parallel mesh
-        the jitted step tail specializes on (ZeRO-1 sharded update —
-        ``parallel.zero``). Invalidates compiled steps; callers own
-        converting/placing ``updater_states`` to match."""
-        if mesh is self._dp_mesh and axis == self._dp_axis:
+        the jitted step tail specializes on (``parallel.zero``).
+        ``mode="fsdp"`` selects the ZeRO-3 tail: params convert to the
+        1/N flat resident layout here (the model owns both param and
+        updater-state conversion under fsdp); for the ZeRO-1 tail
+        callers still own converting/placing ``updater_states``.
+        Invalidates compiled steps."""
+        fsdp = (str(getattr(mode, "value", mode) or "").lower() == "fsdp"
+                and mesh is not None)
+        if mesh is self._dp_mesh and axis == self._dp_axis and \
+                fsdp == self._dp_fsdp:
             return self
         self.flush_accumulated()
         self._dp_mesh = mesh
         self._dp_axis = axis
+        self._dp_fsdp = fsdp
         self._train_step = None
         self._step_fn = None
         self._grad_step = None
@@ -378,6 +422,7 @@ class MultiLayerNetwork:
         self._accum_add = None
         if hasattr(self, "_multi_steps"):
             del self._multi_steps
+        self._sync_param_layout()
         return self
 
     def set_accumulation_steps(self, n: int):
@@ -419,12 +464,57 @@ class MultiLayerNetwork:
             self.updater_states = states_to_dense(self.params,
                                                   self.updater_states)
 
+    def _params_are_fsdp(self) -> bool:
+        from deeplearning4j_tpu.learning.updaters import is_fsdp
+        return any(is_fsdp(p) for p in self.params.values()
+                   if isinstance(p, dict))
+
+    def _sync_param_layout(self):
+        """Enter/leave the fsdp flat resident param layout
+        (parallel.zero). Entering converts updater state to the ZeRO-1
+        flat layout too (the fsdp tail consumes it) and places both at
+        1/N per replica; leaving densifies params (gather timed into
+        ``dl4j_fsdp_gather_seconds``)."""
+        flat = self._params_are_fsdp()
+        if self._dp_fsdp and self._dp_mesh is not None:
+            if flat:
+                return    # already resident; placement happened on entry
+            from deeplearning4j_tpu.parallel.zero import (
+                params_to_fsdp, place_fsdp_params, place_updater_states,
+                states_to_sharded)
+            n = self._dp_mesh.shape[self._dp_axis]
+            self.updater_states = states_to_sharded(
+                self.params, self.updater_states, n)
+            self.params, self._fsdp_specs = params_to_fsdp(self.params, n)
+            self.params = place_fsdp_params(self._dp_mesh, self.params,
+                                            self._dp_axis)
+            self.updater_states = place_updater_states(
+                self._dp_mesh, self.updater_states, self._dp_axis)
+        elif flat:
+            self._densify_params_inplace()
+
+    def _densify_params_inplace(self):
+        if self._params_are_fsdp():
+            from deeplearning4j_tpu.parallel.zero import params_to_dense
+            self.params = params_to_dense(self.params, self._fsdp_specs)
+            # specs kept: a later _sync_param_layout re-entry recomputes
+
+    def dense_params(self) -> dict:
+        """Params in the dense per-layer layout regardless of residency
+        (non-mutating; under fsdp this is a full host-side all-gather —
+        checkpoint/inference/introspection consumers only)."""
+        if not self._params_are_fsdp():
+            return self.params
+        from deeplearning4j_tpu.parallel.zero import params_to_dense
+        return params_to_dense(self.params, self._fsdp_specs)
+
     # ------------------------------------------------------------------
     def fit(self, data, labels=None, *, n_epochs: int = 1):
         """fit(x, y) | fit(DataSet) | fit(iterator[, n_epochs])."""
         if not self._initialized:
             self.init()
         self._sync_updater_layout()
+        self._sync_param_layout()
         if self._train_step is None:
             self._build_train_step()
         if labels is not None:
@@ -472,6 +562,7 @@ class MultiLayerNetwork:
         if not self._initialized:
             self.init()
         self._sync_updater_layout()
+        self._sync_param_layout()
         if self._train_step is None:
             self._build_train_step()
         if getattr(ds, "features_mask", None) is not None or \
@@ -549,6 +640,9 @@ class MultiLayerNetwork:
         if not self._initialized:
             self.init()
         self._sync_updater_layout()
+        # pretrain reads/writes per-layer dense params directly; leave
+        # the flat layout (a later fit() re-enters it)
+        self._densify_params_inplace()
         layer = self.conf.layers[idx]
         if not getattr(layer, "is_pretrainable", lambda: False)():
             raise ValueError(f"layer {idx} is not pretrainable")
@@ -743,8 +837,8 @@ class MultiLayerNetwork:
                 f"state batch size {self._rnn_stream_batch}; call "
                 f"rnn_clear_previous_state() first")
         out, new_states = self._forward(
-            self.params, self._rnn_stream_states, x, training=False,
-            rng=None, want_logits=False)
+            self.dense_params(), self._rnn_stream_states, x,
+            training=False, rng=None, want_logits=False)
         # keep persistent (BN) states as-is; update only the rnn carries
         merged = dict(self._rnn_stream_states)
         for k in self._recurrent_keys():
@@ -769,7 +863,7 @@ class MultiLayerNetwork:
             self.init()
         x = _as_jnp(x, self._dtype)
         mask = _as_jnp(mask) if mask is not None else None
-        out, _ = self._forward(self.params, self.states, x,
+        out, _ = self._forward(self.dense_params(), self.states, x,
                                training=train, rng=None,
                                want_logits=False, mask=mask)
         return out
@@ -779,7 +873,7 @@ class MultiLayerNetwork:
         if not self._initialized:
             self.init()
         x = _as_jnp(x, self._dtype)
-        params = self.params
+        params = self.dense_params()
         if self.conf.compute_dtype:
             # same dtype path as fit()/output() — per-layer activations
             # must match what the trained/predicted path computes
@@ -814,11 +908,12 @@ class MultiLayerNetwork:
         mask = _as_jnp(mask) if mask is not None else None
         out_layer = self.output_layer_conf
         want_logits = out_layer.wants_logits()
-        out, _ = self._forward(self.params, self.states, x, training=False,
+        params = self.dense_params()
+        out, _ = self._forward(params, self.states, x, training=False,
                                rng=None, want_logits=True)
         loss = out_layer.compute_loss(y, out, from_logits=want_logits,
                                       mask=mask)
-        return float(loss + self._regularization(self.params))
+        return float(loss + self._regularization(params))
 
     # ------------------------------------------------------------------
     def evaluate(self, iterator):
@@ -848,13 +943,14 @@ class MultiLayerNetwork:
     # ------------------------------------------------------------------
     def num_params(self) -> int:
         return int(sum(np.prod(p.shape) for p in
-                       jax.tree_util.tree_leaves(self.params)))
+                       jax.tree_util.tree_leaves(self.dense_params())))
 
     def param_table(self) -> dict:
         """{"0_W": array, ...} — reference paramTable naming."""
         out = {}
+        params = self.dense_params()
         for i in range(self.n_layers()):
-            for name, p in self.params.get(f"layer_{i}", {}).items():
+            for name, p in params.get(f"layer_{i}", {}).items():
                 out[f"{i}_{name}"] = p
             for name, s in (self.states.get(f"layer_{i}") or {}).items():
                 out[f"{i}_{name}"] = s
@@ -862,9 +958,10 @@ class MultiLayerNetwork:
 
     def get_param(self, key: str):
         i, name = key.split("_", 1)
-        return self.params[f"layer_{i}"][name]
+        return self.dense_params()[f"layer_{i}"][name]
 
     def set_params_from_table(self, table: dict):
+        self._densify_params_inplace()
         for k, v in table.items():
             i, name = k.split("_", 1)
             lk = f"layer_{i}"
@@ -882,7 +979,8 @@ class MultiLayerNetwork:
         net = MultiLayerNetwork(copy.deepcopy(self.conf))
         if self._initialized:
             net.init()
-            net.params = jax.tree_util.tree_map(lambda a: a, self.params)
+            net.params = jax.tree_util.tree_map(lambda a: a,
+                                                self.dense_params())
             net.states = jax.tree_util.tree_map(lambda a: a, self.states)
             net.updater_states = jax.tree_util.tree_map(
                 lambda a: a, self.updater_states)
@@ -891,9 +989,10 @@ class MultiLayerNetwork:
     def summary(self) -> str:
         lines = [f"{'idx':<4} {'type':<24} {'nIn->nOut':<14} {'params':<10}"]
         total = 0
+        params = self.dense_params()
         for i, layer in enumerate(self.conf.layers):
             n = int(sum(np.prod(p.shape) for p in
-                        self.params.get(f"layer_{i}", {}).values()))
+                        params.get(f"layer_{i}", {}).values()))
             total += n
             lines.append(f"{i:<4} {type(layer).__name__:<24} "
                          f"{layer.n_in}->{layer.n_out:<10} {n:<10}")

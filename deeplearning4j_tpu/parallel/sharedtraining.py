@@ -57,7 +57,8 @@ class SharedTrainingConfiguration:
     residual_post_processor: object = None
     # how replicas exchange the weight update: 'dense' (AllReduce +
     # replicated update), 'sharded' (ZeRO-1 ReduceScatter/AllGather —
-    # parallel.zero), 'auto' (sharded whenever legal)
+    # parallel.zero), 'fsdp' (ZeRO-3: params resident 1/N with
+    # just-in-time per-layer gathers), 'auto' (sharded whenever legal)
     update_exchange: str = "auto"
     # updater applies every N micro-batches on the mean gradient
     # (reference: GradientsAccumulator)
@@ -99,8 +100,8 @@ class SharedTrainingMaster:
             return self
 
         def update_exchange(self, mode):
-            """'dense' | 'sharded' | 'auto' — validated eagerly so a
-            typo fails at build time, not first fit."""
+            """'dense' | 'sharded' | 'fsdp' | 'auto' — validated eagerly
+            so a typo fails at build time, not first fit."""
             from deeplearning4j_tpu.parallel.zero import UpdateExchange
             self._c.update_exchange = UpdateExchange(
                 mode.lower() if isinstance(mode, str) else mode).value
@@ -181,7 +182,7 @@ class SharedTrainingMaster:
                      "compression transform (parallel.encoding), not "
                      "the update exchange; the exchange is governed by "
                      "update_exchange=%r (dense AllReduce | ZeRO-1 "
-                     "sharded ReduceScatter/AllGather)",
+                     "sharded ReduceScatter/AllGather | ZeRO-3 fsdp)",
                      self.config.update_exchange)
         mesh = self._global_mesh()
         from deeplearning4j_tpu.parallel.zero import \

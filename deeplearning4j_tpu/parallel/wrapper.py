@@ -64,11 +64,12 @@ class ParallelWrapper:
         self.averaging_frequency = averaging_frequency  # API parity only
         self.report_score = report_score_after_averaging
         self.accumulation_steps = max(int(accumulation_steps), 1)
-        #: requested exchange ('auto'|'dense'|'sharded'); resolved to
-        #: the effective UpdateExchange at placement time
+        #: requested exchange ('auto'|'dense'|'sharded'|'fsdp');
+        #: resolved to the effective UpdateExchange at placement time
         self.requested_exchange = update_exchange
         self.update_exchange = None
         self._exchange_bytes = 0
+        self._fsdp_gather_bytes = 0
         self._placed = False
         if averaging_frequency != 1:
             log.info("averagingFrequency=%d ignored: pjit DP is exactly "
@@ -109,8 +110,11 @@ class ParallelWrapper:
             return self
 
         def update_exchange(self, mode) -> "ParallelWrapper.Builder":
-            """'dense' | 'sharded' | 'auto' (zero.UpdateExchange):
-            how replicas exchange the weight update."""
+            """'dense' | 'sharded' | 'fsdp' | 'auto'
+            (zero.UpdateExchange): how replicas exchange the weight
+            update. 'fsdp' (ZeRO-3) additionally keeps params + grads
+            resident 1/N per replica with per-layer just-in-time
+            all-gather — opt-in; 'auto' resolves to 'sharded'."""
             from deeplearning4j_tpu.parallel.zero import UpdateExchange
             self._exchange = UpdateExchange(
                 mode.lower() if isinstance(mode, str) else mode)
@@ -161,18 +165,42 @@ class ParallelWrapper:
         mode = resolve_update_exchange(self.mesh, self.data_axis,
                                        self.requested_exchange, m)
         self.update_exchange = mode
-        m.params = replicate_tree(self.mesh, m.params)
-        m.states = replicate_tree(self.mesh, m.states)
-        if hasattr(m, "set_dp_mesh"):
-            m.set_dp_mesh(self.mesh if mode is UpdateExchange.SHARDED
-                          else None, self.data_axis)
+        import numpy as np
+        # wire accounting while params are still in the dense layout
+        # (the fsdp conversion below folds them into padded flats)
+        n = self.n_workers
+        param_bytes = sum(
+            int(np.prod(a.shape)) * a.dtype.itemsize
+            for a in jax.tree_util.tree_leaves(m.params)
+            if hasattr(a, "shape"))
+        self._exchange_bytes = update_exchange_bytes(m.params, n, mode)
+        self._fsdp_gather_bytes = (
+            int((n - 1) * param_bytes / n) if n > 1 else 0)
+        if mode is UpdateExchange.FSDP and not hasattr(m, "set_dp_mesh"):
+            log.info("%s has no set_dp_mesh; fsdp request lowers to "
+                     "dense", type(m).__name__)
+            mode = self.update_exchange = UpdateExchange.DENSE
+        if mode is UpdateExchange.FSDP:
+            # ZeRO-3: the model owns param + updater-state conversion
+            # and placement (1/N flat shards per replica) — params are
+            # NOT replicated here, that would defeat the residency win
+            m.states = replicate_tree(self.mesh, m.states)
+            m.set_dp_mesh(self.mesh, self.data_axis, mode="fsdp")
+        else:
+            m.params = replicate_tree(self.mesh, m.params)
+            m.states = replicate_tree(self.mesh, m.states)
+            if hasattr(m, "set_dp_mesh"):
+                m.set_dp_mesh(self.mesh if mode is UpdateExchange.SHARDED
+                              else None, self.data_axis)
         if hasattr(m, "set_accumulation_steps"):
             m.set_accumulation_steps(self.accumulation_steps)
         elif self.accumulation_steps > 1:
             log.warning("accumulation_steps=%d ignored: %s has no "
                         "gradient accumulation support",
                         self.accumulation_steps, type(m).__name__)
-        if mode is UpdateExchange.SHARDED:
+        if mode is UpdateExchange.FSDP:
+            pass    # set_dp_mesh(mode="fsdp") placed the updater state
+        elif mode is UpdateExchange.SHARDED:
             m.updater_states = place_updater_states(
                 self.mesh,
                 states_to_sharded(m.params, m.updater_states,
@@ -183,8 +211,6 @@ class ParallelWrapper:
             # restored ZeRO-1 checkpoint) converts back to dense first
             m.updater_states = replicate_tree(
                 self.mesh, states_to_dense(m.params, m.updater_states))
-        self._exchange_bytes = update_exchange_bytes(m.params,
-                                                     self.n_workers)
         self._placed = True
 
     def _shard(self, a):
@@ -282,6 +308,13 @@ class ParallelWrapper:
                         "estimated per-replica wire bytes moved by the "
                         "in-step update exchange (ring collectives)"
                     ).inc(self._exchange_bytes, mode=mode)
+                    if mode == "fsdp":
+                        telemetry.counter(
+                            "dl4j_fsdp_gather_bytes_total",
+                            "estimated per-replica wire bytes moved by "
+                            "the per-layer just-in-time fsdp param "
+                            "all-gathers (ring model, analytic)"
+                        ).inc(self._fsdp_gather_bytes, workers=n)
                 else:
                     self.model.fit(ds)
             if hasattr(self.model, "flush_accumulated"):

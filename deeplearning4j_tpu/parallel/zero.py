@@ -19,21 +19,36 @@ the all-gather.  Per-element arithmetic is identical to the dense path,
 so SGD results stay bitwise equal and stateful updaters agree to float
 tolerance.
 
-Kill switch: ``DL4J_TPU_SHARDED_UPDATE=0`` (common.environment) forces
-the dense tail everywhere, restoring the exact pre-ZeRO behavior.
+Full FSDP (ZeRO-3) extends this to parameters and gradients: params
+stay resident as the 1/N flat shard (``{FSDP_KEY: {dtype: flat}}``),
+the forward all-gathers each layer's flats just-in-time through a
+``custom_vjp`` gather whose transpose pins the cotangent back to
+``P(axis)`` — so gradients are born reduce-scattered and a full grad
+never materializes — and the update tail keeps the new flat params
+pinned to the shard (no trailing all-gather). Per-chip residency for
+params + grads + updater state drops to ~1/N; the wire total per step
+is unchanged (param AllGather + grad ReduceScatter = one AllReduce).
+
+Kill switches: ``DL4J_TPU_SHARDED_UPDATE=0`` (common.environment)
+forces the dense tail everywhere, restoring the exact pre-ZeRO
+behavior; ``DL4J_TPU_FSDP=0`` demotes fsdp requests to ZeRO-1;
+``DL4J_TPU_FSDP_PREFETCH=0`` disables the layer k+1 gather prefetch.
 """
 from __future__ import annotations
 
 import enum
+import functools
 import logging
+import time
 from typing import Dict
 
 import jax
 import numpy as np
 
-from deeplearning4j_tpu.learning.updaters import (DP_SHARDED_KEY, dp_ravel,
-                                                  dp_flatten_spec, dp_unravel,
-                                                  is_dp_sharded)
+from deeplearning4j_tpu.learning.updaters import (DP_SHARDED_KEY, FSDP_KEY,
+                                                  dp_ravel, dp_flatten_spec,
+                                                  dp_unravel, is_dp_sharded,
+                                                  is_fsdp)
 from deeplearning4j_tpu.parallel.mesh import (DEFAULT_DATA_AXIS,
                                               flat_sharding, replicated)
 
@@ -44,21 +59,47 @@ class UpdateExchange(str, enum.Enum):
     """How replicas exchange the weight update (the successor of the
     reference's threshold-encoding `TrainingMode` stance): ``dense`` =
     AllReduce + fully replicated update, ``sharded`` = ZeRO-1
-    ReduceScatter/AllGather, ``auto`` = sharded whenever legal."""
+    ReduceScatter/AllGather (updater state resident 1/N), ``fsdp`` =
+    ZeRO-3 (params + grads + state resident 1/N, per-layer just-in-time
+    param all-gather), ``auto`` = sharded whenever legal (fsdp is
+    opt-in only: it trades gather latency for residency)."""
     DENSE = "dense"
     SHARDED = "sharded"
+    FSDP = "fsdp"
     AUTO = "auto"
+
+
+#: attrs nn.conf.constraints.apply_constraints keys off; any of them
+#: set on a layer conf means the step tail must see full tensors
+#: post-update, which the fsdp tail (params never gathered after the
+#: update) cannot provide
+_CONSTRAINT_ATTRS = ("constrain_weights", "constrain_bias",
+                     "constrain_all", "constrain_params")
+
+
+def _has_weight_constraints(model) -> bool:
+    conf = getattr(model, "conf", None)
+    layers = list(getattr(conf, "layers", None) or [])
+    for v in (getattr(conf, "vertices", None) or {}).values():
+        if getattr(v, "is_layer", False) and v.content is not None:
+            layers.append(v.content)
+    return any(getattr(layer, a, None)
+               for layer in layers for a in _CONSTRAINT_ATTRS)
 
 
 def resolve_update_exchange(mesh, axis: str = DEFAULT_DATA_AXIS,
                             requested=UpdateExchange.AUTO,
                             model=None) -> UpdateExchange:
-    """Resolve ``auto``/validate a request down to DENSE or SHARDED.
+    """Resolve ``auto``/validate a request down to DENSE, SHARDED or
+    FSDP.
 
-    DENSE whenever the sharded tail cannot apply: env kill switch off,
-    no mesh / dp axis of 1 (nothing to shard across), or the model uses
+    DENSE whenever no sharded tail can apply: env kill switch off, no
+    mesh / dp axis of 1 (nothing to shard across), or the model uses
     norm-based gradient normalization (it needs the full summed
-    gradient before any slicing)."""
+    gradient before any slicing). An explicit FSDP request additionally
+    falls back to SHARDED when ``DL4J_TPU_FSDP=0`` or the model carries
+    weight constraints (the post-update projection needs full
+    tensors)."""
     if isinstance(requested, str):
         try:
             requested = UpdateExchange(requested.lower())
@@ -67,10 +108,12 @@ def resolve_update_exchange(mesh, axis: str = DEFAULT_DATA_AXIS,
                 f"unknown update_exchange {requested!r}; expected one "
                 f"of {[e.value for e in UpdateExchange]}") from None
     from deeplearning4j_tpu.common.environment import Environment
-    if not Environment.get().sharded_update:
-        if requested is UpdateExchange.SHARDED:
-            log.info("update_exchange=sharded requested but "
-                     "DL4J_TPU_SHARDED_UPDATE=0; using dense")
+    env = Environment.get()
+    if not env.sharded_update:
+        if requested in (UpdateExchange.SHARDED, UpdateExchange.FSDP):
+            log.info("update_exchange=%s requested but "
+                     "DL4J_TPU_SHARDED_UPDATE=0; using dense",
+                     requested.value)
         return UpdateExchange.DENSE
     if requested is UpdateExchange.DENSE:
         return UpdateExchange.DENSE
@@ -83,6 +126,17 @@ def resolve_update_exchange(mesh, axis: str = DEFAULT_DATA_AXIS,
             log.info("gradient_normalization=%s needs the full summed "
                      "gradient; update exchange stays dense", gn.name)
             return UpdateExchange.DENSE
+    if requested is UpdateExchange.FSDP:
+        if not env.fsdp:
+            log.info("update_exchange=fsdp requested but DL4J_TPU_FSDP=0;"
+                     " using sharded (ZeRO-1)")
+            return UpdateExchange.SHARDED
+        if model is not None and _has_weight_constraints(model):
+            log.info("model has weight constraints (post-update "
+                     "projection needs full tensors); update exchange "
+                     "falls back to sharded (ZeRO-1)")
+            return UpdateExchange.SHARDED
+        return UpdateExchange.FSDP
     return UpdateExchange.SHARDED
 
 
@@ -121,6 +175,212 @@ def apply_update_sharded(updater, grads, params, state, iteration, mesh,
     new_state = ({DP_SHARDED_KEY: new_inner} if is_dp_sharded(state)
                  else new_inner)
     return new_params, new_state
+
+
+# -- FSDP (ZeRO-3) -----------------------------------------------------------
+@functools.partial(jax.custom_vjp, nondiff_argnums=(1, 2))
+def _gather_flats(flats, mesh, axis):
+    """All-gather a layer's flat param shards to replicated.
+
+    The custom vjp exists because ``with_sharding_constraint``'s
+    transpose pins the cotangent to the SAME sharding — a plain
+    replicated pin would force the gradient to replicate (all-reduce,
+    full grad resident). Here the backward pins the cotangent to
+    ``P(axis)`` instead, so the partitioner lowers the pending
+    cross-replica gradient sum to a reduce-scatter and each replica
+    only ever holds its 1/N grad shard."""
+    full = replicated(mesh)
+    return {k: jax.lax.with_sharding_constraint(v, full)
+            for k, v in flats.items()}
+
+
+def _gather_flats_fwd(flats, mesh, axis):
+    return _gather_flats(flats, mesh, axis), None
+
+
+def _gather_flats_bwd(mesh, axis, _res, ct):
+    shard = flat_sharding(mesh, axis)
+    return ({k: jax.lax.with_sharding_constraint(v, shard)
+             for k, v in ct.items()},)
+
+
+_gather_flats.defvjp(_gather_flats_fwd, _gather_flats_bwd)
+
+
+def fsdp_gather(flats, spec, mesh, axis: str = DEFAULT_DATA_AXIS,
+                cast_dtype=None):
+    """One layer's flat shards -> dense param dict, traced inside the
+    caller's jit (the just-in-time all-gather). ``cast_dtype`` applies
+    the mixed-precision compute cast per-layer, post-gather."""
+    dense = dp_unravel(_gather_flats(flats, mesh, axis), spec)
+    if cast_dtype is not None:
+        from deeplearning4j_tpu.common.dtypes import cast_floats
+        dense = cast_floats(dense, cast_dtype)
+    return dense
+
+
+class FsdpParamView:
+    """Trace-time lazy mapping over an fsdp-flat param tree.
+
+    The step builders hand this to ``_forward`` in place of the dense
+    param dict; each ``get(key)`` on an fsdp entry emits that layer's
+    all-gather at its point of use, so gathers interleave with compute
+    in program order instead of front-loading the full param tree.
+    With ``prefetch`` the next layer's gather (in ``order``) is also
+    emitted when layer k is touched, giving XLA's scheduler the room to
+    overlap it with layer k's compute. ``cast`` mirrors
+    ``dtypes.cast_floats`` for the compute-dtype path."""
+
+    def __init__(self, params, specs, mesh, axis=DEFAULT_DATA_AXIS,
+                 order=None, prefetch=True, cast_dtype=None):
+        self._params = params
+        self._specs = specs
+        self._mesh = mesh
+        self._axis = axis
+        self._order = [k for k in (params if order is None else order)
+                       if is_fsdp(params.get(k, {}))]
+        self._prefetch = prefetch
+        self._cast_dtype = cast_dtype
+        self._cache = {}
+
+    def cast(self, dtype):
+        return FsdpParamView(self._params, self._specs, self._mesh,
+                             self._axis, order=self._order,
+                             prefetch=self._prefetch, cast_dtype=dtype)
+
+    def _dense(self, key):
+        if key not in self._cache:
+            self._cache[key] = fsdp_gather(
+                self._params[key][FSDP_KEY], self._specs[key],
+                self._mesh, self._axis, cast_dtype=self._cast_dtype)
+        return self._cache[key]
+
+    def get(self, key, default=None):
+        sub = self._params.get(key, default)
+        if not is_fsdp(sub):
+            if self._cast_dtype is not None and sub:
+                from deeplearning4j_tpu.common.dtypes import cast_floats
+                return cast_floats(sub, self._cast_dtype)
+            return sub
+        dense = self._dense(key)
+        if self._prefetch and key in self._order:
+            i = self._order.index(key)
+            if i + 1 < len(self._order):
+                self._dense(self._order[i + 1])
+        return dense
+
+    def __getitem__(self, key):
+        if key not in self._params:
+            raise KeyError(key)
+        return self.get(key)
+
+    def __contains__(self, key):
+        return key in self._params
+
+    def keys(self):
+        return self._params.keys()
+
+
+def params_to_fsdp(params: Dict, n_shards: int):
+    """Model params -> per-entry fsdp flat layout. Returns
+    ``(flat_params, specs)``; empty/already-flat entries pass through
+    (and keep no spec)."""
+    out, specs = {}, {}
+    for k, sub in params.items():
+        if not sub or is_fsdp(sub):
+            out[k] = sub
+            continue
+        flats, spec = dp_ravel(sub, n_shards)
+        out[k] = {FSDP_KEY: flats}
+        specs[k] = spec
+    return out, specs
+
+
+def params_to_dense(params: Dict, specs: Dict) -> Dict:
+    """Inverse of :func:`params_to_fsdp` (padding dropped). Runs on the
+    host at layout-sync boundaries (checkpoint, inference outside the
+    jitted step, mesh teardown); the gather wall time lands in the
+    ``dl4j_fsdp_gather_seconds`` histogram."""
+    if not any(is_fsdp(s) for s in params.values()
+               if isinstance(s, dict)):
+        return params
+    t0 = time.perf_counter()
+    out = {}
+    for k, sub in params.items():
+        out[k] = dp_unravel(sub[FSDP_KEY], specs[k]) if is_fsdp(sub) else sub
+    for leaf in jax.tree_util.tree_leaves(out):
+        if hasattr(leaf, "block_until_ready"):
+            leaf.block_until_ready()
+    from deeplearning4j_tpu.common import telemetry
+    if telemetry.enabled():
+        telemetry.histogram(
+            "dl4j_fsdp_gather_seconds",
+            "host-observed wall time of a full fsdp param densify "
+            "(all-gather + unravel) at a layout-sync boundary"
+        ).observe(time.perf_counter() - t0)
+    return out
+
+
+def place_fsdp_params(mesh, params: Dict,
+                      axis: str = DEFAULT_DATA_AXIS) -> Dict:
+    """Device-put fsdp params on the mesh: flat entries along
+    ``P(axis)`` (1/N resident per replica — the ZeRO-3 win), non-fsdp
+    entries replicated. Sets the ``dl4j_fsdp_param_shard_bytes``
+    residency gauge."""
+    shard = flat_sharding(mesh, axis)
+    full = replicated(mesh)
+    n = mesh.shape.get(axis, 1)
+    out, flat_bytes = {}, 0
+    from deeplearning4j_tpu.common.diagnostics import collective_span
+    with collective_span("fsdp_param_placement", axis, 0,
+                         entries=len(params)):
+        for k, sub in params.items():
+            if is_fsdp(sub):
+                flats = {dt: jax.device_put(v, shard)
+                         for dt, v in sub[FSDP_KEY].items()}
+                flat_bytes += sum(int(np.prod(v.shape)) * v.dtype.itemsize
+                                  for v in flats.values())
+                out[k] = {FSDP_KEY: flats}
+            else:
+                out[k] = jax.tree_util.tree_map(
+                    lambda a: (jax.device_put(a, full)
+                               if hasattr(a, "shape") else a), sub)
+    from deeplearning4j_tpu.common import telemetry
+    if telemetry.enabled():
+        telemetry.gauge(
+            "dl4j_fsdp_param_shard_bytes",
+            "per-replica resident bytes of the fsdp flat parameter "
+            "shards (1/N of the flat param total)"
+        ).set(flat_bytes // max(n, 1))
+    return out
+
+
+def apply_update_fsdp(updater, flat_g, flat_p, state, iteration, mesh,
+                      axis: str = DEFAULT_DATA_AXIS, *, epoch=0):
+    """The ZeRO-3 step tail for one entry's flat shards, traced inside
+    the caller's jit. Unlike :func:`apply_update_sharded` the inputs
+    are already flat (grads arrive as the reduce-scattered cotangent of
+    :func:`_gather_flats`) and the new params stay pinned to
+    ``P(axis)`` — there is no trailing all-gather; the next step's
+    forward re-gathers per-layer."""
+    shard = flat_sharding(mesh, axis)
+
+    def pin(tree):
+        return jax.tree_util.tree_map(
+            lambda a: jax.lax.with_sharding_constraint(a, shard), tree)
+
+    flat_g = pin(flat_g)
+    flat_p = pin(flat_p)
+    inner = state[DP_SHARDED_KEY] if is_dp_sharded(state) else state
+    inner = pin(inner)
+    updates, new_inner = updater.apply(flat_g, inner, iteration, epoch)
+    new_flat = {k: (flat_p[k] - updates[k]).astype(flat_p[k].dtype)
+                for k in flat_p}
+    new_flat = pin(new_flat)     # params stay 1/N resident: no all-gather
+    new_inner = pin(new_inner)
+    new_state = ({DP_SHARDED_KEY: new_inner} if is_dp_sharded(state)
+                 else new_inner)
+    return new_flat, new_state
 
 
 # -- layout conversions ------------------------------------------------------
@@ -182,11 +442,15 @@ def place_updater_states(mesh, states: Dict,
 
 
 # -- accounting --------------------------------------------------------------
-def update_exchange_bytes(params, n_shards: int) -> int:
-    """Per-replica wire bytes one update exchange moves (ring
-    collectives): dense AllReduce = 2(N-1)/N * P bytes; the sharded
-    ReduceScatter + AllGather pair moves the same total — the ZeRO-1
-    win is HBM residency and update-phase HBM traffic, not wire bytes."""
+def update_exchange_bytes(params, n_shards: int, mode=None) -> int:
+    """Per-replica wire bytes one applied update exchange moves (ring
+    collectives). All three modes move the same total: dense AllReduce
+    = 2(N-1)/N * P bytes; sharded ReduceScatter + AllGather = the same
+    pair; fsdp's per-layer param AllGather ((N-1)/N * P across the
+    step) + grad ReduceScatter ((N-1)/N * P) also sum to it.  The
+    ZeRO wins are HBM residency and update-phase HBM traffic, not wire
+    bytes — ``mode`` is accepted so callers can be explicit, and the
+    per-mode breakdown lives in :func:`exchange_report`."""
     total = sum(int(np.prod(a.shape)) * a.dtype.itemsize
                 for a in jax.tree_util.tree_leaves(params)
                 if hasattr(a, "shape"))
@@ -198,20 +462,33 @@ def update_exchange_bytes(params, n_shards: int) -> int:
 def exchange_report(params, n_shards: int, mode=None) -> dict:
     """Scaling-observatory accounting for one step's update exchange:
     parameter bytes, per-replica wire bytes (ring-collective model),
-    and the wire:param ratio — the numbers a `scaling` block needs to
-    say whether an efficiency drop tracks the collective budget or a
-    straggler (`bench.py` folds this in next to the efficiency curve)."""
+    the wire:param ratio, plus a per-mode breakdown — dense reports the
+    single all-reduce, sharded/fsdp split it into the grad
+    reduce-scatter + param all-gather halves, and fsdp adds the
+    per-replica param residency (`bench.py` folds this in next to the
+    efficiency curve)."""
     total = sum(int(np.prod(a.shape)) * a.dtype.itemsize
                 for a in jax.tree_util.tree_leaves(params)
                 if hasattr(a, "shape"))
-    wire = update_exchange_bytes(params, n_shards)
-    return {
-        "mode": getattr(mode, "value", mode) or "dense",
+    mode_s = getattr(mode, "value", mode) or "dense"
+    wire = update_exchange_bytes(params, n_shards, mode)
+    half = (int((n_shards - 1) * total / n_shards) if n_shards > 1 else 0)
+    rep = {
+        "mode": mode_s,
         "shards": int(n_shards),
         "param_bytes": int(total),
         "wire_bytes_per_replica": int(wire),
         "wire_to_param_ratio": round(wire / total, 3) if total else 0.0,
     }
+    if mode_s == UpdateExchange.DENSE.value:
+        rep["all_reduce_bytes"] = int(wire)
+    else:
+        rep["grad_reduce_scatter_bytes"] = half
+        rep["param_all_gather_bytes"] = half
+    if mode_s == UpdateExchange.FSDP.value:
+        rep["param_resident_bytes_per_replica"] = (
+            int(total // n_shards) if n_shards > 1 else int(total))
+    return rep
 
 
 def sharded_state_bytes(states: Dict) -> int:

@@ -54,8 +54,13 @@ class _ModelSnapshot:
         # that honors the donation (cache-loaded ones do) would mutate
         # the snapshot in place while the background thread writes it
         import numpy as _np
+        # dense_params() regathers fsdp flat shards into per-tensor
+        # arrays so the snapshot (and the checkpoint on disk) is
+        # device-count portable
+        params = (model.dense_params()
+                  if hasattr(model, "dense_params") else model.params)
         self.params = jax.tree_util.tree_map(
-            _np.array, jax.device_get(model.params))
+            _np.array, jax.device_get(params))
         self.states = jax.tree_util.tree_map(
             _np.array, jax.device_get(model.states))
         self.updater_states = jax.tree_util.tree_map(

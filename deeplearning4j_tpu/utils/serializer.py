@@ -66,10 +66,16 @@ class ModelSerializer:
         names the real class for restore dispatch)."""
         path = Path(path)
         path.parent.mkdir(parents=True, exist_ok=True)
+        # fsdp keeps params resident as mesh-shaped padded flats;
+        # checkpoints always store the dense per-tensor layout so they
+        # restore on any device count (states_to_dense also needs the
+        # dense params to rebuild its flatten spec)
+        params = (model.dense_params()
+                  if hasattr(model, "dense_params") else model.params)
         with zipfile.ZipFile(path, "w", zipfile.ZIP_DEFLATED) as zf:
             zf.writestr(CONFIG_ENTRY, model.conf.to_json())
             _write_npz(zf, COEFFICIENTS_ENTRY,
-                       _tree_to_flat_dict(model.params))
+                       _tree_to_flat_dict(params))
             _write_npz(zf, STATE_ENTRY, _tree_to_flat_dict(model.states))
             if save_updater:
                 # ZeRO-1 sharded layouts (parallel.zero) are mesh-shaped
@@ -79,7 +85,7 @@ class ModelSerializer:
                     states_to_dense
                 _write_npz(zf, UPDATER_ENTRY,
                            _tree_to_flat_dict(states_to_dense(
-                               model.params, model.updater_states)))
+                               params, model.updater_states)))
             if normalizer is not None:
                 zf.writestr(NORMALIZER_ENTRY,
                             json.dumps(normalizer.to_map()))

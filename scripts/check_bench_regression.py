@@ -42,9 +42,10 @@ import sys
 
 #: metrics where larger is better (substring match on the key)
 HIGHER_BETTER = ("value", "tflops", "throughput", "_ips", "_rps",
-                 "efficiency")
+                 "efficiency", "savings_ratio")
 #: metrics where smaller is better
-LOWER_BETTER = ("_ms", "_us", "_seconds", "overhead", "stall", "skew")
+LOWER_BETTER = ("_ms", "_us", "_seconds", "overhead", "stall", "skew",
+                "_bytes_per_chip")
 #: keys that are identity/config, never compared; "canary" keys are
 #: clock-path checks documented as dispatch-noise-dominated
 SKIP = ("metric", "unit", "n_trials", "vs_baseline", "meta", "min",

@@ -12,7 +12,11 @@
 #      known-polarity metric of the previous round by more than the
 #      threshold — including the PR-9 `scaling` (efficiency up, skew
 #      down) and `step_breakdown` (phase seconds down) blocks
-#      (scripts/check_bench_regression.py).
+#      (scripts/check_bench_regression.py);
+#   3. fsdp residency gate: the ZeRO-3 bench leg on the virtual
+#      8-device CPU mesh must measure per-chip param + updater-state
+#      residency <= 1/4 of dense (the ISSUE 10 acceptance bar,
+#      benchmarks/bench_fsdp.py).
 #
 # Usage: scripts/ci_check.sh [--threshold PCT]     (default 10)
 # Exit 0 = all gates clean, 1 = a gate failed, 2 = bad usage.
@@ -44,5 +48,17 @@ else
   python scripts/check_bench_regression.py \
       --threshold "$THRESHOLD" "$baseline" "$fresh" || fail=1
 fi
+
+echo "== fsdp residency gate =="
+fsdp_out=$(JAX_PLATFORMS=cpu python benchmarks/bench_fsdp.py) || fail=1
+printf '%s\n' "$fsdp_out" | python -c '
+import json, sys
+lines = [l for l in sys.stdin if l.startswith("{")]
+rec = json.loads(lines[-1]) if lines else {}
+ok = rec.get("fsdp_resident_quarter_of_dense") is True
+verdict = "OK" if ok else "FAIL: above 1/4 of dense"
+ratio = rec.get("hbm_total_savings_ratio")
+print(f"fsdp per-chip residency savings: {ratio}x ({verdict})")
+sys.exit(0 if ok else 1)' || fail=1
 
 exit $fail
