@@ -294,6 +294,33 @@ def main():
             print("fsdp leg: no line in child output", file=sys.stderr)
     except Exception as e:
         print(f"fsdp leg failed: {e!r}", file=sys.stderr)
+    # Fault-tolerance leg: checkpoint step-loop stall (fully
+    # synchronous vs deferred async snapshot) and warm-cache resume
+    # latency — the costs the preemption/auto-resume machinery pays.
+    # CPU-proxy subprocess, like the legs above.
+    try:
+        env = {**os.environ, "PYTHONPATH": "", "JAX_PLATFORMS": "cpu"}
+        out = subprocess.run(
+            [sys.executable,
+             os.path.join(_ROOT, "benchmarks",
+                          "bench_fault_tolerance.py")],
+            capture_output=True, text=True, timeout=900, env=env,
+            cwd=_ROOT)
+        if out.returncode != 0:
+            raise RuntimeError(
+                f"rc={out.returncode}: {out.stderr.strip()[-400:]}")
+        for ln in out.stdout.strip().splitlines():
+            if not ln.startswith("{"):
+                continue              # tolerate library banners
+            rec = json.loads(ln)
+            if rec.get("metric") == "fault_tolerance":
+                rec.pop("metric")
+                line["fault_tolerance"] = rec
+        if "fault_tolerance" not in line:
+            print("fault-tolerance leg: no line in child output",
+                  file=sys.stderr)
+    except Exception as e:
+        print(f"fault-tolerance leg failed: {e!r}", file=sys.stderr)
     # Graph-optimizer leg: per-pass rewrite counts + fused-vs-unfused
     # imported-BERT step time, and the flash-vs-dense compiled temp
     # memory floor at a long-sequence shape. CPU-proxy subprocess,

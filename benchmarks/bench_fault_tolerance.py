@@ -1,0 +1,157 @@
+"""Benchmark: fault-tolerance costs (ISSUE 11).
+
+Measures the two latencies the elastic/preemption-tolerant machinery
+must keep small:
+
+- **snapshot stall**: the time a checkpoint blocks the step loop.
+  The synchronous path (``asynchronous=False``) does the device->host
+  copy, serialization AND the zip write inline; the async path with
+  deferred snapshots (``DL4J_TPU_ASYNC_SNAPSHOT``, default on) forks
+  donation-safe on-device copies and moves everything else onto the
+  checkpoint worker — the acceptance bar is the deferred stall <= 20%
+  of the synchronous one at the same cadence.  The eager-copy async
+  stall (device->host copy inline, write on the worker) is reported as
+  an informational third series;
+- **resume latency**: ``load_checkpoint`` wall time from a warm page
+  cache — the fixed cost every auto-resume pays.
+
+CPU-proxy subprocess on the virtual 8-device mesh like the other legs;
+ratios are the claim, absolute times are smoke numbers.
+
+Prints ONE JSON line:
+  {"metric": "fault_tolerance", "sync_stall_mean_seconds": ...,
+   "async_stall_mean_seconds": ..., "async_to_sync_stall_ratio": ...,
+   "resume_latency_seconds": ..., ...}
+"""
+from __future__ import annotations
+
+import json
+import os
+import shutil
+import sys
+import tempfile
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "") +
+                           " --xla_force_host_platform_device_count=8")
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np  # noqa: E402
+
+SAVES = 8
+
+
+def _net():
+    from deeplearning4j_tpu.activations import Activation
+    from deeplearning4j_tpu.learning.updaters import Adam
+    from deeplearning4j_tpu.lossfunctions import LossFunction
+    from deeplearning4j_tpu.nn.conf.builders import NeuralNetConfiguration
+    from deeplearning4j_tpu.nn.conf.inputs import InputType
+    from deeplearning4j_tpu.nn.conf.layers import DenseLayer, OutputLayer
+    from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+    from deeplearning4j_tpu.nn.weights import WeightInit
+    conf = (NeuralNetConfiguration.Builder()
+            .seed(0).updater(Adam(1e-3))
+            .weight_init(WeightInit.XAVIER)
+            .list()
+            .layer(DenseLayer(n_in=512, n_out=1024,
+                              activation=Activation.RELU))
+            .layer(DenseLayer(n_out=1024, activation=Activation.RELU))
+            .layer(OutputLayer(n_out=10,
+                               loss_function=LossFunction.MCXENT,
+                               activation=Activation.SOFTMAX))
+            .set_input_type(InputType.feed_forward(512))
+            .build())
+    return MultiLayerNetwork(conf).init()
+
+
+def _data(n=64):
+    from deeplearning4j_tpu.datasets.dataset import DataSet
+    rng = np.random.RandomState(0)
+    x = rng.randn(n, 512).astype(np.float32)
+    y = np.eye(10, dtype=np.float32)[rng.randint(0, 10, n)]
+    return DataSet(x, y)
+
+
+def _stalls(net, ds, base_dir, mode: str):
+    """Per-save step-loop stall: time ONLY _save (what runs on the
+    step path); any worker flush/join happens outside the timed
+    region.  mode: 'sync' (fully synchronous write), 'eager' (async
+    write, inline device->host copy), 'defer' (async write, on-device
+    fork only)."""
+    from deeplearning4j_tpu.utils import CheckpointListener
+    d = os.path.join(base_dir, mode)
+    lis = CheckpointListener(d, asynchronous=(mode != "sync"),
+                             keep_last=2,
+                             defer_snapshot=(mode == "defer"))
+    samples = []
+    for _ in range(SAVES):
+        net.fit(ds)                 # mutate so every snapshot is fresh
+        jax.block_until_ready(net.params)
+        t0 = time.perf_counter()
+        lis._save(net)
+        samples.append(time.perf_counter() - t0)
+        lis.flush()                 # drain the worker between samples
+    return samples
+
+
+def main():
+    from deeplearning4j_tpu.common.telemetry import MetricsRegistry
+    from deeplearning4j_tpu.utils import CheckpointListener
+
+    MetricsRegistry.get().set_enabled(False)
+    base = tempfile.mkdtemp(prefix="bench_ft_")
+    try:
+        net = _net()
+        ds = _data()
+        net.fit(ds)                           # compile once up front
+        jax.block_until_ready(net.params)
+        n_params = sum(int(np.prod(a.shape)) for a in
+                       jax.tree_util.tree_leaves(net.params)
+                       if hasattr(a, "shape"))
+
+        sync = _stalls(net, ds, base, "sync")
+        eager = _stalls(net, ds, base, "eager")
+        async_ = _stalls(net, ds, base, "defer")
+
+        # resume latency: newest checkpoint -> live model (warm cache)
+        trials = []
+        for _ in range(3):
+            t0 = time.perf_counter()
+            CheckpointListener.load_checkpoint(
+                os.path.join(base, "defer"))
+            trials.append(time.perf_counter() - t0)
+        resume_s = sorted(trials)[1]
+
+        sync_mean = float(np.mean(sync))
+        async_mean = float(np.mean(async_))
+        out = {
+            "metric": "fault_tolerance",
+            "unit": "s",
+            "model_params": n_params,
+            "saves_per_mode": SAVES,
+            "sync_stall_mean_seconds": round(sync_mean, 6),
+            "sync_stall_p99_seconds": round(float(max(sync)), 6),
+            "eager_copy_stall_mean_seconds": round(
+                float(np.mean(eager)), 6),
+            "async_stall_mean_seconds": round(async_mean, 6),
+            "async_stall_p99_seconds": round(float(max(async_)), 6),
+            "async_to_sync_stall_ratio": round(
+                async_mean / max(sync_mean, 1e-9), 4),
+            "resume_latency_seconds": round(resume_s, 5),
+            # ISSUE 11 acceptance: deferred snapshot stall <= 20% of
+            # the synchronous path at the same cadence
+            "async_stall_fifth_of_sync": bool(
+                async_mean * 5 <= sync_mean),
+        }
+        print(json.dumps(out))
+    finally:
+        shutil.rmtree(base, ignore_errors=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -713,6 +713,8 @@ def record_step(model, model_name: str, step: int, loss, span=None,
     """Flight-recorder append only — for funnels that already ran
     :func:`check_numerics` mid-step (the accumulation path must check
     grads BEFORE the apply step donates their buffers)."""
+    from deeplearning4j_tpu.common import faults
+    faults.chaos_step()
     _close_breakdown(model_name, step, span, extra)
     rec = FlightRecorder.get()
     if rec.enabled:
@@ -726,6 +728,8 @@ def after_step(model, model_name: str, step: int, loss, span=None,
     """Record the step into the flight recorder, then run the numerics
     watchdog (which may raise :class:`NumericsEvent`).  Near-free when
     both gates are off: two attribute checks."""
+    from deeplearning4j_tpu.common import faults
+    faults.chaos_step()
     _close_breakdown(model_name, step, span, extra)
     rec = FlightRecorder.get()
     if rec.enabled:

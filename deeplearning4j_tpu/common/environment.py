@@ -66,6 +66,16 @@ class _Env:
     # refresh HBM gauges from jax device memory stats every Nth
     # recorded step (the stats call is cheap but not free)
     hbm_sample_steps: int = 16
+    # fault tolerance (common.faults): supervised in-process retries
+    # after a training failure — attempts before giving up, and the
+    # base of the capped exponential backoff between them (seconds)
+    resume_retries: int = 3
+    resume_backoff: float = 1.0
+    # truly-async checkpoint snapshots (utils.checkpoint): fork a
+    # donation-safe ON-DEVICE copy on the step path and defer the
+    # device->host transfer to the background checkpoint writer. 0
+    # restores the eager (step-loop-blocking) device_get.
+    async_snapshot: bool = True
     # scaling observatory (common.stepstats): per-step phase
     # decomposition + cross-host straggler detection
     stepstats: bool = True
@@ -98,7 +108,8 @@ class Environment:
       DL4J_TPU_FLIGHT_RECORDER_STEPS, DL4J_TPU_FLIGHT_RECORDER_DIR,
       DL4J_TPU_FLIGHT_RECORDER_KEEP, DL4J_TPU_HBM_SAMPLE_STEPS,
       DL4J_TPU_STEPSTATS, DL4J_TPU_STRAGGLER_FACTOR,
-      DL4J_TPU_STRAGGLER_MIN_STEP
+      DL4J_TPU_STRAGGLER_MIN_STEP, DL4J_TPU_RESUME_RETRIES,
+      DL4J_TPU_RESUME_BACKOFF, DL4J_TPU_ASYNC_SNAPSHOT
 
     Read live (not cached here) by their subsystems:
       DL4J_TPU_GRAPHOPT (post-import GraphOptimizer pipeline, default
@@ -106,7 +117,10 @@ class Environment:
       each mutating pass), DL4J_TPU_FLASH_ATTENTION (tri-state: =1
       forces the Pallas flash sdpa backend, =0 kills it, unset =
       auto heuristic), DL4J_TPU_FUSED_BN_BWD (fused BN backward:
-      default on-for-TPU; =0 kills, =1 forces anywhere)
+      default on-for-TPU; =0 kills, =1 forces anywhere),
+      DL4J_TPU_CHAOS (common.faults fault injection: comma-separated
+      kill_after_steps=N / hard_kill_after_steps=N /
+      slow_worker=SECONDS / torn_checkpoint=1)
     """
 
     _inst: _Env | None = None
@@ -153,6 +167,11 @@ class Environment:
                         "DL4J_TPU_FLIGHT_RECORDER_KEEP", "8")),
                     hbm_sample_steps=int(os.environ.get(
                         "DL4J_TPU_HBM_SAMPLE_STEPS", "16")),
+                    resume_retries=int(os.environ.get(
+                        "DL4J_TPU_RESUME_RETRIES", "3")),
+                    resume_backoff=float(os.environ.get(
+                        "DL4J_TPU_RESUME_BACKOFF", "1.0")),
+                    async_snapshot=b("DL4J_TPU_ASYNC_SNAPSHOT", True),
                     stepstats=b("DL4J_TPU_STEPSTATS", True),
                     straggler_factor=float(os.environ.get(
                         "DL4J_TPU_STRAGGLER_FACTOR", "2.0")),

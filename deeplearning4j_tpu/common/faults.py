@@ -1,0 +1,347 @@
+"""Preemption capture, auto-resume policy, and chaos injection.
+
+The elasticity story (ROADMAP item 4, SURVEY.md §5.3) in three layers:
+
+- :class:`PreemptionGuard` turns a SIGTERM / preemption notice into a
+  cooperative flag instead of an immediate death: the training loop
+  checks :func:`preemption_requested` at the next step boundary, writes
+  one final checkpoint, and raises :class:`TrainingPreempted` — a clean
+  resumable exit (exit code 75, EX_TEMPFAIL: supervisors read it as
+  "retry me").  The guard composes with the PR-7 flight recorder's
+  SIGTERM plumbing in either install order: whichever handler runs
+  first dumps the recorder ring (dedup inside ``dump``) and sets the
+  flag; neither re-delivers the killing signal while a capture is
+  possible.  A SECOND notice means the grace period is over — the
+  default disposition is restored and the process dies as SIGTERM.
+
+- the resume policy knobs (``DL4J_TPU_RESUME_RETRIES`` /
+  ``DL4J_TPU_RESUME_BACKOFF``) drive the supervised retry loops in
+  ``utils.checkpoint.FaultTolerantTrainer`` and
+  ``parallel.sharedtraining.SharedTrainingMaster.fit``: capped
+  exponential backoff, then restart from the newest valid checkpoint.
+
+- :class:`ChaosMonkey` (``DL4J_TPU_CHAOS``, read live) injects the
+  faults the harness must survive: SIGTERM after N steps, a hard kill
+  (no capture), a per-step slowdown, a torn newest checkpoint.  It is
+  fed from the ``diagnostics.record_step``/``after_step`` funnels so
+  every fit path (MLN / graph / SameDiff) is injectable.
+
+Metrics: ``dl4j_preemption_total``, ``dl4j_resume_total`` (label
+``kind``: ``restart`` = a new process picked up an existing checkpoint
+dir, ``inprocess`` = a supervised retry reloaded after a failure),
+``dl4j_lost_steps_total``, ``dl4j_chaos_injections_total``.
+"""
+from __future__ import annotations
+
+import logging
+import os
+import signal
+import threading
+import time
+from typing import Optional
+
+from deeplearning4j_tpu.common import telemetry
+from deeplearning4j_tpu.common.environment import Environment
+
+log = logging.getLogger("deeplearning4j_tpu")
+
+#: clean-preemption exit status (EX_TEMPFAIL — "try again later");
+#: supervisors distinguish it from a crash and simply re-run the job
+PREEMPTED_EXIT_CODE = 75
+
+#: backoff ceiling for the supervised retry loops (seconds)
+MAX_RESUME_BACKOFF_S = 30.0
+
+
+class TrainingPreempted(Exception):
+    """Raised at a step boundary AFTER the preemption notice has been
+    captured and the final checkpoint made durable.  Catch it at the
+    job top level and ``sys.exit(e.exit_code)`` — re-running the same
+    command resumes from the checkpoint dir with nothing lost."""
+
+    exit_code = PREEMPTED_EXIT_CODE
+
+
+# ----------------------------------------------------------------------
+# preemption capture
+def _is_flight_recorder_handler(fn) -> bool:
+    try:
+        from deeplearning4j_tpu.common.diagnostics import FlightRecorder
+        return isinstance(getattr(fn, "__self__", None), FlightRecorder)
+    except Exception:       # noqa: BLE001 — never break signal dispatch
+        return False
+
+
+class PreemptionGuard:
+    """Process-wide SIGTERM → cooperative-flag converter.
+
+    ``install()`` is idempotent and safe off the main thread (where it
+    degrades to cooperative :meth:`request` only — Python restricts
+    ``signal.signal`` to the main thread).  The handler never raises
+    and never blocks: it sets the flag, counts the preemption, dumps
+    the flight-recorder ring, and returns so the in-flight train step
+    finishes normally."""
+
+    _instance: Optional["PreemptionGuard"] = None
+    _instance_lock = threading.Lock()
+
+    def __init__(self):
+        self._requested = threading.Event()
+        self._installed = False
+        self._prev = None
+
+    @classmethod
+    def get(cls) -> "PreemptionGuard":
+        inst = cls._instance
+        if inst is not None:
+            return inst
+        with cls._instance_lock:
+            if cls._instance is None:
+                cls._instance = cls()
+            return cls._instance
+
+    @classmethod
+    def _reset_for_tests(cls):
+        with cls._instance_lock:
+            inst, cls._instance = cls._instance, None
+        if inst is not None:
+            inst.uninstall()
+
+    # ------------------------------------------------------------------
+    def install(self) -> "PreemptionGuard":
+        if self._installed:
+            return self
+        try:
+            self._prev = signal.signal(signal.SIGTERM, self._on_sigterm)
+            self._installed = True
+        except ValueError:
+            # not the main thread: cooperative request() still works
+            self._prev = None
+        return self
+
+    def uninstall(self) -> None:
+        if not self._installed:
+            return
+        self._installed = False
+        try:
+            signal.signal(signal.SIGTERM, self._prev
+                          if self._prev is not None else signal.SIG_DFL)
+        except (ValueError, TypeError):
+            pass
+        self._prev = None
+
+    def _on_sigterm(self, signum, frame):
+        if self._requested.is_set():
+            # second notice: the grace period is over — die as SIGTERM
+            try:
+                signal.signal(signal.SIGTERM, signal.SIG_DFL)
+            except ValueError:
+                pass
+            os.kill(os.getpid(), signal.SIGTERM)
+            return
+        self.request("sigterm")
+        prev = self._prev
+        # chain to a prior handler UNLESS it is the flight recorder's:
+        # its fallback re-delivers the signal with the default
+        # disposition, which would kill the process before the final
+        # snapshot (request() already dumped the ring for it)
+        if callable(prev) and not _is_flight_recorder_handler(prev):
+            try:
+                prev(signum, frame)
+            except Exception:   # noqa: BLE001 — capture must proceed
+                pass
+
+    # ------------------------------------------------------------------
+    def request(self, reason: str = "sigterm") -> None:
+        """Mark a preemption notice (signal handler or cooperative —
+        e.g. a cloud metadata watcher thread)."""
+        if self._requested.is_set():
+            return
+        self._requested.set()
+        log.warning("preemption notice (%s): finishing the current "
+                    "step, then snapshotting for resume", reason)
+        if telemetry.enabled():
+            telemetry.counter(
+                "dl4j_preemption_total",
+                "preemption notices captured (SIGTERM or cooperative "
+                "request), by reason").inc(reason=reason)
+        try:
+            from deeplearning4j_tpu.common.diagnostics import \
+                FlightRecorder
+            FlightRecorder.get().dump("preemption")
+        except Exception:       # noqa: BLE001 — never break capture
+            pass
+
+    def requested(self) -> bool:
+        return self._requested.is_set()
+
+    def clear(self) -> None:
+        """Re-arm after a handled preemption (tests; supervisors that
+        keep the process alive across the resume)."""
+        self._requested.clear()
+
+
+def install_preemption_capture() -> PreemptionGuard:
+    return PreemptionGuard.get().install()
+
+
+def preemption_requested() -> bool:
+    return PreemptionGuard.get().requested()
+
+
+# ----------------------------------------------------------------------
+# resume policy + accounting
+def resume_retries() -> int:
+    return max(int(Environment.get().resume_retries), 0)
+
+
+def resume_backoff(attempt: int) -> float:
+    """Delay before retry ``attempt`` (1-based): capped exponential."""
+    base = max(float(Environment.get().resume_backoff), 0.0)
+    return min(base * (2 ** max(attempt - 1, 0)), MAX_RESUME_BACKOFF_S)
+
+
+def note_resume(kind: str, lost_steps: int = 0) -> None:
+    """Count one resume-from-checkpoint.  ``kind``: ``restart`` (a new
+    process picked up an existing checkpoint dir) or ``inprocess``
+    (the supervised retry loop reloaded after a failure).
+    ``lost_steps`` = iterations trained past the restored checkpoint
+    and therefore re-run."""
+    if not telemetry.enabled():
+        return
+    telemetry.counter(
+        "dl4j_resume_total",
+        "training resumes from checkpoint, by kind (restart = new "
+        "process found an existing checkpoint dir; inprocess = "
+        "supervised retry loop reloaded after a failure)").inc(
+            kind=kind)
+    if lost_steps > 0:
+        telemetry.counter(
+            "dl4j_lost_steps_total",
+            "train iterations lost to a failure/preemption (trained "
+            "past the restored checkpoint, re-run after resume)").inc(
+                int(lost_steps))
+
+
+# ----------------------------------------------------------------------
+# chaos injection
+class ChaosMonkey:
+    """Fault injector behind ``DL4J_TPU_CHAOS`` (read live, parsed
+    once per process).  Comma-separated directives:
+
+    - ``kill_after_steps=N`` — SIGTERM to self after N train
+      iterations (the graceful path: a captured preemption when the
+      guard is installed);
+    - ``hard_kill_after_steps=N`` — ``os._exit(137)`` after N
+      iterations (the SIGKILL path: no final snapshot, resume falls
+      back to the last cadence checkpoint);
+    - ``slow_worker=SECONDS`` — sleep that long every iteration (a
+      straggler for the observatory to flag);
+    - ``torn_checkpoint=1`` — after the preemption snapshot, truncate
+      the newest checkpoint on disk (resume must skip it and fall
+      back; fires once).
+    """
+
+    def __init__(self, spec: str):
+        self.kill_after = 0
+        self.hard_kill_after = 0
+        self.slow = 0.0
+        self.torn = False
+        self._steps = 0
+        self._slow_noted = False
+        for directive in spec.split(","):
+            directive = directive.strip()
+            if not directive:
+                continue
+            key, _, val = directive.partition("=")
+            key = key.strip()
+            val = val.strip() or "1"
+            try:
+                if key == "kill_after_steps":
+                    self.kill_after = int(val)
+                elif key == "hard_kill_after_steps":
+                    self.hard_kill_after = int(val)
+                elif key == "slow_worker":
+                    self.slow = float(val)
+                elif key == "torn_checkpoint":
+                    self.torn = val not in ("0", "false", "False")
+                else:
+                    log.warning("DL4J_TPU_CHAOS: unknown directive %r",
+                                directive)
+            except ValueError:
+                log.warning("DL4J_TPU_CHAOS: bad value in %r", directive)
+
+    @staticmethod
+    def _note(kind: str) -> None:
+        if telemetry.enabled():
+            telemetry.counter(
+                "dl4j_chaos_injections_total",
+                "faults injected by the DL4J_TPU_CHAOS harness, by "
+                "kind").inc(kind=kind)
+
+    def on_step(self) -> None:
+        self._steps += 1
+        if self.slow > 0:
+            if not self._slow_noted:
+                self._slow_noted = True
+                self._note("slow_worker")
+            time.sleep(self.slow)
+        if self.kill_after and self._steps == self.kill_after:
+            self._note("sigterm")
+            log.warning("chaos: SIGTERM to self after %d steps",
+                        self._steps)
+            os.kill(os.getpid(), signal.SIGTERM)
+        if self.hard_kill_after and self._steps == self.hard_kill_after:
+            self._note("hard_kill")
+            log.warning("chaos: hard kill after %d steps", self._steps)
+            os._exit(137)
+
+    def maybe_tear(self, save_dir) -> bool:
+        """Truncate the newest checkpoint in ``save_dir`` (once)."""
+        if not self.torn:
+            return False
+        from deeplearning4j_tpu.utils.checkpoint import \
+            CheckpointListener
+        cp = CheckpointListener.last_checkpoint_in(save_dir)
+        if cp is None:
+            return False
+        data = cp.read_bytes()
+        cp.write_bytes(data[:max(len(data) // 3, 1)])
+        self.torn = False
+        self._note("torn_checkpoint")
+        log.warning("chaos: tore newest checkpoint %s", cp)
+        return True
+
+
+_monkey: Optional[ChaosMonkey] = None
+_monkey_parsed = False
+_monkey_lock = threading.Lock()
+
+
+def chaos_monkey() -> Optional[ChaosMonkey]:
+    """The process's chaos injector, or None when ``DL4J_TPU_CHAOS``
+    is unset/empty.  Parsed once; near-free afterwards (the step
+    funnels call this every iteration)."""
+    global _monkey, _monkey_parsed
+    if _monkey_parsed:
+        return _monkey
+    with _monkey_lock:
+        if not _monkey_parsed:
+            spec = os.environ.get("DL4J_TPU_CHAOS", "").strip()
+            _monkey = ChaosMonkey(spec) if spec else None
+            _monkey_parsed = True
+    return _monkey
+
+
+def chaos_step() -> None:
+    cm = chaos_monkey()
+    if cm is not None:
+        cm.on_step()
+
+
+def _reset_for_tests() -> None:
+    global _monkey, _monkey_parsed
+    with _monkey_lock:
+        _monkey = None
+        _monkey_parsed = False
+    PreemptionGuard._reset_for_tests()

@@ -560,51 +560,93 @@ class StepStatsAggregator:
 class StepStatsClient:
     """Worker-side shipper: clock handshake on connect, then one JSON
     line per breakdown.  Register with
-    ``StepStats.get().add_sink(client.ship)``; shipping failures
-    disable the client (observability must never take training down).
+    ``StepStats.get().add_sink(client.ship)``.
+
+    A shipping failure marks the sink dead but schedules a RECONNECT
+    with capped exponential backoff instead of disabling it for the
+    rest of the run (a leader restart — e.g. after a preemption resume
+    — used to silence every worker permanently).  Records offered while
+    disconnected are dropped; observability must never take training
+    down, so reconnect errors only push the retry further out.
 
     ``clock`` is injectable so tests can simulate skewed hosts."""
 
     def __init__(self, host: str, port: int, *, worker: int,
                  hostname: Optional[str] = None,
                  clock: Callable[[], float] = time.time,
-                 timeout: float = 5.0):
+                 timeout: float = 5.0,
+                 reconnect_backoff: float = 0.5,
+                 max_backoff: float = 30.0):
         self.worker = int(worker)
         self.clock = clock
-        self._sock = socket.create_connection((host, port),
-                                              timeout=timeout)
+        self._host, self._port, self._timeout = host, int(port), timeout
+        self._hostname = hostname
+        self._backoff = float(reconnect_backoff)
+        self._max_backoff = float(max_backoff)
+        self._fail_streak = 0
+        self._retry_at = 0.0
+        self._closed = False
+        self._sock = None
+        self._connect()
+
+    def _connect(self) -> None:
+        self._sock = socket.create_connection(
+            (self._host, self._port), timeout=self._timeout)
         self._f = self._sock.makefile("rwb")
-        self._dead = False
         # NTP-lite handshake: offset of OUR clock vs the leader's
-        t0 = clock()
+        t0 = self.clock()
         self._send({"hello": {"worker": self.worker,
-                              "host": hostname
+                              "host": self._hostname
                               or socket.gethostname(),
                               "t0": t0}})
         reply = json.loads(self._f.readline().decode())
-        t1 = clock()
+        t1 = self.clock()
         self.clock_offset_s = estimate_clock_offset(
             t0, float(reply["t_leader"]), t1)
         self._send({"worker": self.worker,
                     "offset_s": self.clock_offset_s})
+        self._dead = False
+        self._fail_streak = 0
 
     def _send(self, obj: dict) -> None:
         self._f.write(json.dumps(obj).encode() + b"\n")
         self._f.flush()
 
+    def _note_failure(self, what: str, e: BaseException) -> None:
+        self._dead = True
+        self._fail_streak += 1
+        delay = min(self._backoff * 2 ** (self._fail_streak - 1),
+                    self._max_backoff)
+        self._retry_at = time.monotonic() + delay
+        log.warning("stepstats client: %s failed (%r); retry in %.1fs",
+                    what, e, delay)
+
     def ship(self, rec: dict) -> None:
         if self._dead:
-            return
+            if self._closed or time.monotonic() < self._retry_at:
+                return
+            try:
+                self._sock.close()
+            except OSError:
+                pass
+            try:
+                self._connect()
+                log.info("stepstats client: reconnected to %s:%d",
+                         self._host, self._port)
+            except (OSError, ValueError) as e:
+                self._note_failure("reconnect", e)
+                return
         try:
             self._send(rec)
         except (OSError, ValueError) as e:
-            self._dead = True
-            log.warning("stepstats client: shipping disabled: %r", e)
+            self._note_failure("shipping", e)
 
     def close(self):
         self._dead = True
+        self._closed = True
         try:
-            self._sock.close()
+            if self._sock is not None:
+                self._sock.close()
         except OSError:
             pass
 

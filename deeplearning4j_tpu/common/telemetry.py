@@ -316,8 +316,9 @@ class MetricsRegistry:
         with cls._instance_lock:
             cls._instance = None
         _trace_buffer.clear()
-        from deeplearning4j_tpu.common import stepstats
+        from deeplearning4j_tpu.common import faults, stepstats
         stepstats.StepStats._reset_for_tests()
+        faults._reset_for_tests()
 
     # -- gate ----------------------------------------------------------
     @property

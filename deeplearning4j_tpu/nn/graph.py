@@ -533,15 +533,23 @@ class ComputationGraph:
         (parallel.zero). Entering converts updater state to the ZeRO-1
         flat layout too (the fsdp tail consumes it) and places both at
         1/N per replica; leaving densifies params (gather timed into
-        ``dl4j_fsdp_gather_seconds``)."""
+        ``dl4j_fsdp_gather_seconds``).  Elastic re-mesh: flats resident
+        for a DIFFERENT world size (resume onto a new mesh) round-trip
+        through the dense layout and re-enter — params via
+        ``params_to_dense`` -> ``place_fsdp_params``, updater state via
+        its ``DpFlatSpec`` re-ravel inside ``states_to_sharded``."""
         flat = self._params_are_fsdp()
         if self._dp_fsdp and self._dp_mesh is not None:
-            if flat:
-                return    # already resident; placement happened on entry
             from deeplearning4j_tpu.parallel.zero import (
-                params_to_fsdp, place_fsdp_params, place_updater_states,
-                states_to_sharded)
+                fsdp_spec_shards, params_to_fsdp, place_fsdp_params,
+                place_updater_states, states_to_sharded)
             n = self._dp_mesh.shape[self._dp_axis]
+            if flat:
+                if fsdp_spec_shards(self._fsdp_specs) == n:
+                    # already resident; placement happened on entry
+                    return
+                # raveled for another world size: densify and re-enter
+                self._densify_params_inplace()
             self.updater_states = states_to_sharded(
                 self.params, self.updater_states, n)
             self.params, self._fsdp_specs = params_to_fsdp(self.params, n)

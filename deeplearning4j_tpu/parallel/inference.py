@@ -305,11 +305,25 @@ class ParallelInference:
             f.set_result(o)
 
     def shutdown(self):
-        """Stop the batching worker (pending requests are flushed)."""
+        """Stop the batching worker (pending requests are flushed).
+
+        After the worker exits, any requests still sitting in the queue
+        (possible when the worker died abnormally, or raced its idle
+        timeout against a submit) have their futures CANCELLED — no
+        caller may block forever on a Future nobody will resolve
+        (ADVICE.md round 5)."""
         with self._lock:
             worker, self._worker = self._worker, None
             if worker is None:
                 return
             self._shutdown = True
-            self._requests.put(None)
+            q = self._requests               # bind THIS queue
+            q.put(None)
         worker.join()
+        while True:
+            try:
+                item = q.get_nowait()
+            except _queue.Empty:
+                break
+            if item is not None:
+                item[1].cancel()

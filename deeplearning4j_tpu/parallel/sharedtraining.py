@@ -30,6 +30,7 @@ protocol — dense XLA AllReduce is bandwidth-optimal on ICI.
 from __future__ import annotations
 
 import logging
+import time
 from dataclasses import dataclass, field
 from typing import Optional
 
@@ -195,6 +196,7 @@ class SharedTrainingMaster:
             "devices participating in the data-parallel mesh").set(
                 mesh.size, master=type(self).__name__,
                 update_exchange=mode.value)
+        from deeplearning4j_tpu.common import faults
         mgr = None
         if checkpoint_dir is not None:
             from deeplearning4j_tpu.utils.checkpoint import (
@@ -204,6 +206,7 @@ class SharedTrainingMaster:
             if mgr.restore_into(model):
                 log.info("resumed from %s at epoch %d",
                          checkpoint_dir, model.epoch_count)
+                faults.note_resume("restart")
                 # n_epochs is the TOTAL target for a RESUMED job only:
                 # a warm-started model (epoch_count from elsewhere,
                 # nothing restored here) still trains n_epochs
@@ -215,19 +218,55 @@ class SharedTrainingMaster:
                          model.epoch_count)
                 model.listeners.remove(lis)
                 return model
+            # with a checkpoint dir a SIGTERM/preemption notice becomes
+            # a coordinated final snapshot + clean resumable exit (75)
+            faults.install_preemption_capture()
         if jax.process_count() > 1:
             self._setup_observatory()
+        target_total = model.epoch_count + n_epochs
+        attempt = 0
         try:
-            pw = ParallelWrapper(
-                model, mesh, update_exchange=mode,
-                accumulation_steps=self.config.accumulation_steps)
-            if jax.process_count() == 1:
-                pw.fit(iterator, n_epochs=n_epochs)
-            else:
-                # multi-host: same epoch loop, batches assembled
-                # globally from each process's local shard
-                pw.run_epochs(iterator, n_epochs,
-                              lambda ds: self._make_global(mesh, ds))
+            while True:
+                remaining = target_total - model.epoch_count
+                if remaining <= 0:
+                    break
+                try:
+                    # a FRESH wrapper per attempt: an elastic resume can
+                    # land on a different world size, so the exchange
+                    # mode and the dense/sharded/fsdp layouts must
+                    # re-resolve against the current mesh
+                    pw = ParallelWrapper(
+                        model, mesh, update_exchange=mode,
+                        accumulation_steps=self.config.accumulation_steps)
+                    if jax.process_count() == 1:
+                        pw.fit(iterator, n_epochs=remaining)
+                    else:
+                        # multi-host: same epoch loop, batches assembled
+                        # globally from each process's local shard
+                        pw.run_epochs(
+                            iterator, remaining,
+                            lambda ds: self._make_global(mesh, ds))
+                except faults.TrainingPreempted:
+                    # final coordinated snapshot, then unwind so the
+                    # supervisor sees the resumable exit code
+                    if mgr is not None:
+                        mgr.save(model)
+                        mgr.flush()
+                    raise
+                except Exception:
+                    attempt += 1
+                    if mgr is None or attempt > faults.resume_retries():
+                        raise
+                    log.warning(
+                        "fit attempt %d failed; auto-resuming from %s",
+                        attempt, checkpoint_dir, exc_info=True)
+                    time.sleep(faults.resume_backoff(attempt))
+                    it_before = model.iteration_count
+                    if mgr.restore_into(model):
+                        faults.note_resume(
+                            "inprocess",
+                            lost_steps=max(
+                                it_before - model.iteration_count, 0))
         finally:
             self._teardown_observatory()
             if mgr is not None:

@@ -165,6 +165,15 @@ class ParallelWrapper:
         mode = resolve_update_exchange(self.mesh, self.data_axis,
                                        self.requested_exchange, m)
         self.update_exchange = mode
+        if hasattr(m, "_params_are_fsdp") and m._params_are_fsdp():
+            # elastic re-place: params still resident as 1/N flats from
+            # a previous mesh.  If the world size changed (or the mode
+            # did), round-trip through the dense layout so the wire
+            # accounting and the re-entry below see real shapes.
+            from deeplearning4j_tpu.parallel.zero import fsdp_spec_shards
+            stale_n = fsdp_spec_shards(getattr(m, "_fsdp_specs", {}) or {})
+            if mode is not UpdateExchange.FSDP or stale_n != self.n_workers:
+                m.set_dp_mesh(None, self.data_axis)
         import numpy as np
         # wire accounting while params are still in the dense layout
         # (the fsdp conversion below folds them into padded flats)
@@ -317,6 +326,17 @@ class ParallelWrapper:
                         ).inc(self._fsdp_gather_bytes, workers=n)
                 else:
                     self.model.fit(ds)
+                from deeplearning4j_tpu.common import faults
+                if faults.preemption_requested():
+                    # coordinated resumable exit: close the partial
+                    # accumulation window, then unwind to whoever owns
+                    # the checkpoint (FaultTolerantTrainer /
+                    # SharedTrainingMaster saves before re-raising)
+                    if hasattr(self.model, "flush_accumulated"):
+                        self.model.flush_accumulated()
+                    raise faults.TrainingPreempted(
+                        "preempted at iteration %d" %
+                        self.model.iteration_count)
             if hasattr(self.model, "flush_accumulated"):
                 # a partial accumulation window must not leak into the
                 # next epoch
@@ -343,6 +363,25 @@ class ParallelWrapper:
                         time.perf_counter() - t0, workers=workers)
             return out
         return place
+
+    def remesh(self, mesh=None, *, workers: Optional[int] = None
+               ) -> "ParallelWrapper":
+        """Elastic world-size change: re-place the model onto ``mesh``
+        (or onto the first ``workers`` devices).  The update exchange is
+        re-resolved for the new mesh and any dense/sharded/fsdp layout
+        resident for the old world size round-trips through the dense
+        layout during ``_place_model`` — training continues the exact
+        dense trajectory with the new device count."""
+        if mesh is None:
+            devs = jax.devices()
+            if workers:
+                devs = devs[:workers]
+            mesh = make_mesh({self.data_axis: len(devs)}, devs)
+        self.mesh = mesh
+        self.update_exchange = None
+        self._placed = False
+        self._place_model()
+        return self
 
     def fit_batch(self, ds):
         if not self._placed:

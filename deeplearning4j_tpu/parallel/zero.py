@@ -296,6 +296,16 @@ def params_to_fsdp(params: Dict, n_shards: int):
     return out, specs
 
 
+def fsdp_spec_shards(specs) -> "int | None":
+    """World size a set of fsdp specs was raveled for (None when there
+    are no specs).  The elastic re-mesh check: resident flats whose
+    spec shard count differs from the mesh about to consume them must
+    round-trip through the dense layout first."""
+    for spec in (specs or {}).values():
+        return int(spec.n_shards)
+    return None
+
+
 def params_to_dense(params: Dict, specs: Dict) -> Dict:
     """Inverse of :func:`params_to_fsdp` (padding dropped). Runs on the
     host at layout-sync boundaries (checkpoint, inference outside the
@@ -384,10 +394,33 @@ def apply_update_fsdp(updater, flat_g, flat_p, state, iteration, mesh,
 
 
 # -- layout conversions ------------------------------------------------------
+def _flats_match_spec(inner, spec) -> bool:
+    """True when every flat's length equals the spec's PADDED length —
+    i.e. the state was raveled for the same shard count."""
+    for flats in inner.values():
+        for dt, flat in flats.items():
+            sizes = spec.sizes.get(dt)
+            if sizes is None or int(flat.shape[0]) != sizes[1]:
+                return False
+    return True
+
+
 def to_sharded_state(params, state, n_shards: int):
-    """One subtree's dense updater state -> ZeRO-1 flat layout."""
-    if not state or is_dp_sharded(state):
+    """One subtree's dense updater state -> ZeRO-1 flat layout.
+
+    A state that is ALREADY flat is checked against the padded sizes
+    for ``n_shards``: flats raveled for a DIFFERENT world size (an
+    elastic resume — padding is a multiple of the shard count) round-
+    trip through the dense layout and re-ravel, so the layout always
+    matches the mesh about to consume it (ROADMAP item 4's
+    ``DpFlatSpec`` re-ravel)."""
+    if not state:
         return state
+    if is_dp_sharded(state):
+        spec = dp_flatten_spec(params, n_shards)
+        if _flats_match_spec(state[DP_SHARDED_KEY], spec):
+            return state
+        state = to_dense_state(params, state)
     return {DP_SHARDED_KEY: {slot: dp_ravel(tree, n_shards)[0]
                              for slot, tree in state.items()}}
 

@@ -16,6 +16,7 @@ state, iteration count, and epoch count intact.
 from __future__ import annotations
 
 import concurrent.futures
+import json
 import logging
 import os
 import re
@@ -25,7 +26,8 @@ from typing import List, Optional
 
 import jax
 
-from deeplearning4j_tpu.common import telemetry
+from deeplearning4j_tpu.common import faults, telemetry
+from deeplearning4j_tpu.common.environment import Environment
 from deeplearning4j_tpu.optimize.listeners import TrainingListener
 from deeplearning4j_tpu.utils.serializer import ModelSerializer
 
@@ -33,9 +35,22 @@ log = logging.getLogger("deeplearning4j_tpu")
 
 
 class _ModelSnapshot:
-    """Host-side copy of everything ``write_model`` reads, taken
-    synchronously at save time so the training loop can keep mutating
-    the live model while the background thread serializes."""
+    """Copy of everything ``write_model`` reads, taken at save time so
+    the training loop can keep mutating the live model while the
+    background thread serializes.
+
+    Two flavors (ROADMAP item 4's async-snapshotting ask):
+
+    - eager (``defer=False``): the device->host transfer happens HERE,
+      on the step loop — the pre-elasticity behavior, still used by
+      synchronous listeners;
+    - deferred (``defer=True``): only donation-safe ON-DEVICE copies
+      are forked here (``jnp.copy`` dispatches asynchronously and
+      preserves sharding, so fsdp flats stay 1/N resident); the
+      device->host transfer — and under fsdp the dense re-gather —
+      runs in :meth:`materialize` on the checkpoint worker.  The stall
+      histogram then collapses to ~the previous-write join plus the
+      copy dispatch."""
 
     class _ConfShim:
         def __init__(self, conf_json: str):
@@ -44,9 +59,33 @@ class _ModelSnapshot:
         def to_json(self) -> str:
             return self._json
 
-    def __init__(self, model):
+    def __init__(self, model, *, defer: bool = False):
         self.model_class = type(model).__name__
         self.conf = _ModelSnapshot._ConfShim(model.conf.to_json())
+        self.iteration_count = model.iteration_count
+        self.epoch_count = model.epoch_count
+        self._device_trees = None
+        self._fsdp_specs = None
+        if defer:
+            # the copy is REQUIRED for the same donation reason as
+            # np.array below: the next train step donates param/state
+            # buffers, and an executable honoring the donation would
+            # mutate the snapshot while the worker reads it.  jnp.copy
+            # forks fresh buffers without a host sync.
+            import jax.numpy as jnp
+
+            def fork(a):
+                return (jnp.copy(a)
+                        if hasattr(a, "shape") and hasattr(a, "dtype")
+                        else a)
+
+            if getattr(model, "_params_are_fsdp", None) is not None \
+                    and model._params_are_fsdp():
+                self._fsdp_specs = dict(model._fsdp_specs)
+            self._device_trees = jax.tree_util.tree_map(
+                fork, (model.params, model.states,
+                       model.updater_states))
+            return
         # device->host transfers (the only part the step loop waits on).
         # np.array (copy) is REQUIRED, not np.asarray: on the CPU
         # backend device_get returns zero-copy VIEWS of the XLA
@@ -65,8 +104,26 @@ class _ModelSnapshot:
             _np.array, jax.device_get(model.states))
         self.updater_states = jax.tree_util.tree_map(
             _np.array, jax.device_get(model.updater_states))
-        self.iteration_count = model.iteration_count
-        self.epoch_count = model.epoch_count
+
+    def materialize(self) -> "_ModelSnapshot":
+        """Deferred device->host transfer (checkpoint worker); no-op
+        for an eager snapshot.  The fsdp dense re-gather happens here
+        too, off the step path."""
+        if self._device_trees is None:
+            return self
+        import numpy as _np
+        params, states, upd = self._device_trees
+        if self._fsdp_specs:
+            from deeplearning4j_tpu.parallel.zero import params_to_dense
+            params = params_to_dense(params, self._fsdp_specs)
+        self.params = jax.tree_util.tree_map(
+            _np.array, jax.device_get(params))
+        self.states = jax.tree_util.tree_map(
+            _np.array, jax.device_get(states))
+        self.updater_states = jax.tree_util.tree_map(
+            _np.array, jax.device_get(upd))
+        self._device_trees = None
+        return self
 
 
 class CheckpointListener(TrainingListener):
@@ -82,7 +139,11 @@ class CheckpointListener(TrainingListener):
                  save_every_n_epochs: int = 0,
                  save_every_n_seconds: float = 0.0,
                  keep_last: int = 0, keep_every: int = 0,
-                 asynchronous: bool = True):
+                 asynchronous: bool = True,
+                 defer_snapshot: Optional[bool] = None):
+        #: defer the device->host snapshot copy to the background
+        #: writer (async listeners only; None -> DL4J_TPU_ASYNC_SNAPSHOT)
+        self.defer_snapshot = defer_snapshot
         self.dir = Path(save_dir)
         self.dir.mkdir(parents=True, exist_ok=True)
         self.n_iter = save_every_n_iterations
@@ -100,6 +161,10 @@ class CheckpointListener(TrainingListener):
     def _write(self, snapshot, tmp: Path, path: Path):
         with telemetry.span("checkpoint.save", path=str(path)):
             t0 = time.perf_counter()
+            if hasattr(snapshot, "materialize"):
+                # deferred snapshot: the device->host transfer (and
+                # fsdp dense re-gather) runs here, off the step path
+                snapshot.materialize()
             if hasattr(snapshot, "write"):
                 # model-provided snapshot (SameDiff.checkpoint_snapshot:
                 # the imported-model path has its own zip format)
@@ -139,9 +204,13 @@ class CheckpointListener(TrainingListener):
             self._saved.append(path)
             self._last_saved_state = (model.iteration_count,
                                       model.epoch_count)
+            defer = (self.defer_snapshot
+                     if self.defer_snapshot is not None
+                     else Environment.get().async_snapshot)
             snap = (model.checkpoint_snapshot()
                     if hasattr(model, "checkpoint_snapshot")
-                    else _ModelSnapshot(model))
+                    else _ModelSnapshot(
+                        model, defer=bool(defer) and self.asynchronous))
             if not self.asynchronous:
                 self._write(snap, tmp, path)
                 return
@@ -286,11 +355,80 @@ class CheckpointListener(TrainingListener):
         return None
 
 
+class _ResumableCheckpointListener(CheckpointListener):
+    """CheckpointListener that writes a ``checkpoint_N.meta.json``
+    sidecar per save recording how deep into the current epoch the
+    snapshot is, so a resumed :class:`FaultTolerantTrainer` skips
+    exactly the batches already trained instead of replaying the
+    interrupted epoch (the loss-trajectory-continuity requirement of
+    the chaos harness)."""
+
+    def __init__(self, trainer, save_dir, **kw):
+        super().__init__(save_dir, **kw)
+        self._trainer = trainer
+
+    def _save(self, model):
+        ckpt_idx = self._counter     # the index _save is about to use
+        super()._save(model)
+        t = self._trainer
+        meta = {
+            "iteration_count": int(model.iteration_count),
+            "epoch_count": int(model.epoch_count),
+            "iters_into_epoch": int(max(
+                model.iteration_count - t._epoch_start_iter, 0)),
+        }
+        # atomic like the checkpoint itself; written AFTER the zip is
+        # submitted so the worker's rotate never races a half sidecar
+        tmp = self.dir / f".checkpoint_{ckpt_idx}.meta.json.tmp"
+        tmp.write_text(json.dumps(meta))
+        os.replace(tmp, self.dir / f"checkpoint_{ckpt_idx}.meta.json")
+
+    def _rotate(self):
+        super()._rotate()
+        # drop sidecars whose checkpoint was rotated away
+        for mp in self.dir.glob("checkpoint_*.meta.json"):
+            zp = mp.with_name(mp.name.replace(".meta.json", ".zip"))
+            if not zp.exists():
+                try:
+                    mp.unlink()
+                except OSError:
+                    pass
+
+    @staticmethod
+    def read_meta(checkpoint_path: Optional[Path]) -> Optional[dict]:
+        if checkpoint_path is None:
+            return None
+        mp = Path(checkpoint_path).with_name(
+            Path(checkpoint_path).stem + ".meta.json")
+        if not mp.exists():
+            return None
+        try:
+            return json.loads(mp.read_text())
+        except (OSError, ValueError):
+            return None
+
+
 class FaultTolerantTrainer:
-    """Resumable training loop (SURVEY.md §5.3: checkpoint-restart is
-    the framework's elasticity story, matching the reference's actual
-    guarantees). Restores the newest loadable checkpoint at
-    construction; ``fit`` then trains with periodic atomic checkpoints.
+    """Resumable, preemption-tolerant training loop (SURVEY.md §5.3;
+    ROADMAP item 4). Restores the newest loadable checkpoint at
+    construction; ``fit`` then trains with periodic atomic checkpoints
+    and three fault-tolerance behaviors on top:
+
+    - **preemption capture**: a SIGTERM (``common.faults``) is caught
+      as a flag, the current step finishes, one final checkpoint is
+      made durable, and :class:`~deeplearning4j_tpu.common.faults.
+      TrainingPreempted` is raised — re-running the same command
+      resumes with nothing lost;
+    - **auto-resume**: any other training failure triggers a
+      supervised in-process retry (``DL4J_TPU_RESUME_RETRIES`` /
+      ``DL4J_TPU_RESUME_BACKOFF``, capped exponential backoff) from
+      the newest VALID checkpoint — a torn/corrupt newest file is
+      skipped;
+    - **exact mid-epoch resume** (MLN/ComputationGraph): a
+      ``checkpoint_N.meta.json`` sidecar records the batch offset into
+      the epoch, and the resumed loop skips exactly those batches.
+      SameDiff models fall back to whole-epoch resume granularity
+      (their fit owns the epoch loop).
 
     Usage::
 
@@ -298,6 +436,12 @@ class FaultTolerantTrainer:
                                        save_every_n_iterations=100)
         trainer.fit(train_iter, n_epochs=10)   # safe to re-run after
                                                # a crash: it resumes
+
+    Note the trainer drives the epoch/batch loop itself for MLN/graph
+    models (batch-at-a-time ``model.fit(ds)``), so extra listeners
+    should be attached to ``trainer.model`` AFTER construction and are
+    re-attached on in-process resume only if registered via
+    :meth:`add_listeners`.
     """
 
     def __init__(self, model_factory, save_dir, *,
@@ -305,38 +449,187 @@ class FaultTolerantTrainer:
                  save_every_n_epochs: int = 1,
                  keep_last: int = 3, asynchronous: bool = True):
         self.save_dir = Path(save_dir)
-        restored = None
-        if CheckpointListener.available_checkpoints(self.save_dir):
-            restored = CheckpointListener.load_checkpoint(self.save_dir)
+        self._factory = model_factory
+        self._extra_listeners: List = []
+        restored, cp_path = self._load_newest()
         self.model = restored if restored is not None \
             else model_factory()
         self.resumed = restored is not None
-        self._listener = CheckpointListener(
-            self.save_dir,
+        self._skip_batches = 0
+        self._epoch_start_iter = self.model.iteration_count
+        if self.resumed:
+            faults.note_resume("restart")
+            self._apply_resume_meta(cp_path)
+        self._listener = _ResumableCheckpointListener(
+            self, self.save_dir,
             save_every_n_iterations=save_every_n_iterations,
             save_every_n_epochs=save_every_n_epochs,
             keep_last=keep_last, asynchronous=asynchronous)
         # continue numbering after existing checkpoints
         self._listener.resume_numbering()
         self.model.add_listeners(self._listener)
+        # SIGTERM becomes a cooperative flag checked at step boundaries
+        self._guard = faults.install_preemption_capture()
 
+    # ------------------------------------------------------------------
+    def add_listeners(self, *listeners):
+        """Attach extra listeners that survive in-process resume (the
+        resume replaces ``self.model`` with a restored instance)."""
+        self._extra_listeners.extend(listeners)
+        self.model.add_listeners(*listeners)
+        return self
+
+    def _load_newest(self):
+        """(model, path) of the newest LOADABLE checkpoint — corrupt/
+        torn files are skipped with a warning; (None, None) when the
+        dir has nothing loadable."""
+        for cp in reversed(
+                CheckpointListener.available_checkpoints(self.save_dir)):
+            try:
+                return CheckpointListener._restore_any(cp), cp
+            except Exception as e:        # corrupt / partial file
+                log.warning("skipping unreadable checkpoint %s: %s",
+                            cp, e)
+        return None, None
+
+    def _apply_resume_meta(self, cp_path):
+        """Set the mid-epoch batch skip from the checkpoint's sidecar
+        (only when the sidecar matches the restored counters — a
+        fallback past a torn newest file resumes at epoch
+        granularity)."""
+        meta = _ResumableCheckpointListener.read_meta(cp_path)
+        self._skip_batches = 0
+        if meta and int(meta.get("iteration_count", -1)) == \
+                self.model.iteration_count:
+            self._skip_batches = max(
+                int(meta.get("iters_into_epoch", 0)), 0)
+        self._epoch_start_iter = (self.model.iteration_count
+                                  - self._skip_batches)
+
+    # ------------------------------------------------------------------
     def fit(self, data, *, n_epochs: int = 1):
         """Train until ``n_epochs`` TOTAL epochs are done — a resumed
         job runs only the remaining epochs, so crash + re-run converges
-        to the same amount of training as an uncrashed run."""
-        remaining = n_epochs - self.model.epoch_count
-        if remaining <= 0:
+        to the same amount of training as an uncrashed run.  Failures
+        are retried in-process from the newest valid checkpoint; a
+        captured preemption exits via :class:`TrainingPreempted` after
+        a final durable checkpoint."""
+        attempt = 0
+        while True:
+            try:
+                return self._fit_once(data, n_epochs)
+            except faults.TrainingPreempted:
+                raise
+            except Exception as e:       # noqa: BLE001 — supervised
+                attempt += 1
+                retries = faults.resume_retries()
+                if attempt > retries:
+                    raise
+                delay = faults.resume_backoff(attempt)
+                log.warning(
+                    "training attempt failed (%r); resuming from the "
+                    "newest checkpoint in %.1fs (retry %d/%d)",
+                    e, delay, attempt, retries)
+                if delay > 0:
+                    time.sleep(delay)
+                self._resume_from_disk()
+
+    def _fit_once(self, data, n_epochs: int):
+        m = self.model
+        if n_epochs - m.epoch_count <= 0:
             log.info("fit: %d epochs already done, nothing to do",
-                     self.model.epoch_count)
-            return self.model
-        self.model.fit(data, n_epochs=remaining)
+                     m.epoch_count)
+            return m
+        if callable(getattr(m, "_fit_batch", None)) or \
+                callable(getattr(m, "_fit_dataset", None)):
+            self._fit_epochs(m, data, n_epochs)
+        else:
+            # SameDiff-style models own their epoch loop: whole-epoch
+            # resume granularity, preemption checked between epochs
+            m.fit(data, n_epochs=n_epochs - m.epoch_count)
+            if faults.preemption_requested():
+                self._preempt_exit(m)
         # final checkpoint — skipped when the epoch-end listener just
         # saved this exact state (don't burn a rotation slot on a dup)
-        state = (self.model.iteration_count, self.model.epoch_count)
+        state = (m.iteration_count, m.epoch_count)
         if getattr(self._listener, "_last_saved_state", None) != state:
-            self._listener._save(self.model)
+            self._listener._save(m)
         self._listener.flush()   # checkpoints durable before return
-        return self.model
+        return m
+
+    def _fit_epochs(self, m, data, n_epochs: int):
+        """Trainer-driven epoch/batch loop for MLN/ComputationGraph —
+        mirrors ``model.fit(iterator)`` (listener order, epoch-count
+        bump before ``on_epoch_end``) but trains one batch per
+        ``model.fit(ds)`` call so preemption is checked and the resume
+        sidecar stays exact at every step boundary."""
+        while m.epoch_count < n_epochs:
+            skip, self._skip_batches = self._skip_batches, 0
+            for lis in m.listeners:
+                lis.on_epoch_start(m)
+            if hasattr(data, "reset"):
+                data.reset()
+            self._epoch_start_iter = m.iteration_count - skip
+            if skip:
+                log.info("resuming mid-epoch: skipping %d already-"
+                         "trained batches of epoch %d", skip,
+                         m.epoch_count)
+            for i, ds in enumerate(data):
+                if i < skip:
+                    continue     # trained before the failure
+                m.fit(ds)
+                if faults.preemption_requested():
+                    self._preempt_exit(m)
+            if hasattr(m, "flush_accumulated"):
+                m.flush_accumulated()
+            m.epoch_count += 1
+            # the new epoch starts AFTER the bump: an epoch-end save's
+            # sidecar must say iters_into_epoch=0
+            self._epoch_start_iter = m.iteration_count
+            for lis in m.listeners:
+                lis.on_epoch_end(m)
+            if faults.preemption_requested():
+                self._preempt_exit(m)
+
+    def _preempt_exit(self, m):
+        """Coordinated final snapshot + clean resumable exit."""
+        state = (m.iteration_count, m.epoch_count)
+        if getattr(self._listener, "_last_saved_state", None) != state:
+            self._listener._save(m)
+        self._listener.flush()
+        cm = faults.chaos_monkey()
+        if cm is not None:
+            cm.maybe_tear(self.save_dir)     # chaos: torn final file
+        log.warning("preemption captured at iteration %d (epoch %d); "
+                    "final checkpoint durable in %s", state[0],
+                    state[1], self.save_dir)
+        raise faults.TrainingPreempted(
+            f"preempted at iteration {state[0]} (epoch {state[1]}); "
+            f"resumable from {self.save_dir}")
+
+    def _resume_from_disk(self):
+        """In-process resume: reload the newest valid checkpoint (or a
+        fresh model if nothing is loadable), re-attach listeners, and
+        account the lost steps."""
+        try:
+            self._listener.flush()
+        except Exception as e:    # noqa: BLE001 — part of the failure
+            log.warning("in-flight checkpoint write failed during "
+                        "resume: %r", e)
+        it_before = getattr(self.model, "iteration_count", 0)
+        restored, cp_path = self._load_newest()
+        if restored is None:
+            log.warning("no loadable checkpoint in %s; restarting "
+                        "from a fresh model", self.save_dir)
+            restored = self._factory()
+        self.model = restored
+        faults.note_resume(
+            "inprocess",
+            lost_steps=max(it_before - restored.iteration_count, 0))
+        self._apply_resume_meta(cp_path)
+        self._listener.resume_numbering()
+        self.model.add_listeners(self._listener,
+                                 *self._extra_listeners)
 
 
 class MultiHostCheckpointManager:

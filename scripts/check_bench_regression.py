@@ -27,7 +27,9 @@ The PR-9 observatory blocks are understood natively: in ``scaling``,
 per-size ``efficiency`` entries are higher-is-better and ``skew``
 entries lower-is-better (matched on the full dotted path, since the
 leaves are bare size/worker labels); ``step_breakdown`` phase means
-gate as time-like seconds.
+gate as time-like seconds.  The ``fault_tolerance`` block's stall /
+ratio / resume-latency figures gate as lower-is-better, as do any
+``lost_steps`` counts.
 
 Self-test (tier-1, no accelerator): comparing the checked-in
 BENCH_r04.json to BENCH_r05.json must pass (r05 improved), and the
@@ -45,7 +47,7 @@ HIGHER_BETTER = ("value", "tflops", "throughput", "_ips", "_rps",
                  "efficiency", "savings_ratio")
 #: metrics where smaller is better
 LOWER_BETTER = ("_ms", "_us", "_seconds", "overhead", "stall", "skew",
-                "_bytes_per_chip")
+                "_bytes_per_chip", "lost_steps")
 #: keys that are identity/config, never compared; "canary" keys are
 #: clock-path checks documented as dispatch-noise-dominated
 SKIP = ("metric", "unit", "n_trials", "vs_baseline", "meta", "min",

@@ -16,7 +16,12 @@
 #   3. fsdp residency gate: the ZeRO-3 bench leg on the virtual
 #      8-device CPU mesh must measure per-chip param + updater-state
 #      residency <= 1/4 of dense (the ISSUE 10 acceptance bar,
-#      benchmarks/bench_fsdp.py).
+#      benchmarks/bench_fsdp.py);
+#   4. chaos gate: a REAL SIGTERM mid-epoch in a subprocess must exit
+#      75 after a final snapshot, and re-running the same command must
+#      auto-resume onto the uninterrupted loss/parameter trajectory
+#      with zero manual steps (the ISSUE 11 acceptance bar,
+#      tests/test_chaos.py).
 #
 # Usage: scripts/ci_check.sh [--threshold PCT]     (default 10)
 # Exit 0 = all gates clean, 1 = a gate failed, 2 = bad usage.
@@ -60,5 +65,9 @@ verdict = "OK" if ok else "FAIL: above 1/4 of dense"
 ratio = rec.get("hbm_total_savings_ratio")
 print(f"fsdp per-chip residency savings: {ratio}x ({verdict})")
 sys.exit(0 if ok else 1)' || fail=1
+
+echo "== chaos / auto-resume gate =="
+JAX_PLATFORMS=cpu python -m pytest tests/test_chaos.py -q \
+    -p no:cacheprovider || fail=1
 
 exit $fail

@@ -1,0 +1,131 @@
+"""Chaos harness (ISSUE 11 acceptance): a real SIGTERM mid-epoch in a
+REAL subprocess must produce a clean resumable exit (code 75), and
+re-running the same command must auto-resume and land on the exact
+loss/parameter trajectory of an uninterrupted run — zero manual steps.
+
+The child trains a deterministic MLN through FaultTolerantTrainer; the
+DL4J_TPU_CHAOS env var is the only thing the legs vary."""
+import json
+import os
+import subprocess
+import sys
+import textwrap
+
+import numpy as np
+import pytest
+
+_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+_CHILD = textwrap.dedent("""
+    import json, sys
+    import numpy as np
+    from deeplearning4j_tpu.activations import Activation
+    from deeplearning4j_tpu.common import faults
+    from deeplearning4j_tpu.datasets.dataset import DataSet
+    from deeplearning4j_tpu.learning import Adam
+    from deeplearning4j_tpu.lossfunctions import LossFunction
+    from deeplearning4j_tpu.nn import (InputType, MultiLayerNetwork,
+                                       NeuralNetConfiguration)
+    from deeplearning4j_tpu.nn.conf.layers import DenseLayer, OutputLayer
+    from deeplearning4j_tpu.utils import FaultTolerantTrainer
+
+    ckpt_dir, out_path = sys.argv[1], sys.argv[2]
+
+    def factory():
+        conf = (NeuralNetConfiguration.Builder().seed(0)
+                .updater(Adam(1e-2)).list()
+                .layer(DenseLayer(n_out=8, activation=Activation.RELU))
+                .layer(OutputLayer(n_out=2,
+                                   loss_function=LossFunction.MCXENT,
+                                   activation=Activation.SOFTMAX))
+                .set_input_type(InputType.feed_forward(4)).build())
+        return MultiLayerNetwork(conf).init()
+
+    rng = np.random.RandomState(0)
+    x = rng.randn(48, 4).astype(np.float32)
+    y = np.eye(2, dtype=np.float32)[(x[:, 0] > 0).astype(int)]
+    batches = [DataSet(x[i * 8:(i + 1) * 8], y[i * 8:(i + 1) * 8])
+               for i in range(6)]
+
+    trainer = FaultTolerantTrainer(factory, ckpt_dir,
+                                   save_every_n_iterations=3)
+    try:
+        trainer.fit(batches, n_epochs=2)
+    except faults.TrainingPreempted as e:
+        sys.exit(e.exit_code)          # 75: "re-run me to resume"
+    m = trainer.model
+    leaves = [np.asarray(v).tolist() for v in
+              __import__("jax").tree_util.tree_leaves(m.params)]
+    with open(out_path, "w") as f:
+        json.dump({"iteration_count": m.iteration_count,
+                   "epoch_count": m.epoch_count,
+                   "score": float(m.score(batches[0])),
+                   "params": leaves}, f)
+""")
+
+
+def _run_child(tmp, ckpt_dir, out, chaos=""):
+    env = {**os.environ, "JAX_PLATFORMS": "cpu", "PYTHONPATH": _ROOT,
+           "DL4J_TPU_CHAOS": chaos,
+           # keep the child lean and artifact-free
+           "DL4J_TPU_FLIGHT_RECORDER": "0",
+           "DL4J_TPU_RESUME_BACKOFF": "0.0"}
+    script = tmp / "train_child.py"
+    if not script.exists():
+        script.write_text(_CHILD)
+    return subprocess.run(
+        [sys.executable, str(script), str(ckpt_dir), str(out)],
+        capture_output=True, text=True, timeout=300, cwd=str(tmp),
+        env=env)
+
+
+@pytest.fixture(scope="module")
+def baseline(tmp_path_factory):
+    """One uninterrupted run: the trajectory every chaos leg must hit."""
+    tmp = tmp_path_factory.mktemp("chaos_baseline")
+    out = tmp / "final.json"
+    r = _run_child(tmp, tmp / "ckpts", out)
+    assert r.returncode == 0, r.stderr[-2000:]
+    return json.loads(out.read_text())
+
+
+def _assert_same_trajectory(final, base):
+    assert final["iteration_count"] == base["iteration_count"]
+    assert final["epoch_count"] == base["epoch_count"]
+    np.testing.assert_allclose(final["score"], base["score"],
+                               rtol=1e-6, atol=1e-8)
+    for a, b in zip(final["params"], base["params"]):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-6, atol=1e-7)
+
+
+def test_sigterm_mid_epoch_resumes_to_identical_trajectory(
+        tmp_path, baseline):
+    """kill_after_steps fires a REAL SIGTERM at step 7 (mid epoch 1):
+    the run exits 75 after a final snapshot; the identical re-run
+    resumes mid-epoch and finishes on the baseline trajectory."""
+    ckpts, out = tmp_path / "ckpts", tmp_path / "final.json"
+    r1 = _run_child(tmp_path, ckpts, out,
+                    chaos="kill_after_steps=7")
+    assert r1.returncode == 75, (r1.returncode, r1.stderr[-2000:])
+    assert not out.exists()            # the first run never finished
+    assert any(p.suffix == ".zip" for p in ckpts.iterdir())
+    r2 = _run_child(tmp_path, ckpts, out)          # same command again
+    assert r2.returncode == 0, r2.stderr[-2000:]
+    _assert_same_trajectory(json.loads(out.read_text()), baseline)
+
+
+def test_torn_final_checkpoint_falls_back_and_still_matches(
+        tmp_path, baseline):
+    """torn_checkpoint truncates the preemption snapshot after it is
+    written: resume must skip the torn newest file, fall back to the
+    last cadence checkpoint, and STILL converge to the baseline (the
+    sidecar of the fallback checkpoint keeps the resume exact)."""
+    ckpts, out = tmp_path / "ckpts", tmp_path / "final.json"
+    r1 = _run_child(tmp_path, ckpts, out,
+                    chaos="kill_after_steps=5,torn_checkpoint=1")
+    assert r1.returncode == 75, (r1.returncode, r1.stderr[-2000:])
+    r2 = _run_child(tmp_path, ckpts, out)
+    assert r2.returncode == 0, r2.stderr[-2000:]
+    assert "skipping unreadable checkpoint" in r2.stderr
+    _assert_same_trajectory(json.loads(out.read_text()), baseline)

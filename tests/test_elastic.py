@@ -1,0 +1,190 @@
+"""Elastic world-size changes (ISSUE 11 tentpole c): resuming or
+re-placing onto a mesh with a DIFFERENT device count must continue the
+exact dense trajectory — dense/sharded/fsdp layouts round-trip through
+the dense layout and re-ravel for the new shard count."""
+import jax
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.activations import Activation
+from deeplearning4j_tpu.datasets.dataset import DataSet
+from deeplearning4j_tpu.learning.updaters import Adam, is_fsdp
+from deeplearning4j_tpu.lossfunctions import LossFunction
+from deeplearning4j_tpu.nn.conf.builders import NeuralNetConfiguration
+from deeplearning4j_tpu.nn.conf.inputs import InputType
+from deeplearning4j_tpu.nn.conf.layers import DenseLayer, OutputLayer
+from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+from deeplearning4j_tpu.nn.weights import WeightInit
+from deeplearning4j_tpu.parallel import ParallelWrapper, UpdateExchange
+from deeplearning4j_tpu.parallel.zero import (DP_SHARDED_KEY,
+                                              fsdp_spec_shards,
+                                              states_to_dense,
+                                              states_to_sharded,
+                                              to_sharded_state)
+
+
+def _mlp(seed=42):
+    conf = (NeuralNetConfiguration.Builder()
+            .seed(seed).updater(Adam(0.01))
+            .weight_init(WeightInit.XAVIER)
+            .list()
+            .layer(DenseLayer(n_in=8, n_out=16,
+                              activation=Activation.TANH))
+            .layer(OutputLayer(n_out=3,
+                               loss_function=LossFunction.MCXENT,
+                               activation=Activation.SOFTMAX))
+            .set_input_type(InputType.feed_forward(8))
+            .build())
+    return MultiLayerNetwork(conf).init()
+
+
+def _data(n=64, seed=0):
+    rng = np.random.RandomState(seed)
+    x = rng.randn(n, 8).astype(np.float32)
+    y = np.eye(3, dtype=np.float32)[rng.randint(0, 3, n)]
+    return DataSet(x, y)
+
+
+def _assert_tree_close(a, b, **kw):
+    la = jax.tree_util.tree_leaves(a)
+    lb = jax.tree_util.tree_leaves(b)
+    assert len(la) == len(lb)
+    for x, y in zip(la, lb):
+        np.testing.assert_allclose(np.asarray(x), np.asarray(y), **kw)
+
+
+# -- updater-state re-ravel unit level --------------------------------------
+def test_to_sharded_state_re_ravels_for_new_world_size():
+    """Flat ZeRO-1 state raveled for 8 shards fed to an n=4 conversion
+    must re-pad for 4 (not silently keep the 8-way padding), with the
+    dense values preserved exactly."""
+    net = _mlp()
+    net.fit(_data())                       # materialize updater state
+    dense = jax.tree_util.tree_map(np.asarray, net.updater_states)
+    s8 = states_to_sharded(net.params, net.updater_states, 8)
+    # same shard count: conversion is a no-op (identity)
+    for k, sub in s8.items():
+        if sub:
+            assert to_sharded_state(net.params[k], sub, 8) is sub
+    s4 = states_to_sharded(net.params, s8, 4)
+    for k, sub in s4.items():
+        if not sub:
+            continue
+        for flats in sub[DP_SHARDED_KEY].values():
+            for flat in flats.values():
+                assert flat.shape[0] % 4 == 0
+    back = states_to_dense(net.params, s4)
+    _assert_tree_close(dense, back, rtol=0, atol=0)
+
+
+def test_fsdp_spec_shards_reads_world_size():
+    from deeplearning4j_tpu.parallel.zero import params_to_fsdp
+    net = _mlp()
+    _, specs = params_to_fsdp(net.params, 8)
+    assert fsdp_spec_shards(specs) == 8
+    assert fsdp_spec_shards({}) is None
+    assert fsdp_spec_shards(None) is None
+
+
+# -- remesh trajectory equivalence ------------------------------------------
+@pytest.mark.parametrize("mode", ["sharded", "fsdp"])
+def test_remesh_8_4_8_continues_dense_trajectory(mode):
+    """The ISSUE acceptance test: train 2 batches on an 8-way mesh,
+    remesh to 4, train 2, remesh back to 8, train 2 — parameters must
+    track a fixed dense 8-way run batch for batch (data-parallel SGD
+    is world-size invariant for divisible batches)."""
+    batches = [_data(64, seed=i) for i in range(6)]
+    ref = _mlp(seed=7)
+    pw_ref = ParallelWrapper.Builder(ref).workers(8) \
+        .update_exchange("dense").build()
+    el = _mlp(seed=7)
+    pw_el = ParallelWrapper.Builder(el).workers(8) \
+        .update_exchange(mode).build()
+
+    def dense(m):
+        return m.dense_params() if hasattr(m, "dense_params") \
+            else m.params
+
+    for i, ds in enumerate(batches):
+        if i == 2:
+            pw_el.remesh(workers=4)        # shrink: 8 -> 4
+        elif i == 4:
+            pw_el.remesh(workers=8)        # grow back: 4 -> 8
+        pw_ref.fit_batch(ds)
+        pw_el.fit_batch(ds)
+        _assert_tree_close(ref.params, dense(el), rtol=2e-5, atol=1e-6)
+    if mode == "fsdp":
+        # flats really re-raveled to each world size along the way
+        assert pw_el.update_exchange is UpdateExchange.FSDP
+        assert all(is_fsdp(p) for p in el.params.values())
+        for flat in jax.tree_util.tree_leaves(el.params):
+            assert len(flat.addressable_shards) == 8
+        assert fsdp_spec_shards(el._fsdp_specs) == 8
+
+
+def test_remesh_fsdp_shrink_re_shards_residency():
+    net = _mlp(seed=3)
+    pw = ParallelWrapper.Builder(net).workers(8) \
+        .update_exchange("fsdp").build()
+    pw.fit_batch(_data(64, seed=0))
+    for flat in jax.tree_util.tree_leaves(net.params):
+        assert len(flat.addressable_shards) == 8
+    pw.remesh(workers=4)
+    pw.fit_batch(_data(64, seed=1))
+    assert pw.n_workers == 4
+    for flat in jax.tree_util.tree_leaves(net.params):
+        assert len(flat.addressable_shards) == 4
+    assert fsdp_spec_shards(net._fsdp_specs) == 4
+
+
+def test_remesh_mode_change_fsdp_to_dense_densifies():
+    """A wrapper re-placing a previously-fsdp-resident model with a
+    dense exchange must densify the stale flats first (the layout must
+    always match the exchange about to consume it)."""
+    net = _mlp(seed=5)
+    ParallelWrapper.Builder(net).workers(8).update_exchange("fsdp") \
+        .build().fit_batch(_data(64, seed=0))
+    assert all(is_fsdp(p) for p in net.params.values())
+    pw = ParallelWrapper.Builder(net).workers(8) \
+        .update_exchange("dense").build()
+    pw.fit_batch(_data(64, seed=1))
+    assert pw.update_exchange is UpdateExchange.DENSE
+    assert not any(is_fsdp(p) for p in net.params.values())
+    assert np.isfinite(float(net.score(_data(32, seed=9))))
+
+
+# -- checkpoint resume across world sizes -----------------------------------
+@pytest.mark.parametrize("mode,shrink", [
+    ("sharded", 4), ("fsdp", 4), ("fsdp", 8),
+], ids=["sharded-8to4", "fsdp-8to4", "fsdp-8to8"])
+def test_checkpoint_resume_on_new_world_size_continues_trajectory(
+        tmp_path, mode, shrink):
+    """Kill-and-restart flavor of elasticity: a checkpoint written
+    under an 8-way run restores and CONTINUES on a different device
+    count, matching the uninterrupted dense trajectory."""
+    from deeplearning4j_tpu.utils import CheckpointListener
+    batches = [_data(64, seed=i) for i in range(4)]
+    ref = _mlp(seed=11)
+    pw_ref = ParallelWrapper.Builder(ref).workers(8) \
+        .update_exchange("dense").build()
+    for ds in batches:
+        pw_ref.fit_batch(ds)
+
+    net = _mlp(seed=11)
+    lis = CheckpointListener(tmp_path, save_every_n_iterations=2)
+    net.set_listeners(lis)
+    pw = ParallelWrapper.Builder(net).workers(8) \
+        .update_exchange(mode).build()
+    for ds in batches[:2]:
+        pw.fit_batch(ds)
+    lis.flush()
+
+    restored = CheckpointListener.load_checkpoint(tmp_path)
+    assert restored.iteration_count == 2
+    pw2 = ParallelWrapper.Builder(restored).workers(shrink) \
+        .update_exchange(mode).build()
+    for ds in batches[2:]:
+        pw2.fit_batch(ds)
+    dense = restored.dense_params() \
+        if hasattr(restored, "dense_params") else restored.params
+    _assert_tree_close(ref.params, dense, rtol=2e-5, atol=1e-6)

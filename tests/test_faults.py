@@ -1,0 +1,421 @@
+"""Preemption capture, resume policy, chaos parsing, and the two
+robustness satellites (ISSUE 11): the StepStatsClient reconnect and the
+ParallelInference shutdown future-cancel guarantee."""
+import json
+import os
+import signal
+import socket
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.common import faults, telemetry
+from deeplearning4j_tpu.common.diagnostics import FlightRecorder
+from deeplearning4j_tpu.common.environment import Environment
+from deeplearning4j_tpu.common.telemetry import MetricsRegistry
+
+
+@pytest.fixture(autouse=True)
+def _clean_state():
+    MetricsRegistry._reset_for_tests()   # also resets faults + guard
+    FlightRecorder._reset_for_tests()
+    yield
+    MetricsRegistry._reset_for_tests()
+    FlightRecorder._reset_for_tests()
+    Environment.reset()
+
+
+def _wait(cond, timeout=5.0):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if cond():
+            return True
+        time.sleep(0.01)
+    return cond()
+
+
+# -- preemption capture ------------------------------------------------------
+class TestPreemptionGuard:
+    def test_sigterm_becomes_flag_and_counter(self):
+        guard = faults.install_preemption_capture()
+        assert not faults.preemption_requested()
+        os.kill(os.getpid(), signal.SIGTERM)
+        assert _wait(faults.preemption_requested)
+        # the process survived (we are still running) and the notice
+        # was counted by reason
+        assert telemetry.counter(
+            "dl4j_preemption_total", "").value(reason="sigterm") == 1
+        guard.clear()
+        assert not faults.preemption_requested()
+
+    def test_install_idempotent(self):
+        g1 = faults.install_preemption_capture()
+        g2 = faults.install_preemption_capture()
+        assert g1 is g2
+
+    def test_cooperative_request_without_signal(self):
+        faults.PreemptionGuard.get().request("maintenance")
+        assert faults.preemption_requested()
+        assert telemetry.counter(
+            "dl4j_preemption_total", "").value(
+                reason="maintenance") == 1
+
+    @pytest.mark.parametrize("guard_first", [True, False],
+                             ids=["guard-then-recorder",
+                                  "recorder-then-guard"])
+    def test_composes_with_flight_recorder_either_order(
+            self, guard_first, tmp_path, monkeypatch):
+        """Whatever the SIGTERM handler install order, one notice must
+        set the flag and the process must SURVIVE to snapshot (the
+        recorder's solo fallback re-delivers the signal fatally)."""
+        monkeypatch.setenv("DL4J_TPU_FLIGHT_RECORDER", "1")
+        monkeypatch.setenv("DL4J_TPU_FLIGHT_RECORDER_DIR", str(tmp_path))
+        Environment.reset()
+        FlightRecorder._reset_for_tests()
+        if guard_first:
+            faults.install_preemption_capture()
+            FlightRecorder.get().install()
+        else:
+            FlightRecorder.get().install()
+            faults.install_preemption_capture()
+        os.kill(os.getpid(), signal.SIGTERM)
+        assert _wait(faults.preemption_requested)
+
+
+# -- resume policy -----------------------------------------------------------
+class TestResumePolicy:
+    def test_backoff_caps_and_env(self, monkeypatch):
+        monkeypatch.setenv("DL4J_TPU_RESUME_BACKOFF", "2.0")
+        monkeypatch.setenv("DL4J_TPU_RESUME_RETRIES", "7")
+        Environment.reset()
+        assert faults.resume_retries() == 7
+        assert faults.resume_backoff(1) == 2.0
+        assert faults.resume_backoff(2) == 4.0
+        assert faults.resume_backoff(100) == faults.MAX_RESUME_BACKOFF_S
+
+    def test_note_resume_counts_kinds_and_lost_steps(self):
+        faults.note_resume("restart")
+        faults.note_resume("inprocess", lost_steps=5)
+        assert telemetry.counter(
+            "dl4j_resume_total", "").value(kind="restart") == 1
+        assert telemetry.counter(
+            "dl4j_resume_total", "").value(kind="inprocess") == 1
+        assert telemetry.counter(
+            "dl4j_lost_steps_total", "").value() == 5
+
+
+# -- chaos monkey ------------------------------------------------------------
+class TestChaosMonkey:
+    def test_spec_parsing(self):
+        cm = faults.ChaosMonkey(
+            "kill_after_steps=5, slow_worker=0.25,"
+            "torn_checkpoint=1,bogus_directive=3")
+        assert cm.kill_after == 5
+        assert cm.slow == 0.25
+        assert cm.torn is True
+        assert cm.hard_kill_after == 0
+
+    def test_env_gate_parsed_once(self, monkeypatch):
+        monkeypatch.delenv("DL4J_TPU_CHAOS", raising=False)
+        assert faults.chaos_monkey() is None
+        faults._reset_for_tests()
+        monkeypatch.setenv("DL4J_TPU_CHAOS", "hard_kill_after_steps=9")
+        cm = faults.chaos_monkey()
+        assert cm is not None and cm.hard_kill_after == 9
+        assert faults.chaos_monkey() is cm      # cached
+
+    def test_slow_worker_injects_and_counts(self):
+        cm = faults.ChaosMonkey("slow_worker=0.01")
+        t0 = time.perf_counter()
+        cm.on_step()
+        assert time.perf_counter() - t0 >= 0.01
+        assert telemetry.counter(
+            "dl4j_chaos_injections_total", "").value(
+                kind="slow_worker") == 1
+
+    def test_maybe_tear_truncates_newest_once(self, tmp_path):
+        cp = tmp_path / "checkpoint_0.zip"
+        cp.write_bytes(b"x" * 300)
+        cm = faults.ChaosMonkey("torn_checkpoint=1")
+        assert cm.maybe_tear(tmp_path)
+        assert cp.stat().st_size == 100
+        assert not cm.maybe_tear(tmp_path)      # fires once
+
+
+# -- StepStatsClient reconnect (satellite #2) --------------------------------
+class _MiniLeader:
+    """A throwaway observatory leader: accepts connections, answers the
+    clock handshake, and collects shipped records."""
+
+    def __init__(self, port=0):
+        self.srv = socket.socket()
+        self.srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self.srv.bind(("127.0.0.1", port))
+        self.srv.listen(4)
+        self.port = self.srv.getsockname()[1]
+        self.records = []
+        self.conns = []
+        self._closing = False
+        threading.Thread(target=self._accept, daemon=True).start()
+
+    def _accept(self):
+        while not self._closing:
+            try:
+                conn, _ = self.srv.accept()
+            except OSError:
+                return
+            self.conns.append(conn)
+            threading.Thread(target=self._serve, args=(conn,),
+                             daemon=True).start()
+
+    def _serve(self, conn):
+        try:
+            f = conn.makefile("rwb")
+            json.loads(f.readline().decode())          # hello
+            f.write(json.dumps(
+                {"t_leader": time.time()}).encode() + b"\n")
+            f.flush()
+            f.readline()                               # offset
+            for line in f:
+                self.records.append(json.loads(line.decode()))
+        except (OSError, ValueError):
+            pass
+
+    def drop_connections(self):
+        for c in self.conns:
+            try:
+                c.shutdown(socket.SHUT_RDWR)
+                c.close()
+            except OSError:
+                pass
+        self.conns = []
+
+    def close(self):
+        self._closing = True
+        try:
+            self.srv.close()
+        except OSError:
+            pass
+
+
+class TestStepStatsClientReconnect:
+    def test_reconnects_after_leader_drop(self):
+        from deeplearning4j_tpu.common.stepstats import StepStatsClient
+        leader = _MiniLeader()
+        client = StepStatsClient("127.0.0.1", leader.port, worker=0,
+                                 reconnect_backoff=0.05)
+        try:
+            client.ship({"seq": 1})
+            assert _wait(lambda: any(r.get("seq") == 1
+                                     for r in leader.records))
+            # leader drops every connection (e.g. restarted after its
+            # own preemption): shipping fails but schedules a retry
+            leader.drop_connections()
+            deadline = time.monotonic() + 5
+            while not client._dead and time.monotonic() < deadline:
+                client.ship({"seq": 2})
+                time.sleep(0.01)
+            assert client._dead        # failure noticed, not fatal
+            # ... and the next ships reconnect and deliver again
+            deadline = time.monotonic() + 10
+            while time.monotonic() < deadline and not any(
+                    r.get("seq") == 3 for r in leader.records):
+                client.ship({"seq": 3})
+                time.sleep(0.05)
+            assert any(r.get("seq") == 3 for r in leader.records)
+            assert not client._dead
+        finally:
+            client.close()
+            leader.close()
+
+    def test_reconnect_backoff_is_bounded(self):
+        from deeplearning4j_tpu.common.stepstats import StepStatsClient
+        leader = _MiniLeader()
+        client = StepStatsClient("127.0.0.1", leader.port, worker=0,
+                                 reconnect_backoff=0.05, max_backoff=0.2)
+        try:
+            leader.close()             # nothing to reconnect to
+            leader.drop_connections()
+            for _ in range(50):
+                client.ship({"x": 1})
+            # the streak grew but the scheduled delay stays capped
+            delay = client._retry_at - time.monotonic()
+            assert delay <= 0.2 + 0.05
+        finally:
+            client.close()
+
+    def test_close_stops_reconnect_attempts(self):
+        from deeplearning4j_tpu.common.stepstats import StepStatsClient
+        leader = _MiniLeader()
+        client = StepStatsClient("127.0.0.1", leader.port, worker=0,
+                                 reconnect_backoff=0.0)
+        client.close()
+        client.ship({"x": 1})          # must not raise or reconnect
+        assert client._dead
+        leader.close()
+
+
+# -- ParallelInference shutdown cancel (satellite #1) ------------------------
+class TestInferenceShutdownCancel:
+    def _net(self):
+        from deeplearning4j_tpu.activations import Activation
+        from deeplearning4j_tpu.learning import Sgd
+        from deeplearning4j_tpu.lossfunctions import LossFunction
+        from deeplearning4j_tpu.nn import (InputType, MultiLayerNetwork,
+                                           NeuralNetConfiguration)
+        from deeplearning4j_tpu.nn.conf.layers import (DenseLayer,
+                                                       OutputLayer)
+        conf = (NeuralNetConfiguration.Builder().seed(0)
+                .updater(Sgd(0.1)).list()
+                .layer(DenseLayer(n_out=4, activation=Activation.RELU))
+                .layer(OutputLayer(n_out=2,
+                                   loss_function=LossFunction.MCXENT,
+                                   activation=Activation.SOFTMAX))
+                .set_input_type(InputType.feed_forward(8)).build())
+        return MultiLayerNetwork(conf).init()
+
+    def test_shutdown_cancels_stranded_futures(self):
+        """A request that reaches the queue after the worker died must
+        be CANCELLED by shutdown, not stranded forever (ADVICE.md
+        round 5: a caller blocking on fut.result() with no timeout
+        would otherwise hang)."""
+        import concurrent.futures
+
+        from deeplearning4j_tpu.parallel.inference import \
+            ParallelInference
+        pi = ParallelInference.Builder(self._net()).build()
+        with pi._lock:
+            pi._ensure_worker()
+            worker = pi._worker
+            pi._shutdown = True        # worker exits at idle timeout
+        assert _wait(lambda: not worker.is_alive())
+        # simulate the lost race: an item left behind in the queue of a
+        # dead worker (no flag reset — shutdown must not need one)
+        fut = concurrent.futures.Future()
+        pi._requests.put((np.zeros((1, 8), np.float32), fut,
+                          time.monotonic()))
+        pi.shutdown()
+        assert fut.cancelled()
+        with pytest.raises(concurrent.futures.CancelledError):
+            fut.result(timeout=0)
+
+    def test_shutdown_then_submit_restarts_service(self):
+        from deeplearning4j_tpu.parallel.inference import \
+            ParallelInference
+        pi = ParallelInference.Builder(self._net()).build()
+        x = np.zeros((2, 8), np.float32)
+        assert pi.submit(x).result(timeout=60).shape == (2, 2)
+        pi.shutdown()
+        assert pi.submit(x).result(timeout=60).shape == (2, 2)
+        pi.shutdown()
+
+
+# -- in-process auto-resume (FaultTolerantTrainer) ---------------------------
+class TestInProcessAutoResume:
+    def _factory(self):
+        from deeplearning4j_tpu.activations import Activation
+        from deeplearning4j_tpu.learning import Adam
+        from deeplearning4j_tpu.lossfunctions import LossFunction
+        from deeplearning4j_tpu.nn import (InputType, MultiLayerNetwork,
+                                           NeuralNetConfiguration)
+        from deeplearning4j_tpu.nn.conf.layers import (DenseLayer,
+                                                       OutputLayer)
+        conf = (NeuralNetConfiguration.Builder().seed(0)
+                .updater(Adam(1e-2)).list()
+                .layer(DenseLayer(n_out=8, activation=Activation.RELU))
+                .layer(OutputLayer(n_out=2,
+                                   loss_function=LossFunction.MCXENT,
+                                   activation=Activation.SOFTMAX))
+                .set_input_type(InputType.feed_forward(4)).build())
+        return MultiLayerNetwork(conf).init()
+
+    def _batches(self, n=8):
+        from deeplearning4j_tpu.datasets.dataset import DataSet
+        rng = np.random.RandomState(0)
+        x = rng.randn(8 * n, 4).astype(np.float32)
+        y = np.eye(2, dtype=np.float32)[(x[:, 0] > 0).astype(int)]
+        return [DataSet(x[i * 8:(i + 1) * 8], y[i * 8:(i + 1) * 8])
+                for i in range(n)]
+
+    def test_transient_failure_resumes_and_finishes(self, monkeypatch,
+                                                    tmp_path):
+        from deeplearning4j_tpu.optimize.listeners import TrainingListener
+        from deeplearning4j_tpu.utils import FaultTolerantTrainer
+        monkeypatch.setenv("DL4J_TPU_RESUME_BACKOFF", "0.01")
+        Environment.reset()
+
+        class FailOnce(TrainingListener):
+            fired = False
+
+            def iteration_done(self, model, iteration, epoch):
+                if not FailOnce.fired and iteration >= 5:
+                    FailOnce.fired = True
+                    raise RuntimeError("injected transient fault")
+
+        t = FaultTolerantTrainer(self._factory, tmp_path,
+                                 save_every_n_iterations=4)
+        t.add_listeners(FailOnce())
+        t.fit(self._batches(), n_epochs=2)
+        assert FailOnce.fired
+        assert t.model.epoch_count == 2
+        assert t.model.iteration_count == 16
+        assert telemetry.counter(
+            "dl4j_resume_total", "").value(kind="inprocess") == 1
+        # the failure hit at iteration 6 with the newest checkpoint at
+        # 4: exactly those 2 steps were lost and re-run
+        assert telemetry.counter(
+            "dl4j_lost_steps_total", "").value() == 2
+
+    def test_retries_exhausted_reraises(self, monkeypatch, tmp_path):
+        from deeplearning4j_tpu.optimize.listeners import TrainingListener
+        from deeplearning4j_tpu.utils import FaultTolerantTrainer
+        monkeypatch.setenv("DL4J_TPU_RESUME_BACKOFF", "0.0")
+        monkeypatch.setenv("DL4J_TPU_RESUME_RETRIES", "2")
+        Environment.reset()
+
+        class AlwaysFail(TrainingListener):
+            calls = 0
+
+            def iteration_done(self, model, iteration, epoch):
+                AlwaysFail.calls += 1
+                raise RuntimeError("permanent fault")
+
+        t = FaultTolerantTrainer(self._factory, tmp_path)
+        t.add_listeners(AlwaysFail())
+        with pytest.raises(RuntimeError, match="permanent fault"):
+            t.fit(self._batches(2), n_epochs=1)
+        assert AlwaysFail.calls == 3       # initial + 2 retries
+
+    def test_cooperative_preemption_snapshots_and_resumes_mid_epoch(
+            self, tmp_path):
+        """request() mid-epoch → final checkpoint + TrainingPreempted
+        (exit code 75); a NEW trainer resumes mid-epoch via the meta
+        sidecar and finishes with exactly the full batch count — no
+        batch retrained, none skipped."""
+        from deeplearning4j_tpu.optimize.listeners import TrainingListener
+        from deeplearning4j_tpu.utils import FaultTolerantTrainer
+
+        class PreemptAt(TrainingListener):
+            def iteration_done(self, model, iteration, epoch):
+                # iteration is 0-based: this is the 4th batch
+                if iteration == 3:
+                    faults.PreemptionGuard.get().request("test")
+
+        batches = self._batches()
+        t1 = FaultTolerantTrainer(self._factory, tmp_path)
+        t1.add_listeners(PreemptAt())
+        with pytest.raises(faults.TrainingPreempted) as ei:
+            t1.fit(batches, n_epochs=1)
+        assert ei.value.exit_code == faults.PREEMPTED_EXIT_CODE
+        assert t1.model.iteration_count == 4
+        faults.PreemptionGuard.get().clear()
+
+        t2 = FaultTolerantTrainer(self._factory, tmp_path)
+        assert t2.resumed
+        assert t2.model.iteration_count == 4
+        assert t2._skip_batches == 4       # sidecar: mid-epoch offset
+        t2.fit(batches, n_epochs=1)
+        assert t2.model.iteration_count == len(batches)
+        assert t2.model.epoch_count == 1
