@@ -294,6 +294,32 @@ def main():
             print("fsdp leg: no line in child output", file=sys.stderr)
     except Exception as e:
         print(f"fsdp leg failed: {e!r}", file=sys.stderr)
+    # 2D-parallelism leg: (data x model) and (fsdp x model) training
+    # modes vs dp-only — per-mode step time, per-axis update wire
+    # bytes (the model axis must move zero), and per-chip residency.
+    # CPU-proxy subprocess on the virtual 8-device mesh, like the
+    # legs above.
+    try:
+        env = {**os.environ, "PYTHONPATH": "", "JAX_PLATFORMS": "cpu"}
+        out = subprocess.run(
+            [sys.executable,
+             os.path.join(_ROOT, "benchmarks", "bench_2d.py")],
+            capture_output=True, text=True, timeout=900, env=env,
+            cwd=_ROOT)
+        if out.returncode != 0:
+            raise RuntimeError(
+                f"rc={out.returncode}: {out.stderr.strip()[-400:]}")
+        for ln in out.stdout.strip().splitlines():
+            if not ln.startswith("{"):
+                continue              # tolerate library banners
+            rec = json.loads(ln)
+            if rec.get("metric") == "scaling_2d":
+                rec.pop("metric")
+                line["scaling_2d"] = rec
+        if "scaling_2d" not in line:
+            print("2d leg: no line in child output", file=sys.stderr)
+    except Exception as e:
+        print(f"2d leg failed: {e!r}", file=sys.stderr)
     # Fault-tolerance leg: checkpoint step-loop stall (fully
     # synchronous vs deferred async snapshot) and warm-cache resume
     # latency — the costs the preemption/auto-resume machinery pays.

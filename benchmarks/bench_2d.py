@@ -1,0 +1,150 @@
+"""Benchmark: 2D (data/fsdp x tensor) training modes vs dp-only
+(parallel.speclayout + the 2D step tails).
+
+ISSUE 12 acceptance: the (dp, tp) and (fsdp, tp) modes train on the
+real fit path with the update exchange confined to the ``data`` axis.
+We report, per mode: step wall time and throughput, the per-axis wire
+accounting from ``zero.exchange_report`` (the ``model`` axis must move
+ZERO update bytes; ``cross_axis_bytes`` is what a naive flat ravel of
+the tp leaves would have moved across ``model``), and the measured
+per-chip param residency after placement.
+
+Runs on the virtual 8-device CPU mesh (the same proxy the parallel
+test suite uses), so the byte accounting is exact and the step-time
+deltas are smoke numbers, not TPU claims.
+
+Prints ONE JSON line:
+  {"metric": "scaling_2d", "dp8_dense": {...}, "dp4_tp2_sharded":
+   {...}, "fsdp4_tp2": {...}}
+"""
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "") +
+                           " --xla_force_host_platform_device_count=8")
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np  # noqa: E402
+
+
+def _net():
+    from deeplearning4j_tpu.activations import Activation
+    from deeplearning4j_tpu.learning.updaters import Adam
+    from deeplearning4j_tpu.lossfunctions import LossFunction
+    from deeplearning4j_tpu.nn.conf.builders import NeuralNetConfiguration
+    from deeplearning4j_tpu.nn.conf.inputs import InputType
+    from deeplearning4j_tpu.nn.conf.layers import DenseLayer, OutputLayer
+    from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+    from deeplearning4j_tpu.nn.weights import WeightInit
+    conf = (NeuralNetConfiguration.Builder()
+            .seed(0).updater(Adam(1e-3))
+            .weight_init(WeightInit.XAVIER)
+            .list()
+            .layer(DenseLayer(n_in=256, n_out=512,
+                              activation=Activation.RELU))
+            .layer(DenseLayer(n_out=512, activation=Activation.RELU))
+            .layer(OutputLayer(n_out=10,
+                               loss_function=LossFunction.MCXENT,
+                               activation=Activation.SOFTMAX))
+            .set_input_type(InputType.feed_forward(256))
+            .build())
+    return MultiLayerNetwork(conf).init()
+
+
+def _data(n=64):
+    from deeplearning4j_tpu.datasets.dataset import DataSet
+    rng = np.random.RandomState(0)
+    x = rng.randn(n, 256).astype(np.float32)
+    y = np.eye(10, dtype=np.float32)[rng.randint(0, 10, n)]
+    return DataSet(x, y)
+
+
+def _bytes_on_chip0(tree) -> int:
+    dev0 = jax.devices()[0]
+    total = 0
+    for leaf in jax.tree_util.tree_leaves(tree):
+        for sh in getattr(leaf, "addressable_shards", ()):
+            if sh.device == dev0:
+                total += sh.data.nbytes
+    return total
+
+
+def _time_steps(pw, ds, steps: int) -> float:
+    """Median-of-3 wall time per fit_batch, compile excluded."""
+    trials = []
+    for _ in range(3):
+        t0 = time.perf_counter()
+        for _ in range(steps):
+            pw.fit_batch(ds)
+        jax.block_until_ready(pw.model.params)
+        trials.append((time.perf_counter() - t0) / steps)
+    return sorted(trials)[1]
+
+
+def main():
+    from deeplearning4j_tpu.common.telemetry import MetricsRegistry
+    from deeplearning4j_tpu.parallel import ParallelWrapper
+    from deeplearning4j_tpu.parallel.zero import exchange_report
+
+    MetricsRegistry.get().set_enabled(False)   # measure the step, not
+    ds = _data()                               # the telemetry spine
+    batch = int(ds.features.shape[0])
+    out = {"metric": "scaling_2d", "devices": 8,
+           "updater": "Adam", "unit": "bytes|s"}
+
+    #: (label, update_exchange, dp workers, tp)
+    modes = (("dp8_dense", "dense", 8, 1),
+             ("dp4_tp2_sharded", "sharded", 4, 2),
+             ("fsdp4_tp2", "fsdp", 4, 2))
+    for label, exchange, workers, tp in modes:
+        net = _net()
+        b = ParallelWrapper.Builder(net).workers(workers) \
+            .update_exchange(exchange)
+        if tp > 1:
+            b = b.tensor_parallel(tp)
+        pw = b.build()
+        pw.fit_batch(ds)                       # place + compile
+        jax.block_until_ready(net.params)
+        step_s = _time_steps(pw, ds, steps=5)
+        rep = exchange_report(net.dense_params()
+                              if hasattr(net, "dense_params")
+                              else net.params,
+                              workers, pw.update_exchange,
+                              model_shards=tp, tp_specs=pw._tp_specs)
+        mode_out = {
+            "step_seconds": round(step_s, 5),
+            "throughput_sps": round(batch / step_s, 1),
+            "param_bytes_per_chip": _bytes_on_chip0(net.params),
+            "dp_wire_bytes": rep["wire_bytes_per_replica"],
+        }
+        if tp > 1:
+            ax = rep["axis_bytes"]
+            mode_out.update({
+                "model_axis_update_bytes": ax["model"],
+                "cross_axis_bytes": ax["cross_axis_bytes"],
+                "naive_ravel_cross_axis_bytes":
+                    ax["naive_ravel_cross_axis_bytes"],
+                "tp_resident_bytes_per_replica":
+                    rep["tp_resident_bytes_per_replica"],
+            })
+        out[label] = mode_out
+
+    # the 2D wire invariant, as a checkable claim: the update exchange
+    # must move ZERO bytes across the model axis in every 2D mode
+    out["update_crosses_model_axis"] = any(
+        out[label].get("model_axis_update_bytes", 0) or
+        out[label].get("cross_axis_bytes", 0)
+        for label, _, _, tp in modes if tp > 1)
+    print(json.dumps(out))
+
+
+if __name__ == "__main__":
+    main()

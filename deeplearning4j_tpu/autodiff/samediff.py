@@ -201,20 +201,36 @@ class OpNode:
         self.attrs = attrs or {}
 
 
-def _shard_placeholders(mesh, ph_vals: Dict, batch_names=None):
+def _shard_placeholders(mesh, ph_vals: Dict, batch_names=None,
+                        specs=None):
     """Shared DP placeholder contract of ``output(mesh=)`` and
     ``fit_steps(mesh=)``: batch dims shard over the mesh's ``data``
     axis, scalars replicate (``shard_batch`` passes them through),
-    indivisible batches are rejected loudly. Returns
+    indivisible batches are rejected loudly. ``specs`` maps
+    placeholder names to explicit ``PartitionSpec``s (or axis-name
+    tuples) — those placeholders skip inference entirely and are
+    device_put at the requested sharding, the escape hatch when the
+    batch-dim vote below would guess wrong. Returns
     ``(ph_vals, mesh_sig)``; ``mesh_sig`` keys compiled-program
-    caches (None when no mesh)."""
+    caches (None when no mesh) and folds the explicit specs in."""
     if mesh is None:
         return ph_vals, None
+    from jax.sharding import NamedSharding, PartitionSpec
     from deeplearning4j_tpu.parallel import replicate_tree, shard_batch
     if "data" not in mesh.axis_names:
         raise ValueError(
             f"mesh must have a 'data' axis, got {mesh.axis_names}")
     ndev = mesh.shape["data"]
+    specs = dict(specs or {})
+    for k in specs:
+        if k not in ph_vals:
+            raise ValueError(
+                f"placeholder spec for unknown placeholder {k!r} "
+                f"(have {sorted(ph_vals)})")
+        if not isinstance(specs[k], PartitionSpec):
+            sp = specs[k]
+            specs[k] = PartitionSpec(*sp) if isinstance(
+                sp, (tuple, list)) else PartitionSpec(sp)
     # batch placeholders shard; everything else replicates (GSPMD
     # semantics are identical either way; only batch tensors gain from
     # sharding). "Batch" = the leading dim of the feature/label-mapped
@@ -224,6 +240,7 @@ def _shard_placeholders(mesh, ph_vals: Dict, batch_names=None):
     # dims that divide the data axis, then higher rank ([B,T] batch
     # outranks a [T] aux), then size
     batch = None
+    inferred = False
     if batch_names:
         for k in batch_names:
             v = ph_vals.get(k)
@@ -233,34 +250,39 @@ def _shard_placeholders(mesh, ph_vals: Dict, batch_names=None):
     if batch is None:
         leads: dict = {}
         ranks: dict = {}
-        for v in ph_vals.values():
-            if v.ndim > 0:
+        for k, v in ph_vals.items():
+            if k not in specs and v.ndim > 0:
                 d = int(v.shape[0])
                 leads[d] = leads.get(d, 0) + 1
                 ranks[d] = max(ranks.get(d, 0), v.ndim)
         if leads:
+            inferred = True
             batch = max(leads, key=lambda d: (
                 leads[d], d % ndev == 0, ranks[d], d))
-            ties = [d for d in leads
-                    if d != batch and leads[d] == leads[batch]]
-            if ties:
-                # the vote was ambiguous: the losing placeholders get
-                # REPLICATED, silently giving up DP batch sharding for
-                # them (and bypassing the divisibility check they would
-                # have hit as batch tensors)
-                excluded = sorted(
-                    k for k, v in ph_vals.items()
-                    if v.ndim > 0 and int(v.shape[0]) in ties)
-                log.warning(
-                    "batch-dim inference chose leading dim %d but %s "
-                    "tie(s) it — placeholders %s will be replicated, "
-                    "not batch-sharded. Pass explicit "
-                    "data_set_feature_mapping/label_mapping (or "
-                    "batch_names) to disambiguate.",
-                    batch, ties, excluded)
+    if inferred:
+        # the vote can be outvoted by aux placeholders that merely
+        # share a leading dim: every loser gets REPLICATED, silently
+        # giving up DP batch sharding for it (and bypassing the
+        # divisibility check it would have hit as a batch tensor) —
+        # warn about ANY excluded candidate, not just exact ties
+        excluded = sorted(
+            k for k, v in ph_vals.items()
+            if k not in specs and v.ndim > 0
+            and int(v.shape[0]) != batch)
+        if excluded:
+            log.warning(
+                "batch-dim inference chose leading dim %d — "
+                "placeholders %s (other leading dims) will be "
+                "replicated, not batch-sharded. Pass explicit "
+                "data_set_feature_mapping/label_mapping (or "
+                "batch_names), or per-placeholder specs "
+                "(ph_specs=...), to disambiguate.",
+                batch, excluded)
     out = {}
     for k, v in ph_vals.items():
-        if v.ndim > 0 and int(v.shape[0]) == batch:
+        if k in specs:
+            out[k] = jax.device_put(v, NamedSharding(mesh, specs[k]))
+        elif v.ndim > 0 and int(v.shape[0]) == batch:
             if v.shape[0] % ndev:
                 raise ValueError(
                     f"placeholder {k!r} batch dim {v.shape} not "
@@ -270,7 +292,8 @@ def _shard_placeholders(mesh, ph_vals: Dict, batch_names=None):
             out[k] = replicate_tree(mesh, v)
     return out, (
         tuple(mesh.axis_names),
-        tuple(int(mesh.shape[a]) for a in mesh.axis_names))
+        tuple(int(mesh.shape[a]) for a in mesh.axis_names),
+        tuple(sorted((k, tuple(sp)) for k, sp in specs.items())))
 
 
 def _write_samediff_zip(path, graph: dict, arrays: dict,
@@ -742,7 +765,7 @@ class SameDiff:
 
     def output(self, placeholders: dict, outputs: Sequence[str],
                *, training: bool = False,
-               mesh=None) -> Dict[str, np.ndarray]:
+               mesh=None, ph_specs=None) -> Dict[str, np.ndarray]:
         """Execute the graph (reference: SameDiff.output). The whole
         requested subgraph compiles to one XLA program, cached per
         (outputs, placeholder signature).
@@ -750,7 +773,9 @@ class SameDiff:
         ``mesh``: a ``jax.sharding.Mesh`` with a ``data`` axis runs
         inference DATA-PARALLEL — placeholder batch dims shard over
         ``data``, variables replicate (the batched-inference half of
-        ``fit_steps(mesh=...)``)."""
+        ``fit_steps(mesh=...)``). ``ph_specs`` maps placeholder names
+        to explicit ``PartitionSpec``s when the batch-dim inference
+        would guess wrong (see ``_shard_placeholders``)."""
         outputs = [o.name if isinstance(o, SDVariable) else o
                    for o in outputs]
         ph_vals = {k: jnp.asarray(v) for k, v in placeholders.items()}
@@ -758,7 +783,8 @@ class SameDiff:
         ph_vals, mesh_sig = _shard_placeholders(
             mesh, ph_vals,
             batch_names=(cfg.data_set_feature_mapping +
-                         cfg.data_set_label_mapping) if cfg else None)
+                         cfg.data_set_label_mapping) if cfg else None,
+            specs=ph_specs)
         sig = (tuple(outputs), training, mesh_sig,
                tuple(sorted((k, v.shape, str(v.dtype))
                             for k, v in ph_vals.items())))
@@ -1076,14 +1102,24 @@ class SameDiff:
 
     def _build_raw_train_step(self, ph_names: Tuple[str, ...],
                               mesh=None, axis: str = "data",
-                              fsdp: bool = False):
+                              fsdp: bool = False, tp_specs=None,
+                              dense_tail: bool = False):
         cfg = self.training_config
         fn, var_names = self._build_fn(tuple(self.loss_variables),
                                        ph_names, True)
         trainable = [n for n in var_names]
         updater = cfg.updater
+        tp_specs = ({n: s for n, s in (tp_specs or {}).items()
+                     if n in trainable} if mesh is not None else {})
 
         def dense_loss(tv, ph_vals, rng):
+            if tp_specs:
+                # 2D mode: pin tp variables to their compute spec; the
+                # custom-vjp pin sends the cotangent to the resident
+                # spec, so dp grad collectives stay on the data axis
+                from deeplearning4j_tpu.parallel.zero import \
+                    pin_tp_entry
+                tv = pin_tp_entry(tv, mesh, tp_specs)
             outs = fn(tv, ph_vals, rng)
             total = sum(jnp.sum(o) for o in outs)
             if cfg.l2:
@@ -1099,38 +1135,73 @@ class SameDiff:
             # ({FSDP_KEY: {dtype: flat}}, resident 1/N along the data
             # axis); the forward gathers them through the custom-vjp
             # gather, so the grad cotangent is born reduce-scattered
-            # and the tail never all-gathers the new variables
+            # and the tail never all-gathers the new variables.
+            # Tensor-parallel variables (tp_specs) never enter the
+            # flats: they ride under TP_KEY at full logical shape,
+            # resident-sharded over model(×data) via their specs
             from deeplearning4j_tpu.learning.updaters import (
-                FSDP_KEY, dp_flatten_spec)
+                FSDP_KEY, TP_KEY, dp_flatten_spec)
             from deeplearning4j_tpu.parallel.zero import (
-                apply_update_fsdp, fsdp_gather)
+                apply_update_fsdp, apply_update_tp, fsdp_gather,
+                merge_tp_state, split_tp_state)
             spec = dp_flatten_spec(
-                {n: self._arrays[n] for n in trainable},
+                {n: self._arrays[n] for n in trainable
+                 if n not in tp_specs},
                 mesh.shape[axis])
             self._fsdp_spec = spec
 
             def fsdp_step(var_vals, upd_state, ph_vals, iteration, rng):
                 def loss_fn(fv):
                     tv = fsdp_gather(fv[FSDP_KEY], spec, mesh, axis)
+                    if tp_specs:
+                        # dense_loss pins these to the compute spec
+                        tv = {**tv, **fv[TP_KEY]}
                     return dense_loss(tv, ph_vals, rng)
 
                 loss, grads = jax.value_and_grad(loss_fn)(var_vals)
+                st_rest, st_tp = split_tp_state(upd_state)
                 new_flat, new_state = apply_update_fsdp(
                     updater, grads[FSDP_KEY], var_vals[FSDP_KEY],
-                    upd_state, iteration, mesh, axis)
-                return {FSDP_KEY: new_flat}, new_state, loss
+                    st_rest, iteration, mesh, axis)
+                new_vars = {FSDP_KEY: new_flat}
+                if tp_specs:
+                    new_tp, us_tp = apply_update_tp(
+                        updater, grads[TP_KEY], var_vals[TP_KEY],
+                        st_tp, iteration, mesh, tp_specs,
+                        gather_params=False)
+                    new_vars[TP_KEY] = new_tp
+                    new_state = merge_tp_state(new_state, us_tp)
+                return new_vars, new_state, loss
 
             return fsdp_step, trainable
 
         def step(var_vals, upd_state, ph_vals, iteration, rng):
             loss, grads = jax.value_and_grad(
                 lambda tv: dense_loss(tv, ph_vals, rng))(var_vals)
-            if mesh is not None:
+            if mesh is not None and not dense_tail:
                 # ZeRO-1 sharded tail (parallel.zero): updater + state
                 # on 1/N shards; new_vars come back replicated and in
-                # each variable's own dtype
-                from deeplearning4j_tpu.parallel.zero import \
-                    apply_update_sharded
+                # each variable's own dtype. Tensor-parallel variables
+                # get their own elementwise tail (apply_update_tp)
+                # pinned to the model-axis layout
+                from deeplearning4j_tpu.parallel.zero import (
+                    apply_update_sharded, apply_update_tp,
+                    merge_tp_state, split_tp_entry, split_tp_state)
+                if tp_specs:
+                    g_rest, g_tp = split_tp_entry(grads, tp_specs)
+                    p_rest, p_tp = split_tp_entry(var_vals, tp_specs)
+                    st_rest, st_tp = split_tp_state(upd_state)
+                    if g_rest:
+                        new_rest, new_state = apply_update_sharded(
+                            updater, g_rest, p_rest, st_rest,
+                            iteration, mesh, axis)
+                    else:
+                        new_rest, new_state = p_rest, st_rest
+                    new_tp, us_tp = apply_update_tp(
+                        updater, g_tp, p_tp, st_tp, iteration, mesh,
+                        tp_specs, gather_params=True)
+                    return ({**new_rest, **new_tp},
+                            merge_tp_state(new_state, us_tp), loss)
                 new_vars, new_state = apply_update_sharded(
                     updater, grads, var_vals, upd_state, iteration,
                     mesh, axis)
@@ -1156,7 +1227,8 @@ class SameDiff:
         return jax.jit(step, donate_argnums=(0, 1)), trainable
 
     def fit_steps(self, placeholders: Dict, n_steps: int,
-                  mesh=None, update_exchange="auto") -> float:
+                  mesh=None, update_exchange="auto", tp_specs=None,
+                  ph_specs=None) -> float:
         """``n_steps`` train-step updates on ONE fixed placeholder
         batch inside a single ``lax.fori_loop`` dispatch, syncing on
         the final loss once. The benchmark-grade loop (same recipe as
@@ -1173,7 +1245,15 @@ class SameDiff:
         replicated, and GSPMD inserts the gradient all-reduce inside
         the compiled step (the ParallelWrapper recipe applied to an
         imported/authored SameDiff program; no reference equivalent —
-        SameDiff in the reference is single-device)."""
+        SameDiff in the reference is single-device).
+
+        A 2D ``(data, model)`` mesh trains TENSOR-PARALLEL on top:
+        eligible variables (``parallel.speclayout`` inference, or an
+        explicit ``tp_specs`` name→``TpLeafSpec`` dict) are physically
+        sharded over ``model`` and updated through ``apply_update_tp``
+        — they never enter the dp flat ravels, so dp collectives stay
+        on the ``data`` axis. ``ph_specs`` maps placeholder names to
+        explicit ``PartitionSpec``s (see ``_shard_placeholders``)."""
         cfg = self.training_config
         if cfg is None:
             raise ValueError("call set_training_config first")
@@ -1182,21 +1262,38 @@ class SameDiff:
         ph_vals = {k: jnp.asarray(v) for k, v in placeholders.items()}
         ph_vals, mesh_sig = _shard_placeholders(
             mesh, ph_vals, batch_names=(cfg.data_set_feature_mapping +
-                                        cfg.data_set_label_mapping))
+                                        cfg.data_set_label_mapping),
+            specs=ph_specs)
         from deeplearning4j_tpu.parallel.zero import (
             UpdateExchange, resolve_update_exchange)
         mode = resolve_update_exchange(mesh, requested=update_exchange)
         sharded = mode is UpdateExchange.SHARDED
         fsdp = mode is UpdateExchange.FSDP
-        key = (tuple(sorted(ph_vals)), mesh_sig, mode.value)
+        tp = (int(mesh.shape.get("model", 1)) if mesh is not None
+              else 1)
+        if mesh is None or tp <= 1:
+            tp_specs = {}
+        elif tp_specs is None:
+            from deeplearning4j_tpu.parallel.speclayout import \
+                SpecLayout
+            tp_specs = SpecLayout(mesh).infer_entry(
+                {n: v for n, v in self._arrays.items()
+                 if self.vars[n].var_type is VariableType.VARIABLE},
+                shard_over_data=sharded or fsdp)
+        tp_sig = tuple(sorted(
+            (n, tuple(s.compute), tuple(s.resident))
+            for n, s in tp_specs.items())) or None
+        key = (tuple(sorted(ph_vals)), mesh_sig, mode.value, tp_sig)
         cached = self._exec_cache.get(("train_multi", key))
         if cached is None:
             from deeplearning4j_tpu.common.compilecache import \
                 enable_persistent_cache
             enable_persistent_cache()
             raw, trainable = self._build_raw_train_step(
-                tuple(ph_vals), mesh if (sharded or fsdp) else None,
-                fsdp=fsdp)
+                tuple(ph_vals),
+                mesh if (sharded or fsdp or tp_specs) else None,
+                fsdp=fsdp, tp_specs=tp_specs,
+                dense_tail=not (sharded or fsdp))
 
             def multi(var_vals, upd_state, ph, rng, it0, n):
                 def body(i, carry):
@@ -1231,16 +1328,22 @@ class SameDiff:
             self._restore_updater_leaves()
         self._updater_trainable = list(trainable)
         var_vals = {n: self._arrays[n] for n in trainable}
+        tp_specs = {n: s for n, s in tp_specs.items() if n in var_vals}
         # layout sync: the sharded/fsdp steps consume/produce the
-        # ZeRO-1 flat state; the dense step the per-variable slot trees
+        # ZeRO-1 flat state (tp variables split out under TP_KEY); the
+        # dense step the per-variable slot trees
         flat_state = sharded or fsdp
-        from deeplearning4j_tpu.learning.updaters import is_dp_sharded
-        if flat_state and self._updater_state and \
-                not is_dp_sharded(self._updater_state):
+        from deeplearning4j_tpu.learning.updaters import (has_tp,
+                                                          is_dp_sharded)
+        if flat_state and self._updater_state:
+            # idempotent: a state already raveled for this world size
+            # and tp split passes through untouched
             from deeplearning4j_tpu.parallel.zero import to_sharded_state
             self._updater_state = to_sharded_state(
-                var_vals, self._updater_state, mesh.shape["data"])
-        elif not flat_state and is_dp_sharded(self._updater_state):
+                var_vals, self._updater_state, mesh.shape["data"],
+                tp_names=tuple(tp_specs))
+        elif not flat_state and (is_dp_sharded(self._updater_state)
+                                 or has_tp(self._updater_state)):
             from deeplearning4j_tpu.parallel.zero import to_dense_state
             self._updater_state = to_dense_state(var_vals,
                                                  self._updater_state)
@@ -1250,14 +1353,31 @@ class SameDiff:
             if fsdp:
                 # variables enter the flat resident layout: 1/N per
                 # replica along the data axis for the whole fori window
+                # (tp variables resident at their model(×data) spec)
                 from deeplearning4j_tpu.learning.updaters import (
-                    FSDP_KEY, dp_ravel)
+                    FSDP_KEY, TP_KEY, dp_ravel)
                 from deeplearning4j_tpu.parallel.mesh import flat_sharding
-                flats, _ = dp_ravel(var_vals, mesh.shape["data"],
+                rest = {n: v for n, v in var_vals.items()
+                        if n not in tp_specs}
+                flats, _ = dp_ravel(rest, mesh.shape["data"],
                                     self._fsdp_spec)
                 shard = flat_sharding(mesh, "data")
-                var_vals = {FSDP_KEY: {dt: jax.device_put(v, shard)
-                                       for dt, v in flats.items()}}
+                vv = {FSDP_KEY: {dt: jax.device_put(v, shard)
+                                 for dt, v in flats.items()}}
+                if tp_specs:
+                    from deeplearning4j_tpu.parallel.zero import \
+                        place_tp_params
+                    vv[TP_KEY] = place_tp_params(
+                        mesh, {"v": {n: var_vals[n] for n in tp_specs}},
+                        {"v": tp_specs}, resident=True)["v"]
+                var_vals = vv
+            elif tp_specs:
+                # dense×tp / sharded×tp: tp variables live at their
+                # compute sharding, the rest replicate
+                from deeplearning4j_tpu.parallel.zero import \
+                    place_tp_params
+                var_vals = place_tp_params(
+                    mesh, {"v": var_vals}, {"v": tp_specs})["v"]
             else:
                 var_vals = replicate_tree(mesh, var_vals)
             if flat_state:
@@ -1265,7 +1385,8 @@ class SameDiff:
                 from deeplearning4j_tpu.parallel.zero import \
                     place_updater_states
                 self._updater_state = place_updater_states(
-                    mesh, {"state": self._updater_state})["state"]
+                    mesh, {"state": self._updater_state},
+                    tp_specs={"state": tp_specs})["state"]
             else:
                 self._updater_state = replicate_tree(
                     mesh, self._updater_state)

@@ -34,18 +34,26 @@ class ImageLoader:
     NHWC here — XLA:TPU's native conv layout, the reference's NCHW
     exists only at import boundaries).
 
-    INTENTIONAL divergence (ADVICE.md r5): file inputs decoded via
-    Pillow resize with Pillow's antialiased BILINEAR (plus JPEG draft
-    mode), while ndarray/`.npy` inputs resize through the half-pixel
-    numpy ``_resize_bilinear`` below — the same logical image can yield
-    slightly different pixels depending on input form. The PIL path is
-    kept because it is the throughput path (GIL-released SIMD resize,
-    147 -> >1k img/s on the ETL bench) and antialiased downscale is the
-    *better* eval-time convention; feed ``.npy``/arrays end-to-end when
-    bit-consistency between file-fed and array-fed pipelines matters."""
+    INTENTIONAL divergence (ADVICE.md r5), BY DEFAULT: file inputs
+    decoded via Pillow resize with Pillow's antialiased BILINEAR (plus
+    JPEG draft mode), while ndarray/`.npy` inputs resize through the
+    half-pixel numpy ``_resize_bilinear`` below — the same logical
+    image can yield slightly different pixels depending on input form.
+    The PIL path is the default because it is the throughput path
+    (GIL-released SIMD resize, 147 -> >1k img/s on the ETL bench) and
+    antialiased downscale is the *better* eval-time convention.
 
-    def __init__(self, height: int, width: int, channels: int = 3):
+    ``exact_resize=True`` removes the divergence: PIL decodes at the
+    image's native size (no draft-mode DCT scaling, no Pillow resize)
+    and the array goes through the SAME half-pixel numpy
+    ``_resize_bilinear`` as ndarray/``.npy`` inputs, so a file-fed and
+    an array-fed pipeline produce bit-identical pixels — at the numpy
+    path's (slower, non-antialiased) throughput."""
+
+    def __init__(self, height: int, width: int, channels: int = 3,
+                 exact_resize: bool = False):
         self.h, self.w, self.c = int(height), int(width), int(channels)
+        self.exact_resize = bool(exact_resize)
 
     def load(self, path_or_array) -> np.ndarray:
         a = self._decode(path_or_array)
@@ -65,10 +73,17 @@ class ImageLoader:
                 # JPEG draft mode: decode directly at the nearest
                 # 1/2 / 1/4 / 1/8 DCT scale >= target — the decoder
                 # skips most of the IDCT work on big downscales
-                if im.format == "JPEG":
+                # (skipped under exact_resize: the scaled decode feeds
+                # different pixels into the resize than an array path
+                # that starts from the full-size image)
+                if im.format == "JPEG" and not self.exact_resize:
                     im.draft("RGB" if self.c == 3 else "L",
                              (self.w, self.h))
                 im = im.convert("RGB" if self.c == 3 else "L")
+                if self.exact_resize:
+                    # native-size decode; load() routes the array
+                    # through _resize_bilinear like any ndarray input
+                    return np.asarray(im)
                 if im.size != (self.w, self.h):
                     # Pillow's C resize (GIL-released, SIMD): feeder
                     # THREADS scale, unlike the numpy fallback below —

@@ -55,23 +55,44 @@ def is_fsdp(tree) -> bool:
     return isinstance(tree, dict) and FSDP_KEY in tree
 
 
+#: reserved key marking the tensor-parallel split of a param or
+#: updater-state entry: ``{TP_KEY: {param name: array}}``. TP leaves
+#: keep their full logical shape and live physically sharded along the
+#: ``model`` mesh axis (``parallel.speclayout`` infers the specs); they
+#: are never raveled into the dp flats — a data-axis ravel of a
+#: model-sharded leaf would all-gather across the model axis inside the
+#: step, which the 2D layouts forbid.
+TP_KEY = "__tp__"
+
+
+def has_tp(tree) -> bool:
+    return isinstance(tree, dict) and TP_KEY in tree
+
+
 class DpFlatSpec:
     """How a pytree ravels into per-dtype padded flat vectors.
 
     ``infos``: per leaf (dtype key, offset into its dtype vector, shape);
     ``sizes``: dtype key -> (original length, padded length). The padded
     length is the original rounded up to a multiple of ``n_shards`` so a
-    ``P(dp)`` NamedSharding divides it evenly.
+    ``P(dp)`` NamedSharding divides it evenly. ``axis`` records WHICH
+    mesh axis the flats shard over (always the data axis today — on a
+    2D ``(data, model)`` mesh the dp collectives the flats imply must
+    never cross the model axis, so per-axis wire accounting keys off
+    it).
     """
 
-    def __init__(self, treedef, infos, sizes, n_shards: int):
+    def __init__(self, treedef, infos, sizes, n_shards: int,
+                 axis: str = "data"):
         self.treedef = treedef
         self.infos: List[Tuple[str, int, tuple]] = infos
         self.sizes: Dict[str, Tuple[int, int]] = sizes
         self.n_shards = n_shards
+        self.axis = axis
 
 
-def dp_flatten_spec(tree, n_shards: int) -> DpFlatSpec:
+def dp_flatten_spec(tree, n_shards: int,
+                    axis: str = "data") -> DpFlatSpec:
     leaves, treedef = jax.tree_util.tree_flatten(tree)
     infos, offsets = [], {}
     for leaf in leaves:
@@ -85,7 +106,7 @@ def dp_flatten_spec(tree, n_shards: int) -> DpFlatSpec:
     for dt, orig in offsets.items():
         padded = -(-orig // n_shards) * n_shards
         sizes[dt] = (orig, padded)
-    return DpFlatSpec(treedef, infos, sizes, n_shards)
+    return DpFlatSpec(treedef, infos, sizes, n_shards, axis)
 
 
 def dp_ravel(tree, n_shards: int, spec: DpFlatSpec = None):

@@ -57,6 +57,13 @@ class ComputationGraph:
         # per-vertex DpFlatSpec needed to densify
         self._dp_fsdp = False
         self._fsdp_specs = {}
+        # dense update tail WITH a mesh installed (dense x tp 2D mode:
+        # the step needs the mesh for tp pins but must not run ZeRO-1)
+        self._dp_dense = False
+        # tensor parallelism (parallel.speclayout): per-vertex
+        # {name: TpLeafSpec} for model-axis sharded leaves
+        self._tp_model_axis = None
+        self._tp_specs = {}
         # gradient accumulation (reference: GradientsAccumulator)
         self._accum_steps = 1
         self._accum_grads = None
@@ -331,6 +338,9 @@ class ComputationGraph:
         thr = conf.gradient_normalization_threshold
         dp_mesh, dp_axis = self._dp_mesh, self._dp_axis
         fsdp = self._dp_fsdp and dp_mesh is not None
+        dense_tail = self._dp_dense and dp_mesh is not None
+        tp_specs_all = (dict(self._tp_specs)
+                        if dp_mesh is not None and self._tp_specs else {})
         if fsdp:
             from deeplearning4j_tpu.common.environment import Environment
             from deeplearning4j_tpu.parallel.zero import FsdpParamView
@@ -344,7 +354,18 @@ class ComputationGraph:
                 # all-gather is emitted at its point of use in the walk
                 params = FsdpParamView(params, fsdp_specs, dp_mesh,
                                        dp_axis, order=vertex_order,
-                                       prefetch=fsdp_prefetch)
+                                       prefetch=fsdp_prefetch,
+                                       tp_specs=tp_specs_all)
+            elif tp_specs_all:
+                # 2D mode: pin tp leaves to their compute spec; the
+                # custom-vjp pin sends the cotangent to the resident
+                # spec, so dp grad collectives stay on the data axis
+                from deeplearning4j_tpu.parallel.zero import pin_tp_entry
+                params = {k: (pin_tp_entry(sub, dp_mesh,
+                                           tp_specs_all[k])
+                              if k in tp_specs_all and
+                              isinstance(sub, dict) else sub)
+                          for k, sub in params.items()}
             acts, new_states = self._forward(params, states, inputs,
                                              training=True, rng=rng,
                                              want_logits=True,
@@ -387,28 +408,60 @@ class ComputationGraph:
                     new_params[name] = params.get(name, {})
                     new_upd[name] = upd_states.get(name, ())
                     continue
+                tps = tp_specs_all.get(name)
                 if fsdp:
                     # ZeRO-3 tail: params/grads already the 1/N flat
                     # shards and stay that way — no trailing all-gather
                     # (constraints skipped: the resolver refuses fsdp
-                    # when any layer has them)
+                    # when any layer has them). TP leaves get their own
+                    # elementwise tail pinned to the model-axis layout.
                     from deeplearning4j_tpu.learning.updaters import \
-                        FSDP_KEY
-                    from deeplearning4j_tpu.parallel.zero import \
-                        apply_update_fsdp
+                        FSDP_KEY, TP_KEY
+                    from deeplearning4j_tpu.parallel.zero import (
+                        apply_update_fsdp, apply_update_tp,
+                        merge_tp_state, split_tp_state)
+                    st_rest, st_tp = split_tp_state(upd_states[name])
                     new_flat, us = apply_update_fsdp(
                         updaters[name], g[FSDP_KEY],
-                        params[name][FSDP_KEY], upd_states[name],
+                        params[name][FSDP_KEY], st_rest,
                         iteration, dp_mesh, dp_axis)
-                    new_params[name] = {FSDP_KEY: new_flat}
+                    ent = {FSDP_KEY: new_flat}
+                    if tps and TP_KEY in g:
+                        new_tp, us_tp = apply_update_tp(
+                            updaters[name], g[TP_KEY],
+                            params[name][TP_KEY], st_tp, iteration,
+                            dp_mesh, tps, gather_params=False)
+                        ent[TP_KEY] = new_tp
+                        us = merge_tp_state(us, us_tp)
+                    new_params[name] = ent
                     new_upd[name] = us
                     continue
-                if dp_mesh is not None:
-                    from deeplearning4j_tpu.parallel.zero import \
-                        apply_update_sharded
-                    new_p, us = apply_update_sharded(
-                        updaters[name], g, params[name],
-                        upd_states[name], iteration, dp_mesh, dp_axis)
+                if dp_mesh is not None and not dense_tail:
+                    from deeplearning4j_tpu.parallel.zero import (
+                        apply_update_sharded, apply_update_tp,
+                        merge_tp_state, split_tp_entry, split_tp_state)
+                    if tps:
+                        g_rest, g_tp = split_tp_entry(g, tps)
+                        p_rest, p_tp = split_tp_entry(params[name], tps)
+                        st_rest, st_tp = split_tp_state(
+                            upd_states[name])
+                        if g_rest:
+                            new_rest, us = apply_update_sharded(
+                                updaters[name], g_rest, p_rest,
+                                st_rest, iteration, dp_mesh, dp_axis)
+                        else:
+                            new_rest, us = p_rest, st_rest
+                        new_tp, us_tp = apply_update_tp(
+                            updaters[name], g_tp, p_tp, st_tp,
+                            iteration, dp_mesh, tps,
+                            gather_params=True)
+                        new_p = {**new_rest, **new_tp}
+                        us = merge_tp_state(us, us_tp)
+                    else:
+                        new_p, us = apply_update_sharded(
+                            updaters[name], g, params[name],
+                            upd_states[name], iteration, dp_mesh,
+                            dp_axis)
                 else:
                     g = apply_gradient_normalization(gn, thr, g)
                     updates, us = updaters[name].apply(
@@ -457,23 +510,34 @@ class ComputationGraph:
             donate_argnums=(0,))
 
     # ------------------------------------------------------------------
-    def set_dp_mesh(self, mesh, axis: str = "data", mode=None):
-        """Install (or clear, with ``mesh=None``) the data-parallel mesh
-        the jitted step tail specializes on (``parallel.zero``).
+    def set_dp_mesh(self, mesh, axis: str = "data", mode=None, *,
+                    model_axis=None, tp_specs=None):
+        """Install (or clear, with ``mesh=None``) the (possibly 2D)
+        mesh the jitted step tail specializes on (``parallel.zero``).
         ``mode="fsdp"`` selects the ZeRO-3 tail: params convert to the
         1/N flat resident layout here (the model owns both param and
-        updater-state conversion under fsdp); for the ZeRO-1 tail
-        callers still own converting/placing ``updater_states``.
-        Invalidates compiled steps."""
-        fsdp = (str(getattr(mode, "value", mode) or "").lower() == "fsdp"
-                and mesh is not None)
+        updater-state conversion under fsdp); ``mode="dense"`` installs
+        the mesh WITHOUT the ZeRO-1 tail (dense×tp); for the ZeRO-1
+        tail callers still own converting/placing ``updater_states``.
+        ``model_axis``/``tp_specs`` (``parallel.speclayout``) add the
+        tensor-parallel dimension. Invalidates compiled steps."""
+        mode_s = str(getattr(mode, "value", mode) or "").lower()
+        fsdp = mode_s == "fsdp" and mesh is not None
+        dense = mode_s == "dense" and mesh is not None
+        tp_specs = dict(tp_specs or {}) if mesh is not None else {}
+        model_axis = model_axis if tp_specs else None
         if mesh is self._dp_mesh and axis == self._dp_axis and \
-                fsdp == self._dp_fsdp:
+                fsdp == self._dp_fsdp and dense == self._dp_dense and \
+                model_axis == self._tp_model_axis and \
+                tp_specs == self._tp_specs:
             return self
         self.flush_accumulated()
         self._dp_mesh = mesh
         self._dp_axis = axis
         self._dp_fsdp = fsdp
+        self._dp_dense = dense
+        self._tp_model_axis = model_axis
+        self._tp_specs = tp_specs
         self._train_step = None
         self._step_fn = None
         self._grad_step = None
@@ -513,12 +577,15 @@ class ComputationGraph:
 
     def _sync_updater_layout(self):
         """A checkpoint restored from a ZeRO-1 run carries flat sharded
-        updater state; on a plain (no-mesh) model convert it back to the
-        dense per-vertex layout before stepping."""
-        if self._dp_mesh is not None:
+        updater state; on a plain (no-mesh) model — or under the
+        dense×tp tail, which consumes dense state — convert it back to
+        the dense per-vertex layout before stepping."""
+        if self._dp_mesh is not None and not self._dp_dense:
             return
-        from deeplearning4j_tpu.learning.updaters import is_dp_sharded
-        if any(is_dp_sharded(s) for s in self.updater_states.values()):
+        from deeplearning4j_tpu.learning.updaters import (has_tp,
+                                                          is_dp_sharded)
+        if any(is_dp_sharded(s) or has_tp(s)
+               for s in self.updater_states.values()):
             from deeplearning4j_tpu.parallel.zero import states_to_dense
             self.updater_states = states_to_dense(self.params,
                                                   self.updater_states)
@@ -545,26 +612,56 @@ class ComputationGraph:
                 place_updater_states, states_to_sharded)
             n = self._dp_mesh.shape[self._dp_axis]
             if flat:
-                if fsdp_spec_shards(self._fsdp_specs) == n:
+                if fsdp_spec_shards(self._fsdp_specs) == n and \
+                        self._tp_layout_matches():
                     # already resident; placement happened on entry
                     return
-                # raveled for another world size: densify and re-enter
+                # raveled for another world size (or another tp
+                # partition): densify and re-enter
                 self._densify_params_inplace()
             self.updater_states = states_to_sharded(
-                self.params, self.updater_states, n)
-            self.params, self._fsdp_specs = params_to_fsdp(self.params, n)
+                self.params, self.updater_states, n,
+                tp_specs=self._tp_specs)
+            self.params, self._fsdp_specs = params_to_fsdp(
+                self.params, n, tp_specs=self._tp_specs)
             self.params = place_fsdp_params(self._dp_mesh, self.params,
-                                            self._dp_axis)
+                                            self._dp_axis,
+                                            tp_specs=self._tp_specs)
             self.updater_states = place_updater_states(
-                self._dp_mesh, self.updater_states, self._dp_axis)
+                self._dp_mesh, self.updater_states, self._dp_axis,
+                tp_specs=self._tp_specs)
         elif flat:
             self._densify_params_inplace()
 
+    def _tp_layout_matches(self) -> bool:
+        """True when the resident fsdp entries' TP_KEY split matches
+        the installed tp specs (an fsdp×tp checkpoint restored onto a
+        mesh with a different tp degree must densify and re-enter)."""
+        from deeplearning4j_tpu.learning.updaters import TP_KEY, is_fsdp
+        want = {k: set(v) for k, v in (self._tp_specs or {}).items()}
+        for k, sub in self.params.items():
+            if not isinstance(sub, dict) or not is_fsdp(sub):
+                continue
+            got = set(sub.get(TP_KEY, {}))
+            if got != want.get(k, set()):
+                return False
+        return True
+
     def _densify_params_inplace(self):
         if self._params_are_fsdp():
-            from deeplearning4j_tpu.parallel.zero import params_to_dense
+            from deeplearning4j_tpu.parallel.zero import (on_2d_mesh,
+                                                          params_to_dense)
             self.params = params_to_dense(self.params, self._fsdp_specs)
             # specs kept: a later _sync_param_layout re-entry recomputes
+            if any(on_2d_mesh(a)
+                   for a in jax.tree_util.tree_leaves(self.params)):
+                # leaving a 2D (data, model) residency: the densified
+                # leaves still carry the old mesh's shardings, and
+                # re-raveling them through XLA SPMD hits the same
+                # concatenate-lowering bug worked around in
+                # zero.apply_update_sharded — re-enter from host copies
+                self.params = jax.device_get(self.params)
+                self.updater_states = jax.device_get(self.updater_states)
 
     def dense_params(self) -> dict:
         """Params in the dense per-vertex layout regardless of residency

@@ -36,6 +36,7 @@ from deeplearning4j_tpu.parallel.encoding import (
 from deeplearning4j_tpu.parallel.zero import (
     UpdateExchange, apply_update_sharded, resolve_update_exchange,
     states_to_dense, states_to_sharded, update_exchange_bytes)
+from deeplearning4j_tpu.parallel.speclayout import SpecLayout, TpLeafSpec
 
 __all__ = [
     "DEFAULT_DATA_AXIS", "MeshFactory", "make_mesh", "data_sharding",
@@ -50,4 +51,5 @@ __all__ = [
     "ulysses_self_attention",
     "UpdateExchange", "apply_update_sharded", "resolve_update_exchange",
     "states_to_dense", "states_to_sharded", "update_exchange_bytes",
+    "SpecLayout", "TpLeafSpec",
 ]

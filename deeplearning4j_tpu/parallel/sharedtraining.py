@@ -64,6 +64,11 @@ class SharedTrainingConfiguration:
     # updater applies every N micro-batches on the mean gradient
     # (reference: GradientsAccumulator)
     accumulation_steps: int = 1
+    # shard model weights N-ways over a second `model` mesh axis
+    # (megatron column/row splits, parallel.speclayout); composes with
+    # every update_exchange mode — the global mesh becomes 2D
+    # (data, model) and the dp world size becomes devices // N
+    tensor_parallel: int = 1
     # control plane (jax.distributed); None = single-process
     coordinator_address: Optional[str] = None
     num_processes: Optional[int] = None
@@ -114,6 +119,18 @@ class SharedTrainingMaster:
             self._c.accumulation_steps = max(int(n), 1)
             return self
 
+        def tensor_parallel(self, n: int):
+            """Shard model weights ``n``-ways over a second ``model``
+            mesh axis (parallel.speclayout); the global mesh becomes
+            2D ``(data, model)``. Composes with every update_exchange
+            mode: dense×tp, sharded×tp, fsdp×tp."""
+            n = int(n)
+            if n < 1:
+                raise ValueError(
+                    f"tensor_parallel must be >= 1, got {n}")
+            self._c.tensor_parallel = n
+            return self
+
         def coordinator(self, address: str, num_processes: int,
                         process_id: int):
             self._c.coordinator_address = address
@@ -150,9 +167,21 @@ class SharedTrainingMaster:
     def _global_mesh(self):
         if self._mesh is None:
             devs = jax.devices()     # global across all processes
+            tp = max(int(self.config.tensor_parallel), 1)
             if self.config.workers_per_node > 0 and jax.process_count() == 1:
-                devs = devs[:self.config.workers_per_node]
-            self._mesh = make_mesh({DEFAULT_DATA_AXIS: len(devs)}, devs)
+                devs = devs[:self.config.workers_per_node * tp]
+            if tp > 1:
+                if len(devs) % tp:
+                    raise ValueError(
+                        f"tensor_parallel={tp} does not divide "
+                        f"{len(devs)} devices")
+                from deeplearning4j_tpu.parallel.mesh import \
+                    DEFAULT_MODEL_AXIS
+                self._mesh = make_mesh({DEFAULT_DATA_AXIS: -1,
+                                        DEFAULT_MODEL_AXIS: tp}, devs)
+            else:
+                self._mesh = make_mesh({DEFAULT_DATA_AXIS: len(devs)},
+                                       devs)
         return self._mesh
 
     # ------------------------------------------------------------------

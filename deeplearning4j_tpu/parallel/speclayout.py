@@ -1,0 +1,142 @@
+"""SpecLayout — GSPMD tensor-parallel parameter partitioner.
+
+Promotes the megatron-style splits from the :mod:`.tensor` dryrun
+(manual shard_map + psum) into first-class ``PartitionSpec`` inference
+over real model param trees, entry by entry (MLN ``layer_i`` / graph
+vertex / SameDiff variable scope). The spec vocabulary is exactly
+:func:`parallel.tensor.megatron_specs` — column ``P(None, model)``,
+row ``P(model, None)``, sharded bias ``P(model)``, replicated ``P()``
+— generalized by shape inference instead of hand-written per-key maps:
+
+- a 2-D weight is **column**-sharded over ``model`` when its output
+  dim divides the tp degree (embeddings, qkv, ffn-in), **row**-sharded
+  when only the input dim does (ffn-out, attention out-proj), and left
+  replicated otherwise;
+- a 1-D bias is sharded ``P(model)`` when it pairs with a
+  column-sharded weight in the same entry (same output width); biases
+  of row-sharded weights and norm gains/offsets stay replicated.
+
+Lowering happens through ``with_sharding_constraint`` pins inside the
+jitted step (``parallel.zero.pin_tp_entry`` / ``tp_gather_leaf``), so
+XLA's SPMD partitioner inserts the collectives — no hand-written psums.
+Every spec keeps the leaf's FULL logical shape; sharding is purely
+physical placement, which is why the dense/ZeRO-1 update math is
+untouched by tp.
+
+Under the ZeRO layouts the **resident** spec additionally shards one
+free dimension over the ``data`` axis (the fsdp×tp scheme of
+SNIPPETS.md [2]: embeddings/qkv/ffn sharded over ``fsdp×tp``); the
+**compute** spec is the resident spec minus ``data``. The asymmetric
+pin pair (gather to compute in forward, pin cotangent to resident in
+backward — ``zero.tp_gather_leaf``) keeps params + grads + updater
+state resident at ``1/(dp·tp)`` while dp collectives never cross the
+``model`` axis.
+"""
+from __future__ import annotations
+
+from typing import Dict, NamedTuple
+
+import numpy as np
+from jax.sharding import PartitionSpec as P
+
+from deeplearning4j_tpu.parallel.mesh import (DEFAULT_DATA_AXIS,
+                                              DEFAULT_MODEL_AXIS)
+
+
+class TpLeafSpec(NamedTuple):
+    """Compute vs resident PartitionSpec for one tensor-parallel leaf.
+
+    ``compute``: how the forward/backward math sees the leaf (model
+    axis only). ``resident``: how the leaf lives between steps — equal
+    to ``compute`` for the dense tail, plus a ``data``-axis dimension
+    under the ZeRO tails (sharded/fsdp)."""
+    compute: P
+    resident: P
+
+
+def _is_flat_array_dict(entry) -> bool:
+    return (isinstance(entry, dict) and bool(entry) and
+            all(hasattr(a, "shape") and hasattr(a, "ndim")
+                for a in entry.values()))
+
+
+class SpecLayout:
+    """Per-entry tp spec inference for a ``{entry: {name: array}}``
+    param tree (or a single flat ``{name: array}`` dict via
+    :meth:`infer_entry`)."""
+
+    def __init__(self, mesh, model_axis: str = DEFAULT_MODEL_AXIS,
+                 data_axis: str = DEFAULT_DATA_AXIS):
+        self.mesh = mesh
+        self.model_axis = model_axis
+        self.data_axis = data_axis
+        self.tp = int(mesh.shape.get(model_axis, 1))
+        self.dp = int(mesh.shape.get(data_axis, 1))
+
+    # -- per-leaf rules ----------------------------------------------------
+    def _resident(self, shape, compute: P,
+                  shard_over_data: bool) -> P:
+        """Add the data axis to a free dimension when the ZeRO layouts
+        want the leaf resident 1/(dp·tp); 1-D leaves and indivisible
+        dims keep compute == resident."""
+        if not shard_over_data or self.dp <= 1 or len(shape) != 2:
+            return compute
+        m, d = self.model_axis, self.data_axis
+        if compute == P(None, m) and shape[0] % self.dp == 0:
+            return P(d, m)
+        if compute == P(m, None) and shape[1] % self.dp == 0:
+            return P(m, d)
+        return compute
+
+    def infer_entry(self, entry,
+                    shard_over_data: bool = False
+                    ) -> Dict[str, TpLeafSpec]:
+        """{name: TpLeafSpec} for one entry; names whose leaves stay
+        replicated are omitted. Entries that are not flat
+        ``{name: array}`` dicts get no tp specs (they ride the dp-only
+        paths untouched)."""
+        if self.tp <= 1 or not _is_flat_array_dict(entry):
+            return {}
+        m = self.model_axis
+        specs: Dict[str, P] = {}
+        col_widths = set()
+        for name, a in entry.items():
+            if a.ndim != 2:
+                continue
+            if a.shape[1] % self.tp == 0 and a.shape[1] >= self.tp:
+                specs[name] = P(None, m)          # column (out-dim)
+                col_widths.add(int(a.shape[1]))
+            elif a.shape[0] % self.tp == 0 and a.shape[0] >= self.tp:
+                specs[name] = P(m, None)          # row (in-dim)
+        for name, a in entry.items():
+            if (a.ndim == 1 and int(a.shape[0]) in col_widths
+                    and a.shape[0] % self.tp == 0):
+                specs[name] = P(m)                # column bias
+        return {name: TpLeafSpec(sp, self._resident(entry[name].shape,
+                                                    sp, shard_over_data))
+                for name, sp in specs.items()}
+
+    def infer(self, params,
+              shard_over_data: bool = False
+              ) -> Dict[str, Dict[str, TpLeafSpec]]:
+        """{entry: {name: TpLeafSpec}} over a two-level param tree;
+        entries with nothing to shard are omitted."""
+        out = {}
+        for k, sub in (params or {}).items():
+            specs = self.infer_entry(sub, shard_over_data)
+            if specs:
+                out[k] = specs
+        return out
+
+
+def tp_param_bytes(params, tp_specs) -> int:
+    """Total bytes of the tensor-parallel leaves (dense accounting —
+    each replica holds 1/tp of this once placed)."""
+    total = 0
+    for k, names in (tp_specs or {}).items():
+        sub = params.get(k, {})
+        for name in names:
+            a = sub.get(name) if isinstance(sub, dict) else None
+            if hasattr(a, "shape"):
+                total += int(np.prod(a.shape)) * a.dtype.itemsize
+    return total

@@ -30,6 +30,16 @@ dedicated ``seq`` axis lives in :mod:`.sequence`.
 All functions here are *manual-collective* primitives meant to run
 inside ``jax.shard_map`` (the pipeline runtime wraps everything in one
 shard_map over the full mesh). ``axis`` is the mesh axis name.
+
+For the TRAINING path this recipe is promoted to a GSPMD lowering in
+:mod:`.speclayout`: ``SpecLayout`` infers the same column/row
+partition per parameter and the jitted step tails pin the leaves with
+``with_sharding_constraint`` on a 2D ``(data, model)`` mesh, so XLA
+inserts the collectives itself and the modes compose with the
+ZeRO-1/ZeRO-3 update exchanges
+(``ParallelWrapper.Builder.tensor_parallel``). This module stays the
+explicit-collective reference (and the shard_map dryrun the 2D suite
+checks the lowering against, tests/test_2d_parallel.py).
 """
 from __future__ import annotations
 

@@ -26,7 +26,8 @@ import jax
 import jax.numpy as jnp
 
 from deeplearning4j_tpu.common import telemetry
-from deeplearning4j_tpu.parallel.mesh import (DEFAULT_DATA_AXIS, make_mesh,
+from deeplearning4j_tpu.parallel.mesh import (DEFAULT_DATA_AXIS,
+                                              DEFAULT_MODEL_AXIS, make_mesh,
                                               data_sharding,
                                               map_dataset_arrays,
                                               replicate_tree)
@@ -52,6 +53,7 @@ class ParallelWrapper:
 
     def __init__(self, model, mesh=None, *,
                  data_axis: str = DEFAULT_DATA_AXIS,
+                 model_axis: str = DEFAULT_MODEL_AXIS,
                  prefetch_buffer: int = 2,
                  averaging_frequency: int = 1,
                  report_score_after_averaging: bool = True,
@@ -60,6 +62,9 @@ class ParallelWrapper:
         self.model = model
         self.mesh = mesh if mesh is not None else make_mesh()
         self.data_axis = data_axis
+        self.model_axis = model_axis
+        #: tp degree, read off the mesh (1 on a pure-DP mesh)
+        self.tensor_parallel = int(self.mesh.shape.get(model_axis, 1))
         self.prefetch_buffer = prefetch_buffer
         self.averaging_frequency = averaging_frequency  # API parity only
         self.report_score = report_score_after_averaging
@@ -70,6 +75,10 @@ class ParallelWrapper:
         self.update_exchange = None
         self._exchange_bytes = 0
         self._fsdp_gather_bytes = 0
+        #: {entry: {name: TpLeafSpec}} inferred at placement (tp > 1)
+        self._tp_specs = {}
+        #: per-axis wire accounting (update_exchange_axis_bytes)
+        self._axis_bytes = None
         self._placed = False
         if averaging_frequency != 1:
             log.info("averagingFrequency=%d ignored: pjit DP is exactly "
@@ -85,9 +94,23 @@ class ParallelWrapper:
             self._workers = None
             self._accum = 1
             self._exchange = "auto"
+            self._tp = 1
 
         def workers(self, n: int) -> "ParallelWrapper.Builder":
             self._workers = n
+            return self
+
+        def tensor_parallel(self, n: int) -> "ParallelWrapper.Builder":
+            """Shard model weights ``n``-ways over a second ``model``
+            mesh axis (megatron-style column/row splits inferred per
+            layer — parallel.speclayout). Composes with every
+            update_exchange mode: dense×tp, sharded×tp, fsdp×tp. The
+            built mesh is 2D ``(data, model)``; the data-parallel
+            world size becomes ``devices // n``."""
+            n = int(n)
+            if n < 1:
+                raise ValueError(f"tensor_parallel must be >= 1, got {n}")
+            self._tp = n
             return self
 
         def mesh(self, mesh) -> "ParallelWrapper.Builder":
@@ -135,9 +158,22 @@ class ParallelWrapper:
             mesh = self._mesh
             if mesh is None:
                 devs = jax.devices()
-                if self._workers:
-                    devs = devs[:self._workers]
-                mesh = make_mesh({DEFAULT_DATA_AXIS: len(devs)}, devs)
+                if self._tp > 1:
+                    # 2D (data, model) mesh: ``workers`` counts the
+                    # data-parallel groups; total devices = workers*tp
+                    if self._workers:
+                        devs = devs[:self._workers * self._tp]
+                    if len(devs) % self._tp:
+                        raise ValueError(
+                            f"tensor_parallel={self._tp} does not "
+                            f"divide {len(devs)} devices")
+                    mesh = make_mesh({DEFAULT_DATA_AXIS: -1,
+                                      DEFAULT_MODEL_AXIS: self._tp},
+                                     devs)
+                else:
+                    if self._workers:
+                        devs = devs[:self._workers]
+                    mesh = make_mesh({DEFAULT_DATA_AXIS: len(devs)}, devs)
             return ParallelWrapper(self._model, mesh,
                                    prefetch_buffer=self._prefetch,
                                    averaging_frequency=self._avg_freq,
@@ -154,26 +190,49 @@ class ParallelWrapper:
         afterwards XLA keeps them resident and in sync). Params/states
         go replicated; with the ZeRO-1 sharded exchange the updater
         state goes 1/N per replica along the data axis instead
-        (parallel.zero — the Adam-family HBM win)."""
+        (parallel.zero — the Adam-family HBM win). On a 2D
+        ``(data, model)`` mesh the tp leaves (parallel.speclayout
+        inference) are additionally placed at their megatron
+        column/row shardings — GSPMD inserts the activation psums, and
+        the update exchange stays strictly inside the ``data`` axis."""
         m = self.model
         if not m._initialized:
             m.init()
         from deeplearning4j_tpu.parallel.zero import (
-            UpdateExchange, place_updater_states,
+            UpdateExchange, place_tp_params, place_updater_states,
             resolve_update_exchange, states_to_dense, states_to_sharded,
-            update_exchange_bytes)
+            update_exchange_axis_bytes, update_exchange_bytes)
         mode = resolve_update_exchange(self.mesh, self.data_axis,
                                        self.requested_exchange, m)
         self.update_exchange = mode
+        tp = self.tensor_parallel
+        if tp > 1 and not hasattr(m, "set_dp_mesh"):
+            log.info("%s has no set_dp_mesh; tensor_parallel=%d lowers "
+                     "to replicated weights", type(m).__name__, tp)
+            tp = 1
         if hasattr(m, "_params_are_fsdp") and m._params_are_fsdp():
             # elastic re-place: params still resident as 1/N flats from
-            # a previous mesh.  If the world size changed (or the mode
-            # did), round-trip through the dense layout so the wire
-            # accounting and the re-entry below see real shapes.
+            # a previous mesh.  If the world size changed, the mode
+            # did, or a tp partition is requested (the specs below are
+            # inferred from dense shapes), round-trip through the dense
+            # layout so the wire accounting and the re-entry see real
+            # shapes.
             from deeplearning4j_tpu.parallel.zero import fsdp_spec_shards
             stale_n = fsdp_spec_shards(getattr(m, "_fsdp_specs", {}) or {})
-            if mode is not UpdateExchange.FSDP or stale_n != self.n_workers:
+            if (mode is not UpdateExchange.FSDP
+                    or stale_n != self.n_workers or tp > 1
+                    or getattr(m, "_tp_specs", None)):
                 m.set_dp_mesh(None, self.data_axis)
+        self._tp_specs = {}
+        if tp > 1:
+            from deeplearning4j_tpu.parallel.speclayout import SpecLayout
+            layout = SpecLayout(self.mesh, model_axis=self.model_axis,
+                                data_axis=self.data_axis)
+            # ZeRO tails keep the tp leaves' between-step residency
+            # additionally sharded over data (1/(dp*tp) per chip)
+            self._tp_specs = layout.infer(
+                m.params, shard_over_data=mode in (
+                    UpdateExchange.SHARDED, UpdateExchange.FSDP))
         import numpy as np
         # wire accounting while params are still in the dense layout
         # (the fsdp conversion below folds them into padded flats)
@@ -185,6 +244,26 @@ class ParallelWrapper:
         self._exchange_bytes = update_exchange_bytes(m.params, n, mode)
         self._fsdp_gather_bytes = (
             int((n - 1) * param_bytes / n) if n > 1 else 0)
+        self._axis_bytes = None
+        if self._tp_specs:
+            self._axis_bytes = update_exchange_axis_bytes(
+                m.params, n, tp, self._tp_specs)
+            # dp collectives only ever move each model-shard group's
+            # own 1/tp slice of the tp leaves
+            self._exchange_bytes = self._axis_bytes["data"]
+            tpb = self._axis_bytes["tp_param_bytes"]
+            self._fsdp_gather_bytes = (
+                int((n - 1) * ((param_bytes - tpb) + tpb // tp) / n)
+                if n > 1 else 0)
+            if telemetry.enabled():
+                telemetry.gauge(
+                    "dl4j_tp_param_shard_bytes",
+                    "per-chip bytes of the tensor-parallel weight "
+                    "shards after 2D placement (1/tp of the tp leaves; "
+                    "x1/dp more under fsdp residency)").set(
+                        tpb // (tp * (n if mode is UpdateExchange.FSDP
+                                      else 1)),
+                        model_shards=tp, mode=mode.value)
         if mode is UpdateExchange.FSDP and not hasattr(m, "set_dp_mesh"):
             log.info("%s has no set_dp_mesh; fsdp request lowers to "
                      "dense", type(m).__name__)
@@ -194,13 +273,33 @@ class ParallelWrapper:
             # and placement (1/N flat shards per replica) — params are
             # NOT replicated here, that would defeat the residency win
             m.states = replicate_tree(self.mesh, m.states)
-            m.set_dp_mesh(self.mesh, self.data_axis, mode="fsdp")
+            m.set_dp_mesh(self.mesh, self.data_axis, mode="fsdp",
+                          model_axis=self.model_axis,
+                          tp_specs=self._tp_specs)
         else:
-            m.params = replicate_tree(self.mesh, m.params)
+            if self._tp_specs:
+                # dense layout, 2D placement: tp leaves at their
+                # compute sharding, everything else replicated
+                m.params = place_tp_params(self.mesh, m.params,
+                                           self._tp_specs)
+            else:
+                m.params = replicate_tree(self.mesh, m.params)
             m.states = replicate_tree(self.mesh, m.states)
             if hasattr(m, "set_dp_mesh"):
-                m.set_dp_mesh(self.mesh if mode is UpdateExchange.SHARDED
-                              else None, self.data_axis)
+                if self._tp_specs:
+                    # the mesh must install even for the dense tail so
+                    # the step pins tp leaves (mode="dense" keeps the
+                    # dp-flat machinery out of the update)
+                    m.set_dp_mesh(
+                        self.mesh, self.data_axis,
+                        mode=("sharded" if mode is UpdateExchange.SHARDED
+                              else "dense"),
+                        model_axis=self.model_axis,
+                        tp_specs=self._tp_specs)
+                else:
+                    m.set_dp_mesh(self.mesh
+                                  if mode is UpdateExchange.SHARDED
+                                  else None, self.data_axis)
         if hasattr(m, "set_accumulation_steps"):
             m.set_accumulation_steps(self.accumulation_steps)
         elif self.accumulation_steps > 1:
@@ -213,8 +312,9 @@ class ParallelWrapper:
             m.updater_states = place_updater_states(
                 self.mesh,
                 states_to_sharded(m.params, m.updater_states,
-                                  self.n_workers),
-                self.data_axis)
+                                  self.n_workers,
+                                  tp_specs=self._tp_specs),
+                self.data_axis, tp_specs=self._tp_specs)
         else:
             # a sharded layout left by a previous placement (or a
             # restored ZeRO-1 checkpoint) converts back to dense first
@@ -317,6 +417,18 @@ class ParallelWrapper:
                         "estimated per-replica wire bytes moved by the "
                         "in-step update exchange (ring collectives)"
                     ).inc(self._exchange_bytes, mode=mode)
+                    if self._axis_bytes is not None:
+                        axis_c = telemetry.counter(
+                            "dl4j_update_exchange_axis_bytes_total",
+                            "per-mesh-axis wire bytes of the update "
+                            "exchange on a 2D (data, model) mesh; the "
+                            "model-axis series staying at 0 is the 2D "
+                            "layout invariant (dp collectives never "
+                            "cross the model axis)")
+                        axis_c.inc(self._axis_bytes["data"],
+                                   axis=self.data_axis)
+                        axis_c.inc(self._axis_bytes["model"],
+                                   axis=self.model_axis)
                     if mode == "fsdp":
                         telemetry.counter(
                             "dl4j_fsdp_gather_bytes_total",
@@ -371,13 +483,28 @@ class ParallelWrapper:
         re-resolved for the new mesh and any dense/sharded/fsdp layout
         resident for the old world size round-trips through the dense
         layout during ``_place_model`` — training continues the exact
-        dense trajectory with the new device count."""
+        dense trajectory with the new device count.  A tp degree from
+        :meth:`Builder.tensor_parallel` is preserved (``workers`` again
+        counts data-parallel groups); pass an explicit 1D ``mesh`` to
+        restore a 2D run onto a pure-DP world."""
         if mesh is None:
             devs = jax.devices()
-            if workers:
-                devs = devs[:workers]
-            mesh = make_mesh({self.data_axis: len(devs)}, devs)
+            tp = self.tensor_parallel
+            if tp > 1:
+                if workers:
+                    devs = devs[:workers * tp]
+                if len(devs) % tp:
+                    raise ValueError(
+                        f"tensor_parallel={tp} does not divide "
+                        f"{len(devs)} devices")
+                mesh = make_mesh({self.data_axis: -1,
+                                  self.model_axis: tp}, devs)
+            else:
+                if workers:
+                    devs = devs[:workers]
+                mesh = make_mesh({self.data_axis: len(devs)}, devs)
         self.mesh = mesh
+        self.tensor_parallel = int(mesh.shape.get(self.model_axis, 1))
         self.update_exchange = None
         self._placed = False
         self._place_model()

@@ -46,9 +46,10 @@ import jax
 import numpy as np
 
 from deeplearning4j_tpu.learning.updaters import (DP_SHARDED_KEY, FSDP_KEY,
-                                                  dp_ravel, dp_flatten_spec,
-                                                  dp_unravel, is_dp_sharded,
-                                                  is_fsdp)
+                                                  TP_KEY, dp_ravel,
+                                                  dp_flatten_spec,
+                                                  dp_unravel, has_tp,
+                                                  is_dp_sharded, is_fsdp)
 from deeplearning4j_tpu.parallel.mesh import (DEFAULT_DATA_AXIS,
                                               flat_sharding, replicated)
 
@@ -159,7 +160,15 @@ def apply_update_sharded(updater, grads, params, state, iteration, mesh,
     flat_p, spec = dp_ravel(params, n)
     flat_g, _ = dp_ravel(grads, n, spec)
     # grads arrive as a per-shard sum pending all-reduce; pinning the
-    # flat view to P(axis) turns that all-reduce into a reduce-scatter
+    # flat view to P(axis) turns that all-reduce into a reduce-scatter.
+    # On a mesh with another non-trivial axis (the 2D (data, model)
+    # mesh) the SPMD partitioner miscompiles the ravel's `concatenate`
+    # when its output is pinned straight to P(axis) — materialize the
+    # flats replicated first, then reshard (an all-reduce + slice
+    # instead of the fused reduce-scatter; values identical).
+    if any(s > 1 for ax, s in mesh.shape.items() if ax != axis):
+        flat_g = pin(flat_g, full)
+        flat_p = pin(flat_p, full)
     flat_g = pin(flat_g, shard)
     flat_p = pin(flat_p, shard)
     inner = state[DP_SHARDED_KEY] if is_dp_sharded(state) else state
@@ -232,7 +241,8 @@ class FsdpParamView:
     ``dtypes.cast_floats`` for the compute-dtype path."""
 
     def __init__(self, params, specs, mesh, axis=DEFAULT_DATA_AXIS,
-                 order=None, prefetch=True, cast_dtype=None):
+                 order=None, prefetch=True, cast_dtype=None,
+                 tp_specs=None):
         self._params = params
         self._specs = specs
         self._mesh = mesh
@@ -241,18 +251,38 @@ class FsdpParamView:
                        if is_fsdp(params.get(k, {}))]
         self._prefetch = prefetch
         self._cast_dtype = cast_dtype
+        self._tp_specs = tp_specs or {}
         self._cache = {}
 
     def cast(self, dtype):
         return FsdpParamView(self._params, self._specs, self._mesh,
                              self._axis, order=self._order,
-                             prefetch=self._prefetch, cast_dtype=dtype)
+                             prefetch=self._prefetch, cast_dtype=dtype,
+                             tp_specs=self._tp_specs)
 
     def _dense(self, key):
         if key not in self._cache:
-            self._cache[key] = fsdp_gather(
-                self._params[key][FSDP_KEY], self._specs[key],
+            sub = self._params[key]
+            dense = fsdp_gather(
+                sub[FSDP_KEY], self._specs[key],
                 self._mesh, self._axis, cast_dtype=self._cast_dtype)
+            if has_tp(sub):
+                # tp leaves gather over data only (resident -> compute
+                # spec); the model-axis sharding stays physical
+                sp = self._tp_specs.get(key, {})
+                tp = {n: (tp_gather_leaf(a,
+                                         _named(self._mesh,
+                                                sp[n].compute),
+                                         _named(self._mesh,
+                                                sp[n].resident))
+                          if n in sp else a)
+                      for n, a in sub[TP_KEY].items()}
+                if self._cast_dtype is not None:
+                    from deeplearning4j_tpu.common.dtypes import \
+                        cast_floats
+                    tp = cast_floats(tp, self._cast_dtype)
+                dense = {**dense, **tp}
+            self._cache[key] = dense
         return self._cache[key]
 
     def get(self, key, default=None):
@@ -281,17 +311,27 @@ class FsdpParamView:
         return self._params.keys()
 
 
-def params_to_fsdp(params: Dict, n_shards: int):
+def params_to_fsdp(params: Dict, n_shards: int, tp_specs=None):
     """Model params -> per-entry fsdp flat layout. Returns
     ``(flat_params, specs)``; empty/already-flat entries pass through
-    (and keep no spec)."""
+    (and keep no spec). Entries with ``tp_specs`` names split: those
+    leaves ride under TP_KEY as full-shape arrays (model-axis sharded
+    via spec placement) and only the rest ravels into the dp flats."""
+    tp_specs = tp_specs or {}
     out, specs = {}, {}
     for k, sub in params.items():
         if not sub or is_fsdp(sub):
             out[k] = sub
             continue
-        flats, spec = dp_ravel(sub, n_shards)
-        out[k] = {FSDP_KEY: flats}
+        names = tp_specs.get(k, ())
+        if names and isinstance(sub, dict):
+            tpp = {n: sub[n] for n in names if n in sub}
+            rest = {n: a for n, a in sub.items() if n not in names}
+        else:
+            tpp, rest = {}, sub
+        flats, spec = dp_ravel(rest, n_shards)
+        out[k] = ({FSDP_KEY: flats, TP_KEY: tpp} if tpp
+                  else {FSDP_KEY: flats})
         specs[k] = spec
     return out, specs
 
@@ -306,6 +346,19 @@ def fsdp_spec_shards(specs) -> "int | None":
     return None
 
 
+def on_2d_mesh(a) -> bool:
+    """True when ``a`` is device-resident on a mesh with more than one
+    non-trivial axis.  Dense leaves densified off a 2D ``(data, model)``
+    residency must round-trip through the host before re-raveling:
+    feeding them back through a concatenate -> shard-pin chain hits the
+    same XLA SPMD lowering bug :func:`apply_update_sharded` pins
+    around."""
+    mesh = getattr(getattr(a, "sharding", None), "mesh", None)
+    if mesh is None or not hasattr(mesh, "shape"):
+        return False
+    return sum(1 for s in mesh.shape.values() if s > 1) > 1
+
+
 def params_to_dense(params: Dict, specs: Dict) -> Dict:
     """Inverse of :func:`params_to_fsdp` (padding dropped). Runs on the
     host at layout-sync boundaries (checkpoint, inference outside the
@@ -317,7 +370,13 @@ def params_to_dense(params: Dict, specs: Dict) -> Dict:
     t0 = time.perf_counter()
     out = {}
     for k, sub in params.items():
-        out[k] = dp_unravel(sub[FSDP_KEY], specs[k]) if is_fsdp(sub) else sub
+        if is_fsdp(sub):
+            dense = dp_unravel(sub[FSDP_KEY], specs[k])
+            if has_tp(sub):
+                dense = {**dense, **sub[TP_KEY]}
+            out[k] = dense
+        else:
+            out[k] = sub
     for leaf in jax.tree_util.tree_leaves(out):
         if hasattr(leaf, "block_until_ready"):
             leaf.block_until_ready()
@@ -332,11 +391,13 @@ def params_to_dense(params: Dict, specs: Dict) -> Dict:
 
 
 def place_fsdp_params(mesh, params: Dict,
-                      axis: str = DEFAULT_DATA_AXIS) -> Dict:
+                      axis: str = DEFAULT_DATA_AXIS,
+                      tp_specs=None) -> Dict:
     """Device-put fsdp params on the mesh: flat entries along
-    ``P(axis)`` (1/N resident per replica — the ZeRO-3 win), non-fsdp
-    entries replicated. Sets the ``dl4j_fsdp_param_shard_bytes``
-    residency gauge."""
+    ``P(axis)`` (1/N resident per replica — the ZeRO-3 win), TP_KEY
+    leaves at their RESIDENT NamedSharding (model×data under fsdp×tp),
+    non-fsdp entries replicated. Sets the
+    ``dl4j_fsdp_param_shard_bytes`` residency gauge."""
     shard = flat_sharding(mesh, axis)
     full = replicated(mesh)
     n = mesh.shape.get(axis, 1)
@@ -351,6 +412,13 @@ def place_fsdp_params(mesh, params: Dict,
                 flat_bytes += sum(int(np.prod(v.shape)) * v.dtype.itemsize
                                   for v in flats.values())
                 out[k] = {FSDP_KEY: flats}
+                if has_tp(sub):
+                    sp = (tp_specs or {}).get(k, {})
+                    out[k][TP_KEY] = {
+                        n_: jax.device_put(
+                            a, _named(mesh, sp[n_].resident)
+                            if n_ in sp else full)
+                        for n_, a in sub[TP_KEY].items()}
             else:
                 out[k] = jax.tree_util.tree_map(
                     lambda a: (jax.device_put(a, full)
@@ -393,6 +461,153 @@ def apply_update_fsdp(updater, flat_g, flat_p, state, iteration, mesh,
     return new_flat, new_state
 
 
+# -- tensor parallelism (2D (data, model) meshes) ----------------------------
+# TP leaves keep their FULL logical shape everywhere; the specs below
+# (parallel.speclayout.TpLeafSpec) only pin physical placement, so the
+# updater/constraint math is byte-for-byte the dense math. The one
+# layout-visible rule: tp leaves never ravel into the dp flats — a
+# data-axis ravel of a model-sharded leaf would all-gather across the
+# model axis inside the step, which 2D mode forbids. They ride under
+# TP_KEY instead and get their own elementwise tail (apply_update_tp).
+
+def _named(mesh, spec):
+    from jax.sharding import NamedSharding
+    return NamedSharding(mesh, spec)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(1, 2))
+def tp_gather_leaf(x, compute_sh, resident_sh):
+    """Pin one tp leaf to its compute sharding for the forward.
+
+    Like :func:`_gather_flats`, the custom vjp exists because a plain
+    constraint's transpose pins the cotangent to the SAME sharding;
+    here the backward pins it to the RESIDENT sharding instead, so
+    under fsdp×tp (resident = ``P(data, model)``) the pending data-axis
+    gradient sum lowers to a reduce-scatter and each replica only holds
+    its 1/(dp·tp) grad shard. When compute == resident (dense×tp) this
+    degenerates to a symmetric pin whose backward all-reduces the grad
+    over ``data`` only — never across ``model``."""
+    return jax.lax.with_sharding_constraint(x, compute_sh)
+
+
+def _tp_gather_fwd(x, compute_sh, resident_sh):
+    return tp_gather_leaf(x, compute_sh, resident_sh), None
+
+
+def _tp_gather_bwd(compute_sh, resident_sh, _res, ct):
+    return (jax.lax.with_sharding_constraint(ct, resident_sh),)
+
+
+tp_gather_leaf.defvjp(_tp_gather_fwd, _tp_gather_bwd)
+
+
+def pin_tp_entry(entry, mesh, specs):
+    """Pin an entry's tp leaves for the forward (traced inside the
+    caller's jit). Non-spec'd leaves pass through untouched."""
+    out = dict(entry)
+    for name, ls in specs.items():
+        a = out.get(name)
+        if hasattr(a, "shape"):
+            out[name] = tp_gather_leaf(a, _named(mesh, ls.compute),
+                                       _named(mesh, ls.resident))
+    return out
+
+
+def split_tp_entry(entry, specs):
+    """One dense entry -> (rest, tp) by spec'd names."""
+    tp = {n: entry[n] for n in specs if n in entry}
+    rest = {n: a for n, a in entry.items() if n not in specs}
+    return rest, tp
+
+
+def split_tp_state(state):
+    """One entry's updater state -> (rest_state, tp_state); inverse is
+    :func:`merge_tp_state`. Stateless entries pass ``()`` through."""
+    if has_tp(state):
+        rest = {k: v for k, v in state.items() if k != TP_KEY}
+        return (rest if rest else ()), state[TP_KEY]
+    return state, ()
+
+
+def merge_tp_state(rest, tp):
+    if not tp:
+        return rest
+    out = dict(rest) if isinstance(rest, dict) else {}
+    out[TP_KEY] = tp
+    return out
+
+
+def _pin_by_name(tree, mesh, specs, which: str):
+    """Pin every leaf of ``tree`` whose innermost dict key is a spec'd
+    param name (handles both ``{name: arr}`` and the updater-state
+    ``{slot: {name: arr}}`` shapes)."""
+    def pin(path, a):
+        if not hasattr(a, "shape"):
+            return a
+        for entry in reversed(path):
+            name = getattr(entry, "key", None)
+            if name in specs:
+                sp = getattr(specs[name], which)
+                return jax.lax.with_sharding_constraint(
+                    a, _named(mesh, sp))
+        return a
+    return jax.tree_util.tree_map_with_path(pin, tree)
+
+
+def apply_update_tp(updater, grads, params, state, iteration, mesh,
+                    specs, *, gather_params: bool, epoch=0):
+    """The update tail for one entry's tensor-parallel leaves, traced
+    inside the caller's jit. Everything keeps full logical shapes; the
+    pins keep the (purely elementwise) updater math physically sharded
+    at the resident layout — model axis, plus ``data`` under the ZeRO
+    layouts — so tp updater state is resident at 1/tp (·1/dp).
+    ``gather_params=True`` pins the new params back to the compute
+    layout (the ZeRO-1-style trailing data-axis all-gather);
+    ``False`` keeps them resident (fsdp — the next forward re-gathers
+    through :func:`tp_gather_leaf`)."""
+    def pin(tree, which):
+        return _pin_by_name(tree, mesh, specs, which)
+
+    grads = pin(grads, "resident")
+    params = pin(params, "resident")
+    state = pin(state, "resident")
+    updates, new_state = updater.apply(grads, state, iteration, epoch)
+    new_params = {n: (params[n] - updates[n]).astype(params[n].dtype)
+                  for n in params}
+    new_params = pin(new_params,
+                     "compute" if gather_params else "resident")
+    new_state = pin(new_state, "resident")
+    return new_params, new_state
+
+
+def place_tp_params(mesh, params, tp_specs, *, resident: bool = False):
+    """Device-put a DENSE-layout param tree on a 2D mesh: tp leaves at
+    their compute (or resident) NamedSharding, everything else
+    replicated. The dense×tp / sharded×tp placement (fsdp entries go
+    through :func:`place_fsdp_params` instead)."""
+    full = replicated(mesh)
+    which = "resident" if resident else "compute"
+    out = {}
+    for k, sub in params.items():
+        specs = (tp_specs or {}).get(k, {})
+        if not specs or not isinstance(sub, dict):
+            out[k] = jax.tree_util.tree_map(
+                lambda a: (jax.device_put(a, full)
+                           if hasattr(a, "shape") else a), sub)
+            continue
+        ent = {}
+        for n, a in sub.items():
+            if n in specs and hasattr(a, "shape"):
+                ent[n] = jax.device_put(
+                    a, _named(mesh, getattr(specs[n], which)))
+            elif hasattr(a, "shape"):
+                ent[n] = jax.device_put(a, full)
+            else:
+                ent[n] = a
+        out[k] = ent
+    return out
+
+
 # -- layout conversions ------------------------------------------------------
 def _flats_match_spec(inner, spec) -> bool:
     """True when every flat's length equals the spec's PADDED length —
@@ -405,38 +620,81 @@ def _flats_match_spec(inner, spec) -> bool:
     return True
 
 
-def to_sharded_state(params, state, n_shards: int):
-    """One subtree's dense updater state -> ZeRO-1 flat layout.
+def _state_tp_names(state) -> set:
+    """Param names the TP_KEY half of a flat state covers (the state is
+    self-describing — slots mirror the tp param dict)."""
+    names = set()
+    for slot_tree in (state.get(TP_KEY, {}) or {}).values():
+        if isinstance(slot_tree, dict):
+            names |= set(slot_tree)
+    return names
+
+
+def to_sharded_state(params, state, n_shards: int, tp_names=()):
+    """One subtree's dense updater state -> ZeRO-1 flat layout (the
+    ``tp_names`` leaves split out under TP_KEY as full-shape trees —
+    they shard over ``model``(×``data``) via specs, never via the
+    flats).
 
     A state that is ALREADY flat is checked against the padded sizes
-    for ``n_shards``: flats raveled for a DIFFERENT world size (an
-    elastic resume — padding is a multiple of the shard count) round-
-    trip through the dense layout and re-ravel, so the layout always
-    matches the mesh about to consume it (ROADMAP item 4's
-    ``DpFlatSpec`` re-ravel)."""
+    for ``n_shards`` AND the tp split: flats raveled for a DIFFERENT
+    world size or tp partition (an elastic resume — padding is a
+    multiple of the shard count) round-trip through the dense layout
+    and re-ravel, so the layout always matches the mesh about to
+    consume it (ROADMAP item 4's ``DpFlatSpec`` re-ravel)."""
     if not state:
         return state
-    if is_dp_sharded(state):
-        spec = dp_flatten_spec(params, n_shards)
-        if _flats_match_spec(state[DP_SHARDED_KEY], spec):
+    tp_names = tuple(tp_names or ())
+
+    def rest_of(tree):
+        if tp_names and isinstance(tree, dict):
+            return {n: a for n, a in tree.items() if n not in tp_names}
+        return tree
+
+    if is_dp_sharded(state) or has_tp(state):
+        spec = dp_flatten_spec(rest_of(params), n_shards)
+        if (_flats_match_spec(state.get(DP_SHARDED_KEY, {}), spec)
+                and _state_tp_names(state) == set(tp_names)):
             return state
         state = to_dense_state(params, state)
-    return {DP_SHARDED_KEY: {slot: dp_ravel(tree, n_shards)[0]
-                             for slot, tree in state.items()}}
+    flats, tp = {}, {}
+    for slot, tree in state.items():
+        flats[slot] = dp_ravel(rest_of(tree), n_shards)[0]
+        if tp_names and isinstance(tree, dict):
+            tp_slot = {n: tree[n] for n in tp_names if n in tree}
+            if tp_slot:
+                tp[slot] = tp_slot
+    out = {DP_SHARDED_KEY: flats}
+    if tp:
+        out[TP_KEY] = tp
+    return out
 
 
 def to_dense_state(params, state):
-    """Inverse of :func:`to_sharded_state` (padding dropped)."""
-    if not is_dp_sharded(state):
+    """Inverse of :func:`to_sharded_state` (padding dropped; TP_KEY
+    leaves — self-describing — merge back into their slots)."""
+    if not (is_dp_sharded(state) or has_tp(state)):
         return state
-    spec = dp_flatten_spec(params, 1)
-    return {slot: dp_unravel(flats, spec)
-            for slot, flats in state[DP_SHARDED_KEY].items()}
+    tp = state.get(TP_KEY, {}) if isinstance(state, dict) else {}
+    tp_names = _state_tp_names(state)
+    rest_params = ({n: p for n, p in params.items() if n not in tp_names}
+                   if tp_names and isinstance(params, dict) else params)
+    spec = dp_flatten_spec(rest_params, 1)
+    out = {slot: dp_unravel(flats, spec)
+           for slot, flats in state.get(DP_SHARDED_KEY, {}).items()}
+    for slot, tree in tp.items():
+        base = out.get(slot)
+        out[slot] = ({**base, **tree} if isinstance(base, dict)
+                     else dict(tree))
+    return out
 
 
-def states_to_sharded(params: Dict, states: Dict, n_shards: int) -> Dict:
+def states_to_sharded(params: Dict, states: Dict, n_shards: int,
+                      tp_specs=None) -> Dict:
     """Model-level convenience: convert every layer/vertex entry."""
-    return {k: to_sharded_state(params.get(k, {}), s, n_shards)
+    tp_specs = tp_specs or {}
+    return {k: to_sharded_state(params.get(k, {}), s, n_shards,
+                                tp_names=tuple(tp_specs.get(k, ())))
             for k, s in states.items()}
 
 
@@ -446,10 +704,12 @@ def states_to_dense(params: Dict, states: Dict) -> Dict:
 
 
 def place_updater_states(mesh, states: Dict,
-                         axis: str = DEFAULT_DATA_AXIS) -> Dict:
+                         axis: str = DEFAULT_DATA_AXIS,
+                         tp_specs=None) -> Dict:
     """Device-put updater states on the mesh: sharded flat entries along
-    ``P(axis)`` (1/N per replica — the whole HBM win), everything else
-    replicated (the pre-ZeRO placement)."""
+    ``P(axis)`` (1/N per replica — the whole HBM win), TP_KEY slots at
+    their leaves' RESIDENT NamedSharding (1/tp, ·1/dp under the ZeRO
+    layouts), everything else replicated (the pre-ZeRO placement)."""
     shard = flat_sharding(mesh, axis)
     full = replicated(mesh)
 
@@ -457,6 +717,13 @@ def place_updater_states(mesh, states: Dict,
         return jax.tree_util.tree_map(
             lambda a: jax.device_put(a, sh) if hasattr(a, "shape") else a,
             tree)
+
+    def put_tp(tp, sp):
+        return {slot: {n: jax.device_put(
+                           a, _named(mesh, sp[n].resident)
+                           if n in sp else full)
+                       for n, a in slot_tree.items()}
+                for slot, slot_tree in tp.items()}
 
     from deeplearning4j_tpu.common.diagnostics import collective_span
     nbytes = sum(int(np.prod(a.shape)) * a.dtype.itemsize
@@ -467,14 +734,50 @@ def place_updater_states(mesh, states: Dict,
     with collective_span("state_placement", axis, nbytes,
                          entries=len(states)):
         for k, s in states.items():
-            if is_dp_sharded(s):
-                out[k] = {DP_SHARDED_KEY: put(s[DP_SHARDED_KEY], shard)}
+            if is_dp_sharded(s) or has_tp(s):
+                ent = {}
+                if DP_SHARDED_KEY in s:
+                    ent[DP_SHARDED_KEY] = put(s[DP_SHARDED_KEY], shard)
+                if TP_KEY in s:
+                    ent[TP_KEY] = put_tp(s[TP_KEY],
+                                         (tp_specs or {}).get(k, {}))
+                out[k] = ent
             else:
                 out[k] = put(s, full)
     return out
 
 
 # -- accounting --------------------------------------------------------------
+def update_exchange_axis_bytes(params, data_shards: int,
+                               model_shards: int = 1,
+                               tp_specs=None) -> dict:
+    """Per-axis, per-replica wire bytes one update exchange moves on a
+    2D ``(data, model)`` mesh (ring-collective model).
+
+    The 2D invariant: dp collectives never cross the ``model`` axis —
+    tp leaves stay out of the dp flats, so each model-shard group only
+    exchanges its OWN 1/tp slice of the tp params over ``data``, and
+    the update exchange moves ZERO bytes across ``model`` (activation
+    psums in forward/backward are the only model-axis traffic).
+    ``cross_axis_bytes`` reports what a naive data-ravel of the tp
+    leaves WOULD have moved across ``model`` (the all-gather a flat
+    pin of a model-sharded leaf implies) — 0 under this layout; the
+    bench regression gate holds it down."""
+    from deeplearning4j_tpu.parallel.speclayout import tp_param_bytes
+    total = sum(int(np.prod(a.shape)) * a.dtype.itemsize
+                for a in jax.tree_util.tree_leaves(params)
+                if hasattr(a, "shape"))
+    tp = max(int(model_shards), 1)
+    tpb = tp_param_bytes(params, tp_specs) if tp > 1 else 0
+    exchanged = (total - tpb) + tpb // tp
+    nd = max(int(data_shards), 1)
+    data = (int(2 * (nd - 1) * exchanged / nd) if nd > 1 else 0)
+    naive = (int((tp - 1) * tpb / tp) if tp > 1 else 0)
+    return {"data": data, "model": 0, "cross_axis_bytes": 0,
+            "naive_ravel_cross_axis_bytes": naive,
+            "tp_param_bytes": int(tpb)}
+
+
 def update_exchange_bytes(params, n_shards: int, mode=None) -> int:
     """Per-replica wire bytes one applied update exchange moves (ring
     collectives). All three modes move the same total: dense AllReduce
@@ -492,20 +795,27 @@ def update_exchange_bytes(params, n_shards: int, mode=None) -> int:
     return int(2 * (n_shards - 1) * total / n_shards)
 
 
-def exchange_report(params, n_shards: int, mode=None) -> dict:
+def exchange_report(params, n_shards: int, mode=None,
+                    model_shards: int = 1, tp_specs=None) -> dict:
     """Scaling-observatory accounting for one step's update exchange:
     parameter bytes, per-replica wire bytes (ring-collective model),
     the wire:param ratio, plus a per-mode breakdown — dense reports the
     single all-reduce, sharded/fsdp split it into the grad
     reduce-scatter + param all-gather halves, and fsdp adds the
     per-replica param residency (`bench.py` folds this in next to the
-    efficiency curve)."""
+    efficiency curve). With ``model_shards > 1`` the report adds the
+    per-axis block from :func:`update_exchange_axis_bytes` and the tp
+    residency (2D modes)."""
     total = sum(int(np.prod(a.shape)) * a.dtype.itemsize
                 for a in jax.tree_util.tree_leaves(params)
                 if hasattr(a, "shape"))
     mode_s = getattr(mode, "value", mode) or "dense"
-    wire = update_exchange_bytes(params, n_shards, mode)
-    half = (int((n_shards - 1) * total / n_shards) if n_shards > 1 else 0)
+    tp = max(int(model_shards), 1)
+    axis_bytes = update_exchange_axis_bytes(params, n_shards, tp,
+                                            tp_specs)
+    wire = (axis_bytes["data"] if tp > 1
+            else update_exchange_bytes(params, n_shards, mode))
+    half = int(wire // 2)
     rep = {
         "mode": mode_s,
         "shards": int(n_shards),
@@ -521,6 +831,11 @@ def exchange_report(params, n_shards: int, mode=None) -> dict:
     if mode_s == UpdateExchange.FSDP.value:
         rep["param_resident_bytes_per_replica"] = (
             int(total // n_shards) if n_shards > 1 else int(total))
+    if tp > 1:
+        rep["model_shards"] = tp
+        rep["axis_bytes"] = axis_bytes
+        rep["tp_resident_bytes_per_replica"] = (
+            axis_bytes["tp_param_bytes"] // tp)
     return rep
 
 

@@ -29,7 +29,11 @@ entries lower-is-better (matched on the full dotted path, since the
 leaves are bare size/worker labels); ``step_breakdown`` phase means
 gate as time-like seconds.  The ``fault_tolerance`` block's stall /
 ratio / resume-latency figures gate as lower-is-better, as do any
-``lost_steps`` counts.
+``lost_steps`` counts.  The ISSUE-12 ``scaling_2d`` block gates
+per-mode ``step_seconds`` / ``throughput_sps`` with the usual
+polarities and its ``cross_axis`` / ``model_axis_update_bytes``
+figures as lower-is-better (the 2D wire invariant: the update
+exchange must not start crossing the model axis).
 
 Self-test (tier-1, no accelerator): comparing the checked-in
 BENCH_r04.json to BENCH_r05.json must pass (r05 improved), and the
@@ -47,7 +51,8 @@ HIGHER_BETTER = ("value", "tflops", "throughput", "_ips", "_rps",
                  "efficiency", "savings_ratio")
 #: metrics where smaller is better
 LOWER_BETTER = ("_ms", "_us", "_seconds", "overhead", "stall", "skew",
-                "_bytes_per_chip", "lost_steps")
+                "_bytes_per_chip", "lost_steps", "cross_axis",
+                "model_axis_update_bytes")
 #: keys that are identity/config, never compared; "canary" keys are
 #: clock-path checks documented as dispatch-noise-dominated
 SKIP = ("metric", "unit", "n_trials", "vs_baseline", "meta", "min",

@@ -21,7 +21,12 @@
 #      75 after a final snapshot, and re-running the same command must
 #      auto-resume onto the uninterrupted loss/parameter trajectory
 #      with zero manual steps (the ISSUE 11 acceptance bar,
-#      tests/test_chaos.py).
+#      tests/test_chaos.py);
+#   5. 2D equivalence gate: the (dp x tp) and (fsdp x tp) training
+#      modes on the virtual 8-device mesh must track the dp-only dense
+#      trajectory, keep the update exchange off the model axis, and
+#      survive checkpoint/remesh back to 1D (the ISSUE 12 acceptance
+#      bar, tests/test_2d_parallel.py).
 #
 # Usage: scripts/ci_check.sh [--threshold PCT]     (default 10)
 # Exit 0 = all gates clean, 1 = a gate failed, 2 = bad usage.
@@ -68,6 +73,10 @@ sys.exit(0 if ok else 1)' || fail=1
 
 echo "== chaos / auto-resume gate =="
 JAX_PLATFORMS=cpu python -m pytest tests/test_chaos.py -q \
+    -p no:cacheprovider || fail=1
+
+echo "== 2D parallelism equivalence gate =="
+JAX_PLATFORMS=cpu python -m pytest tests/test_2d_parallel.py -q \
     -p no:cacheprovider || fail=1
 
 exit $fail

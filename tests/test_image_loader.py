@@ -59,3 +59,27 @@ def test_grayscale_and_upscale(tmp_path):
     g = ImageLoader(48, 48, 1).load(p)
     assert g.shape == (48, 48, 1)
     assert g.dtype == np.float32
+
+
+def test_exact_resize_bitwise_matches_array_path(tmp_path):
+    """``exact_resize=True`` removes the r5 divergence: a lossless
+    file decode routes through the SAME half-pixel numpy resize as an
+    ndarray input — bit-identical, from PNG and from JPEG (draft mode
+    disabled so the resize sees full-size pixels)."""
+    img = _photo()
+    loader = ImageLoader(224, 224, 3, exact_resize=True)
+    p = str(tmp_path / "img.png")
+    Image.fromarray(img).save(p)
+    np.testing.assert_array_equal(loader.load(p), loader.load(img))
+    # default loader on the same file: PIL's antialiased resize —
+    # close, but NOT the array path's pixels (the documented default)
+    default = ImageLoader(224, 224, 3).load(p)
+    assert np.any(default != loader.load(p))
+    # JPEG: lossy decode, but file vs decoded-array must still agree
+    # bitwise once both go through the numpy resize
+    pj = str(tmp_path / "img.jpg")
+    Image.fromarray(img).save(pj, quality=95)
+    with Image.open(pj) as im:
+        decoded = np.asarray(im.convert("RGB"))
+    np.testing.assert_array_equal(loader.load(pj),
+                                  loader.load(decoded))

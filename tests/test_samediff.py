@@ -654,9 +654,10 @@ def test_fuse_attention_skips_multi_consumer_probs():
 
 
 def test_shard_placeholders_warns_on_batch_dim_tie(caplog):
-    """Inferred batch-dim votes can tie; the losers are silently
-    replicated (no DP sharding, no divisibility check) — that must at
-    least WARN, pointing at explicit mappings (ADVICE.md r5)."""
+    """Inferred batch-dim votes can tie OR be outvoted by aux
+    placeholders; the losers are silently replicated (no DP sharding,
+    no divisibility check) — that must at least WARN, pointing at
+    explicit mappings (ADVICE.md r5)."""
     import logging
     from conftest import require_devices
     require_devices(2)
@@ -666,9 +667,50 @@ def test_shard_placeholders_warns_on_batch_dim_tie(caplog):
     ph = {"a": jnp.ones((4, 8)), "b": jnp.ones((6, 8))}
     with caplog.at_level(logging.WARNING, "deeplearning4j_tpu"):
         _shard_placeholders(mesh, ph)
-    assert any("tie" in r.message for r in caplog.records)
+    assert any("replicated" in r.message for r in caplog.records)
+    # the aux-outvote case: two aux tensors sharing a leading dim
+    # outvote the true batch tensor, which gets replicated — warn too
+    caplog.clear()
+    ph3 = {"x": jnp.ones((4, 8)), "aux1": jnp.ones((6, 8)),
+           "aux2": jnp.ones((6, 2))}
+    with caplog.at_level(logging.WARNING, "deeplearning4j_tpu"):
+        out, _ = _shard_placeholders(mesh, ph3)
+    assert any("'x'" in r.message and "replicated" in r.message
+               for r in caplog.records)
     # explicit batch_names: unambiguous, no warning
     caplog.clear()
     with caplog.at_level(logging.WARNING, "deeplearning4j_tpu"):
         _shard_placeholders(mesh, ph, batch_names=["a"])
-    assert not any("tie" in r.message for r in caplog.records)
+    assert not any("replicated" in r.message for r in caplog.records)
+
+
+def test_shard_placeholders_explicit_specs(caplog):
+    """Explicit placeholder->PartitionSpec mappings bypass batch-dim
+    inference entirely (the mesh-run escape hatch, ADVICE.md r5)."""
+    import logging
+    import jax
+    from jax.sharding import PartitionSpec as P
+    from conftest import require_devices
+    require_devices(2)
+    from deeplearning4j_tpu.autodiff.samediff import _shard_placeholders
+    from deeplearning4j_tpu.parallel.mesh import make_mesh
+    mesh = make_mesh({"data": 2}, jax.devices()[:2])
+    ph = {"x": jnp.ones((4, 8)), "aux1": jnp.ones((6, 8)),
+          "aux2": jnp.ones((6, 2))}
+    specs = {"aux1": P(), "aux2": P()}
+    with caplog.at_level(logging.WARNING, "deeplearning4j_tpu"):
+        out, sig = _shard_placeholders(mesh, ph, specs=specs)
+    # spec'd placeholders no longer vote: x wins, no warning
+    assert not any("replicated" in r.message for r in caplog.records)
+    assert out["x"].sharding.spec == P("data", None)
+    assert out["aux1"].sharding.spec == P()
+    # explicit specs key the compiled-program cache
+    _, sig_none = _shard_placeholders(mesh, dict(ph),
+                                      batch_names=["x"])
+    assert sig != sig_none
+    # tuple form coerces; unknown names are rejected loudly
+    out2, _ = _shard_placeholders(mesh, dict(ph),
+                                  specs={"x": ("data",)})
+    assert out2["x"].sharding.spec == P("data")
+    with pytest.raises(ValueError, match="unknown placeholder"):
+        _shard_placeholders(mesh, dict(ph), specs={"nope": P()})

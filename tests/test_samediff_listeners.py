@@ -141,6 +141,36 @@ class TestCheckpointListenerOnSameDiff:
         back.fit(it, n_epochs=1)                 # trains on, no error
         assert back.iteration_count == 10
 
+    def test_load_checkpoint_dispatches_samediff_zip(self, tmp_path):
+        """Regression (ADVICE.md r5): ``CheckpointListener.
+        load_checkpoint`` — the FaultTolerantTrainer resume entry —
+        must dispatch SameDiff-format zips written by
+        ``checkpoint_snapshot()`` through the format-sniffing
+        ``ModelSerializer.restore_model``, not fall through
+        ``restore_multi_layer_network`` (which would die on the
+        missing MLN config entry)."""
+        sd = _classifier_sd()
+        ckpt = CheckpointListener(tmp_path, save_every_n_iterations=1)
+        sd.set_listeners(ckpt)
+        x, y = _data()
+        sd.fit_steps({"x": x, "y": y}, 3)
+        ckpt.flush()
+        assert sorted(tmp_path.glob("checkpoint_*.zip"))
+        back = CheckpointListener.load_checkpoint(tmp_path)
+        assert isinstance(back, SameDiff)
+        assert back.iteration_count == 3
+        np.testing.assert_allclose(
+            np.asarray(back.get_variable("w").get_arr()),
+            np.asarray(sd.get_variable("w").get_arr()),
+            rtol=1e-6, atol=1e-7)
+        # a direct file path dispatches identically
+        last = CheckpointListener.last_checkpoint_in(tmp_path)
+        back2 = CheckpointListener.load_checkpoint(last)
+        assert isinstance(back2, SameDiff)
+        # and the restored program keeps training
+        back.fit_steps({"x": x, "y": y}, 2)
+        assert back.iteration_count == 5
+
     def test_iteration_checkpoints_via_fit_steps(self, tmp_path):
         """The benchmark-grade fori loop checkpoints too: one listener
         round per group, so save_every_n_iterations=1 saves after each
