@@ -373,6 +373,61 @@ def main():
                   file=sys.stderr)
     except Exception as e:
         print(f"graph-optimizer leg failed: {e!r}", file=sys.stderr)
+    # Conv-kernel leg: fused (DL4J_TPU_FUSED_CONV Pallas epilogue
+    # family) vs unfused ResNet-bottleneck train step — step time,
+    # compiled temp bytes, cost-analysis bytes, and pct_of_roof from
+    # the roofline classifier. CPU-proxy subprocess (interpret-mode
+    # kernels; the line carries meta.proxy).
+    try:
+        env = {**os.environ, "PYTHONPATH": "", "JAX_PLATFORMS": "cpu"}
+        out = subprocess.run(
+            [sys.executable,
+             os.path.join(_ROOT, "benchmarks",
+                          "bench_conv_kernels.py")],
+            capture_output=True, text=True, timeout=900, env=env,
+            cwd=_ROOT)
+        if out.returncode != 0:
+            raise RuntimeError(
+                f"rc={out.returncode}: {out.stderr.strip()[-400:]}")
+        for ln in out.stdout.strip().splitlines():
+            if not ln.startswith("{"):
+                continue              # tolerate library banners
+            rec = json.loads(ln)
+            if rec.get("metric") == "conv_kernels":
+                rec.pop("metric")
+                line["conv_kernels"] = rec
+        if "conv_kernels" not in line:
+            print("conv-kernel leg: no line in child output",
+                  file=sys.stderr)
+    except Exception as e:
+        print(f"conv-kernel leg failed: {e!r}", file=sys.stderr)
+    # Long-context leg: the 8192/16384/32768 attention train-step
+    # ladder (collapses to one seq-512 proxy point off-TPU), each
+    # entry stamped with the kernel-select auto decision for its
+    # nominal TPU shape.
+    try:
+        env = {**os.environ, "PYTHONPATH": "", "JAX_PLATFORMS": "cpu"}
+        out = subprocess.run(
+            [sys.executable,
+             os.path.join(_ROOT, "benchmarks", "bench_longcontext.py"),
+             "--sweep"],
+            capture_output=True, text=True, timeout=900, env=env,
+            cwd=_ROOT)
+        if out.returncode != 0:
+            raise RuntimeError(
+                f"rc={out.returncode}: {out.stderr.strip()[-400:]}")
+        for ln in out.stdout.strip().splitlines():
+            if not ln.startswith("{"):
+                continue              # tolerate library banners
+            rec = json.loads(ln)
+            if rec.get("metric") == "longcontext":
+                rec.pop("metric")
+                line["longcontext"] = rec
+        if "longcontext" not in line:
+            print("long-context leg: no line in child output",
+                  file=sys.stderr)
+    except Exception as e:
+        print(f"long-context leg failed: {e!r}", file=sys.stderr)
     # Telemetry panel: the registry the run's hot paths recorded into
     # (train-step histogram, compile-cache counters, prefetch stats
     # when an iterator fed) — the same data /metrics would serve.

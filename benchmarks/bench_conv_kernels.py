@@ -1,0 +1,226 @@
+"""Conv/BN/ReLU epilogue-fusion bench leg (ISSUE-13 tentpole evidence).
+
+A ResNet-50 stage-style bottleneck tower (1x1 -> 3x3 -> 1x1, each
+conv followed by BN and ReLU, residual add) trained for one step
+under both settings of the ``DL4J_TPU_FUSED_CONV`` gate:
+
+  unfused — the dense ``lax.conv_general_dilated`` + XLA-fused
+            epilogue lowering the layers always used
+  fused   — the Pallas epilogue family (ops/conv_pallas.py): BN
+            statistics and scale/shift/act inside output tiles, the
+            1x1 convs on the matmul+epilogue kernel when aligned
+
+Per leg: median train-step ms, compiled ``memory_analysis`` temp
+bytes, XLA cost-analysis flops / bytes accessed, and the roofline
+classification (``diagnostics.roofline``) — pct_of_roof is the
+acceptance number.  Off-TPU the kernels run in Pallas interpret mode
+(same code path, not representative speed) and the line is marked
+``meta.proxy``; the roofline is still computed against the v5e peaks
+so the before/after structure is identical on both rigs.
+
+The gate is trace-time (jit freezes the kernel-select decision), so
+each leg builds and traces its OWN step function while the
+``Environment.extra['fused_conv']`` override is set.
+
+Prints ONE JSON line: ``{"metric": "conv_kernels", ...}``.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import statistics
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+
+def _build_step(batch, hw, channels, dtype):
+    """Bottleneck tower as pure layer calls (no network plumbing):
+    returns (params, states, x, step_fn) with step_fn a fresh
+    un-jitted train step closing over the layer objects."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from deeplearning4j_tpu.activations import Activation
+    from deeplearning4j_tpu.nn.conf.inputs import InputType
+    from deeplearning4j_tpu.nn.conf.layers import (BatchNormalization,
+                                                   ConvolutionLayer)
+    from deeplearning4j_tpu.nn.conf.layers import ConvolutionMode
+
+    width = channels // 4
+    specs = [
+        # biased-ReLU stem: the conv-epilogue site proper (bias+act
+        # streamed into the conv output tiles when fused)
+        (ConvolutionLayer(kernel_size=(3, 3), n_in=channels,
+                          n_out=channels, has_bias=True,
+                          convolution_mode=ConvolutionMode.SAME,
+                          activation=Activation.RELU), None),
+        (ConvolutionLayer(kernel_size=(1, 1), n_in=channels,
+                          n_out=width, has_bias=False,
+                          convolution_mode=ConvolutionMode.SAME,
+                          activation=Activation.IDENTITY), None),
+        (BatchNormalization(activation=Activation.RELU), width),
+        (ConvolutionLayer(kernel_size=(3, 3), n_in=width, n_out=width,
+                          has_bias=False,
+                          convolution_mode=ConvolutionMode.SAME,
+                          activation=Activation.IDENTITY), None),
+        (BatchNormalization(activation=Activation.RELU), width),
+        (ConvolutionLayer(kernel_size=(1, 1), n_in=width,
+                          n_out=channels, has_bias=False,
+                          convolution_mode=ConvolutionMode.SAME,
+                          activation=Activation.IDENTITY), None),
+        (BatchNormalization(activation=Activation.IDENTITY), channels),
+        # biased-ReLU 1x1 head at 128-lane-aligned channels: the
+        # matmul+epilogue kernel site when fused
+        (ConvolutionLayer(kernel_size=(1, 1), n_in=channels,
+                          n_out=channels, has_bias=True,
+                          convolution_mode=ConvolutionMode.SAME,
+                          activation=Activation.RELU), None),
+    ]
+    key = jax.random.PRNGKey(0)
+    params, states = [], []
+    for layer, nf in specs:
+        if isinstance(layer, BatchNormalization):
+            itype = InputType.convolutional(hw, hw, nf)
+            layer.set_n_in(itype, True)
+            params.append(layer.init_params(key, itype, dtype))
+            states.append(layer.init_state(itype, dtype))
+        else:
+            itype = InputType.convolutional(hw, hw, layer.n_in)
+            key, sub = jax.random.split(key)
+            params.append(layer.init_params(sub, itype, dtype))
+            states.append(None)
+
+    rng = np.random.RandomState(0)
+    x = jnp.asarray(rng.randn(batch, hw, hw, channels) * 0.1, dtype)
+
+    def step(params, states, x):
+        def loss(params):
+            h, new_states = x, []
+            for (layer, _), p, st in zip(specs, params, states):
+                h, st = layer.forward(p, h, training=True, state=st)
+                new_states.append(st)
+            out = jax.nn.relu(h + x)          # residual close
+            return jnp.sum(out.astype(jnp.float32) ** 2), new_states
+        (l, new_states), grads = jax.value_and_grad(
+            loss, has_aux=True)(params)
+        return l, grads, new_states
+
+    return params, states, x, step
+
+
+def _leg(gate, batch, hw, channels, dtype, trials, steps):
+    import jax
+
+    from benchmarks.cost_util import V5E_BF16_PEAK_TFLOPS, V5E_HBM_GBPS
+    from deeplearning4j_tpu.common import diagnostics
+    from deeplearning4j_tpu.common.environment import Environment
+    from deeplearning4j_tpu.ops import kernel_select
+
+    env = Environment.get()
+    saved = env.extra.get("fused_conv")
+    env.extra["fused_conv"] = gate
+    before = {fam: kernel_select.decisions(fam)
+              for fam in ("conv_epilogue", "bn_fwd")}
+    try:
+        params, states, x, step = _build_step(batch, hw, channels,
+                                              dtype)
+        jitted = jax.jit(step)
+        l, grads, new_states = jitted(params, states, x)  # trace here
+        jax.block_until_ready(grads)
+        assert bool(jax.numpy.isfinite(l))
+        times = []
+        for _ in range(trials):
+            t0 = time.perf_counter()
+            for _ in range(steps):
+                l, grads, _ = jitted(params, states, x)
+            jax.block_until_ready(grads)
+            times.append((time.perf_counter() - t0) / steps * 1e3)
+        leg = {"step_ms": round(statistics.median(times), 3)}
+        leg["kernel_select"] = {
+            fam: {d: n - before[fam].get(d, 0)
+                  for d, n in kernel_select.decisions(fam).items()
+                  if n != before[fam].get(d, 0)}
+            for fam in before}
+        try:
+            compiled = jitted.lower(params, states, x).compile()
+            leg["temp_bytes"] = int(
+                compiled.memory_analysis().temp_size_in_bytes)
+            ca = compiled.cost_analysis()
+            if isinstance(ca, list):
+                ca = ca[0]
+            flops = float(ca.get("flops", 0.0))
+            byts = float(ca.get("bytes accessed", 0.0))
+            leg["flops"] = flops
+            leg["bytes_accessed"] = byts
+            step_s = leg["step_ms"] / 1e3
+            leg["roofline"] = diagnostics.roofline(
+                flops, byts, step_s,
+                peak_tflops=V5E_BF16_PEAK_TFLOPS,
+                peak_hbm_gbps=V5E_HBM_GBPS)
+        except Exception as e:
+            print(f"cost/memory analysis unavailable ({e!r})",
+                  file=sys.stderr)
+    finally:
+        if saved is None:
+            env.extra.pop("fused_conv", None)
+        else:
+            env.extra["fused_conv"] = saved
+    return leg
+
+
+def main(batch=None, hw=None, channels=None, dtype_name=None,
+         trials=3, steps=5):
+    import jax
+
+    on_tpu = jax.devices()[0].platform == "tpu"
+    if batch is None:
+        batch = 32 if on_tpu else 2
+    if hw is None:
+        hw = 32 if on_tpu else 8
+    if channels is None:
+        # 256 keeps the 1x1 convs on the matmul+epilogue kernel
+        # (128-lane aligned); the CPU proxy uses the same so both
+        # fused sites are exercised in interpret mode
+        channels = 256 if on_tpu else 128
+    if dtype_name is None:
+        dtype_name = "bfloat16" if on_tpu else "float32"
+    import jax.numpy as jnp
+    dtype = jnp.dtype(dtype_name)
+
+    line = {"metric": "conv_kernels",
+            "shape": [batch, hw, hw, channels], "dtype": dtype_name,
+            "meta": {"proxy": not on_tpu,
+                     "platform": jax.devices()[0].platform}}
+    for name, gate in (("unfused", "0"), ("fused", "1")):
+        try:
+            line[name] = _leg(gate, batch, hw, channels, dtype,
+                              trials, steps)
+        except Exception as e:
+            print(f"{name} leg failed: {e!r}", file=sys.stderr)
+            line[name] = {"error":
+                          f"{type(e).__name__}: {str(e)[:160]}"}
+    u, f = line.get("unfused", {}), line.get("fused", {})
+    if "bytes_accessed" in u and "bytes_accessed" in f and \
+            f["bytes_accessed"]:
+        line["bytes_ratio"] = round(
+            u["bytes_accessed"] / f["bytes_accessed"], 3)
+    if "step_ms" in u and "step_ms" in f and f["step_ms"]:
+        line["speedup"] = round(u["step_ms"] / f["step_ms"], 3)
+    print(json.dumps(line))
+    return line
+
+
+if __name__ == "__main__":
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--batch", type=int, default=None)
+    ap.add_argument("--hw", type=int, default=None)
+    ap.add_argument("--channels", type=int, default=None)
+    ap.add_argument("--dtype", default=None)
+    ap.add_argument("--trials", type=int, default=3)
+    ap.add_argument("--steps", type=int, default=5)
+    a = ap.parse_args()
+    main(a.batch, a.hw, a.channels, a.dtype, a.trials, a.steps)

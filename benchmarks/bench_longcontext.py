@@ -12,7 +12,13 @@ measures what long-context TRAINING actually costs per step for:
 
 Each leg times grad(loss) of one attention call at [b, h, t, d],
 median of n_trials, synced on the loss scalar.  Prints ONE JSON line
-per leg.  Use --seq to sweep (8192 / 16384 are the committed legs).
+per leg.  Use --seq for a single point, or --sweep for the committed
+8192 / 16384 / 32768 ladder (one JSON summary line,
+``{"metric": "longcontext"}``, that bench.py folds in) — the shapes
+where the kernel-select auto rung (t_k >= 4096 + HBM headroom,
+ops/attention_pallas.py) picks flash on its own.  Off-TPU the sweep
+collapses to one seq-512 proxy point, but each entry still records
+the analytic TPU-platform ladder decision for its nominal shape.
 """
 from __future__ import annotations
 
@@ -107,6 +113,40 @@ def main(seq=8192, batch=1, heads=8, d=128, dtype="bfloat16",
     return results
 
 
+def sweep(seqs=(8192, 16384, 32768), batch=1, heads=8, d=128,
+          dtype="bfloat16", trials=5, steps=10,
+          legs=("xla", "flash")):
+    """The committed long-context ladder: one measured point per seq
+    (xla-OOM legs are data too), each stamped with the decision the
+    kernel-select auto rung would take for that shape ON TPU — the
+    evidence that the t_k >= 4096 heuristic fires exactly where the
+    measured win is."""
+    import jax
+
+    from deeplearning4j_tpu.ops.attention_pallas import \
+        select_attention_backend
+
+    on_tpu = jax.devices()[0].platform == "tpu"
+    out = {"metric": "longcontext", "batch": batch, "heads": heads,
+           "d": d, "dtype": dtype, "proxy": not on_tpu, "sweep": []}
+    for seq in (seqs if on_tpu else seqs[:1]):
+        res = main(seq=seq, batch=batch, heads=heads, d=d,
+                   dtype=dtype, trials=trials, steps=steps, legs=legs)
+        entry = {"seq": seq if on_tpu else 512,
+                 "legs": {leg: {k: v for k, v in line.items()
+                               if k != "metric"}
+                          for leg, line in res.items()}}
+        qk = (batch, heads, seq, d)
+        backend, reason = select_attention_backend(
+            qk, qk, platform="tpu", override=None,
+            use_env_override=False)
+        entry["auto_backend_on_tpu"] = backend
+        entry["auto_reason"] = reason
+        out["sweep"].append(entry)
+    print(json.dumps(out))
+    return out
+
+
 if __name__ == "__main__":
     ap = argparse.ArgumentParser()
     ap.add_argument("--seq", type=int, default=8192)
@@ -116,7 +156,16 @@ if __name__ == "__main__":
     ap.add_argument("--trials", type=int, default=5)
     ap.add_argument("--steps", type=int, default=10)
     ap.add_argument("--legs", default="xla,flash,block")
+    ap.add_argument("--sweep", action="store_true",
+                    help="run the 8192/16384/32768 ladder and print "
+                         "the one-line summary bench.py folds in")
     a = ap.parse_args()
-    main(seq=a.seq, batch=a.batch, heads=a.heads, d=a.d,
-         trials=a.trials, steps=a.steps,
-         legs=tuple(a.legs.split(",")))
+    if a.sweep:
+        sweep(batch=a.batch, heads=a.heads, d=a.d, trials=a.trials,
+              steps=a.steps,
+              legs=tuple(l for l in a.legs.split(",")
+                         if l != "block"))
+    else:
+        main(seq=a.seq, batch=a.batch, heads=a.heads, d=a.d,
+             trials=a.trials, steps=a.steps,
+             legs=tuple(a.legs.split(",")))

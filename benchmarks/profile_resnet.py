@@ -51,6 +51,67 @@ def categorize(name: str) -> str:
     return base
 
 
+def fused_attribution(batch, hw, steps, on_tpu):
+    """ISSUE-13 roofline attribution: the SAME model costed and timed
+    under both settings of the DL4J_TPU_FUSED_CONV gate (fresh net per
+    leg — jit freezes the kernel-select decision at trace time).
+    Prints bytes / step time / %-of-roof before and after the Pallas
+    epilogue family, i.e. how much of the conv-path roofline gap the
+    fused kernels close.  Off-TPU this runs the kernels in interpret
+    mode on a reduced-stage net: structurally the same program, not a
+    representative speed — read the bytes column there, not the ms."""
+    from deeplearning4j_tpu.common import diagnostics
+    from deeplearning4j_tpu.common.environment import Environment
+    from deeplearning4j_tpu.datasets.dataset import DataSet
+    from deeplearning4j_tpu.models.zoo import ResNet50
+
+    kw = dict(num_classes=1000, height=hw, width=hw,
+              compute_dtype="bfloat16" if on_tpu else None)
+    if not on_tpu:
+        kw.update(STAGES=((2, 16), (2, 32)))
+    rng = np.random.RandomState(0)
+    x = rng.randn(batch, hw, hw, 3).astype(np.float32)
+    y = np.eye(1000, dtype=np.float32)[rng.randint(0, 1000, batch)]
+    ds = DataSet(jax.device_put(jnp.asarray(x)),
+                 jax.device_put(jnp.asarray(y)))
+
+    env = Environment.get()
+    saved = env.extra.get("fused_conv")
+    legs = {}
+    print("\nfused-conv roofline attribution "
+          f"({'tpu' if on_tpu else 'cpu proxy, interpret mode'}):")
+    try:
+        for name, gate in (("unfused", "0"), ("fused", "1")):
+            env.extra["fused_conv"] = gate
+            net = ResNet50(**kw).init()
+            net.fit(ds)                       # build + trace the step
+            float(net.score())
+            flops, byts = graph_step_cost(net, x, y)
+            t0 = time.perf_counter()
+            net.fit_steps(ds, steps)
+            assert np.isfinite(float(net.score()))
+            step_s = (time.perf_counter() - t0) / steps
+            roof = diagnostics.roofline(
+                flops, byts, step_s,
+                peak_tflops=V5E_BF16_PEAK_TFLOPS,
+                peak_hbm_gbps=V5E_HBM_GBPS)
+            legs[name] = {"bytes": byts, "step_s": step_s,
+                          "roof": roof}
+            print(f"  {name:8s} {byts / 1e9:7.2f} GB/step  "
+                  f"{step_s * 1e3:8.2f} ms  "
+                  f"{roof.get('pct_of_roof', 0):5.1f}% of "
+                  f"{roof.get('bound', '?')} roof")
+    finally:
+        if saved is None:
+            env.extra.pop("fused_conv", None)
+        else:
+            env.extra["fused_conv"] = saved
+    if len(legs) == 2 and legs["fused"]["bytes"]:
+        print(f"  bytes ratio (unfused/fused): "
+              f"{legs['unfused']['bytes'] / legs['fused']['bytes']:.3f}")
+    return legs
+
+
 def main(batch=256, hw=224, steps=60):
     from deeplearning4j_tpu.datasets.dataset import DataSet
     from deeplearning4j_tpu.models.zoo import ResNet50
@@ -119,6 +180,12 @@ def main(batch=256, hw=224, steps=60):
     print(f"\ndevice-op time over 3 traced steps: {total:.1f} ms")
     for cat, ms in sorted(cats.items(), key=lambda kv: -kv[1])[:14]:
         print(f"  {ms / 3:7.2f} ms/step  {ms / total:6.1%}  {cat}")
+
+    # -- fused-kernel A/B (ISSUE-13) -----------------------------------
+    try:
+        fused_attribution(batch, hw, steps if on_tpu else 2, on_tpu)
+    except Exception as e:                       # noqa: BLE001
+        print(f"fused attribution skipped: {e!r}", file=sys.stderr)
 
 
 if __name__ == "__main__":

@@ -118,6 +118,12 @@ class Environment:
       forces the Pallas flash sdpa backend, =0 kills it, unset =
       auto heuristic), DL4J_TPU_FUSED_BN_BWD (fused BN backward:
       default on-for-TPU; =0 kills, =1 forces anywhere),
+      DL4J_TPU_FUSED_CONV (tri-state like the flash gate: the Pallas
+      conv/BN/ReLU epilogue family — conv-bias-act, BN statistics +
+      normalize, matmul+epilogue for aligned 1x1 convs; all three
+      gates resolve through the ops/kernel_select.py ladder:
+      structural gate, then force/kill, then auto heuristic, every
+      decision counted in dl4j_kernel_select_total),
       DL4J_TPU_CHAOS (common.faults fault injection: comma-separated
       kill_after_steps=N / hard_kill_after_steps=N /
       slow_worker=SECONDS / torn_checkpoint=1)

@@ -312,15 +312,21 @@ class ConvolutionLayer(Layer):
 
     def forward(self, params, x, *, training, rng=None, state=None):
         x = self._maybe_dropout(x, training, rng)
-        z = jax.lax.conv_general_dilated(
+        # conv + bias/activation epilogue through the shared fused
+        # entry point (ops/conv_pallas.py): when the conv_epilogue
+        # kernel-select ladder admits the site the epilogue runs
+        # inside Pallas output tiles; otherwise this IS the dense
+        # lax.conv_general_dilated lowering the layer always used
+        from deeplearning4j_tpu.ops.conv_pallas import conv_forward
+        z = conv_forward(
             x, params["W"],
             window_strides=self.stride,
             padding=self._pad_cfg(),
             rhs_dilation=self.dilation,
-            dimension_numbers=("NHWC", "HWIO", "NHWC"))
-        if self.has_bias:
-            z = z + params["b"]
-        return self.activation(z), state
+            dimension_numbers=("NHWC", "HWIO", "NHWC"),
+            bias=params["b"] if self.has_bias else None,
+            activation=self.activation)
+        return z, state
 
     def set_n_in(self, input_type, override):
         if isinstance(input_type, InputTypeConvolutional) and \
@@ -463,20 +469,37 @@ class BatchNormalization(Layer):
             # DL4J_TPU_FUSED_BN_BWD the SAME forward runs under a
             # custom_vjp whose backward is the hand Pallas kernel
             # pair (measured slower than XLA's autodiff on ResNet-50;
-            # kept as the tuning seam — BENCH_notes_r03.md).
+            # kept as the tuning seam — BENCH_notes_r03.md), and the
+            # bn_fwd ladder (DL4J_TPU_FUSED_CONV family) additionally
+            # routes its statistics + normalize through the one-pass
+            # Pallas kernels in ops/conv_pallas.py. Without the fused
+            # backward, maybe_fused_bn_train runs the same kernels
+            # with the relu/identity activation streamed into the
+            # normalize epilogue.
             from deeplearning4j_tpu.ops.bn_pallas import (
                 bn_forward_math, bn_train_normalize,
                 fused_bn_bwd_enabled)
+            from deeplearning4j_tpu.ops.conv_pallas import (
+                maybe_fused_bn_train)
+            act_done = False
             if fused_bn_bwd_enabled():
                 out, mean, var = bn_train_normalize(
                     x, params["gamma"], params["beta"], self.eps)
             else:
-                out, mean, var, _ = bn_forward_math(
-                    x, params["gamma"], params["beta"], self.eps)
+                fused = maybe_fused_bn_train(
+                    x, params["gamma"], params["beta"], self.eps,
+                    self.activation)
+                if fused is not None:
+                    out, mean, var = fused
+                    act_done = True
+                else:
+                    out, mean, var, _ = bn_forward_math(
+                        x, params["gamma"], params["beta"], self.eps)
             d = self.decay
             new_state = {"mean": d * state["mean"] + (1 - d) * mean,
                          "var": d * state["var"] + (1 - d) * var}
-            return self.activation(out), new_state
+            return (out if act_done else self.activation(out),
+                    new_state)
         acc = jnp.promote_types(x.dtype, jnp.float32)
         mean = state["mean"].astype(acc)
         var = state["var"].astype(acc)
@@ -484,6 +507,12 @@ class BatchNormalization(Layer):
         # multiply-add over the tensor instead of subtract/divide chains
         scale = params["gamma"].astype(var.dtype) / jnp.sqrt(var + self.eps)
         bias = params["beta"].astype(var.dtype) - mean * scale
+        from deeplearning4j_tpu.ops.conv_pallas import (
+            maybe_bn_inference_epilogue)
+        out = maybe_bn_inference_epilogue(x, scale, bias,
+                                          self.activation)
+        if out is not None:         # scale/shift/act in ONE pass
+            return out, state
         out = x * scale.astype(x.dtype) + bias.astype(x.dtype)
         return self.activation(out), state
 

@@ -80,13 +80,16 @@ class Convolution1DLayer(Layer):
             pad = "SAME"
         else:
             pad = [(self.padding, self.padding)]
-        z = jax.lax.conv_general_dilated(
+        # shared fused-epilogue entry point (ops/conv_pallas.py) —
+        # dense fallback whenever the structural gates demote the site
+        from deeplearning4j_tpu.ops.conv_pallas import conv_forward
+        z = conv_forward(
             x, params["W"], window_strides=(self.stride,), padding=pad,
             rhs_dilation=(self.dilation,),
-            dimension_numbers=("NWC", "WIO", "NWC"))
-        if self.has_bias:
-            z = z + params["b"]
-        return self.activation(z), state
+            dimension_numbers=("NWC", "WIO", "NWC"),
+            bias=params["b"] if self.has_bias else None,
+            activation=self.activation)
+        return z, state
 
     def set_n_in(self, input_type, override):
         if isinstance(input_type, InputTypeRecurrent) and \
@@ -216,13 +219,16 @@ class Convolution3D(Layer):
 
     def forward(self, params, x, *, training, rng=None, state=None):
         x = self._maybe_dropout(x, training, rng)
-        z = jax.lax.conv_general_dilated(
+        # shared fused-epilogue entry point (ops/conv_pallas.py) —
+        # dense fallback whenever the structural gates demote the site
+        from deeplearning4j_tpu.ops.conv_pallas import conv_forward
+        z = conv_forward(
             x, params["W"], window_strides=self.stride,
             padding=self._pad_cfg(), rhs_dilation=self.dilation,
-            dimension_numbers=("NDHWC", "DHWIO", "NDHWC"))
-        if self.has_bias:
-            z = z + params["b"]
-        return self.activation(z), state
+            dimension_numbers=("NDHWC", "DHWIO", "NDHWC"),
+            bias=params["b"] if self.has_bias else None,
+            activation=self.activation)
+        return z, state
 
     def set_n_in(self, input_type, override):
         if isinstance(input_type, InputTypeConvolutional3D) and \

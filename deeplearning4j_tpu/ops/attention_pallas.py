@@ -36,7 +36,6 @@ pattern), so CPU tests exercise the SAME code path the chip runs.
 from __future__ import annotations
 
 import math
-import os
 from typing import Optional, Tuple
 
 import jax
@@ -53,14 +52,11 @@ HBM_HEADROOM_FRACTION = 0.25
 def flash_attention_override() -> Optional[bool]:
     """Tri-state DL4J_TPU_FLASH_ATTENTION gate: True (force on) /
     False (kill switch) / None (auto heuristic). Environment
-    ``extra["flash_attention"]`` overrides the env var."""
-    from deeplearning4j_tpu.common.environment import Environment
-    flag = Environment.get().extra.get("flash_attention")
-    if flag is None:
-        flag = os.environ.get("DL4J_TPU_FLASH_ATTENTION")
-    if flag is None or str(flag) == "":
-        return None
-    return str(flag) in ("1", "true", "True", "yes")
+    ``extra["flash_attention"]`` overrides the env var.  Since the
+    ISSUE-13 unification this is the ``attention`` family row of the
+    shared ``ops/kernel_select.py`` ladder."""
+    from deeplearning4j_tpu.ops import kernel_select
+    return kernel_select.gate_override("attention")
 
 
 def _free_hbm_bytes() -> Optional[int]:
@@ -111,40 +107,48 @@ def select_attention_backend(q_shape: Tuple[int, ...],
     the DL4J_TPU_FLASH_ATTENTION override; then the auto heuristic
     (TPU + long sequence, or scores tensor vs free-HBM headroom).
     ``platform``/``free_hbm``/``override`` exist for tests — they
-    default to the live device."""
+    default to the live device.  The ladder itself lives in
+    ``ops/kernel_select.py`` (family ``attention``), so every decision
+    lands in ``dl4j_kernel_select_total{kernel="attention"}``."""
+    from deeplearning4j_tpu.ops import kernel_select
+
+    structural = None
     if has_bias:
-        return "dense", "additive bias is not streamable"
-    if len(q_shape) not in (3, 4) or len(k_shape) != len(q_shape):
-        return "dense", f"rank {len(q_shape)} not supported"
-    if q_shape[-1] != k_shape[-1]:
-        return "dense", "q/k head-dim mismatch"
-    if not mask_ok:
-        return "dense", "mask is not a key mask"
+        structural = "additive bias is not streamable"
+    elif len(q_shape) not in (3, 4) or len(k_shape) != len(q_shape):
+        structural = f"rank {len(q_shape)} not supported"
+    elif q_shape[-1] != k_shape[-1]:
+        structural = "q/k head-dim mismatch"
+    elif not mask_ok:
+        structural = "mask is not a key mask"
     if override is None and use_env_override:
         override = flash_attention_override()
-    if override is False:
-        return "dense", "DL4J_TPU_FLASH_ATTENTION=0 kill switch"
-    if override is True:
-        return "flash", "DL4J_TPU_FLASH_ATTENTION=1 forced"
-    if platform is None:
-        platform = jax.devices()[0].platform
-    if platform != "tpu":
-        return "dense", f"auto: platform '{platform}' is not tpu"
-    t_k = k_shape[-2]
-    if t_k >= FLASH_MIN_SEQ:
-        return "flash", f"auto: t_k={t_k} >= {FLASH_MIN_SEQ}"
-    scores_bytes = 4            # f32 scores
-    for d in q_shape[:-1]:
-        scores_bytes *= int(d)
-    scores_bytes *= int(t_k)
-    if free_hbm is None:
-        free_hbm = _free_hbm_bytes()
-    if free_hbm is not None and free_hbm > 0 \
-            and scores_bytes > HBM_HEADROOM_FRACTION * free_hbm:
-        return "flash", (f"auto: scores tensor {scores_bytes >> 20} MB"
-                         f" > {HBM_HEADROOM_FRACTION:.0%} of free HBM"
-                         f" ({free_hbm >> 20} MB)")
-    return "dense", f"auto: t_k={t_k} fits the dense lowering"
+
+    def _auto():
+        plat = platform
+        if plat is None:
+            plat = jax.devices()[0].platform
+        if plat != "tpu":
+            return False, f"auto: platform '{plat}' is not tpu"
+        t_k = k_shape[-2]
+        if t_k >= FLASH_MIN_SEQ:
+            return True, f"auto: t_k={t_k} >= {FLASH_MIN_SEQ}"
+        scores_bytes = 4        # f32 scores
+        for d in q_shape[:-1]:
+            scores_bytes *= int(d)
+        scores_bytes *= int(t_k)
+        fh = free_hbm if free_hbm is not None else _free_hbm_bytes()
+        if fh is not None and fh > 0 \
+                and scores_bytes > HBM_HEADROOM_FRACTION * fh:
+            return True, (f"auto: scores tensor {scores_bytes >> 20} MB"
+                          f" > {HBM_HEADROOM_FRACTION:.0%} of free HBM"
+                          f" ({fh >> 20} MB)")
+        return False, f"auto: t_k={t_k} fits the dense lowering"
+
+    sel = kernel_select.select("attention", structural=structural,
+                               auto=_auto, override=override,
+                               use_env_override=False)
+    return ("flash" if sel.fused else "dense"), sel.reason
 
 
 def flash_sdpa(q, k, v, scale: Optional[float] = None, key_mask=None,

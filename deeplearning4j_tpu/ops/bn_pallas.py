@@ -40,17 +40,19 @@ def fused_bn_bwd_enabled() -> bool:
     off elsewhere, where the dense XLA lowering wins and interpret
     mode would crawl. DL4J_TPU_FUSED_BN_BWD=0 is the kill switch,
     =1 forces it on anywhere (Environment ``extra["fused_bn_bwd"]``
-    overrides the env var)."""
-    import os
+    overrides the env var).  Since the ISSUE-13 unification the
+    decision runs through the shared ``ops/kernel_select.py`` ladder
+    (family ``bn_bwd``) and is counted in
+    ``dl4j_kernel_select_total``."""
+    from deeplearning4j_tpu.ops import kernel_select
 
-    from deeplearning4j_tpu.common.environment import Environment
-    env = Environment.get()
-    flag = env.extra.get("fused_bn_bwd")
-    if flag is None:
-        flag = os.environ.get("DL4J_TPU_FUSED_BN_BWD")
-    if flag is None or str(flag) == "":
-        return jax.devices()[0].platform == "tpu"
-    return str(flag) in ("1", "true", "True", "yes")
+    def _auto():
+        platform = jax.devices()[0].platform
+        if platform == "tpu":
+            return True, "auto: tpu — fused backward pays (r02)"
+        return False, f"auto: platform '{platform}' is not tpu"
+
+    return kernel_select.select("bn_bwd", auto=_auto).fused
 
 
 def _interpret() -> bool:
@@ -149,10 +151,20 @@ def bn_forward_math(x, gamma, beta, eps):
     ~16 extra mantissa bits make the cancellation benign — the
     cuDNN/TF fused-BN formulation).  For f32+ activations that margin
     does not exist, so the accurate two-pass mean-then-var form is
-    used.  Returns (y, mean, var, rstd)."""
+    used.  When the ``bn_fwd`` kernel-select ladder admits the site
+    (DL4J_TPU_FUSED_CONV family), the statistics and the normalize
+    each run as ONE Pallas pass (ops/conv_pallas.py) — this is how the
+    forward reduction kernel composes with the fused backward: the
+    same custom_vjp, hand kernels on both sides.  Returns
+    (y, mean, var, rstd)."""
+    from deeplearning4j_tpu.ops import conv_pallas
     axes = tuple(range(x.ndim - 1))
     acc_t = jnp.promote_types(x.dtype, jnp.float32)
-    if x.dtype in (jnp.bfloat16, jnp.float16):
+    fwd_sel = conv_pallas.select_bn_forward(x.shape, x.dtype,
+                                           training=True)
+    if fwd_sel.fused:
+        mean, var = conv_pallas.channel_stats(x)
+    elif x.dtype in (jnp.bfloat16, jnp.float16):
         xf = x.astype(acc_t)
         n = x.size // x.shape[-1]
         mean = jnp.sum(xf, axis=axes) / n
@@ -165,9 +177,12 @@ def bn_forward_math(x, gamma, beta, eps):
     rstd = jax.lax.rsqrt(var + eps)
     scale = gamma.astype(acc_t) * rstd
     bias = beta.astype(acc_t) - mean * scale
-    # x·scale + bias: one fused multiply-add over the tensor instead
-    # of subtract/divide chains
-    y = x * scale.astype(x.dtype) + bias.astype(x.dtype)
+    if fwd_sel.fused:
+        y = conv_pallas.scale_shift_act(x, scale, bias, "identity")
+    else:
+        # x·scale + bias: one fused multiply-add over the tensor
+        # instead of subtract/divide chains
+        y = x * scale.astype(x.dtype) + bias.astype(x.dtype)
     return y, mean, var, rstd
 
 

@@ -1,0 +1,135 @@
+"""Unified kernel-selection ladder for the hand-written Pallas kernels.
+
+Before this module every fused kernel carried its own ad-hoc gate —
+``DL4J_TPU_FLASH_ATTENTION`` in attention_pallas, ``DL4J_TPU_FUSED_BN_BWD``
+in bn_pallas, and now ``DL4J_TPU_FUSED_CONV`` for the conv-epilogue
+family — each re-implementing the same three rungs in slightly
+different shapes.  The ladder is the cuDNN-helper dispatch discipline
+(SURVEY.md D9: the helper seam decides, the layer never does):
+
+  1. **structural gate** — dominates everything.  A site the kernel
+     cannot express (dense additive bias, unaligned channels, wrong
+     dtype/rank, inference-mode BN asked for a batch-stats pass) is
+     demoted to the dense lowering no matter what the env says; the
+     demotion reason is logged and counted.
+  2. **force / kill override** — the tri-state env var (``=1`` force
+     on anywhere, ``=0`` kill switch, unset auto), with the
+     ``Environment.extra`` key taking precedence over the process env
+     so tests and embedding apps can flip gates without touching
+     ``os.environ``.
+  3. **measured auto-heuristic** — kernel-specific, supplied by the
+     caller as a thunk returning ``(fused, reason)``; thresholds are
+     backed by bench rounds (FLASH_MIN_SEQ by BENCH_notes_r03, the
+     conv-family on-TPU default by BENCH_notes_r06).
+
+Every decision increments ``dl4j_kernel_select_total{kernel,decision}``
+so a profile that shows a dense conv where a fused one was expected is
+answerable from telemetry instead of print-debugging trace code.
+Decisions happen at trace time (inside ``jit`` tracing), so the counter
+counts compiled-program dispatch choices, not per-step executions.
+"""
+from __future__ import annotations
+
+import logging
+import os
+from dataclasses import dataclass
+from typing import Callable, Optional, Tuple, Union
+
+from deeplearning4j_tpu.common import telemetry
+
+log = logging.getLogger(__name__)
+
+#: kernel family -> (Environment.extra key, env var) for the tri-state
+#: force/kill override.  The conv epilogue and the BN forward
+#: reduction ride the same DL4J_TPU_FUSED_CONV gate: they are one
+#: family (the epilogue writes what the stats pass reads).
+GATES = {
+    "conv_epilogue": ("fused_conv", "DL4J_TPU_FUSED_CONV"),
+    "bn_fwd": ("fused_conv", "DL4J_TPU_FUSED_CONV"),
+    "bn_bwd": ("fused_bn_bwd", "DL4J_TPU_FUSED_BN_BWD"),
+    "attention": ("flash_attention", "DL4J_TPU_FLASH_ATTENTION"),
+}
+
+_select_total = telemetry.counter(
+    "dl4j_kernel_select_total",
+    "kernel-dispatch ladder decisions by kernel family and rung "
+    "(structural / forced / killed / auto_fused / auto_dense)")
+
+
+@dataclass(frozen=True)
+class Selection:
+    """One dispatch decision: which lowering a site gets and why."""
+
+    kernel: str          #: kernel family (a GATES key)
+    fused: bool          #: True = hand kernel, False = dense lowering
+    decision: str        #: ladder rung that decided (counter label)
+    reason: str          #: human-readable justification
+
+    def __bool__(self) -> bool:  # ``if select(...):`` reads naturally
+        return self.fused
+
+
+def gate_override(kernel: str) -> Optional[bool]:
+    """The tri-state force/kill override for a kernel family:
+    True (force on) / False (kill switch) / None (auto heuristic).
+    ``Environment.extra[<key>]`` overrides the env var."""
+    from deeplearning4j_tpu.common.environment import Environment
+    extra_key, env_var = GATES[kernel]
+    flag = Environment.get().extra.get(extra_key)
+    if flag is None:
+        flag = os.environ.get(env_var)
+    if flag is None or str(flag) == "":
+        return None
+    return str(flag) in ("1", "true", "True", "yes")
+
+
+_UNSET = object()
+
+
+def select(kernel: str, *,
+           structural: Optional[str] = None,
+           auto: Union[Tuple[bool, str],
+                       Callable[[], Tuple[bool, str]]] = (False, "auto"),
+           override=_UNSET,
+           use_env_override: bool = True,
+           record: bool = True) -> Selection:
+    """Run the ladder for one dispatch site.
+
+    ``structural`` — a demotion reason when the site fails the
+    kernel's structural gate, or None when it is admissible.
+    ``auto`` — the measured heuristic: either a ``(fused, reason)``
+    pair or a thunk returning one (thunks keep device probes like
+    free-HBM lookups off the structural/override fast paths).
+    ``override``/``use_env_override`` exist for tests — by default the
+    live ``gate_override(kernel)`` tri-state is consulted.
+    """
+    env_var = GATES[kernel][1]
+    if structural is not None:
+        sel = Selection(kernel, False, "structural", structural)
+    else:
+        if override is _UNSET:
+            override = gate_override(kernel) if use_env_override else None
+        if override is False:
+            sel = Selection(kernel, False, "killed",
+                            f"{env_var}=0 kill switch")
+        elif override is True:
+            sel = Selection(kernel, True, "forced",
+                            f"{env_var}=1 forced")
+        else:
+            fused, reason = auto() if callable(auto) else auto
+            sel = Selection(kernel, bool(fused),
+                            "auto_fused" if fused else "auto_dense",
+                            reason)
+    if record:
+        _select_total.inc(kernel=kernel, decision=sel.decision)
+        log.debug("kernel_select %s -> %s (%s: %s)", kernel,
+                  "fused" if sel.fused else "dense", sel.decision,
+                  sel.reason)
+    return sel
+
+
+def decisions(kernel: str) -> dict:
+    """Counter readback for tests/diagnostics: decision -> count."""
+    return {d: _select_total.value(kernel=kernel, decision=d)
+            for d in ("structural", "forced", "killed", "auto_fused",
+                      "auto_dense")}

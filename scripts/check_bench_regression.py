@@ -33,7 +33,12 @@ ratio / resume-latency figures gate as lower-is-better, as do any
 per-mode ``step_seconds`` / ``throughput_sps`` with the usual
 polarities and its ``cross_axis`` / ``model_axis_update_bytes``
 figures as lower-is-better (the 2D wire invariant: the update
-exchange must not start crossing the model axis).
+exchange must not start crossing the model axis).  The ISSUE-13
+``conv_kernels`` block gates with step time / compiled ``temp_bytes``
+/ cost-analysis ``bytes_accessed`` lower-is-better and
+``pct_of_roof`` / ``speedup`` / ``bytes_ratio`` higher-is-better —
+the fused-epilogue claim is precisely "fewer HBM bytes, closer to
+the roof".
 
 Self-test (tier-1, no accelerator): comparing the checked-in
 BENCH_r04.json to BENCH_r05.json must pass (r05 improved), and the
@@ -48,11 +53,13 @@ import sys
 
 #: metrics where larger is better (substring match on the key)
 HIGHER_BETTER = ("value", "tflops", "throughput", "_ips", "_rps",
-                 "efficiency", "savings_ratio")
+                 "efficiency", "savings_ratio", "pct_of_roof",
+                 "speedup", "bytes_ratio")
 #: metrics where smaller is better
 LOWER_BETTER = ("_ms", "_us", "_seconds", "overhead", "stall", "skew",
                 "_bytes_per_chip", "lost_steps", "cross_axis",
-                "model_axis_update_bytes")
+                "model_axis_update_bytes", "temp_bytes",
+                "bytes_accessed")
 #: keys that are identity/config, never compared; "canary" keys are
 #: clock-path checks documented as dispatch-noise-dominated
 SKIP = ("metric", "unit", "n_trials", "vs_baseline", "meta", "min",

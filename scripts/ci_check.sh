@@ -26,7 +26,14 @@
 #      modes on the virtual 8-device mesh must track the dp-only dense
 #      trajectory, keep the update exchange off the model axis, and
 #      survive checkpoint/remesh back to 1D (the ISSUE 12 acceptance
-#      bar, tests/test_2d_parallel.py).
+#      bar, tests/test_2d_parallel.py);
+#   6. kernel conformance gate: the Pallas conv/BN/ReLU epilogue
+#      family must match the dense lowering bit-for-tolerance in
+#      interpret mode (forward + gradients, incl. an f64
+#      central-difference check) and every kernel family must
+#      dispatch through the unified kernel-select ladder with
+#      counted decisions (the ISSUE 13 acceptance bar,
+#      tests/test_conv_pallas.py + tests/test_kernel_select.py).
 #
 # Usage: scripts/ci_check.sh [--threshold PCT]     (default 10)
 # Exit 0 = all gates clean, 1 = a gate failed, 2 = bad usage.
@@ -78,5 +85,9 @@ JAX_PLATFORMS=cpu python -m pytest tests/test_chaos.py -q \
 echo "== 2D parallelism equivalence gate =="
 JAX_PLATFORMS=cpu python -m pytest tests/test_2d_parallel.py -q \
     -p no:cacheprovider || fail=1
+
+echo "== kernel conformance gate =="
+JAX_PLATFORMS=cpu python -m pytest tests/test_conv_pallas.py \
+    tests/test_kernel_select.py -q -p no:cacheprovider || fail=1
 
 exit $fail
