@@ -81,6 +81,10 @@ def main():
     try:
         from deeplearning4j_tpu.common import diagnostics
         line["meta"] = diagnostics.bench_meta()
+        # top-level proxy marker: a CPU-proxy round and a TPU round
+        # are not comparable — check_bench_regression.py refuses to
+        # diff across a flip of this flag
+        line["meta"]["proxy"] = not on_tpu
     except Exception as e:
         print(f"meta block failed: {e!r}", file=sys.stderr)
     # Roofline evidence (BENCH_notes_r02.md): XLA cost analysis of the
@@ -428,6 +432,33 @@ def main():
                   file=sys.stderr)
     except Exception as e:
         print(f"long-context leg failed: {e!r}", file=sys.stderr)
+    # Layer-attribution leg: per-layer time/flops/bytes roofline with
+    # the kernel-select decision join, on ResNet-50 + BERT-tiny — the
+    # top-k layers each round so a regression comes pre-attributed to
+    # a layer. CPU-proxy subprocess, like the legs above.
+    try:
+        env = {**os.environ, "PYTHONPATH": "", "JAX_PLATFORMS": "cpu"}
+        out = subprocess.run(
+            [sys.executable,
+             os.path.join(_ROOT, "benchmarks",
+                          "bench_layer_attribution.py")],
+            capture_output=True, text=True, timeout=900, env=env,
+            cwd=_ROOT)
+        if out.returncode != 0:
+            raise RuntimeError(
+                f"rc={out.returncode}: {out.stderr.strip()[-400:]}")
+        for ln in out.stdout.strip().splitlines():
+            if not ln.startswith("{"):
+                continue              # tolerate library banners
+            rec = json.loads(ln)
+            if rec.get("metric") == "layer_attribution":
+                rec.pop("metric")
+                line["layer_attribution"] = rec
+        if "layer_attribution" not in line:
+            print("layer-attribution leg: no line in child output",
+                  file=sys.stderr)
+    except Exception as e:
+        print(f"layer-attribution leg failed: {e!r}", file=sys.stderr)
     # Telemetry panel: the registry the run's hot paths recorded into
     # (train-step histogram, compile-cache counters, prefetch stats
     # when an iterator fed) — the same data /metrics would serve.

@@ -1,0 +1,149 @@
+"""Bench leg: per-layer attribution observatory on ResNet-50 + BERT.
+
+For each model: static per-layer flops/bytes from the compiled HLO
+(``common.layerprof``), a measured train-step wall time split into
+per-layer fwd/bwd ms (``share_step_time`` off-TPU — ``time_source``
+marks the proxy), and the kernel-select decision joined per layer.
+Reports the top-k layers by time with pct_of_roof so a throughput
+regression in BENCH_r*.json comes pre-attributed to a layer.
+
+Prints ONE JSON line:
+  {"metric": "layer_attribution",
+   "resnet50": {"layers": [...], "reconcile_err_pct": ..., ...},
+   "bert": {...}, "meta": {"proxy": ...}}
+"""
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+TOP_K = 8
+
+
+def _top_layers(report: dict, k: int = TOP_K) -> list:
+    """Top-k report entries by measured (or estimated) time, flattened
+    to the bench-line schema."""
+    rows = []
+    for name, ent in report["layers"].items():
+        if name == "_unattributed":
+            continue
+        rows.append({
+            "layer": name,
+            **({"type": ent["type"]} if "type" in ent else {}),
+            "fwd_ms": ent.get("fwd_ms"),
+            "bwd_ms": ent.get("bwd_ms"),
+            "flops": ent["flops"],
+            "bytes": ent["bytes"],
+            "bound": ent["bound"],
+            "pct_of_roof": ent.get("pct_of_roof"),
+            "kernel_decision": ent.get("kernel"),
+        })
+    rows.sort(key=lambda r: (r["fwd_ms"] or 0.0) + (r["bwd_ms"] or 0.0),
+              reverse=True)
+    return rows[:k]
+
+
+def _summarize(report: dict, step_ms: float) -> dict:
+    from deeplearning4j_tpu.common import layerprof
+    return {
+        "step_ms": round(step_ms, 3),
+        "time_source": report["time_source"],
+        "reconcile_err_pct": round(
+            layerprof.reconcile_error_pct(report), 4),
+        "coverage": report["coverage"],
+        "raw_model": report["raw_model"],
+        "layers": _top_layers(report),
+    }
+
+
+def _step_ms(fit_once, steps: int, trials: int = 3) -> float:
+    best = float("inf")
+    for _ in range(trials):
+        t0 = time.perf_counter()
+        fit_once()
+        best = min(best, (time.perf_counter() - t0) / steps)
+    return best * 1e3
+
+
+def _resnet50(on_tpu: bool) -> dict:
+    import jax
+    import jax.numpy as jnp
+
+    from deeplearning4j_tpu.common import layerprof
+    from deeplearning4j_tpu.datasets.dataset import DataSet
+    from deeplearning4j_tpu.models.zoo import ResNet50
+
+    batch = 32 if on_tpu else 4
+    hw = 224 if on_tpu else 64
+    net = ResNet50(num_classes=1000, height=hw, width=hw,
+                   compute_dtype="bfloat16").init()
+    rng = np.random.RandomState(0)
+    x = rng.randn(batch, hw, hw, 3).astype(np.float32)
+    y = np.eye(1000, dtype=np.float32)[rng.randint(0, 1000, batch)]
+    ds = DataSet(jax.device_put(jnp.asarray(x)),
+                 jax.device_put(jnp.asarray(y)))
+    report = net.layer_report(x, y)
+
+    steps = 5 if on_tpu else 2
+
+    def fit_once():
+        net.fit_steps(ds, steps)
+        jax.block_until_ready(net.params)
+
+    fit_once()                        # compile outside the clock
+    step_ms = _step_ms(fit_once, steps)
+    layerprof.share_step_time(report, step_ms)
+    return _summarize(report, step_ms)
+
+
+def _bert(on_tpu: bool) -> dict:
+    from deeplearning4j_tpu.common import layerprof
+    from deeplearning4j_tpu.learning.updaters import Adam
+    from deeplearning4j_tpu.models.bert import Bert, BertConfig
+
+    batch, seq = (16, 128) if on_tpu else (4, 64)
+    conf = BertConfig.tiny(compute_dtype="bfloat16",
+                           hidden_dropout_prob=0.0,
+                           attention_probs_dropout_prob=0.0)
+    model = Bert(conf, Adam(1e-4)).init()
+    rng = np.random.default_rng(0)
+    bd = {"input_ids": rng.integers(0, conf.vocab_size, (batch, seq)),
+          "mlm_labels": rng.integers(0, conf.vocab_size, (batch, seq))}
+    report = model.layer_report(bd)
+
+    steps = 5 if on_tpu else 2
+
+    def fit_once():
+        model.fit_steps(bd, steps)
+
+    fit_once()                        # compile outside the clock
+    step_ms = _step_ms(fit_once, steps)
+    layerprof.share_step_time(report, step_ms)
+    return _summarize(report, step_ms)
+
+
+def main():
+    import jax
+
+    on_tpu = jax.devices()[0].platform == "tpu"
+    line = {"metric": "layer_attribution",
+            "meta": {"proxy": not on_tpu}}
+    try:
+        line["resnet50"] = _resnet50(on_tpu)
+    except Exception as e:            # noqa: BLE001
+        print(f"resnet50 attribution failed: {e!r}", file=sys.stderr)
+    try:
+        line["bert"] = _bert(on_tpu)
+    except Exception as e:            # noqa: BLE001
+        print(f"bert attribution failed: {e!r}", file=sys.stderr)
+    print(json.dumps(line))
+
+
+if __name__ == "__main__":
+    main()

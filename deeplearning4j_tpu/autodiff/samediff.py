@@ -26,6 +26,7 @@ import numpy as np
 log = logging.getLogger("deeplearning4j_tpu")
 
 from deeplearning4j_tpu.autodiff.registry import get_op
+from deeplearning4j_tpu.common import layerprof
 
 # ops that consume a PRNG key at execution time; the executor folds a
 # per-op key out of the step rng (deterministic per op position)
@@ -572,7 +573,11 @@ class SameDiff:
                 if node.op_name == "dropout":
                     attrs["training"] = training
             ins = [values[i] for i in node.inputs]
-            out = get_op(node.op_name)(ins, attrs)
+            # layer-attribution scope (common.layerprof): tag the op's
+            # trace — fwd and its autodiff transpose — with the first
+            # output's name, so imported-graph HLO carries op identity
+            with layerprof.scope("sd." + node.outputs[0]):
+                out = get_op(node.op_name)(ins, attrs)
             if len(node.outputs) == 1:
                 values[node.outputs[0]] = out
             else:

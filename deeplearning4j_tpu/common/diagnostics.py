@@ -604,6 +604,14 @@ class FlightRecorder:
             "hbm_peak_bytes": sum(d["peak_bytes_in_use"]
                                   for d in self._last_hbm),
         }
+        # heaviest layer of the last layerprof report, when one was
+        # computed (sys.modules lookup: near-free, and no import edge
+        # from diagnostics to layerprof)
+        lp = sys.modules.get("deeplearning4j_tpu.common.layerprof")
+        if lp is not None:
+            top = lp.top_layer()
+            if top is not None:
+                rec["top_layer"] = top
         if extra:
             rec.update(extra)
         with self._lock:

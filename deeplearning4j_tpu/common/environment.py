@@ -126,7 +126,11 @@ class Environment:
       decision counted in dl4j_kernel_select_total),
       DL4J_TPU_CHAOS (common.faults fault injection: comma-separated
       kill_after_steps=N / hard_kill_after_steps=N /
-      slow_worker=SECONDS / torn_checkpoint=1)
+      slow_worker=SECONDS / torn_checkpoint=1),
+      DL4J_TPU_LAYERPROF (common.layerprof layer-attribution scopes:
+      default on — the annotations are trace-time-only metadata with
+      zero steady-state step cost; =0 kills them;
+      Environment.extra["layerprof"] overrides the env var)
     """
 
     _inst: _Env | None = None

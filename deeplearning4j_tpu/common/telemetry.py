@@ -208,13 +208,15 @@ class Histogram(_Metric):
         """Estimated ``q``-quantile (0..1) from the cumulative bucket
         counts — the ``histogram_quantile`` discipline: linear
         interpolation inside the winning bucket, +Inf observations
-        clamp to the top finite edge. 0.0 with no observations.
+        clamp to the top finite edge. NaN with no observations (a
+        quantile of an empty series is undefined; 0.0 would read as
+        "everything was instant" on a dashboard).
         An estimate bounded by bucket resolution, not an exact order
         statistic — serving benchmarks report p50/p95/p99 from the
         live registry with it."""
         s = self._series.get(_label_key(labels))
         if s is None or s.count == 0:
-            return 0.0
+            return float("nan")
         target = q * s.count
         cum, lo = 0.0, 0.0
         for edge, c in zip(self.buckets, s.counts):

@@ -34,6 +34,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax import lax
 
+from deeplearning4j_tpu.common import layerprof
 from deeplearning4j_tpu.learning.updaters import Adam, IUpdater
 from deeplearning4j_tpu.ops.attention import (dot_product_attention,
                                               merge_heads, split_heads)
@@ -47,12 +48,16 @@ def _raw_step(loss_fn, updater):
     def step(params, opt_state, iteration, batch, rng):
         loss, grads = jax.value_and_grad(
             lambda p: loss_fn(p, batch, rng))(params)
-        updates, new_state = updater.apply(grads, opt_state, iteration)
-        # apply the (possibly f32) updater math at full precision but
-        # keep each param's own dtype — bf16 params would otherwise
-        # silently promote to f32 after one step
-        new_params = jax.tree_util.tree_map(
-            lambda p, u: (p - u).astype(p.dtype), params, updates)
+        # attribution scope (common.layerprof): the updater sweep is
+        # real step work that belongs to no functional block
+        with layerprof.scope("optimizer"):
+            updates, new_state = updater.apply(grads, opt_state,
+                                               iteration)
+            # apply the (possibly f32) updater math at full precision
+            # but keep each param's own dtype — bf16 params would
+            # otherwise silently promote to f32 after one step
+            new_params = jax.tree_util.tree_map(
+                lambda p, u: (p - u).astype(p.dtype), params, updates)
         return new_params, new_state, loss
 
     return step
@@ -136,6 +141,24 @@ class _Trainable:
 
     def score(self) -> float:
         return self.score_value
+
+    def layer_report(self, batch, **roofline_kw):
+        """Per-functional-block flops/bytes/roofline attribution of
+        the compiled train step (common.layerprof): lowers the jitted
+        step at ``batch``, partitions ``cost_analysis()`` by the
+        ``dl4j.*`` scopes (embeddings / encoder.attention /
+        encoder.ffn / pooler / mlm_head / nsp_head for BERT), and
+        joins the kernel-select decisions recorded at trace time.
+        Lowering only — nothing executes, buffers are not donated."""
+        self._ensure_step()
+        batch = {k: jnp.asarray(v) for k, v in batch.items()
+                 if v is not None}
+        lowered = self._step.lower(
+            self.params, self._opt_state, self._iteration, batch,
+            jax.random.PRNGKey(0))
+        return layerprof.attribute_compiled(
+            lowered.compile(), model_name=type(self).__name__,
+            **roofline_kw)
 
 
 @dataclass
@@ -314,14 +337,20 @@ class Bert(_Trainable):
         r1 = r2 = None
         if rng is not None:
             r1, r2 = jax.random.split(rng)
-        a = self._attention(lp, x, key_mask, r1, training)
-        x = _norm(x + a, lp["attn_ln_g"], lp["attn_ln_b"],
-                  c.layer_norm_eps)
-        i = jax.nn.gelu(x @ lp["Wi"] + lp["bi"])
-        o = _dropout(i @ lp["Wout"] + lp["bout"],
-                     c.hidden_dropout_prob, r2, training)
-        return _norm(x + o, lp["out_ln_g"], lp["out_ln_b"],
-                     c.layer_norm_eps)
+        # functional-block attribution scopes (common.layerprof): the
+        # encoder is a lax.scan over stacked layer params — one traced
+        # body for all L layers — so per-layer-index scopes cannot
+        # exist; attention vs FFN is the finest static split
+        with layerprof.scope("encoder.attention"):
+            a = self._attention(lp, x, key_mask, r1, training)
+            x = _norm(x + a, lp["attn_ln_g"], lp["attn_ln_b"],
+                      c.layer_norm_eps)
+        with layerprof.scope("encoder.ffn"):
+            i = jax.nn.gelu(x @ lp["Wi"] + lp["bi"])
+            o = _dropout(i @ lp["Wout"] + lp["bout"],
+                         c.hidden_dropout_prob, r2, training)
+            return _norm(x + o, lp["out_ln_g"], lp["out_ln_b"],
+                         c.layer_norm_eps)
 
     def encode(self, params, input_ids, token_type_ids=None,
                attention_mask=None, *, training=False, rng=None):
@@ -335,18 +364,19 @@ class Bert(_Trainable):
                 f"sequence length {t} exceeds max_position_embeddings "
                 f"{c.max_position_embeddings} (JAX gather would "
                 "silently clamp to the last position)")
-        e = params["embeddings"]
-        x = e["word"][input_ids]
-        x = x + e["position"][jnp.arange(t)][None]
-        if token_type_ids is None:
-            token_type_ids = jnp.zeros_like(input_ids)
-        x = x + e["token_type"][token_type_ids]
-        x = _norm(x, e["ln_g"], e["ln_b"], c.layer_norm_eps)
         r_emb = None
         if rng is not None:
             rng, r_emb = jax.random.split(rng)
-        x = _dropout(x, c.hidden_dropout_prob, r_emb, training)
-        x = x.astype(dt)
+        with layerprof.scope("embeddings"):
+            e = params["embeddings"]
+            x = e["word"][input_ids]
+            x = x + e["position"][jnp.arange(t)][None]
+            if token_type_ids is None:
+                token_type_ids = jnp.zeros_like(input_ids)
+            x = x + e["token_type"][token_type_ids]
+            x = _norm(x, e["ln_g"], e["ln_b"], c.layer_norm_eps)
+            x = _dropout(x, c.hidden_dropout_prob, r_emb, training)
+            x = x.astype(dt)
 
         key_mask = None
         if attention_mask is not None:
@@ -379,21 +409,25 @@ class Bert(_Trainable):
                              (enc, jnp.arange(L)))
 
         x = x.astype(jnp.float32)
-        p = params["pooler"]
-        pooled = jnp.tanh(x[:, 0] @ p["W"] + p["b"])
+        with layerprof.scope("pooler"):
+            p = params["pooler"]
+            pooled = jnp.tanh(x[:, 0] @ p["W"] + p["b"])
         return x, pooled
 
     # -- heads -----------------------------------------------------------
     def mlm_logits(self, params, sequence_output):
-        m = params["mlm"]
-        h = jax.nn.gelu(sequence_output @ m["W"] + m["b"])
-        h = _norm(h, m["ln_g"], m["ln_b"], self.conf.layer_norm_eps)
-        # decoder tied to word embeddings (TF/HF convention)
-        return h @ params["embeddings"]["word"].T + m["out_b"]
+        with layerprof.scope("mlm_head"):
+            m = params["mlm"]
+            h = jax.nn.gelu(sequence_output @ m["W"] + m["b"])
+            h = _norm(h, m["ln_g"], m["ln_b"],
+                      self.conf.layer_norm_eps)
+            # decoder tied to word embeddings (TF/HF convention)
+            return h @ params["embeddings"]["word"].T + m["out_b"]
 
     def nsp_logits(self, params, pooled_output):
-        n = params["nsp"]
-        return pooled_output @ n["W"] + n["b"]
+        with layerprof.scope("nsp_head"):
+            n = params["nsp"]
+            return pooled_output @ n["W"] + n["b"]
 
     def pretrain_loss(self, params, batch, rng=None, training=True):
         """Masked-LM + next-sentence loss.
@@ -425,18 +459,21 @@ class Bert(_Trainable):
         else:
             seq_sel = seq
         logits = self.mlm_logits(params, seq_sel)
-        w = (labels >= 0).astype(jnp.float32)
-        safe = jnp.maximum(labels, 0)
-        logp = jax.nn.log_softmax(logits, -1)
-        nll = -jnp.take_along_axis(logp, safe[..., None], -1)[..., 0]
-        mlm = jnp.sum(nll * w) / jnp.maximum(jnp.sum(w), 1.0)
-        loss = mlm
+        with layerprof.scope("loss"):
+            w = (labels >= 0).astype(jnp.float32)
+            safe = jnp.maximum(labels, 0)
+            logp = jax.nn.log_softmax(logits, -1)
+            nll = -jnp.take_along_axis(logp, safe[..., None],
+                                       -1)[..., 0]
+            mlm = jnp.sum(nll * w) / jnp.maximum(jnp.sum(w), 1.0)
+            loss = mlm
         if "nsp_labels" in batch and batch["nsp_labels"] is not None:
             nlogits = self.nsp_logits(params, pooled)
-            nlogp = jax.nn.log_softmax(nlogits, -1)
-            nsp = -jnp.mean(jnp.take_along_axis(
-                nlogp, batch["nsp_labels"][:, None], -1)[:, 0])
-            loss = loss + nsp
+            with layerprof.scope("loss"):
+                nlogp = jax.nn.log_softmax(nlogits, -1)
+                nsp = -jnp.mean(jnp.take_along_axis(
+                    nlogp, batch["nsp_labels"][:, None], -1)[:, 0])
+                loss = loss + nsp
         return loss
 
     # -- training (fit_batch from _Trainable) ----------------------------

@@ -17,6 +17,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from deeplearning4j_tpu.common import layerprof
 from deeplearning4j_tpu.common.dtypes import to_jnp_dtype
 from deeplearning4j_tpu.nn.conf.graph_conf import \
     ComputationGraphConfiguration
@@ -144,7 +145,15 @@ class ComputationGraph:
             inputs = [cast_floats(x, cd) for x in inputs]
         def run_vertex(name, acts, lrng):
             """Execute one vertex against the live activation dict;
-            returns (activation, layer_state)."""
+            returns (activation, layer_state).  The layer-attribution
+            scope (common.layerprof) tags every op the vertex traces —
+            forward AND its autodiff transpose — with
+            ``dl4j.<vertex name>``; both the remat-segmented and the
+            plain walk funnel through here."""
+            with layerprof.scope(name):
+                return _run_vertex(name, acts, lrng)
+
+        def _run_vertex(name, acts, lrng):
             v = conf.vertices[name]
             xs = [acts[i] for i in v.inputs]
             if not v.is_layer:
@@ -370,16 +379,20 @@ class ComputationGraph:
                                              training=True, rng=rng,
                                              want_logits=True,
                                              fmask=fmask)
-            loss = self._regularization(params)
-            for i, out_name in enumerate(conf.network_outputs):
-                layer = out_confs.get(out_name)
-                if layer is None:
-                    continue
-                loss = loss + layer.compute_loss(
-                    labels[i], acts[out_name],
-                    from_logits=layer.wants_logits(),
-                    mask=lmasks[i] if lmasks is not None else None)
-            return loss, new_states
+            # attribution scope: loss + regularization are real step
+            # work but belong to no vertex — name them instead of
+            # letting them fall into the _unattributed bucket
+            with layerprof.scope("loss"):
+                loss = self._regularization(params)
+                for i, out_name in enumerate(conf.network_outputs):
+                    layer = out_confs.get(out_name)
+                    if layer is None:
+                        continue
+                    loss = loss + layer.compute_loss(
+                        labels[i], acts[out_name],
+                        from_logits=layer.wants_logits(),
+                        mask=lmasks[i] if lmasks is not None else None)
+                return loss, new_states
 
         # numerics watchdog: when armed the step also emits the global
         # grad norm in-jit; when off it is a free zeros constant (see
@@ -481,8 +494,12 @@ class ComputationGraph:
                 loss_fn, has_aux=True)(params, states, inputs, labels,
                                        fmask, lmasks, rng)
             gnorm = grad_norm(grads)
-            new_params, new_upd = update_tail(params, upd_states,
-                                              grads, iteration)
+            # attribution scope: the updater sweep reads/writes every
+            # parameter — substantial byte traffic that is not any
+            # vertex's compute
+            with layerprof.scope("optimizer"):
+                new_params, new_upd = update_tail(params, upd_states,
+                                                  grads, iteration)
             return new_params, new_states, new_upd, loss, gnorm
 
         def grad_step(params, states, inputs, labels, fmask, lmasks,
@@ -496,8 +513,9 @@ class ComputationGraph:
 
         def apply_step(params, upd_states, grads, scale, iteration):
             grads = jax.tree_util.tree_map(lambda g: g * scale, grads)
-            new_params, new_upd = update_tail(params, upd_states,
-                                              grads, iteration)
+            with layerprof.scope("optimizer"):
+                new_params, new_upd = update_tail(params, upd_states,
+                                                  grads, iteration)
             return new_params, new_upd
 
         self._step_fn = step         # unjitted (multi-step path reuses)
@@ -902,6 +920,10 @@ class ComputationGraph:
             self._retrace_guard = RetraceGuard(
                 f"{type(self).__name__} train step")
         self._retrace_guard.record(inputs, labels, fmask, lmasks)
+        # layer_report() with no batch re-lowers at the last fit shape
+        self._layerprof_shapes = (
+            [(x.shape, x.dtype) for x in inputs],
+            [(y.shape, y.dtype) for y in labels])
         from deeplearning4j_tpu.nn.conf.builders import BackpropType
         if self.conf.backprop_type is BackpropType.TRUNCATED_BPTT and \
                 inputs[0].ndim == 3:
@@ -1203,6 +1225,51 @@ class ComputationGraph:
             for pname, p in params.get(name, {}).items():
                 out[f"{name}_{pname}"] = p
         return out
+
+    def layer_report(self, data=None, labels=None, **roofline_kw):
+        """Per-vertex flops/bytes/roofline attribution of the compiled
+        train step (common.layerprof): lowers the jitted step at the
+        given batch (or the last fitted batch's shapes), partitions
+        ``cost_analysis()`` by the ``dl4j.<vertex>`` scopes, and joins
+        the kernel-select decisions recorded at trace time.  Also
+        published to ``GET /api/layers`` and the ``dl4j_layer_*``
+        metrics.  Lowering only — nothing executes, buffers are not
+        donated."""
+        if not self._initialized:
+            self.init()
+        self._sync_updater_layout()
+        self._sync_param_layout()
+        if self._train_step is None:
+            self._build_train_step()
+        if data is not None and hasattr(data, "features"):
+            labels = data.labels
+            data = data.features
+        if data is None:
+            shapes = getattr(self, "_layerprof_shapes", None)
+            if shapes is None:
+                raise ValueError(
+                    "layer_report needs a batch: pass (data, labels) "
+                    "or fit at least one batch first")
+            xs, ys = shapes
+            data = [np.zeros(s, dtype=d) for s, d in xs]
+            labels = [np.zeros(s, dtype=d) for s, d in ys]
+        if not isinstance(data, list):
+            data = [data]
+        if not isinstance(labels, list):
+            labels = [labels]
+        inputs = [_as_jnp(x, self._dtype) for x in data]
+        labs = [_as_jnp(y, self._dtype) for y in labels]
+        states_in = self._with_zero_rnn_states(
+            self.states, int(inputs[0].shape[0]))
+        lowered = self._train_step.lower(
+            self.params, states_in, self.updater_states, inputs, labs,
+            None, None, jnp.asarray(0), jax.random.PRNGKey(0))
+        types = {layerprof.sanitize(n):
+                 type(self.conf.vertices[n].content).__name__
+                 for n in self._topo}
+        return layerprof.attribute_compiled(
+            lowered.compile(), model_name=type(self).__name__,
+            layer_types=types, **roofline_kw)
 
     def summary(self) -> str:
         lines = [f"{'vertex':<28} {'type':<22} {'inputs':<28} {'params':<10}"]

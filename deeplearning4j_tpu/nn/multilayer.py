@@ -22,6 +22,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from deeplearning4j_tpu.common import layerprof
 from deeplearning4j_tpu.common.dtypes import to_jnp_dtype
 from deeplearning4j_tpu.nn.conf.constraints import apply_constraints
 from deeplearning4j_tpu.nn.conf.builders import (BackpropType,
@@ -157,6 +158,14 @@ class MultiLayerNetwork:
         n = len(conf.layers)
 
         def run_layer(i, h, lrng):
+            # layer-attribution scope (common.layerprof): every op this
+            # layer traces — forward AND its autodiff transpose —
+            # carries dl4j.layer_<i> in compiled-HLO metadata; both the
+            # remat-segmented and the plain walk funnel through here
+            with layerprof.scope(f"layer_{i}"):
+                return _run_layer(i, h, lrng)
+
+        def _run_layer(i, h, lrng):
             layer = conf.layers[i]
             if i in conf.input_preprocessors:
                 h = conf.input_preprocessors[i].pre_process(h)
@@ -316,10 +325,14 @@ class MultiLayerNetwork:
             out, new_states = self._forward(params, states, x,
                                             training=True, rng=rng,
                                             want_logits=True, mask=fmask)
-            data_loss = out_layer.compute_loss(y, out,
-                                               from_logits=want_logits,
-                                               mask=lmask)
-            return data_loss + self._regularization(params), new_states
+            # attribution scope: loss + regularization are real step
+            # work but belong to no layer — name them instead of
+            # letting them fall into the _unattributed bucket
+            with layerprof.scope("loss"):
+                data_loss = out_layer.compute_loss(
+                    y, out, from_logits=want_logits, mask=lmask)
+                return (data_loss + self._regularization(params),
+                        new_states)
 
         # numerics watchdog (common.diagnostics): when armed, the step
         # also emits the global grad norm — computed in-jit, fused into
@@ -420,8 +433,12 @@ class MultiLayerNetwork:
                 loss_fn, has_aux=True)(params, states, x, y, fmask,
                                        lmask, rng)
             gnorm = grad_norm(grads)
-            new_params, new_upd = update_tail(params, upd_states,
-                                              grads, iteration)
+            # attribution scope: the updater sweep reads/writes every
+            # parameter — substantial byte traffic that is not any
+            # layer's compute
+            with layerprof.scope("optimizer"):
+                new_params, new_upd = update_tail(params, upd_states,
+                                                  grads, iteration)
             return new_params, new_states, new_upd, loss, gnorm
 
         def grad_step(params, states, x, y, fmask, lmask, rng):
@@ -434,8 +451,9 @@ class MultiLayerNetwork:
 
         def apply_step(params, upd_states, grads, scale, iteration):
             grads = jax.tree_util.tree_map(lambda g: g * scale, grads)
-            new_params, new_upd = update_tail(params, upd_states,
-                                              grads, iteration)
+            with layerprof.scope("optimizer"):
+                new_params, new_upd = update_tail(params, upd_states,
+                                                  grads, iteration)
             return new_params, new_upd
 
         # donate params/states/updater-state buffers: XLA reuses them
@@ -814,6 +832,8 @@ class MultiLayerNetwork:
             self._retrace_guard = RetraceGuard(
                 f"{type(self).__name__} train step")
         self._retrace_guard.record(x, y, fmask, lmask)
+        # layer_report() with no batch re-lowers at the last fit shape
+        self._layerprof_shapes = ((x.shape, x.dtype), (y.shape, y.dtype))
         if self.conf.backprop_type is BackpropType.TRUNCATED_BPTT and \
                 x.ndim == 3:
             return self._fit_tbptt(x, y, fmask, lmask)
@@ -1092,6 +1112,46 @@ class MultiLayerNetwork:
             net.updater_states = jax.tree_util.tree_map(
                 lambda a: a, self.updater_states)
         return net
+
+    def layer_report(self, data=None, labels=None, **roofline_kw):
+        """Per-layer flops/bytes/roofline attribution of the compiled
+        train step (common.layerprof): lowers the jitted step at the
+        given batch (or the last fitted batch's shapes), partitions
+        ``cost_analysis()`` by the ``dl4j.layer_<i>`` scopes, and joins
+        the kernel-select decisions recorded at trace time.  Also
+        published to ``GET /api/layers`` and the ``dl4j_layer_*``
+        metrics.  Lowering only — nothing executes, buffers are not
+        donated."""
+        if not self._initialized:
+            self.init()
+        self._sync_updater_layout()
+        self._sync_param_layout()
+        if self._train_step is None:
+            self._build_train_step()
+        if data is not None and hasattr(data, "features"):
+            labels = data.labels
+            data = data.features
+        if data is None:
+            shapes = getattr(self, "_layerprof_shapes", None)
+            if shapes is None:
+                raise ValueError(
+                    "layer_report needs a batch: pass (data, labels) "
+                    "or fit at least one batch first")
+            (xs, xd), (ys, yd) = shapes
+            data = np.zeros(xs, dtype=xd)
+            labels = np.zeros(ys, dtype=yd)
+        x = _as_jnp(data, self._dtype)
+        y = _as_jnp(labels, self._dtype)
+        states_in = self._with_zero_rnn_states(self.states,
+                                               int(x.shape[0]))
+        lowered = self._train_step.lower(
+            self.params, states_in, self.updater_states, x, y, None,
+            None, jnp.asarray(0), jax.random.PRNGKey(0))
+        types = {f"layer_{i}": type(l).__name__
+                 for i, l in enumerate(self.conf.layers)}
+        return layerprof.attribute_compiled(
+            lowered.compile(), model_name=type(self).__name__,
+            layer_types=types, **roofline_kw)
 
     def summary(self) -> str:
         lines = [f"{'idx':<4} {'type':<24} {'nIn->nOut':<14} {'params':<10}"]

@@ -192,6 +192,15 @@ def _bn_fwd(x, gamma, beta, eps):
 
 
 def _bn_bwd(eps, res, cts):
+    # kernel-site annotation: non-dl4j prefix so the tag nests inside
+    # the enclosing layer's dl4j.<layer> attribution scope (custom_vjp
+    # backward rules inherit the primal trace's scope in HLO metadata;
+    # this marks the hand kernel itself)
+    with jax.named_scope("pallas.bn_bwd"):
+        return _bn_bwd_raw(eps, res, cts)
+
+
+def _bn_bwd_raw(eps, res, cts):
     dy, dmean_ct, dvar_ct = cts
     x, gamma, mean, rstd = res
     acc_t = jnp.promote_types(x.dtype, jnp.float32)

@@ -225,6 +225,13 @@ def _acc_type(x):
 
 
 def _epilogue_apply(x, scale, shift, act):
+    # kernel-site annotation: non-dl4j prefix so the tag nests inside
+    # the enclosing layer's dl4j.<layer> attribution scope
+    with jax.named_scope("pallas.conv_epilogue"):
+        return _epilogue_apply_raw(x, scale, shift, act)
+
+
+def _epilogue_apply_raw(x, scale, shift, act):
     acc_t = _acc_type(x)
     C = x.shape[-1]
     M = x.size // C

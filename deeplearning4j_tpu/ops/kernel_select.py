@@ -122,6 +122,12 @@ def select(kernel: str, *,
                             reason)
     if record:
         _select_total.inc(kernel=kernel, decision=sel.decision)
+        # layer-attribution join: selection happens at trace time,
+        # inside the layer's attribution scope — record which layer's
+        # trace made this decision (lazy import: layerprof imports
+        # telemetry, keep this module light at import time)
+        from deeplearning4j_tpu.common import layerprof
+        layerprof.note_selection(sel)
         log.debug("kernel_select %s -> %s (%s: %s)", kernel,
                   "fused" if sel.fused else "dense", sel.decision,
                   sel.reason)

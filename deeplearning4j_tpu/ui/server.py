@@ -22,6 +22,10 @@ Endpoints:
   one is active); ``GET`` returns capture status + last result
   (``common.stepstats.ProfileCapture``; ``scripts/dl4j_profile.py``
   is the CLI wrapper)
+- ``/api/layers``   last per-layer attribution report (JSON — flops /
+  bytes / roofline / kernel decision per layer,
+  ``common.layerprof``; 404 until a ``model.layer_report()`` ran;
+  ``scripts/dl4j_layers.py`` is the CLI table)
 """
 from __future__ import annotations
 
@@ -149,6 +153,19 @@ class UIServer:
                     from deeplearning4j_tpu.common import diagnostics
                     try:
                         self.send_json(diagnostics.memory_report())
+                    except Exception as e:   # noqa: BLE001
+                        self.send_json({"error": repr(e)}, 500)
+                elif self.path == "/api/layers":
+                    from deeplearning4j_tpu.common import layerprof
+                    try:
+                        rep = layerprof.last_report()
+                        if rep is None:
+                            self.send_json(
+                                {"error": "no layer report computed "
+                                 "yet (run model.layer_report())"},
+                                404)
+                        else:
+                            self.send_json(rep)
                     except Exception as e:   # noqa: BLE001
                         self.send_json({"error": repr(e)}, 500)
                 elif self.path == "/metrics":

@@ -40,6 +40,11 @@ exchange must not start crossing the model axis).  The ISSUE-13
 the fused-epilogue claim is precisely "fewer HBM bytes, closer to
 the roof".
 
+When baseline and fresh disagree on ``meta.proxy`` (one is a
+CPU-proxy round, the other a real-chip round) the comparison is
+skipped with a loud note and exit 0 — cross-rig numbers differ for
+rig reasons, not code reasons.
+
 Self-test (tier-1, no accelerator): comparing the checked-in
 BENCH_r04.json to BENCH_r05.json must pass (r05 improved), and the
 reverse direction at a tight threshold must flag the throughput drop
@@ -162,6 +167,19 @@ def main(argv=None) -> int:
     except (OSError, ValueError, json.JSONDecodeError) as e:
         print(f"error: {e}", file=sys.stderr)
         return 2
+    base_proxy = (base.get("meta") or {}).get("proxy")
+    fresh_proxy = (fresh.get("meta") or {}).get("proxy")
+    if base_proxy is not None and fresh_proxy is not None and \
+            base_proxy != fresh_proxy:
+        # one round ran on the chip, the other on the CPU proxy —
+        # every number differs by orders of magnitude for rig
+        # reasons, so a diff would be pure noise. Loud skip, clean
+        # exit: this is "not comparable", not "regressed".
+        print("SKIP: baseline and fresh disagree on meta.proxy "
+              f"(baseline proxy={base_proxy}, fresh "
+              f"proxy={fresh_proxy}) — a CPU-proxy round and a TPU "
+              "round are not comparable; not gating.")
+        return 0
     if base.get("metric") != fresh.get("metric"):
         print(f"error: metric mismatch — baseline "
               f"{base.get('metric')!r} vs fresh "

@@ -1,0 +1,132 @@
+#!/usr/bin/env python
+"""CI conformance gate for the layer-attribution observatory.
+
+Builds LeNet (MultiLayerNetwork) and BERT-tiny, runs
+``model.layer_report()`` on each, and asserts the contract the
+observatory sells:
+
+1. reconcile: per-layer flops/bytes sums match the whole-model
+   ``cost_analysis()`` totals within 1%;
+2. coverage: at least half of the model's flops land on named layer
+   scopes (bytes coverage is reported but not gated — scan-carry and
+   optimizer plumbing legitimately dominate bytes on small models);
+3. presence: every ``layer_i`` of the LeNet stack appears in the
+   report, forward AND backward flops attributed.
+
+Exit 0 = conformant, 1 = violation (messages on stdout), runs on the
+CPU backend in well under a minute.
+"""
+from __future__ import annotations
+
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+RECONCILE_MAX_PCT = 1.0
+FLOPS_COVERAGE_MIN = 0.5
+
+
+def _check(report, name, fails):
+    from deeplearning4j_tpu.common import layerprof
+    err = layerprof.reconcile_error_pct(report)
+    cov = report["coverage"]
+    print(f"{name}: reconcile_err={err:.4f}% "
+          f"coverage flops={cov['flops']} bytes={cov['bytes']} "
+          f"layers={len(report['layers'])}")
+    if err > RECONCILE_MAX_PCT:
+        fails.append(f"{name}: per-layer sums diverge from "
+                     f"cost_analysis by {err:.2f}% "
+                     f"(max {RECONCILE_MAX_PCT}%)")
+    if cov["flops"] < FLOPS_COVERAGE_MIN:
+        fails.append(f"{name}: flops coverage {cov['flops']} below "
+                     f"{FLOPS_COVERAGE_MIN} — layer scopes are not "
+                     f"reaching the compiled HLO")
+
+
+def _lenet(fails):
+    import numpy as np
+
+    from deeplearning4j_tpu.activations import Activation
+    from deeplearning4j_tpu.learning import Adam
+    from deeplearning4j_tpu.lossfunctions import LossFunction
+    from deeplearning4j_tpu.nn import (InputType, MultiLayerNetwork,
+                                       NeuralNetConfiguration)
+    from deeplearning4j_tpu.nn.conf.layers import (ConvolutionLayer,
+                                                   DenseLayer,
+                                                   OutputLayer,
+                                                   PoolingType,
+                                                   SubsamplingLayer)
+
+    conf = (NeuralNetConfiguration.Builder()
+            .seed(1).updater(Adam(1e-3)).list()
+            .layer(ConvolutionLayer.Builder(5, 5).n_out(20)
+                   .activation(Activation.IDENTITY).build())
+            .layer(SubsamplingLayer.Builder(PoolingType.MAX)
+                   .kernel_size((2, 2)).stride((2, 2)).build())
+            .layer(ConvolutionLayer.Builder(5, 5).n_out(50)
+                   .activation(Activation.IDENTITY).build())
+            .layer(SubsamplingLayer.Builder(PoolingType.MAX)
+                   .kernel_size((2, 2)).stride((2, 2)).build())
+            .layer(DenseLayer.Builder().n_out(500)
+                   .activation(Activation.RELU).build())
+            .layer(OutputLayer.Builder(
+                LossFunction.NEGATIVELOGLIKELIHOOD)
+                   .n_out(10).activation(Activation.SOFTMAX).build())
+            .set_input_type(InputType.convolutional_flat(28, 28, 1))
+            .build())
+    net = MultiLayerNetwork(conf).init()
+    rng = np.random.default_rng(0)
+    x = rng.standard_normal((8, 784)).astype("float32")
+    y = np.eye(10, dtype="float32")[rng.integers(0, 10, 8)]
+    report = net.layer_report(x, y)
+    _check(report, "lenet", fails)
+    for i in range(6):
+        name = f"layer_{i}"
+        ent = report["layers"].get(name)
+        if ent is None:
+            fails.append(f"lenet: {name} missing from the report")
+        elif ent["flops_fwd"] <= 0 or ent["flops_bwd"] <= 0:
+            fails.append(f"lenet: {name} fwd/bwd flops not both "
+                         f"attributed (fwd={ent['flops_fwd']}, "
+                         f"bwd={ent['flops_bwd']})")
+
+
+def _bert(fails):
+    import numpy as np
+
+    from deeplearning4j_tpu.learning.updaters import Adam
+    from deeplearning4j_tpu.models.bert import Bert, BertConfig
+
+    conf = BertConfig.tiny(compute_dtype="bfloat16",
+                           hidden_dropout_prob=0.0,
+                           attention_probs_dropout_prob=0.0)
+    model = Bert(conf, Adam(1e-4)).init()
+    rng = np.random.default_rng(0)
+    batch = {
+        "input_ids": rng.integers(0, conf.vocab_size, (4, 64)),
+        "mlm_labels": rng.integers(0, conf.vocab_size, (4, 64)),
+    }
+    report = model.layer_report(batch)
+    _check(report, "bert-tiny", fails)
+    for scope in ("embeddings", "encoder.attention", "encoder.ffn",
+                  "mlm_head"):
+        if scope not in report["layers"]:
+            fails.append(f"bert-tiny: scope {scope!r} missing from "
+                         f"the report")
+
+
+def main() -> int:
+    fails: list = []
+    _lenet(fails)
+    _bert(fails)
+    if fails:
+        for f in fails:
+            print(f"FAIL: {f}")
+        return 1
+    print("layer-attribution conformance: OK")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

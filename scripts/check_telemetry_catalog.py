@@ -67,13 +67,14 @@ def registered_metrics() -> Dict[str, Set[str]]:
 
 def documented_metrics() -> Dict[str, str]:
     """{metric name: documented kind} from the catalog tables in the
-    "## Observability", "## Diagnostics", "## Scaling observatory"
-    and "## Fault tolerance & elasticity" sections (names mentioned
-    outside table rows count as documented with kind '')."""
+    "## Observability", "## Diagnostics", "## Scaling observatory",
+    "## Layer attribution" and "## Fault tolerance & elasticity"
+    sections (names mentioned outside table rows count as documented
+    with kind '')."""
     text = README.read_text()
     doc: Dict[str, str] = {}
     for heading in ("Observability", "Diagnostics",
-                    "Scaling observatory",
+                    "Scaling observatory", "Layer attribution",
                     "Fault tolerance & elasticity"):
         m = re.search(rf"## {heading}(.*?)(?:\n## |\Z)", text, re.S)
         if not m:

@@ -33,7 +33,12 @@
 #      central-difference check) and every kernel family must
 #      dispatch through the unified kernel-select ladder with
 #      counted decisions (the ISSUE 13 acceptance bar,
-#      tests/test_conv_pallas.py + tests/test_kernel_select.py).
+#      tests/test_conv_pallas.py + tests/test_kernel_select.py);
+#   7. layer-attribution conformance gate: per-layer flops/bytes on
+#      LeNet + BERT-tiny must sum to the whole-model cost_analysis
+#      within 1%, with the named-scope annotations actually reaching
+#      the compiled HLO (the ISSUE 14 acceptance bar,
+#      scripts/check_layer_attribution.py).
 #
 # Usage: scripts/ci_check.sh [--threshold PCT]     (default 10)
 # Exit 0 = all gates clean, 1 = a gate failed, 2 = bad usage.
@@ -89,5 +94,8 @@ JAX_PLATFORMS=cpu python -m pytest tests/test_2d_parallel.py -q \
 echo "== kernel conformance gate =="
 JAX_PLATFORMS=cpu python -m pytest tests/test_conv_pallas.py \
     tests/test_kernel_select.py -q -p no:cacheprovider || fail=1
+
+echo "== layer-attribution conformance gate =="
+JAX_PLATFORMS=cpu python scripts/check_layer_attribution.py || fail=1
 
 exit $fail

@@ -1,0 +1,110 @@
+#!/usr/bin/env python
+"""Print a running training job's per-layer attribution table.
+
+The CLI wrapper for ``GET /api/layers`` (the endpoint
+``common.layerprof`` backs): fetches the last
+``model.layer_report()`` — per-layer flops / bytes / roofline bound /
+measured or estimated milliseconds, and the kernel-select decision
+recorded for the layer's trace sites — and renders it as a table
+sorted heaviest-first.
+
+Usage:
+
+    python scripts/dl4j_layers.py --port 9000
+    python scripts/dl4j_layers.py --url http://host:9000 --json
+
+Exit 0 = table printed, 3 = no report computed yet (HTTP 404),
+1 = anything else.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import urllib.error
+import urllib.request
+
+
+def _get(url: str) -> tuple:
+    try:
+        with urllib.request.urlopen(url, timeout=10) as r:
+            return r.status, json.loads(r.read().decode())
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read().decode() or "{}")
+
+
+def _fmt_count(v) -> str:
+    if v is None:
+        return "-"
+    for unit, div in (("G", 1e9), ("M", 1e6), ("K", 1e3)):
+        if abs(v) >= div:
+            return f"{v / div:.2f}{unit}"
+    return f"{v:.0f}"
+
+
+def _kernel_cell(ent: dict) -> str:
+    kd = ent.get("kernel")
+    if not kd:
+        return "-"
+    parts = []
+    for name, d in kd.items():
+        tag = "fused" if d.get("fused") else "dense"
+        parts.append(f"{name}:{tag}({d.get('decision', '?')})")
+    return ",".join(parts)
+
+
+def render(report: dict) -> str:
+    rows = [("layer", "type", "fwd_ms", "bwd_ms", "est_ms", "flops",
+             "bytes", "bound", "pct_roof", "kernel")]
+    for name, ent in report["layers"].items():
+        rows.append((
+            name, ent.get("type", "-"),
+            f"{ent['fwd_ms']:.3f}" if "fwd_ms" in ent else "-",
+            f"{ent['bwd_ms']:.3f}" if "bwd_ms" in ent else "-",
+            f"{ent['est_ms']:.4f}",
+            _fmt_count(ent["flops"]), _fmt_count(ent["bytes"]),
+            ent["bound"],
+            f"{ent['pct_of_roof']:.1f}" if ent.get("pct_of_roof")
+            is not None else "-",
+            _kernel_cell(ent),
+        ))
+    widths = [max(len(r[i]) for r in rows) for i in range(len(rows[0]))]
+    lines = ["  ".join(c.ljust(w) for c, w in zip(r, widths)).rstrip()
+             for r in rows]
+    head = (f"model={report.get('model')} "
+            f"time_source={report.get('time_source')} "
+            f"coverage={report.get('coverage')}")
+    return "\n".join([head] + lines)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--url", default=None,
+                    help="UIServer base URL (default: localhost:PORT)")
+    ap.add_argument("--port", type=int, default=9000)
+    ap.add_argument("--json", action="store_true",
+                    help="print the raw report JSON instead of a table")
+    args = ap.parse_args(argv)
+
+    base = (args.url or f"http://127.0.0.1:{args.port}").rstrip("/")
+    try:
+        code, body = _get(base + "/api/layers")
+    except (urllib.error.URLError, OSError) as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 1
+    if code == 404:
+        print(body.get("error", "no layer report computed yet"),
+              file=sys.stderr)
+        return 3
+    if code != 200:
+        print(f"error: HTTP {code}: {body}", file=sys.stderr)
+        return 1
+    if args.json:
+        print(json.dumps(body, indent=2))
+    else:
+        print(render(body))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

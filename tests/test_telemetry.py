@@ -4,6 +4,7 @@ chrome-trace span buffer, and — the part that matters — the hot paths
 (prefetcher, compile cache, fit funnels) actually recording during a
 tiny ``fit()``."""
 import json
+import math
 import subprocess
 import sys
 import threading
@@ -83,7 +84,7 @@ class TestRegistry:
     def test_histogram_quantile_estimate(self):
         h = telemetry.histogram("dl4j_t_q", "x",
                                 buckets=(0.01, 0.1, 1.0))
-        assert h.quantile(0.5) == 0.0           # no observations yet
+        assert math.isnan(h.quantile(0.5))      # no observations yet
         for v in (0.005, 0.02, 0.05, 0.2, 5.0):
             h.observe(v)
         # median target 2.5 lands in the (0.01, 0.1] bucket (2 obs):
@@ -93,6 +94,19 @@ class TestRegistry:
         # +Inf observations clamp to the top finite edge
         assert h.quantile(0.99) == 1.0
         assert h.quantile(0.2) <= 0.01
+
+    def test_histogram_quantile_empty_is_nan(self):
+        """Regression: an empty series must answer NaN, not 0.0 — a
+        0.0 p99 on a dashboard reads as 'everything was instant'
+        when nothing was observed at all."""
+        h = telemetry.histogram("dl4j_t_q_empty", "x",
+                                buckets=(0.01, 0.1, 1.0))
+        for q in (0.0, 0.5, 0.99):
+            assert math.isnan(h.quantile(q))
+        # an unseen label set is just as empty as an unseen series
+        h.observe(0.05, model="a")
+        assert math.isnan(h.quantile(0.5, model="b"))
+        assert not math.isnan(h.quantile(0.5, model="a"))
 
     def test_disabled_records_nothing(self):
         reg = MetricsRegistry.get()
@@ -199,6 +213,57 @@ class TestSpans:
             str(tmp_path / "m.json"), p1, str(p2))
         events = json.load(open(merged))["traceEvents"]
         assert {"a", "tpu_op"} <= {e["name"] for e in events}
+
+    def test_merge_host_traces_keeps_named_scopes(self, tmp_path):
+        """The layerprof join depends on three merge invariants: the
+        ``dl4j.<scope>`` strings survive verbatim (attribute_trace
+        keys on them), the pid remap keeps every event attached to
+        its host's process_name row, and the clock shift keeps each
+        host's event stream monotonic on the leader timeline."""
+        leader = tmp_path / "leader.json"
+        worker = tmp_path / "worker.json"
+        leader.write_text(json.dumps({"traceEvents": [
+            {"name": "dl4j.layer_0", "ph": "X", "pid": 7, "tid": 1,
+             "ts": 100, "dur": 10},
+            {"name": "jit_step", "ph": "X", "pid": 7, "tid": 1,
+             "ts": 120, "dur": 5,
+             "args": {"op_name": "dl4j.layer_1/dot"}},
+        ]}))
+        worker.write_text(json.dumps({"traceEvents": [
+            {"name": "transpose(dl4j.layer_0)", "ph": "X", "pid": 7,
+             "tid": 1, "ts": 5000, "dur": 8},
+            {"name": "dl4j.encoder.ffn", "ph": "X", "pid": 7,
+             "tid": 1, "ts": 5100, "dur": 12},
+        ]}))
+        merged = telemetry.merge_host_traces(
+            str(tmp_path / "m.json"),
+            {"path": str(leader), "host": "leader",
+             "clock_offset_s": 0.0},
+            {"path": str(worker), "host": "worker1",
+             "clock_offset_s": 0.004})
+        doc = json.load(open(merged))
+        events = doc["traceEvents"]
+        # scope strings survive verbatim, in names and in op_name args
+        names = {e["name"] for e in events}
+        assert {"dl4j.layer_0", "transpose(dl4j.layer_0)",
+                "dl4j.encoder.ffn"} <= names
+        jit = next(e for e in events if e["name"] == "jit_step")
+        assert jit["args"]["op_name"] == "dl4j.layer_1/dot"
+        # pid remap: same source pid 7 lands on distinct rows, each
+        # labeled with its host
+        proc = {e["pid"]: e["args"]["name"] for e in events
+                if e.get("ph") == "M" and e["name"] == "process_name"}
+        assert sorted(proc.values()) == ["leader", "worker1"]
+        by_host = {proc[e["pid"]] for e in events if e.get("ph") == "X"}
+        assert by_host == {"leader", "worker1"}
+        # clock shift: worker events moved onto the leader clock
+        # (-4000us) and each host's stream stays monotonic
+        ffn = next(e for e in events if e["name"] == "dl4j.encoder.ffn")
+        assert ffn["ts"] == 5100 - 4000
+        for host in ("leader", "worker1"):
+            ts = [e["ts"] for e in events
+                  if e.get("ph") == "X" and proc[e["pid"]] == host]
+            assert ts == sorted(ts)
 
     def test_buffer_cap_counts_drops(self, tmp_path):
         buf = telemetry._trace_buffer
