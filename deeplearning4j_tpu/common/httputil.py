@@ -7,17 +7,70 @@ Both network faces of the system — the training dashboard
 Content-Length framing, JSON bodies, and the Prometheus ``/metrics``
 renderer. This module is the one copy of that plumbing.
 
+It also owns the zero-copy ``.npy`` codec for the serving hot path:
+:func:`npy_view` parses a raw ``.npy`` request body into an ndarray
+*view over the received bytes* (no second materialization of the
+tensor), and :func:`npy_header` + :meth:`QuietHandler.send_body_parts`
+stream a response as header-then-array-buffer without ever joining
+them into one intermediate bytes object. ``bench_serving.py`` measures
+the serialization tax this removes against the JSON path.
+
 Bind host: ``DL4J_TPU_HTTP_HOST`` (default ``127.0.0.1`` — loopback
 only; set ``0.0.0.0`` to expose a server beyond the host, e.g. from a
 container).
 """
 from __future__ import annotations
 
+import io
 import json
 import os
 import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
-from typing import Optional, Tuple
+from typing import Optional, Sequence, Tuple
+
+import numpy as np
+
+
+def npy_view(buf) -> "np.ndarray":
+    """An ndarray view over a raw ``.npy`` byte buffer — header parsed
+    in place, data NOT copied (``np.frombuffer`` aliases ``buf``; the
+    view is read-only when ``buf`` is ``bytes``).
+
+    Contrast ``np.load(io.BytesIO(body))``, which materializes a
+    second copy of the tensor per request. Object-dtype payloads are
+    rejected (they would need pickle — never on a network path).
+    Raises ``ValueError`` on anything that is not a well-formed v1/v2
+    ``.npy`` frame."""
+    f = io.BytesIO(buf)
+    try:
+        version = np.lib.format.read_magic(f)
+    except Exception as e:
+        raise ValueError(f"not a .npy payload: {e}") from e
+    if version == (1, 0):
+        shape, fortran, dtype = np.lib.format.read_array_header_1_0(f)
+    elif version == (2, 0):
+        shape, fortran, dtype = np.lib.format.read_array_header_2_0(f)
+    else:
+        raise ValueError(f"unsupported .npy version {version}")
+    if dtype.hasobject:
+        raise ValueError("object-dtype .npy payloads are not served "
+                         "(pickle is never read off the network)")
+    count = 1
+    for s in shape:
+        count *= int(s)
+    a = np.frombuffer(buf, dtype=dtype, count=count, offset=f.tell())
+    return a.reshape(shape, order="F" if fortran else "C")
+
+
+def npy_header(arr: "np.ndarray") -> bytes:
+    """The ``.npy`` v1 magic + header bytes describing ``arr`` —
+    everything that precedes the raw data buffer. Streaming
+    ``npy_header(a)`` then ``memoryview(a)`` IS the file
+    ``np.save`` would have written, minus the intermediate copy."""
+    f = io.BytesIO()
+    np.lib.format.write_array_header_1_0(
+        f, np.lib.format.header_data_from_array_1_0(arr))
+    return f.getvalue()
 
 
 def bind_host() -> str:
@@ -46,6 +99,25 @@ class QuietHandler(BaseHTTPRequestHandler):
             self.send_header(k, str(v))
         self.end_headers()
         self.wfile.write(body)
+
+    def send_body_parts(self, parts: Sequence, content_type: str,
+                        code: int = 200,
+                        headers: Optional[dict] = None):
+        """Stream a response as a sequence of byte-like parts (bytes /
+        memoryview / C-contiguous ndarray) with ONE summed
+        Content-Length and sequential socket writes — no join into an
+        intermediate buffer. The zero-copy ``.npy`` response path:
+        ``send_body_parts([npy_header(a), memoryview(a)], ...)``."""
+        views = [memoryview(p).cast("B") for p in parts]
+        self.send_response(code)
+        self.send_header("Content-Type", content_type)
+        self.send_header("Content-Length",
+                         str(sum(v.nbytes for v in views)))
+        for k, v in (headers or {}).items():
+            self.send_header(k, str(v))
+        self.end_headers()
+        for v in views:
+            self.wfile.write(v)
 
     def send_json(self, obj, code: int = 200,
                   headers: Optional[dict] = None):

@@ -13,9 +13,19 @@ recompiles.
 
     reg = ModelRegistry()
     reg.register("mnist", net, warmup_shape=(28, 28, 1),
-                 buckets=(8, 32))
+                 buckets=(8, 32), latency_slo_ms=50.0)
     srv = InferenceServer(reg).start(port=8500)
     # POST /v1/models/mnist:predict   {"inputs": [[...], ...]}
+
+Scale-out pieces: flushes are *continuous* by default (the worker
+flushes the instant the device frees — ``flush_policy="window"``
+restores the fixed-window seed), admission budgets adapt to a
+per-model ``latency_slo_ms`` with a drain-rate-derived ``Retry-After``,
+the raw ``.npy`` request/response path is zero-copy, ``mode="sharded"``
+/ ``"fsdp"`` keeps a checkpoint resident 1/N-sharded over a mesh
+between requests (``serving.residency``), and :class:`ServingRouter`
+fronts N replicas with least-loaded dispatch and fleet-wide
+warm-then-drain rollouts.
 """
 from deeplearning4j_tpu.serving.admission import (AdmissionController,
                                                   DeadlineExceeded,
@@ -24,10 +34,11 @@ from deeplearning4j_tpu.serving.batcher import ServingBatcher
 from deeplearning4j_tpu.serving.registry import (ModelRegistry,
                                                  ModelStatus,
                                                  ModelVersion)
+from deeplearning4j_tpu.serving.router import ServingRouter
 from deeplearning4j_tpu.serving.server import InferenceServer
 
 __all__ = [
     "AdmissionController", "DeadlineExceeded", "ShedError",
     "ServingBatcher", "ModelRegistry", "ModelStatus", "ModelVersion",
-    "InferenceServer",
+    "InferenceServer", "ServingRouter",
 ]

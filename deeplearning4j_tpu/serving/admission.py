@@ -3,31 +3,62 @@
 A serving stack that accepts every request melts down under overload:
 queues grow without bound, every request blows its latency SLO, and
 the process eventually OOMs. Admission control bounds the damage —
-requests beyond a per-model in-flight budget are *shed* immediately
-(HTTP 429 + ``Retry-After``) so the requests already admitted still
-meet their deadlines, and shutdown *drains*: no new admissions, wait
-for in-flight work to finish, then stop.
+requests beyond the in-flight budget are *shed* immediately (HTTP 429
++ ``Retry-After``) so the requests already admitted still meet their
+deadlines, and shutdown *drains*: no new admissions, wait for
+in-flight work to finish, then stop.
+
+Two budget regimes:
+
+- **static** (no SLO configured): the classic per-model in-flight cap
+  (``max_queue``), exactly the PR-3 behavior.
+- **SLO-adaptive**: when a model carries a ``latency_slo_ms``, the
+  budget is a *controller output*, not a constant. Every completed
+  request reports its total latency (the same observations that feed
+  the ``dl4j_serving_total_seconds`` histogram); the controller
+  compares the windowed p95 against the SLO and moves the budget
+  AIMD-style — multiplicative shrink while p95 violates the SLO,
+  additive regrow once p95 sits comfortably under it (≤80%). The live
+  budget is exported as ``dl4j_serving_admission_budget``.
+
+``Retry-After`` is likewise *measured*, not guessed: completions per
+second over a sliding window give the drain rate, and a shed client is
+told to come back after ``excess_inflight / drain_rate`` seconds
+(floored at ``retry_after_s``, capped at ``RETRY_AFTER_CAP_S``). With
+zero observations (cold start) the floor is the answer.
 
 Per-request deadlines ride through the batcher: an admitted request
 whose deadline expires while queued is cancelled, not computed
-(``ServingBatcher._flush`` checks before spending device time).
+(``ServingBatcher._flush`` checks before spending device time), and a
+request whose deadline is *already* expired at admission is fast-
+failed 504 without ever occupying a bucket slot — both paths count
+into ``dl4j_serving_deadline_shed_total{where=admission|queue}``.
 """
 from __future__ import annotations
 
 import math
 import threading
 import time
+from collections import deque
 from contextlib import contextmanager
-from typing import Dict, Optional
+from typing import Deque, Dict, Optional, Tuple
 
 from deeplearning4j_tpu.common import telemetry
+
+#: never tell a client to back off longer than this (seconds)
+RETRY_AFTER_CAP_S = 60.0
+
+#: AIMD shrink factor while p95 > SLO / regrow threshold under it
+_SHRINK = 0.7
+_REGROW_AT = 0.8
 
 
 class ShedError(RuntimeError):
     """Raised by :meth:`AdmissionController.admit` when a request is
     rejected. ``reason`` is ``"queue_full"`` (HTTP 429) or
     ``"draining"`` (HTTP 503); ``retry_after_s`` seeds the
-    ``Retry-After`` header."""
+    ``Retry-After`` header (drain-rate-derived when observations
+    exist)."""
 
     def __init__(self, reason: str, retry_after_s: float):
         super().__init__(f"request shed: {reason}")
@@ -37,7 +68,9 @@ class ShedError(RuntimeError):
 
 class DeadlineExceeded(TimeoutError):
     """A request's deadline passed before its batch was computed; the
-    batcher cancels it instead of spending device time (HTTP 504)."""
+    batcher cancels it instead of spending device time (HTTP 504).
+    Also raised at admission when the deadline is already expired on
+    arrival — the request never occupies a slot."""
 
 
 def deadline_after_ms(ms: Optional[float]) -> Optional[float]:
@@ -46,27 +79,54 @@ def deadline_after_ms(ms: Optional[float]) -> Optional[float]:
     return None if ms is None else time.monotonic() + float(ms) / 1e3
 
 
-class AdmissionController:
-    """Bounded per-model admission with load shedding and graceful
-    drain.
+def _deadline_shed_counter() -> telemetry.Counter:
+    return telemetry.counter(
+        "dl4j_serving_deadline_shed_total",
+        "requests dropped because their deadline expired — "
+        "where=admission (already expired on arrival, fast-failed 504 "
+        "before occupying a slot) or where=queue (expired while "
+        "queued, cancelled at flush before compute)")
 
-    - ``max_queue``: in-flight budget per model (queued + computing).
-      Request ``max_queue + 1`` sheds with 429.
-    - ``retry_after_s``: hint returned to shed clients. Defaults to
-      one batch window's worth of drain headroom (1s floor) — by then
-      at least one flush has happened and capacity likely freed.
+
+class AdmissionController:
+    """Bounded per-model admission with load shedding, SLO-adaptive
+    budgets, measured ``Retry-After``, and graceful drain.
+
+    - ``max_queue``: in-flight ceiling per model (queued + computing).
+      Without an SLO this is the whole story: request ``max_queue + 1``
+      sheds with 429.
+    - ``latency_slo_ms``: default SLO for every model (per-model
+      overrides via :meth:`set_slo`, usually wired from
+      ``ModelRegistry.register(latency_slo_ms=)``). Arms the AIMD
+      budget controller described in the module docstring.
+    - ``retry_after_s``: the ``Retry-After`` floor and the cold-start
+      answer before any completion has been observed.
     - :meth:`drain`: flip to draining (new requests shed with 503),
       block until in-flight reaches zero or ``timeout`` passes.
     """
 
     def __init__(self, max_queue: int = 64,
-                 retry_after_s: float = 1.0):
+                 retry_after_s: float = 1.0, *,
+                 latency_slo_ms: Optional[float] = None,
+                 adapt_window: int = 64,
+                 rate_window_s: float = 30.0,
+                 min_budget: int = 1):
         self.max_queue = int(max_queue)
         self.retry_after_s = float(retry_after_s)
+        self.latency_slo_ms = latency_slo_ms
+        self.adapt_window = int(adapt_window)
+        self.rate_window_s = float(rate_window_s)
+        self.min_budget = int(min_budget)
         self._lock = threading.Lock()
         self._idle = threading.Condition(self._lock)
         self._inflight: Dict[str, int] = {}
         self._draining = False
+        self._slo_ms: Dict[str, float] = {}
+        self._budget: Dict[str, int] = {}
+        #: recent total (submit->response) latencies, per model
+        self._totals: Dict[str, Deque[float]] = {}
+        #: completion timestamps for the measured drain rate
+        self._done_ts: Dict[str, Deque[float]] = {}
         self._gauge = telemetry.gauge(
             "dl4j_serving_inflight",
             "admitted requests currently queued or computing, "
@@ -83,18 +143,147 @@ class AdmissionController:
     def inflight(self, model: str) -> int:
         return self._inflight.get(model, 0)
 
+    # -- SLO controller ------------------------------------------------
+    def set_slo(self, model: str, slo_ms: Optional[float]) -> None:
+        """Install (or clear) a per-model latency SLO; the registry
+        wires this from ``register(latency_slo_ms=)``."""
+        with self._lock:
+            if slo_ms is None:
+                self._slo_ms.pop(model, None)
+            else:
+                self._slo_ms[model] = float(slo_ms)
+
+    def budget(self, model: str) -> int:
+        """The live in-flight budget for ``model`` (== ``max_queue``
+        until the SLO controller has reason to move it)."""
+        return self._budget.get(model, self.max_queue)
+
+    def observed_p95_ms(self, model: str) -> Optional[float]:
+        with self._lock:
+            window = self._totals.get(model)
+            if not window:
+                return None
+            lats = sorted(window)
+        return lats[min(len(lats) - 1,
+                        int(math.ceil(0.95 * len(lats))) - 1)] * 1e3
+
+    def observe_total(self, model: str, seconds: float,
+                      now: Optional[float] = None) -> None:
+        """Report one completed request's total latency. Feeds the
+        ``dl4j_serving_total_seconds`` histogram, the drain-rate
+        window behind ``Retry-After``, and the AIMD budget controller.
+        ``now`` is injectable for deterministic tests."""
+        now = time.monotonic() if now is None else now
+        telemetry.histogram(
+            "dl4j_serving_total_seconds",
+            "total submit->response latency of completed predict "
+            "requests — the observation stream the SLO-adaptive "
+            "admission controller compares against latency_slo_ms "
+            "(seconds)").observe(seconds, model=model)
+        with self._lock:
+            self._totals.setdefault(
+                model, deque(maxlen=self.adapt_window)).append(
+                    float(seconds))
+            done = self._done_ts.setdefault(model, deque(maxlen=512))
+            done.append(now)
+            rate = self._drain_rate_locked(model, now)
+            self._adapt_locked(model)
+        if telemetry.enabled() and rate is not None:
+            telemetry.gauge(
+                "dl4j_serving_drain_rate_rps",
+                "measured request completion rate per model over the "
+                "admission controller's sliding window — the "
+                "denominator of the derived Retry-After"
+            ).set(rate, model=model)
+
+    def _adapt_locked(self, model: str) -> None:
+        slo_ms = self._slo_ms.get(model, self.latency_slo_ms)
+        if slo_ms is None:
+            return
+        window = self._totals.get(model)
+        if not window:
+            return
+        lats = sorted(window)
+        p95_ms = lats[min(len(lats) - 1,
+                          int(math.ceil(0.95 * len(lats))) - 1)] * 1e3
+        budget = self._budget.get(model, self.max_queue)
+        if p95_ms > slo_ms:
+            budget = max(self.min_budget, int(budget * _SHRINK))
+        elif p95_ms < _REGROW_AT * slo_ms and budget < self.max_queue:
+            budget += 1
+        self._budget[model] = budget
+        if telemetry.enabled():
+            telemetry.gauge(
+                "dl4j_serving_admission_budget",
+                "live SLO-adaptive in-flight budget per model (AIMD "
+                "on windowed p95 vs latency_slo_ms; == the static "
+                "max_queue when no SLO is set)").set(budget,
+                                                     model=model)
+
+    # -- measured Retry-After ------------------------------------------
+    def _drain_rate_locked(self, model: str,
+                           now: float) -> Optional[float]:
+        """Completions per second over the sliding window (None before
+        the first observation — the cold start)."""
+        done = self._done_ts.get(model)
+        if not done:
+            return None
+        horizon = now - self.rate_window_s
+        recent = [t for t in done if t >= horizon]
+        if not recent:
+            return None
+        span = max(now - recent[0], 1e-3)
+        return len(recent) / span
+
+    def retry_after_s_for(self, model: Optional[str] = None,
+                          now: Optional[float] = None) -> float:
+        """Seconds a shed client should wait, derived from the measured
+        drain rate: time for the excess in-flight depth to drain,
+        floored at ``retry_after_s`` and capped at
+        ``RETRY_AFTER_CAP_S``. Cold start (zero observations) returns
+        the floor."""
+        now = time.monotonic() if now is None else now
+        with self._lock:
+            rate = (self._drain_rate_locked(model, now)
+                    if model is not None else None)
+            if not rate:
+                return self.retry_after_s
+            excess = max(1, self._inflight.get(model, 0)
+                         - self._budget.get(model, self.max_queue) + 1)
+        return min(RETRY_AFTER_CAP_S,
+                   max(self.retry_after_s, excess / rate))
+
+    def retry_after_header(self, model: Optional[str] = None) -> str:
+        """Integral seconds for the ``Retry-After`` header (≥ 1)."""
+        return str(max(1, int(math.ceil(self.retry_after_s_for(model)))))
+
     # ------------------------------------------------------------------
-    def admit(self, model: str) -> None:
-        """Admit one request for ``model`` or raise :class:`ShedError`.
-        Pair every successful admit with a :meth:`release`."""
+    def admit(self, model: str,
+              deadline: Optional[float] = None) -> None:
+        """Admit one request for ``model`` or raise. Pair every
+        successful admit with a :meth:`release`.
+
+        Raises :class:`DeadlineExceeded` when ``deadline`` (a
+        ``time.monotonic()`` instant) is already past — the fast-fail
+        path: an already-dead request must never occupy a slot.
+        Raises :class:`ShedError` on drain or budget exhaustion."""
+        if deadline is not None and time.monotonic() >= deadline:
+            _deadline_shed_counter().inc(model=model, where="admission")
+            raise DeadlineExceeded(
+                "deadline already expired at admission")
         with self._lock:
             if self._draining:
                 self._shed.inc(model=model, reason="draining")
                 raise ShedError("draining", self.retry_after_s)
             n = self._inflight.get(model, 0)
-            if n >= self.max_queue:
+            if n >= min(self._budget.get(model, self.max_queue),
+                        self.max_queue):
                 self._shed.inc(model=model, reason="queue_full")
-                raise ShedError("queue_full", self.retry_after_s)
+                rate = self._drain_rate_locked(model, time.monotonic())
+                retry = (self.retry_after_s if not rate else
+                         min(RETRY_AFTER_CAP_S,
+                             max(self.retry_after_s, 1.0 / rate)))
+                raise ShedError("queue_full", retry)
             self._inflight[model] = n + 1
             self._gauge.set(n + 1, model=model)
 
@@ -107,10 +296,10 @@ class AdmissionController:
                 self._idle.notify_all()
 
     @contextmanager
-    def track(self, model: str):
+    def track(self, model: str, deadline: Optional[float] = None):
         """``admit``/``release`` around a request's whole lifetime
         (queue wait + compute + response)."""
-        self.admit(model)
+        self.admit(model, deadline)
         try:
             yield
         finally:
@@ -134,7 +323,3 @@ class AdmissionController:
         """Leave draining mode (a drained server being restarted)."""
         with self._lock:
             self._draining = False
-
-    def retry_after_header(self) -> str:
-        """Integral seconds for the ``Retry-After`` header."""
-        return str(max(1, int(math.ceil(self.retry_after_s))))

@@ -1,31 +1,46 @@
 """Per-model dynamic batcher with shape-bucketed flushes.
 
 Reuses the ``ParallelInference`` submit/flush discipline (background
-worker drains a queue, aggregates up to ``batch_limit`` requests per
-``batch_window_ms`` window) with one serving-critical change: every
-flush is padded UP to the nearest *warm bucket* — a batch size whose
-XLA program was compiled at warmup — so steady-state requests never
-retrace (TVM's ahead-of-time compilation discipline, PAPERS.md
-1802.04799). A per-version ``RetraceGuard`` counts signatures; after
-warmup its count must not move.
+worker drains a queue and aggregates requests) with two
+serving-critical changes. First, every flush is padded UP to the
+nearest *warm bucket* — a batch size whose XLA program was compiled at
+warmup — so steady-state requests never retrace (TVM's ahead-of-time
+compilation discipline, PAPERS.md 1802.04799). A per-version
+``RetraceGuard`` counts signatures; after warmup its count must not
+move. Second, the default flush trigger is **continuous** (Orca-style
+iteration-level scheduling): the worker flushes the moment the device
+is free and takes whatever is waiting — occupancy-driven, not
+clock-driven. A request never waits out a fixed window behind an idle
+device; under load, queue depth alone fills the buckets. The classic
+fixed ``batch_window_ms`` behavior stays available as
+``flush_policy="window"``. Realized fill lands in the
+``dl4j_serving_batch_occupancy`` histogram (live rows / padded rows).
 
 Two model surfaces:
 
 - MLN/ComputationGraph: the jitted sharded forward inherited from
   ``ParallelInference`` (params replicated over the mesh, batch
-  sharded over ``data``).
+  sharded over ``data``) — or, with ``mode="sharded"``/``"fsdp"``,
+  the ZeRO-layout resident placement from ``serving.residency``:
+  params live 1/N-sharded between requests and are gathered inside
+  the jitted forward, bitwise-equal to the dense path. The sharded
+  tree lives on the *batcher* (``_serve_params``), never on the model,
+  so ``model.output`` and training paths stay untouched.
 - generic (``SameDiff`` adapters, ONNX importers): any object whose
   ``output(batch) -> array`` is signature-cached internally — bucket
-  padding keeps *its* cache to one entry per bucket too.
+  padding keeps *its* cache to one entry per bucket too (dense only).
 
 Requests carry an optional ``time.monotonic()`` deadline: a request
 whose deadline expires while queued is cancelled at flush time with
 :class:`~deeplearning4j_tpu.serving.admission.DeadlineExceeded` —
-never computed.
+never computed (counted under
+``dl4j_serving_deadline_shed_total{where="queue"}``).
 """
 from __future__ import annotations
 
 import concurrent.futures
+import queue as _queue
+import threading
 import time
 from typing import List, Optional, Sequence
 
@@ -35,12 +50,18 @@ from deeplearning4j_tpu.common import telemetry
 from deeplearning4j_tpu.common.compilecache import RetraceGuard
 from deeplearning4j_tpu.parallel.inference import (InferenceMode,
                                                    ParallelInference)
-from deeplearning4j_tpu.serving.admission import DeadlineExceeded
+from deeplearning4j_tpu.serving.admission import (DeadlineExceeded,
+                                                  _deadline_shed_counter)
 
 _LATENCY_HELP = ("serving request latency by stage: queue "
                  "(submit->flush), compute (flush forward), total "
                  "(submit->result), warmup (per-bucket pre-compile) "
                  "(seconds)")
+
+#: flush triggers: continuous = flush whenever the device frees and
+#: requests wait (iteration-level scheduling); window = hold the first
+#: request up to batch_window_ms hoping for batch-mates (the PR-3 seed)
+FLUSH_POLICIES = ("continuous", "window")
 
 
 def _latency() -> telemetry.Histogram:
@@ -55,13 +76,26 @@ class ServingBatcher(ParallelInference):
                  mesh=None, *, name: str = "model",
                  batch_window_ms: float = 2.0,
                  queue_limit: int = 256,
-                 guard: Optional[RetraceGuard] = None):
+                 guard: Optional[RetraceGuard] = None,
+                 flush_policy: str = "continuous",
+                 mode: str = "dense",
+                 tensor_parallel: Optional[int] = None):
         #: generic path: no MLN `_forward` funnel — serve through the
         #: model's own `output(batch)` (SameDiff/ONNX adapters)
         self._generic = None if hasattr(model, "_forward") \
             else model.output
         if not buckets:
             raise ValueError("need at least one warmup bucket")
+        if flush_policy not in FLUSH_POLICIES:
+            raise ValueError(f"flush_policy must be one of "
+                             f"{FLUSH_POLICIES}, got {flush_policy!r}")
+        from deeplearning4j_tpu.serving.residency import assert_mode
+        assert_mode(mode)
+        if mode != "dense" and self._generic is not None:
+            raise ValueError(
+                f"residency mode {mode!r} needs a param-tree model "
+                f"(MLN/ComputationGraph); generic output() models "
+                f"serve dense only")
         super().__init__(model, mesh,
                          inference_mode=InferenceMode.BATCHED,
                          batch_limit=max(int(b) for b in buckets),
@@ -75,15 +109,74 @@ class ServingBatcher(ParallelInference):
         self.buckets = tuple(sorted(int(b) for b in set(buckets)))
         self.batch_limit = self.buckets[-1]
         self.name = name
+        self.flush_policy = flush_policy
+        self.mode = mode
+        self.tensor_parallel = tensor_parallel
         self.guard = guard if guard is not None else RetraceGuard(
             f"serving:{name}", threshold=len(self.buckets) + 1)
         self._warmed = False
+        #: the resident-sharded serving layout (mode != dense); lives
+        #: here — never on the model — so model.output stays dense
+        self._serve_params = None
+        self._serve_states = None
+        self._fsdp_specs = None
+        self._serve_tp_specs = None
 
     # ------------------------------------------------------------------
+    @property
+    def params(self):
+        """What this batcher actually holds resident — the sharded
+        serving layout when one is placed, else the model's own tree
+        (the ``memory_report`` attribution surface)."""
+        if self._serve_params is not None:
+            return self._serve_params
+        return getattr(self.model, "params", None)
+
     def _ensure(self):
         if self._generic is not None:
             return
-        super()._ensure()
+        if self.mode == "dense":
+            super()._ensure()
+            return
+        m = self.model
+        if not m._initialized:
+            m.init()
+        if not self._placed:
+            from deeplearning4j_tpu.parallel.mesh import replicate_tree
+            from deeplearning4j_tpu.serving.residency import \
+                serving_layouts
+            (self._serve_params, self._fsdp_specs,
+             self._serve_tp_specs) = serving_layouts(
+                self.mesh, m.params, self.mode, self.tensor_parallel,
+                name=self.name)
+            self._serve_states = replicate_tree(self.mesh, m.states)
+            self._placed = True
+        if self._fwd is None:
+            import jax
+
+            from deeplearning4j_tpu.common.compilecache import \
+                enable_persistent_cache
+            enable_persistent_cache()
+            from deeplearning4j_tpu.nn.graph import ComputationGraph
+            from deeplearning4j_tpu.serving.residency import \
+                serving_param_view
+            is_graph = isinstance(m, ComputationGraph)
+            mesh, mode = self.mesh, self.mode
+            specs, tp_specs = self._fsdp_specs, self._serve_tp_specs
+
+            def fwd(params, states, x):
+                view = serving_param_view(params, specs, mesh,
+                                          tp_specs, mode)
+                if is_graph:
+                    acts, _ = m._forward(view, states, [x],
+                                         training=False, rng=None,
+                                         want_logits=False)
+                    return acts[m.conf.network_outputs[0]]
+                out, _ = m._forward(view, states, x, training=False,
+                                    rng=None, want_logits=False)
+                return out
+
+            self._fwd = jax.jit(fwd)
 
     def _bucket_for(self, n: int) -> Optional[int]:
         for b in self.buckets:
@@ -124,7 +217,12 @@ class ServingBatcher(ParallelInference):
             return np.asarray(self._generic(padded))[:orig]
         placed, _ = self._place_chunk(padded)
         self._record(placed)
-        out = self._fwd(self.model.params, self.model.states, placed)
+        if self._serve_params is not None:
+            out = self._fwd(self._serve_params, self._serve_states,
+                            placed)
+        else:
+            out = self._fwd(self.model.params, self.model.states,
+                            placed)
         return np.asarray(out)[:orig]
 
     # ------------------------------------------------------------------
@@ -199,6 +297,65 @@ class ServingBatcher(ParallelInference):
             self._requests.put((x, fut, time.monotonic()))
         return fut
 
+    def _ensure_worker(self):
+        """Start the flush worker (caller holds ``self._lock``).
+
+        ``window`` policy keeps the base loop: hold the first request
+        up to ``batch_window_ms`` collecting batch-mates. The
+        ``continuous`` loop never arms a clock — it blocks for ONE
+        request, greedily drains whatever else is already queued (up
+        to ``batch_limit``), and flushes immediately. Batch formation
+        comes from device busy time alone: while a flush computes,
+        arrivals accumulate in the queue and the next iteration takes
+        them all. An idle device therefore gives a lone request
+        zero added latency, and a saturated one fills buckets — the
+        fixed window's latency floor is gone in both regimes."""
+        if self.flush_policy != "continuous":
+            super()._ensure_worker()
+            return
+        if self._worker is not None:
+            return
+        self._requests = _queue.Queue(self.queue_limit)
+        self._shutdown = False
+        q = self._requests                       # bind THIS queue
+
+        def loop():
+            while True:
+                try:
+                    first = q.get(timeout=0.1)
+                except _queue.Empty:
+                    if self._shutdown:
+                        return
+                    continue
+                if first is None:
+                    return
+                batch = [first]
+                while len(batch) < self.batch_limit:
+                    try:
+                        nxt = q.get_nowait()
+                    except _queue.Empty:
+                        break
+                    if nxt is None:
+                        self._flush(batch)
+                        return
+                    batch.append(nxt)
+                self._flush(batch)
+
+        self._worker = threading.Thread(target=loop, daemon=True,
+                                        name="dl4j-tpu-serving")
+        self._worker.start()
+
+    def _padded_rows(self, rows: int) -> int:
+        """Rows the device actually computes for ``rows`` live rows
+        after chunking by the largest bucket and padding each chunk
+        up — the occupancy denominator."""
+        cap, total = self.buckets[-1], 0
+        while rows > 0:
+            take = min(rows, cap)
+            total += self._bucket_for(take) or take
+            rows -= take
+        return total
+
     def _flush(self, batch):
         now = time.monotonic()
         live = []
@@ -210,6 +367,8 @@ class ServingBatcher(ParallelInference):
                     "dl4j_serving_deadline_expired_total",
                     "requests whose deadline passed while queued — "
                     "cancelled before compute").inc(model=self.name)
+                _deadline_shed_counter().inc(model=self.name,
+                                             where="queue")
                 if f.set_running_or_notify_cancel():
                     f.set_exception(DeadlineExceeded(
                         f"deadline passed {now - dl:.3f}s before "
@@ -229,6 +388,17 @@ class ServingBatcher(ParallelInference):
                 "(requests / batch_limit)",
                 buckets=telemetry.RATIO_BUCKETS).observe(
                     len(live) / max(1, self.batch_limit))
+            rows = sum(int(np.asarray(x).shape[0])
+                       for x, _, _ in live)
+            telemetry.histogram(
+                "dl4j_serving_batch_occupancy",
+                "live rows / bucket-padded rows per serving flush — "
+                "how full the warm buckets actually run (1.0 = no "
+                "padding waste; continuous batching should push this "
+                "up under load)",
+                buckets=telemetry.RATIO_BUCKETS).observe(
+                    rows / max(1, self._padded_rows(rows)),
+                    model=self.name, policy=self.flush_policy)
         t0 = time.perf_counter()
         try:
             with telemetry.span("serving.flush", model=self.name,

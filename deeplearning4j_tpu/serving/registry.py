@@ -117,12 +117,15 @@ class ModelVersion:
     """One immutable (model, batcher, guard) serving unit."""
 
     def __init__(self, name: str, version: int, model,
-                 batcher: ServingBatcher, source: str):
+                 batcher: ServingBatcher, source: str,
+                 latency_slo_ms: Optional[float] = None):
         self.name = name
         self.version = version
         self.model = model
         self.batcher = batcher
         self.source = source
+        #: per-model latency SLO driving the adaptive admission budget
+        self.latency_slo_ms = latency_slo_ms
         self.status = ModelStatus.LOADING
         self.created = time.time()
         self.warm_signatures = 0      # guard count frozen at warmup end
@@ -145,6 +148,9 @@ class ModelVersion:
             "warm_buckets": list(self.batcher.buckets),
             "signatures": self.guard.n_signatures,
             "retraces_since_warmup": self.retraces_since_warmup(),
+            "mode": self.batcher.mode,
+            "flush_policy": self.batcher.flush_policy,
+            "latency_slo_ms": self.latency_slo_ms,
             "created": self.created,
         }
 
@@ -155,11 +161,13 @@ class ModelRegistry:
     def __init__(self, mesh=None, *,
                  default_buckets: Sequence[int] = (8, 32),
                  batch_window_ms: float = 2.0,
-                 queue_limit: int = 256):
+                 queue_limit: int = 256,
+                 flush_policy: str = "continuous"):
         self.mesh = mesh
         self.default_buckets = tuple(default_buckets)
         self.batch_window_ms = batch_window_ms
         self.queue_limit = queue_limit
+        self.flush_policy = flush_policy
         self._lock = threading.Lock()
         self._current: Dict[str, ModelVersion] = {}
         self._versions: Dict[str, List[ModelVersion]] = {}
@@ -170,6 +178,10 @@ class ModelRegistry:
                  warmup_dtype=None,
                  buckets: Optional[Sequence[int]] = None,
                  batch_window_ms: Optional[float] = None,
+                 flush_policy: Optional[str] = None,
+                 mode: str = "dense",
+                 tensor_parallel: Optional[int] = None,
+                 latency_slo_ms: Optional[float] = None,
                  input_name: Optional[str] = None,
                  output_name: Optional[str] = None) -> ModelVersion:
         """Register (or hot-swap) the live version of ``name``.
@@ -179,7 +191,17 @@ class ModelRegistry:
         the batch dim) triggers per-bucket pre-compilation BEFORE the
         version goes live; without it the version serves cold (first
         request compiles). ``input_name``/``output_name`` disambiguate
-        SameDiff placeholders when serving a graph."""
+        SameDiff placeholders when serving a graph.
+
+        ``mode`` picks the parameter residency: ``"dense"`` (params
+        replicated, the classic path), or ``"sharded"``/``"fsdp"`` —
+        the checkpoint stays resident 1/N-sharded over the registry
+        mesh between requests (``serving.residency``), optionally ×tp
+        on a 2D ``(data, model)`` mesh via ``tensor_parallel``.
+        Outputs stay bitwise-equal to dense in every mode.
+        ``flush_policy`` (``"continuous"`` default) and
+        ``latency_slo_ms`` (arms the SLO-adaptive admission budget and
+        is surfaced to the server) ride on the version."""
         if isinstance(model, (str, Path)):
             source = str(model)
             model = load_model(model)
@@ -202,8 +224,12 @@ class ModelRegistry:
             batch_window_ms=(batch_window_ms
                              if batch_window_ms is not None
                              else self.batch_window_ms),
-            queue_limit=self.queue_limit, guard=guard)
-        ver = ModelVersion(name, version_no, model, batcher, source)
+            queue_limit=self.queue_limit, guard=guard,
+            flush_policy=(flush_policy if flush_policy is not None
+                          else self.flush_policy),
+            mode=mode, tensor_parallel=tensor_parallel)
+        ver = ModelVersion(name, version_no, model, batcher, source,
+                           latency_slo_ms=latency_slo_ms)
 
         if warmup_shape is not None:
             ver.status = ModelStatus.WARMING

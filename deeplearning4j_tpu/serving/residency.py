@@ -1,0 +1,139 @@
+"""Sharded-model residency for serving.
+
+A dense checkpoint too big for one chip becomes servable by keeping
+its parameters *resident sharded* between requests — the training-side
+ZeRO layouts (:mod:`parallel.zero`, PRs 10/12) applied to the
+inference path:
+
+- ``mode="sharded"`` — ZeRO-1-shaped: params live as per-dtype flat
+  vectors sharded ``P(data)`` (1/N resident per chip); the jitted
+  forward gathers the WHOLE tree up front (one fused all-gather wall
+  at trace start), then runs the exact dense math.
+- ``mode="fsdp"`` — ZeRO-3-shaped: same residency, but each entry's
+  all-gather is emitted at its point of use inside the forward walk
+  (:class:`~deeplearning4j_tpu.parallel.zero.FsdpParamView`), so peak
+  live memory is one layer's dense params, not the whole model's.
+- either mode **×tp**: on a 2D ``(data, model)`` mesh,
+  :class:`~deeplearning4j_tpu.parallel.speclayout.SpecLayout` infers
+  megatron-style splits and the matching leaves ride under ``TP_KEY``
+  sharded over ``model`` (and ``data`` too where a free dim divides —
+  1/(dp·tp) resident).
+
+Serving differs from training in one deliberate way: the **compute**
+spec of every tp leaf is forced to ``P()`` (fully replicated). Sharded
+residency must be a pure placement choice — gather the exact bytes
+back and run the same dense program — so outputs stay *bitwise* equal
+to the single-chip path. Row-sharded compute would lower matmuls to
+partial-sum ``psum`` chains whose float addition order differs from
+dense; that is a fine training trade and a wrong serving default.
+The model axis here buys memory, not FLOPs.
+"""
+from __future__ import annotations
+
+from typing import Dict, Optional, Tuple
+
+import numpy as np
+from jax.sharding import PartitionSpec as P
+
+from deeplearning4j_tpu.common import telemetry
+from deeplearning4j_tpu.parallel.mesh import (DEFAULT_DATA_AXIS,
+                                              DEFAULT_MODEL_AXIS)
+from deeplearning4j_tpu.parallel.speclayout import SpecLayout, TpLeafSpec
+from deeplearning4j_tpu.parallel.zero import (FsdpParamView,
+                                              params_to_fsdp,
+                                              place_fsdp_params)
+
+#: parameter residency modes a ServingBatcher understands
+MODES = ("dense", "sharded", "fsdp")
+
+
+def serving_tp_specs(mesh, dense_params,
+                     model_axis: str = DEFAULT_MODEL_AXIS,
+                     data_axis: str = DEFAULT_DATA_AXIS
+                     ) -> Dict[str, Dict[str, TpLeafSpec]]:
+    """Tensor-parallel residency specs for serving: SpecLayout's
+    inferred splits with every **compute** spec replaced by ``P()``
+    (gather-to-replicated before the math — see the module docstring
+    for why serving insists on this)."""
+    layout = SpecLayout(mesh, model_axis, data_axis)
+    inferred = layout.infer(dense_params, shard_over_data=True)
+    return {k: {n: TpLeafSpec(P(), ls.resident)
+                for n, ls in sub.items()}
+            for k, sub in inferred.items()}
+
+
+def serving_layouts(mesh, dense_params, mode: str,
+                    tensor_parallel: Optional[int] = None, *,
+                    name: str = "model"
+                    ) -> Tuple[dict, dict, dict]:
+    """Place a dense param tree resident-sharded for serving.
+
+    Returns ``(placed, fsdp_specs, tp_specs)`` — the flat-layout tree
+    device_put at its resident shardings, the per-entry
+    :class:`~deeplearning4j_tpu.learning.updaters.DpFlatSpec` map, and
+    the serving tp specs (empty off the tp path). ``tensor_parallel``
+    defaults to the mesh's ``model``-axis extent; pass 1 to force
+    dp-only sharding on a 2D mesh."""
+    if mode not in MODES or mode == "dense":
+        raise ValueError(f"serving residency mode must be one of "
+                         f"{MODES[1:]}, got {mode!r}")
+    tp = int(mesh.shape.get(DEFAULT_MODEL_AXIS, 1)
+             if tensor_parallel is None else tensor_parallel)
+    if tp > 1 and mesh.shape.get(DEFAULT_MODEL_AXIS, 1) != tp:
+        raise ValueError(
+            f"tensor_parallel={tp} needs a mesh with a "
+            f"'{DEFAULT_MODEL_AXIS}' axis of that extent, got "
+            f"{dict(mesh.shape)}")
+    tp_specs = (serving_tp_specs(mesh, dense_params) if tp > 1 else {})
+    n_shards = int(mesh.shape[DEFAULT_DATA_AXIS])
+    flat, fsdp_specs = params_to_fsdp(
+        dense_params, n_shards,
+        tp_specs={k: tuple(sub) for k, sub in tp_specs.items()})
+    placed = place_fsdp_params(mesh, flat, DEFAULT_DATA_AXIS,
+                               tp_specs=tp_specs)
+    if telemetry.enabled():
+        telemetry.gauge(
+            "dl4j_serving_param_resident_bytes",
+            "per-chip resident parameter bytes of a serving model by "
+            "residency mode — ~1/N of dense under sharded/fsdp, "
+            "1/(dp*tp) for tensor-parallel leaves").set(
+                resident_param_bytes(placed), model=name, mode=mode)
+    return placed, fsdp_specs, tp_specs
+
+
+def serving_param_view(placed, fsdp_specs, mesh, tp_specs, mode: str):
+    """The params object the jitted serving forward consumes (traced
+    inside jit, once per XLA signature).
+
+    ``fsdp``: the lazy :class:`FsdpParamView` — each entry's gather is
+    emitted where the forward walk touches it. ``sharded``: the same
+    view, eagerly materialized into a dense dict up front, so XLA sees
+    one gather wall before any compute (ZeRO-1 shape)."""
+    view = FsdpParamView(placed, fsdp_specs, mesh, DEFAULT_DATA_AXIS,
+                         prefetch=(mode == "fsdp"),
+                         tp_specs=tp_specs)
+    if mode == "sharded":
+        return {k: view.get(k) for k in placed}
+    return view
+
+
+def resident_param_bytes(placed) -> int:
+    """Per-chip resident bytes of a placed serving param tree (the
+    sharding-aware accounting from ``common.diagnostics``)."""
+    from deeplearning4j_tpu.common.diagnostics import \
+        _tree_resident_bytes
+    return int(_tree_resident_bytes(placed))
+
+
+def densify(placed, fsdp_specs) -> dict:
+    """Host-side inverse of :func:`serving_layouts` (checkpoint /
+    teardown boundaries)."""
+    from deeplearning4j_tpu.parallel.zero import params_to_dense
+    return params_to_dense(placed, fsdp_specs)
+
+
+def assert_mode(mode: str) -> str:
+    if mode not in MODES:
+        raise ValueError(f"unknown residency mode {mode!r}; expected "
+                         f"one of {MODES}")
+    return mode

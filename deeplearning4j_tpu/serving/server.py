@@ -19,23 +19,38 @@ Endpoints:
 - ``GET /readyz`` — 200 once ≥1 model is READY and not draining.
 - ``GET /metrics`` — the process-wide Prometheus registry.
 
+The raw ``.npy`` path is **zero-copy** end to end: the request body is
+parsed with ``httputil.npy_view`` (an ndarray aliasing the received
+bytes — no json/base64 detour, no second ``np.array``), and the
+response streams ``npy_header`` + the result array's own buffer via
+``send_body_parts`` (no ``np.save``-into-BytesIO materialization).
+``bench_serving.py`` measures the per-request tax this removes.
+
+Every completed request's total latency feeds
+``AdmissionController.observe_total`` — the observation stream behind
+the SLO-adaptive budget and the measured ``Retry-After`` — and a
+version's ``latency_slo_ms`` is wired into the controller the first
+time the version serves.
+
 Status mapping: shed (queue full) → 429 + ``Retry-After``; draining →
-503 + ``Retry-After``; deadline expired → 504; unknown model → 404;
+503 + ``Retry-After``; deadline expired (at admission — fast-fail
+before a slot is taken — or while queued) → 504; unknown model → 404;
 bad body → 400.
 """
 from __future__ import annotations
 
-import io
 import json
 import re
 import threading
+import time
 from concurrent import futures
 from typing import Optional
 
 import numpy as np
 
 from deeplearning4j_tpu.common import telemetry
-from deeplearning4j_tpu.common.httputil import (QuietHandler,
+from deeplearning4j_tpu.common.httputil import (QuietHandler, npy_header,
+                                                npy_view,
                                                 start_http_server)
 from deeplearning4j_tpu.serving.admission import (AdmissionController,
                                                   DeadlineExceeded,
@@ -139,13 +154,19 @@ class InferenceServer:
         except KeyError:
             finish_json({"error": f"model {name!r} not found"}, 404)
             return
+        if version.latency_slo_ms is not None:
+            # arm (or refresh) the SLO-adaptive budget for this model
+            self.admission.set_slo(name, version.latency_slo_ms)
         raw = (handler.headers.get("Content-Type", "")
                .split(";")[0].strip() in _NPY_TYPES)
         body = handler.read_body()
         deadline_ms = handler.headers.get("X-Deadline-Ms")
         try:
             if raw:
-                x = np.load(io.BytesIO(body), allow_pickle=False)
+                # zero-copy: an ndarray view over the received bytes —
+                # the batcher pads/concatenates from here, so the only
+                # tensor copy on the path is the batch assembly itself
+                x = npy_view(body)
             else:
                 doc = json.loads(body.decode() or "{}")
                 if "inputs" not in doc:
@@ -164,39 +185,47 @@ class InferenceServer:
             return
         deadline = deadline_after_ms(
             float(deadline_ms) if deadline_ms is not None else None)
+        t_start = time.monotonic()
         try:
-            with self.admission.track(name):
+            # track() admits first: an already-expired deadline
+            # fast-fails 504 here without ever occupying a slot
+            with self.admission.track(name, deadline):
                 fut = version.batcher.submit(x, deadline=deadline)
                 timeout = (float(deadline_ms) / 1e3 + 1.0
                            if deadline_ms is not None
                            else self.request_timeout_s)
                 try:
                     out = fut.result(timeout=timeout)
-                except DeadlineExceeded as e:
-                    finish_json({"error": str(e)}, 504)
-                    return
                 except (TimeoutError, futures.TimeoutError):
                     # pre-3.11 futures.TimeoutError is its own type
                     fut.cancel()
-                    finish_json({"error": "request timed out"}, 504)
-                    return
+                    raise
+        except DeadlineExceeded as e:
+            finish_json({"error": str(e)}, 504)
+            return
         except ShedError as e:
             code = 503 if e.reason == "draining" else 429
             finish_json(
                 {"error": str(e), "reason": e.reason}, code,
-                {"Retry-After": self.admission.retry_after_header()})
+                {"Retry-After": self.admission.retry_after_header(name)})
+            return
+        except (TimeoutError, futures.TimeoutError):
+            finish_json({"error": "request timed out"}, 504)
             return
         except Exception as e:          # model raised during compute
             finish_json({"error": f"inference failed: {e}"}, 500)
             return
+        self.admission.observe_total(name,
+                                     time.monotonic() - t_start)
         if raw:
-            buf = io.BytesIO()
-            np.save(buf, np.asarray(out), allow_pickle=False)
+            out_arr = np.ascontiguousarray(np.asarray(out))
             counted.inc(model=name, code="200")
-            handler.send_body(buf.getvalue(),
-                              "application/octet-stream",
-                              headers={"X-Model-Version":
-                                       str(version.version)})
+            # header + the array's own buffer, streamed — np.save's
+            # BytesIO join copy is gone
+            handler.send_body_parts(
+                [npy_header(out_arr), memoryview(out_arr)],
+                "application/octet-stream",
+                headers={"X-Model-Version": str(version.version)})
         else:
             finish_json({"outputs": np.asarray(out).tolist(),
                          "model": name,

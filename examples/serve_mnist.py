@@ -6,12 +6,21 @@ The full serving lifecycle on one page:
   1. train a small MNIST-shaped network,
   2. register it in a ``ModelRegistry`` with shape-bucketed warmup
      (every batch bucket's XLA program compiles BEFORE the first
-     request),
+     request) and a ``latency_slo_ms`` — the SLO the adaptive
+     admission budget defends under overload,
   3. start the ``InferenceServer`` and drive it like a client would —
-     JSON predict requests with a deadline,
+     a JSON predict request with a deadline, then the zero-copy raw
+     ``.npy`` hot path (no JSON float round-trip in either
+     direction),
   4. hot-swap a retrained version under the same name (no request
      dropped, live pointer flips atomically),
   5. read back the serving metrics from ``/metrics``.
+
+Flushes are *continuous* by default: the batcher worker flushes the
+moment the device frees, so the lone requests below pay no batching
+window — under concurrent load, queue depth alone fills the warm
+buckets (pass ``flush_policy="window"`` to ``ModelRegistry`` for the
+classic fixed-window behavior).
 
 Synthetic MNIST-shaped data keeps it offline-runnable; point
 ``_data()`` at ``datasets.mnist`` for the real thing.
@@ -20,6 +29,7 @@ import os
 import sys
 sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
 
+import io
 import json
 import urllib.request
 
@@ -68,9 +78,12 @@ def main():
     for _ in range(5):
         net.fit(x, y)
 
-    # registry + warmup: buckets (8, 32) compile now, not on request 1
-    reg = ModelRegistry(default_buckets=(8, 32), batch_window_ms=2.0)
-    ver = reg.register("mnist", net, warmup_shape=(784,))
+    # registry + warmup: buckets (8, 32) compile now, not on request 1;
+    # the 250ms SLO arms the adaptive admission budget (shed early
+    # under overload instead of queueing past the deadline)
+    reg = ModelRegistry(default_buckets=(8, 32))
+    ver = reg.register("mnist", net, warmup_shape=(784,),
+                       latency_slo_ms=250.0)
     print(f"registered mnist v{ver.version}: "
           f"buckets={list(ver.batcher.buckets)}, "
           f"warm signatures={ver.warm_signatures}")
@@ -91,6 +104,20 @@ def main():
     np.testing.assert_allclose(
         np.asarray(resp["outputs"], np.float32),
         np.asarray(net.output(x[:1])), rtol=1e-5, atol=1e-6)
+
+    # zero-copy raw path: a .npy body in, a .npy body out — the
+    # request is parsed as a view over the received bytes and the
+    # response streams the result array's own buffer
+    buf = io.BytesIO()
+    np.save(buf, x[:4])
+    raw_req = urllib.request.Request(
+        base + "/v1/models/mnist:predict", data=buf.getvalue(),
+        headers={"Content-Type": "application/octet-stream"})
+    raw_resp = urllib.request.urlopen(raw_req)
+    raw_out = np.load(io.BytesIO(raw_resp.read()))
+    print(f"raw .npy path: {raw_out.shape} {raw_out.dtype} from "
+          f"v{raw_resp.headers['X-Model-Version']}")
+    assert raw_out.shape == (4, 10)
 
     # hot-swap: retrain, re-register the SAME name — version bumps,
     # no request dropped, warmup happens before the pointer flips

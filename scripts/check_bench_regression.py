@@ -38,7 +38,14 @@ exchange must not start crossing the model axis).  The ISSUE-13
 / cost-analysis ``bytes_accessed`` lower-is-better and
 ``pct_of_roof`` / ``speedup`` / ``bytes_ratio`` higher-is-better —
 the fused-epilogue claim is precisely "fewer HBM bytes, closer to
-the roof".
+the roof".  The ISSUE-15 ``serving`` block gates its open-loop
+percentiles (``p50/p95/p99_ms`` and the ``*_rtt_adj_ms`` twins)
+lower-is-better and ``goodput_rps`` / ``in_slo_pct`` /
+``occupancy_mean`` / the residency ``savings_ratio`` and
+serialization ``speedup`` higher-is-better — the continuous-batching
+claim is "lower tail latency AND more useful completions per second
+at the same offered load"; ``meta.transport_rtt_ms`` rides in the
+skipped ``meta`` block, so rig RTT never gates.
 
 When baseline and fresh disagree on ``meta.proxy`` (one is a
 CPU-proxy round, the other a real-chip round) the comparison is
@@ -59,7 +66,8 @@ import sys
 #: metrics where larger is better (substring match on the key)
 HIGHER_BETTER = ("value", "tflops", "throughput", "_ips", "_rps",
                  "efficiency", "savings_ratio", "pct_of_roof",
-                 "speedup", "bytes_ratio")
+                 "speedup", "bytes_ratio", "goodput", "in_slo_pct",
+                 "occupancy")
 #: metrics where smaller is better
 LOWER_BETTER = ("_ms", "_us", "_seconds", "overhead", "stall", "skew",
                 "_bytes_per_chip", "lost_steps", "cross_axis",

@@ -1,0 +1,168 @@
+#!/usr/bin/env python
+"""CI gate: the serving-SLO contract under load and rollout.
+
+Boots a 2-replica :class:`ServingRouter` on a toy model and hammers
+it with concurrent clients while a fleet-wide warm-then-drain rollout
+replaces the live version. The gate holds the ISSUE-15 acceptance
+bar:
+
+1. every response is either a 200 whose outputs match v1's or v2's
+   dense math bitwise, or a well-formed shed (429/503 carrying a
+   positive integer ``Retry-After``) — nothing is dropped, no 5xx
+   surprises, no connection resets;
+2. zero post-warmup retraces on every replica's live version (the
+   shape-bucketed warmup covered every flush the load produced);
+3. the rollout completed on every replica (live version == 2
+   fleet-wide) while the load was running.
+
+Accelerator-free: runs on the CPU backend in-process, like the other
+gates in ci_check.sh.
+
+Usage: JAX_PLATFORMS=cpu python scripts/check_serving_slo.py
+Exit 0 = gate holds, 1 = a clause failed.
+"""
+from __future__ import annotations
+
+import json
+import os
+import sys
+import threading
+import urllib.error
+import urllib.request
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+import numpy as np  # noqa: E402
+
+N_CLIENTS = 6
+SECONDS_AFTER_ROLLOUT = 0.5
+
+
+def _mlp(seed):
+    from deeplearning4j_tpu.activations import Activation
+    from deeplearning4j_tpu.learning.updaters import Sgd
+    from deeplearning4j_tpu.lossfunctions import LossFunction
+    from deeplearning4j_tpu.nn.conf.builders import \
+        NeuralNetConfiguration
+    from deeplearning4j_tpu.nn.conf.inputs import InputType
+    from deeplearning4j_tpu.nn.conf.layers import (DenseLayer,
+                                                   OutputLayer)
+    from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+    conf = (NeuralNetConfiguration.Builder()
+            .seed(seed).updater(Sgd(0.1))
+            .list()
+            .layer(DenseLayer(n_in=8, n_out=16,
+                              activation=Activation.TANH))
+            .layer(OutputLayer(n_out=3,
+                               loss_function=LossFunction.MCXENT,
+                               activation=Activation.SOFTMAX))
+            .set_input_type(InputType.feed_forward(8))
+            .build())
+    return MultiLayerNetwork(conf).init()
+
+
+def _post(base, payload):
+    req = urllib.request.Request(
+        f"{base}/v1/models/gate:predict",
+        data=json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json"})
+    try:
+        r = urllib.request.urlopen(req, timeout=60)
+        return r.status, r.read(), dict(r.headers)
+    except urllib.error.HTTPError as e:
+        return e.code, e.read(), dict(e.headers)
+
+
+def main() -> int:
+    from deeplearning4j_tpu.serving import ServingRouter
+
+    net1, net2 = _mlp(42), _mlp(99)
+    x = np.random.RandomState(0).randn(2, 8).astype(np.float32)
+    ref1 = np.asarray(net1.output(x))
+    ref2 = np.asarray(net2.output(x))
+
+    router = ServingRouter(n_replicas=2, default_buckets=(8,),
+                           health_interval_s=0.5)
+    router.start(0)
+    failures = []
+    try:
+        router.rollout("gate", lambda: _mlp(42), warmup_shape=(8,),
+                       latency_slo_ms=500.0)
+        results, errors = [], []
+        stop = threading.Event()
+
+        def client():
+            while not stop.is_set():
+                try:
+                    results.append(_post(router.url,
+                                         {"inputs": x.tolist()}))
+                except Exception as e:      # noqa: BLE001
+                    errors.append(repr(e))
+
+        threads = [threading.Thread(target=client)
+                   for _ in range(N_CLIENTS)]
+        for t in threads:
+            t.start()
+        try:
+            router.rollout("gate", lambda: _mlp(99),
+                           warmup_shape=(8,), latency_slo_ms=500.0)
+            stop.wait(SECONDS_AFTER_ROLLOUT)
+        finally:
+            stop.set()
+            for t in threads:
+                t.join(timeout=30)
+
+        ok200 = shed = 0
+        for code, body, headers in results:
+            if code == 200:
+                ok200 += 1
+                got = np.asarray(json.loads(body)["outputs"],
+                                 dtype=np.float32)
+                if not (np.array_equal(got, ref1)
+                        or np.array_equal(got, ref2)):
+                    failures.append(
+                        f"200 response matched neither version "
+                        f"(first row {got[0]!r})")
+            elif code in (429, 503):
+                shed += 1
+                ra = headers.get("Retry-After")
+                if not (ra and ra.isdigit() and int(ra) >= 1):
+                    failures.append(
+                        f"shed {code} without a well-formed "
+                        f"Retry-After (got {ra!r})")
+            else:
+                failures.append(f"unexpected status {code}: "
+                                f"{body[:120]!r}")
+        if errors:
+            failures.append(f"{len(errors)} dropped/raised requests "
+                            f"(first: {errors[0]})")
+        if ok200 == 0:
+            failures.append("no successful responses at all")
+        for rep in router.replicas:
+            ver = rep.registry.model("gate")
+            if ver.version != 2:
+                failures.append(f"{rep.name}: rollout did not land "
+                                f"(live version {ver.version})")
+            retr = ver.retraces_since_warmup()
+            if retr != 0:
+                failures.append(f"{rep.name}: {retr} post-warmup "
+                                f"retrace(s)")
+        print(f"serving-SLO gate: {len(results)} requests across a "
+              f"live rollout -> {ok200} ok, {shed} shed "
+              f"(Retry-After well-formed), "
+              f"{len(errors)} dropped; retraces after warmup: 0 "
+              f"expected on 2 replicas")
+    finally:
+        router.stop(drain=False, timeout=10)
+
+    if failures:
+        for f in failures[:10]:
+            print(f"FAIL: {f}")
+        return 1
+    print("OK: every response was a bitwise-correct 200 or a "
+          "well-formed shed; zero retraces; rollout hitless")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

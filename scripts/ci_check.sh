@@ -38,7 +38,13 @@
 #      LeNet + BERT-tiny must sum to the whole-model cost_analysis
 #      within 1%, with the named-scope annotations actually reaching
 #      the compiled HLO (the ISSUE 14 acceptance bar,
-#      scripts/check_layer_attribution.py).
+#      scripts/check_layer_attribution.py);
+#   8. serving-SLO gate: a 2-replica router under concurrent load
+#      across a live warm-then-drain rollout must answer every
+#      request with a bitwise-correct 200 or a well-formed shed
+#      (429/503 + integer Retry-After), drop nothing, and show zero
+#      post-warmup retraces (the ISSUE 15 acceptance bar,
+#      scripts/check_serving_slo.py).
 #
 # Usage: scripts/ci_check.sh [--threshold PCT]     (default 10)
 # Exit 0 = all gates clean, 1 = a gate failed, 2 = bad usage.
@@ -97,5 +103,8 @@ JAX_PLATFORMS=cpu python -m pytest tests/test_conv_pallas.py \
 
 echo "== layer-attribution conformance gate =="
 JAX_PLATFORMS=cpu python scripts/check_layer_attribution.py || fail=1
+
+echo "== serving-SLO gate =="
+JAX_PLATFORMS=cpu python scripts/check_serving_slo.py || fail=1
 
 exit $fail

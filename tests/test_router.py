@@ -1,0 +1,228 @@
+"""Multi-replica serving router tests (ISSUE 15): least-loaded
+dispatch, fleet-wide warm-then-drain rollouts, replica-failure
+rerouting, and the router's observability surface.
+
+Replicas are in-process (each its own registry/admission/server on a
+free port) — mesh-free, so this module runs on any device count."""
+import io
+import json
+import threading
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.common import telemetry
+from deeplearning4j_tpu.common.telemetry import MetricsRegistry
+from deeplearning4j_tpu.serving import ServingRouter
+
+
+@pytest.fixture(autouse=True)
+def _fresh_registry():
+    MetricsRegistry._reset_for_tests()
+    yield
+    MetricsRegistry._reset_for_tests()
+
+
+def _mlp(seed=42):
+    from deeplearning4j_tpu.activations import Activation
+    from deeplearning4j_tpu.learning.updaters import Sgd
+    from deeplearning4j_tpu.lossfunctions import LossFunction
+    from deeplearning4j_tpu.nn.conf.builders import \
+        NeuralNetConfiguration
+    from deeplearning4j_tpu.nn.conf.inputs import InputType
+    from deeplearning4j_tpu.nn.conf.layers import (DenseLayer,
+                                                   OutputLayer)
+    from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+    conf = (NeuralNetConfiguration.Builder()
+            .seed(seed).updater(Sgd(0.1))
+            .list()
+            .layer(DenseLayer(n_in=8, n_out=16,
+                              activation=Activation.TANH))
+            .layer(OutputLayer(n_out=3,
+                               loss_function=LossFunction.MCXENT,
+                               activation=Activation.SOFTMAX))
+            .set_input_type(InputType.feed_forward(8))
+            .build())
+    return MultiLayerNetwork(conf).init()
+
+
+def _get(base, path):
+    r = urllib.request.urlopen(f"{base}{path}")
+    return r.status, r.read()
+
+
+def _post(base, name, payload, headers=None, raw=False):
+    h = {"Content-Type": ("application/octet-stream" if raw
+                          else "application/json")}
+    h.update(headers or {})
+    data = payload if isinstance(payload, bytes) \
+        else json.dumps(payload).encode()
+    req = urllib.request.Request(
+        f"{base}/v1/models/{name}:predict", data=data, headers=h)
+    try:
+        r = urllib.request.urlopen(req)
+        return r.status, r.read(), dict(r.headers)
+    except urllib.error.HTTPError as e:
+        return e.code, e.read(), dict(e.headers)
+
+
+@pytest.fixture
+def router():
+    rt = ServingRouter(n_replicas=2, default_buckets=(8,),
+                       health_interval_s=0.2)
+    rt.start(0)
+    yield rt
+    rt.stop(drain=False, timeout=5)
+
+
+# ----------------------------------------------------------------------
+class TestRouterDispatch:
+    def test_rollout_then_predict_across_replicas(self, router):
+        net = _mlp()
+        ref_x = np.random.RandomState(0).randn(2, 8).astype(np.float32)
+        ref = np.asarray(net.output(ref_x))
+        versions = router.rollout("m", lambda: _mlp(),
+                                  warmup_shape=(8,))
+        assert len(versions) == 2          # one per replica
+        assert all(v.version == 1 for v in versions)
+
+        code, body = _get(router.url, "/readyz")
+        assert code == 200
+        # JSON path round-trips through the proxy, bitwise to dense
+        code, body, _ = _post(router.url, "m",
+                              {"inputs": ref_x.tolist()})
+        assert code == 200
+        doc = json.loads(body)
+        np.testing.assert_array_equal(
+            np.asarray(doc["outputs"], dtype=np.float32), ref)
+        # raw .npy path relays bytes + X-Model-Version untouched
+        buf = io.BytesIO()
+        np.save(buf, ref_x)
+        code, body, hdrs = _post(router.url, "m", buf.getvalue(),
+                                 raw=True)
+        assert code == 200
+        assert hdrs.get("X-Model-Version") == "1"
+        np.testing.assert_array_equal(np.load(io.BytesIO(body)), ref)
+        # dispatch was counted per replica
+        c = telemetry.counter("dl4j_serving_router_requests_total")
+        served = sum(c.value(replica=f"replica-{i}", code="200")
+                     for i in range(2))
+        assert served == 2
+
+    def test_least_loaded_picks_idle_replica(self, router):
+        r0, r1 = router.replicas
+        r0.begin(); r0.begin()
+        r1.begin()
+        assert router._pick() is r1
+        r1.begin(); r1.begin()
+        assert router._pick() is r0
+        r0.end(); r0.end(); r1.end(); r1.end(); r1.end()
+
+    def test_replicas_endpoint_and_catalog(self, router):
+        router.rollout("m", lambda: _mlp(), warmup_shape=(8,))
+        code, body = _get(router.url, "/v1/replicas")
+        assert code == 200
+        reps = json.loads(body)["replicas"]
+        assert [r["name"] for r in reps] == ["replica-0", "replica-1"]
+        assert all(r["healthy"] and r["ready"] for r in reps)
+        code, body = _get(router.url, "/v1/models")
+        assert code == 200
+        models = json.loads(body)["models"]
+        assert models[0]["name"] == "m"
+
+    def test_unknown_model_relays_replica_404(self, router):
+        router.rollout("m", lambda: _mlp(), warmup_shape=(8,))
+        code, body, _ = _post(router.url, "nope",
+                              {"inputs": [[0.0] * 8]})
+        assert code == 404
+
+    def test_metrics_endpoint(self, router):
+        router.rollout("m", lambda: _mlp(), warmup_shape=(8,))
+        _post(router.url, "m", {"inputs": [[0.0] * 8]})
+        code, body = _get(router.url, "/metrics")
+        assert code == 200
+        text = body.decode()
+        assert "dl4j_serving_router_requests_total" in text
+        assert "dl4j_serving_router_healthy" in text
+        assert "dl4j_serving_rollouts_total" in text
+
+
+# ----------------------------------------------------------------------
+class TestRouterResilience:
+    def test_rollout_under_load_drops_nothing(self, router):
+        """The fleet-wide warm-then-drain acceptance: a hot-swap
+        rollout under concurrent client load yields only 200s, every
+        response matching v1's or v2's math."""
+        net1, net2 = _mlp(seed=42), _mlp(seed=99)
+        x = np.random.RandomState(1).randn(2, 8).astype(np.float32)
+        ref1 = np.asarray(net1.output(x))
+        ref2 = np.asarray(net2.output(x))
+        router.rollout("m", lambda: _mlp(seed=42), warmup_shape=(8,))
+
+        outs, errors = [], []
+        stop = threading.Event()
+
+        def hammer():
+            while not stop.is_set():
+                try:
+                    code, body, _ = _post(router.url, "m",
+                                          {"inputs": x.tolist()})
+                    outs.append((code, body))
+                except Exception as e:      # noqa: BLE001
+                    errors.append(e)
+
+        threads = [threading.Thread(target=hammer) for _ in range(4)]
+        for t in threads:
+            t.start()
+        try:
+            router.rollout("m", lambda: _mlp(seed=99),
+                           warmup_shape=(8,))
+        finally:
+            stop.set()
+            for t in threads:
+                t.join(timeout=30)
+        assert not errors, errors[:3]
+        assert outs
+        assert all(code == 200 for code, _ in outs), \
+            sorted({code for code, _ in outs})
+        for _, body in outs:
+            got = np.asarray(json.loads(body)["outputs"],
+                             dtype=np.float32)
+            assert (np.array_equal(got, ref1)
+                    or np.array_equal(got, ref2))
+        assert telemetry.counter(
+            "dl4j_serving_rollouts_total").value(model="m") == 2
+
+    def test_dead_replica_reroutes_and_leaves_rotation(self, router):
+        """A connection-level failure retries on the next replica and
+        takes the dead one out of rotation — the client sees 200."""
+        x = np.random.RandomState(2).randn(1, 8).astype(np.float32)
+        router.rollout("m", lambda: _mlp(), warmup_shape=(8,))
+        victim = router.replicas[0]
+        victim.server.stop(drain=False)
+
+        code, body, _ = _post(router.url, "m",
+                              {"inputs": x.tolist()})
+        assert code == 200
+        assert victim.healthy is False
+        g = telemetry.gauge("dl4j_serving_router_healthy")
+        assert g.value(replica="replica-0") == 0
+        # the survivor keeps serving
+        code, _, _ = _post(router.url, "m", {"inputs": x.tolist()})
+        assert code == 200
+
+    def test_no_healthy_replica_is_502(self, router):
+        router.rollout("m", lambda: _mlp(), warmup_shape=(8,))
+        for r in router.replicas:
+            r.set_healthy(False)
+            r.server.stop(drain=False)
+        router._stopping = True    # freeze the health poller's verdict
+        code, body, _ = _post(router.url, "m",
+                              {"inputs": [[0.0] * 8]})
+        assert code == 502
+        assert "no healthy replica" in json.loads(body)["error"]
+        assert telemetry.counter(
+            "dl4j_serving_router_requests_total").value(
+                replica="none", code="502") == 1

@@ -142,7 +142,10 @@ class TestServingBatcher:
 
     def test_deadline_expired_request_cancelled_not_computed(self):
         net = _mlp()
-        b = ServingBatcher(net, buckets=(8,), batch_window_ms=150.0)
+        # window policy: the worker holds the batch 150ms, letting the
+        # doomed request's 10ms deadline expire while queued
+        b = ServingBatcher(net, buckets=(8,), batch_window_ms=150.0,
+                           flush_policy="window")
         b.warmup((8,))
         x = np.zeros((1, 8), np.float32)
         computed = []
@@ -161,6 +164,74 @@ class TestServingBatcher:
         assert telemetry.counter(
             "dl4j_serving_deadline_expired_total").value(
                 model="model") == 1
+        assert telemetry.counter(
+            "dl4j_serving_deadline_shed_total").value(
+                model="model", where="queue") == 1
+        b.shutdown()
+
+    def test_continuous_batching_aggregates_under_busy_device(self):
+        """The continuous worker takes whatever is queued the moment
+        the device frees: requests arriving while a flush computes
+        coalesce into ONE next flush — no window clock involved."""
+        flushes = []
+        release = threading.Event()
+
+        class _Slow:
+            def output(self, x):
+                # first flush blocks until the test has queued more
+                if not flushes:
+                    release.wait(timeout=30)
+                return np.asarray(x)[:, :1] * 2
+
+        b = ServingBatcher(_Slow(), buckets=(8,), name="cont")
+        assert b.flush_policy == "continuous"
+        b.warmup((4,))
+        orig = b.output_batched
+        b.output_batched = lambda reqs: flushes.append(len(reqs)) \
+            or orig(reqs)
+        x = np.ones((1, 4), np.float32)
+        first = b.submit(x)              # occupies the worker
+        time.sleep(0.05)                 # worker is inside the flush
+        rest = [b.submit(x) for _ in range(5)]
+        time.sleep(0.05)                 # all five are queued
+        release.set()
+        for f in [first] + rest:
+            np.testing.assert_array_equal(f.result(timeout=30),
+                                          [[2.0]])
+        # flush 1 took the lone first request; flush 2 took ALL five
+        # waiters at once — batch formation from device busyness alone
+        assert flushes == [1, 5]
+        b.shutdown()
+
+    def test_continuous_lone_request_flushes_immediately(self):
+        """An idle continuous batcher adds no window wait: a single
+        request's queue latency is far below the old 2ms floor times
+        any reasonable load factor (bounded here at 50ms for CI
+        noise, but typically sub-ms)."""
+        net = _mlp()
+        b = ServingBatcher(net, buckets=(8,))
+        b.warmup((8,))
+        x = np.zeros((1, 8), np.float32)
+        t0 = time.perf_counter()
+        b.submit(x).result(timeout=30)
+        assert time.perf_counter() - t0 < 0.5
+        b.shutdown()
+
+    def test_flush_policy_validated(self):
+        with pytest.raises(ValueError):
+            ServingBatcher(_mlp(), buckets=(8,), flush_policy="nope")
+        with pytest.raises(ValueError):
+            ServingBatcher(_mlp(), buckets=(8,), mode="bogus")
+
+    def test_serving_batch_occupancy_histogram_observed(self):
+        net = _mlp()
+        b = ServingBatcher(net, buckets=(8,))
+        b.warmup((8,))
+        b.submit(np.zeros((2, 8), np.float32)).result(timeout=30)
+        h = telemetry.histogram("dl4j_serving_batch_occupancy")
+        assert h.count_of(model="model", policy="continuous") >= 1
+        # 2 live rows on an 8-bucket = 0.25 occupancy
+        assert 0 < h.sum_of(model="model", policy="continuous") <= 1
         b.shutdown()
 
 
@@ -198,6 +269,79 @@ class TestAdmissionController:
         assert ei.value.reason == "draining"
         adm.resume()
         adm.admit("m")
+
+    def test_expired_deadline_fast_fails_without_taking_a_slot(self):
+        adm = AdmissionController(max_queue=4)
+        with pytest.raises(DeadlineExceeded):
+            adm.admit("m", deadline=time.monotonic() - 0.001)
+        assert adm.inflight("m") == 0
+        assert telemetry.counter(
+            "dl4j_serving_deadline_shed_total").value(
+                model="m", where="admission") == 1
+        # a live deadline admits normally
+        adm.admit("m", deadline=time.monotonic() + 60)
+        assert adm.inflight("m") == 1
+
+    def test_retry_after_cold_start_returns_floor(self):
+        """Zero observations: no drain rate exists yet, so the header
+        falls back to the configured floor (ceil'd to >= 1s)."""
+        adm = AdmissionController(max_queue=2, retry_after_s=0.5)
+        assert adm.retry_after_s_for("m") == 0.5
+        assert adm.retry_after_header("m") == "1"
+        adm2 = AdmissionController(max_queue=2, retry_after_s=3.0)
+        assert adm2.retry_after_header("m") == "3"
+
+    def test_retry_after_derived_from_measured_drain_rate(self):
+        adm = AdmissionController(max_queue=2, retry_after_s=1.0)
+        # 4 completions over 2 simulated seconds -> ~2 rps drain
+        t0 = 1000.0
+        for i in range(4):
+            adm.observe_total("m", 0.05, now=t0 + 0.5 * (i + 1))
+        # saturate the budget: excess = 1 slot to drain at ~2rps
+        adm.admit("m")
+        adm.admit("m")
+        ra = adm.retry_after_s_for("m", now=t0 + 2.0)
+        assert 1.0 <= ra <= 2.0       # floored at 1s, ~0.5s computed
+        assert int(adm.retry_after_header("m")) >= 1
+        # the gauge published the measured rate
+        assert telemetry.gauge(
+            "dl4j_serving_drain_rate_rps").value(model="m") > 0
+
+    def test_slo_budget_shrinks_on_p95_violation_and_regrows(self):
+        adm = AdmissionController(max_queue=16)
+        adm.set_slo("m", 50.0)                 # 50ms SLO
+        assert adm.budget("m") == 16
+        # sustained 200ms totals: p95 >> SLO, AIMD shrink kicks in
+        for i in range(8):
+            adm.observe_total("m", 0.2, now=1000.0 + i)
+        assert adm.budget("m") < 16
+        shrunk = adm.budget("m")
+        assert shrunk >= adm.min_budget
+        # sustained 1ms totals: p95 < 80% of SLO, budget regrows +1
+        for i in range(64):
+            adm.observe_total("m", 0.001, now=2000.0 + i)
+        assert adm.budget("m") > shrunk
+        assert telemetry.gauge(
+            "dl4j_serving_admission_budget").value(model="m") == \
+            adm.budget("m")
+
+    def test_adaptive_budget_gates_admission(self):
+        adm = AdmissionController(max_queue=16, min_budget=1)
+        adm.set_slo("m", 10.0)
+        # hammer the controller until the budget collapses to the floor
+        for i in range(64):
+            adm.observe_total("m", 5.0, now=1000.0 + i)
+        assert adm.budget("m") == 1
+        adm.admit("m")
+        with pytest.raises(ShedError) as ei:
+            adm.admit("m")                # static cap is 16, budget is 1
+        assert ei.value.reason == "queue_full"
+
+    def test_no_slo_keeps_static_budget(self):
+        adm = AdmissionController(max_queue=4)
+        for i in range(32):
+            adm.observe_total("m", 9.9, now=1000.0 + i)
+        assert adm.budget("m") == 4       # no SLO -> no adaptation
 
 
 # ----------------------------------------------------------------------
@@ -266,11 +410,13 @@ class TestModelRegistry:
 
 # ----------------------------------------------------------------------
 def _serve(net=None, buckets=(8, 16), window_ms=5.0, admission=None,
-           warm=True):
+           warm=True, flush_policy="continuous", **register_kw):
     net = net or _mlp()
     reg = ModelRegistry(default_buckets=buckets,
-                        batch_window_ms=window_ms)
-    reg.register("m", net, warmup_shape=(8,) if warm else None)
+                        batch_window_ms=window_ms,
+                        flush_policy=flush_policy)
+    reg.register("m", net, warmup_shape=(8,) if warm else None,
+                 **register_kw)
     srv = InferenceServer(reg, admission
                           or AdmissionController(max_queue=64))
     srv.start(port=0)
@@ -409,15 +555,66 @@ class TestInferenceServer:
             reg.shutdown()
 
     def test_deadline_expiry_http_504(self):
-        _, reg, srv = _serve(window_ms=100.0)
+        # window policy holds the request 100ms so its 1ms deadline
+        # reliably expires while queued
+        _, reg, srv = _serve(window_ms=100.0, flush_policy="window")
         try:
             code, body, _ = _post(
                 srv.url, "m", {"inputs": [[0.0] * 8]},
                 headers={"X-Deadline-Ms": "1"})
             assert code == 504
+            shed = telemetry.counter(
+                "dl4j_serving_deadline_shed_total")
+            assert (shed.value(model="m", where="queue")
+                    + shed.value(model="m", where="admission")) >= 1
+        finally:
+            srv.stop(drain=True, timeout=10)
+            reg.shutdown()
+
+    def test_already_expired_deadline_fast_fails_before_batcher(self):
+        """A request dead on arrival is answered 504 straight from
+        admission — it never occupies a slot, never reaches the
+        batcher queue, never touches the model."""
+        net, reg, srv = _serve()
+        ver = reg.model("m")
+        submitted = []
+        orig = ver.batcher.submit
+        ver.batcher.submit = lambda *a, **kw: submitted.append(a) or \
+            orig(*a, **kw)
+        try:
+            code, body, _ = _post(
+                srv.url, "m", {"inputs": [[0.0] * 8]},
+                headers={"X-Deadline-Ms": "0"})
+            assert code == 504
+            assert submitted == []
+            assert srv.admission.inflight("m") == 0
             assert telemetry.counter(
-                "dl4j_serving_deadline_expired_total").value(
-                    model="m") >= 1
+                "dl4j_serving_deadline_shed_total").value(
+                    model="m", where="admission") == 1
+        finally:
+            srv.stop(drain=True, timeout=10)
+            reg.shutdown()
+
+    def test_zero_copy_npy_roundtrip_and_slo_wiring(self):
+        """The raw .npy path round-trips through npy_view /
+        send_body_parts, and a version's latency_slo_ms arms the
+        admission controller on first service."""
+        net, reg, srv = _serve(latency_slo_ms=250.0)
+        try:
+            x = np.random.RandomState(11).randn(3, 8).astype(
+                np.float32)
+            buf = io.BytesIO()
+            np.save(buf, x)
+            code, body, hdrs = _post(srv.url, "m", buf.getvalue(),
+                                     raw=True)
+            assert code == 200
+            np.testing.assert_array_equal(
+                np.load(io.BytesIO(body)), np.asarray(net.output(x)))
+            # the completed request observed into the SLO stream and
+            # wired the model's SLO into the controller
+            assert srv.admission._slo_ms.get("m") == 250.0
+            assert telemetry.histogram(
+                "dl4j_serving_total_seconds").count_of(model="m") >= 1
         finally:
             srv.stop(drain=True, timeout=10)
             reg.shutdown()
@@ -429,8 +626,10 @@ class TestInferenceServer:
         429 + Retry-After, and capacity recovers afterwards."""
         net = _mlp()
         adm = AdmissionController(max_queue=2, retry_after_s=0.5)
+        # window policy keeps each admitted request in flight ~150ms,
+        # so the barrier-released surplus deterministically sheds
         net, reg, srv = _serve(net=net, window_ms=150.0,
-                               admission=adm)
+                               admission=adm, flush_policy="window")
         base = srv.url
         x = np.random.RandomState(7).randn(1, 8).astype(np.float32)
         ref = np.asarray(net.output(x))
@@ -489,6 +688,50 @@ class TestInferenceServer:
         finally:
             srv.stop(drain=False)
             reg.shutdown()
+
+
+# ----------------------------------------------------------------------
+class TestNpyZeroCopy:
+    def test_npy_view_aliases_the_buffer(self):
+        from deeplearning4j_tpu.common.httputil import npy_view
+        x = np.arange(24, dtype=np.float32).reshape(4, 6)
+        buf = io.BytesIO()
+        np.save(buf, x)
+        raw = buf.getvalue()
+        v = npy_view(raw)
+        np.testing.assert_array_equal(v, x)
+        assert v.dtype == x.dtype and v.shape == x.shape
+        # a view, not a copy: no ndarray owns this memory and the
+        # bytes object's buffer is the backing store (read-only)
+        assert not v.flags.owndata
+        assert not v.flags.writeable
+        assert np.shares_memory(v, np.frombuffer(raw, np.uint8))
+
+    def test_npy_view_fortran_order_and_float64(self):
+        from deeplearning4j_tpu.common.httputil import npy_view
+        x = np.asfortranarray(
+            np.random.RandomState(0).randn(3, 5))
+        buf = io.BytesIO()
+        np.save(buf, x)
+        np.testing.assert_array_equal(npy_view(buf.getvalue()), x)
+
+    def test_npy_view_rejects_junk_and_pickles(self):
+        from deeplearning4j_tpu.common.httputil import npy_view
+        with pytest.raises(ValueError):
+            npy_view(b"not an npy payload at all")
+        obj = np.array([{"a": 1}], dtype=object)
+        buf = io.BytesIO()
+        np.save(buf, obj, allow_pickle=True)
+        with pytest.raises(ValueError):
+            npy_view(buf.getvalue())
+
+    def test_npy_header_plus_buffer_equals_np_save(self):
+        from deeplearning4j_tpu.common.httputil import npy_header
+        x = np.random.RandomState(2).randn(7, 3).astype(np.float32)
+        buf = io.BytesIO()
+        np.save(buf, x)
+        streamed = npy_header(x) + memoryview(x).cast("B").tobytes()
+        assert streamed == buf.getvalue()
 
 
 # ----------------------------------------------------------------------
