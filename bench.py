@@ -246,6 +246,32 @@ def main():
                   file=sys.stderr)
     except Exception as e:
         print(f"serving leg failed: {e!r}", file=sys.stderr)
+    # Generative leg: paged-KV decode goodput, streaming TTFT /
+    # inter-token percentiles, pool occupancy vs shed rate, and the
+    # paged-vs-dense decode-attention A/B. CPU-proxy subprocess, like
+    # the serving leg above.
+    try:
+        env = {**os.environ, "PYTHONPATH": "", "JAX_PLATFORMS": "cpu"}
+        out = subprocess.run(
+            [sys.executable,
+             os.path.join(_ROOT, "benchmarks", "bench_generative.py")],
+            capture_output=True, text=True, timeout=900, env=env,
+            cwd=_ROOT)
+        if out.returncode != 0:
+            raise RuntimeError(
+                f"rc={out.returncode}: {out.stderr.strip()[-400:]}")
+        for ln in out.stdout.strip().splitlines():
+            if not ln.startswith("{"):
+                continue              # tolerate library banners
+            rec = json.loads(ln)
+            if rec.get("metric") == "generative":
+                rec.pop("metric")
+                line["generative"] = rec
+        if "generative" not in line:
+            print("generative leg: no line in child output",
+                  file=sys.stderr)
+    except Exception as e:
+        print(f"generative leg failed: {e!r}", file=sys.stderr)
     # Update-sharding leg: ZeRO-1 sharded vs dense exchange — per-chip
     # updater-state residency + step time, and the accumulation-window
     # micro-step times. CPU-proxy subprocess on the virtual 8-device

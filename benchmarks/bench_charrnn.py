@@ -90,6 +90,36 @@ def main(batch=64, seq_len=64, hidden=512, vocab=80, steps=1500,
         "unit": "chars/sec/chip",
     }))
 
+    # -- sampling leg: generate characters from the trained net with
+    # the shared ops.sampling primitives (the same sampler the
+    # generative serving decode loop threads through its fused step)
+    from deeplearning4j_tpu.ops.sampling import sample_logits
+
+    sample_steps = 32 if on_tpu else 8
+    key = jax.random.PRNGKey(0)
+    window = jnp.asarray(eye[ids[:, :seq_len]])
+
+    def sample_once():
+        k, w = key, window
+        for i in range(sample_steps):
+            probs = net.output(w)               # [b, t, vocab] softmax
+            logits = jnp.log(probs[:, -1, :] + 1e-9)
+            k = jax.random.fold_in(k, i)
+            nxt = sample_logits(logits, k, temperature=0.8, top_k=40)
+            w = jnp.concatenate(
+                [w[:, 1:], jnp.asarray(eye)[nxt][:, None]], axis=1)
+        jax.block_until_ready(w)
+
+    sample_once()                               # warmup/compile
+    sstats = median_throughput(sample_once, sample_steps * batch,
+                               n_trials=3)
+    print(json.dumps({
+        "metric": "charrnn_sample_throughput"
+                  + ("" if on_tpu else "_cpu_proxy"),
+        **sstats,
+        "unit": "chars/sec/chip",
+    }))
+
 
 if __name__ == "__main__":
     import argparse

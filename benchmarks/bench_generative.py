@@ -1,0 +1,214 @@
+"""Generative serving benchmark: decode goodput, streaming latency
+percentiles, KV-pool occupancy vs shed rate, and the paged-vs-dense
+decode-attention A/B.
+
+Measures the ISSUE-16 claims the way an operator would check them:
+
+- **Decode goodput** — N closed-loop clients stream completions
+  through one :class:`~deeplearning4j_tpu.serving.generative
+  .DecodeEngine` (iteration-level continuous batching: every live
+  sequence advances one token per fused step). Reports generated
+  tokens/s, client-observed TTFT p50/p99 and inter-token p50/p99 —
+  the streaming SLO surface.
+- **Occupancy vs shed** — the same workload against a deliberately
+  small KV pool: mean block occupancy over the run next to the shed
+  rate (PoolExhausted → 429 at submit). The pair says whether the
+  pool is sized to its load or shedding while half empty.
+- **Paged vs dense A/B** — the fused decode step with the Pallas
+  ``paged_decode_attention`` kernel vs the dense-gather reference at
+  equal batch, median step time each, plus the greedy token-equality
+  check (the conformance gate's claim, measured here as perf).
+
+Bench honesty: off-TPU the Pallas kernel runs in interpret mode, so
+the A/B is a *correctness* proxy there, not a perf claim —
+``meta.proxy`` marks those rounds (``scripts/check_bench_regression``
+skips proxy-vs-tpu comparisons).
+
+Prints ONE JSON line (``bench.py`` folds it into its ``generative``
+block):
+
+  {"metric": "generative", "goodput_tokens_per_s": ...,
+   "ttft_p50_ms": ..., "ttft_p99_ms": ..., "intertoken_p50_ms": ...,
+   "intertoken_p99_ms": ..., "occupancy_mean": ...,
+   "shed_rate": ..., "paged": {...}, "meta": {...}}
+
+Run: JAX_PLATFORMS=cpu python benchmarks/bench_generative.py
+"""
+from __future__ import annotations
+
+import json
+import os
+import sys
+import threading
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+import jax  # noqa: E402
+
+if os.environ.get("DL4J_TPU_TEST_PLATFORM", "cpu") == "cpu":
+    jax.config.update("jax_platforms", "cpu")
+
+import numpy as np  # noqa: E402
+
+
+def _pct(xs, q):
+    return float(np.percentile(np.asarray(xs), q)) if xs else None
+
+
+def _engine(conf, *, kv_blocks, block=8, decode_buckets=(8,),
+            max_seq_len=64):
+    from deeplearning4j_tpu.models.decoder import DecoderLM
+    from deeplearning4j_tpu.serving.generative import DecodeEngine
+    from deeplearning4j_tpu.serving.kvcache import KVBlockPool
+    model = DecoderLM(conf)
+    pool = KVBlockPool(conf.n_layers, kv_blocks, block, conf.n_heads,
+                       conf.head_dim, name="bench")
+    eng = DecodeEngine(model, model.init(), pool, name="bench",
+                       prompt_buckets=(16,),
+                       decode_buckets=decode_buckets,
+                       max_seq_len=max_seq_len)
+    eng.warmup()
+    return model, pool, eng
+
+
+def _stream_clients(eng, pool, *, n_clients, prompt_len, max_tokens,
+                    rng):
+    """Closed-loop streaming clients; returns (ttfts, gaps, sheds,
+    occupancy samples, tokens, wall)."""
+    from deeplearning4j_tpu.serving.kvcache import PoolExhausted
+    ttfts, gaps, occ = [], [], []
+    sheds = [0]
+    tokens = [0]
+    lock = threading.Lock()
+
+    def client(i):
+        prompt = rng.integers(2, 60, size=prompt_len)
+        t_sub = time.perf_counter()
+        try:
+            stream = eng.submit(prompt, max_tokens)
+        except PoolExhausted:
+            with lock:
+                sheds[0] += 1
+            return
+        t_prev, first = t_sub, True
+        try:
+            for _ in stream:
+                now = time.perf_counter()
+                with lock:
+                    if first:
+                        ttfts.append((now - t_sub) * 1e3)
+                        first = False
+                    else:
+                        gaps.append((now - t_prev) * 1e3)
+                    tokens[0] += 1
+                    occ.append(pool.occupancy)
+                t_prev = now
+        except PoolExhausted:
+            # retired mid-decode when extend() found the pool dry —
+            # tokens already streamed still count; the end is a shed
+            with lock:
+                sheds[0] += 1
+
+    t0 = time.perf_counter()
+    threads = [threading.Thread(target=client, args=(i,))
+               for i in range(n_clients)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    wall = time.perf_counter() - t0
+    return ttfts, gaps, sheds[0], occ, tokens[0], wall
+
+
+def _paged_ab(conf, model, eng, pool, *, batch, steps=8):
+    """Median fused-step time, paged kernel vs dense gather, plus the
+    greedy token-equality check at the decode protocol's own state."""
+    import jax as _jax
+
+    tokens = np.arange(2, 2 + batch, dtype=np.int32)
+    positions = np.full((batch,), 3, np.int32)
+    tables = np.zeros((batch, eng.max_blocks), np.int32)
+    for i in range(batch):
+        tables[i, 0] = 1 + (i % max(pool.num_blocks - 1, 1))
+    out = {}
+    ids_by_mode = {}
+    for mode, paged in (("paged", True), ("dense", False)):
+        fn = _jax.jit(lambda p, kf, vf, t, pos, tab: model.decode_step(
+            p, t, pos, kf, vf, tab, paged=paged))
+        ids, kp, vp = fn(eng.params, pool.k, pool.v, tokens, positions,
+                         tables)
+        _jax.block_until_ready(ids)
+        times = []
+        for _ in range(steps):
+            t0 = time.perf_counter()
+            ids, kp, vp = fn(eng.params, pool.k, pool.v, tokens,
+                             positions, tables)
+            _jax.block_until_ready(ids)
+            times.append((time.perf_counter() - t0) * 1e3)
+        ids_by_mode[mode] = np.asarray(np.argmax(ids, axis=-1))
+        out[f"{mode}_step_ms"] = round(float(np.median(times)), 3)
+    out["greedy_tokens_equal"] = bool(
+        np.array_equal(ids_by_mode["paged"], ids_by_mode["dense"]))
+    return out
+
+
+def main():
+    from deeplearning4j_tpu.models.decoder import DecoderConfig
+
+    on_tpu = jax.devices()[0].platform == "tpu"
+    conf = DecoderConfig.tiny()
+    rng = np.random.default_rng(0)
+    n_clients = 24 if on_tpu else 12
+    max_tokens = 16 if on_tpu else 8
+
+    # -- goodput + streaming percentiles (roomy pool: no shedding) ----
+    model, pool, eng = _engine(conf, kv_blocks=128,
+                               decode_buckets=(8, 16))
+    ttfts, gaps, sheds, occ, tokens, wall = _stream_clients(
+        eng, pool, n_clients=n_clients, prompt_len=8,
+        max_tokens=max_tokens, rng=rng)
+    line = {
+        "metric": "generative",
+        "n_clients": n_clients,
+        "max_tokens": max_tokens,
+        "goodput_tokens_per_s": round(tokens / wall, 1),
+        "ttft_p50_ms": round(_pct(ttfts, 50) or 0.0, 2),
+        "ttft_p99_ms": round(_pct(ttfts, 99) or 0.0, 2),
+        "intertoken_p50_ms": round(_pct(gaps, 50) or 0.0, 3),
+        "intertoken_p99_ms": round(_pct(gaps, 99) or 0.0, 3),
+        "occupancy_mean": round(float(np.mean(occ)) if occ else 0.0,
+                                3),
+        "retraces_since_warmup": eng.retraces_since_warmup(),
+    }
+
+    # -- paged vs dense fused-step A/B on the same engine -------------
+    line["paged"] = _paged_ab(conf, model, eng, pool,
+                              batch=8 if on_tpu else 4)
+    eng.shutdown()
+
+    # -- occupancy vs shed against a deliberately small pool ----------
+    _, spool, seng = _engine(conf, kv_blocks=8, decode_buckets=(8,))
+    _, _, ssheds, socc, stoks, _ = _stream_clients(
+        seng, spool, n_clients=n_clients, prompt_len=8,
+        max_tokens=max_tokens, rng=rng)
+    line["small_pool"] = {
+        "shed_rate": round(ssheds / n_clients, 3),
+        "occupancy_mean": round(float(np.mean(socc)) if socc
+                                else 0.0, 3),
+        "tokens": stoks,
+    }
+    seng.shutdown()
+
+    try:
+        from deeplearning4j_tpu.common import diagnostics
+        line["meta"] = diagnostics.bench_meta()
+        line["meta"]["proxy"] = not on_tpu
+    except Exception as e:       # noqa: BLE001
+        print(f"meta block failed: {e!r}", file=sys.stderr)
+        line["meta"] = {"proxy": not on_tpu}
+    print(json.dumps(line))
+
+
+if __name__ == "__main__":
+    main()

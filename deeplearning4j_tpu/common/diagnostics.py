@@ -222,6 +222,14 @@ def memory_report(model=None) -> dict:
     accounted = int(staging) + sum(
         v["params_resident_bytes"] + v["updater_state_resident_bytes"] +
         v["model_state_bytes"] for v in models.values())
+    # paged KV-cache pools are their own resident class: preallocated
+    # generation state, not params and not activations (sys.modules
+    # lookup: near-free, and no import edge from diagnostics to
+    # serving)
+    kvc = sys.modules.get("deeplearning4j_tpu.serving.kvcache")
+    kv_pools = kvc.pool_report() if kvc is not None else []
+    kv_bytes = kvc.pool_resident_bytes() if kvc is not None else 0
+    accounted += int(kv_bytes)
     report = {
         "schema_version": SCHEMA_VERSION,
         "devices": devices,
@@ -230,6 +238,8 @@ def memory_report(model=None) -> dict:
                                 for d in devices),
         "models": models,
         "prefetch_staging_bytes": int(staging),
+        "kv_pools": kv_pools,
+        "kv_pool_bytes": int(kv_bytes),
         "accounted_bytes": accounted,
     }
     if devices:

@@ -86,6 +86,11 @@ class QuietHandler(BaseHTTPRequestHandler):
     #: ThreadingHTTPServer threads die with the process
     daemon_threads = True
 
+    #: chunked transfer-encoding (the streaming :generate response)
+    #: requires HTTP/1.1; every non-chunked response still carries an
+    #: explicit Content-Length, so keep-alive connections never hang
+    protocol_version = "HTTP/1.1"
+
     def log_message(self, *args):       # silence request logging
         pass
 
@@ -144,6 +149,54 @@ class QuietHandler(BaseHTTPRequestHandler):
         self.send_body(MetricsRegistry.get().render_prometheus()
                        .encode(),
                        "text/plain; version=0.0.4; charset=utf-8")
+
+    # -- chunked streaming (the :generate token stream) ----------------
+    def begin_chunks(self, content_type: str, code: int = 200,
+                     headers: Optional[dict] = None):
+        """Open a chunked transfer-encoding response: status +
+        headers now, body in :meth:`send_chunk` pieces as they become
+        available (tokens as they decode), closed by
+        :meth:`end_chunks`. No Content-Length — the frame IS the
+        protocol."""
+        self.send_response(code)
+        self.send_header("Content-Type", content_type)
+        self.send_header("Transfer-Encoding", "chunked")
+        for k, v in (headers or {}).items():
+            self.send_header(k, str(v))
+        self.end_headers()
+        self._chunking = True
+
+    def send_chunk(self, data: bytes):
+        """One chunk frame (size line + payload), flushed immediately
+        so the client sees the token the moment it decodes. Raises
+        ``OSError``/``BrokenPipeError`` on client disconnect — the
+        caller's signal to cancel the producing stream."""
+        if not data:
+            return              # a zero-size frame would end the body
+        self.wfile.write(b"%X\r\n" % len(data))
+        self.wfile.write(data)
+        self.wfile.write(b"\r\n")
+        self.wfile.flush()
+
+    def end_chunks(self):
+        """The terminal zero-length chunk — a well-formed end of body;
+        the (HTTP/1.1 keep-alive) connection stays reusable."""
+        self.wfile.write(b"0\r\n\r\n")
+        self.wfile.flush()
+        self._chunking = False
+
+    def abort_chunks(self):
+        """Terminate a chunk stream after a mid-stream handler
+        exception WITHOUT the terminal chunk: the client's de-chunker
+        sees a truncated body (a clean, immediate protocol error)
+        instead of blocking forever on a wedged keep-alive connection.
+        The socket is closed after the handler returns."""
+        self.close_connection = True
+        try:
+            self.wfile.flush()
+        except OSError:
+            pass                # the client may already be gone
+        self._chunking = False
 
     # -- requests ------------------------------------------------------
     def read_body(self) -> bytes:

@@ -7,3 +7,5 @@ from deeplearning4j_tpu.models.bert import (  # noqa: F401
     Bert, BertConfig, BertForSequenceClassification)
 from deeplearning4j_tpu.models.transformer import (  # noqa: F401
     DistributedTransformerLM, TransformerLMConfig)
+from deeplearning4j_tpu.models.decoder import (  # noqa: F401
+    DecoderConfig, DecoderLM)

@@ -183,6 +183,185 @@ def flash_sdpa(q, k, v, scale: Optional[float] = None, key_mask=None,
     return out[:, 0] if squeeze_heads else out
 
 
+# ---------------------------------------------------------------------------
+# paged decode attention (ISSUE 16): one query token per sequence
+# attending over a block-paged KV pool through a per-sequence block
+# table — the decode half of the generative serving engine.
+# ---------------------------------------------------------------------------
+
+#: masked-score value — matches parallel/sequence.py's NEG_INF so the
+#: exp-zeroing trick (exp of masked == exactly 0) carries over
+_PAGED_NEG_INF = -1e30
+
+
+def paged_attention_reference(q, k_pool, v_pool, block_tables,
+                              lengths, scale: Optional[float] = None):
+    """Dense-gather fallback AND numerical reference for paged decode
+    attention.
+
+    ``q`` [b, h, d] (the single new token per sequence); ``k_pool`` /
+    ``v_pool`` [num_blocks, block, h, d] (one layer's paged KV);
+    ``block_tables`` [b, max_blocks] int32 (scratch-block-0 padded);
+    ``lengths`` [b] int32 — valid KV tokens per sequence (>= 1, the
+    current token's KV already written). Returns [b, h, d].
+
+    The gather materializes [b, max_blocks*block, h, d] — exactly the
+    bytes the Pallas kernel avoids — but runs everywhere and defines
+    the semantics the kernel must match bit-for-tolerance."""
+    b, h, d = q.shape
+    block = k_pool.shape[1]
+    t = block_tables.shape[1] * block
+    k = jnp.reshape(k_pool[block_tables], (b, t, h, d))
+    v = jnp.reshape(v_pool[block_tables], (b, t, h, d))
+    if scale is None:
+        scale = 1.0 / math.sqrt(d)
+    s = jnp.einsum("bhd,bthd->bht", q.astype(jnp.float32),
+                   k.astype(jnp.float32)) * scale
+    valid = (jnp.arange(t, dtype=jnp.int32)[None, :]
+             < lengths[:, None])                      # [b, t]
+    s = jnp.where(valid[:, None, :], s, _PAGED_NEG_INF)
+    m = jnp.max(s, axis=-1, keepdims=True)
+    p = jnp.where(s <= _PAGED_NEG_INF / 2, 0.0, jnp.exp(s - m))
+    w = p / jnp.maximum(jnp.sum(p, axis=-1, keepdims=True), 1e-30)
+    out = jnp.einsum("bht,bthd->bhd", w, v.astype(jnp.float32))
+    return out.astype(q.dtype)
+
+
+def _paged_decode_kernel(tables_ref, lens_ref, q_ref, k_ref, v_ref,
+                         out_ref, m_ref, l_ref, acc_ref, *,
+                         block_size: int, scale: float):
+    """Online-softmax accumulation over one sequence's KV blocks.
+    Grid (batch, max_blocks), j innermost; the block table picks the
+    KV block each j step streams in (scalar-prefetch index map), so
+    only table-listed blocks ever leave HBM."""
+    import jax.experimental.pallas as pl
+
+    b = pl.program_id(0)
+    j = pl.program_id(1)
+    n_j = pl.num_programs(1)
+
+    @pl.when(j == 0)
+    def _init():                                  # noqa: ANN202
+        m_ref[...] = jnp.full_like(m_ref, _PAGED_NEG_INF)
+        l_ref[...] = jnp.zeros_like(l_ref)
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+
+    q = q_ref[...].astype(jnp.float32)            # [h, d]
+    k = k_ref[...].astype(jnp.float32)            # [block, h, d]
+    v = v_ref[...].astype(jnp.float32)
+    # per-head scores: contract d, batch over h -> [h, block]
+    s = jax.lax.dot_general(
+        q, k, (((1,), (2,)), ((0,), (1,)))) * scale
+    token_idx = (j * block_size
+                 + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1))
+    s = jnp.where(token_idx < lens_ref[b], s, _PAGED_NEG_INF)
+
+    m_prev = m_ref[:, 0]                          # [h]
+    m_new = jnp.maximum(m_prev, jnp.max(s, axis=1))
+    corr = jnp.exp(m_prev - m_new)
+    p = jnp.where(s <= _PAGED_NEG_INF / 2, 0.0,
+                  jnp.exp(s - m_new[:, None]))    # [h, block]
+    l_new = corr * l_ref[:, 0] + jnp.sum(p, axis=1)
+    # p @ v batched over h: [h, block] x [block, h, d] -> [h, d]
+    pv = jax.lax.dot_general(p, v, (((1,), (0,)), ((0,), (1,))))
+    acc_ref[...] = acc_ref[...] * corr[:, None] + pv
+    m_ref[...] = jnp.broadcast_to(m_new[:, None], m_ref.shape)
+    l_ref[...] = jnp.broadcast_to(l_new[:, None], l_ref.shape)
+
+    @pl.when(j == n_j - 1)
+    def _finish():                                # noqa: ANN202
+        denom = jnp.maximum(l_ref[:, 0], 1e-30)
+        out_ref[...] = (acc_ref[...] / denom[:, None]
+                        ).astype(out_ref.dtype)
+
+
+def paged_decode_attention(q, k_pool, v_pool, block_tables, lengths,
+                           scale: Optional[float] = None,
+                           interpret: Optional[bool] = None):
+    """Pallas paged decode attention — same contract as
+    :func:`paged_attention_reference`, but the KV pool stays in HBM
+    and only the blocks each sequence's table names are streamed into
+    VMEM (scalar-prefetched index map), one online-softmax fold per
+    block. ``interpret=None`` resolves to interpret mode off-TPU so
+    CPU conformance tests run the chip's code path."""
+    import functools
+
+    import jax.experimental.pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    b, h, d = q.shape
+    block = int(k_pool.shape[1])
+    max_blocks = int(block_tables.shape[1])
+    if scale is None:
+        scale = 1.0 / math.sqrt(d)
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,          # block_tables, lengths
+        grid=(b, max_blocks),
+        in_specs=[
+            pl.BlockSpec((None, h, d),
+                         lambda i, j, tables, lens: (i, 0, 0)),
+            pl.BlockSpec((None, block, h, d),
+                         lambda i, j, tables, lens:
+                         (tables[i, j], 0, 0, 0)),
+            pl.BlockSpec((None, block, h, d),
+                         lambda i, j, tables, lens:
+                         (tables[i, j], 0, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((None, h, d),
+                               lambda i, j, tables, lens: (i, 0, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((h, 128), jnp.float32),    # running max
+            pltpu.VMEM((h, 128), jnp.float32),    # running sum
+            pltpu.VMEM((h, d), jnp.float32),      # output accumulator
+        ],
+    )
+    kernel = functools.partial(_paged_decode_kernel,
+                               block_size=block, scale=float(scale))
+    with jax.named_scope("pallas.paged_decode_attention"):
+        return pl.pallas_call(
+            kernel,
+            grid_spec=grid_spec,
+            out_shape=jax.ShapeDtypeStruct((b, h, d), q.dtype),
+            interpret=interpret,
+        )(block_tables.astype(jnp.int32), lengths.astype(jnp.int32),
+          q, k_pool, v_pool)
+
+
+def select_paged_backend(batch: int, max_blocks: int, *,
+                         platform: Optional[str] = None,
+                         override=None,
+                         use_env_override: bool = True):
+    """Pick ("paged" | "dense", reason) for a decode-attention site
+    through the shared kernel-select ladder (family
+    ``paged_attention``, env ``DL4J_TPU_PAGED_ATTENTION``). Auto rung:
+    the Pallas kernel on TPU (it exists to keep gathered KV bytes out
+    of HBM), the dense gather elsewhere (interpret mode is a
+    conformance vehicle, not a fast path)."""
+    from deeplearning4j_tpu.ops import kernel_select
+
+    structural = None
+    if batch < 1 or max_blocks < 1:
+        structural = f"degenerate decode shape b={batch} " \
+                     f"blocks={max_blocks}"
+    if override is None and use_env_override:
+        override = kernel_select.gate_override("paged_attention")
+
+    def _auto():
+        plat = platform
+        if plat is None:
+            plat = jax.devices()[0].platform
+        if plat == "tpu":
+            return True, "auto: paged kernel on tpu"
+        return False, f"auto: platform '{plat}' is not tpu"
+
+    sel = kernel_select.select("paged_attention", structural=structural,
+                               auto=_auto, override=override,
+                               use_env_override=False)
+    return ("paged" if sel.fused else "dense"), sel.reason
+
+
 def maybe_flash_sdpa(q, k, v, scale: Optional[float] = None,
                      mask=None, bias=None, block_q: int = 1024,
                      block_k: int = 1024):

@@ -48,6 +48,7 @@ GATES = {
     "bn_fwd": ("fused_conv", "DL4J_TPU_FUSED_CONV"),
     "bn_bwd": ("fused_bn_bwd", "DL4J_TPU_FUSED_BN_BWD"),
     "attention": ("flash_attention", "DL4J_TPU_FLASH_ATTENTION"),
+    "paged_attention": ("paged_attention", "DL4J_TPU_PAGED_ATTENTION"),
 }
 
 _select_total = telemetry.counter(

@@ -1,0 +1,73 @@
+"""Token sampling — the one implementation behind every decode loop.
+
+Greedy / temperature / top-k next-token selection factored out of the
+benchmark decoders so the generative serving engine
+(:mod:`serving.generative`) and ``benchmarks/bench_charrnn.py`` sample
+through identical math. Everything here is jit-friendly: pure
+functions of ``(logits, key, temperature, top_k)`` with no Python
+branching on traced values, so one compiled decode step serves greedy
+and stochastic sequences side by side in the same batch.
+
+Conventions:
+
+- ``logits`` is ``[batch, vocab]`` (a single decode step's last-token
+  logits). ``temperature`` and ``top_k`` are per-row arrays (or
+  scalars broadcast to the batch), so heterogeneous requests batch
+  together without retracing.
+- ``temperature == 0`` means greedy (argmax) for that row — resolved
+  with ``jnp.where``, not Python ``if``, so it is trace-stable.
+- ``top_k == 0`` means "no top-k filter" (full distribution).
+- The PRNG key is threaded explicitly; callers split per step.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+#: additive score for filtered logits — matches ops.attention.NEG_INF
+#: (finite, so masked-everything rows degrade to uniform, not NaN)
+NEG_INF = -1e9
+
+
+def top_k_filter(logits, top_k):
+    """Keep each row's ``top_k`` largest logits, push the rest to
+    ``NEG_INF``. ``top_k`` is a per-row int array (0 = keep all).
+    Shape-stable: always sorts, always where-selects."""
+    logits = jnp.asarray(logits)
+    vocab = logits.shape[-1]
+    k = jnp.asarray(top_k, jnp.int32)
+    k = jnp.broadcast_to(k, logits.shape[:-1])
+    # threshold = k-th largest value per row (k clamped into [1, vocab])
+    kc = jnp.clip(k, 1, vocab)
+    sorted_desc = jnp.sort(logits, axis=-1)[..., ::-1]
+    thresh = jnp.take_along_axis(sorted_desc, kc[..., None] - 1,
+                                 axis=-1)
+    filtered = jnp.where(logits >= thresh, logits, NEG_INF)
+    return jnp.where(k[..., None] > 0, filtered, logits)
+
+
+def sample_logits(logits, key, temperature=1.0, top_k=0):
+    """Next-token ids ``[batch]`` from ``[batch, vocab]`` logits.
+
+    Per-row ``temperature`` (0 = greedy argmax) and ``top_k``
+    (0 = unfiltered). One fused program: greedy rows ride the same
+    compiled step as sampled rows via ``jnp.where`` — the property the
+    continuous decode batch depends on (no per-request retrace)."""
+    logits = jnp.asarray(logits)
+    temp = jnp.asarray(temperature, logits.dtype)
+    temp = jnp.broadcast_to(temp, logits.shape[:-1])
+    greedy_ids = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    # stochastic path: temperature-scale (guard the 0 rows — their
+    # result is discarded by the where), top-k filter, Gumbel trick
+    safe_temp = jnp.where(temp > 0, temp, 1.0)
+    scaled = logits / safe_temp[..., None]
+    scaled = top_k_filter(scaled, top_k)
+    sampled_ids = jax.random.categorical(key, scaled,
+                                         axis=-1).astype(jnp.int32)
+    return jnp.where(temp > 0, sampled_ids, greedy_ids)
+
+
+def greedy(logits):
+    """Pure argmax ids ``[batch]`` — the deterministic reference the
+    conformance gate compares paged decode against."""
+    return jnp.argmax(jnp.asarray(logits), axis=-1).astype(jnp.int32)

@@ -26,11 +26,21 @@ the raw ``.npy`` request/response path is zero-copy, ``mode="sharded"``
 between requests (``serving.residency``), and :class:`ServingRouter`
 fronts N replicas with least-loaded dispatch and fleet-wide
 warm-then-drain rollouts.
+
+Generative serving: a model with a ``prefill``/``decode_step``
+surface registered with ``generate={...}`` gets a paged KV-cache
+pool (:class:`KVBlockPool`) and a continuous-batching decode engine
+(:class:`DecodeEngine`) — ``POST /v1/models/<name>:generate`` streams
+tokens as chunked ndjson the moment they decode.
 """
 from deeplearning4j_tpu.serving.admission import (AdmissionController,
                                                   DeadlineExceeded,
                                                   ShedError)
 from deeplearning4j_tpu.serving.batcher import ServingBatcher
+from deeplearning4j_tpu.serving.generative import (DecodeEngine,
+                                                   TokenStream)
+from deeplearning4j_tpu.serving.kvcache import (KVBlockPool,
+                                                PoolExhausted)
 from deeplearning4j_tpu.serving.registry import (ModelRegistry,
                                                  ModelStatus,
                                                  ModelVersion)
@@ -41,4 +51,5 @@ __all__ = [
     "AdmissionController", "DeadlineExceeded", "ShedError",
     "ServingBatcher", "ModelRegistry", "ModelStatus", "ModelVersion",
     "InferenceServer", "ServingRouter",
+    "DecodeEngine", "TokenStream", "KVBlockPool", "PoolExhausted",
 ]

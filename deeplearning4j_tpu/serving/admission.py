@@ -259,14 +259,24 @@ class AdmissionController:
 
     # ------------------------------------------------------------------
     def admit(self, model: str,
-              deadline: Optional[float] = None) -> None:
+              deadline: Optional[float] = None, *,
+              cost: int = 1) -> None:
         """Admit one request for ``model`` or raise. Pair every
-        successful admit with a :meth:`release`.
+        successful admit with a :meth:`release` (same ``cost``).
+
+        ``cost`` is the request's weight against the in-flight budget
+        — 1 for a predict, the prompt's token-block cost for a
+        generative prefill (ISSUE 16: prefill is admitted by
+        *token-cost* through the same AIMD controller, so one long
+        prompt spends the budget many short ones would). An oversized
+        request is still admitted when the model is idle — otherwise a
+        prompt longer than the budget could never run.
 
         Raises :class:`DeadlineExceeded` when ``deadline`` (a
         ``time.monotonic()`` instant) is already past — the fast-fail
         path: an already-dead request must never occupy a slot.
         Raises :class:`ShedError` on drain or budget exhaustion."""
+        cost = max(1, int(cost))
         if deadline is not None and time.monotonic() >= deadline:
             _deadline_shed_counter().inc(model=model, where="admission")
             raise DeadlineExceeded(
@@ -276,34 +286,36 @@ class AdmissionController:
                 self._shed.inc(model=model, reason="draining")
                 raise ShedError("draining", self.retry_after_s)
             n = self._inflight.get(model, 0)
-            if n >= min(self._budget.get(model, self.max_queue),
-                        self.max_queue):
+            limit = min(self._budget.get(model, self.max_queue),
+                        self.max_queue)
+            if n >= limit or (n > 0 and n + cost > limit):
                 self._shed.inc(model=model, reason="queue_full")
                 rate = self._drain_rate_locked(model, time.monotonic())
                 retry = (self.retry_after_s if not rate else
                          min(RETRY_AFTER_CAP_S,
-                             max(self.retry_after_s, 1.0 / rate)))
+                             max(self.retry_after_s, cost / rate)))
                 raise ShedError("queue_full", retry)
-            self._inflight[model] = n + 1
-            self._gauge.set(n + 1, model=model)
+            self._inflight[model] = n + cost
+            self._gauge.set(n + cost, model=model)
 
-    def release(self, model: str) -> None:
+    def release(self, model: str, *, cost: int = 1) -> None:
         with self._lock:
-            n = max(0, self._inflight.get(model, 0) - 1)
+            n = max(0, self._inflight.get(model, 0) - max(1, int(cost)))
             self._inflight[model] = n
             self._gauge.set(n, model=model)
             if n == 0:
                 self._idle.notify_all()
 
     @contextmanager
-    def track(self, model: str, deadline: Optional[float] = None):
+    def track(self, model: str, deadline: Optional[float] = None, *,
+              cost: int = 1):
         """``admit``/``release`` around a request's whole lifetime
         (queue wait + compute + response)."""
-        self.admit(model, deadline)
+        self.admit(model, deadline, cost=cost)
         try:
             yield
         finally:
-            self.release(model)
+            self.release(model, cost=cost)
 
     # ------------------------------------------------------------------
     def drain(self, timeout: float = 30.0) -> bool:

@@ -79,11 +79,18 @@ class ServingBatcher(ParallelInference):
                  guard: Optional[RetraceGuard] = None,
                  flush_policy: str = "continuous",
                  mode: str = "dense",
-                 tensor_parallel: Optional[int] = None):
+                 tensor_parallel: Optional[int] = None,
+                 generate: Optional[dict] = None):
         #: generic path: no MLN `_forward` funnel — serve through the
         #: model's own `output(batch)` (SameDiff/ONNX adapters)
         self._generic = None if hasattr(model, "_forward") \
             else model.output
+        #: generative path: a model exposing the prefill/decode_step
+        #: contract gets a DecodeEngine beside the predict path
+        self._generative = (hasattr(model, "prefill")
+                            and hasattr(model, "decode_step"))
+        self.generate_config = dict(generate or {})
+        self.engine = None
         if not buckets:
             raise ValueError("need at least one warmup bucket")
         if flush_policy not in FLUSH_POLICIES:
@@ -91,7 +98,8 @@ class ServingBatcher(ParallelInference):
                              f"{FLUSH_POLICIES}, got {flush_policy!r}")
         from deeplearning4j_tpu.serving.residency import assert_mode
         assert_mode(mode)
-        if mode != "dense" and self._generic is not None:
+        if mode != "dense" and self._generic is not None \
+                and not self._generative:
             raise ValueError(
                 f"residency mode {mode!r} needs a param-tree model "
                 f"(MLN/ComputationGraph); generic output() models "
@@ -248,6 +256,102 @@ class ServingBatcher(ParallelInference):
                         stage="warmup")
         self._warmed = True
         return time.perf_counter() - t_all
+
+    # -- generative path (ISSUE 16) ------------------------------------
+    @property
+    def is_generative(self) -> bool:
+        return self._generative
+
+    def _ensure_generate(self):
+        """Build the KV pool + DecodeEngine on first use. Residency
+        modes compose: under ``sharded``/``fsdp`` the model's params
+        are placed resident-sharded (``serving.residency``) and the
+        engine's jitted programs consume them through the serving
+        param view — the KV pool itself stays dense-replicated (every
+        chip decodes every sequence, classifier-serving style)."""
+        if not self._generative:
+            raise ValueError(f"model {self.name!r} has no "
+                             f"prefill/decode_step surface")
+        if self.engine is not None:
+            return self.engine
+        import functools
+
+        from deeplearning4j_tpu.serving.generative import DecodeEngine
+        from deeplearning4j_tpu.serving.kvcache import KVBlockPool
+        cfg = self.generate_config
+        m = self.model
+        if getattr(m, "params", None) is None:
+            m.init()
+        c = m.conf
+        pool = KVBlockPool(
+            c.n_layers,
+            int(cfg.get("kv_blocks", 64)),
+            int(cfg.get("kv_block_size", 16)),
+            c.n_heads, c.head_dim,
+            dtype=cfg.get("kv_dtype", np.float32), name=self.name)
+        params, view_fn = m.params, None
+        if self.mode != "dense":
+            from deeplearning4j_tpu.serving.residency import (
+                serving_layouts, serving_param_view)
+            placed, fsdp_specs, tp_specs = serving_layouts(
+                self.mesh, m.params, self.mode, self.tensor_parallel,
+                name=self.name)
+            self._serve_params = placed
+            self._fsdp_specs = fsdp_specs
+            self._serve_tp_specs = tp_specs
+            params = placed
+            view_fn = functools.partial(
+                serving_param_view, fsdp_specs=fsdp_specs,
+                mesh=self.mesh, tp_specs=tp_specs, mode=self.mode)
+        self.engine = DecodeEngine(
+            m, params, pool, view_fn=view_fn, name=self.name,
+            prompt_buckets=cfg.get("prompt_buckets", (16, 64)),
+            decode_buckets=cfg.get("decode_buckets", (4, 8)),
+            max_seq_len=cfg.get("max_seq_len"),
+            paged=cfg.get("paged"), guard=self.guard,
+            rng_seed=int(cfg.get("rng_seed", 0)))
+        return self.engine
+
+    def warmup_generate(self) -> float:
+        """Compile every prefill/commit/decode bucket program before
+        the first real generate request (the generative half of
+        :meth:`warmup`). Returns warmup seconds."""
+        engine = self._ensure_generate()
+        lat = _latency()
+        t0 = time.perf_counter()
+        with telemetry.span("serving.warmup_generate",
+                            model=self.name):
+            secs = engine.warmup()
+        lat.observe(secs, model=self.name, stage="warmup")
+        self._warmed = True
+        return time.perf_counter() - t0
+
+    def generate_cost(self, prompt_len: int, max_tokens: int = 0
+                      ) -> int:
+        """Token-cost of a generate admission (KV blocks)."""
+        return self._ensure_generate().generate_cost(prompt_len,
+                                                     max_tokens)
+
+    def submit_generate(self, prompt, max_tokens: int, *,
+                        temperature: float = 0.0, top_k: int = 0,
+                        deadline: Optional[float] = None):
+        """Enqueue a generate request; returns the
+        :class:`~deeplearning4j_tpu.serving.generative.TokenStream`.
+        Raises PoolExhausted synchronously when the KV pool cannot
+        hold the prompt (shed upstream as 429 + Retry-After)."""
+        engine = self._ensure_generate()
+        telemetry.counter(
+            "dl4j_inference_requests_total",
+            "requests submitted to ParallelInference").inc(
+                mode="generate")
+        return engine.submit(prompt, max_tokens,
+                             temperature=temperature, top_k=top_k,
+                             deadline=deadline)
+
+    def shutdown(self, *a, **kw):
+        if self.engine is not None:
+            self.engine.shutdown()
+        return super().shutdown(*a, **kw)
 
     # ------------------------------------------------------------------
     def output_batched(self, requests: List) -> List[np.ndarray]:

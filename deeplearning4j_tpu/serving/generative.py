@@ -1,0 +1,620 @@
+"""Continuous-batching generative decode engine.
+
+The Orca insight applied to the serving stack: autoregressive decode
+is *iteration-level* work — the scheduling unit is one token step over
+all live sequences, not one request. This module owns that loop:
+
+- **Prefill**: a new request's prompt runs full causal attention
+  (through ``sdpa_core``, so the flash-attention ladder applies) on a
+  per-prompt-bucket compiled program, its K/V scattered into the paged
+  :class:`~deeplearning4j_tpu.serving.kvcache.KVBlockPool`, and its
+  first token sampled — the time-to-first-token span.
+- **Decode**: every engine iteration runs ONE fused step over all live
+  sequences — gather KV blocks via block tables, paged attention
+  (Pallas kernel or dense-gather fallback via the ``paged_attention``
+  kernel-select family), sample, append — compiled once per decode
+  bucket, so steady state never retraces while sequences join and
+  leave mid-batch (the zero-post-warmup-retrace acceptance bar).
+- **Retire**: a sequence leaves on EOS / ``max_tokens`` / client
+  disconnect / deadline, and its blocks return to the pool *mid-batch*
+  — the remaining sequences keep decoding, the freed blocks admit the
+  next prefill.
+
+Consumers read a :class:`TokenStream`: a queue the engine thread
+pushes token ids into as they decode — the producer side of the HTTP
+chunked-transfer streaming in ``serving.server``. Cancelling the
+stream (client disconnect) retires the sequence on the next
+iteration.
+
+Dispatch signatures are recorded into the batcher's ``RetraceGuard``,
+so ``retraces_since_warmup() == 0`` covers the generative path with
+the same proof obligation as predict.
+"""
+from __future__ import annotations
+
+import itertools
+import queue as _queue
+import threading
+import time
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+
+from deeplearning4j_tpu.common import telemetry
+from deeplearning4j_tpu.common.compilecache import RetraceGuard
+from deeplearning4j_tpu.serving.admission import DeadlineExceeded
+from deeplearning4j_tpu.serving.kvcache import KVBlockPool
+
+#: terminal reasons a TokenStream closes with
+END_REASONS = ("eos", "max_tokens", "cancelled", "deadline", "kv_pool",
+               "error")
+
+
+def _ttft_hist() -> telemetry.Histogram:
+    return telemetry.histogram(
+        "dl4j_generate_ttft_seconds",
+        "time-to-first-token of generate requests: submit -> first "
+        "sampled token (prefill queue + prefill compute), per model "
+        "(seconds)")
+
+
+def _intertoken_hist() -> telemetry.Histogram:
+    return telemetry.histogram(
+        "dl4j_generate_intertoken_seconds",
+        "gap between consecutive streamed tokens of one sequence — "
+        "the decode-iteration latency a streaming client experiences "
+        "(seconds)")
+
+
+def _tokens_counter() -> telemetry.Counter:
+    return telemetry.counter(
+        "dl4j_generate_tokens_total",
+        "tokens decoded and streamed, per model — the goodput "
+        "numerator")
+
+
+def _requests_counter() -> telemetry.Counter:
+    return telemetry.counter(
+        "dl4j_generate_requests_total",
+        "generate requests finished, by model and outcome (eos | "
+        "max_tokens | cancelled | deadline | kv_pool | error)")
+
+
+def _live_gauge() -> telemetry.Gauge:
+    return telemetry.gauge(
+        "dl4j_generate_live_sequences",
+        "sequences currently in the continuous decode batch, per "
+        "model")
+
+
+def _disconnects_counter() -> telemetry.Counter:
+    return telemetry.counter(
+        "dl4j_generate_stream_disconnects_total",
+        "generate streams cancelled mid-decode by client disconnect — "
+        "their KV blocks return to the pool on the next iteration")
+
+
+class TokenStream:
+    """Consumer handle of one generate request: iterate token ids as
+    the engine decodes them; ``reason`` tells how the sequence ended.
+    ``cancel()`` (client disconnect) retires the sequence and frees
+    its KV blocks on the engine's next iteration."""
+
+    _DONE = object()
+
+    def __init__(self, seq_id: int, prompt_len: int):
+        self.seq_id = seq_id
+        self.prompt_len = prompt_len
+        self.reason: Optional[str] = None
+        self.error: Optional[BaseException] = None
+        self.cancelled = False
+        self._q: "_queue.Queue" = _queue.Queue()
+
+    # engine side ------------------------------------------------------
+    def _put(self, token: int) -> None:
+        self._q.put(int(token))
+
+    def _close(self, reason: str,
+               error: Optional[BaseException] = None) -> None:
+        if self.reason is None:
+            self.reason = reason
+            self.error = error
+            self._q.put(self._DONE)
+
+    # consumer side ----------------------------------------------------
+    def cancel(self) -> None:
+        self.cancelled = True
+
+    def __iter__(self):
+        while True:
+            item = self._q.get()
+            if item is self._DONE:
+                if self.error is not None:
+                    raise self.error
+                return
+            yield item
+
+    def next(self, timeout: Optional[float] = None) -> Optional[int]:
+        """The next token id, or None when the stream has closed
+        (check ``reason``). Raises the stream error on a failed
+        sequence, ``queue.Empty`` on timeout — the server's per-token
+        wait primitive."""
+        item = self._q.get(timeout=timeout)
+        if item is self._DONE:
+            if self.error is not None:
+                raise self.error
+            return None
+        return item
+
+    def tokens(self, timeout: Optional[float] = None) -> List[int]:
+        """Drain the whole stream (blocking); raises the stream error
+        if the sequence failed."""
+        out: List[int] = []
+        deadline = None if timeout is None else (time.monotonic()
+                                                 + timeout)
+        while True:
+            left = None if deadline is None \
+                else max(0.0, deadline - time.monotonic())
+            item = self._q.get(timeout=left)
+            if item is self._DONE:
+                if self.error is not None:
+                    raise self.error
+                return out
+            out.append(item)
+
+
+class _Sequence:
+    """Engine-internal live-sequence state."""
+
+    __slots__ = ("seq_id", "stream", "next_token", "position",
+                 "generated", "max_tokens", "temperature", "top_k",
+                 "deadline", "t_last")
+
+    def __init__(self, seq_id, stream, next_token, position,
+                 max_tokens, temperature, top_k, deadline, t_last):
+        self.seq_id = seq_id
+        self.stream = stream
+        self.next_token = int(next_token)   # fed to the next step
+        self.position = int(position)       # its index in the sequence
+        self.generated = 1                  # the prefill-sampled token
+        self.max_tokens = int(max_tokens)
+        self.temperature = float(temperature)
+        self.top_k = int(top_k)
+        self.deadline = deadline
+        self.t_last = t_last                # last token emit instant
+
+
+class DecodeEngine:
+    """The prefill/decode continuous-batching loop over one model.
+
+    ``model`` exposes the :class:`~deeplearning4j_tpu.models.decoder.
+    DecoderLM` contract (``prefill`` / ``decode_step`` / ``conf``);
+    ``params`` is the (possibly resident-sharded) tree the jitted
+    programs consume, ``view_fn`` the in-jit params adapter
+    (``serving.residency.serving_param_view`` partial, or None for
+    dense). One compiled program per prompt bucket (prefill+commit)
+    and per decode bucket; ``warmup()`` compiles them all so the guard
+    count freezes before the first real request."""
+
+    def __init__(self, model, params, pool: KVBlockPool, *,
+                 view_fn=None, name: str = "model",
+                 prompt_buckets: Sequence[int] = (16, 64),
+                 decode_buckets: Sequence[int] = (4, 8),
+                 max_seq_len: Optional[int] = None,
+                 paged: Optional[bool] = None,
+                 guard: Optional[RetraceGuard] = None,
+                 rng_seed: int = 0):
+        self.model = model
+        self.params = params
+        self.pool = pool
+        self.view_fn = view_fn
+        self.name = name
+        self.prompt_buckets = tuple(sorted(int(b)
+                                           for b in set(prompt_buckets)))
+        self.decode_buckets = tuple(sorted(int(b)
+                                           for b in set(decode_buckets)))
+        cap = pool.usable_blocks * pool.block_size
+        self.max_seq_len = int(min(max_seq_len or model.conf.max_len,
+                                   model.conf.max_len, cap))
+        #: fixed block-table width — part of every decode signature
+        self.max_blocks = pool.blocks_for(self.max_seq_len)
+        self.guard = guard if guard is not None else RetraceGuard(
+            f"generate:{name}",
+            threshold=len(self.prompt_buckets)
+            + len(self.decode_buckets) + 2)
+        self._paged = paged
+        self._seq_ids = itertools.count(1)
+        self._pending: "_queue.Queue" = _queue.Queue()
+        self._live: Dict[int, _Sequence] = {}
+        self._lock = threading.Lock()
+        self._worker: Optional[threading.Thread] = None
+        self._work = threading.Event()
+        self._shutdown = False
+        self._step = 0
+        self._warmed = False
+        self.warm_signatures = 0
+        self._jits: dict = {}
+        import jax
+        self._rng = jax.random.PRNGKey(rng_seed)
+
+    # -- compiled programs ---------------------------------------------
+    def _paged_now(self) -> bool:
+        """Resolve the paged-vs-dense decode backend once per compile
+        (trace-time, like every kernel_select decision)."""
+        if self._paged is not None:
+            return bool(self._paged)
+        from deeplearning4j_tpu.ops.attention_pallas import \
+            select_paged_backend
+        backend, _ = select_paged_backend(1, self.max_blocks)
+        return backend == "paged"
+
+    def _view(self, params):
+        return self.view_fn(params) if self.view_fn is not None \
+            else params
+
+    def _prefill_jit(self):
+        import jax
+        if "prefill" not in self._jits:
+            def fn(params, tokens, length):
+                return self.model.prefill(self._view(params), tokens,
+                                          length)
+            self._jits["prefill"] = jax.jit(fn)
+        return self._jits["prefill"]
+
+    def _commit_jit(self):
+        import jax
+        if "commit" not in self._jits:
+            def fn(kp, vp, k, v, slots):
+                nl, nb, bs = kp.shape[0], kp.shape[1], kp.shape[2]
+                tail = kp.shape[3:]
+                kf = kp.reshape((nl, nb * bs) + tail)
+                vf = vp.reshape((nl, nb * bs) + tail)
+                kf = kf.at[:, slots].set(k[:, 0])
+                vf = vf.at[:, slots].set(v[:, 0])
+                return (kf.reshape(kp.shape), vf.reshape(vp.shape))
+            self._jits["commit"] = jax.jit(fn)
+        return self._jits["commit"]
+
+    def _sample_jit(self):
+        import jax
+        if "sample" not in self._jits:
+            from deeplearning4j_tpu.ops.sampling import sample_logits
+            self._jits["sample"] = jax.jit(sample_logits)
+        return self._jits["sample"]
+
+    def _decode_jit(self):
+        import jax
+
+        from deeplearning4j_tpu.ops.sampling import sample_logits
+        if "decode" not in self._jits:
+            paged = self._paged_now()
+
+            def fn(params, kp, vp, tokens, positions, tables, key,
+                   temps, topks):
+                logits, kp, vp = self.model.decode_step(
+                    self._view(params), tokens, positions, kp, vp,
+                    tables, paged=paged)
+                ids = sample_logits(logits, key, temps, topks)
+                return ids, kp, vp
+            self._jits["decode"] = jax.jit(fn)
+        return self._jits["decode"]
+
+    # -- warmup --------------------------------------------------------
+    def warmup(self) -> float:
+        """Compile every prompt bucket's prefill+commit and every
+        decode bucket's fused step (dummy data, blocked to
+        completion). The guard count freezes here — any later new
+        signature is a bucket miss."""
+        import jax
+        t0 = time.perf_counter()
+        for t in self.prompt_buckets:
+            tokens = np.zeros((1, t), np.int32)
+            length = np.asarray([1], np.int32)
+            self.guard.record(tokens, length)
+            last, k, v = self._prefill_jit()(self.params, tokens,
+                                             length)
+            slots = np.zeros((t,), np.int32)
+            self.guard.record(k, slots)
+            kp, vp = self._commit_jit()(self.pool.k, self.pool.v, k, v,
+                                        slots)
+            # the first-token sampler compiles once here (its [1,
+            # vocab] signature never varies with the prompt bucket)
+            first = self._sample_jit()(
+                last, jax.random.fold_in(self._rng, 0),
+                np.zeros((1,), np.float32), np.zeros((1,), np.int32))
+            jax.block_until_ready((last, kp, vp, first))
+            # scratch-block writes only: pool arrays unchanged where
+            # it matters, but keep the functional update discipline
+            self.pool.update_arrays(kp, vp)
+        for b in self.decode_buckets:
+            tokens = np.zeros((b,), np.int32)
+            positions = np.zeros((b,), np.int32)
+            tables = np.zeros((b, self.max_blocks), np.int32)
+            temps = np.zeros((b,), np.float32)
+            topks = np.zeros((b,), np.int32)
+            self.guard.record(tokens, positions, tables, temps, topks)
+            import jax as _jax
+            key = _jax.random.fold_in(self._rng, 0)
+            ids, kp, vp = self._decode_jit()(
+                self.params, self.pool.k, self.pool.v, tokens,
+                positions, tables, key, temps, topks)
+            jax.block_until_ready(ids)
+            self.pool.update_arrays(kp, vp)
+        self._warmed = True
+        self.warm_signatures = self.guard.n_signatures
+        return time.perf_counter() - t0
+
+    def retraces_since_warmup(self) -> int:
+        """Distinct signatures compiled after warmup — must stay 0 in
+        steady state across any join/leave churn (the zero-retrace
+        proof for the decode loop)."""
+        return self.guard.n_signatures - self.warm_signatures
+
+    # -- request intake ------------------------------------------------
+    def generate_cost(self, prompt_len: int, max_tokens: int = 0
+                      ) -> int:
+        """Admission cost of a generate request: the KV blocks its
+        prompt occupies (token-cost admission — a long prompt spends
+        the AIMD budget many short ones would)."""
+        return self.pool.blocks_for(int(prompt_len) + int(max_tokens))
+
+    def submit(self, prompt, max_tokens: int, *,
+               temperature: float = 0.0, top_k: int = 0,
+               deadline: Optional[float] = None) -> TokenStream:
+        """Enqueue a generate request. Allocates the prompt's KV
+        blocks synchronously — :class:`~deeplearning4j_tpu.serving.
+        kvcache.PoolExhausted` (HTTP 429 upstream) raises HERE, before
+        the caller starts streaming. Returns the token stream."""
+        prompt = np.asarray(prompt, np.int32).reshape(-1)
+        if prompt.size < 1:
+            raise ValueError("prompt must carry at least one token")
+        if prompt.size >= self.max_seq_len:
+            raise ValueError(
+                f"prompt of {prompt.size} tokens >= max_seq_len "
+                f"{self.max_seq_len}")
+        if deadline is not None and time.monotonic() >= deadline:
+            raise DeadlineExceeded(
+                "deadline already expired at generate submit")
+        max_tokens = int(min(max_tokens,
+                             self.max_seq_len - prompt.size))
+        seq_id = next(self._seq_ids)
+        # reserve the prompt's blocks NOW: exhaustion is a synchronous
+        # shed, not a mid-stream surprise
+        self.pool.alloc(seq_id, int(prompt.size))
+        stream = TokenStream(seq_id, int(prompt.size))
+        with self._lock:
+            self._ensure_worker()
+            self._pending.put((seq_id, prompt, max_tokens,
+                               float(temperature), int(top_k),
+                               deadline, stream, time.monotonic()))
+        self._work.set()
+        return stream
+
+    def _ensure_worker(self):
+        if self._worker is not None:
+            return
+        self._shutdown = False
+        self._worker = threading.Thread(target=self._loop, daemon=True,
+                                        name=f"dl4j-generate-"
+                                             f"{self.name}")
+        self._worker.start()
+
+    def shutdown(self, timeout: float = 30.0):
+        self._shutdown = True
+        self._work.set()
+        w = self._worker
+        if w is not None:
+            w.join(timeout)
+            self._worker = None
+
+    # -- the continuous loop -------------------------------------------
+    def _loop(self):
+        while not self._shutdown:
+            # Clear BEFORE draining: a submit that lands after the
+            # drain re-sets the event, so the wait below returns
+            # immediately instead of losing the wake-up.
+            self._work.clear()
+            admitted = self._admit_pending()
+            stepped = self._decode_iteration()
+            if not admitted and not stepped:
+                # Idle: block until a submit wakes us (bounded so
+                # queued deadline/cancel checks still tick over).
+                self._work.wait(0.05)
+
+    def _admit_pending(self) -> bool:
+        """Prefill every queued request (each its own bucket-padded
+        pass), then join it to the decode batch."""
+        admitted = False
+        while True:
+            try:
+                item = self._pending.get_nowait()
+            except _queue.Empty:
+                return admitted
+            admitted = True
+            (seq_id, prompt, max_tokens, temperature, top_k, deadline,
+             stream, t_submit) = item
+            if stream.cancelled or (deadline is not None
+                                    and time.monotonic() >= deadline):
+                reason = "cancelled" if stream.cancelled else "deadline"
+                self.pool.free(seq_id)
+                self._finish(stream, reason)
+                continue
+            try:
+                self._prefill_one(seq_id, prompt, max_tokens,
+                                  temperature, top_k, deadline, stream,
+                                  t_submit)
+            except BaseException as e:      # noqa: BLE001
+                self.pool.free(seq_id)
+                self._finish(stream, "error", e)
+
+    def _prompt_bucket(self, n: int) -> int:
+        for b in self.prompt_buckets:
+            if n <= b:
+                return b
+        return n                    # oversized prompt: cold compile
+
+    def _prefill_one(self, seq_id, prompt, max_tokens, temperature,
+                     top_k, deadline, stream, t_submit):
+        import jax
+
+        from deeplearning4j_tpu.ops.sampling import sample_logits
+        t = self._prompt_bucket(prompt.size)
+        tokens = np.zeros((1, t), np.int32)
+        tokens[0, :prompt.size] = prompt
+        length = np.asarray([prompt.size], np.int32)
+        self._record(tokens, length)
+        with telemetry.span("generate.prefill", model=self.name,
+                            tokens=int(prompt.size)):
+            last, k, v = self._prefill_jit()(self.params, tokens,
+                                             length)
+            # scatter the prompt's K/V into its pool blocks (padded
+            # positions land in scratch block 0)
+            table = self.pool.table(seq_id)
+            idx = np.arange(t)
+            slots = np.where(
+                idx < prompt.size,
+                np.asarray(table, np.int64)[
+                    np.minimum(idx // self.pool.block_size,
+                               len(table) - 1)]
+                * self.pool.block_size + idx % self.pool.block_size,
+                0).astype(np.int32)
+            self._record(k, slots)
+            kp, vp = self._commit_jit()(self.pool.k, self.pool.v, k, v,
+                                        slots)
+            self.pool.update_arrays(kp, vp)
+            self._step += 1
+            key = jax.random.fold_in(self._rng, self._step)
+            first = int(np.asarray(self._sample_jit()(
+                last, key,
+                np.asarray([temperature], np.float32),
+                np.asarray([top_k], np.int32)))[0])
+        now = time.monotonic()
+        _ttft_hist().observe(now - t_submit, model=self.name)
+        stream._put(first)
+        _tokens_counter().inc(model=self.name)
+        eos = self.model.conf.eos_id
+        if first == eos or max_tokens <= 1:
+            self.pool.free(seq_id)
+            self._finish(stream,
+                         "eos" if first == eos else "max_tokens")
+            return
+        self._live[seq_id] = _Sequence(
+            seq_id, stream, first, int(prompt.size), max_tokens,
+            temperature, top_k, deadline, now)
+        _live_gauge().set(len(self._live), model=self.name)
+
+    def _decode_bucket(self, n: int) -> int:
+        for b in self.decode_buckets:
+            if n <= b:
+                return b
+        return self.decode_buckets[-1]
+
+    def _retire(self, seq: _Sequence, reason: str,
+                error: Optional[BaseException] = None) -> None:
+        self._live.pop(seq.seq_id, None)
+        freed = self.pool.free(seq.seq_id)
+        del freed
+        self._finish(seq.stream, reason, error)
+        _live_gauge().set(len(self._live), model=self.name)
+
+    def _finish(self, stream: TokenStream, reason: str,
+                error: Optional[BaseException] = None) -> None:
+        stream._close(reason, error)
+        if reason == "cancelled":
+            _disconnects_counter().inc(model=self.name)
+        _requests_counter().inc(model=self.name, outcome=reason)
+
+    def _decode_iteration(self) -> bool:
+        """ONE fused step over all live sequences (the iteration of
+        iteration-level scheduling). Returns False when idle."""
+        import jax
+        if not self._live:
+            return False
+        now = time.monotonic()
+        # pre-step retirement: cancelled / deadline sequences leave
+        # and their blocks free before we spend device time
+        for seq in list(self._live.values()):
+            if seq.stream.cancelled:
+                self._retire(seq, "cancelled")
+            elif seq.deadline is not None and now >= seq.deadline:
+                self._retire(seq, "deadline")
+        if not self._live:
+            return True
+        # grow every sequence by one token slot; a pool with no free
+        # block sheds THAT sequence mid-batch, the rest keep decoding
+        from deeplearning4j_tpu.serving.kvcache import PoolExhausted
+        for seq in list(self._live.values()):
+            try:
+                self.pool.extend(seq.seq_id, 1)
+            except PoolExhausted as e:
+                self._retire(seq, "kv_pool", e)
+        if not self._live:
+            return True
+        seqs = list(self._live.values())[:self.decode_buckets[-1]]
+        b = self._decode_bucket(len(seqs))
+        tokens = np.zeros((b,), np.int32)
+        positions = np.zeros((b,), np.int32)
+        tables = np.zeros((b, self.max_blocks), np.int32)
+        temps = np.zeros((b,), np.float32)
+        topks = np.zeros((b,), np.int32)
+        for i, seq in enumerate(seqs):
+            tokens[i] = seq.next_token
+            positions[i] = seq.position
+            tables[i] = self.pool.padded_table(seq.seq_id,
+                                               self.max_blocks)
+            temps[i] = seq.temperature
+            topks[i] = seq.top_k
+        self._record(tokens, positions, tables, temps, topks)
+        self._step += 1
+        key = jax.random.fold_in(self._rng, self._step)
+        t0 = time.perf_counter()
+        with telemetry.span("generate.decode_step", model=self.name,
+                            live=len(seqs), bucket=b):
+            ids, kp, vp = self._decode_jit()(
+                self.params, self.pool.k, self.pool.v, tokens,
+                positions, tables, key, temps, topks)
+            ids = np.asarray(ids)
+        self.pool.update_arrays(kp, vp)
+        if telemetry.enabled():
+            telemetry.histogram(
+                "dl4j_generate_decode_step_seconds",
+                "wall time of one fused decode iteration over the "
+                "live batch (gather + paged attention + sample + "
+                "append), per model (seconds)").observe(
+                    time.perf_counter() - t0, model=self.name)
+            telemetry.histogram(
+                "dl4j_serving_batch_occupancy",
+                "live rows / bucket-padded rows per serving flush — "
+                "how full the warm buckets actually run (1.0 = no "
+                "padding waste; continuous batching should push this "
+                "up under load)",
+                buckets=telemetry.RATIO_BUCKETS).observe(
+                    len(seqs) / max(1, b), model=self.name,
+                    policy="decode")
+        now = time.monotonic()
+        eos = self.model.conf.eos_id
+        for i, seq in enumerate(seqs):
+            tok = int(ids[i])
+            seq.stream._put(tok)
+            _tokens_counter().inc(model=self.name)
+            _intertoken_hist().observe(now - seq.t_last,
+                                       model=self.name)
+            seq.t_last = now
+            seq.position += 1
+            seq.next_token = tok
+            seq.generated += 1
+            if tok == eos:
+                self._retire(seq, "eos")
+            elif seq.generated >= seq.max_tokens:
+                self._retire(seq, "max_tokens")
+        return True
+
+    def _record(self, *arrays) -> None:
+        hit = self.guard.record(*arrays)
+        if self._warmed and not hit:
+            telemetry.counter(
+                "dl4j_serving_bucket_miss_total",
+                "post-warmup flushes whose padded signature no warm "
+                "bucket covered — a cold XLA compile on the serving "
+                "path (shape/dtype drift, or grow the bucket set)"
+            ).inc(model=self.name)

@@ -1,0 +1,278 @@
+"""Paged KV-cache residency for generative serving.
+
+The decode phase of autoregressive inference is bound by KV-cache
+memory, not FLOPs: every live sequence keeps ``2 * layers * len *
+heads * head_dim`` activations resident between tokens. Allocating
+that per-request as contiguous max-length tensors wastes HBM on the
+gap between a sequence's current length and its ``max_tokens`` —
+the fragmentation paged attention (vLLM) eliminates. This module is
+that allocator for the TPU stack:
+
+- One preallocated device array pair per pool — ``k`` / ``v`` shaped
+  ``[n_layers, num_blocks, block_size, n_heads, head_dim]`` — carved
+  into fixed-size **blocks** of ``block_size`` token slots. A per-layer
+  view ``pool.k[l]`` is the ``[num_blocks, block, heads, head_dim]``
+  paged layout the decode kernel gathers through.
+- A **block table** per sequence: the ordered list of block ids
+  holding its tokens. Block ids are shared across layers (layer ``l``
+  of token ``t`` lives at ``k[l, table[t // block_size],
+  t % block_size]``), so the table is one small int array per
+  sequence, not one per layer.
+- **Block 0 is reserved scratch**: padded decode-batch rows (slots
+  with no live sequence) write their dummy KV there, so the fused
+  step never branches on liveness for the write. It is never handed
+  to a sequence.
+- alloc/extend/free with occupancy accounting: gauges
+  ``dl4j_kv_pool_blocks{state=free|live}`` / ``dl4j_kv_pool_bytes``,
+  exhaustion counted into ``dl4j_kv_pool_shed_total`` and raised as
+  :class:`PoolExhausted` (a :class:`ShedError` — HTTP 429 with a
+  drain-rate-measured ``Retry-After`` upstream).
+
+The pool's device bytes are a first-class **resident class** in
+``diagnostics.memory_report`` (next to params / updater state), looked
+up lazily via ``sys.modules`` so diagnostics keeps zero import edges
+into serving. ``pool_report()`` is that join point; the report numbers
+reconcile exactly with the gauges (same ``nbytes`` source).
+"""
+from __future__ import annotations
+
+import threading
+import weakref
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from deeplearning4j_tpu.common import telemetry
+from deeplearning4j_tpu.serving.admission import ShedError
+
+#: live pools, for memory_report / pool_report (weak: a retired pool
+#: must not be kept resident by the diagnostics join)
+_pools: "weakref.WeakSet[KVBlockPool]" = weakref.WeakSet()
+
+
+class PoolExhausted(ShedError):
+    """The KV pool has no free block for an alloc/extend — the
+    generative analog of a full admission queue: shed (HTTP 429) with
+    a measured ``Retry-After`` instead of queueing unboundedly."""
+
+    def __init__(self, retry_after_s: float = 1.0):
+        super().__init__("kv_pool", retry_after_s)
+
+
+def _blocks_gauge() -> telemetry.Gauge:
+    return telemetry.gauge(
+        "dl4j_kv_pool_blocks",
+        "KV-cache pool blocks by state (free | live) per pool — "
+        "occupancy = live / (live + free); block 0 is reserved "
+        "scratch and counted in neither state")
+
+
+def _bytes_gauge() -> telemetry.Gauge:
+    return telemetry.gauge(
+        "dl4j_kv_pool_bytes",
+        "preallocated device bytes of a KV-cache pool (k + v arrays; "
+        "constant for the pool's lifetime — paged residency means "
+        "occupancy moves, allocation does not)")
+
+
+def _shed_counter() -> telemetry.Counter:
+    return telemetry.counter(
+        "dl4j_kv_pool_shed_total",
+        "generative requests shed because the KV pool had no free "
+        "block (HTTP 429 + measured Retry-After upstream)")
+
+
+class KVBlockPool:
+    """A paged KV-cache pool: preallocated k/v device arrays plus the
+    host-side block allocator.
+
+    ``alloc(seq_id, n_tokens)`` reserves the block-table for a new
+    sequence, ``extend(seq_id)`` grows it one token (chaining a new
+    block at each ``block_size`` boundary), ``free(seq_id)`` returns
+    every block to the free list — callable mid-batch, which is the
+    whole point of iteration-level scheduling. The device arrays are
+    functional values: the jitted decode step consumes ``pool.k`` /
+    ``pool.v`` and the engine stores the updated arrays back with
+    :meth:`update_arrays`.
+    """
+
+    def __init__(self, n_layers: int, num_blocks: int,
+                 block_size: int, n_heads: int, head_dim: int, *,
+                 dtype=np.float32, name: str = "model",
+                 device_arrays: bool = True):
+        if num_blocks < 2:
+            raise ValueError("num_blocks must be >= 2 (block 0 is "
+                             "reserved scratch)")
+        self.n_layers = int(n_layers)
+        self.num_blocks = int(num_blocks)
+        self.block_size = int(block_size)
+        self.n_heads = int(n_heads)
+        self.head_dim = int(head_dim)
+        self.name = name
+        shape = (self.n_layers, self.num_blocks, self.block_size,
+                 self.n_heads, self.head_dim)
+        if device_arrays:
+            import jax.numpy as jnp
+            self.k = jnp.zeros(shape, dtype=dtype)
+            self.v = jnp.zeros(shape, dtype=dtype)
+        else:               # allocator-only pool (tests, sizing math)
+            self.k = np.zeros(shape, dtype=dtype)
+            self.v = np.zeros(shape, dtype=dtype)
+        self._lock = threading.RLock()
+        #: free block ids, LIFO (block 0 reserved — see module doc)
+        self._free: List[int] = list(range(self.num_blocks - 1, 0, -1))
+        self._tables: Dict[object, List[int]] = {}
+        self._lengths: Dict[object, int] = {}
+        _pools.add(self)
+        if telemetry.enabled():
+            _bytes_gauge().set(self.pool_bytes, pool=self.name)
+            self._export_occupancy()
+
+    # -- sizing ---------------------------------------------------------
+    @property
+    def pool_bytes(self) -> int:
+        """Preallocated device bytes (k + v) — the resident class."""
+        return int(self.k.nbytes) + int(self.v.nbytes)
+
+    @property
+    def usable_blocks(self) -> int:
+        return self.num_blocks - 1          # minus the scratch block
+
+    def blocks_for(self, n_tokens: int) -> int:
+        """Blocks a sequence of ``n_tokens`` occupies (ceil)."""
+        return max(1, -(-int(n_tokens) // self.block_size))
+
+    # -- occupancy ------------------------------------------------------
+    @property
+    def free_blocks(self) -> int:
+        with self._lock:
+            return len(self._free)
+
+    @property
+    def live_blocks(self) -> int:
+        with self._lock:
+            return sum(len(t) for t in self._tables.values())
+
+    @property
+    def occupancy(self) -> float:
+        """live / usable, in [0, 1]."""
+        return self.live_blocks / max(1, self.usable_blocks)
+
+    @property
+    def live_sequences(self) -> int:
+        with self._lock:
+            return len(self._tables)
+
+    def _export_occupancy(self) -> None:
+        if not telemetry.enabled():
+            return
+        g = _blocks_gauge()
+        g.set(len(self._free), pool=self.name, state="free")
+        g.set(sum(len(t) for t in self._tables.values()),
+              pool=self.name, state="live")
+
+    # -- lifecycle ------------------------------------------------------
+    def alloc(self, seq_id, n_tokens: int) -> List[int]:
+        """Reserve blocks for a new sequence of ``n_tokens`` prompt
+        tokens. Raises :class:`PoolExhausted` (counting the shed)
+        without partial allocation when the pool cannot hold it."""
+        need = self.blocks_for(n_tokens)
+        with self._lock:
+            if seq_id in self._tables:
+                raise ValueError(f"sequence {seq_id!r} already has a "
+                                 f"block table")
+            if need > len(self._free):
+                _shed_counter().inc(pool=self.name)
+                raise PoolExhausted()
+            blocks = [self._free.pop() for _ in range(need)]
+            self._tables[seq_id] = blocks
+            self._lengths[seq_id] = int(n_tokens)
+            self._export_occupancy()
+            return list(blocks)
+
+    def extend(self, seq_id, n_tokens: int = 1) -> List[int]:
+        """Grow a sequence by ``n_tokens`` (decode appends one per
+        step), chaining new block-table entries across ``block_size``
+        boundaries. Returns the current table. On exhaustion raises
+        :class:`PoolExhausted` with the sequence's existing blocks
+        intact (the caller decides whether to retire it)."""
+        with self._lock:
+            if seq_id not in self._tables:
+                raise KeyError(f"unknown sequence {seq_id!r}")
+            new_len = self._lengths[seq_id] + int(n_tokens)
+            need = self.blocks_for(new_len) - len(self._tables[seq_id])
+            if need > len(self._free):
+                _shed_counter().inc(pool=self.name)
+                raise PoolExhausted()
+            for _ in range(need):
+                self._tables[seq_id].append(self._free.pop())
+            self._lengths[seq_id] = new_len
+            if need:
+                self._export_occupancy()
+            return list(self._tables[seq_id])
+
+    def free(self, seq_id) -> int:
+        """Return a sequence's blocks to the pool (EOS / max_tokens /
+        client disconnect — all mid-batch paths). Idempotent; returns
+        the number of blocks released."""
+        with self._lock:
+            blocks = self._tables.pop(seq_id, None)
+            self._lengths.pop(seq_id, None)
+            if not blocks:
+                return 0
+            self._free.extend(reversed(blocks))
+            self._export_occupancy()
+            return len(blocks)
+
+    def table(self, seq_id) -> List[int]:
+        with self._lock:
+            return list(self._tables[seq_id])
+
+    def length(self, seq_id) -> int:
+        with self._lock:
+            return self._lengths[seq_id]
+
+    def padded_table(self, seq_id, max_blocks: int) -> np.ndarray:
+        """The sequence's block table as a fixed-width int32 row
+        (padded with the scratch block 0) — the shape-stable form the
+        jitted decode step consumes."""
+        t = self.table(seq_id)
+        if len(t) > max_blocks:
+            raise ValueError(f"sequence {seq_id!r} spans {len(t)} "
+                             f"blocks > table width {max_blocks}")
+        return np.asarray(t + [0] * (max_blocks - len(t)), np.int32)
+
+    def update_arrays(self, k, v) -> None:
+        """Store the decode step's updated pool arrays (functional
+        update: jit returns new values for the same buffers)."""
+        self.k, self.v = k, v
+
+    def report(self) -> dict:
+        """The memory_report join row for this pool."""
+        return {
+            "pool": self.name,
+            "bytes": self.pool_bytes,
+            "blocks": {"free": self.free_blocks,
+                       "live": self.live_blocks,
+                       "reserved": 1,
+                       "total": self.num_blocks},
+            "occupancy": round(self.occupancy, 4),
+            "live_sequences": self.live_sequences,
+            "block_tokens": self.block_size,
+            "layout": [self.n_layers, self.num_blocks, self.block_size,
+                       self.n_heads, self.head_dim],
+        }
+
+
+def pool_report() -> List[dict]:
+    """Reports for every live pool — the ``kv_pools`` resident class
+    ``diagnostics.memory_report`` joins in (lazy ``sys.modules``
+    lookup on its side; no import edge)."""
+    return sorted((p.report() for p in list(_pools)),
+                  key=lambda r: r["pool"])
+
+
+def pool_resident_bytes() -> int:
+    """Total preallocated KV bytes across live pools (the number that
+    must reconcile with the summed ``dl4j_kv_pool_bytes`` gauge)."""
+    return sum(p.pool_bytes for p in list(_pools))

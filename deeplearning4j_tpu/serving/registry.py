@@ -150,6 +150,7 @@ class ModelVersion:
             "retraces_since_warmup": self.retraces_since_warmup(),
             "mode": self.batcher.mode,
             "flush_policy": self.batcher.flush_policy,
+            "generative": self.batcher.is_generative,
             "latency_slo_ms": self.latency_slo_ms,
             "created": self.created,
         }
@@ -183,7 +184,8 @@ class ModelRegistry:
                  tensor_parallel: Optional[int] = None,
                  latency_slo_ms: Optional[float] = None,
                  input_name: Optional[str] = None,
-                 output_name: Optional[str] = None) -> ModelVersion:
+                 output_name: Optional[str] = None,
+                 generate: Optional[dict] = None) -> ModelVersion:
         """Register (or hot-swap) the live version of ``name``.
 
         ``model`` is an in-memory model or an artifact path (zip / h5
@@ -201,7 +203,14 @@ class ModelRegistry:
         Outputs stay bitwise-equal to dense in every mode.
         ``flush_policy`` (``"continuous"`` default) and
         ``latency_slo_ms`` (arms the SLO-adaptive admission budget and
-        is surfaced to the server) ride on the version."""
+        is surfaced to the server) ride on the version.
+
+        ``generate`` configures the generative decode engine for a
+        model with a prefill/decode_step surface (``kv_blocks``,
+        ``kv_block_size``, ``prompt_buckets``, ``decode_buckets``,
+        ``max_seq_len``, ``paged``) — its prefill/commit/decode
+        programs warm with the version, so the zero-retrace proof
+        covers :generate too."""
         if isinstance(model, (str, Path)):
             source = str(model)
             model = load_model(model)
@@ -227,7 +236,8 @@ class ModelRegistry:
             queue_limit=self.queue_limit, guard=guard,
             flush_policy=(flush_policy if flush_policy is not None
                           else self.flush_policy),
-            mode=mode, tensor_parallel=tensor_parallel)
+            mode=mode, tensor_parallel=tensor_parallel,
+            generate=generate)
         ver = ModelVersion(name, version_no, model, batcher, source,
                            latency_slo_ms=latency_slo_ms)
 
@@ -236,6 +246,14 @@ class ModelRegistry:
             import numpy as np
             secs = batcher.warmup(warmup_shape,
                                   warmup_dtype or np.float32)
+            telemetry.histogram(
+                "dl4j_serving_warmup_total_seconds",
+                "whole-version warmup wall time: every bucket "
+                "compiled + executed once (seconds)").observe(
+                    secs, model=name)
+        if generate is not None and batcher.is_generative:
+            ver.status = ModelStatus.WARMING
+            secs = batcher.warmup_generate()
             telemetry.histogram(
                 "dl4j_serving_warmup_total_seconds",
                 "whole-version warmup wall time: every bucket "

@@ -15,6 +15,9 @@ its own port — and fronts them with one HTTP listener:
   (429/503/504, with ``Retry-After`` / ``X-Model-Version`` headers)
   relay untouched — shedding is the *replica's* verdict, not a router
   failure.
+- ``POST /v1/models/<name>:generate`` — same dispatch; a chunked
+  (streaming) replica response is relayed chunk-by-chunk, so each
+  token reaches the client the moment the replica emits it.
 - ``GET /v1/replicas`` — per-replica health/outstanding/url.
 - ``GET /v1/models`` — the first healthy replica's catalog.
 - ``GET /healthz`` / ``GET /readyz`` — the fleet answers (ready when
@@ -53,7 +56,7 @@ from deeplearning4j_tpu.serving.admission import AdmissionController
 from deeplearning4j_tpu.serving.registry import ModelRegistry
 from deeplearning4j_tpu.serving.server import InferenceServer
 
-_PREDICT_RE = re.compile(r"^/v1/models/([^/:]+):predict$")
+_ROUTE_RE = re.compile(r"^/v1/models/([^/:]+):(predict|generate)$")
 
 #: end-to-end headers the proxy relays verbatim in each direction
 _RELAY_REQ = ("Content-Type", "X-Deadline-Ms")
@@ -183,7 +186,7 @@ class ServingRouter:
                     self.send_json({"error": "not found"}, 404)
 
             def do_POST(self):              # noqa: N802
-                m = _PREDICT_RE.match(self.path)
+                m = _ROUTE_RE.match(self.path)
                 if not m:
                     self.send_json({"error": "not found"}, 404)
                     return
@@ -302,11 +305,21 @@ class ServingRouter:
                 conn.request("POST", handler.path, body=body,
                              headers=req_headers)
                 resp = conn.getresponse()
-                payload = resp.read()
+                chunked = (resp.getheader("Transfer-Encoding", "")
+                           .lower() == "chunked")
                 resp_headers = {h: resp.getheader(h)
                                 for h in _RELAY_RESP
                                 if resp.getheader(h)}
                 status = resp.status
+                if chunked:
+                    # token stream: relay incrementally so the client
+                    # sees each token the moment the replica emits it
+                    # (no retry past this point — bytes are out)
+                    self._relay_stream(handler, rep, resp,
+                                       resp_headers, status, counted)
+                    conn.close()
+                    return
+                payload = resp.read()
                 conn.close()
             except OSError:
                 # connection-level failure: out of rotation until the
@@ -321,3 +334,29 @@ class ServingRouter:
             handler.send_body(payload, ctype, status,
                               headers=resp_headers)
             return
+
+    def _relay_stream(self, handler, rep, resp, resp_headers, status,
+                      counted):
+        """Relay a chunked replica response (the :generate token
+        stream) piece by piece. ``http.client`` de-chunks the replica
+        side (``read1`` returns each frame as it lands); the router
+        re-chunks toward the client. A replica failure mid-stream
+        truncates the client's stream (``abort_chunks``); a client
+        disconnect just stops the relay — the replica's own disconnect
+        handling frees the sequence."""
+        ctype = resp_headers.pop("Content-Type",
+                                 "application/x-ndjson")
+        counted.inc(replica=rep.name, code=str(status))
+        handler.begin_chunks(ctype, status, headers=resp_headers)
+        try:
+            while True:
+                piece = resp.read1(65536)
+                if not piece:
+                    break
+                handler.send_chunk(piece)
+        except OSError:
+            # replica died mid-stream, or the client went away —
+            # either way the stream cannot complete cleanly
+            handler.abort_chunks()
+            return
+        handler.end_chunks()

@@ -59,6 +59,7 @@ from deeplearning4j_tpu.serving.admission import (AdmissionController,
 from deeplearning4j_tpu.serving.registry import ModelRegistry
 
 _PREDICT_RE = re.compile(r"^/v1/models/([^/:]+):predict$")
+_GENERATE_RE = re.compile(r"^/v1/models/([^/:]+):generate$")
 
 _NPY_TYPES = ("application/octet-stream", "application/x-npy")
 
@@ -106,10 +107,14 @@ class InferenceServer:
 
             def do_POST(self):              # noqa: N802
                 m = _PREDICT_RE.match(self.path)
-                if not m:
-                    self.send_json({"error": "not found"}, 404)
+                if m:
+                    server._predict(self, m.group(1))
                     return
-                server._predict(self, m.group(1))
+                g = _GENERATE_RE.match(self.path)
+                if g:
+                    server._generate(self, g.group(1))
+                    return
+                self.send_json({"error": "not found"}, 404)
 
         self._httpd, self._thread = start_http_server(Handler, port)
         self.port = self._httpd.server_address[1]
@@ -231,3 +236,145 @@ class InferenceServer:
                          "model": name,
                          "version": version.version,
                          "batch": int(x.shape[0])}, 200)
+
+    # ------------------------------------------------------------------
+    def _generate(self, handler: QuietHandler, name: str):
+        """``POST /v1/models/<name>:generate`` — autoregressive decode
+        with streaming response.
+
+        JSON body: ``{"prompt": [ids...], "max_tokens": N,
+        "temperature": 0.0, "top_k": 0, "deadline_ms": optional,
+        "stream": true}``. With ``stream`` (default) the response is
+        chunked ``application/x-ndjson``: one ``{"token": id,
+        "index": i}`` line per decoded token the moment it decodes,
+        then a terminal ``{"done": true, "reason": ..., "tokens": n}``
+        line. ``stream=false`` buffers the whole completion into one
+        JSON object. Admission is by token-cost (the prompt's KV-block
+        footprint) through the same AIMD controller as predict; pool
+        exhaustion sheds 429 + measured Retry-After *before* any
+        chunk is sent. The first token's latency feeds the SLO
+        machinery as time-to-first-token."""
+        counted = telemetry.counter(
+            "dl4j_serving_requests_total",
+            "predict requests by model and HTTP status code")
+
+        def finish_json(obj, code, headers=None):
+            counted.inc(model=name, code=str(code))
+            handler.send_json(obj, code, headers)
+
+        try:
+            version = self.registry.model(name)
+        except KeyError:
+            finish_json({"error": f"model {name!r} not found"}, 404)
+            return
+        if not version.batcher.is_generative:
+            finish_json({"error": f"model {name!r} has no generate "
+                                  f"surface"}, 400)
+            return
+        if version.latency_slo_ms is not None:
+            self.admission.set_slo(name, version.latency_slo_ms)
+        try:
+            doc = json.loads(handler.read_body().decode() or "{}")
+            prompt = [int(t) for t in doc["prompt"]]
+            if not prompt:
+                raise ValueError("prompt must not be empty")
+            max_tokens = int(doc.get("max_tokens", 16))
+            temperature = float(doc.get("temperature", 0.0))
+            top_k = int(doc.get("top_k", 0))
+            streaming = bool(doc.get("stream", True))
+            deadline_ms = (handler.headers.get("X-Deadline-Ms")
+                           or doc.get("deadline_ms"))
+        except (KeyError, ValueError, TypeError,
+                json.JSONDecodeError) as e:
+            finish_json({"error": f"bad request body: {e}"}, 400)
+            return
+        deadline = deadline_after_ms(
+            float(deadline_ms) if deadline_ms is not None else None)
+        t_start = time.monotonic()
+        cost = version.batcher.generate_cost(len(prompt), max_tokens)
+        tokens_out, idx = [], 0
+        headers_sent = False
+        try:
+            with self.admission.track(name, deadline, cost=cost):
+                stream = version.batcher.submit_generate(
+                    prompt, max_tokens, temperature=temperature,
+                    top_k=top_k, deadline=deadline)
+                per_token_timeout = self.request_timeout_s
+                try:
+                    while True:
+                        tok = stream.next(timeout=per_token_timeout)
+                        if tok is None:          # closed: see reason
+                            break
+                        if idx == 0:
+                            # TTFT feeds the AIMD controller — the
+                            # generative SLO observation stream
+                            self.admission.observe_total(
+                                name, time.monotonic() - t_start)
+                            if streaming:
+                                handler.begin_chunks(
+                                    "application/x-ndjson",
+                                    headers={"X-Model-Version":
+                                             str(version.version)})
+                                headers_sent = True
+                        if streaming:
+                            handler.send_chunk(json.dumps(
+                                {"token": tok,
+                                 "index": idx}).encode() + b"\n")
+                        else:
+                            tokens_out.append(tok)
+                        idx += 1
+                except (OSError, BrokenPipeError):
+                    # client went away mid-stream: cancel so the
+                    # engine retires the sequence and frees its KV
+                    # blocks on the next iteration
+                    stream.cancel()
+                    counted.inc(model=name, code="499")
+                    handler.close_connection = True
+                    return
+                except Exception:
+                    stream.cancel()
+                    raise
+        except DeadlineExceeded as e:
+            if headers_sent:
+                handler.abort_chunks()
+            else:
+                finish_json({"error": str(e)}, 504)
+            return
+        except ShedError as e:
+            if headers_sent:
+                handler.abort_chunks()
+            else:
+                code = 503 if e.reason == "draining" else 429
+                finish_json(
+                    {"error": str(e), "reason": e.reason}, code,
+                    {"Retry-After":
+                     self.admission.retry_after_header(name)})
+            return
+        except Exception as e:
+            # mid-stream failure after headers: terminate the chunk
+            # stream hard (truncated body = clean client error, not a
+            # wedged connection); before headers: a plain 500
+            if headers_sent:
+                handler.abort_chunks()
+            else:
+                finish_json({"error": f"generate failed: {e}"}, 500)
+            return
+        if streaming:
+            if not headers_sent:
+                # closed before the first token (e.g. deadline hit in
+                # the prefill queue): map the reason to a status
+                code = 504 if stream.reason == "deadline" else 500
+                finish_json({"error": f"generate ended before the "
+                                      f"first token "
+                                      f"({stream.reason})"}, code)
+                return
+            handler.send_chunk(json.dumps(
+                {"done": True, "reason": stream.reason,
+                 "tokens": idx}).encode() + b"\n")
+            handler.end_chunks()
+            counted.inc(model=name, code="200")
+        else:
+            finish_json({"tokens": tokens_out,
+                         "reason": stream.reason,
+                         "model": name,
+                         "version": version.version}, 200)

@@ -45,7 +45,13 @@ lower-is-better and ``goodput_rps`` / ``in_slo_pct`` /
 serialization ``speedup`` higher-is-better — the continuous-batching
 claim is "lower tail latency AND more useful completions per second
 at the same offered load"; ``meta.transport_rtt_ms`` rides in the
-skipped ``meta`` block, so rig RTT never gates.
+skipped ``meta`` block, so rig RTT never gates.  The ISSUE-16
+``generative`` block gates decode ``goodput_tokens_per_s`` and
+``occupancy_mean`` higher-is-better; ``ttft_*_ms`` /
+``intertoken_*_ms`` / the paged-vs-dense ``*_step_ms`` pair and any
+``shed_rate`` lower-is-better — the paged-KV claim is "more tokens
+per second at lower streaming tail latency, without shedding while
+the pool sits half empty".
 
 When baseline and fresh disagree on ``meta.proxy`` (one is a
 CPU-proxy round, the other a real-chip round) the comparison is
@@ -72,7 +78,7 @@ HIGHER_BETTER = ("value", "tflops", "throughput", "_ips", "_rps",
 LOWER_BETTER = ("_ms", "_us", "_seconds", "overhead", "stall", "skew",
                 "_bytes_per_chip", "lost_steps", "cross_axis",
                 "model_axis_update_bytes", "temp_bytes",
-                "bytes_accessed")
+                "bytes_accessed", "shed")
 #: keys that are identity/config, never compared; "canary" keys are
 #: clock-path checks documented as dispatch-noise-dominated
 SKIP = ("metric", "unit", "n_trials", "vs_baseline", "meta", "min",

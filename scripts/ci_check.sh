@@ -44,7 +44,13 @@
 #      request with a bitwise-correct 200 or a well-formed shed
 #      (429/503 + integer Retry-After), drop nothing, and show zero
 #      post-warmup retraces (the ISSUE 15 acceptance bar,
-#      scripts/check_serving_slo.py).
+#      scripts/check_serving_slo.py);
+#   9. generative conformance gate: paged-KV decode (Pallas kernel
+#      forced, interpret mode) must be greedy-token-equal to the
+#      dense full-re-forward reference, join/leave churn must never
+#      retrace after warmup, and the KV pool must free every block
+#      and reconcile with its dl4j_kv_pool_bytes gauge (the ISSUE 16
+#      acceptance bar, scripts/check_generative.py).
 #
 # Usage: scripts/ci_check.sh [--threshold PCT]     (default 10)
 # Exit 0 = all gates clean, 1 = a gate failed, 2 = bad usage.
@@ -106,5 +112,8 @@ JAX_PLATFORMS=cpu python scripts/check_layer_attribution.py || fail=1
 
 echo "== serving-SLO gate =="
 JAX_PLATFORMS=cpu python scripts/check_serving_slo.py || fail=1
+
+echo "== generative conformance gate =="
+JAX_PLATFORMS=cpu python scripts/check_generative.py || fail=1
 
 exit $fail
