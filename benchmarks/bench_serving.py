@@ -20,6 +20,10 @@ Measures the ISSUE-15 claims the way an operator would check them:
   parameter bytes on the virtual 8-device mesh, with the bitwise
   output check.
 - **warm vs cold first request** — the shape-bucketed warmup payoff.
+- **Serving observatory overhead** — the same HTTP predict loop with
+  per-request tracing ON (default) vs OFF: ``trace_overhead_pct``
+  must stay ≤ 1% at p50, the cost of leaving the observatory on in
+  production.
 
 Bench honesty: every latency figure here is device-side. On the axon
 rig the client additionally pays the fixed ~100 ms tunnel RTT
@@ -255,6 +259,60 @@ def _serialization_leg(line: dict):
     }
 
 
+def _observatory_leg(line: dict):
+    """The serving-observatory overhead claim: the same HTTP predict
+    loop with request tracing ON (the default — trace ids, phase
+    spans, exemplars, flight-recorder records) vs forced OFF
+    (``DL4J_TPU_REQUEST_TRACE=0`` equivalent, via the in-process
+    override). Tracing is supposed to be default-on in production, so
+    the p50 overhead must stay ≤ 1%."""
+    import urllib.request
+
+    from deeplearning4j_tpu.common import tracectx
+    from deeplearning4j_tpu.serving.registry import ModelRegistry
+    from deeplearning4j_tpu.serving.server import InferenceServer
+
+    registry = ModelRegistry(default_buckets=BUCKETS)
+    registry.register("bench-obs", _net(), warmup_shape=(8,))
+    srv = InferenceServer(registry).start(0)
+    body = json.dumps(
+        {"inputs": np.zeros((1, 8), np.float32).tolist()}).encode()
+    url = f"{srv.url}/v1/models/bench-obs:predict"
+    n = 150
+
+    def loop() -> np.ndarray:
+        lats = []
+        for _ in range(n):
+            req = urllib.request.Request(
+                url, data=body,
+                headers={"Content-Type": "application/json"})
+            t0 = time.perf_counter()
+            with urllib.request.urlopen(req) as resp:
+                resp.read()
+            lats.append(time.perf_counter() - t0)
+        return np.asarray(lats) * 1e3
+
+    try:
+        # warm both paths before timing (HTTP keep-alive, caches)
+        tracectx.set_enabled(True)
+        loop()
+        p50_on = float(np.percentile(loop(), 50))
+        tracectx.set_enabled(False)
+        loop()
+        p50_off = float(np.percentile(loop(), 50))
+    finally:
+        tracectx.set_enabled(None)
+        srv.stop(drain=False)
+        registry.shutdown()
+    line["serving_observatory"] = {
+        "n": n,
+        "p50_on_ms": round(p50_on, 3),
+        "p50_off_ms": round(p50_off, 3),
+        "trace_overhead_pct": round(
+            100.0 * (p50_on - p50_off) / max(p50_off, 1e-9), 2),
+    }
+
+
 def _residency_leg(line: dict):
     """Dense vs fsdp per-chip resident parameter bytes, plus the
     bitwise output check that makes the savings claim honest."""
@@ -314,6 +372,10 @@ def main():
     _policy_leg(line)
     _admission_leg(line)
     _serialization_leg(line)
+    try:
+        _observatory_leg(line)
+    except Exception as e:
+        print(f"observatory leg failed: {e!r}", file=sys.stderr)
     try:
         _residency_leg(line)
     except Exception as e:

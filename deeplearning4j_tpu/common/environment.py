@@ -130,7 +130,22 @@ class Environment:
       DL4J_TPU_LAYERPROF (common.layerprof layer-attribution scopes:
       default on — the annotations are trace-time-only metadata with
       zero steady-state step cost; =0 kills them;
-      Environment.extra["layerprof"] overrides the env var)
+      Environment.extra["layerprof"] overrides the env var),
+      DL4J_TPU_REQUEST_TRACE (common.tracectx per-request serving
+      spans + exemplars: default on; =0 kills — request trace ids
+      still mint so responses/logs stay joinable),
+      DL4J_TPU_ACCESS_LOG / DL4J_TPU_ACCESS_LOG_SAMPLE (httputil
+      sampled JSONL access log: path turns it on, sample rate keeps
+      a deterministic 1-in-N slice),
+      DL4J_TPU_REQREC / _CAPACITY / _DIR / _SHED_THRESHOLD /
+      _SHED_WINDOW_S / _STORM_COOLDOWN_S (serving.reqrec request
+      flight recorder: default on, 512-record ring, dump dir falls
+      back to DL4J_TPU_FLIGHT_RECORDER_DIR; storm = threshold sheds
+      inside the window, then a cooldown between dumps),
+      DL4J_TPU_SLO_TARGET / DL4J_TPU_SLO_FAST_S / DL4J_TPU_SLO_SLOW_S
+      (serving.slo error-budget accounting: in-SLO target fraction,
+      default 0.99, over fast/slow burn-rate windows, default
+      300 s / 3600 s)
     """
 
     _inst: _Env | None = None

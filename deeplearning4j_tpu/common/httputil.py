@@ -18,17 +18,58 @@ the serialization tax this removes against the JSON path.
 Bind host: ``DL4J_TPU_HTTP_HOST`` (default ``127.0.0.1`` — loopback
 only; set ``0.0.0.0`` to expose a server beyond the host, e.g. from a
 container).
+
+Access log: ``DL4J_TPU_ACCESS_LOG=<path>`` turns on a sampled
+structured (JSONL) access log for every server riding
+:class:`QuietHandler` — one line per completed request with method,
+path, status, response bytes, duration, and the request's trace id
+(the serving observatory's join key between the access log, the
+chrome-trace span tree, and the latency-histogram exemplars).
+``DL4J_TPU_ACCESS_LOG_SAMPLE`` (default ``1.0``) keeps every
+``1/rate``-th request deterministically — hot fleets log a thin,
+unbiased slice instead of every request.
 """
 from __future__ import annotations
 
 import io
+import itertools
 import json
 import os
 import threading
+import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Optional, Sequence, Tuple
 
 import numpy as np
+
+from deeplearning4j_tpu.common import telemetry
+
+#: (path, log-every-nth) — cached once per process; tests reset via
+#: the telemetry reset hook after flipping the env vars
+_access_conf: Optional[Tuple[str, int]] = None
+
+
+def _access_log_conf() -> Tuple[str, int]:
+    global _access_conf
+    if _access_conf is None:
+        path = os.environ.get("DL4J_TPU_ACCESS_LOG", "")
+        try:
+            rate = float(os.environ.get(
+                "DL4J_TPU_ACCESS_LOG_SAMPLE", "1"))
+        except ValueError:
+            rate = 1.0
+        every = 0 if not path or rate <= 0 else \
+            max(1, int(round(1.0 / min(1.0, rate))))
+        _access_conf = (path, every)
+    return _access_conf
+
+
+def _reset_access_conf() -> None:
+    global _access_conf
+    _access_conf = None
+
+
+telemetry.on_reset(_reset_access_conf)
 
 
 def npy_view(buf) -> "np.ndarray":
@@ -94,6 +135,60 @@ class QuietHandler(BaseHTTPRequestHandler):
     def log_message(self, *args):       # silence request logging
         pass
 
+    # -- sampled structured access log ---------------------------------
+    #: shared across handler threads: the deterministic 1-in-N sampler
+    _access_seq = itertools.count(1)
+    _access_write_lock = threading.Lock()
+    #: per-request state (reset in parse_request; class-level defaults
+    #: cover requests that never parse, e.g. a closed keep-alive)
+    _t_req = 0.0
+    _resp_status: Optional[int] = None
+    _resp_bytes = 0
+    #: set by the serving server/router during request handling — the
+    #: access log's join key into the span tree
+    _trace_id: Optional[str] = None
+
+    def parse_request(self):
+        # per-request reset: handler threads serve many keep-alive
+        # requests, so stale status/trace ids must not carry over
+        self._t_req = time.monotonic()
+        self._resp_status = None
+        self._resp_bytes = 0
+        self._trace_id = None
+        return super().parse_request()
+
+    def send_response(self, code, message=None):
+        if self._resp_status is None:   # first status wins (chunked
+            self._resp_status = int(code)   # streams send one)
+        super().send_response(code, message)
+
+    def handle_one_request(self):
+        super().handle_one_request()
+        try:
+            self._access_log()
+        except Exception:       # noqa: BLE001 — logging must never
+            pass                # break the serving path
+
+    def _access_log(self) -> None:
+        path, every = _access_log_conf()
+        if not every or self._resp_status is None:
+            return
+        if next(QuietHandler._access_seq) % every:
+            return
+        line = json.dumps({
+            "t": time.time(),
+            "method": self.command,
+            "path": self.path,
+            "status": self._resp_status,
+            "bytes": self._resp_bytes,
+            "duration_ms": round(
+                (time.monotonic() - self._t_req) * 1e3, 3),
+            "trace_id": self._trace_id,
+        })
+        with QuietHandler._access_write_lock:
+            with open(path, "a") as f:
+                f.write(line + "\n")
+
     # -- responses -----------------------------------------------------
     def send_body(self, body: bytes, content_type: str,
                   code: int = 200, headers: Optional[dict] = None):
@@ -104,6 +199,7 @@ class QuietHandler(BaseHTTPRequestHandler):
             self.send_header(k, str(v))
         self.end_headers()
         self.wfile.write(body)
+        self._resp_bytes += len(body)
 
     def send_body_parts(self, parts: Sequence, content_type: str,
                         code: int = 200,
@@ -123,6 +219,7 @@ class QuietHandler(BaseHTTPRequestHandler):
         self.end_headers()
         for v in views:
             self.wfile.write(v)
+            self._resp_bytes += v.nbytes
 
     def send_json(self, obj, code: int = 200,
                   headers: Optional[dict] = None):
@@ -177,6 +274,7 @@ class QuietHandler(BaseHTTPRequestHandler):
         self.wfile.write(data)
         self.wfile.write(b"\r\n")
         self.wfile.flush()
+        self._resp_bytes += len(data)
 
     def end_chunks(self):
         """The terminal zero-length chunk — a well-formed end of body;

@@ -147,12 +147,16 @@ class Gauge(_Metric):
 
 
 class _HistSeries:
-    __slots__ = ("counts", "sum", "count")
+    __slots__ = ("counts", "sum", "count", "exemplar")
 
     def __init__(self, n_buckets: int):
         self.counts = [0] * (n_buckets + 1)     # last slot = +Inf
         self.sum = 0.0
         self.count = 0
+        #: most recent (value, {label: value}, unix_ts) exemplar — a
+        #: concrete request (trace id) behind the aggregate, OpenMetrics
+        #: style, so a bad bucket links to a timeline
+        self.exemplar: Optional[Tuple[float, dict, float]] = None
 
 
 class Histogram(_Metric):
@@ -172,7 +176,20 @@ class Histogram(_Metric):
             return
         self._observe_key(_label_key(labels), value)
 
-    def _observe_key(self, key: _LabelKey, value: float) -> None:
+    def observe_with_exemplar(self, value: float, exemplar: dict,
+                              **labels) -> None:
+        """Observe ``value`` and attach ``exemplar`` (e.g.
+        ``{"trace_id": ...}``) to the series — the latest exemplar is
+        kept per label set and rendered OpenMetrics-style on the +Inf
+        bucket, so a latency spike on a dashboard links to the concrete
+        request timeline that produced it."""
+        if not self._state["on"]:
+            return
+        self._observe_key(_label_key(labels), value,
+                          exemplar=dict(exemplar))
+
+    def _observe_key(self, key: _LabelKey, value: float,
+                     exemplar: Optional[dict] = None) -> None:
         with self._lock:
             s = self._series.get(key)
             if s is None:
@@ -185,6 +202,18 @@ class Histogram(_Metric):
             s.counts[i] += 1
             s.sum += value
             s.count += 1
+            if exemplar is not None:
+                s.exemplar = (float(value), exemplar, time.time())
+
+    def exemplar_of(self, **labels) -> Optional[dict]:
+        """The latest exemplar attached to a series, as
+        ``{"value", "labels", "ts"}`` (None when the series has never
+        seen one)."""
+        s = self._series.get(_label_key(labels))
+        if s is None or s.exemplar is None:
+            return None
+        value, ex_labels, ts = s.exemplar
+        return {"value": value, "labels": dict(ex_labels), "ts": ts}
 
     def bind(self, **labels) -> "_BoundHistogram":
         """Pre-resolve a label set: the returned handle's ``observe``
@@ -244,8 +273,16 @@ class Histogram(_Metric):
                 out.append(f"{self.name}_bucket"
                            f"{_render_labels(key, le)} {cum}")
             inf = 'le="+Inf"'
-            out.append(f"{self.name}_bucket"
-                       f"{_render_labels(key, inf)} {s.count}")
+            inf_line = (f"{self.name}_bucket"
+                        f"{_render_labels(key, inf)} {s.count}")
+            if s.exemplar is not None:
+                # OpenMetrics exemplar syntax on the terminal bucket;
+                # plain-text scrapers that stop at the value ignore it
+                value, ex_labels, ts = s.exemplar
+                ex = ",".join(f'{k}="{v}"'
+                              for k, v in sorted(ex_labels.items()))
+                inf_line += f" # {{{ex}}} {value:g} {ts:.3f}"
+            out.append(inf_line)
             out.append(f"{self.name}_sum{_render_labels(key)} {s.sum:g}")
             out.append(f"{self.name}_count{_render_labels(key)}"
                        f" {s.count}")
@@ -321,6 +358,8 @@ class MetricsRegistry:
         from deeplearning4j_tpu.common import faults, stepstats
         stepstats.StepStats._reset_for_tests()
         faults._reset_for_tests()
+        for hook in list(_reset_hooks):
+            hook()
 
     # -- gate ----------------------------------------------------------
     @property
@@ -400,6 +439,21 @@ def enabled() -> bool:
     return MetricsRegistry.get().enabled
 
 
+#: callables invoked by MetricsRegistry._reset_for_tests — modules
+#: holding their own process-wide singletons (serving.slo,
+#: serving.reqrec, common.tracectx) register here at import time so the
+#: existing autouse test fixtures reset them too, without this module
+#: having to import upward into the serving package
+_reset_hooks: List = []
+
+
+def on_reset(hook) -> None:
+    """Register a zero-arg callable to run on every
+    ``MetricsRegistry._reset_for_tests()`` (idempotent per hook)."""
+    if hook not in _reset_hooks:
+        _reset_hooks.append(hook)
+
+
 # ----------------------------------------------------------------------
 # one-timeline tracing: a shared chrome-trace event buffer, same event
 # schema as ui.profiling.ProfilingListener so everything merges
@@ -473,6 +527,20 @@ def instant(name: str, **attrs) -> None:
         "name": name, "ph": "i", "s": "p", "pid": os.getpid(),
         "tid": threading.get_ident() & 0xFFFF,
         "ts": int(time.time() * 1e6), "args": attrs})
+
+
+def span_at(name: str, t_wall: float, dur_s: float, **attrs) -> None:
+    """Record a chrome-trace span with EXPLICIT start/duration — for
+    phases measured by another thread (a batcher flush attributing
+    queue wait back to each request) where a with-block cannot wrap
+    the interval. ``t_wall`` is a unix timestamp (seconds)."""
+    if not MetricsRegistry.get().enabled:
+        return
+    _trace_buffer.append({
+        "name": name, "ph": "X", "pid": os.getpid(),
+        "tid": threading.get_ident() & 0xFFFF,
+        "ts": int(t_wall * 1e6), "dur": max(0, int(dur_s * 1e6)),
+        "args": attrs})
 
 
 def trace_events() -> List[dict]:

@@ -32,6 +32,14 @@ surface registered with ``generate={...}`` gets a paged KV-cache
 pool (:class:`KVBlockPool`) and a continuous-batching decode engine
 (:class:`DecodeEngine`) — ``POST /v1/models/<name>:generate`` streams
 tokens as chunked ndjson the moment they decode.
+
+Request observatory: every request carries a trace id
+(``common.tracectx``) through admission, batching and the device into
+a connected span tree; :class:`SLOTracker` folds the total-latency
+stream into per-model error-budget burn rates (``GET /api/slo``), and
+:class:`RequestRecorder` keeps the flight-recorder ring of completed
+requests with per-phase timings (``GET /api/reqrec``, dumps on crash
+or shed storm).
 """
 from deeplearning4j_tpu.serving.admission import (AdmissionController,
                                                   DeadlineExceeded,
@@ -44,12 +52,15 @@ from deeplearning4j_tpu.serving.kvcache import (KVBlockPool,
 from deeplearning4j_tpu.serving.registry import (ModelRegistry,
                                                  ModelStatus,
                                                  ModelVersion)
+from deeplearning4j_tpu.serving.reqrec import RequestRecorder
 from deeplearning4j_tpu.serving.router import ServingRouter
 from deeplearning4j_tpu.serving.server import InferenceServer
+from deeplearning4j_tpu.serving.slo import SLOTracker
 
 __all__ = [
     "AdmissionController", "DeadlineExceeded", "ShedError",
     "ServingBatcher", "ModelRegistry", "ModelStatus", "ModelVersion",
     "InferenceServer", "ServingRouter",
     "DecodeEngine", "TokenStream", "KVBlockPool", "PoolExhausted",
+    "SLOTracker", "RequestRecorder",
 ]

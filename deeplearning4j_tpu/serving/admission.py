@@ -36,6 +36,7 @@ into ``dl4j_serving_deadline_shed_total{where=admission|queue}``.
 """
 from __future__ import annotations
 
+import logging
 import math
 import threading
 import time
@@ -44,6 +45,8 @@ from contextlib import contextmanager
 from typing import Deque, Dict, Optional, Tuple
 
 from deeplearning4j_tpu.common import telemetry
+
+log = logging.getLogger("deeplearning4j_tpu")
 
 #: never tell a client to back off longer than this (seconds)
 RETRY_AFTER_CAP_S = 60.0
@@ -168,18 +171,31 @@ class AdmissionController:
                         int(math.ceil(0.95 * len(lats))) - 1)] * 1e3
 
     def observe_total(self, model: str, seconds: float,
-                      now: Optional[float] = None) -> None:
+                      now: Optional[float] = None,
+                      trace_id: Optional[str] = None) -> None:
         """Report one completed request's total latency. Feeds the
-        ``dl4j_serving_total_seconds`` histogram, the drain-rate
-        window behind ``Retry-After``, and the AIMD budget controller.
+        ``dl4j_serving_total_seconds`` histogram (with the request's
+        trace id as an exemplar when tracing is on), the drain-rate
+        window behind ``Retry-After``, the AIMD budget controller,
+        and the SLO error-budget tracker.
         ``now`` is injectable for deterministic tests."""
         now = time.monotonic() if now is None else now
-        telemetry.histogram(
+        hist = telemetry.histogram(
             "dl4j_serving_total_seconds",
             "total submit->response latency of completed predict "
             "requests — the observation stream the SLO-adaptive "
             "admission controller compares against latency_slo_ms "
-            "(seconds)").observe(seconds, model=model)
+            "(seconds)")
+        if trace_id:
+            hist.observe_with_exemplar(seconds,
+                                       {"trace_id": trace_id},
+                                       model=model)
+        else:
+            hist.observe(seconds, model=model)
+        slo_ms = self._slo_ms.get(model, self.latency_slo_ms)
+        if slo_ms is not None:
+            from deeplearning4j_tpu.serving.slo import SLOTracker
+            SLOTracker.get().observe(model, seconds, slo_ms, now=now)
         with self._lock:
             self._totals.setdefault(
                 model, deque(maxlen=self.adapt_window)).append(
@@ -209,6 +225,21 @@ class AdmissionController:
         budget = self._budget.get(model, self.max_queue)
         if p95_ms > slo_ms:
             budget = max(self.min_budget, int(budget * _SHRINK))
+            if budget < self._budget.get(model, self.max_queue):
+                # log the SLO burn rate against the shrink decision:
+                # "the budget dropped because the fast window was
+                # burning at X" is answerable after the fact
+                from deeplearning4j_tpu.serving.slo import SLOTracker
+                burn = SLOTracker.get().burn_rate(model, "fast")
+                log.info(
+                    "admission: shrinking %s budget -> %d "
+                    "(p95 %.1fms > SLO %.1fms; fast burn rate %s)",
+                    model, budget, p95_ms, slo_ms,
+                    f"{burn:.2f}" if burn is not None else "n/a")
+                telemetry.instant(
+                    "admission.shrink", model=model, budget=budget,
+                    p95_ms=round(p95_ms, 3),
+                    burn_rate_fast=burn)
         elif p95_ms < _REGROW_AT * slo_ms and budget < self.max_queue:
             budget += 1
         self._budget[model] = budget
@@ -224,7 +255,17 @@ class AdmissionController:
     def _drain_rate_locked(self, model: str,
                            now: float) -> Optional[float]:
         """Completions per second over the sliding window (None before
-        the first observation — the cold start)."""
+        the first observation — the cold start).
+
+        Cold-window guard: until >= 2 samples actually span the
+        window, ``len(recent) / (now - recent[0])`` is degenerate —
+        one completion observed "just now" used to divide by the 1e-3
+        floor and report an absurd ~1000 rps drain rate, which
+        collapsed the derived Retry-After to its floor right after
+        startup. With too little signal we instead report the
+        conservative floor rate (those completions spread over the
+        FULL window), which can only over-estimate the wait, never
+        promise a drain that is not happening."""
         done = self._done_ts.get(model)
         if not done:
             return None
@@ -232,7 +273,9 @@ class AdmissionController:
         recent = [t for t in done if t >= horizon]
         if not recent:
             return None
-        span = max(now - recent[0], 1e-3)
+        span = now - recent[0]
+        if len(recent) < 2 or span <= 1e-3:
+            return len(recent) / self.rate_window_s
         return len(recent) / span
 
     def retry_after_s_for(self, model: Optional[str] = None,

@@ -334,11 +334,14 @@ class ServingBatcher(ParallelInference):
 
     def submit_generate(self, prompt, max_tokens: int, *,
                         temperature: float = 0.0, top_k: int = 0,
-                        deadline: Optional[float] = None):
+                        deadline: Optional[float] = None,
+                        ctx=None):
         """Enqueue a generate request; returns the
         :class:`~deeplearning4j_tpu.serving.generative.TokenStream`.
         Raises PoolExhausted synchronously when the KV pool cannot
-        hold the prompt (shed upstream as 429 + Retry-After)."""
+        hold the prompt (shed upstream as 429 + Retry-After).
+        ``ctx`` (the request's TraceContext) rides the pending entry
+        into the engine for cross-thread phase attribution."""
         engine = self._ensure_generate()
         telemetry.counter(
             "dl4j_inference_requests_total",
@@ -346,7 +349,7 @@ class ServingBatcher(ParallelInference):
                 mode="generate")
         return engine.submit(prompt, max_tokens,
                              temperature=temperature, top_k=top_k,
-                             deadline=deadline)
+                             deadline=deadline, ctx=ctx)
 
     def shutdown(self, *a, **kw):
         if self.engine is not None:
@@ -382,14 +385,21 @@ class ServingBatcher(ParallelInference):
 
     # ------------------------------------------------------------------
     def submit(self, x,
-               deadline: Optional[float] = None
-               ) -> "concurrent.futures.Future":
+               deadline: Optional[float] = None,
+               ctx=None) -> "concurrent.futures.Future":
         """Enqueue one request; ``deadline`` is an absolute
         ``time.monotonic()`` instant past which the request must not
-        be computed (its Future then raises DeadlineExceeded)."""
+        be computed (its Future then raises DeadlineExceeded).
+        ``ctx`` is the request's
+        :class:`~deeplearning4j_tpu.common.tracectx.TraceContext`:
+        the flush worker runs on its own thread, so the context rides
+        the Future and phase intervals are attributed back with
+        ``phase_at``."""
         fut: concurrent.futures.Future = concurrent.futures.Future()
         if deadline is not None:
             fut._serving_deadline = float(deadline)
+        if ctx is not None:
+            fut._trace_ctx = ctx
         telemetry.counter(
             "dl4j_inference_requests_total",
             "requests submitted to ParallelInference").inc(
@@ -504,6 +514,7 @@ class ServingBatcher(ParallelInference):
                     rows / max(1, self._padded_rows(rows)),
                     model=self.name, policy=self.flush_policy)
         t0 = time.perf_counter()
+        t_dev0 = time.monotonic()
         try:
             with telemetry.span("serving.flush", model=self.name,
                                 requests=len(live)):
@@ -512,9 +523,24 @@ class ServingBatcher(ParallelInference):
             for _, f, _ in live:
                 f.set_exception(e)
             return
+        t_dev1 = time.monotonic()
         lat.observe(time.perf_counter() - t0, model=self.name,
                     stage="compute")
         end = time.monotonic()
+        occ = None
         for (_, f, t), o in zip(live, outs):
             lat.observe(end - t, model=self.name, stage="total")
+            ctx = getattr(f, "_trace_ctx", None)
+            if ctx is not None:
+                # request timeline: queue (submit -> this flush),
+                # batch_wait (deadline/occupancy bookkeeping before
+                # the device dispatch), device (the flush forward)
+                if occ is None:
+                    r = sum(int(np.asarray(x).shape[0])
+                            for x, _, _ in live)
+                    occ = round(r / max(1, self._padded_rows(r)), 3)
+                ctx.phase_at("queue", t, now)
+                ctx.phase_at("batch_wait", now, t_dev0)
+                ctx.phase_at("device", t_dev0, t_dev1)
+                ctx.note(batch=len(live), occupancy=occ)
             f.set_result(o)

@@ -168,10 +168,11 @@ class _Sequence:
 
     __slots__ = ("seq_id", "stream", "next_token", "position",
                  "generated", "max_tokens", "temperature", "top_k",
-                 "deadline", "t_last")
+                 "deadline", "t_last", "ctx")
 
     def __init__(self, seq_id, stream, next_token, position,
-                 max_tokens, temperature, top_k, deadline, t_last):
+                 max_tokens, temperature, top_k, deadline, t_last,
+                 ctx=None):
         self.seq_id = seq_id
         self.stream = stream
         self.next_token = int(next_token)   # fed to the next step
@@ -182,6 +183,7 @@ class _Sequence:
         self.top_k = int(top_k)
         self.deadline = deadline
         self.t_last = t_last                # last token emit instant
+        self.ctx = ctx                      # request TraceContext
 
 
 class DecodeEngine:
@@ -360,11 +362,15 @@ class DecodeEngine:
 
     def submit(self, prompt, max_tokens: int, *,
                temperature: float = 0.0, top_k: int = 0,
-               deadline: Optional[float] = None) -> TokenStream:
+               deadline: Optional[float] = None,
+               ctx=None) -> TokenStream:
         """Enqueue a generate request. Allocates the prompt's KV
         blocks synchronously — :class:`~deeplearning4j_tpu.serving.
         kvcache.PoolExhausted` (HTTP 429 upstream) raises HERE, before
-        the caller starts streaming. Returns the token stream."""
+        the caller starts streaming. Returns the token stream. ``ctx``
+        (the request's TraceContext) rides the pending entry so the
+        engine thread can attribute queue/device phases and per-token
+        instants back onto the request timeline."""
         prompt = np.asarray(prompt, np.int32).reshape(-1)
         if prompt.size < 1:
             raise ValueError("prompt must carry at least one token")
@@ -386,7 +392,8 @@ class DecodeEngine:
             self._ensure_worker()
             self._pending.put((seq_id, prompt, max_tokens,
                                float(temperature), int(top_k),
-                               deadline, stream, time.monotonic()))
+                               deadline, stream, time.monotonic(),
+                               ctx))
         self._work.set()
         return stream
 
@@ -432,17 +439,19 @@ class DecodeEngine:
                 return admitted
             admitted = True
             (seq_id, prompt, max_tokens, temperature, top_k, deadline,
-             stream, t_submit) = item
+             stream, t_submit, ctx) = item
             if stream.cancelled or (deadline is not None
                                     and time.monotonic() >= deadline):
                 reason = "cancelled" if stream.cancelled else "deadline"
                 self.pool.free(seq_id)
+                if ctx is not None:
+                    ctx.phase_at("queue", t_submit, time.monotonic())
                 self._finish(stream, reason)
                 continue
             try:
                 self._prefill_one(seq_id, prompt, max_tokens,
                                   temperature, top_k, deadline, stream,
-                                  t_submit)
+                                  t_submit, ctx)
             except BaseException as e:      # noqa: BLE001
                 self.pool.free(seq_id)
                 self._finish(stream, "error", e)
@@ -454,10 +463,14 @@ class DecodeEngine:
         return n                    # oversized prompt: cold compile
 
     def _prefill_one(self, seq_id, prompt, max_tokens, temperature,
-                     top_k, deadline, stream, t_submit):
+                     top_k, deadline, stream, t_submit, ctx=None):
         import jax
 
         from deeplearning4j_tpu.ops.sampling import sample_logits
+        t_prefill = time.monotonic()
+        if ctx is not None:
+            # engine-side queue phase: submit -> prefill start
+            ctx.phase_at("queue", t_submit, t_prefill)
         t = self._prompt_bucket(prompt.size)
         tokens = np.zeros((1, t), np.int32)
         tokens[0, :prompt.size] = prompt
@@ -490,6 +503,13 @@ class DecodeEngine:
                 np.asarray([top_k], np.int32)))[0])
         now = time.monotonic()
         _ttft_hist().observe(now - t_submit, model=self.name)
+        if ctx is not None:
+            # the prefill forward + commit + first-token sample is
+            # this request's device phase (decode steps are shared
+            # across the live batch, attributed as instants instead)
+            ctx.phase_at("device", t_prefill, now)
+            ctx.note(kv_blocks=len(self.pool.table(seq_id)),
+                     prompt_tokens=int(prompt.size))
         stream._put(first)
         _tokens_counter().inc(model=self.name)
         eos = self.model.conf.eos_id
@@ -500,7 +520,7 @@ class DecodeEngine:
             return
         self._live[seq_id] = _Sequence(
             seq_id, stream, first, int(prompt.size), max_tokens,
-            temperature, top_k, deadline, now)
+            temperature, top_k, deadline, now, ctx)
         _live_gauge().set(len(self._live), model=self.name)
 
     def _decode_bucket(self, n: int) -> int:
@@ -597,6 +617,10 @@ class DecodeEngine:
             tok = int(ids[i])
             seq.stream._put(tok)
             _tokens_counter().inc(model=self.name)
+            if seq.ctx is not None:
+                seq.ctx.instant(
+                    "inter_token", index=seq.generated,
+                    gap_ms=round((now - seq.t_last) * 1e3, 3))
             _intertoken_hist().observe(now - seq.t_last,
                                        model=self.name)
             seq.t_last = now

@@ -49,18 +49,24 @@ import time
 from pathlib import Path
 from typing import List, Optional
 
-from deeplearning4j_tpu.common import telemetry
+from deeplearning4j_tpu.common import telemetry, tracectx
 from deeplearning4j_tpu.common.httputil import (QuietHandler,
                                                 start_http_server)
+from deeplearning4j_tpu.serving import reqrec
 from deeplearning4j_tpu.serving.admission import AdmissionController
 from deeplearning4j_tpu.serving.registry import ModelRegistry
 from deeplearning4j_tpu.serving.server import InferenceServer
+from deeplearning4j_tpu.serving.slo import SLOTracker
 
 _ROUTE_RE = re.compile(r"^/v1/models/([^/:]+):(predict|generate)$")
 
-#: end-to-end headers the proxy relays verbatim in each direction
-_RELAY_REQ = ("Content-Type", "X-Deadline-Ms")
-_RELAY_RESP = ("Content-Type", "Retry-After", "X-Model-Version")
+#: end-to-end headers the proxy relays verbatim in each direction —
+#: the trace id crosses BOTH ways, so the replica adopts the router's
+#: id and the client reads it back off the response
+_RELAY_REQ = ("Content-Type", "X-Deadline-Ms",
+              tracectx.TRACE_HEADER)
+_RELAY_RESP = ("Content-Type", "Retry-After", "X-Model-Version",
+               tracectx.TRACE_HEADER)
 
 
 def _healthy_gauge() -> telemetry.Gauge:
@@ -182,12 +188,21 @@ class ServingRouter:
                                    "text/plain", 200 if ok else 503)
                 elif self.path == "/metrics":
                     self.send_metrics()
+                elif self.path == "/api/slo":
+                    # replicas are in-process: the tracker is the
+                    # shared process singleton
+                    self.send_json(SLOTracker.get().report())
                 else:
                     self.send_json({"error": "not found"}, 404)
 
             def do_POST(self):              # noqa: N802
                 m = _ROUTE_RE.match(self.path)
                 if not m:
+                    if self.path == "/api/reqrec/dump":
+                        path = reqrec.get().dump("api")
+                        self.send_json({"path": path},
+                                       200 if path else 503)
+                        return
                     self.send_json({"error": "not found"}, 404)
                     return
                 router._proxy(self)
@@ -285,16 +300,38 @@ class ServingRouter:
             "requests dispatched by the router per replica and "
             "relayed HTTP status (replica=none -> no replica could "
             "take the request, 502)")
+        # trace id minted at the fleet ingress (or adopted from the
+        # client); _RELAY_REQ carries it into the replica, which
+        # adopts it — the replica's `request` root span nests inside
+        # the router's `req.route` envelope under one id
+        tid = tracectx._clean_id(
+            handler.headers.get(tracectx.TRACE_HEADER))
+        if tid is None and tracectx.request_trace_enabled():
+            tid = tracectx.mint_trace_id()
+        handler._trace_id = tid
+        t0_wall, t0_mono = time.time(), time.monotonic()
+
+        def route_span(replica: str, status) -> None:
+            if tid:
+                telemetry.span_at(
+                    "req.route", t0_wall,
+                    time.monotonic() - t0_mono, trace=tid,
+                    replica=replica, status=str(status))
+
         body = handler.read_body()
         req_headers = {h: handler.headers[h] for h in _RELAY_REQ
                        if handler.headers.get(h)}
+        if tid:
+            req_headers[tracectx.TRACE_HEADER] = tid
         tried = []
         while True:
             rep = self._pick(exclude=tried)
             if rep is None:
                 counted.inc(replica="none", code="502")
                 handler.send_json(
-                    {"error": "no healthy replica available"}, 502)
+                    {"error": "no healthy replica available"}, 502,
+                    {tracectx.TRACE_HEADER: tid} if tid else None)
+                route_span("none", 502)
                 return
             tried.append(rep)
             rep.begin()
@@ -310,6 +347,11 @@ class ServingRouter:
                 resp_headers = {h: resp.getheader(h)
                                 for h in _RELAY_RESP
                                 if resp.getheader(h)}
+                # which replica served is part of the verdict
+                resp_headers[tracectx.REPLICA_HEADER] = rep.name
+                if tid:
+                    resp_headers.setdefault(tracectx.TRACE_HEADER,
+                                            tid)
                 status = resp.status
                 if chunked:
                     # token stream: relay incrementally so the client
@@ -318,6 +360,7 @@ class ServingRouter:
                     self._relay_stream(handler, rep, resp,
                                        resp_headers, status, counted)
                     conn.close()
+                    route_span(rep.name, status)
                     return
                 payload = resp.read()
                 conn.close()
@@ -333,6 +376,7 @@ class ServingRouter:
                                      "application/json")
             handler.send_body(payload, ctype, status,
                               headers=resp_headers)
+            route_span(rep.name, status)
             return
 
     def _relay_stream(self, handler, rep, resp, resp_headers, status,

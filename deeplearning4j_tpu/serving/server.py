@@ -18,6 +18,22 @@ Endpoints:
 - ``GET /healthz`` — process liveness (200 while serving).
 - ``GET /readyz`` — 200 once ≥1 model is READY and not draining.
 - ``GET /metrics`` — the process-wide Prometheus registry.
+- ``GET /api/slo`` — per-model in-SLO fraction / burn rates /
+  remaining error budget (``serving.slo.SLOTracker.report``).
+- ``GET /api/reqrec`` — the request flight recorder's live ring
+  (``?n=`` caps the tail); ``POST /api/reqrec/dump`` forces a dump.
+
+Request observatory: every request gets a
+:class:`~deeplearning4j_tpu.common.tracectx.TraceContext` at ingress
+(trace id minted, or adopted from ``X-Dl4j-Trace-Id``; echoed on the
+response), phase spans (``admit``/``queue``/``batch_wait``/``device``/
+``serialize``; ``stream`` + per-token instants for generate) land in
+the chrome-trace ring under one ``request`` root span, the total
+latency carries the trace id as a histogram exemplar, and the
+completed request is appended to the
+:class:`~deeplearning4j_tpu.serving.reqrec.RequestRecorder` ring
+(sheds feed its storm detector). ``DL4J_TPU_REQUEST_TRACE=0``
+disables all of it.
 
 The raw ``.npy`` path is **zero-copy** end to end: the request body is
 parsed with ``httputil.npy_view`` (an ndarray aliasing the received
@@ -48,20 +64,32 @@ from typing import Optional
 
 import numpy as np
 
-from deeplearning4j_tpu.common import telemetry
+from deeplearning4j_tpu.common import telemetry, tracectx
 from deeplearning4j_tpu.common.httputil import (QuietHandler, npy_header,
                                                 npy_view,
                                                 start_http_server)
+from deeplearning4j_tpu.serving import reqrec
 from deeplearning4j_tpu.serving.admission import (AdmissionController,
                                                   DeadlineExceeded,
                                                   ShedError,
                                                   deadline_after_ms)
 from deeplearning4j_tpu.serving.registry import ModelRegistry
+from deeplearning4j_tpu.serving.slo import SLOTracker
 
 _PREDICT_RE = re.compile(r"^/v1/models/([^/:]+):predict$")
 _GENERATE_RE = re.compile(r"^/v1/models/([^/:]+):generate$")
 
 _NPY_TYPES = ("application/octet-stream", "application/x-npy")
+
+
+def _query_int(path: str, key: str, default: int) -> int:
+    """``?key=N`` from a request path (default on absence/garbage)."""
+    from urllib.parse import parse_qs, urlsplit
+    try:
+        vals = parse_qs(urlsplit(path).query).get(key)
+        return int(vals[0]) if vals else default
+    except (ValueError, TypeError, IndexError):
+        return default
 
 
 class InferenceServer:
@@ -102,6 +130,12 @@ class InferenceServer:
                                    "text/plain", 200 if ok else 503)
                 elif self.path == "/metrics":
                     self.send_metrics()
+                elif self.path == "/api/slo":
+                    self.send_json(SLOTracker.get().report())
+                elif self.path.split("?")[0] == "/api/reqrec":
+                    n = _query_int(self.path, "n", 100)
+                    self.send_json(
+                        {"requests": reqrec.get().records(n)})
                 else:
                     self.send_json({"error": "not found"}, 404)
 
@@ -113,6 +147,11 @@ class InferenceServer:
                 g = _GENERATE_RE.match(self.path)
                 if g:
                     server._generate(self, g.group(1))
+                    return
+                if self.path == "/api/reqrec/dump":
+                    path = reqrec.get().dump("api")
+                    self.send_json({"path": path},
+                                   200 if path else 503)
                     return
                 self.send_json({"error": "not found"}, 404)
 
@@ -145,14 +184,38 @@ class InferenceServer:
         return f"http://{host}:{self.port}"
 
     # ------------------------------------------------------------------
+    def _finish_request(self, ctx, verdict) -> None:
+        """Close a request's trace (root span with the verdict) and
+        append it to the flight-recorder ring."""
+        if not ctx:
+            return
+        ctx.finish(verdict)
+        reqrec.get().record(
+            ctx, verdict,
+            queue_depth=self.admission.inflight(ctx.model))
+
     def _predict(self, handler: QuietHandler, name: str):
+        ctx = tracectx.start(name, "predict",
+                             handler.headers.get(tracectx.TRACE_HEADER))
+        handler._trace_id = ctx.trace_id if ctx else None
+        with tracectx.bind(ctx):
+            self._predict_traced(handler, name, ctx)
+
+    def _predict_traced(self, handler: QuietHandler, name: str, ctx):
         counted = telemetry.counter(
             "dl4j_serving_requests_total",
             "predict requests by model and HTTP status code")
+        trace_headers = ({tracectx.TRACE_HEADER: ctx.trace_id}
+                         if ctx else {})
 
         def finish_json(obj, code, headers=None):
             counted.inc(model=name, code=str(code))
-            handler.send_json(obj, code, headers)
+            hdrs = dict(trace_headers)
+            if headers:
+                hdrs.update(headers)
+            with ctx.phase("serialize"):
+                handler.send_json(obj, code, hdrs or None)
+            self._finish_request(ctx, code)
 
         try:
             version = self.registry.model(name)
@@ -192,10 +255,13 @@ class InferenceServer:
             float(deadline_ms) if deadline_ms is not None else None)
         t_start = time.monotonic()
         try:
-            # track() admits first: an already-expired deadline
-            # fast-fails 504 here without ever occupying a slot
-            with self.admission.track(name, deadline):
-                fut = version.batcher.submit(x, deadline=deadline)
+            # admit first (the unrolled track()): an already-expired
+            # deadline fast-fails 504 here without occupying a slot
+            with ctx.phase("admit"):
+                self.admission.admit(name, deadline)
+            try:
+                fut = version.batcher.submit(x, deadline=deadline,
+                                             ctx=ctx or None)
                 timeout = (float(deadline_ms) / 1e3 + 1.0
                            if deadline_ms is not None
                            else self.request_timeout_s)
@@ -205,11 +271,14 @@ class InferenceServer:
                     # pre-3.11 futures.TimeoutError is its own type
                     fut.cancel()
                     raise
+            finally:
+                self.admission.release(name)
         except DeadlineExceeded as e:
             finish_json({"error": str(e)}, 504)
             return
         except ShedError as e:
             code = 503 if e.reason == "draining" else 429
+            reqrec.get().note_shed(name, e.reason)
             finish_json(
                 {"error": str(e), "reason": e.reason}, code,
                 {"Retry-After": self.admission.retry_after_header(name)})
@@ -220,17 +289,21 @@ class InferenceServer:
         except Exception as e:          # model raised during compute
             finish_json({"error": f"inference failed: {e}"}, 500)
             return
-        self.admission.observe_total(name,
-                                     time.monotonic() - t_start)
+        self.admission.observe_total(
+            name, time.monotonic() - t_start,
+            trace_id=ctx.trace_id if ctx else None)
         if raw:
             out_arr = np.ascontiguousarray(np.asarray(out))
             counted.inc(model=name, code="200")
+            hdrs = {"X-Model-Version": str(version.version)}
+            hdrs.update(trace_headers)
             # header + the array's own buffer, streamed — np.save's
             # BytesIO join copy is gone
-            handler.send_body_parts(
-                [npy_header(out_arr), memoryview(out_arr)],
-                "application/octet-stream",
-                headers={"X-Model-Version": str(version.version)})
+            with ctx.phase("serialize"):
+                handler.send_body_parts(
+                    [npy_header(out_arr), memoryview(out_arr)],
+                    "application/octet-stream", headers=hdrs)
+            self._finish_request(ctx, 200)
         else:
             finish_json({"outputs": np.asarray(out).tolist(),
                          "model": name,
@@ -239,6 +312,13 @@ class InferenceServer:
 
     # ------------------------------------------------------------------
     def _generate(self, handler: QuietHandler, name: str):
+        ctx = tracectx.start(name, "generate",
+                             handler.headers.get(tracectx.TRACE_HEADER))
+        handler._trace_id = ctx.trace_id if ctx else None
+        with tracectx.bind(ctx):
+            self._generate_traced(handler, name, ctx)
+
+    def _generate_traced(self, handler: QuietHandler, name: str, ctx):
         """``POST /v1/models/<name>:generate`` — autoregressive decode
         with streaming response.
 
@@ -257,10 +337,17 @@ class InferenceServer:
         counted = telemetry.counter(
             "dl4j_serving_requests_total",
             "predict requests by model and HTTP status code")
+        trace_headers = ({tracectx.TRACE_HEADER: ctx.trace_id}
+                         if ctx else {})
 
         def finish_json(obj, code, headers=None):
             counted.inc(model=name, code=str(code))
-            handler.send_json(obj, code, headers)
+            hdrs = dict(trace_headers)
+            if headers:
+                hdrs.update(headers)
+            with ctx.phase("serialize"):
+                handler.send_json(obj, code, hdrs or None)
+            self._finish_request(ctx, code)
 
         try:
             version = self.registry.model(name)
@@ -294,11 +381,16 @@ class InferenceServer:
         cost = version.batcher.generate_cost(len(prompt), max_tokens)
         tokens_out, idx = [], 0
         headers_sent = False
+        t_first = None
         try:
-            with self.admission.track(name, deadline, cost=cost):
+            # unrolled track(): admit by token-cost, release in the
+            # finally below
+            with ctx.phase("admit"):
+                self.admission.admit(name, deadline, cost=cost)
+            try:
                 stream = version.batcher.submit_generate(
                     prompt, max_tokens, temperature=temperature,
-                    top_k=top_k, deadline=deadline)
+                    top_k=top_k, deadline=deadline, ctx=ctx or None)
                 per_token_timeout = self.request_timeout_s
                 try:
                     while True:
@@ -306,15 +398,22 @@ class InferenceServer:
                         if tok is None:          # closed: see reason
                             break
                         if idx == 0:
+                            t_first = time.monotonic()
                             # TTFT feeds the AIMD controller — the
                             # generative SLO observation stream
                             self.admission.observe_total(
-                                name, time.monotonic() - t_start)
+                                name, t_first - t_start,
+                                trace_id=(ctx.trace_id if ctx
+                                          else None))
+                            ctx.instant("ttft", ms=round(
+                                (t_first - t_start) * 1e3, 3))
                             if streaming:
+                                hdrs = {"X-Model-Version":
+                                        str(version.version)}
+                                hdrs.update(trace_headers)
                                 handler.begin_chunks(
                                     "application/x-ndjson",
-                                    headers={"X-Model-Version":
-                                             str(version.version)})
+                                    headers=hdrs)
                                 headers_sent = True
                         if streaming:
                             handler.send_chunk(json.dumps(
@@ -323,6 +422,10 @@ class InferenceServer:
                         else:
                             tokens_out.append(tok)
                         idx += 1
+                    if t_first is not None:
+                        # the stream phase: first token -> stream end
+                        ctx.phase_at("stream", t_first,
+                                     time.monotonic())
                 except (OSError, BrokenPipeError):
                     # client went away mid-stream: cancel so the
                     # engine retires the sequence and frees its KV
@@ -330,21 +433,31 @@ class InferenceServer:
                     stream.cancel()
                     counted.inc(model=name, code="499")
                     handler.close_connection = True
+                    if t_first is not None:
+                        ctx.phase_at("stream", t_first,
+                                     time.monotonic())
+                    ctx.note(tokens=idx)
+                    self._finish_request(ctx, 499)
                     return
                 except Exception:
                     stream.cancel()
                     raise
+            finally:
+                self.admission.release(name, cost=cost)
         except DeadlineExceeded as e:
             if headers_sent:
                 handler.abort_chunks()
+                self._finish_request(ctx, 504)
             else:
                 finish_json({"error": str(e)}, 504)
             return
         except ShedError as e:
+            reqrec.get().note_shed(name, e.reason)
+            code = 503 if e.reason == "draining" else 429
             if headers_sent:
                 handler.abort_chunks()
+                self._finish_request(ctx, code)
             else:
-                code = 503 if e.reason == "draining" else 429
                 finish_json(
                     {"error": str(e), "reason": e.reason}, code,
                     {"Retry-After":
@@ -356,9 +469,11 @@ class InferenceServer:
             # wedged connection); before headers: a plain 500
             if headers_sent:
                 handler.abort_chunks()
+                self._finish_request(ctx, 500)
             else:
                 finish_json({"error": f"generate failed: {e}"}, 500)
             return
+        ctx.note(tokens=idx)
         if streaming:
             if not headers_sent:
                 # closed before the first token (e.g. deadline hit in
@@ -368,11 +483,13 @@ class InferenceServer:
                                       f"first token "
                                       f"({stream.reason})"}, code)
                 return
-            handler.send_chunk(json.dumps(
-                {"done": True, "reason": stream.reason,
-                 "tokens": idx}).encode() + b"\n")
-            handler.end_chunks()
+            with ctx.phase("serialize"):
+                handler.send_chunk(json.dumps(
+                    {"done": True, "reason": stream.reason,
+                     "tokens": idx}).encode() + b"\n")
+                handler.end_chunks()
             counted.inc(model=name, code="200")
+            self._finish_request(ctx, 200)
         else:
             finish_json({"tokens": tokens_out,
                          "reason": stream.reason,

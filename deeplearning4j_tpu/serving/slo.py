@@ -1,0 +1,197 @@
+"""SLO error-budget accounting over the serving latency stream.
+
+SRE-style burn-rate accounting applied to the per-model
+``dl4j_serving_total_seconds`` observations the admission controller
+already collects: each completed request is classified in/out of SLO
+against the model's ``latency_slo_ms``, and per-model rolling windows
+answer the three questions a pager needs —
+
+- **in-SLO fraction**: what share of recent requests met the SLO,
+  over a fast (default 5m) and a slow (default 1h) window;
+- **budget remaining**: with an availability target of ``target``
+  (default 0.99 → a 1% error budget), how much of the slow window's
+  budget is left (1.0 = untouched, 0.0 = exhausted, negative =
+  overdrawn);
+- **burn rate**: violation fraction ÷ error budget per window — the
+  multi-window signal (fast AND slow both >1 means "burning now and
+  it's not a blip"). The AIMD admission controller logs the fast burn
+  rate against every budget shrink, so a shrink decision is
+  explainable after the fact.
+
+Surfaced three ways: ``dl4j_slo_*`` gauges on ``/metrics``, the
+``GET /api/slo`` report on both the replica server and the router,
+and :meth:`SLOTracker.report` for tests/tools.
+
+One process-wide tracker (replicas share a process in the router
+harness, so the router's endpoint reads the same object); windows and
+target are env-tunable (``DL4J_TPU_SLO_TARGET``,
+``DL4J_TPU_SLO_FAST_S``, ``DL4J_TPU_SLO_SLOW_S``) and ``now`` is
+injectable everywhere for deterministic tests.
+"""
+from __future__ import annotations
+
+import os
+import threading
+import time
+from collections import deque
+from typing import Deque, Dict, Optional, Tuple
+
+from deeplearning4j_tpu.common import telemetry
+
+#: per-model event-window bound — 1h of history at bounded memory;
+#: beyond it the oldest events age out early (conservative: the
+#: report then covers a shorter effective window, never a stale one)
+_MAX_EVENTS = 8192
+
+
+def _in_fraction_gauge() -> telemetry.Gauge:
+    return telemetry.gauge(
+        "dl4j_slo_in_fraction",
+        "fraction of completed requests inside the model's "
+        "latency_slo_ms over the rolling window "
+        "(window=fast|slow), per model")
+
+
+def _burn_gauge() -> telemetry.Gauge:
+    return telemetry.gauge(
+        "dl4j_slo_burn_rate",
+        "SLO error-budget burn rate per rolling window "
+        "(violation fraction / error budget; 1.0 = burning exactly "
+        "at budget, >1 = on course to exhaust it), per model")
+
+
+def _budget_gauge() -> telemetry.Gauge:
+    return telemetry.gauge(
+        "dl4j_slo_budget_remaining",
+        "share of the slow window's error budget still unspent "
+        "(1 = untouched, 0 = exhausted, negative = overdrawn), "
+        "per model")
+
+
+class SLOTracker:
+    """Per-model rolling-window in-SLO / burn-rate accounting."""
+
+    _instance: Optional["SLOTracker"] = None
+    _instance_lock = threading.Lock()
+
+    def __init__(self, target: Optional[float] = None,
+                 fast_window_s: Optional[float] = None,
+                 slow_window_s: Optional[float] = None):
+        self.target = float(target if target is not None else
+                            os.environ.get("DL4J_TPU_SLO_TARGET",
+                                           "0.99"))
+        if not 0.0 < self.target < 1.0:
+            raise ValueError(f"SLO target must be in (0, 1), got "
+                             f"{self.target}")
+        self.fast_window_s = float(
+            fast_window_s if fast_window_s is not None else
+            os.environ.get("DL4J_TPU_SLO_FAST_S", "300"))
+        self.slow_window_s = float(
+            slow_window_s if slow_window_s is not None else
+            os.environ.get("DL4J_TPU_SLO_SLOW_S", "3600"))
+        self._lock = threading.Lock()
+        #: per model: (monotonic_ts, in_slo) completion events
+        self._events: Dict[str, Deque[Tuple[float, bool]]] = {}
+        self._slo_ms: Dict[str, float] = {}
+
+    @classmethod
+    def get(cls) -> "SLOTracker":
+        inst = cls._instance
+        if inst is not None:
+            return inst
+        with cls._instance_lock:
+            if cls._instance is None:
+                cls._instance = cls()
+            return cls._instance
+
+    @classmethod
+    def _reset_for_tests(cls):
+        with cls._instance_lock:
+            cls._instance = None
+
+    # ------------------------------------------------------------------
+    def observe(self, model: str, seconds: float, slo_ms: float,
+                now: Optional[float] = None) -> None:
+        """Classify one completed request against ``slo_ms`` and
+        refresh the model's gauges. ``now`` (monotonic) is injectable
+        for deterministic tests."""
+        now = time.monotonic() if now is None else now
+        ok = (seconds * 1e3) <= float(slo_ms)
+        with self._lock:
+            self._slo_ms[model] = float(slo_ms)
+            events = self._events.setdefault(
+                model, deque(maxlen=_MAX_EVENTS))
+            events.append((now, ok))
+        if telemetry.enabled():
+            self._publish(model, now)
+
+    def _window_stats(self, events, horizon: float
+                      ) -> Tuple[int, int]:
+        """(n, violations) among events at/after ``horizon``."""
+        n = bad = 0
+        for ts, ok in reversed(events):
+            if ts < horizon:
+                break
+            n += 1
+            if not ok:
+                bad += 1
+        return n, bad
+
+    def _stats_locked(self, model: str, now: float) -> dict:
+        events = self._events.get(model)
+        if not events:
+            return {}
+        budget = 1.0 - self.target
+        out = {"slo_ms": self._slo_ms.get(model),
+               "target": self.target, "windows": {}}
+        for label, win in (("fast", self.fast_window_s),
+                           ("slow", self.slow_window_s)):
+            n, bad = self._window_stats(events, now - win)
+            frac_in = (n - bad) / n if n else 1.0
+            burn = (bad / n) / budget if n else 0.0
+            out["windows"][label] = {
+                "window_s": win, "n": n,
+                "in_slo_fraction": frac_in,
+                "burn_rate": burn}
+        slow = out["windows"]["slow"]
+        out["budget_remaining"] = 1.0 - slow["burn_rate"]
+        return out
+
+    def _publish(self, model: str, now: float) -> None:
+        with self._lock:
+            stats = self._stats_locked(model, now)
+        if not stats:
+            return
+        for label, w in stats["windows"].items():
+            _in_fraction_gauge().set(w["in_slo_fraction"],
+                                     model=model, window=label)
+            _burn_gauge().set(w["burn_rate"], model=model,
+                              window=label)
+        _budget_gauge().set(stats["budget_remaining"], model=model)
+
+    # ------------------------------------------------------------------
+    def burn_rate(self, model: str, window: str = "fast",
+                  now: Optional[float] = None) -> Optional[float]:
+        """The named window's current burn rate (None before any
+        observation for ``model``)."""
+        now = time.monotonic() if now is None else now
+        with self._lock:
+            stats = self._stats_locked(model, now)
+        if not stats:
+            return None
+        return stats["windows"][window]["burn_rate"]
+
+    def report(self, now: Optional[float] = None) -> dict:
+        """The ``GET /api/slo`` document: per-model windows, in-SLO
+        fractions, burn rates, and remaining budget."""
+        now = time.monotonic() if now is None else now
+        with self._lock:
+            models = {m: self._stats_locked(m, now)
+                      for m in self._events}
+        return {"target": self.target,
+                "fast_window_s": self.fast_window_s,
+                "slow_window_s": self.slow_window_s,
+                "models": models}
+
+
+telemetry.on_reset(SLOTracker._reset_for_tests)

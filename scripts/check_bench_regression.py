@@ -45,7 +45,13 @@ lower-is-better and ``goodput_rps`` / ``in_slo_pct`` /
 serialization ``speedup`` higher-is-better — the continuous-batching
 claim is "lower tail latency AND more useful completions per second
 at the same offered load"; ``meta.transport_rtt_ms`` rides in the
-skipped ``meta`` block, so rig RTT never gates.  The ISSUE-16
+skipped ``meta`` block, so rig RTT never gates.  The ISSUE-17
+``serving_observatory`` block gates its tracing-on/off p50 pair
+(``p50_on_ms`` / ``p50_off_ms``) and ``trace_overhead_pct``
+lower-is-better via the usual ``_ms`` / ``overhead`` rules — the
+``_pct`` leaf compares in absolute points, holding the "tracing
+default-on costs ≤1% on the predict hot path" claim round over
+round.  The ISSUE-16
 ``generative`` block gates decode ``goodput_tokens_per_s`` and
 ``occupancy_mean`` higher-is-better; ``ttft_*_ms`` /
 ``intertoken_*_ms`` / the paged-vs-dense ``*_step_ms`` pair and any

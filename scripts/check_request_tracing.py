@@ -1,0 +1,266 @@
+#!/usr/bin/env python
+"""CI gate: one traced request yields a connected span tree.
+
+Boots a 2-replica :class:`ServingRouter`, sends ONE predict request
+through the router with an explicit ``X-Dl4j-Trace-Id``, and checks
+the observatory's structural contract end to end:
+
+1. the response echoes the trace id and stamps ``X-Dl4j-Replica``;
+2. the chrome-trace ring holds exactly one ``request`` root span for
+   the trace id, and every ``req.<phase>`` span with that id nests
+   inside the root's interval (no orphans), with the phase durations
+   summing to ≤ the root duration;
+3. the router's ``req.route`` envelope span contains the root — the
+   cross-hop join is a real containment, not two disconnected
+   timelines;
+4. the total-latency histogram carries the trace id as its exemplar.
+
+Then the shed-storm dump smoke test: a ``max_queue=1`` replica with a
+slow model is hammered until admission sheds past the storm
+threshold, and the request flight recorder must produce a JSONL dump
+whose records carry per-phase timings.
+
+Accelerator-free: runs on the CPU backend in-process, like the other
+gates in ci_check.sh.
+
+Usage: JAX_PLATFORMS=cpu python scripts/check_request_tracing.py
+Exit 0 = gate holds, 1 = a clause failed.
+"""
+from __future__ import annotations
+
+import json
+import os
+import sys
+import tempfile
+import threading
+import urllib.error
+import urllib.request
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+# storm knobs must be in the environment BEFORE the recorder builds
+os.environ.setdefault("DL4J_TPU_REQREC_SHED_THRESHOLD", "5")
+os.environ.setdefault("DL4J_TPU_REQREC_SHED_WINDOW_S", "30")
+_TMP = tempfile.mkdtemp(prefix="dl4j_reqrec_gate_")
+os.environ["DL4J_TPU_REQREC_DIR"] = _TMP
+
+import numpy as np  # noqa: E402
+
+TRACE_ID = "ci-gate-trace-0001"
+
+
+def _mlp(seed):
+    from deeplearning4j_tpu.activations import Activation
+    from deeplearning4j_tpu.learning.updaters import Sgd
+    from deeplearning4j_tpu.lossfunctions import LossFunction
+    from deeplearning4j_tpu.nn.conf.builders import \
+        NeuralNetConfiguration
+    from deeplearning4j_tpu.nn.conf.inputs import InputType
+    from deeplearning4j_tpu.nn.conf.layers import (DenseLayer,
+                                                   OutputLayer)
+    from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+    conf = (NeuralNetConfiguration.Builder()
+            .seed(seed).updater(Sgd(0.1))
+            .list()
+            .layer(DenseLayer(n_in=8, n_out=16,
+                              activation=Activation.TANH))
+            .layer(OutputLayer(n_out=3,
+                               loss_function=LossFunction.MCXENT,
+                               activation=Activation.SOFTMAX))
+            .set_input_type(InputType.feed_forward(8))
+            .build())
+    return MultiLayerNetwork(conf).init()
+
+
+def _post(url, payload, headers=None):
+    req = urllib.request.Request(
+        url, data=json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json",
+                 **(headers or {})})
+    try:
+        r = urllib.request.urlopen(req, timeout=60)
+        return r.status, r.read(), dict(r.headers)
+    except urllib.error.HTTPError as e:
+        return e.code, e.read(), dict(e.headers)
+
+
+def _span_tree_clauses(failures):
+    """Clauses 2-4: connected span tree + exemplar for TRACE_ID."""
+    import time
+
+    from deeplearning4j_tpu.common import telemetry
+
+    # the replica handler emits its root span AFTER the response bytes
+    # are on the wire, so a client that just read the body can race
+    # it — poll briefly, like any async trace consumer
+    deadline = time.monotonic() + 5.0
+    while True:
+        events = [e for e in telemetry.trace_events()
+                  if e.get("args", {}).get("trace") == TRACE_ID]
+        if (any(e["name"] == "request" for e in events)
+                and any(e["name"] == "req.route" for e in events)) \
+                or time.monotonic() >= deadline:
+            break
+        time.sleep(0.02)
+    roots = [e for e in events if e["name"] == "request"]
+    if len(roots) != 1:
+        failures.append(f"expected exactly 1 'request' root span for "
+                        f"the trace id, got {len(roots)}")
+        return
+    root = roots[0]
+    r0, r1 = root["ts"], root["ts"] + root["dur"]
+    phases = [e for e in events if e["name"].startswith("req.")
+              and e["name"] != "req.route" and e.get("ph") == "X"]
+    if not phases:
+        failures.append("no req.<phase> spans under the root")
+    #: chrome-trace timestamps are integer µs; allow 1ms of rounding
+    slack = 1000
+    for e in phases:
+        if e["ts"] < r0 - slack or e["ts"] + e["dur"] > r1 + slack:
+            failures.append(
+                f"orphan phase span {e['name']}: "
+                f"[{e['ts']}, {e['ts'] + e['dur']}] outside root "
+                f"[{r0}, {r1}]")
+    total_phase = sum(e["dur"] for e in phases)
+    if total_phase > root["dur"] + slack:
+        failures.append(
+            f"phase durations sum to {total_phase}µs > root span "
+            f"{root['dur']}µs")
+    for want in ("req.admit", "req.queue", "req.device",
+                 "req.serialize"):
+        if not any(e["name"] == want for e in phases):
+            failures.append(f"missing phase span {want}")
+    routes = [e for e in events if e["name"] == "req.route"]
+    if len(routes) != 1:
+        failures.append(f"expected exactly 1 req.route envelope span, "
+                        f"got {len(routes)}")
+    else:
+        q0, q1 = routes[0]["ts"], routes[0]["ts"] + routes[0]["dur"]
+        if r0 < q0 - slack or r1 > q1 + slack:
+            failures.append(
+                f"req.route [{q0}, {q1}] does not contain the "
+                f"request root [{r0}, {r1}]")
+    ex = telemetry.histogram(
+        "dl4j_serving_total_seconds").exemplar_of(model="gate")
+    if not ex or ex["labels"].get("trace_id") != TRACE_ID:
+        failures.append(f"latency histogram exemplar does not carry "
+                        f"the trace id (got {ex!r})")
+
+
+class _SlowModel:
+    def output(self, x):
+        import time
+        x = np.asarray(x)
+        time.sleep(0.05)
+        return x[:, :1]
+
+
+def _storm_clause(failures):
+    """Shed-storm dump smoke test: a max_queue=1 replica under a
+    burst of concurrent requests must shed past the storm threshold
+    and the flight recorder must dump records with phase timings."""
+    from deeplearning4j_tpu.serving import reqrec
+    from deeplearning4j_tpu.serving.admission import \
+        AdmissionController
+    from deeplearning4j_tpu.serving.registry import ModelRegistry
+    from deeplearning4j_tpu.serving.server import InferenceServer
+
+    registry = ModelRegistry(default_buckets=(8,))
+    registry.register("stormy", _SlowModel())
+    srv = InferenceServer(registry,
+                          AdmissionController(max_queue=1)).start(0)
+    url = f"{srv.url}/v1/models/stormy:predict"
+    payload = {"inputs": np.zeros((1, 8), np.float32).tolist()}
+    codes = []
+    lock = threading.Lock()
+
+    def client():
+        for _ in range(4):
+            code, _, _ = _post(url, payload)
+            with lock:
+                codes.append(code)
+
+    threads = [threading.Thread(target=client) for _ in range(8)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=60)
+    srv.stop(drain=False)
+    registry.shutdown()
+
+    sheds = sum(1 for c in codes if c == 429)
+    if sheds < int(os.environ["DL4J_TPU_REQREC_SHED_THRESHOLD"]):
+        failures.append(f"storm did not materialize: only {sheds} "
+                        f"sheds across {len(codes)} requests")
+        return
+    dumps = [f for f in os.listdir(_TMP)
+             if "shed_storm" in f and f.endswith(".jsonl")]
+    if not dumps:
+        failures.append(f"no shed_storm dump in {_TMP} after "
+                        f"{sheds} sheds")
+        return
+    with open(os.path.join(_TMP, sorted(dumps)[0])) as f:
+        lines = [json.loads(ln) for ln in f if ln.strip()]
+    meta, records = lines[0], lines[1:]
+    if meta.get("reason") != "shed_storm":
+        failures.append(f"dump meta reason {meta.get('reason')!r}")
+    timed = [r for r in records if r.get("phase_ms")]
+    if not timed:
+        failures.append("shed_storm dump has no request records with "
+                        "phase timings")
+    else:
+        print(f"request-tracing gate: storm dump holds "
+              f"{len(records)} records ({len(timed)} with phase "
+              f"timings) after {sheds} sheds")
+    del reqrec  # imported for its side registration only
+
+
+def main() -> int:
+    from deeplearning4j_tpu.serving import ServingRouter
+
+    failures = []
+    router = ServingRouter(n_replicas=2, default_buckets=(8,),
+                           health_interval_s=0.5)
+    router.start(0)
+    try:
+        router.rollout("gate", lambda: _mlp(42), warmup_shape=(8,),
+                       latency_slo_ms=500.0)
+        x = np.random.RandomState(0).randn(2, 8).astype(np.float32)
+        code, body, headers = _post(
+            f"{router.url}/v1/models/gate:predict",
+            {"inputs": x.tolist()},
+            headers={"X-Dl4j-Trace-Id": TRACE_ID})
+        if code != 200:
+            failures.append(f"traced predict returned {code}: "
+                            f"{body[:120]!r}")
+        if headers.get("X-Dl4j-Trace-Id") != TRACE_ID:
+            failures.append(
+                f"response did not echo the trace id (got "
+                f"{headers.get('X-Dl4j-Trace-Id')!r})")
+        rep = headers.get("X-Dl4j-Replica", "")
+        if not rep.startswith("replica-"):
+            failures.append(f"response missing X-Dl4j-Replica "
+                            f"(got {rep!r})")
+        if not failures:
+            _span_tree_clauses(failures)
+            print(f"request-tracing gate: one traced predict through "
+                  f"router->{rep} produced a connected span tree "
+                  f"under trace {TRACE_ID}")
+    finally:
+        router.stop(drain=False, timeout=10)
+
+    _storm_clause(failures)
+
+    if failures:
+        for f in failures[:10]:
+            print(f"FAIL: {f}")
+        return 1
+    print("OK: span tree connected (phases nest in the root, root "
+          "nests in req.route, durations consistent), trace id on "
+          "response + exemplar, shed storm dumped the flight "
+          "recorder")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -50,7 +50,15 @@
 #      dense full-re-forward reference, join/leave churn must never
 #      retrace after warmup, and the KV pool must free every block
 #      and reconcile with its dl4j_kv_pool_bytes gauge (the ISSUE 16
-#      acceptance bar, scripts/check_generative.py).
+#      acceptance bar, scripts/check_generative.py);
+#  10. request-tracing gate: one traced predict through the 2-replica
+#      router must yield a connected span tree (every req.<phase>
+#      span inside the request root, the root inside the router's
+#      req.route envelope, durations consistent), echo the trace id
+#      on the response with the latency-histogram exemplar carrying
+#      it, and a forced shed storm must dump the request flight
+#      recorder with per-phase timings (the ISSUE 17 acceptance bar,
+#      scripts/check_request_tracing.py).
 #
 # Usage: scripts/ci_check.sh [--threshold PCT]     (default 10)
 # Exit 0 = all gates clean, 1 = a gate failed, 2 = bad usage.
@@ -115,5 +123,8 @@ JAX_PLATFORMS=cpu python scripts/check_serving_slo.py || fail=1
 
 echo "== generative conformance gate =="
 JAX_PLATFORMS=cpu python scripts/check_generative.py || fail=1
+
+echo "== request-tracing gate =="
+JAX_PLATFORMS=cpu python scripts/check_request_tracing.py || fail=1
 
 exit $fail

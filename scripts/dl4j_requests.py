@@ -1,0 +1,107 @@
+#!/usr/bin/env python
+"""Render the request flight recorder as a slowest-N table.
+
+The operator's view of "which requests were slow, and WHERE did their
+time go": each row is one completed request from the recorder's ring —
+trace id, model, kind, verdict, total latency, and the per-phase
+millisecond breakdown (admit / queue / batch_wait / device / serialize
+/ stream) its TraceContext collected.
+
+Input is either a dump artifact or a live server:
+
+  python scripts/dl4j_requests.py flightrec/reqrec_1234_shed_storm_1.jsonl
+  python scripts/dl4j_requests.py --url http://127.0.0.1:8500 -n 20
+
+``--url`` reads ``GET /api/reqrec`` off a running replica server or
+router. Rows sort by total latency (slowest first); ``-n`` caps the
+table (default 20). ``--json`` emits the selected records as JSONL
+instead of the table (for piping into jq).
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from typing import List
+
+PHASES = ("admit", "queue", "batch_wait", "device", "serialize",
+          "stream")
+
+
+def load_dump(path: str) -> List[dict]:
+    """Records from a ``reqrec_*.jsonl`` dump (meta line skipped)."""
+    out = []
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            rec = json.loads(line)
+            if rec.get("record") == "meta":
+                continue
+            out.append(rec)
+    return out
+
+
+def load_url(url: str, n: int) -> List[dict]:
+    import urllib.request
+    with urllib.request.urlopen(
+            f"{url.rstrip('/')}/api/reqrec?n={n}", timeout=10) as r:
+        return json.load(r)["requests"]
+
+
+def render(records: List[dict], n: int) -> str:
+    rows = sorted(records,
+                  key=lambda r: -float(r.get("total_ms", 0.0)))[:n]
+    if not rows:
+        return "no request records"
+    head = (f"{'trace':16s} {'model':12s} {'kind':8s} {'verdict':7s} "
+            f"{'total':>8s} "
+            + " ".join(f"{p:>10s}" for p in PHASES))
+    lines = [head, "-" * len(head)]
+    for r in rows:
+        ph = r.get("phase_ms", {}) or {}
+        cells = " ".join(
+            f"{ph[p]:10.2f}" if p in ph else f"{'-':>10s}"
+            for p in PHASES)
+        lines.append(
+            f"{str(r.get('trace_id', '?')):16s} "
+            f"{str(r.get('model', '?'))[:12]:12s} "
+            f"{str(r.get('kind', '?')):8s} "
+            f"{str(r.get('verdict', '?')):7s} "
+            f"{float(r.get('total_ms', 0.0)):8.2f} {cells}")
+    lines.append(f"({len(rows)} of {len(records)} records; "
+                 f"columns in ms)")
+    return "\n".join(lines)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="slowest-N serving requests with per-phase "
+                    "latency breakdown")
+    ap.add_argument("dump", nargs="?",
+                    help="a reqrec_*.jsonl dump artifact")
+    ap.add_argument("--url",
+                    help="read the live ring off a server "
+                         "(GET <url>/api/reqrec)")
+    ap.add_argument("-n", type=int, default=20,
+                    help="show the N slowest requests (default 20)")
+    ap.add_argument("--json", action="store_true",
+                    help="emit the selected records as JSONL")
+    args = ap.parse_args(argv)
+    if bool(args.dump) == bool(args.url):
+        ap.error("pass exactly one of: a dump path, or --url")
+    records = (load_dump(args.dump) if args.dump
+               else load_url(args.url, max(args.n * 4, 100)))
+    if args.json:
+        rows = sorted(records,
+                      key=lambda r: -float(r.get("total_ms", 0.0)))
+        for r in rows[:args.n]:
+            print(json.dumps(r))
+    else:
+        print(render(records, args.n))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

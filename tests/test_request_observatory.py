@@ -1,0 +1,554 @@
+"""Serving request observatory tests (ISSUE 17): trace propagation,
+span-tree connectivity, SLO error-budget accounting, and the request
+flight recorder.
+
+The structural contract under test: ONE request = ONE trace id = ONE
+connected timeline. The id round-trips on the ``X-Dl4j-Trace-Id``
+header, every ``req.<phase>`` span nests inside the request's root
+span, the latency histogram's exemplar points at a concrete trace,
+the sampled access log carries the same id, and concurrent requests
+across models never contaminate each other's ids — the leakage
+hazard of reused keep-alive handler threads.
+
+Timing caveat the tests must respect: the replica emits the
+``request`` root span AFTER the response bytes are on the wire
+(finish_json sends, then closes the context), so a client that just
+read the body can race the span — every trace assertion polls.
+"""
+from __future__ import annotations
+
+import http.client
+import json
+import socket
+import struct
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.common import telemetry, tracectx
+from deeplearning4j_tpu.common.telemetry import MetricsRegistry
+from deeplearning4j_tpu.serving import (AdmissionController,
+                                        InferenceServer, ModelRegistry,
+                                        RequestRecorder, SLOTracker)
+
+
+@pytest.fixture(autouse=True)
+def _fresh_registry():
+    MetricsRegistry._reset_for_tests()
+    yield
+    MetricsRegistry._reset_for_tests()
+
+
+def _mlp(seed=42):
+    from deeplearning4j_tpu.activations import Activation
+    from deeplearning4j_tpu.learning.updaters import Sgd
+    from deeplearning4j_tpu.lossfunctions import LossFunction
+    from deeplearning4j_tpu.nn.conf.builders import \
+        NeuralNetConfiguration
+    from deeplearning4j_tpu.nn.conf.inputs import InputType
+    from deeplearning4j_tpu.nn.conf.layers import (DenseLayer,
+                                                   OutputLayer)
+    from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+    conf = (NeuralNetConfiguration.Builder()
+            .seed(seed).updater(Sgd(0.1))
+            .list()
+            .layer(DenseLayer(n_in=8, n_out=16,
+                              activation=Activation.TANH))
+            .layer(OutputLayer(n_out=3,
+                               loss_function=LossFunction.MCXENT,
+                               activation=Activation.SOFTMAX))
+            .set_input_type(InputType.feed_forward(8))
+            .build())
+    return MultiLayerNetwork(conf).init()
+
+
+def _post(base, name, payload, headers=None):
+    h = {"Content-Type": "application/json"}
+    h.update(headers or {})
+    req = urllib.request.Request(
+        f"{base}/v1/models/{name}:predict",
+        data=json.dumps(payload).encode(), headers=h)
+    try:
+        r = urllib.request.urlopen(req, timeout=60)
+        return r.status, r.read(), dict(r.headers)
+    except urllib.error.HTTPError as e:
+        return e.code, e.read(), dict(e.headers)
+
+
+def _serve(name="m", **register_kw):
+    reg = ModelRegistry(default_buckets=(8,))
+    reg.register(name, _mlp(), warmup_shape=(8,), **register_kw)
+    srv = InferenceServer(reg).start(0)
+    return reg, srv
+
+
+def _trace_spans(trace_id, want=("request",), timeout=5.0):
+    """Spans in the ring carrying ``trace_id``, polled until every
+    name in ``want`` has landed (the root span is emitted after the
+    response bytes — see the module docstring)."""
+    deadline = time.monotonic() + timeout
+    while True:
+        events = [e for e in telemetry.trace_events()
+                  if e.get("args", {}).get("trace") == trace_id]
+        names = {e["name"] for e in events}
+        if all(w in names for w in want) \
+                or time.monotonic() >= deadline:
+            return events
+        time.sleep(0.02)
+
+
+def _x(n=2, seed=0):
+    return np.random.RandomState(seed).randn(n, 8).astype(np.float32)
+
+
+# ----------------------------------------------------------------------
+class TestPredictSpanTree:
+    def test_adopted_id_echoes_and_tree_is_connected(self):
+        reg, srv = _serve()
+        tid = "obs-test-predict-01"
+        try:
+            code, body, headers = _post(
+                srv.url, "m", {"inputs": _x().tolist()},
+                headers={tracectx.TRACE_HEADER: tid})
+            assert code == 200
+            assert headers.get(tracectx.TRACE_HEADER) == tid
+        finally:
+            srv.stop(drain=False)
+            reg.shutdown()
+        events = _trace_spans(tid)
+        roots = [e for e in events if e["name"] == "request"]
+        assert len(roots) == 1
+        root = roots[0]
+        assert root["args"]["kind"] == "predict"
+        assert root["args"]["verdict"] == "200"
+        r0, r1 = root["ts"], root["ts"] + root["dur"]
+        phases = {e["name"]: e for e in events
+                  if e["name"].startswith("req.")
+                  and e.get("ph") == "X"}
+        for want in ("req.admit", "req.queue", "req.device",
+                     "req.serialize"):
+            assert want in phases, f"missing {want}"
+        slack = 1000    # chrome-trace integer-µs rounding
+        for e in phases.values():
+            assert e["ts"] >= r0 - slack
+            assert e["ts"] + e["dur"] <= r1 + slack
+
+    def test_exemplar_carries_trace_id(self):
+        reg, srv = _serve()
+        tid = "obs-test-exemplar-01"
+        try:
+            code, _, _ = _post(srv.url, "m",
+                               {"inputs": _x().tolist()},
+                               headers={tracectx.TRACE_HEADER: tid})
+            assert code == 200
+        finally:
+            srv.stop(drain=False)
+            reg.shutdown()
+        ex = telemetry.histogram(
+            "dl4j_serving_total_seconds").exemplar_of(model="m")
+        assert ex is not None
+        assert ex["labels"]["trace_id"] == tid
+
+    def test_minted_id_when_header_absent_or_hostile(self):
+        reg, srv = _serve()
+        try:
+            _, _, h1 = _post(srv.url, "m", {"inputs": _x().tolist()})
+            minted = h1.get(tracectx.TRACE_HEADER)
+            assert minted and len(minted) == 16
+            # a hostile header (spaces, over-long) is never adopted
+            _, _, h2 = _post(
+                srv.url, "m", {"inputs": _x().tolist()},
+                headers={tracectx.TRACE_HEADER: "a bad id!"})
+            assert h2.get(tracectx.TRACE_HEADER) != "a bad id!"
+            _, _, h3 = _post(
+                srv.url, "m", {"inputs": _x().tolist()},
+                headers={tracectx.TRACE_HEADER: "x" * 65})
+            assert h3.get(tracectx.TRACE_HEADER) != "x" * 65
+        finally:
+            srv.stop(drain=False)
+            reg.shutdown()
+
+    def test_gate_off_serves_without_spans_or_header(self):
+        reg, srv = _serve()
+        try:
+            tracectx.set_enabled(False)
+            code, _, headers = _post(
+                srv.url, "m", {"inputs": _x().tolist()},
+                headers={tracectx.TRACE_HEADER: "gated-off-01"})
+            assert code == 200
+            assert tracectx.TRACE_HEADER not in headers
+        finally:
+            tracectx.set_enabled(None)
+            srv.stop(drain=False)
+            reg.shutdown()
+        assert not [e for e in telemetry.trace_events()
+                    if e.get("args", {}).get("trace") == "gated-off-01"]
+
+
+# ----------------------------------------------------------------------
+class TestAccessLog:
+    def test_log_line_carries_trace_id(self, tmp_path, monkeypatch):
+        from deeplearning4j_tpu.common import httputil
+        log = tmp_path / "access.jsonl"
+        monkeypatch.setenv("DL4J_TPU_ACCESS_LOG", str(log))
+        httputil._reset_access_conf()
+        reg, srv = _serve()
+        tid = "obs-test-accesslog-1"
+        try:
+            code, _, _ = _post(srv.url, "m",
+                               {"inputs": _x().tolist()},
+                               headers={tracectx.TRACE_HEADER: tid})
+            assert code == 200
+        finally:
+            srv.stop(drain=False)
+            reg.shutdown()
+            httputil._reset_access_conf()
+        lines = [json.loads(ln) for ln in
+                 log.read_text().strip().splitlines()]
+        mine = [ln for ln in lines if ln["trace_id"] == tid]
+        assert len(mine) == 1
+        assert mine[0]["method"] == "POST"
+        assert mine[0]["path"].endswith("m:predict")
+        assert mine[0]["status"] == 200
+        assert mine[0]["bytes"] > 0
+        assert mine[0]["duration_ms"] > 0
+
+    def test_sampling_keeps_one_in_n(self, tmp_path, monkeypatch):
+        from deeplearning4j_tpu.common import httputil
+        log = tmp_path / "sampled.jsonl"
+        monkeypatch.setenv("DL4J_TPU_ACCESS_LOG", str(log))
+        monkeypatch.setenv("DL4J_TPU_ACCESS_LOG_SAMPLE", "0.5")
+        httputil._reset_access_conf()
+        reg, srv = _serve()
+        try:
+            for i in range(8):
+                code, _, _ = _post(srv.url, "m",
+                                   {"inputs": _x(seed=i).tolist()})
+                assert code == 200
+        finally:
+            srv.stop(drain=False)
+            reg.shutdown()
+            httputil._reset_access_conf()
+        # deterministic 1-in-2: 8 consecutive sequence numbers hold
+        # exactly 4 multiples of 2, wherever the shared counter sat
+        lines = log.read_text().strip().splitlines()
+        assert len(lines) == 4
+
+
+# ----------------------------------------------------------------------
+def _serve_generative(**overrides):
+    from deeplearning4j_tpu.models.decoder import (DecoderConfig,
+                                                   DecoderLM)
+    conf = DecoderConfig.tiny()
+    gen = {"kv_blocks": 32, "kv_block_size": 8,
+           "prompt_buckets": (16,), "decode_buckets": (4,),
+           "max_seq_len": 64}
+    gen.update(overrides)
+    reg = ModelRegistry()
+    reg.register("lm", DecoderLM(conf), generate=gen)
+    srv = InferenceServer(reg).start(0)
+    return reg, srv
+
+
+def _gen_request(port, body, headers=None, timeout=60):
+    conn = http.client.HTTPConnection("127.0.0.1", port,
+                                      timeout=timeout)
+    conn.request("POST", "/v1/models/lm:generate",
+                 body=json.dumps(body).encode(),
+                 headers={"Content-Type": "application/json",
+                          **(headers or {})})
+    return conn, conn.getresponse()
+
+
+class TestGenerateSpanTree:
+    def test_stream_trace_with_ttft_and_stream_phase(self):
+        reg, srv = _serve_generative()
+        tid = "obs-test-generate-1"
+        try:
+            conn, resp = _gen_request(
+                srv.port, {"prompt": [5, 9, 2, 7], "max_tokens": 4},
+                headers={tracectx.TRACE_HEADER: tid})
+            assert resp.status == 200
+            assert resp.getheader(tracectx.TRACE_HEADER) == tid
+            lines = [json.loads(ln) for ln in
+                     resp.read().decode().strip().splitlines()]
+            assert lines[-1]["done"]
+            conn.close()
+        finally:
+            srv.stop(drain=False)
+            reg.shutdown()
+        events = _trace_spans(tid)
+        roots = [e for e in events if e["name"] == "request"]
+        assert len(roots) == 1
+        assert roots[0]["args"]["kind"] == "generate"
+        assert roots[0]["args"]["verdict"] == "200"
+        assert roots[0]["args"]["tokens"] == 4
+        names = {e["name"] for e in events}
+        assert "req.stream" in names
+        assert "req.ttft" in names          # first-token instant
+        assert "req.inter_token" in names   # per-token cadence
+        # the streamed phases nest inside the root like predict's do
+        r0 = roots[0]["ts"]
+        r1 = r0 + roots[0]["dur"]
+        for e in events:
+            if e["name"].startswith("req.") and e.get("ph") == "X":
+                assert e["ts"] >= r0 - 1000
+                assert e["ts"] + e["dur"] <= r1 + 1000
+
+    def test_client_disconnect_closes_span_as_499(self):
+        # enough decode iterations that the stream is still live well
+        # after the client's close — a 60-token stream can finish
+        # into the socket buffers before the disconnect is noticed
+        reg, srv = _serve_generative(kv_blocks=80, max_seq_len=512)
+        tid = "obs-test-cancel-01"
+        try:
+            conn, resp = _gen_request(
+                srv.port, {"prompt": [5, 9, 2, 7],
+                           "max_tokens": 450},
+                headers={tracectx.TRACE_HEADER: tid})
+            resp.fp.readline()      # one token, then slam the socket
+            # a plain close() would linger: resp.fp still references
+            # the fd, and a graceful FIN lets the server stream into
+            # the receive buffer to completion — RST-on-close is the
+            # real "client went away mid-stream"
+            conn.sock.setsockopt(
+                socket.SOL_SOCKET, socket.SO_LINGER,
+                struct.pack("ii", 1, 0))
+            resp.close()
+            conn.close()
+            events = _trace_spans(tid, timeout=15.0)
+            roots = [e for e in events if e["name"] == "request"]
+            assert len(roots) == 1
+            assert roots[0]["args"]["verdict"] == "499"
+            recs = [r for r in RequestRecorder.get().records()
+                    if r["trace_id"] == tid]
+            assert len(recs) == 1
+            assert recs[0]["verdict"] == "499"
+        finally:
+            srv.stop(drain=False)
+            reg.shutdown()
+
+
+# ----------------------------------------------------------------------
+class TestTraceLeakage:
+    def test_concurrent_predict_and_generate_no_crosstalk(self):
+        """Concurrent requests across two models on reused keep-alive
+        handler threads: every response must echo ITS OWN id, and
+        every id must own exactly one root span on the right model —
+        the cross-request contamination the ambient binding could
+        cause if it ever leaked."""
+        from deeplearning4j_tpu.models.decoder import (DecoderConfig,
+                                                       DecoderLM)
+        reg = ModelRegistry(default_buckets=(8,))
+        reg.register("m", _mlp(), warmup_shape=(8,))
+        reg.register("lm", DecoderLM(DecoderConfig.tiny()), generate={
+            "kv_blocks": 32, "kv_block_size": 8,
+            "prompt_buckets": (16,), "decode_buckets": (4,),
+            "max_seq_len": 64})
+        srv = InferenceServer(reg).start(0)
+        errors = []
+        try:
+            def predict_client(k):
+                for i in range(3):
+                    tid = f"leak-p{k}-{i}"
+                    code, _, h = _post(
+                        srv.url, "m", {"inputs": _x(seed=i).tolist()},
+                        headers={tracectx.TRACE_HEADER: tid})
+                    if code != 200:
+                        errors.append(f"predict {tid}: {code}")
+                    elif h.get(tracectx.TRACE_HEADER) != tid:
+                        errors.append(
+                            f"predict {tid} echoed "
+                            f"{h.get(tracectx.TRACE_HEADER)!r}")
+
+            def generate_client(k):
+                for i in range(2):
+                    tid = f"leak-g{k}-{i}"
+                    conn, resp = _gen_request(
+                        srv.port,
+                        {"prompt": [5, 9, 2, 7], "max_tokens": 3},
+                        headers={tracectx.TRACE_HEADER: tid})
+                    got = resp.getheader(tracectx.TRACE_HEADER)
+                    resp.read()
+                    conn.close()
+                    if resp.status != 200:
+                        errors.append(f"generate {tid}: "
+                                      f"{resp.status}")
+                    elif got != tid:
+                        errors.append(f"generate {tid} echoed "
+                                      f"{got!r}")
+
+            threads = [threading.Thread(target=predict_client,
+                                        args=(k,)) for k in range(3)]
+            threads += [threading.Thread(target=generate_client,
+                                         args=(k,)) for k in range(2)]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join(timeout=120)
+            assert not errors, errors
+        finally:
+            srv.stop(drain=False)
+            reg.shutdown()
+        # every id owns exactly one root span, on the right model
+        for tid, model, kind in \
+                [(f"leak-p{k}-{i}", "m", "predict")
+                 for k in range(3) for i in range(3)] + \
+                [(f"leak-g{k}-{i}", "lm", "generate")
+                 for k in range(2) for i in range(2)]:
+            roots = [e for e in _trace_spans(tid)
+                     if e["name"] == "request"]
+            assert len(roots) == 1, f"{tid}: {len(roots)} roots"
+            assert roots[0]["args"]["model"] == model
+            assert roots[0]["args"]["kind"] == kind
+
+
+# ----------------------------------------------------------------------
+class TestSLOAccounting:
+    def test_multi_window_burn_rate_math(self):
+        t = SLOTracker(target=0.99, fast_window_s=300.0,
+                       slow_window_s=3600.0)
+        t0 = 10_000.0
+        for i in range(90):
+            t.observe("m", 0.010, slo_ms=50.0, now=t0 + i * 0.1)
+        for i in range(10):
+            t.observe("m", 0.500, slo_ms=50.0, now=t0 + 9 + i * 0.1)
+        now = t0 + 10
+        # 10/100 violations against a 1% budget → burn rate 10 on
+        # both windows while everything is recent
+        assert t.burn_rate("m", "fast", now=now) == pytest.approx(10.0)
+        assert t.burn_rate("m", "slow", now=now) == pytest.approx(10.0)
+        rep = t.report(now=now)["models"]["m"]
+        assert rep["windows"]["fast"]["in_slo_fraction"] == \
+            pytest.approx(0.90)
+        assert rep["budget_remaining"] == pytest.approx(-9.0)
+        # the fast window forgets the burst, the slow window doesn't:
+        # the multi-window signal that separates a blip from a trend
+        later = t0 + 10 + 400
+        assert t.burn_rate("m", "fast", now=later) == 0.0
+        assert t.burn_rate("m", "slow",
+                           now=later) == pytest.approx(10.0)
+
+    def test_gauges_published_per_window(self):
+        t = SLOTracker(target=0.99)
+        t.observe("m", 0.500, slo_ms=50.0, now=1000.0)
+        g = telemetry.gauge("dl4j_slo_in_fraction")
+        assert g.value(model="m", window="fast") == 0.0
+        assert g.value(model="m", window="slow") == 0.0
+        assert telemetry.gauge("dl4j_slo_burn_rate").value(
+            model="m", window="fast") == pytest.approx(100.0)
+        assert telemetry.gauge(
+            "dl4j_slo_budget_remaining").value(
+                model="m") == pytest.approx(-99.0)
+
+    def test_api_slo_reports_forced_violation(self):
+        """A model whose SLO every request violates must show up on
+        GET /api/slo with burn rate > 1 and budget draining."""
+        reg, srv = _serve(latency_slo_ms=0.000001)
+        try:
+            code, _, _ = _post(srv.url, "m",
+                               {"inputs": _x().tolist()})
+            assert code == 200
+            with urllib.request.urlopen(f"{srv.url}/api/slo",
+                                        timeout=10) as r:
+                doc = json.load(r)
+        finally:
+            srv.stop(drain=False)
+            reg.shutdown()
+        assert doc["target"] == pytest.approx(0.99)
+        m = doc["models"]["m"]
+        assert m["slo_ms"] == pytest.approx(0.000001)
+        assert m["windows"]["fast"]["n"] >= 1
+        assert m["windows"]["fast"]["in_slo_fraction"] == 0.0
+        assert m["windows"]["fast"]["burn_rate"] > 1.0
+        assert m["budget_remaining"] < 1.0
+
+
+# ----------------------------------------------------------------------
+class TestFlightRecorder:
+    def test_ring_records_and_api_endpoints(self, tmp_path,
+                                            monkeypatch):
+        monkeypatch.setenv("DL4J_TPU_REQREC_DIR", str(tmp_path))
+        RequestRecorder._reset_for_tests()
+        reg, srv = _serve()
+        tid = "obs-test-reqrec-01"
+        try:
+            code, _, _ = _post(srv.url, "m",
+                               {"inputs": _x().tolist()},
+                               headers={tracectx.TRACE_HEADER: tid})
+            assert code == 200
+            with urllib.request.urlopen(
+                    f"{srv.url}/api/reqrec?n=5", timeout=10) as r:
+                live = json.load(r)["requests"]
+            req = urllib.request.Request(
+                f"{srv.url}/api/reqrec/dump", data=b"")
+            with urllib.request.urlopen(req, timeout=10) as r:
+                dump = json.load(r)
+        finally:
+            srv.stop(drain=False)
+            reg.shutdown()
+        mine = [r for r in live if r["trace_id"] == tid]
+        assert len(mine) == 1
+        assert mine[0]["model"] == "m"
+        assert mine[0]["verdict"] == "200"
+        assert mine[0]["phase_ms"].get("device", 0) >= 0
+        assert "queue_depth" in mine[0]
+        path = dump["path"]
+        assert path and path.startswith(str(tmp_path))
+        lines = [json.loads(ln) for ln in
+                 open(path).read().strip().splitlines()]
+        assert lines[0]["record"] == "meta"
+        assert lines[0]["reason"] == "api"
+        assert any(r.get("trace_id") == tid for r in lines[1:])
+        assert telemetry.counter(
+            "dl4j_reqrec_dumps_total").value(reason="api") == 1
+
+    def test_shed_storm_threshold_and_cooldown(self, tmp_path,
+                                               monkeypatch):
+        monkeypatch.setenv("DL4J_TPU_REQREC_DIR", str(tmp_path))
+        monkeypatch.setenv("DL4J_TPU_REQREC_SHED_THRESHOLD", "3")
+        monkeypatch.setenv("DL4J_TPU_REQREC_SHED_WINDOW_S", "30")
+        monkeypatch.setenv("DL4J_TPU_REQREC_STORM_COOLDOWN_S", "60")
+        RequestRecorder._reset_for_tests()
+        rec = RequestRecorder.get()
+        try:
+            assert rec.note_shed("m", "queue_full") is None
+            assert rec.note_shed("m", "queue_full") is None
+            path = rec.note_shed("m", "queue_full")
+            assert path is not None     # third shed crosses threshold
+            meta = json.loads(open(path).readline())
+            assert meta["reason"] == "shed_storm"
+            assert meta["event"]["sheds_in_window"] == 3
+            # cooldown: the storm keeps raging but dumps once
+            assert rec.note_shed("m", "queue_full") is None
+        finally:
+            RequestRecorder._reset_for_tests()
+
+
+# ----------------------------------------------------------------------
+class TestDrainRateColdWindow:
+    def test_single_completion_reports_floor_not_spike(self):
+        """Regression: one completion observed 'just now' used to
+        divide by the 1e-3 span floor and report ~1000 rps, which
+        collapsed the measured Retry-After to its floor right after
+        startup. With < 2 samples the rate must be the conservative
+        floor (completions over the FULL window)."""
+        c = AdmissionController(max_queue=4, rate_window_s=30.0)
+        t0 = 100.0
+        c.observe_total("m", 0.01, now=t0)
+        with c._lock:
+            rate = c._drain_rate_locked("m", t0 + 0.0005)
+        assert rate == pytest.approx(1 / 30.0)
+
+    def test_two_spanning_samples_measure_real_rate(self):
+        c = AdmissionController(max_queue=4, rate_window_s=30.0)
+        t0 = 100.0
+        c.observe_total("m", 0.01, now=t0)
+        c.observe_total("m", 0.01, now=t0 + 1.0)
+        with c._lock:
+            rate = c._drain_rate_locked("m", t0 + 1.0)
+        assert rate == pytest.approx(2.0)

@@ -226,3 +226,80 @@ class TestRouterResilience:
         assert telemetry.counter(
             "dl4j_serving_router_requests_total").value(
                 replica="none", code="502") == 1
+
+
+# ----------------------------------------------------------------------
+class TestRouterObservatory:
+    """ISSUE-17 satellites: the router relays the trace id both ways,
+    stamps which replica served, and keeps the id across a
+    connection-failure retry."""
+
+    def test_trace_header_relays_both_ways_on_predict(self, router):
+        from deeplearning4j_tpu.common import tracectx
+        router.rollout("m", lambda: _mlp(), warmup_shape=(8,))
+        x = np.random.RandomState(0).randn(1, 8).astype(np.float32)
+        tid = "router-relay-tid-1"
+        code, _, headers = _post(
+            router.url, "m", {"inputs": x.tolist()},
+            headers={tracectx.TRACE_HEADER: tid})
+        assert code == 200
+        assert headers.get(tracectx.TRACE_HEADER) == tid
+        assert headers.get(tracectx.REPLICA_HEADER, "").startswith(
+            "replica-")
+        # without a client id the router mints one at ingress
+        code, _, headers = _post(router.url, "m",
+                                 {"inputs": x.tolist()})
+        assert code == 200
+        minted = headers.get(tracectx.TRACE_HEADER)
+        assert minted and len(minted) == 16
+
+    def test_trace_and_replica_headers_on_generate_stream(
+            self, router):
+        import http.client
+
+        from deeplearning4j_tpu.common import tracectx
+        from deeplearning4j_tpu.models.decoder import (DecoderConfig,
+                                                       DecoderLM)
+        conf = DecoderConfig.tiny()
+        router.rollout("lm", lambda: DecoderLM(conf), generate={
+            "kv_blocks": 32, "kv_block_size": 8,
+            "prompt_buckets": (16,), "decode_buckets": (4,),
+            "max_seq_len": 64})
+        tid = "router-relay-gen-1"
+        conn = http.client.HTTPConnection("127.0.0.1", router.port,
+                                          timeout=60)
+        conn.request("POST", "/v1/models/lm:generate",
+                     body=json.dumps({"prompt": [5, 9, 2, 7],
+                                      "max_tokens": 4}).encode(),
+                     headers={"Content-Type": "application/json",
+                              tracectx.TRACE_HEADER: tid})
+        resp = conn.getresponse()
+        assert resp.status == 200
+        assert resp.getheader("Transfer-Encoding") == "chunked"
+        # the chunked relay carries the observatory headers up front
+        assert resp.getheader(tracectx.TRACE_HEADER) == tid
+        assert resp.getheader(tracectx.REPLICA_HEADER, "").startswith(
+            "replica-")
+        lines = [json.loads(ln) for ln in
+                 resp.read().decode().strip().splitlines()]
+        assert lines[-1]["done"] and lines[-1]["tokens"] == 4
+        conn.close()
+
+    def test_retry_after_connect_failure_keeps_trace(self, router):
+        """A connection-level replica failure retries on the
+        survivor — and the response still carries the ORIGINAL trace
+        id plus the replica that actually served."""
+        from deeplearning4j_tpu.common import tracectx
+        router.rollout("m", lambda: _mlp(), warmup_shape=(8,))
+        victim = router.replicas[0]
+        victim.server.stop(drain=False)
+        x = np.random.RandomState(2).randn(1, 8).astype(np.float32)
+        tid = "router-retry-tid-1"
+        code, _, headers = _post(
+            router.url, "m", {"inputs": x.tolist()},
+            headers={tracectx.TRACE_HEADER: tid})
+        assert code == 200
+        assert headers.get(tracectx.TRACE_HEADER) == tid
+        assert headers.get(tracectx.REPLICA_HEADER) == \
+            router.replicas[1].name
+        assert victim.healthy is False
