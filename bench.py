@@ -350,6 +350,34 @@ def main():
             print("2d leg: no line in child output", file=sys.stderr)
     except Exception as e:
         print(f"2d leg failed: {e!r}", file=sys.stderr)
+    # Pipeline-parallelism leg: the promoted pp fit path — analytic
+    # bubble-vs-n_micro sweep, gpipe-vs-1f1b peak activation
+    # residency, and measured pp2 / pp2xdp2 step time + stage idle.
+    # CPU-proxy subprocess on the virtual 8-device mesh, like the
+    # legs above.
+    try:
+        env = {**os.environ, "PYTHONPATH": "", "JAX_PLATFORMS": "cpu"}
+        out = subprocess.run(
+            [sys.executable,
+             os.path.join(_ROOT, "benchmarks", "bench_pipeline.py"),
+             "--pp"],
+            capture_output=True, text=True, timeout=900, env=env,
+            cwd=_ROOT)
+        if out.returncode != 0:
+            raise RuntimeError(
+                f"rc={out.returncode}: {out.stderr.strip()[-400:]}")
+        for ln in out.stdout.strip().splitlines():
+            if not ln.startswith("{"):
+                continue              # tolerate library banners
+            rec = json.loads(ln)
+            if rec.get("metric") == "pipeline":
+                rec.pop("metric")
+                line["pipeline"] = rec
+        if "pipeline" not in line:
+            print("pipeline leg: no line in child output",
+                  file=sys.stderr)
+    except Exception as e:
+        print(f"pipeline leg failed: {e!r}", file=sys.stderr)
     # Fault-tolerance leg: checkpoint step-loop stall (fully
     # synchronous vs deferred async snapshot) and warm-cache resume
     # latency — the costs the preemption/auto-resume machinery pays.

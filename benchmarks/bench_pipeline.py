@@ -27,6 +27,14 @@ import os
 import sys
 import time
 
+if "--pp" in sys.argv and "xla_force_host_platform_device_count" not in \
+        os.environ.get("XLA_FLAGS", ""):
+    # the model-parallel leg wants a multi-device mesh; on a CPU-only
+    # host virtualize 8 devices BEFORE jax initializes its backend
+    os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "") +
+                               " --xla_force_host_platform_device_count=8")
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -322,6 +330,99 @@ def main_real_decode(threads):
             "feeder_threads": threads}))
 
 
+# -- pipeline-parallel leg (ISSUE 18: the promoted real fit path) -----------
+def main_pp():
+    """--pp: pipeline-parallel training bench on the ``pipe`` mesh
+    axis — analytic bubble-vs-n_micro sweep, gpipe-vs-1f1b peak
+    activation residency (schedule counts + measured bytes), and
+    measured pp2 / pp2xdp2 legs through ``ParallelWrapper``'s real fit
+    path. Emits ONE ``{"metric": "pipeline"}`` JSON line for bench.py
+    to fold in (check_bench_regression.py holds bubble_fraction,
+    residency and stage idle down, throughput up)."""
+    from deeplearning4j_tpu.activations import Activation
+    from deeplearning4j_tpu.datasets.dataset import DataSet
+    from deeplearning4j_tpu.learning.updaters import Sgd
+    from deeplearning4j_tpu.lossfunctions import LossFunction
+    from deeplearning4j_tpu.nn.conf.builders import NeuralNetConfiguration
+    from deeplearning4j_tpu.nn.conf.inputs import InputType
+    from deeplearning4j_tpu.nn.conf.layers import DenseLayer, OutputLayer
+    from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+    from deeplearning4j_tpu.nn.weights import WeightInit
+    from deeplearning4j_tpu.parallel import (ParallelWrapper,
+                                             bubble_fraction,
+                                             build_schedule,
+                                             peak_residency, zero)
+
+    def net():
+        conf = (NeuralNetConfiguration.Builder().seed(42)
+                .updater(Sgd(0.1)).weight_init(WeightInit.XAVIER).list()
+                .layer(DenseLayer(n_in=64, n_out=128,
+                                  activation=Activation.TANH))
+                .layer(DenseLayer(n_out=128, activation=Activation.TANH))
+                .layer(DenseLayer(n_out=128, activation=Activation.TANH))
+                .layer(OutputLayer(n_out=10,
+                                   loss_function=LossFunction.MCXENT,
+                                   activation=Activation.SOFTMAX))
+                .set_input_type(InputType.feed_forward(64)).build())
+        return MultiLayerNetwork(conf).init()
+
+    def data(seed):
+        r = np.random.RandomState(seed)
+        x = r.randn(64, 64).astype(np.float32)
+        y = np.eye(10, dtype=np.float32)[r.randint(0, 10, 64)]
+        return DataSet(x, y)
+
+    def run_leg(workers, schedule, n_batches=6):
+        m = net()
+        pw = (ParallelWrapper.Builder(m).workers(workers)
+              .pipeline_stages(2).pipeline_schedule(schedule)
+              .update_exchange("dense").build())
+        pw.fit_batch(data(0))            # compile + place
+        t0 = time.perf_counter()
+        for i in range(n_batches):
+            pw.fit_batch(data(i + 1))
+        dt = time.perf_counter() - t0
+        rep = dict(pw._pipeline.last_report)
+        pw.shutdown()
+        leg = {
+            "step_seconds": round(dt / n_batches, 4),
+            "throughput_rows_per_s": round(64 * n_batches / dt, 1),
+            "bubble_fraction": rep["bubble_fraction"],
+            "stage_idle_ms": [round(1e3 * s, 2)
+                              for s in rep["stage_idle_seconds"]],
+            "peak_residency_microbatches":
+                rep["peak_residency_microbatches"],
+            "peak_residency_bytes": rep["peak_residency_bytes"],
+            "pipe_wire_bytes": rep["pipe_wire_bytes"],
+            "n_micro": rep["n_micro"],
+        }
+        return leg, m, rep
+
+    rec = {"metric": "pipeline",
+           "bubble_fraction_sweep_s2": {
+               f"m{m}": round(bubble_fraction(2, m), 4)
+               for m in (2, 4, 8, 16)}}
+
+    leg_1f1b, m1, rep_1f1b = run_leg(1, "1f1b")
+    leg_gpipe, _, rep_gpipe = run_leg(1, "gpipe")
+    leg_2d, _, _ = run_leg(2, "1f1b")
+    rec["pp2_1f1b"] = leg_1f1b
+    rec["pp2_gpipe"] = leg_gpipe
+    rec["pp2_dp2_1f1b"] = leg_2d
+    rec["residency"] = {
+        "gpipe_peak_microbatches": peak_residency(
+            build_schedule(2, 8, "gpipe"), 2),
+        "1f1b_peak_microbatches": peak_residency(
+            build_schedule(2, 8, "1f1b"), 2),
+        "gpipe_peak_bytes": rep_gpipe["peak_residency_bytes"],
+        "1f1b_peak_bytes": rep_1f1b["peak_residency_bytes"],
+    }
+    rec["update_exchange"] = zero.exchange_report(
+        m1.params, 2, "dense", pipe_shards=2,
+        stage_param_bytes=rep_1f1b["stage_param_bytes"])
+    print(json.dumps(rec))
+
+
 if __name__ == "__main__":
     import argparse
     ap = argparse.ArgumentParser()
@@ -330,8 +431,13 @@ if __name__ == "__main__":
                          "path instead of the synthetic producer")
     ap.add_argument("--threads", type=int, default=16,
                     help="feeder pool size for the real-decode e2e leg")
+    ap.add_argument("--pp", action="store_true",
+                    help="pipeline-parallel leg: bubble/residency/"
+                         "throughput over a pipe-axis mesh")
     a = ap.parse_args()
-    if a.real_decode:
+    if a.pp:
+        main_pp()
+    elif a.real_decode:
         main_real_decode(a.threads)
     else:
         main()

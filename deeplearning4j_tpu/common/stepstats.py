@@ -62,7 +62,7 @@ log = logging.getLogger("deeplearning4j_tpu")
 #: in-step phases; ``data_wait`` / ``checkpoint_stall`` / ``host_sync``
 #: accrue BETWEEN step spans and extend the total beyond it.
 PHASES = ("data_wait", "compute", "collective", "updater",
-          "host_sync", "checkpoint_stall")
+          "host_sync", "checkpoint_stall", "pipeline")
 
 #: collective kinds → breakdown phase.  ``update_exchange`` is special:
 #: its span WRAPS the fused train step, so only its excess over the
@@ -75,8 +75,9 @@ _COLLECTIVE_PHASE = {
 
 _PHASE_HELP = ("per-step time decomposition: seconds attributed to "
                "each phase (data_wait | compute | collective | updater "
-               "| host_sync | checkpoint_stall) of one train-step "
-               "dispatch")
+               "| host_sync | checkpoint_stall | pipeline) of one "
+               "train-step dispatch; ``pipeline`` is the measured "
+               "schedule bubble (stage idle time while peers compute)")
 
 
 class StepStats:

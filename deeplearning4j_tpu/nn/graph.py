@@ -121,7 +121,8 @@ class ComputationGraph:
     # ------------------------------------------------------------------
     def _forward(self, params, states, inputs: Sequence, *,
                  training: bool, rng, want_logits: bool, fmask=None,
-                 upto: Optional[str] = None):
+                 upto: Optional[str] = None, start_acts=None,
+                 topo_slice=None):
         """Topo walk. inputs: list matching conf.network_inputs order.
         ``fmask`` is the per-timestep features mask (first input's), passed
         to mask-aware layers — multi-input graphs with per-input masks can
@@ -129,6 +130,11 @@ class ComputationGraph:
         ``upto``: walk only the ancestor subgraph of this vertex
         (inclusive) — the pretrain path, where downstream vertices must
         not even be traced (their params are held out of the step).
+        ``topo_slice``: ``(lo, hi)`` — walk only ``self._topo[lo:hi]``,
+        the pipeline-stage slice (parallel/pipeline.py), with
+        ``start_acts`` seeding the activations handed over from earlier
+        stages; per-vertex RNG stays folded on the FULL-topo layer
+        position, so a sliced walk reproduces the whole-graph stream.
         Returns ({vertex: activation} for outputs, new_states)."""
         conf = self.conf
         if conf.compute_dtype:
@@ -143,6 +149,8 @@ class ComputationGraph:
             params = (params.cast(cd) if hasattr(params, "cast")
                       else cast_floats(params, cd))
             inputs = [cast_floats(x, cd) for x in inputs]
+            if start_acts is not None:
+                start_acts = cast_floats(start_acts, cd)
         def run_vertex(name, acts, lrng):
             """Execute one vertex against the live activation dict;
             returns (activation, layer_state).  The layer-attribution
@@ -186,18 +194,23 @@ class ComputationGraph:
             return h, ns if ns is not None else {}
 
         if training and conf.remat_segments > 1 and \
-                len(self._topo) > 1:
+                len(self._topo) > 1 and \
+                start_acts is None and topo_slice is None:
             acts, new_states = self._forward_segmented(run_vertex, rng,
                                                        inputs)
         else:
             topo = self._topo
+            if topo_slice is not None:
+                topo = topo[topo_slice[0]:topo_slice[1]]
             if upto is not None:
                 need = {upto}
                 for n in reversed(self._topo):
                     if n in need:
                         need.update(conf.vertices[n].inputs)
-                topo = [n for n in self._topo if n in need]
+                topo = [n for n in topo if n in need]
             acts = dict(zip(conf.network_inputs, inputs))
+            if start_acts is not None:
+                acts.update(start_acts)
             new_states = {}
             # fold_in by layer position IN THE FULL TOPO — same
             # derivation as _forward_segmented, so neither toggling

@@ -132,9 +132,14 @@ class MultiLayerNetwork:
     # ------------------------------------------------------------------
     def _forward(self, params, states, x, *, training: bool, rng,
                  stop_at: Optional[int] = None, want_logits: bool,
-                 mask=None):
+                 mask=None, start_at: int = 0):
         """Walk the stack. ``mask`` is the per-timestep features mask,
         passed to layers that accept one (recurrent/pooling).
+        ``start_at``/``stop_at`` bound the walk to ``[start_at,
+        stop_at)`` — the pipeline-stage slice (parallel/pipeline.py);
+        ``x`` is then the incoming stage activation, and per-layer RNG
+        stays folded on the ABSOLUTE layer index so a sliced walk
+        reproduces the whole-stack random stream.
         Returns (out, new_states)."""
         conf = self.conf
         if conf.compute_dtype:
@@ -191,7 +196,7 @@ class MultiLayerNetwork:
                                       state=ls or None, **kw)
             return h, ns if ns is not None else {}
 
-        if training and stop_at is None and \
+        if training and stop_at is None and start_at == 0 and \
                 conf.remat_segments > 1 and n > 1:
             # sqrt(N) checkpointing: only segment-boundary activations
             # are stored for backward; interiors are recomputed.
@@ -220,7 +225,7 @@ class MultiLayerNetwork:
                 h, ns = seg_fn(h, list(keys[lo:hi]))
                 new_states.update(ns)
         else:
-            for i in range(n):
+            for i in range(start_at, n):
                 if stop_at is not None and i >= stop_at:
                     break
                 # fold_in(rng, layer index), matching the segmented

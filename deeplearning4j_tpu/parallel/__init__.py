@@ -16,6 +16,11 @@ TPU-native replacement for the reference's parallelism stack:
 - threshold gradient encoding (P2 `EncodedGradientsAccumulator`) ->
   :mod:`.encoding` keeps the *semantics* as an optional compression
   transform; on TPU the north star replaces it with dense XLA AllReduce.
+- pipeline parallelism -> :mod:`.pipeline`: :class:`PipelineTrainer`
+  runs the real fit path over a ``pipe`` mesh axis (1F1B or GPipe
+  microbatch schedule), composing with dp/ZeRO-1 and tp into a 3D
+  ``(data, model, pipe)`` mesh via
+  ``ParallelWrapper.Builder.pipeline_stages``.
 """
 from deeplearning4j_tpu.parallel.mesh import (DEFAULT_DATA_AXIS,
                                               MeshFactory, data_sharding,
@@ -37,6 +42,9 @@ from deeplearning4j_tpu.parallel.zero import (
     UpdateExchange, apply_update_sharded, resolve_update_exchange,
     states_to_dense, states_to_sharded, update_exchange_bytes)
 from deeplearning4j_tpu.parallel.speclayout import SpecLayout, TpLeafSpec
+from deeplearning4j_tpu.parallel.pipeline import (
+    PIPE_AXIS, SCHEDULES, PipelineTrainer, StagePartition,
+    bubble_fraction, build_schedule, peak_residency, stage_submesh)
 
 __all__ = [
     "DEFAULT_DATA_AXIS", "MeshFactory", "make_mesh", "data_sharding",
@@ -52,4 +60,7 @@ __all__ = [
     "UpdateExchange", "apply_update_sharded", "resolve_update_exchange",
     "states_to_dense", "states_to_sharded", "update_exchange_bytes",
     "SpecLayout", "TpLeafSpec",
+    "PIPE_AXIS", "SCHEDULES", "PipelineTrainer", "StagePartition",
+    "bubble_fraction", "build_schedule", "peak_residency",
+    "stage_submesh",
 ]

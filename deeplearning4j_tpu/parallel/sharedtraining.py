@@ -69,6 +69,11 @@ class SharedTrainingConfiguration:
     # every update_exchange mode — the global mesh becomes 2D
     # (data, model) and the dp world size becomes devices // N
     tensor_parallel: int = 1
+    # split the layer stack into N contiguous pipeline stages over a
+    # third `pipe` mesh axis (parallel.pipeline — the 1F1B/GPipe
+    # microbatch engine); the global mesh becomes 3D
+    # (data, model, pipe) and the dp world = devices // (tp * pp)
+    pipeline_stages: int = 1
     # control plane (jax.distributed); None = single-process
     coordinator_address: Optional[str] = None
     num_processes: Optional[int] = None
@@ -131,6 +136,20 @@ class SharedTrainingMaster:
             self._c.tensor_parallel = n
             return self
 
+        def pipeline_stages(self, n: int):
+            """Split the layer stack into ``n`` contiguous pipeline
+            stages over a third ``pipe`` mesh axis
+            (parallel.pipeline); the global mesh becomes 3D
+            ``(data, model, pipe)``. Composes with workers_per_node
+            (dp) and tensor_parallel — total devices must divide by
+            ``tp * pp``."""
+            n = int(n)
+            if n < 1:
+                raise ValueError(
+                    f"pipeline_stages must be >= 1, got {n}")
+            self._c.pipeline_stages = n
+            return self
+
         def coordinator(self, address: str, num_processes: int,
                         process_id: int):
             self._c.coordinator_address = address
@@ -168,17 +187,25 @@ class SharedTrainingMaster:
         if self._mesh is None:
             devs = jax.devices()     # global across all processes
             tp = max(int(self.config.tensor_parallel), 1)
+            pp = max(int(self.config.pipeline_stages), 1)
+            group = tp * pp
             if self.config.workers_per_node > 0 and jax.process_count() == 1:
-                devs = devs[:self.config.workers_per_node * tp]
-            if tp > 1:
-                if len(devs) % tp:
+                devs = devs[:self.config.workers_per_node * group]
+            if group > 1:
+                if len(devs) % group or len(devs) < group:
                     raise ValueError(
-                        f"tensor_parallel={tp} does not divide "
-                        f"{len(devs)} devices")
+                        f"tensor_parallel={tp} x pipeline_stages={pp} "
+                        f"does not divide {len(devs)} devices")
                 from deeplearning4j_tpu.parallel.mesh import \
                     DEFAULT_MODEL_AXIS
-                self._mesh = make_mesh({DEFAULT_DATA_AXIS: -1,
-                                        DEFAULT_MODEL_AXIS: tp}, devs)
+                axes = {DEFAULT_DATA_AXIS: -1}
+                if tp > 1:
+                    axes[DEFAULT_MODEL_AXIS] = tp
+                if pp > 1:
+                    from deeplearning4j_tpu.parallel.pipeline import \
+                        PIPE_AXIS
+                    axes[PIPE_AXIS] = pp
+                self._mesh = make_mesh(axes, devs)
             else:
                 self._mesh = make_mesh({DEFAULT_DATA_AXIS: len(devs)},
                                        devs)

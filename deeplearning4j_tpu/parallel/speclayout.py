@@ -31,6 +31,17 @@ pin pair (gather to compute in forward, pin cotangent to resident in
 backward — ``zero.tp_gather_leaf``) keeps params + grads + updater
 state resident at ``1/(dp·tp)`` while dp collectives never cross the
 ``model`` axis.
+
+Layout-axis ownership (the PR-12 cross-link convention): this module
+owns the ``model``-axis parameter specs (and the fsdp ``data``
+residency dimension). ``parallel/tensor.py`` owns the column/row
+sharded matmul math those specs lower to. ``parallel/pipeline.py``
+owns the ``pipe`` axis — a *stage* partition of whole entries, not a
+within-leaf sharding, so the two compose by restriction:
+:meth:`SpecLayout.infer_stages` runs the same inference per stage
+against the stage's ``(data, model)`` submesh, and the specs for each
+entry are identical to the 2D run's (the pipe axis never appears in a
+``PartitionSpec``).
 """
 from __future__ import annotations
 
@@ -66,12 +77,16 @@ class SpecLayout:
     :meth:`infer_entry`)."""
 
     def __init__(self, mesh, model_axis: str = DEFAULT_MODEL_AXIS,
-                 data_axis: str = DEFAULT_DATA_AXIS):
+                 data_axis: str = DEFAULT_DATA_AXIS,
+                 stage_axis: str = "pipe"):
         self.mesh = mesh
         self.model_axis = model_axis
         self.data_axis = data_axis
+        self.stage_axis = stage_axis
         self.tp = int(mesh.shape.get(model_axis, 1))
         self.dp = int(mesh.shape.get(data_axis, 1))
+        #: pipeline-stage degree, read off the mesh (1 = no pipe axis)
+        self.pp = int(mesh.shape.get(stage_axis, 1))
 
     # -- per-leaf rules ----------------------------------------------------
     def _resident(self, shape, compute: P,
@@ -126,6 +141,35 @@ class SpecLayout:
             specs = self.infer_entry(sub, shard_over_data)
             if specs:
                 out[k] = specs
+        return out
+
+    def infer_stages(self, params, partition,
+                     shard_over_data: bool = False):
+        """Per-stage tp specs under a pipeline partition: one
+        ``{entry: {name: TpLeafSpec}}`` dict per stage, inferred
+        against that stage's ``(data, model)`` submesh
+        (:func:`parallel.pipeline.stage_submesh`). The pipe axis is a
+        partition of whole entries, never a dimension in a spec, so
+        each entry's specs equal what a 2D run would infer for it —
+        the stage axis only decides *which* submesh pins them.
+
+        ``partition`` is a :class:`parallel.pipeline.StagePartition`;
+        when the mesh has no pipe axis (``self.pp == 1``) the single
+        "stage" is inferred against the full mesh."""
+        from deeplearning4j_tpu.parallel.pipeline import stage_submesh
+        out = []
+        for s in range(partition.n_stages):
+            if self.pp > 1:
+                sub = stage_submesh(self.mesh, s, self.stage_axis)
+            else:
+                sub = self.mesh
+            layout = SpecLayout(sub, model_axis=self.model_axis,
+                                data_axis=self.data_axis,
+                                stage_axis=self.stage_axis)
+            stage_params = {k: params[k]
+                            for k in partition.stage_entries(s)
+                            if k in (params or {})}
+            out.append(layout.infer(stage_params, shard_over_data))
         return out
 
 

@@ -40,6 +40,14 @@ ZeRO-1/ZeRO-3 update exchanges
 (``ParallelWrapper.Builder.tensor_parallel``). This module stays the
 explicit-collective reference (and the shard_map dryrun the 2D suite
 checks the lowering against, tests/test_2d_parallel.py).
+
+Layout-axis ownership (PR-12 convention): this module owns the
+``model``-axis *math* (column/row sharded matmuls); :mod:`.speclayout`
+owns the per-parameter ``model``/``data`` specs; :mod:`.pipeline` owns
+the ``pipe`` axis — a stage partition of whole entries, orthogonal to
+both, so ``pipe`` never appears in a spec or a shard_map here
+(``ParallelWrapper.Builder.pipeline_stages`` composes all three into
+one ``(data, model, pipe)`` mesh).
 """
 from __future__ import annotations
 

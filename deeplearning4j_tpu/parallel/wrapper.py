@@ -54,17 +54,27 @@ class ParallelWrapper:
     def __init__(self, model, mesh=None, *,
                  data_axis: str = DEFAULT_DATA_AXIS,
                  model_axis: str = DEFAULT_MODEL_AXIS,
+                 pipe_axis: str = "pipe",
                  prefetch_buffer: int = 2,
                  averaging_frequency: int = 1,
                  report_score_after_averaging: bool = True,
                  accumulation_steps: int = 1,
-                 update_exchange="auto"):
+                 update_exchange="auto",
+                 n_micro: Optional[int] = None,
+                 pipeline_schedule: str = "1f1b"):
         self.model = model
         self.mesh = mesh if mesh is not None else make_mesh()
         self.data_axis = data_axis
         self.model_axis = model_axis
+        self.pipe_axis = pipe_axis
         #: tp degree, read off the mesh (1 on a pure-DP mesh)
         self.tensor_parallel = int(self.mesh.shape.get(model_axis, 1))
+        #: pp degree, read off the mesh (1 = no pipeline stage axis)
+        self.pipeline_stages = int(self.mesh.shape.get(pipe_axis, 1))
+        self.n_micro = n_micro
+        self.pipeline_schedule = pipeline_schedule
+        #: the PipelineTrainer owning the fit path when pp > 1
+        self._pipeline = None
         self.prefetch_buffer = prefetch_buffer
         self.averaging_frequency = averaging_frequency  # API parity only
         self.report_score = report_score_after_averaging
@@ -95,9 +105,43 @@ class ParallelWrapper:
             self._accum = 1
             self._exchange = "auto"
             self._tp = 1
+            self._pp = 1
+            self._n_micro = None
+            self._pp_sched = "1f1b"
 
         def workers(self, n: int) -> "ParallelWrapper.Builder":
             self._workers = n
+            return self
+
+        def pipeline_stages(self, n: int) -> "ParallelWrapper.Builder":
+            """Split the layer stack into ``n`` contiguous pipeline
+            stages over a third ``pipe`` mesh axis
+            (parallel.pipeline.PipelineTrainer — the promoted 1F1B/
+            GPipe microbatch engine). Composes with ``workers`` (dp)
+            and ``tensor_parallel`` into a 3D ``(data, model, pipe)``
+            mesh; total devices = workers * tp * pp. An ``fsdp``
+            update_exchange downgrades to per-stage ZeRO-1 (flats stay
+            local to each stage's pipe group)."""
+            n = int(n)
+            if n < 1:
+                raise ValueError(f"pipeline_stages must be >= 1, got {n}")
+            self._pp = n
+            return self
+
+        def microbatches(self, n: int) -> "ParallelWrapper.Builder":
+            """Microbatches per step for the pipeline schedule (default
+            ``2 * pipeline_stages``); the batch must divide by it."""
+            self._n_micro = int(n)
+            return self
+
+        def pipeline_schedule(self, kind: str) -> "ParallelWrapper.Builder":
+            """'1f1b' (default — bounded activation residency) or
+            'gpipe' (the all-forward-then-backward reference)."""
+            from deeplearning4j_tpu.parallel.pipeline import SCHEDULES
+            if kind not in SCHEDULES:
+                raise ValueError(f"unknown pipeline schedule {kind!r} "
+                                 f"(know {SCHEDULES})")
+            self._pp_sched = kind
             return self
 
         def tensor_parallel(self, n: int) -> "ParallelWrapper.Builder":
@@ -158,18 +202,26 @@ class ParallelWrapper:
             mesh = self._mesh
             if mesh is None:
                 devs = jax.devices()
-                if self._tp > 1:
-                    # 2D (data, model) mesh: ``workers`` counts the
-                    # data-parallel groups; total devices = workers*tp
+                group = self._tp * self._pp
+                if group > 1:
+                    # 2D/3D (data, model[, pipe]) mesh: ``workers``
+                    # counts the data-parallel groups; total devices =
+                    # workers * tp * pp
                     if self._workers:
-                        devs = devs[:self._workers * self._tp]
-                    if len(devs) % self._tp:
+                        devs = devs[:self._workers * group]
+                    if len(devs) % group or len(devs) < group:
                         raise ValueError(
-                            f"tensor_parallel={self._tp} does not "
+                            f"tensor_parallel={self._tp} x "
+                            f"pipeline_stages={self._pp} does not "
                             f"divide {len(devs)} devices")
-                    mesh = make_mesh({DEFAULT_DATA_AXIS: -1,
-                                      DEFAULT_MODEL_AXIS: self._tp},
-                                     devs)
+                    axes = {DEFAULT_DATA_AXIS: -1}
+                    if self._tp > 1:
+                        axes[DEFAULT_MODEL_AXIS] = self._tp
+                    if self._pp > 1:
+                        from deeplearning4j_tpu.parallel.pipeline \
+                            import PIPE_AXIS
+                        axes[PIPE_AXIS] = self._pp
+                    mesh = make_mesh(axes, devs)
                 else:
                     if self._workers:
                         devs = devs[:self._workers]
@@ -178,7 +230,9 @@ class ParallelWrapper:
                                    prefetch_buffer=self._prefetch,
                                    averaging_frequency=self._avg_freq,
                                    accumulation_steps=self._accum,
-                                   update_exchange=self._exchange)
+                                   update_exchange=self._exchange,
+                                   n_micro=self._n_micro,
+                                   pipeline_schedule=self._pp_sched)
 
     # ------------------------------------------------------------------
     @property
@@ -205,6 +259,9 @@ class ParallelWrapper:
         mode = resolve_update_exchange(self.mesh, self.data_axis,
                                        self.requested_exchange, m)
         self.update_exchange = mode
+        if self.pipeline_stages > 1:
+            self._place_pipeline(mode)
+            return
         tp = self.tensor_parallel
         if tp > 1 and not hasattr(m, "set_dp_mesh"):
             log.info("%s has no set_dp_mesh; tensor_parallel=%d lowers "
@@ -322,6 +379,48 @@ class ParallelWrapper:
                 self.mesh, states_to_dense(m.params, m.updater_states))
         self._placed = True
 
+    def _place_pipeline(self, mode):
+        """pp > 1: hand placement and the fit path to the
+        PipelineTrainer (parallel.pipeline). Params stay logically
+        dense per stage — checkpoints remain stage-count-portable —
+        and each stage's update tail (dense or per-stage ZeRO-1, tp
+        pinned) stays local to its pipe group."""
+        from deeplearning4j_tpu.parallel.pipeline import PipelineTrainer
+        from deeplearning4j_tpu.parallel.zero import (
+            update_exchange_axis_bytes, update_exchange_bytes)
+        m = self.model
+        tr = PipelineTrainer(
+            m, self.mesh, n_micro=self.n_micro,
+            schedule=self.pipeline_schedule, mode=mode,
+            pipe_axis=self.pipe_axis, data_axis=self.data_axis,
+            model_axis=self.model_axis)
+        tr.place()
+        self._pipeline = tr
+        self._tp_specs = {}
+        for specs in tr._tp_specs:
+            self._tp_specs.update(specs)
+        # per-stage wire accounting: each stage's dp group exchanges
+        # only its OWN stage's params (never crossing the pipe axis)
+        self._exchange_bytes = sum(
+            update_exchange_bytes(
+                {k: m.params[k] for k in tr.part.stage_entries(s)
+                 if k in m.params}, tr.dp, mode)
+            for s in range(tr.n_stages))
+        self._fsdp_gather_bytes = 0
+        self._axis_bytes = None
+        if self._tp_specs:
+            self._axis_bytes = update_exchange_axis_bytes(
+                m.params, tr.dp, self.tensor_parallel, self._tp_specs)
+        self._placed = True
+
+    def _fit_model(self, ds):
+        """One training batch through whichever engine owns the fit
+        path — the model's own fused step, or the pipeline schedule."""
+        if self._pipeline is not None:
+            self._pipeline.fit_batch(ds)
+        else:
+            self.model.fit(ds)
+
     def _shard(self, a):
         if a is None or not hasattr(a, "ndim") or getattr(a, "ndim", 0) == 0:
             return a
@@ -346,6 +445,12 @@ class ParallelWrapper:
                 log.warning("trimming minibatch %d -> %d for %d-way DP",
                             a.shape[0], b, n)
                 a = a[:b]
+            if self._pipeline is not None:
+                # the PipelineTrainer splits into microbatches and
+                # places each on its stage's submesh itself (and its
+                # to_microbatches raises the non-divisible error with
+                # the batch intact)
+                return a
             return self._shard(a)
 
         return map_dataset_arrays(ds, trim)
@@ -405,7 +510,7 @@ class ParallelWrapper:
                                          self.data_axis,
                                          self._exchange_bytes,
                                          mode=mode):
-                        self.model.fit(ds)
+                        self._fit_model(ds)
                     telemetry.histogram(
                         "dl4j_dp_step_seconds",
                         "data-parallel sharded step wall time incl. "
@@ -437,7 +542,7 @@ class ParallelWrapper:
                             "all-gathers (ring model, analytic)"
                         ).inc(self._fsdp_gather_bytes, workers=n)
                 else:
-                    self.model.fit(ds)
+                    self._fit_model(ds)
                 from deeplearning4j_tpu.common import faults
                 if faults.preemption_requested():
                     # coordinated resumable exit: close the partial
@@ -486,25 +591,52 @@ class ParallelWrapper:
         dense trajectory with the new device count.  A tp degree from
         :meth:`Builder.tensor_parallel` is preserved (``workers`` again
         counts data-parallel groups); pass an explicit 1D ``mesh`` to
-        restore a 2D run onto a pure-DP world."""
+        restore a 2D run onto a pure-DP world.
+
+        A pipe axis is different: while pipeline stages are placed, a
+        remesh that would CHANGE the pipe degree is rejected — the
+        stage partition, per-stage jits, and per-stage updater flats
+        are all keyed to it, and silently re-slicing mid-run would
+        leave a stale stage layout. Call :meth:`shutdown` first (the
+        checkpoint stays dense and stage-count-portable), or rebuild
+        via ``ParallelWrapper.Builder.pipeline_stages``."""
         if mesh is None:
             devs = jax.devices()
             tp = self.tensor_parallel
-            if tp > 1:
+            pp = self.pipeline_stages
+            group = tp * pp
+            if group > 1:
                 if workers:
-                    devs = devs[:workers * tp]
-                if len(devs) % tp:
+                    devs = devs[:workers * group]
+                if len(devs) % group:
                     raise ValueError(
-                        f"tensor_parallel={tp} does not divide "
-                        f"{len(devs)} devices")
-                mesh = make_mesh({self.data_axis: -1,
-                                  self.model_axis: tp}, devs)
+                        f"tensor_parallel={tp} x pipeline_stages={pp} "
+                        f"does not divide {len(devs)} devices")
+                axes = {self.data_axis: -1}
+                if tp > 1:
+                    axes[self.model_axis] = tp
+                if pp > 1:
+                    axes[self.pipe_axis] = pp
+                mesh = make_mesh(axes, devs)
             else:
                 if workers:
                     devs = devs[:workers]
                 mesh = make_mesh({self.data_axis: len(devs)}, devs)
+        new_pp = int(mesh.shape.get(self.pipe_axis, 1))
+        if self._pipeline is not None and self._placed \
+                and new_pp != self.pipeline_stages:
+            raise ValueError(
+                f"remesh cannot change the pipe axis while pipeline "
+                f"stages are placed (pipeline_stages="
+                f"{self.pipeline_stages} -> {new_pp}): the stage "
+                f"partition and per-stage updater flats are keyed to "
+                f"it. shutdown() first (checkpoints are dense and "
+                f"stage-count-portable), then rebuild with "
+                f"ParallelWrapper.Builder.pipeline_stages({new_pp}).")
         self.mesh = mesh
         self.tensor_parallel = int(mesh.shape.get(self.model_axis, 1))
+        self.pipeline_stages = new_pp
+        self._pipeline = None
         self.update_exchange = None
         self._placed = False
         self._place_model()
@@ -513,12 +645,15 @@ class ParallelWrapper:
     def fit_batch(self, ds):
         if not self._placed:
             self._place_model()
-        self.model.fit(self._shard_dataset(ds))
+        self._fit_model(self._shard_dataset(ds))
         return self
 
     def average_score(self) -> float:
         return self.model.score()
 
     def shutdown(self):
-        """Reference API: stop trainer threads. Nothing to stop here."""
+        """Reference API: stop trainer threads. Releases the pipeline
+        stage layout (if any), so a later remesh may change the pipe
+        degree."""
         self._placed = False
+        self._pipeline = None

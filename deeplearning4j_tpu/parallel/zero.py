@@ -773,7 +773,8 @@ def update_exchange_axis_bytes(params, data_shards: int,
     nd = max(int(data_shards), 1)
     data = (int(2 * (nd - 1) * exchanged / nd) if nd > 1 else 0)
     naive = (int((tp - 1) * tpb / tp) if tp > 1 else 0)
-    return {"data": data, "model": 0, "cross_axis_bytes": 0,
+    return {"data": data, "model": 0, "pipe": 0,
+            "cross_axis_bytes": 0,
             "naive_ravel_cross_axis_bytes": naive,
             "tp_param_bytes": int(tpb)}
 
@@ -796,7 +797,9 @@ def update_exchange_bytes(params, n_shards: int, mode=None) -> int:
 
 
 def exchange_report(params, n_shards: int, mode=None,
-                    model_shards: int = 1, tp_specs=None) -> dict:
+                    model_shards: int = 1, tp_specs=None,
+                    pipe_shards: int = 1,
+                    stage_param_bytes=None) -> dict:
     """Scaling-observatory accounting for one step's update exchange:
     parameter bytes, per-replica wire bytes (ring-collective model),
     the wire:param ratio, plus a per-mode breakdown — dense reports the
@@ -805,7 +808,12 @@ def exchange_report(params, n_shards: int, mode=None,
     per-replica param residency (`bench.py` folds this in next to the
     efficiency curve). With ``model_shards > 1`` the report adds the
     per-axis block from :func:`update_exchange_axis_bytes` and the tp
-    residency (2D modes)."""
+    residency (2D modes). With ``pipe_shards > 1`` a ``pipeline``
+    block joins per-stage parameter bytes into the accounting — stage
+    flats stay local to their pipe group, so the dp update exchange
+    moves zero bytes across ``pipe`` (microbatch activation/cotangent
+    handoffs, reported by the trainer as ``pipe_wire_bytes``, are the
+    only pipe-axis traffic)."""
     total = sum(int(np.prod(a.shape)) * a.dtype.itemsize
                 for a in jax.tree_util.tree_leaves(params)
                 if hasattr(a, "shape"))
@@ -836,6 +844,17 @@ def exchange_report(params, n_shards: int, mode=None,
         rep["axis_bytes"] = axis_bytes
         rep["tp_resident_bytes_per_replica"] = (
             axis_bytes["tp_param_bytes"] // tp)
+    pp = max(int(pipe_shards), 1)
+    if pp > 1:
+        stage_bytes = [int(b) for b in (stage_param_bytes or [])]
+        rep["pipe_shards"] = pp
+        rep["pipeline"] = {
+            "stages": pp,
+            "stage_param_bytes": stage_bytes,
+            # dp flats are per pipe group; the update exchange never
+            # crosses the pipe axis
+            "cross_pipe_bytes": 0,
+        }
     return rep
 
 

@@ -57,7 +57,12 @@ round.  The ISSUE-16
 ``intertoken_*_ms`` / the paged-vs-dense ``*_step_ms`` pair and any
 ``shed_rate`` lower-is-better — the paged-KV claim is "more tokens
 per second at lower streaming tail latency, without shedding while
-the pool sits half empty".
+the pool sits half empty".  The ISSUE-18 ``pipeline`` block gates
+its per-leg ``step_seconds`` / ``stage_idle_ms`` lower-is-better and
+``throughput_rows_per_s`` higher-is-better via the usual rules, plus
+``bubble_fraction`` and any scalar ``residency`` figure
+lower-is-better — the 1F1B claim is "same bubble as GPipe, strictly
+lower peak activation residency, no throughput give-back".
 
 When baseline and fresh disagree on ``meta.proxy`` (one is a
 CPU-proxy round, the other a real-chip round) the comparison is
@@ -84,7 +89,8 @@ HIGHER_BETTER = ("value", "tflops", "throughput", "_ips", "_rps",
 LOWER_BETTER = ("_ms", "_us", "_seconds", "overhead", "stall", "skew",
                 "_bytes_per_chip", "lost_steps", "cross_axis",
                 "model_axis_update_bytes", "temp_bytes",
-                "bytes_accessed", "shed")
+                "bytes_accessed", "shed", "bubble_fraction",
+                "residency")
 #: keys that are identity/config, never compared; "canary" keys are
 #: clock-path checks documented as dispatch-noise-dominated
 SKIP = ("metric", "unit", "n_trials", "vs_baseline", "meta", "min",

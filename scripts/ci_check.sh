@@ -58,7 +58,13 @@
 #      on the response with the latency-histogram exemplar carrying
 #      it, and a forced shed storm must dump the request flight
 #      recorder with per-phase timings (the ISSUE 17 acceptance bar,
-#      scripts/check_request_tracing.py).
+#      scripts/check_request_tracing.py);
+#  11. pipeline equivalence gate: pp=2 and pp2×dp on the virtual
+#      8-device mesh must track the dp-only dense 4-step trajectory
+#      (Sgd/Nesterovs/Adam, MLN + graph, both schedules), 1F1B must
+#      hold strictly lower peak activation residency than GPipe at
+#      equal n_micro, and pp checkpoints must restore onto a 1D mesh
+#      (the ISSUE 18 acceptance bar, tests/test_pipeline.py).
 #
 # Usage: scripts/ci_check.sh [--threshold PCT]     (default 10)
 # Exit 0 = all gates clean, 1 = a gate failed, 2 = bad usage.
@@ -126,5 +132,9 @@ JAX_PLATFORMS=cpu python scripts/check_generative.py || fail=1
 
 echo "== request-tracing gate =="
 JAX_PLATFORMS=cpu python scripts/check_request_tracing.py || fail=1
+
+echo "== pipeline equivalence gate =="
+JAX_PLATFORMS=cpu python -m pytest tests/test_pipeline.py -q \
+    -p no:cacheprovider || fail=1
 
 exit $fail

@@ -1,0 +1,408 @@
+"""Pipeline parallelism on the REAL fit path (ISSUE 18), on the
+virtual 8-device CPU mesh.
+
+Covers: the 1F1B tick table against a hand-computed 2-stage /
+4-microbatch schedule, the strictly-lower-than-GPipe peak activation
+residency bound, pp=2 and pp2×dp 4-step trajectory parity with the
+dp-only dense baseline (Sgd / Nesterovs / Adam, MLN + graph, both
+schedules), full 3D (dp×tp×pp) composition, the non-divisible
+microbatch error path, pp checkpoints restored onto a 1D mesh, the
+remesh pipe-axis guard, builder device-count validation, the
+fsdp→per-stage-ZeRO-1 downgrade, and the per-stage SpecLayout / wire
+accounting surfaces.
+
+Trajectory tolerances follow test_2d_parallel.py: XLA reassociates
+the microbatch-sum and update-tail reductions differently per layout,
+so parity is float32 noise, not bitwise.
+"""
+import jax
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.activations import Activation
+from deeplearning4j_tpu.datasets.dataset import DataSet
+from deeplearning4j_tpu.learning.updaters import Adam, Nesterovs, Sgd
+from deeplearning4j_tpu.lossfunctions import LossFunction
+from deeplearning4j_tpu.nn.conf.builders import NeuralNetConfiguration
+from deeplearning4j_tpu.nn.conf.inputs import InputType
+from deeplearning4j_tpu.nn.conf.layers import DenseLayer, OutputLayer
+from deeplearning4j_tpu.nn.graph import ComputationGraph
+from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+from deeplearning4j_tpu.nn.weights import WeightInit
+from deeplearning4j_tpu.parallel import (ParallelWrapper,
+                                         PipelineTrainer, SpecLayout,
+                                         StagePartition,
+                                         bubble_fraction,
+                                         build_schedule, make_mesh,
+                                         peak_residency)
+from deeplearning4j_tpu.parallel.pipeline import (schedule_idle_ticks,
+                                                  to_microbatches)
+from deeplearning4j_tpu.parallel.zero import exchange_report
+
+
+def _mlp(updater=None, seed=42):
+    conf = (NeuralNetConfiguration.Builder()
+            .seed(seed)
+            .updater(updater or Sgd(0.1))
+            .weight_init(WeightInit.XAVIER)
+            .list()
+            .layer(DenseLayer(n_in=8, n_out=16,
+                              activation=Activation.TANH))
+            .layer(OutputLayer(n_out=3,
+                               loss_function=LossFunction.MCXENT,
+                               activation=Activation.SOFTMAX))
+            .set_input_type(InputType.feed_forward(8))
+            .build())
+    return MultiLayerNetwork(conf).init()
+
+
+def _graph(seed=7):
+    conf = (NeuralNetConfiguration.Builder().seed(seed)
+            .updater(Adam(0.01)).weight_init(WeightInit.XAVIER)
+            .graph_builder()
+            .add_inputs("in")
+            .set_input_types(InputType.feed_forward(8))
+            .add_layer("d1", DenseLayer(n_out=16,
+                                        activation=Activation.TANH),
+                       "in")
+            .add_layer("out", OutputLayer(
+                n_out=3, loss_function=LossFunction.MCXENT,
+                activation=Activation.SOFTMAX), "d1")
+            .set_outputs("out").build())
+    return ComputationGraph(conf).init()
+
+
+def _data(n=64, seed=0):
+    rng = np.random.RandomState(seed)
+    x = rng.randn(n, 8).astype(np.float32)
+    y = np.eye(3, dtype=np.float32)[rng.randint(0, 3, n)]
+    return DataSet(x, y)
+
+
+def _assert_tree_close(a, b, **kw):
+    la = jax.tree_util.tree_leaves(a)
+    lb = jax.tree_util.tree_leaves(b)
+    assert len(la) == len(lb)
+    for x, y in zip(la, lb):
+        np.testing.assert_allclose(np.asarray(x), np.asarray(y), **kw)
+
+
+def _pp_mesh(dp=4, pp=2):
+    return make_mesh({"data": dp, "pipe": pp}, jax.devices()[:dp * pp])
+
+
+# -- the schedule itself ----------------------------------------------------
+def test_1f1b_schedule_matches_hand_table():
+    """S=2, M=4 against the hand-computed 1F1B table: one warm-up
+    forward on stage 0, then strict one-forward-one-backward
+    alternation, drain at the end."""
+    F, B = "F", "B"
+    expected = [
+        ((F, 0), None),
+        ((F, 1), (F, 0)),
+        (None, (B, 0)),
+        ((B, 0), (F, 1)),
+        ((F, 2), (B, 1)),
+        ((B, 1), (F, 2)),
+        ((F, 3), (B, 2)),
+        ((B, 2), (F, 3)),
+        (None, (B, 3)),
+        ((B, 3), None),
+    ]
+    assert build_schedule(2, 4, "1f1b") == expected
+
+
+def test_gpipe_schedule_all_forward_then_backward():
+    """GPipe reference: every stage finishes all M forwards before any
+    backward, backwards run in reverse microbatch order (the scan
+    engine's VJP order)."""
+    sched = build_schedule(2, 4, "gpipe")
+    for s in range(2):
+        ops = [op for ops in sched if (op := ops[s]) is not None]
+        assert [m for k, m in ops if k == "F"] == [0, 1, 2, 3]
+        assert [m for k, m in ops if k == "B"] == [3, 2, 1, 0]
+        assert [k for k, _ in ops] == ["F"] * 4 + ["B"] * 4
+
+
+@pytest.mark.parametrize("s_n,m_n", [(2, 4), (2, 8), (4, 8)])
+def test_1f1b_residency_strictly_below_gpipe(s_n, m_n):
+    """The acceptance bar: at equal n_micro, 1F1B's peak in-flight
+    microbatch count is min(M, S-s) per stage — strictly below GPipe's
+    M on every stage where M > S-s."""
+    p1 = peak_residency(build_schedule(s_n, m_n, "1f1b"), s_n)
+    pg = peak_residency(build_schedule(s_n, m_n, "gpipe"), s_n)
+    assert p1 == [min(m_n, s_n - s) for s in range(s_n)]
+    assert pg == [m_n] * s_n
+    assert all(a < b for a, b in zip(p1, pg))
+
+
+def test_bubble_fraction_and_idle_ticks():
+    """Analytic bubble (S-1)/(M+S-1) matches the tick table's actual
+    idle count for both schedules — 1F1B trades residency, not
+    bubble."""
+    assert bubble_fraction(2, 4) == pytest.approx(0.2)
+    for kind in ("gpipe", "1f1b"):
+        sched = build_schedule(2, 4, kind)
+        assert len(sched) == 10          # 2*M + 2*(S-1)
+        assert schedule_idle_ticks(sched, 2) == [2, 2]
+    assert bubble_fraction(4, 8) == pytest.approx(3 / 11)
+
+
+def test_schedule_validation():
+    with pytest.raises(ValueError, match="unknown pipeline schedule"):
+        build_schedule(2, 4, "interleaved")
+    with pytest.raises(ValueError, match="n_stages"):
+        build_schedule(0, 4)
+
+
+# -- stage partitioning -----------------------------------------------------
+def test_stage_partition_contiguous_and_balanced():
+    params = {f"layer_{i}": {"W": np.zeros((8, 8), np.float32)}
+              for i in range(4)}
+    part = StagePartition.build(list(params), params, 2)
+    assert part.stage_entries(0) == ["layer_0", "layer_1"]
+    assert part.stage_entries(1) == ["layer_2", "layer_3"]
+    assert part.stage_of("layer_2") == 1
+    with pytest.raises(ValueError, match="cannot split"):
+        StagePartition.build(["layer_0"], params, 2)
+
+
+def test_infer_stages_specs_match_2d_and_never_name_pipe():
+    """SpecLayout.infer_stages: per-stage specs equal what the 2D
+    layout infers for the same entries, and the pipe axis never
+    appears in a PartitionSpec (it partitions whole entries)."""
+    mesh = make_mesh({"data": 2, "model": 2, "pipe": 2},
+                     jax.devices()[:8])
+    params = {f"layer_{i}": {"W": np.zeros((8, 16), np.float32),
+                             "b": np.zeros((16,), np.float32)}
+              for i in range(4)}
+    part = StagePartition.build(list(params), params, 2)
+    lay = SpecLayout(mesh)
+    assert lay.pp == 2
+    staged = lay.infer_stages(params, part)
+    assert [sorted(d) for d in staged] == [["layer_0", "layer_1"],
+                                           ["layer_2", "layer_3"]]
+    flat2d = SpecLayout(make_mesh({"data": 2, "model": 2},
+                                  jax.devices()[:4])).infer(params)
+    for d in staged:
+        for k, specs in d.items():
+            assert specs == flat2d[k]
+            for leaf in specs.values():
+                assert "pipe" not in tuple(leaf.compute)
+                assert "pipe" not in tuple(leaf.resident)
+
+
+# -- trajectory parity: direct trainer --------------------------------------
+@pytest.mark.parametrize("schedule", ["1f1b", "gpipe"])
+@pytest.mark.parametrize("updater,rtol,atol", [
+    (lambda: Sgd(0.1), 1e-6, 1e-7),
+    (lambda: Nesterovs(0.1, 0.9), 1e-5, 1e-6),
+    (lambda: Adam(0.01), 1e-5, 1e-6),
+], ids=["sgd", "nesterovs", "adam"])
+def test_pp2_trajectory_matches_dense(schedule, updater, rtol, atol):
+    """The ISSUE acceptance bar (pp flavor of test_2d_parallel's):
+    pp=2 through the real microbatched fit path tracks the unsplit
+    dense baseline batch for batch — grads sum over microbatches into
+    exactly the full-batch gradient."""
+    ref = _mlp(updater())
+    net = _mlp(updater())
+    tr = PipelineTrainer(net, _pp_mesh(), n_micro=4, schedule=schedule)
+    for i in range(4):
+        ds = _data(16, seed=i)
+        ref.fit(ds)
+        tr.fit_batch(ds)
+    _assert_tree_close(ref.params, net.params, rtol=rtol, atol=atol)
+    rep = tr.last_report
+    assert rep["schedule"] == schedule
+    assert rep["bubble_fraction"] == pytest.approx(0.2)
+    assert rep["pipe_wire_bytes"] > 0
+
+
+def test_pp2_graph_trajectory_matches_dense():
+    """ComputationGraph through the topo-sliced stage forward: same
+    4-batch parity bar as the MLN path."""
+    ref = _graph()
+    net = _graph()
+    tr = PipelineTrainer(net, _pp_mesh(), n_micro=4)
+    for i in range(4):
+        ds = _data(16, seed=i)
+        ref.fit(ds)
+        tr.fit_batch(ds)
+    _assert_tree_close(ref.params, net.params, rtol=1e-5, atol=1e-6)
+
+
+def test_1f1b_measured_residency_below_gpipe():
+    """The residency bound holds for MEASURED activation-stash bytes,
+    not just schedule counts."""
+    reps = {}
+    for kind in ("1f1b", "gpipe"):
+        net = _mlp()
+        tr = PipelineTrainer(net, _pp_mesh(), n_micro=4, schedule=kind)
+        tr.fit_batch(_data(16))
+        reps[kind] = tr.last_report
+    assert reps["1f1b"]["peak_residency_microbatches"] == [2, 1]
+    assert reps["gpipe"]["peak_residency_microbatches"] == [4, 4]
+    assert sum(reps["1f1b"]["peak_residency_bytes"]) < \
+        sum(reps["gpipe"]["peak_residency_bytes"])
+
+
+# -- trajectory parity: wrapper (3D mesh) -----------------------------------
+@pytest.mark.parametrize("mode", ["dense", "sharded"])
+def test_pp2_dp_wrapper_trajectory_matches_dp_only_dense(mode):
+    """pp2×dp through ParallelWrapper.Builder.pipeline_stages tracks
+    the dp-only 8-way dense baseline — the pipe axis is a purely
+    physical re-layout of the same math, in both exchange tails."""
+    batches = [_data(64, seed=i) for i in range(4)]
+    ref = _mlp(Adam(0.01), seed=7)
+    pw_ref = ParallelWrapper.Builder(ref).workers(8) \
+        .update_exchange("dense").build()
+    net = _mlp(Adam(0.01), seed=7)
+    pw = (ParallelWrapper.Builder(net).workers(2).pipeline_stages(2)
+          .microbatches(4).update_exchange(mode).build())
+    assert pw.pipeline_stages == 2 and pw.n_workers == 2
+    for ds in batches:
+        pw_ref.fit_batch(ds)
+        pw.fit_batch(ds)
+    _assert_tree_close(ref.params, net.params, rtol=1e-5, atol=1e-6)
+    assert pw._exchange_bytes > 0          # dp=2 per stage exchanges
+    assert pw._pipeline.last_report["pipe_wire_bytes"] > 0
+
+
+def test_3d_dp_tp_pp_trajectory_matches_dense():
+    """True 3D: (dp=2, tp=2, pp=2) over all 8 virtual devices tracks
+    the dp-only dense baseline — stage partition, per-stage tp specs
+    and the ZeRO-1 per-stage flats all compose."""
+    batches = [_data(64, seed=i) for i in range(4)]
+    ref = _mlp(Adam(0.01), seed=9)
+    pw_ref = ParallelWrapper.Builder(ref).workers(8) \
+        .update_exchange("dense").build()
+    net = _mlp(Adam(0.01), seed=9)
+    pw = (ParallelWrapper.Builder(net).workers(2).tensor_parallel(2)
+          .pipeline_stages(2).microbatches(4)
+          .update_exchange("sharded").build())
+    assert dict(pw.mesh.shape) == {"data": 2, "model": 2, "pipe": 2}
+    for ds in batches:
+        pw_ref.fit_batch(ds)
+        pw.fit_batch(ds)
+    _assert_tree_close(ref.params, net.params, rtol=2e-5, atol=1e-6)
+    # per-stage tp specs were inferred (one sharded entry per stage)
+    assert all(pw._pipeline._tp_specs)
+
+
+def test_fsdp_downgrades_to_per_stage_zero1():
+    """fsdp×pp downgrades to the per-stage ZeRO-1 sharded tail (flats
+    stay local to each pipe group) and still trains."""
+    net = _mlp(Adam(0.01), seed=9)
+    pw = (ParallelWrapper.Builder(net).workers(4).pipeline_stages(2)
+          .update_exchange("fsdp").build())
+    pw.fit_batch(_data(64))
+    assert np.isfinite(float(net.score()))
+    assert pw._pipeline._tail == "sharded"
+
+
+# -- error paths ------------------------------------------------------------
+def test_microbatch_non_divisible_raises():
+    with pytest.raises(ValueError, match="not divisible by 4"):
+        to_microbatches(np.zeros((62, 8), np.float32), 4)
+    net = _mlp()
+    tr = PipelineTrainer(net, _pp_mesh(), n_micro=4)
+    with pytest.raises(ValueError, match="not divisible"):
+        tr.fit_batch(_data(62))
+
+
+def test_builder_device_count_validation():
+    with pytest.raises(ValueError, match="does not divide"):
+        ParallelWrapper.Builder(_mlp()).workers(3) \
+            .pipeline_stages(3).build()
+    from deeplearning4j_tpu.parallel import SharedTrainingMaster
+    master = SharedTrainingMaster.Builder(32).workers_per_node(3) \
+        .pipeline_stages(3).build()
+    with pytest.raises(ValueError, match="does not divide"):
+        master._global_mesh()
+    with pytest.raises(ValueError, match="pipeline_stages"):
+        ParallelWrapper.Builder(_mlp()).pipeline_stages(0)
+
+
+def test_trainer_needs_two_stages_on_pipe_axis():
+    mesh = make_mesh({"data": 8}, jax.devices()[:8])
+    with pytest.raises(ValueError, match=">= 2 stages"):
+        PipelineTrainer(_mlp(), mesh)
+
+
+def test_remesh_rejects_pipe_axis_change_while_placed():
+    """Regression (ISSUE 18 satellite): remesh() must refuse to change
+    the pipe axis under a placed pipeline — stage jits and the
+    partition are keyed to it — and direct the caller to shutdown()
+    first. After shutdown the same remesh works."""
+    net = _mlp(Adam(0.01))
+    pw = (ParallelWrapper.Builder(net).workers(4).pipeline_stages(2)
+          .update_exchange("dense").build())
+    pw.fit_batch(_data(64))
+    with pytest.raises(ValueError, match="pipe axis"):
+        pw.remesh(make_mesh({"data": 8}, jax.devices()[:8]))
+    pw.shutdown()
+    pw.remesh(make_mesh({"data": 8}, jax.devices()[:8]))
+    assert pw.pipeline_stages == 1
+    pw.fit_batch(_data(64, seed=1))
+    assert np.isfinite(float(net.score()))
+
+
+# -- elasticity: pp -> 1D ---------------------------------------------------
+def test_pp_checkpoint_restores_onto_1d_mesh(tmp_path):
+    """A checkpoint written under pp=2 restores and CONTINUES on a
+    plain dp-only 8-way mesh, tracking the uninterrupted dense
+    trajectory (checkpoints densify, so they are stage-count
+    portable)."""
+    from deeplearning4j_tpu.utils import CheckpointListener
+    batches = [_data(64, seed=i) for i in range(4)]
+    ref = _mlp(seed=11)
+    pw_ref = ParallelWrapper.Builder(ref).workers(8) \
+        .update_exchange("dense").build()
+    for ds in batches:
+        pw_ref.fit_batch(ds)
+
+    net = _mlp(seed=11)
+    lis = CheckpointListener(tmp_path, save_every_n_iterations=2)
+    net.set_listeners(lis)
+    pw = (ParallelWrapper.Builder(net).workers(4).pipeline_stages(2)
+          .update_exchange("dense").build())
+    for ds in batches[:2]:
+        pw.fit_batch(ds)
+    lis.flush()
+
+    restored = CheckpointListener.load_checkpoint(tmp_path)
+    assert restored.iteration_count == 2
+    pw2 = ParallelWrapper.Builder(restored).workers(8) \
+        .update_exchange("dense").build()
+    assert pw2.pipeline_stages == 1
+    for ds in batches[2:]:
+        pw2.fit_batch(ds)
+    _assert_tree_close(ref.params, restored.params,
+                       rtol=2e-5, atol=1e-6)
+
+
+# -- observability ----------------------------------------------------------
+def test_pipeline_report_and_accounting_surfaces():
+    """last_report carries the observatory fields, the stepstats
+    breakdown gains the pipeline phase, and exchange_report joins the
+    per-stage accounting under pipe_shards."""
+    from deeplearning4j_tpu.common.stepstats import PHASES
+    assert "pipeline" in PHASES
+    net = _mlp()
+    tr = PipelineTrainer(net, _pp_mesh(), n_micro=4)
+    tr.fit_batch(_data(16))
+    rep = tr.last_report
+    for key in ("bubble_fraction", "bubble_seconds",
+                "stage_idle_seconds", "stage_busy_seconds",
+                "peak_residency_microbatches", "peak_residency_bytes",
+                "pipe_wire_fwd_bytes", "pipe_wire_bwd_bytes",
+                "pipe_wire_bytes", "stage_param_bytes"):
+        assert key in rep, key
+    assert len(rep["stage_idle_seconds"]) == 2
+
+    erep = exchange_report(net.params, 4, "dense", pipe_shards=2,
+                           stage_param_bytes=rep["stage_param_bytes"])
+    assert erep["pipe_shards"] == 2
+    assert erep["pipeline"]["cross_pipe_bytes"] == 0
+    assert erep["pipeline"]["stage_param_bytes"] == \
+        rep["stage_param_bytes"]
