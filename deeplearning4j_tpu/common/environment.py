@@ -120,10 +120,12 @@ class Environment:
       default on-for-TPU; =0 kills, =1 forces anywhere),
       DL4J_TPU_FUSED_CONV (tri-state like the flash gate: the Pallas
       conv/BN/ReLU epilogue family — conv-bias-act, BN statistics +
-      normalize, matmul+epilogue for aligned 1x1 convs; all three
-      gates resolve through the ops/kernel_select.py ladder:
-      structural gate, then force/kill, then auto heuristic, every
-      decision counted in dl4j_kernel_select_total),
+      normalize, matmul+epilogue for aligned 1x1 convs),
+      DL4J_TPU_PAGED_ATTENTION (tri-state: the paged decode-attention
+      Pallas kernel for the serving KV pool; all four gates resolve
+      through the ops/kernel_select.py ladder: structural gate, then
+      force/kill, then auto heuristic, every decision counted in
+      dl4j_kernel_select_total),
       DL4J_TPU_CHAOS (common.faults fault injection: comma-separated
       kill_after_steps=N / hard_kill_after_steps=N /
       slow_worker=SECONDS / torn_checkpoint=1),
@@ -137,15 +139,36 @@ class Environment:
       DL4J_TPU_ACCESS_LOG / DL4J_TPU_ACCESS_LOG_SAMPLE (httputil
       sampled JSONL access log: path turns it on, sample rate keeps
       a deterministic 1-in-N slice),
-      DL4J_TPU_REQREC / _CAPACITY / _DIR / _SHED_THRESHOLD /
-      _SHED_WINDOW_S / _STORM_COOLDOWN_S (serving.reqrec request
+      DL4J_TPU_REQREC / DL4J_TPU_REQREC_CAPACITY /
+      DL4J_TPU_REQREC_DIR / DL4J_TPU_REQREC_SHED_THRESHOLD /
+      DL4J_TPU_REQREC_SHED_WINDOW_S /
+      DL4J_TPU_REQREC_STORM_COOLDOWN_S (serving.reqrec request
       flight recorder: default on, 512-record ring, dump dir falls
       back to DL4J_TPU_FLIGHT_RECORDER_DIR; storm = threshold sheds
       inside the window, then a cooldown between dumps),
       DL4J_TPU_SLO_TARGET / DL4J_TPU_SLO_FAST_S / DL4J_TPU_SLO_SLOW_S
       (serving.slo error-budget accounting: in-SLO target fraction,
       default 0.99, over fast/slow burn-rate windows, default
-      300 s / 3600 s)
+      300 s / 3600 s),
+      DL4J_TPU_HTTP_HOST (bind interface for every HTTP server —
+      httputil, ui.server, serving.router; default 127.0.0.1,
+      loopback only; set 0.0.0.0 to expose beyond the host),
+      DL4J_TPU_OBSERVATORY_PORT (parallel.sharedtraining leader port
+      for the cross-worker step-stats aggregator, default 9470),
+      DL4J_TPU_TELEMETRY_MAX_EVENTS (common.telemetry trace-event
+      ring capacity, default 200000),
+      DL4J_TPU_STEPSTATS_STEPS (common.stepstats per-step ring size,
+      default 1024),
+      DL4J_TPU_DATA_DIR (datasets: directory holding real iris.csv /
+      MNIST IDX files; synthetic fallbacks are used when unset),
+      DL4J_TPU_NATIVE_LIB (native.bridge: explicit path to the
+      compiled helper library — load-or-fail, no silent fallback;
+      the sanitizer suite points it at the ASan+UBSan build),
+      DL4J_TPU_DISABLE_NATIVE (=1 forces the pure-Python fallbacks
+      even when the native library is buildable),
+      DL4J_TPU_TEST_PLATFORM (tests/benchmarks only: platform pin
+      for the suite — default cpu with an 8-device virtual mesh;
+      =axon runs against real accelerators)
     """
 
     _inst: _Env | None = None

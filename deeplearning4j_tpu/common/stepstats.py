@@ -496,7 +496,11 @@ class StepStatsAggregator:
             "tripped": bool(tripped),
         }
         if tripped:
-            self.trips += 1
+            # `trips` is read by report() from the leader thread while
+            # every connection thread can be in here — same lock as
+            # the merge bookkeeping
+            with self._lock:
+                self.trips += 1
             if telemetry.enabled():
                 telemetry.counter(
                     "dl4j_straggler_trips_total",
@@ -537,8 +541,9 @@ class StepStatsAggregator:
         mean step time, worker skew, trip count."""
         with self._lock:
             merged = list(self.merged)
+            trips = self.trips
         if not merged:
-            return {"steps_merged": 0, "trips": self.trips}
+            return {"steps_merged": 0, "trips": trips}
         mean = sum(m["mean_step_seconds"] for m in merged) / len(merged)
         return {
             "steps_merged": len(merged),
@@ -546,7 +551,7 @@ class StepStatsAggregator:
             "mean_step_seconds": mean,
             "max_skew_seconds": max(m["max_skew_seconds"]
                                     for m in merged),
-            "trips": self.trips,
+            "trips": trips,
             "worker_clock_offsets_s": dict(self.worker_offsets),
         }
 

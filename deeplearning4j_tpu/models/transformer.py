@@ -150,6 +150,12 @@ class DistributedTransformerLM:
         }
 
     def param_specs(self):
+        # The 5D flagship STACKS per-stage blocks on a leading stage
+        # dimension and shards that dimension over `pipe` — the one
+        # deliberate exception to the "pipe never appears in a
+        # PartitionSpec" invariant (the 1F1B fit path keeps stages as
+        # stage-local arrays instead; see parallel/speclayout.py).
+        # dl4j-lint: disable-file=spec-invariants
         col = P("pipe", None, "model")
         row = P("pipe", "model", None)
         rep = P("pipe", None)
@@ -305,14 +311,15 @@ class DistributedTransformerLM:
         right axes, so the grads arriving here are already complete —
         verified leaf-for-leaf against a single-device reference in
         test_transformer_5d. On older jax the manual rule applies:
-        psum each leaf over every mesh axis absent from its
-        PartitionSpec (plus ``model`` in megatron-SP mode, where time
-        is sharded over ``model``), which is the same set of axes the
-        VMA transpose derives."""
+        psum each leaf over EVERY mesh axis absent from its
+        PartitionSpec. Size-1 axes are psummed too — numerically a
+        no-op, but it is what marks the leaf replicated over that
+        axis for the shard_map replication checker (skipping them is
+        why the ring-CP step used to be rejected by check_rep: a
+        size-1 ``data`` axis never entered the grads' inferred
+        replication set, so the params' out_specs failed)."""
         if hasattr(lax, "pcast"):
             return grads
-        axes = ["data", "pipe", "seq"] + ([] if self.ring
-                                          else ["model"])
         present = set(self.mesh.axis_names)
 
         def red(g, spec):
@@ -324,9 +331,7 @@ class DistributedTransformerLM:
                     named.update(entry)
                 else:
                     named.add(entry)
-            todo = tuple(ax for ax in axes
-                         if ax in present and ax not in named
-                         and _axsize(self.mesh, ax) > 1)
+            todo = tuple(ax for ax in present if ax not in named)
             return lax.psum(g, todo) if todo else g
 
         return _zip_map(red, grads, specs)
@@ -355,9 +360,19 @@ class DistributedTransformerLM:
             # same CE). Autodiff sums all rank-copies through the
             # collective transposes, so each rank must contribute
             # loss/n_copies for the grads to come out exactly dL/dθ
-            # (verified leaf-for-leaf in test_transformer_5d).
-            vma = tuple(getattr(getattr(loss, "aval", None), "vma", ()))
-            scale = int(np.prod([sizes.get(a, 1) for a in vma])) or 1
+            # (verified leaf-for-leaf in test_transformer_5d). Under
+            # VMA-typed jax (>= 0.8) the copy count is the product of
+            # the loss's varying axes; on older jax there is no vma
+            # type and every rank of the whole mesh seeds cotangent 1
+            # through the rep-checked transpose, so the count is the
+            # full mesh size.
+            if hasattr(lax, "pcast"):
+                vma = tuple(getattr(getattr(loss, "aval", None),
+                                    "vma", ()))
+                scale = int(np.prod([sizes.get(a, 1)
+                                     for a in vma])) or 1
+            else:
+                scale = int(np.prod(list(sizes.values()))) or 1
             return loss / scale, loss
 
         def body(params, opt_state, ids, labels, it):

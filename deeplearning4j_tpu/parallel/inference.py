@@ -136,6 +136,9 @@ class ParallelInference:
                                     rng=None, want_logits=False)
                 return out
 
+            # idempotent lazy init: racing callers both build the same
+            # jitted fn and the last assignment wins — no torn state
+            # dl4j-lint: disable=lock-discipline
             self._fwd = jax.jit(fwd)
 
     def _place_chunk(self, x):
@@ -268,6 +271,9 @@ class ParallelInference:
                     batch.append(nxt)
                 self._flush(batch)
 
+        # caller holds self._lock (see docstring) — submit's
+        # queue-bind and the worker start stay atomic
+        # dl4j-lint: disable=lock-discipline
         self._worker = threading.Thread(target=loop, daemon=True,
                                         name="dl4j-tpu-serving")
         self._worker.start()

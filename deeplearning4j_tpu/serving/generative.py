@@ -230,6 +230,10 @@ class DecodeEngine:
         self._live: Dict[int, _Sequence] = {}
         self._lock = threading.Lock()
         self._worker: Optional[threading.Thread] = None
+        #: the worker shutdown() swapped out, until it finishes its
+        #: drain — _ensure_worker joins it so two workers never touch
+        #: _pending/_live concurrently
+        self._draining: Optional[threading.Thread] = None
         self._work = threading.Event()
         self._shutdown = False
         self._step = 0
@@ -400,33 +404,55 @@ class DecodeEngine:
     def _ensure_worker(self):
         if self._worker is not None:
             return
+        prev, self._draining = self._draining, None
+        if prev is not None:
+            # the old worker drains _pending/_live single-threaded;
+            # it never takes this lock, so waiting here cannot deadlock
+            prev.join()
         self._shutdown = False
+        # caller (submit) holds self._lock: worker startup and the
+        # queue insertion that wakes it stay atomic
+        # dl4j-lint: disable=lock-discipline
         self._worker = threading.Thread(target=self._loop, daemon=True,
                                         name=f"dl4j-generate-"
                                              f"{self.name}")
         self._worker.start()
 
     def shutdown(self, timeout: float = 30.0):
-        self._shutdown = True
+        """Stop the engine worker after it drains every admitted and
+        pending sequence (bounded by ``timeout``). A concurrent submit
+        either reaches the old worker's drain, or sees ``_worker``
+        None and starts a fresh one — it can no longer enqueue onto a
+        joined worker and strand its stream."""
+        with self._lock:
+            self._shutdown = True
+            w, self._worker = self._worker, None
+            if w is not None:
+                self._draining = w
         self._work.set()
-        w = self._worker
         if w is not None:
             w.join(timeout)
-            self._worker = None
 
     # -- the continuous loop -------------------------------------------
     def _loop(self):
-        while not self._shutdown:
+        me = threading.current_thread()
+        while True:
             # Clear BEFORE draining: a submit that lands after the
             # drain re-sets the event, so the wait below returns
             # immediately instead of losing the wake-up.
             self._work.clear()
             admitted = self._admit_pending()
             stepped = self._decode_iteration()
-            if not admitted and not stepped:
-                # Idle: block until a submit wakes us (bounded so
-                # queued deadline/cancel checks still tick over).
-                self._work.wait(0.05)
+            if admitted or stepped:
+                continue
+            # Idle — and only exit on shutdown/supersession while
+            # idle: every pending request was admitted and every
+            # admitted sequence retired, so no stream is stranded.
+            if self._shutdown or self._worker is not me:
+                return
+            # Block until a submit wakes us (bounded so queued
+            # deadline/cancel checks still tick over).
+            self._work.wait(0.05)
 
     def _admit_pending(self) -> bool:
         """Prefill every queued request (each its own bucket-padded

@@ -208,6 +208,9 @@ class ServingRouter:
                 router._proxy(self)
 
         self._httpd, self._thread = start_http_server(Handler, port)
+        # lifecycle transition: assigned before the health thread
+        # starts (happens-before), and start/stop are owner-serialized
+        # dl4j-lint: disable=lock-discipline
         self.port = self._httpd.server_address[1]
         self._stopping = False
         self._health_thread = threading.Thread(
@@ -224,6 +227,8 @@ class ServingRouter:
             self._httpd.server_close()
             self._httpd = None
             self._thread = None
+            # lifecycle transition, owner-serialized with start()
+            # dl4j-lint: disable=lock-discipline
             self.port = None
         for r in self.replicas:
             r.server.stop(drain=drain, timeout=timeout)

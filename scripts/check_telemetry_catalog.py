@@ -1,6 +1,13 @@
 #!/usr/bin/env python
 """Keep the README metrics catalog honest.
 
+Thin CLI wrapper over the metric-registry lint rule
+(``scripts/dl4j_lint/rules_metric.py``) — the scanning logic lives
+there, shared with ``python -m scripts.dl4j_lint``. This entry point
+keeps the historical contract for ci_check gate 1 and the tier-1 test
+(tests/test_telemetry.py): the same FAIL/OK lines, exit 0 iff the
+catalog matches the code.
+
 Scans the source tree — every ``deeplearning4j_tpu`` subpackage
 (including ``serving/``), ``benchmarks/``, ``scripts/``,
 ``examples/``, and ``bench.py`` — for telemetry metric registrations
@@ -22,74 +29,20 @@ Runs as a tier-1 test (tests/test_telemetry.py) and standalone:
 from __future__ import annotations
 
 import pathlib
-import re
 import sys
-from typing import Dict, Set
 
 ROOT = pathlib.Path(__file__).resolve().parent.parent
-README = ROOT / "README.md"
-
-#: metric registrations: counter("name" / gauge("name" /
-#: histogram("name" — any receiver (telemetry module, a registry, or
-#: the module-level helpers called bare inside telemetry.py)
-_REG_RE = re.compile(
-    r"\b(counter|gauge|histogram)\(\s*\n?\s*['\"](dl4j_[a-z0-9_]+)")
-
-#: names prefixed dl4j_ anywhere in the README catalog section
-_DOC_RE = re.compile(r"`(dl4j_[a-z0-9_]+)`")
-
-#: catalog table rows: | `name` | kind | ...
-_DOC_ROW_RE = re.compile(
-    r"^\|\s*`(dl4j_[a-z0-9_]+)`\s*\|\s*(counter|gauge|histogram)\s*\|",
-    re.M)
-
-#: registrations that are deliberately NOT part of the public catalog
-_EXEMPT = {"dl4j_bench_counter_total", "dl4j_bench_hist_seconds"}
-
-_SCAN_BASES = ("deeplearning4j_tpu", "benchmarks", "scripts",
-               "examples")
-
-
-def registered_metrics() -> Dict[str, Set[str]]:
-    """{metric name: {registration kinds seen}} across the tree."""
-    names: Dict[str, Set[str]] = {}
-    texts = []
-    for base in _SCAN_BASES:
-        texts.extend(p.read_text()
-                     for p in (ROOT / base).rglob("*.py"))
-    texts.append((ROOT / "bench.py").read_text())
-    for text in texts:
-        for kind, name in _REG_RE.findall(text):
-            if name not in _EXEMPT:
-                names.setdefault(name, set()).add(kind)
-    return names
-
-
-def documented_metrics() -> Dict[str, str]:
-    """{metric name: documented kind} from the catalog tables in the
-    "## Observability", "## Diagnostics", "## Scaling observatory",
-    "## Layer attribution" and "## Fault tolerance & elasticity"
-    sections (names mentioned outside table rows count as documented
-    with kind '')."""
-    text = README.read_text()
-    doc: Dict[str, str] = {}
-    for heading in ("Observability", "Diagnostics",
-                    "Scaling observatory", "Layer attribution",
-                    "Fault tolerance & elasticity"):
-        m = re.search(rf"## {heading}(.*?)(?:\n## |\Z)", text, re.S)
-        if not m:
-            continue
-        section = m.group(1)
-        for name in _DOC_RE.findall(section):
-            doc.setdefault(name, "")
-        doc.update({name: kind
-                    for name, kind in _DOC_ROW_RE.findall(section)})
-    return doc
 
 
 def main() -> int:
-    reg = registered_metrics()
-    doc = documented_metrics()
+    sys.path.insert(0, str(ROOT))
+    from scripts.dl4j_lint.core import build_repo_context
+    from scripts.dl4j_lint.rules_metric import (documented_metrics,
+                                                registered_metrics)
+
+    repo = build_repo_context(ROOT)
+    reg = registered_metrics(repo)
+    doc = documented_metrics(repo.readme())
     rc = 0
     missing = sorted(set(reg) - set(doc))
     stale = sorted(set(doc) - set(reg))
@@ -109,7 +62,7 @@ def main() -> int:
             print(f"  - {n}")
         rc = 1
     kind_clash = sorted(
-        (n, kinds, doc[n]) for n, kinds in reg.items()
+        (n, kinds, doc[n]) for n, (kinds, _, _) in reg.items()
         if doc.get(n) and doc[n] not in kinds)
     if kind_clash:
         print("FAIL: catalog Type column disagrees with the "

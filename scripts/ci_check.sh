@@ -64,7 +64,12 @@
 #      (Sgd/Nesterovs/Adam, MLN + graph, both schedules), 1F1B must
 #      hold strictly lower peak activation residency than GPipe at
 #      equal n_micro, and pp checkpoints must restore onto a 1D mesh
-#      (the ISSUE 18 acceptance bar, tests/test_pipeline.py).
+#      (the ISSUE 18 acceptance bar, tests/test_pipeline.py);
+#  12. static analysis gate: dl4j-lint (jit-purity, lock-discipline,
+#      env-registry, metric-registry, spec-invariants) over the whole
+#      tree must surface no finding outside the checked-in baseline,
+#      and no rule's finding count may grow past its baselined count
+#      (the ISSUE 19 acceptance bar, scripts/dl4j_lint).
 #
 # Usage: scripts/ci_check.sh [--threshold PCT]     (default 10)
 # Exit 0 = all gates clean, 1 = a gate failed, 2 = bad usage.
@@ -136,5 +141,9 @@ JAX_PLATFORMS=cpu python scripts/check_request_tracing.py || fail=1
 echo "== pipeline equivalence gate =="
 JAX_PLATFORMS=cpu python -m pytest tests/test_pipeline.py -q \
     -p no:cacheprovider || fail=1
+
+echo "== static analysis gate =="
+python -m scripts.dl4j_lint \
+    --baseline scripts/dl4j_lint_baseline.json || fail=1
 
 exit $fail

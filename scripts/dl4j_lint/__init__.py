@@ -1,0 +1,36 @@
+"""dl4j-lint: repo-native static analysis for the invariants the test
+suite cannot see.
+
+Four invariant classes in this codebase are enforced only by
+convention, and each has been violated at least once (ISSUE 19):
+trace-time impurity inside jitted code, lock discipline across the
+threaded serving/telemetry modules, registry drift between code and
+docs (env vars, metrics), and sharding invariants (the ``pipe`` axis,
+donated buffers).  This package is a small AST-based analyzer — the
+"IR" is the Python AST — with one rule per invariant class:
+
+- ``jit-purity``       trace-time impurity reachable from jit roots
+- ``lock-discipline``  unguarded shared-attribute mutation in
+                       thread-starting classes
+- ``env-registry``     DL4J_TPU_* reads vs environment.py + README
+- ``metric-registry``  dl4j_* metric literals vs the README catalog
+- ``spec-invariants``  no ``pipe`` in PartitionSpec; no use of donated
+                       args after the jitted call
+
+Run ``python -m scripts.dl4j_lint --baseline
+scripts/dl4j_lint_baseline.json`` (ci_check.sh gate 12).  Findings are
+gated on NEW debt only: a checked-in baseline grandfathers known
+findings (each with a reason string), per-line suppressions
+(``# dl4j-lint: disable=<rule>``) silence deliberate idioms at the
+site, and the gate also fails when a rule's finding count grows past
+its baselined count.
+"""
+from scripts.dl4j_lint.core import (  # noqa: F401
+    FileContext, Finding, RepoContext, Rule, all_rules,
+    build_repo_context, lint_repo, load_baseline, register,
+)
+
+# importing the rule modules registers them
+from scripts.dl4j_lint import (  # noqa: F401
+    rules_env, rules_jit, rules_lock, rules_metric, rules_spec,
+)

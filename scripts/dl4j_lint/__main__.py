@@ -1,0 +1,134 @@
+"""CLI: ``python -m scripts.dl4j_lint [options] [files...]``.
+
+Exit codes: 0 clean (or every finding baselined), 1 findings the
+baseline does not cover (or a rule's count grew past its baselined
+count), 2 usage / bad baseline.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import pathlib
+import sys
+import time
+
+from scripts.dl4j_lint.core import (all_rules, gate, lint_repo,
+                                    load_baseline, write_baseline)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m scripts.dl4j_lint",
+        description="repo-native static analysis (jit-purity, "
+                    "lock-discipline, env/metric registries, "
+                    "spec-invariants)")
+    ap.add_argument("files", nargs="*",
+                    help="lint only these files (default: the full "
+                         "scan tree)")
+    ap.add_argument("--root", default=None,
+                    help="repo root (default: this script's repo)")
+    ap.add_argument("--baseline", default=None,
+                    help="grandfathered-findings JSON; gates on NEW "
+                         "findings only")
+    ap.add_argument("--write-baseline", metavar="PATH", default=None,
+                    help="write the current findings as the baseline "
+                         "to PATH (keeps existing reasons) and exit 0")
+    ap.add_argument("--rules", default=None,
+                    help="comma-separated rule subset")
+    ap.add_argument("--json", action="store_true", dest="as_json",
+                    help="machine-readable output")
+    ap.add_argument("--list-rules", action="store_true")
+    args = ap.parse_args(argv)
+
+    if args.list_rules:
+        for name, rule in sorted(all_rules().items()):
+            print(f"{name:16s} {rule.description}")
+        return 0
+
+    root = pathlib.Path(args.root) if args.root else \
+        pathlib.Path(__file__).resolve().parents[2]
+    rule_names = None
+    if args.rules:
+        rule_names = [r.strip() for r in args.rules.split(",")
+                      if r.strip()]
+        unknown = set(rule_names) - set(all_rules())
+        if unknown:
+            print(f"unknown rules: {sorted(unknown)} "
+                  f"(have: {sorted(all_rules())})", file=sys.stderr)
+            return 2
+    files = [pathlib.Path(f) for f in args.files] or None
+
+    t0 = time.monotonic()
+    findings = lint_repo(root, rule_names=rule_names, files=files)
+    elapsed = time.monotonic() - t0
+
+    if args.write_baseline:
+        old = None
+        p = pathlib.Path(args.write_baseline)
+        if p.exists():
+            try:
+                old = load_baseline(p)
+            except ValueError:
+                old = None
+        write_baseline(p, findings, old)
+        print(f"wrote {len(findings)} baseline entries to {p} "
+              f"(justify every TODO reason before committing)")
+        return 0
+
+    baseline = None
+    if args.baseline:
+        try:
+            baseline = load_baseline(pathlib.Path(args.baseline))
+        except (OSError, ValueError, KeyError,
+                json.JSONDecodeError) as e:
+            print(f"bad baseline {args.baseline}: {e}",
+                  file=sys.stderr)
+            return 2
+
+    if baseline is None:
+        report, failed = findings, bool(findings)
+        baselined = 0
+    else:
+        result = gate(findings, baseline)
+        report, failed = result.new, result.failed
+        baselined = len(findings) - len(result.new)
+
+    if args.as_json:
+        out = {
+            "findings": [f.as_json() for f in report],
+            "total": len(findings),
+            "baselined": baselined,
+            "seconds": round(elapsed, 3),
+            "failed": failed,
+        }
+        if baseline is not None:
+            out["stale_baseline_keys"] = result.stale
+            out["grown_rules"] = {
+                r: {"current": c, "baselined": b}
+                for r, (c, b) in result.grown.items()}
+        print(json.dumps(out, indent=2))
+        return 1 if failed else 0
+
+    for f in report:
+        print(f.text())
+    if baseline is not None:
+        if result.grown:
+            for rule, (cur, base) in sorted(result.grown.items()):
+                print(f"FAIL: rule {rule} fired {cur}x but the "
+                      f"baseline grandfathers only {base} — fix the "
+                      "regression, do not grow the baseline")
+        if result.stale:
+            print(f"note: {len(result.stale)} baseline entries no "
+                  "longer fire (debt paid down?) — regenerate with "
+                  "--write-baseline to tighten the gate:")
+            for key in result.stale:
+                print(f"  - {key}")
+    verdict = "FAIL" if failed else "OK"
+    print(f"{verdict}: {len(report)} new finding(s), "
+          f"{baselined} baselined, "
+          f"{len(findings)} total, {elapsed:.1f}s")
+    return 1 if failed else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,305 @@
+"""dl4j-lint core: file model, rule registry, suppressions, baseline.
+
+The analyzer is deliberately boring: parse every Python file once
+(:class:`FileContext` caches the AST), hand each file to every
+registered rule's :meth:`Rule.check_file`, then hand the whole repo to
+each rule's :meth:`Rule.check_repo` (the registry rules need the
+global view — every metric registration vs one README).  A
+:class:`Finding` carries a *stable key* (no line numbers — lines
+drift) so the checked-in baseline survives unrelated edits.
+
+Suppression layers, innermost first:
+
+- ``# dl4j-lint: disable=<rule>[,<rule>...]`` on the flagged line or
+  the line directly above silences that site (``all`` matches every
+  rule) — for deliberate idioms, with the justification in the
+  surrounding comment;
+- ``# dl4j-lint: disable-file=<rule>[,...]`` anywhere in a file
+  silences the rule for the whole file (``disable-file=all`` drops
+  the file from repo-level scans too — what tests/test_lint.py uses
+  so its seeded-violation fixtures never leak into the repo gate);
+- the baseline JSON grandfathers known findings by key, each with a
+  reason string; the gate fails only on NEW keys, or when a rule's
+  total finding count grows past its baselined count.
+"""
+from __future__ import annotations
+
+import ast
+import json
+import pathlib
+import re
+from dataclasses import dataclass, field
+from typing import Callable, Dict, Iterable, List, Optional, Set
+
+#: top-level trees/files the runner walks (repo-relative)
+SCAN_BASES = ("deeplearning4j_tpu", "benchmarks", "scripts",
+              "examples", "tests")
+SCAN_FILES = ("bench.py",)
+#: never scanned: the analyzer itself (its sources talk ABOUT the
+#: patterns it hunts)
+EXCLUDE_DIRS = ("scripts/dl4j_lint",)
+
+_SUPPRESS_RE = re.compile(
+    r"#\s*dl4j-lint:\s*(disable|disable-file)=([a-z0-9_,\- ]+)")
+
+
+@dataclass(frozen=True)
+class Finding:
+    """One rule violation.  ``key`` is the stable identity used for
+    baseline matching — rule + path + a rule-chosen detail, never a
+    line number."""
+    rule: str
+    path: str            # repo-relative, posix separators
+    line: int            # 1-based; 0 = whole-file / repo-level
+    message: str
+    key: str
+
+    def text(self) -> str:
+        loc = f"{self.path}:{self.line}" if self.line else self.path
+        return f"{loc}: [{self.rule}] {self.message}"
+
+    def as_json(self) -> dict:
+        return {"rule": self.rule, "path": self.path,
+                "line": self.line, "message": self.message,
+                "key": self.key}
+
+
+class FileContext:
+    """One parsed source file: text, lines, AST (None when the file
+    does not parse — rules must tolerate that), and the suppression
+    index."""
+
+    def __init__(self, root: pathlib.Path, path: pathlib.Path):
+        self.root = root
+        self.path = path
+        self.rel = path.relative_to(root).as_posix()
+        self.text = path.read_text(encoding="utf-8", errors="replace")
+        self.lines = self.text.splitlines()
+        try:
+            self.tree: Optional[ast.AST] = ast.parse(self.text)
+        except SyntaxError:
+            self.tree = None
+        self._line_disable: Dict[int, Set[str]] = {}
+        self.file_disable: Set[str] = set()
+        for i, line in enumerate(self.lines, start=1):
+            m = _SUPPRESS_RE.search(line)
+            if not m:
+                continue
+            rules = {r.strip() for r in m.group(2).split(",")
+                     if r.strip()}
+            if m.group(1) == "disable-file":
+                self.file_disable |= rules
+            else:
+                self._line_disable.setdefault(i, set()).update(rules)
+
+    def suppressed(self, rule: str, line: int) -> bool:
+        if self.file_disable & {rule, "all"}:
+            return True
+        for at in (line, line - 1):
+            if self._line_disable.get(at, set()) & {rule, "all"}:
+                return True
+        return False
+
+
+class RepoContext:
+    """The whole scanned tree, parsed once and shared by every rule."""
+
+    def __init__(self, root: pathlib.Path,
+                 files: List[FileContext]):
+        self.root = root
+        self.files = files
+        self._by_rel = {f.rel: f for f in files}
+
+    def file(self, rel: str) -> Optional[FileContext]:
+        return self._by_rel.get(rel)
+
+    def readme(self) -> str:
+        p = self.root / "README.md"
+        return p.read_text() if p.exists() else ""
+
+
+class Rule:
+    """Base rule.  Subclasses set ``name``/``description`` and
+    override :meth:`check_file` (per-file AST walks) and/or
+    :meth:`check_repo` (global registry diffs, run once after every
+    file is parsed)."""
+
+    name = ""
+    description = ""
+
+    def wants(self, rel: str) -> bool:
+        """Which files :meth:`check_file` runs on (repo-relative
+        posix path)."""
+        return True
+
+    def check_file(self, ctx: FileContext) -> Iterable[Finding]:
+        return ()
+
+    def check_repo(self, repo: RepoContext) -> Iterable[Finding]:
+        return ()
+
+
+_REGISTRY: Dict[str, Rule] = {}
+
+
+def register(rule) -> Rule:
+    """Class decorator (or instance call) adding a rule to the
+    registry."""
+    inst = rule() if isinstance(rule, type) else rule
+    assert inst.name and inst.name not in _REGISTRY, inst.name
+    _REGISTRY[inst.name] = inst
+    return rule
+
+
+def all_rules() -> Dict[str, Rule]:
+    return dict(_REGISTRY)
+
+
+def iter_source_files(root: pathlib.Path) -> List[pathlib.Path]:
+    out: List[pathlib.Path] = []
+    for base in SCAN_BASES:
+        d = root / base
+        if not d.is_dir():
+            continue
+        for p in sorted(d.rglob("*.py")):
+            rel = p.relative_to(root).as_posix()
+            if any(rel == e or rel.startswith(e + "/")
+                   for e in EXCLUDE_DIRS):
+                continue
+            out.append(p)
+    for name in SCAN_FILES:
+        p = root / name
+        if p.is_file():
+            out.append(p)
+    return out
+
+
+def build_repo_context(root: pathlib.Path,
+                       files: Optional[Iterable[pathlib.Path]] = None,
+                       ) -> RepoContext:
+    """Parse the scan tree (or an explicit file list) into a
+    :class:`RepoContext`.  disable-file=all drops the file from EVERY
+    scan, including the repo-level regex rules."""
+    root = pathlib.Path(root).resolve()
+    paths = list(files) if files is not None \
+        else iter_source_files(root)
+    ctxs = [FileContext(root, pathlib.Path(p).resolve())
+            for p in paths]
+    return RepoContext(root, [c for c in ctxs
+                              if "all" not in c.file_disable])
+
+
+def lint_repo(root: pathlib.Path,
+              rule_names: Optional[Iterable[str]] = None,
+              files: Optional[Iterable[pathlib.Path]] = None,
+              ) -> List[Finding]:
+    """Run the selected rules over the tree; returns unsuppressed
+    findings sorted by (path, line, rule).  ``files`` overrides the
+    default walk (what the CLI's positional paths and the fixture
+    tests use)."""
+    root = pathlib.Path(root).resolve()
+    rules = [_REGISTRY[n] for n in (rule_names or sorted(_REGISTRY))]
+    repo = build_repo_context(root, files)
+    findings: List[Finding] = []
+    for rule in rules:
+        for ctx in repo.files:
+            if rule.name in ctx.file_disable or not rule.wants(ctx.rel):
+                continue
+            for f in rule.check_file(ctx):
+                if not ctx.suppressed(f.rule, f.line):
+                    findings.append(f)
+        for f in rule.check_repo(repo):
+            ctx = repo.file(f.path)
+            if ctx is not None and ctx.suppressed(f.rule, f.line):
+                continue
+            findings.append(f)
+    findings.sort(key=lambda f: (f.path, f.line, f.rule, f.key))
+    return findings
+
+
+# ----------------------------------------------------------------------
+# baseline: grandfathered debt, keyed stably, every entry justified
+@dataclass
+class Baseline:
+    reasons: Dict[str, str] = field(default_factory=dict)
+
+    @property
+    def keys(self) -> Set[str]:
+        return set(self.reasons)
+
+    def rule_counts(self) -> Dict[str, int]:
+        counts: Dict[str, int] = {}
+        for key in self.reasons:
+            rule = key.split(":", 1)[0]
+            counts[rule] = counts.get(rule, 0) + 1
+        return counts
+
+
+def load_baseline(path: pathlib.Path) -> Baseline:
+    data = json.loads(pathlib.Path(path).read_text())
+    reasons: Dict[str, str] = {}
+    for entry in data.get("findings", ()):
+        key, reason = entry["key"], entry.get("reason", "")
+        if not reason:
+            raise ValueError(
+                f"baseline entry {key!r} has no reason string — "
+                "every grandfathered finding must be justified")
+        if key in reasons:
+            raise ValueError(f"duplicate baseline key {key!r}")
+        reasons[key] = reason
+    return Baseline(reasons)
+
+
+def write_baseline(path: pathlib.Path, findings: List[Finding],
+                   old: Optional[Baseline] = None) -> None:
+    """Regenerate the baseline from the current findings, keeping the
+    reason strings of keys that persist; new keys get a TODO reason a
+    human must replace before committing."""
+    old_reasons = old.reasons if old else {}
+    entries = [{"key": f.key,
+                "reason": old_reasons.get(
+                    f.key, "TODO: justify this entry or fix the "
+                           "finding"),
+                "message": f.message,
+                "path": f.path}
+               for f in findings]
+    doc = {
+        "_comment": ("dl4j-lint grandfathered findings. The CI gate "
+                     "fails on any finding whose key is not here, and "
+                     "when a rule's finding count grows past its "
+                     "count here. Regenerate with: python -m "
+                     "scripts.dl4j_lint --write-baseline "
+                     "scripts/dl4j_lint_baseline.json "
+                     "(then justify every TODO reason)."),
+        "findings": entries,
+    }
+    pathlib.Path(path).write_text(json.dumps(doc, indent=2,
+                                             sort_keys=False) + "\n")
+
+
+@dataclass
+class GateResult:
+    new: List[Finding]
+    grown: Dict[str, tuple]      # rule -> (current, baselined)
+    stale: List[str]             # baseline keys that no longer fire
+    findings: List[Finding]
+
+    @property
+    def failed(self) -> bool:
+        return bool(self.new or self.grown)
+
+
+def gate(findings: List[Finding], baseline: Baseline) -> GateResult:
+    new = [f for f in findings if f.key not in baseline.keys]
+    current_counts: Dict[str, int] = {}
+    for f in findings:
+        current_counts[f.rule] = current_counts.get(f.rule, 0) + 1
+    base_counts = baseline.rule_counts()
+    grown = {rule: (n, base_counts.get(rule, 0))
+             for rule, n in current_counts.items()
+             if n > base_counts.get(rule, 0)
+             and not any(f.rule == rule for f in new)}
+    fired = {f.key for f in findings}
+    stale = sorted(baseline.keys - fired)
+    return GateResult(new=new, grown=grown, stale=stale,
+                      findings=findings)

@@ -1,0 +1,105 @@
+"""env-registry: every ``DL4J_TPU_*`` read must be documented twice.
+
+``common/environment.py`` is the promised single place to learn the
+knob surface, and the README env table is the operator-facing copy —
+but ~50 reads live outside ``environment.py`` and nothing kept either
+registry honest.  This rule diffs three sets:
+
+- **reads**: every ``DL4J_TPU_<NAME>`` literal in the scanned tree
+  (package, benchmarks, scripts, examples, tests, bench.py) outside
+  the ``environment.py`` module docstring;
+- **environment.py docs**: names in the ``common/environment.py``
+  module docstring;
+- **README docs**: names in ``README.md`` rows of the form
+  ``| `DL4J_TPU_X` | ... |`` (the "## Environment variables" table).
+
+Findings: a read missing from either registry, and a stale entry in
+either registry that no code reads.  Fix by documenting (or deleting)
+the variable — do not baseline doc drift.
+"""
+from __future__ import annotations
+
+import ast
+import re
+from typing import Dict, Iterable, Tuple
+
+from scripts.dl4j_lint.core import (Finding, RepoContext, Rule,
+                                    register)
+
+_VAR_RE = re.compile(r"DL4J_TPU_[A-Z0-9]+(?:_[A-Z0-9]+)*")
+_ROW_RE = re.compile(r"^\|\s*`(DL4J_TPU_[A-Z0-9_]+)`\s*\|", re.M)
+
+ENV_MODULE = "deeplearning4j_tpu/common/environment.py"
+
+
+@register
+class EnvRegistryRule(Rule):
+    name = "env-registry"
+    description = ("every DL4J_TPU_* read must be documented in "
+                   "common/environment.py and the README env table")
+
+    def check_repo(self, repo: RepoContext) -> Iterable[Finding]:
+        reads: Dict[str, Tuple[str, int]] = {}   # first read site
+        env_docs: set = set()
+        for ctx in repo.files:
+            text = ctx.text
+            if ctx.rel == ENV_MODULE and ctx.tree is not None:
+                # documentation = every docstring in the module (the
+                # knob catalog lives in the Environment CLASS
+                # docstring); reads = matches outside docstrings
+                doc_spans = []
+                for node in ast.walk(ctx.tree):
+                    if isinstance(node, (ast.Module, ast.ClassDef,
+                                         ast.FunctionDef,
+                                         ast.AsyncFunctionDef)):
+                        doc = ast.get_docstring(node)
+                        body = getattr(node, "body", [])
+                        if doc and body:
+                            first = body[0].value
+                            doc_spans.append((first.lineno,
+                                              first.end_lineno))
+                            env_docs |= set(_VAR_RE.findall(doc))
+
+                def in_doc(line: int) -> bool:
+                    return any(a <= line <= b for a, b in doc_spans)
+
+                matches = ((name, line) for name, line in
+                           ((m.group(0),
+                             text[:m.start()].count("\n") + 1)
+                            for m in _VAR_RE.finditer(text))
+                           if not in_doc(line))
+            else:
+                matches = ((m.group(0),
+                            text[:m.start()].count("\n") + 1)
+                           for m in _VAR_RE.finditer(text))
+            for name, line in matches:
+                reads.setdefault(name, (ctx.rel, line))
+        readme = repo.readme()
+        readme_docs = set(_ROW_RE.findall(readme))
+        for name in sorted(set(reads) - env_docs):
+            rel, line = reads[name]
+            yield Finding(
+                rule=self.name, path=rel, line=line,
+                message=(f"`{name}` is read here but not documented "
+                         f"in {ENV_MODULE}'s module docstring"),
+                key=f"{self.name}:env-doc:{name}")
+        for name in sorted(set(reads) - readme_docs):
+            rel, line = reads[name]
+            yield Finding(
+                rule=self.name, path=rel, line=line,
+                message=(f"`{name}` is read here but has no row in "
+                         "the README '## Environment variables' "
+                         "table"),
+                key=f"{self.name}:readme:{name}")
+        for name in sorted(readme_docs - set(reads)):
+            yield Finding(
+                rule=self.name, path="README.md", line=0,
+                message=(f"README env table documents `{name}` but "
+                         "no code reads it (stale row)"),
+                key=f"{self.name}:stale-readme:{name}")
+        for name in sorted(env_docs - set(reads)):
+            yield Finding(
+                rule=self.name, path=ENV_MODULE, line=0,
+                message=(f"{ENV_MODULE} documents `{name}` but no "
+                         "code reads it (stale docstring entry)"),
+                key=f"{self.name}:stale-env-doc:{name}")

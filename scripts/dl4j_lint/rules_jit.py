@@ -1,0 +1,244 @@
+"""jit-purity: no trace-time impurity reachable from jit roots.
+
+``jax.jit`` runs the Python function ONCE at trace time and bakes the
+result into the compiled program — a ``time.time()`` call, an env-var
+read, a ``np.random`` draw, a telemetry increment, or a ``print``
+inside a jitted function executes at trace time only and is frozen (or
+silent) for every subsequent step.  PR 13 had to document exactly this
+("jit freezes trace-time decisions"); this rule makes it structural.
+
+Roots: functions decorated with / passed to ``jax.jit`` / ``pjit`` /
+``jax.custom_vjp`` / ``jax.custom_jvp`` (including
+``functools.partial(jax.jit, ...)`` decorators and ``f.defvjp(fwd,
+bwd)`` registrations).  From each root the rule follows same-module
+calls by name (bounded depth) and flags, anywhere reachable:
+
+- ``time.*`` calls (``time``/``perf_counter``/``monotonic``/...)
+- ``np.random.*`` / ``numpy.random.*`` (trace-frozen randomness — use
+  ``jax.random`` with a threaded key)
+- ``os.environ`` / ``os.getenv`` / ``Environment.get`` reads
+- ``telemetry.*`` instrument calls (counters silently stop counting
+  under jit — instrument the dispatch site instead)
+- ``print`` calls
+- ``global`` / ``nonlocal`` declarations (mutating enclosing state
+  from traced code runs once, not per step)
+
+Suppress deliberate trace-time gates at the site with
+``# dl4j-lint: disable=jit-purity`` and a comment saying WHY the
+frozen decision is intended.
+"""
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterable, List, Optional, Set, Tuple
+
+from scripts.dl4j_lint.core import (FileContext, Finding, Rule,
+                                    register)
+
+_JIT_NAMES = {"jit", "pjit", "custom_vjp", "custom_jvp"}
+_TIME_FNS = {"time", "perf_counter", "monotonic", "process_time",
+             "thread_time", "sleep", "time_ns", "perf_counter_ns",
+             "monotonic_ns"}
+_MAX_DEPTH = 8
+
+
+def _dotted(node: ast.AST) -> Optional[str]:
+    """'a.b.c' for a Name/Attribute chain, else None."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def _is_jit_callable(node: ast.AST) -> bool:
+    """Is this expression jax.jit / pjit / custom_vjp (possibly via
+    functools.partial(jax.jit, ...))?"""
+    d = _dotted(node)
+    if d is not None:
+        leaf = d.rsplit(".", 1)[-1]
+        if leaf in _JIT_NAMES:
+            return True
+    if isinstance(node, ast.Call):
+        d = _dotted(node.func)
+        if d and d.rsplit(".", 1)[-1] == "partial" and node.args:
+            return _is_jit_callable(node.args[0])
+        # jax.jit(f, static_argnums=...) used as a decorator factory
+        return _is_jit_callable(node.func)
+    return False
+
+
+class _Scope:
+    """Lexical function-name resolution: module scope plus one nested
+    namespace per function (jit bodies are usually local closures
+    inside ``build_train_step``-style factories)."""
+
+    def __init__(self, tree: ast.AST):
+        self.defs: Dict[ast.AST, Dict[str, ast.AST]] = {}
+        self.parent: Dict[ast.AST, Optional[ast.AST]] = {tree: None}
+        self._index(tree, tree)
+
+    def _index(self, node: ast.AST, owner: ast.AST) -> None:
+        table = self.defs.setdefault(owner, {})
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef,
+                                  ast.AsyncFunctionDef)):
+                table[child.name] = child
+                self.parent[child] = owner
+                self._index(child, child)
+            elif isinstance(child, (ast.ClassDef,)):
+                # methods resolve within their class only; good enough
+                self.parent[child] = owner
+                self._index(child, child)
+            else:
+                self._index(child, owner)
+
+    def resolve(self, owner: ast.AST, name: str) -> Optional[ast.AST]:
+        node: Optional[ast.AST] = owner
+        while node is not None:
+            target = self.defs.get(node, {}).get(name)
+            if target is not None:
+                return target
+            node = self.parent.get(node)
+        return None
+
+
+def _impurities(fn: ast.AST) -> Iterable[Tuple[int, str]]:
+    """(line, what) for each impure construct directly in ``fn``'s
+    body (nested defs excluded — they are reached via call edges)."""
+    for node in _own_nodes(fn):
+        if isinstance(node, (ast.Global, ast.Nonlocal)):
+            kw = "global" if isinstance(node, ast.Global) else \
+                "nonlocal"
+            yield node.lineno, (f"`{kw} {', '.join(node.names)}` — "
+                                "mutating enclosing state under jit "
+                                "runs at trace time only")
+            continue
+        if not isinstance(node, ast.Call):
+            continue
+        d = _dotted(node.func)
+        if d is None:
+            continue
+        if d == "print":
+            yield node.lineno, ("`print(...)` executes at trace time "
+                                "only (use jax.debug.print)")
+        elif d.startswith("time.") and \
+                d.split(".", 1)[1] in _TIME_FNS:
+            yield node.lineno, (f"`{d}(...)` is frozen at trace time "
+                                "(time the dispatch site instead)")
+        elif d.startswith(("np.random.", "numpy.random.")):
+            yield node.lineno, (f"`{d}(...)` draws trace-frozen "
+                                "randomness (thread a jax.random key)")
+        elif d in ("os.getenv", "os.environ.get",
+                   "Environment.get"):
+            yield node.lineno, (f"`{d}(...)` reads the environment at "
+                                "trace time — the decision is frozen "
+                                "into the compiled program")
+        elif d.startswith("telemetry."):
+            yield node.lineno, (f"`{d}(...)` instruments trace time, "
+                                "not execution — counters go silent "
+                                "under jit")
+
+
+def _own_nodes(fn: ast.AST) -> Iterable[ast.AST]:
+    """Walk ``fn`` without descending into nested function defs."""
+    stack = list(ast.iter_child_nodes(fn))
+    while stack:
+        node = stack.pop()
+        yield node
+        if not isinstance(node, (ast.FunctionDef,
+                                 ast.AsyncFunctionDef, ast.Lambda)):
+            stack.extend(ast.iter_child_nodes(node))
+
+
+def _callees(fn: ast.AST) -> Set[str]:
+    out: Set[str] = set()
+    for node in _own_nodes(fn):
+        if isinstance(node, ast.Call) and \
+                isinstance(node.func, ast.Name):
+            out.add(node.func.id)
+    return out
+
+
+@register
+class JitPurityRule(Rule):
+    name = "jit-purity"
+    description = ("functions reachable from jax.jit/pjit/custom_vjp "
+                   "roots must not read clocks, env, np.random, "
+                   "telemetry, print, or mutate nonlocal state")
+
+    def wants(self, rel: str) -> bool:
+        return rel.startswith("deeplearning4j_tpu/")
+
+    def check_file(self, ctx: FileContext) -> Iterable[Finding]:
+        if ctx.tree is None:
+            return
+        scope = _Scope(ctx.tree)
+        roots = self._roots(ctx.tree, scope)
+        seen: Set[ast.AST] = set()
+        for root_name, fn in roots:
+            yield from self._scan(ctx, scope, fn, root_name, seen,
+                                  depth=0)
+
+    # -- root discovery ------------------------------------------------
+    def _roots(self, tree: ast.AST, scope: _Scope
+               ) -> List[Tuple[str, ast.AST]]:
+        roots: List[Tuple[str, ast.AST]] = []
+
+        def add(owner: ast.AST, expr: ast.AST) -> None:
+            if isinstance(expr, ast.Lambda):
+                roots.append(("<lambda>", expr))
+            elif isinstance(expr, ast.Name):
+                target = scope.resolve(owner, expr.id)
+                if target is not None:
+                    roots.append((expr.id, target))
+
+        for owner, table in list(scope.defs.items()):
+            for fn in table.values():
+                for deco in getattr(fn, "decorator_list", ()):
+                    if _is_jit_callable(deco):
+                        roots.append((fn.name, fn))
+        # call sites: jax.jit(f, ...), pjit(f), custom_vjp(f),
+        # f.defvjp(fwd, bwd)
+        for owner in scope.defs:
+            for node in _own_nodes(owner):
+                if not isinstance(node, ast.Call):
+                    continue
+                if _is_jit_callable(node.func) and node.args:
+                    add(owner, node.args[0])
+                d = _dotted(node.func)
+                if d and d.endswith(".defvjp"):
+                    for arg in node.args:
+                        add(owner, arg)
+        # dedupe by node identity, keep first name
+        seen: Set[int] = set()
+        out = []
+        for name, fn in roots:
+            if id(fn) not in seen:
+                seen.add(id(fn))
+                out.append((name, fn))
+        return out
+
+    # -- reachability scan ---------------------------------------------
+    def _scan(self, ctx: FileContext, scope: _Scope, fn: ast.AST,
+              root: str, seen: Set[ast.AST], depth: int
+              ) -> Iterable[Finding]:
+        if id(fn) in seen or depth > _MAX_DEPTH:
+            return
+        seen.add(id(fn))  # type: ignore[arg-type]
+        fn_name = getattr(fn, "name", "<lambda>")
+        via = root if fn_name == root else f"{root} -> {fn_name}"
+        for line, what in _impurities(fn):
+            token = what.split("`")[1].split("(")[0]
+            yield Finding(
+                rule=self.name, path=ctx.rel, line=line,
+                message=f"jit root `{via}`: {what}",
+                key=f"{self.name}:{ctx.rel}:{via}:{token}")
+        for callee in sorted(_callees(fn)):
+            target = scope.resolve(fn, callee)
+            if target is not None:
+                yield from self._scan(ctx, scope, target, root, seen,
+                                      depth + 1)

@@ -1,0 +1,311 @@
+"""lock-discipline: shared attributes of thread-starting classes must
+mutate under the class lock.
+
+The threaded modules (serving, telemetry, the observatory sidecars)
+follow one convention: a class that starts a ``threading.Thread``
+targeting one of its own methods owns a ``self._lock``, and every
+attribute the thread side shares with the public surface mutates under
+it.  The PR-11 ``ParallelInference.shutdown`` race and the PR-17
+``_drain_rate`` cold-window bug were both violations of exactly this,
+found late, by review.  This rule finds them structurally.
+
+Per class the rule computes:
+
+- *thread entries*: methods (or closures inside methods) passed as
+  ``target=`` to ``threading.Thread`` / ``threading.Timer`` created
+  anywhere in the class;
+- the intra-class call graph over ``self.method()`` edges, giving the
+  set of *thread-reachable* methods;
+- per-attribute mutation sites (``self.x = / += ...``, ``self.x[k]
+  =``, and mutating method calls like ``self.x.append(...)``) and
+  access sites.
+
+An attribute is **shared** when it is (a) mutated in thread-reachable
+code and touched anywhere else, or (b) mutated from two or more
+distinct methods.  Every mutation site of a shared attribute outside
+``__init__`` must sit lexically inside ``with self.<lock>:`` (any
+attribute whose name contains ``lock``, ``cv`` or ``cond``).
+Attributes only ever assigned boolean constants are exempt (CPython
+guarantees a torn bool read cannot happen, and the codebase uses bare
+bool flags as cheap latches); so are ``_reset_for_tests`` helpers.
+
+The caller-holds-the-lock idiom (``_ensure_worker`` called under the
+submit lock) is deliberate — suppress those sites with
+``# dl4j-lint: disable=lock-discipline`` and say so in the comment.
+"""
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterable, List, Optional, Set, Tuple
+
+from scripts.dl4j_lint.core import (FileContext, Finding, Rule,
+                                    register)
+
+#: run over the modules that actually start threads (ISSUE 19 list)
+_SCOPE_PREFIXES = ("deeplearning4j_tpu/serving/",)
+_SCOPE_FILES = {
+    "deeplearning4j_tpu/parallel/inference.py",
+    "deeplearning4j_tpu/common/telemetry.py",
+    "deeplearning4j_tpu/common/stepstats.py",
+    "deeplearning4j_tpu/common/faults.py",
+    "deeplearning4j_tpu/common/tracectx.py",
+    "deeplearning4j_tpu/common/httputil.py",
+    "deeplearning4j_tpu/common/compilecache.py",
+    "deeplearning4j_tpu/common/diagnostics.py",
+    "deeplearning4j_tpu/ui/server.py",
+}
+
+_MUTATOR_METHODS = {"append", "appendleft", "extend", "insert", "add",
+                    "remove", "discard", "pop", "popleft", "clear",
+                    "update", "setdefault", "popitem"}
+
+#: constructors whose instances synchronize internally — calling
+#: .set()/.clear()/.put()/.get() on these is not a lock violation
+_THREADSAFE_TYPES = {"Event", "Condition", "Semaphore",
+                     "BoundedSemaphore", "Barrier", "Queue",
+                     "SimpleQueue", "LifoQueue", "PriorityQueue",
+                     "Lock", "RLock"}
+_LOCKISH = ("lock", "cv", "cond")
+_CONTAINER_CTORS = {"dict", "list", "set", "deque", "defaultdict",
+                    "OrderedDict", "Counter"}
+_EXEMPT_METHODS = {"__init__", "_reset_for_tests"}
+
+
+def _is_lockish(expr: ast.AST) -> bool:
+    """``self._lock`` / ``cls._instance_lock`` / ``Foo._cls_lock`` —
+    any attribute whose terminal name smells like a lock."""
+    if isinstance(expr, ast.Call):     # e.g. self._lock.acquire() no,
+        return False                   # with takes the lock object
+    if isinstance(expr, ast.Attribute):
+        name = expr.attr.lower()
+        return any(t in name for t in _LOCKISH)
+    if isinstance(expr, ast.Name):
+        name = expr.id.lower()
+        return any(t in name for t in _LOCKISH)
+    return False
+
+
+class _MethodInfo:
+    def __init__(self, node: ast.AST):
+        self.node = node
+        self.name = node.name
+        self.calls: Set[str] = set()          # self.<m>() edges
+        #: attr -> [(line, guarded, is_bool_const, via_mutator_call)]
+        self.mutations: Dict[str, List[Tuple[int, bool, bool,
+                                             bool]]] = {}
+        #: attrs assigned plain containers ({} / [] / set() / deque())
+        #: — the only ones where .append()/.update() count as
+        #: mutations (on a domain object they are ordinary methods)
+        self.containers: Set[str] = set()
+        self.accesses: Set[str] = set()       # any self.<attr> touch
+        #: attrs assigned from internally-synchronized constructors
+        self.threadsafe: Set[str] = set()
+        #: thread targets started here: method names / local closures
+        self.thread_targets: List[object] = []
+
+
+def _self_attr(node: ast.AST) -> Optional[str]:
+    if isinstance(node, ast.Attribute) and \
+            isinstance(node.value, ast.Name) and \
+            node.value.id in ("self", "cls"):
+        return node.attr
+    return None
+
+
+def _analyze_method(m: ast.AST) -> _MethodInfo:
+    info = _MethodInfo(m)
+
+    def walk(node: ast.AST, guarded: bool) -> None:
+        if isinstance(node, ast.With):
+            g = guarded or any(_is_lockish(item.context_expr)
+                               for item in node.items)
+            for item in node.items:
+                walk(item.context_expr, guarded)
+            for child in node.body:
+                walk(child, g)
+            return
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.Lambda)):
+            # a closure runs later (often on the thread); analyze its
+            # body unguarded unless the with-block wraps the *call*,
+            # which we cannot see — treat as same guard state
+            for child in ast.iter_child_nodes(node):
+                walk(child, guarded)
+            return
+        if isinstance(node, (ast.Assign, ast.AugAssign, ast.AnnAssign)):
+            targets = node.targets if isinstance(node, ast.Assign) \
+                else [node.target]
+            is_bool = isinstance(getattr(node, "value", None),
+                                 ast.Constant) and \
+                isinstance(node.value.value, bool)
+            if isinstance(node, ast.AugAssign):
+                is_bool = False
+            val = getattr(node, "value", None)
+            ctor = (val.func.attr if isinstance(val.func,
+                                                ast.Attribute)
+                    else getattr(val.func, "id", "")) \
+                if isinstance(val, ast.Call) else ""
+            safe_ctor = ctor in _THREADSAFE_TYPES
+            container = ctor in _CONTAINER_CTORS or isinstance(
+                val, (ast.Dict, ast.List, ast.Set, ast.ListComp,
+                      ast.DictComp, ast.SetComp))
+            for t in targets:
+                base = t
+                sub = False
+                while isinstance(base, ast.Subscript):
+                    base = base.value
+                    sub = True
+                attr = _self_attr(base)
+                if attr is not None:
+                    info.mutations.setdefault(attr, []).append(
+                        (node.lineno, guarded,
+                         is_bool and not sub, False))
+                    info.accesses.add(attr)
+                    if safe_ctor and not sub:
+                        info.threadsafe.add(attr)
+                    if container and not sub:
+                        info.containers.add(attr)
+        if isinstance(node, ast.Call):
+            # self.<attr>.append(...) style container mutation
+            if isinstance(node.func, ast.Attribute) and \
+                    node.func.attr in _MUTATOR_METHODS:
+                attr = _self_attr(node.func.value)
+                if attr is not None:
+                    info.mutations.setdefault(attr, []).append(
+                        (node.lineno, guarded, False, True))
+            # self.<m>(...) intra-class call edge
+            attr = _self_attr(node.func)
+            if attr is not None:
+                info.calls.add(attr)
+            # threading.Thread(target=self.m) / threading.Timer(s, f)
+            fname = node.func.attr if isinstance(node.func,
+                                                 ast.Attribute) \
+                else getattr(node.func, "id", "")
+            if fname in ("Thread", "Timer"):
+                cands = [kw.value for kw in node.keywords
+                         if kw.arg == "target"]
+                if fname == "Timer" and len(node.args) >= 2:
+                    cands.append(node.args[1])
+                for c in cands:
+                    t = _self_attr(c)
+                    if t is not None:
+                        info.thread_targets.append(t)
+                    elif isinstance(c, ast.Name):
+                        info.thread_targets.append(("local", c.id))
+        attr = _self_attr(node)
+        if attr is not None:
+            info.accesses.add(attr)
+        for child in ast.iter_child_nodes(node):
+            walk(child, guarded)
+
+    for stmt in m.body:
+        walk(stmt, guarded=False)
+    return info
+
+
+@register
+class LockDisciplineRule(Rule):
+    name = "lock-discipline"
+    description = ("thread-starting classes must mutate shared "
+                   "attributes under the class lock")
+
+    def wants(self, rel: str) -> bool:
+        return rel in _SCOPE_FILES or \
+            any(rel.startswith(p) for p in _SCOPE_PREFIXES)
+
+    def check_file(self, ctx: FileContext) -> Iterable[Finding]:
+        if ctx.tree is None:
+            return
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, ast.ClassDef):
+                yield from self._check_class(ctx, node)
+
+    def _check_class(self, ctx: FileContext, cls: ast.ClassDef
+                     ) -> Iterable[Finding]:
+        methods: Dict[str, _MethodInfo] = {}
+        for node in cls.body:
+            if isinstance(node, (ast.FunctionDef,
+                                 ast.AsyncFunctionDef)):
+                methods[node.name] = _analyze_method(node)
+        # thread entries: targeted methods, plus any method that
+        # starts a thread on a local closure (the closure's effects
+        # were folded into that method's own info)
+        entries: Set[str] = set()
+        for name, info in methods.items():
+            for t in info.thread_targets:
+                if isinstance(t, str) and t in methods:
+                    entries.add(t)
+                elif isinstance(t, tuple):
+                    entries.add(name)   # closure body lives in `name`
+        if not entries:
+            return
+        # thread-reachable closure over self.<m>() edges
+        reach: Set[str] = set()
+        work = list(entries)
+        while work:
+            m = work.pop()
+            if m in reach or m not in methods:
+                continue
+            reach.add(m)
+            work.extend(methods[m].calls)
+        # per-attribute aggregation
+        mut_methods: Dict[str, Set[str]] = {}
+        touching: Dict[str, Set[str]] = {}
+        bool_only: Dict[str, bool] = {}
+        threadsafe: Set[str] = set()
+        containers: Set[str] = set()
+        for info in methods.values():
+            threadsafe |= info.threadsafe
+            containers |= info.containers
+        for name, info in methods.items():
+            for attr, sites in info.mutations.items():
+                real = [s for s in sites
+                        if not s[3] or attr in containers]
+                if not real:
+                    continue
+                if name not in _EXEMPT_METHODS:
+                    mut_methods.setdefault(attr, set()).add(name)
+                for _, _, is_bool, _ in real:
+                    bool_only[attr] = bool_only.get(attr, True) \
+                        and is_bool
+            if name in _EXEMPT_METHODS:
+                continue
+            for attr in info.accesses:
+                touching.setdefault(attr, set()).add(name)
+        # shared = something actually crosses the thread boundary (or
+        # two public methods race each other): mutated on the thread
+        # side with readers outside it, mutated outside with thread
+        # readers, or mutated from >= 2 methods not all on the thread
+        # side.  Attributes that are bool-constant latches or
+        # internally-synchronized objects are exempt.
+        shared: Set[str] = set()
+        for attr, in_methods in mut_methods.items():
+            if bool_only.get(attr, False) or attr in threadsafe:
+                continue
+            mut_t = bool(in_methods & reach)
+            mut_o = bool(in_methods - reach)
+            acc_outside = bool(touching.get(attr, set()) - reach)
+            if (mut_o or acc_outside) and \
+                    (mut_t or len(in_methods) >= 2):
+                shared.add(attr)
+        for name, info in sorted(methods.items()):
+            if name in _EXEMPT_METHODS:
+                continue
+            for attr in sorted(set(info.mutations) & shared):
+                for line, guarded, _, via_call in \
+                        info.mutations[attr]:
+                    if guarded or (via_call
+                                   and attr not in containers):
+                        continue
+                    side = "thread-reachable" if name in reach \
+                        else "public-surface"
+                    yield Finding(
+                        rule=self.name, path=ctx.rel, line=line,
+                        message=(
+                            f"`{cls.name}.{name}` mutates shared "
+                            f"attribute `self.{attr}` without holding "
+                            f"the class lock ({side} site; the class "
+                            f"starts threads targeting "
+                            f"{sorted(entries)})"),
+                        key=(f"{self.name}:{ctx.rel}:{cls.name}."
+                             f"{name}:{attr}"))

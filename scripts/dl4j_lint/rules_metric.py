@@ -1,0 +1,118 @@
+"""metric-registry: the README metrics catalog must match the code.
+
+The single source of the code<->README metric-scanning logic — the
+standalone ``scripts/check_telemetry_catalog.py`` (ci_check gate 1 and
+a tier-1 test) is a thin wrapper over the module-level functions here,
+and the dl4j-lint rule turns the same diffs into findings:
+
+- a ``counter("dl4j_...")`` / ``gauge`` / ``histogram`` registration
+  missing from the README catalog sections,
+- a catalog entry no code registers (stale docs mislead as much as
+  missing ones),
+- a catalog Type column disagreeing with the registration kind (a
+  counter documented as a gauge sends scrapers down the wrong
+  rate()/delta() path).
+"""
+from __future__ import annotations
+
+import re
+from typing import Dict, Iterable, Set, Tuple
+
+from scripts.dl4j_lint.core import (Finding, RepoContext, Rule,
+                                    register)
+
+#: metric registrations: counter("name" / gauge("name" /
+#: histogram("name" — any receiver (telemetry module, a registry, or
+#: the module-level helpers called bare inside telemetry.py)
+_REG_RE = re.compile(
+    r"\b(counter|gauge|histogram)\(\s*\n?\s*['\"](dl4j_[a-z0-9_]+)")
+
+#: names prefixed dl4j_ anywhere in the README catalog section
+_DOC_RE = re.compile(r"`(dl4j_[a-z0-9_]+)`")
+
+#: catalog table rows: | `name` | kind | ...
+_DOC_ROW_RE = re.compile(
+    r"^\|\s*`(dl4j_[a-z0-9_]+)`\s*\|\s*(counter|gauge|histogram)\s*\|",
+    re.M)
+
+#: registrations that are deliberately NOT part of the public catalog
+_EXEMPT = {"dl4j_bench_counter_total", "dl4j_bench_hist_seconds"}
+
+#: README sections whose tables form the catalog
+_CATALOG_SECTIONS = ("Observability", "Diagnostics",
+                     "Scaling observatory", "Layer attribution",
+                     "Fault tolerance & elasticity")
+
+
+def registered_metrics(repo: RepoContext
+                       ) -> Dict[str, Tuple[Set[str], str, int]]:
+    """{name: ({kinds}, first-registration path, line)}."""
+    out: Dict[str, Tuple[Set[str], str, int]] = {}
+    for ctx in repo.files:
+        # tests register throwaway dl4j_t_* fixtures — not catalog
+        # material (same scan surface as the pre-lint checker)
+        if ctx.rel.startswith("tests/"):
+            continue
+        for m in _REG_RE.finditer(ctx.text):
+            kind, name = m.group(1), m.group(2)
+            if name in _EXEMPT:
+                continue
+            line = ctx.text[:m.start()].count("\n") + 1
+            if name in out:
+                out[name][0].add(kind)
+            else:
+                out[name] = ({kind}, ctx.rel, line)
+    return out
+
+
+def documented_metrics(readme_text: str) -> Dict[str, str]:
+    """{name: documented kind} from the catalog tables (names
+    mentioned outside table rows count as documented with kind '')."""
+    doc: Dict[str, str] = {}
+    for heading in _CATALOG_SECTIONS:
+        m = re.search(rf"## {re.escape(heading)}(.*?)(?:\n## |\Z)",
+                      readme_text, re.S)
+        if not m:
+            continue
+        section = m.group(1)
+        for name in _DOC_RE.findall(section):
+            doc.setdefault(name, "")
+        doc.update({name: kind
+                    for name, kind in _DOC_ROW_RE.findall(section)})
+    return doc
+
+
+@register
+class MetricRegistryRule(Rule):
+    name = "metric-registry"
+    description = ("every registered dl4j_* metric must appear in the "
+                   "README catalog with the right type, and the "
+                   "catalog must document nothing stale")
+
+    def check_repo(self, repo: RepoContext) -> Iterable[Finding]:
+        reg = registered_metrics(repo)
+        doc = documented_metrics(repo.readme())
+        for name in sorted(set(reg) - set(doc)):
+            kinds, rel, line = reg[name]
+            yield Finding(
+                rule=self.name, path=rel, line=line,
+                message=(f"metric `{name}` ({'/'.join(sorted(kinds))})"
+                         " is registered here but missing from the "
+                         "README catalog"),
+                key=f"{self.name}:missing:{name}")
+        for name in sorted(set(doc) - set(reg)):
+            yield Finding(
+                rule=self.name, path="README.md", line=0,
+                message=(f"README catalog documents `{name}` but no "
+                         "code registers it (stale entry)"),
+                key=f"{self.name}:stale:{name}")
+        for name in sorted(reg):
+            kinds, rel, line = reg[name]
+            documented = doc.get(name)
+            if documented and documented not in kinds:
+                yield Finding(
+                    rule=self.name, path=rel, line=line,
+                    message=(f"metric `{name}` registered as "
+                             f"{sorted(kinds)} but the README Type "
+                             f"column says {documented!r}"),
+                    key=f"{self.name}:kind:{name}")

@@ -1,0 +1,200 @@
+"""spec-invariants: sharding-spec and donation hygiene.
+
+Two invariants from the pipeline work (PR 18):
+
+- **no ``pipe`` in a PartitionSpec** — pipeline parallelism moves data
+  between stages with explicit ``ppermute`` on stage-local arrays;
+  putting the ``pipe`` axis in a GSPMD ``PartitionSpec`` re-introduces
+  the all-stages-resident layout the stage-partitioned SpecLayout
+  exists to avoid.  (The stage-STACKED flagship transformer shards its
+  leading stage dimension over ``pipe`` by design — that file carries
+  a file-level suppression explaining why.)
+- **donated buffers are dead after the call** — an argument listed in
+  ``donate_argnums`` is deallocated by the jitted call; referencing it
+  afterwards in the same scope either crashes ("buffer donated") or,
+  on backends that silently copy, un-donates the buffer and doubles
+  peak memory.  The rule tracks ``f = jax.jit(g, donate_argnums=...)``
+  bindings within a scope and flags loads of donated argument names
+  after the call site, unless rebound first (``params = step(params)``
+  is the idiom and stays clean).
+"""
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterable, List, Optional, Set, Tuple
+
+from scripts.dl4j_lint.core import (FileContext, Finding, Rule,
+                                    register)
+
+_SPEC_NAMES = {"P", "PartitionSpec"}
+_PIPE_AXES = {"pipe"}
+
+
+def _donated_positions(call: ast.Call) -> Optional[Tuple[int, ...]]:
+    """Literal donate_argnums of a jax.jit(...) call, else None."""
+    fn = call.func
+    name = fn.attr if isinstance(fn, ast.Attribute) else \
+        getattr(fn, "id", "")
+    if name not in ("jit", "pjit"):
+        return None
+    for kw in call.keywords:
+        if kw.arg != "donate_argnums":
+            continue
+        v = kw.value
+        if isinstance(v, ast.Constant) and isinstance(v.value, int):
+            return (v.value,)
+        if isinstance(v, (ast.Tuple, ast.List)):
+            out = []
+            for el in v.elts:
+                if isinstance(el, ast.Constant) and \
+                        isinstance(el.value, int):
+                    out.append(el.value)
+                else:
+                    return None
+            return tuple(out)
+        return None
+    return None
+
+
+@register
+class SpecInvariantsRule(Rule):
+    name = "spec-invariants"
+    description = ("PartitionSpec literals must not use the pipe "
+                   "axis; donated arguments must not be read after "
+                   "the jitted call")
+
+    def wants(self, rel: str) -> bool:
+        return rel.startswith("deeplearning4j_tpu/")
+
+    def check_file(self, ctx: FileContext) -> Iterable[Finding]:
+        if ctx.tree is None:
+            return
+        yield from self._check_pipe_specs(ctx)
+        yield from self._check_donation(ctx)
+
+    # -- pipe axis in PartitionSpec ------------------------------------
+    def _check_pipe_specs(self, ctx: FileContext
+                          ) -> Iterable[Finding]:
+        for node in ast.walk(ctx.tree):
+            if not (isinstance(node, ast.Call) and (
+                    (isinstance(node.func, ast.Name)
+                     and node.func.id in _SPEC_NAMES)
+                    or (isinstance(node.func, ast.Attribute)
+                        and node.func.attr == "PartitionSpec"))):
+                continue
+            axes: List[str] = []
+            for arg in node.args:
+                elts = arg.elts if isinstance(
+                    arg, (ast.Tuple, ast.List)) else [arg]
+                for el in elts:
+                    if isinstance(el, ast.Constant) and \
+                            isinstance(el.value, str):
+                        axes.append(el.value)
+            bad = sorted(set(axes) & _PIPE_AXES)
+            if bad:
+                yield Finding(
+                    rule=self.name, path=ctx.rel, line=node.lineno,
+                    message=(f"PartitionSpec uses the `{bad[0]}` axis "
+                             "— pipeline stages are stage-local "
+                             "arrays moved by ppermute, never a GSPMD "
+                             "sharding dimension"),
+                    key=(f"{self.name}:{ctx.rel}:pipe-spec:"
+                         f"L{node.lineno}"))
+
+    # -- use-after-donation --------------------------------------------
+    def _check_donation(self, ctx: FileContext) -> Iterable[Finding]:
+        for scope in ast.walk(ctx.tree):
+            if isinstance(scope, (ast.FunctionDef,
+                                  ast.AsyncFunctionDef, ast.Module)):
+                yield from self._scan_scope(ctx, scope)
+
+    def _scan_scope(self, ctx: FileContext, scope: ast.AST
+                    ) -> Iterable[Finding]:
+        #: var name -> donated positions, for jitted callables bound
+        #: in THIS scope
+        jitted: Dict[str, Tuple[int, ...]] = {}
+        #: donated var -> (call line, callee) awaiting rebind
+        dead: Dict[str, Tuple[int, str]] = {}
+
+        def stmts(node: ast.AST) -> Iterable[ast.stmt]:
+            for child in ast.iter_child_nodes(node):
+                if isinstance(child, (ast.FunctionDef,
+                                      ast.AsyncFunctionDef,
+                                      ast.Lambda, ast.ClassDef)):
+                    continue
+                if isinstance(child, ast.stmt):
+                    yield child
+                    yield from stmts(child)
+
+        def own(stmt: ast.stmt) -> Iterable[ast.AST]:
+            """The statement's own expressions — nested statements
+            (and defs) are excluded; they arrive via ``stmts``."""
+            stack: List[ast.AST] = [stmt]
+            while stack:
+                node = stack.pop()
+                yield node
+                for child in ast.iter_child_nodes(node):
+                    if isinstance(child, (ast.stmt, ast.FunctionDef,
+                                          ast.AsyncFunctionDef,
+                                          ast.Lambda, ast.ClassDef)):
+                        continue
+                    stack.append(child)
+
+        def assigned_names(stmt: ast.stmt) -> Set[str]:
+            out: Set[str] = set()
+            targets = []
+            if isinstance(stmt, ast.Assign):
+                targets = stmt.targets
+            elif isinstance(stmt, (ast.AugAssign, ast.AnnAssign)):
+                targets = [stmt.target]
+            elif isinstance(stmt, ast.For):
+                targets = [stmt.target]
+            for t in targets:
+                for n in ast.walk(t):
+                    if isinstance(n, ast.Name):
+                        out.add(n.id)
+            return out
+
+        for stmt in stmts(scope):
+            # loads of dead names in this statement (excluding the
+            # assignment targets handled below)
+            for node in own(stmt):
+                if isinstance(node, ast.Name) and \
+                        isinstance(node.ctx, ast.Load) and \
+                        node.id in dead:
+                    line, callee = dead[node.id]
+                    yield Finding(
+                        rule=self.name, path=ctx.rel,
+                        line=node.lineno,
+                        message=(f"`{node.id}` was donated to "
+                                 f"`{callee}` (donate_argnums) on "
+                                 f"line {line} and read again here — "
+                                 "donated buffers are deallocated by "
+                                 "the call"),
+                        key=(f"{self.name}:{ctx.rel}:donated:"
+                             f"{callee}:{node.id}"))
+                    del dead[node.id]
+            # track jit bindings: f = jax.jit(g, donate_argnums=...)
+            if isinstance(stmt, ast.Assign) and \
+                    isinstance(stmt.value, ast.Call):
+                pos = _donated_positions(stmt.value)
+                if pos is not None:
+                    for t in stmt.targets:
+                        base = t
+                        while isinstance(base, ast.Attribute):
+                            base = base.value
+                        if isinstance(t, ast.Name):
+                            jitted[t.id] = pos
+            # calls of tracked jitted callables: mark donated args
+            for node in own(stmt):
+                if isinstance(node, ast.Call) and \
+                        isinstance(node.func, ast.Name) and \
+                        node.func.id in jitted:
+                    for i in jitted[node.func.id]:
+                        if i < len(node.args) and \
+                                isinstance(node.args[i], ast.Name):
+                            dead[node.args[i].id] = (node.lineno,
+                                                     node.func.id)
+            # rebinds resurrect the name (params = step(params, ...))
+            for name in assigned_names(stmt):
+                dead.pop(name, None)

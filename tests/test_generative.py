@@ -178,6 +178,73 @@ class TestDecodeEngine:
         list(s)
         eng.shutdown()
 
+    def test_submit_after_shutdown_restarts_worker(self):
+        """Regression (dl4j-lint lock-discipline finding): shutdown
+        used to leave ``_worker`` pointing at the joined thread, so a
+        later submit enqueued onto a dead queue and its stream hung
+        forever. Shutdown now swaps the worker out under the submit
+        lock; a post-shutdown submit must see None, start a fresh
+        worker, and stream a full, correct completion."""
+        model, pool, eng = _engine()
+        prompt = np.array([5, 9, 2, 7])
+        list(eng.submit(prompt, 4))
+        eng.shutdown()
+        stream = eng.submit(prompt, 8)
+        got = []
+        for _ in range(8):
+            t = stream.next(timeout=10)
+            if t is None:
+                break
+            got.append(t)
+        ref = list(model.reference_decode(eng.params, prompt, 8,
+                                          eos_id=model.conf.eos_id))
+        assert got == ref
+        assert pool.live_blocks == 0
+        eng.shutdown()
+
+    def test_shutdown_submit_race_never_strands_stream(self):
+        """Hammer shutdown against concurrent submits: every stream a
+        submit returns must terminate — served by the old worker
+        (drained before shutdown's join returns) or by the fresh one a
+        post-shutdown submit starts — never parked on a dead queue."""
+        model, pool, eng = _engine()
+        prompt = np.array([5, 9, 2])
+        streams, errs = [], []
+
+        def submitter():
+            for _ in range(6):
+                try:
+                    streams.append(eng.submit(prompt, 3))
+                except PoolExhausted:
+                    pass
+                except Exception as e:       # noqa: BLE001
+                    errs.append(e)
+
+        threads = [threading.Thread(target=submitter)
+                   for _ in range(3)]
+        for t in threads:
+            t.start()
+        for _ in range(8):
+            eng.shutdown()
+            time.sleep(0.005)
+        for t in threads:
+            t.join()
+        assert not errs
+        import queue as _queue
+        deadline = time.monotonic() + 30
+        for s in streams:
+            while s.reason is None:
+                assert time.monotonic() < deadline, \
+                    "stream stranded after shutdown/submit race"
+                try:
+                    s.next(timeout=0.5)
+                except _queue.Empty:
+                    pass
+        assert sum(1 for s in streams
+                   if s.reason in ("max_tokens", "eos")) == len(streams)
+        eng.shutdown()
+        assert pool.live_blocks == 0
+
 
 def _mesh_1d():
     import jax

@@ -1,0 +1,456 @@
+# dl4j-lint: disable-file=all  (fixture snippets below would trip
+# every rule by design — this file must never join the repo scan)
+"""dl4j-lint: per-rule fixtures through the real lint pipeline.
+
+Each rule gets the four variants the gate must distinguish: a
+violating snippet (finding fires), a clean snippet (no finding), a
+suppressed snippet (site-level ``# dl4j-lint: disable=<rule>``), and a
+baselined run (finding fires but is grandfathered, exit stays 0).
+Fixtures are written to ``tmp_path`` trees shaped like the repo so the
+per-rule ``wants()`` scoping applies exactly as in CI.
+"""
+from __future__ import annotations
+
+import json
+import subprocess
+import sys
+import textwrap
+from pathlib import Path
+
+import pytest
+
+_ROOT = Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(_ROOT))
+
+from scripts.dl4j_lint import lint_repo, load_baseline  # noqa: E402
+from scripts.dl4j_lint.core import (Baseline, gate,  # noqa: E402
+                                    write_baseline)
+
+
+def _lint(tmp_path: Path, rules, files: dict, readme: str = ""):
+    """Write ``{relpath: source}`` fixtures under tmp_path and lint
+    them with the selected rules."""
+    paths = []
+    for rel, src in files.items():
+        p = tmp_path / rel
+        p.parent.mkdir(parents=True, exist_ok=True)
+        p.write_text(textwrap.dedent(src))
+        paths.append(p)
+    if readme:
+        (tmp_path / "README.md").write_text(textwrap.dedent(readme))
+    return lint_repo(tmp_path, rule_names=rules, files=paths)
+
+
+def _keys(findings):
+    return {f.key for f in findings}
+
+
+# ----------------------------------------------------------------------
+class TestJitPurity:
+    REL = "deeplearning4j_tpu/mod.py"
+
+    def test_decorated_root_impurity_fires(self, tmp_path):
+        fs = _lint(tmp_path, ["jit-purity"], {self.REL: """\
+            import time
+            import jax
+
+            @jax.jit
+            def step(x):
+                t0 = time.time()
+                return x + t0
+            """})
+        assert any(f.rule == "jit-purity" and "time.time" in f.message
+                   for f in fs)
+
+    def test_interprocedural_chain_fires(self, tmp_path):
+        """Impurity two calls deep from a jit CALL-SITE root — the
+        reachability walk, not just the decorator scan."""
+        fs = _lint(tmp_path, ["jit-purity"], {self.REL: """\
+            import numpy as np
+            import jax
+
+            def helper(x):
+                return x * np.random.rand()
+
+            def step(x):
+                return helper(x) + 1
+
+            fast_step = jax.jit(step)
+            """})
+        assert any(f.rule == "jit-purity"
+                   and "np.random" in f.message for f in fs)
+
+    def test_pure_fn_is_clean(self, tmp_path):
+        fs = _lint(tmp_path, ["jit-purity"], {self.REL: """\
+            import jax
+            import jax.numpy as jnp
+
+            @jax.jit
+            def step(x):
+                return jnp.tanh(x) * 2.0
+            """})
+        assert fs == []
+
+    def test_suppression_comment_silences_site(self, tmp_path):
+        fs = _lint(tmp_path, ["jit-purity"], {self.REL: """\
+            import time
+            import jax
+
+            @jax.jit
+            def step(x):
+                # trace-time stamp is deliberate here
+                # dl4j-lint: disable=jit-purity
+                t0 = time.time()
+                return x + t0
+            """})
+        assert fs == []
+
+
+# ----------------------------------------------------------------------
+class TestLockDiscipline:
+    REL = "deeplearning4j_tpu/serving/svc.py"   # in-scope path
+
+    VIOLATING = """\
+        import threading
+
+        class Svc:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self.count = 0
+
+            def start(self):
+                t = threading.Thread(target=self._loop)
+                t.start()
+
+            def _loop(self):
+                self.count += 1
+
+            def snapshot(self):
+                return self.count
+        """
+
+    def test_unlocked_shared_mutation_fires(self, tmp_path):
+        fs = _lint(tmp_path, ["lock-discipline"],
+                   {self.REL: self.VIOLATING})
+        assert any(f.rule == "lock-discipline"
+                   and f.key.endswith(":count") for f in fs)
+
+    def test_guarded_mutation_is_clean(self, tmp_path):
+        fs = _lint(tmp_path, ["lock-discipline"], {self.REL: """\
+            import threading
+
+            class Svc:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self.count = 0
+
+                def start(self):
+                    t = threading.Thread(target=self._loop)
+                    t.start()
+
+                def _loop(self):
+                    with self._lock:
+                        self.count += 1
+
+                def snapshot(self):
+                    with self._lock:
+                        return self.count
+            """})
+        assert fs == []
+
+    def test_threadsafe_container_is_clean(self, tmp_path):
+        """queue.Queue carries its own lock — not a finding."""
+        fs = _lint(tmp_path, ["lock-discipline"], {self.REL: """\
+            import queue
+            import threading
+
+            class Svc:
+                def __init__(self):
+                    self.q = queue.Queue()
+
+                def start(self):
+                    t = threading.Thread(target=self._loop)
+                    t.start()
+
+                def _loop(self):
+                    self.q.put(1)
+
+                def submit(self, item):
+                    self.q.put(item)
+            """})
+        assert fs == []
+
+    def test_suppression_on_line_above(self, tmp_path):
+        src = self.VIOLATING.replace(
+            "        self.count += 1",
+            "        # benign torn read is fine here\n"
+            "        # dl4j-lint: disable=lock-discipline\n"
+            "        self.count += 1")
+        fs = _lint(tmp_path, ["lock-discipline"], {self.REL: src})
+        assert fs == []
+
+
+# ----------------------------------------------------------------------
+class TestEnvRegistry:
+    ENV_MODULE = "deeplearning4j_tpu/common/environment.py"
+
+    def test_undocumented_read_fires_both_registries(self, tmp_path):
+        fs = _lint(tmp_path, ["env-registry"], {
+            "deeplearning4j_tpu/mod.py": """\
+                import os
+                KNOB = os.environ.get("DL4J_TPU_FIXTURE_KNOB", "0")
+                """,
+            self.ENV_MODULE: '"""Env vars: (none yet)."""\n',
+        }, readme="# fixture\n")
+        keys = _keys(fs)
+        assert "env-registry:env-doc:DL4J_TPU_FIXTURE_KNOB" in keys
+        assert "env-registry:readme:DL4J_TPU_FIXTURE_KNOB" in keys
+
+    def test_documented_read_is_clean(self, tmp_path):
+        fs = _lint(tmp_path, ["env-registry"], {
+            "deeplearning4j_tpu/mod.py": """\
+                import os
+                KNOB = os.environ.get("DL4J_TPU_FIXTURE_KNOB", "0")
+                """,
+            self.ENV_MODULE:
+                '"""Env vars: DL4J_TPU_FIXTURE_KNOB."""\n',
+        }, readme="""\
+            ## Environment variables
+            | Variable | Default | Meaning |
+            |---|---|---|
+            | `DL4J_TPU_FIXTURE_KNOB` | `0` | fixture knob. |
+            """)
+        assert fs == []
+
+    def test_stale_entries_fire(self, tmp_path):
+        """A README row and a docstring entry nothing reads are as
+        misleading as missing docs."""
+        fs = _lint(tmp_path, ["env-registry"], {
+            "deeplearning4j_tpu/mod.py": "X = 1\n",
+            self.ENV_MODULE: '"""Env vars: DL4J_TPU_GONE_KNOB."""\n',
+        }, readme="""\
+            ## Environment variables
+            | Variable | Default | Meaning |
+            |---|---|---|
+            | `DL4J_TPU_GHOST_KNOB` | `0` | nothing reads me. |
+            """)
+        keys = _keys(fs)
+        assert "env-registry:stale-readme:DL4J_TPU_GHOST_KNOB" in keys
+        assert "env-registry:stale-env-doc:DL4J_TPU_GONE_KNOB" in keys
+
+    def test_docstring_mention_is_not_a_read(self, tmp_path):
+        """The catalog inside environment.py's own docstrings must not
+        count as code reads (it would make every entry self-reading)."""
+        fs = _lint(tmp_path, ["env-registry"], {
+            self.ENV_MODULE:
+                '"""Env vars: DL4J_TPU_GONE_KNOB."""\n',
+        }, readme="# fixture\n")
+        assert _keys(fs) == {
+            "env-registry:stale-env-doc:DL4J_TPU_GONE_KNOB"}
+
+
+# ----------------------------------------------------------------------
+class TestMetricRegistry:
+    REL = "deeplearning4j_tpu/mod.py"
+    REG = """\
+        from deeplearning4j_tpu.common import telemetry
+
+        def touch():
+            telemetry.counter("dl4j_fixture_total", "d").inc()
+        """
+
+    def test_unregistered_metric_fires(self, tmp_path):
+        fs = _lint(tmp_path, ["metric-registry"], {self.REL: self.REG},
+                   readme="## Observability\nno table here\n")
+        assert "metric-registry:missing:dl4j_fixture_total" in _keys(fs)
+
+    def test_documented_metric_is_clean(self, tmp_path):
+        fs = _lint(tmp_path, ["metric-registry"], {self.REL: self.REG},
+                   readme="""\
+                   ## Observability
+                   | Metric | Type | Meaning |
+                   |---|---|---|
+                   | `dl4j_fixture_total` | counter | fixture. |
+                   """)
+        assert fs == []
+
+    def test_kind_mismatch_and_stale_fire(self, tmp_path):
+        fs = _lint(tmp_path, ["metric-registry"], {self.REL: self.REG},
+                   readme="""\
+                   ## Observability
+                   | Metric | Type | Meaning |
+                   |---|---|---|
+                   | `dl4j_fixture_total` | gauge | wrong kind. |
+                   | `dl4j_ghost_total` | counter | stale. |
+                   """)
+        keys = _keys(fs)
+        assert "metric-registry:kind:dl4j_fixture_total" in keys
+        assert "metric-registry:stale:dl4j_ghost_total" in keys
+
+
+# ----------------------------------------------------------------------
+class TestSpecInvariants:
+    REL = "deeplearning4j_tpu/mod.py"
+
+    def test_pipe_spec_literal_fires(self, tmp_path):
+        fs = _lint(tmp_path, ["spec-invariants"], {self.REL: """\
+            from jax.sharding import PartitionSpec as P
+
+            SPEC = P("pipe", None)
+            """})
+        assert any(f.rule == "spec-invariants"
+                   and ":pipe-spec:" in f.key for f in fs)
+
+    def test_use_after_donation_fires(self, tmp_path):
+        fs = _lint(tmp_path, ["spec-invariants"], {self.REL: """\
+            import jax
+
+            def g(p, x):
+                return p + x
+
+            def run(p, x):
+                f = jax.jit(g, donate_argnums=(0,))
+                y = f(p, x)
+                return p + y
+            """})
+        assert any(f.rule == "spec-invariants"
+                   and f.key.endswith(":donated:f:p") for f in fs)
+
+    def test_rebind_resurrects_donated_name(self, tmp_path):
+        """The idiomatic ``params = step(params, ...)`` donation
+        pattern must stay clean."""
+        fs = _lint(tmp_path, ["spec-invariants"], {self.REL: """\
+            import jax
+
+            def g(p, x):
+                return p + x
+
+            def run(p, x):
+                f = jax.jit(g, donate_argnums=(0,))
+                p = f(p, x)
+                return p + 1
+            """})
+        assert fs == []
+
+    def test_suppression_silences_pipe_spec(self, tmp_path):
+        fs = _lint(tmp_path, ["spec-invariants"], {self.REL: """\
+            from jax.sharding import PartitionSpec as P
+
+            # stage-partitioned layout owns this literal
+            # dl4j-lint: disable=spec-invariants
+            SPEC = P("pipe", None)
+            """})
+        assert fs == []
+
+
+# ----------------------------------------------------------------------
+class TestBaselineGate:
+    def _finding(self, tmp_path):
+        fs = _lint(tmp_path, ["spec-invariants"],
+                   {"deeplearning4j_tpu/mod.py":
+                    'SPEC = PartitionSpec("pipe")\n'})
+        assert len(fs) == 1
+        return fs
+
+    def test_baselined_finding_passes_gate(self, tmp_path):
+        fs = self._finding(tmp_path)
+        res = gate(fs, Baseline({fs[0].key: "grandfathered"}))
+        assert not res.failed
+        assert res.new == [] and res.grown == {}
+
+    def test_new_finding_fails_gate(self, tmp_path):
+        fs = self._finding(tmp_path)
+        res = gate(fs, Baseline({}))
+        assert res.failed and res.new == fs
+
+    def test_count_growth_fails_even_with_rotated_keys(self, tmp_path):
+        """Two findings of a rule baselined at one entry: even if one
+        key matches, the rule's count grew — the debt may not ratchet
+        up under churned keys."""
+        fs = _lint(tmp_path, ["spec-invariants"],
+                   {"deeplearning4j_tpu/mod.py":
+                    'A = PartitionSpec("pipe")\n'
+                    'B = PartitionSpec("pipe", None)\n'})
+        assert len(fs) == 2
+        res = gate(fs, Baseline({fs[0].key: "grandfathered"}))
+        assert res.failed
+
+    def test_stale_baseline_keys_reported(self, tmp_path):
+        res = gate([], Baseline({"spec-invariants:gone:key": "old"}))
+        assert not res.failed
+        assert res.stale == ["spec-invariants:gone:key"]
+
+    def test_roundtrip_write_then_load(self, tmp_path):
+        fs = self._finding(tmp_path)
+        p = tmp_path / "baseline.json"
+        write_baseline(p, fs, Baseline({fs[0].key: "kept reason"}))
+        bl = load_baseline(p)
+        assert bl.reasons == {fs[0].key: "kept reason"}
+
+    def test_load_rejects_missing_reason(self, tmp_path):
+        p = tmp_path / "baseline.json"
+        p.write_text(json.dumps(
+            {"findings": [{"key": "jit-purity:x", "reason": ""}]}))
+        with pytest.raises(ValueError, match="no reason"):
+            load_baseline(p)
+
+
+# ----------------------------------------------------------------------
+class TestCli:
+    """The exact invocation ci_check.sh gate 12 runs."""
+
+    _SEEDS = {
+        "jit-purity": ("deeplearning4j_tpu/mod.py",
+                       "import time, jax\n\n"
+                       "@jax.jit\n"
+                       "def f(x):\n"
+                       "    return x + time.time()\n"),
+        "lock-discipline": (
+            "deeplearning4j_tpu/serving/svc.py",
+            TestLockDiscipline.VIOLATING),
+        "env-registry": ("deeplearning4j_tpu/mod.py",
+                         "import os\n"
+                         "K = os.environ.get('DL4J_TPU_SEEDED', '')\n"),
+        "metric-registry": ("deeplearning4j_tpu/mod.py",
+                            TestMetricRegistry.REG),
+        "spec-invariants": ("deeplearning4j_tpu/mod.py",
+                            "SPEC = PartitionSpec('pipe')\n"),
+    }
+
+    @pytest.mark.parametrize("rule", sorted(_SEEDS))
+    def test_seeded_violation_exits_nonzero(self, tmp_path, rule):
+        rel, src = self._SEEDS[rule]
+        p = tmp_path / rel
+        p.parent.mkdir(parents=True, exist_ok=True)
+        p.write_text(textwrap.dedent(src))
+        r = subprocess.run(
+            [sys.executable, "-m", "scripts.dl4j_lint",
+             "--root", str(tmp_path), "--rules", rule, str(p)],
+            cwd=_ROOT, capture_output=True, text=True, timeout=120)
+        assert r.returncode == 1, r.stdout + r.stderr
+        assert f"[{rule}]" in r.stdout
+
+    def test_repo_is_clean_under_checked_in_baseline(self):
+        r = subprocess.run(
+            [sys.executable, "-m", "scripts.dl4j_lint",
+             "--baseline", "scripts/dl4j_lint_baseline.json"],
+            cwd=_ROOT, capture_output=True, text=True, timeout=300)
+        assert r.returncode == 0, r.stdout + r.stderr
+        assert "OK:" in r.stdout
+
+    def test_baselined_seed_exits_zero(self, tmp_path):
+        rel, src = self._SEEDS["spec-invariants"]
+        p = tmp_path / rel
+        p.parent.mkdir(parents=True, exist_ok=True)
+        p.write_text(src)
+        findings = lint_repo(tmp_path, ["spec-invariants"], [p])
+        bl = tmp_path / "baseline.json"
+        bl.write_text(json.dumps({"findings": [
+            {"key": f.key, "reason": "seeded fixture"}
+            for f in findings]}))
+        r = subprocess.run(
+            [sys.executable, "-m", "scripts.dl4j_lint",
+             "--root", str(tmp_path), "--rules", "spec-invariants",
+             "--baseline", str(bl), str(p)],
+            cwd=_ROOT, capture_output=True, text=True, timeout=120)
+        assert r.returncode == 0, r.stdout + r.stderr

@@ -7,6 +7,7 @@ retention, the bounded on-demand profile capture behind
 import json
 import subprocess
 import sys
+import threading
 import time
 import urllib.error
 import urllib.request
@@ -218,6 +219,39 @@ class TestAggregator:
             assert g.value(worker="2") > 0.5
         finally:
             agg.close()
+
+    def test_concurrent_ingest_counts_every_trip(self):
+        """Regression (dl4j-lint lock-discipline finding): ``_merge``
+        bumped ``trips`` outside the aggregator lock, so per-connection
+        threads merging different steps could lose increments
+        (load/add/store interleave). Hammer ingest from several
+        threads with every step tripping: the count must be exact."""
+        import random
+
+        agg = StepStatsAggregator(expected_workers=2, trip_factor=1.5,
+                                  min_step_seconds=1e-3)
+        n_steps, n_threads = 400, 8
+        recs = [_breakdown(s, 0.1 if w == 0 else 0.9, worker=w)
+                for s in range(n_steps) for w in (0, 1)]
+        random.Random(0).shuffle(recs)
+        shards = [recs[i::n_threads] for i in range(n_threads)]
+        old_interval = sys.getswitchinterval()
+        sys.setswitchinterval(1e-5)    # force preemption mid-increment
+        try:
+            threads = [threading.Thread(
+                target=lambda rs: [agg.ingest(r) for r in rs],
+                args=(shard,)) for shard in shards]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+        finally:
+            sys.setswitchinterval(old_interval)
+            rep = agg.report()
+            agg.close()
+        assert agg.trips == n_steps
+        assert rep["steps_merged"] == n_steps
+        assert rep["trips"] == n_steps
 
     def test_min_step_guard_blocks_noise_trips(self):
         # microsecond steps with huge RELATIVE skew must not trip:
