@@ -1108,7 +1108,8 @@ class SameDiff:
     def _build_raw_train_step(self, ph_names: Tuple[str, ...],
                               mesh=None, axis: str = "data",
                               fsdp: bool = False, tp_specs=None,
-                              dense_tail: bool = False):
+                              dense_tail: bool = False,
+                              encoding=None):
         cfg = self.training_config
         fn, var_names = self._build_fn(tuple(self.loss_variables),
                                        ph_names, True)
@@ -1190,14 +1191,25 @@ class SameDiff:
                 # get their own elementwise tail (apply_update_tp)
                 # pinned to the model-axis layout
                 from deeplearning4j_tpu.parallel.zero import (
-                    apply_update_sharded, apply_update_tp,
-                    merge_tp_state, split_tp_entry, split_tp_state)
+                    apply_update_encoded, apply_update_sharded,
+                    apply_update_tp, merge_tp_state, split_tp_entry,
+                    split_tp_state)
+                if encoding is not None:
+                    # encoded rung: compress the flat dp gradient
+                    # before the collective (error-feedback state under
+                    # ENCODED_KEY); tp leaves below keep the
+                    # uncompressed elementwise tail
+                    import functools as _ft
+                    apply_dp = _ft.partial(apply_update_encoded,
+                                           encoding=encoding)
+                else:
+                    apply_dp = apply_update_sharded
                 if tp_specs:
                     g_rest, g_tp = split_tp_entry(grads, tp_specs)
                     p_rest, p_tp = split_tp_entry(var_vals, tp_specs)
                     st_rest, st_tp = split_tp_state(upd_state)
                     if g_rest:
-                        new_rest, new_state = apply_update_sharded(
+                        new_rest, new_state = apply_dp(
                             updater, g_rest, p_rest, st_rest,
                             iteration, mesh, axis)
                     else:
@@ -1207,7 +1219,7 @@ class SameDiff:
                         tp_specs, gather_params=True)
                     return ({**new_rest, **new_tp},
                             merge_tp_state(new_state, us_tp), loss)
-                new_vars, new_state = apply_update_sharded(
+                new_vars, new_state = apply_dp(
                     updater, grads, var_vals, upd_state, iteration,
                     mesh, axis)
                 return new_vars, new_state, loss
@@ -1233,7 +1245,7 @@ class SameDiff:
 
     def fit_steps(self, placeholders: Dict, n_steps: int,
                   mesh=None, update_exchange="auto", tp_specs=None,
-                  ph_specs=None) -> float:
+                  ph_specs=None, encoding=None) -> float:
         """``n_steps`` train-step updates on ONE fixed placeholder
         batch inside a single ``lax.fori_loop`` dispatch, syncing on
         the final loss once. The benchmark-grade loop (same recipe as
@@ -1258,7 +1270,14 @@ class SameDiff:
         sharded over ``model`` and updated through ``apply_update_tp``
         — they never enter the dp flat ravels, so dp collectives stay
         on the ``data`` axis. ``ph_specs`` maps placeholder names to
-        explicit ``PartitionSpec``s (see ``_shard_placeholders``)."""
+        explicit ``PartitionSpec``s (see ``_shard_placeholders``).
+
+        ``update_exchange="encoded"`` selects the compressed-collective
+        rung: the flat dp gradient is quantized/sparsified before the
+        data-axis exchange with per-replica error-feedback residuals
+        (``parallel.encoding``); ``encoding=`` takes an
+        ``EncodingSpec`` or scheme string (``"threshold"``/``"int8"``/
+        ``"1bit"``)."""
         cfg = self.training_config
         if cfg is None:
             raise ValueError("call set_training_config first")
@@ -1274,6 +1293,13 @@ class SameDiff:
         mode = resolve_update_exchange(mesh, requested=update_exchange)
         sharded = mode is UpdateExchange.SHARDED
         fsdp = mode is UpdateExchange.FSDP
+        encoded = mode is UpdateExchange.ENCODED
+        if encoded:
+            from deeplearning4j_tpu.parallel.encoding import \
+                resolve_encoding
+            encoding = resolve_encoding(encoding)
+        else:
+            encoding = None
         tp = (int(mesh.shape.get("model", 1)) if mesh is not None
               else 1)
         if mesh is None or tp <= 1:
@@ -1284,11 +1310,13 @@ class SameDiff:
             tp_specs = SpecLayout(mesh).infer_entry(
                 {n: v for n, v in self._arrays.items()
                  if self.vars[n].var_type is VariableType.VARIABLE},
-                shard_over_data=sharded or fsdp)
+                shard_over_data=sharded or fsdp or encoded)
         tp_sig = tuple(sorted(
             (n, tuple(s.compute), tuple(s.resident))
             for n, s in tp_specs.items())) or None
-        key = (tuple(sorted(ph_vals)), mesh_sig, mode.value, tp_sig)
+        enc_sig = encoding.signature() if encoding is not None else None
+        key = (tuple(sorted(ph_vals)), mesh_sig, mode.value, tp_sig,
+               enc_sig)
         cached = self._exec_cache.get(("train_multi", key))
         if cached is None:
             from deeplearning4j_tpu.common.compilecache import \
@@ -1296,9 +1324,11 @@ class SameDiff:
             enable_persistent_cache()
             raw, trainable = self._build_raw_train_step(
                 tuple(ph_vals),
-                mesh if (sharded or fsdp or tp_specs) else None,
+                mesh if (sharded or fsdp or encoded or tp_specs)
+                else None,
                 fsdp=fsdp, tp_specs=tp_specs,
-                dense_tail=not (sharded or fsdp))
+                dense_tail=not (sharded or fsdp or encoded),
+                encoding=encoding)
 
             def multi(var_vals, upd_state, ph, rng, it0, n):
                 def body(i, carry):
@@ -1337,21 +1367,35 @@ class SameDiff:
         # layout sync: the sharded/fsdp steps consume/produce the
         # ZeRO-1 flat state (tp variables split out under TP_KEY); the
         # dense step the per-variable slot trees
-        flat_state = sharded or fsdp
+        flat_state = sharded or fsdp or encoded
         from deeplearning4j_tpu.learning.updaters import (has_tp,
-                                                          is_dp_sharded)
-        if flat_state and self._updater_state:
-            # idempotent: a state already raveled for this world size
-            # and tp split passes through untouched
-            from deeplearning4j_tpu.parallel.zero import to_sharded_state
-            self._updater_state = to_sharded_state(
+                                                          is_dp_sharded,
+                                                          is_encoded)
+        if encoded and self._updater_state is not None:
+            # encoded flats + error-feedback residual injected when
+            # absent (first fit, or a dense/sharded checkpoint
+            # restored into an encoded run on any device count)
+            from deeplearning4j_tpu.parallel.zero import \
+                ensure_encoded_state
+            self._updater_state = ensure_encoded_state(
                 var_vals, self._updater_state, mesh.shape["data"],
-                tp_names=tuple(tp_specs))
+                encoding, tp_names=tuple(tp_specs))
+        elif flat_state and self._updater_state:
+            # idempotent: a state already raveled for this world size
+            # and tp split passes through untouched (a residual left by
+            # an encoded run is stripped — it belongs to that exchange)
+            from deeplearning4j_tpu.parallel.zero import (
+                strip_encoded_state, to_sharded_state)
+            self._updater_state = to_sharded_state(
+                var_vals, strip_encoded_state(self._updater_state),
+                mesh.shape["data"], tp_names=tuple(tp_specs))
         elif not flat_state and (is_dp_sharded(self._updater_state)
-                                 or has_tp(self._updater_state)):
-            from deeplearning4j_tpu.parallel.zero import to_dense_state
-            self._updater_state = to_dense_state(var_vals,
-                                                 self._updater_state)
+                                 or has_tp(self._updater_state)
+                                 or is_encoded(self._updater_state)):
+            from deeplearning4j_tpu.parallel.zero import (
+                strip_encoded_state, to_dense_state)
+            self._updater_state = strip_encoded_state(
+                to_dense_state(var_vals, self._updater_state))
         self._rng, rng = jax.random.split(self._rng)
         if mesh is not None:
             from deeplearning4j_tpu.parallel import replicate_tree

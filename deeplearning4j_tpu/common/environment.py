@@ -52,6 +52,12 @@ class _Env:
     # k computes (off -> strictly on-demand gathers).
     fsdp: bool = True
     fsdp_prefetch: bool = True
+    # encoded update exchange (parallel.zero / parallel.encoding): the
+    # compressed-collective fourth rung (threshold sign·tau, int8,
+    # 1-bit) with error-feedback residuals. 0 demotes
+    # update_exchange="encoded" requests to the ZeRO-1 sharded update
+    # (the exchange survives, only the compression drops).
+    encoded_update: bool = True
     # numerics watchdog (common.diagnostics): opt-in sampled non-finite
     # check on loss / global grad norm inside the fit funnels; a trip
     # raises a structured NumericsEvent instead of training on NaNs
@@ -103,7 +109,8 @@ class Environment:
       DL4J_TPU_COMPILE_CACHE, DL4J_TPU_COMPILE_CACHE_DIR,
       DL4J_TPU_RETRACE_WARN, DL4J_TPU_TELEMETRY,
       DL4J_TPU_SHARDED_UPDATE, DL4J_TPU_FSDP,
-      DL4J_TPU_FSDP_PREFETCH, DL4J_TPU_NUMERICS_WATCHDOG,
+      DL4J_TPU_FSDP_PREFETCH, DL4J_TPU_ENCODED_UPDATE,
+      DL4J_TPU_NUMERICS_WATCHDOG,
       DL4J_TPU_NUMERICS_SAMPLE, DL4J_TPU_FLIGHT_RECORDER,
       DL4J_TPU_FLIGHT_RECORDER_STEPS, DL4J_TPU_FLIGHT_RECORDER_DIR,
       DL4J_TPU_FLIGHT_RECORDER_KEEP, DL4J_TPU_HBM_SAMPLE_STEPS,
@@ -168,7 +175,17 @@ class Environment:
       even when the native library is buildable),
       DL4J_TPU_TEST_PLATFORM (tests/benchmarks only: platform pin
       for the suite — default cpu with an 8-device virtual mesh;
-      =axon runs against real accelerators)
+      =axon runs against real accelerators),
+      DL4J_TPU_ENCODED_SCHEME (parallel.encoding: default wire codec
+      for update_exchange="encoded" when no EncodingSpec is passed —
+      threshold | int8 | 1bit, default threshold),
+      DL4J_TPU_KV_DTYPE (serving.batcher: KV-block pool dtype for
+      generative serving — float32 | bfloat16, default float32; a
+      per-model generate={"kv_dtype": ...} overrides it),
+      DL4J_TPU_SERVING_PARAM_DTYPE (serving.registry: default
+      register(param_dtype=...) low-precision residency cast for
+      sharded/fsdp-resident serving params — bf16 | int8, unset =
+      full precision)
     """
 
     _inst: _Env | None = None
@@ -203,6 +220,7 @@ class Environment:
                     sharded_update=b("DL4J_TPU_SHARDED_UPDATE", True),
                     fsdp=b("DL4J_TPU_FSDP", True),
                     fsdp_prefetch=b("DL4J_TPU_FSDP_PREFETCH", True),
+                    encoded_update=b("DL4J_TPU_ENCODED_UPDATE", True),
                     numerics_watchdog=b("DL4J_TPU_NUMERICS_WATCHDOG"),
                     numerics_sample=int(os.environ.get(
                         "DL4J_TPU_NUMERICS_SAMPLE", "1")),

@@ -69,6 +69,20 @@ def has_tp(tree) -> bool:
     return isinstance(tree, dict) and TP_KEY in tree
 
 
+#: reserved key marking the encoded update-exchange rung's per-replica
+#: error-feedback state inside an updater-state entry:
+#: ``{ENCODED_KEY: {"residual": {dtype key: padded flat}, "tau": f32,
+#: "step": i32, "sparsity": f32}}``.  The residual flats shard
+#: ``P(data)`` beside the DP_SHARDED slots; in the dense (checkpoint)
+#: layout the residual unravels back into the param treedef so restore
+#: works on any device count (``parallel.zero`` owns the conversions).
+ENCODED_KEY = "__encoded__"
+
+
+def is_encoded(state) -> bool:
+    return isinstance(state, dict) and ENCODED_KEY in state
+
+
 class DpFlatSpec:
     """How a pytree ravels into per-dtype padded flat vectors.
 

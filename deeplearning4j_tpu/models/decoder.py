@@ -207,8 +207,10 @@ class DecoderLM:
             p = params[f"layer_{i}"]
             h = _ln(x, p["ln1_g"], p["ln1_b"])
             q, k_new, v_new = self._attn_qkv(p, h, heads_first=False)
-            kf = kf.at[i, slot].set(k_new)
-            vf = vf.at[i, slot].set(v_new)
+            # low-precision pools (kv_dtype=bf16) take writes in the
+            # pool's own dtype; attention math re-promotes via q
+            kf = kf.at[i, slot].set(k_new.astype(kf.dtype))
+            vf = vf.at[i, slot].set(v_new.astype(vf.dtype))
             kp = jnp.reshape(kf[i], (nb, bs, c.n_heads, c.head_dim))
             vp = jnp.reshape(vf[i], (nb, bs, c.n_heads, c.head_dim))
             if paged:

@@ -74,6 +74,11 @@ class MultiLayerNetwork:
         # dense update tail WITH a mesh installed (dense x tp 2D mode:
         # the step needs the mesh for tp pins but must not run ZeRO-1)
         self._dp_dense = False
+        # encoded update exchange (parallel.encoding): the ZeRO-1 tail
+        # with the flat gradient compressed before the data-axis
+        # collective; _dp_encoding holds the static EncodingSpec
+        self._dp_encoded = False
+        self._dp_encoding = None
         # tensor parallelism (parallel.speclayout): per-layer
         # {name: TpLeafSpec} for model-axis sharded leaves
         self._tp_model_axis = None
@@ -297,6 +302,8 @@ class MultiLayerNetwork:
         dp_mesh, dp_axis = self._dp_mesh, self._dp_axis
         fsdp = self._dp_fsdp and dp_mesh is not None
         dense_tail = self._dp_dense and dp_mesh is not None
+        encoded = self._dp_encoded and dp_mesh is not None
+        encoding = self._dp_encoding if encoded else None
         tp_specs_all = (dict(self._tp_specs)
                         if dp_mesh is not None and self._tp_specs else {})
         if fsdp:
@@ -367,7 +374,12 @@ class MultiLayerNetwork:
             (constraints skipped: the resolver refuses fsdp when any
             layer has them). Tensor-parallel leaves (tp_specs) never
             enter the dp flats: they get their own elementwise tail
-            (apply_update_tp) pinned to the model-axis layout."""
+            (apply_update_tp) pinned to the model-axis layout. Under
+            the encoded rung the same structure swaps in
+            apply_update_encoded — flat gradient compressed (with
+            error-feedback residual carried in ENCODED_KEY state)
+            before the data-axis collective; tp leaves keep their
+            uncompressed elementwise tail."""
             new_params, new_upd = {}, {}
             for i, up in enumerate(updaters):
                 k = f"layer_{i}"
@@ -399,15 +411,21 @@ class MultiLayerNetwork:
                     new_upd[k] = us
                     continue
                 if dp_mesh is not None and not dense_tail:
+                    import functools as _ft
+
                     from deeplearning4j_tpu.parallel.zero import (
-                        apply_update_sharded, apply_update_tp,
-                        merge_tp_state, split_tp_entry, split_tp_state)
+                        apply_update_encoded, apply_update_sharded,
+                        apply_update_tp, merge_tp_state,
+                        split_tp_entry, split_tp_state)
+                    apply_dp = (_ft.partial(apply_update_encoded,
+                                            encoding=encoding)
+                                if encoded else apply_update_sharded)
                     if tps:
                         g_rest, g_tp = split_tp_entry(g, tps)
                         p_rest, p_tp = split_tp_entry(params[k], tps)
                         st_rest, st_tp = split_tp_state(upd_states[k])
                         if g_rest:
-                            new_rest, us = apply_update_sharded(
+                            new_rest, us = apply_dp(
                                 up, g_rest, p_rest, st_rest,
                                 iteration, dp_mesh, dp_axis)
                         else:
@@ -418,7 +436,7 @@ class MultiLayerNetwork:
                         new_p = {**new_rest, **new_tp}
                         us = merge_tp_state(us, us_tp)
                     else:
-                        new_p, us = apply_update_sharded(
+                        new_p, us = apply_dp(
                             up, g, params[k], upd_states[k], iteration,
                             dp_mesh, dp_axis)
                 else:
@@ -474,26 +492,38 @@ class MultiLayerNetwork:
 
     # ------------------------------------------------------------------
     def set_dp_mesh(self, mesh, axis: str = "data", mode=None, *,
-                    model_axis=None, tp_specs=None):
+                    model_axis=None, tp_specs=None, encoding=None):
         """Install (or clear, with ``mesh=None``) the (possibly 2D)
         mesh the jitted step tail specializes on (``parallel.zero``).
         ``mode="fsdp"`` selects the ZeRO-3 tail: params convert to the
         1/N flat resident layout here (the model owns both param and
         updater-state conversion under fsdp); ``mode="dense"`` installs
         the mesh WITHOUT the ZeRO-1 tail (dense×tp: the step needs the
-        mesh for tensor-parallel pins only); for the ZeRO-1 tail
-        callers still own converting/placing ``updater_states``.
-        ``model_axis``/``tp_specs`` (``parallel.speclayout``) add the
-        tensor-parallel dimension: spec'd leaves pin to the model axis
-        in-step and never enter the dp flats. Invalidates compiled
-        steps."""
+        mesh for tensor-parallel pins only); ``mode="encoded"`` selects
+        the compressed-collective tail (``encoding=`` takes an
+        ``EncodingSpec`` or scheme string; the ENCODED_KEY
+        error-feedback state is injected at the next layout sync); for
+        the ZeRO-1 tail callers still own converting/placing
+        ``updater_states``. ``model_axis``/``tp_specs``
+        (``parallel.speclayout``) add the tensor-parallel dimension:
+        spec'd leaves pin to the model axis in-step and never enter
+        the dp flats. Invalidates compiled steps."""
         mode_s = str(getattr(mode, "value", mode) or "").lower()
         fsdp = mode_s == "fsdp" and mesh is not None
         dense = mode_s == "dense" and mesh is not None
+        encoded = mode_s == "encoded" and mesh is not None
+        if encoded:
+            from deeplearning4j_tpu.parallel.encoding import \
+                resolve_encoding
+            encoding = resolve_encoding(encoding)
+        else:
+            encoding = None
         tp_specs = dict(tp_specs or {}) if mesh is not None else {}
         model_axis = model_axis if tp_specs else None
         if mesh is self._dp_mesh and axis == self._dp_axis and \
                 fsdp == self._dp_fsdp and dense == self._dp_dense and \
+                encoded == self._dp_encoded and \
+                encoding == self._dp_encoding and \
                 model_axis == self._tp_model_axis and \
                 tp_specs == self._tp_specs:
             return self
@@ -502,6 +532,8 @@ class MultiLayerNetwork:
         self._dp_axis = axis
         self._dp_fsdp = fsdp
         self._dp_dense = dense
+        self._dp_encoded = encoded
+        self._dp_encoding = encoding
         self._tp_model_axis = model_axis
         self._tp_specs = tp_specs
         self._train_step = None
@@ -545,16 +577,37 @@ class MultiLayerNetwork:
         """A checkpoint restored from a ZeRO-1 run carries flat sharded
         updater state; on a plain (no-mesh) model — or under the
         dense×tp tail, which consumes dense state — convert it back to
-        the dense per-layer layout before stepping."""
+        the dense per-layer layout before stepping (ENCODED_KEY
+        error-feedback state is stripped there: the residual belongs
+        to the compressed exchange). Under ``mode="encoded"`` the
+        inverse sync runs: entries missing their ENCODED_KEY state
+        (first fit, or a dense/sharded checkpoint restored into an
+        encoded run — on any device count) get it injected and placed."""
         if self._dp_mesh is not None and not self._dp_dense:
+            if self._dp_encoded:
+                from deeplearning4j_tpu.parallel.zero import (
+                    ensure_encoded_states, place_updater_states)
+                n = self._dp_mesh.shape[self._dp_axis]
+                states = self.updater_states
+                new = ensure_encoded_states(
+                    self.dense_params() if self._params_are_fsdp()
+                    else self.params,
+                    states, n, self._dp_encoding,
+                    tp_specs=self._tp_specs)
+                if any(new[k] is not states.get(k) for k in new):
+                    self.updater_states = place_updater_states(
+                        self._dp_mesh, new, self._dp_axis,
+                        tp_specs=self._tp_specs)
             return
         from deeplearning4j_tpu.learning.updaters import (has_tp,
-                                                          is_dp_sharded)
-        if any(is_dp_sharded(s) or has_tp(s)
+                                                          is_dp_sharded,
+                                                          is_encoded)
+        if any(is_dp_sharded(s) or has_tp(s) or is_encoded(s)
                for s in self.updater_states.values()):
-            from deeplearning4j_tpu.parallel.zero import states_to_dense
-            self.updater_states = states_to_dense(self.params,
-                                                  self.updater_states)
+            from deeplearning4j_tpu.parallel.zero import (
+                states_to_dense, strip_encoded_states)
+            self.updater_states = strip_encoded_states(
+                states_to_dense(self.params, self.updater_states))
 
     def _params_are_fsdp(self) -> bool:
         from deeplearning4j_tpu.learning.updaters import is_fsdp

@@ -16,14 +16,30 @@ gradient transform (quantized + residual carry) usable as an optional
 DCN-side compression mode: all ops are dense and jit-friendly (a sparse
 int-index wire format would fight XLA's static shapes for no win
 in-graph).
+
+ISSUE 20 revives the module as the engine of the fourth
+``UpdateExchange`` rung (``parallel.zero.UpdateExchange.ENCODED``):
+the traced variants below (``next_tau_traced``, ``apply_traced``,
+``encode_flat``) run INSIDE the jitted step tail on the per-dtype flat
+ravel, with per-replica error-feedback residuals carried in updater
+state, and ``EncodingSpec`` is the builder-facing config
+(``.encoding(...)`` on ``ParallelWrapper`` / ``SharedTrainingMaster``).
+The host-side ``EncodingHandler`` remains as the standalone
+out-of-graph transform.
 """
 from __future__ import annotations
 
+import math
 from dataclasses import dataclass, field
 from typing import Optional, Tuple
 
 import jax
 import jax.numpy as jnp
+
+#: Wire codecs the encoded rung understands.  "threshold" is the
+#: reference's sign*tau sparse stream; "int8"/"1bit" are the quantized
+#: ReduceScatter/AllGather recasts (ROADMAP item 3).
+SCHEMES = ("threshold", "int8", "1bit")
 
 
 def encode_threshold(g: jnp.ndarray, tau) -> Tuple[jnp.ndarray,
@@ -45,6 +61,63 @@ def sparsity(q: jnp.ndarray) -> jnp.ndarray:
     return jnp.mean((q != 0).astype(jnp.float32))
 
 
+def encode_int8(c: jnp.ndarray) -> jnp.ndarray:
+    """Symmetric per-bucket int8 quantization: round to 127 levels of
+    max|c|, return the dequantized (decoded) value.  Under SPMD the max
+    is over the local flat shard, so each replica carries its own scale
+    — the scale rides the wire as one f32 beside the int8 payload."""
+    scale = jnp.maximum(jnp.max(jnp.abs(c)), jnp.finfo(jnp.float32).tiny)
+    scale = (scale / 127.0).astype(jnp.float32)
+    q = jnp.clip(jnp.round(c.astype(jnp.float32) / scale), -127.0, 127.0)
+    return (q * scale).astype(c.dtype)
+
+
+def encode_1bit(c: jnp.ndarray) -> jnp.ndarray:
+    """1-bit sign quantization with the scale that minimizes L2 error
+    for a sign codebook (mean|c|); decoded value is sign(c)*mean|c|."""
+    scale = jnp.mean(jnp.abs(c).astype(jnp.float32))
+    return (jnp.sign(c).astype(jnp.float32) * scale).astype(c.dtype)
+
+
+def encode_flat(c: jnp.ndarray, tau, scheme: str
+                ) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Traced encode of one residual-corrected flat: returns
+    ``(decoded, transmitted_fraction)``.  The residual is
+    ``c - decoded`` in every scheme (error feedback)."""
+    if scheme == "threshold":
+        q, _ = encode_threshold(c, tau)
+        return q, sparsity(q)
+    if scheme == "int8":
+        return encode_int8(c), jnp.float32(1.0)
+    if scheme == "1bit":
+        return encode_1bit(c), jnp.float32(1.0)
+    raise ValueError(f"unknown encoding scheme {scheme!r}; "
+                     f"expected one of {SCHEMES}")
+
+
+def encoded_payload_bytes(n_elems: int, scheme: str,
+                          sparsity_frac: float = 1.0) -> int:
+    """Bytes one replica puts on the wire for an ``n_elems`` gradient
+    payload under ``scheme`` (the codec's serialized size, NOT the ring
+    multiple — callers apply ``2(N-1)/N``):
+
+    - ``threshold``: the reference's sparse int stream — one int32
+      index per transmitted element (sign folded into the index as in
+      the reference codec), value implicit ±tau, plus the tau scalar;
+    - ``int8``: one byte per element plus the f32 scale;
+    - ``1bit``: one bit per element plus the f32 scale.
+    """
+    if scheme == "threshold":
+        return int(math.ceil(max(0.0, min(1.0, sparsity_frac))
+                             * n_elems)) * 4 + 4
+    if scheme == "int8":
+        return int(n_elems) + 4
+    if scheme == "1bit":
+        return (int(n_elems) + 7) // 8 + 4
+    raise ValueError(f"unknown encoding scheme {scheme!r}; "
+                     f"expected one of {SCHEMES}")
+
+
 class ThresholdAlgorithm:
     """tau policy. Subclasses return the next tau given the last step's
     observed sparsity (reference: encoding.threshold.ThresholdAlgorithm)."""
@@ -53,6 +126,11 @@ class ThresholdAlgorithm:
         raise NotImplementedError
 
     def next_tau(self, tau: float, last_sparsity: float) -> float:
+        raise NotImplementedError
+
+    def next_tau_traced(self, tau, last_sparsity):
+        """jnp.where twin of ``next_tau`` for use inside the jitted
+        step tail (the host variant branches on concrete values)."""
         raise NotImplementedError
 
 
@@ -65,6 +143,9 @@ class FixedThresholdAlgorithm(ThresholdAlgorithm):
         return self.threshold
 
     def next_tau(self, tau: float, last_sparsity: float) -> float:
+        return tau
+
+    def next_tau_traced(self, tau, last_sparsity):
         return tau
 
 
@@ -88,6 +169,12 @@ class AdaptiveThresholdAlgorithm(ThresholdAlgorithm):
             return tau / self.decay_rate
         return tau
 
+    def next_tau_traced(self, tau, last_sparsity):
+        return jnp.where(
+            last_sparsity > self.max_target, tau * self.decay_rate,
+            jnp.where(last_sparsity < self.min_target,
+                      tau / self.decay_rate, tau))
+
 
 @dataclass
 class TargetSparsityThresholdAlgorithm(ThresholdAlgorithm):
@@ -107,6 +194,12 @@ class TargetSparsityThresholdAlgorithm(ThresholdAlgorithm):
             return tau / self.decay_rate
         return tau
 
+    def next_tau_traced(self, tau, last_sparsity):
+        return jnp.where(
+            last_sparsity > self.target, tau * self.decay_rate,
+            jnp.where(last_sparsity < self.target,
+                      tau / self.decay_rate, tau))
+
 
 @dataclass
 class ResidualClippingPostProcessor:
@@ -122,6 +215,79 @@ class ResidualClippingPostProcessor:
         lim = self.max_multiple * tau
         return jax.tree_util.tree_map(
             lambda r: jnp.clip(r, -lim, lim), residual)
+
+    def apply_traced(self, step, tau, residual):
+        """Traced twin: ``step`` / ``tau`` are tracers, the clip fires
+        via jnp.where every ``frequency`` applied updates."""
+        if self.frequency <= 0:
+            return residual
+        lim = self.max_multiple * tau
+        do = (step % self.frequency) == 0
+        return jax.tree_util.tree_map(
+            lambda r: jnp.where(do, jnp.clip(r, -lim, lim), r), residual)
+
+
+@dataclass(frozen=True)
+class EncodingSpec:
+    """Config of the encoded update-exchange rung — what
+    ``ParallelWrapper.Builder.encoding(...)`` /
+    ``SharedTrainingConfiguration`` hand to the step tail.  All fields
+    are static (baked into the trace); the dynamic quantities (tau,
+    residual, observed sparsity) live in updater state under
+    ``learning.updaters.ENCODED_KEY``.
+    """
+    scheme: str = "threshold"
+    algorithm: ThresholdAlgorithm = field(
+        default_factory=AdaptiveThresholdAlgorithm)
+    residual_post: ResidualClippingPostProcessor = field(
+        default_factory=ResidualClippingPostProcessor)
+
+    def __post_init__(self):
+        if self.scheme not in SCHEMES:
+            raise ValueError(f"unknown encoding scheme "
+                             f"{self.scheme!r}; expected one of "
+                             f"{SCHEMES}")
+
+    def initial_tau(self) -> float:
+        return float(self.algorithm.initial())
+
+    def signature(self) -> tuple:
+        """Hashable identity for compile caches (the spec itself is
+        eq-comparable but its algorithm objects are not hashable)."""
+        return (self.scheme,
+                type(self.algorithm).__name__,
+                tuple(sorted(vars(self.algorithm).items())),
+                type(self.residual_post).__name__,
+                tuple(sorted(vars(self.residual_post).items())))
+
+    def planning_sparsity(self) -> float:
+        """Expected transmitted fraction before any step has run —
+        used for the analytic wire-bytes estimate the live gauge then
+        refines."""
+        if self.scheme != "threshold":
+            return 1.0
+        if isinstance(self.algorithm, AdaptiveThresholdAlgorithm):
+            return self.algorithm.max_target
+        if isinstance(self.algorithm, TargetSparsityThresholdAlgorithm):
+            return self.algorithm.target
+        return 1e-2
+
+
+def resolve_encoding(encoding=None) -> "EncodingSpec":
+    """Normalize the builder-facing ``encoding=`` knob: ``None`` ->
+    default spec, a scheme string -> spec with default algorithm, an
+    ``EncodingSpec`` passes through."""
+    if encoding is None:
+        import os
+        return EncodingSpec(scheme=os.environ.get(
+            "DL4J_TPU_ENCODED_SCHEME", "threshold"))
+    if isinstance(encoding, str):
+        return EncodingSpec(scheme=encoding)
+    if isinstance(encoding, EncodingSpec):
+        return encoding
+    raise TypeError("encoding= expects None, a scheme string "
+                    f"{SCHEMES}, or an EncodingSpec; got "
+                    f"{type(encoding).__name__}")
 
 
 class EncodingHandler:
@@ -165,10 +331,9 @@ class EncodingHandler:
             from deeplearning4j_tpu.common import telemetry
             telemetry.gauge(
                 "dl4j_dp_encoding_sparsity",
-                "fraction of gradient elements the threshold encoder "
-                "would transmit (reference: EncodingHandler wire "
-                "density; drives the adaptive tau)").set(
-                    self.last_sparsity)
+                "fraction of gradient elements the encoder transmits "
+                "(live per-step encoded-rung wire density; drives the "
+                "adaptive tau)").set(self.last_sparsity)
         self.tau = self.algorithm.next_tau(self.tau, self.last_sparsity)
         self.residual = self.residual_post.apply(self.step, self.tau,
                                                  self.residual)

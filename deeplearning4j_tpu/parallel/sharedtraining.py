@@ -59,8 +59,14 @@ class SharedTrainingConfiguration:
     # how replicas exchange the weight update: 'dense' (AllReduce +
     # replicated update), 'sharded' (ZeRO-1 ReduceScatter/AllGather —
     # parallel.zero), 'fsdp' (ZeRO-3: params resident 1/N with
-    # just-in-time per-layer gathers), 'auto' (sharded whenever legal)
+    # just-in-time per-layer gathers), 'encoded' (ZeRO-1 with the flat
+    # gradient compressed before the collective — the reference's
+    # threshold/residual knobs above become LIVE and shape the codec),
+    # 'auto' (sharded whenever legal)
     update_exchange: str = "auto"
+    # EncodingSpec or scheme string for update_exchange='encoded'
+    # (None -> threshold scheme with the knobs above, or env default)
+    encoding: object = None
     # updater applies every N micro-batches on the mean gradient
     # (reference: GradientsAccumulator)
     accumulation_steps: int = 1
@@ -111,11 +117,24 @@ class SharedTrainingMaster:
             return self
 
         def update_exchange(self, mode):
-            """'dense' | 'sharded' | 'fsdp' | 'auto' — validated eagerly
-            so a typo fails at build time, not first fit."""
+            """'dense' | 'sharded' | 'fsdp' | 'encoded' | 'auto' —
+            validated eagerly so a typo fails at build time, not first
+            fit. Under 'encoded' the reference threshold/residual
+            knobs (:meth:`threshold_algorithm`,
+            :meth:`residual_post_processor`) configure the codec."""
             from deeplearning4j_tpu.parallel.zero import UpdateExchange
             self._c.update_exchange = UpdateExchange(
                 mode.lower() if isinstance(mode, str) else mode).value
+            return self
+
+        def encoding(self, spec):
+            """Codec for ``update_exchange('encoded')``: an
+            ``EncodingSpec`` or scheme string ('threshold' | 'int8' |
+            '1bit' — parallel.encoding). Overrides the
+            threshold_algorithm/residual_post_processor knobs."""
+            from deeplearning4j_tpu.parallel.encoding import \
+                resolve_encoding
+            self._c.encoding = resolve_encoding(spec)
             return self
 
         def accumulation_steps(self, n: int):
@@ -234,19 +253,38 @@ class SharedTrainingMaster:
         world barrier, so a killed job re-run with the same arguments
         converges to the same state as an uncrashed one."""
         self._ensure_distributed()
-        if self.config.threshold_algorithm is not None:
-            log.info("threshold_algorithm configures the gradient "
-                     "compression transform (parallel.encoding), not "
-                     "the update exchange; the exchange is governed by "
-                     "update_exchange=%r (dense AllReduce | ZeRO-1 "
-                     "sharded ReduceScatter/AllGather | ZeRO-3 fsdp)",
-                     self.config.update_exchange)
         mesh = self._global_mesh()
-        from deeplearning4j_tpu.parallel.zero import \
-            resolve_update_exchange
+        from deeplearning4j_tpu.parallel.zero import (
+            UpdateExchange, resolve_update_exchange)
         mode = resolve_update_exchange(mesh, DEFAULT_DATA_AXIS,
                                        self.config.update_exchange,
                                        model)
+        encoding = None
+        if mode is UpdateExchange.ENCODED:
+            # the reference threshold/residual knobs are LIVE here:
+            # they shape the codec of the compressed collective
+            from deeplearning4j_tpu.parallel.encoding import (
+                EncodingSpec, resolve_encoding)
+            encoding = resolve_encoding(self.config.encoding)
+            if self.config.encoding is None and (
+                    self.config.threshold_algorithm is not None
+                    or self.config.residual_post_processor is not None):
+                encoding = EncodingSpec(
+                    scheme=encoding.scheme,
+                    algorithm=(self.config.threshold_algorithm
+                               or encoding.algorithm),
+                    residual_post=(self.config.residual_post_processor
+                                   or encoding.residual_post))
+            log.info("encoded update exchange: scheme=%s algorithm=%s",
+                     encoding.scheme,
+                     type(encoding.algorithm).__name__)
+        elif self.config.threshold_algorithm is not None:
+            log.info("threshold_algorithm configures the encoded "
+                     "update exchange; update_exchange=%r keeps it "
+                     "inert (dense AllReduce | ZeRO-1 sharded "
+                     "ReduceScatter/AllGather | ZeRO-3 fsdp) — pass "
+                     "update_exchange='encoded' to compress the "
+                     "gradient collective", self.config.update_exchange)
         telemetry.gauge(
             "dl4j_dp_workers",
             "devices participating in the data-parallel mesh").set(
@@ -293,6 +331,7 @@ class SharedTrainingMaster:
                     # re-resolve against the current mesh
                     pw = ParallelWrapper(
                         model, mesh, update_exchange=mode,
+                        encoding=encoding,
                         accumulation_steps=self.config.accumulation_steps)
                     if jax.process_count() == 1:
                         pw.fit(iterator, n_epochs=remaining)

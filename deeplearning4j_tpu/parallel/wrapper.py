@@ -60,6 +60,7 @@ class ParallelWrapper:
                  report_score_after_averaging: bool = True,
                  accumulation_steps: int = 1,
                  update_exchange="auto",
+                 encoding=None,
                  n_micro: Optional[int] = None,
                  pipeline_schedule: str = "1f1b"):
         self.model = model
@@ -83,7 +84,14 @@ class ParallelWrapper:
         #: resolved to the effective UpdateExchange at placement time
         self.requested_exchange = update_exchange
         self.update_exchange = None
+        #: EncodingSpec request for update_exchange='encoded' (None ->
+        #: resolve_encoding default); resolved at placement
+        self.requested_encoding = encoding
+        self.encoding = None
         self._exchange_bytes = 0
+        #: dense counterfactual of the encoded exchange (what the same
+        #: step would move uncompressed) — 0 unless mode is encoded
+        self._dense_wire_bytes = 0
         self._fsdp_gather_bytes = 0
         #: {entry: {name: TpLeafSpec}} inferred at placement (tp > 1)
         self._tp_specs = {}
@@ -104,6 +112,7 @@ class ParallelWrapper:
             self._workers = None
             self._accum = 1
             self._exchange = "auto"
+            self._encoding = None
             self._tp = 1
             self._pp = 1
             self._n_micro = None
@@ -177,14 +186,28 @@ class ParallelWrapper:
             return self
 
         def update_exchange(self, mode) -> "ParallelWrapper.Builder":
-            """'dense' | 'sharded' | 'fsdp' | 'auto'
+            """'dense' | 'sharded' | 'fsdp' | 'encoded' | 'auto'
             (zero.UpdateExchange): how replicas exchange the weight
             update. 'fsdp' (ZeRO-3) additionally keeps params + grads
             resident 1/N per replica with per-layer just-in-time
-            all-gather — opt-in; 'auto' resolves to 'sharded'."""
+            all-gather — opt-in; 'encoded' compresses the dp gradient
+            exchange (quantized/threshold-sparsified collective with
+            error feedback — see :meth:`encoding`); 'auto' resolves
+            to 'sharded'."""
             from deeplearning4j_tpu.parallel.zero import UpdateExchange
             self._exchange = UpdateExchange(
                 mode.lower() if isinstance(mode, str) else mode)
+            return self
+
+        def encoding(self, spec) -> "ParallelWrapper.Builder":
+            """Codec for ``update_exchange('encoded')``: an
+            ``EncodingSpec`` or a scheme string (``'threshold'`` —
+            sign·tau sparse stream with adaptive tau, ``'int8'``,
+            ``'1bit'`` — parallel.encoding). Ignored under every
+            other exchange mode."""
+            from deeplearning4j_tpu.parallel.encoding import \
+                resolve_encoding
+            self._encoding = resolve_encoding(spec)
             return self
 
         def training_mode(self, mode) -> "ParallelWrapper.Builder":
@@ -231,6 +254,7 @@ class ParallelWrapper:
                                    averaging_frequency=self._avg_freq,
                                    accumulation_steps=self._accum,
                                    update_exchange=self._exchange,
+                                   encoding=self._encoding,
                                    n_micro=self._n_micro,
                                    pipeline_schedule=self._pp_sched)
 
@@ -253,13 +277,32 @@ class ParallelWrapper:
         if not m._initialized:
             m.init()
         from deeplearning4j_tpu.parallel.zero import (
-            UpdateExchange, place_tp_params, place_updater_states,
+            UpdateExchange, ensure_encoded_states, exchange_report,
+            place_tp_params, place_updater_states,
             resolve_update_exchange, states_to_dense, states_to_sharded,
-            update_exchange_axis_bytes, update_exchange_bytes)
+            strip_encoded_states, update_exchange_axis_bytes,
+            update_exchange_bytes)
         mode = resolve_update_exchange(self.mesh, self.data_axis,
                                        self.requested_exchange, m)
+        if mode is UpdateExchange.ENCODED and \
+                not hasattr(m, "set_dp_mesh"):
+            log.info("%s has no set_dp_mesh; encoded request lowers to "
+                     "dense", type(m).__name__)
+            mode = UpdateExchange.DENSE
         self.update_exchange = mode
+        if mode is UpdateExchange.ENCODED:
+            from deeplearning4j_tpu.parallel.encoding import \
+                resolve_encoding
+            self.encoding = resolve_encoding(self.requested_encoding)
+        else:
+            self.encoding = None
         if self.pipeline_stages > 1:
+            if mode is UpdateExchange.ENCODED:
+                log.info("encoded update exchange does not compose "
+                         "with pipeline stages yet; using per-stage "
+                         "sharded (ZeRO-1, uncompressed)")
+                mode = self.update_exchange = UpdateExchange.SHARDED
+                self.encoding = None
             self._place_pipeline(mode)
             return
         tp = self.tensor_parallel
@@ -289,7 +332,8 @@ class ParallelWrapper:
             # additionally sharded over data (1/(dp*tp) per chip)
             self._tp_specs = layout.infer(
                 m.params, shard_over_data=mode in (
-                    UpdateExchange.SHARDED, UpdateExchange.FSDP))
+                    UpdateExchange.SHARDED, UpdateExchange.FSDP,
+                    UpdateExchange.ENCODED))
         import numpy as np
         # wire accounting while params are still in the dense layout
         # (the fsdp conversion below folds them into padded flats)
@@ -299,6 +343,7 @@ class ParallelWrapper:
             for a in jax.tree_util.tree_leaves(m.params)
             if hasattr(a, "shape"))
         self._exchange_bytes = update_exchange_bytes(m.params, n, mode)
+        self._dense_wire_bytes = 0
         self._fsdp_gather_bytes = (
             int((n - 1) * param_bytes / n) if n > 1 else 0)
         self._axis_bytes = None
@@ -321,6 +366,15 @@ class ParallelWrapper:
                         tpb // (tp * (n if mode is UpdateExchange.FSDP
                                       else 1)),
                         model_shards=tp, mode=mode.value)
+        if mode is UpdateExchange.ENCODED:
+            # analytic codec estimate (planning sparsity) while params
+            # are dense; run_epochs refines the live series per step
+            # from the observed sparsity gauge
+            rep = exchange_report(
+                m.params, n, mode, model_shards=tp,
+                tp_specs=self._tp_specs or None, encoding=self.encoding)
+            self._dense_wire_bytes = rep["dense_wire_bytes"]
+            self._exchange_bytes = rep["encoded_wire_bytes"]
         if mode is UpdateExchange.FSDP and not hasattr(m, "set_dp_mesh"):
             log.info("%s has no set_dp_mesh; fsdp request lowers to "
                      "dense", type(m).__name__)
@@ -349,10 +403,18 @@ class ParallelWrapper:
                     # dp-flat machinery out of the update)
                     m.set_dp_mesh(
                         self.mesh, self.data_axis,
-                        mode=("sharded" if mode is UpdateExchange.SHARDED
+                        mode=("encoded"
+                              if mode is UpdateExchange.ENCODED
+                              else "sharded"
+                              if mode is UpdateExchange.SHARDED
                               else "dense"),
                         model_axis=self.model_axis,
-                        tp_specs=self._tp_specs)
+                        tp_specs=self._tp_specs,
+                        encoding=self.encoding)
+                elif mode is UpdateExchange.ENCODED:
+                    m.set_dp_mesh(self.mesh, self.data_axis,
+                                  mode="encoded",
+                                  encoding=self.encoding)
                 else:
                     m.set_dp_mesh(self.mesh
                                   if mode is UpdateExchange.SHARDED
@@ -365,18 +427,31 @@ class ParallelWrapper:
                         self.accumulation_steps, type(m).__name__)
         if mode is UpdateExchange.FSDP:
             pass    # set_dp_mesh(mode="fsdp") placed the updater state
+        elif mode is UpdateExchange.ENCODED:
+            # ZeRO-1 flats + error-feedback residual (zero residual
+            # injected unless a checkpoint restored one — any device
+            # count: the flats re-ravel for this mesh)
+            m.updater_states = place_updater_states(
+                self.mesh,
+                ensure_encoded_states(m.params, m.updater_states,
+                                      self.n_workers, self.encoding,
+                                      tp_specs=self._tp_specs),
+                self.data_axis, tp_specs=self._tp_specs)
         elif mode is UpdateExchange.SHARDED:
             m.updater_states = place_updater_states(
                 self.mesh,
-                states_to_sharded(m.params, m.updater_states,
+                states_to_sharded(m.params,
+                                  strip_encoded_states(m.updater_states),
                                   self.n_workers,
                                   tp_specs=self._tp_specs),
                 self.data_axis, tp_specs=self._tp_specs)
         else:
-            # a sharded layout left by a previous placement (or a
-            # restored ZeRO-1 checkpoint) converts back to dense first
+            # a sharded/encoded layout left by a previous placement (or
+            # a restored ZeRO-1 checkpoint) converts back to dense
+            # first (the encoded residual belongs to that exchange)
             m.updater_states = replicate_tree(
-                self.mesh, states_to_dense(m.params, m.updater_states))
+                self.mesh, strip_encoded_states(
+                    states_to_dense(m.params, m.updater_states)))
         self._placed = True
 
     def _place_pipeline(self, mode):
@@ -541,6 +616,8 @@ class ParallelWrapper:
                             "the per-layer just-in-time fsdp param "
                             "all-gathers (ring model, analytic)"
                         ).inc(self._fsdp_gather_bytes, workers=n)
+                    elif mode == "encoded":
+                        self._emit_encoded_telemetry(n)
                 else:
                     self._fit_model(ds)
                 from deeplearning4j_tpu.common import faults
@@ -562,6 +639,62 @@ class ParallelWrapper:
             for lis in self.model.listeners:
                 lis.on_epoch_end(self.model)
         return self
+
+    def _observed_encoding_sparsity(self):
+        """Size-weighted mean of the per-entry transmitted-fraction
+        scalars the encoded step tail left in updater state
+        (``learning.updaters.ENCODED_KEY``) — ``None`` before the
+        first applied step or when no entry runs the encoded tail."""
+        from deeplearning4j_tpu.learning.updaters import (ENCODED_KEY,
+                                                          is_encoded)
+        states = getattr(self.model, "updater_states", None)
+        if not isinstance(states, dict):
+            return None
+        num, den = 0.0, 0
+        for s in states.values():
+            if is_encoded(s):
+                enc = s[ENCODED_KEY]
+                elems = sum(int(v.size)
+                            for v in enc["residual"].values())
+                num += float(enc["sparsity"]) * elems
+                den += elems
+        return (num / den) if den else None
+
+    def _emit_encoded_telemetry(self, workers: int):
+        """Per-step encoded-exchange series: the LIVE transmitted
+        fraction read back from updater state (not a host-side shadow
+        encode), the codec wire bytes it implies, and the ratio vs the
+        dense counterfactual the same step would have moved."""
+        from deeplearning4j_tpu.parallel.zero import exchange_report
+        sp = self._observed_encoding_sparsity()
+        rep = exchange_report(
+            self.model.params, workers, self.update_exchange,
+            model_shards=self.tensor_parallel,
+            tp_specs=self._tp_specs or None,
+            encoding=self.encoding, observed_sparsity=sp)
+        scheme = self.encoding.scheme
+        telemetry.gauge(
+            "dl4j_dp_encoding_sparsity",
+            "fraction of gradient elements the encoder transmits "
+            "(live per-step encoded-rung wire density; drives the "
+            "adaptive tau)").set(
+                rep["encoding_sparsity"], scheme=scheme)
+        telemetry.counter(
+            "dl4j_encoded_wire_bytes_total",
+            "per-replica wire bytes the compressed update exchange "
+            "moved (ring model over the codec payload; the dense "
+            "counterfactual is dl4j_dp_update_exchange_bytes_total "
+            "at mode=dense)").inc(
+                rep["encoded_wire_bytes"], scheme=scheme)
+        telemetry.gauge(
+            "dl4j_encoded_compression_ratio",
+            "dense-counterfactual wire bytes / encoded wire bytes of "
+            "the update exchange (strictly > 1 while the codec is "
+            "winning)").set(
+                rep["compression_ratio"], scheme=scheme)
+        # the span/counter estimate tracks the live sparsity too
+        self._exchange_bytes = rep["encoded_wire_bytes"]
+        self._dense_wire_bytes = rep["dense_wire_bytes"]
 
     @staticmethod
     def _timed_place(shard_fn, workers: int):

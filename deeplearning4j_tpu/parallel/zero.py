@@ -43,13 +43,16 @@ import time
 from typing import Dict
 
 import jax
+import jax.numpy as jnp
 import numpy as np
 
-from deeplearning4j_tpu.learning.updaters import (DP_SHARDED_KEY, FSDP_KEY,
+from deeplearning4j_tpu.learning.updaters import (DP_SHARDED_KEY,
+                                                  ENCODED_KEY, FSDP_KEY,
                                                   TP_KEY, dp_ravel,
                                                   dp_flatten_spec,
                                                   dp_unravel, has_tp,
-                                                  is_dp_sharded, is_fsdp)
+                                                  is_dp_sharded,
+                                                  is_encoded, is_fsdp)
 from deeplearning4j_tpu.parallel.mesh import (DEFAULT_DATA_AXIS,
                                               flat_sharding, replicated)
 
@@ -63,10 +66,19 @@ class UpdateExchange(str, enum.Enum):
     ReduceScatter/AllGather (updater state resident 1/N), ``fsdp`` =
     ZeRO-3 (params + grads + state resident 1/N, per-layer just-in-time
     param all-gather), ``auto`` = sharded whenever legal (fsdp is
-    opt-in only: it trades gather latency for residency)."""
+    opt-in only: it trades gather latency for residency).
+
+    ``encoded`` (ISSUE 20) is the fourth rung — the reference's
+    threshold-encoded gradient sharing recast as compressed collectives:
+    the sharded exchange with the flat gradient compressed before the
+    data-axis collective (sign·tau threshold stream, int8 or 1-bit
+    quantization per ``parallel.encoding.EncodingSpec``), per-replica
+    error-feedback residuals carried in updater state. Opt-in like
+    fsdp: it trades exact dense math for wire bytes."""
     DENSE = "dense"
     SHARDED = "sharded"
     FSDP = "fsdp"
+    ENCODED = "encoded"
     AUTO = "auto"
 
 
@@ -100,7 +112,9 @@ def resolve_update_exchange(mesh, axis: str = DEFAULT_DATA_AXIS,
     gradient before any slicing). An explicit FSDP request additionally
     falls back to SHARDED when ``DL4J_TPU_FSDP=0`` or the model carries
     weight constraints (the post-update projection needs full
-    tensors)."""
+    tensors); an explicit ENCODED request falls back to SHARDED when
+    ``DL4J_TPU_ENCODED_UPDATE=0`` (the kill switch keeps the sharded
+    exchange, dropping only the compression)."""
     if isinstance(requested, str):
         try:
             requested = UpdateExchange(requested.lower())
@@ -111,7 +125,8 @@ def resolve_update_exchange(mesh, axis: str = DEFAULT_DATA_AXIS,
     from deeplearning4j_tpu.common.environment import Environment
     env = Environment.get()
     if not env.sharded_update:
-        if requested in (UpdateExchange.SHARDED, UpdateExchange.FSDP):
+        if requested in (UpdateExchange.SHARDED, UpdateExchange.FSDP,
+                         UpdateExchange.ENCODED):
             log.info("update_exchange=%s requested but "
                      "DL4J_TPU_SHARDED_UPDATE=0; using dense",
                      requested.value)
@@ -127,6 +142,13 @@ def resolve_update_exchange(mesh, axis: str = DEFAULT_DATA_AXIS,
             log.info("gradient_normalization=%s needs the full summed "
                      "gradient; update exchange stays dense", gn.name)
             return UpdateExchange.DENSE
+    if requested is UpdateExchange.ENCODED:
+        if not env.encoded_update:
+            log.info("update_exchange=encoded requested but "
+                     "DL4J_TPU_ENCODED_UPDATE=0; using sharded "
+                     "(ZeRO-1, uncompressed)")
+            return UpdateExchange.SHARDED
+        return UpdateExchange.ENCODED
     if requested is UpdateExchange.FSDP:
         if not env.fsdp:
             log.info("update_exchange=fsdp requested but DL4J_TPU_FSDP=0;"
@@ -183,6 +205,87 @@ def apply_update_sharded(updater, grads, params, state, iteration, mesh,
     new_inner = pin(new_inner, shard)
     new_state = ({DP_SHARDED_KEY: new_inner} if is_dp_sharded(state)
                  else new_inner)
+    return new_params, new_state
+
+
+# -- encoded rung (ISSUE 20) -------------------------------------------------
+def apply_update_encoded(updater, grads, params, state, iteration, mesh,
+                         axis: str = DEFAULT_DATA_AXIS, *, encoding,
+                         epoch=0):
+    """The encoded (compressed-collective) step tail for one param
+    subtree, traced inside the caller's jit: the ZeRO-1 exchange of
+    :func:`apply_update_sharded` with the flat gradient compressed
+    before the data-axis collective.
+
+    Per applied step, on each replica's 1/N flat shard: add the carried
+    error-feedback residual, encode per ``encoding.scheme`` (sign·tau
+    threshold stream / int8 / 1-bit — ``parallel.encoding``), carry
+    ``corrected - decoded`` as the next residual, adapt tau from the
+    observed transmitted fraction (``next_tau_traced``) and clip stale
+    residual every ``frequency`` steps (``apply_traced``); the updater
+    then consumes the DECODED gradient — what the compressed wire
+    format would reconstruct — so the trailing all-gather moves only
+    codec payload on a real DCN fabric. Under SPMD the encode runs on
+    the summed gradient shard; each replica owns a distinct 1/N slice,
+    so residuals are naturally per-replica.
+
+    ``state`` must carry ``ENCODED_KEY`` (``ensure_encoded_state``
+    injects it); returns ``(new_params, new_state)`` with params
+    replicated post-all-gather, residual/inner state sharded."""
+    n = mesh.shape[axis]
+    shard = flat_sharding(mesh, axis)
+    full = replicated(mesh)
+
+    def pin(tree, sh):
+        return jax.tree_util.tree_map(
+            lambda a: jax.lax.with_sharding_constraint(a, sh), tree)
+
+    flat_p, spec = dp_ravel(params, n)
+    flat_g, _ = dp_ravel(grads, n, spec)
+    # same 2D-mesh SPMD concatenate workaround as apply_update_sharded
+    if any(s > 1 for ax, s in mesh.shape.items() if ax != axis):
+        flat_g = pin(flat_g, full)
+        flat_p = pin(flat_p, full)
+    flat_g = pin(flat_g, shard)
+    flat_p = pin(flat_p, shard)
+    enc = state[ENCODED_KEY]
+    residual = pin(enc["residual"], shard)
+    tau, enc_step = enc["tau"], enc["step"]
+    from deeplearning4j_tpu.parallel.encoding import encode_flat
+    corrected = {k: flat_g[k] + residual[k].astype(flat_g[k].dtype)
+                 for k in flat_g}
+    decoded, frac_num, elems = {}, [], 0
+    for k, c in corrected.items():
+        d, f = encode_flat(c, tau, encoding.scheme)
+        decoded[k] = d
+        frac_num.append(f * c.size)
+        elems += int(c.size)
+    # size-weighted transmitted fraction across the dtype buckets (the
+    # padding zeros count as not-transmitted: a slight underestimate,
+    # bounded by n_shards/elems)
+    sp = (sum(frac_num) / max(elems, 1) if elems
+          else jnp.float32(0.0))
+    new_residual = {k: (corrected[k] - decoded[k]).astype(
+                        residual[k].dtype) for k in corrected}
+    new_tau = encoding.algorithm.next_tau_traced(tau, sp)
+    new_residual = encoding.residual_post.apply_traced(
+        enc_step, new_tau, new_residual)
+    inner = state.get(DP_SHARDED_KEY, ())
+    inner = pin(inner, shard)
+    updates, new_inner = updater.apply(decoded, inner, iteration, epoch)
+    new_flat = {k: (flat_p[k] - updates[k]).astype(flat_p[k].dtype)
+                for k in flat_p}
+    new_flat = pin(new_flat, full)           # <- the all-gather
+    new_params = dp_unravel(new_flat, spec)
+    new_residual = pin(new_residual, shard)
+    new_state = {ENCODED_KEY: {
+        "residual": new_residual,
+        "tau": jnp.asarray(new_tau, jnp.float32),
+        "step": jnp.asarray(enc_step + 1, jnp.int32),
+        "sparsity": jnp.asarray(sp, jnp.float32),
+    }}
+    if is_dp_sharded(state):
+        new_state[DP_SHARDED_KEY] = pin(new_inner, shard)
     return new_params, new_state
 
 
@@ -630,6 +733,20 @@ def _state_tp_names(state) -> set:
     return names
 
 
+def _rest_of_params(params, tp_names):
+    if tp_names and isinstance(params, dict):
+        return {n: a for n, a in params.items() if n not in tp_names}
+    return params
+
+
+def _residual_is_flat(res, spec) -> bool:
+    """Flat residuals are keyed by the spec's dtype names and 1-D;
+    dense residuals carry the param treedef (param-name keys)."""
+    return (isinstance(res, dict)
+            and set(res) == set(spec.sizes)
+            and all(getattr(v, "ndim", None) == 1 for v in res.values()))
+
+
 def to_sharded_state(params, state, n_shards: int, tp_names=()):
     """One subtree's dense updater state -> ZeRO-1 flat layout (the
     ``tp_names`` leaves split out under TP_KEY as full-shape trees —
@@ -641,10 +758,32 @@ def to_sharded_state(params, state, n_shards: int, tp_names=()):
     world size or tp partition (an elastic resume — padding is a
     multiple of the shard count) round-trip through the dense layout
     and re-ravel, so the layout always matches the mesh about to
-    consume it (ROADMAP item 4's ``DpFlatSpec`` re-ravel)."""
+    consume it (ROADMAP item 4's ``DpFlatSpec`` re-ravel).
+
+    ENCODED_KEY rides along: the error-feedback residual re-ravels for
+    ``n_shards`` (dense residuals and flats from a different world
+    size both land on the padded flat for this mesh — padding is
+    zeros, so the round-trip is bitwise); tau/step/sparsity scalars
+    pass through."""
     if not state:
         return state
     tp_names = tuple(tp_names or ())
+    if is_encoded(state):
+        enc = state[ENCODED_KEY]
+        base = {k: v for k, v in state.items() if k != ENCODED_KEY}
+        out = to_sharded_state(params, base, n_shards, tp_names)
+        out = dict(out) if isinstance(out, dict) else {}
+        rest = _rest_of_params(params, tp_names)
+        spec = dp_flatten_spec(rest, n_shards)
+        res = enc["residual"]
+        if not _flats_match_spec({"residual": res}, spec):
+            if _residual_is_flat(res, spec):
+                # flat for another world size -> dense first (slices
+                # the true sizes, dropping that size's padding)
+                res = dp_unravel(res, dp_flatten_spec(rest, 1))
+            res = dp_ravel(res, n_shards)[0]
+        out[ENCODED_KEY] = {**enc, "residual": res}
+        return out
 
     def rest_of(tree):
         if tp_names and isinstance(tree, dict):
@@ -672,7 +811,22 @@ def to_sharded_state(params, state, n_shards: int, tp_names=()):
 
 def to_dense_state(params, state):
     """Inverse of :func:`to_sharded_state` (padding dropped; TP_KEY
-    leaves — self-describing — merge back into their slots)."""
+    leaves — self-describing — merge back into their slots; an
+    ENCODED_KEY residual unravels back into the param treedef so the
+    checkpoint layout is exact and device-count-portable)."""
+    if is_encoded(state):
+        enc = state[ENCODED_KEY]
+        base = {k: v for k, v in state.items() if k != ENCODED_KEY}
+        out = to_dense_state(params, base)
+        out = dict(out) if isinstance(out, dict) else {}
+        tp_names = _state_tp_names(state)
+        rest = _rest_of_params(params, tuple(tp_names))
+        res = enc["residual"]
+        spec1 = dp_flatten_spec(rest, 1)
+        if _residual_is_flat(res, spec1):
+            res = dp_unravel(res, spec1)
+        out[ENCODED_KEY] = {**enc, "residual": res}
+        return out
     if not (is_dp_sharded(state) or has_tp(state)):
         return state
     tp = state.get(TP_KEY, {}) if isinstance(state, dict) else {}
@@ -703,13 +857,74 @@ def states_to_dense(params: Dict, states: Dict) -> Dict:
             for k, s in states.items()}
 
 
+def ensure_encoded_state(params, state, n_shards: int, encoding,
+                         tp_names=()):
+    """One entry's updater state -> encoded flat layout: convert to the
+    ZeRO-1 flats for ``n_shards`` and inject the error-feedback state
+    (zero residual flats, the algorithm's initial tau, step 0) when
+    absent. Entries with no dp-raveled leaves (empty, or fully tp)
+    pass through — they never reach :func:`apply_update_encoded`."""
+    tp_names = tuple(tp_names or ())
+    rest = _rest_of_params(params, tp_names)
+    leaves = [a for a in jax.tree_util.tree_leaves(rest)
+              if hasattr(a, "shape")]
+    if not leaves:
+        # nothing to encode, but a fully-tp entry still needs its
+        # TP_KEY split for the elementwise tail
+        return to_sharded_state(params, state, n_shards, tp_names)
+    base = to_sharded_state(params, state, n_shards, tp_names)
+    if is_encoded(base):
+        return base
+    if isinstance(base, dict):
+        out = dict(base)
+    elif base:
+        out = {DP_SHARDED_KEY: base}
+    else:
+        out = {}
+    zeros = dp_ravel(jax.tree_util.tree_map(
+        lambda a: jnp.zeros_like(a), rest), n_shards)[0]
+    out[ENCODED_KEY] = {
+        "residual": zeros,
+        "tau": jnp.float32(encoding.initial_tau()),
+        "step": jnp.int32(0),
+        "sparsity": jnp.float32(0.0),
+    }
+    return out
+
+
+def ensure_encoded_states(params: Dict, states: Dict, n_shards: int,
+                          encoding, tp_specs=None) -> Dict:
+    """Model-level convenience twin of :func:`states_to_sharded`."""
+    tp_specs = tp_specs or {}
+    return {k: ensure_encoded_state(
+                params.get(k, {}), s, n_shards, encoding,
+                tp_names=tuple(tp_specs.get(k, ())))
+            for k, s in states.items()}
+
+
+def strip_encoded_state(state):
+    """Drop the encoded rung's error-feedback state from one entry (a
+    mode change away from ``encoded`` — the residual belongs to the
+    compressed exchange and must not leak into dense updater math)."""
+    if is_encoded(state):
+        base = {k: v for k, v in state.items() if k != ENCODED_KEY}
+        return base if base else ()
+    return state
+
+
+def strip_encoded_states(states: Dict) -> Dict:
+    return {k: strip_encoded_state(s) for k, s in states.items()}
+
+
 def place_updater_states(mesh, states: Dict,
                          axis: str = DEFAULT_DATA_AXIS,
                          tp_specs=None) -> Dict:
     """Device-put updater states on the mesh: sharded flat entries along
     ``P(axis)`` (1/N per replica — the whole HBM win), TP_KEY slots at
     their leaves' RESIDENT NamedSharding (1/tp, ·1/dp under the ZeRO
-    layouts), everything else replicated (the pre-ZeRO placement)."""
+    layouts), ENCODED_KEY residual flats along ``P(axis)`` with the
+    tau/step/sparsity scalars replicated, everything else replicated
+    (the pre-ZeRO placement)."""
     shard = flat_sharding(mesh, axis)
     full = replicated(mesh)
 
@@ -734,13 +949,20 @@ def place_updater_states(mesh, states: Dict,
     with collective_span("state_placement", axis, nbytes,
                          entries=len(states)):
         for k, s in states.items():
-            if is_dp_sharded(s) or has_tp(s):
+            if is_dp_sharded(s) or has_tp(s) or is_encoded(s):
                 ent = {}
                 if DP_SHARDED_KEY in s:
                     ent[DP_SHARDED_KEY] = put(s[DP_SHARDED_KEY], shard)
                 if TP_KEY in s:
                     ent[TP_KEY] = put_tp(s[TP_KEY],
                                          (tp_specs or {}).get(k, {}))
+                if ENCODED_KEY in s:
+                    enc = s[ENCODED_KEY]
+                    ent[ENCODED_KEY] = {
+                        "residual": put(enc["residual"], shard),
+                        **{kk: put(vv, full) for kk, vv in enc.items()
+                           if kk != "residual"},
+                    }
                 out[k] = ent
             else:
                 out[k] = put(s, full)
@@ -796,10 +1018,51 @@ def update_exchange_bytes(params, n_shards: int, mode=None) -> int:
     return int(2 * (n_shards - 1) * total / n_shards)
 
 
+def _dp_raveled_elems(params, tp_specs=None) -> int:
+    """Element count of the leaves the dp flat ravel covers (tp leaves
+    excluded — they stay on the elementwise tail)."""
+    tp_specs = tp_specs or {}
+    total = 0
+    if not isinstance(params, dict):
+        return sum(int(np.prod(a.shape))
+                   for a in jax.tree_util.tree_leaves(params)
+                   if hasattr(a, "shape"))
+    for k, sub in params.items():
+        names = set(tp_specs.get(k, ()))
+        if names and isinstance(sub, dict):
+            sub = {n: a for n, a in sub.items() if n not in names}
+        total += sum(int(np.prod(a.shape))
+                     for a in jax.tree_util.tree_leaves(sub)
+                     if hasattr(a, "shape"))
+    return total
+
+
+def encoded_exchange_bytes(params, n_shards: int, encoding=None,
+                           sparsity=None, tp_specs=None) -> int:
+    """Per-replica wire bytes the ENCODED exchange moves per applied
+    step: the ring model (``2(N-1)/N``) applied to the codec's
+    serialized payload (``parallel.encoding.encoded_payload_bytes``)
+    instead of the dense parameter bytes. ``sparsity`` is the observed
+    transmitted fraction (threshold scheme); when ``None`` the spec's
+    planning sparsity is used. TP leaves are excluded — they ride
+    their own uncompressed elementwise tail."""
+    from deeplearning4j_tpu.parallel.encoding import (
+        encoded_payload_bytes, resolve_encoding)
+    spec = resolve_encoding(encoding)
+    elems = _dp_raveled_elems(params, tp_specs)
+    if n_shards <= 1 or elems == 0:
+        return 0
+    frac = (spec.planning_sparsity() if sparsity is None
+            else float(sparsity))
+    payload = encoded_payload_bytes(elems, spec.scheme, frac)
+    return int(2 * (n_shards - 1) * payload / n_shards)
+
+
 def exchange_report(params, n_shards: int, mode=None,
                     model_shards: int = 1, tp_specs=None,
                     pipe_shards: int = 1,
-                    stage_param_bytes=None) -> dict:
+                    stage_param_bytes=None, encoding=None,
+                    observed_sparsity=None) -> dict:
     """Scaling-observatory accounting for one step's update exchange:
     parameter bytes, per-replica wire bytes (ring-collective model),
     the wire:param ratio, plus a per-mode breakdown — dense reports the
@@ -813,7 +1076,16 @@ def exchange_report(params, n_shards: int, mode=None,
     flats stay local to their pipe group, so the dp update exchange
     moves zero bytes across ``pipe`` (microbatch activation/cotangent
     handoffs, reported by the trainer as ``pipe_wire_bytes``, are the
-    only pipe-axis traffic)."""
+    only pipe-axis traffic).
+
+    For ``mode="encoded"`` the report compares the codec wire against
+    the dense counterfactual: ``encoded_wire_bytes`` (ring model over
+    the serialized payload, plus the uncompressed tp elementwise
+    exchange when tp > 1) becomes ``wire_bytes_per_replica``,
+    ``dense_wire_bytes`` keeps what the same step would have moved
+    uncompressed, and ``compression_ratio`` is their quotient —
+    strictly > 1 for every scheme (``encoding=`` /
+    ``observed_sparsity=`` refine the estimate)."""
     total = sum(int(np.prod(a.shape)) * a.dtype.itemsize
                 for a in jax.tree_util.tree_leaves(params)
                 if hasattr(a, "shape"))
@@ -836,6 +1108,33 @@ def exchange_report(params, n_shards: int, mode=None,
     else:
         rep["grad_reduce_scatter_bytes"] = half
         rep["param_all_gather_bytes"] = half
+    if mode_s == UpdateExchange.ENCODED.value:
+        from deeplearning4j_tpu.parallel.encoding import resolve_encoding
+        enc_spec = resolve_encoding(encoding)
+        frac = (enc_spec.planning_sparsity() if observed_sparsity is None
+                else float(observed_sparsity))
+        enc_wire = encoded_exchange_bytes(
+            params, n_shards, enc_spec, sparsity=frac,
+            tp_specs=tp_specs if tp > 1 else None)
+        if tp > 1:
+            # the tp elementwise tail exchanges its 1/tp slice dense
+            tpb = axis_bytes["tp_param_bytes"]
+            tp_wire = (int(2 * (n_shards - 1) * (tpb // tp) / n_shards)
+                       if n_shards > 1 else 0)
+        else:
+            tp_wire = 0
+        rep["dense_wire_bytes"] = int(wire)
+        rep["encoded_wire_bytes"] = int(enc_wire + tp_wire)
+        rep["wire_bytes_per_replica"] = rep["encoded_wire_bytes"]
+        rep["wire_to_param_ratio"] = (
+            round(rep["encoded_wire_bytes"] / total, 5) if total else 0.0)
+        rep["compression_ratio"] = round(
+            wire / max(rep["encoded_wire_bytes"], 1), 3)
+        rep["encoding_scheme"] = enc_spec.scheme
+        rep["encoding_sparsity"] = float(frac)
+        enc_half = rep["encoded_wire_bytes"] // 2
+        rep["grad_reduce_scatter_bytes"] = enc_half
+        rep["param_all_gather_bytes"] = enc_half
     if mode_s == UpdateExchange.FSDP.value:
         rep["param_resident_bytes_per_replica"] = (
             int(total // n_shards) if n_shards > 1 else int(total))

@@ -80,7 +80,8 @@ class ServingBatcher(ParallelInference):
                  flush_policy: str = "continuous",
                  mode: str = "dense",
                  tensor_parallel: Optional[int] = None,
-                 generate: Optional[dict] = None):
+                 generate: Optional[dict] = None,
+                 param_dtype=None):
         #: generic path: no MLN `_forward` funnel — serve through the
         #: model's own `output(batch)` (SameDiff/ONNX adapters)
         self._generic = None if hasattr(model, "_forward") \
@@ -96,8 +97,15 @@ class ServingBatcher(ParallelInference):
         if flush_policy not in FLUSH_POLICIES:
             raise ValueError(f"flush_policy must be one of "
                              f"{FLUSH_POLICIES}, got {flush_policy!r}")
-        from deeplearning4j_tpu.serving.residency import assert_mode
+        from deeplearning4j_tpu.serving.residency import (
+            assert_mode, resolve_param_dtype)
         assert_mode(mode)
+        self.param_dtype = resolve_param_dtype(param_dtype)
+        if self.param_dtype is not None and mode == "dense":
+            raise ValueError(
+                f"param_dtype={self.param_dtype!r} needs a sharded "
+                f"residency mode ('sharded'/'fsdp'); dense serving "
+                f"keeps the model's own float32 tree")
         if mode != "dense" and self._generic is not None \
                 and not self._generative:
             raise ValueError(
@@ -156,7 +164,7 @@ class ServingBatcher(ParallelInference):
             (self._serve_params, self._fsdp_specs,
              self._serve_tp_specs) = serving_layouts(
                 self.mesh, m.params, self.mode, self.tensor_parallel,
-                name=self.name)
+                name=self.name, param_dtype=self.param_dtype)
             self._serve_states = replicate_tree(self.mesh, m.states)
             self._placed = True
         if self._fwd is None:
@@ -171,10 +179,12 @@ class ServingBatcher(ParallelInference):
             is_graph = isinstance(m, ComputationGraph)
             mesh, mode = self.mesh, self.mode
             specs, tp_specs = self._fsdp_specs, self._serve_tp_specs
+            pd = self.param_dtype
 
             def fwd(params, states, x):
                 view = serving_param_view(params, specs, mesh,
-                                          tp_specs, mode)
+                                          tp_specs, mode,
+                                          param_dtype=pd)
                 if is_graph:
                     acts, _ = m._forward(view, states, [x],
                                          training=False, rng=None,
@@ -283,26 +293,39 @@ class ServingBatcher(ParallelInference):
         if getattr(m, "params", None) is None:
             m.init()
         c = m.conf
+        from deeplearning4j_tpu.common.dtypes import to_jnp_dtype
+        kv_dtype = cfg.get("kv_dtype")
+        if kv_dtype is None:
+            # fleet-wide default; per-model generate={'kv_dtype': ...}
+            # overrides it
+            import os
+            kv_dtype = os.environ.get("DL4J_TPU_KV_DTYPE", "").strip() \
+                or "float32"
+        if isinstance(kv_dtype, str):
+            kv_dtype = to_jnp_dtype(
+                "bfloat16" if kv_dtype in ("bf16", "bfloat16")
+                else kv_dtype)
         pool = KVBlockPool(
             c.n_layers,
             int(cfg.get("kv_blocks", 64)),
             int(cfg.get("kv_block_size", 16)),
             c.n_heads, c.head_dim,
-            dtype=cfg.get("kv_dtype", np.float32), name=self.name)
+            dtype=kv_dtype, name=self.name)
         params, view_fn = m.params, None
         if self.mode != "dense":
             from deeplearning4j_tpu.serving.residency import (
                 serving_layouts, serving_param_view)
             placed, fsdp_specs, tp_specs = serving_layouts(
                 self.mesh, m.params, self.mode, self.tensor_parallel,
-                name=self.name)
+                name=self.name, param_dtype=self.param_dtype)
             self._serve_params = placed
             self._fsdp_specs = fsdp_specs
             self._serve_tp_specs = tp_specs
             params = placed
             view_fn = functools.partial(
                 serving_param_view, fsdp_specs=fsdp_specs,
-                mesh=self.mesh, tp_specs=tp_specs, mode=self.mode)
+                mesh=self.mesh, tp_specs=tp_specs, mode=self.mode,
+                param_dtype=self.param_dtype)
         self.engine = DecodeEngine(
             m, params, pool, view_fn=view_fn, name=self.name,
             prompt_buckets=cfg.get("prompt_buckets", (16, 64)),

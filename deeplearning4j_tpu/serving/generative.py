@@ -275,8 +275,10 @@ class DecodeEngine:
                 tail = kp.shape[3:]
                 kf = kp.reshape((nl, nb * bs) + tail)
                 vf = vp.reshape((nl, nb * bs) + tail)
-                kf = kf.at[:, slots].set(k[:, 0])
-                vf = vf.at[:, slots].set(v[:, 0])
+                # low-precision pools (kv_dtype=bf16) take the write
+                # in the pool's own dtype
+                kf = kf.at[:, slots].set(k[:, 0].astype(kp.dtype))
+                vf = vf.at[:, slots].set(v[:, 0].astype(vp.dtype))
                 return (kf.reshape(kp.shape), vf.reshape(vp.shape))
             self._jits["commit"] = jax.jit(fn)
         return self._jits["commit"]

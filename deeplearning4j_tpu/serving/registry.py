@@ -185,7 +185,8 @@ class ModelRegistry:
                  latency_slo_ms: Optional[float] = None,
                  input_name: Optional[str] = None,
                  output_name: Optional[str] = None,
-                 generate: Optional[dict] = None) -> ModelVersion:
+                 generate: Optional[dict] = None,
+                 param_dtype: Optional[str] = None) -> ModelVersion:
         """Register (or hot-swap) the live version of ``name``.
 
         ``model`` is an in-memory model or an artifact path (zip / h5
@@ -208,9 +209,21 @@ class ModelRegistry:
         ``generate`` configures the generative decode engine for a
         model with a prefill/decode_step surface (``kv_blocks``,
         ``kv_block_size``, ``prompt_buckets``, ``decode_buckets``,
-        ``max_seq_len``, ``paged``) — its prefill/commit/decode
-        programs warm with the version, so the zero-retrace proof
-        covers :generate too."""
+        ``max_seq_len``, ``paged``, ``kv_dtype`` — defaulting from
+        ``DL4J_TPU_KV_DTYPE``) — its prefill/commit/decode programs
+        warm with the version, so the zero-retrace proof covers
+        :generate too.
+
+        ``param_dtype`` (``"bf16"`` | ``"int8"``; defaults from
+        ``DL4J_TPU_SERVING_PARAM_DTYPE``) stores the resident shards of
+        a ``sharded``/``fsdp`` version low-precision — half or a
+        quarter of ``dl4j_serving_param_resident_bytes`` — with compute
+        restored to float32 post-gather (tolerance-level, not bitwise,
+        outputs)."""
+        if param_dtype is None:
+            import os
+            param_dtype = (os.environ.get(
+                "DL4J_TPU_SERVING_PARAM_DTYPE") or None)
         if isinstance(model, (str, Path)):
             source = str(model)
             model = load_model(model)
@@ -237,7 +250,7 @@ class ModelRegistry:
             flush_policy=(flush_policy if flush_policy is not None
                           else self.flush_policy),
             mode=mode, tensor_parallel=tensor_parallel,
-            generate=generate)
+            generate=generate, param_dtype=param_dtype)
         ver = ModelVersion(name, version_no, model, batcher, source,
                            latency_slo_ms=latency_slo_ms)
 

@@ -46,6 +46,29 @@ from deeplearning4j_tpu.parallel.zero import (FsdpParamView,
 #: parameter residency modes a ServingBatcher understands
 MODES = ("dense", "sharded", "fsdp")
 
+#: low-precision residency storage dtypes (``register(param_dtype=)``)
+PARAM_DTYPES = ("bf16", "int8")
+
+#: per-dtype dequant scales of an int8-at-rest entry, riding beside
+#: FSDP_KEY in the placed tree (replicated f32 scalars)
+QSCALE_KEY = "__qscale__"
+
+
+def resolve_param_dtype(param_dtype) -> Optional[str]:
+    """Normalize a ``param_dtype`` knob to ``None`` (full-precision
+    residency), ``"bf16"`` or ``"int8"``."""
+    if param_dtype is None:
+        return None
+    s = str(param_dtype).lower()
+    if s in ("", "f32", "fp32", "float32", "dense"):
+        return None
+    if s in ("bf16", "bfloat16"):
+        return "bf16"
+    if s in ("int8", "i8"):
+        return "int8"
+    raise ValueError(f"param_dtype must be one of {PARAM_DTYPES} "
+                     f"(or None/'float32'), got {param_dtype!r}")
+
 
 def serving_tp_specs(mesh, dense_params,
                      model_axis: str = DEFAULT_MODEL_AXIS,
@@ -62,9 +85,54 @@ def serving_tp_specs(mesh, dense_params,
             for k, sub in inferred.items()}
 
 
+def _quantize_flat(flat):
+    """Symmetric int8 quantization of one float flat vector. Returns
+    ``(q, scale)`` with ``q = round(flat / scale)`` clipped to ±127 and
+    ``scale`` an f32 scalar (1.0 for an all-zero vector)."""
+    import jax.numpy as jnp
+    v = jnp.asarray(flat)
+    amax = float(jnp.max(jnp.abs(v)))
+    scale = np.float32(amax / 127.0 if amax > 0 else 1.0)
+    q = jnp.clip(jnp.round(v / scale), -127, 127).astype(jnp.int8)
+    return q, scale
+
+
+def _store_low_precision(flat_tree, storage: str):
+    """Apply the at-rest storage dtype to an fsdp-flat tree BEFORE
+    placement. ``bf16`` casts float flats (and tp float leaves) to
+    bfloat16; ``int8`` quantizes each float flat against a per-flat
+    symmetric scale (tp leaves fall back to bf16 — their gather path
+    bypasses the flat dequant). Returns ``(tree, scales)`` with
+    ``scales[entry][dtype_key] -> np.float32``."""
+    import jax.numpy as jnp
+
+    from deeplearning4j_tpu.common.dtypes import cast_floats
+    from deeplearning4j_tpu.parallel.zero import FSDP_KEY, TP_KEY, is_fsdp
+    out, scales = {}, {}
+    for k, sub in flat_tree.items():
+        if not is_fsdp(sub):
+            out[k] = sub
+            continue
+        flats, entry_scales = {}, {}
+        for dt, v in sub[FSDP_KEY].items():
+            if not jnp.issubdtype(jnp.asarray(v).dtype, jnp.floating):
+                flats[dt] = v
+            elif storage == "bf16":
+                flats[dt] = jnp.asarray(v).astype(jnp.bfloat16)
+            else:
+                flats[dt], entry_scales[dt] = _quantize_flat(v)
+        new = {FSDP_KEY: flats}
+        if TP_KEY in sub:
+            new[TP_KEY] = cast_floats(sub[TP_KEY], jnp.bfloat16)
+        out[k] = new
+        if entry_scales:
+            scales[k] = entry_scales
+    return out, scales
+
+
 def serving_layouts(mesh, dense_params, mode: str,
                     tensor_parallel: Optional[int] = None, *,
-                    name: str = "model"
+                    name: str = "model", param_dtype=None
                     ) -> Tuple[dict, dict, dict]:
     """Place a dense param tree resident-sharded for serving.
 
@@ -73,10 +141,16 @@ def serving_layouts(mesh, dense_params, mode: str,
     :class:`~deeplearning4j_tpu.learning.updaters.DpFlatSpec` map, and
     the serving tp specs (empty off the tp path). ``tensor_parallel``
     defaults to the mesh's ``model``-axis extent; pass 1 to force
-    dp-only sharding on a 2D mesh."""
+    dp-only sharding on a 2D mesh.
+
+    ``param_dtype`` (``"bf16"`` | ``"int8"``) stores the resident flats
+    low-precision — half (bf16) or a quarter (int8 + per-flat scale)
+    of the dense bytes per chip; :func:`serving_param_view` restores
+    float32 compute post-gather through ``FsdpParamView.cast``."""
     if mode not in MODES or mode == "dense":
         raise ValueError(f"serving residency mode must be one of "
                          f"{MODES[1:]}, got {mode!r}")
+    storage = resolve_param_dtype(param_dtype)
     tp = int(mesh.shape.get(DEFAULT_MODEL_AXIS, 1)
              if tensor_parallel is None else tensor_parallel)
     if tp > 1 and mesh.shape.get(DEFAULT_MODEL_AXIS, 1) != tp:
@@ -89,8 +163,20 @@ def serving_layouts(mesh, dense_params, mode: str,
     flat, fsdp_specs = params_to_fsdp(
         dense_params, n_shards,
         tp_specs={k: tuple(sub) for k, sub in tp_specs.items()})
+    scales = {}
+    if storage is not None:
+        flat, scales = _store_low_precision(flat, storage)
     placed = place_fsdp_params(mesh, flat, DEFAULT_DATA_AXIS,
                                tp_specs=tp_specs)
+    if scales:
+        import jax
+
+        from deeplearning4j_tpu.parallel.zero import replicated
+        full = replicated(mesh)
+        for k, entry_scales in scales.items():
+            placed[k] = {**placed[k],
+                         QSCALE_KEY: {dt: jax.device_put(s, full)
+                                      for dt, s in entry_scales.items()}}
     if telemetry.enabled():
         telemetry.gauge(
             "dl4j_serving_param_resident_bytes",
@@ -101,19 +187,52 @@ def serving_layouts(mesh, dense_params, mode: str,
     return placed, fsdp_specs, tp_specs
 
 
-def serving_param_view(placed, fsdp_specs, mesh, tp_specs, mode: str):
+def _dequantize_tree(placed):
+    """Trace-time inverse of the int8 at-rest quantization: each flat
+    with a :data:`QSCALE_KEY` scale dequantizes to float32 on its 1/N
+    resident shard (before the all-gather, so the wire carries f32 but
+    the resident bytes stayed int8). Entries without scales pass
+    through untouched."""
+    import jax.numpy as jnp
+
+    from deeplearning4j_tpu.parallel.zero import FSDP_KEY
+    out = {}
+    for k, sub in placed.items():
+        if not (isinstance(sub, dict) and QSCALE_KEY in sub):
+            out[k] = sub
+            continue
+        sc = sub[QSCALE_KEY]
+        flats = {dt: (v.astype(jnp.float32) * sc[dt] if dt in sc else v)
+                 for dt, v in sub[FSDP_KEY].items()}
+        out[k] = {**{kk: vv for kk, vv in sub.items()
+                     if kk != QSCALE_KEY},
+                  FSDP_KEY: flats}
+    return out
+
+
+def serving_param_view(placed, fsdp_specs, mesh, tp_specs, mode: str,
+                       param_dtype=None):
     """The params object the jitted serving forward consumes (traced
     inside jit, once per XLA signature).
 
     ``fsdp``: the lazy :class:`FsdpParamView` — each entry's gather is
     emitted where the forward walk touches it. ``sharded``: the same
     view, eagerly materialized into a dense dict up front, so XLA sees
-    one gather wall before any compute (ZeRO-1 shape)."""
-    view = FsdpParamView(placed, fsdp_specs, mesh, DEFAULT_DATA_AXIS,
+    one gather wall before any compute (ZeRO-1 shape).
+
+    With a low-precision ``param_dtype`` the int8 flats dequantize on
+    their resident shards and the view is re-cast float32 through
+    :meth:`FsdpParamView.cast`, so the forward math runs full-precision
+    on values that round-tripped the storage dtype once."""
+    storage = resolve_param_dtype(param_dtype)
+    tree = _dequantize_tree(placed) if storage == "int8" else placed
+    view = FsdpParamView(tree, fsdp_specs, mesh, DEFAULT_DATA_AXIS,
                          prefetch=(mode == "fsdp"),
                          tp_specs=tp_specs)
+    if storage is not None:
+        view = view.cast(np.float32)
     if mode == "sharded":
-        return {k: view.get(k) for k in placed}
+        return {k: view.get(k) for k in tree}
     return view
 
 
@@ -127,9 +246,10 @@ def resident_param_bytes(placed) -> int:
 
 def densify(placed, fsdp_specs) -> dict:
     """Host-side inverse of :func:`serving_layouts` (checkpoint /
-    teardown boundaries)."""
+    teardown boundaries). Int8-at-rest flats dequantize first; bf16
+    flats densify as bf16 (cast back at the caller if needed)."""
     from deeplearning4j_tpu.parallel.zero import params_to_dense
-    return params_to_dense(placed, fsdp_specs)
+    return params_to_dense(_dequantize_tree(placed), fsdp_specs)
 
 
 def assert_mode(mode: str) -> str:

@@ -164,8 +164,9 @@ class ModelSerializer:
             net.states = _merge_flat(net.states,
                                      _read_npz(zf, STATE_ENTRY))
         if load_updater and UPDATER_ENTRY in zf.namelist():
-            net.updater_states = _merge_flat(
-                net.updater_states, _read_npz(zf, UPDATER_ENTRY))
+            flat = _read_npz(zf, UPDATER_ENTRY)
+            net.updater_states = _graft_encoded(
+                _merge_flat(net.updater_states, flat), flat)
         meta = json.loads(zf.read(META_ENTRY).decode()) \
             if META_ENTRY in zf.namelist() else {}
         net.iteration_count = meta.get("iteration_count", 0)
@@ -179,6 +180,36 @@ class ModelSerializer:
                 return None
             return Normalizer.from_map(
                 json.loads(zf.read(NORMALIZER_ENTRY).decode()))
+
+
+def _graft_encoded(tree, flat: dict):
+    """Re-attach encoded-rung subtrees the dense template has no slot
+    for. A fresh net's updater states carry only the optimizer's own
+    slots, so ``_merge_flat`` would silently drop the ``__encoded__``
+    error-feedback residual (+ tau/step/sparsity) that
+    ``states_to_dense`` wrote; graft those npz keys back so encoded
+    checkpoints restore bitwise on any device count."""
+    from deeplearning4j_tpu.learning.updaters import ENCODED_KEY
+    marker = f"/{ENCODED_KEY}/"
+    extras: dict = {}
+    for key, value in flat.items():
+        entry, _, rest = key.partition(marker)
+        if not rest:
+            continue
+        node = extras.setdefault(entry, {})
+        parts = rest.split("/")
+        for p in parts[:-1]:
+            node = node.setdefault(p, {})
+        node[parts[-1]] = jnp.asarray(value)
+    if not extras:
+        return tree
+    out = dict(tree)
+    for entry, enc in extras.items():
+        base = out.get(entry)
+        base = dict(base) if isinstance(base, dict) else {}
+        base[ENCODED_KEY] = enc
+        out[entry] = base
+    return out
 
 
 def _merge_flat(template_tree, flat: dict):

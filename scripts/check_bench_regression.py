@@ -62,7 +62,13 @@ its per-leg ``step_seconds`` / ``stage_idle_ms`` lower-is-better and
 ``throughput_rows_per_s`` higher-is-better via the usual rules, plus
 ``bubble_fraction`` and any scalar ``residency`` figure
 lower-is-better — the 1F1B claim is "same bubble as GPipe, strictly
-lower peak activation residency, no throughput give-back".
+lower peak activation residency, no throughput give-back".  The
+ISSUE-20 ``encoded`` block gates its ``wire_bytes`` /
+``bytes_per_step`` (both arms and the ``dense_wire_bytes``
+counterfactual) lower-is-better and ``compression_ratio``
+higher-is-better — the compressed-collective claim is "strictly
+fewer bytes on the data axis at the same step count, loss curve
+within tolerance of uncompressed".
 
 When baseline and fresh disagree on ``meta.proxy`` (one is a
 CPU-proxy round, the other a real-chip round) the comparison is
@@ -84,13 +90,13 @@ import sys
 HIGHER_BETTER = ("value", "tflops", "throughput", "_ips", "_rps",
                  "efficiency", "savings_ratio", "pct_of_roof",
                  "speedup", "bytes_ratio", "goodput", "in_slo_pct",
-                 "occupancy")
+                 "occupancy", "compression_ratio")
 #: metrics where smaller is better
 LOWER_BETTER = ("_ms", "_us", "_seconds", "overhead", "stall", "skew",
                 "_bytes_per_chip", "lost_steps", "cross_axis",
                 "model_axis_update_bytes", "temp_bytes",
                 "bytes_accessed", "shed", "bubble_fraction",
-                "residency")
+                "residency", "wire_bytes", "bytes_per_step")
 #: keys that are identity/config, never compared; "canary" keys are
 #: clock-path checks documented as dispatch-noise-dominated
 SKIP = ("metric", "unit", "n_trials", "vs_baseline", "meta", "min",

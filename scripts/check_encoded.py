@@ -1,0 +1,162 @@
+#!/usr/bin/env python
+"""CI gate: the encoded-rung equivalence-and-compression contract.
+
+Holds the ISSUE-20 acceptance bar on the virtual 8-device CPU mesh:
+
+1. **Encoded trains** — ``update_exchange="encoded"`` resolves to the
+   ENCODED rung on the real fit path and the 10-step loss trajectory
+   actually descends (error-feedback residuals doing their job, not
+   a silent dense fallback).
+2. **Compression** — ``exchange_report`` at the observed sparsity
+   shows ``encoded_wire_bytes`` strictly below the dense
+   counterfactual for the same step.
+3. **Telemetry live** — the ``dl4j_dp_encoding_sparsity`` gauge
+   carries the live per-step transmitted fraction (0 < s <= 1), the
+   ``dl4j_encoded_wire_bytes_total`` counter accumulated codec bytes,
+   and ``dl4j_encoded_compression_ratio`` reads > 1.
+4. **Zero cross-axis bytes** — encoded ×tp on a 2D ``(data, model)``
+   mesh keeps the compressed dp exchange entirely off the model axis
+   (the ``dl4j_update_exchange_axis_bytes_total`` model series
+   stays 0).
+
+Usage: JAX_PLATFORMS=cpu python scripts/check_encoded.py
+Exit 0 = gate holds, 1 = a clause failed.
+"""
+from __future__ import annotations
+
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "") +
+                           " --xla_force_host_platform_device_count=8")
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+import numpy as np  # noqa: E402
+
+
+def _net(seed=0, n_in=16, hidden=32, n_out=4):
+    from deeplearning4j_tpu.activations import Activation
+    from deeplearning4j_tpu.learning.updaters import Adam
+    from deeplearning4j_tpu.lossfunctions import LossFunction
+    from deeplearning4j_tpu.nn.conf.builders import NeuralNetConfiguration
+    from deeplearning4j_tpu.nn.conf.inputs import InputType
+    from deeplearning4j_tpu.nn.conf.layers import DenseLayer, OutputLayer
+    from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+    from deeplearning4j_tpu.nn.weights import WeightInit
+    conf = (NeuralNetConfiguration.Builder()
+            .seed(seed).updater(Adam(1e-2))
+            .weight_init(WeightInit.XAVIER)
+            .list()
+            .layer(DenseLayer(n_in=n_in, n_out=hidden,
+                              activation=Activation.TANH))
+            .layer(OutputLayer(n_out=n_out,
+                               loss_function=LossFunction.MCXENT,
+                               activation=Activation.SOFTMAX))
+            .set_input_type(InputType.feed_forward(n_in))
+            .build())
+    return MultiLayerNetwork(conf).init()
+
+
+def _iterator(n=64, n_in=16, n_out=4, batch=32):
+    from deeplearning4j_tpu.datasets.dataset import DataSet
+    from deeplearning4j_tpu.datasets.iterators import ListDataSetIterator
+    rng = np.random.RandomState(0)
+    x = rng.randn(n, n_in).astype(np.float32)
+    y = np.eye(n_out, dtype=np.float32)[rng.randint(0, n_out, n)]
+    return ListDataSetIterator(DataSet(x, y), batch_size=batch)
+
+
+def main() -> int:
+    import jax
+
+    from deeplearning4j_tpu.common import telemetry
+    from deeplearning4j_tpu.common.telemetry import MetricsRegistry
+    from deeplearning4j_tpu.parallel import ParallelWrapper
+    from deeplearning4j_tpu.parallel.mesh import make_mesh
+    from deeplearning4j_tpu.parallel.zero import (UpdateExchange,
+                                                  exchange_report)
+
+    if len(jax.devices()) < 8:
+        print("FAIL: needs the virtual 8-device mesh "
+              "(xla_force_host_platform_device_count=8)")
+        return 1
+    MetricsRegistry._reset_for_tests()
+    failures = []
+
+    # -- clauses 1-3: encoded trains, compresses, and reports ---------
+    net = _net()
+    it = _iterator()
+    pw = ParallelWrapper.Builder(net).workers(8) \
+        .update_exchange("encoded").build()
+    loss0 = None
+    for epoch in range(5):                     # 5 epochs x 2 batches
+        pw.fit(it)
+        if loss0 is None:
+            loss0 = float(net.score(_iterator().next()))
+    loss1 = float(net.score(_iterator().next()))
+    if pw.update_exchange is not UpdateExchange.ENCODED:
+        failures.append(f"clause 1: resolved {pw.update_exchange}, "
+                        f"not ENCODED")
+    if not loss1 < loss0:
+        failures.append(f"clause 1: loss did not descend "
+                        f"({loss0:.4f} -> {loss1:.4f})")
+    print(f"clause 1: encoded rung trained, loss {loss0:.4f} -> "
+          f"{loss1:.4f}")
+
+    sp = pw._observed_encoding_sparsity()
+    rep = exchange_report(net.params, 8, UpdateExchange.ENCODED,
+                          encoding=pw.encoding, observed_sparsity=sp)
+    if not rep["encoded_wire_bytes"] < rep["dense_wire_bytes"]:
+        failures.append(
+            f"clause 2: encoded wire {rep['encoded_wire_bytes']} not "
+            f"< dense {rep['dense_wire_bytes']}")
+    print(f"clause 2: encoded wire {rep['encoded_wire_bytes']} B < "
+          f"dense {rep['dense_wire_bytes']} B "
+          f"({rep['compression_ratio']:.1f}x)")
+
+    scheme = pw.encoding.scheme
+    g = telemetry.gauge("dl4j_dp_encoding_sparsity", "").value(
+        scheme=scheme)
+    wire = telemetry.counter(
+        "dl4j_encoded_wire_bytes_total", "").value(scheme=scheme)
+    ratio = telemetry.gauge(
+        "dl4j_encoded_compression_ratio", "").value(scheme=scheme)
+    if g is None or not (0.0 < float(g) <= 1.0):
+        failures.append(f"clause 3: sparsity gauge not live ({g})")
+    if not wire or wire <= 0:
+        failures.append(f"clause 3: wire-bytes counter at {wire}")
+    if ratio is None or float(ratio) <= 1.0:
+        failures.append(f"clause 3: compression ratio gauge {ratio}")
+    print(f"clause 3: sparsity gauge {g}, wire counter {wire} B, "
+          f"ratio gauge {ratio}")
+
+    # -- clause 4: encoded x tp keeps the model axis silent -----------
+    MetricsRegistry._reset_for_tests()
+    mesh2 = make_mesh({"data": 4, "model": 2}, jax.devices()[:8])
+    net2 = _net(seed=7)
+    pw2 = ParallelWrapper.Builder(net2).workers(8) \
+        .update_exchange("encoded").mesh(mesh2).tensor_parallel(2) \
+        .build()
+    pw2.fit(_iterator())
+    axis_c = telemetry.counter(
+        "dl4j_update_exchange_axis_bytes_total", "")
+    data_b = axis_c.value(axis="data") or 0
+    model_b = axis_c.value(axis="model") or 0
+    if pw2.update_exchange is not UpdateExchange.ENCODED:
+        failures.append(f"clause 4: 2D resolved {pw2.update_exchange}")
+    if not (data_b > 0 and model_b == 0):
+        failures.append(f"clause 4: axis bytes data={data_b} "
+                        f"model={model_b} (model axis must stay 0)")
+    print(f"clause 4: axis bytes data={data_b} model={model_b}")
+
+    if failures:
+        for f in failures:
+            print(f"FAIL {f}")
+        return 1
+    print("encoded gate: all clauses hold")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -69,7 +69,15 @@
 #      env-registry, metric-registry, spec-invariants) over the whole
 #      tree must surface no finding outside the checked-in baseline,
 #      and no rule's finding count may grow past its baselined count
-#      (the ISSUE 19 acceptance bar, scripts/dl4j_lint).
+#      (the ISSUE 19 acceptance bar, scripts/dl4j_lint);
+#  13. encoded-rung equivalence-and-compression gate: the ENCODED
+#      update exchange must train on the real fit path (loss
+#      descends), exchange_report must show encoded_wire_bytes
+#      strictly below the dense counterfactual, the live sparsity
+#      gauge/wire counter/compression-ratio series must be populated,
+#      and encoded ×tp on a 2D mesh must keep the compressed dp
+#      exchange entirely off the model axis (the ISSUE 20 acceptance
+#      bar, scripts/check_encoded.py).
 #
 # Usage: scripts/ci_check.sh [--threshold PCT]     (default 10)
 # Exit 0 = all gates clean, 1 = a gate failed, 2 = bad usage.
@@ -145,5 +153,8 @@ JAX_PLATFORMS=cpu python -m pytest tests/test_pipeline.py -q \
 echo "== static analysis gate =="
 python -m scripts.dl4j_lint \
     --baseline scripts/dl4j_lint_baseline.json || fail=1
+
+echo "== encoded-rung compression gate =="
+JAX_PLATFORMS=cpu python scripts/check_encoded.py || fail=1
 
 exit $fail

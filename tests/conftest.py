@@ -31,7 +31,7 @@ _MESH_ONLY_MODULES = {
     "test_parallel", "test_tensor_parallel", "test_pipeline_parallel",
     "test_pipeline", "test_expert_parallel", "test_transformer_5d",
     "test_update_sharding", "test_fsdp", "test_elastic",
-    "test_2d_parallel", "test_serving_sharded",
+    "test_2d_parallel", "test_serving_sharded", "test_encoded",
 }
 
 
